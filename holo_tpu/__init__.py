@@ -1,0 +1,22 @@
+"""holo_tpu — a TPU-native routing-protocol framework.
+
+A from-scratch rebuild of the capabilities of `holo-routing/holo` (IP routing
+protocol suite: OSPFv2/v3, IS-IS, BGP, LDP, RIP, BFD, VRRP, IGMP with
+YANG-modeled transactional management), re-architected TPU-first:
+
+- The link-state SPF hot path (reference: `holo-ospf/src/spf.rs`,
+  `holo-isis/src/spf.rs`) runs behind a pluggable ``SpfBackend``. The TPU
+  backend marshals the LSDB into padded ELL adjacency tensors and executes
+  batched min-plus SSSP + ECMP next-hop extraction under JAX/XLA
+  (:mod:`holo_tpu.ops`), with what-if link-failure batches vmapped and
+  node-axis sharding over a `jax.sharding.Mesh` (:mod:`holo_tpu.parallel`).
+- The scalar CPU SPF (reference Dijkstra semantics) remains the default and
+  the bit-identical parity oracle (:mod:`holo_tpu.spf.scalar`).
+- Protocol machinery (actors, timers, ibus, packet codecs, FSMs) lives in
+  :mod:`holo_tpu.protocols` / :mod:`holo_tpu.utils`, with a C++ native
+  runtime core under ``native/``.
+- Management: YANG-modeled transactional config (:mod:`holo_tpu.yang`,
+  :mod:`holo_tpu.northbound`) served over gRPC by :mod:`holo_tpu.daemon`.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,29 @@
+"""TPU compute kernels for the SPF hot path.
+
+Reference hot loops this package replaces (see SURVEY.md §3.3):
+- OSPF Dijkstra: /root/reference/holo-ospf/src/spf.rs:587-729
+- IS-IS SPT:     /root/reference/holo-isis/src/spf.rs:527-709
+
+Design: instead of a scalar priority-queue Dijkstra, distances are computed by
+masked int32 min-plus relaxation over a padded ELL (in-edge) adjacency layout —
+each round is a dense gather + add + row-min that XLA maps onto the TPU VPU,
+and the round count equals the shortest-path hop diameter (small for real
+topologies).  ECMP next-hop sets are extracted as bitmask propagation over the
+shortest-path DAG, and what-if link failures batch along a vmapped edge-mask
+axis.  All arithmetic is exact int32, enabling bit-identical parity with the
+scalar reference semantics.
+"""
+
+from holo_tpu.ops.graph import INF, EllGraph, Topology, build_ell
+from holo_tpu.ops.spf_engine import SpfTensors, spf_one, spf_whatif_batch, sssp_distances
+
+__all__ = [
+    "INF",
+    "EllGraph",
+    "Topology",
+    "build_ell",
+    "SpfTensors",
+    "spf_one",
+    "spf_whatif_batch",
+    "sssp_distances",
+]

@@ -1,0 +1,219 @@
+"""Jitted SPF engine: exact int32 SSSP + ECMP next-hop extraction.
+
+Replaces the reference's scalar Dijkstra (holo-ospf/src/spf.rs:587-729,
+holo-isis/src/spf.rs:527-709) with fixed-point tensor iterations:
+
+1. Distances: masked min-plus relaxation over the ELL in-edge layout
+   (Bellman-Ford).  Each round is one gather + add + row-min on the VPU;
+   rounds needed = shortest-path hop diameter.
+2. Shortest-path DAG: edge (u→v) is on the DAG iff dist[u] + w == dist[v].
+3. ``hops`` (router-hop count from root) via the reference's first-parent
+   rule: the parent popped earliest from the candidate BTreeMap is the DAG
+   parent minimizing (dist[u], u) (holo-ospf/src/spf.rs:614-622, 676-706);
+   ``hops`` increments only when the target vertex is a router
+   (holo-ospf/src/spf.rs:673-677).
+4. ECMP next-hop sets as uint32 bitmasks over "next-hop atoms" (protocol
+   layer's (interface, address) table): a DAG parent with hops==0
+   contributes the edge's precomputed direct atom, any other DAG parent
+   contributes its own set — exactly calc_nexthops' direct-vs-inherit split
+   (holo-ospf/src/spf.rs:733-767); equal-cost parents union
+   (spf.rs:710-717 `nexthops.extend`).
+
+All int32, exact; results are bit-comparable against the scalar oracle
+(:mod:`holo_tpu.spf.scalar`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from holo_tpu.ops.graph import INF, EllGraph
+
+
+class DeviceGraph(NamedTuple):
+    """Pure-array pytree handed to jitted SPF programs."""
+
+    in_src: jax.Array  # int32[N, K]
+    in_cost: jax.Array  # int32[N, K]
+    in_valid: jax.Array  # bool[N, K]
+    in_edge_id: jax.Array  # int32[N, K]
+    direct_nh_words: jax.Array  # uint32[N, K, W] one-hot atom bitmask (0 if none)
+    is_router: jax.Array  # bool[N]
+
+
+class SpfTensors(NamedTuple):
+    """Result of one SPF run (or a batch thereof, with a leading axis)."""
+
+    dist: jax.Array  # int32[N]; INF if unreachable
+    parent: jax.Array  # int32[N]; chosen first parent, N (sentinel) if none
+    hops: jax.Array  # int32[N]; router hops from root (first-parent rule)
+    nexthops: jax.Array  # uint32[N, W] atom bitmask
+
+
+def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
+    """Expand per-slot direct atoms into one-hot bitmask words (host side)."""
+    n, k = ell.in_src.shape
+    w = max((ell.n_atoms + 31) // 32, 1)
+    words = np.zeros((n, k, w), np.uint32)
+    atom = ell.in_direct_atom
+    has = atom >= 0
+    rows, cols = np.nonzero(has)
+    a = atom[rows, cols]
+    words[rows, cols, a // 32] = np.uint32(1) << (a % 32).astype(np.uint32)
+    return DeviceGraph(
+        in_src=jnp.asarray(ell.in_src),
+        in_cost=jnp.asarray(ell.in_cost),
+        in_valid=jnp.asarray(ell.in_valid),
+        in_edge_id=jnp.asarray(ell.in_edge_id),
+        direct_nh_words=jnp.asarray(words),
+        is_router=jnp.asarray(ell.is_router),
+    )
+
+
+def _slot_mask(g: DeviceGraph, edge_mask: jax.Array | None) -> jax.Array:
+    """bool[N,K]: usable in-edge slots under the scenario's edge mask."""
+    ok = g.in_valid
+    # Skip the gather for edgeless graphs (shape is static under trace);
+    # every slot is already invalid in that case.
+    if edge_mask is not None and edge_mask.shape[0] > 0:
+        ok = ok & edge_mask[g.in_edge_id]
+    return ok
+
+
+def sssp_distances(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+) -> jax.Array:
+    """Exact shortest-path distances from ``root`` (int32[N], INF unreachable)."""
+    n = g.in_src.shape[0]
+    ok = _slot_mask(g, edge_mask)
+    dist0 = jnp.full((n,), INF, jnp.int32).at[root].set(0)
+    limit = n if max_iters is None else max_iters
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        dist, _, it = carry
+        d_nbr = dist[g.in_src]  # [N, K]
+        usable = ok & (d_nbr < INF)
+        cand = jnp.where(usable, d_nbr + g.in_cost, INF)
+        new = jnp.minimum(dist, cand.min(axis=1))
+        return new, jnp.any(new != dist), it + 1
+
+    dist, _, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True), 0))
+    return dist
+
+
+def _sp_dag(g: DeviceGraph, dist: jax.Array, ok: jax.Array, root: jax.Array):
+    """bool[N,K]: slot k is a shortest-path-DAG in-edge of vertex v."""
+    d_nbr = dist[g.in_src]
+    dag = (
+        ok
+        & (d_nbr < INF)
+        & (dist < INF)[:, None]
+        & (d_nbr + g.in_cost == dist[:, None])
+    )
+    # The root has no DAG parents (dist 0; zero-cost network→router edges
+    # cannot close a zero cycle since router→network costs are >= 1).
+    return dag & (jnp.arange(g.in_src.shape[0]) != root)[:, None]
+
+
+def spf_one(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """Full SPF: distances + first-parent + hops + ECMP next-hop bitmasks."""
+    n, k = g.in_src.shape
+    ok = _slot_mask(g, edge_mask)
+    dist = sssp_distances(g, root, edge_mask, max_iters)
+    dag = _sp_dag(g, dist, ok, root)
+    d_nbr = dist[g.in_src]
+
+    # First parent = DAG parent minimizing (dist[u], u): two-stage lex argmin.
+    dmin = jnp.where(dag, d_nbr, INF).min(axis=1)  # int32[N]
+    src_cand = jnp.where(dag & (d_nbr == dmin[:, None]), g.in_src, n)
+    parent = src_cand.min(axis=1).astype(jnp.int32)  # n = no parent
+
+    limit = n if max_iters is None else max_iters
+
+    # hops fixpoint along the first-parent chain.
+    big = jnp.int32(n + 1)
+    hops0 = jnp.where(jnp.arange(n) == root, 0, big).astype(jnp.int32)
+    inc = g.is_router.astype(jnp.int32)
+    parent_safe = jnp.minimum(parent, n - 1)
+    has_parent = parent < n
+
+    def hcond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def hbody(carry):
+        hops, _, it = carry
+        ph = jnp.where(has_parent, hops[parent_safe], big)
+        new = jnp.minimum(hops, jnp.where(ph < big, ph + inc, big))
+        return new, jnp.any(new != hops), it + 1
+
+    hops, _, _ = jax.lax.while_loop(hcond, hbody, (hops0, jnp.bool_(True), 0))
+
+    # Next-hop bitmask fixpoint over the full DAG (all equal-cost parents).
+    w = g.direct_nh_words.shape[2]
+    nh0 = jnp.zeros((n, w), jnp.uint32)
+    use_direct = (hops[g.in_src] == 0)[:, :, None]  # [N,K,1]
+    direct = jnp.where(dag[:, :, None], g.direct_nh_words, jnp.uint32(0))
+
+    def ncond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def nbody(carry):
+        nh, _, it = carry
+        inherit = jnp.where(dag[:, :, None], nh[g.in_src], jnp.uint32(0))
+        contrib = jnp.where(use_direct, direct, inherit)  # [N,K,W]
+        new = nh | jax.lax.reduce(
+            contrib, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+        )
+        return new, jnp.any(new != nh), it + 1
+
+    nh, _, _ = jax.lax.while_loop(ncond, nbody, (nh0, jnp.bool_(True), 0))
+
+    return SpfTensors(
+        dist=dist, parent=parent, hops=jnp.where(dist < INF, hops, big), nexthops=nh
+    )
+
+
+def spf_whatif_batch(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_masks: jax.Array,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """Batched what-if SPF: vmap over scenario edge masks (bool[B, E]).
+
+    This is the framework's data-parallel axis — e.g. 1024 concurrent
+    link-failure studies over one LSDB (BASELINE.md config 5).  Remember to
+    mask *both* directions of a failed link.
+    """
+    fn = jax.vmap(lambda m: spf_one(g, root, m, max_iters))
+    return fn(edge_masks)
+
+
+def spf_multiroot(
+    g: DeviceGraph,
+    roots: jax.Array,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """SPF from many roots (int32[R]) — e.g. per-neighbor SPTs for IS-IS
+    flooding reduction (holo-isis/src/flooding/manet.rs:39-97) or TI-LFA."""
+    fn = jax.vmap(lambda r: spf_one(g, r, edge_mask, max_iters))
+    return fn(roots)

@@ -1,0 +1,18 @@
+"""SPF backends: scalar CPU reference (default) and TPU/JAX engine (opt-in).
+
+Mirrors the reference's dispatch shape: the SPF-delay FSM's compute call
+(holo-ospf/src/spf.rs:428-435) is the single point where a backend is invoked,
+so protocols are backend-agnostic.  The scalar backend IS the semantics spec;
+the TPU backend must match it bit-for-bit (tests/test_spf_parity.py).
+"""
+
+from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend, SpfResult, TpuSpfBackend
+from holo_tpu.spf.scalar import spf_reference
+
+__all__ = [
+    "SpfBackend",
+    "SpfResult",
+    "ScalarSpfBackend",
+    "TpuSpfBackend",
+    "spf_reference",
+]

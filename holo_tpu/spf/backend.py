@@ -1,0 +1,161 @@
+"""Pluggable SPF backends.
+
+``SpfBackend.compute`` is the single dispatch point the protocol layer calls
+from its SPF-delay FSM (the reference's compute site: holo-ospf/src/spf.rs:428-435).
+The scalar backend is the default (reference semantics, zero marshaling
+latency — the right choice for small LSDBs); the TPU backend wins on large
+LSDBs and on batched what-if / multi-root workloads, which the scalar path
+can only do serially.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from holo_tpu.ops.graph import Topology, build_ell
+from holo_tpu.ops.spf_engine import (
+    DeviceGraph,
+    device_graph_from_ell,
+    spf_multiroot,
+    spf_one,
+    spf_whatif_batch,
+)
+from holo_tpu.spf.scalar import spf_reference
+
+
+@dataclass
+class SpfResult:
+    """Backend-independent SPF output in host (numpy) space."""
+
+    dist: np.ndarray  # int32[N]
+    parent: np.ndarray  # int32[N]
+    hops: np.ndarray  # int32[N]
+    nexthop_words: np.ndarray  # uint32[N, W]
+
+
+@dataclass
+class MultiRootResult:
+    """Multi-root SPF output: SPT shape only (see compute_multiroot)."""
+
+    dist: np.ndarray  # int32[R, N]
+    parent: np.ndarray  # int32[R, N]
+    hops: np.ndarray  # int32[R, N]
+
+
+class SpfBackend:
+    """Interface: one SPF run, a what-if batch, or a multi-root batch."""
+
+    name = "abstract"
+
+    def compute(self, topo: Topology, edge_mask: np.ndarray | None = None) -> SpfResult:
+        raise NotImplementedError
+
+    def compute_whatif(self, topo: Topology, edge_masks: np.ndarray) -> list[SpfResult]:
+        raise NotImplementedError
+
+
+class ScalarSpfBackend(SpfBackend):
+    """Default backend: exact reference-semantics Dijkstra on the host CPU."""
+
+    name = "scalar"
+
+    def __init__(self, n_atoms: int = 64):
+        self.n_atoms = n_atoms
+
+    def _one(self, topo: Topology, edge_mask) -> SpfResult:
+        out = spf_reference(topo, edge_mask)
+        return SpfResult(
+            dist=out.dist,
+            parent=out.parent,
+            hops=out.hops,
+            nexthop_words=out.nexthop_words(max(self.n_atoms, topo.n_atoms())),
+        )
+
+    def compute(self, topo, edge_mask=None):
+        return self._one(topo, edge_mask)
+
+    def compute_whatif(self, topo, edge_masks):
+        return [self._one(topo, m) for m in edge_masks]
+
+
+class TpuSpfBackend(SpfBackend):
+    """JAX/XLA backend: jitted tensor SPF, cached per topology generation.
+
+    Marshaling (Topology → ELL → DeviceGraph) happens once per LSDB
+    generation and is reused across runs/batches; jit caches compile per
+    (N, K, W) shape bucket.
+    """
+
+    name = "tpu"
+
+    def __init__(self, n_atoms: int = 64, max_iters: int | None = None):
+        self.n_atoms = n_atoms
+        self.max_iters = max_iters
+        self._cache: tuple[tuple, DeviceGraph] | None = None
+        self._jit_one = jax.jit(lambda g, r, m: spf_one(g, r, m, self.max_iters))
+        self._jit_batch = jax.jit(
+            lambda g, r, ms: spf_whatif_batch(g, r, ms, self.max_iters)
+        )
+        self._jit_multiroot = jax.jit(
+            lambda g, rs, m: spf_multiroot(g, rs, m, self.max_iters)
+        )
+
+    def prepare(self, topo: Topology) -> DeviceGraph:
+        # Keyed by (process-unique uid, generation): in-place mutators must
+        # topo.touch(), and uid reuse across freed objects cannot occur.
+        key = topo.cache_key
+        if self._cache is None or self._cache[0] != key:
+            ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
+            self._cache = (key, device_graph_from_ell(ell))
+        return self._cache[1]
+
+    def _full_mask(self, topo: Topology, edge_mask) -> np.ndarray:
+        if edge_mask is None:
+            return np.ones(topo.n_edges, bool)
+        return np.asarray(edge_mask, bool)
+
+    def compute(self, topo, edge_mask=None):
+        g = self.prepare(topo)
+        out = self._jit_one(g, topo.root, self._full_mask(topo, edge_mask))
+        return SpfResult(
+            dist=np.asarray(out.dist),
+            parent=np.asarray(out.parent),
+            hops=np.asarray(out.hops),
+            nexthop_words=np.asarray(out.nexthops),
+        )
+
+    def compute_whatif(self, topo, edge_masks):
+        g = self.prepare(topo)
+        out = self._jit_batch(g, topo.root, np.asarray(edge_masks, bool))
+        # One bulk device→host transfer per plane: per-scenario slicing of
+        # device arrays would pay the host round-trip B×4 times.
+        dist, parent, hops, nh = (
+            np.asarray(out.dist),
+            np.asarray(out.parent),
+            np.asarray(out.hops),
+            np.asarray(out.nexthops),
+        )
+        return [
+            SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
+            for i in range(edge_masks.shape[0])
+        ]
+
+    def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
+        """Distances/parents/hops from many roots (one device program).
+
+        Next-hop bitmasks are intentionally NOT returned: direct atoms are
+        marshaled relative to ``topo.root``, so they are meaningless for any
+        other root.  Multi-root users (IS-IS flooding reduction, TI-LFA)
+        need the SPT shape only.
+        """
+        g = self.prepare(topo)
+        mask = np.ones(topo.n_edges, bool)
+        out = self._jit_multiroot(g, np.asarray(roots, np.int32), mask)
+        return MultiRootResult(
+            dist=np.asarray(out.dist),
+            parent=np.asarray(out.parent),
+            hops=np.asarray(out.hops),
+        )

@@ -1,0 +1,22 @@
+"""Test config: force a deterministic 8-device virtual CPU mesh.
+
+Must set env before the first `import jax` anywhere in the test process
+(SURVEY-mandated determinism; mirrors the reference's `testing`/
+`deterministic` feature discipline, holo-ospf/Cargo.toml:49-52).
+"""
+
+import os
+
+# The environment pre-imports jax via PYTHONPATH site hooks, so env vars are
+# too late for platform selection — but jax.config still works as long as no
+# backend has been initialized yet.  XLA_FLAGS is read at backend init.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, jax.devices()
