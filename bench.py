@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched full-SPF throughput, TPU vs scalar CPU.
+
+Measures the BASELINE.md north-star workload: full SPF runs/sec on a
+10k-node OSPF-style fat-tree LSDB.  The CPU baseline is the C++ scalar
+candidate-list Dijkstra (reference semantics, native/spf_baseline.cpp) run
+serially over what-if scenarios; the TPU side runs the same scenarios as one
+vmapped batch (distances + first-parent + hops + 64-way ECMP next-hop
+bitmasks per scenario — the same logical outputs).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    small = "--small" in sys.argv
+    k = 20 if small else 90  # 500 vs 10,125 vertices
+    n_scenarios = 32 if small else 256
+    cpu_runs = 8 if small else 32
+
+    import jax
+
+    from holo_tpu.native_build import native_spf_batch_dist, spf_baseline_lib
+    from holo_tpu.ops.graph import build_ell
+    from holo_tpu.ops.spf_engine import device_graph_from_ell, spf_whatif_batch
+    from holo_tpu.spf.synth import fat_tree_topology, whatif_link_failure_masks
+
+    topo = fat_tree_topology(k=k, seed=0)
+    masks = whatif_link_failure_masks(topo, n_scenarios, seed=1)
+
+    # --- CPU baseline: serial scalar Dijkstra (C++) over the first scenarios.
+    spf_baseline_lib()  # build/load outside the timed region
+    t0 = time.perf_counter()
+    cpu_dist = native_spf_batch_dist(topo, masks[:cpu_runs])
+    cpu_dt = time.perf_counter() - t0
+    cpu_rps = cpu_runs / cpu_dt
+
+    # --- TPU: one vmapped batch, all scenarios.
+    g = device_graph_from_ell(build_ell(topo))
+    g = jax.device_put(g)
+    masks_dev = jax.device_put(masks)
+    step = jax.jit(lambda gr, ms: spf_whatif_batch(gr, topo.root, ms))
+
+    def sync(o):
+        # On the axon platform block_until_ready returns before execution
+        # finishes; a scalar readback is the reliable completion barrier.
+        return float(o.dist[0, 0])
+
+    out = step(g, masks_dev)
+    sync(out)  # compile + first run
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = step(g, masks_dev)
+        sync(out)
+    tpu_dt = (time.perf_counter() - t0) / reps
+    tpu_rps = n_scenarios / tpu_dt
+
+    # --- Parity gate: scenario results must match the scalar baseline.
+    check = np.asarray(out.dist[:cpu_runs])[:, : topo.n_vertices]
+    if not np.array_equal(check, cpu_dist):
+        print(
+            json.dumps(
+                {
+                    "metric": "ospfv2_full_spf_runs_per_sec_PARITY_FAIL",
+                    "value": 0.0,
+                    "unit": "runs/s",
+                    "vs_baseline": 0.0,
+                }
+            )
+        )
+        return
+
+    print(
+        json.dumps(
+            {
+                "metric": f"ospfv2_full_spf_whatif_runs_per_sec_{topo.n_vertices}v",
+                "value": round(tpu_rps, 2),
+                "unit": "runs/s",
+                "vs_baseline": round(tpu_rps / cpu_rps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

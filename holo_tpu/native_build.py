@@ -1,0 +1,112 @@
+"""Lazy build + ctypes loader for the C++ native components.
+
+The native pieces (scalar SPF baseline now; runtime core as it lands) are
+compiled on first use into ``native/build/`` with g++ — no pip/cmake
+dependency — and loaded via ctypes.  Rebuilds happen automatically when the
+source is newer than the shared object.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+BUILD = NATIVE / "build"
+
+
+def _ensure(so_name: str, sources: list[str], extra: list[str] | None = None) -> Path:
+    BUILD.mkdir(parents=True, exist_ok=True)
+    so = BUILD / so_name
+    srcs = [NATIVE / s for s in sources]
+    if so.exists() and all(so.stat().st_mtime >= s.stat().st_mtime for s in srcs):
+        return so
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        *(extra or []),
+        *[str(s) for s in srcs],
+        "-o",
+        str(so),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so
+
+
+_spf_lib = None
+
+
+def spf_baseline_lib() -> ctypes.CDLL:
+    global _spf_lib
+    if _spf_lib is None:
+        lib = ctypes.CDLL(str(_ensure("libspf_baseline.so", ["spf_baseline.cpp"])))
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C")
+        lib.holo_spf_scalar.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i32p,
+            ctypes.c_void_p, ctypes.c_int32, i32p, i32p, i32p, u64p, u8p,
+        ]
+        lib.holo_spf_scalar.restype = None
+        lib.holo_spf_scalar_batch.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i32p,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, i32p, u8p,
+        ]
+        lib.holo_spf_scalar_batch.restype = None
+        _spf_lib = lib
+    return _spf_lib
+
+
+def native_spf(topo, edge_mask=None):
+    """C++ scalar SPF: returns (dist, parent, hops, nh_u64) numpy arrays."""
+    if topo.n_atoms() > 64:
+        raise ValueError(
+            f"native baseline supports <= 64 next-hop atoms, got {topo.n_atoms()}"
+        )
+    lib = spf_baseline_lib()
+    n, e = topo.n_vertices, topo.n_edges
+    dist = np.empty(n, np.int32)
+    parent = np.empty(n, np.int32)
+    hops = np.empty(n, np.int32)
+    nh = np.empty(n, np.uint64)
+    is_router = np.ascontiguousarray(topo.is_router, np.uint8)
+    mask_p = None
+    if edge_mask is not None:
+        mask_arr = np.ascontiguousarray(edge_mask, np.uint8)
+        mask_p = mask_arr.ctypes.data_as(ctypes.c_void_p)
+    lib.holo_spf_scalar(
+        n, e,
+        np.ascontiguousarray(topo.edge_src),
+        np.ascontiguousarray(topo.edge_dst),
+        np.ascontiguousarray(topo.edge_cost),
+        np.ascontiguousarray(topo.edge_direct_atom),
+        mask_p, topo.root, dist, parent, hops, nh, is_router,
+    )
+    return dist, parent, hops, nh
+
+
+def native_spf_batch_dist(topo, edge_masks) -> np.ndarray:
+    """C++ serial what-if batch (distances only): the CPU baseline workload."""
+    lib = spf_baseline_lib()
+    n, e = topo.n_vertices, topo.n_edges
+    b = edge_masks.shape[0]
+    out = np.empty((b, n), np.int32)
+    masks = np.ascontiguousarray(edge_masks, np.uint8)
+    lib.holo_spf_scalar_batch(
+        n, e,
+        np.ascontiguousarray(topo.edge_src),
+        np.ascontiguousarray(topo.edge_dst),
+        np.ascontiguousarray(topo.edge_cost),
+        np.ascontiguousarray(topo.edge_direct_atom),
+        masks.ctypes.data_as(ctypes.c_void_p), b, topo.root, out,
+        np.ascontiguousarray(topo.is_router, np.uint8),
+    )
+    return out

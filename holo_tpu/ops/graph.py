@@ -15,6 +15,7 @@ reference tie-break.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import NamedTuple
 
@@ -25,7 +26,7 @@ import numpy as np
 # overflow as long as candidate sums are masked before the add (see sssp.py).
 INF = np.int32(1 << 30)
 
-_TOPOLOGY_UIDS = __import__("itertools").count()
+_TOPOLOGY_UIDS = itertools.count()
 
 
 @dataclass

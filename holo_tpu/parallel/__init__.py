@@ -1,0 +1,25 @@
+"""Multi-chip distribution of the SPF engine.
+
+The reference is a single-host concurrent system (SURVEY.md §2.4): its scale
+axes are LSDB size and the number of concurrent SPF problems.  Those map to a
+2-D device mesh here:
+
+- ``batch`` axis — data parallelism over what-if scenarios / multi-root SPTs
+  (each scenario independent; zero cross-device traffic).
+- ``node`` axis — graph-model parallelism: the ELL adjacency rows (and all
+  per-vertex planes) are sharded over devices, the distance vector is
+  replicated, and each relaxation round ends in an all-gather of row-block
+  updates over ICI (tensor-parallel analog).
+
+Shardings are expressed with `jax.sharding.NamedSharding` annotations and the
+program stays a single jitted computation — XLA/GSPMD inserts the collectives
+(all-gathers on the node axis) automatically.
+"""
+
+from holo_tpu.parallel.mesh import (
+    make_spf_mesh,
+    shard_graph,
+    sharded_whatif_step,
+)
+
+__all__ = ["make_spf_mesh", "shard_graph", "sharded_whatif_step"]

@@ -1,0 +1,35 @@
+"""Deterministic test/dry-run environment helpers.
+
+Mirrors the reference's `testing`/`deterministic` feature discipline
+(holo-ospf/Cargo.toml:49-52): one place that knows how to force the
+virtual multi-device CPU platform regardless of the host's default
+(the axon site hook pins JAX_PLATFORMS to the one real TPU chip).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu_mesh(n_devices: int) -> None:
+    """Force an n-device virtual CPU platform before backend init.
+
+    Must run before any JAX backend initializes (jax.devices(), any
+    device_put/jit execution).  Safe to call multiple times.  Raises if the
+    platform was already initialized differently or the count can't be met.
+    """
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices())
+    if have < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {have} ({jax.devices()}); "
+            "XLA_FLAGS with a conflicting xla_force_host_platform_device_count "
+            "was probably set before startup"
+        )

@@ -1,0 +1,126 @@
+// Scalar SPF baseline: candidate-list Dijkstra with the reference semantics
+// (holo-ospf/src/spf.rs:587-729), C++ for an honest CPU baseline against the
+// TPU backend.  Exposed via a C ABI consumed through ctypes
+// (holo_tpu/native_build.py).
+//
+// Semantics mirrored from the scalar Python oracle (holo_tpu/spf/scalar.py):
+// pop order (dist, vertex-id); strictly-better paths re-create the candidate
+// (fresh hops + next-hop set from the improving parent); equal-cost paths
+// union next-hop atoms; parent.hops==0 contributes the edge's direct atom,
+// otherwise the parent's set is inherited.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace {
+constexpr int32_t kInf = 1 << 30;
+}
+
+extern "C" {
+
+// All arrays are caller-allocated.  mask may be null (all edges usable).
+// out_nh is a 64-bit atom bitmask per vertex (n_atoms <= 64 supported here;
+// the TPU backend widens arbitrarily, 64 matches the ECMP cap in
+// BASELINE.md config 3).
+void holo_spf_scalar(int32_t n, int32_t e, const int32_t* src,
+                     const int32_t* dst, const int32_t* cost,
+                     const int32_t* atom, const uint8_t* mask, int32_t root,
+                     int32_t* out_dist, int32_t* out_parent,
+                     int32_t* out_hops, uint64_t* out_nh,
+                     const uint8_t* is_router) {
+  // CSR out-adjacency.
+  std::vector<int32_t> deg(n + 1, 0);
+  for (int32_t i = 0; i < e; ++i)
+    if (!mask || mask[i]) deg[src[i] + 1]++;
+  for (int32_t v = 0; v < n; ++v) deg[v + 1] += deg[v];
+  std::vector<int32_t> adj_dst(deg[n]), adj_cost(deg[n]), adj_atom(deg[n]);
+  {
+    std::vector<int32_t> fill(deg.begin(), deg.end() - 1);
+    for (int32_t i = 0; i < e; ++i) {
+      if (mask && !mask[i]) continue;
+      int32_t p = fill[src[i]]++;
+      adj_dst[p] = dst[i];
+      adj_cost[p] = cost[i];
+      adj_atom[p] = atom ? atom[i] : -1;
+    }
+  }
+
+  struct Cand {
+    int32_t dist, hops, parent;
+    uint64_t nh;
+    bool live;
+  };
+  std::vector<Cand> cand(n, {kInf, 0, 0, 0, false});
+  std::vector<uint8_t> in_spt(n, 0);
+  for (int32_t v = 0; v < n; ++v) {
+    out_dist[v] = kInf;
+    out_parent[v] = n;
+    out_hops[v] = n + 1;
+    out_nh[v] = 0;
+  }
+
+  using Key = std::pair<int32_t, int32_t>;  // (dist, vid): reference pop order
+  std::priority_queue<Key, std::vector<Key>, std::greater<Key>> heap;
+  cand[root] = {0, 0, n, 0, true};
+  heap.push({0, root});
+
+  while (!heap.empty()) {
+    auto [d, v] = heap.top();
+    heap.pop();
+    if (in_spt[v] || !cand[v].live || cand[v].dist != d) continue;  // stale
+    in_spt[v] = 1;
+    out_dist[v] = d;
+    out_hops[v] = cand[v].hops;
+    out_parent[v] = cand[v].parent;
+    out_nh[v] = cand[v].nh;
+    const int32_t v_hops = cand[v].hops;
+    const uint64_t v_nh = cand[v].nh;
+
+    for (int32_t p = deg[v]; p < deg[v + 1]; ++p) {
+      const int32_t u = adj_dst[p];
+      if (in_spt[u]) continue;
+      const int32_t nd = d + adj_cost[p];
+      Cand& c = cand[u];
+      if (c.live) {
+        if (nd > c.dist) continue;
+        if (nd < c.dist) {
+          c = {nd, v_hops + (is_router[u] ? 1 : 0), v, 0, true};
+          heap.push({nd, u});
+        }
+      } else {
+        c = {nd, v_hops + (is_router[u] ? 1 : 0), v, 0, true};
+        heap.push({nd, u});
+      }
+      if (v_hops == 0) {
+        // Atom ids >= 64 would be UB in the shift (and alias mod 64 on
+        // x86); the Python wrapper validates, this guards defensively.
+        if (adj_atom[p] >= 0 && adj_atom[p] < 64)
+          c.nh |= uint64_t(1) << adj_atom[p];
+      } else {
+        c.nh |= v_nh;
+      }
+    }
+  }
+  out_parent[root] = n;
+}
+
+// Batched what-if: run `b` scenarios serially (the CPU reference has no
+// batch parallelism — that asymmetry is the point of the TPU backend).
+void holo_spf_scalar_batch(int32_t n, int32_t e, const int32_t* src,
+                           const int32_t* dst, const int32_t* cost,
+                           const int32_t* atom, const uint8_t* masks,
+                           int32_t b, int32_t root, int32_t* out_dist,
+                           const uint8_t* is_router) {
+  std::vector<int32_t> parent(n), hops(n);
+  std::vector<uint64_t> nh(n);
+  for (int32_t i = 0; i < b; ++i) {
+    holo_spf_scalar(n, e, src, dst, cost, atom, masks ? masks + i * e : nullptr,
+                    root, out_dist + i * n, parent.data(), hops.data(),
+                    nh.data(), is_router);
+  }
+}
+
+}  // extern "C"

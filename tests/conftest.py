@@ -5,18 +5,11 @@ Must set env before the first `import jax` anywhere in the test process
 `deterministic` feature discipline, holo-ospf/Cargo.toml:49-52).
 """
 
-import os
+import sys
+from pathlib import Path
 
-# The environment pre-imports jax via PYTHONPATH site hooks, so env vars are
-# too late for platform selection — but jax.config still works as long as no
-# backend has been initialized yet.  XLA_FLAGS is read at backend init.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import jax  # noqa: E402
+from holo_tpu.testing import force_virtual_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.device_count() == 8, jax.devices()
+force_virtual_cpu_mesh(8)
