@@ -37,7 +37,11 @@ def _ensure(so_name: str, sources: list[str], extra: list[str] | None = None) ->
         "-o",
         str(so),
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}"
+        )
     return so
 
 

@@ -1,0 +1,6 @@
+"""Protocol implementations (SURVEY.md §2.3).
+
+Each protocol is an actor on the shared event loop with the common anatomy
+of the reference crates: packet codecs, FSMs, an instance root, northbound
+glue, and ibus rx/tx.
+"""

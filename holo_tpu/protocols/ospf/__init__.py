@@ -1,0 +1,12 @@
+"""OSPFv2 (RFC 2328) — link-state IGP with the SPF hot path on a pluggable
+backend (scalar CPU default, TPU batch engine opt-in).
+
+Reference crate: holo-ospf (SURVEY.md §2.3).  This implementation follows
+the same anatomy — packet codecs (packet.py), LSDB (lsdb.py), interface ISM
+(interface.py), neighbor NSM (neighbor.py), flooding (flooding.py), SPF
+delay FSM + route calc (spf_run.py), instance actor (instance.py) — but is
+structured for the deterministic event loop and tensor SPF backend.
+
+Round-1 scope: OSPFv2 single/multi-area, p2p + broadcast interfaces,
+null auth, intra-area + inter-area routes; NSSA/virtual-link/GR/SR later.
+"""

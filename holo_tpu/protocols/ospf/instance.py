@@ -1,0 +1,1010 @@
+"""OSPFv2 instance actor: event dispatch, adjacency, flooding, SPF, routes.
+
+Reference anatomy: holo-ospf/src/instance.rs (root state machine),
+events.rs (packet handlers), flood.rs (flooding), spf.rs (delay FSM).
+One actor per instance on the shared event loop; all IO via NetIo; all
+timers via loop timers (virtual-clock testable).
+
+Round-1 scope notes (vs reference): null auth only; no NSSA/virtual links;
+DD packets carry up to DD_CHUNK headers (MTU pagination simplified);
+MaxAge LSAs are removed once flooded with empty retransmission lists.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+from holo_tpu.protocols.ospf.interface import (
+    ElectionView,
+    IfConfig,
+    IfType,
+    IsmState,
+    OspfInterface,
+    elect_dr_bdr,
+)
+from holo_tpu.protocols.ospf.lsdb import (
+    MIN_LS_ARRIVAL,
+    Lsdb,
+    next_seq_no,
+)
+from holo_tpu.protocols.ospf.neighbor import (
+    Neighbor,
+    NsmEvent,
+    NsmState,
+    nsm_transition,
+)
+from holo_tpu.protocols.ospf.packet import (
+    MAX_AGE,
+    DbDesc,
+    DbDescFlags,
+    Hello,
+    Lsa,
+    LsaKey,
+    LsaRouter,
+    LsaNetwork,
+    LsaType,
+    LsAck,
+    LsRequest,
+    LsUpdate,
+    Options,
+    Packet,
+    PacketType,
+    RouterFlags,
+    RouterLink,
+    RouterLinkType,
+)
+from holo_tpu.protocols.ospf.spf_run import build_topology, derive_routes
+from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.utils.ip import ALL_SPF_RTRS_V4, mask_of
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+DD_CHUNK = 64  # LSA headers per DD packet
+LSREQ_CHUNK = 64
+AGE_TICK = 1.0
+
+
+# ===== timer messages =====
+
+
+@dataclass
+class HelloTimerMsg:
+    ifname: str
+
+
+@dataclass
+class WaitTimerMsg:
+    ifname: str
+
+
+@dataclass
+class InactivityTimerMsg:
+    ifname: str
+    nbr_id: IPv4Address
+
+
+@dataclass
+class RxmtTimerMsg:
+    ifname: str
+    nbr_id: IPv4Address
+
+
+@dataclass
+class SpfDelayTimerMsg:
+    pass
+
+
+@dataclass
+class SpfHoldDownMsg:
+    pass
+
+
+@dataclass
+class AgeTickMsg:
+    pass
+
+
+@dataclass
+class IfUpMsg:
+    ifname: str
+
+
+@dataclass
+class IfDownMsg:
+    ifname: str
+
+
+# ===== SPF delay FSM (RFC 8405; reference holo-ospf/src/spf.rs:270-484) ==
+
+
+class SpfFsmState(enum.Enum):
+    QUIET = "quiet"
+    SHORT_WAIT = "short-wait"
+    LONG_WAIT = "long-wait"
+
+
+@dataclass
+class SpfTimers:
+    initial_delay: float = 0.05
+    short_delay: float = 0.2
+    long_delay: float = 5.0
+    hold_down: float = 10.0
+    time_to_learn: float = 0.5
+
+
+@dataclass
+class InstanceConfig:
+    router_id: IPv4Address = IPv4Address("0.0.0.0")
+    spf: SpfTimers = field(default_factory=SpfTimers)
+
+
+@dataclass
+class Area:
+    area_id: IPv4Address
+    lsdb: Lsdb = field(default_factory=Lsdb)
+    interfaces: dict[str, OspfInterface] = field(default_factory=dict)
+
+
+class OspfInstance(Actor):
+    """One OSPFv2 routing process."""
+
+    def __init__(
+        self,
+        name: str,
+        config: InstanceConfig,
+        netio: NetIo,
+        spf_backend: SpfBackend | None = None,
+        route_cb=None,
+    ):
+        self.name = name
+        self.config = config
+        self.netio = netio
+        self.backend = spf_backend or ScalarSpfBackend()
+        self.route_cb = route_cb  # callable(dict[prefix -> IntraRoute])
+        self.areas: dict[IPv4Address, Area] = {}
+        self._if_area: dict[str, IPv4Address] = {}
+        self._timers: dict[tuple, object] = {}
+        self._dd_seq = 0x1000  # deterministic DD seq seed
+        # SPF FSM state
+        self.spf_state = SpfFsmState.QUIET
+        self._spf_timer = None
+        self._hold_timer = None
+        self._spf_scheduled = False
+        self._last_event_time: float | None = None
+        self._first_full_run = False
+        self._learn_deadline: float | None = None
+        self.routes = {}
+        self.spf_run_count = 0
+
+    # ----- wiring helpers
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._age_timer = self.loop.timer(self.name, AgeTickMsg)
+        self._age_timer.start(AGE_TICK)
+
+    def add_interface(
+        self,
+        ifname: str,
+        cfg: IfConfig,
+        addr: IPv4Network,
+        addr_ip: IPv4Address,
+    ) -> OspfInterface:
+        area = self.areas.setdefault(cfg.area_id, Area(cfg.area_id))
+        iface = OspfInterface(
+            name=ifname, config=cfg, addr_ip=addr_ip, prefix=addr
+        )
+        area.interfaces[ifname] = iface
+        self._if_area[ifname] = cfg.area_id
+        return iface
+
+    def _iface(self, ifname: str) -> tuple[Area, OspfInterface] | None:
+        aid = self._if_area.get(ifname)
+        if aid is None:
+            return None
+        area = self.areas[aid]
+        iface = area.interfaces.get(ifname)
+        return None if iface is None else (area, iface)
+
+    def _timer(self, key: tuple, msg_fn):
+        t = self._timers.get(key)
+        if t is None:
+            t = self.loop.timer(self.name, msg_fn)
+            self._timers[key] = t
+        return t
+
+    # ----- message dispatch
+
+    def handle(self, msg) -> None:
+        if isinstance(msg, NetRxPacket):
+            self._rx_packet(msg)
+        elif isinstance(msg, HelloTimerMsg):
+            self._send_hello(msg.ifname)
+        elif isinstance(msg, WaitTimerMsg):
+            self._wait_timer(msg.ifname)
+        elif isinstance(msg, InactivityTimerMsg):
+            self._nbr_event(msg.ifname, msg.nbr_id, NsmEvent.INACTIVITY_TIMER)
+        elif isinstance(msg, RxmtTimerMsg):
+            self._rxmt(msg.ifname, msg.nbr_id)
+        elif isinstance(msg, SpfDelayTimerMsg):
+            self._spf_timer_fired()
+        elif isinstance(msg, SpfHoldDownMsg):
+            self._spf_holddown_fired()
+        elif isinstance(msg, AgeTickMsg):
+            self._age_tick()
+        elif isinstance(msg, IfUpMsg):
+            self.if_up(msg.ifname)
+        elif isinstance(msg, IfDownMsg):
+            self.if_down(msg.ifname)
+
+    # ----- ISM
+
+    def if_up(self, ifname: str) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if iface.state != IsmState.DOWN:
+            return
+        if iface.config.if_type == IfType.POINT_TO_POINT:
+            iface.state = IsmState.POINT_TO_POINT
+        else:
+            iface.state = IsmState.WAITING
+            self._timer(("wait", ifname), lambda: WaitTimerMsg(ifname)).start(
+                iface.config.dead_interval
+            )
+        self._timer(("hello", ifname), lambda: HelloTimerMsg(ifname)).start(0.0)
+        self._originate_router_lsa(area)
+
+    def if_down(self, ifname: str) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        for nbr_id in list(iface.neighbors):
+            self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+        iface.state = IsmState.DOWN
+        iface.dr = IPv4Address(0)
+        iface.bdr = IPv4Address(0)
+        for key in ("hello", "wait"):
+            t = self._timers.get((key, ifname))
+            if t:
+                t.cancel()
+        self._originate_router_lsa(area)
+
+    def _wait_timer(self, ifname: str) -> None:
+        ai = self._iface(ifname)
+        if ai and ai[1].state == IsmState.WAITING:
+            self._run_dr_election(*ai)
+
+    def _run_dr_election(self, area: Area, iface: OspfInterface) -> None:
+        """§9.4 (run twice when our own role changes, per step 4)."""
+        for _ in range(2):
+            views = [
+                ElectionView(
+                    iface.config.priority,
+                    self.config.router_id,
+                    iface.addr_ip,
+                    iface.dr,
+                    iface.bdr,
+                )
+            ]
+            for nbr in iface.neighbors.values():
+                if nbr.state >= NsmState.TWO_WAY:
+                    views.append(
+                        ElectionView(nbr.priority, nbr.router_id, nbr.src, nbr.dr, nbr.bdr)
+                    )
+            new_dr, new_bdr = elect_dr_bdr(views)
+            changed = (new_dr, new_bdr) != (iface.dr, iface.bdr)
+            iface.dr, iface.bdr = new_dr, new_bdr
+            if new_dr == iface.addr_ip:
+                iface.state = IsmState.DR
+            elif new_bdr == iface.addr_ip:
+                iface.state = IsmState.BACKUP
+            else:
+                iface.state = IsmState.DR_OTHER
+            if not changed:
+                break
+        # AdjOK? on all 2-Way+ neighbors (adjacency set may change).
+        for nbr_id in list(iface.neighbors):
+            nbr = iface.neighbors[nbr_id]
+            if nbr.state >= NsmState.TWO_WAY:
+                self._nbr_event(iface.name, nbr_id, NsmEvent.ADJ_OK)
+        self._originate_router_lsa(area)
+        self._originate_network_lsa(area, iface)
+
+    # ----- hello
+
+    def _send_hello(self, ifname: str) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if iface.state == IsmState.DOWN or iface.config.passive:
+            return
+        hello = Hello(
+            mask=mask_of(iface.prefix) if iface.prefix else IPv4Address(0),
+            hello_interval=iface.config.hello_interval,
+            options=Options.E,
+            priority=iface.config.priority,
+            dead_interval=iface.config.dead_interval,
+            dr=iface.dr,
+            bdr=iface.bdr,
+            neighbors=[n.router_id for n in iface.neighbors.values()
+                       if n.state >= NsmState.INIT],
+        )
+        self._send(iface, ALL_SPF_RTRS_V4, hello, area)
+        self._timer(("hello", ifname), lambda: HelloTimerMsg(ifname)).start(
+            iface.config.hello_interval
+        )
+
+    def _rx_hello(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
+        h: Hello = pkt.body
+        if (
+            h.hello_interval != iface.config.hello_interval
+            or h.dead_interval != iface.config.dead_interval
+        ):
+            return  # §10.5 parameter mismatch
+        if (
+            iface.config.if_type == IfType.BROADCAST
+            and iface.prefix is not None
+            and h.mask != mask_of(iface.prefix)
+        ):
+            return
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None:
+            nbr = Neighbor(router_id=pkt.router_id, src=src)
+            iface.neighbors[pkt.router_id] = nbr
+        prev = (nbr.priority, nbr.dr, nbr.bdr)
+        nbr.src = src
+        nbr.priority = h.priority
+        nbr.dr, nbr.bdr = h.dr, h.bdr
+        self._nbr_event(iface.name, pkt.router_id, NsmEvent.HELLO_RECEIVED)
+        self._timer(
+            ("inactivity", iface.name, pkt.router_id),
+            lambda: InactivityTimerMsg(iface.name, pkt.router_id),
+        ).start(iface.config.dead_interval)
+        if self.config.router_id in h.neighbors:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.TWO_WAY_RECEIVED)
+        else:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.ONE_WAY_RECEIVED)
+            return
+        if iface.config.if_type == IfType.BROADCAST:
+            if iface.state == IsmState.WAITING:
+                # BackupSeen (§9.2): nbr declares itself BDR, or DR with no BDR.
+                if h.bdr == src or (h.dr == src and h.bdr == IPv4Address(0)):
+                    t = self._timers.get(("wait", iface.name))
+                    if t:
+                        t.cancel()
+                    self._run_dr_election(area, iface)
+            elif (nbr.priority, nbr.dr, nbr.bdr) != prev:
+                self._run_dr_election(area, iface)
+
+    # ----- NSM plumbing
+
+    def _adj_ok(self, iface: OspfInterface, nbr: Neighbor) -> bool:
+        """§10.4: should we form/keep an adjacency with this neighbor?"""
+        if iface.config.if_type == IfType.POINT_TO_POINT:
+            return True
+        return (
+            iface.state in (IsmState.DR, IsmState.BACKUP)
+            or nbr.src == iface.dr
+            or nbr.src == iface.bdr
+        )
+
+    def _nbr_event(self, ifname: str, nbr_id: IPv4Address, event: NsmEvent) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        nbr = iface.neighbors.get(nbr_id)
+        if nbr is None:
+            return
+        old_state = nbr.state
+        res = nsm_transition(nbr, event, adj_ok=self._adj_ok(iface, nbr))
+        nbr.state = res.new_state
+        for act in res.actions:
+            if act == "start_exstart":
+                self._start_exstart(area, iface, nbr)
+            elif act == "send_dd_summary":
+                self._enter_exchange(area, iface, nbr)
+            elif act == "send_ls_request":
+                self._send_ls_request(area, iface, nbr)
+            elif act == "clear_lists":
+                nbr.ls_request.clear()
+                nbr.ls_rxmt.clear()
+                nbr.dd_summary.clear()
+            elif act == "stop_timers":
+                for key in ("inactivity", "rxmt"):
+                    t = self._timers.get((key, ifname, nbr_id))
+                    if t:
+                        t.cancel()
+            elif act == "full":
+                t = self._timers.get(("rxmt", ifname, nbr_id))
+                if t:
+                    t.cancel()
+        if nbr.state == NsmState.DOWN:
+            del iface.neighbors[nbr_id]
+        if (old_state >= NsmState.FULL) != (nbr.state >= NsmState.FULL) or (
+            nbr.state == NsmState.DOWN
+        ):
+            # Adjacency formed/lost: re-originate router LSA (+network if DR),
+            # and rerun election bookkeeping via NeighborChange where needed.
+            self._originate_router_lsa(area)
+            self._originate_network_lsa(area, iface)
+        if event in (NsmEvent.KILL_NBR, NsmEvent.INACTIVITY_TIMER, NsmEvent.ONE_WAY_RECEIVED):
+            if iface.config.if_type == IfType.BROADCAST and iface.state >= IsmState.DR_OTHER:
+                self._run_dr_election(area, iface)
+
+    # ----- DD exchange
+
+    def _start_exstart(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
+        self._dd_seq += 1
+        nbr.dd_seq_no = self._dd_seq
+        nbr.master = True  # assume master until negotiation says otherwise
+        dd = DbDesc(
+            mtu=iface.config.mtu,
+            options=Options.E,
+            flags=DbDescFlags.I | DbDescFlags.M | DbDescFlags.MS,
+            dd_seq_no=nbr.dd_seq_no,
+        )
+        nbr.last_sent_dd = dd
+        self._send(iface, nbr.src, dd, area)
+        self._arm_rxmt(iface, nbr)
+
+    def _dd_summary_chunk(self, nbr: Neighbor) -> list[Lsa]:
+        return nbr.dd_summary[:DD_CHUNK]
+
+    def _enter_exchange(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
+        """Populate the DD summary list (§10.8 NegotiationDone).  Sending is
+        driven by the caller: the master continues processing the packet
+        that completed negotiation, the slave replies to it."""
+        now = self.loop.clock.now()
+        nbr.dd_summary = [e.lsa for e in area.lsdb.entries.values()
+                          if e.current_age(now) < MAX_AGE]
+
+    def _send_dd(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
+        chunk = self._dd_summary_chunk(nbr)
+        more = len(nbr.dd_summary) > len(chunk)
+        flags = DbDescFlags(0)
+        if nbr.master:
+            flags |= DbDescFlags.MS
+        if more:
+            flags |= DbDescFlags.M
+        dd = DbDesc(
+            mtu=iface.config.mtu,
+            options=Options.E,
+            flags=flags,
+            dd_seq_no=nbr.dd_seq_no,
+            lsa_headers=chunk,
+        )
+        nbr.last_sent_dd = dd
+        self._send(iface, nbr.src, dd, area)
+        if nbr.master:
+            self._arm_rxmt(iface, nbr)
+
+    def _rx_db_desc(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
+        dd: DbDesc = pkt.body
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EX_START:
+            return
+        if nbr.state == NsmState.EX_START:
+            negotiated = False
+            if (
+                dd.flags == DbDescFlags.I | DbDescFlags.M | DbDescFlags.MS
+                and not dd.lsa_headers
+                and int(pkt.router_id) > int(self.config.router_id)
+            ):
+                # Peer is master; adopt its sequence number.
+                nbr.master = False
+                nbr.dd_seq_no = dd.dd_seq_no
+                negotiated = True
+            elif (
+                not (dd.flags & DbDescFlags.I)
+                and not (dd.flags & DbDescFlags.MS)
+                and dd.dd_seq_no == nbr.dd_seq_no
+                and int(pkt.router_id) < int(self.config.router_id)
+            ):
+                nbr.master = True
+                negotiated = True
+            if not negotiated:
+                return
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.NEGOTIATION_DONE)
+            nbr = iface.neighbors.get(pkt.router_id)
+            if nbr is None or nbr.state != NsmState.EXCHANGE:
+                return
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            # Either way the packet completing negotiation must be processed
+            # for content (§10.8): the slave's echo may carry LSA headers.
+            self._process_dd_headers(area, iface, nbr, dd)
+            if nbr.master:
+                nbr.dd_seq_no += 1
+                if not nbr.dd_summary and not (dd.flags & DbDescFlags.M):
+                    self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
+                else:
+                    self._send_dd(area, iface, nbr)
+            else:
+                self._slave_reply(area, iface, nbr, dd)
+            return
+
+        if nbr.state != NsmState.EXCHANGE:
+            # §10.6: duplicate handling in Loading/Full — slave re-echoes.
+            if (
+                nbr.state in (NsmState.LOADING, NsmState.FULL)
+                and not nbr.master
+                and nbr.last_dd == (dd.flags, dd.options, dd.dd_seq_no)
+            ):
+                if nbr.last_sent_dd is not None:
+                    self._send(iface, nbr.src, nbr.last_sent_dd, area)
+                return
+            if nbr.state in (NsmState.LOADING, NsmState.FULL):
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+            return
+
+        dup = nbr.last_dd == (dd.flags, dd.options, dd.dd_seq_no)
+        if dup:
+            if not nbr.master and nbr.last_sent_dd is not None:
+                self._send(iface, nbr.src, nbr.last_sent_dd, area)
+            return
+        # Master/slave bit must be consistent (exactly one master).
+        peer_is_master = bool(dd.flags & DbDescFlags.MS)
+        if peer_is_master == nbr.master:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+            return
+        if dd.flags & DbDescFlags.I:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+            return
+        if nbr.master:
+            if dd.dd_seq_no != nbr.dd_seq_no:
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+                return
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            self._process_dd_headers(area, iface, nbr, dd)
+            nbr.dd_summary = nbr.dd_summary[len(self._dd_summary_chunk(nbr)) :]
+            nbr.dd_seq_no += 1
+            if not nbr.dd_summary and not (dd.flags & DbDescFlags.M):
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
+            else:
+                self._send_dd(area, iface, nbr)
+        else:
+            if dd.dd_seq_no != nbr.dd_seq_no + 1 and nbr.last_dd is not None:
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+                return
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            self._process_dd_headers(area, iface, nbr, dd)
+            self._slave_reply(area, iface, nbr, dd)
+
+    def _slave_reply(self, area: Area, iface: OspfInterface, nbr: Neighbor, dd: DbDesc) -> None:
+        nbr.dd_seq_no = dd.dd_seq_no
+        chunk = self._dd_summary_chunk(nbr)
+        nbr.dd_summary = nbr.dd_summary[len(chunk) :]
+        flags = DbDescFlags(0)
+        if nbr.dd_summary:
+            flags |= DbDescFlags.M
+        reply = DbDesc(
+            mtu=iface.config.mtu,
+            options=Options.E,
+            flags=flags,
+            dd_seq_no=nbr.dd_seq_no,
+            lsa_headers=chunk,
+        )
+        nbr.last_sent_dd = reply
+        self._send(iface, nbr.src, reply, area)
+        if not (dd.flags & DbDescFlags.M) and not (flags & DbDescFlags.M):
+            self._nbr_event(iface.name, nbr.router_id, NsmEvent.EXCHANGE_DONE)
+
+    def _process_dd_headers(self, area: Area, iface: OspfInterface, nbr: Neighbor, dd: DbDesc) -> None:
+        for hdr in dd.lsa_headers:
+            cur = area.lsdb.get(hdr.key)
+            if cur is None or hdr.compare(cur.lsa) > 0:
+                nbr.ls_request[hdr.key] = hdr
+
+    # ----- LS request / update / ack
+
+    def _send_ls_request(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
+        keys = list(nbr.ls_request.keys())[:LSREQ_CHUNK]
+        if not keys:
+            return
+        self._send(iface, nbr.src, LsRequest(keys), area)
+        self._arm_rxmt(iface, nbr)
+
+    def _rx_ls_request(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        lsas = []
+        for key in pkt.body.entries:
+            e = area.lsdb.get(key)
+            if e is None:
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
+                return
+            lsas.append(self._aged_copy(e))
+        if lsas:
+            self._send(iface, nbr.src, LsUpdate(lsas), area)
+
+    def _aged_copy(self, entry) -> Lsa:
+        """LSA with age advanced to now (for tx; §13.1 InfTransDelay ~1s)."""
+        lsa = entry.lsa
+        age = entry.current_age(self.loop.clock.now())
+        if age == lsa.age:
+            return lsa
+        import copy
+
+        out = copy.copy(lsa)
+        out.age = age
+        if lsa.raw:
+            raw = bytearray(lsa.raw)
+            raw[0:2] = age.to_bytes(2, "big")
+            out.raw = bytes(raw)
+        return out
+
+    def _rx_ls_update(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        acks: list[Lsa] = []
+        now = self.loop.clock.now()
+        for lsa in pkt.body.lsas:
+            cur = area.lsdb.get(lsa.key)
+            # §13 (5): newer than DB copy (or no copy).
+            if cur is None or lsa.compare(cur.lsa) > 0:
+                if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
+                    continue
+                # Self-originated received from elsewhere (§13.4): advance
+                # seqno and re-originate our copy.
+                if lsa.adv_rtr == self.config.router_id and not lsa.is_maxage:
+                    self._refresh_self_lsa(area, lsa)
+                    continue
+                self._install_and_flood(area, lsa, from_iface=iface, from_nbr=nbr)
+                acks.append(lsa)
+            elif lsa.key in nbr.ls_request:
+                # §13 (4)... actually handled via request list below.
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
+                return
+            elif cur is not None and lsa.compare(cur.lsa) == 0:
+                # Duplicate: implied ack if on rxmt list, else direct ack.
+                if lsa.key in nbr.ls_rxmt:
+                    nbr.ls_rxmt.pop(lsa.key, None)
+                else:
+                    self._send(iface, nbr.src, LsAck([lsa]), area)
+            else:
+                # DB copy is newer: send it back directly (§13 (8)).
+                self._send(iface, nbr.src, LsUpdate([self._aged_copy(cur)]), area)
+            # Fulfilled request?
+            if lsa.key in nbr.ls_request:
+                req = nbr.ls_request[lsa.key]
+                if lsa.compare(req) >= 0:
+                    del nbr.ls_request[lsa.key]
+        if acks:
+            # §13.5 delayed-ack destination: AllSPFRouters on p2p and from
+            # DR/BDR; AllDRouters (modeled as the DR address) otherwise.
+            if iface.config.if_type == IfType.POINT_TO_POINT or iface.is_dr_or_bdr():
+                ack_dst = ALL_SPF_RTRS_V4
+            else:
+                ack_dst = iface.dr if int(iface.dr) else nbr.src
+            self._send(iface, ack_dst, LsAck(acks), area)
+        if nbr.state == NsmState.LOADING and not nbr.ls_request:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.LOADING_DONE)
+        elif nbr.state == NsmState.LOADING:
+            self._send_ls_request(area, iface, nbr)
+
+    def _rx_ls_ack(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        for hdr in pkt.body.lsa_headers:
+            cur = nbr.ls_rxmt.get(hdr.key)
+            if cur is not None and hdr.compare(cur) == 0:
+                del nbr.ls_rxmt[hdr.key]
+
+    # ----- flooding (§13.3)
+
+    def _install_and_flood(self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None) -> None:
+        now = self.loop.clock.now()
+        _, changed = area.lsdb.install(lsa, now)
+        if changed:
+            self._schedule_spf()
+        self._flood(area, lsa, from_iface, from_nbr)
+        if lsa.is_maxage:
+            # Simplified MaxAge handling: once flooded and unreferenced,
+            # remove (reference tracks ack state; the rxmt lists here drain
+            # via acks and the entry is gone from SPF either way at MaxAge).
+            area.lsdb.remove(lsa.key)
+
+    def _flood(self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None) -> None:
+        for iface in area.interfaces.values():
+            if iface.state == IsmState.DOWN:
+                continue
+            flood_it = False
+            for nbr in iface.neighbors.values():
+                if nbr.state < NsmState.EXCHANGE:
+                    continue
+                if nbr.state in (NsmState.EXCHANGE, NsmState.LOADING):
+                    req = nbr.ls_request.get(lsa.key)
+                    if req is not None:
+                        c = lsa.compare(req)
+                        if c < 0:
+                            continue
+                        del nbr.ls_request[lsa.key]
+                        if c == 0:
+                            continue
+                if from_nbr is not None and nbr is from_nbr:
+                    continue
+                nbr.ls_rxmt[lsa.key] = lsa
+                flood_it = True
+                self._arm_rxmt(iface, nbr)
+            if not flood_it:
+                continue
+            if iface is from_iface and from_nbr is not None:
+                # §13.3 (4): received on this iface from DR/BDR → skip send.
+                if from_nbr.src in (iface.dr, iface.bdr):
+                    continue
+            self._send(iface, ALL_SPF_RTRS_V4, LsUpdate([lsa]), area)
+
+    def _arm_rxmt(self, iface: OspfInterface, nbr: Neighbor) -> None:
+        t = self._timer(
+            ("rxmt", iface.name, nbr.router_id),
+            lambda: RxmtTimerMsg(iface.name, nbr.router_id),
+        )
+        if not t.armed:
+            t.start(iface.config.rxmt_interval)
+
+    def _rxmt(self, ifname: str, nbr_id: IPv4Address) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        nbr = iface.neighbors.get(nbr_id)
+        if nbr is None:
+            return
+        if nbr.state == NsmState.EX_START or (
+            nbr.state == NsmState.EXCHANGE and nbr.master
+        ):
+            if nbr.last_sent_dd is not None:
+                self._send(iface, nbr.src, nbr.last_sent_dd, area)
+        if nbr.state == NsmState.LOADING and nbr.ls_request:
+            self._send_ls_request(area, iface, nbr)
+        if nbr.ls_rxmt:
+            lsas = list(nbr.ls_rxmt.values())[:20]
+            self._send(iface, nbr.src, LsUpdate(lsas), area)
+        if (
+            nbr.state in (NsmState.EX_START, NsmState.EXCHANGE, NsmState.LOADING)
+            or nbr.ls_rxmt
+        ):
+            self._arm_rxmt(iface, nbr)
+
+    # ----- origination
+
+    def _originate(self, area: Area, ltype: LsaType, lsid: IPv4Address, body) -> None:
+        key = LsaKey(ltype, lsid, self.config.router_id)
+        old = area.lsdb.get(key)
+        lsa = Lsa(
+            age=0,
+            options=Options.E,
+            type=ltype,
+            lsid=lsid,
+            adv_rtr=self.config.router_id,
+            seq_no=next_seq_no(old.lsa if old else None),
+            body=body,
+        )
+        lsa.encode()
+        if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
+            return  # unchanged content: no re-origination needed
+        self._install_and_flood(area, lsa)
+
+    def _flush_self_lsa(self, area: Area, key: LsaKey) -> None:
+        e = area.lsdb.get(key)
+        if e is None:
+            return
+        import copy
+
+        lsa = copy.copy(e.lsa)
+        lsa.age = MAX_AGE
+        if lsa.raw:
+            raw = bytearray(lsa.raw)
+            raw[0:2] = MAX_AGE.to_bytes(2, "big")
+            lsa.raw = bytes(raw)
+        self._install_and_flood(area, lsa)
+
+    def _refresh_self_lsa(self, area: Area, received: Lsa) -> None:
+        """§13.4: our LSA came back newer than our copy: outpace it."""
+        key = received.key
+        cur = area.lsdb.get(key)
+        if cur is None:
+            # We no longer originate it: flush the received copy.
+            received2 = received
+            self._install_and_flood(area, received2)
+            self._flush_self_lsa(area, key)
+            return
+        lsa = Lsa(
+            age=0,
+            options=cur.lsa.options,
+            type=cur.lsa.type,
+            lsid=cur.lsa.lsid,
+            adv_rtr=cur.lsa.adv_rtr,
+            seq_no=received.seq_no + 1,
+            body=cur.lsa.body,
+        )
+        lsa.encode()
+        self._install_and_flood(area, lsa)
+
+    def _originate_router_lsa(self, area: Area) -> None:
+        links: list[RouterLink] = []
+        for iface in area.interfaces.values():
+            if iface.state == IsmState.DOWN or iface.prefix is None:
+                continue
+            cost = iface.config.cost
+            if iface.config.if_type == IfType.POINT_TO_POINT:
+                for nbr in iface.neighbors.values():
+                    if nbr.state == NsmState.FULL:
+                        links.append(
+                            RouterLink(RouterLinkType.POINT_TO_POINT,
+                                       nbr.router_id, iface.addr_ip, cost)
+                        )
+                links.append(
+                    RouterLink(RouterLinkType.STUB_NETWORK,
+                               iface.prefix.network_address,
+                               mask_of(iface.prefix), cost)
+                )
+            else:
+                dr_full = any(
+                    n.state == NsmState.FULL and n.src == iface.dr
+                    for n in iface.neighbors.values()
+                )
+                we_are_dr_with_full = iface.is_dr() and any(
+                    n.state == NsmState.FULL for n in iface.neighbors.values()
+                )
+                if iface.state >= IsmState.DR_OTHER and (dr_full or we_are_dr_with_full):
+                    links.append(
+                        RouterLink(RouterLinkType.TRANSIT_NETWORK,
+                                   iface.dr, iface.addr_ip, cost)
+                    )
+                else:
+                    links.append(
+                        RouterLink(RouterLinkType.STUB_NETWORK,
+                                   iface.prefix.network_address,
+                                   mask_of(iface.prefix), cost)
+                    )
+        body = LsaRouter(flags=RouterFlags(0), links=links)
+        self._originate(area, LsaType.ROUTER, self.config.router_id, body)
+
+    def _originate_network_lsa(self, area: Area, iface: OspfInterface) -> None:
+        key = LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id)
+        full = [n.router_id for n in iface.neighbors.values()
+                if n.state == NsmState.FULL]
+        if iface.is_dr() and full and iface.prefix is not None:
+            body = LsaNetwork(
+                mask=mask_of(iface.prefix),
+                attached=[self.config.router_id] + sorted(full, key=int),
+            )
+            self._originate(area, LsaType.NETWORK, iface.addr_ip, body)
+        elif area.lsdb.get(key) is not None:
+            self._flush_self_lsa(area, key)
+
+    # ----- aging / refresh
+
+    def _age_tick(self) -> None:
+        now = self.loop.clock.now()
+        for area in self.areas.values():
+            for e in area.lsdb.refresh_due(now, self.config.router_id):
+                lsa = Lsa(
+                    age=0,
+                    options=e.lsa.options,
+                    type=e.lsa.type,
+                    lsid=e.lsa.lsid,
+                    adv_rtr=e.lsa.adv_rtr,
+                    seq_no=next_seq_no(e.lsa),
+                    body=e.lsa.body,
+                )
+                lsa.encode()
+                self._install_and_flood(area, lsa)
+            for key in area.lsdb.maxage_keys(now):
+                e = area.lsdb.get(key)
+                lsa = self._aged_copy(e)
+                self._install_and_flood(area, lsa)
+        self._age_timer.start(AGE_TICK)
+
+    # ----- SPF scheduling (RFC 8405 delay FSM)
+
+    def _schedule_spf(self) -> None:
+        """RFC 8405 SPF delay FSM (reference holo-ospf/src/spf.rs:295-484):
+        QUIET→SHORT_WAIT on first IGP event (initial_delay); further events
+        in SHORT_WAIT use short_delay until time_to_learn expires, then
+        LONG_WAIT uses long_delay; HOLDDOWN quiet time returns to QUIET."""
+        cfg = self.config.spf
+        now = self.loop.clock.now()
+        if self._spf_timer is None:
+            self._spf_timer = self.loop.timer(self.name, SpfDelayTimerMsg)
+        if self._hold_timer is None:
+            self._hold_timer = self.loop.timer(self.name, SpfHoldDownMsg)
+        self._hold_timer.start(cfg.hold_down)  # reset on every IGP event
+        if self.spf_state == SpfFsmState.QUIET:
+            self._learn_deadline = now + cfg.time_to_learn
+            self.spf_state = SpfFsmState.SHORT_WAIT
+            self._spf_timer.start(cfg.initial_delay)
+        elif self.spf_state == SpfFsmState.SHORT_WAIT:
+            if now >= (self._learn_deadline or 0):
+                self.spf_state = SpfFsmState.LONG_WAIT
+                self._spf_timer.start(cfg.long_delay)
+            elif not self._spf_timer.armed:
+                self._spf_timer.start(cfg.short_delay)
+        elif self.spf_state == SpfFsmState.LONG_WAIT:
+            if not self._spf_timer.armed:
+                self._spf_timer.start(cfg.long_delay)
+
+    def _spf_timer_fired(self) -> None:
+        self.run_spf()
+
+    def _spf_holddown_fired(self) -> None:
+        self.spf_state = SpfFsmState.QUIET
+        self._learn_deadline = None
+
+    # ----- SPF execution + route programming
+
+    def run_spf(self) -> None:
+        now = self.loop.clock.now()
+        self.spf_run_count += 1
+        all_routes = {}
+        for area in self.areas.values():
+            iface_by_addr = {
+                i.addr_ip: i.name for i in area.interfaces.values() if i.addr_ip
+            }
+            iface_by_nbr = {}
+            for i in area.interfaces.values():
+                for nbr in i.neighbors.values():
+                    if nbr.state == NsmState.FULL:
+                        iface_by_nbr[nbr.router_id] = (i.name, nbr.src)
+            st = build_topology(
+                area.lsdb, self.config.router_id, now, iface_by_addr, iface_by_nbr
+            )
+            if st is None:
+                continue
+            res = self.backend.compute(st.topo)
+            for prefix, route in derive_routes(st, res, area.lsdb, now, area.area_id).items():
+                cur = all_routes.get(prefix)
+                if cur is None or route.dist < cur.dist or (
+                    route.dist == cur.dist and int(route.area_id) < int(cur.area_id)
+                ):
+                    all_routes[prefix] = route
+        self.routes = all_routes
+        if self.route_cb is not None:
+            self.route_cb(all_routes)
+
+    # ----- rx/tx plumbing
+
+    def _rx_packet(self, msg: NetRxPacket) -> None:
+        ai = self._iface(msg.ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if iface.state == IsmState.DOWN:
+            return
+        try:
+            pkt = Packet.decode(msg.data)
+        except Exception:
+            return  # malformed: drop (decode fuzzing guards the codec)
+        if pkt.router_id == self.config.router_id:
+            return  # our own multicast
+        if pkt.area_id != area.area_id:
+            return
+        t = pkt.body.TYPE
+        if t == PacketType.HELLO:
+            self._rx_hello(area, iface, msg.src, pkt)
+        elif t == PacketType.DB_DESC:
+            self._rx_db_desc(area, iface, msg.src, pkt)
+        elif t == PacketType.LS_REQUEST:
+            self._rx_ls_request(area, iface, msg.src, pkt)
+        elif t == PacketType.LS_UPDATE:
+            self._rx_ls_update(area, iface, msg.src, pkt)
+        elif t == PacketType.LS_ACK:
+            self._rx_ls_ack(area, iface, msg.src, pkt)
+
+    def _send(self, iface: OspfInterface, dst, body, area: Area) -> None:
+        pkt = Packet(
+            router_id=self.config.router_id,
+            area_id=area.area_id,
+            body=body,
+        )
+        self.netio.send(iface.name, iface.addr_ip, dst, pkt.encode())

@@ -1,0 +1,500 @@
+"""OSPFv2 packet and LSA codecs (RFC 2328 §A).
+
+Zero-copy-ish cursor codecs in the style of the reference's packet layer
+(holo-ospf/src/ospfv2/packet/), with strict length/checksum validation.
+All multi-byte fields are network byte order via utils.bytesbuf.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address
+
+from holo_tpu.utils.bytesbuf import (
+    DecodeError,
+    Reader,
+    Writer,
+    fletcher16_checksum,
+    fletcher16_verify,
+    ip_checksum,
+)
+
+OSPF_VERSION = 2
+PKT_HDR_LEN = 24
+LSA_HDR_LEN = 20
+MAX_AGE = 3600  # seconds (RFC 2328 §B)
+LS_REFRESH_TIME = 1800
+MAX_AGE_DIFF = 900
+LS_INFINITY = 0xFFFFFF
+INITIAL_SEQ_NO = -0x7FFFFFFF  # 0x80000001 signed
+MAX_SEQ_NO = 0x7FFFFFFF
+
+
+class PacketType(enum.IntEnum):
+    HELLO = 1
+    DB_DESC = 2
+    LS_REQUEST = 3
+    LS_UPDATE = 4
+    LS_ACK = 5
+
+
+class LsaType(enum.IntEnum):
+    ROUTER = 1
+    NETWORK = 2
+    SUMMARY_NETWORK = 3
+    SUMMARY_ROUTER = 4
+    AS_EXTERNAL = 5
+    OPAQUE_LINK = 9
+    OPAQUE_AREA = 10
+    OPAQUE_AS = 11
+
+
+class Options(enum.IntFlag):
+    E = 0x02  # external routing capability (not a stub area)
+    MC = 0x04
+    NP = 0x08  # NSSA
+    DC = 0x20
+    O = 0x40  # opaque capable
+
+
+class RouterLinkType(enum.IntEnum):
+    POINT_TO_POINT = 1
+    TRANSIT_NETWORK = 2
+    STUB_NETWORK = 3
+    VIRTUAL_LINK = 4
+
+
+class RouterFlags(enum.IntFlag):
+    B = 0x01  # area border router
+    E = 0x02  # AS boundary router
+    V = 0x04  # virtual link endpoint
+
+
+class AuthType(enum.IntEnum):
+    NULL = 0
+    SIMPLE = 1
+    CRYPTOGRAPHIC = 2
+
+
+# ===== LSA bodies =====
+
+
+@dataclass(frozen=True)
+class RouterLink:
+    link_type: RouterLinkType
+    id: IPv4Address  # neighbor router id / DR addr / network
+    data: IPv4Address  # iface addr / mask for stub
+    metric: int
+
+
+@dataclass
+class LsaRouter:
+    flags: RouterFlags = RouterFlags(0)
+    links: list[RouterLink] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.u8(int(self.flags)).u8(0).u16(len(self.links))
+        for l in self.links:
+            w.ipv4(l.id).ipv4(l.data).u8(int(l.link_type)).u8(0).u16(l.metric)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaRouter":
+        flags = RouterFlags(r.u8() & 0x07)
+        r.u8()
+        n = r.u16()
+        links = []
+        for _ in range(n):
+            lid, data = r.ipv4(), r.ipv4()
+            ltype = r.u8()
+            ntos = r.u8()
+            metric = r.u16()
+            for _ in range(ntos):  # skip per-TOS metrics
+                r.u32()
+            try:
+                lt = RouterLinkType(ltype)
+            except ValueError as e:
+                raise DecodeError(f"bad router link type {ltype}") from e
+            links.append(RouterLink(lt, lid, data, metric))
+        return cls(RouterFlags(flags), links)
+
+
+@dataclass
+class LsaNetwork:
+    mask: IPv4Address = IPv4Address(0)
+    attached: list[IPv4Address] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.ipv4(self.mask)
+        for a in self.attached:
+            w.ipv4(a)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaNetwork":
+        mask = r.ipv4()
+        attached = []
+        while r.remaining() >= 4:
+            attached.append(r.ipv4())
+        return cls(mask, attached)
+
+
+@dataclass
+class LsaSummary:
+    """Type 3 (network) and 4 (ASBR) summary share the body format."""
+
+    mask: IPv4Address = IPv4Address(0)
+    metric: int = 0
+
+    def encode(self, w: Writer) -> None:
+        w.ipv4(self.mask).u32(self.metric & LS_INFINITY)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaSummary":
+        mask = r.ipv4()
+        metric = r.u32() & LS_INFINITY
+        return cls(mask, metric)
+
+
+@dataclass
+class LsaAsExternal:
+    mask: IPv4Address = IPv4Address(0)
+    e_bit: bool = True  # type 2 external metric
+    metric: int = 0
+    fwd_addr: IPv4Address = IPv4Address(0)
+    tag: int = 0
+
+    def encode(self, w: Writer) -> None:
+        w.ipv4(self.mask)
+        w.u32(((0x80000000 if self.e_bit else 0) | (self.metric & LS_INFINITY)))
+        w.ipv4(self.fwd_addr).u32(self.tag)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaAsExternal":
+        mask = r.ipv4()
+        word = r.u32()
+        fwd = r.ipv4()
+        tag = r.u32()
+        # additional TOS routes ignored
+        return cls(mask, bool(word & 0x80000000), word & LS_INFINITY, fwd, tag)
+
+
+@dataclass
+class LsaOpaque:
+    data: bytes = b""
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.data)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaOpaque":
+        return cls(r.rest())
+
+
+_BODY_CODECS = {
+    LsaType.ROUTER: LsaRouter,
+    LsaType.NETWORK: LsaNetwork,
+    LsaType.SUMMARY_NETWORK: LsaSummary,
+    LsaType.SUMMARY_ROUTER: LsaSummary,
+    LsaType.AS_EXTERNAL: LsaAsExternal,
+    LsaType.OPAQUE_LINK: LsaOpaque,
+    LsaType.OPAQUE_AREA: LsaOpaque,
+    LsaType.OPAQUE_AS: LsaOpaque,
+}
+
+
+@dataclass(frozen=True)
+class LsaKey:
+    """LSDB key (RFC 2328 §12.1: type, link-state id, advertising router)."""
+
+    type: LsaType
+    lsid: IPv4Address
+    adv_rtr: IPv4Address
+
+
+@dataclass
+class Lsa:
+    """Header + body; raw wire image cached for flooding/checksum."""
+
+    age: int
+    options: Options
+    type: LsaType
+    lsid: IPv4Address
+    adv_rtr: IPv4Address
+    seq_no: int
+    body: object
+    cksum: int = 0
+    length: int = 0
+    raw: bytes = b""
+
+    @property
+    def key(self) -> LsaKey:
+        return LsaKey(self.type, self.lsid, self.adv_rtr)
+
+    @property
+    def is_maxage(self) -> bool:
+        return self.age >= MAX_AGE
+
+    def encode(self) -> bytes:
+        """Encode body, compute length + Fletcher checksum, cache raw."""
+        w = Writer()
+        w.u16(self.age).u8(int(self.options)).u8(int(self.type))
+        w.ipv4(self.lsid).ipv4(self.adv_rtr)
+        w.u32(self.seq_no & 0xFFFFFFFF)
+        w.u16(0)  # checksum placeholder
+        w.u16(0)  # length placeholder
+        self.body.encode(w)
+        w.patch_u16(18, len(w))
+        self.length = len(w)
+        # Fletcher over everything except the age field (first 2 bytes).
+        cks = fletcher16_checksum(bytes(w.buf[2:]), 14)
+        w.patch_u16(16, cks)
+        self.cksum = cks
+        self.raw = w.finish()
+        return self.raw
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Lsa":
+        start = r.pos
+        if r.remaining() < LSA_HDR_LEN:
+            raise DecodeError("short LSA header")
+        age = r.u16()
+        options = Options(r.u8())
+        try:
+            ltype = LsaType(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown LSA type") from e
+        lsid, adv = r.ipv4(), r.ipv4()
+        seq = r.u32()
+        if seq & 0x80000000:
+            seq -= 1 << 32
+        cksum = r.u16()
+        length = r.u16()
+        if length < LSA_HDR_LEN:
+            raise DecodeError(f"bad LSA length {length}")
+        body_len = length - LSA_HDR_LEN
+        if r.remaining() < body_len:
+            raise DecodeError("LSA length exceeds buffer")
+        raw = r.data[start : start + length]
+        if not fletcher16_verify(raw[2:]):
+            raise DecodeError("LSA checksum mismatch")
+        body = _BODY_CODECS[ltype].decode(r.sub(body_len))
+        return cls(age, options, ltype, lsid, adv, seq, body, cksum, length, raw)
+
+    @classmethod
+    def decode_header(cls, r: Reader) -> "Lsa":
+        """Header-only decode (DD packets, LS Ack)."""
+        age = r.u16()
+        options = Options(r.u8())
+        ltype = LsaType(r.u8())
+        lsid, adv = r.ipv4(), r.ipv4()
+        seq = r.u32()
+        if seq & 0x80000000:
+            seq -= 1 << 32
+        cksum = r.u16()
+        length = r.u16()
+        return cls(age, options, ltype, lsid, adv, seq, None, cksum, length)
+
+    def encode_header(self, w: Writer) -> None:
+        w.u16(self.age).u8(int(self.options)).u8(int(self.type))
+        w.ipv4(self.lsid).ipv4(self.adv_rtr).u32(self.seq_no & 0xFFFFFFFF)
+        w.u16(self.cksum).u16(self.length)
+
+    def compare(self, other: "Lsa") -> int:
+        """RFC 2328 §13.1 which-is-newer: >0 self newer, <0 other newer."""
+        if self.seq_no != other.seq_no:
+            return 1 if self.seq_no > other.seq_no else -1
+        if self.cksum != other.cksum:
+            return 1 if self.cksum > other.cksum else -1
+        if self.is_maxage != other.is_maxage:
+            return 1 if self.is_maxage else -1
+        if abs(self.age - other.age) > MAX_AGE_DIFF:
+            return 1 if self.age < other.age else -1
+        return 0
+
+
+# ===== Packets =====
+
+
+@dataclass
+class Hello:
+    mask: IPv4Address
+    hello_interval: int
+    options: Options
+    priority: int
+    dead_interval: int
+    dr: IPv4Address
+    bdr: IPv4Address
+    neighbors: list[IPv4Address] = field(default_factory=list)
+
+    TYPE = PacketType.HELLO
+
+    def encode_body(self, w: Writer) -> None:
+        w.ipv4(self.mask).u16(self.hello_interval).u8(int(self.options))
+        w.u8(self.priority).u32(self.dead_interval)
+        w.ipv4(self.dr).ipv4(self.bdr)
+        for n in self.neighbors:
+            w.ipv4(n)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "Hello":
+        mask = r.ipv4()
+        hello_int = r.u16()
+        options = Options(r.u8())
+        prio = r.u8()
+        dead = r.u32()
+        dr, bdr = r.ipv4(), r.ipv4()
+        nbrs = []
+        while r.remaining() >= 4:
+            nbrs.append(r.ipv4())
+        return cls(mask, hello_int, options, prio, dead, dr, bdr, nbrs)
+
+
+class DbDescFlags(enum.IntFlag):
+    MS = 0x01  # master
+    M = 0x02  # more
+    I = 0x04  # init
+
+
+@dataclass
+class DbDesc:
+    mtu: int
+    options: Options
+    flags: DbDescFlags
+    dd_seq_no: int
+    lsa_headers: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.DB_DESC
+
+    def encode_body(self, w: Writer) -> None:
+        w.u16(self.mtu).u8(int(self.options)).u8(int(self.flags))
+        w.u32(self.dd_seq_no)
+        for h in self.lsa_headers:
+            h.encode_header(w)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "DbDesc":
+        mtu = r.u16()
+        options = Options(r.u8())
+        flags = DbDescFlags(r.u8())
+        seq = r.u32()
+        hdrs = []
+        while r.remaining() >= LSA_HDR_LEN:
+            hdrs.append(Lsa.decode_header(r))
+        return cls(mtu, options, flags, seq, hdrs)
+
+
+@dataclass
+class LsRequest:
+    entries: list[LsaKey] = field(default_factory=list)
+
+    TYPE = PacketType.LS_REQUEST
+
+    def encode_body(self, w: Writer) -> None:
+        for k in self.entries:
+            w.u32(int(k.type)).ipv4(k.lsid).ipv4(k.adv_rtr)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsRequest":
+        entries = []
+        while r.remaining() >= 12:
+            t = LsaType(r.u32())
+            entries.append(LsaKey(t, r.ipv4(), r.ipv4()))
+        return cls(entries)
+
+
+@dataclass
+class LsUpdate:
+    lsas: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.LS_UPDATE
+
+    def encode_body(self, w: Writer) -> None:
+        w.u32(len(self.lsas))
+        for lsa in self.lsas:
+            w.bytes(lsa.raw if lsa.raw else lsa.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsUpdate":
+        n = r.u32()
+        lsas = []
+        for _ in range(n):
+            lsas.append(Lsa.decode(r))
+        return cls(lsas)
+
+
+@dataclass
+class LsAck:
+    lsa_headers: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.LS_ACK
+
+    def encode_body(self, w: Writer) -> None:
+        for h in self.lsa_headers:
+            h.encode_header(w)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsAck":
+        hdrs = []
+        while r.remaining() >= LSA_HDR_LEN:
+            hdrs.append(Lsa.decode_header(r))
+        return cls(hdrs)
+
+
+_PKT_CODECS = {
+    PacketType.HELLO: Hello,
+    PacketType.DB_DESC: DbDesc,
+    PacketType.LS_REQUEST: LsRequest,
+    PacketType.LS_UPDATE: LsUpdate,
+    PacketType.LS_ACK: LsAck,
+}
+
+
+@dataclass
+class Packet:
+    """OSPFv2 packet: 24-byte header + typed body (RFC 2328 §A.3.1)."""
+
+    router_id: IPv4Address
+    area_id: IPv4Address
+    body: object
+    auth_type: AuthType = AuthType.NULL
+    auth_data: bytes = bytes(8)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8(OSPF_VERSION).u8(int(self.body.TYPE)).u16(0)
+        w.ipv4(self.router_id).ipv4(self.area_id)
+        w.u16(0)  # checksum
+        w.u16(int(self.auth_type))
+        w.zeros(8)  # auth data excluded from checksum
+        self.body.encode_body(w)
+        w.patch_u16(2, len(w))
+        # Standard checksum over the packet minus the 8 auth bytes.
+        cks = ip_checksum(bytes(w.buf[:16]) + bytes(w.buf[24:]))
+        w.patch_u16(12, cks)
+        if self.auth_type == AuthType.SIMPLE:
+            w.patch_bytes(16, self.auth_data[:8].ljust(8, b"\x00"))
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Packet":
+        r = Reader(data)
+        if r.remaining() < PKT_HDR_LEN:
+            raise DecodeError("short packet")
+        version = r.u8()
+        if version != OSPF_VERSION:
+            raise DecodeError(f"bad version {version}")
+        try:
+            ptype = PacketType(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown packet type") from e
+        length = r.u16()
+        if length < PKT_HDR_LEN or length > len(data):
+            raise DecodeError("bad packet length")
+        router_id, area_id = r.ipv4(), r.ipv4()
+        r.u16()  # checksum (verified below)
+        auth_type = AuthType(r.u16())
+        auth_data = r.bytes(8)
+        if ip_checksum(data[:16] + data[24:length]) != 0:
+            raise DecodeError("packet checksum mismatch")
+        body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))
+        return cls(router_id, area_id, body, auth_type, auth_data)

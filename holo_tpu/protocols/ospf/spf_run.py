@@ -1,0 +1,257 @@
+"""SPF marshaling + route derivation for OSPFv2.
+
+Bridges the protocol LSDB to the tensor/scalar SPF backends:
+
+- :func:`build_topology` lowers an area LSDB into the generic
+  :class:`~holo_tpu.ops.graph.Topology` (vertex model of RFC 2328 §16.1,
+  ordering contract of holo_tpu.ops.graph), assigning next-hop atoms for
+  exactly the parent-hops==0 cases (reference calc_nexthops,
+  holo-ospf/src/ospfv2/spf.rs:172-…).
+- :func:`derive_routes` turns backend results (distances + ECMP atom
+  bitmasks) into per-prefix intra-area routes (reference
+  route::update_rib_full, holo-ospf/src/route.rs:146-197).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from ipaddress import IPv4Address, IPv4Network
+
+import numpy as np
+
+from holo_tpu.ops.graph import INF, Topology
+from holo_tpu.protocols.ospf.lsdb import Lsdb
+from holo_tpu.protocols.ospf.packet import (
+    LsaNetwork,
+    LsaRouter,
+    LsaType,
+    RouterLinkType,
+)
+from holo_tpu.spf.backend import SpfResult
+from holo_tpu.utils.ip import apply_mask
+
+
+@dataclass(frozen=True)
+class NexthopAtom:
+    """Resolved direct next hop: outgoing interface + neighbor address.
+
+    addr is None for p2p links where the neighbor address is learned from
+    the adjacency (filled by the instance) — kept explicit for RIB parity.
+    """
+
+    ifname: str
+    addr: IPv4Address | None
+
+
+@dataclass
+class SpfTopology:
+    topo: Topology
+    atoms: list[NexthopAtom]
+    # vertex index maps
+    router_index: dict[IPv4Address, int]
+    network_index: dict[IPv4Address, int]
+
+
+def build_topology(
+    lsdb: Lsdb,
+    router_id: IPv4Address,
+    now: float,
+    iface_by_addr: dict[IPv4Address, str],
+    iface_by_nbr: dict[IPv4Address, tuple[str, IPv4Address]],
+) -> SpfTopology | None:
+    """Lower the area LSDB to the SPF vertex/edge model.
+
+    iface_by_addr: our interface address -> ifname (for transit networks we
+    attach to).  iface_by_nbr: neighbor router-id -> (ifname, nbr addr)
+    for p2p adjacencies (direct next-hop resolution).
+    MaxAge LSAs are excluded (RFC 2328 §16.1 note).
+    """
+    routers: list[IPv4Address] = []
+    networks: list[IPv4Address] = []  # keyed by DR interface address (lsid)
+    rlsa: dict[IPv4Address, LsaRouter] = {}
+    nlsa: dict[IPv4Address, LsaNetwork] = {}
+    for e in lsdb.all():
+        if e.current_age(now) >= 3600:
+            continue
+        lsa = e.lsa
+        if lsa.type == LsaType.ROUTER:
+            rlsa[lsa.adv_rtr] = lsa.body
+            routers.append(lsa.adv_rtr)
+        elif lsa.type == LsaType.NETWORK:
+            nlsa[lsa.lsid] = lsa.body
+            networks.append(lsa.lsid)
+
+    if router_id not in rlsa:
+        return None  # no self LSA yet (reference: SpfRootNotFound)
+
+    # Vertex ordering contract: Network < Router (ospfv2/spf.rs:42-45).
+    networks.sort()
+    routers.sort()
+    network_index = {a: i for i, a in enumerate(networks)}
+    router_index = {r: len(networks) + i for i, r in enumerate(routers)}
+    n = len(networks) + len(routers)
+    is_router = np.zeros(n, bool)
+    is_router[len(networks) :] = True
+
+    src, dst, cost = [], [], []
+    for rid, body in rlsa.items():
+        u = router_index[rid]
+        for link in body.links:
+            if link.link_type == RouterLinkType.POINT_TO_POINT:
+                v = router_index.get(link.id)
+                if v is not None:
+                    src.append(u), dst.append(v), cost.append(link.metric)
+            elif link.link_type == RouterLinkType.TRANSIT_NETWORK:
+                v = network_index.get(link.id)
+                if v is not None:
+                    src.append(u), dst.append(v), cost.append(link.metric)
+    for dr_addr, body in nlsa.items():
+        u = network_index[dr_addr]
+        for rid in body.attached:
+            v = router_index.get(rid)
+            if v is not None:
+                src.append(u), dst.append(v), cost.append(0)
+
+    topo = Topology(
+        n_vertices=n,
+        is_router=is_router,
+        edge_src=np.array(src, np.int32).reshape(-1),
+        edge_dst=np.array(dst, np.int32).reshape(-1),
+        edge_cost=np.array(cost, np.int32).reshape(-1),
+        root=router_index[router_id],
+    ).filter_mutual()
+
+    # Next-hop atoms: edges out of the root, and edges out of root-adjacent
+    # transit networks (the hops==0 direct-calculation cases).
+    atoms: list[NexthopAtom] = []
+    atom_ids = np.full(topo.n_edges, -1, np.int32)
+    root = topo.root
+    root_nets: set[int] = set()
+    self_body = rlsa[router_id]
+    # Map vertex index -> transit our-iface (for root->net edges).
+    net_if: dict[int, str] = {}
+    for link in self_body.links:
+        if link.link_type == RouterLinkType.TRANSIT_NETWORK:
+            vi = network_index.get(link.id)
+            if vi is not None:
+                ifname = iface_by_addr.get(link.data)
+                if ifname is not None:
+                    net_if[vi] = ifname
+    for e in range(topo.n_edges):
+        if topo.edge_src[e] == root:
+            v = int(topo.edge_dst[e])
+            if is_router[v]:
+                # p2p neighbor: resolve via adjacency table.
+                rid = routers[v - len(networks)]
+                hop = iface_by_nbr.get(rid)
+                if hop is not None:
+                    atom_ids[e] = len(atoms)
+                    atoms.append(NexthopAtom(hop[0], hop[1]))
+            else:
+                root_nets.add(v)
+        # second pass below needs root_nets complete
+    for e in range(topo.n_edges):
+        u = int(topo.edge_src[e])
+        v = int(topo.edge_dst[e])
+        if u in root_nets and is_router[v] and v != root:
+            # Destination router's address on that network = the link.data
+            # of ITS transit link pointing at this network's DR address.
+            rid = routers[v - len(networks)]
+            dr_addr = networks[u]
+            body = rlsa.get(rid)
+            ifname = net_if.get(u)
+            if body is None or ifname is None:
+                continue
+            for link in body.links:
+                if (
+                    link.link_type == RouterLinkType.TRANSIT_NETWORK
+                    and link.id == dr_addr
+                ):
+                    atom_ids[e] = len(atoms)
+                    atoms.append(NexthopAtom(ifname, link.data))
+                    break
+
+    topo.edge_direct_atom = atom_ids
+    topo.touch()
+    return SpfTopology(topo, atoms, router_index, network_index)
+
+
+@dataclass(frozen=True)
+class RouteNexthop:
+    ifname: str
+    addr: IPv4Address | None
+
+
+@dataclass
+class IntraRoute:
+    prefix: IPv4Network
+    dist: int
+    nexthops: frozenset[RouteNexthop]
+    area_id: IPv4Address
+
+
+def _atoms_of(words: np.ndarray, atoms: list[NexthopAtom]) -> frozenset[RouteNexthop]:
+    out = []
+    for a in range(len(atoms)):
+        if words[a // 32] & (np.uint32(1) << np.uint32(a % 32)):
+            out.append(RouteNexthop(atoms[a].ifname, atoms[a].addr))
+    return frozenset(out)
+
+
+def derive_routes(
+    st: SpfTopology,
+    res: SpfResult,
+    lsdb: Lsdb,
+    now: float,
+    area_id: IPv4Address,
+) -> dict[IPv4Network, IntraRoute]:
+    """Intra-area routes from SPF results (RFC 2328 §16.1 steps 2-4).
+
+    Transit networks yield their prefix at the network vertex's distance;
+    router stub links yield prefix routes at dist(router)+metric.  Equal
+    cost contributions union their next-hop sets; the root's own stubs are
+    local (empty next-hop set) — the RIB treats them as connected.
+    """
+    routes: dict[IPv4Network, IntraRoute] = {}
+
+    def offer(prefix, dist, nhs):
+        cur = routes.get(prefix)
+        if cur is None or dist < cur.dist:
+            routes[prefix] = IntraRoute(prefix, dist, nhs, area_id)
+        elif dist == cur.dist:
+            routes[prefix] = IntraRoute(
+                prefix, dist, cur.nexthops | nhs, area_id
+            )
+
+    inv_net = {i: a for a, i in st.network_index.items()}
+    inv_rtr = {i: r for r, i in st.router_index.items()}
+    nlsa = {}
+    rlsa = {}
+    for e in lsdb.all():
+        if e.current_age(now) >= 3600:
+            continue
+        if e.lsa.type == LsaType.NETWORK:
+            nlsa[e.lsa.lsid] = e.lsa.body
+        elif e.lsa.type == LsaType.ROUTER:
+            rlsa[e.lsa.adv_rtr] = e.lsa.body
+
+    n = st.topo.n_vertices
+    for v in range(n):
+        if res.dist[v] >= INF:
+            continue
+        nhs = _atoms_of(res.nexthop_words[v], st.atoms)
+        if v in inv_net:
+            body = nlsa.get(inv_net[v])
+            if body is None:
+                continue
+            prefix = apply_mask(inv_net[v], body.mask)
+            offer(prefix, int(res.dist[v]), nhs)
+        else:
+            body = rlsa.get(inv_rtr[v])
+            if body is None:
+                continue
+            for link in body.links:
+                if link.link_type == RouterLinkType.STUB_NETWORK:
+                    prefix = apply_mask(link.id, link.data)
+                    offer(prefix, int(res.dist[v]) + link.metric, nhs)
+    return routes

@@ -1,0 +1,133 @@
+"""ibus: the in-process typed pub/sub bus between providers and protocols.
+
+Reference: holo-utils/src/ibus.rs — five server components (routing,
+interface, system, keychain, policy) serve subscriptions; each client has a
+dedicated channel pair; ~50 message kinds (ibus.rs:112-228).
+
+Here the bus rides the shared EventLoop: a subscription routes matching
+publications into the subscriber actor's inbox, wrapped in ``IbusMsg`` so
+protocol actors can dispatch on one envelope type.  Disconnect = actor
+unregistration (the loop drops undeliverable sends, mirroring
+channel-drop detection at ibus.rs:473-488).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from holo_tpu.utils.runtime import EventLoop
+from holo_tpu.utils.southbound import Protocol
+
+
+@dataclass
+class IbusMsg:
+    """Envelope delivered to subscriber actors."""
+
+    topic: str
+    payload: Any
+    sender: str = ""
+
+
+# Topic names (grouped as in ibus.rs:112-228).
+TOPIC_INTERFACE_UPD = "interface.upd"
+TOPIC_INTERFACE_DEL = "interface.del"
+TOPIC_ADDRESS_ADD = "interface.addr.add"
+TOPIC_ADDRESS_DEL = "interface.addr.del"
+TOPIC_ROUTER_ID = "system.router_id"
+TOPIC_HOSTNAME = "system.hostname"
+TOPIC_ROUTE_ADD = "routing.route.add"
+TOPIC_ROUTE_DEL = "routing.route.del"
+TOPIC_ROUTE_MPLS_ADD = "routing.mpls.add"
+TOPIC_ROUTE_MPLS_DEL = "routing.mpls.del"
+TOPIC_ROUTE_BIER_ADD = "routing.bier.add"
+TOPIC_ROUTE_BIER_DEL = "routing.bier.del"
+TOPIC_REDISTRIBUTE_ADD = "routing.redistribute.add"
+TOPIC_REDISTRIBUTE_DEL = "routing.redistribute.del"
+TOPIC_NHT_UPD = "routing.nht.upd"
+TOPIC_BFD_STATE = "bfd.state"
+TOPIC_KEYCHAIN_UPD = "keychain.upd"
+TOPIC_KEYCHAIN_DEL = "keychain.del"
+TOPIC_POLICY_UPD = "policy.upd"
+TOPIC_POLICY_MATCH_SETS_UPD = "policy.match_sets.upd"
+TOPIC_SR_CFG = "sr.cfg"
+TOPIC_BIER_CFG = "bier.cfg"
+TOPIC_MACVLAN_ADD = "interface.macvlan.add"
+TOPIC_MACVLAN_DEL = "interface.macvlan.del"
+
+
+@dataclass
+class _Sub:
+    actor: str
+    # Optional filters: e.g. redistribute subs filter on (protocol, af);
+    # interface subs may filter on ifname.
+    filter: dict = field(default_factory=dict)
+
+
+class Ibus:
+    """Topic-routed pub/sub over the event loop."""
+
+    def __init__(self, loop_: EventLoop):
+        self.loop = loop_
+        self._subs: dict[str, list[_Sub]] = {}
+
+    def subscribe(self, topic: str, actor: str, **filters) -> None:
+        subs = self._subs.setdefault(topic, [])
+        if not any(s.actor == actor and s.filter == filters for s in subs):
+            subs.append(_Sub(actor, filters))
+
+    def unsubscribe(self, topic: str, actor: str) -> None:
+        self._subs[topic] = [
+            s for s in self._subs.get(topic, []) if s.actor != actor
+        ]
+
+    def unsubscribe_all(self, actor: str) -> None:
+        for topic in self._subs:
+            self._subs[topic] = [
+                s for s in self._subs[topic] if s.actor != actor
+            ]
+
+    def publish(
+        self, topic: str, payload: Any, sender: str = "", **match
+    ) -> int:
+        """Deliver to all subscribers whose filters match; returns count."""
+        n = 0
+        for s in self._subs.get(topic, []):
+            if all(match.get(k) == v for k, v in s.filter.items()):
+                if self.loop.send(s.actor, IbusMsg(topic, payload, sender)):
+                    n += 1
+        return n
+
+    def request(self, server_actor: str, payload: Any, sender: str = "") -> bool:
+        """Directed request to a server component (e.g. route install —
+        ibus.rs route_install path); reply comes back as a publication or a
+        directed IbusMsg."""
+        return self.loop.send(server_actor, IbusMsg("request", payload, sender))
+
+
+@dataclass
+class BfdSessionReg:
+    sender: str
+    key: tuple  # session key (ifname/addr family specifics)
+    client_id: int = 0
+    min_rx: int = 1000000
+    min_tx: int = 1000000
+    multiplier: int = 3
+
+
+@dataclass
+class BfdSessionUnreg:
+    sender: str
+    key: tuple
+
+
+@dataclass
+class BfdStateUpd:
+    key: tuple
+    state: str  # 'up' | 'down' | 'admin-down' | 'init'
+
+
+@dataclass
+class RedistributeSub:
+    protocol: Protocol
+    af: int

@@ -1,0 +1,254 @@
+"""Actor runtime: event loop, typed messages, timers, deterministic clock.
+
+Design (vs reference holo-protocol/src/lib.rs:383-435 + holo-utils/src/task.rs):
+the reference gives each protocol instance an OS thread with a Tokio event
+loop and swaps timers/sockets for no-ops under its `testing` feature.  Here
+every actor shares one cooperative event loop whose clock is pluggable:
+
+- ``RealClock`` — wall time; the loop sleeps until the next timer/IO.
+- ``VirtualClock`` — tests advance time explicitly; timers fire in exact
+  deadline order, messages deliver FIFO — fully reproducible runs without
+  mocking timers away (stronger determinism than the reference's no-op
+  timers, since timer-driven behavior is actually exercised).
+
+Messages are plain dataclasses; delivery is per-actor FIFO.  Panic
+containment mirrors holo-protocol/src/lib.rs:344-360: an exception in one
+actor's handler stops that actor only and notifies its supervisor.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+log = logging.getLogger("holo_tpu.runtime")
+
+
+class RealClock:
+    def now(self) -> float:
+        return time.monotonic()
+
+
+class VirtualClock:
+    """Deterministic clock; time moves only via advance()."""
+
+    def __init__(self) -> None:
+        self._now = 0.0
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        self._now += dt
+
+
+@dataclass(order=True)
+class _TimerEntry:
+    deadline: float
+    seq: int
+    timer: "Timer" = field(compare=False)
+
+
+class Timer:
+    """One-shot timer delivering a message to an actor; reset/cancel-able.
+
+    Equivalent of TimeoutTask (holo-utils/src/task.rs:167-233); IntervalTask
+    is modeled by the actor re-arming in its handler (keeps re-arm policy —
+    jitter, backoff — in protocol code where the RFCs put it).
+    """
+
+    def __init__(self, loop_: "EventLoop", actor: str, msg_fn: Callable[[], Any]):
+        self._loop = loop_
+        self._actor = actor
+        self._msg_fn = msg_fn
+        self._armed_seq: int | None = None
+        self.deadline: float | None = None
+
+    @property
+    def armed(self) -> bool:
+        return self._armed_seq is not None
+
+    def remaining(self) -> float | None:
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - self._loop.clock.now())
+
+    def start(self, delay: float) -> None:
+        self.cancel()
+        self.deadline = self._loop.clock.now() + delay
+        self._armed_seq = self._loop._arm(self)
+
+    reset = start
+
+    def cancel(self) -> None:
+        self._armed_seq = None
+        self.deadline = None
+
+    def _fire(self, seq: int) -> None:
+        if self._armed_seq != seq:
+            return  # canceled or reset since arming
+        self._armed_seq = None
+        self.deadline = None
+        self._loop.send(self._actor, self._msg_fn())
+
+
+class Actor:
+    """Base actor: single-writer state, message handler, crash containment."""
+
+    name: str = "actor"
+
+    def attach(self, loop_: "EventLoop") -> None:
+        self.loop = loop_
+
+    def handle(self, msg: Any) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        """Cleanup hook (channel-drop cascade equivalent)."""
+
+
+@dataclass
+class ActorCrashed:
+    """Supervision notice (panic containment, holo-protocol/src/lib.rs:344-360)."""
+
+    actor: str
+    error: BaseException
+
+
+class EventLoop:
+    """Cooperative scheduler: per-actor FIFO inboxes + timer heap + IO.
+
+    IO sources register a (fileno, callback) pair; in virtual-clock mode IO
+    is driven by tests injecting messages instead (mock sockets).
+    """
+
+    def __init__(self, clock=None):
+        self.clock = clock if clock is not None else RealClock()
+        self.actors: dict[str, Actor] = {}
+        self._inboxes: dict[str, deque] = {}
+        self._ready: deque[str] = deque()
+        self._timers: list[_TimerEntry] = []
+        self._seq = itertools.count()
+        self._crashed: dict[str, BaseException] = {}
+        self._supervisor: Callable[[ActorCrashed], None] | None = None
+        self._stopping = False
+
+    # -- actors
+
+    def register(self, actor: Actor, name: str | None = None) -> None:
+        name = name or actor.name
+        if name in self.actors:
+            raise ValueError(f"actor {name!r} already registered")
+        actor.name = name
+        actor.attach(self)
+        self.actors[name] = actor
+        self._inboxes[name] = deque()
+
+    def unregister(self, name: str) -> None:
+        actor = self.actors.pop(name, None)
+        self._inboxes.pop(name, None)
+        self._crashed.pop(name, None)
+        if actor is not None:
+            actor.on_stop()
+
+    def set_supervisor(self, fn: Callable[[ActorCrashed], None]) -> None:
+        self._supervisor = fn
+
+    # -- messaging
+
+    def send(self, actor: str, msg: Any) -> bool:
+        """Enqueue msg to actor's inbox; False if actor unknown/crashed."""
+        if actor not in self._inboxes or actor in self._crashed:
+            return False
+        self._inboxes[actor].append(msg)
+        self._ready.append(actor)
+        return True
+
+    # -- timers
+
+    def timer(self, actor: str, msg_fn: Callable[[], Any]) -> Timer:
+        return Timer(self, actor, msg_fn)
+
+    def _arm(self, t: Timer) -> int:
+        seq = next(self._seq)
+        heapq.heappush(self._timers, _TimerEntry(t.deadline, seq, t))
+        return seq
+
+    def next_deadline(self) -> float | None:
+        while self._timers:
+            e = self._timers[0]
+            if e.timer._armed_seq == e.seq:
+                return e.deadline
+            heapq.heappop(self._timers)  # stale (canceled/reset)
+        return None
+
+    # -- scheduling
+
+    def _deliver_one(self) -> bool:
+        while self._ready:
+            name = self._ready.popleft()
+            inbox = self._inboxes.get(name)
+            if not inbox:
+                continue
+            msg = inbox.popleft()
+            actor = self.actors.get(name)
+            if actor is None:
+                continue
+            try:
+                actor.handle(msg)
+            except Exception as exc:  # crash containment
+                log.exception("actor %s crashed", name)
+                self._crashed[name] = exc
+                if self._supervisor:
+                    self._supervisor(ActorCrashed(name, exc))
+            return True
+        return False
+
+    def _fire_due_timers(self) -> bool:
+        fired = False
+        now = self.clock.now()
+        while self._timers:
+            e = self._timers[0]
+            if e.timer._armed_seq != e.seq:
+                heapq.heappop(self._timers)
+                continue
+            if e.deadline > now:
+                break
+            heapq.heappop(self._timers)
+            e.timer._fire(e.seq)
+            fired = True
+        return fired
+
+    def run_until_idle(self) -> int:
+        """Deliver messages + due timers until quiescent.  Returns count."""
+        n = 0
+        progress = True
+        while progress:
+            progress = False
+            if self._fire_due_timers():
+                progress = True
+            while self._deliver_one():
+                n += 1
+                progress = True
+        return n
+
+    def advance(self, dt: float) -> int:
+        """(Virtual clock) move time forward, firing timers in deadline
+        order and draining all resulting messages at each firing instant."""
+        if not isinstance(self.clock, VirtualClock):
+            raise RuntimeError("advance() requires VirtualClock")
+        target = self.clock.now() + dt
+        n = self.run_until_idle()
+        while True:
+            nd = self.next_deadline()
+            if nd is None or nd > target:
+                break
+            self.clock._now = max(self.clock._now, nd)
+            n += self.run_until_idle()
+        self.clock._now = target
+        return n

@@ -1,0 +1,252 @@
+"""End-to-end OSPFv2 convergence on the in-memory fabric (virtual clock).
+
+The multi-router analog of the reference's conformance topologies
+(holo-ospf/tests/conformance): real instances exchange real packets over
+MockFabric links; we assert adjacency, LSDB synchronization, and RIB
+contents — then inject a link failure and assert reconvergence.
+"""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+import pytest
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType, IsmState
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+AREA0 = A("0.0.0.0")
+
+
+def mk_router(loop, fabric, name, rid):
+    inst = OspfInstance(
+        name=name,
+        config=InstanceConfig(router_id=A(rid)),
+        netio=fabric.sender_for(name),
+    )
+    loop.register(inst)
+    return inst
+
+
+def p2p_link(fabric, link, a, a_if, a_addr, b, b_if, b_addr, net, cost=10):
+    cfg = IfConfig(area_id=AREA0, if_type=IfType.POINT_TO_POINT, cost=cost)
+    a.add_interface(a_if, cfg, N(net), A(a_addr))
+    b.add_interface(b_if, cfg, N(net), A(b_addr))
+    fabric.join(link, a.name, a_if, A(a_addr))
+    fabric.join(link, b.name, b_if, A(b_addr))
+
+
+def lan_link(fabric, link, members, net, cost=10, prios=None):
+    # members: list of (inst, ifname, addr)
+    for i, (inst, ifname, addr) in enumerate(members):
+        prio = 1 if prios is None else prios[i]
+        cfg = IfConfig(area_id=AREA0, if_type=IfType.BROADCAST, cost=cost,
+                       priority=prio)
+        inst.add_interface(ifname, cfg, N(net), A(addr))
+        fabric.join(link, inst.name, ifname, A(addr))
+
+
+def bring_up(loop, routers, seconds=60):
+    from holo_tpu.protocols.ospf.instance import IfUpMsg
+
+    for r in routers:
+        for area in r.areas.values():
+            for ifname in area.interfaces:
+                loop.send(r.name, IfUpMsg(ifname))
+    loop.advance(seconds)
+
+
+def full_neighbors(r):
+    out = []
+    for area in r.areas.values():
+        for iface in area.interfaces.values():
+            for nbr in iface.neighbors.values():
+                if nbr.state == NsmState.FULL:
+                    out.append(nbr.router_id)
+    return out
+
+
+def lsdb_image(r):
+    imgs = {}
+    for aid, area in r.areas.items():
+        imgs[aid] = sorted(
+            (k.type, str(k.lsid), str(k.adv_rtr), e.lsa.seq_no, e.lsa.raw[20:])
+            for k, e in area.lsdb.entries.items()
+        )
+    return imgs
+
+
+def test_master_learns_slave_only_lsa():
+    """Regression (§10.8): the slave's negotiation-DD reply carries LSA
+    headers the master must process — a slave-only LSA must reach the
+    master's LSDB, not silently vanish."""
+    from holo_tpu.protocols.ospf.packet import (
+        Lsa, LsaSummary, LsaType, Options,
+    )
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")  # lower RID -> slave
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")  # higher RID -> master
+    p2p_link(fabric, "l12", r1, "eth0", "10.0.12.1", r2, "eth0", "10.0.12.2",
+             "10.0.12.0/30")
+    # Seed a third-party LSA into the slave's LSDB only.
+    foreign = Lsa(10, Options.E, LsaType.SUMMARY_NETWORK, A("172.16.0.0"),
+                  A("9.9.9.9"), -100, LsaSummary(A("255.255.0.0"), 7))
+    foreign.encode()
+    r1.areas[AREA0].lsdb.install(foreign, 0.0)
+    bring_up(loop, [r1, r2])
+    assert full_neighbors(r1) == [A("2.2.2.2")]
+    assert r2.areas[AREA0].lsdb.get(foreign.key) is not None, (
+        "master never requested the slave-only LSA"
+    )
+    assert lsdb_image(r1) == lsdb_image(r2)
+
+
+def test_spf_holddown_backoff_under_churn():
+    """RFC 8405: sustained churn must back off to long_delay, not run SPF
+    at initial_delay frequency forever."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    p2p_link(fabric, "l12", r1, "eth0", "10.0.12.1", r2, "eth0", "10.0.12.2",
+             "10.0.12.0/30")
+    bring_up(loop, [r1, r2])
+    runs_before = r1.spf_run_count
+    # Churn: flap the link every 2 simulated seconds for 60s.
+    for _ in range(15):
+        fabric.set_link_up("l12", False)
+        loop.advance(2)
+        fabric.set_link_up("l12", True)
+        loop.advance(2)
+    churn_runs = r1.spf_run_count - runs_before
+    # long_delay=5s over 60s of churn: well under once per 2s.
+    assert churn_runs <= 61 // 5 + 3, f"SPF ran {churn_runs} times under churn"
+
+
+def test_two_routers_p2p_full_and_routes():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    p2p_link(fabric, "l12", r1, "eth0", "10.0.12.1", r2, "eth0", "10.0.12.2",
+             "10.0.12.0/30")
+    bring_up(loop, [r1, r2])
+
+    assert full_neighbors(r1) == [A("2.2.2.2")]
+    assert full_neighbors(r2) == [A("1.1.1.1")]
+    assert lsdb_image(r1) == lsdb_image(r2)
+    # Both see the p2p stub route.
+    assert N("10.0.12.0/30") in r1.routes
+    assert N("10.0.12.0/30") in r2.routes
+
+
+def test_three_router_chain_transit_routes():
+    """r1 -- r2 -- r3 chain: r1 must route to r3's stub via r2."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")
+    p2p_link(fabric, "l12", r1, "eth0", "10.0.12.1", r2, "eth0", "10.0.12.2",
+             "10.0.12.0/30", cost=10)
+    p2p_link(fabric, "l23", r2, "eth1", "10.0.23.1", r3, "eth0", "10.0.23.2",
+             "10.0.23.0/30", cost=5)
+    bring_up(loop, [r1, r2, r3])
+
+    assert sorted(map(str, full_neighbors(r2))) == ["1.1.1.1", "3.3.3.3"]
+    assert lsdb_image(r1) == lsdb_image(r2) == lsdb_image(r3)
+    # r1 -> 10.0.23.0/30 via r2 at cost 10+5.
+    route = r1.routes.get(N("10.0.23.0/30"))
+    assert route is not None and route.dist == 15
+    nhs = {(nh.ifname, str(nh.addr)) for nh in route.nexthops}
+    assert nhs == {("eth0", "10.0.12.2")}
+    # r3 -> 10.0.12.0/30 via r2 at cost 5+10.
+    route = r3.routes.get(N("10.0.12.0/30"))
+    assert route is not None and route.dist == 15
+
+
+def test_broadcast_lan_dr_election_and_network_lsa():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")
+    lan_link(fabric, "lan0", [(r1, "eth0", "10.0.0.1"), (r2, "eth0", "10.0.0.2"),
+                              (r3, "eth0", "10.0.0.3")], "10.0.0.0/24")
+    bring_up(loop, [r1, r2, r3], seconds=120)
+
+    # Highest RID (equal priorities) should be DR.
+    states = {}
+    for r in (r1, r2, r3):
+        iface = r.areas[AREA0].interfaces["eth0"]
+        states[r.name] = (iface.state, str(iface.dr), str(iface.bdr))
+    assert states["r3"][0] == IsmState.DR
+    assert states["r2"][0] == IsmState.BACKUP
+    assert states["r1"][0] == IsmState.DR_OTHER
+    assert all(s[1] == "10.0.0.3" for s in states.values())
+    # All adjacent to DR/BDR; LSDBs synced; network LSA present.
+    assert lsdb_image(r1) == lsdb_image(r2) == lsdb_image(r3)
+    from holo_tpu.protocols.ospf.packet import LsaType
+
+    nets = [k for k in r1.areas[AREA0].lsdb.entries if k.type == LsaType.NETWORK]
+    assert len(nets) == 1 and nets[0].adv_rtr == A("3.3.3.3")
+    # Everyone routes the LAN prefix.
+    for r in (r1, r2, r3):
+        assert N("10.0.0.0/24") in r.routes
+
+
+def test_link_failure_reconvergence():
+    """Square topology: r1-r2-r4, r1-r3-r4; fail r1-r2, traffic shifts."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    rs = {n: mk_router(loop, fabric, n, rid) for n, rid in
+          [("r1", "1.1.1.1"), ("r2", "2.2.2.2"), ("r3", "3.3.3.3"), ("r4", "4.4.4.4")]}
+    r1, r2, r3, r4 = rs["r1"], rs["r2"], rs["r3"], rs["r4"]
+    p2p_link(fabric, "l12", r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2", "10.0.12.0/30", cost=1)
+    p2p_link(fabric, "l13", r1, "e1", "10.0.13.1", r3, "e0", "10.0.13.2", "10.0.13.0/30", cost=5)
+    p2p_link(fabric, "l24", r2, "e1", "10.0.24.1", r4, "e0", "10.0.24.2", "10.0.24.0/30", cost=1)
+    p2p_link(fabric, "l34", r3, "e1", "10.0.34.1", r4, "e1", "10.0.34.2", "10.0.34.0/30", cost=5)
+    bring_up(loop, rs.values(), seconds=90)
+
+    # Shortest r1->r4 is via r2 (cost 2 to reach 10.0.24.0/30).
+    route = r1.routes.get(N("10.0.24.0/30"))
+    assert route is not None and route.dist == 2
+    assert {nh.ifname for nh in route.nexthops} == {"e0"}
+
+    # Fail the r1-r2 link: dead interval expires, reconverge via r3.
+    fabric.set_link_up("l12", False)
+    loop.advance(120)
+    route = r1.routes.get(N("10.0.24.0/30"))
+    assert route is not None, "route lost after reconvergence"
+    assert {nh.ifname for nh in route.nexthops} == {"e1"}
+    assert route.dist == 5 + 5 + 1
+
+
+def test_ecmp_on_equal_cost_paths():
+    """Two equal-cost paths r1->r4 must produce two next hops."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    rs = {n: mk_router(loop, fabric, n, rid) for n, rid in
+          [("r1", "1.1.1.1"), ("r2", "2.2.2.2"), ("r3", "3.3.3.3"), ("r4", "4.4.4.4")]}
+    r1, r2, r3, r4 = rs["r1"], rs["r2"], rs["r3"], rs["r4"]
+    p2p_link(fabric, "l12", r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2", "10.0.12.0/30", cost=1)
+    p2p_link(fabric, "l13", r1, "e1", "10.0.13.1", r3, "e0", "10.0.13.2", "10.0.13.0/30", cost=1)
+    p2p_link(fabric, "l24", r2, "e1", "10.0.24.1", r4, "e0", "10.0.24.2", "10.0.24.0/30", cost=1)
+    p2p_link(fabric, "l34", r3, "e1", "10.0.34.1", r4, "e1", "10.0.34.2", "10.0.34.0/30", cost=1)
+    # r4 loopback-ish stub via an extra LAN it alone sits on:
+    lan_link(fabric, "lan4", [(r4, "e2", "192.168.4.1")], "192.168.4.0/24")
+    bring_up(loop, rs.values(), seconds=90)
+
+    route = r1.routes.get(N("192.168.4.0/24"))
+    assert route is not None
+    assert {nh.ifname for nh in route.nexthops} == {"e0", "e1"}
+    nhs = {str(nh.addr) for nh in route.nexthops}
+    assert nhs == {"10.0.12.2", "10.0.13.2"}

@@ -1,0 +1,112 @@
+"""Runtime core: deterministic scheduling, timers, crash containment, ibus."""
+
+from dataclasses import dataclass
+
+from holo_tpu.utils.ibus import TOPIC_INTERFACE_UPD, Ibus, IbusMsg
+from holo_tpu.utils.runtime import Actor, EventLoop, VirtualClock
+
+
+@dataclass
+class Ping:
+    n: int
+
+
+class Recorder(Actor):
+    def __init__(self, name):
+        self.name = name
+        self.got = []
+
+    def handle(self, msg):
+        self.got.append(msg)
+
+
+class Crasher(Actor):
+    name = "crasher"
+
+    def handle(self, msg):
+        raise RuntimeError("boom")
+
+
+def mkloop():
+    return EventLoop(clock=VirtualClock())
+
+
+def test_fifo_delivery():
+    loop = mkloop()
+    a = Recorder("a")
+    loop.register(a)
+    for i in range(5):
+        loop.send("a", Ping(i))
+    loop.run_until_idle()
+    assert [m.n for m in a.got] == [0, 1, 2, 3, 4]
+
+
+def test_timers_fire_in_deadline_order():
+    loop = mkloop()
+    a = Recorder("a")
+    loop.register(a)
+    t2 = loop.timer("a", lambda: Ping(2))
+    t1 = loop.timer("a", lambda: Ping(1))
+    t3 = loop.timer("a", lambda: Ping(3))
+    t2.start(2.0)
+    t1.start(1.0)
+    t3.start(3.0)
+    loop.advance(2.5)
+    assert [m.n for m in a.got] == [1, 2]
+    assert t3.armed and t3.remaining() == 0.5
+    loop.advance(1.0)
+    assert [m.n for m in a.got] == [1, 2, 3]
+
+
+def test_timer_reset_and_cancel():
+    loop = mkloop()
+    a = Recorder("a")
+    loop.register(a)
+    t = loop.timer("a", lambda: Ping(9))
+    t.start(1.0)
+    loop.advance(0.9)
+    t.reset(1.0)  # push deadline out
+    loop.advance(0.9)
+    assert a.got == []
+    loop.advance(0.2)
+    assert [m.n for m in a.got] == [9]
+    t.start(1.0)
+    t.cancel()
+    loop.advance(5.0)
+    assert len(a.got) == 1
+
+
+def test_crash_containment_and_supervision():
+    loop = mkloop()
+    a = Recorder("a")
+    crashed = []
+    loop.register(a)
+    loop.register(Crasher())
+    loop.set_supervisor(lambda c: crashed.append(c.actor))
+    loop.send("crasher", Ping(0))
+    loop.send("a", Ping(1))
+    loop.run_until_idle()
+    assert crashed == ["crasher"]
+    assert [m.n for m in a.got] == [1]  # other actors unaffected
+    assert not loop.send("crasher", Ping(2))  # crashed actor stops receiving
+
+
+def test_ibus_filtered_pubsub():
+    loop = mkloop()
+    a, b = Recorder("a"), Recorder("b")
+    loop.register(a)
+    loop.register(b)
+    bus = Ibus(loop)
+    bus.subscribe(TOPIC_INTERFACE_UPD, "a")
+    bus.subscribe(TOPIC_INTERFACE_UPD, "b", ifname="eth0")
+    bus.publish(TOPIC_INTERFACE_UPD, {"mtu": 1500}, ifname="eth1")
+    loop.run_until_idle()
+    assert len(a.got) == 1 and len(b.got) == 0
+    bus.publish(TOPIC_INTERFACE_UPD, {"mtu": 9000}, ifname="eth0")
+    loop.run_until_idle()
+    assert len(a.got) == 2 and len(b.got) == 1
+    assert isinstance(b.got[0], IbusMsg)
+    bus.unsubscribe_all("a")
+    bus.publish(TOPIC_INTERFACE_UPD, {}, ifname="eth0")
+    loop.run_until_idle()
+    assert len(a.got) == 2
