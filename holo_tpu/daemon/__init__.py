@@ -1,0 +1,7 @@
+"""Daemon core: process assembly, static config, gRPC northbound.
+
+Reference: holo-daemon (SURVEY.md §2.1, §3.1) — entry point, TOML static
+config, provider startup order, northbound transaction engine, gRPC
+service.  Privilege handling and netlink programming are gated behind the
+kernel interface (mock by default; Linux netlink when running as root).
+"""

@@ -1,0 +1,61 @@
+"""Static daemon config (TOML), parsed at boot.
+
+Reference: holo-daemon/src/config.rs + holod.toml — user/group, db path,
+logging, plugin addresses.  Runtime routing config flows through the
+northbound transaction engine instead.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    style: str = "compact"  # compact | full | json
+    file: str | None = None
+
+
+@dataclass
+class GrpcConfig:
+    enabled: bool = True
+    address: str = "127.0.0.1:50051"
+
+
+@dataclass
+class EventRecorderConfig:
+    enabled: bool = False
+    dir: str = "/tmp/holo_tpu-events"
+
+
+@dataclass
+class DaemonConfig:
+    db_path: str | None = None
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    grpc: GrpcConfig = field(default_factory=GrpcConfig)
+    event_recorder: EventRecorderConfig = field(default_factory=EventRecorderConfig)
+
+    @classmethod
+    def load(cls, path: str | Path | None) -> "DaemonConfig":
+        cfg = cls()
+        if path is None or not Path(path).exists():
+            return cfg
+        raw = tomllib.loads(Path(path).read_text())
+        if "database" in raw:
+            cfg.db_path = raw["database"].get("path")
+        if "logging" in raw:
+            for k in ("level", "style", "file"):
+                if k in raw["logging"]:
+                    setattr(cfg.logging, k, raw["logging"][k])
+        if "grpc" in raw:
+            g = raw["grpc"]
+            cfg.grpc.enabled = g.get("enabled", True)
+            cfg.grpc.address = g.get("address", cfg.grpc.address)
+        if "event_recorder" in raw:
+            e = raw["event_recorder"]
+            cfg.event_recorder.enabled = e.get("enabled", False)
+            cfg.event_recorder.dir = e.get("dir", cfg.event_recorder.dir)
+        return cfg

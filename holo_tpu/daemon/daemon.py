@@ -1,0 +1,138 @@
+"""Daemon assembly: loop + ibus + providers + northbound + gRPC.
+
+Reference startup order: holo-daemon/src/northbound/core.rs:670-731
+(interface → keychain → policy → system → routing), clients after
+providers (:734-755).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+from holo_tpu.daemon.config import DaemonConfig
+from holo_tpu.daemon.providers import (
+    InterfaceProvider,
+    KeychainProvider,
+    PolicyProvider,
+    RoutingProvider,
+    SystemProvider,
+)
+from holo_tpu.northbound.core import Northbound
+from holo_tpu.routing.rib import Kernel
+from holo_tpu.utils.ibus import Ibus
+from holo_tpu.utils.netio import MockFabric, NetIo
+from holo_tpu.utils.runtime import EventLoop, RealClock, VirtualClock
+from holo_tpu.yang.modules import full_schema
+
+log = logging.getLogger("holo_tpu.daemon")
+
+
+class Daemon:
+    """One holo_tpu daemon process (testable in-process: pass a virtual
+    clock and a MockFabric netio)."""
+
+    def __init__(
+        self,
+        config: DaemonConfig | None = None,
+        clock=None,
+        netio: NetIo | None = None,
+        kernel: Kernel | None = None,
+        loop: EventLoop | None = None,
+        name: str = "",
+    ):
+        """``loop``/``name`` support multi-daemon simulations: several
+        daemons sharing one virtual-clock loop get name-prefixed actors."""
+        import threading
+
+        self.config = config or DaemonConfig()
+        self.loop = loop if loop is not None else EventLoop(clock=clock or RealClock())
+        # The EventLoop is single-threaded by design; every external entry
+        # point (gRPC worker threads, the main timer loop) must hold this
+        # lock around loop access.
+        self.lock = threading.RLock()
+        self.name = name
+        self._p = f"{name}." if name else ""
+        self.ibus = Ibus(self.loop)
+        self.fabric = None
+        if netio is None:
+            self.fabric = MockFabric(self.loop)
+            netio = self.fabric.sender_for
+        elif isinstance(netio, MockFabric):
+            self.fabric = netio
+            netio = netio.sender_for
+        self.netio = netio
+
+        # Providers in reference startup order.
+        self.interface = InterfaceProvider(self.ibus)
+        self.keychain = KeychainProvider(self.ibus)
+        self.policy = PolicyProvider(self.ibus)
+        self.system = SystemProvider(self.ibus)
+        self.routing = RoutingProvider(
+            self.loop, self.ibus, netio, self.interface, kernel, prefix=self._p
+        )
+        for p in (self.interface, self.keychain, self.policy, self.system, self.routing):
+            self.loop.register(p, name=self._p + p.name)
+
+        db = Path(self.config.db_path) if self.config.db_path else None
+        self.northbound = Northbound(
+            full_schema(),
+            [self.interface, self.keychain, self.policy, self.system, self.routing],
+            db_path=db,
+        )
+        self._grpc_server = None
+
+    # -- config entry points
+
+    def candidate(self):
+        with self.lock:
+            return self.northbound.running.copy()
+
+    def commit(self, candidate, **kw):
+        with self.lock:
+            txn = self.northbound.commit(candidate, **kw)
+            self.loop.run_until_idle()
+            return txn
+
+    # -- gRPC
+
+    def start_grpc(self, address: str | None = None):
+        from holo_tpu.daemon.grpc_server import serve
+
+        self._grpc_server = serve(self, address or self.config.grpc.address)
+        return self._grpc_server
+
+    def stop(self):
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=0.5)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="holo-tpu-daemon")
+    ap.add_argument("-f", "--config", default=None, help="TOML static config")
+    args = ap.parse_args(argv)
+    cfg = DaemonConfig.load(args.config)
+    logging.basicConfig(level=getattr(logging, cfg.logging.level.upper(), logging.INFO))
+    daemon = Daemon(config=cfg)
+    if cfg.grpc.enabled:
+        daemon.start_grpc()
+        log.info("gRPC northbound on %s", cfg.grpc.address)
+    log.info("holo_tpu daemon running")
+    try:
+        import time
+
+        while True:  # timers/IO loop; real IO integration lands with netlink
+            with daemon.lock:
+                daemon.loop.run_until_idle()
+                daemon.northbound.check_confirmed_timeout(time.time())
+                nd = daemon.loop.next_deadline()
+                now = daemon.loop.clock.now()
+            time.sleep(min(max(nd - now, 0.01), 1.0) if nd else 0.2)
+    except KeyboardInterrupt:
+        daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,369 @@
+"""Base system providers: interface, system, keychain, policy, routing.
+
+Reference: SURVEY.md §2.2 — each is an actor + northbound provider + ibus
+server.  The routing provider owns the RIB manager and spawns/stops
+protocol instances from configuration (the reference does this in
+holo-routing/src/northbound/configuration.rs:1228-1301).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, ip_interface
+
+from holo_tpu.northbound.provider import CommitPhase, Provider
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+    SpfTimers,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.routing.rib import Kernel, MockKernel, RibManager
+from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+from holo_tpu.utils.ibus import (
+    TOPIC_ADDRESS_ADD,
+    TOPIC_HOSTNAME,
+    TOPIC_INTERFACE_UPD,
+    TOPIC_KEYCHAIN_UPD,
+    TOPIC_POLICY_UPD,
+    TOPIC_ROUTER_ID,
+    Ibus,
+)
+from holo_tpu.utils.netio import NetIo
+from holo_tpu.utils.runtime import Actor, EventLoop
+from holo_tpu.utils.southbound import InterfaceUpdMsg
+
+
+@dataclass
+class IfaceState:
+    name: str
+    ifindex: int
+    mtu: int = 1500
+    enabled: bool = True
+    operative: bool = True
+    addresses: list = field(default_factory=list)
+
+
+class InterfaceProvider(Provider, Actor):
+    """Interface table owner.  In the daemon this mirrors the OS via
+    netlink (holo-interface/src/netlink.rs); under test it is driven by
+    config + synthetic link events."""
+
+    name = "interface"
+    subtree_prefixes = ("interfaces",)
+
+    def __init__(self, ibus: Ibus):
+        self.ibus = ibus
+        self.interfaces: dict[str, IfaceState] = {}
+        self._next_ifindex = 1
+
+    def handle(self, msg):
+        pass
+
+    def commit(self, phase, old, new, changes):
+        if phase != CommitPhase.APPLY:
+            return
+        conf = new.get("interfaces/interface", {}) or {}
+        for name, entry in conf.items():
+            st = self.interfaces.get(name)
+            if st is None:
+                st = IfaceState(name=name, ifindex=self._next_ifindex)
+                self._next_ifindex += 1
+                self.interfaces[name] = st
+            st.mtu = entry.get("mtu", 1500)
+            st.enabled = entry.get("enabled", True)
+            st.addresses = [ip_interface(a) for a in entry.get("address", [])]
+            self.ibus.publish(
+                TOPIC_INTERFACE_UPD,
+                InterfaceUpdMsg(ifname=name, ifindex=st.ifindex, mtu=st.mtu,
+                                operative=st.enabled),
+                ifname=name,
+            )
+            for addr in st.addresses:
+                self.ibus.publish(TOPIC_ADDRESS_ADD, (name, addr), ifname=name)
+        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
+
+        for name in list(self.interfaces):
+            if name not in conf:
+                del self.interfaces[name]
+                self.ibus.publish(TOPIC_INTERFACE_DEL, name, ifname=name)
+        self._publish_router_id()
+
+    def _publish_router_id(self):
+        """Router-ID derivation: highest interface address (reference
+        holo-interface/src/interface.rs Router-ID logic)."""
+        best = None
+        for st in self.interfaces.values():
+            for a in st.addresses:
+                if a.version == 4 and (best is None or int(a.ip) > int(best)):
+                    best = a.ip
+        self.ibus.publish(TOPIC_ROUTER_ID, best)
+
+    def get_state(self, path=None):
+        return {
+            "interfaces": {
+                "interface": {
+                    name: {
+                        "name": name,
+                        "if-index": st.ifindex,
+                        "oper-status": "up" if st.operative else "down",
+                        "mtu": st.mtu,
+                    }
+                    for name, st in self.interfaces.items()
+                }
+            }
+        }
+
+
+class SystemProvider(Provider, Actor):
+    name = "system"
+    subtree_prefixes = ("system",)
+
+    def __init__(self, ibus: Ibus):
+        self.ibus = ibus
+        self.hostname = ""
+
+    def handle(self, msg):
+        pass
+
+    def commit(self, phase, old, new, changes):
+        if phase != CommitPhase.APPLY:
+            return
+        hostname = new.get("system/hostname")
+        if hostname != self.hostname:
+            self.hostname = hostname or ""
+            self.ibus.publish(TOPIC_HOSTNAME, self.hostname)
+
+    def get_state(self, path=None):
+        return {"system": {"hostname": self.hostname}}
+
+
+class KeychainProvider(Provider, Actor):
+    name = "keychain"
+    subtree_prefixes = ("key-chains",)
+
+    def __init__(self, ibus: Ibus):
+        self.ibus = ibus
+        self.keychains: dict = {}
+
+    def handle(self, msg):
+        pass
+
+    def commit(self, phase, old, new, changes):
+        if phase != CommitPhase.APPLY:
+            return
+        self.keychains = new.get("key-chains/key-chain", {}) or {}
+        for name in self.keychains:
+            self.ibus.publish(TOPIC_KEYCHAIN_UPD, name)
+
+
+class PolicyProvider(Provider, Actor):
+    name = "policy"
+    subtree_prefixes = ("routing-policy",)
+
+    def __init__(self, ibus: Ibus):
+        self.ibus = ibus
+        self.policies: dict = {}
+        self.defined_sets: dict = {}
+
+    def handle(self, msg):
+        pass
+
+    def commit(self, phase, old, new, changes):
+        if phase != CommitPhase.APPLY:
+            return
+        self.policies = new.get("routing-policy/policy-definition", {}) or {}
+        self.defined_sets = new.get("routing-policy/defined-sets", {}) or {}
+        for name in self.policies:
+            self.ibus.publish(TOPIC_POLICY_UPD, name)
+
+
+class RoutingProvider(Provider, Actor):
+    """RIB owner + protocol instance lifecycle from configuration."""
+
+    name = "routing"
+    subtree_prefixes = ("routing",)
+
+    def __init__(
+        self,
+        loop: EventLoop,
+        ibus: Ibus,
+        netio,
+        interface_provider: InterfaceProvider,
+        kernel: Kernel | None = None,
+        prefix: str = "",
+    ):
+        self.loop = loop
+        self.ibus = ibus
+        # netio: either a NetIo (shared sender) or a callable actor->NetIo
+        # (MockFabric.sender_for) so each protocol actor receives its own
+        # bound transmit handle.
+        self.netio_factory = netio if callable(netio) else (lambda _actor: netio)
+        self.ifp = interface_provider
+        self.prefix = prefix
+        self.rib = RibManager(ibus, kernel or MockKernel())
+        self.instances: dict[str, OspfInstance] = {}
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        loop_.register(self.rib, name=f"{self.prefix}routing-rib")
+        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
+
+        self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
+
+    def handle(self, msg):
+        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL, IbusMsg
+
+        if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
+            # Interface removed from the system: down it in every protocol
+            # instance that uses it (stops hellos, withdraws the subnet).
+            from holo_tpu.protocols.ospf.instance import IfDownMsg
+
+            ifname = msg.payload
+            for inst in self.instances.values():
+                if ifname in inst._if_area:
+                    self.loop.send(inst.name, IfDownMsg(ifname))
+
+    def commit(self, phase, old, new, changes):
+        if phase != CommitPhase.APPLY:
+            return
+        self._apply_ospfv2(new)
+        self._apply_static(new)
+
+    # -- OSPFv2 lifecycle (holo-routing northbound/configuration.rs analog)
+
+    def _apply_ospfv2(self, new):
+        base = "routing/control-plane-protocols/ospfv2"
+        conf = new.get(base)
+        enabled = bool(conf) and new.get(f"{base}/enabled", True)
+        inst = self.instances.get("ospfv2")
+        if not enabled:
+            if inst is not None:
+                # Withdraw every route the instance installed before it goes
+                # (reference: instance stop purges its RIB contributions).
+                from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+
+                for prefix in inst.routes:
+                    self.rib.route_del(RouteKeyMsg(Protocol.OSPFV2, prefix))
+                self.loop.unregister(inst.name)
+                del self.instances["ospfv2"]
+            return
+        router_id = new.get(f"{base}/router-id")
+        if router_id is None:
+            return  # not ready (reference: instance waits for router-id)
+        spf = new.get(f"{base}/spf-control", {}) or {}
+        delay = spf.get("ietf-spf-delay", {}) or {}
+        timers = SpfTimers(
+            initial_delay=delay.get("initial-delay", 50) / 1000,
+            short_delay=delay.get("short-delay", 200) / 1000,
+            long_delay=delay.get("long-delay", 5000) / 1000,
+            hold_down=delay.get("hold-down", 10000) / 1000,
+            time_to_learn=delay.get("time-to-learn", 500) / 1000,
+        )
+        backend_name = spf.get("backend", "scalar")
+        backend = TpuSpfBackend() if backend_name == "tpu" else ScalarSpfBackend()
+        if inst is None:
+            inst = OspfInstance(
+                name=f"{self.prefix}ospfv2",
+                config=InstanceConfig(router_id=IPv4Address(router_id), spf=timers),
+                netio=self.netio_factory(f"{self.prefix}ospfv2"),
+                spf_backend=backend,
+            )
+            self.loop.register(inst)
+            inst.attach_ibus(self.ibus, routing_actor=f"{self.prefix}routing-rib")
+            self.instances["ospfv2"] = inst
+        else:
+            inst.config.router_id = IPv4Address(router_id)
+            inst.config.spf = timers
+            inst.backend = backend
+
+        areas = new.get(f"{base}/area", {}) or {}
+        for area_id, area_conf in areas.items():
+            for ifname, if_conf in (area_conf.get("interface") or {}).items():
+                if ifname in inst._if_area:
+                    continue  # reconfig of existing interfaces: later round
+                st = self.ifp.interfaces.get(ifname)
+                if st is None or not st.addresses:
+                    continue
+                addr = st.addresses[0].network
+                host = st.addresses[0].ip
+                cfg = IfConfig(
+                    area_id=IPv4Address(area_id),
+                    if_type=(
+                        IfType.POINT_TO_POINT
+                        if if_conf.get("interface-type") == "point-to-point"
+                        else IfType.BROADCAST
+                    ),
+                    cost=if_conf.get("cost", 10),
+                    hello_interval=if_conf.get("hello-interval", 10),
+                    dead_interval=if_conf.get("dead-interval", 40),
+                    rxmt_interval=if_conf.get("retransmit-interval", 5),
+                    priority=if_conf.get("priority", 1),
+                    passive=if_conf.get("passive", False),
+                    mtu=st.mtu,
+                )
+                inst.add_interface(ifname, cfg, addr, host)
+                self.loop.send(inst.name, IfUpMsg(ifname))
+
+    def _apply_static(self, new):
+        from holo_tpu.utils.southbound import (
+            Nexthop,
+            Protocol,
+            RouteKeyMsg,
+            RouteMsg,
+        )
+
+        routes = new.get(
+            "routing/control-plane-protocols/static-routes/route", {}
+        ) or {}
+        # Withdraw statics removed from config.
+        new_prefixes = {r.get("prefix") for r in routes.values()}
+        for prefix in getattr(self, "_static_prefixes", set()) - new_prefixes:
+            self.rib.route_del(RouteKeyMsg(Protocol.STATIC, prefix))
+        self._static_prefixes = {p for p in new_prefixes if p is not None}
+        for _key, r in routes.items():
+            prefix = r.get("prefix")
+            if prefix is None:
+                continue
+            nhs = set()
+            if r.get("next-hop") is not None:
+                nhs.add(Nexthop(addr=r["next-hop"], ifname=r.get("interface")))
+            elif r.get("interface"):
+                nhs.add(Nexthop(ifname=r["interface"]))
+            self.rib.route_add(
+                RouteMsg(
+                    protocol=Protocol.STATIC,
+                    prefix=prefix,
+                    distance=1,
+                    metric=r.get("metric", 0),
+                    nexthops=frozenset(nhs),
+                )
+            )
+
+    def get_state(self, path=None):
+        rib = {
+            str(prefix): {
+                "protocol": msg.protocol.value,
+                "distance": msg.distance,
+                "metric": msg.metric,
+                "next-hops": sorted(
+                    f"{nh.ifname or ''}:{nh.addr or ''}" for nh in msg.nexthops
+                ),
+            }
+            for prefix, msg in self.rib.active_routes().items()
+        }
+        state = {"routing": {"rib": rib}}
+        ospf = self.instances.get("ospfv2")
+        if ospf is not None:
+            state["routing"]["ospfv2"] = {
+                "spf-run-count": ospf.spf_run_count,
+                "neighbors": {
+                    str(n.router_id): {"state": n.state.name.lower(), "iface": i.name}
+                    for a in ospf.areas.values()
+                    for i in a.interfaces.values()
+                    for n in i.neighbors.values()
+                },
+            }
+        return state

@@ -1,0 +1,11 @@
+"""Northbound framework: providers, callbacks, 3-phase transactions.
+
+Reference: holo-northbound (configuration.rs 3-phase commit, state.rs
+operational walks, rpc.rs) + holo-daemon/src/northbound/core.rs
+(transaction engine, rollback, confirmed commit).
+"""
+
+from holo_tpu.northbound.core import Northbound, Transaction
+from holo_tpu.northbound.provider import CommitPhase, Provider
+
+__all__ = ["Northbound", "Transaction", "CommitPhase", "Provider"]

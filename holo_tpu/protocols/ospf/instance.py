@@ -177,6 +177,13 @@ class OspfInstance(Actor):
         self._learn_deadline: float | None = None
         self.routes = {}
         self.spf_run_count = 0
+        self.ibus = None  # set via attach_ibus for RIB integration
+        self.routing_actor = "routing"
+
+    def attach_ibus(self, ibus, routing_actor: str = "routing") -> None:
+        """Wire route programming to the routing provider over the ibus."""
+        self.ibus = ibus
+        self.routing_actor = routing_actor
 
     # ----- wiring helpers
 
@@ -968,9 +975,48 @@ class OspfInstance(Actor):
                     route.dist == cur.dist and int(route.area_id) < int(cur.area_id)
                 ):
                     all_routes[prefix] = route
+        old = self.routes
         self.routes = all_routes
         if self.route_cb is not None:
             self.route_cb(all_routes)
+        if self.ibus is not None:
+            self._sync_rib(old, all_routes)
+
+    def _sync_rib(self, old: dict, new: dict) -> None:
+        """Publish route deltas to the routing provider (ibus route
+        install/uninstall — reference route.rs:894-906 → ibus.rs:344-351)."""
+        from holo_tpu.utils.southbound import (
+            Nexthop,
+            Protocol,
+            RouteKeyMsg,
+            RouteMsg,
+            DEFAULT_DISTANCE,
+        )
+
+        for prefix in old.keys() - new.keys():
+            self.ibus.request(
+                self.routing_actor,
+                RouteKeyMsg(Protocol.OSPFV2, prefix),
+                sender=self.name,
+            )
+        for prefix, route in new.items():
+            prev = old.get(prefix)
+            if prev is not None and prev.dist == route.dist and prev.nexthops == route.nexthops:
+                continue
+            nhs = frozenset(
+                Nexthop(addr=nh.addr, ifname=nh.ifname) for nh in route.nexthops
+            )
+            self.ibus.request(
+                self.routing_actor,
+                RouteMsg(
+                    protocol=Protocol.OSPFV2,
+                    prefix=prefix,
+                    distance=DEFAULT_DISTANCE[Protocol.OSPFV2],
+                    metric=route.dist,
+                    nexthops=nhs,
+                ),
+                sender=self.name,
+            )
 
     # ----- rx/tx plumbing
 

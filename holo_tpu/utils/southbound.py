@@ -53,6 +53,7 @@ class Nexthop:
     """Resolved next hop: address and/or outgoing interface (+MPLS labels)."""
 
     addr: IpAddr | None = None
+    ifname: str | None = None
     ifindex: int | None = None
     labels: tuple[int, ...] = ()
 

@@ -1,0 +1,263 @@
+"""Schema module definitions mirroring the IETF models the reference
+implements (holo-yang/modules/ietf/*): ietf-interfaces, ietf-routing with
+per-protocol subtrees, ietf-system, ietf-key-chain, ietf-routing-policy.
+
+These are our own declarative definitions shaped to the same northbound
+paths; full YANG-text parsing is a later layer (see package docstring).
+"""
+
+from __future__ import annotations
+
+from holo_tpu.yang.schema import C, L, Leaf, LeafList, Schema
+
+
+def _leaf(name, type="string", **kw):
+    return Leaf(name, type, **kw)
+
+
+def interfaces_module():
+    return C(
+        "interfaces",
+        L(
+            "interface",
+            "name",
+            _leaf("name"),
+            _leaf("description"),
+            _leaf("type", "enum", enum=("ethernet", "loopback", "vlan", "macvlan")),
+            _leaf("enabled", "boolean", default=True),
+            _leaf("mtu", "uint16", default=1500),
+            LeafList("address", "ifaddr"),  # host addr + prefix length
+        ),
+    )
+
+
+def system_module():
+    return C(
+        "system",
+        _leaf("hostname"),
+        _leaf("contact"),
+        _leaf("location"),
+    )
+
+
+def keychains_module():
+    return C(
+        "key-chains",
+        L(
+            "key-chain",
+            "name",
+            _leaf("name"),
+            L(
+                "key",
+                "key-id",
+                _leaf("key-id", "uint32"),
+                _leaf("key-string"),
+                _leaf("crypto-algorithm", "enum",
+                      enum=("md5", "hmac-sha-1", "hmac-sha-256", "hmac-sha-384",
+                            "hmac-sha-512")),
+            ),
+        ),
+    )
+
+
+def routing_policy_module():
+    return C(
+        "routing-policy",
+        C(
+            "defined-sets",
+            L("prefix-set", "name", _leaf("name"), LeafList("prefix", "prefix")),
+            L("tag-set", "name", _leaf("name"), LeafList("tag", "uint32")),
+        ),
+        L(
+            "policy-definition",
+            "name",
+            _leaf("name"),
+            L(
+                "statement",
+                "name",
+                _leaf("name"),
+                C(
+                    "conditions",
+                    _leaf("match-prefix-set"),
+                    _leaf("match-tag-set"),
+                ),
+                C(
+                    "actions",
+                    _leaf("policy-result", "enum",
+                          enum=("accept-route", "reject-route")),
+                    _leaf("set-metric", "uint32"),
+                    _leaf("set-tag", "uint32"),
+                ),
+            ),
+        ),
+    )
+
+
+def _spf_control():
+    return C(
+        "spf-control",
+        _leaf("paths", "uint16", default=16),
+        C(
+            "ietf-spf-delay",
+            _leaf("initial-delay", "uint32", default=50),
+            _leaf("short-delay", "uint32", default=200),
+            _leaf("long-delay", "uint32", default=5000),
+            _leaf("hold-down", "uint32", default=10000),
+            _leaf("time-to-learn", "uint32", default=500),
+        ),
+        _leaf("backend", "enum", enum=("scalar", "tpu"), default="scalar"),
+    )
+
+
+def _ospf_subtree(name):
+    return C(
+        name,
+        _leaf("router-id", "ip"),
+        _leaf("enabled", "boolean", default=True),
+        _spf_control(),
+        L(
+            "area",
+            "area-id",
+            _leaf("area-id"),
+            _leaf("area-type", "enum", enum=("normal", "stub", "nssa"),
+                  default="normal"),
+            L(
+                "interface",
+                "name",
+                _leaf("name"),
+                _leaf("interface-type", "enum",
+                      enum=("broadcast", "point-to-point"), default="broadcast"),
+                _leaf("cost", "uint16", default=10),
+                _leaf("hello-interval", "uint16", default=10),
+                _leaf("dead-interval", "uint32", default=40),
+                _leaf("retransmit-interval", "uint16", default=5),
+                _leaf("priority", "uint8", default=1),
+                _leaf("passive", "boolean", default=False),
+            ),
+        ),
+    )
+
+
+def _rip_subtree(name):
+    return C(
+        name,
+        _leaf("enabled", "boolean", default=True),
+        _leaf("update-interval", "uint16", default=30),
+        _leaf("invalid-interval", "uint16", default=180),
+        _leaf("flush-interval", "uint16", default=240),
+        L("interface", "name", _leaf("name"),
+          _leaf("cost", "uint8", default=1),
+          _leaf("split-horizon", "enum",
+                enum=("disabled", "simple", "poison-reverse"),
+                default="poison-reverse")),
+    )
+
+
+def _bgp_subtree():
+    return C(
+        "bgp",
+        _leaf("as", "uint32"),
+        _leaf("router-id", "ip"),
+        L(
+            "neighbor",
+            "address",
+            _leaf("address", "ip"),
+            _leaf("peer-as", "uint32"),
+            _leaf("hold-time", "uint16", default=90),
+            _leaf("connect-retry-interval", "uint16", default=30),
+            _leaf("import-policy"),
+            _leaf("export-policy"),
+        ),
+    )
+
+
+def _bfd_subtree():
+    return C(
+        "bfd",
+        L(
+            "session",
+            "dest-addr",
+            _leaf("dest-addr", "ip"),
+            _leaf("source-addr", "ip"),
+            _leaf("local-multiplier", "uint8", default=3),
+            _leaf("desired-min-tx-interval", "uint32", default=1000000),
+            _leaf("required-min-rx-interval", "uint32", default=1000000),
+        ),
+    )
+
+
+def _vrrp_subtree():
+    return C(
+        "vrrp",
+        L(
+            "instance",
+            "vrid",
+            _leaf("vrid", "uint8"),
+            _leaf("interface"),
+            _leaf("version", "enum", enum=("2", "3"), default="3"),
+            _leaf("priority", "uint8", default=100),
+            _leaf("advertise-interval", "uint16", default=1),
+            LeafList("virtual-address", "ip"),
+        ),
+    )
+
+
+def _static_subtree():
+    return C(
+        "static-routes",
+        L(
+            "route",
+            "prefix",
+            _leaf("prefix", "prefix"),
+            _leaf("next-hop", "ip"),
+            _leaf("interface"),
+            _leaf("metric", "uint32", default=0),
+        ),
+    )
+
+
+def routing_module():
+    """ietf-routing shaped: control-plane-protocols hosting each protocol."""
+    return C(
+        "routing",
+        _leaf("router-id", "ip"),
+        C(
+            "control-plane-protocols",
+            _ospf_subtree("ospfv2"),
+            _ospf_subtree("ospfv3"),
+            C("isis",
+              _leaf("enabled", "boolean", default=True),
+              _leaf("system-id"),
+              _leaf("level", "enum", enum=("level-1", "level-2", "level-all"),
+                    default="level-all"),
+              _spf_control(),
+              L("interface", "name", _leaf("name"),
+                _leaf("interface-type", "enum",
+                      enum=("broadcast", "point-to-point"), default="broadcast"),
+                _leaf("metric", "uint32", default=10))),
+            _rip_subtree("ripv2"),
+            _rip_subtree("ripng"),
+            _bgp_subtree(),
+            _bfd_subtree(),
+            _vrrp_subtree(),
+            C("igmp",
+              L("interface", "name", _leaf("name"),
+                _leaf("version", "uint8", default=2),
+                _leaf("query-interval", "uint16", default=125))),
+            C("ldp",
+              _leaf("enabled", "boolean", default=True),
+              L("interface", "name", _leaf("name"),
+                _leaf("hello-interval", "uint16", default=5))),
+            _static_subtree(),
+        ),
+    )
+
+
+def full_schema() -> Schema:
+    s = Schema()
+    s.mount(interfaces_module())
+    s.mount(system_module())
+    s.mount(keychains_module())
+    s.mount(routing_policy_module())
+    s.mount(routing_module())
+    return s

@@ -1,0 +1,116 @@
+"""RIB manager: admin distance, reselection, redistribution, OSPF wiring."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.routing.rib import MockKernel, RibManager
+from holo_tpu.utils.ibus import TOPIC_REDISTRIBUTE_ADD, Ibus
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+from holo_tpu.utils.southbound import Nexthop, Protocol, RouteKeyMsg, RouteMsg
+
+
+def mk():
+    loop = EventLoop(clock=VirtualClock())
+    ibus = Ibus(loop)
+    kernel = MockKernel()
+    rib = RibManager(ibus, kernel)
+    loop.register(rib)
+    return loop, ibus, kernel, rib
+
+
+def test_admin_distance_selection_and_fallback():
+    loop, ibus, kernel, rib = mk()
+    p = N("10.1.0.0/16")
+    nh_ospf = frozenset({Nexthop(addr=A("10.0.0.2"), ifname="e0")})
+    nh_rip = frozenset({Nexthop(addr=A("10.0.0.3"), ifname="e1")})
+    rib.route_add(RouteMsg(Protocol.RIPV2, p, 120, 4, nh_rip))
+    assert kernel.fib[p][1] == Protocol.RIPV2
+    rib.route_add(RouteMsg(Protocol.OSPFV2, p, 110, 20, nh_ospf))
+    assert kernel.fib[p][1] == Protocol.OSPFV2  # lower distance wins
+    rib.route_del(RouteKeyMsg(Protocol.OSPFV2, p))
+    assert kernel.fib[p][1] == Protocol.RIPV2  # falls back
+    rib.route_del(RouteKeyMsg(Protocol.RIPV2, p))
+    assert p not in kernel.fib
+
+
+def test_redistribution_published():
+    loop, ibus, kernel, rib = mk()
+    got = []
+
+    class Sub:
+        name = "bgp"
+
+        def attach(self, l):
+            pass
+
+        def handle(self, msg):
+            got.append(msg.payload)
+
+        def on_stop(self):
+            pass
+
+    loop.register(Sub())
+    ibus.subscribe(TOPIC_REDISTRIBUTE_ADD, "bgp")
+    rib.route_add(RouteMsg(Protocol.OSPFV2, N("10.2.0.0/16"), 110, 5,
+                           frozenset({Nexthop(addr=A("10.0.0.2"))})))
+    loop.run_until_idle()
+    assert len(got) == 1 and got[0].prefix == N("10.2.0.0/16")
+
+
+def test_ospf_instances_program_rib():
+    """Full wiring: OSPF converges and programs per-router RIB/kernels."""
+    from ipaddress import IPv4Address, IPv4Network
+
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ibus = {}
+    kernels = {}
+    routers = {}
+    for name, rid in [("r1", "1.1.1.1"), ("r2", "2.2.2.2"), ("r3", "3.3.3.3")]:
+        # Each router gets its own loop-scoped bus/rib under unique names.
+        bus = Ibus(loop)
+        k = MockKernel()
+        rib = RibManager(bus, k)
+        rib.name = "routing" if name == "r1" else f"routing-{name}"
+        loop.register(rib)
+        inst = OspfInstance(
+            name=name,
+            config=InstanceConfig(router_id=IPv4Address(rid)),
+            netio=fabric.sender_for(name),
+        )
+        loop.register(inst)
+        inst.attach_ibus(bus, routing_actor=rib.name)
+        ibus[name] = bus
+        kernels[name] = k
+        routers[name] = inst
+
+    cfg = lambda c: IfConfig(if_type=IfType.POINT_TO_POINT, cost=c)
+    r1, r2, r3 = routers["r1"], routers["r2"], routers["r3"]
+    r1.add_interface("e0", cfg(10), IPv4Network("10.0.12.0/30"), IPv4Address("10.0.12.1"))
+    r2.add_interface("e0", cfg(10), IPv4Network("10.0.12.0/30"), IPv4Address("10.0.12.2"))
+    r2.add_interface("e1", cfg(5), IPv4Network("10.0.23.0/30"), IPv4Address("10.0.23.1"))
+    r3.add_interface("e0", cfg(5), IPv4Network("10.0.23.0/30"), IPv4Address("10.0.23.2"))
+    fabric.join("l12", "r1", "e0", IPv4Address("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", IPv4Address("10.0.12.2"))
+    fabric.join("l23", "r2", "e1", IPv4Address("10.0.23.1"))
+    fabric.join("l23", "r3", "e0", IPv4Address("10.0.23.2"))
+    for r in routers.values():
+        for area in r.areas.values():
+            for ifname in area.interfaces:
+                loop.send(r.name, IfUpMsg(ifname))
+    loop.advance(90)
+
+    # r1's kernel has the remote prefix via 10.0.12.2.
+    fib = kernels["r1"].fib
+    assert N("10.0.23.0/30") in fib
+    nhs, proto = fib[N("10.0.23.0/30")]
+    assert proto == Protocol.OSPFV2
+    assert {str(nh.addr) for nh in nhs} == {"10.0.12.2"}
+    # Local/connected prefixes are not programmed (empty next hops).
+    assert N("10.0.12.0/30") not in fib

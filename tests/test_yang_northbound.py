@@ -1,0 +1,158 @@
+"""YANG-lite data trees, diffs, and the 3-phase transaction engine."""
+
+import pytest
+
+from holo_tpu.northbound.core import Northbound
+from holo_tpu.northbound.provider import (
+    Callbacks,
+    CommitError,
+    CommitPhase,
+    Provider,
+)
+from holo_tpu.yang.data import DataTree, DiffKind, diff_trees
+from holo_tpu.yang.modules import full_schema
+from holo_tpu.yang.schema import SchemaError
+
+
+@pytest.fixture
+def schema():
+    return full_schema()
+
+
+def test_set_get_delete_roundtrip(schema):
+    t = DataTree(schema)
+    t.set("interfaces/interface[eth0]")
+    t.set("interfaces/interface[eth0]/mtu", 9000)
+    t.set("interfaces/interface[eth0]/enabled", "true")
+    assert t.get("interfaces/interface[eth0]/mtu") == 9000
+    assert t.get("interfaces/interface[eth0]/enabled") is True
+    t.delete("interfaces/interface[eth0]/mtu")
+    assert t.get("interfaces/interface[eth0]/mtu") is None
+    t.delete("interfaces/interface[eth0]")
+    assert t.get("interfaces/interface[eth0]") is None
+
+
+def test_type_validation_rejects(schema):
+    t = DataTree(schema)
+    t.set("interfaces/interface[eth0]")
+    with pytest.raises(SchemaError):
+        t.set("interfaces/interface[eth0]/mtu", 70000)  # > uint16
+    with pytest.raises(SchemaError):
+        t.set("interfaces/interface[eth0]/type", "carrier-pigeon")
+    with pytest.raises(SchemaError):
+        t.set("interfaces/interface[eth0]/bogus-leaf", 1)
+
+
+def test_diff_create_modify_delete(schema):
+    old = DataTree(schema)
+    old.set("interfaces/interface[eth0]/mtu", 1500)
+    new = old.copy()
+    new.set("interfaces/interface[eth0]/mtu", 9000)
+    new.set("interfaces/interface[eth1]/mtu", 1500)
+    new.delete("interfaces/interface[eth0]/description")
+    ops = diff_trees(old, new)
+    kinds = {(o.kind, o.path) for o in ops}
+    assert (DiffKind.MODIFY, "interfaces/interface[eth0]/mtu") in kinds
+    assert (DiffKind.CREATE, "interfaces/interface[eth1]") in kinds
+    # deletes are child-first
+    old2, new2 = new, old
+    ops2 = diff_trees(old2, new2)
+    del_paths = [o.path for o in ops2 if o.kind == DiffKind.DELETE]
+    assert del_paths.index("interfaces/interface[eth1]/mtu") < del_paths.index(
+        "interfaces/interface[eth1]"
+    )
+
+
+def test_json_roundtrip(schema):
+    t = DataTree(schema)
+    t.set("routing/control-plane-protocols/ospfv2/router-id", "1.1.1.1")
+    t.set("routing/control-plane-protocols/ospfv2/area[0.0.0.0]")
+    t.set(
+        "routing/control-plane-protocols/ospfv2/area[0.0.0.0]/interface[eth0]/cost",
+        25,
+    )
+    t2 = DataTree.from_json(schema, t.to_json())
+    assert diff_trees(t, t2) == [] or all(
+        o.kind != DiffKind.MODIFY for o in diff_trees(t, t2)
+    )
+    assert (
+        t2.get(
+            "routing/control-plane-protocols/ospfv2/area[0.0.0.0]/interface[eth0]/cost"
+        )
+        == 25
+    )
+
+
+class RecordingProvider(Provider):
+    name = "rec"
+    subtree_prefixes = ("interfaces",)
+
+    def __init__(self, veto=False):
+        self.phases = []
+        self.veto = veto
+
+    def commit(self, phase, old, new, changes):
+        self.phases.append((phase, tuple(c.path for c in changes)))
+        if self.veto and phase == CommitPhase.PREPARE:
+            raise CommitError("no thanks")
+
+
+def test_two_phase_commit_apply(schema):
+    p = RecordingProvider()
+    other = RecordingProvider()
+    other.subtree_prefixes = ("system",)
+    nb = Northbound(schema, [p, other])
+    cand = nb.running.copy()
+    cand.set("interfaces/interface[eth0]/mtu", 1400)
+    txn = nb.commit(cand, comment="t1")
+    assert [ph for ph, _ in p.phases] == [CommitPhase.PREPARE, CommitPhase.APPLY]
+    assert other.phases == []  # unrelated subtree: not called
+    assert nb.running.get("interfaces/interface[eth0]/mtu") == 1400
+    assert txn.id == 1
+
+
+def test_prepare_veto_aborts(schema):
+    good, bad = RecordingProvider(), RecordingProvider(veto=True)
+    nb = Northbound(schema, [good, bad])
+    cand = nb.running.copy()
+    cand.set("interfaces/interface[eth0]/mtu", 1400)
+    with pytest.raises(CommitError):
+        nb.commit(cand)
+    assert nb.running.get("interfaces/interface[eth0]/mtu") is None
+    # good provider saw Prepare then Abort; never Apply.
+    assert [ph for ph, _ in good.phases] == [CommitPhase.PREPARE, CommitPhase.ABORT]
+
+
+def test_rollback_and_confirmed_commit(schema):
+    p = RecordingProvider()
+    nb = Northbound(schema, [p])
+    c1 = nb.running.copy()
+    c1.set("interfaces/interface[eth0]/mtu", 1400)
+    t1 = nb.commit(c1, now=100.0)
+    c2 = nb.running.copy()
+    c2.set("interfaces/interface[eth0]/mtu", 9000)
+    nb.commit(c2, now=101.0)
+    assert nb.running.get("interfaces/interface[eth0]/mtu") == 9000
+    nb.rollback(t1.id)
+    assert nb.running.get("interfaces/interface[eth0]/mtu") == 1400
+
+    # confirmed commit rolls back when unconfirmed
+    c3 = nb.running.copy()
+    c3.set("interfaces/interface[eth0]/mtu", 1200)
+    nb.commit(c3, confirmed_timeout=60.0, now=200.0)
+    assert nb.running.get("interfaces/interface[eth0]/mtu") == 1200
+    assert not nb.check_confirmed_timeout(now=230.0)
+    assert nb.check_confirmed_timeout(now=261.0)
+    assert nb.running.get("interfaces/interface[eth0]/mtu") == 1400
+
+
+def test_txn_persistence(schema, tmp_path):
+    db = tmp_path / "nb.json"
+    p = RecordingProvider()
+    nb = Northbound(schema, [p], db_path=db)
+    cand = nb.running.copy()
+    cand.set("system/hostname", "rt1")
+    # system isn't in p's subtree; commit with no matching provider still records
+    nb.commit(cand, comment="hostname")
+    nb2 = Northbound(schema, [RecordingProvider()], db_path=db)
+    assert nb2.get_transaction(1).comment == "hostname"
