@@ -212,6 +212,14 @@ class RoutingProvider(Provider, Actor):
         from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
 
         self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
+        # BFD is always-on, spawned at startup inside the routing provider
+        # (reference holo-routing/src/lib.rs:261-281).
+        from holo_tpu.protocols.bfd import BfdInstance
+
+        self.bfd = BfdInstance(
+            self.netio_factory(f"{self.prefix}bfd"), self.ibus
+        )
+        loop_.register(self.bfd, name=f"{self.prefix}bfd")
 
     def handle(self, msg):
         from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL, IbusMsg
@@ -272,7 +280,11 @@ class RoutingProvider(Provider, Actor):
                 spf_backend=backend,
             )
             self.loop.register(inst)
-            inst.attach_ibus(self.ibus, routing_actor=f"{self.prefix}routing-rib")
+            inst.attach_ibus(
+                self.ibus,
+                routing_actor=f"{self.prefix}routing-rib",
+                bfd_actor=f"{self.prefix}bfd",
+            )
             self.instances["ospfv2"] = inst
         else:
             inst.config.router_id = IPv4Address(router_id)
@@ -303,6 +315,7 @@ class RoutingProvider(Provider, Actor):
                     priority=if_conf.get("priority", 1),
                     passive=if_conf.get("passive", False),
                     mtu=st.mtu,
+                    bfd_enabled=if_conf.get("bfd", False),
                 )
                 inst.add_interface(ifname, cfg, addr, host)
                 self.loop.send(inst.name, IfUpMsg(ifname))

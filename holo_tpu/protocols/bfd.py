@@ -1,0 +1,295 @@
+"""BFD (RFC 5880/5881): asynchronous-mode session FSM.
+
+Reference: holo-bfd (SURVEY.md §2.3) — session table keyed by peer,
+clients (OSPF/IS-IS/BGP) register over the ibus and receive state-change
+notifications to kill adjacencies fast (§3.5 of SURVEY.md).
+
+Wire format (RFC 5880 §4.1) is implemented for real interop; the fabric
+delivers packets like any other protocol.  Echo mode and authentication
+are later-round items.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
+from holo_tpu.utils.ibus import TOPIC_BFD_STATE, BfdSessionReg, BfdSessionUnreg, BfdStateUpd, Ibus, IbusMsg
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+
+class BfdState(enum.IntEnum):
+    ADMIN_DOWN = 0
+    DOWN = 1
+    INIT = 2
+    UP = 3
+
+
+class BfdDiag(enum.IntEnum):
+    NONE = 0
+    TIME_EXPIRED = 1
+    ECHO_FAILED = 2
+    NEIGHBOR_DOWN = 3
+    FWD_PLANE_RESET = 4
+    PATH_DOWN = 5
+    CONCAT_DOWN = 6
+    ADMIN_DOWN = 7
+    REVERSE_CONCAT_DOWN = 8
+
+
+@dataclass
+class BfdPacket:
+    """RFC 5880 §4.1 mandatory section."""
+
+    state: BfdState
+    diag: BfdDiag = BfdDiag.NONE
+    poll: bool = False
+    final: bool = False
+    detect_mult: int = 3
+    my_discr: int = 0
+    your_discr: int = 0
+    desired_min_tx: int = 1_000_000  # microseconds
+    required_min_rx: int = 1_000_000
+    required_min_echo_rx: int = 0
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8((1 << 5) | int(self.diag))  # version 1
+        flags = (int(self.state) << 6) | (0x20 if self.poll else 0) | (
+            0x10 if self.final else 0
+        )
+        w.u8(flags)
+        w.u8(self.detect_mult)
+        w.u8(24)  # length
+        w.u32(self.my_discr).u32(self.your_discr)
+        w.u32(self.desired_min_tx).u32(self.required_min_rx)
+        w.u32(self.required_min_echo_rx)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BfdPacket":
+        r = Reader(data)
+        vd = r.u8()
+        if vd >> 5 != 1:
+            raise DecodeError("bad BFD version")
+        flags = r.u8()
+        mult = r.u8()
+        length = r.u8()
+        if length < 24 or length > len(data):
+            raise DecodeError("bad BFD length")
+        my, your = r.u32(), r.u32()
+        tx, rx, erx = r.u32(), r.u32(), r.u32()
+        if mult == 0 or my == 0:
+            raise DecodeError("invalid BFD fields")
+        try:
+            diag = BfdDiag(vd & 0x1F)
+        except ValueError:
+            diag = BfdDiag.NONE  # reserved diag codes: accept, ignore diag
+        return cls(
+            state=BfdState((flags >> 6) & 0x3),
+            diag=diag,
+            poll=bool(flags & 0x20),
+            final=bool(flags & 0x10),
+            detect_mult=mult,
+            my_discr=my,
+            your_discr=your,
+            desired_min_tx=tx,
+            required_min_rx=rx,
+            required_min_echo_rx=erx,
+        )
+
+
+@dataclass
+class TxTimerMsg:
+    key: tuple
+
+
+@dataclass
+class DetectTimerMsg:
+    key: tuple
+
+
+@dataclass
+class Session:
+    key: tuple  # (ifname, peer_addr)
+    local_discr: int
+    state: BfdState = BfdState.DOWN
+    remote_discr: int = 0
+    remote_min_rx: int = 1_000_000
+    remote_min_tx: int = 1_000_000
+    remote_detect_mult: int = 3
+    remote_state: BfdState = BfdState.DOWN
+    desired_min_tx: int = 1_000_000
+    required_min_rx: int = 1_000_000
+    detect_mult: int = 3
+    diag: BfdDiag = BfdDiag.NONE
+    clients: set = field(default_factory=set)
+
+
+class BfdInstance(Actor):
+    """BFD master actor: one session table for all interfaces/peers.
+
+    Spawned at daemon startup inside the routing provider, like the
+    reference (holo-routing/src/lib.rs:261-281).
+    """
+
+    name = "bfd"
+
+    def __init__(self, netio: NetIo, ibus: Ibus | None = None, slow_tx: float = 1.0):
+        self.netio = netio
+        self.ibus = ibus
+        self.sessions: dict[tuple, Session] = {}
+        self._next_discr = 1
+        self.slow_tx = slow_tx  # tx interval until session is UP (seconds)
+
+    # -- lifecycle
+
+    def session_key(self, ifname: str, peer: IPv4Address) -> tuple:
+        return (ifname, peer)
+
+    def register(self, key: tuple, client: str, local: IPv4Address) -> Session:
+        s = self.sessions.get(key)
+        if s is None:
+            s = Session(key=key, local_discr=self._next_discr)
+            self._next_discr += 1
+            s.local = local
+            self.sessions[key] = s
+            self._arm_tx(s, self.slow_tx)
+        elif local is not None:
+            s.local = local
+        s.clients.add(client)
+        return s
+
+    def unregister(self, key: tuple, client: str) -> None:
+        s = self.sessions.get(key)
+        if s is None:
+            return
+        s.clients.discard(client)
+        if not s.clients:
+            for attr in ("_tx_timer", "_detect_timer"):
+                t = getattr(s, attr, None)
+                if t is not None:
+                    t.cancel()
+            del self.sessions[key]
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, TxTimerMsg):
+            s = self.sessions.get(msg.key)
+            if s is not None:
+                self._send(s)
+                self._arm_tx(s, self._tx_interval(s))
+        elif isinstance(msg, DetectTimerMsg):
+            s = self.sessions.get(msg.key)
+            if s is not None and s.state in (BfdState.INIT, BfdState.UP):
+                self._transition(s, BfdState.DOWN, BfdDiag.TIME_EXPIRED)
+        elif isinstance(msg, IbusMsg):
+            p = msg.payload
+            if isinstance(p, BfdSessionReg):
+                s = self.register(p.key, msg.sender, p.local)
+                # Honor the client's requested timing parameters (take the
+                # fastest/safest across clients).
+                s.required_min_rx = min(s.required_min_rx, p.min_rx)
+                s.desired_min_tx = min(s.desired_min_tx, p.min_tx)
+                s.detect_mult = p.multiplier
+            elif isinstance(p, BfdSessionUnreg):
+                self.unregister(p.key, msg.sender)
+
+    # -- FSM (RFC 5880 §6.8.6)
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        try:
+            pkt = BfdPacket.decode(msg.data)
+        except DecodeError:
+            return
+        key = self.session_key(msg.ifname, msg.src)
+        s = self.sessions.get(key)
+        if s is None:
+            return
+        if pkt.your_discr != 0 and pkt.your_discr != s.local_discr:
+            return
+        s.remote_discr = pkt.my_discr
+        s.remote_state = pkt.state
+        s.remote_min_rx = pkt.required_min_rx
+        s.remote_min_tx = pkt.desired_min_tx
+        s.remote_detect_mult = pkt.detect_mult
+
+        if pkt.state == BfdState.ADMIN_DOWN:
+            if s.state in (BfdState.INIT, BfdState.UP):
+                self._transition(s, BfdState.DOWN, BfdDiag.NEIGHBOR_DOWN)
+        elif s.state == BfdState.DOWN:
+            if pkt.state == BfdState.DOWN:
+                self._transition(s, BfdState.INIT)
+            elif pkt.state == BfdState.INIT:
+                self._transition(s, BfdState.UP)
+        elif s.state == BfdState.INIT:
+            if pkt.state in (BfdState.INIT, BfdState.UP):
+                self._transition(s, BfdState.UP)
+        elif s.state == BfdState.UP:
+            if pkt.state == BfdState.DOWN:
+                self._transition(s, BfdState.DOWN, BfdDiag.NEIGHBOR_DOWN)
+        self._arm_detect(s)
+
+    def _transition(self, s: Session, new: BfdState, diag: BfdDiag = BfdDiag.NONE) -> None:
+        if s.state == new:
+            return
+        s.state = new
+        s.diag = diag
+        if self.ibus is not None:
+            label = {
+                BfdState.UP: "up",
+                BfdState.DOWN: "down",
+                BfdState.INIT: "init",
+                BfdState.ADMIN_DOWN: "admin-down",
+            }[new]
+            self.ibus.publish(TOPIC_BFD_STATE, BfdStateUpd(s.key, label))
+        # Faster tx once the session leaves Down.
+        self._arm_tx(s, self._tx_interval(s))
+
+    def _tx_interval(self, s: Session) -> float:
+        if s.state == BfdState.UP:
+            return max(s.desired_min_tx, s.remote_min_rx) / 1e6
+        return self.slow_tx
+
+    def _detect_time(self, s: Session) -> float:
+        """RFC 5880 §6.8.4: remote detect-mult × max(our RequiredMinRx,
+        remote DesiredMinTx) — the peer may legitimately transmit slower
+        than we are willing to receive."""
+        return (
+            s.remote_detect_mult
+            * max(s.required_min_rx, s.remote_min_tx, 1)
+            / 1e6
+        )
+
+    def _arm_tx(self, s: Session, delay: float) -> None:
+        t = getattr(s, "_tx_timer", None)
+        if t is None:
+            t = self.loop.timer(self.name, lambda key=s.key: TxTimerMsg(key))
+            s._tx_timer = t
+        t.start(delay)
+
+    def _arm_detect(self, s: Session) -> None:
+        t = getattr(s, "_detect_timer", None)
+        if t is None:
+            t = self.loop.timer(self.name, lambda key=s.key: DetectTimerMsg(key))
+            s._detect_timer = t
+        t.start(self._detect_time(s))
+
+    def _send(self, s: Session) -> None:
+        pkt = BfdPacket(
+            state=s.state,
+            diag=s.diag,
+            detect_mult=s.detect_mult,
+            my_discr=s.local_discr,
+            your_discr=s.remote_discr,
+            desired_min_tx=s.desired_min_tx,
+            required_min_rx=s.required_min_rx,
+        )
+        ifname, peer = s.key
+        self.netio.send(ifname, getattr(s, "local", None), peer, pkt.encode())

@@ -180,10 +180,13 @@ class OspfInstance(Actor):
         self.ibus = None  # set via attach_ibus for RIB integration
         self.routing_actor = "routing"
 
-    def attach_ibus(self, ibus, routing_actor: str = "routing") -> None:
-        """Wire route programming to the routing provider over the ibus."""
+    def attach_ibus(
+        self, ibus, routing_actor: str = "routing", bfd_actor: str = "bfd"
+    ) -> None:
+        """Wire route programming + BFD registration over the ibus."""
         self.ibus = ibus
         self.routing_actor = routing_actor
+        self.bfd_actor = bfd_actor
 
     # ----- wiring helpers
 
@@ -245,6 +248,27 @@ class OspfInstance(Actor):
             self.if_up(msg.ifname)
         elif isinstance(msg, IfDownMsg):
             self.if_down(msg.ifname)
+        else:
+            self._rx_ibus(msg)
+
+    def _rx_ibus(self, msg) -> None:
+        """BFD fast failure: a Down state update kills the adjacency
+        immediately (reference: SURVEY.md §3.5 BfdStateUpd path)."""
+        from holo_tpu.utils.ibus import TOPIC_BFD_STATE, BfdStateUpd, IbusMsg
+
+        if not isinstance(msg, IbusMsg) or msg.topic != TOPIC_BFD_STATE:
+            return
+        upd = msg.payload
+        if not isinstance(upd, BfdStateUpd) or upd.state != "down":
+            return
+        ifname, peer = upd.key
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        _, iface = ai
+        for nbr_id, nbr in list(iface.neighbors.items()):
+            if nbr.src == peer:
+                self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
 
     # ----- ISM
 
@@ -364,6 +388,21 @@ class OspfInstance(Actor):
         if nbr is None:
             nbr = Neighbor(router_id=pkt.router_id, src=src)
             iface.neighbors[pkt.router_id] = nbr
+            if iface.config.bfd_enabled and self.ibus is not None:
+                # Register a BFD session for fast failure detection
+                # (ibus bfd_session_reg path, SURVEY.md §3.5).
+                from holo_tpu.utils.ibus import TOPIC_BFD_STATE, BfdSessionReg
+
+                self.ibus.subscribe(TOPIC_BFD_STATE, self.name)
+                self.ibus.request(
+                    self.bfd_actor,
+                    BfdSessionReg(
+                        sender=self.name,
+                        key=(iface.name, src),
+                        local=iface.addr_ip,
+                    ),
+                    sender=self.name,
+                )
         prev = (nbr.priority, nbr.dr, nbr.bdr)
         nbr.src = src
         nbr.priority = h.priority
@@ -434,6 +473,14 @@ class OspfInstance(Actor):
                     t.cancel()
         if nbr.state == NsmState.DOWN:
             del iface.neighbors[nbr_id]
+            if iface.config.bfd_enabled and self.ibus is not None:
+                from holo_tpu.utils.ibus import BfdSessionUnreg
+
+                self.ibus.request(
+                    self.bfd_actor,
+                    BfdSessionUnreg(sender=self.name, key=(iface.name, nbr.src)),
+                    sender=self.name,
+                )
         if (old_state >= NsmState.FULL) != (nbr.state >= NsmState.FULL) or (
             nbr.state == NsmState.DOWN
         ):

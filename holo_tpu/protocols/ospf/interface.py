@@ -47,6 +47,7 @@ class IfConfig:
     priority: int = 1
     passive: bool = False
     mtu: int = 1500
+    bfd_enabled: bool = False
 
 
 @dataclass

@@ -1,0 +1,286 @@
+"""RIPv2 (RFC 2453): distance-vector routing.
+
+Reference: holo-rip (SURVEY.md §2.3) — route table with timeout/garbage
+timers, split horizon with poisoned reverse, triggered updates, periodic
+full updates.  RIPng (RFC 2080) shares the machinery via the address
+family parameter (v6 codec lands with OSPFv3).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
+from holo_tpu.utils.ip import RIPV2_GROUP, mask_of
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+RIP_PORT = 520
+INFINITY_METRIC = 16
+
+
+class RipCommand(enum.IntEnum):
+    REQUEST = 1
+    RESPONSE = 2
+
+
+@dataclass(frozen=True)
+class Rte:
+    """Route table entry on the wire (RFC 2453 §4)."""
+
+    prefix: IPv4Network
+    nexthop: IPv4Address
+    metric: int
+    tag: int = 0
+
+
+@dataclass
+class RipPacket:
+    command: RipCommand
+    rtes: list[Rte] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8(int(self.command)).u8(2).u16(0)  # version 2
+        for rte in self.rtes:
+            w.u16(2)  # AF_INET
+            w.u16(rte.tag)
+            w.ipv4(rte.prefix.network_address)
+            w.ipv4(mask_of(rte.prefix))
+            w.ipv4(rte.nexthop)
+            w.u32(rte.metric)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RipPacket":
+        r = Reader(data)
+        try:
+            cmd = RipCommand(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown RIP command") from e
+        version = r.u8()
+        if version != 2:
+            raise DecodeError(f"unsupported RIP version {version}")
+        r.u16()
+        rtes = []
+        while r.remaining() >= 20:
+            af = r.u16()
+            tag = r.u16()
+            addr = r.ipv4()
+            mask = r.ipv4()
+            nh = r.ipv4()
+            metric = r.u32()
+            if af != 2 or not 1 <= metric <= INFINITY_METRIC:
+                raise DecodeError("bad RTE")
+            m = int(mask)
+            plen = bin(m).count("1")
+            if m != (0xFFFFFFFF << (32 - plen)) & 0xFFFFFFFF and m != 0:
+                raise DecodeError("non-contiguous mask")
+            try:
+                prefix = IPv4Network((int(addr) & m, plen))
+            except ValueError as e:
+                raise DecodeError(f"bad prefix: {e}") from e
+            rtes.append(Rte(prefix, nh, metric, tag))
+        return cls(cmd, rtes)
+
+
+@dataclass
+class RipRoute:
+    prefix: IPv4Network
+    nexthop: IPv4Address | None  # None = connected
+    ifname: str
+    metric: int
+    tag: int = 0
+    changed: bool = True
+    timeout_at: float | None = None  # None for connected
+    garbage_at: float | None = None
+
+
+@dataclass
+class UpdateTimerMsg:
+    pass
+
+
+@dataclass
+class TriggeredTimerMsg:
+    pass
+
+
+@dataclass
+class AgeTimerMsg:
+    pass
+
+
+@dataclass
+class RipIfConfig:
+    cost: int = 1
+    split_horizon: str = "poison-reverse"  # disabled|simple|poison-reverse
+
+
+class RipInstance(Actor):
+    """RIPv2 routing process."""
+
+    name = "ripv2"
+
+    def __init__(
+        self,
+        name: str,
+        netio: NetIo,
+        update_interval: float = 30.0,
+        timeout: float = 180.0,
+        garbage: float = 120.0,
+        route_cb=None,
+    ):
+        self.name = name
+        self.netio = netio
+        self.update_interval = update_interval
+        self.timeout = timeout
+        self.garbage = garbage
+        self.route_cb = route_cb
+        self.interfaces: dict[str, tuple[RipIfConfig, IPv4Address, IPv4Network]] = {}
+        self.routes: dict[IPv4Network, RipRoute] = {}
+        self._triggered_pending = False
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._update_timer = self.loop.timer(self.name, UpdateTimerMsg)
+        self._age_timer = self.loop.timer(self.name, AgeTimerMsg)
+        self._trig_timer = self.loop.timer(self.name, TriggeredTimerMsg)
+        self._update_timer.start(0.1)
+        self._age_timer.start(1.0)
+
+    def add_interface(self, ifname: str, cfg: RipIfConfig, addr: IPv4Address, prefix: IPv4Network):
+        self.interfaces[ifname] = (cfg, addr, prefix)
+        self.routes[prefix] = RipRoute(
+            prefix=prefix, nexthop=None, ifname=ifname, metric=cfg.cost
+        )
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, UpdateTimerMsg):
+            self._send_updates(changed_only=False)
+            self._update_timer.start(self.update_interval)
+        elif isinstance(msg, TriggeredTimerMsg):
+            if self._triggered_pending:
+                self._triggered_pending = False
+                self._send_updates(changed_only=True)
+        elif isinstance(msg, AgeTimerMsg):
+            self._age()
+            self._age_timer.start(1.0)
+
+    # -- rx path (RFC 2453 §3.9.2)
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        entry = self.interfaces.get(msg.ifname)
+        if entry is None:
+            return
+        cfg, our_addr, _prefix = entry
+        if msg.src == our_addr:
+            return
+        try:
+            pkt = RipPacket.decode(msg.data)
+        except DecodeError:
+            return
+        if pkt.command != RipCommand.RESPONSE:
+            return
+        now = self.loop.clock.now()
+        changed_any = False
+        for rte in pkt.rtes:
+            metric = min(rte.metric + cfg.cost, INFINITY_METRIC)
+            nh = msg.src if int(rte.nexthop) == 0 else rte.nexthop
+            cur = self.routes.get(rte.prefix)
+            if cur is None:
+                if metric < INFINITY_METRIC:
+                    self.routes[rte.prefix] = RipRoute(
+                        prefix=rte.prefix,
+                        nexthop=nh,
+                        ifname=msg.ifname,
+                        metric=metric,
+                        tag=rte.tag,
+                        timeout_at=now + self.timeout,
+                    )
+                    changed_any = True
+                continue
+            if cur.nexthop is None:
+                continue  # connected beats learned
+            from_same = cur.nexthop == nh and cur.ifname == msg.ifname
+            if from_same:
+                cur.timeout_at = now + self.timeout
+            if (from_same and metric != cur.metric) or metric < cur.metric:
+                old_metric = cur.metric
+                cur.metric = metric
+                cur.nexthop = nh
+                cur.ifname = msg.ifname
+                cur.changed = True
+                changed_any = True
+                if metric >= INFINITY_METRIC:
+                    if cur.garbage_at is None:
+                        cur.garbage_at = now + self.garbage
+                else:
+                    cur.garbage_at = None
+                    cur.timeout_at = now + self.timeout
+        if changed_any:
+            self._schedule_triggered()
+            self._notify()
+
+    # -- tx path
+
+    def _send_updates(self, changed_only: bool) -> None:
+        for ifname, (cfg, our_addr, _prefix) in self.interfaces.items():
+            rtes = []
+            for route in self.routes.values():
+                if changed_only and not route.changed:
+                    continue
+                metric = route.metric
+                if route.ifname == ifname and route.nexthop is not None:
+                    if cfg.split_horizon == "simple":
+                        continue
+                    if cfg.split_horizon == "poison-reverse":
+                        metric = INFINITY_METRIC
+                rtes.append(
+                    Rte(route.prefix, IPv4Address(0), metric, route.tag)
+                )
+            for i in range(0, len(rtes), 25):
+                pkt = RipPacket(RipCommand.RESPONSE, rtes[i : i + 25])
+                self.netio.send(ifname, our_addr, RIPV2_GROUP, pkt.encode())
+        for route in self.routes.values():
+            route.changed = False
+
+    def _schedule_triggered(self) -> None:
+        if not self._triggered_pending:
+            self._triggered_pending = True
+            self._trig_timer.start(1.0)  # 1-5s randomized in the RFC
+
+    # -- aging (RFC 2453 §3.8)
+
+    def _age(self) -> None:
+        now = self.loop.clock.now()
+        changed = False
+        for route in list(self.routes.values()):
+            if route.timeout_at is not None and route.garbage_at is None:
+                if now >= route.timeout_at:
+                    route.metric = INFINITY_METRIC
+                    route.garbage_at = now + self.garbage
+                    route.changed = True
+                    changed = True
+            if route.garbage_at is not None and now >= route.garbage_at:
+                del self.routes[route.prefix]
+                changed = True
+        if changed:
+            self._schedule_triggered()
+            self._notify()
+
+    def _notify(self) -> None:
+        if self.route_cb is not None:
+            self.route_cb(
+                {
+                    p: r
+                    for p, r in self.routes.items()
+                    if r.metric < INFINITY_METRIC
+                }
+            )

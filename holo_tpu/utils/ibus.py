@@ -109,6 +109,7 @@ class Ibus:
 class BfdSessionReg:
     sender: str
     key: tuple  # session key (ifname/addr family specifics)
+    local: Any = None  # local address for the session's tx packets
     client_id: int = 0
     min_rx: int = 1000000
     min_tx: int = 1000000

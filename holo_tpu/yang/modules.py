@@ -133,6 +133,7 @@ def _ospf_subtree(name):
                 _leaf("retransmit-interval", "uint16", default=5),
                 _leaf("priority", "uint8", default=1),
                 _leaf("passive", "boolean", default=False),
+                _leaf("bfd", "boolean", default=False),
             ),
         ),
     )

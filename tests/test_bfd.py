@@ -1,0 +1,81 @@
+"""BFD session FSM + OSPF fast-failure integration."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.bfd import BfdInstance, BfdPacket, BfdState
+from holo_tpu.utils.ibus import Ibus
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_bfd_packet_roundtrip():
+    p = BfdPacket(state=BfdState.INIT, detect_mult=3, my_discr=7, your_discr=9)
+    out = BfdPacket.decode(p.encode())
+    assert out.state == BfdState.INIT
+    assert out.my_discr == 7 and out.your_discr == 9
+    assert out.detect_mult == 3
+
+
+def test_bfd_sessions_come_up_and_detect_failure():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ibus = Ibus(loop)
+    b1 = BfdInstance(fabric.sender_for("bfd1"), ibus)
+    b2 = BfdInstance(fabric.sender_for("bfd2"), ibus)
+    b1.name, b2.name = "bfd1", "bfd2"
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "bfd1", "e0", A("10.0.0.1"))
+    fabric.join("l", "bfd2", "e0", A("10.0.0.2"))
+    s1 = b1.register(("e0", A("10.0.0.2")), "test", A("10.0.0.1"))
+    s2 = b2.register(("e0", A("10.0.0.1")), "test", A("10.0.0.2"))
+    loop.advance(5)
+    assert s1.state == BfdState.UP and s2.state == BfdState.UP
+
+    fabric.set_link_up("l", False)
+    loop.advance(5)  # detect time = 3 * 1s
+    assert s1.state == BfdState.DOWN
+    assert s1.diag.name == "TIME_EXPIRED"
+
+
+def test_ospf_adjacency_killed_by_bfd():
+    """BFD down must kill the OSPF adjacency in ~3s, not dead-interval 40s."""
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+
+    nodes = {}
+    for name, rid, addr in [("r1", "1.1.1.1", "10.0.0.1"), ("r2", "2.2.2.2", "10.0.0.2")]:
+        bus = Ibus(loop)
+        bfd = BfdInstance(fabric.sender_for(f"{name}.bfd"), bus)
+        loop.register(bfd, name=f"{name}.bfd")
+        inst = OspfInstance(
+            name=name,
+            config=InstanceConfig(router_id=A(rid)),
+            netio=fabric.sender_for(name),
+        )
+        loop.register(inst)
+        inst.attach_ibus(bus, bfd_actor=f"{name}.bfd")
+        cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1, bfd_enabled=True)
+        inst.add_interface("e0", cfg, N("10.0.0.0/30"), A(addr))
+        fabric.join("lan", name, "e0", A(addr))
+        fabric.join("lan", f"{name}.bfd", "e0", A(addr))
+        nodes[name] = (inst, bfd)
+
+    for name in nodes:
+        loop.send(name, IfUpMsg("e0"))
+    loop.advance(30)
+    r1, _ = nodes["r1"]
+    iface = list(r1.areas.values())[0].interfaces["e0"]
+    assert any(n.state == NsmState.FULL for n in iface.neighbors.values())
+
+    # Silent failure: drop all frames but keep link "up" (no carrier loss).
+    fabric.add_drop_rule(lambda link, dst, data: True)
+    loop.advance(6)  # BFD detect (~3s) << dead interval (40s)
+    assert not iface.neighbors, "BFD failed to kill adjacency quickly"

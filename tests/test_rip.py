@@ -1,0 +1,93 @@
+"""RIPv2: codec, propagation, split horizon, timeout/garbage aging."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.rip import (
+    INFINITY_METRIC,
+    RipCommand,
+    RipIfConfig,
+    RipInstance,
+    RipPacket,
+    Rte,
+)
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_packet_roundtrip():
+    pkt = RipPacket(
+        RipCommand.RESPONSE,
+        [Rte(N("10.1.0.0/16"), A("0.0.0.0"), 3, tag=7)],
+    )
+    out = RipPacket.decode(pkt.encode())
+    assert out.command == RipCommand.RESPONSE
+    assert out.rtes == [Rte(N("10.1.0.0/16"), A("0.0.0.0"), 3, 7)]
+
+
+def chain(loop, fabric, n=3):
+    """r0 -- r1 -- r2 chain over /30 p2p-ish LANs."""
+    routers = []
+    for i in range(n):
+        r = RipInstance(f"rip{i}", fabric.sender_for(f"rip{i}"))
+        loop.register(r)
+        routers.append(r)
+    for i in range(n - 1):
+        net = N(f"10.0.{i}.0/30")
+        a1, a2 = A(f"10.0.{i}.1"), A(f"10.0.{i}.2")
+        routers[i].add_interface(f"e{i}r", RipIfConfig(), a1, net)
+        routers[i + 1].add_interface(f"e{i}l", RipIfConfig(), a2, net)
+        fabric.join(f"l{i}", f"rip{i}", f"e{i}r", a1)
+        fabric.join(f"l{i}", f"rip{i+1}", f"e{i}l", a2)
+    return routers
+
+
+def test_chain_propagation_and_metrics():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r0, r1, r2 = chain(loop, fabric)
+    loop.advance(70)  # two update cycles
+    # r0 learns the far subnet via r1 with metric 2 (1 hop + iface cost 1).
+    route = r0.routes.get(N("10.0.1.0/30"))
+    assert route is not None and route.metric == 2
+    assert route.nexthop == A("10.0.0.2")
+    # r2 learns the near subnet symmetric.
+    route = r2.routes.get(N("10.0.0.0/30"))
+    assert route is not None and route.metric == 2
+
+
+def test_split_horizon_poison_reverse():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r0, r1, r2 = chain(loop, fabric)
+    loop.advance(70)
+    # Capture r0's updates out of e0r: routes learned from that iface must
+    # be poisoned (metric 16).
+    fabric.tx_log.clear()
+    loop.advance(31)
+    poisoned = False
+    for actor, ifname, dst, data in fabric.tx_log:
+        if actor == "rip0":
+            pkt = RipPacket.decode(data)
+            for rte in pkt.rtes:
+                if rte.prefix == N("10.0.1.0/30"):
+                    poisoned = rte.metric == INFINITY_METRIC
+    assert poisoned, "learned route not poisoned back toward its source"
+
+
+def test_timeout_and_garbage_collection():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r0, r1, r2 = chain(loop, fabric)
+    loop.advance(70)
+    assert N("10.0.1.0/30") in r0.routes
+    # Partition r0 from r1: r0's learned routes must time out (180s) and be
+    # garbage-collected (another 120s).
+    fabric.set_link_up("l0", False)
+    loop.advance(185)
+    route = r0.routes.get(N("10.0.1.0/30"))
+    assert route is not None and route.metric == INFINITY_METRIC
+    loop.advance(125)
+    assert N("10.0.1.0/30") not in r0.routes
+    # Connected route survives.
+    assert N("10.0.0.0/30") in r0.routes
