@@ -180,11 +180,34 @@ class PolicyProvider(Provider, Actor):
             self.ibus.publish(TOPIC_POLICY_UPD, name)
 
 
+def _parse_system_id(s: str) -> bytes | None:
+    """Parse an IS-IS system id: dotted-hex ('1921.6800.1001') or six
+    dotted-decimal octets ('0.0.0.0.0.1').  Returns None if invalid."""
+    parts = s.split(".")
+    try:
+        if len(parts) == 3 and all(len(p) == 4 for p in parts):
+            return bytes.fromhex("".join(parts))
+        if len(parts) == 6:
+            vals = [int(p) for p in parts]
+            if all(0 <= v <= 255 for v in vals):
+                return bytes(vals)
+    except ValueError:
+        pass
+    return None
+
+
 class RoutingProvider(Provider, Actor):
     """RIB owner + protocol instance lifecycle from configuration."""
 
     name = "routing"
     subtree_prefixes = ("routing",)
+
+    def validate(self, new_tree) -> None:
+        from holo_tpu.northbound.provider import CommitError
+
+        sid = new_tree.get("routing/control-plane-protocols/isis/system-id")
+        if sid is not None and _parse_system_id(sid) is None:
+            raise CommitError(f"invalid IS-IS system-id {sid!r}")
 
     def __init__(
         self,
@@ -227,17 +250,21 @@ class RoutingProvider(Provider, Actor):
         if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
             # Interface removed from the system: down it in every protocol
             # instance that uses it (stops hellos, withdraws the subnet).
+            from holo_tpu.protocols.isis.instance import IsisIfDownMsg
             from holo_tpu.protocols.ospf.instance import IfDownMsg
 
             ifname = msg.payload
             for inst in self.instances.values():
-                if ifname in inst._if_area:
+                if ifname in getattr(inst, "_if_area", {}):
                     self.loop.send(inst.name, IfDownMsg(ifname))
+                elif ifname in getattr(inst, "interfaces", {}):
+                    self.loop.send(inst.name, IsisIfDownMsg(ifname))
 
     def commit(self, phase, old, new, changes):
         if phase != CommitPhase.APPLY:
             return
         self._apply_ospfv2(new)
+        self._apply_isis(new)
         self._apply_static(new)
 
     # -- OSPFv2 lifecycle (holo-routing northbound/configuration.rs analog)
@@ -319,6 +346,94 @@ class RoutingProvider(Provider, Actor):
                 )
                 inst.add_interface(ifname, cfg, addr, host)
                 self.loop.send(inst.name, IfUpMsg(ifname))
+
+    def _apply_isis(self, new):
+        from holo_tpu.protocols.isis.instance import (
+            IsisIfConfig,
+            IsisIfUpMsg,
+            IsisInstance,
+        )
+        from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+
+        base = "routing/control-plane-protocols/isis"
+        conf = new.get(base)
+        enabled = bool(conf) and new.get(f"{base}/enabled", True)
+        inst = self.instances.get("isis")
+        if not enabled:
+            if inst is not None:
+                for prefix in inst.routes:
+                    self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
+                self.loop.unregister(inst.name)
+                del self.instances["isis"]
+            return
+        system_id = new.get(f"{base}/system-id")
+        if system_id is None:
+            return
+        sysid = _parse_system_id(system_id)
+        if sysid is None:
+            return  # rejected in validate(); defensive here
+        if inst is not None and inst.sysid != sysid:
+            # System-id change requires a new incarnation: withdraw and
+            # restart (mirrors disable+enable).
+            from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+
+            for prefix in inst.routes:
+                self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
+            self.loop.unregister(inst.name)
+            del self.instances["isis"]
+            inst = None
+        if inst is None:
+            actor = f"{self.prefix}isis"
+            inst = IsisInstance(
+                name=actor,
+                sysid=sysid,
+                netio=self.netio_factory(actor),
+                route_cb=self._isis_routes_to_rib,
+            )
+            self.loop.register(inst)
+            self.instances["isis"] = inst
+        for ifname, if_conf in (new.get(f"{base}/interface") or {}).items():
+            if ifname in inst.interfaces:
+                continue
+            st = self.ifp.interfaces.get(ifname)
+            if st is None or not st.addresses:
+                continue
+            inst.add_interface(
+                ifname,
+                IsisIfConfig(metric=if_conf.get("metric", 10)),
+                st.addresses[0].ip,
+                st.addresses[0].network,
+            )
+            self.loop.send(inst.name, IsisIfUpMsg(ifname))
+
+    def _isis_routes_to_rib(self, routes):
+        from holo_tpu.utils.southbound import (
+            DEFAULT_DISTANCE,
+            Nexthop,
+            Protocol,
+            RouteKeyMsg,
+            RouteMsg,
+        )
+
+        old = getattr(self, "_isis_last_routes", {})
+        for prefix in old.keys() - routes.keys():
+            self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
+        for prefix, entry in routes.items():
+            if old.get(prefix) == entry:
+                continue  # unchanged: skip RIB churn
+            metric, nhs = entry
+            self.rib.route_add(
+                RouteMsg(
+                    protocol=Protocol.ISIS,
+                    prefix=prefix,
+                    distance=DEFAULT_DISTANCE[Protocol.ISIS],
+                    metric=metric,
+                    nexthops=frozenset(
+                        Nexthop(addr=a, ifname=i) for i, a in nhs
+                    ),
+                )
+            )
+        self._isis_last_routes = dict(routes)
 
     def _apply_static(self, new):
         from holo_tpu.utils.southbound import (

@@ -1,0 +1,12 @@
+"""IS-IS (ISO 10589 + RFC 1195/5305) — second link-state family.
+
+Reference crate: holo-isis (SURVEY.md §2.3).  Shares the pluggable SPF
+backend with OSPF: the LSDB lowers to the same generic Topology (routers +
+pseudonodes), so the TPU batch engine serves both protocols — the reason
+the reference keeps `compute_spt` root-agnostic (holo-isis/src/spf.rs:520-526).
+
+Round-1 scope: point-to-point circuits with 3-way handshake (RFC 5303),
+single configurable level, wide metrics (ext IS reach TLV 22 + ext IP
+reach TLV 135), LSP flooding with PSNP acks + CSNP sync, SPF + route
+derivation.  LAN DIS election and multi-topology land next round.
+"""

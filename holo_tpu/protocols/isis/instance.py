@@ -1,0 +1,564 @@
+"""IS-IS instance actor: p2p adjacencies, LSP flooding, SPF, routes.
+
+Reference: holo-isis/src/{instance,adjacency,lsdb,spf}.rs.  The SPF lowers
+the LSP database to the same generic Topology as OSPF (pseudonodes as
+"network" vertices), so the scalar and TPU backends are shared.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+import numpy as np
+
+from holo_tpu.ops.graph import INF, Topology
+from holo_tpu.protocols.isis.packet import (
+    LSP_MAX_AGE,
+    LSP_REFRESH,
+    AdjState3Way,
+    ExtIpReach,
+    ExtIsReach,
+    HelloP2p,
+    Lsp,
+    LspId,
+    P2pAdjState,
+    PduType,
+    Snp,
+    decode_pdu,
+)
+from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.utils.bytesbuf import DecodeError
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+class _McastMac(str):
+    """L2 multicast destination stand-in (AllISs); the fabric checks
+    ``is_multicast`` like it does for IP groups."""
+
+    is_multicast = True
+
+
+ALL_ISS = _McastMac("01:80:c2:00:00:14")
+
+
+class AdjacencyState(enum.Enum):
+    DOWN = "down"
+    INITIALIZING = "init"
+    UP = "up"
+
+
+@dataclass
+class IsisIfConfig:
+    metric: int = 10
+    hello_interval: int = 3  # p2p default (holo uses 3x multiplier)
+    hold_multiplier: int = 3
+    level: int = 2
+
+
+@dataclass
+class Adjacency:
+    sysid: bytes
+    state: AdjacencyState = AdjacencyState.DOWN
+    hold_time: int = 9
+    addr: IPv4Address | None = None
+
+
+@dataclass
+class IsisInterface:
+    name: str
+    config: IsisIfConfig
+    addr_ip: IPv4Address
+    prefix: IPv4Network
+    circuit_id: int = 1
+    adj: Adjacency | None = None  # p2p: single adjacency
+    srm: set = field(default_factory=set)  # LspIds pending flood on this iface
+    ssn: set = field(default_factory=set)  # LspIds pending PSNP ack
+
+
+@dataclass
+class HelloTimerMsg:
+    ifname: str
+
+
+@dataclass
+class HoldTimerMsg:
+    ifname: str
+
+
+@dataclass
+class FloodTimerMsg:
+    pass
+
+
+@dataclass
+class AgeTickMsg:
+    pass
+
+
+@dataclass
+class SpfTimerMsg:
+    pass
+
+
+@dataclass
+class IsisIfUpMsg:
+    ifname: str
+
+
+@dataclass
+class IsisIfDownMsg:
+    ifname: str
+
+
+@dataclass
+class LspEntry:
+    lsp: Lsp
+    installed_at: float
+
+    def remaining_lifetime(self, now: float) -> int:
+        return max(0, int(self.lsp.lifetime - (now - self.installed_at)))
+
+
+class IsisInstance(Actor):
+    """One IS-IS routing process (single level for now)."""
+
+    name = "isis"
+
+    def __init__(
+        self,
+        name: str,
+        sysid: bytes,
+        area: bytes = b"\x49\x00\x01",
+        level: int = 2,
+        netio: NetIo | None = None,
+        spf_backend: SpfBackend | None = None,
+        route_cb=None,
+    ):
+        assert len(sysid) == 6
+        self.name = name
+        self.sysid = sysid
+        self.area = area
+        self.level = level
+        self.netio = netio
+        self.backend = spf_backend or ScalarSpfBackend()
+        self.route_cb = route_cb
+        self.interfaces: dict[str, IsisInterface] = {}
+        self.lsdb: dict[LspId, LspEntry] = {}
+        self.routes: dict[IPv4Network, tuple] = {}
+        self.spf_run_count = 0
+        self._spf_pending = False
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._age_timer = self.loop.timer(self.name, AgeTickMsg)
+        self._age_timer.start(1.0)
+        self._flood_timer = self.loop.timer(self.name, FloodTimerMsg)
+        self._spf_timer = self.loop.timer(self.name, SpfTimerMsg)
+
+    def add_interface(self, ifname: str, cfg: IsisIfConfig, addr: IPv4Address, prefix: IPv4Network):
+        self.interfaces[ifname] = IsisInterface(
+            name=ifname, config=cfg, addr_ip=addr, prefix=prefix,
+            circuit_id=len(self.interfaces) + 1,
+        )
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, HelloTimerMsg):
+            self._send_hello(msg.ifname)
+        elif isinstance(msg, HoldTimerMsg):
+            self._adj_down(msg.ifname)
+        elif isinstance(msg, FloodTimerMsg):
+            self._flush_flooding()
+        elif isinstance(msg, AgeTickMsg):
+            self._age_tick()
+        elif isinstance(msg, SpfTimerMsg):
+            self._spf_pending = False
+            self.run_spf()
+        elif isinstance(msg, IsisIfUpMsg):
+            self.if_up(msg.ifname)
+        elif isinstance(msg, IsisIfDownMsg):
+            self.if_down(msg.ifname)
+
+    def if_up(self, ifname: str) -> None:
+        if ifname in self.interfaces:
+            self._send_hello(ifname)
+            self._originate_lsp()
+
+    def if_down(self, ifname: str) -> None:
+        iface = self.interfaces.pop(ifname, None)
+        if iface is None:
+            return
+        for attr in ("_hello_timer", "_hold_timer"):
+            t = getattr(iface, attr, None)
+            if t is not None:
+                t.cancel()
+        self._adj_changed()
+
+    # -- hellos / adjacency (RFC 5303 three-way)
+
+    def _send_hello(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        adj = iface.adj
+        if adj is None or adj.state == AdjacencyState.DOWN:
+            state = AdjState3Way.DOWN
+            nbr_sys = None
+        elif adj.state == AdjacencyState.INITIALIZING:
+            state = AdjState3Way.INITIALIZING
+            nbr_sys = adj.sysid
+        else:
+            state = AdjState3Way.UP
+            nbr_sys = adj.sysid
+        hello = HelloP2p(
+            circuit_type=3,
+            sysid=self.sysid,
+            hold_time=iface.config.hello_interval * iface.config.hold_multiplier,
+            local_circuit_id=iface.circuit_id,
+            tlvs={
+                "area_addresses": [self.area],
+                "protocols_supported": [0xCC],  # IPv4
+                "ip_addresses": [iface.addr_ip],
+                "p2p_adj": P2pAdjState(
+                    state, iface.circuit_id, nbr_sys,
+                    iface.circuit_id if nbr_sys else None,
+                ),
+            },
+        )
+        self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
+        t = getattr(iface, "_hello_timer", None)
+        if t is None:
+            t = self.loop.timer(self.name, lambda: HelloTimerMsg(ifname))
+            iface._hello_timer = t
+        t.start(iface.config.hello_interval)
+
+    def _rx_hello(self, iface: IsisInterface, hello: HelloP2p) -> None:
+        if hello.sysid == self.sysid:
+            return
+        adj = iface.adj
+        if adj is None or adj.sysid != hello.sysid:
+            adj = Adjacency(sysid=hello.sysid)
+            iface.adj = adj
+        adj.hold_time = hello.hold_time
+        addrs = hello.tlvs.get("ip_addresses") or []
+        if addrs:
+            adj.addr = addrs[0]
+        p2p = hello.tlvs.get("p2p_adj")
+        they_see_us = p2p is not None and p2p.neighbor_sysid == self.sysid
+        old = adj.state
+        if they_see_us:
+            new = AdjacencyState.UP
+        else:
+            new = AdjacencyState.INITIALIZING
+        adj.state = new
+        t = getattr(iface, "_hold_timer", None)
+        if t is None:
+            t = self.loop.timer(self.name, lambda: HoldTimerMsg(iface.name))
+            iface._hold_timer = t
+        t.start(adj.hold_time)
+        if new != old:
+            self._send_hello(iface.name)  # accelerate the handshake
+            if new == AdjacencyState.UP:
+                self._adj_up(iface)
+            elif old == AdjacencyState.UP:
+                self._adj_changed()
+
+    def _adj_up(self, iface: IsisInterface) -> None:
+        # Sync databases: send CSNP describing our LSDB + set SRM on all
+        # (ISO 10589 §7.3.17 behavior for p2p).
+        now = self.loop.clock.now()
+        entries = [
+            (e.remaining_lifetime(now), lid, e.lsp.seqno, e.lsp.cksum)
+            for lid, e in sorted(self.lsdb.items())
+        ]
+        snp = Snp(self.level, True, self.sysid, entries)
+        self.netio.send(iface.name, iface.addr_ip, ALL_ISS, snp.encode())
+        for lid in self.lsdb:
+            iface.srm.add(lid)
+        self._arm_flood()
+        self._adj_changed()
+
+    def _adj_down(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None or iface.adj is None:
+            return
+        iface.adj = None
+        iface.srm.clear()
+        iface.ssn.clear()
+        self._adj_changed()
+
+    def _adj_changed(self) -> None:
+        self._originate_lsp()
+        self._schedule_spf()
+
+    # -- LSP origination
+
+    def _originate_lsp(self, force: bool = False, min_seqno: int = 0) -> None:
+        """(Re-)originate our LSP.  ``force`` bypasses the content-unchanged
+        skip (periodic refresh MUST bump seqno even with identical TLVs or
+        neighbors age us out); ``min_seqno`` outpaces a stale incarnation
+        seen in the network (ISO 10589 §7.3.16.1)."""
+        lsp_id = LspId(self.sysid)
+        old = self.lsdb.get(lsp_id)
+        is_reach = []
+        ip_reach = []
+        for iface in self.interfaces.values():
+            ip_reach.append(ExtIpReach(iface.prefix, iface.config.metric))
+            if iface.adj is not None and iface.adj.state == AdjacencyState.UP:
+                is_reach.append(
+                    ExtIsReach(iface.adj.sysid + b"\x00", iface.config.metric)
+                )
+        tlvs = {
+            "area_addresses": [self.area],
+            "protocols_supported": [0xCC],
+            "ext_is_reach": is_reach,
+            "ext_ip_reach": ip_reach,
+        }
+        seqno = max((old.lsp.seqno + 1) if old else 1, min_seqno)
+        lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
+        lsp.encode()
+        if (
+            not force
+            and old is not None
+            and old.lsp.raw[27:] == lsp.raw[27:]
+        ):
+            return  # content unchanged
+        self._install_lsp(lsp, flood_from=None)
+
+    # -- LSDB install + flooding (SRM/SSN model)
+
+    def _install_lsp(self, lsp: Lsp, flood_from: str | None) -> None:
+        now = self.loop.clock.now()
+        self.lsdb[lsp.lsp_id] = LspEntry(lsp, now)
+        for iface in self.interfaces.values():
+            if iface.adj is None or iface.adj.state != AdjacencyState.UP:
+                continue
+            if iface.name == flood_from:
+                iface.srm.discard(lsp.lsp_id)
+                iface.ssn.add(lsp.lsp_id)  # ack via PSNP
+            else:
+                iface.srm.add(lsp.lsp_id)
+        self._arm_flood()
+        self._schedule_spf()
+
+    def _arm_flood(self) -> None:
+        if not self._flood_timer.armed:
+            self._flood_timer.start(0.05)
+
+    def _flush_flooding(self) -> None:
+        now = self.loop.clock.now()
+        for iface in self.interfaces.values():
+            if iface.srm:
+                for lid in list(iface.srm)[:10]:
+                    e = self.lsdb.get(lid)
+                    if e is None:
+                        iface.srm.discard(lid)
+                        continue
+                    self.netio.send(iface.name, iface.addr_ip, ALL_ISS, e.lsp.raw)
+                # p2p: keep SRM set until PSNP ack clears it (§7.3.15.1);
+                # rearm to retransmit.
+            if iface.ssn:
+                entries = []
+                for lid in sorted(iface.ssn):
+                    e = self.lsdb.get(lid)
+                    if e is not None:
+                        entries.append(
+                            (e.remaining_lifetime(now), lid, e.lsp.seqno, e.lsp.cksum)
+                        )
+                    iface.ssn.discard(lid)
+                if entries:
+                    snp = Snp(self.level, False, self.sysid, entries)
+                    self.netio.send(iface.name, iface.addr_ip, ALL_ISS, snp.encode())
+        if any(i.srm for i in self.interfaces.values()):
+            self._flood_timer.start(5.0)  # retransmit interval
+
+    # -- rx dispatch
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        iface = self.interfaces.get(msg.ifname)
+        if iface is None:
+            return
+        try:
+            pdu_type, pdu = decode_pdu(msg.data)
+        except DecodeError:
+            return
+        if pdu_type == PduType.HELLO_P2P:
+            self._rx_hello(iface, pdu)
+        elif pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
+            self._rx_lsp(iface, pdu)
+        elif pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
+            self._rx_csnp(iface, pdu)
+        elif pdu_type in (PduType.PSNP_L1, PduType.PSNP_L2):
+            self._rx_psnp(iface, pdu)
+
+    def _rx_lsp(self, iface: IsisInterface, lsp: Lsp) -> None:
+        if iface.adj is None or iface.adj.state != AdjacencyState.UP:
+            return
+        cur = self.lsdb.get(lsp.lsp_id)
+        # Self-originated received newer: outpace it (§7.3.16.1) — also
+        # when we hold no copy (restart case: stale incarnation in the
+        # network must not outlive our fresh origination).
+        if lsp.lsp_id.sysid == self.sysid:
+            if cur is None or lsp.seqno >= cur.lsp.seqno:
+                self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
+            return
+        now = self.loop.clock.now()
+        if cur is None:
+            c = 1
+        else:
+            c = lsp.compare(
+                cur.remaining_lifetime(now), cur.lsp.seqno, cur.lsp.cksum
+            )
+        if c > 0:
+            self._install_lsp(lsp, flood_from=iface.name)
+        elif c == 0:
+            iface.srm.discard(lsp.lsp_id)
+            iface.ssn.add(lsp.lsp_id)
+            self._arm_flood()
+        else:
+            # Ours is newer: send it back.
+            iface.srm.add(lsp.lsp_id)
+            self._arm_flood()
+
+    def _rx_csnp(self, iface: IsisInterface, snp: Snp) -> None:
+        now = self.loop.clock.now()
+        described = {lid: (lt, seq, ck) for lt, lid, seq, ck in snp.entries}
+        # LSPs we have that they didn't describe (in range): set SRM.
+        for lid, e in self.lsdb.items():
+            if lid not in described:
+                iface.srm.add(lid)
+            else:
+                lt, seq, ck = described[lid]
+                c = e.lsp.compare(lt, seq, ck)
+                if c > 0:
+                    iface.srm.add(lid)
+                elif c < 0:
+                    iface.ssn.add(lid)  # request newer via PSNP
+        # LSPs they described that we lack: request via PSNP with seqno 0.
+        missing = [
+            (0, lid, 0, 0) for lid in described if lid not in self.lsdb
+        ]
+        if missing:
+            psnp = Snp(self.level, False, self.sysid, missing)
+            self.netio.send(iface.name, iface.addr_ip, ALL_ISS, psnp.encode())
+        self._arm_flood()
+
+    def _rx_psnp(self, iface: IsisInterface, snp: Snp) -> None:
+        now = self.loop.clock.now()
+        for lt, lid, seq, ck in snp.entries:
+            e = self.lsdb.get(lid)
+            if e is None:
+                continue
+            c = e.lsp.compare(lt, seq, ck)
+            if c == 0:
+                iface.srm.discard(lid)  # ack
+            elif c > 0:
+                iface.srm.add(lid)  # they asked / have older
+        self._arm_flood()
+
+    # -- aging
+
+    def _age_tick(self) -> None:
+        now = self.loop.clock.now()
+        for lid, e in list(self.lsdb.items()):
+            if (
+                lid.sysid == self.sysid
+                and e.remaining_lifetime(now) < (LSP_MAX_AGE - LSP_REFRESH)
+            ):
+                # Periodic refresh: force a seqno bump even with unchanged
+                # content, or neighbors age our LSP out.
+                self._originate_lsp(force=True)
+            elif e.remaining_lifetime(now) == 0:
+                del self.lsdb[lid]
+                self._schedule_spf()
+        self._age_timer.start(1.0)
+
+    # -- SPF (shared backend)
+
+    def _schedule_spf(self) -> None:
+        if not self._spf_pending:
+            self._spf_pending = True
+            self._spf_timer.start(0.1)
+
+    def run_spf(self) -> None:
+        self.spf_run_count += 1
+        now = self.loop.clock.now()
+        nodes: dict[bytes, dict] = {}  # key: sysid+pn byte
+        for lid, e in self.lsdb.items():
+            if e.remaining_lifetime(now) == 0:
+                continue
+            key = lid.sysid + bytes((lid.pseudonode,))
+            node = nodes.setdefault(key, {"is": [], "ip": []})
+            node["is"].extend(e.lsp.tlvs.get("ext_is_reach", []))
+            node["ip"].extend(e.lsp.tlvs.get("ext_ip_reach", []))
+
+        self_key = self.sysid + b"\x00"
+        if self_key not in nodes:
+            return
+        order = sorted(nodes.keys())
+        index = {k: i for i, k in enumerate(order)}
+        n = len(order)
+        is_router = np.array([k[6] == 0 for k in order], bool)
+        src, dst, cost = [], [], []
+        for k, node in nodes.items():
+            u = index[k]
+            for reach in node["is"]:
+                v = index.get(reach.neighbor)
+                if v is not None:
+                    src.append(u), dst.append(v), cost.append(reach.metric)
+        topo = Topology(
+            n_vertices=n,
+            is_router=is_router,
+            edge_src=np.array(src, np.int32).reshape(-1),
+            edge_dst=np.array(dst, np.int32).reshape(-1),
+            edge_cost=np.array(cost, np.int32).reshape(-1),
+            root=index[self_key],
+        ).filter_mutual()
+
+        # Next-hop atoms: adjacencies out of the root.
+        atoms = []
+        atom_ids = np.full(topo.n_edges, -1, np.int32)
+        adj_by_sysid = {}
+        for iface in self.interfaces.values():
+            if iface.adj is not None and iface.adj.state == AdjacencyState.UP:
+                adj_by_sysid[iface.adj.sysid + b"\x00"] = (
+                    iface.name,
+                    iface.adj.addr,
+                )
+        for e_i in range(topo.n_edges):
+            if topo.edge_src[e_i] == topo.root:
+                k = order[int(topo.edge_dst[e_i])]
+                hop = adj_by_sysid.get(k)
+                if hop is not None:
+                    atom_ids[e_i] = len(atoms)
+                    atoms.append(hop)
+        topo.edge_direct_atom = atom_ids
+        topo.touch()
+
+        res = self.backend.compute(topo)
+
+        routes: dict[IPv4Network, tuple] = {}
+        for k, node in nodes.items():
+            v = index[k]
+            if res.dist[v] >= INF:
+                continue
+            nhs = frozenset(
+                atoms[a]
+                for a in range(len(atoms))
+                if res.nexthop_words[v][a // 32] & (np.uint32(1) << np.uint32(a % 32))
+            )
+            for reach in node["ip"]:
+                total = int(res.dist[v]) + reach.metric
+                cur = routes.get(reach.prefix)
+                if cur is None or total < cur[0]:
+                    routes[reach.prefix] = (total, nhs)
+                elif total == cur[0]:
+                    routes[reach.prefix] = (total, cur[1] | nhs)
+        self.routes = routes
+        if self.route_cb is not None:
+            self.route_cb(routes)

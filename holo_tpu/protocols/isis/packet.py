@@ -1,0 +1,370 @@
+"""IS-IS PDU and TLV codecs (ISO 10589 §9; RFCs 1195, 5303, 5305).
+
+Reference: holo-isis packet layer.  System IDs are 6 bytes; LSP IDs are
+sysid + pseudonode byte + fragment byte.  Wide metrics only.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, fletcher16_checksum, fletcher16_verify
+
+IRDP_DISCRIMINATOR = 0x83
+SYSID_LEN = 6
+LSP_MAX_AGE = 1200
+LSP_REFRESH = 900
+
+
+class PduType(enum.IntEnum):
+    HELLO_P2P = 17
+    LSP_L1 = 18
+    LSP_L2 = 20
+    CSNP_L1 = 24
+    CSNP_L2 = 25
+    PSNP_L1 = 26
+    PSNP_L2 = 27
+
+
+class TlvType(enum.IntEnum):
+    AREA_ADDRESSES = 1
+    PROTOCOLS_SUPPORTED = 129
+    IP_INTERFACE_ADDRESS = 132
+    EXT_IS_REACH = 22
+    EXT_IP_REACH = 135
+    LSP_ENTRIES = 9
+    P2P_ADJ_STATE = 240  # RFC 5303 three-way handshake
+
+
+@dataclass(frozen=True)
+class LspId:
+    sysid: bytes  # 6 bytes
+    pseudonode: int = 0
+    fragment: int = 0
+
+    def encode(self) -> bytes:
+        return self.sysid + bytes((self.pseudonode, self.fragment))
+
+    @classmethod
+    def decode(cls, b: bytes) -> "LspId":
+        if len(b) != 8:
+            raise DecodeError("bad LSP id")
+        return cls(b[:6], b[6], b[7])
+
+    def __lt__(self, other):
+        return self.encode() < other.encode()
+
+
+@dataclass(frozen=True)
+class ExtIsReach:
+    neighbor: bytes  # sysid + pseudonode byte (7 bytes)
+    metric: int
+
+
+@dataclass(frozen=True)
+class ExtIpReach:
+    prefix: IPv4Network
+    metric: int
+    up_down: bool = False
+
+
+class AdjState3Way(enum.IntEnum):
+    UP = 0
+    INITIALIZING = 1
+    DOWN = 2
+
+
+@dataclass
+class P2pAdjState:
+    state: AdjState3Way
+    ext_circuit_id: int = 0
+    neighbor_sysid: bytes | None = None
+    neighbor_ext_circuit_id: int | None = None
+
+
+def _encode_tlvs(w: Writer, tlvs: dict) -> None:
+    if tlvs.get("area_addresses"):
+        body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
+        w.u8(TlvType.AREA_ADDRESSES).u8(len(body)).bytes(body)
+    if tlvs.get("protocols_supported"):
+        body = bytes(tlvs["protocols_supported"])
+        w.u8(TlvType.PROTOCOLS_SUPPORTED).u8(len(body)).bytes(body)
+    if tlvs.get("ip_addresses"):
+        body = b"".join(a.packed for a in tlvs["ip_addresses"])
+        w.u8(TlvType.IP_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+    if tlvs.get("p2p_adj") is not None:
+        adj: P2pAdjState = tlvs["p2p_adj"]
+        body = bytes((int(adj.state),)) + adj.ext_circuit_id.to_bytes(4, "big")
+        if adj.neighbor_sysid is not None:
+            body += adj.neighbor_sysid
+            body += (adj.neighbor_ext_circuit_id or 0).to_bytes(4, "big")
+        w.u8(TlvType.P2P_ADJ_STATE).u8(len(body)).bytes(body)
+    for reach in _chunks(tlvs.get("ext_is_reach", []), 23):
+        body = b""
+        for r in reach:
+            body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
+        w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
+    for reach in _chunks(tlvs.get("ext_ip_reach", []), 20):
+        body = b""
+        for r in reach:
+            ctrl = (0x80 if r.up_down else 0) | r.prefix.prefixlen
+            plen_bytes = (r.prefix.prefixlen + 7) // 8
+            body += r.metric.to_bytes(4, "big") + bytes((ctrl,))
+            body += r.prefix.network_address.packed[:plen_bytes]
+        w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
+    if tlvs.get("lsp_entries"):
+        for chunk in _chunks(tlvs["lsp_entries"], 15):
+            body = b""
+            for lifetime, lsp_id, seqno, cksum in chunk:
+                body += lifetime.to_bytes(2, "big") + lsp_id.encode()
+                body += seqno.to_bytes(4, "big") + cksum.to_bytes(2, "big")
+            w.u8(TlvType.LSP_ENTRIES).u8(len(body)).bytes(body)
+
+
+def _chunks(seq, n):
+    seq = list(seq)
+    return [seq[i : i + n] for i in range(0, len(seq), n)] if seq else []
+
+
+def _decode_tlvs(r: Reader) -> dict:
+    out: dict = {
+        "area_addresses": [],
+        "protocols_supported": [],
+        "ip_addresses": [],
+        "ext_is_reach": [],
+        "ext_ip_reach": [],
+        "lsp_entries": [],
+        "p2p_adj": None,
+    }
+    while r.remaining() >= 2:
+        t = r.u8()
+        length = r.u8()
+        body = r.sub(length)
+        if t == TlvType.AREA_ADDRESSES:
+            while body.remaining() >= 1:
+                n = body.u8()
+                out["area_addresses"].append(body.bytes(n))
+        elif t == TlvType.PROTOCOLS_SUPPORTED:
+            out["protocols_supported"] = list(body.rest())
+        elif t == TlvType.IP_INTERFACE_ADDRESS:
+            while body.remaining() >= 4:
+                out["ip_addresses"].append(body.ipv4())
+        elif t == TlvType.P2P_ADJ_STATE:
+            try:
+                state = AdjState3Way(body.u8())
+            except ValueError as e:
+                raise DecodeError("bad 3-way state") from e
+            ext_id = int.from_bytes(body.bytes(4), "big")
+            nbr_sys = nbr_ext = None
+            if body.remaining() >= 10:
+                nbr_sys = body.bytes(6)
+                nbr_ext = int.from_bytes(body.bytes(4), "big")
+            out["p2p_adj"] = P2pAdjState(state, ext_id, nbr_sys, nbr_ext)
+        elif t == TlvType.EXT_IS_REACH:
+            while body.remaining() >= 11:
+                nbr = body.bytes(7)
+                metric = body.u24()
+                sub_len = body.u8()
+                body.bytes(min(sub_len, body.remaining()))
+                out["ext_is_reach"].append(ExtIsReach(nbr, metric))
+        elif t == TlvType.EXT_IP_REACH:
+            while body.remaining() >= 5:
+                metric = body.u32()
+                ctrl = body.u8()
+                plen = ctrl & 0x3F
+                if plen > 32:
+                    raise DecodeError("bad prefix length")
+                nbytes = (plen + 7) // 8
+                raw = body.bytes(nbytes) + bytes(4 - nbytes)
+                if ctrl & 0x40:  # sub-TLVs present
+                    sl = body.u8()
+                    body.bytes(min(sl, body.remaining()))
+                prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
+                out["ext_ip_reach"].append(
+                    ExtIpReach(prefix, metric, bool(ctrl & 0x80))
+                )
+        elif t == TlvType.LSP_ENTRIES:
+            while body.remaining() >= 16:
+                lifetime = body.u16()
+                lsp_id = LspId.decode(body.bytes(8))
+                seqno = body.u32()
+                cksum = body.u16()
+                out["lsp_entries"].append((lifetime, lsp_id, seqno, cksum))
+        # unknown TLVs skipped (body already consumed)
+    return out
+
+
+def _pdu_header(w: Writer, pdu_type: PduType, hdr_len: int) -> None:
+    w.u8(IRDP_DISCRIMINATOR).u8(hdr_len).u8(1).u8(0)
+    w.u8(int(pdu_type)).u8(1).u8(0).u8(0)
+
+
+def _check_header(r: Reader) -> PduType:
+    if r.u8() != IRDP_DISCRIMINATOR:
+        raise DecodeError("not an IS-IS PDU")
+    r.u8()  # header length
+    if r.u8() != 1:
+        raise DecodeError("bad protocol version")
+    r.u8()  # sysid len (0 = 6)
+    try:
+        pdu_type = PduType(r.u8() & 0x1F)
+    except ValueError as e:
+        raise DecodeError("unknown PDU type") from e
+    r.u8()
+    r.u8()
+    r.u8()
+    return pdu_type
+
+
+@dataclass
+class HelloP2p:
+    circuit_type: int  # 1=L1, 2=L2, 3=L1L2
+    sysid: bytes
+    hold_time: int
+    local_circuit_id: int
+    tlvs: dict = field(default_factory=dict)
+
+    TYPE = PduType.HELLO_P2P
+
+    def encode(self) -> bytes:
+        w = Writer()
+        _pdu_header(w, self.TYPE, 20)
+        w.u8(self.circuit_type).bytes(self.sysid)
+        w.u16(self.hold_time)
+        len_pos = len(w)
+        w.u16(0)
+        w.u8(self.local_circuit_id)
+        _encode_tlvs(w, self.tlvs)
+        w.patch_u16(len_pos, len(w))
+        return w.finish()
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "HelloP2p":
+        ct = r.u8() & 0x3
+        sysid = r.bytes(SYSID_LEN)
+        hold = r.u16()
+        r.u16()  # pdu length
+        circuit_id = r.u8()
+        return cls(ct, sysid, hold, circuit_id, _decode_tlvs(r))
+
+
+@dataclass
+class Lsp:
+    level: int  # 1 or 2
+    lifetime: int
+    lsp_id: LspId
+    seqno: int
+    flags: int = 0x03  # IS-type bits (L2)
+    tlvs: dict = field(default_factory=dict)
+    cksum: int = 0
+    raw: bytes = b""
+
+    @property
+    def is_expired(self) -> bool:
+        return self.lifetime == 0
+
+    def encode(self) -> bytes:
+        w = Writer()
+        _pdu_header(w, PduType.LSP_L2 if self.level == 2 else PduType.LSP_L1, 27)
+        len_pos = len(w)
+        w.u16(0)  # pdu length
+        w.u16(self.lifetime)
+        w.bytes(self.lsp_id.encode())
+        w.u32(self.seqno)
+        cks_pos = len(w)
+        w.u16(0)
+        w.u8(self.flags)
+        _encode_tlvs(w, self.tlvs)
+        w.patch_u16(len_pos, len(w))
+        # ISO 10589 §7.3.11: checksum over lsp_id..end (offset 12 in PDU).
+        cks = fletcher16_checksum(bytes(w.buf[12:]), cks_pos - 12)
+        w.patch_u16(cks_pos, cks)
+        self.cksum = cks
+        self.raw = w.finish()
+        return self.raw
+
+    @classmethod
+    def decode_body(cls, r: Reader, level: int, raw: bytes) -> "Lsp":
+        pdu_len = r.u16()
+        if pdu_len > len(raw):
+            raise DecodeError("bad LSP length")
+        lifetime = r.u16()
+        lsp_id = LspId.decode(r.bytes(8))
+        seqno = r.u32()
+        cksum = r.u16()
+        flags = r.u8()
+        if lifetime > 0 and not fletcher16_verify(raw[12:pdu_len]):
+            raise DecodeError("LSP checksum mismatch")
+        tlvs = _decode_tlvs(Reader(raw, r.pos, pdu_len))
+        return cls(level, lifetime, lsp_id, seqno, flags, tlvs, cksum, raw[:pdu_len])
+
+    def compare(self, lifetime: int, seqno: int, cksum: int) -> int:
+        """ISO 10589 §7.3.16: newer comparison vs a summary tuple."""
+        if self.seqno != seqno:
+            return 1 if self.seqno > seqno else -1
+        if (self.lifetime == 0) != (lifetime == 0):
+            return 1 if self.lifetime == 0 else -1
+        if self.cksum != cksum:
+            return 1 if self.cksum > cksum else -1
+        return 0
+
+
+@dataclass
+class Snp:
+    """CSNP (complete, with range) or PSNP (partial)."""
+
+    level: int
+    complete: bool
+    sysid: bytes
+    entries: list = field(default_factory=list)  # (lifetime, LspId, seqno, cksum)
+    start: LspId | None = None
+    end: LspId | None = None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        if self.complete:
+            t = PduType.CSNP_L2 if self.level == 2 else PduType.CSNP_L1
+            _pdu_header(w, t, 33)
+        else:
+            t = PduType.PSNP_L2 if self.level == 2 else PduType.PSNP_L1
+            _pdu_header(w, t, 17)
+        len_pos = len(w)
+        w.u16(0)
+        w.bytes(self.sysid + b"\x00")  # source id (sysid + circuit 0)
+        if self.complete:
+            w.bytes((self.start or LspId(b"\x00" * 6)).encode())
+            w.bytes((self.end or LspId(b"\xff" * 6, 0xFF, 0xFF)).encode())
+        _encode_tlvs(w, {"lsp_entries": self.entries})
+        w.patch_u16(len_pos, len(w))
+        return w.finish()
+
+    @classmethod
+    def decode_body(cls, r: Reader, level: int, complete: bool) -> "Snp":
+        r.u16()  # pdu length
+        src = r.bytes(7)
+        start = end = None
+        if complete:
+            start = LspId.decode(r.bytes(8))
+            end = LspId.decode(r.bytes(8))
+        tlvs = _decode_tlvs(r)
+        return cls(level, complete, src[:6], tlvs["lsp_entries"], start, end)
+
+
+def decode_pdu(data: bytes):
+    """Top-level dispatch; returns (PduType, object)."""
+    r = Reader(data)
+    pdu_type = _check_header(r)
+    if pdu_type == PduType.HELLO_P2P:
+        return pdu_type, HelloP2p.decode_body(r)
+    if pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
+        level = 2 if pdu_type == PduType.LSP_L2 else 1
+        return pdu_type, Lsp.decode_body(r, level, data)
+    if pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
+        level = 2 if pdu_type == PduType.CSNP_L2 else 1
+        return pdu_type, Snp.decode_body(r, level, True)
+    if pdu_type in (PduType.PSNP_L1, PduType.PSNP_L2):
+        level = 2 if pdu_type == PduType.PSNP_L2 else 1
+        return pdu_type, Snp.decode_body(r, level, False)
+    raise DecodeError("unhandled PDU type")

@@ -100,6 +100,28 @@ def test_ospf_disable_withdraws_routes():
     assert N("10.0.12.0/30") not in d1.routing.rib.active_routes()
 
 
+def test_isis_config_driven_convergence():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="i1")
+    d2 = Daemon(loop=loop, netio=fabric, name="i2")
+    import ipaddress
+
+    fabric.join("l", "i1.isis", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "i2.isis", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, sid, addr in [(d1, "0.0.0.0.0.1", "10.0.12.1/30"),
+                         (d2, "0.0.0.0.0.2", "10.0.12.2/30")]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/isis/system-id", sid)
+        cand.set("routing/control-plane-protocols/isis/interface[eth0]/metric", 7)
+        d.commit(cand)
+    loop.advance(30)
+    rib = d1.routing.rib.active_routes()
+    assert N("10.0.12.0/30") in rib
+    assert rib[N("10.0.12.0/30")].protocol.value == "isis"
+
+
 def test_grpc_northbound_end_to_end():
     """Drive the daemon purely through the gRPC client."""
     import holo_tpu.daemon.grpc_server as gs
