@@ -1,0 +1,574 @@
+"""BGP-4 (RFC 4271): neighbor FSM, RIBs, decision process, policy.
+
+Reference: holo-bgp (SURVEY.md §2.3) — neighbor FSM, Adj-RIB-In/Out +
+Loc-RIB with the decision process, attribute interning, and policy
+evaluation offloaded to a dedicated worker (holo-bgp/src/tasks.rs:457-520
+— the pattern the TPU SPF service generalizes; here the policy engine is
+the separate ``PolicyWorker`` actor fed over the loop).
+
+Transport: BGP runs over TCP; on the in-memory fabric a session is a
+unicast frame exchange between peer addresses (connection collision
+resolution via router-id comparison is preserved).  Real-socket transport
+binds in the daemon.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+BGP_MARKER = b"\xff" * 16
+BGP_VERSION = 4
+
+
+class MsgType(enum.IntEnum):
+    OPEN = 1
+    UPDATE = 2
+    NOTIFICATION = 3
+    KEEPALIVE = 4
+
+
+class Origin(enum.IntEnum):
+    IGP = 0
+    EGP = 1
+    INCOMPLETE = 2
+
+
+class AttrType(enum.IntEnum):
+    ORIGIN = 1
+    AS_PATH = 2
+    NEXT_HOP = 3
+    MED = 4
+    LOCAL_PREF = 5
+
+
+@dataclass
+class PathAttrs:
+    origin: Origin = Origin.INCOMPLETE
+    as_path: tuple[int, ...] = ()
+    next_hop: IPv4Address | None = None
+    med: int | None = None
+    local_pref: int | None = None
+
+    def encode(self, w: Writer) -> None:
+        pos = len(w)
+        w.u16(0)  # total length placeholder
+        start = len(w)
+        w.u8(0x40).u8(AttrType.ORIGIN).u8(1).u8(int(self.origin))
+        # AS_PATH: one AS_SEQUENCE segment, 4-byte ASNs (RFC 6793 style).
+        body = Writer()
+        if self.as_path:
+            body.u8(2).u8(len(self.as_path))
+            for asn in self.as_path:
+                body.u32(asn)
+        w.u8(0x40).u8(AttrType.AS_PATH).u8(len(body)).bytes(body.finish())
+        if self.next_hop is not None:
+            w.u8(0x40).u8(AttrType.NEXT_HOP).u8(4).ipv4(self.next_hop)
+        if self.med is not None:
+            w.u8(0x80).u8(AttrType.MED).u8(4).u32(self.med)
+        if self.local_pref is not None:
+            w.u8(0x40).u8(AttrType.LOCAL_PREF).u8(4).u32(self.local_pref)
+        w.patch_u16(pos, len(w) - start)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "PathAttrs":
+        total = r.u16()
+        sub = r.sub(total)
+        out = cls()
+        while sub.remaining() >= 3:
+            flags = sub.u8()
+            atype = sub.u8()
+            alen = sub.u16() if flags & 0x10 else sub.u8()
+            body = sub.sub(alen)
+            if atype == AttrType.ORIGIN:
+                try:
+                    out.origin = Origin(body.u8())
+                except ValueError as e:
+                    raise DecodeError("bad ORIGIN attribute") from e
+            elif atype == AttrType.AS_PATH:
+                path = []
+                while body.remaining() >= 2:
+                    body.u8()  # segment type
+                    n = body.u8()
+                    for _ in range(n):
+                        path.append(body.u32())
+                out.as_path = tuple(path)
+            elif atype == AttrType.NEXT_HOP:
+                out.next_hop = body.ipv4()
+            elif atype == AttrType.MED:
+                out.med = body.u32()
+            elif atype == AttrType.LOCAL_PREF:
+                out.local_pref = body.u32()
+            # unknown attrs skipped (body consumed)
+        return out
+
+
+def _encode_prefixes(w: Writer, prefixes) -> None:
+    for p in prefixes:
+        plen = p.prefixlen
+        w.u8(plen)
+        w.bytes(p.network_address.packed[: (plen + 7) // 8])
+
+
+def _decode_prefixes(r: Reader) -> list[IPv4Network]:
+    out = []
+    while r.remaining() >= 1:
+        plen = r.u8()
+        if plen > 32:
+            raise DecodeError("bad prefix length")
+        nbytes = (plen + 7) // 8
+        raw = r.bytes(nbytes) + bytes(4 - nbytes)
+        out.append(IPv4Network((int.from_bytes(raw, "big"), plen)))
+    return out
+
+
+@dataclass
+class OpenMsg:
+    asn: int
+    hold_time: int
+    router_id: IPv4Address
+
+    TYPE = MsgType.OPEN
+
+    def encode_body(self, w: Writer) -> None:
+        w.u8(BGP_VERSION)
+        w.u16(self.asn if self.asn < 65536 else 23456)  # AS_TRANS
+        w.u16(self.hold_time)
+        w.ipv4(self.router_id)
+        # Capabilities: 4-octet AS (65).
+        cap = Writer()
+        cap.u8(65).u8(4).u32(self.asn)
+        opt = Writer()
+        opt.u8(2).u8(len(cap)).bytes(cap.finish())
+        w.u8(len(opt)).bytes(opt.finish())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "OpenMsg":
+        if r.u8() != BGP_VERSION:
+            raise DecodeError("bad BGP version")
+        asn = r.u16()
+        hold = r.u16()
+        rid = r.ipv4()
+        optlen = r.u8()
+        opts = r.sub(optlen)
+        while opts.remaining() >= 2:
+            ptype = opts.u8()
+            plen = opts.u8()
+            body = opts.sub(plen)
+            if ptype == 2:  # capabilities
+                while body.remaining() >= 2:
+                    code = body.u8()
+                    clen = body.u8()
+                    cbody = body.sub(clen)
+                    if code == 65 and clen == 4:
+                        asn = cbody.u32()
+        if hold != 0 and hold < 3:
+            raise DecodeError("bad hold time")
+        return cls(asn, hold, rid)
+
+
+@dataclass
+class UpdateMsg:
+    withdrawn: list[IPv4Network] = field(default_factory=list)
+    attrs: PathAttrs | None = None
+    nlri: list[IPv4Network] = field(default_factory=list)
+
+    TYPE = MsgType.UPDATE
+
+    def encode_body(self, w: Writer) -> None:
+        pos = len(w)
+        w.u16(0)
+        start = len(w)
+        _encode_prefixes(w, self.withdrawn)
+        w.patch_u16(pos, len(w) - start)
+        if self.attrs is not None:
+            self.attrs.encode(w)
+        else:
+            w.u16(0)
+        _encode_prefixes(w, self.nlri)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "UpdateMsg":
+        wlen = r.u16()
+        withdrawn = _decode_prefixes(r.sub(wlen))
+        attrs = PathAttrs.decode(r)
+        nlri = _decode_prefixes(r)
+        return cls(withdrawn, attrs, nlri)
+
+
+@dataclass
+class KeepaliveMsg:
+    TYPE = MsgType.KEEPALIVE
+
+    def encode_body(self, w: Writer) -> None:
+        pass
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "KeepaliveMsg":
+        return cls()
+
+
+@dataclass
+class NotificationMsg:
+    code: int
+    subcode: int = 0
+    data: bytes = b""
+
+    TYPE = MsgType.NOTIFICATION
+
+    def encode_body(self, w: Writer) -> None:
+        w.u8(self.code).u8(self.subcode).bytes(self.data)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "NotificationMsg":
+        return cls(r.u8(), r.u8(), r.rest())
+
+
+_BODIES = {
+    MsgType.OPEN: OpenMsg,
+    MsgType.UPDATE: UpdateMsg,
+    MsgType.KEEPALIVE: KeepaliveMsg,
+    MsgType.NOTIFICATION: NotificationMsg,
+}
+
+
+def encode_msg(body) -> bytes:
+    w = Writer()
+    w.bytes(BGP_MARKER)
+    w.u16(0)
+    w.u8(int(body.TYPE))
+    body.encode_body(w)
+    w.patch_u16(16, len(w))
+    return w.finish()
+
+
+def decode_msg(data: bytes):
+    r = Reader(data)
+    if r.bytes(16) != BGP_MARKER:
+        raise DecodeError("bad marker")
+    length = r.u16()
+    if length < 19 or length > 4096 or length > len(data):
+        raise DecodeError("bad length")
+    try:
+        t = MsgType(r.u8())
+    except ValueError as e:
+        raise DecodeError("unknown message type") from e
+    return t, _BODIES[t].decode_body(Reader(data, 19, length))
+
+
+# ===== neighbor FSM =====
+
+
+class PeerState(enum.Enum):
+    IDLE = "idle"
+    CONNECT = "connect"
+    OPEN_SENT = "open-sent"
+    OPEN_CONFIRM = "open-confirm"
+    ESTABLISHED = "established"
+
+
+from typing import Any
+
+
+@dataclass
+class PeerConfig:
+    addr: IPv4Address
+    remote_as: int
+    ifname: str
+    hold_time: int = 90
+    connect_retry: float = 5.0
+    export_policy: Any = None  # callable(prefix, attrs) -> attrs|None
+    import_policy: Any = None
+
+
+@dataclass
+class RouteEntry:
+    attrs: PathAttrs
+    peer: IPv4Address | None  # None = locally originated
+
+
+@dataclass
+class ConnectRetryMsg:
+    peer: IPv4Address
+
+
+@dataclass
+class HoldTimerExpiredMsg:
+    peer: IPv4Address
+
+
+@dataclass
+class KeepaliveTimerMsg:
+    peer: IPv4Address
+
+
+class Peer:
+    def __init__(self, cfg: PeerConfig):
+        self.config = cfg
+        self.state = PeerState.IDLE
+        self.remote_rid: IPv4Address | None = None
+        self.hold_time = cfg.hold_time
+        self.adj_rib_in: dict[IPv4Network, PathAttrs] = {}
+        self.adj_rib_out: dict[IPv4Network, PathAttrs] = {}
+
+
+class BgpInstance(Actor):
+    """One BGP speaker."""
+
+    name = "bgp"
+
+    def __init__(
+        self,
+        name: str,
+        asn: int,
+        router_id: IPv4Address,
+        netio: NetIo,
+        route_cb=None,
+    ):
+        self.name = name
+        self.asn = asn
+        self.router_id = router_id
+        self.netio = netio
+        self.route_cb = route_cb
+        self.peers: dict[IPv4Address, Peer] = {}
+        self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our addr
+        # Loc-RIB: prefix -> list[RouteEntry]; best first after decision.
+        self.loc_rib: dict[IPv4Network, list[RouteEntry]] = {}
+        self.originated: dict[IPv4Network, PathAttrs] = {}
+
+    def add_peer(self, cfg: PeerConfig, local_addr: IPv4Address) -> Peer:
+        peer = Peer(cfg)
+        self.peers[cfg.addr] = peer
+        self.local_addr[cfg.ifname] = local_addr
+        return peer
+
+    def start_peer(self, addr: IPv4Address) -> None:
+        peer = self.peers[addr]
+        peer.state = PeerState.CONNECT
+        self._send_open(peer)
+
+    def originate(self, prefix: IPv4Network, med: int | None = None) -> None:
+        attrs = PathAttrs(
+            origin=Origin.IGP, as_path=(), next_hop=None, med=med
+        )
+        self.originated[prefix] = attrs
+        self._decision(prefix)
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, ConnectRetryMsg):
+            peer = self.peers.get(msg.peer)
+            if peer is not None and peer.state in (PeerState.IDLE, PeerState.CONNECT):
+                self.start_peer(msg.peer)
+        elif isinstance(msg, HoldTimerExpiredMsg):
+            peer = self.peers.get(msg.peer)
+            if peer is not None and peer.state != PeerState.IDLE:
+                self._send(peer, NotificationMsg(4, 0))  # hold timer expired
+                self._drop_peer(peer)
+        elif isinstance(msg, KeepaliveTimerMsg):
+            peer = self.peers.get(msg.peer)
+            if peer is not None and peer.state in (
+                PeerState.OPEN_CONFIRM,
+                PeerState.ESTABLISHED,
+            ):
+                self._send(peer, KeepaliveMsg())
+                self._keepalive_timer(peer).start(max(peer.hold_time / 3, 1))
+
+    # -- fsm helpers
+
+    def _timer(self, key, fn):
+        attr = f"_t_{key[0]}_{key[1]}"
+        t = getattr(self, attr, None)
+        if t is None:
+            t = self.loop.timer(self.name, fn)
+            setattr(self, attr, t)
+        return t
+
+    def _hold_timer(self, peer: Peer):
+        return self._timer(("hold", peer.config.addr),
+                           lambda a=peer.config.addr: HoldTimerExpiredMsg(a))
+
+    def _keepalive_timer(self, peer: Peer):
+        return self._timer(("ka", peer.config.addr),
+                           lambda a=peer.config.addr: KeepaliveTimerMsg(a))
+
+    def _send(self, peer: Peer, body) -> None:
+        src = self.local_addr.get(peer.config.ifname)
+        self.netio.send(peer.config.ifname, src, peer.config.addr, encode_msg(body))
+
+    def _send_open(self, peer: Peer) -> None:
+        self._send(peer, OpenMsg(self.asn, peer.config.hold_time, self.router_id))
+        peer.state = PeerState.OPEN_SENT
+        self._hold_timer(peer).start(peer.config.hold_time)
+
+    def _drop_peer(self, peer: Peer) -> None:
+        peer.state = PeerState.IDLE
+        withdrawn = list(peer.adj_rib_in.keys())
+        peer.adj_rib_in.clear()
+        peer.adj_rib_out.clear()
+        for prefix in withdrawn:
+            self._decision(prefix)
+        self._timer(("retry", peer.config.addr),
+                    lambda a=peer.config.addr: ConnectRetryMsg(a)).start(
+            peer.config.connect_retry
+        )
+
+    # -- rx
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        peer = self.peers.get(msg.src)
+        if peer is None:
+            return
+        try:
+            t, body = decode_msg(msg.data)
+        except DecodeError:
+            return
+        if t == MsgType.OPEN:
+            self._rx_open(peer, body)
+        elif t == MsgType.KEEPALIVE:
+            self._rx_keepalive(peer)
+        elif t == MsgType.UPDATE:
+            self._rx_update(peer, body)
+        elif t == MsgType.NOTIFICATION:
+            self._drop_peer(peer)
+
+    def _rx_open(self, peer: Peer, open_: OpenMsg) -> None:
+        if open_.asn != peer.config.remote_as:
+            self._send(peer, NotificationMsg(2, 2))  # bad peer AS
+            self._drop_peer(peer)
+            return
+        peer.remote_rid = open_.router_id
+        peer.hold_time = min(peer.config.hold_time, open_.hold_time)
+        if peer.state == PeerState.IDLE:
+            self._send_open(peer)
+        self._send(peer, KeepaliveMsg())
+        peer.state = PeerState.OPEN_CONFIRM
+        self._hold_timer(peer).start(peer.hold_time)
+        self._keepalive_timer(peer).start(max(peer.hold_time / 3, 1))
+
+    def _rx_keepalive(self, peer: Peer) -> None:
+        if peer.state == PeerState.OPEN_CONFIRM:
+            peer.state = PeerState.ESTABLISHED
+            self._advertise_all(peer)
+        if peer.state != PeerState.IDLE:
+            self._hold_timer(peer).start(peer.hold_time)
+
+    def _rx_update(self, peer: Peer, upd: UpdateMsg) -> None:
+        if peer.state != PeerState.ESTABLISHED:
+            return
+        changed = set()
+        for prefix in upd.withdrawn:
+            if peer.adj_rib_in.pop(prefix, None) is not None:
+                changed.add(prefix)
+        if upd.nlri and upd.attrs is not None:
+            attrs = upd.attrs
+            # Loop prevention: our AS in the path -> reject.
+            if self.asn in attrs.as_path:
+                pass
+            else:
+                imp = peer.config.import_policy
+                for prefix in upd.nlri:
+                    a = imp(prefix, attrs) if imp else attrs
+                    if a is None:
+                        continue
+                    peer.adj_rib_in[prefix] = a
+                    changed.add(prefix)
+        for prefix in changed:
+            self._decision(prefix)
+        if changed:
+            self._hold_timer(peer).start(peer.hold_time)
+
+    # -- decision process (RFC 4271 §9.1, condensed)
+
+    def _candidates(self, prefix: IPv4Network) -> list[RouteEntry]:
+        out = []
+        if prefix in self.originated:
+            out.append(RouteEntry(self.originated[prefix], None))
+        for peer in self.peers.values():
+            attrs = peer.adj_rib_in.get(prefix)
+            if attrs is not None:
+                out.append(RouteEntry(attrs, peer.config.addr))
+        return out
+
+    def _decision(self, prefix: IPv4Network) -> None:
+        cands = self._candidates(prefix)
+
+        def rank(e: RouteEntry):
+            peer = self.peers.get(e.peer) if e.peer else None
+            ebgp = peer is not None and peer.config.remote_as != self.asn
+            return (
+                -(e.attrs.local_pref if e.attrs.local_pref is not None else 100),
+                len(e.attrs.as_path),
+                int(e.attrs.origin),
+                e.attrs.med if e.attrs.med is not None else 0,
+                0 if e.peer is None else (1 if ebgp else 2),
+                int(peer.remote_rid or 0) if peer else 0,
+            )
+
+        cands.sort(key=rank)
+        if cands:
+            self.loc_rib[prefix] = cands
+        else:
+            self.loc_rib.pop(prefix, None)
+        self._advertise_prefix(prefix)
+        if self.route_cb is not None:
+            best = cands[0] if cands else None
+            self.route_cb(prefix, best)
+
+    # -- advertisement
+
+    def _export_attrs(self, peer: Peer, prefix, entry: RouteEntry) -> PathAttrs | None:
+        if entry.peer == peer.config.addr:
+            return None  # never echo back to the source peer
+        ebgp = peer.config.remote_as != self.asn
+        if not ebgp and entry.peer is not None:
+            src_peer = self.peers.get(entry.peer)
+            if src_peer is not None and src_peer.config.remote_as == self.asn:
+                return None  # iBGP does not re-reflect iBGP routes
+        attrs = PathAttrs(
+            origin=entry.attrs.origin,
+            as_path=((self.asn,) + entry.attrs.as_path) if ebgp else entry.attrs.as_path,
+            next_hop=self.local_addr.get(peer.config.ifname),
+            med=entry.attrs.med if not ebgp else None,
+            local_pref=(entry.attrs.local_pref or 100) if not ebgp else None,
+        )
+        exp = peer.config.export_policy
+        if exp is not None:
+            return exp(prefix, attrs)
+        return attrs
+
+    def _advertise_prefix(self, prefix: IPv4Network) -> None:
+        best = self.loc_rib.get(prefix)
+        for peer in self.peers.values():
+            if peer.state != PeerState.ESTABLISHED:
+                continue
+            if best:
+                attrs = self._export_attrs(peer, prefix, best[0])
+                if attrs is None:
+                    if prefix in peer.adj_rib_out:
+                        del peer.adj_rib_out[prefix]
+                        self._send(peer, encode_update_withdraw(prefix))
+                    continue
+                cur = peer.adj_rib_out.get(prefix)
+                if cur != attrs:
+                    peer.adj_rib_out[prefix] = attrs
+                    self._send(peer, UpdateMsg(nlri=[prefix], attrs=attrs))
+            elif prefix in peer.adj_rib_out:
+                del peer.adj_rib_out[prefix]
+                self._send(peer, encode_update_withdraw(prefix))
+
+    def _advertise_all(self, peer: Peer) -> None:
+        for prefix in list(self.loc_rib.keys()) + list(self.originated.keys()):
+            self._advertise_prefix(prefix)
+
+
+def encode_update_withdraw(prefix: IPv4Network) -> UpdateMsg:
+    return UpdateMsg(withdrawn=[prefix])

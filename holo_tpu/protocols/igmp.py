@@ -1,0 +1,203 @@
+"""IGMPv1/v2 querier (RFC 2236): group membership tracking.
+
+Reference: holo-igmp (SURVEY.md §2.3) — querier election (lowest address),
+per-group membership state with expiry, last-member query on leave.
+Kernel multicast VIF programming is a daemon concern behind the kernel
+interface; tests observe the group table.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, ip_checksum
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+ALL_SYSTEMS = IPv4Address("224.0.0.1")
+ALL_ROUTERS = IPv4Address("224.0.0.2")
+
+
+class IgmpType(enum.IntEnum):
+    QUERY = 0x11
+    REPORT_V1 = 0x12
+    REPORT_V2 = 0x16
+    LEAVE = 0x17
+
+
+@dataclass
+class IgmpPacket:
+    type: IgmpType
+    max_resp: int  # tenths of seconds
+    group: IPv4Address
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8(int(self.type)).u8(self.max_resp).u16(0)
+        w.ipv4(self.group)
+        cks = ip_checksum(bytes(w.buf))
+        w.patch_u16(2, cks)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "IgmpPacket":
+        r = Reader(data)
+        try:
+            t = IgmpType(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown IGMP type") from e
+        max_resp = r.u8()
+        r.u16()
+        if ip_checksum(data[:8]) != 0:
+            raise DecodeError("IGMP checksum mismatch")
+        return cls(t, max_resp, r.ipv4())
+
+
+@dataclass
+class QueryTimerMsg:
+    ifname: str
+
+
+@dataclass
+class GroupExpiryMsg:
+    ifname: str
+    group: IPv4Address
+
+
+@dataclass
+class OtherQuerierMsg:
+    ifname: str
+
+
+@dataclass
+class IgmpIfConfig:
+    query_interval: float = 125.0
+    query_response_interval: float = 10.0
+    robustness: int = 2
+    version: int = 2
+
+
+@dataclass
+class Group:
+    addr: IPv4Address
+    reporters: set = field(default_factory=set)
+
+
+class IgmpInterface:
+    def __init__(self, name: str, cfg: IgmpIfConfig, addr: IPv4Address):
+        self.name = name
+        self.config = cfg
+        self.addr = addr
+        self.querier = True  # assume querier until a lower address queries
+        self.groups: dict[IPv4Address, Group] = {}
+
+
+class IgmpInstance(Actor):
+    name = "igmp"
+
+    def __init__(self, name: str, netio: NetIo, group_cb=None):
+        self.name = name
+        self.netio = netio
+        self.group_cb = group_cb  # callable(ifname, groups) — VIF programming
+        self.interfaces: dict[str, IgmpInterface] = {}
+
+    def add_interface(self, ifname: str, cfg: IgmpIfConfig, addr: IPv4Address):
+        iface = IgmpInterface(ifname, cfg, addr)
+        self.interfaces[ifname] = iface
+        t = self.loop.timer(self.name, lambda: QueryTimerMsg(ifname))
+        iface._query_timer = t
+        t.start(0.1)
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, QueryTimerMsg):
+            self._send_query(msg.ifname)
+        elif isinstance(msg, GroupExpiryMsg):
+            self._expire_group(msg.ifname, msg.group)
+        elif isinstance(msg, OtherQuerierMsg):
+            iface = self.interfaces.get(msg.ifname)
+            if iface is not None:
+                iface.querier = True  # other querier present timer expired
+                iface._query_timer.start(0.1)
+
+    # -- querier
+
+    def _send_query(self, ifname: str, group: IPv4Address = IPv4Address(0)) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None or not iface.querier:
+            return
+        pkt = IgmpPacket(
+            IgmpType.QUERY,
+            int(iface.config.query_response_interval * 10),
+            group,
+        )
+        self.netio.send(ifname, iface.addr, ALL_SYSTEMS, pkt.encode())
+        iface._query_timer.start(iface.config.query_interval)
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        iface = self.interfaces.get(msg.ifname)
+        if iface is None:
+            return
+        try:
+            pkt = IgmpPacket.decode(msg.data)
+        except DecodeError:
+            return
+        if pkt.type == IgmpType.QUERY:
+            # Querier election: lowest address wins (RFC 2236 §3).
+            if msg.src is not None and int(msg.src) < int(iface.addr):
+                iface.querier = False
+                t = getattr(iface, "_other_querier_timer", None)
+                if t is None:
+                    t = self.loop.timer(
+                        self.name, lambda: OtherQuerierMsg(iface.name)
+                    )
+                    iface._other_querier_timer = t
+                t.start(
+                    iface.config.robustness * iface.config.query_interval
+                    + iface.config.query_response_interval / 2
+                )
+        elif pkt.type in (IgmpType.REPORT_V1, IgmpType.REPORT_V2):
+            if not pkt.group.is_multicast:
+                return
+            g = iface.groups.get(pkt.group)
+            if g is None:
+                g = Group(pkt.group)
+                iface.groups[pkt.group] = g
+                self._notify(iface)
+            if msg.src is not None:
+                g.reporters.add(msg.src)
+            t = getattr(g, "_expiry", None)
+            if t is None:
+                t = self.loop.timer(
+                    self.name,
+                    lambda grp=pkt.group: GroupExpiryMsg(iface.name, grp),
+                )
+                g._expiry = t
+            t.start(
+                iface.config.robustness * iface.config.query_interval
+                + iface.config.query_response_interval
+            )
+        elif pkt.type == IgmpType.LEAVE:
+            g = iface.groups.get(pkt.group)
+            if g is not None and iface.querier:
+                # Last-member query: short expiry unless a report arrives.
+                self._send_group_query(iface, pkt.group)
+                g._expiry.start(2.0)
+
+    def _send_group_query(self, iface: IgmpInterface, group: IPv4Address) -> None:
+        pkt = IgmpPacket(IgmpType.QUERY, 10, group)
+        self.netio.send(iface.name, iface.addr, group, pkt.encode())
+
+    def _expire_group(self, ifname: str, group: IPv4Address) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        if iface.groups.pop(group, None) is not None:
+            self._notify(iface)
+
+    def _notify(self, iface: IgmpInterface) -> None:
+        if self.group_cb is not None:
+            self.group_cb(iface.name, set(iface.groups.keys()))

@@ -1,0 +1,321 @@
+"""LDP (RFC 5036): label distribution for MPLS.
+
+Reference: holo-ldp (SURVEY.md §2.3) — UDP hello discovery, TCP session
+with init/keepalive, downstream-unsolicited label distribution with
+liberal retention, FEC table driven by RIB routes.
+
+Transport on the fabric: hellos are multicast frames, session messages
+unicast frames (the daemon binds real UDP 646 + TCP 646).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
+from holo_tpu.utils.mpls import IMPLICIT_NULL, LabelManager
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+
+class _McastAll(str):
+    is_multicast = True
+
+
+ALL_ROUTERS_LDP = _McastAll("224.0.0.2:646")
+
+LDP_VERSION = 1
+
+
+class LdpMsgType(enum.IntEnum):
+    HELLO = 0x0100
+    INIT = 0x0200
+    KEEPALIVE = 0x0201
+    LABEL_MAPPING = 0x0400
+    LABEL_WITHDRAW = 0x0402
+    LABEL_RELEASE = 0x0403
+
+
+@dataclass
+class LdpMsg:
+    type: LdpMsgType
+    lsr_id: IPv4Address
+    # message payload fields (superset; relevant per type):
+    hold_time: int = 15
+    keepalive_time: int = 30
+    fec: IPv4Network | None = None
+    label: int | None = None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u16(LDP_VERSION)
+        len_pos = len(w)
+        w.u16(0)
+        w.ipv4(self.lsr_id).u16(0)  # LDP identifier (label space 0)
+        body_start = len(w)
+        w.u16(int(self.type))
+        mlen_pos = len(w)
+        w.u16(0)
+        w.u32(0)  # message id (filled by sender when needed)
+        mstart = len(w)
+        if self.type == LdpMsgType.HELLO:
+            # Common hello params TLV 0x0400
+            w.u16(0x0400).u16(4).u16(self.hold_time).u16(0)
+        elif self.type == LdpMsgType.INIT:
+            # Common session params TLV 0x0500
+            w.u16(0x0500).u16(14)
+            w.u16(LDP_VERSION).u16(self.keepalive_time).u8(0).u8(0)
+            w.u16(0)  # max pdu
+            w.ipv4(self.lsr_id).u16(0)
+        elif self.type in (
+            LdpMsgType.LABEL_MAPPING,
+            LdpMsgType.LABEL_WITHDRAW,
+            LdpMsgType.LABEL_RELEASE,
+        ):
+            # FEC TLV 0x0100 (prefix element type 2)
+            plen = self.fec.prefixlen
+            nbytes = (plen + 7) // 8
+            w.u16(0x0100).u16(4 + nbytes)
+            w.u8(2).u8(1).u8(0).u8(plen)  # element 2, AF=1 (IPv4)
+            w.bytes(self.fec.network_address.packed[:nbytes])
+            if self.type != LdpMsgType.LABEL_RELEASE or self.label is not None:
+                # Generic label TLV 0x0200
+                w.u16(0x0200).u16(4).u32(self.label if self.label is not None else 0)
+        w.patch_u16(mlen_pos, len(w) - mstart + 4)
+        w.patch_u16(len_pos, len(w) - body_start + 6)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LdpMsg":
+        r = Reader(data)
+        if r.u16() != LDP_VERSION:
+            raise DecodeError("bad LDP version")
+        pdu_len = r.u16()
+        lsr_id = r.ipv4()
+        r.u16()  # label space
+        try:
+            mtype = LdpMsgType(r.u16())
+        except ValueError as e:
+            raise DecodeError("unknown LDP message") from e
+        r.u16()  # msg length
+        r.u32()  # msg id
+        out = cls(mtype, lsr_id)
+        while r.remaining() >= 4:
+            tlv = r.u16()
+            tlen = r.u16()
+            body = r.sub(min(tlen, r.remaining()))
+            if tlv == 0x0400:
+                out.hold_time = body.u16()
+            elif tlv == 0x0500:
+                body.u16()
+                out.keepalive_time = body.u16()
+            elif tlv == 0x0100:
+                el = body.u8()
+                af = body.u8()
+                body.u8()
+                plen = body.u8()
+                if el != 2 or plen > 32:
+                    raise DecodeError("bad FEC element")
+                nbytes = (plen + 7) // 8
+                raw = body.bytes(nbytes) + bytes(4 - nbytes)
+                out.fec = IPv4Network((int.from_bytes(raw, "big"), plen))
+            elif tlv == 0x0200:
+                out.label = body.u32()
+        return out
+
+
+class NbrState(enum.Enum):
+    DISCOVERED = "discovered"
+    INIT_SENT = "init-sent"
+    OPERATIONAL = "operational"
+
+
+@dataclass
+class LdpNeighbor:
+    lsr_id: IPv4Address
+    addr: IPv4Address
+    ifname: str
+    state: NbrState = NbrState.DISCOVERED
+    hold_time: int = 15
+    # label bindings learned from this peer: fec -> label
+    bindings: dict[IPv4Network, int] = field(default_factory=dict)
+
+
+@dataclass
+class HelloTimerMsg:
+    pass
+
+
+@dataclass
+class NbrTimeoutMsg:
+    lsr_id: IPv4Address
+
+
+class LdpInstance(Actor):
+    """One LDP LSR: discovery + sessions + DU label distribution."""
+
+    name = "ldp"
+
+    def __init__(
+        self,
+        name: str,
+        lsr_id: IPv4Address,
+        netio: NetIo,
+        label_manager: LabelManager | None = None,
+        lib_cb=None,
+    ):
+        self.name = name
+        self.lsr_id = lsr_id
+        self.netio = netio
+        self.labels = label_manager or LabelManager()
+        self.lib_cb = lib_cb  # callable(lib) on label-table change
+        self.interfaces: dict[str, IPv4Address] = {}  # ifname -> our addr
+        self.neighbors: dict[IPv4Address, LdpNeighbor] = {}
+        # Our FECs: prefix -> (local label, is_egress)
+        self.fec_table: dict[IPv4Network, tuple[int, bool]] = {}
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._hello_timer = self.loop.timer(self.name, HelloTimerMsg)
+        self._hello_timer.start(0.1)
+
+    def add_interface(self, ifname: str, addr: IPv4Address) -> None:
+        self.interfaces[ifname] = addr
+
+    def add_fec(self, prefix: IPv4Network, egress: bool) -> int:
+        """Create a local binding (egress FECs bind implicit-null)."""
+        if prefix in self.fec_table:
+            return self.fec_table[prefix][0]
+        label = IMPLICIT_NULL if egress else self.labels.allocate()
+        self.fec_table[prefix] = (label, egress)
+        for nbr in self.neighbors.values():
+            if nbr.state == NbrState.OPERATIONAL:
+                self._send_mapping(nbr, prefix, label)
+        self._lib_changed()
+        return label
+
+    def remove_fec(self, prefix: IPv4Network) -> None:
+        entry = self.fec_table.pop(prefix, None)
+        if entry is None:
+            return
+        label, egress = entry
+        if not egress:
+            self.labels.release(label)
+        for nbr in self.neighbors.values():
+            if nbr.state == NbrState.OPERATIONAL:
+                self._send(
+                    nbr.ifname,
+                    nbr.addr,
+                    LdpMsg(LdpMsgType.LABEL_WITHDRAW, self.lsr_id,
+                           fec=prefix, label=label),
+                )
+        self._lib_changed()
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, HelloTimerMsg):
+            for ifname, addr in self.interfaces.items():
+                hello = LdpMsg(LdpMsgType.HELLO, self.lsr_id, hold_time=15)
+                self.netio.send(ifname, addr, ALL_ROUTERS_LDP, hello.encode())
+            self._hello_timer.start(5.0)
+        elif isinstance(msg, NbrTimeoutMsg):
+            nbr = self.neighbors.pop(msg.lsr_id, None)
+            if nbr is not None:
+                self._lib_changed()
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        try:
+            pdu = LdpMsg.decode(msg.data)
+        except DecodeError:
+            return
+        if pdu.lsr_id == self.lsr_id:
+            return
+        if pdu.type == LdpMsgType.HELLO:
+            self._rx_hello(msg, pdu)
+            return
+        nbr = self.neighbors.get(pdu.lsr_id)
+        if nbr is None:
+            return
+        if pdu.type == LdpMsgType.INIT:
+            if nbr.state == NbrState.DISCOVERED:
+                self._send_init(nbr)
+            self._send(nbr.ifname, nbr.addr,
+                       LdpMsg(LdpMsgType.KEEPALIVE, self.lsr_id))
+        elif pdu.type == LdpMsgType.KEEPALIVE:
+            if nbr.state != NbrState.OPERATIONAL:
+                nbr.state = NbrState.OPERATIONAL
+                # Advertise all local bindings (downstream unsolicited).
+                for prefix, (label, _e) in self.fec_table.items():
+                    self._send_mapping(nbr, prefix, label)
+            self._touch(nbr)
+        elif pdu.type == LdpMsgType.LABEL_MAPPING and pdu.fec is not None:
+            nbr.bindings[pdu.fec] = pdu.label
+            self._lib_changed()
+        elif pdu.type == LdpMsgType.LABEL_WITHDRAW and pdu.fec is not None:
+            nbr.bindings.pop(pdu.fec, None)
+            self._send(nbr.ifname, nbr.addr,
+                       LdpMsg(LdpMsgType.LABEL_RELEASE, self.lsr_id,
+                              fec=pdu.fec, label=pdu.label))
+            self._lib_changed()
+
+    def _rx_hello(self, msg: NetRxPacket, pdu: LdpMsg) -> None:
+        nbr = self.neighbors.get(pdu.lsr_id)
+        if nbr is None:
+            nbr = LdpNeighbor(pdu.lsr_id, msg.src, msg.ifname,
+                              hold_time=pdu.hold_time)
+            self.neighbors[pdu.lsr_id] = nbr
+            # Active side: higher LSR id initiates the session (RFC 5036
+            # §2.5.2 transport connection roles).
+            if int(self.lsr_id) > int(pdu.lsr_id):
+                self._send_init(nbr)
+        self._touch(nbr)
+
+    def _touch(self, nbr: LdpNeighbor) -> None:
+        t = getattr(nbr, "_timeout", None)
+        if t is None:
+            t = self.loop.timer(
+                self.name, lambda l=nbr.lsr_id: NbrTimeoutMsg(l)
+            )
+            nbr._timeout = t
+        t.start(nbr.hold_time * 3)
+
+    def _send(self, ifname: str, dst, pdu: LdpMsg) -> None:
+        self.netio.send(ifname, self.interfaces.get(ifname), dst, pdu.encode())
+
+    def _send_init(self, nbr: LdpNeighbor) -> None:
+        nbr.state = NbrState.INIT_SENT
+        self._send(nbr.ifname, nbr.addr,
+                   LdpMsg(LdpMsgType.INIT, self.lsr_id))
+
+    def _send_mapping(self, nbr: LdpNeighbor, prefix: IPv4Network, label: int) -> None:
+        self._send(nbr.ifname, nbr.addr,
+                   LdpMsg(LdpMsgType.LABEL_MAPPING, self.lsr_id,
+                          fec=prefix, label=label))
+
+    # -- LIB (label information base) view
+
+    def lib(self) -> dict:
+        """fec -> {local, remote: {lsr_id: label}} — the MPLS LIB the
+        routing provider merges with RIB next hops to build LFIB entries
+        (reference rib.rs:152-212)."""
+        out = {}
+        for prefix, (label, egress) in self.fec_table.items():
+            out[prefix] = {
+                "local": label,
+                "egress": egress,
+                "remote": {
+                    str(n.lsr_id): n.bindings[prefix]
+                    for n in self.neighbors.values()
+                    if prefix in n.bindings
+                },
+            }
+        return out
+
+    def _lib_changed(self) -> None:
+        if self.lib_cb is not None:
+            self.lib_cb(self.lib())

@@ -492,7 +492,10 @@ class Packet:
             raise DecodeError("bad packet length")
         router_id, area_id = r.ipv4(), r.ipv4()
         r.u16()  # checksum (verified below)
-        auth_type = AuthType(r.u16())
+        try:
+            auth_type = AuthType(r.u16())
+        except ValueError as e:
+            raise DecodeError("unknown auth type") from e
         auth_data = r.bytes(8)
         if ip_checksum(data[:16] + data[24:length]) != 0:
             raise DecodeError("packet checksum mismatch")

@@ -1,0 +1,224 @@
+"""VRRP v2/v3 (RFC 3768 / RFC 5798): virtual router redundancy.
+
+Reference: holo-vrrp (SURVEY.md §2.3) — master election FSM per virtual
+router instance on an interface; the master answers for the virtual IPs
+(macvlan programming in the daemon; recorded on the mock kernel in tests).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, ip_checksum
+from holo_tpu.utils.ip import VRRP_GROUP_V4
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+
+class VrrpState(enum.Enum):
+    INITIALIZE = "initialize"
+    BACKUP = "backup"
+    MASTER = "master"
+
+
+@dataclass
+class VrrpPacket:
+    """VRRPv3 (RFC 5798 §5.2); v2 differs in advert-int units + auth."""
+
+    version: int
+    vrid: int
+    priority: int
+    max_advert_int: int  # centiseconds (v3) / seconds (v2)
+    addresses: list[IPv4Address] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8((self.version << 4) | 1)  # type 1 = advertisement
+        w.u8(self.vrid)
+        w.u8(self.priority)
+        w.u8(len(self.addresses))
+        if self.version == 3:
+            w.u16(self.max_advert_int & 0xFFF)
+        else:
+            w.u8(0).u8(self.max_advert_int & 0xFF)  # auth type 0, advert int
+        w.u16(0)  # checksum
+        for a in self.addresses:
+            w.ipv4(a)
+        if self.version == 2:
+            w.u64(0)  # empty auth data
+        cks = ip_checksum(bytes(w.buf))
+        w.patch_u16(6, cks)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VrrpPacket":
+        r = Reader(data)
+        vt = r.u8()
+        version, ptype = vt >> 4, vt & 0xF
+        if version not in (2, 3) or ptype != 1:
+            raise DecodeError("bad VRRP version/type")
+        vrid = r.u8()
+        prio = r.u8()
+        count = r.u8()
+        if version == 3:
+            advert = r.u16() & 0xFFF
+        else:
+            r.u8()
+            advert = r.u8()
+        r.u16()  # checksum (validated below)
+        if ip_checksum(data) != 0:
+            raise DecodeError("VRRP checksum mismatch")
+        addrs = [r.ipv4() for _ in range(count)]
+        return cls(version, vrid, prio, advert, addrs)
+
+
+@dataclass
+class AdvertTimerMsg:
+    vrid: int
+
+
+@dataclass
+class MasterDownTimerMsg:
+    vrid: int
+
+
+@dataclass
+class VrrpConfig:
+    vrid: int
+    ifname: str
+    version: int = 3
+    priority: int = 100
+    advert_interval: float = 1.0  # seconds
+    addresses: list[IPv4Address] = field(default_factory=list)
+    preempt: bool = True
+    accept: bool = False
+
+
+class VrrpInstance(Actor):
+    """One virtual router (per (interface, vrid) like the reference's
+    per-interface ProtocolInstance, holo-vrrp/src/interface.rs:36)."""
+
+    name = "vrrp"
+
+    def __init__(self, name: str, config: VrrpConfig, iface_addr: IPv4Address,
+                 netio: NetIo, on_state=None):
+        self.name = name
+        self.config = config
+        self.iface_addr = iface_addr
+        self.netio = netio
+        self.on_state = on_state  # callable(state) for macvlan programming
+        self.state = VrrpState.INITIALIZE
+        self.master_adver_int = config.advert_interval
+        self.owner = iface_addr in config.addresses
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._advert_timer = self.loop.timer(
+            self.name, lambda: AdvertTimerMsg(self.config.vrid)
+        )
+        self._mdown_timer = self.loop.timer(
+            self.name, lambda: MasterDownTimerMsg(self.config.vrid)
+        )
+
+    # -- FSM entry points
+
+    def startup(self) -> None:
+        if self.owner or self.config.priority == 255:
+            self._become_master()
+        else:
+            self._become_backup()
+
+    def shutdown(self) -> None:
+        if self.state == VrrpState.MASTER:
+            self._send_advert(priority=0)
+        self._advert_timer.cancel()
+        self._mdown_timer.cancel()
+        self._set_state(VrrpState.INITIALIZE)
+
+    # -- timers
+
+    def _skew_time(self) -> float:
+        return ((256 - self.config.priority) / 256.0) * self.master_adver_int
+
+    def _master_down_interval(self) -> float:
+        return 3 * self.master_adver_int + self._skew_time()
+
+    def _become_master(self) -> None:
+        self._set_state(VrrpState.MASTER)
+        self._send_advert()
+        self._advert_timer.start(self.config.advert_interval)
+        self._mdown_timer.cancel()
+
+    def _become_backup(self) -> None:
+        self._set_state(VrrpState.BACKUP)
+        self._advert_timer.cancel()
+        self._mdown_timer.start(self._master_down_interval())
+
+    def _set_state(self, new: VrrpState) -> None:
+        if new != self.state:
+            self.state = new
+            if self.on_state is not None:
+                self.on_state(new)
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, AdvertTimerMsg):
+            if self.state == VrrpState.MASTER:
+                self._send_advert()
+                self._advert_timer.start(self.config.advert_interval)
+        elif isinstance(msg, MasterDownTimerMsg):
+            if self.state == VrrpState.BACKUP:
+                self._become_master()
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        try:
+            pkt = VrrpPacket.decode(msg.data)
+        except DecodeError:
+            return
+        if pkt.vrid != self.config.vrid:
+            return
+        if pkt.version == 3:
+            advert = pkt.max_advert_int / 100.0
+        else:
+            advert = float(pkt.max_advert_int)
+        if self.state == VrrpState.BACKUP:
+            if pkt.priority == 0:
+                self._mdown_timer.start(self._skew_time())
+            elif (
+                not self.config.preempt
+                or pkt.priority >= self.config.priority
+            ):
+                self.master_adver_int = advert
+                self._mdown_timer.start(self._master_down_interval())
+            # else: we preempt by letting master-down expire
+        elif self.state == VrrpState.MASTER:
+            if pkt.priority == 0:
+                self._send_advert()
+                self._advert_timer.start(self.config.advert_interval)
+            elif pkt.priority > self.config.priority or (
+                pkt.priority == self.config.priority
+                and int(msg.src) > int(self.iface_addr)
+            ):
+                self.master_adver_int = advert
+                self._become_backup()
+
+    def _send_advert(self, priority: int | None = None) -> None:
+        cfg = self.config
+        adv = (
+            int(cfg.advert_interval * 100)
+            if cfg.version == 3
+            else int(cfg.advert_interval)
+        )
+        pkt = VrrpPacket(
+            version=cfg.version,
+            vrid=cfg.vrid,
+            priority=cfg.priority if priority is None else priority,
+            max_advert_int=adv,
+            addresses=list(cfg.addresses),
+        )
+        self.netio.send(cfg.ifname, self.iface_addr, VRRP_GROUP_V4, pkt.encode())

@@ -1,0 +1,50 @@
+"""MPLS label space management.
+
+Reference: holo-utils/src/mpls.rs — label constants and the shared
+LabelManager allocating from a configured range, used by LDP (and later
+SR) through the ibus label request messages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Reserved labels (RFC 3032).
+IMPLICIT_NULL = 3
+EXPLICIT_NULL_V4 = 0
+EXPLICIT_NULL_V6 = 2
+FIRST_UNRESERVED = 16
+
+
+class LabelExhausted(Exception):
+    pass
+
+
+@dataclass
+class LabelManager:
+    """Allocates labels from [lower, upper]; freed labels are reused."""
+
+    lower: int = 10000
+    upper: int = 19999
+    _next: int = 0
+    _free: list[int] = field(default_factory=list)
+    _allocated: set[int] = field(default_factory=set)
+
+    def __post_init__(self):
+        self._next = self.lower
+
+    def allocate(self) -> int:
+        if self._free:
+            label = self._free.pop()
+        elif self._next <= self.upper:
+            label = self._next
+            self._next += 1
+        else:
+            raise LabelExhausted(f"label range {self.lower}-{self.upper} full")
+        self._allocated.add(label)
+        return label
+
+    def release(self, label: int) -> None:
+        if label in self._allocated:
+            self._allocated.remove(label)
+            self._free.append(label)

@@ -1,0 +1,150 @@
+"""BGP: codecs, session establishment, propagation, decision, policy."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.bgp import (
+    BgpInstance,
+    KeepaliveMsg,
+    MsgType,
+    NotificationMsg,
+    OpenMsg,
+    Origin,
+    PathAttrs,
+    PeerConfig,
+    PeerState,
+    UpdateMsg,
+    decode_msg,
+    encode_msg,
+)
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_message_roundtrips():
+    o = OpenMsg(70000, 90, A("1.1.1.1"))  # 4-byte ASN via capability
+    t, out = decode_msg(encode_msg(o))
+    assert t == MsgType.OPEN and out.asn == 70000 and out.router_id == A("1.1.1.1")
+
+    attrs = PathAttrs(Origin.IGP, (65001, 65002), A("10.0.0.1"), med=5,
+                      local_pref=200)
+    u = UpdateMsg(withdrawn=[N("192.0.2.0/24")], attrs=attrs,
+                  nlri=[N("10.1.0.0/16"), N("10.2.0.0/24")])
+    t, out = decode_msg(encode_msg(u))
+    assert out.withdrawn == [N("192.0.2.0/24")]
+    assert out.nlri == [N("10.1.0.0/16"), N("10.2.0.0/24")]
+    assert out.attrs.as_path == (65001, 65002)
+    assert out.attrs.next_hop == A("10.0.0.1")
+    assert out.attrs.local_pref == 200
+
+    t, _ = decode_msg(encode_msg(KeepaliveMsg()))
+    assert t == MsgType.KEEPALIVE
+    t, out = decode_msg(encode_msg(NotificationMsg(6, 2)))
+    assert (out.code, out.subcode) == (6, 2)
+
+
+def two_speakers(as1=65001, as2=65002):
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    b1 = BgpInstance("b1", as1, A("1.1.1.1"), fabric.sender_for("b1"))
+    b2 = BgpInstance("b2", as2, A("2.2.2.2"), fabric.sender_for("b2"))
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "b1", "e0", A("10.0.0.1"))
+    fabric.join("l", "b2", "e0", A("10.0.0.2"))
+    b1.add_peer(PeerConfig(A("10.0.0.2"), as2, "e0"), A("10.0.0.1"))
+    b2.add_peer(PeerConfig(A("10.0.0.1"), as1, "e0"), A("10.0.0.2"))
+    b1.start_peer(A("10.0.0.2"))
+    b2.start_peer(A("10.0.0.1"))
+    return loop, fabric, b1, b2
+
+
+def test_session_establishment_and_route_exchange():
+    loop, fabric, b1, b2 = two_speakers()
+    loop.advance(5)
+    assert b1.peers[A("10.0.0.2")].state == PeerState.ESTABLISHED
+    assert b2.peers[A("10.0.0.1")].state == PeerState.ESTABLISHED
+
+    b1.originate(N("203.0.113.0/24"))
+    loop.advance(2)
+    best = b2.loc_rib.get(N("203.0.113.0/24"))
+    assert best is not None
+    assert best[0].attrs.as_path == (65001,)  # eBGP prepends
+    assert best[0].attrs.next_hop == A("10.0.0.1")
+
+
+def test_withdraw_and_peer_loss():
+    loop, fabric, b1, b2 = two_speakers()
+    loop.advance(5)
+    b1.originate(N("203.0.113.0/24"))
+    loop.advance(2)
+    assert N("203.0.113.0/24") in b2.loc_rib
+
+    # Silent peer death: hold timer expires, routes withdrawn.
+    fabric.set_link_up("l", False)
+    loop.advance(100)
+    assert b2.peers[A("10.0.0.1")].state in (PeerState.IDLE, PeerState.CONNECT,
+                                             PeerState.OPEN_SENT)
+    assert N("203.0.113.0/24") not in b2.loc_rib
+
+
+def test_decision_prefers_shorter_as_path():
+    """b3 hears the same prefix from b1 (direct) and via b2 (longer path)."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    speakers = {}
+    for i, asn in ((1, 65001), (2, 65002), (3, 65003)):
+        b = BgpInstance(f"b{i}", asn, A(f"{i}.{i}.{i}.{i}"),
+                        fabric.sender_for(f"b{i}"))
+        loop.register(b)
+        speakers[i] = b
+    # full mesh of eBGP over one LAN
+    for i in range(1, 4):
+        fabric.join("lan", f"b{i}", "e0", A(f"10.0.0.{i}"))
+    for i in range(1, 4):
+        for j in range(1, 4):
+            if i != j:
+                speakers[i].add_peer(
+                    PeerConfig(A(f"10.0.0.{j}"), 65000 + j, "e0",
+                               connect_retry=1.0),
+                    A(f"10.0.0.{i}"),
+                )
+    for i in range(1, 4):
+        for j in range(1, 4):
+            if i != j:
+                speakers[i].start_peer(A(f"10.0.0.{j}"))
+    loop.advance(10)
+    speakers[1].originate(N("198.51.100.0/24"))
+    loop.advance(5)
+    best = speakers[3].loc_rib[N("198.51.100.0/24")]
+    # direct path (65001) beats (65002, 65001) via b2
+    assert best[0].attrs.as_path == (65001,)
+    assert best[0].peer == A("10.0.0.1")
+    assert len(best) >= 2  # the longer path is known but not best
+
+
+def test_import_policy_rejects():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    b1 = BgpInstance("b1", 65001, A("1.1.1.1"), fabric.sender_for("b1"))
+    b2 = BgpInstance("b2", 65002, A("2.2.2.2"), fabric.sender_for("b2"))
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "b1", "e0", A("10.0.0.1"))
+    fabric.join("l", "b2", "e0", A("10.0.0.2"))
+    b1.add_peer(PeerConfig(A("10.0.0.2"), 65002, "e0"), A("10.0.0.1"))
+    b2.add_peer(
+        PeerConfig(
+            A("10.0.0.1"), 65001, "e0",
+            import_policy=lambda p, a: None if p == N("203.0.113.0/24") else a,
+        ),
+        A("10.0.0.2"),
+    )
+    b1.start_peer(A("10.0.0.2"))
+    b2.start_peer(A("10.0.0.1"))
+    loop.advance(5)
+    b1.originate(N("203.0.113.0/24"))
+    b1.originate(N("198.51.100.0/24"))
+    loop.advance(2)
+    assert N("203.0.113.0/24") not in b2.loc_rib
+    assert N("198.51.100.0/24") in b2.loc_rib

@@ -1,0 +1,73 @@
+"""LDP: discovery, session, label mapping distribution, withdrawal."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ldp import (
+    LdpInstance,
+    LdpMsg,
+    LdpMsgType,
+    NbrState,
+)
+from holo_tpu.utils.mpls import IMPLICIT_NULL, LabelManager
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_ldp_msg_roundtrips():
+    for m in (
+        LdpMsg(LdpMsgType.HELLO, A("1.1.1.1"), hold_time=15),
+        LdpMsg(LdpMsgType.INIT, A("1.1.1.1"), keepalive_time=30),
+        LdpMsg(LdpMsgType.LABEL_MAPPING, A("2.2.2.2"),
+               fec=N("10.1.0.0/16"), label=10001),
+        LdpMsg(LdpMsgType.LABEL_WITHDRAW, A("2.2.2.2"),
+               fec=N("10.1.0.0/16"), label=10001),
+    ):
+        out = LdpMsg.decode(m.encode())
+        assert out.type == m.type and out.lsr_id == m.lsr_id
+        if m.fec:
+            assert out.fec == m.fec and out.label == m.label
+
+
+def test_label_manager_reuse():
+    lm = LabelManager(lower=100, upper=102)
+    a, b, c = lm.allocate(), lm.allocate(), lm.allocate()
+    assert {a, b, c} == {100, 101, 102}
+    import pytest
+    from holo_tpu.utils.mpls import LabelExhausted
+
+    with pytest.raises(LabelExhausted):
+        lm.allocate()
+    lm.release(b)
+    assert lm.allocate() == b
+
+
+def test_session_and_label_distribution():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    l1 = LdpInstance("l1", A("1.1.1.1"), fabric.sender_for("l1"))
+    l2 = LdpInstance("l2", A("2.2.2.2"), fabric.sender_for("l2"))
+    loop.register(l1)
+    loop.register(l2)
+    fabric.join("l", "l1", "e0", A("10.0.0.1"))
+    fabric.join("l", "l2", "e0", A("10.0.0.2"))
+    l1.add_interface("e0", A("10.0.0.1"))
+    l2.add_interface("e0", A("10.0.0.2"))
+    loop.advance(10)
+    assert l1.neighbors[A("2.2.2.2")].state == NbrState.OPERATIONAL
+    assert l2.neighbors[A("1.1.1.1")].state == NbrState.OPERATIONAL
+
+    # l2 is egress for a prefix -> implicit null; l1 allocates a real label.
+    l2.add_fec(N("203.0.113.0/24"), egress=True)
+    l1.add_fec(N("203.0.113.0/24"), egress=False)
+    loop.advance(2)
+    lib1 = l1.lib()[N("203.0.113.0/24")]
+    assert lib1["remote"]["2.2.2.2"] == IMPLICIT_NULL
+    assert lib1["local"] >= 10000
+    lib2 = l2.lib()[N("203.0.113.0/24")]
+    assert lib2["remote"]["1.1.1.1"] == lib1["local"]
+
+    # withdraw propagates
+    l2.remove_fec(N("203.0.113.0/24"))
+    loop.advance(2)
+    assert "2.2.2.2" not in l1.lib()[N("203.0.113.0/24")]["remote"]

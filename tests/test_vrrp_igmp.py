@@ -1,0 +1,101 @@
+"""VRRP master election/failover + IGMP querier/membership."""
+
+from ipaddress import IPv4Address as A
+
+from holo_tpu.protocols.igmp import (
+    ALL_SYSTEMS,
+    IgmpIfConfig,
+    IgmpInstance,
+    IgmpPacket,
+    IgmpType,
+)
+from holo_tpu.protocols.vrrp import (
+    VrrpConfig,
+    VrrpInstance,
+    VrrpPacket,
+    VrrpState,
+)
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_vrrp_packet_roundtrip_v2_v3():
+    for version, adv in ((2, 1), (3, 100)):
+        p = VrrpPacket(version, 7, 150, adv, [A("192.0.2.254")])
+        out = VrrpPacket.decode(p.encode())
+        assert (out.version, out.vrid, out.priority) == (version, 7, 150)
+        assert out.addresses == [A("192.0.2.254")]
+
+
+def mk_vrrp(loop, fabric, name, addr, prio):
+    states = []
+    inst = VrrpInstance(
+        name,
+        VrrpConfig(vrid=9, ifname="e0", priority=prio,
+                   addresses=[A("192.0.2.254")]),
+        A(addr),
+        fabric.sender_for(name),
+        on_state=lambda s: states.append(s),
+    )
+    loop.register(inst)
+    fabric.join("lan", name, "e0", A(addr))
+    return inst, states
+
+
+def test_vrrp_election_and_failover():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    v1, s1 = mk_vrrp(loop, fabric, "v1", "192.0.2.1", prio=100)
+    v2, s2 = mk_vrrp(loop, fabric, "v2", "192.0.2.2", prio=200)
+    v1.startup()
+    v2.startup()
+    loop.advance(10)
+    assert v2.state == VrrpState.MASTER
+    assert v1.state == VrrpState.BACKUP
+
+    # Master dies silently: backup takes over after master-down interval.
+    loop.unregister("v2")
+    loop.advance(5)
+    assert v1.state == VrrpState.MASTER
+
+    # Graceful shutdown propagates fast via priority-0 advert.
+    v3, _ = mk_vrrp(loop, fabric, "v3", "192.0.2.3", prio=250)
+    v3.startup()
+    loop.advance(5)
+    assert v3.state == VrrpState.MASTER and v1.state == VrrpState.BACKUP
+    v3.shutdown()
+    loop.advance(1.0)
+    assert v1.state == VrrpState.MASTER  # skew-time takeover, not 3x advert
+
+
+def test_igmp_membership_and_querier_election():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    q1 = IgmpInstance("q1", fabric.sender_for("q1"))
+    q2 = IgmpInstance("q2", fabric.sender_for("q2"))
+    loop.register(q1)
+    loop.register(q2)
+    fabric.join("lan", "q1", "e0", A("10.0.0.1"))
+    fabric.join("lan", "q2", "e0", A("10.0.0.2"))
+    q1.add_interface("e0", IgmpIfConfig(), A("10.0.0.1"))
+    q2.add_interface("e0", IgmpIfConfig(), A("10.0.0.2"))
+    loop.advance(5)
+    # Lower address must win the querier election.
+    assert q1.interfaces["e0"].querier is True
+    assert q2.interfaces["e0"].querier is False
+
+    # A host reports membership; both routers track it.
+    report = IgmpPacket(IgmpType.REPORT_V2, 0, A("239.1.2.3")).encode()
+    from holo_tpu.utils.netio import NetRxPacket
+
+    loop.send("q1", NetRxPacket("e0", A("10.0.0.99"), ALL_SYSTEMS, report))
+    loop.send("q2", NetRxPacket("e0", A("10.0.0.99"), ALL_SYSTEMS, report))
+    loop.run_until_idle()
+    assert A("239.1.2.3") in q1.interfaces["e0"].groups
+    assert A("239.1.2.3") in q2.interfaces["e0"].groups
+
+    # Leave -> last-member query -> fast expiry on the querier.
+    leave = IgmpPacket(IgmpType.LEAVE, 0, A("239.1.2.3")).encode()
+    loop.send("q1", NetRxPacket("e0", A("10.0.0.99"), ALL_SYSTEMS, leave))
+    loop.advance(3)
+    assert A("239.1.2.3") not in q1.interfaces["e0"].groups
