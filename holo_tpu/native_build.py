@@ -97,6 +97,48 @@ def native_spf(topo, edge_mask=None):
     return dist, parent, hops, nh
 
 
+_runtime_lib = None
+
+
+def runtime_core_lib() -> ctypes.CDLL:
+    """C++ runtime core: timer wheel, MPSC rings, epoll poller."""
+    global _runtime_lib
+    if _runtime_lib is None:
+        lib = ctypes.CDLL(str(_ensure("libruntime_core.so", ["runtime_core.cpp"])))
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+        lib.holo_wheel_new.restype = ctypes.c_void_p
+        lib.holo_wheel_free.argtypes = [ctypes.c_void_p]
+        lib.holo_wheel_create.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.holo_wheel_create.restype = ctypes.c_int32
+        lib.holo_wheel_arm.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+        lib.holo_wheel_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.holo_wheel_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.holo_wheel_advance.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, i64p, ctypes.c_int,
+        ]
+        lib.holo_wheel_advance.restype = ctypes.c_int
+        lib.holo_ring_new.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+        lib.holo_ring_new.restype = ctypes.c_void_p
+        lib.holo_ring_free.argtypes = [ctypes.c_void_p]
+        lib.holo_ring_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32]
+        lib.holo_ring_push.restype = ctypes.c_int
+        lib.holo_ring_pop.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32]
+        lib.holo_ring_pop.restype = ctypes.c_int
+        lib.holo_poller_new.restype = ctypes.c_int
+        lib.holo_poller_add.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_uint32]
+        lib.holo_poller_del.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.holo_poller_wait.argtypes = [
+            ctypes.c_int, ctypes.c_int, i32p, u32p, ctypes.c_int,
+        ]
+        lib.holo_poller_wait.restype = ctypes.c_int
+        lib.holo_monotonic_now.restype = ctypes.c_double
+        _runtime_lib = lib
+    return _runtime_lib
+
+
 def native_spf_batch_dist(topo, edge_masks) -> np.ndarray:
     """C++ serial what-if batch (distances only): the CPU baseline workload."""
     lib = spf_baseline_lib()

@@ -1,0 +1,116 @@
+"""Python bindings for the C++ runtime core (native/runtime_core.cpp).
+
+Production-mode runtime primitives: a 1ms-resolution hierarchical timer
+wheel (O(1) arm/cancel), MPSC byte-message rings for cross-thread message
+passing into an actor's inbox, and an epoll poller for real-socket IO.
+The deterministic Python EventLoop remains the test-mode scheduler — same
+split as the reference's `testing` feature vs production Tokio runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from holo_tpu.native_build import runtime_core_lib
+
+EPOLLIN = 0x001
+EPOLLOUT = 0x004
+EPOLLERR = 0x008
+
+
+class NativeTimerWheel:
+    """O(1) timer wheel; user ids come back from advance() when due."""
+
+    def __init__(self) -> None:
+        self._lib = runtime_core_lib()
+        self._w = ctypes.c_void_p(self._lib.holo_wheel_new())
+        self._out = np.empty(4096, np.int64)
+
+    def create(self, user_id: int) -> int:
+        return self._lib.holo_wheel_create(self._w, user_id)
+
+    def arm(self, handle: int, deadline_s: float) -> None:
+        self._lib.holo_wheel_arm(self._w, handle, deadline_s)
+
+    def cancel(self, handle: int) -> None:
+        self._lib.holo_wheel_cancel(self._w, handle)
+
+    def destroy(self, handle: int) -> None:
+        self._lib.holo_wheel_destroy(self._w, handle)
+
+    def advance(self, to_s: float) -> list[int]:
+        fired = []
+        while True:
+            n = self._lib.holo_wheel_advance(
+                self._w, to_s, self._out, len(self._out)
+            )
+            fired.extend(self._out[:n].tolist())
+            if n < len(self._out):
+                break
+        return fired
+
+    def __del__(self):
+        try:
+            self._lib.holo_wheel_free(self._w)
+        except Exception:
+            pass
+
+
+class NativeMsgRing:
+    """MPSC ring: producer threads push bytes, the owning actor pops."""
+
+    def __init__(self, capacity: int = 4096, slot_size: int = 2048) -> None:
+        self._lib = runtime_core_lib()
+        self._r = ctypes.c_void_p(self._lib.holo_ring_new(capacity, slot_size))
+        self._buf = np.empty(slot_size, np.uint8)
+
+    def push(self, data: bytes) -> bool:
+        arr = np.frombuffer(data, np.uint8)
+        return self._lib.holo_ring_push(self._r, np.ascontiguousarray(arr), len(arr)) == 0
+
+    def pop(self) -> bytes | None:
+        n = self._lib.holo_ring_pop(self._r, self._buf, len(self._buf))
+        if n < 0:
+            return None
+        return bytes(self._buf[:n])
+
+    def __del__(self):
+        try:
+            self._lib.holo_ring_free(self._r)
+        except Exception:
+            pass
+
+
+class NativePoller:
+    """epoll wrapper for production socket IO."""
+
+    def __init__(self) -> None:
+        self._lib = runtime_core_lib()
+        self._ep = self._lib.holo_poller_new()
+        self._fds = np.empty(64, np.int32)
+        self._events = np.empty(64, np.uint32)
+
+    def add(self, fd: int, events: int = EPOLLIN) -> None:
+        if self._lib.holo_poller_add(self._ep, fd, events) != 0:
+            raise OSError(f"epoll add failed for fd {fd}")
+
+    def remove(self, fd: int) -> None:
+        self._lib.holo_poller_del(self._ep, fd)
+
+    def wait(self, timeout_ms: int) -> list[tuple[int, int]]:
+        n = self._lib.holo_poller_wait(
+            self._ep, timeout_ms, self._fds, self._events, 64
+        )
+        return [(int(self._fds[i]), int(self._events[i])) for i in range(max(n, 0))]
+
+    def __del__(self):
+        try:
+            self._lib.holo_poller_free(self._ep)
+        except Exception:
+            pass
+
+
+def monotonic_now() -> float:
+    return runtime_core_lib().holo_monotonic_now()

@@ -1,0 +1,266 @@
+// Native runtime core: timer wheel, MPSC message rings, epoll poller.
+//
+// Scope parallels the reference's holo-utils runtime primitives
+// (Task/TimeoutTask/IntervalTask, channels, socket polling —
+// holo-utils/src/task.rs, ibus.rs, socket.rs), built as a C ABI library
+// the Python daemon drives via ctypes: the deterministic Python loop stays
+// for tests, while production mode can pump timers + IO through this core
+// (single-writer actors preserved — the ring hands messages back to the
+// owning thread, it never runs Python callbacks concurrently).
+//
+// Components:
+//  - TimerWheel: hierarchical 2-level wheel, O(1) arm/cancel/advance.
+//  - MsgRing: fixed-capacity MPSC byte-message ring with mutex-free fast
+//    path for a single producer (CAS slot claim for multiple).
+//  - Poller: epoll wrapper returning (fd, events) batches.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <sys/epoll.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int kWheelSlots = 256;   // 2 levels x 256 slots
+constexpr uint64_t kTickNs = 1'000'000;  // 1ms resolution
+
+struct Timer {
+  uint64_t deadline_ns = 0;
+  uint64_t gen = 0;   // arm generation; stale wheel entries are skipped
+  int64_t user_id = 0;
+  bool armed = false;
+};
+
+struct WheelEntry {
+  int32_t timer_idx;
+  uint64_t gen;
+};
+
+struct TimerWheel {
+  std::vector<Timer> timers;
+  std::vector<int32_t> free_list;
+  std::vector<WheelEntry> slots_l0[kWheelSlots];  // next 256ms
+  std::vector<WheelEntry> slots_l1[kWheelSlots];  // next ~65s
+  std::vector<WheelEntry> overflow;               // beyond the wheels
+  uint64_t now_ns = 0;
+  uint64_t last_tick = 0;
+
+  int32_t create(int64_t user_id) {
+    int32_t idx;
+    if (!free_list.empty()) {
+      idx = free_list.back();
+      free_list.pop_back();
+    } else {
+      idx = (int32_t)timers.size();
+      timers.emplace_back();
+    }
+    timers[idx] = Timer{};
+    timers[idx].user_id = user_id;
+    return idx;
+  }
+
+  void place(int32_t idx) {
+    Timer& t = timers[idx];
+    uint64_t ticks = (t.deadline_ns > now_ns)
+                         ? (t.deadline_ns - now_ns + kTickNs - 1) / kTickNs
+                         : 0;
+    uint64_t tick = last_tick + ticks;
+    WheelEntry e{idx, t.gen};
+    if (ticks < kWheelSlots) {
+      slots_l0[tick % kWheelSlots].push_back(e);
+    } else if (ticks < (uint64_t)kWheelSlots * kWheelSlots) {
+      slots_l1[(tick / kWheelSlots) % kWheelSlots].push_back(e);
+    } else {
+      overflow.push_back(e);
+    }
+  }
+
+  void arm(int32_t idx, uint64_t deadline_ns) {
+    Timer& t = timers[idx];
+    t.gen++;
+    t.armed = true;
+    t.deadline_ns = deadline_ns;
+    place(idx);
+  }
+
+  void cancel(int32_t idx) {
+    timers[idx].gen++;
+    timers[idx].armed = false;
+  }
+
+  void destroy(int32_t idx) {
+    cancel(idx);
+    free_list.push_back(idx);
+  }
+
+  // Advance to now_ns; append expired user_ids. Returns count.
+  int advance(uint64_t to_ns, int64_t* out, int max_out) {
+    int n = 0;
+    while (now_ns < to_ns && n < max_out) {
+      uint64_t next_tick_ns = (last_tick + 1) * kTickNs;
+      if (next_tick_ns > to_ns) {
+        now_ns = to_ns;
+        break;
+      }
+      now_ns = next_tick_ns;
+      last_tick++;
+      if (last_tick % kWheelSlots == 0) cascade();
+      auto& slot = slots_l0[last_tick % kWheelSlots];
+      for (const WheelEntry& e : slot) {
+        Timer& t = timers[e.timer_idx];
+        if (t.armed && t.gen == e.gen) {
+          if (t.deadline_ns <= now_ns) {
+            t.armed = false;
+            out[n++] = t.user_id;
+            if (n == max_out) { /* rest re-found next advance */ }
+          } else {
+            place(e.timer_idx);  // re-place (cascaded early)
+          }
+        }
+      }
+      slot.clear();
+    }
+    return n;
+  }
+
+  void cascade() {
+    auto& slot = slots_l1[(last_tick / kWheelSlots) % kWheelSlots];
+    for (const WheelEntry& e : slot) {
+      Timer& t = timers[e.timer_idx];
+      if (t.armed && t.gen == e.gen) place(e.timer_idx);
+    }
+    slot.clear();
+    if ((last_tick / kWheelSlots) % kWheelSlots == 0 && !overflow.empty()) {
+      std::vector<WheelEntry> still;
+      for (const WheelEntry& e : overflow) {
+        Timer& t = timers[e.timer_idx];
+        if (!t.armed || t.gen != e.gen) continue;
+        uint64_t ticks = (t.deadline_ns - now_ns) / kTickNs;
+        if (ticks < (uint64_t)kWheelSlots * kWheelSlots) {
+          place(e.timer_idx);
+        } else {
+          still.push_back(e);
+        }
+      }
+      overflow.swap(still);
+    }
+  }
+};
+
+// MPSC ring of length-prefixed byte messages.
+struct MsgRing {
+  std::vector<uint8_t> buf;
+  std::vector<uint32_t> lens;   // per-slot payload length
+  uint32_t slot_size;
+  uint32_t capacity;
+  std::atomic<uint64_t> head{0};  // producers claim
+  std::atomic<uint64_t> ready{0}; // producers publish (in order)
+  uint64_t tail = 0;              // single consumer
+
+  MsgRing(uint32_t cap, uint32_t slot)
+      : buf((size_t)cap * slot), lens(cap), slot_size(slot), capacity(cap) {}
+
+  bool push(const uint8_t* data, uint32_t len) {
+    if (len > slot_size) return false;
+    uint64_t h = head.load(std::memory_order_relaxed);
+    for (;;) {
+      if (h - tail >= capacity) return false;  // full (approximate)
+      if (head.compare_exchange_weak(h, h + 1, std::memory_order_acq_rel))
+        break;
+    }
+    uint32_t slot = h % capacity;
+    std::memcpy(&buf[(size_t)slot * slot_size], data, len);
+    lens[slot] = len;
+    // Publish in order: wait until prior slots are published.
+    uint64_t expect = h;
+    while (!ready.compare_exchange_weak(expect, h + 1,
+                                        std::memory_order_release)) {
+      expect = h;
+    }
+    return true;
+  }
+
+  int pop(uint8_t* out, uint32_t max_len) {
+    if (tail >= ready.load(std::memory_order_acquire)) return -1;
+    uint32_t slot = tail % capacity;
+    uint32_t len = lens[slot];
+    if (len > max_len) return -2;
+    std::memcpy(out, &buf[(size_t)slot * slot_size], len);
+    tail++;
+    return (int)len;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- timer wheel
+
+void* holo_wheel_new() { return new TimerWheel(); }
+void holo_wheel_free(void* w) { delete (TimerWheel*)w; }
+int32_t holo_wheel_create(void* w, int64_t user_id) {
+  return ((TimerWheel*)w)->create(user_id);
+}
+void holo_wheel_arm(void* w, int32_t idx, double deadline_s) {
+  ((TimerWheel*)w)->arm(idx, (uint64_t)(deadline_s * 1e9));
+}
+void holo_wheel_cancel(void* w, int32_t idx) {
+  ((TimerWheel*)w)->cancel(idx);
+}
+void holo_wheel_destroy(void* w, int32_t idx) {
+  ((TimerWheel*)w)->destroy(idx);
+}
+int holo_wheel_advance(void* w, double to_s, int64_t* out, int max_out) {
+  return ((TimerWheel*)w)->advance((uint64_t)(to_s * 1e9), out, max_out);
+}
+
+// ---- message ring
+
+void* holo_ring_new(uint32_t capacity, uint32_t slot_size) {
+  return new MsgRing(capacity, slot_size);
+}
+void holo_ring_free(void* r) { delete (MsgRing*)r; }
+int holo_ring_push(void* r, const uint8_t* data, uint32_t len) {
+  return ((MsgRing*)r)->push(data, len) ? 0 : -1;
+}
+int holo_ring_pop(void* r, uint8_t* out, uint32_t max_len) {
+  return ((MsgRing*)r)->pop(out, max_len);
+}
+
+// ---- epoll poller
+
+int holo_poller_new() { return epoll_create1(0); }
+void holo_poller_free(int ep) { close(ep); }
+int holo_poller_add(int ep, int fd, uint32_t events) {
+  struct epoll_event ev;
+  ev.events = events;
+  ev.data.fd = fd;
+  return epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+}
+int holo_poller_del(int ep, int fd) {
+  return epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+}
+// Wait up to timeout_ms; writes fd/event pairs. Returns count or -errno.
+int holo_poller_wait(int ep, int timeout_ms, int32_t* fds, uint32_t* events,
+                     int max_out) {
+  struct epoll_event evs[64];
+  if (max_out > 64) max_out = 64;
+  int n = epoll_wait(ep, evs, max_out, timeout_ms);
+  for (int i = 0; i < n; i++) {
+    fds[i] = evs[i].data.fd;
+    events[i] = evs[i].events;
+  }
+  return n;
+}
+
+double holo_monotonic_now() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+}  // extern "C"
