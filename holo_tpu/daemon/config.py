@@ -26,6 +26,12 @@ class GrpcConfig:
 
 
 @dataclass
+class GnmiConfig:
+    enabled: bool = False
+    address: str = "127.0.0.1:50052"
+
+
+@dataclass
 class EventRecorderConfig:
     enabled: bool = False
     dir: str = "/tmp/holo_tpu-events"
@@ -36,6 +42,7 @@ class DaemonConfig:
     db_path: str | None = None
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     grpc: GrpcConfig = field(default_factory=GrpcConfig)
+    gnmi: GnmiConfig = field(default_factory=GnmiConfig)
     event_recorder: EventRecorderConfig = field(default_factory=EventRecorderConfig)
 
     @classmethod
@@ -54,6 +61,10 @@ class DaemonConfig:
             g = raw["grpc"]
             cfg.grpc.enabled = g.get("enabled", True)
             cfg.grpc.address = g.get("address", cfg.grpc.address)
+        if "gnmi" in raw:
+            g = raw["gnmi"]
+            cfg.gnmi.enabled = g.get("enabled", False)
+            cfg.gnmi.address = g.get("address", cfg.gnmi.address)
         if "event_recorder" in raw:
             e = raw["event_recorder"]
             cfg.event_recorder.enabled = e.get("enabled", False)

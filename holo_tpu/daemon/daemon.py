@@ -102,9 +102,17 @@ class Daemon:
         self._grpc_server = serve(self, address or self.config.grpc.address)
         return self._grpc_server
 
+    def start_gnmi(self, address: str | None = None):
+        from holo_tpu.daemon.gnmi_server import serve_gnmi
+
+        self._gnmi_server = serve_gnmi(self, address or self.config.gnmi.address)
+        return self._gnmi_server
+
     def stop(self):
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=0.5)
+        if getattr(self, "_gnmi_server", None) is not None:
+            self._gnmi_server.stop(grace=0.5)
 
 
 def main(argv=None):
@@ -119,6 +127,9 @@ def main(argv=None):
     if cfg.grpc.enabled:
         daemon.start_grpc()
         log.info("gRPC northbound on %s", cfg.grpc.address)
+    if cfg.gnmi.enabled:
+        daemon.start_gnmi()
+        log.info("gNMI northbound on %s", cfg.gnmi.address)
     log.info("holo_tpu daemon running")
     try:
         import time

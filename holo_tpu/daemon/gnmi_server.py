@@ -1,0 +1,262 @@
+"""gNMI service: Capabilities / Get / Set / Subscribe over the northbound.
+
+Reference: holo-daemon gNMI plugin (client/gnmi.rs:49-268) — Get merges
+config+state, Set runs one transaction per request, Subscribe streams
+notifications.  gNMI paths map to the YANG-lite tree: path elems with keys
+become the bracket path segments (``interface[name=eth0]`` ->
+``interface[eth0]``).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import time
+from concurrent import futures
+from pathlib import Path as FsPath
+
+import grpc
+
+sys.path.insert(0, str(FsPath(__file__).resolve().parent))
+import gnmi_lite_pb2 as pb  # noqa: E402
+
+import holo_tpu
+from holo_tpu.northbound.provider import CommitError
+from holo_tpu.yang.schema import SchemaError
+
+
+def path_to_str(path: pb.Path) -> str:
+    segs = []
+    for elem in path.elem:
+        if elem.key:
+            # single-key lists: the key value is the instance selector
+            key = next(iter(elem.key.values()))
+            segs.append(f"{elem.name}[{key}]")
+        else:
+            segs.append(elem.name)
+    return "/".join(segs)
+
+
+def str_to_path(s: str) -> pb.Path:
+    from holo_tpu.yang.schema import parse_path
+
+    p = pb.Path()
+    for name, key in parse_path(s):
+        e = p.elem.add()
+        e.name = name
+        if key is not None:
+            e.key["name"] = key
+    return p
+
+
+class GnmiService:
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self._subscribers: list[queue.Queue] = []
+
+    def Capabilities(self, request, context):
+        resp = pb.CapabilityResponse(
+            supported_encodings=["JSON_IETF"],
+            gNMI_version="0.8.0-lite",
+        )
+        for name in sorted(self.daemon.northbound.schema.roots.keys()):
+            resp.supported_models.add(
+                name=name, organization="holo_tpu", version=holo_tpu.__version__
+            )
+        return resp
+
+    def Get(self, request, context):
+        with self.daemon.lock:
+            nb = self.daemon.northbound
+            notif = pb.Notification(timestamp=int(time.time() * 1e9))
+            paths = list(request.path) or [pb.Path()]
+            for path in paths:
+                pstr = path_to_str(path)
+                payload = {}
+                if request.type in (pb.GetRequest.ALL, pb.GetRequest.CONFIG):
+                    val = (
+                        json.loads(nb.running.to_json())
+                        if not pstr
+                        else nb.running.get(pstr)
+                    )
+                    if val is not None:
+                        payload["config"] = val
+                if request.type in (
+                    pb.GetRequest.ALL,
+                    pb.GetRequest.STATE,
+                    pb.GetRequest.OPERATIONAL,
+                ):
+                    state = nb.get_state(pstr or None)
+                    if state:
+                        payload["state"] = state
+                notif.update.add(
+                    path=path,
+                    val=pb.TypedValue(
+                        json_ietf_val=json.dumps(payload, default=str)
+                    ),
+                )
+        return pb.GetResponse(notification=[notif])
+
+    def Set(self, request, context):
+        nb = self.daemon.northbound
+        results = []
+        try:
+            with self.daemon.lock:
+                cand = nb.running.copy()
+                for path in request.delete:
+                    cand.delete(path_to_str(path))
+                    results.append(
+                        pb.UpdateResult(path=path, op=pb.UpdateResult.DELETE)
+                    )
+                n_replace = len(request.replace)
+                for i, upd in enumerate(
+                    list(request.replace) + list(request.update)
+                ):
+                    is_replace = i < n_replace
+                    pstr = path_to_str(upd.path)
+                    if is_replace:
+                        # gNMI Replace semantics: the subtree is replaced,
+                        # not merged — leaves absent from the payload go.
+                        cand.delete(pstr)
+                    v = upd.val
+                    which = v.WhichOneof("value")
+                    if which == "json_ietf_val":
+                        sub = json.loads(v.json_ietf_val)
+                        _apply_json(cand, pstr, sub)
+                    elif which is not None:
+                        cand.set(pstr, getattr(v, which))
+                    else:
+                        cand.set(pstr)
+                    op = (
+                        pb.UpdateResult.REPLACE
+                        if is_replace
+                        else pb.UpdateResult.UPDATE
+                    )
+                    results.append(pb.UpdateResult(path=upd.path, op=op))
+                txn = self.daemon.commit(cand, comment="gnmi-set")
+            self._notify_commit(txn)
+        except (SchemaError, CommitError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.SetResponse(
+            response=results, timestamp=int(time.time() * 1e9)
+        )
+
+    def Subscribe(self, request_iterator, context):
+        q: queue.Queue = queue.Queue(maxsize=256)
+        self._subscribers.append(q)
+        try:
+            first = next(iter(request_iterator), None)
+            # Initial sync: current state snapshot then sync_response.
+            with self.daemon.lock:
+                state = self.daemon.northbound.get_state(None)
+            notif = pb.Notification(timestamp=int(time.time() * 1e9))
+            notif.update.add(
+                path=pb.Path(),
+                val=pb.TypedValue(json_ietf_val=json.dumps(state, default=str)),
+            )
+            yield pb.SubscribeResponse(update=notif)
+            yield pb.SubscribeResponse(sync_response=True)
+            if (
+                first is not None
+                and first.subscribe.mode == pb.SubscriptionList.ONCE
+            ):
+                return
+            while context.is_active():
+                try:
+                    notif = q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                yield pb.SubscribeResponse(update=notif)
+        finally:
+            self._subscribers.remove(q)
+
+    def _notify_commit(self, txn) -> None:
+        notif = pb.Notification(timestamp=int(time.time() * 1e9))
+        notif.update.add(
+            path=str_to_path("transactions"),
+            val=pb.TypedValue(
+                json_ietf_val=json.dumps(
+                    {"transaction-id": txn.id, "comment": txn.comment}
+                )
+            ),
+        )
+        for q in list(self._subscribers):
+            try:
+                q.put_nowait(notif)
+            except queue.Full:
+                pass
+
+
+def _apply_json(tree, base: str, sub) -> None:
+    """Merge a JSON subtree at base path (leaves set individually)."""
+    if not isinstance(sub, dict):
+        tree.set(base, sub)
+        return
+    for k, v in sub.items():
+        p = f"{base}/{k}" if base else k
+        if isinstance(v, dict):
+            # list entries look like {"key": {...}} under a list node; we
+            # detect by trying as a container first and falling back.
+            try:
+                node = tree.schema.resolve(p)
+            except SchemaError:
+                node = None
+            from holo_tpu.yang.schema import List as SchemaList
+
+            if isinstance(node, SchemaList):
+                for key, entry in v.items():
+                    _apply_json(tree, f"{p}[{key}]", entry)
+            else:
+                _apply_json(tree, p, v)
+        elif isinstance(v, list):
+            tree.set(p, v)
+        else:
+            tree.set(p, v)
+
+
+def serve_gnmi(daemon, address: str) -> grpc.Server:
+    service = GnmiService(daemon)
+    svc_desc = pb.DESCRIPTOR.services_by_name["gNMI"]
+    handlers = {}
+    for m in svc_desc.methods:
+        req = getattr(pb, m.input_type.name)
+        resp = getattr(pb, m.output_type.name)
+        fn = getattr(service, m.name)
+        if m.name == "Subscribe":
+            handlers[m.name] = grpc.stream_stream_rpc_method_handler(
+                fn, request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString)
+        else:
+            handlers[m.name] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req.FromString,
+                response_serializer=resp.SerializeToString)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("gnmi.gNMI", handlers),)
+    )
+    server.add_insecure_port(address)
+    server.start()
+    daemon._gnmi_service = service
+    return server
+
+
+class GnmiClient:
+    """Minimal test client."""
+
+    def __init__(self, address: str):
+        self.channel = grpc.insecure_channel(address)
+        svc = pb.DESCRIPTOR.services_by_name["gNMI"]
+        for m in svc.methods:
+            req = getattr(pb, m.input_type.name)
+            resp = getattr(pb, m.output_type.name)
+            path = f"/gnmi.gNMI/{m.name}"
+            if m.name == "Subscribe":
+                call = self.channel.stream_stream(
+                    path, request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString)
+            else:
+                call = self.channel.unary_unary(
+                    path, request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString)
+            setattr(self, m.name, call)

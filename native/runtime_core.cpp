@@ -45,6 +45,8 @@ struct TimerWheel {
   std::vector<WheelEntry> slots_l0[kWheelSlots];  // next 256ms
   std::vector<WheelEntry> slots_l1[kWheelSlots];  // next ~65s
   std::vector<WheelEntry> overflow;               // beyond the wheels
+  std::vector<int64_t> fired_queue;               // expired, not yet reported
+  size_t fired_pos = 0;
   uint64_t now_ns = 0;
   uint64_t last_tick = 0;
 
@@ -67,6 +69,9 @@ struct TimerWheel {
     uint64_t ticks = (t.deadline_ns > now_ns)
                          ? (t.deadline_ns - now_ns + kTickNs - 1) / kTickNs
                          : 0;
+    // A due/past deadline must fire on the NEXT scanned tick; placing it
+    // in the current slot would delay it a full wheel rotation (256ms).
+    if (ticks == 0) ticks = 1;
     uint64_t tick = last_tick + ticks;
     WheelEntry e{idx, t.gen};
     if (ticks < kWheelSlots) {
@@ -96,10 +101,12 @@ struct TimerWheel {
     free_list.push_back(idx);
   }
 
-  // Advance to now_ns; append expired user_ids. Returns count.
+  // Advance to now_ns; report expired user_ids (internally queued so a
+  // dense slot can never overflow the caller's buffer — the Python side
+  // keeps calling until it gets a short read).  Returns count.
   int advance(uint64_t to_ns, int64_t* out, int max_out) {
-    int n = 0;
-    while (now_ns < to_ns && n < max_out) {
+    while (fired_queue.size() - fired_pos < (size_t)max_out &&
+           now_ns < to_ns) {
       uint64_t next_tick_ns = (last_tick + 1) * kTickNs;
       if (next_tick_ns > to_ns) {
         now_ns = to_ns;
@@ -114,14 +121,21 @@ struct TimerWheel {
         if (t.armed && t.gen == e.gen) {
           if (t.deadline_ns <= now_ns) {
             t.armed = false;
-            out[n++] = t.user_id;
-            if (n == max_out) { /* rest re-found next advance */ }
+            fired_queue.push_back(t.user_id);
           } else {
             place(e.timer_idx);  // re-place (cascaded early)
           }
         }
       }
       slot.clear();
+    }
+    int n = 0;
+    while (n < max_out && fired_pos < fired_queue.size()) {
+      out[n++] = fired_queue[fired_pos++];
+    }
+    if (fired_pos == fired_queue.size()) {
+      fired_queue.clear();
+      fired_pos = 0;
     }
     return n;
   }
@@ -156,9 +170,9 @@ struct MsgRing {
   std::vector<uint32_t> lens;   // per-slot payload length
   uint32_t slot_size;
   uint32_t capacity;
-  std::atomic<uint64_t> head{0};  // producers claim
-  std::atomic<uint64_t> ready{0}; // producers publish (in order)
-  uint64_t tail = 0;              // single consumer
+  std::atomic<uint64_t> head{0};   // producers claim
+  std::atomic<uint64_t> ready{0};  // producers publish (in order)
+  std::atomic<uint64_t> tail{0};   // single consumer advances; producers read
 
   MsgRing(uint32_t cap, uint32_t slot)
       : buf((size_t)cap * slot), lens(cap), slot_size(slot), capacity(cap) {}
@@ -167,7 +181,8 @@ struct MsgRing {
     if (len > slot_size) return false;
     uint64_t h = head.load(std::memory_order_relaxed);
     for (;;) {
-      if (h - tail >= capacity) return false;  // full (approximate)
+      if (h - tail.load(std::memory_order_acquire) >= capacity)
+        return false;  // full
       if (head.compare_exchange_weak(h, h + 1, std::memory_order_acq_rel))
         break;
     }
@@ -184,12 +199,13 @@ struct MsgRing {
   }
 
   int pop(uint8_t* out, uint32_t max_len) {
-    if (tail >= ready.load(std::memory_order_acquire)) return -1;
-    uint32_t slot = tail % capacity;
+    uint64_t t = tail.load(std::memory_order_relaxed);
+    if (t >= ready.load(std::memory_order_acquire)) return -1;
+    uint32_t slot = t % capacity;
     uint32_t len = lens[slot];
     if (len > max_len) return -2;
     std::memcpy(out, &buf[(size_t)slot * slot_size], len);
-    tail++;
+    tail.store(t + 1, std::memory_order_release);
     return (int)len;
   }
 };
