@@ -92,7 +92,20 @@ class Daemon:
         with self.lock:
             txn = self.northbound.commit(candidate, **kw)
             self.loop.run_until_idle()
-            return txn
+        # Commit notifications fan out to every management surface
+        # (gRPC Subscribe, gNMI Subscribe, ...), regardless of which one
+        # performed the commit.
+        for listener in list(getattr(self, "commit_listeners", [])):
+            try:
+                listener(txn)
+            except Exception:
+                log.exception("commit listener failed")
+        return txn
+
+    def add_commit_listener(self, fn) -> None:
+        if not hasattr(self, "commit_listeners"):
+            self.commit_listeners = []
+        self.commit_listeners.append(fn)
 
     # -- gRPC
 

@@ -72,31 +72,35 @@ class GnmiService:
             notif = pb.Notification(timestamp=int(time.time() * 1e9))
             paths = list(request.path) or [pb.Path()]
             for path in paths:
-                pstr = path_to_str(path)
-                payload = {}
-                if request.type in (pb.GetRequest.ALL, pb.GetRequest.CONFIG):
-                    val = (
-                        json.loads(nb.running.to_json())
-                        if not pstr
-                        else nb.running.get(pstr)
-                    )
-                    if val is not None:
-                        payload["config"] = val
-                if request.type in (
-                    pb.GetRequest.ALL,
-                    pb.GetRequest.STATE,
-                    pb.GetRequest.OPERATIONAL,
-                ):
-                    state = nb.get_state(pstr or None)
-                    if state:
-                        payload["state"] = state
-                notif.update.add(
-                    path=path,
-                    val=pb.TypedValue(
-                        json_ietf_val=json.dumps(payload, default=str)
-                    ),
-                )
+                try:
+                    self._get_one(nb, request, notif, path)
+                except SchemaError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.GetResponse(notification=[notif])
+
+    def _get_one(self, nb, request, notif, path):
+        pstr = path_to_str(path)
+        payload = {}
+        if request.type in (pb.GetRequest.ALL, pb.GetRequest.CONFIG):
+            val = (
+                json.loads(nb.running.to_json())
+                if not pstr
+                else nb.running.get(pstr)
+            )
+            if val is not None:
+                payload["config"] = val
+        if request.type in (
+            pb.GetRequest.ALL,
+            pb.GetRequest.STATE,
+            pb.GetRequest.OPERATIONAL,
+        ):
+            state = nb.get_state(pstr or None)
+            if state:
+                payload["state"] = state
+        notif.update.add(
+            path=path,
+            val=pb.TypedValue(json_ietf_val=json.dumps(payload, default=str)),
+        )
 
     def Set(self, request, context):
         nb = self.daemon.northbound
@@ -135,7 +139,6 @@ class GnmiService:
                     )
                     results.append(pb.UpdateResult(path=upd.path, op=op))
                 txn = self.daemon.commit(cand, comment="gnmi-set")
-            self._notify_commit(txn)
         except (SchemaError, CommitError) as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.SetResponse(
@@ -217,6 +220,7 @@ def _apply_json(tree, base: str, sub) -> None:
 
 def serve_gnmi(daemon, address: str) -> grpc.Server:
     service = GnmiService(daemon)
+    daemon.add_commit_listener(service._notify_commit)
     svc_desc = pb.DESCRIPTOR.services_by_name["gNMI"]
     handlers = {}
     for m in svc_desc.methods:

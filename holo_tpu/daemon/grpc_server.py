@@ -118,7 +118,6 @@ class NorthboundService:
                 comment=request.comment,
                 confirmed_timeout=request.confirmed_timeout or None,
             )
-            self._notify("commit", {"transaction-id": txn.id, "comment": txn.comment})
             return pb.CommitResponse(transaction_id=txn.id, error="")
         except (SchemaError, CommitError) as e:
             return pb.CommitResponse(transaction_id=0, error=str(e))
@@ -234,6 +233,11 @@ def _handlers(service: NorthboundService) -> grpc.GenericRpcHandler:
 
 def serve(daemon, address: str) -> grpc.Server:
     service = NorthboundService(daemon)
+    daemon.add_commit_listener(
+        lambda txn: service._notify(
+            "commit", {"transaction-id": txn.id, "comment": txn.comment}
+        )
+    )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((_handlers(service),))
     server.add_insecure_port(address)

@@ -75,7 +75,15 @@ def marshal_blocks(topo: Topology) -> BlockGraph:
     bj = src // S
     bi = dst // S
     key = bi.astype(np.int64) * nb + bj
-    uniq, inv = np.unique(key, return_inverse=True)
+    # Every destination block needs at least one pair or the kernel never
+    # initializes its output rows — add identity CAP-only pairs for blocks
+    # with no in-edges (their rows then just carry the previous distances).
+    missing = sorted(set(range(nb)) - set((key // nb).tolist()))
+    key_all = np.concatenate(
+        [key, np.array([m * nb + m for m in missing], np.int64)]
+    )
+    uniq, inv_all = np.unique(key_all, return_inverse=True)
+    inv = inv_all[: len(key)]
     p = len(uniq)
     bsrc = (uniq % nb).astype(np.int32)
     bdst = (uniq // nb).astype(np.int32)

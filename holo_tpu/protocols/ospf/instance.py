@@ -921,7 +921,8 @@ class OspfInstance(Actor):
                                    iface.prefix.network_address,
                                    mask_of(iface.prefix), cost)
                     )
-        body = LsaRouter(flags=RouterFlags(0), links=links)
+        flags = RouterFlags.B if self.is_abr else RouterFlags(0)
+        body = LsaRouter(flags=flags, links=links)
         self._originate(area, LsaType.ROUTER, self.config.router_id, body)
 
     def _originate_network_lsa(self, area: Area, iface: OspfInterface) -> None:
@@ -997,10 +998,22 @@ class OspfInstance(Actor):
 
     # ----- SPF execution + route programming
 
+    @property
+    def is_abr(self) -> bool:
+        """Area border router: interfaces in more than one active area."""
+        active = [
+            a
+            for a in self.areas.values()
+            if any(i.state != IsmState.DOWN for i in a.interfaces.values())
+        ]
+        return len(active) > 1
+
     def run_spf(self) -> None:
         now = self.loop.clock.now()
         self.spf_run_count += 1
         all_routes = {}
+        area_intra: dict[IPv4Address, dict] = {}
+        area_results: dict[IPv4Address, tuple] = {}
         for area in self.areas.values():
             iface_by_addr = {
                 i.addr_ip: i.name for i in area.interfaces.values() if i.addr_ip
@@ -1016,12 +1029,146 @@ class OspfInstance(Actor):
             if st is None:
                 continue
             res = self.backend.compute(st.topo)
-            for prefix, route in derive_routes(st, res, area.lsdb, now, area.area_id).items():
+            area_results[area.area_id] = (st, res)
+            intra = derive_routes(st, res, area.lsdb, now, area.area_id)
+            area_intra[area.area_id] = intra
+            for prefix, route in intra.items():
                 cur = all_routes.get(prefix)
                 if cur is None or route.dist < cur.dist or (
                     route.dist == cur.dist and int(route.area_id) < int(cur.area_id)
                 ):
                     all_routes[prefix] = route
+
+        # Inter-area routes (RFC 2328 §16.2, condensed): consume Summary
+        # LSAs using the distance to the advertising ABR from this area's
+        # SPF; intra-area paths are always preferred for the same prefix.
+        from holo_tpu.protocols.ospf.spf_run import IntraRoute, _atoms_of
+        from holo_tpu.utils.ip import apply_mask
+
+        intra_prefixes = set(all_routes.keys())
+        inter_routes: dict = {}
+        for area in self.areas.values():
+            sr = area_results.get(area.area_id)
+            if sr is None:
+                continue
+            st, res = sr
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.SUMMARY_NETWORK
+                    or lsa.adv_rtr == self.config.router_id
+                    or e.current_age(now) >= MAX_AGE
+                ):
+                    continue
+                if self.is_abr and int(area.area_id) != 0:
+                    # §16.2: ABRs examine backbone summaries only — transit
+                    # through non-backbone areas would break the hierarchy.
+                    continue
+                abr_v = st.router_index.get(lsa.adv_rtr)
+                if abr_v is None or res.dist[abr_v] >= 0x40000000:
+                    continue
+                prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                if prefix in intra_prefixes:
+                    continue  # intra-area preferred
+                dist = int(res.dist[abr_v]) + lsa.body.metric
+                nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
+                cur = all_routes.get(prefix)
+                if cur is None or dist < cur.dist:
+                    route = IntraRoute(prefix, dist, nhs, area.area_id)
+                    all_routes[prefix] = route
+                    inter_routes[prefix] = route
+                elif dist == cur.dist:
+                    # Equal-cost inter-area paths union their next hops
+                    # (area_id reflects the latest contributing area).
+                    route = IntraRoute(
+                        prefix, dist, cur.nexthops | nhs, area.area_id
+                    )
+                    all_routes[prefix] = route
+                    inter_routes[prefix] = route
+
+        # ABR: (re-)originate Summary LSAs — each area's intra routes are
+        # advertised into every other attached area (loop-free: summaries
+        # are never derived from summaries).
+        if self.is_abr:
+            self._originate_summaries(area_intra, inter_routes)
+        else:
+            # No longer (or never) an ABR: flush any self-originated
+            # summaries or neighbors would route into a dead hierarchy
+            # forever (refresh would keep them alive otherwise).
+            for area in self.areas.values():
+                for key in list(area.lsdb.entries):
+                    if (
+                        key.type == LsaType.SUMMARY_NETWORK
+                        and key.adv_rtr == self.config.router_id
+                        and not area.lsdb.entries[key].lsa.is_maxage
+                    ):
+                        self._flush_self_lsa(area, key)
+
+        self._finish_spf(all_routes)
+
+    def _originate_summaries(self, area_intra: dict, inter_routes: dict) -> None:
+        """ABR summary generation: intra-area routes of each area go into
+        every other attached area; inter-area routes learned via the
+        BACKBONE are re-summarized into non-backbone areas (the standard
+        loop-free hierarchy, RFC 2328 §12.4.3)."""
+        from holo_tpu.utils.ip import mask_of
+
+        backbone = IPv4Address(0)
+        wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
+        for src_aid, routes in area_intra.items():
+            for prefix, route in routes.items():
+                for dst_aid in self.areas:
+                    if dst_aid == src_aid:
+                        continue
+                    cur = wanted[dst_aid].get(prefix)
+                    if cur is None or route.dist < cur:
+                        wanted[dst_aid][prefix] = route.dist
+        for prefix, route in inter_routes.items():
+            if route.area_id != backbone:
+                continue
+            for dst_aid in self.areas:
+                if dst_aid == backbone:
+                    continue
+                cur = wanted[dst_aid].get(prefix)
+                if cur is None or route.dist < cur:
+                    wanted[dst_aid][prefix] = route.dist
+        for aid, prefixes in wanted.items():
+            area = self.areas[aid]
+            # Link-state-ID assignment with the RFC 2328 Appendix E rule:
+            # prefixes sharing a network address get host bits set on the
+            # more specific ones so their LSA keys stay distinct.
+            by_net: dict[IPv4Address, list] = {}
+            for p in prefixes:
+                by_net.setdefault(p.network_address, []).append(p)
+            lsid_of = {}
+            for net, group in by_net.items():
+                group.sort(key=lambda p: p.prefixlen)
+                lsid_of[group[0]] = net
+                for p in group[1:]:
+                    lsid_of[p] = IPv4Address(
+                        int(net) | (~int(mask_of(p)) & 0xFFFFFFFF)
+                    )
+            wanted_lsids = set(lsid_of.values())
+            # Flush summaries we no longer want in this area.
+            for key in list(area.lsdb.entries):
+                if (
+                    key.type == LsaType.SUMMARY_NETWORK
+                    and key.adv_rtr == self.config.router_id
+                    and key.lsid not in wanted_lsids
+                ):
+                    if not area.lsdb.entries[key].lsa.is_maxage:
+                        self._flush_self_lsa(area, key)
+            for prefix, dist in prefixes.items():
+                from holo_tpu.protocols.ospf.packet import LsaSummary
+
+                self._originate(
+                    area,
+                    LsaType.SUMMARY_NETWORK,
+                    lsid_of[prefix],
+                    LsaSummary(mask_of(prefix), dist),
+                )
+
+    def _finish_spf(self, all_routes: dict) -> None:
         old = self.routes
         self.routes = all_routes
         if self.route_cb is not None:

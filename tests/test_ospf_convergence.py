@@ -230,6 +230,74 @@ def test_link_failure_reconvergence():
     assert route.dist == 5 + 5 + 1
 
 
+def test_multi_area_inter_area_routes():
+    """r1 (area 1) -- r2 (ABR: areas 1+0) -- r3 (area 0): prefixes cross
+    the ABR as Summary-LSAs and both edge routers get inter-area routes."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")
+    area1 = A("0.0.0.1")
+    cfg1 = IfConfig(area_id=area1, if_type=IfType.POINT_TO_POINT, cost=10)
+    cfg0 = IfConfig(area_id=AREA0, if_type=IfType.POINT_TO_POINT, cost=5)
+    r1.add_interface("e0", cfg1, N("10.0.12.0/30"), A("10.0.12.1"))
+    r2.add_interface("e0", cfg1, N("10.0.12.0/30"), A("10.0.12.2"))
+    r2.add_interface("e1", cfg0, N("10.0.23.0/30"), A("10.0.23.1"))
+    r3.add_interface("e0", cfg0, N("10.0.23.0/30"), A("10.0.23.2"))
+    fabric.join("l12", "r1", "e0", A("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", A("10.0.12.2"))
+    fabric.join("l23", "r2", "e1", A("10.0.23.1"))
+    fabric.join("l23", "r3", "e0", A("10.0.23.2"))
+    bring_up(loop, [r1, r2, r3], seconds=90)
+
+    assert r2.is_abr
+    # r1 (area 1 only) reaches the area-0 prefix via a summary.
+    route = r1.routes.get(N("10.0.23.0/30"))
+    assert route is not None, "no inter-area route at r1"
+    assert route.dist == 10 + 5
+    assert {(nh.ifname, str(nh.addr)) for nh in route.nexthops} == {
+        ("e0", "10.0.12.2")
+    }
+    # r3 (area 0 only) reaches the area-1 prefix.
+    route = r3.routes.get(N("10.0.12.0/30"))
+    assert route is not None and route.dist == 5 + 10
+    # ABR's router LSA carries the B bit in both areas.
+    from holo_tpu.protocols.ospf.packet import LsaKey, LsaType, RouterFlags
+
+    for aid in (AREA0, area1):
+        e = r2.areas[aid].lsdb.get(
+            LsaKey(LsaType.ROUTER, A("2.2.2.2"), A("2.2.2.2"))
+        )
+        assert e is not None and e.lsa.body.flags & RouterFlags.B
+
+
+def test_three_area_hierarchy_chained_abrs():
+    """area1 -- ABR -- backbone -- ABR -- area2: backbone-learned
+    inter-area routes are re-summarized into leaf areas (§12.4.3)."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    rs = [mk_router(loop, fabric, f"r{i}", f"{i}.{i}.{i}.{i}") for i in (1, 2, 3, 4)]
+    r1, r2, r3, r4 = rs
+
+    def alink(nm, a, ai, aa, b, bi, ba, net, c, area):
+        cfg = IfConfig(area_id=A(area), if_type=IfType.POINT_TO_POINT, cost=c)
+        a.add_interface(ai, cfg, N(net), A(aa))
+        b.add_interface(bi, cfg, N(net), A(ba))
+        fabric.join(nm, a.name, ai, A(aa))
+        fabric.join(nm, b.name, bi, A(ba))
+
+    alink("a", r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2", "10.0.12.0/30", 10, "0.0.0.1")
+    alink("b", r2, "e1", "10.0.23.1", r3, "e0", "10.0.23.2", "10.0.23.0/30", 5, "0.0.0.0")
+    alink("c", r3, "e1", "10.0.34.1", r4, "e0", "10.0.34.2", "10.0.34.0/30", 3, "0.0.0.2")
+    bring_up(loop, rs, seconds=150)
+
+    route = r1.routes.get(N("10.0.34.0/30"))
+    assert route is not None and route.dist == 10 + 5 + 3
+    route = r4.routes.get(N("10.0.12.0/30"))
+    assert route is not None and route.dist == 18
+
+
 def test_ecmp_on_equal_cost_paths():
     """Two equal-cost paths r1->r4 must produce two next hops."""
     loop = EventLoop(clock=VirtualClock())
