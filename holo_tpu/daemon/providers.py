@@ -250,20 +250,27 @@ class RoutingProvider(Provider, Actor):
         if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
             # Interface removed from the system: down it in every protocol
             # instance that uses it (stops hellos, withdraws the subnet).
-            from holo_tpu.protocols.isis.instance import IsisIfDownMsg
+            from holo_tpu.protocols.isis.instance import IsisIfDownMsg, IsisInstance
             from holo_tpu.protocols.ospf.instance import IfDownMsg
+            from holo_tpu.protocols.ospf.instance_v3 import (
+                OspfV3Instance,
+                V3IfDownMsg,
+            )
 
             ifname = msg.payload
             for inst in self.instances.values():
-                if ifname in getattr(inst, "_if_area", {}):
+                if isinstance(inst, OspfInstance) and ifname in inst._if_area:
                     self.loop.send(inst.name, IfDownMsg(ifname))
-                elif ifname in getattr(inst, "interfaces", {}):
+                elif isinstance(inst, OspfV3Instance) and ifname in inst.interfaces:
+                    self.loop.send(inst.name, V3IfDownMsg(ifname))
+                elif isinstance(inst, IsisInstance) and ifname in inst.interfaces:
                     self.loop.send(inst.name, IsisIfDownMsg(ifname))
 
     def commit(self, phase, old, new, changes):
         if phase != CommitPhase.APPLY:
             return
         self._apply_ospfv2(new)
+        self._apply_ospfv3(new)
         self._apply_isis(new)
         self._apply_static(new)
 
@@ -347,6 +354,127 @@ class RoutingProvider(Provider, Actor):
                 inst.add_interface(ifname, cfg, addr, host)
                 self.loop.send(inst.name, IfUpMsg(ifname))
 
+    def _apply_ospfv3(self, new):
+        from holo_tpu.protocols.ospf.instance_v3 import (
+            OspfV3Instance,
+            V3IfConfig,
+            V3IfUpMsg,
+        )
+        from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+
+        base = "routing/control-plane-protocols/ospfv3"
+        conf = new.get(base)
+        enabled = bool(conf) and new.get(f"{base}/enabled", True)
+        inst = self.instances.get("ospfv3")
+        if not enabled:
+            if inst is not None:
+                self._drop_instance_routes(Protocol.OSPFV3, inst.routes)
+                self.loop.unregister(inst.name)
+                del self.instances["ospfv3"]
+            return
+        router_id = new.get(f"{base}/router-id")
+        if router_id is None:
+            return
+        if inst is not None and inst.router_id != IPv4Address(router_id):
+            # Router-id change: restart the instance (new LSA identity).
+            self._drop_instance_routes(Protocol.OSPFV3, inst.routes)
+            self.loop.unregister(inst.name)
+            del self.instances["ospfv3"]
+            inst = None
+        if inst is None:
+            actor = f"{self.prefix}ospfv3"
+            inst = OspfV3Instance(
+                name=actor,
+                router_id=IPv4Address(router_id),
+                netio=self.netio_factory(actor),
+                route_cb=self._ospfv3_routes_to_rib,
+            )
+            self.loop.register(inst)
+            self.instances["ospfv3"] = inst
+        areas = new.get(f"{base}/area", {}) or {}
+        for area_id, area_conf in areas.items():
+            for ifname, if_conf in (area_conf.get("interface") or {}).items():
+                if ifname in inst.interfaces:
+                    continue
+                st = self.ifp.interfaces.get(ifname)
+                if st is None:
+                    continue
+                v6 = [a for a in st.addresses if a.version == 6]
+                if not v6:
+                    continue
+                link_local = next(
+                    (a.ip for a in v6 if a.ip.is_link_local), v6[0].ip
+                )
+                prefixes = [a.network for a in v6 if not a.ip.is_link_local]
+                inst.add_interface(
+                    ifname,
+                    V3IfConfig(
+                        area_id=IPv4Address(area_id),
+                        cost=if_conf.get("cost", 10),
+                        hello_interval=if_conf.get("hello-interval", 10),
+                        dead_interval=if_conf.get("dead-interval", 40),
+                    ),
+                    link_local,
+                    prefixes,
+                )
+                self.loop.send(inst.name, V3IfUpMsg(ifname))
+
+    def _sink_routes(self, protocol, items: dict) -> None:
+        """Shared delta route sink: items = {prefix: (metric, {(if, addr)})}.
+
+        Caches the last pushed set per protocol so unchanged routes skip
+        RIB churn; the cache is cleared when the instance stops (otherwise
+        a disable/re-enable would suppress re-installation).
+        """
+        from holo_tpu.utils.southbound import (
+            DEFAULT_DISTANCE,
+            Nexthop,
+            RouteKeyMsg,
+            RouteMsg,
+        )
+
+        caches = getattr(self, "_route_caches", None)
+        if caches is None:
+            caches = self._route_caches = {}
+        old = caches.get(protocol, {})
+        for prefix in old.keys() - items.keys():
+            self.rib.route_del(RouteKeyMsg(protocol, prefix))
+        for prefix, entry in items.items():
+            if old.get(prefix) == entry:
+                continue
+            metric, nhs = entry
+            self.rib.route_add(
+                RouteMsg(
+                    protocol=protocol,
+                    prefix=prefix,
+                    distance=DEFAULT_DISTANCE.get(protocol, 250),
+                    metric=metric,
+                    nexthops=frozenset(
+                        Nexthop(addr=a, ifname=i) for i, a in nhs
+                    ),
+                )
+            )
+        caches[protocol] = dict(items)
+
+    def _drop_instance_routes(self, protocol, inst_routes) -> None:
+        from holo_tpu.utils.southbound import RouteKeyMsg
+
+        for prefix in inst_routes:
+            self.rib.route_del(RouteKeyMsg(protocol, prefix))
+        if getattr(self, "_route_caches", None):
+            self._route_caches.pop(protocol, None)
+
+    def _ospfv3_routes_to_rib(self, routes):
+        from holo_tpu.utils.southbound import Protocol
+
+        self._sink_routes(
+            Protocol.OSPFV3,
+            {
+                p: (r.dist, frozenset(r.nexthops))
+                for p, r in routes.items()
+            },
+        )
+
     def _apply_isis(self, new):
         from holo_tpu.protocols.isis.instance import (
             IsisIfConfig,
@@ -361,8 +489,7 @@ class RoutingProvider(Provider, Actor):
         inst = self.instances.get("isis")
         if not enabled:
             if inst is not None:
-                for prefix in inst.routes:
-                    self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
+                self._drop_instance_routes(Protocol.ISIS, inst.routes)
                 self.loop.unregister(inst.name)
                 del self.instances["isis"]
             return
@@ -375,10 +502,9 @@ class RoutingProvider(Provider, Actor):
         if inst is not None and inst.sysid != sysid:
             # System-id change requires a new incarnation: withdraw and
             # restart (mirrors disable+enable).
-            from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+            from holo_tpu.utils.southbound import Protocol
 
-            for prefix in inst.routes:
-                self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
+            self._drop_instance_routes(Protocol.ISIS, inst.routes)
             self.loop.unregister(inst.name)
             del self.instances["isis"]
             inst = None
@@ -407,33 +533,12 @@ class RoutingProvider(Provider, Actor):
             self.loop.send(inst.name, IsisIfUpMsg(ifname))
 
     def _isis_routes_to_rib(self, routes):
-        from holo_tpu.utils.southbound import (
-            DEFAULT_DISTANCE,
-            Nexthop,
-            Protocol,
-            RouteKeyMsg,
-            RouteMsg,
-        )
+        from holo_tpu.utils.southbound import Protocol
 
-        old = getattr(self, "_isis_last_routes", {})
-        for prefix in old.keys() - routes.keys():
-            self.rib.route_del(RouteKeyMsg(Protocol.ISIS, prefix))
-        for prefix, entry in routes.items():
-            if old.get(prefix) == entry:
-                continue  # unchanged: skip RIB churn
-            metric, nhs = entry
-            self.rib.route_add(
-                RouteMsg(
-                    protocol=Protocol.ISIS,
-                    prefix=prefix,
-                    distance=DEFAULT_DISTANCE[Protocol.ISIS],
-                    metric=metric,
-                    nexthops=frozenset(
-                        Nexthop(addr=a, ifname=i) for i, a in nhs
-                    ),
-                )
-            )
-        self._isis_last_routes = dict(routes)
+        self._sink_routes(
+            Protocol.ISIS,
+            {p: (metric, frozenset(nhs)) for p, (metric, nhs) in routes.items()},
+        )
 
     def _apply_static(self, new):
         from holo_tpu.utils.southbound import (

@@ -1,0 +1,786 @@
+"""OSPFv3 instance actor (RFC 5340): p2p circuits, v6 routing.
+
+Reference: holo-ospf's ospfv3 side of the Version trait.  Shares the
+neighbor NSM (neighbor.py) and the DD/flooding semantics with the v2
+instance; differs where the protocol differs — link-local transport,
+router-id keyed hellos, LSA types with flooding scopes, prefixes carried
+in Link / Intra-Area-Prefix LSAs, and the SPF topology built from router
+links keyed by (router-id, interface-id).
+
+Round-1 scope: point-to-point interfaces, single area, intra-area v6
+routes via Intra-Area-Prefix LSAs referencing router vertices; LAN DR
+election and inter-area land with the version-trait unification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv6Address, IPv6Network
+
+import numpy as np
+
+from holo_tpu.ops.graph import INF, Topology
+from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.lsdb import MIN_LS_ARRIVAL, Lsdb, next_seq_no
+from holo_tpu.protocols.ospf.neighbor import (
+    Neighbor,
+    NsmEvent,
+    NsmState,
+    nsm_transition,
+)
+from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.utils.ip import ALL_SPF_RTRS_V6
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor
+
+DD_CHUNK = 64
+AGE_TICK = 1.0
+
+
+@dataclass
+class V3IfConfig:
+    area_id: IPv4Address = IPv4Address(0)
+    cost: int = 10
+    hello_interval: int = 10
+    dead_interval: int = 40
+    rxmt_interval: int = 5
+    mtu: int = 1500
+    instance_id: int = 0
+
+
+@dataclass
+class V3Interface:
+    name: str
+    config: V3IfConfig
+    iface_id: int
+    link_local: IPv6Address
+    prefixes: list[IPv6Network] = field(default_factory=list)
+    up: bool = False
+    neighbors: dict[IPv4Address, Neighbor] = field(default_factory=dict)
+
+
+@dataclass
+class HelloTimerV3:
+    ifname: str
+
+
+@dataclass
+class InactivityTimerV3:
+    ifname: str
+    nbr_id: IPv4Address
+
+
+@dataclass
+class RxmtTimerV3:
+    ifname: str
+    nbr_id: IPv4Address
+
+
+@dataclass
+class SpfTimerV3:
+    pass
+
+
+@dataclass
+class AgeTickV3:
+    pass
+
+
+@dataclass
+class V3IfUpMsg:
+    ifname: str
+
+
+@dataclass
+class V3IfDownMsg:
+    ifname: str
+
+
+@dataclass
+class V6Route:
+    prefix: IPv6Network
+    dist: int
+    nexthops: frozenset  # {(ifname, link-local addr)}
+
+
+class OspfV3Instance(Actor):
+    """One OSPFv3 routing process (single area, p2p)."""
+
+    name = "ospfv3"
+
+    def __init__(
+        self,
+        name: str,
+        router_id: IPv4Address,
+        netio: NetIo,
+        spf_backend: SpfBackend | None = None,
+        route_cb=None,
+    ):
+        self.name = name
+        self.router_id = router_id
+        self.netio = netio
+        self.backend = spf_backend or ScalarSpfBackend()
+        self.route_cb = route_cb
+        self.interfaces: dict[str, V3Interface] = {}
+        self.lsdb = Lsdb()
+        self.routes: dict[IPv6Network, V6Route] = {}
+        self.spf_run_count = 0
+        self._dd_seq = 0x3000
+        self._next_iface_id = 1
+        self._spf_pending = False
+        self._timers: dict[tuple, object] = {}
+
+    def attach(self, loop_):
+        super().attach(loop_)
+        self._age_timer = self.loop.timer(self.name, AgeTickV3)
+        self._age_timer.start(AGE_TICK)
+        self._spf_timer = self.loop.timer(self.name, SpfTimerV3)
+
+    def add_interface(
+        self,
+        ifname: str,
+        cfg: V3IfConfig,
+        link_local: IPv6Address,
+        prefixes: list[IPv6Network],
+    ) -> V3Interface:
+        iface = V3Interface(
+            name=ifname,
+            config=cfg,
+            iface_id=self._next_iface_id,
+            link_local=link_local,
+            prefixes=list(prefixes),
+        )
+        self._next_iface_id += 1
+        self.interfaces[ifname] = iface
+        return iface
+
+    # -- actor
+
+    def handle(self, msg):
+        if isinstance(msg, NetRxPacket):
+            self._rx(msg)
+        elif isinstance(msg, HelloTimerV3):
+            self._send_hello(msg.ifname)
+        elif isinstance(msg, InactivityTimerV3):
+            self._nbr_event(msg.ifname, msg.nbr_id, NsmEvent.INACTIVITY_TIMER)
+        elif isinstance(msg, RxmtTimerV3):
+            self._rxmt(msg.ifname, msg.nbr_id)
+        elif isinstance(msg, SpfTimerV3):
+            self._spf_pending = False
+            self.run_spf()
+        elif isinstance(msg, AgeTickV3):
+            self._age_tick()
+        elif isinstance(msg, V3IfUpMsg):
+            self.if_up(msg.ifname)
+        elif isinstance(msg, V3IfDownMsg):
+            self.if_down(msg.ifname)
+
+    def if_up(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None or iface.up:
+            return
+        iface.up = True
+        self._send_hello(ifname)
+        self._originate_router_lsa()
+        self._originate_intra_area_prefix()
+
+    def if_down(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None or not iface.up:
+            return
+        for nbr_id in list(iface.neighbors):
+            self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+        iface.up = False
+        for key in (("hello", ifname),):
+            t = self._timers.get(key)
+            if t:
+                t.cancel()
+        self._originate_router_lsa()
+        self._originate_intra_area_prefix()
+        self._schedule_spf()
+
+    # -- timers
+
+    def _timer(self, key, fn):
+        t = self._timers.get(key)
+        if t is None:
+            t = self.loop.timer(self.name, fn)
+            self._timers[key] = t
+        return t
+
+    # -- hello
+
+    def _send_hello(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None or not iface.up:
+            return
+        hello = P.Hello(
+            iface_id=iface.iface_id,
+            priority=1,
+            options=P.Options.V6 | P.Options.E | P.Options.R,
+            hello_interval=iface.config.hello_interval,
+            dead_interval=iface.config.dead_interval,
+            dr=IPv4Address(0),
+            bdr=IPv4Address(0),
+            neighbors=[n.router_id for n in iface.neighbors.values()
+                       if n.state >= NsmState.INIT],
+        )
+        self._send(iface, ALL_SPF_RTRS_V6, hello)
+        self._timer(("hello", ifname), lambda: HelloTimerV3(ifname)).start(
+            iface.config.hello_interval
+        )
+
+    def _rx_hello(self, iface: V3Interface, src, pkt) -> None:
+        h = pkt.body
+        if (
+            h.hello_interval != iface.config.hello_interval
+            or h.dead_interval != iface.config.dead_interval
+        ):
+            return
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None:
+            nbr = Neighbor(router_id=pkt.router_id, src=src)
+            iface.neighbors[pkt.router_id] = nbr
+        nbr.src = src  # link-local — the v6 next hop
+        self._nbr_event(iface.name, pkt.router_id, NsmEvent.HELLO_RECEIVED)
+        self._timer(
+            ("inactivity", iface.name, pkt.router_id),
+            lambda: InactivityTimerV3(iface.name, pkt.router_id),
+        ).start(iface.config.dead_interval)
+        if self.router_id in h.neighbors:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.TWO_WAY_RECEIVED)
+        else:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.ONE_WAY_RECEIVED)
+
+    # -- NSM plumbing (p2p: always form adjacency)
+
+    def _nbr_event(self, ifname: str, nbr_id, event: NsmEvent) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        nbr = iface.neighbors.get(nbr_id)
+        if nbr is None:
+            return
+        old_state = nbr.state
+        res = nsm_transition(nbr, event, adj_ok=True)
+        nbr.state = res.new_state
+        for act in res.actions:
+            if act == "start_exstart":
+                self._start_exstart(iface, nbr)
+            elif act == "send_dd_summary":
+                self._enter_exchange(iface, nbr)
+            elif act == "send_ls_request":
+                self._send_ls_request(iface, nbr)
+            elif act == "clear_lists":
+                nbr.ls_request.clear()
+                nbr.ls_rxmt.clear()
+                nbr.dd_summary.clear()
+            elif act == "stop_timers":
+                for key in ("inactivity", "rxmt"):
+                    t = self._timers.get((key, ifname, nbr_id))
+                    if t:
+                        t.cancel()
+            elif act == "full":
+                t = self._timers.get(("rxmt", ifname, nbr_id))
+                if t:
+                    t.cancel()
+        if nbr.state == NsmState.DOWN:
+            del iface.neighbors[nbr_id]
+        if (old_state >= NsmState.FULL) != (nbr.state >= NsmState.FULL) or (
+            nbr.state == NsmState.DOWN
+        ):
+            self._originate_router_lsa()
+            self._originate_intra_area_prefix()
+
+    # -- DD exchange (same semantics as v2; v3 codec)
+
+    def _start_exstart(self, iface: V3Interface, nbr: Neighbor) -> None:
+        self._dd_seq += 1
+        nbr.dd_seq_no = self._dd_seq
+        nbr.master = True
+        dd = P.DbDesc(
+            mtu=iface.config.mtu,
+            options=P.Options.V6 | P.Options.E | P.Options.R,
+            flags=P.DbDescFlags.I | P.DbDescFlags.M | P.DbDescFlags.MS,
+            dd_seq_no=nbr.dd_seq_no,
+        )
+        nbr.last_sent_dd = dd
+        self._send(iface, nbr.src, dd)
+        self._arm_rxmt(iface, nbr)
+
+    def _enter_exchange(self, iface: V3Interface, nbr: Neighbor) -> None:
+        now = self.loop.clock.now()
+        nbr.dd_summary = [
+            e.lsa
+            for e in self.lsdb.entries.values()
+            if e.current_age(now) < P.MAX_AGE
+        ]
+
+    def _send_dd(self, iface: V3Interface, nbr: Neighbor) -> None:
+        chunk = nbr.dd_summary[:DD_CHUNK]
+        more = len(nbr.dd_summary) > len(chunk)
+        flags = P.DbDescFlags(0)
+        if nbr.master:
+            flags |= P.DbDescFlags.MS
+        if more:
+            flags |= P.DbDescFlags.M
+        dd = P.DbDesc(
+            mtu=iface.config.mtu,
+            options=P.Options.V6 | P.Options.E | P.Options.R,
+            flags=flags,
+            dd_seq_no=nbr.dd_seq_no,
+            lsa_headers=chunk,
+        )
+        nbr.last_sent_dd = dd
+        self._send(iface, nbr.src, dd)
+        if nbr.master:
+            self._arm_rxmt(iface, nbr)
+
+    def _rx_db_desc(self, iface: V3Interface, src, pkt) -> None:
+        dd = pkt.body
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EX_START:
+            return
+        F = P.DbDescFlags
+        if nbr.state == NsmState.EX_START:
+            negotiated = False
+            if (
+                dd.flags == F.I | F.M | F.MS
+                and not dd.lsa_headers
+                and int(pkt.router_id) > int(self.router_id)
+            ):
+                nbr.master = False
+                nbr.dd_seq_no = dd.dd_seq_no
+                negotiated = True
+            elif (
+                not (dd.flags & F.I)
+                and not (dd.flags & F.MS)
+                and dd.dd_seq_no == nbr.dd_seq_no
+                and int(pkt.router_id) < int(self.router_id)
+            ):
+                nbr.master = True
+                negotiated = True
+            if not negotiated:
+                return
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.NEGOTIATION_DONE)
+            nbr = iface.neighbors.get(pkt.router_id)
+            if nbr is None or nbr.state != NsmState.EXCHANGE:
+                return
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            self._process_dd_headers(nbr, dd)
+            if nbr.master:
+                nbr.dd_seq_no += 1
+                if not nbr.dd_summary and not (dd.flags & F.M):
+                    self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
+                else:
+                    self._send_dd(iface, nbr)
+            else:
+                self._slave_reply(iface, nbr, dd)
+            return
+        if nbr.state != NsmState.EXCHANGE:
+            if (
+                nbr.state in (NsmState.LOADING, NsmState.FULL)
+                and not nbr.master
+                and nbr.last_dd == (dd.flags, dd.options, dd.dd_seq_no)
+            ):
+                if nbr.last_sent_dd is not None:
+                    self._send(iface, nbr.src, nbr.last_sent_dd)
+                return
+            if nbr.state in (NsmState.LOADING, NsmState.FULL):
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+            return
+        if nbr.last_dd == (dd.flags, dd.options, dd.dd_seq_no):
+            if not nbr.master and nbr.last_sent_dd is not None:
+                self._send(iface, nbr.src, nbr.last_sent_dd)
+            return
+        if bool(dd.flags & F.MS) == nbr.master or dd.flags & F.I:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+            return
+        if nbr.master:
+            if dd.dd_seq_no != nbr.dd_seq_no:
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
+                return
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            self._process_dd_headers(nbr, dd)
+            nbr.dd_summary = nbr.dd_summary[len(nbr.dd_summary[:DD_CHUNK]) :]
+            nbr.dd_seq_no += 1
+            if not nbr.dd_summary and not (dd.flags & F.M):
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
+            else:
+                self._send_dd(iface, nbr)
+        else:
+            nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
+            self._process_dd_headers(nbr, dd)
+            self._slave_reply(iface, nbr, dd)
+
+    def _slave_reply(self, iface: V3Interface, nbr: Neighbor, dd) -> None:
+        nbr.dd_seq_no = dd.dd_seq_no
+        chunk = nbr.dd_summary[:DD_CHUNK]
+        nbr.dd_summary = nbr.dd_summary[len(chunk) :]
+        flags = P.DbDescFlags(0)
+        if nbr.dd_summary:
+            flags |= P.DbDescFlags.M
+        reply = P.DbDesc(
+            mtu=iface.config.mtu,
+            options=P.Options.V6 | P.Options.E | P.Options.R,
+            flags=flags,
+            dd_seq_no=nbr.dd_seq_no,
+            lsa_headers=chunk,
+        )
+        nbr.last_sent_dd = reply
+        self._send(iface, nbr.src, reply)
+        if not (dd.flags & P.DbDescFlags.M) and not (flags & P.DbDescFlags.M):
+            self._nbr_event(iface.name, nbr.router_id, NsmEvent.EXCHANGE_DONE)
+
+    def _process_dd_headers(self, nbr: Neighbor, dd) -> None:
+        for hdr in dd.lsa_headers:
+            cur = self.lsdb.get(hdr.key)
+            if cur is None or hdr.compare(cur.lsa) > 0:
+                nbr.ls_request[hdr.key] = hdr
+
+    # -- request / update / ack / flooding
+
+    def _send_ls_request(self, iface: V3Interface, nbr: Neighbor) -> None:
+        keys = list(nbr.ls_request.keys())[:DD_CHUNK]
+        if keys:
+            self._send(iface, nbr.src, P.LsRequest(keys))
+            self._arm_rxmt(iface, nbr)
+
+    def _rx_ls_request(self, iface: V3Interface, src, pkt) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        lsas = []
+        for key in pkt.body.entries:
+            e = self.lsdb.get(key)
+            if e is None:
+                self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
+                return
+            lsas.append(e.lsa)
+        if lsas:
+            self._send(iface, nbr.src, P.LsUpdate(lsas))
+
+    def _rx_ls_update(self, iface: V3Interface, src, pkt) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        acks = []
+        now = self.loop.clock.now()
+        for lsa in pkt.body.lsas:
+            cur = self.lsdb.get(lsa.key)
+            if cur is None or lsa.compare(cur.lsa) > 0:
+                if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
+                    continue
+                if lsa.adv_rtr == self.router_id and not lsa.is_maxage:
+                    self._refresh_self_lsa(lsa)
+                    continue
+                self._install_and_flood(lsa, from_iface=iface, from_nbr=nbr)
+                acks.append(lsa)
+            elif cur is not None and lsa.compare(cur.lsa) == 0:
+                if lsa.key in nbr.ls_rxmt:
+                    nbr.ls_rxmt.pop(lsa.key, None)
+                else:
+                    self._send(iface, nbr.src, P.LsAck([lsa]))
+            else:
+                self._send(iface, nbr.src, P.LsUpdate([cur.lsa]))
+            if lsa.key in nbr.ls_request:
+                req = nbr.ls_request[lsa.key]
+                if lsa.compare(req) >= 0:
+                    del nbr.ls_request[lsa.key]
+        if acks:
+            self._send(iface, ALL_SPF_RTRS_V6, P.LsAck(acks))
+        if nbr.state == NsmState.LOADING and not nbr.ls_request:
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.LOADING_DONE)
+        elif nbr.state == NsmState.LOADING:
+            self._send_ls_request(iface, nbr)
+
+    def _rx_ls_ack(self, iface: V3Interface, src, pkt) -> None:
+        nbr = iface.neighbors.get(pkt.router_id)
+        if nbr is None or nbr.state < NsmState.EXCHANGE:
+            return
+        for hdr in pkt.body.lsa_headers:
+            cur = nbr.ls_rxmt.get(hdr.key)
+            if cur is not None and hdr.compare(cur) == 0:
+                del nbr.ls_rxmt[hdr.key]
+
+    def _install_and_flood(self, lsa, from_iface=None, from_nbr=None) -> None:
+        now = self.loop.clock.now()
+        _, changed = self.lsdb.install(lsa, now)
+        if changed:
+            self._schedule_spf()
+        for iface in self.interfaces.values():
+            if not iface.up:
+                continue
+            # Link-scope LSAs only flood on their own link.
+            if P.scope_of(int(lsa.type)) == "link" and iface is not from_iface:
+                continue
+            sent = False
+            for nbr in iface.neighbors.values():
+                if nbr.state < NsmState.EXCHANGE:
+                    continue
+                if nbr.exchange_or_loading():
+                    req = nbr.ls_request.get(lsa.key)
+                    if req is not None:
+                        c = lsa.compare(req)
+                        if c < 0:
+                            continue
+                        del nbr.ls_request[lsa.key]
+                        if c == 0:
+                            continue
+                if from_nbr is not None and nbr is from_nbr:
+                    continue
+                nbr.ls_rxmt[lsa.key] = lsa
+                sent = True
+                self._arm_rxmt(iface, nbr)
+            if sent:
+                self._send(iface, ALL_SPF_RTRS_V6, P.LsUpdate([lsa]))
+        if lsa.is_maxage:
+            self.lsdb.remove(lsa.key)
+
+    def _arm_rxmt(self, iface: V3Interface, nbr: Neighbor) -> None:
+        t = self._timer(
+            ("rxmt", iface.name, nbr.router_id),
+            lambda: RxmtTimerV3(iface.name, nbr.router_id),
+        )
+        if not t.armed:
+            t.start(iface.config.rxmt_interval)
+
+    def _rxmt(self, ifname: str, nbr_id) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        nbr = iface.neighbors.get(nbr_id)
+        if nbr is None:
+            return
+        if nbr.state == NsmState.EX_START or (
+            nbr.state == NsmState.EXCHANGE and nbr.master
+        ):
+            if nbr.last_sent_dd is not None:
+                self._send(iface, nbr.src, nbr.last_sent_dd)
+        if nbr.state == NsmState.LOADING and nbr.ls_request:
+            self._send_ls_request(iface, nbr)
+        if nbr.ls_rxmt:
+            self._send(
+                iface, nbr.src, P.LsUpdate(list(nbr.ls_rxmt.values())[:20])
+            )
+        if (
+            nbr.state in (NsmState.EX_START, NsmState.EXCHANGE, NsmState.LOADING)
+            or nbr.ls_rxmt
+        ):
+            self._arm_rxmt(iface, nbr)
+
+    # -- origination
+
+    def _originate(self, ltype: P.LsaType, lsid: IPv4Address, body) -> None:
+        key = P.LsaKey(ltype, lsid, self.router_id)
+        old = self.lsdb.get(key)
+        lsa = P.Lsa(
+            age=0,
+            type=ltype,
+            lsid=lsid,
+            adv_rtr=self.router_id,
+            seq_no=next_seq_no(old.lsa if old else None),
+            body=body,
+        )
+        lsa.encode()
+        if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
+            return
+        self._install_and_flood(lsa)
+
+    def _refresh_self_lsa(self, received) -> None:
+        cur = self.lsdb.get(received.key)
+        if cur is None:
+            self._install_and_flood(received)
+            lsa = received
+            import copy
+
+            flush = copy.copy(lsa)
+            flush.age = P.MAX_AGE
+            raw = bytearray(flush.raw)
+            raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
+            flush.raw = bytes(raw)
+            self._install_and_flood(flush)
+            return
+        lsa = P.Lsa(
+            age=0,
+            type=cur.lsa.type,
+            lsid=cur.lsa.lsid,
+            adv_rtr=cur.lsa.adv_rtr,
+            seq_no=received.seq_no + 1,
+            body=cur.lsa.body,
+        )
+        lsa.encode()
+        self._install_and_flood(lsa)
+
+    def _originate_router_lsa(self) -> None:
+        links = []
+        for iface in self.interfaces.values():
+            if not iface.up:
+                continue
+            for nbr in iface.neighbors.values():
+                if nbr.state == NsmState.FULL:
+                    links.append(
+                        P.RouterLinkV3(
+                            P.RouterLinkType.POINT_TO_POINT,
+                            iface.config.cost,
+                            iface.iface_id,
+                            0,  # learned from hello iface_id in full impl
+                            nbr.router_id,
+                        )
+                    )
+        self._originate(P.LsaType.ROUTER, IPv4Address(0), P.LsaRouterV3(links=links))
+
+    def _originate_intra_area_prefix(self) -> None:
+        prefixes = []
+        for iface in self.interfaces.values():
+            if iface.up:
+                for p in iface.prefixes:
+                    prefixes.append((p, iface.config.cost))
+        body = P.LsaIntraAreaPrefix(
+            ref_type=int(P.LsaType.ROUTER),
+            ref_lsid=IPv4Address(0),
+            ref_adv_rtr=self.router_id,
+            prefixes=prefixes,
+        )
+        self._originate(P.LsaType.INTRA_AREA_PREFIX, IPv4Address(1), body)
+
+    # -- aging
+
+    def _age_tick(self) -> None:
+        now = self.loop.clock.now()
+        for e in self.lsdb.refresh_due(now, self.router_id):
+            lsa = P.Lsa(
+                age=0,
+                type=e.lsa.type,
+                lsid=e.lsa.lsid,
+                adv_rtr=e.lsa.adv_rtr,
+                seq_no=next_seq_no(e.lsa),
+                body=e.lsa.body,
+            )
+            lsa.encode()
+            self._install_and_flood(lsa)
+        for key in self.lsdb.maxage_keys(now):
+            e = self.lsdb.get(key)
+            if e is not None:
+                self._install_and_flood(e.lsa)
+        self._age_timer.start(AGE_TICK)
+
+    # -- SPF
+
+    def _schedule_spf(self) -> None:
+        if not self._spf_pending:
+            self._spf_pending = True
+            self._spf_timer.start(0.1)
+
+    def run_spf(self) -> None:
+        self.spf_run_count += 1
+        now = self.loop.clock.now()
+        routers: dict[IPv4Address, P.LsaRouterV3] = {}
+        prefix_lsas: list[P.LsaIntraAreaPrefix] = []
+        for e in self.lsdb.all():
+            if e.current_age(now) >= P.MAX_AGE:
+                continue
+            if e.lsa.type == P.LsaType.ROUTER:
+                routers[e.lsa.adv_rtr] = e.lsa.body
+            elif e.lsa.type == P.LsaType.INTRA_AREA_PREFIX:
+                prefix_lsas.append(e.lsa.body)
+        if self.router_id not in routers:
+            return
+        order = sorted(routers.keys(), key=int)
+        index = {r: i for i, r in enumerate(order)}
+        src, dst, cost = [], [], []
+        for rid, body in routers.items():
+            for link in body.links:
+                v = index.get(link.nbr_router_id)
+                if v is not None:
+                    src.append(index[rid])
+                    dst.append(v)
+                    cost.append(link.metric)
+        topo = Topology(
+            n_vertices=len(order),
+            is_router=np.ones(len(order), bool),
+            edge_src=np.array(src, np.int32).reshape(-1),
+            edge_dst=np.array(dst, np.int32).reshape(-1),
+            edge_cost=np.array(cost, np.int32).reshape(-1),
+            root=index[self.router_id],
+        ).filter_mutual()
+
+        atoms = []
+        atom_ids = np.full(topo.n_edges, -1, np.int32)
+        nbr_hop = {}
+        for iface in self.interfaces.values():
+            for nbr in iface.neighbors.values():
+                if nbr.state == NsmState.FULL:
+                    nbr_hop[nbr.router_id] = (iface.name, nbr.src)
+        for e_i in range(topo.n_edges):
+            if topo.edge_src[e_i] == topo.root:
+                rid = order[int(topo.edge_dst[e_i])]
+                hop = nbr_hop.get(rid)
+                if hop is not None:
+                    atom_ids[e_i] = len(atoms)
+                    atoms.append(hop)
+        topo.edge_direct_atom = atom_ids
+        topo.touch()
+
+        res = self.backend.compute(topo)
+        routes: dict[IPv6Network, V6Route] = {}
+        for body in prefix_lsas:
+            if body.ref_type != int(P.LsaType.ROUTER):
+                continue
+            v = index.get(body.ref_adv_rtr)
+            if v is None or res.dist[v] >= INF:
+                continue
+            nhs = frozenset(
+                atoms[a]
+                for a in range(len(atoms))
+                if res.nexthop_words[v][a // 32]
+                & (np.uint32(1) << np.uint32(a % 32))
+            )
+            for prefix, metric in body.prefixes:
+                total = int(res.dist[v]) + metric
+                cur = routes.get(prefix)
+                if cur is None or total < cur.dist:
+                    routes[prefix] = V6Route(prefix, total, nhs)
+                elif total == cur.dist:
+                    routes[prefix] = V6Route(prefix, total, cur.nexthops | nhs)
+        self.routes = routes
+        if self.route_cb is not None:
+            self.route_cb(routes)
+
+    # -- rx/tx
+
+    def _rx(self, msg: NetRxPacket) -> None:
+        iface = self.interfaces.get(msg.ifname)
+        if iface is None or not iface.up:
+            return
+        try:
+            pkt = P.Packet.decode(msg.data, src=msg.src, dst=None)
+        except Exception:
+            return
+        if pkt.router_id == self.router_id:
+            return
+        # RFC 5340 §4.1.2: area and instance-id must match the interface.
+        if (
+            pkt.area_id != iface.config.area_id
+            or pkt.instance_id != iface.config.instance_id
+        ):
+            return
+        t = pkt.body.TYPE
+        if t == P.PacketType.HELLO:
+            self._rx_hello(iface, msg.src, pkt)
+        elif t == P.PacketType.DB_DESC:
+            self._rx_db_desc(iface, msg.src, pkt)
+        elif t == P.PacketType.LS_REQUEST:
+            self._rx_ls_request(iface, msg.src, pkt)
+        elif t == P.PacketType.LS_UPDATE:
+            self._rx_ls_update(iface, msg.src, pkt)
+        elif t == P.PacketType.LS_ACK:
+            self._rx_ls_ack(iface, msg.src, pkt)
+
+    def _send(self, iface: V3Interface, dst, body) -> None:
+        pkt = P.Packet(router_id=self.router_id,
+                       area_id=iface.config.area_id, body=body,
+                       instance_id=iface.config.instance_id)
+        # Checksum zero on the fabric (decode skips it); real transports
+        # pass src/dst so the IPv6 pseudo-header checksum is computed.
+        self.netio.send(iface.name, iface.link_local, dst, pkt.encode())

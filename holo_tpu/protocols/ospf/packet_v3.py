@@ -1,0 +1,569 @@
+"""OSPFv3 packet and LSA codecs (RFC 5340 §A).
+
+Mirrors the API of packet.py (the v2 codecs) so the instance machinery can
+be parameterized over the version — the analog of the reference's
+``Version`` trait split (holo-ospf/src/version.rs:27-54).
+
+v3 specifics: 16-byte header with instance id and a checksum over an IPv6
+pseudo-header; options are 24-bit; DR/BDR are router-ids; LSA types are
+16-bit with flooding-scope bits; prefixes encode as (len, options,
+truncated address).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv6Address, IPv6Network
+
+from holo_tpu.utils.bytesbuf import (
+    DecodeError,
+    Reader,
+    Writer,
+    fletcher16_checksum,
+    fletcher16_verify,
+)
+
+OSPF_VERSION = 3
+PKT_HDR_LEN = 16
+LSA_HDR_LEN = 20
+MAX_AGE = 3600
+LS_REFRESH_TIME = 1800
+MAX_AGE_DIFF = 900
+INITIAL_SEQ_NO = -0x7FFFFFFF
+MAX_SEQ_NO = 0x7FFFFFFF
+
+
+class PacketType(enum.IntEnum):
+    HELLO = 1
+    DB_DESC = 2
+    LS_REQUEST = 3
+    LS_UPDATE = 4
+    LS_ACK = 5
+
+
+class LsaType(enum.IntEnum):
+    """Function codes with flooding scope (RFC 5340 §A.4.2.1)."""
+
+    ROUTER = 0x2001
+    NETWORK = 0x2002
+    INTER_AREA_PREFIX = 0x2003
+    INTER_AREA_ROUTER = 0x2004
+    AS_EXTERNAL = 0x4005
+    LINK = 0x0008
+    INTRA_AREA_PREFIX = 0x2009
+
+    # aliases used by the version-generic machinery:
+    SUMMARY_NETWORK = 0x2003
+
+
+def scope_of(ltype: int) -> str:
+    s = (ltype >> 13) & 0x3
+    return {0: "link", 1: "area", 2: "as"}.get(s, "reserved")
+
+
+class Options(enum.IntFlag):
+    V6 = 0x01
+    E = 0x02
+    R = 0x10
+
+
+class RouterLinkType(enum.IntEnum):
+    POINT_TO_POINT = 1
+    TRANSIT_NETWORK = 2
+    VIRTUAL_LINK = 4
+
+
+class RouterFlags(enum.IntFlag):
+    B = 0x01
+    E = 0x02
+    V = 0x04
+
+
+@dataclass(frozen=True)
+class RouterLinkV3:
+    link_type: RouterLinkType
+    metric: int
+    iface_id: int
+    nbr_iface_id: int
+    nbr_router_id: IPv4Address
+
+
+@dataclass
+class LsaRouterV3:
+    flags: RouterFlags = RouterFlags(0)
+    options: Options = Options.V6 | Options.E | Options.R
+    links: list[RouterLinkV3] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.u8(int(self.flags)).u24(int(self.options))
+        for l in self.links:
+            w.u8(int(l.link_type)).u8(0).u16(l.metric)
+            w.u32(l.iface_id).u32(l.nbr_iface_id)
+            w.ipv4(l.nbr_router_id)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaRouterV3":
+        flags = RouterFlags(r.u8() & 0x07)
+        options = Options(r.u24())
+        links = []
+        while r.remaining() >= 16:
+            try:
+                lt = RouterLinkType(r.u8())
+            except ValueError as e:
+                raise DecodeError("bad v3 router link type") from e
+            r.u8()
+            metric = r.u16()
+            links.append(
+                RouterLinkV3(lt, metric, r.u32(), r.u32(), r.ipv4())
+            )
+        return cls(flags, options, links)
+
+
+@dataclass
+class LsaNetworkV3:
+    options: Options = Options.V6 | Options.E | Options.R
+    attached: list[IPv4Address] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.u8(0).u24(int(self.options))
+        for a in self.attached:
+            w.ipv4(a)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaNetworkV3":
+        r.u8()
+        options = Options(r.u24())
+        attached = []
+        while r.remaining() >= 4:
+            attached.append(r.ipv4())
+        return cls(options, attached)
+
+
+def _encode_prefix(w: Writer, prefix: IPv6Network, options: int = 0, metric: int | None = None) -> None:
+    w.u8(prefix.prefixlen).u8(options)
+    if metric is None:
+        w.u16(0)
+    else:
+        w.u16(metric)
+    nbytes = (prefix.prefixlen + 31) // 32 * 4
+    w.bytes(prefix.network_address.packed[:nbytes])
+
+
+def _decode_prefix(r: Reader) -> tuple[IPv6Network, int, int]:
+    plen = r.u8()
+    opts = r.u8()
+    metric = r.u16()
+    if plen > 128:
+        raise DecodeError("bad v6 prefix length")
+    nbytes = (plen + 31) // 32 * 4
+    raw = r.bytes(nbytes) + bytes(16 - nbytes)
+    return IPv6Network((int.from_bytes(raw, "big"), plen)), opts, metric
+
+
+@dataclass
+class LsaInterAreaPrefix:
+    metric: int = 0
+    prefix: IPv6Network = IPv6Network("::/0")
+
+    def encode(self, w: Writer) -> None:
+        w.u32(self.metric & 0xFFFFFF)
+        _encode_prefix(w, self.prefix)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaInterAreaPrefix":
+        metric = r.u32() & 0xFFFFFF
+        prefix, _, _ = _decode_prefix(r)
+        return cls(metric, prefix)
+
+    # duck-type v2 LsaSummary for the generic ABR machinery
+    @property
+    def mask(self):
+        return self.prefix
+
+
+@dataclass
+class LsaLink:
+    priority: int = 1
+    options: Options = Options.V6 | Options.E | Options.R
+    link_local: IPv6Address = IPv6Address("fe80::1")
+    prefixes: list[IPv6Network] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.u8(self.priority).u24(int(self.options))
+        w.ipv6(self.link_local)
+        w.u32(len(self.prefixes))
+        for p in self.prefixes:
+            _encode_prefix(w, p)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaLink":
+        prio = r.u8()
+        options = Options(r.u24())
+        ll = r.ipv6()
+        n = r.u32()
+        prefixes = []
+        for _ in range(n):
+            p, _, _ = _decode_prefix(r)
+            prefixes.append(p)
+        return cls(prio, options, ll, prefixes)
+
+
+@dataclass
+class LsaIntraAreaPrefix:
+    """Prefixes attached to a router/network vertex (RFC 5340 §A.4.10)."""
+
+    ref_type: int = 0x2001
+    ref_lsid: IPv4Address = IPv4Address(0)
+    ref_adv_rtr: IPv4Address = IPv4Address(0)
+    prefixes: list[tuple[IPv6Network, int]] = field(default_factory=list)  # (prefix, metric)
+
+    def encode(self, w: Writer) -> None:
+        w.u16(len(self.prefixes)).u16(self.ref_type)
+        w.ipv4(self.ref_lsid).ipv4(self.ref_adv_rtr)
+        for prefix, metric in self.prefixes:
+            _encode_prefix(w, prefix, metric=metric)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaIntraAreaPrefix":
+        n = r.u16()
+        ref_type = r.u16()
+        ref_lsid, ref_adv = r.ipv4(), r.ipv4()
+        prefixes = []
+        for _ in range(n):
+            p, _, metric = _decode_prefix(r)
+            prefixes.append((p, metric))
+        return cls(ref_type, ref_lsid, ref_adv, prefixes)
+
+
+@dataclass
+class LsaAsExternalV3:
+    metric: int = 0
+    e_bit: bool = True
+    prefix: IPv6Network = IPv6Network("::/0")
+
+    def encode(self, w: Writer) -> None:
+        w.u32((0x04000000 if self.e_bit else 0) | (self.metric & 0xFFFFFF))
+        _encode_prefix(w, self.prefix)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaAsExternalV3":
+        word = r.u32()
+        prefix, _, _ = _decode_prefix(r)
+        return cls(word & 0xFFFFFF, bool(word & 0x04000000), prefix)
+
+
+_BODY_CODECS = {
+    LsaType.ROUTER: LsaRouterV3,
+    LsaType.NETWORK: LsaNetworkV3,
+    LsaType.INTER_AREA_PREFIX: LsaInterAreaPrefix,
+    LsaType.LINK: LsaLink,
+    LsaType.INTRA_AREA_PREFIX: LsaIntraAreaPrefix,
+    LsaType.AS_EXTERNAL: LsaAsExternalV3,
+}
+
+
+@dataclass(frozen=True)
+class LsaKey:
+    type: LsaType
+    lsid: IPv4Address
+    adv_rtr: IPv4Address
+
+
+@dataclass
+class Lsa:
+    """v3 LSA: same header geometry as v2 with 16-bit type."""
+
+    age: int
+    type: LsaType
+    lsid: IPv4Address
+    adv_rtr: IPv4Address
+    seq_no: int
+    body: object
+    cksum: int = 0
+    length: int = 0
+    raw: bytes = b""
+    options: int = 0  # kept for interface parity with v2 (unused in v3 hdr)
+
+    @property
+    def key(self) -> LsaKey:
+        return LsaKey(self.type, self.lsid, self.adv_rtr)
+
+    @property
+    def is_maxage(self) -> bool:
+        return self.age >= MAX_AGE
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u16(self.age).u16(int(self.type))
+        w.ipv4(self.lsid).ipv4(self.adv_rtr)
+        w.u32(self.seq_no & 0xFFFFFFFF)
+        w.u16(0).u16(0)
+        self.body.encode(w)
+        w.patch_u16(18, len(w))
+        self.length = len(w)
+        cks = fletcher16_checksum(bytes(w.buf[2:]), 14)
+        w.patch_u16(16, cks)
+        self.cksum = cks
+        self.raw = w.finish()
+        return self.raw
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Lsa":
+        start = r.pos
+        if r.remaining() < LSA_HDR_LEN:
+            raise DecodeError("short LSA header")
+        age = r.u16()
+        try:
+            ltype = LsaType(r.u16())
+        except ValueError as e:
+            raise DecodeError("unknown v3 LSA type") from e
+        lsid, adv = r.ipv4(), r.ipv4()
+        seq = r.u32()
+        if seq & 0x80000000:
+            seq -= 1 << 32
+        cksum = r.u16()
+        length = r.u16()
+        if length < LSA_HDR_LEN:
+            raise DecodeError("bad LSA length")
+        body_len = length - LSA_HDR_LEN
+        if r.remaining() < body_len:
+            raise DecodeError("LSA length exceeds buffer")
+        raw = r.data[start : start + length]
+        if not fletcher16_verify(raw[2:]):
+            raise DecodeError("LSA checksum mismatch")
+        body = _BODY_CODECS[ltype].decode(r.sub(body_len))
+        return cls(age, ltype, lsid, adv, seq, body, cksum, length, raw)
+
+    @classmethod
+    def decode_header(cls, r: Reader) -> "Lsa":
+        age = r.u16()
+        try:
+            ltype = LsaType(r.u16())
+        except ValueError as e:
+            raise DecodeError("unknown v3 LSA type") from e
+        lsid, adv = r.ipv4(), r.ipv4()
+        seq = r.u32()
+        if seq & 0x80000000:
+            seq -= 1 << 32
+        return cls(age, ltype, lsid, adv, seq, None, r.u16(), r.u16())
+
+    def encode_header(self, w: Writer) -> None:
+        w.u16(self.age).u16(int(self.type))
+        w.ipv4(self.lsid).ipv4(self.adv_rtr).u32(self.seq_no & 0xFFFFFFFF)
+        w.u16(self.cksum).u16(self.length)
+
+    def compare(self, other: "Lsa") -> int:
+        if self.seq_no != other.seq_no:
+            return 1 if self.seq_no > other.seq_no else -1
+        if self.cksum != other.cksum:
+            return 1 if self.cksum > other.cksum else -1
+        if self.is_maxage != other.is_maxage:
+            return 1 if self.is_maxage else -1
+        if abs(self.age - other.age) > MAX_AGE_DIFF:
+            return 1 if self.age < other.age else -1
+        return 0
+
+
+# ===== packet bodies (same shapes as v2 where possible) =====
+
+
+@dataclass
+class Hello:
+    iface_id: int
+    priority: int
+    options: Options
+    hello_interval: int
+    dead_interval: int
+    dr: IPv4Address  # router-id of DR (not an address, unlike v2)
+    bdr: IPv4Address
+    neighbors: list[IPv4Address] = field(default_factory=list)
+
+    TYPE = PacketType.HELLO
+
+    def encode_body(self, w: Writer) -> None:
+        w.u32(self.iface_id)
+        w.u8(self.priority).u24(int(self.options))
+        w.u16(self.hello_interval).u16(self.dead_interval)
+        w.ipv4(self.dr).ipv4(self.bdr)
+        for n in self.neighbors:
+            w.ipv4(n)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "Hello":
+        iface_id = r.u32()
+        prio = r.u8()
+        options = Options(r.u24())
+        hi, di = r.u16(), r.u16()
+        dr, bdr = r.ipv4(), r.ipv4()
+        nbrs = []
+        while r.remaining() >= 4:
+            nbrs.append(r.ipv4())
+        return cls(iface_id, prio, options, hi, di, dr, bdr, nbrs)
+
+
+class DbDescFlags(enum.IntFlag):
+    MS = 0x01
+    M = 0x02
+    I = 0x04
+
+
+@dataclass
+class DbDesc:
+    mtu: int
+    options: Options
+    flags: DbDescFlags
+    dd_seq_no: int
+    lsa_headers: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.DB_DESC
+
+    def encode_body(self, w: Writer) -> None:
+        w.u8(0).u24(int(self.options))
+        w.u16(self.mtu).u8(0).u8(int(self.flags))
+        w.u32(self.dd_seq_no)
+        for h in self.lsa_headers:
+            h.encode_header(w)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "DbDesc":
+        r.u8()
+        options = Options(r.u24())
+        mtu = r.u16()
+        r.u8()
+        flags = DbDescFlags(r.u8() & 0x07)
+        seq = r.u32()
+        hdrs = []
+        while r.remaining() >= LSA_HDR_LEN:
+            hdrs.append(Lsa.decode_header(r))
+        return cls(mtu, options, flags, seq, hdrs)
+
+
+@dataclass
+class LsRequest:
+    entries: list[LsaKey] = field(default_factory=list)
+
+    TYPE = PacketType.LS_REQUEST
+
+    def encode_body(self, w: Writer) -> None:
+        for k in self.entries:
+            w.u16(0).u16(int(k.type)).ipv4(k.lsid).ipv4(k.adv_rtr)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsRequest":
+        entries = []
+        while r.remaining() >= 12:
+            r.u16()
+            try:
+                t = LsaType(r.u16())
+            except ValueError as e:
+                raise DecodeError("unknown v3 LSA type in request") from e
+            entries.append(LsaKey(t, r.ipv4(), r.ipv4()))
+        return cls(entries)
+
+
+@dataclass
+class LsUpdate:
+    lsas: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.LS_UPDATE
+
+    def encode_body(self, w: Writer) -> None:
+        w.u32(len(self.lsas))
+        for lsa in self.lsas:
+            w.bytes(lsa.raw if lsa.raw else lsa.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsUpdate":
+        n = r.u32()
+        return cls([Lsa.decode(r) for _ in range(n)])
+
+
+@dataclass
+class LsAck:
+    lsa_headers: list[Lsa] = field(default_factory=list)
+
+    TYPE = PacketType.LS_ACK
+
+    def encode_body(self, w: Writer) -> None:
+        for h in self.lsa_headers:
+            h.encode_header(w)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "LsAck":
+        hdrs = []
+        while r.remaining() >= LSA_HDR_LEN:
+            hdrs.append(Lsa.decode_header(r))
+        return cls(hdrs)
+
+
+_PKT_CODECS = {
+    PacketType.HELLO: Hello,
+    PacketType.DB_DESC: DbDesc,
+    PacketType.LS_REQUEST: LsRequest,
+    PacketType.LS_UPDATE: LsUpdate,
+    PacketType.LS_ACK: LsAck,
+}
+
+
+def _pseudo_header(src: IPv6Address, dst: IPv6Address, length: int) -> bytes:
+    return (
+        src.packed + dst.packed + struct.pack(">I", length) + b"\x00\x00\x00\x59"
+    )  # next header 89
+
+
+def _cksum16(data: bytes) -> int:
+    if len(data) % 2:
+        data += b"\x00"
+    s = sum(struct.unpack(f">{len(data) // 2}H", data))
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+@dataclass
+class Packet:
+    """OSPFv3 packet: 16-byte header; checksum over IPv6 pseudo-header."""
+
+    router_id: IPv4Address
+    area_id: IPv4Address
+    body: object
+    instance_id: int = 0
+
+    def encode(self, src: IPv6Address | None = None, dst: IPv6Address | None = None) -> bytes:
+        w = Writer()
+        w.u8(OSPF_VERSION).u8(int(self.body.TYPE)).u16(0)
+        w.ipv4(self.router_id).ipv4(self.area_id)
+        w.u16(0)  # checksum
+        w.u8(self.instance_id).u8(0)
+        self.body.encode_body(w)
+        w.patch_u16(2, len(w))
+        if src is not None and dst is not None:
+            cks = _cksum16(_pseudo_header(src, dst, len(w)) + bytes(w.buf))
+            w.patch_u16(12, cks)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes, src: IPv6Address | None = None, dst: IPv6Address | None = None) -> "Packet":
+        r = Reader(data)
+        if r.remaining() < PKT_HDR_LEN:
+            raise DecodeError("short packet")
+        if r.u8() != OSPF_VERSION:
+            raise DecodeError("bad version")
+        try:
+            ptype = PacketType(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown packet type") from e
+        length = r.u16()
+        if length < PKT_HDR_LEN or length > len(data):
+            raise DecodeError("bad packet length")
+        router_id, area_id = r.ipv4(), r.ipv4()
+        cksum = r.u16()
+        instance_id = r.u8()
+        r.u8()
+        if src is not None and dst is not None and cksum != 0:
+            if _cksum16(_pseudo_header(src, dst, length) + data[:length]) != 0:
+                raise DecodeError("packet checksum mismatch")
+        body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))
+        return cls(router_id, area_id, body, instance_id)

@@ -122,6 +122,35 @@ def test_isis_config_driven_convergence():
     assert rib[N("10.0.12.0/30")].protocol.value == "isis"
 
 
+def test_ospfv3_config_driven_convergence():
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="w1")
+    d2 = Daemon(loop=loop, netio=fabric, name="w2")
+    fabric.join("l", "w1.ospfv3", "eth0", ipaddress.ip_address("fe80::1"))
+    fabric.join("l", "w2.ospfv3", "eth0", ipaddress.ip_address("fe80::2"))
+    for d, rid, ll, pfx in [
+        (d1, "1.1.1.1", "fe80::1/64", "2001:db8:1::1/64"),
+        (d2, "2.2.2.2", "fe80::2/64", "2001:db8:2::1/64"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [ll, pfx])
+        cand.set("routing/control-plane-protocols/ospfv3/router-id", rid)
+        cand.set(
+            "routing/control-plane-protocols/ospfv3/area[0.0.0.0]/interface[eth0]/cost",
+            4,
+        )
+        d.commit(cand)
+    loop.advance(60)
+    from ipaddress import IPv6Network as N6
+
+    rib = d1.routing.rib.active_routes()
+    assert N6("2001:db8:2::/64") in rib
+    assert rib[N6("2001:db8:2::/64")].protocol.value == "ospfv3"
+
+
 def test_grpc_northbound_end_to_end():
     """Drive the daemon purely through the gRPC client."""
     import holo_tpu.daemon.grpc_server as gs

@@ -1,0 +1,134 @@
+"""OSPFv3: codecs (incl. pseudo-header checksum) + v6 convergence."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv6Address as A6
+from ipaddress import IPv6Network as N6
+
+import pytest
+
+from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.instance_v3 import (
+    OspfV3Instance,
+    V3IfConfig,
+    V3IfUpMsg,
+)
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.bytesbuf import DecodeError, Reader
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_hello_roundtrip_with_pseudo_header_checksum():
+    pkt = P.Packet(
+        A("1.1.1.1"), A("0.0.0.0"),
+        P.Hello(iface_id=3, priority=1,
+                options=P.Options.V6 | P.Options.E | P.Options.R,
+                hello_interval=10, dead_interval=40,
+                dr=A("0.0.0.0"), bdr=A("0.0.0.0"), neighbors=[A("2.2.2.2")]),
+    )
+    src, dst = A6("fe80::1"), A6("ff02::5")
+    raw = pkt.encode(src, dst)
+    out = P.Packet.decode(raw, src, dst)
+    assert out.body.iface_id == 3 and out.body.neighbors == [A("2.2.2.2")]
+    # corrupt -> checksum failure
+    bad = bytearray(raw)
+    bad[20] ^= 0xFF
+    with pytest.raises(DecodeError):
+        P.Packet.decode(bytes(bad), src, dst)
+
+
+def test_v3_lsa_roundtrips():
+    rl = P.Lsa(1, P.LsaType.ROUTER, A("0.0.0.0"), A("1.1.1.1"), -100,
+               P.LsaRouterV3(links=[
+                   P.RouterLinkV3(P.RouterLinkType.POINT_TO_POINT, 10, 1, 2,
+                                  A("2.2.2.2"))]))
+    out = P.Lsa.decode(Reader(rl.encode()))
+    assert out.body.links[0].nbr_router_id == A("2.2.2.2")
+
+    iap = P.Lsa(1, P.LsaType.INTRA_AREA_PREFIX, A("0.0.0.1"), A("1.1.1.1"),
+                -99, P.LsaIntraAreaPrefix(
+                    ref_type=int(P.LsaType.ROUTER), ref_lsid=A("0.0.0.0"),
+                    ref_adv_rtr=A("1.1.1.1"),
+                    prefixes=[(N6("2001:db8:1::/64"), 10),
+                              (N6("2001:db8:2::/48"), 20)]))
+    out = P.Lsa.decode(Reader(iap.encode()))
+    assert out.body.prefixes == [(N6("2001:db8:1::/64"), 10),
+                                 (N6("2001:db8:2::/48"), 20)]
+
+    link = P.Lsa(1, P.LsaType.LINK, A("0.0.0.3"), A("1.1.1.1"), -98,
+                 P.LsaLink(link_local=A6("fe80::1"),
+                           prefixes=[N6("2001:db8:1::/64")]))
+    out = P.Lsa.decode(Reader(link.encode()))
+    assert out.body.link_local == A6("fe80::1")
+    assert P.scope_of(int(P.LsaType.LINK)) == "link"
+    assert P.scope_of(int(P.LsaType.ROUTER)) == "area"
+    assert P.scope_of(int(P.LsaType.AS_EXTERNAL)) == "as"
+
+
+def mk_v3(loop, fabric, name, rid):
+    r = OspfV3Instance(name=name, router_id=A(rid),
+                       netio=fabric.sender_for(name))
+    loop.register(r)
+    return r
+
+
+def v6link(fabric, link, a, ai, alla, b, bi, allb):
+    a_if = a.add_interface(ai, V3IfConfig(cost=10), A6(alla), [])
+    b_if = b.add_interface(bi, V3IfConfig(cost=10), A6(allb), [])
+    fabric.join(link, a.name, ai, A6(alla))
+    fabric.join(link, b.name, bi, A6(allb))
+    return a_if, b_if
+
+
+def test_v3_three_router_chain_routes():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_v3(loop, fabric, "v1", "1.1.1.1")
+    r2 = mk_v3(loop, fabric, "v2", "2.2.2.2")
+    r3 = mk_v3(loop, fabric, "v3", "3.3.3.3")
+    v6link(fabric, "l12", r1, "e0", "fe80::1:1", r2, "e0", "fe80::2:1")
+    v6link(fabric, "l23", r2, "e1", "fe80::2:2", r3, "e0", "fe80::3:1")
+    # r3 advertises a global prefix.
+    r3.interfaces["e0"].prefixes.append(N6("2001:db8:33::/64"))
+    r1.interfaces["e0"].prefixes.append(N6("2001:db8:11::/64"))
+    for r in (r1, r2, r3):
+        for ifname in r.interfaces:
+            loop.send(r.name, V3IfUpMsg(ifname))
+    loop.advance(60)
+
+    # Full adjacencies both hops.
+    nbrs1 = r1.interfaces["e0"].neighbors
+    assert nbrs1[A("2.2.2.2")].state == NsmState.FULL
+    assert set(r1.lsdb.entries) == set(r3.lsdb.entries)
+
+    route = r1.routes.get(N6("2001:db8:33::/64"))
+    assert route is not None
+    assert route.dist == 10 + 10 + 10  # two hops + prefix metric
+    assert {(i, str(a)) for i, a in route.nexthops} == {("e0", "fe80::2:1")}
+    # and the reverse direction
+    route = r3.routes.get(N6("2001:db8:11::/64"))
+    assert route is not None and route.dist == 30
+
+
+def test_v3_failure_reroute_triangle():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_v3(loop, fabric, "v1", "1.1.1.1")
+    r2 = mk_v3(loop, fabric, "v2", "2.2.2.2")
+    r3 = mk_v3(loop, fabric, "v3", "3.3.3.3")
+    v6link(fabric, "l12", r1, "e0", "fe80::1:1", r2, "e0", "fe80::2:1")
+    v6link(fabric, "l23", r2, "e1", "fe80::2:2", r3, "e0", "fe80::3:1")
+    v6link(fabric, "l13", r1, "e1", "fe80::1:2", r3, "e1", "fe80::3:2")
+    r3.interfaces["e0"].prefixes.append(N6("2001:db8:33::/64"))
+    for r in (r1, r2, r3):
+        for ifname in r.interfaces:
+            loop.send(r.name, V3IfUpMsg(ifname))
+    loop.advance(60)
+    route = r1.routes[N6("2001:db8:33::/64")]
+    assert {i for i, _ in route.nexthops} == {"e1"}  # direct link
+
+    fabric.set_link_up("l13", False)
+    loop.advance(120)  # dead interval
+    route = r1.routes.get(N6("2001:db8:33::/64"))
+    assert route is not None
+    assert {i for i, _ in route.nexthops} == {"e0"}  # around via r2
