@@ -360,7 +360,7 @@ class RoutingProvider(Provider, Actor):
             V3IfConfig,
             V3IfUpMsg,
         )
-        from holo_tpu.utils.southbound import Protocol, RouteKeyMsg
+        from holo_tpu.utils.southbound import Protocol
 
         base = "routing/control-plane-protocols/ospfv3"
         conf = new.get(base)

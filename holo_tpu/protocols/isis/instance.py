@@ -547,10 +547,10 @@ class IsisInstance(Actor):
             v = index[k]
             if res.dist[v] >= INF:
                 continue
+            from holo_tpu.protocols.ospf.spf_run import atom_bits
+
             nhs = frozenset(
-                atoms[a]
-                for a in range(len(atoms))
-                if res.nexthop_words[v][a // 32] & (np.uint32(1) << np.uint32(a % 32))
+                atoms[a] for a in atom_bits(res.nexthop_words[v], len(atoms))
             )
             for reach in node["ip"]:
                 total = int(res.dist[v]) + reach.metric

@@ -310,10 +310,14 @@ class OspfV3Instance(Actor):
 
     def _enter_exchange(self, iface: V3Interface, nbr: Neighbor) -> None:
         now = self.loop.clock.now()
+        # Link-scope LSAs are excluded: they must only be exchanged with
+        # neighbors on their own link (RFC 5340 §4.5; origin-link tracking
+        # lands with Link-LSA origination).
         nbr.dd_summary = [
             e.lsa
             for e in self.lsdb.entries.values()
             if e.current_age(now) < P.MAX_AGE
+            and P.scope_of(int(e.lsa.type)) != "link"
         ]
 
     def _send_dd(self, iface: V3Interface, nbr: Neighbor) -> None:
@@ -730,11 +734,10 @@ class OspfV3Instance(Actor):
             v = index.get(body.ref_adv_rtr)
             if v is None or res.dist[v] >= INF:
                 continue
+            from holo_tpu.protocols.ospf.spf_run import atom_bits
+
             nhs = frozenset(
-                atoms[a]
-                for a in range(len(atoms))
-                if res.nexthop_words[v][a // 32]
-                & (np.uint32(1) << np.uint32(a % 32))
+                atoms[a] for a in atom_bits(res.nexthop_words[v], len(atoms))
             )
             for prefix, metric in body.prefixes:
                 total = int(res.dist[v]) + metric

@@ -159,7 +159,12 @@ def _decode_prefix(r: Reader) -> tuple[IPv6Network, int, int]:
         raise DecodeError("bad v6 prefix length")
     nbytes = (plen + 31) // 32 * 4
     raw = r.bytes(nbytes) + bytes(16 - nbytes)
-    return IPv6Network((int.from_bytes(raw, "big"), plen)), opts, metric
+    # Mask stray host bits (strict construction would raise ValueError on
+    # hostile padding, violating the decoder contract).
+    val = int.from_bytes(raw, "big")
+    if plen < 128:
+        val &= ~((1 << (128 - plen)) - 1)
+    return IPv6Network((val, plen)), opts, metric
 
 
 @dataclass
@@ -254,10 +259,26 @@ class LsaAsExternalV3:
         return cls(word & 0xFFFFFF, bool(word & 0x04000000), prefix)
 
 
+@dataclass
+class LsaRawBody:
+    """Opaque body for types we flood but do not interpret (e.g.
+    Inter-Area-Router until ASBR support lands)."""
+
+    data: bytes = b""
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.data)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaRawBody":
+        return cls(r.rest())
+
+
 _BODY_CODECS = {
     LsaType.ROUTER: LsaRouterV3,
     LsaType.NETWORK: LsaNetworkV3,
     LsaType.INTER_AREA_PREFIX: LsaInterAreaPrefix,
+    LsaType.INTER_AREA_ROUTER: LsaRawBody,
     LsaType.LINK: LsaLink,
     LsaType.INTRA_AREA_PREFIX: LsaIntraAreaPrefix,
     LsaType.AS_EXTERNAL: LsaAsExternalV3,

@@ -190,12 +190,20 @@ class IntraRoute:
     area_id: IPv4Address
 
 
+def atom_bits(words: np.ndarray, n_atoms: int) -> list[int]:
+    """Indices of set bits in an ECMP atom bitmask (uint32 words)."""
+    return [
+        a
+        for a in range(n_atoms)
+        if words[a // 32] & (np.uint32(1) << np.uint32(a % 32))
+    ]
+
+
 def _atoms_of(words: np.ndarray, atoms: list[NexthopAtom]) -> frozenset[RouteNexthop]:
-    out = []
-    for a in range(len(atoms)):
-        if words[a // 32] & (np.uint32(1) << np.uint32(a % 32)):
-            out.append(RouteNexthop(atoms[a].ifname, atoms[a].addr))
-    return frozenset(out)
+    return frozenset(
+        RouteNexthop(atoms[a].ifname, atoms[a].addr)
+        for a in atom_bits(words, len(atoms))
+    )
 
 
 def derive_routes(

@@ -1,0 +1,291 @@
+"""rtnetlink kernel FIB programming (Linux), from raw AF_NETLINK sockets.
+
+Reference: holo-routing/src/netlink.rs (route install/uninstall incl. ECMP
+:30-223, stale purge :177) and holo-interface/src/netlink.rs (link/address
+monitor).  No netlink library is available in this environment, so the
+message marshaling is implemented directly: nlmsghdr + rtmsg/ifinfomsg +
+attribute TLVs.
+
+Routes are tagged with a private ``rtm_protocol`` value so purge_stale can
+remove leftovers from a crashed previous run without touching other
+daemons' routes — the same trick the reference uses.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from dataclasses import dataclass
+from ipaddress import IPv4Network, IPv6Network
+
+from holo_tpu.routing.rib import Kernel
+from holo_tpu.utils.southbound import Nexthop, Protocol
+
+# netlink message types
+RTM_NEWROUTE = 24
+RTM_DELROUTE = 25
+RTM_GETROUTE = 26
+RTM_NEWLINK = 16
+RTM_GETLINK = 18
+NLMSG_ERROR = 2
+NLMSG_DONE = 3
+
+NLM_F_REQUEST = 0x01
+NLM_F_ACK = 0x04
+NLM_F_DUMP = 0x300
+NLM_F_CREATE = 0x400
+NLM_F_REPLACE = 0x100
+
+# rtmsg fields
+RT_TABLE_MAIN = 254
+RTPROT_HOLO_TPU = 99  # our protocol tag (rtm_protocol)
+RT_SCOPE_UNIVERSE = 0
+RT_SCOPE_LINK = 253
+RTN_UNICAST = 1
+
+# route attributes
+RTA_DST = 1
+RTA_OIF = 4
+RTA_GATEWAY = 5
+RTA_PRIORITY = 6
+RTA_MULTIPATH = 9
+RTA_TABLE = 15
+
+# link attributes
+IFLA_IFNAME = 3
+
+
+def _align(n: int) -> int:
+    return (n + 3) & ~3
+
+
+def _attr(rta_type: int, data: bytes) -> bytes:
+    length = 4 + len(data)
+    return struct.pack("<HH", length, rta_type) + data + b"\x00" * (
+        _align(length) - length
+    )
+
+
+class NetlinkSocket:
+    def __init__(self) -> None:
+        self.sock = socket.socket(
+            socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
+        )
+        self.sock.bind((0, 0))
+        self._seq = 1
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def _send(self, msg_type: int, flags: int, payload: bytes) -> int:
+        seq = self._seq
+        self._seq += 1
+        hdr = struct.pack(
+            "<IHHII", 16 + len(payload), msg_type, flags, seq, os.getpid()
+        )
+        self.sock.send(hdr + payload)
+        return seq
+
+    def request_ack(self, msg_type: int, flags: int, payload: bytes) -> None:
+        """Send and wait for the ACK; raises OSError on kernel error."""
+        seq = self._send(msg_type, flags | NLM_F_REQUEST | NLM_F_ACK, payload)
+        while True:
+            data = self.sock.recv(65536)
+            off = 0
+            while off < len(data):
+                mlen, mtype, _, mseq, _ = struct.unpack_from("<IHHII", data, off)
+                if mseq == seq and mtype == NLMSG_ERROR:
+                    (err,) = struct.unpack_from("<i", data, off + 16)
+                    if err != 0:
+                        raise OSError(-err, os.strerror(-err))
+                    return
+                off += _align(mlen)
+
+    def dump(self, msg_type: int, payload: bytes) -> list[tuple[int, bytes]]:
+        """NLM_F_DUMP request; returns [(msg_type, payload)] until DONE."""
+        seq = self._send(msg_type, NLM_F_REQUEST | NLM_F_DUMP, payload)
+        out = []
+        done = False
+        while not done:
+            data = self.sock.recv(65536)
+            off = 0
+            while off < len(data):
+                mlen, mtype, _, mseq, _ = struct.unpack_from("<IHHII", data, off)
+                if mseq == seq:
+                    if mtype == NLMSG_DONE:
+                        done = True
+                        break
+                    if mtype == NLMSG_ERROR:
+                        (err,) = struct.unpack_from("<i", data, off + 16)
+                        raise OSError(-err, os.strerror(-err))
+                    out.append((mtype, data[off + 16 : off + mlen]))
+                off += _align(mlen)
+        return out
+
+
+def parse_attrs(data: bytes) -> dict[int, bytes]:
+    out = {}
+    off = 0
+    while off + 4 <= len(data):
+        length, rta_type = struct.unpack_from("<HH", data, off)
+        if length < 4:
+            break
+        out[rta_type] = data[off + 4 : off + length]
+        off += _align(length)
+    return out
+
+
+def link_table(nl: NetlinkSocket) -> dict[str, int]:
+    """ifname -> ifindex via RTM_GETLINK dump."""
+    payload = struct.pack("<BBHiII", socket.AF_UNSPEC, 0, 0, 0, 0, 0)
+    out = {}
+    for mtype, body in nl.dump(RTM_GETLINK, payload):
+        if mtype != RTM_NEWLINK or len(body) < 16:
+            continue
+        _, _, _, ifindex, _, _ = struct.unpack_from("<BBHiII", body, 0)
+        attrs = parse_attrs(body[16:])
+        name = attrs.get(IFLA_IFNAME, b"").split(b"\x00")[0].decode()
+        if name:
+            out[name] = ifindex
+    return out
+
+
+@dataclass
+class _RtMsg:
+    family: int
+    dst_len: int
+    table: int = RT_TABLE_MAIN
+
+    def pack(self) -> bytes:
+        return struct.pack(
+            "<BBBBBBBBI",
+            self.family,
+            self.dst_len,
+            0,  # src_len
+            0,  # tos
+            self.table if self.table < 256 else 0,
+            RTPROT_HOLO_TPU,
+            RT_SCOPE_UNIVERSE,
+            RTN_UNICAST,
+            0,  # flags
+        )
+
+
+class NetlinkKernel(Kernel):
+    """Real FIB programming: the production implementation of the RIB's
+    kernel interface (MockKernel is the test double)."""
+
+    def __init__(self, table: int = RT_TABLE_MAIN):
+        self.nl = NetlinkSocket()
+        self.table = table
+        self._links = link_table(self.nl)
+
+    def refresh_links(self) -> None:
+        self._links = link_table(self.nl)
+
+    def _route_payload(self, prefix, nexthops: frozenset[Nexthop] | None) -> bytes:
+        family = socket.AF_INET if prefix.version == 4 else socket.AF_INET6
+        rt = _RtMsg(family, prefix.prefixlen, self.table)
+        payload = rt.pack()
+        payload += _attr(RTA_DST, prefix.network_address.packed)
+        if self.table >= 256:
+            payload += _attr(RTA_TABLE, struct.pack("<I", self.table))
+        if not nexthops:
+            return payload
+        hops = sorted(
+            nexthops, key=lambda nh: (str(nh.addr or ""), nh.ifname or "")
+        )
+        if len(hops) == 1:
+            nh = hops[0]
+            if nh.addr is not None:
+                payload += _attr(RTA_GATEWAY, nh.addr.packed)
+            ifidx = self._ifindex(nh)
+            if ifidx is not None:
+                payload += _attr(RTA_OIF, struct.pack("<i", ifidx))
+        else:
+            # ECMP: RTA_MULTIPATH of rtnexthop entries.
+            mp = b""
+            for nh in hops:
+                inner = b""
+                if nh.addr is not None:
+                    inner = _attr(RTA_GATEWAY, nh.addr.packed)
+                ifidx = self._ifindex(nh) or 0
+                rtnh = struct.pack("<HBBi", 8 + len(inner), 0, 0, ifidx)
+                mp += rtnh + inner
+            payload += _attr(RTA_MULTIPATH, mp)
+        return payload
+
+    def _ifindex(self, nh: Nexthop) -> int | None:
+        if nh.ifindex is not None:
+            return nh.ifindex
+        if nh.ifname is not None:
+            idx = self._links.get(nh.ifname)
+            if idx is None:
+                self.refresh_links()
+                idx = self._links.get(nh.ifname)
+            return idx
+        return None
+
+    # -- Kernel interface
+
+    def install(self, prefix, nexthops, proto: Protocol) -> None:
+        payload = self._route_payload(prefix, nexthops)
+        self.nl.request_ack(RTM_NEWROUTE, NLM_F_CREATE | NLM_F_REPLACE, payload)
+
+    def uninstall(self, prefix) -> None:
+        payload = self._route_payload(prefix, None)
+        try:
+            self.nl.request_ack(RTM_DELROUTE, 0, payload)
+        except OSError as e:
+            if e.errno != 3:  # ESRCH: already gone
+                raise
+
+    def purge_stale(self) -> None:
+        """Remove every route carrying our rtm_protocol tag."""
+        for family in (socket.AF_INET, socket.AF_INET6):
+            payload = struct.pack("<BBBBBBBBI", family, 0, 0, 0, 0, 0, 0, 0, 0)
+            for mtype, body in self.nl.dump(RTM_GETROUTE, payload):
+                if mtype not in (RTM_NEWROUTE,) or len(body) < 12:
+                    continue
+                (fam, dst_len, _sl, _tos, table, proto, _scope, _rtype, _flags
+                 ) = struct.unpack_from("<BBBBBBBBI", body, 0)
+                if proto != RTPROT_HOLO_TPU:
+                    continue
+                attrs = parse_attrs(body[12:])
+                full_table = table
+                if RTA_TABLE in attrs:
+                    (full_table,) = struct.unpack("<I", attrs[RTA_TABLE])
+                if full_table != self.table:
+                    continue
+                dst = attrs.get(RTA_DST)
+                if dst is None:
+                    continue
+                cls = IPv4Network if fam == socket.AF_INET else IPv6Network
+                prefix = cls((dst, dst_len))
+                self.uninstall(prefix)
+
+    def routes(self) -> dict:
+        """Dump our routes (verification/ops)."""
+        out = {}
+        for family in (socket.AF_INET, socket.AF_INET6):
+            payload = struct.pack("<BBBBBBBBI", family, 0, 0, 0, 0, 0, 0, 0, 0)
+            for mtype, body in self.nl.dump(RTM_GETROUTE, payload):
+                if mtype != RTM_NEWROUTE or len(body) < 12:
+                    continue
+                (fam, dst_len, _sl, _tos, table, proto, _scope, _rtype, _flags
+                 ) = struct.unpack_from("<BBBBBBBBI", body, 0)
+                if proto != RTPROT_HOLO_TPU:
+                    continue
+                attrs = parse_attrs(body[12:])
+                full_table = table
+                if RTA_TABLE in attrs:
+                    (full_table,) = struct.unpack("<I", attrs[RTA_TABLE])
+                if full_table != self.table:
+                    continue
+                dst = attrs.get(RTA_DST)
+                if dst is None:
+                    continue
+                cls = IPv4Network if fam == socket.AF_INET else IPv6Network
+                out[cls((dst, dst_len))] = attrs
+        return out

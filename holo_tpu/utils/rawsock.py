@@ -1,0 +1,124 @@
+"""Real-socket NetIo: raw IP (OSPF proto 89), UDP, with multicast.
+
+Reference: holo-utils/src/socket.rs — capability-gated raw/UDP/TCP socket
+wrappers.  This is the production counterpart of MockFabric: a
+``RawSocketIo`` owns per-interface sockets, registers them with the
+NativePoller (C++ epoll core), and delivers frames to protocol actors as
+NetRxPacket messages.
+
+Requires CAP_NET_RAW; constructed only by the daemon, never by unit tests
+(the loopback smoke test is root-gated).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+from ipaddress import IPv4Address, ip_address
+
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import EventLoop
+
+OSPF_PROTO = 89
+
+
+@dataclass
+class _IfSock:
+    ifname: str
+    sock: socket.socket
+    actor: str
+
+
+class RawSocketIo(NetIo):
+    """Raw IPv4 sockets, one per (interface, protocol actor).
+
+    send(ifname, src, dst, data) transmits to a unicast or multicast IPv4
+    destination out of the bound interface; received frames are dispatched
+    to the owning actor with the IP header stripped.
+    """
+
+    def __init__(self, loop_: EventLoop, proto: int = OSPF_PROTO):
+        self.loop = loop_
+        self.proto = proto
+        self._socks: dict[str, _IfSock] = {}
+        self._by_fd: dict[int, _IfSock] = {}
+
+    def open_interface(
+        self, ifname: str, actor: str, mcast_groups: list[IPv4Address] = ()
+    ) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_RAW, self.proto)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_BINDTODEVICE,
+                     ifname.encode() + b"\x00")
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 0)
+        ifindex = socket.if_nametoindex(ifname)
+        # Pin multicast egress AND group membership to THIS interface via
+        # ip_mreqn (an address-less join lands on the default route iface).
+        mreqn = struct.pack("4s4si", b"\x00" * 4, b"\x00" * 4, ifindex)
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_IF, mreqn)
+        for group in mcast_groups:
+            mreqn = struct.pack("4s4si", group.packed, b"\x00" * 4, ifindex)
+            try:
+                s.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreqn)
+            except OSError:
+                pass  # interface may lack an address yet
+        s.setblocking(False)
+        entry = _IfSock(ifname, s, actor)
+        self._socks[ifname] = entry
+        self._by_fd[s.fileno()] = entry
+
+    def close_interface(self, ifname: str) -> None:
+        entry = self._socks.pop(ifname, None)
+        if entry is not None:
+            self._by_fd.pop(entry.sock.fileno(), None)
+            entry.sock.close()
+
+    def fds(self) -> list[int]:
+        return list(self._by_fd.keys())
+
+    # -- NetIo
+
+    def send(self, ifname: str, src, dst, data: bytes) -> None:
+        entry = self._socks.get(ifname)
+        if entry is None:
+            return
+        entry.sock.sendto(data, (str(dst), 0))
+
+    # -- rx pump (called from the daemon IO loop on poller readiness)
+
+    def pump(self, fd: int) -> int:
+        """Drain one socket; returns number of packets delivered."""
+        entry = self._by_fd.get(fd)
+        if entry is None:
+            return 0
+        n = 0
+        while True:
+            try:
+                data, addr = entry.sock.recvfrom(65535)
+            except BlockingIOError:
+                break
+            except OSError:
+                break
+            # Raw IPv4 sockets deliver the IP header; strip it.
+            if len(data) < 20:
+                continue
+            ihl = (data[0] & 0x0F) * 4
+            if len(data) < ihl:
+                continue
+            src_ip = ip_address(data[12:16])
+            dst_ip = ip_address(data[16:20])
+            self.loop.send(
+                entry.actor,
+                NetRxPacket(entry.ifname, src_ip, dst_ip, data[ihl:]),
+            )
+            n += 1
+        return n
+
+
+def pump_all(io: RawSocketIo, poller, timeout_ms: int = 0) -> int:
+    """Poll + drain all ready raw sockets (daemon IO loop helper)."""
+    n = 0
+    for fd, _events in poller.wait(timeout_ms):
+        n += io.pump(fd)
+    return n

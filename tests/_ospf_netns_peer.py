@@ -1,0 +1,69 @@
+"""Helper: run one OSPF instance over raw sockets (launched in a netns).
+
+Usage: python _ospf_netns_peer.py <ifname> <router-id> <addr/plen> <seconds>
+Prints "FULL <nbr-id>" when the adjacency reaches FULL, then keeps running
+until the deadline so the peer can finish DD/flooding.
+"""
+
+import sys
+import time
+from ipaddress import IPv4Address, IPv4Interface
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from holo_tpu.protocols.ospf.instance import (  # noqa: E402
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType  # noqa: E402
+from holo_tpu.protocols.ospf.neighbor import NsmState  # noqa: E402
+from holo_tpu.utils.ip import ALL_SPF_RTRS_V4  # noqa: E402
+from holo_tpu.utils.native_runtime import EPOLLIN, NativePoller  # noqa: E402
+from holo_tpu.utils.rawsock import RawSocketIo  # noqa: E402
+from holo_tpu.utils.runtime import EventLoop  # noqa: E402
+
+
+def main() -> None:
+    ifname, rid, addr, seconds = (
+        sys.argv[1],
+        sys.argv[2],
+        IPv4Interface(sys.argv[3]),
+        float(sys.argv[4]),
+    )
+    loop = EventLoop()
+    io = RawSocketIo(loop)
+    inst = OspfInstance(
+        name="peer",
+        config=InstanceConfig(router_id=IPv4Address(rid)),
+        netio=io,
+    )
+    loop.register(inst)
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=5,
+                   hello_interval=1, dead_interval=4)
+    inst.add_interface(ifname, cfg, addr.network, addr.ip)
+    io.open_interface(ifname, "peer", [ALL_SPF_RTRS_V4])
+    poller = NativePoller()
+    for fd in io.fds():
+        poller.add(fd, EPOLLIN)
+    loop.send("peer", IfUpMsg(ifname))
+
+    deadline = time.monotonic() + seconds
+    announced = False
+    while time.monotonic() < deadline:
+        loop.run_until_idle()
+        for fd, _ in poller.wait(50):
+            io.pump(fd)
+        if not announced:
+            for area in inst.areas.values():
+                for iface in area.interfaces.values():
+                    for nbr in iface.neighbors.values():
+                        if nbr.state == NsmState.FULL:
+                            print(f"FULL {nbr.router_id}", flush=True)
+                            announced = True
+    print(f"ROUTES {len(inst.routes)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

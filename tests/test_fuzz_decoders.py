@@ -54,6 +54,24 @@ def corpus():
     out.append(ilsp.encode())
     out.append(isis_pkt.Snp(2, True, b"\x00" * 5 + b"\x01",
                             [(1200, isis_pkt.LspId(b"\x00" * 5 + b"\x02"), 1, 0xAB)]).encode())
+    from ipaddress import IPv6Address as A6
+    from ipaddress import IPv6Network as N6
+
+    from holo_tpu.protocols.ospf import packet_v3 as v3
+
+    h3 = v3.Packet(
+        A("1.1.1.1"), A("0.0.0.0"),
+        v3.Hello(1, 1, v3.Options.V6 | v3.Options.E | v3.Options.R,
+                 10, 40, A("0.0.0.0"), A("0.0.0.0"), [A("2.2.2.2")]),
+    )
+    out.append(h3.encode(A6("fe80::1"), A6("ff02::5")))
+    l3 = v3.Lsa(1, v3.LsaType.INTRA_AREA_PREFIX, A("0.0.0.1"), A("1.1.1.1"),
+                -99, v3.LsaIntraAreaPrefix(
+                    ref_type=int(v3.LsaType.ROUTER), ref_lsid=A("0.0.0.0"),
+                    ref_adv_rtr=A("1.1.1.1"),
+                    prefixes=[(N6("2001:db8:1::/64"), 10)]))
+    l3.encode()
+    out.append(v3.Packet(A("1.1.1.1"), A("0.0.0.0"), v3.LsUpdate([l3])).encode())
     out.append(bgp.encode_msg(bgp.OpenMsg(65001, 90, A("1.1.1.1"))))
     out.append(bgp.encode_msg(bgp.UpdateMsg(
         nlri=[N("10.0.0.0/8")],
@@ -74,9 +92,13 @@ def decoders():
     from holo_tpu.protocols.isis import packet as isis_pkt
     from holo_tpu.protocols.ospf import packet as ospf_pkt
 
+    from holo_tpu.protocols.ospf import packet_v3 as v3
+
     return {
         "ospf_packet": ospf_pkt.Packet.decode,
         "ospf_lsa": lambda b: ospf_pkt.Lsa.decode(Reader(b)),
+        "ospfv3_packet": v3.Packet.decode,
+        "ospfv3_lsa": lambda b: v3.Lsa.decode(Reader(b)),
         "isis_pdu": isis_pkt.decode_pdu,
         "bgp_msg": bgp.decode_msg,
         "rip": rip.RipPacket.decode,

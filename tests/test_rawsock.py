@@ -1,0 +1,109 @@
+"""Raw-socket IO over real kernel interfaces (root-gated).
+
+The flagship check: two REAL OSPF instances in network namespaces wired by
+a veth pair exchange REAL protocol packets through raw sockets and reach
+FULL adjacency — the production transport path end to end.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.geteuid() != 0 or not os.path.exists("/proc/net/netlink"),
+    reason="requires root + netlink",
+)
+
+
+def sh(cmd, check=True):
+    return subprocess.run(cmd, shell=True, check=check, capture_output=True,
+                          text=True)
+
+
+NS = "htpu-test-ns"
+
+
+@pytest.fixture
+def netns_veth():
+    """veth pair with one end moved into a fresh network namespace —
+    packets genuinely cross the virtual wire (same-netns veth pairs
+    short-circuit through the local stack)."""
+    sh(f"ip netns del {NS} 2>/dev/null", check=False)
+    sh("ip link del vhtpu0 2>/dev/null", check=False)
+    sh(f"ip netns add {NS}")
+    sh("ip link add vhtpu0 type veth peer name vhtpu1")
+    sh(f"ip link set vhtpu1 netns {NS}")
+    sh("ip addr add 10.99.0.1/30 dev vhtpu0")
+    sh("ip link set vhtpu0 up")
+    sh(f"ip netns exec {NS} ip addr add 10.99.0.2/30 dev vhtpu1")
+    sh(f"ip netns exec {NS} ip link set vhtpu1 up")
+    sh(f"ip netns exec {NS} ip link set lo up")
+    yield ("vhtpu0", "vhtpu1")
+    sh("ip link del vhtpu0", check=False)
+    sh(f"ip netns del {NS}", check=False)
+
+
+def test_raw_ospf_adjacency_over_netns_veth(netns_veth):
+    """The production transport end to end: our instance (raw sockets +
+    C++ epoll poller) peers with another instance running inside a network
+    namespace, over a real veth wire."""
+    import sys
+    import time
+    from pathlib import Path
+
+    from ipaddress import IPv4Address as A
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+    from holo_tpu.utils.ip import ALL_SPF_RTRS_V4
+    from holo_tpu.utils.native_runtime import EPOLLIN, NativePoller
+    from holo_tpu.utils.rawsock import RawSocketIo
+    from holo_tpu.utils.runtime import EventLoop
+
+    a_if, b_if = netns_veth
+    peer_script = Path(__file__).parent / "_ospf_netns_peer.py"
+    peer = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, str(peer_script),
+         b_if, "2.2.2.2", "10.99.0.2/30", "25"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        loop = EventLoop()  # real clock
+        io = RawSocketIo(loop)
+        r1 = OspfInstance(
+            name="r1", config=InstanceConfig(router_id=A("1.1.1.1")), netio=io
+        )
+        loop.register(r1)
+        cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=5,
+                       hello_interval=1, dead_interval=4)
+        r1.add_interface(a_if, cfg, N("10.99.0.0/30"), A("10.99.0.1"))
+        io.open_interface(a_if, "r1", [ALL_SPF_RTRS_V4])
+        poller = NativePoller()
+        for fd in io.fds():
+            poller.add(fd, EPOLLIN)
+        loop.send("r1", IfUpMsg(a_if))
+
+        deadline = time.monotonic() + 20.0
+        full = False
+        while time.monotonic() < deadline and not full:
+            loop.run_until_idle()
+            for fd, _ in poller.wait(50):
+                io.pump(fd)
+            nbrs = r1.areas[A("0.0.0.0")].interfaces[a_if].neighbors
+            full = any(n.state == NsmState.FULL for n in nbrs.values())
+        assert full, "adjacency never reached FULL over the netns veth"
+        # The peer's stub prefix arrived via real flooding.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and N("10.99.0.0/30") not in r1.routes:
+            loop.run_until_idle()
+            for fd, _ in poller.wait(50):
+                io.pump(fd)
+        assert N("10.99.0.0/30") in r1.routes
+    finally:
+        out, err = peer.communicate(timeout=30)
+    assert "FULL 1.1.1.1" in out, f"peer never saw us: {out!r} {err[-400:]!r}"
