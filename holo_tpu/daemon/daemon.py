@@ -69,7 +69,8 @@ class Daemon:
         self.policy = PolicyProvider(self.ibus)
         self.system = SystemProvider(self.ibus)
         self.routing = RoutingProvider(
-            self.loop, self.ibus, netio, self.interface, kernel, prefix=self._p
+            self.loop, self.ibus, netio, self.interface, kernel,
+            prefix=self._p, policy_engine=self.policy.engine,
         )
         for p in (self.interface, self.keychain, self.policy, self.system, self.routing):
             self.loop.register(p, name=self._p + p.name)

@@ -164,7 +164,10 @@ class PolicyProvider(Provider, Actor):
     subtree_prefixes = ("routing-policy",)
 
     def __init__(self, ibus: Ibus):
+        from holo_tpu.utils.policy import PolicyEngine
+
         self.ibus = ibus
+        self.engine = PolicyEngine()
         self.policies: dict = {}
         self.defined_sets: dict = {}
 
@@ -176,6 +179,12 @@ class PolicyProvider(Provider, Actor):
             return
         self.policies = new.get("routing-policy/policy-definition", {}) or {}
         self.defined_sets = new.get("routing-policy/defined-sets", {}) or {}
+        self.engine.load_from_config(
+            {
+                "defined-sets": self.defined_sets,
+                "policy-definition": self.policies,
+            }
+        )
         for name in self.policies:
             self.ibus.publish(TOPIC_POLICY_UPD, name)
 
@@ -217,9 +226,11 @@ class RoutingProvider(Provider, Actor):
         interface_provider: InterfaceProvider,
         kernel: Kernel | None = None,
         prefix: str = "",
+        policy_engine=None,
     ):
         self.loop = loop
         self.ibus = ibus
+        self.policy_engine = policy_engine
         # netio: either a NetIo (shared sender) or a callable actor->NetIo
         # (MockFabric.sender_for) so each protocol actor receives its own
         # bound transmit handle.
@@ -272,6 +283,7 @@ class RoutingProvider(Provider, Actor):
         self._apply_ospfv2(new)
         self._apply_ospfv3(new)
         self._apply_isis(new)
+        self._apply_bgp(new)
         self._apply_static(new)
 
     # -- OSPFv2 lifecycle (holo-routing northbound/configuration.rs analog)
@@ -538,6 +550,116 @@ class RoutingProvider(Provider, Actor):
         self._sink_routes(
             Protocol.ISIS,
             {p: (metric, frozenset(nhs)) for p, (metric, nhs) in routes.items()},
+        )
+
+    def _apply_bgp(self, new):
+        """BGP lifecycle from config (reference: holo-bgp spawn path).
+
+        Policies referenced by neighbors resolve through the policy
+        provider's engine (set at wiring time via ``policy_engine``).
+        """
+        from ipaddress import ip_address
+
+        from holo_tpu.protocols.bgp import BgpInstance, PeerConfig
+        from holo_tpu.utils.southbound import Protocol
+
+        base = "routing/control-plane-protocols/bgp"
+        conf = new.get(base)
+        inst = self.instances.get("bgp")
+        asn = new.get(f"{base}/as")
+        router_id = new.get(f"{base}/router-id")
+        if not conf or asn is None or router_id is None:
+            # Subtree (or its identity leaves) gone: tear down fully.
+            if inst is not None:
+                self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
+                self.loop.unregister(inst.name)
+                del self.instances["bgp"]
+            return
+        if inst is not None and (
+            inst.asn != asn or inst.router_id != IPv4Address(router_id)
+        ):
+            # Speaker identity change: restart (new OPENs, fresh RIBs).
+            self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
+            self.loop.unregister(inst.name)
+            del self.instances["bgp"]
+            inst = None
+        if inst is None:
+            actor = f"{self.prefix}bgp"
+            inst = BgpInstance(
+                name=actor,
+                asn=asn,
+                router_id=IPv4Address(router_id),
+                netio=self.netio_factory(actor),
+                route_cb=self._bgp_route_cb,
+            )
+            self.loop.register(inst)
+            self.instances["bgp"] = inst
+        engine = self.policy_engine
+        wanted_peers = set()
+        for addr_s, n in (new.get(f"{base}/neighbor") or {}).items():
+            addr = ip_address(n.get("address", addr_s))
+            wanted_peers.add(addr)
+            if addr in inst.peers:
+                continue
+            # Outgoing interface: longest-prefix interface subnet
+            # containing the peer (single-hop eBGP/iBGP assumption).
+            ifname = None
+            local = None
+            best_len = -1
+            for st in self.ifp.interfaces.values():
+                for a in st.addresses:
+                    if (
+                        a.version == addr.version
+                        and addr in a.network
+                        and a.network.prefixlen > best_len
+                    ):
+                        ifname, local = st.name, a.ip
+                        best_len = a.network.prefixlen
+            if ifname is None:
+                continue
+            imp = exp = None
+            if engine is not None:
+                if n.get("import-policy"):
+                    imp = engine.bgp_import_hook(n["import-policy"])
+                if n.get("export-policy"):
+                    exp = engine.bgp_import_hook(n["export-policy"])
+            inst.add_peer(
+                PeerConfig(
+                    addr,
+                    n.get("peer-as", asn),
+                    ifname,
+                    hold_time=n.get("hold-time", 90),
+                    connect_retry=n.get("connect-retry-interval", 30),
+                    import_policy=imp,
+                    export_policy=exp,
+                ),
+                local,
+            )
+            inst.start_peer(addr)
+        # Neighbors removed from config: drop the session + their routes.
+        for addr in list(inst.peers.keys() - wanted_peers):
+            inst.remove_peer(addr)
+
+    def _bgp_route_cb(self, prefix, best):
+        from holo_tpu.utils.southbound import (
+            DEFAULT_DISTANCE,
+            Nexthop,
+            Protocol,
+            RouteKeyMsg,
+            RouteMsg,
+        )
+
+        if best is None or best.peer is None:
+            self.rib.route_del(RouteKeyMsg(Protocol.BGP, prefix))
+            return
+        self.rib.route_add(
+            RouteMsg(
+                protocol=Protocol.BGP,
+                prefix=prefix,
+                distance=DEFAULT_DISTANCE[Protocol.BGP],
+                metric=best.attrs.med or 0,
+                nexthops=frozenset({Nexthop(addr=best.attrs.next_hop)}),
+            )
         )
 
     def _apply_static(self, new):

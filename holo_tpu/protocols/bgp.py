@@ -352,6 +352,22 @@ class BgpInstance(Actor):
         peer.state = PeerState.CONNECT
         self._send_open(peer)
 
+    def remove_peer(self, addr: IPv4Address) -> None:
+        """Deconfigure a neighbor: notify, withdraw its routes, forget it."""
+        peer = self.peers.get(addr)
+        if peer is None:
+            return
+        if peer.state != PeerState.IDLE:
+            self._send(peer, NotificationMsg(6, 3))  # cease / deconfigured
+        for key in (("hold", addr), ("ka", addr), ("retry", addr)):
+            t = getattr(self, f"_t_{key[0]}_{key[1]}", None)
+            if t is not None:
+                t.cancel()
+        withdrawn = list(peer.adj_rib_in.keys())
+        del self.peers[addr]
+        for prefix in withdrawn:
+            self._decision(prefix)
+
     def originate(self, prefix: IPv4Network, med: int | None = None) -> None:
         attrs = PathAttrs(
             origin=Origin.IGP, as_path=(), next_hop=None, med=med
@@ -366,7 +382,18 @@ class BgpInstance(Actor):
             self._rx(msg)
         elif isinstance(msg, ConnectRetryMsg):
             peer = self.peers.get(msg.peer)
-            if peer is not None and peer.state in (PeerState.IDLE, PeerState.CONNECT):
+            if peer is not None and peer.state in (
+                PeerState.IDLE,
+                PeerState.CONNECT,
+                PeerState.OPEN_SENT,
+                PeerState.OPEN_CONFIRM,
+            ):
+                # Timer-driven OPEN (re)send: covers a lost first OPEN (the
+                # peer's socket may not have existed yet) without the
+                # message-triggered resend loops a datagram fabric invites.
+                # OPEN_CONFIRM is included: if the peer never saw our OPEN
+                # it cannot confirm us, so re-negotiating is the only way
+                # forward short of the hold-timer reset.
                 self.start_peer(msg.peer)
         elif isinstance(msg, HoldTimerExpiredMsg):
             peer = self.peers.get(msg.peer)
@@ -408,6 +435,10 @@ class BgpInstance(Actor):
         self._send(peer, OpenMsg(self.asn, peer.config.hold_time, self.router_id))
         peer.state = PeerState.OPEN_SENT
         self._hold_timer(peer).start(peer.config.hold_time)
+        self._timer(("retry", peer.config.addr),
+                    lambda a=peer.config.addr: ConnectRetryMsg(a)).start(
+            peer.config.connect_retry
+        )
 
     def _drop_peer(self, peer: Peer) -> None:
         peer.state = PeerState.IDLE

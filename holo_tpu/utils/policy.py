@@ -1,0 +1,211 @@
+"""Routing policy engine: match sets, statements, apply chains.
+
+Reference: holo-utils/src/policy.rs:139-346 (the ietf-routing-policy data
+model) + holo-bgp's policy application worker.  A ``Policy`` is an ordered
+list of statements; each statement has match conditions (prefix sets, tag
+sets, protocol) and actions (accept/reject, set metric/tag/local-pref).
+
+Policies evaluate against a neutral ``RouteContext`` so one engine serves
+BGP import/export, redistribution filtering, and RIP/OSPF route maps.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from ipaddress import ip_network
+
+from holo_tpu.utils.ip import IpNetwork
+
+
+class PolicyResult(enum.Enum):
+    ACCEPT = "accept-route"
+    REJECT = "reject-route"
+    CONTINUE = "continue"  # no terminal action matched
+
+
+@dataclass
+class RouteContext:
+    """Mutable route view a policy evaluates and edits.
+
+    ``metric``/``local_pref`` are Optional so a policy's set-metric 0 is
+    distinguishable from "unset".
+    """
+
+    prefix: IpNetwork
+    protocol: str = ""
+    metric: int | None = None
+    tag: int | None = None
+    local_pref: int | None = None
+    communities: set = field(default_factory=set)
+
+
+@dataclass
+class PrefixSet:
+    """Prefix match set with masklength ranges (exact | le | ge)."""
+
+    name: str
+    entries: list[tuple[IpNetwork, int | None, int | None]] = field(
+        default_factory=list
+    )  # (prefix, ge, le)
+
+    def add(self, prefix, ge: int | None = None, le: int | None = None):
+        self.entries.append((ip_network(prefix, strict=False), ge, le))
+        return self
+
+    def matches(self, prefix: IpNetwork) -> bool:
+        for base, ge, le in self.entries:
+            if prefix.version != base.version:
+                continue
+            if ge is None and le is None:
+                if prefix == base:
+                    return True
+                continue
+            if not base.supernet_of(prefix) and prefix != base:
+                continue
+            plen = prefix.prefixlen
+            if ge is not None and plen < ge:
+                continue
+            if le is not None and plen > le:
+                continue
+            return True
+        return False
+
+
+@dataclass
+class Conditions:
+    prefix_set: str | None = None
+    tag_set: str | None = None
+    protocol: str | None = None
+
+    def match(self, ctx: RouteContext, sets: "DefinedSets") -> bool:
+        if self.prefix_set is not None:
+            ps = sets.prefix_sets.get(self.prefix_set)
+            if ps is None or not ps.matches(ctx.prefix):
+                return False
+        if self.tag_set is not None:
+            tags = sets.tag_sets.get(self.tag_set, set())
+            if ctx.tag not in tags:
+                return False
+        if self.protocol is not None and ctx.protocol != self.protocol:
+            return False
+        return True
+
+
+@dataclass
+class Actions:
+    result: PolicyResult | None = None  # terminal accept/reject
+    set_metric: int | None = None
+    set_tag: int | None = None
+    set_local_pref: int | None = None
+
+    def apply(self, ctx: RouteContext) -> PolicyResult:
+        if self.set_metric is not None:
+            ctx.metric = self.set_metric
+        if self.set_tag is not None:
+            ctx.tag = self.set_tag
+        if self.set_local_pref is not None:
+            ctx.local_pref = self.set_local_pref
+        return self.result or PolicyResult.CONTINUE
+
+
+@dataclass
+class Statement:
+    name: str
+    conditions: Conditions = field(default_factory=Conditions)
+    actions: Actions = field(default_factory=Actions)
+
+
+@dataclass
+class Policy:
+    name: str
+    statements: list[Statement] = field(default_factory=list)
+    default_result: PolicyResult = PolicyResult.REJECT
+
+    def evaluate(self, ctx: RouteContext, sets: "DefinedSets") -> PolicyResult:
+        """First terminal statement wins; edits accumulate along the way."""
+        for stmt in self.statements:
+            if stmt.conditions.match(ctx, sets):
+                result = stmt.actions.apply(ctx)
+                if result != PolicyResult.CONTINUE:
+                    return result
+        return self.default_result
+
+
+@dataclass
+class DefinedSets:
+    prefix_sets: dict[str, PrefixSet] = field(default_factory=dict)
+    tag_sets: dict[str, set[int]] = field(default_factory=dict)
+
+
+class PolicyEngine:
+    """Registry + evaluation entry point (what the ibus PolicyUpd carries)."""
+
+    def __init__(self) -> None:
+        self.sets = DefinedSets()
+        self.policies: dict[str, Policy] = {}
+
+    def load_from_config(self, conf: dict) -> None:
+        """Build from the routing-policy YANG-lite subtree."""
+        self.sets = DefinedSets()
+        self.policies = {}
+        defined = conf.get("defined-sets", {}) or {}
+        for name, entry in (defined.get("prefix-set") or {}).items():
+            ps = PrefixSet(name)
+            for p in entry.get("prefix", []):
+                ps.add(p)
+            self.sets.prefix_sets[name] = ps
+        for name, entry in (defined.get("tag-set") or {}).items():
+            self.sets.tag_sets[name] = set(entry.get("tag", []))
+        for name, entry in (conf.get("policy-definition") or {}).items():
+            pol = Policy(name)
+            for sname, s in (entry.get("statement") or {}).items():
+                cond = s.get("conditions", {}) or {}
+                act = s.get("actions", {}) or {}
+                result = None
+                if act.get("policy-result") == "accept-route":
+                    result = PolicyResult.ACCEPT
+                elif act.get("policy-result") == "reject-route":
+                    result = PolicyResult.REJECT
+                pol.statements.append(
+                    Statement(
+                        sname,
+                        Conditions(
+                            prefix_set=cond.get("match-prefix-set"),
+                            tag_set=cond.get("match-tag-set"),
+                        ),
+                        Actions(
+                            result=result,
+                            set_metric=act.get("set-metric"),
+                            set_tag=act.get("set-tag"),
+                            set_local_pref=act.get("set-local-pref"),
+                        ),
+                    )
+                )
+            self.policies[name] = pol
+
+    def apply(self, policy_name: str, ctx: RouteContext) -> PolicyResult:
+        pol = self.policies.get(policy_name)
+        if pol is None:
+            return PolicyResult.ACCEPT  # no policy = accept untouched
+        return pol.evaluate(ctx, self.sets)
+
+    def bgp_import_hook(self, policy_name: str):
+        """Adapter: BGP PeerConfig.import_policy/export_policy callable."""
+
+        def hook(prefix, attrs):
+            ctx = RouteContext(
+                prefix=prefix,
+                protocol="bgp",
+                metric=attrs.med,
+                local_pref=attrs.local_pref,
+            )
+            if self.apply(policy_name, ctx) == PolicyResult.REJECT:
+                return None
+            from dataclasses import replace
+
+            # ctx carries the (possibly edited) values verbatim — a
+            # set-metric of 0 sticks.
+            return replace(attrs, med=ctx.metric, local_pref=ctx.local_pref)
+
+        return hook

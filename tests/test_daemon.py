@@ -151,6 +151,67 @@ def test_ospfv3_config_driven_convergence():
     assert rib[N6("2001:db8:2::/64")].protocol.value == "ospfv3"
 
 
+def test_bgp_config_driven_with_policy():
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="b1")
+    d2 = Daemon(loop=loop, netio=fabric, name="b2")
+    fabric.join("l", "b1.bgp", "eth0", ipaddress.ip_address("10.0.0.1"))
+    fabric.join("l", "b2.bgp", "eth0", ipaddress.ip_address("10.0.0.2"))
+
+    for d, asn, rid, addr, peer in [
+        (d1, 65001, "1.1.1.1", "10.0.0.1/30", "10.0.0.2"),
+        (d2, 65002, "2.2.2.2", "10.0.0.2/30", "10.0.0.1"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        # policy on d2: reject 203.0.113.0/24
+        if d is d2:
+            cand.set(
+                "routing-policy/defined-sets/prefix-set[blocked]/prefix",
+                ["203.0.113.0/24"],
+            )
+            cand.set(
+                "routing-policy/policy-definition[edge-in]/statement[drop]/conditions/match-prefix-set",
+                "blocked",
+            )
+            cand.set(
+                "routing-policy/policy-definition[edge-in]/statement[drop]/actions/policy-result",
+                "reject-route",
+            )
+            cand.set(
+                "routing-policy/policy-definition[edge-in]/statement[ok]/actions/policy-result",
+                "accept-route",
+            )
+        cand.set("routing/control-plane-protocols/bgp/as", asn)
+        cand.set("routing/control-plane-protocols/bgp/router-id", rid)
+        cand.set(
+            f"routing/control-plane-protocols/bgp/neighbor[{peer}]/peer-as",
+            65001 if d is d2 else 65002,
+        )
+        cand.set(
+            f"routing/control-plane-protocols/bgp/neighbor[{peer}]/connect-retry-interval",
+            2,
+        )
+        if d is d2:
+            cand.set(
+                f"routing/control-plane-protocols/bgp/neighbor[{peer}]/import-policy",
+                "edge-in",
+            )
+        d.commit(cand)
+    loop.advance(10)
+    b1 = d1.routing.instances["bgp"]
+    b1.originate(N("198.51.100.0/24"))
+    b1.originate(N("203.0.113.0/24"))
+    loop.advance(5)
+    rib2 = d2.routing.rib.active_routes()
+    assert N("198.51.100.0/24") in rib2
+    assert rib2[N("198.51.100.0/24")].protocol.value == "bgp"
+    assert N("203.0.113.0/24") not in rib2  # blocked by configured policy
+
+
 def test_grpc_northbound_end_to_end():
     """Drive the daemon purely through the gRPC client."""
     import holo_tpu.daemon.grpc_server as gs

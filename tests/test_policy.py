@@ -1,0 +1,108 @@
+"""Routing policy engine: match sets, statement chains, BGP integration."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.utils.policy import (
+    Actions,
+    Conditions,
+    DefinedSets,
+    Policy,
+    PolicyEngine,
+    PolicyResult,
+    PrefixSet,
+    RouteContext,
+    Statement,
+)
+
+
+def test_prefix_set_ranges():
+    ps = PrefixSet("p").add("10.0.0.0/8", ge=16, le=24)
+    assert ps.matches(N("10.1.0.0/16"))
+    assert ps.matches(N("10.1.2.0/24"))
+    assert not ps.matches(N("10.0.0.0/8"))  # too short
+    assert not ps.matches(N("10.1.2.128/25"))  # too long
+    assert not ps.matches(N("11.0.0.0/16"))  # outside base
+    exact = PrefixSet("e").add("192.0.2.0/24")
+    assert exact.matches(N("192.0.2.0/24"))
+    assert not exact.matches(N("192.0.2.0/25"))
+
+
+def test_statement_chain_edits_then_terminal():
+    sets = DefinedSets(prefix_sets={"nets": PrefixSet("nets").add("10.0.0.0/8", ge=8, le=32)})
+    pol = Policy(
+        "p",
+        statements=[
+            Statement("tag-it", Conditions(prefix_set="nets"),
+                      Actions(set_tag=77)),  # non-terminal edit
+            Statement("accept-all", Conditions(), Actions(result=PolicyResult.ACCEPT)),
+        ],
+    )
+    ctx = RouteContext(prefix=N("10.5.0.0/16"))
+    assert pol.evaluate(ctx, sets) == PolicyResult.ACCEPT
+    assert ctx.tag == 77
+    ctx2 = RouteContext(prefix=N("172.16.0.0/16"))
+    assert pol.evaluate(ctx2, sets) == PolicyResult.ACCEPT
+    assert ctx2.tag is None  # first statement didn't match
+
+
+def test_engine_from_yang_config_and_bgp_hook():
+    engine = PolicyEngine()
+    engine.load_from_config(
+        {
+            "defined-sets": {
+                "prefix-set": {"blocked": {"prefix": ["203.0.113.0/24"]}},
+            },
+            "policy-definition": {
+                "edge-in": {
+                    "statement": {
+                        "drop-doc": {
+                            "conditions": {"match-prefix-set": "blocked"},
+                            "actions": {"policy-result": "reject-route"},
+                        },
+                        "accept": {
+                            "actions": {"policy-result": "accept-route",
+                                        "set-metric": 500},
+                        },
+                    }
+                }
+            },
+        }
+    )
+    ctx = RouteContext(prefix=N("203.0.113.0/24"))
+    assert engine.apply("edge-in", ctx) == PolicyResult.REJECT
+    ctx = RouteContext(prefix=N("198.51.100.0/24"))
+    assert engine.apply("edge-in", ctx) == PolicyResult.ACCEPT
+    assert ctx.metric == 500
+
+    # End-to-end with BGP: the hook filters and rewrites attributes.
+    from holo_tpu.protocols.bgp import (
+        BgpInstance, PeerConfig, PeerState,
+    )
+    from holo_tpu.utils.netio import MockFabric
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    b1 = BgpInstance("b1", 65001, A("1.1.1.1"), fabric.sender_for("b1"))
+    b2 = BgpInstance("b2", 65002, A("2.2.2.2"), fabric.sender_for("b2"))
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "b1", "e0", A("10.0.0.1"))
+    fabric.join("l", "b2", "e0", A("10.0.0.2"))
+    b1.add_peer(PeerConfig(A("10.0.0.2"), 65002, "e0"), A("10.0.0.1"))
+    b2.add_peer(
+        PeerConfig(A("10.0.0.1"), 65001, "e0",
+                   import_policy=engine.bgp_import_hook("edge-in")),
+        A("10.0.0.2"),
+    )
+    b1.start_peer(A("10.0.0.2"))
+    b2.start_peer(A("10.0.0.1"))
+    loop.advance(5)
+    assert b2.peers[A("10.0.0.1")].state == PeerState.ESTABLISHED
+    b1.originate(N("203.0.113.0/24"))
+    b1.originate(N("198.51.100.0/24"))
+    loop.advance(2)
+    assert N("203.0.113.0/24") not in b2.loc_rib  # rejected by policy
+    best = b2.loc_rib[N("198.51.100.0/24")][0]
+    assert best.attrs.med == 500  # rewritten by set-metric
