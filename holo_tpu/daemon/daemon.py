@@ -71,6 +71,7 @@ class Daemon:
         self.routing = RoutingProvider(
             self.loop, self.ibus, netio, self.interface, kernel,
             prefix=self._p, policy_engine=self.policy.engine,
+            keychains=self.keychain,
         )
         for p in (self.interface, self.keychain, self.policy, self.system, self.routing):
             self.loop.register(p, name=self._p + p.name)

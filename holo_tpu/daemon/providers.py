@@ -217,6 +217,18 @@ class RoutingProvider(Provider, Actor):
         sid = new_tree.get("routing/control-plane-protocols/isis/system-id")
         if sid is not None and _parse_system_id(sid) is None:
             raise CommitError(f"invalid IS-IS system-id {sid!r}")
+        # Keychain references must resolve within the same candidate.
+        chains = new_tree.get("key-chains/key-chain", {}) or {}
+        areas = new_tree.get(
+            "routing/control-plane-protocols/ospfv2/area", {}
+        ) or {}
+        for area_conf in areas.values():
+            for ifname, if_conf in (area_conf.get("interface") or {}).items():
+                kc = (if_conf.get("authentication") or {}).get("key-chain")
+                if kc is not None and kc not in chains:
+                    raise CommitError(
+                        f"interface {ifname}: unknown key-chain {kc!r}"
+                    )
 
     def __init__(
         self,
@@ -227,10 +239,12 @@ class RoutingProvider(Provider, Actor):
         kernel: Kernel | None = None,
         prefix: str = "",
         policy_engine=None,
+        keychains: "KeychainProvider | None" = None,
     ):
         self.loop = loop
         self.ibus = ibus
         self.policy_engine = policy_engine
+        self.keychains = keychains
         # netio: either a NetIo (shared sender) or a callable actor->NetIo
         # (MockFabric.sender_for) so each protocol actor receives its own
         # bound transmit handle.
@@ -243,9 +257,15 @@ class RoutingProvider(Provider, Actor):
     def attach(self, loop_):
         super().attach(loop_)
         loop_.register(self.rib, name=f"{self.prefix}routing-rib")
-        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
+        from holo_tpu.utils.ibus import (
+            TOPIC_INTERFACE_DEL,
+            TOPIC_KEYCHAIN_DEL,
+            TOPIC_KEYCHAIN_UPD,
+        )
 
         self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
+        self.ibus.subscribe(TOPIC_KEYCHAIN_UPD, self.name)
+        self.ibus.subscribe(TOPIC_KEYCHAIN_DEL, self.name)
         # BFD is always-on, spawned at startup inside the routing provider
         # (reference holo-routing/src/lib.rs:261-281).
         from holo_tpu.protocols.bfd import BfdInstance
@@ -256,8 +276,21 @@ class RoutingProvider(Provider, Actor):
         loop_.register(self.bfd, name=f"{self.prefix}bfd")
 
     def handle(self, msg):
-        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL, IbusMsg
+        from holo_tpu.utils.ibus import (
+            TOPIC_INTERFACE_DEL,
+            TOPIC_KEYCHAIN_DEL,
+            TOPIC_KEYCHAIN_UPD,
+            IbusMsg,
+        )
 
+        if isinstance(msg, IbusMsg) and msg.topic in (
+            TOPIC_KEYCHAIN_UPD,
+            TOPIC_KEYCHAIN_DEL,
+        ):
+            # Key rotation: re-resolve AuthCtx for interfaces referencing
+            # the changed keychain (in place — adjacencies re-key live).
+            self._refresh_ospf_auth()
+            return
         if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
             # Interface removed from the system: down it in every protocol
             # instance that uses it (stops hellos, withdraws the subnet).
@@ -280,11 +313,26 @@ class RoutingProvider(Provider, Actor):
     def commit(self, phase, old, new, changes):
         if phase != CommitPhase.APPLY:
             return
+        self._last_tree = new
         self._apply_ospfv2(new)
         self._apply_ospfv3(new)
         self._apply_isis(new)
         self._apply_bgp(new)
         self._apply_static(new)
+
+    def _refresh_ospf_auth(self) -> None:
+        tree = getattr(self, "_last_tree", None)
+        inst = self.instances.get("ospfv2")
+        if tree is None or inst is None:
+            return
+        areas = tree.get("routing/control-plane-protocols/ospfv2/area", {}) or {}
+        for area_conf in areas.values():
+            for ifname, if_conf in (area_conf.get("interface") or {}).items():
+                ai = inst._iface(ifname)
+                if ai is not None:
+                    ai[1].config.auth = self._ospf_auth(
+                        if_conf.get("authentication")
+                    )
 
     # -- OSPFv2 lifecycle (holo-routing northbound/configuration.rs analog)
 
@@ -362,9 +410,54 @@ class RoutingProvider(Provider, Actor):
                     passive=if_conf.get("passive", False),
                     mtu=st.mtu,
                     bfd_enabled=if_conf.get("bfd", False),
+                    auth=self._ospf_auth(if_conf.get("authentication")),
                 )
                 inst.add_interface(ifname, cfg, addr, host)
                 self.loop.send(inst.name, IfUpMsg(ifname))
+
+    def _ospf_auth(self, auth_conf):
+        """Build an AuthCtx from interface auth config, resolving keychain
+        references through the keychain provider (holo-keychain analog).
+
+        FAIL-CLOSED: an unresolvable keychain reference yields a deny-all
+        context (random key nobody shares) — never an unauthenticated
+        interface.  The reference likewise drops packets when the key
+        cannot be resolved.
+        """
+        import os as _os
+
+        from holo_tpu.protocols.ospf.packet import AuthCtx, AuthType
+
+        if not auth_conf:
+            return None
+        kc_name = auth_conf.get("key-chain")
+        if kc_name:
+            kc = (
+                self.keychains.keychains.get(kc_name)
+                if self.keychains is not None
+                else None
+            )
+            if kc and kc.get("key"):
+                # Lowest key-id wins (numeric order; lifetime-based
+                # selection lands with keychain lifetimes).
+                key_id_s, key = sorted(
+                    kc["key"].items(), key=lambda kv: int(kv[0])
+                )[0]
+                algo = key.get("crypto-algorithm", "md5")
+                return AuthCtx(
+                    AuthType.CRYPTOGRAPHIC,
+                    (key.get("key-string") or "").encode(),
+                    key_id=key.get("key-id", int(key_id_s)) & 0xFF,
+                    algo=algo,
+                )
+            return AuthCtx(AuthType.CRYPTOGRAPHIC, _os.urandom(16), key_id=0)
+        atype = auth_conf.get("type", "none")
+        key = (auth_conf.get("key") or "").encode()
+        if atype == "simple":
+            return AuthCtx(AuthType.SIMPLE, key)
+        if atype == "md5":
+            return AuthCtx(AuthType.CRYPTOGRAPHIC, key, key_id=1)
+        return None
 
     def _apply_ospfv3(self, new):
         from holo_tpu.protocols.ospf.instance_v3 import (

@@ -37,6 +37,7 @@ from holo_tpu.protocols.ospf.neighbor import (
 )
 from holo_tpu.protocols.ospf.packet import (
     MAX_AGE,
+    AuthType,
     DbDesc,
     DbDescFlags,
     Hello,
@@ -167,6 +168,7 @@ class OspfInstance(Actor):
         self._if_area: dict[str, IPv4Address] = {}
         self._timers: dict[tuple, object] = {}
         self._dd_seq = 0x1000  # deterministic DD seq seed
+        self._crypto_seq = 0  # MD5 auth sequence (boot-count persisted later)
         # SPF FSM state
         self.spf_state = SpfFsmState.QUIET
         self._spf_timer = None
@@ -1222,13 +1224,19 @@ class OspfInstance(Actor):
         if iface.state == IsmState.DOWN:
             return
         try:
-            pkt = Packet.decode(msg.data)
+            pkt = Packet.decode(msg.data, auth=iface.config.auth)
         except Exception:
-            return  # malformed: drop (decode fuzzing guards the codec)
+            return  # malformed/unauthenticated: drop
         if pkt.router_id == self.config.router_id:
             return  # our own multicast
         if pkt.area_id != area.area_id:
             return
+        if pkt.auth_type == AuthType.CRYPTOGRAPHIC:
+            nbr = iface.neighbors.get(pkt.router_id)
+            if nbr is not None:
+                if pkt.auth_seqno < nbr.crypto_seqno:
+                    return  # replay
+                nbr.crypto_seqno = pkt.auth_seqno
         t = pkt.body.TYPE
         if t == PacketType.HELLO:
             self._rx_hello(area, iface, msg.src, pkt)
@@ -1247,4 +1255,8 @@ class OspfInstance(Actor):
             area_id=area.area_id,
             body=body,
         )
-        self.netio.send(iface.name, iface.addr_ip, dst, pkt.encode())
+        auth = iface.config.auth
+        if auth is not None and auth.type == AuthType.CRYPTOGRAPHIC:
+            self._crypto_seq += 1
+            auth.seqno = self._crypto_seq
+        self.netio.send(iface.name, iface.addr_ip, dst, pkt.encode(auth=auth))

@@ -48,6 +48,7 @@ class IfConfig:
     passive: bool = False
     mtu: int = 1500
     bfd_enabled: bool = False
+    auth: object = None  # AuthCtx (packet.py) or None
 
 
 @dataclass

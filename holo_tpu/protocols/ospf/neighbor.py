@@ -61,6 +61,9 @@ class Neighbor:
     ls_rxmt: dict[LsaKey, Lsa] = field(default_factory=dict)
     # Timers owned by the instance actor:
     timers: dict = field(default_factory=dict)
+    # Cryptographic auth replay protection (RFC 2328 D.3): last accepted
+    # sequence number from this neighbor.
+    crypto_seqno: int = -1
 
     def is_adjacent(self) -> bool:
         return self.state >= NsmState.EX_START

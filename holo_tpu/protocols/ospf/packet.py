@@ -8,6 +8,8 @@ All multi-byte fields are network byte order via utils.bytesbuf.
 from __future__ import annotations
 
 import enum
+import hashlib
+import hmac as _hmac
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address
 
@@ -449,6 +451,43 @@ _PKT_CODECS = {
 }
 
 
+# Digest algorithms: RFC 2328 Appendix D keyed-MD5 plus the RFC 5709
+# HMAC-SHA family.  Value = (digest_len, hmac_name or None for keyed-md5).
+AUTH_ALGOS = {
+    "md5": (16, None),
+    "hmac-sha-1": (20, "sha1"),
+    "hmac-sha-256": (32, "sha256"),
+    "hmac-sha-384": (48, "sha384"),
+    "hmac-sha-512": (64, "sha512"),
+}
+
+
+@dataclass
+class AuthCtx:
+    """Interface authentication context (RFC 2328 Appendix D / RFC 5709).
+
+    type SIMPLE: ``key`` is the 8-byte password.  type CRYPTOGRAPHIC: a
+    keyed digest (per ``algo``) is appended after the packet; ``seqno``
+    provides replay protection (non-decreasing per neighbor).
+    """
+
+    type: AuthType = AuthType.NULL
+    key: bytes = b""
+    key_id: int = 1
+    seqno: int = 0
+    algo: str = "md5"
+
+    def digest(self, data: bytes) -> bytes:
+        dlen, hname = AUTH_ALGOS[self.algo]
+        if hname is None:  # RFC 2328 keyed-MD5: md5(packet || padded key)
+            return hashlib.md5(data + self.key[:16].ljust(16, b"\x00")).digest()
+        return _hmac.new(self.key, data, hname).digest()
+
+    @property
+    def digest_len(self) -> int:
+        return AUTH_ALGOS[self.algo][0]
+
+
 @dataclass
 class Packet:
     """OSPFv2 packet: 24-byte header + typed body (RFC 2328 §A.3.1)."""
@@ -456,27 +495,43 @@ class Packet:
     router_id: IPv4Address
     area_id: IPv4Address
     body: object
+    # auth_type/auth_data/auth_seqno are DECODE OUTPUTS (what the wire
+    # carried); encode() authenticates solely from its ``auth`` argument.
     auth_type: AuthType = AuthType.NULL
     auth_data: bytes = bytes(8)
+    auth_seqno: int = 0
 
-    def encode(self) -> bytes:
+    def encode(self, auth: AuthCtx | None = None) -> bytes:
+        auth = auth or AuthCtx()
         w = Writer()
         w.u8(OSPF_VERSION).u8(int(self.body.TYPE)).u16(0)
         w.ipv4(self.router_id).ipv4(self.area_id)
         w.u16(0)  # checksum
-        w.u16(int(self.auth_type))
-        w.zeros(8)  # auth data excluded from checksum
+        w.u16(int(auth.type))
+        w.zeros(8)
         self.body.encode_body(w)
         w.patch_u16(2, len(w))
-        # Standard checksum over the packet minus the 8 auth bytes.
+        if auth.type == AuthType.CRYPTOGRAPHIC:
+            # Appendix D.4.3: checksum not computed; auth field carries
+            # (0, key id, digest length, seqno); digest appended.
+            w.patch_bytes(
+                16,
+                bytes((0, 0, auth.key_id, auth.digest_len))
+                + (auth.seqno & 0xFFFFFFFF).to_bytes(4, "big"),
+            )
+            w.bytes(auth.digest(bytes(w.buf)))
+            return w.finish()
         cks = ip_checksum(bytes(w.buf[:16]) + bytes(w.buf[24:]))
         w.patch_u16(12, cks)
-        if self.auth_type == AuthType.SIMPLE:
-            w.patch_bytes(16, self.auth_data[:8].ljust(8, b"\x00"))
+        if auth.type == AuthType.SIMPLE:
+            w.patch_bytes(16, auth.key[:8].ljust(8, b"\x00"))
         return w.finish()
 
     @classmethod
-    def decode(cls, data: bytes) -> "Packet":
+    def decode(cls, data: bytes, auth: AuthCtx | None = None) -> "Packet":
+        """Decode + authenticate.  ``auth`` is the receiving interface's
+        configured context; a type/credential mismatch raises DecodeError
+        (the reference drops such packets with an auth error counter)."""
         r = Reader(data)
         if r.remaining() < PKT_HDR_LEN:
             raise DecodeError("short packet")
@@ -497,7 +552,27 @@ class Packet:
         except ValueError as e:
             raise DecodeError("unknown auth type") from e
         auth_data = r.bytes(8)
-        if ip_checksum(data[:16] + data[24:length]) != 0:
-            raise DecodeError("packet checksum mismatch")
+        expected = auth.type if auth is not None else AuthType.NULL
+        if auth_type != expected:
+            raise DecodeError(f"auth type mismatch: got {auth_type}")
+        seqno = 0
+        if auth_type == AuthType.CRYPTOGRAPHIC:
+            key_id = auth_data[2]
+            dlen = auth_data[3]
+            seqno = int.from_bytes(auth_data[4:8], "big")
+            if dlen != auth.digest_len or key_id != auth.key_id:
+                raise DecodeError("bad crypto auth parameters")
+            if len(data) < length + dlen:
+                raise DecodeError("missing auth digest")
+            digest = auth.digest(data[:length])
+            if not _hmac.compare_digest(digest, data[length : length + dlen]):
+                raise DecodeError("auth digest mismatch")
+        else:
+            if auth_type == AuthType.SIMPLE:
+                want = (auth.key[:8] if auth else b"").ljust(8, b"\x00")
+                if not _hmac.compare_digest(auth_data, want):
+                    raise DecodeError("bad simple password")
+            if ip_checksum(data[:16] + data[24:length]) != 0:
+                raise DecodeError("packet checksum mismatch")
         body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))
-        return cls(router_id, area_id, body, auth_type, auth_data)
+        return cls(router_id, area_id, body, auth_type, auth_data, seqno)

@@ -134,6 +134,13 @@ def _ospf_subtree(name):
                 _leaf("priority", "uint8", default=1),
                 _leaf("passive", "boolean", default=False),
                 _leaf("bfd", "boolean", default=False),
+                C(
+                    "authentication",
+                    _leaf("key-chain"),
+                    _leaf("type", "enum",
+                          enum=("none", "simple", "md5"), default="none"),
+                    _leaf("key"),
+                ),
             ),
         ),
     )

@@ -1,0 +1,128 @@
+"""OSPF authentication: MD5 cryptographic + simple password."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+import pytest
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.protocols.ospf.packet import (
+    AuthCtx,
+    AuthType,
+    Hello,
+    LsRequest,
+    Options,
+    Packet,
+)
+from holo_tpu.utils.bytesbuf import DecodeError
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def mk_pkt():
+    return Packet(A("1.1.1.1"), A("0.0.0.0"), LsRequest([]))
+
+
+def test_md5_roundtrip_and_tamper_detection():
+    auth = AuthCtx(AuthType.CRYPTOGRAPHIC, b"s3cret", key_id=5, seqno=42)
+    raw = mk_pkt().encode(auth=auth)
+    out = Packet.decode(raw, auth=auth)
+    assert out.auth_seqno == 42
+    # tampering breaks the digest
+    bad = bytearray(raw)
+    bad[5] ^= 0x01
+    with pytest.raises(DecodeError, match="digest|length"):
+        Packet.decode(bytes(bad), auth=auth)
+    # wrong key rejected
+    with pytest.raises(DecodeError, match="digest"):
+        Packet.decode(raw, auth=AuthCtx(AuthType.CRYPTOGRAPHIC, b"wrong", key_id=5))
+    # wrong key id rejected
+    with pytest.raises(DecodeError, match="parameters"):
+        Packet.decode(raw, auth=AuthCtx(AuthType.CRYPTOGRAPHIC, b"s3cret", key_id=6))
+    # unauthenticated receiver rejects authenticated packet (type mismatch)
+    with pytest.raises(DecodeError, match="mismatch"):
+        Packet.decode(raw)
+
+
+def test_simple_password():
+    auth = AuthCtx(AuthType.SIMPLE, b"pw1")
+    raw = mk_pkt().encode(auth=auth)
+    assert Packet.decode(raw, auth=auth).auth_type == AuthType.SIMPLE
+    with pytest.raises(DecodeError, match="password"):
+        Packet.decode(raw, auth=AuthCtx(AuthType.SIMPLE, b"pw2"))
+
+
+def convergence(auth1, auth2, seconds=60):
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    routers = []
+    for name, rid, addr, auth in [("r1", "1.1.1.1", "10.0.0.1", auth1),
+                                  ("r2", "2.2.2.2", "10.0.0.2", auth2)]:
+        r = OspfInstance(name=name, config=InstanceConfig(router_id=A(rid)),
+                         netio=fabric.sender_for(name))
+        loop.register(r)
+        cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1, auth=auth)
+        r.add_interface("e0", cfg, N("10.0.0.0/30"), A(addr))
+        fabric.join("lan", name, "e0", A(addr))
+        routers.append(r)
+    for r in routers:
+        loop.send(r.name, IfUpMsg("e0"))
+    loop.advance(seconds)
+    r1 = routers[0]
+    nbrs = r1.areas[A("0.0.0.0")].interfaces["e0"].neighbors
+    return any(n.state == NsmState.FULL for n in nbrs.values())
+
+
+def test_md5_adjacency_matching_keys():
+    a = lambda: AuthCtx(AuthType.CRYPTOGRAPHIC, b"k1", key_id=1)
+    assert convergence(a(), a())
+
+
+def test_md5_adjacency_mismatched_keys_blocked():
+    assert not convergence(
+        AuthCtx(AuthType.CRYPTOGRAPHIC, b"k1", key_id=1),
+        AuthCtx(AuthType.CRYPTOGRAPHIC, b"k2", key_id=1),
+    )
+
+
+def test_auth_vs_null_blocked():
+    assert not convergence(AuthCtx(AuthType.SIMPLE, b"pw"), None)
+
+
+def test_daemon_keychain_driven_md5():
+    """Config-driven: both daemons reference a keychain; adjacency forms."""
+    import ipaddress
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.protocols.ospf.packet import AuthType
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="k1")
+    d2 = Daemon(loop=loop, netio=fabric, name="k2")
+    fabric.join("l", "k1.ospfv2", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "k2.ospfv2", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, rid, addr in [(d1, "1.1.1.1", "10.0.12.1/30"),
+                         (d2, "2.2.2.2", "10.0.12.2/30")]:
+        cand = d.candidate()
+        cand.set("key-chains/key-chain[ospf-keys]/key[1]/key-string", "hunter2")
+        cand.set("key-chains/key-chain[ospf-keys]/key[1]/crypto-algorithm", "md5")
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/ospfv2/router-id", rid)
+        base = "routing/control-plane-protocols/ospfv2/area[0.0.0.0]/interface[eth0]"
+        cand.set(f"{base}/interface-type", "point-to-point")
+        cand.set(f"{base}/authentication/key-chain", "ospf-keys")
+        d.commit(cand)
+    loop.advance(60)
+    inst = d1.routing.instances["ospfv2"]
+    iface = list(inst.areas.values())[0].interfaces["eth0"]
+    assert iface.config.auth is not None
+    assert iface.config.auth.type == AuthType.CRYPTOGRAPHIC
+    assert any(n.state == NsmState.FULL for n in iface.neighbors.values())
