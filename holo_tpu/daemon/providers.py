@@ -152,11 +152,17 @@ class KeychainProvider(Provider, Actor):
         pass
 
     def commit(self, phase, old, new, changes):
+        from holo_tpu.utils.ibus import TOPIC_KEYCHAIN_DEL
+
         if phase != CommitPhase.APPLY:
             return
+        prev = self.keychains
         self.keychains = new.get("key-chains/key-chain", {}) or {}
-        for name in self.keychains:
-            self.ibus.publish(TOPIC_KEYCHAIN_UPD, name)
+        for name in prev.keys() - self.keychains.keys():
+            self.ibus.publish(TOPIC_KEYCHAIN_DEL, name)
+        for name, chain in self.keychains.items():
+            if prev.get(name) != chain:  # changed or new only
+                self.ibus.publish(TOPIC_KEYCHAIN_UPD, name)
 
 
 class PolicyProvider(Provider, Actor):
@@ -228,6 +234,18 @@ class RoutingProvider(Provider, Actor):
                 if kc is not None and kc not in chains:
                     raise CommitError(
                         f"interface {ifname}: unknown key-chain {kc!r}"
+                    )
+        # OSPFv3 authentication is IPsec-based (RFC 4552) and not yet
+        # implemented; reject rather than silently run unauthenticated.
+        v3_areas = new_tree.get(
+            "routing/control-plane-protocols/ospfv3/area", {}
+        ) or {}
+        for area_conf in v3_areas.values():
+            for ifname, if_conf in (area_conf.get("interface") or {}).items():
+                if if_conf.get("authentication"):
+                    raise CommitError(
+                        f"ospfv3 interface {ifname}: authentication is not "
+                        "supported yet (RFC 4552 IPsec pending)"
                     )
 
     def __init__(

@@ -55,6 +55,8 @@ class IsisIfConfig:
     hello_interval: int = 3  # p2p default (holo uses 3x multiplier)
     hold_multiplier: int = 3
     level: int = 2
+    circuit_type: str = "p2p"  # "p2p" | "broadcast"
+    priority: int = 64  # DIS election priority (LAN)
 
 
 @dataclass
@@ -63,6 +65,8 @@ class Adjacency:
     state: AdjacencyState = AdjacencyState.DOWN
     hold_time: int = 9
     addr: IPv4Address | None = None
+    priority: int = 64
+    lan_id: bytes = b""  # DIS the neighbor declares
 
 
 @dataclass
@@ -73,8 +77,24 @@ class IsisInterface:
     prefix: IPv4Network
     circuit_id: int = 1
     adj: Adjacency | None = None  # p2p: single adjacency
+    adjs: dict = field(default_factory=dict)  # LAN: sysid -> Adjacency
+    dis_lan_id: bytes | None = None  # elected DIS (sysid + pn byte)
     srm: set = field(default_factory=set)  # LspIds pending flood on this iface
     ssn: set = field(default_factory=set)  # LspIds pending PSNP ack
+
+    @property
+    def is_lan(self) -> bool:
+        return self.config.circuit_type == "broadcast"
+
+    def up_adjacencies(self) -> list:
+        if self.is_lan:
+            return [a for a in self.adjs.values() if a.state == AdjacencyState.UP]
+        if self.adj is not None and self.adj.state == AdjacencyState.UP:
+            return [self.adj]
+        return []
+
+    def we_are_dis(self, self_sysid: bytes, circuit_id: int) -> bool:
+        return self.dis_lan_id == self_sysid + bytes((circuit_id,))
 
 
 @dataclass
@@ -84,6 +104,17 @@ class HelloTimerMsg:
 
 @dataclass
 class HoldTimerMsg:
+    ifname: str
+
+
+@dataclass
+class LanHoldTimerMsg:
+    ifname: str
+    sysid: bytes
+
+
+@dataclass
+class CsnpTimerMsg:
     ifname: str
 
 
@@ -172,6 +203,10 @@ class IsisInstance(Actor):
             self._send_hello(msg.ifname)
         elif isinstance(msg, HoldTimerMsg):
             self._adj_down(msg.ifname)
+        elif isinstance(msg, LanHoldTimerMsg):
+            self._lan_adj_down(msg.ifname, msg.sysid)
+        elif isinstance(msg, CsnpTimerMsg):
+            self._send_periodic_csnp(msg.ifname)
         elif isinstance(msg, FloodTimerMsg):
             self._flush_flooding()
         elif isinstance(msg, AgeTickMsg):
@@ -205,37 +240,160 @@ class IsisInstance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None:
             return
-        adj = iface.adj
-        if adj is None or adj.state == AdjacencyState.DOWN:
-            state = AdjState3Way.DOWN
-            nbr_sys = None
-        elif adj.state == AdjacencyState.INITIALIZING:
-            state = AdjState3Way.INITIALIZING
-            nbr_sys = adj.sysid
+        if iface.is_lan:
+            from holo_tpu.protocols.isis.packet import HelloLan
+
+            lan_id = iface.dis_lan_id or (
+                self.sysid + bytes((iface.circuit_id,))
+            )
+            hello = HelloLan(
+                circuit_type=3,
+                sysid=self.sysid,
+                hold_time=iface.config.hello_interval
+                * iface.config.hold_multiplier,
+                priority=iface.config.priority,
+                lan_id=lan_id,
+                level=self.level,
+                tlvs={
+                    "area_addresses": [self.area],
+                    "protocols_supported": [0xCC],
+                    "ip_addresses": [iface.addr_ip],
+                    # SNPAs on the fabric are system ids.
+                    "is_neighbors": sorted(iface.adjs.keys()),
+                },
+            )
+            self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
         else:
-            state = AdjState3Way.UP
-            nbr_sys = adj.sysid
-        hello = HelloP2p(
-            circuit_type=3,
-            sysid=self.sysid,
-            hold_time=iface.config.hello_interval * iface.config.hold_multiplier,
-            local_circuit_id=iface.circuit_id,
-            tlvs={
-                "area_addresses": [self.area],
-                "protocols_supported": [0xCC],  # IPv4
-                "ip_addresses": [iface.addr_ip],
-                "p2p_adj": P2pAdjState(
-                    state, iface.circuit_id, nbr_sys,
-                    iface.circuit_id if nbr_sys else None,
-                ),
-            },
-        )
-        self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
+            adj = iface.adj
+            if adj is None or adj.state == AdjacencyState.DOWN:
+                state = AdjState3Way.DOWN
+                nbr_sys = None
+            elif adj.state == AdjacencyState.INITIALIZING:
+                state = AdjState3Way.INITIALIZING
+                nbr_sys = adj.sysid
+            else:
+                state = AdjState3Way.UP
+                nbr_sys = adj.sysid
+            hello = HelloP2p(
+                circuit_type=3,
+                sysid=self.sysid,
+                hold_time=iface.config.hello_interval * iface.config.hold_multiplier,
+                local_circuit_id=iface.circuit_id,
+                tlvs={
+                    "area_addresses": [self.area],
+                    "protocols_supported": [0xCC],  # IPv4
+                    "ip_addresses": [iface.addr_ip],
+                    "p2p_adj": P2pAdjState(
+                        state, iface.circuit_id, nbr_sys,
+                        iface.circuit_id if nbr_sys else None,
+                    ),
+                },
+            )
+            self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
         t = getattr(iface, "_hello_timer", None)
         if t is None:
             t = self.loop.timer(self.name, lambda: HelloTimerMsg(ifname))
             iface._hello_timer = t
         t.start(iface.config.hello_interval)
+
+    # -- LAN hellos + DIS election (ISO 10589 §8.4.5)
+
+    def _rx_hello_lan(self, iface: IsisInterface, hello) -> None:
+        if hello.sysid == self.sysid:
+            return
+        adj = iface.adjs.get(hello.sysid)
+        if adj is None:
+            adj = Adjacency(sysid=hello.sysid)
+            iface.adjs[hello.sysid] = adj
+        adj.hold_time = hello.hold_time
+        adj.priority = hello.priority
+        adj.lan_id = hello.lan_id
+        addrs = hello.tlvs.get("ip_addresses") or []
+        if addrs:
+            adj.addr = addrs[0]
+        old = adj.state
+        new = (
+            AdjacencyState.UP
+            if self.sysid in (hello.tlvs.get("is_neighbors") or [])
+            else AdjacencyState.INITIALIZING
+        )
+        adj.state = new
+        t = getattr(adj, "_hold_timer", None)
+        if t is None:
+            t = self.loop.timer(
+                self.name,
+                lambda s=hello.sysid: LanHoldTimerMsg(iface.name, s),
+            )
+            adj._hold_timer = t
+        t.start(adj.hold_time)
+        if new != old:
+            self._send_hello(iface.name)  # accelerate 2-way
+        self._run_dis_election(iface)
+        if new != old and new == AdjacencyState.UP:
+            self._lan_adj_up(iface, adj)
+
+    def _run_dis_election(self, iface: IsisInterface) -> None:
+        cands = [(iface.config.priority, self.sysid)]
+        for adj in iface.up_adjacencies():
+            cands.append((adj.priority, adj.sysid))
+        prio, winner = max(cands)
+        new_lan_id = (
+            self.sysid + bytes((iface.circuit_id,))
+            if winner == self.sysid
+            else next(
+                (
+                    a.lan_id
+                    for a in iface.up_adjacencies()
+                    if a.sysid == winner and a.lan_id
+                ),
+                winner + bytes((1,)),
+            )
+        )
+        if new_lan_id == iface.dis_lan_id:
+            return
+        was_dis = iface.we_are_dis(self.sysid, iface.circuit_id)
+        iface.dis_lan_id = new_lan_id
+        now_dis = iface.we_are_dis(self.sysid, iface.circuit_id)
+        if was_dis and not now_dis:
+            self._flush_pseudonode(iface)
+        if now_dis:
+            t = getattr(iface, "_csnp_timer", None)
+            if t is None:
+                t = self.loop.timer(
+                    self.name, lambda: CsnpTimerMsg(iface.name)
+                )
+                iface._csnp_timer = t
+            t.start(1.0)
+        self._adj_changed()
+
+    def _lan_adj_up(self, iface: IsisInterface, adj: Adjacency) -> None:
+        self._adj_up(iface)
+
+    def _lan_adj_down(self, ifname: str, sysid: bytes) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        if iface.adjs.pop(sysid, None) is not None:
+            self._run_dis_election(iface)
+            self._adj_changed()
+
+    def _send_periodic_csnp(self, ifname: str) -> None:
+        """DIS duty: periodic CSNPs make LAN flooding reliable (implicit
+        acks; receivers request/flood differences)."""
+        iface = self.interfaces.get(ifname)
+        if iface is None or not iface.we_are_dis(self.sysid, iface.circuit_id):
+            return
+        self._send_csnp(iface)
+        iface._csnp_timer.start(10.0)
+
+    def _flush_pseudonode(self, iface: IsisInterface) -> None:
+        lsp_id = LspId(self.sysid, pseudonode=iface.circuit_id)
+        e = self.lsdb.get(lsp_id)
+        if e is not None and e.lsp.lifetime > 0:
+            dead = Lsp(self.level, 0, lsp_id, e.lsp.seqno + 1, e.lsp.flags,
+                       e.lsp.tlvs)
+            dead.encode()
+            self._install_lsp(dead, flood_from=None)
 
     def _rx_hello(self, iface: IsisInterface, hello: HelloP2p) -> None:
         if hello.sysid == self.sysid:
@@ -268,9 +426,8 @@ class IsisInstance(Actor):
             elif old == AdjacencyState.UP:
                 self._adj_changed()
 
-    def _adj_up(self, iface: IsisInterface) -> None:
-        # Sync databases: send CSNP describing our LSDB + set SRM on all
-        # (ISO 10589 §7.3.17 behavior for p2p).
+    def _send_csnp(self, iface: IsisInterface) -> None:
+        """Describe the whole LSDB as a CSNP on this interface."""
         now = self.loop.clock.now()
         entries = [
             (e.remaining_lifetime(now), lid, e.lsp.seqno, e.lsp.cksum)
@@ -278,6 +435,11 @@ class IsisInstance(Actor):
         ]
         snp = Snp(self.level, True, self.sysid, entries)
         self.netio.send(iface.name, iface.addr_ip, ALL_ISS, snp.encode())
+
+    def _adj_up(self, iface: IsisInterface) -> None:
+        # Sync databases: send CSNP describing our LSDB + set SRM on all
+        # (ISO 10589 §7.3.17 behavior for p2p).
+        self._send_csnp(iface)
         for lid in self.lsdb:
             iface.srm.add(lid)
         self._arm_flood()
@@ -309,7 +471,13 @@ class IsisInstance(Actor):
         ip_reach = []
         for iface in self.interfaces.values():
             ip_reach.append(ExtIpReach(iface.prefix, iface.config.metric))
-            if iface.adj is not None and iface.adj.state == AdjacencyState.UP:
+            if iface.is_lan:
+                if iface.dis_lan_id is not None and iface.up_adjacencies():
+                    # LAN: advertise reach to the pseudonode.
+                    is_reach.append(
+                        ExtIsReach(iface.dis_lan_id, iface.config.metric)
+                    )
+            elif iface.adj is not None and iface.adj.state == AdjacencyState.UP:
                 is_reach.append(
                     ExtIsReach(iface.adj.sysid + b"\x00", iface.config.metric)
                 )
@@ -327,8 +495,35 @@ class IsisInstance(Actor):
             and old is not None
             and old.lsp.raw[27:] == lsp.raw[27:]
         ):
+            self._originate_pseudonodes()
             return  # content unchanged
         self._install_lsp(lsp, flood_from=None)
+        self._originate_pseudonodes()
+
+    def _originate_pseudonodes(self, force: bool = False) -> None:
+        """DIS duty: one pseudonode LSP per LAN we are DIS of, listing all
+        members (incl. ourselves) at metric 0.  ``force`` bypasses the
+        content-unchanged skip for periodic refresh (same seqno-bump
+        requirement as the node LSP)."""
+        for iface in self.interfaces.values():
+            if not iface.is_lan or not iface.we_are_dis(
+                self.sysid, iface.circuit_id
+            ):
+                continue
+            lsp_id = LspId(self.sysid, pseudonode=iface.circuit_id)
+            members = [self.sysid + b"\x00"] + [
+                a.sysid + b"\x00" for a in iface.up_adjacencies()
+            ]
+            tlvs = {
+                "ext_is_reach": [ExtIsReach(m, 0) for m in sorted(members)],
+            }
+            old = self.lsdb.get(lsp_id)
+            seqno = (old.lsp.seqno + 1) if old else 1
+            lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
+            lsp.encode()
+            if not force and old is not None and old.lsp.raw[27:] == lsp.raw[27:]:
+                continue
+            self._install_lsp(lsp, flood_from=None)
 
     # -- LSDB install + flooding (SRM/SSN model)
 
@@ -336,11 +531,12 @@ class IsisInstance(Actor):
         now = self.loop.clock.now()
         self.lsdb[lsp.lsp_id] = LspEntry(lsp, now)
         for iface in self.interfaces.values():
-            if iface.adj is None or iface.adj.state != AdjacencyState.UP:
+            if not iface.up_adjacencies():
                 continue
             if iface.name == flood_from:
                 iface.srm.discard(lsp.lsp_id)
-                iface.ssn.add(lsp.lsp_id)  # ack via PSNP
+                if not iface.is_lan:
+                    iface.ssn.add(lsp.lsp_id)  # p2p ack via PSNP
             else:
                 iface.srm.add(lsp.lsp_id)
         self._arm_flood()
@@ -388,7 +584,13 @@ class IsisInstance(Actor):
         except DecodeError:
             return
         if pdu_type == PduType.HELLO_P2P:
+            if iface.is_lan:
+                return  # circuit-type mismatch: drop (misconfigured peer)
             self._rx_hello(iface, pdu)
+        elif pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
+            if not iface.is_lan:
+                return
+            self._rx_hello_lan(iface, pdu)
         elif pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
             self._rx_lsp(iface, pdu)
         elif pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
@@ -397,7 +599,7 @@ class IsisInstance(Actor):
             self._rx_psnp(iface, pdu)
 
     def _rx_lsp(self, iface: IsisInterface, lsp: Lsp) -> None:
-        if iface.adj is None or iface.adj.state != AdjacencyState.UP:
+        if not iface.up_adjacencies():
             return
         cur = self.lsdb.get(lsp.lsp_id)
         # Self-originated received newer: outpace it (§7.3.16.1) — also
@@ -439,6 +641,10 @@ class IsisInstance(Actor):
                     iface.srm.add(lid)
                 elif c < 0:
                     iface.ssn.add(lid)  # request newer via PSNP
+                else:
+                    # Equal: the CSNP is an implicit ack (LAN flooding
+                    # reliability rides the DIS's periodic CSNPs).
+                    iface.srm.discard(lid)
         # LSPs they described that we lack: request via PSNP with seqno 0.
         missing = [
             (0, lid, 0, 0) for lid in described if lid not in self.lsdb
@@ -471,8 +677,12 @@ class IsisInstance(Actor):
                 and e.remaining_lifetime(now) < (LSP_MAX_AGE - LSP_REFRESH)
             ):
                 # Periodic refresh: force a seqno bump even with unchanged
-                # content, or neighbors age our LSP out.
-                self._originate_lsp(force=True)
+                # content, or neighbors age our LSP out.  Pseudonode LSPs
+                # refresh on the same rule.
+                if lid.pseudonode == 0:
+                    self._originate_lsp(force=True)
+                else:
+                    self._originate_pseudonodes(force=True)
             elif e.remaining_lifetime(now) == 0:
                 del self.lsdb[lid]
                 self._schedule_spf()
@@ -523,18 +733,36 @@ class IsisInstance(Actor):
         # Next-hop atoms: adjacencies out of the root.
         atoms = []
         atom_ids = np.full(topo.n_edges, -1, np.int32)
-        adj_by_sysid = {}
+        adj_by_sysid = {}  # neighbor node key -> (ifname, addr)
+        lan_iface_by_id = {}  # pseudonode key -> ifname (LANs we sit on)
         for iface in self.interfaces.values():
-            if iface.adj is not None and iface.adj.state == AdjacencyState.UP:
-                adj_by_sysid[iface.adj.sysid + b"\x00"] = (
-                    iface.name,
-                    iface.adj.addr,
-                )
+            for adj in iface.up_adjacencies():
+                adj_by_sysid[adj.sysid + b"\x00"] = (iface.name, adj.addr)
+            if iface.is_lan and iface.dis_lan_id is not None:
+                lan_iface_by_id[iface.dis_lan_id] = iface.name
+        root_lans: set[int] = set()
         for e_i in range(topo.n_edges):
             if topo.edge_src[e_i] == topo.root:
                 k = order[int(topo.edge_dst[e_i])]
-                hop = adj_by_sysid.get(k)
-                if hop is not None:
+                if k[6] == 0:  # router neighbor (p2p)
+                    hop = adj_by_sysid.get(k)
+                    if hop is not None:
+                        atom_ids[e_i] = len(atoms)
+                        atoms.append(hop)
+                elif k in lan_iface_by_id:
+                    root_lans.add(int(topo.edge_dst[e_i]))
+        # Pseudonode -> member edges on root-adjacent LANs: direct next hop
+        # is the member's address on that LAN (the generic hops==0 rule).
+        for e_i in range(topo.n_edges):
+            u = int(topo.edge_src[e_i])
+            if u in root_lans:
+                lan_key = order[u]
+                member = order[int(topo.edge_dst[e_i])]
+                if member == self_key:
+                    continue
+                hop = adj_by_sysid.get(member)
+                ifname = lan_iface_by_id.get(lan_key)
+                if hop is not None and ifname == hop[0]:
                     atom_ids[e_i] = len(atoms)
                     atoms.append(hop)
         topo.edge_direct_atom = atom_ids

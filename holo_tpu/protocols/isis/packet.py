@@ -19,6 +19,8 @@ LSP_REFRESH = 900
 
 
 class PduType(enum.IntEnum):
+    HELLO_LAN_L1 = 15
+    HELLO_LAN_L2 = 16
     HELLO_P2P = 17
     LSP_L1 = 18
     LSP_L2 = 20
@@ -30,6 +32,7 @@ class PduType(enum.IntEnum):
 
 class TlvType(enum.IntEnum):
     AREA_ADDRESSES = 1
+    IS_NEIGHBORS = 6  # LAN hellos: heard SNPAs
     PROTOCOLS_SUPPORTED = 129
     IP_INTERFACE_ADDRESS = 132
     EXT_IS_REACH = 22
@@ -88,6 +91,9 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
     if tlvs.get("area_addresses"):
         body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
         w.u8(TlvType.AREA_ADDRESSES).u8(len(body)).bytes(body)
+    if tlvs.get("is_neighbors"):
+        body = b"".join(tlvs["is_neighbors"])  # 6-byte SNPAs
+        w.u8(TlvType.IS_NEIGHBORS).u8(len(body)).bytes(body)
     if tlvs.get("protocols_supported"):
         body = bytes(tlvs["protocols_supported"])
         w.u8(TlvType.PROTOCOLS_SUPPORTED).u8(len(body)).bytes(body)
@@ -131,6 +137,7 @@ def _chunks(seq, n):
 def _decode_tlvs(r: Reader) -> dict:
     out: dict = {
         "area_addresses": [],
+        "is_neighbors": [],
         "protocols_supported": [],
         "ip_addresses": [],
         "ext_is_reach": [],
@@ -146,6 +153,9 @@ def _decode_tlvs(r: Reader) -> dict:
             while body.remaining() >= 1:
                 n = body.u8()
                 out["area_addresses"].append(body.bytes(n))
+        elif t == TlvType.IS_NEIGHBORS:
+            while body.remaining() >= 6:
+                out["is_neighbors"].append(body.bytes(6))
         elif t == TlvType.PROTOCOLS_SUPPORTED:
             out["protocols_supported"] = list(body.rest())
         elif t == TlvType.IP_INTERFACE_ADDRESS:
@@ -248,6 +258,46 @@ class HelloP2p:
         r.u16()  # pdu length
         circuit_id = r.u8()
         return cls(ct, sysid, hold, circuit_id, _decode_tlvs(r))
+
+
+@dataclass
+class HelloLan:
+    """LAN IIH (ISO 10589 §9.5/9.6): priority + LAN ID for DIS election."""
+
+    circuit_type: int
+    sysid: bytes
+    hold_time: int
+    priority: int
+    lan_id: bytes  # DIS sysid + pseudonode byte (7 bytes)
+    level: int = 2
+    tlvs: dict = field(default_factory=dict)
+
+    @property
+    def TYPE(self):
+        return PduType.HELLO_LAN_L2 if self.level == 2 else PduType.HELLO_LAN_L1
+
+    def encode(self) -> bytes:
+        w = Writer()
+        _pdu_header(w, self.TYPE, 27)
+        w.u8(self.circuit_type).bytes(self.sysid)
+        w.u16(self.hold_time)
+        len_pos = len(w)
+        w.u16(0)
+        w.u8(self.priority & 0x7F)
+        w.bytes(self.lan_id)
+        _encode_tlvs(w, self.tlvs)
+        w.patch_u16(len_pos, len(w))
+        return w.finish()
+
+    @classmethod
+    def decode_body(cls, r: Reader, level: int) -> "HelloLan":
+        ct = r.u8() & 0x3
+        sysid = r.bytes(SYSID_LEN)
+        hold = r.u16()
+        r.u16()  # pdu length
+        prio = r.u8() & 0x7F
+        lan_id = r.bytes(7)
+        return cls(ct, sysid, hold, prio, lan_id, level, _decode_tlvs(r))
 
 
 @dataclass
@@ -358,6 +408,9 @@ def decode_pdu(data: bytes):
     pdu_type = _check_header(r)
     if pdu_type == PduType.HELLO_P2P:
         return pdu_type, HelloP2p.decode_body(r)
+    if pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
+        level = 2 if pdu_type == PduType.HELLO_LAN_L2 else 1
+        return pdu_type, HelloLan.decode_body(r, level)
     if pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
         level = 2 if pdu_type == PduType.LSP_L2 else 1
         return pdu_type, Lsp.decode_body(r, level, data)

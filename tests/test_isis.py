@@ -140,6 +140,79 @@ def test_link_failure_reroute_square():
     assert dist == 10 + 1
 
 
+def test_lan_dis_election_and_pseudonode():
+    """Three routers on one LAN: DIS elected, pseudonode LSP, routes."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    routers = []
+    for i in range(3):
+        r = IsisInstance(f"is{i}", sysid(i + 1),
+                         netio=fabric.sender_for(f"is{i}"))
+        loop.register(r)
+        routers.append(r)
+    from holo_tpu.protocols.isis.instance import IsisIfConfig
+
+    for i, r in enumerate(routers):
+        cfg = IsisIfConfig(metric=10, circuit_type="broadcast",
+                           priority=64 + (10 if i == 2 else 0))
+        r.add_interface("e0", cfg, A(f"10.0.0.{i + 1}"), N("10.0.0.0/24"))
+        fabric.join("lan", r.name, "e0", A(f"10.0.0.{i + 1}"))
+    # Leaf prefix on r0 via a p2p stub iface (advertised in its LSP).
+    routers[0].add_interface(
+        "stub", IsisIfConfig(metric=5), A("192.168.9.1"), N("192.168.9.0/24")
+    )
+    for r in routers:
+        loop.send(r.name, IsisIfUpMsg("e0"))
+    loop.advance(60)
+
+    # Highest priority (r2) is DIS; everyone agrees on the LAN ID.
+    dis_id = sysid(3) + bytes((routers[2].interfaces["e0"].circuit_id,))
+    for r in routers:
+        assert r.interfaces["e0"].dis_lan_id == dis_id, r.name
+    # Pseudonode LSP exists and lists all three members.
+    from holo_tpu.protocols.isis.packet import LspId
+
+    pn = LspId(sysid(3), pseudonode=routers[2].interfaces["e0"].circuit_id)
+    for r in routers:
+        assert pn in r.lsdb, f"{r.name} missing pseudonode LSP"
+    members = {x.neighbor[:6] for x in routers[0].lsdb[pn].lsp.tlvs["ext_is_reach"]}
+    assert members == {sysid(1), sysid(2), sysid(3)}
+    # r2 and r1 route to r0's stub prefix across the LAN.
+    for r in routers[1:]:
+        route = r.routes.get(N("192.168.9.0/24"))
+        assert route is not None, r.name
+        dist, nhs = route
+        assert dist == 10 + 5
+        assert {str(a) for _, a in nhs} == {"10.0.0.1"}
+
+
+def test_lan_dis_failover():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    routers = []
+    from holo_tpu.protocols.isis.instance import IsisIfConfig
+
+    for i in range(3):
+        r = IsisInstance(f"is{i}", sysid(i + 1),
+                         netio=fabric.sender_for(f"is{i}"))
+        loop.register(r)
+        cfg = IsisIfConfig(metric=10, circuit_type="broadcast")
+        r.add_interface("e0", cfg, A(f"10.0.0.{i + 1}"), N("10.0.0.0/24"))
+        fabric.join("lan", r.name, "e0", A(f"10.0.0.{i + 1}"))
+        routers.append(r)
+    for r in routers:
+        loop.send(r.name, IsisIfUpMsg("e0"))
+    loop.advance(60)
+    # Equal priority: highest sysid (r2) is DIS.
+    assert routers[0].interfaces["e0"].dis_lan_id[:6] == sysid(3)
+    # Kill the DIS: hold time expires, a new DIS takes over, old
+    # pseudonode is no longer used for routing.
+    loop.unregister("is2")
+    loop.advance(60)
+    assert routers[0].interfaces["e0"].dis_lan_id[:6] == sysid(2)
+    assert routers[0].routes  # still have LAN routes via new pseudonode
+
+
 def test_lsp_retransmission_on_loss():
     loop, fabric, (r1, r2) = mk_net(2)
     link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2", "10.0.12.0/30")
