@@ -825,11 +825,51 @@ class RoutingProvider(Provider, Actor):
         if ospf is not None:
             state["routing"]["ospfv2"] = {
                 "spf-run-count": ospf.spf_run_count,
+                "spf-log": list(ospf.spf_log),
+                "is-abr": ospf.is_abr,
+                "areas": {
+                    str(aid): {
+                        "lsdb-count": len(a.lsdb.entries),
+                        "interfaces": {
+                            i.name: {
+                                "state": i.state.name.lower(),
+                                "dr": str(i.dr),
+                                "bdr": str(i.bdr),
+                            }
+                            for i in a.interfaces.values()
+                        },
+                    }
+                    for aid, a in ospf.areas.items()
+                },
                 "neighbors": {
                     str(n.router_id): {"state": n.state.name.lower(), "iface": i.name}
                     for a in ospf.areas.values()
                     for i in a.interfaces.values()
                     for n in i.neighbors.values()
                 },
+            }
+        isis = self.instances.get("isis")
+        if isis is not None:
+            state["routing"]["isis"] = {
+                "spf-run-count": isis.spf_run_count,
+                "lsdb-count": len(isis.lsdb),
+                "adjacencies": {
+                    i.name: [
+                        {"sysid": a.sysid.hex(), "state": a.state.value}
+                        for a in i.up_adjacencies()
+                    ]
+                    for i in isis.interfaces.values()
+                },
+            }
+        bgp = self.instances.get("bgp")
+        if bgp is not None:
+            state["routing"]["bgp"] = {
+                "as": bgp.asn,
+                "peers": {
+                    str(a): {"state": p.state.value,
+                             "prefixes-in": len(p.adj_rib_in)}
+                    for a, p in bgp.peers.items()
+                },
+                "loc-rib-count": len(bgp.loc_rib),
             }
         return state

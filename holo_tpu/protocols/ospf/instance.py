@@ -169,6 +169,10 @@ class OspfInstance(Actor):
         self._timers: dict[tuple, object] = {}
         self._dd_seq = 0x1000  # deterministic DD seq seed
         self._crypto_seq = 0  # MD5 auth sequence (boot-count persisted later)
+        # RFC 3623 restarting side: while True, self-LSA origination is
+        # suppressed and pre-restart copies are adopted (not outpaced) so
+        # helpers keep forwarding on the pre-restart topology.
+        self.gr_restarting = False
         # SPF FSM state
         self.spf_state = SpfFsmState.QUIET
         self._spf_timer = None
@@ -179,6 +183,12 @@ class OspfInstance(Actor):
         self._learn_deadline: float | None = None
         self.routes = {}
         self.spf_run_count = 0
+        # SPF run log: ring of the last 32 runs with schedule/start/end
+        # times and trigger counts (reference holo-ospf/src/spf.rs:33-36,
+        # 770-804 — exposed via operational state).
+        self.spf_log: list[dict] = []
+        self._spf_scheduled_at: float | None = None
+        self._spf_trigger_count = 0
         self.ibus = None  # set via attach_ibus for RIB integration
         self.routing_actor = "routing"
 
@@ -237,7 +247,7 @@ class OspfInstance(Actor):
         elif isinstance(msg, WaitTimerMsg):
             self._wait_timer(msg.ifname)
         elif isinstance(msg, InactivityTimerMsg):
-            self._nbr_event(msg.ifname, msg.nbr_id, NsmEvent.INACTIVITY_TIMER)
+            self._inactivity_expired(msg.ifname, msg.nbr_id)
         elif isinstance(msg, RxmtTimerMsg):
             self._rxmt(msg.ifname, msg.nbr_id)
         elif isinstance(msg, SpfDelayTimerMsg):
@@ -430,6 +440,111 @@ class OspfInstance(Actor):
             elif (nbr.priority, nbr.dr, nbr.bdr) != prev:
                 self._run_dr_election(area, iface)
 
+    # ----- graceful restart (RFC 3623)
+
+    def _inactivity_expired(self, ifname: str, nbr_id: IPv4Address) -> None:
+        """Dead timer fired — unless we are helping this neighbor restart
+        (grace window open), in which case we hold the adjacency
+        (reference gr.rs helper mode)."""
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        nbr = ai[1].neighbors.get(nbr_id)
+        if nbr is not None and nbr.gr_deadline is not None:
+            now = self.loop.clock.now()
+            if now < nbr.gr_deadline:
+                self._timer(
+                    ("inactivity", ifname, nbr_id),
+                    lambda: InactivityTimerMsg(ifname, nbr_id),
+                ).start(nbr.gr_deadline - now)
+                return
+            nbr.gr_deadline = None  # grace expired: proceed with the kill
+        self._nbr_event(ifname, nbr_id, NsmEvent.INACTIVITY_TIMER)
+
+    def send_grace_lsas(self, grace_period: int = 120, reason: int = 1) -> None:
+        """Restarting side: announce intent to restart, one link-local
+        Grace-LSA per interface (opaque type 9.3), flooded only on its
+        own link.  Exempt from the gr_restarting origination suppression
+        (RFC 3623 §2.2 — Grace-LSAs are the one thing a restarting router
+        DOES originate)."""
+        from holo_tpu.protocols.ospf.packet import (
+            LsaOpaque,
+            encode_grace_tlvs,
+            grace_lsa_lsid,
+        )
+
+        for area in self.areas.values():
+            for idx, iface in enumerate(area.interfaces.values()):
+                if iface.state == IsmState.DOWN or iface.addr_ip is None:
+                    continue
+                body = LsaOpaque(
+                    encode_grace_tlvs(grace_period, reason, iface.addr_ip)
+                )
+                self._originate(
+                    area,
+                    LsaType.OPAQUE_LINK,
+                    grace_lsa_lsid(idx),
+                    body,
+                    allow_in_gr=True,
+                    only_iface=iface,
+                )
+
+    def _gr_resync_complete(self) -> bool:
+        """All p2p neighbors named in our adopted pre-restart router LSA
+        must be FULL again before the restart is considered complete
+        (RFC 3623 §2.3; the pre-restart LSA is the surviving record of
+        which adjacencies existed)."""
+        for area in self.areas.values():
+            key = LsaKey(LsaType.ROUTER, self.config.router_id, self.config.router_id)
+            e = area.lsdb.get(key)
+            expected: set = set()
+            if e is not None:
+                for link in e.lsa.body.links:
+                    if link.link_type == RouterLinkType.POINT_TO_POINT:
+                        expected.add(link.id)
+            full = {
+                n.router_id
+                for i in area.interfaces.values()
+                for n in i.neighbors.values()
+                if n.state == NsmState.FULL
+            }
+            if expected - full:
+                return False
+        return True
+
+    def _flush_grace_lsas(self) -> None:
+        """Restart complete (§2.4): withdraw our Grace-LSAs."""
+        for area in self.areas.values():
+            for key in list(area.lsdb.entries):
+                if (
+                    key.type == LsaType.OPAQUE_LINK
+                    and key.adv_rtr == self.config.router_id
+                    and (int(key.lsid) >> 24) == 3
+                ):
+                    self._flush_self_lsa(area, key)
+
+    def _maybe_enter_gr_helper(self, area: Area, lsa: Lsa) -> None:
+        from holo_tpu.protocols.ospf.packet import decode_grace_tlvs
+
+        if lsa.type != LsaType.OPAQUE_LINK or (int(lsa.lsid) >> 24) != 3:
+            return
+        if lsa.is_maxage:
+            # Flushed Grace-LSA = restart complete: close the window.
+            for iface in area.interfaces.values():
+                nbr = iface.neighbors.get(lsa.adv_rtr)
+                if nbr is not None:
+                    nbr.gr_deadline = None
+            return
+        info = decode_grace_tlvs(lsa.body.data)
+        period = info.get("grace_period")
+        if period is None:
+            return
+        now = self.loop.clock.now()
+        for iface in area.interfaces.values():
+            nbr = iface.neighbors.get(lsa.adv_rtr)
+            if nbr is not None and nbr.state == NsmState.FULL:
+                nbr.gr_deadline = now + period
+
     # ----- NSM plumbing
 
     def _adj_ok(self, iface: OspfInterface, nbr: Neighbor) -> bool:
@@ -473,6 +588,14 @@ class OspfInstance(Actor):
                 t = self._timers.get(("rxmt", ifname, nbr_id))
                 if t:
                     t.cancel()
+                nbr.gr_deadline = None  # restart completed: exit helper
+                if self.gr_restarting and self._gr_resync_complete():
+                    # All pre-restart adjacencies re-established (§2.3):
+                    # resume origination and withdraw Grace-LSAs (§2.4).
+                    self.gr_restarting = False
+                    for a in self.areas.values():
+                        self._originate_router_lsa(a)
+                    self._flush_grace_lsas()
         if nbr.state == NsmState.DOWN:
             del iface.neighbors[nbr_id]
             if iface.config.bfd_enabled and self.ibus is not None:
@@ -518,8 +641,14 @@ class OspfInstance(Actor):
         driven by the caller: the master continues processing the packet
         that completed negotiation, the slave replies to it."""
         now = self.loop.clock.now()
-        nbr.dd_summary = [e.lsa for e in area.lsdb.entries.values()
-                          if e.current_age(now) < MAX_AGE]
+        # Link-local (type 9) LSAs are excluded: they must not DD-sync
+        # beyond their own link (RFC 5250 §3).
+        nbr.dd_summary = [
+            e.lsa
+            for e in area.lsdb.entries.values()
+            if e.current_age(now) < MAX_AGE
+            and e.lsa.type != LsaType.OPAQUE_LINK
+        ]
 
     def _send_dd(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
         chunk = self._dd_summary_chunk(nbr)
@@ -576,11 +705,11 @@ class OspfInstance(Actor):
             # for content (§10.8): the slave's echo may carry LSA headers.
             self._process_dd_headers(area, iface, nbr, dd)
             if nbr.master:
+                # The master always sends its first data DD (even with an
+                # empty summary): the slave can only conclude the exchange
+                # from a master DD with M clear.
                 nbr.dd_seq_no += 1
-                if not nbr.dd_summary and not (dd.flags & DbDescFlags.M):
-                    self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
-                else:
-                    self._send_dd(area, iface, nbr)
+                self._send_dd(area, iface, nbr)
             else:
                 self._slave_reply(area, iface, nbr, dd)
             return
@@ -757,21 +886,36 @@ class OspfInstance(Actor):
 
     # ----- flooding (§13.3)
 
-    def _install_and_flood(self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None) -> None:
+    def _install_and_flood(
+        self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
+    ) -> None:
         now = self.loop.clock.now()
         _, changed = area.lsdb.install(lsa, now)
         if changed:
             self._schedule_spf()
-        self._flood(area, lsa, from_iface, from_nbr)
+        if lsa.adv_rtr != self.config.router_id:
+            self._maybe_enter_gr_helper(area, lsa)
+        # Link-local opaque LSAs (type 9) never leave their link: received
+        # copies are not re-flooded at all; self-originated ones go out on
+        # the originating interface only (RFC 5250 §3).
+        if lsa.type == LsaType.OPAQUE_LINK and only_iface is None:
+            if lsa.is_maxage:
+                area.lsdb.remove(lsa.key)
+            return
+        self._flood(area, lsa, from_iface, from_nbr, only_iface=only_iface)
         if lsa.is_maxage:
             # Simplified MaxAge handling: once flooded and unreferenced,
             # remove (reference tracks ack state; the rxmt lists here drain
             # via acks and the entry is gone from SPF either way at MaxAge).
             area.lsdb.remove(lsa.key)
 
-    def _flood(self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None) -> None:
+    def _flood(
+        self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
+    ) -> None:
         for iface in area.interfaces.values():
             if iface.state == IsmState.DOWN:
+                continue
+            if only_iface is not None and iface is not only_iface:
                 continue
             flood_it = False
             for nbr in iface.neighbors.values():
@@ -833,7 +977,17 @@ class OspfInstance(Actor):
 
     # ----- origination
 
-    def _originate(self, area: Area, ltype: LsaType, lsid: IPv4Address, body) -> None:
+    def _originate(
+        self,
+        area: Area,
+        ltype: LsaType,
+        lsid: IPv4Address,
+        body,
+        allow_in_gr: bool = False,
+        only_iface=None,
+    ) -> None:
+        if self.gr_restarting and not allow_in_gr:
+            return  # RFC 3623 §2.2: no origination until resync completes
         key = LsaKey(ltype, lsid, self.config.router_id)
         old = area.lsdb.get(key)
         lsa = Lsa(
@@ -848,7 +1002,7 @@ class OspfInstance(Actor):
         lsa.encode()
         if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
             return  # unchanged content: no re-origination needed
-        self._install_and_flood(area, lsa)
+        self._install_and_flood(area, lsa, only_iface=only_iface)
 
     def _flush_self_lsa(self, area: Area, key: LsaKey) -> None:
         e = area.lsdb.get(key)
@@ -866,6 +1020,11 @@ class OspfInstance(Actor):
 
     def _refresh_self_lsa(self, area: Area, received: Lsa) -> None:
         """§13.4: our LSA came back newer than our copy: outpace it."""
+        if self.gr_restarting:
+            # Adopt the pre-restart copy: helpers forward on it until we
+            # re-sync and re-originate (exit path in _nbr_event "full").
+            self._install_and_flood(area, received)
+            return
         key = received.key
         cur = area.lsdb.get(key)
         if cur is None:
@@ -886,6 +1045,17 @@ class OspfInstance(Actor):
         lsa.encode()
         self._install_and_flood(area, lsa)
 
+    def _nbr_counts_full(self, nbr: Neighbor) -> bool:
+        """FULL, or in an open graceful-restart helper window — the helper
+        keeps advertising the adjacency while the neighbor restarts
+        (RFC 3623 §3.1)."""
+        if nbr.state == NsmState.FULL:
+            return True
+        return (
+            nbr.gr_deadline is not None
+            and self.loop.clock.now() < nbr.gr_deadline
+        )
+
     def _originate_router_lsa(self, area: Area) -> None:
         links: list[RouterLink] = []
         for iface in area.interfaces.values():
@@ -894,7 +1064,7 @@ class OspfInstance(Actor):
             cost = iface.config.cost
             if iface.config.if_type == IfType.POINT_TO_POINT:
                 for nbr in iface.neighbors.values():
-                    if nbr.state == NsmState.FULL:
+                    if self._nbr_counts_full(nbr):
                         links.append(
                             RouterLink(RouterLinkType.POINT_TO_POINT,
                                        nbr.router_id, iface.addr_ip, cost)
@@ -906,11 +1076,11 @@ class OspfInstance(Actor):
                 )
             else:
                 dr_full = any(
-                    n.state == NsmState.FULL and n.src == iface.dr
+                    self._nbr_counts_full(n) and n.src == iface.dr
                     for n in iface.neighbors.values()
                 )
                 we_are_dr_with_full = iface.is_dr() and any(
-                    n.state == NsmState.FULL for n in iface.neighbors.values()
+                    self._nbr_counts_full(n) for n in iface.neighbors.values()
                 )
                 if iface.state >= IsmState.DR_OTHER and (dr_full or we_are_dr_with_full):
                     links.append(
@@ -930,7 +1100,7 @@ class OspfInstance(Actor):
     def _originate_network_lsa(self, area: Area, iface: OspfInterface) -> None:
         key = LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id)
         full = [n.router_id for n in iface.neighbors.values()
-                if n.state == NsmState.FULL]
+                if self._nbr_counts_full(n)]
         if iface.is_dr() and full and iface.prefix is not None:
             body = LsaNetwork(
                 mask=mask_of(iface.prefix),
@@ -972,6 +1142,9 @@ class OspfInstance(Actor):
         LONG_WAIT uses long_delay; HOLDDOWN quiet time returns to QUIET."""
         cfg = self.config.spf
         now = self.loop.clock.now()
+        self._spf_trigger_count += 1
+        if self._spf_scheduled_at is None:
+            self._spf_scheduled_at = now
         if self._spf_timer is None:
             self._spf_timer = self.loop.timer(self.name, SpfDelayTimerMsg)
         if self._hold_timer is None:
@@ -1013,6 +1186,11 @@ class OspfInstance(Actor):
     def run_spf(self) -> None:
         now = self.loop.clock.now()
         self.spf_run_count += 1
+        start_time = now
+        scheduled_at = self._spf_scheduled_at
+        triggers = self._spf_trigger_count
+        self._spf_scheduled_at = None
+        self._spf_trigger_count = 0
         all_routes = {}
         area_intra: dict[IPv4Address, dict] = {}
         area_results: dict[IPv4Address, tuple] = {}
@@ -1105,6 +1283,20 @@ class OspfInstance(Actor):
                         and not area.lsdb.entries[key].lsa.is_maxage
                     ):
                         self._flush_self_lsa(area, key)
+
+        # SPF log ring (32 entries, reference spf.rs:770-804).
+        self.spf_log.append(
+            {
+                "run": self.spf_run_count,
+                "backend": self.backend.name,
+                "scheduled-at": scheduled_at,
+                "start-time": start_time,
+                "end-time": self.loop.clock.now(),
+                "trigger-count": triggers,
+                "route-count": len(all_routes),
+            }
+        )
+        del self.spf_log[:-32]
 
         self._finish_spf(all_routes)
 

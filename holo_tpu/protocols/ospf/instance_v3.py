@@ -373,11 +373,10 @@ class OspfV3Instance(Actor):
             nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
             self._process_dd_headers(nbr, dd)
             if nbr.master:
+                # Master always sends its first data DD — the slave can
+                # only conclude the exchange from a master DD with M clear.
                 nbr.dd_seq_no += 1
-                if not nbr.dd_summary and not (dd.flags & F.M):
-                    self._nbr_event(iface.name, pkt.router_id, NsmEvent.EXCHANGE_DONE)
-                else:
-                    self._send_dd(iface, nbr)
+                self._send_dd(iface, nbr)
             else:
                 self._slave_reply(iface, nbr, dd)
             return

@@ -64,6 +64,9 @@ class Neighbor:
     # Cryptographic auth replay protection (RFC 2328 D.3): last accepted
     # sequence number from this neighbor.
     crypto_seqno: int = -1
+    # Graceful-restart helper (RFC 3623): while now < gr_deadline the
+    # inactivity timer must not kill this neighbor.
+    gr_deadline: float | None = None
 
     def is_adjacent(self) -> bool:
         return self.state >= NsmState.EX_START

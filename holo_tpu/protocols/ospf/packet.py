@@ -192,6 +192,40 @@ class LsaOpaque:
         return cls(r.rest())
 
 
+GRACE_OPAQUE_TYPE = 3  # RFC 3623 Grace-LSA (opaque type 9.3)
+
+
+def grace_lsa_lsid(opaque_id: int = 0) -> IPv4Address:
+    """Opaque LSAs carry (opaque type, opaque id) in the link-state id;
+    the opaque id keeps per-interface Grace-LSAs distinct."""
+    return IPv4Address((GRACE_OPAQUE_TYPE << 24) | (opaque_id & 0xFFFFFF))
+
+
+def encode_grace_tlvs(grace_period: int, reason: int, addr: IPv4Address) -> bytes:
+    """RFC 3623 §B: grace period (1), restart reason (2), IP address (3)."""
+    w = Writer()
+    w.u16(1).u16(4).u32(grace_period)
+    w.u16(2).u16(1).u8(reason).zeros(3)
+    w.u16(3).u16(4).ipv4(addr)
+    return w.finish()
+
+
+def decode_grace_tlvs(data: bytes) -> dict:
+    r = Reader(data)
+    out: dict = {}
+    while r.remaining() >= 4:
+        t = r.u16()
+        length = r.u16()
+        body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
+        if t == 1 and length >= 4:
+            out["grace_period"] = body.u32()
+        elif t == 2 and length >= 1:
+            out["reason"] = body.u8()
+        elif t == 3 and length >= 4:
+            out["addr"] = body.ipv4()
+    return out
+
+
 _BODY_CODECS = {
     LsaType.ROUTER: LsaRouter,
     LsaType.NETWORK: LsaNetwork,

@@ -1,0 +1,113 @@
+"""OSPF graceful restart (RFC 3623): helper mode keeps routes through a
+neighbor's restart; without GR the same restart drops them."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def setup(loop, fabric):
+    def rtr(name, rid):
+        r = OspfInstance(name=name, config=InstanceConfig(router_id=A(rid)),
+                         netio=fabric.sender_for(name))
+        loop.register(r)
+        return r
+
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1)
+    r1, r2 = rtr("r1", "1.1.1.1"), rtr("r2", "2.2.2.2")
+    r1.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.1"))
+    r2.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.2"))
+    # a second prefix so r1 holds a route THROUGH r2
+    r2.add_interface("stub", IfConfig(if_type=IfType.POINT_TO_POINT, cost=1,
+                                      passive=True),
+                     N("192.168.2.0/24"), A("192.168.2.1"))
+    fabric.join("l", "r1", "e0", A("10.0.0.1"))
+    fabric.join("l", "r2", "e0", A("10.0.0.2"))
+    for r, ifs in ((r1, ["e0"]), (r2, ["e0", "stub"])):
+        for i in ifs:
+            loop.send(r.name, IfUpMsg(i))
+    loop.advance(60)
+    return r1, r2
+
+
+def restart_r2(loop, fabric, graceful: bool):
+    """Simulate an r2 control-plane restart (instance dies and returns)."""
+    r2_old = loop.actors["r2"]
+    if graceful:
+        r2_old.send_grace_lsas(grace_period=120)
+        loop.run_until_idle()
+    loop.unregister("r2")
+    loop.advance(60)  # dead interval (40s) elapses during the outage
+    r2_new = OspfInstance(name="r2",
+                          config=InstanceConfig(router_id=A("2.2.2.2")),
+                          netio=fabric.sender_for("r2"))
+    r2_new.gr_restarting = graceful  # RFC 3623 restarting-side mode
+    loop.register(r2_new)
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1)
+    r2_new.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.2"))
+    r2_new.add_interface("stub", IfConfig(if_type=IfType.POINT_TO_POINT,
+                                          cost=1, passive=True),
+                         N("192.168.2.0/24"), A("192.168.2.1"))
+    loop.send("r2", IfUpMsg("e0"))
+    loop.send("r2", IfUpMsg("stub"))
+    loop.advance(60)
+    return r2_new
+
+
+def test_without_gr_routes_drop_during_restart():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1, r2 = setup(loop, fabric)
+    assert N("192.168.2.0/24") in r1.routes
+    r2_old = r2
+    loop.unregister("r2")
+    loop.advance(60)  # dead interval expires -> adjacency killed
+    assert N("192.168.2.0/24") not in r1.routes, "route should drop w/o GR"
+
+
+def test_gr_helper_retains_routes_through_restart():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1, r2 = setup(loop, fabric)
+    assert N("192.168.2.0/24") in r1.routes
+
+    dropped = []
+    orig_cb = r1.route_cb
+
+    def watch(routes):
+        if N("192.168.2.0/24") not in routes:
+            dropped.append(loop.clock.now())
+
+    r1.route_cb = watch
+
+    restart_r2(loop, fabric, graceful=True)
+    # Route held for the entire restart window and adjacency re-formed.
+    assert not dropped, f"route dropped during graceful restart at {dropped}"
+    assert N("192.168.2.0/24") in r1.routes
+    iface = r1.areas[A("0.0.0.0")].interfaces["e0"]
+    nbr = iface.neighbors[A("2.2.2.2")]
+    assert nbr.state == NsmState.FULL
+    assert nbr.gr_deadline is None  # helper exited after re-FULL
+
+
+def test_gr_grace_expiry_kills_adjacency():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1, r2 = setup(loop, fabric)
+    r2.send_grace_lsas(grace_period=50)
+    loop.run_until_idle()
+    loop.unregister("r2")  # restarts... and never comes back
+    loop.advance(120)  # grace (50s) + margin
+    assert N("192.168.2.0/24") not in r1.routes
+    iface = r1.areas[A("0.0.0.0")].interfaces["e0"]
+    assert A("2.2.2.2") not in iface.neighbors
