@@ -8,6 +8,7 @@ providers (:734-755).
 from __future__ import annotations
 
 import logging
+import os
 from pathlib import Path
 
 from holo_tpu.daemon.config import DaemonConfig
@@ -146,16 +147,36 @@ def main(argv=None):
         daemon.start_gnmi()
         log.info("gNMI northbound on %s", cfg.gnmi.address)
     log.info("holo_tpu daemon running")
+    # Kernel link/address monitor (production path; requires NETLINK).
+    monitor = None
+    if os.geteuid() == 0:
+        try:
+            from holo_tpu.routing.netlink import NetlinkMonitor, link_table
+
+            monitor = NetlinkMonitor()
+            log.info("kernel interface monitor active")
+        except OSError as e:
+            log.warning("kernel monitor unavailable: %s", e)
+
     try:
         import time
 
-        while True:  # timers/IO loop; real IO integration lands with netlink
+        while True:
             with daemon.lock:
+                if monitor is not None:
+                    events = monitor.drain()
+                    if monitor.overflowed:
+                        log.warning("netlink queue overflow: full resync")
+                        monitor.overflowed = False
+                        events = monitor.resync()
+                    for ev in events:
+                        daemon.interface.apply_kernel_event(ev)
+
                 daemon.loop.run_until_idle()
                 daemon.northbound.check_confirmed_timeout(time.time())
                 nd = daemon.loop.next_deadline()
                 now = daemon.loop.clock.now()
-            time.sleep(min(max(nd - now, 0.01), 1.0) if nd else 0.2)
+            time.sleep(min(max(nd - now, 0.01), 0.2) if nd else 0.2)
     except KeyboardInterrupt:
         daemon.stop()
 

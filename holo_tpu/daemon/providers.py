@@ -101,6 +101,43 @@ class InterfaceProvider(Provider, Actor):
                     best = a.ip
         self.ibus.publish(TOPIC_ROUTER_ID, best)
 
+    def apply_kernel_event(self, ev) -> None:
+        """Feed a NetlinkMonitor LinkEvent into the provider table (the
+        production path; config-driven interfaces take precedence)."""
+        from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
+
+        if ev.kind == "link":
+            st = self.interfaces.get(ev.ifname)
+            if st is None:
+                st = IfaceState(name=ev.ifname, ifindex=ev.ifindex)
+                self.interfaces[ev.ifname] = st
+            st.ifindex = ev.ifindex
+            st.operative = ev.up and ev.running
+            if ev.mtu:
+                st.mtu = ev.mtu
+            self.ibus.publish(
+                TOPIC_INTERFACE_UPD,
+                InterfaceUpdMsg(ifname=ev.ifname, ifindex=st.ifindex,
+                                mtu=st.mtu, operative=st.operative),
+                ifname=ev.ifname,
+            )
+        elif ev.kind == "link-del":
+            if self.interfaces.pop(ev.ifname, None) is not None:
+                self.ibus.publish(TOPIC_INTERFACE_DEL, ev.ifname,
+                                  ifname=ev.ifname)
+                self._publish_router_id()
+        elif ev.kind in ("addr", "addr-del"):
+            for st in self.interfaces.values():
+                if st.ifindex == ev.ifindex:
+                    if ev.kind == "addr" and ev.addr not in st.addresses:
+                        st.addresses.append(ev.addr)
+                        self.ibus.publish(TOPIC_ADDRESS_ADD,
+                                          (st.name, ev.addr), ifname=st.name)
+                    elif ev.kind == "addr-del" and ev.addr in st.addresses:
+                        st.addresses.remove(ev.addr)
+                    self._publish_router_id()
+                    break
+
     def get_state(self, path=None):
         return {
             "interfaces": {

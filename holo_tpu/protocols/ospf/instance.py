@@ -103,6 +103,11 @@ class SpfHoldDownMsg:
 
 
 @dataclass
+class GrRestartExpireMsg:
+    pass
+
+
+@dataclass
 class AgeTickMsg:
     pass
 
@@ -254,6 +259,8 @@ class OspfInstance(Actor):
             self._spf_timer_fired()
         elif isinstance(msg, SpfHoldDownMsg):
             self._spf_holddown_fired()
+        elif isinstance(msg, GrRestartExpireMsg):
+            self._gr_restart_expired()
         elif isinstance(msg, AgeTickMsg):
             self._age_tick()
         elif isinstance(msg, IfUpMsg):
@@ -489,6 +496,26 @@ class OspfInstance(Actor):
                     only_iface=iface,
                 )
 
+    def begin_graceful_restart(self, grace_period: int = 120) -> None:
+        """Enter restarting mode with a hard exit deadline (RFC 3623 §2.5):
+        if resync hasn't completed when the grace period lapses, resume
+        normal operation with whatever adjacencies exist — a vanished
+        pre-restart neighbor must not suppress origination forever."""
+        self.gr_restarting = True
+        t = self._timers.get(("gr-expire",))
+        if t is None:
+            t = self.loop.timer(self.name, GrRestartExpireMsg)
+            self._timers[("gr-expire",)] = t
+        t.start(grace_period)
+
+    def _gr_restart_expired(self) -> None:
+        if not self.gr_restarting:
+            return
+        self.gr_restarting = False
+        for a in self.areas.values():
+            self._originate_router_lsa(a)
+        self._flush_grace_lsas()
+
     def _gr_resync_complete(self) -> bool:
         """All p2p neighbors named in our adopted pre-restart router LSA
         must be FULL again before the restart is considered complete
@@ -513,15 +540,23 @@ class OspfInstance(Actor):
         return True
 
     def _flush_grace_lsas(self) -> None:
-        """Restart complete (§2.4): withdraw our Grace-LSAs."""
+        """Restart complete (§2.4): withdraw our Grace-LSAs on the wire.
+
+        The opaque id encodes the interface's position in the area's
+        interface order (assigned identically in send_grace_lsas), so the
+        maxage copy floods on exactly its own link.
+        """
         for area in self.areas.values():
+            ifaces = list(area.interfaces.values())
             for key in list(area.lsdb.entries):
                 if (
                     key.type == LsaType.OPAQUE_LINK
                     and key.adv_rtr == self.config.router_id
                     and (int(key.lsid) >> 24) == 3
                 ):
-                    self._flush_self_lsa(area, key)
+                    idx = int(key.lsid) & 0xFFFFFF
+                    only = ifaces[idx] if idx < len(ifaces) else None
+                    self._flush_self_lsa(area, key, only_iface=only)
 
     def _maybe_enter_gr_helper(self, area: Area, lsa: Lsa) -> None:
         from holo_tpu.protocols.ospf.packet import decode_grace_tlvs
@@ -593,6 +628,9 @@ class OspfInstance(Actor):
                     # All pre-restart adjacencies re-established (§2.3):
                     # resume origination and withdraw Grace-LSAs (§2.4).
                     self.gr_restarting = False
+                    t = self._timers.get(("gr-expire",))
+                    if t:
+                        t.cancel()
                     for a in self.areas.values():
                         self._originate_router_lsa(a)
                     self._flush_grace_lsas()
@@ -1004,7 +1042,7 @@ class OspfInstance(Actor):
             return  # unchanged content: no re-origination needed
         self._install_and_flood(area, lsa, only_iface=only_iface)
 
-    def _flush_self_lsa(self, area: Area, key: LsaKey) -> None:
+    def _flush_self_lsa(self, area: Area, key: LsaKey, only_iface=None) -> None:
         e = area.lsdb.get(key)
         if e is None:
             return
@@ -1016,7 +1054,7 @@ class OspfInstance(Actor):
             raw = bytearray(lsa.raw)
             raw[0:2] = MAX_AGE.to_bytes(2, "big")
             lsa.raw = bytes(raw)
-        self._install_and_flood(area, lsa)
+        self._install_and_flood(area, lsa, only_iface=only_iface)
 
     def _refresh_self_lsa(self, area: Area, received: Lsa) -> None:
         """§13.4: our LSA came back newer than our copy: outpace it."""

@@ -211,17 +211,19 @@ def encode_grace_tlvs(grace_period: int, reason: int, addr: IPv4Address) -> byte
 
 
 def decode_grace_tlvs(data: bytes) -> dict:
+    """Tolerant parse: gates on ACTUAL remaining bytes, never the declared
+    length (a crafted short TLV must not raise out of the rx path)."""
     r = Reader(data)
     out: dict = {}
     while r.remaining() >= 4:
         t = r.u16()
         length = r.u16()
         body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
-        if t == 1 and length >= 4:
+        if t == 1 and body.remaining() >= 4:
             out["grace_period"] = body.u32()
-        elif t == 2 and length >= 1:
+        elif t == 2 and body.remaining() >= 1:
             out["reason"] = body.u8()
-        elif t == 3 and length >= 4:
+        elif t == 3 and body.remaining() >= 4:
             out["addr"] = body.ipv4()
     return out
 

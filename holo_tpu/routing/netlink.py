@@ -27,9 +27,24 @@ RTM_NEWROUTE = 24
 RTM_DELROUTE = 25
 RTM_GETROUTE = 26
 RTM_NEWLINK = 16
+RTM_DELLINK = 17
 RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_DELADDR = 21
+RTM_GETADDR = 22
 NLMSG_ERROR = 2
 NLMSG_DONE = 3
+
+# multicast groups for the monitor socket
+RTMGRP_LINK = 0x1
+RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV6_IFADDR = 0x100
+
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+IFF_UP = 0x1
+IFF_RUNNING = 0x40
+IFF_LOOPBACK = 0x8
 
 NLM_F_REQUEST = 0x01
 NLM_F_ACK = 0x04
@@ -170,6 +185,132 @@ class _RtMsg:
             RTN_UNICAST,
             0,  # flags
         )
+
+
+@dataclass
+class LinkEvent:
+    kind: str  # "link" | "link-del" | "addr" | "addr-del"
+    ifindex: int
+    ifname: str = ""
+    up: bool = False
+    running: bool = False
+    mtu: int = 0
+    addr: object = None  # ip_interface for addr events
+
+
+class NetlinkMonitor:
+    """Kernel link/address event monitor (holo-interface's netlink watch,
+    holo-interface/src/netlink.rs:92-239).
+
+    A second AF_NETLINK socket subscribed to the LINK/IFADDR multicast
+    groups; the daemon registers its fd with the poller and calls
+    ``drain()`` on readiness, feeding events into the interface provider.
+    """
+
+    IFLA_MTU = 4
+
+    def __init__(self) -> None:
+        self.sock = socket.socket(
+            socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
+        )
+        groups = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR
+        self.sock.bind((0, groups))
+        self.sock.setblocking(False)
+        self.overflowed = False
+
+    def fileno(self) -> int:
+        return self.sock.fileno()
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def drain(self) -> list[LinkEvent]:
+        """Drain queued events.  On kernel queue overflow (ENOBUFS) the
+        ``overflowed`` flag is set — the caller MUST re-dump full state
+        (link_table + addresses) because events were lost."""
+        import errno
+        from ipaddress import ip_address, ip_interface
+
+        events: list[LinkEvent] = []
+        while True:
+            try:
+                data = self.sock.recv(65536)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                if e.errno == errno.ENOBUFS:
+                    self.overflowed = True
+                    continue  # later events may still be readable
+                raise
+            off = 0
+            while off + 16 <= len(data):
+                mlen, mtype, _f, _seq, _pid = struct.unpack_from(
+                    "<IHHII", data, off
+                )
+                if mlen < 16:
+                    break
+                ev = self._parse_one(mtype, data[off + 16 : off + mlen])
+                if ev is not None:
+                    events.append(ev)
+                off += _align(mlen)
+        return events
+
+    @staticmethod
+    def _parse_one(mtype: int, body: bytes) -> "LinkEvent | None":
+        from ipaddress import ip_address, ip_interface
+
+        if mtype in (RTM_NEWLINK, RTM_DELLINK) and len(body) >= 16:
+            _fam, _res, _t, ifindex, flags, _chg = struct.unpack_from(
+                "<BBHiII", body, 0
+            )
+            attrs = parse_attrs(body[16:])
+            name = attrs.get(IFLA_IFNAME, b"").split(b"\x00")[0].decode()
+            mtu = 0
+            raw_mtu = attrs.get(NetlinkMonitor.IFLA_MTU)
+            if raw_mtu is not None and len(raw_mtu) >= 4:
+                (mtu,) = struct.unpack("<I", raw_mtu[:4])
+            return LinkEvent(
+                "link" if mtype == RTM_NEWLINK else "link-del",
+                ifindex,
+                name,
+                bool(flags & IFF_UP),
+                bool(flags & IFF_RUNNING),
+                mtu,
+            )
+        if mtype in (RTM_NEWADDR, RTM_DELADDR) and len(body) >= 8:
+            fam, plen, _flags, _scope, ifindex = struct.unpack_from(
+                "<BBBBi", body, 0
+            )
+            attrs = parse_attrs(body[8:])
+            raw = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS)
+            if raw is not None:
+                addr = ip_interface((ip_address(raw), plen))
+                return LinkEvent(
+                    "addr" if mtype == RTM_NEWADDR else "addr-del",
+                    ifindex,
+                    addr=addr,
+                )
+        return None
+
+    def resync(self) -> list[LinkEvent]:
+        """Full link+address dump (recovery after ENOBUFS overflow)."""
+        nl = NetlinkSocket()
+        try:
+            events: list[LinkEvent] = []
+            payload = struct.pack("<BBHiII", socket.AF_UNSPEC, 0, 0, 0, 0, 0)
+            for mtype, body in nl.dump(RTM_GETLINK, payload):
+                ev = self._parse_one(mtype, body)
+                if ev is not None:
+                    events.append(ev)
+            for family in (socket.AF_INET, socket.AF_INET6):
+                payload = struct.pack("<BBBBi", family, 0, 0, 0, 0)
+                for mtype, body in nl.dump(RTM_GETADDR, payload):
+                    ev = self._parse_one(mtype, body)
+                    if ev is not None:
+                        events.append(ev)
+            return events
+        finally:
+            nl.close()
 
 
 class NetlinkKernel(Kernel):

@@ -1,0 +1,9 @@
+"""Developer tools (reference: holo-tools + holo-replay, SURVEY.md §2.1).
+
+``python -m holo_tpu.tools.cli <command>``:
+  schema      — dump the management schema tree (yang_impls analog)
+  coverage    — schema node counts per module (yang_coverage analog)
+  validate    — validate a JSON config against the schema
+  replay      — feed a recorded event file into a fresh OSPFv2 instance
+                and print the resulting LSDB/routes (holo-replay analog)
+"""

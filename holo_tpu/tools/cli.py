@@ -1,0 +1,161 @@
+"""Dev tools CLI.  See package docstring for commands."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_schema(args) -> int:
+    from holo_tpu.yang.modules import full_schema
+    from holo_tpu.yang.schema import Container, Leaf, LeafList, List
+
+    def walk(node, indent=0):
+        pad = "  " * indent
+        if isinstance(node, Leaf):
+            extra = f" [{node.type}]"
+            if node.default is not None:
+                extra += f" = {node.default}"
+            print(f"{pad}{node.name}{extra}")
+        elif isinstance(node, LeafList):
+            print(f"{pad}{node.name}* [{node.type}]")
+        elif isinstance(node, List):
+            print(f"{pad}{node.name}[{node.key}]/")
+            for c in node.children.values():
+                walk(c, indent + 1)
+        elif isinstance(node, Container):
+            print(f"{pad}{node.name}/")
+            for c in node.children.values():
+                walk(c, indent + 1)
+
+    schema = full_schema()
+    roots = [args.module] if args.module else sorted(schema.roots)
+    for name in roots:
+        node = schema.roots.get(name)
+        if node is None:
+            print(f"no module {name!r}", file=sys.stderr)
+            return 1
+        walk(node)
+    return 0
+
+
+def cmd_coverage(args) -> int:
+    from holo_tpu.yang.modules import full_schema
+    from holo_tpu.yang.schema import Container, Leaf, LeafList, List
+
+    def count(node):
+        leaves = lists = containers = 0
+        if isinstance(node, (Leaf, LeafList)):
+            return 1, 0, 0
+        if isinstance(node, List):
+            lists = 1
+        elif isinstance(node, Container):
+            containers = 1
+        for c in getattr(node, "children", {}).values():
+            l2, li2, c2 = count(c)
+            leaves += l2
+            lists += li2
+            containers += c2
+        return leaves, lists, containers
+
+    total = [0, 0, 0]
+    for name, node in sorted(full_schema().roots.items()):
+        l, li, c = count(node)
+        total[0] += l
+        total[1] += li
+        total[2] += c
+        print(f"{name:20s} leaves={l:3d} lists={li:2d} containers={c:2d}")
+    print(f"{'TOTAL':20s} leaves={total[0]:3d} lists={total[1]:2d} "
+          f"containers={total[2]:2d}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from holo_tpu.yang.data import DataTree
+    from holo_tpu.yang.modules import full_schema
+    from holo_tpu.yang.schema import SchemaError
+
+    text = open(args.file).read() if args.file != "-" else sys.stdin.read()
+    try:
+        DataTree.from_json(full_schema(), text)
+    except (SchemaError, json.JSONDecodeError) as e:
+        print(f"INVALID: {e}")
+        return 1
+    print("valid")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from ipaddress import IPv4Address, IPv4Network
+
+    from holo_tpu.protocols.ospf.instance import IfConfig, InstanceConfig, OspfInstance
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.utils.event_recorder import replay
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    setup = json.load(open(args.setup))
+    loop = EventLoop(clock=VirtualClock())
+
+    class NullIo:
+        def send(self, *a):
+            pass
+
+    inst = OspfInstance(
+        name=setup.get("actor", "ospfv2"),
+        config=InstanceConfig(router_id=IPv4Address(setup["router-id"])),
+        netio=NullIo(),
+    )
+    loop.register(inst)
+    for ifname, icfg in setup.get("interfaces", {}).items():
+        inst.add_interface(
+            ifname,
+            IfConfig(
+                area_id=IPv4Address(icfg.get("area", "0.0.0.0")),
+                if_type=(
+                    IfType.POINT_TO_POINT
+                    if icfg.get("type") == "point-to-point"
+                    else IfType.BROADCAST
+                ),
+                cost=icfg.get("cost", 10),
+            ),
+            IPv4Network(icfg["prefix"], strict=False),
+            IPv4Address(icfg["address"]),
+        )
+    n = replay(args.events, loop)
+    print(f"replayed {n} events")
+    for aid, area in inst.areas.items():
+        print(f"area {aid}: {len(area.lsdb.entries)} LSAs")
+        for key in sorted(area.lsdb.entries, key=str):
+            e = area.lsdb.entries[key]
+            print(f"  {key.type.name:16s} {key.lsid} adv={key.adv_rtr} "
+                  f"seq={e.lsa.seq_no}")
+    print(f"routes ({len(inst.routes)}):")
+    for prefix, route in sorted(inst.routes.items(), key=lambda kv: str(kv[0])):
+        nhs = sorted(f"{nh.ifname}:{nh.addr}" for nh in route.nexthops)
+        print(f"  {prefix} dist={route.dist} via {nhs}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="holo-tpu-tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("schema", help="dump the management schema tree")
+    s.add_argument("module", nargs="?")
+    s.set_defaults(fn=cmd_schema)
+    s = sub.add_parser("coverage", help="schema node counts per module")
+    s.set_defaults(fn=cmd_coverage)
+    s = sub.add_parser("validate", help="validate a JSON config")
+    s.add_argument("file")
+    s.set_defaults(fn=cmd_validate)
+    s = sub.add_parser("replay", help="replay recorded events into OSPFv2")
+    s.add_argument("events")
+    s.add_argument("--setup", required=True,
+                   help="JSON: router-id + interfaces layout")
+    s.set_defaults(fn=cmd_replay)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,38 @@ def test_purge_stale_only_our_protocol(kernel):
         )
 
 
+def test_monitor_sees_link_and_address_events(kernel):
+    """Live kernel: create a dummy link, flip it, add an address — the
+    monitor reports each event."""
+    from holo_tpu.routing.netlink import NetlinkMonitor
+
+    mon = NetlinkMonitor()
+    try:
+        subprocess.run("ip link del vmon0 2>/dev/null", shell=True)
+        subprocess.run("ip link add vmon0 type veth peer name vmon1", shell=True, check=True)
+        subprocess.run("ip link set vmon0 up", shell=True, check=True)
+        subprocess.run("ip addr add 192.0.2.77/24 dev vmon0", shell=True,
+                       check=True)
+        import time
+
+        time.sleep(0.2)
+        events = mon.drain()
+        kinds = [(e.kind, e.ifname or e.addr) for e in events]
+        assert any(e.kind == "link" and e.ifname == "vmon0" and e.up
+                   for e in events), kinds
+        assert any(e.kind == "addr" and str(e.addr) == "192.0.2.77/24"
+                   for e in events), kinds
+
+        subprocess.run("ip link del vmon0", shell=True, check=True)
+        time.sleep(0.2)
+        events = mon.drain()
+        assert any(e.kind == "link-del" and e.ifname == "vmon0"
+                   for e in events)
+    finally:
+        subprocess.run("ip link del vmon0 2>/dev/null", shell=True)
+        mon.close()
+
+
 def test_rib_manager_with_real_kernel(kernel):
     """The full path: RIB manager programming the actual kernel FIB."""
     from holo_tpu.routing.rib import RibManager

@@ -51,8 +51,9 @@ def restart_r2(loop, fabric, graceful: bool):
     r2_new = OspfInstance(name="r2",
                           config=InstanceConfig(router_id=A("2.2.2.2")),
                           netio=fabric.sender_for("r2"))
-    r2_new.gr_restarting = graceful  # RFC 3623 restarting-side mode
     loop.register(r2_new)
+    if graceful:
+        r2_new.begin_graceful_restart(grace_period=120)
     cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1)
     r2_new.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.2"))
     r2_new.add_interface("stub", IfConfig(if_type=IfType.POINT_TO_POINT,
@@ -98,6 +99,35 @@ def test_gr_helper_retains_routes_through_restart():
     nbr = iface.neighbors[A("2.2.2.2")]
     assert nbr.state == NsmState.FULL
     assert nbr.gr_deadline is None  # helper exited after re-FULL
+
+
+def test_restarting_side_expiry_resumes_origination():
+    """A vanished pre-restart neighbor must not suppress origination
+    forever: the restarting side exits GR at the grace deadline."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1, r2 = setup(loop, fabric)
+    r2.send_grace_lsas(grace_period=40)
+    loop.run_until_idle()
+    loop.unregister("r2")
+    loop.advance(10)
+    # r2 restarts but r1 never comes back (fail its link).
+    fabric.set_link_up("l", False)
+    r2n = OspfInstance(name="r2", config=InstanceConfig(router_id=A("2.2.2.2")),
+                       netio=fabric.sender_for("r2"))
+    loop.register(r2n)
+    r2n.begin_graceful_restart(grace_period=40)
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=1)
+    r2n.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.2"))
+    r2n.add_interface("stub", IfConfig(if_type=IfType.POINT_TO_POINT, cost=1,
+                                       passive=True),
+                      N("192.168.2.0/24"), A("192.168.2.1"))
+    loop.send("r2", IfUpMsg("e0"))
+    loop.send("r2", IfUpMsg("stub"))
+    loop.advance(60)  # grace (40s) lapses without resync
+    assert not r2n.gr_restarting
+    # Origination resumed: r2 advertises its stub and routes locally.
+    assert N("192.168.2.0/24") in r2n.routes
 
 
 def test_gr_grace_expiry_kills_adjacency():
