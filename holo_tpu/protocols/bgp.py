@@ -18,9 +18,15 @@ import enum
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address, IPv4Network
 
+import logging
+
+from holo_tpu.protocols.bgp_worker import EvalBatchRequest
+from holo_tpu.protocols.bgp_worker import EvalBatchResult as _EvalBatchResultT
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
+
+log = logging.getLogger("holo_tpu.bgp")
 
 BGP_MARKER = b"\xff" * 16
 BGP_VERSION = 4
@@ -315,6 +321,14 @@ class Peer:
         self.hold_time = cfg.hold_time
         self.adj_rib_in: dict[IPv4Network, PathAttrs] = {}
         self.adj_rib_out: dict[IPv4Network, PathAttrs] = {}
+        # Bumped whenever the session drops: stale async policy-worker
+        # results for an old incarnation are discarded on arrival.
+        self.generation = 0
+        # Pipeline ordering for async policy evaluation: every UPDATE gets
+        # a sequence number; withdrawals record it so an in-flight result
+        # from BEFORE the withdraw cannot resurrect the route.
+        self.update_seq = 0
+        self.last_withdraw_seq: dict = {}
 
 
 class BgpInstance(Actor):
@@ -329,12 +343,17 @@ class BgpInstance(Actor):
         router_id: IPv4Address,
         netio: NetIo,
         route_cb=None,
+        policy_worker: str | None = None,
     ):
+        """``policy_worker``: actor name of a PolicyWorker — import
+        policies given as strings are then evaluated asynchronously off
+        the instance path (the reference's offload boundary)."""
         self.name = name
         self.asn = asn
         self.router_id = router_id
         self.netio = netio
         self.route_cb = route_cb
+        self.policy_worker = policy_worker
         self.peers: dict[IPv4Address, Peer] = {}
         self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our addr
         # Loc-RIB: prefix -> list[RouteEntry]; best first after decision.
@@ -380,6 +399,8 @@ class BgpInstance(Actor):
     def handle(self, msg):
         if isinstance(msg, NetRxPacket):
             self._rx(msg)
+        elif isinstance(msg, _EvalBatchResultT):
+            self._rx_policy_result(msg)
         elif isinstance(msg, ConnectRetryMsg):
             peer = self.peers.get(msg.peer)
             if peer is not None and peer.state in (
@@ -442,6 +463,7 @@ class BgpInstance(Actor):
 
     def _drop_peer(self, peer: Peer) -> None:
         peer.state = PeerState.IDLE
+        peer.generation += 1  # invalidate in-flight policy-worker results
         withdrawn = list(peer.adj_rib_in.keys())
         peer.adj_rib_in.clear()
         peer.adj_rib_out.clear()
@@ -495,27 +517,77 @@ class BgpInstance(Actor):
     def _rx_update(self, peer: Peer, upd: UpdateMsg) -> None:
         if peer.state != PeerState.ESTABLISHED:
             return
+        # RFC 4271 §4.4: any valid UPDATE resets the hold timer.
+        self._hold_timer(peer).start(peer.hold_time)
+        peer.update_seq += 1
+        seq = peer.update_seq
         changed = set()
         for prefix in upd.withdrawn:
+            peer.last_withdraw_seq[prefix] = seq
             if peer.adj_rib_in.pop(prefix, None) is not None:
                 changed.add(prefix)
         if upd.nlri and upd.attrs is not None:
             attrs = upd.attrs
             # Loop prevention: our AS in the path -> reject.
-            if self.asn in attrs.as_path:
-                pass
-            else:
+            if self.asn not in attrs.as_path:
                 imp = peer.config.import_policy
-                for prefix in upd.nlri:
-                    a = imp(prefix, attrs) if imp else attrs
-                    if a is None:
-                        continue
-                    peer.adj_rib_in[prefix] = a
-                    changed.add(prefix)
+                if isinstance(imp, str) and self.policy_worker is not None:
+                    # Offload: evaluation happens in the worker; results
+                    # return as an EvalBatchResult message.
+                    ok = self.loop.send(
+                        self.policy_worker,
+                        EvalBatchRequest(
+                            reply_to=self.name,
+                            peer=peer.config.addr,
+                            peer_generation=peer.generation,
+                            policy_name=imp,
+                            entries=[(p, attrs) for p in upd.nlri],
+                            token=seq,
+                        ),
+                    )
+                    if not ok:
+                        # Fail-closed (reject) but never silently: a
+                        # missing/crashed worker must be operator-visible.
+                        log.error(
+                            "policy worker %r unreachable: rejecting %d "
+                            "announcements from %s",
+                            self.policy_worker, len(upd.nlri),
+                            peer.config.addr,
+                        )
+                else:
+                    for prefix in upd.nlri:
+                        a = imp(prefix, attrs) if imp else attrs
+                        if a is None:
+                            # Rejected re-announcement replaces (removes)
+                            # any previously accepted route (implicit
+                            # replace, RFC 4271 §3.1).
+                            if peer.adj_rib_in.pop(prefix, None) is not None:
+                                changed.add(prefix)
+                            continue
+                        peer.adj_rib_in[prefix] = a
+                        changed.add(prefix)
         for prefix in changed:
             self._decision(prefix)
-        if changed:
-            self._hold_timer(peer).start(peer.hold_time)
+
+    def _rx_policy_result(self, res) -> None:
+        peer = self.peers.get(res.peer)
+        if peer is None or peer.generation != res.peer_generation:
+            return  # session flapped since the request: stale
+        if peer.state != PeerState.ESTABLISHED:
+            return
+        changed = set()
+        for prefix, attrs in res.entries:
+            # A withdraw processed after this batch was requested wins.
+            if peer.last_withdraw_seq.get(prefix, -1) >= res.token:
+                continue
+            if attrs is None:
+                if peer.adj_rib_in.pop(prefix, None) is not None:
+                    changed.add(prefix)  # rejected replaces prior accept
+                continue
+            peer.adj_rib_in[prefix] = attrs
+            changed.add(prefix)
+        for prefix in changed:
+            self._decision(prefix)
 
     # -- decision process (RFC 4271 §9.1, condensed)
 

@@ -1,0 +1,77 @@
+"""BGP policy evaluation worker: the CPU-offload actor pattern.
+
+Reference: holo-bgp offloads policy application to a dedicated blocking
+worker fed over crossbeam channels (holo-bgp/src/tasks.rs:457-520,
+SURVEY.md §2.4.6) so heavy policy runs never stall the instance's event
+loop.  This is the same boundary the TPU SPF backend generalizes: ship a
+batch out, results return as input messages.
+
+``PolicyWorker`` is an actor (separate OS thread in production via the
+native MsgRing; same loop in deterministic tests) evaluating batches of
+(prefix, attrs) through the policy engine and replying to the BGP
+instance, which applies results only if the peer generation still
+matches (a peer flap between request and reply discards stale results).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from holo_tpu.utils.policy import PolicyEngine, PolicyResult, RouteContext
+from holo_tpu.utils.runtime import Actor
+
+
+@dataclass
+class EvalBatchRequest:
+    reply_to: str
+    peer: Any  # peer address
+    peer_generation: int
+    policy_name: str
+    entries: list  # [(prefix, PathAttrs)]
+    token: int = 0
+
+
+@dataclass
+class EvalBatchResult:
+    peer: Any
+    peer_generation: int
+    entries: list  # [(prefix, PathAttrs | None)]  None = rejected
+    token: int = 0
+
+
+class PolicyWorker(Actor):
+    """Evaluates policy batches; CPU-bound work isolated from protocol
+    actors (swap in a thread + MsgRing for true parallelism in prod)."""
+
+    name = "bgp-policy-worker"
+
+    def __init__(self, engine: PolicyEngine):
+        self.engine = engine
+        self.batches_processed = 0
+
+    def handle(self, msg):
+        if not isinstance(msg, EvalBatchRequest):
+            return
+        out = []
+        for prefix, attrs in msg.entries:
+            ctx = RouteContext(
+                prefix=prefix,
+                protocol="bgp",
+                metric=attrs.med,
+                local_pref=attrs.local_pref,
+            )
+            if self.engine.apply(msg.policy_name, ctx) == PolicyResult.REJECT:
+                out.append((prefix, None))
+            else:
+                from dataclasses import replace
+
+                out.append(
+                    (prefix, replace(attrs, med=ctx.metric,
+                                     local_pref=ctx.local_pref))
+                )
+        self.batches_processed += 1
+        self.loop.send(
+            msg.reply_to,
+            EvalBatchResult(msg.peer, msg.peer_generation, out, msg.token),
+        )

@@ -1,0 +1,122 @@
+"""BGP policy-worker offload: async evaluation + stale-result discard."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.bgp import BgpInstance, PeerConfig, PeerState
+from holo_tpu.protocols.bgp_worker import PolicyWorker
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.policy import PolicyEngine
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def engine():
+    e = PolicyEngine()
+    e.load_from_config(
+        {
+            "defined-sets": {
+                "prefix-set": {"blocked": {"prefix": ["203.0.113.0/24"]}},
+            },
+            "policy-definition": {
+                "edge-in": {
+                    "statement": {
+                        "drop": {
+                            "conditions": {"match-prefix-set": "blocked"},
+                            "actions": {"policy-result": "reject-route"},
+                        },
+                        "ok": {
+                            "actions": {"policy-result": "accept-route",
+                                        "set-metric": 777},
+                        },
+                    }
+                }
+            },
+        }
+    )
+    return e
+
+
+def test_worker_offload_filters_and_rewrites():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    worker = PolicyWorker(engine())
+    loop.register(worker)
+    b1 = BgpInstance("b1", 65001, A("1.1.1.1"), fabric.sender_for("b1"))
+    b2 = BgpInstance("b2", 65002, A("2.2.2.2"), fabric.sender_for("b2"),
+                     policy_worker="bgp-policy-worker")
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "b1", "e0", A("10.0.0.1"))
+    fabric.join("l", "b2", "e0", A("10.0.0.2"))
+    b1.add_peer(PeerConfig(A("10.0.0.2"), 65002, "e0"), A("10.0.0.1"))
+    # String policy name triggers the async worker path.
+    b2.add_peer(PeerConfig(A("10.0.0.1"), 65001, "e0",
+                           import_policy="edge-in"), A("10.0.0.2"))
+    b1.start_peer(A("10.0.0.2"))
+    b2.start_peer(A("10.0.0.1"))
+    loop.advance(5)
+    assert b2.peers[A("10.0.0.1")].state == PeerState.ESTABLISHED
+    b1.originate(N("203.0.113.0/24"))
+    b1.originate(N("198.51.100.0/24"))
+    loop.advance(2)
+    assert worker.batches_processed >= 1
+    assert N("203.0.113.0/24") not in b2.loc_rib  # rejected in the worker
+    best = b2.loc_rib[N("198.51.100.0/24")][0]
+    assert best.attrs.med == 777  # rewritten in the worker
+
+
+def test_stale_worker_results_discarded():
+    """A result for a flapped session generation must not be applied."""
+    from holo_tpu.protocols.bgp import PathAttrs
+    from holo_tpu.protocols.bgp_worker import EvalBatchResult
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    b = BgpInstance("b", 65001, A("1.1.1.1"), fabric.sender_for("b"),
+                    policy_worker="w")
+    loop.register(b)
+    fabric.join("l", "b", "e0", A("10.0.0.1"))
+    peer = b.add_peer(PeerConfig(A("10.0.0.9"), 65002, "e0"), A("10.0.0.1"))
+    peer.state = PeerState.ESTABLISHED
+    old_gen = peer.generation
+    # Session flaps: generation bumps.
+    b._drop_peer(peer)
+    peer.state = PeerState.ESTABLISHED  # re-established incarnation
+    loop.send("b", EvalBatchResult(
+        peer=A("10.0.0.9"), peer_generation=old_gen,
+        entries=[(N("10.5.0.0/16"), PathAttrs())],
+    ))
+    loop.run_until_idle()
+    assert N("10.5.0.0/16") not in peer.adj_rib_in  # stale: discarded
+    # Fresh-generation result applies.
+    loop.send("b", EvalBatchResult(
+        peer=A("10.0.0.9"), peer_generation=peer.generation,
+        entries=[(N("10.5.0.0/16"), PathAttrs())], token=1,
+    ))
+    loop.run_until_idle()
+    assert N("10.5.0.0/16") in peer.adj_rib_in
+
+
+def test_withdraw_beats_inflight_result():
+    """A withdraw processed after the batch was requested must win over
+    the in-flight policy result (no route resurrection)."""
+    from holo_tpu.protocols.bgp import PathAttrs
+    from holo_tpu.protocols.bgp_worker import EvalBatchResult
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    b = BgpInstance("b", 65001, A("1.1.1.1"), fabric.sender_for("b"),
+                    policy_worker="w")
+    loop.register(b)
+    fabric.join("l", "b", "e0", A("10.0.0.1"))
+    peer = b.add_peer(PeerConfig(A("10.0.0.9"), 65002, "e0"), A("10.0.0.1"))
+    peer.state = PeerState.ESTABLISHED
+    # Announcement batched at seq 1 (simulated), withdraw arrives at seq 2.
+    peer.update_seq = 2
+    peer.last_withdraw_seq[N("10.5.0.0/16")] = 2
+    loop.send("b", EvalBatchResult(
+        peer=A("10.0.0.9"), peer_generation=peer.generation,
+        entries=[(N("10.5.0.0/16"), PathAttrs())], token=1,
+    ))
+    loop.run_until_idle()
+    assert N("10.5.0.0/16") not in peer.adj_rib_in
