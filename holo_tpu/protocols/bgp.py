@@ -464,6 +464,7 @@ class BgpInstance(Actor):
     def _drop_peer(self, peer: Peer) -> None:
         peer.state = PeerState.IDLE
         peer.generation += 1  # invalidate in-flight policy-worker results
+        peer.last_withdraw_seq.clear()  # generation guard covers old batches
         withdrawn = list(peer.adj_rib_in.keys())
         peer.adj_rib_in.clear()
         peer.adj_rib_out.clear()
@@ -526,6 +527,14 @@ class BgpInstance(Actor):
             peer.last_withdraw_seq[prefix] = seq
             if peer.adj_rib_in.pop(prefix, None) is not None:
                 changed.add(prefix)
+        # Bounded memory: withdraw markers only matter while a policy batch
+        # can still be in flight; anything far behind the sequence horizon
+        # can never race a result again.
+        if len(peer.last_withdraw_seq) > 16384:
+            horizon = seq - 1024
+            peer.last_withdraw_seq = {
+                p: s for p, s in peer.last_withdraw_seq.items() if s >= horizon
+            }
         if upd.nlri and upd.attrs is not None:
             attrs = upd.attrs
             # Loop prevention: our AS in the path -> reject.
@@ -548,12 +557,27 @@ class BgpInstance(Actor):
                     if not ok:
                         # Fail-closed (reject) but never silently: a
                         # missing/crashed worker must be operator-visible.
+                        # Reject = implicit replace of any prior accept.
                         log.error(
                             "policy worker %r unreachable: rejecting %d "
                             "announcements from %s",
                             self.policy_worker, len(upd.nlri),
                             peer.config.addr,
                         )
+                        for prefix in upd.nlri:
+                            if peer.adj_rib_in.pop(prefix, None) is not None:
+                                changed.add(prefix)
+                elif isinstance(imp, str):
+                    # String policy but no worker: misconfiguration —
+                    # fail closed rather than crash the actor.
+                    log.error(
+                        "peer %s references policy %r but no policy worker "
+                        "is configured: rejecting announcements",
+                        peer.config.addr, imp,
+                    )
+                    for prefix in upd.nlri:
+                        if peer.adj_rib_in.pop(prefix, None) is not None:
+                            changed.add(prefix)
                 else:
                     for prefix in upd.nlri:
                         a = imp(prefix, attrs) if imp else attrs

@@ -18,7 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any
 
-from holo_tpu.utils.policy import PolicyEngine, PolicyResult, RouteContext
+from holo_tpu.utils.policy import PolicyEngine
 from holo_tpu.utils.runtime import Actor
 
 
@@ -53,23 +53,10 @@ class PolicyWorker(Actor):
     def handle(self, msg):
         if not isinstance(msg, EvalBatchRequest):
             return
-        out = []
-        for prefix, attrs in msg.entries:
-            ctx = RouteContext(
-                prefix=prefix,
-                protocol="bgp",
-                metric=attrs.med,
-                local_pref=attrs.local_pref,
-            )
-            if self.engine.apply(msg.policy_name, ctx) == PolicyResult.REJECT:
-                out.append((prefix, None))
-            else:
-                from dataclasses import replace
-
-                out.append(
-                    (prefix, replace(attrs, med=ctx.metric,
-                                     local_pref=ctx.local_pref))
-                )
+        # Reuse the engine's canonical per-route hook so the sync and async
+        # paths can never diverge.
+        hook = self.engine.bgp_import_hook(msg.policy_name)
+        out = [(prefix, hook(prefix, attrs)) for prefix, attrs in msg.entries]
         self.batches_processed += 1
         self.loop.send(
             msg.reply_to,

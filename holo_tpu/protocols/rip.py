@@ -1,9 +1,10 @@
-"""RIPv2 (RFC 2453): distance-vector routing.
+"""RIPv2 (RFC 2453) + RIPng (RFC 2080): distance-vector routing.
 
 Reference: holo-rip (SURVEY.md §2.3) — route table with timeout/garbage
 timers, split horizon with poisoned reverse, triggered updates, periodic
-full updates.  RIPng (RFC 2080) shares the machinery via the address
-family parameter (v6 codec lands with OSPFv3).
+full updates.  The two versions share the instance machinery through the
+version object (codec + multicast group), mirroring the reference's
+``Version`` trait (holo-rip/src/version.rs:22).
 """
 
 from __future__ import annotations
@@ -12,12 +13,15 @@ import enum
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address, IPv4Network
 
+from ipaddress import IPv6Address, IPv6Network
+
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
-from holo_tpu.utils.ip import RIPV2_GROUP, mask_of
+from holo_tpu.utils.ip import RIPNG_GROUP, RIPV2_GROUP, mask_of
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
 
 RIP_PORT = 520
+RIPNG_PORT = 521
 INFINITY_METRIC = 16
 
 
@@ -87,6 +91,94 @@ class RipPacket:
 
 
 @dataclass
+class RipngPacket:
+    """RIPng (RFC 2080 §2): v6 RTEs are (prefix 16B, tag, plen, metric).
+
+    Next-hop RTEs (metric 0xFF) are not yet emitted; receivers treat the
+    packet source (link-local) as next hop, which is the common case.
+    """
+
+    command: RipCommand
+    rtes: list = field(default_factory=list)  # [(IPv6Network, tag, metric)]
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u8(int(self.command)).u8(1).u16(0)  # version 1
+        for prefix, tag, metric in self.rtes:
+            w.ipv6(prefix.network_address)
+            w.u16(tag).u8(prefix.prefixlen).u8(metric)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RipngPacket":
+        r = Reader(data)
+        try:
+            cmd = RipCommand(r.u8())
+        except ValueError as e:
+            raise DecodeError("unknown RIPng command") from e
+        if r.u8() != 1:
+            raise DecodeError("unsupported RIPng version")
+        r.u16()
+        rtes = []
+        while r.remaining() >= 20:
+            addr = r.ipv6()
+            tag = r.u16()
+            plen = r.u8()
+            metric = r.u8()
+            if metric == 0xFF:
+                # Next-hop RTE (RFC 2080 §2.1.1): sets the next hop for
+                # following RTEs; not an error.  We currently use the
+                # packet source as next hop, so it is skipped.
+                continue
+            if plen > 128 or not 1 <= metric <= INFINITY_METRIC:
+                raise DecodeError("bad RIPng RTE")
+            masked = int(addr) & ~((1 << (128 - plen)) - 1) if plen < 128 else int(addr)
+            rtes.append((IPv6Network((masked, plen)), tag, metric))
+        return cls(cmd, rtes)
+
+
+class RipVersion:
+    """Version strategy: v2 (IPv4) — reference version.rs Ripv2 arm."""
+
+    name = "ripv2"
+    group = RIPV2_GROUP
+
+    @staticmethod
+    def encode(command, entries) -> bytes:
+        return RipPacket(
+            command,
+            [Rte(prefix, IPv4Address(0), metric, tag)
+             for prefix, tag, metric in entries],
+        ).encode()
+
+    @staticmethod
+    def decode(data: bytes):
+        pkt = RipPacket.decode(data)
+        return pkt.command, [
+            (r.prefix, r.tag, r.metric, r.nexthop if int(r.nexthop) else None)
+            for r in pkt.rtes
+        ]
+
+
+class RipngVersion:
+    """Version strategy: RIPng (IPv6) — reference version.rs Ripng arm."""
+
+    name = "ripng"
+    group = RIPNG_GROUP
+
+    @staticmethod
+    def encode(command, entries) -> bytes:
+        return RipngPacket(command, list(entries)).encode()
+
+    @staticmethod
+    def decode(data: bytes):
+        pkt = RipngPacket.decode(data)
+        return pkt.command, [
+            (prefix, tag, metric, None) for prefix, tag, metric in pkt.rtes
+        ]
+
+
+@dataclass
 class RipRoute:
     prefix: IPv4Network
     nexthop: IPv4Address | None  # None = connected
@@ -132,9 +224,11 @@ class RipInstance(Actor):
         timeout: float = 180.0,
         garbage: float = 120.0,
         route_cb=None,
+        version=RipVersion,
     ):
         self.name = name
         self.netio = netio
+        self.V = version
         self.update_interval = update_interval
         self.timeout = timeout
         self.garbage = garbage
@@ -183,25 +277,25 @@ class RipInstance(Actor):
         if msg.src == our_addr:
             return
         try:
-            pkt = RipPacket.decode(msg.data)
+            command, entries = self.V.decode(msg.data)
         except DecodeError:
             return
-        if pkt.command != RipCommand.RESPONSE:
+        if command != RipCommand.RESPONSE:
             return
         now = self.loop.clock.now()
         changed_any = False
-        for rte in pkt.rtes:
-            metric = min(rte.metric + cfg.cost, INFINITY_METRIC)
-            nh = msg.src if int(rte.nexthop) == 0 else rte.nexthop
-            cur = self.routes.get(rte.prefix)
+        for prefix, tag, rte_metric, rte_nh in entries:
+            metric = min(rte_metric + cfg.cost, INFINITY_METRIC)
+            nh = rte_nh if rte_nh is not None else msg.src
+            cur = self.routes.get(prefix)
             if cur is None:
                 if metric < INFINITY_METRIC:
-                    self.routes[rte.prefix] = RipRoute(
-                        prefix=rte.prefix,
+                    self.routes[prefix] = RipRoute(
+                        prefix=prefix,
                         nexthop=nh,
                         ifname=msg.ifname,
                         metric=metric,
-                        tag=rte.tag,
+                        tag=tag,
                         timeout_at=now + self.timeout,
                     )
                     changed_any = True
@@ -232,7 +326,7 @@ class RipInstance(Actor):
 
     def _send_updates(self, changed_only: bool) -> None:
         for ifname, (cfg, our_addr, _prefix) in self.interfaces.items():
-            rtes = []
+            entries = []
             for route in self.routes.values():
                 if changed_only and not route.changed:
                     continue
@@ -242,12 +336,10 @@ class RipInstance(Actor):
                         continue
                     if cfg.split_horizon == "poison-reverse":
                         metric = INFINITY_METRIC
-                rtes.append(
-                    Rte(route.prefix, IPv4Address(0), metric, route.tag)
-                )
-            for i in range(0, len(rtes), 25):
-                pkt = RipPacket(RipCommand.RESPONSE, rtes[i : i + 25])
-                self.netio.send(ifname, our_addr, RIPV2_GROUP, pkt.encode())
+                entries.append((route.prefix, route.tag, metric))
+            for i in range(0, len(entries), 25):
+                data = self.V.encode(RipCommand.RESPONSE, entries[i : i + 25])
+                self.netio.send(ifname, our_addr, self.V.group, data)
         for route in self.routes.values():
             route.changed = False
 

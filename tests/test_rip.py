@@ -75,6 +75,41 @@ def test_split_horizon_poison_reverse():
     assert poisoned, "learned route not poisoned back toward its source"
 
 
+def test_ripng_v6_chain_propagation():
+    """RIPng: same machinery, v6 codec + group (RFC 2080)."""
+    from ipaddress import IPv6Address as A6
+    from ipaddress import IPv6Network as N6
+
+    from holo_tpu.protocols.rip import RipngPacket, RipngVersion
+
+    # codec roundtrip
+    pkt = RipngPacket(RipCommand.RESPONSE, [(N6("2001:db8:1::/48"), 7, 3)])
+    out = RipngPacket.decode(pkt.encode())
+    assert out.rtes == [(N6("2001:db8:1::/48"), 7, 3)]
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    routers = []
+    for i in range(3):
+        r = RipInstance(f"rng{i}", fabric.sender_for(f"rng{i}"),
+                        version=RipngVersion)
+        loop.register(r)
+        routers.append(r)
+    for i in range(2):
+        net = N6(f"2001:db8:{i}::/64")
+        a1, a2 = A6(f"fe80::{i}:1"), A6(f"fe80::{i}:2")
+        routers[i].add_interface(f"e{i}r", RipIfConfig(), a1, net)
+        routers[i + 1].add_interface(f"e{i}l", RipIfConfig(), a2, net)
+        fabric.join(f"l{i}", f"rng{i}", f"e{i}r", a1)
+        fabric.join(f"l{i}", f"rng{i+1}", f"e{i}l", a2)
+    loop.advance(70)
+    route = routers[0].routes.get(N6("2001:db8:1::/64"))
+    assert route is not None and route.metric == 2
+    assert route.nexthop == A6("fe80::0:2")  # learned via link-local source
+    route = routers[2].routes.get(N6("2001:db8:0::/64"))
+    assert route is not None and route.metric == 2
+
+
 def test_timeout_and_garbage_collection():
     loop = EventLoop(clock=VirtualClock())
     fabric = MockFabric(loop)
