@@ -284,6 +284,10 @@ class RoutingProvider(Provider, Actor):
                         f"ospfv3 interface {ifname}: authentication is not "
                         "supported yet (RFC 4552 IPsec pending)"
                     )
+        if new_tree.get("routing/control-plane-protocols/ospfv3/redistribute"):
+            raise CommitError(
+                "ospfv3 redistribution is not supported yet"
+            )
 
     def __init__(
         self,
@@ -318,9 +322,16 @@ class RoutingProvider(Provider, Actor):
             TOPIC_KEYCHAIN_UPD,
         )
 
+        from holo_tpu.utils.ibus import (
+            TOPIC_REDISTRIBUTE_ADD,
+            TOPIC_REDISTRIBUTE_DEL,
+        )
+
         self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
         self.ibus.subscribe(TOPIC_KEYCHAIN_UPD, self.name)
         self.ibus.subscribe(TOPIC_KEYCHAIN_DEL, self.name)
+        self.ibus.subscribe(TOPIC_REDISTRIBUTE_ADD, self.name)
+        self.ibus.subscribe(TOPIC_REDISTRIBUTE_DEL, self.name)
         # BFD is always-on, spawned at startup inside the routing provider
         # (reference holo-routing/src/lib.rs:261-281).
         from holo_tpu.protocols.bfd import BfdInstance
@@ -338,6 +349,17 @@ class RoutingProvider(Provider, Actor):
             IbusMsg,
         )
 
+        from holo_tpu.utils.ibus import (
+            TOPIC_REDISTRIBUTE_ADD,
+            TOPIC_REDISTRIBUTE_DEL,
+        )
+
+        if isinstance(msg, IbusMsg) and msg.topic in (
+            TOPIC_REDISTRIBUTE_ADD,
+            TOPIC_REDISTRIBUTE_DEL,
+        ):
+            self._handle_redistribution(msg)
+            return
         if isinstance(msg, IbusMsg) and msg.topic in (
             TOPIC_KEYCHAIN_UPD,
             TOPIC_KEYCHAIN_DEL,
@@ -374,6 +396,33 @@ class RoutingProvider(Provider, Actor):
         self._apply_isis(new)
         self._apply_bgp(new)
         self._apply_static(new)
+
+    def _handle_redistribution(self, msg) -> None:
+        """RIB redistribution → OSPF type-5 origination (reference:
+        redistribution pub/sub, holo-routing/src/rib.rs:71)."""
+        from holo_tpu.utils.ibus import TOPIC_REDISTRIBUTE_ADD
+        from holo_tpu.utils.southbound import Protocol
+
+        inst = self.instances.get("ospfv2")
+        wanted = getattr(self, "_ospf_redistribute", set())
+        if inst is None:
+            return
+        payload = msg.payload
+        proto = payload.protocol
+        if proto in (Protocol.OSPFV2,):
+            return  # never re-inject our own routes
+        if payload.prefix.version != 4:
+            return
+        if msg.topic == TOPIC_REDISTRIBUTE_ADD:
+            if proto.value in wanted:
+                inst.redistribute(payload.prefix, metric=max(payload.metric, 1))
+            elif payload.prefix in inst.redistributed:
+                # Best route switched to a non-redistributed protocol: the
+                # type-5 must go (the RIB only publishes DEL on full
+                # removal, so the ADD with the new winner is our signal).
+                inst.withdraw_redistributed(payload.prefix)
+        else:
+            inst.withdraw_redistributed(payload.prefix)
 
     def _refresh_ospf_auth(self) -> None:
         tree = getattr(self, "_last_tree", None)
@@ -421,6 +470,9 @@ class RoutingProvider(Provider, Actor):
         )
         backend_name = spf.get("backend", "scalar")
         backend = TpuSpfBackend() if backend_name == "tpu" else ScalarSpfBackend()
+        old_redist = getattr(self, "_ospf_redistribute", set())
+        self._ospf_redistribute = set(new.get(f"{base}/redistribute") or [])
+        redist_changed = old_redist != self._ospf_redistribute
         if inst is None:
             inst = OspfInstance(
                 name=f"{self.prefix}ospfv2",
@@ -469,6 +521,29 @@ class RoutingProvider(Provider, Actor):
                 )
                 inst.add_interface(ifname, cfg, addr, host)
                 self.loop.send(inst.name, IfUpMsg(ifname))
+        if redist_changed:
+            self._reconcile_redistribution(inst)
+
+    def _reconcile_redistribution(self, inst) -> None:
+        """Replay the RIB against a changed redistribute set: inject
+        now-wanted active routes, withdraw no-longer-wanted type-5s."""
+        from holo_tpu.utils.southbound import Protocol
+
+        wanted = self._ospf_redistribute
+        active = self.rib.active_routes()
+        backed: set = set()
+        for prefix, routemsg in active.items():
+            if prefix.version != 4:
+                continue
+            if (
+                routemsg.protocol.value in wanted
+                and routemsg.protocol != Protocol.OSPFV2
+            ):
+                backed.add(prefix)
+                inst.redistribute(prefix, metric=max(routemsg.metric, 1))
+        for prefix in list(inst.redistributed.keys()):
+            if prefix not in backed:
+                inst.withdraw_redistributed(prefix)
 
     def _ospf_auth(self, auth_conf):
         """Build an AuthCtx from interface auth config, resolving keychain

@@ -153,6 +153,16 @@ class Area:
     interfaces: dict[str, OspfInterface] = field(default_factory=dict)
 
 
+@dataclass
+class ExternalRoute:
+    """A route this ASBR redistributes into OSPF (→ type-5 LSA)."""
+
+    prefix: IPv4Network
+    metric: int = 20
+    e2: bool = True  # type-2 external metric (default, like the reference)
+    tag: int = 0
+
+
 class OspfInstance(Actor):
     """One OSPFv2 routing process."""
 
@@ -196,6 +206,10 @@ class OspfInstance(Actor):
         self._spf_trigger_count = 0
         self.ibus = None  # set via attach_ibus for RIB integration
         self.routing_actor = "routing"
+        # Externals we originate (type 5; stored in every area's LSDB with
+        # install-time cross-area propagation = AS flooding scope).
+        self.redistributed: dict[IPv4Network, ExternalRoute] = {}
+        self._external_lsids: dict[IPv4Network, IPv4Address] = {}
 
     def attach_ibus(
         self, ibus, routing_actor: str = "routing", bfd_actor: str = "bfd"
@@ -219,12 +233,17 @@ class OspfInstance(Actor):
         addr: IPv4Network,
         addr_ip: IPv4Address,
     ) -> OspfInterface:
+        new_area = cfg.area_id not in self.areas
         area = self.areas.setdefault(cfg.area_id, Area(cfg.area_id))
         iface = OspfInterface(
             name=ifname, config=cfg, addr_ip=addr_ip, prefix=addr
         )
         area.interfaces[ifname] = iface
         self._if_area[ifname] = cfg.area_id
+        if new_area and self.redistributed:
+            # AS-scope LSAs must exist in every area, incl. late-attached.
+            for prefix in list(self.redistributed):
+                self._originate_external(prefix)
         return iface
 
     def _iface(self, ifname: str) -> tuple[Area, OspfInterface] | None:
@@ -446,6 +465,151 @@ class OspfInstance(Actor):
                     self._run_dr_election(area, iface)
             elif (nbr.priority, nbr.dr, nbr.bdr) != prev:
                 self._run_dr_election(area, iface)
+
+    # ----- AS-external routes (type 5, §12.4.4 / §16.4)
+
+    @property
+    def is_asbr(self) -> bool:
+        return bool(self.redistributed)
+
+    def _external_lsid(self, prefix: IPv4Network) -> IPv4Address:
+        """Appendix E link-state-id assignment for type-5 LSAs: prefixes
+        sharing a network address get host bits set so keys stay unique."""
+        from holo_tpu.utils.ip import mask_of
+
+        cur = self._external_lsids.get(prefix)
+        if cur is not None:
+            return cur
+        net = prefix.network_address
+        taken = set(self._external_lsids.values())
+        lsid = net
+        if lsid in taken:
+            lsid = IPv4Address(int(net) | (~int(mask_of(prefix)) & 0xFFFFFFFF))
+        self._external_lsids[prefix] = lsid
+        return lsid
+
+    def redistribute(
+        self,
+        prefix: IPv4Network,
+        metric: int = 20,
+        e2: bool = True,
+        tag: int = 0,
+    ) -> None:
+        """ASBR: inject an external route as a type-5 LSA (AS scope — one
+        copy per area LSDB, kept consistent by install-time propagation)."""
+        was_asbr = self.is_asbr
+        self.redistributed[prefix] = ExternalRoute(prefix, metric, e2, tag)
+        self._originate_external(prefix)
+        if not was_asbr:
+            for area in self.areas.values():
+                self._originate_router_lsa(area)  # E flag
+
+    def _originate_external(self, prefix: IPv4Network) -> None:
+        from holo_tpu.protocols.ospf.packet import LsaAsExternal
+        from holo_tpu.utils.ip import mask_of
+
+        route = self.redistributed[prefix]
+        body = LsaAsExternal(
+            mask=mask_of(prefix), e_bit=route.e2, metric=route.metric,
+            fwd_addr=IPv4Address(0), tag=route.tag,
+        )
+        lsid = self._external_lsid(prefix)
+        for area in self.areas.values():
+            self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
+
+    def withdraw_redistributed(self, prefix: IPv4Network) -> None:
+        if self.redistributed.pop(prefix, None) is None:
+            return
+        lsid = self._external_lsids.pop(prefix, prefix.network_address)
+        key = LsaKey(LsaType.AS_EXTERNAL, lsid, self.config.router_id)
+        for area in self.areas.values():
+            self._flush_self_lsa(area, key)
+        if not self.is_asbr:
+            for area in self.areas.values():
+                self._originate_router_lsa(area)
+
+    def _propagate_external(self, from_area: Area, lsa: Lsa) -> None:
+        """AS scope: a type-5 installed in one area is installed (and thus
+        flooded) into every other area by ABRs."""
+        for area in self.areas.values():
+            if area is from_area:
+                continue
+            cur = area.lsdb.get(lsa.key)
+            if cur is None or lsa.compare(cur.lsa) > 0:
+                self._install_and_flood(area, lsa)
+
+    def _asbr_distance(self, aid, st, res, asbr: IPv4Address, now: float):
+        """Distance + next hops to an ASBR within one area — directly if
+        it is in this area's SPF, else via a type-4 ASBR-summary from a
+        reachable ABR (§16.4 step 3)."""
+        from holo_tpu.protocols.ospf.spf_run import _atoms_of
+
+        v = st.router_index.get(asbr)
+        if v is not None and res.dist[v] < 0x40000000:
+            return int(res.dist[v]), _atoms_of(res.nexthop_words[v], st.atoms)
+        best = None
+        area = self.areas[aid]
+        for e in area.lsdb.all():
+            lsa = e.lsa
+            if (
+                lsa.type != LsaType.SUMMARY_ROUTER
+                or lsa.lsid != asbr
+                or lsa.adv_rtr == self.config.router_id
+                or e.current_age(now) >= MAX_AGE
+            ):
+                continue
+            abr_v = st.router_index.get(lsa.adv_rtr)
+            if abr_v is None or res.dist[abr_v] >= 0x40000000:
+                continue
+            dist = int(res.dist[abr_v]) + lsa.body.metric
+            if best is None or dist < best[0]:
+                best = (dist, _atoms_of(res.nexthop_words[abr_v], st.atoms))
+        return best if best is not None else (None, None)
+
+    def _external_routes(self, area_results: dict, known: set) -> dict:
+        """§16.4 condensed: E1 = dist(ASBR)+metric; E2 ranked by (metric,
+        dist(ASBR)) after all internal paths; intra/inter always win."""
+        best: dict = {}
+        now = self.loop.clock.now()
+        for aid, (st, res) in area_results.items():
+            area = self.areas[aid]
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.AS_EXTERNAL
+                    or lsa.adv_rtr == self.config.router_id
+                    or e.current_age(now) >= MAX_AGE
+                    or lsa.body.metric >= 0xFFFFFF
+                ):
+                    continue
+                asbr_dist, nhs = self._asbr_distance(
+                    aid, st, res, lsa.adv_rtr, now
+                )
+                if asbr_dist is None:
+                    continue
+                from holo_tpu.protocols.ospf.spf_run import IntraRoute
+                from holo_tpu.utils.ip import apply_mask
+
+                prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                if prefix in known:
+                    continue  # internal paths always preferred
+                # Ranking key: E1 before E2; E1 by total; E2 by (metric,
+                # asbr dist).
+                if lsa.body.e_bit:
+                    rank = (1, lsa.body.metric, asbr_dist)
+                    dist = lsa.body.metric
+                else:
+                    rank = (0, asbr_dist + lsa.body.metric, 0)
+                    dist = asbr_dist + lsa.body.metric
+                cur = best.get(prefix)
+                if cur is None or rank < cur[0]:
+                    best[prefix] = (rank, IntraRoute(prefix, dist, nhs, aid))
+                elif rank == cur[0]:
+                    merged = IntraRoute(
+                        prefix, dist, cur[1].nexthops | nhs, aid
+                    )
+                    best[prefix] = (rank, merged)
+        return {p: r for p, (rank, r) in best.items()}
 
     # ----- graceful restart (RFC 3623)
 
@@ -933,6 +1097,8 @@ class OspfInstance(Actor):
             self._schedule_spf()
         if lsa.adv_rtr != self.config.router_id:
             self._maybe_enter_gr_helper(area, lsa)
+        if lsa.type == LsaType.AS_EXTERNAL and changed and len(self.areas) > 1:
+            self._propagate_external(area, lsa)
         # Link-local opaque LSAs (type 9) never leave their link: received
         # copies are not re-flooded at all; self-originated ones go out on
         # the originating interface only (RFC 5250 §3).
@@ -1131,7 +1297,11 @@ class OspfInstance(Actor):
                                    iface.prefix.network_address,
                                    mask_of(iface.prefix), cost)
                     )
-        flags = RouterFlags.B if self.is_abr else RouterFlags(0)
+        flags = RouterFlags(0)
+        if self.is_abr:
+            flags |= RouterFlags.B
+        if self.is_asbr:
+            flags |= RouterFlags.E
         body = LsaRouter(flags=flags, links=links)
         self._originate(area, LsaType.ROUTER, self.config.router_id, body)
 
@@ -1307,8 +1477,15 @@ class OspfInstance(Actor):
         # ABR: (re-)originate Summary LSAs — each area's intra routes are
         # advertised into every other attached area (loop-free: summaries
         # are never derived from summaries).
+        # AS-external routes (lowest preference — only for unknown prefixes).
+        for prefix, route in self._external_routes(
+            area_results, set(all_routes.keys())
+        ).items():
+            all_routes[prefix] = route
+
         if self.is_abr:
             self._originate_summaries(area_intra, inter_routes)
+            self._originate_asbr_summaries(area_results)
         else:
             # No longer (or never) an ABR: flush any self-originated
             # summaries or neighbors would route into a dead hierarchy
@@ -1398,6 +1575,56 @@ class OspfInstance(Actor):
                     LsaType.SUMMARY_NETWORK,
                     lsid_of[prefix],
                     LsaSummary(mask_of(prefix), dist),
+                )
+
+    def _originate_asbr_summaries(self, area_results: dict) -> None:
+        """ABR: type-4 ASBR-summary LSAs (§12.4.3) so other areas can
+        resolve ASBRs they cannot see in their own SPF."""
+        from holo_tpu.protocols.ospf.packet import LsaSummary
+
+        now = self.loop.clock.now()
+        # ASBRs reachable per area: routers whose router-LSA carries E.
+        asbr_dist: dict[IPv4Address, tuple[IPv4Address, int]] = {}
+        for aid, (st, res) in area_results.items():
+            area = self.areas[aid]
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.ROUTER
+                    or not (lsa.body.flags & RouterFlags.E)
+                    or lsa.adv_rtr == self.config.router_id
+                    or e.current_age(now) >= MAX_AGE
+                ):
+                    continue
+                v = st.router_index.get(lsa.adv_rtr)
+                if v is None or res.dist[v] >= 0x40000000:
+                    continue
+                d = int(res.dist[v])
+                cur = asbr_dist.get(lsa.adv_rtr)
+                if cur is None or d < cur[1]:
+                    asbr_dist[lsa.adv_rtr] = (aid, d)
+        wanted_per_area: dict[IPv4Address, dict] = {
+            aid: {} for aid in self.areas
+        }
+        for asbr, (src_aid, d) in asbr_dist.items():
+            for dst_aid in self.areas:
+                if dst_aid != src_aid:
+                    wanted_per_area[dst_aid][asbr] = d
+        zero_mask = IPv4Address(0)
+        for aid, wanted in wanted_per_area.items():
+            area = self.areas[aid]
+            for key in list(area.lsdb.entries):
+                if (
+                    key.type == LsaType.SUMMARY_ROUTER
+                    and key.adv_rtr == self.config.router_id
+                    and key.lsid not in wanted
+                    and not area.lsdb.entries[key].lsa.is_maxage
+                ):
+                    self._flush_self_lsa(area, key)
+            for asbr, d in wanted.items():
+                self._originate(
+                    area, LsaType.SUMMARY_ROUTER, asbr,
+                    LsaSummary(zero_mask, d),
                 )
 
     def _finish_spf(self, all_routes: dict) -> None:

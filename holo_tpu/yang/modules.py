@@ -114,6 +114,7 @@ def _ospf_subtree(name):
         name,
         _leaf("router-id", "ip"),
         _leaf("enabled", "boolean", default=True),
+        LeafList("redistribute", "string"),  # protocols to inject as type-5
         _spf_control(),
         L(
             "area",

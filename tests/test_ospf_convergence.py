@@ -298,6 +298,103 @@ def test_three_area_hierarchy_chained_abrs():
     assert route is not None and route.dist == 18
 
 
+def test_external_routes_type5():
+    """r3 (ASBR) redistributes a prefix; r1 learns it as an E2 external
+    via type-5 flooding, with next hops toward the ASBR."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")
+    p2p_link(fabric, "l12", r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2",
+             "10.0.12.0/30", cost=10)
+    p2p_link(fabric, "l23", r2, "e1", "10.0.23.1", r3, "e0", "10.0.23.2",
+             "10.0.23.0/30", cost=5)
+    bring_up(loop, [r1, r2, r3])
+
+    r3.redistribute(N("203.0.113.0/24"), metric=20)
+    loop.advance(30)
+    route = r1.routes.get(N("203.0.113.0/24"))
+    assert route is not None, "external route missing at r1"
+    assert route.dist == 20  # E2: metric, internal cost breaks ties
+    assert {(nh.ifname, str(nh.addr)) for nh in route.nexthops} == {
+        ("e0", "10.0.12.2")
+    }
+    # ASBR flag set in r3's router LSA.
+    from holo_tpu.protocols.ospf.packet import LsaKey, LsaType, RouterFlags
+
+    e = r1.areas[AREA0].lsdb.get(
+        LsaKey(LsaType.ROUTER, A("3.3.3.3"), A("3.3.3.3"))
+    )
+    assert e is not None and e.lsa.body.flags & RouterFlags.E
+
+    # Withdrawal flushes the type-5 and removes the route everywhere.
+    r3.withdraw_redistributed(N("203.0.113.0/24"))
+    loop.advance(30)
+    assert N("203.0.113.0/24") not in r1.routes
+
+
+def test_external_across_areas_type4():
+    """ASBR in area 1, consumer in area 0: the ABR's type-4 ASBR-summary
+    lets area-0 routers resolve the ASBR and use the type-5 route."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")  # area 0 only
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")  # ABR
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")  # ASBR, area 1 only
+    cfg0 = IfConfig(area_id=AREA0, if_type=IfType.POINT_TO_POINT, cost=10)
+    cfg1 = IfConfig(area_id=A("0.0.0.1"), if_type=IfType.POINT_TO_POINT, cost=5)
+    r1.add_interface("e0", cfg0, N("10.0.12.0/30"), A("10.0.12.1"))
+    r2.add_interface("e0", cfg0, N("10.0.12.0/30"), A("10.0.12.2"))
+    r2.add_interface("e1", cfg1, N("10.0.23.0/30"), A("10.0.23.1"))
+    r3.add_interface("e0", cfg1, N("10.0.23.0/30"), A("10.0.23.2"))
+    fabric.join("l12", "r1", "e0", A("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", A("10.0.12.2"))
+    fabric.join("l23", "r2", "e1", A("10.0.23.1"))
+    fabric.join("l23", "r3", "e0", A("10.0.23.2"))
+    bring_up(loop, [r1, r2, r3], seconds=90)
+
+    r3.redistribute(N("203.0.113.0/24"), metric=20)
+    loop.advance(60)
+    route = r1.routes.get(N("203.0.113.0/24"))
+    assert route is not None, "cross-area external missing (type-4 path)"
+    assert {(nh.ifname, str(nh.addr)) for nh in route.nexthops} == {
+        ("e0", "10.0.12.2")
+    }
+    # Appendix E: two externals sharing a network address coexist.
+    r3.redistribute(N("203.0.113.0/25"), metric=30)
+    loop.advance(60)
+    assert N("203.0.113.0/24") in r1.routes
+    assert N("203.0.113.0/25") in r1.routes
+    r3.withdraw_redistributed(N("203.0.113.0/25"))
+    loop.advance(60)
+    assert N("203.0.113.0/24") in r1.routes  # /24 survives /25 withdrawal
+    assert N("203.0.113.0/25") not in r1.routes
+
+
+def test_daemon_redistribute_static_into_ospf():
+    """Config-driven: d2 redistributes a static route; d1's RIB learns it
+    through OSPF."""
+    loop, fabric, d1, d2 = __import__("tests.test_daemon",
+                                      fromlist=["two_daemon_setup"]
+                                      ).two_daemon_setup()
+    from tests.test_daemon import configure
+
+    configure(d1, "1.1.1.1", "10.0.12.1/30")
+    configure(d2, "2.2.2.2", "10.0.12.2/30")
+    cand = d2.candidate()
+    cand.set("routing/control-plane-protocols/ospfv2/redistribute", ["static"])
+    cand.set(
+        "routing/control-plane-protocols/static-routes/route[198.51.100.0/24]/next-hop",
+        "192.0.2.254",
+    )
+    d2.commit(cand)
+    loop.advance(60)
+    rib1 = d1.routing.rib.active_routes()
+    assert N("198.51.100.0/24") in rib1
+    assert rib1[N("198.51.100.0/24")].protocol.value == "ospfv2"
+
+
 def test_ecmp_on_equal_cost_paths():
     """Two equal-cost paths r1->r4 must produce two next hops."""
     loop = EventLoop(clock=VirtualClock())
