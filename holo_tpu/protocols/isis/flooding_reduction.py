@@ -1,0 +1,66 @@
+"""IS-IS flooding reduction.
+
+Reference: holo-isis/src/flooding/manet.rs:24-176 + SURVEY.md §2.3 — after
+each full SPF, per-neighbor hop-count SPTs (a multi-root batch on the SPF
+backend — the root-agnostic requirement of holo-isis/src/spf.rs:520-526)
+drive pruning of redundant LSP transmissions.
+
+Pruning rule (sound): when re-flooding an LSP received from neighbor f,
+skip neighbors adjacent to f — f floods its own neighborhood.  Proof that
+every router still receives every LSP: consider the first neighbor y of
+any router n to receive the LSP, with sender z.  If z were adjacent to n,
+z would have received before y (contradiction with y first), so z is not
+adjacent to n, hence y does not suppress n.  Self-originated LSPs always
+flood everywhere (they have no sender).
+
+As defense against stale coverage during topology-change windows, p2p
+interfaces send periodic CSNPs while reduction is enabled (LAN already
+has DIS CSNPs), so any suppressed-in-error LSP is recovered.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from holo_tpu.ops.graph import Topology
+
+
+def hop_topology(topo: Topology) -> Topology:
+    """Same graph with unit costs (distances = hop counts), memoized per
+    topology generation so backend device caches stay warm."""
+    cached = getattr(topo, "_hop_cache", None)
+    if cached is not None and cached[0] == topo.generation:
+        return cached[1]
+    t = Topology(
+        n_vertices=topo.n_vertices,
+        is_router=topo.is_router,
+        edge_src=topo.edge_src,
+        edge_dst=topo.edge_dst,
+        edge_cost=np.ones(topo.n_edges, np.int32),
+        edge_direct_atom=topo.edge_direct_atom,
+        root=topo.root,
+    )
+    topo._hop_cache = (topo.generation, t)
+    return t
+
+
+def neighbor_coverage(
+    topo: Topology,
+    backend,
+    neighbor_vertices: list[int],
+) -> dict[int, set[int]]:
+    """coverage[m] = set of our neighbors adjacent to neighbor m.
+
+    Computed from per-neighbor hop-count SPTs (dist == 1) via one
+    multi-root backend batch.
+    """
+    if len(neighbor_vertices) <= 1:
+        return {v: set() for v in neighbor_vertices}
+    roots = np.array(neighbor_vertices, np.int32)
+    res = backend.compute_multiroot(hop_topology(topo), roots)
+    out: dict[int, set[int]] = {}
+    for j, m in enumerate(neighbor_vertices):
+        out[m] = {
+            n for n in neighbor_vertices if n != m and res.dist[j, n] == 1
+        }
+    return out

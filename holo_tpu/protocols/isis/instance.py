@@ -180,6 +180,11 @@ class IsisInstance(Actor):
         self.routes: dict[IPv4Network, tuple] = {}
         self.spf_run_count = 0
         self._spf_pending = False
+        # Flooding reduction: per-sender coverage map rebuilt after each
+        # full SPF (reference flooding/manet.rs).  _covered_by[sender
+        # sysid] = iface names whose neighbor is adjacent to that sender.
+        self.flooding_reduction = False
+        self._covered_by: dict[bytes, set[str]] = {}
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -378,13 +383,20 @@ class IsisInstance(Actor):
             self._adj_changed()
 
     def _send_periodic_csnp(self, ifname: str) -> None:
-        """DIS duty: periodic CSNPs make LAN flooding reliable (implicit
-        acks; receivers request/flood differences)."""
+        """Periodic CSNPs: DIS duty on LANs (10s); on p2p circuits only
+        while flooding reduction is enabled (30s) — the recovery net for
+        stale-coverage suppression windows."""
         iface = self.interfaces.get(ifname)
-        if iface is None or not iface.we_are_dis(self.sysid, iface.circuit_id):
+        if iface is None:
             return
-        self._send_csnp(iface)
-        iface._csnp_timer.start(10.0)
+        if iface.is_lan:
+            if not iface.we_are_dis(self.sysid, iface.circuit_id):
+                return
+            self._send_csnp(iface)
+            iface._csnp_timer.start(10.0)
+        elif self.flooding_reduction and iface.up_adjacencies():
+            self._send_csnp(iface)
+            iface._csnp_timer.start(30.0)
 
     def _flush_pseudonode(self, iface: IsisInterface) -> None:
         lsp_id = LspId(self.sysid, pseudonode=iface.circuit_id)
@@ -442,6 +454,14 @@ class IsisInstance(Actor):
         self._send_csnp(iface)
         for lid in self.lsdb:
             iface.srm.add(lid)
+        if self.flooding_reduction and not iface.is_lan:
+            t = getattr(iface, "_csnp_timer", None)
+            if t is None:
+                t = self.loop.timer(
+                    self.name, lambda n=iface.name: CsnpTimerMsg(n)
+                )
+                iface._csnp_timer = t
+            t.start(30.0)
         self._arm_flood()
         self._adj_changed()
 
@@ -530,6 +550,16 @@ class IsisInstance(Actor):
     def _install_lsp(self, lsp: Lsp, flood_from: str | None) -> None:
         now = self.loop.clock.now()
         self.lsdb[lsp.lsp_id] = LspEntry(lsp, now)
+        # Flooding reduction: interfaces whose neighbor the SENDER also
+        # covers (sound: the sender floods its own neighborhood; periodic
+        # CSNPs recover stale-coverage windows).
+        suppressed: set[str] = set()
+        if self.flooding_reduction and flood_from is not None:
+            sender_iface = self.interfaces.get(flood_from)
+            if sender_iface is not None and sender_iface.adj is not None:
+                suppressed = self._covered_by.get(
+                    sender_iface.adj.sysid, set()
+                )
         for iface in self.interfaces.values():
             if not iface.up_adjacencies():
                 continue
@@ -537,6 +567,8 @@ class IsisInstance(Actor):
                 iface.srm.discard(lsp.lsp_id)
                 if not iface.is_lan:
                     iface.ssn.add(lsp.lsp_id)  # p2p ack via PSNP
+            elif iface.name in suppressed:
+                continue
             else:
                 iface.srm.add(lsp.lsp_id)
         self._arm_flood()
@@ -769,6 +801,34 @@ class IsisInstance(Actor):
         topo.touch()
 
         res = self.backend.compute(topo)
+
+        # Flooding-reduction cache rebuild (reference spf.rs:763-779):
+        # per-neighbor hop-count SPTs via one multi-root batch.
+        if self.flooding_reduction:
+            from holo_tpu.protocols.isis.flooding_reduction import (
+                neighbor_coverage,
+            )
+
+            nbr_vertex_by_iface = {}
+            iface_by_vertex = {}
+            sysid_by_vertex = {}
+            for iface in self.interfaces.values():
+                if iface.is_lan or iface.adj is None:
+                    continue
+                v = index.get(iface.adj.sysid + b"\x00")
+                if v is not None and iface.adj.state == AdjacencyState.UP:
+                    nbr_vertex_by_iface[iface.name] = v
+                    iface_by_vertex[v] = iface.name
+                    sysid_by_vertex[v] = iface.adj.sysid
+            self._covered_by = {}
+            if len(nbr_vertex_by_iface) > 1:
+                cov = neighbor_coverage(
+                    topo, self.backend, list(nbr_vertex_by_iface.values())
+                )
+                for m, others in cov.items():
+                    self._covered_by[sysid_by_vertex[m]] = {
+                        iface_by_vertex[n] for n in others
+                    }
 
         routes: dict[IPv4Network, tuple] = {}
         for k, node in nodes.items():

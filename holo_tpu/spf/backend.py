@@ -80,6 +80,21 @@ class ScalarSpfBackend(SpfBackend):
     def compute_whatif(self, topo, edge_masks):
         return [self._one(topo, m) for m in edge_masks]
 
+    def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
+        import copy
+
+        dists, parents, hops = [], [], []
+        for r in roots:
+            t = copy.copy(topo)
+            t.root = int(r)
+            out = spf_reference(t)
+            dists.append(out.dist)
+            parents.append(out.parent)
+            hops.append(out.hops)
+        return MultiRootResult(
+            dist=np.stack(dists), parent=np.stack(parents), hops=np.stack(hops)
+        )
+
 
 class TpuSpfBackend(SpfBackend):
     """JAX/XLA backend: jitted tensor SPF, cached per topology generation.
@@ -94,7 +109,10 @@ class TpuSpfBackend(SpfBackend):
     def __init__(self, n_atoms: int = 64, max_iters: int | None = None):
         self.n_atoms = n_atoms
         self.max_iters = max_iters
-        self._cache: tuple[tuple, DeviceGraph] | None = None
+        # Small LRU of marshaled graphs: an instance typically alternates
+        # between its LSDB topology and derived ones (hop graphs for
+        # flooding reduction), which must not evict each other.
+        self._cache: dict[tuple, DeviceGraph] = {}
         self._jit_one = jax.jit(lambda g, r, m: spf_one(g, r, m, self.max_iters))
         self._jit_batch = jax.jit(
             lambda g, r, ms: spf_whatif_batch(g, r, ms, self.max_iters)
@@ -107,10 +125,14 @@ class TpuSpfBackend(SpfBackend):
         # Keyed by (process-unique uid, generation): in-place mutators must
         # topo.touch(), and uid reuse across freed objects cannot occur.
         key = topo.cache_key
-        if self._cache is None or self._cache[0] != key:
+        g = self._cache.get(key)
+        if g is None:
             ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
-            self._cache = (key, device_graph_from_ell(ell))
-        return self._cache[1]
+            g = device_graph_from_ell(ell)
+            self._cache[key] = g
+            while len(self._cache) > 4:
+                self._cache.pop(next(iter(self._cache)))
+        return g
 
     def _full_mask(self, topo: Topology, edge_mask) -> np.ndarray:
         if edge_mask is None:

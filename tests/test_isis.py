@@ -213,6 +213,87 @@ def test_lan_dis_failover():
     assert routers[0].routes  # still have LAN routes via new pseudonode
 
 
+def test_flooding_reduction_suppresses_redundant_floods():
+    """Full-mesh triangle with flooding reduction: LSDBs still converge
+    while redundant LSP transmissions drop measurably."""
+
+    def build(reduction: bool):
+        loop = EventLoop(clock=VirtualClock())
+        fabric = MockFabric(loop)
+        routers = []
+        for i in range(3):
+            r = IsisInstance(f"fr{i}", sysid(i + 1),
+                             netio=fabric.sender_for(f"fr{i}"))
+            r.flooding_reduction = reduction
+            loop.register(r)
+            routers.append(r)
+        pairs = [(0, 1), (1, 2), (0, 2)]
+        for a, b in pairs:
+            octet = 10 * a + b + 1
+            net = f"10.{octet}.0.0/30"
+            link(loop, fabric, routers[a], f"e{a}{b}", f"10.{octet}.0.1",
+                 routers[b], f"e{b}{a}", f"10.{octet}.0.2", net, 10)
+        for r in routers:
+            for ifname in r.interfaces:
+                loop.send(r.name, IsisIfUpMsg(ifname))
+        loop.advance(40)
+        # topology change: metric bump re-originates and floods the mesh
+        routers[0].interfaces["e01"].config.metric = 11
+        routers[0]._originate_lsp()
+        fabric.tx_log.clear()
+        loop.advance(30)
+        lsp_tx = 0
+        from holo_tpu.protocols.isis.packet import PduType
+
+        for _actor, _ifn, _dst, data in fabric.tx_log:
+            if len(data) > 4 and data[4] in (
+                int(PduType.LSP_L1), int(PduType.LSP_L2)
+            ):
+                lsp_tx += 1
+        images = [sorted((lid.encode(), e.lsp.seqno) for lid, e in r.lsdb.items())
+                  for r in routers]
+        return lsp_tx, images
+
+    tx_full, images_full = build(reduction=False)
+    tx_red, images_red = build(reduction=True)
+    assert images_red[0] == images_red[1] == images_red[2], (
+        "LSDBs diverged under flooding reduction"
+    )
+    assert tx_red < tx_full, (tx_red, tx_full)
+
+
+def test_flooding_reduction_leaf_delivery_soundness():
+    """The soundness trap: X connects leaf W and triangle peers P, Q.
+    W's LSPs must reach P and Q even with reduction enabled everywhere."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    names = ["X", "P", "Q", "W"]
+    routers = {}
+    for i, nm in enumerate(names):
+        r = IsisInstance(nm, sysid(i + 1), netio=fabric.sender_for(nm))
+        r.flooding_reduction = True
+        loop.register(r)
+        routers[nm] = r
+    X, P, Q, W = (routers[n] for n in names)
+    link(loop, fabric, X, "xp", "10.1.0.1", P, "px", "10.1.0.2", "10.1.0.0/30", 10)
+    link(loop, fabric, X, "xq", "10.2.0.1", Q, "qx", "10.2.0.2", "10.2.0.0/30", 10)
+    link(loop, fabric, P, "pq", "10.3.0.1", Q, "qp", "10.3.0.2", "10.3.0.0/30", 10)
+    link(loop, fabric, X, "xw", "10.4.0.1", W, "wx", "10.4.0.2", "10.4.0.0/30", 10)
+    for r in routers.values():
+        for ifname in r.interfaces:
+            loop.send(r.name, IsisIfUpMsg(ifname))
+    loop.advance(60)
+    # W's LSP (and the whole LSDB) must be identical everywhere.
+    images = {
+        nm: sorted((lid.encode(), e.lsp.seqno) for lid, e in r.lsdb.items())
+        for nm, r in routers.items()
+    }
+    assert images["P"] == images["W"] == images["Q"] == images["X"]
+    # And W's prefix is routable from P and Q.
+    for nm in ("P", "Q"):
+        assert N("10.4.0.0/30") in dict(routers[nm].routes)
+
+
 def test_lsp_retransmission_on_loss():
     loop, fabric, (r1, r2) = mk_net(2)
     link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2", "10.0.12.0/30")
