@@ -100,6 +100,37 @@ def test_ospf_disable_withdraws_routes():
     assert N("10.0.12.0/30") not in d1.routing.rib.active_routes()
 
 
+def test_tpu_backend_opt_in_convergence():
+    """spf-control/backend=tpu: config-driven opt-in to the tensor SPF
+    backend, converging end to end (on the virtual CPU mesh here; the
+    same path runs on the real chip)."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    loop, fabric, d1, d2 = two_daemon_setup()
+    for d, rid, addr in [(d1, "1.1.1.1", "10.0.12.1/30"),
+                         (d2, "2.2.2.2", "10.0.12.2/30")]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/ospfv2/router-id", rid)
+        cand.set(
+            "routing/control-plane-protocols/ospfv2/spf-control/backend", "tpu"
+        )
+        cand.set(
+            "routing/control-plane-protocols/ospfv2/area[0.0.0.0]/interface[eth0]/interface-type",
+            "point-to-point",
+        )
+        d.commit(cand)
+    inst = d1.routing.instances["ospfv2"]
+    assert isinstance(inst.backend, TpuSpfBackend)
+    loop.advance(60)
+    state = d1.routing.get_state()
+    assert state["routing"]["ospfv2"]["neighbors"]["2.2.2.2"]["state"] == "full"
+    rib = d1.routing.rib.active_routes()
+    assert N("10.0.12.0/30") in rib
+    # the SPF log records the backend that ran
+    assert state["routing"]["ospfv2"]["spf-log"][-1]["backend"] == "tpu"
+
+
 def test_isis_config_driven_convergence():
     loop = EventLoop(clock=VirtualClock())
     fabric = MockFabric(loop)
