@@ -288,6 +288,13 @@ class RoutingProvider(Provider, Actor):
             raise CommitError(
                 "ospfv3 redistribution is not supported yet"
             )
+        # RFC 2328: the backbone can never be a stub area.
+        for proto in ("ospfv2", "ospfv3"):
+            at = new_tree.get(
+                f"routing/control-plane-protocols/{proto}/area[0.0.0.0]/area-type"
+            )
+            if at == "stub":
+                raise CommitError("the backbone area cannot be stub")
 
     def __init__(
         self,
@@ -494,6 +501,7 @@ class RoutingProvider(Provider, Actor):
 
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
+            stub = area_conf.get("area-type", "normal") == "stub"
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst._if_area:
                     continue  # reconfig of existing interfaces: later round
@@ -519,8 +527,12 @@ class RoutingProvider(Provider, Actor):
                     bfd_enabled=if_conf.get("bfd", False),
                     auth=self._ospf_auth(if_conf.get("authentication")),
                 )
-                inst.add_interface(ifname, cfg, addr, host)
+                inst.add_interface(ifname, cfg, addr, host, stub=stub)
                 self.loop.send(inst.name, IfUpMsg(ifname))
+            # area-type reconfig on an existing area (no new interfaces):
+            aid = IPv4Address(area_id)
+            if aid in inst.areas and inst.areas[aid].stub != stub:
+                inst.set_area_stub(aid, stub)
         if redist_changed:
             self._reconcile_redistribution(inst)
 

@@ -151,6 +151,10 @@ class Area:
     area_id: IPv4Address
     lsdb: Lsdb = field(default_factory=Lsdb)
     interfaces: dict[str, OspfInterface] = field(default_factory=dict)
+    # RFC 2328 stub areas: no type-5 flooding; ABRs inject a default
+    # summary with this cost instead.  (NSSA later.)
+    stub: bool = False
+    stub_default_cost: int = 1
 
 
 @dataclass
@@ -232,19 +236,50 @@ class OspfInstance(Actor):
         cfg: IfConfig,
         addr: IPv4Network,
         addr_ip: IPv4Address,
+        stub: bool = False,
+        stub_default_cost: int = 1,
     ) -> OspfInterface:
+        """Area type is part of area creation — the stub flag must be set
+        BEFORE any LSA origination touches the area."""
         new_area = cfg.area_id not in self.areas
         area = self.areas.setdefault(cfg.area_id, Area(cfg.area_id))
+        if new_area:
+            area.stub = stub
+            area.stub_default_cost = stub_default_cost
+        elif area.stub != stub:
+            self.set_area_stub(cfg.area_id, stub)
         iface = OspfInterface(
             name=ifname, config=cfg, addr_ip=addr_ip, prefix=addr
         )
         area.interfaces[ifname] = iface
         self._if_area[ifname] = cfg.area_id
         if new_area and self.redistributed:
-            # AS-scope LSAs must exist in every area, incl. late-attached.
+            # AS-scope LSAs must exist in every (non-stub) area, incl.
+            # late-attached ones.
             for prefix in list(self.redistributed):
                 self._originate_external(prefix)
         return iface
+
+    def set_area_stub(self, area_id: IPv4Address, stub: bool) -> None:
+        """Flip an area's stub-ness at runtime: purge now-forbidden
+        type-5s and restart the area's adjacencies (the E-bit changed, so
+        existing neighbors would reject our hellos anyway)."""
+        area = self.areas.get(area_id)
+        if area is None or area.stub == stub:
+            return
+        area.stub = stub
+        if stub:
+            for key in list(area.lsdb.entries):
+                if key.type == LsaType.AS_EXTERNAL:
+                    area.lsdb.remove(key)
+        elif self.redistributed:
+            for prefix in list(self.redistributed):
+                self._originate_external(prefix)
+        for ifname, iface in list(area.interfaces.items()):
+            if iface.state != IsmState.DOWN:
+                self.if_down(ifname)
+                self.if_up(ifname)
+        self._schedule_spf()
 
     def _iface(self, ifname: str) -> tuple[Area, OspfInterface] | None:
         aid = self._if_area.get(ifname)
@@ -396,7 +431,7 @@ class OspfInstance(Actor):
         hello = Hello(
             mask=mask_of(iface.prefix) if iface.prefix else IPv4Address(0),
             hello_interval=iface.config.hello_interval,
-            options=Options.E,
+            options=Options(0) if area.stub else Options.E,
             priority=iface.config.priority,
             dead_interval=iface.config.dead_interval,
             dr=iface.dr,
@@ -416,6 +451,8 @@ class OspfInstance(Actor):
             or h.dead_interval != iface.config.dead_interval
         ):
             return  # §10.5 parameter mismatch
+        if bool(h.options & Options.E) == area.stub:
+            return  # §10.5: E-bit must agree with the area's stub-ness
         if (
             iface.config.if_type == IfType.BROADCAST
             and iface.prefix is not None
@@ -515,6 +552,8 @@ class OspfInstance(Actor):
         )
         lsid = self._external_lsid(prefix)
         for area in self.areas.values():
+            if area.stub:
+                continue  # §3.6: no type-5s in stub areas
             self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
 
     def withdraw_redistributed(self, prefix: IPv4Network) -> None:
@@ -530,9 +569,9 @@ class OspfInstance(Actor):
 
     def _propagate_external(self, from_area: Area, lsa: Lsa) -> None:
         """AS scope: a type-5 installed in one area is installed (and thus
-        flooded) into every other area by ABRs."""
+        flooded) into every other NON-STUB area by ABRs (§3.6)."""
         for area in self.areas.values():
-            if area is from_area:
+            if area is from_area or area.stub:
                 continue
             cur = area.lsdb.get(lsa.key)
             if cur is None or lsa.compare(cur.lsa) > 0:
@@ -1091,6 +1130,8 @@ class OspfInstance(Actor):
     def _install_and_flood(
         self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
     ) -> None:
+        if lsa.type == LsaType.AS_EXTERNAL and area.stub:
+            return  # §3.6: stub areas refuse AS-external LSAs
         now = self.loop.clock.now()
         _, changed = area.lsdb.install(lsa, now)
         if changed:
@@ -1541,6 +1582,11 @@ class OspfInstance(Actor):
                 cur = wanted[dst_aid].get(prefix)
                 if cur is None or route.dist < cur:
                     wanted[dst_aid][prefix] = route.dist
+        # Stub areas get a default summary instead of type-5s (§12.4.3.1).
+        default = IPv4Network("0.0.0.0/0")
+        for aid, area in self.areas.items():
+            if area.stub:
+                wanted[aid][default] = area.stub_default_cost
         for aid, prefixes in wanted.items():
             area = self.areas[aid]
             # Link-state-ID assignment with the RFC 2328 Appendix E rule:
@@ -1607,8 +1653,10 @@ class OspfInstance(Actor):
             aid: {} for aid in self.areas
         }
         for asbr, (src_aid, d) in asbr_dist.items():
-            for dst_aid in self.areas:
-                if dst_aid != src_aid:
+            for dst_aid, dst_area in self.areas.items():
+                if dst_aid != src_aid and not dst_area.stub:
+                    # §12.4.3.1: no type-4s into stub areas (no type-5s
+                    # there to resolve).
                     wanted_per_area[dst_aid][asbr] = d
         zero_mask = IPv4Address(0)
         for aid, wanted in wanted_per_area.items():

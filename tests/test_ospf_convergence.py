@@ -372,6 +372,56 @@ def test_external_across_areas_type4():
     assert N("203.0.113.0/25") not in r1.routes
 
 
+def test_stub_area_default_and_no_type5():
+    """Stub area 1: type-5s stay out, ABR injects a default summary, and
+    stub routers still reach externals via the default."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")  # stub area 1
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")  # ABR
+    r3 = mk_router(loop, fabric, "r3", "3.3.3.3")  # ASBR, backbone
+    area1 = A("0.0.0.1")
+    cfg1 = IfConfig(area_id=area1, if_type=IfType.POINT_TO_POINT, cost=10)
+    cfg0 = IfConfig(area_id=AREA0, if_type=IfType.POINT_TO_POINT, cost=5)
+    r1.add_interface("e0", cfg1, N("10.0.12.0/30"), A("10.0.12.1"), stub=True)
+    r2.add_interface("e0", cfg1, N("10.0.12.0/30"), A("10.0.12.2"), stub=True)
+    r2.add_interface("e1", cfg0, N("10.0.23.0/30"), A("10.0.23.1"))
+    r3.add_interface("e0", cfg0, N("10.0.23.0/30"), A("10.0.23.2"))
+    fabric.join("l12", "r1", "e0", A("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", A("10.0.12.2"))
+    fabric.join("l23", "r2", "e1", A("10.0.23.1"))
+    fabric.join("l23", "r3", "e0", A("10.0.23.2"))
+    bring_up(loop, [r1, r2, r3], seconds=90)
+
+    r3.redistribute(N("203.0.113.0/24"), metric=20)
+    loop.advance(60)
+    from holo_tpu.protocols.ospf.packet import LsaType
+
+    # No type-5 (and no type-4) in the stub area's LSDB; a default
+    # summary instead.
+    stub_lsdb = r1.areas[area1].lsdb
+    assert not any(k.type == LsaType.AS_EXTERNAL for k in stub_lsdb.entries)
+    assert not any(k.type == LsaType.SUMMARY_ROUTER for k in stub_lsdb.entries)
+    assert N("0.0.0.0/0") in r1.routes
+    assert N("203.0.113.0/24") not in r1.routes  # reachable via default
+    # Backbone side still has the external.
+    assert N("203.0.113.0/24") in r2.routes
+
+
+def test_stub_ebit_mismatch_blocks_adjacency():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT)
+    r1.add_interface("e0", cfg, N("10.0.12.0/30"), A("10.0.12.1"), stub=True)
+    r2.add_interface("e0", cfg, N("10.0.12.0/30"), A("10.0.12.2"))
+    fabric.join("l12", "r1", "e0", A("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", A("10.0.12.2"))
+    bring_up(loop, [r1, r2])
+    assert full_neighbors(r1) == []  # E-bit disagreement: no adjacency
+
+
 def test_daemon_redistribute_static_into_ospf():
     """Config-driven: d2 redistributes a static route; d1's RIB learns it
     through OSPF."""
