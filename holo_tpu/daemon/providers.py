@@ -288,13 +288,19 @@ class RoutingProvider(Provider, Actor):
             raise CommitError(
                 "ospfv3 redistribution is not supported yet"
             )
-        # RFC 2328: the backbone can never be a stub area.
+        # RFC 2328: the backbone can never be a stub area (any spelling of
+        # area id 0 counts).
         for proto in ("ospfv2", "ospfv3"):
-            at = new_tree.get(
-                f"routing/control-plane-protocols/{proto}/area[0.0.0.0]/area-type"
-            )
-            if at == "stub":
-                raise CommitError("the backbone area cannot be stub")
+            areas_conf = new_tree.get(
+                f"routing/control-plane-protocols/{proto}/area", {}
+            ) or {}
+            for area_id, area_conf in areas_conf.items():
+                try:
+                    is_backbone = int(IPv4Address(area_id)) == 0
+                except Exception:
+                    is_backbone = area_id in ("0", "0.0.0.0")
+                if is_backbone and area_conf.get("area-type") == "stub":
+                    raise CommitError("the backbone area cannot be stub")
 
     def __init__(
         self,
@@ -502,6 +508,7 @@ class RoutingProvider(Provider, Actor):
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
             stub = area_conf.get("area-type", "normal") == "stub"
+            stub_cost = area_conf.get("default-cost", 1)
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst._if_area:
                     continue  # reconfig of existing interfaces: later round
@@ -527,7 +534,8 @@ class RoutingProvider(Provider, Actor):
                     bfd_enabled=if_conf.get("bfd", False),
                     auth=self._ospf_auth(if_conf.get("authentication")),
                 )
-                inst.add_interface(ifname, cfg, addr, host, stub=stub)
+                inst.add_interface(ifname, cfg, addr, host, stub=stub,
+                                   stub_default_cost=stub_cost)
                 self.loop.send(inst.name, IfUpMsg(ifname))
             # area-type reconfig on an existing area (no new interfaces):
             aid = IPv4Address(area_id)

@@ -144,6 +144,7 @@ class SpfTimers:
 class InstanceConfig:
     router_id: IPv4Address = IPv4Address("0.0.0.0")
     spf: SpfTimers = field(default_factory=SpfTimers)
+    sr: object = None  # holo_tpu.utils.sr.SrConfig (None = SR disabled)
 
 
 @dataclass
@@ -214,6 +215,9 @@ class OspfInstance(Actor):
         # install-time cross-area propagation = AS flooding scope).
         self.redistributed: dict[IPv4Network, ExternalRoute] = {}
         self._external_lsids: dict[IPv4Network, IPv4Address] = {}
+        # Segment routing state (labels resolved after each SPF).
+        self.sr_labels: dict = {}
+        self._sr_opaque_ids: dict[IPv4Network, int] = {}
 
     def attach_ibus(
         self, ibus, routing_actor: str = "routing", bfd_actor: str = "bfd"
@@ -272,9 +276,26 @@ class OspfInstance(Actor):
             for key in list(area.lsdb.entries):
                 if key.type == LsaType.AS_EXTERNAL:
                     area.lsdb.remove(key)
-        elif self.redistributed:
-            for prefix in list(self.redistributed):
-                self._originate_external(prefix)
+        else:
+            if self.redistributed:
+                for prefix in list(self.redistributed):
+                    self._originate_external(prefix)
+            # Foreign type-5s held in our other areas must reach the
+            # newly-normal area too (AS scope).
+            seen: dict = {}
+            for other in self.areas.values():
+                if other is area:
+                    continue
+                for key, e in other.lsdb.entries.items():
+                    if key.type != LsaType.AS_EXTERNAL:
+                        continue
+                    cur = seen.get(key)
+                    if cur is None or e.lsa.compare(cur) > 0:
+                        seen[key] = e.lsa
+            for lsa in seen.values():
+                cur = area.lsdb.get(lsa.key)
+                if cur is None or lsa.compare(cur.lsa) > 0:
+                    self._install_and_flood(area, lsa)
         for ifname, iface in list(area.interfaces.items()):
             if iface.state != IsmState.DOWN:
                 self.if_down(ifname)
@@ -1675,7 +1696,70 @@ class OspfInstance(Actor):
                     LsaSummary(zero_mask, d),
                 )
 
+    # ----- segment routing (RFC 8665 prefix-SIDs over RFC 7684 LSAs)
+
+    def _originate_prefix_sids(self) -> None:
+        sr = self.config.sr
+        if sr is None or not sr.enabled:
+            return
+        from holo_tpu.protocols.ospf.packet import (
+            LsaOpaque,
+            encode_ext_prefix_sid,
+            ext_prefix_lsid,
+        )
+
+        # Stable opaque-id per prefix (never reused) so removals can be
+        # flushed and reorderings can't cross LSAs.
+        for prefix in sr.prefix_sids:
+            if prefix not in self._sr_opaque_ids:
+                self._sr_opaque_ids[prefix] = len(self._sr_opaque_ids)
+        for prefix, opaque_id in list(self._sr_opaque_ids.items()):
+            psid = sr.prefix_sids.get(prefix)
+            lsid = ext_prefix_lsid(opaque_id)
+            if psid is None:
+                key = LsaKey(LsaType.OPAQUE_AREA, lsid, self.config.router_id)
+                for area in self.areas.values():
+                    self._flush_self_lsa(area, key)
+                continue
+            flags = 0x40 if psid.no_php else 0
+            body = LsaOpaque(
+                encode_ext_prefix_sid(psid.prefix, psid.index, flags)
+            )
+            for area in self.areas.values():
+                self._originate(area, LsaType.OPAQUE_AREA, lsid, body)
+
+    def _resolve_sr_labels(self, all_routes: dict) -> dict:
+        """prefix → (local label, route) for every prefix-SID heard,
+        resolved through the SRGB (reference holo-ospf/src/sr.rs)."""
+        sr = self.config.sr
+        if sr is None or not sr.enabled:
+            return {}
+        from holo_tpu.protocols.ospf.packet import decode_ext_prefix_sid
+
+        now = self.loop.clock.now()
+        out = {}
+        for area in self.areas.values():
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.OPAQUE_AREA
+                    or (int(lsa.lsid) >> 24) != 7
+                    or e.current_age(now) >= MAX_AGE
+                ):
+                    continue
+                parsed = decode_ext_prefix_sid(lsa.body.data)
+                if parsed is None:
+                    continue
+                prefix, sid_index, _flags = parsed
+                label = sr.srgb.label_of(sid_index)
+                route = all_routes.get(prefix)
+                if label is not None and route is not None:
+                    out[prefix] = (label, route)
+        return out
+
     def _finish_spf(self, all_routes: dict) -> None:
+        self._originate_prefix_sids()
+        self.sr_labels = self._resolve_sr_labels(all_routes)
         old = self.routes
         self.routes = all_routes
         if self.route_cb is not None:

@@ -193,6 +193,70 @@ class LsaOpaque:
 
 
 GRACE_OPAQUE_TYPE = 3  # RFC 3623 Grace-LSA (opaque type 9.3)
+EXT_PREFIX_OPAQUE_TYPE = 7  # RFC 7684 Extended Prefix (opaque type 10.7)
+
+
+def ext_prefix_lsid(opaque_id: int) -> IPv4Address:
+    return IPv4Address((EXT_PREFIX_OPAQUE_TYPE << 24) | (opaque_id & 0xFFFFFF))
+
+
+def encode_ext_prefix_sid(prefix, sid_index: int, flags: int = 0) -> bytes:
+    """Extended-Prefix TLV (1) with a Prefix-SID sub-TLV (2) — the RFC
+    7684/8665 shape, condensed to the fields the SPF/SR path consumes."""
+    w = Writer()
+    body = Writer()
+    plen = prefix.prefixlen
+    body.u8(1).u8(plen).u8(0).u8(0)  # route-type ignored, af 0 (v4)
+    nbytes = (plen + 7) // 8
+    body.bytes(prefix.network_address.packed[:nbytes])
+    body.zeros((4 - nbytes % 4) % 4)
+    # Prefix-SID sub-TLV: type 2, flags, reserved, MT, algo, SID index.
+    sub = Writer()
+    sub.u8(flags).u8(0).u8(0).u8(0).u32(sid_index)
+    body.u16(2).u16(len(sub)).bytes(sub.finish())
+    w.u16(1).u16(len(body)).bytes(body.finish())
+    return w.finish()
+
+
+def decode_ext_prefix_sid(data: bytes):
+    """Returns (IPv4Network prefix, sid_index, flags) or None."""
+    from ipaddress import IPv4Network
+
+    r = Reader(data)
+    while r.remaining() >= 4:
+        t = r.u16()
+        length = r.u16()
+        body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
+        if t != 1 or body.remaining() < 4:
+            continue
+        body.u8()  # route type
+        plen = body.u8()
+        body.u8()
+        body.u8()
+        if plen > 32:
+            return None
+        nbytes = (plen + 7) // 8
+        if body.remaining() < nbytes:
+            return None
+        raw = body.bytes(nbytes) + bytes(4 - nbytes)
+        pad = (4 - nbytes % 4) % 4
+        if body.remaining() >= pad:
+            body.bytes(pad)
+        val = int.from_bytes(raw, "big")
+        if plen < 32:
+            val &= ~((1 << (32 - plen)) - 1)
+        prefix = IPv4Network((val, plen))
+        while body.remaining() >= 4:
+            st = body.u16()
+            sl = body.u16()
+            sbody = body.sub(min((sl + 3) // 4 * 4, body.remaining()))
+            if st == 2 and sbody.remaining() >= 8:
+                flags = sbody.u8()
+                sbody.u8()
+                sbody.u8()
+                sbody.u8()
+                return prefix, sbody.u32(), flags
+    return None
 
 
 def grace_lsa_lsid(opaque_id: int = 0) -> IPv4Address:

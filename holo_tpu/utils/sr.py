@@ -1,0 +1,46 @@
+"""Segment Routing shared types (reference: holo-utils/src/sr.rs:62).
+
+SRGB (segment-routing global block) config plus SID→label resolution.
+Prefix-SIDs are advertised by OSPF via Extended-Prefix opaque LSAs
+(RFC 7684/8665) and resolve to MPLS labels as SRGB.base + SID index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from ipaddress import IPv4Network
+
+
+@dataclass(frozen=True)
+class Srgb:
+    lower: int = 16000
+    upper: int = 23999
+
+    @property
+    def size(self) -> int:
+        return self.upper - self.lower + 1
+
+    def label_of(self, sid_index: int) -> int | None:
+        if 0 <= sid_index < self.size:
+            return self.lower + sid_index
+        return None
+
+
+@dataclass(frozen=True)
+class PrefixSid:
+    prefix: IPv4Network
+    index: int
+    # PHP/no-PHP and explicit-null flags (RFC 8665 §5):
+    no_php: bool = False
+    explicit_null: bool = False
+
+
+@dataclass
+class SrConfig:
+    enabled: bool = False
+    srgb: Srgb = Srgb()
+    prefix_sids: dict = None  # prefix -> PrefixSid
+
+    def __post_init__(self):
+        if self.prefix_sids is None:
+            self.prefix_sids = {}

@@ -122,6 +122,7 @@ def _ospf_subtree(name):
             _leaf("area-id"),
             _leaf("area-type", "enum", enum=("normal", "stub", "nssa"),
                   default="normal"),
+            _leaf("default-cost", "uint32", default=1),
             L(
                 "interface",
                 "name",

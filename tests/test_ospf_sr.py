@@ -1,0 +1,74 @@
+"""OSPF segment routing: prefix-SID advertisement + SRGB label resolution."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.packet import (
+    decode_ext_prefix_sid,
+    encode_ext_prefix_sid,
+)
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+from holo_tpu.utils.sr import PrefixSid, Srgb, SrConfig
+
+
+def test_ext_prefix_sid_codec():
+    raw = encode_ext_prefix_sid(N("10.7.0.0/16"), 42, flags=0x40)
+    prefix, idx, flags = decode_ext_prefix_sid(raw)
+    assert prefix == N("10.7.0.0/16") and idx == 42 and flags == 0x40
+
+
+def test_srgb_label_resolution():
+    srgb = Srgb(lower=16000, upper=16999)
+    assert srgb.label_of(42) == 16042
+    assert srgb.label_of(2000) is None  # out of block
+
+
+def test_prefix_sid_end_to_end():
+    """r2 advertises a prefix-SID for its stub prefix; r1 resolves the
+    SRGB label and associates it with the routed next hops."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+
+    def rtr(name, rid, sids=None):
+        sr = SrConfig(enabled=True)
+        if sids:
+            for psid in sids:
+                sr.prefix_sids[psid.prefix] = psid
+        inst = OspfInstance(
+            name=name,
+            config=InstanceConfig(router_id=A(rid), sr=sr),
+            netio=fabric.sender_for(name),
+        )
+        loop.register(inst)
+        return inst
+
+    r1 = rtr("r1", "1.1.1.1")
+    r2 = rtr("r2", "2.2.2.2",
+             sids=[PrefixSid(N("192.168.2.0/24"), index=7)])
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT, cost=4)
+    r1.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.1"))
+    r2.add_interface("e0", cfg, N("10.0.0.0/30"), A("10.0.0.2"))
+    r2.add_interface("stub", IfConfig(if_type=IfType.POINT_TO_POINT,
+                                      cost=1, passive=True),
+                     N("192.168.2.0/24"), A("192.168.2.1"))
+    fabric.join("l", "r1", "e0", A("10.0.0.1"))
+    fabric.join("l", "r2", "e0", A("10.0.0.2"))
+    for r, ifs in ((r1, ["e0"]), (r2, ["e0", "stub"])):
+        for i in ifs:
+            loop.send(r.name, IfUpMsg(i))
+    loop.advance(60)
+
+    assert N("192.168.2.0/24") in r1.routes
+    labels = r1.sr_labels
+    assert N("192.168.2.0/24") in labels
+    label, route = labels[N("192.168.2.0/24")]
+    assert label == Srgb().lower + 7  # SRGB base + SID index
+    assert {str(nh.addr) for nh in route.nexthops} == {"10.0.0.2"}
