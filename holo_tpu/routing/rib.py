@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from holo_tpu.utils.ibus import (
+    TOPIC_NHT_UPD,
     TOPIC_REDISTRIBUTE_ADD,
     TOPIC_REDISTRIBUTE_DEL,
     TOPIC_ROUTE_ADD,
@@ -62,6 +63,29 @@ class MockKernel(Kernel):
 
 
 @dataclass
+class NhtUpd:
+    """Next-hop tracking update: resolvability of a tracked address."""
+
+    addr: object
+    reachable: bool
+    # Longest-prefix route currently resolving the address (or None).
+    via_prefix: object = None
+    metric: int = 0
+
+
+@dataclass
+class NhtRegister:
+    addr: object
+    sender: str = ""
+
+
+@dataclass
+class NhtUnregister:
+    addr: object
+    sender: str = ""
+
+
+@dataclass
 class RibEntry:
     msg: RouteMsg
     active: bool = False
@@ -92,6 +116,8 @@ class RibManager(Actor):
         self.kernel = kernel or MockKernel()
         self.routes: dict[IpNetwork, _PrefixRoutes] = {}
         self._programmed: set[IpNetwork] = set()  # prefixes in the kernel FIB
+        # Next-hop tracking: addr -> (last NhtUpd, subscriber names).
+        self._nht: dict = {}
         # (protocol, af) redistribution subscriptions handled via ibus topics.
         self.kernel.purge_stale()
 
@@ -104,6 +130,67 @@ class RibManager(Actor):
                 self.route_add(payload)
             elif isinstance(payload, RouteKeyMsg):
                 self.route_del(payload)
+            elif isinstance(payload, NhtRegister):
+                self.nht_register(payload.addr, payload.sender or msg.sender)
+            elif isinstance(payload, NhtUnregister):
+                self.nht_unregister(payload.addr, payload.sender or msg.sender)
+
+    # -- next-hop tracking (reference rib.rs:64,290)
+
+    def nht_register(self, addr, sender: str = "") -> None:
+        """Track resolvability of an address for ``sender``; publishes an
+        immediate NhtUpd and further ones on every change.  Tracking is
+        per-subscriber refcounted (the reference's nht_add/nht_del)."""
+        entry = self._nht.get(addr)
+        if entry is None:
+            state = self._resolve_nht(addr)
+            self._nht[addr] = (state, {sender})
+        else:
+            entry[1].add(sender)
+            state = entry[0]
+        self.ibus.publish(TOPIC_NHT_UPD, state)
+
+    def nht_unregister(self, addr, sender: str = "") -> None:
+        entry = self._nht.get(addr)
+        if entry is None:
+            return
+        entry[1].discard(sender)
+        if not entry[1]:
+            del self._nht[addr]
+
+    def _resolve_nht(self, addr) -> NhtUpd:
+        from holo_tpu.utils.ip import prefix_contains
+
+        best = None
+        for prefix, pr in self.routes.items():
+            if not prefix_contains(prefix, addr):
+                continue
+            e = pr.best()
+            if e is None:
+                continue
+            if best is None or prefix.prefixlen > best[0].prefixlen:
+                best = (prefix, e)
+        if best is None:
+            return NhtUpd(addr, False)
+        return NhtUpd(addr, True, best[0], best[1].msg.metric)
+
+    def _nht_reeval(self, changed_prefix) -> None:
+        """Re-resolve only addresses the changed prefix can affect: those
+        it covers, or whose current resolution rode it."""
+        from holo_tpu.utils.ip import prefix_contains
+
+        for addr, (old, subs) in list(self._nht.items()):
+            if not (
+                prefix_contains(changed_prefix, addr)
+                or old.via_prefix == changed_prefix
+            ):
+                continue
+            new = self._resolve_nht(addr)
+            if (new.reachable, new.via_prefix, new.metric) != (
+                old.reachable, old.via_prefix, old.metric
+            ):
+                self._nht[addr] = (new, subs)
+                self.ibus.publish(TOPIC_NHT_UPD, new)
 
     # -- RIB operations (also callable directly by the daemon)
 
@@ -111,6 +198,7 @@ class RibManager(Actor):
         pr = self.routes.setdefault(msg.prefix, _PrefixRoutes())
         pr.entries[msg.protocol] = RibEntry(msg)
         self._reselect(msg.prefix)
+        self._nht_reeval(msg.prefix)
 
     def route_del(self, msg: RouteKeyMsg) -> None:
         pr = self.routes.get(msg.prefix)
@@ -125,8 +213,10 @@ class RibManager(Actor):
             self.ibus.publish(
                 TOPIC_REDISTRIBUTE_DEL, RouteKeyMsg(msg.protocol, msg.prefix)
             )
+            self._nht_reeval(msg.prefix)
             return
         self._reselect(msg.prefix)
+        self._nht_reeval(msg.prefix)
 
     def _reselect(self, prefix: IpNetwork) -> None:
         pr = self.routes[prefix]

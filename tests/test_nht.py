@@ -1,0 +1,61 @@
+"""Next-hop tracking: registration, longest-prefix resolution, updates."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.routing.rib import MockKernel, NhtRegister, NhtUpd, RibManager
+from holo_tpu.utils.ibus import TOPIC_NHT_UPD, Ibus
+from holo_tpu.utils.runtime import Actor, EventLoop, VirtualClock
+from holo_tpu.utils.southbound import Nexthop, Protocol, RouteKeyMsg, RouteMsg
+
+
+class Sink(Actor):
+    name = "sink"
+
+    def __init__(self):
+        self.updates = []
+
+    def handle(self, msg):
+        if isinstance(msg.payload, NhtUpd):
+            self.updates.append(msg.payload)
+
+
+def test_nht_lifecycle():
+    loop = EventLoop(clock=VirtualClock())
+    ibus = Ibus(loop)
+    rib = RibManager(ibus, MockKernel())
+    loop.register(rib, name="routing-rib")
+    sink = Sink()
+    loop.register(sink)
+    ibus.subscribe(TOPIC_NHT_UPD, "sink")
+
+    # Register before any route exists: immediate "unreachable".
+    ibus.request("routing-rib", NhtRegister(A("10.9.9.9")), sender="sink")
+    loop.run_until_idle()
+    assert sink.updates[-1].reachable is False
+
+    # A covering route appears: update fires with the resolving prefix.
+    rib.route_add(RouteMsg(Protocol.OSPFV2, N("10.9.0.0/16"), 110, 7,
+                           frozenset({Nexthop(addr=A("10.0.0.2"))})))
+    loop.run_until_idle()
+    assert sink.updates[-1].reachable is True
+    assert sink.updates[-1].via_prefix == N("10.9.0.0/16")
+
+    # A more specific route takes over: update with the new prefix.
+    rib.route_add(RouteMsg(Protocol.STATIC, N("10.9.9.0/24"), 1, 0,
+                           frozenset({Nexthop(addr=A("10.0.0.3"))})))
+    loop.run_until_idle()
+    assert sink.updates[-1].via_prefix == N("10.9.9.0/24")
+
+    # No change -> no spurious update.
+    n = len(sink.updates)
+    rib.route_add(RouteMsg(Protocol.RIPV2, N("172.16.0.0/16"), 120, 1,
+                           frozenset({Nexthop(addr=A("10.0.0.4"))})))
+    loop.run_until_idle()
+    assert len(sink.updates) == n
+
+    # Both covering routes vanish: unreachable again.
+    rib.route_del(RouteKeyMsg(Protocol.STATIC, N("10.9.9.0/24")))
+    rib.route_del(RouteKeyMsg(Protocol.OSPFV2, N("10.9.0.0/16")))
+    loop.run_until_idle()
+    assert sink.updates[-1].reachable is False
