@@ -74,6 +74,7 @@ class Daemon:
             prefix=self._p, policy_engine=self.policy.engine,
             keychains=self.keychain,
         )
+        self.interface.routing_actor = f"{self._p}routing-rib"
         for p in (self.interface, self.keychain, self.policy, self.system, self.routing):
             self.loop.register(p, name=self._p + p.name)
 

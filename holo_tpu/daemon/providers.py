@@ -58,9 +58,41 @@ class InterfaceProvider(Provider, Actor):
         self.ibus = ibus
         self.interfaces: dict[str, IfaceState] = {}
         self._next_ifindex = 1
+        # Set by the daemon: where connected (direct) routes are sent.
+        self.routing_actor: str | None = None
+        self._direct: set = set()  # prefixes currently installed as direct
 
     def handle(self, msg):
         pass
+
+    def _sync_direct_routes(self) -> None:
+        """Connected prefixes go into the RIB as protocol 'direct' at
+        distance 0 with an empty next-hop set — they win over any IGP copy
+        of the same prefix and the empty set keeps them out of the kernel
+        FIB (which already has them)."""
+        from holo_tpu.utils.southbound import Protocol, RouteKeyMsg, RouteMsg
+
+        if self.routing_actor is None:
+            return
+        wanted = {
+            a.network
+            for st in self.interfaces.values()
+            if st.operative
+            for a in st.addresses
+        }
+        for prefix in self._direct - wanted:
+            self.ibus.request(
+                self.routing_actor,
+                RouteKeyMsg(Protocol.DIRECT, prefix),
+                sender=self.name,
+            )
+        for prefix in wanted - self._direct:
+            self.ibus.request(
+                self.routing_actor,
+                RouteMsg(Protocol.DIRECT, prefix, 0, 0, frozenset()),
+                sender=self.name,
+            )
+        self._direct = wanted
 
     def commit(self, phase, old, new, changes):
         if phase != CommitPhase.APPLY:
@@ -90,6 +122,7 @@ class InterfaceProvider(Provider, Actor):
                 del self.interfaces[name]
                 self.ibus.publish(TOPIC_INTERFACE_DEL, name, ifname=name)
         self._publish_router_id()
+        self._sync_direct_routes()
 
     def _publish_router_id(self):
         """Router-ID derivation: highest interface address (reference
@@ -136,6 +169,7 @@ class InterfaceProvider(Provider, Actor):
                     elif ev.kind == "addr-del" and ev.addr in st.addresses:
                         st.addresses.remove(ev.addr)
                     self._publish_router_id()
+                    self._sync_direct_routes()
                     break
 
     def get_state(self, path=None):

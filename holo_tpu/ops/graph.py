@@ -97,11 +97,7 @@ class Topology:
         Equivalent of the reference's per-visit bidirectionality check
         (holo-ospf/src/spf.rs:653-664), hoisted to marshal time.
         """
-        fwd = set(zip(self.edge_src.tolist(), self.edge_dst.tolist()))
-        keep = np.array(
-            [(d, s) in fwd for s, d in zip(self.edge_src, self.edge_dst)],
-            dtype=bool,
-        )
+        keep = mutual_keep_mask(self.edge_src, self.edge_dst)
         return Topology(
             n_vertices=self.n_vertices,
             is_router=self.is_router,
@@ -136,6 +132,15 @@ class EllGraph(NamedTuple):
     @property
     def k_pad(self) -> int:
         return self.in_src.shape[1]
+
+
+def mutual_keep_mask(edge_src, edge_dst) -> np.ndarray:
+    """bool[E]: edge has a reverse edge (the single bidirectionality rule
+    shared by every protocol's marshaling path)."""
+    src = np.asarray(edge_src)
+    dst = np.asarray(edge_dst)
+    fwd = set(zip(src.tolist(), dst.tolist()))
+    return np.array([(d, s) in fwd for s, d in zip(src, dst)], dtype=bool)
 
 
 def _round_up(x: int, m: int) -> int:

@@ -1469,12 +1469,20 @@ class OspfInstance(Actor):
                 i.addr_ip: i.name for i in area.interfaces.values() if i.addr_ip
             }
             iface_by_nbr = {}
+            p2p_nbr_addr = {}
             for i in area.interfaces.values():
                 for nbr in i.neighbors.values():
                     if nbr.state == NsmState.FULL:
                         iface_by_nbr[nbr.router_id] = (i.name, nbr.src)
+                        p2p_nbr_addr[(i.name, nbr.router_id)] = nbr.src
+            iface_by_ifindex = {
+                i.ifindex: i.name
+                for i in area.interfaces.values()
+                if i.ifindex
+            }
             st = build_topology(
-                area.lsdb, self.config.router_id, now, iface_by_addr, iface_by_nbr
+                area.lsdb, self.config.router_id, now, iface_by_addr,
+                iface_by_nbr, p2p_nbr_addr, iface_by_ifindex,
             )
             if st is None:
                 continue

@@ -58,12 +58,17 @@ def build_topology(
     now: float,
     iface_by_addr: dict[IPv4Address, str],
     iface_by_nbr: dict[IPv4Address, tuple[str, IPv4Address]],
+    p2p_nbr_addr: dict[tuple, IPv4Address] | None = None,
+    iface_by_ifindex: dict[int, str] | None = None,
 ) -> SpfTopology | None:
     """Lower the area LSDB to the SPF vertex/edge model.
 
     iface_by_addr: our interface address -> ifname (for transit networks we
     attach to).  iface_by_nbr: neighbor router-id -> (ifname, nbr addr)
-    for p2p adjacencies (direct next-hop resolution).
+    for p2p adjacencies (direct next-hop resolution); with
+    ``p2p_nbr_addr`` {(ifname, nbr_rid): addr} parallel p2p links each
+    resolve through their own interface (the per-link link_data of our
+    router LSA selects the interface).
     MaxAge LSAs are excluded (RFC 2328 §16.1 note).
     """
     routers: list[IPv4Address] = []
@@ -94,16 +99,23 @@ def build_topology(
     is_router[len(networks) :] = True
 
     src, dst, cost = [], [], []
+    # Per-edge link_data for edges out of the root (parallel p2p links
+    # each resolve to their own interface).
+    root_edge_data: dict[int, IPv4Address] = {}
     for rid, body in rlsa.items():
         u = router_index[rid]
         for link in body.links:
             if link.link_type == RouterLinkType.POINT_TO_POINT:
                 v = router_index.get(link.id)
                 if v is not None:
+                    if rid == router_id:
+                        root_edge_data[len(src)] = link.data
                     src.append(u), dst.append(v), cost.append(link.metric)
             elif link.link_type == RouterLinkType.TRANSIT_NETWORK:
                 v = network_index.get(link.id)
                 if v is not None:
+                    if rid == router_id:
+                        root_edge_data[len(src)] = link.data
                     src.append(u), dst.append(v), cost.append(link.metric)
     for dr_addr, body in nlsa.items():
         u = network_index[dr_addr]
@@ -112,14 +124,26 @@ def build_topology(
             if v is not None:
                 src.append(u), dst.append(v), cost.append(0)
 
+    # Mutual-link filter (bidirectionality check, spf.rs:653-664) applied
+    # here with index tracking so root-edge link_data survives filtering.
+    from holo_tpu.ops.graph import mutual_keep_mask
+
+    keep_mask = mutual_keep_mask(
+        np.array(src, np.int32), np.array(dst, np.int32)
+    )
+    keep = [i for i in range(len(src)) if keep_mask[i]]
+    remap = {old: new for new, old in enumerate(keep)}
+    root_edge_data = {
+        remap[i]: d for i, d in root_edge_data.items() if i in remap
+    }
     topo = Topology(
         n_vertices=n,
         is_router=is_router,
-        edge_src=np.array(src, np.int32).reshape(-1),
-        edge_dst=np.array(dst, np.int32).reshape(-1),
-        edge_cost=np.array(cost, np.int32).reshape(-1),
+        edge_src=np.array([src[i] for i in keep], np.int32).reshape(-1),
+        edge_dst=np.array([dst[i] for i in keep], np.int32).reshape(-1),
+        edge_cost=np.array([cost[i] for i in keep], np.int32).reshape(-1),
         root=router_index[router_id],
-    ).filter_mutual()
+    )
 
     # Next-hop atoms: edges out of the root, and edges out of root-adjacent
     # transit networks (the hops==0 direct-calculation cases).
@@ -140,15 +164,48 @@ def build_topology(
     for e in range(topo.n_edges):
         if topo.edge_src[e] == root:
             v = int(topo.edge_dst[e])
+            link_data = root_edge_data.get(e)
             if is_router[v]:
-                # p2p neighbor: resolve via adjacency table.
+                # p2p neighbor: the link's own interface (parallel links
+                # each get their own atom), neighbor addr per interface.
+                # Unnumbered links carry the MIB ifIndex in link_data
+                # (RFC 2328 A.4.2) instead of an address.
                 rid = routers[v - len(networks)]
-                hop = iface_by_nbr.get(rid)
-                if hop is not None:
+                ifname = (
+                    iface_by_addr.get(link_data)
+                    if link_data is not None
+                    else None
+                )
+                if (
+                    ifname is None
+                    and link_data is not None
+                    and iface_by_ifindex is not None
+                    and int(link_data) < 0x1000000  # 0.x.y.z: never an addr
+                ):
+                    ifname = iface_by_ifindex.get(int(link_data))
+                addr = None
+                if ifname is not None and p2p_nbr_addr is not None:
+                    addr = p2p_nbr_addr.get((ifname, rid))
+                if ifname is not None and addr is not None:
                     atom_ids[e] = len(atoms)
-                    atoms.append(NexthopAtom(hop[0], hop[1]))
+                    atoms.append(NexthopAtom(ifname, addr))
+                else:
+                    hop = iface_by_nbr.get(rid)
+                    if hop is not None:
+                        atom_ids[e] = len(atoms)
+                        atoms.append(NexthopAtom(hop[0], hop[1]))
             else:
                 root_nets.add(v)
+                # Directly-attached transit network: next hop is the
+                # outgoing interface itself (no gateway address).
+                ifname = (
+                    iface_by_addr.get(link_data)
+                    if link_data is not None
+                    else None
+                )
+                if ifname is not None:
+                    atom_ids[e] = len(atoms)
+                    atoms.append(NexthopAtom(ifname, None))
         # second pass below needs root_nets complete
     for e in range(topo.n_edges):
         u = int(topo.edge_src[e])

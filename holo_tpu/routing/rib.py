@@ -140,13 +140,14 @@ class RibManager(Actor):
     def nht_register(self, addr, sender: str = "") -> None:
         """Track resolvability of an address for ``sender``; publishes an
         immediate NhtUpd and further ones on every change.  Tracking is
-        per-subscriber refcounted (the reference's nht_add/nht_del)."""
+        refcounted PER SUBSCRIBER (a sender registering twice must
+        unregister twice — two BGP peers sharing a next hop)."""
         entry = self._nht.get(addr)
         if entry is None:
             state = self._resolve_nht(addr)
-            self._nht[addr] = (state, {sender})
+            self._nht[addr] = (state, {sender: 1})
         else:
-            entry[1].add(sender)
+            entry[1][sender] = entry[1].get(sender, 0) + 1
             state = entry[0]
         self.ibus.publish(TOPIC_NHT_UPD, state)
 
@@ -154,8 +155,12 @@ class RibManager(Actor):
         entry = self._nht.get(addr)
         if entry is None:
             return
-        entry[1].discard(sender)
-        if not entry[1]:
+        refs = entry[1]
+        if sender in refs:
+            refs[sender] -= 1
+            if refs[sender] <= 0:
+                del refs[sender]
+        if not refs:
             del self._nht[addr]
 
     def _resolve_nht(self, addr) -> NhtUpd:

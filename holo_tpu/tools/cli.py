@@ -137,6 +137,35 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_conformance(args) -> int:
+    from pathlib import Path
+
+    from holo_tpu.tools.conformance import REFERENCE_CONFORMANCE, run_topology
+
+    if args.topo_dir:
+        dirs = [Path(args.topo_dir)]
+    elif REFERENCE_CONFORMANCE.exists():
+        dirs = sorted(p for p in REFERENCE_CONFORMANCE.iterdir() if p.is_dir())
+    else:
+        print(f"conformance corpus not found at {REFERENCE_CONFORMANCE}",
+              file=sys.stderr)
+        return 2
+    total = ok = 0
+    failed = False
+    for topo in dirs:
+        results = run_topology(topo)
+        bad = {rt: p for rt, p in results.items() if p}
+        total += len(results)
+        ok += len(results) - len(bad)
+        print(f"{topo.name}: {len(results) - len(bad)}/{len(results)} conformant")
+        for rt, problems in bad.items():
+            failed = True
+            for p in problems:
+                print(f"    {rt}: {p}")
+    print(f"TOTAL: {ok}/{total} routers bit-identical")
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="holo-tpu-tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -153,6 +182,13 @@ def main(argv=None) -> int:
     s.add_argument("--setup", required=True,
                    help="JSON: router-id + interfaces layout")
     s.set_defaults(fn=cmd_replay)
+    s = sub.add_parser(
+        "conformance",
+        help="run the reference conformance corpus (RIB bit-identity)",
+    )
+    s.add_argument("topo_dir", nargs="?",
+                   help="one topology dir (default: all)")
+    s.set_defaults(fn=cmd_conformance)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,270 @@
+"""Reference-conformance harness: replay recorded topologies, compare RIBs.
+
+Consumes the reference's conformance corpus
+(/root/reference/holo-*/tests/conformance — SURVEY.md §4): per-router
+recorded events (whose LS-Update entries carry the raw LSA wire bytes)
+and expected operational state.  For each topology:
+
+1. Decode every recorded LSA with OUR codecs (cross-implementation codec
+   validation for free) and union them into the converged per-area LSDB
+   (newest copy per key).
+2. For each router, rebuild its local view (interfaces/addresses from the
+   recorded ibus events, FULL p2p neighbors resolved by subnet matching
+   across routers) and run OUR SPF + route derivation pipeline.
+3. Compare (prefix, metric, next-hop set) against the reference's
+   expected ``local-rib`` — the BASELINE.md bit-identical-RIB gate,
+   checked against the reference's own expected outputs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address, IPv4Network, ip_interface
+from pathlib import Path
+
+from holo_tpu.protocols.ospf.instance import InstanceConfig, OspfInstance
+from holo_tpu.protocols.ospf.interface import IfConfig, IfType
+from holo_tpu.protocols.ospf.neighbor import Neighbor, NsmState
+from holo_tpu.protocols.ospf.packet import Lsa
+from holo_tpu.utils.bytesbuf import Reader
+from holo_tpu.utils.netio import NetIo
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+REFERENCE_CONFORMANCE = Path(
+    "/root/reference/holo-ospf/tests/conformance/ospfv2/topologies"
+)
+
+
+@dataclass
+class ExpectedRoute:
+    prefix: IPv4Network
+    metric: int
+    route_type: str
+    nexthops: frozenset  # {(ifname, addr|None)}
+
+
+@dataclass
+class RouterData:
+    name: str
+    router_id: IPv4Address = None
+    # area id -> {ifname: iface config dict}
+    areas: dict = field(default_factory=dict)
+    # ifname -> IPv4Interface (first v4 address)
+    addrs: dict = field(default_factory=dict)
+    # area id -> [Lsa] every LSA this router received
+    rx_lsas: dict = field(default_factory=dict)
+    expected: list = field(default_factory=list)
+    ifindexes: dict = field(default_factory=dict)  # ifname -> ifindex
+
+
+def load_router(rt_dir: Path) -> RouterData:
+    rd = RouterData(name=rt_dir.name)
+    cfg = json.loads((rt_dir / "config.json").read_text())
+    proto = cfg["ietf-routing:routing"]["control-plane-protocols"][
+        "control-plane-protocol"
+    ][0]
+    ospf = proto["ietf-ospf:ospf"]
+    rd.router_id = IPv4Address(ospf["explicit-router-id"])
+    for area in ospf.get("areas", {}).get("area", []):
+        aid = IPv4Address(area["area-id"])
+        rd.areas[aid] = {}
+        for iface in area.get("interfaces", {}).get("interface", []):
+            rd.areas[aid][iface["name"]] = iface
+
+    rd.ifindexes = {}
+    for line in (rt_dir / "events.jsonl").read_text().splitlines():
+        if not line.strip():
+            continue
+        ev = json.loads(line)
+        ibus = ev.get("Ibus")
+        if ibus and "InterfaceUpd" in ibus:
+            upd = ibus["InterfaceUpd"]
+            rd.ifindexes[upd["ifname"]] = upd["ifindex"]
+        if ibus and "InterfaceAddressAdd" in ibus:
+            upd = ibus["InterfaceAddressAdd"]
+            try:
+                addr = ip_interface(upd["addr"])
+            except ValueError:
+                continue
+            if addr.version == 4 and upd["ifname"] not in rd.addrs:
+                rd.addrs[upd["ifname"]] = addr
+        pkt_ev = (ev.get("Protocol") or {}).get("NetRxPacket")
+        if pkt_ev:
+            packet = (pkt_ev.get("packet") or {}).get("Ok") or {}
+            upd = packet.get("LsUpdate")
+            if not upd:
+                continue
+            area_id = IPv4Address(upd["hdr"]["area_id"])
+            for lsa_obj in upd.get("lsas", []):
+                raw = bytes(lsa_obj["raw"])
+                try:
+                    lsa = Lsa.decode(Reader(raw))
+                except Exception:
+                    continue  # LSA types we don't implement yet (opaque…)
+                rd.rx_lsas.setdefault(area_id, []).append(lsa)
+
+    state = json.loads(
+        (rt_dir / "output" / "northbound-state.json").read_text()
+    )
+    ospf_state = state["ietf-routing:routing"]["control-plane-protocols"][
+        "control-plane-protocol"
+    ][0]["ietf-ospf:ospf"]
+    for route in ospf_state.get("local-rib", {}).get("route", []):
+        nhs = set()
+        for nh in route.get("next-hops", {}).get("next-hop", []):
+            addr = nh.get("next-hop")
+            nhs.add(
+                (nh.get("outgoing-interface"),
+                 IPv4Address(addr) if addr else None)
+            )
+        rd.expected.append(
+            ExpectedRoute(
+                prefix=IPv4Network(route["prefix"]),
+                metric=route.get("metric", 0),
+                route_type=route.get("route-type", ""),
+                nexthops=frozenset(nhs),
+            )
+        )
+    return rd
+
+
+def load_topology(topo_dir: Path) -> dict[str, RouterData]:
+    return {
+        rt.name: load_router(rt)
+        for rt in sorted(topo_dir.iterdir())
+        if rt.is_dir() and (rt / "events.jsonl").exists()
+    }
+
+
+def converged_lsdb(routers: dict[str, RouterData]) -> dict:
+    """area id -> {LsaKey: Lsa}, newest copy wins."""
+    out: dict = {}
+    for rd in routers.values():
+        for aid, lsas in rd.rx_lsas.items():
+            area = out.setdefault(aid, {})
+            for lsa in lsas:
+                cur = area.get(lsa.key)
+                if cur is None or lsa.compare(cur) > 0:
+                    area[lsa.key] = lsa
+    return out
+
+
+class _NullIo(NetIo):
+    def send(self, *a):
+        pass
+
+
+def compute_routes(rd: RouterData, lsdb_by_area: dict, routers: dict):
+    """Run OUR pipeline for one router over the converged LSDB."""
+    loop = EventLoop(clock=VirtualClock())
+    inst = OspfInstance(
+        name=f"conf-{rd.name}",
+        config=InstanceConfig(router_id=rd.router_id),
+        netio=_NullIo(),
+    )
+    loop.register(inst)
+
+    for aid, ifaces in rd.areas.items():
+        for ifname, icfg in ifaces.items():
+            addr = rd.addrs.get(ifname)
+            if addr is None:
+                continue
+            if_type = (
+                IfType.POINT_TO_POINT
+                if icfg.get("interface-type") == "point-to-point"
+                else IfType.BROADCAST
+            )
+            iface = inst.add_interface(
+                ifname,
+                IfConfig(area_id=aid, if_type=if_type),
+                addr.network,
+                addr.ip,
+            )
+            iface.ifindex = rd.ifindexes.get(ifname, 0)
+            # Synthesize FULL neighbors by subnet matching: the far-side
+            # address of the shared link belongs to exactly one other
+            # recorded router.
+            for other in routers.values():
+                if other.name == rd.name:
+                    continue
+                for oif, oaddr in other.addrs.items():
+                    if oaddr.ip != addr.ip and oaddr.ip in addr.network:
+                        iface.neighbors[other.router_id] = Neighbor(
+                            router_id=other.router_id,
+                            src=oaddr.ip,
+                            state=NsmState.FULL,
+                        )
+    # Unnumbered p2p links: our router LSA's link_data is the ifIndex and
+    # the neighbor's packets come from its borrowed (router-id) address.
+    own_key = None
+    for aid, lsas in lsdb_by_area.items():
+        for key, lsa in lsas.items():
+            if (
+                key.adv_rtr == rd.router_id
+                and key.type.name == "ROUTER"
+                and aid in inst.areas
+            ):
+                from holo_tpu.protocols.ospf.packet import RouterLinkType
+
+                by_ifindex = {
+                    i.ifindex: i
+                    for a in inst.areas.values()
+                    for i in a.interfaces.values()
+                    if i.ifindex
+                }
+                for link in lsa.body.links:
+                    if link.link_type != RouterLinkType.POINT_TO_POINT:
+                        continue
+                    ld = int(link.data)
+                    if ld >= 0x10000:
+                        continue  # numbered link
+                    iface = by_ifindex.get(ld)
+                    if iface is not None and link.id not in iface.neighbors:
+                        iface.neighbors[link.id] = Neighbor(
+                            router_id=link.id,
+                            src=IPv4Address(link.id),
+                            state=NsmState.FULL,
+                        )
+    # Inject the converged LSDB (bypassing the flooding machinery).
+    for aid, lsas in lsdb_by_area.items():
+        if aid not in inst.areas:
+            continue
+        for lsa in lsas.values():
+            inst.areas[aid].lsdb.install(lsa, 0.0)
+    inst.run_spf()
+    return inst.routes
+
+
+def compare_router(rd: RouterData, routes: dict) -> list[str]:
+    """Returns mismatch descriptions (empty = conformant)."""
+    problems = []
+    expected_by_prefix = {e.prefix: e for e in rd.expected}
+    for prefix, exp in expected_by_prefix.items():
+        got = routes.get(prefix)
+        if got is None:
+            problems.append(f"missing route {prefix}")
+            continue
+        if got.dist != exp.metric:
+            problems.append(
+                f"{prefix}: metric {got.dist} != expected {exp.metric}"
+            )
+        ours = frozenset((nh.ifname, nh.addr) for nh in got.nexthops)
+        if ours != exp.nexthops:
+            problems.append(
+                f"{prefix}: nexthops {sorted(map(str, ours))} != "
+                f"expected {sorted(map(str, exp.nexthops))}"
+            )
+    for prefix in routes.keys() - expected_by_prefix.keys():
+        problems.append(f"unexpected extra route {prefix}")
+    return problems
+
+
+def run_topology(topo_dir: Path) -> dict[str, list[str]]:
+    routers = load_topology(topo_dir)
+    lsdb = converged_lsdb(routers)
+    results = {}
+    for name, rd in sorted(routers.items()):
+        routes = compute_routes(rd, lsdb, routers)
+        results[name] = compare_router(rd, routes)
+    return results

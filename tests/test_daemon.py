@@ -50,10 +50,13 @@ def test_config_commit_spawns_ospf_and_converges():
     state = d1.routing.get_state()
     nbrs = state["routing"]["ospfv2"]["neighbors"]
     assert nbrs.get("2.2.2.2", {}).get("state") == "full"
-    # Connected prefix in instance routes; RIB active.
+    # Connected prefix: DIRECT (distance 0) wins in the RIB; the OSPF
+    # entry coexists beneath it.
     rib = d1.routing.rib.active_routes()
     assert N("10.0.12.0/30") in rib
-    assert rib[N("10.0.12.0/30")].protocol == Protocol.OSPFV2
+    assert rib[N("10.0.12.0/30")].protocol == Protocol.DIRECT
+    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
+    assert Protocol.OSPFV2 in entries
 
 
 def test_static_routes_program_rib():
@@ -92,12 +95,15 @@ def test_ospf_disable_withdraws_routes():
     configure(d1, "1.1.1.1", "10.0.12.1/30")
     configure(d2, "2.2.2.2", "10.0.12.2/30")
     loop.advance(60)
-    assert N("10.0.12.0/30") in d1.routing.rib.active_routes()
+    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
+    assert Protocol.OSPFV2 in entries
     cand = d1.candidate()
     cand.set("routing/control-plane-protocols/ospfv2/enabled", "false")
     d1.commit(cand)
     assert "ospfv2" not in d1.routing.instances
-    assert N("10.0.12.0/30") not in d1.routing.rib.active_routes()
+    # The OSPF contribution is withdrawn (the DIRECT route remains).
+    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
+    assert Protocol.OSPFV2 not in entries
 
 
 def test_tpu_backend_opt_in_convergence():
@@ -148,9 +154,12 @@ def test_isis_config_driven_convergence():
         cand.set("routing/control-plane-protocols/isis/interface[eth0]/metric", 7)
         d.commit(cand)
     loop.advance(30)
-    rib = d1.routing.rib.active_routes()
-    assert N("10.0.12.0/30") in rib
-    assert rib[N("10.0.12.0/30")].protocol.value == "isis"
+    # DIRECT wins the connected prefix; IS-IS holds its own entry.
+    from holo_tpu.utils.southbound import Protocol as P
+
+    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
+    assert P.ISIS in entries
+    assert d1.routing.rib.active_routes()[N("10.0.12.0/30")].protocol == P.DIRECT
 
 
 def test_ospfv3_config_driven_convergence():
