@@ -1464,7 +1464,13 @@ class OspfInstance(Actor):
         all_routes = {}
         area_intra: dict[IPv4Address, dict] = {}
         area_results: dict[IPv4Address, tuple] = {}
-        for area in self.areas.values():
+        # Backbone last: its SPF consumes transit-area results for virtual
+        # links (§16.1 — vlink next hops come from the transit area).
+        backbone_id = IPv4Address(0)
+        ordered_areas = sorted(
+            self.areas.values(), key=lambda a: int(a.area_id) == 0
+        )
+        for area in ordered_areas:
             iface_by_addr = {
                 i.addr_ip: i.name for i in area.interfaces.values() if i.addr_ip
             }
@@ -1480,9 +1486,13 @@ class OspfInstance(Actor):
                 for i in area.interfaces.values()
                 if i.ifindex
             }
+            vlink_nexthops = None
+            if int(area.area_id) == 0:
+                vlink_nexthops = self._vlink_nexthops(area, area_results, now)
             st = build_topology(
                 area.lsdb, self.config.router_id, now, iface_by_addr,
                 iface_by_nbr, p2p_nbr_addr, iface_by_ifindex,
+                vlink_nexthops,
             )
             if st is None:
                 continue
@@ -1651,6 +1661,32 @@ class OspfInstance(Actor):
                     lsid_of[prefix],
                     LsaSummary(mask_of(prefix), dist),
                 )
+
+    def _vlink_nexthops(self, backbone: Area, area_results: dict, now) -> dict:
+        """{vlink neighbor rid: frozenset[RouteNexthop]} — the transit
+        area's next hops toward each virtual-link neighbor named in our
+        backbone router LSA."""
+        from holo_tpu.protocols.ospf.spf_run import _atoms_of
+
+        key = LsaKey(
+            LsaType.ROUTER, self.config.router_id, self.config.router_id
+        )
+        e = backbone.lsdb.get(key)
+        if e is None:
+            return {}
+        out = {}
+        for link in e.lsa.body.links:
+            if link.link_type != RouterLinkType.VIRTUAL_LINK:
+                continue
+            for aid, (st, res) in area_results.items():
+                v = st.router_index.get(link.id)
+                if v is None or res.dist[v] >= 0x40000000:
+                    continue
+                nhs = _atoms_of(res.nexthop_words[v], st.atoms)
+                if nhs:
+                    out[link.id] = nhs
+                    break
+        return out
 
     def _originate_asbr_summaries(self, area_results: dict) -> None:
         """ABR: type-4 ASBR-summary LSAs (§12.4.3) so other areas can

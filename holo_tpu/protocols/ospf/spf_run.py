@@ -37,10 +37,14 @@ class NexthopAtom:
 
     addr is None for p2p links where the neighbor address is learned from
     the adjacency (filled by the instance) — kept explicit for RIB parity.
+    ``expand`` (virtual links, §16.1): the atom stands for the transit
+    area's next-hop set toward the vlink neighbor and expands to it when
+    atoms are converted to route next hops.
     """
 
-    ifname: str
+    ifname: str | None
     addr: IPv4Address | None
+    expand: frozenset = None
 
 
 @dataclass
@@ -60,6 +64,7 @@ def build_topology(
     iface_by_nbr: dict[IPv4Address, tuple[str, IPv4Address]],
     p2p_nbr_addr: dict[tuple, IPv4Address] | None = None,
     iface_by_ifindex: dict[int, str] | None = None,
+    vlink_nexthops: dict | None = None,
 ) -> SpfTopology | None:
     """Lower the area LSDB to the SPF vertex/edge model.
 
@@ -100,16 +105,25 @@ def build_topology(
 
     src, dst, cost = [], [], []
     # Per-edge link_data for edges out of the root (parallel p2p links
-    # each resolve to their own interface).
+    # each resolve to their own interface); vlink edges tracked apart.
     root_edge_data: dict[int, IPv4Address] = {}
+    root_vlink_edges: dict[int, IPv4Address] = {}  # edge -> nbr router id
     for rid, body in rlsa.items():
         u = router_index[rid]
         for link in body.links:
-            if link.link_type == RouterLinkType.POINT_TO_POINT:
+            if link.link_type in (
+                RouterLinkType.POINT_TO_POINT,
+                RouterLinkType.VIRTUAL_LINK,
+            ):
+                # Virtual links are router-router edges whose cost is the
+                # transit-area distance (§15); for SPF they behave as p2p.
                 v = router_index.get(link.id)
                 if v is not None:
                     if rid == router_id:
-                        root_edge_data[len(src)] = link.data
+                        if link.link_type == RouterLinkType.VIRTUAL_LINK:
+                            root_vlink_edges[len(src)] = link.id
+                        else:
+                            root_edge_data[len(src)] = link.data
                     src.append(u), dst.append(v), cost.append(link.metric)
             elif link.link_type == RouterLinkType.TRANSIT_NETWORK:
                 v = network_index.get(link.id)
@@ -135,6 +149,9 @@ def build_topology(
     remap = {old: new for new, old in enumerate(keep)}
     root_edge_data = {
         remap[i]: d for i, d in root_edge_data.items() if i in remap
+    }
+    root_vlink_edges = {
+        remap[i]: r for i, r in root_vlink_edges.items() if i in remap
     }
     topo = Topology(
         n_vertices=n,
@@ -164,6 +181,15 @@ def build_topology(
     for e in range(topo.n_edges):
         if topo.edge_src[e] == root:
             v = int(topo.edge_dst[e])
+            if e in root_vlink_edges:
+                # Virtual link: next hops borrowed from the transit area's
+                # path to the vlink neighbor (§16.1).
+                nbr_rid = root_vlink_edges[e]
+                expand = (vlink_nexthops or {}).get(nbr_rid)
+                if expand:
+                    atom_ids[e] = len(atoms)
+                    atoms.append(NexthopAtom(None, None, expand))
+                continue
             link_data = root_edge_data.get(e)
             if is_router[v]:
                 # p2p neighbor: the link's own interface (parallel links
@@ -257,10 +283,14 @@ def atom_bits(words: np.ndarray, n_atoms: int) -> list[int]:
 
 
 def _atoms_of(words: np.ndarray, atoms: list[NexthopAtom]) -> frozenset[RouteNexthop]:
-    return frozenset(
-        RouteNexthop(atoms[a].ifname, atoms[a].addr)
-        for a in atom_bits(words, len(atoms))
-    )
+    out = set()
+    for a in atom_bits(words, len(atoms)):
+        atom = atoms[a]
+        if atom.expand is not None:
+            out |= atom.expand
+        else:
+            out.add(RouteNexthop(atom.ifname, atom.addr))
+    return frozenset(out)
 
 
 def derive_routes(

@@ -3,10 +3,9 @@
 For every OSPFv2 conformance topology shipped with the reference
 (SURVEY.md §4), the harness decodes the recorded LSAs with OUR codecs,
 runs OUR SPF/route pipeline per router, and requires the computed RIB to
-be bit-identical to the reference's expected local-rib.
-
-Known exclusions (documented unimplemented feature): routers whose
-expected routes depend on VIRTUAL LINKS (topo3-x rt1/rt6).
+be bit-identical to the reference's expected local-rib — all 63 routers
+across all topologies, including multi-area, virtual links, unnumbered
+and parallel links, ECMP and stub semantics.
 """
 
 from pathlib import Path
@@ -19,14 +18,6 @@ pytestmark = pytest.mark.skipif(
     not REFERENCE_CONFORMANCE.exists(),
     reason="reference conformance corpus not mounted",
 )
-
-# Routers reachable only through virtual links (not implemented yet).
-VLINK_EXCLUSIONS = {
-    ("topo3-1", "rt1"),
-    ("topo3-2", "rt1"),
-    ("topo3-2", "rt6"),
-    ("topo3-3", "rt1"),
-}
 
 
 def topo_dirs():
@@ -41,16 +32,7 @@ def topo_dirs():
 def test_reference_topology_rib_conformance(topo_name):
     results = run_topology(REFERENCE_CONFORMANCE / topo_name)
     assert results, "no routers loaded"
-    failures = {
-        rt: problems
-        for rt, problems in results.items()
-        if problems and (topo_name, rt) not in VLINK_EXCLUSIONS
-    }
+    failures = {rt: problems for rt, problems in results.items() if problems}
     assert not failures, "\n".join(
         f"{rt}: {p}" for rt, probs in failures.items() for p in probs
     )
-    # The exclusions must be exactly the vlink-dependent routers — if one
-    # starts passing (vlinks implemented), tighten the list.
-    for rt, problems in results.items():
-        if (topo_name, rt) in VLINK_EXCLUSIONS:
-            assert problems, f"{rt} now passes: remove from VLINK_EXCLUSIONS"
