@@ -13,7 +13,7 @@ from ipaddress import IPv4Address, IPv4Network
 
 import numpy as np
 
-from holo_tpu.ops.graph import INF, Topology
+from holo_tpu.ops.graph import INF, Topology, mutual_keep_mask
 from holo_tpu.protocols.isis.packet import (
     LSP_MAX_AGE,
     LSP_REFRESH,
@@ -65,6 +65,7 @@ class Adjacency:
     state: AdjacencyState = AdjacencyState.DOWN
     hold_time: int = 9
     addr: IPv4Address | None = None
+    addr6: object = None  # neighbor's link-local (RFC 5308 v6 next hop)
     priority: int = 64
     lan_id: bytes = b""  # DIS the neighbor declares
 
@@ -316,6 +317,10 @@ class IsisInstance(Actor):
         addrs = hello.tlvs.get("ip_addresses") or []
         if addrs:
             adj.addr = addrs[0]
+        for a6 in hello.tlvs.get("ipv6_addresses") or []:
+            if a6.is_link_local:
+                adj.addr6 = a6
+                break
         old = adj.state
         new = (
             AdjacencyState.UP
@@ -418,6 +423,10 @@ class IsisInstance(Actor):
         addrs = hello.tlvs.get("ip_addresses") or []
         if addrs:
             adj.addr = addrs[0]
+        for a6 in hello.tlvs.get("ipv6_addresses") or []:
+            if a6.is_link_local:
+                adj.addr6 = a6
+                break
         p2p = hello.tlvs.get("p2p_adj")
         they_see_us = p2p is not None and p2p.neighbor_sysid == self.sysid
         old = adj.state
@@ -730,77 +739,168 @@ class IsisInstance(Actor):
     def run_spf(self) -> None:
         self.spf_run_count += 1
         now = self.loop.clock.now()
+        MT_IPV6 = 2  # RFC 5120 IPv6 unicast topology id
         nodes: dict[bytes, dict] = {}  # key: sysid+pn byte
         for lid, e in self.lsdb.items():
             if e.remaining_lifetime(now) == 0:
                 continue
             key = lid.sysid + bytes((lid.pseudonode,))
-            node = nodes.setdefault(key, {"is": [], "ip": []})
-            node["is"].extend(e.lsp.tlvs.get("ext_is_reach", []))
-            node["ip"].extend(e.lsp.tlvs.get("ext_ip_reach", []))
+            node = nodes.setdefault(
+                key,
+                {"is": [], "ip": [], "ip6": [], "is6": [], "ip6mt": [],
+                 "flags": 0, "mt": {}, "protos": set()},
+            )
+            tlvs = e.lsp.tlvs
+            node["is"].extend(tlvs.get("ext_is_reach", []))
+            node["ip"].extend(tlvs.get("ext_ip_reach", []))
+            node["ip6"].extend(tlvs.get("ipv6_reach", []))
+            for mt_id, reach in tlvs.get("mt_is_reach", []):
+                if mt_id == 0:
+                    node["is"].append(reach)
+                elif mt_id == MT_IPV6:
+                    node["is6"].append(reach)
+            for mt_id, reach in tlvs.get("mt_ip_reach", []):
+                if mt_id == 0:
+                    node["ip"].append(reach)
+            for mt_id, reach in tlvs.get("mt_ipv6_reach", []):
+                if mt_id == MT_IPV6:
+                    node["ip6mt"].append(reach)
+            for mt_id, att, ovl in tlvs.get("mt_ids", []):
+                node["mt"][mt_id] = (att, ovl)
+            node["protos"] |= set(tlvs.get("protocols_supported") or [])
+            if lid.pseudonode == 0:
+                node["flags"] |= e.lsp.flags
 
         self_key = self.sysid + b"\x00"
         if self_key not in nodes:
             return
-        order = sorted(nodes.keys())
+        # Vertex ordering contract (same as OSPF): network vertices —
+        # pseudonodes — sort before routers, so equal-distance paths
+        # through a zero-cost pseudonode edge settle before the router
+        # they lead to and ECMP unions are not dropped.
+        order = sorted(nodes.keys(), key=lambda k: (k[6] == 0, k))
         index = {k: i for i, k in enumerate(order)}
         n = len(order)
         is_router = np.array([k[6] == 0 for k in order], bool)
-        src, dst, cost = [], [], []
-        for k, node in nodes.items():
-            u = index[k]
-            for reach in node["is"]:
-                v = index.get(reach.neighbor)
-                if v is not None:
-                    src.append(u), dst.append(v), cost.append(reach.metric)
-        topo = Topology(
-            n_vertices=n,
-            is_router=is_router,
-            edge_src=np.array(src, np.int32).reshape(-1),
-            edge_dst=np.array(dst, np.int32).reshape(-1),
-            edge_cost=np.array(cost, np.int32).reshape(-1),
-            root=index[self_key],
-        ).filter_mutual()
-
-        # Next-hop atoms: adjacencies out of the root.
-        atoms = []
-        atom_ids = np.full(topo.n_edges, -1, np.int32)
-        adj_by_sysid = {}  # neighbor node key -> (ifname, addr)
+        adj_by_sysid: dict[bytes, list] = {}  # key -> [(ifname, a4, a6)]
         lan_iface_by_id = {}  # pseudonode key -> ifname (LANs we sit on)
         for iface in self.interfaces.values():
             for adj in iface.up_adjacencies():
-                adj_by_sysid[adj.sysid + b"\x00"] = (iface.name, adj.addr)
+                adj_by_sysid.setdefault(adj.sysid + b"\x00", []).append(
+                    (iface.name, adj.addr, adj.addr6)
+                )
             if iface.is_lan and iface.dis_lan_id is not None:
                 lan_iface_by_id[iface.dis_lan_id] = iface.name
-        root_lans: set[int] = set()
-        for e_i in range(topo.n_edges):
-            if topo.edge_src[e_i] == topo.root:
-                k = order[int(topo.edge_dst[e_i])]
-                if k[6] == 0:  # router neighbor (p2p)
-                    hop = adj_by_sysid.get(k)
+
+        def _att(node, mt_id) -> bool:
+            """Attached bit for one topology: LSP flags nibble (0x78 —
+            the reference emits 0x40) for the default topology, the
+            RFC 5120 TLV-229 A bit for others."""
+            if mt_id == 0:
+                return bool(node["flags"] & 0x78)
+            return node["mt"].get(mt_id, (False, False))[0]
+
+        def _ovl(node, mt_id) -> bool:
+            """Overload bit per topology: LSP flags (ISO 10589) for the
+            default topology, the TLV-229 O bit for others."""
+            if mt_id == 0:
+                return bool(node["flags"] & 0x04)
+            return node["mt"].get(mt_id, (False, False))[1]
+
+        def _build(edges_of, mt_id):
+            """Topology + next-hop atoms for one edge selection (the
+            default topology, or the RFC 5120 MT-2 overlay)."""
+            src, dst, cost = [], [], []
+            for k, node in nodes.items():
+                u = index[k]
+                for reach in edges_of(k, node):
+                    v = index.get(reach.neighbor)
+                    if v is not None:
+                        src.append(u)
+                        dst.append(v)
+                        cost.append(reach.metric)
+            src = np.array(src, np.int32).reshape(-1)
+            dst = np.array(dst, np.int32).reshape(-1)
+            cost = np.array(cost, np.int32).reshape(-1)
+            keep = mutual_keep_mask(src, dst)
+            # Overload (ISO 10589 §7.2.8.1, reference spf.rs:563-574):
+            # an overloaded router stays REACHABLE — its own prefixes
+            # install — but is never expanded for transit.  Drop its
+            # out-edges AFTER the mutual filter so its in-edges survive.
+            ovl_vertices = {
+                index[k]
+                for k, node in nodes.items()
+                if k[6] == 0 and k != self_key and _ovl(node, mt_id)
+            }
+            if ovl_vertices:
+                keep &= ~np.isin(src, np.array(list(ovl_vertices), np.int32))
+            topo = Topology(
+                n_vertices=n,
+                is_router=is_router,
+                edge_src=src[keep],
+                edge_dst=dst[keep],
+                edge_cost=cost[keep],
+                root=index[self_key],
+            )
+            # Next-hop atoms: adjacencies out of the root.  A neighbor
+            # reached over parallel p2p circuits has one adjacency per
+            # circuit AND one duplicate is-reach edge per circuit — pair
+            # them up so each edge carries its own interface atom
+            # (reference spf next-hop model).
+            atoms = []
+            atom_ids = np.full(topo.n_edges, -1, np.int32)
+            root_lans: set[int] = set()
+            hops_used: dict[bytes, int] = {}
+            for e_i in range(topo.n_edges):
+                if topo.edge_src[e_i] == topo.root:
+                    k = order[int(topo.edge_dst[e_i])]
+                    if k[6] == 0:  # router neighbor (p2p)
+                        hops = adj_by_sysid.get(k)
+                        if hops:
+                            i = hops_used.get(k, 0)
+                            hops_used[k] = i + 1
+                            atom_ids[e_i] = len(atoms)
+                            atoms.append(hops[min(i, len(hops) - 1)])
+                    elif k in lan_iface_by_id:
+                        root_lans.add(int(topo.edge_dst[e_i]))
+            # Pseudonode -> member edges on root-adjacent LANs: direct
+            # next hop is the member's address on that LAN (the generic
+            # hops==0 rule).
+            for e_i in range(topo.n_edges):
+                u = int(topo.edge_src[e_i])
+                if u in root_lans:
+                    lan_key = order[u]
+                    member = order[int(topo.edge_dst[e_i])]
+                    if member == self_key:
+                        continue
+                    ifname = lan_iface_by_id.get(lan_key)
+                    hop = next(
+                        (h for h in adj_by_sysid.get(member, [])
+                         if h[0] == ifname),
+                        None,
+                    )
                     if hop is not None:
                         atom_ids[e_i] = len(atoms)
                         atoms.append(hop)
-                elif k in lan_iface_by_id:
-                    root_lans.add(int(topo.edge_dst[e_i]))
-        # Pseudonode -> member edges on root-adjacent LANs: direct next hop
-        # is the member's address on that LAN (the generic hops==0 rule).
-        for e_i in range(topo.n_edges):
-            u = int(topo.edge_src[e_i])
-            if u in root_lans:
-                lan_key = order[u]
-                member = order[int(topo.edge_dst[e_i])]
-                if member == self_key:
-                    continue
-                hop = adj_by_sysid.get(member)
-                ifname = lan_iface_by_id.get(lan_key)
-                if hop is not None and ifname == hop[0]:
-                    atom_ids[e_i] = len(atoms)
-                    atoms.append(hop)
-        topo.edge_direct_atom = atom_ids
-        topo.touch()
+            topo.edge_direct_atom = atom_ids
+            topo.touch()
+            return topo, atoms
 
-        res = self.backend.compute(topo)
+        topo, atoms4 = _build(lambda k, node: node["is"], 0)
+        res4 = self.backend.compute(topo)
+        # IPv6 path: routers running MT (RFC 5120) keep IPv6 in topology
+        # 2 — a separate graph (pseudonodes contribute their plain TLV-22
+        # membership; the mutual filter prunes members without an MT-2
+        # back edge).  Single-topology routers share the default SPF.
+        mt6 = MT_IPV6 in nodes[self_key]["mt"]
+        if mt6:
+            topo6, atoms6 = _build(
+                lambda k, node: node["is6"] if k[6] == 0 else node["is"],
+                MT_IPV6,
+            )
+            res6 = self.backend.compute(topo6)
+        else:
+            res6, atoms6 = res4, atoms4
 
         # Flooding-reduction cache rebuild (reference spf.rs:763-779):
         # per-neighbor hop-count SPTs via one multi-root batch.
@@ -830,23 +930,74 @@ class IsisInstance(Actor):
                         iface_by_vertex[n] for n in others
                     }
 
-        routes: dict[IPv4Network, tuple] = {}
+        from holo_tpu.protocols.ospf.spf_run import atom_bits
+
+        routes: dict = {}  # prefix (v4 or v6) -> (metric, {(ifname, addr)})
+
+        def _add(prefix, total, nhs):
+            cur = routes.get(prefix)
+            if cur is None or total < cur[0]:
+                routes[prefix] = (total, nhs)
+            elif total == cur[0]:
+                routes[prefix] = (total, cur[1] | nhs)
+
+        def _af_nexthops(res_, atoms_, v, want_v6):
+            triples = [
+                atoms_[a]
+                for a in atom_bits(res_.nexthop_words[v], len(atoms_))
+            ]
+            if want_v6:
+                return frozenset((ifn, a6) for ifn, _, a6 in triples)
+            return frozenset((ifn, a4) for ifn, a4, _ in triples)
+
         for k, node in nodes.items():
             v = index[k]
-            if res.dist[v] >= INF:
-                continue
-            from holo_tpu.protocols.ospf.spf_run import atom_bits
+            if res4.dist[v] < INF and node["ip"]:
+                nhs4 = _af_nexthops(res4, atoms4, v, False)
+                for reach in node["ip"]:
+                    _add(reach.prefix, int(res4.dist[v]) + reach.metric, nhs4)
+            ip6_list = node["ip6mt"] if mt6 else node["ip6"]
+            if res6.dist[v] < INF and ip6_list:
+                nhs6 = _af_nexthops(res6, atoms6, v, True)
+                for reach in ip6_list:
+                    _add(reach.prefix, int(res6.dist[v]) + reach.metric, nhs6)
 
-            nhs = frozenset(
-                atoms[a] for a in atom_bits(res.nexthop_words[v], len(atoms))
-            )
-            for reach in node["ip"]:
-                total = int(res.dist[v]) + reach.metric
-                cur = routes.get(reach.prefix)
-                if cur is None or total < cur[0]:
-                    routes[reach.prefix] = (total, nhs)
-                elif total == cur[0]:
-                    routes[reach.prefix] = (total, cur[1] | nhs)
+        # Level-1 routers that are not themselves attached install a
+        # per-AF default route toward the nearest attached router(s),
+        # ECMP across equal-cost exits (ISO 10589 §7.2.9.2; ATT nibble
+        # 0x78 — the reference emits 0x40).
+        if self.level == 1:
+            from ipaddress import IPv6Network
+
+            for want_v6, res_, atoms_, proto, default in (
+                (False, res4, atoms4, 0xCC, IPv4Network("0.0.0.0/0")),
+                (True, res6, atoms6, 0x8E, IPv6Network("::/0")),
+            ):
+                mt_id = MT_IPV6 if (want_v6 and mt6) else 0
+                if _att(nodes[self_key], mt_id):
+                    continue  # we are an exit ourselves in this topology
+                best = None
+                nhs = frozenset()
+                for k, node in nodes.items():
+                    if k[6] != 0 or k == self_key:
+                        continue
+                    # Reference spf.rs:870-876: att && !overload — an
+                    # overloaded exit must not attract default traffic.
+                    if not _att(node, mt_id) or _ovl(node, mt_id):
+                        continue
+                    if node["protos"] and proto not in node["protos"]:
+                        continue  # exit must route this address family
+                    v = index[k]
+                    d = int(res_.dist[v])
+                    if d >= INF:
+                        continue
+                    cur = _af_nexthops(res_, atoms_, v, want_v6)
+                    if best is None or d < best:
+                        best, nhs = d, cur
+                    elif d == best:
+                        nhs |= cur
+                if best is not None:
+                    _add(default, best, nhs)
         self.routes = routes
         if self.route_cb is not None:
             self.route_cb(routes)

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from ipaddress import IPv4Address, IPv4Network
+from ipaddress import IPv4Address, IPv4Network, IPv6Network
 
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, fletcher16_checksum, fletcher16_verify
 
@@ -32,11 +32,21 @@ class PduType(enum.IntEnum):
 
 class TlvType(enum.IntEnum):
     AREA_ADDRESSES = 1
+    IS_REACH = 2  # ISO 10589 narrow-metric IS reachability
     IS_NEIGHBORS = 6  # LAN hellos: heard SNPAs
+    IP_INTERNAL_REACH = 128  # RFC 1195 narrow-metric IP reachability
     PROTOCOLS_SUPPORTED = 129
+    IP_EXTERNAL_REACH = 130
     IP_INTERFACE_ADDRESS = 132
     EXT_IS_REACH = 22
     EXT_IP_REACH = 135
+    DYNAMIC_HOSTNAME = 137  # RFC 5301
+    MT_IS_REACH = 222  # RFC 5120 multi-topology
+    MULTI_TOPOLOGY = 229
+    IPV6_INTERFACE_ADDRESS = 232  # RFC 5308
+    MT_IP_REACH = 235
+    IPV6_REACH = 236
+    MT_IPV6_REACH = 237
     LSP_ENTRIES = 9
     P2P_ADJ_STATE = 240  # RFC 5303 three-way handshake
 
@@ -68,7 +78,7 @@ class ExtIsReach:
 
 @dataclass(frozen=True)
 class ExtIpReach:
-    prefix: IPv4Network
+    prefix: IPv4Network | IPv6Network  # v6 when carried in TLV 236
     metric: int
     up_down: bool = False
 
@@ -100,6 +110,12 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
     if tlvs.get("ip_addresses"):
         body = b"".join(a.packed for a in tlvs["ip_addresses"])
         w.u8(TlvType.IP_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+    if tlvs.get("ipv6_addresses"):
+        body = b"".join(a.packed for a in tlvs["ipv6_addresses"])
+        w.u8(TlvType.IPV6_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+    if tlvs.get("hostname"):
+        body = tlvs["hostname"].encode("ascii", "replace")
+        w.u8(TlvType.DYNAMIC_HOSTNAME).u8(len(body)).bytes(body)
     if tlvs.get("p2p_adj") is not None:
         adj: P2pAdjState = tlvs["p2p_adj"]
         body = bytes((int(adj.state),)) + adj.ext_circuit_id.to_bytes(4, "big")
@@ -120,6 +136,17 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             body += r.metric.to_bytes(4, "big") + bytes((ctrl,))
             body += r.prefix.network_address.packed[:plen_bytes]
         w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
+    # Max 11 entries per TLV: a full-length /128 entry is 22 bytes and
+    # the TLV length octet caps the body at 255 (11*22=242).
+    for reach in _chunks(tlvs.get("ipv6_reach", []), 11):
+        body = b""
+        for r in reach:
+            ctrl = 0x80 if r.up_down else 0
+            plen_bytes = (r.prefix.prefixlen + 7) // 8
+            body += r.metric.to_bytes(4, "big")
+            body += bytes((ctrl, r.prefix.prefixlen))
+            body += r.prefix.network_address.packed[:plen_bytes]
+        w.u8(TlvType.IPV6_REACH).u8(len(body)).bytes(body)
     if tlvs.get("lsp_entries"):
         for chunk in _chunks(tlvs["lsp_entries"], 15):
             body = b""
@@ -134,14 +161,67 @@ def _chunks(seq, n):
     return [seq[i : i + n] for i in range(0, len(seq), n)] if seq else []
 
 
+def _read_wide_is_entries(body: Reader, out: list) -> None:
+    """TLV 22/222 entry stream: 7B neighbor + 3B metric + sub-TLVs."""
+    while body.remaining() >= 11:
+        nbr = body.bytes(7)
+        metric = body.u24()
+        sub_len = body.u8()
+        body.bytes(min(sub_len, body.remaining()))
+        out.append(ExtIsReach(nbr, metric))
+
+
+def _read_wide_ip_entries(body: Reader, out: list) -> None:
+    """TLV 135/235 entry stream: u32 metric + ctrl + truncated prefix."""
+    while body.remaining() >= 5:
+        metric = body.u32()
+        ctrl = body.u8()
+        plen = ctrl & 0x3F
+        if plen > 32:
+            raise DecodeError("bad prefix length")
+        nbytes = (plen + 7) // 8
+        raw = body.bytes(nbytes) + bytes(4 - nbytes)
+        if ctrl & 0x40:  # sub-TLVs present
+            sl = body.u8()
+            body.bytes(min(sl, body.remaining()))
+        prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
+        out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80)))
+
+
+def _read_ipv6_entries(body: Reader, out: list) -> None:
+    """TLV 236/237 entry stream (RFC 5308 §2): metric u32, control byte
+    (U/X/S), prefix-len, truncated prefix, optional sub-TLVs."""
+    while body.remaining() >= 6:
+        metric = body.u32()
+        ctrl = body.u8()
+        plen = body.u8()
+        if plen > 128:
+            raise DecodeError("bad v6 prefix length")
+        nbytes = (plen + 7) // 8
+        raw = body.bytes(nbytes) + bytes(16 - nbytes)
+        if ctrl & 0x20:  # sub-TLVs present
+            sl = body.u8()
+            body.bytes(min(sl, body.remaining()))
+        prefix = IPv6Network((int.from_bytes(raw, "big"), plen))
+        out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80)))
+
+
 def _decode_tlvs(r: Reader) -> dict:
     out: dict = {
         "area_addresses": [],
         "is_neighbors": [],
         "protocols_supported": [],
         "ip_addresses": [],
+        "ipv6_addresses": [],
         "ext_is_reach": [],
         "ext_ip_reach": [],
+        "ipv6_reach": [],
+        # RFC 5120 multi-topology: (mt_id, att, ovl) / (mt_id, entry).
+        "mt_ids": [],
+        "mt_is_reach": [],
+        "mt_ip_reach": [],
+        "mt_ipv6_reach": [],
+        "hostname": None,
         "lsp_entries": [],
         "p2p_adj": None,
     }
@@ -172,29 +252,62 @@ def _decode_tlvs(r: Reader) -> dict:
                 nbr_sys = body.bytes(6)
                 nbr_ext = int.from_bytes(body.bytes(4), "big")
             out["p2p_adj"] = P2pAdjState(state, ext_id, nbr_sys, nbr_ext)
-        elif t == TlvType.EXT_IS_REACH:
+        elif t == TlvType.IS_REACH:
+            # ISO 10589 §9.8: virtual-flag byte, then 11-byte entries of
+            # four metric octets + 7-byte neighbor id.  Only the default
+            # metric (low 6 bits) is used; decoded into the same unified
+            # reach list the wide TLV (22) fills.
+            if body.remaining() >= 1:
+                body.u8()  # virtual flag
             while body.remaining() >= 11:
+                metric = body.u8() & 0x3F
+                body.bytes(3)  # delay/expense/error metrics (unsupported)
                 nbr = body.bytes(7)
-                metric = body.u24()
-                sub_len = body.u8()
-                body.bytes(min(sub_len, body.remaining()))
                 out["ext_is_reach"].append(ExtIsReach(nbr, metric))
+        elif t in (TlvType.IP_INTERNAL_REACH, TlvType.IP_EXTERNAL_REACH):
+            # RFC 1195 §3.2: 12-byte entries of four metric octets +
+            # address + mask.
+            while body.remaining() >= 12:
+                metric = body.u8() & 0x3F
+                body.bytes(3)
+                addr = int.from_bytes(body.bytes(4), "big")
+                mask = int.from_bytes(body.bytes(4), "big")
+                plen = bin(mask).count("1")
+                prefix = IPv4Network((addr & mask, plen))
+                out["ext_ip_reach"].append(ExtIpReach(prefix, metric))
+        elif t == TlvType.EXT_IS_REACH:
+            _read_wide_is_entries(body, out["ext_is_reach"])
         elif t == TlvType.EXT_IP_REACH:
-            while body.remaining() >= 5:
-                metric = body.u32()
-                ctrl = body.u8()
-                plen = ctrl & 0x3F
-                if plen > 32:
-                    raise DecodeError("bad prefix length")
-                nbytes = (plen + 7) // 8
-                raw = body.bytes(nbytes) + bytes(4 - nbytes)
-                if ctrl & 0x40:  # sub-TLVs present
-                    sl = body.u8()
-                    body.bytes(min(sl, body.remaining()))
-                prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
-                out["ext_ip_reach"].append(
-                    ExtIpReach(prefix, metric, bool(ctrl & 0x80))
+            _read_wide_ip_entries(body, out["ext_ip_reach"])
+        elif t == TlvType.IPV6_INTERFACE_ADDRESS:
+            while body.remaining() >= 16:
+                out["ipv6_addresses"].append(body.ipv6())
+        elif t == TlvType.DYNAMIC_HOSTNAME:
+            out["hostname"] = body.rest().decode("ascii", "replace")
+        elif t == TlvType.IPV6_REACH:
+            _read_ipv6_entries(body, out["ipv6_reach"])
+        elif t == TlvType.MULTI_TOPOLOGY:
+            # RFC 5120 §7.1: u16 per topology — O(15) A(14) + 12-bit id.
+            while body.remaining() >= 2:
+                v = body.u16()
+                out["mt_ids"].append(
+                    (v & 0x0FFF, bool(v & 0x4000), bool(v & 0x8000))
                 )
+        elif t in (TlvType.MT_IS_REACH, TlvType.MT_IP_REACH,
+                   TlvType.MT_IPV6_REACH):
+            # RFC 5120 §7.2-7.4: 12-bit MT id, then the same entry stream
+            # as the corresponding single-topology TLV (22/135/236).
+            mt_id = body.u16() & 0x0FFF
+            entries: list = []
+            if t == TlvType.MT_IS_REACH:
+                _read_wide_is_entries(body, entries)
+                out["mt_is_reach"].extend((mt_id, e) for e in entries)
+            elif t == TlvType.MT_IP_REACH:
+                _read_wide_ip_entries(body, entries)
+                out["mt_ip_reach"].extend((mt_id, e) for e in entries)
+            else:
+                _read_ipv6_entries(body, entries)
+                out["mt_ipv6_reach"].extend((mt_id, e) for e in entries)
         elif t == TlvType.LSP_ENTRIES:
             while body.remaining() >= 16:
                 lifetime = body.u16()

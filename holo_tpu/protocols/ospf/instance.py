@@ -1466,7 +1466,6 @@ class OspfInstance(Actor):
         area_results: dict[IPv4Address, tuple] = {}
         # Backbone last: its SPF consumes transit-area results for virtual
         # links (§16.1 — vlink next hops come from the transit area).
-        backbone_id = IPv4Address(0)
         ordered_areas = sorted(
             self.areas.values(), key=lambda a: int(a.area_id) == 0
         )
@@ -1674,19 +1673,28 @@ class OspfInstance(Actor):
         e = backbone.lsdb.get(key)
         if e is None:
             return {}
-        out = {}
+        from holo_tpu.ops.graph import INF
+
+        # The transit area is the one actually carrying the vlink
+        # (§16.1).  Without per-vlink config we pick it deterministically:
+        # the area giving the shortest intra-area path to the endpoint,
+        # lowest area-id on ties — never dict iteration order.
+        best: dict = {}  # rid -> (dist, area id, nhs)
         for link in e.lsa.body.links:
             if link.link_type != RouterLinkType.VIRTUAL_LINK:
                 continue
             for aid, (st, res) in area_results.items():
                 v = st.router_index.get(link.id)
-                if v is None or res.dist[v] >= 0x40000000:
+                if v is None or res.dist[v] >= INF:
                     continue
                 nhs = _atoms_of(res.nexthop_words[v], st.atoms)
-                if nhs:
-                    out[link.id] = nhs
-                    break
-        return out
+                if not nhs:
+                    continue
+                cand = (int(res.dist[v]), int(aid))
+                cur = best.get(link.id)
+                if cur is None or cand < cur[:2]:
+                    best[link.id] = (*cand, nhs)
+        return {rid: nhs for rid, (_d, _a, nhs) in best.items()}
 
     def _originate_asbr_summaries(self, area_results: dict) -> None:
         """ABR: type-4 ASBR-summary LSAs (§12.4.3) so other areas can

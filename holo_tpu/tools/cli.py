@@ -140,15 +140,23 @@ def cmd_replay(args) -> int:
 def cmd_conformance(args) -> int:
     from pathlib import Path
 
-    from holo_tpu.tools.conformance import REFERENCE_CONFORMANCE, run_topology
+    if getattr(args, "protocol", "ospf") == "isis":
+        from holo_tpu.tools.conformance_isis import (
+            REFERENCE_CONFORMANCE_ISIS as corpus,
+            run_topology,
+        )
+    else:
+        from holo_tpu.tools.conformance import (
+            REFERENCE_CONFORMANCE as corpus,
+            run_topology,
+        )
 
     if args.topo_dir:
         dirs = [Path(args.topo_dir)]
-    elif REFERENCE_CONFORMANCE.exists():
-        dirs = sorted(p for p in REFERENCE_CONFORMANCE.iterdir() if p.is_dir())
+    elif corpus.exists():
+        dirs = sorted(p for p in corpus.iterdir() if p.is_dir())
     else:
-        print(f"conformance corpus not found at {REFERENCE_CONFORMANCE}",
-              file=sys.stderr)
+        print(f"conformance corpus not found at {corpus}", file=sys.stderr)
         return 2
     total = ok = 0
     failed = False
@@ -188,6 +196,7 @@ def main(argv=None) -> int:
     )
     s.add_argument("topo_dir", nargs="?",
                    help="one topology dir (default: all)")
+    s.add_argument("--protocol", choices=("ospf", "isis"), default="ospf")
     s.set_defaults(fn=cmd_conformance)
     args = ap.parse_args(argv)
     return args.fn(args)

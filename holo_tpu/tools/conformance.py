@@ -155,13 +155,15 @@ class _NullIo(NetIo):
         pass
 
 
-def compute_routes(rd: RouterData, lsdb_by_area: dict, routers: dict):
+def compute_routes(rd: RouterData, lsdb_by_area: dict, routers: dict,
+                   backend=None):
     """Run OUR pipeline for one router over the converged LSDB."""
     loop = EventLoop(clock=VirtualClock())
     inst = OspfInstance(
         name=f"conf-{rd.name}",
         config=InstanceConfig(router_id=rd.router_id),
         netio=_NullIo(),
+        spf_backend=backend,
     )
     loop.register(inst)
 
@@ -260,11 +262,14 @@ def compare_router(rd: RouterData, routes: dict) -> list[str]:
     return problems
 
 
-def run_topology(topo_dir: Path) -> dict[str, list[str]]:
+def run_topology(topo_dir: Path, backend_factory=None) -> dict[str, list[str]]:
+    """backend_factory: () -> SpfBackend (None = scalar default); passing
+    TpuSpfBackend proves the TENSOR engine reproduces the reference RIBs."""
     routers = load_topology(topo_dir)
     lsdb = converged_lsdb(routers)
     results = {}
     for name, rd in sorted(routers.items()):
-        routes = compute_routes(rd, lsdb, routers)
+        backend = backend_factory() if backend_factory else None
+        routes = compute_routes(rd, lsdb, routers, backend)
         results[name] = compare_router(rd, routes)
     return results

@@ -1,0 +1,50 @@
+"""IS-IS conformance against the reference's own recorded expectations.
+
+For every IS-IS conformance topology shipped with the reference
+(SURVEY.md §4), the harness decodes the recorded PDUs with OUR codecs
+(narrow TLV 2/128 and wide TLV 22/135 metrics, RFC 5308 IPv6, RFC 5120
+multi-topology), runs OUR SPF/route pipeline per router per level, and
+requires the computed RIB — IPv4 AND IPv6, including L1 ATT-bit default
+routes and L1-over-L2 preference — to be bit-identical to the
+reference's expected local-rib: all 38 routers across 6 topologies.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.conformance_isis import (
+    REFERENCE_CONFORMANCE_ISIS,
+    run_topology,
+)
+
+pytestmark = pytest.mark.skipif(
+    not REFERENCE_CONFORMANCE_ISIS.exists(),
+    reason="reference conformance corpus not mounted",
+)
+
+
+def topo_dirs():
+    if not REFERENCE_CONFORMANCE_ISIS.exists():
+        return []
+    return sorted(
+        p.name for p in REFERENCE_CONFORMANCE_ISIS.iterdir() if p.is_dir()
+    )
+
+
+@pytest.mark.parametrize("backend", ["scalar", "tpu"])
+@pytest.mark.parametrize("topo_name", topo_dirs())
+def test_reference_topology_rib_conformance(topo_name, backend):
+    """Both backends — the scalar oracle AND the tensor engine — must
+    reproduce the reference's expected RIBs bit-identically."""
+    factory = None
+    if backend == "tpu":
+        from holo_tpu.spf.backend import TpuSpfBackend
+
+        factory = TpuSpfBackend
+    results = run_topology(REFERENCE_CONFORMANCE_ISIS / topo_name, factory)
+    assert results, "no routers loaded"
+    failures = {rt: problems for rt, problems in results.items() if problems}
+    assert not failures, "\n".join(
+        f"{rt}: {p}" for rt, probs in failures.items() for p in probs
+    )

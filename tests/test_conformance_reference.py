@@ -28,9 +28,17 @@ def topo_dirs():
     )
 
 
+@pytest.mark.parametrize("backend", ["scalar", "tpu"])
 @pytest.mark.parametrize("topo_name", topo_dirs())
-def test_reference_topology_rib_conformance(topo_name):
-    results = run_topology(REFERENCE_CONFORMANCE / topo_name)
+def test_reference_topology_rib_conformance(topo_name, backend):
+    """Both backends — the scalar oracle AND the tensor engine — must
+    reproduce the reference's expected RIBs bit-identically."""
+    factory = None
+    if backend == "tpu":
+        from holo_tpu.spf.backend import TpuSpfBackend
+
+        factory = TpuSpfBackend
+    results = run_topology(REFERENCE_CONFORMANCE / topo_name, factory)
     assert results, "no routers loaded"
     failures = {rt: problems for rt, problems in results.items() if problems}
     assert not failures, "\n".join(

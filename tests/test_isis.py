@@ -319,3 +319,58 @@ def test_lsp_retransmission_on_loss():
     e1 = r1.lsdb[list(r1.lsdb)[0]]
     lid = LspId(sysid(1))
     assert r2.lsdb[lid].lsp.seqno == r1.lsdb[lid].lsp.seqno
+
+
+def test_overload_reachable_but_no_transit():
+    """ISO 10589 §7.2.8.1 (reference spf.rs:563-574): an overloaded
+    router's own prefixes still install, but nothing routes THROUGH it."""
+    from holo_tpu.protocols.isis.instance import Adjacency, LspEntry
+
+    loop = EventLoop(clock=VirtualClock())
+    inst = IsisInstance("a", sysid(1))
+    loop.register(inst)
+    inst.add_interface("e0", IsisIfConfig(metric=10),
+                       A("10.0.12.1"), N("10.0.12.0/24"))
+    inst.interfaces["e0"].adj = Adjacency(
+        sysid=sysid(2), state=AdjacencyState.UP, addr=A("10.0.12.2")
+    )
+
+    def mk(owner, nbrs, prefix, flags=0x03):
+        return Lsp(
+            2, 1200, LspId(sysid(owner)), 1, flags,
+            tlvs={
+                "ext_is_reach": [ExtIsReach(sysid(x) + b"\x00", 10)
+                                 for x in nbrs],
+                "ext_ip_reach": [ExtIpReach(N(prefix), 0)],
+            },
+        )
+
+    for lsp in (
+        mk(1, [2], "1.1.1.1/32"),
+        mk(2, [1, 3], "2.2.2.2/32", flags=0x03 | 0x04),  # overloaded
+        mk(3, [2], "3.3.3.3/32"),
+    ):
+        lsp.encode()
+        inst.lsdb[lsp.lsp_id] = LspEntry(lsp, 0.0)
+    inst.run_spf()
+    # B itself is reachable (its loopback installs)…
+    assert inst.routes[N("2.2.2.2/32")][0] == 10
+    # …but C, only reachable THROUGH overloaded B, is not.
+    assert N("3.3.3.3/32") not in inst.routes
+
+
+def test_ipv6_reach_tlv_chunking_roundtrip():
+    """15 full-length /128 entries exceed one TLV body (255B): the
+    encoder must split them and the decoder must recover all of them."""
+    from ipaddress import IPv6Network
+
+    prefixes = [IPv6Network(f"2001:db8::{i:x}/128") for i in range(1, 16)]
+    lsp = Lsp(
+        2, 1200, LspId(sysid(1)), 1,
+        tlvs={"ipv6_reach": [ExtIpReach(p, i)
+                             for i, p in enumerate(prefixes)]},
+    )
+    raw = lsp.encode()
+    t, out = decode_pdu(raw)
+    assert [r.prefix for r in out.tlvs["ipv6_reach"]] == prefixes
+    assert [r.metric for r in out.tlvs["ipv6_reach"]] == list(range(15))
