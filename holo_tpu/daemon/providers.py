@@ -333,8 +333,12 @@ class RoutingProvider(Provider, Actor):
                     is_backbone = int(IPv4Address(area_id)) == 0
                 except Exception:
                     is_backbone = area_id in ("0", "0.0.0.0")
-                if is_backbone and area_conf.get("area-type") == "stub":
-                    raise CommitError("the backbone area cannot be stub")
+                if is_backbone and area_conf.get("area-type") in (
+                    "stub", "nssa"
+                ):
+                    raise CommitError(
+                        "the backbone area cannot be stub or NSSA"
+                    )
 
     def __init__(
         self,
@@ -541,7 +545,9 @@ class RoutingProvider(Provider, Actor):
 
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
-            stub = area_conf.get("area-type", "normal") == "stub"
+            area_type = area_conf.get("area-type", "normal")
+            stub = area_type == "stub"
+            nssa = area_type == "nssa"
             stub_cost = area_conf.get("default-cost", 1)
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst._if_area:
@@ -569,12 +575,14 @@ class RoutingProvider(Provider, Actor):
                     auth=self._ospf_auth(if_conf.get("authentication")),
                 )
                 inst.add_interface(ifname, cfg, addr, host, stub=stub,
-                                   stub_default_cost=stub_cost)
+                                   stub_default_cost=stub_cost, nssa=nssa)
                 self.loop.send(inst.name, IfUpMsg(ifname))
             # area-type reconfig on an existing area (no new interfaces):
             aid = IPv4Address(area_id)
-            if aid in inst.areas and inst.areas[aid].stub != stub:
-                inst.set_area_stub(aid, stub)
+            if aid in inst.areas and (
+                inst.areas[aid].stub != stub or inst.areas[aid].nssa != nssa
+            ):
+                inst.set_area_type(aid, stub=stub, nssa=nssa)
         if redist_changed:
             self._reconcile_redistribution(inst)
 

@@ -76,6 +76,8 @@ class IsisInterface:
     config: IsisIfConfig
     addr_ip: IPv4Address
     prefix: IPv4Network
+    addr6: object = None  # our link-local (RFC 5308 hello TLV 232)
+    prefix6: object = None  # advertised global v6 prefix (TLV 236)
     circuit_id: int = 1
     adj: Adjacency | None = None  # p2p: single adjacency
     adjs: dict = field(default_factory=dict)  # LAN: sysid -> Adjacency
@@ -179,6 +181,9 @@ class IsisInstance(Actor):
         self.interfaces: dict[str, IsisInterface] = {}
         self.lsdb: dict[LspId, LspEntry] = {}
         self.routes: dict[IPv4Network, tuple] = {}
+        # RFC 5301 dynamic hostnames learned from LSPs (sysid -> name).
+        self.hostname = name
+        self.hostnames: dict[bytes, str] = {}
         self.spf_run_count = 0
         self._spf_pending = False
         # Flooding reduction: per-sender coverage map rebuilt after each
@@ -194,9 +199,10 @@ class IsisInstance(Actor):
         self._flood_timer = self.loop.timer(self.name, FloodTimerMsg)
         self._spf_timer = self.loop.timer(self.name, SpfTimerMsg)
 
-    def add_interface(self, ifname: str, cfg: IsisIfConfig, addr: IPv4Address, prefix: IPv4Network):
+    def add_interface(self, ifname: str, cfg: IsisIfConfig, addr: IPv4Address, prefix: IPv4Network, addr6=None, prefix6=None):
         self.interfaces[ifname] = IsisInterface(
             name=ifname, config=cfg, addr_ip=addr, prefix=prefix,
+            addr6=addr6, prefix6=prefix6,
             circuit_id=len(self.interfaces) + 1,
         )
 
@@ -264,6 +270,9 @@ class IsisInstance(Actor):
                     "area_addresses": [self.area],
                     "protocols_supported": [0xCC],
                     "ip_addresses": [iface.addr_ip],
+                    "ipv6_addresses": (
+                        [iface.addr6] if iface.addr6 is not None else []
+                    ),
                     # SNPAs on the fabric are system ids.
                     "is_neighbors": sorted(iface.adjs.keys()),
                 },
@@ -289,6 +298,9 @@ class IsisInstance(Actor):
                     "area_addresses": [self.area],
                     "protocols_supported": [0xCC],  # IPv4
                     "ip_addresses": [iface.addr_ip],
+                    "ipv6_addresses": (
+                        [iface.addr6] if iface.addr6 is not None else []
+                    ),
                     "p2p_adj": P2pAdjState(
                         state, iface.circuit_id, nbr_sys,
                         iface.circuit_id if nbr_sys else None,
@@ -498,8 +510,16 @@ class IsisInstance(Actor):
         old = self.lsdb.get(lsp_id)
         is_reach = []
         ip_reach = []
+        ip6_reach = []
+        ip6_addrs = []
         for iface in self.interfaces.values():
             ip_reach.append(ExtIpReach(iface.prefix, iface.config.metric))
+            if iface.prefix6 is not None:
+                ip6_reach.append(
+                    ExtIpReach(iface.prefix6, iface.config.metric)
+                )
+            if iface.addr6 is not None:
+                ip6_addrs.append(iface.addr6)
             if iface.is_lan:
                 if iface.dis_lan_id is not None and iface.up_adjacencies():
                     # LAN: advertise reach to the pseudonode.
@@ -510,11 +530,15 @@ class IsisInstance(Actor):
                 is_reach.append(
                     ExtIsReach(iface.adj.sysid + b"\x00", iface.config.metric)
                 )
+        protos = [0xCC] + ([0x8E] if (ip6_reach or ip6_addrs) else [])
         tlvs = {
             "area_addresses": [self.area],
-            "protocols_supported": [0xCC],
+            "protocols_supported": protos,
+            "hostname": self.hostname,
             "ext_is_reach": is_reach,
             "ext_ip_reach": ip_reach,
+            "ipv6_reach": ip6_reach,
+            "ipv6_addresses": ip6_addrs,
         }
         seqno = max((old.lsp.seqno + 1) if old else 1, min_seqno)
         lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
@@ -559,6 +583,13 @@ class IsisInstance(Actor):
     def _install_lsp(self, lsp: Lsp, flood_from: str | None) -> None:
         now = self.loop.clock.now()
         self.lsdb[lsp.lsp_id] = LspEntry(lsp, now)
+        # RFC 5301: learn/forget the originator's dynamic hostname.
+        if lsp.lsp_id.pseudonode == 0 and lsp.lsp_id.fragment == 0:
+            name = lsp.tlvs.get("hostname")
+            if name and lsp.lifetime > 0:
+                self.hostnames[lsp.lsp_id.sysid] = name
+            else:
+                self.hostnames.pop(lsp.lsp_id.sysid, None)
         # Flooding reduction: interfaces whose neighbor the SENDER also
         # covers (sound: the sender floods its own neighborhood; periodic
         # CSNPs recover stale-coverage windows).
@@ -933,13 +964,17 @@ class IsisInstance(Actor):
         from holo_tpu.protocols.ospf.spf_run import atom_bits
 
         routes: dict = {}  # prefix (v4 or v6) -> (metric, {(ifname, addr)})
+        rank_of: dict = {}  # prefix -> (external, metric): RFC 1195
+        # §3.10.2 internal paths beat external regardless of metric.
 
-        def _add(prefix, total, nhs):
-            cur = routes.get(prefix)
-            if cur is None or total < cur[0]:
+        def _add(prefix, total, nhs, external=False):
+            rank = (external, total)
+            cur = rank_of.get(prefix)
+            if cur is None or rank < cur:
+                rank_of[prefix] = rank
                 routes[prefix] = (total, nhs)
-            elif total == cur[0]:
-                routes[prefix] = (total, cur[1] | nhs)
+            elif rank == cur:
+                routes[prefix] = (total, routes[prefix][1] | nhs)
 
         def _af_nexthops(res_, atoms_, v, want_v6):
             triples = [
@@ -955,7 +990,8 @@ class IsisInstance(Actor):
             if res4.dist[v] < INF and node["ip"]:
                 nhs4 = _af_nexthops(res4, atoms4, v, False)
                 for reach in node["ip"]:
-                    _add(reach.prefix, int(res4.dist[v]) + reach.metric, nhs4)
+                    _add(reach.prefix, int(res4.dist[v]) + reach.metric,
+                         nhs4, reach.external)
             ip6_list = node["ip6mt"] if mt6 else node["ip6"]
             if res6.dist[v] < INF and ip6_list:
                 nhs6 = _af_nexthops(res6, atoms6, v, True)

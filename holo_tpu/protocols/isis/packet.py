@@ -81,6 +81,9 @@ class ExtIpReach:
     prefix: IPv4Network | IPv6Network  # v6 when carried in TLV 236
     metric: int
     up_down: bool = False
+    # RFC 1195 internal/external distinction (narrow TLV 130 or the I/E
+    # metric bit); wide TLVs dropped it, so False there.
+    external: bool = False
 
 
 class AdjState3Way(enum.IntEnum):
@@ -266,15 +269,20 @@ def _decode_tlvs(r: Reader) -> dict:
                 out["ext_is_reach"].append(ExtIsReach(nbr, metric))
         elif t in (TlvType.IP_INTERNAL_REACH, TlvType.IP_EXTERNAL_REACH):
             # RFC 1195 §3.2: 12-byte entries of four metric octets +
-            # address + mask.
+            # address + mask.  Bit 6 of the default metric is I/E.
             while body.remaining() >= 12:
-                metric = body.u8() & 0x3F
+                m = body.u8()
                 body.bytes(3)
                 addr = int.from_bytes(body.bytes(4), "big")
                 mask = int.from_bytes(body.bytes(4), "big")
                 plen = bin(mask).count("1")
                 prefix = IPv4Network((addr & mask, plen))
-                out["ext_ip_reach"].append(ExtIpReach(prefix, metric))
+                external = (
+                    t == TlvType.IP_EXTERNAL_REACH or bool(m & 0x40)
+                )
+                out["ext_ip_reach"].append(
+                    ExtIpReach(prefix, m & 0x3F, external=external)
+                )
         elif t == TlvType.EXT_IS_REACH:
             _read_wide_is_entries(body, out["ext_is_reach"])
         elif t == TlvType.EXT_IP_REACH:

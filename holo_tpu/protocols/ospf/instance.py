@@ -153,9 +153,15 @@ class Area:
     lsdb: Lsdb = field(default_factory=Lsdb)
     interfaces: dict[str, OspfInterface] = field(default_factory=dict)
     # RFC 2328 stub areas: no type-5 flooding; ABRs inject a default
-    # summary with this cost instead.  (NSSA later.)
+    # summary with this cost instead.  RFC 3101 NSSA: no type-5s either,
+    # but type-7s circulate inside and the elected ABR translates them.
     stub: bool = False
+    nssa: bool = False
     stub_default_cost: int = 1
+
+    @property
+    def no_type5(self) -> bool:
+        return self.stub or self.nssa
 
 
 @dataclass
@@ -215,6 +221,9 @@ class OspfInstance(Actor):
         # install-time cross-area propagation = AS flooding scope).
         self.redistributed: dict[IPv4Network, ExternalRoute] = {}
         self._external_lsids: dict[IPv4Network, IPv4Address] = {}
+        # Prefixes we currently translate type-7 -> type-5 for (RFC 3101
+        # §3, elected NSSA ABR translator duty).
+        self._nssa_translated: set[IPv4Network] = set()
         # Segment routing state (labels resolved after each SPF).
         self.sr_labels: dict = {}
         self._sr_opaque_ids: dict[IPv4Network, int] = {}
@@ -242,16 +251,19 @@ class OspfInstance(Actor):
         addr_ip: IPv4Address,
         stub: bool = False,
         stub_default_cost: int = 1,
+        nssa: bool = False,
     ) -> OspfInterface:
-        """Area type is part of area creation — the stub flag must be set
-        BEFORE any LSA origination touches the area."""
+        """Area type is part of area creation — the stub/NSSA flags must
+        be set BEFORE any LSA origination touches the area."""
+        assert not (stub and nssa), "area cannot be both stub and NSSA"
         new_area = cfg.area_id not in self.areas
         area = self.areas.setdefault(cfg.area_id, Area(cfg.area_id))
         if new_area:
             area.stub = stub
+            area.nssa = nssa
             area.stub_default_cost = stub_default_cost
-        elif area.stub != stub:
-            self.set_area_stub(cfg.area_id, stub)
+        elif area.stub != stub or area.nssa != nssa:
+            self.set_area_type(cfg.area_id, stub=stub, nssa=nssa)
         iface = OspfInterface(
             name=ifname, config=cfg, addr_ip=addr_ip, prefix=addr
         )
@@ -265,17 +277,33 @@ class OspfInstance(Actor):
         return iface
 
     def set_area_stub(self, area_id: IPv4Address, stub: bool) -> None:
-        """Flip an area's stub-ness at runtime: purge now-forbidden
-        type-5s and restart the area's adjacencies (the E-bit changed, so
+        self.set_area_type(area_id, stub=stub)
+
+    def set_area_type(
+        self, area_id: IPv4Address, stub: bool = False, nssa: bool = False
+    ) -> None:
+        """Flip an area's type at runtime: purge now-forbidden LSAs and
+        restart the area's adjacencies (the E/N option bits changed, so
         existing neighbors would reject our hellos anyway)."""
+        assert not (stub and nssa), "area cannot be both stub and NSSA"
         area = self.areas.get(area_id)
-        if area is None or area.stub == stub:
+        if area is None or (area.stub == stub and area.nssa == nssa):
             return
+        was_nssa = area.nssa
         area.stub = stub
-        if stub:
+        area.nssa = nssa
+        if was_nssa and not nssa:
+            # Leaving NSSA: type-7s are meaningless outside one.
+            for key in list(area.lsdb.entries):
+                if key.type == LsaType.NSSA_EXTERNAL:
+                    area.lsdb.remove(key)
+        if area.no_type5:
             for key in list(area.lsdb.entries):
                 if key.type == LsaType.AS_EXTERNAL:
                     area.lsdb.remove(key)
+            if nssa and self.redistributed:
+                for prefix in list(self.redistributed):
+                    self._originate_external(prefix)  # as type-7 now
         else:
             if self.redistributed:
                 for prefix in list(self.redistributed):
@@ -452,7 +480,11 @@ class OspfInstance(Actor):
         hello = Hello(
             mask=mask_of(iface.prefix) if iface.prefix else IPv4Address(0),
             hello_interval=iface.config.hello_interval,
-            options=Options(0) if area.stub else Options.E,
+            options=(
+                Options.NP if area.nssa
+                else Options(0) if area.stub
+                else Options.E
+            ),
             priority=iface.config.priority,
             dead_interval=iface.config.dead_interval,
             dr=iface.dr,
@@ -472,8 +504,10 @@ class OspfInstance(Actor):
             or h.dead_interval != iface.config.dead_interval
         ):
             return  # §10.5 parameter mismatch
-        if bool(h.options & Options.E) == area.stub:
-            return  # §10.5: E-bit must agree with the area's stub-ness
+        if bool(h.options & Options.E) == area.no_type5:
+            return  # §10.5: E-bit must agree with the area's type
+        if bool(h.options & Options.NP) != area.nssa:
+            return  # RFC 3101 §2.4: N-bit must agree on NSSA-ness
         if (
             iface.config.if_type == IfType.BROADCAST
             and iface.prefix is not None
@@ -528,7 +562,9 @@ class OspfInstance(Actor):
 
     @property
     def is_asbr(self) -> bool:
-        return bool(self.redistributed)
+        # An NSSA translator originates type-5s, so it is an ASBR to the
+        # rest of the domain (RFC 3101 §3.1).
+        return bool(self.redistributed) or bool(self._nssa_translated)
 
     def _external_lsid(self, prefix: IPv4Network) -> IPv4Address:
         """Appendix E link-state-id assignment for type-5 LSAs: prefixes
@@ -573,26 +609,38 @@ class OspfInstance(Actor):
         )
         lsid = self._external_lsid(prefix)
         for area in self.areas.values():
-            if area.stub:
-                continue  # §3.6: no type-5s in stub areas
-            self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
+            if area.nssa:
+                # RFC 3101 §2.4: inside an NSSA the ASBR originates a
+                # type-7 instead.  P-bit set so the elected ABR
+                # translates it — unless we are an ABR ourselves (we
+                # already flood the type-5 into the other areas, and
+                # §2.3 forbids translating our own).
+                opts = Options(0) if self.is_abr else Options.NP
+                self._originate(
+                    area, LsaType.NSSA_EXTERNAL, lsid, body, options=opts
+                )
+            elif not area.stub:  # §3.6: no type-5s in stub areas
+                self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
 
     def withdraw_redistributed(self, prefix: IPv4Network) -> None:
         if self.redistributed.pop(prefix, None) is None:
             return
         lsid = self._external_lsids.pop(prefix, prefix.network_address)
-        key = LsaKey(LsaType.AS_EXTERNAL, lsid, self.config.router_id)
         for area in self.areas.values():
-            self._flush_self_lsa(area, key)
+            for ltype in (LsaType.AS_EXTERNAL, LsaType.NSSA_EXTERNAL):
+                self._flush_self_lsa(
+                    area, LsaKey(ltype, lsid, self.config.router_id)
+                )
         if not self.is_asbr:
             for area in self.areas.values():
                 self._originate_router_lsa(area)
 
     def _propagate_external(self, from_area: Area, lsa: Lsa) -> None:
         """AS scope: a type-5 installed in one area is installed (and thus
-        flooded) into every other NON-STUB area by ABRs (§3.6)."""
+        flooded) into every other non-stub, non-NSSA area by ABRs
+        (§3.6, RFC 3101 §2.2)."""
         for area in self.areas.values():
-            if area is from_area or area.stub:
+            if area is from_area or area.no_type5:
                 continue
             cur = area.lsdb.get(lsa.key)
             if cur is None or lsa.compare(cur.lsa) > 0:
@@ -633,10 +681,17 @@ class OspfInstance(Actor):
         now = self.loop.clock.now()
         for aid, (st, res) in area_results.items():
             area = self.areas[aid]
+            # RFC 3101 §2.5: inside an NSSA, type-7s are examined
+            # alongside type-5s from the other attached areas.
+            wanted_types = (
+                (LsaType.AS_EXTERNAL, LsaType.NSSA_EXTERNAL)
+                if area.nssa
+                else (LsaType.AS_EXTERNAL,)
+            )
             for e in area.lsdb.all():
                 lsa = e.lsa
                 if (
-                    lsa.type != LsaType.AS_EXTERNAL
+                    lsa.type not in wanted_types
                     or lsa.adv_rtr == self.config.router_id
                     or e.current_age(now) >= MAX_AGE
                     or lsa.body.metric >= 0xFFFFFF
@@ -654,12 +709,18 @@ class OspfInstance(Actor):
                 if prefix in known:
                     continue  # internal paths always preferred
                 # Ranking key: E1 before E2; E1 by total; E2 by (metric,
-                # asbr dist).
+                # asbr dist); type-5 over type-7 on full ties (§2.5).
+                is_t7 = lsa.type == LsaType.NSSA_EXTERNAL
+                if is_t7 and self.is_abr and prefix.prefixlen == 0:
+                    # RFC 3101 §2.5: type-7 default LSAs are examined
+                    # only by non-border NSSA routers — two ABRs would
+                    # otherwise default-route into each other.
+                    continue
                 if lsa.body.e_bit:
-                    rank = (1, lsa.body.metric, asbr_dist)
+                    rank = (1, lsa.body.metric, asbr_dist, is_t7)
                     dist = lsa.body.metric
                 else:
-                    rank = (0, asbr_dist + lsa.body.metric, 0)
+                    rank = (0, asbr_dist + lsa.body.metric, 0, is_t7)
                     dist = asbr_dist + lsa.body.metric
                 cur = best.get(prefix)
                 if cur is None or rank < cur[0]:
@@ -670,6 +731,82 @@ class OspfInstance(Actor):
                     )
                     best[prefix] = (rank, merged)
         return {p: r for p, (rank, r) in best.items()}
+
+    def _nssa_translate(self, area_results: dict) -> None:
+        """RFC 3101 §3: the reachable NSSA ABR with the highest router-id
+        translates P-bit type-7s into type-5s for the rest of the domain;
+        everyone else (and routers losing the election) withdraws."""
+        from holo_tpu.protocols.ospf.packet import LsaAsExternal, RouterFlags
+        from holo_tpu.utils.ip import apply_mask
+
+        now = self.loop.clock.now()
+        wanted: dict[IPv4Network, LsaAsExternal] = {}
+        if self.is_abr:
+            for aid, (st, res) in area_results.items():
+                area = self.areas[aid]
+                if not area.nssa:
+                    continue
+                # Translator election (§3.1): highest-RID reachable ABR.
+                abrs = {self.config.router_id}
+                for e in area.lsdb.all():
+                    lsa = e.lsa
+                    if (
+                        lsa.type != LsaType.ROUTER
+                        or not (lsa.body.flags & RouterFlags.B)
+                        or e.current_age(now) >= MAX_AGE
+                    ):
+                        continue
+                    v = st.router_index.get(lsa.adv_rtr)
+                    if v is not None and res.dist[v] < 0x40000000:
+                        abrs.add(lsa.adv_rtr)
+                if max(abrs) != self.config.router_id:
+                    continue  # someone else translates for this NSSA
+                for e in area.lsdb.all():
+                    lsa = e.lsa
+                    if (
+                        lsa.type != LsaType.NSSA_EXTERNAL
+                        or lsa.adv_rtr == self.config.router_id
+                        or not (lsa.options & Options.NP)  # P=0: never
+                        or e.current_age(now) >= MAX_AGE
+                        or lsa.body.metric >= 0xFFFFFF
+                    ):
+                        continue
+                    v = st.router_index.get(lsa.adv_rtr)
+                    if v is None or res.dist[v] >= 0x40000000:
+                        continue  # §3.2: ASBR must be reachable
+                    prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                    body = LsaAsExternal(
+                        mask=lsa.body.mask,
+                        e_bit=lsa.body.e_bit,
+                        metric=lsa.body.metric,
+                        fwd_addr=lsa.body.fwd_addr,
+                        tag=lsa.body.tag,
+                    )
+                    cur = wanted.get(prefix)
+                    # Aggregate duplicates: best (E1-first, lowest metric).
+                    if cur is None or (not body.e_bit, body.metric) < (
+                        not cur.e_bit, cur.metric
+                    ):
+                        wanted[prefix] = body
+        was_asbr = self.is_asbr
+        for prefix in self._nssa_translated - set(wanted):
+            if prefix in self.redistributed:
+                continue  # still advertised in our own right
+            lsid = self._external_lsids.pop(prefix, prefix.network_address)
+            key = LsaKey(LsaType.AS_EXTERNAL, lsid, self.config.router_id)
+            for area in self.areas.values():
+                self._flush_self_lsa(area, key)
+        self._nssa_translated = set(wanted)
+        for prefix, body in wanted.items():
+            if prefix in self.redistributed:
+                continue  # our own type-5 wins; no translated duplicate
+            lsid = self._external_lsid(prefix)
+            for area in self.areas.values():
+                if not area.no_type5:
+                    self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
+        if was_asbr != self.is_asbr:
+            for area in self.areas.values():
+                self._originate_router_lsa(area)  # E-flag changed
 
     # ----- graceful restart (RFC 3623)
 
@@ -1094,6 +1231,12 @@ class OspfInstance(Actor):
         acks: list[Lsa] = []
         now = self.loop.clock.now()
         for lsa in pkt.body.lsas:
+            # Flooding scope (§3.6 / RFC 3101 §2.2): no type-5s into
+            # stub or NSSA areas, type-7s only inside an NSSA.
+            if lsa.type == LsaType.AS_EXTERNAL and area.no_type5:
+                continue
+            if lsa.type == LsaType.NSSA_EXTERNAL and not area.nssa:
+                continue
             cur = area.lsdb.get(lsa.key)
             # §13 (5): newer than DB copy (or no copy).
             if cur is None or lsa.compare(cur.lsa) > 0:
@@ -1251,6 +1394,7 @@ class OspfInstance(Actor):
         body,
         allow_in_gr: bool = False,
         only_iface=None,
+        options: Options = Options.E,
     ) -> None:
         if self.gr_restarting and not allow_in_gr:
             return  # RFC 3623 §2.2: no origination until resync completes
@@ -1258,7 +1402,7 @@ class OspfInstance(Actor):
         old = area.lsdb.get(key)
         lsa = Lsa(
             age=0,
-            options=Options.E,
+            options=options,
             type=ltype,
             lsid=lsid,
             adv_rtr=self.config.router_id,
@@ -1562,6 +1706,7 @@ class OspfInstance(Actor):
         ).items():
             all_routes[prefix] = route
 
+        self._nssa_translate(area_results)
         if self.is_abr:
             self._originate_summaries(area_intra, inter_routes)
             self._originate_asbr_summaries(area_results)
@@ -1620,11 +1765,27 @@ class OspfInstance(Actor):
                 cur = wanted[dst_aid].get(prefix)
                 if cur is None or route.dist < cur:
                     wanted[dst_aid][prefix] = route.dist
-        # Stub areas get a default summary instead of type-5s (§12.4.3.1).
+        # Stub areas get a default summary instead of type-5s (§12.4.3.1);
+        # NSSAs get a default type-7 (RFC 3101 §2.4, P=0 so it is never
+        # translated back out).
         default = IPv4Network("0.0.0.0/0")
         for aid, area in self.areas.items():
             if area.stub:
                 wanted[aid][default] = area.stub_default_cost
+            elif area.nssa:
+                from holo_tpu.protocols.ospf.packet import LsaAsExternal
+
+                self._originate(
+                    area,
+                    LsaType.NSSA_EXTERNAL,
+                    IPv4Address(0),
+                    LsaAsExternal(
+                        mask=IPv4Address(0), e_bit=True,
+                        metric=area.stub_default_cost,
+                        fwd_addr=IPv4Address(0), tag=0,
+                    ),
+                    options=Options(0),
+                )
         for aid, prefixes in wanted.items():
             area = self.areas[aid]
             # Link-state-ID assignment with the RFC 2328 Appendix E rule:

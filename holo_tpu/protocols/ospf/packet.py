@@ -47,6 +47,7 @@ class LsaType(enum.IntEnum):
     SUMMARY_NETWORK = 3
     SUMMARY_ROUTER = 4
     AS_EXTERNAL = 5
+    NSSA_EXTERNAL = 7  # RFC 3101 type-7 (same body as type-5)
     OPAQUE_LINK = 9
     OPAQUE_AREA = 10
     OPAQUE_AS = 11
@@ -298,6 +299,7 @@ _BODY_CODECS = {
     LsaType.SUMMARY_NETWORK: LsaSummary,
     LsaType.SUMMARY_ROUTER: LsaSummary,
     LsaType.AS_EXTERNAL: LsaAsExternal,
+    LsaType.NSSA_EXTERNAL: LsaAsExternal,
     LsaType.OPAQUE_LINK: LsaOpaque,
     LsaType.OPAQUE_AREA: LsaOpaque,
     LsaType.OPAQUE_AS: LsaOpaque,

@@ -374,3 +374,43 @@ def test_ipv6_reach_tlv_chunking_roundtrip():
     t, out = decode_pdu(raw)
     assert [r.prefix for r in out.tlvs["ipv6_reach"]] == prefixes
     assert [r.metric for r in out.tlvs["ipv6_reach"]] == list(range(15))
+
+
+def test_live_ipv6_origination_and_hostname():
+    """Two live routers: IPv6 reachability and hostnames must flow from
+    ORIGINATION (TLV 232/236/137), not just be decodable (RFC 5308/5301)."""
+    from ipaddress import IPv6Address, IPv6Network
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    from holo_tpu.protocols.isis.instance import IsisIfConfig
+
+    routers = []
+    for i in (1, 2):
+        r = IsisInstance(f"is{i}", sysid(i),
+                         netio=fabric.sender_for(f"is{i}"))
+        loop.register(r)
+        r.add_interface(
+            "e0", IsisIfConfig(metric=10),
+            A(f"10.0.0.{i}"), N("10.0.0.0/24"),
+            addr6=IPv6Address(f"fe80::{i}"),
+            prefix6=IPv6Network(f"2001:db8:{i}::/64"),
+        )
+        fabric.join("wire", r.name, "e0", A(f"10.0.0.{i}"))
+        routers.append(r)
+    for r in routers:
+        loop.send(r.name, IsisIfUpMsg("e0"))
+    loop.advance(60)
+    r1, r2 = routers
+    # v6 route with the neighbor's link-local as next hop.
+    route = r1.routes.get(IPv6Network("2001:db8:2::/64"))
+    assert route is not None, "no v6 route from live origination"
+    dist, nhs = route
+    assert dist == 20  # dist(r2)=10 + advertised prefix metric 10
+    assert {str(a) for _, a in nhs} == {"fe80::2"}
+    # Hostname learned from the neighbor's LSP.
+    assert r1.hostnames.get(sysid(2)) == "is2"
+    assert r2.hostnames.get(sysid(1)) == "is1"
+    # protocols_supported advertises IPv6 (NLPID 0x8E).
+    own = r2.lsdb[LspId(sysid(1))].lsp
+    assert 0x8E in own.tlvs["protocols_supported"]

@@ -1,0 +1,152 @@
+"""RFC 3101 NSSA end-to-end: type-7 origination, ABR translation to
+type-5, default type-7 injection, and scope rules — real instances over
+MockFabric (reference: holo-ospf area types / nssa handling)."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.packet import LsaType, Options
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+AREA0 = A("0.0.0.0")
+AREA1 = A("0.0.0.1")
+
+
+def _mk(loop, fabric, name, rid):
+    inst = OspfInstance(
+        name=name,
+        config=InstanceConfig(router_id=A(rid)),
+        netio=fabric.sender_for(name),
+    )
+    loop.register(inst)
+    return inst
+
+
+def _p2p(fabric, link, a, a_if, a_addr, b, b_if, b_addr, net, area,
+         nssa=False):
+    cfg = IfConfig(area_id=area, if_type=IfType.POINT_TO_POINT, cost=10)
+    a.add_interface(a_if, cfg, N(net), A(a_addr), nssa=nssa)
+    b.add_interface(b_if, cfg, N(net), A(b_addr), nssa=nssa)
+    fabric.join(link, a.name, a_if, A(a_addr))
+    fabric.join(link, b.name, b_if, A(b_addr))
+
+
+def _bring_up(loop, routers, seconds=60):
+    from holo_tpu.protocols.ospf.instance import IfUpMsg
+
+    for r in routers:
+        for area in r.areas.values():
+            for ifname in area.interfaces:
+                loop.send(r.name, IfUpMsg(ifname))
+    loop.advance(seconds)
+
+
+def _setup():
+    """rt3(backbone) -- rt1(ABR) -- rt2(NSSA-internal ASBR)."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    rt1 = _mk(loop, fabric, "rt1", "1.1.1.1")
+    rt2 = _mk(loop, fabric, "rt2", "2.2.2.2")
+    rt3 = _mk(loop, fabric, "rt3", "3.3.3.3")
+    _p2p(fabric, "l13", rt1, "eth0", "10.0.0.1", rt3, "eth0", "10.0.0.3",
+         "10.0.0.0/24", AREA0)
+    _p2p(fabric, "l12", rt1, "eth1", "10.0.1.1", rt2, "eth0", "10.0.1.2",
+         "10.0.1.0/24", AREA1, nssa=True)
+    return loop, (rt1, rt2, rt3)
+
+
+def test_nssa_type7_translated_to_type5():
+    loop, (rt1, rt2, rt3) = _setup()
+    _bring_up(loop, (rt1, rt2, rt3))
+    ext = N("203.0.113.0/24")
+    rt2.redistribute(ext, metric=20)
+    loop.advance(30)
+
+    # Type-7 with the P-bit circulates inside the NSSA…
+    k7 = next(
+        (k for k in rt1.areas[AREA1].lsdb.entries
+         if k.type == LsaType.NSSA_EXTERNAL and k.adv_rtr == A("2.2.2.2")),
+        None,
+    )
+    assert k7 is not None, "ABR never received the type-7"
+    assert rt1.areas[AREA1].lsdb.entries[k7].lsa.options & Options.NP
+    # …never as a type-5 inside the NSSA…
+    assert not any(
+        k.type == LsaType.AS_EXTERNAL for k in rt2.areas[AREA1].lsdb.entries
+    )
+    # …and the elected translator (rt1, the only NSSA ABR) re-originates
+    # it as a type-5 into the backbone: rt3 routes to the prefix.
+    assert any(
+        k.type == LsaType.AS_EXTERNAL and k.adv_rtr == A("1.1.1.1")
+        for k in rt3.areas[AREA0].lsdb.entries
+    ), "translator did not originate the type-5"
+    route = rt3.routes.get(ext)
+    assert route is not None, "backbone router missing translated route"
+    assert {str(nh.addr) for nh in route.nexthops} == {"10.0.0.1"}
+    # The NSSA-internal ASBR routes externals learned via its own type-7
+    # machinery, and the translator advertises E in its router LSA.
+    assert rt1.is_asbr
+
+
+def test_nssa_withdraw_flushes_translation():
+    loop, (rt1, rt2, rt3) = _setup()
+    _bring_up(loop, (rt1, rt2, rt3))
+    ext = N("203.0.113.0/24")
+    rt2.redistribute(ext, metric=20)
+    loop.advance(30)
+    assert rt3.routes.get(ext) is not None
+    rt2.withdraw_redistributed(ext)
+    loop.advance(30)
+    assert rt3.routes.get(ext) is None, "stale translated type-5 route"
+    assert not rt1._nssa_translated
+    assert not rt1.is_asbr
+
+
+def test_nssa_abr_injects_default_type7():
+    loop, (rt1, rt2, rt3) = _setup()
+    _bring_up(loop, (rt1, rt2, rt3))
+    # The ABR originates a P=0 default type-7 into the NSSA; the internal
+    # router installs 0.0.0.0/0 toward the ABR and it is never
+    # re-translated (P=0).
+    k = next(
+        (k for k in rt2.areas[AREA1].lsdb.entries
+         if k.type == LsaType.NSSA_EXTERNAL and k.lsid == A("0.0.0.0")),
+        None,
+    )
+    assert k is not None, "no default type-7 in the NSSA"
+    lsa = rt2.areas[AREA1].lsdb.entries[k].lsa
+    assert not (lsa.options & Options.NP)
+    route = rt2.routes.get(N("0.0.0.0/0"))
+    assert route is not None
+    assert {str(nh.addr) for nh in route.nexthops} == {"10.0.1.1"}
+    # The default never leaks into the backbone as a type-5.
+    assert not any(
+        k.type == LsaType.AS_EXTERNAL and k.lsid == A("0.0.0.0")
+        for k in rt3.areas[AREA0].lsdb.entries
+    )
+
+
+def test_nssa_hello_bit_agreement():
+    """A normal-area neighbor on an NSSA interface must not form an
+    adjacency (N/E option bits disagree, RFC 3101 §2.4 / §10.5)."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    rt1 = _mk(loop, fabric, "rt1", "1.1.1.1")
+    rt2 = _mk(loop, fabric, "rt2", "2.2.2.2")
+    cfg = IfConfig(area_id=AREA1, if_type=IfType.POINT_TO_POINT, cost=10)
+    rt1.add_interface("eth0", cfg, N("10.0.1.0/24"), A("10.0.1.1"), nssa=True)
+    rt2.add_interface("eth0", cfg, N("10.0.1.0/24"), A("10.0.1.2"))
+    fabric.join("l12", rt1.name, "eth0", A("10.0.1.1"))
+    fabric.join("l12", rt2.name, "eth0", A("10.0.1.2"))
+    _bring_up(loop, (rt1, rt2), 30)
+    for r in (rt1, rt2):
+        for area in r.areas.values():
+            for iface in area.interfaces.values():
+                assert not iface.neighbors, "mismatched areas formed adjacency"
