@@ -446,6 +446,7 @@ class RoutingProvider(Provider, Actor):
         self._apply_ospfv3(new)
         self._apply_isis(new)
         self._apply_bgp(new)
+        self._apply_ldp(new)
         self._apply_static(new)
 
     def _handle_redistribution(self, msg) -> None:
@@ -837,6 +838,68 @@ class RoutingProvider(Provider, Actor):
             {p: (metric, frozenset(nhs)) for p, (metric, nhs) in routes.items()},
         )
 
+    def _apply_ldp(self, new):
+        """LDP lifecycle from config (reference: holo-ldp spawn path).
+
+        Egress FECs are seeded from the connected networks of the
+        LDP-enabled interfaces; the LIB is surfaced in operational
+        state.  label-distribution-control selects RFC 5036 §2.6
+        independent vs ordered mode (a mode change restarts the LSR,
+        like the reference's instance reconfiguration)."""
+        from ipaddress import IPv4Address
+
+        from holo_tpu.protocols.ldp import LdpInstance
+
+        base = "routing/control-plane-protocols/ldp"
+        conf = new.get(base)
+        enabled = bool(conf) and new.get(f"{base}/enabled", True)
+        lsr_id = new.get(f"{base}/lsr-id")
+        inst = self.instances.get("ldp")
+        if not enabled or lsr_id is None:
+            if inst is not None:
+                self.loop.unregister(inst.name)
+                del self.instances["ldp"]
+            return
+        mode = new.get(
+            f"{base}/label-distribution-control", "independent"
+        )
+        if inst is not None and (
+            str(inst.lsr_id) != lsr_id or inst.control_mode != mode
+        ):
+            self.loop.unregister(inst.name)
+            del self.instances["ldp"]
+            inst = None
+        if inst is None:
+            actor = f"{self.prefix}ldp"
+            inst = LdpInstance(
+                name=actor,
+                lsr_id=IPv4Address(lsr_id),
+                netio=self.netio_factory(actor),
+                control_mode=mode,
+            )
+            self.loop.register(inst)
+            self.instances["ldp"] = inst
+        wanted = set(new.get(f"{base}/interface") or {})
+        for ifname in list(inst.interfaces):
+            if ifname not in wanted:
+                st = self.ifp.interfaces.get(ifname)
+                fec = (
+                    st.addresses[0].network
+                    if st is not None and st.addresses
+                    else None
+                )
+                inst.remove_interface(ifname, fec)
+        for ifname in wanted:
+            if ifname in inst.interfaces:
+                continue
+            st = self.ifp.interfaces.get(ifname)
+            if st is None or not st.addresses:
+                continue
+            addr = st.addresses[0]
+            inst.add_interface(ifname, addr.ip)
+            # Directly-attached networks are egress FECs (implicit null).
+            inst.add_fec(addr.network, egress=True)
+
     def _apply_bgp(self, new):
         """BGP lifecycle from config (reference: holo-bgp spawn path).
 
@@ -1033,6 +1096,19 @@ class RoutingProvider(Provider, Actor):
                         for a in i.up_adjacencies()
                     ]
                     for i in isis.interfaces.values()
+                },
+            }
+        ldp = self.instances.get("ldp")
+        if ldp is not None:
+            state["routing"]["ldp"] = {
+                "lsr-id": str(ldp.lsr_id),
+                "control-mode": ldp.control_mode,
+                "neighbors": {
+                    str(rid): n.state.value
+                    for rid, n in ldp.neighbors.items()
+                },
+                "lib": {
+                    str(fec): entry for fec, entry in ldp.lib().items()
                 },
             }
         bgp = self.instances.get("bgp")

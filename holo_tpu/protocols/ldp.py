@@ -165,16 +165,26 @@ class LdpInstance(Actor):
         netio: NetIo,
         label_manager: LabelManager | None = None,
         lib_cb=None,
+        control_mode: str = "independent",
     ):
+        assert control_mode in ("independent", "ordered")
         self.name = name
         self.lsr_id = lsr_id
         self.netio = netio
         self.labels = label_manager or LabelManager()
         self.lib_cb = lib_cb  # callable(lib) on label-table change
+        # RFC 5036 §2.6: independent control advertises local bindings
+        # immediately; ordered control (§2.6.1) only once the FEC's next
+        # hop has advertised its own mapping (or we are the egress).
+        self.control_mode = control_mode
         self.interfaces: dict[str, IPv4Address] = {}  # ifname -> our addr
         self.neighbors: dict[IPv4Address, LdpNeighbor] = {}
         # Our FECs: prefix -> (local label, is_egress)
         self.fec_table: dict[IPv4Network, tuple[int, bool]] = {}
+        # Ordered mode: FEC -> next-hop LSR id (fed from the RIB) and the
+        # set of FECs currently advertised upstream.
+        self.nexthop_lsr: dict[IPv4Network, IPv4Address] = {}
+        self.advertised: set[IPv4Network] = set()
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -184,25 +194,92 @@ class LdpInstance(Actor):
     def add_interface(self, ifname: str, addr: IPv4Address) -> None:
         self.interfaces[ifname] = addr
 
+    def remove_interface(self, ifname: str, fec: IPv4Network | None = None) -> None:
+        """Stop discovery on an interface; drop its connected FEC (and
+        any neighbors discovered over it)."""
+        if self.interfaces.pop(ifname, None) is None:
+            return
+        if fec is not None:
+            self.remove_fec(fec)
+        for lsr_id, nbr in list(self.neighbors.items()):
+            if nbr.ifname == ifname:
+                del self.neighbors[lsr_id]
+        self._lib_changed()
+
     def add_fec(self, prefix: IPv4Network, egress: bool) -> int:
         """Create a local binding (egress FECs bind implicit-null)."""
         if prefix in self.fec_table:
             return self.fec_table[prefix][0]
         label = IMPLICIT_NULL if egress else self.labels.allocate()
         self.fec_table[prefix] = (label, egress)
-        for nbr in self.neighbors.values():
-            if nbr.state == NbrState.OPERATIONAL:
-                self._send_mapping(nbr, prefix, label)
+        if self._may_advertise(prefix):
+            self.advertised.add(prefix)
+            for nbr in self.neighbors.values():
+                if nbr.state == NbrState.OPERATIONAL:
+                    self._send_mapping(nbr, prefix, label)
         self._lib_changed()
         return label
+
+    def set_nexthops(self, nexthop_lsr: dict) -> None:
+        """Ordered mode: the RIB feeds each FEC's downstream LSR id so
+        eligibility (§2.6.1: egress, or mapping received from the next
+        hop) can be evaluated."""
+        self.nexthop_lsr = dict(nexthop_lsr)
+        self._reeval_ordered()
+
+    def _may_advertise(self, prefix: IPv4Network) -> bool:
+        if self.control_mode == "independent":
+            return True
+        label, egress = self.fec_table[prefix]
+        if egress:
+            return True
+        nh = self.nexthop_lsr.get(prefix)
+        if nh is None:
+            return False
+        nbr = self.neighbors.get(nh)
+        return nbr is not None and prefix in nbr.bindings
+
+    def _reeval_ordered(self) -> None:
+        """Advertise newly-eligible FECs upstream; withdraw ones whose
+        downstream mapping disappeared (ordered-control propagation)."""
+        if self.control_mode != "ordered":
+            return
+        ops = [
+            n for n in self.neighbors.values()
+            if n.state == NbrState.OPERATIONAL
+        ]
+        changed = False
+        for prefix in self.fec_table:
+            eligible = self._may_advertise(prefix)
+            if eligible and prefix not in self.advertised:
+                self.advertised.add(prefix)
+                for nbr in ops:
+                    self._send_mapping(nbr, prefix, self.fec_table[prefix][0])
+                changed = True
+            elif not eligible and prefix in self.advertised:
+                self.advertised.discard(prefix)
+                for nbr in ops:
+                    self._send(
+                        nbr.ifname, nbr.addr,
+                        LdpMsg(LdpMsgType.LABEL_WITHDRAW, self.lsr_id,
+                               fec=prefix, label=self.fec_table[prefix][0]),
+                    )
+                changed = True
+        if changed:
+            self._lib_changed()
 
     def remove_fec(self, prefix: IPv4Network) -> None:
         entry = self.fec_table.pop(prefix, None)
         if entry is None:
             return
         label, egress = entry
+        was_advertised = prefix in self.advertised
+        self.advertised.discard(prefix)
         if not egress:
             self.labels.release(label)
+        if not was_advertised and self.control_mode == "ordered":
+            self._lib_changed()
+            return  # never advertised: nothing to withdraw upstream
         for nbr in self.neighbors.values():
             if nbr.state == NbrState.OPERATIONAL:
                 self._send(
@@ -226,6 +303,7 @@ class LdpInstance(Actor):
         elif isinstance(msg, NbrTimeoutMsg):
             nbr = self.neighbors.pop(msg.lsr_id, None)
             if nbr is not None:
+                self._reeval_ordered()  # lost downstream: withdraw
                 self._lib_changed()
 
     def _rx(self, msg: NetRxPacket) -> None:
@@ -249,18 +327,23 @@ class LdpInstance(Actor):
         elif pdu.type == LdpMsgType.KEEPALIVE:
             if nbr.state != NbrState.OPERATIONAL:
                 nbr.state = NbrState.OPERATIONAL
-                # Advertise all local bindings (downstream unsolicited).
+                # Advertise eligible local bindings (DU; ordered mode
+                # holds back FECs still waiting on their next hop).
                 for prefix, (label, _e) in self.fec_table.items():
-                    self._send_mapping(nbr, prefix, label)
+                    if self._may_advertise(prefix):
+                        self.advertised.add(prefix)
+                        self._send_mapping(nbr, prefix, label)
             self._touch(nbr)
         elif pdu.type == LdpMsgType.LABEL_MAPPING and pdu.fec is not None:
             nbr.bindings[pdu.fec] = pdu.label
+            self._reeval_ordered()  # downstream arrived: maybe advertise
             self._lib_changed()
         elif pdu.type == LdpMsgType.LABEL_WITHDRAW and pdu.fec is not None:
             nbr.bindings.pop(pdu.fec, None)
             self._send(nbr.ifname, nbr.addr,
                        LdpMsg(LdpMsgType.LABEL_RELEASE, self.lsr_id,
                               fec=pdu.fec, label=pdu.label))
+            self._reeval_ordered()  # downstream gone: withdraw upstream
             self._lib_changed()
 
     def _rx_hello(self, msg: NetRxPacket, pdu: LdpMsg) -> None:

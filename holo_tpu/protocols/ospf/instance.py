@@ -1230,6 +1230,12 @@ class OspfInstance(Actor):
             return
         acks: list[Lsa] = []
         now = self.loop.clock.now()
+        exchanging = any(
+            n.state in (NsmState.EXCHANGE, NsmState.LOADING)
+            for a2 in self.areas.values()
+            for i2 in a2.interfaces.values()
+            for n in i2.neighbors.values()
+        )
         for lsa in pkt.body.lsas:
             # Flooding scope (§3.6 / RFC 3101 §2.2): no type-5s into
             # stub or NSSA areas, type-7s only inside an NSSA.
@@ -1238,6 +1244,12 @@ class OspfInstance(Actor):
             if lsa.type == LsaType.NSSA_EXTERNAL and not area.nssa:
                 continue
             cur = area.lsdb.get(lsa.key)
+            # §13 (4): a MaxAge LSA with no database copy (and no
+            # exchange in progress) is acked directly, never installed —
+            # otherwise flushes ping-pong around multi-access links.
+            if lsa.is_maxage and cur is None and not exchanging:
+                acks.append(lsa)
+                continue
             # §13 (5): newer than DB copy (or no copy).
             if cur is None or lsa.compare(cur.lsa) > 0:
                 if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
@@ -1410,8 +1422,14 @@ class OspfInstance(Actor):
             body=body,
         )
         lsa.encode()
-        if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
-            return  # unchanged content: no re-origination needed
+        if (
+            old is not None
+            and old.lsa.raw[20:] == lsa.raw[20:]
+            and old.lsa.options == options
+        ):
+            # Unchanged content AND header options (the NSSA P-bit lives
+            # in the header): no re-origination needed.
+            return
         self._install_and_flood(area, lsa, only_iface=only_iface)
 
     def _flush_self_lsa(self, area: Area, key: LsaKey, only_iface=None) -> None:
@@ -1772,7 +1790,9 @@ class OspfInstance(Actor):
         for aid, area in self.areas.items():
             if area.stub:
                 wanted[aid][default] = area.stub_default_cost
-            elif area.nssa:
+            elif area.nssa and default not in self.redistributed:
+                # Injected ABR default (skipped when the operator
+                # redistributes 0.0.0.0/0 — that type-7 owns the lsid).
                 from holo_tpu.protocols.ospf.packet import LsaAsExternal
 
                 self._originate(

@@ -7,9 +7,10 @@ router-id keyed hellos, LSA types with flooding scopes, prefixes carried
 in Link / Intra-Area-Prefix LSAs, and the SPF topology built from router
 links keyed by (router-id, interface-id).
 
-Round-1 scope: point-to-point interfaces, single area, intra-area v6
-routes via Intra-Area-Prefix LSAs referencing router vertices; LAN DR
-election and inter-area land with the version-trait unification.
+Scope: p2p + broadcast interfaces (router-id DR election with a
+Waiting/BackupSeen analog, network LSAs, network-referenced
+Intra-Area-Prefix LSAs), single area, intra-area v6 routes over router
+AND network vertices; inter-area (ABR) lands next.
 """
 
 from __future__ import annotations
@@ -21,6 +22,7 @@ import numpy as np
 
 from holo_tpu.ops.graph import INF, Topology
 from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.interface import ElectionView, IfType, elect_dr_bdr
 from holo_tpu.protocols.ospf.lsdb import MIN_LS_ARRIVAL, Lsdb, next_seq_no
 from holo_tpu.protocols.ospf.neighbor import (
     Neighbor,
@@ -46,6 +48,8 @@ class V3IfConfig:
     rxmt_interval: int = 5
     mtu: int = 1500
     instance_id: int = 0
+    if_type: IfType = IfType.POINT_TO_POINT
+    priority: int = 1
 
 
 @dataclass
@@ -57,6 +61,17 @@ class V3Interface:
     prefixes: list[IPv6Network] = field(default_factory=list)
     up: bool = False
     neighbors: dict[IPv4Address, Neighbor] = field(default_factory=dict)
+    # LAN state (RFC 5340 identifies DR/BDR by ROUTER-ID, not address).
+    dr: IPv4Address = IPv4Address(0)
+    bdr: IPv4Address = IPv4Address(0)
+    # §9.4 Waiting state: no self-election until the wait timer expires
+    # or a neighbor declares an existing DR/BDR (BackupSeen).
+    wait_until: float = 0.0
+    up_since: float = -1e9
+
+    @property
+    def is_lan(self) -> bool:
+        return self.config.if_type == IfType.BROADCAST
 
 
 @dataclass
@@ -79,6 +94,11 @@ class RxmtTimerV3:
 @dataclass
 class SpfTimerV3:
     pass
+
+
+@dataclass
+class WaitTimerV3:
+    ifname: str
 
 
 @dataclass
@@ -168,6 +188,11 @@ class OspfV3Instance(Actor):
         elif isinstance(msg, SpfTimerV3):
             self._spf_pending = False
             self.run_spf()
+        elif isinstance(msg, WaitTimerV3):
+            iface = self.interfaces.get(msg.ifname)
+            if iface is not None and iface.up and iface.is_lan:
+                iface.wait_until = 0.0
+                self._run_dr_election(iface)
         elif isinstance(msg, AgeTickV3):
             self._age_tick()
         elif isinstance(msg, V3IfUpMsg):
@@ -180,6 +205,15 @@ class OspfV3Instance(Actor):
         if iface is None or iface.up:
             return
         iface.up = True
+        if iface.is_lan:
+            # §9.4 Waiting: listen for an incumbent DR before claiming.
+            iface.up_since = self.loop.clock.now()
+            iface.wait_until = (
+                self.loop.clock.now() + iface.config.dead_interval
+            )
+            self._timer(
+                ("wait", ifname), lambda: WaitTimerV3(ifname)
+            ).start(iface.config.dead_interval)
         self._send_hello(ifname)
         self._originate_router_lsa()
         self._originate_intra_area_prefix()
@@ -188,9 +222,11 @@ class OspfV3Instance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None or not iface.up:
             return
+        iface.up = False  # before the kills: elections no-op on a dead iface
         for nbr_id in list(iface.neighbors):
             self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
-        iface.up = False
+        iface.dr = IPv4Address(0)
+        iface.bdr = IPv4Address(0)
         for key in (("hello", ifname),):
             t = self._timers.get(key)
             if t:
@@ -216,12 +252,12 @@ class OspfV3Instance(Actor):
             return
         hello = P.Hello(
             iface_id=iface.iface_id,
-            priority=1,
+            priority=iface.config.priority,
             options=P.Options.V6 | P.Options.E | P.Options.R,
             hello_interval=iface.config.hello_interval,
             dead_interval=iface.config.dead_interval,
-            dr=IPv4Address(0),
-            bdr=IPv4Address(0),
+            dr=iface.dr,
+            bdr=iface.bdr,
             neighbors=[n.router_id for n in iface.neighbors.values()
                        if n.state >= NsmState.INIT],
         )
@@ -242,17 +278,106 @@ class OspfV3Instance(Actor):
             nbr = Neighbor(router_id=pkt.router_id, src=src)
             iface.neighbors[pkt.router_id] = nbr
         nbr.src = src  # link-local — the v6 next hop
+        changed = (h.priority, h.dr, h.bdr) != (nbr.priority, nbr.dr, nbr.bdr)
+        nbr.priority = h.priority
+        nbr.iface_id = h.iface_id
+        nbr.dr, nbr.bdr = h.dr, h.bdr
         self._nbr_event(iface.name, pkt.router_id, NsmEvent.HELLO_RECEIVED)
         self._timer(
             ("inactivity", iface.name, pkt.router_id),
             lambda: InactivityTimerV3(iface.name, pkt.router_id),
         ).start(iface.config.dead_interval)
+        was_2way = nbr.state >= NsmState.TWO_WAY
         if self.router_id in h.neighbors:
             self._nbr_event(iface.name, pkt.router_id, NsmEvent.TWO_WAY_RECEIVED)
         else:
             self._nbr_event(iface.name, pkt.router_id, NsmEvent.ONE_WAY_RECEIVED)
+        if iface.is_lan:
+            now_2way = (
+                pkt.router_id in iface.neighbors
+                and iface.neighbors[pkt.router_id].state >= NsmState.TWO_WAY
+            )
+            if changed or was_2way != now_2way:
+                self._run_dr_election(iface)
 
-    # -- NSM plumbing (p2p: always form adjacency)
+    # -- DR election (RFC 5340 §4.2.1.1: §9.4 with router-ids)
+
+    def _run_dr_election(self, iface: V3Interface) -> None:
+        if not iface.up:
+            return
+        if self.loop.clock.now() < iface.wait_until:
+            # BackupSeen: an established DR/BDR declared by a 2-Way
+            # neighbor ends Waiting early; otherwise keep listening.
+            if any(
+                n.state >= NsmState.TWO_WAY and (int(n.dr) or int(n.bdr))
+                for n in iface.neighbors.values()
+            ):
+                iface.wait_until = 0.0
+            else:
+                return
+        # Partial-view guard, active only in the first DeadInterval after
+        # coming up: a 2-Way neighbor names an incumbent DR we have not
+        # heard from yet (its hello is still in flight after our rejoin).
+        # Electing now would self-promote and preempt it — defer until
+        # the incumbent is in view.  Outside that window the named DR is
+        # genuinely dead and elections must proceed (failover).
+        if (
+            self.loop.clock.now()
+            < iface.up_since + iface.config.dead_interval
+        ):
+            twoway = {
+                n.router_id: n
+                for n in iface.neighbors.values()
+                if n.state >= NsmState.TWO_WAY
+            }
+            for n in twoway.values():
+                if (
+                    int(n.dr)
+                    and n.dr != self.router_id
+                    and n.dr not in twoway
+                ):
+                    return
+        for _ in range(2):  # §9.4 step 4: rerun when our own role changes
+            views = [
+                ElectionView(
+                    iface.config.priority,
+                    self.router_id,
+                    self.router_id,  # v3 elects by router-id, not address
+                    iface.dr,
+                    iface.bdr,
+                )
+            ]
+            for nbr in iface.neighbors.values():
+                if nbr.state >= NsmState.TWO_WAY:
+                    views.append(
+                        ElectionView(
+                            nbr.priority, nbr.router_id, nbr.router_id,
+                            nbr.dr, nbr.bdr,
+                        )
+                    )
+            new_dr, new_bdr = elect_dr_bdr(views)
+            changed = (new_dr, new_bdr) != (iface.dr, iface.bdr)
+            iface.dr, iface.bdr = new_dr, new_bdr
+            if not changed:
+                break
+        # AdjOK? — the adjacency set depends on who is DR/BDR.
+        for nbr_id in list(iface.neighbors):
+            if iface.neighbors[nbr_id].state >= NsmState.TWO_WAY:
+                self._nbr_event(iface.name, nbr_id, NsmEvent.ADJ_OK)
+        self._originate_router_lsa()
+        self._originate_network_lsa(iface)
+        self._originate_intra_area_prefix()
+
+    def _adj_ok(self, iface: V3Interface, nbr: Neighbor) -> bool:
+        """p2p always; LAN only with/as the DR or BDR (§10.4)."""
+        if not iface.is_lan:
+            return True
+        return (
+            iface.dr in (self.router_id, nbr.router_id)
+            or iface.bdr in (self.router_id, nbr.router_id)
+        )
+
+    # -- NSM plumbing
 
     def _nbr_event(self, ifname: str, nbr_id, event: NsmEvent) -> None:
         iface = self.interfaces.get(ifname)
@@ -262,7 +387,7 @@ class OspfV3Instance(Actor):
         if nbr is None:
             return
         old_state = nbr.state
-        res = nsm_transition(nbr, event, adj_ok=True)
+        res = nsm_transition(nbr, event, adj_ok=self._adj_ok(iface, nbr))
         nbr.state = res.new_state
         for act in res.actions:
             if act == "start_exstart":
@@ -286,10 +411,14 @@ class OspfV3Instance(Actor):
                     t.cancel()
         if nbr.state == NsmState.DOWN:
             del iface.neighbors[nbr_id]
+            if iface.is_lan:
+                self._run_dr_election(iface)
         if (old_state >= NsmState.FULL) != (nbr.state >= NsmState.FULL) or (
             nbr.state == NsmState.DOWN
         ):
             self._originate_router_lsa()
+            if iface.is_lan:
+                self._originate_network_lsa(iface)
             self._originate_intra_area_prefix()
 
     # -- DD exchange (same semantics as v2; v3 codec)
@@ -463,14 +592,28 @@ class OspfV3Instance(Actor):
         if lsas:
             self._send(iface, nbr.src, P.LsUpdate(lsas))
 
+    def _any_nbr_exchanging(self) -> bool:
+        return any(
+            n.state in (NsmState.EXCHANGE, NsmState.LOADING)
+            for i in self.interfaces.values()
+            for n in i.neighbors.values()
+        )
+
     def _rx_ls_update(self, iface: V3Interface, src, pkt) -> None:
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EXCHANGE:
             return
         acks = []
         now = self.loop.clock.now()
+        exchanging = self._any_nbr_exchanging()
         for lsa in pkt.body.lsas:
             cur = self.lsdb.get(lsa.key)
+            # §13 (4): a MaxAge LSA with no database copy (and no
+            # exchange in progress) is acked directly, never installed —
+            # otherwise flushes ping-pong around multi-access links.
+            if lsa.is_maxage and cur is None and not exchanging:
+                acks.append(lsa)
+                continue
             if cur is None or lsa.compare(cur.lsa) > 0:
                 if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
                     continue
@@ -593,16 +736,10 @@ class OspfV3Instance(Actor):
     def _refresh_self_lsa(self, received) -> None:
         cur = self.lsdb.get(received.key)
         if cur is None:
+            # A stale incarnation of ours we no longer originate: install
+            # it so the flush has something to outrank, then flush it.
             self._install_and_flood(received)
-            lsa = received
-            import copy
-
-            flush = copy.copy(lsa)
-            flush.age = P.MAX_AGE
-            raw = bytearray(flush.raw)
-            raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
-            flush.raw = bytes(raw)
-            self._install_and_flood(flush)
+            self._flush_self(received.key)
             return
         lsa = P.Lsa(
             age=0,
@@ -615,10 +752,42 @@ class OspfV3Instance(Actor):
         lsa.encode()
         self._install_and_flood(lsa)
 
+    def _transit_active(self, iface: V3Interface) -> bool:
+        """A LAN contributes a transit link once a DR exists and we are
+        synchronized with it (or are it)."""
+        if not iface.is_lan or int(iface.dr) == 0:
+            return False
+        if iface.dr == self.router_id:
+            return any(
+                n.state == NsmState.FULL for n in iface.neighbors.values()
+            )
+        dr = iface.neighbors.get(iface.dr)
+        return dr is not None and dr.state == NsmState.FULL
+
+    def _dr_iface_id(self, iface: V3Interface) -> int:
+        if iface.dr == self.router_id:
+            return iface.iface_id
+        dr = iface.neighbors.get(iface.dr)
+        return dr.iface_id if dr is not None else 0
+
     def _originate_router_lsa(self) -> None:
         links = []
         for iface in self.interfaces.values():
             if not iface.up:
+                continue
+            if iface.is_lan:
+                if self._transit_active(iface):
+                    # RFC 5340 §4.4.3.2: transit link names the DR's
+                    # (interface id, router id) — the network vertex.
+                    links.append(
+                        P.RouterLinkV3(
+                            P.RouterLinkType.TRANSIT_NETWORK,
+                            iface.config.cost,
+                            iface.iface_id,
+                            self._dr_iface_id(iface),
+                            iface.dr,
+                        )
+                    )
                 continue
             for nbr in iface.neighbors.values():
                 if nbr.state == NsmState.FULL:
@@ -627,16 +796,52 @@ class OspfV3Instance(Actor):
                             P.RouterLinkType.POINT_TO_POINT,
                             iface.config.cost,
                             iface.iface_id,
-                            0,  # learned from hello iface_id in full impl
+                            nbr.iface_id,
                             nbr.router_id,
                         )
                     )
         self._originate(P.LsaType.ROUTER, IPv4Address(0), P.LsaRouterV3(links=links))
 
+    def _originate_network_lsa(self, iface: V3Interface) -> None:
+        """DR duty: the network LSA (lsid = DR's interface id) lists all
+        fully-adjacent members plus the DR itself (RFC 5340 §4.4.3.3)."""
+        lsid = IPv4Address(iface.iface_id)
+        key = P.LsaKey(P.LsaType.NETWORK, lsid, self.router_id)
+        if (
+            iface.up
+            and iface.dr == self.router_id
+            and any(n.state == NsmState.FULL for n in iface.neighbors.values())
+        ):
+            attached = [self.router_id] + sorted(
+                (n.router_id for n in iface.neighbors.values()
+                 if n.state == NsmState.FULL),
+                key=int,
+            )
+            self._originate(
+                P.LsaType.NETWORK, lsid, P.LsaNetworkV3(attached=attached)
+            )
+        else:
+            self._flush_self(key)
+
+    def _flush_self(self, key) -> None:
+        e = self.lsdb.get(key)
+        if e is None or e.lsa.is_maxage:
+            return
+        import copy
+
+        flush = copy.copy(e.lsa)
+        flush.age = P.MAX_AGE
+        raw = bytearray(flush.raw)
+        raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
+        flush.raw = bytes(raw)
+        self._install_and_flood(flush)
+
     def _originate_intra_area_prefix(self) -> None:
+        # Router-referenced LSA: p2p prefixes plus LAN prefixes whose LAN
+        # has no active network LSA yet (stub behavior, RFC 5340 §4.4.3.9).
         prefixes = []
         for iface in self.interfaces.values():
-            if iface.up:
+            if iface.up and not self._transit_active(iface):
                 for p in iface.prefixes:
                     prefixes.append((p, iface.config.cost))
         body = P.LsaIntraAreaPrefix(
@@ -646,6 +851,31 @@ class OspfV3Instance(Actor):
             prefixes=prefixes,
         )
         self._originate(P.LsaType.INTRA_AREA_PREFIX, IPv4Address(1), body)
+        # Network-referenced LSAs: the DR advertises each transit LAN's
+        # prefixes against the network vertex (metric 0 — the path cost
+        # to the network vertex already includes the link cost).
+        for iface in self.interfaces.values():
+            lsid = IPv4Address(0x100 + iface.iface_id)
+            if (
+                iface.up
+                and iface.is_lan
+                and iface.dr == self.router_id
+                and self._transit_active(iface)
+            ):
+                self._originate(
+                    P.LsaType.INTRA_AREA_PREFIX,
+                    lsid,
+                    P.LsaIntraAreaPrefix(
+                        ref_type=int(P.LsaType.NETWORK),
+                        ref_lsid=IPv4Address(iface.iface_id),
+                        ref_adv_rtr=self.router_id,
+                        prefixes=[(p, 0) for p in iface.prefixes],
+                    ),
+                )
+            else:
+                self._flush_self(
+                    P.LsaKey(P.LsaType.INTRA_AREA_PREFIX, lsid, self.router_id)
+                )
 
     # -- aging
 
@@ -679,58 +909,114 @@ class OspfV3Instance(Actor):
         self.spf_run_count += 1
         now = self.loop.clock.now()
         routers: dict[IPv4Address, P.LsaRouterV3] = {}
+        networks: dict[tuple, P.LsaNetworkV3] = {}  # (adv, iface id)
         prefix_lsas: list[P.LsaIntraAreaPrefix] = []
         for e in self.lsdb.all():
             if e.current_age(now) >= P.MAX_AGE:
                 continue
             if e.lsa.type == P.LsaType.ROUTER:
                 routers[e.lsa.adv_rtr] = e.lsa.body
+            elif e.lsa.type == P.LsaType.NETWORK:
+                networks[(e.lsa.adv_rtr, int(e.lsa.lsid))] = e.lsa.body
             elif e.lsa.type == P.LsaType.INTRA_AREA_PREFIX:
                 prefix_lsas.append(e.lsa.body)
         if self.router_id not in routers:
             return
-        order = sorted(routers.keys(), key=int)
-        index = {r: i for i, r in enumerate(order)}
+        # Vertex ordering contract: network vertices sort before routers
+        # so zero-cost network->router edges settle first (shared engine
+        # semantics — see the v2/IS-IS marshaling).
+        keys = [("N",) + k for k in sorted(networks, key=lambda k: (int(k[0]), k[1]))]
+        keys += [("R", rid) for rid in sorted(routers, key=int)]
+        index = {k: i for i, k in enumerate(keys)}
+        n = len(keys)
+        is_router = np.array([k[0] == "R" for k in keys], bool)
         src, dst, cost = [], [], []
         for rid, body in routers.items():
+            u = index[("R", rid)]
             for link in body.links:
-                v = index.get(link.nbr_router_id)
+                if link.link_type == P.RouterLinkType.TRANSIT_NETWORK:
+                    v = index.get(
+                        ("N", link.nbr_router_id, link.nbr_iface_id)
+                    )
+                else:
+                    v = index.get(("R", link.nbr_router_id))
                 if v is not None:
-                    src.append(index[rid])
+                    src.append(u)
                     dst.append(v)
                     cost.append(link.metric)
+        for (adv, ifid), body in networks.items():
+            u = index[("N", adv, ifid)]
+            for member in body.attached:
+                v = index.get(("R", member))
+                if v is not None:
+                    src.append(u)
+                    dst.append(v)
+                    cost.append(0)
         topo = Topology(
-            n_vertices=len(order),
-            is_router=np.ones(len(order), bool),
+            n_vertices=n,
+            is_router=is_router,
             edge_src=np.array(src, np.int32).reshape(-1),
             edge_dst=np.array(dst, np.int32).reshape(-1),
             edge_cost=np.array(cost, np.int32).reshape(-1),
-            root=index[self.router_id],
+            root=index[("R", self.router_id)],
         ).filter_mutual()
 
         atoms = []
         atom_ids = np.full(topo.n_edges, -1, np.int32)
         nbr_hop = {}
+        lan_iface_of = {}  # network vertex key -> our iface on that LAN
         for iface in self.interfaces.values():
             for nbr in iface.neighbors.values():
-                if nbr.state == NsmState.FULL:
+                if nbr.state == NsmState.FULL and not iface.is_lan:
                     nbr_hop[nbr.router_id] = (iface.name, nbr.src)
+            if iface.is_lan and self._transit_active(iface):
+                lan_iface_of[
+                    ("N", iface.dr, self._dr_iface_id(iface))
+                ] = iface
+        root_lans: set[int] = set()
         for e_i in range(topo.n_edges):
             if topo.edge_src[e_i] == topo.root:
-                rid = order[int(topo.edge_dst[e_i])]
-                hop = nbr_hop.get(rid)
-                if hop is not None:
+                k = keys[int(topo.edge_dst[e_i])]
+                if k[0] == "R":
+                    hop = nbr_hop.get(k[1])
+                    if hop is not None:
+                        atom_ids[e_i] = len(atoms)
+                        atoms.append(hop)
+                elif k in lan_iface_of:
+                    # Directly-attached LAN: the network vertex's route
+                    # (the LAN prefix) is reached on the interface itself
+                    # — same (ifname, no-address) atom the v2 marshaling
+                    # assigns (spf_run.py root_edge_data).
+                    root_lans.add(int(topo.edge_dst[e_i]))
                     atom_ids[e_i] = len(atoms)
-                    atoms.append(hop)
+                    atoms.append((lan_iface_of[k].name, None))
+        # Network -> member edges on root-attached LANs: the direct next
+        # hop is the member's link-local on that LAN (hops==0 rule).
+        for e_i in range(topo.n_edges):
+            u = int(topo.edge_src[e_i])
+            if u in root_lans:
+                iface = lan_iface_of[keys[u]]
+                member = keys[int(topo.edge_dst[e_i])][1]
+                if member == self.router_id:
+                    continue
+                nbr = iface.neighbors.get(member)
+                if nbr is not None:
+                    atom_ids[e_i] = len(atoms)
+                    atoms.append((iface.name, nbr.src))
         topo.edge_direct_atom = atom_ids
         topo.touch()
 
         res = self.backend.compute(topo)
         routes: dict[IPv6Network, V6Route] = {}
         for body in prefix_lsas:
-            if body.ref_type != int(P.LsaType.ROUTER):
+            if body.ref_type == int(P.LsaType.ROUTER):
+                v = index.get(("R", body.ref_adv_rtr))
+            elif body.ref_type == int(P.LsaType.NETWORK):
+                v = index.get(
+                    ("N", body.ref_adv_rtr, int(body.ref_lsid))
+                )
+            else:
                 continue
-            v = index.get(body.ref_adv_rtr)
             if v is None or res.dist[v] >= INF:
                 continue
             from holo_tpu.protocols.ospf.spf_run import atom_bits

@@ -49,6 +49,9 @@ class Neighbor:
     priority: int = 0
     dr: IPv4Address = IPv4Address(0)
     bdr: IPv4Address = IPv4Address(0)
+    # OSPFv3: the neighbor's interface id from its hellos (RFC 5340
+    # §4.2.1 — needed for transit links and network-LSA vertex keys).
+    iface_id: int = 0
     # DD exchange (§10.8):
     master: bool = False  # True if WE are master
     dd_seq_no: int = 0

@@ -256,6 +256,10 @@ def routing_module():
                 _leaf("query-interval", "uint16", default=125))),
             C("ldp",
               _leaf("enabled", "boolean", default=True),
+              _leaf("lsr-id"),
+              _leaf("label-distribution-control", "enum",
+                    enum=("independent", "ordered"),
+                    default="independent"),
               L("interface", "name", _leaf("name"),
                 _leaf("hello-interval", "uint16", default=5))),
             _static_subtree(),

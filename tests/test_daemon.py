@@ -315,3 +315,53 @@ def test_grpc_northbound_end_to_end():
         assert "tpu-rtr-1" in txn.config_json
     finally:
         server.stop(grace=0)
+
+
+def test_ldp_config_driven_session_and_lib():
+    """LDP lifecycle from config: two daemons discover each other, reach
+    OPERATIONAL, exchange labels for their connected FECs, and the
+    label-distribution-control knob is consumed (mode change restarts)."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="m1")
+    d2 = Daemon(loop=loop, netio=fabric, name="m2")
+    fabric.join("l", "m1.ldp", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "m2.ldp", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, lsr in [(d1, "1.1.1.1"), (d2, "2.2.2.2")]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address",
+                 [f"10.0.12.{lsr[0]}/30"])
+        cand.set("routing/control-plane-protocols/ldp/lsr-id", lsr)
+        cand.set(
+            "routing/control-plane-protocols/ldp/interface[eth0]/hello-interval",
+            5,
+        )
+        d.commit(cand)
+    loop.advance(20)
+    ldp1 = d1.routing.instances["ldp"]
+    ldp2 = d2.routing.instances["ldp"]
+    from holo_tpu.protocols.ldp import NbrState
+
+    assert ldp1.neighbors[ipaddress.ip_address("2.2.2.2")].state == NbrState.OPERATIONAL
+    # Connected networks became egress FECs and labels flowed.
+    lib = ldp1.lib()[N("10.0.12.0/30")]
+    assert lib["egress"] and "2.2.2.2" in lib["remote"]
+    # Operational state surfaces the LIB.
+    state = d1.routing.get_state()
+    assert state["routing"]["ldp"]["control-mode"] == "independent"
+    assert "10.0.12.0/30" in state["routing"]["ldp"]["lib"]
+    # Mode flip restarts the LSR with ordered control.
+    cand = d1.candidate()
+    cand.set("routing/control-plane-protocols/ldp/label-distribution-control",
+             "ordered")
+    d1.commit(cand)
+    loop.advance(20)
+    assert d1.routing.instances["ldp"].control_mode == "ordered"
+    assert d1.routing.instances["ldp"] is not ldp1  # new incarnation
+    # Disable tears down.
+    cand = d1.candidate()
+    cand.set("routing/control-plane-protocols/ldp/enabled", False)
+    d1.commit(cand)
+    assert "ldp" not in d1.routing.instances

@@ -71,3 +71,57 @@ def test_session_and_label_distribution():
     l2.remove_fec(N("203.0.113.0/24"))
     loop.advance(2)
     assert "2.2.2.2" not in l1.lib()[N("203.0.113.0/24")]["remote"]
+
+
+def _chain3(control_mode):
+    """A(1.1.1.1) -- B(2.2.2.2) -- C(3.3.3.3), two links."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    a = LdpInstance("a", A("1.1.1.1"), fabric.sender_for("a"),
+                    control_mode=control_mode)
+    b = LdpInstance("b", A("2.2.2.2"), fabric.sender_for("b"),
+                    control_mode=control_mode)
+    c = LdpInstance("c", A("3.3.3.3"), fabric.sender_for("c"),
+                    control_mode=control_mode)
+    for inst in (a, b, c):
+        loop.register(inst)
+    fabric.join("ab", "a", "e0", A("10.0.1.1"))
+    fabric.join("ab", "b", "e0", A("10.0.1.2"))
+    fabric.join("bc", "b", "e1", A("10.0.2.2"))
+    fabric.join("bc", "c", "e0", A("10.0.2.3"))
+    a.add_interface("e0", A("10.0.1.1"))
+    b.add_interface("e0", A("10.0.1.2"))
+    b.add_interface("e1", A("10.0.2.2"))
+    c.add_interface("e0", A("10.0.2.3"))
+    loop.advance(10)
+    return loop, a, b, c
+
+
+def test_ordered_mode_waits_for_downstream():
+    """RFC 5036 §2.6.1: a transit LSR advertises a FEC upstream only
+    after its next hop has — and propagates withdrawal when it goes."""
+    fec = N("203.0.113.0/24")
+    loop, a, b, c = _chain3("ordered")
+    # Transit binding at B with the next hop known but no downstream
+    # mapping yet: B must NOT advertise to A.
+    b.set_nexthops({fec: A("3.3.3.3")})
+    b.add_fec(fec, egress=False)
+    loop.advance(2)
+    assert fec not in a.neighbors[A("2.2.2.2")].bindings
+    # Egress binding appears at C -> C advertises -> B becomes eligible
+    # and advertises upstream -> A learns it.
+    c.add_fec(fec, egress=True)
+    loop.advance(2)
+    assert a.neighbors[A("2.2.2.2")].bindings.get(fec) == b.fec_table[fec][0]
+    # Downstream withdraws: B withdraws upstream too.
+    c.remove_fec(fec)
+    loop.advance(2)
+    assert fec not in a.neighbors[A("2.2.2.2")].bindings
+
+
+def test_independent_mode_advertises_immediately():
+    fec = N("203.0.113.0/24")
+    loop, a, b, c = _chain3("independent")
+    b.add_fec(fec, egress=False)  # no downstream mapping, no next hop
+    loop.advance(2)
+    assert a.neighbors[A("2.2.2.2")].bindings.get(fec) == b.fec_table[fec][0]

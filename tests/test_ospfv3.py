@@ -132,3 +132,99 @@ def test_v3_failure_reroute_triangle():
     route = r1.routes.get(N6("2001:db8:33::/64"))
     assert route is not None
     assert {i for i, _ in route.nexthops} == {"e0"}  # around via r2
+
+
+def _lan3():
+    """Three routers on one v6 LAN, each with a loopback prefix."""
+    from holo_tpu.protocols.ospf.interface import IfType
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    routers = []
+    for i in (1, 2, 3):
+        inst = OspfV3Instance(f"v3r{i}", A(f"{i}.{i}.{i}.{i}"),
+                              fabric.sender_for(f"v3r{i}"))
+        loop.register(inst)
+        cfg = V3IfConfig(if_type=IfType.BROADCAST, cost=10)
+        inst.add_interface("e0", cfg, A6(f"fe80::{i}"),
+                           [N6("2001:db8:99::/64")])
+        inst.add_interface("lo", V3IfConfig(cost=1), A6(f"fe80::1:{i}"),
+                           [N6(f"2001:db8:{i}::/64")])
+        fabric.join("lan", inst.name, "e0", A6(f"fe80::{i}"))
+        routers.append(inst)
+    for r in routers:
+        loop.send(r.name, V3IfUpMsg("e0"))
+        loop.send(r.name, V3IfUpMsg("lo"))
+    loop.advance(80)
+    return loop, fabric, routers
+
+
+def test_v3_lan_dr_election_and_routes():
+    """RFC 5340 LAN: DR elected by router-id, network LSA + network-
+    referenced intra-area-prefix LSA, full any-to-any v6 routes with
+    link-local next hops across the LAN."""
+    loop, fabric, routers = _lan3()
+    r1, r2, r3 = routers
+    # Highest router-id wins at equal priority.
+    for r in routers:
+        assert r.interfaces["e0"].dr == A("3.3.3.3"), r.name
+    # The DR originated the network LSA listing all three members.
+    net = [e for e in r1.lsdb.all() if e.lsa.type == P.LsaType.NETWORK
+           and not e.lsa.is_maxage]
+    assert len(net) == 1
+    assert sorted(map(str, net[0].lsa.body.attached)) == [
+        "1.1.1.1", "2.2.2.2", "3.3.3.3"]
+    # Everyone routes to everyone's loopback across the LAN.
+    for r in routers:
+        me = int(str(r.router_id).split(".")[0])
+        for i in (1, 2, 3):
+            if i == me:
+                continue
+            route = r.routes.get(N6(f"2001:db8:{i}::/64"))
+            assert route is not None, f"{r.name} missing r{i} loopback"
+            assert route.dist == 10 + 1
+            assert {str(a) for _, a in route.nexthops} == {f"fe80::{i}"}
+        # The LAN prefix itself: via the network vertex, dist = cost,
+        # next hop = the attached interface (no gateway address).
+        lan = r.routes.get(N6("2001:db8:99::/64"))
+        assert lan is not None and lan.dist == 10
+        assert {(ifn, a) for ifn, a in lan.nexthops} == {("e0", None)}
+
+
+def test_v3_lan_dr_failover():
+    loop, fabric, routers = _lan3()
+    r1, r2, r3 = routers
+    # Kill the DR: BDR (2.2.2.2) must take over and re-originate the
+    # network LSA; routes between the survivors must survive.
+    loop.unregister("v3r3")
+    loop.advance(120)
+    for r in (r1, r2):
+        assert r.interfaces["e0"].dr == A("2.2.2.2"), r.name
+    route = r1.routes.get(N6("2001:db8:2::/64"))
+    assert route is not None
+    assert {str(a) for _, a in route.nexthops} == {"fe80::2"}
+    # The dead router's loopback is gone.
+    assert r1.routes.get(N6("2001:db8:3::/64")) is None
+
+
+def test_v3_lan_dr_sticky_across_flap():
+    """A flapped higher-id router must NOT preempt the incumbent DR
+    (§9.4 stickiness via declared-DR preference; no self-claim on
+    rejoin)."""
+    from holo_tpu.protocols.ospf.instance_v3 import V3IfDownMsg
+
+    loop, fabric, routers = _lan3()
+    r1, r2, r3 = routers
+    assert r1.interfaces["e0"].dr == A("3.3.3.3")
+    loop.send("v3r3", V3IfDownMsg("e0"))
+    loop.advance(120)  # incumbents re-elect: r2 takes over
+    assert r1.interfaces["e0"].dr == A("2.2.2.2")
+    loop.send("v3r3", V3IfUpMsg("e0"))
+    loop.advance(60)
+    # r3 (higher id) rejoins but r2 keeps the role; r3 reaches FULL
+    # with the DR and routes flow again.
+    for r in routers:
+        assert r.interfaces["e0"].dr == A("2.2.2.2"), r.name
+    route = r1.routes.get(N6("2001:db8:3::/64"))
+    assert route is not None
+    assert {str(a) for _, a in route.nexthops} == {"fe80::3"}
