@@ -54,6 +54,41 @@ def corpus():
     out.append(ilsp.encode())
     out.append(isis_pkt.Snp(2, True, b"\x00" * 5 + b"\x01",
                             [(1200, isis_pkt.LspId(b"\x00" * 5 + b"\x02"), 1, 0xAB)]).encode())
+    # Hand-built LSP exercising the narrow (2/128/130), v6 (232/236),
+    # hostname (137) and RFC 5120 MT (229/222/237) decode branches.
+    # Lifetime 0 skips the checksum so raw TLVs can be spliced freely.
+    def tlv(t, value):
+        return bytes([t, len(value)]) + value
+
+    mt_tlvs = (
+        tlv(2, bytes([0])  # virtual flag
+            + bytes([10, 0x80, 0x80, 0x80]) + b"\x00" * 5 + b"\x02\x00")
+        + tlv(128, bytes([10, 0x80, 0x80, 0x80, 10, 0, 1, 0,
+                          255, 255, 255, 0]))
+        + tlv(130, bytes([10 | 0x40, 0x80, 0x80, 0x80, 203, 0, 113, 0,
+                          255, 255, 255, 0]))
+        + tlv(137, b"rt1")
+        + tlv(229, bytes([0x00, 0x00, 0x40, 0x02]))  # MT ids: 0, 2(A)
+        + tlv(222, bytes([0x00, 0x02]) + b"\x00" * 5 + b"\x03\x00"
+              + bytes([0, 0, 10, 0]))
+        + tlv(232, bytes(15) + b"\x01")
+        + tlv(236, bytes([0, 0, 0, 10, 0, 16, 0x20, 0x01]))
+        + tlv(237, bytes([0x00, 0x02, 0, 0, 0, 10, 0, 16, 0x20, 0x01]))
+    )
+    body = (
+        (0).to_bytes(2, "big")  # lifetime 0: checksum not verified
+        + b"\x00" * 5 + b"\x01\x00\x00"  # LSP id
+        + (7).to_bytes(4, "big")  # seqno
+        + (0).to_bytes(2, "big")  # cksum
+        + bytes([0x03])
+        + mt_tlvs
+    )
+    pdu_len = 8 + 2 + len(body)
+    out.append(
+        bytes([0x83, 27, 1, 0, 20, 1, 0, 0])
+        + pdu_len.to_bytes(2, "big")
+        + body
+    )
     from ipaddress import IPv6Address as A6
     from ipaddress import IPv6Network as N6
 
@@ -72,6 +107,21 @@ def corpus():
                     prefixes=[(N6("2001:db8:1::/64"), 10)]))
     l3.encode()
     out.append(v3.Packet(A("1.1.1.1"), A("0.0.0.0"), v3.LsUpdate([l3])).encode())
+    n3 = v3.Lsa(1, v3.LsaType.NETWORK, A("0.0.0.4"), A("3.3.3.3"), -98,
+                v3.LsaNetworkV3(attached=[A("1.1.1.1"), A("3.3.3.3")]))
+    n3.encode()
+    out.append(v3.Packet(A("3.3.3.3"), A("0.0.0.0"), v3.LsUpdate([n3])).encode())
+    t7 = ospf_pkt.Lsa(
+        1, ospf_pkt.Options.NP, ospf_pkt.LsaType.NSSA_EXTERNAL,
+        A("203.0.113.0"), A("2.2.2.2"), -97,
+        ospf_pkt.LsaAsExternal(mask=A("255.255.255.0"), e_bit=True,
+                               metric=20, fwd_addr=A("0.0.0.0"), tag=0),
+    )
+    t7.encode()
+    out.append(
+        ospf_pkt.Packet(A("2.2.2.2"), A("0.0.0.1"),
+                        ospf_pkt.LsUpdate([t7])).encode()
+    )
     out.append(bgp.encode_msg(bgp.OpenMsg(65001, 90, A("1.1.1.1"))))
     out.append(bgp.encode_msg(bgp.UpdateMsg(
         nlri=[N("10.0.0.0/8")],
