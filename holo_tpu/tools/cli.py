@@ -174,6 +174,51 @@ def cmd_conformance(args) -> int:
     return 1 if failed else 0
 
 
+def cmd_import_yang(args) -> int:
+    """Parse YANG text file(s) and dump the resulting schema subtrees —
+    the libyang-load analog for externally authored modules.  Multiple
+    files form one module set with cross-module grouping/typedef
+    resolution (pass every import together, like a libyang context)."""
+    from pathlib import Path
+
+    from holo_tpu.yang.parser import load_modules
+    from holo_tpu.yang.schema import Container, Leaf, LeafList, List, SchemaError
+
+    try:
+        mods = load_modules(
+            [Path(f).read_text() for f in args.files]
+        )
+    except (OSError, UnicodeDecodeError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    nodes = [n for ns in mods.values() for n in ns]
+    if not nodes:
+        print("(no config data nodes — augment/identity-only modules)")
+
+    def dump(node, depth=0):
+        pad = "  " * depth
+        if isinstance(node, Leaf):
+            extra = f" = {node.default!r}" if node.default is not None else ""
+            enum = f" {{{','.join(node.enum)}}}" if node.enum else ""
+            ro = "" if node.config else " (state)"
+            print(f"{pad}{node.name} [{node.type}{enum}]{extra}{ro}")
+        elif isinstance(node, LeafList):
+            print(f"{pad}{node.name}* [{node.type}]")
+        elif isinstance(node, List):
+            print(f"{pad}{node.name}[{node.key}]/")
+            for c in node.children.values():
+                dump(c, depth + 1)
+        elif isinstance(node, Container):
+            p = " (presence)" if node.presence else ""
+            print(f"{pad}{node.name}/{p}")
+            for c in node.children.values():
+                dump(c, depth + 1)
+
+    for node in nodes:
+        dump(node)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="holo-tpu-tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -198,6 +243,12 @@ def main(argv=None) -> int:
                    help="one topology dir (default: all)")
     s.add_argument("--protocol", choices=("ospf", "isis"), default="ospf")
     s.set_defaults(fn=cmd_conformance)
+    s = sub.add_parser(
+        "import-yang",
+        help="parse YANG text module(s) and dump their schema subtrees",
+    )
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_import_yang)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -1,0 +1,301 @@
+"""YANG text front-end (RFC 7950 subset) for the YANG-lite schema.
+
+The reference loads its 104 modules through libyang; this parser covers
+the statement subset those modules actually use for CONFIG modeling —
+module/container/list/leaf/leaf-list, types (integers, string, boolean,
+enumeration, inet addresses/prefixes), key, default, mandatory, config,
+presence, typedef (one-level resolution), grouping/uses — and maps them
+onto the same :mod:`holo_tpu.yang.schema` nodes the built-in modules
+use, so a parsed module mounts and validates identically.
+
+Statements that do not affect config-tree shape (description, reference,
+namespace, prefix, import, revision, organization, contact, notification,
+rpc, augment, when, must, status, units, yang-version, ordered-by...) are
+parsed and skipped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from holo_tpu.yang.schema import Container, Leaf, LeafList, List, SchemaError
+
+
+@dataclass
+class Stmt:
+    """One YANG statement: ``keyword [argument] { substatements }``."""
+
+    keyword: str
+    arg: str | None
+    subs: list = field(default_factory=list)
+
+    def sub(self, keyword: str) -> "Stmt | None":
+        for s in self.subs:
+            if s.keyword == keyword:
+                return s
+        return None
+
+    def all(self, keyword: str) -> list:
+        return [s for s in self.subs if s.keyword == keyword]
+
+
+class YangParseError(SchemaError):
+    pass
+
+
+def _tokenize(text: str) -> list[str]:
+    """Tokens: quoted strings (with ``+`` concatenation handled by the
+    parser), ``{``, ``}``, ``;`` and bare words."""
+    out: list[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if ch in " \t\r\n":
+            i += 1
+        elif text.startswith("//", i):
+            i = text.find("\n", i)
+            i = n if i < 0 else i
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise YangParseError("unterminated comment")
+            i = j + 2
+        elif ch in "\"'":
+            j = i + 1
+            buf = []
+            while j < n and text[j] != ch:
+                if ch == '"' and text[j] == "\\" and j + 1 < n:
+                    esc = text[j + 1]
+                    buf.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\"}
+                               .get(esc, esc))
+                    j += 2
+                else:
+                    buf.append(text[j])
+                    j += 1
+            if j >= n:
+                raise YangParseError("unterminated string")
+            out.append('"' + "".join(buf))  # marker prefix: quoted token
+            i = j + 1
+        elif ch in "{};":
+            out.append(ch)
+            i += 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n{};\"'":
+                j += 1
+            out.append(text[i:j])
+            i = j
+    return out
+
+
+def _parse_stmts(tokens: list[str], pos: int) -> tuple[list, int]:
+    stmts: list[Stmt] = []
+    while pos < len(tokens) and tokens[pos] != "}":
+        kw = tokens[pos]
+        if kw.startswith('"'):
+            raise YangParseError(f"unexpected string where keyword expected")
+        pos += 1
+        # Argument: bare word or quoted string(s) joined by '+'.
+        arg = None
+        if pos < len(tokens) and tokens[pos] not in "{};":
+            parts = []
+            while True:
+                t = tokens[pos]
+                parts.append(t[1:] if t.startswith('"') else t)
+                pos += 1
+                if pos < len(tokens) and tokens[pos] == "+":
+                    pos += 1
+                    continue
+                break
+            arg = "".join(parts)
+        if pos >= len(tokens):
+            raise YangParseError(f"{kw}: missing terminator")
+        if tokens[pos] == ";":
+            stmts.append(Stmt(kw, arg))
+            pos += 1
+        elif tokens[pos] == "{":
+            subs, pos = _parse_stmts(tokens, pos + 1)
+            if pos >= len(tokens) or tokens[pos] != "}":
+                raise YangParseError(f"{kw}: missing closing brace")
+            stmts.append(Stmt(kw, arg, subs))
+            pos += 1
+        else:
+            raise YangParseError(f"{kw}: expected ';' or '{{'")
+    return stmts, pos
+
+
+def parse_text(text: str) -> Stmt:
+    """Parse YANG text into a statement tree (module or submodule)."""
+    tokens = _tokenize(text)
+    stmts, pos = _parse_stmts(tokens, 0)
+    if pos != len(tokens):
+        raise YangParseError("trailing tokens after module")
+    if len(stmts) != 1 or stmts[0].keyword not in ("module", "submodule"):
+        raise YangParseError("expected exactly one module statement")
+    return stmts[0]
+
+
+# YANG type -> schema-lite type.
+_TYPE_MAP = {
+    "string": "string",
+    "uint8": "uint8",
+    "uint16": "uint16",
+    "uint32": "uint32",
+    "int32": "int32",
+    "boolean": "boolean",
+    "inet:ip-address": "ip",
+    "inet:ipv4-address": "ip",
+    "inet:ipv6-address": "ip",
+    "inet:ip-prefix": "prefix",
+    "inet:ipv4-prefix": "prefix",
+    "inet:ipv6-prefix": "prefix",
+    # Best-effort mappings: validated downstream where it matters.
+    "union": "string",
+    "identityref": "string",
+    "yang:dotted-quad": "string",
+    "inet:domain-name": "string",
+    "uint64": "uint32",
+    "int64": "int32",
+    "uint": "uint32",
+    "binary": "string",
+    "empty": "boolean",
+}
+
+
+class _Builder:
+    def __init__(self, module: Stmt, shared: "dict | None" = None):
+        """``shared``: cross-module grouping/typedef namespaces (bare
+        names) built by :func:`load_modules` — the import-resolution
+        analog of libyang's module set."""
+        self.module = module
+        self.shared = shared or {"groupings": {}, "typedefs": {}}
+        self.typedefs: dict[str, tuple[str, tuple]] = {}  # name -> (type, enum)
+        self.groupings: dict[str, Stmt] = {}
+        for td in module.all("typedef"):
+            t = td.sub("type")
+            if t is not None:
+                base, enum = self._resolve_type(t)
+                self.typedefs[td.arg] = (base, enum)
+        for g in module.all("grouping"):
+            self.groupings[g.arg] = g
+
+    def _resolve_type(self, t: Stmt) -> tuple[str, tuple]:
+        name = t.arg or "string"
+        if name == "enumeration":
+            return "enum", tuple(e.arg for e in t.all("enum"))
+        if name in self.typedefs:
+            return self.typedefs[name]
+        # Strip an unknown prefix: "foo:bar" -> try the mapped full name
+        # first, then bare "bar" as a local typedef.
+        mapped = _TYPE_MAP.get(name)
+        if mapped is not None:
+            return mapped, ()
+        bare = name.split(":")[-1]
+        if bare in self.typedefs:
+            return self.typedefs[bare]
+        if bare in self.shared["typedefs"]:
+            return self.shared["typedefs"][bare]
+        return _TYPE_MAP.get(bare, "string"), ()
+
+    def _children(self, stmt: Stmt, config: bool) -> list:
+        out = []
+        for s in stmt.subs:
+            node = self._node(s, config)
+            if node is not None:
+                out.append(node)
+            elif s.keyword == "uses":
+                bare = s.arg.split(":")[-1]
+                g = (
+                    self.groupings.get(s.arg)
+                    or self.groupings.get(bare)
+                    or self.shared["groupings"].get(bare)
+                )
+                if g is None:
+                    raise YangParseError(f"uses {s.arg}: unknown grouping")
+                out.extend(self._children(g, config))
+        return out
+
+    def _config(self, stmt: Stmt, inherited: bool) -> bool:
+        c = stmt.sub("config")
+        if c is None:
+            return inherited
+        return c.arg == "true"
+
+    def _node(self, s: Stmt, config: bool):
+        if s.keyword == "container":
+            cfg = self._config(s, config)
+            return Container(
+                s.arg,
+                {c.name: c for c in self._children(s, cfg)},
+                presence=s.sub("presence") is not None,
+                config=cfg,
+            )
+        if s.keyword == "list":
+            cfg = self._config(s, config)
+            key = s.sub("key")
+            # Compound keys: schema-lite addresses lists by their first
+            # key leaf (the reference's config lists are single-keyed).
+            key_name = (key.arg.split()[0] if key is not None and key.arg
+                        else "name")
+            return List(
+                s.arg, key_name,
+                {c.name: c for c in self._children(s, cfg)},
+                config=cfg,
+            )
+        if s.keyword == "leaf":
+            cfg = self._config(s, config)
+            t = s.sub("type")
+            base, enum = (
+                self._resolve_type(t) if t is not None else ("string", ())
+            )
+            default = s.sub("default")
+            mandatory = s.sub("mandatory")
+            leaf = Leaf(
+                s.arg, base,
+                enum=enum,
+                mandatory=mandatory is not None and mandatory.arg == "true",
+                config=cfg,
+            )
+            if default is not None:
+                leaf.default = leaf.check(default.arg)
+            return leaf
+        if s.keyword == "leaf-list":
+            t = s.sub("type")
+            base, _enum = (
+                self._resolve_type(t) if t is not None else ("string", ())
+            )
+            return LeafList(s.arg, base, config=self._config(s, config))
+        return None  # non-data statement: skipped (or 'uses', see caller)
+
+
+def build_module(module: Stmt, shared: dict | None = None) -> list:
+    """Statement tree -> top-level schema nodes (mountable containers)."""
+    return _Builder(module, shared)._children(module, config=True)
+
+
+def load_yang(text: str) -> list:
+    """YANG text -> mountable schema nodes (the libyang-load analog)."""
+    return build_module(parse_text(text))
+
+
+def load_modules(texts: list[str]) -> dict[str, list]:
+    """Parse a whole module SET with cross-module grouping/typedef
+    resolution (imports resolve by bare name, like libyang's context):
+    {module name: top-level schema nodes}."""
+    modules = [parse_text(t) for t in texts]
+    shared: dict = {"groupings": {}, "typedefs": {}}
+
+    def collect(stmt):
+        for s in stmt.subs:
+            if s.keyword == "grouping":
+                shared["groupings"].setdefault(s.arg, s)
+            collect(s)
+
+    for m in modules:
+        collect(m)
+    # Typedefs need per-module resolution first (they may chain).
+    for m in modules:
+        b = _Builder(m, shared)
+        for name, resolved in b.typedefs.items():
+            shared["typedefs"].setdefault(name, resolved)
+    return {m.arg: build_module(m, shared) for m in modules}
