@@ -69,10 +69,18 @@ class Daemon:
         self.keychain = KeychainProvider(self.ibus)
         self.policy = PolicyProvider(self.ibus)
         self.system = SystemProvider(self.ibus)
+        # Durable state store (boot counters, GR info) next to the txn db
+        # (reference: pickledb, holo-daemon/src/main.rs:148-157).
+        self.nvstore = None
+        if self.config.db_path:
+            from holo_tpu.utils.nvstore import NvStore
+
+            nv = Path(self.config.db_path)
+            self.nvstore = NvStore(nv.with_name(nv.stem + "_nv.json"))
         self.routing = RoutingProvider(
             self.loop, self.ibus, netio, self.interface, kernel,
             prefix=self._p, policy_engine=self.policy.engine,
-            keychains=self.keychain,
+            keychains=self.keychain, nvstore=self.nvstore,
         )
         self.interface.routing_actor = f"{self._p}routing-rib"
         for p in (self.interface, self.keychain, self.policy, self.system, self.routing):

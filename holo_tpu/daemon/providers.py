@@ -350,11 +350,13 @@ class RoutingProvider(Provider, Actor):
         prefix: str = "",
         policy_engine=None,
         keychains: "KeychainProvider | None" = None,
+        nvstore=None,
     ):
         self.loop = loop
         self.ibus = ibus
         self.policy_engine = policy_engine
         self.keychains = keychains
+        self.nvstore = nvstore
         # netio: either a NetIo (shared sender) or a callable actor->NetIo
         # (MockFabric.sender_for) so each protocol actor receives its own
         # bound transmit handle.
@@ -531,6 +533,7 @@ class RoutingProvider(Provider, Actor):
                 config=InstanceConfig(router_id=IPv4Address(router_id), spf=timers),
                 netio=self.netio_factory(f"{self.prefix}ospfv2"),
                 spf_backend=backend,
+                nvstore=self.nvstore,
             )
             self.loop.register(inst)
             inst.attach_ibus(

@@ -5,9 +5,14 @@ events.rs (packet handlers), flood.rs (flooding), spf.rs (delay FSM).
 One actor per instance on the shared event loop; all IO via NetIo; all
 timers via loop timers (virtual-clock testable).
 
-Round-1 scope notes (vs reference): null auth only; no NSSA/virtual links;
-DD packets carry up to DD_CHUNK headers (MTU pagination simplified);
-MaxAge LSAs are removed once flooded with empty retransmission lists.
+Implemented here: multi-area ABR (type-3/4), AS externals (type-5) with
+redistribution, stub + NSSA areas (RFC 3101, elected translator), virtual
+links, keyed-MD5/HMAC auth with keychains and restart-safe seqno
+reservation (persisted ceiling; replaces the reference's boot-count seed),
+graceful restart (RFC 3623, both sides), RFC 8405 SPF delay FSM.
+Simplifications: DD packets carry up to DD_CHUNK headers (MTU pagination
+simplified); MaxAge LSAs are removed once flooded with empty
+retransmission lists.
 """
 
 from __future__ import annotations
@@ -184,6 +189,7 @@ class OspfInstance(Actor):
         netio: NetIo,
         spf_backend: SpfBackend | None = None,
         route_cb=None,
+        nvstore=None,
     ):
         self.name = name
         self.config = config
@@ -194,7 +200,28 @@ class OspfInstance(Actor):
         self._if_area: dict[str, IPv4Address] = {}
         self._timers: dict[tuple, object] = {}
         self._dd_seq = 0x1000  # deterministic DD seq seed
-        self._crypto_seq = 0  # MD5 auth sequence (boot-count persisted later)
+        # Cryptographic-auth sequence numbers must be strictly higher after
+        # a restart than anything a neighbor saw before it, or every packet
+        # is dropped as a replay until the dead interval expires.  The
+        # reference seeds from a persisted boot count
+        # (holo-ospf/src/instance.rs:231,257-258 initial_auth_seqno).  We
+        # persist a *reserved ceiling* instead: the store always holds a
+        # seqno no packet has used yet, and tx extends the reservation in
+        # 2^16-packet windows (one durable write per window), so restarts
+        # always seed above every previously sent seqno regardless of
+        # uptime.  Without a store (deterministic tests) the seed stays 0.
+        self._nvstore = nvstore
+        self._seqno_key = f"ospf/{name}/seqno-ceiling"
+        self._crypto_reserved = 0
+        if nvstore is not None:
+            # Boot count is operational state only (exposed for debugging,
+            # GR bookkeeping later); the seqno seed comes from the ceiling.
+            nvstore.incr(f"ospf/{name}/boot-count")
+            self._crypto_seq = int(nvstore.get(self._seqno_key, 0))
+            self._reserve_seqnos()
+        else:
+            self._crypto_seq = 0
+
         # RFC 3623 restarting side: while True, self-LSA origination is
         # suppressed and pre-restart copies are adopted (not outpaced) so
         # helpers keep forwarding on the pre-restart topology.
@@ -227,6 +254,13 @@ class OspfInstance(Actor):
         # Segment routing state (labels resolved after each SPF).
         self.sr_labels: dict = {}
         self._sr_opaque_ids: dict[IPv4Network, int] = {}
+
+    _SEQNO_WINDOW = 1 << 16
+
+    def _reserve_seqnos(self) -> None:
+        """Durably reserve the next window of auth sequence numbers."""
+        self._crypto_reserved = self._crypto_seq + self._SEQNO_WINDOW
+        self._nvstore.put(self._seqno_key, self._crypto_reserved)
 
     def attach_ibus(
         self, ibus, routing_actor: str = "routing", bfd_actor: str = "bfd"
@@ -2080,5 +2114,7 @@ class OspfInstance(Actor):
         auth = iface.config.auth
         if auth is not None and auth.type == AuthType.CRYPTOGRAPHIC:
             self._crypto_seq += 1
+            if self._nvstore is not None and self._crypto_seq >= self._crypto_reserved:
+                self._reserve_seqnos()
             auth.seqno = self._crypto_seq
         self.netio.send(iface.name, iface.addr_ip, dst, pkt.encode(auth=auth))

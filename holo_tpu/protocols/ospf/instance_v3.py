@@ -1042,7 +1042,7 @@ class OspfV3Instance(Actor):
         if iface is None or not iface.up:
             return
         try:
-            pkt = P.Packet.decode(msg.data, src=msg.src, dst=None)
+            pkt = P.Packet.decode(msg.data, src=msg.src, dst=msg.dst)
         except Exception:
             return
         if pkt.router_id == self.router_id:
@@ -1069,6 +1069,6 @@ class OspfV3Instance(Actor):
         pkt = P.Packet(router_id=self.router_id,
                        area_id=iface.config.area_id, body=body,
                        instance_id=iface.config.instance_id)
-        # Checksum zero on the fabric (decode skips it); real transports
-        # pass src/dst so the IPv6 pseudo-header checksum is computed.
-        self.netio.send(iface.name, iface.link_local, dst, pkt.encode())
+        self.netio.send(
+            iface.name, iface.link_local, dst, pkt.encode(iface.link_local, dst)
+        )

@@ -583,7 +583,11 @@ class Packet:
         cksum = r.u16()
         instance_id = r.u8()
         r.u8()
-        if src is not None and dst is not None and cksum != 0:
+        if src is not None and dst is not None:
+            # RFC 5340 §4.2.2: the checksum is mandatory; a zero wire value
+            # is not a bypass (the reference permits that only under its
+            # 'testing' cfg — holo-ospf lsa.rs is_checksum_valid).  Callers
+            # that cannot reconstruct the pseudo-header pass src/dst=None.
             if _cksum16(_pseudo_header(src, dst, length) + data[:length]) != 0:
                 raise DecodeError("packet checksum mismatch")
         body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))

@@ -1,0 +1,93 @@
+"""Durable-state store + auth seqno boot seeding (ADVICE round-1 items)."""
+
+from ipaddress import IPv4Address as A
+
+from holo_tpu.protocols.ospf.instance import InstanceConfig, OspfInstance
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.nvstore import NvStore
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def test_nvstore_roundtrip_and_incr(tmp_path):
+    p = tmp_path / "nv.json"
+    s = NvStore(p)
+    assert s.get("x") is None
+    s.put("x", {"a": 1})
+    assert s.incr("boot") == 1
+    assert s.incr("boot") == 2
+    # re-open: contents survive
+    s2 = NvStore(p)
+    assert s2.get("x") == {"a": 1}
+    assert s2.incr("boot") == 3
+
+
+def _mk_instance(nvstore):
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    return OspfInstance(
+        name="ospf-a",
+        config=InstanceConfig(router_id=A("1.1.1.1")),
+        netio=fabric.sender_for("ospf-a"),
+        nvstore=nvstore,
+    )
+
+
+def test_crypto_seq_restart_never_reuses_seqnos(tmp_path):
+    store = NvStore(tmp_path / "nv.json")
+    first = _mk_instance(store)
+    # simulate long uptime: exhaust several reservation windows
+    for _ in range(3):
+        first._crypto_seq = first._crypto_reserved
+        first._reserve_seqnos()
+    last_sent = first._crypto_seq
+    # a "restart" (new instance, same store) must seed strictly above every
+    # seqno the previous boot could have used, regardless of uptime
+    second = _mk_instance(store)
+    assert second._crypto_seq >= last_sent
+    assert second._crypto_reserved > second._crypto_seq
+    assert store.get("ospf/ospf-a/boot-count") == 2
+
+
+def test_crypto_seq_zero_without_store():
+    assert _mk_instance(None)._crypto_seq == 0
+
+
+def test_tx_path_extends_reservation_at_window_boundary(tmp_path):
+    """Crossing the reserved ceiling on a real transmit must durably extend
+    the reservation BEFORE the boundary seqno goes on the wire."""
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.protocols.ospf.instance import IfConfig, IfUpMsg
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.protocols.ospf.packet import AuthCtx, AuthType, Packet
+
+    store = NvStore(tmp_path / "nv.json")
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    inst = OspfInstance(
+        name="r1",
+        config=InstanceConfig(router_id=A("1.1.1.1")),
+        netio=fabric.sender_for("r1"),
+        nvstore=store,
+    )
+    loop.register(inst)
+    auth = AuthCtx(AuthType.CRYPTOGRAPHIC, b"k", key_id=1)
+    inst.add_interface(
+        "e0",
+        IfConfig(if_type=IfType.POINT_TO_POINT, cost=1, auth=auth),
+        N("10.0.0.0/30"),
+        A("10.0.0.1"),
+    )
+    fabric.join("l", "r1", "e0", A("10.0.0.1"))
+    # Park the counter one below the ceiling; the next hello crosses it.
+    inst._crypto_seq = inst._crypto_reserved - 1
+    loop.send(inst.name, IfUpMsg("e0"))
+    loop.advance(1)  # at least one hello transmits
+    sent = [Packet.decode(d, auth=auth) for (_, _, _, d) in fabric.tx_log]
+    assert sent, "no packets transmitted"
+    top = max(p.auth_seqno for p in sent)
+    assert top >= NvStore(tmp_path / "nv.json").get("ospf/r1/seqno-ceiling") - (
+        OspfInstance._SEQNO_WINDOW
+    ), "reservation not extended"
+    # Invariant: every transmitted seqno is strictly below the durable ceiling.
+    assert top < store.get("ospf/r1/seqno-ceiling")
