@@ -106,9 +106,21 @@ class TpuSpfBackend(SpfBackend):
 
     name = "tpu"
 
-    def __init__(self, n_atoms: int = 64, max_iters: int | None = None):
+    def __init__(
+        self,
+        n_atoms: int = 64,
+        max_iters: int | None = None,
+        engine: str = "gather",
+    ):
+        """``engine``: 'gather' (ELL gathers; handles any topology) or
+        'blocked' (block-sparse Pallas kernels; fastest on large LSDBs,
+        requires unique (src,dst) pairs and distances < 2**27 — falls back
+        to gather per topology when those preconditions fail)."""
         self.n_atoms = n_atoms
         self.max_iters = max_iters
+        self.engine = engine
+        self._blocked_cache: dict[tuple, object] = {}
+        self._jit_blocked = None  # built lazily (pallas import)
         # Small LRU of marshaled graphs: an instance typically alternates
         # between its LSDB topology and derived ones (hop graphs for
         # flooding reduction), which must not evict each other.
@@ -140,6 +152,12 @@ class TpuSpfBackend(SpfBackend):
         return np.asarray(edge_mask, bool)
 
     def compute(self, topo, edge_mask=None):
+        if self.engine == "blocked":
+            res = self._whatif_blocked(
+                topo, self._full_mask(topo, edge_mask)[None, :]
+            )
+            if res is not None:
+                return res[0]
         g = self.prepare(topo)
         out = self._jit_one(g, topo.root, self._full_mask(topo, edge_mask))
         return SpfResult(
@@ -149,7 +167,61 @@ class TpuSpfBackend(SpfBackend):
             nexthop_words=np.asarray(out.nexthops),
         )
 
+    def prepare_blocked(self, topo: Topology):
+        """Marshal (and cache) the blocked planes; None if unsupported.
+
+        The cache key includes the root: unlike the gather planes, the
+        blocked planes bake the root in (BFS permutation + rootp).
+        """
+        key = (*topo.cache_key, topo.root)
+        if key in self._blocked_cache:
+            return self._blocked_cache[key]
+        from holo_tpu.ops.blocked_spf import marshal_block_spf
+
+        try:
+            g = marshal_block_spf(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
+        except ValueError:
+            g = None  # preconditions unmet: gather engine handles it
+        self._blocked_cache[key] = g
+        while len(self._blocked_cache) > 4:
+            self._blocked_cache.pop(next(iter(self._blocked_cache)))
+        return g
+
+    def _whatif_blocked(self, topo, edge_masks):
+        from holo_tpu.ops.blocked_spf import failed_edges_perm, whatif_spf_blocked
+
+        g = self.prepare_blocked(topo)
+        if g is None:
+            return None
+        try:
+            fdst, fid = failed_edges_perm(
+                np.asarray(g.orig2perm), topo, np.asarray(edge_masks, bool)
+            )
+        except ValueError:
+            return None  # too many failed edges per scenario
+        if self._jit_blocked is None:
+            from functools import partial
+
+            self._jit_blocked = jax.jit(
+                partial(whatif_spf_blocked, max_iters=self.max_iters)
+            )
+        out = self._jit_blocked(g, fdst, fid)
+        dist, parent, hops, nh = (
+            np.asarray(out.dist),
+            np.asarray(out.parent),
+            np.asarray(out.hops),
+            np.asarray(out.nexthops),
+        )
+        return [
+            SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
+            for i in range(dist.shape[0])
+        ]
+
     def compute_whatif(self, topo, edge_masks):
+        if self.engine == "blocked":
+            res = self._whatif_blocked(topo, edge_masks)
+            if res is not None:
+                return res
         g = self.prepare(topo)
         out = self._jit_batch(g, topo.root, np.asarray(edge_masks, bool))
         # One bulk device→host transfer per plane: per-scenario slicing of
