@@ -181,6 +181,11 @@ def main(argv=None):
                     for ev in events:
                         daemon.interface.apply_kernel_event(ev)
 
+                tcp = getattr(daemon.routing, "bgp_tcp_io", None)
+                if tcp is not None:
+                    from holo_tpu.utils.tcpio import pump_once
+
+                    pump_once([tcp], timeout_ms=0)
                 daemon.loop.run_until_idle()
                 daemon.northbound.check_confirmed_timeout(time.time())
                 nd = daemon.loop.next_deadline()

@@ -8,6 +8,10 @@ holo-routing/src/northbound/configuration.rs:1228-1301).
 
 from __future__ import annotations
 
+import logging
+
+log = logging.getLogger("holo_tpu.providers")
+
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address, ip_interface
 
@@ -925,22 +929,46 @@ class RoutingProvider(Provider, Actor):
                 self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
                 self.loop.unregister(inst.name)
                 del self.instances["bgp"]
+                self._close_bgp_tcp()
             return
+        wanted_transport = (
+            new.get(f"{base}/transport", "fabric"),
+            new.get(f"{base}/port", 179),
+        )
         if inst is not None and (
-            inst.asn != asn or inst.router_id != IPv4Address(router_id)
+            inst.asn != asn
+            or inst.router_id != IPv4Address(router_id)
+            or wanted_transport != getattr(self, "_bgp_transport", wanted_transport)
         ):
-            # Speaker identity change: restart (new OPENs, fresh RIBs).
+            # Speaker identity or transport change: restart (new OPENs,
+            # fresh RIBs, fresh sockets).
             self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
             self.loop.unregister(inst.name)
             del self.instances["bgp"]
+            self._close_bgp_tcp()
             inst = None
+        self._bgp_transport = wanted_transport
+        tcp_io = getattr(self, "bgp_tcp_io", None)
         if inst is None:
             actor = f"{self.prefix}bgp"
+            # Transport: real TCP sessions (production; RFC 4271 §8 over
+            # holo-bgp/src/network.rs semantics) or the in-memory fabric
+            # (deterministic tests).
+            if new.get(f"{base}/transport") == "tcp":
+                from holo_tpu.utils.tcpio import BgpTcpIo
+
+                tcp_io = BgpTcpIo(
+                    self.loop, actor, port=new.get(f"{base}/port", 179)
+                )
+                self.bgp_tcp_io = tcp_io
+                netio = tcp_io
+            else:
+                netio = self.netio_factory(actor)
             inst = BgpInstance(
                 name=actor,
                 asn=asn,
                 router_id=IPv4Address(router_id),
-                netio=self.netio_factory(actor),
+                netio=netio,
                 route_cb=self._bgp_route_cb,
             )
             self.loop.register(inst)
@@ -986,10 +1014,47 @@ class RoutingProvider(Provider, Actor):
                 ),
                 local,
             )
+            if tcp_io is not None:
+                try:
+                    tcp_io.listen(local)  # idempotent per address
+                except OSError as e:
+                    log.error(
+                        "BGP listen on %s:%s failed: %s (passive peers "
+                        "cannot connect in)",
+                        local, wanted_transport[1], e,
+                    )
+                tcp_io.add_peer(
+                    local, addr, ifname=ifname,
+                    md5_key=(
+                        n["authentication-key"].encode()
+                        if n.get("authentication-key")
+                        else None
+                    ),
+                )
             inst.start_peer(addr)
         # Neighbors removed from config: drop the session + their routes.
         for addr in list(inst.peers.keys() - wanted_peers):
             inst.remove_peer(addr)
+            if tcp_io is not None:
+                tcp_io.remove_peer(addr)
+        # network statements: locally originated routes (v4 or v6).
+        from ipaddress import ip_network
+
+        wanted_nets = set()
+        for p_s, nconf in (new.get(f"{base}/network") or {}).items():
+            prefix = ip_network(nconf.get("prefix", p_s), strict=False)
+            wanted_nets.add(prefix)
+            if prefix not in inst.originated:
+                inst.originate(prefix)
+        for prefix in list(inst.originated.keys() - wanted_nets):
+            del inst.originated[prefix]
+            inst._decision(prefix)
+
+    def _close_bgp_tcp(self):
+        io = getattr(self, "bgp_tcp_io", None)
+        if io is not None:
+            io.close()
+            self.bgp_tcp_io = None
 
     def _bgp_route_cb(self, prefix, best):
         from holo_tpu.utils.southbound import (
@@ -1003,13 +1068,25 @@ class RoutingProvider(Provider, Actor):
         if best is None or best.peer is None:
             self.rib.route_del(RouteKeyMsg(Protocol.BGP, prefix))
             return
+        from ipaddress import IPv6Network
+
+        nh = (
+            best.attrs.nh6
+            if isinstance(prefix, IPv6Network)
+            else best.attrs.next_hop
+        )
+        if nh is None:
+            # No usable next hop for this family: never install a
+            # blackhole; drop any previous entry instead.
+            self.rib.route_del(RouteKeyMsg(Protocol.BGP, prefix))
+            return
         self.rib.route_add(
             RouteMsg(
                 protocol=Protocol.BGP,
                 prefix=prefix,
                 distance=DEFAULT_DISTANCE[Protocol.BGP],
                 metric=best.attrs.med or 0,
-                nexthops=frozenset({Nexthop(addr=best.attrs.next_hop)}),
+                nexthops=frozenset({Nexthop(addr=nh)}),
             )
         )
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from ipaddress import IPv4Address, IPv4Network
+from ipaddress import IPv4Address, IPv4Network, IPv6Address, IPv6Network
 
 import logging
 
@@ -51,6 +51,11 @@ class AttrType(enum.IntEnum):
     NEXT_HOP = 3
     MED = 4
     LOCAL_PREF = 5
+    MP_REACH_NLRI = 14  # RFC 4760
+    MP_UNREACH_NLRI = 15
+
+
+AFI_IPV4, AFI_IPV6, SAFI_UNICAST = 1, 2, 1
 
 
 @dataclass
@@ -60,8 +65,18 @@ class PathAttrs:
     next_hop: IPv4Address | None = None
     med: int | None = None
     local_pref: int | None = None
+    # MP-BGP (RFC 4760): the IPv6-unicast next hop rides inside the
+    # MP_REACH_NLRI attribute (holo-bgp/src/af.rs:25,59-62 — the
+    # AddressFamily trait's nexthop handling); it lives here so one attrs
+    # object describes a route of either family.
+    nh6: IPv6Address | None = None
 
-    def encode(self, w: Writer) -> None:
+    def encode(
+        self,
+        w: Writer,
+        nlri6: list[IPv6Network] | None = None,
+        withdrawn6: list[IPv6Network] | None = None,
+    ) -> None:
         pos = len(w)
         w.u16(0)  # total length placeholder
         start = len(w)
@@ -75,6 +90,20 @@ class PathAttrs:
         w.u8(0x40).u8(AttrType.AS_PATH).u8(len(body)).bytes(body.finish())
         if self.next_hop is not None:
             w.u8(0x40).u8(AttrType.NEXT_HOP).u8(4).ipv4(self.next_hop)
+        if nlri6:
+            # MP_REACH_NLRI (RFC 4760 §3): AFI/SAFI, next hop, NLRI.
+            mp = Writer()
+            mp.u16(AFI_IPV6).u8(SAFI_UNICAST)
+            nh = self.nh6.packed if self.nh6 is not None else bytes(16)
+            mp.u8(len(nh)).bytes(nh)
+            mp.u8(0)  # reserved (SNPA count)
+            _encode_prefixes(mp, nlri6)
+            w.u8(0x80).u8(AttrType.MP_REACH_NLRI).u8(len(mp)).bytes(mp.finish())
+        if withdrawn6:
+            mp = Writer()
+            mp.u16(AFI_IPV6).u8(SAFI_UNICAST)
+            _encode_prefixes(mp, withdrawn6)
+            w.u8(0x80).u8(AttrType.MP_UNREACH_NLRI).u8(len(mp)).bytes(mp.finish())
         if self.med is not None:
             w.u8(0x80).u8(AttrType.MED).u8(4).u32(self.med)
         if self.local_pref is not None:
@@ -82,10 +111,13 @@ class PathAttrs:
         w.patch_u16(pos, len(w) - start)
 
     @classmethod
-    def decode(cls, r: Reader) -> "PathAttrs":
+    def decode(cls, r: Reader) -> "tuple[PathAttrs, list, list]":
+        """Returns (attrs, mp-reach IPv6 NLRI, mp-unreach IPv6 prefixes)."""
         total = r.u16()
         sub = r.sub(total)
         out = cls()
+        nlri6: list[IPv6Network] = []
+        withdrawn6: list[IPv6Network] = []
         while sub.remaining() >= 3:
             flags = sub.u8()
             atype = sub.u8()
@@ -110,8 +142,22 @@ class PathAttrs:
                 out.med = body.u32()
             elif atype == AttrType.LOCAL_PREF:
                 out.local_pref = body.u32()
+            elif atype == AttrType.MP_REACH_NLRI:
+                afi, safi = body.u16(), body.u8()
+                nhlen = body.u8()
+                nh = body.bytes(nhlen)
+                body.u8()  # reserved
+                if afi == AFI_IPV6 and safi == SAFI_UNICAST:
+                    if nhlen >= 16:
+                        # a link-local may follow the global (RFC 2545 §3)
+                        out.nh6 = IPv6Address(nh[:16])
+                    nlri6 = _decode_prefixes(body, v6=True)
+            elif atype == AttrType.MP_UNREACH_NLRI:
+                afi, safi = body.u16(), body.u8()
+                if afi == AFI_IPV6 and safi == SAFI_UNICAST:
+                    withdrawn6 = _decode_prefixes(body, v6=True)
             # unknown attrs skipped (body consumed)
-        return out
+        return out, nlri6, withdrawn6
 
 
 def _encode_prefixes(w: Writer, prefixes) -> None:
@@ -121,15 +167,16 @@ def _encode_prefixes(w: Writer, prefixes) -> None:
         w.bytes(p.network_address.packed[: (plen + 7) // 8])
 
 
-def _decode_prefixes(r: Reader) -> list[IPv4Network]:
+def _decode_prefixes(r: Reader, v6: bool = False):
     out = []
+    maxlen, size, cls_ = (128, 16, IPv6Network) if v6 else (32, 4, IPv4Network)
     while r.remaining() >= 1:
         plen = r.u8()
-        if plen > 32:
+        if plen > maxlen:
             raise DecodeError("bad prefix length")
         nbytes = (plen + 7) // 8
-        raw = r.bytes(nbytes) + bytes(4 - nbytes)
-        out.append(IPv4Network((int.from_bytes(raw, "big"), plen)))
+        raw = r.bytes(nbytes) + bytes(size - nbytes)
+        out.append(cls_((int.from_bytes(raw, "big"), plen)))
     return out
 
 
@@ -138,6 +185,10 @@ class OpenMsg:
     asn: int
     hold_time: int
     router_id: IPv4Address
+    # (afi, safi) pairs from the peer's multiprotocol capabilities; a
+    # speaker advertising no MP capability implies IPv4 unicast only
+    # (RFC 4760 §8).
+    mp_afs: tuple = ((AFI_IPV4, SAFI_UNICAST),)
 
     TYPE = MsgType.OPEN
 
@@ -146,8 +197,11 @@ class OpenMsg:
         w.u16(self.asn if self.asn < 65536 else 23456)  # AS_TRANS
         w.u16(self.hold_time)
         w.ipv4(self.router_id)
-        # Capabilities: 4-octet AS (65).
+        # Capabilities: multiprotocol IPv4+IPv6 unicast (RFC 4760 §8),
+        # 4-octet AS (RFC 6793).
         cap = Writer()
+        cap.u8(1).u8(4).u16(AFI_IPV4).u8(0).u8(SAFI_UNICAST)
+        cap.u8(1).u8(4).u16(AFI_IPV6).u8(0).u8(SAFI_UNICAST)
         cap.u8(65).u8(4).u32(self.asn)
         opt = Writer()
         opt.u8(2).u8(len(cap)).bytes(cap.finish())
@@ -162,6 +216,7 @@ class OpenMsg:
         rid = r.ipv4()
         optlen = r.u8()
         opts = r.sub(optlen)
+        mp_afs: list = []
         while opts.remaining() >= 2:
             ptype = opts.u8()
             plen = opts.u8()
@@ -173,9 +228,16 @@ class OpenMsg:
                     cbody = body.sub(clen)
                     if code == 65 and clen == 4:
                         asn = cbody.u32()
+                    elif code == 1 and clen == 4:  # multiprotocol
+                        afi = cbody.u16()
+                        cbody.u8()  # reserved
+                        mp_afs.append((afi, cbody.u8()))
         if hold != 0 and hold < 3:
             raise DecodeError("bad hold time")
-        return cls(asn, hold, rid)
+        return cls(
+            asn, hold, rid,
+            tuple(mp_afs) if mp_afs else ((AFI_IPV4, SAFI_UNICAST),),
+        )
 
 
 @dataclass
@@ -183,6 +245,9 @@ class UpdateMsg:
     withdrawn: list[IPv4Network] = field(default_factory=list)
     attrs: PathAttrs | None = None
     nlri: list[IPv4Network] = field(default_factory=list)
+    # IPv6 unicast rides the MP_REACH/MP_UNREACH attributes (RFC 4760).
+    nlri6: list[IPv6Network] = field(default_factory=list)
+    withdrawn6: list[IPv6Network] = field(default_factory=list)
 
     TYPE = MsgType.UPDATE
 
@@ -192,8 +257,8 @@ class UpdateMsg:
         start = len(w)
         _encode_prefixes(w, self.withdrawn)
         w.patch_u16(pos, len(w) - start)
-        if self.attrs is not None:
-            self.attrs.encode(w)
+        if self.attrs is not None or self.nlri6 or self.withdrawn6:
+            (self.attrs or PathAttrs()).encode(w, self.nlri6, self.withdrawn6)
         else:
             w.u16(0)
         _encode_prefixes(w, self.nlri)
@@ -202,9 +267,9 @@ class UpdateMsg:
     def decode_body(cls, r: Reader) -> "UpdateMsg":
         wlen = r.u16()
         withdrawn = _decode_prefixes(r.sub(wlen))
-        attrs = PathAttrs.decode(r)
+        attrs, nlri6, withdrawn6 = PathAttrs.decode(r)
         nlri = _decode_prefixes(r)
-        return cls(withdrawn, attrs, nlri)
+        return cls(withdrawn, attrs, nlri, nlri6, withdrawn6)
 
 
 @dataclass
@@ -283,7 +348,7 @@ from typing import Any
 
 @dataclass
 class PeerConfig:
-    addr: IPv4Address
+    addr: Any  # IPv4Address or IPv6Address (session transport address)
     remote_as: int
     ifname: str
     hold_time: int = 90
@@ -313,12 +378,22 @@ class KeepaliveTimerMsg:
     peer: IPv4Address
 
 
+@dataclass
+class ConnectionDownMsg:
+    """Transport-level session loss (TCP reset/close) from the IO layer."""
+
+    peer: Any
+
+
 class Peer:
     def __init__(self, cfg: PeerConfig):
         self.config = cfg
         self.state = PeerState.IDLE
         self.remote_rid: IPv4Address | None = None
         self.hold_time = cfg.hold_time
+        # Negotiated address families (RFC 4760 §8): v6 routes are only
+        # advertised to peers that declared IPv6-unicast capability.
+        self.af6 = False
         self.adj_rib_in: dict[IPv4Network, PathAttrs] = {}
         self.adj_rib_out: dict[IPv4Network, PathAttrs] = {}
         # Bumped whenever the session drops: stale async policy-worker
@@ -354,17 +429,25 @@ class BgpInstance(Actor):
         self.netio = netio
         self.route_cb = route_cb
         self.policy_worker = policy_worker
-        self.peers: dict[IPv4Address, Peer] = {}
-        self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our addr
-        # Loc-RIB: prefix -> list[RouteEntry]; best first after decision.
-        self.loc_rib: dict[IPv4Network, list[RouteEntry]] = {}
-        self.originated: dict[IPv4Network, PathAttrs] = {}
+        self.peers: dict = {}  # peer address (v4 or v6) -> Peer
+        self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our v4 addr
+        self.local_addr6: dict[str, IPv6Address] = {}  # ifname -> our v6 addr
+        # Loc-RIB: prefix (v4 or v6) -> list[RouteEntry]; best first.
+        self.loc_rib: dict = {}
+        self.originated: dict = {}
 
-    def add_peer(self, cfg: PeerConfig, local_addr: IPv4Address) -> Peer:
+    def add_peer(self, cfg: PeerConfig, local_addr) -> Peer:
         peer = Peer(cfg)
         self.peers[cfg.addr] = peer
-        self.local_addr[cfg.ifname] = local_addr
+        if isinstance(local_addr, IPv6Address):
+            self.local_addr6[cfg.ifname] = local_addr
+        else:
+            self.local_addr[cfg.ifname] = local_addr
         return peer
+
+    def set_local_addr6(self, ifname: str, addr: IPv6Address) -> None:
+        """v6 source address for MP next hops on a v4-transported session."""
+        self.local_addr6[ifname] = addr
 
     def start_peer(self, addr: IPv4Address) -> None:
         peer = self.peers[addr]
@@ -429,6 +512,10 @@ class BgpInstance(Actor):
             ):
                 self._send(peer, KeepaliveMsg())
                 self._keepalive_timer(peer).start(max(peer.hold_time / 3, 1))
+        elif isinstance(msg, ConnectionDownMsg):
+            peer = self.peers.get(msg.peer)
+            if peer is not None and peer.state != PeerState.IDLE:
+                self._drop_peer(peer)
 
     # -- fsm helpers
 
@@ -449,7 +536,12 @@ class BgpInstance(Actor):
                            lambda a=peer.config.addr: KeepaliveTimerMsg(a))
 
     def _send(self, peer: Peer, body) -> None:
-        src = self.local_addr.get(peer.config.ifname)
+        table = (
+            self.local_addr6
+            if isinstance(peer.config.addr, IPv6Address)
+            else self.local_addr
+        )
+        src = table.get(peer.config.ifname)
         self.netio.send(peer.config.ifname, src, peer.config.addr, encode_msg(body))
 
     def _send_open(self, peer: Peer) -> None:
@@ -463,6 +555,11 @@ class BgpInstance(Actor):
 
     def _drop_peer(self, peer: Peer) -> None:
         peer.state = PeerState.IDLE
+        # Tell a connection-oriented transport to tear the session down
+        # (stale TCP sockets would otherwise block re-establishment).
+        reset = getattr(self.netio, "session_reset", None)
+        if reset is not None:
+            reset(peer.config.addr)
         peer.generation += 1  # invalidate in-flight policy-worker results
         peer.last_withdraw_seq.clear()  # generation guard covers old batches
         withdrawn = list(peer.adj_rib_in.keys())
@@ -500,6 +597,7 @@ class BgpInstance(Actor):
             self._drop_peer(peer)
             return
         peer.remote_rid = open_.router_id
+        peer.af6 = (AFI_IPV6, SAFI_UNICAST) in open_.mp_afs
         peer.hold_time = min(peer.config.hold_time, open_.hold_time)
         if peer.state == PeerState.IDLE:
             self._send_open(peer)
@@ -523,7 +621,7 @@ class BgpInstance(Actor):
         peer.update_seq += 1
         seq = peer.update_seq
         changed = set()
-        for prefix in upd.withdrawn:
+        for prefix in list(upd.withdrawn) + list(upd.withdrawn6):
             peer.last_withdraw_seq[prefix] = seq
             if peer.adj_rib_in.pop(prefix, None) is not None:
                 changed.add(prefix)
@@ -535,7 +633,8 @@ class BgpInstance(Actor):
             peer.last_withdraw_seq = {
                 p: s for p, s in peer.last_withdraw_seq.items() if s >= horizon
             }
-        if upd.nlri and upd.attrs is not None:
+        announced = list(upd.nlri) + list(upd.nlri6)
+        if announced and upd.attrs is not None:
             attrs = upd.attrs
             # Loop prevention: our AS in the path -> reject.
             if self.asn not in attrs.as_path:
@@ -550,7 +649,7 @@ class BgpInstance(Actor):
                             peer=peer.config.addr,
                             peer_generation=peer.generation,
                             policy_name=imp,
-                            entries=[(p, attrs) for p in upd.nlri],
+                            entries=[(p, attrs) for p in announced],
                             token=seq,
                         ),
                     )
@@ -561,10 +660,10 @@ class BgpInstance(Actor):
                         log.error(
                             "policy worker %r unreachable: rejecting %d "
                             "announcements from %s",
-                            self.policy_worker, len(upd.nlri),
+                            self.policy_worker, len(announced),
                             peer.config.addr,
                         )
-                        for prefix in upd.nlri:
+                        for prefix in announced:
                             if peer.adj_rib_in.pop(prefix, None) is not None:
                                 changed.add(prefix)
                 elif isinstance(imp, str):
@@ -575,11 +674,11 @@ class BgpInstance(Actor):
                         "is configured: rejecting announcements",
                         peer.config.addr, imp,
                     )
-                    for prefix in upd.nlri:
+                    for prefix in announced:
                         if peer.adj_rib_in.pop(prefix, None) is not None:
                             changed.add(prefix)
                 else:
-                    for prefix in upd.nlri:
+                    for prefix in announced:
                         a = imp(prefix, attrs) if imp else attrs
                         if a is None:
                             # Rejected re-announcement replaces (removes)
@@ -660,19 +759,27 @@ class BgpInstance(Actor):
             src_peer = self.peers.get(entry.peer)
             if src_peer is not None and src_peer.config.remote_as == self.asn:
                 return None  # iBGP does not re-reflect iBGP routes
+        v6 = isinstance(prefix, IPv6Network)
+        if v6 and (
+            not peer.af6 or self.local_addr6.get(peer.config.ifname) is None
+        ):
+            # Unnegotiated family, or no v6 next-hop source: advertising
+            # would violate RFC 4760 §8 / install a :: next hop.
+            return None
         attrs = PathAttrs(
             origin=entry.attrs.origin,
             as_path=((self.asn,) + entry.attrs.as_path) if ebgp else entry.attrs.as_path,
-            next_hop=self.local_addr.get(peer.config.ifname),
+            next_hop=None if v6 else self.local_addr.get(peer.config.ifname),
             med=entry.attrs.med if not ebgp else None,
             local_pref=(entry.attrs.local_pref or 100) if not ebgp else None,
+            nh6=self.local_addr6.get(peer.config.ifname) if v6 else None,
         )
         exp = peer.config.export_policy
         if exp is not None:
             return exp(prefix, attrs)
         return attrs
 
-    def _advertise_prefix(self, prefix: IPv4Network) -> None:
+    def _advertise_prefix(self, prefix) -> None:
         best = self.loc_rib.get(prefix)
         for peer in self.peers.values():
             if peer.state != PeerState.ESTABLISHED:
@@ -687,7 +794,11 @@ class BgpInstance(Actor):
                 cur = peer.adj_rib_out.get(prefix)
                 if cur != attrs:
                     peer.adj_rib_out[prefix] = attrs
-                    self._send(peer, UpdateMsg(nlri=[prefix], attrs=attrs))
+                    if isinstance(prefix, IPv6Network):
+                        upd = UpdateMsg(nlri6=[prefix], attrs=attrs)
+                    else:
+                        upd = UpdateMsg(nlri=[prefix], attrs=attrs)
+                    self._send(peer, upd)
             elif prefix in peer.adj_rib_out:
                 del peer.adj_rib_out[prefix]
                 self._send(peer, encode_update_withdraw(prefix))
@@ -697,5 +808,7 @@ class BgpInstance(Actor):
             self._advertise_prefix(prefix)
 
 
-def encode_update_withdraw(prefix: IPv4Network) -> UpdateMsg:
+def encode_update_withdraw(prefix) -> UpdateMsg:
+    if isinstance(prefix, IPv6Network):
+        return UpdateMsg(withdrawn6=[prefix])
     return UpdateMsg(withdrawn=[prefix])

@@ -1,0 +1,353 @@
+"""BGP TCP transport: real stream sessions with framing and TCP-MD5.
+
+Reference: holo-bgp/src/network.rs (connect/listen/accept + message
+framing) and holo-utils/src/socket.rs:38-53 (TCP_MD5SIG).  The instance
+actor stays transport-agnostic — this IO layer owns the sockets and
+delivers whole BGP messages as :class:`NetRxPacket`s, exactly like the
+mock fabric, so the FSM/test code paths are identical.
+
+Connection establishment is deterministic instead of collision-resolved:
+the side with the numerically GREATER transport address connects
+actively; the other side only listens.  (The reference lets both sides
+connect and resolves the collision by router-id comparison,
+holo-bgp/src/neighbor.rs — with a single connection per peer pair the
+deterministic role split reaches the same steady state without the
+transient duplicate sessions.)
+
+Framing: BGP messages are length-delimited at bytes 16..18 (after the
+16-byte marker); partial reads accumulate per connection until a whole
+message is available.
+
+Integration: the daemon's main loop polls ``fds()`` and calls ``pump(fd)``
+on readiness plus ``tick()`` periodically (connect retries), mirroring
+:mod:`holo_tpu.utils.rawsock`.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import socket
+import struct
+from dataclasses import dataclass, field
+from ipaddress import IPv6Address, ip_address
+
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+
+log = logging.getLogger("holo_tpu.tcpio")
+
+BGP_PORT = 179
+MAX_MSG = 4096
+TCP_MD5SIG = 14  # setsockopt optname (Linux, IPPROTO_TCP level)
+TCP_MD5SIG_MAXKEYLEN = 80
+
+
+def _sockaddr_storage(addr, port: int) -> bytes:
+    """Pack a sockaddr_{in,in6} into 128-byte sockaddr_storage."""
+    ip = ip_address(addr)
+    if isinstance(ip, IPv6Address):
+        sa = struct.pack("=H", socket.AF_INET6) + struct.pack(
+            ">H", port
+        ) + b"\0\0\0\0" + ip.packed + b"\0\0\0\0"
+    else:
+        sa = struct.pack("=H", socket.AF_INET) + struct.pack(">H", port) + ip.packed
+    return sa + bytes(128 - len(sa))
+
+
+def set_md5sig(sock: socket.socket, peer_addr, key: bytes, port: int = 0) -> None:
+    """Attach a TCP-MD5 (RFC 2385) key for ``peer_addr`` to the socket.
+
+    Layout: struct tcp_md5sig { sockaddr_storage addr; u8 flags;
+    u8 prefixlen; u16 keylen; int ifindex; u8 key[80]; }.
+    """
+    if len(key) > TCP_MD5SIG_MAXKEYLEN:
+        raise ValueError("TCP-MD5 key too long")
+    blob = (
+        _sockaddr_storage(peer_addr, port)
+        + struct.pack("=BBHi", 0, 0, len(key), 0)
+        + key.ljust(TCP_MD5SIG_MAXKEYLEN, b"\0")
+    )
+    sock.setsockopt(socket.IPPROTO_TCP, TCP_MD5SIG, blob)
+
+
+@dataclass
+class _PeerSlot:
+    peer_ip: object  # IPv4Address | IPv6Address
+    local_ip: object
+    ifname: str
+    md5_key: bytes | None = None
+    sock: socket.socket | None = None  # established connection
+    connecting: socket.socket | None = None
+    rxbuf: bytearray = field(default_factory=bytearray)
+    txbuf: bytearray = field(default_factory=bytearray)
+    active: bool = False  # we initiate (local > peer)
+
+
+class BgpTcpIo(NetIo):
+    """Per-instance BGP TCP session manager."""
+
+    def __init__(self, loop_, actor: str, port: int = BGP_PORT):
+        self.loop = loop_
+        self.actor = actor
+        self.port = port
+        self.peers: dict = {}  # peer ip -> _PeerSlot
+        self._listeners: dict[int, socket.socket] = {}  # fd -> socket
+        self._bound: set = set()  # local ips with a listener
+        self._by_fd: dict[int, _PeerSlot] = {}
+
+    # -- setup
+
+    def listen(self, local_ip) -> None:
+        """Bind a listening socket on ``local_ip`` (idempotent per address)."""
+        ip = ip_address(local_ip)
+        if ip in self._bound:
+            return
+        af = socket.AF_INET6 if isinstance(ip, IPv6Address) else socket.AF_INET
+        s = socket.socket(af, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((str(ip), self.port))
+            s.listen(8)
+            s.setblocking(False)
+        except OSError:
+            s.close()
+            raise
+        self._listeners[s.fileno()] = s
+        self._bound.add(ip)
+        for slot in self.peers.values():
+            if slot.md5_key and slot.local_ip == ip:
+                set_md5sig(s, slot.peer_ip, slot.md5_key)
+
+    def add_peer(self, local_ip, peer_ip, ifname: str = "tcp", md5_key=None):
+        lip, pip = ip_address(local_ip), ip_address(peer_ip)
+        slot = _PeerSlot(
+            peer_ip=pip,
+            local_ip=lip,
+            ifname=ifname,
+            md5_key=md5_key,
+            active=int(lip) > int(pip),
+        )
+        self.peers[pip] = slot
+        for ls in self._listeners.values():
+            if slot.md5_key:
+                try:
+                    set_md5sig(ls, pip, slot.md5_key)
+                except OSError as e:
+                    log.error("MD5 key install on listener failed: %s", e)
+        return slot
+
+    def remove_peer(self, peer_ip) -> None:
+        """Deconfigure: close any sockets and stop reconnecting."""
+        slot = self.peers.pop(ip_address(peer_ip), None)
+        if slot is None:
+            return
+        for s in (slot.sock, slot.connecting):
+            if s is not None:
+                self._by_fd.pop(s.fileno(), None)
+                s.close()
+        slot.sock = slot.connecting = None
+
+    def session_reset(self, peer_ip) -> None:
+        """FSM-initiated drop (hold timer, NOTIFICATION): close the
+        transport silently so a fresh connection can form.  Without this
+        a dead socket would block inbound accepts until TCP timeouts."""
+        slot = self.peers.get(ip_address(peer_ip))
+        if slot is None or slot.sock is None:
+            return
+        self._by_fd.pop(slot.sock.fileno(), None)
+        slot.sock.close()
+        slot.sock = None
+        slot.rxbuf.clear()
+        slot.txbuf.clear()
+
+    # -- NetIo
+
+    def send(self, ifname: str, src, dst, data: bytes) -> None:
+        slot = self.peers.get(ip_address(dst))
+        if slot is None or slot.sock is None:
+            return  # no session: the FSM's retry timer re-sends
+        slot.txbuf += data
+        self._flush(slot)
+
+    # -- polling integration
+
+    def fds(self) -> list[int]:
+        """Readable fds (listeners + sessions) for the daemon's poller."""
+        out = list(self._listeners)
+        for slot in self.peers.values():
+            if slot.sock is not None:
+                out.append(slot.sock.fileno())
+            if slot.connecting is not None:
+                out.append(slot.connecting.fileno())
+        return out
+
+    def wfds(self) -> list[int]:
+        """Writable-interest fds: in-progress connects + pending tx."""
+        out = []
+        for slot in self.peers.values():
+            if slot.connecting is not None:
+                out.append(slot.connecting.fileno())
+            elif slot.sock is not None and slot.txbuf:
+                out.append(slot.sock.fileno())
+        return out
+
+    def tick(self) -> None:
+        """Retry outbound connects for active peers without a session."""
+        for slot in self.peers.values():
+            if slot.active and slot.sock is None and slot.connecting is None:
+                self._connect(slot)
+
+    def pump(self, fd: int) -> int:
+        """Handle readiness on ``fd``; returns number of delivered msgs."""
+        if fd in self._listeners:
+            self._accept(self._listeners[fd])
+            return 0
+        slot = self._by_fd.get(fd)
+        if slot is None:
+            return 0
+        if slot.connecting is not None and slot.connecting.fileno() == fd:
+            self._finish_connect(slot)
+            return 0
+        return self._read(slot)
+
+    # -- internals
+
+    def _connect(self, slot: _PeerSlot) -> None:
+        af = (
+            socket.AF_INET6
+            if isinstance(slot.peer_ip, IPv6Address)
+            else socket.AF_INET
+        )
+        s = socket.socket(af, socket.SOCK_STREAM)
+        s.setblocking(False)
+        try:
+            s.bind((str(slot.local_ip), 0))
+            if slot.md5_key:
+                set_md5sig(s, slot.peer_ip, slot.md5_key)
+            rc = s.connect_ex((str(slot.peer_ip), self.port))
+            if rc not in (0, errno.EINPROGRESS):
+                s.close()
+                return
+        except OSError as e:
+            log.debug("connect to %s failed: %s", slot.peer_ip, e)
+            s.close()
+            return
+        slot.connecting = s
+        self._by_fd[s.fileno()] = slot
+
+    def _finish_connect(self, slot: _PeerSlot) -> None:
+        s = slot.connecting
+        err = s.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+        self._by_fd.pop(s.fileno(), None)
+        slot.connecting = None
+        if err != 0:
+            s.close()
+            return
+        self._adopt(slot, s)
+
+    def _accept(self, ls: socket.socket) -> None:
+        try:
+            s, addr = ls.accept()
+        except OSError:
+            return
+        pip = ip_address(addr[0].split("%")[0])
+        slot = self.peers.get(pip)
+        if slot is None or slot.sock is not None:
+            s.close()  # unknown peer, or session already up
+            return
+        s.setblocking(False)
+        self._adopt(slot, s)
+
+    def _adopt(self, slot: _PeerSlot, s: socket.socket) -> None:
+        slot.sock = s
+        slot.rxbuf.clear()
+        slot.txbuf.clear()
+        self._by_fd[s.fileno()] = slot
+        # Nudge the FSM: (re)send OPEN now that transport is up.
+        from holo_tpu.protocols.bgp import ConnectRetryMsg
+
+        self.loop.send(self.actor, ConnectRetryMsg(slot.peer_ip))
+
+    def _teardown(self, slot: _PeerSlot) -> None:
+        if slot.sock is not None:
+            self._by_fd.pop(slot.sock.fileno(), None)
+            slot.sock.close()
+            slot.sock = None
+        from holo_tpu.protocols.bgp import ConnectionDownMsg
+
+        self.loop.send(self.actor, ConnectionDownMsg(slot.peer_ip))
+
+    def _flush(self, slot: _PeerSlot) -> None:
+        while slot.txbuf:
+            try:
+                n = slot.sock.send(slot.txbuf)
+            except BlockingIOError:
+                return  # rest goes out on the next send/pump
+            except OSError:
+                self._teardown(slot)
+                return
+            del slot.txbuf[:n]
+
+    def _read(self, slot: _PeerSlot) -> int:
+        try:
+            data = slot.sock.recv(65536)
+        except BlockingIOError:
+            return 0
+        except OSError:
+            self._teardown(slot)
+            return 0
+        if not data:
+            self._teardown(slot)
+            return 0
+        slot.rxbuf += data
+        delivered = 0
+        while len(slot.rxbuf) >= 19:
+            length = int.from_bytes(slot.rxbuf[16:18], "big")
+            if length < 19 or length > MAX_MSG:
+                self._teardown(slot)  # framing is unrecoverable
+                return delivered
+            if len(slot.rxbuf) < length:
+                break
+            frame = bytes(slot.rxbuf[:length])
+            del slot.rxbuf[:length]
+            self.loop.send(
+                self.actor,
+                NetRxPacket(slot.ifname, slot.peer_ip, slot.local_ip, frame),
+            )
+            delivered += 1
+        if slot.txbuf:
+            self._flush(slot)
+        return delivered
+
+    def close(self) -> None:
+        for s in self._listeners.values():
+            s.close()
+        self._listeners.clear()
+        for slot in self.peers.values():
+            for s in (slot.sock, slot.connecting):
+                if s is not None:
+                    s.close()
+            slot.sock = slot.connecting = None
+        self._by_fd.clear()
+
+
+def pump_once(ios: list[BgpTcpIo], timeout_ms: int = 50) -> int:
+    """Poll all IO managers once; returns delivered message count."""
+    import select
+
+    rmap, wmap = {}, {}
+    for io in ios:
+        io.tick()
+        for fd in io.fds():
+            rmap[fd] = io
+        for fd in io.wfds():
+            wmap[fd] = io
+    if not rmap and not wmap:
+        return 0
+    r, w, _ = select.select(list(rmap), list(wmap), [], timeout_ms / 1000.0)
+    n = 0
+    for fd in set(r) | set(w):
+        io = rmap.get(fd) or wmap.get(fd)
+        if io is not None:
+            n += io.pump(fd)
+    return n

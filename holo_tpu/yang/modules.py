@@ -168,6 +168,9 @@ def _bgp_subtree():
         "bgp",
         _leaf("as", "uint32"),
         _leaf("router-id", "ip"),
+        # Real TCP sessions vs the in-memory test fabric.
+        _leaf("transport", "enum", enum=("fabric", "tcp"), default="fabric"),
+        _leaf("port", "uint16", default=179),
         L(
             "neighbor",
             "address",
@@ -177,6 +180,12 @@ def _bgp_subtree():
             _leaf("connect-retry-interval", "uint16", default=30),
             _leaf("import-policy"),
             _leaf("export-policy"),
+            _leaf("authentication-key"),  # TCP-MD5 (RFC 2385)
+        ),
+        L(
+            "network",
+            "prefix",
+            _leaf("prefix", "prefix"),  # locally originated route
         ),
     )
 
