@@ -190,7 +190,17 @@ def main(argv=None):
                 daemon.northbound.check_confirmed_timeout(time.time())
                 nd = daemon.loop.next_deadline()
                 now = daemon.loop.clock.now()
-            time.sleep(min(max(nd - now, 0.01), 0.2) if nd else 0.2)
+            wait = min(max(nd - now, 0.01), 0.2) if nd else 0.2
+            if tcp is not None:
+                # Block in select on the BGP fds (no state touched, so no
+                # lock needed) so inbound traffic is handled immediately
+                # instead of on the next 200 ms tick; the pump itself runs
+                # under the lock at the top of the loop.
+                from holo_tpu.utils.tcpio import wait_ready
+
+                wait_ready([tcp], int(wait * 1000))
+            else:
+                time.sleep(wait)
     except KeyboardInterrupt:
         daemon.stop()
 

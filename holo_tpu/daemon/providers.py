@@ -979,6 +979,15 @@ class RoutingProvider(Provider, Actor):
             addr = ip_address(n.get("address", addr_s))
             wanted_peers.add(addr)
             if addr in inst.peers:
+                if tcp_io is not None:
+                    # MD5 key rotation on a live neighbor re-keys the
+                    # listeners and resets the session.
+                    tcp_io.update_md5(
+                        addr,
+                        n["authentication-key"].encode()
+                        if n.get("authentication-key")
+                        else None,
+                    )
                 continue
             # Outgoing interface: longest-prefix interface subnet
             # containing the peer (single-hop eBGP/iBGP assumption).

@@ -71,6 +71,16 @@ class PathAttrs:
     # object describes a route of either family.
     nh6: IPv6Address | None = None
 
+    @staticmethod
+    def _attr(w: Writer, flags: int, atype: int, body: bytes) -> None:
+        """Emit one path attribute, using the extended-length form
+        (RFC 4271 §4.3 flag 0x10) whenever the body exceeds 255 bytes
+        (long AS_PATH prepends, large MP_REACH NLRI sets)."""
+        if len(body) > 255:
+            w.u8(flags | 0x10).u8(atype).u16(len(body)).bytes(body)
+        else:
+            w.u8(flags).u8(atype).u8(len(body)).bytes(body)
+
     def encode(
         self,
         w: Writer,
@@ -87,7 +97,7 @@ class PathAttrs:
             body.u8(2).u8(len(self.as_path))
             for asn in self.as_path:
                 body.u32(asn)
-        w.u8(0x40).u8(AttrType.AS_PATH).u8(len(body)).bytes(body.finish())
+        self._attr(w, 0x40, AttrType.AS_PATH, body.finish())
         if self.next_hop is not None:
             w.u8(0x40).u8(AttrType.NEXT_HOP).u8(4).ipv4(self.next_hop)
         if nlri6:
@@ -98,12 +108,12 @@ class PathAttrs:
             mp.u8(len(nh)).bytes(nh)
             mp.u8(0)  # reserved (SNPA count)
             _encode_prefixes(mp, nlri6)
-            w.u8(0x80).u8(AttrType.MP_REACH_NLRI).u8(len(mp)).bytes(mp.finish())
+            self._attr(w, 0x80, AttrType.MP_REACH_NLRI, mp.finish())
         if withdrawn6:
             mp = Writer()
             mp.u16(AFI_IPV6).u8(SAFI_UNICAST)
             _encode_prefixes(mp, withdrawn6)
-            w.u8(0x80).u8(AttrType.MP_UNREACH_NLRI).u8(len(mp)).bytes(mp.finish())
+            self._attr(w, 0x80, AttrType.MP_UNREACH_NLRI, mp.finish())
         if self.med is not None:
             w.u8(0x80).u8(AttrType.MED).u8(4).u32(self.med)
         if self.local_pref is not None:

@@ -150,6 +150,14 @@ class InstanceConfig:
     router_id: IPv4Address = IPv4Address("0.0.0.0")
     spf: SpfTimers = field(default_factory=SpfTimers)
     sr: object = None  # holo_tpu.utils.sr.SrConfig (None = SR disabled)
+    # RFC 3623 helper-mode capability (advertised in the RI LSA).
+    gr_helper_enabled: bool = True
+    # Interop knobs for replaying the reference's recorded exchanges
+    # (tools/stepwise.py): seed DD seqnos like the reference's
+    # 'deterministic' build, and override the §13(5a) arrival throttle
+    # (frozen-clock replays carry no timestamps).
+    deterministic_dd: bool = False
+    min_ls_arrival: float = MIN_LS_ARRIVAL
 
 
 @dataclass
@@ -308,7 +316,59 @@ class OspfInstance(Actor):
             # late-attached ones.
             for prefix in list(self.redistributed):
                 self._originate_external(prefix)
+        if new_area:
+            self._originate_router_info(area)
         return iface
+
+    def _originate_router_info(self, area: Area) -> None:
+        """RFC 7770 Router-Information opaque LSA (one per area).
+
+        Advertises the informational capabilities the instance actually
+        has: GR helper (gr.rs) and stub-router support (reference
+        holo-ospf originates the same pair at area start).
+        """
+        from holo_tpu.protocols.ospf.packet import (
+            RI_CAP_GR_HELPER,
+            RI_CAP_STUB_ROUTER,
+            LsaOpaque,
+            encode_router_info,
+            ri_lsid,
+        )
+
+        caps = RI_CAP_STUB_ROUTER
+        if self.config.gr_helper_enabled:
+            caps |= RI_CAP_GR_HELPER
+        opts = Options.O | (Options(0) if area.no_type5 else Options.E)
+        self._originate(
+            area,
+            LsaType.OPAQUE_AREA,
+            ri_lsid(),
+            LsaOpaque(data=encode_router_info(caps)),
+            options=opts,
+        )
+
+    def interface_address_add(self, ifname: str, prefix: IPv4Network) -> None:
+        """Secondary subnet on a live interface: advertise it as a stub
+        link (kernel address-add path, holo-interface ibus feed)."""
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if prefix == iface.prefix or prefix in iface.secondary:
+            return
+        iface.secondary.append(prefix)
+        if iface.state != IsmState.DOWN:
+            self._originate_router_lsa(area)
+
+    def interface_address_del(self, ifname: str, prefix: IPv4Network) -> None:
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if prefix in iface.secondary:
+            iface.secondary.remove(prefix)
+            if iface.state != IsmState.DOWN:
+                self._originate_router_lsa(area)
 
     def set_area_stub(self, area_id: IPv4Address, stub: bool) -> None:
         self.set_area_type(area_id, stub=stub)
@@ -434,6 +494,10 @@ class OspfInstance(Actor):
             return
         area, iface = ai
         if iface.state != IsmState.DOWN:
+            return
+        if iface.config.loopback:
+            iface.state = IsmState.LOOPBACK
+            self._originate_router_lsa(area)
             return
         if iface.config.if_type == IfType.POINT_TO_POINT:
             iface.state = IsmState.POINT_TO_POINT
@@ -1053,8 +1117,16 @@ class OspfInstance(Actor):
     # ----- DD exchange
 
     def _start_exstart(self, area: Area, iface: OspfInterface, nbr: Neighbor) -> None:
-        self._dd_seq += 1
-        nbr.dd_seq_no = self._dd_seq
+        if self.config.deterministic_dd:
+            # Interop with the reference's recorded exchanges: its
+            # 'deterministic' build seeds the DD sequence number from the
+            # neighbor's router-id (holo-ospf/src/neighbor.rs:171-178) and
+            # increments before the first DD, so recorded slave echoes only
+            # line up if we do the same.
+            nbr.dd_seq_no = int(nbr.router_id) + 1
+        else:
+            self._dd_seq += 1
+            nbr.dd_seq_no = self._dd_seq
         nbr.master = True  # assume master until negotiation says otherwise
         dd = DbDesc(
             mtu=iface.config.mtu,
@@ -1286,7 +1358,10 @@ class OspfInstance(Actor):
                 continue
             # §13 (5): newer than DB copy (or no copy).
             if cur is None or lsa.compare(cur.lsa) > 0:
-                if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
+                if (
+                    cur is not None
+                    and now - cur.rcvd_time < self.config.min_ls_arrival
+                ):
                     continue
                 # Self-originated received from elsewhere (§13.4): advance
                 # seqno and re-originate our copy.
@@ -1520,10 +1595,26 @@ class OspfInstance(Actor):
 
     def _originate_router_lsa(self, area: Area) -> None:
         links: list[RouterLink] = []
-        for iface in area.interfaces.values():
+        # Real interfaces first, loopback host routes last (matches the
+        # reference's router-LSA build order).
+        ifaces = sorted(
+            area.interfaces.values(), key=lambda i: i.config.loopback
+        )
+        for iface in ifaces:
             if iface.state == IsmState.DOWN or iface.prefix is None:
                 continue
             cost = iface.config.cost
+            if iface.config.loopback:
+                # Host route for the loopback address, zero cost.
+                links.append(
+                    RouterLink(
+                        RouterLinkType.STUB_NETWORK,
+                        iface.addr_ip,
+                        IPv4Address("255.255.255.255"),
+                        0,
+                    )
+                )
+                continue
             if iface.config.if_type == IfType.POINT_TO_POINT:
                 for nbr in iface.neighbors.values():
                     if self._nbr_counts_full(nbr):
@@ -1555,6 +1646,11 @@ class OspfInstance(Actor):
                                    iface.prefix.network_address,
                                    mask_of(iface.prefix), cost)
                     )
+            for extra in iface.secondary:
+                links.append(
+                    RouterLink(RouterLinkType.STUB_NETWORK,
+                               extra.network_address, mask_of(extra), cost)
+                )
         flags = RouterFlags(0)
         if self.is_abr:
             flags |= RouterFlags.B

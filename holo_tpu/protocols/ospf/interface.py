@@ -46,6 +46,10 @@ class IfConfig:
     rxmt_interval: int = 5
     priority: int = 1
     passive: bool = False
+    # Loopback interfaces advertise their host address as a zero-cost
+    # stub link and run no hello machinery (reference: holo-ospf treats
+    # kernel-loopback interfaces this way in the router-LSA build).
+    loopback: bool = False
     mtu: int = 1500
     bfd_enabled: bool = False
     auth: object = None  # AuthCtx (packet.py) or None
@@ -62,6 +66,9 @@ class OspfInterface:
     dr: IPv4Address = IPv4Address(0)  # DR *interface address* (v2, §9)
     bdr: IPv4Address = IPv4Address(0)
     neighbors: dict = field(default_factory=dict)  # nbr router-id -> Neighbor
+    # Additional subnets on the interface: advertised as stub links
+    # (reference advertises every interface address).
+    secondary: list = field(default_factory=list)  # [IPv4Network]
 
     def options(self) -> Options:
         return Options.E  # stub-area support sets E=0 per area config later

@@ -194,7 +194,36 @@ class LsaOpaque:
 
 
 GRACE_OPAQUE_TYPE = 3  # RFC 3623 Grace-LSA (opaque type 9.3)
+RI_OPAQUE_TYPE = 4  # RFC 7770 Router Information (opaque type 10.4)
 EXT_PREFIX_OPAQUE_TYPE = 7  # RFC 7684 Extended Prefix (opaque type 10.7)
+
+# RFC 7770 informational capability bits (bit 0 = MSB of the 32-bit field).
+RI_CAP_GR_CAPABLE = 0x80000000
+RI_CAP_GR_HELPER = 0x40000000
+RI_CAP_STUB_ROUTER = 0x20000000
+
+
+def ri_lsid() -> IPv4Address:
+    return IPv4Address(RI_OPAQUE_TYPE << 24)
+
+
+def encode_router_info(info_caps: int) -> bytes:
+    """RI LSA body: Informational Capabilities TLV (type 1, RFC 7770 §2.2)."""
+    w = Writer()
+    w.u16(1).u16(4).u32(info_caps & 0xFFFFFFFF)
+    return w.finish()
+
+
+def decode_router_info(data: bytes) -> int:
+    """Returns the informational capability bits (0 if TLV absent)."""
+    r = Reader(data)
+    while r.remaining() >= 4:
+        t = r.u16()
+        length = r.u16()
+        body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
+        if t == 1 and body.remaining() >= 4:
+            return body.u32()
+    return 0
 
 
 def ext_prefix_lsid(opaque_id: int) -> IPv4Address:

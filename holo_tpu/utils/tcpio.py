@@ -147,6 +147,20 @@ class BgpTcpIo(NetIo):
                 s.close()
         slot.sock = slot.connecting = None
 
+    def update_md5(self, peer_ip, key: bytes | None) -> None:
+        """Key rotation: re-key listeners, reset the session so the next
+        connection authenticates with the new key."""
+        slot = self.peers.get(ip_address(peer_ip))
+        if slot is None or slot.md5_key == key:
+            return
+        slot.md5_key = key
+        for ls in self._listeners.values():
+            try:
+                set_md5sig(ls, slot.peer_ip, key or b"")
+            except OSError as e:
+                log.error("MD5 re-key on listener failed: %s", e)
+        self.session_reset(peer_ip)
+
     def session_reset(self, peer_ip) -> None:
         """FSM-initiated drop (hold timer, NOTIFICATION): close the
         transport silently so a fresh connection can form.  Without this
@@ -208,6 +222,13 @@ class BgpTcpIo(NetIo):
         if slot.connecting is not None and slot.connecting.fileno() == fd:
             self._finish_connect(slot)
             return 0
+        # Write-readiness drains pending tx before the read attempt (the
+        # poller wakes us for either; recv simply raises EWOULDBLOCK when
+        # it was a write event).
+        if slot.txbuf and slot.sock is not None:
+            self._flush(slot)
+            if slot.sock is None:
+                return 0  # flush tore the session down
         return self._read(slot)
 
     # -- internals
@@ -240,7 +261,10 @@ class BgpTcpIo(NetIo):
         err = s.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
         self._by_fd.pop(s.fileno(), None)
         slot.connecting = None
-        if err != 0:
+        if err != 0 or slot.sock is not None:
+            # Failed, or an inbound connection was adopted while we were
+            # connecting (collision with a both-sides-active peer): keep
+            # the established one, never cross-wire two sockets.
             s.close()
             return
         self._adopt(slot, s)
@@ -329,6 +353,25 @@ class BgpTcpIo(NetIo):
                     s.close()
             slot.sock = slot.connecting = None
         self._by_fd.clear()
+
+
+def wait_ready(ios: list["BgpTcpIo"], timeout_ms: int) -> list[int]:
+    """Block in select on the managers' fds WITHOUT touching their state
+    (safe to call outside the daemon lock); returns ready fds."""
+    import select
+
+    rfds: list[int] = []
+    wfds: list[int] = []
+    for io in ios:
+        rfds += io.fds()
+        wfds += io.wfds()
+    if not rfds and not wfds:
+        import time as _t
+
+        _t.sleep(timeout_ms / 1000.0)
+        return []
+    r, w, _ = select.select(rfds, wfds, [], timeout_ms / 1000.0)
+    return list(set(r) | set(w))
 
 
 def pump_once(ios: list[BgpTcpIo], timeout_ms: int = 50) -> int:

@@ -1,0 +1,50 @@
+"""Stepwise conformance: the reference's per-step golden cases replayed
+through our live OSPFv2 instance (tools/stepwise.py).
+
+Every case brings ONE recorded router to convergence by replaying its
+events.jsonl through the real packet/FSM/flooding machinery, then applies
+the numbered step inputs and asserts the protocol-output plane (exact tx
+messages) and the local-rib state plane.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.stepwise import OSPFV2_DIR, case_map, run_all, run_case
+
+pytestmark = pytest.mark.skipif(
+    not OSPFV2_DIR.exists(), reason="reference corpus not present"
+)
+
+# Cases that must pass (regression lock).  The full sweep also enforces a
+# floor on total passes so newly-supported cases only ratchet UP.
+KNOWN_PASS = [
+    "ibus-addr-add1",
+    "ibus-addr-add2",
+    "packet-hello-validation1",
+    "packet-area-mismatch1",
+]
+PASS_FLOOR = 31
+
+
+def test_known_cases_pass():
+    cm = case_map()
+    for case in KNOWN_PASS:
+        status, detail = run_case(OSPFV2_DIR / case, *cm[case])
+        assert status == "pass", f"{case}: {detail}"
+
+
+@pytest.mark.skipif(
+    os.environ.get("HOLO_TPU_FULL_STEPWISE", "1") != "1",
+    reason="full sweep disabled",
+)
+def test_stepwise_sweep_floor():
+    res = run_all()
+    passed = sorted(c for c, (s, _) in res.items() if s == "pass")
+    failed = {c: d for c, (s, d) in res.items() if s == "fail"}
+    assert len(passed) >= PASS_FLOOR, (
+        f"only {len(passed)} stepwise cases pass (floor {PASS_FLOOR}); "
+        f"failures: { {c: d[:120] for c, d in list(failed.items())[:5]} }"
+    )
