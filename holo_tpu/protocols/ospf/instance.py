@@ -696,7 +696,9 @@ class OspfInstance(Actor):
             for area in self.areas.values():
                 self._originate_router_lsa(area)  # E flag
 
-    def _originate_external(self, prefix: IPv4Network) -> None:
+    def _originate_external(
+        self, prefix: IPv4Network, force: bool = False
+    ) -> None:
         from holo_tpu.protocols.ospf.packet import LsaAsExternal
         from holo_tpu.utils.ip import mask_of
 
@@ -715,10 +717,13 @@ class OspfInstance(Actor):
                 # §2.3 forbids translating our own).
                 opts = Options(0) if self.is_abr else Options.NP
                 self._originate(
-                    area, LsaType.NSSA_EXTERNAL, lsid, body, options=opts
+                    area, LsaType.NSSA_EXTERNAL, lsid, body,
+                    options=opts, force=force,
                 )
             elif not area.stub:  # §3.6: no type-5s in stub areas
-                self._originate(area, LsaType.AS_EXTERNAL, lsid, body)
+                self._originate(
+                    area, LsaType.AS_EXTERNAL, lsid, body, force=force
+                )
 
     def withdraw_redistributed(self, prefix: IPv4Network) -> None:
         if self.redistributed.pop(prefix, None) is None:
@@ -1178,7 +1183,17 @@ class OspfInstance(Actor):
     def _rx_db_desc(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
         dd: DbDesc = pkt.body
         nbr = iface.neighbors.get(pkt.router_id)
-        if nbr is None or nbr.state < NsmState.EX_START:
+        if nbr is None:
+            return
+        if nbr.state == NsmState.INIT:
+            # §10.6: a DD in Init proves the neighbor sees us — run
+            # 2-WayReceived and, if that starts the adjacency (ExStart),
+            # keep processing this same packet.
+            self._nbr_event(iface.name, pkt.router_id, NsmEvent.TWO_WAY_RECEIVED)
+            nbr = iface.neighbors.get(pkt.router_id)
+            if nbr is None:
+                return
+        if nbr.state < NsmState.EX_START:
             return
         if nbr.state == NsmState.EX_START:
             negotiated = False
@@ -1363,10 +1378,28 @@ class OspfInstance(Actor):
                     and now - cur.rcvd_time < self.config.min_ls_arrival
                 ):
                     continue
-                # Self-originated received from elsewhere (§13.4): advance
-                # seqno and re-originate our copy.
-                if lsa.adv_rtr == self.config.router_id and not lsa.is_maxage:
-                    self._refresh_self_lsa(area, lsa)
+                # Self-originated received from elsewhere (§13.4): flood
+                # the newer copy on as usual, then outpace or flush it
+                # (the reference does both, in that order — two floods on
+                # every adjacency).  Network LSAs are self-identified by
+                # the LSA-ID matching one of our interface addresses, NOT
+                # only by the advertising router (a pre-restart router-id
+                # change leaves stale copies under the old adv-rtr).
+                self_net_iface = (
+                    self._iface_by_addr(lsa.lsid)
+                    if lsa.type == LsaType.NETWORK
+                    else None
+                )
+                if (
+                    lsa.adv_rtr == self.config.router_id
+                    or self_net_iface is not None
+                ) and not lsa.is_maxage:
+                    prev_lsa = cur.lsa if cur is not None else None
+                    self._install_and_flood(
+                        area, lsa, from_iface=iface, from_nbr=nbr
+                    )
+                    acks.append(lsa)
+                    self._post_self_orig(area, lsa, prev_lsa, self_net_iface)
                     continue
                 self._install_and_flood(area, lsa, from_iface=iface, from_nbr=nbr)
                 acks.append(lsa)
@@ -1516,6 +1549,7 @@ class OspfInstance(Actor):
         allow_in_gr: bool = False,
         only_iface=None,
         options: Options = Options.E,
+        force: bool = False,
     ) -> None:
         if self.gr_restarting and not allow_in_gr:
             return  # RFC 3623 §2.2: no origination until resync completes
@@ -1532,7 +1566,8 @@ class OspfInstance(Actor):
         )
         lsa.encode()
         if (
-            old is not None
+            not force
+            and old is not None
             and old.lsa.raw[20:] == lsa.raw[20:]
             and old.lsa.options == options
         ):
@@ -1555,32 +1590,68 @@ class OspfInstance(Actor):
             lsa.raw = bytes(raw)
         self._install_and_flood(area, lsa, only_iface=only_iface)
 
-    def _refresh_self_lsa(self, area: Area, received: Lsa) -> None:
-        """§13.4: our LSA came back newer than our copy: outpace it."""
+    def _iface_by_addr(self, addr: IPv4Address):
+        for area in self.areas.values():
+            for iface in area.interfaces.values():
+                if iface.addr_ip == addr:
+                    return iface
+        return None
+
+    def _post_self_orig(
+        self, area: Area, received: Lsa, prev: Lsa | None, net_iface
+    ) -> None:
+        """§13.4 per-type disposition after flooding the received copy
+        (mirrors the reference's process_self_originated_lsa,
+        holo-ospf/src/ospfv2/lsdb.rs:975-1035)."""
         if self.gr_restarting:
-            # Adopt the pre-restart copy: helpers forward on it until we
-            # re-sync and re-originate (exit path in _nbr_event "full").
-            self._install_and_flood(area, received)
-            return
-        key = received.key
-        cur = area.lsdb.get(key)
-        if cur is None:
-            # We no longer originate it: flush the received copy.
-            received2 = received
-            self._install_and_flood(area, received2)
-            self._flush_self_lsa(area, key)
-            return
-        lsa = Lsa(
-            age=0,
-            options=cur.lsa.options,
-            type=cur.lsa.type,
-            lsid=cur.lsa.lsid,
-            adv_rtr=cur.lsa.adv_rtr,
-            seq_no=received.seq_no + 1,
-            body=cur.lsa.body,
-        )
-        lsa.encode()
-        self._install_and_flood(area, lsa)
+            return  # adopt the pre-restart copy until resync completes
+        t = received.type
+        if t == LsaType.ROUTER:
+            # Force: the received copy is already installed, so a content
+            # comparison would wrongly suppress the outpacing origination.
+            self._originate_router_lsa(area, force=True)
+        elif t == LsaType.NETWORK:
+            # Still DR for the network under the current router-id?
+            if (
+                net_iface is not None
+                and net_iface.is_dr()
+                and received.adv_rtr == self.config.router_id
+            ):
+                self._originate_network_lsa(area, net_iface, force=True)
+            else:
+                self._flush_self_lsa(area, received.key)
+        elif t in (LsaType.SUMMARY_NETWORK, LsaType.SUMMARY_ROUTER):
+            pass  # the next SPF run re-originates or flushes summaries
+        elif t in (LsaType.AS_EXTERNAL, LsaType.NSSA_EXTERNAL):
+            prefix = IPv4Network(
+                (int(received.lsid), bin(int(received.body.mask)).count("1")),
+                strict=False,
+            )
+            cur_lsid = self._external_lsids.get(prefix)
+            if prefix in self.redistributed:
+                self._originate_external(prefix, force=True)
+                if cur_lsid is not None and cur_lsid != received.lsid:
+                    # Appendix-E drift: the echo came back under a stale
+                    # link-state id; the fresh origination used the current
+                    # one, so the stale copy must not linger.
+                    self._flush_self_lsa(area, received.key)
+            else:
+                self._flush_self_lsa(area, received.key)
+        elif prev is not None:
+            # Opaque and friends: outpace with our previous content.
+            lsa = Lsa(
+                age=0,
+                options=prev.options,
+                type=prev.type,
+                lsid=prev.lsid,
+                adv_rtr=prev.adv_rtr,
+                seq_no=received.seq_no + 1,
+                body=prev.body,
+            )
+            lsa.encode()
+            self._install_and_flood(area, lsa)
+        else:
+            self._flush_self_lsa(area, received.key)
 
     def _nbr_counts_full(self, nbr: Neighbor) -> bool:
         """FULL, or in an open graceful-restart helper window — the helper
@@ -1593,7 +1664,7 @@ class OspfInstance(Actor):
             and self.loop.clock.now() < nbr.gr_deadline
         )
 
-    def _originate_router_lsa(self, area: Area) -> None:
+    def _originate_router_lsa(self, area: Area, force: bool = False) -> None:
         links: list[RouterLink] = []
         # Real interfaces first, loopback host routes last (matches the
         # reference's router-LSA build order).
@@ -1657,18 +1728,24 @@ class OspfInstance(Actor):
         if self.is_asbr:
             flags |= RouterFlags.E
         body = LsaRouter(flags=flags, links=links)
-        self._originate(area, LsaType.ROUTER, self.config.router_id, body)
+        self._originate(
+            area, LsaType.ROUTER, self.config.router_id, body, force=force
+        )
 
-    def _originate_network_lsa(self, area: Area, iface: OspfInterface) -> None:
+    def _originate_network_lsa(
+        self, area: Area, iface: OspfInterface, force: bool = False
+    ) -> None:
         key = LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id)
         full = [n.router_id for n in iface.neighbors.values()
                 if self._nbr_counts_full(n)]
         if iface.is_dr() and full and iface.prefix is not None:
             body = LsaNetwork(
                 mask=mask_of(iface.prefix),
-                attached=[self.config.router_id] + sorted(full, key=int),
+                attached=sorted([self.config.router_id] + full, key=int),
             )
-            self._originate(area, LsaType.NETWORK, iface.addr_ip, body)
+            self._originate(
+                area, LsaType.NETWORK, iface.addr_ip, body, force=force
+            )
         elif area.lsdb.get(key) is not None:
             self._flush_self_lsa(area, key)
 

@@ -295,12 +295,16 @@ def grace_lsa_lsid(opaque_id: int = 0) -> IPv4Address:
     return IPv4Address((GRACE_OPAQUE_TYPE << 24) | (opaque_id & 0xFFFFFF))
 
 
-def encode_grace_tlvs(grace_period: int, reason: int, addr: IPv4Address) -> bytes:
-    """RFC 3623 §B: grace period (1), restart reason (2), IP address (3)."""
+def encode_grace_tlvs(
+    grace_period: int, reason: int, addr: IPv4Address | None
+) -> bytes:
+    """RFC 3623 §B: grace period (1), restart reason (2), and — only when
+    present (it is optional on p2p links) — IP address (3)."""
     w = Writer()
     w.u16(1).u16(4).u32(grace_period)
     w.u16(2).u16(1).u8(reason).zeros(3)
-    w.u16(3).u16(4).ipv4(addr)
+    if addr is not None:
+        w.u16(3).u16(4).ipv4(addr)
     return w.finish()
 
 

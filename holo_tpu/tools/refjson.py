@@ -139,7 +139,7 @@ def lsa_body_from_json(body: dict):
             data=encode_grace_tlvs(
                 g.get("grace_period", 0),
                 g.get("gr_reason", 0),
-                _a(g.get("addr") or "0.0.0.0"),
+                _a(g["addr"]) if g.get("addr") else None,
             )
         )
     if kind == "OpaqueArea" and "RouterInfo" in b:

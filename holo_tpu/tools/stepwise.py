@@ -107,13 +107,34 @@ class CaseRun:
                 self.if_conf[iface["name"]] = iface
                 self.if_area[iface["name"]] = aid
         self.addrs: dict[str, list] = {}  # ifname -> [IPv4Interface]
-        self.iface_order: list[str] = []  # arena-id order (1-based)
         self.up: set[str] = set()
+        # Reference arena-id mapping (observed from the recordings):
+        # areas are keyed {"Id": n} with n = 1-based rank of the area-id
+        # in ascending order; interfaces are keyed per area, 1-based over
+        # the area's interfaces sorted by NAME (the reference's config
+        # trees iterate BTreeMap order — 'lo' naturally sorts last).
+        self.area_by_id = {
+            i + 1: aid for i, aid in enumerate(sorted(self.area_conf, key=int))
+        }
+        self.iface_by_id: dict[tuple, str] = {}
+        for aid, area in self.area_conf.items():
+            names = sorted(
+                i["name"]
+                for i in area.get("interfaces", {}).get("interface", [])
+            )
+            for n, name in enumerate(names, start=1):
+                self.iface_by_id[(aid, n)] = name
 
     # -- input application
 
     def _ensure_iface(self, ifname: str) -> None:
         if ifname in self.up or ifname not in self.if_conf:
+            return
+        if self._find_iface(ifname) is not None:
+            # Already created, currently down: bring it back up.
+            self.up.add(ifname)
+            self.loop.send(self.inst.name, IfUpMsg(ifname))
+            self.loop.run_until_idle()
             return
         addrs = self.addrs.get(ifname) or []
         if not addrs:
@@ -147,28 +168,41 @@ class CaseRun:
             stub_default_cost=area.get("default-cost", 1),
             nssa="nssa" in atype,
         )
-        if ifname not in self.iface_order:
-            self.iface_order.append(ifname)
         self.up.add(ifname)
         self.loop.send(self.inst.name, IfUpMsg(ifname))
         self.loop.run_until_idle()
 
-    def _iface_by_key(self, key) -> str | None:
+    def _iface_by_key(self, key, area_key=None) -> str | None:
         if isinstance(key, dict):
             if "Value" in key:
                 return key["Value"]
             if "Id" in key:
-                idx = key["Id"] - 1
-                if 0 <= idx < len(self.iface_order):
-                    return self.iface_order[idx]
+                aid = None
+                if isinstance(area_key, dict):
+                    if "Value" in area_key:
+                        aid = IPv4Address(area_key["Value"])
+                    elif "Id" in area_key:
+                        aid = self.area_by_id.get(area_key["Id"])
+                if aid is None and len(self.area_conf) == 1:
+                    aid = next(iter(self.area_conf))
+                return self.iface_by_id.get((aid, key["Id"]))
         return None
 
     def apply_ibus(self, ev: dict) -> None:
         if "InterfaceUpd" in ev:
             upd = ev["InterfaceUpd"]
             ifname = upd["ifname"]
-            if ifname in self.if_conf and ifname not in self.iface_order:
-                self.iface_order.append(ifname)
+            operative = "OPERATIVE" in (
+                upd["flags"] if upd.get("flags") is not None else "OPERATIVE"
+            )
+            if not operative:
+                if ifname in self.up:
+                    from holo_tpu.protocols.ospf.instance import IfDownMsg
+
+                    self.loop.send(self.inst.name, IfDownMsg(ifname))
+                    self.loop.run_until_idle()
+                    self.up.discard(ifname)
+                return
             self._ensure_iface(ifname)
             iface = self._find_iface(ifname)
             if iface is not None:
@@ -197,9 +231,20 @@ class CaseRun:
             lst = self.addrs.get(upd["ifname"]) or []
             if addr in lst:
                 lst.remove(addr)
-            if upd["ifname"] in self.up:
-                self.inst.interface_address_del(upd["ifname"], addr.network)
-                self.loop.run_until_idle()
+            ifname = upd["ifname"]
+            iface = self._find_iface(ifname)
+            if ifname in self.up and iface is not None:
+                if iface.addr_ip == addr.ip:
+                    # Primary address gone: the interface can no longer
+                    # run OSPF (the kernel path would withdraw it).
+                    from holo_tpu.protocols.ospf.instance import IfDownMsg
+
+                    self.loop.send(self.inst.name, IfDownMsg(ifname))
+                    self.loop.run_until_idle()
+                    self.up.discard(ifname)
+                else:
+                    self.inst.interface_address_del(ifname, addr.network)
+                    self.loop.run_until_idle()
         elif any(
             k in ev
             for k in (
@@ -233,9 +278,9 @@ class CaseRun:
             pkt_json = pkt_json.get("Ok", pkt_json)
             if not pkt_json or "Err" in rx.get("packet", {}):
                 return  # decode-error cases: nothing to feed
-            ifname = self._iface_by_key(rx.get("iface_key")) or rx.get(
-                "ifname"
-            )
+            ifname = self._iface_by_key(
+                rx.get("iface_key"), rx.get("area_key")
+            ) or rx.get("ifname")
             if ifname is None:
                 raise Unsupported("unmapped iface key")
             pkt = refjson.packet_from_json(pkt_json)
@@ -250,10 +295,25 @@ class CaseRun:
             if ev["SpfDelayEvent"].get("event") == "DelayTimer":
                 self.inst.run_spf()
                 self.loop.run_until_idle()
+        elif "NsmEvent" in ev and ev["NsmEvent"].get("event") == "InactivityTimer":
+            sub = ev["NsmEvent"]
+            ifname = self._iface_by_key(sub.get("iface_key"), sub.get("area_key"))
+            nbr_key = sub.get("nbr_key") or {}
+            if not ifname or "Value" not in nbr_key:
+                raise Unsupported("unmapped InactivityTimer keys")
+            from holo_tpu.protocols.ospf.instance import InactivityTimerMsg
+
+            self.loop.send(
+                self.inst.name,
+                InactivityTimerMsg(ifname, IPv4Address(nbr_key["Value"])),
+            )
+            self.loop.run_until_idle()
         elif "IsmEvent" in ev:
             sub = ev["IsmEvent"]
             if sub.get("event") == "WaitTimer":
-                ifname = self._iface_by_key(sub.get("iface_key"))
+                ifname = self._iface_by_key(
+                    sub.get("iface_key"), sub.get("area_key")
+                )
                 if ifname:
                     self.loop.send(self.inst.name, WaitTimerMsg(ifname))
                     self.loop.run_until_idle()
@@ -307,29 +367,70 @@ class CaseRun:
             j = refjson.packet_to_json(pkt)
             ours.append({"ifname": ifname, "dst": str(dst), "pkt": j})
         problems = []
-        unmatched = list(ours)
+        # LS Updates are compared as (ifname, lsa) ITEMS, not packets: the
+        # reference's debounced flood task coalesces/splits LSAs into
+        # packets on timing, which is not semantics.  Other packet types
+        # are compared whole.
+        want_items = []  # (ifname|None, hdr-subset, lsa-or-packet json)
+        got_items = []
         for exp in expected_lines:
             tx = exp.get("NetTxPacket")
             if tx is None:
                 problems.append(f"unsupported output {next(iter(exp))}")
                 continue
-            want = {"pkt": tx["packet"]}
-            if "ifname" in tx:
-                want["ifname"] = tx["ifname"]
-            hit = None
-            for i, got in enumerate(unmatched):
-                if refjson.subset_match(want["pkt"], got["pkt"]) and (
-                    "ifname" not in want or want["ifname"] == got["ifname"]
-                ):
-                    hit = i
-                    break
-            if hit is None:
-                problems.append(
-                    "expected tx not sent: "
-                    + json.dumps(tx)[:160]
-                )
+            pk = tx["packet"]
+            if "LsUpdate" in pk:
+                for lsa in pk["LsUpdate"]["lsas"]:
+                    want_items.append(
+                        (tx.get("ifname"), {"hdr": pk["LsUpdate"]["hdr"]}, lsa)
+                    )
             else:
-                unmatched.pop(hit)
+                want_items.append((tx.get("ifname"), None, pk))
+        for got in ours:
+            pk = got["pkt"]
+            if "LsUpdate" in pk:
+                for lsa in pk["LsUpdate"]["lsas"]:
+                    got_items.append(
+                        (got["ifname"], {"hdr": pk["LsUpdate"]["hdr"]}, lsa)
+                    )
+            else:
+                got_items.append((got["ifname"], None, pk))
+
+        def matches(w, g):
+            wif, whdr, wpk = w
+            gif, ghdr, gpk = g
+            if wif is not None and wif != gif:
+                return False
+            if (whdr is None) != (ghdr is None):
+                return False
+            if whdr is not None and not refjson.subset_match(whdr, ghdr):
+                return False
+            return refjson.subset_match(wpk, gpk)
+
+        # Bipartite match expected -> ours: greedy steals (an
+        # under-specified expected grabbing the item a later, more
+        # pinned-down expected needs) are undone by backtracking.
+        cand = [
+            [i for i, g in enumerate(got_items) if matches(w, g)]
+            for w in want_items
+        ]
+        assign: dict[int, int] = {}  # got index -> want index
+
+        def try_assign(w: int, seen: set) -> bool:
+            for i in cand[w]:
+                if i in seen:
+                    continue
+                seen.add(i)
+                if i not in assign or try_assign(assign[i], seen):
+                    assign[i] = w
+                    return True
+            return False
+
+        for w, item in enumerate(want_items):
+            if not try_assign(w, set()):
+                problems.append(
+                    "expected tx not sent: " + json.dumps(item[2])[:160]
+                )
         return problems
 
     def compare_state(self, state: dict) -> list[str]:
