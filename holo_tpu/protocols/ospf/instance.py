@@ -208,6 +208,7 @@ class OspfInstance(Actor):
         self._if_area: dict[str, IPv4Address] = {}
         self._timers: dict[tuple, object] = {}
         self._dd_seq = 0x1000  # deterministic DD seq seed
+        self.hostname: str | None = None  # RFC 5642, advertised in RI LSA
         # Cryptographic-auth sequence numbers must be strictly higher after
         # a restart than anything a neighbor saw before it, or every packet
         # is dropped as a replay until the dead interval expires.  The
@@ -338,14 +339,23 @@ class OspfInstance(Actor):
         caps = RI_CAP_STUB_ROUTER
         if self.config.gr_helper_enabled:
             caps |= RI_CAP_GR_HELPER
-        opts = Options.O | (Options(0) if area.no_type5 else Options.E)
+        opts = Options(0) if area.no_type5 else Options.E
         self._originate(
             area,
             LsaType.OPAQUE_AREA,
             ri_lsid(),
-            LsaOpaque(data=encode_router_info(caps)),
+            LsaOpaque(data=encode_router_info(caps, self.hostname)),
             options=opts,
         )
+
+    def set_hostname(self, hostname: str | None) -> None:
+        """RFC 5642 dynamic hostname: carried in the RI LSA, re-originated
+        on change (reference: HostnameChange -> lsa_orig_router_info)."""
+        if hostname == self.hostname:
+            return
+        self.hostname = hostname
+        for area in self.areas.values():
+            self._originate_router_info(area)
 
     def interface_address_add(self, ifname: str, prefix: IPv4Network) -> None:
         """Secondary subnet on a live interface: advertise it as a stub
@@ -514,6 +524,14 @@ class OspfInstance(Actor):
         if ai is None:
             return
         area, iface = ai
+        # Flush our network LSA while the interface can still flood it
+        # (the reference's down path floods the MaxAge copy on the dying
+        # segment too).
+        if iface.is_dr() and iface.addr_ip is not None:
+            self._flush_self_lsa(
+                area,
+                LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id),
+            )
         for nbr_id in list(iface.neighbors):
             self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
         iface.state = IsmState.DOWN
@@ -978,6 +996,7 @@ class OspfInstance(Actor):
         self.gr_restarting = False
         for a in self.areas.values():
             self._originate_router_lsa(a)
+            self._originate_router_info(a)  # hostname/caps changed during GR
         self._flush_grace_lsas()
 
     def _gr_resync_complete(self) -> bool:
@@ -1097,6 +1116,7 @@ class OspfInstance(Actor):
                         t.cancel()
                     for a in self.areas.values():
                         self._originate_router_lsa(a)
+                        self._originate_router_info(a)
                     self._flush_grace_lsas()
         if nbr.state == NsmState.DOWN:
             del iface.neighbors[nbr_id]
@@ -1589,6 +1609,27 @@ class OspfInstance(Actor):
             raw[0:2] = MAX_AGE.to_bytes(2, "big")
             lsa.raw = bytes(raw)
         self._install_and_flood(area, lsa, only_iface=only_iface)
+
+    def refresh_lsa(self, area_id: IPv4Address, key: LsaKey) -> None:
+        """LSRefreshTime: re-originate a self LSA with a fresh sequence
+        number (also driven by the age machinery in _age_tick)."""
+        area = self.areas.get(area_id)
+        if area is None:
+            return
+        e = area.lsdb.get(key)
+        if e is None or e.lsa.adv_rtr != self.config.router_id:
+            return
+        lsa = Lsa(
+            age=0,
+            options=e.lsa.options,
+            type=e.lsa.type,
+            lsid=e.lsa.lsid,
+            adv_rtr=e.lsa.adv_rtr,
+            seq_no=next_seq_no(e.lsa),
+            body=e.lsa.body,
+        )
+        lsa.encode()
+        self._install_and_flood(area, lsa)
 
     def _iface_by_addr(self, addr: IPv4Address):
         for area in self.areas.values():

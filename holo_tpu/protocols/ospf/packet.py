@@ -207,23 +207,34 @@ def ri_lsid() -> IPv4Address:
     return IPv4Address(RI_OPAQUE_TYPE << 24)
 
 
-def encode_router_info(info_caps: int) -> bytes:
-    """RI LSA body: Informational Capabilities TLV (type 1, RFC 7770 §2.2)."""
+def encode_router_info(info_caps: int, hostname: str | None = None) -> bytes:
+    """RI LSA body: Informational Capabilities TLV (type 1, RFC 7770 §2.2)
+    plus the Dynamic Hostname TLV (type 7, RFC 5642) when set."""
     w = Writer()
     w.u16(1).u16(4).u32(info_caps & 0xFFFFFFFF)
+    if hostname:
+        raw = hostname.encode()[:255]
+        w.u16(7).u16(len(raw)).bytes(raw)
+        w.zeros((4 - len(raw) % 4) % 4)
     return w.finish()
 
 
-def decode_router_info(data: bytes) -> int:
-    """Returns the informational capability bits (0 if TLV absent)."""
+def decode_router_info(data: bytes) -> dict:
+    """Returns {'info_caps': int, 'hostname': str|None}."""
     r = Reader(data)
+    out = {"info_caps": 0, "hostname": None}
     while r.remaining() >= 4:
         t = r.u16()
         length = r.u16()
         body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
         if t == 1 and body.remaining() >= 4:
-            return body.u32()
-    return 0
+            out["info_caps"] = body.u32()
+        elif t == 7 and body.remaining() >= length:
+            try:
+                out["hostname"] = body.bytes(length).decode()
+            except UnicodeDecodeError:
+                pass
+    return out
 
 
 def ext_prefix_lsid(opaque_id: int) -> IPv4Address:
@@ -555,7 +566,22 @@ class LsUpdate:
         n = r.u32()
         lsas = []
         for _ in range(n):
-            lsas.append(Lsa.decode(r))
+            start = r.pos
+            try:
+                lsas.append(Lsa.decode(r))
+            except DecodeError:
+                # §13 steps 2-3: an LSA of unknown type (or otherwise
+                # undecodable body) is discarded; the REST of the update
+                # is still processed.  Advance by the header's length
+                # field; if even that is unusable, the packet is
+                # unrecoverable.
+                r.pos = start
+                if r.remaining() < LSA_HDR_LEN:
+                    raise
+                length = int.from_bytes(r.data[start + 18 : start + 20], "big")
+                if length < LSA_HDR_LEN or r.remaining() < length:
+                    raise
+                r.pos = start + length
         return cls(lsas)
 
 

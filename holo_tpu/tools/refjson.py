@@ -145,9 +145,11 @@ def lsa_body_from_json(body: dict):
     if kind == "OpaqueArea" and "RouterInfo" in b:
         from holo_tpu.protocols.ospf.packet import encode_router_info
 
+        ri = b["RouterInfo"]
         return LsaOpaque(
             data=encode_router_info(
-                _flags_from_str(b["RouterInfo"].get("info_caps"), _RI_BITS)
+                _flags_from_str(ri.get("info_caps"), _RI_BITS),
+                (ri.get("info_hostname") or {}).get("hostname"),
             )
         )
     raise Unsupported(f"LSA body {kind}")
@@ -211,12 +213,19 @@ def lsa_body_to_json(lsa: Lsa):
     ):
         from holo_tpu.protocols.ospf.packet import decode_router_info
 
+        ri = decode_router_info(body.data)
         return {
             "OpaqueArea": {
                 "RouterInfo": {
-                    "info_caps": _flags_to_str(
-                        decode_router_info(body.data), _RI_BITS
-                    )
+                    "info_caps": _flags_to_str(ri["info_caps"], _RI_BITS),
+                    "info_hostname": (
+                        {"hostname": ri["hostname"]} if ri["hostname"] else None
+                    ),
+                    # TLVs we do not originate: present-but-empty in the
+                    # reference's serde output, so emit the same shape.
+                    "srgb": [],
+                    "srlb": [],
+                    "unknown_tlvs": [],
                 }
             }
         }
@@ -239,6 +248,38 @@ def lsa_from_json(obj: dict) -> Lsa:
     if "raw" in obj:
         return Lsa.decode(Reader(bytes(obj["raw"])))
     hdr = obj["hdr"]
+    body_json = obj.get("body")
+    if isinstance(body_json, dict) and "Unknown" in body_json:
+        # Unknown-type LSA (decode-robustness cases): synthesize the raw
+        # header bytes; our decoder discards it by the length field.
+        import struct
+
+        raw = (
+            struct.pack(
+                ">HBB", hdr.get("age", 0),
+                _flags_from_str(hdr.get("options"), _OPT_BITS),
+                hdr["lsa_type"],
+            )
+            + _a(hdr["lsa_id"]).packed
+            + _a(hdr["adv_rtr"]).packed
+            + struct.pack(
+                ">IHH", hdr.get("seq_no", 0x80000001) & 0xFFFFFFFF, 0,
+                hdr.get("length", 20),
+            )
+        )
+        # Keep the wire image self-consistent with the declared length so
+        # the decoder's skip-by-length lands on the next LSA boundary.
+        raw = raw.ljust(hdr.get("length", 20), b"\0")
+        return Lsa(
+            age=hdr.get("age", 0),
+            options=Options(0),
+            type=LsaType.ROUTER,  # placeholder; raw carries the real type
+            lsid=_a(hdr["lsa_id"]),
+            adv_rtr=_a(hdr["adv_rtr"]),
+            seq_no=_signed32(hdr.get("seq_no", 0x80000001)),
+            body=None,
+            raw=raw,
+        )
     lsa = Lsa(
         age=hdr.get("age", 0),
         options=Options(_flags_from_str(hdr.get("options"), _OPT_BITS)),

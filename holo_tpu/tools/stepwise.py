@@ -245,6 +245,9 @@ class CaseRun:
                 else:
                     self.inst.interface_address_del(ifname, addr.network)
                     self.loop.run_until_idle()
+        elif "HostnameUpdate" in ev:
+            self.inst.set_hostname(ev["HostnameUpdate"])
+            self.loop.run_until_idle()
         elif any(
             k in ev
             for k in (
@@ -253,7 +256,6 @@ class CaseRun:
                 "RouteRedistributeAdd",
                 "RouteRedistributeDel",
                 "RouterIdUpdate",
-                "HostnameUpdate",
                 "RouteIpAdd",
                 "RouteIpDel",
                 "RouteMplsAdd",
@@ -317,6 +319,52 @@ class CaseRun:
                 if ifname:
                     self.loop.send(self.inst.name, WaitTimerMsg(ifname))
                     self.loop.run_until_idle()
+        elif "LsaRefresh" in ev:
+            key = self._lse_key(ev["LsaRefresh"])
+            aid = self._lsdb_area(ev["LsaRefresh"])
+            if key is None or aid is None:
+                raise Unsupported("unmapped LsaRefresh key")
+            self.inst.refresh_lsa(aid, key)
+            self.loop.run_until_idle()
+        elif "LsaFlush" in ev and ev["LsaFlush"].get("reason") == "Expiry":
+            key = self._lse_key(ev["LsaFlush"])
+            aid = self._lsdb_area(ev["LsaFlush"])
+            if key is None or aid is None:
+                raise Unsupported("unmapped LsaFlush key")
+            area = self.inst.areas.get(aid)
+            if area is not None:
+                self.inst._flush_self_lsa(area, key)
+            self.loop.run_until_idle()
+        elif "GracePeriod" in ev:
+            sub = ev["GracePeriod"]
+            ifname = self._iface_by_key(
+                sub.get("iface_key"), sub.get("area_key")
+            )
+            nbr_key = sub.get("nbr_key") or {}
+            if not ifname or "Value" not in nbr_key:
+                raise Unsupported("unmapped GracePeriod keys")
+            from holo_tpu.protocols.ospf.neighbor import NsmEvent
+
+            iface = self._find_iface(ifname)
+            nbr_id = IPv4Address(nbr_key["Value"])
+            if iface is not None and nbr_id in iface.neighbors:
+                # Grace period timed out: the helper window closes and the
+                # pre-existing kill proceeds.
+                iface.neighbors[nbr_id].gr_deadline = None
+                self.inst._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+            self.loop.run_until_idle()
+        elif "RxmtInterval" in ev and "Value" in (
+            ev["RxmtInterval"].get("nbr_key") or {}
+        ):
+            sub = ev["RxmtInterval"]
+            ifname = self._iface_by_key(
+                sub.get("iface_key"), sub.get("area_key")
+            )
+            if ifname:
+                self.inst._rxmt(
+                    ifname, IPv4Address(sub["nbr_key"]["Value"])
+                )
+                self.loop.run_until_idle()
         elif any(
             k in ev
             for k in (
@@ -334,6 +382,29 @@ class CaseRun:
             pass  # internal plumbing our inline machinery covers
         else:
             raise Unsupported(f"protocol {next(iter(ev))}")
+
+    @staticmethod
+    def _lse_key(sub: dict):
+        from holo_tpu.protocols.ospf.packet import LsaKey, LsaType
+
+        val = (sub.get("lse_key") or {}).get("Value")
+        if not isinstance(val, dict):
+            return None
+        try:
+            return LsaKey(
+                LsaType(val["lsa_type"]),
+                IPv4Address(val["lsa_id"]),
+                IPv4Address(val["adv_rtr"]),
+            )
+        except (KeyError, ValueError):
+            return None
+
+    @staticmethod
+    def _lsdb_area(sub: dict):
+        lsdb = (sub.get("lsdb_key") or {}).get("Area")
+        if isinstance(lsdb, dict) and "Value" in lsdb:
+            return IPv4Address(lsdb["Value"])
+        return None
 
     def bring_up(self) -> None:
         for line in (self.rt_dir / "events.jsonl").read_text().splitlines():

@@ -26,7 +26,7 @@ KNOWN_PASS = [
     "packet-hello-validation1",
     "packet-area-mismatch1",
 ]
-PASS_FLOOR = 53
+PASS_FLOOR = 62
 
 
 def test_known_cases_pass():
