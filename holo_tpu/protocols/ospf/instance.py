@@ -150,6 +150,13 @@ class InstanceConfig:
     router_id: IPv4Address = IPv4Address("0.0.0.0")
     spf: SpfTimers = field(default_factory=SpfTimers)
     sr: object = None  # holo_tpu.utils.sr.SrConfig (None = SR disabled)
+    # Administrative distances for routes published to the RIB manager
+    # (ietf-ospf preference hierarchy: specific type > internal > all).
+    preference: int = 110
+    preference_intra: int | None = None
+    preference_inter: int | None = None
+    preference_internal: int | None = None
+    preference_external: int | None = None
     # RFC 3623 helper-mode capability (advertised in the RI LSA).
     gr_helper_enabled: bool = True
     # Interop knobs for replaying the reference's recorded exchanges
@@ -171,6 +178,13 @@ class Area:
     stub: bool = False
     nssa: bool = False
     stub_default_cost: int = 1
+    # Totally-stubby variant: ABRs inject only the default summary into
+    # the (stub/NSSA) area, no per-prefix type-3s (RFC 2328 §12.4.3.1).
+    summary: bool = True
+    # RFC 2328 area address ranges: [{prefix, advertise, cost}] — intra
+    # routes inside an active range are aggregated when summarized into
+    # other areas.
+    ranges: list = field(default_factory=list)
 
     @property
     def no_type5(self) -> bool:
@@ -209,6 +223,7 @@ class OspfInstance(Actor):
         self._timers: dict[tuple, object] = {}
         self._dd_seq = 0x1000  # deterministic DD seq seed
         self.hostname: str | None = None  # RFC 5642, advertised in RI LSA
+        self.node_tags: tuple[int, ...] = ()  # RFC 7777, RI LSA TLV 10
         # Cryptographic-auth sequence numbers must be strictly higher after
         # a restart than anything a neighbor saw before it, or every packet
         # is dropped as a replay until the dead interval expires.  The
@@ -344,9 +359,20 @@ class OspfInstance(Actor):
             area,
             LsaType.OPAQUE_AREA,
             ri_lsid(),
-            LsaOpaque(data=encode_router_info(caps, self.hostname)),
+            LsaOpaque(
+                data=encode_router_info(caps, self.hostname, self.node_tags)
+            ),
             options=opts,
         )
+
+    def set_node_tags(self, tags: tuple[int, ...]) -> None:
+        """RFC 7777 node administrative tags (RI LSA, re-originated on
+        change — reference NodeTagsChange event)."""
+        if tuple(tags) == self.node_tags:
+            return
+        self.node_tags = tuple(tags)
+        for area in self.areas.values():
+            self._originate_router_info(area)
 
     def set_hostname(self, hostname: str | None) -> None:
         """RFC 5642 dynamic hostname: carried in the RI LSA, re-originated
@@ -845,10 +871,12 @@ class OspfInstance(Actor):
                     dist = asbr_dist + lsa.body.metric
                 cur = best.get(prefix)
                 if cur is None or rank < cur[0]:
-                    best[prefix] = (rank, IntraRoute(prefix, dist, nhs, aid))
+                    best[prefix] = (
+                        rank, IntraRoute(prefix, dist, nhs, aid, "external")
+                    )
                 elif rank == cur[0]:
                     merged = IntraRoute(
-                        prefix, dist, cur[1].nexthops | nhs, aid
+                        prefix, dist, cur[1].nexthops | nhs, aid, "external"
                     )
                     best[prefix] = (rank, merged)
         return {p: r for p, (rank, r) in best.items()}
@@ -1573,6 +1601,8 @@ class OspfInstance(Actor):
     ) -> None:
         if self.gr_restarting and not allow_in_gr:
             return  # RFC 3623 §2.2: no origination until resync completes
+        if getattr(self, "_shutting_down", False):
+            return  # teardown in progress: nothing new goes out
         key = LsaKey(ltype, lsid, self.config.router_id)
         old = area.lsdb.get(key)
         lsa = Lsa(
@@ -1951,14 +1981,14 @@ class OspfInstance(Actor):
                 nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
                 cur = all_routes.get(prefix)
                 if cur is None or dist < cur.dist:
-                    route = IntraRoute(prefix, dist, nhs, area.area_id)
+                    route = IntraRoute(prefix, dist, nhs, area.area_id, "inter")
                     all_routes[prefix] = route
                     inter_routes[prefix] = route
                 elif dist == cur.dist:
                     # Equal-cost inter-area paths union their next hops
                     # (area_id reflects the latest contributing area).
                     route = IntraRoute(
-                        prefix, dist, cur.nexthops | nhs, area.area_id
+                        prefix, dist, cur.nexthops | nhs, area.area_id, "inter"
                     )
                     all_routes[prefix] = route
                     inter_routes[prefix] = route
@@ -2005,6 +2035,13 @@ class OspfInstance(Actor):
 
         self._finish_spf(all_routes)
 
+    def reoriginate_summaries(self) -> None:
+        """Config-triggered summary refresh (ranges / totally-stubby /
+        default-cost changed): re-run origination over the LAST SPF's
+        routing inputs without recomputing routes."""
+        if getattr(self, "_last_summary_inputs", None) is not None:
+            self._originate_summaries(*self._last_summary_inputs)
+
     def _originate_summaries(self, area_intra: dict, inter_routes: dict) -> None:
         """ABR summary generation: intra-area routes of each area go into
         every other attached area; inter-area routes learned via the
@@ -2012,16 +2049,48 @@ class OspfInstance(Actor):
         loop-free hierarchy, RFC 2328 §12.4.3)."""
         from holo_tpu.utils.ip import mask_of
 
+        self._last_summary_inputs = (area_intra, inter_routes)
         backbone = IPv4Address(0)
         wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
         for src_aid, routes in area_intra.items():
+            if src_aid not in self.areas:
+                continue  # area deleted since that SPF ran
+            # Area address ranges (§12.4.3 / Appendix C.2): components of
+            # an active advertised range aggregate into the range prefix
+            # at the max component distance (or its configured cost);
+            # advertise=false ranges black-hole their components.
+            src_ranges = self.areas[src_aid].ranges
+            eff: dict = {}
+            range_max: dict = {}
             for prefix, route in routes.items():
+                matches = [
+                    r for r in src_ranges if prefix.subnet_of(r["prefix"])
+                ]
+                # Most-specific range wins (Appendix C.2 semantics).
+                rng = max(
+                    matches,
+                    key=lambda r: r["prefix"].prefixlen,
+                    default=None,
+                )
+                if rng is None:
+                    eff[prefix] = route.dist
+                elif rng.get("advertise", True):
+                    cur = range_max.get(rng["prefix"], -1)
+                    range_max[rng["prefix"]] = max(cur, route.dist)
+            for r in src_ranges:
+                if r["prefix"] in range_max:
+                    eff[r["prefix"]] = (
+                        r["cost"]
+                        if r.get("cost") is not None
+                        else range_max[r["prefix"]]
+                    )
+            for prefix, dist in eff.items():
                 for dst_aid in self.areas:
                     if dst_aid == src_aid:
                         continue
                     cur = wanted[dst_aid].get(prefix)
-                    if cur is None or route.dist < cur:
-                        wanted[dst_aid][prefix] = route.dist
+                    if cur is None or dist < cur:
+                        wanted[dst_aid][prefix] = dist
         for prefix, route in inter_routes.items():
             if route.area_id != backbone:
                 continue
@@ -2036,6 +2105,9 @@ class OspfInstance(Actor):
         # translated back out).
         default = IPv4Network("0.0.0.0/0")
         for aid, area in self.areas.items():
+            if (area.stub or area.nssa) and not area.summary:
+                # Totally stubby: the default is the only summary.
+                wanted[aid].clear()
             if area.stub:
                 wanted[aid][default] = area.stub_default_cost
             elif area.nssa and default not in self.redistributed:
@@ -2088,6 +2160,9 @@ class OspfInstance(Actor):
                     LsaType.SUMMARY_NETWORK,
                     lsid_of[prefix],
                     LsaSummary(mask_of(prefix), dist),
+                    # Stub/NSSA areas clear the E option (no external
+                    # routing capability inside, RFC 2328 §12.1.2).
+                    options=Options(0) if area.no_type5 else Options.E,
                 )
 
     def _vlink_nexthops(self, backbone: Area, area_results: dict, now) -> dict:
@@ -2269,20 +2344,134 @@ class OspfInstance(Actor):
             prev = old.get(prefix)
             if prev is not None and prev.dist == route.dist and prev.nexthops == route.nexthops:
                 continue
+            if not route.nexthops:
+                # Local/connected destination (we sit on it): nothing to
+                # install — the RIB's DIRECT entries own these (reference
+                # route.rs skips nexthop-less routes the same way).
+                if prev is not None and prev.nexthops:
+                    self.ibus.request(
+                        self.routing_actor,
+                        RouteKeyMsg(Protocol.OSPFV2, prefix),
+                        sender=self.name,
+                    )
+                continue
             nhs = frozenset(
-                Nexthop(addr=nh.addr, ifname=nh.ifname) for nh in route.nexthops
+                Nexthop(
+                    addr=nh.addr,
+                    ifname=nh.ifname,
+                    ifindex=self._ifindex_of(nh.ifname),
+                )
+                for nh in route.nexthops
             )
             self.ibus.request(
                 self.routing_actor,
                 RouteMsg(
                     protocol=Protocol.OSPFV2,
                     prefix=prefix,
-                    distance=DEFAULT_DISTANCE[Protocol.OSPFV2],
+                    distance=self._route_distance(route),
                     metric=route.dist,
                     nexthops=nhs,
                 ),
                 sender=self.name,
             )
+
+    def _route_distance(self, route) -> int:
+        c = self.config
+        rtype = getattr(route, "rtype", "intra")
+        if rtype == "external":
+            return c.preference_external if c.preference_external is not None else c.preference
+        typed = c.preference_intra if rtype == "intra" else c.preference_inter
+        if typed is not None:
+            return typed
+        if c.preference_internal is not None:
+            return c.preference_internal
+        return c.preference
+
+    def _ifindex_of(self, ifname: str | None) -> int | None:
+        if ifname is None:
+            return None
+        ai = self._iface(ifname)
+        return ai[1].ifindex if ai else None
+
+    def set_preference(self, preference: int | None = None, **typed) -> None:
+        """Administrative-distance change: republish every route with the
+        new distances (the RIB re-ranks protocols on them).  ``typed``
+        accepts intra/inter/internal/external keyword overrides."""
+        changed = False
+        if preference is not None and preference != self.config.preference:
+            self.config.preference = preference
+            changed = True
+        for kind, val in typed.items():
+            attr = f"preference_{kind}"
+            if getattr(self.config, attr) != val:
+                setattr(self.config, attr, val)
+                changed = True
+        if changed and self.ibus is not None:
+            self._sync_rib({}, self.routes)
+
+    def shutdown_self(self) -> None:
+        """Disable path (and router-id change): flush every LSA we
+        originated and withdraw all routes (reference: instance teardown
+        floods MaxAge self-LSAs and uninstalls its RIB contribution)."""
+        # Flush while adjacencies can still flood the MaxAge copies; the
+        # shutdown guard stops the FULL->DOWN kill hooks from
+        # re-originating live LSAs behind the flush.
+        self._shutting_down = True
+        try:
+            for area in self.areas.values():
+                for key in list(area.lsdb.entries):
+                    if key.adv_rtr == self.config.router_id:
+                        self._flush_self_lsa(area, key)
+            for area in self.areas.values():
+                for iface in area.interfaces.values():
+                    for nbr_id in list(iface.neighbors):
+                        self._nbr_event(iface.name, nbr_id, NsmEvent.KILL_NBR)
+        finally:
+            self._shutting_down = False
+        old = self.routes
+        self.routes = {}
+        if self.route_cb is not None:
+            self.route_cb({})
+        if self.ibus is not None:
+            self._sync_rib(old, {})
+
+    def restart_with_router_id(self, router_id: IPv4Address) -> None:
+        """Router-id change requires a restart: flush the old identity's
+        LSAs, adopt the new id, let adjacencies re-form."""
+        if router_id == self.config.router_id:
+            return
+        self.shutdown_self()
+        self.config.router_id = router_id
+
+    def clear_neighbors(
+        self,
+        nbr_id: IPv4Address | None = None,
+        ifname: str | None = None,
+    ) -> None:
+        """ietf-ospf clear-neighbor RPC: tear down adjacencies (they
+        re-form from hellos), optionally scoped to one interface/neighbor."""
+        for area in self.areas.values():
+            for iface in area.interfaces.values():
+                if ifname is not None and iface.name != ifname:
+                    continue
+                for rid in list(iface.neighbors):
+                    if nbr_id is None or rid == nbr_id:
+                        self._nbr_event(iface.name, rid, NsmEvent.KILL_NBR)
+
+    def clear_database(self) -> None:
+        """ietf-ospf clear-database RPC: drop every LSA, re-originate our
+        own, and resync adjacencies from scratch."""
+        for area in self.areas.values():
+            for key in list(area.lsdb.entries):
+                area.lsdb.remove(key)
+            for iface in area.interfaces.values():
+                for rid in list(iface.neighbors):
+                    self._nbr_event(iface.name, rid, NsmEvent.KILL_NBR)
+            self._originate_router_lsa(area)
+            self._originate_router_info(area)
+        for prefix in list(self.redistributed):
+            self._originate_external(prefix)
+        self.reoriginate_summaries()
 
     # ----- rx/tx plumbing
 

@@ -207,22 +207,31 @@ def ri_lsid() -> IPv4Address:
     return IPv4Address(RI_OPAQUE_TYPE << 24)
 
 
-def encode_router_info(info_caps: int, hostname: str | None = None) -> bytes:
-    """RI LSA body: Informational Capabilities TLV (type 1, RFC 7770 §2.2)
-    plus the Dynamic Hostname TLV (type 7, RFC 5642) when set."""
+def encode_router_info(
+    info_caps: int,
+    hostname: str | None = None,
+    node_tags: tuple[int, ...] = (),
+) -> bytes:
+    """RI LSA body: Informational Capabilities TLV (type 1, RFC 7770
+    §2.2), Dynamic Hostname TLV (type 7, RFC 5642), and Node Admin Tag
+    TLV (type 10, RFC 7777) when set."""
     w = Writer()
     w.u16(1).u16(4).u32(info_caps & 0xFFFFFFFF)
     if hostname:
         raw = hostname.encode()[:255]
         w.u16(7).u16(len(raw)).bytes(raw)
         w.zeros((4 - len(raw) % 4) % 4)
+    if node_tags:
+        w.u16(10).u16(4 * len(node_tags))
+        for tag in node_tags:
+            w.u32(tag)
     return w.finish()
 
 
 def decode_router_info(data: bytes) -> dict:
-    """Returns {'info_caps': int, 'hostname': str|None}."""
+    """Returns {'info_caps': int, 'hostname': str|None, 'node_tags': tuple}."""
     r = Reader(data)
-    out = {"info_caps": 0, "hostname": None}
+    out = {"info_caps": 0, "hostname": None, "node_tags": ()}
     while r.remaining() >= 4:
         t = r.u16()
         length = r.u16()
@@ -234,6 +243,11 @@ def decode_router_info(data: bytes) -> dict:
                 out["hostname"] = body.bytes(length).decode()
             except UnicodeDecodeError:
                 pass
+        elif t == 10:
+            tags = []
+            while body.remaining() >= 4:
+                tags.append(body.u32())
+            out["node_tags"] = tuple(tags)
     return out
 
 

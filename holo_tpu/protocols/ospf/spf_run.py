@@ -271,6 +271,9 @@ class IntraRoute:
     dist: int
     nexthops: frozenset[RouteNexthop]
     area_id: IPv4Address
+    # "intra" | "inter" | "external" — drives per-type admin distance
+    # (ietf-ospf preference intra-area/inter-area/internal/external).
+    rtype: str = "intra"
 
 
 def atom_bits(words: np.ndarray, n_atoms: int) -> list[int]:

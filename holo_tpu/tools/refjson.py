@@ -146,10 +146,14 @@ def lsa_body_from_json(body: dict):
         from holo_tpu.protocols.ospf.packet import encode_router_info
 
         ri = b["RouterInfo"]
+        tags = tuple(
+            t for grp in (ri.get("node_tags") or []) for t in grp.get("tags", [])
+        )
         return LsaOpaque(
             data=encode_router_info(
                 _flags_from_str(ri.get("info_caps"), _RI_BITS),
                 (ri.get("info_hostname") or {}).get("hostname"),
+                tags,
             )
         )
     raise Unsupported(f"LSA body {kind}")
@@ -220,6 +224,11 @@ def lsa_body_to_json(lsa: Lsa):
                     "info_caps": _flags_to_str(ri["info_caps"], _RI_BITS),
                     "info_hostname": (
                         {"hostname": ri["hostname"]} if ri["hostname"] else None
+                    ),
+                    "node_tags": (
+                        [{"tags": list(ri["node_tags"])}]
+                        if ri["node_tags"]
+                        else []
                     ),
                     # TLVs we do not originate: present-but-empty in the
                     # reference's serde output, so emit the same shape.

@@ -93,7 +93,26 @@ class CaseRun:
         # copy of an LSA; the recording is the reference's own accepted
         # sequence, so arrival pacing is moot here.
         self.inst.config.min_ls_arrival = 0.0
+        self.inst.config.preference = ospf.get("preference", {}).get("all", 110)
         self.loop.register(self.inst)
+        # Capture the instance's real ibus route messages (the reference's
+        # output-ibus plane).
+        from holo_tpu.utils.ibus import Ibus
+
+        self.ibus_log: list = []
+        log = self.ibus_log
+
+        class _IbusCapture:
+            name = "rib-capture"
+
+            def attach(self, loop_):
+                pass
+
+            def handle(self, msg):
+                log.append(getattr(msg, "payload", msg))
+
+        self.loop.register(_IbusCapture())
+        self.inst.attach_ibus(Ibus(self.loop), routing_actor="rib-capture")
         # interface configs from the YANG config tree
         self.if_conf: dict[str, dict] = {}
         self.if_area: dict[str, IPv4Address] = {}
@@ -107,6 +126,7 @@ class CaseRun:
                 self.if_conf[iface["name"]] = iface
                 self.if_area[iface["name"]] = aid
         self.addrs: dict[str, list] = {}  # ifname -> [IPv4Interface]
+        self.ifindexes: dict[str, int] = {}  # ifname -> kernel ifindex
         self.up: set[str] = set()
         # Reference arena-id mapping (observed from the recordings):
         # areas are keyed {"Id": n} with n = 1-based rank of the area-id
@@ -150,6 +170,7 @@ class CaseRun:
             else IfType.BROADCAST
         )
         addr = addrs[0]
+        new_area = aid not in self.inst.areas
         self.inst.add_interface(
             ifname,
             IfConfig(
@@ -168,6 +189,13 @@ class CaseRun:
             stub_default_cost=area.get("default-cost", 1),
             nssa="nssa" in atype,
         )
+        got = self._find_iface(ifname)
+        if got is not None and ifname in self.ifindexes:
+            got.ifindex = self.ifindexes[ifname]
+        if new_area:
+            # Initial config snapshot applies at area creation only —
+            # later config-change mutations must not be clobbered.
+            self.inst.areas[aid].summary = area.get("summary", True)
         self.up.add(ifname)
         self.loop.send(self.inst.name, IfUpMsg(ifname))
         self.loop.run_until_idle()
@@ -203,6 +231,8 @@ class CaseRun:
                     self.loop.run_until_idle()
                     self.up.discard(ifname)
                 return
+            if upd.get("ifindex"):
+                self.ifindexes[ifname] = upd["ifindex"]
             self._ensure_iface(ifname)
             iface = self._find_iface(ifname)
             if iface is not None:
@@ -504,6 +534,323 @@ class CaseRun:
                 )
         return problems
 
+    def drain_ibus(self) -> list:
+        out = self.ibus_log[:]
+        self.ibus_log.clear()
+        return out
+
+    def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
+        """Compare expected RouteIpAdd/RouteIpDel against our captured
+        ibus route messages (converted to the reference JSON shape)."""
+        from holo_tpu.utils.southbound import RouteKeyMsg, RouteMsg
+
+        def canon(msg: dict) -> dict:
+            if "RouteIpAdd" in msg:
+                m = dict(msg["RouteIpAdd"])
+                m["nexthops"] = sorted(
+                    (n for n in m.get("nexthops", [])),
+                    key=lambda n: json.dumps(n, sort_keys=True),
+                )
+                return {"RouteIpAdd": m}
+            return msg
+
+        ours = []
+        for m in self.drain_ibus():
+            if isinstance(m, RouteMsg):
+                ours.append(
+                    canon(
+                        {
+                            "RouteIpAdd": {
+                                "protocol": "ospfv2",
+                                "prefix": str(m.prefix),
+                                "distance": m.distance,
+                                "metric": m.metric,
+                                "tag": m.tag,
+                                "nexthops": [
+                                    {
+                                        "Address": {
+                                            "ifindex": nh.ifindex,
+                                            "addr": str(nh.addr),
+                                            "labels": list(nh.labels),
+                                        }
+                                    }
+                                    for nh in m.nexthops
+                                ],
+                            }
+                        }
+                    )
+                )
+            elif isinstance(m, RouteKeyMsg):
+                ours.append(
+                    {
+                        "RouteIpDel": {
+                            "protocol": "ospfv2",
+                            "prefix": str(m.prefix),
+                        }
+                    }
+                )
+        problems = []
+        unmatched = list(ours)
+        for exp in expected_lines:
+            # Per-interface subscription bookkeeping has no analog in our
+            # topic-filter ibus; skip those expectations.
+            if any(k in exp for k in ("InterfaceSub", "InterfaceUnsub")):
+                continue
+            exp = canon(exp)
+            hit = next(
+                (
+                    i
+                    for i, got in enumerate(unmatched)
+                    if refjson.subset_match(exp, got)
+                ),
+                None,
+            )
+            if hit is None:
+                problems.append(
+                    "expected ibus msg not sent: " + json.dumps(exp)[:140]
+                )
+            else:
+                unmatched.pop(hit)
+        return problems
+
+    # -- northbound config-change / RPC inputs
+
+    def apply_rpc(self, rpc: dict) -> None:
+        if "ietf-ospf:clear-neighbor" in rpc:
+            self.inst.clear_neighbors(
+                ifname=rpc["ietf-ospf:clear-neighbor"].get("interface")
+            )
+        elif "ietf-ospf:clear-database" in rpc:
+            self.inst.clear_database()
+        else:
+            raise Unsupported(f"rpc {next(iter(rpc))}")
+        self.loop.run_until_idle()
+
+    def apply_config_change(self, tree: dict) -> None:
+        """Apply a recorded YANG config diff (yang:operation annotations).
+
+        Every annotation must be consumed by a handler; anything else
+        raises Unsupported so unmodeled config never fake-passes."""
+        proto = tree["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]
+        ospf = proto.get("ietf-ospf:ospf", {})
+        unhandled: list[str] = []
+
+        def op_of(node: dict, leaf: str | None = None):
+            ann = node.get("@" + leaf if leaf else "@") or {}
+            return ann.get("yang:operation")
+
+        if op_of(ospf, "enabled") == "delete":
+            raise Unsupported("enabled delete")
+        if op_of(ospf, "enabled") == "replace":
+            if ospf.get("enabled") is False:
+                self.inst.shutdown_self()
+            else:
+                for area in self.inst.areas.values():
+                    self.inst._originate_router_lsa(area, force=True)
+                    self.inst._originate_router_info(area)
+        if op_of(ospf, "explicit-router-id") == "replace":
+            self.inst.restart_with_router_id(
+                IPv4Address(ospf["explicit-router-id"])
+            )
+        pref = ospf.get("preference", {})
+        pref_kw = {}
+        pref_all = None
+        for leaf, kind in (
+            ("all", None),
+            ("intra-area", "intra"),
+            ("inter-area", "inter"),
+            ("internal", "internal"),
+            ("external", "external"),
+        ):
+            op = op_of(pref, leaf)
+            if op in ("replace", "create"):
+                if kind is None:
+                    pref_all = pref[leaf]
+                else:
+                    pref_kw[kind] = pref[leaf]
+            elif op == "delete":
+                raise Unsupported(f"preference {leaf} delete")
+        if pref_all is not None or pref_kw:
+            self.inst.set_preference(pref_all, **pref_kw)
+        gr = ospf.get("graceful-restart", {})
+        if op_of(gr, "helper-enabled") == "replace":
+            self.inst.config.gr_helper_enabled = bool(gr["helper-enabled"])
+            for area in self.inst.areas.values():
+                self.inst._originate_router_info(area)
+            # A helper-capability change is a topology-info change: open
+            # helper sessions exit (reference gr.rs topology-change path).
+            from holo_tpu.protocols.ospf.neighbor import NsmEvent
+
+            if not gr["helper-enabled"]:
+                for area in self.inst.areas.values():
+                    for iface in area.interfaces.values():
+                        for rid, nbr in list(iface.neighbors.items()):
+                            if nbr.gr_deadline is not None:
+                                nbr.gr_deadline = None
+                                self.inst._nbr_event(
+                                    iface.name, rid, NsmEvent.KILL_NBR
+                                )
+
+        for area_node in ospf.get("areas", {}).get("area", []):
+            aid = IPv4Address(area_node["area-id"])
+            area = self.inst.areas.get(aid)
+            if op_of(area_node) == "delete":
+                if area is not None:
+                    deleted_ifnames = list(area.interfaces)
+                    for ifname in deleted_ifnames:
+                        from holo_tpu.protocols.ospf.instance import IfDownMsg
+
+                        self.loop.send(self.inst.name, IfDownMsg(ifname))
+                        self.loop.run_until_idle()
+                        self.up.discard(ifname)
+                        del area.interfaces[ifname]
+                        self.inst._if_area.pop(ifname, None)
+                    for key in list(area.lsdb.entries):
+                        if key.adv_rtr == self.inst.config.router_id:
+                            self.inst._flush_self_lsa(area, key)
+                    del self.inst.areas[aid]
+                    # ABR status may change: refresh remaining router LSAs.
+                    for other in self.inst.areas.values():
+                        self.inst._originate_router_lsa(other)
+                    # Routes through the deleted area's interfaces are gone
+                    # immediately (the reference uninstalls them with the
+                    # area, before any SPF).
+                    dead_ifs = set(deleted_ifnames)
+                    old_routes = self.inst.routes
+                    kept = {
+                        p: r
+                        for p, r in old_routes.items()
+                        if getattr(r, "area_id", None) != aid
+                        and not any(
+                            nh.ifname in dead_ifs for nh in r.nexthops
+                        )
+                    }
+                    self.inst.routes = kept
+                    if self.inst.ibus is not None:
+                        self.inst._sync_rib(old_routes, kept)
+                continue
+            if area is None:
+                unhandled.append(f"area {aid} create")
+                continue
+            for leaf in ("default-cost", "summary"):
+                if op_of(area_node, leaf) == "delete":
+                    raise Unsupported(f"area {leaf} delete")
+            if op_of(area_node, "default-cost") in ("replace", "create"):
+                area.stub_default_cost = area_node["default-cost"]
+            if op_of(area_node, "summary") in ("replace", "create"):
+                area.summary = bool(area_node["summary"])
+            for rng in (area_node.get("ranges") or {}).get("range", []):
+                prefix = IPv4Network(rng["prefix"])
+                if op_of(rng) == "delete":
+                    area.ranges = [
+                        r for r in area.ranges if r["prefix"] != prefix
+                    ]
+                else:  # create / modify (merge over the existing entry)
+                    prev_rng = next(
+                        (r for r in area.ranges if r["prefix"] == prefix),
+                        {"advertise": True, "cost": None},
+                    )
+                    area.ranges = [
+                        r for r in area.ranges if r["prefix"] != prefix
+                    ] + [
+                        {
+                            "prefix": prefix,
+                            "advertise": rng.get(
+                                "advertise", prev_rng["advertise"]
+                            ),
+                            "cost": rng.get("cost", prev_rng["cost"]),
+                        }
+                    ]
+            for if_node in (area_node.get("interfaces") or {}).get(
+                "interface", []
+            ):
+                ifname = if_node["name"]
+                iface = self._find_iface(ifname)
+                if op_of(if_node) == "delete":
+                    from holo_tpu.protocols.ospf.instance import IfDownMsg
+
+                    self.loop.send(self.inst.name, IfDownMsg(ifname))
+                    self.loop.run_until_idle()
+                    self.up.discard(ifname)
+                    if iface is not None:
+                        area.interfaces.pop(ifname, None)
+                        self.inst._if_area.pop(ifname, None)
+                    self.if_conf.pop(ifname, None)
+                    # Stale routes keep their entry but lose next hops
+                    # through the deleted interface (unresolvable now).
+                    for route in self.inst.routes.values():
+                        route.nexthops = frozenset(
+                            nh for nh in route.nexthops
+                            if nh.ifname != ifname
+                        )
+                    continue
+                if op_of(if_node) == "create":
+                    self.if_conf[ifname] = if_node
+                    self.if_area[ifname] = aid
+                    self._ensure_iface(ifname)
+                    continue
+                if iface is None:
+                    unhandled.append(f"iface {ifname} modify (absent)")
+                    continue
+                if op_of(if_node, "cost") == "delete":
+                    raise Unsupported("iface cost delete")
+                if op_of(if_node, "cost") in ("replace", "create"):
+                    iface.config.cost = if_node["cost"]
+                    self.inst._originate_router_lsa(area)
+                for key in if_node:
+                    if key.startswith("@") and key not in ("@", "@cost"):
+                        unhandled.append(f"iface leaf {key[1:]}")
+            for key in area_node:
+                if key.startswith("@") and key not in (
+                    "@",
+                    "@default-cost",
+                    "@summary",
+                ):
+                    unhandled.append(f"area leaf {key[1:]}")
+        for key in ospf:
+            if key.startswith("@") and key not in (
+                "@",
+                "@enabled",
+                "@explicit-router-id",
+            ):
+                unhandled.append(f"ospf leaf {key[1:]}")
+        unhandled += [
+            f"graceful-restart {k}"
+            for k in gr
+            if k.startswith("@") and k != "@helper-enabled"
+        ]
+        node_tags = ospf.get("node-tags")
+        if node_tags is not None:
+            tags = []
+            ok = True
+            for t in node_tags.get("node-tag", []):
+                if op_of(t) in ("create", None, "replace"):
+                    tags.append(t["tag"])
+                elif op_of(t) == "delete":
+                    pass
+                else:
+                    ok = False
+            if ok:
+                self.inst.set_node_tags(tuple(tags))
+            else:
+                unhandled.append("node-tags")
+        pref_keys = [
+            k
+            for k in pref
+            if k.startswith("@")
+            and k
+            not in ("@all", "@intra-area", "@inter-area", "@internal", "@external")
+        ]
+        unhandled += [f"preference {k}" for k in pref_keys]
+        if unhandled:
+            raise Unsupported("; ".join(sorted(set(unhandled))[:4]))
+        # Summaries re-originate from the last SPF's inputs immediately;
+        # routes themselves wait for the (recorded) SPF delay timer.
+        self.inst.reoriginate_summaries()
+        self.loop.run_until_idle()
+
     def compare_state(self, state: dict) -> list[str]:
         """Compare the expected local-rib plane against our routes."""
         ospf = state["ietf-routing:routing"]["control-plane-protocols"][
@@ -559,6 +906,7 @@ def run_case(case_dir: Path, topo: str, rt: str):
     )
     problems = []
     for step in steps:
+        run.drain_ibus()  # only this step's ibus traffic is asserted
         try:
             for kind in ("ibus", "protocol"):
                 f = case_dir / f"{step}-input-{kind}.jsonl"
@@ -571,9 +919,12 @@ def run_case(case_dir: Path, topo: str, rt: str):
                             run.apply_ibus(ev)
                         else:
                             run.apply_protocol(ev)
-            for unsup in ("northbound-config-change.json", "northbound-rpc.json"):
-                if (case_dir / f"{step}-input-{unsup}").exists():
-                    raise Unsupported(unsup.split(".")[0])
+            f = case_dir / f"{step}-input-northbound-config-change.json"
+            if f.exists():
+                run.apply_config_change(json.loads(f.read_text()))
+            f = case_dir / f"{step}-input-northbound-rpc.json"
+            if f.exists():
+                run.apply_rpc(json.loads(f.read_text()))
         except Unsupported as e:
             return "skip", f"step {step}: {e}"
         out_proto = case_dir / f"{step}-output-protocol.jsonl"
@@ -589,6 +940,16 @@ def run_case(case_dir: Path, topo: str, rt: str):
             ]
         else:
             run.drain_tx()
+        out_ibus = case_dir / f"{step}-output-ibus.jsonl"
+        if out_ibus.exists():
+            expected = [
+                json.loads(l)
+                for l in out_ibus.read_text().splitlines()
+                if l.strip()
+            ]
+            problems += [
+                f"step {step}: {p}" for p in run.compare_ibus(expected)
+            ]
         out_state = case_dir / f"{step}-output-northbound-state.json"
         if out_state.exists():
             state = json.loads(out_state.read_text())

@@ -50,13 +50,16 @@ def test_config_commit_spawns_ospf_and_converges():
     state = d1.routing.get_state()
     nbrs = state["routing"]["ospfv2"]["neighbors"]
     assert nbrs.get("2.2.2.2", {}).get("state") == "full"
-    # Connected prefix: DIRECT (distance 0) wins in the RIB; the OSPF
-    # entry coexists beneath it.
+    # Connected prefix: DIRECT owns it; OSPF never installs its own
+    # nexthop-less local routes (reference route.rs skips them).
     rib = d1.routing.rib.active_routes()
     assert N("10.0.12.0/30") in rib
     assert rib[N("10.0.12.0/30")].protocol == Protocol.DIRECT
     entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
-    assert Protocol.OSPFV2 in entries
+    assert Protocol.OSPFV2 not in entries
+    # ...but the instance computed it (it is simply local, hence no install).
+    inst = d1.routing.instances["ospfv2"]
+    assert N("10.0.12.0/30") in inst.routes
 
 
 def test_static_routes_program_rib():
@@ -95,15 +98,16 @@ def test_ospf_disable_withdraws_routes():
     configure(d1, "1.1.1.1", "10.0.12.1/30")
     configure(d2, "2.2.2.2", "10.0.12.2/30")
     loop.advance(60)
-    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
-    assert Protocol.OSPFV2 in entries
+    assert N("10.0.12.0/30") in d1.routing.instances["ospfv2"].routes
     cand = d1.candidate()
     cand.set("routing/control-plane-protocols/ospfv2/enabled", "false")
     d1.commit(cand)
     assert "ospfv2" not in d1.routing.instances
-    # The OSPF contribution is withdrawn (the DIRECT route remains).
-    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
-    assert Protocol.OSPFV2 not in entries
+    # No OSPF contribution remains anywhere in the RIB.
+    assert all(
+        Protocol.OSPFV2 not in pr.entries
+        for pr in d1.routing.rib.routes.values()
+    )
 
 
 def test_tpu_backend_opt_in_convergence():
