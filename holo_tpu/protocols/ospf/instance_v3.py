@@ -24,6 +24,7 @@ from holo_tpu.ops.graph import INF, Topology
 from holo_tpu.protocols.ospf import packet_v3 as P
 from holo_tpu.protocols.ospf.interface import ElectionView, IfType, elect_dr_bdr
 from holo_tpu.protocols.ospf.lsdb import MIN_LS_ARRIVAL, Lsdb, next_seq_no
+from holo_tpu.protocols.ospf.spf_run import atom_bits
 from holo_tpu.protocols.ospf.neighbor import (
     Neighbor,
     NsmEvent,
@@ -50,6 +51,7 @@ class V3IfConfig:
     instance_id: int = 0
     if_type: IfType = IfType.POINT_TO_POINT
     priority: int = 1
+    auth: object = None  # packet_v3.AuthCtxV3 or None (RFC 7166 trailer)
 
 
 @dataclass
@@ -68,6 +70,8 @@ class V3Interface:
     # or a neighbor declares an existing DR/BDR (BackupSeen).
     wait_until: float = 0.0
     up_since: float = -1e9
+    # RFC 7166 replay protection: highest verified seqno per neighbor.
+    at_seqnos: dict = field(default_factory=dict)
 
     @property
     def is_lan(self) -> bool:
@@ -123,8 +127,25 @@ class V6Route:
     nexthops: frozenset  # {(ifname, link-local addr)}
 
 
+@dataclass
+class V3Area:
+    """One OSPFv3 area: its LSDB plus type flags (RFC 5340 areas carry
+    the same stub/NSSA semantics as v2, with v6 LSA types)."""
+
+    area_id: IPv4Address
+    lsdb: Lsdb = field(default_factory=Lsdb)
+    stub: bool = False
+    nssa: bool = False
+    stub_default_cost: int = 1
+
+    @property
+    def no_external(self) -> bool:
+        return self.stub or self.nssa
+
+
 class OspfV3Instance(Actor):
-    """One OSPFv3 routing process (single area, p2p)."""
+    """One OSPFv3 routing process: multi-area ABR (inter-area-prefix
+    LSAs), stub areas, externals, LAN + p2p circuits."""
 
     name = "ospfv3"
 
@@ -135,6 +156,7 @@ class OspfV3Instance(Actor):
         netio: NetIo,
         spf_backend: SpfBackend | None = None,
         route_cb=None,
+        nvstore=None,
     ):
         self.name = name
         self.router_id = router_id
@@ -142,13 +164,32 @@ class OspfV3Instance(Actor):
         self.backend = spf_backend or ScalarSpfBackend()
         self.route_cb = route_cb
         self.interfaces: dict[str, V3Interface] = {}
-        self.lsdb = Lsdb()
+        self.areas: dict[IPv4Address, V3Area] = {}
         self.routes: dict[IPv6Network, V6Route] = {}
+        # v6 prefixes we redistribute as AS-external LSAs (ASBR duty).
+        self.redistributed: dict[IPv6Network, int] = {}  # prefix -> metric
         self.spf_run_count = 0
         self._dd_seq = 0x3000
         self._next_iface_id = 1
         self._spf_pending = False
         self._timers: dict[tuple, object] = {}
+        self._inter_ids: dict = {}  # summarized prefix/asbr -> lsid
+        # RFC 7166 64-bit tx sequence number: restart-safe via a durable
+        # reservation ceiling (same scheme as the v2 instance).
+        self._nvstore = nvstore
+        self._at_key = f"ospfv3/{name}/at-seqno-ceiling"
+        self._at_reserved = 0
+        if nvstore is not None:
+            self._at_seqno = int(nvstore.get(self._at_key, 0))
+            self._reserve_at_seqnos()
+        else:
+            self._at_seqno = 0
+
+    _AT_WINDOW = 1 << 16
+
+    def _reserve_at_seqnos(self) -> None:
+        self._at_reserved = self._at_seqno + self._AT_WINDOW
+        self._nvstore.put(self._at_key, self._at_reserved)
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -162,7 +203,20 @@ class OspfV3Instance(Actor):
         cfg: V3IfConfig,
         link_local: IPv6Address,
         prefixes: list[IPv6Network],
+        stub: bool = False,
+        nssa: bool = False,
+        stub_default_cost: int = 1,
     ) -> V3Interface:
+        assert not (stub and nssa), "area cannot be both stub and NSSA"
+        area = self.areas.get(cfg.area_id)
+        if area is None:
+            area = V3Area(cfg.area_id, stub=stub, nssa=nssa,
+                          stub_default_cost=stub_default_cost)
+            self.areas[cfg.area_id] = area
+        else:
+            area.stub = stub
+            area.nssa = nssa
+            area.stub_default_cost = stub_default_cost
         iface = V3Interface(
             name=ifname,
             config=cfg,
@@ -173,6 +227,28 @@ class OspfV3Instance(Actor):
         self._next_iface_id += 1
         self.interfaces[ifname] = iface
         return iface
+
+    @property
+    def is_abr(self) -> bool:
+        return len(self.areas) > 1
+
+    @property
+    def lsdb(self) -> Lsdb:
+        """Single-area convenience view: the backbone (or only) area."""
+        backbone = self.areas.get(IPv4Address(0))
+        if backbone is not None:
+            return backbone.lsdb
+        return next(iter(self.areas.values())).lsdb
+
+    def _area_of(self, iface: V3Interface) -> V3Area:
+        return self.areas[iface.config.area_id]
+
+    def _area_ifaces(self, area: "V3Area"):
+        return (
+            i
+            for i in self.interfaces.values()
+            if i.config.area_id == area.area_id
+        )
 
     # -- actor
 
@@ -250,10 +326,13 @@ class OspfV3Instance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None or not iface.up:
             return
+        opts = P.Options.V6 | P.Options.R
+        if not self._area_of(iface).no_external:
+            opts |= P.Options.E
         hello = P.Hello(
             iface_id=iface.iface_id,
             priority=iface.config.priority,
-            options=P.Options.V6 | P.Options.E | P.Options.R,
+            options=opts,
             hello_interval=iface.config.hello_interval,
             dead_interval=iface.config.dead_interval,
             dr=iface.dr,
@@ -272,6 +351,11 @@ class OspfV3Instance(Actor):
             h.hello_interval != iface.config.hello_interval
             or h.dead_interval != iface.config.dead_interval
         ):
+            return
+        # §10.5 E-bit agreement: both sides must agree on the area's
+        # external capability (stub misconfig detection).
+        want_e = not self._area_of(iface).no_external
+        if bool(h.options & P.Options.E) != want_e:
             return
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None:
@@ -411,6 +495,7 @@ class OspfV3Instance(Actor):
                     t.cancel()
         if nbr.state == NsmState.DOWN:
             del iface.neighbors[nbr_id]
+            iface.at_seqnos.pop(nbr_id, None)
             if iface.is_lan:
                 self._run_dr_election(iface)
         if (old_state >= NsmState.FULL) != (nbr.state >= NsmState.FULL) or (
@@ -444,7 +529,7 @@ class OspfV3Instance(Actor):
         # lands with Link-LSA origination).
         nbr.dd_summary = [
             e.lsa
-            for e in self.lsdb.entries.values()
+            for e in self._area_of(iface).lsdb.entries.values()
             if e.current_age(now) < P.MAX_AGE
             and P.scope_of(int(e.lsa.type)) != "link"
         ]
@@ -500,7 +585,7 @@ class OspfV3Instance(Actor):
             if nbr is None or nbr.state != NsmState.EXCHANGE:
                 return
             nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
-            self._process_dd_headers(nbr, dd)
+            self._process_dd_headers(iface, nbr, dd)
             if nbr.master:
                 # Master always sends its first data DD — the slave can
                 # only conclude the exchange from a master DD with M clear.
@@ -533,7 +618,7 @@ class OspfV3Instance(Actor):
                 self._nbr_event(iface.name, pkt.router_id, NsmEvent.SEQ_NUMBER_MISMATCH)
                 return
             nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
-            self._process_dd_headers(nbr, dd)
+            self._process_dd_headers(iface, nbr, dd)
             nbr.dd_summary = nbr.dd_summary[len(nbr.dd_summary[:DD_CHUNK]) :]
             nbr.dd_seq_no += 1
             if not nbr.dd_summary and not (dd.flags & F.M):
@@ -542,7 +627,7 @@ class OspfV3Instance(Actor):
                 self._send_dd(iface, nbr)
         else:
             nbr.last_dd = (dd.flags, dd.options, dd.dd_seq_no)
-            self._process_dd_headers(nbr, dd)
+            self._process_dd_headers(iface, nbr, dd)
             self._slave_reply(iface, nbr, dd)
 
     def _slave_reply(self, iface: V3Interface, nbr: Neighbor, dd) -> None:
@@ -564,9 +649,10 @@ class OspfV3Instance(Actor):
         if not (dd.flags & P.DbDescFlags.M) and not (flags & P.DbDescFlags.M):
             self._nbr_event(iface.name, nbr.router_id, NsmEvent.EXCHANGE_DONE)
 
-    def _process_dd_headers(self, nbr: Neighbor, dd) -> None:
+    def _process_dd_headers(self, iface: V3Interface, nbr: Neighbor, dd) -> None:
+        lsdb = self._area_of(iface).lsdb
         for hdr in dd.lsa_headers:
-            cur = self.lsdb.get(hdr.key)
+            cur = lsdb.get(hdr.key)
             if cur is None or hdr.compare(cur.lsa) > 0:
                 nbr.ls_request[hdr.key] = hdr
 
@@ -583,8 +669,9 @@ class OspfV3Instance(Actor):
         if nbr is None or nbr.state < NsmState.EXCHANGE:
             return
         lsas = []
+        lsdb = self._area_of(iface).lsdb
         for key in pkt.body.entries:
-            e = self.lsdb.get(key)
+            e = lsdb.get(key)
             if e is None:
                 self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
                 return
@@ -605,9 +692,10 @@ class OspfV3Instance(Actor):
             return
         acks = []
         now = self.loop.clock.now()
+        area = self._area_of(iface)
         exchanging = self._any_nbr_exchanging()
         for lsa in pkt.body.lsas:
-            cur = self.lsdb.get(lsa.key)
+            cur = area.lsdb.get(lsa.key)
             # §13 (4): a MaxAge LSA with no database copy (and no
             # exchange in progress) is acked directly, never installed —
             # otherwise flushes ping-pong around multi-access links.
@@ -618,9 +706,11 @@ class OspfV3Instance(Actor):
                 if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
                     continue
                 if lsa.adv_rtr == self.router_id and not lsa.is_maxage:
-                    self._refresh_self_lsa(lsa)
+                    self._refresh_self_lsa(area, lsa)
                     continue
-                self._install_and_flood(lsa, from_iface=iface, from_nbr=nbr)
+                self._install_and_flood(
+                    area, lsa, from_iface=iface, from_nbr=nbr
+                )
                 acks.append(lsa)
             elif cur is not None and lsa.compare(cur.lsa) == 0:
                 if lsa.key in nbr.ls_rxmt:
@@ -649,13 +739,32 @@ class OspfV3Instance(Actor):
             if cur is not None and hdr.compare(cur) == 0:
                 del nbr.ls_rxmt[hdr.key]
 
-    def _install_and_flood(self, lsa, from_iface=None, from_nbr=None) -> None:
+    def _install_and_flood(
+        self, area: V3Area, lsa, from_iface=None, from_nbr=None
+    ) -> None:
         now = self.loop.clock.now()
-        _, changed = self.lsdb.install(lsa, now)
+        if P.scope_of(int(lsa.type)) == "as":
+            if area.no_external:
+                return  # stub/NSSA areas refuse AS-scope LSAs outright
+            # AS scope: one logical instance, installed + flooded through
+            # every non-stub area (stub/NSSA areas refuse externals).
+            for other in self.areas.values():
+                if other.no_external:
+                    continue
+                if other is not area:
+                    other.lsdb.install(lsa, now)
+        _, changed = area.lsdb.install(lsa, now)
         if changed:
             self._schedule_spf()
+        as_scope = P.scope_of(int(lsa.type)) == "as"
         for iface in self.interfaces.values():
             if not iface.up:
+                continue
+            iface_area = self._area_of(iface)
+            if as_scope:
+                if iface_area.no_external:
+                    continue
+            elif iface_area is not area:
                 continue
             # Link-scope LSAs only flood on their own link.
             if P.scope_of(int(lsa.type)) == "link" and iface is not from_iface:
@@ -681,7 +790,10 @@ class OspfV3Instance(Actor):
             if sent:
                 self._send(iface, ALL_SPF_RTRS_V6, P.LsUpdate([lsa]))
         if lsa.is_maxage:
-            self.lsdb.remove(lsa.key)
+            area.lsdb.remove(lsa.key)
+            if P.scope_of(int(lsa.type)) == "as":
+                for other in self.areas.values():
+                    other.lsdb.remove(lsa.key)
 
     def _arm_rxmt(self, iface: V3Interface, nbr: Neighbor) -> None:
         t = self._timer(
@@ -717,9 +829,11 @@ class OspfV3Instance(Actor):
 
     # -- origination
 
-    def _originate(self, ltype: P.LsaType, lsid: IPv4Address, body) -> None:
+    def _originate(
+        self, area: V3Area, ltype: P.LsaType, lsid: IPv4Address, body
+    ) -> None:
         key = P.LsaKey(ltype, lsid, self.router_id)
-        old = self.lsdb.get(key)
+        old = area.lsdb.get(key)
         lsa = P.Lsa(
             age=0,
             type=ltype,
@@ -731,15 +845,15 @@ class OspfV3Instance(Actor):
         lsa.encode()
         if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
             return
-        self._install_and_flood(lsa)
+        self._install_and_flood(area, lsa)
 
-    def _refresh_self_lsa(self, received) -> None:
-        cur = self.lsdb.get(received.key)
+    def _refresh_self_lsa(self, area: V3Area, received) -> None:
+        cur = area.lsdb.get(received.key)
         if cur is None:
             # A stale incarnation of ours we no longer originate: install
             # it so the flush has something to outrank, then flush it.
-            self._install_and_flood(received)
-            self._flush_self(received.key)
+            self._install_and_flood(area, received)
+            self._flush_self(area, received.key)
             return
         lsa = P.Lsa(
             age=0,
@@ -750,7 +864,7 @@ class OspfV3Instance(Actor):
             body=cur.lsa.body,
         )
         lsa.encode()
-        self._install_and_flood(lsa)
+        self._install_and_flood(area, lsa)
 
     def _transit_active(self, iface: V3Interface) -> bool:
         """A LAN contributes a transit link once a DR exists and we are
@@ -771,8 +885,17 @@ class OspfV3Instance(Actor):
         return dr.iface_id if dr is not None else 0
 
     def _originate_router_lsa(self) -> None:
+        for area in self.areas.values():
+            self._originate_router_lsa_area(area)
+
+    def _originate_router_lsa_area(self, area: V3Area) -> None:
         links = []
-        for iface in self.interfaces.values():
+        flags = P.RouterFlags(0)
+        if self.is_abr:
+            flags |= P.RouterFlags.B
+        if self.redistributed and not area.no_external:
+            flags |= P.RouterFlags.E
+        for iface in self._area_ifaces(area):
             if not iface.up:
                 continue
             if iface.is_lan:
@@ -800,11 +923,17 @@ class OspfV3Instance(Actor):
                             nbr.router_id,
                         )
                     )
-        self._originate(P.LsaType.ROUTER, IPv4Address(0), P.LsaRouterV3(links=links))
+        self._originate(
+            area,
+            P.LsaType.ROUTER,
+            IPv4Address(0),
+            P.LsaRouterV3(flags=flags, links=links),
+        )
 
     def _originate_network_lsa(self, iface: V3Interface) -> None:
         """DR duty: the network LSA (lsid = DR's interface id) lists all
         fully-adjacent members plus the DR itself (RFC 5340 §4.4.3.3)."""
+        area = self._area_of(iface)
         lsid = IPv4Address(iface.iface_id)
         key = P.LsaKey(P.LsaType.NETWORK, lsid, self.router_id)
         if (
@@ -818,13 +947,13 @@ class OspfV3Instance(Actor):
                 key=int,
             )
             self._originate(
-                P.LsaType.NETWORK, lsid, P.LsaNetworkV3(attached=attached)
+                area, P.LsaType.NETWORK, lsid, P.LsaNetworkV3(attached=attached)
             )
         else:
-            self._flush_self(key)
+            self._flush_self(area, key)
 
-    def _flush_self(self, key) -> None:
-        e = self.lsdb.get(key)
+    def _flush_self(self, area: V3Area, key) -> None:
+        e = area.lsdb.get(key)
         if e is None or e.lsa.is_maxage:
             return
         import copy
@@ -834,13 +963,17 @@ class OspfV3Instance(Actor):
         raw = bytearray(flush.raw)
         raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
         flush.raw = bytes(raw)
-        self._install_and_flood(flush)
+        self._install_and_flood(area, flush)
 
     def _originate_intra_area_prefix(self) -> None:
+        for area in self.areas.values():
+            self._originate_intra_area_prefix_area(area)
+
+    def _originate_intra_area_prefix_area(self, area: V3Area) -> None:
         # Router-referenced LSA: p2p prefixes plus LAN prefixes whose LAN
         # has no active network LSA yet (stub behavior, RFC 5340 §4.4.3.9).
         prefixes = []
-        for iface in self.interfaces.values():
+        for iface in self._area_ifaces(area):
             if iface.up and not self._transit_active(iface):
                 for p in iface.prefixes:
                     prefixes.append((p, iface.config.cost))
@@ -850,11 +983,11 @@ class OspfV3Instance(Actor):
             ref_adv_rtr=self.router_id,
             prefixes=prefixes,
         )
-        self._originate(P.LsaType.INTRA_AREA_PREFIX, IPv4Address(1), body)
+        self._originate(area, P.LsaType.INTRA_AREA_PREFIX, IPv4Address(1), body)
         # Network-referenced LSAs: the DR advertises each transit LAN's
         # prefixes against the network vertex (metric 0 — the path cost
         # to the network vertex already includes the link cost).
-        for iface in self.interfaces.values():
+        for iface in self._area_ifaces(area):
             lsid = IPv4Address(0x100 + iface.iface_id)
             if (
                 iface.up
@@ -863,6 +996,7 @@ class OspfV3Instance(Actor):
                 and self._transit_active(iface)
             ):
                 self._originate(
+                    area,
                     P.LsaType.INTRA_AREA_PREFIX,
                     lsid,
                     P.LsaIntraAreaPrefix(
@@ -874,28 +1008,30 @@ class OspfV3Instance(Actor):
                 )
             else:
                 self._flush_self(
-                    P.LsaKey(P.LsaType.INTRA_AREA_PREFIX, lsid, self.router_id)
+                    area,
+                    P.LsaKey(P.LsaType.INTRA_AREA_PREFIX, lsid, self.router_id),
                 )
 
     # -- aging
 
     def _age_tick(self) -> None:
         now = self.loop.clock.now()
-        for e in self.lsdb.refresh_due(now, self.router_id):
-            lsa = P.Lsa(
-                age=0,
-                type=e.lsa.type,
-                lsid=e.lsa.lsid,
-                adv_rtr=e.lsa.adv_rtr,
-                seq_no=next_seq_no(e.lsa),
-                body=e.lsa.body,
-            )
-            lsa.encode()
-            self._install_and_flood(lsa)
-        for key in self.lsdb.maxage_keys(now):
-            e = self.lsdb.get(key)
-            if e is not None:
-                self._install_and_flood(e.lsa)
+        for area in self.areas.values():
+            for e in area.lsdb.refresh_due(now, self.router_id):
+                lsa = P.Lsa(
+                    age=0,
+                    type=e.lsa.type,
+                    lsid=e.lsa.lsid,
+                    adv_rtr=e.lsa.adv_rtr,
+                    seq_no=next_seq_no(e.lsa),
+                    body=e.lsa.body,
+                )
+                lsa.encode()
+                self._install_and_flood(area, lsa)
+            for key in area.lsdb.maxage_keys(now):
+                e = area.lsdb.get(key)
+                if e is not None:
+                    self._install_and_flood(area, e.lsa)
         self._age_timer.start(AGE_TICK)
 
     # -- SPF
@@ -907,11 +1043,308 @@ class OspfV3Instance(Actor):
 
     def run_spf(self) -> None:
         self.spf_run_count += 1
+        area_results = {}
+        for area in self.areas.values():
+            out = self._area_spf(area)
+            if out is not None:
+                area_results[area.area_id] = out
+
+        routes: dict[IPv6Network, V6Route] = {}
+        intra_by_area: dict[IPv4Address, dict] = {}
+        # 1. intra-area routes (preferred over inter/external).
+        for aid, (index, keys, res, atoms, prefix_lsas) in area_results.items():
+            intra = {}
+            for adv, body in prefix_lsas:
+                if body.ref_type == int(P.LsaType.ROUTER):
+                    v = index.get(("R", body.ref_adv_rtr))
+                elif body.ref_type == int(P.LsaType.NETWORK):
+                    v = index.get(("N", body.ref_adv_rtr, int(body.ref_lsid)))
+                else:
+                    continue
+                if v is None or res.dist[v] >= INF:
+                    continue
+                nhs = frozenset(
+                    atoms[a]
+                    for a in atom_bits(res.nexthop_words[v], len(atoms))
+                )
+                for prefix, metric in body.prefixes:
+                    total = int(res.dist[v]) + metric
+                    cur = intra.get(prefix)
+                    if cur is None or total < cur.dist:
+                        intra[prefix] = V6Route(prefix, total, nhs)
+                    elif total == cur.dist:
+                        intra[prefix] = V6Route(
+                            prefix, total, cur.nexthops | nhs
+                        )
+            intra_by_area[aid] = intra
+            for prefix, route in intra.items():
+                cur = routes.get(prefix)
+                if cur is None or route.dist < cur.dist:
+                    routes[prefix] = route
+                elif route.dist == cur.dist:
+                    routes[prefix] = V6Route(
+                        prefix, route.dist, cur.nexthops | route.nexthops
+                    )
+
+        # 2. inter-area routes from received Inter-Area-Prefix LSAs:
+        #    distance = dist(advertising ABR in that area) + metric.
+        inter_routes: dict[IPv6Network, V6Route] = {}
+        for aid, (index, keys, res, atoms, _pl) in area_results.items():
+            area = self.areas[aid]
+            if self.is_abr and aid != IPv4Address(0):
+                # §16.2 hierarchy: an ABR examines summaries from the
+                # backbone only (non-ABRs use their single attached area).
+                continue
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != P.LsaType.INTER_AREA_PREFIX
+                    or lsa.adv_rtr == self.router_id
+                    or lsa.is_maxage
+                ):
+                    continue
+                abr_v = index.get(("R", lsa.adv_rtr))
+                if abr_v is None or res.dist[abr_v] >= INF:
+                    continue
+                prefix = lsa.body.prefix
+                if prefix in routes and prefix not in inter_routes:
+                    continue  # intra-area wins
+                dist = int(res.dist[abr_v]) + lsa.body.metric
+                nhs = frozenset(
+                    atoms[a]
+                    for a in atom_bits(res.nexthop_words[abr_v], len(atoms))
+                )
+                cur = inter_routes.get(prefix)
+                if cur is None or dist < cur.dist:
+                    inter_routes[prefix] = V6Route(prefix, dist, nhs)
+                elif dist == cur.dist:
+                    inter_routes[prefix] = V6Route(
+                        prefix, dist, cur.nexthops | nhs
+                    )
+        for prefix, route in inter_routes.items():
+            if prefix not in routes:
+                routes[prefix] = route
+
+        # 3. AS-external routes (lowest preference): RFC 5340 type 0x4005.
+        #    E2 ranks on the external metric, E1 on asbr-dist + metric.
+        ext_best: dict[IPv6Network, tuple] = {}
+        seen_ext = set()
+        for aid, (index, keys, res, atoms, _pl) in area_results.items():
+            area = self.areas[aid]
+            if area.no_external:
+                continue
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if lsa.type != P.LsaType.AS_EXTERNAL or lsa.is_maxage:
+                    continue
+                if lsa.adv_rtr == self.router_id:
+                    continue
+                if (lsa.key, aid) in seen_ext:
+                    continue
+                seen_ext.add((lsa.key, aid))
+                asbr_v = index.get(("R", lsa.adv_rtr))
+                if asbr_v is not None and res.dist[asbr_v] < INF:
+                    asbr_dist = int(res.dist[asbr_v])
+                    nhs = frozenset(
+                        atoms[a]
+                        for a in atom_bits(
+                            res.nexthop_words[asbr_v], len(atoms)
+                        )
+                    )
+                else:
+                    # ASBR outside this area: resolve through an ABR's
+                    # Inter-Area-Router LSA (RFC 5340 type 0x2004 — the
+                    # v3 analog of the v2 type-4 summary).
+                    resolved = self._asbr_via_inter_router(
+                        area, index, res, atoms, lsa.adv_rtr
+                    )
+                    if resolved is None:
+                        continue
+                    asbr_dist, nhs = resolved
+                prefix = lsa.body.prefix
+                if prefix in routes:
+                    continue  # intra/inter win
+                if lsa.body.e_bit:
+                    rank = (1, lsa.body.metric, asbr_dist)
+                    dist = lsa.body.metric
+                else:
+                    rank = (0, asbr_dist + lsa.body.metric, 0)
+                    dist = asbr_dist + lsa.body.metric
+                cur = ext_best.get(prefix)
+                if cur is None or rank < cur[0]:
+                    ext_best[prefix] = (rank, V6Route(prefix, dist, nhs))
+                elif rank == cur[0]:
+                    ext_best[prefix] = (
+                        rank,
+                        V6Route(prefix, dist, cur[1].nexthops | nhs),
+                    )
+        for prefix, (_rank, route) in ext_best.items():
+            routes[prefix] = route
+
+        # 4. ABR duties: inter-area-prefix origination (each area's intra
+        #    prefixes into every other area; default into stub areas).
+        if self.is_abr:
+            self._originate_inter_area(
+                intra_by_area, inter_routes, area_results
+            )
+
+        self.routes = routes
+        if self.route_cb is not None:
+            self.route_cb(routes)
+
+    def _originate_inter_area(
+        self, intra_by_area: dict, inter_routes: dict, area_results: dict
+    ) -> None:
+        backbone = IPv4Address(0)
+        wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
+        for src_aid, intra in intra_by_area.items():
+            for prefix, route in intra.items():
+                for dst_aid in self.areas:
+                    if dst_aid == src_aid:
+                        continue
+                    cur = wanted[dst_aid].get(prefix)
+                    if cur is None or route.dist < cur:
+                        wanted[dst_aid][prefix] = route.dist
+        # backbone-learned inter routes re-summarize into non-backbone
+        # areas (the v2 §12.4.3 hierarchy rule).
+        if backbone in self.areas:
+            for prefix, route in inter_routes.items():
+                for dst_aid in self.areas:
+                    if dst_aid == backbone:
+                        continue
+                    cur = wanted[dst_aid].get(prefix)
+                    if cur is None or route.dist < cur:
+                        wanted[dst_aid][prefix] = route.dist
+        default = IPv6Network("::/0")
+        for aid, area in self.areas.items():
+            if area.stub:
+                wanted[aid][default] = area.stub_default_cost
+        # ASBR reachability into other areas (Inter-Area-Router LSAs).
+        asbr_wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
+        for src_aid, (index, keys, res, atoms, _pl) in area_results.items():
+            src_area = self.areas.get(src_aid)
+            if src_area is None:
+                continue
+            for e in src_area.lsdb.all():
+                if e.lsa.type != P.LsaType.ROUTER or e.lsa.is_maxage:
+                    continue
+                if P.RouterFlags.E not in e.lsa.body.flags:
+                    continue
+                if e.lsa.adv_rtr == self.router_id:
+                    continue
+                v = index.get(("R", e.lsa.adv_rtr))
+                if v is None or res.dist[v] >= INF:
+                    continue
+                for dst_aid in self.areas:
+                    if dst_aid == src_aid or self.areas[dst_aid].no_external:
+                        continue
+                    cur = asbr_wanted[dst_aid].get(e.lsa.adv_rtr)
+                    if cur is None or int(res.dist[v]) < cur:
+                        asbr_wanted[dst_aid][e.lsa.adv_rtr] = int(res.dist[v])
+        for aid, asbrs in asbr_wanted.items():
+            area = self.areas[aid]
+            wanted_lsids = set()
+            for rid, dist in asbrs.items():
+                lsid = self._inter_lsid(("asbr", rid))
+                wanted_lsids.add(lsid)
+                self._originate(
+                    area,
+                    P.LsaType.INTER_AREA_ROUTER,
+                    lsid,
+                    P.LsaInterAreaRouter(metric=dist, dest_router_id=rid),
+                )
+            for key in list(area.lsdb.entries):
+                if (
+                    key.type == P.LsaType.INTER_AREA_ROUTER
+                    and key.adv_rtr == self.router_id
+                    and key.lsid not in wanted_lsids
+                ):
+                    if not area.lsdb.entries[key].lsa.is_maxage:
+                        self._flush_self(area, key)
+        for aid, prefixes in wanted.items():
+            area = self.areas[aid]
+            wanted_lsids = set()
+            for prefix, dist in prefixes.items():
+                lsid = self._inter_lsid(prefix)
+                wanted_lsids.add(lsid)
+                self._originate(
+                    area,
+                    P.LsaType.INTER_AREA_PREFIX,
+                    lsid,
+                    P.LsaInterAreaPrefix(metric=dist, prefix=prefix),
+                )
+            for key in list(area.lsdb.entries):
+                if (
+                    key.type == P.LsaType.INTER_AREA_PREFIX
+                    and key.adv_rtr == self.router_id
+                    and key.lsid not in wanted_lsids
+                ):
+                    if not area.lsdb.entries[key].lsa.is_maxage:
+                        self._flush_self(area, key)
+
+    def _asbr_via_inter_router(self, area, index, res, atoms, asbr_rid):
+        """(dist, nexthops) toward an out-of-area ASBR via the best ABR's
+        Inter-Area-Router LSA in this area, or None."""
+        best = None
+        for e in area.lsdb.all():
+            lsa = e.lsa
+            if (
+                lsa.type != P.LsaType.INTER_AREA_ROUTER
+                or lsa.is_maxage
+                or lsa.adv_rtr == self.router_id
+                or lsa.body.dest_router_id != asbr_rid
+            ):
+                continue
+            abr_v = index.get(("R", lsa.adv_rtr))
+            if abr_v is None or res.dist[abr_v] >= INF:
+                continue
+            dist = int(res.dist[abr_v]) + lsa.body.metric
+            nhs = frozenset(
+                atoms[a]
+                for a in atom_bits(res.nexthop_words[abr_v], len(atoms))
+            )
+            if best is None or dist < best[0]:
+                best = (dist, nhs)
+            elif dist == best[0]:
+                best = (dist, best[1] | nhs)
+        return best
+
+    def _inter_lsid(self, prefix) -> IPv4Address:
+        """v3 link-state ids are opaque; allocate one per summarized
+        prefix (stable across re-originations)."""
+        ids = self._inter_ids
+        lsid = ids.get(prefix)
+        if lsid is None:
+            lsid = IPv4Address(0x1000 + len(ids))
+            ids[prefix] = lsid
+        return lsid
+
+    def redistribute(self, prefix: IPv6Network, metric: int = 20) -> None:
+        """ASBR: inject a v6 external as an AS-external LSA (AS scope)."""
+        was_asbr = bool(self.redistributed)
+        self.redistributed[prefix] = metric
+        lsid = self._inter_lsid(prefix)
+        for area in self.areas.values():
+            if area.no_external:
+                continue
+            self._originate(
+                area,
+                P.LsaType.AS_EXTERNAL,
+                lsid,
+                P.LsaAsExternalV3(metric=metric, e_bit=True, prefix=prefix),
+            )
+            break  # AS scope: one origination floods everywhere eligible
+        if not was_asbr:
+            self._originate_router_lsa()
+
+    def _area_spf(self, area: V3Area):
+        """Per-area SPF: returns (index, keys, result, atoms, prefix_lsas)
+        or None when we have no router LSA in the area."""
         now = self.loop.clock.now()
         routers: dict[IPv4Address, P.LsaRouterV3] = {}
         networks: dict[tuple, P.LsaNetworkV3] = {}  # (adv, iface id)
-        prefix_lsas: list[P.LsaIntraAreaPrefix] = []
-        for e in self.lsdb.all():
+        prefix_lsas: list[tuple] = []  # (adv_rtr, body)
+        for e in area.lsdb.all():
             if e.current_age(now) >= P.MAX_AGE:
                 continue
             if e.lsa.type == P.LsaType.ROUTER:
@@ -919,9 +1352,9 @@ class OspfV3Instance(Actor):
             elif e.lsa.type == P.LsaType.NETWORK:
                 networks[(e.lsa.adv_rtr, int(e.lsa.lsid))] = e.lsa.body
             elif e.lsa.type == P.LsaType.INTRA_AREA_PREFIX:
-                prefix_lsas.append(e.lsa.body)
+                prefix_lsas.append((e.lsa.adv_rtr, e.lsa.body))
         if self.router_id not in routers:
-            return
+            return None
         # Vertex ordering contract: network vertices sort before routers
         # so zero-cost network->router edges settle first (shared engine
         # semantics — see the v2/IS-IS marshaling).
@@ -965,7 +1398,7 @@ class OspfV3Instance(Actor):
         atom_ids = np.full(topo.n_edges, -1, np.int32)
         nbr_hop = {}
         lan_iface_of = {}  # network vertex key -> our iface on that LAN
-        for iface in self.interfaces.values():
+        for iface in self._area_ifaces(area):
             for nbr in iface.neighbors.values():
                 if nbr.state == NsmState.FULL and not iface.is_lan:
                     nbr_hop[nbr.router_id] = (iface.name, nbr.src)
@@ -1007,33 +1440,7 @@ class OspfV3Instance(Actor):
         topo.touch()
 
         res = self.backend.compute(topo)
-        routes: dict[IPv6Network, V6Route] = {}
-        for body in prefix_lsas:
-            if body.ref_type == int(P.LsaType.ROUTER):
-                v = index.get(("R", body.ref_adv_rtr))
-            elif body.ref_type == int(P.LsaType.NETWORK):
-                v = index.get(
-                    ("N", body.ref_adv_rtr, int(body.ref_lsid))
-                )
-            else:
-                continue
-            if v is None or res.dist[v] >= INF:
-                continue
-            from holo_tpu.protocols.ospf.spf_run import atom_bits
-
-            nhs = frozenset(
-                atoms[a] for a in atom_bits(res.nexthop_words[v], len(atoms))
-            )
-            for prefix, metric in body.prefixes:
-                total = int(res.dist[v]) + metric
-                cur = routes.get(prefix)
-                if cur is None or total < cur.dist:
-                    routes[prefix] = V6Route(prefix, total, nhs)
-                elif total == cur.dist:
-                    routes[prefix] = V6Route(prefix, total, cur.nexthops | nhs)
-        self.routes = routes
-        if self.route_cb is not None:
-            self.route_cb(routes)
+        return index, keys, res, atoms, prefix_lsas
 
     # -- rx/tx
 
@@ -1042,11 +1449,20 @@ class OspfV3Instance(Actor):
         if iface is None or not iface.up:
             return
         try:
-            pkt = P.Packet.decode(msg.data, src=msg.src, dst=msg.dst)
+            pkt = P.Packet.decode(
+                msg.data, src=msg.src, dst=msg.dst, auth=iface.config.auth
+            )
         except Exception:
             return
         if pkt.router_id == self.router_id:
             return
+        if iface.config.auth is not None:
+            # RFC 7166 §4.1 replay protection: per-neighbor monotonic
+            # sequence numbers.
+            last = iface.at_seqnos.get(pkt.router_id, -1)
+            if pkt.auth_seqno <= last:
+                return
+            iface.at_seqnos[pkt.router_id] = pkt.auth_seqno
         # RFC 5340 §4.1.2: area and instance-id must match the interface.
         if (
             pkt.area_id != iface.config.area_id
@@ -1069,6 +1485,15 @@ class OspfV3Instance(Actor):
         pkt = P.Packet(router_id=self.router_id,
                        area_id=iface.config.area_id, body=body,
                        instance_id=iface.config.instance_id)
+        auth = iface.config.auth
+        if auth is not None:
+            self._at_seqno += 1
+            if self._nvstore is not None and self._at_seqno >= self._at_reserved:
+                self._reserve_at_seqnos()
+            auth.seqno = self._at_seqno
         self.netio.send(
-            iface.name, iface.link_local, dst, pkt.encode(iface.link_local, dst)
+            iface.name,
+            iface.link_local,
+            dst,
+            pkt.encode(iface.link_local, dst, auth=auth),
         )

@@ -189,6 +189,28 @@ class LsaInterAreaPrefix:
 
 
 @dataclass
+class LsaInterAreaRouter:
+    """RFC 5340 §A.4.6: ABR-advertised reachability to an ASBR."""
+
+    options: Options = Options.V6 | Options.E | Options.R
+    metric: int = 0
+    dest_router_id: IPv4Address = IPv4Address(0)
+
+    def encode(self, w: Writer) -> None:
+        w.u8(0).u24(int(self.options))
+        w.u32(self.metric & 0xFFFFFF)
+        w.ipv4(self.dest_router_id)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LsaInterAreaRouter":
+        r.u8()
+        options = Options(r.u24())
+        metric = r.u32() & 0xFFFFFF
+        dest = r.ipv4()
+        return cls(options, metric, dest)
+
+
+@dataclass
 class LsaLink:
     priority: int = 1
     options: Options = Options.V6 | Options.E | Options.R
@@ -278,7 +300,7 @@ _BODY_CODECS = {
     LsaType.ROUTER: LsaRouterV3,
     LsaType.NETWORK: LsaNetworkV3,
     LsaType.INTER_AREA_PREFIX: LsaInterAreaPrefix,
-    LsaType.INTER_AREA_ROUTER: LsaRawBody,
+    LsaType.INTER_AREA_ROUTER: LsaInterAreaRouter,
     LsaType.LINK: LsaLink,
     LsaType.INTRA_AREA_PREFIX: LsaIntraAreaPrefix,
     LsaType.AS_EXTERNAL: LsaAsExternalV3,
@@ -545,14 +567,26 @@ def _cksum16(data: bytes) -> int:
 
 @dataclass
 class Packet:
-    """OSPFv3 packet: 16-byte header; checksum over IPv6 pseudo-header."""
+    """OSPFv3 packet: 16-byte header; checksum over IPv6 pseudo-header.
+
+    Authentication: RFC 7166 authentication trailer (HMAC family).  With
+    an :class:`AuthCtxV3`, ``encode`` appends the trailer (SA id, 64-bit
+    sequence number, HMAC digest over header+body+trailer-preamble) and
+    ``decode`` requires and verifies it.  Reference:
+    holo-ospf/src/packet/auth.rs applied to the v3 trailer."""
 
     router_id: IPv4Address
     area_id: IPv4Address
     body: object
     instance_id: int = 0
+    auth_seqno: int = 0  # from a verified trailer on decode
 
-    def encode(self, src: IPv6Address | None = None, dst: IPv6Address | None = None) -> bytes:
+    def encode(
+        self,
+        src: IPv6Address | None = None,
+        dst: IPv6Address | None = None,
+        auth: "AuthCtxV3 | None" = None,
+    ) -> bytes:
         w = Writer()
         w.u8(OSPF_VERSION).u8(int(self.body.TYPE)).u16(0)
         w.ipv4(self.router_id).ipv4(self.area_id)
@@ -563,10 +597,19 @@ class Packet:
         if src is not None and dst is not None:
             cks = _cksum16(_pseudo_header(src, dst, len(w)) + bytes(w.buf))
             w.patch_u16(12, cks)
-        return w.finish()
+        pkt = w.finish()
+        if auth is None:
+            return pkt
+        return pkt + auth.trailer(pkt)
 
     @classmethod
-    def decode(cls, data: bytes, src: IPv6Address | None = None, dst: IPv6Address | None = None) -> "Packet":
+    def decode(
+        cls,
+        data: bytes,
+        src: IPv6Address | None = None,
+        dst: IPv6Address | None = None,
+        auth: "AuthCtxV3 | None" = None,
+    ) -> "Packet":
         r = Reader(data)
         if r.remaining() < PKT_HDR_LEN:
             raise DecodeError("short packet")
@@ -590,5 +633,56 @@ class Packet:
             # that cannot reconstruct the pseudo-header pass src/dst=None.
             if _cksum16(_pseudo_header(src, dst, length) + data[:length]) != 0:
                 raise DecodeError("packet checksum mismatch")
+        seqno = 0
+        if auth is not None:
+            seqno = auth.verify(data[:length], data[length:])
         body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))
-        return cls(router_id, area_id, body, instance_id)
+        return cls(router_id, area_id, body, instance_id, auth_seqno=seqno)
+
+
+_AT_HMACS = {"sha256": ("sha256", 32), "sha384": ("sha384", 48), "sha1": ("sha1", 20)}
+AT_TYPE_HMAC = 1  # RFC 7166 §2.1 authentication type
+
+
+@dataclass
+class AuthCtxV3:
+    """RFC 7166 authentication-trailer context (HMAC family)."""
+
+    key: bytes
+    sa_id: int = 1
+    algo: str = "sha256"
+    seqno: int = 0  # 64-bit, monotonic per sender
+
+    def _digest(self, pkt: bytes, preamble: bytes) -> bytes:
+        import hashlib
+        import hmac as _hmac
+
+        name, _dlen = _AT_HMACS[self.algo]
+        return _hmac.new(self.key, pkt + preamble, getattr(hashlib, name)).digest()
+
+    def trailer(self, pkt: bytes) -> bytes:
+        name, dlen = _AT_HMACS[self.algo]
+        pre = struct.pack(
+            ">HHHHQ", AT_TYPE_HMAC, 16 + dlen, 0, self.sa_id, self.seqno
+        )
+        return pre + self._digest(pkt, pre)
+
+    def verify(self, pkt: bytes, trailer: bytes) -> int:
+        """Returns the trailer's sequence number; raises on any failure
+        (missing trailer, wrong SA, bad digest)."""
+        import hmac as _hmac
+
+        name, dlen = _AT_HMACS[self.algo]
+        if len(trailer) < 16 + dlen:
+            raise DecodeError("authentication trailer missing/short")
+        at_type, at_len, _res, sa_id, seqno = struct.unpack(
+            ">HHHHQ", trailer[:16]
+        )
+        if at_type != AT_TYPE_HMAC or at_len != 16 + dlen:
+            raise DecodeError("bad authentication trailer parameters")
+        if sa_id != self.sa_id:
+            raise DecodeError("unknown authentication SA")
+        want = self._digest(pkt, trailer[:16])
+        if not _hmac.compare_digest(want, trailer[16 : 16 + dlen]):
+            raise DecodeError("authentication digest mismatch")
+        return seqno

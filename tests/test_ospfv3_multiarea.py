@@ -1,0 +1,199 @@
+"""OSPFv3 multi-area: ABR inter-area-prefix LSAs, stub default, externals.
+
+Reference: holo-ospf's version-trait inter-area paths applied to v3
+(spf.rs / route.rs inter-area machinery, RFC 5340 §4.4.3.4 + §4.8).
+"""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv6Address as A6
+from ipaddress import IPv6Network as N6
+
+from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.instance_v3 import (
+    OspfV3Instance,
+    V3IfConfig,
+    V3IfUpMsg,
+)
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+AREA0 = A("0.0.0.0")
+AREA1 = A("0.0.0.1")
+
+
+def mk(loop, fabric, name, rid):
+    r = OspfV3Instance(
+        name=name, router_id=A(rid), netio=fabric.sender_for(name)
+    )
+    loop.register(r)
+    return r
+
+
+def link(fabric, lname, a, ai, alla, aid_a, b, bi, allb, aid_b, **area_kw):
+    a.add_interface(ai, V3IfConfig(cost=10, area_id=aid_a), A6(alla), [], **area_kw)
+    b.add_interface(bi, V3IfConfig(cost=10, area_id=aid_b), A6(allb), [], **area_kw)
+    fabric.join(lname, a.name, ai, A6(alla))
+    fabric.join(lname, b.name, bi, A6(allb))
+
+
+def three_router_two_areas(stub=False):
+    """r1 --area1-- r2(ABR) --area0-- r3; r1/r3 advertise one prefix each."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk(loop, fabric, "m1", "1.1.1.1")
+    r2 = mk(loop, fabric, "m2", "2.2.2.2")
+    r3 = mk(loop, fabric, "m3", "3.3.3.3")
+    kw = {"stub": True} if stub else {}
+    link(fabric, "l12", r1, "e0", "fe80::1:1", AREA1,
+         r2, "e0", "fe80::2:1", AREA1, **kw)
+    link(fabric, "l23", r2, "e1", "fe80::2:2", AREA0,
+         r3, "e0", "fe80::3:1", AREA0)
+    r1.interfaces["e0"].prefixes.append(N6("2001:db8:11::/64"))
+    r3.interfaces["e0"].prefixes.append(N6("2001:db8:33::/64"))
+    for r in (r1, r2, r3):
+        for ifname in r.interfaces:
+            loop.send(r.name, V3IfUpMsg(ifname))
+    loop.advance(90)
+    return loop, r1, r2, r3
+
+
+def test_abr_inter_area_routes_both_directions():
+    loop, r1, r2, r3 = three_router_two_areas()
+    # r2 is the ABR and knows it
+    assert r2.is_abr
+    # r1 (area 1) reaches r3's area-0 prefix via an inter-area route
+    route = r1.routes.get(N6("2001:db8:33::/64"))
+    assert route is not None, sorted(map(str, r1.routes))
+    assert route.dist == 10 + 10 + 10
+    assert {(i, str(a)) for i, a in route.nexthops} == {("e0", "fe80::2:1")}
+    # and symmetric: r3 reaches r1's area-1 prefix
+    back = r3.routes.get(N6("2001:db8:11::/64"))
+    assert back is not None and back.dist == 30
+    # the ABR's router LSA carries the B flag in both areas
+    for area in r2.areas.values():
+        e = area.lsdb.get(
+            P.LsaKey(P.LsaType.ROUTER, A("0.0.0.0"), A("2.2.2.2"))
+        )
+        assert e is not None and P.RouterFlags.B in e.lsa.body.flags
+    # r1's area-1 LSDB holds the ABR's inter-area-prefix LSA
+    inter = [
+        e.lsa
+        for e in r1.lsdb.all()
+        if e.lsa.type == P.LsaType.INTER_AREA_PREFIX
+        and e.lsa.adv_rtr == A("2.2.2.2")
+    ]
+    assert any(l.body.prefix == N6("2001:db8:33::/64") for l in inter)
+
+
+def test_stub_area_gets_default_not_externals():
+    loop, r1, r2, r3 = three_router_two_areas(stub=True)
+    # r3 (backbone) redistributes an external prefix
+    r3.redistribute(N6("2001:db8:ee::/48"), metric=20)
+    loop.advance(30)
+    # backbone members see the external
+    assert N6("2001:db8:ee::/48") in r2.routes
+    # the stub-area member does NOT see the AS-external LSA...
+    assert not any(
+        e.lsa.type == P.LsaType.AS_EXTERNAL for e in r1.lsdb.all()
+    )
+    # ...but follows the ABR's injected default instead
+    default = r1.routes.get(N6("::/0"))
+    assert default is not None
+    assert {(i, str(a)) for i, a in default.nexthops} == {("e0", "fe80::2:1")}
+
+
+def test_v3_externals_reach_other_areas():
+    loop, r1, r2, r3 = three_router_two_areas()
+    r3.redistribute(N6("2001:db8:ee::/48"), metric=20)
+    loop.advance(30)
+    # normal (non-stub) area member computes the external route via the
+    # ASBR (E2: external metric ranks, distance = metric)
+    route = r1.routes.get(N6("2001:db8:ee::/48"))
+    assert route is not None, sorted(map(str, r1.routes))
+    assert {(i, str(a)) for i, a in route.nexthops} == {("e0", "fe80::2:1")}
+    # the ASBR's router LSA carries the E flag
+    e = r3.lsdb.get(P.LsaKey(P.LsaType.ROUTER, A("0.0.0.0"), A("3.3.3.3")))
+    assert P.RouterFlags.E in e.lsa.body.flags
+
+
+def test_v3_authentication_trailer():
+    """RFC 7166: matching SAs converge; tampering and wrong keys drop."""
+    import pytest
+
+    from holo_tpu.utils.bytesbuf import DecodeError
+
+    auth = P.AuthCtxV3(key=b"s3cret", sa_id=5, seqno=7)
+    pkt = P.Packet(
+        A("1.1.1.1"), A("0.0.0.0"),
+        P.Hello(iface_id=1, priority=1,
+                options=P.Options.V6 | P.Options.E | P.Options.R,
+                hello_interval=10, dead_interval=40,
+                dr=A("0.0.0.0"), bdr=A("0.0.0.0"), neighbors=[]),
+    )
+    src, dst = A6("fe80::1"), A6("ff02::5")
+    raw = pkt.encode(src, dst, auth=auth)
+    out = P.Packet.decode(raw, src, dst, auth=auth)
+    assert out.auth_seqno == 7
+    bad = bytearray(raw)
+    bad[4] ^= 0x01  # tamper inside the signed region
+    with pytest.raises(DecodeError):
+        P.Packet.decode(bytes(bad), src, dst, auth=auth)
+    with pytest.raises(DecodeError):
+        P.Packet.decode(raw, src, dst, auth=P.AuthCtxV3(key=b"wrong", sa_id=5))
+    with pytest.raises(DecodeError):
+        P.Packet.decode(raw[: len(raw) - 10], src, dst, auth=auth)
+
+
+def _auth_pair(key_a, key_b):
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk(loop, fabric, "a1", "1.1.1.1")
+    r2 = mk(loop, fabric, "a2", "2.2.2.2")
+    r1.add_interface(
+        "e0", V3IfConfig(cost=10, auth=P.AuthCtxV3(key=key_a)),
+        A6("fe80::a:1"), [],
+    )
+    r2.add_interface(
+        "e0", V3IfConfig(cost=10, auth=P.AuthCtxV3(key=key_b)),
+        A6("fe80::a:2"), [],
+    )
+    fabric.join("l", "a1", "e0", A6("fe80::a:1"))
+    fabric.join("l", "a2", "e0", A6("fe80::a:2"))
+    for r in (r1, r2):
+        loop.send(r.name, V3IfUpMsg("e0"))
+    loop.advance(60)
+    nbrs = r1.interfaces["e0"].neighbors
+    return any(n.state == NsmState.FULL for n in nbrs.values())
+
+
+def test_v3_auth_convergence_and_mismatch():
+    assert _auth_pair(b"same-key", b"same-key")
+    assert not _auth_pair(b"key-one", b"key-two")
+
+
+def test_v3_auth_seqno_restart_safe(tmp_path):
+    """A restarted sender must never reuse trailer seqnos (nvstore
+    reservation ceiling, like the v2 crypto seqno)."""
+    from holo_tpu.utils.nvstore import NvStore
+
+    store = NvStore(tmp_path / "nv.json")
+
+    def boot():
+        loop = EventLoop(clock=VirtualClock())
+        fabric = MockFabric(loop)
+        r = OspfV3Instance(
+            name="rs", router_id=A("9.9.9.9"),
+            netio=fabric.sender_for("rs"), nvstore=store,
+        )
+        loop.register(r)
+        return r
+
+    first = boot()
+    for _ in range(3):  # simulate heavy uptime: exhaust windows
+        first._at_seqno = first._at_reserved
+        first._reserve_at_seqnos()
+    last_sent = first._at_seqno
+    second = boot()
+    assert second._at_seqno >= last_sent
+    assert second._at_reserved > second._at_seqno
