@@ -49,6 +49,9 @@ class AdjacencyState(enum.Enum):
     UP = "up"
 
 
+MT_IPV6 = 2  # RFC 5120 IPv6 unicast topology id
+
+
 @dataclass
 class IsisIfConfig:
     metric: int = 10
@@ -57,6 +60,9 @@ class IsisIfConfig:
     level: int = 2
     circuit_type: str = "p2p"  # "p2p" | "broadcast"
     priority: int = 64  # DIS election priority (LAN)
+    # packet.AuthCtxIsis: hello authentication on this circuit (LSPs/SNPs
+    # use the instance-level area auth).
+    auth: object = None
 
 
 @dataclass
@@ -169,12 +175,31 @@ class IsisInstance(Actor):
         netio: NetIo | None = None,
         spf_backend: SpfBackend | None = None,
         route_cb=None,
+        auth=None,
+        mt_enabled: bool = False,
+        sr=None,
     ):
         assert len(sysid) == 6
         self.name = name
         self.sysid = sysid
         self.area = area
         self.level = level
+        # Area/domain authentication (packet.AuthCtxIsis): signs LSPs and
+        # SNPs end-to-end; hellos use it too unless the circuit overrides
+        # (reference holo-isis/src/packet/auth.rs key semantics).
+        self.auth = auth
+        # RFC 5120 multi-topology ORIGINATION: carry IPv6 in the
+        # ipv6-unicast topology (MT id 2) instead of the base topology
+        # (the rx side consumes both forms regardless).
+        self.mt_enabled = mt_enabled
+        # Segment routing (utils.sr.SrConfig): SRGB advertised via the
+        # Router Capability TLV, prefix-SIDs as sub-TLVs of the wide IP
+        # reach entries (RFC 8667; reference holo-isis/src/sr.rs).
+        self.sr = sr
+        self.sr_labels: dict = {}
+        # lsp_id -> unauthenticated TLV bytes of our last origination
+        # (content-unchanged suppression; see _originate_lsp).
+        self._plain_raw: dict = {}
         self.netio = netio
         self.backend = spf_backend or ScalarSpfBackend()
         self.route_cb = route_cb
@@ -277,7 +302,10 @@ class IsisInstance(Actor):
                     "is_neighbors": sorted(iface.adjs.keys()),
                 },
             )
-            self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
+            self.netio.send(
+                ifname, iface.addr_ip, ALL_ISS,
+                hello.encode(auth=self._hello_auth(iface)),
+            )
         else:
             adj = iface.adj
             if adj is None or adj.state == AdjacencyState.DOWN:
@@ -307,7 +335,10 @@ class IsisInstance(Actor):
                     ),
                 },
             )
-            self.netio.send(ifname, iface.addr_ip, ALL_ISS, hello.encode())
+            self.netio.send(
+                ifname, iface.addr_ip, ALL_ISS,
+                hello.encode(auth=self._hello_auth(iface)),
+            )
         t = getattr(iface, "_hello_timer", None)
         if t is None:
             t = self.loop.timer(self.name, lambda: HelloTimerMsg(ifname))
@@ -421,7 +452,7 @@ class IsisInstance(Actor):
         if e is not None and e.lsp.lifetime > 0:
             dead = Lsp(self.level, 0, lsp_id, e.lsp.seqno + 1, e.lsp.flags,
                        e.lsp.tlvs)
-            dead.encode()
+            dead.encode(auth=self.auth)
             self._install_lsp(dead, flood_from=None)
 
     def _rx_hello(self, iface: IsisInterface, hello: HelloP2p) -> None:
@@ -467,7 +498,9 @@ class IsisInstance(Actor):
             for lid, e in sorted(self.lsdb.items())
         ]
         snp = Snp(self.level, True, self.sysid, entries)
-        self.netio.send(iface.name, iface.addr_ip, ALL_ISS, snp.encode())
+        self.netio.send(
+            iface.name, iface.addr_ip, ALL_ISS, snp.encode(auth=self.auth)
+        )
 
     def _adj_up(self, iface: IsisInterface) -> None:
         # Sync databases: send CSNP describing our LSDB + set SRM on all
@@ -512,8 +545,20 @@ class IsisInstance(Actor):
         ip_reach = []
         ip6_reach = []
         ip6_addrs = []
+        sids = (
+            self.sr.prefix_sids
+            if self.sr is not None and self.sr.enabled
+            else {}
+        )
         for iface in self.interfaces.values():
-            ip_reach.append(ExtIpReach(iface.prefix, iface.config.metric))
+            psid = sids.get(iface.prefix)
+            ip_reach.append(
+                ExtIpReach(
+                    iface.prefix,
+                    iface.config.metric,
+                    sid_index=psid.index if psid is not None else None,
+                )
+            )
             if iface.prefix6 is not None:
                 ip6_reach.append(
                     ExtIpReach(iface.prefix6, iface.config.metric)
@@ -540,16 +585,28 @@ class IsisInstance(Actor):
             "ipv6_reach": ip6_reach,
             "ipv6_addresses": ip6_addrs,
         }
+        if self.sr is not None and self.sr.enabled:
+            tlvs["sr_cap"] = (self.sr.srgb.lower, self.sr.srgb.size)
+        if self.mt_enabled:
+            # Membership in the base + ipv6-unicast topologies, v6 reach
+            # and v6-topology adjacencies under the MT TLVs.
+            tlvs["mt_ids"] = [(0, False, False), (MT_IPV6, False, False)]
+            tlvs["mt_ipv6_reach"] = [(MT_IPV6, e) for e in ip6_reach]
+            tlvs["ipv6_reach"] = []
+            tlvs["mt_is_reach"] = [(MT_IPV6, e) for e in is_reach]
         seqno = max((old.lsp.seqno + 1) if old else 1, min_seqno)
         lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
-        lsp.encode()
+        # Content comparison uses the UNauthenticated bytes: the auth
+        # digest covers the seqno, so authenticated raw always differs.
+        plain = lsp.encode()
         if (
             not force
-            and old is not None
-            and old.lsp.raw[27:] == lsp.raw[27:]
+            and self._plain_raw.get(lsp_id) == plain[27:]
         ):
             self._originate_pseudonodes()
             return  # content unchanged
+        self._plain_raw[lsp_id] = plain[27:]
+        lsp.encode(auth=self.auth)
         self._install_lsp(lsp, flood_from=None)
         self._originate_pseudonodes()
 
@@ -573,9 +630,11 @@ class IsisInstance(Actor):
             old = self.lsdb.get(lsp_id)
             seqno = (old.lsp.seqno + 1) if old else 1
             lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
-            lsp.encode()
-            if not force and old is not None and old.lsp.raw[27:] == lsp.raw[27:]:
+            plain = lsp.encode()
+            if not force and self._plain_raw.get(lsp_id) == plain[27:]:
                 continue
+            self._plain_raw[lsp_id] = plain[27:]
+            lsp.encode(auth=self.auth)
             self._install_lsp(lsp, flood_from=None)
 
     # -- LSDB install + flooding (SRM/SSN model)
@@ -641,18 +700,35 @@ class IsisInstance(Actor):
                     iface.ssn.discard(lid)
                 if entries:
                     snp = Snp(self.level, False, self.sysid, entries)
-                    self.netio.send(iface.name, iface.addr_ip, ALL_ISS, snp.encode())
+                    self.netio.send(
+                        iface.name, iface.addr_ip, ALL_ISS,
+                        snp.encode(auth=self.auth),
+                    )
         if any(i.srm for i in self.interfaces.values()):
             self._flood_timer.start(5.0)  # retransmit interval
 
     # -- rx dispatch
 
+    def _hello_auth(self, iface):
+        return iface.config.auth or self.auth
+
     def _rx(self, msg: NetRxPacket) -> None:
         iface = self.interfaces.get(msg.ifname)
         if iface is None:
             return
+        # Hellos authenticate with the circuit key; LSPs/SNPs carry the
+        # end-to-end area key (the originator's signature is forwarded).
+        hello_types = (
+            PduType.HELLO_P2P, PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2
+        )
+        probe = msg.data[4] & 0x1F if len(msg.data) > 4 else 0
+        rx_auth = (
+            self._hello_auth(iface)
+            if probe in tuple(int(t) for t in hello_types)
+            else self.auth
+        )
         try:
-            pdu_type, pdu = decode_pdu(msg.data)
+            pdu_type, pdu = decode_pdu(msg.data, auth=rx_auth)
         except DecodeError:
             return
         if pdu_type == PduType.HELLO_P2P:
@@ -723,7 +799,10 @@ class IsisInstance(Actor):
         ]
         if missing:
             psnp = Snp(self.level, False, self.sysid, missing)
-            self.netio.send(iface.name, iface.addr_ip, ALL_ISS, psnp.encode())
+            self.netio.send(
+                iface.name, iface.addr_ip, ALL_ISS,
+                psnp.encode(auth=self.auth),
+            )
         self._arm_flood()
 
     def _rx_psnp(self, iface: IsisInterface, snp: Snp) -> None:
@@ -770,7 +849,6 @@ class IsisInstance(Actor):
     def run_spf(self) -> None:
         self.spf_run_count += 1
         now = self.loop.clock.now()
-        MT_IPV6 = 2  # RFC 5120 IPv6 unicast topology id
         nodes: dict[bytes, dict] = {}  # key: sysid+pn byte
         for lid, e in self.lsdb.items():
             if e.remaining_lifetime(now) == 0:
@@ -1035,5 +1113,27 @@ class IsisInstance(Actor):
                 if best is not None:
                     _add(default, best, nhs)
         self.routes = routes
+        self.sr_labels = self._resolve_sr_labels(routes)
         if self.route_cb is not None:
             self.route_cb(routes)
+
+    def _resolve_sr_labels(self, routes: dict) -> dict:
+        """prefix -> (local label, route) for every prefix-SID heard,
+        resolved through our SRGB (holo-isis/src/spf.rs:931-946)."""
+        if self.sr is None or not self.sr.enabled:
+            return {}
+        out = {}
+        for e in self.lsdb.values():
+            if e.lsp.is_expired:
+                continue
+            entries = list(e.lsp.tlvs.get("ext_ip_reach", []))
+            entries += [r for _mt, r in e.lsp.tlvs.get("mt_ip_reach", [])]
+            for r in entries:
+                idx = getattr(r, "sid_index", None)
+                if idx is None:
+                    continue
+                label = self.sr.srgb.label_of(idx)
+                route = routes.get(r.prefix)
+                if label is not None and route is not None:
+                    out[r.prefix] = (label, route)
+        return out

@@ -49,6 +49,8 @@ class TlvType(enum.IntEnum):
     MT_IPV6_REACH = 237
     LSP_ENTRIES = 9
     P2P_ADJ_STATE = 240  # RFC 5303 three-way handshake
+    AUTHENTICATION = 10  # RFC 5304 (HMAC-MD5) / RFC 5310 (generic crypto)
+    ROUTER_CAPABILITY = 242  # RFC 7981 (carries the RFC 8667 SR caps)
 
 
 @dataclass(frozen=True)
@@ -84,6 +86,8 @@ class ExtIpReach:
     # RFC 1195 internal/external distinction (narrow TLV 130 or the I/E
     # metric bit); wide TLVs dropped it, so False there.
     external: bool = False
+    # RFC 8667 §2.1 Prefix-SID sub-TLV (index form) when not None.
+    sid_index: int | None = None
 
 
 class AdjState3Way(enum.IntEnum):
@@ -131,13 +135,31 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
         for r in reach:
             body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
         w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
-    for reach in _chunks(tlvs.get("ext_ip_reach", []), 20):
-        body = b""
-        for r in reach:
-            ctrl = (0x80 if r.up_down else 0) | r.prefix.prefixlen
-            plen_bytes = (r.prefix.prefixlen + 7) // 8
-            body += r.metric.to_bytes(4, "big") + bytes((ctrl,))
-            body += r.prefix.network_address.packed[:plen_bytes]
+    def _wide_ip_entry(r) -> bytes:
+        has_sub = getattr(r, "sid_index", None) is not None
+        ctrl = (
+            (0x80 if r.up_down else 0)
+            | (0x40 if has_sub else 0)
+            | r.prefix.prefixlen
+        )
+        plen_bytes = (r.prefix.prefixlen + 7) // 8
+        out = r.metric.to_bytes(4, "big") + bytes((ctrl,))
+        out += r.prefix.network_address.packed[:plen_bytes]
+        if has_sub:
+            # Prefix-SID sub-TLV (type 3): flags, algo 0, u32 index.
+            out += bytes((8, 3, 6, 0, 0)) + r.sid_index.to_bytes(4, "big")
+        return out
+
+    # Chunk by ENCODED size (entries vary 5..18 bytes with sub-TLVs; the
+    # one-byte TLV length caps the body at 255).
+    body = b""
+    for r in tlvs.get("ext_ip_reach", []):
+        enc = _wide_ip_entry(r)
+        if body and len(body) + len(enc) > 255:
+            w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
+            body = b""
+        body += enc
+    if body:
         w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
     # Max 11 entries per TLV: a full-length /128 entry is 22 bytes and
     # the TLV length octet caps the body at 255 (11*22=242).
@@ -150,6 +172,54 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             body += bytes((ctrl, r.prefix.prefixlen))
             body += r.prefix.network_address.packed[:plen_bytes]
         w.u8(TlvType.IPV6_REACH).u8(len(body)).bytes(body)
+    if tlvs.get("sr_cap"):
+        # Router Capability (RFC 7981) with the SR-Capabilities sub-TLV
+        # (RFC 8667 §3.1): flags + one SRGB descriptor (range u24 +
+        # SID/Label sub-TLV type 1 carrying the base label u24).
+        srgb_base, srgb_range = tlvs["sr_cap"]
+        sub = bytes((0xC0,))  # I+V flags: MPLS v4+v6
+        sub += srgb_range.to_bytes(3, "big")
+        sub += bytes((1, 3)) + srgb_base.to_bytes(3, "big")
+        body = bytes(4)  # router id (unset) 
+        body += bytes((0,))  # capability flags
+        body += bytes((2, len(sub))) + sub
+        w.u8(TlvType.ROUTER_CAPABILITY).u8(len(body)).bytes(body)
+    if tlvs.get("mt_ids"):
+        # RFC 5120 §7.1: u16 per member topology — O(15) A(14) + 12-bit id.
+        body = b"".join(
+            (
+                (0x8000 if ovl else 0)
+                | (0x4000 if att else 0)
+                | (mt_id & 0x0FFF)
+            ).to_bytes(2, "big")
+            for mt_id, att, ovl in tlvs["mt_ids"]
+        )
+        w.u8(TlvType.MULTI_TOPOLOGY).u8(len(body)).bytes(body)
+    # RFC 5120 §7.2/7.4: MT-prefixed variants of the reach TLVs.  Entries
+    # arrive as [(mt_id, entry)]; group per topology, chunk like the
+    # single-topology TLVs.
+    _mt_groups: dict = {}
+    for mt_id, entry in tlvs.get("mt_is_reach", []):
+        _mt_groups.setdefault(("is", mt_id), []).append(entry)
+    for mt_id, entry in tlvs.get("mt_ipv6_reach", []):
+        _mt_groups.setdefault(("v6", mt_id), []).append(entry)
+    for (kind, mt_id), entries in _mt_groups.items():
+        if kind == "is":
+            for chunk in _chunks(entries, 23):
+                body = (mt_id & 0x0FFF).to_bytes(2, "big")
+                for r in chunk:
+                    body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
+                w.u8(TlvType.MT_IS_REACH).u8(len(body)).bytes(body)
+        else:
+            for chunk in _chunks(entries, 11):
+                body = (mt_id & 0x0FFF).to_bytes(2, "big")
+                for r in chunk:
+                    ctrl = 0x80 if r.up_down else 0
+                    plen_bytes = (r.prefix.prefixlen + 7) // 8
+                    body += r.metric.to_bytes(4, "big")
+                    body += bytes((ctrl, r.prefix.prefixlen))
+                    body += r.prefix.network_address.packed[:plen_bytes]
+                w.u8(TlvType.MT_IPV6_REACH).u8(len(body)).bytes(body)
     if tlvs.get("lsp_entries"):
         for chunk in _chunks(tlvs["lsp_entries"], 15):
             body = b""
@@ -174,6 +244,24 @@ def _read_wide_is_entries(body: Reader, out: list) -> None:
         out.append(ExtIsReach(nbr, metric))
 
 
+def _read_prefix_subtlvs(body: Reader) -> int | None:
+    """Parse a prefix entry's sub-TLV block; returns the Prefix-SID
+    index (RFC 8667 sub-TLV 3, index form) if present."""
+    sl = body.u8()
+    sub = body.sub(min(sl, body.remaining()))
+    sid_index = None
+    while sub.remaining() >= 2:
+        st = sub.u8()
+        stl = sub.u8()
+        sb = sub.sub(min(stl, sub.remaining()))
+        if st == 3 and stl >= 6:
+            flags = sb.u8()
+            sb.u8()  # algorithm
+            if not (flags & 0x0C):  # V/L clear: 4-byte index
+                sid_index = sb.u32()
+    return sid_index
+
+
 def _read_wide_ip_entries(body: Reader, out: list) -> None:
     """TLV 135/235 entry stream: u32 metric + ctrl + truncated prefix."""
     while body.remaining() >= 5:
@@ -184,11 +272,13 @@ def _read_wide_ip_entries(body: Reader, out: list) -> None:
             raise DecodeError("bad prefix length")
         nbytes = (plen + 7) // 8
         raw = body.bytes(nbytes) + bytes(4 - nbytes)
+        sid_index = None
         if ctrl & 0x40:  # sub-TLVs present
-            sl = body.u8()
-            body.bytes(min(sl, body.remaining()))
+            sid_index = _read_prefix_subtlvs(body)
         prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
-        out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80)))
+        out.append(
+            ExtIpReach(prefix, metric, bool(ctrl & 0x80), sid_index=sid_index)
+        )
 
 
 def _read_ipv6_entries(body: Reader, out: list) -> None:
@@ -227,12 +317,19 @@ def _decode_tlvs(r: Reader) -> dict:
         "hostname": None,
         "lsp_entries": [],
         "p2p_adj": None,
+        "sr_cap": None,
     }
     while r.remaining() >= 2:
         t = r.u8()
         length = r.u8()
+        value_start = r.pos
         body = r.sub(length)
-        if t == TlvType.AREA_ADDRESSES:
+        if t == TlvType.AUTHENTICATION:
+            if length < 1:
+                raise DecodeError("short authentication TLV")
+            out["auth"] = (body.u8(), body.rest())
+            out["_auth_span"] = (value_start, length)
+        elif t == TlvType.AREA_ADDRESSES:
             while body.remaining() >= 1:
                 n = body.u8()
                 out["area_addresses"].append(body.bytes(n))
@@ -316,6 +413,20 @@ def _decode_tlvs(r: Reader) -> dict:
             else:
                 _read_ipv6_entries(body, entries)
                 out["mt_ipv6_reach"].extend((mt_id, e) for e in entries)
+        elif t == TlvType.ROUTER_CAPABILITY:
+            body.bytes(4)  # router id
+            body.u8()  # flags
+            while body.remaining() >= 2:
+                st = body.u8()
+                stl = body.u8()
+                sb = body.sub(min(stl, body.remaining()))
+                if st == 2 and stl >= 9:
+                    sb.u8()  # sr flags
+                    rng = int.from_bytes(sb.bytes(3), "big")
+                    if sb.remaining() >= 5 and sb.u8() == 1:
+                        sb.u8()  # length (3)
+                        base = int.from_bytes(sb.bytes(3), "big")
+                        out["sr_cap"] = (base, rng)
         elif t == TlvType.LSP_ENTRIES:
             while body.remaining() >= 16:
                 lifetime = body.u16()
@@ -325,6 +436,101 @@ def _decode_tlvs(r: Reader) -> dict:
                 out["lsp_entries"].append((lifetime, lsp_id, seqno, cksum))
         # unknown TLVs skipped (body already consumed)
     return out
+
+
+AUTH_HMAC_MD5 = 54  # RFC 5304 authentication type
+AUTH_CRYPTO = 3  # RFC 5310 generic cryptographic authentication
+
+_ISIS_HMACS = {"hmac-md5": ("md5", 16), "hmac-sha1": ("sha1", 20),
+               "hmac-sha256": ("sha256", 32)}
+
+
+@dataclass
+class AuthCtxIsis:
+    """IS-IS cryptographic authentication context.
+
+    ``hmac-md5`` emits the RFC 5304 TLV (type octet 54, no key id);
+    the SHA family emits the RFC 5310 generic TLV (type octet 3 +
+    16-bit key id).  The digest is computed over the whole PDU with the
+    digest zeroed — and, for LSPs, the checksum and remaining lifetime
+    zeroed too (RFC 5304 §3.2)."""
+
+    key: bytes
+    algo: str = "hmac-md5"
+    key_id: int = 1
+
+    def _hmac(self, data: bytes) -> bytes:
+        import hashlib
+        import hmac as _h
+
+        name, _dlen = _ISIS_HMACS[self.algo]
+        return _h.new(self.key, data, getattr(hashlib, name)).digest()
+
+    def tlv_value_len(self) -> int:
+        _name, dlen = _ISIS_HMACS[self.algo]
+        return (1 + dlen) if self.algo == "hmac-md5" else (3 + dlen)
+
+
+def _append_auth_tlv(w: Writer, auth: AuthCtxIsis) -> int:
+    """Write the auth TLV with a zeroed digest; returns digest offset."""
+    _name, dlen = _ISIS_HMACS[auth.algo]
+    w.u8(TlvType.AUTHENTICATION).u8(auth.tlv_value_len())
+    if auth.algo == "hmac-md5":
+        w.u8(AUTH_HMAC_MD5)
+    else:
+        w.u8(AUTH_CRYPTO).u16(auth.key_id)
+    pos = len(w)
+    w.zeros(dlen)
+    return pos
+
+
+def _patch_auth_digest(
+    w: Writer, auth: AuthCtxIsis, digest_pos: int, lsp_zero: tuple | None = None
+) -> None:
+    """Compute the digest over the current buffer (digest zeroed; for
+    LSPs also lifetime/cksum zeroed) and patch it in."""
+    _name, dlen = _ISIS_HMACS[auth.algo]
+    buf = bytearray(w.buf)
+    buf[digest_pos : digest_pos + dlen] = bytes(dlen)
+    if lsp_zero is not None:
+        life_pos, cks_pos = lsp_zero
+        buf[life_pos : life_pos + 2] = b"\x00\x00"
+        buf[cks_pos : cks_pos + 2] = b"\x00\x00"
+    digest = auth._hmac(bytes(buf))
+    for i, b in enumerate(digest):
+        w.buf[digest_pos + i] = b
+
+
+def verify_pdu_auth(data: bytes, tlvs: dict, auth: AuthCtxIsis) -> None:
+    """Raises DecodeError unless the PDU carries a valid auth TLV."""
+    import hmac as _h
+
+    span = tlvs.get("_auth_span")
+    info = tlvs.get("auth")
+    if span is None or info is None:
+        raise DecodeError("authentication TLV missing")
+    atype, value = info
+    _name, dlen = _ISIS_HMACS[auth.algo]
+    if auth.algo == "hmac-md5":
+        if atype != AUTH_HMAC_MD5 or len(value) != dlen:
+            raise DecodeError("authentication type mismatch")
+        digest_off = span[0] + 1
+    else:
+        if atype != AUTH_CRYPTO or len(value) != 2 + dlen:
+            raise DecodeError("authentication type mismatch")
+        key_id = int.from_bytes(value[:2], "big")
+        if key_id != auth.key_id:
+            raise DecodeError("unknown authentication key id")
+        digest_off = span[0] + 3
+    got = data[digest_off : digest_off + dlen]
+    buf = bytearray(data)
+    buf[digest_off : digest_off + dlen] = bytes(dlen)
+    pdu_type = PduType(data[4] & 0x1F)
+    if pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
+        buf[10:12] = b"\x00\x00"  # remaining lifetime
+        buf[24:26] = b"\x00\x00"  # checksum
+    if not _h.compare_digest(auth._hmac(bytes(buf)), got):
+        raise DecodeError("authentication digest mismatch")
 
 
 def _pdu_header(w: Writer, pdu_type: PduType, hdr_len: int) -> None:
@@ -359,7 +565,7 @@ class HelloP2p:
 
     TYPE = PduType.HELLO_P2P
 
-    def encode(self) -> bytes:
+    def encode(self, auth: "AuthCtxIsis | None" = None) -> bytes:
         w = Writer()
         _pdu_header(w, self.TYPE, 20)
         w.u8(self.circuit_type).bytes(self.sysid)
@@ -367,8 +573,11 @@ class HelloP2p:
         len_pos = len(w)
         w.u16(0)
         w.u8(self.local_circuit_id)
+        digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
+        if digest_pos is not None:
+            _patch_auth_digest(w, auth, digest_pos)
         return w.finish()
 
     @classmethod
@@ -397,7 +606,7 @@ class HelloLan:
     def TYPE(self):
         return PduType.HELLO_LAN_L2 if self.level == 2 else PduType.HELLO_LAN_L1
 
-    def encode(self) -> bytes:
+    def encode(self, auth: "AuthCtxIsis | None" = None) -> bytes:
         w = Writer()
         _pdu_header(w, self.TYPE, 27)
         w.u8(self.circuit_type).bytes(self.sysid)
@@ -406,8 +615,11 @@ class HelloLan:
         w.u16(0)
         w.u8(self.priority & 0x7F)
         w.bytes(self.lan_id)
+        digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
+        if digest_pos is not None:
+            _patch_auth_digest(w, auth, digest_pos)
         return w.finish()
 
     @classmethod
@@ -436,19 +648,25 @@ class Lsp:
     def is_expired(self) -> bool:
         return self.lifetime == 0
 
-    def encode(self) -> bytes:
+    def encode(self, auth: "AuthCtxIsis | None" = None) -> bytes:
         w = Writer()
         _pdu_header(w, PduType.LSP_L2 if self.level == 2 else PduType.LSP_L1, 27)
         len_pos = len(w)
         w.u16(0)  # pdu length
+        life_pos = len(w)
         w.u16(self.lifetime)
         w.bytes(self.lsp_id.encode())
         w.u32(self.seqno)
         cks_pos = len(w)
         w.u16(0)
         w.u8(self.flags)
+        digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
+        if digest_pos is not None:
+            # RFC 5304 §3.2: digest first (lifetime/cksum zeroed), then
+            # the regular checksum over the final bytes.
+            _patch_auth_digest(w, auth, digest_pos, (life_pos, cks_pos))
         # ISO 10589 §7.3.11: checksum over lsp_id..end (offset 12 in PDU).
         cks = fletcher16_checksum(bytes(w.buf[12:]), cks_pos - 12)
         w.patch_u16(cks_pos, cks)
@@ -492,8 +710,9 @@ class Snp:
     entries: list = field(default_factory=list)  # (lifetime, LspId, seqno, cksum)
     start: LspId | None = None
     end: LspId | None = None
+    tlvs: dict = field(default_factory=dict)
 
-    def encode(self) -> bytes:
+    def encode(self, auth: "AuthCtxIsis | None" = None) -> bytes:
         w = Writer()
         if self.complete:
             t = PduType.CSNP_L2 if self.level == 2 else PduType.CSNP_L1
@@ -507,8 +726,11 @@ class Snp:
         if self.complete:
             w.bytes((self.start or LspId(b"\x00" * 6)).encode())
             w.bytes((self.end or LspId(b"\xff" * 6, 0xFF, 0xFF)).encode())
+        digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, {"lsp_entries": self.entries})
         w.patch_u16(len_pos, len(w))
+        if digest_pos is not None:
+            _patch_auth_digest(w, auth, digest_pos)
         return w.finish()
 
     @classmethod
@@ -520,25 +742,41 @@ class Snp:
             start = LspId.decode(r.bytes(8))
             end = LspId.decode(r.bytes(8))
         tlvs = _decode_tlvs(r)
-        return cls(level, complete, src[:6], tlvs["lsp_entries"], start, end)
+        return cls(
+            level, complete, src[:6], tlvs["lsp_entries"], start, end, tlvs
+        )
 
 
-def decode_pdu(data: bytes):
-    """Top-level dispatch; returns (PduType, object)."""
+def decode_pdu(data: bytes, auth: "AuthCtxIsis | None" = None):
+    """Top-level dispatch; returns (PduType, object).
+
+    With ``auth``, every PDU must carry a valid authentication TLV
+    (RFC 5304/5310) or DecodeError is raised."""
     r = Reader(data)
     pdu_type = _check_header(r)
     if pdu_type == PduType.HELLO_P2P:
-        return pdu_type, HelloP2p.decode_body(r)
-    if pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
+        out = HelloP2p.decode_body(r)
+    elif pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
         level = 2 if pdu_type == PduType.HELLO_LAN_L2 else 1
-        return pdu_type, HelloLan.decode_body(r, level)
-    if pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
+        out = HelloLan.decode_body(r, level)
+    elif pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
         level = 2 if pdu_type == PduType.LSP_L2 else 1
-        return pdu_type, Lsp.decode_body(r, level, data)
-    if pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
+        out = Lsp.decode_body(r, level, data)
+    elif pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
         level = 2 if pdu_type == PduType.CSNP_L2 else 1
-        return pdu_type, Snp.decode_body(r, level, True)
-    if pdu_type in (PduType.PSNP_L1, PduType.PSNP_L2):
+        out = Snp.decode_body(r, level, True)
+    elif pdu_type in (PduType.PSNP_L1, PduType.PSNP_L2):
         level = 2 if pdu_type == PduType.PSNP_L2 else 1
-        return pdu_type, Snp.decode_body(r, level, False)
-    raise DecodeError("unhandled PDU type")
+        out = Snp.decode_body(r, level, False)
+    else:
+        raise DecodeError("unhandled PDU type")
+    if auth is not None:
+        tlvs = _tlvs_of(out)
+        if tlvs is None:
+            raise DecodeError("authentication required")
+        verify_pdu_auth(data, tlvs, auth)
+    return pdu_type, out
+
+
+def _tlvs_of(pdu):
+    return getattr(pdu, "tlvs", None)

@@ -414,3 +414,142 @@ def test_live_ipv6_origination_and_hostname():
     # protocols_supported advertises IPv6 (NLPID 0x8E).
     own = r2.lsdb[LspId(sysid(1))].lsp
     assert 0x8E in own.tlvs["protocols_supported"]
+
+
+def test_isis_authentication():
+    """RFC 5304/5310: authenticated adjacency + LSDB sync; key mismatch
+    and tampering drop PDUs."""
+    import pytest
+
+    from holo_tpu.protocols.isis.packet import (
+        AuthCtxIsis,
+        Lsp,
+        LspId,
+        decode_pdu,
+    )
+    from holo_tpu.utils.bytesbuf import DecodeError
+
+    # codec level: round-trip + tamper for both TLV families
+    for algo in ("hmac-md5", "hmac-sha256"):
+        auth = AuthCtxIsis(key=b"k3y", algo=algo, key_id=9)
+        lsp = Lsp(2, 1200, LspId(b"\x00\x00\x00\x00\x00\x01"), 4,
+                  tlvs={"hostname": "a"})
+        raw = lsp.encode(auth=auth)
+        t, out = decode_pdu(raw, auth=auth)
+        assert out.seqno == 4
+        bad = bytearray(raw)
+        bad[-1] ^= 0x40
+        with pytest.raises(DecodeError):
+            decode_pdu(bytes(bad), auth=auth)
+        with pytest.raises(DecodeError):
+            decode_pdu(raw, auth=AuthCtxIsis(key=b"other", algo=algo, key_id=9))
+        # unauthenticated PDU rejected when auth required
+        with pytest.raises(DecodeError):
+            decode_pdu(Lsp(2, 1200, LspId(b"\x00" * 6), 1).encode(), auth=auth)
+
+    def converge(key_a, key_b):
+        loop = EventLoop(clock=VirtualClock())
+        fabric = MockFabric(loop)
+        insts = []
+        for name, sid, addr, key in (
+            ("ia", b"\x00\x00\x00\x00\x00\x0a", "10.7.0.1", key_a),
+            ("ib", b"\x00\x00\x00\x00\x00\x0b", "10.7.0.2", key_b),
+        ):
+            inst = IsisInstance(
+                name=name, sysid=sid, netio=fabric.sender_for(name),
+                auth=AuthCtxIsis(key=key),
+            )
+            loop.register(inst)
+            inst.add_interface("e0", IsisIfConfig(), A(addr), N("10.7.0.0/30"))
+            fabric.join("l", name, "e0", A(addr))
+            insts.append(inst)
+        for inst in insts:
+            loop.send(inst.name, IsisIfUpMsg("e0"))
+        loop.advance(60)
+        a, b = insts
+        up = any(
+            True for i in a.interfaces.values() for _ in i.up_adjacencies()
+        )
+        return up and set(a.lsdb) == set(b.lsdb)
+
+    assert converge(b"ring0", b"ring0")
+    assert not converge(b"ring0", b"wrong")
+
+
+def test_isis_mt_origination_end_to_end():
+    """RFC 5120 originate side: with mt_enabled the v6 reach rides the MT
+    TLVs (ids 229/222/237) and an MT peer still computes v6 routes."""
+    from ipaddress import IPv6Address as A6
+    from ipaddress import IPv6Network as N6
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    insts = []
+    for name, sid, a4, a6, p6 in (
+        ("mta", b"\x00\x00\x00\x00\x00\x1a", "10.8.0.1", "fe80::8:1",
+         "2001:db8:a::/64"),
+        ("mtb", b"\x00\x00\x00\x00\x00\x1b", "10.8.0.2", "fe80::8:2",
+         "2001:db8:b::/64"),
+    ):
+        inst = IsisInstance(
+            name=name, sysid=sid, netio=fabric.sender_for(name),
+            mt_enabled=True,
+        )
+        loop.register(inst)
+        inst.add_interface(
+            "e0", IsisIfConfig(), A(a4), N("10.8.0.0/30"),
+            addr6=A6(a6), prefix6=N6(p6),
+        )
+        fabric.join("l", name, "e0", A(a4))
+        insts.append(inst)
+    for inst in insts:
+        loop.send(inst.name, IsisIfUpMsg("e0"))
+    loop.advance(60)
+    a, b = insts
+    # our own LSP carries MT TLVs, not plain ipv6 reach
+    own = a.lsdb[LspId(a.sysid)].lsp
+    assert own.tlvs.get("mt_ids"), own.tlvs.keys()
+    assert own.tlvs.get("mt_ipv6_reach") and not own.tlvs.get("ipv6_reach")
+    # the peer computes the v6 route from the MT topology
+    r6 = b.routes.get(N6("2001:db8:a::/64"))
+    assert r6 is not None, sorted(map(str, b.routes))
+
+
+def test_isis_sr_prefix_sids():
+    """RFC 8667: SRGB capability + prefix-SID sub-TLVs resolve to labels."""
+    from holo_tpu.utils.sr import PrefixSid, SrConfig, Srgb
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    insts = []
+    for name, sid, addr, lo in (
+        ("sa", b"\x00\x00\x00\x00\x00\x2a", "10.9.0.1", "1.1.1.1"),
+        ("sb", b"\x00\x00\x00\x00\x00\x2b", "10.9.0.2", "2.2.2.2"),
+    ):
+        loop_pfx = N(f"{lo}/32")
+        sr = SrConfig(
+            enabled=True, srgb=Srgb(16000, 23999),
+            prefix_sids={loop_pfx: PrefixSid(loop_pfx, int(lo[0]) * 10)},
+        )
+        inst = IsisInstance(
+            name=name, sysid=sid, netio=fabric.sender_for(name), sr=sr
+        )
+        loop.register(inst)
+        inst.add_interface("e0", IsisIfConfig(), A(addr), N("10.9.0.0/30"))
+        inst.add_interface(
+            "lo", IsisIfConfig(metric=0), A(lo), loop_pfx
+        )
+        fabric.join("l", name, "e0", A(addr))
+        insts.append(inst)
+    for inst in insts:
+        loop.send(inst.name, IsisIfUpMsg("e0"))
+    loop.advance(60)
+    a, b = insts
+    # a resolves b's loopback SID through its SRGB: 16000 + 20
+    entry = a.sr_labels.get(N("2.2.2.2/32"))
+    assert entry is not None, a.sr_labels
+    label, route = entry
+    assert label == 16000 + 20  # our SRGB base + the advertised index
+    # and the capability TLV round-tripped through b's LSP
+    e = a.lsdb[LspId(b.sysid)].lsp
+    assert e.tlvs.get("sr_cap") == (16000, 8000)
