@@ -368,6 +368,7 @@ class RoutingProvider(Provider, Actor):
         self.ifp = interface_provider
         self.prefix = prefix
         self.rib = RibManager(ibus, kernel or MockKernel())
+        self.rib.on_change = self._rib_changed
         self.instances: dict[str, OspfInstance] = {}
 
     def attach(self, loop_):
@@ -866,6 +867,7 @@ class RoutingProvider(Provider, Actor):
             if inst is not None:
                 self.loop.unregister(inst.name)
                 del self.instances["ldp"]
+                self._uninstall_ldp_labels()
             return
         mode = new.get(
             f"{base}/label-distribution-control", "independent"
@@ -875,6 +877,7 @@ class RoutingProvider(Provider, Actor):
         ):
             self.loop.unregister(inst.name)
             del self.instances["ldp"]
+            self._uninstall_ldp_labels()
             inst = None
         if inst is None:
             actor = f"{self.prefix}ldp"
@@ -883,6 +886,7 @@ class RoutingProvider(Provider, Actor):
                 lsr_id=IPv4Address(lsr_id),
                 netio=self.netio_factory(actor),
                 control_mode=mode,
+                lib_cb=self._ldp_lib_changed,
             )
             self.loop.register(inst)
             self.instances["ldp"] = inst
@@ -1058,6 +1062,120 @@ class RoutingProvider(Provider, Actor):
         for prefix in list(inst.originated.keys() - wanted_nets):
             del inst.originated[prefix]
             inst._decision(prefix)
+
+    def _rib_changed(self) -> None:
+        """RIB delta: keep the LDP FEC table in lockstep (routed prefixes
+        become transit FECs with real labels; reference seeds FECs from
+        the RIB the same way) and refresh LFIB entries whose next hops
+        may have moved."""
+        ldp = self.instances.get("ldp")
+        if ldp is None:
+            return
+        active = {
+            prefix: msg
+            for prefix, msg in self.rib.active_routes().items()
+            if prefix.version == 4
+        }
+        from holo_tpu.utils.southbound import Protocol
+
+        for prefix, msg in active.items():
+            if msg.protocol == Protocol.DIRECT:
+                continue  # connected nets are egress FECs (iface seeding)
+            if prefix not in ldp.fec_table:
+                ldp.add_fec(prefix, egress=False)
+        for prefix, (label, egress) in list(ldp.fec_table.items()):
+            if not egress and prefix not in active:
+                ldp.remove_fec(prefix)
+        # Ordered mode eligibility (§2.6.1): each FEC's downstream LSR is
+        # the neighbor owning the route's next hop.
+        nexthop_lsr = {}
+        for prefix, msg in active.items():
+            for nh in msg.nexthops:
+                for lsr, nbr in ldp.neighbors.items():
+                    if nbr.addr == nh.addr:
+                        nexthop_lsr[prefix] = lsr
+                        break
+        ldp.set_nexthops(nexthop_lsr)
+        self._ldp_lib_changed(ldp.lib())
+
+    def _uninstall_ldp_labels(self) -> None:
+        from holo_tpu.utils.southbound import LabelUninstallMsg, Protocol
+
+        for label, msg in list(self.rib.mpls.items()):
+            if msg.protocol == Protocol.LDP:
+                self.rib.label_del(
+                    LabelUninstallMsg(protocol=Protocol.LDP, label=label)
+                )
+
+    def _ldp_lib_changed(self, lib: dict) -> None:
+        """Merge the LDP LIB with RIB next hops into LFIB entries
+        (reference holo-routing/src/rib.rs:152-212): for every FEC with a
+        real local label, the in-label swaps to the downstream peer's
+        binding (implicit-null => penultimate-hop pop) along the FEC's
+        routed next hops; egress FECs keep implicit-null and install
+        nothing."""
+        from holo_tpu.utils.mpls import IMPLICIT_NULL
+        from holo_tpu.utils.southbound import (
+            LabelInstallMsg,
+            LabelUninstallMsg,
+            Nexthop,
+            Protocol,
+        )
+
+        ldp = self.instances.get("ldp")
+        wanted: dict[int, LabelInstallMsg] = {}
+        for fec, entry in lib.items():
+            local = entry["local"]
+            if entry.get("egress") or local == IMPLICIT_NULL:
+                continue
+            pr = self.rib.routes.get(fec)
+            best = None
+            if pr is not None:
+                for e in pr.entries.values():
+                    if e.active:
+                        best = e.msg
+                        break
+            if best is None:
+                continue
+            # Downstream peer = the neighbor owning the route's next hop.
+            remote = entry.get("remote", {})
+            nhs = set()
+            for nh in best.nexthops:
+                out_label = None
+                for lsr, label in remote.items():
+                    nbr = ldp.neighbors.get(IPv4Address(lsr)) if ldp else None
+                    if nbr is not None and nbr.addr == nh.addr:
+                        out_label = label
+                        break
+                if out_label is None:
+                    continue
+                labels = () if out_label == IMPLICIT_NULL else (out_label,)
+                nhs.add(
+                    Nexthop(
+                        addr=nh.addr,
+                        ifname=nh.ifname,
+                        ifindex=nh.ifindex,
+                        labels=labels,
+                    )
+                )
+            if nhs:
+                wanted[local] = LabelInstallMsg(
+                    protocol=Protocol.LDP,
+                    label=local,
+                    nexthops=frozenset(nhs),
+                    route=(fec,),
+                )
+        current = {
+            label
+            for label, msg in self.rib.mpls.items()
+            if msg.protocol == Protocol.LDP
+        }
+        for label, msg in wanted.items():
+            self.rib.label_add(msg)
+        for label in current - set(wanted):
+            self.rib.label_del(
+                LabelUninstallMsg(protocol=Protocol.LDP, label=label)
+            )
 
     def _close_bgp_tcp(self):
         io = getattr(self, "bgp_tcp_io", None)

@@ -66,6 +66,13 @@ RTA_GATEWAY = 5
 RTA_PRIORITY = 6
 RTA_MULTIPATH = 9
 RTA_TABLE = 15
+RTA_VIA = 18
+RTA_NEWDST = 19
+RTA_ENCAP_TYPE = 21
+RTA_ENCAP = 22
+AF_MPLS = 28
+LWTUNNEL_ENCAP_MPLS = 1
+MPLS_IPTUNNEL_DST = 1
 
 # link attributes
 IFLA_IFNAME = 3
@@ -339,23 +346,107 @@ class NetlinkKernel(Kernel):
         )
         if len(hops) == 1:
             nh = hops[0]
+            if nh.labels:
+                # FTN: push the label stack via lightweight MPLS encap.
+                payload += _attr(
+                    RTA_ENCAP_TYPE, struct.pack("<H", LWTUNNEL_ENCAP_MPLS)
+                )
+                payload += _attr(
+                    RTA_ENCAP,
+                    _attr(MPLS_IPTUNNEL_DST, self._mpls_stack(nh.labels)),
+                )
             if nh.addr is not None:
                 payload += _attr(RTA_GATEWAY, nh.addr.packed)
             ifidx = self._ifindex(nh)
             if ifidx is not None:
                 payload += _attr(RTA_OIF, struct.pack("<i", ifidx))
         else:
-            # ECMP: RTA_MULTIPATH of rtnexthop entries.
+            # ECMP: RTA_MULTIPATH of rtnexthop entries (with per-hop MPLS
+            # encap for labeled next hops).
             mp = b""
             for nh in hops:
                 inner = b""
+                if nh.labels:
+                    inner += _attr(
+                        RTA_ENCAP_TYPE, struct.pack("<H", LWTUNNEL_ENCAP_MPLS)
+                    )
+                    inner += _attr(
+                        RTA_ENCAP,
+                        _attr(MPLS_IPTUNNEL_DST, self._mpls_stack(nh.labels)),
+                    )
                 if nh.addr is not None:
-                    inner = _attr(RTA_GATEWAY, nh.addr.packed)
+                    inner += _attr(RTA_GATEWAY, nh.addr.packed)
                 ifidx = self._ifindex(nh) or 0
                 rtnh = struct.pack("<HBBi", 8 + len(inner), 0, 0, ifidx)
                 mp += rtnh + inner
             payload += _attr(RTA_MULTIPATH, mp)
         return payload
+
+    @staticmethod
+    def _mpls_stack(labels) -> bytes:
+        """MPLS label stack records: u32 BE label<<12, BoS on the last."""
+        out = b""
+        for i, label in enumerate(labels):
+            word = (label & 0xFFFFF) << 12
+            if i == len(labels) - 1:
+                word |= 0x100  # bottom of stack
+            out += struct.pack(">I", word)
+        return out
+
+    def _label_payload(self, in_label: int, nexthops=None) -> bytes:
+        rt = _RtMsg(AF_MPLS, 20, self.table)
+        payload = rt.pack()
+        payload += _attr(RTA_DST, self._mpls_stack((in_label,)))
+        if not nexthops:
+            return payload
+        hops = sorted(
+            nexthops, key=lambda n: (str(n.addr or ""), n.ifname or "")
+        )
+
+        def hop_attrs(nh) -> bytes:
+            # Swap: RTA_NEWDST carries the outgoing stack; absent = pop
+            # (penultimate-hop / egress behavior).
+            out = b""
+            if nh.labels:
+                out += _attr(RTA_NEWDST, self._mpls_stack(nh.labels))
+            if nh.addr is not None:
+                fam = (
+                    socket.AF_INET
+                    if nh.addr.version == 4
+                    else socket.AF_INET6
+                )
+                out += _attr(RTA_VIA, struct.pack("<H", fam) + nh.addr.packed)
+            return out
+
+        if len(hops) == 1:
+            nh = hops[0]
+            payload += hop_attrs(nh)
+            ifidx = self._ifindex(nh)
+            if ifidx is not None:
+                payload += _attr(RTA_OIF, struct.pack("<i", ifidx))
+        else:
+            mp = b""
+            for nh in hops:
+                inner = hop_attrs(nh)
+                ifidx = self._ifindex(nh) or 0
+                rtnh = struct.pack("<HBBi", 8 + len(inner), 0, 0, ifidx)
+                mp += rtnh + inner
+            payload += _attr(RTA_MULTIPATH, mp)
+        return payload
+
+    def install_label(self, in_label: int, nexthops) -> None:
+        """LFIB entry: in_label -> swap/pop toward the nexthop
+        (reference holo-routing/src/netlink.rs:30-223 MPLS path)."""
+        payload = self._label_payload(in_label, nexthops)
+        self.nl.request_ack(RTM_NEWROUTE, NLM_F_CREATE | NLM_F_REPLACE, payload)
+
+    def uninstall_label(self, in_label: int) -> None:
+        payload = self._label_payload(in_label)
+        try:
+            self.nl.request_ack(RTM_DELROUTE, 0, payload)
+        except OSError as e:
+            if e.errno != 3:
+                raise
 
     def _ifindex(self, nh: Nexthop) -> int | None:
         if nh.ifindex is not None:
@@ -383,7 +474,22 @@ class NetlinkKernel(Kernel):
                 raise
 
     def purge_stale(self) -> None:
-        """Remove every route carrying our rtm_protocol tag."""
+        """Remove every route carrying our rtm_protocol tag (including
+        AF_MPLS label routes from a dead incarnation)."""
+        payload = struct.pack("<BBBBBBBBI", AF_MPLS, 0, 0, 0, 0, 0, 0, 0, 0)
+        for mtype, body in self.nl.dump(RTM_GETROUTE, payload):
+            if mtype != RTM_NEWROUTE or len(body) < 12:
+                continue
+            (fam, _dl, _sl, _tos, _table, proto, _scope, _rtype, _flags
+             ) = struct.unpack_from("<BBBBBBBBI", body, 0)
+            if fam != AF_MPLS or proto != RTPROT_HOLO_TPU:
+                continue
+            attrs = parse_attrs(body[12:])
+            dst = attrs.get(RTA_DST)
+            if dst is None or len(dst) < 4:
+                continue
+            in_label = struct.unpack(">I", dst[:4])[0] >> 12
+            self.uninstall_label(in_label)
         for family in (socket.AF_INET, socket.AF_INET6):
             payload = struct.pack("<BBBBBBBBI", family, 0, 0, 0, 0, 0, 0, 0, 0)
             for mtype, body in self.nl.dump(RTM_GETROUTE, payload):

@@ -24,6 +24,8 @@ from holo_tpu.utils.ibus import (
 from holo_tpu.utils.ip import IpNetwork
 from holo_tpu.utils.runtime import Actor
 from holo_tpu.utils.southbound import (
+    LabelInstallMsg,
+    LabelUninstallMsg,
     DEFAULT_DISTANCE,
     Nexthop,
     Protocol,
@@ -41,6 +43,12 @@ class Kernel:
     def uninstall(self, prefix: IpNetwork) -> None:
         raise NotImplementedError
 
+    def install_label(self, in_label: int, nexthops) -> None:
+        """LFIB entry: in-label -> swap (nexthop .labels) or pop."""
+
+    def uninstall_label(self, in_label: int) -> None:
+        pass
+
     def purge_stale(self) -> None:
         """Remove leftover routes from a previous run (netlink.rs:177)."""
 
@@ -48,6 +56,7 @@ class Kernel:
 class MockKernel(Kernel):
     def __init__(self) -> None:
         self.fib: dict[IpNetwork, tuple[frozenset[Nexthop], Protocol]] = {}
+        self.lfib: dict[int, frozenset[Nexthop]] = {}  # in-label -> nexthops
         self.log: list[tuple[str, IpNetwork]] = []
 
     def install(self, prefix, nexthops, proto):
@@ -57,6 +66,18 @@ class MockKernel(Kernel):
     def uninstall(self, prefix):
         self.fib.pop(prefix, None)
         self.log.append(("uninstall", prefix))
+
+    def install_label(self, in_label, nexthops):
+        self.lfib[in_label] = nexthops
+        self.log.append(("install-label", in_label))
+
+    def purge_stale(self):
+        self.fib.clear()
+        self.lfib.clear()
+
+    def uninstall_label(self, in_label):
+        self.lfib.pop(in_label, None)
+        self.log.append(("uninstall-label", in_label))
 
     def purge_stale(self):
         self.fib.clear()
@@ -115,6 +136,10 @@ class RibManager(Actor):
         self.ibus = ibus
         self.kernel = kernel or MockKernel()
         self.routes: dict[IpNetwork, _PrefixRoutes] = {}
+        self.mpls: dict[int, LabelInstallMsg] = {}  # in-label -> LFIB entry
+        # Invoked after any route table change (the provider uses it to
+        # keep LDP FECs and LFIB entries in sync with the RIB).
+        self.on_change: Callable | None = None
         self._programmed: set[IpNetwork] = set()  # prefixes in the kernel FIB
         # Next-hop tracking: addr -> (last NhtUpd, subscriber names).
         self._nht: dict = {}
@@ -130,6 +155,10 @@ class RibManager(Actor):
                 self.route_add(payload)
             elif isinstance(payload, RouteKeyMsg):
                 self.route_del(payload)
+            elif isinstance(payload, LabelInstallMsg):
+                self.label_add(payload)
+            elif isinstance(payload, LabelUninstallMsg):
+                self.label_del(payload)
             elif isinstance(payload, NhtRegister):
                 self.nht_register(payload.addr, payload.sender or msg.sender)
             elif isinstance(payload, NhtUnregister):
@@ -205,6 +234,21 @@ class RibManager(Actor):
         self._reselect(msg.prefix)
         self._nht_reeval(msg.prefix)
 
+    def label_add(self, msg: LabelInstallMsg) -> None:
+        """LFIB programming: the protocol's (LDP/SR) label binding joined
+        with its next hops (reference rib.rs:152-212 -> netlink MPLS).
+        Identical re-installs are elided (convergence churn)."""
+        cur = self.mpls.get(msg.label)
+        if cur is not None and cur.nexthops == msg.nexthops:
+            self.mpls[msg.label] = msg
+            return
+        self.mpls[msg.label] = msg
+        self.kernel.install_label(msg.label, msg.nexthops)
+
+    def label_del(self, msg: LabelUninstallMsg) -> None:
+        if self.mpls.pop(msg.label, None) is not None:
+            self.kernel.uninstall_label(msg.label)
+
     def route_del(self, msg: RouteKeyMsg) -> None:
         pr = self.routes.get(msg.prefix)
         if pr is None:
@@ -219,6 +263,8 @@ class RibManager(Actor):
                 TOPIC_REDISTRIBUTE_DEL, RouteKeyMsg(msg.protocol, msg.prefix)
             )
             self._nht_reeval(msg.prefix)
+            if self.on_change is not None:
+                self.on_change()
             return
         self._reselect(msg.prefix)
         self._nht_reeval(msg.prefix)
@@ -240,6 +286,8 @@ class RibManager(Actor):
                 self.kernel.uninstall(prefix)
                 self._programmed.discard(prefix)
             self.ibus.publish(TOPIC_REDISTRIBUTE_ADD, best.msg)
+        if self.on_change is not None:
+            self.on_change()
 
     # -- queries
 

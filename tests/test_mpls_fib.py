@@ -1,0 +1,137 @@
+"""MPLS label routes to the FIB: LDP LIB x RIB -> LFIB programming.
+
+Reference: holo-routing/src/rib.rs:152-212 (LIB merge) and
+netlink.rs:30-223 (AF_MPLS route install incl. label stacks).
+"""
+
+import struct
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.utils.southbound import LabelInstallMsg, Nexthop, Protocol
+
+
+def test_netlink_mpls_payload_encoding():
+    """AF_MPLS swap + IP-route label push encode the right attributes
+    (checked at the byte level: no MPLS kernel module in this container)."""
+    from holo_tpu.routing import netlink as nl
+
+    k = nl.NetlinkKernel.__new__(nl.NetlinkKernel)  # no socket needed
+    k.table = nl.RT_TABLE_MAIN
+    k._links = {"eth0": 7}
+
+    # label stack records: 20-bit label << 12, bottom-of-stack on last
+    assert nl.NetlinkKernel._mpls_stack((100,)) == struct.pack(
+        ">I", (100 << 12) | 0x100
+    )
+    assert nl.NetlinkKernel._mpls_stack((16001, 17)) == struct.pack(
+        ">I", 16001 << 12
+    ) + struct.pack(">I", (17 << 12) | 0x100)
+
+    nh = Nexthop(addr=A("10.0.0.2"), ifname="eth0", labels=(10042,))
+    payload = k._label_payload(10017, frozenset({nh}))
+    # rtmsg header: AF_MPLS family, /20 "prefix" (one label record)
+    assert payload[0] == nl.AF_MPLS and payload[1] == 20
+    def attrs_of(buf):
+        out = {}
+        off = 12
+        while off + 4 <= len(buf):
+            ln, t = struct.unpack_from("<HH", buf, off)
+            out[t] = buf[off + 4 : off + ln]
+            off += (ln + 3) & ~3
+        return out
+    attrs = attrs_of(payload)
+    assert attrs[nl.RTA_DST] == nl.NetlinkKernel._mpls_stack((10017,))
+    assert attrs[nl.RTA_NEWDST] == nl.NetlinkKernel._mpls_stack((10042,))
+    assert attrs[nl.RTA_VIA][2:] == A("10.0.0.2").packed
+    assert struct.unpack("<i", attrs[nl.RTA_OIF])[0] == 7
+
+    # pop (PHP): no outgoing labels -> no RTA_NEWDST
+    pop = k._label_payload(10017, frozenset({Nexthop(addr=A("10.0.0.2"), ifname="eth0")}))
+    assert nl.RTA_NEWDST not in attrs_of(pop)
+
+    # FTN: IP route with a label push carries the MPLS encap
+    ip_payload = k._route_payload(N("7.7.7.7/32"), frozenset({nh}))
+    attrs = attrs_of(ip_payload)
+    assert struct.unpack("<H", attrs[nl.RTA_ENCAP_TYPE])[0] == nl.LWTUNNEL_ENCAP_MPLS
+    inner = attrs[nl.RTA_ENCAP]
+    ln, t = struct.unpack_from("<HH", inner, 0)
+    assert t == nl.MPLS_IPTUNNEL_DST
+    assert inner[4:4 + ln - 4] == nl.NetlinkKernel._mpls_stack((10042,))
+
+
+def test_ldp_lsp_end_to_end_lfib():
+    """3 LSRs in a chain: the transit router installs a swap LFIB entry,
+    the penultimate hop installs a pop (implicit-null from the egress)."""
+    import ipaddress
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ds = {}
+    for i, name in enumerate(("r1", "r2", "r3"), start=1):
+        d = Daemon(loop=loop, netio=fabric, name=name)
+        ds[name] = d
+    # chain links r1-r2 (10.0.12.0/30) and r2-r3 (10.0.23.0/30)
+    for proto in ("ospfv2", "ldp"):
+        fabric.join("l12", f"r1.{proto}", "e12", ipaddress.ip_address("10.0.12.1"))
+        fabric.join("l12", f"r2.{proto}", "e12", ipaddress.ip_address("10.0.12.2"))
+        fabric.join("l23", f"r2.{proto}", "e23", ipaddress.ip_address("10.0.23.2"))
+        fabric.join("l23", f"r3.{proto}", "e23", ipaddress.ip_address("10.0.23.3"))
+
+    def conf(d, rid, ifaces):
+        c = d.candidate()
+        for ifname, addr in ifaces:
+            c.set(f"interfaces/interface[{ifname}]/enabled", "true")
+            c.set(f"interfaces/interface[{ifname}]/address", [addr])
+        c.set("routing/control-plane-protocols/ospfv2/router-id", rid)
+        for ifname, _ in ifaces:
+            c.set(
+                "routing/control-plane-protocols/ospfv2/"
+                f"area[0.0.0.0]/interface[{ifname}]/interface-type",
+                "point-to-point",
+            )
+        c.set("routing/control-plane-protocols/ldp/lsr-id", rid)
+        c.set("routing/control-plane-protocols/ldp/enabled", "true")
+        for ifname, _ in ifaces:
+            c.set(
+                f"routing/control-plane-protocols/ldp/interface[{ifname}]/name",
+                ifname,
+            )
+        d.commit(c)
+
+    conf(ds["r1"], "1.1.1.1", [("e12", "10.0.12.1/30")])
+    conf(ds["r2"], "2.2.2.2", [("e12", "10.0.12.2/30"), ("e23", "10.0.23.2/30")])
+    # r3 also owns a far stub network (the LSP's egress FEC two hops from r1)
+    conf(ds["r3"], "3.3.3.3", [("e23", "10.0.23.3/30"), ("e30", "10.0.30.3/30")])
+    loop.advance(120)
+
+    far = N("10.0.30.0/30")
+    # r2 (penultimate hop): transit FEC with a REAL local label; r3's
+    # binding is implicit-null => pop entry (PHP), nexthop r3.
+    k2 = ds["r2"].routing.rib.kernel
+    pops = [
+        (label, nhs)
+        for label, nhs in k2.lfib.items()
+        if nhs and all(nh.labels == () for nh in nhs)
+    ]
+    assert pops, k2.lfib
+    assert any(
+        nh.addr == A("10.0.23.3") for _l, nhs in pops for nh in nhs
+    ), pops
+    # r1: swap entry toward r2 carrying r2's (real) label for the far FEC.
+    ldp2 = ds["r2"].routing.instances["ldp"]
+    r2_label = ldp2.fec_table[far][0]
+    k1 = ds["r1"].routing.rib.kernel
+    swaps = [
+        (label, nhs)
+        for label, nhs in k1.lfib.items()
+        if any(nh.labels == (r2_label,) for nh in nhs)
+    ]
+    assert swaps, (k1.lfib, r2_label)
+    for _l, nhs in swaps:
+        for nh in nhs:
+            assert nh.addr == A("10.0.12.2")
