@@ -160,10 +160,18 @@ def main(argv=None):
     monitor = None
     if os.geteuid() == 0:
         try:
-            from holo_tpu.routing.netlink import NetlinkMonitor, link_table
+            from holo_tpu.routing.netlink import (
+                LinkManager,
+                NetlinkMonitor,
+                link_table,
+            )
 
             monitor = NetlinkMonitor()
-            log.info("kernel interface monitor active")
+            # Real link actuation: VRRP macvlans + admin/MTU apply.
+            lm = LinkManager()
+            daemon.routing.link_mgr = lm
+            daemon.interface.link_mgr = lm
+            log.info("kernel interface monitor + link actuation active")
         except OSError as e:
             log.warning("kernel monitor unavailable: %s", e)
 

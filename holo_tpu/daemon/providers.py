@@ -65,6 +65,10 @@ class InterfaceProvider(Provider, Actor):
         # Set by the daemon: where connected (direct) routes are sent.
         self.routing_actor: str | None = None
         self._direct: set = set()  # prefixes currently installed as direct
+        # Set by the daemon when kernel actuation is available: config
+        # admin-status/MTU changes then apply via netlink (reference
+        # holo-interface/src/netlink.rs:242-270).
+        self.link_mgr = None
 
     def handle(self, msg):
         pass
@@ -108,8 +112,21 @@ class InterfaceProvider(Provider, Actor):
                 st = IfaceState(name=name, ifindex=self._next_ifindex)
                 self._next_ifindex += 1
                 self.interfaces[name] = st
-            st.mtu = entry.get("mtu", 1500)
-            st.enabled = entry.get("enabled", True)
+            new_mtu = entry.get("mtu", 1500)
+            new_enabled = entry.get("enabled", True)
+            if self.link_mgr is not None and (
+                new_mtu != st.mtu or new_enabled != st.enabled
+            ):
+                try:
+                    self.link_mgr.set_link(
+                        name,
+                        up=new_enabled if new_enabled != st.enabled else None,
+                        mtu=new_mtu if new_mtu != st.mtu else None,
+                    )
+                except OSError as e:
+                    log.error("link apply failed for %s: %s", name, e)
+            st.mtu = new_mtu
+            st.enabled = new_enabled
             st.addresses = [ip_interface(a) for a in entry.get("address", [])]
             self.ibus.publish(
                 TOPIC_INTERFACE_UPD,
@@ -355,12 +372,20 @@ class RoutingProvider(Provider, Actor):
         policy_engine=None,
         keychains: "KeychainProvider | None" = None,
         nvstore=None,
+        link_mgr=None,
     ):
         self.loop = loop
         self.ibus = ibus
         self.policy_engine = policy_engine
         self.keychains = keychains
         self.nvstore = nvstore
+        # Link actuation (macvlans, admin/MTU): LinkManager in production,
+        # MockLinkManager under test.
+        if link_mgr is None:
+            from holo_tpu.routing.netlink import MockLinkManager
+
+            link_mgr = MockLinkManager()
+        self.link_mgr = link_mgr
         # netio: either a NetIo (shared sender) or a callable actor->NetIo
         # (MockFabric.sender_for) so each protocol actor receives its own
         # bound transmit handle.
@@ -453,6 +478,7 @@ class RoutingProvider(Provider, Actor):
         self._apply_ospfv3(new)
         self._apply_isis(new)
         self._apply_bgp(new)
+        self._apply_vrrp(new)
         self._apply_ldp(new)
         self._apply_static(new)
 
@@ -910,6 +936,93 @@ class RoutingProvider(Provider, Actor):
             inst.add_interface(ifname, addr.ip)
             # Directly-attached networks are egress FECs (implicit null).
             inst.add_fec(addr.network, egress=True)
+
+    def _apply_vrrp(self, new):
+        """VRRP lifecycle: one instance per (interface, vrid).  The master
+        owns a macvlan carrying the virtual MAC 00:00:5e:00:01:<vrid> and
+        the virtual addresses (reference holo-vrrp/src/instance.rs:301-311
+        macvlan programming); backup/init tears it down."""
+        from ipaddress import ip_address
+
+        from holo_tpu.protocols.vrrp import VrrpConfig, VrrpInstance
+
+        base = "routing/control-plane-protocols/vrrp"
+        wanted = {}
+        for vrid_s, entry in (new.get(f"{base}/instance") or {}).items():
+            vrid = int(entry.get("vrid", vrid_s))
+            ifname = entry.get("interface")
+            if ifname is None:
+                continue
+            st = self.ifp.interfaces.get(ifname)
+            if st is None or not st.addresses:
+                continue
+            wanted[vrid] = (ifname, entry, st.addresses[0].ip)
+        have = getattr(self, "vrrp_instances", {})
+        self.vrrp_instances = have
+
+        def _stop(vrid):
+            inst = have.pop(vrid)
+            inst.shutdown()  # on_state(INITIALIZE) removes the macvlan
+            self.loop.unregister(inst.name)
+
+        for vrid in list(have.keys() - wanted.keys()):
+            _stop(vrid)
+        for vrid, (ifname, entry, addr) in wanted.items():
+            cfg = VrrpConfig(
+                vrid=vrid,
+                ifname=ifname,
+                version=int(entry.get("version", 3)),
+                priority=entry.get("priority", 100),
+                advert_interval=entry.get("advertise-interval", 1),
+                addresses=[
+                    ip_address(a) for a in entry.get("virtual-address", [])
+                ],
+            )
+            if vrid in have:
+                if have[vrid].config == cfg:
+                    continue
+                # Config changed: restart with the new parameters (the
+                # reference reconfigures the per-interface instance).
+                _stop(vrid)
+            actor = f"{self.prefix}vrrp-{ifname}-{vrid}"
+            inst = VrrpInstance(
+                name=actor,
+                config=cfg,
+                iface_addr=addr,
+                netio=self.netio_factory(actor),
+            )
+            inst.vrrp_ifname = ifname
+            inst.on_state = (
+                lambda state, i=inst: self._vrrp_state_changed(i, state)
+            )
+            self.loop.register(inst)
+            have[vrid] = inst
+            inst.startup()
+
+    def _vrrp_macvlan(self, inst) -> str:
+        # Kernel IFNAMSIZ is 16 incl. NUL; keep the vrid even when the
+        # parent name gets truncated.
+        return f"vrrp{inst.config.vrid}.{inst.vrrp_ifname}"[:15]
+
+    def _vrrp_state_changed(self, inst, state) -> None:
+        from ipaddress import ip_interface
+
+        from holo_tpu.protocols.vrrp import VrrpState
+
+        if self.link_mgr is None:
+            return
+        name = self._vrrp_macvlan(inst)
+        if state == VrrpState.MASTER:
+            # RFC 5798 §7.3 virtual MAC.
+            mac = bytes((0x00, 0x00, 0x5E, 0x00, 0x01, inst.config.vrid))
+            self.link_mgr.create_macvlan(inst.vrrp_ifname, name, mac)
+            for addr in inst.config.addresses:
+                self.link_mgr.add_address(
+                    name, ip_interface(f"{addr}/{addr.max_prefixlen}")
+                )
+            self.link_mgr.set_link(name, up=True)
+        else:
+            self.link_mgr.delete_link(name)
 
     def _apply_bgp(self, new):
         """BGP lifecycle from config (reference: holo-bgp spawn path).

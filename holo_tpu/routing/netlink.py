@@ -66,6 +66,15 @@ RTA_GATEWAY = 5
 RTA_PRIORITY = 6
 RTA_MULTIPATH = 9
 RTA_TABLE = 15
+IFLA_ADDRESS = 1
+IFLA_MTU = 4
+IFLA_LINK = 5
+IFLA_LINKINFO = 18
+IFLA_INFO_KIND = 1
+IFLA_INFO_DATA = 2
+IFLA_MACVLAN_MODE = 1
+MACVLAN_MODE_BRIDGE = 4
+IFF_UP = 1
 RTA_VIA = 18
 RTA_NEWDST = 19
 RTA_ENCAP_TYPE = 21
@@ -203,6 +212,109 @@ class LinkEvent:
     running: bool = False
     mtu: int = 0
     addr: object = None  # ip_interface for addr events
+
+
+class MockLinkManager:
+    """Test double for :class:`LinkManager` (records actuations)."""
+
+    def __init__(self):
+        self.links: dict[str, dict] = {}
+        self.log: list[tuple] = []
+
+    def create_macvlan(self, parent, name, mac=None):
+        self.links[name] = {"parent": parent, "mac": mac, "up": False,
+                            "addrs": []}
+        self.log.append(("create-macvlan", parent, name, mac))
+
+    def delete_link(self, name):
+        self.links.pop(name, None)
+        self.log.append(("delete-link", name))
+
+    def set_link(self, name, up=None, mtu=None):
+        if name not in self.links:
+            raise OSError(f"no such link {name!r}")
+        st = self.links[name]
+        if up is not None:
+            st["up"] = up
+        if mtu is not None:
+            st["mtu"] = mtu
+        self.log.append(("set-link", name, up, mtu))
+
+    def add_address(self, name, addr):
+        if name not in self.links:
+            raise OSError(f"no such link {name!r}")
+        self.links[name]["addrs"].append(addr)
+        self.log.append(("add-address", name, addr))
+
+
+class LinkManager:
+    """Link actuation: macvlan creation (VRRP virtual MACs), admin status
+    and MTU apply (reference holo-interface/src/netlink.rs:242-270 and the
+    macvlan path instance.rs:301-311)."""
+
+    def __init__(self, nl: NetlinkSocket | None = None):
+        self.nl = nl or NetlinkSocket()
+
+    def _ifindex(self, name: str) -> int | None:
+        return link_table(self.nl).get(name)
+
+    @staticmethod
+    def _ifinfomsg(ifindex: int = 0, flags: int = 0, change: int = 0) -> bytes:
+        return struct.pack("<BBHiII", socket.AF_UNSPEC, 0, 0, ifindex, flags, change)
+
+    def create_macvlan(
+        self, parent: str, name: str, mac: bytes | None = None
+    ) -> None:
+        parent_idx = self._ifindex(parent)
+        if parent_idx is None:
+            raise OSError(f"no such link {parent!r}")
+        payload = self._ifinfomsg()
+        payload += _attr(IFLA_IFNAME, name.encode() + b"\x00")
+        payload += _attr(IFLA_LINK, struct.pack("<i", parent_idx))
+        if mac is not None:
+            payload += _attr(IFLA_ADDRESS, mac)
+        info = _attr(IFLA_INFO_KIND, b"macvlan\x00")
+        info += _attr(
+            IFLA_INFO_DATA,
+            _attr(IFLA_MACVLAN_MODE, struct.pack("<I", MACVLAN_MODE_BRIDGE)),
+        )
+        payload += _attr(IFLA_LINKINFO, info)
+        self.nl.request_ack(RTM_NEWLINK, NLM_F_CREATE | NLM_F_REPLACE, payload)
+
+    def delete_link(self, name: str) -> None:
+        idx = self._ifindex(name)
+        if idx is None:
+            return
+        self.nl.request_ack(RTM_DELLINK, 0, self._ifinfomsg(ifindex=idx))
+
+    def set_link(
+        self, name: str, up: bool | None = None, mtu: int | None = None
+    ) -> None:
+        idx = self._ifindex(name)
+        if idx is None:
+            raise OSError(f"no such link {name!r}")
+        flags = change = 0
+        if up is not None:
+            change = IFF_UP
+            flags = IFF_UP if up else 0
+        payload = self._ifinfomsg(ifindex=idx, flags=flags, change=change)
+        if mtu is not None:
+            payload += _attr(IFLA_MTU, struct.pack("<I", mtu))
+        self.nl.request_ack(RTM_NEWLINK, 0, payload)
+
+    def add_address(self, name: str, addr) -> None:
+        """ip_interface-style addr on a link (the VRRP virtual IP)."""
+        idx = self._ifindex(name)
+        if idx is None:
+            raise OSError(f"no such link {name!r}")
+        family = socket.AF_INET if addr.version == 4 else socket.AF_INET6
+        payload = struct.pack(
+            "<BBBBi", family, addr.network.prefixlen, 0, 0, idx
+        )
+        IFA_LOCAL, IFA_ADDRESS = 2, 1
+        payload += _attr(IFA_LOCAL, addr.ip.packed)
+        payload += _attr(IFA_ADDRESS, addr.ip.packed)
+        self.nl.request_ack(RTM_NEWADDR, NLM_F_CREATE | NLM_F_REPLACE, payload)
 
 
 class NetlinkMonitor:

@@ -1,0 +1,151 @@
+"""Preemptive instance isolation: one event loop per OS thread.
+
+The reference runs every protocol instance on a dedicated OS thread via
+``spawn_blocking`` so a long computation in one instance cannot stall
+another's hello/dead-timer processing (holo-protocol/src/lib.rs:419-430;
+its ``testing`` feature downgrades to cooperative scheduling, exactly
+like our single EventLoop).  This module is the production-side analog:
+
+- :class:`ThreadedLoop` hosts ONE EventLoop (real clock) on its own
+  thread, waking on cross-thread sends and on timer deadlines;
+- :class:`ThreadedFabric` is a mock-wire variant whose delivery respects
+  each endpoint's owning loop, so instances on different threads exchange
+  real frames without sharing a scheduler.
+
+Python's GIL means CPU-bound work still serializes, but any blocking
+call (kernel IO, the TPU backend round-trip, a C extension releasing the
+GIL) no longer freezes unrelated instances — which is precisely the
+reference's isolation property.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import Actor, EventLoop, RealClock
+
+
+class ThreadedLoop:
+    """An EventLoop pumped by a dedicated thread.
+
+    ``send`` is thread-safe: it enqueues under the loop's lock and wakes
+    the pump.  All actor callbacks run on this loop's thread only — the
+    single-writer actor discipline is preserved per thread.
+    """
+
+    def __init__(self, name: str = "threaded-loop"):
+        self.loop = EventLoop(clock=RealClock())
+        self.name = name
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._pump, name=name, daemon=True
+        )
+
+    def start(self) -> "ThreadedLoop":
+        self._thread.start()
+        return self
+
+    def register(self, actor: Actor, name: str | None = None) -> None:
+        with self._lock:
+            self.loop.register(actor, name=name)
+
+    def send(self, actor: str, msg: Any) -> bool:
+        # Enqueue WITHOUT the lock (deque appends are GIL-atomic and the
+        # pump never holds the lock while running handlers — holding it
+        # there would AB-BA deadlock two loops sending to each other).
+        ok = self.loop.send(actor, msg)
+        with self._wake:
+            self._wake.notify()
+        return ok
+
+    def call(self, fn, *args) -> None:
+        """Run ``fn(*args)`` on the loop thread (setup helpers)."""
+        done = threading.Event()
+        box: list = []
+
+        class _Call(Actor):
+            name = f"_call_{id(done)}"
+
+            def handle(self, msg):
+                try:
+                    box.append(fn(*args))
+                finally:
+                    done.set()
+
+        with self._lock:
+            self.loop.register(_Call())
+        self.send(_Call.name, ())
+        done.wait(timeout=10)
+        with self._lock:
+            self.loop.unregister(_Call.name)
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify()
+        self._thread.join(timeout=5)
+
+    def _pump(self) -> None:
+        while True:
+            with self._wake:
+                if self._stop:
+                    return
+            # Handlers run with NO lock held: a handler's cross-loop send
+            # (fabric delivery to a peer loop) must never wait on us.
+            self.loop.run_until_idle()
+            nd = self.loop.next_deadline()
+            now = self.loop.clock.now()
+            timeout = max(nd - now, 0.0) if nd is not None else 0.5
+            with self._wake:
+                if self._stop:
+                    return
+                if not self.loop._ready:
+                    # A send landing between run_until_idle and here
+                    # leaves _ready non-empty and we skip the wait.
+                    self._wake.wait(timeout=min(timeout, 0.5))
+
+
+class ThreadedFabric:
+    """Mock wire for instances living on different :class:`ThreadedLoop`s.
+
+    Mirrors MockFabric's join/sender_for API; delivery posts to each
+    endpoint's OWN loop, crossing threads safely.
+    """
+
+    def __init__(self):
+        self._eps: dict[str, list] = {}  # link -> [(owner, actor, ifname, addr)]
+        self._lock = threading.Lock()
+
+    def join(
+        self, link: str, owner: ThreadedLoop, actor: str, ifname: str, addr
+    ) -> None:
+        with self._lock:
+            self._eps.setdefault(link, []).append((owner, actor, ifname, addr))
+
+    def sender_for(self, actor: str) -> NetIo:
+        fabric = self
+
+        class _Io(NetIo):
+            def send(self, ifname, src, dst, data):
+                fabric._send(actor, ifname, src, dst, data)
+
+        return _Io()
+
+    def _send(self, from_actor: str, ifname: str, src, dst, data) -> None:
+        with self._lock:
+            eps = [
+                e
+                for link, members in self._eps.items()
+                if any(a == from_actor and i == ifname for (_o, a, i, _ad) in members)
+                for e in members
+            ]
+        is_mcast = getattr(dst, "is_multicast", False)
+        for owner, actor, eifname, eaddr in eps:
+            if actor == from_actor:
+                continue
+            if is_mcast or eaddr == dst:
+                owner.send(actor, NetRxPacket(eifname, src, dst, data))
