@@ -40,6 +40,9 @@ class EventRecorderConfig:
 @dataclass
 class DaemonConfig:
     db_path: str | None = None
+    # Production hardening (holo-daemon/src/main.rs:28-209 equivalents).
+    lock_path: str | None = None  # flock single-instance (None = off)
+    user: str | None = None  # drop privileges to this user after setup
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     grpc: GrpcConfig = field(default_factory=GrpcConfig)
     gnmi: GnmiConfig = field(default_factory=GnmiConfig)
@@ -53,6 +56,9 @@ class DaemonConfig:
         raw = tomllib.loads(Path(path).read_text())
         if "database" in raw:
             cfg.db_path = raw["database"].get("path")
+        if "daemon" in raw:
+            cfg.lock_path = raw["daemon"].get("lock-path")
+            cfg.user = raw["daemon"].get("user")
         if "logging" in raw:
             for k in ("level", "style", "file"):
                 if k in raw["logging"]:

@@ -148,6 +148,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     cfg = DaemonConfig.load(args.config)
     logging.basicConfig(level=getattr(logging, cfg.logging.level.upper(), logging.INFO))
+    from holo_tpu.daemon import hardening
+
+    lock_fd = None
+    if cfg.lock_path:
+        lock_fd = hardening.acquire_instance_lock(cfg.lock_path)
     daemon = Daemon(config=cfg)
     if cfg.grpc.enabled:
         daemon.start_grpc()
@@ -175,10 +180,19 @@ def main(argv=None):
         except OSError as e:
             log.warning("kernel monitor unavailable: %s", e)
 
+    if cfg.user:
+        # Privileged sockets (raw, netlink, port 179) are open; drop now.
+        from holo_tpu.daemon import hardening
+
+        hardening.drop_privileges(cfg.user)
+    stopping = []
+    from holo_tpu.daemon import hardening as _h
+
+    _h.install_signal_handlers(lambda: stopping.append(True))
     try:
         import time
 
-        while True:
+        while not stopping:
             with daemon.lock:
                 if monitor is not None:
                     events = monitor.drain()
@@ -209,8 +223,13 @@ def main(argv=None):
                 wait_ready([tcp], int(wait * 1000))
             else:
                 time.sleep(wait)
+        daemon.stop()
+        log.info("daemon stopped")
     except KeyboardInterrupt:
         daemon.stop()
+    finally:
+        if lock_fd is not None:
+            os.close(lock_fd)
 
 
 if __name__ == "__main__":
