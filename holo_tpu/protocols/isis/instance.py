@@ -17,6 +17,8 @@ from holo_tpu.ops.graph import INF, Topology, mutual_keep_mask
 from holo_tpu.protocols.isis.packet import (
     LSP_MAX_AGE,
     LSP_REFRESH,
+    MAX_NARROW_METRIC,
+    PREFIX_ATTR_N,
     AdjState3Way,
     ExtIpReach,
     ExtIsReach,
@@ -63,6 +65,10 @@ class IsisIfConfig:
     # packet.AuthCtxIsis: hello authentication on this circuit (LSPs/SNPs
     # use the instance-level area auth).
     auth: object = None
+    # Passive circuits (loopbacks): prefixes are advertised but no
+    # hellos are sent and no adjacencies form.
+    passive: bool = False
+    loopback: bool = False  # RFC 7794 N-flag eligibility
 
 
 @dataclass
@@ -74,6 +80,12 @@ class Adjacency:
     addr6: object = None  # neighbor's link-local (RFC 5308 v6 next hop)
     priority: int = 64
     lan_id: bytes = b""  # DIS the neighbor declares
+    snpa: bytes = b""  # neighbor's MAC (LAN 2-way check, hello TLV 6)
+    # Last hello's TLV contents surfaced in operational state.
+    area_addresses: tuple = ()
+    protocols: tuple = ()
+    addrs4: tuple = ()
+    addrs6: tuple = ()
 
 
 @dataclass
@@ -84,6 +96,11 @@ class IsisInterface:
     prefix: IPv4Network
     addr6: object = None  # our link-local (RFC 5308 hello TLV 232)
     prefix6: object = None  # advertised global v6 prefix (TLV 236)
+    # Full address lists (ip_interface objects); when empty the single
+    # addr_ip/prefix (+prefix6) pair above is the effective list.
+    addrs4: list = field(default_factory=list)
+    addrs6: list = field(default_factory=list)  # global v6
+    mac: bytes = b""  # our SNPA on this circuit
     circuit_id: int = 1
     adj: Adjacency | None = None  # p2p: single adjacency
     adjs: dict = field(default_factory=dict)  # LAN: sysid -> Adjacency
@@ -94,6 +111,18 @@ class IsisInterface:
     @property
     def is_lan(self) -> bool:
         return self.config.circuit_type == "broadcast"
+
+    def v4_addresses(self) -> list:
+        """[(ip, network)] — every IPv4 address on this circuit."""
+        if self.addrs4:
+            return [(ia.ip, ia.network) for ia in self.addrs4]
+        return [(self.addr_ip, self.prefix)] if self.prefix is not None else []
+
+    def v6_addresses(self) -> list:
+        """[(ip|None, network)] — global IPv6 addresses."""
+        if self.addrs6:
+            return [(ia.ip, ia.network) for ia in self.addrs6]
+        return [(None, self.prefix6)] if self.prefix6 is not None else []
 
     def up_adjacencies(self) -> list:
         if self.is_lan:
@@ -178,6 +207,12 @@ class IsisInstance(Actor):
         auth=None,
         mt_enabled: bool = False,
         sr=None,
+        metric_style: str = "wide",  # "wide" | "narrow" | "both"
+        lsp_mtu: int | None = None,  # originate lsp-buf-size TLV when set
+        te_rid4: IPv4Address | None = None,  # RFC 7794 source-rid stlvs
+        te_rid6=None,
+        protocols: list | None = None,  # NLPID list override ([0xCC,0x8E])
+        node_flag: bool = True,  # RFC 7794 N on loopback host prefixes
     ):
         assert len(sysid) == 6
         self.name = name
@@ -197,6 +232,22 @@ class IsisInstance(Actor):
         # reach entries (RFC 8667; reference holo-isis/src/sr.rs).
         self.sr = sr
         self.sr_labels: dict = {}
+        self.metric_style = metric_style
+        self.lsp_mtu = lsp_mtu
+        self.te_rid4 = te_rid4
+        self.te_rid6 = te_rid6
+        self.protocols = protocols
+        self.node_flag = node_flag
+        # Deferred origination (the reference's LspOriginate task model):
+        # when True, non-forced origination only marks pending; the
+        # conformance replay fires originate_pending() at the recorded
+        # LspOriginate events so seqnos — and therefore LSP bytes and
+        # checksums — match the reference's exactly.
+        self.deferred_origination = False
+        self._orig_pending = False
+        # Purges of self-originated fragments we never originate: kept
+        # out of the LSDB but flooded via SRM (events.rs:734-740).
+        self._srm_phantom: dict = {}
         # lsp_id -> unauthenticated TLV bytes of our last origination
         # (content-unchanged suppression; see _originate_lsp).
         self._plain_raw: dict = {}
@@ -224,11 +275,12 @@ class IsisInstance(Actor):
         self._flood_timer = self.loop.timer(self.name, FloodTimerMsg)
         self._spf_timer = self.loop.timer(self.name, SpfTimerMsg)
 
-    def add_interface(self, ifname: str, cfg: IsisIfConfig, addr: IPv4Address, prefix: IPv4Network, addr6=None, prefix6=None):
+    def add_interface(self, ifname: str, cfg: IsisIfConfig, addr: IPv4Address, prefix: IPv4Network, addr6=None, prefix6=None, addrs4=None, addrs6=None, mac: bytes = b"", circuit_id: int | None = None):
         self.interfaces[ifname] = IsisInterface(
             name=ifname, config=cfg, addr_ip=addr, prefix=prefix,
             addr6=addr6, prefix6=prefix6,
-            circuit_id=len(self.interfaces) + 1,
+            addrs4=list(addrs4 or []), addrs6=list(addrs6 or []), mac=mac,
+            circuit_id=circuit_id or (len(self.interfaces) + 1),
         )
 
     # -- actor
@@ -275,7 +327,7 @@ class IsisInstance(Actor):
 
     def _send_hello(self, ifname: str) -> None:
         iface = self.interfaces.get(ifname)
-        if iface is None:
+        if iface is None or iface.config.passive:
             return
         if iface.is_lan:
             from holo_tpu.protocols.isis.packet import HelloLan
@@ -293,13 +345,16 @@ class IsisInstance(Actor):
                 level=self.level,
                 tlvs={
                     "area_addresses": [self.area],
-                    "protocols_supported": [0xCC],
-                    "ip_addresses": [iface.addr_ip],
+                    "protocols_supported": self.protocols or [0xCC],
+                    "ip_addresses": [ip for ip, _ in iface.v4_addresses()],
                     "ipv6_addresses": (
                         [iface.addr6] if iface.addr6 is not None else []
                     ),
-                    # SNPAs on the fabric are system ids.
-                    "is_neighbors": sorted(iface.adjs.keys()),
+                    # Heard SNPAs: neighbor MACs when known, else the
+                    # mock fabric's system-id stand-ins.
+                    "is_neighbors": sorted(
+                        a.snpa or a.sysid for a in iface.adjs.values()
+                    ),
                 },
             )
             self.netio.send(
@@ -345,9 +400,25 @@ class IsisInstance(Actor):
             iface._hello_timer = t
         t.start(iface.config.hello_interval)
 
+    @staticmethod
+    def _adj_learn_tlvs(adj: Adjacency, hello) -> None:
+        """Record the neighbor's hello TLVs on the adjacency (next hops
+        + operational state)."""
+        addrs = hello.tlvs.get("ip_addresses") or []
+        if addrs:
+            adj.addr = addrs[0]
+        for a6 in hello.tlvs.get("ipv6_addresses") or []:
+            if a6.is_link_local:
+                adj.addr6 = a6
+                break
+        adj.area_addresses = tuple(hello.tlvs.get("area_addresses") or ())
+        adj.protocols = tuple(hello.tlvs.get("protocols_supported") or ())
+        adj.addrs4 = tuple(addrs)
+        adj.addrs6 = tuple(hello.tlvs.get("ipv6_addresses") or ())
+
     # -- LAN hellos + DIS election (ISO 10589 §8.4.5)
 
-    def _rx_hello_lan(self, iface: IsisInterface, hello) -> None:
+    def _rx_hello_lan(self, iface: IsisInterface, hello, snpa: bytes = b"") -> None:
         if hello.sysid == self.sysid:
             return
         adj = iface.adjs.get(hello.sysid)
@@ -357,17 +428,17 @@ class IsisInstance(Actor):
         adj.hold_time = hello.hold_time
         adj.priority = hello.priority
         adj.lan_id = hello.lan_id
-        addrs = hello.tlvs.get("ip_addresses") or []
-        if addrs:
-            adj.addr = addrs[0]
-        for a6 in hello.tlvs.get("ipv6_addresses") or []:
-            if a6.is_link_local:
-                adj.addr6 = a6
-                break
+        if snpa:
+            adj.snpa = snpa
+        self._adj_learn_tlvs(adj, hello)
         old = adj.state
+        # ISO 10589 §8.4.2 two-way check: our SNPA in their IS-Neighbors
+        # TLV.  Our SNPA is the interface MAC when known (real circuits /
+        # replay), else the system id (mock fabric).
+        our_snpa = iface.mac or self.sysid
         new = (
             AdjacencyState.UP
-            if self.sysid in (hello.tlvs.get("is_neighbors") or [])
+            if our_snpa in (hello.tlvs.get("is_neighbors") or [])
             else AdjacencyState.INITIALIZING
         )
         adj.state = new
@@ -386,8 +457,18 @@ class IsisInstance(Actor):
             self._lan_adj_up(iface, adj)
 
     def _run_dis_election(self, iface: IsisInterface) -> None:
+        ups = iface.up_adjacencies()
+        if not ups:
+            # ISO 10589 §8.4.5: no adjacencies — the LAN has no DIS;
+            # purge our pseudonode if we held the role.
+            if iface.we_are_dis(self.sysid, iface.circuit_id):
+                self._flush_pseudonode(iface)
+            if iface.dis_lan_id is not None:
+                iface.dis_lan_id = None
+                self._adj_changed()
+            return
         cands = [(iface.config.priority, self.sysid)]
-        for adj in iface.up_adjacencies():
+        for adj in ups:
             cands.append((adj.priority, adj.sysid))
         prio, winner = max(cands)
         new_lan_id = (
@@ -446,14 +527,83 @@ class IsisInstance(Actor):
             self._send_csnp(iface)
             iface._csnp_timer.start(30.0)
 
+    # -- deferred-event entry points (the reference models these as
+    # dedicated tasks; the conformance replay drives them directly)
+
+    def send_psnp(self, ifname: str) -> None:
+        """Flush this circuit's SSN list as one PSNP (SendPsnp task)."""
+        iface = self.interfaces.get(ifname)
+        if iface is not None:
+            self._flush_ssn(iface)
+
+    def _flush_ssn(self, iface: IsisInterface) -> None:
+        now = self.loop.clock.now()
+        entries = []
+        for lid in sorted(iface.ssn):
+            e = self.lsdb.get(lid)
+            if e is not None:
+                entries.append(
+                    (e.remaining_lifetime(now), lid, e.lsp.seqno, e.lsp.cksum)
+                )
+            iface.ssn.discard(lid)
+        if entries:
+            snp = Snp(self.level, False, self.sysid, entries)
+            self.netio.send(
+                iface.name, iface.addr_ip, ALL_ISS,
+                snp.encode(auth=self.auth),
+            )
+
+    def send_csnp(self, ifname: str) -> None:
+        """Describe the full LSDB on this circuit (SendCsnp task)."""
+        iface = self.interfaces.get(ifname)
+        if iface is not None:
+            self._send_csnp(iface)
+
+    def run_dis_election(self, ifname: str) -> None:
+        iface = self.interfaces.get(ifname)
+        if iface is not None and iface.is_lan:
+            self._run_dis_election(iface)
+
+    def set_hostname(self, hostname: str) -> None:
+        """RFC 5301: our dynamic hostname changed; re-originate."""
+        if hostname != self.hostname:
+            self.hostname = hostname
+            self._originate_lsp()
+
+    def refresh_lsp(self, lid: LspId) -> None:
+        """Periodic refresh of one self-originated LSP (seqno bump even
+        with unchanged content)."""
+        if lid.sysid != self.sysid:
+            return
+        if lid.pseudonode == 0:
+            self._originate_lsp(force=True)
+        else:
+            self._originate_pseudonodes(force=True)
+
+    def purge_lsp(self, lid: LspId) -> None:
+        """ISO 10589 §7.3.16.4 purge: flood a body-less zero-lifetime
+        header so neighbors drop the LSP too (the reference's LspPurge
+        event on expiry)."""
+        e = self.lsdb.get(lid)
+        if e is None:
+            return
+        dead = Lsp(self.level, 0, lid, e.lsp.seqno, e.lsp.flags, {})
+        dead.encode(auth=self.auth)
+        # §7.3.16.4: the purge advertises the original checksum.  Patch
+        # the wire bytes too so SNP descriptions and the flooded PDU
+        # agree (zero-lifetime LSPs skip checksum verification).
+        dead.cksum = e.lsp.cksum
+        raw = bytearray(dead.raw)
+        raw[24:26] = e.lsp.cksum.to_bytes(2, "big")
+        dead.raw = bytes(raw)
+        self._install_lsp(dead, flood_from=None)
+
     def _flush_pseudonode(self, iface: IsisInterface) -> None:
         lsp_id = LspId(self.sysid, pseudonode=iface.circuit_id)
         e = self.lsdb.get(lsp_id)
         if e is not None and e.lsp.lifetime > 0:
-            dead = Lsp(self.level, 0, lsp_id, e.lsp.seqno + 1, e.lsp.flags,
-                       e.lsp.tlvs)
-            dead.encode(auth=self.auth)
-            self._install_lsp(dead, flood_from=None)
+            self.purge_lsp(lsp_id)
+            self._plain_raw.pop(lsp_id, None)
 
     def _rx_hello(self, iface: IsisInterface, hello: HelloP2p) -> None:
         if hello.sysid == self.sysid:
@@ -463,17 +613,14 @@ class IsisInstance(Actor):
             adj = Adjacency(sysid=hello.sysid)
             iface.adj = adj
         adj.hold_time = hello.hold_time
-        addrs = hello.tlvs.get("ip_addresses") or []
-        if addrs:
-            adj.addr = addrs[0]
-        for a6 in hello.tlvs.get("ipv6_addresses") or []:
-            if a6.is_link_local:
-                adj.addr6 = a6
-                break
+        self._adj_learn_tlvs(adj, hello)
         p2p = hello.tlvs.get("p2p_adj")
-        they_see_us = p2p is not None and p2p.neighbor_sysid == self.sysid
         old = adj.state
-        if they_see_us:
+        if p2p is None:
+            # Classic ISO 10589 §8.2.4 p2p: no three-way TLV, the
+            # adjacency comes up on hello receipt.
+            new = AdjacencyState.UP
+        elif p2p.neighbor_sysid == self.sysid:
             new = AdjacencyState.UP
         else:
             new = AdjacencyState.INITIALIZING
@@ -539,11 +686,18 @@ class IsisInstance(Actor):
         skip (periodic refresh MUST bump seqno even with identical TLVs or
         neighbors age us out); ``min_seqno`` outpaces a stale incarnation
         seen in the network (ISO 10589 §7.3.16.1)."""
+        if self.deferred_origination and not force:
+            self._orig_pending = True
+            return
         lsp_id = LspId(self.sysid)
         old = self.lsdb.get(lsp_id)
+        wide = self.metric_style in ("wide", "both")
+        narrow = self.metric_style in ("narrow", "both")
         is_reach = []
-        ip_reach = []
-        ip6_reach = []
+        narrow_is = []
+        ip4_addrs: list = []
+        ip4_prefixes: dict = {}  # prefix -> metric (BTreeMap dedup)
+        ip6_reach_map: dict = {}
         ip6_addrs = []
         sids = (
             self.sr.prefix_sids
@@ -551,40 +705,117 @@ class IsisInstance(Actor):
             else {}
         )
         for iface in self.interfaces.values():
-            psid = sids.get(iface.prefix)
-            ip_reach.append(
-                ExtIpReach(
-                    iface.prefix,
-                    iface.config.metric,
-                    sid_index=psid.index if psid is not None else None,
-                )
-            )
-            if iface.prefix6 is not None:
-                ip6_reach.append(
-                    ExtIpReach(iface.prefix6, iface.config.metric)
-                )
+            metric = iface.config.metric
+            for ip, net in iface.v4_addresses():
+                if ip not in ip4_addrs:
+                    ip4_addrs.append(ip)
+                ip4_prefixes.setdefault(net, (metric, iface))
+            for ip6, net6 in iface.v6_addresses():
+                if ip6 is not None and ip6 not in ip6_addrs:
+                    ip6_addrs.append(ip6)
+                if net6 is not None and net6 not in ip6_reach_map:
+                    attr = 0
+                    if (
+                        self.node_flag
+                        and iface.config.loopback
+                        and net6.prefixlen == 128
+                    ):
+                        attr |= PREFIX_ATTR_N
+                    psid6 = sids.get(net6)
+                    ip6_reach_map[net6] = ExtIpReach(
+                        net6, metric,
+                        sid_index=psid6.index if psid6 is not None else None,
+                        attr_flags=attr or None,
+                        src_rid4=self.te_rid4,
+                        src_rid6=self.te_rid6,
+                    )
             if iface.addr6 is not None:
-                ip6_addrs.append(iface.addr6)
+                lla = iface.addr6
+                if lla not in ip6_addrs and not lla.is_link_local:
+                    ip6_addrs.append(lla)
             if iface.is_lan:
                 if iface.dis_lan_id is not None and iface.up_adjacencies():
                     # LAN: advertise reach to the pseudonode.
-                    is_reach.append(
-                        ExtIsReach(iface.dis_lan_id, iface.config.metric)
-                    )
+                    if wide:
+                        is_reach.append(
+                            ExtIsReach(iface.dis_lan_id, metric)
+                        )
+                    if narrow:
+                        narrow_is.append(
+                            ExtIsReach(
+                                iface.dis_lan_id,
+                                min(metric, MAX_NARROW_METRIC),
+                            )
+                        )
             elif iface.adj is not None and iface.adj.state == AdjacencyState.UP:
-                is_reach.append(
-                    ExtIsReach(iface.adj.sysid + b"\x00", iface.config.metric)
+                if wide:
+                    is_reach.append(
+                        ExtIsReach(iface.adj.sysid + b"\x00", metric)
+                    )
+                if narrow:
+                    narrow_is.append(
+                        ExtIsReach(
+                            iface.adj.sysid + b"\x00",
+                            min(metric, MAX_NARROW_METRIC),
+                        )
+                    )
+        ip_reach = []
+        narrow_ip = []
+        for net in sorted(ip4_prefixes, key=lambda p: (int(p.network_address), p.prefixlen)):
+            metric, iface = ip4_prefixes[net]
+            if wide:
+                attr = 0
+                if (
+                    self.node_flag
+                    and iface.config.loopback
+                    and net.prefixlen == 32
+                ):
+                    attr |= PREFIX_ATTR_N
+                psid = sids.get(net)
+                ip_reach.append(
+                    ExtIpReach(
+                        net, metric,
+                        sid_index=psid.index if psid is not None else None,
+                        attr_flags=attr or None,
+                        src_rid4=self.te_rid4,
+                        src_rid6=self.te_rid6,
+                    )
                 )
-        protos = [0xCC] + ([0x8E] if (ip6_reach or ip6_addrs) else [])
+            if narrow:
+                narrow_ip.append(
+                    ExtIpReach(net, min(metric, MAX_NARROW_METRIC))
+                )
+        ip6_reach = [
+            ip6_reach_map[p]
+            for p in sorted(
+                ip6_reach_map,
+                key=lambda p: (int(p.network_address), p.prefixlen),
+            )
+        ]
+        ip4_addrs.sort(key=int)
+        ip6_addrs.sort(key=int)
+        if self.protocols is not None:
+            protos = list(self.protocols)
+        else:
+            protos = [0xCC] + ([0x8E] if (ip6_reach or ip6_addrs) else [])
         tlvs = {
             "area_addresses": [self.area],
             "protocols_supported": protos,
             "hostname": self.hostname,
             "ext_is_reach": is_reach,
             "ext_ip_reach": ip_reach,
+            "narrow_is_reach": narrow_is,
+            "narrow_ip_reach": narrow_ip,
+            "ip_addresses": ip4_addrs,
             "ipv6_reach": ip6_reach,
             "ipv6_addresses": ip6_addrs,
         }
+        if self.te_rid4 is not None:
+            tlvs["ipv4_router_id"] = self.te_rid4
+        if self.te_rid6 is not None:
+            tlvs["ipv6_router_id"] = self.te_rid6
+        if self.lsp_mtu is not None:
+            tlvs["lsp_buf_size"] = self.lsp_mtu
         if self.sr is not None and self.sr.enabled:
             tlvs["sr_cap"] = (self.sr.srgb.lower, self.sr.srgb.size)
         if self.mt_enabled:
@@ -610,6 +841,16 @@ class IsisInstance(Actor):
         self._install_lsp(lsp, flood_from=None)
         self._originate_pseudonodes()
 
+    def originate_pending(self) -> None:
+        """Run a deferred origination now (recorded LspOriginate event)."""
+        self._orig_pending = False
+        saved = self.deferred_origination
+        self.deferred_origination = False
+        try:
+            self._originate_lsp()
+        finally:
+            self.deferred_origination = saved
+
     def _originate_pseudonodes(self, force: bool = False) -> None:
         """DIS duty: one pseudonode LSP per LAN we are DIS of, listing all
         members (incl. ourselves) at metric 0.  ``force`` bypasses the
@@ -621,12 +862,16 @@ class IsisInstance(Actor):
             ):
                 continue
             lsp_id = LspId(self.sysid, pseudonode=iface.circuit_id)
-            members = [self.sysid + b"\x00"] + [
+            # Reference member order (lsdb.rs lsp_build_tlvs_pseudo):
+            # adjacencies in arena (first-heard) order, ourselves last.
+            members = [
                 a.sysid + b"\x00" for a in iface.up_adjacencies()
-            ]
-            tlvs = {
-                "ext_is_reach": [ExtIsReach(m, 0) for m in sorted(members)],
-            }
+            ] + [self.sysid + b"\x00"]
+            tlvs = {"protocols_supported": []}
+            if self.metric_style in ("wide", "both"):
+                tlvs["ext_is_reach"] = [ExtIsReach(m, 0) for m in members]
+            if self.metric_style in ("narrow", "both"):
+                tlvs["narrow_is_reach"] = [ExtIsReach(m, 0) for m in members]
             old = self.lsdb.get(lsp_id)
             seqno = (old.lsp.seqno + 1) if old else 1
             lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
@@ -677,35 +922,37 @@ class IsisInstance(Actor):
         if not self._flood_timer.armed:
             self._flood_timer.start(0.05)
 
-    def _flush_flooding(self) -> None:
+    def _flush_flooding(self, srm_only: bool = False) -> None:
         now = self.loop.clock.now()
         for iface in self.interfaces.values():
             if iface.srm:
-                for lid in list(iface.srm)[:10]:
+                for lid in list(iface.srm):
                     e = self.lsdb.get(lid)
                     if e is None:
-                        iface.srm.discard(lid)
-                        continue
-                    self.netio.send(iface.name, iface.addr_ip, ALL_ISS, e.lsp.raw)
-                # p2p: keep SRM set until PSNP ack clears it (§7.3.15.1);
-                # rearm to retransmit.
-            if iface.ssn:
-                entries = []
-                for lid in sorted(iface.ssn):
-                    e = self.lsdb.get(lid)
-                    if e is not None:
-                        entries.append(
-                            (e.remaining_lifetime(now), lid, e.lsp.seqno, e.lsp.cksum)
+                        ph = self._srm_phantom.get(lid)
+                        if ph is None or not ph.raw:
+                            iface.srm.discard(lid)
+                            continue
+                        self.netio.send(
+                            iface.name, iface.addr_ip, ALL_ISS, ph.raw
                         )
-                    iface.ssn.discard(lid)
-                if entries:
-                    snp = Snp(self.level, False, self.sysid, entries)
-                    self.netio.send(
-                        iface.name, iface.addr_ip, ALL_ISS,
-                        snp.encode(auth=self.auth),
-                    )
+                        if iface.is_lan:
+                            iface.srm.discard(lid)
+                        continue
+                    if not e.lsp.raw:
+                        continue  # zero-seqno placeholder: nothing to send
+                    self.netio.send(iface.name, iface.addr_ip, ALL_ISS, e.lsp.raw)
+                    if iface.is_lan:
+                        # §7.3.15.1: broadcast circuits clear SRM after
+                        # transmit (the DIS's CSNPs recover losses);
+                        # p2p keeps it until the PSNP ack.
+                        iface.srm.discard(lid)
+            if srm_only:
+                continue
+            if iface.ssn:
+                self._flush_ssn(iface)
         if any(i.srm for i in self.interfaces.values()):
-            self._flood_timer.start(5.0)  # retransmit interval
+            self._flood_timer.start(5.0)  # p2p retransmit interval
 
     # -- rx dispatch
 
@@ -731,6 +978,15 @@ class IsisInstance(Actor):
             pdu_type, pdu = decode_pdu(msg.data, auth=rx_auth)
         except DecodeError:
             return
+        snpa = msg.src if isinstance(msg.src, bytes) else b""
+        self.rx_pdu(msg.ifname, pdu_type, pdu, snpa)
+
+    def rx_pdu(self, ifname: str, pdu_type: PduType, pdu, snpa: bytes = b"") -> None:
+        """Dispatch one decoded PDU (the conformance replay feeds decoded
+        objects directly, like the reference's testing stub)."""
+        iface = self.interfaces.get(ifname)
+        if iface is None or iface.config.passive:
+            return
         if pdu_type == PduType.HELLO_P2P:
             if iface.is_lan:
                 return  # circuit-type mismatch: drop (misconfigured peer)
@@ -738,7 +994,7 @@ class IsisInstance(Actor):
         elif pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
             if not iface.is_lan:
                 return
-            self._rx_hello_lan(iface, pdu)
+            self._rx_hello_lan(iface, pdu, snpa)
         elif pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
             self._rx_lsp(iface, pdu)
         elif pdu_type in (PduType.CSNP_L1, PduType.CSNP_L2):
@@ -750,14 +1006,49 @@ class IsisInstance(Actor):
         if not iface.up_adjacencies():
             return
         cur = self.lsdb.get(lsp.lsp_id)
-        # Self-originated received newer: outpace it (§7.3.16.1) — also
-        # when we hold no copy (restart case: stale incarnation in the
-        # network must not outlive our fresh origination).
-        if lsp.lsp_id.sysid == self.sysid:
-            if cur is None or lsp.seqno >= cur.lsp.seqno:
-                self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
-            return
         now = self.loop.clock.now()
+        # LSP expiration synchronization (ISO 10589 §7.3.16.4.a): an
+        # expired LSP we have no copy of is never installed; on p2p
+        # circuits it is acknowledged directly with a PSNP.
+        if lsp.is_expired and cur is None:
+            if not iface.is_lan:
+                snp = Snp(
+                    self.level, False, self.sysid,
+                    [(0, lsp.lsp_id, lsp.seqno, lsp.cksum)],
+                )
+                self.netio.send(
+                    iface.name, iface.addr_ip, ALL_ISS,
+                    snp.encode(auth=self.auth),
+                )
+            return
+        # Self-originated received NEWER: outpace it (§7.3.16.1) — also
+        # when we hold no copy (restart case: stale incarnation in the
+        # network must not outlive our fresh origination).  An EQUAL or
+        # older copy flows through the generic comparison below (equal =
+        # implicit ack; older = send ours back).
+        if lsp.lsp_id.sysid == self.sysid:
+            if cur is None:
+                # A fragment we don't currently originate: purge the
+                # received incarnation network-wide without installing
+                # it (reference events.rs:734-740).  The LSP checksum
+                # excludes the lifetime field, so zeroing it in place
+                # keeps the signature valid.
+                lsp.lifetime = 0
+                if lsp.raw:
+                    raw = bytearray(lsp.raw)
+                    raw[10:12] = b"\x00\x00"
+                    lsp.raw = bytes(raw)
+                self._srm_phantom[lsp.lsp_id] = lsp
+                for other in self.interfaces.values():
+                    if other.up_adjacencies():
+                        other.srm.add(lsp.lsp_id)
+                self._arm_flood()
+                return
+            if lsp.compare(
+                cur.remaining_lifetime(now), cur.lsp.seqno, cur.lsp.cksum
+            ) > 0:
+                self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
+                return
         if cur is None:
             c = 1
         else:
@@ -767,13 +1058,50 @@ class IsisInstance(Actor):
         if c > 0:
             self._install_lsp(lsp, flood_from=iface.name)
         elif c == 0:
+            if cur is not None and cur.lsp.cksum != lsp.cksum and cur.lsp.seqno != 0:
+                # LSP confusion (§7.3.16.2): same seqno, different
+                # contents.  Our own LSP skips ahead a seqno; a received
+                # one is treated as expired and purged.
+                if lsp.lsp_id.sysid == self.sysid:
+                    self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
+                else:
+                    self.purge_lsp(lsp.lsp_id)
+                return
             iface.srm.discard(lsp.lsp_id)
-            iface.ssn.add(lsp.lsp_id)
+            if not iface.is_lan:
+                iface.ssn.add(lsp.lsp_id)
             self._arm_flood()
         else:
             # Ours is newer: send it back.
             iface.srm.add(lsp.lsp_id)
             self._arm_flood()
+
+    def _snp_entry_update(self, iface: IsisInterface, lid: LspId, lt: int, seq: int, ck: int) -> None:
+        """Apply one SNP entry against the stored LSP (reference
+        events.rs process_pdu_snp comparison block)."""
+        e = self.lsdb.get(lid)
+        if e is None:
+            return
+        c = e.lsp.compare(lt, seq)
+        if c == 0:
+            if e.lsp.cksum != ck and e.lsp.seqno != 0:
+                # LSP confusion (ISO 10589 §7.3.16.2): a received LSP is
+                # treated as expired (purge); a self-originated one
+                # skips ahead a sequence number.
+                if lid.sysid == self.sysid:
+                    self.refresh_lsp(lid)
+                else:
+                    self.purge_lsp(lid)
+            else:
+                iface.srm.discard(lid)  # implicit ack
+        elif c > 0:
+            iface.ssn.discard(lid)
+            iface.srm.add(lid)  # they have older: send ours
+        else:
+            # §7.3.15.2(c): they described a newer incarnation —
+            # request it (SSN) and stop offering ours.
+            iface.srm.discard(lid)
+            iface.ssn.add(lid)
 
     def _rx_csnp(self, iface: IsisInterface, snp: Snp) -> None:
         now = self.loop.clock.now()
@@ -784,15 +1112,7 @@ class IsisInstance(Actor):
                 iface.srm.add(lid)
             else:
                 lt, seq, ck = described[lid]
-                c = e.lsp.compare(lt, seq, ck)
-                if c > 0:
-                    iface.srm.add(lid)
-                elif c < 0:
-                    iface.ssn.add(lid)  # request newer via PSNP
-                else:
-                    # Equal: the CSNP is an implicit ack (LAN flooding
-                    # reliability rides the DIS's periodic CSNPs).
-                    iface.srm.discard(lid)
+                self._snp_entry_update(iface, lid, lt, seq, ck)
         # LSPs they described that we lack: request via PSNP with seqno 0.
         missing = [
             (0, lid, 0, 0) for lid in described if lid not in self.lsdb
@@ -810,12 +1130,29 @@ class IsisInstance(Actor):
         for lt, lid, seq, ck in snp.entries:
             e = self.lsdb.get(lid)
             if e is None:
+                # Acknowledge outstanding phantom purges (stale
+                # self-originated fragments we flooded as expired).
+                if lid in self._srm_phantom:
+                    iface.srm.discard(lid)
+                    if not any(
+                        lid in i.srm for i in self.interfaces.values()
+                    ):
+                        del self._srm_phantom[lid]
+                    continue
+                # ISO 10589 §7.3.15.2(b): an entry for an LSP we lack
+                # (all of lifetime/seqno/cksum nonzero) creates a
+                # zero-seqno placeholder and requests it via SSN.
+                if (
+                    lt and seq and ck
+                    and not iface.is_lan
+                    and lid.sysid != self.sysid
+                ):
+                    ph = Lsp(self.level, 0, lid, 0, 0)
+                    self.lsdb[lid] = LspEntry(ph, now)
+                    iface.ssn.add(lid)
+                    self._arm_flood()
                 continue
-            c = e.lsp.compare(lt, seq, ck)
-            if c == 0:
-                iface.srm.discard(lid)  # ack
-            elif c > 0:
-                iface.srm.add(lid)  # they asked / have older
+            self._snp_entry_update(iface, lid, lt, seq, ck)
         self._arm_flood()
 
     # -- aging
@@ -825,6 +1162,7 @@ class IsisInstance(Actor):
         for lid, e in list(self.lsdb.items()):
             if (
                 lid.sysid == self.sysid
+                and e.lsp.seqno > 0
                 and e.remaining_lifetime(now) < (LSP_MAX_AGE - LSP_REFRESH)
             ):
                 # Periodic refresh: force a seqno bump even with unchanged
@@ -863,6 +1201,12 @@ class IsisInstance(Actor):
             node["is"].extend(tlvs.get("ext_is_reach", []))
             node["ip"].extend(tlvs.get("ext_ip_reach", []))
             node["ip6"].extend(tlvs.get("ipv6_reach", []))
+            # Narrow-metric TLVs (2/128/130) join the same graph; when a
+            # router advertises both styles the duplicate edges/prefixes
+            # carry identical metrics and collapse in the SPF.
+            node["is"].extend(tlvs.get("narrow_is_reach", []))
+            node["ip"].extend(tlvs.get("narrow_ip_reach", []))
+            node["ip"].extend(tlvs.get("narrow_ip_ext_reach", []))
             for mt_id, reach in tlvs.get("mt_is_reach", []):
                 if mt_id == 0:
                     node["is"].append(reach)

@@ -34,6 +34,8 @@ class TlvType(enum.IntEnum):
     AREA_ADDRESSES = 1
     IS_REACH = 2  # ISO 10589 narrow-metric IS reachability
     IS_NEIGHBORS = 6  # LAN hellos: heard SNPAs
+    PURGE_ORIGINATOR = 13  # RFC 6232
+    LSP_BUFFER_SIZE = 14  # ISO 10589 §9.8 originating-LSP-buffer-size
     IP_INTERNAL_REACH = 128  # RFC 1195 narrow-metric IP reachability
     PROTOCOLS_SUPPORTED = 129
     IP_EXTERNAL_REACH = 130
@@ -41,6 +43,8 @@ class TlvType(enum.IntEnum):
     EXT_IS_REACH = 22
     EXT_IP_REACH = 135
     DYNAMIC_HOSTNAME = 137  # RFC 5301
+    IPV4_ROUTER_ID = 134  # RFC 5305 TE router id
+    IPV6_ROUTER_ID = 140  # RFC 6119
     MT_IS_REACH = 222  # RFC 5120 multi-topology
     MULTI_TOPOLOGY = 229
     IPV6_INTERFACE_ADDRESS = 232  # RFC 5308
@@ -88,6 +92,17 @@ class ExtIpReach:
     external: bool = False
     # RFC 8667 §2.1 Prefix-SID sub-TLV (index form) when not None.
     sid_index: int | None = None
+    # RFC 7794 prefix attributes (wide v4 + v6 only): raw flags byte
+    # (X=0x80 external, R=0x40 re-advertisement, N=0x20 node) and the
+    # source-router-id sub-TLVs.
+    attr_flags: int | None = None
+    src_rid4: IPv4Address | None = None
+    src_rid6: object = None  # IPv6Address
+
+PREFIX_ATTR_X = 0x80
+PREFIX_ATTR_R = 0x40
+PREFIX_ATTR_N = 0x20
+MAX_NARROW_METRIC = 63
 
 
 class AdjState3Way(enum.IntEnum):
@@ -105,73 +120,16 @@ class P2pAdjState:
 
 
 def _encode_tlvs(w: Writer, tlvs: dict) -> None:
-    if tlvs.get("area_addresses"):
-        body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
-        w.u8(TlvType.AREA_ADDRESSES).u8(len(body)).bytes(body)
-    if tlvs.get("is_neighbors"):
-        body = b"".join(tlvs["is_neighbors"])  # 6-byte SNPAs
-        w.u8(TlvType.IS_NEIGHBORS).u8(len(body)).bytes(body)
-    if tlvs.get("protocols_supported"):
+    """TLV emission in the reference's serialization order
+    (holo-isis/src/packet/pdu.rs LspTlvs/HelloTlvs field order) so that
+    re-encoded and self-originated LSPs are byte-identical to the
+    reference's — the conformance corpus's recorded SNP checksums
+    assert this.  ``protocols_supported`` distinguishes present-but-
+    empty ([] -> empty TLV, as in pseudonode LSPs) from absent (None).
+    """
+    if tlvs.get("protocols_supported") is not None:
         body = bytes(tlvs["protocols_supported"])
         w.u8(TlvType.PROTOCOLS_SUPPORTED).u8(len(body)).bytes(body)
-    if tlvs.get("ip_addresses"):
-        body = b"".join(a.packed for a in tlvs["ip_addresses"])
-        w.u8(TlvType.IP_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
-    if tlvs.get("ipv6_addresses"):
-        body = b"".join(a.packed for a in tlvs["ipv6_addresses"])
-        w.u8(TlvType.IPV6_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
-    if tlvs.get("hostname"):
-        body = tlvs["hostname"].encode("ascii", "replace")
-        w.u8(TlvType.DYNAMIC_HOSTNAME).u8(len(body)).bytes(body)
-    if tlvs.get("p2p_adj") is not None:
-        adj: P2pAdjState = tlvs["p2p_adj"]
-        body = bytes((int(adj.state),)) + adj.ext_circuit_id.to_bytes(4, "big")
-        if adj.neighbor_sysid is not None:
-            body += adj.neighbor_sysid
-            body += (adj.neighbor_ext_circuit_id or 0).to_bytes(4, "big")
-        w.u8(TlvType.P2P_ADJ_STATE).u8(len(body)).bytes(body)
-    for reach in _chunks(tlvs.get("ext_is_reach", []), 23):
-        body = b""
-        for r in reach:
-            body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
-        w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
-    def _wide_ip_entry(r) -> bytes:
-        has_sub = getattr(r, "sid_index", None) is not None
-        ctrl = (
-            (0x80 if r.up_down else 0)
-            | (0x40 if has_sub else 0)
-            | r.prefix.prefixlen
-        )
-        plen_bytes = (r.prefix.prefixlen + 7) // 8
-        out = r.metric.to_bytes(4, "big") + bytes((ctrl,))
-        out += r.prefix.network_address.packed[:plen_bytes]
-        if has_sub:
-            # Prefix-SID sub-TLV (type 3): flags, algo 0, u32 index.
-            out += bytes((8, 3, 6, 0, 0)) + r.sid_index.to_bytes(4, "big")
-        return out
-
-    # Chunk by ENCODED size (entries vary 5..18 bytes with sub-TLVs; the
-    # one-byte TLV length caps the body at 255).
-    body = b""
-    for r in tlvs.get("ext_ip_reach", []):
-        enc = _wide_ip_entry(r)
-        if body and len(body) + len(enc) > 255:
-            w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
-            body = b""
-        body += enc
-    if body:
-        w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
-    # Max 11 entries per TLV: a full-length /128 entry is 22 bytes and
-    # the TLV length octet caps the body at 255 (11*22=242).
-    for reach in _chunks(tlvs.get("ipv6_reach", []), 11):
-        body = b""
-        for r in reach:
-            ctrl = 0x80 if r.up_down else 0
-            plen_bytes = (r.prefix.prefixlen + 7) // 8
-            body += r.metric.to_bytes(4, "big")
-            body += bytes((ctrl, r.prefix.prefixlen))
-            body += r.prefix.network_address.packed[:plen_bytes]
-        w.u8(TlvType.IPV6_REACH).u8(len(body)).bytes(body)
     if tlvs.get("sr_cap"):
         # Router Capability (RFC 7981) with the SR-Capabilities sub-TLV
         # (RFC 8667 §3.1): flags + one SRGB descriptor (range u24 +
@@ -180,10 +138,13 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
         sub = bytes((0xC0,))  # I+V flags: MPLS v4+v6
         sub += srgb_range.to_bytes(3, "big")
         sub += bytes((1, 3)) + srgb_base.to_bytes(3, "big")
-        body = bytes(4)  # router id (unset) 
+        body = bytes(4)  # router id (unset)
         body += bytes((0,))  # capability flags
         body += bytes((2, len(sub))) + sub
         w.u8(TlvType.ROUTER_CAPABILITY).u8(len(body)).bytes(body)
+    if tlvs.get("area_addresses"):
+        body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
+        w.u8(TlvType.AREA_ADDRESSES).u8(len(body)).bytes(body)
     if tlvs.get("mt_ids"):
         # RFC 5120 §7.1: u16 per member topology — O(15) A(14) + 12-bit id.
         body = b"".join(
@@ -195,31 +156,153 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             for mt_id, att, ovl in tlvs["mt_ids"]
         )
         w.u8(TlvType.MULTI_TOPOLOGY).u8(len(body)).bytes(body)
+    if tlvs.get("purge_originator"):
+        ids = tlvs["purge_originator"]
+        body = bytes((len(ids),)) + b"".join(ids)
+        w.u8(TlvType.PURGE_ORIGINATOR).u8(len(body)).bytes(body)
+    if tlvs.get("hostname"):
+        body = tlvs["hostname"].encode("ascii", "replace")
+        w.u8(TlvType.DYNAMIC_HOSTNAME).u8(len(body)).bytes(body)
+    if tlvs.get("lsp_buf_size"):
+        w.u8(TlvType.LSP_BUFFER_SIZE).u8(2).u16(tlvs["lsp_buf_size"])
+    if tlvs.get("is_neighbors"):
+        body = b"".join(tlvs["is_neighbors"])  # 6-byte SNPAs
+        w.u8(TlvType.IS_NEIGHBORS).u8(len(body)).bytes(body)
+    if tlvs.get("p2p_adj") is not None:
+        adj: P2pAdjState = tlvs["p2p_adj"]
+        body = bytes((int(adj.state),)) + adj.ext_circuit_id.to_bytes(4, "big")
+        if adj.neighbor_sysid is not None:
+            body += adj.neighbor_sysid
+            body += (adj.neighbor_ext_circuit_id or 0).to_bytes(4, "big")
+        w.u8(TlvType.P2P_ADJ_STATE).u8(len(body)).bytes(body)
+    # ISO 10589 narrow-metric IS reach (TLV 2): virtual-flag byte + 11-byte
+    # entries; the three QoS metrics are always unsupported (S bit 0x80).
+    if tlvs.get("narrow_is_reach"):
+        for chunk in _chunks(tlvs["narrow_is_reach"], 22):
+            body = b"\x00"  # virtual flag
+            for r in chunk:
+                body += bytes((r.metric & 0x3F, 0x80, 0x80, 0x80)) + r.neighbor
+            w.u8(TlvType.IS_REACH).u8(len(body)).bytes(body)
+    for reach in _chunks(tlvs.get("ext_is_reach", []), 23):
+        body = b""
+        for r in reach:
+            body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
+        w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
     # RFC 5120 §7.2/7.4: MT-prefixed variants of the reach TLVs.  Entries
     # arrive as [(mt_id, entry)]; group per topology, chunk like the
     # single-topology TLVs.
-    _mt_groups: dict = {}
+    _mt_is_groups: dict = {}
     for mt_id, entry in tlvs.get("mt_is_reach", []):
-        _mt_groups.setdefault(("is", mt_id), []).append(entry)
+        _mt_is_groups.setdefault(mt_id, []).append(entry)
+    for mt_id, entries in _mt_is_groups.items():
+        for chunk in _chunks(entries, 23):
+            body = (mt_id & 0x0FFF).to_bytes(2, "big")
+            for r in chunk:
+                body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
+            w.u8(TlvType.MT_IS_REACH).u8(len(body)).bytes(body)
+    if tlvs.get("ip_addresses"):
+        body = b"".join(a.packed for a in tlvs["ip_addresses"])
+        w.u8(TlvType.IP_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+    # RFC 1195 narrow-metric IP reach (TLV 128 internal / 130 external).
+    for key, tlv_type in (
+        ("narrow_ip_reach", TlvType.IP_INTERNAL_REACH),
+        ("narrow_ip_ext_reach", TlvType.IP_EXTERNAL_REACH),
+    ):
+        for chunk in _chunks(tlvs.get(key, []), 21):
+            body = b""
+            for r in chunk:
+                m = (r.metric & 0x3F) | (
+                    0x40 if r.external and key == "narrow_ip_reach" else 0
+                )
+                body += bytes((m, 0x80, 0x80, 0x80))
+                body += r.prefix.network_address.packed
+                body += r.prefix.netmask.packed
+            w.u8(tlv_type).u8(len(body)).bytes(body)
+
+    def _prefix_subtlvs(r) -> bytes:
+        """RFC 7794 attr-flags/source-rid + RFC 8667 prefix-SID block."""
+        sub = b""
+        if getattr(r, "attr_flags", None) is not None:
+            sub += bytes((4, 1, r.attr_flags))
+        if getattr(r, "src_rid4", None) is not None:
+            sub += bytes((11, 4)) + r.src_rid4.packed
+        if getattr(r, "src_rid6", None) is not None:
+            sub += bytes((12, 16)) + r.src_rid6.packed
+        if getattr(r, "sid_index", None) is not None:
+            # Prefix-SID sub-TLV (type 3): flags, algo 0, u32 index.
+            sub += bytes((3, 6, 0, 0)) + r.sid_index.to_bytes(4, "big")
+        return sub
+
+    def _wide_ip_entry(r) -> bytes:
+        sub = _prefix_subtlvs(r)
+        ctrl = (
+            (0x80 if r.up_down else 0)
+            | (0x40 if sub else 0)
+            | r.prefix.prefixlen
+        )
+        plen_bytes = (r.prefix.prefixlen + 7) // 8
+        out = r.metric.to_bytes(4, "big") + bytes((ctrl,))
+        out += r.prefix.network_address.packed[:plen_bytes]
+        if sub:
+            out += bytes((len(sub),)) + sub
+        return out
+
+    # Chunk by ENCODED size (entries vary 5..30 bytes with sub-TLVs; the
+    # one-byte TLV length caps the body at 255).
+    body = b""
+    for r in tlvs.get("ext_ip_reach", []):
+        enc = _wide_ip_entry(r)
+        if body and len(body) + len(enc) > 255:
+            w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
+            body = b""
+        body += enc
+    if body:
+        w.u8(TlvType.EXT_IP_REACH).u8(len(body)).bytes(body)
+    if tlvs.get("ipv4_router_id") is not None:
+        w.u8(TlvType.IPV4_ROUTER_ID).u8(4).bytes(tlvs["ipv4_router_id"].packed)
+    if tlvs.get("ipv6_addresses"):
+        body = b"".join(a.packed for a in tlvs["ipv6_addresses"])
+        w.u8(TlvType.IPV6_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+
+    def _v6_entry(r) -> bytes:
+        sub = _prefix_subtlvs(r)
+        ctrl = (
+            (0x80 if r.up_down else 0)
+            | (0x40 if r.external else 0)
+            | (0x20 if sub else 0)
+        )
+        plen_bytes = (r.prefix.prefixlen + 7) // 8
+        out = r.metric.to_bytes(4, "big")
+        out += bytes((ctrl, r.prefix.prefixlen))
+        out += r.prefix.network_address.packed[:plen_bytes]
+        if sub:
+            out += bytes((len(sub),)) + sub
+        return out
+
+    body = b""
+    for r in tlvs.get("ipv6_reach", []):
+        enc = _v6_entry(r)
+        if body and len(body) + len(enc) > 255:
+            w.u8(TlvType.IPV6_REACH).u8(len(body)).bytes(body)
+            body = b""
+        body += enc
+    if body:
+        w.u8(TlvType.IPV6_REACH).u8(len(body)).bytes(body)
+    _mt_v6_groups: dict = {}
     for mt_id, entry in tlvs.get("mt_ipv6_reach", []):
-        _mt_groups.setdefault(("v6", mt_id), []).append(entry)
-    for (kind, mt_id), entries in _mt_groups.items():
-        if kind == "is":
-            for chunk in _chunks(entries, 23):
-                body = (mt_id & 0x0FFF).to_bytes(2, "big")
-                for r in chunk:
-                    body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
-                w.u8(TlvType.MT_IS_REACH).u8(len(body)).bytes(body)
-        else:
-            for chunk in _chunks(entries, 11):
-                body = (mt_id & 0x0FFF).to_bytes(2, "big")
-                for r in chunk:
-                    ctrl = 0x80 if r.up_down else 0
-                    plen_bytes = (r.prefix.prefixlen + 7) // 8
-                    body += r.metric.to_bytes(4, "big")
-                    body += bytes((ctrl, r.prefix.prefixlen))
-                    body += r.prefix.network_address.packed[:plen_bytes]
+        _mt_v6_groups.setdefault(mt_id, []).append(entry)
+    for mt_id, entries in _mt_v6_groups.items():
+        body = (mt_id & 0x0FFF).to_bytes(2, "big")
+        for r in entries:
+            enc = _v6_entry(r)
+            if len(body) + len(enc) > 255:
                 w.u8(TlvType.MT_IPV6_REACH).u8(len(body)).bytes(body)
+                body = (mt_id & 0x0FFF).to_bytes(2, "big")
+            body += enc
+        if len(body) > 2:
+            w.u8(TlvType.MT_IPV6_REACH).u8(len(body)).bytes(body)
+    if tlvs.get("ipv6_router_id") is not None:
+        w.u8(TlvType.IPV6_ROUTER_ID).u8(16).bytes(tlvs["ipv6_router_id"].packed)
     if tlvs.get("lsp_entries"):
         for chunk in _chunks(tlvs["lsp_entries"], 15):
             body = b""
@@ -244,12 +327,12 @@ def _read_wide_is_entries(body: Reader, out: list) -> None:
         out.append(ExtIsReach(nbr, metric))
 
 
-def _read_prefix_subtlvs(body: Reader) -> int | None:
-    """Parse a prefix entry's sub-TLV block; returns the Prefix-SID
-    index (RFC 8667 sub-TLV 3, index form) if present."""
+def _read_prefix_subtlvs(body: Reader) -> dict:
+    """Parse a prefix entry's sub-TLV block; returns {sid_index,
+    attr_flags, src_rid4, src_rid6} (RFC 8667 §2.1, RFC 7794)."""
     sl = body.u8()
     sub = body.sub(min(sl, body.remaining()))
-    sid_index = None
+    out: dict = {}
     while sub.remaining() >= 2:
         st = sub.u8()
         stl = sub.u8()
@@ -258,8 +341,14 @@ def _read_prefix_subtlvs(body: Reader) -> int | None:
             flags = sb.u8()
             sb.u8()  # algorithm
             if not (flags & 0x0C):  # V/L clear: 4-byte index
-                sid_index = sb.u32()
-    return sid_index
+                out["sid_index"] = sb.u32()
+        elif st == 4 and stl >= 1:
+            out["attr_flags"] = sb.u8()
+        elif st == 11 and stl == 4:
+            out["src_rid4"] = sb.ipv4()
+        elif st == 12 and stl == 16:
+            out["src_rid6"] = sb.ipv6()
+    return out
 
 
 def _read_wide_ip_entries(body: Reader, out: list) -> None:
@@ -272,13 +361,11 @@ def _read_wide_ip_entries(body: Reader, out: list) -> None:
             raise DecodeError("bad prefix length")
         nbytes = (plen + 7) // 8
         raw = body.bytes(nbytes) + bytes(4 - nbytes)
-        sid_index = None
+        sub: dict = {}
         if ctrl & 0x40:  # sub-TLVs present
-            sid_index = _read_prefix_subtlvs(body)
+            sub = _read_prefix_subtlvs(body)
         prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
-        out.append(
-            ExtIpReach(prefix, metric, bool(ctrl & 0x80), sid_index=sid_index)
-        )
+        out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80), **sub))
 
 
 def _read_ipv6_entries(body: Reader, out: list) -> None:
@@ -292,11 +379,16 @@ def _read_ipv6_entries(body: Reader, out: list) -> None:
             raise DecodeError("bad v6 prefix length")
         nbytes = (plen + 7) // 8
         raw = body.bytes(nbytes) + bytes(16 - nbytes)
+        sub: dict = {}
         if ctrl & 0x20:  # sub-TLVs present
-            sl = body.u8()
-            body.bytes(min(sl, body.remaining()))
+            sub = _read_prefix_subtlvs(body)
         prefix = IPv6Network((int.from_bytes(raw, "big"), plen))
-        out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80)))
+        out.append(
+            ExtIpReach(
+                prefix, metric, bool(ctrl & 0x80),
+                external=bool(ctrl & 0x40), **sub,
+            )
+        )
 
 
 def _decode_tlvs(r: Reader) -> dict:
@@ -318,6 +410,13 @@ def _decode_tlvs(r: Reader) -> dict:
         "lsp_entries": [],
         "p2p_adj": None,
         "sr_cap": None,
+        # ISO 10589 / RFC 1195 narrow-metric TLVs kept distinct from the
+        # wide lists so originated PDUs round-trip TLV-exactly.
+        "narrow_is_reach": [],
+        "narrow_ip_reach": [],
+        "narrow_ip_ext_reach": [],
+        "lsp_buf_size": None,
+        "purge_originator": [],
     }
     while r.remaining() >= 2:
         t = r.u8()
@@ -355,15 +454,14 @@ def _decode_tlvs(r: Reader) -> dict:
         elif t == TlvType.IS_REACH:
             # ISO 10589 §9.8: virtual-flag byte, then 11-byte entries of
             # four metric octets + 7-byte neighbor id.  Only the default
-            # metric (low 6 bits) is used; decoded into the same unified
-            # reach list the wide TLV (22) fills.
+            # metric (low 6 bits) is used.
             if body.remaining() >= 1:
                 body.u8()  # virtual flag
             while body.remaining() >= 11:
                 metric = body.u8() & 0x3F
                 body.bytes(3)  # delay/expense/error metrics (unsupported)
                 nbr = body.bytes(7)
-                out["ext_is_reach"].append(ExtIsReach(nbr, metric))
+                out["narrow_is_reach"].append(ExtIsReach(nbr, metric))
         elif t in (TlvType.IP_INTERNAL_REACH, TlvType.IP_EXTERNAL_REACH):
             # RFC 1195 §3.2: 12-byte entries of four metric octets +
             # address + mask.  Bit 6 of the default metric is I/E.
@@ -374,12 +472,29 @@ def _decode_tlvs(r: Reader) -> dict:
                 mask = int.from_bytes(body.bytes(4), "big")
                 plen = bin(mask).count("1")
                 prefix = IPv4Network((addr & mask, plen))
-                external = (
-                    t == TlvType.IP_EXTERNAL_REACH or bool(m & 0x40)
-                )
-                out["ext_ip_reach"].append(
-                    ExtIpReach(prefix, m & 0x3F, external=external)
-                )
+                if t == TlvType.IP_EXTERNAL_REACH:
+                    out["narrow_ip_ext_reach"].append(
+                        ExtIpReach(prefix, m & 0x3F, external=True)
+                    )
+                else:
+                    out["narrow_ip_reach"].append(
+                        ExtIpReach(prefix, m & 0x3F, external=bool(m & 0x40))
+                    )
+        elif t == TlvType.IPV4_ROUTER_ID:
+            if length >= 4:
+                out["ipv4_router_id"] = body.ipv4()
+        elif t == TlvType.IPV6_ROUTER_ID:
+            if length >= 16:
+                out["ipv6_router_id"] = body.ipv6()
+        elif t == TlvType.LSP_BUFFER_SIZE:
+            if length >= 2:
+                out["lsp_buf_size"] = body.u16()
+        elif t == TlvType.PURGE_ORIGINATOR:
+            # RFC 6232: count byte + that many system ids.
+            if body.remaining() >= 1:
+                n_ids = body.u8()
+                for _ in range(min(n_ids, body.remaining() // 6)):
+                    out["purge_originator"].append(body.bytes(6))
         elif t == TlvType.EXT_IS_REACH:
             _read_wide_is_entries(body, out["ext_is_reach"])
         elif t == TlvType.EXT_IP_REACH:
@@ -689,14 +804,17 @@ class Lsp:
         tlvs = _decode_tlvs(Reader(raw, r.pos, pdu_len))
         return cls(level, lifetime, lsp_id, seqno, flags, tlvs, cksum, raw[:pdu_len])
 
-    def compare(self, lifetime: int, seqno: int, cksum: int) -> int:
-        """ISO 10589 §7.3.16: newer comparison vs a summary tuple."""
+    def compare(self, lifetime: int, seqno: int, cksum: int = -1) -> int:
+        """ISO 10589 §7.3.16: newer comparison vs a summary tuple.
+
+        The checksum does NOT participate in the ordering (reference
+        lsp_compare): an equal result with differing checksums is "LSP
+        confusion" (§7.3.16.2), handled by the caller."""
+        del cksum
         if self.seqno != seqno:
             return 1 if self.seqno > seqno else -1
         if (self.lifetime == 0) != (lifetime == 0):
             return 1 if self.lifetime == 0 else -1
-        if self.cksum != cksum:
-            return 1 if self.cksum > cksum else -1
         return 0
 
 

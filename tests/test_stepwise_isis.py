@@ -1,0 +1,74 @@
+"""IS-IS stepwise conformance: the reference's per-step golden cases
+replayed through our live IsisInstance (tools/stepwise_isis.py).
+
+Each case replays one recorded router's events.jsonl through the real
+adjacency FSM / flooding / SPF machinery — with byte-identical LSP
+re-encoding, so the recorded PSNP acks of the reference's own LSPs
+validate OUR origination checksums — then applies the numbered step
+inputs and asserts the protocol-output, local-rib, LSP-database, and
+SRM/SSN state planes.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.stepwise_isis import ISIS_DIR, case_map, run_all, run_case
+
+pytestmark = pytest.mark.skipif(
+    not ISIS_DIR.exists(), reason="reference corpus not present"
+)
+
+KNOWN_PASS = [
+    "pdu-csnp1",
+    "pdu-psnp1",
+    "pdu-lsp1",
+    "timeout-adj1",
+    "csnp-interval1",
+]
+PASS_FLOOR = 43
+
+
+def test_known_cases_pass():
+    cm = case_map()
+    for case in KNOWN_PASS:
+        status, detail = run_case(ISIS_DIR / case, *cm[case])
+        assert status == "pass", f"{case}: {detail}"
+
+
+def test_stepwise_sweep_floor():
+    res = run_all()
+    passed = sorted(c for c, (s, _) in res.items() if s == "pass")
+    failed = {c: d for c, (s, d) in res.items() if s == "fail"}
+    assert len(passed) >= PASS_FLOOR, (
+        f"only {len(passed)} IS-IS stepwise cases pass (floor {PASS_FLOOR}); "
+        f"failures: { {c: d[:120] for c, d in list(failed.items())[:5]} }"
+    )
+
+
+def test_lsp_reencode_byte_identical():
+    """Every recorded LSP in the corpus re-encodes to its exact wire
+    bytes through our codec (TLV order, sub-TLVs, empty-TLV semantics)."""
+    import json
+
+    from holo_tpu.protocols.isis.packet import Lsp, decode_pdu
+
+    ok = bad = 0
+    for f in (ISIS_DIR / "topologies").glob("*/*/events.jsonl"):
+        for line in f.read_text().splitlines():
+            ev = json.loads(line)
+            rx = (ev.get("Protocol") or {}).get("NetRxPdu")
+            if not rx or "bytes" not in rx:
+                continue
+            raw = bytes(rx["bytes"])
+            try:
+                _t, pdu = decode_pdu(raw)
+            except Exception:
+                continue
+            if not isinstance(pdu, Lsp):
+                continue
+            if pdu.encode() == raw:
+                ok += 1
+            else:
+                bad += 1
+    assert bad == 0 and ok > 900, f"re-encode: {ok} ok, {bad} diverged"
