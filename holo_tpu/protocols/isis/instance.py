@@ -238,6 +238,20 @@ class IsisInstance(Actor):
         self.te_rid6 = te_rid6
         self.protocols = protocols
         self.node_flag = node_flag
+        # ISO 10589 §7.2.8.1 overload bit: advertised in our LSP flags;
+        # an overloaded router stays reachable but is never transit.
+        self.overload = False
+        # Enabled address families gate route installation per AF.
+        self.afs = {"ipv4", "ipv6"}
+        # RFC 8668-style ECMP clamp (reference spf.rs:920-929).
+        self.max_paths: int | None = None
+        # RFC 7981 node administrative tags (router-capability sub-TLV).
+        self.node_tags: tuple = ()
+        # RFC 6232 purge originator identification.
+        self.purge_originator = False
+        # System IPv4 router id (ibus RouterIdUpdate): the router-
+        # capability TLV's router-id when no TE rid overrides it.
+        self.router_id: IPv4Address | None = None
         # Deferred origination (the reference's LspOriginate task model):
         # when True, non-forced origination only marks pending; the
         # conformance replay fires originate_pending() at the recorded
@@ -564,6 +578,29 @@ class IsisInstance(Actor):
         if iface is not None and iface.is_lan:
             self._run_dis_election(iface)
 
+    def clear_adjacencies(self, ifname: str | None = None) -> None:
+        """ietf-isis clear-adjacency RPC: tear down adjacencies (all, or
+        one interface's) — the neighbor re-forms them from hellos."""
+        for iface in self.interfaces.values():
+            if ifname is not None and iface.name != ifname:
+                continue
+            if iface.is_lan:
+                for sysid in list(iface.adjs):
+                    self._lan_adj_down(iface.name, sysid)
+            elif iface.adj is not None:
+                self._adj_down(iface.name)
+
+    def clear_database(self) -> None:
+        """ietf-isis clear-database RPC: drop the LSDB and rebuild our
+        own LSPs (neighbors resync via CSNP/PSNP)."""
+        self.lsdb.clear()
+        self._plain_raw.clear()
+        for iface in self.interfaces.values():
+            iface.srm.clear()
+            iface.ssn.clear()
+        self._originate_lsp(force=True)
+        self._schedule_spf()
+
     def set_hostname(self, hostname: str) -> None:
         """RFC 5301: our dynamic hostname changed; re-originate."""
         if hostname != self.hostname:
@@ -587,7 +624,13 @@ class IsisInstance(Actor):
         e = self.lsdb.get(lid)
         if e is None:
             return
-        dead = Lsp(self.level, 0, lid, e.lsp.seqno, e.lsp.flags, {})
+        tlvs = {}
+        if self.purge_originator:
+            # RFC 6232 §3: the purge carries the POI TLV naming us plus
+            # our dynamic hostname.
+            tlvs["purge_originator"] = [self.sysid]
+            tlvs["hostname"] = self.hostname
+        dead = Lsp(self.level, 0, lid, e.lsp.seqno, e.lsp.flags, tlvs)
         dead.encode(auth=self.auth)
         # §7.3.16.4: the purge advertises the original checksum.  Patch
         # the wire bytes too so SNP descriptions and the flooded PDU
@@ -816,8 +859,12 @@ class IsisInstance(Actor):
             tlvs["ipv6_router_id"] = self.te_rid6
         if self.lsp_mtu is not None:
             tlvs["lsp_buf_size"] = self.lsp_mtu
+        if self.node_tags:
+            tlvs["node_tags"] = tuple(self.node_tags)
         if self.sr is not None and self.sr.enabled:
             tlvs["sr_cap"] = (self.sr.srgb.lower, self.sr.srgb.size)
+        if tlvs.get("sr_cap") or tlvs.get("node_tags"):
+            tlvs["cap_router_id"] = self.te_rid4 or self.router_id
         if self.mt_enabled:
             # Membership in the base + ipv6-unicast topologies, v6 reach
             # and v6-topology adjacencies under the MT TLVs.
@@ -826,17 +873,18 @@ class IsisInstance(Actor):
             tlvs["ipv6_reach"] = []
             tlvs["mt_is_reach"] = [(MT_IPV6, e) for e in is_reach]
         seqno = max((old.lsp.seqno + 1) if old else 1, min_seqno)
-        lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
+        flags = 0x03 | (0x04 if self.overload else 0)
+        lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, flags=flags, tlvs=tlvs)
         # Content comparison uses the UNauthenticated bytes: the auth
         # digest covers the seqno, so authenticated raw always differs.
         plain = lsp.encode()
         if (
             not force
-            and self._plain_raw.get(lsp_id) == plain[27:]
+            and self._plain_raw.get(lsp_id) == plain[26:]
         ):
             self._originate_pseudonodes()
             return  # content unchanged
-        self._plain_raw[lsp_id] = plain[27:]
+        self._plain_raw[lsp_id] = plain[26:]
         lsp.encode(auth=self.auth)
         self._install_lsp(lsp, flood_from=None)
         self._originate_pseudonodes()
@@ -876,9 +924,9 @@ class IsisInstance(Actor):
             seqno = (old.lsp.seqno + 1) if old else 1
             lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, tlvs=tlvs)
             plain = lsp.encode()
-            if not force and self._plain_raw.get(lsp_id) == plain[27:]:
+            if not force and self._plain_raw.get(lsp_id) == plain[26:]:
                 continue
-            self._plain_raw[lsp_id] = plain[27:]
+            self._plain_raw[lsp_id] = plain[26:]
             lsp.encode(auth=self.auth)
             self._install_lsp(lsp, flood_from=None)
 
@@ -1056,6 +1104,27 @@ class IsisInstance(Actor):
                 cur.remaining_lifetime(now), cur.lsp.seqno, cur.lsp.cksum
             )
         if c > 0:
+            if (
+                lsp.is_expired
+                and self.purge_originator
+                and not lsp.tlvs.get("purge_originator")
+            ):
+                # RFC 6232 §3: a relayed purge without a POI TLV gains
+                # one naming us and the system we received it from.
+                if iface.is_lan:
+                    # Any single up adjacency identifies the relayer on
+                    # a LAN only when unambiguous.
+                    ups = iface.up_adjacencies()
+                    sender = ups[0].sysid if len(ups) == 1 else None
+                elif iface.adj is not None:
+                    sender = iface.adj.sysid
+                else:
+                    sender = None
+                lsp.tlvs["purge_originator"] = [self.sysid] + (
+                    [sender] if sender else []
+                )
+                lsp.tlvs["hostname"] = self.hostname
+                lsp.encode(auth=self.auth)
             self._install_lsp(lsp, flood_from=iface.name)
         elif c == 0:
             if cur is not None and cur.lsp.cksum != lsp.cksum and cur.lsp.seqno != 0:
@@ -1389,14 +1458,31 @@ class IsisInstance(Actor):
         rank_of: dict = {}  # prefix -> (external, metric): RFC 1195
         # §3.10.2 internal paths beat external regardless of metric.
 
+        def _clamp(nhs):
+            if self.max_paths is None or len(nhs) <= self.max_paths:
+                return nhs
+            # Reference spf.rs:920-929: deterministic ECMP clamp — keep
+            # the lowest next-hop addresses.
+            ranked = sorted(
+                nhs,
+                key=lambda h: (
+                    h[1] is None,
+                    h[1].packed if h[1] is not None else b"",
+                    h[0] or "",
+                ),
+            )
+            return frozenset(ranked[: self.max_paths])
+
         def _add(prefix, total, nhs, external=False):
             rank = (external, total)
             cur = rank_of.get(prefix)
             if cur is None or rank < cur:
                 rank_of[prefix] = rank
-                routes[prefix] = (total, nhs)
+                routes[prefix] = (total, _clamp(nhs))
             elif rank == cur:
-                routes[prefix] = (total, routes[prefix][1] | nhs)
+                routes[prefix] = (
+                    total, _clamp(routes[prefix][1] | nhs)
+                )
 
         def _af_nexthops(res_, atoms_, v, want_v6):
             triples = [
@@ -1407,15 +1493,17 @@ class IsisInstance(Actor):
                 return frozenset((ifn, a6) for ifn, _, a6 in triples)
             return frozenset((ifn, a4) for ifn, a4, _ in triples)
 
+        af4 = "ipv4" in self.afs
+        af6 = "ipv6" in self.afs
         for k, node in nodes.items():
             v = index[k]
-            if res4.dist[v] < INF and node["ip"]:
+            if af4 and res4.dist[v] < INF and node["ip"]:
                 nhs4 = _af_nexthops(res4, atoms4, v, False)
                 for reach in node["ip"]:
                     _add(reach.prefix, int(res4.dist[v]) + reach.metric,
                          nhs4, reach.external)
             ip6_list = node["ip6mt"] if mt6 else node["ip6"]
-            if res6.dist[v] < INF and ip6_list:
+            if af6 and res6.dist[v] < INF and ip6_list:
                 nhs6 = _af_nexthops(res6, atoms6, v, True)
                 for reach in ip6_list:
                     _add(reach.prefix, int(res6.dist[v]) + reach.metric, nhs6)

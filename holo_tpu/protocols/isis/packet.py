@@ -130,17 +130,23 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
     if tlvs.get("protocols_supported") is not None:
         body = bytes(tlvs["protocols_supported"])
         w.u8(TlvType.PROTOCOLS_SUPPORTED).u8(len(body)).bytes(body)
-    if tlvs.get("sr_cap"):
-        # Router Capability (RFC 7981) with the SR-Capabilities sub-TLV
-        # (RFC 8667 §3.1): flags + one SRGB descriptor (range u24 +
-        # SID/Label sub-TLV type 1 carrying the base label u24).
-        srgb_base, srgb_range = tlvs["sr_cap"]
-        sub = bytes((0xC0,))  # I+V flags: MPLS v4+v6
-        sub += srgb_range.to_bytes(3, "big")
-        sub += bytes((1, 3)) + srgb_base.to_bytes(3, "big")
-        body = bytes(4)  # router id (unset)
+    if tlvs.get("sr_cap") or tlvs.get("node_tags") or tlvs.get("cap_router_id") is not None:
+        # Router Capability (RFC 7981): router id + flags, then the
+        # RFC 8667 §3.1 SR-Capabilities sub-TLV (flags + one SRGB
+        # descriptor: range u24 + SID/Label sub-TLV type 1 with the base
+        # label) and/or the RFC 7917 node-admin-tag sub-TLV (type 21).
+        rid = tlvs.get("cap_router_id")
+        body = (rid.packed if rid is not None else bytes(4))
         body += bytes((0,))  # capability flags
-        body += bytes((2, len(sub))) + sub
+        if tlvs.get("sr_cap"):
+            srgb_base, srgb_range = tlvs["sr_cap"]
+            sub = bytes((0xC0,))  # I+V flags: MPLS v4+v6
+            sub += srgb_range.to_bytes(3, "big")
+            sub += bytes((1, 3)) + srgb_base.to_bytes(3, "big")
+            body += bytes((2, len(sub))) + sub
+        if tlvs.get("node_tags"):
+            sub = b"".join(t.to_bytes(4, "big") for t in tlvs["node_tags"])
+            body += bytes((21, len(sub))) + sub
         w.u8(TlvType.ROUTER_CAPABILITY).u8(len(body)).bytes(body)
     if tlvs.get("area_addresses"):
         body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
@@ -395,7 +401,8 @@ def _decode_tlvs(r: Reader) -> dict:
     out: dict = {
         "area_addresses": [],
         "is_neighbors": [],
-        "protocols_supported": [],
+        # None = TLV absent; [] = present but empty (pseudonode LSPs).
+        "protocols_supported": None,
         "ip_addresses": [],
         "ipv6_addresses": [],
         "ext_is_reach": [],
@@ -529,7 +536,9 @@ def _decode_tlvs(r: Reader) -> dict:
                 _read_ipv6_entries(body, entries)
                 out["mt_ipv6_reach"].extend((mt_id, e) for e in entries)
         elif t == TlvType.ROUTER_CAPABILITY:
-            body.bytes(4)  # router id
+            rid = body.ipv4()
+            if int(rid):
+                out["cap_router_id"] = rid
             body.u8()  # flags
             while body.remaining() >= 2:
                 st = body.u8()
@@ -542,6 +551,13 @@ def _decode_tlvs(r: Reader) -> dict:
                         sb.u8()  # length (3)
                         base = int.from_bytes(sb.bytes(3), "big")
                         out["sr_cap"] = (base, rng)
+                elif st == 21:
+                    tags = []
+                    while sb.remaining() >= 4:
+                        tags.append(sb.u32())
+                    out["node_tags"] = tuple(
+                        out.get("node_tags", ()) or ()
+                    ) + tuple(tags)
         elif t == TlvType.LSP_ENTRIES:
             while body.remaining() >= 16:
                 lifetime = body.u16()

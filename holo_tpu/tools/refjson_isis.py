@@ -169,7 +169,11 @@ def _wide_is_json(entries) -> list:
     return [
         {
             "list": [
-                {"neighbor": _lan_id_json(r.neighbor), "metric": r.metric}
+                {
+                    "neighbor": _lan_id_json(r.neighbor),
+                    "metric": r.metric,
+                    "sub_tlvs": {},
+                }
                 for r in entries
             ]
         }
@@ -245,19 +249,26 @@ def lsp_tlvs_to_json(tlvs: dict) -> dict:
                 ]
             }
         ]
-    if tlvs.get("sr_cap"):
-        base, rng = tlvs["sr_cap"]
-        out["router_cap"] = [
-            {
-                "sub_tlvs": {
-                    "sr_cap": {
-                        "srgb_entries": [
-                            {"range": rng, "first_sid": {"Label": base}}
-                        ]
-                    }
-                }
+    if tlvs.get("ipv4_router_id") is not None:
+        out["ipv4_router_id"] = str(tlvs["ipv4_router_id"])
+    if tlvs.get("ipv6_router_id") is not None:
+        out["ipv6_router_id"] = str(tlvs["ipv6_router_id"])
+    if tlvs.get("sr_cap") or tlvs.get("node_tags") or tlvs.get("cap_router_id") is not None:
+        sub: dict = {}
+        if tlvs.get("sr_cap"):
+            base, rng = tlvs["sr_cap"]
+            sub["sr_cap"] = {
+                "srgb_entries": [
+                    {"range": rng, "first_sid": {"Label": base}}
+                ]
             }
-        ]
+        if tlvs.get("node_tags"):
+            sub["node_tags"] = [list(tlvs["node_tags"])]
+        cap = {"flags": "", "sub_tlvs": sub}
+        rid = tlvs.get("cap_router_id")
+        if rid is not None:
+            cap["router_id"] = str(rid)
+        out["router_cap"] = [cap]
     return out
 
 
@@ -336,6 +347,25 @@ def lsp_tlvs_from_json(j: dict) -> dict:
             )
             for e in _entries_of(j["multi_topology"])
         ]
+    if j.get("ipv4_router_id"):
+        tlvs["ipv4_router_id"] = IPv4Address(j["ipv4_router_id"])
+    if j.get("ipv6_router_id"):
+        tlvs["ipv6_router_id"] = IPv6Address(j["ipv6_router_id"])
+    if j.get("router_cap"):
+        cap = j["router_cap"][0]
+        if cap.get("router_id"):
+            tlvs["cap_router_id"] = IPv4Address(cap["router_id"])
+        sub = cap.get("sub_tlvs") or {}
+        if sub.get("node_tags"):
+            tlvs["node_tags"] = tuple(
+                t for grp in sub["node_tags"] for t in grp
+            )
+        sr = sub.get("sr_cap")
+        if sr and sr.get("srgb_entries"):
+            ent = sr["srgb_entries"][0]
+            first = (ent.get("first_sid") or {}).get("Label")
+            if first is not None:
+                tlvs["sr_cap"] = (first, ent.get("range", 0))
     for key in j:
         if key not in (
             "protocols_supported", "area_addrs", "hostname", "lsp_buf_size",

@@ -119,6 +119,7 @@ class CaseRun:
             protocols=protocols,
         )
         self.inst.hostname = rt
+        self.inst.afs = set(afs)
         self.inst.deferred_origination = True
         self.loop.register(self.inst)
         # Route-diff capture for the ibus plane.
@@ -276,7 +277,7 @@ class CaseRun:
             self.inst.set_hostname(ev["HostnameUpdate"])
             self.loop.run_until_idle()
         elif "RouterIdUpdate" in ev:
-            pass  # consumed only by TE router-id config we model directly
+            self.inst.router_id = IPv4Address(ev["RouterIdUpdate"])
         else:
             raise Unsupported(f"ibus {next(iter(ev))}")
 
@@ -388,6 +389,230 @@ class CaseRun:
                 inst.lsdb.pop(refjson_isis._lsp_id_from(key), None)
         else:
             raise Unsupported(f"protocol {next(iter(ev))}")
+
+
+    # -- northbound config-change / RPC inputs
+
+    def apply_rpc(self, rpc: dict) -> None:
+        if "ietf-isis:clear-adjacency" in rpc:
+            self.inst.clear_adjacencies(
+                ifname=rpc["ietf-isis:clear-adjacency"].get("interface")
+            )
+        elif "ietf-isis:clear-database" in rpc:
+            self.inst.clear_database()
+        else:
+            raise Unsupported(f"rpc {next(iter(rpc))}")
+        self.loop.run_until_idle()
+        self.inst._flush_flooding(srm_only=True)
+
+    def apply_config_change(self, tree: dict) -> None:
+        """Apply a recorded YANG config diff (yang:operation annotations).
+
+        Every annotation must be consumed by a handler; anything else
+        raises Unsupported so unmodeled config never fake-passes."""
+        proto = tree["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]
+        isis = proto.get("ietf-isis:isis", {})
+        inst = self.inst
+        unhandled: list[str] = []
+
+        def op_of(node: dict, leaf: str | None = None):
+            ann = node.get("@" + leaf if leaf else "@") or {}
+            return ann.get("yang:operation")
+
+        handled_at = {"@"}
+
+        def leaf(node, name, anchor=""):
+            handled_at.add(f"{anchor}@{name}")
+            return op_of(node, name)
+
+        if leaf(isis, "enabled") in ("replace", "create"):
+            if isis["enabled"] is False:
+                # Purge our LSPs, then drop all state (instance stop).
+                for lid in list(inst.lsdb):
+                    if lid.sysid == inst.sysid:
+                        inst.purge_lsp(lid)
+                inst.routes = {}
+                self._routes_changed({})
+                self.loop.run_until_idle()
+                inst._flush_flooding(srm_only=True)
+                self.drain_tx()
+                inst.lsdb.clear()
+                inst._plain_raw.clear()
+                for iface in inst.interfaces.values():
+                    iface.adj = None
+                    iface.adjs.clear()
+                    iface.srm.clear()
+                    iface.ssn.clear()
+            else:
+                inst._plain_raw.clear()
+                inst._originate_lsp(force=True)
+        mt = isis.get("metric-type") or {}
+        if op_of(mt, "value") in ("replace", "create"):
+            handled_at.update(("@metric-type", "metric-type"))
+            inst.metric_style = {
+                "old-only": "narrow", "wide-only": "wide", "both": "both"
+            }[mt["value"]]
+            inst._originate_lsp()
+        ov = isis.get("overload") or {}
+        if op_of(ov, "status") in ("replace", "create"):
+            handled_at.update(("@overload", "overload"))
+            inst.overload = bool(ov["status"])
+            inst._originate_lsp()
+        pref = isis.get("preference") or {}
+        if op_of(pref, "default") in ("replace", "create"):
+            handled_at.update(("@preference", "preference"))
+            self.preference = pref["default"]
+            # Distance change reinstalls every route.
+            for prefix, (metric, nhs) in self.inst.routes.items():
+                self.ibus_log.append(("add", prefix, metric, nhs))
+        spfc = isis.get("spf-control") or {}
+        if op_of(spfc, "paths") in ("replace", "create", "delete"):
+            handled_at.update(("@spf-control", "spf-control"))
+            inst.max_paths = (
+                None if op_of(spfc, "paths") == "delete" else spfc["paths"]
+            )
+            inst.run_spf()
+        nt = isis.get("node-tags")
+        if nt is not None:
+            handled_at.update(("@node-tags", "node-tags"))
+            tags = list(inst.node_tags)
+            for t in nt.get("node-tag", []):
+                if op_of(t) == "create" and t["tag"] not in tags:
+                    tags.append(t["tag"])
+                elif op_of(t) == "delete" and t["tag"] in tags:
+                    tags.remove(t["tag"])
+            inst.node_tags = tuple(tags)
+            inst._originate_lsp()
+        terid = (isis.get("mpls") or {}).get("te-rid") or {}
+        if terid:
+            handled_at.update(("@mpls", "mpls"))
+            for name, attr in (
+                ("ipv4-router-id", "te_rid4"),
+                ("ipv6-router-id", "te_rid6"),
+            ):
+                op = op_of(terid, name)
+                if op in ("replace", "create"):
+                    from ipaddress import ip_address
+
+                    setattr(inst, attr, ip_address(terid[name]))
+                elif op == "delete":
+                    setattr(inst, attr, None)
+            inst._originate_lsp()
+        if leaf(isis, "ietf-isis:poi-tlv") in ("replace", "create"):
+            inst.purge_originator = bool(isis["ietf-isis:poi-tlv"])
+        afl = (isis.get("address-families") or {}).get(
+            "address-family-list"
+        )
+        if afl is not None:
+            handled_at.update(("@address-families", "address-families"))
+            for af in afl:
+                name = af["address-family"]
+                if op_of(af) == "delete" or af.get("enabled") is False:
+                    self.afs.discard(name)
+                elif op_of(af) == "create" or af.get("enabled"):
+                    self.afs.add(name)
+            inst.protocols = (
+                [0xCC] if "ipv4" in self.afs else []
+            ) + ([0x8E] if "ipv6" in self.afs else [])
+            inst.afs = set(self.afs)
+            inst._originate_lsp()
+        for if_node in (isis.get("interfaces") or {}).get("interface", []):
+            handled_at.update(("@interfaces", "interfaces"))
+            ifname = if_node["name"]
+            iface = inst.interfaces.get(ifname)
+            if op_of(if_node) == "delete":
+                if ifname in self.up:
+                    inst.if_down(ifname)
+                    self.up.discard(ifname)
+                self.if_conf.pop(ifname, None)
+                # Routes keep their entries but lose next hops through
+                # the deleted circuit (stale until the next SPF).
+                for prefix, (metric, nhs) in list(inst.routes.items()):
+                    kept = frozenset(
+                        nh for nh in nhs if nh[0] != ifname
+                    )
+                    if kept != nhs:
+                        inst.routes[prefix] = (metric, kept)
+                        self.prev_routes[prefix] = (metric, kept)
+                        self.ibus_log.append(("add", prefix, metric, kept))
+                inst._originate_lsp()
+                continue
+            for key in if_node:
+                if not key.startswith("@") or key == "@":
+                    continue
+                name = key[1:]
+                op = op_of(if_node, name)
+                if name == "enabled":
+                    if if_node["enabled"] is False and ifname in self.up:
+                        inst.if_down(ifname)
+                        self.up.discard(ifname)
+                        inst._originate_lsp()
+                    elif if_node["enabled"] and ifname not in self.up:
+                        self._ensure_iface(ifname)
+                        iface = inst.interfaces.get(ifname)
+                elif name == "passive":
+                    if ifname in self.if_conf:
+                        self.if_conf[ifname]["passive"] = bool(
+                            if_node["passive"]
+                        )
+                    if iface is not None:
+                        iface.config.passive = bool(if_node["passive"])
+                        if iface.config.passive:
+                            iface.adj = None
+                            iface.adjs.clear()
+                            inst._adj_changed()
+                        else:
+                            inst._send_hello(ifname)
+                else:
+                    unhandled.append(f"iface leaf {name}")
+            metric = if_node.get("metric") or {}
+            if op_of(metric, "value") in ("replace", "create"):
+                if ifname in self.if_conf:
+                    self.if_conf[ifname].setdefault("metric", {})[
+                        "value"
+                    ] = metric["value"]
+                if iface is not None:
+                    iface.config.metric = metric["value"]
+                    inst._originate_lsp()
+            elif set(metric) - {"value", "@value"}:
+                unhandled.append("iface metric")
+            af_sub = (if_node.get("address-families") or {}).get(
+                "address-family-list"
+            )
+            if af_sub is not None:
+                unhandled.append("iface address-families")
+            if if_node.get("bfd"):
+                unhandled.append("iface bfd")
+            if if_node.get("holo-isis:extended-sequence-number"):
+                unhandled.append("iface ext-seqnum")
+        for key in isis:
+            if key.startswith("@") and key not in handled_at:
+                unhandled.append(f"isis leaf {key[1:]}")
+            elif not key.startswith("@") and key not in (
+                "enabled", "metric-type", "overload", "preference",
+                "spf-control", "node-tags", "mpls", "ietf-isis:poi-tlv",
+                "address-families", "interfaces", "level-type",
+                "system-id", "area-address", "lsp-mtu",
+                "ietf-isis-sr-mpls:segment-routing",
+                "holo-isis:attached-bit",
+                "holo-isis:inter-level-propagation-policies",
+            ):
+                unhandled.append(f"isis node {key}")
+        if isis.get("ietf-isis-sr-mpls:segment-routing"):
+            unhandled.append("segment-routing")
+        if isis.get("holo-isis:attached-bit"):
+            unhandled.append("attached-bit")
+        if isis.get("holo-isis:inter-level-propagation-policies"):
+            unhandled.append("inter-level-propagation")
+        if unhandled:
+            raise Unsupported("; ".join(sorted(set(unhandled))[:4]))
+        self.loop.run_until_idle()
+        if inst._orig_pending:
+            inst.originate_pending()
+            self.loop.run_until_idle()
+        inst._flush_flooding(srm_only=True)
 
     def bring_up(self) -> None:
         for line in (self.rt_dir / "events.jsonl").read_text().splitlines():
@@ -655,10 +880,12 @@ def run_case(case_dir: Path, topo: str, rt: str):
                             run.apply_ibus(ev)
                         else:
                             run.apply_protocol(ev)
-            for suffix in ("northbound-config-change", "northbound-rpc"):
-                f = case_dir / f"{step}-input-{suffix}.json"
-                if f.exists():
-                    raise Unsupported(suffix)
+            f = case_dir / f"{step}-input-northbound-config-change.json"
+            if f.exists():
+                run.apply_config_change(json.loads(f.read_text()))
+            f = case_dir / f"{step}-input-northbound-rpc.json"
+            if f.exists():
+                run.apply_rpc(json.loads(f.read_text()))
         except Unsupported as e:
             return "skip", f"step {step}: {e}"
         # Self-posted deferred events (origination enqueued by the step's
