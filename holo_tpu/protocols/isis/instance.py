@@ -35,6 +35,19 @@ from holo_tpu.utils.bytesbuf import DecodeError
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
 
+def _sid_flags(psid) -> int:
+    """RFC 8667 §2.1 prefix-SID flags from config: no-PHP (P) and
+    explicit-null (E)."""
+    if psid is None:
+        return 0
+    flags = 0
+    if getattr(psid, "no_php", False):
+        flags |= 0x20
+    if getattr(psid, "explicit_null", False):
+        flags |= 0x10
+    return flags
+
+
 class _McastMac(str):
     """L2 multicast destination stand-in (AllISs); the fabric checks
     ``is_multicast`` like it does for IP groups."""
@@ -69,11 +82,17 @@ class IsisIfConfig:
     # hellos are sent and no adjacencies form.
     passive: bool = False
     loopback: bool = False  # RFC 7794 N-flag eligibility
+    # Per-circuit enabled address families (None = instance AFs).
+    afs: object = None
+    # RFC 8491 Link MSD ({msd-type: value}) from the kernel interface.
+    msd: dict = None
 
 
 @dataclass
 class Adjacency:
     sysid: bytes
+    # RFC 8667 §2.2 adjacency SIDs ((flags, weight, label), ...).
+    adj_sids: tuple = ()
     state: AdjacencyState = AdjacencyState.DOWN
     hold_time: int = 9
     addr: IPv4Address | None = None
@@ -243,12 +262,32 @@ class IsisInstance(Actor):
         self.overload = False
         # Enabled address families gate route installation per AF.
         self.afs = {"ipv4", "ipv6"}
+        # IS-type bits advertised in our LSP flags (ISO 10589 §9.9:
+        # IS_TYPE1 always; IS_TYPE2 when the router runs L2).
+        self.is_type = 0x03
+        # Level-all coupling hooks (protocols.isis.multi): L1 queries
+        # att_cb() for the ATT bit; L2 merges extra_reach_cb()'s
+        # propagated L1 reachability into its LSP.
+        self.att_cb = None
+        self.extra_reach_cb = None
+        # ISO 10589 §7.2.9.2 receive-side ATT handling can be disabled.
+        self.att_ignore = False
+        # sysid -> SPT distance from the last SPF (L1->L2 propagation).
+        self.vertex_dist: dict = {}
         # RFC 8668-style ECMP clamp (reference spf.rs:920-929).
         self.max_paths: int | None = None
         # RFC 7981 node administrative tags (router-capability sub-TLV).
         self.node_tags: tuple = ()
+        # RFC 8491 node MSD advertisement ({msd-type: value}).
+        self.node_msd: dict = {}
         # RFC 6232 purge originator identification.
         self.purge_originator = False
+        # Redistributed routes ({prefix: metric}) -> external reach.
+        self.redist: dict = {}
+        # RFC 8667 adjacency-SID label allocator (v4+v6 per adjacency).
+        # A mutable box so a level-all composition can share one
+        # node-wide label space across its L1/L2 instances.
+        self._adj_sid_box = [16]
         # System IPv4 router id (ibus RouterIdUpdate): the router-
         # capability TLV's router-id when no TE rid overrides it.
         self.router_id: IPv4Address | None = None
@@ -601,6 +640,20 @@ class IsisInstance(Actor):
         self._originate_lsp(force=True)
         self._schedule_spf()
 
+    def sr_allocate_adj_sids(self) -> None:
+        """Allocate v4+v6 adjacency-SID labels for every up adjacency
+        that lacks them (RFC 8667 §2.2; V|L value/local label form)."""
+        for iface in self.interfaces.values():
+            for adj in iface.up_adjacencies():
+                if not adj.adj_sids:
+                    v4 = self._adj_sid_box[0]
+                    v6 = v4 + 1
+                    self._adj_sid_box[0] = v4 + 2
+                    adj.adj_sids = (
+                        (0x30, 0, v4),  # V|L
+                        (0xB0, 0, v6),  # F|V|L
+                    )
+
     def set_hostname(self, hostname: str) -> None:
         """RFC 5301: our dynamic hostname changed; re-originate."""
         if hostname != self.hostname:
@@ -749,11 +802,16 @@ class IsisInstance(Actor):
         )
         for iface in self.interfaces.values():
             metric = iface.config.metric
-            for ip, net in iface.v4_addresses():
+            if_afs = (
+                iface.config.afs
+                if iface.config.afs is not None
+                else self.afs
+            )
+            for ip, net in iface.v4_addresses() if "ipv4" in if_afs else []:
                 if ip not in ip4_addrs:
                     ip4_addrs.append(ip)
                 ip4_prefixes.setdefault(net, (metric, iface))
-            for ip6, net6 in iface.v6_addresses():
+            for ip6, net6 in iface.v6_addresses() if "ipv6" in if_afs else []:
                 if ip6 is not None and ip6 not in ip6_addrs:
                     ip6_addrs.append(ip6)
                 if net6 is not None and net6 not in ip6_reach_map:
@@ -768,6 +826,7 @@ class IsisInstance(Actor):
                     ip6_reach_map[net6] = ExtIpReach(
                         net6, metric,
                         sid_index=psid6.index if psid6 is not None else None,
+                        sid_flags=_sid_flags(psid6),
                         attr_flags=attr or None,
                         src_rid4=self.te_rid4,
                         src_rid6=self.te_rid6,
@@ -776,12 +835,20 @@ class IsisInstance(Actor):
                 lla = iface.addr6
                 if lla not in ip6_addrs and not lla.is_link_local:
                     ip6_addrs.append(lla)
+            link_msd = (
+                tuple(sorted(iface.config.msd.items()))
+                if iface.config.msd
+                else None
+            )
+            sr_on = self.sr is not None and self.sr.enabled
             if iface.is_lan:
                 if iface.dis_lan_id is not None and iface.up_adjacencies():
                     # LAN: advertise reach to the pseudonode.
                     if wide:
                         is_reach.append(
-                            ExtIsReach(iface.dis_lan_id, metric)
+                            ExtIsReach(
+                                iface.dis_lan_id, metric, link_msd=link_msd
+                            )
                         )
                     if narrow:
                         narrow_is.append(
@@ -793,7 +860,15 @@ class IsisInstance(Actor):
             elif iface.adj is not None and iface.adj.state == AdjacencyState.UP:
                 if wide:
                     is_reach.append(
-                        ExtIsReach(iface.adj.sysid + b"\x00", metric)
+                        ExtIsReach(
+                            iface.adj.sysid + b"\x00", metric,
+                            link_msd=link_msd,
+                            adj_sids=(
+                                iface.adj.adj_sids
+                                if sr_on and iface.adj.adj_sids
+                                else None
+                            ),
+                        )
                     )
                 if narrow:
                     narrow_is.append(
@@ -819,6 +894,7 @@ class IsisInstance(Actor):
                     ExtIpReach(
                         net, metric,
                         sid_index=psid.index if psid is not None else None,
+                        sid_flags=_sid_flags(psid),
                         attr_flags=attr or None,
                         src_rid4=self.te_rid4,
                         src_rid6=self.te_rid6,
@@ -828,6 +904,26 @@ class IsisInstance(Actor):
                 narrow_ip.append(
                     ExtIpReach(net, min(metric, MAX_NARROW_METRIC))
                 )
+        # Redistributed routes: RFC 1195 external reach (TLV 130 narrow;
+        # wide entries share TLV 135; v6 entries set the X bit).
+        narrow_ext = []
+        for net in sorted(
+            self.redist,
+            key=lambda p: (p.version, int(p.network_address), p.prefixlen),
+        ):
+            metric = self.redist[net]
+            if net.version == 4:
+                if narrow:
+                    narrow_ext.append(
+                        ExtIpReach(
+                            net, min(metric, MAX_NARROW_METRIC),
+                            external=True,
+                        )
+                    )
+                if wide and net not in ip4_prefixes:
+                    ip_reach.append(ExtIpReach(net, metric))
+            elif net not in ip6_reach_map:
+                ip6_reach_map[net] = ExtIpReach(net, metric, external=True)
         ip6_reach = [
             ip6_reach_map[p]
             for p in sorted(
@@ -849,6 +945,7 @@ class IsisInstance(Actor):
             "ext_ip_reach": ip_reach,
             "narrow_is_reach": narrow_is,
             "narrow_ip_reach": narrow_ip,
+            "narrow_ip_ext_reach": narrow_ext,
             "ip_addresses": ip4_addrs,
             "ipv6_reach": ip6_reach,
             "ipv6_addresses": ip6_addrs,
@@ -861,9 +958,18 @@ class IsisInstance(Actor):
             tlvs["lsp_buf_size"] = self.lsp_mtu
         if self.node_tags:
             tlvs["node_tags"] = tuple(self.node_tags)
-        if self.sr is not None and self.sr.enabled:
+        if self.node_msd:
+            tlvs["node_msd"] = dict(self.node_msd)
+        if (
+            self.sr is not None
+            and self.sr.enabled
+            and getattr(self.sr, "srgb_set", True)
+        ):
             tlvs["sr_cap"] = (self.sr.srgb.lower, self.sr.srgb.size)
-        if tlvs.get("sr_cap") or tlvs.get("node_tags"):
+            if self.sr.srlb:
+                lo, hi = self.sr.srlb
+                tlvs["srlb"] = (lo, hi - lo + 1)
+        if tlvs.get("sr_cap") or tlvs.get("node_tags") or tlvs.get("node_msd"):
             tlvs["cap_router_id"] = self.te_rid4 or self.router_id
         if self.mt_enabled:
             # Membership in the base + ipv6-unicast topologies, v6 reach
@@ -872,8 +978,47 @@ class IsisInstance(Actor):
             tlvs["mt_ipv6_reach"] = [(MT_IPV6, e) for e in ip6_reach]
             tlvs["ipv6_reach"] = []
             tlvs["mt_is_reach"] = [(MT_IPV6, e) for e in is_reach]
+        if self.extra_reach_cb is not None:
+            # Level-all L2: merge propagated L1 reachability (metric
+            # already includes the L1 SPT distance; lowest wins) and
+            # active summaries (lsdb.rs lsp_propagate_l1_to_l2).
+            xnarrow, xwide, xv6, xnarrow_ext = self.extra_reach_cb()
+
+            def _merge(own_list, extra):
+                have = {r.prefix: i for i, r in enumerate(own_list)}
+                for r in extra:
+                    i = have.get(r.prefix)
+                    if i is None:
+                        own_list.append(r)
+                    elif r.metric < own_list[i].metric:
+                        own_list[i] = r
+                own_list.sort(
+                    key=lambda r: (
+                        int(r.prefix.network_address), r.prefix.prefixlen
+                    )
+                )
+
+            if narrow:
+                _merge(tlvs["narrow_ip_reach"], xnarrow)
+                _merge(tlvs["narrow_ip_ext_reach"], xnarrow_ext)
+            if wide:
+                _merge(tlvs["ext_ip_reach"], xwide)
+            if self.mt_enabled:
+                # MT routers carry v6 under TLV 237 (topology 2).
+                have6 = {r.prefix for _mt, r in tlvs.get("mt_ipv6_reach", [])}
+                tlvs.setdefault("mt_ipv6_reach", []).extend(
+                    (MT_IPV6, r) for r in xv6 if r.prefix not in have6
+                )
+            else:
+                _merge(tlvs["ipv6_reach"], xv6)
         seqno = max((old.lsp.seqno + 1) if old else 1, min_seqno)
-        flags = 0x03 | (0x04 if self.overload else 0)
+        flags = self.is_type | (0x04 if self.overload else 0)
+        if (
+            self.att_cb is not None
+            and not self.overload
+            and self.att_cb()
+        ):
+            flags |= 0x40  # ATT (default-metric bit)
         lsp = Lsp(self.level, LSP_MAX_AGE, lsp_id, seqno, flags=flags, tlvs=tlvs)
         # Content comparison uses the UNauthenticated bytes: the auth
         # digest covers the seqno, so authenticated raw always differs.
@@ -1410,6 +1555,11 @@ class IsisInstance(Actor):
 
         topo, atoms4 = _build(lambda k, node: node["is"], 0)
         res4 = self.backend.compute(topo)
+        self.vertex_dist = {
+            k[:6]: int(res4.dist[index[k]])
+            for k in nodes
+            if k[6] == 0 and res4.dist[index[k]] < INF
+        }
         # IPv6 path: routers running MT (RFC 5120) keep IPv6 in topology
         # 2 — a separate graph (pseudonodes contribute their plain TLV-22
         # membership; the mutual filter prunes members without an MT-2
@@ -1519,7 +1669,11 @@ class IsisInstance(Actor):
                 (False, res4, atoms4, 0xCC, IPv4Network("0.0.0.0/0")),
                 (True, res6, atoms6, 0x8E, IPv6Network("::/0")),
             ):
+                if not (af6 if want_v6 else af4):
+                    continue  # address family disabled
                 mt_id = MT_IPV6 if (want_v6 and mt6) else 0
+                if self.att_ignore:
+                    continue  # §7.2.9.2 disabled by configuration
                 if _att(nodes[self_key], mt_id):
                     continue  # we are an exit ourselves in this topology
                 best = None

@@ -1,0 +1,233 @@
+"""Level-all (L1/L2) IS-IS: two single-level instances coupled per
+ISO 10589 + RFC 1195 inter-level rules.
+
+Reference: holo-isis runs one instance with per-level state; this
+composition reproduces its externally observable behavior —
+
+- shared circuits: hellos with circuit-type L1L2 feed both levels,
+  LSPs/SNPs dispatch on their PDU level;
+- L1->L2 route propagation (lsdb.rs lsp_propagate_l1_to_l2): each L1
+  router's reachability joins our L2 LSP with metric increased by the
+  L1 SPT distance, R-flag set on wide entries, deduped lowest-metric,
+  minus prefixes covered by configured summaries (which are advertised
+  instead, at their lowest contributing metric);
+- the ATT bit on our L1 LSP while an up L2 adjacency reaches another
+  area (instance.rs is_l2_attached_to_backbone), unless suppressed;
+- merged route table with L1 preferred over L2 for equal prefixes.
+"""
+
+from __future__ import annotations
+
+from holo_tpu.protocols.isis.instance import (
+    IsisInstance,
+    AdjacencyState,
+)
+from holo_tpu.protocols.isis.packet import (
+    MAX_NARROW_METRIC,
+    PREFIX_ATTR_R,
+    ExtIpReach,
+    PduType,
+)
+
+
+class IsisLevelAllInstance:
+    """Facade over an L1 and an L2 IsisInstance sharing the circuits."""
+
+    def __init__(self, name: str, sysid: bytes, area: bytes, netio=None,
+                 spf_backend_factory=None, route_cb=None, **kw):
+        self.name = name
+        self.sysid = sysid
+        self.route_cb = route_cb
+        mk = spf_backend_factory or (lambda: None)
+        self.l1 = IsisInstance(
+            f"{name}-l1", sysid, area, level=1, netio=netio,
+            spf_backend=mk(), **kw,
+        )
+        self.l2 = IsisInstance(
+            f"{name}-l2", sysid, area, level=2, netio=netio,
+            spf_backend=mk(), **kw,
+        )
+        for inst in (self.l1, self.l2):
+            inst.is_type = 0x03
+            inst.route_cb = self._level_routes_changed
+        # One node-wide adjacency-SID label space across both levels.
+        self.l2._adj_sid_box = self.l1._adj_sid_box
+        self.l1.att_cb = self._l2_attached
+        self.l2.extra_reach_cb = self._propagated_reach
+        self.att_suppress = False
+        # {v4/v6 prefix: metric-or-None} — summary config (l1-to-l2).
+        self.summaries: dict = {}
+        # Active summaries (prefix -> advertised metric): installed as
+        # discard routes for loop prevention.  Entries that become
+        # inactive linger in the RIB until the next SPF run (the
+        # reference uninstalls summary routes during route calc only).
+        self._summary_routes: dict = {}
+        self._lingering_summaries: dict = {}
+        self.routes: dict = {}
+
+    # -- shared-circuit plumbing
+
+    def instances(self):
+        return (self.l1, self.l2)
+
+    def level(self, n: int) -> IsisInstance:
+        return self.l1 if n == 1 else self.l2
+
+    def attach_loop(self, loop) -> None:
+        loop.register(self.l1)
+        loop.register(self.l2)
+
+    def add_interface(self, ifname, cfg, addr, prefix, **kw):
+        import copy
+
+        for inst in self.instances():
+            inst.add_interface(ifname, copy.copy(cfg), addr, prefix, **kw)
+
+    def if_up(self, ifname: str) -> None:
+        for inst in self.instances():
+            inst.if_up(ifname)
+
+    def if_down(self, ifname: str) -> None:
+        for inst in self.instances():
+            inst.if_down(ifname)
+
+    def rx_pdu(self, ifname: str, pdu_type: PduType, pdu, snpa: bytes = b"") -> None:
+        """Dispatch by PDU level; L1L2 p2p hellos feed both levels."""
+        if pdu_type == PduType.HELLO_P2P:
+            ct = pdu.circuit_type
+            if ct & 1:
+                self.l1.rx_pdu(ifname, pdu_type, pdu, snpa)
+            if ct & 2:
+                self.l2.rx_pdu(ifname, pdu_type, pdu, snpa)
+            return
+        level = getattr(pdu, "level", 2)
+        self.level(level).rx_pdu(ifname, pdu_type, pdu, snpa)
+
+    # -- inter-level coupling
+
+    def _l2_attached(self) -> bool:
+        """ATT: an up L2 adjacency whose area addresses are all foreign
+        (instance.rs:577-591)."""
+        if self.att_suppress:
+            return False
+        ours = {self.l2.area}
+        for iface in self.l2.interfaces.values():
+            for adj in iface.up_adjacencies():
+                areas = set(adj.area_addresses)
+                if areas and areas.isdisjoint(ours):
+                    return True
+        return False
+
+    def _propagated_reach(self):
+        """L1 LSDB -> L2 LSP reachability (lsp_propagate_l1_to_l2)."""
+        narrow: dict = {}
+        narrow_ext: dict = {}
+        wide: dict = {}
+        v6: dict = {}
+        summary_active: dict = {}  # prefix -> lowest contributing metric
+
+        def covered(prefix):
+            for sp in self.summaries:
+                if (
+                    sp.version == prefix.version
+                    and prefix.subnet_of(sp)
+                ):
+                    return sp
+            return None
+
+        now = self.l1.loop.clock.now() if self.l1.loop else 0.0
+        for lid, e in self.l1.lsdb.items():
+            if (
+                e.lsp.seqno == 0
+                or e.remaining_lifetime(now) == 0
+                or lid.pseudonode != 0
+                or lid.sysid == self.sysid
+            ):
+                continue
+            dist = self.l1.vertex_dist.get(lid.sysid)
+            if dist is None:
+                continue
+            tlvs = e.lsp.tlvs
+
+            def _prop(entries, out, is_wide):
+                for r in entries:
+                    if r.up_down:
+                        continue
+                    total = r.metric + dist
+                    sp = covered(r.prefix)
+                    if sp is not None:
+                        cur = summary_active.get(sp)
+                        if cur is None or total < cur:
+                            summary_active[sp] = total
+                        continue
+                    cur = out.get(r.prefix)
+                    if cur is not None and cur.metric <= total:
+                        continue
+                    if is_wide:
+                        out[r.prefix] = ExtIpReach(
+                            r.prefix, total, external=r.external,
+                            attr_flags=(r.attr_flags or 0) | PREFIX_ATTR_R,
+                            sid_index=r.sid_index,
+                            src_rid4=r.src_rid4, src_rid6=r.src_rid6,
+                        )
+                    else:
+                        out[r.prefix] = ExtIpReach(
+                            r.prefix, min(total, MAX_NARROW_METRIC),
+                            external=r.external,
+                        )
+
+            _prop(tlvs.get("narrow_ip_reach", []), narrow, False)
+            _prop(tlvs.get("narrow_ip_ext_reach", []), narrow_ext, False)
+            _prop(tlvs.get("ext_ip_reach", []), wide, True)
+            _prop(tlvs.get("ipv6_reach", []), v6, True)
+        # Active summaries advertise at their lowest contributing metric
+        # (or the configured metric when set).
+        old = dict(self._summary_routes)
+        self._summary_routes = {}
+        for sp, metric in summary_active.items():
+            cfg_metric = self.summaries.get(sp)
+            m = cfg_metric if cfg_metric is not None else metric
+            self._summary_routes[sp] = m
+            entry = ExtIpReach(
+                sp, m,
+                src_rid4=self.l2.te_rid4, src_rid6=self.l2.te_rid6,
+            )
+            if sp.version == 4:
+                narrow[sp] = ExtIpReach(sp, min(m, MAX_NARROW_METRIC))
+                wide[sp] = entry
+            else:
+                v6[sp] = entry
+        for sp, m in old.items():
+            if sp not in self._summary_routes:
+                self._lingering_summaries[sp] = m
+        return (
+            list(narrow.values()),
+            list(wide.values()),
+            list(v6.values()),
+            list(narrow_ext.values()),
+        )
+
+    # -- merged routes (L1 preferred over L2)
+
+    def _level_routes_changed(self, _routes) -> None:
+        merged = dict(self.l2.routes)
+        merged.update(self.l1.routes)
+        # Active summary prefixes install as nexthop-less discard routes
+        # (loop prevention for the aggregated advertisement).
+        for sp, metric in {
+            **self._lingering_summaries, **self._summary_routes
+        }.items():
+            merged[sp] = (metric, frozenset())
+        self.routes = merged
+        if self.route_cb is not None:
+            self.route_cb(merged)
+
+    def run_spf(self, level: int | None = None) -> None:
+        for inst in self.instances():
+            if level is None or inst.level == level:
+                inst.run_spf()
+        # SPF is where stale summary discard routes finally leave.
+        self._lingering_summaries = {}
+        # An L1 topology change alters our L2 LSP (propagation).
+        self.l2._originate_lsp()
+        self._level_routes_changed({})

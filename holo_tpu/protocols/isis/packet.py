@@ -80,6 +80,11 @@ class LspId:
 class ExtIsReach:
     neighbor: bytes  # sysid + pseudonode byte (7 bytes)
     metric: int
+    # RFC 8491 Link MSD sub-TLV: ((msd-type, value), ...) or None.
+    link_msd: tuple | None = None
+    # RFC 8667 §2.2 Adjacency-SIDs: ((flags, weight, label), ...).
+    # Flags: F=0x80 B=0x40 V=0x20 L=0x10 S=0x08 P=0x04.
+    adj_sids: tuple | None = None
 
 
 @dataclass(frozen=True)
@@ -92,6 +97,7 @@ class ExtIpReach:
     external: bool = False
     # RFC 8667 §2.1 Prefix-SID sub-TLV (index form) when not None.
     sid_index: int | None = None
+    sid_flags: int = 0  # R=0x80 N=0x40 P=0x20 E=0x10 V=0x08 L=0x04
     # RFC 7794 prefix attributes (wide v4 + v6 only): raw flags byte
     # (X=0x80 external, R=0x40 re-advertisement, N=0x20 node) and the
     # source-router-id sub-TLVs.
@@ -130,7 +136,13 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
     if tlvs.get("protocols_supported") is not None:
         body = bytes(tlvs["protocols_supported"])
         w.u8(TlvType.PROTOCOLS_SUPPORTED).u8(len(body)).bytes(body)
-    if tlvs.get("sr_cap") or tlvs.get("node_tags") or tlvs.get("cap_router_id") is not None:
+    if (
+        tlvs.get("sr_cap")
+        or tlvs.get("srlb")
+        or tlvs.get("node_tags")
+        or tlvs.get("node_msd")
+        or tlvs.get("cap_router_id") is not None
+    ):
         # Router Capability (RFC 7981): router id + flags, then the
         # RFC 8667 §3.1 SR-Capabilities sub-TLV (flags + one SRGB
         # descriptor: range u24 + SID/Label sub-TLV type 1 with the base
@@ -144,9 +156,23 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             sub += srgb_range.to_bytes(3, "big")
             sub += bytes((1, 3)) + srgb_base.to_bytes(3, "big")
             body += bytes((2, len(sub))) + sub
+            # SR-Algorithm sub-TLV (19): SPF only.
+            body += bytes((19, 1, 0))
+        if tlvs.get("srlb"):
+            lb_base, lb_range = tlvs["srlb"]
+            sub = bytes((0,))  # reserved flags
+            sub += lb_range.to_bytes(3, "big")
+            sub += bytes((1, 3)) + lb_base.to_bytes(3, "big")
+            body += bytes((22, len(sub))) + sub
         if tlvs.get("node_tags"):
             sub = b"".join(t.to_bytes(4, "big") for t in tlvs["node_tags"])
             body += bytes((21, len(sub))) + sub
+        if tlvs.get("node_msd"):
+            # RFC 8491 Node MSD sub-TLV: (type, value) octet pairs.
+            sub = b"".join(
+                bytes((int(t), v)) for t, v in sorted(tlvs["node_msd"].items())
+            )
+            body += bytes((23, len(sub))) + sub
         w.u8(TlvType.ROUTER_CAPABILITY).u8(len(body)).bytes(body)
     if tlvs.get("area_addresses"):
         body = b"".join(bytes((len(a),)) + a for a in tlvs["area_addresses"])
@@ -189,10 +215,27 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             for r in chunk:
                 body += bytes((r.metric & 0x3F, 0x80, 0x80, 0x80)) + r.neighbor
             w.u8(TlvType.IS_REACH).u8(len(body)).bytes(body)
-    for reach in _chunks(tlvs.get("ext_is_reach", []), 23):
-        body = b""
-        for r in reach:
-            body += r.neighbor + r.metric.to_bytes(3, "big") + b"\x00"
+    def _is_entry(r) -> bytes:
+        sub = b""
+        for flags, weight, label in getattr(r, "adj_sids", None) or ():
+            body31 = bytes((flags, weight)) + label.to_bytes(3, "big")
+            sub += bytes((31, len(body31))) + body31
+        if getattr(r, "link_msd", None):
+            msd = b"".join(bytes((int(t), v)) for t, v in r.link_msd)
+            sub += bytes((15, len(msd))) + msd
+        return (
+            r.neighbor + r.metric.to_bytes(3, "big")
+            + bytes((len(sub),)) + sub
+        )
+
+    body = b""
+    for r in tlvs.get("ext_is_reach", []):
+        enc = _is_entry(r)
+        if body and len(body) + len(enc) > 255:
+            w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
+            body = b""
+        body += enc
+    if body:
         w.u8(TlvType.EXT_IS_REACH).u8(len(body)).bytes(body)
     # RFC 5120 §7.2/7.4: MT-prefixed variants of the reach TLVs.  Entries
     # arrive as [(mt_id, entry)]; group per topology, chunk like the
@@ -236,7 +279,8 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
             sub += bytes((12, 16)) + r.src_rid6.packed
         if getattr(r, "sid_index", None) is not None:
             # Prefix-SID sub-TLV (type 3): flags, algo 0, u32 index.
-            sub += bytes((3, 6, 0, 0)) + r.sid_index.to_bytes(4, "big")
+            sub += bytes((3, 6, getattr(r, "sid_flags", 0), 0))
+            sub += r.sid_index.to_bytes(4, "big")
         return sub
 
     def _wide_ip_entry(r) -> bytes:
@@ -329,8 +373,29 @@ def _read_wide_is_entries(body: Reader, out: list) -> None:
         nbr = body.bytes(7)
         metric = body.u24()
         sub_len = body.u8()
-        body.bytes(min(sub_len, body.remaining()))
-        out.append(ExtIsReach(nbr, metric))
+        sub = body.sub(min(sub_len, body.remaining()))
+        link_msd = None
+        adj_sids = []
+        while sub.remaining() >= 2:
+            st = sub.u8()
+            stl = sub.u8()
+            sb = sub.sub(min(stl, sub.remaining()))
+            if st == 15:
+                pairs = []
+                while sb.remaining() >= 2:
+                    pairs.append((sb.u8(), sb.u8()))
+                link_msd = tuple(pairs)
+            elif st == 31 and stl >= 5:
+                flags = sb.u8()
+                weight = sb.u8()
+                label = int.from_bytes(sb.bytes(3), "big")
+                adj_sids.append((flags, weight, label))
+        out.append(
+            ExtIsReach(
+                nbr, metric, link_msd=link_msd,
+                adj_sids=tuple(adj_sids) or None,
+            )
+        )
 
 
 def _read_prefix_subtlvs(body: Reader) -> dict:
@@ -348,6 +413,7 @@ def _read_prefix_subtlvs(body: Reader) -> dict:
             sb.u8()  # algorithm
             if not (flags & 0x0C):  # V/L clear: 4-byte index
                 out["sid_index"] = sb.u32()
+                out["sid_flags"] = flags
         elif st == 4 and stl >= 1:
             out["attr_flags"] = sb.u8()
         elif st == 11 and stl == 4:
@@ -551,6 +617,13 @@ def _decode_tlvs(r: Reader) -> dict:
                         sb.u8()  # length (3)
                         base = int.from_bytes(sb.bytes(3), "big")
                         out["sr_cap"] = (base, rng)
+                elif st == 22 and stl >= 9:
+                    sb.u8()  # reserved
+                    rng = int.from_bytes(sb.bytes(3), "big")
+                    if sb.remaining() >= 5 and sb.u8() == 1:
+                        sb.u8()  # length (3)
+                        base = int.from_bytes(sb.bytes(3), "big")
+                        out["srlb"] = (base, rng)
                 elif st == 21:
                     tags = []
                     while sb.remaining() >= 4:
@@ -558,6 +631,12 @@ def _decode_tlvs(r: Reader) -> dict:
                     out["node_tags"] = tuple(
                         out.get("node_tags", ()) or ()
                     ) + tuple(tags)
+                elif st == 23:
+                    msd = dict(out.get("node_msd") or {})
+                    while sb.remaining() >= 2:
+                        mt = sb.u8()
+                        msd[mt] = sb.u8()
+                    out["node_msd"] = msd
         elif t == TlvType.LSP_ENTRIES:
             while body.remaining() >= 16:
                 lifetime = body.u16()

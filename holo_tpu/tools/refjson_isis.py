@@ -39,6 +39,10 @@ _LSP_FLAGS = [
     ("IS_TYPE2", 0x02), ("IS_TYPE1", 0x01),
 ]
 _ATTR_FLAGS = [("X", PREFIX_ATTR_X), ("R", PREFIX_ATTR_R), ("N", PREFIX_ATTR_N)]
+_SID_FLAGS = [("R", 0x80), ("N", 0x40), ("P", 0x20), ("E", 0x10),
+              ("V", 0x08), ("L", 0x04)]
+_ADJ_SID_FLAGS = [("F", 0x80), ("B", 0x40), ("V", 0x20), ("L", 0x10),
+                  ("S", 0x08), ("P", 0x04)]
 
 
 def _flags_str(value: int, table) -> str:
@@ -81,9 +85,10 @@ def _sub_tlvs_json(r: ExtIpReach) -> dict:
     if r.src_rid6 is not None:
         out["ipv6_source_rid"] = str(r.src_rid6)
     if r.sid_index is not None:
-        out["prefix_sids"] = {
-            "Spf": {"algo": "Spf", "sid": {"Index": r.sid_index}}
-        }
+        sid = {"algo": "Spf", "sid": {"Index": r.sid_index}}
+        flags = _flags_str(getattr(r, "sid_flags", 0), _SID_FLAGS)
+        sid["flags"] = flags
+        out["prefix_sids"] = {"Spf": sid}
     return out
 
 
@@ -99,16 +104,20 @@ def _sub_tlvs_from(j: dict) -> dict:
     spf = sids.get("Spf")
     if spf and "Index" in (spf.get("sid") or {}):
         out["sid_index"] = spf["sid"]["Index"]
+        if spf.get("flags"):
+            out["sid_flags"] = _flags_val(spf["flags"], _SID_FLAGS)
     return out
 
 
-def _narrow_ip_json(entries) -> list:
+def _narrow_ip_json(entries, ext_tlv: bool = False) -> list:
+    # In TLV 130 the whole TLV is external; its entries' I/E bit stays
+    # clear (the reference only sets ie_bit inside TLV 128).
     return [
         {
             "list": [
                 {
                     "up_down": r.up_down,
-                    "ie_bit": bool(r.external),
+                    "ie_bit": False if ext_tlv else bool(r.external),
                     "metric": r.metric,
                     "prefix": str(r.prefix),
                 }
@@ -165,6 +174,23 @@ def _narrow_is_json(entries) -> list:
     ] if entries else []
 
 
+def _is_sub_tlvs_json(r) -> dict:
+    out: dict = {}
+    if r.adj_sids:
+        out["adj_sids"] = [
+            {
+                "flags": _flags_str(flags, _ADJ_SID_FLAGS),
+                "weight": weight,
+                "nbr_system_id": None,
+                "sid": {"Label": label},
+            }
+            for flags, weight, label in r.adj_sids
+        ]
+    if r.link_msd:
+        out["link_msd"] = {str(t): v for t, v in r.link_msd}
+    return out
+
+
 def _wide_is_json(entries) -> list:
     return [
         {
@@ -172,7 +198,7 @@ def _wide_is_json(entries) -> list:
                 {
                     "neighbor": _lan_id_json(r.neighbor),
                     "metric": r.metric,
-                    "sub_tlvs": {},
+                    "sub_tlvs": _is_sub_tlvs_json(r),
                 }
                 for r in entries
             ]
@@ -224,7 +250,9 @@ def lsp_tlvs_to_json(tlvs: dict) -> dict:
     if tlvs.get("narrow_ip_reach"):
         out["ipv4_internal_reach"] = _narrow_ip_json(tlvs["narrow_ip_reach"])
     if tlvs.get("narrow_ip_ext_reach"):
-        out["ipv4_external_reach"] = _narrow_ip_json(tlvs["narrow_ip_ext_reach"])
+        out["ipv4_external_reach"] = _narrow_ip_json(
+            tlvs["narrow_ip_ext_reach"], ext_tlv=True
+        )
     if tlvs.get("ext_ip_reach"):
         out["ext_ipv4_reach"] = _wide_v4_json(tlvs["ext_ip_reach"])
     if tlvs.get("ipv6_addresses"):
@@ -253,17 +281,33 @@ def lsp_tlvs_to_json(tlvs: dict) -> dict:
         out["ipv4_router_id"] = str(tlvs["ipv4_router_id"])
     if tlvs.get("ipv6_router_id") is not None:
         out["ipv6_router_id"] = str(tlvs["ipv6_router_id"])
-    if tlvs.get("sr_cap") or tlvs.get("node_tags") or tlvs.get("cap_router_id") is not None:
+    if (
+        tlvs.get("sr_cap")
+        or tlvs.get("node_tags")
+        or tlvs.get("node_msd")
+        or tlvs.get("cap_router_id") is not None
+    ):
         sub: dict = {}
         if tlvs.get("sr_cap"):
             base, rng = tlvs["sr_cap"]
             sub["sr_cap"] = {
+                "flags": "I | V",
                 "srgb_entries": [
-                    {"range": rng, "first_sid": {"Label": base}}
-                ]
+                    {"range": rng, "first": {"Label": base}}
+                ],
+            }
+            sub["sr_algo"] = ["Spf"]
+        if tlvs.get("srlb"):
+            base, rng = tlvs["srlb"]
+            sub["srlb"] = {
+                "entries": [{"range": rng, "first": {"Label": base}}]
             }
         if tlvs.get("node_tags"):
             sub["node_tags"] = [list(tlvs["node_tags"])]
+        if tlvs.get("node_msd"):
+            sub["node_msd"] = {
+                str(t): v for t, v in sorted(tlvs["node_msd"].items())
+            }
         cap = {"flags": "", "sub_tlvs": sub}
         rid = tlvs.get("cap_router_id")
         if rid is not None:
@@ -360,12 +404,24 @@ def lsp_tlvs_from_json(j: dict) -> dict:
             tlvs["node_tags"] = tuple(
                 t for grp in sub["node_tags"] for t in grp
             )
+        if sub.get("node_msd"):
+            tlvs["node_msd"] = {
+                int(t): v for t, v in sub["node_msd"].items()
+            }
         sr = sub.get("sr_cap")
         if sr and sr.get("srgb_entries"):
             ent = sr["srgb_entries"][0]
-            first = (ent.get("first_sid") or {}).get("Label")
+            first = (ent.get("first") or ent.get("first_sid") or {}).get(
+                "Label"
+            )
             if first is not None:
                 tlvs["sr_cap"] = (first, ent.get("range", 0))
+        lb = sub.get("srlb")
+        if lb and lb.get("entries"):
+            ent = lb["entries"][0]
+            first = (ent.get("first") or {}).get("Label")
+            if first is not None:
+                tlvs["srlb"] = (first, ent.get("range", 0))
     for key in j:
         if key not in (
             "protocols_supported", "area_addrs", "hostname", "lsp_buf_size",
@@ -407,6 +463,34 @@ def _snp_entries_from(j) -> list:
 
 
 # -- PDU-level conversion
+
+def flatten_tlv_occurrences(pdu_json: dict) -> dict:
+    """Merge multi-occurrence TLV arrays ([{"list": [...]}, ...]) into a
+    single occurrence.  Our decoder flattens repeated TLVs (chunk
+    boundaries are wire artifacts), so expected PDUs canonicalize the
+    same way before comparison."""
+    out = json_deepcopy(pdu_json)
+    for body in out.values():
+        tlvs = body.get("tlvs") if isinstance(body, dict) else None
+        if not isinstance(tlvs, dict):
+            continue
+        for key, val in tlvs.items():
+            if (
+                isinstance(val, list)
+                and len(val) > 1
+                and all(isinstance(o, dict) and "list" in o for o in val)
+            ):
+                tlvs[key] = [
+                    {"list": [e for o in val for e in o["list"]]}
+                ]
+    return out
+
+
+def json_deepcopy(x):
+    import copy
+
+    return copy.deepcopy(x)
+
 
 _PDU_TYPE_NAMES = {
     PduType.HELLO_LAN_L1: "HelloLanL1",

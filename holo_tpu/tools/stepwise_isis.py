@@ -83,8 +83,7 @@ class CaseRun:
             "control-plane-protocol"
         ][0]["ietf-isis:isis"]
         lt = proto.get("level-type", "level-all")
-        if lt == "level-all":
-            raise Unsupported("level-all router")
+        self.level_all = lt == "level-all"
         self.level = 1 if lt == "level-1" else 2
         mt = (proto.get("metric-type") or {}).get("value", "wide-only")
         metric_style = {
@@ -108,24 +107,52 @@ class CaseRun:
         self.preference = (proto.get("preference") or {}).get(
             "default", {}
         ).get("value", 115)
-        self.inst = IsisInstance(
-            name=rt,
-            sysid=_parse_area(proto["system-id"]),
-            area=_parse_area(proto["area-address"][0]),
-            level=self.level,
+        from ipaddress import ip_address
+
+        terid = (proto.get("mpls") or {}).get("te-rid") or {}
+        kw = dict(
             netio=self.tx,
             metric_style=metric_style,
             lsp_mtu=proto.get("lsp-mtu", 1492),
             protocols=protocols,
+            te_rid4=(
+                ip_address(terid["ipv4-router-id"])
+                if terid.get("ipv4-router-id")
+                else None
+            ),
+            te_rid6=(
+                ip_address(terid["ipv6-router-id"])
+                if terid.get("ipv6-router-id")
+                else None
+            ),
         )
-        self.inst.hostname = rt
-        self.inst.afs = set(afs)
-        self.inst.deferred_origination = True
-        self.loop.register(self.inst)
+        sysid = _parse_area(proto["system-id"])
+        area = _parse_area(proto["area-address"][0])
         # Route-diff capture for the ibus plane.
         self.prev_routes: dict = {}
         self.ibus_log: list = []
-        self.inst.route_cb = self._routes_changed
+        if self.level_all:
+            from holo_tpu.protocols.isis.multi import IsisLevelAllInstance
+
+            self.node = IsisLevelAllInstance(
+                rt, sysid, area, route_cb=self._routes_changed, **kw
+            )
+            self.insts = list(self.node.instances())
+            self.node.attach_loop(self.loop)
+        else:
+            inst = IsisInstance(
+                name=rt, sysid=sysid, area=area, level=self.level, **kw
+            )
+            if lt == "level-1":
+                inst.is_type = 0x01
+            inst.route_cb = self._routes_changed
+            self.node = inst
+            self.insts = [inst]
+            self.loop.register(inst)
+        for inst in self.insts:
+            inst.hostname = rt
+            inst.afs = set(afs)
+            inst.deferred_origination = True
         # Interface config, keyed by name; arena ids are 1-based config
         # order (the reference's arena insertion order).
         self.if_conf: dict[str, dict] = {}
@@ -148,6 +175,30 @@ class CaseRun:
         for prefix in self.prev_routes.keys() - routes.keys():
             self.ibus_log.append(("del", prefix, None, None))
         self.prev_routes = dict(routes)
+
+    def _remerge(self) -> None:
+        """Refresh the merged route table after L2 re-origination (the
+        active-summary discard routes live in the merge)."""
+        if self.level_all:
+            self.node._level_routes_changed({})
+
+    @property
+    def inst(self):
+        """Single-level instance (back-compat); level-all callers use
+        _by_level/insts."""
+        return self.insts[0]
+
+    def _by_level(self, sub: dict) -> list:
+        """Instances addressed by an event's 'level' field (both when
+        absent on a level-all router)."""
+        lv = sub.get("level") if isinstance(sub, dict) else None
+        if lv in ("L1", 1):
+            want = 1
+        elif lv in ("L2", 2):
+            want = 2
+        else:
+            return list(self.insts)
+        return [i for i in self.insts if i.level == want]
 
     # -- interface lifecycle
 
@@ -187,7 +238,7 @@ class CaseRun:
         hold_mult = (icfg.get("hello-multiplier") or {}).get("value", 3)
         metric = (icfg.get("metric") or {}).get("value", 10)
         prio = (icfg.get("priority") or {}).get("value", 64)
-        self.inst.add_interface(
+        self.node.add_interface(
             ifname,
             IsisIfConfig(
                 metric=metric,
@@ -217,7 +268,7 @@ class CaseRun:
             ),
         )
         self.up.add(ifname)
-        self.inst.if_up(ifname)
+        self.node.if_up(ifname)
         self.loop.run_until_idle()
 
     # -- event application
@@ -230,15 +281,24 @@ class CaseRun:
             operative = "OPERATIVE" in flags
             if upd.get("mac_address"):
                 self.mac[ifname] = bytes(upd["mac_address"])
-                iface = self.inst.interfaces.get(ifname)
-                if iface is not None:
-                    iface.mac = self.mac[ifname]
+                for inst in self.insts:
+                    iface = inst.interfaces.get(ifname)
+                    if iface is not None:
+                        iface.mac = self.mac[ifname]
+            if upd.get("msd"):
+                msd = upd["msd"]
+                for inst in self.insts:
+                    iface = inst.interfaces.get(ifname)
+                    if iface is not None and "BaseMplsImposition" in msd:
+                        iface.config.msd = dict(iface.config.msd or {})
+                        iface.config.msd[1] = msd["BaseMplsImposition"]
+                        inst._originate_lsp()
             if upd.get("ifindex"):
                 self.ifindex[ifname] = upd["ifindex"]
             if operative:
                 self._ensure_iface(ifname)
             elif ifname in self.up:
-                self.inst.if_down(ifname)
+                self.node.if_down(ifname)
                 self.up.discard(ifname)
                 self.loop.run_until_idle()
         elif "InterfaceAddressAdd" in ev:
@@ -252,9 +312,9 @@ class CaseRun:
                 lst.append(addr)
             ifname = upd["ifname"]
             if ifname in self.up:
-                iface = self.inst.interfaces[ifname]
-                self._sync_iface_addrs(iface)
-                self.inst._originate_lsp()
+                for inst in self.insts:
+                    self._sync_iface_addrs(inst.interfaces[ifname])
+                    inst._originate_lsp()
                 self.loop.run_until_idle()
             else:
                 self._ensure_iface(ifname)
@@ -269,15 +329,77 @@ class CaseRun:
                 lst.remove(addr)
             ifname = upd["ifname"]
             if ifname in self.up:
-                iface = self.inst.interfaces[ifname]
-                self._sync_iface_addrs(iface)
-                self.inst._originate_lsp()
+                for inst in self.insts:
+                    self._sync_iface_addrs(inst.interfaces[ifname])
+                    inst._originate_lsp()
                 self.loop.run_until_idle()
         elif "HostnameUpdate" in ev:
-            self.inst.set_hostname(ev["HostnameUpdate"])
+            for inst in self.insts:
+                inst.set_hostname(ev["HostnameUpdate"])
             self.loop.run_until_idle()
         elif "RouterIdUpdate" in ev:
-            self.inst.router_id = IPv4Address(ev["RouterIdUpdate"])
+            for inst in self.insts:
+                inst.router_id = IPv4Address(ev["RouterIdUpdate"])
+        elif "RouteRedistributeAdd" in ev:
+            upd = ev["RouteRedistributeAdd"]
+            from ipaddress import ip_network
+
+            prefix = ip_network(upd["prefix"])
+            for inst in self.insts:
+                inst.redist[prefix] = upd.get("metric", 0)
+                inst._originate_lsp()
+            self.loop.run_until_idle()
+        elif "RouteRedistributeDel" in ev:
+            upd = ev["RouteRedistributeDel"]
+            from ipaddress import ip_network
+
+            prefix = ip_network(upd["prefix"])
+            for inst in self.insts:
+                inst.redist.pop(prefix, None)
+                inst._originate_lsp()
+            self.loop.run_until_idle()
+        elif "SrCfgUpd" in ev:
+            upd = ev["SrCfgUpd"]
+            from ipaddress import ip_network
+
+            from holo_tpu.utils.sr import PrefixSid, SrConfig, Srgb
+
+            srgb_cfg = (upd.get("srgb") or [{}])[0]
+            srgb = Srgb(
+                srgb_cfg.get("lower_bound", 16000),
+                srgb_cfg.get("upper_bound", 23999),
+            )
+            srlb_cfg = (upd.get("srlb") or [None])[0]
+            srlb = (
+                (srlb_cfg["lower_bound"], srlb_cfg["upper_bound"])
+                if srlb_cfg
+                else None
+            )
+            sids = {}
+            for (pfx_algo, cfg) in upd.get("prefix_sids", []):
+                prefix = ip_network(pfx_algo[0])
+                sids[prefix] = PrefixSid(
+                    prefix, cfg["index"],
+                    no_php=cfg.get("last_hop") == "NoPhp",
+                    explicit_null=cfg.get("last_hop") == "ExplicitNull",
+                )
+            enabled = getattr(self, "_sr_enabled", False)
+            for inst in self.insts:
+                inst.sr = SrConfig(
+                    enabled=enabled, srgb=srgb, prefix_sids=sids, srlb=srlb
+                )
+                if enabled:
+                    inst.sr_allocate_adj_sids()
+                    inst._originate_lsp()
+            self.loop.run_until_idle()
+        elif "NodeMsdUpd" in ev:
+            # RFC 8491: BaseMplsImposition is MSD-type 1.
+            msd = ev["NodeMsdUpd"]
+            for inst in self.insts:
+                if "BaseMplsImposition" in msd:
+                    inst.node_msd[1] = msd["BaseMplsImposition"]
+                inst._originate_lsp()
+            self.loop.run_until_idle()
         else:
             raise Unsupported(f"ibus {next(iter(ev))}")
 
@@ -298,7 +420,6 @@ class CaseRun:
             iface.prefix = None
 
     def apply_protocol(self, ev: dict) -> None:
-        inst = self.inst
         if "NetRxPdu" in ev:
             rx = ev["NetRxPdu"]
             ifname = self._iface_by_key(rx.get("iface_key"))
@@ -317,39 +438,60 @@ class CaseRun:
                 if "Err" in pj:
                     return  # decode-error input: instance never sees it
                 pdu_type, pdu = pdu_from_json(pj.get("Ok", pj))
-            # Level scoping: a single-level instance ignores the other
+            # Level scoping: single-level instances ignore the other
             # level's PDUs (the reference's level gating).
             lvl = getattr(pdu, "level", None)
-            if lvl is not None and lvl != self.level:
+            if (
+                not self.level_all
+                and lvl is not None
+                and lvl != self.level
+            ):
                 return
-            inst.rx_pdu(ifname, pdu_type, pdu, snpa)
+            self.node.rx_pdu(ifname, pdu_type, pdu, snpa)
             self.loop.run_until_idle()
-            inst._flush_flooding(srm_only=True)
+            for inst in self.insts:
+                inst._flush_flooding(srm_only=True)
         elif "SendPsnp" in ev:
             ifname = self._iface_by_key(ev["SendPsnp"].get("iface_key"))
             if ifname:
-                inst.send_psnp(ifname)
+                for inst in self._by_level(ev["SendPsnp"]):
+                    inst.send_psnp(ifname)
         elif "SendCsnp" in ev:
             ifname = self._iface_by_key(ev["SendCsnp"].get("iface_key"))
-            if ifname and ifname in inst.interfaces:
-                iface = inst.interfaces[ifname]
-                if iface.is_lan and not iface.we_are_dis(
-                    inst.sysid, iface.circuit_id
-                ):
-                    return
-                inst.send_csnp(ifname)
+            for inst in self._by_level(ev["SendCsnp"]):
+                if ifname and ifname in inst.interfaces:
+                    iface = inst.interfaces[ifname]
+                    if iface.is_lan and not iface.we_are_dis(
+                        inst.sysid, iface.circuit_id
+                    ):
+                        continue
+                    inst.send_csnp(ifname)
         elif "DisElection" in ev:
             ifname = self._iface_by_key(ev["DisElection"].get("iface_key"))
             if ifname:
-                inst.run_dis_election(ifname)
+                for inst in self._by_level(ev["DisElection"]):
+                    inst.run_dis_election(ifname)
                 self.loop.run_until_idle()
         elif "LspOriginate" in ev:
-            inst.originate_pending()
+            for inst in self.insts:
+                inst.originate_pending()
             self.loop.run_until_idle()
-            inst._flush_flooding(srm_only=True)
+            for inst in self.insts:
+                inst._flush_flooding(srm_only=True)
+            self._remerge()
         elif "SpfDelayEvent" in ev:
             if ev["SpfDelayEvent"].get("event") == "DelayTimer":
-                inst.run_spf()
+                if self.level_all:
+                    lv = ev["SpfDelayEvent"].get("level")
+                    self.node.run_spf(
+                        1 if lv == "L1" else 2 if lv == "L2" else None
+                    )
+                    if self.insts[1]._orig_pending:
+                        self.insts[1].originate_pending()
+                    self._remerge()
+                else:
+                    for inst in self._by_level(ev["SpfDelayEvent"]):
+                        inst.run_spf()
                 self.loop.run_until_idle()
         elif "AdjInitLsdbSync" in ev:
             pass  # our adjacency-up path sends the init CSNP inline
@@ -360,33 +502,43 @@ class CaseRun:
                     sub["PointToPoint"].get("iface_key")
                 )
                 if ifname:
-                    self.loop.send(inst.name, HoldTimerMsg(ifname))
+                    for inst in self.insts:
+                        self.loop.send(inst.name, HoldTimerMsg(ifname))
             else:
                 b = sub["Broadcast"]
                 ifname = self._iface_by_key(b.get("iface_key"))
                 sysid = bytes((b.get("adj_key") or {}).get("Value") or b"")
                 if ifname and sysid:
-                    self.loop.send(inst.name, LanHoldTimerMsg(ifname, sysid))
+                    for inst in self._by_level(b):
+                        self.loop.send(
+                            inst.name, LanHoldTimerMsg(ifname, sysid)
+                        )
             self.loop.run_until_idle()
-            inst._flush_flooding(srm_only=True)
+            for inst in self.insts:
+                inst._flush_flooding(srm_only=True)
         elif "LspRefresh" in ev:
             key = (ev["LspRefresh"].get("lse_key") or {}).get("Value")
             if not isinstance(key, dict):
                 raise Unsupported("unmapped LspRefresh key")
-            inst.refresh_lsp(refjson_isis._lsp_id_from(key))
+            for inst in self._by_level(ev["LspRefresh"]):
+                inst.refresh_lsp(refjson_isis._lsp_id_from(key))
             self.loop.run_until_idle()
-            inst._flush_flooding(srm_only=True)
+            for inst in self.insts:
+                inst._flush_flooding(srm_only=True)
         elif "LspPurge" in ev:
             key = (ev["LspPurge"].get("lse_key") or {}).get("Value")
             if not isinstance(key, dict):
                 raise Unsupported("unmapped LspPurge key")
-            inst.purge_lsp(refjson_isis._lsp_id_from(key))
+            for inst in self._by_level(ev["LspPurge"]):
+                inst.purge_lsp(refjson_isis._lsp_id_from(key))
             self.loop.run_until_idle()
-            inst._flush_flooding(srm_only=True)
+            for inst in self.insts:
+                inst._flush_flooding(srm_only=True)
         elif "LspDelete" in ev:
             key = (ev["LspDelete"].get("lse_key") or {}).get("Value")
             if isinstance(key, dict):
-                inst.lsdb.pop(refjson_isis._lsp_id_from(key), None)
+                for inst in self._by_level(ev["LspDelete"]):
+                    inst.lsdb.pop(refjson_isis._lsp_id_from(key), None)
         else:
             raise Unsupported(f"protocol {next(iter(ev))}")
 
@@ -395,15 +547,18 @@ class CaseRun:
 
     def apply_rpc(self, rpc: dict) -> None:
         if "ietf-isis:clear-adjacency" in rpc:
-            self.inst.clear_adjacencies(
-                ifname=rpc["ietf-isis:clear-adjacency"].get("interface")
-            )
+            for inst in self.insts:
+                inst.clear_adjacencies(
+                    ifname=rpc["ietf-isis:clear-adjacency"].get("interface")
+                )
         elif "ietf-isis:clear-database" in rpc:
-            self.inst.clear_database()
+            for inst in self.insts:
+                inst.clear_database()
         else:
             raise Unsupported(f"rpc {next(iter(rpc))}")
         self.loop.run_until_idle()
-        self.inst._flush_flooding(srm_only=True)
+        for inst in self.insts:
+            inst._flush_flooding(srm_only=True)
 
     def apply_config_change(self, tree: dict) -> None:
         """Apply a recorded YANG config diff (yang:operation annotations).
@@ -414,7 +569,6 @@ class CaseRun:
             "control-plane-protocol"
         ][0]
         isis = proto.get("ietf-isis:isis", {})
-        inst = self.inst
         unhandled: list[str] = []
 
         def op_of(node: dict, leaf: str | None = None):
@@ -430,61 +584,74 @@ class CaseRun:
         if leaf(isis, "enabled") in ("replace", "create"):
             if isis["enabled"] is False:
                 # Purge our LSPs, then drop all state (instance stop).
-                for lid in list(inst.lsdb):
-                    if lid.sysid == inst.sysid:
-                        inst.purge_lsp(lid)
-                inst.routes = {}
+                for inst in self.insts:
+                    for lid in list(inst.lsdb):
+                        if lid.sysid == inst.sysid:
+                            inst.purge_lsp(lid)
+                    inst.routes = {}
                 self._routes_changed({})
                 self.loop.run_until_idle()
-                inst._flush_flooding(srm_only=True)
+                for inst in self.insts:
+                    inst._flush_flooding(srm_only=True)
                 self.drain_tx()
-                inst.lsdb.clear()
-                inst._plain_raw.clear()
-                for iface in inst.interfaces.values():
-                    iface.adj = None
-                    iface.adjs.clear()
-                    iface.srm.clear()
-                    iface.ssn.clear()
+                for inst in self.insts:
+                    inst.lsdb.clear()
+                    inst._plain_raw.clear()
+                    for iface in inst.interfaces.values():
+                        iface.adj = None
+                        iface.adjs.clear()
+                        iface.srm.clear()
+                        iface.ssn.clear()
             else:
-                inst._plain_raw.clear()
-                inst._originate_lsp(force=True)
+                for inst in self.insts:
+                    inst._plain_raw.clear()
+                    inst._originate_lsp(force=True)
         mt = isis.get("metric-type") or {}
         if op_of(mt, "value") in ("replace", "create"):
             handled_at.update(("@metric-type", "metric-type"))
-            inst.metric_style = {
-                "old-only": "narrow", "wide-only": "wide", "both": "both"
-            }[mt["value"]]
-            inst._originate_lsp()
+            for inst in self.insts:
+                inst.metric_style = {
+                    "old-only": "narrow", "wide-only": "wide", "both": "both"
+                }[mt["value"]]
+                inst._originate_lsp()
         ov = isis.get("overload") or {}
         if op_of(ov, "status") in ("replace", "create"):
             handled_at.update(("@overload", "overload"))
-            inst.overload = bool(ov["status"])
-            inst._originate_lsp()
+            for inst in self.insts:
+                inst.overload = bool(ov["status"])
+                inst._originate_lsp()
         pref = isis.get("preference") or {}
         if op_of(pref, "default") in ("replace", "create"):
             handled_at.update(("@preference", "preference"))
             self.preference = pref["default"]
             # Distance change reinstalls every route.
-            for prefix, (metric, nhs) in self.inst.routes.items():
+            routes = (
+                self.node.routes if self.level_all else self.inst.routes
+            )
+            for prefix, (metric, nhs) in routes.items():
                 self.ibus_log.append(("add", prefix, metric, nhs))
         spfc = isis.get("spf-control") or {}
         if op_of(spfc, "paths") in ("replace", "create", "delete"):
             handled_at.update(("@spf-control", "spf-control"))
-            inst.max_paths = (
-                None if op_of(spfc, "paths") == "delete" else spfc["paths"]
-            )
-            inst.run_spf()
+            for inst in self.insts:
+                inst.max_paths = (
+                    None
+                    if op_of(spfc, "paths") == "delete"
+                    else spfc["paths"]
+                )
+                inst.run_spf()
         nt = isis.get("node-tags")
         if nt is not None:
             handled_at.update(("@node-tags", "node-tags"))
-            tags = list(inst.node_tags)
+            tags = list(self.inst.node_tags)
             for t in nt.get("node-tag", []):
                 if op_of(t) == "create" and t["tag"] not in tags:
                     tags.append(t["tag"])
                 elif op_of(t) == "delete" and t["tag"] in tags:
                     tags.remove(t["tag"])
-            inst.node_tags = tuple(tags)
-            inst._originate_lsp()
+            for inst in self.insts:
+                inst.node_tags = tuple(tags)
+                inst._originate_lsp()
         terid = (isis.get("mpls") or {}).get("te-rid") or {}
         if terid:
             handled_at.update(("@mpls", "mpls"))
@@ -493,15 +660,18 @@ class CaseRun:
                 ("ipv6-router-id", "te_rid6"),
             ):
                 op = op_of(terid, name)
-                if op in ("replace", "create"):
-                    from ipaddress import ip_address
+                for inst in self.insts:
+                    if op in ("replace", "create"):
+                        from ipaddress import ip_address
 
-                    setattr(inst, attr, ip_address(terid[name]))
-                elif op == "delete":
-                    setattr(inst, attr, None)
-            inst._originate_lsp()
+                        setattr(inst, attr, ip_address(terid[name]))
+                    elif op == "delete":
+                        setattr(inst, attr, None)
+            for inst in self.insts:
+                inst._originate_lsp()
         if leaf(isis, "ietf-isis:poi-tlv") in ("replace", "create"):
-            inst.purge_originator = bool(isis["ietf-isis:poi-tlv"])
+            for inst in self.insts:
+                inst.purge_originator = bool(isis["ietf-isis:poi-tlv"])
         afl = (isis.get("address-families") or {}).get(
             "address-family-list"
         )
@@ -513,31 +683,34 @@ class CaseRun:
                     self.afs.discard(name)
                 elif op_of(af) == "create" or af.get("enabled"):
                     self.afs.add(name)
-            inst.protocols = (
-                [0xCC] if "ipv4" in self.afs else []
-            ) + ([0x8E] if "ipv6" in self.afs else [])
-            inst.afs = set(self.afs)
-            inst._originate_lsp()
+            for inst in self.insts:
+                inst.protocols = (
+                    [0xCC] if "ipv4" in self.afs else []
+                ) + ([0x8E] if "ipv6" in self.afs else [])
+                inst.afs = set(self.afs)
+                inst._originate_lsp()
         for if_node in (isis.get("interfaces") or {}).get("interface", []):
             handled_at.update(("@interfaces", "interfaces"))
             ifname = if_node["name"]
-            iface = inst.interfaces.get(ifname)
             if op_of(if_node) == "delete":
                 if ifname in self.up:
-                    inst.if_down(ifname)
+                    self.node.if_down(ifname)
                     self.up.discard(ifname)
                 self.if_conf.pop(ifname, None)
                 # Routes keep their entries but lose next hops through
                 # the deleted circuit (stale until the next SPF).
-                for prefix, (metric, nhs) in list(inst.routes.items()):
-                    kept = frozenset(
-                        nh for nh in nhs if nh[0] != ifname
-                    )
-                    if kept != nhs:
-                        inst.routes[prefix] = (metric, kept)
-                        self.prev_routes[prefix] = (metric, kept)
-                        self.ibus_log.append(("add", prefix, metric, kept))
-                inst._originate_lsp()
+                for inst in self.insts:
+                    for prefix, (metric, nhs) in list(inst.routes.items()):
+                        kept = frozenset(
+                            nh for nh in nhs if nh[0] != ifname
+                        )
+                        if kept != nhs:
+                            inst.routes[prefix] = (metric, kept)
+                            self.prev_routes[prefix] = (metric, kept)
+                            self.ibus_log.append(
+                                ("add", prefix, metric, kept)
+                            )
+                    inst._originate_lsp()
                 continue
             for key in if_node:
                 if not key.startswith("@") or key == "@":
@@ -546,18 +719,21 @@ class CaseRun:
                 op = op_of(if_node, name)
                 if name == "enabled":
                     if if_node["enabled"] is False and ifname in self.up:
-                        inst.if_down(ifname)
+                        self.node.if_down(ifname)
                         self.up.discard(ifname)
-                        inst._originate_lsp()
+                        for inst in self.insts:
+                            inst._originate_lsp()
                     elif if_node["enabled"] and ifname not in self.up:
                         self._ensure_iface(ifname)
-                        iface = inst.interfaces.get(ifname)
                 elif name == "passive":
                     if ifname in self.if_conf:
                         self.if_conf[ifname]["passive"] = bool(
                             if_node["passive"]
                         )
-                    if iface is not None:
+                    for inst in self.insts:
+                        iface = inst.interfaces.get(ifname)
+                        if iface is None:
+                            continue
                         iface.config.passive = bool(if_node["passive"])
                         if iface.config.passive:
                             iface.adj = None
@@ -573,16 +749,34 @@ class CaseRun:
                     self.if_conf[ifname].setdefault("metric", {})[
                         "value"
                     ] = metric["value"]
-                if iface is not None:
-                    iface.config.metric = metric["value"]
-                    inst._originate_lsp()
+                for inst in self.insts:
+                    iface = inst.interfaces.get(ifname)
+                    if iface is not None:
+                        iface.config.metric = metric["value"]
+                        inst._originate_lsp()
             elif set(metric) - {"value", "@value"}:
                 unhandled.append("iface metric")
             af_sub = (if_node.get("address-families") or {}).get(
                 "address-family-list"
             )
             if af_sub is not None:
-                unhandled.append("iface address-families")
+                for target in self.insts:
+                    ifc = target.interfaces.get(ifname)
+                    if ifc is None:
+                        continue
+                    cur = (
+                        set(ifc.config.afs)
+                        if ifc.config.afs is not None
+                        else set(target.afs)
+                    )
+                    for af in af_sub:
+                        nm = af["address-family"]
+                        if op_of(af) == "delete" or af.get("enabled") is False:
+                            cur.discard(nm)
+                        else:
+                            cur.add(nm)
+                    ifc.config.afs = cur
+                    target._originate_lsp()
             if if_node.get("bfd"):
                 unhandled.append("iface bfd")
             if if_node.get("holo-isis:extended-sequence-number"):
@@ -600,19 +794,71 @@ class CaseRun:
                 "holo-isis:inter-level-propagation-policies",
             ):
                 unhandled.append(f"isis node {key}")
-        if isis.get("ietf-isis-sr-mpls:segment-routing"):
-            unhandled.append("segment-routing")
-        if isis.get("holo-isis:attached-bit"):
-            unhandled.append("attached-bit")
-        if isis.get("holo-isis:inter-level-propagation-policies"):
-            unhandled.append("inter-level-propagation")
+        srn = isis.get("ietf-isis-sr-mpls:segment-routing") or {}
+        if srn:
+            handled_at.add("@ietf-isis-sr-mpls:segment-routing")
+            if op_of(srn, "enabled") in ("replace", "create"):
+                self._sr_enabled = bool(srn["enabled"])
+                from holo_tpu.utils.sr import SrConfig
+
+                for i in self.insts:
+                    if i.sr is None:
+                        i.sr = SrConfig(
+                            enabled=self._sr_enabled, srgb_set=False
+                        )
+                    else:
+                        i.sr = SrConfig(
+                            enabled=self._sr_enabled, srgb=i.sr.srgb,
+                            prefix_sids=i.sr.prefix_sids, srlb=i.sr.srlb,
+                            srgb_set=getattr(i.sr, "srgb_set", True),
+                        )
+                    if self._sr_enabled:
+                        i.sr_allocate_adj_sids()
+                    i._originate_lsp()
+        att = isis.get("holo-isis:attached-bit") or {}
+        if att:
+            handled_at.update(("@holo-isis:attached-bit",))
+            if op_of(att, "ignore-reception") in ("replace", "create"):
+                for i in self.insts:
+                    i.att_ignore = bool(att["ignore-reception"])
+                # Receive-side change recomputes the default route.
+                for i in self.insts:
+                    i.run_spf()
+            if op_of(att, "suppress-advertisement") in ("replace", "create"):
+                if not self.level_all:
+                    raise Unsupported("att-suppress on single level")
+                self.node.att_suppress = bool(att["suppress-advertisement"])
+                self.insts[0]._originate_lsp()
+        ilpp = isis.get("holo-isis:inter-level-propagation-policies") or {}
+        if ilpp:
+            handled_at.update(("@holo-isis:inter-level-propagation-policies",))
+            if not self.level_all:
+                raise Unsupported("inter-level-propagation on single level")
+            sp = (ilpp.get("level1-to-level2") or {}).get(
+                "summary-prefixes", []
+            )
+            from ipaddress import ip_network
+
+            for entry in sp:
+                prefix = ip_network(entry["prefix"])
+                if op_of(entry) == "delete":
+                    self.node.summaries.pop(prefix, None)
+                else:
+                    self.node.summaries[prefix] = entry.get("metric")
+            self.insts[1]._originate_lsp()
+            if self.insts[1]._orig_pending:
+                self.insts[1].originate_pending()
+            # Active-summary discard routes join the merged table now.
+            self.node._level_routes_changed({})
+            self.loop.run_until_idle()
         if unhandled:
             raise Unsupported("; ".join(sorted(set(unhandled))[:4]))
         self.loop.run_until_idle()
-        if inst._orig_pending:
-            inst.originate_pending()
-            self.loop.run_until_idle()
-        inst._flush_flooding(srm_only=True)
+        for target in self.insts:
+            if target._orig_pending:
+                target.originate_pending()
+                self.loop.run_until_idle()
+            target._flush_flooding(srm_only=True)
 
     def bring_up(self) -> None:
         for line in (self.rt_dir / "events.jsonl").read_text().splitlines():
@@ -652,7 +898,12 @@ class CaseRun:
             if tx is None:
                 problems.append(f"unsupported output {next(iter(exp))}")
                 continue
-            want.append({"ifname": tx.get("ifname"), "pdu": tx["pdu"]})
+            want.append(
+                {
+                    "ifname": tx.get("ifname"),
+                    "pdu": refjson_isis.flatten_tlv_occurrences(tx["pdu"]),
+                }
+            )
 
         def matches(w, g):
             if w["ifname"] is not None and w["ifname"] != g["ifname"]:
@@ -768,7 +1019,9 @@ class CaseRun:
                     route.get("metric", 0),
                     nhs,
                 )
-            ours = self.inst.routes
+            ours = (
+                self.node.routes if self.level_all else self.inst.routes
+            )
             for prefix, (metric, nhs) in expected.items():
                 got = ours.get(prefix)
                 if got is None:
@@ -794,18 +1047,21 @@ class CaseRun:
         db = (isis.get("database") or {}).get("levels")
         if db:
             for lvl in db:
-                if lvl.get("level") != self.level:
+                target = next(
+                    (i for i in self.insts if i.level == lvl.get("level")),
+                    None,
+                )
+                if target is None:
                     continue
                 exp_ids = {l["lsp-id"] for l in lvl.get("lsp", [])}
-                got_ids = {_lsp_id_str(lid) for lid in self.inst.lsdb}
+                got_ids = {_lsp_id_str(lid) for lid in target.lsdb}
                 for missing in exp_ids - got_ids:
-                    problems.append(f"missing lsp {missing}")
+                    problems.append(f"missing lsp L{lvl.get('level')} {missing}")
                 for extra in got_ids - exp_ids:
-                    problems.append(f"extra lsp {extra}")
+                    problems.append(f"extra lsp L{lvl.get('level')} {extra}")
         # interfaces plane: SRM/SSN lists + adjacency state
         for ifstate in (isis.get("interfaces") or {}).get("interface", []):
             ifname = ifstate.get("name")
-            iface = self.inst.interfaces.get(ifname)
             for plane_name, attr in (
                 ("holo-isis-dev:srm", "srm"),
                 ("holo-isis-dev:ssn", "ssn"),
@@ -813,27 +1069,38 @@ class CaseRun:
                 plane = ifstate.get(plane_name)
                 if plane is None:
                     continue
-                exp_ids = set()
                 for lvl in plane.get("level", []):
-                    if lvl.get("level") == self.level:
-                        exp_ids = set(lvl.get("lsp-id", []))
-                got_ids = (
-                    {_lsp_id_str(lid) for lid in getattr(iface, attr)}
-                    if iface is not None
-                    else set()
-                )
-                if exp_ids != got_ids:
-                    problems.append(
-                        f"{ifname} {attr}: {sorted(got_ids)} != "
-                        f"{sorted(exp_ids)}"
+                    target = next(
+                        (
+                            i for i in self.insts
+                            if i.level == lvl.get("level")
+                        ),
+                        None,
                     )
+                    if target is None:
+                        continue
+                    iface = target.interfaces.get(ifname)
+                    exp_ids = set(lvl.get("lsp-id", []))
+                    got_ids = (
+                        {_lsp_id_str(lid) for lid in getattr(iface, attr)}
+                        if iface is not None
+                        else set()
+                    )
+                    if exp_ids != got_ids:
+                        problems.append(
+                            f"{ifname} {attr}: {sorted(got_ids)} != "
+                            f"{sorted(exp_ids)}"
+                        )
             adjs = (ifstate.get("adjacencies") or {}).get("adjacency")
             if adjs is not None:
                 exp_adj = {
                     a["neighbor-sysid"]: a.get("state", "up") for a in adjs
                 }
                 got_adj = {}
-                if iface is not None:
+                for target in self.insts:
+                    iface = target.interfaces.get(ifname)
+                    if iface is None:
+                        continue
                     pool = (
                         iface.adjs.values()
                         if iface.is_lan
@@ -891,10 +1158,11 @@ def run_case(case_dir: Path, topo: str, rt: str):
         # Self-posted deferred events (origination enqueued by the step's
         # inputs) drain before the output planes are read — the stub's
         # sync() equivalent.
-        if run.inst._orig_pending:
-            run.inst.originate_pending()
-            run.loop.run_until_idle()
-            run.inst._flush_flooding(srm_only=True)
+        for inst in run.insts:
+            if inst._orig_pending:
+                inst.originate_pending()
+                run.loop.run_until_idle()
+                inst._flush_flooding(srm_only=True)
         out_proto = case_dir / f"{step}-output-protocol.jsonl"
         if out_proto.exists():
             expected = [

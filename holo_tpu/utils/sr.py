@@ -40,6 +40,10 @@ class SrConfig:
     enabled: bool = False
     srgb: Srgb = Srgb()
     prefix_sids: dict = None  # prefix -> PrefixSid
+    srlb: tuple | None = None  # (lower, upper) local block
+    # False while no SRGB has been received from config: SR is on but
+    # the router-capability TLV is withheld (holo lsdb.rs:468).
+    srgb_set: bool = True
 
     def __post_init__(self):
         if self.prefix_sids is None:

@@ -26,7 +26,7 @@ KNOWN_PASS = [
     "timeout-adj1",
     "csnp-interval1",
 ]
-PASS_FLOOR = 65
+PASS_FLOOR = 75
 
 
 def test_known_cases_pass():
