@@ -86,6 +86,13 @@ class IsisIfConfig:
     afs: object = None
     # RFC 8491 Link MSD ({msd-type: value}) from the kernel interface.
     msd: dict = None
+    # RFC 7602 extended sequence numbers ("send-only"/"send-and-verify").
+    esn_mode: str | None = None
+    # BFD fast-failure detection on this circuit (RFC 5880 client).
+    bfd_enabled: bool = False
+    bfd_min_tx: int = 1000000
+    bfd_min_rx: int = 1000000
+    bfd_multiplier: int = 3
 
 
 @dataclass
@@ -93,6 +100,8 @@ class Adjacency:
     sysid: bytes
     # RFC 8667 §2.2 adjacency SIDs ((flags, weight, label), ...).
     adj_sids: tuple = ()
+    # Registered BFD session destinations (one per address family).
+    bfd_sessions: tuple = ()
     state: AdjacencyState = AdjacencyState.DOWN
     hold_time: int = 9
     addr: IPv4Address | None = None
@@ -126,6 +135,10 @@ class IsisInterface:
     dis_lan_id: bytes | None = None  # elected DIS (sysid + pn byte)
     srm: set = field(default_factory=set)  # LspIds pending flood on this iface
     ssn: set = field(default_factory=set)  # LspIds pending PSNP ack
+    # RFC 7602 state: last accepted (session, packet) per PDU class and
+    # our transmit counter.
+    esn_rx: dict = field(default_factory=dict)
+    esn_tx: int = 0
 
     @property
     def is_lan(self) -> bool:
@@ -142,6 +155,12 @@ class IsisInterface:
         if self.addrs6:
             return [(ia.ip, ia.network) for ia in self.addrs6]
         return [(None, self.prefix6)] if self.prefix6 is not None else []
+
+    def all_adjacencies(self) -> list:
+        """Every adjacency object regardless of state."""
+        if self.is_lan:
+            return list(self.adjs.values())
+        return [self.adj] if self.adj is not None else []
 
     def up_adjacencies(self) -> list:
         if self.is_lan:
@@ -284,6 +303,9 @@ class IsisInstance(Actor):
         self.purge_originator = False
         # Redistributed routes ({prefix: metric}) -> external reach.
         self.redist: dict = {}
+        # BFD session plumbing: bfd_cb(op, ifname, dst, cfg) emits
+        # register/unregister requests over the ibus ("reg"/"unreg").
+        self.bfd_cb = None
         # RFC 8667 adjacency-SID label allocator (v4+v6 per adjacency).
         # A mutable box so a level-all composition can share one
         # node-wide label space across its L1/L2 instances.
@@ -410,6 +432,7 @@ class IsisInstance(Actor):
                     ),
                 },
             )
+            self._esn_stamp(iface, hello.tlvs)
             self.netio.send(
                 ifname, iface.addr_ip, ALL_ISS,
                 hello.encode(auth=self._hello_auth(iface)),
@@ -443,6 +466,7 @@ class IsisInstance(Actor):
                     ),
                 },
             )
+            self._esn_stamp(iface, hello.tlvs)
             self.netio.send(
                 ifname, iface.addr_ip, ALL_ISS,
                 hello.encode(auth=self._hello_auth(iface)),
@@ -456,14 +480,18 @@ class IsisInstance(Actor):
     @staticmethod
     def _adj_learn_tlvs(adj: Adjacency, hello) -> None:
         """Record the neighbor's hello TLVs on the adjacency (next hops
-        + operational state)."""
+        + operational state).  Each hello is authoritative: an address
+        family that disappears from the TLVs is cleared."""
         addrs = hello.tlvs.get("ip_addresses") or []
-        if addrs:
-            adj.addr = addrs[0]
-        for a6 in hello.tlvs.get("ipv6_addresses") or []:
-            if a6.is_link_local:
-                adj.addr6 = a6
-                break
+        adj.addr = addrs[0] if addrs else None
+        adj.addr6 = next(
+            (
+                a6
+                for a6 in hello.tlvs.get("ipv6_addresses") or []
+                if a6.is_link_local
+            ),
+            None,
+        )
         adj.area_addresses = tuple(hello.tlvs.get("area_addresses") or ())
         adj.protocols = tuple(hello.tlvs.get("protocols_supported") or ())
         adj.addrs4 = tuple(addrs)
@@ -503,6 +531,7 @@ class IsisInstance(Actor):
             )
             adj._hold_timer = t
         t.start(adj.hold_time)
+        self._bfd_update_adj(iface, adj)
         if new != old:
             self._send_hello(iface.name)  # accelerate 2-way
         self._run_dis_election(iface)
@@ -560,6 +589,9 @@ class IsisInstance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None:
             return
+        gone = iface.adjs.get(sysid)
+        if gone is not None:
+            self._bfd_unreg_adj(iface, gone)
         if iface.adjs.pop(sysid, None) is not None:
             self._run_dis_election(iface)
             self._adj_changed()
@@ -601,6 +633,7 @@ class IsisInstance(Actor):
             iface.ssn.discard(lid)
         if entries:
             snp = Snp(self.level, False, self.sysid, entries)
+            self._esn_stamp(iface, snp.tlvs)
             self.netio.send(
                 iface.name, iface.addr_ip, ALL_ISS,
                 snp.encode(auth=self.auth),
@@ -726,6 +759,7 @@ class IsisInstance(Actor):
             t = self.loop.timer(self.name, lambda: HoldTimerMsg(iface.name))
             iface._hold_timer = t
         t.start(adj.hold_time)
+        self._bfd_update_adj(iface, adj)
         if new != old:
             self._send_hello(iface.name)  # accelerate the handshake
             if new == AdjacencyState.UP:
@@ -741,9 +775,95 @@ class IsisInstance(Actor):
             for lid, e in sorted(self.lsdb.items())
         ]
         snp = Snp(self.level, True, self.sysid, entries)
+        self._esn_stamp(iface, snp.tlvs)
         self.netio.send(
             iface.name, iface.addr_ip, ALL_ISS, snp.encode(auth=self.auth)
         )
+
+    def _esn_stamp(self, iface: IsisInterface, tlvs: dict) -> None:
+        """RFC 7602: stamp outgoing hellos/SNPs with the next extended
+        sequence number when the circuit runs ESN."""
+        if iface.config.esn_mode in ("send-only", "send-and-verify"):
+            iface.esn_tx += 1
+            tlvs["ext_seqnum"] = (1, iface.esn_tx)
+
+    def _bfd_dsts(self, adj: Adjacency):
+        out = []
+        if adj.addr is not None:
+            out.append(adj.addr)
+        if adj.addr6 is not None:
+            out.append(adj.addr6)
+        return out
+
+    def _bfd_update_adj(self, iface: IsisInterface, adj: Adjacency, force: bool = False) -> None:
+        """(Re)register this adjacency's per-AF BFD sessions (reference
+        adjacency.rs bfd_update_sessions: runs on every hello while BFD
+        is enabled, any adjacency state)."""
+        if not iface.config.bfd_enabled or self.bfd_cb is None:
+            return
+        cfg = {
+            "local_multiplier": iface.config.bfd_multiplier,
+            "min_tx": iface.config.bfd_min_tx,
+            "min_rx": iface.config.bfd_min_rx,
+        }
+        want = self._bfd_dsts(adj)
+        have = list(adj.bfd_sessions)
+        for dst in want:
+            if dst not in have or force:
+                self.bfd_cb("reg", iface.name, dst, cfg)
+        for dst in have:
+            if dst not in want:
+                self.bfd_cb("unreg", iface.name, dst, None)
+        adj.bfd_sessions = tuple(want)
+
+    def _bfd_unreg_adj(self, iface: IsisInterface, adj: Adjacency) -> None:
+        if self.bfd_cb is None:
+            return
+        for dst in adj.bfd_sessions:
+            self.bfd_cb("unreg", iface.name, dst, None)
+        adj.bfd_sessions = ()
+
+    def set_bfd_config(self, ifname: str, enabled: bool, min_tx: int | None = None, min_rx: int | None = None) -> None:
+        """Enable/disable/retune BFD on a circuit; sessions for current
+        up adjacencies (un)register accordingly."""
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        was = iface.config.bfd_enabled
+        if was and not enabled:
+            for adj in iface.all_adjacencies():
+                self._bfd_unreg_adj(iface, adj)
+        iface.config.bfd_enabled = enabled
+        if min_tx is not None:
+            iface.config.bfd_min_tx = min_tx
+        if min_rx is not None:
+            iface.config.bfd_min_rx = min_rx
+        if enabled:
+            # New registration or parameter change re-registration.
+            for adj in iface.all_adjacencies():
+                self._bfd_update_adj(iface, adj, force=True)
+
+    def bfd_state_down(self, ifname: str, dst) -> None:
+        """BFD declared the path dead: kill the matching adjacency
+        immediately (the reference's fast-failure integration)."""
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        if iface.is_lan:
+            for sysid, adj in list(iface.adjs.items()):
+                if dst in (adj.addr, adj.addr6):
+                    self._lan_adj_down(ifname, sysid)
+        elif iface.adj is not None and dst in (
+            iface.adj.addr, iface.adj.addr6
+        ):
+            # The failed adjacency stays visible in the Down state (the
+            # reference deletes it only on hello re-init or hold expiry).
+            adj = iface.adj
+            self._bfd_unreg_adj(iface, adj)
+            adj.state = AdjacencyState.DOWN
+            iface.srm.clear()
+            iface.ssn.clear()
+            self._adj_changed()
 
     def _adj_up(self, iface: IsisInterface) -> None:
         # Sync databases: send CSNP describing our LSDB + set SRM on all
@@ -766,6 +886,7 @@ class IsisInstance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None or iface.adj is None:
             return
+        self._bfd_unreg_adj(iface, iface.adj)
         iface.adj = None
         iface.srm.clear()
         iface.ssn.clear()
@@ -1180,13 +1301,33 @@ class IsisInstance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None or iface.config.passive:
             return
+        # Circuit-type sanity precedes everything: mismatched hello
+        # kinds never advance protocol state of any sort.
+        if pdu_type == PduType.HELLO_P2P and iface.is_lan:
+            return
+        if (
+            pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2)
+            and not iface.is_lan
+        ):
+            return
+        if iface.config.esn_mode == "send-and-verify" and pdu_type not in (
+            PduType.LSP_L1, PduType.LSP_L2
+        ):
+            # RFC 7602 §3: hellos and SNPs must carry a strictly
+            # increasing extended sequence number or be discarded.
+            # State is per sending system per PDU type — independent
+            # neighbors run independent sequence spaces.
+            esn = (getattr(pdu, "tlvs", None) or {}).get("ext_seqnum")
+            if esn is None:
+                return
+            key = (getattr(pdu, "sysid", b""), int(pdu_type))
+            last = iface.esn_rx.get(key)
+            if last is not None and esn <= last:
+                return  # replayed or stale
+            iface.esn_rx[key] = esn
         if pdu_type == PduType.HELLO_P2P:
-            if iface.is_lan:
-                return  # circuit-type mismatch: drop (misconfigured peer)
             self._rx_hello(iface, pdu)
         elif pdu_type in (PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2):
-            if not iface.is_lan:
-                return
             self._rx_hello_lan(iface, pdu, snpa)
         elif pdu_type in (PduType.LSP_L1, PduType.LSP_L2):
             self._rx_lsp(iface, pdu)

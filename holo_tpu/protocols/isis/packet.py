@@ -34,6 +34,7 @@ class TlvType(enum.IntEnum):
     AREA_ADDRESSES = 1
     IS_REACH = 2  # ISO 10589 narrow-metric IS reachability
     IS_NEIGHBORS = 6  # LAN hellos: heard SNPAs
+    EXTENDED_SEQNUM = 11  # RFC 7602
     PURGE_ORIGINATOR = 13  # RFC 6232
     LSP_BUFFER_SIZE = 14  # ISO 10589 §9.8 originating-LSP-buffer-size
     IP_INTERNAL_REACH = 128  # RFC 1195 narrow-metric IP reachability
@@ -313,6 +314,10 @@ def _encode_tlvs(w: Writer, tlvs: dict) -> None:
     if tlvs.get("ipv6_addresses"):
         body = b"".join(a.packed for a in tlvs["ipv6_addresses"])
         w.u8(TlvType.IPV6_INTERFACE_ADDRESS).u8(len(body)).bytes(body)
+    if tlvs.get("ext_seqnum"):
+        session, packet = tlvs["ext_seqnum"]
+        w.u8(TlvType.EXTENDED_SEQNUM).u8(12)
+        w.bytes(session.to_bytes(8, "big") + packet.to_bytes(4, "big"))
 
     def _v6_entry(r) -> bytes:
         sub = _prefix_subtlvs(r)
@@ -559,6 +564,12 @@ def _decode_tlvs(r: Reader) -> dict:
         elif t == TlvType.IPV6_ROUTER_ID:
             if length >= 16:
                 out["ipv6_router_id"] = body.ipv6()
+        elif t == TlvType.EXTENDED_SEQNUM:
+            if length == 12:
+                session = int.from_bytes(body.bytes(8), "big")
+                packet = body.u32()
+                if session:
+                    out["ext_seqnum"] = (session, packet)
         elif t == TlvType.LSP_BUFFER_SIZE:
             if length >= 2:
                 out["lsp_buf_size"] = body.u16()
@@ -940,7 +951,13 @@ class Snp:
             w.bytes((self.start or LspId(b"\x00" * 6)).encode())
             w.bytes((self.end or LspId(b"\xff" * 6, 0xFF, 0xFF)).encode())
         digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
-        _encode_tlvs(w, {"lsp_entries": self.entries})
+        _encode_tlvs(
+            w,
+            {
+                "ext_seqnum": (self.tlvs or {}).get("ext_seqnum"),
+                "lsp_entries": self.entries,
+            },
+        )
         w.patch_u16(len_pos, len(w))
         if digest_pos is not None:
             _patch_auth_digest(w, auth, digest_pos)

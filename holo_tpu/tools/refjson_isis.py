@@ -628,11 +628,17 @@ def pdu_from_json(j: dict):
         if sub.get("summary"):
             start = _lsp_id_from(sub["summary"][0])
             end = _lsp_id_from(sub["summary"][1])
-        entries = _snp_entries_from((sub.get("tlvs") or {}).get("lsp_entries"))
+        jt = sub.get("tlvs") or {}
+        entries = _snp_entries_from(jt.get("lsp_entries"))
         snp = Snp(
             level, complete, bytes(sub["source"]["system_id"]),
             entries, start, end,
         )
+        esn = jt.get("ext_seqnum")
+        if esn:
+            snp.tlvs["ext_seqnum"] = (
+                esn.get("session", 0), esn.get("packet", 0)
+            )
         pdu_type = PduType[
             ("CSNP_" if complete else "PSNP_") + f"L{level}"
         ]
@@ -661,6 +667,11 @@ def pdu_from_json(j: dict):
             tlvs["ipv6_addresses"] = [
                 IPv6Address(a) for a in _entries_of(jt["ipv6_addrs"])
             ]
+        esn = jt.get("ext_seqnum")
+        if esn:
+            tlvs["ext_seqnum"] = (
+                esn.get("session", 0), esn.get("packet", 0)
+            )
         tw = jt.get("three_way_adj")
         if tw is not None:
             tlvs["p2p_adj"] = P2pAdjState(

@@ -149,10 +149,16 @@ class CaseRun:
             self.node = inst
             self.insts = [inst]
             self.loop.register(inst)
+        self.bfd_log: list = []  # ("reg"/"unreg", ifname, dst, cfg)
         for inst in self.insts:
             inst.hostname = rt
             inst.afs = set(afs)
             inst.deferred_origination = True
+            inst.bfd_cb = (
+                lambda op, ifname, dst, cfg: self.bfd_log.append(
+                    (op, ifname, dst, cfg)
+                )
+            )
         # Interface config, keyed by name; arena ids are 1-based config
         # order (the reference's arena insertion order).
         self.if_conf: dict[str, dict] = {}
@@ -392,6 +398,19 @@ class CaseRun:
                     inst.sr_allocate_adj_sids()
                     inst._originate_lsp()
             self.loop.run_until_idle()
+        elif "BfdStateUpd" in ev:
+            upd = ev["BfdStateUpd"]
+            key = (upd.get("sess_key") or {}).get("IpSingleHop") or {}
+            if upd.get("state") == "Down" and key:
+                from ipaddress import ip_address
+
+                for inst in self.insts:
+                    inst.bfd_state_down(
+                        key["ifname"], ip_address(key["dst"])
+                    )
+                self.loop.run_until_idle()
+                for inst in self.insts:
+                    inst._flush_flooding(srm_only=True)
         elif "NodeMsdUpd" in ev:
             # RFC 8491: BaseMplsImposition is MSD-type 1.
             msd = ev["NodeMsdUpd"]
@@ -777,10 +796,39 @@ class CaseRun:
                             cur.add(nm)
                     ifc.config.afs = cur
                     target._originate_lsp()
-            if if_node.get("bfd"):
-                unhandled.append("iface bfd")
-            if if_node.get("holo-isis:extended-sequence-number"):
-                unhandled.append("iface ext-seqnum")
+            bfd = if_node.get("bfd") or {}
+            if bfd:
+                enabled_op = op_of(bfd, "enabled")
+                mt_node = (bfd.get("min-transmission-interval") or {})
+                mr_node = (bfd.get("min-receive-interval") or {})
+                min_tx = (
+                    mt_node.get("value")
+                    if op_of(mt_node, "value") in ("replace", "create")
+                    else None
+                )
+                min_rx = (
+                    mr_node.get("value")
+                    if op_of(mr_node, "value") in ("replace", "create")
+                    else None
+                )
+                if op_of(bfd, "min-interval") in ("replace", "create"):
+                    min_tx = min_rx = bfd["min-interval"]
+                for target in self.insts:
+                    cur = target.interfaces.get(ifname)
+                    enabled = (
+                        bool(bfd["enabled"])
+                        if enabled_op in ("replace", "create")
+                        else (cur.config.bfd_enabled if cur else False)
+                    )
+                    target.set_bfd_config(
+                        ifname, enabled, min_tx=min_tx, min_rx=min_rx
+                    )
+            esn = if_node.get("holo-isis:extended-sequence-number") or {}
+            if esn and op_of(esn, "mode") in ("replace", "create", None):
+                for target in self.insts:
+                    ifc = target.interfaces.get(ifname)
+                    if ifc is not None:
+                        ifc.config.esn_mode = esn.get("mode")
         for key in isis:
             if key.startswith("@") and key not in handled_at:
                 unhandled.append(f"isis leaf {key[1:]}")
@@ -881,6 +929,7 @@ class CaseRun:
     def drain_ibus(self):
         out = self.ibus_log[:]
         self.ibus_log.clear()
+        self.bfd_log.clear()
         return out
 
     def compare_protocol_output(self, expected_lines: list[dict]) -> list[str]:
@@ -934,6 +983,36 @@ class CaseRun:
 
     def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
         ours = []
+        for op, ifname, dst, cfg in self.bfd_log:
+            if op == "reg":
+                ours.append(
+                    {
+                        "BfdSessionReg": {
+                            "sess_key": {
+                                "IpSingleHop": {
+                                    "ifname": ifname, "dst": str(dst)
+                                }
+                            },
+                            "client_id": {
+                                "protocol": "isis", "name": "test"
+                            },
+                            "client_config": cfg,
+                        }
+                    }
+                )
+            else:
+                ours.append(
+                    {
+                        "BfdSessionUnreg": {
+                            "sess_key": {
+                                "IpSingleHop": {
+                                    "ifname": ifname, "dst": str(dst)
+                                }
+                            }
+                        }
+                    }
+                )
+        self.bfd_log.clear()
         for kind, prefix, metric, nhs in self.drain_ibus():
             if kind == "add":
                 ours.append(
@@ -959,7 +1038,30 @@ class CaseRun:
         problems = []
         unmatched = list(ours)
         for exp in expected_lines:
-            if not any(k in exp for k in ("RouteIpAdd", "RouteIpDel")):
+            if not any(
+                k in exp
+                for k in (
+                    "RouteIpAdd", "RouteIpDel",
+                    "BfdSessionReg", "BfdSessionUnreg",
+                )
+            ):
+                continue
+            if any(k in exp for k in ("BfdSessionReg", "BfdSessionUnreg")):
+                hit = next(
+                    (
+                        i
+                        for i, got in enumerate(unmatched)
+                        if subset_match(exp, got)
+                    ),
+                    None,
+                )
+                if hit is None:
+                    problems.append(
+                        "expected ibus msg not sent: "
+                        + json.dumps(exp)[:140]
+                    )
+                else:
+                    unmatched.pop(hit)
                 continue
             if "RouteIpAdd" in exp:
                 e = exp["RouteIpAdd"]
@@ -1101,18 +1203,12 @@ class CaseRun:
                     iface = target.interfaces.get(ifname)
                     if iface is None:
                         continue
-                    pool = (
-                        iface.adjs.values()
-                        if iface.is_lan
-                        else ([iface.adj] if iface.adj else [])
-                    )
-                    for a in pool:
-                        if a.state != AdjacencyState.DOWN:
-                            got_adj[_sysid_str(a.sysid)] = (
-                                "up"
-                                if a.state == AdjacencyState.UP
-                                else "init"
-                            )
+                    for a in iface.all_adjacencies():
+                        got_adj[_sysid_str(a.sysid)] = {
+                            AdjacencyState.UP: "up",
+                            AdjacencyState.INITIALIZING: "init",
+                            AdjacencyState.DOWN: "down",
+                        }[a.state]
                 if exp_adj != got_adj:
                     problems.append(
                         f"{ifname} adjacencies {got_adj} != {exp_adj}"

@@ -5,8 +5,10 @@ Each case replays one recorded router's events.jsonl through the real
 adjacency FSM / flooding / SPF machinery — with byte-identical LSP
 re-encoding, so the recorded PSNP acks of the reference's own LSPs
 validate OUR origination checksums — then applies the numbered step
-inputs and asserts the protocol-output, local-rib, LSP-database, and
-SRM/SSN state planes.
+inputs (PDUs, ibus events, config changes, RPCs) and asserts the
+protocol-output, local-rib, LSP-database, SRM/SSN, adjacency, and
+BFD-session planes.  All 79 reference cases pass, including level-all
+(L1/L2) routers.
 """
 
 from pathlib import Path
@@ -26,7 +28,7 @@ KNOWN_PASS = [
     "timeout-adj1",
     "csnp-interval1",
 ]
-PASS_FLOOR = 75
+PASS_FLOOR = 79
 
 
 def test_known_cases_pass():
