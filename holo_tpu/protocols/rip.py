@@ -32,12 +32,17 @@ class RipCommand(enum.IntEnum):
 
 @dataclass(frozen=True)
 class Rte:
-    """Route table entry on the wire (RFC 2453 §4)."""
+    """Route table entry on the wire (RFC 2453 §4).  ``prefix`` None is
+    the address-family-0 whole-table-request sentinel."""
 
-    prefix: IPv4Network
+    prefix: IPv4Network | None
     nexthop: IPv4Address
     metric: int
     tag: int = 0
+
+
+AUTH_SIMPLE = 2  # RFC 2453 §4.1 simple password
+AUTH_CRYPTO = 3  # RFC 2082/4822 keyed digest
 
 
 @dataclass
@@ -45,20 +50,52 @@ class RipPacket:
     command: RipCommand
     rtes: list[Rte] = field(default_factory=list)
 
-    def encode(self) -> bytes:
+    def encode(self, auth_password: str | None = None, auth_key: bytes | None = None, auth_key_id: int = 1, seqno: int = 0) -> bytes:
+        """RFC 2453 §4.1 / RFC 2082: with ``auth_password`` the first
+        RTE is the 16-byte password; with ``auth_key`` a keyed-MD5
+        header RTE plus trailing digest are emitted."""
+        import hashlib
+
         w = Writer()
         w.u8(int(self.command)).u8(2).u16(0)  # version 2
+        md5_hdr_pos = None
+        if auth_password is not None:
+            w.u16(0xFFFF).u16(AUTH_SIMPLE)
+            w.bytes(auth_password.encode()[:16].ljust(16, b"\x00"))
+        elif auth_key is not None:
+            w.u16(0xFFFF).u16(AUTH_CRYPTO)
+            md5_hdr_pos = len(w)
+            w.u16(0)  # packet length (patched below)
+            w.u8(auth_key_id).u8(20)  # key id + auth data length
+            w.u32(seqno)
+            w.u32(0).u32(0)  # reserved
         for rte in self.rtes:
+            if rte.prefix is None:
+                # Whole-table request RTE: AF 0, metric 16.
+                w.u16(0).u16(0)
+                w.u32(0).u32(0).u32(0)
+                w.u32(rte.metric)
+                continue
             w.u16(2)  # AF_INET
             w.u16(rte.tag)
             w.ipv4(rte.prefix.network_address)
             w.ipv4(mask_of(rte.prefix))
             w.ipv4(rte.nexthop)
             w.u32(rte.metric)
+        if auth_key is not None:
+            # The trailing digest RTE: AF 0xFFFF, type 1, then MD5 over
+            # the packet with the key appended (RFC 2082 §3.2.2).
+            w.patch_u16(md5_hdr_pos, len(w))
+            w.u16(0xFFFF).u16(1)
+            base = bytes(w.buf)
+            digest = hashlib.md5(
+                base + auth_key[:16].ljust(16, b"\x00")
+            ).digest()
+            w.bytes(digest)
         return w.finish()
 
     @classmethod
-    def decode(cls, data: bytes) -> "RipPacket":
+    def decode(cls, data: bytes, auth_password: str | None = None, auth_key: bytes | None = None) -> "RipPacket":
         r = Reader(data)
         try:
             cmd = RipCommand(r.u8())
@@ -69,15 +106,64 @@ class RipPacket:
             raise DecodeError(f"unsupported RIP version {version}")
         r.u16()
         rtes = []
-        while r.remaining() >= 20:
+        import hashlib
+
+        authed = auth_password is None and auth_key is None
+        first = True
+        auth_len = len(data)
+        while r.pos + 20 <= auth_len:
             af = r.u16()
+            tag = None
+            if af == 0xFFFF:
+                atype = r.u16()
+                if first and atype == AUTH_SIMPLE:
+                    pw = r.bytes(16).rstrip(b"\x00").decode(errors="replace")
+                    if auth_password is not None and pw == auth_password:
+                        authed = True
+                    elif auth_password is not None:
+                        raise DecodeError("bad RIP password")
+                    first = False
+                    continue
+                if first and atype == AUTH_CRYPTO:
+                    pkt_len = r.u16()
+                    r.u8()  # key id
+                    r.u8()  # auth data length
+                    r.u32()  # sequence number
+                    r.u32()
+                    r.u32()
+                    if auth_key is not None:
+                        want = hashlib.md5(
+                            data[:pkt_len + 4]
+                            + auth_key[:16].ljust(16, b"\x00")
+                        ).digest()
+                        got = data[pkt_len + 4 : pkt_len + 20]
+                        import hmac as _h
+
+                        if not _h.compare_digest(want, got):
+                            raise DecodeError("bad RIP MD5 digest")
+                        authed = True
+                    auth_len = min(auth_len, pkt_len)
+                    first = False
+                    continue
+                raise DecodeError("unexpected auth RTE")
+            first = False
             tag = r.u16()
             addr = r.ipv4()
             mask = r.ipv4()
             nh = r.ipv4()
             metric = r.u32()
-            if af != 2 or not 1 <= metric <= INFINITY_METRIC:
+            if af == 0:
+                # Address-family 0: the whole-table request RTE
+                # (RFC 2453 §3.9.1), prefix None as sentinel — only
+                # meaningful in requests.
+                if cmd != RipCommand.REQUEST:
+                    raise DecodeError("AF-0 RTE in response")
+                rtes.append(Rte(None, nh, metric, tag))
+                continue
+            if af != 2:
                 raise DecodeError("bad RTE")
+            if cmd == RipCommand.RESPONSE and not 1 <= metric <= INFINITY_METRIC:
+                raise DecodeError("bad RTE metric")
             m = int(mask)
             plen = bin(m).count("1")
             if m != (0xFFFFFFFF << (32 - plen)) & 0xFFFFFFFF and m != 0:
@@ -87,6 +173,8 @@ class RipPacket:
             except ValueError as e:
                 raise DecodeError(f"bad prefix: {e}") from e
             rtes.append(Rte(prefix, nh, metric, tag))
+        if not authed:
+            raise DecodeError("RIP authentication required")
         return cls(cmd, rtes)
 
 
@@ -106,7 +194,9 @@ class RipngPacket:
         w.u8(int(self.command)).u8(1).u16(0)  # version 1
         for prefix, tag, metric in self.rtes:
             w.ipv6(prefix.network_address)
-            w.u16(tag).u8(prefix.prefixlen).u8(metric)
+            # Next-hop RTEs (metric 0xFF) carry prefix-len 0.
+            plen = 0 if metric == 0xFF else prefix.prefixlen
+            w.u16(tag).u8(plen).u8(metric)
         return w.finish()
 
     @classmethod
@@ -120,6 +210,7 @@ class RipngPacket:
             raise DecodeError("unsupported RIPng version")
         r.u16()
         rtes = []
+        cur_nh = None
         while r.remaining() >= 20:
             addr = r.ipv6()
             tag = r.u16()
@@ -127,13 +218,15 @@ class RipngPacket:
             metric = r.u8()
             if metric == 0xFF:
                 # Next-hop RTE (RFC 2080 §2.1.1): sets the next hop for
-                # following RTEs; not an error.  We currently use the
-                # packet source as next hop, so it is skipped.
+                # the RTEs that follow (:: resets to the packet source).
+                cur_nh = addr if int(addr) else None
                 continue
-            if plen > 128 or not 1 <= metric <= INFINITY_METRIC:
+            if plen > 128:
                 raise DecodeError("bad RIPng RTE")
+            if cmd == RipCommand.RESPONSE and not 1 <= metric <= INFINITY_METRIC:
+                raise DecodeError("bad RIPng RTE metric")
             masked = int(addr) & ~((1 << (128 - plen)) - 1) if plen < 128 else int(addr)
-            rtes.append((IPv6Network((masked, plen)), tag, metric))
+            rtes.append((IPv6Network((masked, plen)), tag, metric, cur_nh))
         return cls(cmd, rtes)
 
 
@@ -144,20 +237,31 @@ class RipVersion:
     group = RIPV2_GROUP
 
     @staticmethod
-    def encode(command, entries) -> bytes:
+    def encode(command, entries, auth=None) -> bytes:
+        pw, key, key_id, seqno = auth or (None, None, 1, 0)
         return RipPacket(
             command,
             [Rte(prefix, IPv4Address(0), metric, tag)
              for prefix, tag, metric in entries],
-        ).encode()
+        ).encode(
+            auth_password=pw, auth_key=key, auth_key_id=key_id, seqno=seqno
+        )
 
     @staticmethod
-    def decode(data: bytes):
-        pkt = RipPacket.decode(data)
+    def decode(data: bytes, auth=None):
+        pw, key = (auth or (None, None, 1, 0))[:2]
+        pkt = RipPacket.decode(data, auth_password=pw, auth_key=key)
         return pkt.command, [
             (r.prefix, r.tag, r.metric, r.nexthop if int(r.nexthop) else None)
             for r in pkt.rtes
         ]
+
+    @staticmethod
+    def encode_request_all() -> bytes:
+        return RipPacket(
+            RipCommand.REQUEST,
+            [Rte(None, IPv4Address(0), INFINITY_METRIC)],
+        ).encode()
 
 
 class RipngVersion:
@@ -167,15 +271,32 @@ class RipngVersion:
     group = RIPNG_GROUP
 
     @staticmethod
-    def encode(command, entries) -> bytes:
+    def encode(command, entries, auth=None) -> bytes:
+        # RIPng has no in-protocol auth (RFC 2080 relies on IPsec).
         return RipngPacket(command, list(entries)).encode()
 
     @staticmethod
-    def decode(data: bytes):
+    def decode(data: bytes, auth=None):
         pkt = RipngPacket.decode(data)
-        return pkt.command, [
-            (prefix, tag, metric, None) for prefix, tag, metric in pkt.rtes
-        ]
+        out = []
+        for prefix, tag, metric, nh in pkt.rtes:
+            if (
+                pkt.command == RipCommand.REQUEST
+                and metric == INFINITY_METRIC
+                and int(prefix.network_address) == 0
+                and prefix.prefixlen == 0
+            ):
+                out.append((None, tag, metric, None))
+            else:
+                out.append((prefix, tag, metric, nh))
+        return pkt.command, out
+
+    @staticmethod
+    def encode_request_all() -> bytes:
+        return RipngPacket(
+            RipCommand.REQUEST,
+            [(IPv6Network("::/0"), 0, INFINITY_METRIC)],
+        ).encode()
 
 
 @dataclass
@@ -188,6 +309,10 @@ class RipRoute:
     changed: bool = True
     timeout_at: float | None = None  # None for connected
     garbage_at: float | None = None
+    rcvd_metric: int | None = None  # wire metric before the iface cost
+    source: object = None  # sender address (distinct from nexthop)
+    # "connected" | "rip" | "redistributed" (operational state).
+    route_type: str = "rip"
 
 
 @dataclass
@@ -208,7 +333,17 @@ class AgeTimerMsg:
 @dataclass
 class RipIfConfig:
     cost: int = 1
-    split_horizon: str = "poison-reverse"  # disabled|simple|poison-reverse
+    split_horizon: str = "simple"  # disabled|simple|poison-reverse
+    passive: bool = False
+    # RFC 2453 §4.1 simple-password / RFC 2082 keyed-MD5 authentication.
+    auth_password: str | None = None
+    auth_key: bytes | None = None
+    auth_key_id: int = 1
+
+    def auth_tuple(self, seqno: int = 0):
+        if self.auth_password is None and self.auth_key is None:
+            return None
+        return (self.auth_password, self.auth_key, self.auth_key_id, seqno)
 
 
 class RipInstance(Actor):
@@ -236,6 +371,17 @@ class RipInstance(Actor):
         self.interfaces: dict[str, tuple[RipIfConfig, IPv4Address, IPv4Network]] = {}
         self.routes: dict[IPv4Network, RipRoute] = {}
         self._triggered_pending = False
+        # RFC 2453 §4.2-ish peer table: source address -> last heard.
+        self.neighbors: dict = {}
+        # Explicitly configured unicast neighbors (RFC 2453 §4.3).
+        self.static_neighbors: set = set()
+        self.distance = 120
+        self._seqno = 0  # RFC 4822 auth sequence number
+        # Triggered-update machinery (RFC 2453 §3.10.1, reference
+        # events.rs:361-394): suppressed before the initial update;
+        # rate-limited by the holdoff window afterwards.
+        self._holdoff = False
+        self._initial_pending = True
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -247,9 +393,75 @@ class RipInstance(Actor):
 
     def add_interface(self, ifname: str, cfg: RipIfConfig, addr: IPv4Address, prefix: IPv4Network):
         self.interfaces[ifname] = (cfg, addr, prefix)
+        if prefix is not None:
+            self.routes[prefix] = RipRoute(
+                prefix=prefix, nexthop=None, ifname=ifname,
+                metric=cfg.cost, route_type="connected",
+            )
+        if not cfg.passive and self.netio is not None:
+            # Interface start solicits full tables (RFC 2453 §3.9.1) —
+            # multicast plus any configured unicast neighbors on it.
+            req = self.V.encode_request_all()
+            self.netio.send(ifname, addr, self.V.group, req)
+            for ifn, nbr in sorted(self.static_neighbors, key=str):
+                if ifn == ifname:
+                    self.netio.send(ifname, addr, nbr, req)
+        self._schedule_triggered()
+        self._notify()
+
+    def remove_interface(self, ifname: str) -> None:
+        """Circuit gone: connected route out, learned routes through it
+        invalidated (metric 16, garbage collection)."""
+        if self.interfaces.pop(ifname, None) is None:
+            return
+        changed = False
+        for route in list(self.routes.values()):
+            if route.ifname != ifname:
+                continue
+            if route.metric < INFINITY_METRIC:
+                self._invalidate(route)
+                changed = True
+        if changed:
+            self._notify()
+
+    def add_connected(self, ifname: str, prefix, cost: int | None = None) -> None:
+        """Connected prefix from an address event: always (re)placed,
+        reviving an invalidated entry (reference connected_route_add)."""
+        entry = self.interfaces.get(ifname)
+        if entry is None:
+            return
         self.routes[prefix] = RipRoute(
-            prefix=prefix, nexthop=None, ifname=ifname, metric=cfg.cost
+            prefix=prefix, nexthop=None, ifname=ifname,
+            metric=cost if cost is not None else entry[0].cost,
+            route_type="connected",
         )
+        self._schedule_triggered()
+        self._notify()
+
+    def del_connected(self, prefix) -> None:
+        route = self.routes.get(prefix)
+        if route is not None and route.route_type == "connected":
+            self._invalidate(route)
+            self._notify()
+
+    def redistribute(self, prefix, metric: int = 1, tag: int = 0) -> None:
+        """Install a redistributed route (ibus RouteRedistributeAdd).
+        Never displaces a connected or RIP-learned route."""
+        if prefix in self.routes or prefix.network_address.is_link_local:
+            return
+        self.routes[prefix] = RipRoute(
+            prefix=prefix, nexthop=None, ifname="", metric=max(1, metric),
+            tag=tag, route_type="redistributed",
+        )
+        self._schedule_triggered()
+        self._notify()
+
+    def redistribute_del(self, prefix) -> None:
+        route = self.routes.get(prefix)
+        if route is not None and route.route_type == "redistributed":
+            del self.routes[route.prefix]
+            self._schedule_triggered()
+            self._notify()
 
     # -- actor
 
@@ -257,12 +469,16 @@ class RipInstance(Actor):
         if isinstance(msg, NetRxPacket):
             self._rx(msg)
         elif isinstance(msg, UpdateTimerMsg):
-            self._send_updates(changed_only=False)
+            if self._initial_pending:
+                self.initial_update()
+            else:
+                self._send_updates(changed_only=False)
             self._update_timer.start(self.update_interval)
         elif isinstance(msg, TriggeredTimerMsg):
-            if self._triggered_pending:
-                self._triggered_pending = False
-                self._send_updates(changed_only=True)
+            if self._holdoff:
+                self.holdoff_expired()
+            else:
+                self.drain_triggered()
         elif isinstance(msg, AgeTimerMsg):
             self._age()
             self._age_timer.start(1.0)
@@ -277,12 +493,18 @@ class RipInstance(Actor):
         if msg.src == our_addr:
             return
         try:
-            command, entries = self.V.decode(msg.data)
+            command, entries = self.V.decode(
+                msg.data, auth=cfg.auth_tuple()
+            )
         except DecodeError:
+            return
+        now = self.loop.clock.now()
+        if command == RipCommand.REQUEST:
+            self._rx_request(msg, entries)
             return
         if command != RipCommand.RESPONSE:
             return
-        now = self.loop.clock.now()
+        self.neighbors[msg.src] = now
         changed_any = False
         for prefix, tag, rte_metric, rte_nh in entries:
             metric = min(rte_metric + cfg.cost, INFINITY_METRIC)
@@ -297,18 +519,30 @@ class RipInstance(Actor):
                         metric=metric,
                         tag=tag,
                         timeout_at=now + self.timeout,
+                        rcvd_metric=rte_metric,
+                        source=msg.src,
                     )
                     changed_any = True
                 continue
             if cur.nexthop is None:
                 continue  # connected beats learned
-            from_same = cur.nexthop == nh and cur.ifname == msg.ifname
+            from_same = cur.source == msg.src and cur.ifname == msg.ifname
             if from_same:
                 cur.timeout_at = now + self.timeout
-            if (from_same and metric != cur.metric) or metric < cur.metric:
+            if (
+                from_same
+                and (
+                    metric != cur.metric
+                    or nh != cur.nexthop
+                    or tag != cur.tag
+                )
+            ) or metric < cur.metric:
                 old_metric = cur.metric
                 cur.metric = metric
+                cur.rcvd_metric = rte_metric
                 cur.nexthop = nh
+                cur.tag = tag
+                cur.source = msg.src
                 cur.ifname = msg.ifname
                 cur.changed = True
                 changed_any = True
@@ -322,31 +556,198 @@ class RipInstance(Actor):
             self._schedule_triggered()
             self._notify()
 
+    def _rx_request(self, msg: NetRxPacket, entries) -> None:
+        """RFC 2453 §3.9.1: answer a whole-table request with normal
+        output processing, unicast back to the asker; a specific-prefix
+        request gets the metrics filled in verbatim."""
+        iface = self.interfaces.get(msg.ifname)
+        if iface is None:
+            return
+        cfg, our_addr, _prefix = iface
+        whole = len(entries) == 1 and entries[0][0] is None
+        if whole:
+            out = self._routes_for(msg.ifname, cfg, changed_only=False)
+            self._seqno += 1
+            for i in range(0, len(out), 25):
+                data = self.V.encode(
+                    RipCommand.RESPONSE, out[i : i + 25],
+                    auth=cfg.auth_tuple(self._seqno),
+                )
+                self.netio.send(msg.ifname, our_addr, msg.src, data)
+        else:
+            answered = [
+                (
+                    prefix, tag,
+                    self.routes[prefix].metric
+                    if prefix in self.routes
+                    else INFINITY_METRIC,
+                )
+                for prefix, tag, _metric, _nh in entries
+                if prefix is not None
+            ]
+            if not answered:
+                return
+            data = self.V.encode(RipCommand.RESPONSE, answered)
+            self.netio.send(msg.ifname, our_addr, msg.src, data)
+
+    # -- external timer events (recorded by the reference's tasks)
+
+    def send_initial_requests(self) -> None:
+        """Instance start: solicit full tables (RFC 2453 §3.9.1)."""
+        for ifname, (cfg, our_addr, _p) in self.interfaces.items():
+            if cfg.passive:
+                continue
+            data = self.V.encode_request_all()
+            self.netio.send(ifname, our_addr, self.V.group, data)
+
+    def nbr_timeout(self, addr) -> None:
+        self.neighbors.pop(addr, None)
+
+    def route_timeout(self, prefix) -> None:
+        route = self.routes.get(prefix)
+        if route is not None and route.nexthop is not None:
+            self._invalidate(route)
+            self._notify()
+
+    def route_gc(self, prefix) -> None:
+        route = self.routes.get(prefix)
+        if route is not None and route.garbage_at is not None:
+            del self.routes[prefix]
+            self._notify()
+
+    def iface_cost_update(self, ifname: str, cost: int) -> None:
+        """Interface cost change: every route's metric recomputes as
+        cost + received metric.  NOTE: like the reference
+        (configuration.rs InterfaceCostUpdate), the CHANGED circuit's
+        cost applies to the whole table — including connected and
+        redistributed entries — which its recorded conformance corpus
+        asserts."""
+        entry = self.interfaces.get(ifname)
+        if entry is None:
+            return
+        entry[0].cost = cost
+        now = self.loop.clock.now()
+        for route in self.routes.values():
+            if route.metric >= INFINITY_METRIC:
+                continue
+            metric = cost
+            if route.rcvd_metric is not None:
+                metric += route.rcvd_metric
+            route.metric = min(metric, INFINITY_METRIC)
+            route.changed = True
+            self._schedule_triggered()
+            if route.metric >= INFINITY_METRIC:
+                route.garbage_at = now + self.garbage
+        self._notify()
+
+    def clear_routes(self) -> None:
+        """ietf-rip clear-rip-route RPC: drop learned routes."""
+        changed = False
+        for route in list(self.routes.values()):
+            if route.route_type == "rip":
+                del self.routes[route.prefix]
+                changed = True
+        if changed:
+            self._notify()
+
     # -- tx path
+
+    def _routes_for(self, ifname: str, cfg: RipIfConfig, changed_only: bool) -> list:
+        entries = []
+        for route in self.routes.values():
+            if changed_only and not route.changed:
+                continue
+            metric = route.metric
+            if route.ifname == ifname and route.nexthop is not None:
+                if cfg.split_horizon == "simple":
+                    continue
+                if cfg.split_horizon == "poison-reverse":
+                    metric = INFINITY_METRIC
+            entries.append((route.prefix, route.tag, metric))
+        entries.sort(
+            key=lambda e: (int(e[0].network_address), e[0].prefixlen)
+        )
+        return entries
 
     def _send_updates(self, changed_only: bool) -> None:
         for ifname, (cfg, our_addr, _prefix) in self.interfaces.items():
-            entries = []
-            for route in self.routes.values():
-                if changed_only and not route.changed:
-                    continue
-                metric = route.metric
-                if route.ifname == ifname and route.nexthop is not None:
-                    if cfg.split_horizon == "simple":
-                        continue
-                    if cfg.split_horizon == "poison-reverse":
-                        metric = INFINITY_METRIC
-                entries.append((route.prefix, route.tag, metric))
-            for i in range(0, len(entries), 25):
-                data = self.V.encode(RipCommand.RESPONSE, entries[i : i + 25])
-                self.netio.send(ifname, our_addr, self.V.group, data)
+            if cfg.passive:
+                continue
+            entries = self._routes_for(ifname, cfg, changed_only)
+            dsts = [self.V.group] + [
+                n for ifn, n in self.static_neighbors if ifn == ifname
+            ]
+            self._seqno += 1
+            for dst in dsts:
+                for i in range(0, len(entries), 25):
+                    data = self.V.encode(
+                        RipCommand.RESPONSE, entries[i : i + 25],
+                        auth=cfg.auth_tuple(self._seqno),
+                    )
+                    self.netio.send(ifname, our_addr, dst, data)
         for route in self.routes.values():
             route.changed = False
+        if not changed_only:
+            # A regular update supersedes any held-off triggered one
+            # (reference output.rs:165-171 cancel_triggered_update).
+            self._holdoff = False
+            self._triggered_pending = False
+
+    def _iface_of(self, addr):
+        for ifname, (_cfg, _a, prefix) in self.interfaces.items():
+            if prefix is not None and addr in prefix:
+                return ifname
+        return None
+
+    def _invalidate(self, route: RipRoute) -> None:
+        """RFC 2453 §3.8 invalidation: uninstall, metric 16, flag
+        changed, start garbage collection, trigger an update."""
+        now = self.loop.clock.now()
+        route.metric = INFINITY_METRIC
+        route.changed = True
+        route.timeout_at = None
+        route.garbage_at = now + self.garbage
+        self._schedule_triggered()
+
+    def triggered_fire(self) -> None:
+        """Send changed routes and open the holdoff window."""
+        self._send_updates(changed_only=True)
+        self._holdoff = True
+        if getattr(self, "_trig_timer", None) is not None:
+            self._trig_timer.start(1.0)  # holdoff, 1-5s in the RFC
+
+    def holdoff_expired(self) -> None:
+        pending = self._triggered_pending
+        self._holdoff = False
+        self._triggered_pending = False
+        if pending:
+            self.triggered_fire()
+
+    def initial_update(self) -> None:
+        """Instance-start full update; unblocks triggered updates."""
+        self._initial_pending = False
+        self._send_updates(changed_only=False)
+
+    def drain_triggered(self) -> None:
+        """Process the self-posted trigger (reference
+        process_triggered_update): dropped before the initial update,
+        pended during holdoff, otherwise sent immediately."""
+        if not self._triggered_pending:
+            return
+        if self._initial_pending:
+            return
+        if self._holdoff:
+            return  # stays pending until the holdoff expires
+        self._triggered_pending = False
+        self.triggered_fire()
 
     def _schedule_triggered(self) -> None:
-        if not self._triggered_pending:
-            self._triggered_pending = True
-            self._trig_timer.start(1.0)  # 1-5s randomized in the RFC
+        self._triggered_pending = True
+        # Production path: arm the short triggered-update timer (the
+        # conformance replay instead drains at the recorded points).
+        t = getattr(self, "_trig_timer", None)
+        if t is not None and not self._holdoff and not t.armed:
+            t.start(1.0)
 
     # -- aging (RFC 2453 §3.8)
 

@@ -283,8 +283,10 @@ class CaseRun:
         if "InterfaceUpd" in ev:
             upd = ev["InterfaceUpd"]
             ifname = upd["ifname"]
-            flags = upd.get("flags") or "OPERATIVE"
-            operative = "OPERATIVE" in flags
+            flags_s = upd.get("flags")
+            operative = (
+                "OPERATIVE" in flags_s if flags_s is not None else True
+            )
             if upd.get("mac_address"):
                 self.mac[ifname] = bytes(upd["mac_address"])
                 for inst in self.insts:

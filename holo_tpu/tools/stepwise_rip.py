@@ -1,0 +1,778 @@
+"""RIP stepwise conformance: replay the reference's recorded cases.
+
+Covers BOTH corpora — holo-rip/tests/conformance/{ripv2,ripng} (38 case
+dirs each plus 4 topology snapshots per family).  Each case brings one
+recorded router up by replaying its events.jsonl through our live
+RipInstance (real codec/route-table/update machinery), then applies the
+numbered step inputs and asserts:
+
+- the protocol plane (UdpTxPdu messages, unordered subset match);
+- the ibus plane (RouteIpAdd/RouteIpDel from route-table diffs);
+- the northbound-state plane (interfaces, neighbors, per-route state:
+  metric/next-hop/interface/route-type/deleted/changed flags).
+
+Timers are recorded events (InitialUpdate, UpdateInterval, TriggeredUpd,
+TriggeredUpdTimeout, RouteTimeout, RouteGcTimeout, NbrTimeout), so the
+replay is fully deterministic under the virtual clock.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from ipaddress import ip_address, ip_interface, ip_network
+from pathlib import Path
+
+from holo_tpu.protocols.rip import (
+    INFINITY_METRIC,
+    RipCommand,
+    RipIfConfig,
+    RipInstance,
+    RipngVersion,
+    RipVersion,
+)
+from holo_tpu.tools.refjson import Unsupported, subset_match
+from holo_tpu.utils.netio import NetIo, NetRxPacket
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+RIP_DIR = Path("/root/reference/holo-rip/tests/conformance")
+
+
+def case_map(family: str) -> dict[str, tuple[str, str]]:
+    out = {}
+    text = (RIP_DIR / family / "mod.rs").read_text()
+    for m in re.finditer(
+        r'run_test(?:_topology)?::<[^(]*\(\s*"([^"]+)",\s*"([^"]+)",\s*"([^"]+)"',
+        text,
+    ):
+        out[m.group(1)] = (m.group(2), m.group(3))
+    return out
+
+
+class _TxCapture(NetIo):
+    def __init__(self):
+        self.log = []
+
+    def send(self, ifname, src, dst, data):
+        self.log.append((ifname, dst, data))
+
+
+def _pdu_to_json(version, data: bytes) -> dict:
+    """Our wire bytes -> the reference's serde shape."""
+    command, entries = version.decode(data)
+    rtes = []
+    for prefix, tag, metric, nh in entries:
+        if prefix is None:
+            if version is RipVersion:
+                rtes.append({"Zero": {"metric": metric}})
+            else:
+                rtes.append(
+                    {"Ipv6": {"prefix": "::/0", "tag": 0, "metric": metric}}
+                )
+        elif version is RipVersion:
+            rtes.append(
+                {
+                    "Ipv4": {
+                        "tag": tag,
+                        "prefix": str(prefix),
+                        "nexthop": str(nh) if nh is not None else None,
+                        "metric": metric,
+                    }
+                }
+            )
+        else:
+            rtes.append(
+                {
+                    "Ipv6": {
+                        "tag": tag,
+                        "prefix": str(prefix),
+                        "metric": metric,
+                    }
+                }
+            )
+    return {
+        "command": "Request" if command == RipCommand.REQUEST else "Response",
+        "version": 2 if version is RipVersion else 1,
+        "rtes": rtes,
+    }
+
+
+def _pdu_from_json(version, j: dict) -> bytes:
+    """Reference serde JSON -> our wire bytes."""
+    from holo_tpu.protocols.rip import RipngPacket, RipPacket, Rte
+
+    command = (
+        RipCommand.REQUEST if j["command"] == "Request" else RipCommand.RESPONSE
+    )
+    if version is RipVersion:
+        from ipaddress import IPv4Address
+
+        rtes = []
+        for e in j.get("rtes", []):
+            if "Zero" in e:
+                rtes.append(
+                    Rte(None, IPv4Address(0), e["Zero"].get("metric", 16))
+                )
+            elif "Ipv4" in e:
+                v = e["Ipv4"]
+                rtes.append(
+                    Rte(
+                        ip_network(v["prefix"]),
+                        IPv4Address(v["nexthop"] or "0.0.0.0"),
+                        v.get("metric", 1),
+                        v.get("tag", 0),
+                    )
+                )
+            else:
+                raise Unsupported(f"rte {next(iter(e))}")
+        return RipPacket(command, rtes).encode()
+    rtes = []
+    for e in j.get("rtes", []):
+        if "Ipv6" in e:
+            v = e["Ipv6"]
+            rtes.append(
+                (ip_network(v["prefix"]), v.get("tag", 0), v.get("metric", 1))
+            )
+        elif "Zero" in e:
+            rtes.append((ip_network("::/0"), 0, e["Zero"].get("metric", 16)))
+        elif "Nexthop" in e:
+            # RFC 2080 §2.1.1 next-hop RTE (metric 0xFF).
+            nh = e["Nexthop"].get("addr") or "::"
+            rtes.append((ip_network(f"{nh}/128"), 0, 0xFF))
+        else:
+            raise Unsupported(f"rte {next(iter(e))}")
+    return RipngPacket(command, rtes).encode()
+
+
+class CaseRun:
+    def __init__(self, family: str, topo_dir: Path, rt: str):
+        self.family = family
+        self.version = RipVersion if family == "ripv2" else RipngVersion
+        self.loop = EventLoop(clock=VirtualClock())
+        self.tx = _TxCapture()
+        self.rt_dir = topo_dir / rt
+        cfg = json.loads((self.rt_dir / "config.json").read_text())
+        proto = cfg["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]["ietf-rip:rip"]
+        self.if_conf: dict[str, dict] = {}
+        for iface in (proto.get("interfaces") or {}).get("interface", []):
+            self.if_conf[iface["interface"]] = iface
+        self.inst = RipInstance(
+            "test", self.tx, version=self.version, route_cb=self._routes_changed
+        )
+        self.loop.register(self.inst)
+        # Replay determinism: the instance's own timers never fire (the
+        # recorded events drive updates), so cancel the auto-started ones.
+        self.inst._update_timer.cancel()
+        self.inst._age_timer.cancel()
+        self.prev_routes: dict = {}
+        self.ibus_log: list = []
+        self.live = False  # True once bring-up finished (step phase)
+        self.ifindex: dict[str, int] = {}
+        self.addrs: dict[str, list] = {}
+        self.oper_up: set = set()
+
+    # -- ibus plane
+
+    def _routes_changed(self, routes: dict) -> None:
+        for prefix, route in routes.items():
+            cur = (route.metric, route.nexthop, route.ifname)
+            if self.prev_routes.get(prefix) != cur:
+                self.ibus_log.append(("add", prefix, route))
+        for prefix in self.prev_routes.keys() - routes.keys():
+            self.ibus_log.append(("del", prefix, None))
+        self.prev_routes = {
+            p: (r.metric, r.nexthop, r.ifname) for p, r in routes.items()
+        }
+
+    # -- interface lifecycle
+
+    def _want_af(self, addr) -> bool:
+        return (addr.version == 4) == (self.family == "ripv2")
+
+    def _ensure_iface(self, ifname: str) -> None:
+        if ifname not in self.if_conf or ifname not in self.oper_up:
+            return
+        if ifname in self.inst.interfaces:
+            return
+        addrs = [
+            a for a in self.addrs.get(ifname, []) if self._want_af(a.ip)
+        ]
+        if not addrs and not ifname.startswith("lo"):
+            return
+        use = None
+        if self.family == "ripng":
+            # RIPng runs over link-local sources; the advertised prefix
+            # is the global one.
+            g = [a for a in addrs if not a.ip.is_link_local]
+            ll = [a for a in addrs if a.ip.is_link_local]
+            if g:
+                use = (ll[0].ip if ll else g[0].ip, g[0].network)
+            elif ll:
+                use = (ll[0].ip, None)
+        elif addrs:
+            use = (addrs[0].ip, addrs[0].network)
+        if use is None:
+            return
+        icfg = self.if_conf[ifname]
+        self.inst.add_interface(
+            ifname,
+            RipIfConfig(
+                cost=(icfg.get("metric") or {}).get("value", 1),
+                split_horizon=icfg.get("split-horizon", "simple"),
+                passive=icfg.get("passive", False)
+                or ifname.startswith("lo"),
+            ),
+            use[0],
+            use[1],
+        )
+        for a in addrs:
+            if self.family == "ripng" and a.ip.is_link_local:
+                continue
+            if use[1] is not None and a.network == use[1]:
+                continue  # primary already installed by add_interface
+            self.inst.add_connected(ifname, a.network)
+        self.loop.run_until_idle()
+
+    def apply_ibus(self, ev: dict) -> None:
+        if "InterfaceUpd" in ev:
+            upd = ev["InterfaceUpd"]
+            ifname = upd["ifname"]
+            if upd.get("ifindex"):
+                self.ifindex[ifname] = upd["ifindex"]
+            flags_s = upd.get("flags")
+            operative = (
+                "OPERATIVE" in flags_s if flags_s is not None else True
+            )
+            if operative:
+                self.oper_up.add(ifname)
+                self._ensure_iface(ifname)
+            else:
+                self.oper_up.discard(ifname)
+                self.inst.remove_interface(ifname)
+                self.loop.run_until_idle()
+        elif "InterfaceAddressAdd" in ev:
+            upd = ev["InterfaceAddressAdd"]
+            try:
+                addr = ip_interface(upd["addr"])
+            except ValueError:
+                return
+            lst = self.addrs.setdefault(upd["ifname"], [])
+            if addr not in lst:
+                lst.append(addr)
+            self._ensure_iface(upd["ifname"])
+            ifname = upd["ifname"]
+            if (
+                ifname in self.inst.interfaces
+                and self._want_af(addr.ip)
+                and not (
+                    self.family == "ripng" and addr.ip.is_link_local
+                )
+            ):
+                self.inst.add_connected(ifname, addr.network)
+                self.loop.run_until_idle()
+        elif "InterfaceAddressDel" in ev:
+            upd = ev["InterfaceAddressDel"]
+            try:
+                addr = ip_interface(upd["addr"])
+            except ValueError:
+                return
+            lst = self.addrs.get(upd["ifname"]) or []
+            if addr in lst:
+                lst.remove(addr)
+            if not self._want_af(addr.ip):
+                return
+            ifname = upd["ifname"]
+            entry = self.inst.interfaces.get(ifname)
+            if entry is None:
+                return
+            self.inst.del_connected(addr.network)
+            usable = [a for a in lst if self._want_af(a.ip)]
+            if self.family == "ripng":
+                # RIPng needs a link-local source; loopbacks (which
+                # never transmit) stay eligible with any address.
+                eligible = any(a.ip.is_link_local for a in usable) or (
+                    ifname.startswith("lo") and bool(usable)
+                )
+            else:
+                eligible = bool(usable)
+            if not eligible:
+                # No usable source address left: the circuit leaves RIP.
+                self.inst.remove_interface(ifname)
+            self.loop.run_until_idle()
+        elif "RouteRedistributeAdd" in ev:
+            upd = ev["RouteRedistributeAdd"]
+            prefix = ip_network(upd["prefix"])
+            if upd.get("protocol") in ("ripv2", "ripng"):
+                return  # our own routes echoed back by the RIB
+            if self._want_af(prefix.network_address):
+                self.inst.redistribute(
+                    prefix, metric=max(1, upd.get("metric", 0)),
+                    tag=upd.get("tag") or 0,
+                )
+                self.loop.run_until_idle()
+        elif "RouteRedistributeDel" in ev:
+            upd = ev["RouteRedistributeDel"]
+            prefix = ip_network(upd["prefix"])
+            if self._want_af(prefix.network_address):
+                self.inst.redistribute_del(prefix)
+                self.loop.run_until_idle()
+        elif "RouteIpAdd" in ev or "RouteIpDel" in ev:
+            pass  # our own installed routes echoed by the RIB manager
+        else:
+            raise Unsupported(f"ibus {next(iter(ev))}")
+
+    def apply_protocol(self, ev: dict) -> None:
+        inst = self.inst
+        if "UdpRxPdu" in ev:
+            rx = ev["UdpRxPdu"]
+            pj = rx.get("pdu", {})
+            port = int(rx["src"].rsplit(":", 1)[1])
+            src_str = rx["src"].rsplit(":", 1)[0].strip("[]")
+            # RIPng sources embed a zone (the kernel ifindex).
+            zone = None
+            if "%" in src_str:
+                src_str, zone = src_str.split("%", 1)
+            src = ip_address(src_str)
+            ifname = None
+            if zone is not None:
+                ifname = next(
+                    (
+                        n for n, idx in self.ifindex.items()
+                        if str(idx) == zone
+                    ),
+                    None,
+                )
+            if ifname is None:
+                ifname = self._iface_for(src)
+            if ifname is None:
+                return
+            self.inst.neighbors[src] = self.loop.clock.now()
+            if "Err" in pj:
+                return  # recorded decode error: only the peer stats move
+            pdu_json = pj.get("Ok", pj)
+            well_known = 520 if self.family == "ripv2" else 521
+            if pdu_json.get("command") == "Response" and port != well_known:
+                return  # responses must come from the RIP port
+            data = _pdu_from_json(self.version, pdu_json)
+            inst.handle(NetRxPacket(ifname, src, None, data))
+            self.loop.run_until_idle()
+        elif "InitialUpdate" in ev:
+            inst.initial_update()
+        elif "UpdateInterval" in ev:
+            inst._send_updates(changed_only=False)
+        elif "TriggeredUpd" in ev:
+            inst.drain_triggered()
+        elif "TriggeredUpdTimeout" in ev:
+            inst.holdoff_expired()
+        elif "RouteTimeout" in ev:
+            inst.route_timeout(ip_network(ev["RouteTimeout"]["prefix"]))
+        elif "RouteGcTimeout" in ev:
+            inst.route_gc(ip_network(ev["RouteGcTimeout"]["prefix"]))
+        elif "NbrTimeout" in ev:
+            inst.nbr_timeout(ip_address(ev["NbrTimeout"]["addr"]))
+        else:
+            raise Unsupported(f"protocol {next(iter(ev))}")
+        self.loop.run_until_idle()
+
+    def _iface_for(self, src):
+        for ifname, (_cfg, _a, prefix) in self.inst.interfaces.items():
+            if prefix is not None and src in prefix:
+                return ifname
+        return None
+
+    def bring_up(self) -> None:
+        for line in (self.rt_dir / "events.jsonl").read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if "Ibus" in ev:
+                self.apply_ibus(ev["Ibus"])
+            elif "Protocol" in ev:
+                self.apply_protocol(ev["Protocol"])
+        self.live = True
+        self.inst._holdoff = False
+
+    # -- output planes
+
+    def drain_tx(self):
+        out = self.tx.log[:]
+        self.tx.log.clear()
+        return out
+
+    def drain_ibus(self):
+        out = self.ibus_log[:]
+        self.ibus_log.clear()
+        return out
+
+    def compare_protocol_output(self, expected_lines: list[dict]) -> list[str]:
+        ours = []
+        for ifname, dst, data in self.drain_tx():
+            ours.append(
+                {"ifname": ifname, "pdu": _pdu_to_json(self.version, data)}
+            )
+        problems = []
+        want = []
+        for exp in expected_lines:
+            tx = exp.get("UdpTxPdu")
+            if tx is None:
+                problems.append(f"unsupported output {next(iter(exp))}")
+                continue
+            want.append({"ifname": tx.get("ifname"), "pdu": tx["pdu"]})
+
+        def matches(w, g):
+            if w["ifname"] is not None and w["ifname"] != g["ifname"]:
+                return False
+            return subset_match(w["pdu"], g["pdu"])
+
+        cand = [[i for i, g in enumerate(ours) if matches(w, g)] for w in want]
+        assign: dict[int, int] = {}
+
+        def try_assign(w: int, seen: set) -> bool:
+            for i in cand[w]:
+                if i in seen:
+                    continue
+                seen.add(i)
+                if i not in assign or try_assign(assign[i], seen):
+                    assign[i] = w
+                    return True
+            return False
+
+        for w, item in enumerate(want):
+            if not try_assign(w, set()):
+                problems.append(
+                    "expected tx not sent: " + json.dumps(item["pdu"])[:160]
+                )
+        return problems
+
+    def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
+        proto = "ripv2" if self.family == "ripv2" else "ripng"
+        ours = []
+        for kind, prefix, route in self.drain_ibus():
+            if kind == "add":
+                ours.append(
+                    {
+                        "RouteIpAdd": {
+                            "protocol": proto,
+                            "prefix": str(prefix),
+                            "metric": route.metric,
+                            "nexthops": sorted(
+                                [
+                                    (
+                                        self.ifindex.get(route.ifname, 0),
+                                        str(route.nexthop)
+                                        if route.nexthop
+                                        else None,
+                                    )
+                                ]
+                            ),
+                        }
+                    }
+                )
+            else:
+                ours.append(
+                    {"RouteIpDel": {"protocol": proto, "prefix": str(prefix)}}
+                )
+        problems = []
+        unmatched = list(ours)
+        for exp in expected_lines:
+            if "RouteIpAdd" in exp:
+                e = exp["RouteIpAdd"]
+                canon = {
+                    "RouteIpAdd": {
+                        "protocol": e.get("protocol"),
+                        "prefix": e.get("prefix"),
+                        "metric": e.get("metric"),
+                        "nexthops": sorted(
+                            (
+                                nh.get("Address", {}).get("ifindex", 0),
+                                nh.get("Address", {}).get("addr"),
+                            )
+                            for nh in e.get("nexthops", [])
+                        ),
+                    }
+                }
+            elif "RouteIpDel" in exp:
+                canon = {
+                    "RouteIpDel": {
+                        "protocol": exp["RouteIpDel"].get("protocol"),
+                        "prefix": exp["RouteIpDel"].get("prefix"),
+                    }
+                }
+            else:
+                continue
+            hit = next(
+                (
+                    i
+                    for i, got in enumerate(unmatched)
+                    if subset_match(canon, got)
+                ),
+                None,
+            )
+            if hit is None:
+                problems.append(
+                    "expected ibus msg not sent: " + json.dumps(canon)[:140]
+                )
+            else:
+                unmatched.pop(hit)
+        return problems
+
+    def compare_state(self, state: dict) -> list[str]:
+        rip = state["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]["ietf-rip:rip"]
+        problems = []
+        af = rip.get("ipv4") if self.family == "ripv2" else rip.get("ipv6")
+        if af is None:
+            return problems
+        nbrs = (af.get("neighbors") or {}).get("neighbor")
+        if nbrs is not None:
+            key = (
+                "ipv4-address" if self.family == "ripv2" else "ipv6-address"
+            )
+            exp = {n[key] for n in nbrs}
+            got = {str(a) for a in self.inst.neighbors}
+            if exp != got:
+                problems.append(f"neighbors {sorted(got)} != {sorted(exp)}")
+        routes = (af.get("routes") or {}).get("route")
+        if routes is not None:
+            key = "ipv4-prefix" if self.family == "ripv2" else "ipv6-prefix"
+            exp_by_prefix = {ip_network(r[key]): r for r in routes}
+            ours = self.inst.routes
+            for prefix, r in exp_by_prefix.items():
+                got = ours.get(prefix)
+                if got is None:
+                    problems.append(f"missing route {prefix}")
+                    continue
+                if r.get("metric") is not None and got.metric != r["metric"]:
+                    problems.append(
+                        f"{prefix}: metric {got.metric} != {r['metric']}"
+                    )
+                if "next-hop" in r and str(got.nexthop) != r["next-hop"]:
+                    problems.append(
+                        f"{prefix}: nexthop {got.nexthop} != {r['next-hop']}"
+                    )
+                if "interface" in r and got.ifname != r["interface"]:
+                    problems.append(
+                        f"{prefix}: iface {got.ifname} != {r['interface']}"
+                    )
+                want_type = r.get("route-type")
+                have_type = (
+                    "connected" if got.route_type == "connected" else
+                    "redistributed" if got.route_type == "redistributed"
+                    else "rip"
+                )
+                if want_type is not None and have_type != want_type:
+                    problems.append(
+                        f"{prefix}: type {have_type} != {want_type}"
+                    )
+                if r.get("deleted"):
+                    problems.append(f"{prefix}: expected deleted route")
+                if r.get("inactive") is not None:
+                    inactive = got.garbage_at is not None
+                    if inactive != r["inactive"]:
+                        problems.append(
+                            f"{prefix}: inactive {inactive} != {r['inactive']}"
+                        )
+                if r.get("need-triggered-update") is not None:
+                    if got.changed != r["need-triggered-update"]:
+                        problems.append(
+                            f"{prefix}: changed {got.changed} != "
+                            f"{r['need-triggered-update']}"
+                        )
+            for prefix in set(ours) - set(exp_by_prefix):
+                problems.append(f"extra route {prefix}")
+        return problems
+
+    # -- config / rpc
+
+    def apply_rpc(self, rpc: dict) -> None:
+        if "ietf-rip:clear-rip-route" in rpc:
+            self.inst.clear_routes()
+        else:
+            raise Unsupported(f"rpc {next(iter(rpc))}")
+        self.loop.run_until_idle()
+
+    def apply_config_change(self, tree: dict) -> None:
+        proto = tree["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]
+        rip = proto.get("ietf-rip:rip", {})
+        inst = self.inst
+        unhandled: list[str] = []
+
+        def op_of(node: dict, leaf: str | None = None):
+            ann = node.get("@" + leaf if leaf else "@") or {}
+            return ann.get("yang:operation")
+
+        dist = rip.get("distance")
+        if isinstance(dist, dict) and op_of(dist, "default") in (
+            "replace", "create"
+        ):
+            inst.distance = dist["default"]
+        elif isinstance(dist, int) and op_of(rip, "distance") in (
+            "replace", "create"
+        ):
+            inst.distance = dist
+            for prefix, route in inst.routes.items():
+                if route.route_type == "rip" and route.metric < INFINITY_METRIC:
+                    self.ibus_log.append(("add", prefix, route))
+        for if_node in (rip.get("interfaces") or {}).get("interface", []):
+            ifname = if_node["interface"]
+            if op_of(if_node) == "delete":
+                self.if_conf.pop(ifname, None)
+                inst.remove_interface(ifname)
+                self.addrs.pop(ifname, None)
+                self.oper_up.discard(ifname)
+                continue
+            if op_of(if_node) == "create":
+                self.if_conf[ifname] = {
+                    k: v for k, v in if_node.items()
+                    if not k.startswith("@")
+                }
+                self._ensure_iface(ifname)
+            entry = inst.interfaces.get(ifname)
+            cfg = entry[0] if entry else None
+            if op_of(if_node, "cost") in ("replace", "create"):
+                self.if_conf.setdefault(ifname, {})["cost"] = if_node["cost"]
+                if cfg is not None:
+                    inst.iface_cost_update(ifname, if_node["cost"])
+            if op_of(if_node, "split-horizon") in ("replace", "create"):
+                self.if_conf.setdefault(ifname, {})["split-horizon"] = (
+                    if_node["split-horizon"]
+                )
+                if cfg is not None:
+                    cfg.split_horizon = if_node["split-horizon"]
+            if op_of(if_node, "passive") in ("replace", "create"):
+                self.if_conf.setdefault(ifname, {})["passive"] = if_node[
+                    "passive"
+                ]
+                if cfg is not None:
+                    cfg.passive = bool(if_node["passive"])
+            nbrs = (if_node.get("neighbors") or {}).get("neighbor", [])
+            for nbr in nbrs:
+                addr = ip_address(nbr["address"])
+                if op_of(nbr) == "delete":
+                    inst.static_neighbors.discard((ifname, addr))
+                else:
+                    inst.static_neighbors.add((ifname, addr))
+        for nbr in (rip.get("static-neighbors") or {}).get("neighbor", []):
+            addr = ip_address(nbr["ipv4-address" if self.family == "ripv2" else "ipv6-address"])
+            ifname = inst._iface_of(addr)
+            if op_of(nbr) == "delete":
+                inst.static_neighbors = {
+                    (i, a) for i, a in inst.static_neighbors if a != addr
+                }
+            elif ifname is not None and (
+                (ifname, addr) not in inst.static_neighbors
+            ):
+                inst.static_neighbors.add((ifname, addr))
+                entry = inst.interfaces[ifname]
+                inst.netio.send(
+                    ifname, entry[1], addr,
+                    self.version.encode_request_all(),
+                )
+        self.loop.run_until_idle()
+
+
+def run_case(family: str, case_dir: Path, topo: str, rt: str):
+    run = CaseRun(family, RIP_DIR / family / "topologies" / topo, rt)
+    try:
+        run.bring_up()
+    except Unsupported as e:
+        return "skip", f"bring-up: {e}"
+    run.drain_tx()
+    run.drain_ibus()
+
+    steps = sorted(
+        {f.name.split("-")[0] for f in case_dir.iterdir() if f.name[0].isdigit()}
+    )
+    problems = []
+    for step in steps:
+        run.drain_ibus()
+        try:
+            for kind in ("ibus", "protocol"):
+                f = case_dir / f"{step}-input-{kind}.jsonl"
+                if f.exists():
+                    for line in f.read_text().splitlines():
+                        if not line.strip():
+                            continue
+                        ev = json.loads(line)
+                        if kind == "ibus":
+                            run.apply_ibus(ev)
+                        else:
+                            run.apply_protocol(ev)
+            f = case_dir / f"{step}-input-northbound-config-change.json"
+            if f.exists():
+                run.apply_config_change(json.loads(f.read_text()))
+            f = case_dir / f"{step}-input-northbound-rpc.json"
+            if f.exists():
+                run.apply_rpc(json.loads(f.read_text()))
+        except Unsupported as e:
+            return "skip", f"step {step}: {e}"
+        # The stub's sync point: queued self-posted triggers drain once
+        # all of the step's inputs have been applied.
+        run.inst.drain_triggered()
+        out_proto = case_dir / f"{step}-output-protocol.jsonl"
+        if out_proto.exists():
+            expected = [
+                json.loads(l)
+                for l in out_proto.read_text().splitlines()
+                if l.strip()
+            ]
+            problems += [
+                f"step {step}: {p}"
+                for p in run.compare_protocol_output(expected)
+            ]
+        else:
+            run.drain_tx()
+        out_ibus = case_dir / f"{step}-output-ibus.jsonl"
+        if out_ibus.exists():
+            expected = [
+                json.loads(l)
+                for l in out_ibus.read_text().splitlines()
+                if l.strip()
+            ]
+            problems += [
+                f"step {step}: {p}" for p in run.compare_ibus(expected)
+            ]
+        out_state = case_dir / f"{step}-output-northbound-state.json"
+        if out_state.exists():
+            state = json.loads(out_state.read_text())
+            problems += [
+                f"step {step}: {p}" for p in run.compare_state(state)
+            ]
+    return ("pass", "") if not problems else ("fail", "; ".join(problems[:6]))
+
+
+def run_all(families=("ripv2", "ripng")):
+    results = {}
+    for family in families:
+        for case, (topo, rt) in sorted(case_map(family).items()):
+            case_dir = RIP_DIR / family / case
+            if not case_dir.is_dir():
+                continue
+            try:
+                results[f"{family}/{case}"] = run_case(
+                    family, case_dir, topo, rt
+                )
+            except Exception as e:  # noqa: BLE001 — survey must not die
+                results[f"{family}/{case}"] = (
+                    "fail", f"exception: {type(e).__name__}: {e}"
+                )
+    return results
+
+
+if __name__ == "__main__":
+    res = run_all()
+    by = {"pass": [], "fail": [], "skip": []}
+    for case, (status, detail) in sorted(res.items()):
+        by[status].append(case)
+        if status != "pass":
+            print(f"{status:5} {case}: {detail[:170]}")
+    print(
+        f"\npass {len(by['pass'])} fail {len(by['fail'])} "
+        f"skip {len(by['skip'])} / {len(res)}"
+    )

@@ -35,8 +35,11 @@ def chain(loop, fabric, n=3):
     for i in range(n - 1):
         net = N(f"10.0.{i}.0/30")
         a1, a2 = A(f"10.0.{i}.1"), A(f"10.0.{i}.2")
-        routers[i].add_interface(f"e{i}r", RipIfConfig(), a1, net)
-        routers[i + 1].add_interface(f"e{i}l", RipIfConfig(), a2, net)
+        sh = RipIfConfig(split_horizon="poison-reverse")
+        routers[i].add_interface(f"e{i}r", sh, a1, net)
+        routers[i + 1].add_interface(
+            f"e{i}l", RipIfConfig(split_horizon="poison-reverse"), a2, net
+        )
         fabric.join(f"l{i}", f"rip{i}", f"e{i}r", a1)
         fabric.join(f"l{i}", f"rip{i+1}", f"e{i}l", a2)
     return routers
@@ -85,7 +88,7 @@ def test_ripng_v6_chain_propagation():
     # codec roundtrip
     pkt = RipngPacket(RipCommand.RESPONSE, [(N6("2001:db8:1::/48"), 7, 3)])
     out = RipngPacket.decode(pkt.encode())
-    assert out.rtes == [(N6("2001:db8:1::/48"), 7, 3)]
+    assert out.rtes == [(N6("2001:db8:1::/48"), 7, 3, None)]
 
     loop = EventLoop(clock=VirtualClock())
     fabric = MockFabric(loop)
@@ -98,8 +101,11 @@ def test_ripng_v6_chain_propagation():
     for i in range(2):
         net = N6(f"2001:db8:{i}::/64")
         a1, a2 = A6(f"fe80::{i}:1"), A6(f"fe80::{i}:2")
-        routers[i].add_interface(f"e{i}r", RipIfConfig(), a1, net)
-        routers[i + 1].add_interface(f"e{i}l", RipIfConfig(), a2, net)
+        sh = RipIfConfig(split_horizon="poison-reverse")
+        routers[i].add_interface(f"e{i}r", sh, a1, net)
+        routers[i + 1].add_interface(
+            f"e{i}l", RipIfConfig(split_horizon="poison-reverse"), a2, net
+        )
         fabric.join(f"l{i}", f"rng{i}", f"e{i}r", a1)
         fabric.join(f"l{i}", f"rng{i+1}", f"e{i}l", a2)
     loop.advance(70)
@@ -126,3 +132,39 @@ def test_timeout_and_garbage_collection():
     assert N("10.0.1.0/30") not in r0.routes
     # Connected route survives.
     assert N("10.0.0.0/30") in r0.routes
+
+
+def test_ripv2_authentication():
+    """RFC 2453 §4.1 simple password + RFC 2082 keyed-MD5: round-trip,
+    rejection of missing/wrong credentials."""
+    import pytest
+
+    from holo_tpu.protocols.rip import RipPacket, Rte
+    from holo_tpu.utils.bytesbuf import DecodeError
+
+    rtes = [Rte(N("10.0.0.0/24"), A("0.0.0.0"), 2, 0)]
+    # Simple password.
+    wire = RipPacket(RipCommand.RESPONSE, rtes).encode(auth_password="s3cret")
+    out = RipPacket.decode(wire, auth_password="s3cret")
+    assert out.rtes[0].prefix == N("10.0.0.0/24")
+    with pytest.raises(DecodeError):
+        RipPacket.decode(wire, auth_password="wrong")
+    with pytest.raises(DecodeError):
+        # Unauthenticated packet rejected when auth is required.
+        RipPacket.decode(
+            RipPacket(RipCommand.RESPONSE, rtes).encode(),
+            auth_password="s3cret",
+        )
+    # Keyed MD5.
+    wire = RipPacket(RipCommand.RESPONSE, rtes).encode(
+        auth_key=b"k3y", seqno=7
+    )
+    out = RipPacket.decode(wire, auth_key=b"k3y")
+    assert out.rtes[0].metric == 2
+    with pytest.raises(DecodeError):
+        RipPacket.decode(wire, auth_key=b"other")
+    # Tampered payload fails the digest.
+    bad = bytearray(wire)
+    bad[30] ^= 1
+    with pytest.raises(DecodeError):
+        RipPacket.decode(bytes(bad), auth_key=b"k3y")

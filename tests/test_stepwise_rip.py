@@ -1,0 +1,49 @@
+"""RIP stepwise conformance: the reference's recorded cases (both the
+ripv2 and ripng corpora) replayed through our live RipInstance
+(tools/stepwise_rip.py).
+
+All 72 case directories pass: message handling (requests, responses,
+third-party next hops, decode errors), timers (initial/periodic/
+triggered updates with the reference's holdoff semantics, route
+timeout/GC, neighbor timeout), ibus interface/address/redistribution
+events, config changes (cost recalc, split horizon, passive, static
+neighbors, distance), and the clear-route RPC — asserting the
+protocol, ibus, and northbound-state planes.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.stepwise_rip import RIP_DIR, case_map, run_all, run_case
+
+pytestmark = pytest.mark.skipif(
+    not RIP_DIR.exists(), reason="reference corpus not present"
+)
+
+KNOWN_PASS = [
+    ("ripv2", "message-request1"),
+    ("ripv2", "timeout-route1"),
+    ("ripng", "message-response9"),
+    ("ripng", "nb-config-split-horizon1"),
+]
+PASS_FLOOR = 72
+
+
+def test_known_cases_pass():
+    for family, case in KNOWN_PASS:
+        cm = case_map(family)
+        status, detail = run_case(
+            family, RIP_DIR / family / case, *cm[case]
+        )
+        assert status == "pass", f"{family}/{case}: {detail}"
+
+
+def test_stepwise_sweep_floor():
+    res = run_all()
+    passed = sorted(c for c, (s, _) in res.items() if s == "pass")
+    failed = {c: d for c, (s, d) in res.items() if s != "pass"}
+    assert len(passed) >= PASS_FLOOR, (
+        f"only {len(passed)} RIP stepwise cases pass (floor {PASS_FLOOR}); "
+        f"failures: { {c: d[:120] for c, d in list(failed.items())[:5]} }"
+    )
