@@ -23,12 +23,17 @@ class LoggingConfig:
 class GrpcConfig:
     enabled: bool = True
     address: str = "127.0.0.1:50051"
+    # TLS (holo-daemon grpc.rs TLS option): both paths set = secure port.
+    tls_cert: str | None = None
+    tls_key: str | None = None
 
 
 @dataclass
 class GnmiConfig:
     enabled: bool = False
     address: str = "127.0.0.1:50052"
+    tls_cert: str | None = None
+    tls_key: str | None = None
 
 
 @dataclass
@@ -67,10 +72,14 @@ class DaemonConfig:
             g = raw["grpc"]
             cfg.grpc.enabled = g.get("enabled", True)
             cfg.grpc.address = g.get("address", cfg.grpc.address)
+            cfg.grpc.tls_cert = g.get("tls-cert")
+            cfg.grpc.tls_key = g.get("tls-key")
         if "gnmi" in raw:
             g = raw["gnmi"]
             cfg.gnmi.enabled = g.get("enabled", False)
             cfg.gnmi.address = g.get("address", cfg.gnmi.address)
+            cfg.gnmi.tls_cert = g.get("tls-cert")
+            cfg.gnmi.tls_key = g.get("tls-key")
         if "event_recorder" in raw:
             e = raw["event_recorder"]
             cfg.event_recorder.enabled = e.get("enabled", False)

@@ -124,13 +124,23 @@ class Daemon:
     def start_grpc(self, address: str | None = None):
         from holo_tpu.daemon.grpc_server import serve
 
-        self._grpc_server = serve(self, address or self.config.grpc.address)
+        self._grpc_server = serve(
+            self,
+            address or self.config.grpc.address,
+            tls_cert=self.config.grpc.tls_cert,
+            tls_key=self.config.grpc.tls_key,
+        )
         return self._grpc_server
 
     def start_gnmi(self, address: str | None = None):
         from holo_tpu.daemon.gnmi_server import serve_gnmi
 
-        self._gnmi_server = serve_gnmi(self, address or self.config.gnmi.address)
+        self._gnmi_server = serve_gnmi(
+            self,
+            address or self.config.gnmi.address,
+            tls_cert=self.config.gnmi.tls_cert,
+            tls_key=self.config.gnmi.tls_key,
+        )
         return self._gnmi_server
 
     def stop(self):

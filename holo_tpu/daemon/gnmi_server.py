@@ -218,7 +218,7 @@ def _apply_json(tree, base: str, sub) -> None:
             tree.set(p, v)
 
 
-def serve_gnmi(daemon, address: str) -> grpc.Server:
+def serve_gnmi(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server:
     service = GnmiService(daemon)
     daemon.add_commit_listener(service._notify_commit)
     svc_desc = pb.DESCRIPTOR.services_by_name["gNMI"]
@@ -239,7 +239,9 @@ def serve_gnmi(daemon, address: str) -> grpc.Server:
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler("gnmi.gNMI", handlers),)
     )
-    server.add_insecure_port(address)
+    from holo_tpu.daemon.grpc_server import _bind
+
+    _bind(server, address, tls_cert, tls_key)
     server.start()
     daemon._gnmi_service = service
     return server

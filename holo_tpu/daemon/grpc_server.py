@@ -231,7 +231,24 @@ def _handlers(service: NorthboundService) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler("holo_tpu.Northbound", method_handlers)
 
 
-def serve(daemon, address: str) -> grpc.Server:
+def _bind(server, address: str, tls_cert=None, tls_key=None) -> None:
+    """Bind the listen port, with TLS when both PEM paths are set
+    (holo-daemon grpc.rs TLS option).  A half-configured TLS pair is a
+    hard error — never silently fail open to plaintext."""
+    if bool(tls_cert) != bool(tls_key):
+        raise ValueError(
+            "TLS misconfigured: need both tls-cert and tls-key"
+        )
+    if tls_cert and tls_key:
+        creds = grpc.ssl_server_credentials(
+            [(Path(tls_key).read_bytes(), Path(tls_cert).read_bytes())]
+        )
+        server._bound_port = server.add_secure_port(address, creds)
+    else:
+        server._bound_port = server.add_insecure_port(address)
+
+
+def serve(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server:
     service = NorthboundService(daemon)
     daemon.add_commit_listener(
         lambda txn: service._notify(
@@ -240,7 +257,7 @@ def serve(daemon, address: str) -> grpc.Server:
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((_handlers(service),))
-    server.add_insecure_port(address)
+    _bind(server, address, tls_cert, tls_key)
     server.start()
     daemon._grpc_service = service
     return server

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 from ipaddress import IPv4Address
 
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, ip_checksum
-from holo_tpu.utils.ip import VRRP_GROUP_V4
+from holo_tpu.utils.ip import VRRP_GROUP_V4, VRRP_GROUP_V6
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
 
@@ -25,13 +25,16 @@ class VrrpState(enum.Enum):
 
 @dataclass
 class VrrpPacket:
-    """VRRPv3 (RFC 5798 §5.2); v2 differs in advert-int units + auth."""
+    """VRRPv3 (RFC 5798 §5.2); v2 differs in advert-int units + auth.
+    ``af`` selects the address family the virtual addresses encode in
+    (v6 checksums ride the kernel's pseudo-header offload: 0 on tx)."""
 
     version: int
     vrid: int
     priority: int
     max_advert_int: int  # centiseconds (v3) / seconds (v2)
-    addresses: list[IPv4Address] = field(default_factory=list)
+    addresses: list = field(default_factory=list)
+    af: int = 4
 
     def encode(self) -> bytes:
         w = Writer()
@@ -45,15 +48,19 @@ class VrrpPacket:
             w.u8(0).u8(self.max_advert_int & 0xFF)  # auth type 0, advert int
         w.u16(0)  # checksum
         for a in self.addresses:
-            w.ipv4(a)
+            if self.af == 4:
+                w.ipv4(a)
+            else:
+                w.ipv6(a)
         if self.version == 2:
             w.u64(0)  # empty auth data
-        cks = ip_checksum(bytes(w.buf))
-        w.patch_u16(6, cks)
+        if self.af == 4:
+            cks = ip_checksum(bytes(w.buf))
+            w.patch_u16(6, cks)
         return w.finish()
 
     @classmethod
-    def decode(cls, data: bytes) -> "VrrpPacket":
+    def decode(cls, data: bytes, af: int = 4) -> "VrrpPacket":
         r = Reader(data)
         vt = r.u8()
         version, ptype = vt >> 4, vt & 0xF
@@ -67,11 +74,12 @@ class VrrpPacket:
         else:
             r.u8()
             advert = r.u8()
-        r.u16()  # checksum (validated below)
-        if ip_checksum(data) != 0:
+        r.u16()  # checksum (validated below; v6 uses the pseudo-header
+        # and is checked by the kernel before delivery)
+        if af == 4 and ip_checksum(data) != 0:
             raise DecodeError("VRRP checksum mismatch")
-        addrs = [r.ipv4() for _ in range(count)]
-        return cls(version, vrid, prio, advert, addrs)
+        addrs = [r.ipv4() if af == 4 else r.ipv6() for _ in range(count)]
+        return cls(version, vrid, prio, advert, addrs, af)
 
 
 @dataclass
@@ -89,9 +97,10 @@ class VrrpConfig:
     vrid: int
     ifname: str
     version: int = 3
+    af: int = 4
     priority: int = 100
     advert_interval: float = 1.0  # seconds
-    addresses: list[IPv4Address] = field(default_factory=list)
+    addresses: list = field(default_factory=list)
     preempt: bool = True
     accept: bool = False
 
@@ -103,12 +112,15 @@ class VrrpInstance(Actor):
     name = "vrrp"
 
     def __init__(self, name: str, config: VrrpConfig, iface_addr: IPv4Address,
-                 netio: NetIo, on_state=None):
+                 netio: NetIo, on_state=None, garp_cb=None):
         self.name = name
         self.config = config
         self.iface_addr = iface_addr
         self.netio = netio
         self.on_state = on_state  # callable(state) for macvlan programming
+        # callable(addr) fired per virtual address on master transition:
+        # gratuitous ARP (v4) / unsolicited neighbor advert (v6).
+        self.garp_cb = garp_cb
         self.state = VrrpState.INITIALIZE
         self.master_adver_int = config.advert_interval
         self.owner = iface_addr in config.addresses
@@ -148,6 +160,9 @@ class VrrpInstance(Actor):
     def _become_master(self) -> None:
         self._set_state(VrrpState.MASTER)
         self._send_advert()
+        if self.garp_cb is not None:
+            for addr in self.config.addresses:
+                self.garp_cb(addr)
         self._advert_timer.start(self.config.advert_interval)
         self._mdown_timer.cancel()
 
@@ -177,9 +192,14 @@ class VrrpInstance(Actor):
 
     def _rx(self, msg: NetRxPacket) -> None:
         try:
-            pkt = VrrpPacket.decode(msg.data)
+            pkt = VrrpPacket.decode(msg.data, af=self.config.af)
         except DecodeError:
             return
+        self.rx_packet(msg.src, pkt)
+
+    def rx_packet(self, src, pkt: VrrpPacket) -> None:
+        """Process a decoded advertisement (the conformance replay feeds
+        decoded objects, like the reference's testing stub)."""
         if pkt.vrid != self.config.vrid:
             return
         if pkt.version == 3:
@@ -202,10 +222,14 @@ class VrrpInstance(Actor):
                 self._advert_timer.start(self.config.advert_interval)
             elif pkt.priority > self.config.priority or (
                 pkt.priority == self.config.priority
-                and int(msg.src) > int(self.iface_addr)
+                and int(src) > int(self.iface_addr)
             ):
                 self.master_adver_int = advert
                 self._become_backup()
+            else:
+                # Lower-priority challenger: assert mastership at once.
+                self._send_advert()
+                self._advert_timer.start(self.config.advert_interval)
 
     def _send_advert(self, priority: int | None = None) -> None:
         cfg = self.config
@@ -220,5 +244,7 @@ class VrrpInstance(Actor):
             priority=cfg.priority if priority is None else priority,
             max_advert_int=adv,
             addresses=list(cfg.addresses),
+            af=cfg.af,
         )
-        self.netio.send(cfg.ifname, self.iface_addr, VRRP_GROUP_V4, pkt.encode())
+        group = VRRP_GROUP_V4 if cfg.af == 4 else VRRP_GROUP_V6
+        self.netio.send(cfg.ifname, self.iface_addr, group, pkt.encode())

@@ -35,6 +35,7 @@ RIPV2_GROUP = IPv4Address("224.0.0.9")
 RIPNG_GROUP = IPv6Address("ff02::9")
 # VRRP (RFC 5798).
 VRRP_GROUP_V4 = IPv4Address("224.0.0.18")
+VRRP_GROUP_V6 = IPv6Address("ff02::12")
 
 
 def af_of(addr: IpAddr) -> AddressFamily:

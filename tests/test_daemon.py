@@ -369,3 +369,67 @@ def test_ldp_config_driven_session_and_lib():
     cand.set("routing/control-plane-protocols/ldp/enabled", False)
     d1.commit(cand)
     assert "ldp" not in d1.routing.instances
+
+
+def test_grpc_tls(tmp_path):
+    """gRPC northbound over TLS (holo-daemon grpc.rs TLS option): a
+    self-signed server cert; the client trusts it as root CA."""
+    import datetime
+
+    import grpc as _grpc
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = tmp_path / "cert.pem"
+    key_pem = tmp_path / "key.pem"
+    cert_pem.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_pem.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+
+    from holo_tpu.daemon import grpc_server as gs
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, name="tls1")
+    d.config.grpc.tls_cert = str(cert_pem)
+    d.config.grpc.tls_key = str(key_pem)
+    server = d.start_grpc("localhost:0")
+    port = server._bound_port
+    assert port
+    creds = _grpc.ssl_channel_credentials(
+        root_certificates=cert_pem.read_bytes()
+    )
+    channel = _grpc.secure_channel(f"localhost:{port}", creds)
+    pb = gs.pb
+    resp = channel.unary_unary(
+        "/holo_tpu.Northbound/Capabilities",
+        request_serializer=pb.CapabilitiesRequest.SerializeToString,
+        response_deserializer=pb.CapabilitiesResponse.FromString,
+    )(pb.CapabilitiesRequest(), timeout=10)
+    assert resp.modules
+    channel.close()
+    server.stop(None)

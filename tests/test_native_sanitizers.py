@@ -1,0 +1,48 @@
+"""ASan/UBSan coverage for the C++ core (SURVEY.md §5: mandatory once
+Rust's compile-time guarantees are dropped).
+
+Builds native/sanitize_driver.cpp together with both native translation
+units under -fsanitize=address,undefined and runs it; any heap error,
+leak, overflow, or UB aborts the binary with a nonzero exit.
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+NATIVE = ROOT / "native"
+
+
+@pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="g++ unavailable",
+)
+def test_native_under_asan_ubsan(tmp_path):
+    binary = tmp_path / "sanitize_driver"
+    build = subprocess.run(
+        [
+            "g++", "-std=c++17", "-O1", "-g", "-fno-omit-frame-pointer",
+            "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+            str(NATIVE / "sanitize_driver.cpp"),
+            str(NATIVE / "runtime_core.cpp"),
+            str(NATIVE / "spf_baseline.cpp"),
+            "-o", str(binary),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert build.returncode == 0, f"build failed:\n{build.stderr[-2000:]}"
+    run = subprocess.run(
+        [str(binary)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={"ASAN_OPTIONS": "detect_leaks=1", "UBSAN_OPTIONS": "print_stacktrace=1"},
+    )
+    assert run.returncode == 0, (
+        f"sanitizer failure:\n{run.stdout[-1000:]}\n{run.stderr[-3000:]}"
+    )
+    assert "sanitize_driver OK" in run.stdout

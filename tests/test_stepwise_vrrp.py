@@ -1,0 +1,36 @@
+"""VRRP stepwise conformance: all 15 reference cases replayed through
+our live per-interface virtual routers (tools/stepwise_vrrp.py) —
+VRRPv2, VRRPv3-IPv4 and VRRPv3-IPv6 topologies; master election,
+macvlan lifecycle, virtual-address programming, gratuitous ARP /
+unsolicited NA bursts, packet errors, and config changes.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.stepwise_vrrp import VRRP_DIR, case_map, run_all, run_case
+
+pytestmark = pytest.mark.skipif(
+    not VRRP_DIR.exists(), reason="reference corpus not present"
+)
+
+PASS_FLOOR = 15
+
+
+def test_known_case():
+    cm = case_map()
+    status, detail = run_case(
+        VRRP_DIR / "master-down-timer1", *cm["master-down-timer1"]
+    )
+    assert status == "pass", detail
+
+
+def test_stepwise_sweep_floor():
+    res = run_all()
+    passed = sorted(c for c, (s, _) in res.items() if s == "pass")
+    failed = {c: d for c, (s, d) in res.items() if s != "pass"}
+    assert len(passed) >= PASS_FLOOR, (
+        f"only {len(passed)} VRRP cases pass (floor {PASS_FLOOR}); "
+        f"failures: { {c: d[:120] for c, d in list(failed.items())[:5]} }"
+    )
