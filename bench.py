@@ -202,7 +202,7 @@ def main() -> None:
 
             jax.config.update("jax_platforms", "cpu")
         stage = sys.argv[sys.argv.index("--stage") + 1]
-        k10, b10, cpu10 = (20, 32, 8) if small else (90, 256, 32)
+        k10, b10, cpu10 = (20, 32, 8) if small else (90, 512, 32)
         k50, b50, cpu50 = (30, 16, 4) if small else (200, 128, 8)
         blat = 32 if small else 128
         fn = {
@@ -233,14 +233,31 @@ def main() -> None:
     n10 = "500" if small else "10125"
     blocked = extra.get("blocked10k", {})
     gather = extra.get("gather10k", {})
-    if blocked.get("ok") and "runs_per_sec" in blocked:
-        value = blocked["runs_per_sec"]
-        cpu = blocked.get("cpu_runs_per_sec") or gather.get("cpu_runs_per_sec")
-        metric = f"ospfv2_full_spf_whatif_runs_per_sec_{n10}v_blocked{suffix}"
-    elif gather.get("ok") and "runs_per_sec" in gather:
-        value = gather["runs_per_sec"]
-        cpu = gather.get("cpu_runs_per_sec")
+    # Headline = the faster of the two parity-checked engines on the 10k
+    # what-if batch (both compute the identical full-SPF result).  The
+    # metric NAME stays fixed either way so the driver's per-round series
+    # doesn't fragment; the winning engine is recorded in extra.
+    candidates = [
+        (gather, "gather"),
+        (blocked, "blocked"),
+    ]
+    candidates = [
+        (st, eng)
+        for st, eng in candidates
+        if st.get("ok") and "runs_per_sec" in st
+    ]
+    if candidates:
+        best, engine = max(candidates, key=lambda c: c[0]["runs_per_sec"])
+        value = best["runs_per_sec"]
         metric = f"ospfv2_full_spf_whatif_runs_per_sec_{n10}v{suffix}"
+        extra["headline_engine"] = engine
+        cpu = best.get("cpu_runs_per_sec") or max(
+            (
+                st.get("cpu_runs_per_sec", 0)
+                for st, _ in candidates
+            ),
+            default=0,
+        )
     else:
         print(
             json.dumps(
