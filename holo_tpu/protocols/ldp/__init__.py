@@ -4,6 +4,18 @@ Reference: holo-ldp (SURVEY.md §2.3) — UDP hello discovery, TCP session
 with init/keepalive, downstream-unsolicited label distribution with
 liberal retention, FEC table driven by RIB routes.
 
+Package layout:
+- :mod:`.packet` — full RFC 5036 wire codec (all messages/TLVs, status
+  codes, decode-error -> status mapping);
+- :mod:`.engine` — the reference-grade protocol core (session FSM,
+  LMp/LRq/LWd/LRl label procedures, targeted discovery, YANG state),
+  verified against all 70 recorded holo-ldp conformance cases + both
+  topology snapshots (tools/stepwise_ldp.py);
+- this module — the daemon-facing transport slice (fabric/netns
+  hellos + sessions, LIB feed to the RIB manager).  Its simplified
+  internal codec predates :mod:`.packet` and is being migrated onto the
+  engine; new protocol behavior belongs in :mod:`.engine`.
+
 Transport on the fabric: hellos are multicast frames, session messages
 unicast frames (the daemon binds real UDP 646 + TCP 646).
 """

@@ -1,0 +1,218 @@
+"""LDP wire codec round-trips and error paths (RFC 5036).
+
+Reference parity: holo-ldp/src/packet/* — message set, TLV U/F-bit
+handling, PDU splitting at max_pdu_len, and the DecodeError -> StatusCode
+mapping (notification.rs:459-477).
+"""
+
+from ipaddress import IPv4Address as A
+from ipaddress import ip_network as N
+
+import pytest
+
+from holo_tpu.protocols.ldp.packet import (
+    AF_IPV4,
+    AddressMsg,
+    CapabilityMsg,
+    DecodeError,
+    FecPrefix,
+    FecWildcard,
+    HELLO_GTSM,
+    HELLO_REQ_TARGETED,
+    HELLO_TARGETED,
+    HelloMsg,
+    InitMsg,
+    KeepaliveMsg,
+    LabelMsg,
+    MsgType,
+    NotifMsg,
+    Pdu,
+    StatusCode,
+    status_is_fatal,
+)
+
+ALL_MSGS = [
+    HelloMsg(
+        msg_id=1,
+        holdtime=15,
+        flags=HELLO_GTSM,
+        ipv4_addr=A("1.1.1.1"),
+        cfg_seqno=1,
+    ),
+    HelloMsg(
+        msg_id=2,
+        holdtime=45,
+        flags=HELLO_TARGETED | HELLO_REQ_TARGETED,
+        ipv4_addr=A("6.6.6.6"),
+        cfg_seqno=2,
+    ),
+    InitMsg(
+        msg_id=3,
+        keepalive_time=180,
+        lsr_id=A("2.2.2.2"),
+        cap_dynamic=True,
+        cap_twcard_fec=True,
+        cap_unrec_notif=True,
+    ),
+    KeepaliveMsg(msg_id=4),
+    AddressMsg(msg_id=5, addr_list=[A("10.0.1.1"), A("10.0.2.1")]),
+    AddressMsg(msg_id=6, withdraw=True, addr_list=[A("10.0.1.1")]),
+    LabelMsg(
+        msg_id=7,
+        msg_type=MsgType.LABEL_MAPPING,
+        fec=[FecPrefix(N("10.0.0.0/24"))],
+        label=16,
+        request_id=68,
+    ),
+    LabelMsg(
+        msg_id=8,
+        msg_type=MsgType.LABEL_REQUEST,
+        fec=[FecWildcard(typed_af=AF_IPV4)],
+    ),
+    LabelMsg(
+        msg_id=9,
+        msg_type=MsgType.LABEL_WITHDRAW,
+        fec=[FecWildcard()],
+        label=17,
+    ),
+    LabelMsg(
+        msg_id=10,
+        msg_type=MsgType.LABEL_RELEASE,
+        fec=[FecPrefix(N("2001:db8::/64"))],
+        label=18,
+    ),
+    NotifMsg(
+        msg_id=11,
+        status_code=StatusCode.SHUTDOWN.encode_status(),
+        status_msg_id=40,
+        status_msg_type=0x400,
+    ),
+    NotifMsg(
+        msg_id=12,
+        status_code=StatusCode.END_OF_LIB.encode_status(),
+        fec=[FecWildcard(typed_af=AF_IPV4)],
+    ),
+    CapabilityMsg(msg_id=13, twcard_fec=False, unrec_notif=True),
+]
+
+
+def test_round_trip_all_messages():
+    pdu = Pdu(A("9.9.9.9"), 0, ALL_MSGS)
+    out = Pdu.decode(pdu.encode())
+    assert out.lsr_id == pdu.lsr_id
+    assert out.messages == ALL_MSGS
+
+
+def test_pdu_split_at_max_len():
+    msgs = [
+        LabelMsg(
+            msg_id=i,
+            msg_type=MsgType.LABEL_MAPPING,
+            fec=[FecPrefix(N("10.0.0.0/24"))],
+            label=16,
+        )
+        for i in range(300)
+    ]
+    wire = Pdu(A("9.9.9.9"), 0, msgs).encode(max_pdu_len=600)
+    total, off = 0, 0
+    while off < len(wire):
+        ln = int.from_bytes(wire[off + 2 : off + 4], "big") + 4
+        assert ln <= 600 + 4
+        sub = Pdu.decode(wire[off : off + ln])
+        total += len(sub.messages)
+        off += ln
+    assert total == 300
+
+
+@pytest.mark.parametrize(
+    "mutate,kind",
+    [
+        (lambda w: b"\x00\x02" + w[2:], "InvalidVersion"),
+        (lambda w: w[:4] + bytes(4) + w[8:], "InvalidLsrId"),
+        (lambda w: w[:8] + b"\x00\x01" + w[10:], "InvalidLabelSpace"),
+        (lambda w: w[:2] + b"\x00\x01" + w[4:], "InvalidPduLength"),
+    ],
+)
+def test_decode_errors(mutate, kind):
+    wire = Pdu(A("1.1.1.1"), 0, [KeepaliveMsg(msg_id=1)]).encode()
+    with pytest.raises(DecodeError) as e:
+        Pdu.decode(mutate(wire))
+    assert e.value.kind == kind
+
+
+def test_error_status_mapping():
+    # notification.rs:459-477
+    assert (
+        DecodeError("InvalidVersion", 2).status_code()
+        == StatusCode.BAD_PROTO_VERS
+    )
+    assert (
+        DecodeError("UnknownMessage", 0x9999).status_code()
+        == StatusCode.UNKNOWN_MSG_TYPE
+    )
+    assert (
+        DecodeError("ReadOutOfBounds").status_code()
+        == StatusCode.INTERNAL_ERROR
+    )
+
+
+def test_fatal_bit():
+    assert status_is_fatal(StatusCode.SHUTDOWN.encode_status())
+    assert not status_is_fatal(StatusCode.END_OF_LIB.encode_status())
+    assert not status_is_fatal(StatusCode.NO_ROUTE.encode_status())
+
+
+def test_unknown_ubit_message_skipped():
+    # RFC 5036 §3.3 / message.rs:363: U-bit unknown messages are
+    # silently ignored, not surfaced as a placeholder message.
+    from holo_tpu.utils.bytesbuf import Writer
+
+    w = Writer()
+    w.u16(1).u16(0).ipv4(A("1.1.1.1")).u16(0)
+    w.u16(0x8F00).u16(4).u32(99)
+    buf = bytearray(w.finish())
+    buf[2:4] = (len(buf) - 4).to_bytes(2, "big")
+    assert Pdu.decode(bytes(buf)).messages == []
+
+
+def test_truncated_tlv_maps_to_ldp_error():
+    # A TLV whose declared length is shorter than its fields must raise
+    # packet.DecodeError (status-mappable), not leak bytesbuf errors.
+    from holo_tpu.utils.bytesbuf import Writer
+
+    w = Writer()
+    w.u16(1).u16(0).ipv4(A("1.1.1.1")).u16(0)
+    w.u16(0x0202).u16(8).u32(5)
+    w.u16(0x050B | 0x8000).u16(0)  # capability TLV, empty body
+    buf = bytearray(w.finish())
+    buf[2:4] = (len(buf) - 4).to_bytes(2, "big")
+    with pytest.raises(DecodeError) as e:
+        Pdu.decode(bytes(buf))
+    assert e.value.status_code() == StatusCode.INTERNAL_ERROR
+
+
+def test_mixed_address_list_rejected():
+    from ipaddress import IPv6Address
+
+    msg = AddressMsg(
+        msg_id=1,
+        addr_list=[A("10.0.0.1"), IPv6Address("2001:db8::1")],
+    )
+    with pytest.raises(ValueError):
+        Pdu(A("1.1.1.1"), 0, [msg]).encode()
+
+
+def test_hello_transport_cross_checks():
+    # hello.rs:266-280: targeted hello on multicast (and vice versa).
+    targeted = Pdu(
+        A("1.1.1.1"),
+        0,
+        [HelloMsg(msg_id=1, flags=HELLO_TARGETED)],
+    ).encode()
+    with pytest.raises(DecodeError) as e:
+        Pdu.decode(targeted, multicast=True)
+    assert e.value.kind == "McastTHello"
+    link = Pdu(A("1.1.1.1"), 0, [HelloMsg(msg_id=1)]).encode()
+    with pytest.raises(DecodeError) as e:
+        Pdu.decode(link, multicast=False)
+    assert e.value.kind == "UcastLHello"

@@ -1,0 +1,67 @@
+"""LDP stepwise conformance: the reference's recorded corpus replayed
+through the live LdpEngine + real RFC 5036 wire codec
+(tools/stepwise_ldp.py).
+
+All 70 step-case directories pass — discovery (link + targeted hellos,
+hold timeouts, hello-accept), session establishment (TCP accept/connect
+roles, init/keepalive FSM, backoff), the full label distribution set
+(mapping/request/withdraw/release incl. typed-wildcard FECs, No-Route and
+Loop-Detected notifications, decode-error notifications), address
+messages, config changes (instance/interface/targeted enable-disable) and
+the clear-peer / clear-hello-adjacency RPCs — asserting the protocol,
+ibus (label FIB), northbound-notif, and northbound-state planes.  Both
+topology snapshots additionally converge to bit-identical operational
+trees on every router.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from holo_tpu.tools.stepwise_ldp import (
+    LDP_DIR,
+    case_map,
+    run_all,
+    run_case,
+    run_topology,
+)
+
+pytestmark = pytest.mark.skipif(
+    not LDP_DIR.exists(), reason="reference corpus not present"
+)
+
+KNOWN_PASS = [
+    "message-label-mapping1",
+    "message-addr2",
+    "tcp-accept1",
+    "nb-config-tnbr1",
+    "timeout-nbr1",
+    "message-decode-error1",
+]
+PASS_FLOOR = 70
+
+
+def test_known_cases_pass():
+    cm = case_map()
+    for case in KNOWN_PASS:
+        topo, rt = cm[case]
+        status, detail = run_case(LDP_DIR / case, topo, rt)
+        assert status == "pass", f"{case}: {detail}"
+
+
+def test_stepwise_sweep_floor():
+    res = run_all()
+    passed = sorted(c for c, (s, _) in res.items() if s == "pass")
+    failed = {c: d for c, (s, d) in res.items() if s != "pass"}
+    assert len(passed) >= PASS_FLOOR, (
+        f"only {len(passed)} LDP stepwise cases pass (floor {PASS_FLOOR}); "
+        f"failures: { {c: d[:120] for c, d in list(failed.items())[:5]} }"
+    )
+
+
+@pytest.mark.parametrize("topo", ["topo1-1", "topo2-1"])
+def test_topology_convergence(topo):
+    res = run_topology(topo)
+    assert res, f"no routers found for {topo}"
+    bad = {c: d for c, (s, d) in res.items() if s != "pass"}
+    assert not bad, f"{topo}: {bad}"
