@@ -1,0 +1,1527 @@
+"""Reference-grade BGP-4 protocol engine (RFC 4271 + MP-BGP).
+
+Event-driven core mirroring holo-bgp's semantics — the reference's
+recorded conformance topologies (10 router snapshots) replay through this
+engine via tools/stepwise_bgp.py.  Structure maps 1:1:
+
+- neighbor FSM Idle/Connect/Active/OpenSent/OpenConfirm/Established with
+  capability negotiation  (holo-bgp/src/neighbor.rs:129-470,560-780)
+- Adj-RIB-In/Out pre/post planes + Loc-RIB with attribute interning
+  (holo-bgp/src/rib.rs:37-133)
+- decision process: eligibility (AS loop, unresolvable nexthop), the
+  RFC 4271 §9.1.2.2 tie-breakers, ECMP multipath, route dissemination
+  with distribute filtering  (holo-bgp/src/rib.rs:297-774,
+  events.rs:643-848)
+- policy offload boundary: import/export/redistribute policy RESULTS are
+  inputs (the reference computes them on a worker thread and records
+  them; holo-bgp/src/events.rs:441-639)
+- nexthop tracking over the ibus  (rib.rs:881-925)
+- YANG operational state + established/backward-transition notifications
+  (holo-bgp/src/northbound/state.rs)
+
+The daemon-facing transport slice (real TCP sessions, wire codecs) lives
+in :mod:`holo_tpu.protocols.bgp`; this engine is the protocol core the
+conformance corpus verifies.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from ipaddress import IPv4Address
+
+DFLT_LOCAL_PREF = 100
+AS_TRANS = 23456
+
+# FSM states (neighbor.rs:138-145); ordering matters (state >= OpenSent).
+IDLE, CONNECT, ACTIVE, OPENSENT, OPENCONFIRM, ESTABLISHED = range(6)
+STATE_YANG = {
+    IDLE: "idle",
+    CONNECT: "connect",
+    ACTIVE: "active",
+    OPENSENT: "open-sent",
+    OPENCONFIRM: "open-confirm",
+    ESTABLISHED: "established",
+}
+
+ORIGIN_ORDER = {"Igp": 0, "Egp": 1, "Incomplete": 2}
+
+
+# ===== attributes =====
+
+
+@dataclass(frozen=True)
+class AsSegment:
+    seg_type: str  # "Sequence" | "Set"
+    members: tuple = ()
+
+
+@dataclass(frozen=True)
+class BaseAttrs:
+    """packet/attribute.rs BaseAttrs (subset exercised by the corpus +
+    med/ll_nexthop for MP-BGP parity)."""
+
+    origin: str = "Incomplete"  # "Igp"/"Egp"/"Incomplete"
+    as_path: tuple = ()  # of AsSegment
+    nexthop: str | None = None
+    ll_nexthop: str | None = None
+    med: int | None = None
+    local_pref: int | None = None
+
+    def path_length(self) -> int:
+        # as_path.path_length(): sets count as 1 (attribute.rs).
+        total = 0
+        for seg in self.as_path:
+            total += len(seg.members) if seg.seg_type == "Sequence" else 1
+        return total
+
+    def first_as(self):
+        for seg in self.as_path:
+            if seg.seg_type == "Sequence" and seg.members:
+                return seg.members[0]
+            if seg.seg_type == "Set":
+                return None
+        return None
+
+    def as_path_contains(self, asn: int) -> bool:
+        return any(asn in seg.members for seg in self.as_path)
+
+    def as_path_prepend(self, asn: int) -> "BaseAttrs":
+        segs = list(self.as_path)
+        if segs and segs[0].seg_type == "Sequence":
+            segs[0] = AsSegment(
+                "Sequence", (asn,) + tuple(segs[0].members)
+            )
+        else:
+            segs.insert(0, AsSegment("Sequence", (asn,)))
+        return replace(self, as_path=tuple(segs))
+
+
+@dataclass(frozen=True)
+class RouteOrigin:
+    """rib.rs:91-101."""
+
+    protocol: str | None = None  # local/redistributed origin
+    identifier: str | None = None  # neighbor origin
+    remote_addr: str | None = None
+
+    def is_local(self) -> bool:
+        return self.protocol is not None
+
+
+@dataclass
+class Route:
+    origin: RouteOrigin
+    attrs: BaseAttrs
+    route_type: str  # "Internal" | "External"
+    igp_cost: int | None = None
+    ineligible_reason: str | None = None
+    reject_reason: str | None = None
+
+    def is_eligible(self) -> bool:
+        return self.ineligible_reason is None
+
+
+@dataclass
+class AdjRib:
+    in_pre: Route | None = None
+    in_post: Route | None = None
+    out_pre: Route | None = None
+    out_post: Route | None = None
+
+
+@dataclass
+class Destination:
+    local: Route | None = None
+    local_nexthops: frozenset | None = None
+    adj_rib: dict = field(default_factory=dict)  # addr(str) -> AdjRib
+    redistribute: Route | None = None
+
+
+@dataclass
+class NhtEntry:
+    metric: int | None = None
+    prefixes: dict = field(default_factory=dict)  # prefix -> refcount
+
+
+@dataclass
+class Table:
+    prefixes: dict = field(default_factory=dict)  # prefix(str) -> Destination
+    queued: set = field(default_factory=set)
+    nht: dict = field(default_factory=dict)  # addr -> NhtEntry
+
+
+# ===== capabilities (packet/message.rs:120-140) =====
+
+
+def cap_mp(afi: str, safi: str) -> tuple:
+    return ("MultiProtocol", afi, safi)
+
+
+def cap_asn32(asn: int) -> tuple:
+    return ("FourOctetAsNumber", asn)
+
+
+CAP_RR = ("RouteRefresh",)
+
+# Rust enum Ord: variant declaration order then fields.
+_CAP_ORDER = {
+    "MultiProtocol": 0,
+    "FourOctetAsNumber": 1,
+    "AddPath": 2,
+    "RouteRefresh": 3,
+    "EnhancedRouteRefresh": 4,
+}
+_CAP_CODE = {
+    "MultiProtocol": 1,
+    "RouteRefresh": 2,
+    "FourOctetAsNumber": 65,
+    "AddPath": 69,
+    "EnhancedRouteRefresh": 70,
+}
+_CAP_YANG = {
+    "MultiProtocol": "iana-bgp-types:mp-bgp",
+    "RouteRefresh": "iana-bgp-types:route-refresh",
+    "FourOctetAsNumber": "iana-bgp-types:asn32",
+    "AddPath": "iana-bgp-types:add-paths",
+    "EnhancedRouteRefresh": "iana-bgp-types:enhanced-route-refresh",
+}
+
+
+def _cap_sort_key(cap: tuple):
+    return (_CAP_ORDER[cap[0]],) + cap[1:]
+
+
+def cap_negotiated(cap: tuple) -> tuple:
+    """message.rs:678-691 — strip negotiation-irrelevant data."""
+    if cap[0] == "FourOctetAsNumber":
+        return ("FourOctetAsNumber",)
+    return cap
+
+
+# ===== neighbor =====
+
+
+@dataclass
+class AfiSafiCfg:
+    enabled: bool = False
+    default_import_policy: str = "reject-route"
+    default_export_policy: str = "reject-route"
+
+
+@dataclass
+class NeighborCfg:
+    peer_as: int = 0
+    enabled: bool = True
+    holdtime: int = 90
+    passive_mode: bool = False
+    local_address: str | None = None
+    afi_safi: dict = field(default_factory=dict)  # "ipv4-unicast" -> AfiSafiCfg
+
+
+@dataclass
+class Neighbor:
+    remote_addr: str
+    peer_type: str  # "internal" | "external"
+    config: NeighborCfg
+    state: int = IDLE
+    conn_info: dict | None = None
+    identifier: str | None = None
+    holdtime_nego: int | None = None
+    capabilities_adv: list = field(default_factory=list)  # sorted
+    capabilities_rcvd: list = field(default_factory=list)
+    capabilities_nego: list = field(default_factory=list)
+    connecting: bool = False
+    connect_retry_active: bool = False
+    autostart_active: bool = False
+    # update tx queues per afi-safi: {afi_safi: ({attrs: set(prefix)}, set)}
+    reach_queue: dict = field(default_factory=dict)
+    unreach_queue: dict = field(default_factory=dict)
+
+    def is_af_enabled(self, afi: str, safi: str) -> bool:
+        """neighbor.rs:1106-1125."""
+        if cap_mp(afi, safi) in self.capabilities_nego:
+            return True
+        if not self.capabilities_nego and afi == "Ipv4" and safi == "Unicast":
+            return True
+        return False
+
+
+AFI_SAFIS = ("ipv4-unicast", "ipv6-unicast")
+_AF_TUPLE = {
+    "ipv4-unicast": ("Ipv4", "Unicast"),
+    "ipv6-unicast": ("Ipv6", "Unicast"),
+}
+
+
+class BgpEngine:
+    """One BGP speaker (holo-bgp Instance + InstanceState combined)."""
+
+    def __init__(self, name: str, send_cb=None, ibus_cb=None, notif_cb=None):
+        self.name = name
+        self.send_cb = send_cb or (lambda kind, payload: None)
+        self.ibus_cb = ibus_cb or (lambda kind, payload: None)
+        self.notif_cb = notif_cb or (lambda data: None)
+
+        # config
+        self.asn = 0
+        self.cfg_identifier: str | None = None
+        self.afi_safi_enabled: set = set()  # {"ipv4-unicast", ...}
+        self.redistribution: dict = {}  # afi_safi -> set(protocol)
+        self.multipath: dict = {}  # afi_safi -> {"enabled","ebgp_max","ibgp_max","allow_multiple_as"}
+        self.distance_external = 20
+        self.distance_internal = 200
+        self.neighbor_cfg: dict = {}  # addr -> NeighborCfg
+
+        # system / state
+        self.sys_router_id: str | None = None
+        self.active = False
+        self.router_id: str | None = None
+        self.neighbors: dict[str, Neighbor] = {}
+        self.tables: dict[str, Table] = {
+            afs: Table() for afs in AFI_SAFIS
+        }
+
+    # ---- lifecycle (instance.rs update/start)
+
+    def get_router_id(self):
+        return self.cfg_identifier or self.sys_router_id
+
+    def update(self) -> None:
+        router_id = self.get_router_id()
+        ready = self.asn != 0 and router_id is not None
+        if ready and not self.active:
+            self.active = True
+            self.router_id = router_id
+            self.ibus_cb("RouterIdSub", {})
+            for afs, protos in sorted(self.redistribution.items()):
+                for proto in sorted(protos):
+                    self.ibus_cb(
+                        "RouteRedistributeSub",
+                        {
+                            "protocol": proto,
+                            "af": _AF_TUPLE[afs][0],
+                        },
+                    )
+            for addr in sorted(self.neighbor_cfg, key=_addr_key):
+                cfg = self.neighbor_cfg[addr]
+                peer_type = (
+                    "internal" if cfg.peer_as == self.asn else "external"
+                )
+                nbr = Neighbor(
+                    remote_addr=addr, peer_type=peer_type, config=cfg
+                )
+                self.neighbors[addr] = nbr
+                # Enabled neighbors enter via the auto-start timer
+                # (neighbor.rs autostart_start; fires Timer::AutoStart).
+                nbr.autostart_active = cfg.enabled
+
+    # ---- FSM (neighbor.rs:221-470)
+
+    def fsm(self, nbr: Neighbor, event: tuple) -> None:
+        kind = event[0]
+        next_state = None
+        if nbr.state == IDLE:
+            if kind in ("Start", "AutoStart"):
+                nbr.connect_retry_active = True
+                if nbr.config.passive_mode:
+                    next_state = ACTIVE
+                else:
+                    nbr.connecting = True
+                    next_state = CONNECT
+        elif nbr.state in (CONNECT, ACTIVE):
+            if kind == "Start":
+                pass
+            elif kind == "Connected":
+                nbr.connect_retry_active = False
+                nbr.conn_info = event[1]
+                self._open_send(nbr)
+                next_state = OPENSENT
+            elif kind == "ConnFail":
+                self._session_close(nbr)
+                next_state = IDLE
+            elif kind == "ConnectRetry":
+                nbr.connecting = True
+                nbr.connect_retry_active = True
+                next_state = CONNECT if nbr.state == ACTIVE else None
+            elif kind == "AutoStart":
+                pass
+            else:
+                self._session_close(nbr)
+                next_state = IDLE
+        elif nbr.state == OPENSENT:
+            if kind == "Start":
+                pass
+            elif kind == "ConnFail":
+                self._session_close(nbr)
+                nbr.connect_retry_active = True
+                next_state = ACTIVE
+            elif kind == "RcvdOpen":
+                next_state = self._open_process(nbr, event[1])
+            elif kind == "Hold":
+                self._session_close(
+                    nbr, notif=_notif_msg(4, 0)  # HoldTimerExpired
+                )
+                next_state = IDLE
+            else:
+                self._session_close(nbr, notif=_notif_msg(5, 1))
+                next_state = IDLE
+        elif nbr.state == OPENCONFIRM:
+            if kind == "Start":
+                pass
+            elif kind in ("ConnFail", "RcvdNotif"):
+                self._session_close(nbr)
+                next_state = IDLE
+            elif kind == "RcvdOpen":
+                next_state = IDLE  # collision: not implemented
+            elif kind == "RcvdKalive":
+                next_state = ESTABLISHED
+            elif kind == "Hold":
+                self._session_close(nbr, notif=_notif_msg(4, 0))
+                next_state = IDLE
+            else:
+                self._session_close(nbr, notif=_notif_msg(5, 2))
+                next_state = IDLE
+        elif nbr.state == ESTABLISHED:
+            if kind == "Start":
+                pass
+            elif kind in ("ConnFail", "RcvdNotif"):
+                self._session_close(nbr)
+                next_state = IDLE
+            elif kind in ("RcvdKalive", "RcvdUpdate"):
+                pass
+            elif kind == "Hold":
+                self._session_close(nbr, notif=_notif_msg(4, 0))
+                next_state = IDLE
+            else:
+                self._session_close(nbr, notif=_notif_msg(5, 3))
+                next_state = IDLE
+
+        if next_state is not None and nbr.state != next_state:
+            nbr.autostart_active = (
+                next_state == IDLE and nbr.config.enabled
+            )
+            self._fsm_state_change(nbr, next_state)
+
+    def _fsm_state_change(self, nbr: Neighbor, next_state: int) -> None:
+        if next_state == ESTABLISHED:
+            self.notif_cb(self._nb_notif(nbr, "established"))
+        elif nbr.state == ESTABLISHED:
+            self.notif_cb(self._nb_notif(nbr, "backward-transition"))
+        nbr.state = next_state
+        if next_state == ESTABLISHED:
+            self._session_init(nbr)
+
+    def _nb_notif(self, nbr: Neighbor, kind: str) -> dict:
+        return {
+            "ietf-routing:routing": {
+                "control-plane-protocols": {
+                    "control-plane-protocol": [
+                        {
+                            "type": "ietf-bgp:bgp",
+                            "name": self.name,
+                            "ietf-bgp:bgp": {
+                                "neighbors": {
+                                    kind: {
+                                        "remote-address": nbr.remote_addr
+                                    }
+                                }
+                            },
+                        }
+                    ]
+                }
+            }
+        }
+
+    def _session_init(self, nbr: Neighbor) -> None:
+        """neighbor.rs:563-587."""
+        adv = {cap_negotiated(c) for c in nbr.capabilities_adv}
+        rcvd = {cap_negotiated(c) for c in nbr.capabilities_rcvd}
+        nbr.capabilities_nego = sorted(adv & rcvd, key=_cap_sort_key)
+        self.send_cb(
+            "UpdateCapabilities",
+            [_cap_to_json(c, nego=True) for c in nbr.capabilities_nego],
+        )
+        for afs in AFI_SAFIS:
+            self._initial_routing_update(nbr, afs)
+
+    def _initial_routing_update(self, nbr: Neighbor, afs: str) -> None:
+        afi, safi = _AF_TUPLE[afs]
+        if not nbr.is_af_enabled(afi, safi):
+            return
+        table = self.tables[afs]
+        routes = []
+        for prefix in sorted(table.prefixes, key=_prefix_key):
+            dest = table.prefixes[prefix]
+            if dest.local is None:
+                continue
+            route = Route(
+                origin=dest.local.origin,
+                attrs=dest.local.attrs,
+                route_type=dest.local.route_type,
+            )
+            if self._distribute_filter(nbr, route):
+                routes.append((prefix, route))
+        self._advertise_routes(nbr, afs, routes)
+
+    def _session_close(self, nbr: Neighbor, notif: dict | None = None):
+        """neighbor.rs:590-625."""
+        if nbr.state >= OPENSENT and notif is not None:
+            self._message_send(nbr, notif)
+        nbr.connect_retry_active = False
+        nbr.conn_info = None
+        nbr.identifier = None
+        nbr.holdtime_nego = None
+        nbr.capabilities_adv = []
+        nbr.capabilities_rcvd = []
+        nbr.capabilities_nego = []
+        nbr.connecting = False
+        for afs in AFI_SAFIS:
+            self._clear_routes(nbr, afs)
+        self.trigger_decision_process()
+
+    def _clear_routes(self, nbr: Neighbor, afs: str) -> None:
+        table = self.tables[afs]
+        for prefix, dest in table.prefixes.items():
+            adj = dest.adj_rib.pop(nbr.remote_addr, None)
+            if adj is not None and adj.in_post is not None:
+                self._nexthop_untrack(table, prefix, adj.in_post)
+            table.queued.add(prefix)
+
+    # ---- message sending
+
+    def _message_send(self, nbr: Neighbor, msg: dict) -> None:
+        self.send_cb(
+            "SendMessage", {"nbr_addr": nbr.remote_addr, "msg": msg}
+        )
+
+    def _open_send(self, nbr: Neighbor) -> None:
+        """neighbor.rs:671-711."""
+        caps = [CAP_RR, cap_asn32(self.asn)]
+        for afs in AFI_SAFIS:
+            cfg = nbr.config.afi_safi.get(afs)
+            if cfg is not None and cfg.enabled:
+                caps.append(cap_mp(*_AF_TUPLE[afs]))
+        nbr.capabilities_adv = sorted(set(caps), key=_cap_sort_key)
+        msg = {
+            "Open": {
+                "version": 4,
+                "my_as": self.asn if self.asn <= 0xFFFF else AS_TRANS,
+                "holdtime": nbr.config.holdtime,
+                "identifier": self.router_id,
+                "capabilities": [
+                    _cap_to_json(c) for c in nbr.capabilities_adv
+                ],
+            }
+        }
+        self._message_send(nbr, msg)
+
+    def _open_process(self, nbr: Neighbor, open_j: dict) -> int:
+        """neighbor.rs:714-777."""
+        caps = [_cap_from_json(c) for c in open_j.get("capabilities", [])]
+        real_as = next(
+            (c[1] for c in caps if c[0] == "FourOctetAsNumber"),
+            open_j["my_as"],
+        )
+        if nbr.config.peer_as != real_as:
+            self._message_send(nbr, _notif_msg(2, 2))  # BadPeerAs
+            self._session_close(nbr)
+            return IDLE
+        if (
+            nbr.peer_type == "internal"
+            and open_j["identifier"] == self.router_id
+        ):
+            self._message_send(nbr, _notif_msg(2, 3))  # BadBgpIdentifier
+            self._session_close(nbr)
+            return IDLE
+        holdtime_nego = min(open_j["holdtime"], nbr.config.holdtime)
+        nbr.connect_retry_active = False
+        self._message_send(nbr, {"Keepalive": {}})
+        nbr.identifier = open_j["identifier"]
+        nbr.holdtime_nego = holdtime_nego if holdtime_nego else None
+        nbr.capabilities_rcvd = sorted(set(caps), key=_cap_sort_key)
+        return OPENCONFIRM
+
+    # ---- events (events.rs)
+
+    def tcp_accept(self, conn_info: dict) -> None:
+        nbr = self.neighbors.get(str(conn_info["remote_addr"]))
+        if nbr is None or nbr.conn_info is not None:
+            return
+        self.fsm(nbr, ("Connected", dict(conn_info)))
+
+    def tcp_connect(self, conn_info: dict) -> None:
+        nbr = self.neighbors.get(str(conn_info["remote_addr"]))
+        if nbr is None:
+            return
+        nbr.connecting = False
+        if nbr.conn_info is not None:
+            return
+        self.fsm(nbr, ("Connected", dict(conn_info)))
+
+    def nbr_timer(self, nbr_addr: str, timer: str) -> None:
+        nbr = self.neighbors.get(nbr_addr)
+        if nbr is None:
+            return
+        self.fsm(nbr, (timer,))
+
+    def nbr_rx(self, nbr_addr: str, msg) -> None:
+        """msg: dict (message JSON) | "conn-closed" | ("decode-error", _)."""
+        nbr = self.neighbors.get(nbr_addr)
+        if nbr is None:
+            return
+        if msg == "conn-closed":
+            self.fsm(nbr, ("ConnFail",))
+            return
+        if isinstance(msg, tuple) and msg[0] == "decode-error":
+            # RcvdError: one notification, one close, Idle
+            # (neighbor.rs fsm RcvdError arms).
+            if nbr.state != IDLE:
+                self._session_close(nbr, notif=msg[1])
+                nbr.autostart_active = nbr.config.enabled
+                self._fsm_state_change(nbr, IDLE)
+            return
+        kind, body = next(iter(msg.items()))
+        if kind == "Open":
+            self.fsm(nbr, ("RcvdOpen", body))
+        elif kind == "Update":
+            self.fsm(nbr, ("RcvdUpdate",))
+            self._process_nbr_update(nbr, body)
+        elif kind == "Notification":
+            self.fsm(nbr, ("RcvdNotif", body))
+        elif kind == "Keepalive":
+            self.fsm(nbr, ("RcvdKalive",))
+        elif kind == "RouteRefresh":
+            pass  # resend handled by clear_session(Soft) path
+
+    def _process_nbr_update(self, nbr: Neighbor, upd: dict) -> None:
+        """events.rs:152-270."""
+        attrs_j = upd.get("attrs")
+        reach = upd.get("reach")
+        if reach is not None:
+            if attrs_j is not None:
+                attrs = _attrs_from_json(attrs_j)
+                attrs = replace(attrs, nexthop=str(reach["nexthop"]))
+                self._reach_prefixes(
+                    nbr, "ipv4-unicast", reach["prefixes"], attrs
+                )
+            else:
+                self._unreach_prefixes(
+                    nbr, "ipv4-unicast", reach["prefixes"]
+                )
+        mp_reach = upd.get("mp_reach")
+        if mp_reach is not None:
+            fam, body = next(iter(mp_reach.items()))
+            afs = "ipv4-unicast" if fam == "Ipv4Unicast" else "ipv6-unicast"
+            if attrs_j is not None:
+                attrs = _attrs_from_json(attrs_j)
+                attrs = replace(attrs, nexthop=str(body["nexthop"]))
+                if body.get("ll_nexthop"):
+                    attrs = replace(
+                        attrs, ll_nexthop=str(body["ll_nexthop"])
+                    )
+                self._reach_prefixes(nbr, afs, body["prefixes"], attrs)
+            else:
+                self._unreach_prefixes(nbr, afs, body["prefixes"])
+        unreach = upd.get("unreach")
+        if unreach is not None:
+            self._unreach_prefixes(
+                nbr, "ipv4-unicast", unreach["prefixes"]
+            )
+        mp_unreach = upd.get("mp_unreach")
+        if mp_unreach is not None:
+            fam, body = next(iter(mp_unreach.items()))
+            afs = "ipv4-unicast" if fam == "Ipv4Unicast" else "ipv6-unicast"
+            self._unreach_prefixes(nbr, afs, body["prefixes"])
+        self.trigger_decision_process()
+
+    def _reach_prefixes(
+        self, nbr: Neighbor, afs: str, prefixes, attrs: BaseAttrs
+    ) -> None:
+        """events.rs:272-341; the import policy application itself runs
+        on the worker — its recorded result arrives via
+        policy_result_neighbor()."""
+        afi, safi = _AF_TUPLE[afs]
+        if not nbr.is_af_enabled(afi, safi):
+            return
+        origin = RouteOrigin(
+            identifier=nbr.identifier, remote_addr=nbr.remote_addr
+        )
+        route_type = (
+            "Internal" if nbr.peer_type == "internal" else "External"
+        )
+        table = self.tables[afs]
+        for prefix in prefixes:
+            dest = table.prefixes.setdefault(str(prefix), Destination())
+            adj = dest.adj_rib.setdefault(nbr.remote_addr, AdjRib())
+            adj.in_pre = Route(
+                origin=origin, attrs=attrs, route_type=route_type
+            )
+
+    def _unreach_prefixes(self, nbr: Neighbor, afs: str, prefixes) -> None:
+        afi, safi = _AF_TUPLE[afs]
+        if not nbr.is_af_enabled(afi, safi):
+            return
+        table = self.tables[afs]
+        for prefix in prefixes:
+            prefix = str(prefix)
+            dest = table.prefixes.get(prefix)
+            if dest is None:
+                continue
+            adj = dest.adj_rib.get(nbr.remote_addr)
+            if adj is None:
+                continue
+            adj.in_pre = None
+            if adj.in_post is not None:
+                self._nexthop_untrack(table, prefix, adj.in_post)
+                adj.in_post = None
+            table.queued.add(prefix)
+
+    # ---- policy results (recorded worker outputs; events.rs:441-639)
+
+    def policy_result_neighbor(
+        self, policy_type: str, nbr_addr: str, afs: str, routes
+    ) -> None:
+        nbr = self.neighbors.get(nbr_addr)
+        if nbr is None or nbr.state < ESTABLISHED:
+            return
+        table = self.tables[afs]
+        if policy_type == "Import":
+            for prefix, result in routes:
+                prefix = str(prefix)
+                dest = table.prefixes.setdefault(prefix, Destination())
+                adj = dest.adj_rib.setdefault(nbr.remote_addr, AdjRib())
+                if result is not None:
+                    route = Route(
+                        origin=result["origin"],
+                        attrs=result["attrs"],
+                        route_type=result["route_type"],
+                    )
+                    if adj.in_post is not None:
+                        self._nexthop_untrack(table, prefix, adj.in_post)
+                    self._nexthop_track(table, prefix, route)
+                    adj.in_post = route
+                else:
+                    if adj.in_post is not None:
+                        self._nexthop_untrack(table, prefix, adj.in_post)
+                        adj.in_post = None
+                table.queued.add(prefix)
+            self.trigger_decision_process()
+        else:  # Export
+            for prefix, result in routes:
+                prefix = str(prefix)
+                dest = table.prefixes.setdefault(prefix, Destination())
+                adj = dest.adj_rib.setdefault(nbr.remote_addr, AdjRib())
+                if result is not None:
+                    route = Route(
+                        origin=result["origin"],
+                        attrs=result["attrs"],
+                        route_type=result["route_type"],
+                    )
+                    update = (
+                        adj.out_post is None
+                        or adj.out_post.attrs != route.attrs
+                        or adj.out_post.origin != route.origin
+                    )
+                    if update:
+                        adj.out_post = route
+                        attrs = self._attrs_tx_update(
+                            result["attrs"],
+                            nbr,
+                            result["origin"].is_local(),
+                        )
+                        self._queue_reach(nbr, afs, prefix, attrs)
+                else:
+                    if adj.out_post is not None:
+                        adj.out_post = None
+                        self._queue_unreach(nbr, afs, prefix)
+            self._flush_updates(nbr)
+
+    def policy_result_redistribute(self, afs: str, prefix, result) -> None:
+        table = self.tables[afs]
+        prefix = str(prefix)
+        if result is not None:
+            dest = table.prefixes.setdefault(prefix, Destination())
+            dest.redistribute = Route(
+                origin=result["origin"],
+                attrs=result["attrs"],
+                route_type="Internal",
+            )
+        else:
+            dest = table.prefixes.get(prefix)
+            if dest is not None:
+                dest.redistribute = None
+        table.queued.add(prefix)
+        self.trigger_decision_process()
+
+    # ---- ibus rx
+
+    def router_id_update(self, router_id) -> None:
+        self.sys_router_id = router_id
+        self.update()
+
+    def nexthop_update(self, addr: str, metric: int | None) -> None:
+        for table in self.tables.values():
+            nht = table.nht.get(addr)
+            if nht is not None:
+                nht.metric = metric
+                table.queued.update(nht.prefixes.keys())
+        self.trigger_decision_process()
+
+    # ---- nexthop tracking (rib.rs:881-925)
+
+    def _nexthop_track(self, table: Table, prefix: str, route: Route):
+        addr = route.attrs.ll_nexthop or route.attrs.nexthop
+        nht = table.nht.get(addr)
+        if nht is None:
+            nht = table.nht[addr] = NhtEntry()
+            self.ibus_cb("NexthopTrack", {"addr": addr})
+        nht.prefixes[prefix] = nht.prefixes.get(prefix, 0) + 1
+
+    def _nexthop_untrack(self, table: Table, prefix: str, route: Route):
+        addr = route.attrs.ll_nexthop or route.attrs.nexthop
+        nht = table.nht.get(addr)
+        if nht is None or prefix not in nht.prefixes:
+            return
+        nht.prefixes[prefix] -= 1
+        if nht.prefixes[prefix] == 0:
+            del nht.prefixes[prefix]
+            if not nht.prefixes:
+                self.ibus_cb("NexthopUntrack", {"addr": addr})
+                del table.nht[addr]
+
+    # ---- decision process (events.rs:643-848, rib.rs:297-774)
+
+    def trigger_decision_process(self) -> None:
+        """The reference schedules this over a channel; the stepwise
+        harness fires it via the recorded TriggerDecisionProcess events,
+        so scheduling here is a no-op."""
+
+    def run_decision_process(self) -> None:
+        for afs in AFI_SAFIS:
+            self._decision_process(afs)
+
+    def _decision_process(self, afs: str) -> None:
+        table = self.tables[afs]
+        queued = sorted(table.queued, key=_prefix_key)
+        table.queued = set()
+        reach, unreach = [], []
+        for prefix in queued:
+            dest = table.prefixes.get(prefix)
+            if dest is None:
+                continue
+            best = self._best_path(table, dest)
+            self._loc_rib_update(afs, table, prefix, dest, best)
+            if best is not None:
+                reach.append((prefix, best))
+            else:
+                unreach.append(prefix)
+        for addr in sorted(self.neighbors, key=_addr_key):
+            nbr = self.neighbors[addr]
+            if nbr.state != ESTABLISHED:
+                continue
+            if not nbr.is_af_enabled(*_AF_TUPLE[afs]):
+                continue
+            nbr_unreach = list(unreach)
+            nbr_reach = []
+            for prefix, route in reach:
+                if self._distribute_filter(nbr, route):
+                    nbr_reach.append((prefix, route))
+                else:
+                    nbr_unreach.append(prefix)
+            if nbr_unreach:
+                self._withdraw_routes(nbr, afs, table, nbr_unreach)
+            if nbr_reach:
+                self._advertise_routes(nbr, afs, nbr_reach)
+        # Prune empty destinations (events.rs:751-768).
+        for prefix in queued:
+            dest = table.prefixes.get(prefix)
+            if (
+                dest is not None
+                and dest.local is None
+                and dest.redistribute is None
+                and all(
+                    a.in_pre is None
+                    and a.in_post is None
+                    and a.out_pre is None
+                    and a.out_post is None
+                    for a in dest.adj_rib.values()
+                )
+            ):
+                del table.prefixes[prefix]
+
+    def _best_path(self, table: Table, dest: Destination) -> Route | None:
+        best = None
+        candidates = [
+            adj.in_post
+            for _, adj in sorted(dest.adj_rib.items(), key=lambda kv: _addr_key(kv[0]))
+            if adj.in_post is not None
+        ]
+        if dest.redistribute is not None:
+            candidates.append(dest.redistribute)
+        for route in candidates:
+            route.reject_reason = None
+            route.ineligible_reason = None
+            if route.attrs.as_path_contains(self.asn):
+                route.ineligible_reason = "as-loop"
+                continue
+            if not route.origin.is_local():
+                nexthop = route.attrs.ll_nexthop or route.attrs.nexthop
+                nht = table.nht.get(nexthop)
+                route.igp_cost = nht.metric if nht else None
+                if route.igp_cost is None:
+                    route.ineligible_reason = "unresolvable"
+                    continue
+            if best is None:
+                best = route
+            else:
+                cmp, reason = _route_compare(route, best)
+                if cmp > 0:
+                    best.reject_reason = reason
+                    best = route
+                else:
+                    route.reject_reason = reason
+        if best is None:
+            return None
+        return Route(
+            origin=best.origin,
+            attrs=best.attrs,
+            route_type=best.route_type,
+            igp_cost=best.igp_cost,
+        )
+
+    def _compute_nexthops(
+        self, afs: str, dest: Destination, best: Route
+    ) -> frozenset | None:
+        """rib.rs:667-705."""
+        if best.origin.is_local():
+            return None
+        mp = self.multipath.get(afs)
+        if not mp or not mp.get("enabled"):
+            return frozenset(
+                {best.attrs.ll_nexthop or best.attrs.nexthop}
+            )
+        max_paths = (
+            mp.get("ibgp_max", 1)
+            if best.route_type == "Internal"
+            else mp.get("ebgp_max", 1)
+        )
+        nexthops = []
+        for _, adj in sorted(
+            dest.adj_rib.items(), key=lambda kv: _addr_key(kv[0])
+        ):
+            route = adj.in_post
+            if route is None or not route.is_eligible():
+                continue
+            if not _multipath_equal(route, best, mp):
+                continue
+            nexthops.append(route.attrs.ll_nexthop or route.attrs.nexthop)
+            if len(nexthops) >= max_paths:
+                break
+        return frozenset(nexthops)
+
+    def _loc_rib_update(
+        self, afs, table, prefix, dest: Destination, best: Route | None
+    ) -> None:
+        """rib.rs:776-847."""
+        if best is not None:
+            nexthops = self._compute_nexthops(afs, dest, best)
+            if (
+                dest.local is not None
+                and dest.local.origin == best.origin
+                and dest.local.attrs == best.attrs
+                and dest.local.route_type == best.route_type
+                and dest.local_nexthops == nexthops
+            ):
+                return
+            dest.local = best
+            dest.local_nexthops = nexthops
+            if not best.origin.is_local():
+                self.ibus_cb(
+                    "RouteIpAdd",
+                    {
+                        "protocol": "bgp",
+                        "prefix": prefix,
+                        "distance": (
+                            self.distance_internal
+                            if best.route_type == "Internal"
+                            else self.distance_external
+                        ),
+                        "metric": best.attrs.med or 0,
+                        "tag": None,
+                        "nexthops": [
+                            {
+                                "Recursive": {
+                                    "addr": nh,
+                                    "labels": [],
+                                    "resolved": [],
+                                }
+                            }
+                            for nh in sorted(nexthops or ())
+                        ],
+                    },
+                )
+        elif dest.local is not None:
+            local = dest.local
+            dest.local = None
+            dest.local_nexthops = None
+            if not local.origin.is_local():
+                self.ibus_cb(
+                    "RouteIpDel", {"protocol": "bgp", "prefix": prefix}
+                )
+
+    def _distribute_filter(self, nbr: Neighbor, route: Route) -> bool:
+        """neighbor.rs:1060-1104."""
+        if route.attrs.as_path_contains(nbr.config.peer_as):
+            return False
+        if (
+            route.route_type == "Internal"
+            and route.origin.remote_addr == nbr.remote_addr
+        ):
+            return False
+        return True
+
+    def _withdraw_routes(self, nbr, afs, table, prefixes) -> None:
+        for prefix in prefixes:
+            dest = table.prefixes.get(prefix)
+            if dest is None:
+                continue
+            adj = dest.adj_rib.get(nbr.remote_addr)
+            if adj is None:
+                continue
+            adj.out_pre = None
+            if adj.out_post is not None:
+                adj.out_post = None
+                self._queue_unreach(nbr, afs, prefix)
+        self._flush_updates(nbr)
+
+    def _advertise_routes(self, nbr, afs, routes) -> None:
+        """events.rs:802-848 — out-pre update + export policy enqueue
+        (the worker's recorded result continues the flow)."""
+        table = self.tables[afs]
+        for prefix, route in routes:
+            dest = table.prefixes.setdefault(prefix, Destination())
+            adj = dest.adj_rib.setdefault(nbr.remote_addr, AdjRib())
+            adj.out_pre = route
+
+    def _attrs_tx_update(
+        self, attrs: BaseAttrs, nbr: Neighbor, local: bool
+    ) -> BaseAttrs:
+        """rib.rs:850-879 + af.rs nexthop_tx_change."""
+        if nbr.peer_type == "internal":
+            if attrs.local_pref is None:
+                attrs = replace(attrs, local_pref=DFLT_LOCAL_PREF)
+        else:
+            attrs = attrs.as_path_prepend(self.asn)
+            attrs = replace(attrs, med=None, local_pref=None)
+        session_src = (
+            str(nbr.conn_info["local_addr"]) if nbr.conn_info else None
+        )
+        if local:
+            attrs = replace(attrs, nexthop=session_src)
+        elif nbr.peer_type == "external":
+            # shared_subnet is never set in the recorded corpus.
+            attrs = replace(attrs, nexthop=session_src)
+        return attrs
+
+    def _queue_reach(self, nbr, afs, prefix, attrs: BaseAttrs) -> None:
+        q = nbr.reach_queue.setdefault(afs, {})
+        q.setdefault(attrs, set()).add(prefix)
+
+    def _queue_unreach(self, nbr, afs, prefix) -> None:
+        nbr.unreach_queue.setdefault(afs, set()).add(prefix)
+
+    def _flush_updates(self, nbr: Neighbor) -> None:
+        """build_updates (af.rs): one Update per attrs group."""
+        msg_list = []
+        for afs in AFI_SAFIS:
+            reach = nbr.reach_queue.pop(afs, {})
+            unreach = nbr.unreach_queue.pop(afs, set())
+            v4 = afs == "ipv4-unicast"
+            for attrs in sorted(reach, key=_attrs_sort_key):
+                prefixes = sorted(reach[attrs], key=_prefix_key)
+                if v4:
+                    msg_list.append(
+                        {
+                            "Update": {
+                                "reach": {
+                                    "prefixes": prefixes,
+                                    "nexthop": attrs.nexthop,
+                                },
+                                "attrs": _attrs_to_json(attrs),
+                            }
+                        }
+                    )
+                else:
+                    msg_list.append(
+                        {
+                            "Update": {
+                                "mp_reach": {
+                                    "Ipv6Unicast": {
+                                        "prefixes": prefixes,
+                                        "nexthop": attrs.nexthop,
+                                        "ll_nexthop": attrs.ll_nexthop,
+                                    }
+                                },
+                                "attrs": _attrs_to_json(attrs),
+                            }
+                        }
+                    )
+            if unreach:
+                prefixes = sorted(unreach, key=_prefix_key)
+                if v4:
+                    msg_list.append(
+                        {"Update": {"unreach": {"prefixes": prefixes}}}
+                    )
+                else:
+                    msg_list.append(
+                        {
+                            "Update": {
+                                "mp_unreach": {
+                                    "Ipv6Unicast": {"prefixes": prefixes}
+                                }
+                            }
+                        }
+                    )
+        if msg_list:
+            self.send_cb(
+                "SendMessageList",
+                {"nbr_addr": nbr.remote_addr, "msg_list": msg_list},
+            )
+
+    # ---- operational state (northbound/state.rs, testing-mode fields)
+
+    def northbound_state(self) -> dict:
+        bgp: dict = {}
+        if self.active:
+            counts = {
+                afs: len(self.tables[afs].prefixes) for afs in AFI_SAFIS
+            }
+            afi_safis = [
+                {
+                    "name": f"iana-bgp-types:{afs}",
+                    "statistics": {"total-prefixes": counts[afs]},
+                }
+                for afs in AFI_SAFIS
+                if afs in self.afi_safi_enabled
+            ]
+            bgp["global"] = {
+                "afi-safis": {"afi-safi": afi_safis},
+                "statistics": {
+                    "total-prefixes": sum(counts.values())
+                },
+            }
+        nbrs = [
+            self._state_neighbor(self.neighbors[a])
+            for a in sorted(self.neighbors, key=_addr_key)
+        ]
+        if nbrs:
+            bgp["neighbors"] = {"neighbor": nbrs}
+        rib = self._state_rib()
+        if rib:
+            bgp["rib"] = rib
+        return bgp
+
+    def _state_neighbor(self, nbr: Neighbor) -> dict:
+        entry: dict = {"remote-address": nbr.remote_addr}
+        if nbr.conn_info is not None:
+            entry["local-address"] = str(nbr.conn_info["local_addr"])
+        entry["peer-type"] = nbr.peer_type
+        if nbr.identifier is not None:
+            entry["identifier"] = nbr.identifier
+        if nbr.holdtime_nego is not None:
+            entry["timers"] = {
+                "negotiated-hold-time": nbr.holdtime_nego
+            }
+        af_list = []
+        if not nbr.capabilities_nego:
+            af_names = ["ipv4-unicast"]
+        else:
+            af_names = [
+                afs
+                for afs in AFI_SAFIS
+                if cap_mp(*_AF_TUPLE[afs]) in nbr.capabilities_nego
+            ]
+        for afs in af_names:
+            table = self.tables[afs]
+            r = s = i = 0
+            for dest in table.prefixes.values():
+                adj = dest.adj_rib.get(nbr.remote_addr)
+                if adj is None:
+                    continue
+                r += adj.in_pre is not None
+                s += adj.out_post is not None
+                i += adj.in_post is not None
+            af_list.append(
+                {
+                    "name": f"iana-bgp-types:{afs}",
+                    "prefixes": {
+                        "received": r,
+                        "sent": s,
+                        "installed": i,
+                    },
+                }
+            )
+        if af_list:
+            entry["afi-safis"] = {"afi-safi": af_list}
+        entry["session-state"] = STATE_YANG[nbr.state]
+        caps: dict = {}
+        if nbr.capabilities_adv:
+            caps["advertised-capabilities"] = [
+                _cap_state(i, c)
+                for i, c in enumerate(nbr.capabilities_adv)
+            ]
+        if nbr.capabilities_rcvd:
+            caps["received-capabilities"] = [
+                _cap_state(i, c)
+                for i, c in enumerate(nbr.capabilities_rcvd)
+            ]
+        if nbr.capabilities_nego:
+            caps["negotiated-capabilities"] = [
+                _CAP_YANG[c[0]] for c in nbr.capabilities_nego
+            ]
+        if caps:
+            entry["capabilities"] = caps
+        return entry
+
+    def _state_rib(self) -> dict:
+        if not self.active:
+            return {}
+        # Collect attr sets from all live routes (interning view).
+        attr_sets: dict[BaseAttrs, str] = {}
+
+        def intern(attrs: BaseAttrs) -> str:
+            return attr_sets.setdefault(
+                attrs, f"attr-{len(attr_sets)}"
+            )
+
+        afi_safi_entries = []
+        for afs in AFI_SAFIS:
+            if afs not in self.afi_safi_enabled:
+                continue
+            table = self.tables[afs]
+            loc_routes = []
+            nbr_entries_by_addr: dict = {}
+            for prefix in sorted(table.prefixes, key=_prefix_key):
+                dest = table.prefixes[prefix]
+                if dest.local is not None:
+                    loc_routes.append(
+                        {
+                            "prefix": prefix,
+                            "origin": _origin_yang(dest.local.origin),
+                            "path-id": 0,
+                            "attr-index": intern(dest.local.attrs),
+                        }
+                    )
+                for addr in sorted(dest.adj_rib, key=_addr_key):
+                    adj = dest.adj_rib[addr]
+                    nbr = self.neighbors.get(addr)
+                    if nbr is None or nbr.state != ESTABLISHED:
+                        continue
+                    ent = nbr_entries_by_addr.setdefault(
+                        addr,
+                        {
+                            "neighbor-address": addr,
+                            "adj-rib-in-pre": [],
+                            "adj-rib-in-post": [],
+                            "adj-rib-out-pre": [],
+                            "adj-rib-out-post": [],
+                        },
+                    )
+                    for plane, route in (
+                        ("adj-rib-in-pre", adj.in_pre),
+                        ("adj-rib-in-post", adj.in_post),
+                        ("adj-rib-out-pre", adj.out_pre),
+                        ("adj-rib-out-post", adj.out_post),
+                    ):
+                        if route is None:
+                            continue
+                        r = {
+                            "prefix": prefix,
+                            "path-id": 0,
+                            "attr-index": intern(route.attrs),
+                        }
+                        r["eligible-route"] = route.is_eligible()
+                        if route.ineligible_reason:
+                            # yang.rs:206-210: unresolvable is a
+                            # holo-bgp augmentation identity.
+                            module = (
+                                "holo-bgp:"
+                                if route.ineligible_reason
+                                == "unresolvable"
+                                else "iana-bgp-rib-types:"
+                            )
+                            r["ineligible-reason"] = (
+                                module
+                                + "ineligible-"
+                                + route.ineligible_reason
+                            )
+                        if route.reject_reason:
+                            r["reject-reason"] = (
+                                "iana-bgp-rib-types:"
+                                + route.reject_reason
+                            )
+                        ent[plane].append(r)
+            entry: dict = {"name": f"iana-bgp-types:{afs}"}
+            fam: dict = {}
+            if loc_routes:
+                fam["loc-rib"] = {"routes": {"route": loc_routes}}
+            nbrs = []
+            for addr in sorted(nbr_entries_by_addr, key=_addr_key):
+                ent = nbr_entries_by_addr[addr]
+                out = {"neighbor-address": ent["neighbor-address"]}
+                for plane in (
+                    "adj-rib-in-pre",
+                    "adj-rib-in-post",
+                    "adj-rib-out-pre",
+                    "adj-rib-out-post",
+                ):
+                    if ent[plane]:
+                        out[plane] = {
+                            "routes": {"route": ent[plane]}
+                        }
+                nbrs.append(out)
+            if nbrs:
+                fam["neighbors"] = {"neighbor": nbrs}
+            if fam:
+                entry[afs] = fam
+            afi_safi_entries.append(entry)
+
+        rib: dict = {}
+        if attr_sets:
+            rib["attr-sets"] = {
+                "attr-set": [
+                    {
+                        "index": idx,
+                        "attributes": _attrs_state(attrs),
+                    }
+                    for attrs, idx in attr_sets.items()
+                ]
+            }
+        if afi_safi_entries:
+            rib["afi-safis"] = {"afi-safi": afi_safi_entries}
+        return rib
+
+
+# ===== helpers =====
+
+
+def _addr_key(addr: str):
+    try:
+        return (0, int(IPv4Address(addr)))
+    except Exception:  # noqa: BLE001 — v6 sort after v4
+        return (1, addr)
+
+
+def _prefix_key(prefix: str):
+    addr, _, plen = prefix.partition("/")
+    return (_addr_key(addr), int(plen or 0))
+
+
+def _attrs_sort_key(attrs: BaseAttrs):
+    return json.dumps(_attrs_to_json(attrs), sort_keys=True)
+
+
+def _notif_msg(code: int, subcode) -> dict:
+    return {
+        "Notification": {
+            "error_code": code,
+            "error_subcode": int(subcode),
+            "data": [],
+        }
+    }
+
+
+def _route_compare(a: Route, b: Route) -> tuple[int, str]:
+    """rib.rs Route::compare with default selection config.
+    Returns (+1 if a preferred, -1 if b preferred, reason)."""
+    av = a.attrs.local_pref if a.attrs.local_pref is not None else DFLT_LOCAL_PREF
+    bv = b.attrs.local_pref if b.attrs.local_pref is not None else DFLT_LOCAL_PREF
+    if av != bv:
+        return (1 if av > bv else -1), "local-pref-lower"
+    av, bv = a.attrs.path_length(), b.attrs.path_length()
+    if av != bv:
+        return (1 if av < bv else -1), "as-path-longer"
+    av = ORIGIN_ORDER[a.attrs.origin]
+    bv = ORIGIN_ORDER[b.attrs.origin]
+    if av != bv:
+        return (1 if av < bv else -1), "origin-type-higher"
+    if a.attrs.first_as() == b.attrs.first_as():
+        av, bv = a.attrs.med or 0, b.attrs.med or 0
+        if av != bv:
+            return (1 if av < bv else -1), "med-higher"
+    order = {"Internal": 0, "External": 1}
+    av, bv = order[a.route_type], order[b.route_type]
+    if av != bv:
+        return (1 if av > bv else -1), "prefer-external"
+    if (a.igp_cost is None) != (b.igp_cost is None):
+        return (
+            1 if a.igp_cost is None else -1
+        ), "nexthop-cost-higher"
+    if a.igp_cost is not None and a.igp_cost != b.igp_cost:
+        return (
+            1 if a.igp_cost < b.igp_cost else -1
+        ), "nexthop-cost-higher"
+    if (
+        a.origin.identifier is not None
+        and b.origin.identifier is not None
+    ):
+        av = int(IPv4Address(a.origin.identifier))
+        bv = int(IPv4Address(b.origin.identifier))
+        if av != bv:
+            return (1 if av < bv else -1), "higher-router-id"
+    if (
+        a.origin.remote_addr is not None
+        and b.origin.remote_addr is not None
+    ):
+        av = _addr_key(a.origin.remote_addr)
+        bv = _addr_key(b.origin.remote_addr)
+        if av != bv:
+            return (
+                1 if av < bv else -1
+            ), "higher-peer-address"
+    return -1, "higher-peer-address"
+
+
+def _multipath_equal(a: Route, b: Route, mp: dict) -> bool:
+    """rib.rs:463-487 — equality prerequisites after full tie chain."""
+    a_lp = a.attrs.local_pref if a.attrs.local_pref is not None else DFLT_LOCAL_PREF
+    b_lp = b.attrs.local_pref if b.attrs.local_pref is not None else DFLT_LOCAL_PREF
+    cmp_fields = (
+        a_lp == b_lp
+        and a.attrs.path_length() == b.attrs.path_length()
+        and a.attrs.origin == b.attrs.origin
+        and a.route_type == b.route_type
+        and a.igp_cost == b.igp_cost
+    )
+    if not cmp_fields:
+        return False
+    if a.attrs.first_as() == b.attrs.first_as():
+        if (a.attrs.med or 0) != (b.attrs.med or 0):
+            return False
+    if a.route_type == "External":
+        return mp.get("allow_multiple_as", False) or (
+            a.attrs.first_as() == b.attrs.first_as()
+        )
+    return a.attrs.as_path == b.attrs.as_path
+
+
+def _attrs_from_json(j: dict) -> BaseAttrs:
+    base = j.get("base", {})
+    segs = tuple(
+        AsSegment(s["seg_type"], tuple(s["members"]))
+        for s in base.get("as_path", {}).get("segments", [])
+    )
+    return BaseAttrs(
+        origin=base.get("origin", "Incomplete"),
+        as_path=segs,
+        nexthop=base.get("nexthop"),
+        ll_nexthop=base.get("ll_nexthop"),
+        med=base.get("med"),
+        local_pref=base.get("local_pref"),
+    )
+
+
+def _attrs_to_json(attrs: BaseAttrs) -> dict:
+    base: dict = {
+        "origin": attrs.origin,
+        "as_path": {
+            "segments": [
+                {"seg_type": s.seg_type, "members": list(s.members)}
+                for s in attrs.as_path
+            ]
+        },
+    }
+    if attrs.nexthop is not None:
+        base["nexthop"] = attrs.nexthop
+    if attrs.ll_nexthop is not None:
+        base["ll_nexthop"] = attrs.ll_nexthop
+    if attrs.med is not None:
+        base["med"] = attrs.med
+    if attrs.local_pref is not None:
+        base["local_pref"] = attrs.local_pref
+    return {"base": base}
+
+
+def origin_from_json(j) -> RouteOrigin:
+    if isinstance(j, dict):
+        if "Neighbor" in j:
+            return RouteOrigin(
+                identifier=str(j["Neighbor"]["identifier"]),
+                remote_addr=str(j["Neighbor"]["remote_addr"]),
+            )
+        if "Protocol" in j:
+            return RouteOrigin(protocol=j["Protocol"])
+    raise ValueError(f"origin {j}")
+
+
+def _origin_yang(origin: RouteOrigin) -> str:
+    if origin.protocol is not None:
+        return f"ietf-routing:{origin.protocol}"
+    return origin.remote_addr
+
+
+def _cap_to_json(cap: tuple, nego: bool = False):
+    if cap[0] == "MultiProtocol":
+        return {"MultiProtocol": {"afi": cap[1], "safi": cap[2]}}
+    if cap[0] == "FourOctetAsNumber":
+        if nego or len(cap) == 1:
+            return "FourOctetAsNumber"
+        return {"FourOctetAsNumber": {"asn": cap[1]}}
+    return cap[0]
+
+
+def _cap_from_json(j) -> tuple:
+    if isinstance(j, str):
+        return (j,)
+    kind, body = next(iter(j.items()))
+    if kind == "MultiProtocol":
+        return cap_mp(body["afi"], body["safi"])
+    if kind == "FourOctetAsNumber":
+        return cap_asn32(body["asn"])
+    return (kind,)
+
+
+def _cap_state(index: int, cap: tuple) -> dict:
+    out = {
+        "code": _CAP_CODE[cap[0]],
+        "index": index,
+        "name": _CAP_YANG[cap[0]],
+    }
+    if cap[0] == "MultiProtocol":
+        afi = cap[1].lower()
+        safi = "unicast-safi" if cap[2] == "Unicast" else cap[2].lower()
+        name = f"iana-bgp-types:{cap[1].lower()}-{cap[2].lower()}"
+        out["value"] = {
+            "mpbgp": {"afi": afi, "safi": safi, "name": name}
+        }
+    elif cap[0] == "FourOctetAsNumber":
+        out["value"] = {"asn32": {"as": cap[1]}}
+    return out
+
+
+def _attrs_state(attrs: BaseAttrs) -> dict:
+    out: dict = {"origin": attrs.origin.lower()}
+    if attrs.as_path:
+        out["as-path"] = {
+            "segment": [
+                {
+                    "type": (
+                        "iana-bgp-types:as-sequence"
+                        if s.seg_type == "Sequence"
+                        else "iana-bgp-types:as-set"
+                    ),
+                    "member": list(s.members),
+                }
+                for s in attrs.as_path
+            ]
+        }
+    if attrs.nexthop is not None:
+        out["next-hop"] = attrs.nexthop
+    if attrs.ll_nexthop is not None:
+        out["link-local-next-hop"] = attrs.ll_nexthop
+    if attrs.med is not None:
+        out["med"] = attrs.med
+    if attrs.local_pref is not None:
+        out["local-pref"] = attrs.local_pref
+    return out

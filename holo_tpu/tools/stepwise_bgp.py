@@ -1,0 +1,463 @@
+"""BGP topology conformance: replay the reference's recorded snapshots.
+
+Drives holo-bgp/tests/conformance/topologies (10 router snapshots across
+topo1-1 eBGP mesh and topo2-1 iBGP/multipath) through the live BgpEngine,
+replaying each router's recorded view — TCP accept/connect, wire messages,
+policy-worker results, decision-process triggers, nexthop-tracking
+updates — and comparing ALL FOUR recorded output planes:
+
+- protocol: every SendMessage/SendMessageList/UpdateCapabilities emitted
+  during bring-up (multiset over flattened messages);
+- ibus: RouterIdSub / RouteRedistributeSub / NexthopTrack(+Untrack) /
+  RouteIpAdd / RouteIpDel (multiset);
+- northbound-notif: established / backward-transition events (multiset);
+- northbound-state: the full ietf-bgp operational tree.  Attr-set indexes
+  are XxHash64 outputs in the recording and engine-local ids here, so the
+  comparison dereferences every attr-index into the attr-set CONTENTS on
+  both sides before the deep diff — structurally exact, hash-free.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from holo_tpu.protocols.bgp_engine import (
+    AfiSafiCfg,
+    BgpEngine,
+    NeighborCfg,
+    origin_from_json,
+    _attrs_from_json,
+)
+
+BGP_DIR = Path("/root/reference/holo-bgp/tests/conformance/topologies")
+
+AFS_MAP = {"Ipv4Unicast": "ipv4-unicast", "Ipv6Unicast": "ipv6-unicast"}
+
+
+def _loads_lenient(text: str):
+    return json.JSONDecoder().raw_decode(text)[0]
+
+
+class CaseRun:
+    def __init__(self, rt_dir: Path):
+        self.rt_dir = rt_dir
+        self.tx_log: list = []
+        self.ibus_log: list = []
+        self.notif_log: list = []
+        self.engine = BgpEngine(
+            "test",
+            send_cb=lambda kind, payload: self.tx_log.append(
+                {"NbrTx": {kind: payload}}
+            ),
+            ibus_cb=lambda kind, payload: self.ibus_log.append(
+                {kind: payload}
+            ),
+            notif_cb=lambda data: self.notif_log.append(data),
+        )
+        self._apply_config(
+            _loads_lenient((rt_dir / "config.json").read_text())
+        )
+
+    def _apply_config(self, cfg: dict) -> None:
+        protos = cfg["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ]
+        proto = next(p["ietf-bgp:bgp"] for p in protos if "ietf-bgp:bgp" in p)
+        eng = self.engine
+        g = proto.get("global", {})
+        eng.asn = g.get("as", 0)
+        eng.cfg_identifier = g.get("identifier")
+        for af in (g.get("afi-safis") or {}).get("afi-safi", []):
+            name = af["name"].split(":")[-1]
+            eng.afi_safi_enabled.add(name)
+            fam = af.get("ipv4-unicast") or af.get("ipv6-unicast") or {}
+            for redist in fam.get("holo-bgp:redistribution", []):
+                eng.redistribution.setdefault(name, set()).add(
+                    redist["type"].split(":")[-1]
+                )
+            mp = af.get("use-multiple-paths")
+            if mp is not None:
+                ebgp = mp.get("ebgp") or {}
+                ibgp = mp.get("ibgp") or {}
+                eng.multipath[name] = {
+                    "enabled": mp.get("enabled", False),
+                    "ebgp_max": ebgp.get("maximum-paths", 1),
+                    "ibgp_max": ibgp.get("maximum-paths", 1),
+                    "allow_multiple_as": ebgp.get(
+                        "allow-multiple-as", False
+                    ),
+                }
+        for nbr in (proto.get("neighbors") or {}).get("neighbor", []):
+            ncfg = NeighborCfg(peer_as=nbr.get("peer-as", 0))
+            transport = nbr.get("transport") or {}
+            ncfg.local_address = transport.get("local-address")
+            ncfg.passive_mode = (transport.get("passive-mode")) or False
+            for af in (nbr.get("afi-safis") or {}).get("afi-safi", []):
+                name = af["name"].split(":")[-1]
+                pol = af.get("apply-policy") or {}
+                ncfg.afi_safi[name] = AfiSafiCfg(
+                    enabled=af.get("enabled", False),
+                    default_import_policy=pol.get(
+                        "default-import-policy", "reject-route"
+                    ),
+                    default_export_policy=pol.get(
+                        "default-export-policy", "reject-route"
+                    ),
+                )
+            eng.neighbor_cfg[str(nbr["remote-address"])] = ncfg
+
+    # ---- events
+
+    def apply_ibus(self, ev: dict) -> None:
+        kind, body = next(iter(ev.items()))
+        eng = self.engine
+        if kind == "RouterIdUpdate":
+            eng.router_id_update(str(body) if body is not None else None)
+        elif kind == "NexthopUpd":
+            eng.nexthop_update(str(body["addr"]), body.get("metric"))
+        elif kind in (
+            "PolicyUpd",
+            "PolicyMatchSetsUpd",
+            "PolicyDel",
+            "RouteRedistributeSub",
+        ):
+            pass  # policy evaluation results arrive as recorded events
+        elif kind == "RouteRedistributeAdd":
+            pass  # triggers worker policy; result is a recorded event
+        elif kind == "RouteRedistributeDel":
+            afs = (
+                "ipv6-unicast" if ":" in body["prefix"] else "ipv4-unicast"
+            )
+            table = eng.tables[afs]
+            dest = table.prefixes.get(body["prefix"])
+            if dest is not None:
+                dest.redistribute = None
+                table.queued.add(body["prefix"])
+        elif kind in (
+            "RouteIpAdd",
+            "RouteIpDel",
+            "InterfaceUpd",
+            "InterfaceAddressAdd",
+            "InterfaceAddressDel",
+        ):
+            pass  # own routes echoed back / iface events BGP ignores
+        else:
+            raise ValueError(f"unsupported ibus {kind}")
+
+    def apply_protocol(self, ev: dict) -> None:
+        kind, body = next(iter(ev.items()))
+        eng = self.engine
+        if kind == "TcpAccept":
+            eng.tcp_accept(body["conn_info"])
+        elif kind == "TcpConnect":
+            eng.tcp_connect(body["conn_info"])
+        elif kind == "NbrRx":
+            msg = body["msg"]
+            if "Err" in msg:
+                err = msg["Err"]
+                ekind = err if isinstance(err, str) else next(iter(err))
+                if ekind == "TcpConnClosed":
+                    eng.nbr_rx(str(body["nbr_addr"]), "conn-closed")
+                else:
+                    raise ValueError(f"nbr rx err {ekind}")
+            else:
+                eng.nbr_rx(str(body["nbr_addr"]), msg["Ok"])
+        elif kind == "NbrTimer":
+            eng.nbr_timer(str(body["nbr_addr"]), body["timer"])
+        elif kind == "TriggerDecisionProcess":
+            eng.run_decision_process()
+        elif kind == "PolicyResult":
+            self._apply_policy_result(body)
+        else:
+            raise ValueError(f"unsupported protocol {kind}")
+
+    def _apply_policy_result(self, pr: dict) -> None:
+        eng = self.engine
+        if "Redistribute" in pr:
+            body = pr["Redistribute"]
+            afs = AFS_MAP[body["afi_safi"]]
+            eng.policy_result_redistribute(
+                afs, body["prefix"], _result_from_json(body["result"])
+            )
+        elif "Neighbor" in pr:
+            body = pr["Neighbor"]
+            afs = AFS_MAP[body["afi_safi"]]
+            routes = [
+                (prefix, _result_from_json(result))
+                for prefix, result in body["routes"]
+            ]
+            eng.policy_result_neighbor(
+                body["policy_type"],
+                str(body["nbr_addr"]),
+                afs,
+                routes,
+            )
+        else:
+            raise ValueError(f"policy result {next(iter(pr))}")
+
+    def bring_up(self) -> None:
+        for line in (
+            (self.rt_dir / "events.jsonl").read_text().splitlines()
+        ):
+            line = line.strip()
+            if not line:
+                continue
+            ev = _loads_lenient(line)
+            if "Ibus" in ev:
+                self.apply_ibus(ev["Ibus"])
+            elif "Protocol" in ev:
+                self.apply_protocol(ev["Protocol"])
+
+    # ---- comparisons
+
+    def compare_protocol(self, expected_lines: list[dict]) -> list[str]:
+        def flatten(entries):
+            out = []
+            for e in entries:
+                body = e.get("NbrTx", {})
+                if "SendMessage" in body:
+                    m = body["SendMessage"]
+                    out.append(
+                        (
+                            "msg",
+                            str(m["nbr_addr"]),
+                            _canon_msg(m["msg"]),
+                        )
+                    )
+                elif "SendMessageList" in body:
+                    m = body["SendMessageList"]
+                    for msg in m["msg_list"]:
+                        out.append(
+                            (
+                                "msg",
+                                str(m["nbr_addr"]),
+                                _canon_msg(msg),
+                            )
+                        )
+                elif "UpdateCapabilities" in body:
+                    out.append(
+                        (
+                            "caps",
+                            json.dumps(
+                                body["UpdateCapabilities"],
+                                sort_keys=True,
+                            ),
+                        )
+                    )
+            return out
+
+        return _multiset_diff(
+            flatten(expected_lines), flatten(self.tx_log), "protocol"
+        )
+
+    def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
+        def canon(entries):
+            return [json.dumps(e, sort_keys=True) for e in entries]
+
+        return _multiset_diff(
+            canon(expected_lines), canon(self.ibus_log), "ibus"
+        )
+
+    def compare_notifs(self, expected_lines: list[dict]) -> list[str]:
+        def canon(entries):
+            return [json.dumps(e, sort_keys=True) for e in entries]
+
+        return _multiset_diff(
+            canon(expected_lines), canon(self.notif_log), "notif"
+        )
+
+    def compare_state(self, expected: dict) -> list[str]:
+        exp = expected["ietf-routing:routing"]["control-plane-protocols"][
+            "control-plane-protocol"
+        ][0]["ietf-bgp:bgp"]
+        got = self.engine.northbound_state()
+        return _tree_diff(
+            _deref_attr_indexes(exp), _deref_attr_indexes(got), "bgp"
+        )
+
+
+def _result_from_json(j):
+    if j == "Reject" or (isinstance(j, dict) and "Reject" in j):
+        return None
+    body = j["Accept"]
+    return {
+        "origin": origin_from_json(body["origin"]),
+        "route_type": body["route_type"],
+        "attrs": _attrs_from_json(body.get("attrs", {})),
+    }
+
+
+def _canon_msg(msg: dict) -> str:
+    """Canonical string for a protocol message; Update prefix lists are
+    sorted (BTreeSet order on both sides, but belt-and-braces)."""
+    msg = json.loads(json.dumps(msg))
+    if "Update" in msg:
+        upd = msg["Update"]
+        for key in ("reach", "unreach"):
+            if upd.get(key):
+                upd[key]["prefixes"] = sorted(upd[key]["prefixes"])
+        for key in ("mp_reach", "mp_unreach"):
+            if upd.get(key):
+                for body in upd[key].values():
+                    if "prefixes" in body:
+                        body["prefixes"] = sorted(body["prefixes"])
+    return json.dumps(msg, sort_keys=True)
+
+
+def _multiset_diff(want, got, plane: str) -> list[str]:
+    problems = []
+    got = list(got)
+    for item in want:
+        if item in got:
+            got.remove(item)
+        else:
+            problems.append(f"{plane} missing: {str(item)[:200]}")
+    for item in got:
+        problems.append(f"{plane} unexpected: {str(item)[:200]}")
+    return problems
+
+
+def _deref_attr_indexes(tree):
+    """Replace attr-index leaf values with the attr-set contents and drop
+    the raw indexes (engine-local vs XxHash64 in the recording)."""
+    tree = json.loads(json.dumps(tree))
+    sets = {}
+    for attr_set in (
+        tree.get("rib", {}).get("attr-sets", {}).get("attr-set", [])
+    ):
+        sets[str(attr_set["index"])] = attr_set.get("attributes", {})
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "attr-index" in node:
+                node["attr-index"] = sets.get(
+                    str(node["attr-index"]), node["attr-index"]
+                )
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(tree)
+    if "attr-sets" in tree.get("rib", {}):
+        tree["rib"]["attr-sets"] = {
+            "attr-set": sorted(
+                (
+                    {"attributes": s.get("attributes", {})}
+                    for s in tree["rib"]["attr-sets"]["attr-set"]
+                ),
+                key=lambda s: json.dumps(s, sort_keys=True),
+            )
+        }
+    return tree
+
+
+_LIST_KEYS = {
+    "neighbor": ("remote-address", "neighbor-address"),
+    "afi-safi": ("name",),
+    "route": ("prefix",),
+    "attr-set": (),
+    "advertised-capabilities": ("index",),
+    "received-capabilities": ("index",),
+    "segment": (),
+}
+
+
+def _tree_diff(exp, got, path: str) -> list[str]:
+    problems: list[str] = []
+    if isinstance(exp, dict) and isinstance(got, dict):
+        for k in exp:
+            if k not in got:
+                problems.append(f"{path}/{k}: missing")
+            else:
+                problems += _tree_diff(exp[k], got[k], f"{path}/{k}")
+        for k in got:
+            if k not in exp:
+                problems.append(f"{path}/{k}: unexpected")
+        return problems
+    if isinstance(exp, list) and isinstance(got, list):
+        name = path.rsplit("/", 1)[-1]
+        keys = _LIST_KEYS.get(name)
+
+        def keyfn(entry):
+            if keys and isinstance(entry, dict):
+                return json.dumps(
+                    [entry.get(k) for k in keys], sort_keys=True
+                )
+            return json.dumps(entry, sort_keys=True)
+
+        exp_s = sorted(exp, key=keyfn)
+        got_s = sorted(got, key=keyfn)
+        if len(exp_s) != len(got_s):
+            problems.append(
+                f"{path}: list length {len(got_s)} != {len(exp_s)}"
+            )
+        for i, (e, g) in enumerate(zip(exp_s, got_s)):
+            problems += _tree_diff(e, g, f"{path}[{i}]")
+        return problems
+    if exp != got:
+        problems.append(f"{path}: {got!r} != {exp!r}")
+    return problems
+
+
+def run_router(topo: str, rt: str):
+    rt_dir = BGP_DIR / topo / rt
+    run = CaseRun(rt_dir)
+    run.bring_up()
+    problems = []
+    out = rt_dir / "output"
+    for fname, cmp in (
+        ("protocol.jsonl", run.compare_protocol),
+        ("ibus.jsonl", run.compare_ibus),
+        ("northbound-notif.jsonl", run.compare_notifs),
+    ):
+        f = out / fname
+        expected = (
+            [
+                _loads_lenient(line)
+                for line in f.read_text().splitlines()
+                if line.strip()
+            ]
+            if f.exists()
+            else []
+        )
+        problems += cmp(expected)
+    f = out / "northbound-state.json"
+    if f.exists():
+        problems += run.compare_state(_loads_lenient(f.read_text()))
+    return ("pass", "") if not problems else (
+        "fail", "; ".join(problems[:8])
+    )
+
+
+def run_all():
+    results = {}
+    for topo_dir in sorted(BGP_DIR.iterdir()):
+        if not topo_dir.is_dir():
+            continue
+        for rt_dir in sorted(topo_dir.iterdir()):
+            if not rt_dir.is_dir():
+                continue
+            name = f"{topo_dir.name}/{rt_dir.name}"
+            try:
+                results[name] = run_router(topo_dir.name, rt_dir.name)
+            except Exception as e:  # noqa: BLE001 — sweep must not die
+                results[name] = (
+                    "fail",
+                    f"exception: {type(e).__name__}: {e}",
+                )
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    res = run_all()
+    by = {"pass": [], "fail": []}
+    for case, (status, detail) in sorted(res.items()):
+        by.setdefault(status, []).append(case)
+        if status != "pass" and "-v" in sys.argv:
+            print(f"{status:5} {case}: {detail[:400]}")
+    print(f"pass {len(by['pass'])} fail {len(by['fail'])} / {len(res)}")
