@@ -85,6 +85,20 @@ class MockFabric(NetIo):
 
     def _send(self, actor: str, ifname: str, src: Any, dst: Any, data: bytes) -> None:
         self.tx_log.append((actor, ifname, dst, data))
+        if ifname is None:
+            # Routed (multihop) send: pick the sender's link that can
+            # reach ``dst`` — the mock kernel's FIB lookup.
+            for (a, ifn), link in self._if_link.items():
+                if a != actor:
+                    continue
+                if any(
+                    ep.addr == dst and ep.actor != actor
+                    for ep in self.links[link]
+                ):
+                    ifname = ifn
+                    break
+            else:
+                return
         link = self._if_link.get((actor, ifname))
         if link is None or not self.link_up.get(link, False):
             return

@@ -80,6 +80,20 @@ class RawSocketIo(NetIo):
     # -- NetIo
 
     def send(self, ifname: str, src, dst, data: bytes) -> None:
+        if ifname is None:
+            # Routed (multihop) send: the kernel FIB picks the egress.
+            # With one open interface we can still satisfy it directly;
+            # otherwise fail loudly — silent drops hide misconfiguration
+            # (callers should resolve the egress from the RIB first).
+            if len(self._socks) == 1:
+                entry = next(iter(self._socks.values()))
+                entry.sock.sendto(data, (str(dst), 0))
+                return
+            raise ValueError(
+                "routed send (ifname=None) is ambiguous with "
+                f"{len(self._socks)} open interfaces; resolve the "
+                "egress interface from the RIB first"
+            )
         entry = self._socks.get(ifname)
         if entry is None:
             return

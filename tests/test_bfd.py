@@ -79,3 +79,100 @@ def test_ospf_adjacency_killed_by_bfd():
     fabric.add_drop_rule(lambda link, dst, data: True)
     loop.advance(6)  # BFD detect (~3s) << dead interval (40s)
     assert not iface.neighbors, "BFD failed to kill adjacency quickly"
+
+
+def _pair(loop, fabric, ibus, key1, key2):
+    b1 = BfdInstance(fabric.sender_for("bfd1"), ibus)
+    b2 = BfdInstance(fabric.sender_for("bfd2"), ibus)
+    b1.name, b2.name = "bfd1", "bfd2"
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "bfd1", "e0", A("10.0.0.1"))
+    fabric.join("l", "bfd2", "e0", A("10.0.0.2"))
+    s1 = b1.register(key1, "test", A("10.0.0.1"))
+    s2 = b2.register(key2, "test", A("10.0.0.2"))
+    return b1, b2, s1, s2
+
+
+def test_bfd_auth_roundtrip_and_verification():
+    from holo_tpu.protocols.bfd import BfdAuth, BfdAuthType
+
+    for atype in (
+        BfdAuthType.SIMPLE_PASSWORD,
+        BfdAuthType.KEYED_MD5,
+        BfdAuthType.METICULOUS_KEYED_SHA1,
+    ):
+        p = BfdPacket(
+            state=BfdState.UP,
+            my_discr=5,
+            your_discr=6,
+            auth=BfdAuth(atype, key_id=1, seq=42),
+        )
+        wire = p.encode(auth_key=b"s3cret")
+        out = BfdPacket.decode(wire)
+        assert out.auth is not None and out.auth.auth_type == atype
+        assert out.verify_auth(wire, b"s3cret")
+        assert not out.verify_auth(wire, b"wrong-key")
+
+
+def test_bfd_authenticated_session_rejects_bad_key():
+    from holo_tpu.protocols.bfd import BfdAuthType
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ibus = Ibus(loop)
+    k1, k2 = ("e0", A("10.0.0.2")), ("e0", A("10.0.0.1"))
+    b1, b2, s1, s2 = _pair(loop, fabric, ibus, k1, k2)
+    b1.configure_auth(k1, BfdAuthType.METICULOUS_KEYED_MD5, b"hunter2")
+    b2.configure_auth(k2, BfdAuthType.METICULOUS_KEYED_MD5, b"hunter2")
+    loop.advance(5)
+    assert s1.state == BfdState.UP and s2.state == BfdState.UP
+
+    # Re-key one side only: its packets now fail verification and the
+    # peer's detect timer expires.
+    b1.configure_auth(k1, BfdAuthType.METICULOUS_KEYED_MD5, b"other")
+    loop.advance(10)
+    assert s2.state == BfdState.DOWN
+
+
+def test_bfd_multihop_session():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ibus = Ibus(loop)
+    k1 = BfdInstance.session_key_mh(A("10.0.0.1"), A("10.0.0.2"))
+    k2 = BfdInstance.session_key_mh(A("10.0.0.2"), A("10.0.0.1"))
+    b1, b2, s1, s2 = _pair(loop, fabric, ibus, k1, k2)
+    loop.advance(5)
+    assert s1.state == BfdState.UP and s2.state == BfdState.UP
+    assert s1.is_multihop()
+
+    fabric.set_link_up("l", False)
+    loop.advance(5)
+    assert s1.state == BfdState.DOWN
+
+
+def test_bfd_echo_failure_detection():
+    from holo_tpu.protocols.bfd import BfdDiag
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    ibus = Ibus(loop)
+    k1, k2 = ("e0", A("10.0.0.2")), ("e0", A("10.0.0.1"))
+    b1, b2, s1, s2 = _pair(loop, fabric, ibus, k1, k2)
+    loop.advance(5)
+    assert s1.state == BfdState.UP
+    # Peer advertises a nonzero echo-rx window, then we start echoing.
+    s2.required_min_echo_rx = 50_000
+    b1.enable_echo(k1, interval=0.2)
+    loop.advance(3)
+    assert s1.state == BfdState.UP  # echoes looping back fine
+
+    # Kill the link: control packets stop AND echoes stop looping; the
+    # echo detect window (interval * mult) is shorter than the control
+    # detect time, so the failure diag is ECHO_FAILED.
+    fabric.set_link_up("l", False)
+    # Next echo goes out at +0.2s and its detect window (0.2s * 3) lapses
+    # at ~0.8s — well before the 3s control-packet detect time.
+    loop.advance(1.5)
+    assert s1.state == BfdState.DOWN
+    assert s1.diag == BfdDiag.ECHO_FAILED
