@@ -2,8 +2,11 @@
 
 Reference: holo-igmp (SURVEY.md §2.3) — querier election (lowest address),
 per-group membership state with expiry, last-member query on leave.
-Kernel multicast VIF programming is a daemon concern behind the kernel
-interface; tests observe the group table.
+Kernel multicast VIF registration mirrors the reference's per-interface
+start_vif (holo-igmp/src/interface.rs:106): pass a
+:class:`holo_tpu.routing.mroute.MulticastRouting` as ``mroute`` and each
+IGMP interface is added/removed as a VIF on the kernel's multicast
+routing socket.
 """
 
 from __future__ import annotations
@@ -97,18 +100,44 @@ class IgmpInterface:
 class IgmpInstance(Actor):
     name = "igmp"
 
-    def __init__(self, name: str, netio: NetIo, group_cb=None):
+    def __init__(self, name: str, netio: NetIo, group_cb=None, mroute=None):
         self.name = name
         self.netio = netio
-        self.group_cb = group_cb  # callable(ifname, groups) — VIF programming
+        self.group_cb = group_cb  # callable(ifname, groups) membership hook
+        self.mroute = mroute  # MulticastRouting: kernel VIF programming
         self.interfaces: dict[str, IgmpInterface] = {}
 
-    def add_interface(self, ifname: str, cfg: IgmpIfConfig, addr: IPv4Address):
+    def add_interface(
+        self,
+        ifname: str,
+        cfg: IgmpIfConfig,
+        addr: IPv4Address,
+        ifindex: int | None = None,
+    ):
         iface = IgmpInterface(ifname, cfg, addr)
         self.interfaces[ifname] = iface
+        if self.mroute is not None and ifindex is not None:
+            # Register the interface as a kernel multicast VIF
+            # (reference interface.rs:106 start_vif).
+            self.mroute.add_vif(ifname, ifindex)
         t = self.loop.timer(self.name, lambda: QueryTimerMsg(ifname))
         iface._query_timer = t
         t.start(0.1)
+
+    def remove_interface(self, ifname: str) -> None:
+        iface = self.interfaces.pop(ifname, None)
+        if iface is None:
+            return
+        for attr in ("_query_timer", "_other_querier_timer"):
+            t = getattr(iface, attr, None)
+            if t is not None:
+                t.cancel()
+        for g in iface.groups.values():
+            t = getattr(g, "_expiry", None)
+            if t is not None:
+                t.cancel()
+        if self.mroute is not None:
+            self.mroute.del_vif(ifname)
 
     def handle(self, msg):
         if isinstance(msg, NetRxPacket):

@@ -619,14 +619,24 @@ class OspfInstance(Actor):
         area, iface = ai
         if iface.state == IsmState.DOWN or iface.config.passive:
             return
+        options = (
+            Options.NP if area.nssa
+            else Options(0) if area.stub
+            else Options.E
+        )
+        lls = None
+        if self.gr_restarting:
+            # RFC 4812 restart signal: hellos during graceful restart
+            # carry an LLS block with the RS bit so helpers keep the
+            # adjacency without resetting it.
+            from holo_tpu.protocols.ospf.packet import LLS_EOF_RS, LlsBlock
+
+            options |= Options.L
+            lls = LlsBlock(eof=LLS_EOF_RS)
         hello = Hello(
             mask=mask_of(iface.prefix) if iface.prefix else IPv4Address(0),
             hello_interval=iface.config.hello_interval,
-            options=(
-                Options.NP if area.nssa
-                else Options(0) if area.stub
-                else Options.E
-            ),
+            options=options,
             priority=iface.config.priority,
             dead_interval=iface.config.dead_interval,
             dr=iface.dr,
@@ -634,7 +644,7 @@ class OspfInstance(Actor):
             neighbors=[n.router_id for n in iface.neighbors.values()
                        if n.state >= NsmState.INIT],
         )
-        self._send(iface, ALL_SPF_RTRS_V4, hello, area)
+        self._send(iface, ALL_SPF_RTRS_V4, hello, area, lls=lls)
         self._timer(("hello", ifname), lambda: HelloTimerMsg(ifname)).start(
             iface.config.hello_interval
         )
@@ -648,6 +658,9 @@ class OspfInstance(Actor):
             return  # §10.5 parameter mismatch
         if bool(h.options & Options.E) == area.no_type5:
             return  # §10.5: E-bit must agree with the area's type
+        # RFC 5613: record the peer's LLS extended options (restart
+        # signal / OOB-resync capability) on the neighbor.
+        lls_eof = pkt.lls.eof if pkt.lls is not None else None
         if bool(h.options & Options.NP) != area.nssa:
             return  # RFC 3101 §2.4: N-bit must agree on NSSA-ness
         if (
@@ -657,9 +670,12 @@ class OspfInstance(Actor):
         ):
             return
         nbr = iface.neighbors.get(pkt.router_id)
-        if nbr is None:
+        created = nbr is None
+        if created:
             nbr = Neighbor(router_id=pkt.router_id, src=src)
             iface.neighbors[pkt.router_id] = nbr
+        nbr.lls_eof = lls_eof
+        if created:
             if iface.config.bfd_enabled and self.ibus is not None:
                 # Register a BFD session for fast failure detection
                 # (ibus bfd_session_reg path, SURVEY.md §3.5).
@@ -2508,11 +2524,12 @@ class OspfInstance(Actor):
         elif t == PacketType.LS_ACK:
             self._rx_ls_ack(area, iface, msg.src, pkt)
 
-    def _send(self, iface: OspfInterface, dst, body, area: Area) -> None:
+    def _send(self, iface: OspfInterface, dst, body, area: Area, lls=None) -> None:
         pkt = Packet(
             router_id=self.config.router_id,
             area_id=area.area_id,
             body=body,
+            lls=lls,
         )
         auth = iface.config.auth
         if auth is not None and auth.type == AuthType.CRYPTOGRAPHIC:

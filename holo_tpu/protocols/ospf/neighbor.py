@@ -67,6 +67,9 @@ class Neighbor:
     # Cryptographic auth replay protection (RFC 2328 D.3): last accepted
     # sequence number from this neighbor.
     crypto_seqno: int = -1
+    # RFC 5613 LLS: extended-options flags from the peer's last hello
+    # (LR = OOB resync capable, RS = restart signal), None = no block.
+    lls_eof: int | None = None
     # Graceful-restart helper (RFC 3623): while now < gr_deadline the
     # inactivity timer must not kill this neighbor.
     gr_deadline: float | None = None

@@ -57,6 +57,7 @@ class Options(enum.IntFlag):
     E = 0x02  # external routing capability (not a stub area)
     MC = 0x04
     NP = 0x08  # NSSA
+    L = 0x10  # LLS data block present (RFC 5613)
     DC = 0x20
     O = 0x40  # opaque capable
 
@@ -663,9 +664,103 @@ class AuthCtx:
         return AUTH_ALGOS[self.algo][0]
 
 
+# LLS Extended Options and Flags bits (RFC 5613 / lls.rs:115-125).
+LLS_EOF_LR = 0x00000001  # LSDB resynchronization (RFC 4811)
+LLS_EOF_RS = 0x00000002  # restart signal (RFC 4812)
+
+
+@dataclass
+class LlsBlock:
+    """RFC 5613 link-local signaling data block, appended after the
+    OSPF packet (reference holo-ospf/src/packet/lls.rs).
+
+    Carried on Hello/DbDesc packets whose options set the L bit; the
+    Extended Options and Flags TLV transports the LR (out-of-band LSDB
+    resync capability) and RS (restart signal) bits.
+    """
+
+    eof: int | None = None  # LLS_EOF_* bits
+
+    def encode(self, auth: "AuthCtx | None" = None) -> bytes:
+        crypto = auth is not None and auth.type == AuthType.CRYPTOGRAPHIC
+        w = Writer()
+        w.u16(0)  # checksum (0 under cryptographic auth, §2.2)
+        len_pos = len(w)
+        w.u16(0)  # block length in 32-bit words (incl. header)
+        if self.eof is not None:
+            w.u16(1).u16(4).u32(self.eof)  # Extended Options TLV
+        if crypto:
+            # §2.5 Cryptographic Authentication TLV: MUST be last; the
+            # digest covers the block with the length field final
+            # (ospfv2/packet/lls.rs:88-120).
+            dlen = auth.digest_len
+            w.u16(2).u16(4 + dlen).u32(auth.seqno & 0xFFFFFFFF)
+            digest_start = len(w)
+            w.zeros(dlen)
+            w.patch_u16(len_pos, len(w) // 4)
+            out = bytearray(w.finish())
+            digest = auth.digest(bytes(out[:digest_start]))
+            out[digest_start:] = digest
+            return bytes(out)
+        w.patch_u16(len_pos, len(w) // 4)
+        out = bytearray(w.finish())
+        cks = ip_checksum(bytes(out))
+        out[0:2] = cks.to_bytes(2, "big")
+        return bytes(out)
+
+    @classmethod
+    def decode(
+        cls, data: bytes, auth: "AuthCtx | None" = None
+    ) -> "LlsBlock":
+        crypto = auth is not None and auth.type == AuthType.CRYPTOGRAPHIC
+        if len(data) < 4:
+            raise DecodeError("short LLS block")
+        words = int.from_bytes(data[2:4], "big")
+        blen = words * 4
+        if blen < 4 or blen > len(data):
+            raise DecodeError("bad LLS length")
+        if not crypto and ip_checksum(data[:blen]) != 0:
+            raise DecodeError("LLS checksum mismatch")
+        r = Reader(data, 4, blen)
+        out = cls()
+        ca_verified = False
+        while r.remaining() >= 4:
+            tlv_start = 4 + (r.pos - 4)
+            ttype = r.u16()
+            tlen = r.u16()
+            if tlen > r.remaining():
+                raise DecodeError("bad LLS TLV length")
+            body = r.sub(tlen)
+            # TLVs are padded to 32-bit alignment.
+            pad = (-tlen) % 4
+            if pad and r.remaining() >= pad:
+                r.bytes(pad)
+            if ttype == 1:
+                if tlen != 4:
+                    raise DecodeError("bad LLS EOF TLV length")
+                out.eof = body.u32()
+            elif ttype == 2 and crypto:
+                # CA TLV digest covers the block up to the digest field.
+                body.u32()  # seqno (replay handled at the packet layer)
+                dlen = tlen - 4
+                if dlen != auth.digest_len:
+                    raise DecodeError("bad LLS CA digest length")
+                digest_off = tlv_start + 8
+                want = auth.digest(data[:digest_off])
+                got = data[digest_off : digest_off + dlen]
+                if not _hmac.compare_digest(want, got):
+                    raise DecodeError("LLS CA digest mismatch")
+                ca_verified = True
+            # Other unknown LLS TLVs are skipped.
+        if crypto and not ca_verified:
+            raise DecodeError("missing LLS CA TLV under crypto auth")
+        return out
+
+
 @dataclass
 class Packet:
-    """OSPFv2 packet: 24-byte header + typed body (RFC 2328 §A.3.1)."""
+    """OSPFv2 packet: 24-byte header + typed body (RFC 2328 §A.3.1) +
+    optional LLS data block (RFC 5613) when the body options set L."""
 
     router_id: IPv4Address
     area_id: IPv4Address
@@ -675,6 +770,7 @@ class Packet:
     auth_type: AuthType = AuthType.NULL
     auth_data: bytes = bytes(8)
     auth_seqno: int = 0
+    lls: LlsBlock | None = None
 
     def encode(self, auth: AuthCtx | None = None) -> bytes:
         auth = auth or AuthCtx()
@@ -695,12 +791,18 @@ class Packet:
                 + (auth.seqno & 0xFFFFFFFF).to_bytes(4, "big"),
             )
             w.bytes(auth.digest(bytes(w.buf)))
-            return w.finish()
+            out = w.finish()
+            if self.lls is not None:
+                out += self.lls.encode(auth=auth)
+            return out
         cks = ip_checksum(bytes(w.buf[:16]) + bytes(w.buf[24:]))
         w.patch_u16(12, cks)
         if auth.type == AuthType.SIMPLE:
             w.patch_bytes(16, auth.key[:8].ljust(8, b"\x00"))
-        return w.finish()
+        out = w.finish()
+        if self.lls is not None:
+            out += self.lls.encode()
+        return out
 
     @classmethod
     def decode(cls, data: bytes, auth: AuthCtx | None = None) -> "Packet":
@@ -750,4 +852,12 @@ class Packet:
             if ip_checksum(data[:16] + data[24:length]) != 0:
                 raise DecodeError("packet checksum mismatch")
         body = _PKT_CODECS[ptype].decode_body(Reader(data, PKT_HDR_LEN, length))
-        return cls(router_id, area_id, body, auth_type, auth_data, seqno)
+        lls = None
+        if Options.L & getattr(body, "options", 0):
+            crypto = auth_type == AuthType.CRYPTOGRAPHIC
+            off = length + (auth.digest_len if crypto else 0)
+            if len(data) > off:
+                lls = LlsBlock.decode(data[off:], auth=auth)
+        return cls(
+            router_id, area_id, body, auth_type, auth_data, seqno, lls
+        )

@@ -1,0 +1,136 @@
+"""Kernel multicast routing control (VIFs + MFC entries).
+
+Reference: holo-utils/src/socket.rs:47-96,560-600 — the vifctl ioctl
+surface IGMP uses to register multicast-capable interfaces with the
+kernel (MRT_INIT / MRT_ADD_VIF / MRT_DEL_VIF), plus the MFC
+(multicast forwarding cache) add/del used once group membership exists.
+
+One process may hold the kernel's IPv4 multicast routing socket at a
+time (MRT_INIT fails with EADDRINUSE otherwise) — the daemon's routing
+provider owns it, mirroring the reference where holo-routing holds the
+privileged sockets.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from ipaddress import IPv4Address
+
+# linux/mroute.h
+MRT_BASE = 200
+MRT_INIT = MRT_BASE
+MRT_DONE = MRT_BASE + 1
+MRT_ADD_VIF = MRT_BASE + 2
+MRT_DEL_VIF = MRT_BASE + 3
+MRT_ADD_MFC = MRT_BASE + 4
+MRT_DEL_MFC = MRT_BASE + 5
+
+VIFF_USE_IFINDEX = 0x8
+
+IGMP_PROTO = 2
+MAXVIFS = 32
+
+
+def _vifctl(
+    vifi: int, ifindex: int, threshold: int = 1, rate_limit: int = 0
+) -> bytes:
+    """struct vifctl with the ifindex union arm
+    (socket.rs:47-62,579-592)."""
+    return struct.pack(
+        "=HBBIiI",
+        vifi,
+        VIFF_USE_IFINDEX,
+        threshold,
+        rate_limit,
+        ifindex,
+        0,  # vifc_rmt_addr (unused for non-tunnel VIFs)
+    )
+
+
+def _mfcctl(
+    origin: IPv4Address,
+    group: IPv4Address,
+    parent_vifi: int,
+    ttls: dict[int, int],
+) -> bytes:
+    """struct mfcctl: (S,G) forwarding cache entry."""
+    ttl_arr = bytearray(MAXVIFS)
+    for vifi, ttl in ttls.items():
+        ttl_arr[vifi] = ttl
+    return (
+        origin.packed
+        + group.packed
+        + struct.pack("=H", parent_vifi)
+        + bytes(ttl_arr)
+        + b"\x00\x00"  # alignment padding before the uint counters
+        + struct.pack("=IIIi", 0, 0, 0, 0)  # stats + expire (kernel-set)
+    )
+
+
+class MulticastRouting:
+    """Owner of the kernel IPv4 multicast-routing socket."""
+
+    def __init__(self) -> None:
+        self.sock = socket.socket(
+            socket.AF_INET, socket.SOCK_RAW, IGMP_PROTO
+        )
+        self.sock.setsockopt(socket.IPPROTO_IP, MRT_INIT, 1)
+        self._vifs: dict[str, int] = {}  # ifname -> vifi
+
+    def close(self) -> None:
+        try:
+            self.sock.setsockopt(socket.IPPROTO_IP, MRT_DONE, 1)
+        except OSError:
+            pass
+        self.sock.close()
+
+    def add_vif(self, ifname: str, ifindex: int) -> int:
+        """Register an interface as a multicast VIF; returns its index."""
+        if ifname in self._vifs:
+            return self._vifs[ifname]
+        # Lowest free slot: the kernel table has MAXVIFS entries and
+        # released indexes must be reusable across interface flaps.
+        used = set(self._vifs.values())
+        vifi = next(i for i in range(MAXVIFS) if i not in used)
+        self.sock.setsockopt(
+            socket.IPPROTO_IP, MRT_ADD_VIF, _vifctl(vifi, ifindex)
+        )
+        self._vifs[ifname] = vifi
+        return vifi
+
+    def del_vif(self, ifname: str) -> None:
+        vifi = self._vifs.pop(ifname, None)
+        if vifi is None:
+            return
+        # MRT_DEL_VIF takes the same struct with only vifc_vifi relevant.
+        self.sock.setsockopt(
+            socket.IPPROTO_IP, MRT_DEL_VIF, _vifctl(vifi, 0)
+        )
+
+    def add_mfc(
+        self,
+        origin: IPv4Address,
+        group: IPv4Address,
+        in_ifname: str,
+        out_ifnames: list[str],
+        ttl: int = 1,
+    ) -> None:
+        """Install an (S,G) forwarding entry across registered VIFs."""
+        parent = self._vifs[in_ifname]
+        ttls = {self._vifs[n]: ttl for n in out_ifnames}
+        self.sock.setsockopt(
+            socket.IPPROTO_IP,
+            MRT_ADD_MFC,
+            _mfcctl(origin, group, parent, ttls),
+        )
+
+    def del_mfc(self, origin: IPv4Address, group: IPv4Address) -> None:
+        self.sock.setsockopt(
+            socket.IPPROTO_IP,
+            MRT_DEL_MFC,
+            _mfcctl(origin, group, 0, {}),
+        )
+
+    def vifs(self) -> dict[str, int]:
+        return dict(self._vifs)

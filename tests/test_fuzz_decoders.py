@@ -134,6 +134,60 @@ def corpus():
     out.append(igmp.IgmpPacket(igmp.IgmpType.REPORT_V2, 0, A("239.0.0.1")).encode())
     out.append(ldp.LdpMsg(ldp.LdpMsgType.LABEL_MAPPING, A("1.1.1.1"),
                           fec=N("10.0.0.0/16"), label=10001).encode())
+    # Full RFC 5036 codec seeds (ldp/packet.py): session messages with
+    # capabilities, typed wildcards, status TLVs, auth'd BFD packets.
+    from holo_tpu.protocols.ldp import packet as ldp_full
+
+    out.append(
+        ldp_full.Pdu(
+            A("1.1.1.1"),
+            0,
+            [
+                ldp_full.HelloMsg(
+                    msg_id=1,
+                    flags=ldp_full.HELLO_GTSM,
+                    ipv4_addr=A("1.1.1.1"),
+                    cfg_seqno=1,
+                ),
+                ldp_full.InitMsg(
+                    msg_id=2,
+                    lsr_id=A("2.2.2.2"),
+                    cap_dynamic=True,
+                    cap_twcard_fec=True,
+                    cap_unrec_notif=True,
+                ),
+                ldp_full.AddressMsg(
+                    msg_id=3, addr_list=[A("10.0.0.1")]
+                ),
+                ldp_full.LabelMsg(
+                    msg_id=4,
+                    fec=[
+                        ldp_full.FecPrefix(N("10.0.0.0/24")),
+                        ldp_full.FecWildcard(
+                            typed_af=ldp_full.AF_IPV4
+                        ),
+                    ],
+                    label=16,
+                ),
+                ldp_full.NotifMsg(
+                    msg_id=5,
+                    status_code=(
+                        ldp_full.StatusCode.SHUTDOWN.encode_status()
+                    ),
+                ),
+            ],
+        ).encode()
+    )
+    out.append(
+        bfd.BfdPacket(
+            bfd.BfdState.UP,
+            my_discr=1,
+            your_discr=2,
+            auth=bfd.BfdAuth(
+                bfd.BfdAuthType.METICULOUS_KEYED_SHA1, key_id=1, seq=7
+            ),
+        ).encode(auth_key=b"k")
+    )
     return out
 
 
@@ -156,7 +210,17 @@ def decoders():
         "vrrp": vrrp.VrrpPacket.decode,
         "igmp": igmp.IgmpPacket.decode,
         "ldp": ldp.LdpMsg.decode,
+        "ldp_pdu": _ldp_pdu_decode,
     }
+
+
+def _ldp_pdu_decode(data: bytes):
+    from holo_tpu.protocols.ldp import packet as ldp_full
+
+    try:
+        return ldp_full.Pdu.decode(data)
+    except ldp_full.DecodeError as e:
+        raise DecodeError(str(e)) from e
 
 
 @pytest.mark.parametrize("name", sorted(decoders().keys()))

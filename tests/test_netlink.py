@@ -144,3 +144,48 @@ def test_rib_manager_with_real_kernel(kernel):
     assert "192.0.2.64/26" in ip_route_show()
     rib.route_del(RouteKeyMsg(Protocol.OSPFV2, N("192.0.2.64/26")))
     assert "192.0.2.64/26" not in ip_route_show()
+
+
+def test_multicast_vif_programming():
+    """Kernel VIF + MFC control (reference holo-utils/src/socket.rs:560-600
+    vifctl; runs in a private netns so the host mroute socket stays free)."""
+    import sys
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    script = rf'''
+import subprocess, sys
+sys.path.insert(0, {repo_root!r})
+subprocess.run(["ip", "link", "add", "mrd0", "type", "veth",
+                "peer", "name", "mrd1"], check=True)
+subprocess.run(["ip", "link", "set", "mrd0", "up"], check=True)
+ifindex = int(open("/sys/class/net/mrd0/ifindex").read())
+from ipaddress import IPv4Address as A
+from holo_tpu.routing.mroute import MulticastRouting
+from holo_tpu.protocols.igmp import IgmpIfConfig, IgmpInstance
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+loop = EventLoop(clock=VirtualClock())
+fabric = MockFabric(loop)
+m = MulticastRouting()
+inst = IgmpInstance("igmp", fabric.sender_for("igmp"), mroute=m)
+loop.register(inst)
+inst.add_interface("mrd0", IgmpIfConfig(), A("10.99.0.1"), ifindex=ifindex)
+assert "mrd0" in open("/proc/net/ip_mr_vif").read()
+m.add_mfc(A("10.99.0.2"), A("239.1.1.1"), "mrd0", ["mrd0"])
+assert "010101EF" in open("/proc/net/ip_mr_cache").read()
+m.del_mfc(A("10.99.0.2"), A("239.1.1.1"))
+inst.remove_interface("mrd0")
+assert "mrd0" not in open("/proc/net/ip_mr_vif").read()
+m.close()
+print("VIF-OK")
+'''
+    subprocess.run(["ip", "netns", "add", "viftest"], capture_output=True)
+    try:
+        out = subprocess.run(
+            ["ip", "netns", "exec", "viftest", sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert "VIF-OK" in out.stdout, out.stderr[-800:]
+    finally:
+        subprocess.run(["ip", "netns", "del", "viftest"], capture_output=True)

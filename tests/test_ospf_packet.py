@@ -175,3 +175,74 @@ def test_ls_request_update_ack_roundtrip():
     assert out.body.lsas[0].key == lsa.key
     assert out.body.lsas[0].raw == lsa.raw
     assert roundtrip_packet(ack).body.lsa_headers[0].key == lsa.key
+
+
+def test_lls_block_roundtrip():
+    """RFC 5613 LLS data block on hellos (reference packet/lls.rs)."""
+    from ipaddress import IPv4Address as A
+
+    from holo_tpu.protocols.ospf.packet import (
+        AuthCtx, AuthType, Hello, LLS_EOF_LR, LLS_EOF_RS, LlsBlock,
+        Options, Packet,
+    )
+
+    h = Hello(A("255.255.255.0"), 10, Options.E | Options.L, 1, 40,
+              A("0.0.0.0"), A("0.0.0.0"), [])
+    p = Packet(A("1.1.1.1"), A("0.0.0.0"), h,
+               lls=LlsBlock(eof=LLS_EOF_LR | LLS_EOF_RS))
+    out = Packet.decode(p.encode())
+    assert out.lls is not None
+    assert out.lls.eof == (LLS_EOF_LR | LLS_EOF_RS)
+
+    # Under cryptographic auth the LLS block follows the digest and its
+    # checksum field is unused (RFC 5613 §2.2).
+    auth = AuthCtx(type=AuthType.CRYPTOGRAPHIC, key=b"k", key_id=1, seqno=9)
+    out = Packet.decode(p.encode(auth=auth), auth=auth)
+    assert out.lls is not None and out.lls.eof == (LLS_EOF_LR | LLS_EOF_RS)
+
+    # Corrupting the block must be detected.
+    wire = bytearray(p.encode())
+    wire[-1] ^= 0xFF
+    import pytest
+
+    from holo_tpu.utils.bytesbuf import DecodeError
+
+    with pytest.raises(DecodeError):
+        Packet.decode(bytes(wire))
+
+
+def test_lls_restart_signal_on_gr_hellos():
+    """A restarting router's hellos carry LLS RS; the helper records it."""
+    from ipaddress import IPv4Address as A
+
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.protocols.ospf.packet import LLS_EOF_RS
+    from holo_tpu.utils.netio import MockFabric
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    insts = {}
+    for name, rid, addr in (("r1", "1.1.1.1", "10.0.0.1"),
+                            ("r2", "2.2.2.2", "10.0.0.2")):
+        inst = OspfInstance(name=name, config=InstanceConfig(router_id=A(rid)),
+                            netio=fabric.sender_for(name))
+        loop.register(inst, name=name)
+        fabric.join("l", name, "e0", A(addr))
+        inst.add_interface("e0", IfConfig(if_type=IfType.POINT_TO_POINT),
+                           N("10.0.0.0/24"), A(addr))
+        loop.send(name, IfUpMsg("e0"))
+        insts[name] = inst
+    loop.advance(60)
+    r1, r2 = insts["r1"], insts["r2"]
+    nbr = r2.areas[A("0.0.0.0")].interfaces["e0"].neighbors[A("1.1.1.1")]
+    assert nbr.lls_eof is None
+
+    r1.gr_restarting = True
+    loop.advance(15)  # next hello interval
+    assert nbr.lls_eof is not None and nbr.lls_eof & LLS_EOF_RS
