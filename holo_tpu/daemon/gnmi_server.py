@@ -57,7 +57,7 @@ class GnmiService:
 
     def Capabilities(self, request, context):
         resp = pb.CapabilityResponse(
-            supported_encodings=["JSON_IETF"],
+            supported_encodings=["JSON_IETF", "PROTO"],
             gNMI_version="0.8.0-lite",
         )
         for name in sorted(self.daemon.northbound.schema.roots.keys()):
@@ -97,6 +97,25 @@ class GnmiService:
             state = nb.get_state(pstr or None)
             if state:
                 payload["state"] = state
+        if request.encoding == pb.PROTO:
+            # Proto-encoded updates: one Update per scalar leaf with a
+            # native TypedValue (reference gnmi.rs gen_update_proto).
+            # Leaves are rooted at the requested path (no config/state
+            # wrapper segments) so returned paths round-trip into Set;
+            # when both planes are requested, state wins on overlap.
+            leaves: dict[str, object] = {}
+            for section in ("config", "state"):
+                if section in payload:
+                    for leaf_path, value in _walk_leaves(
+                        pstr, payload[section]
+                    ):
+                        leaves[leaf_path] = value
+            for leaf_path, value in leaves.items():
+                notif.update.add(
+                    path=str_to_path(leaf_path),
+                    val=_typed_value(value),
+                )
+            return
         notif.update.add(
             path=path,
             val=pb.TypedValue(json_ietf_val=json.dumps(payload, default=str)),
@@ -189,6 +208,56 @@ class GnmiService:
                 q.put_nowait(notif)
             except queue.Full:
                 pass
+
+
+def _typed_value(value) -> pb.TypedValue:
+    """Scalar -> native gNMI TypedValue (gnmi.rs:332-388 proto arm)."""
+    if isinstance(value, bool):
+        return pb.TypedValue(bool_val=value)
+    if isinstance(value, int):
+        if value < 0:
+            return pb.TypedValue(int_val=value)
+        return pb.TypedValue(uint_val=value)
+    if isinstance(value, float):
+        return pb.TypedValue(double_val=value)
+    return pb.TypedValue(string_val=str(value))
+
+
+def _walk_leaves(base: str, tree):
+    """Yield (path, scalar) for every leaf under a JSON state tree.
+
+    List entries use the value of their first key-ish member ("name",
+    else the first scalar) as the gNMI path key segment.
+    """
+    if not isinstance(tree, (dict, list)):
+        yield base, tree
+        return
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            sub = f"{base}/{k}" if base else str(k)
+            yield from _walk_leaves(sub, v)
+        return
+    if all(not isinstance(e, dict) for e in tree):
+        # Leaf-list: one update carrying the whole array (our lite
+        # proto has no ScalarArray; JSON keeps the path unique).
+        yield base, json.dumps(tree, default=str)
+        return
+    for i, entry in enumerate(tree):
+        if isinstance(entry, dict):
+            key = entry.get("name")
+            if key is None:
+                key = next(
+                    (
+                        v
+                        for v in entry.values()
+                        if not isinstance(v, (dict, list))
+                    ),
+                    None,
+                )
+            sub = f"{base}[{key}]" if key is not None else f"{base}[{i}]"
+            yield from _walk_leaves(sub, entry)
+        else:
+            yield f"{base}[{i}]", entry
 
 
 def _apply_json(tree, base: str, sub) -> None:

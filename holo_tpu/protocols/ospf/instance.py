@@ -150,6 +150,7 @@ class InstanceConfig:
     router_id: IPv4Address = IPv4Address("0.0.0.0")
     spf: SpfTimers = field(default_factory=SpfTimers)
     sr: object = None  # holo_tpu.utils.sr.SrConfig (None = SR disabled)
+    bier: object = None  # holo_tpu.utils.bier.BierCfg (None = disabled)
     # Administrative distances for routes published to the RIB manager
     # (ietf-ospf preference hierarchy: specific type > internal > all).
     preference: int = 110
@@ -277,7 +278,10 @@ class OspfInstance(Actor):
         self._nssa_translated: set[IPv4Network] = set()
         # Segment routing state (labels resolved after each SPF).
         self.sr_labels: dict = {}
-        self._sr_opaque_ids: dict[IPv4Network, int] = {}
+        self.bier_routes: dict = {}
+        # Shared opaque-id allocator for RFC 7684 extended-prefix LSAs:
+        # keys are ("sr", prefix) and ("bier", sd_id); ids never reused.
+        self._ext_prefix_opaque_ids: dict[tuple, int] = {}
 
     _SEQNO_WINDOW = 1 << 16
 
@@ -2283,9 +2287,11 @@ class OspfInstance(Actor):
         # Stable opaque-id per prefix (never reused) so removals can be
         # flushed and reorderings can't cross LSAs.
         for prefix in sr.prefix_sids:
-            if prefix not in self._sr_opaque_ids:
-                self._sr_opaque_ids[prefix] = len(self._sr_opaque_ids)
-        for prefix, opaque_id in list(self._sr_opaque_ids.items()):
+            self._alloc_ext_prefix_opaque_id(("sr", prefix))
+        for key, opaque_id in list(self._ext_prefix_opaque_ids.items()):
+            if key[0] != "sr":
+                continue
+            prefix = key[1]
             psid = sr.prefix_sids.get(prefix)
             lsid = ext_prefix_lsid(opaque_id)
             if psid is None:
@@ -2329,8 +2335,102 @@ class OspfInstance(Actor):
                     out[prefix] = (label, route)
         return out
 
+    def _alloc_ext_prefix_opaque_id(self, key: tuple) -> int:
+        if key not in self._ext_prefix_opaque_ids:
+            self._ext_prefix_opaque_ids[key] = len(
+                self._ext_prefix_opaque_ids
+            )
+        return self._ext_prefix_opaque_ids[key]
+
+    # ----- BIER underlay (RFC 9089 over RFC 7684 LSAs)
+
+    def _originate_bier(self) -> None:
+        bier = self.config.bier
+        if bier is None or not bier.enabled():
+            # Withdraw any previously advertised sub-domains.
+            from holo_tpu.protocols.ospf.packet import ext_prefix_lsid
+
+            for key, opaque_id in self._ext_prefix_opaque_ids.items():
+                if key[0] != "bier":
+                    continue
+                lsa_key = LsaKey(
+                    LsaType.OPAQUE_AREA,
+                    ext_prefix_lsid(opaque_id),
+                    self.config.router_id,
+                )
+                for area in self.areas.values():
+                    self._flush_self_lsa(area, lsa_key)
+            return
+        from holo_tpu.protocols.ospf.packet import (
+            LsaOpaque,
+            encode_ext_prefix_bier,
+            ext_prefix_lsid,
+        )
+
+        for sd_id, sd in sorted(bier.sub_domains.items()):
+            if sd.bfr_prefix is None:
+                continue
+            self._alloc_ext_prefix_opaque_id(("bier", sd_id))
+        for key, opaque_id in list(self._ext_prefix_opaque_ids.items()):
+            if key[0] != "bier":
+                continue
+            sd = bier.sub_domains.get(key[1])
+            lsid = ext_prefix_lsid(opaque_id)
+            if sd is None or sd.bfr_prefix is None:
+                # Sub-domain removed: withdraw the advertisement.
+                lsa_key = LsaKey(
+                    LsaType.OPAQUE_AREA, lsid, self.config.router_id
+                )
+                for area in self.areas.values():
+                    self._flush_self_lsa(area, lsa_key)
+                continue
+            body = LsaOpaque(
+                encode_ext_prefix_bier(
+                    sd.bfr_prefix, key[1], sd.bfr_id, sd.encaps
+                )
+            )
+            for area in self.areas.values():
+                self._originate(area, LsaType.OPAQUE_AREA, lsid, body)
+
+    def _resolve_bier(self, all_routes: dict) -> dict:
+        """prefix -> (BierInfo, route) for every BFR prefix heard in a
+        locally configured sub-domain (reference holo-ospf/src/bier.rs:
+        bier_route_add filters on the shared sub-domain config)."""
+        bier = self.config.bier
+        if bier is None or not bier.enabled():
+            return {}
+        from holo_tpu.protocols.ospf.packet import decode_ext_prefix_bier
+        from holo_tpu.utils.bier import BierInfo
+
+        now = self.loop.clock.now()
+        out = {}
+        for area in self.areas.values():
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.OPAQUE_AREA
+                    or (int(lsa.lsid) >> 24) != 7
+                    or e.current_age(now) >= MAX_AGE
+                ):
+                    continue
+                parsed = decode_ext_prefix_bier(lsa.body.data)
+                if parsed is None:
+                    continue
+                prefix, sd_id, _mt, bfr_id, bsls = parsed
+                if sd_id not in bier.sub_domains or not bsls:
+                    continue
+                route = all_routes.get(prefix)
+                if route is not None:
+                    out[prefix] = (
+                        BierInfo(sd_id=sd_id, bfr_id=bfr_id, bfr_bss=bsls),
+                        route,
+                    )
+        return out
+
     def _finish_spf(self, all_routes: dict) -> None:
         self._originate_prefix_sids()
+        self._originate_bier()
+        self.bier_routes = self._resolve_bier(all_routes)
         self.sr_labels = self._resolve_sr_labels(all_routes)
         old = self.routes
         self.routes = all_routes

@@ -256,9 +256,9 @@ def ext_prefix_lsid(opaque_id: int) -> IPv4Address:
     return IPv4Address((EXT_PREFIX_OPAQUE_TYPE << 24) | (opaque_id & 0xFFFFFF))
 
 
-def encode_ext_prefix_sid(prefix, sid_index: int, flags: int = 0) -> bytes:
-    """Extended-Prefix TLV (1) with a Prefix-SID sub-TLV (2) — the RFC
-    7684/8665 shape, condensed to the fields the SPF/SR path consumes."""
+def _encode_ext_prefix_tlv1(prefix, sub_tlvs: bytes) -> bytes:
+    """Extended-Prefix TLV (1) framing shared by the SR and BIER
+    encoders (RFC 7684 §2.1)."""
     w = Writer()
     body = Writer()
     plen = prefix.prefixlen
@@ -266,16 +266,15 @@ def encode_ext_prefix_sid(prefix, sid_index: int, flags: int = 0) -> bytes:
     nbytes = (plen + 7) // 8
     body.bytes(prefix.network_address.packed[:nbytes])
     body.zeros((4 - nbytes % 4) % 4)
-    # Prefix-SID sub-TLV: type 2, flags, reserved, MT, algo, SID index.
-    sub = Writer()
-    sub.u8(flags).u8(0).u8(0).u8(0).u32(sid_index)
-    body.u16(2).u16(len(sub)).bytes(sub.finish())
+    body.bytes(sub_tlvs)
     w.u16(1).u16(len(body)).bytes(body.finish())
     return w.finish()
 
 
-def decode_ext_prefix_sid(data: bytes):
-    """Returns (IPv4Network prefix, sid_index, flags) or None."""
+def _walk_ext_prefix_tlv1(data: bytes):
+    """Yield (IPv4Network prefix, Reader over sub-TLVs) for each
+    Extended-Prefix TLV; host bits below the prefix length are masked
+    off (foreign advertisements may carry them)."""
     from ipaddress import IPv4Network
 
     r = Reader(data)
@@ -290,10 +289,10 @@ def decode_ext_prefix_sid(data: bytes):
         body.u8()
         body.u8()
         if plen > 32:
-            return None
+            continue
         nbytes = (plen + 7) // 8
         if body.remaining() < nbytes:
-            return None
+            continue
         raw = body.bytes(nbytes) + bytes(4 - nbytes)
         pad = (4 - nbytes % 4) % 4
         if body.remaining() >= pad:
@@ -301,7 +300,71 @@ def decode_ext_prefix_sid(data: bytes):
         val = int.from_bytes(raw, "big")
         if plen < 32:
             val &= ~((1 << (32 - plen)) - 1)
-        prefix = IPv4Network((val, plen))
+        yield IPv4Network((val, plen)), body
+
+
+def encode_ext_prefix_sid(prefix, sid_index: int, flags: int = 0) -> bytes:
+    """Extended-Prefix TLV (1) with a Prefix-SID sub-TLV (2) — the RFC
+    7684/8665 shape, condensed to the fields the SPF/SR path consumes."""
+    sub = Writer()
+    # Prefix-SID sub-TLV: type 2, flags, reserved, MT, algo, SID index.
+    inner = Writer()
+    inner.u8(flags).u8(0).u8(0).u8(0).u32(sid_index)
+    sub.u16(2).u16(len(inner)).bytes(inner.finish())
+    return _encode_ext_prefix_tlv1(prefix, sub.finish())
+
+
+def encode_ext_prefix_bier(
+    prefix, sd_id: int, bfr_id: int, bsls, mt_id: int = 0
+) -> bytes:
+    """Extended-Prefix TLV (1) with a BIER sub-TLV (9, RFC 9089 §2.1)
+    carrying our BFR-id in a sub-domain plus one BIER MPLS Encapsulation
+    sub-sub-TLV (1) per advertised bitstring length."""
+    sub = Writer()
+    inner = Writer()
+    inner.u8(sd_id).u8(mt_id).u16(bfr_id)
+    inner.u8(0).u8(0).u16(0)  # BAR, IPA, reserved
+    for bsl in bsls:
+        # RFC 8296 BSL identifier: 1 = 64 bits, doubling per step.
+        bsl_id = (bsl // 64).bit_length()
+        inner.u16(1).u16(4).u8(0).u8(bsl_id << 4).u16(0)
+    sub.u16(9).u16(len(inner)).bytes(inner.finish())
+    return _encode_ext_prefix_tlv1(prefix, sub.finish())
+
+
+def decode_ext_prefix_bier(data: bytes):
+    """Returns (IPv4Network prefix, sd_id, mt_id, bfr_id, (bsl, ...))
+    or None when no BIER sub-TLV is present."""
+    for prefix, body in _walk_ext_prefix_tlv1(data):
+        while body.remaining() >= 4:
+            st = body.u16()
+            sl = body.u16()
+            sbody = body.sub(min((sl + 3) // 4 * 4, body.remaining()))
+            if st != 9 or sbody.remaining() < 8:
+                continue
+            sd_id = sbody.u8()
+            mt_id = sbody.u8()
+            bfr_id = sbody.u16()
+            sbody.u8()
+            sbody.u8()
+            sbody.u16()
+            bsls = []
+            while sbody.remaining() >= 4:
+                sst = sbody.u16()
+                ssl = sbody.u16()
+                ssb = sbody.sub(min((ssl + 3) // 4 * 4, sbody.remaining()))
+                if sst == 1 and ssb.remaining() >= 4:
+                    ssb.u8()
+                    bsl_id = ssb.u8() >> 4
+                    if bsl_id >= 1:
+                        bsls.append(64 << (bsl_id - 1))
+            return prefix, sd_id, mt_id, bfr_id, tuple(bsls)
+    return None
+
+
+def decode_ext_prefix_sid(data: bytes):
+    """Returns (IPv4Network prefix, sid_index, flags) or None."""
+    for prefix, body in _walk_ext_prefix_tlv1(data):
         while body.remaining() >= 4:
             st = body.u16()
             sl = body.u16()

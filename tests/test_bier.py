@@ -1,0 +1,144 @@
+"""BIER underlay: bitstring math, OSPF BFR advertisement, BIRT/F-BM.
+
+Reference: holo-utils/src/bier.rs, holo-routing/src/birt.rs,
+holo-ospf/src/bier.rs.
+"""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+import pytest
+
+from holo_tpu.utils.bier import (
+    BierCfg,
+    BierError,
+    BierSubDomainCfg,
+    Birt,
+    Bitstring,
+)
+
+
+def test_bitstring_math():
+    b1 = Bitstring.from_bfr_id(1, 64)
+    assert (b1.si, b1.bits) == (0, 1)
+    b64 = Bitstring.from_bfr_id(64, 64)
+    assert (b64.si, b64.bits) == (0, 1 << 63)
+    b65 = Bitstring.from_bfr_id(65, 64)
+    assert (b65.si, b65.bits) == (1, 1)
+    u = b1.union(b64)
+    assert u.bits == (1 << 63) | 1
+    with pytest.raises(BierError):
+        b1.union(b65)  # different set identifiers
+    with pytest.raises(BierError):
+        Bitstring.from_bfr_id(0, 64)
+    with pytest.raises(BierError):
+        Bitstring.from_bfr_id(1, 100)
+
+
+def test_birt_fbm_aggregation():
+    """BFERs behind the same neighbor share one forwarding bitmask."""
+    synced = []
+    birt = Birt(bift_sync=synced.append)
+    birt.nbr_add(0, 2, A("2.2.2.2"), [64], A("10.0.0.2"), ifname="e0")
+    birt.nbr_add(0, 3, A("3.3.3.3"), [64], A("10.0.0.2"), ifname="e0")
+    birt.nbr_add(0, 4, A("4.4.4.4"), [64], A("10.0.0.9"), ifname="e1")
+    bift = birt.compute_bift()
+    fbm, bfrs, ifname = bift[(0, A("10.0.0.2"), 0, 64)]
+    assert fbm.bits == (1 << 1) | (1 << 2)  # bfr-ids 2 and 3
+    assert {b for b, _ in bfrs} == {2, 3}
+    assert ifname == "e0"
+    fbm4, _, _ = bift[(0, A("10.0.0.9"), 0, 64)]
+    assert fbm4.bits == 1 << 3
+    assert len(synced) == 3  # re-synced per change
+
+    birt.nbr_del(0, 3, 64)
+    bift = birt.compute_bift()
+    fbm, _, _ = bift[(0, A("10.0.0.2"), 0, 64)]
+    assert fbm.bits == 1 << 1
+
+
+def test_ext_prefix_bier_roundtrip():
+    from holo_tpu.protocols.ospf.packet import (
+        decode_ext_prefix_bier,
+        encode_ext_prefix_bier,
+    )
+
+    data = encode_ext_prefix_bier(N("2.2.2.2/32"), 0, 7, (64, 256))
+    out = decode_ext_prefix_bier(data)
+    assert out == (N("2.2.2.2/32"), 0, 0, 7, (64, 256))
+
+
+def test_ospf_bier_underlay_populates_birt():
+    """Three routers in a line; BIER sub-domain 0 everywhere.  r1 learns
+    both BFERs' prefixes with their BFR-ids and the BIRT aggregates the
+    F-BM through the shared next hop (r2)."""
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.utils.netio import MockFabric
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+
+    def bier_cfg(bfr_id, prefix):
+        return BierCfg(sub_domains={0: BierSubDomainCfg(
+            sd_id=0, bfr_id=bfr_id, bfr_prefix=N(prefix), encaps=(64,),
+        )})
+
+    routers = {}
+    for name, rid, bfr_id in (("r1", "1.1.1.1", 1), ("r2", "2.2.2.2", 2),
+                              ("r3", "3.3.3.3", 3)):
+        inst = OspfInstance(
+            name=name,
+            config=InstanceConfig(
+                router_id=A(rid), bier=bier_cfg(bfr_id, f"{rid}/32"),
+            ),
+            netio=fabric.sender_for(name),
+        )
+        loop.register(inst, name=name)
+        routers[name] = inst
+
+    cfg = IfConfig(if_type=IfType.POINT_TO_POINT)
+    links = [("l12", "r1", "e0", "10.0.1.1", "r2", "w0", "10.0.1.2"),
+             ("l23", "r2", "e1", "10.0.2.1", "r3", "w1", "10.0.2.2")]
+    for link, an, aif, aaddr, bn, bif, baddr in links:
+        net = N(aaddr + "/24", strict=False)
+        routers[an].add_interface(aif, cfg, net, A(aaddr))
+        routers[bn].add_interface(bif, cfg, net, A(baddr))
+        fabric.join(link, an, aif, A(aaddr))
+        fabric.join(link, bn, bif, A(baddr))
+    # Loopback-ish stub for each BFR prefix.
+    for name, rid in (("r1", "1.1.1.1"), ("r2", "2.2.2.2"), ("r3", "3.3.3.3")):
+        routers[name].add_interface(
+            f"lo-{name}", IfConfig(if_type=IfType.POINT_TO_POINT, passive=True),
+            N(rid + "/32"), A(rid),
+        )
+    for name, inst in routers.items():
+        for ifname in list(inst.areas[A("0.0.0.0")].interfaces):
+            loop.send(name, IfUpMsg(ifname))
+    loop.advance(120)
+
+    r1 = routers["r1"]
+    assert N("3.3.3.3/32") in r1.routes
+    assert N("3.3.3.3/32") in r1.bier_routes
+    info, _route = r1.bier_routes[N("3.3.3.3/32")]
+    assert info.bfr_id == 3 and info.sd_id == 0 and 64 in info.bfr_bss
+
+    # Feed the learned BFERs into a BIRT the way the routing provider
+    # does (route nexthop + advertised BIER info).
+    birt = Birt()
+    for prefix, (info, route) in r1.bier_routes.items():
+        nh = next(iter(route.nexthops), None)
+        if nh is None or nh.addr is None:
+            continue
+        birt.nbr_add(info.sd_id, info.bfr_id, prefix.network_address,
+                     info.bfr_bss, nh.addr, ifname=nh.ifname)
+    bift = birt.compute_bift()
+    # Both r2 and r3 are reached via r2 (10.0.1.2): one shared F-BM.
+    key = (0, A("10.0.1.2"), 0, 64)
+    assert key in bift
+    fbm, bfrs, _ = bift[key]
+    assert fbm.bits == (1 << 1) | (1 << 2)
+    assert {b for b, _ in bfrs} == {2, 3}

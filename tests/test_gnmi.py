@@ -66,6 +66,34 @@ def test_gnmi_end_to_end():
             cli.Set(bad)
         assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
+        # Get with PROTO encoding: one Update per leaf, native types
+        # (reference gnmi.rs gen_update_proto).
+        assert "PROTO" in caps.supported_encodings
+        get = gs.pb.GetRequest(
+            type=gs.pb.GetRequest.CONFIG, encoding=gs.pb.PROTO
+        )
+        get.path.add().CopyFrom(gs.str_to_path("interfaces"))
+        out = cli.Get(get)
+        updates = out.notification[0].update
+        by_path = {
+            gs.path_to_str(u.path): u.val for u in updates
+        }
+        mtu_path = next(p for p in by_path if p.endswith("/mtu"))
+        assert by_path[mtu_path].WhichOneof("value") == "uint_val"
+        assert by_path[mtu_path].uint_val == 4000
+        hn = cli.Get(
+            gs.pb.GetRequest(
+                type=gs.pb.GetRequest.CONFIG, encoding=gs.pb.PROTO,
+                path=[gs.str_to_path("system/hostname")],
+            )
+        )
+        vals = hn.notification[0].update
+        assert any(
+            v.val.WhichOneof("value") == "string_val"
+            and v.val.string_val == "gnmi-rtr"
+            for v in vals
+        )
+
         # Subscribe ONCE: snapshot + sync_response.
         sub = gs.pb.SubscribeRequest()
         sub.subscribe.mode = gs.pb.SubscriptionList.ONCE
