@@ -2342,6 +2342,48 @@ class OspfInstance(Actor):
             )
         return self._ext_prefix_opaque_ids[key]
 
+    def update_ext_prefix_flags(self) -> None:
+        """Originate (or flush) the extended-prefix attribute LSA
+        carrying N/AC flags for interface addresses (reference
+        ospfv2/lsdb.rs:760-800: lsa-id 7.0.0.0, one TLV per flagged
+        address; N for node-flag host addresses, else AC for
+        anycast-flag interfaces)."""
+        from holo_tpu.protocols.ospf.packet import (
+            EXT_PREFIX_FLAG_AC,
+            EXT_PREFIX_FLAG_N,
+            LsaOpaque,
+            encode_ext_prefix_flags,
+        )
+
+        lsid = IPv4Address(7 << 24)  # opaque type 7, opaque id 0
+        for area in self.areas.values():
+            entries = []
+            for iface in area.interfaces.values():
+                if iface.state == IsmState.DOWN:
+                    continue
+                addrs = []
+                if iface.prefix is not None:
+                    addrs.append(iface.prefix)
+                addrs.extend(iface.secondary)
+                for prefix in addrs:
+                    if (
+                        iface.config.node_flag
+                        and prefix.prefixlen == 32
+                    ):
+                        entries.append((prefix, EXT_PREFIX_FLAG_N))
+                    elif iface.config.anycast_flag:
+                        entries.append((prefix, EXT_PREFIX_FLAG_AC))
+            if entries:
+                body = LsaOpaque(encode_ext_prefix_flags(sorted(
+                    entries, key=lambda e: (int(e[0].network_address), e[0].prefixlen)
+                )))
+                self._originate(area, LsaType.OPAQUE_AREA, lsid, body)
+            else:
+                key = LsaKey(
+                    LsaType.OPAQUE_AREA, lsid, self.config.router_id
+                )
+                self._flush_self_lsa(area, key)
+
     # ----- BIER underlay (RFC 9089 over RFC 7684 LSAs)
 
     def _originate_bier(self) -> None:

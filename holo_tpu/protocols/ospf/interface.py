@@ -53,6 +53,11 @@ class IfConfig:
     mtu: int = 1500
     bfd_enabled: bool = False
     auth: object = None  # AuthCtx (packet.py) or None
+    # RFC 7684 prefix attribute flags advertised in extended-prefix
+    # opaque LSAs: N marks a node host address, AC an anycast address
+    # (reference ospfv2/lsdb.rs:760-783, iana.rs LsaExtPrefixFlags).
+    node_flag: bool = False
+    anycast_flag: bool = False
 
 
 @dataclass

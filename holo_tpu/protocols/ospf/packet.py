@@ -256,13 +256,19 @@ def ext_prefix_lsid(opaque_id: int) -> IPv4Address:
     return IPv4Address((EXT_PREFIX_OPAQUE_TYPE << 24) | (opaque_id & 0xFFFFFF))
 
 
-def _encode_ext_prefix_tlv1(prefix, sub_tlvs: bytes) -> bytes:
-    """Extended-Prefix TLV (1) framing shared by the SR and BIER
+# Extended-prefix attribute flags (RFC 7684/9085; reference iana.rs).
+EXT_PREFIX_FLAG_A = 0x80  # attach
+EXT_PREFIX_FLAG_N = 0x40  # node
+EXT_PREFIX_FLAG_AC = 0x10  # anycast
+
+
+def _encode_ext_prefix_tlv1(prefix, sub_tlvs: bytes, flags: int = 0) -> bytes:
+    """Extended-Prefix TLV (1) framing shared by the SR/BIER/flag
     encoders (RFC 7684 §2.1)."""
     w = Writer()
     body = Writer()
     plen = prefix.prefixlen
-    body.u8(1).u8(plen).u8(0).u8(0)  # route-type ignored, af 0 (v4)
+    body.u8(1).u8(plen).u8(0).u8(flags)  # route-type IntraArea, af 0
     nbytes = (plen + 7) // 8
     body.bytes(prefix.network_address.packed[:nbytes])
     body.zeros((4 - nbytes % 4) % 4)
@@ -271,10 +277,20 @@ def _encode_ext_prefix_tlv1(prefix, sub_tlvs: bytes) -> bytes:
     return w.finish()
 
 
-def _walk_ext_prefix_tlv1(data: bytes):
-    """Yield (IPv4Network prefix, Reader over sub-TLVs) for each
-    Extended-Prefix TLV; host bits below the prefix length are masked
-    off (foreign advertisements may carry them)."""
+def encode_ext_prefix_flags(entries) -> bytes:
+    """One Extended-Prefix TLV per (prefix, flags) pair — the N/AC
+    attribute advertisement (reference ospfv2/lsdb.rs:760-800)."""
+    out = b""
+    for prefix, flags in entries:
+        out += _encode_ext_prefix_tlv1(prefix, b"", flags=flags)
+    return out
+
+
+def _walk_ext_prefix_tlv1(data: bytes, with_meta: bool = False):
+    """Yield (prefix, sub-TLV Reader) — or (prefix, route_type, flags,
+    Reader) with ``with_meta`` — for each Extended-Prefix TLV; host bits
+    below the prefix length are masked off (foreign advertisements may
+    carry them)."""
     from ipaddress import IPv4Network
 
     r = Reader(data)
@@ -284,10 +300,10 @@ def _walk_ext_prefix_tlv1(data: bytes):
         body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
         if t != 1 or body.remaining() < 4:
             continue
-        body.u8()  # route type
+        route_type = body.u8()
         plen = body.u8()
-        body.u8()
-        body.u8()
+        body.u8()  # AF
+        flags = body.u8()
         if plen > 32:
             continue
         nbytes = (plen + 7) // 8
@@ -300,7 +316,33 @@ def _walk_ext_prefix_tlv1(data: bytes):
         val = int.from_bytes(raw, "big")
         if plen < 32:
             val &= ~((1 << (32 - plen)) - 1)
-        yield IPv4Network((val, plen)), body
+        prefix = IPv4Network((val, plen))
+        if with_meta:
+            yield prefix, route_type, flags, body
+        else:
+            yield prefix, body
+
+
+def decode_ext_prefix_entries(data: bytes) -> list:
+    """All Extended-Prefix TLVs of an opaque LSA, fully parsed:
+    [(prefix, route_type, flags, {sid_index: sid_flags})]."""
+    out = []
+    for prefix, route_type, flags, body in _walk_ext_prefix_tlv1(
+        data, with_meta=True
+    ):
+        sids = {}
+        while body.remaining() >= 4:
+            st = body.u16()
+            sl = body.u16()
+            sbody = body.sub(min((sl + 3) // 4 * 4, body.remaining()))
+            if st == 2 and sbody.remaining() >= 8:
+                sid_flags = sbody.u8()
+                sbody.u8()
+                sbody.u8()
+                sbody.u8()
+                sids[sbody.u32()] = sid_flags
+        out.append((prefix, route_type, flags, sids))
+    return out
 
 
 def encode_ext_prefix_sid(prefix, sid_index: int, flags: int = 0) -> bytes:

@@ -213,6 +213,25 @@ def lsa_body_to_json(lsa: Lsa):
             }
         }
     if isinstance(body, LsaOpaque) and t == LsaType.OPAQUE_AREA and (
+        int(lsa.lsid) >> 24 == 7
+    ):
+        from holo_tpu.protocols.ospf.packet import decode_ext_prefix_entries
+
+        _RT = {0: "Unspecified", 1: "IntraArea", 3: "InterArea",
+               5: "AsExternal", 7: "NssaExternal"}
+        _PF = {"A": 0x80, "N": 0x40, "AC": 0x10}
+        prefixes = {}
+        for prefix, rt, flags, sids in decode_ext_prefix_entries(body.data):
+            prefixes[str(prefix)] = {
+                "route_type": _RT.get(rt, "Unspecified"),
+                "af": 0,
+                "flags": _flags_to_str(flags, _PF),
+                "prefix": str(prefix),
+                "prefix_sids": {},
+                "unknown_tlvs": [],
+            }
+        return {"OpaqueArea": {"ExtPrefix": {"prefixes": prefixes}}}
+    if isinstance(body, LsaOpaque) and t == LsaType.OPAQUE_AREA and (
         int(lsa.lsid) >> 24 == 4
     ):
         from holo_tpu.protocols.ospf.packet import decode_router_info

@@ -799,8 +799,18 @@ class CaseRun:
                 if op_of(if_node, "cost") in ("replace", "create"):
                     iface.config.cost = if_node["cost"]
                     self.inst._originate_router_lsa(area)
+                ac_key = "ietf-ospf-anycast-flag:anycast-flag"
+                if op_of(if_node, ac_key) in ("replace", "create"):
+                    iface.config.anycast_flag = bool(if_node[ac_key])
+                    self.inst.update_ext_prefix_flags()
+                nf_key = "ietf-ospf-node-flag:node-flag"
+                if op_of(if_node, nf_key) in ("replace", "create"):
+                    iface.config.node_flag = bool(if_node[nf_key])
+                    self.inst.update_ext_prefix_flags()
                 for key in if_node:
-                    if key.startswith("@") and key not in ("@", "@cost"):
+                    if key.startswith("@") and key not in (
+                        "@", "@cost", "@" + ac_key, "@" + nf_key,
+                    ):
                         unhandled.append(f"iface leaf {key[1:]}")
             for key in area_node:
                 if key.startswith("@") and key not in (

@@ -26,7 +26,7 @@ KNOWN_PASS = [
     "packet-hello-validation1",
     "packet-area-mismatch1",
 ]
-PASS_FLOOR = 85
+PASS_FLOOR = 86
 
 
 def test_known_cases_pass():
