@@ -1041,11 +1041,77 @@ class OspfV3Instance(Actor):
             self._spf_pending = True
             self._spf_timer.start(0.1)
 
+    @staticmethod
+    def _expand_atoms(words, atoms) -> frozenset:
+        """Atom bits -> next-hop tuples; NexthopAtom vlink atoms expand
+        to their borrowed transit-area set (§16.1), same typed design
+        as the v2 marshaling (spf_run.NexthopAtom.expand)."""
+        from holo_tpu.protocols.ospf.spf_run import NexthopAtom
+
+        out = set()
+        for a in atom_bits(words, len(atoms)):
+            atom = atoms[a]
+            if isinstance(atom, NexthopAtom):
+                if atom.expand:
+                    out |= atom.expand
+            else:
+                out.add(atom)
+        return frozenset(out)
+
+    def _vlink_nexthops(self, backbone: V3Area, area_results: dict) -> dict:
+        """{vlink peer rid: frozenset[(ifname, ll)]} from each transit
+        area's path to the peer (mirrors the v2 instance §16.1 logic;
+        our backbone router-LSA names the vlink peers)."""
+        from holo_tpu.ops.graph import INF
+
+        now = self.loop.clock.now()
+        peers = set()
+        for e in backbone.lsdb.all():
+            lsa = e.lsa
+            if (
+                lsa.type == P.LsaType.ROUTER
+                and lsa.adv_rtr == self.router_id
+                and e.current_age(now) < P.MAX_AGE
+            ):
+                for link in lsa.body.links:
+                    if link.link_type == P.RouterLinkType.VIRTUAL_LINK:
+                        peers.add(link.nbr_router_id)
+        best: dict = {}
+        for rid in peers:
+            for aid, (index, _k, res, atoms, _pl) in area_results.items():
+                if aid == IPv4Address(0):
+                    continue
+                v = index.get(("R", rid))
+                if v is None or res.dist[v] >= INF:
+                    continue
+                nhs = self._expand_atoms(res.nexthop_words[v], atoms)
+                if not nhs:
+                    continue
+                dist = int(res.dist[v])
+                cur = best.get(rid)
+                if cur is None or dist < cur[0]:
+                    best[rid] = (dist, nhs)
+                elif dist == cur[0]:
+                    # Parallel virtual links through different transit
+                    # areas at equal cost: ECMP union (topo3-3 shape).
+                    best[rid] = (dist, cur[1] | nhs)
+        return {rid: nhs for rid, (_d, nhs) in best.items()}
+
     def run_spf(self) -> None:
         self.spf_run_count += 1
         area_results = {}
-        for area in self.areas.values():
-            out = self._area_spf(area)
+        # Backbone last: its SPF borrows transit-area next hops for
+        # virtual links (§16.1), like the v2 instance.
+        ordered = sorted(
+            self.areas.values(), key=lambda a: int(a.area_id) == 0
+        )
+        for area in ordered:
+            vlink_nexthops = None
+            if int(area.area_id) == 0:
+                vlink_nexthops = self._vlink_nexthops(
+                    area, area_results
+                )
+            out = self._area_spf(area, vlink_nexthops)
             if out is not None:
                 area_results[area.area_id] = out
 
@@ -1063,10 +1129,7 @@ class OspfV3Instance(Actor):
                     continue
                 if v is None or res.dist[v] >= INF:
                     continue
-                nhs = frozenset(
-                    atoms[a]
-                    for a in atom_bits(res.nexthop_words[v], len(atoms))
-                )
+                nhs = self._expand_atoms(res.nexthop_words[v], atoms)
                 for prefix, metric in body.prefixes:
                     total = int(res.dist[v]) + metric
                     cur = intra.get(prefix)
@@ -1110,9 +1173,8 @@ class OspfV3Instance(Actor):
                 if prefix in routes and prefix not in inter_routes:
                     continue  # intra-area wins
                 dist = int(res.dist[abr_v]) + lsa.body.metric
-                nhs = frozenset(
-                    atoms[a]
-                    for a in atom_bits(res.nexthop_words[abr_v], len(atoms))
+                nhs = self._expand_atoms(
+                    res.nexthop_words[abr_v], atoms
                 )
                 cur = inter_routes.get(prefix)
                 if cur is None or dist < cur.dist:
@@ -1145,11 +1207,8 @@ class OspfV3Instance(Actor):
                 asbr_v = index.get(("R", lsa.adv_rtr))
                 if asbr_v is not None and res.dist[asbr_v] < INF:
                     asbr_dist = int(res.dist[asbr_v])
-                    nhs = frozenset(
-                        atoms[a]
-                        for a in atom_bits(
-                            res.nexthop_words[asbr_v], len(atoms)
-                        )
+                    nhs = self._expand_atoms(
+                        res.nexthop_words[asbr_v], atoms
                     )
                 else:
                     # ASBR outside this area: resolve through an ABR's
@@ -1299,10 +1358,7 @@ class OspfV3Instance(Actor):
             if abr_v is None or res.dist[abr_v] >= INF:
                 continue
             dist = int(res.dist[abr_v]) + lsa.body.metric
-            nhs = frozenset(
-                atoms[a]
-                for a in atom_bits(res.nexthop_words[abr_v], len(atoms))
-            )
+            nhs = self._expand_atoms(res.nexthop_words[abr_v], atoms)
             if best is None or dist < best[0]:
                 best = (dist, nhs)
             elif dist == best[0]:
@@ -1337,7 +1393,7 @@ class OspfV3Instance(Actor):
         if not was_asbr:
             self._originate_router_lsa()
 
-    def _area_spf(self, area: V3Area):
+    def _area_spf(self, area: V3Area, vlink_nexthops: dict | None = None):
         """Per-area SPF: returns (index, keys, result, atoms, prefix_lsas)
         or None when we have no router LSA in the area."""
         now = self.loop.clock.now()
@@ -1364,6 +1420,8 @@ class OspfV3Instance(Actor):
         n = len(keys)
         is_router = np.array([k[0] == "R" for k in keys], bool)
         src, dst, cost = [], [], []
+        edge_kind = []  # per edge: router-link type int, or -1 (network)
+        edge_nbr_ifid = []  # p2p/vlink: the neighbor's iface id
         for rid, body in routers.items():
             u = index[("R", rid)]
             for link in body.links:
@@ -1377,6 +1435,8 @@ class OspfV3Instance(Actor):
                     src.append(u)
                     dst.append(v)
                     cost.append(link.metric)
+                    edge_kind.append(int(link.link_type))
+                    edge_nbr_ifid.append(link.nbr_iface_id)
         for (adv, ifid), body in networks.items():
             u = index[("N", adv, ifid)]
             for member in body.attached:
@@ -1385,23 +1445,43 @@ class OspfV3Instance(Actor):
                     src.append(u)
                     dst.append(v)
                     cost.append(0)
+                    edge_kind.append(-1)
+                    edge_nbr_ifid.append(0)
+        from holo_tpu.ops.graph import mutual_keep_mask
+
+        src_a = np.array(src, np.int32).reshape(-1)
+        dst_a = np.array(dst, np.int32).reshape(-1)
+        keep = mutual_keep_mask(src_a, dst_a)
+        edge_kind = [k for k, kp in zip(edge_kind, keep) if kp]
+        edge_nbr_ifid = [
+            i for i, kp in zip(edge_nbr_ifid, keep) if kp
+        ]
         topo = Topology(
             n_vertices=n,
             is_router=is_router,
-            edge_src=np.array(src, np.int32).reshape(-1),
-            edge_dst=np.array(dst, np.int32).reshape(-1),
-            edge_cost=np.array(cost, np.int32).reshape(-1),
+            edge_src=src_a[keep],
+            edge_dst=dst_a[keep],
+            edge_cost=np.array(cost, np.int32).reshape(-1)[keep],
             root=index[("R", self.router_id)],
-        ).filter_mutual()
+        )
 
         atoms = []
         atom_ids = np.full(topo.n_edges, -1, np.int32)
-        nbr_hop = {}
+        # Per-link hop resolution: parallel p2p links to the same
+        # neighbor are distinct atoms, matched by the neighbor's
+        # interface id carried in its hellos (and in our router-LSA's
+        # link entries) so each link's atom rides the right interface.
+        nbr_hop = {}  # rid -> (ifname, src) — any one link (fallback)
+        nbr_hop_by_ifid = {}  # (rid, nbr iface id) -> (ifname, src)
         lan_iface_of = {}  # network vertex key -> our iface on that LAN
         for iface in self._area_ifaces(area):
             for nbr in iface.neighbors.values():
                 if nbr.state == NsmState.FULL and not iface.is_lan:
                     nbr_hop[nbr.router_id] = (iface.name, nbr.src)
+                    nbr_hop_by_ifid[(nbr.router_id, nbr.iface_id)] = (
+                        iface.name,
+                        nbr.src,
+                    )
             if iface.is_lan and self._transit_active(iface):
                 lan_iface_of[
                     ("N", iface.dr, self._dr_iface_id(iface))
@@ -1411,7 +1491,24 @@ class OspfV3Instance(Actor):
             if topo.edge_src[e_i] == topo.root:
                 k = keys[int(topo.edge_dst[e_i])]
                 if k[0] == "R":
-                    hop = nbr_hop.get(k[1])
+                    hop = None
+                    if edge_kind[e_i] == int(
+                        P.RouterLinkType.VIRTUAL_LINK
+                    ):
+                        # Virtual link: borrowed transit-area set only —
+                        # a direct-adjacency fallback here would pair
+                        # the vlink metric with the wrong next hop.
+                        borrowed = (vlink_nexthops or {}).get(k[1])
+                        if borrowed:
+                            from holo_tpu.protocols.ospf.spf_run import (
+                                NexthopAtom,
+                            )
+
+                            hop = NexthopAtom(None, None, borrowed)
+                    else:
+                        hop = nbr_hop_by_ifid.get(
+                            (k[1], edge_nbr_ifid[e_i])
+                        ) or nbr_hop.get(k[1])
                     if hop is not None:
                         atom_ids[e_i] = len(atoms)
                         atoms.append(hop)

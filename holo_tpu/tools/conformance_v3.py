@@ -1,0 +1,416 @@
+"""OSPFv3 reference-conformance: replay recorded topologies, compare RIBs.
+
+Consumes /root/reference/holo-ospf/tests/conformance/ospfv3/topologies
+(7 topologies, 44 routers: single/multi-area, stub areas, p2p and LAN
+circuits) the same way tools/conformance.py does for OSPFv2:
+
+1. Decode every recorded LSA's raw wire bytes with OUR v3 codec and
+   union them into the converged per-area LSDB (newest copy per key).
+2. Rebuild each router's local view — interfaces in config order so our
+   interface ids line up with the recorded ``iface_key`` ids, FULL
+   neighbors synthesized from the recorded hellos (router-id, link-local
+   source, and the neighbor's interface id from the hello body).
+3. Run OUR v3 SPF + route derivation and compare (prefix, metric,
+   next-hop set) against the reference's expected ``local-rib``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from ipaddress import (
+    IPv4Address,
+    IPv6Address,
+    IPv6Network,
+    ip_interface,
+)
+from pathlib import Path
+
+from holo_tpu.protocols.ospf.instance_v3 import (
+    OspfV3Instance,
+    V3IfConfig,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.neighbor import Neighbor, NsmState
+from holo_tpu.protocols.ospf.packet_v3 import Lsa
+from holo_tpu.utils.bytesbuf import Reader
+from holo_tpu.utils.netio import NetIo
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+V3_DIR = Path(
+    "/root/reference/holo-ospf/tests/conformance/ospfv3/topologies"
+)
+
+
+def _loads_lenient(text: str):
+    return json.JSONDecoder().raw_decode(text)[0]
+
+
+def _area_id(v) -> IPv4Address:
+    if isinstance(v, dict):
+        return IPv4Address(v.get("Id", 0))
+    return IPv4Address(v)
+
+
+@dataclass
+class ExpectedRoute:
+    prefix: IPv6Network
+    metric: int
+    route_type: str
+    nexthops: frozenset  # {(ifname, IPv6Address|None)}
+
+
+@dataclass
+class RouterData:
+    name: str
+    router_id: IPv4Address = None
+    # config order: [(area_id, ifname, iface cfg dict, stub)]
+    ifaces: list = field(default_factory=list)
+    area_ids: list = field(default_factory=list)  # all configured areas
+    # ifname -> (link_local, [global prefixes])
+    addrs: dict = field(default_factory=dict)
+    # iface slot id (1-based, config order) -> [(router_id, src_ll,
+    #                                            nbr_iface_id)]
+    hellos: dict = field(default_factory=dict)
+    # area id -> [Lsa]
+    rx_lsas: dict = field(default_factory=dict)
+    expected: list = field(default_factory=list)
+
+
+def load_router(rt_dir: Path) -> RouterData:
+    rd = RouterData(name=rt_dir.name)
+    cfg = _loads_lenient((rt_dir / "config.json").read_text())
+    proto = cfg["ietf-routing:routing"]["control-plane-protocols"][
+        "control-plane-protocol"
+    ][0]
+    ospf = proto["ietf-ospf:ospf"]
+    rd.router_id = IPv4Address(ospf["explicit-router-id"])
+    for area in ospf.get("areas", {}).get("area", []):
+        aid = IPv4Address(area["area-id"])
+        stub = "stub" in (area.get("area-type") or "")
+        rd.area_ids.append(aid)
+        for iface in area.get("interfaces", {}).get("interface", []):
+            rd.ifaces.append((aid, iface["name"], iface, stub))
+
+    ll, globs = {}, {}
+    for line in (rt_dir / "events.jsonl").read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        ev = _loads_lenient(line)
+        ibus = ev.get("Ibus")
+        if ibus and "InterfaceAddressAdd" in ibus:
+            upd = ibus["InterfaceAddressAdd"]
+            try:
+                addr = ip_interface(upd["addr"])
+            except ValueError:
+                continue
+            if addr.version != 6:
+                continue
+            if addr.ip.is_link_local:
+                ll.setdefault(upd["ifname"], addr.ip)
+            else:
+                globs.setdefault(upd["ifname"], []).append(addr.network)
+        pkt_ev = (ev.get("Protocol") or {}).get("NetRxPacket")
+        if pkt_ev:
+            packet = (pkt_ev.get("packet") or {}).get("Ok") or {}
+            iface_id = (pkt_ev.get("iface_key") or {}).get("Id")
+            hello = packet.get("Hello")
+            if hello is not None and iface_id is not None:
+                rd.hellos.setdefault(iface_id, []).append(
+                    (
+                        IPv4Address(hello["hdr"]["router_id"]),
+                        IPv6Address(pkt_ev["src"]),
+                        hello.get("iface_id", 0),
+                    )
+                )
+            upd = packet.get("LsUpdate")
+            if upd is not None:
+                aid = IPv4Address(upd["hdr"]["area_id"])
+                for lsa_obj in upd.get("lsas", []):
+                    raw = bytes(lsa_obj["raw"])
+                    try:
+                        lsa = Lsa.decode(Reader(raw))
+                    except Exception:  # noqa: BLE001 — foreign types
+                        continue
+                    rd.rx_lsas.setdefault(aid, []).append(lsa)
+    for ifname in set(ll) | set(globs):
+        rd.addrs[ifname] = (
+            ll.get(ifname),
+            globs.get(ifname, []),
+        )
+
+    state = _loads_lenient(
+        (rt_dir / "output" / "northbound-state.json").read_text()
+    )
+    ospf_state = state["ietf-routing:routing"]["control-plane-protocols"][
+        "control-plane-protocol"
+    ][0]["ietf-ospf:ospf"]
+    for route in ospf_state.get("local-rib", {}).get("route", []):
+        nhs = set()
+        for nh in route.get("next-hops", {}).get("next-hop", []):
+            addr = nh.get("next-hop")
+            nhs.add(
+                (
+                    nh.get("outgoing-interface"),
+                    IPv6Address(addr) if addr else None,
+                )
+            )
+        rd.expected.append(
+            ExpectedRoute(
+                prefix=IPv6Network(route["prefix"]),
+                metric=route.get("metric", 0),
+                route_type=route.get("route-type", ""),
+                nexthops=frozenset(nhs),
+            )
+        )
+    return rd
+
+
+def load_topology(topo_dir: Path) -> dict[str, RouterData]:
+    return {
+        rt.name: load_router(rt)
+        for rt in sorted(topo_dir.iterdir())
+        if rt.is_dir() and (rt / "events.jsonl").exists()
+    }
+
+
+def link_lsa_map(routers: dict[str, RouterData]) -> dict:
+    """(adv_rtr, originator's iface id) -> link-local address, from every
+    Link-LSA recorded anywhere in the topology (RFC 5340 §4.4.3.8: the
+    link-state id of a Link-LSA is the originating interface's id)."""
+    from holo_tpu.protocols.ospf.packet_v3 import LsaLink
+
+    out = {}
+    for rd in routers.values():
+        for lsas in rd.rx_lsas.values():
+            for lsa in lsas:
+                if isinstance(lsa.body, LsaLink):
+                    out[(lsa.adv_rtr, int(lsa.lsid))] = (
+                        lsa.body.link_local
+                    )
+    return out
+
+
+def converged_lsdb(routers: dict[str, RouterData]) -> dict:
+    out: dict = {}
+    for rd in routers.values():
+        for aid, lsas in rd.rx_lsas.items():
+            area = out.setdefault(aid, {})
+            for lsa in lsas:
+                cur = area.get(lsa.key)
+                if cur is None or lsa.compare(cur) > 0:
+                    area[lsa.key] = lsa
+    return out
+
+
+class _NullIo(NetIo):
+    def send(self, *a):
+        pass
+
+
+def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
+    loop = EventLoop(clock=VirtualClock())
+    inst = OspfV3Instance(
+        name=f"conf3-{rd.name}", router_id=rd.router_id, netio=_NullIo()
+    )
+    loop.register(inst)
+
+    # Bind every recorded hello to the right local interface by chaining
+    # through the LSDB (the recorded iface_key ids are arena keys in a
+    # different id space than the protocol's interface ids):
+    #   hello src link-local --(Link-LSAs)--> (nbr router-id, nbr ifid)
+    #   --(our router-LSA p2p/transit entry)--> our protocol iface id
+    #   --(our Link-LSA)--> our link-local --> our interface name.
+    ll_to_ref = {ll: key for key, ll in ll_map.items()}
+    our_ll_by_refid = {
+        ref_id: ll
+        for (adv, ref_id), ll in ll_map.items()
+        if adv == rd.router_id
+    }
+    ifname_by_ll = {
+        ll: ifname
+        for ifname, (ll, _g) in rd.addrs.items()
+        if ll is not None
+    }
+    our_links = []
+    for lsas in lsdb_by_area.values():
+        for lsa in lsas.values():
+            if (
+                lsa.adv_rtr == rd.router_id
+                and type(lsa.body).__name__ == "LsaRouterV3"
+            ):
+                our_links.extend(lsa.body.links)
+    nbrs_by_ifname: dict = {}
+    for key_hellos in rd.hellos.values():
+        for router_id, src, nbr_iface_id in key_hellos:
+            ref = ll_to_ref.get(src)
+            our_ifid = None
+            if ref is not None:
+                nbr_rid, nbr_ifid = ref
+                for link in our_links:
+                    if (
+                        link.nbr_router_id == nbr_rid
+                        and link.nbr_iface_id == nbr_ifid
+                    ):
+                        our_ifid = link.iface_id
+                        break
+                else:
+                    # LAN: our transit entry names the DR, not each
+                    # neighbor — find the network LSA whose attached
+                    # list contains this neighbor, then the transit
+                    # link referencing that (DR, DR-ifid) pair.
+                    lan_keys = set()
+                    for lsas in lsdb_by_area.values():
+                        for lsa in lsas.values():
+                            if (
+                                type(lsa.body).__name__
+                                == "LsaNetworkV3"
+                                and nbr_rid in lsa.body.attached
+                            ):
+                                lan_keys.add(
+                                    (lsa.adv_rtr, int(lsa.lsid))
+                                )
+                    for link in our_links:
+                        if int(link.link_type) == 2 and (
+                            link.nbr_router_id,
+                            link.nbr_iface_id,
+                        ) in lan_keys:
+                            our_ifid = link.iface_id
+                            break
+            ifname = None
+            if our_ifid is not None:
+                ll = our_ll_by_refid.get(our_ifid)
+                ifname = ifname_by_ll.get(ll)
+            if ifname is not None:
+                nbrs_by_ifname.setdefault(ifname, []).append(
+                    (router_id, src, nbr_iface_id)
+                )
+
+    for aid, ifname, icfg, stub in rd.ifaces:
+        link_local, prefixes = rd.addrs.get(ifname, (None, []))
+        if link_local is None:
+            link_local = IPv6Address("fe80::1")
+        if_type = (
+            IfType.POINT_TO_POINT
+            if icfg.get("interface-type") == "point-to-point"
+            else IfType.BROADCAST
+        )
+        iface = inst.add_interface(
+            ifname,
+            V3IfConfig(area_id=aid, if_type=if_type),
+            link_local,
+            prefixes,
+            stub=stub,
+        )
+        iface.up = True
+        # Use the reference's interface id (from our own Link-LSA) so
+        # self-originated network-vertex keys line up with the LSDB.
+        ref = ll_to_ref.get(link_local)
+        if ref is not None and ref[0] == rd.router_id:
+            iface.iface_id = ref[1]
+        for router_id, src, nbr_iface_id in nbrs_by_ifname.get(
+            ifname, []
+        ):
+            nbr = iface.neighbors.get(router_id)
+            if nbr is None:
+                nbr = Neighbor(
+                    router_id=router_id, src=src, state=NsmState.FULL
+                )
+                iface.neighbors[router_id] = nbr
+            nbr.iface_id = nbr_iface_id
+        # LAN DR from the converged network LSAs: the LSA whose
+        # (originator, iface id) matches one of this LAN's neighbors —
+        # or our own interface — names the DR.
+        if if_type == IfType.BROADCAST:
+            for lsas in lsdb_by_area.values():
+                for lsa in lsas.values():
+                    if type(lsa.body).__name__ != "LsaNetworkV3":
+                        continue
+                    adv, lsid = lsa.adv_rtr, int(lsa.lsid)
+                    if adv == rd.router_id and lsid == iface.iface_id:
+                        iface.dr = adv
+                    else:
+                        nbr = iface.neighbors.get(adv)
+                        if nbr is not None and nbr.iface_id == lsid:
+                            iface.dr = adv
+
+    # Configured areas without interfaces (a virtual-link-attached
+    # backbone, reference topo3) still hold an LSDB and join route calc.
+    from holo_tpu.protocols.ospf.instance_v3 import V3Area
+
+    for aid in rd.area_ids:
+        if aid not in inst.areas:
+            inst.areas[aid] = V3Area(aid)
+    for aid, lsas in lsdb_by_area.items():
+        if aid not in inst.areas:
+            continue
+        for lsa in lsas.values():
+            inst.areas[aid].lsdb.install(lsa, 0.0)
+    inst.run_spf()
+    return inst.routes
+
+
+def compare_router(rd: RouterData, routes: dict) -> list[str]:
+    problems = []
+    expected_by_prefix = {e.prefix: e for e in rd.expected}
+    for prefix, exp in expected_by_prefix.items():
+        got = routes.get(prefix)
+        if got is None:
+            problems.append(f"missing route {prefix}")
+            continue
+        if got.dist != exp.metric:
+            problems.append(
+                f"{prefix}: metric {got.dist} != expected {exp.metric}"
+            )
+        ours = frozenset(
+            (nh[0], nh[1]) for nh in got.nexthops
+        )
+        want = exp.nexthops
+        # Local (metric-0) routes have no next hops on either side.
+        if want == frozenset() and not got.nexthops:
+            continue
+        if ours != want:
+            problems.append(
+                f"{prefix}: nexthops {sorted(map(str, ours))} != "
+                f"expected {sorted(map(str, want))}"
+            )
+    for prefix in routes.keys() - expected_by_prefix.keys():
+        problems.append(f"unexpected extra route {prefix}")
+    return problems
+
+
+def run_topology(topo_dir: Path) -> dict[str, list[str]]:
+    routers = load_topology(topo_dir)
+    lsdb = converged_lsdb(routers)
+    ll_map = link_lsa_map(routers)
+    results = {}
+    for name, rd in sorted(routers.items()):
+        try:
+            routes = compute_routes(rd, lsdb, ll_map)
+            results[name] = compare_router(rd, routes)
+        except Exception as e:  # noqa: BLE001 — sweep must not die
+            results[name] = [f"exception: {type(e).__name__}: {e}"]
+    return results
+
+
+def run_all() -> dict[str, list[str]]:
+    results = {}
+    for topo_dir in sorted(V3_DIR.iterdir()):
+        if not topo_dir.is_dir():
+            continue
+        for rt, problems in run_topology(topo_dir).items():
+            results[f"{topo_dir.name}/{rt}"] = problems
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    res = run_all()
+    ok = [k for k, v in res.items() if not v]
+    bad = {k: v for k, v in res.items() if v}
+    for k, v in sorted(bad.items()):
+        if "-v" in sys.argv:
+            print(f"FAIL {k}: {'; '.join(v[:4])[:400]}")
+    print(f"pass {len(ok)} fail {len(bad)} / {len(res)}")
