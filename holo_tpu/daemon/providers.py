@@ -1380,18 +1380,51 @@ class RoutingProvider(Provider, Actor):
         state = {"routing": {"rib": rib}}
         ospf = self.instances.get("ospfv2")
         if ospf is not None:
+            now = self.loop.clock.now() if self.loop else 0.0
+
+            def _lsdb_state(a):
+                out = []
+                for e in a.lsdb.all():
+                    lsa = e.lsa
+                    out.append(
+                        {
+                            "type": int(lsa.type),
+                            "lsa-id": str(lsa.lsid),
+                            "adv-router": str(lsa.adv_rtr),
+                            "seq-num": lsa.seq_no & 0xFFFFFFFF,
+                            "age": int(e.current_age(now)),
+                            "length": lsa.length,
+                        }
+                    )
+                return out
+
             state["routing"]["ospfv2"] = {
+                "router-id": str(ospf.config.router_id),
                 "spf-run-count": ospf.spf_run_count,
                 "spf-log": list(ospf.spf_log),
                 "is-abr": ospf.is_abr,
                 "areas": {
                     str(aid): {
+                        "area-type": (
+                            "nssa" if a.nssa
+                            else "stub" if a.stub
+                            else "normal"
+                        ),
                         "lsdb-count": len(a.lsdb.entries),
+                        "database": _lsdb_state(a),
                         "interfaces": {
                             i.name: {
                                 "state": i.state.name.lower(),
+                                "type": i.config.if_type.name.lower(),
+                                "cost": i.config.cost,
+                                "hello-interval": (
+                                    i.config.hello_interval
+                                ),
+                                "dead-interval": i.config.dead_interval,
+                                "passive": i.config.passive,
                                 "dr": str(i.dr),
                                 "bdr": str(i.bdr),
+                                "neighbor-count": len(i.neighbors),
                             }
                             for i in a.interfaces.values()
                         },
@@ -1399,10 +1432,34 @@ class RoutingProvider(Provider, Actor):
                     for aid, a in ospf.areas.items()
                 },
                 "neighbors": {
-                    str(n.router_id): {"state": n.state.name.lower(), "iface": i.name}
+                    str(n.router_id): {
+                        "state": n.state.name.lower(),
+                        "iface": i.name,
+                        "address": str(n.src),
+                        "dr": str(n.dr),
+                        "bdr": str(n.bdr),
+                        "priority": n.priority,
+                    }
                     for a in ospf.areas.values()
                     for i in a.interfaces.values()
                     for n in i.neighbors.values()
+                },
+                "local-rib": {
+                    str(prefix): {
+                        "metric": r.dist,
+                        "route-type": getattr(r, "route_type", ""),
+                        "next-hops": sorted(
+                            f"{nh.ifname or ''}:{nh.addr or ''}"
+                            for nh in r.nexthops
+                        ),
+                    }
+                    for prefix, r in ospf.routes.items()
+                },
+                "sr-labels": {
+                    str(prefix): label
+                    for prefix, (label, _r) in getattr(
+                        ospf, "sr_labels", {}
+                    ).items()
                 },
             }
         isis = self.instances.get("isis")
@@ -1410,12 +1467,30 @@ class RoutingProvider(Provider, Actor):
             state["routing"]["isis"] = {
                 "spf-run-count": isis.spf_run_count,
                 "lsdb-count": len(isis.lsdb),
+                "database": [
+                    {
+                        "lsp-id": lsp.lsp_id.hex()
+                        if hasattr(lsp.lsp_id, "hex")
+                        else str(lsp.lsp_id),
+                        "seq-num": lsp.seq_no,
+                        "lifetime": lsp.lifetime,
+                    }
+                    for lsp in (
+                        isis.lsdb.values()
+                        if hasattr(isis.lsdb, "values")
+                        else []
+                    )
+                ],
                 "adjacencies": {
                     i.name: [
                         {"sysid": a.sysid.hex(), "state": a.state.value}
                         for a in i.up_adjacencies()
                     ]
                     for i in isis.interfaces.values()
+                },
+                "hostnames": {
+                    k.hex() if hasattr(k, "hex") else str(k): v
+                    for k, v in getattr(isis, "hostnames", {}).items()
                 },
             }
         ldp = self.instances.get("ldp")
