@@ -33,11 +33,24 @@ import time
 
 import numpy as np
 
-STAGE_TIMEOUT = {"gather10k": 900, "blocked10k": 900, "latency": 600, "scale50k": 1500}
+STAGE_TIMEOUT = {
+    "gather10k": 1200,
+    "blocked10k": 900,
+    "latency": 600,
+    "scale50k": 1500,
+    "scale50k_packed": 1200,
+    "scale50k_fused": 1200,
+    "cpubaseline": 600,
+}
 
 
-def _device_responsive(timeout_s: float = 120.0) -> bool:
-    """Probe the default JAX platform in a subprocess with a hard timeout."""
+def _probe_once(timeout_s: float) -> bool:
+    """One fresh-subprocess probe of the default JAX platform.
+
+    Wedging is per-process on the axon relay: a fresh interpreter can
+    succeed minutes after another one hung, so each attempt must be a new
+    subprocess with its own hard timeout.
+    """
     code = (
         "import jax, numpy as np;"
         "print(float(jax.jit(lambda a: a + 1)"
@@ -50,6 +63,45 @@ def _device_responsive(timeout_s: float = 120.0) -> bool:
         return proc.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _device_responsive(
+    probe_timeout_s: float | None = None,
+    budget_s: float | None = None,
+    retry_sleep_s: float = 45.0,
+    history: list | None = None,
+) -> bool:
+    """Retry-probe the platform for up to ``budget_s`` before giving up.
+
+    The axon relay wedges for stretches and then recovers; a single probe
+    (rounds 1-2) turned transient wedges into CPU-fallback artifacts.  Spend
+    a bounded slice of the bench budget retrying with fresh subprocesses.
+    """
+    import os
+
+    if probe_timeout_s is None:
+        probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", 1500))
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
+        ok = _probe_once(probe_timeout_s)
+        if history is not None:
+            history.append(
+                {
+                    "attempt": attempt,
+                    "ok": ok,
+                    "took_s": round(time.monotonic() - t0, 1),
+                }
+            )
+        if ok:
+            return True
+        if time.monotonic() + retry_sleep_s + probe_timeout_s > deadline:
+            return False
+        time.sleep(retry_sleep_s)
 
 
 def _sync(x) -> float:
@@ -81,7 +133,7 @@ def _make(k, n_scenarios, seed=0):
     return topo, masks
 
 
-def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64):
+def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64, engine="fused"):
     import jax
 
     from holo_tpu.ops.graph import build_ell
@@ -92,7 +144,9 @@ def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64):
         device_graph_from_ell(build_ell(topo, n_atoms=n_atoms))
     )
     masks_dev = jax.device_put(masks)
-    step = jax.jit(lambda gr, ms: spf_whatif_batch(gr, topo.root, ms))
+    step = jax.jit(
+        lambda gr, ms: spf_whatif_batch(gr, topo.root, ms, engine=engine)
+    )
     out = step(g, masks_dev)
     _sync(out.dist)
     times = []
@@ -104,6 +158,7 @@ def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64):
     result = {
         "runs_per_sec": B / dt,
         "batch_ms": dt * 1e3,
+        "engine": engine,
         "times_ms": [round(t * 1e3, 2) for t in times],
     }
     if cpu_runs:
@@ -120,8 +175,21 @@ def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64):
 
 
 def stage_gather10k(k, B, cpu_runs):
+    """Sweep the three gather-path fixpoint engines at 10k; report all,
+    headline the fastest parity-ok one (compiles are cheap at this size)."""
     topo, masks = _make(k, B)
-    return _gather_run(topo, masks, cpu_runs)
+    rows = {}
+    for engine in ("fused", "packed", "seq"):
+        try:
+            rows[engine] = _gather_run(topo, masks, cpu_runs, engine=engine)
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            rows[engine] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    best = max(
+        (r for r in rows.values() if r.get("ok") and "runs_per_sec" in r),
+        key=lambda r: r["runs_per_sec"],
+        default={"ok": False, "error": "no engine succeeded"},
+    )
+    return best | {"sweep": rows}
 
 
 def _blocked_run(topo, masks, cpu_runs=0, reps=3):
@@ -171,33 +239,52 @@ def stage_blocked10k(k, B, cpu_runs):
 
 
 def stage_latency(k, B):
-    """Small-batch run on the faster (gather) engine: p50 time-to-result
-    for one SPF answer.  Every scenario's answer lands when the batch
-    completes, so the batch wall IS the per-answer latency.
+    """Honest p50 rows: (a) time-to-answer for a B-scenario batch (every
+    answer lands when the batch completes, so the batch wall IS the
+    per-answer latency), (b) a true single-run (B=1) TPU SPF, and (c) the
+    C++ scalar single-run p50 they compete with.
     """
     topo, masks = _make(k, B)
     r = _gather_run(topo, masks, cpu_runs=1, reps=7)
+    single = _gather_run(topo, masks[:1], cpu_runs=0, reps=7)
     return {
         "ok": r["ok"],
         "p50_ms": float(np.median(r["times_ms"])),
+        "amortized_per_answer_ms": float(np.median(r["times_ms"])) / B,
+        "tpu_single_run_p50_ms": float(np.median(single["times_ms"])),
         "cpu_p50_ms": r["cpu_p50_ms"],
         "batch": B,
     }
 
 
-def stage_scale50k(k, B, cpu_runs):
-    """BASELINE.md's target scale.  The gather engine (word-unrolled
-    next-hop stage) both compiles and outruns the block-sparse Pallas
-    path here; the blocked engine remains the fallback."""
+def stage_cpubaseline(k, runs):
+    """C++ scalar baseline only (no JAX device needed): the interpretable
+    row to lead with when the relay is down."""
+    topo, masks = _make(k, runs)
+    _, cpu_rps, cpu_p50 = _cpu_baseline(topo, masks, runs)
+    return {
+        "ok": True,
+        "cpu_runs_per_sec": cpu_rps,
+        "cpu_p50_ms": cpu_p50,
+        "n_vertices": int(topo.n_vertices),
+    }
+
+
+def stage_scale50k(k, B, cpu_runs, engine="seq"):
+    """BASELINE.md's target scale.  Each fixpoint engine gets its own
+    subprocess stage (50k compiles run ~minutes each); 'seq' keeps the
+    blocked-Pallas fallback as the insurance row."""
     topo, masks = _make(k, B)
     try:
-        return _gather_run(topo, masks, cpu_runs, reps=2, n_atoms=128)
+        return _gather_run(topo, masks, cpu_runs, reps=2, n_atoms=128, engine=engine)
     except Exception as e:  # noqa: BLE001 — compiler limits: fall back
         print(
-            f"scale50k: gather engine failed ({type(e).__name__}: "
+            f"scale50k[{engine}]: gather engine failed ({type(e).__name__}: "
             f"{str(e)[:200]}); falling back to blocked",
             file=sys.stderr,
         )
+        if engine != "seq":
+            raise
         return _blocked_run(topo, masks, cpu_runs, reps=2)
 
 
@@ -237,25 +324,60 @@ def main() -> None:
             "blocked10k": lambda: stage_blocked10k(k10, b10, cpu10),
             "latency": lambda: stage_latency(k10, blat),
             "scale50k": lambda: stage_scale50k(k50, b50, cpu50),
+            "scale50k_packed": lambda: stage_scale50k(
+                k50, b50, cpu50, engine="packed"
+            ),
+            "scale50k_fused": lambda: stage_scale50k(k50, b50, cpu50, engine="fused"),
+            "cpubaseline": lambda: stage_cpubaseline(k10, cpu10),
         }[stage]
         print(json.dumps(fn()))
         return
 
+    probe_history: list = []
     suffix = ""
-    if not _device_responsive():
-        # The whole default platform is dead: fall back to JAX-CPU inside
-        # the stages via env (clearly labeled) so the driver still gets a
-        # number instead of a hang.
+    if not _device_responsive(history=probe_history):
+        # The platform never answered a probe within the retry budget.
+        # Emit the cheap, interpretable artifact: the native C++ scalar
+        # baseline (no JAX device involved) as the headline row, plus a
+        # small JAX-CPU sanity run — NOT a full-size JAX-CPU slog.
         suffix = "_cpufallback"
 
-    extra: dict = {}
-    rows = ["gather10k", "blocked10k", "latency"] + ([] if small else ["scale50k"])
+    extra: dict = {"probe_history": probe_history}
     if suffix:
-        # Fallback runs JAX-on-CPU where the blocked engine would be in
-        # Pallas interpret mode (hopeless at 10k) — gather only, and small.
-        rows = ["gather10k"]
+        k10 = 20 if small else 90
+        cpu10 = 8 if small else 32
+        extra["cpubaseline"] = _run_stage("cpubaseline", small)
+        extra["gather10k_jaxcpu_small"] = _run_stage("gather10k", True, cpu=True)
+        base = extra["cpubaseline"]
+        n10 = base.get("n_vertices", "500" if small else "10125")
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"ospfv2_full_spf_cpp_scalar_baseline_runs_per_sec_"
+                        f"{n10}v_RELAY_DOWN"
+                    ),
+                    "value": round(base.get("cpu_runs_per_sec", 0.0), 2),
+                    "unit": "runs/s",
+                    "vs_baseline": 1.0 if base.get("ok") else 0.0,
+                    "extra": extra,
+                }
+            )
+        )
+        return
+
+    rows = ["gather10k", "blocked10k", "latency"] + (
+        [] if small else ["scale50k_packed", "scale50k_fused", "scale50k"]
+    )
     for name in rows:
-        extra[name] = _run_stage(name, small, cpu=bool(suffix))
+        extra[name] = _run_stage(name, small)
+        if name.startswith("scale50k") and extra[name].get("ok"):
+            # One good 50k row is enough: don't spend two more multi-minute
+            # compiles (relay time is the scarce resource) unless needed.
+            got = extra[name].get("runs_per_sec", 0)
+            cpu = extra[name].get("cpu_runs_per_sec", 0)
+            if cpu and got / cpu >= 50:
+                break
 
     n10 = "500" if small else "10125"
     blocked = extra.get("blocked10k", {})

@@ -218,20 +218,174 @@ def spf_one(
     )
 
 
+def spf_one_fused(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+    packed: bool = False,
+) -> SpfTensors:
+    """Full SPF with ALL fixpoints fused into ONE while_loop.
+
+    The sequential formulation (:func:`spf_one`) runs 2+W loops — dist,
+    hops, and one per next-hop word — each chasing ~diameter rounds with
+    one [N,K] gather per round.  Here every quantity is recomputed
+    Jacobi-style each round from the *same* gathered neighbor state:
+
+    - ``dist`` keeps the monotone min-accumulate relaxation;
+    - ``parent``/DAG membership are derived from the current ``dist``;
+    - ``hops`` and the next-hop words are *recomputed* (not accumulated)
+      from the gathered neighbor values, so values derived from stale
+      intermediate DAGs wash out once ``dist`` settles.
+
+    Termination: a state the round maps to itself satisfies every
+    fixpoint equation simultaneously (dist relaxation-stable + hops/nh
+    consistent along the settled, acyclic DAG), so "unchanged" == done.
+    hops and next-hop values chase the dist wavefront and settle a couple
+    of rounds behind it: total rounds ~= hop-diameter + small constant,
+    vs (2+W) x diameter across the sequential loops.
+
+    ``packed=False`` gathers each quantity separately (2+W gathers of a
+    [N] operand per round — same memory shape as the proven sequential
+    path).  ``packed=True`` stores the state as one int32[N, 2+W] array
+    and performs a SINGLE row gather per round ([N,K] indices fetching
+    2+W contiguous lanes each) — ~(2+W)x fewer gather index operations
+    per round, the dominant cost on TPU (see memory notes) — at the risk
+    of a larger [N,K,C] intermediate at 50k-vertex scale.
+
+    Reference semantics preserved: holo-ospf/src/spf.rs:587-767.
+    """
+    n, k = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    c = 2 + w
+    ok = _slot_mask(g, edge_mask)
+    # Worst case the quantities settle strictly in sequence (dist, then
+    # hops, then nh), each taking up to ~n rounds on a path graph.
+    limit = (3 * n + 6) if max_iters is None else max_iters
+
+    big = jnp.int32(n + 1)
+    vidx = jnp.arange(n)
+    not_root = vidx != root
+    inc = g.is_router.astype(jnp.int32)
+    # nh words live in int32 lanes (bitwise ops are representation-exact);
+    # bitcast back to uint32 on exit.
+    direct_i32 = jax.lax.bitcast_convert_type(g.direct_nh_words, jnp.int32)
+
+    dist0 = jnp.full((n,), INF, jnp.int32).at[root].set(0)
+    hops0 = jnp.where(vidx == root, 0, big).astype(jnp.int32)
+    nh0 = jnp.zeros((n, w), jnp.int32)
+
+    def round_fn(dist, hops, nh):
+        if packed:
+            state = jnp.concatenate(
+                [dist[:, None], hops[:, None], nh], axis=1
+            )  # int32[N, C]
+            nbr = state[g.in_src]  # [N, K, C] — ONE gather
+            d_nbr = nbr[:, :, 0]
+            h_nbr = nbr[:, :, 1]
+            nh_nbr = [nbr[:, :, 2 + wi] for wi in range(w)]
+        else:
+            d_nbr = dist[g.in_src]
+            h_nbr = hops[g.in_src]
+            nh_nbr = [nh[:, wi][g.in_src] for wi in range(w)]
+
+        usable = ok & (d_nbr < INF)
+        cand = jnp.where(usable, d_nbr + g.in_cost, INF)
+        dist_new = jnp.minimum(dist, cand.min(axis=1))
+
+        dag = usable & (dist_new < INF)[:, None] & (
+            d_nbr + g.in_cost == dist_new[:, None]
+        )
+        dag = dag & not_root[:, None]
+
+        dmin = jnp.where(dag, d_nbr, INF).min(axis=1)
+        src_cand = jnp.where(dag & (d_nbr == dmin[:, None]), g.in_src, n)
+        parent = src_cand.min(axis=1).astype(jnp.int32)
+
+        # hops[parent] without a batch-dependent gather: every slot whose
+        # src == parent carries the same gathered hops value.
+        parent_slot = g.in_src == parent[:, None]
+        ph = jnp.where(parent_slot, h_nbr, big).min(axis=1)
+        hops_new = jnp.where(
+            vidx == root,
+            0,
+            jnp.where((parent < n) & (ph < big), ph + inc, big),
+        ).astype(jnp.int32)
+
+        use_direct = h_nbr == 0
+        direct_slot = dag & use_direct
+        inherit_slot = dag & ~use_direct
+        words = []
+        for wi in range(w):
+            seed_w = jax.lax.reduce(
+                jnp.where(direct_slot, direct_i32[:, :, wi], 0),
+                jnp.int32(0),
+                jax.lax.bitwise_or,
+                dimensions=(1,),
+            )
+            inh_w = jax.lax.reduce(
+                jnp.where(inherit_slot, nh_nbr[wi], 0),
+                jnp.int32(0),
+                jax.lax.bitwise_or,
+                dimensions=(1,),
+            )
+            words.append(seed_w | inh_w)
+        nh_new = jnp.stack(words, axis=1)
+        return dist_new, hops_new, nh_new, parent
+
+    def cond(carry):
+        _, _, _, _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        dist, hops, nh, _, _, it = carry
+        dist_new, hops_new, nh_new, parent = round_fn(dist, hops, nh)
+        changed = (
+            jnp.any(dist_new != dist)
+            | jnp.any(hops_new != hops)
+            | jnp.any(nh_new != nh)
+        )
+        return dist_new, hops_new, nh_new, parent, changed, it + 1
+
+    parent0 = jnp.full((n,), n, jnp.int32)
+    dist, hops, nh, parent, _, _ = jax.lax.while_loop(
+        cond, body, (dist0, hops0, nh0, parent0, jnp.bool_(True), 0)
+    )
+    return SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+
+
 def spf_whatif_batch(
     g: DeviceGraph,
     root: jax.Array,
     edge_masks: jax.Array,
     max_iters: int | None = None,
+    engine: str = "fused",
 ) -> SpfTensors:
     """Batched what-if SPF: vmap over scenario edge masks (bool[B, E]).
 
     This is the framework's data-parallel axis — e.g. 1024 concurrent
     link-failure studies over one LSDB (BASELINE.md config 5).  Remember to
     mask *both* directions of a failed link.
+
+    ``engine``: 'fused' (default — one fixpoint loop, separate gathers),
+    'packed' (one fixpoint loop, ONE row gather per round), or 'seq'
+    (the staged-loop formulation).
     """
-    fn = jax.vmap(lambda m: spf_one(g, root, m, max_iters))
+    one = _ONE_ENGINES[engine]
+    fn = jax.vmap(lambda m: one(g, root, m, max_iters))
     return fn(edge_masks)
+
+
+_ONE_ENGINES = {
+    "seq": spf_one,
+    "fused": spf_one_fused,
+    "packed": lambda g, r, m, mi: spf_one_fused(g, r, m, mi, packed=True),
+}
 
 
 def spf_multiroot(

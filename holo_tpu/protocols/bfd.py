@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import enum
 import hashlib
+import hmac
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address
 
@@ -211,12 +212,20 @@ class BfdPacket:
         if a is None:
             return False
         if a.auth_type == BfdAuthType.SIMPLE_PASSWORD:
-            return a.password == key
+            return hmac.compare_digest(a.password or b"", key)
         _len, dlen, algo = _AUTH_DIGEST_LEN[a.auth_type]
-        buf = bytearray(raw)
-        digest_pos = len(buf) - dlen
+        # The digest sits at (declared length - dlen): derive it from the
+        # packet's own length field (byte 3), not the datagram size —
+        # trailing bytes in the datagram must not shift the digest window.
+        declared = raw[3] if len(raw) > 3 else len(raw)
+        if declared < 24 + 8 + dlen or declared > len(raw):
+            return False
+        buf = bytearray(raw[:declared])
+        digest_pos = declared - dlen
         buf[digest_pos:] = key[:dlen].ljust(dlen, b"\x00")
-        return hashlib.new(algo, bytes(buf)).digest() == a.digest
+        return hmac.compare_digest(
+            hashlib.new(algo, bytes(buf)).digest(), a.digest
+        )
 
 
 @dataclass

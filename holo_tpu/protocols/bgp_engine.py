@@ -287,7 +287,25 @@ class BgpEngine:
     def get_router_id(self):
         return self.cfg_identifier or self.sys_router_id
 
+    def _instantiate_neighbor(self, addr: str) -> None:
+        cfg = self.neighbor_cfg[addr]
+        peer_type = "internal" if cfg.peer_as == self.asn else "external"
+        nbr = Neighbor(remote_addr=addr, peer_type=peer_type, config=cfg)
+        self.neighbors[addr] = nbr
+        # Enabled neighbors enter via the auto-start timer
+        # (neighbor.rs autostart_start; fires Timer::AutoStart).
+        nbr.autostart_active = cfg.enabled
+
+    def _neighbor_shutdown(self, nbr: Neighbor) -> None:
+        """Cease/administrative-shutdown close (neighbor.rs fsm Stop arm)."""
+        if nbr.state != IDLE:
+            self._session_close(nbr, notif=_notif_msg(6, 2))  # Cease/AdminShutdown
+            nbr.autostart_active = False
+            self._fsm_state_change(nbr, IDLE)
+
     def update(self) -> None:
+        """instance.rs update(): start when ready, stop when unconfigured,
+        and reconcile the neighbor set against config while active."""
         router_id = self.get_router_id()
         ready = self.asn != 0 and router_id is not None
         if ready and not self.active:
@@ -304,17 +322,27 @@ class BgpEngine:
                         },
                     )
             for addr in sorted(self.neighbor_cfg, key=_addr_key):
-                cfg = self.neighbor_cfg[addr]
-                peer_type = (
-                    "internal" if cfg.peer_as == self.asn else "external"
-                )
-                nbr = Neighbor(
-                    remote_addr=addr, peer_type=peer_type, config=cfg
-                )
-                self.neighbors[addr] = nbr
-                # Enabled neighbors enter via the auto-start timer
-                # (neighbor.rs autostart_start; fires Timer::AutoStart).
-                nbr.autostart_active = cfg.enabled
+                self._instantiate_neighbor(addr)
+        elif not ready and self.active:
+            # Instance stop (instance.rs stop path): close every session,
+            # drop neighbor state, clear the tables.
+            for addr in sorted(self.neighbors, key=_addr_key):
+                self._neighbor_shutdown(self.neighbors[addr])
+            self.neighbors.clear()
+            self.tables = {afs: Table() for afs in AFI_SAFIS}
+            self.active = False
+            self.router_id = None
+        elif ready and self.active:
+            self.router_id = router_id
+            for addr in sorted(
+                set(self.neighbor_cfg) - set(self.neighbors), key=_addr_key
+            ):
+                self._instantiate_neighbor(addr)
+            for addr in sorted(
+                set(self.neighbors) - set(self.neighbor_cfg), key=_addr_key
+            ):
+                nbr = self.neighbors.pop(addr)
+                self._neighbor_shutdown(nbr)
 
     # ---- FSM (neighbor.rs:221-470)
 

@@ -1274,48 +1274,50 @@ class LdpEngine:
         self.update()
 
     def iface_update(self, ifname: str, ifindex, operative: bool) -> None:
-        if not self.active:
-            return
+        # System data is tracked regardless of instance state (the
+        # reference keeps it outside the instance, ibus/rx.rs) — only the
+        # protocol side effects are gated on self.active.
         iface = self.interfaces.get(ifname)
         if iface is None:
             return
         iface.ifindex = ifindex
         iface.operative = operative
-        self.iface_check(iface)
+        if self.active:
+            self.iface_check(iface)
 
     def addr_add(
         self, ifname: str, prefix, unnumbered: bool = False
     ) -> None:
-        if not self.active:
-            return
         if prefix.version == 4:
             if not unnumbered and prefix not in self.ipv4_addr_list:
                 self.ipv4_addr_list.add(prefix)
-                for nbr in self._nbrs_sorted():
-                    if nbr.is_operational():
-                        self.send_address(nbr, False, [prefix.ip])
+                if self.active:
+                    for nbr in self._nbrs_sorted():
+                        if nbr.is_operational():
+                            self.send_address(nbr, False, [prefix.ip])
         iface = self.interfaces.get(ifname)
         if iface is not None and prefix.version == 4:
             if prefix not in iface.ipv4_addr_list:
                 iface.ipv4_addr_list.add(prefix)
-                self.iface_check(iface)
+                if self.active:
+                    self.iface_check(iface)
 
     def addr_del(
         self, ifname: str, prefix, unnumbered: bool = False
     ) -> None:
-        if not self.active:
-            return
         if prefix.version == 4:
             if not unnumbered and prefix in self.ipv4_addr_list:
                 self.ipv4_addr_list.discard(prefix)
-                for nbr in self._nbrs_sorted():
-                    if nbr.is_operational():
-                        self.send_address(nbr, True, [prefix.ip])
+                if self.active:
+                    for nbr in self._nbrs_sorted():
+                        if nbr.is_operational():
+                            self.send_address(nbr, True, [prefix.ip])
         iface = self.interfaces.get(ifname)
         if iface is not None and prefix.version == 4:
             if prefix in iface.ipv4_addr_list:
                 iface.ipv4_addr_list.discard(prefix)
-                self.iface_check(iface)
+                if self.active:
+                    self.iface_check(iface)
 
     def route_add(self, prefix, protocol: str, nexthops) -> None:
         """ibus/rx.rs process_route_add; nexthops: [(ifindex, addr)]."""

@@ -63,7 +63,7 @@ from holo_tpu.protocols.ospf.packet import (
 )
 from holo_tpu.protocols.ospf.spf_run import build_topology, derive_routes
 from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
-from holo_tpu.utils.ip import ALL_SPF_RTRS_V4, mask_of
+from holo_tpu.utils.ip import ALL_DR_RTRS_V4, ALL_SPF_RTRS_V4, mask_of
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
 
@@ -166,6 +166,14 @@ class InstanceConfig:
     # (frozen-clock replays carry no timestamps).
     deterministic_dd: bool = False
     min_ls_arrival: float = MIN_LS_ARRIVAL
+    # Two-phase origination (reference lsdb.rs LsaOriginateEvent →
+    # originate_check): triggers queue re-origination CHECKS; flushing
+    # rebuilds each LSA from current state and skips unchanged content.
+    # False (production): checks run immediately at the trigger site.
+    # True (conformance replay): checks accumulate until the harness
+    # flushes at the recorded LsaOrigCheck positions, reproducing the
+    # reference's exact instance counts.
+    external_orig_checks: bool = False
 
 
 @dataclass
@@ -178,7 +186,7 @@ class Area:
     # but type-7s circulate inside and the elected ABR translates them.
     stub: bool = False
     nssa: bool = False
-    stub_default_cost: int = 1
+    stub_default_cost: int = 10  # holo-ietf-ospf-deviations.yang:61-66
     # Totally-stubby variant: ABRs inject only the default summary into
     # the (stub/NSSA) area, no per-prefix type-3s (RFC 2328 §12.4.3.1).
     summary: bool = True
@@ -202,6 +210,15 @@ class ExternalRoute:
     tag: int = 0
 
 
+_PKT_TYPE_YANG = {
+    PacketType.HELLO: "hello",
+    PacketType.DB_DESC: "database-description",
+    PacketType.LS_REQUEST: "link-state-request",
+    PacketType.LS_UPDATE: "link-state-update",
+    PacketType.LS_ACK: "link-state-ack",
+}
+
+
 class OspfInstance(Actor):
     """One OSPFv2 routing process."""
 
@@ -213,10 +230,14 @@ class OspfInstance(Actor):
         spf_backend: SpfBackend | None = None,
         route_cb=None,
         nvstore=None,
+        notif_cb=None,
     ):
         self.name = name
         self.config = config
         self.netio = netio
+        # YANG notification sink: receives ietf-ospf notification dicts
+        # (reference holo-ospf/src/northbound/notification.rs).
+        self.notif_cb = notif_cb
         self.backend = spf_backend or ScalarSpfBackend()
         self.route_cb = route_cb  # callable(dict[prefix -> IntraRoute])
         self.areas: dict[IPv4Address, Area] = {}
@@ -251,6 +272,9 @@ class OspfInstance(Actor):
         # suppressed and pre-restart copies are adopted (not outpaced) so
         # helpers keep forwarding on the pre-restart topology.
         self.gr_restarting = False
+        # Admin state: False after a disable (operational state renders a
+        # minimal tree, like the reference's torn-down Instance).
+        self.enabled = True
         # SPF FSM state
         self.spf_state = SpfFsmState.QUIET
         self._spf_timer = None
@@ -282,6 +306,15 @@ class OspfInstance(Actor):
         # Shared opaque-id allocator for RFC 7684 extended-prefix LSAs:
         # keys are ("sr", prefix) and ("bier", sd_id); ids never reused.
         self._ext_prefix_opaque_ids: dict[tuple, int] = {}
+        # Which interface each link-scope (type 9) LSA belongs to, for
+        # per-interface operational-state grouping (state.rs link db).
+        self._link_scope_iface: dict[LsaKey, str] = {}
+        # Routers reachable per area in the last SPF (intra-area paths):
+        # serves abr-count/asbr-count (reference area.rs:164-182).
+        self._area_reachable_routers: dict[IPv4Address, set] = {}
+        # Deferred origination checks (see InstanceConfig.external_orig_checks):
+        # key -> kwargs, deduped so N triggers collapse into one rebuild.
+        self._pending_checks: dict[tuple, dict] = {}
 
     _SEQNO_WINDOW = 1 << 16
 
@@ -312,7 +345,7 @@ class OspfInstance(Actor):
         addr: IPv4Network,
         addr_ip: IPv4Address,
         stub: bool = False,
-        stub_default_cost: int = 1,
+        stub_default_cost: int = 10,  # deviation holo-ietf-ospf-deviations.yang:61-66
         nssa: bool = False,
     ) -> OspfInterface:
         """Area type is part of area creation — the stub/NSSA flags must
@@ -340,7 +373,7 @@ class OspfInstance(Actor):
             self._originate_router_info(area)
         return iface
 
-    def _originate_router_info(self, area: Area) -> None:
+    def _do_originate_router_info(self, area: Area) -> None:
         """RFC 7770 Router-Information opaque LSA (one per area).
 
         Advertises the informational capabilities the instance actually
@@ -526,6 +559,81 @@ class OspfInstance(Actor):
             if nbr.src == peer:
                 self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
 
+    # ----- YANG notifications (reference northbound/notification.rs)
+
+    def _notify(self, kind: str, data: dict) -> None:
+        if self.notif_cb is not None:
+            self.notif_cb({kind: data})
+
+    def _notif_iface(self, iface: OspfInterface) -> dict:
+        return {
+            "routing-protocol-name": self.name,
+            "address-family": "ipv4",
+            "interface": {"interface": iface.name},
+        }
+
+    def _set_ism_state(self, iface: OspfInterface, new: IsmState) -> None:
+        if iface.state == new:
+            return
+        iface.state = new
+        if iface.config.loopback:
+            # Loopback interfaces never run the ISM in the reference —
+            # no if-state-change notifications for them.
+            return
+        from holo_tpu.protocols.ospf.nb_state import _ISM_NAME
+
+        self._notify(
+            "ietf-ospf:if-state-change",
+            self._notif_iface(iface) | {"state": _ISM_NAME[new]},
+        )
+
+    def _notify_if_config_error(
+        self, iface: OspfInterface, src, pkt_type: str, error: str
+    ) -> None:
+        self._notify(
+            "ietf-ospf:if-config-error",
+            self._notif_iface(iface)
+            | {
+                "packet-source": str(src),
+                "packet-type": pkt_type,
+                "error": error,
+            },
+        )
+
+    def gr_helper_enter(
+        self, area: Area, iface: OspfInterface, nbr, grace_period: int
+    ) -> None:
+        self._notify(
+            "ietf-ospf:nbr-restart-helper-status-change",
+            self._notif_iface(iface)
+            | {
+                "neighbor-router-id": str(nbr.router_id),
+                "neighbor-ip-addr": str(nbr.src),
+                "status": "helping",
+                "age": grace_period,
+            },
+        )
+
+    def gr_helper_exit(
+        self, area: Area, iface: OspfInterface, nbr, reason: str
+    ) -> None:
+        """End the helper window (gr.rs:166-203): notify, clear the GR
+        state, and re-originate the segment's LSAs.  The adjacency itself
+        is untouched — it only dies later on the inactivity timer."""
+        nbr.gr_deadline = None
+        self._notify(
+            "ietf-ospf:nbr-restart-helper-status-change",
+            self._notif_iface(iface)
+            | {
+                "neighbor-router-id": str(nbr.router_id),
+                "neighbor-ip-addr": str(nbr.src),
+                "status": "not-helping",
+                "exit-reason": reason,
+            },
+        )
+        self._originate_router_lsa(area)
+        self._originate_network_lsa(area, iface)
+
     # ----- ISM
 
     def if_up(self, ifname: str) -> None:
@@ -536,13 +644,13 @@ class OspfInstance(Actor):
         if iface.state != IsmState.DOWN:
             return
         if iface.config.loopback:
-            iface.state = IsmState.LOOPBACK
+            self._set_ism_state(iface, IsmState.LOOPBACK)
             self._originate_router_lsa(area)
             return
         if iface.config.if_type == IfType.POINT_TO_POINT:
-            iface.state = IsmState.POINT_TO_POINT
+            self._set_ism_state(iface, IsmState.POINT_TO_POINT)
         else:
-            iface.state = IsmState.WAITING
+            self._set_ism_state(iface, IsmState.WAITING)
             self._timer(("wait", ifname), lambda: WaitTimerMsg(ifname)).start(
                 iface.config.dead_interval
             )
@@ -564,7 +672,7 @@ class OspfInstance(Actor):
             )
         for nbr_id in list(iface.neighbors):
             self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
-        iface.state = IsmState.DOWN
+        self._set_ism_state(iface, IsmState.DOWN)
         iface.dr = IPv4Address(0)
         iface.bdr = IPv4Address(0)
         for key in ("hello", "wait"):
@@ -599,11 +707,11 @@ class OspfInstance(Actor):
             changed = (new_dr, new_bdr) != (iface.dr, iface.bdr)
             iface.dr, iface.bdr = new_dr, new_bdr
             if new_dr == iface.addr_ip:
-                iface.state = IsmState.DR
+                self._set_ism_state(iface, IsmState.DR)
             elif new_bdr == iface.addr_ip:
-                iface.state = IsmState.BACKUP
+                self._set_ism_state(iface, IsmState.BACKUP)
             else:
-                iface.state = IsmState.DR_OTHER
+                self._set_ism_state(iface, IsmState.DR_OTHER)
             if not changed:
                 break
         # AdjOK? on all 2-Way+ neighbors (adjacency set may change).
@@ -655,23 +763,36 @@ class OspfInstance(Actor):
 
     def _rx_hello(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
         h: Hello = pkt.body
-        if (
-            h.hello_interval != iface.config.hello_interval
-            or h.dead_interval != iface.config.dead_interval
-        ):
-            return  # §10.5 parameter mismatch
+        if h.hello_interval != iface.config.hello_interval:
+            # §10.5 parameter mismatch (notification per error.rs to_yang).
+            self._notify_if_config_error(
+                iface, src, "hello", "hello-interval-mismatch"
+            )
+            return
+        if h.dead_interval != iface.config.dead_interval:
+            self._notify_if_config_error(
+                iface, src, "hello", "dead-interval-mismatch"
+            )
+            return
         if bool(h.options & Options.E) == area.no_type5:
-            return  # §10.5: E-bit must agree with the area's type
+            # §10.5: E-bit must agree with the area's type.
+            self._notify_if_config_error(iface, src, "hello", "option-mismatch")
+            return
         # RFC 5613: record the peer's LLS extended options (restart
         # signal / OOB-resync capability) on the neighbor.
         lls_eof = pkt.lls.eof if pkt.lls is not None else None
         if bool(h.options & Options.NP) != area.nssa:
-            return  # RFC 3101 §2.4: N-bit must agree on NSSA-ness
+            # RFC 3101 §2.4: N-bit must agree on NSSA-ness.
+            self._notify_if_config_error(iface, src, "hello", "option-mismatch")
+            return
         if (
             iface.config.if_type == IfType.BROADCAST
             and iface.prefix is not None
             and h.mask != mask_of(iface.prefix)
         ):
+            self._notify_if_config_error(
+                iface, src, "hello", "net-mask-mismatch"
+            )
             return
         nbr = iface.neighbors.get(pkt.router_id)
         created = nbr is None
@@ -886,17 +1007,19 @@ class OspfInstance(Actor):
                 if lsa.body.e_bit:
                     rank = (1, lsa.body.metric, asbr_dist, is_t7)
                     dist = lsa.body.metric
+                    rtype = "nssa-2" if is_t7 else "external-2"
                 else:
                     rank = (0, asbr_dist + lsa.body.metric, 0, is_t7)
                     dist = asbr_dist + lsa.body.metric
+                    rtype = "nssa-1" if is_t7 else "external-1"
                 cur = best.get(prefix)
                 if cur is None or rank < cur[0]:
                     best[prefix] = (
-                        rank, IntraRoute(prefix, dist, nhs, aid, "external")
+                        rank, IntraRoute(prefix, dist, nhs, aid, rtype)
                     )
                 elif rank == cur[0]:
                     merged = IntraRoute(
-                        prefix, dist, cur[1].nexthops | nhs, aid, "external"
+                        prefix, dist, cur[1].nexthops | nhs, aid, rtype
                     )
                     best[prefix] = (rank, merged)
         return {p: r for p, (rank, r) in best.items()}
@@ -1098,8 +1221,8 @@ class OspfInstance(Actor):
             # Flushed Grace-LSA = restart complete: close the window.
             for iface in area.interfaces.values():
                 nbr = iface.neighbors.get(lsa.adv_rtr)
-                if nbr is not None:
-                    nbr.gr_deadline = None
+                if nbr is not None and nbr.gr_deadline is not None:
+                    self.gr_helper_exit(area, iface, nbr, "completed")
             return
         info = decode_grace_tlvs(lsa.body.data)
         period = info.get("grace_period")
@@ -1109,7 +1232,11 @@ class OspfInstance(Actor):
         for iface in area.interfaces.values():
             nbr = iface.neighbors.get(lsa.adv_rtr)
             if nbr is not None and nbr.state == NsmState.FULL:
+                entering = nbr.gr_deadline is None
                 nbr.gr_deadline = now + period
+                nbr.gr_reason = info.get("reason", 0)
+                if entering:
+                    self.gr_helper_enter(area, iface, nbr, period)
 
     # ----- NSM plumbing
 
@@ -1134,6 +1261,18 @@ class OspfInstance(Actor):
         old_state = nbr.state
         res = nsm_transition(nbr, event, adj_ok=self._adj_ok(iface, nbr))
         nbr.state = res.new_state
+        if nbr.state != old_state:
+            from holo_tpu.protocols.ospf.nb_state import _NSM_NAME
+
+            self._notify(
+                "ietf-ospf:nbr-state-change",
+                self._notif_iface(iface)
+                | {
+                    "neighbor-router-id": str(nbr.router_id),
+                    "neighbor-ip-addr": str(nbr.src),
+                    "state": _NSM_NAME[nbr.state],
+                },
+            )
         for act in res.actions:
             if act == "start_exstart":
                 self._start_exstart(area, iface, nbr)
@@ -1154,7 +1293,9 @@ class OspfInstance(Actor):
                 t = self._timers.get(("rxmt", ifname, nbr_id))
                 if t:
                     t.cancel()
-                nbr.gr_deadline = None  # restart completed: exit helper
+                # The helper window stays open until the restarting router
+                # flushes its Grace-LSA (gr.rs:49-63) — reaching FULL alone
+                # does not end it.
                 if self.gr_restarting and self._gr_resync_complete():
                     # All pre-restart adjacencies re-established (§2.3):
                     # resume origination and withdraw Grace-LSAs (§2.4).
@@ -1413,6 +1554,24 @@ class OspfInstance(Actor):
             out.raw = bytes(raw)
         return out
 
+    @staticmethod
+    def _validate_lsa(lsa: Lsa) -> str | None:
+        """LSA sanity checks (reference lsa.rs validate()); returns the
+        holo-ospf lsa-validation-error identity or None."""
+        from holo_tpu.utils.bytesbuf import fletcher16_verify
+
+        if lsa.age > MAX_AGE:
+            return "invalid-age"
+        if (lsa.seq_no & 0xFFFFFFFF) == 0x80000000:  # reserved seqno
+            return "invalid-seq-num"
+        if lsa.raw and len(lsa.raw) >= 20 and not fletcher16_verify(
+            lsa.raw[2:]
+        ):
+            return "invalid-checksum"
+        if lsa.type == LsaType.ROUTER and lsa.lsid != lsa.adv_rtr:
+            return "ospfv2-router-lsa-id-mismatch"
+        return None
+
     def _rx_ls_update(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EXCHANGE:
@@ -1426,6 +1585,19 @@ class OspfInstance(Actor):
             for n in i2.neighbors.values()
         )
         for lsa in pkt.body.lsas:
+            # (1) Validation beyond the RFC's checksum-only rule
+            # (reference lsa.rs:370-386 + events.rs:830-845).
+            err = self._validate_lsa(lsa)
+            if err is not None:
+                self._notify(
+                    "holo-ospf:if-rx-bad-lsa",
+                    {
+                        "routing-protocol-name": self.name,
+                        "packet-source": str(src),
+                        "error": err,
+                    },
+                )
+                continue
             # Flooding scope (§3.6 / RFC 3101 §2.2): no type-5s into
             # stub or NSSA areas, type-7s only inside an NSSA.
             if lsa.type == LsaType.AS_EXTERNAL and area.no_type5:
@@ -1508,6 +1680,7 @@ class OspfInstance(Actor):
             return
         for hdr in pkt.body.lsa_headers:
             cur = nbr.ls_rxmt.get(hdr.key)
+            # Same-instance acks only (§13.7) — the reference's exact rule.
             if cur is not None and hdr.compare(cur) == 0:
                 del nbr.ls_rxmt[hdr.key]
 
@@ -1520,25 +1693,52 @@ class OspfInstance(Actor):
             return  # §3.6: stub areas refuse AS-external LSAs
         now = self.loop.clock.now()
         _, changed = area.lsdb.install(lsa, now)
-        if changed:
+        if lsa.type == LsaType.OPAQUE_LINK:
+            # Operational state groups type-9s under their link: remember
+            # which interface each one belongs to (arrival interface for
+            # received copies, the pinned tx interface for our own).
+            owner = only_iface or from_iface
+            if owner is not None:
+                self._link_scope_iface[lsa.key] = owner.name
+        # Our OWN summary LSAs never trigger route recalculation — they
+        # are derived FROM the routes (reference lsdb.rs:465-469).
+        self_orig_summary = (
+            lsa.adv_rtr == self.config.router_id
+            and lsa.type
+            in (LsaType.SUMMARY_NETWORK, LsaType.SUMMARY_ROUTER)
+        )
+        if changed and not self_orig_summary:
             self._schedule_spf()
         if lsa.adv_rtr != self.config.router_id:
             self._maybe_enter_gr_helper(area, lsa)
+        # A changed topology-information LSA terminates every open helper
+        # window (strict-LSA-checking, reference lsdb.rs:472-482).
+        if changed and lsa.type in (
+            LsaType.ROUTER,
+            LsaType.NETWORK,
+            LsaType.SUMMARY_NETWORK,
+            LsaType.SUMMARY_ROUTER,
+            LsaType.AS_EXTERNAL,
+            LsaType.NSSA_EXTERNAL,
+        ):
+            for a2 in self.areas.values():
+                for i2 in a2.interfaces.values():
+                    for n2 in i2.neighbors.values():
+                        if n2.gr_deadline is not None:
+                            self.gr_helper_exit(
+                                a2, i2, n2, "topology-changed"
+                            )
         if lsa.type == LsaType.AS_EXTERNAL and changed and len(self.areas) > 1:
             self._propagate_external(area, lsa)
         # Link-local opaque LSAs (type 9) never leave their link: received
         # copies are not re-flooded at all; self-originated ones go out on
         # the originating interface only (RFC 5250 §3).
         if lsa.type == LsaType.OPAQUE_LINK and only_iface is None:
-            if lsa.is_maxage:
-                area.lsdb.remove(lsa.key)
             return
         self._flood(area, lsa, from_iface, from_nbr, only_iface=only_iface)
-        if lsa.is_maxage:
-            # Simplified MaxAge handling: once flooded and unreferenced,
-            # remove (reference tracks ack state; the rxmt lists here drain
-            # via acks and the entry is gone from SPF either way at MaxAge).
-            area.lsdb.remove(lsa.key)
+        # MaxAge copies STAY installed (marked maxage in operational
+        # state, invisible to SPF) until the rxmt lists drain — the
+        # RFC 2328 §14 removal condition, swept from the age tick.
 
     def _flood(
         self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
@@ -1616,9 +1816,13 @@ class OspfInstance(Actor):
         body,
         allow_in_gr: bool = False,
         only_iface=None,
-        options: Options = Options.E,
+        options: Options | None = None,
         force: bool = False,
     ) -> None:
+        if options is None:
+            # Area-default LSA options (reference area_options): stub
+            # areas clear the E-bit on everything originated into them.
+            options = Options(0) if area.stub else Options.E
         if self.gr_restarting and not allow_in_gr:
             return  # RFC 3623 §2.2: no origination until resync completes
         if getattr(self, "_shutting_down", False):
@@ -1755,7 +1959,64 @@ class OspfInstance(Actor):
             and self.loop.clock.now() < nbr.gr_deadline
         )
 
+    # -- deferred origination checks (reference lsdb.rs:589-660)
+
+    def _queue_check(self, key: tuple, **kwargs) -> None:
+        if self.config.external_orig_checks:
+            self._pending_checks[key] = kwargs
+        else:
+            self._run_check(key, **kwargs)
+
+    def flush_orig_checks(self, kind: str | None = None) -> None:
+        """Run the accumulated origination checks against CURRENT state.
+
+        Called by the conformance harness at each recorded LsaOrigCheck
+        position (``kind`` narrows to that check's LSA class — the
+        reference's checks are per-LSA messages): N earlier triggers
+        rebuild once here, and the unchanged-content skip in
+        :meth:`_originate` coalesces them — reproducing the reference's
+        deferred originate_check batching."""
+        run = [
+            k
+            for k in self._pending_checks
+            if kind is None or k[0] == kind
+        ]
+        for key in run:
+            kwargs = self._pending_checks.pop(key)
+            self._run_check(key, **kwargs)
+
+    def _run_check(self, key: tuple, **kwargs) -> None:
+        kind = key[0]
+        area = self.areas.get(key[1])
+        if area is None:
+            return
+        if kind == "router":
+            self._do_originate_router_lsa(area, **kwargs)
+        elif kind == "network":
+            iface = area.interfaces.get(key[2])
+            if iface is not None:
+                self._do_originate_network_lsa(area, iface, **kwargs)
+        elif kind == "ri":
+            self._do_originate_router_info(area, **kwargs)
+
     def _originate_router_lsa(self, area: Area, force: bool = False) -> None:
+        self._queue_check(("router", area.area_id), force=force)
+
+    def _originate_network_lsa(
+        self, area: Area, iface: OspfInterface, force: bool = False
+    ) -> None:
+        self._queue_check(("network", area.area_id, iface.name), force=force)
+
+    def _originate_router_info(self, area: Area) -> None:
+        self._queue_check(("ri", area.area_id))
+
+    def _do_originate_router_lsa(self, area: Area, force: bool = False) -> None:
+        body = self._build_router_lsa(area)
+        self._originate(
+            area, LsaType.ROUTER, self.config.router_id, body, force=force
+        )
+
+    def _build_router_lsa(self, area: Area) -> "LsaRouter":
         links: list[RouterLink] = []
         # Real interfaces first, loopback host routes last (matches the
         # reference's router-LSA build order).
@@ -1823,7 +2084,7 @@ class OspfInstance(Actor):
             area, LsaType.ROUTER, self.config.router_id, body, force=force
         )
 
-    def _originate_network_lsa(
+    def _do_originate_network_lsa(
         self, area: Area, iface: OspfInterface, force: bool = False
     ) -> None:
         key = LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id)
@@ -1859,9 +2120,26 @@ class OspfInstance(Actor):
                 self._install_and_flood(area, lsa)
             for key in area.lsdb.maxage_keys(now):
                 e = area.lsdb.get(key)
-                lsa = self._aged_copy(e)
-                self._install_and_flood(area, lsa)
+                if not e.lsa.is_maxage:
+                    # Newly expired: flood the MaxAge copy once (§14).
+                    lsa = self._aged_copy(e)
+                    self._install_and_flood(area, lsa)
+                elif not self._maxage_referenced(area, key):
+                    # §14 removal: no rxmt holds it and no neighbor is
+                    # mid-exchange — the MaxAge copy leaves the database.
+                    area.lsdb.remove(key)
+                    self._link_scope_iface.pop(key, None)
         self._age_timer.start(AGE_TICK)
+
+    def _maxage_referenced(self, area: Area, key: LsaKey) -> bool:
+        for iface in area.interfaces.values():
+            for nbr in iface.neighbors.values():
+                if key in nbr.ls_rxmt or nbr.state in (
+                    NsmState.EXCHANGE,
+                    NsmState.LOADING,
+                ):
+                    return True
+        return False
 
     # ----- SPF scheduling (RFC 8405 delay FSM)
 
@@ -1957,6 +2235,15 @@ class OspfInstance(Actor):
                 continue
             res = self.backend.compute(st.topo)
             area_results[area.area_id] = (st, res)
+            # Reachable-router set per area: operational state serves
+            # abr-count/asbr-count from it (reference area.rs:164-182).
+            from holo_tpu.ops.graph import INF as _INF
+
+            self._area_reachable_routers[area.area_id] = {
+                rid
+                for rid, v in st.router_index.items()
+                if res.dist[v] < _INF
+            }
             intra = derive_routes(st, res, area.lsdb, now, area.area_id)
             area_intra[area.area_id] = intra
             for prefix, route in intra.items():
@@ -2536,7 +2823,7 @@ class OspfInstance(Actor):
     def _route_distance(self, route) -> int:
         c = self.config
         rtype = getattr(route, "rtype", "intra")
-        if rtype == "external":
+        if rtype.startswith(("external", "nssa")):
             return c.preference_external if c.preference_external is not None else c.preference
         typed = c.preference_intra if rtype == "intra" else c.preference_inter
         if typed is not None:
@@ -2580,12 +2867,37 @@ class OspfInstance(Actor):
                 for key in list(area.lsdb.entries):
                     if key.adv_rtr == self.config.router_id:
                         self._flush_self_lsa(area, key)
+            # Stop interfaces one by one (reference teardown): each kills
+            # its neighbors (nbr down notifications) then transitions the
+            # interface itself to Down (if-state-change notification).
+            # Loopbacks have no ISM to stop — they stay 'loopback'.
             for area in self.areas.values():
                 for iface in area.interfaces.values():
                     for nbr_id in list(iface.neighbors):
                         self._nbr_event(iface.name, nbr_id, NsmEvent.KILL_NBR)
+                    if iface.config.loopback:
+                        continue
+                    self._set_ism_state(iface, IsmState.DOWN)
+                    iface.dr = IPv4Address(0)
+                    iface.bdr = IPv4Address(0)
+                    for key in ("hello", "wait"):
+                        t = self._timers.get((key, iface.name))
+                        if t:
+                            t.cancel()
         finally:
             self._shutting_down = False
+        # Teardown discards any re-origination checks its kill hooks queued,
+        # and drops ALL instance state — the reference tears the whole
+        # Instance<Up> down, so the LSDBs and SPF products vanish with it.
+        self._pending_checks.clear()
+        for area in self.areas.values():
+            area.lsdb.entries.clear()
+            area.lsdb.pending.clear()
+        self._link_scope_iface.clear()
+        self._area_reachable_routers.clear()
+        self.spf_state = SpfFsmState.QUIET
+        self._learn_deadline = None
+        self.enabled = False
         old = self.routes
         self.routes = {}
         if self.route_cb is not None:
@@ -2595,11 +2907,25 @@ class OspfInstance(Actor):
 
     def restart_with_router_id(self, router_id: IPv4Address) -> None:
         """Router-id change requires a restart: flush the old identity's
-        LSAs, adopt the new id, let adjacencies re-form."""
+        LSAs, adopt the new id, bring interfaces back up and let
+        adjacencies re-form."""
         if router_id == self.config.router_id:
             return
+        was_up = [
+            iface.name
+            for area in self.areas.values()
+            for iface in area.interfaces.values()
+            if iface.state != IsmState.DOWN
+        ]
         self.shutdown_self()
         self.config.router_id = router_id
+        self.enabled = True
+        # Instance (re)start: AreaStart re-originates the RI LSAs, then
+        # the interfaces come back up under the new identity.
+        for area in self.areas.values():
+            self._originate_router_info(area)
+        for ifname in was_up:
+            self.if_up(ifname)
 
     def clear_neighbors(
         self,
@@ -2617,19 +2943,17 @@ class OspfInstance(Actor):
                         self._nbr_event(iface.name, rid, NsmEvent.KILL_NBR)
 
     def clear_database(self) -> None:
-        """ietf-ospf clear-database RPC: drop every LSA, re-originate our
-        own, and resync adjacencies from scratch."""
+        """ietf-ospf clear-database RPC (reference rpc.rs:48-76): drop
+        every LSA and kill the neighbors; re-origination happens through
+        the kill events' own origination checks (router-LSA), NOT
+        explicitly — the RI LSA only returns at area (re)start."""
         for area in self.areas.values():
             for key in list(area.lsdb.entries):
                 area.lsdb.remove(key)
             for iface in area.interfaces.values():
                 for rid in list(iface.neighbors):
                     self._nbr_event(iface.name, rid, NsmEvent.KILL_NBR)
-            self._originate_router_lsa(area)
-            self._originate_router_info(area)
-        for prefix in list(self.redistributed):
-            self._originate_external(prefix)
-        self.reoriginate_summaries()
+        self._link_scope_iface.clear()
 
     # ----- rx/tx plumbing
 
@@ -2643,10 +2967,42 @@ class OspfInstance(Actor):
         try:
             pkt = Packet.decode(msg.data, auth=iface.config.auth)
         except Exception:
-            return  # malformed/unauthenticated: drop
+            # Malformed/unauthenticated: drop + notify (events.rs:132).
+            self._notify(
+                "ietf-ospf:if-rx-bad-packet",
+                self._notif_iface(iface) | {"packet-source": str(msg.src)},
+            )
+            return
+        # Destination validation (ospfv2/interface.rs:94-126): our own
+        # address, AllSPFRouters, or AllDRouters when we are DR/BDR.
+        if msg.dst is not None and msg.dst not in (
+            iface.addr_ip,
+            ALL_SPF_RTRS_V4,
+        ):
+            if not (msg.dst == ALL_DR_RTRS_V4 and iface.is_dr_or_bdr()):
+                return
+        # Source validation (:128-146): usable, and on the interface's
+        # subnet for non-p2p interfaces.
+        if int(msg.src) == 0:
+            return
+        if (
+            iface.config.if_type != IfType.POINT_TO_POINT
+            and iface.prefix is not None
+            and msg.src not in iface.prefix
+        ):
+            return
         if pkt.router_id == self.config.router_id:
-            return  # our own multicast
+            if pkt.body.TYPE == PacketType.HELLO:
+                # Another router is using OUR router-id (hello from a
+                # different source): misconfiguration worth flagging.
+                self._notify_if_config_error(
+                    iface, msg.src, "hello", "duplicate-router-id"
+                )
+            return  # our own multicast (or a duplicate router-id)
         if pkt.area_id != area.area_id:
+            self._notify_if_config_error(
+                iface, msg.src, _PKT_TYPE_YANG[pkt.body.TYPE], "area-mismatch"
+            )
             return
         if pkt.auth_type == AuthType.CRYPTOGRAPHIC:
             nbr = iface.neighbors.get(pkt.router_id)

@@ -73,6 +73,7 @@ class Neighbor:
     # Graceful-restart helper (RFC 3623): while now < gr_deadline the
     # inactivity timer must not kill this neighbor.
     gr_deadline: float | None = None
+    gr_reason: int = 0  # Grace-LSA restart reason while helping
 
     def is_adjacent(self) -> bool:
         return self.state >= NsmState.EX_START

@@ -543,8 +543,9 @@ class Lsa:
         if r.remaining() < body_len:
             raise DecodeError("LSA length exceeds buffer")
         raw = r.data[start : start + length]
-        if not fletcher16_verify(raw[2:]):
-            raise DecodeError("LSA checksum mismatch")
+        # A checksum mismatch does NOT abort the decode: the rx path
+        # validates separately and emits if-rx-bad-lsa (reference decodes
+        # tolerantly, lsa.rs validate() flags it — events.rs:830-845).
         body = _BODY_CODECS[ltype].decode(r.sub(body_len))
         return cls(age, options, ltype, lsid, adv, seq, body, cksum, length, raw)
 

@@ -271,8 +271,9 @@ class IntraRoute:
     dist: int
     nexthops: frozenset[RouteNexthop]
     area_id: IPv4Address
-    # "intra" | "inter" | "external" — drives per-type admin distance
-    # (ietf-ospf preference intra-area/inter-area/internal/external).
+    # "intra" | "inter" | "external-1" | "external-2" | "nssa-1" |
+    # "nssa-2" — drives per-type admin distance and maps onto the
+    # ietf-ospf route-type enumeration in operational state.
     rtype: str = "intra"
 
 

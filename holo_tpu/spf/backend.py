@@ -111,23 +111,34 @@ class TpuSpfBackend(SpfBackend):
         n_atoms: int = 64,
         max_iters: int | None = None,
         engine: str = "gather",
+        one_engine: str = "fused",
     ):
         """``engine``: 'gather' (ELL gathers; handles any topology) or
         'blocked' (block-sparse Pallas kernels; fastest on large LSDBs,
         requires unique (src,dst) pairs and distances < 2**27 — falls back
-        to gather per topology when those preconditions fail)."""
+        to gather per topology when those preconditions fail).
+
+        ``one_engine`` picks the gather-path fixpoint formulation
+        ('fused' | 'packed' | 'seq' — see :func:`spf_one_fused`); all are
+        bit-identical, differing only in TPU round/gather scheduling."""
         self.n_atoms = n_atoms
         self.max_iters = max_iters
         self.engine = engine
+        self.one_engine = one_engine
         self._blocked_cache: dict[tuple, object] = {}
         self._jit_blocked = None  # built lazily (pallas import)
         # Small LRU of marshaled graphs: an instance typically alternates
         # between its LSDB topology and derived ones (hop graphs for
         # flooding reduction), which must not evict each other.
         self._cache: dict[tuple, DeviceGraph] = {}
-        self._jit_one = jax.jit(lambda g, r, m: spf_one(g, r, m, self.max_iters))
+        from holo_tpu.ops.spf_engine import _ONE_ENGINES
+
+        one = _ONE_ENGINES[one_engine]
+        self._jit_one = jax.jit(lambda g, r, m: one(g, r, m, self.max_iters))
         self._jit_batch = jax.jit(
-            lambda g, r, ms: spf_whatif_batch(g, r, ms, self.max_iters)
+            lambda g, r, ms: spf_whatif_batch(
+                g, r, ms, self.max_iters, engine=one_engine
+            )
         )
         self._jit_multiroot = jax.jit(
             lambda g, rs, m: spf_multiroot(g, rs, m, self.max_iters)

@@ -80,14 +80,18 @@ class CaseRun:
         ospf = cfg["ietf-routing:routing"]["control-plane-protocols"][
             "control-plane-protocol"
         ][0]["ietf-ospf:ospf"]
+        self.notif_log: list = []
         self.inst = OspfInstance(
             name=f"step-{rt}",
             config=InstanceConfig(
                 router_id=IPv4Address(ospf["explicit-router-id"])
             ),
             netio=self.tx,
+            # Late-bound: drain_notifs() swaps the list object out.
+            notif_cb=lambda n: self.notif_log.append(n),
         )
         self.inst.config.deterministic_dd = True
+        self.inst.config.external_orig_checks = True
         # The replay clock is frozen (recordings carry no timestamps), so
         # the RFC §13(5a) MinLSArrival throttle would reject every newer
         # copy of an LSA; the recording is the reference's own accepted
@@ -128,6 +132,10 @@ class CaseRun:
         self.addrs: dict[str, list] = {}  # ifname -> [IPv4Interface]
         self.ifindexes: dict[str, int] = {}  # ifname -> kernel ifindex
         self.up: set[str] = set()
+        # Interfaces fully provisioned (created + addressed + operative)
+        # awaiting their recorded InterfaceStateChange position to come up.
+        self.ready: set[str] = set()
+        self._saw_state_change_evt = False
         # Reference arena-id mapping (observed from the recordings):
         # areas are keyed {"Id": n} with n = 1-based rank of the area-id
         # in ascending order; interfaces are keyed per area, 1-based over
@@ -151,10 +159,9 @@ class CaseRun:
         if ifname in self.up or ifname not in self.if_conf:
             return
         if self._find_iface(ifname) is not None:
-            # Already created, currently down: bring it back up.
-            self.up.add(ifname)
-            self.loop.send(self.inst.name, IfUpMsg(ifname))
-            self.loop.run_until_idle()
+            # Already created, currently down: ready to come back up at
+            # the next recorded InterfaceStateChange position.
+            self.ready.add(ifname)
             return
         addrs = self.addrs.get(ifname) or []
         if not addrs:
@@ -186,9 +193,14 @@ class CaseRun:
             addr.network,
             addr.ip,
             stub="stub-area" in atype,
-            stub_default_cost=area.get("default-cost", 1),
+            stub_default_cost=area.get("default-cost", 10),
             nssa="nssa" in atype,
         )
+        if new_area:
+            # AreaStart fires the RI-LSA origination check immediately in
+            # the reference (its areas exist from config apply, before any
+            # recorded event) — reproduce that at our lazy area creation.
+            self.inst.flush_orig_checks("ri")
         got = self._find_iface(ifname)
         if got is not None and ifname in self.ifindexes:
             got.ifindex = self.ifindexes[ifname]
@@ -196,9 +208,12 @@ class CaseRun:
             # Initial config snapshot applies at area creation only —
             # later config-change mutations must not be clobbered.
             self.inst.areas[aid].summary = area.get("summary", True)
-        self.up.add(ifname)
-        self.loop.send(self.inst.name, IfUpMsg(ifname))
-        self.loop.run_until_idle()
+        # The interface is created but comes UP only at the recorded
+        # InterfaceStateChange origination-event position — the moment the
+        # reference's own ISM ran its up transition (its system events and
+        # ISM processing are decoupled; ours must match that timing for
+        # identical LSA instance histories).
+        self.ready.add(ifname)
 
     def _iface_by_key(self, key, area_key=None) -> str | None:
         if isinstance(key, dict):
@@ -306,27 +321,41 @@ class CaseRun:
     def apply_protocol(self, ev: dict) -> None:
         if "NetRxPacket" in ev:
             rx = ev["NetRxPacket"]
-            pkt_json = rx.get("packet", {})
-            pkt_json = pkt_json.get("Ok", pkt_json)
-            if not pkt_json or "Err" in rx.get("packet", {}):
-                return  # decode-error cases: nothing to feed
             ifname = self._iface_by_key(
                 rx.get("iface_key"), rx.get("area_key")
             ) or rx.get("ifname")
             if ifname is None:
                 raise Unsupported("unmapped iface key")
-            pkt = refjson.packet_from_json(pkt_json)
             src = IPv4Address(rx["src"]) if rx.get("src") else IPv4Address(0)
             dst = IPv4Address(rx["dst"]) if rx.get("dst") else IPv4Address(0)
+            pkt_json = rx.get("packet", {})
+            if "Err" in pkt_json or not pkt_json.get("Ok", pkt_json):
+                # Decode-error cases: feed undecodable bytes so the rx
+                # path raises + notifies exactly like the real wire would.
+                data = b"\x02\x99\x00\x04"
+            else:
+                pkt = refjson.packet_from_json(pkt_json.get("Ok", pkt_json))
+                data = pkt.encode()
             self.loop.send(
-                self.inst.name,
-                NetRxPacket(ifname, src, dst, pkt.encode()),
+                self.inst.name, NetRxPacket(ifname, src, dst, data)
             )
             self.loop.run_until_idle()
         elif "SpfDelayEvent" in ev:
-            if ev["SpfDelayEvent"].get("event") == "DelayTimer":
+            from holo_tpu.protocols.ospf.instance import SpfFsmState
+
+            sev = ev["SpfDelayEvent"].get("event")
+            if sev == "DelayTimer":
                 self.inst.run_spf()
                 self.loop.run_until_idle()
+            elif sev == "LearnTimer":
+                # RFC 8405 transition 3 (spf.rs:372-377).
+                if self.inst.spf_state == SpfFsmState.SHORT_WAIT:
+                    self.inst.spf_state = SpfFsmState.LONG_WAIT
+            elif sev == "HoldDownTimer":
+                # Transitions 5/6: back to QUIET (spf.rs:402-418).
+                self.inst._spf_holddown_fired()
+            # "Igp" entries are the reference's own trigger messages; our
+            # instance generates its own IGP events inline.
         elif "NsmEvent" in ev and ev["NsmEvent"].get("event") == "InactivityTimer":
             sub = ev["NsmEvent"]
             ifname = self._iface_by_key(sub.get("iface_key"), sub.get("area_key"))
@@ -378,9 +407,14 @@ class CaseRun:
             iface = self._find_iface(ifname)
             nbr_id = IPv4Address(nbr_key["Value"])
             if iface is not None and nbr_id in iface.neighbors:
-                # Grace period timed out: the helper window closes and the
-                # pre-existing kill proceeds.
-                iface.neighbors[nbr_id].gr_deadline = None
+                # Grace period timed out: the helper window closes
+                # (events.rs:1486 helper_exit TimedOut) and the deferred
+                # kill proceeds.
+                nbr = iface.neighbors[nbr_id]
+                aid = self.inst._if_area.get(ifname)
+                area = self.inst.areas.get(aid)
+                if nbr.gr_deadline is not None and area is not None:
+                    self.inst.gr_helper_exit(area, iface, nbr, "timed-out")
                 self.inst._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
             self.loop.run_until_idle()
         elif "RxmtInterval" in ev and "Value" in (
@@ -395,11 +429,38 @@ class CaseRun:
                     ifname, IPv4Address(sub["nbr_key"]["Value"])
                 )
                 self.loop.run_until_idle()
+        elif "LsaOrigEvent" in ev and "InterfaceStateChange" in (
+            ev["LsaOrigEvent"].get("event") or {}
+        ):
+            # The reference's ISM just ran an interface state transition:
+            # any provisioned-but-down interface of ours comes up HERE.
+            sub = ev["LsaOrigEvent"]["event"]["InterfaceStateChange"]
+            aid = self.area_by_id.get(sub.get("area_id"))
+            ifname = self.iface_by_id.get((aid, sub.get("iface_id")))
+            if ifname and ifname in self.ready and ifname not in self.up:
+                self.up.add(ifname)
+                self.loop.send(self.inst.name, IfUpMsg(ifname))
+                self.loop.run_until_idle()
+        elif "LsaOrigCheck" in ev:
+            # The reference's deferred originate_check position: flush our
+            # queued check for the SAME LSA class so earlier triggers
+            # rebuild exactly here (lsdb.rs:589-660).  The recorded body
+            # identifies the class; unmatched classes flush unfiltered.
+            body = ev["LsaOrigCheck"].get("lsa_body", {})
+            kind = next(iter(body), "")
+            if kind == "Router":
+                self.inst.flush_orig_checks("router")
+            elif kind == "Network":
+                self.inst.flush_orig_checks("network")
+            elif kind == "OpaqueArea":
+                self.inst.flush_orig_checks("ri")
+            else:
+                self.inst.flush_orig_checks()
+            self.loop.run_until_idle()
         elif any(
             k in ev
             for k in (
                 "LsaOrigEvent",
-                "LsaOrigCheck",
                 "SendLsUpdate",
                 "DelayedAck",
                 "NsmEvent",
@@ -647,9 +708,13 @@ class CaseRun:
             if ospf.get("enabled") is False:
                 self.inst.shutdown_self()
             else:
+                # Re-enable = full instance start: RI LSAs (AreaStart),
+                # then every operationally-up interface comes back.
+                self.inst.enabled = True
                 for area in self.inst.areas.values():
-                    self.inst._originate_router_lsa(area, force=True)
                     self.inst._originate_router_info(area)
+                for ifname in sorted(self.up):
+                    self.inst.if_up(ifname)
         if op_of(ospf, "explicit-router-id") == "replace":
             self.inst.restart_with_router_id(
                 IPv4Address(ospf["explicit-router-id"])
@@ -677,21 +742,20 @@ class CaseRun:
         gr = ospf.get("graceful-restart", {})
         if op_of(gr, "helper-enabled") == "replace":
             self.inst.config.gr_helper_enabled = bool(gr["helper-enabled"])
-            for area in self.inst.areas.values():
-                self.inst._originate_router_info(area)
-            # A helper-capability change is a topology-info change: open
-            # helper sessions exit (reference gr.rs topology-change path).
-            from holo_tpu.protocols.ospf.neighbor import NsmEvent
-
+            # Disabling the helper capability exits helper mode for every
+            # restarting neighbor — the adjacency itself survives (it only
+            # dies later on the inactivity timer); reference gr.rs:166-203
+            # + configuration.rs GrHelperChange.
             if not gr["helper-enabled"]:
                 for area in self.inst.areas.values():
                     for iface in area.interfaces.values():
-                        for rid, nbr in list(iface.neighbors.items()):
+                        for nbr in iface.neighbors.values():
                             if nbr.gr_deadline is not None:
-                                nbr.gr_deadline = None
-                                self.inst._nbr_event(
-                                    iface.name, rid, NsmEvent.KILL_NBR
+                                self.inst.gr_helper_exit(
+                                    area, iface, nbr, "topology-changed"
                                 )
+            for area in self.inst.areas.values():
+                self.inst._originate_router_info(area)
 
         for area_node in ospf.get("areas", {}).get("area", []):
             aid = IPv4Address(area_node["area-id"])
@@ -861,45 +925,43 @@ class CaseRun:
         self.inst.reoriginate_summaries()
         self.loop.run_until_idle()
 
+    def drain_notifs(self) -> list:
+        out, self.notif_log = self.notif_log, []
+        return out
+
+    def compare_notifs(self, expected_lines: list[dict]) -> list[str]:
+        """Both-sided notification-plane compare (multiset, like the
+        reference's assert_notifications)."""
+
+        def canon(n: dict) -> str:
+            kind, body = next(iter(n.items()))
+            body = dict(body)
+            # The recordings use the reference's instance name.
+            body.pop("routing-protocol-name", None)
+            return json.dumps({kind: body}, sort_keys=True)
+
+        got = [canon(n) for n in self.drain_notifs()]
+        problems = []
+        for exp in expected_lines:
+            c = canon(exp)
+            if c in got:
+                got.remove(c)
+            else:
+                problems.append(f"expected notif missing: {c[:180]}")
+        for item in got:
+            problems.append(f"unexpected notif: {item[:180]}")
+        return problems
+
     def compare_state(self, state: dict) -> list[str]:
-        """Compare the expected local-rib plane against our routes."""
-        ospf = state["ietf-routing:routing"]["control-plane-protocols"][
+        """Full-tree compare: the recorded ietf-ospf state plane against
+        our YANG-modeled operational state (both-sided, every leaf)."""
+        from holo_tpu.protocols.ospf.nb_state import instance_state
+        from holo_tpu.tools.treediff import tree_diff
+
+        exp = state["ietf-routing:routing"]["control-plane-protocols"][
             "control-plane-protocol"
         ][0]["ietf-ospf:ospf"]
-        rib = ospf.get("local-rib", {}).get("route")
-        if rib is None:
-            return []
-        problems = []
-        expected = {}
-        for route in rib:
-            nhs = frozenset(
-                (
-                    nh.get("outgoing-interface"),
-                    IPv4Address(nh["next-hop"]) if nh.get("next-hop") else None,
-                )
-                for nh in route.get("next-hops", {}).get("next-hop", [])
-            )
-            expected[IPv4Network(route["prefix"])] = (
-                route.get("metric", 0),
-                nhs,
-            )
-        ours = self.inst.routes
-        for prefix, (metric, nhs) in expected.items():
-            got = ours.get(prefix)
-            if got is None:
-                problems.append(f"missing route {prefix}")
-                continue
-            if got.dist != metric:
-                problems.append(f"{prefix}: metric {got.dist} != {metric}")
-            got_nhs = frozenset((nh.ifname, nh.addr) for nh in got.nexthops)
-            if got_nhs != nhs:
-                problems.append(
-                    f"{prefix}: nexthops {sorted(map(str, got_nhs))} != "
-                    f"{sorted(map(str, nhs))}"
-                )
-        for prefix in set(ours) - set(expected):
-            problems.append(f"extra route {prefix}")
-        return problems
+        return tree_diff(exp, instance_state(self.inst), "ospf")
 
 
 def run_case(case_dir: Path, topo: str, rt: str):
@@ -917,6 +979,7 @@ def run_case(case_dir: Path, topo: str, rt: str):
     problems = []
     for step in steps:
         run.drain_ibus()  # only this step's ibus traffic is asserted
+        run.drain_notifs()  # likewise for notifications
         try:
             for kind in ("ibus", "protocol"):
                 f = case_dir / f"{step}-input-{kind}.jsonl"
@@ -935,6 +998,11 @@ def run_case(case_dir: Path, topo: str, rt: str):
             f = case_dir / f"{step}-input-northbound-rpc.json"
             if f.exists():
                 run.apply_rpc(json.loads(f.read_text()))
+            # End-of-step quiescence: the reference snapshots after its
+            # internal queues drain, so any origination checks queued by
+            # this step's triggers rebuild now.
+            run.inst.flush_orig_checks()
+            run.loop.run_until_idle()
         except Unsupported as e:
             return "skip", f"step {step}: {e}"
         out_proto = case_dir / f"{step}-output-protocol.jsonl"
@@ -960,6 +1028,17 @@ def run_case(case_dir: Path, topo: str, rt: str):
             problems += [
                 f"step {step}: {p}" for p in run.compare_ibus(expected)
             ]
+        out_notif = case_dir / f"{step}-output-northbound-notif.jsonl"
+        expected_notifs = []
+        if out_notif.exists():
+            expected_notifs = [
+                json.loads(l)
+                for l in out_notif.read_text().splitlines()
+                if l.strip()
+            ]
+        problems += [
+            f"step {step}: {p}" for p in run.compare_notifs(expected_notifs)
+        ]
         out_state = case_dir / f"{step}-output-northbound-state.json"
         if out_state.exists():
             state = json.loads(out_state.read_text())

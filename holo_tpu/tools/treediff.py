@@ -1,0 +1,73 @@
+"""Deep YANG-JSON tree comparison shared by the stepwise harnesses.
+
+Mirrors the reference's full-plane state assertion
+(holo-protocol/src/test/stub/northbound.rs): every leaf in the expected
+tree must match, and every leaf we emit must be expected — both-sided.
+Lists are paired by their YANG keys when known (falling back to a
+whole-entry canonical sort), so a single mismatched entry produces one
+focused diff instead of a cascade.
+"""
+
+from __future__ import annotations
+
+import json
+
+# YANG list-entry keys by list name (union across the protocols' trees;
+# name collisions resolve to compatible keys).
+LIST_KEYS = {
+    # ietf-ospf
+    "area": ("area-id",),
+    "interface": ("name",),
+    "neighbor": ("neighbor-router-id", "address", "remote-address"),
+    "route": ("prefix",),
+    "area-scope-lsa-type": ("lsa-type",),
+    "link-scope-lsa-type": ("lsa-type",),
+    "as-scope-lsa-type": ("lsa-type",),
+    "area-scope-lsa": ("lsa-id", "adv-router"),
+    "link-scope-lsa": ("lsa-id", "adv-router"),
+    "as-scope-lsa": ("lsa-id", "adv-router"),
+    "hostname": ("router-id",),
+    "extended-prefix-tlv": ("prefix",),
+    # ietf-mpls-ldp
+    "address": ("address", "advertisement-type", "peer"),
+    "fec-label": ("fec",),
+    "peer": ("lsr-id",),
+    "hello-adjacency": ("adjacent-address",),
+    "target": ("adjacent-address",),
+}
+
+
+def tree_diff(exp, got, path: str, list_keys: dict | None = None) -> list[str]:
+    keys_map = LIST_KEYS if list_keys is None else list_keys
+    problems: list[str] = []
+    if isinstance(exp, dict) and isinstance(got, dict):
+        for k in exp:
+            if k not in got:
+                problems.append(f"{path}/{k}: missing")
+            else:
+                problems += tree_diff(exp[k], got[k], f"{path}/{k}", keys_map)
+        for k in got:
+            if k not in exp:
+                problems.append(f"{path}/{k}: unexpected")
+        return problems
+    if isinstance(exp, list) and isinstance(got, list):
+        name = path.rsplit("/", 1)[-1].split("[", 1)[0]
+        keys = keys_map.get(name)
+
+        def keyfn(entry):
+            if keys and isinstance(entry, dict):
+                return json.dumps(
+                    [entry.get(k) for k in keys], sort_keys=True
+                )
+            return json.dumps(entry, sort_keys=True)
+
+        exp_s = sorted(exp, key=keyfn)
+        got_s = sorted(got, key=keyfn)
+        if len(exp_s) != len(got_s):
+            problems.append(f"{path}: list length {len(got_s)} != {len(exp_s)}")
+        for i, (e, g) in enumerate(zip(exp_s, got_s)):
+            problems += tree_diff(e, g, f"{path}[{i}]", keys_map)
+        return problems
+    if exp != got:
+        problems.append(f"{path}: {got!r} != {exp!r}")
+    return problems

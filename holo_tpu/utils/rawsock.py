@@ -43,6 +43,7 @@ class RawSocketIo(NetIo):
         self.proto = proto
         self._socks: dict[str, _IfSock] = {}
         self._by_fd: dict[int, _IfSock] = {}
+        self._routed_sock: socket.socket | None = None
 
     def open_interface(
         self, ifname: str, actor: str, mcast_groups: list[IPv4Address] = ()
@@ -81,19 +82,16 @@ class RawSocketIo(NetIo):
 
     def send(self, ifname: str, src, dst, data: bytes) -> None:
         if ifname is None:
-            # Routed (multihop) send: the kernel FIB picks the egress.
-            # With one open interface we can still satisfy it directly;
-            # otherwise fail loudly — silent drops hide misconfiguration
-            # (callers should resolve the egress from the RIB first).
-            if len(self._socks) == 1:
-                entry = next(iter(self._socks.values()))
-                entry.sock.sendto(data, (str(dst), 0))
-                return
-            raise ValueError(
-                "routed send (ifname=None) is ambiguous with "
-                f"{len(self._socks)} open interfaces; resolve the "
-                "egress interface from the RIB first"
-            )
+            # Routed (multihop) send — e.g. BFD multihop: an UNBOUND raw
+            # socket lets the kernel FIB pick the egress interface, so this
+            # works regardless of how many interface sockets are open.
+            if self._routed_sock is None:
+                self._routed_sock = socket.socket(
+                    socket.AF_INET, socket.SOCK_RAW, self.proto
+                )
+                self._routed_sock.setblocking(False)
+            self._routed_sock.sendto(data, (str(dst), 0))
+            return
         entry = self._socks.get(ifname)
         if entry is None:
             return

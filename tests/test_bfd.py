@@ -113,6 +113,9 @@ def test_bfd_auth_roundtrip_and_verification():
         assert out.auth is not None and out.auth.auth_type == atype
         assert out.verify_auth(wire, b"s3cret")
         assert not out.verify_auth(wire, b"wrong-key")
+        # Trailing datagram bytes must not shift the digest window: the
+        # digest position derives from the packet's own length field.
+        assert out.verify_auth(wire + b"\x00" * 7, b"s3cret")
 
 
 def test_bfd_authenticated_session_rejects_bad_key():

@@ -148,3 +148,50 @@ def test_import_policy_rejects():
     loop.advance(2)
     assert N("203.0.113.0/24") not in b2.loc_rib
     assert N("198.51.100.0/24") in b2.loc_rib
+
+
+def test_engine_deactivation_and_late_neighbor_add():
+    """instance.rs update(): unconfiguring ASN/router-id tears the instance
+    down (sessions closed, tables cleared); neighbors added after activation
+    are instantiated on the next update()."""
+    from holo_tpu.protocols.bgp_engine import (
+        ESTABLISHED,
+        IDLE,
+        BgpEngine,
+        NeighborCfg,
+    )
+
+    sent = []
+    eng = BgpEngine("test", send_cb=lambda k, p: sent.append((k, p)))
+    eng.asn = 65001
+    eng.cfg_identifier = "1.1.1.1"
+    eng.neighbor_cfg["10.0.0.2"] = NeighborCfg(peer_as=65002)
+    eng.update()
+    assert eng.active and "10.0.0.2" in eng.neighbors
+
+    # Late neighbor add: instantiated without instance restart.
+    eng.neighbor_cfg["10.0.0.3"] = NeighborCfg(peer_as=65001)
+    eng.update()
+    assert "10.0.0.3" in eng.neighbors
+    assert eng.neighbors["10.0.0.3"].peer_type == "internal"
+
+    # Pretend one session is up, then unconfigure the ASN: the engine must
+    # go inactive, close sessions (Cease sent), and clear all state.
+    eng.neighbors["10.0.0.2"].state = ESTABLISHED
+    eng.asn = 0
+    eng.update()
+    assert not eng.active and not eng.neighbors
+    cease = [
+        p
+        for k, p in sent
+        if k == "SendMessage" and "Notification" in p.get("msg", {})
+    ]
+    assert cease and cease[0]["msg"]["Notification"]["error_code"] == 6
+
+    # Neighbor config removal while active closes just that neighbor.
+    eng.asn = 65001
+    eng.update()
+    assert eng.active and set(eng.neighbors) == {"10.0.0.2", "10.0.0.3"}
+    del eng.neighbor_cfg["10.0.0.3"]
+    eng.update()
+    assert set(eng.neighbors) == {"10.0.0.2"}

@@ -125,3 +125,38 @@ def test_independent_mode_advertises_immediately():
     b.add_fec(fec, egress=False)  # no downstream mapping, no next hop
     loop.advance(2)
     assert a.neighbors[A("2.2.2.2")].bindings.get(fec) == b.fec_table[fec][0]
+
+
+def test_system_data_tracked_while_inactive():
+    """Addresses and interface state delivered BEFORE activation must be
+    tracked (the reference keeps system data outside instance state,
+    holo-ldp/src/instance.rs:58-63) so a later start sees them."""
+    from ipaddress import ip_interface
+
+    from holo_tpu.protocols.ldp.engine import Interface, InterfaceCfg, LdpEngine
+
+    sent = []
+    eng = LdpEngine("ldp", send_cb=lambda *a: sent.append(a))
+    eng.interfaces["eth0"] = Interface(
+        name="eth0", config=InterfaceCfg(ipv4_enabled=True)
+    )
+    assert not eng.active
+
+    # System events arrive before the instance is configured/active.
+    eng.iface_update("eth0", ifindex=3, operative=True)
+    eng.addr_add("eth0", ip_interface("10.0.1.1/24"))
+    eng.addr_add("lo", ip_interface("1.1.1.1/32"))
+
+    assert eng.interfaces["eth0"].ifindex == 3
+    assert eng.interfaces["eth0"].operative
+    assert eng.interfaces["eth0"].ipv4_addr_list
+    assert ip_interface("1.1.1.1/32") in eng.ipv4_addr_list
+
+    # Activate: the interface must come up from the tracked state alone.
+    from ipaddress import IPv4Address
+
+    eng.config.ipv4_enabled = True
+    eng.config.router_id = IPv4Address("1.1.1.1")
+    eng.update()
+    assert eng.active
+    assert eng.interfaces["eth0"].active

@@ -103,12 +103,17 @@ def test_lsa_fletcher_checksum():
     lsa = make_router_lsa()
     raw = lsa.encode()
     assert fletcher16_verify(raw[2:])
-    # corrupt a body byte -> decode must fail
+    # Corrupt a body byte: the decode is tolerant (reference parity) but
+    # the instance-level validation must flag invalid-checksum so the rx
+    # path discards it with an if-rx-bad-lsa notification.
+    from holo_tpu.protocols.ospf.instance import OspfInstance
+
     bad = bytearray(raw)
     bad[25] ^= 0x01
-    with pytest.raises(DecodeError, match="checksum"):
-        Lsa.decode(Reader(bytes(bad)))
+    out_bad = Lsa.decode(Reader(bytes(bad)))
+    assert OspfInstance._validate_lsa(out_bad) == "invalid-checksum"
     out = Lsa.decode(Reader(raw))
+    assert OspfInstance._validate_lsa(out) is None
     assert out.body.links == lsa.body.links
     assert out.seq_no == lsa.seq_no
 

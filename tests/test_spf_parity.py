@@ -13,6 +13,11 @@ from holo_tpu.spf.synth import random_ospf_topology, whatif_link_failure_masks
 
 N_ATOMS = 64
 
+# Every gather-path fixpoint formulation must be bit-identical; 'fused'
+# is the production default, 'packed' the single-gather TPU variant,
+# 'seq' the original staged-loop form.
+ENGINES = ["fused", "packed", "seq"]
+
 
 def assert_parity(topo, scalar_res, tpu_res):
     np.testing.assert_array_equal(scalar_res.dist, tpu_res.dist, err_msg="dist")
@@ -23,6 +28,7 @@ def assert_parity(topo, scalar_res, tpu_res):
     )
 
 
+@pytest.mark.parametrize("engine", ENGINES)
 @pytest.mark.parametrize("seed", range(8))
 @pytest.mark.parametrize(
     "shape",
@@ -32,10 +38,10 @@ def assert_parity(topo, scalar_res, tpu_res):
         dict(n_routers=40, n_networks=10, extra_p2p=60),
     ],
 )
-def test_single_spf_parity(seed, shape):
+def test_single_spf_parity(seed, shape, engine):
     topo = random_ospf_topology(seed=seed, **shape)
     scalar = ScalarSpfBackend(N_ATOMS).compute(topo)
-    tpu = TpuSpfBackend(N_ATOMS).compute(topo)
+    tpu = TpuSpfBackend(N_ATOMS, one_engine=engine).compute(topo)
     assert_parity(topo, scalar, tpu)
 
 
@@ -72,12 +78,13 @@ def test_disconnected_component_unreachable():
     assert (tpu.dist[unreachable] == INF).all()
 
 
+@pytest.mark.parametrize("engine", ENGINES)
 @pytest.mark.parametrize("seed", range(3))
-def test_whatif_batch_parity(seed):
+def test_whatif_batch_parity(seed, engine):
     topo = random_ospf_topology(n_routers=16, n_networks=5, seed=seed)
     masks = whatif_link_failure_masks(topo, n_scenarios=8, seed=seed)
     scalar = ScalarSpfBackend(N_ATOMS).compute_whatif(topo, masks)
-    tpu = TpuSpfBackend(N_ATOMS).compute_whatif(topo, masks)
+    tpu = TpuSpfBackend(N_ATOMS, one_engine=engine).compute_whatif(topo, masks)
     for s, t in zip(scalar, tpu):
         assert_parity(topo, s, t)
 
