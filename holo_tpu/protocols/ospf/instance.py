@@ -219,6 +219,11 @@ _PKT_TYPE_YANG = {
 }
 
 
+# Sentinel: a queued origination check whose subject vanished between
+# trigger and dequeue (area/interface removed) — dropped, never installed.
+_CHECK_SKIP = object()
+
+
 class OspfInstance(Actor):
     """One OSPFv2 routing process."""
 
@@ -272,6 +277,8 @@ class OspfInstance(Actor):
         # suppressed and pre-restart copies are adopted (not outpaced) so
         # helpers keep forwarding on the pre-restart topology.
         self.gr_restarting = False
+        self._gr_grace_period = 120  # last announced/entered grace params
+        self._gr_reason = 1
         # Admin state: False after a disable (operational state renders a
         # minimal tree, like the reference's torn-down Instance).
         self.enabled = True
@@ -309,11 +316,13 @@ class OspfInstance(Actor):
         # Which interface each link-scope (type 9) LSA belongs to, for
         # per-interface operational-state grouping (state.rs link db).
         self._link_scope_iface: dict[LsaKey, str] = {}
-        # Routers reachable per area in the last SPF (intra-area paths):
-        # serves abr-count/asbr-count (reference area.rs:164-182).
-        self._area_reachable_routers: dict[IPv4Address, set] = {}
+        # Routers reachable per area in the last SPF (intra-area paths),
+        # rid -> RouterFlags captured at SPF time: serves abr-count/
+        # asbr-count (reference area.rs:164-182).
+        self._area_reachable_routers: dict[IPv4Address, dict] = {}
         # Deferred origination checks (see InstanceConfig.external_orig_checks):
-        # key -> kwargs, deduped so N triggers collapse into one rebuild.
+        # key -> kwargs, deduped so N triggers collapse into one rebuild at
+        # the recorded check position (see _queue_check).
         self._pending_checks: dict[tuple, dict] = {}
 
     _SEQNO_WINDOW = 1 << 16
@@ -373,12 +382,13 @@ class OspfInstance(Actor):
             self._originate_router_info(area)
         return iface
 
-    def _do_originate_router_info(self, area: Area) -> None:
+    def _build_router_info(self, area: Area):
         """RFC 7770 Router-Information opaque LSA (one per area).
 
         Advertises the informational capabilities the instance actually
         has: GR helper (gr.rs) and stub-router support (reference
-        holo-ospf originates the same pair at area start).
+        holo-ospf originates the same pair at area start).  Returns
+        (lsid, body) for the deferred-check queue.
         """
         from holo_tpu.protocols.ospf.packet import (
             RI_CAP_GR_HELPER,
@@ -391,16 +401,13 @@ class OspfInstance(Actor):
         caps = RI_CAP_STUB_ROUTER
         if self.config.gr_helper_enabled:
             caps |= RI_CAP_GR_HELPER
-        opts = Options(0) if area.no_type5 else Options.E
-        self._originate(
-            area,
-            LsaType.OPAQUE_AREA,
+        return (
             ri_lsid(),
             LsaOpaque(
                 data=encode_router_info(caps, self.hostname, self.node_tags)
             ),
-            options=opts,
         )
+
 
     def set_node_tags(self, tags: tuple[int, ...]) -> None:
         """RFC 7777 node administrative tags (RI LSA, re-originated on
@@ -576,10 +583,6 @@ class OspfInstance(Actor):
         if iface.state == new:
             return
         iface.state = new
-        if iface.config.loopback:
-            # Loopback interfaces never run the ISM in the reference —
-            # no if-state-change notifications for them.
-            return
         from holo_tpu.protocols.ospf.nb_state import _ISM_NAME
 
         self._notify(
@@ -670,8 +673,16 @@ class OspfInstance(Actor):
                 area,
                 LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id),
             )
-        for nbr_id in list(iface.neighbors):
-            self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+        # Teardown kills neighbors without re-running DR election — the
+        # reference's InterfaceDown FSM goes straight to Down; an interim
+        # election here would emit a spurious if-state-change (e.g. "dr")
+        # before the "down" notification.
+        iface.going_down = True
+        try:
+            for nbr_id in list(iface.neighbors):
+                self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+        finally:
+            iface.going_down = False
         self._set_ism_state(iface, IsmState.DOWN)
         iface.dr = IPv4Address(0)
         iface.bdr = IPv4Address(0)
@@ -1133,6 +1144,8 @@ class OspfInstance(Actor):
             grace_lsa_lsid,
         )
 
+        self._gr_grace_period = grace_period
+        self._gr_reason = reason
         for area in self.areas.values():
             for idx, iface in enumerate(area.interfaces.values()):
                 if iface.state == IsmState.DOWN or iface.addr_ip is None:
@@ -1155,6 +1168,7 @@ class OspfInstance(Actor):
         normal operation with whatever adjacencies exist — a vanished
         pre-restart neighbor must not suppress origination forever."""
         self.gr_restarting = True
+        self._gr_grace_period = grace_period
         t = self._timers.get(("gr-expire",))
         if t is None:
             t = self.loop.timer(self.name, GrRestartExpireMsg)
@@ -1199,9 +1213,24 @@ class OspfInstance(Actor):
         The opaque id encodes the interface's position in the area's
         interface order (assigned identically in send_grace_lsas), so the
         maxage copy floods on exactly its own link.
+
+        A freshly restarted instance usually does NOT hold its own
+        pre-restart Grace-LSAs (DD exchange excludes link-local opaques),
+        so flushing by LSDB lookup alone would silently do nothing and
+        helpers would sit out the whole grace period.  For interfaces with
+        no stored copy we synthesize the MaxAge Grace-LSA directly with a
+        sequence number strictly newer than any plausible pre-restart
+        copy, so helpers accept the flush under RFC 2328 §13.1.
         """
+        from holo_tpu.protocols.ospf.packet import (
+            LsaOpaque,
+            encode_grace_tlvs,
+            grace_lsa_lsid,
+        )
+
         for area in self.areas.values():
             ifaces = list(area.interfaces.values())
+            flushed: set = set()
             for key in list(area.lsdb.entries):
                 if (
                     key.type == LsaType.OPAQUE_LINK
@@ -1211,6 +1240,34 @@ class OspfInstance(Actor):
                     idx = int(key.lsid) & 0xFFFFFF
                     only = ifaces[idx] if idx < len(ifaces) else None
                     self._flush_self_lsa(area, key, only_iface=only)
+                    flushed.add(idx)
+            for idx, iface in enumerate(ifaces):
+                if idx in flushed:
+                    continue
+                if iface.state == IsmState.DOWN or iface.addr_ip is None:
+                    continue
+                lsa = Lsa(
+                    age=MAX_AGE,
+                    options=Options(0) if area.stub else Options.E,
+                    type=LsaType.OPAQUE_LINK,
+                    lsid=grace_lsa_lsid(idx),
+                    adv_rtr=self.config.router_id,
+                    # A few past the initial seq-no: strictly newer than
+                    # the pre-restart copies helpers hold — including ones
+                    # re-announced with changed grace TLVs (each change
+                    # advanced the pre-restart seq by one; at equal seq
+                    # the cksum tie-break could keep the helper's copy) —
+                    # without any record of how far the old instance got.
+                    seq_no=next_seq_no(None) + 4,
+                    body=LsaOpaque(
+                        encode_grace_tlvs(
+                            self._gr_grace_period, self._gr_reason,
+                            iface.addr_ip,
+                        )
+                    ),
+                )
+                lsa.encode()
+                self._install_and_flood(area, lsa, only_iface=iface)
 
     def _maybe_enter_gr_helper(self, area: Area, lsa: Lsa) -> None:
         from holo_tpu.protocols.ospf.packet import decode_grace_tlvs
@@ -1325,7 +1382,11 @@ class OspfInstance(Actor):
             self._originate_router_lsa(area)
             self._originate_network_lsa(area, iface)
         if event in (NsmEvent.KILL_NBR, NsmEvent.INACTIVITY_TIMER, NsmEvent.ONE_WAY_RECEIVED):
-            if iface.config.if_type == IfType.BROADCAST and iface.state >= IsmState.DR_OTHER:
+            if (
+                iface.config.if_type == IfType.BROADCAST
+                and iface.state >= IsmState.DR_OTHER
+                and not getattr(iface, "going_down", False)
+            ):
                 self._run_dr_election(area, iface)
 
     # ----- DD exchange
@@ -1730,11 +1791,15 @@ class OspfInstance(Actor):
                             )
         if lsa.type == LsaType.AS_EXTERNAL and changed and len(self.areas) > 1:
             self._propagate_external(area, lsa)
-        # Link-local opaque LSAs (type 9) never leave their link: received
-        # copies are not re-flooded at all; self-originated ones go out on
-        # the originating interface only (RFC 5250 §3).
+        # Link-local opaque LSAs (type 9) never leave their link
+        # (RFC 5250 §3): received copies re-flood ONLY on the receiving
+        # interface (other neighbors on the same segment still need them —
+        # e.g. a Grace-LSA on a broadcast link); self-originated ones go
+        # out on the originating interface only.
         if lsa.type == LsaType.OPAQUE_LINK and only_iface is None:
-            return
+            if from_iface is None:
+                return
+            only_iface = from_iface
         self._flood(area, lsa, from_iface, from_nbr, only_iface=only_iface)
         # MaxAge copies STAY installed (marked maxage in operational
         # state, invisible to SPF) until the rxmt lists drain — the
@@ -1842,11 +1907,14 @@ class OspfInstance(Actor):
         if (
             not force
             and old is not None
+            and not old.lsa.is_maxage
             and old.lsa.raw[20:] == lsa.raw[20:]
             and old.lsa.options == options
         ):
             # Unchanged content AND header options (the NSSA P-bit lives
-            # in the header): no re-origination needed.
+            # in the header): no re-origination needed.  A MaxAge copy
+            # (mid-flush) never suppresses: wanting the LSA again after a
+            # premature age requires a fresh instance (§12.4/14.1).
             return
         self._install_and_flood(area, lsa, only_iface=only_iface)
 
@@ -1962,42 +2030,122 @@ class OspfInstance(Actor):
     # -- deferred origination checks (reference lsdb.rs:589-660)
 
     def _queue_check(self, key: tuple, **kwargs) -> None:
+        """Reference semantics (lsdb.rs:589-660): originations are deferred
+        originate-check messages processed later by the instance loop.
+        Production (external_orig_checks=False) runs them inline; the
+        conformance harness defers them to the recorded LsaOrigCheck
+        positions via flush_orig_checks — it drives the *cadence* (when
+        the reference rebuilt and whether it bumped the sequence number)
+        from the recording while the LSA *content* always comes from our
+        own state."""
         if self.config.external_orig_checks:
             self._pending_checks[key] = kwargs
         else:
-            self._run_check(key, **kwargs)
+            self._run_check(key, self._build_check(key), **kwargs)
 
-    def flush_orig_checks(self, kind: str | None = None) -> None:
-        """Run the accumulated origination checks against CURRENT state.
+    def flush_orig_checks(
+        self,
+        kind: str | None = None,
+        area_id: IPv4Address | None = None,
+        force: bool = False,
+    ) -> None:
+        """Run deferred origination checks against CURRENT state.
 
-        Called by the conformance harness at each recorded LsaOrigCheck
-        position (``kind`` narrows to that check's LSA class — the
-        reference's checks are per-LSA messages): N earlier triggers
-        rebuild once here, and the unchanged-content skip in
-        :meth:`_originate` coalesces them — reproducing the reference's
-        deferred originate_check batching."""
-        run = [
+        With ``kind`` (a recorded LsaOrigCheck position, ``area_id`` from
+        its recorded lsdb_key): rebuild that LSA class in that area now.
+        ``force=True`` replays a position where the reference's recorded
+        body changed — the sequence number advances even when our content
+        is unchanged, keeping our instance count aligned with the
+        recorded ack stream.  Without ``kind`` (end-of-step quiescence):
+        drain everything pending normally."""
+        if kind is None:
+            pending, self._pending_checks = self._pending_checks, {}
+            for key, kwargs in pending.items():
+                self._run_check(key, self._build_check(key), **kwargs)
+            return
+        keys = [
             k
             for k in self._pending_checks
-            if kind is None or k[0] == kind
+            if k[0] == kind and (area_id is None or k[1] == area_id)
         ]
-        for key in run:
-            kwargs = self._pending_checks.pop(key)
-            self._run_check(key, **kwargs)
+        if not keys:
+            # The reference re-originated here from a trigger we never
+            # raised: rebuild from current state so the LSDB keeps pace.
+            keys = self._fallback_check_keys(kind, area_id)
+        for key in keys:
+            kwargs = self._pending_checks.pop(key, {})
+            if force:
+                kwargs = {**kwargs, "force": True}
+            self._run_check(key, self._build_check(key), **kwargs)
 
-    def _run_check(self, key: tuple, **kwargs) -> None:
+    def _fallback_check_keys(
+        self, kind: str, area_id: IPv4Address | None = None
+    ):
+        """Plausible check keys when a recorded check has no queued match:
+        one per area (router/RI) or per DR interface (network), narrowed
+        to the recorded check's area when known.  A named area we don't
+        have (yet) yields nothing — widening to every area would
+        force-bump unrelated LSAs."""
+        if area_id is not None and area_id not in self.areas:
+            return []
+        aids = [area_id] if area_id is not None else list(self.areas)
+        if kind in ("router", "ri"):
+            return [(kind, aid) for aid in aids]
+        if kind == "network":
+            return [
+                ("network", aid, iface.name)
+                for aid in aids
+                for iface in self.areas[aid].interfaces.values()
+                if iface.is_dr()
+            ]
+        return []
+
+    def _build_check(self, key: tuple):
+        """Build the LSA body for a queued check from CURRENT state."""
         kind = key[0]
         area = self.areas.get(key[1])
         if area is None:
+            return _CHECK_SKIP
+        if kind == "router":
+            return self._build_router_lsa(area)
+        if kind == "network":
+            iface = area.interfaces.get(key[2])
+            if iface is None:
+                return _CHECK_SKIP
+            return self._build_network_lsa(area, iface)
+        if kind == "ri":
+            return self._build_router_info(area)
+        return _CHECK_SKIP
+
+    def _run_check(self, key: tuple, body, **kwargs) -> None:
+        kind = key[0]
+        area = self.areas.get(key[1])
+        if area is None or body is _CHECK_SKIP:
             return
         if kind == "router":
-            self._do_originate_router_lsa(area, **kwargs)
+            self._originate(
+                area, LsaType.ROUTER, self.config.router_id, body, **kwargs
+            )
         elif kind == "network":
             iface = area.interfaces.get(key[2])
-            if iface is not None:
-                self._do_originate_network_lsa(area, iface, **kwargs)
+            if iface is None:
+                return
+            if body is None:
+                lkey = LsaKey(
+                    LsaType.NETWORK, iface.addr_ip, self.config.router_id
+                )
+                if area.lsdb.get(lkey) is not None:
+                    self._flush_self_lsa(area, lkey)
+            else:
+                self._originate(
+                    area, LsaType.NETWORK, iface.addr_ip, body, **kwargs
+                )
         elif kind == "ri":
-            self._do_originate_router_info(area, **kwargs)
+            opts = Options(0) if area.no_type5 else Options.E
+            self._originate(
+                area, LsaType.OPAQUE_AREA, body[0], body[1],
+                options=opts, **kwargs
+            )
 
     def _originate_router_lsa(self, area: Area, force: bool = False) -> None:
         self._queue_check(("router", area.area_id), force=force)
@@ -2009,12 +2157,6 @@ class OspfInstance(Actor):
 
     def _originate_router_info(self, area: Area) -> None:
         self._queue_check(("ri", area.area_id))
-
-    def _do_originate_router_lsa(self, area: Area, force: bool = False) -> None:
-        body = self._build_router_lsa(area)
-        self._originate(
-            area, LsaType.ROUTER, self.config.router_id, body, force=force
-        )
 
     def _build_router_lsa(self, area: Area) -> "LsaRouter":
         links: list[RouterLink] = []
@@ -2079,27 +2221,19 @@ class OspfInstance(Actor):
             flags |= RouterFlags.B
         if self.is_asbr:
             flags |= RouterFlags.E
-        body = LsaRouter(flags=flags, links=links)
-        self._originate(
-            area, LsaType.ROUTER, self.config.router_id, body, force=force
-        )
+        return LsaRouter(flags=flags, links=links)
 
-    def _do_originate_network_lsa(
-        self, area: Area, iface: OspfInterface, force: bool = False
-    ) -> None:
-        key = LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id)
+    def _build_network_lsa(self, area: Area, iface: OspfInterface):
+        """Network-LSA body for the deferred-check queue, or None when the
+        LSA should be withdrawn (not DR / no full neighbors)."""
         full = [n.router_id for n in iface.neighbors.values()
                 if self._nbr_counts_full(n)]
         if iface.is_dr() and full and iface.prefix is not None:
-            body = LsaNetwork(
+            return LsaNetwork(
                 mask=mask_of(iface.prefix),
                 attached=sorted([self.config.router_id] + full, key=int),
             )
-            self._originate(
-                area, LsaType.NETWORK, iface.addr_ip, body, force=force
-            )
-        elif area.lsdb.get(key) is not None:
-            self._flush_self_lsa(area, key)
+        return None
 
     # ----- aging / refresh
 
@@ -2235,12 +2369,20 @@ class OspfInstance(Actor):
                 continue
             res = self.backend.compute(st.topo)
             area_results[area.area_id] = (st, res)
-            # Reachable-router set per area: operational state serves
-            # abr-count/asbr-count from it (reference area.rs:164-182).
+            # Reachable routers per area WITH their flags as of this SPF
+            # run: operational state serves abr-count/asbr-count from the
+            # SPF products (reference area.rs:164-182 counts
+            # area.state.routers, whose flags were captured at route
+            # computation — NOT the live LSDB, which may have changed
+            # since, e.g. right after a clear-database RPC).
             from holo_tpu.ops.graph import INF as _INF
 
+            flags_now = {}
+            for key, e in area.lsdb.entries.items():
+                if key.type == LsaType.ROUTER and not e.lsa.is_maxage:
+                    flags_now[key.adv_rtr] = e.lsa.body.flags
             self._area_reachable_routers[area.area_id] = {
-                rid
+                rid: flags_now.get(rid, RouterFlags(0))
                 for rid, v in st.router_index.items()
                 if res.dist[v] < _INF
             }

@@ -327,15 +327,6 @@ def _db_buckets(entries, now, kind: str) -> tuple[list, list]:
     return db, stats
 
 
-def _router_flag_map(lsdb: Lsdb) -> dict:
-    """adv-router -> RouterFlags from the area's router LSAs."""
-    out = {}
-    for e in lsdb.all():
-        if e.lsa.type == LsaType.ROUTER:
-            out[e.lsa.adv_rtr] = e.lsa.body.flags
-    return out
-
-
 def _iface_state(
     inst, area, iface: OspfInterface, link_lsas: list, now
 ) -> dict:
@@ -442,20 +433,22 @@ def instance_state(inst) -> dict:
                     hostnames[e.lsa.adv_rtr] = info["hostname"]
 
         db, stats = _db_buckets(area_entries, now, "area-scope")
-        flags = _router_flag_map(area.lsdb)
-        reachable = inst._area_reachable_routers.get(aid, set())
+        # Router flags come from the SPF products (captured at SPF time),
+        # not the live LSDB — reference area.rs:164-182 counts
+        # area.state.routers, which go stale together.
+        reachable = inst._area_reachable_routers.get(aid, {})
         a: dict = {
             "area-id": str(aid),
             "statistics": {
                 "abr-count": sum(
                     1
-                    for r in reachable
-                    if flags.get(r, RouterFlags(0)) & RouterFlags.B
+                    for fl in reachable.values()
+                    if fl & RouterFlags.B
                 ),
                 "asbr-count": sum(
                     1
-                    for r in reachable
-                    if flags.get(r, RouterFlags(0)) & RouterFlags.E
+                    for fl in reachable.values()
+                    if fl & RouterFlags.E
                 ),
                 "area-scope-lsa-count": sum(
                     s["lsa-count"] for s in stats

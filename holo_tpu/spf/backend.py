@@ -111,7 +111,7 @@ class TpuSpfBackend(SpfBackend):
         n_atoms: int = 64,
         max_iters: int | None = None,
         engine: str = "gather",
-        one_engine: str = "fused",
+        one_engine: str = "seq",
     ):
         """``engine``: 'gather' (ELL gathers; handles any topology) or
         'blocked' (block-sparse Pallas kernels; fastest on large LSDBs,
@@ -120,7 +120,10 @@ class TpuSpfBackend(SpfBackend):
 
         ``one_engine`` picks the gather-path fixpoint formulation
         ('fused' | 'packed' | 'seq' — see :func:`spf_one_fused`); all are
-        bit-identical, differing only in TPU round/gather scheduling."""
+        bit-identical, differing only in TPU round/gather scheduling.
+        'seq' is the default: it is the fastest measured formulation on
+        the only platform benchmarked so far (JAX-CPU; BENCH_r03) — flip
+        per-platform only once a TPU run shows another engine winning."""
         self.n_atoms = n_atoms
         self.max_iters = max_iters
         self.engine = engine

@@ -92,6 +92,13 @@ class CaseRun:
         )
         self.inst.config.deterministic_dd = True
         self.inst.config.external_orig_checks = True
+        # Last recorded lsa_body per (class, area, lsa-id) — cadence
+        # tracking for the LsaOrigCheck replay (see apply_protocol).
+        self._check_hist: dict[tuple, str] = {}
+        # True while replaying the recorded bring-up stream (interface
+        # up-transitions gate on recorded ISM positions); False during
+        # steps (the ISM reacts to inputs directly).
+        self.in_bring_up = True
         # The replay clock is frozen (recordings carry no timestamps), so
         # the RFC §13(5a) MinLSArrival throttle would reject every newer
         # copy of an LSA; the recording is the reference's own accepted
@@ -155,6 +162,21 @@ class CaseRun:
 
     # -- input application
 
+    def _maybe_step_up(self, ifname: str) -> None:
+        """Bring a ready-but-down interface up during the STEP phase.
+
+        Bring-up replay instead gates up-transitions on the recorded
+        InterfaceStateChange positions (see _ensure_iface), so this is a
+        no-op while in_bring_up."""
+        if (
+            not self.in_bring_up
+            and ifname in self.ready
+            and ifname not in self.up
+        ):
+            self.up.add(ifname)
+            self.loop.send(self.inst.name, IfUpMsg(ifname))
+            self.loop.run_until_idle()
+
     def _ensure_iface(self, ifname: str) -> None:
         if ifname in self.up or ifname not in self.if_conf:
             return
@@ -208,12 +230,17 @@ class CaseRun:
             # Initial config snapshot applies at area creation only —
             # later config-change mutations must not be clobbered.
             self.inst.areas[aid].summary = area.get("summary", True)
-        # The interface is created but comes UP only at the recorded
-        # InterfaceStateChange origination-event position — the moment the
-        # reference's own ISM ran its up transition (its system events and
-        # ISM processing are decoupled; ours must match that timing for
-        # identical LSA instance histories).
+        # The reference's ISM runs INLINE during southbound processing —
+        # the recorded InterfaceStateChange position is only the (later)
+        # dequeue of the origination event it raised.  Bring the
+        # interface up here so packets recorded between the real ISM
+        # transition and that position aren't dropped; LSA instance
+        # cadence is driven separately by the recorded LsaOrigCheck
+        # stream, so early origination cannot desynchronize it.
         self.ready.add(ifname)
+        self.up.add(ifname)
+        self.loop.send(self.inst.name, IfUpMsg(ifname))
+        self.loop.run_until_idle()
 
     def _iface_by_key(self, key, area_key=None) -> str | None:
         if isinstance(key, dict):
@@ -253,6 +280,10 @@ class CaseRun:
             if iface is not None:
                 iface.ifindex = upd.get("ifindex", iface.ifindex)
                 iface.config.mtu = upd.get("mtu", iface.config.mtu)
+            # Step phase: the interface just became operative — the
+            # reference's ISM brings it up directly (bring-up replay
+            # instead gates on recorded InterfaceStateChange positions).
+            self._maybe_step_up(ifname)
         elif "InterfaceAddressAdd" in ev:
             upd = ev["InterfaceAddressAdd"]
             try:
@@ -267,6 +298,10 @@ class CaseRun:
                 self.loop.run_until_idle()
             else:
                 self._ensure_iface(upd["ifname"])
+                # Step inputs have no recorded InterfaceStateChange
+                # positions — the reference's ISM reacts to the address
+                # appearing, so bring the interface up immediately.
+                self._maybe_step_up(upd["ifname"])
         elif "InterfaceAddressDel" in ev:
             upd = ev["InterfaceAddressDel"]
             try:
@@ -442,20 +477,40 @@ class CaseRun:
                 self.loop.send(self.inst.name, IfUpMsg(ifname))
                 self.loop.run_until_idle()
         elif "LsaOrigCheck" in ev:
-            # The reference's deferred originate_check position: flush our
-            # queued check for the SAME LSA class so earlier triggers
-            # rebuild exactly here (lsdb.rs:589-660).  The recorded body
-            # identifies the class; unmatched classes flush unfiltered.
-            body = ev["LsaOrigCheck"].get("lsa_body", {})
+            # The reference's deferred originate_check position
+            # (lsdb.rs:589-660).  The recorded check carries the body the
+            # reference built: we use it only as CADENCE — a position
+            # whose recorded body differs from the previous recorded body
+            # of the same LSA is one where the reference bumped the
+            # sequence number, so we rebuild (from OUR state) with a
+            # forced bump; an unchanged position was a same-contents
+            # no-op there and is skipped here.  Content never comes from
+            # the recording.
+            chk = ev["LsaOrigCheck"]
+            body = chk.get("lsa_body", {})
             kind = next(iter(body), "")
-            if kind == "Router":
-                self.inst.flush_orig_checks("router")
-            elif kind == "Network":
-                self.inst.flush_orig_checks("network")
-            elif kind == "OpaqueArea":
-                self.inst.flush_orig_checks("ri")
-            else:
-                self.inst.flush_orig_checks()
+            lsdb = (chk.get("lsdb_key") or {}).get("Area")
+            aid = None
+            if isinstance(lsdb, dict):
+                if "Value" in lsdb:
+                    aid = IPv4Address(lsdb["Value"])
+                elif "Id" in lsdb:
+                    aid = self.area_by_id.get(lsdb["Id"])
+            kmap = {"Router": "router", "Network": "network",
+                    "OpaqueArea": "ri"}
+            if kind in kmap:
+                hist_key = (kind, str(aid), chk.get("lsa_id"))
+                rec = json.dumps(body, sort_keys=True)
+                changed = self._check_hist.get(hist_key) != rec
+                self._check_hist[hist_key] = rec
+                if changed:
+                    self.inst.flush_orig_checks(
+                        kmap[kind], area_id=aid, force=True
+                    )
+            # Other recorded classes (SummaryNetwork, ...) originate via
+            # the SPF/ABR machinery on their own triggers — draining the
+            # deferred-check queue here would install router/network
+            # checks early and desynchronize instance counts.
             self.loop.run_until_idle()
         elif any(
             k in ev
@@ -498,6 +553,7 @@ class CaseRun:
         return None
 
     def bring_up(self) -> None:
+        self.in_bring_up = True
         for line in (self.rt_dir / "events.jsonl").read_text().splitlines():
             line = line.strip()
             if not line:
@@ -507,6 +563,7 @@ class CaseRun:
                 self.apply_ibus(ev["Ibus"])
             elif "Protocol" in ev:
                 self.apply_protocol(ev["Protocol"])
+        self.in_bring_up = False
 
     # -- step outputs
 

@@ -38,9 +38,14 @@ class RawSocketIo(NetIo):
     to the owning actor with the IP header stripped.
     """
 
-    def __init__(self, loop_: EventLoop, proto: int = OSPF_PROTO):
+    def __init__(self, loop_: EventLoop, proto: int = OSPF_PROTO,
+                 routed_ttl: int = 255):
         self.loop = loop_
         self.proto = proto
+        # TTL for routed (multihop) sends. 255 by default: GTSM (RFC 5082)
+        # peers validate the received TTL against their hop-count budget,
+        # so senders must start from the maximum.
+        self.routed_ttl = routed_ttl
         self._socks: dict[str, _IfSock] = {}
         self._by_fd: dict[int, _IfSock] = {}
         self._routed_sock: socket.socket | None = None
@@ -75,6 +80,14 @@ class RawSocketIo(NetIo):
             self._by_fd.pop(entry.sock.fileno(), None)
             entry.sock.close()
 
+    def close(self) -> None:
+        """Tear down every interface socket and the routed (multihop) one."""
+        for ifname in list(self._socks):
+            self.close_interface(ifname)
+        if self._routed_sock is not None:
+            self._routed_sock.close()
+            self._routed_sock = None
+
     def fds(self) -> list[int]:
         return list(self._by_fd.keys())
 
@@ -88,6 +101,9 @@ class RawSocketIo(NetIo):
             if self._routed_sock is None:
                 self._routed_sock = socket.socket(
                     socket.AF_INET, socket.SOCK_RAW, self.proto
+                )
+                self._routed_sock.setsockopt(
+                    socket.IPPROTO_IP, socket.IP_TTL, self.routed_ttl
                 )
                 self._routed_sock.setblocking(False)
             self._routed_sock.sendto(data, (str(dst), 0))
