@@ -324,6 +324,10 @@ class OspfInstance(Actor):
         # key -> kwargs, deduped so N triggers collapse into one rebuild at
         # the recorded check position (see _queue_check).
         self._pending_checks: dict[tuple, dict] = {}
+        # Prefixes we've actually pushed to the RIB — tracked explicitly
+        # because route objects can mutate between syncs, so inferring
+        # "was installed" from snapshots is unreliable (see _sync_rib).
+        self._installed_prefixes: set = set()
 
     _SEQNO_WINDOW = 1 << 16
 
@@ -665,14 +669,11 @@ class OspfInstance(Actor):
         if ai is None:
             return
         area, iface = ai
-        # Flush our network LSA while the interface can still flood it
-        # (the reference's down path floods the MaxAge copy on the dying
-        # segment too).
-        if iface.is_dr() and iface.addr_ip is not None:
-            self._flush_self_lsa(
-                area,
-                LsaKey(LsaType.NETWORK, iface.addr_ip, self.config.router_id),
-            )
+        # No network-LSA flush here: the reference's interface stop only
+        # resets state (interface.rs:391-437) — the MaxAge flood happens
+        # solely on a DR change while the interface is still up.  The
+        # stale network LSA is invalidated anyway once our router-LSA
+        # stops listing the transit link.
         # Teardown kills neighbors without re-running DR election — the
         # reference's InterfaceDown FSM goes straight to Down; an interim
         # election here would emit a spurious if-state-change (e.g. "dr")
@@ -1660,8 +1661,14 @@ class OspfInstance(Actor):
                 )
                 continue
             # Flooding scope (§3.6 / RFC 3101 §2.2): no type-5s into
-            # stub or NSSA areas, type-7s only inside an NSSA.
-            if lsa.type == LsaType.AS_EXTERNAL and area.no_type5:
+            # stub or NSSA areas — nor type-4 ASBR-summaries or AS-scope
+            # opaques (RFC 2328 errata 3746; reference lsdb.rs:85-99) —
+            # and type-7s only inside an NSSA.
+            if lsa.type in (
+                LsaType.AS_EXTERNAL,
+                LsaType.SUMMARY_ROUTER,
+                LsaType.OPAQUE_AS,
+            ) and area.no_type5:
                 continue
             if lsa.type == LsaType.NSSA_EXTERNAL and not area.nssa:
                 continue
@@ -1696,14 +1703,18 @@ class OspfInstance(Actor):
                     or self_net_iface is not None
                 ) and not lsa.is_maxage:
                     prev_lsa = cur.lsa if cur is not None else None
-                    self._install_and_flood(
+                    fb = self._install_and_flood(
                         area, lsa, from_iface=iface, from_nbr=nbr
                     )
-                    acks.append(lsa)
+                    if self._ack_wanted(iface, nbr, fb):
+                        acks.append(lsa)
                     self._post_self_orig(area, lsa, prev_lsa, self_net_iface)
                     continue
-                self._install_and_flood(area, lsa, from_iface=iface, from_nbr=nbr)
-                acks.append(lsa)
+                fb = self._install_and_flood(
+                    area, lsa, from_iface=iface, from_nbr=nbr
+                )
+                if self._ack_wanted(iface, nbr, fb):
+                    acks.append(lsa)
             elif lsa.key in nbr.ls_request:
                 # §13 (4)... actually handled via request list below.
                 self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
@@ -1735,6 +1746,17 @@ class OspfInstance(Actor):
         elif nbr.state == NsmState.LOADING:
             self._send_ls_request(area, iface, nbr)
 
+    @staticmethod
+    def _ack_wanted(iface: OspfInterface, nbr: Neighbor, flooded_back: bool) -> bool:
+        """§13.5 (5.e) delayed-ack condition (events.rs:941-947): no ack
+        when the LSA was flooded back out the receiving interface, and a
+        Backup DR only acks what arrived from the DR."""
+        if flooded_back:
+            return False
+        return (
+            iface.state != IsmState.BACKUP or nbr.src == iface.dr
+        )
+
     def _rx_ls_ack(self, area: Area, iface: OspfInterface, src: IPv4Address, pkt: Packet) -> None:
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EXCHANGE:
@@ -1749,9 +1771,11 @@ class OspfInstance(Actor):
 
     def _install_and_flood(
         self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
-    ) -> None:
+    ) -> bool:
+        """Installs and floods; returns the §13.5 flooded-back flag (see
+        _flood)."""
         if lsa.type == LsaType.AS_EXTERNAL and area.stub:
-            return  # §3.6: stub areas refuse AS-external LSAs
+            return False  # §3.6: stub areas refuse AS-external LSAs
         now = self.loop.clock.now()
         _, changed = area.lsdb.install(lsa, now)
         if lsa.type == LsaType.OPAQUE_LINK:
@@ -1798,16 +1822,20 @@ class OspfInstance(Actor):
         # out on the originating interface only.
         if lsa.type == LsaType.OPAQUE_LINK and only_iface is None:
             if from_iface is None:
-                return
+                return False
             only_iface = from_iface
-        self._flood(area, lsa, from_iface, from_nbr, only_iface=only_iface)
         # MaxAge copies STAY installed (marked maxage in operational
         # state, invisible to SPF) until the rxmt lists drain — the
         # RFC 2328 §14 removal condition, swept from the age tick.
+        return self._flood(area, lsa, from_iface, from_nbr, only_iface=only_iface)
 
     def _flood(
         self, area: Area, lsa: Lsa, from_iface=None, from_nbr=None, only_iface=None
-    ) -> None:
+    ) -> bool:
+        """Returns True if the LSA was flooded back out the RECEIVING
+        interface — the §13.5 'flooded back' condition that suppresses
+        the delayed acknowledgment (reference events.rs:941-947)."""
+        flooded_back = False
         for iface in area.interfaces.values():
             if iface.state == IsmState.DOWN:
                 continue
@@ -1834,10 +1862,16 @@ class OspfInstance(Actor):
             if not flood_it:
                 continue
             if iface is from_iface and from_nbr is not None:
-                # §13.3 (4): received on this iface from DR/BDR → skip send.
+                # §13.3 (3): received on this iface from DR/BDR → skip send.
                 if from_nbr.src in (iface.dr, iface.bdr):
                     continue
+                # §13.3 (4): the Backup DR defers to the DR's re-flood.
+                if iface.state == IsmState.BACKUP:
+                    continue
+            if iface is from_iface:
+                flooded_back = True
             self._send(iface, ALL_SPF_RTRS_V4, LsUpdate([lsa]), area)
+        return flooded_back
 
     def _arm_rxmt(self, iface: OspfInterface, nbr: Neighbor) -> None:
         t = self._timer(
@@ -1921,6 +1955,10 @@ class OspfInstance(Actor):
     def _flush_self_lsa(self, area: Area, key: LsaKey, only_iface=None) -> None:
         e = area.lsdb.get(key)
         if e is None:
+            return
+        if e.lsa.is_maxage:
+            # Already being flushed — never flush the same LSA twice
+            # (reference lsdb.rs flush(): early-return on is_maxage).
             return
         import copy
 
@@ -2921,26 +2959,36 @@ class OspfInstance(Actor):
             DEFAULT_DISTANCE,
         )
 
-        for prefix in old.keys() - new.keys():
+        def installable(route) -> bool:
+            # Connected destinations — no next-hops at all, or only
+            # address-less (interface-only) ones — are never installed:
+            # the RIB's DIRECT entries own them (reference route.rs:96
+            # models connected with addr=None and skips the install).
+            return any(nh.addr is not None for nh in route.nexthops)
+
+        installed = self._installed_prefixes
+
+        def uninstall(prefix):
+            installed.discard(prefix)
             self.ibus.request(
                 self.routing_actor,
                 RouteKeyMsg(Protocol.OSPFV2, prefix),
                 sender=self.name,
             )
+
+        for prefix in old:
+            if prefix not in new and prefix in installed:
+                uninstall(prefix)
         for prefix, route in new.items():
             prev = old.get(prefix)
             if prev is not None and prev.dist == route.dist and prev.nexthops == route.nexthops:
                 continue
-            if not route.nexthops:
-                # Local/connected destination (we sit on it): nothing to
-                # install — the RIB's DIRECT entries own these (reference
-                # route.rs skips nexthop-less routes the same way).
-                if prev is not None and prev.nexthops:
-                    self.ibus.request(
-                        self.routing_actor,
-                        RouteKeyMsg(Protocol.OSPFV2, prefix),
-                        sender=self.name,
-                    )
+            if not installable(route):
+                # A previously-installed route degrading to connected
+                # (directly attached again) is left in place — the
+                # reference emits nothing on this transition (verified
+                # against its recordings: ibus-addr-add3 step 4); the
+                # entry is withdrawn when the prefix itself goes away.
                 continue
             nhs = frozenset(
                 Nexthop(
@@ -2948,8 +2996,13 @@ class OspfInstance(Actor):
                     ifname=nh.ifname,
                     ifindex=self._ifindex_of(nh.ifname),
                 )
+                # An ECMP tie between a directly-attached path and one
+                # via a neighbor can mix address-less and addressed
+                # next-hops: only the addressed ones are installable.
                 for nh in route.nexthops
+                if nh.addr is not None
             )
+            installed.add(prefix)
             self.ibus.request(
                 self.routing_actor,
                 RouteMsg(

@@ -308,8 +308,11 @@ def derive_routes(
 
     Transit networks yield their prefix at the network vertex's distance;
     router stub links yield prefix routes at dist(router)+metric.  Equal
-    cost contributions union their next-hop sets; the root's own stubs are
-    local (empty next-hop set) — the RIB treats them as connected.
+    cost contributions union their next-hop sets; the root's own stubs
+    are local (empty next-hop set).  Address-less next-hops (interface
+    only) mean DIRECTLY ATTACHED (reference route.rs:96): they render in
+    operational state but are never installed to the RIB — the connected
+    route owns the FIB entry (see OspfInstance._sync_rib).
     """
     routes: dict[IPv4Network, IntraRoute] = {}
 
