@@ -43,6 +43,15 @@ class EventRecorderConfig:
 
 
 @dataclass
+class RuntimeConfig:
+    # "cooperative": every actor on the daemon's single event loop.
+    # "threaded": each protocol instance on its own OS thread (the
+    # reference's per-instance spawn_blocking isolation,
+    # holo-protocol/src/lib.rs:419-430) — requires the real clock.
+    isolation: str = "cooperative"
+
+
+@dataclass
 class DaemonConfig:
     db_path: str | None = None
     # Production hardening (holo-daemon/src/main.rs:28-209 equivalents).
@@ -52,6 +61,7 @@ class DaemonConfig:
     grpc: GrpcConfig = field(default_factory=GrpcConfig)
     gnmi: GnmiConfig = field(default_factory=GnmiConfig)
     event_recorder: EventRecorderConfig = field(default_factory=EventRecorderConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
 
     @classmethod
     def load(cls, path: str | Path | None) -> "DaemonConfig":
@@ -84,4 +94,12 @@ class DaemonConfig:
             e = raw["event_recorder"]
             cfg.event_recorder.enabled = e.get("enabled", False)
             cfg.event_recorder.dir = e.get("dir", cfg.event_recorder.dir)
+        if "runtime" in raw:
+            iso = raw["runtime"].get("isolation", cfg.runtime.isolation)
+            if iso not in ("cooperative", "threaded"):
+                raise ValueError(
+                    f"[runtime] isolation must be 'cooperative' or "
+                    f"'threaded', got {iso!r}"
+                )
+            cfg.runtime.isolation = iso
         return cfg

@@ -54,10 +54,34 @@ class Daemon:
         self.lock = threading.RLock()
         self.name = name
         self._p = f"{name}." if name else ""
-        self.ibus = Ibus(self.loop)
+
+        # Preemptive isolation (reference holo-protocol/src/lib.rs:419-430,
+        # [runtime] isolation = "threaded"): protocol instances each get
+        # their own OS thread + loop; shared services stay on the primary
+        # loop and reach instances through the router.  Requires the real
+        # clock — virtual-clock (test) daemons stay cooperative, like the
+        # reference's `testing` feature.
+        self.instance_loops: dict = {}
+        self.loop_router = None
+        send_loop = self.loop
+        if self.config.runtime.isolation == "threaded":
+            if not isinstance(self.loop.clock, RealClock):
+                log.warning(
+                    "isolation=threaded requires the real clock; "
+                    "falling back to cooperative scheduling"
+                )
+            else:
+                from holo_tpu.utils.preempt import CallRunner, LoopRouter
+
+                self.loop_router = LoopRouter(self.loop)
+                send_loop = self.loop_router
+                self.loop.register(
+                    CallRunner(), name=f"{self._p}call-runner"
+                )
+        self.ibus = Ibus(send_loop)
         self.fabric = None
         if netio is None:
-            self.fabric = MockFabric(self.loop)
+            self.fabric = MockFabric(send_loop)
             netio = self.fabric.sender_for
         elif isinstance(netio, MockFabric):
             self.fabric = netio
@@ -78,13 +102,19 @@ class Daemon:
             nv = Path(self.config.db_path)
             self.nvstore = NvStore(nv.with_name(nv.stem + "_nv.json"))
         self.routing = RoutingProvider(
-            self.loop, self.ibus, netio, self.interface, kernel,
+            send_loop, self.ibus, netio, self.interface, kernel,
             prefix=self._p, policy_engine=self.policy.engine,
             keychains=self.keychain, nvstore=self.nvstore,
         )
+        if self.loop_router is not None:
+            self.routing.instance_placer = self._place_instance
+            self.routing.instance_unplacer = self._unplace_instance
         self.interface.routing_actor = f"{self._p}routing-rib"
         for p in (self.interface, self.keychain, self.policy, self.system, self.routing):
-            self.loop.register(p, name=self._p + p.name)
+            # Through send_loop: with isolation the router's register()
+            # attaches ITSELF as the provider's loop, so provider sends
+            # keep reaching instances that live on their own threads.
+            send_loop.register(p, name=self._p + p.name)
 
         db = Path(self.config.db_path) if self.config.db_path else None
         self.northbound = Northbound(
@@ -93,6 +123,81 @@ class Daemon:
             db_path=db,
         )
         self._grpc_server = None
+
+        # Event recorder (reference holo-protocol/src/lib.rs:266-269 +
+        # holod.toml [event_recorder]): every message delivered on the
+        # daemon loop is journaled BEFORE its actor handles it, so a
+        # production incident can be replayed bit-for-bit through
+        # `holo-tpu-cli replay` / utils.event_recorder.replay.  Protocol
+        # instances register on this loop lazily at commit time, so the
+        # loop-level hook covers them without per-instance wiring.
+        self.recorder = None
+        if self.config.event_recorder.enabled:
+            from holo_tpu.utils.event_recorder import (
+                EventRecorder,
+                instrument,
+            )
+
+            self.recorder = EventRecorder(
+                Path(self.config.event_recorder.dir)
+                / f"{self.name or 'holo'}-events.jsonl"
+            )
+            instrument(self.loop, self.recorder)
+
+    # -- preemptive instance placement ([runtime] isolation = "threaded")
+
+    # Instance-side callbacks the providers install: these mutate shared
+    # provider/RIB state and must run on the primary loop, not on the
+    # instance's thread.
+    _MARSHALLED_CALLBACKS = ("route_cb", "lib_cb", "on_state", "notif_cb")
+
+    def _place_instance(self, inst):
+        from holo_tpu.utils.preempt import (
+            InstanceHandle,
+            ThreadedLoop,
+            _MarshalCall,
+        )
+
+        tl = ThreadedLoop(name=f"{self._p}inst-{inst.name}")
+        if self.recorder is not None:
+            # Instance messages bypass the primary loop under isolation;
+            # journal them on the instance's own loop (same recorder —
+            # it serializes cross-thread appends).
+            from holo_tpu.utils.event_recorder import instrument
+
+            instrument(tl.loop, self.recorder)
+        # Route BEFORE the pump starts: a send in the window lands on the
+        # (not yet registered) remote loop and is reported undeliverable,
+        # never silently swallowed by the primary loop.
+        self.loop_router.register_remote(inst.name, tl)
+        tl.register(inst)
+        # Provider-installed callbacks run as primary-loop messages.
+        runner = f"{self._p}call-runner"
+        for attr in self._MARSHALLED_CALLBACKS:
+            cb = getattr(inst, attr, None)
+            if cb is None or not callable(cb):
+                continue
+            setattr(
+                inst,
+                attr,
+                (lambda cb: lambda *a: self.loop.send(
+                    runner, _MarshalCall(cb, a)
+                ))(cb),
+            )
+        tl.start()
+        self.instance_loops[inst.name] = tl
+        return InstanceHandle(inst, tl)
+
+    def _unplace_instance(self, name: str) -> None:
+        # Stop routing first (no new messages), then kill the pump, THEN
+        # unregister: pending messages are dropped, matching cooperative
+        # unregister semantics — a queued SPF result must not re-install
+        # routes after _drop_instance_routes purged them.
+        self.loop_router.unregister_remote(name)
+        tl = self.instance_loops.pop(name, None)
+        if tl is not None:
+            tl.stop()
+            tl.loop.unregister(name)
 
     # -- config entry points
 
@@ -148,6 +253,11 @@ class Daemon:
             self._grpc_server.stop(grace=0.5)
         if getattr(self, "_gnmi_server", None) is not None:
             self._gnmi_server.stop(grace=0.5)
+        for name, tl in list(self.instance_loops.items()):
+            if self.loop_router is not None:
+                self.loop_router.unregister_remote(name)
+            tl.stop()
+        self.instance_loops.clear()
 
 
 def main(argv=None):

@@ -309,6 +309,27 @@ class RoutingProvider(Provider, Actor):
     name = "routing"
     subtree_prefixes = ("routing",)
 
+    # Optional placement hooks (set by the daemon): with preemptive
+    # isolation each protocol instance is registered on its own
+    # ThreadedLoop instead of the shared loop (utils/preempt.py).
+    instance_placer = None
+    instance_unplacer = None
+
+    def _place_instance(self, inst):
+        """Registers the instance and returns the object the provider
+        should hold: the instance itself (cooperative), or a marshalling
+        handle when the daemon placed it on its own thread."""
+        if self.instance_placer is not None:
+            return self.instance_placer(inst) or inst
+        self.loop.register(inst)
+        return inst
+
+    def _unplace_instance(self, name: str) -> None:
+        if self.instance_unplacer is not None:
+            self.instance_unplacer(name)
+        else:
+            self.loop.unregister(name)
+
     def validate(self, new_tree) -> None:
         from holo_tpu.northbound.provider import CommitError
 
@@ -538,7 +559,7 @@ class RoutingProvider(Provider, Actor):
 
                 for prefix in inst.routes:
                     self.rib.route_del(RouteKeyMsg(Protocol.OSPFV2, prefix))
-                self.loop.unregister(inst.name)
+                self._unplace_instance(inst.name)
                 del self.instances["ospfv2"]
             return
         router_id = new.get(f"{base}/router-id")
@@ -566,7 +587,7 @@ class RoutingProvider(Provider, Actor):
                 spf_backend=backend,
                 nvstore=self.nvstore,
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             inst.attach_ibus(
                 self.ibus,
                 routing_actor=f"{self.prefix}routing-rib",
@@ -701,7 +722,7 @@ class RoutingProvider(Provider, Actor):
         if not enabled:
             if inst is not None:
                 self._drop_instance_routes(Protocol.OSPFV3, inst.routes)
-                self.loop.unregister(inst.name)
+                self._unplace_instance(inst.name)
                 del self.instances["ospfv3"]
             return
         router_id = new.get(f"{base}/router-id")
@@ -710,7 +731,7 @@ class RoutingProvider(Provider, Actor):
         if inst is not None and inst.router_id != IPv4Address(router_id):
             # Router-id change: restart the instance (new LSA identity).
             self._drop_instance_routes(Protocol.OSPFV3, inst.routes)
-            self.loop.unregister(inst.name)
+            self._unplace_instance(inst.name)
             del self.instances["ospfv3"]
             inst = None
         if inst is None:
@@ -721,7 +742,7 @@ class RoutingProvider(Provider, Actor):
                 netio=self.netio_factory(actor),
                 route_cb=self._ospfv3_routes_to_rib,
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             self.instances["ospfv3"] = inst
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
@@ -822,7 +843,7 @@ class RoutingProvider(Provider, Actor):
         if not enabled:
             if inst is not None:
                 self._drop_instance_routes(Protocol.ISIS, inst.routes)
-                self.loop.unregister(inst.name)
+                self._unplace_instance(inst.name)
                 del self.instances["isis"]
             return
         system_id = new.get(f"{base}/system-id")
@@ -837,7 +858,7 @@ class RoutingProvider(Provider, Actor):
             from holo_tpu.utils.southbound import Protocol
 
             self._drop_instance_routes(Protocol.ISIS, inst.routes)
-            self.loop.unregister(inst.name)
+            self._unplace_instance(inst.name)
             del self.instances["isis"]
             inst = None
         if inst is None:
@@ -848,7 +869,7 @@ class RoutingProvider(Provider, Actor):
                 netio=self.netio_factory(actor),
                 route_cb=self._isis_routes_to_rib,
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             self.instances["isis"] = inst
         for ifname, if_conf in (new.get(f"{base}/interface") or {}).items():
             if ifname in inst.interfaces:
@@ -891,7 +912,7 @@ class RoutingProvider(Provider, Actor):
         inst = self.instances.get("ldp")
         if not enabled or lsr_id is None:
             if inst is not None:
-                self.loop.unregister(inst.name)
+                self._unplace_instance(inst.name)
                 del self.instances["ldp"]
                 self._uninstall_ldp_labels()
             return
@@ -901,7 +922,7 @@ class RoutingProvider(Provider, Actor):
         if inst is not None and (
             str(inst.lsr_id) != lsr_id or inst.control_mode != mode
         ):
-            self.loop.unregister(inst.name)
+            self._unplace_instance(inst.name)
             del self.instances["ldp"]
             self._uninstall_ldp_labels()
             inst = None
@@ -914,7 +935,7 @@ class RoutingProvider(Provider, Actor):
                 control_mode=mode,
                 lib_cb=self._ldp_lib_changed,
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             self.instances["ldp"] = inst
         wanted = set(new.get(f"{base}/interface") or {})
         for ifname in list(inst.interfaces):
@@ -963,7 +984,7 @@ class RoutingProvider(Provider, Actor):
         def _stop(vrid):
             inst = have.pop(vrid)
             inst.shutdown()  # on_state(INITIALIZE) removes the macvlan
-            self.loop.unregister(inst.name)
+            self._unplace_instance(inst.name)
 
         for vrid in list(have.keys() - wanted.keys()):
             _stop(vrid)
@@ -995,7 +1016,7 @@ class RoutingProvider(Provider, Actor):
             inst.on_state = (
                 lambda state, i=inst: self._vrrp_state_changed(i, state)
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             have[vrid] = inst
             inst.startup()
 
@@ -1044,7 +1065,7 @@ class RoutingProvider(Provider, Actor):
             # Subtree (or its identity leaves) gone: tear down fully.
             if inst is not None:
                 self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
-                self.loop.unregister(inst.name)
+                self._unplace_instance(inst.name)
                 del self.instances["bgp"]
                 self._close_bgp_tcp()
             return
@@ -1060,7 +1081,7 @@ class RoutingProvider(Provider, Actor):
             # Speaker identity or transport change: restart (new OPENs,
             # fresh RIBs, fresh sockets).
             self._drop_instance_routes(Protocol.BGP, list(inst.loc_rib))
-            self.loop.unregister(inst.name)
+            self._unplace_instance(inst.name)
             del self.instances["bgp"]
             self._close_bgp_tcp()
             inst = None
@@ -1088,7 +1109,7 @@ class RoutingProvider(Provider, Actor):
                 netio=netio,
                 route_cb=self._bgp_route_cb,
             )
-            self.loop.register(inst)
+            inst = self._place_instance(inst)
             self.instances["bgp"] = inst
         engine = self.policy_engine
         wanted_peers = set()

@@ -88,15 +88,22 @@ class EventRecorder:
     JSONL file before the actor handles it."""
 
     def __init__(self, path: Path):
+        import threading
+
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = self.path.open("a")
+        # One recorder may serve several loops (daemon primary + each
+        # instance's thread under preemptive isolation): line-buffered
+        # appends must not interleave.
+        self._lock = threading.Lock()
 
     def record(self, actor: str, now: float, msg) -> None:
         try:
             entry = {"actor": actor, "time": now, "msg": _encode_value(msg)}
-            self._fh.write(json.dumps(entry) + "\n")
-            self._fh.flush()
+            with self._lock:
+                self._fh.write(json.dumps(entry) + "\n")
+                self._fh.flush()
         except Exception:
             pass  # recording must never break the instance
 

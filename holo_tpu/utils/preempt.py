@@ -109,6 +109,106 @@ class ThreadedLoop:
                     self._wake.wait(timeout=min(timeout, 0.5))
 
 
+class LoopRouter:
+    """EventLoop facade that routes per-actor sends across loops.
+
+    The daemon's shared components (ibus, providers, netio pumps) talk to
+    ONE loop object; with preemptive isolation each protocol instance
+    actually lives on its own :class:`ThreadedLoop`.  The router keeps a
+    name -> owning-loop map: ``send`` posts to the owner (waking its pump
+    thread), everything else (timers, registration of main-loop actors,
+    clock, idle pumping) delegates to the primary loop.  This mirrors the
+    reference's channel topology, where per-instance threads receive
+    their messages over dedicated channels while shared services stay on
+    the main runtime (holo-protocol/src/lib.rs:419-430).
+    """
+
+    def __init__(self, primary: EventLoop):
+        self.primary = primary
+        self._remote: dict[str, ThreadedLoop] = {}
+
+    def register_remote(self, name: str, owner: ThreadedLoop) -> None:
+        self._remote[name] = owner
+
+    def unregister_remote(self, name: str) -> None:
+        self._remote.pop(name, None)
+
+    def send(self, actor: str, msg: Any) -> bool:
+        owner = self._remote.get(actor)
+        if owner is not None:
+            return owner.send(actor, msg)
+        return self.primary.send(actor, msg)
+
+    def register(self, actor: Actor, name: str | None = None) -> None:
+        """Register on the primary loop but attach the ROUTER as the
+        actor's loop, so the actor's own sends keep routing to remote
+        instances (EventLoop.register would attach the raw loop)."""
+        self.primary.register(actor, name=name)
+        actor.loop = self
+
+    def __getattr__(self, attr):
+        return getattr(self.primary, attr)
+
+
+class _MarshalCall:
+    """Message processed on the primary loop: run a stored closure.
+
+    Instance-side callbacks (route_cb and friends) must not mutate
+    provider/RIB state from the instance's thread — they are marshalled
+    back to the primary loop as these messages and executed there, under
+    the same serialization as every other provider message.
+    """
+
+    __slots__ = ("fn", "args")
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+
+
+class CallRunner(Actor):
+    """Primary-loop actor executing marshalled closures."""
+
+    name = "call-runner"
+
+    def handle(self, msg) -> None:
+        if isinstance(msg, _MarshalCall):
+            msg.fn(*msg.args)
+
+
+class InstanceHandle:
+    """Provider-side proxy for an instance living on a ThreadedLoop.
+
+    Method calls are marshalled onto the instance's own thread
+    (synchronously, via :meth:`ThreadedLoop.call`) so commit-time
+    reconfiguration never races the instance's handlers; attribute reads
+    pass through (operational-state rendering reads are point-in-time
+    snapshots — same guarantees the reference's state queries have).
+    """
+
+    _PASSTHROUGH = {"_inst", "_tl"}
+
+    def __init__(self, inst: Actor, tl: ThreadedLoop):
+        object.__setattr__(self, "_inst", inst)
+        object.__setattr__(self, "_tl", tl)
+
+    def __getattr__(self, attr):
+        val = getattr(self._inst, attr)
+        if callable(val) and not attr.startswith("__"):
+            tl = self._tl
+
+            def marshalled(*args, **kwargs):
+                out = []
+                tl.call(lambda: out.append(val(*args, **kwargs)))
+                return out[0] if out else None
+
+            return marshalled
+        return val
+
+    def __setattr__(self, attr, value):
+        setattr(self._inst, attr, value)
+
+
 class ThreadedFabric:
     """Mock wire for instances living on different :class:`ThreadedLoop`s.
 
