@@ -160,6 +160,13 @@ class InstanceConfig:
     preference_external: int | None = None
     # RFC 3623 helper-mode capability (advertised in the RI LSA).
     gr_helper_enabled: bool = True
+    # RFC 2328 §15 virtual links: (transit_area_id, peer_router_id)
+    # pairs.  The vlink interface itself materializes when the peer
+    # becomes reachable through the transit area (see
+    # _sync_virtual_links); hello/dead intervals for vlink adjacencies.
+    virtual_links: tuple = ()
+    vlink_hello_interval: int = 10
+    vlink_dead_interval: int = 60
     # Interop knobs for replaying the reference's recorded exchanges
     # (tools/stepwise.py): seed DD seqnos like the reference's
     # 'deterministic' build, and override the §13(5a) arrival throttle
@@ -758,6 +765,7 @@ class OspfInstance(Actor):
             options |= Options.L
             lls = LlsBlock(eof=LLS_EOF_RS)
         hello = Hello(
+            # §15/A.3.2: unnumbered p2p and virtual links send mask 0.
             mask=mask_of(iface.prefix) if iface.prefix else IPv4Address(0),
             hello_interval=iface.config.hello_interval,
             options=options,
@@ -1300,7 +1308,9 @@ class OspfInstance(Actor):
 
     def _adj_ok(self, iface: OspfInterface, nbr: Neighbor) -> bool:
         """§10.4: should we form/keep an adjacency with this neighbor?"""
-        if iface.config.if_type == IfType.POINT_TO_POINT:
+        if iface.config.if_type in (
+            IfType.POINT_TO_POINT, IfType.VIRTUAL_LINK
+        ):
             return True
         return (
             iface.state in (IsmState.DR, IsmState.BACKUP)
@@ -1736,7 +1746,11 @@ class OspfInstance(Actor):
         if acks:
             # §13.5 delayed-ack destination: AllSPFRouters on p2p and from
             # DR/BDR; AllDRouters (modeled as the DR address) otherwise.
-            if iface.config.if_type == IfType.POINT_TO_POINT or iface.is_dr_or_bdr():
+            if (
+                iface.config.if_type
+                in (IfType.POINT_TO_POINT, IfType.VIRTUAL_LINK)
+                or iface.is_dr_or_bdr()
+            ):
                 ack_dst = ALL_SPF_RTRS_V4
             else:
                 ack_dst = iface.dr if int(iface.dr) else nbr.src
@@ -1840,6 +1854,14 @@ class OspfInstance(Actor):
             if iface.state == IsmState.DOWN:
                 continue
             if only_iface is not None and iface is not only_iface:
+                continue
+            if iface.config.if_type == IfType.VIRTUAL_LINK and lsa.type in (
+                LsaType.AS_EXTERNAL,
+                LsaType.OPAQUE_AS,
+            ):
+                # AS-scope LSAs never cross virtual links (reference
+                # lsdb.rs:74-83; the transit area's own flooding carries
+                # them).
                 continue
             flood_it = False
             for nbr in iface.neighbors.values():
@@ -2204,6 +2226,23 @@ class OspfInstance(Actor):
             area.interfaces.values(), key=lambda i: i.config.loopback
         )
         for iface in ifaces:
+            if iface.config.if_type == IfType.VIRTUAL_LINK:
+                # §12.4.1.3: a type-4 link for each FULL virtual-link
+                # neighbor, link data = our vlink interface address,
+                # metric = the transit area's current path cost.
+                if iface.state == IsmState.DOWN:
+                    continue
+                for nbr in iface.neighbors.values():
+                    if self._nbr_counts_full(nbr):
+                        links.append(
+                            RouterLink(
+                                RouterLinkType.VIRTUAL_LINK,
+                                nbr.router_id,
+                                iface.addr_ip,
+                                iface.config.cost,
+                            )
+                        )
+                continue
             if iface.state == IsmState.DOWN or iface.prefix is None:
                 continue
             cost = iface.config.cost
@@ -2376,10 +2415,24 @@ class OspfInstance(Actor):
         area_results: dict[IPv4Address, tuple] = {}
         # Backbone last: its SPF consumes transit-area results for virtual
         # links (§16.1 — vlink next hops come from the transit area).
+        # The vlink sync sits between the two passes: it may CREATE the
+        # backbone area (a router whose only area-0 attachment is the
+        # vlink itself) before the backbone pass runs.
         ordered_areas = sorted(
             self.areas.values(), key=lambda a: int(a.area_id) == 0
         )
+        if self.config.virtual_links:
+            ordered_areas = [
+                a for a in ordered_areas if int(a.area_id) != 0
+            ] + ["_vlink_sync"]
         for area in ordered_areas:
+            if area == "_vlink_sync":
+                self._sync_virtual_links(area_results, now)
+                # Backbone pass — the sync may have just created area 0.
+                ordered_areas += [
+                    a for a in self.areas.values() if int(a.area_id) == 0
+                ]
+                continue
             iface_by_addr = {
                 i.addr_ip: i.name for i in area.interfaces.values() if i.addr_ip
             }
@@ -2651,6 +2704,154 @@ class OspfInstance(Actor):
                     # routing capability inside, RFC 2328 §12.1.2).
                     options=Options(0) if area.no_type5 else Options.E,
                 )
+
+    def add_virtual_link(
+        self, transit_area_id: IPv4Address, peer_rid: IPv4Address
+    ) -> None:
+        """Configure a §15 virtual link; it comes up when the peer is
+        reachable through the transit area (next SPF run)."""
+        entry = (transit_area_id, peer_rid)
+        if entry not in self.config.virtual_links:
+            self.config.virtual_links = self.config.virtual_links + (entry,)
+        self._schedule_spf()
+
+    def _vlink_endpoint_addr(
+        self, transit: Area, peer_rid: IPv4Address, now: float
+    ) -> IPv4Address | None:
+        """The peer's transit-area interface address (§15.1: learned from
+        its router-LSA in the transit area) — the vlink's unicast dst."""
+        e = transit.lsdb.get(
+            LsaKey(LsaType.ROUTER, peer_rid, peer_rid)
+        )
+        if e is None or e.current_age(now) >= MAX_AGE:
+            return None
+        # First p2p/transit link's data, exactly like the reference
+        # (ospfv2/area.rs:75-95 vlink_neighbor_addr) — in deployment the
+        # unicast is routed to the peer regardless of which of its
+        # transit-area addresses is picked.
+        return next(
+            (
+                link.data
+                for link in e.lsa.body.links
+                if link.link_type
+                in (
+                    RouterLinkType.POINT_TO_POINT,
+                    RouterLinkType.TRANSIT_NETWORK,
+                )
+            ),
+            None,
+        )
+
+    def _sync_virtual_links(self, area_results: dict, now: float) -> None:
+        """Bring configured virtual links up/down from transit-area SPF
+        reachability (reference interface.rs:50,84,135-148): a reachable
+        endpoint materializes an unnumbered point-to-point interface in
+        the BACKBONE whose packets ride the transit area's shortest path;
+        an unreachable one tears the interface (and adjacency) down."""
+        from holo_tpu.ops.graph import INF as _INF
+        from holo_tpu.protocols.ospf.spf_run import _atoms_of
+
+        wanted: dict[str, tuple] = {}
+        # Virtual links only activate on ABRs (reference area.rs:304-306).
+        vlinks = self.config.virtual_links if self.is_abr else ()
+        for taid, rid in vlinks:
+            transit = self.areas.get(taid)
+            got = area_results.get(taid)
+            if transit is None or transit.stub or transit.nssa or got is None:
+                continue
+            st, res = got
+            v = st.router_index.get(rid)
+            # §15.1: a path cost at or above LSInfinity means the
+            # endpoint is unusable — the vlink stays down rather than
+            # advertising a wrapped 16-bit metric.
+            if v is None or res.dist[v] >= min(_INF, 0xFFFF):
+                continue
+            # The endpoint must itself be an ABR (reference area.rs:314).
+            pe = transit.lsdb.get(LsaKey(LsaType.ROUTER, rid, rid))
+            if pe is None or not (pe.lsa.body.flags & RouterFlags.B):
+                continue
+            nhs = _atoms_of(res.nexthop_words[v], st.atoms)
+            out_if = next(
+                (nh.ifname for nh in nhs if nh.ifname is not None), None
+            )
+            dst = self._vlink_endpoint_addr(transit, rid, now)
+            if out_if is None or dst is None:
+                continue
+            phys = transit.interfaces.get(out_if)
+            if phys is None or phys.addr_ip is None:
+                continue
+            wanted[f"vlink-{rid}"] = (
+                taid, rid, dst, out_if, phys.addr_ip, int(res.dist[v]),
+                phys.config.auth,
+            )
+        backbone = self.areas.get(IPv4Address(0))
+        if backbone is None:
+            if not wanted:
+                return
+            # A vlink IS the router's backbone attachment (§15): area 0
+            # springs into existence with the first RESOLVED vlink, with
+            # the same new-area hooks add_interface runs.
+            backbone = self.areas[IPv4Address(0)] = Area(IPv4Address(0))
+            for prefix in list(self.redistributed):
+                self._originate_external(prefix)
+            self._originate_router_info(backbone)
+        # Tear down vlinks that lost their transit path.
+        for name in [
+            n
+            for n, i in backbone.interfaces.items()
+            if i.config.if_type == IfType.VIRTUAL_LINK and n not in wanted
+        ]:
+            self.if_down(name)
+            del backbone.interfaces[name]
+            self._if_area.pop(name, None)
+        # Bring up / refresh the rest.
+        changed = False
+        for name, (taid, rid, dst, out_if, src, cost, auth) in wanted.items():
+            iface = backbone.interfaces.get(name)
+            if iface is None:
+                iface = OspfInterface(
+                    name=name,
+                    config=IfConfig(
+                        area_id=backbone.area_id,
+                        if_type=IfType.VIRTUAL_LINK,
+                        cost=cost,
+                        hello_interval=self.config.vlink_hello_interval,
+                        dead_interval=self.config.vlink_dead_interval,
+                        # Vlink packets arrive on (and are decoded with)
+                        # the transit interface — send with its auth.
+                        auth=auth,
+                    ),
+                    addr_ip=src,
+                    vlink_peer=rid,
+                    vlink_transit=taid,
+                    vlink_dst=dst,
+                    vlink_out_ifname=out_if,
+                )
+                backbone.interfaces[name] = iface
+                self._if_area[name] = backbone.area_id
+                self._set_ism_state(iface, IsmState.POINT_TO_POINT)
+                self._timer(
+                    ("hello", name), lambda n=name: HelloTimerMsg(n)
+                ).start(0.0)
+                changed = True
+            else:
+                # Any dynamic-parameter change re-originates the backbone
+                # router-LSA (reference area.rs:339-371: nbr_addr /
+                # src_addr / cost changes all resync advertisement).
+                if (
+                    iface.vlink_dst,
+                    iface.vlink_out_ifname,
+                    iface.addr_ip,
+                    iface.config.cost,
+                ) != (dst, out_if, src, cost):
+                    iface.vlink_dst = dst
+                    iface.vlink_out_ifname = out_if
+                    iface.addr_ip = src
+                    iface.config.cost = cost
+                    iface.config.auth = auth
+                    changed = True
+        if changed:
+            self._originate_router_lsa(backbone)
 
     def _vlink_nexthops(self, backbone: Area, area_results: dict, now) -> dict:
         """{vlink neighbor rid: frozenset[RouteNexthop]} — the transit
@@ -3177,13 +3378,16 @@ class OspfInstance(Actor):
             if not (msg.dst == ALL_DR_RTRS_V4 and iface.is_dr_or_bdr()):
                 return
         # Source validation (:128-146): usable, and on the interface's
-        # subnet for non-p2p interfaces.
+        # subnet for non-p2p interfaces.  Virtual-link packets are exempt
+        # from the subnet rule — the peer sits several hops away across
+        # the transit area (§15), identified by area id 0 in the header.
         if int(msg.src) == 0:
             return
         if (
             iface.config.if_type != IfType.POINT_TO_POINT
             and iface.prefix is not None
             and msg.src not in iface.prefix
+            and not (int(pkt.area_id) == 0 and int(area.area_id) != 0)
         ):
             return
         if pkt.router_id == self.config.router_id:
@@ -3195,10 +3399,35 @@ class OspfInstance(Actor):
                 )
             return  # our own multicast (or a duplicate router-id)
         if pkt.area_id != area.area_id:
-            self._notify_if_config_error(
-                iface, msg.src, _PKT_TYPE_YANG[pkt.body.TYPE], "area-mismatch"
-            )
-            return
+            # §15: virtual-link packets carry the BACKBONE area id but
+            # arrive over the transit area's physical interface — rebind
+            # to the matching vlink interface before processing.
+            vl = None
+            if int(pkt.area_id) == 0 and int(area.area_id) != 0:
+                backbone = self.areas.get(IPv4Address(0))
+                if backbone is not None:
+                    # The vlink must be configured THROUGH this transit
+                    # area and the source must be the resolved endpoint —
+                    # otherwise an off-path sender could inject packets
+                    # as the vlink neighbor.
+                    vl = next(
+                        (
+                            i
+                            for i in backbone.interfaces.values()
+                            if i.config.if_type == IfType.VIRTUAL_LINK
+                            and i.vlink_peer == pkt.router_id
+                            and i.vlink_transit == area.area_id
+                            and i.vlink_dst == msg.src
+                        ),
+                        None,
+                    )
+            if vl is None:
+                self._notify_if_config_error(
+                    iface, msg.src, _PKT_TYPE_YANG[pkt.body.TYPE],
+                    "area-mismatch",
+                )
+                return
+            area, iface = self.areas[IPv4Address(0)], vl
         if pkt.auth_type == AuthType.CRYPTOGRAPHIC:
             nbr = iface.neighbors.get(pkt.router_id)
             if nbr is not None:
@@ -3230,4 +3459,12 @@ class OspfInstance(Actor):
             if self._nvstore is not None and self._crypto_seq >= self._crypto_reserved:
                 self._reserve_seqnos()
             auth.seqno = self._crypto_seq
-        self.netio.send(iface.name, iface.addr_ip, dst, pkt.encode(auth=auth))
+        out_ifname = iface.name
+        if iface.config.if_type == IfType.VIRTUAL_LINK:
+            # §15: vlink packets are unicast to the resolved endpoint and
+            # leave through the transit area's physical interface.
+            out_ifname = iface.vlink_out_ifname or iface.name
+            dst = iface.vlink_dst
+            if dst is None:
+                return
+        self.netio.send(out_ifname, iface.addr_ip, dst, pkt.encode(auth=auth))

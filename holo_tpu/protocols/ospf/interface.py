@@ -16,6 +16,8 @@ from holo_tpu.protocols.ospf.packet import Options
 class IfType(enum.Enum):
     POINT_TO_POINT = "p2p"
     BROADCAST = "broadcast"
+    # RFC 2328 §15: unnumbered point-to-point through a transit area.
+    VIRTUAL_LINK = "virtual-link"
 
 
 class IsmState(enum.IntEnum):
@@ -74,6 +76,14 @@ class OspfInterface:
     # Additional subnets on the interface: advertised as stub links
     # (reference advertises every interface address).
     secondary: list = field(default_factory=list)  # [IPv4Network]
+    # Virtual-link state (reference interface.rs:50,84,135-148): the
+    # configured peer router-id, the transit area carrying the link, the
+    # resolved unicast destination (the peer's transit-area interface
+    # address) and the physical interface packets leave through.
+    vlink_peer: IPv4Address | None = None
+    vlink_transit: IPv4Address | None = None
+    vlink_dst: IPv4Address | None = None
+    vlink_out_ifname: str | None = None
 
     def options(self) -> Options:
         return Options.E  # stub-area support sets E=0 per area config later
