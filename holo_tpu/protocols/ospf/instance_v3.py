@@ -706,7 +706,9 @@ class OspfV3Instance(Actor):
                 if cur is not None and now - cur.rcvd_time < MIN_LS_ARRIVAL:
                     continue
                 if lsa.adv_rtr == self.router_id and not lsa.is_maxage:
-                    self._refresh_self_lsa(area, lsa)
+                    self._refresh_self_lsa(
+                        area, lsa, from_iface=iface, from_nbr=nbr
+                    )
                     continue
                 self._install_and_flood(
                     area, lsa, from_iface=iface, from_nbr=nbr
@@ -734,10 +736,14 @@ class OspfV3Instance(Actor):
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EXCHANGE:
             return
+        drained = False
         for hdr in pkt.body.lsa_headers:
             cur = nbr.ls_rxmt.get(hdr.key)
             if cur is not None and hdr.compare(cur) == 0:
                 del nbr.ls_rxmt[hdr.key]
+                drained = cur.is_maxage or drained
+        if drained:
+            self._sweep_maxage()
 
     def _install_and_flood(
         self, area: V3Area, lsa, from_iface=None, from_nbr=None
@@ -790,10 +796,29 @@ class OspfV3Instance(Actor):
             if sent:
                 self._send(iface, ALL_SPF_RTRS_V6, P.LsUpdate([lsa]))
         if lsa.is_maxage:
-            area.lsdb.remove(lsa.key)
-            if P.scope_of(int(lsa.type)) == "as":
-                for other in self.areas.values():
-                    other.lsdb.remove(lsa.key)
+            # The MaxAge copy STAYS installed until every retransmission
+            # list drains and no neighbor is in Exchange/Loading — the
+            # RFC 2328 §14 removal condition (same as v2; the reference's
+            # ospfv3 conformance expects the MaxAge copy visible in the
+            # LSDB, packet-lsupd-self-orig2).
+            self._sweep_maxage()
+
+    def _sweep_maxage(self) -> None:
+        """§14: drop MaxAge LSAs no rxmt list holds, unless an exchange
+        is in progress (the DD summaries may still reference them)."""
+        if self._any_nbr_exchanging():
+            return
+        held: set = set()
+        for iface in self.interfaces.values():
+            for nbr in iface.neighbors.values():
+                held |= set(nbr.ls_rxmt)
+        for area in self.areas.values():
+            for key in [
+                k
+                for k, e in area.lsdb.entries.items()
+                if e.lsa.is_maxage and k not in held
+            ]:
+                area.lsdb.remove(key)
 
     def _arm_rxmt(self, iface: V3Interface, nbr: Neighbor) -> None:
         t = self._timer(
@@ -843,16 +868,36 @@ class OspfV3Instance(Actor):
             body=body,
         )
         lsa.encode()
-        if old is not None and old.lsa.raw[20:] == lsa.raw[20:]:
+        if (
+            old is not None
+            and not old.lsa.is_maxage
+            and old.lsa.raw[20:] == lsa.raw[20:]
+        ):
+            # Unchanged content: no re-origination — but a MaxAge copy
+            # (mid-flush, retained until rxmt lists drain) never
+            # suppresses; wanting the LSA again needs a fresh instance.
             return
         self._install_and_flood(area, lsa)
 
-    def _refresh_self_lsa(self, area: V3Area, received) -> None:
+    def _refresh_self_lsa(
+        self, area: V3Area, received, from_iface=None, from_nbr=None
+    ) -> None:
+        """§13.4 received self-originated LSA: the newer received copy is
+        first flooded on as usual (reference §13 step 5.b runs before the
+        self-orig check — one LS Update per adjacency with the received
+        instance), then either outpaced with a fresh re-origination or
+        flushed with MaxAge (a second LS Update), exactly the two-update
+        sequence the reference's ospfv3 conformance cases record
+        (tests/conformance/ospfv3/packet-lsupd-self-orig{1,2})."""
         cur = area.lsdb.get(received.key)
-        if cur is None:
-            # A stale incarnation of ours we no longer originate: install
-            # it so the flush has something to outrank, then flush it.
-            self._install_and_flood(area, received)
+        self._install_and_flood(
+            area, received, from_iface=from_iface, from_nbr=from_nbr
+        )
+        if cur is None or received.seq_no >= P.MAX_SEQ_NO:
+            # No live incarnation of ours, or the sequence space is
+            # exhausted (§12.1.6): flush the received copy — the refresh
+            # machinery re-originates from INITIAL_SEQ_NO once the
+            # MaxAge instance drains.
             self._flush_self(area, received.key)
             return
         lsa = P.Lsa(
@@ -952,18 +997,24 @@ class OspfV3Instance(Actor):
         else:
             self._flush_self(area, key)
 
+    @staticmethod
+    def _maxage_copy(lsa):
+        """A copy of ``lsa`` with the header age pinned at MaxAge."""
+        import copy
+
+        flush = copy.copy(lsa)
+        flush.age = P.MAX_AGE
+        if flush.raw:
+            raw = bytearray(flush.raw)
+            raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
+            flush.raw = bytes(raw)
+        return flush
+
     def _flush_self(self, area: V3Area, key) -> None:
         e = area.lsdb.get(key)
         if e is None or e.lsa.is_maxage:
             return
-        import copy
-
-        flush = copy.copy(e.lsa)
-        flush.age = P.MAX_AGE
-        raw = bytearray(flush.raw)
-        raw[0:2] = P.MAX_AGE.to_bytes(2, "big")
-        flush.raw = bytes(raw)
-        self._install_and_flood(area, flush)
+        self._install_and_flood(area, self._maxage_copy(e.lsa))
 
     def _originate_intra_area_prefix(self) -> None:
         for area in self.areas.values():
@@ -1030,8 +1081,12 @@ class OspfV3Instance(Actor):
                 self._install_and_flood(area, lsa)
             for key in area.lsdb.maxage_keys(now):
                 e = area.lsdb.get(key)
-                if e is not None:
-                    self._install_and_flood(area, e.lsa)
+                if e is not None and not e.lsa.is_maxage:
+                    # Natural expiry: pin the header age at MaxAge so the
+                    # flood (and the §14 sweep) see the flushed state.
+                    self._install_and_flood(area, self._maxage_copy(e.lsa))
+        # One §14 sweep per tick drops every drained MaxAge entry.
+        self._sweep_maxage()
         self._age_timer.start(AGE_TICK)
 
     # -- SPF
@@ -1318,7 +1373,8 @@ class OspfV3Instance(Actor):
                     and key.adv_rtr == self.router_id
                     and key.lsid not in wanted_lsids
                 ):
-                    if not area.lsdb.entries[key].lsa.is_maxage:
+                    e = area.lsdb.entries.get(key)
+                    if e is not None and not e.lsa.is_maxage:
                         self._flush_self(area, key)
         for aid, prefixes in wanted.items():
             area = self.areas[aid]
@@ -1338,7 +1394,10 @@ class OspfV3Instance(Actor):
                     and key.adv_rtr == self.router_id
                     and key.lsid not in wanted_lsids
                 ):
-                    if not area.lsdb.entries[key].lsa.is_maxage:
+                    # .get: a flush above may have swept drained MaxAge
+                    # entries out of the snapshot already (§14 sweep).
+                    e = area.lsdb.entries.get(key)
+                    if e is not None and not e.lsa.is_maxage:
                         self._flush_self(area, key)
 
     def _asbr_via_inter_router(self, area, index, res, atoms, asbr_rid):

@@ -1,0 +1,142 @@
+"""OSPFv3 stepwise conformance: the reference's two recorded cases
+(holo-ospf/tests/conformance/ospfv3/packet-lsupd-self-orig{1,2},
+described in .../ospfv3/mod.rs:13-50) replayed through the live v3
+instance.
+
+Both inject a newer SELF-ORIGINATED Router-LSA at a LAN router (the
+recorded input is rt3 on topo2-1's eth-sw1; here the equivalent
+three-router LAN) and assert the recorded output plane:
+
+  case 1 (lsa-id 0.0.0.0, newer than the database copy):
+    - one LS Update flooding the RECEIVED instance,
+    - one LS Update with the re-originated copy (seq = received + 1),
+    - every adjacency's retransmission queue length rises to 1.
+  case 2 (lsa-id 0.0.0.1, absent from the LSDB):
+    - one LS Update flooding the received instance,
+    - one LS Update with the same LSA at MaxAge (the flush),
+    - the MaxAge copy sits in the LSDB; rxmt queue length is 1.
+"""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv6Address as A6
+
+from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.netio import NetRxPacket
+
+from tests.test_ospfv3 import _lan3
+
+# The recorded input's sequence number (0x83215600 as a signed 32-bit
+# value) — far newer than the converged instance's own copy.
+_RECORDED_SEQ = 0x83215600 - (1 << 32)
+
+
+def _inject_self_orig(loop, subject, sender, lsid: A):
+    """Deliver to ``subject`` an LsUpdate from ``sender`` carrying a
+    copy of subject's own Router-LSA with the recorded newer seq-no and
+    the case's lsa-id."""
+    own = next(
+        e.lsa
+        for e in subject.lsdb.all()
+        if e.lsa.type == P.LsaType.ROUTER
+        and e.lsa.adv_rtr == subject.router_id
+    )
+    bogus = P.Lsa(
+        age=1,
+        type=P.LsaType.ROUTER,
+        lsid=lsid,
+        adv_rtr=subject.router_id,
+        seq_no=_RECORDED_SEQ,
+        body=own.body,
+    )
+    bogus.encode()
+    pkt = P.Packet(
+        router_id=sender.router_id,
+        area_id=A("0.0.0.0"),
+        body=P.LsUpdate([bogus]),
+    )
+    src = A6(f"fe80::{int(str(sender.router_id).split('.')[0])}")
+    dst = A6(f"fe80::{int(str(subject.router_id).split('.')[0])}")
+    loop.send(
+        subject.name,
+        NetRxPacket("e0", src, dst, pkt.encode(src=src, dst=dst)),
+    )
+    loop.run_until_idle()
+    return bogus
+
+
+def _capture_tx(subject):
+    sent = []
+    orig = subject.netio
+
+    class _Tap:
+        def send(self, ifname, src, dst, data):
+            try:
+                pkt = P.Packet.decode(data, src=src, dst=dst)
+            except Exception:
+                pkt = None
+            if pkt is not None and pkt.body.TYPE == P.PacketType.LS_UPDATE:
+                sent.append(pkt)
+            orig.send(ifname, src, dst, data)
+
+    subject.netio = _Tap()
+    return sent
+
+
+def test_v3_self_orig_newer_copy_is_outpaced():
+    """packet-lsupd-self-orig1 (mod.rs:13-30)."""
+    loop, fabric, routers = _lan3()
+    r1, _r2, r3 = routers
+    sent = _capture_tx(r3)
+    _inject_self_orig(loop, r3, r1, A("0.0.0.0"))
+
+    flooded = [
+        (l.lsid, l.seq_no, l.is_maxage)
+        for pkt in sent
+        for l in pkt.body.lsas
+        if l.type == P.LsaType.ROUTER and l.adv_rtr == r3.router_id
+    ]
+    # Two instances went out: the received one, then the outpacing one.
+    seqs = [s for _lsid, s, _m in flooded]
+    assert _RECORDED_SEQ in seqs, "received self-orig instance not flooded"
+    assert _RECORDED_SEQ + 1 in seqs, "re-originated instance not flooded"
+    # The database copy is the re-originated instance.
+    cur = r3.lsdb.get(
+        P.LsaKey(P.LsaType.ROUTER, A("0.0.0.0"), r3.router_id)
+    )
+    assert cur is not None and cur.lsa.seq_no == _RECORDED_SEQ + 1
+    # Every adjacency's retransmission queue holds it.
+    for iface in r3.interfaces.values():
+        for nbr in iface.neighbors.values():
+            if nbr.state == NsmState.FULL:
+                assert len(nbr.ls_rxmt) == 1, (
+                    f"rxmt qlen {len(nbr.ls_rxmt)} != 1"
+                )
+
+
+def test_v3_self_orig_unknown_lsid_is_flushed():
+    """packet-lsupd-self-orig2 (mod.rs:32-50)."""
+    loop, fabric, routers = _lan3()
+    r1, _r2, r3 = routers
+    sent = _capture_tx(r3)
+    _inject_self_orig(loop, r3, r1, A("0.0.0.1"))
+
+    flooded = [
+        (l.seq_no, l.is_maxage)
+        for pkt in sent
+        for l in pkt.body.lsas
+        if l.type == P.LsaType.ROUTER
+        and l.adv_rtr == r3.router_id
+        and l.lsid == A("0.0.0.1")
+    ]
+    assert (_RECORDED_SEQ, False) in flooded, "received instance not flooded"
+    assert any(m for _s, m in flooded), "MaxAge flush not flooded"
+    # The LSDB retains the MaxAge copy until the rxmt lists drain.
+    cur = r3.lsdb.get(
+        P.LsaKey(P.LsaType.ROUTER, A("0.0.0.1"), r3.router_id)
+    )
+    assert cur is not None and cur.lsa.is_maxage
+    for iface in r3.interfaces.values():
+        for nbr in iface.neighbors.values():
+            if nbr.state == NsmState.FULL:
+                assert len(nbr.ls_rxmt) == 1
