@@ -186,7 +186,9 @@ def _decode_prefixes(r: Reader, v6: bool = False):
             raise DecodeError("bad prefix length")
         nbytes = (plen + 7) // 8
         raw = r.bytes(nbytes) + bytes(size - nbytes)
-        out.append(cls_((int.from_bytes(raw, "big"), plen)))
+        # strict=False masks stray host bits (RFC 4271 §4.3 treats the
+        # trailing bits as irrelevant; crashing would be a remote DoS).
+        out.append(cls_((int.from_bytes(raw, "big"), plen), strict=False))
     return out
 
 

@@ -441,7 +441,9 @@ def _read_wide_ip_entries(body: Reader, out: list) -> None:
         sub: dict = {}
         if ctrl & 0x40:  # sub-TLVs present
             sub = _read_prefix_subtlvs(body)
-        prefix = IPv4Network((int.from_bytes(raw, "big"), plen))
+        # strict=False masks trailing host bits inside the truncated
+        # prefix (the wire permits them; the route is the covering net).
+        prefix = IPv4Network((int.from_bytes(raw, "big"), plen), strict=False)
         out.append(ExtIpReach(prefix, metric, bool(ctrl & 0x80), **sub))
 
 
@@ -459,7 +461,7 @@ def _read_ipv6_entries(body: Reader, out: list) -> None:
         sub: dict = {}
         if ctrl & 0x20:  # sub-TLVs present
             sub = _read_prefix_subtlvs(body)
-        prefix = IPv6Network((int.from_bytes(raw, "big"), plen))
+        prefix = IPv6Network((int.from_bytes(raw, "big"), plen), strict=False)
         out.append(
             ExtIpReach(
                 prefix, metric, bool(ctrl & 0x80),
@@ -549,6 +551,8 @@ def _decode_tlvs(r: Reader) -> dict:
                 addr = int.from_bytes(body.bytes(4), "big")
                 mask = int.from_bytes(body.bytes(4), "big")
                 plen = bin(mask).count("1")
+                if mask != (((1 << plen) - 1) << (32 - plen) if plen else 0):
+                    raise DecodeError("non-contiguous subnet mask")
                 prefix = IPv4Network((addr & mask, plen))
                 if t == TlvType.IP_EXTERNAL_REACH:
                     out["narrow_ip_ext_reach"].append(

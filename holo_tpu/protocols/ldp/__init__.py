@@ -132,7 +132,9 @@ class LdpMsg:
                     raise DecodeError("bad FEC element")
                 nbytes = (plen + 7) // 8
                 raw = body.bytes(nbytes) + bytes(4 - nbytes)
-                out.fec = IPv4Network((int.from_bytes(raw, "big"), plen))
+                out.fec = IPv4Network(
+                    (int.from_bytes(raw, "big"), plen), strict=False
+                )
             elif tlv == 0x0200:
                 out.label = body.u32()
         return out

@@ -1,0 +1,245 @@
+"""Coverage-guided decoder fuzzing (the reference's fuzz/ equivalent).
+
+The reference ships 31 libFuzzer targets over its wire decoders
+(``fuzz/fuzz_targets/**``, driven by ``fuzz/fuzz-all.sh``).  atheris —
+the Python libFuzzer binding — is not available in this image, so this
+module implements the same loop natively on :mod:`sys.monitoring`
+(PEP 669, CPython 3.12): per-target corpora evolve by keeping any
+mutated input that lights up a previously-unseen line in the decoder
+modules.
+
+Contract under test (same as the reference's): a decoder fed arbitrary
+bytes either succeeds or raises ``DecodeError`` — any other exception
+is a crash, reported with the reproducing input.
+
+Run standalone (`python -m holo_tpu.tools.fuzz [seconds-per-target]`)
+or through ``tests/test_fuzz_coverage.py`` (time-capped).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.utils.bytesbuf import DecodeError, Reader
+
+_TOOL_ID = 4  # sys.monitoring tool slot (0-5 free for applications)
+
+
+@dataclass
+class FuzzResult:
+    name: str
+    executions: int = 0
+    corpus_size: int = 0
+    coverage: int = 0
+    crashes: list = field(default_factory=list)  # (exc, repr, hex)
+
+
+class _Coverage:
+    """Line coverage over holo_tpu.protocols via sys.monitoring."""
+
+    def __init__(self):
+        self.seen: set = set()
+        self._new = False
+
+    def _on_line(self, code, line):
+        if "holo_tpu/protocols" not in code.co_filename:
+            return sys.monitoring.DISABLE
+        key = (id(code), line)
+        if key not in self.seen:
+            self.seen.add(key)
+            self._new = True
+        # Keep receiving events for this location only until seen once.
+        return sys.monitoring.DISABLE
+
+    def start(self):
+        mon = sys.monitoring
+        mon.use_tool_id(_TOOL_ID, "holo-fuzz")
+        mon.register_callback(_TOOL_ID, mon.events.LINE, self._on_line)
+        mon.set_events(_TOOL_ID, mon.events.LINE)
+
+    def stop(self):
+        mon = sys.monitoring
+        mon.set_events(_TOOL_ID, 0)
+        mon.free_tool_id(_TOOL_ID)
+
+    def run(self, fn, data) -> tuple[bool, BaseException | None]:
+        """Execute fn(data); returns (new_coverage, crash_exc)."""
+        self._new = False
+        sys.monitoring.restart_events()
+        try:
+            fn(data)
+        except DecodeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — the point of the fuzzer
+            return self._new, e
+        return self._new, None
+
+
+def _mutate(rng: random.Random, seed: bytes) -> bytes:
+    data = bytearray(seed)
+    mode = rng.randrange(5)
+    if mode == 0 or not data:
+        return rng.randbytes(rng.randrange(0, 256))
+    if mode == 1:  # byte flips
+        for _ in range(rng.randrange(1, 8)):
+            data[rng.randrange(len(data))] = rng.randrange(256)
+    elif mode == 2:  # truncate / extend
+        if rng.random() < 0.5:
+            del data[rng.randrange(len(data)) :]
+        else:
+            data += rng.randbytes(rng.randrange(1, 32))
+    elif mode == 3:  # interesting integers at random offsets
+        v = rng.choice((0, 1, 0x7F, 0x80, 0xFF, 0xFFFF, 0x7FFFFFFF))
+        w = rng.choice((1, 2, 4))
+        off = rng.randrange(len(data))
+        chunk = (v & ((1 << (8 * w)) - 1)).to_bytes(w, "big")
+        data[off : off + w] = chunk
+    else:  # splice two seeds
+        other = bytearray(seed)
+        cut = rng.randrange(len(data))
+        data = data[:cut] + other[rng.randrange(len(other) or 1) :]
+    return bytes(data)
+
+
+def fuzz_target(
+    name: str,
+    fn,
+    seeds: list[bytes],
+    budget_s: float = 0.5,
+    rng: random.Random | None = None,
+) -> FuzzResult:
+    """Evolve a corpus for one decoder until the time budget lapses."""
+    rng = rng or random.Random(hash(name) & 0xFFFFFFFF)
+    res = FuzzResult(name=name)
+    cov = _Coverage()
+    cov.start()
+    try:
+        corpus = [s for s in seeds if s]
+        # Seed pass: baseline coverage from the valid inputs.
+        for s in corpus:
+            _, crash = cov.run(fn, s)
+            if crash is not None:
+                res.crashes.append((type(crash).__name__, str(crash)[:120], s.hex()))
+            res.executions += 1
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            seed = rng.choice(corpus) if corpus else b""
+            data = _mutate(rng, seed)
+            new_cov, crash = cov.run(fn, data)
+            res.executions += 1
+            if crash is not None:
+                res.crashes.append(
+                    (type(crash).__name__, str(crash)[:120], data.hex())
+                )
+                if len(res.crashes) >= 5:
+                    break
+            elif new_cov:
+                corpus.append(data)  # coverage-guided corpus growth
+        res.corpus_size = len(corpus)
+        res.coverage = len(cov.seen)
+    finally:
+        cov.stop()
+    return res
+
+
+# ===== target registry (the reference's fuzz_targets/** inventory) =====
+
+
+def _seed_corpus():
+    """Valid wire messages per protocol — reuses the regression corpus."""
+    from tests.test_fuzz_decoders import corpus  # noqa: PLC0415
+
+    return corpus()
+
+
+def targets() -> dict:
+    """name -> (decode_fn, seed_filter) — ≥25 targets mirroring
+    fuzz/fuzz_targets/** (bfd, bgp message+attribute, isis, ldp, ospf
+    v2+v3, rip, vrrp) plus igmp (ours)."""
+    from holo_tpu.protocols import bfd, bgp, igmp, ldp, rip, vrrp
+    from holo_tpu.protocols.isis import packet as isis_pkt
+    from holo_tpu.protocols.ldp import packet as ldp_full
+    from holo_tpu.protocols.ospf import packet as ospf_pkt
+    from holo_tpu.protocols.ospf import packet_v3 as v3
+
+    def ldp_pdu(data):
+        try:
+            return ldp_full.Pdu.decode(data)
+        except ldp_full.DecodeError as e:
+            raise DecodeError(str(e)) from e
+
+    def bgp_body(cls):
+        def run(data):
+            return cls.decode_body(Reader(data))
+
+        return run
+
+    out = {
+        # ospf/ (reference: 6 targets over v2+v3 packet/LSA)
+        "ospfv2_packet_decode": ospf_pkt.Packet.decode,
+        "ospfv2_lsa_decode": lambda b: ospf_pkt.Lsa.decode(Reader(b)),
+        "ospfv2_router_info_decode": ospf_pkt.decode_router_info,
+        "ospfv2_ext_prefix_decode": ospf_pkt.decode_ext_prefix_entries,
+        "ospfv2_grace_tlvs_decode": ospf_pkt.decode_grace_tlvs,
+        "ospfv3_packet_decode": v3.Packet.decode,
+        "ospfv3_lsa_decode": lambda b: v3.Lsa.decode(Reader(b)),
+        # isis/ (reference: isis_pdu_decode; split by PDU class for
+        # per-corpus guidance)
+        "isis_pdu_decode": isis_pkt.decode_pdu,
+        "isis_hello_decode": isis_pkt.decode_pdu,
+        "isis_lsp_decode": isis_pkt.decode_pdu,
+        "isis_snp_decode": isis_pkt.decode_pdu,
+        # ldp/
+        "ldp_msg_decode": ldp.LdpMsg.decode,
+        "ldp_pdu_decode": ldp_pdu,
+        # rip/
+        "ripv2_pdu_decode": rip.RipPacket.decode,
+        "ripng_pdu_decode": rip.RipngPacket.decode,
+        # bfd/
+        "bfd_packet_decode": bfd.BfdPacket.decode,
+        # vrrp/
+        "vrrphdr_ipv4_decode": lambda b: vrrp.VrrpPacket.decode(b, af=4),
+        "vrrphdr_ipv6_decode": lambda b: vrrp.VrrpPacket.decode(b, af=6),
+        # bgp/message + bgp/attribute
+        "bgp_message_decode": bgp.decode_msg,
+        "bgp_open_decode": bgp_body(bgp.OpenMsg),
+        "bgp_update_decode": bgp_body(bgp.UpdateMsg),
+        "bgp_notification_decode": bgp_body(bgp.NotificationMsg),
+        "bgp_keepalive_decode": bgp_body(bgp.KeepaliveMsg),
+        "bgp_attrs_decode": lambda b: bgp.PathAttrs.decode(Reader(b)),
+        "bgp_ipv4_prefix_decode": lambda b: bgp._decode_prefixes(Reader(b)),
+        "bgp_ipv6_prefix_decode": lambda b: bgp._decode_prefixes(
+            Reader(b), v6=True
+        ),
+        # igmp (no reference counterpart — ours has a kernel-facing decoder)
+        "igmp_packet_decode": igmp.IgmpPacket.decode,
+    }
+    return out
+
+
+def run_all(budget_s: float = 0.5) -> dict[str, FuzzResult]:
+    seeds = _seed_corpus()
+    results = {}
+    for name, fn in sorted(targets().items()):
+        results[name] = fuzz_target(name, fn, seeds, budget_s=budget_s)
+    return results
+
+
+if __name__ == "__main__":
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 2.0
+    total_crashes = 0
+    for name, res in run_all(budget).items():
+        status = "CRASH" if res.crashes else "ok"
+        print(
+            f"{name:28} {status:5} execs={res.executions:6} "
+            f"cov={res.coverage:5} corpus={res.corpus_size}"
+        )
+        for exc, msg, hexdata in res.crashes[:3]:
+            print(f"    {exc}: {msg}  input={hexdata[:80]}")
+        total_crashes += len(res.crashes)
+    sys.exit(1 if total_crashes else 0)
