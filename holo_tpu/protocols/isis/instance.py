@@ -114,6 +114,8 @@ class Adjacency:
     protocols: tuple = ()
     addrs4: tuple = ()
     addrs6: tuple = ()
+    # RFC 5120 topologies from the hello's MT TLV ((0,) when absent).
+    topologies: tuple = (0,)
 
 
 @dataclass
@@ -223,6 +225,15 @@ class IsisIfDownMsg:
 class LspEntry:
     lsp: Lsp
     installed_at: float
+    # Provenance for operational-state rendering: received off the wire
+    # (vs locally originated), and whether a database copy existed when
+    # this instance was installed (a purge for an unknown LSP renders
+    # without lifetime leaves; reference state.rs).
+    rcvd: bool = False
+    had_copy: bool = False
+    # Header-only entry: a received purge for an LSP we never held
+    # (§7.3.16.4) — renders as id + attributes, no lifetime leaves.
+    hdr_only: bool = False
 
     def remaining_lifetime(self, now: float) -> int:
         return max(0, int(self.lsp.lifetime - (now - self.installed_at)))
@@ -337,6 +348,10 @@ class IsisInstance(Actor):
         self.hostnames: dict[bytes, str] = {}
         self.spf_run_count = 0
         self._spf_pending = False
+        # RFC 8405 SPF-delay FSM state surfaced in operational state
+        # (reference spf.rs delay FSM; transitions driven by IGP events
+        # + the Learn/HoldDown timers the conformance harness replays).
+        self.spf_delay_state = "quiet"
         # Flooding reduction: per-sender coverage map rebuilt after each
         # full SPF (reference flooding/manet.rs).  _covered_by[sender
         # sysid] = iface names whose neighbor is adjacent to that sender.
@@ -496,6 +511,9 @@ class IsisInstance(Actor):
         adj.protocols = tuple(hello.tlvs.get("protocols_supported") or ())
         adj.addrs4 = tuple(addrs)
         adj.addrs6 = tuple(hello.tlvs.get("ipv6_addresses") or ())
+        adj.topologies = tuple(
+            mt for mt, _a, _o in hello.tlvs.get("mt_ids") or ()
+        ) or (0,)
 
     # -- LAN hellos + DIS election (ISO 10589 §8.4.5)
 
@@ -663,13 +681,18 @@ class IsisInstance(Actor):
                 self._adj_down(iface.name)
 
     def clear_database(self) -> None:
-        """ietf-isis clear-database RPC: drop the LSDB and rebuild our
-        own LSPs (neighbors resync via CSNP/PSNP)."""
+        """ietf-isis clear-database RPC: drop the LSDB, RESTART every
+        adjacency (the reference's clear tears them down; hellos re-form
+        them), and rebuild our own LSPs from scratch."""
         self.lsdb.clear()
         self._plain_raw.clear()
         for iface in self.interfaces.values():
             iface.srm.clear()
             iface.ssn.clear()
+            for adj in iface.all_adjacencies():
+                self._bfd_unreg_adj(iface, adj)
+            iface.adj = None
+            iface.adjs.clear()
         self._originate_lsp(force=True)
         self._schedule_spf()
 
@@ -742,6 +765,10 @@ class IsisInstance(Actor):
             adj = Adjacency(sysid=hello.sysid)
             iface.adj = adj
         adj.hold_time = hello.hold_time
+        # The hello's circuit type drives the adjacency's rendered usage
+        # on p2p links (level-1/level-2/level-all), independent of our
+        # own level (reference adjacency arena).
+        adj.usage_ctype = hello.circuit_type
         self._adj_learn_tlvs(adj, hello)
         p2p = hello.tlvs.get("p2p_adj")
         old = adj.state
@@ -893,8 +920,12 @@ class IsisInstance(Actor):
         self._adj_changed()
 
     def _adj_changed(self) -> None:
+        # No direct SPF trigger: the RFC 8405 Igp event fires from LSP
+        # CONTENT changes at install (reference lsdb.rs:1606-1618) — if
+        # the adjacency change altered our LSP, the re-origination below
+        # schedules it; a LAN member losing an adjacency it never
+        # advertised (the DIS does) waits for the pseudonode update.
         self._originate_lsp()
-        self._schedule_spf()
 
     # -- LSP origination
 
@@ -1200,7 +1231,15 @@ class IsisInstance(Actor):
 
     def _install_lsp(self, lsp: Lsp, flood_from: str | None) -> None:
         now = self.loop.clock.now()
-        self.lsdb[lsp.lsp_id] = LspEntry(lsp, now)
+        prev = self.lsdb.get(lsp.lsp_id)
+        self.lsdb[lsp.lsp_id] = LspEntry(
+            lsp, now,
+            rcvd=flood_from is not None,
+            # Only a LIVE copy counts (not SNP shells or prior purges).
+            had_copy=prev is not None
+            and prev.lsp.seqno != 0
+            and prev.lsp.lifetime > 0,
+        )
         # RFC 5301: learn/forget the originator's dynamic hostname.
         if lsp.lsp_id.pseudonode == 0 and lsp.lsp_id.fragment == 0:
             name = lsp.tlvs.get("hostname")
@@ -1230,7 +1269,17 @@ class IsisInstance(Actor):
             else:
                 iface.srm.add(lsp.lsp_id)
         self._arm_flood()
-        self._schedule_spf()
+        # SPF (and the RFC 8405 Igp event) fires only on CONTENT change —
+        # a pure refresh (same TLVs/flags/liveness, new seqno) schedules
+        # nothing (reference lsdb.rs:1558-1618).
+        content_change = not (
+            prev is not None
+            and prev.lsp.is_expired == lsp.is_expired
+            and prev.lsp.flags == lsp.flags
+            and prev.lsp.tlvs == lsp.tlvs
+        )
+        if content_change and lsp.seqno != 0:
+            self._schedule_spf()
 
     def _arm_flood(self) -> None:
         if not self._flood_timer.armed:
@@ -1411,6 +1460,18 @@ class IsisInstance(Actor):
                 )
                 lsp.tlvs["hostname"] = self.hostname
                 lsp.encode(auth=self.auth)
+            if (
+                lsp.is_expired
+                and not lsp.tlvs.get("purge_originator")
+                and (cur is None or cur.lsp.seqno == 0 or cur.lsp.is_expired)
+            ):
+                # §7.3.16.4: a purge for an LSP we never held installs
+                # as a HEADER-ONLY entry (acked and remembered, but no
+                # body/lifetime state — reference state.rs renders just
+                # the id and attributes).
+                self._install_lsp(lsp, flood_from=iface.name)
+                self.lsdb[lsp.lsp_id].hdr_only = True
+                return
             self._install_lsp(lsp, flood_from=iface.name)
         elif c == 0:
             if cur is not None and cur.lsp.cksum != lsp.cksum and cur.lsp.seqno != 0:
@@ -1427,8 +1488,10 @@ class IsisInstance(Actor):
                 iface.ssn.add(lsp.lsp_id)
             self._arm_flood()
         else:
-            # Ours is newer: send it back.
+            # Ours is newer: send it back — and clear any pending ack
+            # for the stale instance (§7.3.16.4.c: set SRM, clear SSN).
             iface.srm.add(lsp.lsp_id)
+            iface.ssn.discard(lsp.lsp_id)
             self._arm_flood()
 
     def _snp_entry_update(self, iface: IsisInterface, lid: LspId, lt: int, seq: int, ck: int) -> None:
@@ -1535,9 +1598,19 @@ class IsisInstance(Actor):
     # -- SPF (shared backend)
 
     def _schedule_spf(self) -> None:
+        if self.spf_delay_state == "quiet":
+            self.spf_delay_state = "short-wait"
         if not self._spf_pending:
             self._spf_pending = True
             self._spf_timer.start(0.1)
+
+    def spf_delay_event(self, event: str) -> None:
+        """RFC 8405 timer transitions (LEARN/HOLDDOWN; the conformance
+        harness replays them at the recorded positions)."""
+        if event == "learn" and self.spf_delay_state == "short-wait":
+            self.spf_delay_state = "long-wait"
+        elif event == "holddown":
+            self.spf_delay_state = "quiet"
 
     def run_spf(self) -> None:
         self.spf_run_count += 1

@@ -1,0 +1,550 @@
+"""ietf-isis operational-state rendering (YANG-modeled, full tree).
+
+Builds the same ``ietf-isis:isis`` state tree the reference's northbound
+walks (holo-isis/src/northbound/state.rs): spf-control, hostnames, the
+per-level LSP database with every TLV rendered, the local RIB, and the
+per-interface adjacency/SRM/SSN planes — so the conformance harness can
+diff the FULL recorded northbound-state plane leaf by leaf
+(VERDICT round-2 item 2; tools/stepwise_isis.py compare_state).
+"""
+
+from __future__ import annotations
+
+from holo_tpu.protocols.isis.instance import AdjacencyState
+
+def _adj_sid_flags(fl: int) -> list[str]:
+    """RFC 8667 §2.2.1 flag names in the reference's render order."""
+    names = []
+    for bit, name in (
+        (0x80, "f-flag"),
+        (0x40, "b-flag"),
+        (0x20, "vi-flag"),
+        (0x10, "lg-flag"),
+        (0x08, "s-flag"),
+        (0x04, "p-flag"),
+    ):
+        if fl & bit:
+            names.append(name)
+    return names
+
+
+_ALGO = {
+    0: "ietf-segment-routing-common:prefix-sid-algorithm-shortest-path",
+    1: "ietf-segment-routing-common:prefix-sid-algorithm-strict-spf",
+}
+
+
+def sysid_str(b: bytes) -> str:
+    h = b.hex()
+    return f"{h[0:4]}.{h[4:8]}.{h[8:12]}"
+
+
+def lsp_id_str(lid) -> str:
+    raw = lid.encode() if hasattr(lid, "encode") else bytes(lid)
+    return f"{sysid_str(raw[:6])}.{raw[6]:02x}-{raw[7]:02x}"
+
+
+def _area_str(a: bytes) -> str:
+    h = a.hex()
+    return h[0:2] + "".join(
+        "." + h[i : i + 4] for i in range(2, len(h), 4)
+    )
+
+
+def _narrow_metric_block(metric: int, i_e: bool = False) -> dict:
+    return {
+        "i-e": i_e,
+        "default-metric": {"metric": metric},
+        "delay-metric": {"supported": False},
+        "expense-metric": {"supported": False},
+        "error-metric": {"supported": False},
+    }
+
+
+def _wide_prefix(entry, mt_id: int | None = None) -> dict:
+    """extended-ipv4-reachability / ipv6-reachability prefix node."""
+    out: dict = {}
+    if mt_id is not None:
+        out["mt-id"] = mt_id
+    out |= {
+        "up-down": bool(entry.up_down),
+        "ip-prefix": str(entry.prefix.network_address),
+        "prefix-len": entry.prefix.prefixlen,
+        "metric": entry.metric,
+    }
+    # v6 reach (RFC 5308) carries X in its control byte, so the flag
+    # always renders; v4 wide reach gets X/R/N only from the RFC 7794
+    # prefix-attributes sub-TLV (matches the recorded trees).
+    if entry.prefix.version == 6:
+        out["external-prefix-flag"] = bool(entry.external) or bool(
+            (entry.attr_flags or 0) & 0x80
+        )
+        if entry.attr_flags is not None:
+            out["readvertisement-flag"] = bool(entry.attr_flags & 0x40)
+            out["node-flag"] = bool(entry.attr_flags & 0x20)
+    elif entry.attr_flags is not None:
+        out["external-prefix-flag"] = bool(entry.attr_flags & 0x80)
+        out["readvertisement-flag"] = bool(entry.attr_flags & 0x40)
+        out["node-flag"] = bool(entry.attr_flags & 0x20)
+    if getattr(entry, "src_rid4", None) is not None:
+        out["ipv4-source-router-id"] = str(entry.src_rid4)
+    if getattr(entry, "src_rid6", None) is not None:
+        out["ipv6-source-router-id"] = str(entry.src_rid6)
+    if entry.sid_index is not None:
+        flags = []
+        for bit, name in (
+            (0x80, "r-flag"),
+            (0x40, "n-flag"),
+            (0x20, "p-flag"),
+            (0x10, "e-flag"),
+            (0x08, "v-flag"),
+            (0x04, "l-flag"),
+        ):
+            if entry.sid_flags & bit:
+                flags.append(name)
+        out["ietf-isis-sr-mpls:prefix-sid-sub-tlvs"] = {
+            "prefix-sid-sub-tlv": [
+                {
+                    "prefix-sid-flags": {"flag": flags},
+                    "algorithm": _ALGO[0],
+                    "index-value": entry.sid_index,
+                }
+            ]
+        }
+    return out
+
+
+def _narrow_prefixes(entries) -> list:
+    return [
+        {
+            "ip-prefix": str(e.prefix.network_address),
+            "prefix-len": e.prefix.prefixlen,
+        }
+        | _narrow_metric_block(e.metric)
+        for e in entries
+    ]
+
+
+def _render_lsp(lsp, entry_meta=None) -> dict:
+    t = lsp.tlvs
+    out: dict = {"lsp-id": lsp_id_str(lsp.lsp_id)}
+    flags = []
+    if lsp.flags & 0x01:
+        flags.append("lsp-l1-system-flag")
+    if lsp.flags & 0x02:
+        flags.append("lsp-l2-system-flag")
+    if lsp.flags & 0x04:
+        flags.append("lsp-overload-flag")
+    if lsp.flags & 0x40:
+        # The reference models one ATT bit at 0x40 (packet/pdu.rs:137).
+        flags.append("lsp-attached-default-metric-flag")
+    # Descending bit order, as the reference's bitflags render.
+    order = [
+        "lsp-attached-default-metric-flag",
+        "lsp-overload-flag",
+        "lsp-l2-system-flag",
+        "lsp-l1-system-flag",
+    ]
+    if lsp.seqno == 0:
+        # Empty shell entry (a PSNP named an LSP we do not have yet):
+        # the reference renders only the id and the zero sequence.
+        return {"lsp-id": out["lsp-id"], "sequence": 0}
+    out["attributes"] = {
+        "lsp-flags": [f for f in order if f in flags]
+    }
+    if lsp.lifetime == 0:
+        # Purged LSP (no sequence leaf — it is scrubbed as
+        # nondeterministic for live LSPs and simply absent here);
+        # whatever TLVs the purge carried still render (RFC 6232 purges
+        # keep hostname + purge-originator).  Lifetime leaves depend on
+        # provenance: a purge replacing a known received LSP pins both
+        # at zero; a locally generated purge renders only
+        # remaining-lifetime; a received purge for an UNKNOWN LSP
+        # renders neither (reference state.rs).
+        rcvd = getattr(entry_meta, "rcvd", True)
+        had = getattr(entry_meta, "had_copy", True)
+        if getattr(entry_meta, "hdr_only", False) or not (rcvd or had):
+            # §7.3.16.4 header-only entry (a purge for an LSP we never
+            # actually held): id + attributes only.
+            return {"lsp-id": out["lsp-id"], "attributes": out["attributes"]}
+        if rcvd:
+            out["remaining-lifetime"] = 0
+            out["holo-isis:received-remaining-lifetime"] = 0
+        else:
+            # Locally generated purge: no received lifetime to pin.
+            out["remaining-lifetime"] = 0
+        po = t.get("purge_originator")
+        if po:
+            node = {"originator": sysid_str(po[0])}
+            if len(po) > 1:
+                node["received-from"] = sysid_str(po[1])
+            out["holo-isis:purge-originator-identification"] = node
+    if t.get("ip_addresses"):
+        out["ipv4-addresses"] = [str(a) for a in t["ip_addresses"]]
+    if t.get("ipv6_addresses"):
+        out["ipv6-addresses"] = [str(a) for a in t["ipv6_addresses"]]
+    if t.get("protocols_supported"):
+        out["protocol-supported"] = list(t["protocols_supported"])
+    if t.get("hostname"):
+        out["dynamic-hostname"] = t["hostname"]
+    if t.get("ipv4_router_id"):
+        out["ipv4-te-routerid"] = str(t["ipv4_router_id"])
+    if t.get("ipv6_router_id"):
+        out["ipv6-te-routerid"] = str(t["ipv6_router_id"])
+    def _nbr_id(raw: bytes) -> str:
+        return sysid_str(raw[:6]) + (
+            f".{raw[6]:02x}" if len(raw) > 6 else ""
+        )
+
+    def _grouped(entries, instance_of):
+        """Parallel adjacencies to one neighbor render as ONE list entry
+        with per-instance ids (the reference groups by neighbor-id)."""
+        by_id: dict[str, list] = {}
+        for n in entries:
+            by_id.setdefault(_nbr_id(n.neighbor), []).append(n)
+        # BTreeMap order, like the reference renders.
+        return [
+            {
+                "neighbor-id": nid,
+                "instances": {
+                    "instance": [
+                        {"id": i} | instance_of(n)
+                        for i, n in enumerate(group)
+                    ]
+                },
+            }
+            for nid, group in sorted(by_id.items())
+        ]
+
+    if t.get("narrow_is_reach"):
+        out["is-neighbor"] = {
+            "neighbor": _grouped(
+                t["narrow_is_reach"],
+                lambda n: _narrow_metric_block(n.metric),
+            )
+        }
+    def _ext_instance(n) -> dict:
+        node = {"metric": n.metric}
+        if getattr(n, "adj_sids", None):
+            node["ietf-isis-sr-mpls:adj-sid-sub-tlvs"] = {
+                "adj-sid-sub-tlv": [
+                    {
+                        "adj-sid-flags": {"flag": _adj_sid_flags(fl)},
+                        "weight": w,
+                        "label-value": label,
+                    }
+                    for fl, w, label in n.adj_sids
+                ]
+            }
+        if getattr(n, "link_msd", None):
+            node["ietf-isis-msd:link-msd-sub-tlv"] = {
+                "link-msds": [
+                    {"msd-type": mt, "msd-value": v}
+                    for mt, v in n.link_msd
+                ]
+            }
+        return node
+
+    if t.get("ext_is_reach"):
+        out["extended-is-neighbor"] = {
+            "neighbor": _grouped(t["ext_is_reach"], _ext_instance)
+        }
+    if t.get("mt_is_reach"):
+        by_key: dict[tuple, list] = {}
+        for mt, n in t["mt_is_reach"]:
+            by_key.setdefault((mt, _nbr_id(n.neighbor)), []).append(n)
+        out["mt-is-neighbor"] = {
+            "neighbor": [
+                {
+                    "mt-id": mt,
+                    "neighbor-id": nid,
+                    "instances": {
+                        "instance": [
+                            {"id": i, "metric": n.metric}
+                            for i, n in enumerate(group)
+                        ]
+                    },
+                }
+                for (mt, nid), group in sorted(by_key.items())
+            ]
+        }
+    if t.get("narrow_ip_reach"):
+        out["ipv4-internal-reachability"] = {
+            "prefixes": _narrow_prefixes(t["narrow_ip_reach"])
+        }
+    if t.get("narrow_ip_ext_reach"):
+        out["ipv4-external-reachability"] = {
+            "prefixes": _narrow_prefixes(t["narrow_ip_ext_reach"])
+        }
+    # Wire/TLV order throughout: received LSPs replay byte-exact, and
+    # our own origination emits the reference's order.
+    if t.get("ext_ip_reach"):
+        out["extended-ipv4-reachability"] = {
+            "prefixes": [_wide_prefix(e) for e in t["ext_ip_reach"]]
+        }
+    if t.get("ipv6_reach"):
+        out["ipv6-reachability"] = {
+            "prefixes": [_wide_prefix(e) for e in t["ipv6_reach"]]
+        }
+    if t.get("mt_ipv6_reach"):
+        out["mt-ipv6-reachability"] = {
+            "prefixes": [
+                _wide_prefix(e, mt_id=mt) for mt, e in t["mt_ipv6_reach"]
+            ]
+        }
+    if t.get("mt_ids"):
+        out["mt-entries"] = {
+            "topology": [{"mt-id": mt} for mt, _a, _o in t["mt_ids"]]
+        }
+    if any(
+        t.get(k)
+        for k in ("sr_cap", "srlb", "node_msd", "node_tags", "sr_algos")
+    ):
+        rc: dict = {}
+        if t.get("sr_cap"):
+            base, rng = t["sr_cap"]
+            cap_flags = t.get("sr_cap_flags", 0xC0)
+            names = []
+            if cap_flags & 0x80:
+                names.append("mpls-ipv4")
+            if cap_flags & 0x40:
+                names.append("mpls-ipv6")
+            rc["ietf-isis-sr-mpls:sr-capability"] = {
+                "sr-capability-flag": names,
+                "global-blocks": {
+                    "global-block": [
+                        {"range-size": rng, "label-value": base}
+                    ]
+                },
+            }
+        if t.get("sr_algos") or t.get("sr_cap"):
+            rc["ietf-isis-sr-mpls:sr-algorithms"] = {
+                "sr-algorithm": [
+                    _ALGO.get(a, _ALGO[0])
+                    for a in (t.get("sr_algos") or (0,))
+                ]
+            }
+        if t.get("srlb"):
+            base, rng = t["srlb"]
+            rc["ietf-isis-sr-mpls:local-blocks"] = {
+                "local-block": [{"range-size": rng, "label-value": base}]
+            }
+        if t.get("node_msd"):
+            rc["ietf-isis-msd:node-msd-tlv"] = {
+                "node-msds": [
+                    {"msd-type": mt, "msd-value": v}
+                    for mt, v in sorted(t["node_msd"].items())
+                ]
+            }
+        if t.get("node_tags"):
+            rc["node-tags"] = {
+                "node-tag": [{"tag": tag} for tag in t["node_tags"]]
+            }
+        out["router-capabilities"] = {"router-capability": [rc]}
+    if t.get("area_addresses"):
+        out["holo-isis:area-addresses"] = [
+            _area_str(a) for a in t["area_addresses"]
+        ]
+    if t.get("lsp_buf_size"):
+        out["holo-isis:lsp-buffer-size"] = t["lsp_buf_size"]
+    return out
+
+
+def _render_level_db(inst, now: float) -> dict:
+    entries = sorted(
+        inst.lsdb.items(), key=lambda kv: bytes(kv[0].encode())
+    )
+    lsps = [_render_lsp(e.lsp, entry_meta=e) for _lid, e in entries]
+    # The count excludes entries mid-purge (the ones rendering a pinned
+    # zero remaining-lifetime); header-only shells still count
+    # (reference lsp-count gauge).
+    live = sum(1 for n in lsps if "remaining-lifetime" not in n)
+    return {
+        "level": inst.level,
+        "lsp": lsps,
+        "holo-isis:lsp-count": live,
+    }
+
+
+def _render_iface(insts, ifname: str) -> dict:
+    out: dict = {"name": ifname}
+    adjacencies = []
+    state = "down"
+    srm_levels = []
+    ssn_levels = []
+    # A p2p adjacency UP in both levels is ONE level-all adjacency in
+    # the reference's arena (usage/sys-type "level-all").
+    seen_levels: dict[tuple, set] = {}
+    for inst in insts:
+        iface = inst.interfaces.get(ifname)
+        if iface is not None and not getattr(iface, "is_lan", False):
+            for a in iface.all_adjacencies():
+                seen_levels.setdefault((ifname, a.sysid), set()).add(
+                    inst.level
+                )
+    rendered_all: set = set()
+    for inst in insts:
+        iface = inst.interfaces.get(ifname)
+        if iface is None:
+            continue
+        if getattr(iface, "up", True) and getattr(inst, "enabled", True):
+            state = "up"
+        for a in iface.all_adjacencies():
+            lvl = f"level-{inst.level}"
+            sys_type = lvl
+            ctype = getattr(a, "usage_ctype", None)
+            if not getattr(iface, "is_lan", False):
+                # p2p: sys-type is what the neighbor's hello announced;
+                # usage is the negotiated intersection with our levels.
+                if ctype == 3:
+                    sys_type = "level-all"
+                elif ctype in (1, 2):
+                    sys_type = f"level-{ctype}"
+                both_local = (
+                    seen_levels.get((ifname, a.sysid), set()) == {1, 2}
+                )
+                if sys_type == "level-all" and both_local:
+                    if (ifname, a.sysid) in rendered_all:
+                        continue
+                    rendered_all.add((ifname, a.sysid))
+                    lvl = "level-all"
+            node = {
+                "neighbor-sys-type": sys_type,
+                "neighbor-sysid": sysid_str(a.sysid),
+                "usage": lvl,
+            }
+            if getattr(iface, "is_lan", False):
+                node["neighbor-priority"] = a.priority
+            node["state"] = {
+                AdjacencyState.UP: "up",
+                AdjacencyState.INITIALIZING: "init",
+                AdjacencyState.DOWN: "down",
+            }[a.state]
+            if a.adj_sids:
+                node["ietf-isis-sr-mpls:adjacency-sid"] = [
+                    {
+                        "value": label,
+                        "address-family": "ipv6" if fl & 0x80 else "ipv4",
+                        "weight": w,
+                        "protection-requested": bool(fl & 0x40),
+                    }
+                    for fl, w, label in a.adj_sids
+                ]
+            if a.area_addresses:
+                node["holo-isis:area-addresses"] = [
+                    _area_str(x) for x in a.area_addresses
+                ]
+            if a.addrs4:
+                node["holo-isis:ipv4-addresses"] = [
+                    str(x) for x in a.addrs4
+                ]
+            if a.addrs6:
+                node["holo-isis:ipv6-addresses"] = [
+                    str(x) for x in a.addrs6
+                ]
+            if a.protocols:
+                node["holo-isis:protocol-supported"] = list(a.protocols)
+            node["holo-isis:topologies"] = sorted(set(a.topologies) | {0})
+            adjacencies.append(node)
+        for attr, acc in (("srm", srm_levels), ("ssn", ssn_levels)):
+            ids = sorted(
+                lsp_id_str(lid) for lid in getattr(iface, attr, ())
+            )
+            if ids:
+                acc.append({"level": inst.level, "lsp-id": ids})
+    if adjacencies:
+        out["adjacencies"] = {"adjacency": adjacencies}
+    out["holo-isis:state"] = state
+    if srm_levels:
+        out["holo-isis-dev:srm"] = {"level": srm_levels}
+    if ssn_levels:
+        out["holo-isis-dev:ssn"] = {"level": ssn_levels}
+    return out
+
+
+def instance_state(
+    insts, node=None, now: float | None = None, ifnames=None
+) -> dict:
+    """The full ietf-isis:isis state tree over one or two level
+    instances (``node`` = the L1/L2 facade when running level-all).
+    ``ifnames``: ordered CONFIGURED interface list — a configured but
+    down interface renders with state "down" even though the instances
+    no longer hold it."""
+    insts = list(insts)
+    if now is None:
+        now = insts[0].loop.clock.now() if insts else 0.0
+    out: dict = {}
+    if insts and not any(getattr(i, "enabled", True) for i in insts):
+        # Disabled instance: only the interface table renders, all down
+        # (reference: the torn-down Instance has no Up state).
+        if ifnames is None:
+            ifnames = [
+                n for inst in insts for n in inst.interfaces
+            ]
+        out["interfaces"] = {
+            "interface": [
+                {"name": n, "holo-isis:state": "down"} for n in ifnames
+            ]
+        }
+        return out
+    spf_levels = [
+        {
+            "level": inst.level,
+            "current-state": getattr(inst, "spf_delay_state", "quiet"),
+        }
+        for inst in insts
+    ]
+    out["spf-control"] = {
+        "ietf-spf-delay": {"holo-isis:level": spf_levels}
+    }
+    names: dict[str, str] = {}
+    for inst in insts:
+        for sysid, name in inst.hostnames.items():
+            names.setdefault(sysid_str(sysid), name)
+    if names:
+        out["hostnames"] = {
+            "hostname": [
+                {"system-id": sid, "hostname": n}
+                for sid, n in sorted(names.items())
+            ]
+        }
+    out["database"] = {
+        "levels": [_render_level_db(inst, now) for inst in insts]
+    }
+    routes_src = node if node is not None else insts[0]
+    route_nodes = []
+    l1 = next((i for i in insts if i.level == 1), None)
+    for prefix in sorted(
+        routes_src.routes, key=lambda p: (p.version, int(p.network_address), p.prefixlen)
+    ):
+        metric, nhs = routes_src.routes[prefix][:2]
+        level = 2 if len(insts) > 1 else insts[0].level
+        if l1 is not None and routes_src.routes[prefix] == l1.routes.get(prefix):
+            level = 1
+        node_r: dict = {"prefix": str(prefix)}
+        nh_nodes = []
+        for ifn, addr in sorted(
+            nhs, key=lambda x: (str(x[0]), str(x[1]))
+        ):
+            nh: dict = {}
+            if addr is not None:
+                nh["next-hop"] = str(addr)
+            nh["outgoing-interface"] = ifn
+            nh_nodes.append(nh)
+        if nh_nodes:
+            node_r["next-hops"] = {"next-hop": nh_nodes}
+        node_r["metric"] = metric
+        node_r["level"] = level
+        route_nodes.append(node_r)
+    if route_nodes:
+        out["local-rib"] = {"route": route_nodes}
+    if ifnames is None:
+        ifnames = []
+        for inst in insts:
+            for name in inst.interfaces:
+                if name not in ifnames:
+                    ifnames.append(name)
+    out["interfaces"] = {
+        "interface": [_render_iface(insts, n) for n in ifnames]
+    }
+    return out

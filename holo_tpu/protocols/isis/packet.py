@@ -626,12 +626,18 @@ def _decode_tlvs(r: Reader) -> dict:
                 stl = body.u8()
                 sb = body.sub(min(stl, body.remaining()))
                 if st == 2 and stl >= 9:
-                    sb.u8()  # sr flags
+                    out["sr_cap_flags"] = sb.u8()  # I=0x80 V=0x40
                     rng = int.from_bytes(sb.bytes(3), "big")
                     if sb.remaining() >= 5 and sb.u8() == 1:
                         sb.u8()  # length (3)
                         base = int.from_bytes(sb.bytes(3), "big")
                         out["sr_cap"] = (base, rng)
+                elif st == 19:
+                    # RFC 8667 §3.2 SR-Algorithm sub-TLV.
+                    algos = []
+                    while sb.remaining() >= 1:
+                        algos.append(sb.u8())
+                    out["sr_algos"] = tuple(algos)
                 elif st == 22 and stl >= 9:
                     sb.u8()  # reserved
                     rng = int.from_bytes(sb.bytes(3), "big")

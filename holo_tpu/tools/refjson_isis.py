@@ -290,13 +290,18 @@ def lsp_tlvs_to_json(tlvs: dict) -> dict:
         sub: dict = {}
         if tlvs.get("sr_cap"):
             base, rng = tlvs["sr_cap"]
+            fl = tlvs.get("sr_cap_flags", 0xC0)
+            names = [n for b, n in ((0x80, "I"), (0x40, "V")) if fl & b]
             sub["sr_cap"] = {
-                "flags": "I | V",
+                "flags": " | ".join(names),
                 "srgb_entries": [
                     {"range": rng, "first": {"Label": base}}
                 ],
             }
-            sub["sr_algo"] = ["Spf"]
+            sub["sr_algo"] = [
+                {0: "Spf", 1: "StrictSpf"}.get(a, "Spf")
+                for a in (tlvs.get("sr_algos") or (0,))
+            ]
         if tlvs.get("srlb"):
             base, rng = tlvs["srlb"]
             sub["srlb"] = {
@@ -416,6 +421,15 @@ def lsp_tlvs_from_json(j: dict) -> dict:
             )
             if first is not None:
                 tlvs["sr_cap"] = (first, ent.get("range", 0))
+            fl = 0
+            for name in str(sr.get("flags", "I | V")).split("|"):
+                fl |= {"I": 0x80, "V": 0x40}.get(name.strip(), 0)
+            tlvs["sr_cap_flags"] = fl
+        if sub.get("sr_algo"):
+            tlvs["sr_algos"] = tuple(
+                {"Spf": 0, "StrictSpf": 1}.get(a, 0)
+                for a in sub["sr_algo"]
+            )
         lb = sub.get("srlb")
         if lb and lb.get("entries"):
             ent = lb["entries"][0]
@@ -612,10 +626,14 @@ def pdu_from_json(j: dict):
             flags=_flags_val(sub.get("flags", ""), _LSP_FLAGS),
             tlvs=lsp_tlvs_from_json(sub.get("tlvs") or {}),
         )
-        recorded_cksum = sub.get("cksum", 0)
+        recorded_cksum = sub.get("cksum")
         lsp.encode()  # fills raw + computes the real checksum
-        if recorded_cksum:
-            # Hand-written corpus checksums drive §7.3.16 comparisons.
+        if recorded_cksum is not None:
+            # The recorded checksum drives §7.3.16 comparisons — INCLUDING
+            # an explicit zero: the reference's testing build stores and
+            # compares 0 as-is (RFC 3719 §7 validation is skipped), so a
+            # later SNP naming the same zero checksum must not look like
+            # LSP confusion.
             lsp.cksum = recorded_cksum
         pdu_type = PduType.LSP_L2 if level == 2 else PduType.LSP_L1
         return pdu_type, lsp

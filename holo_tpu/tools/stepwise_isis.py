@@ -501,7 +501,8 @@ class CaseRun:
                 inst._flush_flooding(srm_only=True)
             self._remerge()
         elif "SpfDelayEvent" in ev:
-            if ev["SpfDelayEvent"].get("event") == "DelayTimer":
+            sev = ev["SpfDelayEvent"].get("event")
+            if sev == "DelayTimer":
                 if self.level_all:
                     lv = ev["SpfDelayEvent"].get("level")
                     self.node.run_spf(
@@ -514,6 +515,12 @@ class CaseRun:
                     for inst in self._by_level(ev["SpfDelayEvent"]):
                         inst.run_spf()
                 self.loop.run_until_idle()
+            elif sev == "LearnTimer":
+                for inst in self._by_level(ev["SpfDelayEvent"]):
+                    inst.spf_delay_event("learn")
+            elif sev == "HoldDownTimer":
+                for inst in self._by_level(ev["SpfDelayEvent"]):
+                    inst.spf_delay_event("holddown")
         elif "AdjInitLsdbSync" in ev:
             pass  # our adjacency-up path sends the init CSNP inline
         elif "AdjHoldTimer" in ev:
@@ -618,6 +625,8 @@ class CaseRun:
                 for inst in self.insts:
                     inst.lsdb.clear()
                     inst._plain_raw.clear()
+                    inst.hostnames.clear()
+                    inst.enabled = False
                     for iface in inst.interfaces.values():
                         iface.adj = None
                         iface.adjs.clear()
@@ -625,6 +634,7 @@ class CaseRun:
                         iface.ssn.clear()
             else:
                 for inst in self.insts:
+                    inst.enabled = True
                     inst._plain_raw.clear()
                     inst._originate_lsp(force=True)
         mt = isis.get("metric-type") or {}
@@ -1101,122 +1111,21 @@ class CaseRun:
         return problems
 
     def compare_state(self, state: dict) -> list[str]:
+        """Full-tree compare: the recorded ietf-isis state plane against
+        our YANG-modeled operational state (both-sided, every leaf) —
+        same contract as the OSPFv2 harness."""
+        from holo_tpu.protocols.isis.nb_state import instance_state
+        from holo_tpu.tools.treediff import tree_diff
+
         isis = state["ietf-routing:routing"]["control-plane-protocols"][
             "control-plane-protocol"
         ][0]["ietf-isis:isis"]
-        problems = []
-        # local-rib plane
-        rib = (isis.get("local-rib") or {}).get("route")
-        if rib is not None:
-            expected = {}
-            for route in rib:
-                nhs = frozenset(
-                    (
-                        nh.get("outgoing-interface"),
-                        nh.get("next-hop"),
-                    )
-                    for nh in route.get("next-hops", {}).get("next-hop", [])
-                )
-                from ipaddress import ip_network
-
-                expected[ip_network(route["prefix"])] = (
-                    route.get("metric", 0),
-                    nhs,
-                )
-            ours = (
-                self.node.routes if self.level_all else self.inst.routes
-            )
-            for prefix, (metric, nhs) in expected.items():
-                got = ours.get(prefix)
-                if got is None:
-                    problems.append(f"missing route {prefix}")
-                    continue
-                if got[0] != metric:
-                    problems.append(
-                        f"{prefix}: metric {got[0]} != {metric}"
-                    )
-                got_nhs = frozenset(
-                    (ifn, str(a) if a is not None else None)
-                    for ifn, a in got[1]
-                )
-                if got_nhs != nhs:
-                    problems.append(
-                        f"{prefix}: nexthops {sorted(map(str, got_nhs))} != "
-                        f"{sorted(map(str, nhs))}"
-                    )
-            for prefix in set(ours) - set(expected):
-                problems.append(f"extra route {prefix}")
-        # database plane: per-level LSP id set (zero-lifetime entries are
-        # still listed by the reference until LspDelete removes them)
-        db = (isis.get("database") or {}).get("levels")
-        if db:
-            for lvl in db:
-                target = next(
-                    (i for i in self.insts if i.level == lvl.get("level")),
-                    None,
-                )
-                if target is None:
-                    continue
-                exp_ids = {l["lsp-id"] for l in lvl.get("lsp", [])}
-                got_ids = {_lsp_id_str(lid) for lid in target.lsdb}
-                for missing in exp_ids - got_ids:
-                    problems.append(f"missing lsp L{lvl.get('level')} {missing}")
-                for extra in got_ids - exp_ids:
-                    problems.append(f"extra lsp L{lvl.get('level')} {extra}")
-        # interfaces plane: SRM/SSN lists + adjacency state
-        for ifstate in (isis.get("interfaces") or {}).get("interface", []):
-            ifname = ifstate.get("name")
-            for plane_name, attr in (
-                ("holo-isis-dev:srm", "srm"),
-                ("holo-isis-dev:ssn", "ssn"),
-            ):
-                plane = ifstate.get(plane_name)
-                if plane is None:
-                    continue
-                for lvl in plane.get("level", []):
-                    target = next(
-                        (
-                            i for i in self.insts
-                            if i.level == lvl.get("level")
-                        ),
-                        None,
-                    )
-                    if target is None:
-                        continue
-                    iface = target.interfaces.get(ifname)
-                    exp_ids = set(lvl.get("lsp-id", []))
-                    got_ids = (
-                        {_lsp_id_str(lid) for lid in getattr(iface, attr)}
-                        if iface is not None
-                        else set()
-                    )
-                    if exp_ids != got_ids:
-                        problems.append(
-                            f"{ifname} {attr}: {sorted(got_ids)} != "
-                            f"{sorted(exp_ids)}"
-                        )
-            adjs = (ifstate.get("adjacencies") or {}).get("adjacency")
-            if adjs is not None:
-                exp_adj = {
-                    a["neighbor-sysid"]: a.get("state", "up") for a in adjs
-                }
-                got_adj = {}
-                for target in self.insts:
-                    iface = target.interfaces.get(ifname)
-                    if iface is None:
-                        continue
-                    for a in iface.all_adjacencies():
-                        got_adj[_sysid_str(a.sysid)] = {
-                            AdjacencyState.UP: "up",
-                            AdjacencyState.INITIALIZING: "init",
-                            AdjacencyState.DOWN: "down",
-                        }[a.state]
-                if exp_adj != got_adj:
-                    problems.append(
-                        f"{ifname} adjacencies {got_adj} != {exp_adj}"
-                    )
-        return problems
-
+        ours = instance_state(
+            self.insts,
+            node=self.node if self.level_all else None,
+            ifnames=[n for n in self.if_order if n in self.if_conf],
+        )
+        return tree_diff(isis, ours, "isis")
 
 def run_case(case_dir: Path, topo: str, rt: str):
     run = CaseRun(ISIS_DIR / "topologies" / topo, rt)

@@ -18,7 +18,10 @@ LIST_KEYS = {
     # ietf-ospf
     "area": ("area-id",),
     "interface": ("name",),
-    "neighbor": ("neighbor-router-id", "address", "remote-address"),
+    "neighbor": (
+        "neighbor-router-id", "address", "remote-address",
+        "neighbor-id", "mt-id",
+    ),
     "route": ("prefix",),
     "area-scope-lsa-type": ("lsa-type",),
     "link-scope-lsa-type": ("lsa-type",),
@@ -26,7 +29,7 @@ LIST_KEYS = {
     "area-scope-lsa": ("lsa-id", "adv-router"),
     "link-scope-lsa": ("lsa-id", "adv-router"),
     "as-scope-lsa": ("lsa-id", "adv-router"),
-    "hostname": ("router-id",),
+    "hostname": ("router-id", "system-id"),
     "extended-prefix-tlv": ("prefix",),
     # ietf-mpls-ldp
     "address": ("address", "advertisement-type", "peer"),
@@ -34,6 +37,18 @@ LIST_KEYS = {
     "peer": ("lsr-id",),
     "hello-adjacency": ("adjacent-address",),
     "target": ("adjacent-address",),
+    # ietf-isis
+    "levels": ("level",),
+    "level": ("level",),
+    "holo-isis:level": ("level",),
+    "lsp": ("lsp-id",),
+    "adjacency": ("neighbor-sysid",),
+    "instance": ("id",),
+    "topology": ("mt-id",),
+    "prefixes": ("ip-prefix", "prefix-len", "mt-id"),
+    "node-msds": ("msd-type",),
+    "global-block": ("label-value",),
+    "local-block": ("label-value",),
 }
 
 
