@@ -69,20 +69,66 @@ class NorthboundService:
             out = {n: describe(c) for n, c in roots.items()}
         return pb.GetSchemaResponse(schema_json=json.dumps(out))
 
+    @staticmethod
+    def _encode_payload(obj, encoding, root_tag: str) -> str:
+        """YANG-XML / LYB-lite (base64) per the request's DataEncoding
+        (reference client grpc.rs:43-454).  ``obj`` must already be a
+        JSON-plain tree (scalars stringified, keyed maps expanded)."""
+        if not isinstance(obj, dict):
+            obj = {"value": obj}
+        if encoding == pb.XML:
+            from holo_tpu.yang.serde import to_xml
+
+            return to_xml(obj, root_tag)
+        import base64
+
+        from holo_tpu.yang.serde import to_lyb
+
+        return base64.b64encode(to_lyb(obj)).decode()
+
     def GetConfig(self, request, context):
         with self.daemon.lock:
             tree = self.daemon.northbound.running
-            if request.path:
-                val = tree.get(request.path)
-                payload = json.dumps(val, default=str)
+            if request.encoding == pb.JSON:
+                if request.path:
+                    payload = json.dumps(tree.get(request.path), default=str)
+                else:
+                    payload = tree.to_json()
             else:
-                payload = tree.to_json()
+                from holo_tpu.yang.serde import config_to_plain
+
+                schema = self.daemon.northbound.schema
+                if request.path:
+                    obj = tree.get(request.path)
+                    try:
+                        node = schema.resolve(request.path)
+                    except Exception:  # noqa: BLE001 — leaf paths etc.
+                        node = None
+                    obj = config_to_plain(node, obj)
+                else:
+                    obj = {
+                        name: config_to_plain(
+                            schema.roots.get(name), val
+                        )
+                        for name, val in tree.root.items()
+                    }
+                obj = json.loads(json.dumps(obj, default=str))
+                payload = self._encode_payload(
+                    obj, request.encoding, "config"
+                )
         return pb.GetConfigResponse(config_json=payload)
 
     def GetState(self, request, context):
         with self.daemon.lock:
             state = self.daemon.northbound.get_state(request.path or None)
-        return pb.GetStateResponse(state_json=json.dumps(state, default=str))
+        if request.encoding == pb.JSON:
+            payload = json.dumps(state, default=str)
+        else:
+            # State trees are already plain (dicts = containers, JSON
+            # lists = list entries) — no keyed maps to expand.
+            state = json.loads(json.dumps(state, default=str))
+            payload = self._encode_payload(state, request.encoding, "state")
+        return pb.GetStateResponse(state_json=payload)
 
     def Validate(self, request, context):
         try:

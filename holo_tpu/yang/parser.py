@@ -8,10 +8,13 @@ presence, typedef (one-level resolution), grouping/uses — and maps them
 onto the same :mod:`holo_tpu.yang.schema` nodes the built-in modules
 use, so a parsed module mounts and validates identically.
 
+Augment and deviation statements are APPLIED across the module set
+(load_modules grafts augments onto foreign trees to a fixpoint, then
+prunes/retypes per deviations — the libyang context-load behavior).
 Statements that do not affect config-tree shape (description, reference,
 namespace, prefix, import, revision, organization, contact, notification,
-rpc, augment, when, must, status, units, yang-version, ordered-by...) are
-parsed and skipped.
+rpc, when, must, status, units, yang-version, ordered-by...) are parsed
+and skipped.
 """
 
 from __future__ import annotations
@@ -298,4 +301,139 @@ def load_modules(texts: list[str]) -> dict[str, list]:
         b = _Builder(m, shared)
         for name, resolved in b.typedefs.items():
             shared["typedefs"].setdefault(name, resolved)
-    return {m.arg: build_module(m, shared) for m in modules}
+    trees = {m.arg: build_module(m, shared) for m in modules}
+    apply_augments(trees, modules, shared)
+    apply_deviations(trees, modules, shared)
+    return trees
+
+
+def _prefix_map(module: Stmt) -> dict[str, str]:
+    """prefix -> module-name for a module's own prefix + its imports."""
+    out: dict[str, str] = {}
+    own = module.sub("prefix")
+    if own is not None:
+        out[own.arg] = module.arg
+    for imp in module.all("import"):
+        p = imp.sub("prefix")
+        if p is not None:
+            out[p.arg] = imp.arg
+    return out
+
+
+def _resolve_target(trees: dict, prefixes: dict, path: str):
+    """Resolve an augment/deviation absolute schema path.
+
+    Returns (parent, name, node) where ``parent`` is the containing node
+    (or the target module's root list for top-level targets) — or None
+    when any component crosses a statement we don't model (choice/case,
+    notification bodies, ...)."""
+    comps = [c for c in path.strip("/").split("/") if c]
+    if not comps:
+        return None
+    first = comps[0]
+    if ":" not in first:
+        return None
+    pref, name = first.split(":", 1)
+    mod = prefixes.get(pref)
+    roots = trees.get(mod)
+    if roots is None:
+        return None
+    node = next((r for r in roots if getattr(r, "name", None) == name), None)
+    if node is None:
+        return None
+    parent: object = roots
+    for comp in comps[1:]:
+        cname = comp.split(":", 1)[1] if ":" in comp else comp
+        children = getattr(node, "children", None)
+        if children is None or cname not in children:
+            return None
+        parent, node = node, children[cname]
+    return parent, getattr(node, "name", None), node
+
+
+def apply_augments(
+    trees: dict[str, list], modules: list[Stmt], shared: dict
+) -> int:
+    """Graft each module's top-level augment statements onto the target
+    module's schema tree (libyang's ctx augment application).  Augments
+    may target nodes OTHER augments create (holo-ospf targets the ospf
+    container that ietf-ospf grafts into ietf-routing), so application
+    iterates to a fixpoint.  Returns the number of statements applied."""
+    ctx = {
+        id(m): (_prefix_map(m), _Builder(m, shared)) for m in modules
+    }
+    pending = [
+        (m, aug) for m in modules for aug in m.all("augment")
+    ]
+    applied = 0
+    while pending:
+        progressed = False
+        still = []
+        for m, aug in pending:
+            prefixes, builder = ctx[id(m)]
+            got = _resolve_target(trees, prefixes, aug.arg)
+            if got is None:
+                still.append((m, aug))
+                continue
+            _parent, _name, node = got
+            children = getattr(node, "children", None)
+            if children is None:
+                continue
+            cfg = getattr(node, "config", True)
+            new = builder._children(aug, cfg)
+            for child in new:
+                children[child.name] = child
+            applied += 1 if new else 0
+            progressed = True
+        if not progressed:
+            break
+        pending = still
+    return applied
+
+
+def apply_deviations(
+    trees: dict[str, list], modules: list[Stmt], shared: dict | None = None
+) -> int:
+    """Apply each module's deviation statements (the libyang analog):
+    ``deviate not-supported`` prunes the target node; ``deviate
+    replace { type ... }`` retypes a leaf; add/delete of defaults adjust
+    the leaf in place.  Returns the number applied."""
+    applied = 0
+    for m in modules:
+        prefixes = _prefix_map(m)
+        builder = _Builder(m, shared)
+        for dev in m.all("deviation"):
+            got = _resolve_target(trees, prefixes, dev.arg)
+            if got is None:
+                continue
+            parent, name, node = got
+            for deviate in dev.all("deviate"):
+                kind = deviate.arg
+                if kind == "not-supported":
+                    children = getattr(parent, "children", None)
+                    if children is not None:
+                        children.pop(name, None)
+                    elif isinstance(parent, list):
+                        parent[:] = [
+                            r
+                            for r in parent
+                            if getattr(r, "name", None) != name
+                        ]
+                    applied += 1
+                elif kind == "replace":
+                    t = deviate.sub("type")
+                    if t is not None and isinstance(node, Leaf):
+                        node.base, node.enum = builder._resolve_type(t)
+                        applied += 1
+                    d = deviate.sub("default")
+                    if d is not None and isinstance(node, Leaf):
+                        node.default = node.check(d.arg)
+                        applied += 1
+                elif kind in ("add", "delete"):
+                    d = deviate.sub("default")
+                    if d is not None and isinstance(node, Leaf):
+                        node.default = (
+                            node.check(d.arg) if kind == "add" else None
+                        )
+                        applied += 1
+    return applied

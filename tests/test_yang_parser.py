@@ -147,3 +147,44 @@ def test_parse_all_reference_modules():
     for node in mods["ietf-routing"]:
         sch.mount(node)
     assert "routing" in sch.roots
+
+
+def test_augments_and_deviations_apply_to_foreign_trees():
+    """The reference applies its augmentations/ and deviations/ modules
+    onto the ietf trees at context load (holo-yang/src/lib.rs) — our
+    load_modules must graft and prune the same way."""
+    from pathlib import Path
+
+    from holo_tpu.yang.parser import load_modules
+
+    base = Path("/root/reference/holo-yang/modules")
+    if not base.exists():
+        pytest.skip("reference modules not mounted")
+    files = sorted(base.rglob("*.yang"))
+    mods = load_modules([f.read_text() for f in files])
+
+    # holo-ietf-routing-deviations prunes /rt:routing/rt:router-id and
+    # the whole routing-state tree from ietf-routing.
+    routing = next(
+        n for n in mods["ietf-routing"] if n.name == "routing"
+    )
+    assert "router-id" not in routing.children
+    assert "interfaces" not in routing.children
+    assert not any(
+        n.name == "routing-state" for n in mods["ietf-routing"]
+    )
+    # ...but the ribs tree survives with active-route pruned.
+    ribs = routing.children["ribs"]
+    rib = ribs.children["rib"]
+    assert "active-route" not in rib.children
+
+    # ietf-ospf grafts its whole tree into ietf-routing's
+    # control-plane-protocol; holo-ospf then augments THAT grafted tree
+    # (fixpoint application), e.g. the hostnames operational list.
+    cpp = routing.children["control-plane-protocols"]
+    proto = cpp.children["control-plane-protocol"]
+    ospf = proto.children["ospf"]
+    assert "ietf-spf-delay" in ospf.children["spf-control"].children
+    assert "hostnames" in ospf.children, (
+        "holo-ospf's augment onto the grafted ospf tree did not apply"
+    )
