@@ -509,3 +509,44 @@ def test_grpc_get_xml_and_lyb_encodings():
         assert ET.fromstring(sxml).tag == "state"
     finally:
         server.stop(grace=0)
+
+
+def test_yang_modeled_state_served_through_daemon():
+    """VERDICT §5 observability: GetState serves the standard
+    module-qualified ietf-ospf / ietf-isis operational trees (the same
+    renderers the conformance harnesses diff), not just ad-hoc dicts."""
+    loop, fabric, d1, d2 = two_daemon_setup()
+    configure(d1, "1.1.1.1", "10.0.12.1/30")
+    configure(d2, "2.2.2.2", "10.0.12.2/30")
+    loop.advance(60)
+    state = d1.northbound.get_state(None)
+    ospf = state["routing"]["ietf-ospf:ospf"]
+    # Standard tree shape with live content.
+    area = ospf["areas"]["area"][0]
+    nbr = area["interfaces"]["interface"][0]["neighbors"]["neighbor"][0]
+    assert nbr["neighbor-router-id"] == "2.2.2.2"
+    assert nbr["state"] == "full"
+    assert ospf["local-rib"]["route"][0]["prefix"] == "10.0.12.0/30"
+    assert ospf["spf-control"]["ietf-spf-delay"]["current-state"]
+
+    # IS-IS likewise once configured.
+    import ipaddress
+
+    fabric.join("li", "d1.isis", "eth0",
+                ipaddress.ip_address("10.0.12.1"))
+    fabric.join("li", "d2.isis", "eth0",
+                ipaddress.ip_address("10.0.12.2"))
+    for d, sid in ((d1, "0000.0000.0001"), (d2, "0000.0000.0002")):
+        cand = d.candidate()
+        cand.set("routing/control-plane-protocols/isis/system-id", sid)
+        cand.set(
+            "routing/control-plane-protocols/isis/interface[eth0]/metric", 7
+        )
+        d.commit(cand)
+    loop.advance(60)
+    isis = d1.northbound.get_state(None)["routing"]["ietf-isis:isis"]
+    levels = isis["database"]["levels"]
+    assert levels and levels[0]["holo-isis:lsp-count"] >= 2
+    adj = isis["interfaces"]["interface"][0]["adjacencies"]["adjacency"][0]
+    assert adj["neighbor-sysid"] == "0000.0000.0002"
+    assert adj["state"] == "up"
