@@ -170,6 +170,13 @@ class Daemon:
         # (not yet registered) remote loop and is reported undeliverable,
         # never silently swallowed by the primary loop.
         self.loop_router.register_remote(inst.name, tl)
+        # Per-interface Tx tasks (reference tasks.rs:288-348): packet
+        # production decouples from the wire send; a slow interface
+        # backpressures its own producer only.
+        if getattr(inst, "netio", None) is not None:
+            from holo_tpu.utils.txqueue import TxTaskNetIo
+
+            inst.netio = TxTaskNetIo(inst.netio)
         tl.register(inst)
         # Provider-installed callbacks run as primary-loop messages.
         runner = f"{self._p}call-runner"
@@ -196,8 +203,12 @@ class Daemon:
         self.loop_router.unregister_remote(name)
         tl = self.instance_loops.pop(name, None)
         if tl is not None:
+            inst = tl.loop.actors.get(name)
             tl.stop()
             tl.loop.unregister(name)
+            netio = getattr(inst, "netio", None)
+            if netio is not None and hasattr(netio, "close"):
+                netio.close()  # drain + join the per-interface Tx tasks
 
     # -- config entry points
 
@@ -256,7 +267,11 @@ class Daemon:
         for name, tl in list(self.instance_loops.items()):
             if self.loop_router is not None:
                 self.loop_router.unregister_remote(name)
+            inst = tl.loop.actors.get(name)
             tl.stop()
+            netio = getattr(inst, "netio", None)
+            if netio is not None and hasattr(netio, "close"):
+                netio.close()  # drain + join the per-interface Tx tasks
         self.instance_loops.clear()
 
 

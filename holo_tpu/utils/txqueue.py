@@ -1,0 +1,111 @@
+"""Per-interface transmit tasks with bounded backpressure.
+
+The reference gives every interface a dedicated Tx task fed over a
+bounded channel (holo-ospf/src/tasks.rs:288-348): packet production is
+decoupled from the kernel send, a slow interface exerts backpressure on
+its own producers only, and per-interface ordering is preserved.
+
+:class:`TxTaskNetIo` is the NetIo-wrapping analog: one daemon thread +
+bounded queue per interface, created lazily on first send.  A full
+queue blocks the producer (the reference's bounded mpsc semantics) —
+never drops — and `close()` drains each queue before joining so no
+accepted packet is lost.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from holo_tpu.utils.netio import NetIo
+
+_STOP = object()
+
+
+class _IfaceTxTask:
+    def __init__(self, ifname: str, inner: NetIo, maxsize: int):
+        self.ifname = ifname
+        self.inner = inner
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.sent = 0
+        self.thread = threading.Thread(
+            target=self._pump, name=f"tx-{ifname}", daemon=True
+        )
+        self.thread.start()
+
+    def _pump(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is _STOP:
+                return
+            src, dst, data = item
+            try:
+                self.inner.send(self.ifname, src, dst, data)
+                self.sent += 1
+            except Exception:  # noqa: BLE001 — a bad send must not kill tx
+                pass
+
+    def request_stop(self) -> None:
+        try:
+            # Bounded put with a timeout: a wedged wire (consumer stuck
+            # in a kernel send) must not hang daemon teardown forever.
+            self.q.put(_STOP, timeout=5)
+        except queue.Full:
+            pass
+
+    def join(self) -> None:
+        self.thread.join(timeout=5)
+
+    def stop(self) -> None:
+        self.request_stop()
+        self.join()
+
+
+class TxTaskNetIo(NetIo):
+    """NetIo decorator: routes each interface's sends through its own
+    bounded Tx task (reference tasks.rs per-interface Tx channels)."""
+
+    def __init__(self, inner: NetIo, maxsize: int = 256):
+        self.inner = inner
+        self.maxsize = maxsize
+        self._tasks: dict[str, _IfaceTxTask] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _task(self, ifname: str) -> "_IfaceTxTask | None":
+        t = self._tasks.get(ifname)
+        if t is None:
+            with self._lock:
+                if self._closed:
+                    return None
+                t = self._tasks.get(ifname)
+                if t is None:
+                    t = _IfaceTxTask(ifname, self.inner, self.maxsize)
+                    self._tasks[ifname] = t
+        return t
+
+    def send(self, ifname, src, dst, data) -> None:
+        # Bounded put: a slow interface applies backpressure to ITS
+        # producer only (block, never drop) — other interfaces' tasks
+        # keep draining independently.  A late send after close() (an
+        # instance handler that outlived its 5s teardown join) is
+        # dropped: resurrecting a task here would leak its thread.
+        t = self._task(ifname)
+        if t is not None:
+            t.q.put((src, dst, data))
+
+    def queue_depth(self, ifname: str) -> int:
+        t = self._tasks.get(ifname)
+        return t.q.qsize() if t is not None else 0
+
+    def close(self) -> None:
+        # Two-phase: request every stop FIRST, then join — teardown cost
+        # is the slowest single task, not the sum over interfaces.
+        with self._lock:
+            self._closed = True
+            tasks = list(self._tasks.values())
+            self._tasks.clear()
+        for t in tasks:
+            t.request_stop()
+        for t in tasks:
+            t.join()
