@@ -17,6 +17,10 @@ class LoggingConfig:
     level: str = "info"
     style: str = "compact"  # compact | full | json
     file: str | None = None
+    # Per-subsystem level overrides (the reference's per-target tracing
+    # directives, main.rs:59-146): {"ospf": "debug", "bgp.fsm": "trace"}.
+    # Keys address holo_tpu logger names below the package root.
+    subsystems: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -78,6 +82,9 @@ class DaemonConfig:
             for k in ("level", "style", "file"):
                 if k in raw["logging"]:
                     setattr(cfg.logging, k, raw["logging"][k])
+            subs = raw["logging"].get("subsystems")
+            if isinstance(subs, dict):
+                cfg.logging.subsystems = dict(subs)
         if "grpc" in raw:
             g = raw["grpc"]
             cfg.grpc.enabled = g.get("enabled", True)

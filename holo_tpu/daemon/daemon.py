@@ -275,6 +275,64 @@ class Daemon:
         self.instance_loops.clear()
 
 
+def setup_logging(cfg) -> None:
+    """Apply [logging]: root level, output style (compact / full / json),
+    optional file sink, and per-subsystem level overrides — the
+    reference's tracing-subscriber configuration (main.rs:59-146)."""
+    lvl = getattr(logging, cfg.logging.level.upper(), logging.INFO)
+    if cfg.logging.style == "json":
+        import json as _json
+
+        class _JsonFormatter(logging.Formatter):
+            def format(self, record):
+                out = {
+                    "ts": self.formatTime(record),
+                    "level": record.levelname.lower(),
+                    "target": record.name,
+                    "message": record.getMessage(),
+                }
+                if record.exc_info:
+                    out["exception"] = self.formatException(record.exc_info)
+                if record.stack_info:
+                    out["stack"] = record.stack_info
+                return _json.dumps(out)
+
+        fmt: logging.Formatter = _JsonFormatter()
+    elif cfg.logging.style == "full":
+        fmt = logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(name)s "
+            "[%(filename)s:%(lineno)d] %(message)s"
+        )
+    else:  # compact
+        fmt = logging.Formatter("%(asctime)s %(levelname).1s %(name)s %(message)s")
+    handler: logging.Handler = (
+        logging.FileHandler(cfg.logging.file)
+        if cfg.logging.file
+        else logging.StreamHandler()
+    )
+    handler.setFormatter(fmt)
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(lvl)
+    # Per-subsystem overrides: "ospf" -> holo_tpu.ospf / providers etc.
+    # "trace" maps to DEBUG (Python logging's most verbose level); an
+    # unknown level name is a config error worth a visible warning, not
+    # a silent INFO fallback.
+    for name, level in cfg.logging.subsystems.items():
+        target = name if name.startswith("holo_tpu") else f"holo_tpu.{name}"
+        lname = str(level).upper()
+        resolved = {"TRACE": logging.DEBUG}.get(
+            lname, getattr(logging, lname, None)
+        )
+        if not isinstance(resolved, int):
+            logging.getLogger(__name__).warning(
+                "unknown log level %r for subsystem %s; using DEBUG",
+                level, name,
+            )
+            resolved = logging.DEBUG
+        logging.getLogger(target).setLevel(resolved)
+
+
 def main(argv=None):
     import argparse
 
@@ -282,7 +340,7 @@ def main(argv=None):
     ap.add_argument("-f", "--config", default=None, help="TOML static config")
     args = ap.parse_args(argv)
     cfg = DaemonConfig.load(args.config)
-    logging.basicConfig(level=getattr(logging, cfg.logging.level.upper(), logging.INFO))
+    setup_logging(cfg)
     from holo_tpu.daemon import hardening
 
     lock_fd = None

@@ -550,3 +550,49 @@ def test_yang_modeled_state_served_through_daemon():
     adj = isis["interfaces"]["interface"][0]["adjacencies"]["adjacency"][0]
     assert adj["neighbor-sysid"] == "0000.0000.0002"
     assert adj["state"] == "up"
+
+
+def test_logging_config_styles_and_subsystems(tmp_path):
+    """[logging]: styles, file sink, per-subsystem level overrides
+    (reference main.rs:59-146 tracing configuration)."""
+    import logging as pylog
+
+    from holo_tpu.daemon.config import DaemonConfig
+    from holo_tpu.daemon.daemon import setup_logging
+
+    toml = tmp_path / "holod.toml"
+    logfile = tmp_path / "holo.log"
+    toml.write_text(
+        f"""
+[logging]
+level = "warning"
+style = "json"
+file = "{logfile}"
+
+[logging.subsystems]
+ospf = "debug"
+providers = "error"
+"""
+    )
+    cfg = DaemonConfig.load(toml)
+    assert cfg.logging.subsystems == {"ospf": "debug", "providers": "error"}
+    old_handlers = pylog.getLogger().handlers[:]
+    old_level = pylog.getLogger().level
+    try:
+        setup_logging(cfg)
+        assert pylog.getLogger().level == pylog.WARNING
+        assert pylog.getLogger("holo_tpu.ospf").level == pylog.DEBUG
+        assert pylog.getLogger("holo_tpu.providers").level == pylog.ERROR
+        pylog.getLogger("holo_tpu.ospf").debug("subsystem-trace-line")
+        for h in pylog.getLogger().handlers:
+            h.flush()
+        line = logfile.read_text().strip().splitlines()[-1]
+        rec = json.loads(line)  # json style emits one object per line
+        assert rec["level"] == "debug"
+        assert rec["target"] == "holo_tpu.ospf"
+        assert rec["message"] == "subsystem-trace-line"
+    finally:
+        pylog.getLogger().handlers[:] = old_handlers
+        pylog.getLogger().setLevel(old_level)
+        pylog.getLogger("holo_tpu.ospf").setLevel(pylog.NOTSET)
+        pylog.getLogger("holo_tpu.providers").setLevel(pylog.NOTSET)
