@@ -275,11 +275,28 @@ class Daemon:
         self.instance_loops.clear()
 
 
+def _resolve_level(level, fallback: int, what: str) -> int:
+    """Level-name → logging constant.  "trace" maps to DEBUG (Python
+    logging's most verbose level); an unknown name is a config error
+    worth a visible warning, not a silent fallback.  One resolver for
+    the root level and the per-subsystem overrides so the two accept
+    the same vocabulary."""
+    lname = str(level).upper()
+    resolved = {"TRACE": logging.DEBUG}.get(lname, getattr(logging, lname, None))
+    if not isinstance(resolved, int):
+        logging.getLogger(__name__).warning(
+            "unknown log level %r for %s; using %s",
+            level, what, logging.getLevelName(fallback),
+        )
+        resolved = fallback
+    return resolved
+
+
 def setup_logging(cfg) -> None:
     """Apply [logging]: root level, output style (compact / full / json),
     optional file sink, and per-subsystem level overrides — the
     reference's tracing-subscriber configuration (main.rs:59-146)."""
-    lvl = getattr(logging, cfg.logging.level.upper(), logging.INFO)
+    lvl = _resolve_level(cfg.logging.level, logging.INFO, "root logger")
     if cfg.logging.style == "json":
         import json as _json
 
@@ -312,25 +329,17 @@ def setup_logging(cfg) -> None:
     )
     handler.setFormatter(fmt)
     root = logging.getLogger()
+    for old in root.handlers:
+        if isinstance(old, logging.FileHandler):
+            old.close()  # re-config must not leak the previous sink's fd
     root.handlers[:] = [handler]
     root.setLevel(lvl)
     # Per-subsystem overrides: "ospf" -> holo_tpu.ospf / providers etc.
-    # "trace" maps to DEBUG (Python logging's most verbose level); an
-    # unknown level name is a config error worth a visible warning, not
-    # a silent INFO fallback.
     for name, level in cfg.logging.subsystems.items():
         target = name if name.startswith("holo_tpu") else f"holo_tpu.{name}"
-        lname = str(level).upper()
-        resolved = {"TRACE": logging.DEBUG}.get(
-            lname, getattr(logging, lname, None)
+        logging.getLogger(target).setLevel(
+            _resolve_level(level, logging.DEBUG, f"subsystem {name}")
         )
-        if not isinstance(resolved, int):
-            logging.getLogger(__name__).warning(
-                "unknown log level %r for subsystem %s; using DEBUG",
-                level, name,
-            )
-            resolved = logging.DEBUG
-        logging.getLogger(target).setLevel(resolved)
 
 
 def main(argv=None):

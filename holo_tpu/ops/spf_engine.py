@@ -126,6 +126,46 @@ def _sp_dag(g: DeviceGraph, dist: jax.Array, ok: jax.Array, root: jax.Array):
     return dag & (jnp.arange(g.in_src.shape[0]) != root)[:, None]
 
 
+def _first_parent(g: DeviceGraph, dag: jax.Array, d_nbr: jax.Array) -> jax.Array:
+    """int32[N]: DAG parent minimizing (dist[u], u) — the reference's
+    candidate-BTreeMap pop order (holo-ospf/src/spf.rs:614-622) — or N
+    (sentinel) when the vertex has no DAG parent.  Two-stage lex argmin;
+    every engine MUST use this same tie-break for bit-parity."""
+    n = g.in_src.shape[0]
+    dmin = jnp.where(dag, d_nbr, INF).min(axis=1)  # int32[N]
+    src_cand = jnp.where(dag & (d_nbr == dmin[:, None]), g.in_src, n)
+    return src_cand.min(axis=1).astype(jnp.int32)
+
+
+def _nh_words_round(dag, h_nbr, direct_i32, nbr_word):
+    """One Jacobi next-hop recompute: per word, OR the direct atoms of
+    hops==0 DAG parents with the inherited sets of the rest
+    (holo-ospf/src/spf.rs:733-767 direct-vs-inherit split).
+
+    ``nbr_word(wi) -> int32[N, K]``: gathered neighbor values of word wi.
+    Shared by the fused and hybrid engines so the split rule cannot drift.
+    """
+    w = direct_i32.shape[2]
+    direct_slot = dag & (h_nbr == 0)
+    inherit_slot = dag & (h_nbr != 0)
+    words = []
+    for wi in range(w):
+        seed_w = jax.lax.reduce(
+            jnp.where(direct_slot, direct_i32[:, :, wi], 0),
+            jnp.int32(0),
+            jax.lax.bitwise_or,
+            dimensions=(1,),
+        )
+        inh_w = jax.lax.reduce(
+            jnp.where(inherit_slot, nbr_word(wi), 0),
+            jnp.int32(0),
+            jax.lax.bitwise_or,
+            dimensions=(1,),
+        )
+        words.append(seed_w | inh_w)
+    return jnp.stack(words, axis=1)
+
+
 def spf_one(
     g: DeviceGraph,
     root: jax.Array,
@@ -138,11 +178,7 @@ def spf_one(
     dist = sssp_distances(g, root, edge_mask, max_iters)
     dag = _sp_dag(g, dist, ok, root)
     d_nbr = dist[g.in_src]
-
-    # First parent = DAG parent minimizing (dist[u], u): two-stage lex argmin.
-    dmin = jnp.where(dag, d_nbr, INF).min(axis=1)  # int32[N]
-    src_cand = jnp.where(dag & (d_nbr == dmin[:, None]), g.in_src, n)
-    parent = src_cand.min(axis=1).astype(jnp.int32)  # n = no parent
+    parent = _first_parent(g, dag, d_nbr)  # n = no parent
 
     limit = n if max_iters is None else max_iters
 
@@ -297,10 +333,7 @@ def spf_one_fused(
             d_nbr + g.in_cost == dist_new[:, None]
         )
         dag = dag & not_root[:, None]
-
-        dmin = jnp.where(dag, d_nbr, INF).min(axis=1)
-        src_cand = jnp.where(dag & (d_nbr == dmin[:, None]), g.in_src, n)
-        parent = src_cand.min(axis=1).astype(jnp.int32)
+        parent = _first_parent(g, dag, d_nbr)
 
         # hops[parent] without a batch-dependent gather: every slot whose
         # src == parent carries the same gathered hops value.
@@ -312,25 +345,7 @@ def spf_one_fused(
             jnp.where((parent < n) & (ph < big), ph + inc, big),
         ).astype(jnp.int32)
 
-        use_direct = h_nbr == 0
-        direct_slot = dag & use_direct
-        inherit_slot = dag & ~use_direct
-        words = []
-        for wi in range(w):
-            seed_w = jax.lax.reduce(
-                jnp.where(direct_slot, direct_i32[:, :, wi], 0),
-                jnp.int32(0),
-                jax.lax.bitwise_or,
-                dimensions=(1,),
-            )
-            inh_w = jax.lax.reduce(
-                jnp.where(inherit_slot, nh_nbr[wi], 0),
-                jnp.int32(0),
-                jax.lax.bitwise_or,
-                dimensions=(1,),
-            )
-            words.append(seed_w | inh_w)
-        nh_new = jnp.stack(words, axis=1)
+        nh_new = _nh_words_round(dag, h_nbr, direct_i32, lambda wi: nh_nbr[wi])
         return dist_new, hops_new, nh_new, parent
 
     def cond(carry):
@@ -359,12 +374,95 @@ def spf_one_fused(
     )
 
 
+def spf_one_hybrid(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """Full SPF in TWO fixpoint loops: dist alone, then hops+nh packed.
+
+    Rationale (see the engine notes in :func:`spf_one_fused`): the
+    sequential engine runs 2+W loops of one [N,K]-shaped gather each;
+    the fused engines recompute the DAG/parent/tie-break work every
+    round *while dist is still settling*.  This formulation takes the
+    best half of each:
+
+    - Phase 1 is the lean dist-only relaxation (:func:`sssp_distances`)
+      — one gather + add + row-min per round, nothing else.
+    - The shortest-path DAG, first parent, parent-slot mask and direct
+      next-hop seeds are then computed ONCE — they depend only on the
+      settled dist.
+    - Phase 2 chases hops and the W next-hop words together,
+      Jacobi-style, through a SINGLE packed int32[N, 1+W] row gather
+      per round: (1+W)x fewer gather-index operations than the
+      sequential loops over the same total bytes, with none of the
+      fused engines' per-round DAG recomputation.
+
+    Results are exact and bit-identical to :func:`spf_one` (parity-gated
+    in tests/test_spf_parity.py).  Reference semantics:
+    holo-ospf/src/spf.rs:587-767.
+    """
+    n, k = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    ok = _slot_mask(g, edge_mask)
+    dist = sssp_distances(g, root, edge_mask, max_iters)
+    dag = _sp_dag(g, dist, ok, root)
+    d_nbr = dist[g.in_src]
+    # First parent is fixed from here on (the DAG depends only on dist).
+    parent = _first_parent(g, dag, d_nbr)
+
+    big = jnp.int32(n + 1)
+    vidx = jnp.arange(n)
+    is_root = vidx == root
+    inc = g.is_router.astype(jnp.int32)
+    parent_slot = g.in_src == parent[:, None]
+    has_parent = parent < n
+    direct_i32 = jax.lax.bitcast_convert_type(g.direct_nh_words, jnp.int32)
+    limit = n if max_iters is None else max_iters
+
+    hops0 = jnp.where(is_root, 0, big).astype(jnp.int32)
+    nh0 = jnp.zeros((n, w), jnp.int32)
+
+    def cond(carry):
+        _, _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        hops, nh, _, it = carry
+        state = jnp.concatenate([hops[:, None], nh], axis=1)  # int32[N, 1+W]
+        nbr = state[g.in_src]  # [N, K, 1+W] — the ONE gather per round
+        h_nbr = nbr[:, :, 0]
+
+        ph = jnp.where(parent_slot, h_nbr, big).min(axis=1)
+        hops_new = jnp.where(
+            is_root, 0, jnp.where(has_parent & (ph < big), ph + inc, big)
+        ).astype(jnp.int32)
+
+        nh_new = _nh_words_round(
+            dag, h_nbr, direct_i32, lambda wi: nbr[:, :, 1 + wi]
+        )
+
+        changed = jnp.any(hops_new != hops) | jnp.any(nh_new != nh)
+        return hops_new, nh_new, changed, it + 1
+
+    hops, nh, _, _ = jax.lax.while_loop(
+        cond, body, (hops0, nh0, jnp.bool_(True), 0)
+    )
+    return SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+
+
 def spf_whatif_batch(
     g: DeviceGraph,
     root: jax.Array,
     edge_masks: jax.Array,
     max_iters: int | None = None,
-    engine: str = "fused",
+    engine: str = "seq",
 ) -> SpfTensors:
     """Batched what-if SPF: vmap over scenario edge masks (bool[B, E]).
 
@@ -372,9 +470,10 @@ def spf_whatif_batch(
     link-failure studies over one LSDB (BASELINE.md config 5).  Remember to
     mask *both* directions of a failed link.
 
-    ``engine``: 'fused' (default — one fixpoint loop, separate gathers),
-    'packed' (one fixpoint loop, ONE row gather per round), or 'seq'
-    (the staged-loop formulation).
+    ``engine``: 'seq' (default — the staged-loop formulation, fastest
+    measured so far; see ADVICE round 3), 'fused' (one fixpoint loop,
+    separate gathers), 'packed' (one fixpoint loop, ONE row gather per
+    round), or 'hybrid' (dist loop, then one packed hops+next-hop loop).
     """
     one = _ONE_ENGINES[engine]
     fn = jax.vmap(lambda m: one(g, root, m, max_iters))
@@ -385,6 +484,7 @@ _ONE_ENGINES = {
     "seq": spf_one,
     "fused": spf_one_fused,
     "packed": lambda g, r, m, mi: spf_one_fused(g, r, m, mi, packed=True),
+    "hybrid": spf_one_hybrid,
 }
 
 

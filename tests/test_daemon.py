@@ -591,7 +591,15 @@ providers = "error"
         assert rec["level"] == "debug"
         assert rec["target"] == "holo_tpu.ospf"
         assert rec["message"] == "subsystem-trace-line"
+        # Root level accepts the same vocabulary as the subsystems:
+        # "trace" is the reference's most-verbose name, not a typo.
+        cfg.logging.level = "trace"
+        setup_logging(cfg)
+        assert pylog.getLogger().level == pylog.DEBUG
     finally:
+        for h in pylog.getLogger().handlers:
+            if h not in old_handlers:
+                h.close()
         pylog.getLogger().handlers[:] = old_handlers
         pylog.getLogger().setLevel(old_level)
         pylog.getLogger("holo_tpu.ospf").setLevel(pylog.NOTSET)

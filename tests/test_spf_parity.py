@@ -13,10 +13,11 @@ from holo_tpu.spf.synth import random_ospf_topology, whatif_link_failure_masks
 
 N_ATOMS = 64
 
-# Every gather-path fixpoint formulation must be bit-identical; 'fused'
-# is the production default, 'packed' the single-gather TPU variant,
-# 'seq' the original staged-loop form.
-ENGINES = ["fused", "packed", "seq"]
+# Every gather-path fixpoint formulation must be bit-identical: 'seq'
+# the staged-loop form (production default, both here and in
+# spf_whatif_batch), 'fused'/'packed' the one-loop variants, 'hybrid'
+# the dist-loop + packed hops/next-hop loop.
+ENGINES = ["fused", "packed", "seq", "hybrid"]
 
 
 def assert_parity(topo, scalar_res, tpu_res):

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Watch the axon TPU relay; the moment it answers, run the full bench.
+# Writes status lines to RELAY_WATCH.log and, on success, BENCH_live.json.
+# Probe must run with cwd=/root/repo (axon plugin requirement).
+cd /root/repo || exit 1
+N=0
+while true; do
+  N=$((N+1))
+  ts=$(date +%H:%M:%S)
+  if timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert any("cpu" not in str(x).lower() for x in d), d
+x = jnp.ones((128, 128))
+y = (x @ x)
+assert float(y[0, 0]) == 128.0
+EOF
+  then
+    echo "$ts probe $N: ALIVE" >> RELAY_WATCH.log
+    # Don't contaminate the C++ baseline with a concurrently running suite.
+    while pgrep -f "pytest" >/dev/null 2>&1; do sleep 20; done
+    echo "$(date +%H:%M:%S) benching..." >> RELAY_WATCH.log
+    python bench.py > BENCH_live.json 2> RELAY_BENCH.err
+    echo "$(date +%H:%M:%S) bench rc=$? (see BENCH_live.json)" >> RELAY_WATCH.log
+    exit 0
+  else
+    echo "$ts probe $N: down" >> RELAY_WATCH.log
+  fi
+  sleep 300
+done
