@@ -12,9 +12,10 @@ Package layout:
   verified against all 70 recorded holo-ldp conformance cases + both
   topology snapshots (tools/stepwise_ldp.py);
 - this module — the daemon-facing transport slice (fabric/netns
-  hellos + sessions, LIB feed to the RIB manager).  Its simplified
-  internal codec predates :mod:`.packet` and is being migrated onto the
-  engine; new protocol behavior belongs in :mod:`.engine`.
+  hellos + sessions, LIB feed to the RIB manager).  Its
+  :class:`LdpMsg` is a convenience view over one single-message PDU;
+  all wire encoding/decoding goes through :mod:`.packet` (one codec
+  for the protocol).  New protocol behavior belongs in :mod:`.engine`.
 
 Transport on the fabric: hellos are multicast frames, session messages
 unicast frames (the daemon binds real UDP 646 + TCP 646).
@@ -26,7 +27,8 @@ import enum
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address, IPv4Network
 
-from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
+from holo_tpu.protocols.ldp import packet as wire
+from holo_tpu.utils.bytesbuf import DecodeError
 from holo_tpu.utils.mpls import IMPLICIT_NULL, LabelManager
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
@@ -37,8 +39,6 @@ class _McastAll(str):
 
 
 ALL_ROUTERS_LDP = _McastAll("224.0.0.2:646")
-
-LDP_VERSION = 1
 
 
 class LdpMsgType(enum.IntEnum):
@@ -61,82 +61,52 @@ class LdpMsg:
     label: int | None = None
 
     def encode(self) -> bytes:
-        w = Writer()
-        w.u16(LDP_VERSION)
-        len_pos = len(w)
-        w.u16(0)
-        w.ipv4(self.lsr_id).u16(0)  # LDP identifier (label space 0)
-        body_start = len(w)
-        w.u16(int(self.type))
-        mlen_pos = len(w)
-        w.u16(0)
-        w.u32(0)  # message id (filled by sender when needed)
-        mstart = len(w)
+        """One single-message PDU through the :mod:`.packet` codec."""
+        msg: wire.Message
         if self.type == LdpMsgType.HELLO:
-            # Common hello params TLV 0x0400
-            w.u16(0x0400).u16(4).u16(self.hold_time).u16(0)
+            msg = wire.HelloMsg(holdtime=self.hold_time)
         elif self.type == LdpMsgType.INIT:
-            # Common session params TLV 0x0500
-            w.u16(0x0500).u16(14)
-            w.u16(LDP_VERSION).u16(self.keepalive_time).u8(0).u8(0)
-            w.u16(0)  # max pdu
-            w.ipv4(self.lsr_id).u16(0)
-        elif self.type in (
-            LdpMsgType.LABEL_MAPPING,
-            LdpMsgType.LABEL_WITHDRAW,
-            LdpMsgType.LABEL_RELEASE,
-        ):
-            # FEC TLV 0x0100 (prefix element type 2)
-            plen = self.fec.prefixlen
-            nbytes = (plen + 7) // 8
-            w.u16(0x0100).u16(4 + nbytes)
-            w.u8(2).u8(1).u8(0).u8(plen)  # element 2, AF=1 (IPv4)
-            w.bytes(self.fec.network_address.packed[:nbytes])
-            if self.type != LdpMsgType.LABEL_RELEASE or self.label is not None:
-                # Generic label TLV 0x0200
-                w.u16(0x0200).u16(4).u32(self.label if self.label is not None else 0)
-        w.patch_u16(mlen_pos, len(w) - mstart + 4)
-        w.patch_u16(len_pos, len(w) - body_start + 6)
-        return w.finish()
+            msg = wire.InitMsg(
+                keepalive_time=self.keepalive_time, lsr_id=self.lsr_id
+            )
+        elif self.type == LdpMsgType.KEEPALIVE:
+            msg = wire.KeepaliveMsg()
+        else:
+            label = self.label
+            if label is None and self.type != LdpMsgType.LABEL_RELEASE:
+                label = 0  # mapping/withdraw always carry a label TLV
+            msg = wire.LabelMsg(
+                msg_type=wire.MsgType(int(self.type)),
+                fec=[wire.FecPrefix(self.fec)],
+                label=label,
+            )
+        return wire.Pdu(self.lsr_id, messages=[msg]).encode()
 
     @classmethod
     def decode(cls, data: bytes) -> "LdpMsg":
-        r = Reader(data)
-        if r.u16() != LDP_VERSION:
-            raise DecodeError("bad LDP version")
-        pdu_len = r.u16()
-        lsr_id = r.ipv4()
-        r.u16()  # label space
+        """First message of a PDU, folded back into the flat view."""
         try:
-            mtype = LdpMsgType(r.u16())
+            pdu = wire.Pdu.decode(data)
+        except wire.DecodeError as e:
+            raise DecodeError(f"LDP: {e}") from e
+        if not pdu.messages:
+            raise DecodeError("LDP: empty PDU")
+        msg = pdu.messages[0]
+        try:
+            mtype = LdpMsgType(int(msg.msg_type))
         except ValueError as e:
             raise DecodeError("unknown LDP message") from e
-        r.u16()  # msg length
-        r.u32()  # msg id
-        out = cls(mtype, lsr_id)
-        while r.remaining() >= 4:
-            tlv = r.u16()
-            tlen = r.u16()
-            body = r.sub(min(tlen, r.remaining()))
-            if tlv == 0x0400:
-                out.hold_time = body.u16()
-            elif tlv == 0x0500:
-                body.u16()
-                out.keepalive_time = body.u16()
-            elif tlv == 0x0100:
-                el = body.u8()
-                af = body.u8()
-                body.u8()
-                plen = body.u8()
-                if el != 2 or plen > 32:
-                    raise DecodeError("bad FEC element")
-                nbytes = (plen + 7) // 8
-                raw = body.bytes(nbytes) + bytes(4 - nbytes)
-                out.fec = IPv4Network(
-                    (int.from_bytes(raw, "big"), plen), strict=False
-                )
-            elif tlv == 0x0200:
-                out.label = body.u32()
+        out = cls(mtype, pdu.lsr_id)
+        if isinstance(msg, wire.HelloMsg):
+            out.hold_time = msg.holdtime
+        elif isinstance(msg, wire.InitMsg):
+            out.keepalive_time = msg.keepalive_time
+        elif isinstance(msg, wire.LabelMsg):
+            for elem in msg.fec:
+                if isinstance(elem, wire.FecPrefix) and elem.prefix.version == 4:
+                    out.fec = elem.prefix
+                    break
+            out.label = msg.label
         return out
 
 

@@ -21,7 +21,8 @@ EOF
     while pgrep -f "pytest" >/dev/null 2>&1; do sleep 20; done
     echo "$(date +%H:%M:%S) benching..." >> RELAY_WATCH.log
     python bench.py > BENCH_live.json 2> RELAY_BENCH.err
-    echo "$(date +%H:%M:%S) bench rc=$? (see BENCH_live.json)" >> RELAY_WATCH.log
+    rc=$?
+    echo "$(date +%H:%M:%S) bench rc=$rc (see BENCH_live.json)" >> RELAY_WATCH.log
     exit 0
   else
     echo "$ts probe $N: down" >> RELAY_WATCH.log
