@@ -37,6 +37,7 @@ class MsgType(enum.IntEnum):
     UPDATE = 2
     NOTIFICATION = 3
     KEEPALIVE = 4
+    ROUTE_REFRESH = 5  # RFC 2918
 
 
 class Origin(enum.IntEnum):
@@ -51,11 +52,24 @@ class AttrType(enum.IntEnum):
     NEXT_HOP = 3
     MED = 4
     LOCAL_PREF = 5
+    ATOMIC_AGGREGATE = 6
+    AGGREGATOR = 7
+    COMMUNITIES = 8  # RFC 1997
+    ORIGINATOR_ID = 9  # RFC 4456
+    CLUSTER_LIST = 10  # RFC 4456
     MP_REACH_NLRI = 14  # RFC 4760
     MP_UNREACH_NLRI = 15
+    EXT_COMMUNITIES = 16  # RFC 4360
+    EXTV6_COMMUNITIES = 25  # RFC 5701
+    LARGE_COMMUNITIES = 32  # RFC 8092
 
 
 AFI_IPV4, AFI_IPV6, SAFI_UNICAST = 1, 2, 1
+
+# Well-known communities (RFC 1997; holo-utils/src/bgp.rs:74-78).
+NO_EXPORT = 0xFFFFFF01
+NO_ADVERTISE = 0xFFFFFF02
+NO_EXPORT_SUBCONFED = 0xFFFFFF03
 
 
 @dataclass
@@ -70,6 +84,17 @@ class PathAttrs:
     # AddressFamily trait's nexthop handling); it lives here so one attrs
     # object describes a route of either family.
     nh6: IPv6Address | None = None
+    # Community families + aggregation + route-reflection, mirroring the
+    # reference's Attrs/BaseAttrs split members
+    # (holo-bgp/src/packet/attribute.rs:37-62).
+    communities: tuple = ()  # of u32 (RFC 1997)
+    ext_communities: tuple = ()  # of 8-byte values (RFC 4360)
+    extv6_communities: tuple = ()  # of 20-byte values (RFC 5701)
+    large_communities: tuple = ()  # of (global, local1, local2) (RFC 8092)
+    aggregator: tuple | None = None  # (asn, IPv4Address) (RFC 4271 §5.1.7)
+    atomic_aggregate: bool = False
+    originator_id: IPv4Address | None = None  # RFC 4456
+    cluster_list: tuple = ()  # of IPv4Address (RFC 4456)
 
     @staticmethod
     def _attr(w: Writer, flags: int, atype: int, body: bytes) -> None:
@@ -118,6 +143,38 @@ class PathAttrs:
             w.u8(0x80).u8(AttrType.MED).u8(4).u32(self.med)
         if self.local_pref is not None:
             w.u8(0x40).u8(AttrType.LOCAL_PREF).u8(4).u32(self.local_pref)
+        if self.atomic_aggregate:
+            w.u8(0x40).u8(AttrType.ATOMIC_AGGREGATE).u8(0)
+        if self.aggregator is not None:
+            asn, addr = self.aggregator
+            w.u8(0xC0).u8(AttrType.AGGREGATOR).u8(8).u32(asn).ipv4(addr)
+        if self.communities:
+            body = Writer()
+            for c in self.communities:
+                body.u32(c)
+            self._attr(w, 0xC0, AttrType.COMMUNITIES, body.finish())
+        if self.originator_id is not None:
+            w.u8(0x80).u8(AttrType.ORIGINATOR_ID).u8(4).ipv4(self.originator_id)
+        if self.cluster_list:
+            body = Writer()
+            for cid in self.cluster_list:
+                body.ipv4(cid)
+            self._attr(w, 0x80, AttrType.CLUSTER_LIST, body.finish())
+        if self.ext_communities:
+            body = Writer()
+            for ec in self.ext_communities:
+                body.bytes(bytes(ec))
+            self._attr(w, 0xC0, AttrType.EXT_COMMUNITIES, body.finish())
+        if self.extv6_communities:
+            body = Writer()
+            for ec in self.extv6_communities:
+                body.bytes(bytes(ec))
+            self._attr(w, 0xC0, AttrType.EXTV6_COMMUNITIES, body.finish())
+        if self.large_communities:
+            body = Writer()
+            for ga, l1, l2 in self.large_communities:
+                body.u32(ga).u32(l1).u32(l2)
+            self._attr(w, 0xC0, AttrType.LARGE_COMMUNITIES, body.finish())
         w.patch_u16(pos, len(w) - start)
 
     @classmethod
@@ -166,8 +223,73 @@ class PathAttrs:
                 afi, safi = body.u16(), body.u8()
                 if afi == AFI_IPV6 and safi == SAFI_UNICAST:
                     withdrawn6 = _decode_prefixes(body, v6=True)
+            elif atype == AttrType.ATOMIC_AGGREGATE:
+                out.atomic_aggregate = True
+            elif atype == AttrType.AGGREGATOR:
+                out.aggregator = decode_aggregator(body)
+            elif atype == AttrType.COMMUNITIES:
+                out.communities = decode_comm(body)
+            elif atype == AttrType.ORIGINATOR_ID:
+                if alen != 4:
+                    raise DecodeError("bad ORIGINATOR_ID length")
+                out.originator_id = body.ipv4()
+            elif atype == AttrType.CLUSTER_LIST:
+                out.cluster_list = decode_cluster_list(body)
+            elif atype == AttrType.EXT_COMMUNITIES:
+                out.ext_communities = decode_ext_comm(body)
+            elif atype == AttrType.EXTV6_COMMUNITIES:
+                out.extv6_communities = decode_extv6_comm(body)
+            elif atype == AttrType.LARGE_COMMUNITIES:
+                out.large_communities = decode_large_comm(body)
             # unknown attrs skipped (body consumed)
         return out, nlri6, withdrawn6
+
+
+def decode_aggregator(body: Reader) -> tuple:
+    """AGGREGATOR (RFC 4271 §5.1.7, 4-octet-AS form per RFC 6793)."""
+    if body.remaining() == 8:
+        return (body.u32(), body.ipv4())
+    if body.remaining() == 6:  # 2-octet-AS speaker
+        return (body.u16(), body.ipv4())
+    raise DecodeError("bad AGGREGATOR length")
+
+
+def decode_comm(body: Reader) -> tuple:
+    """COMMUNITIES (RFC 1997): list of u32, length must be 4-aligned."""
+    if body.remaining() % 4:
+        raise DecodeError("bad COMMUNITIES length")
+    return tuple(body.u32() for _ in range(body.remaining() // 4))
+
+
+def decode_cluster_list(body: Reader) -> tuple:
+    """CLUSTER_LIST (RFC 4456 §8): list of 4-byte cluster ids."""
+    if body.remaining() % 4:
+        raise DecodeError("bad CLUSTER_LIST length")
+    return tuple(body.ipv4() for _ in range(body.remaining() // 4))
+
+
+def decode_ext_comm(body: Reader) -> tuple:
+    """EXTENDED COMMUNITIES (RFC 4360): list of opaque 8-byte values."""
+    if body.remaining() % 8:
+        raise DecodeError("bad EXT_COMMUNITIES length")
+    return tuple(body.bytes(8) for _ in range(body.remaining() // 8))
+
+
+def decode_extv6_comm(body: Reader) -> tuple:
+    """IPv6 address-specific extended communities (RFC 5701): 20 bytes."""
+    if body.remaining() % 20:
+        raise DecodeError("bad EXTV6_COMMUNITIES length")
+    return tuple(body.bytes(20) for _ in range(body.remaining() // 20))
+
+
+def decode_large_comm(body: Reader) -> tuple:
+    """LARGE COMMUNITIES (RFC 8092): list of (global, local1, local2)."""
+    if body.remaining() % 12:
+        raise DecodeError("bad LARGE_COMMUNITIES length")
+    return tuple(
+        (body.u32(), body.u32(), body.u32())
+        for _ in range(body.remaining() // 12)
+    )
 
 
 def _encode_prefixes(w: Writer, prefixes) -> None:
@@ -201,6 +323,7 @@ class OpenMsg:
     # speaker advertising no MP capability implies IPv4 unicast only
     # (RFC 4760 §8).
     mp_afs: tuple = ((AFI_IPV4, SAFI_UNICAST),)
+    route_refresh: bool = True  # RFC 2918 capability (code 2)
 
     TYPE = MsgType.OPEN
 
@@ -210,10 +333,12 @@ class OpenMsg:
         w.u16(self.hold_time)
         w.ipv4(self.router_id)
         # Capabilities: multiprotocol IPv4+IPv6 unicast (RFC 4760 §8),
-        # 4-octet AS (RFC 6793).
+        # route refresh (RFC 2918), 4-octet AS (RFC 6793).
         cap = Writer()
         cap.u8(1).u8(4).u16(AFI_IPV4).u8(0).u8(SAFI_UNICAST)
         cap.u8(1).u8(4).u16(AFI_IPV6).u8(0).u8(SAFI_UNICAST)
+        if self.route_refresh:
+            cap.u8(2).u8(0)
         cap.u8(65).u8(4).u32(self.asn)
         opt = Writer()
         opt.u8(2).u8(len(cap)).bytes(cap.finish())
@@ -229,6 +354,7 @@ class OpenMsg:
         optlen = r.u8()
         opts = r.sub(optlen)
         mp_afs: list = []
+        route_refresh = False
         while opts.remaining() >= 2:
             ptype = opts.u8()
             plen = opts.u8()
@@ -244,11 +370,14 @@ class OpenMsg:
                         afi = cbody.u16()
                         cbody.u8()  # reserved
                         mp_afs.append((afi, cbody.u8()))
+                    elif code == 2:  # route refresh (RFC 2918)
+                        route_refresh = True
         if hold != 0 and hold < 3:
             raise DecodeError("bad hold time")
         return cls(
             asn, hold, rid,
             tuple(mp_afs) if mp_afs else ((AFI_IPV4, SAFI_UNICAST),),
+            route_refresh,
         )
 
 
@@ -312,11 +441,34 @@ class NotificationMsg:
         return cls(r.u8(), r.u8(), r.rest())
 
 
+@dataclass
+class RouteRefreshMsg:
+    """ROUTE-REFRESH (RFC 2918): ask the peer to resend its Adj-RIB-Out
+    for one AFI/SAFI (the reference decodes it in packet/message.rs)."""
+
+    afi: int = AFI_IPV4
+    safi: int = SAFI_UNICAST
+
+    TYPE = MsgType.ROUTE_REFRESH
+
+    def encode_body(self, w: Writer) -> None:
+        w.u16(self.afi).u8(0).u8(self.safi)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "RouteRefreshMsg":
+        if r.remaining() != 4:
+            raise DecodeError("bad ROUTE-REFRESH length")
+        afi = r.u16()
+        r.u8()  # reserved
+        return cls(afi, r.u8())
+
+
 _BODIES = {
     MsgType.OPEN: OpenMsg,
     MsgType.UPDATE: UpdateMsg,
     MsgType.KEEPALIVE: KeepaliveMsg,
     MsgType.NOTIFICATION: NotificationMsg,
+    MsgType.ROUTE_REFRESH: RouteRefreshMsg,
 }
 
 
@@ -406,6 +558,8 @@ class Peer:
         # Negotiated address families (RFC 4760 §8): v6 routes are only
         # advertised to peers that declared IPv6-unicast capability.
         self.af6 = False
+        # RFC 2918 capability negotiated on OPEN.
+        self.route_refresh = False
         self.adj_rib_in: dict[IPv4Network, PathAttrs] = {}
         self.adj_rib_out: dict[IPv4Network, PathAttrs] = {}
         # Bumped whenever the session drops: stale async policy-worker
@@ -482,9 +636,15 @@ class BgpInstance(Actor):
         for prefix in withdrawn:
             self._decision(prefix)
 
-    def originate(self, prefix: IPv4Network, med: int | None = None) -> None:
+    def originate(
+        self,
+        prefix: IPv4Network,
+        med: int | None = None,
+        communities: tuple = (),
+    ) -> None:
         attrs = PathAttrs(
-            origin=Origin.IGP, as_path=(), next_hop=None, med=med
+            origin=Origin.IGP, as_path=(), next_hop=None, med=med,
+            communities=tuple(communities),
         )
         self.originated[prefix] = attrs
         self._decision(prefix)
@@ -600,6 +760,12 @@ class BgpInstance(Actor):
             self._rx_keepalive(peer)
         elif t == MsgType.UPDATE:
             self._rx_update(peer, body)
+        elif t == MsgType.ROUTE_REFRESH:
+            # RFC 2918: resend our Adj-RIB-Out for the named AFI/SAFI.
+            # Gated on OUR capability (which we always advertise), not the
+            # peer's — theirs only governs refreshes we would send.
+            if peer.state == PeerState.ESTABLISHED:
+                self._refresh_peer(peer, body.afi)
         elif t == MsgType.NOTIFICATION:
             self._drop_peer(peer)
 
@@ -610,6 +776,7 @@ class BgpInstance(Actor):
             return
         peer.remote_rid = open_.router_id
         peer.af6 = (AFI_IPV6, SAFI_UNICAST) in open_.mp_afs
+        peer.route_refresh = open_.route_refresh
         peer.hold_time = min(peer.config.hold_time, open_.hold_time)
         if peer.state == PeerState.IDLE:
             self._send_open(peer)
@@ -778,6 +945,15 @@ class BgpInstance(Actor):
             # Unnegotiated family, or no v6 next-hop source: advertising
             # would violate RFC 4760 §8 / install a :: next hop.
             return None
+        # Well-known communities (RFC 1997; reference
+        # holo-bgp/src/neighbor.rs:1083-1102 distribute filter).
+        if NO_ADVERTISE in entry.attrs.communities:
+            return None
+        if ebgp and (
+            NO_EXPORT in entry.attrs.communities
+            or NO_EXPORT_SUBCONFED in entry.attrs.communities
+        ):
+            return None
         attrs = PathAttrs(
             origin=entry.attrs.origin,
             as_path=((self.asn,) + entry.attrs.as_path) if ebgp else entry.attrs.as_path,
@@ -785,6 +961,13 @@ class BgpInstance(Actor):
             med=entry.attrs.med if not ebgp else None,
             local_pref=(entry.attrs.local_pref or 100) if not ebgp else None,
             nh6=self.local_addr6.get(peer.config.ifname) if v6 else None,
+            # Transitive attribute families propagate unchanged.
+            communities=entry.attrs.communities,
+            ext_communities=entry.attrs.ext_communities,
+            extv6_communities=entry.attrs.extv6_communities,
+            large_communities=entry.attrs.large_communities,
+            aggregator=entry.attrs.aggregator,
+            atomic_aggregate=entry.attrs.atomic_aggregate,
         )
         exp = peer.config.export_policy
         if exp is not None:
@@ -818,6 +1001,25 @@ class BgpInstance(Actor):
     def _advertise_all(self, peer: Peer) -> None:
         for prefix in list(self.loc_rib.keys()) + list(self.originated.keys()):
             self._advertise_prefix(prefix)
+
+    def _refresh_peer(self, peer: Peer, afi: int) -> None:
+        """RFC 2918: resend THIS peer's Adj-RIB-Out for the family (a
+        peer-scoped advertise pass — other peers' RIB-Out is untouched)."""
+        want6 = afi == AFI_IPV6
+        for prefix in list(self.loc_rib.keys()) + list(self.originated.keys()):
+            if isinstance(prefix, IPv6Network) != want6:
+                continue
+            best = self.loc_rib.get(prefix)
+            if not best:
+                continue
+            attrs = self._export_attrs(peer, prefix, best[0])
+            if attrs is None:
+                continue
+            peer.adj_rib_out[prefix] = attrs
+            if want6:
+                self._send(peer, UpdateMsg(nlri6=[prefix], attrs=attrs))
+            else:
+                self._send(peer, UpdateMsg(nlri=[prefix], attrs=attrs))
 
 
 def encode_update_withdraw(prefix) -> UpdateMsg:

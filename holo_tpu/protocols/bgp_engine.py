@@ -67,6 +67,18 @@ class BaseAttrs:
     ll_nexthop: str | None = None
     med: int | None = None
     local_pref: int | None = None
+    # Aggregation + route reflection (attribute.rs BaseAttrs:57-61).
+    aggregator: tuple | None = None  # (asn, identifier)
+    atomic_aggregate: bool = False
+    originator_id: str | None = None
+    cluster_list: tuple = ()
+    # Community families (attribute.rs Attrs:39-42; the reference interns
+    # each list separately in the RIB — rib.rs:106-119 — our engine keys
+    # the whole attrs object, which subsumes that sharing).
+    comm: tuple = ()  # of u32
+    ext_comm: tuple = ()  # of 8-byte values (hex strings in JSON)
+    extv6_comm: tuple = ()  # of 20-byte values (hex strings in JSON)
+    large_comm: tuple = ()  # of (global, local1, local2)
 
     def path_length(self) -> int:
         # as_path.path_length(): sets count as 1 (attribute.rs).
@@ -1007,6 +1019,16 @@ class BgpEngine:
             and route.origin.remote_addr == nbr.remote_addr
         ):
             return False
+        # Well-known communities (neighbor.rs:1083-1102).
+        if route.attrs.comm:
+            ebgp = nbr.config.peer_as != self.asn
+            if 0xFFFFFF02 in route.attrs.comm:  # no-advertise
+                return False
+            if ebgp and (
+                0xFFFFFF01 in route.attrs.comm  # no-export
+                or 0xFFFFFF03 in route.attrs.comm  # no-export-subconfed
+            ):
+                return False
         return True
 
     def _withdraw_routes(self, nbr, afs, table, prefixes) -> None:
@@ -1215,13 +1237,20 @@ class BgpEngine:
     def _state_rib(self) -> dict:
         if not self.active:
             return {}
-        # Collect attr sets from all live routes (interning view).
+        # Collect attr sets from all live routes (interning view).  The
+        # community lists are interned separately, as the reference RIB
+        # does (rib.rs:106-119; ietf-bgp rib/communities + the routes'
+        # community-index pointer).
         attr_sets: dict[BaseAttrs, str] = {}
+        comm_sets: dict[tuple, int] = {}
 
         def intern(attrs: BaseAttrs) -> str:
             return attr_sets.setdefault(
                 attrs, f"attr-{len(attr_sets)}"
             )
+
+        def intern_comm(comm: tuple) -> int:
+            return comm_sets.setdefault(comm, len(comm_sets))
 
         afi_safi_entries = []
         for afs in AFI_SAFIS:
@@ -1233,14 +1262,17 @@ class BgpEngine:
             for prefix in sorted(table.prefixes, key=_prefix_key):
                 dest = table.prefixes[prefix]
                 if dest.local is not None:
-                    loc_routes.append(
-                        {
-                            "prefix": prefix,
-                            "origin": _origin_yang(dest.local.origin),
-                            "path-id": 0,
-                            "attr-index": intern(dest.local.attrs),
-                        }
-                    )
+                    loc: dict = {
+                        "prefix": prefix,
+                        "origin": _origin_yang(dest.local.origin),
+                        "path-id": 0,
+                        "attr-index": intern(dest.local.attrs),
+                    }
+                    if dest.local.attrs.comm:
+                        loc["community-index"] = intern_comm(
+                            dest.local.attrs.comm
+                        )
+                    loc_routes.append(loc)
                 for addr in sorted(dest.adj_rib, key=_addr_key):
                     adj = dest.adj_rib[addr]
                     nbr = self.neighbors.get(addr)
@@ -1269,6 +1301,10 @@ class BgpEngine:
                             "path-id": 0,
                             "attr-index": intern(route.attrs),
                         }
+                        if route.attrs.comm:
+                            r["community-index"] = intern_comm(
+                                route.attrs.comm
+                            )
                         r["eligible-route"] = route.is_eligible()
                         if route.ineligible_reason:
                             # yang.rs:206-210: unresolvable is a
@@ -1326,12 +1362,33 @@ class BgpEngine:
                     for attrs, idx in attr_sets.items()
                 ]
             }
+        if comm_sets:
+            rib["communities"] = {
+                "community": [
+                    {"index": idx, "community": [_comm_yang(c) for c in comm]}
+                    for comm, idx in comm_sets.items()
+                ]
+            }
         if afi_safi_entries:
             rib["afi-safis"] = {"afi-safi": afi_safi_entries}
         return rib
 
 
 # ===== helpers =====
+
+_WELL_KNOWN_COMMS = {
+    0xFFFFFF01: "iana-bgp-community-types:no-export",
+    0xFFFFFF02: "iana-bgp-community-types:no-advertise",
+    0xFFFFFF03: "iana-bgp-community-types:no-export-subconfed",
+}
+
+
+def _comm_yang(comm: int) -> str:
+    """holo-utils/src/bgp.rs:161-175 Comm::to_yang — well-known identity
+    or "global:local"."""
+    if comm in _WELL_KNOWN_COMMS:
+        return _WELL_KNOWN_COMMS[comm]
+    return f"{comm >> 16}:{comm & 0xFFFF}"
 
 
 def _addr_key(addr: str):
@@ -1440,6 +1497,7 @@ def _attrs_from_json(j: dict) -> BaseAttrs:
         AsSegment(s["seg_type"], tuple(s["members"]))
         for s in base.get("as_path", {}).get("segments", [])
     )
+    agg = base.get("aggregator")
     return BaseAttrs(
         origin=base.get("origin", "Incomplete"),
         as_path=segs,
@@ -1447,6 +1505,15 @@ def _attrs_from_json(j: dict) -> BaseAttrs:
         ll_nexthop=base.get("ll_nexthop"),
         med=base.get("med"),
         local_pref=base.get("local_pref"),
+        aggregator=(agg["asn"], agg["identifier"]) if agg else None,
+        # Option<()> serializes as a null-valued key: presence == Some(()).
+        atomic_aggregate="atomic_aggregate" in base,
+        originator_id=base.get("originator_id"),
+        cluster_list=tuple(base.get("cluster_list", ())),
+        comm=tuple(j.get("comm", ())),
+        ext_comm=tuple(j.get("ext_comm", ())),
+        extv6_comm=tuple(j.get("extv6_comm", ())),
+        large_comm=tuple(tuple(c) for c in j.get("large_comm", ())),
     )
 
 
@@ -1468,7 +1535,27 @@ def _attrs_to_json(attrs: BaseAttrs) -> dict:
         base["med"] = attrs.med
     if attrs.local_pref is not None:
         base["local_pref"] = attrs.local_pref
-    return {"base": base}
+    if attrs.aggregator is not None:
+        base["aggregator"] = {
+            "asn": attrs.aggregator[0],
+            "identifier": attrs.aggregator[1],
+        }
+    if attrs.atomic_aggregate:
+        base["atomic_aggregate"] = None  # Option<()> serde shape
+    if attrs.originator_id is not None:
+        base["originator_id"] = attrs.originator_id
+    if attrs.cluster_list:
+        base["cluster_list"] = list(attrs.cluster_list)
+    out = {"base": base}
+    if attrs.comm:
+        out["comm"] = sorted(attrs.comm)
+    if attrs.ext_comm:
+        out["ext_comm"] = sorted(attrs.ext_comm)
+    if attrs.extv6_comm:
+        out["extv6_comm"] = sorted(attrs.extv6_comm)
+    if attrs.large_comm:
+        out["large_comm"] = sorted(list(c) for c in attrs.large_comm)
+    return out
 
 
 def origin_from_json(j) -> RouteOrigin:

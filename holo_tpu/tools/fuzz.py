@@ -158,7 +158,7 @@ def _seed_corpus():
 
 
 def targets() -> dict:
-    """name -> (decode_fn, seed_filter) — ≥25 targets mirroring
+    """name -> (decode_fn, seed_filter) — ≥31 targets mirroring
     fuzz/fuzz_targets/** (bfd, bgp message+attribute, isis, ldp, ospf
     v2+v3, rip, vrrp) plus igmp (ours)."""
     from holo_tpu.protocols import bfd, bgp, igmp, ldp, rip, vrrp
@@ -216,6 +216,16 @@ def targets() -> dict:
         "bgp_ipv6_prefix_decode": lambda b: bgp._decode_prefixes(
             Reader(b), v6=True
         ),
+        # per-attribute decoders (reference: bgp/attribute/*_decode.rs)
+        "bgp_aggregator_decode": lambda b: bgp.decode_aggregator(Reader(b)),
+        "bgp_comm_decode": lambda b: bgp.decode_comm(Reader(b)),
+        "bgp_ext_comm_decode": lambda b: bgp.decode_ext_comm(Reader(b)),
+        "bgp_extv6_comm_decode": lambda b: bgp.decode_extv6_comm(Reader(b)),
+        "bgp_large_comm_decode": lambda b: bgp.decode_large_comm(Reader(b)),
+        "bgp_cluster_list_decode": lambda b: bgp.decode_cluster_list(
+            Reader(b)
+        ),
+        "bgp_routerefresh_decode": bgp_body(bgp.RouteRefreshMsg),
         # igmp (no reference counterpart — ours has a kernel-facing decoder)
         "igmp_packet_decode": igmp.IgmpPacket.decode,
     }

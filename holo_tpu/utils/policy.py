@@ -72,11 +72,25 @@ class PrefixSet:
         return False
 
 
+def parse_community(value) -> int:
+    """"asn:value" notation or plain int → u32 (RFC 1997 encoding)."""
+    if isinstance(value, int):
+        return value
+    asn, _, local = str(value).partition(":")
+    if local:
+        return (int(asn) << 16) | int(local)
+    return int(asn)
+
+
 @dataclass
 class Conditions:
     prefix_set: str | None = None
     tag_set: str | None = None
     protocol: str | None = None
+    # BGP community matching (ietf-bgp-policy match-community-set):
+    # options per the ietf-routing-policy match-set-options type.
+    community_set: str | None = None
+    community_match: str = "any"  # "any" | "all" | "invert"
 
     def match(self, ctx: RouteContext, sets: "DefinedSets") -> bool:
         if self.prefix_set is not None:
@@ -89,6 +103,18 @@ class Conditions:
                 return False
         if self.protocol is not None and ctx.protocol != self.protocol:
             return False
+        if self.community_set is not None:
+            wanted = sets.community_sets.get(self.community_set, set())
+            have = ctx.communities
+            if self.community_match == "all":
+                if not wanted or not wanted.issubset(have):
+                    return False
+            elif self.community_match == "invert":
+                if wanted & have:
+                    return False
+            else:  # any
+                if not wanted & have:
+                    return False
         return True
 
 
@@ -98,6 +124,10 @@ class Actions:
     set_metric: int | None = None
     set_tag: int | None = None
     set_local_pref: int | None = None
+    # ietf-bgp-policy set-community: inline communities, applied by
+    # method "add" (default) / "remove" / "replace".
+    set_communities: tuple = ()
+    set_communities_method: str = "add"
 
     def apply(self, ctx: RouteContext) -> PolicyResult:
         if self.set_metric is not None:
@@ -106,6 +136,14 @@ class Actions:
             ctx.tag = self.set_tag
         if self.set_local_pref is not None:
             ctx.local_pref = self.set_local_pref
+        if self.set_communities or self.set_communities_method == "replace":
+            comms = set(self.set_communities)
+            if self.set_communities_method == "replace":
+                ctx.communities = comms
+            elif self.set_communities_method == "remove":
+                ctx.communities -= comms
+            else:  # add
+                ctx.communities |= comms
         return self.result or PolicyResult.CONTINUE
 
 
@@ -136,6 +174,9 @@ class Policy:
 class DefinedSets:
     prefix_sets: dict[str, PrefixSet] = field(default_factory=dict)
     tag_sets: dict[str, set[int]] = field(default_factory=dict)
+    # name -> set of u32 community values (ietf-bgp-policy
+    # community-sets; members accept "asn:value" or raw ints).
+    community_sets: dict[str, set[int]] = field(default_factory=dict)
 
 
 class PolicyEngine:
@@ -157,6 +198,10 @@ class PolicyEngine:
             self.sets.prefix_sets[name] = ps
         for name, entry in (defined.get("tag-set") or {}).items():
             self.sets.tag_sets[name] = set(entry.get("tag", []))
+        for name, entry in (defined.get("community-set") or {}).items():
+            self.sets.community_sets[name] = {
+                parse_community(m) for m in entry.get("member", [])
+            }
         for name, entry in (conf.get("policy-definition") or {}).items():
             pol = Policy(name)
             for sname, s in (entry.get("statement") or {}).items():
@@ -167,18 +212,30 @@ class PolicyEngine:
                     result = PolicyResult.ACCEPT
                 elif act.get("policy-result") == "reject-route":
                     result = PolicyResult.REJECT
+                set_comm = act.get("set-community") or {}
                 pol.statements.append(
                     Statement(
                         sname,
                         Conditions(
                             prefix_set=cond.get("match-prefix-set"),
                             tag_set=cond.get("match-tag-set"),
+                            community_set=cond.get("match-community-set"),
+                            community_match=cond.get(
+                                "community-match-options", "any"
+                            ),
                         ),
                         Actions(
                             result=result,
                             set_metric=act.get("set-metric"),
                             set_tag=act.get("set-tag"),
                             set_local_pref=act.get("set-local-pref"),
+                            set_communities=tuple(
+                                parse_community(m)
+                                for m in set_comm.get("communities", [])
+                            ),
+                            set_communities_method=set_comm.get(
+                                "method", "add"
+                            ),
                         ),
                     )
                 )
@@ -191,14 +248,22 @@ class PolicyEngine:
         return pol.evaluate(ctx, self.sets)
 
     def bgp_import_hook(self, policy_name: str):
-        """Adapter: BGP PeerConfig.import_policy/export_policy callable."""
+        """Adapter: BGP PeerConfig.import_policy/export_policy callable.
+
+        Works on either attrs flavor — ``PathAttrs.communities`` (wire
+        slice) or ``BaseAttrs.comm`` (engine) — whichever field exists.
+        """
 
         def hook(prefix, attrs):
+            comm_field = (
+                "communities" if hasattr(attrs, "communities") else "comm"
+            )
             ctx = RouteContext(
                 prefix=prefix,
                 protocol="bgp",
                 metric=attrs.med,
                 local_pref=attrs.local_pref,
+                communities=set(getattr(attrs, comm_field, ()) or ()),
             )
             if self.apply(policy_name, ctx) == PolicyResult.REJECT:
                 return None
@@ -206,6 +271,11 @@ class PolicyEngine:
 
             # ctx carries the (possibly edited) values verbatim — a
             # set-metric of 0 sticks.
-            return replace(attrs, med=ctx.metric, local_pref=ctx.local_pref)
+            return replace(
+                attrs,
+                med=ctx.metric,
+                local_pref=ctx.local_pref,
+                **{comm_field: tuple(sorted(ctx.communities))},
+            )
 
         return hook

@@ -195,3 +195,123 @@ def test_engine_deactivation_and_late_neighbor_add():
     del eng.neighbor_cfg["10.0.0.3"]
     eng.update()
     assert set(eng.neighbors) == {"10.0.0.2"}
+
+
+def test_community_attr_roundtrips():
+    """RFC 1997/4360/5701/8092 community families + aggregation +
+    route-reflection attrs survive the wire round-trip."""
+    from holo_tpu.protocols.bgp import RouteRefreshMsg
+
+    attrs = PathAttrs(
+        Origin.IGP,
+        (65001,),
+        A("10.0.0.1"),
+        communities=(0x00010002, 0xFFFFFF01),
+        ext_communities=(b"\x00\x02\x00\x01\x00\x00\x00\x64",),
+        extv6_communities=(bytes(20),),
+        large_communities=((65001, 7, 9),),
+        aggregator=(65010, A("9.9.9.9")),
+        atomic_aggregate=True,
+        originator_id=A("3.3.3.3"),
+        cluster_list=(A("4.4.4.4"), A("5.5.5.5")),
+    )
+    u = UpdateMsg(attrs=attrs, nlri=[N("10.1.0.0/16")])
+    _, out = decode_msg(encode_msg(u))
+    a = out.attrs
+    assert a.communities == (0x00010002, 0xFFFFFF01)
+    assert a.ext_communities == (b"\x00\x02\x00\x01\x00\x00\x00\x64",)
+    assert a.extv6_communities == (bytes(20),)
+    assert a.large_communities == ((65001, 7, 9),)
+    assert a.aggregator == (65010, A("9.9.9.9"))
+    assert a.atomic_aggregate
+    assert a.originator_id == A("3.3.3.3")
+    assert a.cluster_list == (A("4.4.4.4"), A("5.5.5.5"))
+
+    # ROUTE-REFRESH (RFC 2918) round-trip + capability negotiation.
+    t, rr = decode_msg(encode_msg(RouteRefreshMsg(afi=2)))
+    assert t == MsgType.ROUTE_REFRESH and rr.afi == 2 and rr.safi == 1
+    _, o = decode_msg(encode_msg(OpenMsg(65001, 90, A("1.1.1.1"))))
+    assert o.route_refresh
+
+
+def test_malformed_community_lengths_rejected():
+    import pytest
+
+    from holo_tpu.protocols.bgp import (
+        decode_aggregator,
+        decode_comm,
+        decode_ext_comm,
+        decode_large_comm,
+    )
+    from holo_tpu.utils.bytesbuf import DecodeError, Reader
+
+    for fn, bad in (
+        (decode_comm, b"\x00\x01\x00"),  # not 4-aligned
+        (decode_ext_comm, b"\x00" * 7),  # not 8-aligned
+        (decode_large_comm, b"\x00" * 13),  # not 12-aligned
+        (decode_aggregator, b"\x00" * 5),  # neither 6 nor 8 bytes
+    ):
+        with pytest.raises(DecodeError):
+            fn(Reader(bad))
+
+
+def test_communities_propagate_and_well_knowns_filter():
+    """Transitive carry b1->b2, and NO_EXPORT suppresses eBGP
+    advertisement (neighbor.rs:1083-1102 distribute filter)."""
+    from holo_tpu.protocols.bgp import NO_EXPORT
+
+    loop, fabric, b1, b2 = two_speakers()
+    loop.advance(5)
+    b1.originate(N("203.0.113.0/24"), communities=(0x00010002,))
+    b1.originate(N("198.51.100.0/24"), communities=(NO_EXPORT,))
+    loop.advance(2)
+    best = b2.loc_rib.get(N("203.0.113.0/24"))
+    assert best is not None and best[0].attrs.communities == (0x00010002,)
+    # NO_EXPORT: never advertised over the eBGP session.
+    assert N("198.51.100.0/24") not in b2.loc_rib
+
+
+def test_route_refresh_resends_adj_rib_out():
+    from holo_tpu.protocols.bgp import RouteRefreshMsg
+
+    loop, fabric, b1, b2 = two_speakers()
+    loop.advance(5)
+    b1.originate(N("203.0.113.0/24"))
+    loop.advance(2)
+    assert N("203.0.113.0/24") in b2.loc_rib
+    # b2 forgets the route (simulated RIB loss), then asks for a refresh.
+    peer1 = b2.peers[A("10.0.0.1")]
+    peer1.adj_rib_in.clear()
+    b2.loc_rib.clear()
+    b2._send(peer1, RouteRefreshMsg())
+    loop.advance(2)
+    assert N("203.0.113.0/24") in b2.loc_rib
+
+
+def test_engine_attrs_json_carries_communities():
+    """Recorded-corpus serde shape: comm/large_comm side-by-side with
+    base, atomic_aggregate as a present-null key (serde Option<()>)."""
+    from holo_tpu.protocols.bgp_engine import (
+        _attrs_from_json,
+        _attrs_to_json,
+    )
+
+    j = {
+        "base": {
+            "origin": "Igp",
+            "as_path": {"segments": [{"seg_type": "Sequence", "members": [65001]}]},
+            "nexthop": "10.0.0.1",
+            "aggregator": {"asn": 65010, "identifier": "9.9.9.9"},
+            "atomic_aggregate": None,
+            "originator_id": "3.3.3.3",
+            "cluster_list": ["4.4.4.4"],
+        },
+        "comm": [65538, 4294967041],
+        "large_comm": [[65001, 7, 9]],
+    }
+    attrs = _attrs_from_json(j)
+    assert attrs.comm == (65538, 4294967041)
+    assert attrs.large_comm == ((65001, 7, 9),)
+    assert attrs.aggregator == (65010, "9.9.9.9")
+    assert attrs.atomic_aggregate
+    assert _attrs_from_json(_attrs_to_json(attrs)) == attrs

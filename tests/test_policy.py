@@ -106,3 +106,87 @@ def test_engine_from_yang_config_and_bgp_hook():
     assert N("203.0.113.0/24") not in b2.loc_rib  # rejected by policy
     best = b2.loc_rib[N("198.51.100.0/24")][0]
     assert best.attrs.med == 500  # rewritten by set-metric
+
+
+def test_community_set_match_and_set_actions():
+    """ietf-bgp-policy: match-community-set (any/all/invert) and
+    set-community add/remove/replace through the BGP import hook."""
+    from holo_tpu.protocols.bgp import PathAttrs
+    from holo_tpu.utils.policy import PolicyEngine, parse_community
+
+    assert parse_community("65001:100") == (65001 << 16) | 100
+
+    eng = PolicyEngine()
+    eng.load_from_config(
+        {
+            "defined-sets": {
+                "community-set": {
+                    "cust": {"member": ["65001:100", "65001:200"]},
+                }
+            },
+            "policy-definition": {
+                "imp": {
+                    "statement": {
+                        "10-tag": {
+                            "conditions": {"match-community-set": "cust"},
+                            "actions": {
+                                "set-community": {
+                                    "method": "add",
+                                    "communities": ["65009:1"],
+                                },
+                                "set-local-pref": 200,
+                                "policy-result": "accept-route",
+                            },
+                        },
+                        "20-rest": {
+                            "conditions": {},
+                            "actions": {"policy-result": "reject-route"},
+                        },
+                    }
+                }
+            },
+        }
+    )
+    hook = eng.bgp_import_hook("imp")
+    from ipaddress import IPv4Network as N
+
+    tagged = PathAttrs(communities=(parse_community("65001:100"),))
+    out = hook(N("10.0.0.0/24"), tagged)
+    assert out is not None and out.local_pref == 200
+    assert parse_community("65009:1") in out.communities
+    assert parse_community("65001:100") in out.communities  # add keeps
+
+    untagged = PathAttrs()
+    assert hook(N("10.1.0.0/24"), untagged) is None  # fell to reject
+
+    # invert + replace: untagged routes match, get stamped.
+    eng.load_from_config(
+        {
+            "defined-sets": {
+                "community-set": {"cust": {"member": ["65001:100"]}}
+            },
+            "policy-definition": {
+                "imp": {
+                    "statement": {
+                        "10": {
+                            "conditions": {
+                                "match-community-set": "cust",
+                                "community-match-options": "invert",
+                            },
+                            "actions": {
+                                "set-community": {
+                                    "method": "replace",
+                                    "communities": ["65000:999"],
+                                },
+                                "policy-result": "accept-route",
+                            },
+                        }
+                    }
+                }
+            },
+        }
+    )
+    hook = eng.bgp_import_hook("imp")
+    out = hook(N("10.2.0.0/24"), PathAttrs(communities=(1,)))
+    assert out is not None and out.communities == (parse_community("65000:999"),)
+    assert hook(N("10.3.0.0/24"), tagged) is None  # tagged inverted away
