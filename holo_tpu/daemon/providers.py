@@ -1148,10 +1148,16 @@ class RoutingProvider(Provider, Actor):
                 continue
             imp = exp = None
             if engine is not None:
+                # Scope the hooks to this peer so match-neighbor-set
+                # conditions see the route's source address.
                 if n.get("import-policy"):
-                    imp = engine.bgp_import_hook(n["import-policy"])
+                    imp = engine.bgp_import_hook(
+                        n["import-policy"], neighbor=addr
+                    )
                 if n.get("export-policy"):
-                    exp = engine.bgp_import_hook(n["export-policy"])
+                    exp = engine.bgp_import_hook(
+                        n["export-policy"], neighbor=addr
+                    )
             inst.add_peer(
                 PeerConfig(
                     addr,

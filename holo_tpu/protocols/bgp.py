@@ -764,7 +764,12 @@ class BgpInstance(Actor):
             # RFC 2918: resend our Adj-RIB-Out for the named AFI/SAFI.
             # Gated on OUR capability (which we always advertise), not the
             # peer's — theirs only governs refreshes we would send.
-            if peer.state == PeerState.ESTABLISHED:
+            # Unsupported AFI/SAFI pairs are ignored (RFC 7313 §4).
+            if (
+                peer.state == PeerState.ESTABLISHED
+                and body.safi == SAFI_UNICAST
+                and body.afi in (AFI_IPV4, AFI_IPV6)
+            ):
                 self._refresh_peer(peer, body.afi)
         elif t == MsgType.NOTIFICATION:
             self._drop_peer(peer)
@@ -1006,7 +1011,9 @@ class BgpInstance(Actor):
         """RFC 2918: resend THIS peer's Adj-RIB-Out for the family (a
         peer-scoped advertise pass — other peers' RIB-Out is untouched)."""
         want6 = afi == AFI_IPV6
-        for prefix in list(self.loc_rib.keys()) + list(self.originated.keys()):
+        # originate() lands prefixes in loc_rib via _decision, so the
+        # loc-RIB alone is the complete Adj-RIB-Out source.
+        for prefix in list(self.loc_rib.keys()):
             if isinstance(prefix, IPv6Network) != want6:
                 continue
             best = self.loc_rib.get(prefix)

@@ -27,6 +27,12 @@ conformance corpus verifies.
 from __future__ import annotations
 
 import json
+
+from holo_tpu.protocols.bgp import (
+    NO_ADVERTISE,
+    NO_EXPORT,
+    NO_EXPORT_SUBCONFED,
+)
 from dataclasses import dataclass, field, replace
 from ipaddress import IPv4Address
 
@@ -1022,11 +1028,11 @@ class BgpEngine:
         # Well-known communities (neighbor.rs:1083-1102).
         if route.attrs.comm:
             ebgp = nbr.config.peer_as != self.asn
-            if 0xFFFFFF02 in route.attrs.comm:  # no-advertise
+            if NO_ADVERTISE in route.attrs.comm:
                 return False
             if ebgp and (
-                0xFFFFFF01 in route.attrs.comm  # no-export
-                or 0xFFFFFF03 in route.attrs.comm  # no-export-subconfed
+                NO_EXPORT in route.attrs.comm
+                or NO_EXPORT_SUBCONFED in route.attrs.comm
             ):
                 return False
         return True
@@ -1377,9 +1383,9 @@ class BgpEngine:
 # ===== helpers =====
 
 _WELL_KNOWN_COMMS = {
-    0xFFFFFF01: "iana-bgp-community-types:no-export",
-    0xFFFFFF02: "iana-bgp-community-types:no-advertise",
-    0xFFFFFF03: "iana-bgp-community-types:no-export-subconfed",
+    NO_EXPORT: "iana-bgp-community-types:no-export",
+    NO_ADVERTISE: "iana-bgp-community-types:no-advertise",
+    NO_EXPORT_SUBCONFED: "iana-bgp-community-types:no-export-subconfed",
 }
 
 

@@ -54,8 +54,9 @@ class PolicyWorker(Actor):
         if not isinstance(msg, EvalBatchRequest):
             return
         # Reuse the engine's canonical per-route hook so the sync and async
-        # paths can never diverge.
-        hook = self.engine.bgp_import_hook(msg.policy_name)
+        # paths can never diverge; the batch's peer scopes neighbor-set
+        # conditions.
+        hook = self.engine.bgp_import_hook(msg.policy_name, neighbor=msg.peer)
         out = [(prefix, hook(prefix, attrs)) for prefix, attrs in msg.entries]
         self.batches_processed += 1
         self.loop.send(

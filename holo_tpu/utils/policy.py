@@ -29,7 +29,9 @@ class RouteContext:
     """Mutable route view a policy evaluates and edits.
 
     ``metric``/``local_pref`` are Optional so a policy's set-metric 0 is
-    distinguishable from "unset".
+    distinguishable from "unset".  The BGP-only fields mirror the
+    reference's BgpPolicyCondition/-Action surface
+    (holo-utils/src/policy.rs:259-386).
     """
 
     prefix: IpNetwork
@@ -38,6 +40,12 @@ class RouteContext:
     tag: int | None = None
     local_pref: int | None = None
     communities: set = field(default_factory=set)
+    ext_communities: set = field(default_factory=set)
+    large_communities: set = field(default_factory=set)
+    as_path: tuple = ()  # flattened ASN sequence
+    origin: str | None = None  # "igp" | "egp" | "incomplete"
+    nexthop: str | None = None
+    neighbor: str | None = None  # peer address the route came from
 
 
 @dataclass
@@ -82,15 +90,87 @@ def parse_community(value) -> int:
     return int(asn)
 
 
+def parse_large_community(value) -> tuple:
+    """"global:local1:local2" or 3-sequence → (u32, u32, u32)."""
+    if isinstance(value, (tuple, list)):
+        ga, l1, l2 = value
+        return (int(ga), int(l1), int(l2))
+    ga, l1, l2 = str(value).split(":")
+    return (int(ga), int(l1), int(l2))
+
+
+def parse_ext_community(value) -> bytes:
+    """Extended community → its 8-byte wire value (RFC 4360).
+
+    Accepts bytes verbatim, "rt:ASN:VAL" / "soo:ASN:VAL" notation
+    (two-octet-AS route-target/route-origin), or 16 hex digits.
+    """
+    if isinstance(value, (bytes, bytearray)):
+        if len(value) != 8:
+            raise ValueError(f"ext community needs 8 bytes, got {len(value)}")
+        return bytes(value)
+    s = str(value)
+    kind, _, rest = s.partition(":")
+    if kind in ("rt", "soo") and rest:
+        asn, _, local = rest.partition(":")
+        sub = 0x02 if kind == "rt" else 0x03
+        return (
+            bytes((0x00, sub))
+            + int(asn).to_bytes(2, "big")
+            + int(local).to_bytes(4, "big")
+        )
+    hexstr = s.replace(":", "").replace(".", "")
+    raw = bytes.fromhex(hexstr)
+    if len(raw) != 8:
+        raise ValueError(f"ext community needs 8 bytes, got {len(raw)}")
+    return raw
+
+
+def _cmp(have: int | None, want: dict) -> bool:
+    """{"value": N, "op": "eq"|"le"|"ge"} — reference BgpEqOperator."""
+    if have is None:
+        return False
+    value = int(want.get("value", 0))
+    op = want.get("op", "eq")
+    if op in ("le", "less-than-or-equal"):
+        return have <= value
+    if op in ("ge", "greater-than-or-equal"):
+        return have >= value
+    return have == value
+
+
+def _match_set(wanted: set, have: set, how: str) -> bool:
+    """ietf match-set-options: any | all | invert."""
+    if how == "all":
+        return bool(wanted) and wanted.issubset(have)
+    if how == "invert":
+        return not (wanted & have)
+    return bool(wanted & have)
+
+
 @dataclass
 class Conditions:
     prefix_set: str | None = None
     tag_set: str | None = None
     protocol: str | None = None
-    # BGP community matching (ietf-bgp-policy match-community-set):
-    # options per the ietf-routing-policy match-set-options type.
+    neighbor_set: str | None = None
+    # BGP set matching (ietf-bgp-policy / reference
+    # BgpPolicyCondition, holo-utils/src/policy.rs:259-310): options per
+    # the ietf-routing-policy match-set-options type.
     community_set: str | None = None
     community_match: str = "any"  # "any" | "all" | "invert"
+    ext_community_set: str | None = None
+    ext_community_match: str = "any"
+    large_community_set: str | None = None
+    large_community_match: str = "any"
+    as_path_set: str | None = None  # matches any member ASN on the path
+    nexthop_set: str | None = None
+    # Scalar comparisons: {"value": N, "op": "eq"|"le"|"ge"}.
+    med: dict | None = None
+    local_pref: dict | None = None
+    as_path_len: dict | None = None
+    community_count: dict | None = None
+    origin: str | None = None  # "igp" | "egp" | "incomplete"
 
     def match(self, ctx: RouteContext, sets: "DefinedSets") -> bool:
         if self.prefix_set is not None:
@@ -103,19 +183,62 @@ class Conditions:
                 return False
         if self.protocol is not None and ctx.protocol != self.protocol:
             return False
-        if self.community_set is not None:
-            wanted = sets.community_sets.get(self.community_set, set())
-            have = ctx.communities
-            if self.community_match == "all":
-                if not wanted or not wanted.issubset(have):
-                    return False
-            elif self.community_match == "invert":
-                if wanted & have:
-                    return False
-            else:  # any
-                if not wanted & have:
-                    return False
+        if self.neighbor_set is not None:
+            addrs = sets.neighbor_sets.get(self.neighbor_set, set())
+            if ctx.neighbor is None or str(ctx.neighbor) not in addrs:
+                return False
+        if self.community_set is not None and not _match_set(
+            sets.community_sets.get(self.community_set, set()),
+            ctx.communities,
+            self.community_match,
+        ):
+            return False
+        if self.ext_community_set is not None and not _match_set(
+            sets.ext_community_sets.get(self.ext_community_set, set()),
+            ctx.ext_communities,
+            self.ext_community_match,
+        ):
+            return False
+        if self.large_community_set is not None and not _match_set(
+            sets.large_community_sets.get(self.large_community_set, set()),
+            ctx.large_communities,
+            self.large_community_match,
+        ):
+            return False
+        if self.as_path_set is not None:
+            asns = sets.as_path_sets.get(self.as_path_set, set())
+            if not asns & set(ctx.as_path):
+                return False
+        if self.nexthop_set is not None:
+            hops = sets.nexthop_sets.get(self.nexthop_set, set())
+            if ctx.nexthop is None or str(ctx.nexthop) not in hops:
+                return False
+        if self.med is not None and not _cmp(ctx.metric, self.med):
+            return False
+        if self.local_pref is not None and not _cmp(
+            ctx.local_pref, self.local_pref
+        ):
+            return False
+        if self.as_path_len is not None and not _cmp(
+            len(ctx.as_path), self.as_path_len
+        ):
+            return False
+        if self.community_count is not None and not _cmp(
+            len(ctx.communities), self.community_count
+        ):
+            return False
+        if self.origin is not None and ctx.origin != self.origin:
+            return False
         return True
+
+
+def _apply_comm_edit(have: set, comms: set, method: str) -> set:
+    """BgpSetCommOptions Add/Remove/Replace (policy.rs:415-420)."""
+    if method == "replace":
+        return set(comms)
+    if method == "remove":
+        return have - comms
+    return have | comms
 
 
 @dataclass
@@ -124,10 +247,21 @@ class Actions:
     set_metric: int | None = None
     set_tag: int | None = None
     set_local_pref: int | None = None
-    # ietf-bgp-policy set-community: inline communities, applied by
-    # method "add" (default) / "remove" / "replace".
+    # ietf-bgp-policy set-community family: inline values applied by
+    # method "add" (default) / "remove" / "replace" (reference
+    # BgpPolicyAction, holo-utils/src/policy.rs:361-386).
     set_communities: tuple = ()
     set_communities_method: str = "add"
+    set_ext_communities: tuple = ()
+    set_ext_communities_method: str = "add"
+    set_large_communities: tuple = ()
+    set_large_communities_method: str = "add"
+    set_origin: str | None = None
+    set_nexthop: str | None = None  # address or "self"
+    # {"set"|"add"|"subtract": N} — reference BgpSetMed.
+    set_med: dict | None = None
+    # {"asn": N, "repeat": N} — reference SetAsPathPrepent.
+    as_path_prepend: dict | None = None
 
     def apply(self, ctx: RouteContext) -> PolicyResult:
         if self.set_metric is not None:
@@ -137,13 +271,46 @@ class Actions:
         if self.set_local_pref is not None:
             ctx.local_pref = self.set_local_pref
         if self.set_communities or self.set_communities_method == "replace":
-            comms = set(self.set_communities)
-            if self.set_communities_method == "replace":
-                ctx.communities = comms
-            elif self.set_communities_method == "remove":
-                ctx.communities -= comms
-            else:  # add
-                ctx.communities |= comms
+            ctx.communities = _apply_comm_edit(
+                ctx.communities,
+                set(self.set_communities),
+                self.set_communities_method,
+            )
+        if (
+            self.set_ext_communities
+            or self.set_ext_communities_method == "replace"
+        ):
+            ctx.ext_communities = _apply_comm_edit(
+                ctx.ext_communities,
+                set(self.set_ext_communities),
+                self.set_ext_communities_method,
+            )
+        if (
+            self.set_large_communities
+            or self.set_large_communities_method == "replace"
+        ):
+            ctx.large_communities = _apply_comm_edit(
+                ctx.large_communities,
+                set(self.set_large_communities),
+                self.set_large_communities_method,
+            )
+        if self.set_origin is not None:
+            ctx.origin = self.set_origin
+        if self.set_nexthop is not None:
+            ctx.nexthop = self.set_nexthop
+        if self.set_med is not None:
+            if "set" in self.set_med:
+                ctx.metric = int(self.set_med["set"])
+            elif "add" in self.set_med:
+                ctx.metric = (ctx.metric or 0) + int(self.set_med["add"])
+            elif "subtract" in self.set_med:
+                ctx.metric = max(
+                    0, (ctx.metric or 0) - int(self.set_med["subtract"])
+                )
+        if self.as_path_prepend is not None:
+            asn = int(self.as_path_prepend["asn"])
+            repeat = int(self.as_path_prepend.get("repeat") or 1)
+            ctx.as_path = (asn,) * repeat + tuple(ctx.as_path)
         return self.result or PolicyResult.CONTINUE
 
 
@@ -172,11 +339,19 @@ class Policy:
 
 @dataclass
 class DefinedSets:
+    """Reference MatchSets (holo-utils/src/policy.rs:139-182): shared
+    prefix/neighbor/tag sets plus the BGP families."""
+
     prefix_sets: dict[str, PrefixSet] = field(default_factory=dict)
     tag_sets: dict[str, set[int]] = field(default_factory=dict)
+    neighbor_sets: dict[str, set[str]] = field(default_factory=dict)
     # name -> set of u32 community values (ietf-bgp-policy
     # community-sets; members accept "asn:value" or raw ints).
     community_sets: dict[str, set[int]] = field(default_factory=dict)
+    ext_community_sets: dict[str, set] = field(default_factory=dict)
+    large_community_sets: dict[str, set] = field(default_factory=dict)
+    as_path_sets: dict[str, set[int]] = field(default_factory=dict)
+    nexthop_sets: dict[str, set[str]] = field(default_factory=dict)
 
 
 class PolicyEngine:
@@ -202,6 +377,26 @@ class PolicyEngine:
             self.sets.community_sets[name] = {
                 parse_community(m) for m in entry.get("member", [])
             }
+        for name, entry in (defined.get("neighbor-set") or {}).items():
+            self.sets.neighbor_sets[name] = {
+                str(a) for a in entry.get("address", [])
+            }
+        for name, entry in (defined.get("ext-community-set") or {}).items():
+            self.sets.ext_community_sets[name] = {
+                parse_ext_community(m) for m in entry.get("member", [])
+            }
+        for name, entry in (defined.get("large-community-set") or {}).items():
+            self.sets.large_community_sets[name] = {
+                parse_large_community(m) for m in entry.get("member", [])
+            }
+        for name, entry in (defined.get("as-path-set") or {}).items():
+            self.sets.as_path_sets[name] = {
+                int(m) for m in entry.get("member", [])
+            }
+        for name, entry in (defined.get("next-hop-set") or {}).items():
+            self.sets.nexthop_sets[name] = {
+                str(a) for a in entry.get("address", [])
+            }
         for name, entry in (conf.get("policy-definition") or {}).items():
             pol = Policy(name)
             for sname, s in (entry.get("statement") or {}).items():
@@ -213,16 +408,38 @@ class PolicyEngine:
                 elif act.get("policy-result") == "reject-route":
                     result = PolicyResult.REJECT
                 set_comm = act.get("set-community") or {}
+                set_ext = act.get("set-ext-community") or {}
+                set_large = act.get("set-large-community") or {}
                 pol.statements.append(
                     Statement(
                         sname,
                         Conditions(
                             prefix_set=cond.get("match-prefix-set"),
                             tag_set=cond.get("match-tag-set"),
+                            neighbor_set=cond.get("match-neighbor-set"),
                             community_set=cond.get("match-community-set"),
                             community_match=cond.get(
                                 "community-match-options", "any"
                             ),
+                            ext_community_set=cond.get(
+                                "match-ext-community-set"
+                            ),
+                            ext_community_match=cond.get(
+                                "ext-community-match-options", "any"
+                            ),
+                            large_community_set=cond.get(
+                                "match-large-community-set"
+                            ),
+                            large_community_match=cond.get(
+                                "large-community-match-options", "any"
+                            ),
+                            as_path_set=cond.get("match-as-path-set"),
+                            nexthop_set=cond.get("match-next-hop-set"),
+                            med=cond.get("med"),
+                            local_pref=cond.get("local-pref"),
+                            as_path_len=cond.get("as-path-length"),
+                            community_count=cond.get("community-count"),
+                            origin=cond.get("origin-eq"),
                         ),
                         Actions(
                             result=result,
@@ -236,6 +453,24 @@ class PolicyEngine:
                             set_communities_method=set_comm.get(
                                 "method", "add"
                             ),
+                            set_ext_communities=tuple(
+                                parse_ext_community(m)
+                                for m in set_ext.get("communities", [])
+                            ),
+                            set_ext_communities_method=set_ext.get(
+                                "method", "add"
+                            ),
+                            set_large_communities=tuple(
+                                parse_large_community(m)
+                                for m in set_large.get("communities", [])
+                            ),
+                            set_large_communities_method=set_large.get(
+                                "method", "add"
+                            ),
+                            set_origin=act.get("set-route-origin"),
+                            set_nexthop=act.get("set-next-hop"),
+                            set_med=act.get("set-med"),
+                            as_path_prepend=act.get("set-as-path-prepend"),
                         ),
                     )
                 )
@@ -247,35 +482,114 @@ class PolicyEngine:
             return PolicyResult.ACCEPT  # no policy = accept untouched
         return pol.evaluate(ctx, self.sets)
 
-    def bgp_import_hook(self, policy_name: str):
+    def bgp_import_hook(self, policy_name: str, neighbor=None):
         """Adapter: BGP PeerConfig.import_policy/export_policy callable.
 
-        Works on either attrs flavor — ``PathAttrs.communities`` (wire
-        slice) or ``BaseAttrs.comm`` (engine) — whichever field exists.
+        Works on either attrs flavor — ``PathAttrs`` (wire slice, flat
+        tuple as_path / enum origin) or ``BaseAttrs`` (engine, segment
+        as_path / string origin) — whichever fields exist.  ``neighbor``
+        scopes match-neighbor-set conditions to the owning peer.
         """
 
         def hook(prefix, attrs):
-            comm_field = (
-                "communities" if hasattr(attrs, "communities") else "comm"
-            )
+            from dataclasses import replace
+
+            wire = hasattr(attrs, "communities")
+            comm_field = "communities" if wire else "comm"
+            ext_field = "ext_communities" if wire else "ext_comm"
+            large_field = "large_communities" if wire else "large_comm"
+            if wire:
+                flat_path = tuple(attrs.as_path)
+                origin = attrs.origin.name.lower()
+            else:
+                flat_path = tuple(
+                    asn for seg in attrs.as_path for asn in seg.members
+                )
+                origin = attrs.origin.lower()
+            def canon_ext(v):
+                # ctx holds canonical 8-byte values in both flavors (the
+                # engine's JSON shape carries hex strings); values that
+                # don't canonicalize stay raw and simply never match.
+                try:
+                    return parse_ext_community(v)
+                except (ValueError, TypeError):
+                    return v
+
             ctx = RouteContext(
                 prefix=prefix,
                 protocol="bgp",
                 metric=attrs.med,
                 local_pref=attrs.local_pref,
                 communities=set(getattr(attrs, comm_field, ()) or ()),
+                ext_communities={
+                    canon_ext(v)
+                    for v in (getattr(attrs, ext_field, ()) or ())
+                },
+                large_communities=set(
+                    tuple(c) for c in (getattr(attrs, large_field, ()) or ())
+                ),
+                as_path=flat_path,
+                origin=origin,
+                nexthop=(
+                    str(n) if (n := getattr(attrs, "nexthop", None)
+                               or getattr(attrs, "next_hop", None))
+                    is not None else None
+                ),
+                neighbor=str(neighbor) if neighbor is not None else None,
             )
             if self.apply(policy_name, ctx) == PolicyResult.REJECT:
                 return None
-            from dataclasses import replace
-
             # ctx carries the (possibly edited) values verbatim — a
             # set-metric of 0 sticks.
-            return replace(
+            ext_out = tuple(
+                sorted(
+                    v if wire else (v.hex() if isinstance(v, bytes) else v)
+                    for v in ctx.ext_communities
+                )
+            )
+            out = replace(
                 attrs,
                 med=ctx.metric,
                 local_pref=ctx.local_pref,
-                **{comm_field: tuple(sorted(ctx.communities))},
+                **{
+                    comm_field: tuple(sorted(ctx.communities)),
+                    ext_field: ext_out,
+                    large_field: tuple(sorted(ctx.large_communities)),
+                },
             )
+            # as-path prepends: re-apply through each flavor's native shape.
+            if ctx.as_path != flat_path:
+                n_new = len(ctx.as_path) - len(flat_path)
+                prepended = ctx.as_path[:n_new]
+                if wire:
+                    out = replace(out, as_path=prepended + out.as_path)
+                else:
+                    for asn in reversed(prepended):
+                        out = out.as_path_prepend(asn)
+            if ctx.origin != origin:
+                if wire:
+                    from holo_tpu.protocols.bgp import Origin
+
+                    out = replace(
+                        out, origin=Origin[ctx.origin.upper()]
+                    )
+                else:
+                    out = replace(out, origin=ctx.origin.capitalize())
+            if ctx.nexthop is not None and ctx.nexthop != "self":
+                # ("self" resolves at export time, where the local
+                # address is known — a no-op on the import side.)
+                cur = (getattr(attrs, "nexthop", None)
+                       or getattr(attrs, "next_hop", None))
+                if str(cur) != ctx.nexthop:
+                    from ipaddress import ip_address
+
+                    nh = ip_address(ctx.nexthop)
+                    if nh.version != prefix.version:
+                        pass  # family mismatch would corrupt NEXT_HOP
+                    elif wire:
+                        out = replace(out, next_hop=nh)
+                    else:
+                        out = replace(out, nexthop=str(nh))
+            return out
 
         return hook

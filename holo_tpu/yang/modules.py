@@ -61,12 +61,40 @@ def keychains_module():
 
 
 def routing_policy_module():
+    # BGP augmentations mirror the reference's BgpMatchSets /
+    # BgpPolicyCondition / BgpPolicyAction surface
+    # (holo-utils/src/policy.rs:139-386).
+    match_options = ("any", "all", "invert")
+
+    def _cmp_cond(name):
+        return C(name, _leaf("value", "uint32"),
+                 _leaf("op", "enum", enum=("eq", "le", "ge")))
+
+    def _set_comm(name):
+        return C(
+            name,
+            _leaf("method", "enum", enum=("add", "remove", "replace")),
+            LeafList("communities", "string"),
+        )
+
     return C(
         "routing-policy",
         C(
             "defined-sets",
             L("prefix-set", "name", _leaf("name"), LeafList("prefix", "prefix")),
             L("tag-set", "name", _leaf("name"), LeafList("tag", "uint32")),
+            L("neighbor-set", "name", _leaf("name"),
+              LeafList("address", "string")),
+            L("community-set", "name", _leaf("name"),
+              LeafList("member", "string")),
+            L("ext-community-set", "name", _leaf("name"),
+              LeafList("member", "string")),
+            L("large-community-set", "name", _leaf("name"),
+              LeafList("member", "string")),
+            L("as-path-set", "name", _leaf("name"),
+              LeafList("member", "uint32")),
+            L("next-hop-set", "name", _leaf("name"),
+              LeafList("address", "string")),
         ),
         L(
             "policy-definition",
@@ -80,6 +108,24 @@ def routing_policy_module():
                     "conditions",
                     _leaf("match-prefix-set"),
                     _leaf("match-tag-set"),
+                    _leaf("match-neighbor-set"),
+                    _leaf("match-community-set"),
+                    _leaf("community-match-options", "enum",
+                          enum=match_options),
+                    _leaf("match-ext-community-set"),
+                    _leaf("ext-community-match-options", "enum",
+                          enum=match_options),
+                    _leaf("match-large-community-set"),
+                    _leaf("large-community-match-options", "enum",
+                          enum=match_options),
+                    _leaf("match-as-path-set"),
+                    _leaf("match-next-hop-set"),
+                    _cmp_cond("med"),
+                    _cmp_cond("local-pref"),
+                    _cmp_cond("as-path-length"),
+                    _cmp_cond("community-count"),
+                    _leaf("origin-eq", "enum",
+                          enum=("igp", "egp", "incomplete")),
                 ),
                 C(
                     "actions",
@@ -87,6 +133,20 @@ def routing_policy_module():
                           enum=("accept-route", "reject-route")),
                     _leaf("set-metric", "uint32"),
                     _leaf("set-tag", "uint32"),
+                    _leaf("set-local-pref", "uint32"),
+                    _set_comm("set-community"),
+                    _set_comm("set-ext-community"),
+                    _set_comm("set-large-community"),
+                    _leaf("set-route-origin", "enum",
+                          enum=("igp", "egp", "incomplete")),
+                    _leaf("set-next-hop", "string"),
+                    C("set-med",
+                      _leaf("set", "uint32"),
+                      _leaf("add", "uint32"),
+                      _leaf("subtract", "uint32")),
+                    C("set-as-path-prepend",
+                      _leaf("asn", "uint32"),
+                      _leaf("repeat", "uint8")),
                 ),
             ),
         ),

@@ -190,3 +190,113 @@ def test_community_set_match_and_set_actions():
     out = hook(N("10.2.0.0/24"), PathAttrs(communities=(1,)))
     assert out is not None and out.communities == (parse_community("65000:999"),)
     assert hook(N("10.3.0.0/24"), tagged) is None  # tagged inverted away
+
+
+def test_bgp_condition_and_action_surface():
+    """Reference BgpPolicyCondition/-Action parity
+    (holo-utils/src/policy.rs:259-386): comparisons, as-path sets,
+    neighbor sets, prepend, set-med arithmetic, origin/nexthop edits."""
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.protocols.bgp import Origin, PathAttrs
+    from holo_tpu.utils.policy import PolicyEngine, parse_large_community
+
+    eng = PolicyEngine()
+    eng.load_from_config(
+        {
+            "defined-sets": {
+                "as-path-set": {"upstreams": {"member": [65100, 65200]}},
+                "neighbor-set": {"edge": {"address": ["10.0.0.9"]}},
+                "large-community-set": {
+                    "lc": {"member": ["65001:1:2"]},
+                },
+            },
+            "policy-definition": {
+                "shape": {
+                    "statement": {
+                        "10-prepend-upstream": {
+                            "conditions": {
+                                "match-as-path-set": "upstreams",
+                                "med": {"value": 50, "op": "le"},
+                            },
+                            "actions": {
+                                "set-as-path-prepend": {"asn": 65001, "repeat": 2},
+                                "set-med": {"add": 10},
+                                "set-route-origin": "incomplete",
+                                "set-next-hop": "192.0.2.9",
+                                "set-large-community": {
+                                    "method": "add",
+                                    "communities": ["65001:1:2"],
+                                },
+                                "policy-result": "accept-route",
+                            },
+                        },
+                        "20-neighbor-gate": {
+                            "conditions": {"match-neighbor-set": "edge"},
+                            "actions": {"policy-result": "accept-route"},
+                        },
+                        "30-long-paths": {
+                            "conditions": {
+                                "as-path-length": {"value": 5, "op": "ge"}
+                            },
+                            "actions": {"policy-result": "reject-route"},
+                        },
+                    }
+                }
+            },
+        }
+    )
+    hook = eng.bgp_import_hook("shape", neighbor="10.0.0.9")
+    # Statement 10: as-path set + med<=50 -> prepend, med+=10, origin,
+    # nexthop, large community.
+    attrs = PathAttrs(Origin.IGP, (65100,), med=20)
+    out = hook(N("10.0.0.0/24"), attrs)
+    assert out.as_path == (65001, 65001, 65100)
+    assert out.med == 30
+    assert out.origin == Origin.INCOMPLETE
+    assert str(out.next_hop) == "192.0.2.9"
+    assert parse_large_community("65001:1:2") in out.large_communities
+    # Statement 20: falls through 10 (med too high), matches neighbor set.
+    out2 = hook(N("10.1.0.0/24"), PathAttrs(Origin.IGP, (65300,), med=500))
+    assert out2 is not None and out2.as_path == (65300,)
+    # A 5-hop path from a non-edge neighbor falls to statement 30: reject.
+    hook_other = eng.bgp_import_hook("shape", neighbor="10.0.0.1")
+    long_path = PathAttrs(Origin.IGP, (1, 2, 3, 4, 5), med=500)
+    assert hook_other(N("10.2.0.0/24"), long_path) is None
+
+
+def test_engine_attrs_through_hook():
+    """The same hook drives the engine's segment-shaped BaseAttrs."""
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.protocols.bgp_engine import AsSegment, BaseAttrs
+    from holo_tpu.utils.policy import PolicyEngine
+
+    eng = PolicyEngine()
+    eng.load_from_config(
+        {
+            "policy-definition": {
+                "p": {
+                    "statement": {
+                        "10": {
+                            "conditions": {"origin-eq": "igp"},
+                            "actions": {
+                                "set-as-path-prepend": {"asn": 65009},
+                                "set-route-origin": "egp",
+                                "policy-result": "accept-route",
+                            },
+                        }
+                    }
+                }
+            }
+        }
+    )
+    hook = eng.bgp_import_hook("p")
+    attrs = BaseAttrs(
+        origin="Igp",
+        as_path=(AsSegment("Sequence", (65100,)),),
+        nexthop="10.0.0.1",
+    )
+    out = hook(N("10.0.0.0/24"), attrs)
+    assert out.origin == "Egp"
+    assert out.as_path[0].members == (65009, 65100)
