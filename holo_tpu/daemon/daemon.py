@@ -20,6 +20,7 @@ from holo_tpu.daemon.providers import (
     SystemProvider,
 )
 from holo_tpu.northbound.core import Northbound
+from holo_tpu.northbound.provider import Provider as NbProvider
 from holo_tpu.routing.rib import Kernel
 from holo_tpu.utils.ibus import Ibus
 from holo_tpu.utils.netio import MockFabric, NetIo
@@ -119,7 +120,8 @@ class Daemon:
         db = Path(self.config.db_path) if self.config.db_path else None
         self.northbound = Northbound(
             full_schema(),
-            [self.interface, self.keychain, self.policy, self.system, self.routing],
+            [self.interface, self.keychain, self.policy, self.system,
+             self.routing, _RuntimeStateProvider(self)],
             db_path=db,
         )
         self._grpc_server = None
@@ -275,6 +277,33 @@ class Daemon:
         self.instance_loops.clear()
 
 
+class _RuntimeStateProvider(NbProvider):
+    """Scheduler introspection served as operational state — the
+    always-on analog of the reference's optional tokio-console runtime
+    instrumentation (holo-daemon/src/main.rs:115-133).  Read-only: it
+    owns no config subtree and vetoes nothing (base-class defaults)."""
+
+    name = "runtime"
+
+    def __init__(self, daemon: "Daemon"):
+        self._daemon = daemon
+
+    def filter_changes(self, changes):
+        return []  # no config subtree: never part of a commit fan-out
+
+    def get_state(self, path: str | None = None) -> dict:
+        if path and not "holo-runtime".startswith(path.split("/")[0]):
+            return {}
+        d = self._daemon
+        out = {"main-loop": d.loop.introspect()}
+        if d.instance_loops:
+            out["instance-loops"] = {
+                name: tl.introspect()
+                for name, tl in d.instance_loops.items()
+            }
+        return {"holo-runtime": out}
+
+
 def _resolve_level(level, fallback: int, what: str) -> int:
     """Level-name → logging constant.  "trace" maps to DEBUG (Python
     logging's most verbose level); an unknown name is a config error
@@ -390,7 +419,12 @@ def main(argv=None):
     stopping = []
     from holo_tpu.daemon import hardening as _h
 
-    _h.install_signal_handlers(lambda: stopping.append(True))
+    _h.install_signal_handlers(
+        lambda: stopping.append(True),
+        dump_cb=lambda: daemon.northbound.get_state("holo-runtime").get(
+            "holo-runtime"
+        ),
+    )
     try:
         import time
 

@@ -94,9 +94,10 @@ def drop_privileges(user: str) -> None:
     )
 
 
-def install_signal_handlers(shutdown_cb) -> None:
+def install_signal_handlers(shutdown_cb, dump_cb=None) -> None:
     """SIGINT/SIGTERM -> orderly shutdown; SIGHUP ignored (config is
-    transactional via the northbound, not file reload)."""
+    transactional via the northbound, not file reload); SIGUSR1 ->
+    runtime-introspection dump to the log when ``dump_cb`` is given."""
 
     def _handler(signum, _frame):
         log.info("signal %s: shutting down", signal.Signals(signum).name)
@@ -105,3 +106,11 @@ def install_signal_handlers(shutdown_cb) -> None:
     signal.signal(signal.SIGINT, _handler)
     signal.signal(signal.SIGTERM, _handler)
     signal.signal(signal.SIGHUP, signal.SIG_IGN)
+    if dump_cb is not None:
+        def _dump(_signum, _frame):
+            try:
+                log.info("runtime introspection: %s", dump_cb())
+            except Exception:  # never let a diagnostics hook kill us
+                log.exception("runtime dump failed")
+
+        signal.signal(signal.SIGUSR1, _dump)

@@ -1186,6 +1186,8 @@ class RoutingProvider(Provider, Actor):
                         if n.get("authentication-key")
                         else None
                     ),
+                    # 0 means "not configured" (the uint8 leaf default).
+                    ttl_security=n.get("ttl-security") or None,
                 )
             inst.start_peer(addr)
         # Neighbors removed from config: drop the session + their routes.

@@ -587,7 +587,11 @@ class PolicyEngine:
                     if nh.version != prefix.version:
                         pass  # family mismatch would corrupt NEXT_HOP
                     elif wire:
-                        out = replace(out, next_hop=nh)
+                        # v6 rides in MP_REACH (nh6); v4 in NEXT_HOP.
+                        if nh.version == 6:
+                            out = replace(out, nh6=nh)
+                        else:
+                            out = replace(out, next_hop=nh)
                     else:
                         out = replace(out, nexthop=str(nh))
             return out

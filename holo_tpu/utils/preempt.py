@@ -83,6 +83,15 @@ class ThreadedLoop:
         with self._lock:
             self.loop.unregister(_Call.name)
 
+    def introspect(self) -> dict:
+        """Snapshot of the inner loop plus thread liveness.  Taken under
+        the loop lock: register/unregister mutate the actor dict from
+        other threads, and iterating it unlocked could see a resize."""
+        with self._lock:
+            out = self.loop.introspect()
+        out["thread-alive"] = self._thread.is_alive()
+        return out
+
     def stop(self) -> None:
         with self._wake:
             self._stop = True

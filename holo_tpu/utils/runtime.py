@@ -137,6 +137,7 @@ class EventLoop:
         self._crashed: dict[str, BaseException] = {}
         self._supervisor: Callable[[ActorCrashed], None] | None = None
         self._stopping = False
+        self._delivered: dict[str, int] = {}
 
     # -- actors
 
@@ -153,6 +154,7 @@ class EventLoop:
         actor = self.actors.pop(name, None)
         self._inboxes.pop(name, None)
         self._crashed.pop(name, None)
+        self._delivered.pop(name, None)
         if actor is not None:
             actor.on_stop()
 
@@ -187,6 +189,32 @@ class EventLoop:
             heapq.heappop(self._timers)  # stale (canceled/reset)
         return None
 
+    # -- introspection
+
+    def introspect(self) -> dict:
+        """Live scheduler snapshot — the reference gates the equivalent
+        behind its tokio_console feature (holo-daemon/src/main.rs:115-133);
+        here it is always-on state the management plane can serve."""
+        now = self.clock.now()
+        armed = sum(
+            1 for e in self._timers if e.timer._armed_seq == e.seq
+        )
+        nd = self.next_deadline()
+        return {
+            "actors": {
+                name: {
+                    "inbox-depth": len(self._inboxes.get(name, ())),
+                    "messages-delivered": self._delivered.get(name, 0),
+                    "crashed": name in self._crashed,
+                }
+                for name in self.actors
+            },
+            "timers-armed": armed,
+            "next-timer-in-ms": (
+                round(max(nd - now, 0.0) * 1e3, 1) if nd is not None else None
+            ),
+        }
+
     # -- scheduling
 
     def _deliver_one(self) -> bool:
@@ -199,6 +227,7 @@ class EventLoop:
             actor = self.actors.get(name)
             if actor is None:
                 continue
+            self._delivered[name] = self._delivered.get(name, 0) + 1
             try:
                 actor.handle(msg)
             except Exception as exc:  # crash containment

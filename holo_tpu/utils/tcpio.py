@@ -76,11 +76,43 @@ class _PeerSlot:
     local_ip: object
     ifname: str
     md5_key: bytes | None = None
+    # GTSM (RFC 5082, reference network.rs:107-141): when set to the
+    # expected hop budget, we send TTL 255 and require received TTL
+    # >= 255 - hops + 1 via IP_MINTTL.
+    ttl_security: int | None = None
     sock: socket.socket | None = None  # established connection
     connecting: socket.socket | None = None
     rxbuf: bytearray = field(default_factory=bytearray)
     txbuf: bytearray = field(default_factory=bytearray)
     active: bool = False  # we initiate (local > peer)
+
+
+_TTL_MAX = 255
+IP_MINTTL = 21  # Linux setsockopt optname (IPPROTO_IP level)
+IPV6_MINHOPCOUNT = 73
+
+
+def _apply_gtsm(s: socket.socket, slot: "_PeerSlot") -> None:
+    """Max out the sent TTL and enforce the received floor (RFC 5082)."""
+    if slot.ttl_security is None:
+        return
+    minttl = _TTL_MAX - slot.ttl_security + 1
+    if isinstance(slot.peer_ip, IPv6Address):
+        s.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_UNICAST_HOPS, _TTL_MAX)
+        s.setsockopt(socket.IPPROTO_IPV6, IPV6_MINHOPCOUNT, minttl)
+    else:
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_TTL, _TTL_MAX)
+        s.setsockopt(socket.IPPROTO_IP, IP_MINTTL, minttl)
+
+
+def _listener_max_ttl(s: socket.socket, v6: bool) -> None:
+    """A GTSM peer's MINTTL would drop our SYN-ACKs if the listener sent
+    them at the default TTL — listeners send at 255 once any peer has
+    ttl-security (reference network.rs:43)."""
+    if v6:
+        s.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_UNICAST_HOPS, _TTL_MAX)
+    else:
+        s.setsockopt(socket.IPPROTO_IP, socket.IP_TTL, _TTL_MAX)
 
 
 class BgpTcpIo(NetIo):
@@ -117,14 +149,22 @@ class BgpTcpIo(NetIo):
         for slot in self.peers.values():
             if slot.md5_key and slot.local_ip == ip:
                 set_md5sig(s, slot.peer_ip, slot.md5_key)
+            if slot.ttl_security is not None:
+                _listener_max_ttl(s, isinstance(ip, IPv6Address))
 
-    def add_peer(self, local_ip, peer_ip, ifname: str = "tcp", md5_key=None):
+    def add_peer(self, local_ip, peer_ip, ifname: str = "tcp", md5_key=None,
+                 ttl_security: int | None = None):
+        if ttl_security is not None and not 1 <= ttl_security <= 255:
+            raise ValueError(
+                f"ttl_security hops must be 1-255, got {ttl_security}"
+            )
         lip, pip = ip_address(local_ip), ip_address(peer_ip)
         slot = _PeerSlot(
             peer_ip=pip,
             local_ip=lip,
             ifname=ifname,
             md5_key=md5_key,
+            ttl_security=ttl_security,
             active=int(lip) > int(pip),
         )
         self.peers[pip] = slot
@@ -134,6 +174,11 @@ class BgpTcpIo(NetIo):
                     set_md5sig(ls, pip, slot.md5_key)
                 except OSError as e:
                     log.error("MD5 key install on listener failed: %s", e)
+            if slot.ttl_security is not None:
+                try:
+                    _listener_max_ttl(ls, isinstance(pip, IPv6Address))
+                except OSError as e:
+                    log.error("listener TTL bump failed: %s", e)
         return slot
 
     def remove_peer(self, peer_ip) -> None:
@@ -245,6 +290,7 @@ class BgpTcpIo(NetIo):
             s.bind((str(slot.local_ip), 0))
             if slot.md5_key:
                 set_md5sig(s, slot.peer_ip, slot.md5_key)
+            _apply_gtsm(s, slot)
             rc = s.connect_ex((str(slot.peer_ip), self.port))
             if rc not in (0, errno.EINPROGRESS):
                 s.close()
@@ -280,6 +326,12 @@ class BgpTcpIo(NetIo):
             s.close()  # unknown peer, or session already up
             return
         s.setblocking(False)
+        try:
+            _apply_gtsm(s, slot)
+        except OSError as e:
+            log.error("GTSM enforcement on inbound %s failed: %s", pip, e)
+            s.close()
+            return
         self._adopt(slot, s)
 
     def _adopt(self, slot: _PeerSlot, s: socket.socket) -> None:

@@ -241,6 +241,8 @@ def _bgp_subtree():
             _leaf("import-policy"),
             _leaf("export-policy"),
             _leaf("authentication-key"),  # TCP-MD5 (RFC 2385)
+            # GTSM (RFC 5082): expected hop budget; unset = disabled.
+            _leaf("ttl-security", "uint8"),
         ),
         L(
             "network",

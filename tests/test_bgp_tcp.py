@@ -269,3 +269,39 @@ def test_session_reset_allows_reestablishment():
     assert _drive(loop, ios, est, timeout=15.0), "did not re-establish"
     for io in ios:
         io.close()
+
+
+def test_gtsm_ttl_security_session():
+    """GTSM (RFC 5082, reference network.rs:107-141): with ttl-security
+    hops=1 both sides send TTL 255 and enforce MINTTL 255 — a loopback
+    direct session still forms (TTL undecremented), and the socket
+    options are verifiably applied."""
+    import socket as _socket
+
+    from holo_tpu.utils.tcpio import IP_MINTTL, _TTL_MAX
+
+    import ipaddress
+
+    loop = EventLoop(clock=RealClock())
+    r1, io1 = _mk_speaker(loop, "g1", 65001, "1.1.1.1", "127.0.9.1", port=PORT + 7)
+    r2, io2 = _mk_speaker(loop, "g2", 65002, "2.2.2.2", "127.0.9.2", port=PORT + 7)
+    for inst, io, lip, pip, ras in (
+        (r1, io1, "127.0.9.1", "127.0.9.2", 65002),
+        (r2, io2, "127.0.9.2", "127.0.9.1", 65001),
+    ):
+        cfg = PeerConfig(
+            addr=ipaddress.ip_address(pip), remote_as=ras, ifname="tcp",
+            hold_time=15, connect_retry=0.3,
+        )
+        inst.add_peer(cfg, ipaddress.ip_address(lip))
+        io.add_peer(lip, pip, ttl_security=1)
+        inst.start_peer(cfg.addr)
+    assert _drive(
+        loop, [io1, io2],
+        lambda: all(p.state == PeerState.ESTABLISHED
+                    for i in (r1, r2) for p in i.peers.values()),
+    ), "GTSM session failed to establish"
+    # The established socket carries the GTSM options.
+    slot = io1.peers[ipaddress.ip_address("127.0.9.2")]
+    assert slot.sock.getsockopt(_socket.IPPROTO_IP, _socket.IP_TTL) == _TTL_MAX
+    assert slot.sock.getsockopt(_socket.IPPROTO_IP, IP_MINTTL) == _TTL_MAX
