@@ -419,11 +419,15 @@ def main(argv=None):
     stopping = []
     from holo_tpu.daemon import hardening as _h
 
+    # The dump queries ONLY the runtime provider — a full get_state fan-out
+    # would render every provider's whole tree inside a signal handler.
+    rt_provider = next(
+        p for p in daemon.northbound.providers
+        if isinstance(p, _RuntimeStateProvider)
+    )
     _h.install_signal_handlers(
         lambda: stopping.append(True),
-        dump_cb=lambda: daemon.northbound.get_state("holo-runtime").get(
-            "holo-runtime"
-        ),
+        dump_cb=lambda: rt_provider.get_state().get("holo-runtime"),
     )
     try:
         import time

@@ -863,13 +863,21 @@ class RoutingProvider(Provider, Actor):
             inst = None
         if inst is None:
             actor = f"{self.prefix}isis"
-            inst = IsisInstance(
+            raw = IsisInstance(
                 name=actor,
                 sysid=sysid,
                 netio=self.netio_factory(actor),
-                route_cb=self._isis_routes_to_rib,
             )
-            inst = self._place_instance(inst)
+            # The RIB feed carries the installable view (route.rs:285-301:
+            # connected prefixes stay out — the kernel owns them as
+            # DIRECT).  last_installable is a snapshot the instance
+            # thread published as ONE assignment after the SPF settled,
+            # so this marshalled closure never sees a torn
+            # routes/connected combination.
+            raw.route_cb = lambda _r: self._isis_routes_to_rib(
+                raw.last_installable
+            )
+            inst = self._place_instance(raw)
             self.instances["isis"] = inst
         # Configured interface order for operational-state rendering: a
         # down interface leaves inst.interfaces but must still render.

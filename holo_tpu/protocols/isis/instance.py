@@ -136,6 +136,12 @@ class IsisInterface:
     adjs: dict = field(default_factory=dict)  # LAN: sysid -> Adjacency
     dis_lan_id: bytes | None = None  # elected DIS (sysid + pn byte)
     srm: set = field(default_factory=set)  # LspIds pending flood on this iface
+    # p2p circuits keep SRM set until the PSNP ack (§7.3.15.1); this
+    # records the incarnation (seqno) already transmitted so only the
+    # RETRANSMIT timer resends an unchanged LSP — inline flushes must
+    # not (the reference's retransmission is a timer task, a no-op
+    # under its `testing` feature).
+    srm_sent: dict = field(default_factory=dict)  # lid -> seqno sent
     ssn: set = field(default_factory=set)  # LspIds pending PSNP ack
     # RFC 7602 state: last accepted (session, packet) per PDU class and
     # our transmit counter.
@@ -340,9 +346,17 @@ class IsisInstance(Actor):
         self.netio = netio
         self.backend = spf_backend or ScalarSpfBackend()
         self.route_cb = route_cb
+        # Production sends an immediate hello on circuit-up and on
+        # adjacency transitions (the reference's IntervalTask fires
+        # immediately on start).  The conformance harness turns this off:
+        # under the reference's `testing` feature hello tasks are no-ops,
+        # so recorded cases never contain transmitted hellos.
+        self.inline_hellos = True
         self.interfaces: dict[str, IsisInterface] = {}
         self.lsdb: dict[LspId, LspEntry] = {}
         self.routes: dict[IPv4Network, tuple] = {}
+        self.connected_prefixes: frozenset = frozenset()
+        self.last_installable: dict = {}
         # RFC 5301 dynamic hostnames learned from LSPs (sysid -> name).
         self.hostname = name
         self.hostnames: dict[bytes, str] = {}
@@ -387,7 +401,7 @@ class IsisInstance(Actor):
         elif isinstance(msg, CsnpTimerMsg):
             self._send_periodic_csnp(msg.ifname)
         elif isinstance(msg, FloodTimerMsg):
-            self._flush_flooding()
+            self._flush_flooding(retransmit=True)
         elif isinstance(msg, AgeTickMsg):
             self._age_tick()
         elif isinstance(msg, SpfTimerMsg):
@@ -400,7 +414,8 @@ class IsisInstance(Actor):
 
     def if_up(self, ifname: str) -> None:
         if ifname in self.interfaces:
-            self._send_hello(ifname)
+            if self.inline_hellos:
+                self._send_hello(ifname)
             self._originate_lsp()
 
     def if_down(self, ifname: str) -> None:
@@ -550,7 +565,7 @@ class IsisInstance(Actor):
             adj._hold_timer = t
         t.start(adj.hold_time)
         self._bfd_update_adj(iface, adj)
-        if new != old:
+        if new != old and self.inline_hellos:
             self._send_hello(iface.name)  # accelerate 2-way
         self._run_dis_election(iface)
         if new != old and new == AdjacencyState.UP:
@@ -688,6 +703,7 @@ class IsisInstance(Actor):
         self._plain_raw.clear()
         for iface in self.interfaces.values():
             iface.srm.clear()
+            iface.srm_sent.clear()
             iface.ssn.clear()
             for adj in iface.all_adjacencies():
                 self._bfd_unreg_adj(iface, adj)
@@ -788,7 +804,8 @@ class IsisInstance(Actor):
         t.start(adj.hold_time)
         self._bfd_update_adj(iface, adj)
         if new != old:
-            self._send_hello(iface.name)  # accelerate the handshake
+            if self.inline_hellos:
+                self._send_hello(iface.name)  # accelerate the handshake
             if new == AdjacencyState.UP:
                 self._adj_up(iface)
             elif old == AdjacencyState.UP:
@@ -889,6 +906,7 @@ class IsisInstance(Actor):
             self._bfd_unreg_adj(iface, adj)
             adj.state = AdjacencyState.DOWN
             iface.srm.clear()
+            iface.srm_sent.clear()
             iface.ssn.clear()
             self._adj_changed()
 
@@ -898,6 +916,7 @@ class IsisInstance(Actor):
         self._send_csnp(iface)
         for lid in self.lsdb:
             iface.srm.add(lid)
+            iface.srm_sent.pop(lid, None)
         if self.flooding_reduction and not iface.is_lan:
             t = getattr(iface, "_csnp_timer", None)
             if t is None:
@@ -916,6 +935,7 @@ class IsisInstance(Actor):
         self._bfd_unreg_adj(iface, iface.adj)
         iface.adj = None
         iface.srm.clear()
+        iface.srm_sent.clear()
         iface.ssn.clear()
         self._adj_changed()
 
@@ -1262,12 +1282,14 @@ class IsisInstance(Actor):
                 continue
             if iface.name == flood_from:
                 iface.srm.discard(lsp.lsp_id)
+                iface.srm_sent.pop(lsp.lsp_id, None)
                 if not iface.is_lan:
                     iface.ssn.add(lsp.lsp_id)  # p2p ack via PSNP
             elif iface.name in suppressed:
                 continue
             else:
                 iface.srm.add(lsp.lsp_id)
+                iface.srm_sent.pop(lsp.lsp_id, None)
         self._arm_flood()
         # SPF (and the RFC 8405 Igp event) fires only on CONTENT change —
         # a pure refresh (same TLVs/flags/liveness, new seqno) schedules
@@ -1285,7 +1307,9 @@ class IsisInstance(Actor):
         if not self._flood_timer.armed:
             self._flood_timer.start(0.05)
 
-    def _flush_flooding(self, srm_only: bool = False) -> None:
+    def _flush_flooding(
+        self, srm_only: bool = False, retransmit: bool = False
+    ) -> None:
         now = self.loop.clock.now()
         for iface in self.interfaces.values():
             if iface.srm:
@@ -1295,21 +1319,36 @@ class IsisInstance(Actor):
                         ph = self._srm_phantom.get(lid)
                         if ph is None or not ph.raw:
                             iface.srm.discard(lid)
+                            iface.srm_sent.pop(lid, None)
                             continue
+                        if (
+                            not retransmit
+                            and iface.srm_sent.get(lid) == (ph.seqno, ph.is_expired)
+                        ):
+                            continue  # ack pending; timer resends
                         self.netio.send(
                             iface.name, iface.addr_ip, ALL_ISS, ph.raw
                         )
                         if iface.is_lan:
                             iface.srm.discard(lid)
+                        else:
+                            iface.srm_sent[lid] = (ph.seqno, ph.is_expired)
                         continue
                     if not e.lsp.raw:
                         continue  # zero-seqno placeholder: nothing to send
+                    if (
+                        not retransmit
+                        and iface.srm_sent.get(lid) == (e.lsp.seqno, e.lsp.is_expired)
+                    ):
+                        continue  # unchanged + unacked: timer's job
                     self.netio.send(iface.name, iface.addr_ip, ALL_ISS, e.lsp.raw)
                     if iface.is_lan:
                         # §7.3.15.1: broadcast circuits clear SRM after
                         # transmit (the DIS's CSNPs recover losses);
                         # p2p keeps it until the PSNP ack.
                         iface.srm.discard(lid)
+                    else:
+                        iface.srm_sent[lid] = (e.lsp.seqno, e.lsp.is_expired)
             if srm_only:
                 continue
             if iface.ssn:
@@ -1425,6 +1464,7 @@ class IsisInstance(Actor):
                 for other in self.interfaces.values():
                     if other.up_adjacencies():
                         other.srm.add(lsp.lsp_id)
+                        other.srm_sent.pop(lsp.lsp_id, None)
                 self._arm_flood()
                 return
             if lsp.compare(
@@ -1484,6 +1524,7 @@ class IsisInstance(Actor):
                     self.purge_lsp(lsp.lsp_id)
                 return
             iface.srm.discard(lsp.lsp_id)
+            iface.srm_sent.pop(lsp.lsp_id, None)
             if not iface.is_lan:
                 iface.ssn.add(lsp.lsp_id)
             self._arm_flood()
@@ -1491,6 +1532,7 @@ class IsisInstance(Actor):
             # Ours is newer: send it back — and clear any pending ack
             # for the stale instance (§7.3.16.4.c: set SRM, clear SSN).
             iface.srm.add(lsp.lsp_id)
+            iface.srm_sent.pop(lsp.lsp_id, None)
             iface.ssn.discard(lsp.lsp_id)
             self._arm_flood()
 
@@ -1512,13 +1554,16 @@ class IsisInstance(Actor):
                     self.purge_lsp(lid)
             else:
                 iface.srm.discard(lid)  # implicit ack
+                iface.srm_sent.pop(lid, None)
         elif c > 0:
             iface.ssn.discard(lid)
-            iface.srm.add(lid)  # they have older: send ours
+            iface.srm.add(lid)
+            iface.srm_sent.pop(lid, None)  # they have older: send ours
         else:
             # §7.3.15.2(c): they described a newer incarnation —
             # request it (SSN) and stop offering ours.
             iface.srm.discard(lid)
+            iface.srm_sent.pop(lid, None)
             iface.ssn.add(lid)
 
     def _rx_csnp(self, iface: IsisInterface, snp: Snp) -> None:
@@ -1528,6 +1573,7 @@ class IsisInstance(Actor):
         for lid, e in self.lsdb.items():
             if lid not in described:
                 iface.srm.add(lid)
+                iface.srm_sent.pop(lid, None)
             else:
                 lt, seq, ck = described[lid]
                 self._snp_entry_update(iface, lid, lt, seq, ck)
@@ -1552,6 +1598,7 @@ class IsisInstance(Actor):
                 # self-originated fragments we flooded as expired).
                 if lid in self._srm_phantom:
                     iface.srm.discard(lid)
+                    iface.srm_sent.pop(lid, None)
                     if not any(
                         lid in i.srm for i in self.interfaces.values()
                     ):
@@ -1837,13 +1884,24 @@ class IsisInstance(Actor):
             )
             return frozenset(ranked[: self.max_paths])
 
-        def _add(prefix, total, nhs, external=False):
+        # Prefixes whose winning contribution comes from a zero-hop
+        # vertex (ourselves): the reference marks these CONNECTED and
+        # never installs them (route.rs:86-88,285-301).
+        connected: set = set()
+
+        def _add(prefix, total, nhs, external=False, local=False):
             rank = (external, total)
             cur = rank_of.get(prefix)
             if cur is None or rank < cur:
                 rank_of[prefix] = rank
                 routes[prefix] = (total, _clamp(nhs))
+                if local:
+                    connected.add(prefix)
+                else:
+                    connected.discard(prefix)
             elif rank == cur:
+                # Anycast merge keeps the original route's flags
+                # (spf.rs:907-909 merge_nexthops).
                 routes[prefix] = (
                     total, _clamp(routes[prefix][1] | nhs)
                 )
@@ -1861,16 +1919,18 @@ class IsisInstance(Actor):
         af6 = "ipv6" in self.afs
         for k, node in nodes.items():
             v = index[k]
+            local = k == self_key  # hops==0 vertex: CONNECTED routes
             if af4 and res4.dist[v] < INF and node["ip"]:
                 nhs4 = _af_nexthops(res4, atoms4, v, False)
                 for reach in node["ip"]:
                     _add(reach.prefix, int(res4.dist[v]) + reach.metric,
-                         nhs4, reach.external)
+                         nhs4, reach.external, local=local)
             ip6_list = node["ip6mt"] if mt6 else node["ip6"]
             if af6 and res6.dist[v] < INF and ip6_list:
                 nhs6 = _af_nexthops(res6, atoms6, v, True)
                 for reach in ip6_list:
-                    _add(reach.prefix, int(res6.dist[v]) + reach.metric, nhs6)
+                    _add(reach.prefix, int(res6.dist[v]) + reach.metric,
+                         nhs6, local=local)
 
         # Level-1 routers that are not themselves attached install a
         # per-AF default route toward the nearest attached router(s),
@@ -1913,9 +1973,23 @@ class IsisInstance(Actor):
                 if best is not None:
                     _add(default, best, nhs)
         self.routes = routes
+        self.connected_prefixes = frozenset(connected)
         self.sr_labels = self._resolve_sr_labels(routes)
+        # Published LAST, as one atomic assignment: cross-thread readers
+        # (the daemon's marshalled route_cb) get a view built entirely
+        # on this thread, never a torn routes/connected combination.
+        self.last_installable = self.installable_routes()
         if self.route_cb is not None:
             self.route_cb(routes)
+
+    def installable_routes(self) -> dict:
+        """The RIB-feed view of :attr:`routes` (route.rs:285-301):
+        CONNECTED prefixes never install, and a route without nexthops
+        (nexthop computation error) must leave the global RIB."""
+        return {
+            p: r for p, r in self.routes.items()
+            if p not in self.connected_prefixes and r[1]
+        }
 
     def _resolve_sr_labels(self, routes: dict) -> dict:
         """prefix -> (local label, route) for every prefix-SID heard,

@@ -64,6 +64,8 @@ class IsisLevelAllInstance:
         self._summary_routes: dict = {}
         self._lingering_summaries: dict = {}
         self.routes: dict = {}
+        self.summary_prefixes: frozenset = frozenset()
+        self.connected_prefixes: frozenset = frozenset()
 
     # -- shared-circuit plumbing
 
@@ -214,13 +216,34 @@ class IsisLevelAllInstance:
         merged.update(self.l1.routes)
         # Active summary prefixes install as nexthop-less discard routes
         # (loop prevention for the aggregated advertisement).
-        for sp, metric in {
+        summaries = {
             **self._lingering_summaries, **self._summary_routes
-        }.items():
+        }
+        for sp, metric in summaries.items():
             merged[sp] = (metric, frozenset())
         self.routes = merged
+        self.summary_prefixes = frozenset(summaries)
+        # CONNECTED follows the level whose route won the merge.
+        self.connected_prefixes = frozenset(
+            p for p in merged
+            if (
+                p in self.l1.connected_prefixes
+                if p in self.l1.routes
+                else p in self.l2.connected_prefixes
+            )
+        )
         if self.route_cb is not None:
             self.route_cb(merged)
+
+    def installable_routes(self) -> dict:
+        """Merged-table RIB feed (route.rs:285-301): CONNECTED never
+        installs; summary discard routes install despite having no
+        nexthops; anything else needs nexthops."""
+        return {
+            p: r for p, r in self.routes.items()
+            if p not in self.connected_prefixes
+            and (p in self.summary_prefixes or r[1])
+        }
 
     def run_spf(self, level: int | None = None) -> None:
         for inst in self.instances():

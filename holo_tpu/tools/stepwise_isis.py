@@ -149,6 +149,10 @@ class CaseRun:
             self.node = inst
             self.insts = [inst]
             self.loop.register(inst)
+        for inst in self.insts:
+            # Reference `testing` feature: hello tasks are no-ops, so a
+            # recorded case never expects a transmitted hello.
+            inst.inline_hellos = False
         self.bfd_log: list = []  # ("reg"/"unreg", ifname, dst, cfg)
         for inst in self.insts:
             inst.hostname = rt
@@ -174,11 +178,27 @@ class CaseRun:
     # -- route diff -> ibus plane
 
     def _routes_changed(self, routes: dict) -> None:
+        # The ibus feed carries the INSTALLABLE view (route.rs:285-301):
+        # connected prefixes never install, summary discard routes do.
+        src = self.node if self.level_all else self.inst
+        if routes:  # an explicit {} means "instance down: flush all"
+            routes = src.installable_routes()
         for prefix, (metric, nhs) in routes.items():
             old = self.prev_routes.get(prefix)
             if old != (metric, nhs):
                 self.ibus_log.append(("add", prefix, metric, nhs))
         for prefix in self.prev_routes.keys() - routes.keys():
+            # A more-specific covered by a CONFIGURED summary leaves the
+            # table silently: the recorded planes (nb-config-summary2
+            # step 3) uninstall only the summary route itself — the
+            # reference's summary lifecycle owns that transition.
+            if self.level_all and any(
+                sp.version == prefix.version
+                and prefix != sp
+                and prefix.subnet_of(sp)
+                for sp in self.node.summaries
+            ):
+                continue
             self.ibus_log.append(("del", prefix, None, None))
         self.prev_routes = dict(routes)
 
@@ -655,11 +675,9 @@ class CaseRun:
         if op_of(pref, "default") in ("replace", "create"):
             handled_at.update(("@preference", "preference"))
             self.preference = pref["default"]
-            # Distance change reinstalls every route.
-            routes = (
-                self.node.routes if self.level_all else self.inst.routes
-            )
-            for prefix, (metric, nhs) in routes.items():
+            # Distance change reinstalls every INSTALLED route.
+            src = self.node if self.level_all else self.inst
+            for prefix, (metric, nhs) in src.installable_routes().items():
                 self.ibus_log.append(("add", prefix, metric, nhs))
         spfc = isis.get("spf-control") or {}
         if op_of(spfc, "paths") in ("replace", "create", "delete"):
@@ -728,8 +746,13 @@ class CaseRun:
                     self.node.if_down(ifname)
                     self.up.discard(ifname)
                 self.if_conf.pop(ifname, None)
-                # Routes keep their entries but lose next hops through
-                # the deleted circuit (stale until the next SPF).
+                # The LOCAL route table loses next hops through the
+                # deleted circuit with NO ibus emission (recorded
+                # nb-config-iface-delete1 step 1 emits only
+                # InterfaceUnsub), and the reference's reinstall diff at
+                # the next SPF runs against this stripped local RIB
+                # (update_global_rib's old_rib) — so prev_routes tracks
+                # the stripped view, leaving the kernel stale by design.
                 for inst in self.insts:
                     for prefix, (metric, nhs) in list(inst.routes.items()):
                         kept = frozenset(
@@ -737,10 +760,8 @@ class CaseRun:
                         )
                         if kept != nhs:
                             inst.routes[prefix] = (metric, kept)
-                            self.prev_routes[prefix] = (metric, kept)
-                            self.ibus_log.append(
-                                ("add", prefix, metric, kept)
-                            )
+                            if prefix in self.prev_routes:
+                                self.prev_routes[prefix] = (metric, kept)
                     inst._originate_lsp()
                 continue
             for key in if_node:
@@ -770,7 +791,7 @@ class CaseRun:
                             iface.adj = None
                             iface.adjs.clear()
                             inst._adj_changed()
-                        else:
+                        elif inst.inline_hellos:
                             inst._send_hello(ifname)
                 else:
                     unhandled.append(f"iface leaf {name}")
@@ -991,6 +1012,13 @@ class CaseRun:
                 problems.append(
                     "expected tx not sent: " + json.dumps(item["pdu"])[:160]
                 )
+        # Two-sided (stub/mod.rs:320-429 diffs both directions): a PDU we
+        # sent that the recording doesn't contain is a failure too.
+        for i, got in enumerate(ours):
+            if i not in assign:
+                problems.append(
+                    "unexpected tx: " + json.dumps(got["pdu"])[:160]
+                )
         return problems
 
     def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
@@ -1108,6 +1136,10 @@ class CaseRun:
                 )
             else:
                 unmatched.pop(hit)
+        for got in unmatched:  # two-sided: extra ibus emissions fail
+            problems.append(
+                "unexpected ibus msg: " + json.dumps(got)[:140]
+            )
         return problems
 
     def compare_state(self, state: dict) -> list[str]:

@@ -176,6 +176,14 @@ class CaseRun:
     # -- ibus plane
 
     def _routes_changed(self, routes: dict) -> None:
+        # Connected routes stay out of the RIB feed: the kernel already
+        # owns them as DIRECT, and the reference only ever installs
+        # learned routes (recorded ibus planes carry distance-120 adds
+        # with real nexthops, never the interface's own prefix).
+        routes = {
+            p: r for p, r in routes.items()
+            if r.route_type != "connected"
+        }
         for prefix, route in routes.items():
             cur = (route.metric, route.nexthop, route.ifname)
             if self.prev_routes.get(prefix) != cur:
@@ -445,6 +453,12 @@ class CaseRun:
                 problems.append(
                     "expected tx not sent: " + json.dumps(item["pdu"])[:160]
                 )
+        # Two-sided (stub/mod.rs:320-429): extra transmissions fail too.
+        for i, got in enumerate(ours):
+            if i not in assign:
+                problems.append(
+                    "unexpected tx: " + json.dumps(got["pdu"])[:160]
+                )
         return problems
 
     def compare_ibus(self, expected_lines: list[dict]) -> list[str]:
@@ -517,6 +531,10 @@ class CaseRun:
                 )
             else:
                 unmatched.pop(hit)
+        for got in unmatched:  # two-sided: extra ibus emissions fail
+            problems.append(
+                "unexpected ibus msg: " + json.dumps(got)[:140]
+            )
         return problems
 
     def compare_state(self, state: dict) -> list[str]:

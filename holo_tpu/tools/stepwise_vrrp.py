@@ -193,8 +193,9 @@ class CaseRun:
         inst = self.instances.pop((af, vrid), None)
         if inst is None:
             return
-        if inst.state == VrrpState.MASTER:
-            self._withdraw_addrs(af, vrid, inst)
+        # No address withdrawal first: deleting the macvlan removes its
+        # addresses with it (recorded nb-config-instance2 emits ONLY the
+        # MacvlanDel).
         inst.shutdown()
         self.ibus_log.append(
             ("MacvlanDel", {"ifname": _mvlan_name(af, vrid)})
@@ -491,6 +492,8 @@ class CaseRun:
                 )
             else:
                 unmatched.pop(hit)
+        for got in unmatched:  # two-sided (stub/mod.rs:320-429)
+            problems.append("unexpected tx: " + json.dumps(got)[:150])
         return problems
 
     def drain_ibus(self):
@@ -518,6 +521,10 @@ class CaseRun:
                 )
             else:
                 unmatched.pop(hit)
+        for got in unmatched:  # two-sided: extra ibus emissions fail
+            problems.append(
+                "unexpected ibus msg: " + json.dumps(got)[:140]
+            )
         return problems
 
     def compare_state(self, state: dict) -> list[str]:

@@ -194,12 +194,23 @@ class EventLoop:
     def introspect(self) -> dict:
         """Live scheduler snapshot — the reference gates the equivalent
         behind its tokio_console feature (holo-daemon/src/main.rs:115-133);
-        here it is always-on state the management plane can serve."""
+        here it is always-on state the management plane can serve.
+
+        Read-only by design: it scans the timer heap instead of calling
+        :meth:`next_deadline` (whose stale-entry pops would race the
+        pump thread when a ThreadedLoop is inspected cross-thread)."""
         now = self.clock.now()
         armed = sum(
             1 for e in self._timers if e.timer._armed_seq == e.seq
         )
-        nd = self.next_deadline()
+        nd = min(
+            (
+                e.deadline
+                for e in self._timers
+                if e.timer._armed_seq == e.seq
+            ),
+            default=None,
+        )
         return {
             "actors": {
                 name: {

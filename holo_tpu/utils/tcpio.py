@@ -108,7 +108,12 @@ def _apply_gtsm(s: socket.socket, slot: "_PeerSlot") -> None:
 def _listener_max_ttl(s: socket.socket, v6: bool) -> None:
     """A GTSM peer's MINTTL would drop our SYN-ACKs if the listener sent
     them at the default TTL — listeners send at 255 once any peer has
-    ttl-security (reference network.rs:43)."""
+    ttl-security (reference network.rs:43).
+
+    The received-TTL floor is deliberately NOT set on the listener: a
+    shared listener may serve non-GTSM peers too, and the reference
+    likewise enforces MINTTL only on the accepted stream
+    (network.rs:103-125 accepted_stream_init)."""
     if v6:
         s.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_UNICAST_HOPS, _TTL_MAX)
     else:

@@ -158,12 +158,17 @@ def test_isis_config_driven_convergence():
         cand.set("routing/control-plane-protocols/isis/interface[eth0]/metric", 7)
         d.commit(cand)
     loop.advance(30)
-    # DIRECT wins the connected prefix; IS-IS holds its own entry.
+    # DIRECT owns the connected prefix; IS-IS computes it but never
+    # installs CONNECTED routes (reference route.rs:285-301) — same
+    # rule OSPF follows.
     from holo_tpu.utils.southbound import Protocol as P
 
     entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
-    assert P.ISIS in entries
+    assert P.ISIS not in entries
     assert d1.routing.rib.active_routes()[N("10.0.12.0/30")].protocol == P.DIRECT
+    inst = d1.routing.instances["isis"]
+    assert N("10.0.12.0/30") in inst.routes  # computed, just not installed
+    assert N("10.0.12.0/30") in inst.connected_prefixes
 
 
 def test_ospfv3_config_driven_convergence():
