@@ -501,6 +501,8 @@ class RoutingProvider(Provider, Actor):
         self._apply_bgp(new)
         self._apply_vrrp(new)
         self._apply_ldp(new)
+        self._apply_rip(new)
+        self._apply_igmp(new)
         self._apply_static(new)
 
     def _handle_redistribution(self, msg) -> None:
@@ -968,6 +970,167 @@ class RoutingProvider(Provider, Actor):
             inst.add_interface(ifname, addr.ip)
             # Directly-attached networks are egress FECs (implicit null).
             inst.add_fec(addr.network, egress=True)
+
+    def _apply_rip(self, new):
+        """RIPv2/RIPng lifecycle from config (reference: holo-rip spawn
+        path; both families share the Version-strategy instance)."""
+        from holo_tpu.protocols.rip import (
+            RipIfConfig,
+            RipInstance,
+            RipngVersion,
+            RipVersion,
+        )
+        from holo_tpu.utils.southbound import Protocol
+
+        for proto, version, want_v6 in (
+            ("ripv2", RipVersion, False),
+            ("ripng", RipngVersion, True),
+        ):
+            base = f"routing/control-plane-protocols/{proto}"
+            conf = new.get(base)
+            enabled = bool(conf) and new.get(f"{base}/enabled", True)
+            inst = self.instances.get(proto)
+            sink_proto = Protocol.RIPV2 if proto == "ripv2" else Protocol.RIPNG
+            if not enabled:
+                if inst is not None:
+                    self._sink_routes(sink_proto, {})  # delta-clears RIB
+                    self._unplace_instance(inst.name)
+                    del self.instances[proto]
+                continue
+            if inst is None:
+                actor = f"{self.prefix}{proto}"
+                raw = RipInstance(
+                    name=actor,
+                    netio=self.netio_factory(actor),
+                    update_interval=new.get(f"{base}/update-interval", 30),
+                    timeout=new.get(f"{base}/invalid-interval", 180),
+                    garbage=max(
+                        new.get(f"{base}/flush-interval", 240)
+                        - new.get(f"{base}/invalid-interval", 180),
+                        1,
+                    ),
+                    version=version,
+                )
+                # The RIB feed installs LEARNED routes only — connected
+                # prefixes stay with the kernel/DIRECT (same rule as
+                # OSPF/IS-IS; the reference never installs them).
+                raw.route_cb = lambda routes, rp=sink_proto: (
+                    self._sink_routes(
+                        rp,
+                        {
+                            p: (
+                                r.metric,
+                                frozenset({(r.ifname, r.nexthop)}),
+                            )
+                            for p, r in routes.items()
+                            if r.route_type != "connected"
+                            and r.nexthop is not None
+                        },
+                    )
+                )
+                inst = self._place_instance(raw)
+                self.instances[proto] = inst
+            # Timers reconfigure in place (they are read per tick).
+            inst.update_interval = new.get(f"{base}/update-interval", 30)
+            inst.timeout = new.get(f"{base}/invalid-interval", 180)
+            inst.garbage = max(
+                new.get(f"{base}/flush-interval", 240) - inst.timeout, 1
+            )
+            wanted = new.get(f"{base}/interface") or {}
+            for ifname, if_conf in wanted.items():
+                cost = if_conf.get("cost", 1)
+                split = if_conf.get("split-horizon", "poison-reverse")
+                cur = inst.interfaces.get(ifname)
+                if cur is not None:
+                    # Live reconfiguration (reference configuration.rs
+                    # InterfaceCostUpdate): metrics recompute table-wide.
+                    if cur[0].cost != cost:
+                        inst.iface_cost_update(ifname, cost)
+                    cur[0].split_horizon = split
+                    continue
+                st = self.ifp.interfaces.get(ifname)
+                if st is None:
+                    continue
+                addrs = [
+                    a for a in st.addresses
+                    if (a.ip.version == 6) == want_v6
+                ]
+                if not addrs:
+                    continue
+                a = addrs[0]
+                inst.add_interface(
+                    ifname,
+                    RipIfConfig(cost=cost, split_horizon=split),
+                    a.ip,
+                    a.network,
+                )
+            for ifname in list(inst.interfaces):
+                if ifname not in wanted:
+                    inst.remove_interface(ifname)
+
+    def _apply_igmp(self, new):
+        """IGMP querier lifecycle from config (reference: holo-igmp
+        spawn inside holo-routing).  Kernel VIF programming engages when
+        the multicast routing socket is available (root)."""
+        from holo_tpu.protocols.igmp import IgmpIfConfig, IgmpInstance
+
+        base = "routing/control-plane-protocols/igmp"
+        conf = new.get(base)
+        wanted = (new.get(f"{base}/interface") or {}) if conf else {}
+        inst = self.instances.get("igmp")
+        if not wanted:
+            if inst is not None:
+                # Tear down kernel state first: del_vif per interface,
+                # then release the one-per-system MRT socket so a
+                # re-enable can MRT_INIT again.
+                for ifname in list(inst.interfaces):
+                    inst.remove_interface(ifname)
+                if inst.mroute is not None:
+                    inst.mroute.close()
+                self._unplace_instance(inst.name)
+                del self.instances["igmp"]
+            return
+        if inst is None:
+            actor = f"{self.prefix}igmp"
+            mroute = None
+            import os
+
+            if os.geteuid() == 0:
+                try:
+                    from holo_tpu.routing.mroute import MulticastRouting
+
+                    mroute = MulticastRouting()
+                except OSError:
+                    mroute = None  # no kernel mcast socket: queried-only
+            inst = self._place_instance(
+                IgmpInstance(
+                    name=actor,
+                    netio=self.netio_factory(actor),
+                    mroute=mroute,
+                )
+            )
+            self.instances["igmp"] = inst
+        for ifname, if_conf in wanted.items():
+            if ifname in inst.interfaces:
+                continue
+            st = self.ifp.interfaces.get(ifname)
+            if st is None or not st.addresses:
+                continue
+            v4 = [a for a in st.addresses if a.ip.version == 4]
+            if not v4:
+                continue
+            inst.add_interface(
+                ifname,
+                IgmpIfConfig(
+                    version=if_conf.get("version", 2),
+                    query_interval=if_conf.get("query-interval", 125),
+                ),
+                v4[0].ip,
+                ifindex=getattr(st, "ifindex", None),
+            )
+        for ifname in list(inst.interfaces):
+            if ifname not in wanted:
+                inst.remove_interface(ifname)
 
     def _apply_vrrp(self, new):
         """VRRP lifecycle: one instance per (interface, vrid).  The master
@@ -1558,6 +1721,49 @@ class RoutingProvider(Provider, Actor):
                     for k, v in getattr(isis, "hostnames", {}).items()
                 },
             }
+        for proto in ("ripv2", "ripng"):
+            rip = self.instances.get(proto)
+            if rip is None:
+                continue
+            # dict() snapshots are GIL-atomic: under preemptive
+            # isolation the instance thread mutates these containers
+            # while this (management-side) render iterates.
+            routes = dict(rip.routes)
+            neighbors = dict(rip.neighbors)
+            state["routing"][proto] = {
+                "routes": {
+                    str(p): {
+                        "metric": r.metric,
+                        "type": r.route_type,
+                        "interface": r.ifname,
+                        "next-hop": (
+                            str(r.nexthop) if r.nexthop is not None else None
+                        ),
+                    }
+                    for p, r in routes.items()
+                },
+                "neighbors": {
+                    str(a): {"last-update": t}
+                    for a, t in neighbors.items()
+                },
+            }
+        igmp = self.instances.get("igmp")
+        if igmp is not None:
+            out_ifaces = {}
+            for i in list(igmp.interfaces.values()):
+                groups = dict(i.groups)
+                out_ifaces[i.name] = {
+                    "querier": i.querier,
+                    "groups": {
+                        str(g): {
+                            "reporters": sorted(
+                                str(r) for r in set(grp.reporters)
+                            )
+                        }
+                        for g, grp in groups.items()
+                    },
+                }
+            state["routing"]["igmp"] = {"interfaces": out_ifaces}
         ldp = self.instances.get("ldp")
         if ldp is not None:
             state["routing"]["ldp"] = {

@@ -635,3 +635,87 @@ def test_runtime_introspection_state():
     assert rt["timers-armed"] >= 0
     # Scoped GetState for another subtree must not include the runtime.
     assert "holo-runtime" not in d.northbound.get_state("routing")
+
+
+def test_rip_config_driven_convergence():
+    """Config-driven RIPv2: daemon spawns the instance, interfaces join
+    from the interface table, learned routes land in the RIB (connected
+    prefixes stay with DIRECT — reference never installs them)."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="r1")
+    d2 = Daemon(loop=loop, netio=fabric, name="r2")
+    fabric.join("l", "r1.ripv2", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "r2.ripv2", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, addr, stub in [
+        (d1, "10.0.12.1/30", "10.99.1.0/24"),
+        (d2, "10.0.12.2/30", "10.99.2.0/24"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/ripv2/interface[eth0]/cost", 1)
+        cand.set(
+            f"routing/control-plane-protocols/static-routes/route[{stub}]/next-hop",
+            addr.split("/")[0],
+        )
+        d.commit(cand)
+    assert "ripv2" in d1.routing.instances
+    loop.advance(90)
+    from holo_tpu.utils.southbound import Protocol as P
+
+    # d1 learned d2's connected prefix... no — connected isn't advertised
+    # beyond the shared link; RIP advertises its route table: d2's
+    # connected 10.0.12.0/30 is suppressed on d1 (already DIRECT) but the
+    # instance-level learning works both ways.  Assert the RIP instances
+    # exchanged and hold each other as neighbors.
+    i1 = d1.routing.instances["ripv2"]
+    assert any(str(a) == "10.0.12.2" for a in i1.neighbors)
+    # connected prefix: DIRECT owns it, RIPV2 never installs its own.
+    rib = d1.routing.rib.active_routes()
+    assert rib[N("10.0.12.0/30")].protocol == P.DIRECT
+    entries = d1.routing.rib.routes[N("10.0.12.0/30")].entries
+    assert P.RIPV2 not in entries
+    state = d1.routing.get_state()
+    assert "10.0.12.0/30" in state["routing"]["ripv2"]["routes"]
+    # Disable: instance torn down, neighbors gone from state.
+    cand = d1.candidate()
+    cand.set("routing/control-plane-protocols/ripv2/enabled", "false")
+    d1.commit(cand)
+    assert "ripv2" not in d1.routing.instances
+
+
+def test_igmp_config_driven_querier():
+    """Config-driven IGMP: daemon spawns the querier, a membership
+    report populates group state."""
+    import ipaddress
+
+    from holo_tpu.protocols.igmp import IgmpPacket
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="q1")
+    fabric.join("lan", "q1.igmp", "eth0", ipaddress.ip_address("10.0.1.1"))
+    host = fabric.sender_for("host")
+    fabric.join("lan", "host", "e0", ipaddress.ip_address("10.0.1.50"))
+    cand = d1.candidate()
+    cand.set("interfaces/interface[eth0]/address", ["10.0.1.1/24"])
+    cand.set(
+        "routing/control-plane-protocols/igmp/interface[eth0]/version", 2
+    )
+    d1.commit(cand)
+    assert "igmp" in d1.routing.instances
+    loop.advance(5)
+    # Host joins 239.1.1.1 (v2 membership report).
+    report = IgmpPacket(
+        type=0x16, max_resp=0, group=ipaddress.ip_address("239.1.1.1")
+    ).encode()
+    host.send("e0", ipaddress.ip_address("10.0.1.50"),
+              ipaddress.ip_address("239.1.1.1"), report)
+    loop.advance(2)
+    inst = d1.routing.instances["igmp"]
+    groups = inst.interfaces["eth0"].groups
+    assert ipaddress.ip_address("239.1.1.1") in groups
+    state = d1.routing.get_state()
+    assert "239.1.1.1" in state["routing"]["igmp"]["interfaces"]["eth0"]["groups"]
