@@ -44,6 +44,8 @@ STAGE_TIMEOUT = {
     "scale50k_fused": 1200,
     "scale50k_hybrid": 1200,
     "scale50k_b256": 1500,
+    "whatif1024": 900,
+    "cspf10k": 900,
     "cpubaseline": 600,
 }
 
@@ -263,6 +265,57 @@ def stage_latency(k, B):
     }
 
 
+def stage_whatif1024(k, cpu_runs):
+    """BASELINE.md config 5 verbatim: 1024 concurrent link-failure SPFs
+    vmapped over one 10k-node LSDB."""
+    topo, masks = _make(k, 1024)
+    return _gather_run(topo, masks, cpu_runs, engine="seq") | {"batch": 1024}
+
+
+def stage_cspf10k(k, B):
+    """BASELINE.md config 4: constrained SPF as masked batched SSSP —
+    B TE path requests (affinity/bandwidth constraints) over the 10k
+    LSDB in one device batch."""
+    import numpy as np
+
+    from holo_tpu.ops.cspf import Constraint, CspfEngine, LinkAttrs
+    from holo_tpu.spf.synth import fat_tree_topology
+
+    topo = fat_tree_topology(k=k, seed=0)
+    rng = np.random.default_rng(7)
+    attrs = LinkAttrs(
+        affinity=rng.integers(0, 2**8, topo.n_edges, dtype=np.uint32),
+        bandwidth=rng.uniform(1.0, 10.0, topo.n_edges),
+    )
+    eng = CspfEngine(topo, attrs)
+    cons = [
+        Constraint(
+            exclude_any=int(rng.integers(0, 4)),
+            min_bandwidth=float(rng.uniform(0.0, 2.0)),
+        )
+        for _ in range(B)
+    ]
+    dsts = [int(d) for d in rng.integers(0, topo.n_vertices, B)]
+    t0 = time.perf_counter()
+    paths = eng.compute(cons, dsts)  # includes host path extraction
+    warm = time.perf_counter() - t0  # first call compiles
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        paths = eng.compute(cons, dsts)
+        times.append(time.perf_counter() - t0)
+    dt = sum(times) / len(times)
+    found = sum(1 for p in paths if p.cost is not None)
+    return {
+        "ok": found > 0,
+        "requests_per_sec": B / dt,
+        "batch_ms": dt * 1e3,
+        "paths_found": found,
+        "batch": B,
+        "compile_s": round(warm, 1),
+    }
+
+
 def stage_cpubaseline(k, runs):
     """C++ scalar baseline only (no JAX device needed): the interpretable
     row to lead with when the relay is down."""
@@ -348,6 +401,8 @@ def main() -> None:
                 k50, b50, cpu50, engine="hybrid"
             ),
             "scale50k_b256": lambda: stage_scale50k(k50, b256, cpu50, engine=eng),
+            "whatif1024": lambda: stage_whatif1024(k10, 8 if small else 16),
+            "cspf10k": lambda: stage_cspf10k(k10, 32 if small else 256),
             "cpubaseline": lambda: stage_cpubaseline(k10, cpu10),
         }[stage]
         print(json.dumps(fn()))
@@ -424,6 +479,11 @@ def main() -> None:
         extra["scale50k_b256"] = _run_stage(
             "scale50k_b256", small, engine=best50["engine"]
         )
+    if not small:
+        # BASELINE.md configs 4 and 5 verbatim (CSPF batch; 1024-scenario
+        # what-if) — coverage rows, not the headline.
+        extra["whatif1024"] = _run_stage("whatif1024", small)
+        extra["cspf10k"] = _run_stage("cspf10k", small)
 
     n10 = "500" if small else "10125"
     blocked = extra.get("blocked10k", {})

@@ -171,15 +171,35 @@ class Daemon:
         # Route BEFORE the pump starts: a send in the window lands on the
         # (not yet registered) remote loop and is reported undeliverable,
         # never silently swallowed by the primary loop.
-        self.loop_router.register_remote(inst.name, tl)
+        # Multi-actor nodes (the IS-IS L1/L2 pair) place BOTH actors on
+        # the one loop — single-writer per thread still holds.
+        subs = [inst]
+        if hasattr(inst, "instances") and callable(inst.instances):
+            # The node itself stays registered too: it is the packet
+            # entry point that fans out to the per-level actors.
+            subs += list(inst.instances())
+        for sub in subs:
+            self.loop_router.register_remote(sub.name, tl)
         # Per-interface Tx tasks (reference tasks.rs:288-348): packet
         # production decouples from the wire send; a slow interface
         # backpressures its own producer only.
-        if getattr(inst, "netio", None) is not None:
+        shared_netio = next(
+            (
+                n
+                for n in (getattr(s, "netio", None) for s in subs)
+                if n is not None
+            ),
+            None,
+        )
+        if shared_netio is not None:
             from holo_tpu.utils.txqueue import TxTaskNetIo
 
-            inst.netio = TxTaskNetIo(inst.netio)
-        tl.register(inst)
+            wrapped = TxTaskNetIo(shared_netio)
+            for sub in subs:
+                if getattr(sub, "netio", None) is not None:
+                    sub.netio = wrapped
+        for sub in subs:
+            tl.register(sub)
         # Provider-installed callbacks run as primary-loop messages.
         runner = f"{self._p}call-runner"
         for attr in self._MARSHALLED_CALLBACKS:
@@ -205,12 +225,23 @@ class Daemon:
         self.loop_router.unregister_remote(name)
         tl = self.instance_loops.pop(name, None)
         if tl is not None:
-            inst = tl.loop.actors.get(name)
+            actors = list(tl.loop.actors)
+            insts = [tl.loop.actors[a] for a in actors]
+            for a in actors:  # multi-actor nodes route every sub-name
+                self.loop_router.unregister_remote(a)
             tl.stop()
-            tl.loop.unregister(name)
-            netio = getattr(inst, "netio", None)
-            if netio is not None and hasattr(netio, "close"):
-                netio.close()  # drain + join the per-interface Tx tasks
+            for a in actors:
+                tl.loop.unregister(a)
+            closed = set()
+            for inst in insts:
+                netio = getattr(inst, "netio", None)
+                if (
+                    netio is not None
+                    and hasattr(netio, "close")
+                    and id(netio) not in closed
+                ):
+                    netio.close()  # drain + join the per-interface Tx tasks
+                    closed.add(id(netio))
 
     # -- config entry points
 

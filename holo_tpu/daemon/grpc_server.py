@@ -152,6 +152,17 @@ class NorthboundService:
                         cand.delete(edit.path)
                     else:
                         value = edit.value if edit.value != "" else None
+                        # Leaf-lists cross the wire as JSON arrays (a
+                        # PathEdit value is a string); scalars that
+                        # merely look like JSON stay strings unless the
+                        # parse yields a list.
+                        if isinstance(value, str) and value.lstrip().startswith("["):
+                            try:
+                                parsed = json.loads(value)
+                                if isinstance(parsed, list):
+                                    value = parsed
+                            except ValueError:
+                                pass
                         cand.set(edit.path, value)
             elif request.operation == pb.CommitOperation.REPLACE:
                 cand = DataTree.from_json(nb.schema, request.config_json)

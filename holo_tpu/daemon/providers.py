@@ -321,14 +321,23 @@ class RoutingProvider(Provider, Actor):
         handle when the daemon placed it on its own thread."""
         if self.instance_placer is not None:
             return self.instance_placer(inst) or inst
-        self.loop.register(inst)
+        if hasattr(inst, "attach_loop"):
+            # Multi-actor node (IS-IS L1/L2): registers the per-level
+            # actors plus the node's own packet entry point.
+            inst.attach_loop(self.loop)
+        else:
+            self.loop.register(inst)
         return inst
 
     def _unplace_instance(self, name: str) -> None:
         if self.instance_unplacer is not None:
             self.instance_unplacer(name)
-        else:
+            return
+        if name in self.loop.actors:
             self.loop.unregister(name)
+        # Multi-actor node: its per-level actors carry "<name>-..." names.
+        for sub in [a for a in self.loop.actors if a.startswith(f"{name}-")]:
+            self.loop.unregister(sub)
 
     def validate(self, new_tree) -> None:
         from holo_tpu.northbound.provider import CommitError
@@ -490,6 +499,13 @@ class RoutingProvider(Provider, Actor):
                     self.loop.send(inst.name, V3IfDownMsg(ifname))
                 elif isinstance(inst, IsisInstance) and ifname in inst.interfaces:
                     self.loop.send(inst.name, IsisIfDownMsg(ifname))
+                elif (
+                    hasattr(inst, "instances")
+                    and hasattr(inst, "if_down")
+                    and ifname in inst.interfaces
+                ):
+                    # IS-IS L1/L2 node: marshalled call downs both levels.
+                    inst.if_down(ifname)
 
     def commit(self, phase, old, new, changes):
         if phase != CommitPhase.APPLY:
@@ -854,9 +870,13 @@ class RoutingProvider(Provider, Actor):
         sysid = _parse_system_id(system_id)
         if sysid is None:
             return  # rejected in validate(); defensive here
-        if inst is not None and inst.sysid != sysid:
-            # System-id change requires a new incarnation: withdraw and
-            # restart (mirrors disable+enable).
+        level_cfg = new.get(f"{base}/level", "level-all")
+        if inst is not None and (
+            inst.sysid != sysid
+            or getattr(inst, "level_name", None) != level_cfg
+        ):
+            # System-id or level change requires a new incarnation:
+            # withdraw and restart (mirrors disable+enable).
             from holo_tpu.utils.southbound import Protocol
 
             self._drop_instance_routes(Protocol.ISIS, inst.routes)
@@ -865,11 +885,28 @@ class RoutingProvider(Provider, Actor):
             inst = None
         if inst is None:
             actor = f"{self.prefix}isis"
-            raw = IsisInstance(
-                name=actor,
-                sysid=sysid,
-                netio=self.netio_factory(actor),
-            )
+            if level_cfg == "level-all":
+                from holo_tpu.protocols.isis.multi import (
+                    IsisLevelAllInstance,
+                )
+
+                raw = IsisLevelAllInstance(
+                    actor, sysid, b"\x49\x00\x01",
+                    netio=self.netio_factory(actor),
+                )
+            else:
+                raw = IsisInstance(
+                    name=actor,
+                    sysid=sysid,
+                    level=1 if level_cfg == "level-1" else 2,
+                    netio=self.netio_factory(actor),
+                )
+                if level_cfg == "level-1":
+                    raw.is_type = 0x01
+                # level-2 keeps the default 0x03: ISO 10589 §9.9 requires
+                # the L1-IS bit set even on L2-only systems
+                # (reference lsdb.rs:202-207).
+            raw.level_name = level_cfg
             # The RIB feed carries the installable view (route.rs:285-301:
             # connected prefixes stay out — the kernel owns them as
             # DIRECT).  last_installable is a snapshot the instance
@@ -896,7 +933,11 @@ class RoutingProvider(Provider, Actor):
                 st.addresses[0].ip,
                 st.addresses[0].network,
             )
-            self.loop.send(inst.name, IsisIfUpMsg(ifname))
+            if hasattr(inst, "instances"):
+                # L1/L2 node: marshalled method call reaches both levels.
+                inst.if_up(ifname)
+            else:
+                self.loop.send(inst.name, IsisIfUpMsg(ifname))
 
     def _isis_routes_to_rib(self, routes):
         from holo_tpu.utils.southbound import Protocol
@@ -1686,10 +1727,17 @@ class RoutingProvider(Provider, Actor):
                     instance_state as isis_state,
                 )
 
-                state["routing"]["ietf-isis:isis"] = isis_state(
-                    [isis],
-                    ifnames=getattr(self, "_isis_ifnames", None),
-                )
+                if hasattr(isis, "instances"):  # L1/L2 node
+                    state["routing"]["ietf-isis:isis"] = isis_state(
+                        list(isis.instances()),
+                        node=isis._inst if hasattr(isis, "_inst") else isis,
+                        ifnames=getattr(self, "_isis_ifnames", None),
+                    )
+                else:
+                    state["routing"]["ietf-isis:isis"] = isis_state(
+                        [isis],
+                        ifnames=getattr(self, "_isis_ifnames", None),
+                    )
             except Exception:  # noqa: BLE001 — ad-hoc state must survive
                 log.exception("ietf-isis state render failed")
             state["routing"]["isis"] = {

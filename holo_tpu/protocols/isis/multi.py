@@ -28,10 +28,16 @@ from holo_tpu.protocols.isis.packet import (
     ExtIpReach,
     PduType,
 )
+from holo_tpu.utils.runtime import Actor
 
 
-class IsisLevelAllInstance:
-    """Facade over an L1 and an L2 IsisInstance sharing the circuits."""
+class IsisLevelAllInstance(Actor):
+    """Facade over an L1 and an L2 IsisInstance sharing the circuits.
+
+    Also an actor in its own right: the daemon's fabric/sockets deliver
+    raw packets to the NODE name, and :meth:`handle` dispatches them to
+    the level that owns the PDU (L1 kinds to l1, L2 kinds to l2, P2P
+    hellos to both — they cover both levels on a shared circuit)."""
 
     def __init__(self, name: str, sysid: bytes, area: bytes, netio=None,
                  spf_backend_factory=None, route_cb=None, **kw):
@@ -66,6 +72,7 @@ class IsisLevelAllInstance:
         self.routes: dict = {}
         self.summary_prefixes: frozenset = frozenset()
         self.connected_prefixes: frozenset = frozenset()
+        self.last_installable: dict = {}
 
     # -- shared-circuit plumbing
 
@@ -78,6 +85,56 @@ class IsisLevelAllInstance:
     def attach_loop(self, loop) -> None:
         loop.register(self.l1)
         loop.register(self.l2)
+        loop.register(self)  # packet entry point under the node name
+
+    _HELLO_PDUS = frozenset(
+        (PduType.HELLO_P2P, PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2)
+    )
+
+    def handle(self, msg) -> None:
+        """Raw packet entry point: decode ONCE, then :meth:`rx_pdu`
+        dispatches by level — including the P2P hello's circuit-type
+        bits, so an L1-only neighbor never raises a bogus L2
+        adjacency."""
+        from holo_tpu.protocols.isis.packet import DecodeError, decode_pdu
+
+        data = getattr(msg, "data", None)
+        if data is None or len(data) <= 4:
+            return
+        iface = self.l1.interfaces.get(msg.ifname)
+        if iface is None:
+            return
+        probe = data[4] & 0x1F
+        rx_auth = (
+            self.l1._hello_auth(iface)
+            if probe in tuple(int(t) for t in self._HELLO_PDUS)
+            else self.l1.auth
+        )
+        try:
+            ptype, pdu = decode_pdu(data, auth=rx_auth)
+        except DecodeError:
+            return
+        snpa = msg.src if isinstance(msg.src, bytes) else b""
+        self.rx_pdu(msg.ifname, ptype, pdu, snpa)
+
+    # -- daemon-facing delegation (the provider treats a node like a
+    #    single instance for interface membership and state rendering)
+
+    @property
+    def interfaces(self):
+        return self.l1.interfaces  # both levels share the circuit set
+
+    @property
+    def spf_run_count(self) -> int:
+        return self.l1.spf_run_count + self.l2.spf_run_count
+
+    @property
+    def lsdb(self):
+        return {**self.l1.lsdb, **self.l2.lsdb}
+
+    @property
+    def hostnames(self):
+        return {**self.l1.hostnames, **self.l2.hostnames}
 
     def add_interface(self, ifname, cfg, addr, prefix, **kw):
         import copy
@@ -232,6 +289,9 @@ class IsisLevelAllInstance:
                 else p in self.l2.connected_prefixes
             )
         )
+        # One atomic publication for cross-thread readers (the daemon's
+        # marshalled route_cb) — same contract as the single instance.
+        self.last_installable = self.installable_routes()
         if self.route_cb is not None:
             self.route_cb(merged)
 

@@ -719,3 +719,40 @@ def test_igmp_config_driven_querier():
     assert ipaddress.ip_address("239.1.1.1") in groups
     state = d1.routing.get_state()
     assert "239.1.1.1" in state["routing"]["igmp"]["interfaces"]["eth0"]["groups"]
+
+
+def test_isis_level_all_config_driven():
+    """level=level-all spawns the L1/L2 node (both instances on one
+    loop); adjacency forms at both levels and level reconfiguration
+    restarts the incarnation."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="m1")
+    d2 = Daemon(loop=loop, netio=fabric, name="m2")
+    fabric.join("l", "m1.isis", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "m2.isis", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, sid, addr in [(d1, "0.0.0.0.0.1", "10.0.12.1/30"),
+                         (d2, "0.0.0.0.0.2", "10.0.12.2/30")]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/isis/system-id", sid)
+        cand.set("routing/control-plane-protocols/isis/level", "level-all")
+        cand.set("routing/control-plane-protocols/isis/interface[eth0]/metric", 5)
+        d.commit(cand)
+    node = d1.routing.instances["isis"]
+    assert hasattr(node, "instances") and len(list(node.instances())) == 2
+    loop.advance(30)
+    for inst in node.instances():
+        ups = [a for i in inst.interfaces.values() for a in i.up_adjacencies()]
+        assert ups, f"L{inst.level} adjacency did not form"
+    state = d1.routing.get_state()
+    assert state["routing"]["isis"]["spf-run-count"] >= 1
+    # Level change restarts the incarnation as a single-level instance.
+    cand = d1.candidate()
+    cand.set("routing/control-plane-protocols/isis/level", "level-2")
+    d1.commit(cand)
+    inst2 = d1.routing.instances["isis"]
+    assert not hasattr(inst2, "instances")
+    assert inst2.level == 2 and inst2.level_name == "level-2"
