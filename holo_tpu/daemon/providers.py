@@ -1714,14 +1714,26 @@ class RoutingProvider(Provider, Actor):
                 state["routing"]["ietf-ospf:ospf"] = instance_state(ospf)
             except Exception:  # noqa: BLE001 — ad-hoc state must survive
                 log.exception("ietf-ospf state render failed")
+        v3 = self.instances.get("ospfv3")
+        if v3 is not None:
+            # YANG-modeled ietf-ospf (v3) tree — the renderer the v3
+            # conformance harness diffs 44/44 recorded routers against.
+            try:
+                from holo_tpu.protocols.ospf.nb_state_v3 import (
+                    instance_state as v3_state,
+                )
+
+                state["routing"]["ietf-ospf:ospfv3"] = v3_state(v3)
+            except Exception:  # noqa: BLE001 — ad-hoc state must survive
+                log.exception("ietf-ospf v3 state render failed")
         isis = self.instances.get("isis")
         if isis is not None:
             # The YANG-modeled ietf-isis operational tree — the same
             # renderer the conformance harness diffs against the
             # reference's recorded state plane — served at the standard
             # module-qualified name alongside the ad-hoc summary below.
-            # (ietf-ospf:ospf is rendered in the ospf block above;
-            # OSPFv3 has no YANG renderer yet and serves ad-hoc only.)
+            # (ietf-ospf:ospf v2 is rendered in the ospf block above,
+            # v3 in the ospfv3 block below.)
             try:
                 from holo_tpu.protocols.isis.nb_state import (
                     instance_state as isis_state,

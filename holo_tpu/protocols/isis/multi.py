@@ -88,7 +88,8 @@ class IsisLevelAllInstance(Actor):
         loop.register(self)  # packet entry point under the node name
 
     _HELLO_PDUS = frozenset(
-        (PduType.HELLO_P2P, PduType.HELLO_LAN_L1, PduType.HELLO_LAN_L2)
+        (int(PduType.HELLO_P2P), int(PduType.HELLO_LAN_L1),
+         int(PduType.HELLO_LAN_L2))
     )
 
     def handle(self, msg) -> None:
@@ -107,7 +108,7 @@ class IsisLevelAllInstance(Actor):
         probe = data[4] & 0x1F
         rx_auth = (
             self.l1._hello_auth(iface)
-            if probe in tuple(int(t) for t in self._HELLO_PDUS)
+            if probe in self._HELLO_PDUS
             else self.l1.auth
         )
         try:

@@ -51,6 +51,7 @@ class V3IfConfig:
     instance_id: int = 0
     if_type: IfType = IfType.POINT_TO_POINT
     priority: int = 1
+    loopback: bool = False
     auth: object = None  # packet_v3.AuthCtxV3 or None (RFC 7166 trailer)
 
 
@@ -61,6 +62,8 @@ class V3Interface:
     iface_id: int
     link_local: IPv6Address
     prefixes: list[IPv6Network] = field(default_factory=list)
+    # Link-scope LSDB (RFC 5340 §4.4.2: Link LSAs live per circuit).
+    link_lsdb: Lsdb = field(default_factory=Lsdb)
     up: bool = False
     neighbors: dict[IPv4Address, Neighbor] = field(default_factory=dict)
     # LAN state (RFC 5340 identifies DR/BDR by ROUTER-ID, not address).
@@ -125,6 +128,13 @@ class V6Route:
     prefix: IPv6Network
     dist: int
     nexthops: frozenset  # {(ifname, link-local addr)}
+    # ietf-ospf route-type identity for the local-rib state plane.
+    route_type: str = "intra-area"
+    # Prefix options from the originating LSA entry (LA propagates into
+    # the ABR's inter-area advertisement, like the reference).
+    prefix_options: int = 0
+    # Area that contributed the winning path (None for external).
+    area_id: object = None
 
 
 @dataclass
@@ -136,7 +146,10 @@ class V3Area:
     lsdb: Lsdb = field(default_factory=Lsdb)
     stub: bool = False
     nssa: bool = False
-    stub_default_cost: int = 1
+    # ietf-ospf summary=false: a totally-stubby area gets ONLY the
+    # default inter-area-prefix from its ABRs.
+    summary: bool = True
+    stub_default_cost: int = 10  # ietf-ospf default-cost default
 
     @property
     def no_external(self) -> bool:
@@ -166,6 +179,12 @@ class OspfV3Instance(Actor):
         self.interfaces: dict[str, V3Interface] = {}
         self.areas: dict[IPv4Address, V3Area] = {}
         self.routes: dict[IPv6Network, V6Route] = {}
+        # Configured virtual links [(transit area id, peer router id)];
+        # when empty, vlink peers are discovered from our backbone
+        # router-LSA and the best transit area is reported.
+        self.vlink_config: list = []
+        # Vlink endpoint state rows (ietf-ospf virtual-links render).
+        self.vlink_state: list = []
         # v6 prefixes we redistribute as AS-external LSAs (ASBR duty).
         self.redistributed: dict[IPv6Network, int] = {}  # prefix -> metric
         self.spf_run_count = 0
@@ -205,17 +224,20 @@ class OspfV3Instance(Actor):
         prefixes: list[IPv6Network],
         stub: bool = False,
         nssa: bool = False,
-        stub_default_cost: int = 1,
+        stub_default_cost: int = 10,
+        summary: bool = True,
     ) -> V3Interface:
         assert not (stub and nssa), "area cannot be both stub and NSSA"
         area = self.areas.get(cfg.area_id)
         if area is None:
             area = V3Area(cfg.area_id, stub=stub, nssa=nssa,
+                          summary=summary,
                           stub_default_cost=stub_default_cost)
             self.areas[cfg.area_id] = area
         else:
             area.stub = stub
             area.nssa = nssa
+            area.summary = summary
             area.stub_default_cost = stub_default_cost
         iface = V3Interface(
             name=ifname,
@@ -695,7 +717,7 @@ class OspfV3Instance(Actor):
         area = self._area_of(iface)
         exchanging = self._any_nbr_exchanging()
         for lsa in pkt.body.lsas:
-            cur = area.lsdb.get(lsa.key)
+            cur = self._scope_db(area, lsa.type, iface).get(lsa.key)
             # §13 (4): a MaxAge LSA with no database copy (and no
             # exchange in progress) is acked directly, never installed —
             # otherwise flushes ping-pong around multi-access links.
@@ -745,6 +767,13 @@ class OspfV3Instance(Actor):
         if drained:
             self._sweep_maxage()
 
+    def _scope_db(self, area: V3Area, ltype, iface=None):
+        """The database that owns LSAs of this type: the circuit's
+        link-scope LSDB for Link LSAs, the area LSDB otherwise."""
+        if P.scope_of(int(ltype)) == "link" and iface is not None:
+            return iface.link_lsdb
+        return area.lsdb
+
     def _install_and_flood(
         self, area: V3Area, lsa, from_iface=None, from_nbr=None
     ) -> None:
@@ -759,7 +788,14 @@ class OspfV3Instance(Actor):
                     continue
                 if other is not area:
                     other.lsdb.install(lsa, now)
-        _, changed = area.lsdb.install(lsa, now)
+        if P.scope_of(int(lsa.type)) == "link":
+            # Link scope lives in the circuit's own LSDB (§4.4.2) —
+            # never the area database.
+            if from_iface is None:
+                return
+            _, changed = from_iface.link_lsdb.install(lsa, now)
+        else:
+            _, changed = area.lsdb.install(lsa, now)
         if changed:
             self._schedule_spf()
         as_scope = P.scope_of(int(lsa.type)) == "as"
@@ -812,13 +848,16 @@ class OspfV3Instance(Actor):
         for iface in self.interfaces.values():
             for nbr in iface.neighbors.values():
                 held |= set(nbr.ls_rxmt)
-        for area in self.areas.values():
+        dbs = [a.lsdb for a in self.areas.values()] + [
+            i.link_lsdb for i in self.interfaces.values()
+        ]
+        for db in dbs:
             for key in [
                 k
-                for k, e in area.lsdb.entries.items()
+                for k, e in db.entries.items()
                 if e.lsa.is_maxage and k not in held
             ]:
-                area.lsdb.remove(key)
+                db.remove(key)
 
     def _arm_rxmt(self, iface: V3Interface, nbr: Neighbor) -> None:
         t = self._timer(
@@ -855,10 +894,16 @@ class OspfV3Instance(Actor):
     # -- origination
 
     def _originate(
-        self, area: V3Area, ltype: P.LsaType, lsid: IPv4Address, body
+        self, area: V3Area, ltype: P.LsaType, lsid: IPv4Address, body,
+        iface: "V3Interface | None" = None,
     ) -> None:
         key = P.LsaKey(ltype, lsid, self.router_id)
-        old = area.lsdb.get(key)
+        scope_db = (
+            iface.link_lsdb
+            if iface is not None and P.scope_of(int(ltype)) == "link"
+            else area.lsdb
+        )
+        old = scope_db.get(key)
         lsa = P.Lsa(
             age=0,
             type=ltype,
@@ -877,7 +922,7 @@ class OspfV3Instance(Actor):
             # (mid-flush, retained until rxmt lists drain) never
             # suppresses; wanting the LSA again needs a fresh instance.
             return
-        self._install_and_flood(area, lsa)
+        self._install_and_flood(area, lsa, from_iface=iface)
 
     def _refresh_self_lsa(
         self, area: V3Area, received, from_iface=None, from_nbr=None
@@ -889,7 +934,9 @@ class OspfV3Instance(Actor):
         flushed with MaxAge (a second LS Update), exactly the two-update
         sequence the reference's ospfv3 conformance cases record
         (tests/conformance/ospfv3/packet-lsupd-self-orig{1,2})."""
-        cur = area.lsdb.get(received.key)
+        cur = self._scope_db(area, received.type, from_iface).get(
+            received.key
+        )
         self._install_and_flood(
             area, received, from_iface=from_iface, from_nbr=from_nbr
         )
@@ -1019,15 +1066,60 @@ class OspfV3Instance(Actor):
     def _originate_intra_area_prefix(self) -> None:
         for area in self.areas.values():
             self._originate_intra_area_prefix_area(area)
+            self._originate_router_information(area)
+        self._originate_link_lsas()
+
+    def _originate_link_lsas(self) -> None:
+        """RFC 5340 §4.4.3.8: one Link LSA per up circuit — our
+        priority, options, link-local address, and the link's global
+        prefixes; link-state id = interface id."""
+        for iface in self.interfaces.values():
+            if not iface.up:
+                continue
+            area = self._area_of(iface)
+            self._originate(
+                area,
+                P.LsaType.LINK,
+                IPv4Address(iface.iface_id),
+                P.LsaLink(
+                    priority=iface.config.priority,
+                    link_local=iface.link_local,
+                    prefixes=list(iface.prefixes),
+                ),
+                iface=iface,
+            )
+
+    def _originate_router_information(self, area: V3Area) -> None:
+        """RFC 7770 Router-Information LSA, one per area (the v3 analog
+        of v2's RI opaque; the reference originates GR-helper +
+        stub-router capabilities at area start)."""
+        from holo_tpu.protocols.ospf.packet import (
+            RI_CAP_GR_HELPER,
+            RI_CAP_STUB_ROUTER,
+            encode_router_info,
+        )
+
+        caps = RI_CAP_STUB_ROUTER | RI_CAP_GR_HELPER
+        self._originate(
+            area,
+            P.LsaType.ROUTER_INFORMATION,
+            IPv4Address(0),
+            P.LsaRawBody(data=encode_router_info(caps)),
+        )
 
     def _originate_intra_area_prefix_area(self, area: V3Area) -> None:
         # Router-referenced LSA: p2p prefixes plus LAN prefixes whose LAN
         # has no active network LSA yet (stub behavior, RFC 5340 §4.4.3.9).
+        # Host prefixes carry the LA bit (§A.4.1.1 — local addresses).
         prefixes = []
         for iface in self._area_ifaces(area):
             if iface.up and not self._transit_active(iface):
                 for p in iface.prefixes:
-                    prefixes.append((p, iface.config.cost))
+                    prefixes.append((
+                        p,
+                        iface.config.cost,
+                        P.PREFIX_OPT_LA if p.prefixlen == 128 else 0,
+                    ))
         body = P.LsaIntraAreaPrefix(
             ref_type=int(P.LsaType.ROUTER),
             ref_lsid=IPv4Address(0),
@@ -1068,23 +1160,33 @@ class OspfV3Instance(Actor):
     def _age_tick(self) -> None:
         now = self.loop.clock.now()
         for area in self.areas.values():
-            for e in area.lsdb.refresh_due(now, self.router_id):
-                lsa = P.Lsa(
-                    age=0,
-                    type=e.lsa.type,
-                    lsid=e.lsa.lsid,
-                    adv_rtr=e.lsa.adv_rtr,
-                    seq_no=next_seq_no(e.lsa),
-                    body=e.lsa.body,
-                )
-                lsa.encode()
-                self._install_and_flood(area, lsa)
-            for key in area.lsdb.maxage_keys(now):
-                e = area.lsdb.get(key)
-                if e is not None and not e.lsa.is_maxage:
-                    # Natural expiry: pin the header age at MaxAge so the
-                    # flood (and the §14 sweep) see the flushed state.
-                    self._install_and_flood(area, self._maxage_copy(e.lsa))
+            # Link-scope databases age/refresh alongside the area's.
+            ifaces = [
+                i for i in self.interfaces.values()
+                if self._area_of(i) is area
+            ]
+            dbs = [(area.lsdb, None)] + [(i.link_lsdb, i) for i in ifaces]
+            for db, iface in dbs:
+                for e in db.refresh_due(now, self.router_id):
+                    lsa = P.Lsa(
+                        age=0,
+                        type=e.lsa.type,
+                        lsid=e.lsa.lsid,
+                        adv_rtr=e.lsa.adv_rtr,
+                        seq_no=next_seq_no(e.lsa),
+                        body=e.lsa.body,
+                    )
+                    lsa.encode()
+                    self._install_and_flood(area, lsa, from_iface=iface)
+                for key in db.maxage_keys(now):
+                    e = db.get(key)
+                    if e is not None and not e.lsa.is_maxage:
+                        # Natural expiry: pin the header age at MaxAge so
+                        # the flood (and the §14 sweep) see the flush.
+                        self._install_and_flood(
+                            area, self._maxage_copy(e.lsa),
+                            from_iface=iface,
+                        )
         # One §14 sweep per tick drops every drained MaxAge entry.
         self._sweep_maxage()
         self._age_timer.start(AGE_TICK)
@@ -1132,6 +1234,7 @@ class OspfV3Instance(Actor):
                     if link.link_type == P.RouterLinkType.VIRTUAL_LINK:
                         peers.add(link.nbr_router_id)
         best: dict = {}
+        via: dict = {}  # rid -> (transit aid, dist) for state rendering
         for rid in peers:
             for aid, (index, _k, res, atoms, _pl) in area_results.items():
                 if aid == IPv4Address(0):
@@ -1146,10 +1249,60 @@ class OspfV3Instance(Actor):
                 cur = best.get(rid)
                 if cur is None or dist < cur[0]:
                     best[rid] = (dist, nhs)
+                    via[rid] = (aid, dist)
                 elif dist == cur[0]:
                     # Parallel virtual links through different transit
                     # areas at equal cost: ECMP union (topo3-3 shape).
                     best[rid] = (dist, cur[1] | nhs)
+        # Operational state for the vlink endpoints (ietf-ospf
+        # virtual-links): peer, transit area, cost, and the peer's
+        # endpoint address — the LA host prefix it advertises in the
+        # transit area (RFC 5340 §4.4.3.9).
+        self.vlink_state = []
+        if self.vlink_config:
+            rows = []
+            for aid, rid in self.vlink_config:
+                pair = area_results.get(aid)
+                dist = None
+                if pair is not None:
+                    index, _k, res, atoms, _pl = pair
+                    v = index.get(("R", rid))
+                    if v is not None and res.dist[v] < INF:
+                        dist = int(res.dist[v])
+                if dist is not None:
+                    rows.append((rid, aid, dist))
+        else:
+            rows = [
+                (rid, aid, dist)
+                for rid, (aid, dist) in sorted(
+                    via.items(), key=lambda kv: int(kv[0])
+                )
+            ]
+        for rid, aid, dist in rows:
+            addr = None
+            transit = self.areas.get(aid)
+            if transit is not None:
+                for e in transit.lsdb.all():
+                    lsa = e.lsa
+                    if (
+                        lsa.type == P.LsaType.INTRA_AREA_PREFIX
+                        and lsa.adv_rtr == rid
+                    ):
+                        for entry in lsa.body.prefixes:
+                            if (
+                                entry[0].prefixlen == 128
+                                and lsa.body.entry_opts(entry)
+                                & P.PREFIX_OPT_LA
+                            ):
+                                addr = entry[0].network_address
+            self.vlink_state.append(
+                {
+                    "transit_area_id": aid,
+                    "router_id": rid,
+                    "cost": dist,
+                    "address": addr,
+                }
+            )
         return {rid: nhs for rid, (_d, nhs) in best.items()}
 
     def run_spf(self) -> None:
@@ -1185,14 +1338,21 @@ class OspfV3Instance(Actor):
                 if v is None or res.dist[v] >= INF:
                     continue
                 nhs = self._expand_atoms(res.nexthop_words[v], atoms)
-                for prefix, metric in body.prefixes:
+                for entry in body.prefixes:
+                    prefix, metric = entry[0], entry[1]
+                    opts = body.entry_opts(entry)
                     total = int(res.dist[v]) + metric
                     cur = intra.get(prefix)
                     if cur is None or total < cur.dist:
-                        intra[prefix] = V6Route(prefix, total, nhs)
+                        intra[prefix] = V6Route(
+                            prefix, total, nhs, prefix_options=opts,
+                            area_id=aid,
+                        )
                     elif total == cur.dist:
                         intra[prefix] = V6Route(
-                            prefix, total, cur.nexthops | nhs
+                            prefix, total, cur.nexthops | nhs,
+                            prefix_options=cur.prefix_options,
+                            area_id=aid,
                         )
             intra_by_area[aid] = intra
             for prefix, route in intra.items():
@@ -1201,7 +1361,8 @@ class OspfV3Instance(Actor):
                     routes[prefix] = route
                 elif route.dist == cur.dist:
                     routes[prefix] = V6Route(
-                        prefix, route.dist, cur.nexthops | route.nexthops
+                        prefix, route.dist, cur.nexthops | route.nexthops,
+                        route_type=cur.route_type,
                     )
 
         # 2. inter-area routes from received Inter-Area-Prefix LSAs:
@@ -1233,10 +1394,17 @@ class OspfV3Instance(Actor):
                 )
                 cur = inter_routes.get(prefix)
                 if cur is None or dist < cur.dist:
-                    inter_routes[prefix] = V6Route(prefix, dist, nhs)
+                    inter_routes[prefix] = V6Route(
+                        prefix, dist, nhs, route_type="inter-area",
+                        prefix_options=lsa.body.prefix_options,
+                        area_id=aid,
+                    )
                 elif dist == cur.dist:
                     inter_routes[prefix] = V6Route(
-                        prefix, dist, cur.nexthops | nhs
+                        prefix, dist, cur.nexthops | nhs,
+                        route_type="inter-area",
+                        prefix_options=cur.prefix_options,
+                        area_id=cur.area_id,
                     )
         for prefix, route in inter_routes.items():
             if prefix not in routes:
@@ -1286,11 +1454,15 @@ class OspfV3Instance(Actor):
                     dist = asbr_dist + lsa.body.metric
                 cur = ext_best.get(prefix)
                 if cur is None or rank < cur[0]:
-                    ext_best[prefix] = (rank, V6Route(prefix, dist, nhs))
+                    ext_best[prefix] = (
+                        rank,
+                        V6Route(prefix, dist, nhs, route_type="external"),
+                    )
                 elif rank == cur[0]:
                     ext_best[prefix] = (
                         rank,
-                        V6Route(prefix, dist, cur[1].nexthops | nhs),
+                        V6Route(prefix, dist, cur[1].nexthops | nhs,
+                                route_type="external"),
                     )
         for prefix, (_rank, route) in ext_best.items():
             routes[prefix] = route
@@ -1311,28 +1483,50 @@ class OspfV3Instance(Actor):
     ) -> None:
         backbone = IPv4Address(0)
         wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
+
+        def _nexthops_in_area(route, dst_aid) -> bool:
+            # area.rs:628-630 split horizon: skip a route whose next
+            # hops already exit through the destination area.
+            for ifname, _addr in route.nexthops:
+                iface = self.interfaces.get(ifname)
+                if iface is not None and iface.config.area_id == dst_aid:
+                    return True
+            return False
+
+        # The reference walks the final RIB (area.rs:602-643): intra
+        # routes summarize everywhere, inter routes into non-backbone
+        # areas only; a route never returns to its own area.
+        candidates: dict = {}
         for src_aid, intra in intra_by_area.items():
             for prefix, route in intra.items():
-                for dst_aid in self.areas:
-                    if dst_aid == src_aid:
-                        continue
-                    cur = wanted[dst_aid].get(prefix)
-                    if cur is None or route.dist < cur:
-                        wanted[dst_aid][prefix] = route.dist
-        # backbone-learned inter routes re-summarize into non-backbone
-        # areas (the v2 §12.4.3 hierarchy rule).
-        if backbone in self.areas:
-            for prefix, route in inter_routes.items():
-                for dst_aid in self.areas:
-                    if dst_aid == backbone:
-                        continue
-                    cur = wanted[dst_aid].get(prefix)
-                    if cur is None or route.dist < cur:
-                        wanted[dst_aid][prefix] = route.dist
+                cur = candidates.get(prefix)
+                if cur is None or route.dist < cur.dist:
+                    candidates[prefix] = route
+        for prefix, route in inter_routes.items():
+            if prefix not in candidates:  # intra always wins
+                candidates[prefix] = route
+        for prefix, route in candidates.items():
+            for dst_aid in self.areas:
+                if route.area_id == dst_aid:
+                    continue
+                if (
+                    route.route_type != "intra-area"
+                    and dst_aid == backbone
+                ):
+                    continue  # only intra advertises into the backbone
+                if not self.areas[dst_aid].summary:
+                    continue  # totally stubby: default only
+                if _nexthops_in_area(route, dst_aid):
+                    continue
+                cur = wanted[dst_aid].get(prefix)
+                if cur is None or route.dist < cur[0]:
+                    wanted[dst_aid][prefix] = (
+                        route.dist, route.prefix_options
+                    )
         default = IPv6Network("::/0")
         for aid, area in self.areas.items():
             if area.stub:
-                wanted[aid][default] = area.stub_default_cost
+                wanted[aid][default] = (area.stub_default_cost, 0)
         # ASBR reachability into other areas (Inter-Area-Router LSAs).
         asbr_wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
         for src_aid, (index, keys, res, atoms, _pl) in area_results.items():
@@ -1359,7 +1553,7 @@ class OspfV3Instance(Actor):
             area = self.areas[aid]
             wanted_lsids = set()
             for rid, dist in asbrs.items():
-                lsid = self._inter_lsid(("asbr", rid))
+                lsid = self._inter_lsid(aid, ("asbr", rid))
                 wanted_lsids.add(lsid)
                 self._originate(
                     area,
@@ -1379,14 +1573,16 @@ class OspfV3Instance(Actor):
         for aid, prefixes in wanted.items():
             area = self.areas[aid]
             wanted_lsids = set()
-            for prefix, dist in prefixes.items():
-                lsid = self._inter_lsid(prefix)
+            for prefix, (dist, popts) in prefixes.items():
+                lsid = self._inter_lsid(aid, prefix)
                 wanted_lsids.add(lsid)
                 self._originate(
                     area,
                     P.LsaType.INTER_AREA_PREFIX,
                     lsid,
-                    P.LsaInterAreaPrefix(metric=dist, prefix=prefix),
+                    P.LsaInterAreaPrefix(
+                        metric=dist, prefix=prefix, prefix_options=popts
+                    ),
                 )
             for key in list(area.lsdb.entries):
                 if (
@@ -1424,21 +1620,29 @@ class OspfV3Instance(Actor):
                 best = (dist, best[1] | nhs)
         return best
 
-    def _inter_lsid(self, prefix) -> IPv4Address:
-        """v3 link-state ids are opaque; allocate one per summarized
-        prefix (stable across re-originations)."""
+    def _inter_lsid(self, area_id, prefix) -> IPv4Address:
+        """v3 link-state ids are opaque; allocate one per (area,
+        summarized prefix) — the reference numbers them per area, and a
+        prefix summarized into two areas gets independent ids."""
         ids = self._inter_ids
-        lsid = ids.get(prefix)
+        key = (area_id, prefix)
+        lsid = ids.get(key)
         if lsid is None:
-            lsid = IPv4Address(0x1000 + len(ids))
-            ids[prefix] = lsid
+            # Gap-safe: next id after the highest in this area (seeded
+            # sets may be sparse after completed flushes).
+            top = max(
+                (int(l) for (a, _p), l in ids.items() if a == area_id),
+                default=0x0FFF,
+            )
+            lsid = IPv4Address(top + 1)
+            ids[key] = lsid
         return lsid
 
     def redistribute(self, prefix: IPv6Network, metric: int = 20) -> None:
         """ASBR: inject a v6 external as an AS-external LSA (AS scope)."""
         was_asbr = bool(self.redistributed)
         self.redistributed[prefix] = metric
-        lsid = self._inter_lsid(prefix)
+        lsid = self._inter_lsid(None, prefix)  # AS scope: one id space
         for area in self.areas.values():
             if area.no_external:
                 continue

@@ -1,0 +1,439 @@
+"""YANG-modeled OSPFv3 operational state.
+
+Renders a live :class:`OspfV3Instance` into the ietf-ospf state tree —
+the shape the reference serves and records in its v3 conformance
+snapshots (holo-ospf/src/northbound/state.rs; corpus:
+holo-ospf/tests/conformance/ospfv3/**/northbound-state.json).  Volatile
+leaves the reference marks ``ignore_in_testing`` (ages, seqnos,
+checksums, timestamps) are omitted, matching the recorded trees.
+
+Empty lists/containers are dropped, mirroring the reference's JSON
+printer.
+"""
+
+from __future__ import annotations
+
+from ipaddress import IPv4Address
+
+from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.protocols.ospf.packet import (
+    RI_CAP_GR_CAPABLE,
+    RI_CAP_GR_HELPER,
+    RI_CAP_STUB_ROUTER,
+    decode_router_info,
+)
+
+LSA_TYPE_NAME = {
+    P.LsaType.ROUTER: "ospfv3-router-lsa",
+    P.LsaType.NETWORK: "ospfv3-network-lsa",
+    P.LsaType.INTER_AREA_PREFIX: "ospfv3-inter-area-prefix-lsa",
+    P.LsaType.INTER_AREA_ROUTER: "ospfv3-inter-area-router-lsa",
+    P.LsaType.AS_EXTERNAL: "ospfv3-external-lsa-type",
+    P.LsaType.LINK: "ospfv3-link-lsa",
+    P.LsaType.INTRA_AREA_PREFIX: "ospfv3-intra-area-prefix-lsa",
+    P.LsaType.ROUTER_INFORMATION: "ospfv3-router-information-lsa",
+}
+
+_LSA_OPTION_BITS = [
+    (P.Options.V6, "v6-bit"),
+    (P.Options.E, "e-bit"),
+    (P.Options.DC, "dc-bit"),
+    (P.Options.R, "r-bit"),
+    (P.Options.AF, "af-bit"),
+]
+
+_PREFIX_OPTION_BITS = [
+    (0x01, "nu-bit"),
+    (P.PREFIX_OPT_LA, "la-bit"),
+    (0x08, "p-bit"),
+    (0x10, "dn-bit"),
+]
+
+_ROUTER_LINK_TYPE = {
+    P.RouterLinkType.POINT_TO_POINT: "point-to-point-link",
+    P.RouterLinkType.TRANSIT_NETWORK: "transit-network-link",
+    P.RouterLinkType.VIRTUAL_LINK: "virtual-link",
+}
+
+_RI_CAP_BITS = [
+    (RI_CAP_GR_CAPABLE, "graceful-restart"),
+    (RI_CAP_GR_HELPER, "graceful-restart-helper"),
+    (RI_CAP_STUB_ROUTER, "stub-router"),
+]
+
+
+def _a(x) -> str:
+    return str(x)
+
+
+def _bits(value, table) -> list[str]:
+    return [name for bit, name in table if int(value) & int(bit)]
+
+
+def _lsa_options(value) -> dict:
+    return {"lsa-options": _bits(value, _LSA_OPTION_BITS)}
+
+
+def _prefix_options(value) -> dict:
+    return {"prefix-options": _bits(value, _PREFIX_OPTION_BITS)}
+
+
+def lsa_header_yang(lsa: P.Lsa) -> dict:
+    return {
+        "lsa-id": int(lsa.lsid),
+        "type": LSA_TYPE_NAME.get(
+            lsa.type, "ospfv3-unknown-lsa-type"
+        ),
+        "adv-router": _a(lsa.adv_rtr),
+        "length": lsa.length or len(lsa.raw),
+    }
+
+
+def _ri_body_yang(lsa: P.Lsa) -> dict:
+    info = decode_router_info(lsa.body.data)
+    caps = info.get("info_caps", 0)
+    out: dict = {
+        "router-capabilities-tlv": {
+            "router-informational-capabilities": {
+                "informational-capabilities": _bits(caps, _RI_CAP_BITS)
+            },
+            "informational-capabilities-flags": [
+                {"informational-flag": int(bit)}
+                for bit, _name in _RI_CAP_BITS
+                if caps & bit
+            ],
+        }
+    }
+    return {"router-information": out}
+
+
+def lsa_body_yang(lsa: P.Lsa) -> dict:
+    body = lsa.body
+    t = lsa.type
+    if t == P.LsaType.ROUTER:
+        out: dict = {}
+        bits = []
+        if body.flags & P.RouterFlags.B:
+            bits.append("abr-bit")
+        if body.flags & P.RouterFlags.E:
+            bits.append("asbr-bit")
+        if body.flags & P.RouterFlags.V:
+            bits.append("vlink-end-bit")
+        if bits:
+            out["router-bits"] = {"rtr-lsa-bits": bits}
+        out["lsa-options"] = _lsa_options(body.options)
+        links = [
+            {
+                "interface-id": l.iface_id,
+                "neighbor-interface-id": l.nbr_iface_id,
+                "neighbor-router-id": _a(l.nbr_router_id),
+                "type": _ROUTER_LINK_TYPE.get(l.link_type, "unknown"),
+                "metric": l.metric,
+            }
+            for l in body.links
+        ]
+        if links:
+            out["links"] = {"link": links}
+        return {"router": out}
+    if t == P.LsaType.NETWORK:
+        return {
+            "network": {
+                "lsa-options": _lsa_options(body.options),
+                "attached-routers": {
+                    "attached-router": [_a(r) for r in body.attached]
+                },
+            }
+        }
+    if t == P.LsaType.INTER_AREA_PREFIX:
+        out = {"metric": body.metric, "prefix": str(body.prefix)}
+        if body.prefix_options:
+            out["prefix-options"] = _prefix_options(body.prefix_options)
+        return {"inter-area-prefix": out}
+    if t == P.LsaType.INTER_AREA_ROUTER:
+        return {
+            "inter-area-router": {
+                "lsa-options": _lsa_options(body.options),
+                "metric": body.metric,
+                "destination-router-id": _a(body.dest_router_id),
+            }
+        }
+    if t == P.LsaType.AS_EXTERNAL:
+        return {
+            "as-external": {
+                "metric": body.metric,
+                "flags": {"ospfv3-e-external-prefix-flags": (
+                    ["e-bit"] if body.e_bit else []
+                )},
+                "prefix": str(body.prefix),
+            }
+        }
+    if t == P.LsaType.LINK:
+        prefixes = [{"prefix": str(p)} for p in body.prefixes]
+        out = {
+            "rtr-priority": body.priority,
+            "lsa-options": _lsa_options(body.options),
+            "link-local-interface-address": str(body.link_local),
+            "num-of-prefixes": len(prefixes),
+        }
+        if prefixes:
+            out["prefixes"] = {"prefix": prefixes}
+        return {"link": out}
+    if t == P.LsaType.INTRA_AREA_PREFIX:
+        prefixes = []
+        for entry in body.prefixes:
+            prefix, metric = entry[0], entry[1]
+            opts = body.entry_opts(entry)
+            p: dict = {"prefix": str(prefix)}
+            if opts:
+                p["prefix-options"] = _prefix_options(opts)
+            p["metric"] = metric
+            prefixes.append(p)
+        out = {
+            "referenced-ls-type": LSA_TYPE_NAME.get(
+                P.LsaType(body.ref_type), "ospfv3-unknown-lsa-type"
+            ),
+            "referenced-link-state-id": int(body.ref_lsid),
+            "referenced-adv-router": _a(body.ref_adv_rtr),
+            "num-of-prefixes": len(prefixes),
+        }
+        if prefixes:
+            out["prefixes"] = {"prefix": prefixes}
+        return {"intra-area-prefix": out}
+    if t == P.LsaType.ROUTER_INFORMATION:
+        return _ri_body_yang(lsa)
+    return {}
+
+
+def render_lsa(lsa: P.Lsa) -> dict:
+    return {
+        "lsa-id": _a(lsa.lsid),
+        "adv-router": _a(lsa.adv_rtr),
+        "decode-completed": True,
+        "ospfv3": {
+            "header": lsa_header_yang(lsa),
+            "body": lsa_body_yang(lsa),
+        },
+    }
+
+
+def _db_buckets(entries, kind: str) -> tuple[list, list]:
+    """(full database buckets, statistics buckets) per 16-bit LSA type."""
+    by_type: dict[int, list] = {}
+    for e in entries:
+        by_type.setdefault(int(e.lsa.type), []).append(e.lsa)
+    full, stats = [], []
+    for ltype in sorted(by_type):
+        lsas = sorted(
+            by_type[ltype], key=lambda l: (int(l.adv_rtr), int(l.lsid))
+        )
+        full.append(
+            {
+                "lsa-type": ltype,
+                f"{kind}-scope-lsas": {
+                    f"{kind}-scope-lsa": [render_lsa(l) for l in lsas]
+                },
+            }
+        )
+        stats.append({"lsa-type": ltype, "lsa-count": len(lsas)})
+    return full, stats
+
+
+_ISM_NAME = {
+    "down": "down",
+    "loopback": "loopback",
+    "waiting": "waiting",
+    "point-to-point": "point-to-point",
+    "dr-other": "dr-other",
+    "bdr": "bdr",
+    "dr": "dr",
+}
+
+_NSM_NAME = {
+    NsmState.DOWN: "down",
+    NsmState.INIT: "init",
+    NsmState.TWO_WAY: "2-way",
+    NsmState.EX_START: "exstart",
+    NsmState.EXCHANGE: "exchange",
+    NsmState.LOADING: "loading",
+    NsmState.FULL: "full",
+}
+
+
+def _iface_state_name(inst, iface) -> str:
+    if not iface.up:
+        return "down"
+    if getattr(iface.config, "loopback", False):
+        return "loopback"
+    if iface.config.if_type == IfType.POINT_TO_POINT:
+        return "point-to-point"
+    if iface.dr == inst.router_id:
+        return "dr"
+    if iface.bdr == inst.router_id:
+        return "bdr"
+    return "dr-other"
+
+
+def _addr_of(inst, iface, rid):
+    if rid == inst.router_id:
+        return str(iface.link_local)
+    for nbr in iface.neighbors.values():
+        if nbr.router_id == rid:
+            return str(nbr.src)
+    return None
+
+
+def _dr_bdr_leaves(inst, iface) -> dict:
+    out: dict = {}
+    if int(iface.dr):
+        out["dr-router-id"] = _a(iface.dr)
+        addr = _addr_of(inst, iface, iface.dr)
+        if addr:
+            out["dr-ip-addr"] = addr
+    if int(iface.bdr):
+        out["bdr-router-id"] = _a(iface.bdr)
+        addr = _addr_of(inst, iface, iface.bdr)
+        if addr:
+            out["bdr-ip-addr"] = addr
+    return out
+
+
+def _iface_yang(inst, iface, link_entries) -> dict:
+    out: dict = {
+        "name": iface.name,
+        "state": _iface_state_name(inst, iface),
+    }
+    if iface.is_lan:
+        out.update(_dr_bdr_leaves(inst, iface))
+    full, stats = _db_buckets(link_entries, "link")
+    out["statistics"] = {
+        "link-scope-lsa-count": sum(s["lsa-count"] for s in stats),
+    }
+    if stats:
+        out["statistics"]["database"] = {"link-scope-lsa-type": stats}
+    nbrs = []
+    for rid, nbr in sorted(iface.neighbors.items(), key=lambda kv: int(kv[0])):
+        n: dict = {
+            "neighbor-router-id": _a(rid),
+            "address": str(nbr.src),
+        }
+        if iface.is_lan:
+            n.update(_dr_bdr_leaves(inst, iface))
+        n["state"] = _NSM_NAME.get(nbr.state, "down")
+        n["statistics"] = {"nbr-retrans-qlen": 0}
+        nbrs.append(n)
+    if nbrs:
+        out["neighbors"] = {"neighbor": nbrs}
+    if full:
+        out["database"] = {"link-scope-lsa-type": full}
+    out["interface-id"] = iface.iface_id
+    return out
+
+
+def instance_state(inst) -> dict:
+    """The ietf-ospf:ospf state subtree for one OSPFv3 instance."""
+    out: dict = {
+        "spf-control": {"ietf-spf-delay": {"current-state": "quiet"}},
+        "router-id": _a(inst.router_id),
+    }
+
+    routes = []
+    for prefix in sorted(
+        inst.routes,
+        key=lambda p: (int(p.network_address), p.prefixlen),
+    ):
+        r = inst.routes[prefix]
+        row: dict = {"prefix": str(prefix)}
+        nhs = []
+        for ifn, addr in sorted(
+            r.nexthops,
+            key=lambda t: (t[0], int(t[1]) if t[1] else 0),
+        ):
+            nh = {"outgoing-interface": ifn}
+            if addr is not None:
+                nh["next-hop"] = str(addr)
+            nhs.append(nh)
+        if nhs:
+            row["next-hops"] = {"next-hop": nhs}
+        row["metric"] = r.dist
+        row["route-type"] = r.route_type
+        routes.append(row)
+    if routes:
+        out["local-rib"] = {"route": routes}
+
+    out["statistics"] = {"as-scope-lsa-count": 0}
+
+    areas = []
+    for aid in sorted(inst.areas, key=int):
+        area = inst.areas[aid]
+        entries = list(area.lsdb.all())
+        full, stats = _db_buckets(entries, "area")
+        abr = sum(
+            1
+            for e in entries
+            if e.lsa.type == P.LsaType.ROUTER
+            and e.lsa.body.flags & P.RouterFlags.B
+        )
+        asbr = sum(
+            1
+            for e in entries
+            if e.lsa.type == P.LsaType.ROUTER
+            and e.lsa.body.flags & P.RouterFlags.E
+        )
+        a: dict = {
+            "area-id": _a(aid),
+            "statistics": {
+                "abr-count": abr,
+                "asbr-count": asbr,
+                "area-scope-lsa-count": sum(s["lsa-count"] for s in stats),
+            },
+        }
+        if stats:
+            a["statistics"]["database"] = {"area-scope-lsa-type": stats}
+        if int(aid) == 0 and getattr(inst, "vlink_state", None):
+            a["virtual-links"] = {
+                "virtual-link": [
+                    {
+                        "transit-area-id": _a(v["transit_area_id"]),
+                        "router-id": _a(v["router_id"]),
+                        "cost": v["cost"],
+                        "state": "point-to-point",
+                        "statistics": {"link-scope-lsa-count": 0},
+                        "neighbors": {
+                            "neighbor": [
+                                {
+                                    "neighbor-router-id": _a(
+                                        v["router_id"]
+                                    ),
+                                    **(
+                                        {"address": _a(v["address"])}
+                                        if v["address"] is not None
+                                        else {}
+                                    ),
+                                    "state": "full",
+                                    "statistics": {
+                                        "nbr-retrans-qlen": 0
+                                    },
+                                }
+                            ]
+                        },
+                    }
+                    for v in inst.vlink_state
+                ]
+            }
+        if full:
+            a["database"] = {"area-scope-lsa-type": full}
+        ifaces = []
+        for iface in sorted(inst.interfaces.values(), key=lambda i: i.name):
+            if inst._area_of(iface) is not area:
+                continue
+            ifaces.append(
+                _iface_yang(inst, iface, list(iface.link_lsdb.all()))
+            )
+        if ifaces:
+            a["interfaces"] = {"interface": ifaces}
+        areas.append(a)
+    if areas:
+        out["areas"] = {"area": areas}
+    return out

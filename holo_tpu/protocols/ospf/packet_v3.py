@@ -53,6 +53,8 @@ class LsaType(enum.IntEnum):
     AS_EXTERNAL = 0x4005
     LINK = 0x0008
     INTRA_AREA_PREFIX = 0x2009
+    # RFC 7770 Router Information, area scope (function code 12).
+    ROUTER_INFORMATION = 0xA00C
 
     # aliases used by the version-generic machinery:
     SUMMARY_NETWORK = 0x2003
@@ -67,6 +69,12 @@ class Options(enum.IntFlag):
     V6 = 0x01
     E = 0x02
     R = 0x10
+    DC = 0x20
+    AF = 0x0100  # RFC 5838 address-family capability
+
+
+# RFC 5340 §A.4.1.1 prefix options.
+PREFIX_OPT_LA = 0x02  # local address (host prefixes)
 
 
 class RouterLinkType(enum.IntEnum):
@@ -93,7 +101,7 @@ class RouterLinkV3:
 @dataclass
 class LsaRouterV3:
     flags: RouterFlags = RouterFlags(0)
-    options: Options = Options.V6 | Options.E | Options.R
+    options: Options = Options.V6 | Options.E | Options.R | Options.AF
     links: list[RouterLinkV3] = field(default_factory=list)
 
     def encode(self, w: Writer) -> None:
@@ -123,7 +131,7 @@ class LsaRouterV3:
 
 @dataclass
 class LsaNetworkV3:
-    options: Options = Options.V6 | Options.E | Options.R
+    options: Options = Options.V6 | Options.E | Options.R | Options.AF
     attached: list[IPv4Address] = field(default_factory=list)
 
     def encode(self, w: Writer) -> None:
@@ -171,16 +179,19 @@ def _decode_prefix(r: Reader) -> tuple[IPv6Network, int, int]:
 class LsaInterAreaPrefix:
     metric: int = 0
     prefix: IPv6Network = IPv6Network("::/0")
+    # Propagated prefix options (the reference carries the summarized
+    # intra prefix's LA bit through its inter-area advertisement).
+    prefix_options: int = 0
 
     def encode(self, w: Writer) -> None:
         w.u32(self.metric & 0xFFFFFF)
-        _encode_prefix(w, self.prefix)
+        _encode_prefix(w, self.prefix, options=self.prefix_options)
 
     @classmethod
     def decode(cls, r: Reader) -> "LsaInterAreaPrefix":
         metric = r.u32() & 0xFFFFFF
-        prefix, _, _ = _decode_prefix(r)
-        return cls(metric, prefix)
+        prefix, opts, _ = _decode_prefix(r)
+        return cls(metric, prefix, opts)
 
     # duck-type v2 LsaSummary for the generic ABR machinery
     @property
@@ -192,7 +203,7 @@ class LsaInterAreaPrefix:
 class LsaInterAreaRouter:
     """RFC 5340 §A.4.6: ABR-advertised reachability to an ASBR."""
 
-    options: Options = Options.V6 | Options.E | Options.R
+    options: Options = Options.V6 | Options.E | Options.R | Options.AF
     metric: int = 0
     dest_router_id: IPv4Address = IPv4Address(0)
 
@@ -213,7 +224,7 @@ class LsaInterAreaRouter:
 @dataclass
 class LsaLink:
     priority: int = 1
-    options: Options = Options.V6 | Options.E | Options.R
+    options: Options = Options.V6 | Options.E | Options.R | Options.AF
     link_local: IPv6Address = IPv6Address("fe80::1")
     prefixes: list[IPv6Network] = field(default_factory=list)
 
@@ -239,18 +250,31 @@ class LsaLink:
 
 @dataclass
 class LsaIntraAreaPrefix:
-    """Prefixes attached to a router/network vertex (RFC 5340 §A.4.10)."""
+    """Prefixes attached to a router/network vertex (RFC 5340 §A.4.10).
+
+    ``prefixes`` entries are (prefix, metric) or (prefix, metric,
+    prefix-options) — the 2-tuple form implies options 0, so existing
+    builders keep working while decode preserves the received bits
+    (LA etc.) for state rendering.
+    """
 
     ref_type: int = 0x2001
     ref_lsid: IPv4Address = IPv4Address(0)
     ref_adv_rtr: IPv4Address = IPv4Address(0)
-    prefixes: list[tuple[IPv6Network, int]] = field(default_factory=list)  # (prefix, metric)
+    prefixes: list[tuple] = field(default_factory=list)
+
+    @staticmethod
+    def entry_opts(entry: tuple) -> int:
+        return entry[2] if len(entry) > 2 else 0
 
     def encode(self, w: Writer) -> None:
         w.u16(len(self.prefixes)).u16(self.ref_type)
         w.ipv4(self.ref_lsid).ipv4(self.ref_adv_rtr)
-        for prefix, metric in self.prefixes:
-            _encode_prefix(w, prefix, metric=metric)
+        for entry in self.prefixes:
+            prefix, metric = entry[0], entry[1]
+            _encode_prefix(
+                w, prefix, options=self.entry_opts(entry), metric=metric
+            )
 
     @classmethod
     def decode(cls, r: Reader) -> "LsaIntraAreaPrefix":
@@ -259,8 +283,8 @@ class LsaIntraAreaPrefix:
         ref_lsid, ref_adv = r.ipv4(), r.ipv4()
         prefixes = []
         for _ in range(n):
-            p, _, metric = _decode_prefix(r)
-            prefixes.append((p, metric))
+            p, opts, metric = _decode_prefix(r)
+            prefixes.append((p, metric, opts))
         return cls(ref_type, ref_lsid, ref_adv, prefixes)
 
 
@@ -304,6 +328,9 @@ _BODY_CODECS = {
     LsaType.LINK: LsaLink,
     LsaType.INTRA_AREA_PREFIX: LsaIntraAreaPrefix,
     LsaType.AS_EXTERNAL: LsaAsExternalV3,
+    # RFC 7770 RI: same TLV wire format as v2's opaque RI — carried raw
+    # and parsed by the shared TLV decoder at state-render time.
+    LsaType.ROUTER_INFORMATION: LsaRawBody,
 }
 
 

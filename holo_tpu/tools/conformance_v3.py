@@ -75,6 +75,13 @@ class RouterData:
     # area id -> [Lsa]
     rx_lsas: dict = field(default_factory=dict)
     expected: list = field(default_factory=list)
+    # ifname -> OS ifindex (the reference's interface id, from the
+    # recorded InterfaceUpd events)
+    ifindex: dict = field(default_factory=dict)
+    # Configured virtual links [(transit area id, peer router id)].
+    vlinks: list = field(default_factory=list)
+    # The complete recorded ietf-ospf:ospf state tree (full-tree diff).
+    full_state: dict = field(default_factory=dict)
 
 
 def load_router(rt_dir: Path) -> RouterData:
@@ -89,8 +96,16 @@ def load_router(rt_dir: Path) -> RouterData:
         aid = IPv4Address(area["area-id"])
         stub = "stub" in (area.get("area-type") or "")
         rd.area_ids.append(aid)
+        summary = area.get("summary", True)
+        for vl in (area.get("virtual-links") or {}).get(
+            "virtual-link", []
+        ):
+            rd.vlinks.append(
+                (IPv4Address(vl["transit-area-id"]),
+                 IPv4Address(vl["router-id"]))
+            )
         for iface in area.get("interfaces", {}).get("interface", []):
-            rd.ifaces.append((aid, iface["name"], iface, stub))
+            rd.ifaces.append((aid, iface["name"], iface, (stub, summary)))
 
     ll, globs = {}, {}
     for line in (rt_dir / "events.jsonl").read_text().splitlines():
@@ -99,6 +114,9 @@ def load_router(rt_dir: Path) -> RouterData:
             continue
         ev = _loads_lenient(line)
         ibus = ev.get("Ibus")
+        if ibus and "InterfaceUpd" in ibus:
+            u = ibus["InterfaceUpd"]
+            rd.ifindex[u["ifname"]] = u.get("ifindex", 0)
         if ibus and "InterfaceAddressAdd" in ibus:
             upd = ibus["InterfaceAddressAdd"]
             try:
@@ -122,6 +140,8 @@ def load_router(rt_dir: Path) -> RouterData:
                         IPv4Address(hello["hdr"]["router_id"]),
                         IPv6Address(pkt_ev["src"]),
                         hello.get("iface_id", 0),
+                        hello.get("dr"),
+                        hello.get("bdr"),
                     )
                 )
             upd = packet.get("LsUpdate")
@@ -146,6 +166,7 @@ def load_router(rt_dir: Path) -> RouterData:
     ospf_state = state["ietf-routing:routing"]["control-plane-protocols"][
         "control-plane-protocol"
     ][0]["ietf-ospf:ospf"]
+    rd.full_state = ospf_state
     for route in ospf_state.get("local-rib", {}).get("route", []):
         nhs = set()
         for nh in route.get("next-hops", {}).get("next-hop", []):
@@ -214,6 +235,7 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
     inst = OspfV3Instance(
         name=f"conf3-{rd.name}", router_id=rd.router_id, netio=_NullIo()
     )
+    inst.vlink_config = list(rd.vlinks)
     loop.register(inst)
 
     # Bind every recorded hello to the right local interface by chaining
@@ -243,7 +265,7 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
                 our_links.extend(lsa.body.links)
     nbrs_by_ifname: dict = {}
     for key_hellos in rd.hellos.values():
-        for router_id, src, nbr_iface_id in key_hellos:
+        for router_id, src, nbr_iface_id, _dr, _bdr in key_hellos:
             ref = ll_to_ref.get(src)
             our_ifid = None
             if ref is not None:
@@ -284,10 +306,10 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
                 ifname = ifname_by_ll.get(ll)
             if ifname is not None:
                 nbrs_by_ifname.setdefault(ifname, []).append(
-                    (router_id, src, nbr_iface_id)
+                    (router_id, src, nbr_iface_id, _dr, _bdr)
                 )
 
-    for aid, ifname, icfg, stub in rd.ifaces:
+    for aid, ifname, icfg, (stub, summary) in rd.ifaces:
         link_local, prefixes = rd.addrs.get(ifname, (None, []))
         if link_local is None:
             link_local = IPv6Address("fe80::1")
@@ -298,18 +320,25 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
         )
         iface = inst.add_interface(
             ifname,
-            V3IfConfig(area_id=aid, if_type=if_type),
+            V3IfConfig(area_id=aid, if_type=if_type,
+                       loopback=ifname == "lo" or ifname.startswith("lo:")),
             link_local,
             prefixes,
             stub=stub,
+            summary=summary,
         )
         iface.up = True
-        # Use the reference's interface id (from our own Link-LSA) so
-        # self-originated network-vertex keys line up with the LSDB.
+        # Use the reference's interface id — the OS ifindex (recorded
+        # InterfaceUpd), which is also what its Link-LSA lsids carry —
+        # so self-originated network-vertex keys line up with the LSDB.
         ref = ll_to_ref.get(link_local)
         if ref is not None and ref[0] == rd.router_id:
             iface.iface_id = ref[1]
-        for router_id, src, nbr_iface_id in nbrs_by_ifname.get(
+        elif iface.config.loopback and ifname in rd.ifindex:
+            # Loopbacks have no Link-LSA to chain through; their id is
+            # the OS ifindex and keys nothing in the protocol.
+            iface.iface_id = rd.ifindex[ifname]
+        for router_id, src, nbr_iface_id, h_dr, h_bdr in nbrs_by_ifname.get(
             ifname, []
         ):
             nbr = iface.neighbors.get(router_id)
@@ -319,6 +348,12 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
                 )
                 iface.neighbors[router_id] = nbr
             nbr.iface_id = nbr_iface_id
+            # Converged DR/BDR from the last recorded hello claims (the
+            # reference ran the real election during recording).
+            if h_dr is not None and int(IPv4Address(h_dr)):
+                iface.dr = IPv4Address(h_dr)
+            if h_bdr is not None and int(IPv4Address(h_bdr)):
+                iface.bdr = IPv4Address(h_bdr)
         # LAN DR from the converged network LSAs: the LSA whose
         # (originator, iface id) matches one of this LAN's neighbors —
         # or our own interface — names the DR.
@@ -342,13 +377,51 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, ll_map: dict):
     for aid in rd.area_ids:
         if aid not in inst.areas:
             inst.areas[aid] = V3Area(aid)
+    # Link-scope LSAs (type 8) live in the owning circuit's LSDB; map
+    # each one through its originator's link-local to our interface.
+    ifname_of_ll = {
+        ll: ifname
+        for ifname, (ll, _g) in rd.addrs.items()
+        if ll is not None
+    }
+    # Seed the inter-area lsid allocator from the recorded SELF LSAs so
+    # our re-origination lands on the recorded link-state ids instead of
+    # duplicating them under fresh ones.
+    for aid, lsas in lsdb_by_area.items():
+        for lsa in lsas.values():
+            if lsa.adv_rtr != rd.router_id:
+                continue
+            if int(lsa.type) == 0x2003:
+                inst._inter_ids[(aid, lsa.body.prefix)] = lsa.lsid
+            elif int(lsa.type) == 0x2004:
+                inst._inter_ids[
+                    (aid, ("asbr", lsa.body.dest_router_id))
+                ] = lsa.lsid
     for aid, lsas in lsdb_by_area.items():
         if aid not in inst.areas:
             continue
         for lsa in lsas.values():
+            if int(lsa.type) == 8:
+                ll = ll_map.get((lsa.adv_rtr, int(lsa.lsid)))
+                target = None
+                if ll is not None:
+                    name = ifname_of_ll.get(ll)
+                    if name is not None:
+                        target = inst.interfaces.get(name)
+                    else:
+                        for iface in inst.interfaces.values():
+                            if any(
+                                n.src == ll
+                                for n in iface.neighbors.values()
+                            ):
+                                target = iface
+                                break
+                if target is not None:
+                    target.link_lsdb.install(lsa, 0.0)
+                continue  # never into the area database
             inst.areas[aid].lsdb.install(lsa, 0.0)
     inst.run_spf()
-    return inst.routes
+    return inst
 
 
 def compare_router(rd: RouterData, routes: dict) -> list[str]:
@@ -380,15 +453,57 @@ def compare_router(rd: RouterData, routes: dict) -> list[str]:
     return problems
 
 
+def compare_state(rd: RouterData, inst) -> list[str]:
+    """Full recorded ietf-ospf tree vs our YANG-modeled render — the
+    same both-sided contract the v2/IS-IS stepwise harnesses enforce."""
+    from holo_tpu.protocols.ospf.nb_state_v3 import instance_state
+    from holo_tpu.tools.treediff import tree_diff
+
+    return tree_diff(rd.full_state, instance_state(inst), "ospf")
+
+
+def router_lsdb(rd: RouterData, union: dict) -> dict:
+    """This router's LSDB view: foreign LSAs newest-per-key from ITS OWN
+    recorded stream (lsid reuse across re-originations means another
+    router's stream can hold a different final incarnation), self LSAs
+    from the topology union (a router never receives its own floods —
+    other routers' streams carry what we last originated)."""
+    out: dict = {}
+    for aid, lsas in rd.rx_lsas.items():
+        area = out.setdefault(aid, {})
+        for lsa in lsas:
+            cur = area.get(lsa.key)
+            if cur is None or lsa.compare(cur) > 0:
+                area[lsa.key] = lsa
+    for aid, lsas in union.items():
+        area = out.setdefault(aid, {})
+        for key, lsa in lsas.items():
+            if lsa.adv_rtr != rd.router_id:
+                continue
+            cur = area.get(key)
+            # Prefer the union only on a strictly higher seqno: lsid
+            # reuse can produce same-seqno different-content collisions
+            # across streams, and our own echo is authoritative then.
+            if cur is None or lsa.seq_no > cur.seq_no:
+                area[key] = lsa
+    # A winning MaxAge incarnation is a completed flush: the reference
+    # removed it from the database once acked (§14).
+    for area in out.values():
+        for key in [k for k, l in area.items() if l.is_maxage]:
+            del area[key]
+    return out
+
+
 def run_topology(topo_dir: Path) -> dict[str, list[str]]:
     routers = load_topology(topo_dir)
-    lsdb = converged_lsdb(routers)
+    union = converged_lsdb(routers)
     ll_map = link_lsa_map(routers)
     results = {}
     for name, rd in sorted(routers.items()):
         try:
-            routes = compute_routes(rd, lsdb, ll_map)
-            results[name] = compare_router(rd, routes)
+            inst = compute_routes(rd, router_lsdb(rd, union), ll_map)
+            results[name] = compare_router(rd, inst.routes)
+            results[name] += compare_state(rd, inst)
         except Exception as e:  # noqa: BLE001 — sweep must not die
             results[name] = [f"exception: {type(e).__name__}: {e}"]
     return results

@@ -52,7 +52,9 @@ def test_v3_lsa_roundtrips():
                     prefixes=[(N6("2001:db8:1::/64"), 10),
                               (N6("2001:db8:2::/48"), 20)]))
     out = P.Lsa.decode(Reader(iap.encode()))
-    assert out.body.prefixes == [(N6("2001:db8:1::/64"), 10),
+    # decode preserves per-prefix options as a third element (0 here)
+    assert [(p, m) for p, m, _o in out.body.prefixes] == [
+        (N6("2001:db8:1::/64"), 10),
                                  (N6("2001:db8:2::/48"), 20)]
 
     link = P.Lsa(1, P.LsaType.LINK, A("0.0.0.3"), A("1.1.1.1"), -98,
