@@ -2298,6 +2298,19 @@ class OspfInstance(Actor):
             flags |= RouterFlags.B
         if self.is_asbr:
             flags |= RouterFlags.E
+        # §12.4.1: the V bit marks this area as the transit area of one
+        # of our ACTIVE virtual links (its endpoint interface is up).
+        backbone = self.areas.get(IPv4Address(0))
+        if backbone is not None:
+            for taid, rid in self.config.virtual_links:
+                if taid != area.area_id:
+                    continue
+                if any(
+                    i.name == f"vlink-{taid}-{rid}"
+                    for i in backbone.interfaces.values()
+                ):
+                    flags |= RouterFlags.V
+                    break
         return LsaRouter(flags=flags, links=links)
 
     def _build_network_lsa(self, area: Area, iface: OspfInterface):
@@ -2592,6 +2605,21 @@ class OspfInstance(Actor):
         self._last_summary_inputs = (area_intra, inter_routes)
         backbone = IPv4Address(0)
         wanted: dict[IPv4Address, dict] = {aid: {} for aid in self.areas}
+
+        area_ifnames = {
+            aid: frozenset(a.interfaces) for aid, a in self.areas.items()
+        }
+
+        def _nexthops_in_area(route, dst_aid) -> bool:
+            # area.rs:628-630 split horizon: never summarize a route
+            # into the area its next hops already exit through (the
+            # vlink-transit case).
+            names = area_ifnames.get(dst_aid, frozenset())
+            return any(
+                nh.ifname in names
+                for nh in getattr(route, "nexthops", ())
+                if nh.ifname is not None
+            )
         for src_aid, routes in area_intra.items():
             if src_aid not in self.areas:
                 continue  # area deleted since that SPF ran
@@ -2628,6 +2656,9 @@ class OspfInstance(Actor):
                 for dst_aid in self.areas:
                     if dst_aid == src_aid:
                         continue
+                    r = routes.get(prefix)
+                    if r is not None and _nexthops_in_area(r, dst_aid):
+                        continue
                     cur = wanted[dst_aid].get(prefix)
                     if cur is None or dist < cur:
                         wanted[dst_aid][prefix] = dist
@@ -2636,6 +2667,8 @@ class OspfInstance(Actor):
                 continue
             for dst_aid in self.areas:
                 if dst_aid == backbone:
+                    continue
+                if _nexthops_in_area(route, dst_aid):
                     continue
                 cur = wanted[dst_aid].get(prefix)
                 if cur is None or route.dist < cur:
@@ -2771,16 +2804,27 @@ class OspfInstance(Actor):
             if pe is None or not (pe.lsa.body.flags & RouterFlags.B):
                 continue
             nhs = _atoms_of(res.nexthop_words[v], st.atoms)
-            out_if = next(
-                (nh.ifname for nh in nhs if nh.ifname is not None), None
+            # Deterministic egress for the unnumbered link-data: the
+            # lowest-addressed transit interface among the ECMP set.
+            cands = sorted(
+                (
+                    n
+                    for n in (
+                        nh.ifname for nh in nhs if nh.ifname is not None
+                    )
+                    if n in transit.interfaces
+                    and transit.interfaces[n].addr_ip is not None
+                ),
+                key=lambda n: int(transit.interfaces[n].addr_ip),
             )
+            out_if = cands[0] if cands else None
             dst = self._vlink_endpoint_addr(transit, rid, now)
             if out_if is None or dst is None:
                 continue
             phys = transit.interfaces.get(out_if)
             if phys is None or phys.addr_ip is None:
                 continue
-            wanted[f"vlink-{rid}"] = (
+            wanted[f"vlink-{taid}-{rid}"] = (
                 taid, rid, dst, out_if, phys.addr_ip, int(res.dist[v]),
                 phys.config.auth,
             )
@@ -2884,8 +2928,13 @@ class OspfInstance(Actor):
                     continue
                 cand = (int(res.dist[v]), int(aid))
                 cur = best.get(link.id)
-                if cur is None or cand < cur[:2]:
+                if cur is None or cand[0] < cur[0]:
                     best[link.id] = (*cand, nhs)
+                elif cand[0] == cur[0]:
+                    # Parallel virtual links through different transit
+                    # areas at equal cost: ECMP union (reference
+                    # topo3-3 shape).
+                    best[link.id] = (cur[0], cur[1], cur[2] | nhs)
         return {rid: nhs for rid, (_d, _a, nhs) in best.items()}
 
     def _originate_asbr_summaries(self, area_results: dict) -> None:

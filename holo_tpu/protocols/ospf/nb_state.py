@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from ipaddress import IPv4Address
 
-from holo_tpu.protocols.ospf.interface import IsmState, OspfInterface
+from holo_tpu.protocols.ospf.interface import IfType, IsmState, OspfInterface
 from holo_tpu.protocols.ospf.lsdb import Lsdb
 from holo_tpu.protocols.ospf.neighbor import NsmState
 from holo_tpu.protocols.ospf.packet import (
@@ -51,6 +51,29 @@ LSA_TYPE_NAME = {
     LsaType.OPAQUE_AREA: "ospfv2-area-scope-opaque-lsa",
     LsaType.OPAQUE_AS: "ospfv2-as-scope-opaque-lsa",
 }
+
+# RFC 8665 SID flag bit names, in the RECORDED corpus vintage's
+# spelling (ietf-ospf-sr module, '-bit' suffixes; the module prefix is
+# canonicalized away by the tree diff).
+_PREFIX_SID_BITS = [
+    (0x40, "np-bit"),
+    (0x20, "m-bit"),
+    (0x10, "e-bit"),
+    (0x08, "v-bit"),
+    (0x04, "l-bit"),
+]
+_ADJ_SID_BITS = [
+    (0x80, "b-bit"),
+    (0x40, "vi-bit"),
+    (0x20, "lo-bit"),
+    (0x10, "g-bit"),
+    (0x08, "p-bit"),
+]
+_EXT_LINK_TYPE = {
+    1: "point-to-point-link",
+    2: "transit-network-link",
+}
+EXT_LINK_OPAQUE_TYPE = 8
 
 _OPTION_BITS = [
     (Options.E, "v2-e-bit"),
@@ -191,6 +214,24 @@ def _opaque_body_yang(lsa: Lsa) -> dict:
                 },
                 "informational-capabilities-flags": flags,
             }
+        if info.get("sr_algos"):
+            ri["ietf-ospf-sr:sr-algorithm-tlv"] = {
+                "sr-algorithm": list(info["sr_algos"])
+            }
+        if info.get("srgb_ranges"):
+            ri["ietf-ospf-sr:sid-range-tlvs"] = {
+                "sid-range-tlv": [
+                    {
+                        "range-size": size,
+                        **(
+                            {"sid-sub-tlv": {"sid": first}}
+                            if first is not None
+                            else {}
+                        ),
+                    }
+                    for size, first in info["srgb_ranges"]
+                ]
+            }
         if info["hostname"]:
             ri["dynamic-hostname-tlv"] = {"hostname": info["hostname"]}
         if info["node_tags"]:
@@ -206,11 +247,10 @@ def _opaque_body_yang(lsa: Lsa) -> dict:
         return {"ri-opaque": ri}
     if otype == EXT_PREFIX_OPAQUE_TYPE:
         tlvs = []
-        for prefix, route_type, flags, _sids in decode_ext_prefix_entries(
+        for prefix, route_type, flags, sids in decode_ext_prefix_entries(
             data
         ):
             entry: dict = {
-                "prefix": str(prefix),
                 "route-type": _EXT_PREFIX_ROUTE_TYPE.get(
                     route_type, "unspecified"
                 ),
@@ -224,10 +264,71 @@ def _opaque_body_yang(lsa: Lsa) -> dict:
                 fl.append("ietf-ospf-anycast-flag:ac-flag")
             if fl:
                 entry["flags"] = {"extended-prefix-flags": fl}
+            entry["prefix"] = str(prefix)
+            if sids:
+                entry["ietf-ospf-sr:prefix-sid-sub-tlvs"] = {
+                    "prefix-sid-sub-tlv": [
+                        {
+                            "prefix-sid-flags": {
+                                "bits": _bits(
+                                    s["flags"], _PREFIX_SID_BITS
+                                )
+                            },
+                            "mt-id": s["mt"],
+                            "algorithm": s["algo"],
+                            "sid": s["sid"],
+                        }
+                        for s in sids
+                    ]
+                }
             tlvs.append(entry)
         return {
             "extended-prefix-opaque": {"extended-prefix-tlv": tlvs}
         }
+    if otype == EXT_LINK_OPAQUE_TYPE:
+        from holo_tpu.protocols.ospf.packet import decode_ext_link
+
+        links = decode_ext_link(data)
+        if not links:
+            return {}
+        ltype, link_id, link_data, sids = links[0]
+        out: dict = {
+            "link-id": str(link_id),
+            "link-data": str(link_data),
+            "type": _EXT_LINK_TYPE.get(ltype, "unknown"),
+        }
+        p2p = [s for s in sids if "nbr" not in s]
+        lan = [s for s in sids if "nbr" in s]
+        if p2p:
+            out["ietf-ospf-sr:adj-sid-sub-tlvs"] = {
+                "adj-sid-sub-tlv": [
+                    {
+                        "adj-sid-flags": {
+                            "bits": _bits(s["flags"], _ADJ_SID_BITS)
+                        },
+                        "mt-id": s["mt"],
+                        "weight": s["weight"],
+                        "sid": s["sid"],
+                    }
+                    for s in p2p
+                ]
+            }
+        if lan:
+            out["ietf-ospf-sr:lan-adj-sid-sub-tlvs"] = {
+                "lan-adj-sid-sub-tlv": [
+                    {
+                        "lan-adj-sid-flags": {
+                            "bits": _bits(s["flags"], _ADJ_SID_BITS)
+                        },
+                        "mt-id": s["mt"],
+                        "weight": s["weight"],
+                        "neighbor-router-id": str(s["nbr"]),
+                        "sid": s["sid"],
+                    }
+                    for s in lan
+                ]
+            }
+        return {"extended-link-opaque": {"extended-link-tlv": out}}
     return {}
 
 
@@ -459,13 +560,51 @@ def instance_state(inst) -> dict:
             a["statistics"]["database"] = {"area-scope-lsa-type": stats}
         if db:
             a["database"] = {"area-scope-lsa-type": db}
+        # Virtual links render in their own container (§15), never in
+        # the physical interface list.
+        phys = [
+            i for i in area.interfaces.values()
+            if i.config.if_type != IfType.VIRTUAL_LINK
+        ]
+        vlinks = [
+            i for i in area.interfaces.values()
+            if i.config.if_type == IfType.VIRTUAL_LINK
+        ]
+        if vlinks:
+            a["virtual-links"] = {
+                "virtual-link": [
+                    {
+                        "transit-area-id": v.name.rsplit("-", 2)[-2],
+                        "router-id": v.name.rsplit("-", 1)[-1],
+                        "cost": v.config.cost,
+                        "state": "point-to-point",
+                        "statistics": {"link-scope-lsa-count": 0},
+                        "neighbors": {
+                            "neighbor": [
+                                {
+                                    "neighbor-router-id": str(
+                                        n.router_id
+                                    ),
+                                    "address": str(n.src),
+                                    "state": _NSM_NAME[n.state],
+                                    "statistics": {
+                                        "nbr-retrans-qlen": len(
+                                            n.ls_rxmt
+                                        )
+                                    },
+                                }
+                                for n in v.neighbors.values()
+                            ]
+                        },
+                    }
+                    for v in sorted(vlinks, key=lambda i: i.name)
+                ]
+            }
         ifaces = [
             _iface_state(
                 inst, area, iface, link_by_iface.get(iface.name, []), now
             )
-            for iface in sorted(
-                area.interfaces.values(), key=lambda i: i.name
-            )
+            for iface in sorted(phys, key=lambda i: i.name)
         ]
         if ifaces:
             a["interfaces"] = {"interface": ifaces}

@@ -232,13 +232,37 @@ def encode_router_info(
 def decode_router_info(data: bytes) -> dict:
     """Returns {'info_caps': int, 'hostname': str|None, 'node_tags': tuple}."""
     r = Reader(data)
-    out = {"info_caps": 0, "hostname": None, "node_tags": ()}
+    out = {
+        "info_caps": 0, "hostname": None, "node_tags": (),
+        "sr_algos": (), "srgb_ranges": (),
+    }
     while r.remaining() >= 4:
         t = r.u16()
         length = r.u16()
         body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
         if t == 1 and body.remaining() >= 4:
             out["info_caps"] = body.u32()
+        elif t == 8:  # SR-Algorithm TLV (RFC 8665 §3.1)
+            out["sr_algos"] = tuple(
+                body.u8()
+                for _ in range(min(length, body.remaining()))
+            )
+        elif t == 9 and body.remaining() >= 4:  # SID/Label Range (§3.2)
+            size = body.u24()
+            body.u8()
+            first = None
+            if body.remaining() >= 4:
+                st = body.u16()
+                sl = body.u16()
+                if st == 1 and body.remaining() >= min(sl, 3):
+                    first = (
+                        body.u24()
+                        if sl == 3 or body.remaining() < 4
+                        else body.u32()
+                    )
+            out["srgb_ranges"] = out["srgb_ranges"] + (
+                (size, first),
+            )
         elif t == 7 and body.remaining() >= length:
             try:
                 out["hostname"] = body.bytes(length).decode()
@@ -325,23 +349,89 @@ def _walk_ext_prefix_tlv1(data: bytes, with_meta: bool = False):
 
 def decode_ext_prefix_entries(data: bytes) -> list:
     """All Extended-Prefix TLVs of an opaque LSA, fully parsed:
-    [(prefix, route_type, flags, {sid_index: sid_flags})]."""
+    [(prefix, route_type, flags, [{flags, mt, algo, sid}])] — the SID
+    sub-TLV fields per RFC 8665 §5."""
     out = []
     for prefix, route_type, flags, body in _walk_ext_prefix_tlv1(
         data, with_meta=True
     ):
-        sids = {}
+        sids = []
         while body.remaining() >= 4:
             st = body.u16()
             sl = body.u16()
             sbody = body.sub(min((sl + 3) // 4 * 4, body.remaining()))
             if st == 2 and sbody.remaining() >= 8:
                 sid_flags = sbody.u8()
-                sbody.u8()
-                sbody.u8()
-                sbody.u8()
-                sids[sbody.u32()] = sid_flags
+                sbody.u8()  # reserved
+                mt = sbody.u8()
+                algo = sbody.u8()
+                sids.append(
+                    {
+                        "flags": sid_flags,
+                        "mt": mt,
+                        "algo": algo,
+                        "sid": sbody.u32(),
+                    }
+                )
         out.append((prefix, route_type, flags, sids))
+    return out
+
+
+def decode_ext_link(data: bytes) -> list:
+    """Extended-Link TLVs (RFC 7684 §3, opaque type 8) with their
+    Adj-SID sub-TLVs (RFC 8665 §6.1):
+    [(link_type, link_id, link_data, [{flags, mt, weight, sid}])]."""
+    r = Reader(data)
+    out = []
+    while r.remaining() >= 4:
+        t = r.u16()
+        length = r.u16()
+        body = r.sub(min((length + 3) // 4 * 4, r.remaining()))
+        if t != 1 or body.remaining() < 12:  # Extended-Link TLV
+            continue
+        ltype = body.u8()
+        body.u8()
+        body.u16()
+        link_id = body.ipv4()
+        link_data = body.ipv4()
+        sids = []
+        while body.remaining() >= 4:
+            st = body.u16()
+            sl = body.u16()
+            sbody = body.sub(min((sl + 3) // 4 * 4, body.remaining()))
+            if st == 2 and sbody.remaining() >= 7:  # Adj-SID
+                fl = sbody.u8()
+                sbody.u8()  # reserved
+                mt = sbody.u8()
+                weight = sbody.u8()
+                # sub-TLV length decides the SID width: 7 = 3-byte
+                # label (L flag), 8 = 4-byte index (§6.1).
+                sid = (
+                    sbody.u24()
+                    if sl == 7 or sbody.remaining() < 4
+                    else sbody.u32()
+                )
+                sids.append(
+                    {"flags": fl, "mt": mt, "weight": weight, "sid": sid}
+                )
+            elif st == 3 and sbody.remaining() >= 11:  # LAN Adj-SID
+                fl = sbody.u8()
+                sbody.u8()
+                mt = sbody.u8()
+                weight = sbody.u8()
+                nbr = sbody.ipv4()
+                sid = (
+                    sbody.u24()
+                    if sl == 11 or sbody.remaining() < 4
+                    else sbody.u32()
+                )
+                sids.append(
+                    {
+                        "flags": fl, "mt": mt, "weight": weight,
+                        "nbr": nbr, "sid": sid,
+                    }
+                )
+        out.append((ltype, link_id, link_data, sids))
     return out
 
 

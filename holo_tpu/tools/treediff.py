@@ -11,6 +11,7 @@ focused diff instead of a cascade.
 from __future__ import annotations
 
 import json
+import re
 
 # YANG list-entry keys by list name (union across the protocols' trees;
 # name collisions resolve to compatible keys).
@@ -83,6 +84,25 @@ def tree_diff(exp, got, path: str, list_keys: dict | None = None) -> list[str]:
         for i, (e, g) in enumerate(zip(exp_s, got_s)):
             problems += tree_diff(e, g, f"{path}[{i}]", keys_map)
         return problems
-    if exp != got:
+    if exp != got and not _identity_eq(exp, got):
         problems.append(f"{path}: {got!r} != {exp!r}")
     return problems
+
+
+_IDENTITY_PREFIX = re.compile(r"^[a-z][a-z0-9.-]*:(?=[a-z])")
+
+
+def _identity_eq(a, b) -> bool:
+    """YANG identityref leaves may or may not carry the module prefix
+    depending on the recording's libyang vintage ('ietf-ospf:v2-e-bit'
+    vs 'v2-e-bit'): equal when stripping the prefix from the ONE side
+    that has it yields the other.  Requiring the other side to be
+    colon-free keeps IPv6 literals (both sides have colons) and
+    cross-module identities (both sides prefixed) unequal."""
+    if not (isinstance(a, str) and isinstance(b, str)):
+        return False
+    if ":" not in a and _IDENTITY_PREFIX.match(b):
+        return _IDENTITY_PREFIX.sub("", b) == a
+    if ":" not in b and _IDENTITY_PREFIX.match(a):
+        return _IDENTITY_PREFIX.sub("", a) == b
+    return False
