@@ -293,9 +293,18 @@ def _render_lsp(lsp, entry_meta=None) -> dict:
             ]
         }
     if t.get("mt_ids"):
-        out["mt-entries"] = {
-            "topology": [{"mt-id": mt} for mt, _a, _o in t["mt_ids"]]
-        }
+        topo_nodes = []
+        for mt, att, ovl in t["mt_ids"]:
+            tn: dict = {"mt-id": mt}
+            flags = []
+            if ovl:
+                flags.append("tlv229-overload-flag")
+            if att:
+                flags.append("tlv229-attached-flag")
+            if flags:
+                tn["attributes"] = {"flags": flags}
+            topo_nodes.append(tn)
+        out["mt-entries"] = {"topology": topo_nodes}
     if any(
         t.get(k)
         for k in ("sr_cap", "srlb", "node_msd", "node_tags", "sr_algos")
@@ -393,7 +402,15 @@ def _render_iface(insts, ifname: str) -> dict:
             lvl = f"level-{inst.level}"
             sys_type = lvl
             ctype = getattr(a, "usage_ctype", None)
-            if not getattr(iface, "is_lan", False):
+            if getattr(iface, "is_lan", False):
+                # LAN adjacencies stay per-level in the arena, but the
+                # sys-type reflects the NEIGHBOR's announced circuit
+                # type (its LAN IIH carries it).
+                if ctype == 3:
+                    sys_type = "level-all"
+                elif ctype in (1, 2):
+                    sys_type = f"level-{ctype}"
+            else:
                 # p2p: sys-type is what the neighbor's hello announced;
                 # usage is the negotiated intersection with our levels.
                 if ctype == 3:

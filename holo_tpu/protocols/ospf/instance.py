@@ -2298,16 +2298,17 @@ class OspfInstance(Actor):
             flags |= RouterFlags.B
         if self.is_asbr:
             flags |= RouterFlags.E
-        # §12.4.1: the V bit marks this area as the transit area of one
-        # of our ACTIVE virtual links (its endpoint interface is up).
+        # §12.4.1: the V bit marks this area as the transit area of a
+        # FULLY ADJACENT virtual link of ours.
         backbone = self.areas.get(IPv4Address(0))
         if backbone is not None:
             for taid, rid in self.config.virtual_links:
                 if taid != area.area_id:
                     continue
-                if any(
-                    i.name == f"vlink-{taid}-{rid}"
-                    for i in backbone.interfaces.values()
+                vl = backbone.interfaces.get(f"vlink-{taid}-{rid}")
+                if vl is not None and any(
+                    self._nbr_counts_full(n)
+                    for n in vl.neighbors.values()
                 ):
                     flags |= RouterFlags.V
                     break
@@ -2630,6 +2631,9 @@ class OspfInstance(Actor):
             src_ranges = self.areas[src_aid].ranges
             eff: dict = {}
             range_max: dict = {}
+            # Areas a range's COMPONENT routes exit through: the split
+            # horizon below must also cover range aggregates.
+            range_nh_areas: dict = {}
             for prefix, route in routes.items():
                 matches = [
                     r for r in src_ranges if prefix.subnet_of(r["prefix"])
@@ -2645,6 +2649,12 @@ class OspfInstance(Actor):
                 elif rng.get("advertise", True):
                     cur = range_max.get(rng["prefix"], -1)
                     range_max[rng["prefix"]] = max(cur, route.dist)
+                    acc = range_nh_areas.setdefault(
+                        rng["prefix"], set()
+                    )
+                    for aid2 in self.areas:
+                        if _nexthops_in_area(route, aid2):
+                            acc.add(aid2)
             for r in src_ranges:
                 if r["prefix"] in range_max:
                     eff[r["prefix"]] = (
@@ -2659,6 +2669,8 @@ class OspfInstance(Actor):
                     r = routes.get(prefix)
                     if r is not None and _nexthops_in_area(r, dst_aid):
                         continue
+                    if dst_aid in range_nh_areas.get(prefix, ()):
+                        continue  # aggregate: component split horizon
                     cur = wanted[dst_aid].get(prefix)
                     if cur is None or dist < cur:
                         wanted[dst_aid][prefix] = dist
@@ -2912,10 +2924,10 @@ class OspfInstance(Actor):
         from holo_tpu.ops.graph import INF
 
         # The transit area is the one actually carrying the vlink
-        # (§16.1).  Without per-vlink config we pick it deterministically:
-        # the area giving the shortest intra-area path to the endpoint,
-        # lowest area-id on ties — never dict iteration order.
-        best: dict = {}  # rid -> (dist, area id, nhs)
+        # (§16.1): shortest intra-area path to the endpoint; equal-cost
+        # paths through DIFFERENT transit areas union their next hops
+        # (parallel virtual links, reference topo3-3).
+        best: dict = {}  # rid -> (dist, area id of first best, nhs)
         for link in e.lsa.body.links:
             if link.link_type != RouterLinkType.VIRTUAL_LINK:
                 continue

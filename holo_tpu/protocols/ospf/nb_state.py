@@ -577,8 +577,12 @@ def instance_state(inst) -> dict:
                         "transit-area-id": v.name.rsplit("-", 2)[-2],
                         "router-id": v.name.rsplit("-", 1)[-1],
                         "cost": v.config.cost,
-                        "state": "point-to-point",
-                        "statistics": {"link-scope-lsa-count": 0},
+                        "state": _ISM_NAME[v.state],
+                        "statistics": {
+                            "link-scope-lsa-count": len(
+                                link_by_iface.get(v.name, [])
+                            )
+                        },
                         "neighbors": {
                             "neighbor": [
                                 {

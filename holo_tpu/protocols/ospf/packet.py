@@ -254,7 +254,7 @@ def decode_router_info(data: bytes) -> dict:
             if body.remaining() >= 4:
                 st = body.u16()
                 sl = body.u16()
-                if st == 1 and body.remaining() >= min(sl, 3):
+                if st == 1 and body.remaining() >= 3:
                     first = (
                         body.u24()
                         if sl == 3 or body.remaining() < 4

@@ -327,12 +327,7 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, routers: dict,
     # and summary origination runs.  DR/BDR details stay post-SPF.
     for area in inst.areas.values():
         for iface in area.interfaces.values():
-            if iface.config.loopback:
-                iface.state = IsmState.LOOPBACK
-            elif iface.config.if_type == IfType.POINT_TO_POINT:
-                iface.state = IsmState.POINT_TO_POINT
-            else:
-                iface.state = IsmState.DR_OTHER
+            iface.state = _base_ism_state(iface, IsmState)
     inst.run_spf()
     # Virtual links: the first SPF materialized the vlink interfaces
     # (reachable endpoints); synthesize their FULL adjacencies — the
@@ -388,12 +383,8 @@ def compute_routes(rd: RouterData, lsdb_by_area: dict, routers: dict,
     # computation (the vlink machinery consults circuit state).
     for area in inst.areas.values():
         for iface in area.interfaces.values():
-            if iface.config.loopback:
-                iface.state = IsmState.LOOPBACK
-            elif iface.config.if_type == IfType.POINT_TO_POINT:
-                iface.state = IsmState.POINT_TO_POINT
-            else:
-                iface.state = IsmState.DR_OTHER
+            iface.state = _base_ism_state(iface, IsmState)
+            if iface.state == IsmState.DR_OTHER:
                 # Converged DR/BDR from the recorded hello claims of
                 # any neighbor on this segment (the reference ran the
                 # real election during recording).
@@ -471,6 +462,19 @@ def compare_router(rd: RouterData, routes: dict) -> list[str]:
     for prefix in routes.keys() - expected_by_prefix.keys():
         problems.append(f"unexpected extra route {prefix}")
     return problems
+
+
+def _base_ism_state(iface, IsmState):
+    """Converged base ISM state by interface type (used both for the
+    pre-SPF ABR-detection posture and the render posture)."""
+    if iface.config.loopback:
+        return IsmState.LOOPBACK
+    if iface.config.if_type in (
+        IfType.POINT_TO_POINT,
+        IfType.VIRTUAL_LINK,
+    ):
+        return IsmState.POINT_TO_POINT
+    return IsmState.DR_OTHER
 
 
 def _prune_adj_sid_labels(tree):
