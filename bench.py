@@ -46,6 +46,7 @@ STAGE_TIMEOUT = {
     "scale50k_b256": 1500,
     "whatif1024": 900,
     "cspf10k": 900,
+    "cpu100": 300,
     "cpubaseline": 600,
 }
 
@@ -316,6 +317,25 @@ def stage_cspf10k(k, B):
     }
 
 
+def stage_cpu100(runs=200):
+    """BASELINE.md config 1: the 100-router single-area LSDB — full-SPF
+    runs/sec + p50 on the scalar CPU reference (TPU only wins at scale;
+    this row documents the small-LSDB floor it must not regress)."""
+    from holo_tpu.spf.synth import random_ospf_topology
+
+    topo = random_ospf_topology(
+        n_routers=100, n_networks=20, extra_p2p=150, seed=3
+    )
+    masks = np.ones((runs, topo.n_edges), bool)
+    _, cpu_rps, cpu_p50 = _cpu_baseline(topo, masks, runs)
+    return {
+        "ok": True,
+        "cpu_runs_per_sec": cpu_rps,
+        "cpu_p50_ms": cpu_p50,
+        "n_vertices": int(topo.n_vertices),
+    }
+
+
 def stage_cpubaseline(k, runs):
     """C++ scalar baseline only (no JAX device needed): the interpretable
     row to lead with when the relay is down."""
@@ -403,6 +423,7 @@ def main() -> None:
             "scale50k_b256": lambda: stage_scale50k(k50, b256, cpu50, engine=eng),
             "whatif1024": lambda: stage_whatif1024(k10, 8 if small else 16),
             "cspf10k": lambda: stage_cspf10k(k10, 32 if small else 256),
+            "cpu100": lambda: stage_cpu100(32 if small else 200),
             "cpubaseline": lambda: stage_cpubaseline(k10, cpu10),
         }[stage]
         print(json.dumps(fn()))
@@ -422,6 +443,7 @@ def main() -> None:
         k10 = 20 if small else 90
         cpu10 = 8 if small else 32
         extra["cpubaseline"] = _run_stage("cpubaseline", small)
+        extra["cpu100"] = _run_stage("cpu100", small)  # device-free row
         extra["gather10k_jaxcpu_small"] = _run_stage("gather10k", True, cpu=True)
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -484,6 +506,8 @@ def main() -> None:
         # what-if) — coverage rows, not the headline.
         extra["whatif1024"] = _run_stage("whatif1024", small)
         extra["cspf10k"] = _run_stage("cspf10k", small)
+    # Config 1: the 100-router CPU-reference floor (no device needed).
+    extra["cpu100"] = _run_stage("cpu100", small)
 
     n10 = "500" if small else "10125"
     blocked = extra.get("blocked10k", {})
