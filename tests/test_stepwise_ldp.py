@@ -2,7 +2,7 @@
 through the live LdpEngine + real RFC 5036 wire codec
 (tools/stepwise_ldp.py).
 
-All 70 step-case directories pass — discovery (link + targeted hellos,
+All 70 step-case directories pass (the CLI sweep also replays the 10 topology routers, reporting 80 total) — discovery (link + targeted hellos,
 hold timeouts, hello-accept), session establishment (TCP accept/connect
 roles, init/keepalive FSM, backoff), the full label distribution set
 (mapping/request/withdraw/release incl. typed-wildcard FECs, No-Route and
