@@ -186,6 +186,7 @@ def targets() -> dict:
         "ospfv2_router_info_decode": ospf_pkt.decode_router_info,
         "ospfv2_ext_prefix_decode": ospf_pkt.decode_ext_prefix_entries,
         "ospfv2_grace_tlvs_decode": ospf_pkt.decode_grace_tlvs,
+        "ospfv2_ext_link_decode": ospf_pkt.decode_ext_link,
         "ospfv3_packet_decode": v3.Packet.decode,
         "ospfv3_lsa_decode": lambda b: v3.Lsa.decode(Reader(b)),
         # isis/ (reference: isis_pdu_decode; split by PDU class for
