@@ -1341,6 +1341,7 @@ class RoutingProvider(Provider, Actor):
                         if n.get("authentication-key")
                         else None,
                     )
+                    tcp_io.update_mss(addr, n.get("tcp-mss") or None)
                 continue
             # Outgoing interface: longest-prefix interface subnet
             # containing the peer (single-hop eBGP/iBGP assumption).
@@ -1400,6 +1401,7 @@ class RoutingProvider(Provider, Actor):
                     ),
                     # 0 means "not configured" (the uint8 leaf default).
                     ttl_security=n.get("ttl-security") or None,
+                    tcp_mss=n.get("tcp-mss") or None,
                 )
             inst.start_peer(addr)
         # Neighbors removed from config: drop the session + their routes.

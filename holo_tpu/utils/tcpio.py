@@ -80,6 +80,8 @@ class _PeerSlot:
     # expected hop budget, we send TTL 255 and require received TTL
     # >= 255 - hops + 1 via IP_MINTTL.
     ttl_security: int | None = None
+    # TCP Maximum Segment Size (reference network.rs set_mss).
+    tcp_mss: int | None = None
     sock: socket.socket | None = None  # established connection
     connecting: socket.socket | None = None
     rxbuf: bytearray = field(default_factory=bytearray)
@@ -90,6 +92,21 @@ class _PeerSlot:
 _TTL_MAX = 255
 IP_MINTTL = 21  # Linux setsockopt optname (IPPROTO_IP level)
 IPV6_MINHOPCOUNT = 73
+
+
+def _apply_mss(s: socket.socket, slot: "_PeerSlot") -> None:
+    if slot.tcp_mss is not None:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_MAXSEG, slot.tcp_mss)
+
+
+def _listener_mss(ls: socket.socket, peers) -> None:
+    """The MSS a passive side advertises is fixed at SYN-ACK time, so the
+    clamp must sit on the LISTENER, not the accepted socket.  One listener
+    serves every peer on its address: advertise the smallest configured
+    value (conservative for all of them)."""
+    vals = [p.tcp_mss for p in peers if p.tcp_mss is not None]
+    if vals:
+        ls.setsockopt(socket.IPPROTO_TCP, socket.TCP_MAXSEG, min(vals))
 
 
 def _apply_gtsm(s: socket.socket, slot: "_PeerSlot") -> None:
@@ -156,13 +173,21 @@ class BgpTcpIo(NetIo):
                 set_md5sig(s, slot.peer_ip, slot.md5_key)
             if slot.ttl_security is not None:
                 _listener_max_ttl(s, isinstance(ip, IPv6Address))
+        _listener_mss(
+            s, [p for p in self.peers.values() if p.local_ip == ip]
+        )
 
     def add_peer(self, local_ip, peer_ip, ifname: str = "tcp", md5_key=None,
-                 ttl_security: int | None = None):
+                 ttl_security: int | None = None,
+                 tcp_mss: int | None = None):
         if ttl_security is not None and not 1 <= ttl_security <= 255:
             raise ValueError(
                 f"ttl_security hops must be 1-255, got {ttl_security}"
             )
+        if tcp_mss is not None and not 88 <= tcp_mss <= 32767:
+            # Linux rejects TCP_MAXSEG outside this range with EINVAL,
+            # which would otherwise surface only as a silent retry loop.
+            raise ValueError(f"tcp_mss must be 88-32767, got {tcp_mss}")
         lip, pip = ip_address(local_ip), ip_address(peer_ip)
         slot = _PeerSlot(
             peer_ip=pip,
@@ -170,6 +195,7 @@ class BgpTcpIo(NetIo):
             ifname=ifname,
             md5_key=md5_key,
             ttl_security=ttl_security,
+            tcp_mss=tcp_mss,
             active=int(lip) > int(pip),
         )
         self.peers[pip] = slot
@@ -184,7 +210,41 @@ class BgpTcpIo(NetIo):
                     _listener_max_ttl(ls, isinstance(pip, IPv6Address))
                 except OSError as e:
                     log.error("listener TTL bump failed: %s", e)
+            try:
+                _listener_mss(
+                    ls,
+                    [p for p in self.peers.values()
+                     if p.local_ip == slot.local_ip],
+                )
+            except OSError as e:
+                log.error("listener MSS clamp failed: %s", e)
         return slot
+
+    def update_mss(self, peer_ip, tcp_mss: int | None) -> None:
+        """Live tcp-mss reconfiguration.  Re-clamps the listeners (for
+        future inbound handshakes) and best-effort lowers the current
+        session's segment size; the negotiated ceiling from the original
+        handshake still applies until the next reconnect."""
+        if tcp_mss is not None and not 88 <= tcp_mss <= 32767:
+            raise ValueError(f"tcp_mss must be 88-32767, got {tcp_mss}")
+        slot = self.peers.get(ip_address(peer_ip))
+        if slot is None or slot.tcp_mss == tcp_mss:
+            return
+        slot.tcp_mss = tcp_mss
+        for ls in self._listeners.values():
+            try:
+                _listener_mss(
+                    ls,
+                    [p for p in self.peers.values()
+                     if p.local_ip == slot.local_ip],
+                )
+            except OSError as e:
+                log.error("listener MSS clamp failed: %s", e)
+        if slot.sock is not None and tcp_mss is not None:
+            try:
+                _apply_mss(slot.sock, slot)
+            except OSError as e:
+                log.error("live MSS update on %s failed: %s", peer_ip, e)
 
     def remove_peer(self, peer_ip) -> None:
         """Deconfigure: close any sockets and stop reconnecting."""
@@ -296,6 +356,7 @@ class BgpTcpIo(NetIo):
             if slot.md5_key:
                 set_md5sig(s, slot.peer_ip, slot.md5_key)
             _apply_gtsm(s, slot)
+            _apply_mss(s, slot)
             rc = s.connect_ex((str(slot.peer_ip), self.port))
             if rc not in (0, errno.EINPROGRESS):
                 s.close()
@@ -333,8 +394,11 @@ class BgpTcpIo(NetIo):
         s.setblocking(False)
         try:
             _apply_gtsm(s, slot)
+            _apply_mss(s, slot)
         except OSError as e:
-            log.error("GTSM enforcement on inbound %s failed: %s", pip, e)
+            log.error(
+                "socket options on inbound %s failed: %s", pip, e
+            )
             s.close()
             return
         self._adopt(slot, s)

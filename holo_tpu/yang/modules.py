@@ -243,6 +243,7 @@ def _bgp_subtree():
             _leaf("authentication-key"),  # TCP-MD5 (RFC 2385)
             # GTSM (RFC 5082): expected hop budget; unset = disabled.
             _leaf("ttl-security", "uint8"),
+            _leaf("tcp-mss", "uint16"),  # reference network.rs set_mss
         ),
         L(
             "network",

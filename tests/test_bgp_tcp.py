@@ -305,3 +305,46 @@ def test_gtsm_ttl_security_session():
     slot = io1.peers[ipaddress.ip_address("127.0.9.2")]
     assert slot.sock.getsockopt(_socket.IPPROTO_IP, _socket.IP_TTL) == _TTL_MAX
     assert slot.sock.getsockopt(_socket.IPPROTO_IP, IP_MINTTL) == _TTL_MAX
+
+
+def test_tcp_mss_option_applied():
+    """tcp-mss (reference network.rs set_mss): configured ONLY on the
+    passive (listening) side, so the active peer's negotiated MSS proves
+    the listener advertised the clamp in its SYN-ACK — applying it to
+    the accepted socket after the handshake would be too late."""
+    import ipaddress
+    import socket as _socket
+
+    import pytest
+
+    loop = EventLoop(clock=RealClock())
+    r1, io1 = _mk_speaker(loop, "s1", 65001, "1.1.1.1", "127.0.11.1", port=PORT + 9)
+    r2, io2 = _mk_speaker(loop, "s2", 65002, "2.2.2.2", "127.0.11.2", port=PORT + 9)
+    for inst, io, lip, pip, ras, mss in (
+        (r1, io1, "127.0.11.1", "127.0.11.2", 65002, 1200),  # passive
+        (r2, io2, "127.0.11.2", "127.0.11.1", 65001, None),  # active
+    ):
+        cfg = PeerConfig(
+            addr=ipaddress.ip_address(pip), remote_as=ras, ifname="tcp",
+            hold_time=15, connect_retry=0.3,
+        )
+        inst.add_peer(cfg, ipaddress.ip_address(lip))
+        io.add_peer(lip, pip, tcp_mss=mss)
+        inst.start_peer(cfg.addr)
+    assert _drive(
+        loop, [io1, io2],
+        lambda: all(p.state == PeerState.ESTABLISHED
+                    for i in (r1, r2) for p in i.peers.values()),
+    ), "session with tcp-mss failed to establish"
+    slot = io2.peers[ipaddress.ip_address("127.0.11.1")]
+    mss = slot.sock.getsockopt(_socket.IPPROTO_TCP, _socket.TCP_MAXSEG)
+    assert mss <= 1200, mss  # kernel may clamp lower, never higher
+    # Live reconfiguration re-clamps; bad values are rejected up front.
+    io1.update_mss("127.0.11.2", 1000)
+    assert io1.peers[ipaddress.ip_address("127.0.11.2")].tcp_mss == 1000
+    with pytest.raises(ValueError):
+        io1.update_mss("127.0.11.2", 40000)
+    with pytest.raises(ValueError):
+        io1.add_peer("127.0.11.1", "127.0.11.9", tcp_mss=40000)
+    for io in (io1, io2):
+        io.close()
