@@ -106,6 +106,7 @@ class Daemon:
             send_loop, self.ibus, netio, self.interface, kernel,
             prefix=self._p, policy_engine=self.policy.engine,
             keychains=self.keychain, nvstore=self.nvstore,
+            yang_notify=self._dispatch_yang_notification,
         )
         if self.loop_router is not None:
             self.routing.instance_placer = self._place_instance
@@ -267,6 +268,22 @@ class Daemon:
         if not hasattr(self, "commit_listeners"):
             self.commit_listeners = []
         self.commit_listeners.append(fn)
+
+    # -- YANG notifications (reference holo-northbound/src/notification.rs:
+    # protocol instances emit, the daemon fans out to every management
+    # surface's Subscribe stream)
+
+    def _dispatch_yang_notification(self, payload: dict) -> None:
+        for fn in list(getattr(self, "notification_listeners", [])):
+            try:
+                fn(payload)
+            except Exception:
+                log.exception("notification listener failed")
+
+    def add_notification_listener(self, fn) -> None:
+        if not hasattr(self, "notification_listeners"):
+            self.notification_listeners = []
+        self.notification_listeners.append(fn)
 
     # -- gRPC
 

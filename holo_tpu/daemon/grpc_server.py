@@ -312,6 +312,14 @@ def serve(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server:
             "commit", {"transaction-id": txn.id, "comment": txn.comment}
         )
     )
+    # Protocol YANG notifications stream on their own topic (the
+    # notification's qualified name), so Subscribe(topics=[...]) can
+    # filter e.g. just "ietf-ospf:nbr-state-change".
+    daemon.add_notification_listener(
+        lambda payload: [
+            service._notify(kind, body) for kind, body in payload.items()
+        ]
+    )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((_handlers(service),))
     _bind(server, address, tls_cert, tls_key)

@@ -403,9 +403,14 @@ class RoutingProvider(Provider, Actor):
         keychains: "KeychainProvider | None" = None,
         nvstore=None,
         link_mgr=None,
+        yang_notify=None,
     ):
         self.loop = loop
         self.ibus = ibus
+        # Sink for protocol YANG notifications (reference notification.rs
+        # -> northbound -> management clients); the daemon points this at
+        # its fan-out so gRPC/gNMI Subscribe streams see them.
+        self.yang_notify = yang_notify
         self.policy_engine = policy_engine
         self.keychains = keychains
         self.nvstore = nvstore
@@ -603,6 +608,7 @@ class RoutingProvider(Provider, Actor):
                 config=InstanceConfig(router_id=IPv4Address(router_id), spf=timers),
                 netio=self.netio_factory(f"{self.prefix}ospfv2"),
                 spf_backend=backend,
+                notif_cb=self.yang_notify,
                 nvstore=self.nvstore,
             )
             inst = self._place_instance(inst)
@@ -759,6 +765,7 @@ class RoutingProvider(Provider, Actor):
                 router_id=IPv4Address(router_id),
                 netio=self.netio_factory(actor),
                 route_cb=self._ospfv3_routes_to_rib,
+                notif_cb=self.yang_notify,
             )
             inst = self._place_instance(inst)
             self.instances["ospfv3"] = inst
@@ -893,6 +900,7 @@ class RoutingProvider(Provider, Actor):
                 raw = IsisLevelAllInstance(
                     actor, sysid, b"\x49\x00\x01",
                     netio=self.netio_factory(actor),
+                    notif_cb=self.yang_notify,
                 )
             else:
                 raw = IsisInstance(
@@ -900,6 +908,7 @@ class RoutingProvider(Provider, Actor):
                     sysid=sysid,
                     level=1 if level_cfg == "level-1" else 2,
                     netio=self.netio_factory(actor),
+                    notif_cb=self.yang_notify,
                 )
                 if level_cfg == "level-1":
                     raw.is_type = 0x01

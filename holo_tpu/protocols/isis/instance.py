@@ -259,6 +259,7 @@ class IsisInstance(Actor):
         netio: NetIo | None = None,
         spf_backend: SpfBackend | None = None,
         route_cb=None,
+        notif_cb=None,
         auth=None,
         mt_enabled: bool = False,
         sr=None,
@@ -274,6 +275,7 @@ class IsisInstance(Actor):
         self.sysid = sysid
         self.area = area
         self.level = level
+        self.notif_cb = notif_cb
         # Area/domain authentication (packet.AuthCtxIsis): signs LSPs and
         # SNPs end-to-end; hellos use it too unless the circuit overrides
         # (reference holo-isis/src/packet/auth.rs key semantics).
@@ -556,6 +558,10 @@ class IsisInstance(Actor):
             else AdjacencyState.INITIALIZING
         )
         adj.state = new
+        if new != old and AdjacencyState.UP in (new, old):
+            self._notify_adj_change(
+                iface, hello.sysid, new == AdjacencyState.UP
+            )
         t = getattr(adj, "_hold_timer", None)
         if t is None:
             t = self.loop.timer(
@@ -625,6 +631,8 @@ class IsisInstance(Actor):
         gone = iface.adjs.get(sysid)
         if gone is not None:
             self._bfd_unreg_adj(iface, gone)
+            if gone.state == AdjacencyState.UP:
+                self._notify_adj_change(iface, sysid, False)
         if iface.adjs.pop(sysid, None) is not None:
             self._run_dis_election(iface)
             self._adj_changed()
@@ -778,6 +786,10 @@ class IsisInstance(Actor):
             return
         adj = iface.adj
         if adj is None or adj.sysid != hello.sysid:
+            if adj is not None and adj.state == AdjacencyState.UP:
+                # A different system took over the link: the old
+                # neighbor is gone even though no timer fired.
+                self._notify_adj_change(iface, adj.sysid, False)
             adj = Adjacency(sysid=hello.sysid)
             iface.adj = adj
         adj.hold_time = hello.hold_time
@@ -804,6 +816,10 @@ class IsisInstance(Actor):
         t.start(adj.hold_time)
         self._bfd_update_adj(iface, adj)
         if new != old:
+            if AdjacencyState.UP in (new, old):
+                self._notify_adj_change(
+                    iface, adj.sysid, new == AdjacencyState.UP
+                )
             if self.inline_hellos:
                 self._send_hello(iface.name)  # accelerate the handshake
             if new == AdjacencyState.UP:
@@ -904,6 +920,8 @@ class IsisInstance(Actor):
             # reference deletes it only on hello re-init or hold expiry).
             adj = iface.adj
             self._bfd_unreg_adj(iface, adj)
+            if adj.state == AdjacencyState.UP:
+                self._notify_adj_change(iface, adj.sysid, False)
             adj.state = AdjacencyState.DOWN
             iface.srm.clear()
             iface.srm_sent.clear()
@@ -932,12 +950,90 @@ class IsisInstance(Actor):
         iface = self.interfaces.get(ifname)
         if iface is None or iface.adj is None:
             return
+        if iface.adj.state == AdjacencyState.UP:
+            self._notify_adj_change(iface, iface.adj.sysid, False)
         self._bfd_unreg_adj(iface, iface.adj)
         iface.adj = None
         iface.srm.clear()
         iface.srm_sent.clear()
         iface.ssn.clear()
         self._adj_changed()
+
+    # ----- YANG notifications (reference holo-isis
+    # northbound/notification.rs: common leaves per notification)
+
+    def _notify(self, kind: str, data: dict) -> None:
+        if self.notif_cb is not None:
+            self.notif_cb({f"ietf-isis:{kind}": data})
+
+    def _notif_common(self, iface=None) -> dict:
+        lvl = {1: "level-1", 2: "level-2"}.get(self.level, "level-all")
+        d = {
+            # Level-all nodes override display_name: notifications name
+            # the configured protocol instance, not the per-level actor.
+            "routing-protocol-name": getattr(
+                self, "display_name", self.name
+            ),
+            "isis-level": lvl,
+        }
+        if iface is not None:
+            d["interface-name"] = iface.name
+            d["interface-level"] = lvl
+        return d
+
+    def _notify_adj_change(self, iface, sysid: bytes, up: bool) -> None:
+        from holo_tpu.protocols.isis.nb_state import sysid_str
+
+        self._notify(
+            "adjacency-state-change",
+            self._notif_common(iface)
+            | {
+                "neighbor-system-id": sysid_str(sysid),
+                "state": "up" if up else "down",
+            },
+        )
+
+    def _notify_decode_error(self, iface, data, err, rx_auth) -> None:
+        """Reference notification.rs:161-188: wrong/missing auth TLV
+        type vs a failed digest are separate notifications.  Only an
+        authenticated circuit alarms — garbage frames on an open circuit
+        are not a security event."""
+        from holo_tpu.protocols.isis.packet import AuthError, AuthTypeError
+
+        if rx_auth is None or not isinstance(err, AuthError):
+            return
+        import base64
+
+        kind = (
+            "authentication-type-failure"
+            if isinstance(err, AuthTypeError)
+            else "authentication-failure"
+        )
+        self._notify(
+            kind,
+            self._notif_common(iface)
+            | {"raw-pdu": base64.b64encode(data[:64]).decode()},
+        )
+
+    def _notify_seqno_skipped(self, iface, lsp) -> None:
+        from holo_tpu.protocols.isis.nb_state import lsp_id_str
+
+        self._notify(
+            "sequence-number-skipped",
+            self._notif_common(iface) | {"lsp-id": lsp_id_str(lsp.lsp_id)},
+        )
+
+    def set_overload(self, on: bool) -> None:
+        """ISO 10589 §7.2.8.1 overload bit with the reference's
+        database-overload notification (notification.rs:28-38)."""
+        if self.overload == bool(on):
+            return
+        self.overload = bool(on)
+        self._notify(
+            "database-overload",
+            self._notif_common() | {"overload": "on" if on else "off"},
+        )
+        self._originate_lsp(force=True)
 
     def _adj_changed(self) -> None:
         # No direct SPF trigger: the RFC 8405 Igp event fires from LSP
@@ -1378,7 +1474,8 @@ class IsisInstance(Actor):
         )
         try:
             pdu_type, pdu = decode_pdu(msg.data, auth=rx_auth)
-        except DecodeError:
+        except DecodeError as e:
+            self._notify_decode_error(iface, msg.data, e, rx_auth)
             return
         snpa = msg.src if isinstance(msg.src, bytes) else b""
         self.rx_pdu(msg.ifname, pdu_type, pdu, snpa)
@@ -1461,6 +1558,13 @@ class IsisInstance(Actor):
                     raw[10:12] = b"\x00\x00"
                     lsp.raw = bytes(raw)
                 self._srm_phantom[lsp.lsp_id] = lsp
+                from holo_tpu.protocols.isis.nb_state import lsp_id_str
+
+                self._notify(
+                    "own-lsp-purge",
+                    self._notif_common(iface)
+                    | {"lsp-id": lsp_id_str(lsp.lsp_id)},
+                )
                 for other in self.interfaces.values():
                     if other.up_adjacencies():
                         other.srm.add(lsp.lsp_id)
@@ -1470,6 +1574,7 @@ class IsisInstance(Actor):
             if lsp.compare(
                 cur.remaining_lifetime(now), cur.lsp.seqno, cur.lsp.cksum
             ) > 0:
+                self._notify_seqno_skipped(iface, lsp)
                 self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
                 return
         if cur is None:
@@ -1519,6 +1624,7 @@ class IsisInstance(Actor):
                 # contents.  Our own LSP skips ahead a seqno; a received
                 # one is treated as expired and purged.
                 if lsp.lsp_id.sysid == self.sysid:
+                    self._notify_seqno_skipped(iface, lsp)
                     self._originate_lsp(force=True, min_seqno=lsp.seqno + 1)
                 else:
                     self.purge_lsp(lsp.lsp_id)

@@ -39,6 +39,17 @@ class IsisLevelAllInstance(Actor):
     the level that owns the PDU (L1 kinds to l1, L2 kinds to l2, P2P
     hellos to both — they cover both levels on a shared circuit)."""
 
+    @property
+    def notif_cb(self):
+        return self.l1.notif_cb
+
+    @notif_cb.setter
+    def notif_cb(self, cb):
+        # The daemon's placement marshals this attribute; both levels
+        # share the sink.
+        self.l1.notif_cb = cb
+        self.l2.notif_cb = cb
+
     def __init__(self, name: str, sysid: bytes, area: bytes, netio=None,
                  spf_backend_factory=None, route_cb=None, **kw):
         self.name = name
@@ -56,6 +67,7 @@ class IsisLevelAllInstance(Actor):
         for inst in (self.l1, self.l2):
             inst.is_type = 0x03
             inst.route_cb = self._level_routes_changed
+            inst.display_name = name
         # One node-wide adjacency-SID label space across both levels.
         self.l2._adj_sid_box = self.l1._adj_sid_box
         self.l1.att_cb = self._l2_attached
@@ -113,7 +125,8 @@ class IsisLevelAllInstance(Actor):
         )
         try:
             ptype, pdu = decode_pdu(data, auth=rx_auth)
-        except DecodeError:
+        except DecodeError as e:
+            self.l1._notify_decode_error(iface, data, e, rx_auth)
             return
         snpa = msg.src if isinstance(msg.src, bytes) else b""
         self.rx_pdu(msg.ifname, ptype, pdu, snpa)

@@ -12,6 +12,14 @@ from ipaddress import IPv4Address, IPv4Network, IPv6Network
 
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer, fletcher16_checksum, fletcher16_verify
 
+
+class AuthError(DecodeError):
+    """Authentication verification failed (bad digest / unknown key)."""
+
+
+class AuthTypeError(AuthError):
+    """Authentication TLV missing or of the wrong type."""
+
 IRDP_DISCRIMINATOR = 0x83
 SYSID_LEN = 6
 LSP_MAX_AGE = 1200
@@ -739,19 +747,19 @@ def verify_pdu_auth(data: bytes, tlvs: dict, auth: AuthCtxIsis) -> None:
     span = tlvs.get("_auth_span")
     info = tlvs.get("auth")
     if span is None or info is None:
-        raise DecodeError("authentication TLV missing")
+        raise AuthTypeError("authentication TLV missing")
     atype, value = info
     _name, dlen = _ISIS_HMACS[auth.algo]
     if auth.algo == "hmac-md5":
         if atype != AUTH_HMAC_MD5 or len(value) != dlen:
-            raise DecodeError("authentication type mismatch")
+            raise AuthTypeError("authentication type mismatch")
         digest_off = span[0] + 1
     else:
         if atype != AUTH_CRYPTO or len(value) != 2 + dlen:
-            raise DecodeError("authentication type mismatch")
+            raise AuthTypeError("authentication type mismatch")
         key_id = int.from_bytes(value[:2], "big")
         if key_id != auth.key_id:
-            raise DecodeError("unknown authentication key id")
+            raise AuthError("unknown authentication key id")
         digest_off = span[0] + 3
     got = data[digest_off : digest_off + dlen]
     buf = bytearray(data)
@@ -761,7 +769,7 @@ def verify_pdu_auth(data: bytes, tlvs: dict, auth: AuthCtxIsis) -> None:
         buf[10:12] = b"\x00\x00"  # remaining lifetime
         buf[24:26] = b"\x00\x00"  # checksum
     if not _h.compare_digest(auth._hmac(bytes(buf)), got):
-        raise DecodeError("authentication digest mismatch")
+        raise AuthError("authentication digest mismatch")
 
 
 def _pdu_header(w: Writer, pdu_type: PduType, hdr_len: int) -> None:
@@ -1013,7 +1021,7 @@ def decode_pdu(data: bytes, auth: "AuthCtxIsis | None" = None):
     if auth is not None:
         tlvs = _tlvs_of(out)
         if tlvs is None:
-            raise DecodeError("authentication required")
+            raise AuthTypeError("authentication required")
         verify_pdu_auth(data, tlvs, auth)
     return pdu_type, out
 

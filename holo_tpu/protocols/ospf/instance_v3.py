@@ -169,6 +169,7 @@ class OspfV3Instance(Actor):
         netio: NetIo,
         spf_backend: SpfBackend | None = None,
         route_cb=None,
+        notif_cb=None,
         nvstore=None,
     ):
         self.name = name
@@ -176,6 +177,7 @@ class OspfV3Instance(Actor):
         self.netio = netio
         self.backend = spf_backend or ScalarSpfBackend()
         self.route_cb = route_cb
+        self.notif_cb = notif_cb
         self.interfaces: dict[str, V3Interface] = {}
         self.areas: dict[IPv4Address, V3Area] = {}
         self.routes: dict[IPv6Network, V6Route] = {}
@@ -495,6 +497,21 @@ class OspfV3Instance(Actor):
         old_state = nbr.state
         res = nsm_transition(nbr, event, adj_ok=self._adj_ok(iface, nbr))
         nbr.state = res.new_state
+        if nbr.state != old_state and self.notif_cb is not None:
+            # Reference holo-ospf northbound/notification.rs (shared by
+            # both versions): same shape as the v2 instance's notify.
+            from holo_tpu.protocols.ospf.nb_state import _NSM_NAME
+
+            self.notif_cb({
+                "ietf-ospf:nbr-state-change": {
+                    "routing-protocol-name": self.name,
+                    "address-family": "ipv6",
+                    "interface": {"interface": iface.name},
+                    "neighbor-router-id": str(nbr.router_id),
+                    "neighbor-ip-addr": str(nbr.src),
+                    "state": _NSM_NAME[nbr.state],
+                }
+            })
         for act in res.actions:
             if act == "start_exstart":
                 self._start_exstart(iface, nbr)

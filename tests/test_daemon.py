@@ -756,3 +756,110 @@ def test_isis_level_all_config_driven():
     inst2 = d1.routing.instances["isis"]
     assert not hasattr(inst2, "instances")
     assert inst2.level == 2 and inst2.level_name == "level-2"
+
+
+def test_yang_notifications_reach_daemon_listeners():
+    """Protocol YANG notifications (reference notification.rs) flow from
+    config-spawned instances through the daemon's fan-out, where every
+    management surface's Subscribe stream taps in."""
+    loop, fabric, d1, d2 = two_daemon_setup()
+    seen = []
+    d1.add_notification_listener(seen.append)
+    configure(d1, "1.1.1.1", "10.0.12.1/30")
+    configure(d2, "2.2.2.2", "10.0.12.2/30")
+    loop.advance(60)
+    kinds = {k for n in seen for k in n}
+    assert "ietf-ospf:nbr-state-change" in kinds, kinds
+    assert "ietf-ospf:if-state-change" in kinds, kinds
+    full = [
+        n["ietf-ospf:nbr-state-change"]
+        for n in seen
+        if n.get("ietf-ospf:nbr-state-change", {}).get("state") == "full"
+    ]
+    assert full and full[-1]["neighbor-router-id"] == "2.2.2.2"
+    assert full[-1]["routing-protocol-name"].endswith("ospfv2")
+
+
+def test_grpc_subscribe_streams_protocol_notifications():
+    """gRPC Subscribe delivers protocol YANG notifications with the
+    notification's qualified name as the topic (filterable)."""
+    import socket as _socket
+    import threading
+
+    import holo_tpu.daemon.grpc_server as gs
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, name="gsub1")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = d.start_grpc(f"127.0.0.1:{port}")
+    try:
+        cli = gs.NorthboundClient(f"127.0.0.1:{port}")
+        got = []
+        ready = threading.Event()
+
+        def _consume():
+            ready.set()
+            for note in cli.Subscribe(
+                gs.pb.SubscribeRequest(
+                    topics=["ietf-ospf:if-state-change"]
+                )
+            ):
+                got.append(note)
+                break
+
+        t = threading.Thread(target=_consume, daemon=True)
+        t.start()
+        ready.wait(5)
+        import time as _time
+
+        _time.sleep(0.3)  # let the stream register its queue
+        # Emit straight through the daemon dispatch (the same path the
+        # marshalled instance callback uses).
+        d._dispatch_yang_notification(
+            {"ietf-ospf:nbr-state-change": {"state": "init"}}  # filtered
+        )
+        d._dispatch_yang_notification(
+            {"ietf-ospf:if-state-change": {"state": "dr",
+                                           "interface": {"interface": "e0"}}}
+        )
+        t.join(10)
+        assert got, "Subscribe stream delivered nothing"
+        assert got[0].topic == "ietf-ospf:if-state-change"
+        assert json.loads(got[0].payload_json)["state"] == "dr"
+    finally:
+        server.stop(grace=0)
+
+
+def test_isis_level_all_notifications_use_instance_name():
+    """A level-all node's notifications name the configured protocol
+    instance, not its internal per-level actors, and flow through the
+    daemon fan-out like any single-level instance's."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="n1")
+    d2 = Daemon(loop=loop, netio=fabric, name="n2")
+    seen = []
+    d1.add_notification_listener(seen.append)
+    fabric.join("l", "n1.isis", "eth0", ipaddress.ip_address("10.0.12.1"))
+    fabric.join("l", "n2.isis", "eth0", ipaddress.ip_address("10.0.12.2"))
+    for d, sid, addr in [(d1, "0.0.0.0.0.1", "10.0.12.1/30"),
+                         (d2, "0.0.0.0.0.2", "10.0.12.2/30")]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/isis/system-id", sid)
+        cand.set("routing/control-plane-protocols/isis/level", "level-all")
+        cand.set("routing/control-plane-protocols/isis/interface[eth0]/metric", 5)
+        d.commit(cand)
+    loop.advance(30)
+    adj = [n["ietf-isis:adjacency-state-change"] for n in seen
+           if "ietf-isis:adjacency-state-change" in n]
+    ups = [b for b in adj if b["state"] == "up"]
+    assert ups, seen
+    names = {b["routing-protocol-name"] for b in ups}
+    assert names == {"n1.isis"}, names  # node name, no -l1/-l2 suffix
+    assert {b["isis-level"] for b in ups} <= {"level-1", "level-2"}

@@ -553,3 +553,63 @@ def test_isis_sr_prefix_sids():
     # and the capability TLV round-tripped through b's LSP
     e = a.lsdb[LspId(b.sysid)].lsp
     assert e.tlvs.get("sr_cap") == (16000, 8000)
+
+
+def test_yang_notifications_adjacency_lifecycle():
+    """Reference holo-isis northbound/notification.rs: adjacency up/down,
+    database-overload, and auth failures reach the notif_cb sink."""
+    loop, fabric, (r1, r2) = mk_net(2)
+    notifs = []
+    r1.notif_cb = notifs.append
+    link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2",
+         "10.0.12.0/30", 10)
+    for r in (r1, r2):
+        loop.send(r.name, IsisIfUpMsg("e0"))
+    loop.advance(30)
+    assert r1.interfaces["e0"].adj.state == AdjacencyState.UP
+    ups = [n for n in notifs if "ietf-isis:adjacency-state-change" in n]
+    assert ups, notifs
+    body = ups[-1]["ietf-isis:adjacency-state-change"]
+    assert body["state"] == "up"
+    assert body["interface-name"] == "e0"
+    assert body["neighbor-system-id"].count(".") == 2  # dotted sysid
+    # Hold-time expiry: silence r2 so r1's hold timer fires.
+    notifs.clear()
+    loop.unregister(r2.name)
+    loop.advance(120)
+    downs = [n for n in notifs if "ietf-isis:adjacency-state-change" in n
+             and n["ietf-isis:adjacency-state-change"]["state"] == "down"]
+    assert downs, notifs
+    # Overload toggling emits database-overload and re-originates.
+    notifs.clear()
+    r1.set_overload(True)
+    ov = [n for n in notifs if "ietf-isis:database-overload" in n]
+    assert ov and ov[0]["ietf-isis:database-overload"]["overload"] == "on"
+    r1.set_overload(False)
+    assert any(
+        n.get("ietf-isis:database-overload", {}).get("overload") == "off"
+        for n in notifs
+    )
+
+
+def test_yang_notification_auth_failure():
+    """A PDU failing digest verification raises the authentication-failure
+    notification (wrong TLV type raises the -type-failure variant)."""
+    from holo_tpu.protocols.isis.packet import AuthCtxIsis
+    from holo_tpu.utils.netio import NetRxPacket
+
+    loop, fabric, (r1, r2) = mk_net(2)
+    notifs = []
+    r1.notif_cb = notifs.append
+    r1.auth = AuthCtxIsis(key=b"right-key", algo="hmac-md5")
+    link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2",
+         "10.0.12.0/30", 10)
+    # r2 signs with the wrong key: digest mismatch on r1's LSP path.
+    r2.auth = AuthCtxIsis(key=b"wrong-key", algo="hmac-md5")
+    r2._originate_lsp(force=True)
+    raw = next(iter(r2.lsdb.values())).lsp.raw
+    r1.handle(NetRxPacket(ifname="e0", src=b"\x02\x00\x00\x00\x00\x02",
+                          dst=None, data=raw))
+    fails = [n for n in notifs if "ietf-isis:authentication-failure" in n]
+    assert fails, notifs
+    assert "raw-pdu" in fails[0]["ietf-isis:authentication-failure"]
