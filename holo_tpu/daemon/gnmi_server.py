@@ -193,6 +193,23 @@ class GnmiService:
         finally:
             self._subscribers.remove(q)
 
+    def _notify_yang(self, payload: dict) -> None:
+        # Protocol YANG notifications ride the same update stream, one
+        # update per notification keyed by its qualified name.
+        for kind, body in payload.items():
+            notif = pb.Notification(timestamp=int(time.time() * 1e9))
+            notif.update.add(
+                path=str_to_path(kind),
+                val=pb.TypedValue(
+                    json_ietf_val=json.dumps(body, default=str)
+                ),
+            )
+            for q in list(self._subscribers):
+                try:
+                    q.put_nowait(notif)
+                except queue.Full:
+                    pass
+
     def _notify_commit(self, txn) -> None:
         notif = pb.Notification(timestamp=int(time.time() * 1e9))
         notif.update.add(
@@ -290,6 +307,7 @@ def _apply_json(tree, base: str, sub) -> None:
 def serve_gnmi(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server:
     service = GnmiService(daemon)
     daemon.add_commit_listener(service._notify_commit)
+    daemon.add_notification_listener(service._notify_yang)
     svc_desc = pb.DESCRIPTOR.services_by_name["gNMI"]
     handlers = {}
     for m in svc_desc.methods:

@@ -455,7 +455,8 @@ class RoutingProvider(Provider, Actor):
         from holo_tpu.protocols.bfd import BfdInstance
 
         self.bfd = BfdInstance(
-            self.netio_factory(f"{self.prefix}bfd"), self.ibus
+            self.netio_factory(f"{self.prefix}bfd"), self.ibus,
+            notif_cb=self.yang_notify,
         )
         loop_.register(self.bfd, name=f"{self.prefix}bfd")
 
@@ -997,6 +998,7 @@ class RoutingProvider(Provider, Actor):
                 netio=self.netio_factory(actor),
                 control_mode=mode,
                 lib_cb=self._ldp_lib_changed,
+                notif_cb=self.yang_notify,
             )
             inst = self._place_instance(inst)
             self.instances["ldp"] = inst
@@ -1235,6 +1237,7 @@ class RoutingProvider(Provider, Actor):
                 config=cfg,
                 iface_addr=addr,
                 netio=self.netio_factory(actor),
+                notif_cb=self.yang_notify,
             )
             inst.vrrp_ifname = ifname
             inst.on_state = (
@@ -1332,6 +1335,7 @@ class RoutingProvider(Provider, Actor):
                 router_id=IPv4Address(router_id),
                 netio=netio,
                 route_cb=self._bgp_route_cb,
+                notif_cb=self.yang_notify,
             )
             inst = self._place_instance(inst)
             self.instances["bgp"] = inst

@@ -295,9 +295,11 @@ class BfdInstance(Actor):
 
     name = "bfd"
 
-    def __init__(self, netio: NetIo, ibus: Ibus | None = None, slow_tx: float = 1.0):
+    def __init__(self, netio: NetIo, ibus: Ibus | None = None, slow_tx: float = 1.0,
+                 notif_cb=None):
         self.netio = netio
         self.ibus = ibus
+        self.notif_cb = notif_cb  # YANG notifications (ietf-bfd-ip-sh/mh)
         self.sessions: dict[tuple, Session] = {}
         self._next_discr = 1
         self.slow_tx = slow_tx  # tx interval until session is UP (seconds)
@@ -488,6 +490,31 @@ class BfdInstance(Actor):
             # detection timer expires so a recovered peer's sequence
             # numbers are accepted afresh.
             s._last_rx_seq = None
+        if self.notif_cb is not None:
+            # Reference holo-bfd northbound/notification.rs:18-33: the
+            # notification module matches the session key flavor.
+            body = {
+                "local-discr": s.local_discr,
+                "remote-discr": s.remote_discr,
+                "new-state": {
+                    BfdState.UP: "up",
+                    BfdState.DOWN: "down",
+                    BfdState.INIT: "init",
+                    BfdState.ADMIN_DOWN: "admin-down",
+                }[new],
+            }
+            if s.key and s.key[0] == "mh":
+                body["source-addr"] = str(s.key[1])
+                body["dest-addr"] = str(s.key[2])
+                self.notif_cb(
+                    {"ietf-bfd-multihop:multihop-notification": body}
+                )
+            else:
+                body["interface"] = s.key[0]
+                body["dest-addr"] = str(s.key[1])
+                self.notif_cb(
+                    {"ietf-bfd-ip-sh:singlehop-notification": body}
+                )
         if self.ibus is not None:
             label = {
                 BfdState.UP: "up",

@@ -550,6 +550,9 @@ class ConnectionDownMsg:
 
 
 class Peer:
+    last_notification_rcvd: tuple | None = None
+    last_notification_sent: tuple | None = None
+
     def __init__(self, cfg: PeerConfig):
         self.config = cfg
         self.state = PeerState.IDLE
@@ -584,6 +587,7 @@ class BgpInstance(Actor):
         router_id: IPv4Address,
         netio: NetIo,
         route_cb=None,
+        notif_cb=None,
         policy_worker: str | None = None,
     ):
         """``policy_worker``: actor name of a PolicyWorker — import
@@ -594,6 +598,7 @@ class BgpInstance(Actor):
         self.router_id = router_id
         self.netio = netio
         self.route_cb = route_cb
+        self.notif_cb = notif_cb
         self.policy_worker = policy_worker
         self.peers: dict = {}  # peer address (v4 or v6) -> Peer
         self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our v4 addr
@@ -626,6 +631,7 @@ class BgpInstance(Actor):
         if peer is None:
             return
         if peer.state != PeerState.IDLE:
+            peer.last_notification_sent = (6, 3)
             self._send(peer, NotificationMsg(6, 3))  # cease / deconfigured
         for key in (("hold", addr), ("ka", addr), ("retry", addr)):
             t = getattr(self, f"_t_{key[0]}_{key[1]}", None)
@@ -674,6 +680,7 @@ class BgpInstance(Actor):
         elif isinstance(msg, HoldTimerExpiredMsg):
             peer = self.peers.get(msg.peer)
             if peer is not None and peer.state != PeerState.IDLE:
+                peer.last_notification_sent = (4, 0)
                 self._send(peer, NotificationMsg(4, 0))  # hold timer expired
                 self._drop_peer(peer)
         elif isinstance(msg, KeepaliveTimerMsg):
@@ -726,7 +733,29 @@ class BgpInstance(Actor):
         )
 
     def _drop_peer(self, peer: Peer) -> None:
+        was_established = peer.state == PeerState.ESTABLISHED
         peer.state = PeerState.IDLE
+        if was_established and self.notif_cb is not None:
+            # Reference notification.rs:28-50 (codes of the NOTIFICATION
+            # message, when one was exchanged, travel in the event).
+            # "remote-addr" here vs "remote-address" in established is
+            # the ietf-bgp model's own naming (the reference's generated
+            # Established/BackwardTransition structs differ the same way).
+            body = {
+                "routing-protocol-name": self.name,
+                "remote-addr": str(peer.config.addr),
+            }
+            if peer.last_notification_rcvd is not None:
+                code, sub = peer.last_notification_rcvd
+                body["notification-received"] = {
+                    "last-error-code": code, "last-error-subcode": sub,
+                }
+            if peer.last_notification_sent is not None:
+                code, sub = peer.last_notification_sent
+                body["notification-sent"] = {
+                    "last-error-code": code, "last-error-subcode": sub,
+                }
+            self.notif_cb({"ietf-bgp:backward-transition": body})
         # Tell a connection-oriented transport to tear the session down
         # (stale TCP sockets would otherwise block re-establishment).
         reset = getattr(self.netio, "session_reset", None)
@@ -772,10 +801,12 @@ class BgpInstance(Actor):
             ):
                 self._refresh_peer(peer, body.afi)
         elif t == MsgType.NOTIFICATION:
+            peer.last_notification_rcvd = (body.code, body.subcode)
             self._drop_peer(peer)
 
     def _rx_open(self, peer: Peer, open_: OpenMsg) -> None:
         if open_.asn != peer.config.remote_as:
+            peer.last_notification_sent = (2, 2)
             self._send(peer, NotificationMsg(2, 2))  # bad peer AS
             self._drop_peer(peer)
             return
@@ -793,6 +824,18 @@ class BgpInstance(Actor):
     def _rx_keepalive(self, peer: Peer) -> None:
         if peer.state == PeerState.OPEN_CONFIRM:
             peer.state = PeerState.ESTABLISHED
+            # Codes from a previous flap must not leak into this
+            # session's eventual backward-transition event.
+            peer.last_notification_rcvd = None
+            peer.last_notification_sent = None
+            if self.notif_cb is not None:
+                # Reference holo-bgp northbound/notification.rs:18-26.
+                self.notif_cb({
+                    "ietf-bgp:established": {
+                        "routing-protocol-name": self.name,
+                        "remote-address": str(peer.config.addr),
+                    }
+                })
             self._advertise_all(peer)
         if peer.state != PeerState.IDLE:
             self._hold_timer(peer).start(peer.hold_time)

@@ -1526,6 +1526,17 @@ class IsisInstance(Actor):
             return
         cur = self.lsdb.get(lsp.lsp_id)
         now = self.loop.clock.now()
+        if lsp.lsp_id.sysid == self.sysid and lsp.is_expired:
+            # ietf-isis own-lsp-purge: we RECEIVED a purged copy of one
+            # of our own LSPs (reference events.rs gates the event on
+            # zero remaining lifetime, not on stale live incarnations).
+            from holo_tpu.protocols.isis.nb_state import lsp_id_str
+
+            self._notify(
+                "own-lsp-purge",
+                self._notif_common(iface)
+                | {"lsp-id": lsp_id_str(lsp.lsp_id)},
+            )
         # LSP expiration synchronization (ISO 10589 §7.3.16.4.a): an
         # expired LSP we have no copy of is never installed; on p2p
         # circuits it is acknowledged directly with a PSNP.
@@ -1558,13 +1569,6 @@ class IsisInstance(Actor):
                     raw[10:12] = b"\x00\x00"
                     lsp.raw = bytes(raw)
                 self._srm_phantom[lsp.lsp_id] = lsp
-                from holo_tpu.protocols.isis.nb_state import lsp_id_str
-
-                self._notify(
-                    "own-lsp-purge",
-                    self._notif_common(iface)
-                    | {"lsp-id": lsp_id_str(lsp.lsp_id)},
-                )
                 for other in self.interfaces.values():
                     if other.up_adjacencies():
                         other.srm.add(lsp.lsp_id)

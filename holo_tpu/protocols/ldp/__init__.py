@@ -149,6 +149,7 @@ class LdpInstance(Actor):
         netio: NetIo,
         label_manager: LabelManager | None = None,
         lib_cb=None,
+        notif_cb=None,
         control_mode: str = "independent",
     ):
         assert control_mode in ("independent", "ordered")
@@ -157,6 +158,7 @@ class LdpInstance(Actor):
         self.netio = netio
         self.labels = label_manager or LabelManager()
         self.lib_cb = lib_cb  # callable(lib) on label-table change
+        self.notif_cb = notif_cb  # YANG notifications (mpls-ldp events)
         # RFC 5036 §2.6: independent control advertises local bindings
         # immediately; ordered control (§2.6.1) only once the FEC's next
         # hop has advertised its own mapping (or we are the egress).
@@ -287,8 +289,24 @@ class LdpInstance(Actor):
         elif isinstance(msg, NbrTimeoutMsg):
             nbr = self.neighbors.pop(msg.lsr_id, None)
             if nbr is not None:
+                self._notify("mpls-ldp-hello-adjacency-event", {
+                    "event-type": "down",
+                    "interface": nbr.ifname,
+                    "adjacent-address": str(nbr.addr),
+                })
+                if nbr.state == NbrState.OPERATIONAL:
+                    self._notify("mpls-ldp-peer-event", {
+                        "event-type": "down",
+                        "peer": {"lsr-id": str(nbr.lsr_id)},
+                    })
                 self._reeval_ordered()  # lost downstream: withdraw
                 self._lib_changed()
+
+    def _notify(self, kind: str, data: dict) -> None:
+        """Reference holo-ldp northbound/notification.rs: peer and
+        hello-adjacency lifecycle events under ietf-mpls-ldp."""
+        if self.notif_cb is not None:
+            self.notif_cb({f"ietf-mpls-ldp:{kind}": data})
 
     def _rx(self, msg: NetRxPacket) -> None:
         try:
@@ -311,6 +329,10 @@ class LdpInstance(Actor):
         elif pdu.type == LdpMsgType.KEEPALIVE:
             if nbr.state != NbrState.OPERATIONAL:
                 nbr.state = NbrState.OPERATIONAL
+                self._notify("mpls-ldp-peer-event", {
+                    "event-type": "up",
+                    "peer": {"lsr-id": str(nbr.lsr_id)},
+                })
                 # Advertise eligible local bindings (DU; ordered mode
                 # holds back FECs still waiting on their next hop).
                 for prefix, (label, _e) in self.fec_table.items():
@@ -336,6 +358,11 @@ class LdpInstance(Actor):
             nbr = LdpNeighbor(pdu.lsr_id, msg.src, msg.ifname,
                               hold_time=pdu.hold_time)
             self.neighbors[pdu.lsr_id] = nbr
+            self._notify("mpls-ldp-hello-adjacency-event", {
+                "event-type": "up",
+                "interface": msg.ifname,
+                "adjacent-address": str(msg.src),
+            })
             # Active side: higher LSR id initiates the session (RFC 5036
             # §2.5.2 transport connection roles).
             if int(self.lsr_id) > int(pdu.lsr_id):

@@ -112,18 +112,22 @@ class VrrpInstance(Actor):
     name = "vrrp"
 
     def __init__(self, name: str, config: VrrpConfig, iface_addr: IPv4Address,
-                 netio: NetIo, on_state=None, garp_cb=None):
+                 netio: NetIo, on_state=None, garp_cb=None, notif_cb=None):
         self.name = name
         self.config = config
         self.iface_addr = iface_addr
         self.netio = netio
         self.on_state = on_state  # callable(state) for macvlan programming
+        self.notif_cb = notif_cb  # YANG notifications (vrrp-new-master-event)
         # callable(addr) fired per virtual address on master transition:
         # gratuitous ARP (v4) / unsolicited neighbor advert (v6).
         self.garp_cb = garp_cb
         self.state = VrrpState.INITIALIZE
         self.master_adver_int = config.advert_interval
         self.owner = iface_addr in config.addresses
+        # True while we are deliberately letting master-down expire to
+        # preempt a live lower-priority master (event reason plumbing).
+        self._preempting = False
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -138,7 +142,7 @@ class VrrpInstance(Actor):
 
     def startup(self) -> None:
         if self.owner or self.config.priority == 255:
-            self._become_master()
+            self._become_master("priority")
         else:
             self._become_backup()
 
@@ -157,8 +161,18 @@ class VrrpInstance(Actor):
     def _master_down_interval(self) -> float:
         return 3 * self.master_adver_int + self._skew_time()
 
-    def _become_master(self) -> None:
+    def _become_master(self, reason: str = "no-response") -> None:
+        became = self.state != VrrpState.MASTER
+        self._preempting = False
         self._set_state(VrrpState.MASTER)
+        if became and self.notif_cb is not None:
+            # Reference holo-vrrp northbound/notification.rs:21-29.
+            self.notif_cb({
+                "ietf-vrrp:vrrp-new-master-event": {
+                    "master-ip-address": str(self.iface_addr),
+                    "new-master-reason": reason,
+                }
+            })
         self._send_advert()
         if self.garp_cb is not None:
             for addr in self.config.addresses:
@@ -188,7 +202,9 @@ class VrrpInstance(Actor):
                 self._advert_timer.start(self.config.advert_interval)
         elif isinstance(msg, MasterDownTimerMsg):
             if self.state == VrrpState.BACKUP:
-                self._become_master()
+                self._become_master(
+                    "preempted" if self._preempting else "no-response"
+                )
 
     def _rx(self, msg: NetRxPacket) -> None:
         try:
@@ -213,9 +229,12 @@ class VrrpInstance(Actor):
                 not self.config.preempt
                 or pkt.priority >= self.config.priority
             ):
+                self._preempting = False
                 self.master_adver_int = advert
                 self._mdown_timer.start(self._master_down_interval())
-            # else: we preempt by letting master-down expire
+            else:
+                # We preempt by letting master-down expire.
+                self._preempting = True
         elif self.state == VrrpState.MASTER:
             if pkt.priority == 0:
                 self._send_advert()

@@ -179,3 +179,31 @@ def test_bfd_echo_failure_detection():
     loop.advance(1.5)
     assert s1.state == BfdState.DOWN
     assert s1.diag == BfdDiag.ECHO_FAILED
+
+
+def test_yang_notification_on_state_change():
+    """Reference holo-bfd northbound/notification.rs: singlehop sessions
+    notify under ietf-bfd-ip-sh on every state transition."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    notifs = []
+    b1 = BfdInstance(fabric.sender_for("bfd1"), Ibus(loop),
+                     notif_cb=notifs.append)
+    b2 = BfdInstance(fabric.sender_for("bfd2"), Ibus(loop))
+    b1.name, b2.name = "bfd1", "bfd2"
+    loop.register(b1)
+    loop.register(b2)
+    fabric.join("l", "bfd1", "e0", A("10.0.0.1"))
+    fabric.join("l", "bfd2", "e0", A("10.0.0.2"))
+    b1.register(("e0", A("10.0.0.2")), "test", A("10.0.0.1"))
+    b2.register(("e0", A("10.0.0.1")), "test", A("10.0.0.2"))
+    loop.advance(5)
+    sh = [n["ietf-bfd-ip-sh:singlehop-notification"] for n in notifs
+          if "ietf-bfd-ip-sh:singlehop-notification" in n]
+    assert sh and sh[-1]["new-state"] == "up"
+    assert sh[-1]["dest-addr"] == "10.0.0.2" and sh[-1]["interface"] == "e0"
+    fabric.set_link_up("l", False)
+    loop.advance(5)
+    sh = [n["ietf-bfd-ip-sh:singlehop-notification"] for n in notifs
+          if "ietf-bfd-ip-sh:singlehop-notification" in n]
+    assert sh[-1]["new-state"] == "down"

@@ -315,3 +315,25 @@ def test_engine_attrs_json_carries_communities():
     assert attrs.aggregator == (65010, "9.9.9.9")
     assert attrs.atomic_aggregate
     assert _attrs_from_json(_attrs_to_json(attrs)) == attrs
+
+
+def test_yang_notifications_session_lifecycle():
+    """Reference holo-bgp northbound/notification.rs: established on
+    session up; backward-transition (with last NOTIFICATION codes) on
+    session loss."""
+    loop, fabric, b1, b2 = two_speakers()
+    notifs = []
+    b1.notif_cb = notifs.append
+    loop.advance(5)
+    assert b1.peers[A("10.0.0.2")].state == PeerState.ESTABLISHED
+    est = [n for n in notifs if "ietf-bgp:established" in n]
+    assert est and est[0]["ietf-bgp:established"]["remote-address"] == "10.0.0.2"
+    # Hold-timer expiry: b2 goes quiet, b1 sends (4,0) and transitions back.
+    notifs.clear()
+    loop.unregister("b2")
+    loop.advance(300)
+    back = [n["ietf-bgp:backward-transition"] for n in notifs
+            if "ietf-bgp:backward-transition" in n]
+    assert back, notifs
+    assert back[0]["remote-addr"] == "10.0.0.2"
+    assert back[0]["notification-sent"]["last-error-code"] == 4

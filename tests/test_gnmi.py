@@ -103,3 +103,57 @@ def test_gnmi_end_to_end():
         assert snap["system"]["hostname"] == "gnmi-rtr"
     finally:
         server.stop(grace=0)
+
+
+def test_gnmi_subscribe_streams_yang_notifications():
+    """Protocol YANG notifications reach gNMI STREAM subscribers as
+    updates pathed by the notification's qualified name."""
+    import socket as _socket
+    import threading
+
+    import holo_tpu.daemon.gnmi_server as gs
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, name="gn2")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = gs.serve_gnmi(d, f"127.0.0.1:{port}")
+    try:
+        cli = gs.GnmiClient(f"127.0.0.1:{port}")
+        got = []
+        synced = threading.Event()
+
+        def consume():
+            sub = gs.pb.SubscribeRequest()
+            sub.subscribe.mode = gs.pb.SubscriptionList.STREAM
+            for m in cli.Subscribe(iter([sub])):
+                if m.HasField("sync_response"):
+                    synced.set()
+                    continue
+                paths = [
+                    "/".join(e.name for e in u.path.elem)
+                    for u in m.update.update
+                ]
+                if any("nbr-state-change" in p for p in paths):
+                    got.append(m)
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        assert synced.wait(10), "no sync_response"
+        import time as _time
+
+        _time.sleep(0.3)
+        d._dispatch_yang_notification(
+            {"ietf-ospf:nbr-state-change": {"state": "full"}}
+        )
+        t.join(10)
+        assert got, "gNMI stream delivered no YANG notification"
+        body = json.loads(got[0].update.update[0].val.json_ietf_val)
+        assert body["state"] == "full"
+    finally:
+        server.stop(grace=0)

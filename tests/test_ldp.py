@@ -160,3 +160,39 @@ def test_system_data_tracked_while_inactive():
     eng.update()
     assert eng.active
     assert eng.interfaces["eth0"].active
+
+
+def test_yang_notifications_adjacency_and_peer():
+    """Reference holo-ldp northbound/notification.rs: hello-adjacency and
+    peer events at discovery, session-up, and hold expiry."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    notifs = []
+    l1 = LdpInstance("l1", A("1.1.1.1"), fabric.sender_for("l1"),
+                     notif_cb=notifs.append)
+    l2 = LdpInstance("l2", A("2.2.2.2"), fabric.sender_for("l2"))
+    loop.register(l1)
+    loop.register(l2)
+    fabric.join("l", "l1", "e0", A("10.0.0.1"))
+    fabric.join("l", "l2", "e0", A("10.0.0.2"))
+    l1.add_interface("e0", A("10.0.0.1"))
+    l2.add_interface("e0", A("10.0.0.2"))
+    loop.advance(10)
+    assert l1.neighbors[A("2.2.2.2")].state == NbrState.OPERATIONAL
+    kinds = [k for n in notifs for k in n]
+    assert "ietf-mpls-ldp:mpls-ldp-hello-adjacency-event" in kinds
+    peer_up = [n["ietf-mpls-ldp:mpls-ldp-peer-event"] for n in notifs
+               if "ietf-mpls-ldp:mpls-ldp-peer-event" in n]
+    assert peer_up and peer_up[0]["event-type"] == "up"
+    assert peer_up[0]["peer"]["lsr-id"] == "2.2.2.2"
+    # Silence l2: hold expiry tears adjacency + peer down.
+    notifs.clear()
+    loop.unregister("l2")
+    loop.advance(120)
+    downs = [n["ietf-mpls-ldp:mpls-ldp-peer-event"] for n in notifs
+             if "ietf-mpls-ldp:mpls-ldp-peer-event" in n]
+    assert downs and downs[-1]["event-type"] == "down"
+    adj_down = [n["ietf-mpls-ldp:mpls-ldp-hello-adjacency-event"]
+                for n in notifs
+                if "ietf-mpls-ldp:mpls-ldp-hello-adjacency-event" in n]
+    assert adj_down and adj_down[-1]["event-type"] == "down"

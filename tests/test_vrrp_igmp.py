@@ -99,3 +99,45 @@ def test_igmp_membership_and_querier_election():
     loop.send("q1", NetRxPacket("e0", A("10.0.0.99"), ALL_SYSTEMS, leave))
     loop.advance(3)
     assert A("239.1.2.3") not in q1.interfaces["e0"].groups
+
+
+def test_vrrp_yang_new_master_notification():
+    """Reference holo-vrrp northbound/notification.rs:21-29: master
+    transitions raise vrrp-new-master-event with the reason."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    v1, _ = mk_vrrp(loop, fabric, "v1", "192.0.2.1", prio=100)
+    v2, _ = mk_vrrp(loop, fabric, "v2", "192.0.2.2", prio=200)
+    notifs = []
+    v1.notif_cb = notifs.append
+    v1.startup()
+    v2.startup()
+    loop.advance(10)
+    assert v1.state == VrrpState.BACKUP and not notifs
+    loop.unregister("v2")
+    loop.advance(5)
+    assert v1.state == VrrpState.MASTER
+    ev = [n["ietf-vrrp:vrrp-new-master-event"] for n in notifs
+          if "ietf-vrrp:vrrp-new-master-event" in n]
+    assert ev and ev[0]["master-ip-address"] == "192.0.2.1"
+    assert ev[0]["new-master-reason"] == "no-response"
+
+
+def test_vrrp_new_master_reason_preempted():
+    """Preempting a live lower-priority master reports 'preempted', not
+    'no-response' (the master never stopped advertising)."""
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    v1, _ = mk_vrrp(loop, fabric, "v1", "192.0.2.1", prio=100)
+    v2, _ = mk_vrrp(loop, fabric, "v2", "192.0.2.2", prio=200)
+    notifs = []
+    v2.notif_cb = notifs.append
+    v1.startup()
+    loop.advance(10)
+    assert v1.state == VrrpState.MASTER
+    v2.startup()  # higher priority joins and preempts
+    loop.advance(15)
+    assert v2.state == VrrpState.MASTER
+    ev = [n["ietf-vrrp:vrrp-new-master-event"] for n in notifs
+          if "ietf-vrrp:vrrp-new-master-event" in n]
+    assert ev and ev[-1]["new-master-reason"] == "preempted", ev
