@@ -270,6 +270,7 @@ class OspfInstance(Actor):
         # uptime.  Without a store (deterministic tests) the seed stays 0.
         self._nvstore = nvstore
         self._seqno_key = f"ospf/{name}/seqno-ceiling"
+        self._grace_seqno_key = f"ospf/{name}/grace-seqno"
         self._crypto_reserved = 0
         if nvstore is not None:
             # Boot count is operational state only (exposed for debugging,
@@ -1170,6 +1171,28 @@ class OspfInstance(Actor):
                     allow_in_gr=True,
                     only_iface=iface,
                 )
+        # Persist the highest Grace-LSA seq-no actually used: the post-
+        # restart instance resumes from it when synthesizing the MaxAge
+        # flush, so helpers accept the flush no matter how many times
+        # grace params were re-announced before the restart.
+        if self._nvstore is not None:
+            seqs = [
+                e.lsa.seq_no
+                for area in self.areas.values()
+                for key in list(area.lsdb.entries)
+                if self._is_own_grace_lsa(key)
+                and (e := area.lsdb.get(key)) is not None
+            ]
+            if seqs:
+                self._nvstore.put(self._grace_seqno_key, max(seqs))
+
+    def _is_own_grace_lsa(self, key: "LsaKey") -> bool:
+        """Self-originated Grace-LSA key (link-local opaque type 3)."""
+        return (
+            key.type == LsaType.OPAQUE_LINK
+            and key.adv_rtr == self.config.router_id
+            and (int(key.lsid) >> 24) == 3
+        )
 
     def begin_graceful_restart(self, grace_period: int = 120) -> None:
         """Enter restarting mode with a hard exit deadline (RFC 3623 §2.5):
@@ -1237,15 +1260,19 @@ class OspfInstance(Actor):
             grace_lsa_lsid,
         )
 
+        # Resume from the persisted pre-restart Grace-LSA seq-no when the
+        # NV store has one (send_grace_lsas records it); the +4 guess is
+        # only the fallback for instances that never wrote the record.
+        synth_seq = next_seq_no(None) + 4
+        if self._nvstore is not None:
+            persisted = self._nvstore.get(self._grace_seqno_key)
+            if persisted is not None:
+                synth_seq = max(int(persisted) + 1, synth_seq)
         for area in self.areas.values():
             ifaces = list(area.interfaces.values())
             flushed: set = set()
             for key in list(area.lsdb.entries):
-                if (
-                    key.type == LsaType.OPAQUE_LINK
-                    and key.adv_rtr == self.config.router_id
-                    and (int(key.lsid) >> 24) == 3
-                ):
+                if self._is_own_grace_lsa(key):
                     idx = int(key.lsid) & 0xFFFFFF
                     only = ifaces[idx] if idx < len(ifaces) else None
                     self._flush_self_lsa(area, key, only_iface=only)
@@ -1261,13 +1288,11 @@ class OspfInstance(Actor):
                     type=LsaType.OPAQUE_LINK,
                     lsid=grace_lsa_lsid(idx),
                     adv_rtr=self.config.router_id,
-                    # A few past the initial seq-no: strictly newer than
-                    # the pre-restart copies helpers hold — including ones
-                    # re-announced with changed grace TLVs (each change
-                    # advanced the pre-restart seq by one; at equal seq
-                    # the cksum tie-break could keep the helper's copy) —
-                    # without any record of how far the old instance got.
-                    seq_no=next_seq_no(None) + 4,
+                    # Strictly newer than any pre-restart copy helpers
+                    # hold: the NV store records how far the old instance
+                    # got (synth_seq above); the +4-past-initial fallback
+                    # covers instances without the record.
+                    seq_no=synth_seq,
                     body=LsaOpaque(
                         encode_grace_tlvs(
                             self._gr_grace_period, self._gr_reason,

@@ -62,26 +62,71 @@ class ThreadedLoop:
             self._wake.notify()
         return ok
 
-    def call(self, fn, *args) -> None:
-        """Run ``fn(*args)`` on the loop thread (setup helpers)."""
+    def call(self, fn, *args) -> Any:
+        """Run ``fn(*args)`` on the loop thread and return its result.
+
+        Exceptions raised by ``fn`` propagate to the caller (a commit-time
+        reconfiguration error must fail the commit, exactly as it would
+        under cooperative scheduling), and a pump that never answers
+        raises ``TimeoutError`` rather than silently returning ``None``.
+        """
         done = threading.Event()
         box: list = []
+        err: list = []
+        # pending -> running -> finished, or pending -> cancelled: a call
+        # that times out before the pump picked it up is CANCELLED so the
+        # closure can never run after the caller was told it failed; only
+        # a closure already mid-run at the deadline may still complete
+        # (nothing can preempt it), and the TimeoutError says which case
+        # happened.
+        state = {"v": "pending"}
+        state_lock = threading.Lock()
 
         class _Call(Actor):
             name = f"_call_{id(done)}"
 
             def handle(self, msg):
+                with state_lock:
+                    if state["v"] == "cancelled":
+                        return
+                    state["v"] = "running"
                 try:
                     box.append(fn(*args))
+                except BaseException as exc:  # noqa: BLE001 — re-raised in caller
+                    err.append(exc)
                 finally:
+                    state["v"] = "finished"
                     done.set()
 
         with self._lock:
             self.loop.register(_Call())
         self.send(_Call.name, ())
-        done.wait(timeout=10)
+        ok = done.wait(timeout=10)
         with self._lock:
             self.loop.unregister(_Call.name)
+        if not ok:
+            with state_lock:
+                current = state["v"]
+                if current == "pending":
+                    state["v"] = "cancelled"
+                started = current != "pending"
+            if current == "finished":
+                # Finished in the race window between wait() and here:
+                # it's a success, report it as one.
+                if err:
+                    raise err[0]
+                return box[0] if box else None
+            raise TimeoutError(
+                f"{self.name}: call() timed out after 10s "
+                + (
+                    "(closure still running; its effects may still apply)"
+                    if started
+                    else "(closure cancelled before starting)"
+                )
+            )
+        if err:
+            raise err[0]
+        return box[0] if box else None
 
     def introspect(self) -> dict:
         """Snapshot of the inner loop plus thread liveness.  Taken under
@@ -207,9 +252,7 @@ class InstanceHandle:
             tl = self._tl
 
             def marshalled(*args, **kwargs):
-                out = []
-                tl.call(lambda: out.append(val(*args, **kwargs)))
-                return out[0] if out else None
+                return tl.call(lambda: val(*args, **kwargs))
 
             return marshalled
         return val

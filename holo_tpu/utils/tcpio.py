@@ -29,6 +29,7 @@ import errno
 import logging
 import socket
 import struct
+import threading
 from dataclasses import dataclass, field
 from ipaddress import IPv6Address, ip_address
 
@@ -107,6 +108,15 @@ def _listener_mss(ls: socket.socket, peers) -> None:
     vals = [p.tcp_mss for p in peers if p.tcp_mss is not None]
     if vals:
         ls.setsockopt(socket.IPPROTO_TCP, socket.TCP_MAXSEG, min(vals))
+    else:
+        # Removing the last configured clamp must un-stick the listener:
+        # Linux treats TCP_MAXSEG=0 as "clear user_mss" (tcp_setsockopt
+        # accepts 0 explicitly), restoring default MSS negotiation for
+        # future inbound sessions.
+        try:
+            ls.setsockopt(socket.IPPROTO_TCP, socket.TCP_MAXSEG, 0)
+        except OSError:
+            pass  # non-Linux: leave the previous clamp; documented limit
 
 
 def _apply_gtsm(s: socket.socket, slot: "_PeerSlot") -> None:
@@ -137,6 +147,27 @@ def _listener_max_ttl(s: socket.socket, v6: bool) -> None:
         s.setsockopt(socket.IPPROTO_IP, socket.IP_TTL, _TTL_MAX)
 
 
+def _locked(fn):
+    """Serialize a public BgpTcpIo entry point on the manager's lock.
+
+    Under ``[runtime] isolation = "threaded"`` three threads touch one
+    manager: the primary loop's poller (pump/tick), the instance thread
+    (session_reset on hold-timer expiry, add_peer/update_* at commit
+    time), and per-interface Tx tasks (send).  All slot/socket mutation
+    happens under this one re-entrant lock; nothing inside blocks (all
+    sockets are non-blocking and loop.send only enqueues), so hold times
+    are bounded.
+    """
+
+    def wrapper(self, *a, **k):
+        with self._lock:
+            return fn(self, *a, **k)
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
 class BgpTcpIo(NetIo):
     """Per-instance BGP TCP session manager."""
 
@@ -146,11 +177,27 @@ class BgpTcpIo(NetIo):
         self.port = port
         self.peers: dict = {}  # peer ip -> _PeerSlot
         self._listeners: dict[int, socket.socket] = {}  # fd -> socket
+        self._listener_ip: dict[int, object] = {}  # fd -> bound local ip
         self._bound: set = set()  # local ips with a listener
         self._by_fd: dict[int, _PeerSlot] = {}
+        self._lock = threading.RLock()
+
+    def _reclamp_listeners(self, local_ip) -> None:
+        """Re-apply the MSS clamp on the listener(s) bound to
+        ``local_ip`` only — a peer config change on one address must
+        never touch (or clear) another address's clamp."""
+        peers = [p for p in self.peers.values() if p.local_ip == local_ip]
+        for fd, ls in self._listeners.items():
+            if self._listener_ip.get(fd) != local_ip:
+                continue
+            try:
+                _listener_mss(ls, peers)
+            except OSError as e:
+                log.error("listener MSS clamp failed: %s", e)
 
     # -- setup
 
+    @_locked
     def listen(self, local_ip) -> None:
         """Bind a listening socket on ``local_ip`` (idempotent per address)."""
         ip = ip_address(local_ip)
@@ -167,6 +214,7 @@ class BgpTcpIo(NetIo):
             s.close()
             raise
         self._listeners[s.fileno()] = s
+        self._listener_ip[s.fileno()] = ip
         self._bound.add(ip)
         for slot in self.peers.values():
             if slot.md5_key and slot.local_ip == ip:
@@ -177,6 +225,7 @@ class BgpTcpIo(NetIo):
             s, [p for p in self.peers.values() if p.local_ip == ip]
         )
 
+    @_locked
     def add_peer(self, local_ip, peer_ip, ifname: str = "tcp", md5_key=None,
                  ttl_security: int | None = None,
                  tcp_mss: int | None = None):
@@ -210,16 +259,10 @@ class BgpTcpIo(NetIo):
                     _listener_max_ttl(ls, isinstance(pip, IPv6Address))
                 except OSError as e:
                     log.error("listener TTL bump failed: %s", e)
-            try:
-                _listener_mss(
-                    ls,
-                    [p for p in self.peers.values()
-                     if p.local_ip == slot.local_ip],
-                )
-            except OSError as e:
-                log.error("listener MSS clamp failed: %s", e)
+        self._reclamp_listeners(slot.local_ip)
         return slot
 
+    @_locked
     def update_mss(self, peer_ip, tcp_mss: int | None) -> None:
         """Live tcp-mss reconfiguration.  Re-clamps the listeners (for
         future inbound handshakes) and best-effort lowers the current
@@ -231,21 +274,14 @@ class BgpTcpIo(NetIo):
         if slot is None or slot.tcp_mss == tcp_mss:
             return
         slot.tcp_mss = tcp_mss
-        for ls in self._listeners.values():
-            try:
-                _listener_mss(
-                    ls,
-                    [p for p in self.peers.values()
-                     if p.local_ip == slot.local_ip],
-                )
-            except OSError as e:
-                log.error("listener MSS clamp failed: %s", e)
+        self._reclamp_listeners(slot.local_ip)
         if slot.sock is not None and tcp_mss is not None:
             try:
                 _apply_mss(slot.sock, slot)
             except OSError as e:
                 log.error("live MSS update on %s failed: %s", peer_ip, e)
 
+    @_locked
     def remove_peer(self, peer_ip) -> None:
         """Deconfigure: close any sockets and stop reconnecting."""
         slot = self.peers.pop(ip_address(peer_ip), None)
@@ -256,7 +292,9 @@ class BgpTcpIo(NetIo):
                 self._by_fd.pop(s.fileno(), None)
                 s.close()
         slot.sock = slot.connecting = None
+        self._reclamp_listeners(slot.local_ip)
 
+    @_locked
     def update_md5(self, peer_ip, key: bytes | None) -> None:
         """Key rotation: re-key listeners, reset the session so the next
         connection authenticates with the new key."""
@@ -271,6 +309,7 @@ class BgpTcpIo(NetIo):
                 log.error("MD5 re-key on listener failed: %s", e)
         self.session_reset(peer_ip)
 
+    @_locked
     def session_reset(self, peer_ip) -> None:
         """FSM-initiated drop (hold timer, NOTIFICATION): close the
         transport silently so a fresh connection can form.  Without this
@@ -286,6 +325,7 @@ class BgpTcpIo(NetIo):
 
     # -- NetIo
 
+    @_locked
     def send(self, ifname: str, src, dst, data: bytes) -> None:
         slot = self.peers.get(ip_address(dst))
         if slot is None or slot.sock is None:
@@ -295,6 +335,7 @@ class BgpTcpIo(NetIo):
 
     # -- polling integration
 
+    @_locked
     def fds(self) -> list[int]:
         """Readable fds (listeners + sessions) for the daemon's poller."""
         out = list(self._listeners)
@@ -305,6 +346,7 @@ class BgpTcpIo(NetIo):
                 out.append(slot.connecting.fileno())
         return out
 
+    @_locked
     def wfds(self) -> list[int]:
         """Writable-interest fds: in-progress connects + pending tx."""
         out = []
@@ -315,12 +357,14 @@ class BgpTcpIo(NetIo):
                 out.append(slot.sock.fileno())
         return out
 
+    @_locked
     def tick(self) -> None:
         """Retry outbound connects for active peers without a session."""
         for slot in self.peers.values():
             if slot.active and slot.sock is None and slot.connecting is None:
                 self._connect(slot)
 
+    @_locked
     def pump(self, fd: int) -> int:
         """Handle readiness on ``fd``; returns number of delivered msgs."""
         if fd in self._listeners:
@@ -434,6 +478,8 @@ class BgpTcpIo(NetIo):
             del slot.txbuf[:n]
 
     def _read(self, slot: _PeerSlot) -> int:
+        if slot.sock is None:
+            return 0  # torn down earlier in this pump cycle
         try:
             data = slot.sock.recv(65536)
         except BlockingIOError:
@@ -464,6 +510,7 @@ class BgpTcpIo(NetIo):
             self._flush(slot)
         return delivered
 
+    @_locked
     def close(self) -> None:
         for s in self._listeners.values():
             s.close()
@@ -474,6 +521,7 @@ class BgpTcpIo(NetIo):
                     s.close()
             slot.sock = slot.connecting = None
         self._by_fd.clear()
+        self._listener_ip.clear()
 
 
 def wait_ready(ios: list["BgpTcpIo"], timeout_ms: int) -> list[int]:
@@ -491,7 +539,14 @@ def wait_ready(ios: list["BgpTcpIo"], timeout_ms: int) -> list[int]:
 
         _t.sleep(timeout_ms / 1000.0)
         return []
-    r, w, _ = select.select(rfds, wfds, [], timeout_ms / 1000.0)
+    try:
+        r, w, _ = select.select(rfds, wfds, [], timeout_ms / 1000.0)
+    except (OSError, ValueError):
+        # An instance/management thread closed one of the snapshotted
+        # sockets (session_reset, remove_peer) mid-select: EBADF (or a
+        # -1 fileno).  The snapshot is stale, not the daemon — return
+        # empty and let the caller re-collect fds on its next cycle.
+        return []
     return list(set(r) | set(w))
 
 
@@ -508,7 +563,10 @@ def pump_once(ios: list[BgpTcpIo], timeout_ms: int = 50) -> int:
             wmap[fd] = io
     if not rmap and not wmap:
         return 0
-    r, w, _ = select.select(list(rmap), list(wmap), [], timeout_ms / 1000.0)
+    try:
+        r, w, _ = select.select(list(rmap), list(wmap), [], timeout_ms / 1000.0)
+    except (OSError, ValueError):
+        return 0  # fd closed cross-thread mid-select; retry next cycle
     n = 0
     for fd in set(r) | set(w):
         io = rmap.get(fd) or wmap.get(fd)

@@ -94,6 +94,16 @@ class TxTaskNetIo(NetIo):
         if t is not None:
             t.q.put((src, dst, data))
 
+    def __getattr__(self, name: str):
+        # Forward everything we don't override to the wrapped NetIo:
+        # protocol engines probe transport-specific surface (e.g. BGP's
+        # session_reset on BgpTcpIo) via getattr, and wrapping under
+        # threaded isolation must not hide it.
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
     def queue_depth(self, ifname: str) -> int:
         t = self._tasks.get(ifname)
         return t.q.qsize() if t is not None else 0

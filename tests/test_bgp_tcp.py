@@ -348,3 +348,38 @@ def test_tcp_mss_option_applied():
         io1.add_peer("127.0.11.1", "127.0.11.9", tcp_mss=40000)
     for io in (io1, io2):
         io.close()
+
+
+def test_listener_mss_scoped_to_bound_address():
+    """A peer config change on one local address must never touch —
+    or clear — another address's listener clamp (r5 review): the clamp
+    is re-applied only to listeners bound to the changed peer's
+    local ip, and removing the last clamped peer clears it."""
+    import socket as _socket
+
+    loop = EventLoop(clock=RealClock())
+    io = BgpTcpIo(loop, "mss-scope", port=PORT + 11)
+    io.add_peer("127.0.12.1", "127.0.12.9", tcp_mss=1400)
+    io.listen("127.0.12.1")
+    io.listen("127.0.12.2")
+    fd1 = next(
+        fd for fd, ip in io._listener_ip.items() if str(ip) == "127.0.12.1"
+    )
+    fd2 = next(
+        fd for fd, ip in io._listener_ip.items() if str(ip) == "127.0.12.2"
+    )
+    ls1, ls2 = io._listeners[fd1], io._listeners[fd2]
+
+    def user_mss(s):
+        # On a LISTEN socket Linux reports user_mss (0 = unset).
+        return s.getsockopt(_socket.IPPROTO_TCP, _socket.TCP_MAXSEG)
+
+    assert user_mss(ls1) == 1400
+    # Unclamped peer on the OTHER address: L1's clamp must survive.
+    io.add_peer("127.0.12.2", "127.0.12.8", tcp_mss=None)
+    assert user_mss(ls1) == 1400
+    assert user_mss(ls2) in (0, 536)  # unset (platform default report)
+    # Removing the last clamped peer on .1 clears that listener only.
+    io.remove_peer("127.0.12.9")
+    assert user_mss(ls1) in (0, 536)
+    io.close()

@@ -154,3 +154,27 @@ def test_cooperative_loop_shows_the_hazard():
     assert not (before & after), (
         "expected the cooperative loop to show the starvation hazard"
     )
+
+
+def test_call_propagates_exceptions_and_returns_result():
+    """ThreadedLoop.call must surface the closure's result AND its
+    exception: a commit-time reconfiguration error on a threaded instance
+    has to fail the commit, not vanish (advisor r4)."""
+    from holo_tpu.utils.preempt import ThreadedLoop
+
+    tl = ThreadedLoop("t-call").start()
+    try:
+        assert tl.call(lambda: 41 + 1) == 42
+
+        def boom():
+            raise ValueError("bad peer config")
+
+        try:
+            tl.call(boom)
+            raise AssertionError("expected ValueError")
+        except ValueError as exc:
+            assert "bad peer config" in str(exc)
+        # The loop is still healthy after a raising call.
+        assert tl.call(lambda: "ok") == "ok"
+    finally:
+        tl.stop()
