@@ -364,6 +364,15 @@ class IsisInstance(Actor):
         self.hostnames: dict[bytes, str] = {}
         self.spf_run_count = 0
         self._spf_pending = False
+        # Full-vs-RouteOnly classification (reference
+        # holo-isis/src/spf.rs:150-156, lsdb.rs:1558-1612): an LSP whose
+        # IS-reachability TLVs are unchanged only needs route
+        # recomputation over the cached SPT, not a new Dijkstra.  Any
+        # non-LSP event (adjacency churn, config) forces Full.
+        self._spf_type_full = True
+        self._spt_cache: dict | None = None
+        # SPF run log ring (reference spf.rs log_spf_run; 32 entries).
+        self.spf_log: list[dict] = []
         # RFC 8405 SPF-delay FSM state surfaced in operational state
         # (reference spf.rs delay FSM; transitions driven by IGP events
         # + the Learn/HoldDown timers the conformance harness replays).
@@ -1397,7 +1406,24 @@ class IsisInstance(Actor):
             and prev.lsp.tlvs == lsp.tlvs
         )
         if content_change and lsp.seqno != 0:
-            self._schedule_spf()
+            # Full SPF only when the IS-reachability (or flags/liveness)
+            # changed; a prefix-only change is a RouteOnly run over the
+            # cached SPT (reference lsdb.rs:1604-1612 topology_change).
+            topology_change = not (
+                prev is not None
+                and prev.lsp.is_expired == lsp.is_expired
+                and prev.lsp.flags == lsp.flags
+                and all(
+                    prev.lsp.tlvs.get(k) == lsp.tlvs.get(k)
+                    for k in (
+                        "ext_is_reach",
+                        "narrow_is_reach",
+                        "mt_is_reach",
+                        "mt_ids",
+                    )
+                )
+            )
+            self._schedule_spf(topology=topology_change)
 
     def _arm_flood(self) -> None:
         if not self._flood_timer.armed:
@@ -1754,7 +1780,9 @@ class IsisInstance(Actor):
 
     # -- SPF (shared backend)
 
-    def _schedule_spf(self) -> None:
+    def _schedule_spf(self, topology: bool = True) -> None:
+        if topology:
+            self._spf_type_full = True
         if self.spf_delay_state == "quiet":
             self.spf_delay_state = "short-wait"
         if not self._spf_pending:
@@ -1812,24 +1840,6 @@ class IsisInstance(Actor):
         self_key = self.sysid + b"\x00"
         if self_key not in nodes:
             return
-        # Vertex ordering contract (same as OSPF): network vertices —
-        # pseudonodes — sort before routers, so equal-distance paths
-        # through a zero-cost pseudonode edge settle before the router
-        # they lead to and ECMP unions are not dropped.
-        order = sorted(nodes.keys(), key=lambda k: (k[6] == 0, k))
-        index = {k: i for i, k in enumerate(order)}
-        n = len(order)
-        is_router = np.array([k[6] == 0 for k in order], bool)
-        adj_by_sysid: dict[bytes, list] = {}  # key -> [(ifname, a4, a6)]
-        lan_iface_by_id = {}  # pseudonode key -> ifname (LANs we sit on)
-        for iface in self.interfaces.values():
-            for adj in iface.up_adjacencies():
-                adj_by_sysid.setdefault(adj.sysid + b"\x00", []).append(
-                    (iface.name, adj.addr, adj.addr6)
-                )
-            if iface.is_lan and iface.dis_lan_id is not None:
-                lan_iface_by_id[iface.dis_lan_id] = iface.name
-
         def _att(node, mt_id) -> bool:
             """Attached bit for one topology: LSP flags nibble (0x78 —
             the reference emits 0x40) for the default topology, the
@@ -1845,133 +1855,177 @@ class IsisInstance(Actor):
                 return bool(node["flags"] & 0x04)
             return node["mt"].get(mt_id, (False, False))[1]
 
-        def _build(edges_of, mt_id):
-            """Topology + next-hop atoms for one edge selection (the
-            default topology, or the RFC 5120 MT-2 overlay)."""
-            src, dst, cost = [], [], []
-            for k, node in nodes.items():
-                u = index[k]
-                for reach in edges_of(k, node):
-                    v = index.get(reach.neighbor)
-                    if v is not None:
-                        src.append(u)
-                        dst.append(v)
-                        cost.append(reach.metric)
-            src = np.array(src, np.int32).reshape(-1)
-            dst = np.array(dst, np.int32).reshape(-1)
-            cost = np.array(cost, np.int32).reshape(-1)
-            keep = mutual_keep_mask(src, dst)
-            # Overload (ISO 10589 §7.2.8.1, reference spf.rs:563-574):
-            # an overloaded router stays REACHABLE — its own prefixes
-            # install — but is never expanded for transit.  Drop its
-            # out-edges AFTER the mutual filter so its in-edges survive.
-            ovl_vertices = {
-                index[k]
-                for k, node in nodes.items()
-                if k[6] == 0 and k != self_key and _ovl(node, mt_id)
-            }
-            if ovl_vertices:
-                keep &= ~np.isin(src, np.array(list(ovl_vertices), np.int32))
-            topo = Topology(
-                n_vertices=n,
-                is_router=is_router,
-                edge_src=src[keep],
-                edge_dst=dst[keep],
-                edge_cost=cost[keep],
-                root=index[self_key],
-            )
-            # Next-hop atoms: adjacencies out of the root.  A neighbor
-            # reached over parallel p2p circuits has one adjacency per
-            # circuit AND one duplicate is-reach edge per circuit — pair
-            # them up so each edge carries its own interface atom
-            # (reference spf next-hop model).
-            atoms = []
-            atom_ids = np.full(topo.n_edges, -1, np.int32)
-            root_lans: set[int] = set()
-            hops_used: dict[bytes, int] = {}
-            for e_i in range(topo.n_edges):
-                if topo.edge_src[e_i] == topo.root:
-                    k = order[int(topo.edge_dst[e_i])]
-                    if k[6] == 0:  # router neighbor (p2p)
-                        hops = adj_by_sysid.get(k)
-                        if hops:
-                            i = hops_used.get(k, 0)
-                            hops_used[k] = i + 1
-                            atom_ids[e_i] = len(atoms)
-                            atoms.append(hops[min(i, len(hops) - 1)])
-                    elif k in lan_iface_by_id:
-                        root_lans.add(int(topo.edge_dst[e_i]))
-            # Pseudonode -> member edges on root-adjacent LANs: direct
-            # next hop is the member's address on that LAN (the generic
-            # hops==0 rule).
-            for e_i in range(topo.n_edges):
-                u = int(topo.edge_src[e_i])
-                if u in root_lans:
-                    lan_key = order[u]
-                    member = order[int(topo.edge_dst[e_i])]
-                    if member == self_key:
-                        continue
-                    ifname = lan_iface_by_id.get(lan_key)
-                    hop = next(
-                        (h for h in adj_by_sysid.get(member, [])
-                         if h[0] == ifname),
-                        None,
-                    )
-                    if hop is not None:
-                        atom_ids[e_i] = len(atoms)
-                        atoms.append(hop)
-            topo.edge_direct_atom = atom_ids
-            topo.touch()
-            return topo, atoms
 
-        topo, atoms4 = _build(lambda k, node: node["is"], 0)
-        res4 = self.backend.compute(topo)
-        self.vertex_dist = {
-            k[:6]: int(res4.dist[index[k]])
-            for k in nodes
-            if k[6] == 0 and res4.dist[index[k]] < INF
-        }
-        # IPv6 path: routers running MT (RFC 5120) keep IPv6 in topology
-        # 2 — a separate graph (pseudonodes contribute their plain TLV-22
-        # membership; the mutual filter prunes members without an MT-2
-        # back edge).  Single-topology routers share the default SPF.
-        mt6 = MT_IPV6 in nodes[self_key]["mt"]
-        if mt6:
-            topo6, atoms6 = _build(
-                lambda k, node: node["is6"] if k[6] == 0 else node["is"],
-                MT_IPV6,
-            )
-            res6 = self.backend.compute(topo6)
+        spf_type_full_req = self._spf_type_full
+        self._spf_type_full = False
+        _cache = self._spt_cache
+        spf_type = "full"
+        if (
+            not spf_type_full_req
+            and _cache is not None
+            and all(k in _cache["index"] for k in nodes)
+        ):
+            spf_type = "route-only"
+        if spf_type == "route-only":
+            # RouteOnly (reference spf.rs:744): prefix reachability
+            # changed but the IS graph did not — reuse the cached SPTs
+            # and recompute routes only; no Dijkstra dispatch.
+            order, index = _cache["order"], _cache["index"]
+            res4, atoms4 = _cache["res4"], _cache["atoms4"]
+            mt6 = _cache["mt6"]
+            res6, atoms6 = _cache["res6"], _cache["atoms6"]
         else:
-            res6, atoms6 = res4, atoms4
-
-        # Flooding-reduction cache rebuild (reference spf.rs:763-779):
-        # per-neighbor hop-count SPTs via one multi-root batch.
-        if self.flooding_reduction:
-            from holo_tpu.protocols.isis.flooding_reduction import (
-                neighbor_coverage,
-            )
-
-            nbr_vertex_by_iface = {}
-            iface_by_vertex = {}
-            sysid_by_vertex = {}
+            # Vertex ordering contract (same as OSPF): network vertices —
+            # pseudonodes — sort before routers, so equal-distance paths
+            # through a zero-cost pseudonode edge settle before the router
+            # they lead to and ECMP unions are not dropped.
+            order = sorted(nodes.keys(), key=lambda k: (k[6] == 0, k))
+            index = {k: i for i, k in enumerate(order)}
+            n = len(order)
+            is_router = np.array([k[6] == 0 for k in order], bool)
+            adj_by_sysid: dict[bytes, list] = {}  # key -> [(ifname, a4, a6)]
+            lan_iface_by_id = {}  # pseudonode key -> ifname (LANs we sit on)
             for iface in self.interfaces.values():
-                if iface.is_lan or iface.adj is None:
-                    continue
-                v = index.get(iface.adj.sysid + b"\x00")
-                if v is not None and iface.adj.state == AdjacencyState.UP:
-                    nbr_vertex_by_iface[iface.name] = v
-                    iface_by_vertex[v] = iface.name
-                    sysid_by_vertex[v] = iface.adj.sysid
-            self._covered_by = {}
-            if len(nbr_vertex_by_iface) > 1:
-                cov = neighbor_coverage(
-                    topo, self.backend, list(nbr_vertex_by_iface.values())
+                for adj in iface.up_adjacencies():
+                    adj_by_sysid.setdefault(adj.sysid + b"\x00", []).append(
+                        (iface.name, adj.addr, adj.addr6)
+                    )
+                if iface.is_lan and iface.dis_lan_id is not None:
+                    lan_iface_by_id[iface.dis_lan_id] = iface.name
+
+            def _build(edges_of, mt_id):
+                """Topology + next-hop atoms for one edge selection (the
+                default topology, or the RFC 5120 MT-2 overlay)."""
+                src, dst, cost = [], [], []
+                for k, node in nodes.items():
+                    u = index[k]
+                    for reach in edges_of(k, node):
+                        v = index.get(reach.neighbor)
+                        if v is not None:
+                            src.append(u)
+                            dst.append(v)
+                            cost.append(reach.metric)
+                src = np.array(src, np.int32).reshape(-1)
+                dst = np.array(dst, np.int32).reshape(-1)
+                cost = np.array(cost, np.int32).reshape(-1)
+                keep = mutual_keep_mask(src, dst)
+                # Overload (ISO 10589 §7.2.8.1, reference spf.rs:563-574):
+                # an overloaded router stays REACHABLE — its own prefixes
+                # install — but is never expanded for transit.  Drop its
+                # out-edges AFTER the mutual filter so its in-edges survive.
+                ovl_vertices = {
+                    index[k]
+                    for k, node in nodes.items()
+                    if k[6] == 0 and k != self_key and _ovl(node, mt_id)
+                }
+                if ovl_vertices:
+                    keep &= ~np.isin(src, np.array(list(ovl_vertices), np.int32))
+                topo = Topology(
+                    n_vertices=n,
+                    is_router=is_router,
+                    edge_src=src[keep],
+                    edge_dst=dst[keep],
+                    edge_cost=cost[keep],
+                    root=index[self_key],
                 )
-                for m, others in cov.items():
-                    self._covered_by[sysid_by_vertex[m]] = {
-                        iface_by_vertex[n] for n in others
-                    }
+                # Next-hop atoms: adjacencies out of the root.  A neighbor
+                # reached over parallel p2p circuits has one adjacency per
+                # circuit AND one duplicate is-reach edge per circuit — pair
+                # them up so each edge carries its own interface atom
+                # (reference spf next-hop model).
+                atoms = []
+                atom_ids = np.full(topo.n_edges, -1, np.int32)
+                root_lans: set[int] = set()
+                hops_used: dict[bytes, int] = {}
+                for e_i in range(topo.n_edges):
+                    if topo.edge_src[e_i] == topo.root:
+                        k = order[int(topo.edge_dst[e_i])]
+                        if k[6] == 0:  # router neighbor (p2p)
+                            hops = adj_by_sysid.get(k)
+                            if hops:
+                                i = hops_used.get(k, 0)
+                                hops_used[k] = i + 1
+                                atom_ids[e_i] = len(atoms)
+                                atoms.append(hops[min(i, len(hops) - 1)])
+                        elif k in lan_iface_by_id:
+                            root_lans.add(int(topo.edge_dst[e_i]))
+                # Pseudonode -> member edges on root-adjacent LANs: direct
+                # next hop is the member's address on that LAN (the generic
+                # hops==0 rule).
+                for e_i in range(topo.n_edges):
+                    u = int(topo.edge_src[e_i])
+                    if u in root_lans:
+                        lan_key = order[u]
+                        member = order[int(topo.edge_dst[e_i])]
+                        if member == self_key:
+                            continue
+                        ifname = lan_iface_by_id.get(lan_key)
+                        hop = next(
+                            (h for h in adj_by_sysid.get(member, [])
+                             if h[0] == ifname),
+                            None,
+                        )
+                        if hop is not None:
+                            atom_ids[e_i] = len(atoms)
+                            atoms.append(hop)
+                topo.edge_direct_atom = atom_ids
+                topo.touch()
+                return topo, atoms
+
+            topo, atoms4 = _build(lambda k, node: node["is"], 0)
+            res4 = self.backend.compute(topo)
+            self.vertex_dist = {
+                k[:6]: int(res4.dist[index[k]])
+                for k in nodes
+                if k[6] == 0 and res4.dist[index[k]] < INF
+            }
+            # IPv6 path: routers running MT (RFC 5120) keep IPv6 in topology
+            # 2 — a separate graph (pseudonodes contribute their plain TLV-22
+            # membership; the mutual filter prunes members without an MT-2
+            # back edge).  Single-topology routers share the default SPF.
+            mt6 = MT_IPV6 in nodes[self_key]["mt"]
+            if mt6:
+                topo6, atoms6 = _build(
+                    lambda k, node: node["is6"] if k[6] == 0 else node["is"],
+                    MT_IPV6,
+                )
+                res6 = self.backend.compute(topo6)
+            else:
+                res6, atoms6 = res4, atoms4
+
+            # Flooding-reduction cache rebuild (reference spf.rs:763-779):
+            # per-neighbor hop-count SPTs via one multi-root batch.
+            if self.flooding_reduction:
+                from holo_tpu.protocols.isis.flooding_reduction import (
+                    neighbor_coverage,
+                )
+
+                nbr_vertex_by_iface = {}
+                iface_by_vertex = {}
+                sysid_by_vertex = {}
+                for iface in self.interfaces.values():
+                    if iface.is_lan or iface.adj is None:
+                        continue
+                    v = index.get(iface.adj.sysid + b"\x00")
+                    if v is not None and iface.adj.state == AdjacencyState.UP:
+                        nbr_vertex_by_iface[iface.name] = v
+                        iface_by_vertex[v] = iface.name
+                        sysid_by_vertex[v] = iface.adj.sysid
+                self._covered_by = {}
+                if len(nbr_vertex_by_iface) > 1:
+                    cov = neighbor_coverage(
+                        topo, self.backend, list(nbr_vertex_by_iface.values())
+                    )
+                    for m, others in cov.items():
+                        self._covered_by[sysid_by_vertex[m]] = {
+                            iface_by_vertex[n] for n in others
+                        }
+
+            self._spt_cache = {
+                "order": order, "index": index, "res4": res4,
+                "atoms4": atoms4, "mt6": mt6, "res6": res6,
+                "atoms6": atoms6,
+            }
 
         from holo_tpu.protocols.ospf.spf_run import atom_bits
 
@@ -2082,6 +2136,18 @@ class IsisInstance(Actor):
                         nhs |= cur
                 if best is not None:
                     _add(default, best, nhs)
+        # SPF run log ring (reference spf.rs log_spf_run): records the
+        # Full/RouteOnly split for operational state.
+        self.spf_log.append(
+            {
+                "run": self.spf_run_count,
+                "type": spf_type,
+                "start-time": now,
+                "end-time": self.loop.clock.now(),
+                "route-count": len(routes),
+            }
+        )
+        del self.spf_log[:-32]
         self.routes = routes
         self.connected_prefixes = frozenset(connected)
         self.sr_labels = self._resolve_sr_labels(routes)

@@ -306,6 +306,16 @@ class OspfInstance(Actor):
         self.spf_log: list[dict] = []
         self._spf_scheduled_at: float | None = None
         self._spf_trigger_count = 0
+        # Full-vs-partial trigger classification (reference
+        # holo-ospf/src/spf.rs:49-60,513-516): LSAs that changed since the
+        # last run accumulate here; non-LSA events (config, interface
+        # state, clear) force a full run.  The cache holds the last full
+        # run's products (per-area SPTs + derived route tables) so a
+        # summary/external-only change recomputes scoped table entries
+        # without re-running Dijkstra (route.rs:200-333).
+        self._spf_triggers: list = []
+        self._spf_force_full = True
+        self._spf_cache: dict | None = None
         self.ibus = None  # set via attach_ibus for RIB integration
         self.routing_actor = "routing"
         # Externals we originate (type 5; stored in every area's LSDB with
@@ -983,9 +993,14 @@ class OspfInstance(Actor):
                 best = (dist, _atoms_of(res.nexthop_words[abr_v], st.atoms))
         return best if best is not None else (None, None)
 
-    def _external_routes(self, area_results: dict, known: set) -> dict:
+    def _external_routes(
+        self, area_results: dict, known: set, only: set | None = None
+    ) -> dict:
         """§16.4 condensed: E1 = dist(ASBR)+metric; E2 ranked by (metric,
-        dist(ASBR)) after all internal paths; intra/inter always win."""
+        dist(ASBR)) after all internal paths; intra/inter always win.
+
+        ``only`` scopes a partial run to the changed prefixes
+        (route.rs:307-321): other externals keep their table entries."""
         best: dict = {}
         now = self.loop.clock.now()
         for aid, (st, res) in area_results.items():
@@ -1015,6 +1030,8 @@ class OspfInstance(Actor):
                 from holo_tpu.utils.ip import apply_mask
 
                 prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                if only is not None and prefix not in only:
+                    continue  # partial run: out-of-scope prefix
                 if prefix in known:
                     continue  # internal paths always preferred
                 # Ranking key: E1 before E2; E1 by total; E2 by (metric,
@@ -1816,6 +1833,7 @@ class OspfInstance(Actor):
         if lsa.type == LsaType.AS_EXTERNAL and area.stub:
             return False  # §3.6: stub areas refuse AS-external LSAs
         now = self.loop.clock.now()
+        old = area.lsdb.get(lsa.key)
         _, changed = area.lsdb.install(lsa, now)
         if lsa.type == LsaType.OPAQUE_LINK:
             # Operational state groups type-9s under their link: remember
@@ -1832,7 +1850,12 @@ class OspfInstance(Actor):
             in (LsaType.SUMMARY_NETWORK, LsaType.SUMMARY_ROUTER)
         )
         if changed and not self_orig_summary:
-            self._schedule_spf()
+            # Old body rides along: a mask change moves the prefix, and
+            # the partial run must reconsider BOTH the old and the new
+            # prefix or the withdrawn one keeps a stale route.
+            self._schedule_spf(
+                trigger=(lsa, old.lsa if old is not None else None)
+            )
         if lsa.adv_rtr != self.config.router_id:
             self._maybe_enter_gr_helper(area, lsa)
         # A changed topology-information LSA terminates every open helper
@@ -2393,11 +2416,19 @@ class OspfInstance(Actor):
 
     # ----- SPF scheduling (RFC 8405 delay FSM)
 
-    def _schedule_spf(self) -> None:
+    def _schedule_spf(self, trigger=None) -> None:
         """RFC 8405 SPF delay FSM (reference holo-ospf/src/spf.rs:295-484):
         QUIET→SHORT_WAIT on first IGP event (initial_delay); further events
         in SHORT_WAIT use short_delay until time_to_learn expires, then
-        LONG_WAIT uses long_delay; HOLDDOWN quiet time returns to QUIET."""
+        LONG_WAIT uses long_delay; HOLDDOWN quiet time returns to QUIET.
+
+        ``trigger`` is the changed LSA when the event is an LSDB install;
+        a trigger-less call (config/interface/clear events) marks the next
+        run as unconditionally full (spf.rs:511-516 force_full_run)."""
+        if trigger is None:
+            self._spf_force_full = True
+        else:
+            self._spf_triggers.append(trigger)
         cfg = self.config.spf
         now = self.loop.clock.now()
         self._spf_trigger_count += 1
@@ -2441,6 +2472,52 @@ class OspfInstance(Actor):
         ]
         return len(active) > 1
 
+    def _classify_spf(self, triggers: list) -> dict | None:
+        """Full-vs-partial trigger classification (reference
+        holo-ospf/src/ospfv2/spf.rs:99-171).  Returns None when a full
+        SPF is required (topology changed), else the partial sets.
+
+        Router/Network-LSA changes are topological; Opaque changes
+        (RI/SR ext-prefix/ext-link) also force full because SR label
+        derivation depends on them (the reference makes the same
+        simplification).  Link-local opaques (Grace) never affect
+        routes.  Summaries and externals are prefix-scoped."""
+        from holo_tpu.utils.ip import apply_mask
+
+        inter_network: set = set()
+        inter_router: set = set()
+        external: set = set()
+        for new, old in triggers:
+            t = new.type
+            if t in (
+                LsaType.ROUTER,
+                LsaType.NETWORK,
+                LsaType.OPAQUE_AREA,
+                LsaType.OPAQUE_AS,
+            ):
+                return None
+            if t == LsaType.OPAQUE_LINK:
+                continue  # Grace-LSAs carry no routing information
+            # Both versions contribute prefixes: a mask change moves the
+            # prefix and the OLD one must drop its route too.
+            if t == LsaType.SUMMARY_NETWORK:
+                for lsa in (new, old):
+                    if lsa is not None:
+                        inter_network.add(apply_mask(lsa.lsid, lsa.body.mask))
+            elif t == LsaType.SUMMARY_ROUTER:
+                inter_router.add(new.lsid)
+            elif t in (LsaType.AS_EXTERNAL, LsaType.NSSA_EXTERNAL):
+                for lsa in (new, old):
+                    if lsa is not None:
+                        external.add(apply_mask(lsa.lsid, lsa.body.mask))
+            else:
+                return None  # unknown type: be safe, run full
+        return {
+            "inter_network": inter_network,
+            "inter_router": inter_router,
+            "external": external,
+        }
+
     def run_spf(self) -> None:
         now = self.loop.clock.now()
         self.spf_run_count += 1
@@ -2449,6 +2526,14 @@ class OspfInstance(Actor):
         triggers = self._spf_trigger_count
         self._spf_scheduled_at = None
         self._spf_trigger_count = 0
+        trigger_lsas = self._spf_triggers
+        self._spf_triggers = []
+        force_full = self._spf_force_full
+        self._spf_force_full = False
+        partial = None if force_full else self._classify_spf(trigger_lsas)
+        if partial is not None and self._spf_cache is not None:
+            self._run_spf_partial(partial, scheduled_at, triggers, start_time)
+            return
         all_routes = {}
         area_intra: dict[IPv4Address, dict] = {}
         area_results: dict[IPv4Address, tuple] = {}
@@ -2602,6 +2687,7 @@ class OspfInstance(Actor):
         self.spf_log.append(
             {
                 "run": self.spf_run_count,
+                "type": "full",
                 "backend": self.backend.name,
                 "scheduled-at": scheduled_at,
                 "start-time": start_time,
@@ -2612,7 +2698,151 @@ class OspfInstance(Actor):
         )
         del self.spf_log[:-32]
 
+        # Cache this run's products: a later summary/external-only change
+        # reuses the per-area SPTs and rewrites only the affected table
+        # entries (reference route.rs:200-333 update_rib_partial).
+        self._spf_cache = {
+            "area_results": area_results,
+            "area_intra": area_intra,
+            "routes": all_routes,
+            "inter_routes": inter_routes,
+        }
+
         self._finish_spf(all_routes)
+
+    def _run_spf_partial(
+        self, partial: dict, scheduled_at, triggers: int, start_time: float
+    ) -> None:
+        """Prefix-scoped route recomputation over the cached SPTs —
+        no Dijkstra runs (reference route.rs:200-333).
+
+        In OSPFv2 intra-area information lives in Router/Network-LSAs,
+        which always force a full run, so only the inter-area and
+        external stages apply (ospfv2/spf.rs:124-126)."""
+        cache = self._spf_cache
+        area_results = cache["area_results"]
+        area_intra = cache["area_intra"]
+        routes = dict(cache["routes"])
+        inter_routes = dict(cache["inter_routes"])
+        now = self.loop.clock.now()
+        inter_network = set(partial["inter_network"])
+        inter_router = set(partial["inter_router"])
+        external = set(partial["external"])
+
+        from holo_tpu.protocols.ospf.spf_run import IntraRoute, _atoms_of
+        from holo_tpu.utils.ip import apply_mask
+
+        inter_changed = False
+        if inter_network:
+            # Remove affected inter-area routes, then re-derive them for
+            # exactly those prefixes from the cached per-area SPTs.
+            removed: set = set()
+            for prefix in inter_network:
+                r = routes.get(prefix)
+                if r is not None and r.rtype == "inter":
+                    del routes[prefix]
+                    inter_routes.pop(prefix, None)
+                    removed.add(prefix)
+            intra_prefixes = {
+                p for p, r in routes.items() if r.rtype == "intra"
+            }
+            for area in self.areas.values():
+                sr = area_results.get(area.area_id)
+                if sr is None:
+                    continue
+                st, res = sr
+                for e in area.lsdb.all():
+                    lsa = e.lsa
+                    if (
+                        lsa.type != LsaType.SUMMARY_NETWORK
+                        or lsa.adv_rtr == self.config.router_id
+                        or e.current_age(now) >= MAX_AGE
+                    ):
+                        continue
+                    if self.is_abr and int(area.area_id) != 0:
+                        continue  # §16.2: ABRs examine backbone summaries
+                    prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                    if prefix not in inter_network:
+                        continue  # scoped: untouched prefixes keep routes
+                    abr_v = st.router_index.get(lsa.adv_rtr)
+                    if abr_v is None or res.dist[abr_v] >= 0x40000000:
+                        continue
+                    if prefix in intra_prefixes:
+                        continue  # intra-area preferred
+                    dist = int(res.dist[abr_v]) + lsa.body.metric
+                    nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
+                    cur = routes.get(prefix)
+                    if cur is not None and cur.rtype not in ("intra", "inter"):
+                        # Path-type preference, not distance: inter-area
+                        # always displaces an external entry (§11).
+                        cur = None
+                    if cur is None or dist < cur.dist:
+                        route = IntraRoute(
+                            prefix, dist, nhs, area.area_id, "inter"
+                        )
+                        routes[prefix] = route
+                        inter_routes[prefix] = route
+                        inter_changed = True
+                    elif dist == cur.dist and cur.rtype == "inter":
+                        route = IntraRoute(
+                            prefix, dist, cur.nexthops | nhs,
+                            area.area_id, "inter",
+                        )
+                        routes[prefix] = route
+                        inter_routes[prefix] = route
+                        inter_changed = True
+            # Destinations now newly unreachable fall through to the
+            # external stage for alternate paths (route.rs:234-237).
+            external |= {p for p in removed if p not in routes}
+            inter_changed = inter_changed or bool(removed)
+
+        if inter_router or external:
+            # A type-4 change alters ASBR reachability, which can affect
+            # ANY external route — re-evaluate them all (route.rs:302-306);
+            # otherwise only the changed prefixes.
+            reevaluate_all = bool(inter_router)
+            ext_types = ("external-1", "external-2", "nssa-1", "nssa-2")
+            for prefix in list(routes):
+                r = routes[prefix]
+                if r.rtype in ext_types and (
+                    reevaluate_all or prefix in external
+                ):
+                    del routes[prefix]
+            known = set(routes.keys())
+            new_ext = self._external_routes(
+                area_results,
+                known,
+                only=None if reevaluate_all else external,
+            )
+            routes.update(new_ext)
+            # Type-7 changes can shift the NSSA translator's output set.
+            if external and any(a.nssa for a in self.areas.values()):
+                self._nssa_translate(area_results)
+
+        # ABR summary re-origination: inter routes feed non-backbone
+        # summaries, so a changed inter table re-runs origination over
+        # the cached intra inputs.
+        if inter_changed and self.is_abr:
+            self._originate_summaries(area_intra, inter_routes)
+
+        log_type = "inter" if inter_network else "external"
+        self.spf_log.append(
+            {
+                "run": self.spf_run_count,
+                "type": log_type,
+                "backend": self.backend.name,
+                "scheduled-at": scheduled_at,
+                "start-time": start_time,
+                "end-time": self.loop.clock.now(),
+                "trigger-count": triggers,
+                "route-count": len(routes),
+            }
+        )
+        del self.spf_log[:-32]
+
+        cache["routes"] = routes
+        cache["inter_routes"] = inter_routes
+        self._finish_spf(routes)
 
     def reoriginate_summaries(self) -> None:
         """Config-triggered summary refresh (ranges / totally-stubby /

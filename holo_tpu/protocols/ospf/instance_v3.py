@@ -190,6 +190,15 @@ class OspfV3Instance(Actor):
         # v6 prefixes we redistribute as AS-external LSAs (ASBR duty).
         self.redistributed: dict[IPv6Network, int] = {}  # prefix -> metric
         self.spf_run_count = 0
+        # Full-vs-partial classification (reference ospfv3/spf.rs:97-163):
+        # changed LSAs accumulate as (new, old) pairs; non-LSA events
+        # force Full.  The cache keeps the last full run's SPTs + route
+        # tables for prefix-scoped partial updates (route.rs:200-333).
+        self._spf_triggers: list = []
+        self._spf_force_full = True
+        self._spf_cache: dict | None = None
+        # SPF run log ring (reference spf.rs:770-804).
+        self.spf_log: list[dict] = []
         self._dd_seq = 0x3000
         self._next_iface_id = 1
         self._spf_pending = False
@@ -810,11 +819,18 @@ class OspfV3Instance(Actor):
             # never the area database.
             if from_iface is None:
                 return
+            old = from_iface.link_lsdb.get(lsa.key)
             _, changed = from_iface.link_lsdb.install(lsa, now)
         else:
+            old = area.lsdb.get(lsa.key)
             _, changed = area.lsdb.install(lsa, now)
         if changed:
-            self._schedule_spf()
+            # Old body rides along: partial classification merges the
+            # prefixes of both versions of an Intra-Area-Prefix LSA so
+            # withdrawn prefixes drop their routes (ospfv3/spf.rs:120-131).
+            self._schedule_spf(
+                trigger=(lsa, old.lsa if old is not None else None)
+            )
         as_scope = P.scope_of(int(lsa.type)) == "as"
         for iface in self.interfaces.values():
             if not iface.up:
@@ -1210,7 +1226,14 @@ class OspfV3Instance(Actor):
 
     # -- SPF
 
-    def _schedule_spf(self) -> None:
+    def _schedule_spf(self, trigger=None) -> None:
+        """``trigger`` is a ``(new_lsa, old_lsa | None)`` pair for LSDB
+        installs; trigger-less calls (interface/config events) force the
+        next run Full (reference spf.rs:511-516)."""
+        if trigger is None:
+            self._spf_force_full = True
+        else:
+            self._spf_triggers.append(trigger)
         if not self._spf_pending:
             self._spf_pending = True
             self._spf_timer.start(0.1)
@@ -1322,8 +1345,63 @@ class OspfV3Instance(Actor):
             )
         return {rid: nhs for rid, (_d, nhs) in best.items()}
 
+    def _classify_spf(self, triggers: list) -> dict | None:
+        """Full-vs-partial classification (reference ospfv3/spf.rs:97-163).
+        Returns None when a full SPF is required.
+
+        Router/Network-LSAs are topological; Link-LSAs and Router
+        Information changes also force Full (next-hop resolution and SR
+        state depend on them — the reference makes the same
+        simplification).  Intra-Area-Prefix changes merge prefixes from
+        BOTH the old and new versions so withdrawn prefixes drop."""
+        intra: set = set()
+        inter_network: set = set()
+        inter_router: set = set()
+        external: set = set()
+        for new, old in triggers:
+            t = new.type
+            if t in (
+                P.LsaType.ROUTER,
+                P.LsaType.NETWORK,
+                P.LsaType.LINK,
+                P.LsaType.ROUTER_INFORMATION,
+            ):
+                return None
+            if t == P.LsaType.INTRA_AREA_PREFIX:
+                for lsa in (new, old):
+                    if lsa is not None:
+                        for entry in lsa.body.prefixes:
+                            intra.add(entry[0])
+            elif t == P.LsaType.INTER_AREA_PREFIX:
+                for lsa in (new, old):
+                    if lsa is not None:
+                        inter_network.add(lsa.body.prefix)
+            elif t == P.LsaType.INTER_AREA_ROUTER:
+                inter_router.add(new.body.dest_router_id)
+            elif t == P.LsaType.AS_EXTERNAL:
+                for lsa in (new, old):
+                    if lsa is not None:
+                        external.add(lsa.body.prefix)
+            else:
+                return None  # unknown type: be safe, run full
+        return {
+            "intra": intra,
+            "inter_network": inter_network,
+            "inter_router": inter_router,
+            "external": external,
+        }
+
     def run_spf(self) -> None:
+        triggers = self._spf_triggers
+        self._spf_triggers = []
+        force_full = self._spf_force_full
+        self._spf_force_full = False
+        partial = None if force_full else self._classify_spf(triggers)
+        if partial is not None and self._spf_cache is not None:
+            self._run_spf_partial(partial)
+            return
         self.spf_run_count += 1
+        start_time = self.loop.clock.now()
         area_results = {}
         # Backbone last: its SPF borrows transit-area next hops for
         # virtual links (§16.1), like the v2 instance.
@@ -1384,9 +1462,59 @@ class OspfV3Instance(Actor):
 
         # 2. inter-area routes from received Inter-Area-Prefix LSAs:
         #    distance = dist(advertising ABR in that area) + metric.
+        #    The candidate table covers EVERY advertised prefix (intra
+        #    preference applies only at install time) so a later partial
+        #    run can fall back to it when an intra path withdraws.
         inter_routes: dict[IPv6Network, V6Route] = {}
-        for aid, (index, keys, res, atoms, _pl) in area_results.items():
-            area = self.areas[aid]
+        self._derive_inter_area(area_results, inter_routes)
+        for prefix, route in inter_routes.items():
+            if prefix not in routes:
+                routes[prefix] = route
+
+        # 3. AS-external routes (lowest preference): RFC 5340 type 0x4005.
+        #    E2 ranks on the external metric, E1 on asbr-dist + metric.
+        routes.update(self._derive_external(area_results, routes))
+
+        # 4. ABR duties: inter-area-prefix origination (each area's intra
+        #    prefixes into every other area; default into stub areas).
+        if self.is_abr:
+            self._originate_inter_area(
+                intra_by_area, inter_routes, area_results
+            )
+
+        self.spf_log.append(
+            {
+                "run": self.spf_run_count,
+                "type": "full",
+                "start-time": start_time,
+                "end-time": self.loop.clock.now(),
+                "route-count": len(routes),
+            }
+        )
+        del self.spf_log[:-32]
+        # Cache the run's products for prefix-scoped partial updates
+        # (reference route.rs:200-333 update_rib_partial).
+        self._spf_cache = {
+            "area_results": area_results,
+            "intra_by_area": intra_by_area,
+            "routes": routes,
+            "inter_routes": inter_routes,
+        }
+        self.routes = routes
+        if self.route_cb is not None:
+            self.route_cb(routes)
+
+    def _derive_inter_area(
+        self, area_results: dict, inter_routes: dict, only: set | None = None
+    ) -> None:
+        """Accumulate inter-area candidates into ``inter_routes`` from
+        received Inter-Area-Prefix LSAs (RFC 2328 §16.2 hierarchy rules).
+        Shared by the full run and the prefix-scoped partial run
+        (``only`` restricts to the changed prefixes)."""
+        for aid, (index, _k, res, atoms, _pl) in area_results.items():
+            area = self.areas.get(aid)
+            if area is None:
+                continue
             if self.is_abr and aid != IPv4Address(0):
                 # §16.2 hierarchy: an ABR examines summaries from the
                 # backbone only (non-ABRs use their single attached area).
@@ -1399,16 +1527,14 @@ class OspfV3Instance(Actor):
                     or lsa.is_maxage
                 ):
                     continue
+                prefix = lsa.body.prefix
+                if only is not None and prefix not in only:
+                    continue  # partial run: out-of-scope prefix
                 abr_v = index.get(("R", lsa.adv_rtr))
                 if abr_v is None or res.dist[abr_v] >= INF:
                     continue
-                prefix = lsa.body.prefix
-                if prefix in routes and prefix not in inter_routes:
-                    continue  # intra-area wins
                 dist = int(res.dist[abr_v]) + lsa.body.metric
-                nhs = self._expand_atoms(
-                    res.nexthop_words[abr_v], atoms
-                )
+                nhs = self._expand_atoms(res.nexthop_words[abr_v], atoms)
                 cur = inter_routes.get(prefix)
                 if cur is None or dist < cur.dist:
                     inter_routes[prefix] = V6Route(
@@ -1423,17 +1549,18 @@ class OspfV3Instance(Actor):
                         prefix_options=cur.prefix_options,
                         area_id=cur.area_id,
                     )
-        for prefix, route in inter_routes.items():
-            if prefix not in routes:
-                routes[prefix] = route
 
-        # 3. AS-external routes (lowest preference): RFC 5340 type 0x4005.
-        #    E2 ranks on the external metric, E1 on asbr-dist + metric.
-        ext_best: dict[IPv6Network, tuple] = {}
+    def _derive_external(
+        self, area_results: dict, routes: dict, only: set | None = None
+    ) -> dict:
+        """AS-external route derivation (E1/E2 ranking, ASBR resolution
+        through Inter-Area-Router LSAs).  Returns winners for prefixes
+        with no internal path; shared by the full and partial runs."""
+        ext_best: dict = {}
         seen_ext = set()
-        for aid, (index, keys, res, atoms, _pl) in area_results.items():
-            area = self.areas[aid]
-            if area.no_external:
+        for aid, (index, _k, res, atoms, _pl) in area_results.items():
+            area = self.areas.get(aid)
+            if area is None or area.no_external:
                 continue
             for e in area.lsdb.all():
                 lsa = e.lsa
@@ -1441,6 +1568,9 @@ class OspfV3Instance(Actor):
                     continue
                 if lsa.adv_rtr == self.router_id:
                     continue
+                prefix = lsa.body.prefix
+                if only is not None and prefix not in only:
+                    continue  # partial run: out-of-scope prefix
                 if (lsa.key, aid) in seen_ext:
                     continue
                 seen_ext.add((lsa.key, aid))
@@ -1460,7 +1590,6 @@ class OspfV3Instance(Actor):
                     if resolved is None:
                         continue
                     asbr_dist, nhs = resolved
-                prefix = lsa.body.prefix
                 if prefix in routes:
                     continue  # intra/inter win
                 if lsa.body.e_bit:
@@ -1481,16 +1610,165 @@ class OspfV3Instance(Actor):
                         V6Route(prefix, dist, cur[1].nexthops | nhs,
                                 route_type="external"),
                     )
-        for prefix, (_rank, route) in ext_best.items():
-            routes[prefix] = route
+        return {p: r for p, (_rank, r) in ext_best.items()}
 
-        # 4. ABR duties: inter-area-prefix origination (each area's intra
-        #    prefixes into every other area; default into stub areas).
-        if self.is_abr:
+    def _run_spf_partial(self, partial: dict) -> None:
+        """Prefix-scoped route recomputation over the cached per-area
+        SPTs — no Dijkstra runs (reference route.rs:200-333).  Prefix
+        LSAs are re-read from the live LSDB; reachability and next hops
+        come from the cached SPT results."""
+        self.spf_run_count += 1
+        start_time = now = self.loop.clock.now()
+        cache = self._spf_cache
+        area_results = cache["area_results"]
+        intra_by_area = cache["intra_by_area"]
+        routes = dict(cache["routes"])
+        inter_routes = dict(cache["inter_routes"])
+        intra_set = set(partial["intra"])
+        inter_network = set(partial["inter_network"])
+        inter_router = set(partial["inter_router"])
+        external = set(partial["external"])
+        origination_dirty = False
+
+        if intra_set:
+            # Drop affected intra routes, then re-derive them for exactly
+            # those prefixes (route.rs:214-237).
+            for prefix in intra_set:
+                r = routes.get(prefix)
+                if r is not None and r.route_type == "intra-area":
+                    del routes[prefix]
+            for intra in intra_by_area.values():
+                for prefix in intra_set:
+                    intra.pop(prefix, None)
+            for aid, (index, _k, res, atoms, _pl) in area_results.items():
+                area = self.areas.get(aid)
+                if area is None:
+                    continue
+                intra = intra_by_area.setdefault(aid, {})
+                for e in area.lsdb.all():
+                    lsa = e.lsa
+                    if (
+                        lsa.type != P.LsaType.INTRA_AREA_PREFIX
+                        # current_age, not the stored header: a wall-clock
+                        # expired LSA must not resurrect a route the full
+                        # run (_area_spf) would exclude.
+                        or e.current_age(now) >= P.MAX_AGE
+                    ):
+                        continue
+                    body = lsa.body
+                    if body.ref_type == int(P.LsaType.ROUTER):
+                        v = index.get(("R", body.ref_adv_rtr))
+                    elif body.ref_type == int(P.LsaType.NETWORK):
+                        v = index.get(
+                            ("N", body.ref_adv_rtr, int(body.ref_lsid))
+                        )
+                    else:
+                        continue
+                    if v is None or res.dist[v] >= INF:
+                        continue
+                    nhs = self._expand_atoms(res.nexthop_words[v], atoms)
+                    for entry in body.prefixes:
+                        prefix, metric = entry[0], entry[1]
+                        if prefix not in intra_set:
+                            continue  # scoped
+                        opts = body.entry_opts(entry)
+                        total = int(res.dist[v]) + metric
+                        cur = intra.get(prefix)
+                        if cur is None or total < cur.dist:
+                            intra[prefix] = V6Route(
+                                prefix, total, nhs, prefix_options=opts,
+                                area_id=aid,
+                            )
+                        elif total == cur.dist:
+                            intra[prefix] = V6Route(
+                                prefix, total, cur.nexthops | nhs,
+                                prefix_options=cur.prefix_options,
+                                area_id=aid,
+                            )
+            # Merge the recomputed intra winners across areas (same
+            # preference as the full run: lowest dist, ECMP union).
+            for intra in intra_by_area.values():
+                for prefix in intra_set:
+                    route = intra.get(prefix)
+                    if route is None:
+                        continue
+                    cur = routes.get(prefix)
+                    if cur is not None and cur.route_type != "intra-area":
+                        cur = None  # intra beats inter/external
+                    if cur is None or route.dist < cur.dist:
+                        routes[prefix] = route
+                    elif route.dist == cur.dist:
+                        routes[prefix] = V6Route(
+                            prefix, route.dist,
+                            cur.nexthops | route.nexthops,
+                            route_type=cur.route_type,
+                        )
+            # Prefixes now without an intra path fall back to a cached
+            # inter-area candidate, else to the external stage.
+            for prefix in intra_set:
+                if prefix not in routes and prefix in inter_routes:
+                    routes[prefix] = inter_routes[prefix]
+            external |= {p for p in intra_set if p not in routes}
+            origination_dirty = True
+
+        if inter_network:
+            for prefix in inter_network:
+                inter_routes.pop(prefix, None)
+                r = routes.get(prefix)
+                if r is not None and r.route_type == "inter-area":
+                    del routes[prefix]
+            self._derive_inter_area(
+                area_results, inter_routes, only=inter_network
+            )
+            for prefix in inter_network:
+                cand = inter_routes.get(prefix)
+                if cand is None:
+                    continue
+                cur = routes.get(prefix)
+                if cur is None or cur.route_type != "intra-area":
+                    routes[prefix] = cand
+            external |= {p for p in inter_network if p not in routes}
+            origination_dirty = True
+
+        if inter_router or external:
+            # An Inter-Area-Router change alters ASBR reachability, which
+            # can affect ANY external route (route.rs:302-306).
+            reevaluate_all = bool(inter_router)
+            for prefix in list(routes):
+                if routes[prefix].route_type == "external" and (
+                    reevaluate_all or prefix in external
+                ):
+                    del routes[prefix]
+            routes.update(
+                self._derive_external(
+                    area_results,
+                    routes,
+                    only=None if reevaluate_all else external,
+                )
+            )
+
+        if origination_dirty and self.is_abr:
             self._originate_inter_area(
                 intra_by_area, inter_routes, area_results
             )
 
+        log_type = (
+            "intra" if intra_set
+            else "inter" if inter_network
+            else "external"
+        )
+        self.spf_log.append(
+            {
+                "run": self.spf_run_count,
+                "type": log_type,
+                "start-time": start_time,
+                "end-time": self.loop.clock.now(),
+                "route-count": len(routes),
+            }
+        )
+        del self.spf_log[:-32]
+        cache["routes"] = routes
+        cache["inter_routes"] = inter_routes
         self.routes = routes
         if self.route_cb is not None:
             self.route_cb(routes)

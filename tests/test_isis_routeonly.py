@@ -1,0 +1,81 @@
+"""IS-IS Full-vs-RouteOnly SPF split (reference holo-isis/src/spf.rs:
+150-156, lsdb.rs:1558-1612): a prefix-only LSP change recomputes routes
+over the cached SPT without a Dijkstra dispatch; IS-reach changes keep
+forcing Full."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.isis.instance import IsisIfConfig, IsisIfUpMsg
+
+from tests.test_isis import link, mk_net
+
+
+class _CountingBackend:
+    def __init__(self, inner):
+        self.inner = inner
+        self.name = inner.name
+        self.computes = 0
+
+    def compute(self, topo):
+        self.computes += 1
+        return self.inner.compute(topo)
+
+
+def _converged_pair():
+    loop, fabric, (r1, r2) = mk_net(2)
+    link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2",
+         "10.0.12.0/30", 10)
+    for r in (r1, r2):
+        for ifname in list(r.interfaces):
+            loop.send(r.name, IsisIfUpMsg(ifname))
+    loop.advance(30)
+    return loop, r1, r2
+
+
+def test_prefix_only_change_is_route_only():
+    loop, r1, r2 = _converged_pair()
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    # A passive circuit adds an ext_ip_reach prefix to r2's LSP without
+    # touching its IS-reachability.
+    r2.add_interface(
+        "lo1", IsisIfConfig(metric=1, passive=True),
+        A("192.0.2.1"), N("192.0.2.0/24"),
+    )
+    loop.send(r2.name, IsisIfUpMsg("lo1"))
+    loop.advance(30)
+    assert counter.computes == 0, (
+        "prefix-only LSP change must not re-run Dijkstra"
+    )
+    assert r1.spf_log[-1]["type"] == "route-only"
+    route = r1.routes.get(N("192.0.2.0/24"))
+    assert route is not None and route[0] == 10 + 1
+
+
+def test_adjacency_change_is_full():
+    loop, r1, r2 = _converged_pair()
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    # Metric change rewrites r2's ext_is_reach: topology changed.
+    r2.interfaces["e0"].config.metric = 33
+    r2._originate_lsp(force=True)
+    loop.advance(30)
+    assert counter.computes > 0
+    assert r1.spf_log[-1]["type"] == "full"
+
+
+def test_route_only_and_full_agree():
+    loop, r1, r2 = _converged_pair()
+    for i in range(3):
+        r2.add_interface(
+            f"lo{i}", IsisIfConfig(metric=2 + i, passive=True),
+            A(f"198.51.{i}.1"), N(f"198.51.{i}.0/24"),
+        )
+        loop.send(r2.name, IsisIfUpMsg(f"lo{i}"))
+    loop.advance(30)
+    partial = dict(r1.routes)
+    r1._schedule_spf()  # force a full run
+    loop.advance(30)
+    assert r1.spf_log[-1]["type"] == "full"
+    assert r1.routes == partial

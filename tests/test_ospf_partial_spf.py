@@ -1,0 +1,141 @@
+"""Partial SPF: summary/external-only changes must not re-run Dijkstra
+(reference holo-ospf/src/spf.rs:49-60,513-516 Full-vs-Partial trigger
+classification; route.rs:200-333 update_rib_partial)."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+from tests.test_ospf_convergence import bring_up, mk_router, p2p_link
+
+
+class _CountingBackend:
+    """Wraps the instance's real backend; counts Dijkstra dispatches."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.name = inner.name
+        self.computes = 0
+
+    def compute(self, topo):
+        self.computes += 1
+        return self.inner.compute(topo)
+
+
+def _mk_pair():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "r1", "1.1.1.1")
+    r2 = mk_router(loop, fabric, "r2", "2.2.2.2")
+    p2p_link(fabric, "l12", r1, "e0", "10.0.0.1", r2, "e0", "10.0.0.2",
+             "10.0.0.0/30")
+    bring_up(loop, [r1, r2])
+    return loop, r1, r2
+
+
+def test_external_only_change_skips_dijkstra():
+    """A type-5-only change runs the partial path: zero backend.compute
+    calls, the route still lands, and the SPF log records 'external'."""
+    loop, r1, r2 = _mk_pair()
+    # Prime ASBR status: the FIRST redistribution re-originates r2's
+    # router-LSA (E flag), which is legitimately a full-SPF topology
+    # change.  Subsequent type-5s are external-only.
+    r2.redistribute(N("192.0.2.0/24"), metric=10)
+    loop.advance(30)
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    r2.redistribute(N("203.0.113.0/24"), metric=20)
+    loop.advance(30)
+    assert counter.computes == 0, (
+        "type-5-only change must not re-run Dijkstra"
+    )
+    assert N("203.0.113.0/24") in r1.routes
+    assert r1.routes[N("203.0.113.0/24")].rtype == "external-2"
+    assert r1.spf_log[-1]["type"] == "external"
+
+    # Withdrawal is equally partial and removes the route.
+    r2.withdraw_redistributed(N("203.0.113.0/24"))
+    loop.advance(30)
+    assert counter.computes == 0
+    assert N("203.0.113.0/24") not in r1.routes
+
+
+def test_router_lsa_change_still_runs_full():
+    """Topology changes (Router-LSA) keep forcing a full run."""
+    loop, r1, r2 = _mk_pair()
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    # A cost change re-originates r2's Router-LSA.
+    area = next(iter(r2.areas.values()))
+    area.interfaces["e0"].config.cost = 55
+    r2._originate_router_lsa(area)
+    loop.advance(30)
+    assert counter.computes > 0, "router-LSA change must run full SPF"
+    assert r1.spf_log[-1]["type"] == "full"
+    assert N("10.0.0.0/30") in r1.routes
+
+
+def test_partial_and_full_agree_on_external_routes():
+    """Route table after a partial external update is identical to what a
+    forced full recomputation produces (the acceptance gate)."""
+    loop, r1, r2 = _mk_pair()
+    for i in range(4):
+        r2.redistribute(N(f"198.51.{i}.0/24"), metric=10 + i)
+    loop.advance(30)
+    partial_routes = {
+        p: (r.dist, r.nexthops, r.rtype) for p, r in r1.routes.items()
+    }
+    # Force a full run and compare.
+    r1._schedule_spf()
+    loop.advance(30)
+    assert r1.spf_log[-1]["type"] == "full"
+    full_routes = {
+        p: (r.dist, r.nexthops, r.rtype) for p, r in r1.routes.items()
+    }
+    assert partial_routes == full_routes
+
+
+def test_summary_only_change_is_partial_inter():
+    """A summary (type-3) metric change at a non-ABR reruns only the
+    inter-area stage from the cached SPT — no Dijkstra — and the route
+    distance updates (route.rs:239-267)."""
+    from holo_tpu.protocols.ospf.instance import IfConfig
+    from holo_tpu.protocols.ospf.interface import IfType
+
+    AREA0, AREA1 = A("0.0.0.0"), A("0.0.0.1")
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_router(loop, fabric, "p1", "1.1.1.1")   # area 0 only
+    abr = mk_router(loop, fabric, "pa", "2.2.2.2")  # ABR
+    r3 = mk_router(loop, fabric, "p3", "3.3.3.3")   # area 1 only
+    c0 = IfConfig(area_id=AREA0, if_type=IfType.POINT_TO_POINT, cost=10)
+    c1 = IfConfig(area_id=AREA1, if_type=IfType.POINT_TO_POINT, cost=10)
+    r1.add_interface("e0", c0, N("10.0.0.0/30"), A("10.0.0.1"))
+    abr.add_interface("e0", c0, N("10.0.0.0/30"), A("10.0.0.2"))
+    abr.add_interface("e1", c1, N("10.0.1.0/30"), A("10.0.1.1"))
+    r3.add_interface("e1", c1, N("10.0.1.0/30"), A("10.0.1.2"))
+    fabric.join("l0", "p1", "e0", A("10.0.0.1"))
+    fabric.join("l0", "pa", "e0", A("10.0.0.2"))
+    fabric.join("l1", "pa", "e1", A("10.0.1.1"))
+    fabric.join("l1", "p3", "e1", A("10.0.1.2"))
+    bring_up(loop, [r1, abr, r3])
+    assert N("10.0.1.0/30") in r1.routes
+    before = r1.routes[N("10.0.1.0/30")].dist
+
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    # Raise area-1 link cost: r3/abr re-run full locally, but r1 only
+    # sees a changed type-3 summary from the ABR.
+    for inst in (abr, r3):
+        area = inst.areas[AREA1]
+        area.interfaces["e1"].config.cost = 40
+        inst._originate_router_lsa(area)
+    loop.advance(30)
+    assert counter.computes == 0, (
+        "summary-only change at a non-ABR must not re-run Dijkstra"
+    )
+    assert r1.spf_log[-1]["type"] == "inter"
+    after = r1.routes[N("10.0.1.0/30")].dist
+    assert after == before + 30, (before, after)

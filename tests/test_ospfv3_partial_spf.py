@@ -1,0 +1,154 @@
+"""OSPFv3 partial SPF (reference ospfv3/spf.rs:97-163 classification,
+route.rs:200-333 update_rib_partial): prefix-only changes skip Dijkstra."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv6Address as A6
+from ipaddress import IPv6Network as N6
+
+from holo_tpu.protocols.ospf.instance_v3 import V3IfUpMsg
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+from tests.test_ospfv3 import mk_v3, v6link
+
+
+class _CountingBackend:
+    def __init__(self, inner):
+        self.inner = inner
+        self.name = inner.name
+        self.computes = 0
+
+    def compute(self, topo):
+        self.computes += 1
+        return self.inner.compute(topo)
+
+
+def _pair():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_v3(loop, fabric, "w1", "1.1.1.1")
+    r2 = mk_v3(loop, fabric, "w2", "2.2.2.2")
+    v6link(fabric, "l12", r1, "e0", "fe80::1:1", r2, "e0", "fe80::2:1")
+    for r in (r1, r2):
+        for ifname in r.interfaces:
+            loop.send(r.name, V3IfUpMsg(ifname))
+    loop.advance(60)
+    return loop, r1, r2
+
+
+def _chain():
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    r1 = mk_v3(loop, fabric, "w1", "1.1.1.1")
+    r2 = mk_v3(loop, fabric, "w2", "2.2.2.2")
+    r3 = mk_v3(loop, fabric, "w3", "3.3.3.3")
+    v6link(fabric, "l12", r1, "e0", "fe80::1:1", r2, "e0", "fe80::2:1")
+    v6link(fabric, "l23", r2, "e1", "fe80::2:2", r3, "e0", "fe80::3:1")
+    for r in (r1, r2, r3):
+        for ifname in r.interfaces:
+            loop.send(r.name, V3IfUpMsg(ifname))
+    loop.advance(60)
+    return loop, r1, r2, r3
+
+
+def test_intra_prefix_change_is_partial():
+    """A REMOTE router's prefix change reaches us as an
+    Intra-Area-Prefix change only (its Link-LSA is link-scope and never
+    leaves its own link): partial run, no Dijkstra.  A later withdrawal
+    drops the route (old+new prefix-set merge).  On an attached link the
+    neighbor's Link-LSA changes too, correctly forcing Full — same as
+    the reference (ospfv3/spf.rs:106-113)."""
+    loop, r1, r2, r3 = _chain()
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    r3.interfaces["e0"].prefixes.append(N6("2001:db8:33::/64"))
+    r3._originate_intra_area_prefix()
+    loop.advance(30)
+    assert counter.computes == 0, (
+        "remote intra-area-prefix-only change must not re-run Dijkstra"
+    )
+    assert r1.spf_log[-1]["type"] == "intra"
+    assert N6("2001:db8:33::/64") in r1.routes
+
+    # Withdrawal: the prefix disappears from the new LSA but lives in the
+    # OLD one — the merged old+new set must still cover it.
+    r3.interfaces["e0"].prefixes.remove(N6("2001:db8:33::/64"))
+    r3._originate_intra_area_prefix()
+    loop.advance(30)
+    assert counter.computes == 0
+    assert N6("2001:db8:33::/64") not in r1.routes
+
+
+def test_v3_external_change_is_partial():
+    loop, r1, r2 = _pair()
+    # Prime ASBR status (first redistribution re-originates the
+    # router-LSA with the E bit — a legitimate full run).
+    r2.redistribute(N6("2001:db8:aa::/48"), metric=5)
+    loop.advance(30)
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    r2.redistribute(N6("2001:db8:bb::/48"), metric=7)
+    loop.advance(30)
+    assert counter.computes == 0
+    assert r1.spf_log[-1]["type"] == "external"
+    assert N6("2001:db8:bb::/48") in r1.routes
+
+
+def test_v3_partial_matches_full():
+    loop, r1, r2 = _pair()
+    r2.redistribute(N6("2001:db8:aa::/48"), metric=5)
+    r2.interfaces["e0"].prefixes.append(N6("2001:db8:22::/64"))
+    r2._originate_intra_area_prefix()
+    loop.advance(30)
+    partial = {
+        p: (r.dist, r.nexthops, r.route_type) for p, r in r1.routes.items()
+    }
+    r1._schedule_spf()  # force full
+    loop.advance(30)
+    assert r1.spf_log[-1]["type"] == "full"
+    full = {
+        p: (r.dist, r.nexthops, r.route_type) for p, r in r1.routes.items()
+    }
+    assert partial == full
+
+
+def test_intra_withdrawal_falls_back_to_inter_candidate():
+    """A withdrawn intra prefix with a still-valid inter-area path must
+    fall back to it in the partial run (r5 review: the candidate table
+    covers intra-won prefixes too)."""
+    from holo_tpu.protocols.ospf import packet_v3 as P
+    from ipaddress import IPv4Address
+
+    loop, r1, r2, r3 = _chain()
+    shared = N6("2001:db8:77::/64")
+    # r3 advertises `shared` intra-area; an inter-area-prefix LSA for the
+    # same prefix also exists (injected as if from another area's ABR —
+    # r2 originates it here for simplicity via direct install on r1's
+    # area through the flooding path).
+    r3.interfaces["e0"].prefixes.append(shared)
+    r3._originate_intra_area_prefix()
+    loop.advance(30)
+    assert r1.routes[shared].route_type == "intra-area"
+
+    # Inject an inter-area candidate from r2 (an ABR-shaped source).
+    area2 = next(iter(r2.areas.values()))
+    lsa = P.Lsa(
+        age=0, type=P.LsaType.INTER_AREA_PREFIX,
+        lsid=IPv4Address("0.0.9.9"), adv_rtr=r2.router_id, seq_no=-99,
+        body=P.LsaInterAreaPrefix(metric=44, prefix=shared),
+    )
+    lsa.encode()
+    r2._install_and_flood(area2, lsa)
+    loop.advance(30)
+    assert r1.routes[shared].route_type == "intra-area"  # intra wins
+
+    counter = _CountingBackend(r1.backend)
+    r1.backend = counter
+    # Withdraw the intra prefix: partial run must fall back to inter.
+    r3.interfaces["e0"].prefixes.remove(shared)
+    r3._originate_intra_area_prefix()
+    loop.advance(30)
+    assert counter.computes == 0
+    got = r1.routes.get(shared)
+    assert got is not None and got.route_type == "inter-area", got
+    assert got.dist == 10 + 44
