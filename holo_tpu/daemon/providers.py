@@ -243,6 +243,20 @@ class KeychainProvider(Provider, Actor):
     def handle(self, msg):
         pass
 
+    def validate(self, new_tree) -> None:
+        # FAIL-CLOSED on lifetimes: a malformed date-and-time must
+        # reject the commit, never silently become an unbounded key.
+        from holo_tpu.northbound.provider import CommitError
+        from holo_tpu.utils.keychain import Keychain
+
+        for name, chain in (
+            new_tree.get("key-chains/key-chain", {}) or {}
+        ).items():
+            try:
+                Keychain.from_config(name, chain)
+            except ValueError as e:
+                raise CommitError(f"key-chain {name!r}: {e}") from e
+
     def commit(self, phase, old, new, changes):
         from holo_tpu.utils.ibus import TOPIC_KEYCHAIN_DEL
 
@@ -705,23 +719,21 @@ class RoutingProvider(Provider, Actor):
             return None
         kc_name = auth_conf.get("key-chain")
         if kc_name:
+            from holo_tpu.utils.keychain import Keychain
+
             kc = (
                 self.keychains.keychains.get(kc_name)
                 if self.keychains is not None
                 else None
             )
             if kc and kc.get("key"):
-                # Lowest key-id wins (numeric order; lifetime-based
-                # selection lands with keychain lifetimes).
-                key_id_s, key = sorted(
-                    kc["key"].items(), key=lambda kv: int(kv[0])
-                )[0]
-                algo = key.get("crypto-algorithm", "md5")
+                # Lifetime-based selection (keychain.rs:42-92): the
+                # active SEND key signs, received key ids validate
+                # against their ACCEPT lifetimes — rollover works.
                 return AuthCtx(
                     AuthType.CRYPTOGRAPHIC,
-                    (key.get("key-string") or "").encode(),
-                    key_id=key.get("key-id", int(key_id_s)) & 0xFF,
-                    algo=algo,
+                    keychain=Keychain.from_config(kc_name, kc),
+                    clock=lambda: self.loop.clock.now(),
                 )
             return AuthCtx(AuthType.CRYPTOGRAPHIC, _os.urandom(16), key_id=0)
         atype = auth_conf.get("type", "none")

@@ -697,6 +697,58 @@ class AuthCtxIsis:
     key: bytes
     algo: str = "hmac-md5"
     key_id: int = 1
+    # Lifetime-based key selection (reference holo-isis/src/packet/
+    # auth.rs AuthMethod::Keychain over holo-utils keychain.rs:42-92):
+    # send uses the active send key; RFC 5310 verification looks the
+    # received key id up against accept lifetimes; RFC 5304 (no key id
+    # on the wire) uses the first active accept key.
+    keychain: object = None
+    clock: object = None
+
+    def _now(self) -> float:
+        if callable(self.clock):
+            return self.clock()
+        import time as _time
+
+        return _time.time()
+
+    def for_send(self) -> "AuthCtxIsis | None":
+        """Resolved fixed-key context for ONE outgoing PDU (key id,
+        algo, and digest must all come from the same key).  None when
+        the keychain has no active send key: the PDU goes out without
+        an auth TLV, like the reference's get_key_send → None."""
+        if self.keychain is None:
+            return self
+        k = self.keychain.key_lookup_send(self._now())
+        if k is None:
+            return None
+        return AuthCtxIsis(key=k.string, algo=k.algo, key_id=k.id & 0xFFFF)
+
+    def for_accept(self, key_id: "int | None") -> "list[AuthCtxIsis]":
+        """Resolved candidate contexts for verifying a received PDU.
+
+        RFC 5310 TLVs carry the key id → at most one candidate.  RFC
+        5304 (HMAC-MD5) has NO key id on the wire, so during rollover
+        the receiver cannot know which accept-active key signed the PDU
+        — EVERY accept-active md5 key is a candidate and verification
+        tries each until a digest matches (otherwise the overlap window
+        the lifetimes exist for would drop every PDU)."""
+        if self.keychain is None:
+            return [self]
+        now = self._now()
+        if key_id is not None:
+            k = self.keychain.key_lookup_accept(key_id, now)
+            keys = [k] if k is not None else []
+        else:
+            keys = [
+                k
+                for k in self.keychain.keys
+                if k.accept_lifetime.is_active(now) and k.algo == "hmac-md5"
+            ]
+        return [
+            AuthCtxIsis(key=k.string, algo=k.algo, key_id=k.id & 0xFFFF)
+            for k in keys
+        ]
 
     def _hmac(self, data: bytes) -> bytes:
         import hashlib
@@ -749,6 +801,32 @@ def verify_pdu_auth(data: bytes, tlvs: dict, auth: AuthCtxIsis) -> None:
     if span is None or info is None:
         raise AuthTypeError("authentication TLV missing")
     atype, value = info
+    # Accept-side key selection (auth.rs get_key_accept / RFC 5304
+    # accept-any): RFC 5310 TLVs carry the key id; RFC 5304 does not,
+    # so every accept-active md5 key is tried until a digest matches.
+    rx_key_id = (
+        int.from_bytes(value[:2], "big")
+        if atype == AUTH_CRYPTO and len(value) >= 2
+        else None
+    )
+    candidates = auth.for_accept(rx_key_id)
+    if not candidates:
+        raise AuthError("unknown authentication key id")
+    last_err: AuthError | None = None
+    for cand in candidates:
+        try:
+            _verify_pdu_auth_one(data, span, atype, value, cand)
+            return
+        except AuthError as e:  # try the next candidate key
+            last_err = e
+    raise last_err
+
+
+def _verify_pdu_auth_one(
+    data: bytes, span, atype: int, value: bytes, auth: AuthCtxIsis
+) -> None:
+    import hmac as _h
+
     _name, dlen = _ISIS_HMACS[auth.algo]
     if auth.algo == "hmac-md5":
         if atype != AUTH_HMAC_MD5 or len(value) != dlen:
@@ -812,6 +890,9 @@ class HelloP2p:
         len_pos = len(w)
         w.u16(0)
         w.u8(self.local_circuit_id)
+        # Resolve the keychain's active send key ONCE per PDU: key id,
+        # algo, and digest must agree (auth.rs get_key_send).
+        auth = auth.for_send() if auth is not None else None
         digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
@@ -854,6 +935,9 @@ class HelloLan:
         w.u16(0)
         w.u8(self.priority & 0x7F)
         w.bytes(self.lan_id)
+        # Resolve the keychain's active send key ONCE per PDU: key id,
+        # algo, and digest must agree (auth.rs get_key_send).
+        auth = auth.for_send() if auth is not None else None
         digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
@@ -899,6 +983,9 @@ class Lsp:
         cks_pos = len(w)
         w.u16(0)
         w.u8(self.flags)
+        # Resolve the keychain's active send key ONCE per PDU: key id,
+        # algo, and digest must agree (auth.rs get_key_send).
+        auth = auth.for_send() if auth is not None else None
         digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(w, self.tlvs)
         w.patch_u16(len_pos, len(w))
@@ -968,6 +1055,9 @@ class Snp:
         if self.complete:
             w.bytes((self.start or LspId(b"\x00" * 6)).encode())
             w.bytes((self.end or LspId(b"\xff" * 6, 0xFF, 0xFF)).encode())
+        # Resolve the keychain's active send key ONCE per PDU: key id,
+        # algo, and digest must agree (auth.rs get_key_send).
+        auth = auth.for_send() if auth is not None else None
         digest_pos = _append_auth_tlv(w, auth) if auth is not None else None
         _encode_tlvs(
             w,

@@ -2610,52 +2610,13 @@ class OspfInstance(Actor):
                 ):
                     all_routes[prefix] = route
 
-        # Inter-area routes (RFC 2328 §16.2, condensed): consume Summary
-        # LSAs using the distance to the advertising ABR from this area's
-        # SPF; intra-area paths are always preferred for the same prefix.
-        from holo_tpu.protocols.ospf.spf_run import IntraRoute, _atoms_of
-        from holo_tpu.utils.ip import apply_mask
-
+        # Inter-area routes (RFC 2328 §16.2): shared consumption stage
+        # (also used by the partial run with a prefix scope).
         intra_prefixes = set(all_routes.keys())
         inter_routes: dict = {}
-        for area in self.areas.values():
-            sr = area_results.get(area.area_id)
-            if sr is None:
-                continue
-            st, res = sr
-            for e in area.lsdb.all():
-                lsa = e.lsa
-                if (
-                    lsa.type != LsaType.SUMMARY_NETWORK
-                    or lsa.adv_rtr == self.config.router_id
-                    or e.current_age(now) >= MAX_AGE
-                ):
-                    continue
-                if self.is_abr and int(area.area_id) != 0:
-                    # §16.2: ABRs examine backbone summaries only — transit
-                    # through non-backbone areas would break the hierarchy.
-                    continue
-                abr_v = st.router_index.get(lsa.adv_rtr)
-                if abr_v is None or res.dist[abr_v] >= 0x40000000:
-                    continue
-                prefix = apply_mask(lsa.lsid, lsa.body.mask)
-                if prefix in intra_prefixes:
-                    continue  # intra-area preferred
-                dist = int(res.dist[abr_v]) + lsa.body.metric
-                nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
-                cur = all_routes.get(prefix)
-                if cur is None or dist < cur.dist:
-                    route = IntraRoute(prefix, dist, nhs, area.area_id, "inter")
-                    all_routes[prefix] = route
-                    inter_routes[prefix] = route
-                elif dist == cur.dist:
-                    # Equal-cost inter-area paths union their next hops
-                    # (area_id reflects the latest contributing area).
-                    route = IntraRoute(
-                        prefix, dist, cur.nexthops | nhs, area.area_id, "inter"
-                    )
-                    all_routes[prefix] = route
-                    inter_routes[prefix] = route
+        self._derive_inter_area(
+            area_results, all_routes, inter_routes, intra_prefixes
+        )
 
         # ABR: (re-)originate Summary LSAs — each area's intra routes are
         # advertised into every other attached area (loop-free: summaries
@@ -2710,6 +2671,75 @@ class OspfInstance(Actor):
 
         self._finish_spf(all_routes)
 
+    def _derive_inter_area(
+        self,
+        area_results: dict,
+        routes: dict,
+        inter_routes: dict,
+        intra_prefixes: set,
+        only: set | None = None,
+    ) -> bool:
+        """Summary-LSA consumption (RFC 2328 §16.2): distance to the
+        advertising ABR from the cached/current SPT plus the advertised
+        metric; intra-area always preferred, inter-area displaces
+        externals (path-type preference, §11).  Shared by the full and
+        partial runs — ``only`` scopes a partial run to the changed
+        prefixes.  Returns whether anything changed."""
+        from holo_tpu.protocols.ospf.spf_run import IntraRoute, _atoms_of
+        from holo_tpu.utils.ip import apply_mask
+
+        now = self.loop.clock.now()
+        changed = False
+        for area in self.areas.values():
+            sr = area_results.get(area.area_id)
+            if sr is None:
+                continue
+            st, res = sr
+            for e in area.lsdb.all():
+                lsa = e.lsa
+                if (
+                    lsa.type != LsaType.SUMMARY_NETWORK
+                    or lsa.adv_rtr == self.config.router_id
+                    or e.current_age(now) >= MAX_AGE
+                ):
+                    continue
+                if self.is_abr and int(area.area_id) != 0:
+                    # §16.2: ABRs examine backbone summaries only — transit
+                    # through non-backbone areas would break the hierarchy.
+                    continue
+                prefix = apply_mask(lsa.lsid, lsa.body.mask)
+                if only is not None and prefix not in only:
+                    continue  # partial run: out-of-scope prefix
+                if prefix in intra_prefixes:
+                    continue  # intra-area preferred
+                abr_v = st.router_index.get(lsa.adv_rtr)
+                if abr_v is None or res.dist[abr_v] >= 0x40000000:
+                    continue
+                dist = int(res.dist[abr_v]) + lsa.body.metric
+                nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
+                cur = routes.get(prefix)
+                if cur is not None and cur.rtype not in ("intra", "inter"):
+                    # Path-type preference, not distance: inter-area
+                    # always displaces an external entry (§11).  Only
+                    # reachable in partial runs — the full run computes
+                    # externals after this stage.
+                    cur = None
+                if cur is None or dist < cur.dist:
+                    route = IntraRoute(prefix, dist, nhs, area.area_id, "inter")
+                    routes[prefix] = route
+                    inter_routes[prefix] = route
+                    changed = True
+                elif dist == cur.dist and cur.rtype == "inter":
+                    # Equal-cost inter-area paths union their next hops
+                    # (area_id reflects the latest contributing area).
+                    route = IntraRoute(
+                        prefix, dist, cur.nexthops | nhs, area.area_id, "inter"
+                    )
+                    routes[prefix] = route
+                    inter_routes[prefix] = route
+                    changed = True
+        return changed
+
     def _run_spf_partial(
         self, partial: dict, scheduled_at, triggers: int, start_time: float
     ) -> None:
@@ -2729,9 +2759,6 @@ class OspfInstance(Actor):
         inter_router = set(partial["inter_router"])
         external = set(partial["external"])
 
-        from holo_tpu.protocols.ospf.spf_run import IntraRoute, _atoms_of
-        from holo_tpu.utils.ip import apply_mask
-
         inter_changed = False
         if inter_network:
             # Remove affected inter-area routes, then re-derive them for
@@ -2746,51 +2773,10 @@ class OspfInstance(Actor):
             intra_prefixes = {
                 p for p, r in routes.items() if r.rtype == "intra"
             }
-            for area in self.areas.values():
-                sr = area_results.get(area.area_id)
-                if sr is None:
-                    continue
-                st, res = sr
-                for e in area.lsdb.all():
-                    lsa = e.lsa
-                    if (
-                        lsa.type != LsaType.SUMMARY_NETWORK
-                        or lsa.adv_rtr == self.config.router_id
-                        or e.current_age(now) >= MAX_AGE
-                    ):
-                        continue
-                    if self.is_abr and int(area.area_id) != 0:
-                        continue  # §16.2: ABRs examine backbone summaries
-                    prefix = apply_mask(lsa.lsid, lsa.body.mask)
-                    if prefix not in inter_network:
-                        continue  # scoped: untouched prefixes keep routes
-                    abr_v = st.router_index.get(lsa.adv_rtr)
-                    if abr_v is None or res.dist[abr_v] >= 0x40000000:
-                        continue
-                    if prefix in intra_prefixes:
-                        continue  # intra-area preferred
-                    dist = int(res.dist[abr_v]) + lsa.body.metric
-                    nhs = _atoms_of(res.nexthop_words[abr_v], st.atoms)
-                    cur = routes.get(prefix)
-                    if cur is not None and cur.rtype not in ("intra", "inter"):
-                        # Path-type preference, not distance: inter-area
-                        # always displaces an external entry (§11).
-                        cur = None
-                    if cur is None or dist < cur.dist:
-                        route = IntraRoute(
-                            prefix, dist, nhs, area.area_id, "inter"
-                        )
-                        routes[prefix] = route
-                        inter_routes[prefix] = route
-                        inter_changed = True
-                    elif dist == cur.dist and cur.rtype == "inter":
-                        route = IntraRoute(
-                            prefix, dist, cur.nexthops | nhs,
-                            area.area_id, "inter",
-                        )
-                        routes[prefix] = route
-                        inter_routes[prefix] = route
-                        inter_changed = True
+            inter_changed = self._derive_inter_area(
+                area_results, routes, inter_routes, intra_prefixes,
+                only=inter_network,
+            )
             # Destinations now newly unreachable fall through to the
             # external stage for alternate paths (route.rs:234-237).
             external |= {p for p in removed if p not in routes}

@@ -848,16 +848,91 @@ class AuthCtx:
     key_id: int = 1
     seqno: int = 0
     algo: str = "md5"
+    # Lifetime-based key selection (reference holo-utils/src/keychain.rs
+    # :42-92): when set, the active SEND key signs outgoing packets and
+    # received key ids validate against their ACCEPT lifetimes — this is
+    # what makes key rollover work.  ``clock`` supplies epoch seconds
+    # (the owning loop's clock; virtual in tests).
+    keychain: object = None
+    clock: object = None
+
+    def _now(self) -> float:
+        if callable(self.clock):
+            return self.clock()
+        import time as _time
+
+        return _time.time()
+
+    def _send_key(self):
+        if self.keychain is None:
+            return None
+        return self.keychain.key_lookup_send(self._now())
+
+    def accept_params(self, key_id: int) -> "tuple[bytes, str] | None":
+        """(key, algo) accepted for a received packet carrying
+        ``key_id`` — None rejects (keychain.rs key_lookup_accept)."""
+        if self.keychain is None:
+            if key_id != self.key_id:
+                return None
+            return self.key, self.algo
+        k = self.keychain.key_lookup_accept(key_id, self._now())
+        if k is None:
+            return None
+        return k.string, k.algo
+
+    @property
+    def tx_key_id(self) -> int:
+        k = self._send_key()
+        return (k.id & 0xFF) if k is not None else self.key_id
+
+    def resolve_send(self) -> "AuthCtx | None":
+        """Fixed-key context for ONE outgoing packet: key id, digest
+        length, packet digest, and LLS digest must all come from the
+        SAME key, so the keychain is consulted exactly once per encode.
+        None when the keychain has no active send key — the packet goes
+        out unauthenticated, like the reference's get_key_send → None
+        (the peer's type check rejects it, which is the correct signal
+        for a lifetime coverage gap, not a forged-looking digest)."""
+        if self.keychain is None:
+            return self
+        k = self.keychain.key_lookup_send(self._now())
+        if k is None:
+            return None
+        return AuthCtx(
+            self.type, k.string, k.id & 0xFF, self.seqno, k.algo
+        )
+
+    def resolve_accept(self, key_id: int) -> "AuthCtx | None":
+        """Fixed-key context for verifying ONE received packet (same
+        single-consultation rule on the accept side)."""
+        params = self.accept_params(key_id)
+        if params is None:
+            return None
+        key, algo = params
+        return AuthCtx(self.type, key, key_id, self.seqno, algo)
+
+    @staticmethod
+    def make_digest(key: bytes, algo: str, data: bytes) -> bytes:
+        dlen, hname = AUTH_ALGOS[algo]
+        if hname is None:  # RFC 2328 keyed-MD5: md5(packet || padded key)
+            return hashlib.md5(data + key[:16].ljust(16, b"\x00")).digest()
+        return _hmac.new(key, data, hname).digest()
 
     def digest(self, data: bytes) -> bytes:
-        dlen, hname = AUTH_ALGOS[self.algo]
-        if hname is None:  # RFC 2328 keyed-MD5: md5(packet || padded key)
-            return hashlib.md5(data + self.key[:16].ljust(16, b"\x00")).digest()
-        return _hmac.new(self.key, data, hname).digest()
+        """Sign with this context's key.  Keychain contexts are resolved
+        to a fixed key via resolve_send/resolve_accept BEFORE any digest
+        is computed (one consultation per packet); the dynamic fallback
+        here covers direct callers only."""
+        k = self._send_key()
+        key, algo = (k.string, k.algo) if k is not None else (
+            self.key, self.algo
+        )
+        return self.make_digest(key, algo, data)
 
     @property
     def digest_len(self) -> int:
-        return AUTH_ALGOS[self.algo][0]
+        k = self._send_key()
+        return AUTH_ALGOS[k.algo if k is not None else self.algo][0]
 
 
 # LLS Extended Options and Flags bits (RFC 5613 / lls.rs:115-125).
@@ -908,6 +983,8 @@ class LlsBlock:
     def decode(
         cls, data: bytes, auth: "AuthCtx | None" = None
     ) -> "LlsBlock":
+        """``auth`` is already key-resolved by Packet.decode (the LLS
+        digest must verify with the SAME accept key as the packet)."""
         crypto = auth is not None and auth.type == AuthType.CRYPTOGRAPHIC
         if len(data) < 4:
             raise DecodeError("short LLS block")
@@ -970,6 +1047,10 @@ class Packet:
 
     def encode(self, auth: AuthCtx | None = None) -> bytes:
         auth = auth or AuthCtx()
+        if auth.type == AuthType.CRYPTOGRAPHIC:
+            # One keychain consultation per packet: key id, digest
+            # length, and both digests must agree (resolve_send).
+            auth = auth.resolve_send() or AuthCtx()
         w = Writer()
         w.u8(OSPF_VERSION).u8(int(self.body.TYPE)).u16(0)
         w.ipv4(self.router_id).ipv4(self.area_id)
@@ -983,7 +1064,7 @@ class Packet:
             # (0, key id, digest length, seqno); digest appended.
             w.patch_bytes(
                 16,
-                bytes((0, 0, auth.key_id, auth.digest_len))
+                bytes((0, 0, auth.tx_key_id, auth.digest_len))
                 + (auth.seqno & 0xFFFFFFFF).to_bytes(4, "big"),
             )
             w.bytes(auth.digest(bytes(w.buf)))
@@ -1029,12 +1110,20 @@ class Packet:
         if auth_type != expected:
             raise DecodeError(f"auth type mismatch: got {auth_type}")
         seqno = 0
+        dlen = 0
         if auth_type == AuthType.CRYPTOGRAPHIC:
-            key_id = auth_data[2]
+            rx_key_id = auth_data[2]
             dlen = auth_data[3]
             seqno = int.from_bytes(auth_data[4:8], "big")
-            if dlen != auth.digest_len or key_id != auth.key_id:
+            # Accept-side key selection, resolved ONCE for the whole
+            # packet (incl. the LLS block below): the received key id
+            # must name a key whose ACCEPT lifetime is active
+            # (keychain.rs key_lookup_accept); fixed-key contexts only
+            # accept their own id.
+            eff = auth.resolve_accept(rx_key_id)
+            if eff is None or eff.digest_len != dlen:
                 raise DecodeError("bad crypto auth parameters")
+            auth = eff
             if len(data) < length + dlen:
                 raise DecodeError("missing auth digest")
             digest = auth.digest(data[:length])
@@ -1051,8 +1140,10 @@ class Packet:
         lls = None
         if Options.L & getattr(body, "options", 0):
             crypto = auth_type == AuthType.CRYPTOGRAPHIC
-            off = length + (auth.digest_len if crypto else 0)
+            off = length + (dlen if crypto else 0)
             if len(data) > off:
+                # auth was rebound to the resolved accept key above —
+                # the LLS digest verifies with the SAME key.
                 lls = LlsBlock.decode(data[off:], auth=auth)
         return cls(
             router_id, area_id, body, auth_type, auth_data, seqno, lls

@@ -55,6 +55,27 @@ def keychains_module():
                 _leaf("crypto-algorithm", "enum",
                       enum=("md5", "hmac-sha-1", "hmac-sha-256", "hmac-sha-384",
                             "hmac-sha-512")),
+                # ietf-key-chain lifetimes (RFC 8177): independent send
+                # and accept windows make key rollover lossless
+                # (reference holo-utils/src/keychain.rs:42-92).
+                C(
+                    "send-lifetime",
+                    _leaf("start-date-time"),
+                    _leaf("end-date-time"),
+                ),
+                C(
+                    "accept-lifetime",
+                    _leaf("start-date-time"),
+                    _leaf("end-date-time"),
+                ),
+                C(
+                    "lifetime",
+                    C(
+                        "send-accept-lifetime",
+                        _leaf("start-date-time"),
+                        _leaf("end-date-time"),
+                    ),
+                ),
             ),
         ),
     )

@@ -1,0 +1,288 @@
+"""Keychain send/accept lifetimes + live key rollover
+(reference holo-utils/src/keychain.rs:42-92; the overlap of the old
+key's accept lifetime with the new key's send lifetime is what makes
+rollover lossless)."""
+
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ospf.neighbor import NsmState
+from holo_tpu.utils.keychain import Key, Keychain, KeyLifetime
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+
+
+def _rollover_chain():
+    """Key 1 sends until t=100 and is accepted until t=140; key 2 sends
+    from t=100 and is accepted from t=60 — a 40 s overlap either side."""
+    return Keychain(
+        "roll",
+        [
+            Key(1, "md5", b"old-key",
+                send_lifetime=KeyLifetime(None, 100),
+                accept_lifetime=KeyLifetime(None, 140)),
+            Key(2, "hmac-sha-256", b"new-key",
+                send_lifetime=KeyLifetime(100, None),
+                accept_lifetime=KeyLifetime(60, None)),
+        ],
+    )
+
+
+def test_lookup_semantics():
+    kc = _rollover_chain()
+    assert kc.key_lookup_send(50).id == 1
+    assert kc.key_lookup_send(100).id == 2  # boundary: start inclusive
+    assert kc.key_lookup_send(99.9).id == 1
+    assert kc.key_lookup_accept(1, 120).id == 1  # old still accepted
+    assert kc.key_lookup_accept(1, 140) is None  # accept window over
+    assert kc.key_lookup_accept(2, 50) is None  # not yet
+    assert kc.key_lookup_accept(2, 70).id == 2
+    assert kc.key_lookup_accept_any(50).id == 1
+    assert kc.key_lookup_accept_any(150).id == 2
+
+
+def test_from_config_lifetimes():
+    kc = Keychain.from_config(
+        "c",
+        {
+            "key": {
+                "1": {
+                    "key-string": "aaa",
+                    "crypto-algorithm": "md5",
+                    "send-lifetime": {
+                        "start-date-time": "1970-01-01T00:00:10+00:00",
+                        "end-date-time": "1970-01-01T00:01:40+00:00",
+                    },
+                    "accept-lifetime": {
+                        "start-date-time": 0,
+                        "end-date-time": 130,
+                    },
+                },
+                "2": {"key-string": "bbb"},
+            }
+        },
+    )
+    k1 = kc.key_lookup_accept(1, 50)
+    assert k1 is not None and k1.string == b"aaa"
+    assert kc.key_lookup_send(5).id == 2  # key 1 send starts at t=10
+    assert kc.key_lookup_send(50).id == 1  # ascending id, both active
+
+
+def test_ospf_rollover_zero_loss():
+    """OSPF adjacency across a send-key boundary: zero auth failures,
+    neighbor stays FULL, even with different algorithms per key
+    (the VERDICT acceptance test)."""
+    from holo_tpu.protocols.ospf import packet as pkt_mod
+    from holo_tpu.protocols.ospf.instance import (
+        IfConfig, IfUpMsg, InstanceConfig, OspfInstance,
+    )
+    from holo_tpu.protocols.ospf.interface import IfType
+    from holo_tpu.protocols.ospf.packet import AuthCtx, AuthType
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    failures = []
+    orig_decode = pkt_mod.Packet.decode.__func__
+
+    def counting_decode(cls, data, auth=None):
+        try:
+            return orig_decode(cls, data, auth)
+        except pkt_mod.DecodeError as e:
+            failures.append(str(e))
+            raise
+
+    pkt_mod.Packet.decode = classmethod(counting_decode)
+    try:
+        routers = []
+        for name, rid, addr in (
+            ("a1", "1.1.1.1", "10.0.0.1"),
+            ("a2", "2.2.2.2", "10.0.0.2"),
+        ):
+            inst = OspfInstance(
+                name=name,
+                config=InstanceConfig(router_id=A(rid)),
+                netio=fabric.sender_for(name),
+            )
+            loop.register(inst)
+            auth = AuthCtx(
+                AuthType.CRYPTOGRAPHIC,
+                keychain=_rollover_chain(),
+                clock=loop.clock.now,
+            )
+            cfg = IfConfig(
+                if_type=IfType.POINT_TO_POINT,
+                hello_interval=2, dead_interval=8, auth=auth,
+            )
+            inst.add_interface("e0", cfg, N("10.0.0.0/30"), A(addr))
+            fabric.join("l", name, "e0", A(addr))
+            routers.append(inst)
+        for r in routers:
+            loop.send(r.name, IfUpMsg("e0"))
+        loop.advance(40)  # converge well before the t=100 boundary
+
+        def full(r):
+            return any(
+                n.state == NsmState.FULL
+                for a in r.areas.values()
+                for i in a.interfaces.values()
+                for n in i.neighbors.values()
+            )
+
+        assert all(full(r) for r in routers), "pre-rollover adjacency"
+        failures.clear()
+        loop.advance(120)  # cross t=100: key 1 -> key 2, algo changes too
+        assert all(full(r) for r in routers), "adjacency lost in rollover"
+        assert failures == [], f"auth failures across rollover: {failures}"
+        # The new key is genuinely in use now (key id 2 on the wire).
+        a = routers[0]._iface("e0")[1].config.auth
+        assert a.tx_key_id == 2
+    finally:
+        pkt_mod.Packet.decode = classmethod(orig_decode)
+
+
+def test_isis_rollover_zero_loss():
+    """IS-IS LSP/hello auth across a send-key boundary (RFC 5310 key
+    ids; reference packet/auth.rs AuthMethod::Keychain)."""
+    from holo_tpu.protocols.isis import packet as ipkt
+    from holo_tpu.protocols.isis.instance import IsisIfConfig, IsisIfUpMsg
+    from holo_tpu.protocols.isis.packet import AuthCtxIsis
+
+    from tests.test_isis import link, mk_net
+
+    kc = Keychain(
+        "iroll",
+        [
+            Key(1, "hmac-sha1", b"old",
+                send_lifetime=KeyLifetime(None, 100),
+                accept_lifetime=KeyLifetime(None, 140)),
+            Key(2, "hmac-sha256", b"new",
+                send_lifetime=KeyLifetime(100, None),
+                accept_lifetime=KeyLifetime(60, None)),
+        ],
+    )
+    loop, fabric, (r1, r2) = mk_net(2)
+    for r in (r1, r2):
+        r.auth = AuthCtxIsis(
+            key=b"", keychain=kc, clock=loop.clock.now
+        )
+    link(loop, fabric, r1, "e0", "10.0.12.1", r2, "e0", "10.0.12.2",
+         "10.0.12.0/30", 10)
+    failures = []
+    orig = ipkt.verify_pdu_auth
+
+    def counting_verify(data, tlvs, auth):
+        try:
+            return orig(data, tlvs, auth)
+        except ipkt.AuthError as e:
+            failures.append(str(e))
+            raise
+
+    ipkt.verify_pdu_auth = counting_verify
+    try:
+        for r in (r1, r2):
+            for ifname in list(r.interfaces):
+                loop.send(r.name, IsisIfUpMsg(ifname))
+        loop.advance(40)
+        assert set(r1.lsdb) == set(r2.lsdb) and r1.routes, "pre-rollover"
+        failures.clear()
+        loop.advance(120)  # cross the t=100 send boundary
+        from holo_tpu.protocols.isis.instance import AdjacencyState
+
+        assert r1.interfaces["e0"].adj.state == AdjacencyState.UP
+        assert r2.interfaces["e0"].adj.state == AdjacencyState.UP
+        assert failures == [], f"auth failures across rollover: {failures}"
+        assert r1.auth.for_send().key_id == 2  # new key on the wire
+    finally:
+        ipkt.verify_pdu_auth = orig
+
+
+def test_isis_md5_rollover_tries_all_accept_keys():
+    """RFC 5304 HMAC-MD5 carries no key id: during the overlap window
+    verification must try every accept-active md5 key, or rollover
+    drops each PDU signed with the other key (r5 review)."""
+    from holo_tpu.protocols.isis.instance import (
+        AdjacencyState, IsisIfUpMsg,
+    )
+    from holo_tpu.protocols.isis.packet import AuthCtxIsis
+
+    from tests.test_isis import link, mk_net
+
+    kc = Keychain(
+        "md5roll",
+        [
+            Key(1, "hmac-md5", b"old",
+                send_lifetime=KeyLifetime(None, 100),
+                accept_lifetime=KeyLifetime(None, 140)),
+            Key(2, "hmac-md5", b"new",
+                send_lifetime=KeyLifetime(100, None),
+                accept_lifetime=KeyLifetime(60, None)),
+        ],
+    )
+    loop, fabric, (r1, r2) = mk_net(2)
+    for r in (r1, r2):
+        r.auth = AuthCtxIsis(key=b"", keychain=kc, clock=loop.clock.now)
+    link(loop, fabric, r1, "e0", "10.0.14.1", r2, "e0", "10.0.14.2",
+         "10.0.14.0/30", 10)
+    for r in (r1, r2):
+        for ifname in list(r.interfaces):
+            loop.send(r.name, IsisIfUpMsg(ifname))
+    loop.advance(40)
+    assert r1.interfaces["e0"].adj.state == AdjacencyState.UP
+    loop.advance(120)  # cross t=100: both keys md5, no wire key id
+    assert r1.interfaces["e0"].adj.state == AdjacencyState.UP
+    assert r2.interfaces["e0"].adj.state == AdjacencyState.UP
+    assert r1.auth.for_send().key == b"new"
+
+
+def test_malformed_lifetime_fails_closed():
+    """A typo'd date-time must reject the commit, not silently make the
+    key immortal (r5 review)."""
+    import pytest
+
+    from holo_tpu.utils.keychain import Keychain
+
+    with pytest.raises(ValueError, match="invalid lifetime"):
+        Keychain.from_config(
+            "bad",
+            {"key": {"1": {
+                "key-string": "x",
+                "send-lifetime": {"end-date-time": "2026-13-01T00:00:00Z"},
+            }}},
+        )
+
+    from holo_tpu.daemon.daemon import Daemon
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, netio=MockFabric(loop), name="kv")
+    cand = d.candidate()
+    cand.set("key-chains/key-chain[bad]/key[1]/key-string", "x")
+    cand.set(
+        "key-chains/key-chain[bad]/key[1]/send-lifetime/end-date-time",
+        "2026-13-01T00:00:00Z",
+    )
+    with pytest.raises(Exception, match="key-chain 'bad'"):
+        d.commit(cand)
+
+
+def test_ospf_send_gap_goes_unauthenticated():
+    """A keychain coverage gap (no active send key) sends NULL-auth
+    packets — the reference's get_key_send -> None behavior — rather
+    than signing with a phantom empty key under a real key id."""
+    from holo_tpu.protocols.ospf.packet import (
+        AuthCtx, AuthType, Hello, Options, Packet,
+    )
+
+    kc = Keychain(
+        "gap",
+        [Key(1, "md5", b"k", send_lifetime=KeyLifetime(None, 10))],
+    )
+    t = [50.0]  # inside the gap
+    auth = AuthCtx(AuthType.CRYPTOGRAPHIC, keychain=kc, clock=lambda: t[0])
+    pkt = Packet(
+        A("1.1.1.1"), A("0.0.0.0"),
+        Hello(A("255.255.255.252"), 2, Options.E, 1, 8, A("0.0.0.0"),
+              A("0.0.0.0"), []),
+    )
+    raw = pkt.encode(auth=auth)
+    # Auth type field (bytes 14:16) is NULL, not CRYPTOGRAPHIC.
+    assert int.from_bytes(raw[14:16], "big") == int(AuthType.NULL)

@@ -126,3 +126,55 @@ def test_daemon_keychain_driven_md5():
     assert iface.config.auth is not None
     assert iface.config.auth.type == AuthType.CRYPTOGRAPHIC
     assert any(n.state == NsmState.FULL for n in iface.neighbors.values())
+
+
+def test_daemon_keychain_lifetime_rollover():
+    """Config-driven keychain with send/accept lifetimes: the daemons
+    roll from key 1 to key 2 at t=60 with the adjacency intact
+    (ietf-key-chain lifetimes -> utils.keychain.Keychain)."""
+    import ipaddress
+
+    from holo_tpu.daemon.daemon import Daemon
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="r1")
+    d2 = Daemon(loop=loop, netio=fabric, name="r2")
+    fabric.join("l", "r1.ospfv2", "eth0", ipaddress.ip_address("10.0.13.1"))
+    fabric.join("l", "r2.ospfv2", "eth0", ipaddress.ip_address("10.0.13.2"))
+    for d, rid, addr in [(d1, "1.1.1.1", "10.0.13.1/30"),
+                         (d2, "2.2.2.2", "10.0.13.2/30")]:
+        cand = d.candidate()
+        kb = "key-chains/key-chain[roll]"
+        cand.set(f"{kb}/key[1]/key-string", "old-secret")
+        cand.set(f"{kb}/key[1]/crypto-algorithm", "md5")
+        cand.set(f"{kb}/key[1]/send-lifetime/end-date-time",
+                 "1970-01-01T00:01:00+00:00")
+        cand.set(f"{kb}/key[1]/accept-lifetime/end-date-time",
+                 "1970-01-01T00:02:00+00:00")
+        cand.set(f"{kb}/key[2]/key-string", "new-secret")
+        cand.set(f"{kb}/key[2]/crypto-algorithm", "hmac-sha-256")
+        cand.set(f"{kb}/key[2]/send-lifetime/start-date-time",
+                 "1970-01-01T00:01:00+00:00")
+        cand.set(f"{kb}/key[2]/accept-lifetime/start-date-time",
+                 "1970-01-01T00:00:30+00:00")
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("routing/control-plane-protocols/ospfv2/router-id", rid)
+        base = "routing/control-plane-protocols/ospfv2/area[0.0.0.0]/interface[eth0]"
+        cand.set(f"{base}/interface-type", "point-to-point")
+        cand.set(f"{base}/hello-interval", 2)
+        cand.set(f"{base}/dead-interval", 8)
+        cand.set(f"{base}/authentication/key-chain", "roll")
+        d.commit(cand)
+    loop.advance(40)
+    inst = d1.routing.instances["ospfv2"]
+    iface = list(inst.areas.values())[0].interfaces["eth0"]
+
+    def full():
+        return any(n.state == NsmState.FULL for n in iface.neighbors.values())
+
+    assert full(), "pre-rollover adjacency"
+    assert iface.config.auth.tx_key_id == 1
+    loop.advance(60)  # cross the t=60 send boundary
+    assert full(), "adjacency lost across keychain rollover"
+    assert iface.config.auth.tx_key_id == 2
