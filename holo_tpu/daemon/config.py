@@ -48,11 +48,17 @@ class EventRecorderConfig:
 
 @dataclass
 class RuntimeConfig:
-    # "cooperative": every actor on the daemon's single event loop.
-    # "threaded": each protocol instance on its own OS thread (the
-    # reference's per-instance spawn_blocking isolation,
-    # holo-protocol/src/lib.rs:419-430) — requires the real clock.
-    isolation: str = "cooperative"
+    # "threaded" (default): each protocol instance on its own OS thread
+    # — the reference's PRODUCTION posture (per-instance spawn_blocking,
+    # holo-protocol/src/lib.rs:419-430).  Requires the real clock;
+    # virtual-clock (test) daemons automatically fall back to
+    # "cooperative" single-loop scheduling, the analog of the
+    # reference's `testing` feature.
+    isolation: str = "threaded"
+    # True when [runtime] isolation was explicitly configured (vs the
+    # default): an EXPLICIT threaded request that must downgrade (no
+    # real clock) warns; the defaulted case downgrades silently.
+    isolation_explicit: bool = False
 
 
 @dataclass
@@ -102,11 +108,13 @@ class DaemonConfig:
             cfg.event_recorder.enabled = e.get("enabled", False)
             cfg.event_recorder.dir = e.get("dir", cfg.event_recorder.dir)
         if "runtime" in raw:
-            iso = raw["runtime"].get("isolation", cfg.runtime.isolation)
-            if iso not in ("cooperative", "threaded"):
-                raise ValueError(
-                    f"[runtime] isolation must be 'cooperative' or "
-                    f"'threaded', got {iso!r}"
-                )
-            cfg.runtime.isolation = iso
+            iso = raw["runtime"].get("isolation")
+            if iso is not None:
+                if iso not in ("cooperative", "threaded"):
+                    raise ValueError(
+                        f"[runtime] isolation must be 'cooperative' or "
+                        f"'threaded', got {iso!r}"
+                    )
+                cfg.runtime.isolation = iso
+                cfg.runtime.isolation_explicit = True
         return cfg

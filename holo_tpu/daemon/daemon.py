@@ -67,10 +67,19 @@ class Daemon:
         send_loop = self.loop
         if self.config.runtime.isolation == "threaded":
             if not isinstance(self.loop.clock, RealClock):
-                log.warning(
+                # The reference's `testing` feature makes the same
+                # downgrade: deterministic single-loop scheduling under
+                # a virtual clock, threaded in production.  An operator
+                # who EXPLICITLY asked for threaded deserves the
+                # warning; the defaulted case downgrades quietly.
+                msg = (
                     "isolation=threaded requires the real clock; "
                     "falling back to cooperative scheduling"
                 )
+                if self.config.runtime.isolation_explicit:
+                    log.warning(msg)
+                else:
+                    log.debug(msg)
             else:
                 from holo_tpu.utils.preempt import CallRunner, LoopRouter
 

@@ -1,0 +1,181 @@
+// ThreadSanitizer driver: exercises the native runtime primitives under
+// the EXACT concurrency contracts the threaded daemon uses them with
+// (SURVEY.md §5 — with [runtime] isolation = "threaded" as the default,
+// the lock-free MPSC ring, the poller, and per-thread timer wheels are
+// production paths and lose Rust's compile-time guarantees).
+//
+// Concurrency shapes mirrored from the Python runtime:
+//  - MsgRing: N producer threads (instance threads, Tx tasks, fabric
+//    deliveries) push while ONE owner thread pops — ThreadedLoop's
+//    single-writer actor discipline.
+//  - Poller: the owner blocks in wait while another thread adds/removes
+//    fds (session_reset/remove_peer from an instance thread).
+//  - TimerWheel: single-owner per loop; one wheel per thread running
+//    concurrently catches any accidental shared state.
+//
+// Built and run by tests/test_native_sanitizers.py with
+// -fsanitize=thread; any data race aborts with a nonzero exit.
+
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+void* holo_wheel_new();
+void holo_wheel_free(void*);
+int32_t holo_wheel_create(void*, int64_t);
+void holo_wheel_arm(void*, int32_t, double);
+void holo_wheel_cancel(void*, int32_t);
+int holo_wheel_advance(void*, double, int64_t*, int);
+void* holo_ring_new(uint32_t, uint32_t);
+void holo_ring_free(void*);
+int holo_ring_push(void*, const uint8_t*, uint32_t);
+int holo_ring_pop(void*, uint8_t*, uint32_t);
+int holo_poller_new();
+void holo_poller_free(int);
+int holo_poller_add(int, int, uint32_t);
+int holo_poller_del(int, int);
+int holo_poller_wait(int, int, int32_t*, uint32_t*, int);
+double holo_monotonic_now();
+}
+
+// N producers, one consumer — the ThreadedLoop inbox pattern.  Each
+// producer tags its messages; the consumer checks per-producer FIFO
+// order and total counts, so a torn publish is a logic failure even
+// before TSan flags the race.
+static void mpsc_ring_producers_vs_owner() {
+  constexpr int kProducers = 4;
+  constexpr int kPerProducer = 20000;
+  void* r = holo_ring_new(64, 16);
+  std::atomic<bool> done{false};
+  std::vector<std::thread> producers;
+  for (int p = 0; p < kProducers; ++p) {
+    producers.emplace_back([r, p]() {
+      uint8_t msg[8];
+      for (uint32_t i = 0; i < kPerProducer; ++i) {
+        msg[0] = (uint8_t)p;
+        memcpy(msg + 1, &i, sizeof(i));
+        while (holo_ring_push(r, msg, 5) != 0) {
+          std::this_thread::yield();  // ring full: backpressure
+        }
+      }
+    });
+  }
+  uint64_t got = 0;
+  uint32_t next_seq[kProducers] = {0};
+  std::thread consumer([&]() {
+    uint8_t out[16];
+    while (got < (uint64_t)kProducers * kPerProducer) {
+      int n = holo_ring_pop(r, out, sizeof(out));
+      if (n < 0) {
+        if (done.load(std::memory_order_acquire) &&
+            holo_ring_pop(r, out, sizeof(out)) < 0) {
+          break;
+        }
+        std::this_thread::yield();
+        continue;
+      }
+      assert(n == 5);
+      int p = out[0];
+      uint32_t seq;
+      memcpy(&seq, out + 1, sizeof(seq));
+      assert(p >= 0 && p < kProducers);
+      assert(seq == next_seq[p]);  // per-producer FIFO
+      next_seq[p] = seq + 1;
+      got++;
+    }
+  });
+  for (auto& t : producers) t.join();
+  done.store(true, std::memory_order_release);
+  consumer.join();
+  assert(got == (uint64_t)kProducers * kPerProducer);
+  holo_ring_free(r);
+}
+
+// Owner blocks in epoll_wait while another thread mutates the interest
+// set and writes wakeups — the daemon poller vs instance-thread
+// session_reset shape.
+static void poller_cross_thread_mutation() {
+  int ep = holo_poller_new();
+  int fds[2];
+  assert(pipe(fds) == 0);
+  assert(holo_poller_add(ep, fds[0], 0x001 /*EPOLLIN*/) == 0);
+  std::atomic<bool> stop{false};
+  std::thread owner([&]() {
+    int32_t rfds[8];
+    uint32_t evs[8];
+    uint8_t b;
+    while (!stop.load(std::memory_order_acquire)) {
+      int n = holo_poller_wait(ep, 10, rfds, evs, 8);
+      for (int i = 0; i < n; ++i) {
+        if (read(rfds[i], &b, 1) == 1 && b == 0xFF) {
+          stop.store(true, std::memory_order_release);
+        }
+      }
+    }
+  });
+  std::thread mutator([&]() {
+    for (int i = 0; i < 200; ++i) {
+      int extra[2];
+      assert(pipe(extra) == 0);
+      holo_poller_add(ep, extra[0], 0x001);
+      uint8_t b = 1;
+      (void)!write(fds[1], &b, 1);
+      holo_poller_del(ep, extra[0]);
+      close(extra[0]);
+      close(extra[1]);
+    }
+    uint8_t fin = 0xFF;
+    (void)!write(fds[1], &fin, 1);
+  });
+  mutator.join();
+  owner.join();
+  close(fds[0]);
+  close(fds[1]);
+  holo_poller_free(ep);
+}
+
+// One wheel per thread (the per-ThreadedLoop ownership contract):
+// concurrent wheels must share nothing.
+static void per_thread_timer_wheels() {
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([t]() {
+      void* w = holo_wheel_new();
+      std::mt19937 rng(100 + t);
+      std::vector<int32_t> ids;
+      for (int i = 0; i < 500; ++i) {
+        int32_t id = holo_wheel_create(w, i);
+        holo_wheel_arm(w, id, (rng() % 2000) / 1000.0);
+        ids.push_back(id);
+      }
+      for (size_t k = 0; k < ids.size(); k += 4) {
+        holo_wheel_cancel(w, ids[k]);
+      }
+      int64_t fired[32];
+      double now = 0.0;
+      while (now < 3.0) {
+        now += 0.05;
+        while (holo_wheel_advance(w, now, fired, 32) == 32) {
+        }
+      }
+      holo_wheel_free(w);
+    });
+  }
+  for (auto& t : threads) t.join();
+  (void)holo_monotonic_now();
+}
+
+int main() {
+  mpsc_ring_producers_vs_owner();
+  poller_cross_thread_mutation();
+  per_thread_timer_wheels();
+  printf("tsan_driver OK\n");
+  return 0;
+}

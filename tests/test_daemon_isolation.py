@@ -153,3 +153,30 @@ def test_threaded_daemon_isolation_and_recording(tmp_path):
     assert n > 0
     # The replayed instance rebuilt its LSDB from the journal alone.
     assert any(area.lsdb.entries for area in replayed.areas.values())
+
+
+def test_default_config_runs_threaded():
+    """The DEFAULT daemon posture is per-instance OS threads (reference
+    holo-protocol/src/lib.rs:419-430 production mode); cooperative is
+    the virtual-clock/test fallback — polarity per VERDICT r4."""
+    from holo_tpu.utils.runtime import VirtualClock
+
+    cfg = DaemonConfig()
+    assert cfg.runtime.isolation == "threaded"
+    d = Daemon(config=DaemonConfig())  # real clock by default
+    try:
+        assert d.loop_router is not None, "default daemon must be threaded"
+        _configure_ospf(d, "9.9.9.1", "10.90.0.1/30")
+        assert d.instance_loops, "instance did not get its own thread"
+        assert all(
+            tl._thread.is_alive() for tl in d.instance_loops.values()
+        )
+    finally:
+        d.stop()
+    # Virtual-clock daemons silently downgrade (the reference's
+    # `testing` feature analog).
+    from holo_tpu.utils.runtime import EventLoop
+
+    loop = EventLoop(clock=VirtualClock())
+    d2 = Daemon(loop=loop, config=DaemonConfig())
+    assert d2.loop_router is None

@@ -6,6 +6,7 @@ units under -fsanitize=address,undefined and runs it; any heap error,
 leak, overflow, or UB aborts the binary with a nonzero exit.
 """
 
+import shutil
 import subprocess
 from pathlib import Path
 
@@ -13,12 +14,10 @@ import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
 NATIVE = ROOT / "native"
+HAVE_GXX = shutil.which("g++") is not None
 
 
-@pytest.mark.skipif(
-    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
-    reason="g++ unavailable",
-)
+@pytest.mark.skipif(not HAVE_GXX, reason="g++ unavailable")
 def test_native_under_asan_ubsan(tmp_path):
     binary = tmp_path / "sanitize_driver"
     build = subprocess.run(
@@ -46,3 +45,40 @@ def test_native_under_asan_ubsan(tmp_path):
         f"sanitizer failure:\n{run.stdout[-1000:]}\n{run.stderr[-3000:]}"
     )
     assert "sanitize_driver OK" in run.stdout
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="g++ unavailable")
+def test_native_under_tsan_threaded_runtime(tmp_path):
+    """ThreadSanitizer over the native runtime under the threaded
+    daemon's exact concurrency contracts (SURVEY.md §5: mandatory now
+    that [runtime] isolation = "threaded" makes the MPSC ring, poller,
+    and per-thread wheels production paths).  The driver replicates the
+    ThreadedLoop/ThreadedFabric shapes at the native layer — N producer
+    threads vs one ring owner, cross-thread poller mutation, per-thread
+    wheel ownership; the Python halves of those structures are
+    GIL-serialized and covered by tests/test_preempt_stress.py."""
+    binary = tmp_path / "tsan_driver"
+    build = subprocess.run(
+        [
+            "g++", "-std=c++17", "-O1", "-g", "-fno-omit-frame-pointer",
+            "-fsanitize=thread",
+            str(NATIVE / "tsan_driver.cpp"),
+            str(NATIVE / "runtime_core.cpp"),
+            "-o", str(binary), "-lpthread",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert build.returncode == 0, f"build failed:\n{build.stderr[-2000:]}"
+    run = subprocess.run(
+        [str(binary)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={"TSAN_OPTIONS": "halt_on_error=1 exitcode=66"},
+    )
+    assert run.returncode == 0, (
+        f"TSan failure:\n{run.stdout[-1000:]}\n{run.stderr[-4000:]}"
+    )
+    assert "tsan_driver OK" in run.stdout
