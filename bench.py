@@ -3,15 +3,23 @@
 
 Measures the BASELINE.md north-star workloads:
 
-- 10k-vertex fat-tree LSDB, 256-scenario what-if batch (configs 2/5):
+- 10k-vertex fat-tree LSDB, 512-scenario what-if batch (configs 1/5):
   full SPF (distances + first-parent + hops + 64-way ECMP next-hop
-  bitmasks) on two TPU engines — the block-sparse Pallas pipeline
-  (ops/blocked_spf.py, the headline) and the ELL gather engine
-  (ops/spf_engine.py) — against the serial C++ candidate-list Dijkstra
-  (reference semantics, native/spf_baseline.cpp).
+  bitmasks) on the ELL gather engines (ops/spf_engine.py — the
+  HEADLINE path: `seq` has won every recorded sweep, r02-r04) against
+  the serial C++ candidate-list Dijkstra (reference semantics,
+  native/spf_baseline.cpp).  The block-sparse Pallas pipeline
+  (ops/blocked_spf.py) runs as a parity-tested EXPERIMENT row: it has
+  lost every sweep so far (3x slower on JAX-CPU, r03+r04) and keeps its
+  slot only until a real-TPU A/B settles it (VERDICT r4 weak #6) — the
+  headline picks whichever parity-ok engine measures fastest, so a TPU
+  win would promote it automatically.
 - 50k-vertex fat-tree (the BASELINE.md target scale): gather engine
   first (it outruns the Pallas path and compiles there since the
   next-hop word unroll), blocked engine as fallback.
+- OSPFv3 multi-area + IS-IS L1/L2 protocol-marshaled rows (configs
+  2/3): topologies extracted through the real instance marshal paths
+  (spf/synth_proto.py), parity-gated per area/level.
 - p50 latency: small-batch gather run + C++ single-run p50.
 
 Every TPU stage runs in a SUBPROCESS with a hard timeout: the axon TPU
@@ -48,6 +56,8 @@ STAGE_TIMEOUT = {
     "cspf10k": 900,
     "cpu100": 300,
     "cpubaseline": 600,
+    "ospfv3_multiarea": 1200,
+    "isis_l1l2": 1200,
 }
 
 
@@ -369,6 +379,66 @@ def stage_scale50k(k, B, cpu_runs, engine="seq"):
         return _blocked_run(topo, masks, cpu_runs, reps=2)
 
 
+def _multi_topo_run(topos, B, cpu_runs, engine="seq", n_atoms=64, reps=2):
+    """One FULL SPF run = every topology computed for one scenario
+    (multi-area OSPFv3: all areas; IS-IS: both levels).  Aggregates the
+    per-topology batched engine runs into a full-run rate, parity-gated
+    per topology against the C++ scalar baseline."""
+    from holo_tpu.spf.synth import whatif_link_failure_masks
+
+    parts = []
+    tpu_time = 0.0
+    cpu_time = 0.0
+    ok = True
+    for topo in topos:
+        masks = whatif_link_failure_masks(topo, B, seed=1)
+        r = _gather_run(
+            topo, masks, cpu_runs, reps=reps, n_atoms=n_atoms, engine=engine
+        )
+        parts.append(r | {"n_vertices": int(topo.n_vertices)})
+        ok = ok and r.get("ok", False)
+        tpu_time += r["batch_ms"] / 1e3
+        if cpu_runs and r.get("cpu_runs_per_sec"):
+            cpu_time += cpu_runs / r["cpu_runs_per_sec"]
+    out = {
+        "ok": ok,
+        "runs_per_sec": (B / tpu_time) if tpu_time else 0.0,
+        "engine": engine,
+        "parts": parts,
+    }
+    if cpu_time:
+        out["cpu_runs_per_sec"] = cpu_runs / cpu_time
+        out["vs_cpu"] = round(out["runs_per_sec"] / out["cpu_runs_per_sec"], 2)
+    return out
+
+
+def stage_ospfv3_multiarea(n_routers, n_areas, B, cpu_runs):
+    """BASELINE config 2: 10k-node multi-area OSPFv3 LSDB, marshaled
+    through OspfV3Instance._area_spf (one SPT per area)."""
+    from holo_tpu.spf.synth_proto import ospfv3_multiarea_topologies
+
+    topos = ospfv3_multiarea_topologies(n_routers, n_areas)
+    return _multi_topo_run(topos, B, cpu_runs) | {
+        "n_routers": int(n_routers),
+        "n_areas": int(n_areas),
+    }
+
+
+def stage_isis_l1l2(n_l2, n_l1, ecmp, B, cpu_runs):
+    """BASELINE config 3: 10k-node IS-IS L1/L2 with 64-way ECMP
+    extraction at the L2 root, marshaled through IsisInstance.run_spf
+    (the builder asserts the root's route table really fans out
+    ``ecmp`` ways)."""
+    from holo_tpu.spf.synth_proto import isis_l1l2_topologies
+
+    topos = isis_l1l2_topologies(n_l2, n_l1, ecmp)
+    return _multi_topo_run(topos, B, cpu_runs, n_atoms=max(64, ecmp)) | {
+        "n_l2": int(n_l2),
+        "n_l1": int(n_l1),
+        "ecmp_width": int(ecmp),
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -425,6 +495,16 @@ def main() -> None:
             "cspf10k": lambda: stage_cspf10k(k10, 32 if small else 256),
             "cpu100": lambda: stage_cpu100(32 if small else 200),
             "cpubaseline": lambda: stage_cpubaseline(k10, cpu10),
+            "ospfv3_multiarea": lambda: (
+                stage_ospfv3_multiarea(400, 4, 16, 4)
+                if small
+                else stage_ospfv3_multiarea(10_000, 4, 128, 8)
+            ),
+            "isis_l1l2": lambda: (
+                stage_isis_l1l2(360, 40, 16, 16, 4)
+                if small
+                else stage_isis_l1l2(9_000, 1_000, 64, 128, 8)
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -445,6 +525,15 @@ def main() -> None:
         extra["cpubaseline"] = _run_stage("cpubaseline", small)
         extra["cpu100"] = _run_stage("cpu100", small)  # device-free row
         extra["gather10k_jaxcpu_small"] = _run_stage("gather10k", True, cpu=True)
+        # BASELINE configs 2+3 parity rows (protocol-marshaled
+        # topologies): small JAX-CPU versions so the rows exist —
+        # parity-gated — even when the relay never answers.
+        extra["ospfv3_multiarea_jaxcpu_small"] = _run_stage(
+            "ospfv3_multiarea", True, cpu=True
+        )
+        extra["isis_l1l2_jaxcpu_small"] = _run_stage(
+            "isis_l1l2", True, cpu=True
+        )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
         print(
@@ -506,6 +595,11 @@ def main() -> None:
         # what-if) — coverage rows, not the headline.
         extra["whatif1024"] = _run_stage("whatif1024", small)
         extra["cspf10k"] = _run_stage("cspf10k", small)
+        # BASELINE.md configs 2 and 3: protocol-marshaled topologies
+        # (OSPFv3 multi-area; IS-IS L1/L2 with 64-way ECMP) through the
+        # shared engine, parity-gated per area/level.
+        extra["ospfv3_multiarea"] = _run_stage("ospfv3_multiarea", small)
+        extra["isis_l1l2"] = _run_stage("isis_l1l2", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

@@ -48,6 +48,9 @@ class IfaceState:
     enabled: bool = True
     operative: bool = True
     addresses: list = field(default_factory=list)
+    # (parent, vlan-id) of the kernel 802.1Q device we actuated for this
+    # interface; None = no vlan device created by us.
+    vlan_actuated: tuple | None = None
 
 
 class InterfaceProvider(Provider, Actor):
@@ -72,6 +75,29 @@ class InterfaceProvider(Provider, Actor):
 
     def handle(self, msg):
         pass
+
+    def validate(self, new_tree) -> None:
+        # Fail-closed at commit time (same pattern as the keychain
+        # lifetime validation): a bad vlan-id or a vlan interface
+        # without its parent must reject the commit, not silently skip
+        # device creation at apply time.
+        from holo_tpu.northbound.provider import CommitError
+
+        for name, entry in (
+            new_tree.get("interfaces/interface", {}) or {}
+        ).items():
+            if entry.get("type") != "vlan":
+                continue
+            vid = entry.get("vlan-id")
+            if vid is not None and not 1 <= vid <= 4094:
+                raise CommitError(
+                    f"interface {name}: vlan-id must be 1-4094, got {vid}"
+                )
+            if (vid is None) != (not entry.get("parent-interface")):
+                raise CommitError(
+                    f"interface {name}: vlan interfaces need BOTH "
+                    f"parent-interface and vlan-id"
+                )
 
     def _sync_direct_routes(self) -> None:
         """Connected prefixes go into the RIB as protocol 'direct' at
@@ -112,6 +138,30 @@ class InterfaceProvider(Provider, Actor):
                 st = IfaceState(name=name, ifindex=self._next_ifindex)
                 self._next_ifindex += 1
                 self.interfaces[name] = st
+            # 802.1Q subinterface actuation is CHANGE-driven (reference
+            # configuration.rs:122-131,354-365 Event::VlanCreate fires
+            # on the config change, not on map appearance): whenever the
+            # wanted (parent, vlan-id) differs from what we actuated,
+            # tear the old device down and create the new one.
+            want_vlan = (
+                (entry.get("parent-interface"), entry.get("vlan-id"))
+                if entry.get("type") == "vlan"
+                and entry.get("parent-interface")
+                and entry.get("vlan-id") is not None
+                else None
+            )
+            if self.link_mgr is not None and want_vlan != st.vlan_actuated:
+                try:
+                    if st.vlan_actuated is not None:
+                        self.link_mgr.delete_link(name)
+                        st.vlan_actuated = None
+                    if want_vlan is not None:
+                        self.link_mgr.create_vlan(
+                            want_vlan[0], name, want_vlan[1]
+                        )
+                        st.vlan_actuated = want_vlan
+                except (OSError, ValueError) as e:
+                    log.error("vlan actuation failed for %s: %s", name, e)
             new_mtu = entry.get("mtu", 1500)
             new_enabled = entry.get("enabled", True)
             if self.link_mgr is not None and (
@@ -140,7 +190,15 @@ class InterfaceProvider(Provider, Actor):
 
         for name in list(self.interfaces):
             if name not in conf:
-                del self.interfaces[name]
+                st = self.interfaces.pop(name)
+                # Symmetric teardown: a vlan device WE created goes away
+                # with its config entry, or the kernel link leaks and a
+                # later re-add with a different id fails changelink.
+                if st.vlan_actuated is not None and self.link_mgr is not None:
+                    try:
+                        self.link_mgr.delete_link(name)
+                    except OSError as e:
+                        log.error("vlan teardown failed for %s: %s", name, e)
                 self.ibus.publish(TOPIC_INTERFACE_DEL, name, ifname=name)
         self._publish_router_id()
         self._sync_direct_routes()

@@ -74,6 +74,7 @@ IFLA_INFO_KIND = 1
 IFLA_INFO_DATA = 2
 IFLA_MACVLAN_MODE = 1
 MACVLAN_MODE_BRIDGE = 4
+IFLA_VLAN_ID = 1  # nested in IFLA_INFO_DATA for kind "vlan"
 IFF_UP = 1
 RTA_VIA = 18
 RTA_NEWDST = 19
@@ -226,6 +227,11 @@ class MockLinkManager:
                             "addrs": []}
         self.log.append(("create-macvlan", parent, name, mac))
 
+    def create_vlan(self, parent, name, vlan_id):
+        self.links[name] = {"parent": parent, "vlan_id": vlan_id,
+                            "up": False, "addrs": []}
+        self.log.append(("create-vlan", parent, name, vlan_id))
+
     def delete_link(self, name):
         self.links.pop(name, None)
         self.log.append(("delete-link", name))
@@ -277,6 +283,25 @@ class LinkManager:
         info += _attr(
             IFLA_INFO_DATA,
             _attr(IFLA_MACVLAN_MODE, struct.pack("<I", MACVLAN_MODE_BRIDGE)),
+        )
+        payload += _attr(IFLA_LINKINFO, info)
+        self.nl.request_ack(RTM_NEWLINK, NLM_F_CREATE | NLM_F_REPLACE, payload)
+
+    def create_vlan(self, parent: str, name: str, vlan_id: int) -> None:
+        """802.1Q subinterface on ``parent`` (reference
+        holo-interface/src/netlink.rs:271-285 vlan_create)."""
+        if not 1 <= vlan_id <= 4094:
+            raise ValueError(f"vlan-id must be 1-4094, got {vlan_id}")
+        parent_idx = self._ifindex(parent)
+        if parent_idx is None:
+            raise OSError(f"no such link {parent!r}")
+        payload = self._ifinfomsg()
+        payload += _attr(IFLA_IFNAME, name.encode() + b"\x00")
+        payload += _attr(IFLA_LINK, struct.pack("<i", parent_idx))
+        info = _attr(IFLA_INFO_KIND, b"vlan\x00")
+        info += _attr(
+            IFLA_INFO_DATA,
+            _attr(IFLA_VLAN_ID, struct.pack("<H", vlan_id)),
         )
         payload += _attr(IFLA_LINKINFO, info)
         self.nl.request_ack(RTM_NEWLINK, NLM_F_CREATE | NLM_F_REPLACE, payload)

@@ -219,6 +219,62 @@ def cmd_import_yang(args) -> int:
     return 0
 
 
+def cmd_deviations(args) -> int:
+    """Generate a "not-supported" deviations skeleton for a YANG module
+    (reference holo-tools/src/yang_deviations.rs): one commented-out
+    ``deviate not-supported`` per schema node, fully prefixed, ready for
+    an implementer to uncomment for the nodes they do NOT support.
+    Extra files are the module's imports (one context, like libyang)."""
+    from pathlib import Path
+
+    from holo_tpu.yang.parser import load_modules, parse_text
+    from holo_tpu.yang.schema import SchemaError
+
+    try:
+        texts = [Path(f).read_text() for f in args.files]
+        target = parse_text(texts[0])
+    except (OSError, UnicodeDecodeError, SchemaError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if target.keyword != "module":
+        print("error: first file must be a YANG module", file=sys.stderr)
+        return 2
+    name = target.arg
+    pfx_stmt = target.sub("prefix")
+    prefix = pfx_stmt.arg if pfx_stmt is not None else name
+    try:
+        mods = load_modules(texts)
+    except SchemaError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"module holo-{name}-deviations {{")
+    print("  yang-version 1.1;")
+    print(
+        f'  namespace "http://holo-routing.org/yang/holo-{name}-deviations";'
+    )
+    print(f"  prefix holo-{name}-deviations;")
+    print(f"\n  import {name} {{\n    prefix {prefix};\n  }}")
+    print('\n  organization\n    "Holo Routing Stack";')
+    print(
+        f'\n  description\n    "This module defines deviation statements '
+        f'for the {name}\n     module.";'
+    )
+
+    def emit(node, path):
+        path = f"{path}/{prefix}:{node.name}"
+        print(
+            f"\n  /*\n  deviation \"{path}\" {{\n"
+            f"    deviate not-supported;\n  }}\n  */"
+        )
+        for child in getattr(node, "children", {}).values():
+            emit(child, path)
+
+    for node in mods.get(name, []):
+        emit(node, "")
+    print("}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="holo-tpu-tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -249,6 +305,12 @@ def main(argv=None) -> int:
     )
     s.add_argument("files", nargs="+")
     s.set_defaults(fn=cmd_import_yang)
+    s = sub.add_parser(
+        "deviations",
+        help="generate a not-supported deviations skeleton for a module",
+    )
+    s.add_argument("files", nargs="+", help="module file, then its imports")
+    s.set_defaults(fn=cmd_deviations)
     args = ap.parse_args(argv)
     return args.fn(args)
 

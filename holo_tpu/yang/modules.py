@@ -26,6 +26,12 @@ def interfaces_module():
             _leaf("type", "enum", enum=("ethernet", "loopback", "vlan", "macvlan")),
             _leaf("enabled", "boolean", default=True),
             _leaf("mtu", "uint16", default=1500),
+            # 802.1Q subinterface config (reference holo-interface
+            # encapsulation/dot1q-vlan + parent-interface,
+            # northbound/configuration.rs:122-131): a "vlan"-typed
+            # interface with both leaves is created via netlink.
+            _leaf("parent-interface"),
+            _leaf("vlan-id", "uint16"),
             LeafList("address", "ifaddr"),  # host addr + prefix length
         ),
     )

@@ -79,3 +79,29 @@ def test_replay_cli(tmp_path, capsys):
     assert rc == 0
     assert "replayed" in out and "ROUTER" in out
     assert "10.0.0.0/30" in out  # route reproduced offline
+
+
+def test_deviations_generator(capsys):
+    """`deviations MODULE.yang` emits the holo-tools yang_deviations
+    skeleton: header, import with the module's own prefix, one
+    commented-out not-supported deviation per node, footer
+    (reference holo-tools/src/yang_deviations.rs)."""
+    import glob
+
+    from holo_tpu.tools.cli import main
+
+    mods = glob.glob(
+        "/root/reference/holo-yang/modules/ietf/ietf-key-chain*.yang"
+    )
+    if not mods:
+        import pytest
+
+        pytest.skip("reference YANG corpus unavailable")
+    rc = main(["deviations", mods[0]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.startswith("module holo-ietf-key-chain-deviations {")
+    assert "import ietf-key-chain {\n    prefix key-chain;" in out
+    assert 'deviation "/key-chain:key-chains/key-chain:key-chain"' in out
+    assert "deviate not-supported;" in out
+    assert out.rstrip().endswith("}")
