@@ -558,6 +558,7 @@ class RoutingProvider(Provider, Actor):
             # Key rotation: re-resolve AuthCtx for interfaces referencing
             # the changed keychain (in place — adjacencies re-key live).
             self._refresh_ospf_auth()
+            self._refresh_isis_auth()
             return
         if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
             # Interface removed from the system: down it in every protocol
@@ -777,20 +778,14 @@ class RoutingProvider(Provider, Actor):
             return None
         kc_name = auth_conf.get("key-chain")
         if kc_name:
-            from holo_tpu.utils.keychain import Keychain
-
-            kc = (
-                self.keychains.keychains.get(kc_name)
-                if self.keychains is not None
-                else None
-            )
-            if kc and kc.get("key"):
-                # Lifetime-based selection (keychain.rs:42-92): the
-                # active SEND key signs, received key ids validate
-                # against their ACCEPT lifetimes — rollover works.
+            # Lifetime-based selection (keychain.rs:42-92): the active
+            # SEND key signs, received key ids validate against their
+            # ACCEPT lifetimes — rollover works.
+            resolved = self._resolve_keychain(kc_name)
+            if resolved is not None:
                 return AuthCtx(
                     AuthType.CRYPTOGRAPHIC,
-                    keychain=Keychain.from_config(kc_name, kc),
+                    keychain=resolved,
                     clock=lambda: self.loop.clock.now(),
                 )
             return AuthCtx(AuthType.CRYPTOGRAPHIC, _os.urandom(16), key_id=0)
@@ -1009,7 +1004,12 @@ class RoutingProvider(Provider, Actor):
                 continue
             inst.add_interface(
                 ifname,
-                IsisIfConfig(metric=if_conf.get("metric", 10)),
+                IsisIfConfig(
+                    metric=if_conf.get("metric", 10),
+                    auth=self._isis_auth(
+                        if_conf.get("hello-authentication")
+                    ),
+                ),
                 st.addresses[0].ip,
                 st.addresses[0].network,
             )
@@ -1018,6 +1018,91 @@ class RoutingProvider(Provider, Actor):
                 inst.if_up(ifname)
             else:
                 self.loop.send(inst.name, IsisIfUpMsg(ifname))
+        # Authentication is change-driven on the RUNNING instance
+        # (reference configuration.rs:531-597 reacts to the config
+        # change): enabling/changing/removing auth applies immediately,
+        # not only at instance creation.
+        self._apply_isis_auth(inst, new)
+
+    def _resolve_keychain(self, name):
+        """Keychain object from the provider store, or None when the
+        reference is unknown/empty (callers FAIL CLOSED).  Shared by the
+        OSPF and IS-IS auth builders so keychain-resolution semantics
+        cannot drift between protocols."""
+        from holo_tpu.utils.keychain import Keychain
+
+        kc = (
+            self.keychains.keychains.get(name)
+            if self.keychains is not None
+            else None
+        )
+        if kc and kc.get("key"):
+            return Keychain.from_config(name, kc)
+        return None
+
+    def _isis_auth(self, auth_conf):
+        """AuthCtxIsis from IS-IS auth config: a key-chain reference
+        resolves keys by lifetime (utils/keychain.py), an inline key is
+        fixed (reference packet/auth.rs AuthMethod::{Keychain,ManualKey};
+        config surface configuration.rs:531-597).  Unknown key-chain
+        names FAIL CLOSED with a random key nobody shares."""
+        import os as _os
+
+        from holo_tpu.protocols.isis.packet import AuthCtxIsis
+
+        if not auth_conf:
+            return None
+        kc_name = auth_conf.get("key-chain")
+        if kc_name:
+            resolved = self._resolve_keychain(kc_name)
+            if resolved is not None:
+                return AuthCtxIsis(
+                    key=b"",
+                    keychain=resolved,
+                    clock=lambda: self.loop.clock.now(),
+                )
+            return AuthCtxIsis(key=_os.urandom(16))
+        key = auth_conf.get("key")
+        if not key:
+            return None
+        return AuthCtxIsis(
+            key=key.encode(),
+            # The RFC 5310 TLV carries a u16 key id: mask here so two
+            # identically-configured peers agree on the wire value.
+            key_id=auth_conf.get("key-id", 1) & 0xFFFF,
+            algo=auth_conf.get("crypto-algorithm", "hmac-md5"),
+        )
+
+    def _apply_isis_auth(self, inst, tree) -> None:
+        """(Re)apply instance + hello authentication from the isis
+        config subtree — change-driven, every commit AND on keychain
+        store updates (the OSPF _refresh_ospf_auth analog)."""
+        base = "routing/control-plane-protocols/isis"
+        auth = self._isis_auth(tree.get(f"{base}/authentication"))
+        subs = (
+            list(inst.instances())
+            if hasattr(inst, "instances") and callable(inst.instances)
+            else [inst]
+        )
+        for sub in subs:
+            sub.auth = auth
+        for ifname, if_conf in (tree.get(f"{base}/interface") or {}).items():
+            for sub in subs:
+                iface = sub.interfaces.get(ifname)
+                if iface is not None:
+                    iface.config.auth = self._isis_auth(
+                        if_conf.get("hello-authentication")
+                    )
+
+    def _refresh_isis_auth(self) -> None:
+        """Keychain store changed: re-resolve IS-IS auth contexts so the
+        instances see the NEW key set (not the snapshot taken at the
+        last config commit) — key rollover reaches IS-IS live."""
+        tree = getattr(self, "_last_tree", None)
+        inst = self.instances.get("isis")
+        if tree is None or inst is None:
+            return
+        self._apply_isis_auth(inst, tree)
 
     def _isis_routes_to_rib(self, routes):
         from holo_tpu.utils.southbound import Protocol

@@ -683,6 +683,18 @@ AUTH_CRYPTO = 3  # RFC 5310 generic cryptographic authentication
 _ISIS_HMACS = {"hmac-md5": ("md5", 16), "hmac-sha1": ("sha1", 20),
                "hmac-sha256": ("sha256", 32)}
 
+# ietf-key-chain crypto-algorithm identities use the OSPF-style names; a
+# keychain shared between protocols must resolve to the IS-IS TLV algos.
+_KEYCHAIN_ALGO = {
+    "md5": "hmac-md5",
+    "hmac-sha-1": "hmac-sha1",
+    "hmac-sha-256": "hmac-sha256",
+}
+
+
+def _isis_algo(name: str) -> str:
+    return _KEYCHAIN_ALGO.get(name, name)
+
 
 @dataclass
 class AuthCtxIsis:
@@ -722,7 +734,9 @@ class AuthCtxIsis:
         k = self.keychain.key_lookup_send(self._now())
         if k is None:
             return None
-        return AuthCtxIsis(key=k.string, algo=k.algo, key_id=k.id & 0xFFFF)
+        return AuthCtxIsis(
+            key=k.string, algo=_isis_algo(k.algo), key_id=k.id & 0xFFFF
+        )
 
     def for_accept(self, key_id: "int | None") -> "list[AuthCtxIsis]":
         """Resolved candidate contexts for verifying a received PDU.
@@ -743,10 +757,13 @@ class AuthCtxIsis:
             keys = [
                 k
                 for k in self.keychain.keys
-                if k.accept_lifetime.is_active(now) and k.algo == "hmac-md5"
+                if k.accept_lifetime.is_active(now)
+                and _isis_algo(k.algo) == "hmac-md5"
             ]
         return [
-            AuthCtxIsis(key=k.string, algo=k.algo, key_id=k.id & 0xFFFF)
+            AuthCtxIsis(
+                key=k.string, algo=_isis_algo(k.algo), key_id=k.id & 0xFFFF
+            )
             for k in keys
         ]
 

@@ -340,10 +340,29 @@ def routing_module():
               _leaf("level", "enum", enum=("level-1", "level-2", "level-all"),
                     default="level-all"),
               _spf_control(),
+              # Instance-level LSP/SNP authentication (reference
+              # holo-isis northbound configuration.rs:531-597: key-chain
+              # OR inline key + key-id + crypto-algorithm).
+              C("authentication",
+                _leaf("key-chain"),
+                _leaf("key"),
+                _leaf("key-id", "uint32", default=1),
+                _leaf("crypto-algorithm", "enum",
+                      enum=("hmac-md5", "hmac-sha1", "hmac-sha256"),
+                      default="hmac-md5")),
               L("interface", "name", _leaf("name"),
                 _leaf("interface-type", "enum",
                       enum=("broadcast", "point-to-point"), default="broadcast"),
-                _leaf("metric", "uint32", default=10))),
+                _leaf("metric", "uint32", default=10),
+                # Per-circuit hello authentication (reference
+                # configuration.rs hello_auth paths).
+                C("hello-authentication",
+                  _leaf("key-chain"),
+                  _leaf("key"),
+                  _leaf("key-id", "uint32", default=1),
+                  _leaf("crypto-algorithm", "enum",
+                        enum=("hmac-md5", "hmac-sha1", "hmac-sha256"),
+                        default="hmac-md5")))),
             _rip_subtree("ripv2"),
             _rip_subtree("ripng"),
             _bgp_subtree(),
