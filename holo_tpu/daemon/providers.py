@@ -417,6 +417,16 @@ class RoutingProvider(Provider, Actor):
         sid = new_tree.get("routing/control-plane-protocols/isis/system-id")
         if sid is not None and _parse_system_id(sid) is None:
             raise CommitError(f"invalid IS-IS system-id {sid!r}")
+        # RFC 2080: RIPng relies on IPsec, it has no in-protocol auth.
+        for ifname, if_conf in (
+            new_tree.get("routing/control-plane-protocols/ripng/interface")
+            or {}
+        ).items():
+            if if_conf.get("authentication"):
+                raise CommitError(
+                    f"ripng interface {ifname}: RIPng has no in-protocol "
+                    f"authentication (RFC 2080)"
+                )
         # Keychain references must resolve within the same candidate.
         chains = new_tree.get("key-chains/key-chain", {}) or {}
         areas = new_tree.get(
@@ -429,6 +439,42 @@ class RoutingProvider(Provider, Actor):
                     raise CommitError(
                         f"interface {ifname}: unknown key-chain {kc!r}"
                     )
+        # Same resolution check for EVERY key-chain consumer — a typo'd
+        # name must fail the commit, not silently run with the random
+        # fail-closed key.
+        isis_base = "routing/control-plane-protocols/isis"
+        kc_refs = [
+            (
+                "isis authentication",
+                (new_tree.get(f"{isis_base}/authentication") or {}).get(
+                    "key-chain"
+                ),
+            )
+        ]
+        for ifname, if_conf in (
+            new_tree.get(f"{isis_base}/interface") or {}
+        ).items():
+            kc_refs.append(
+                (
+                    f"isis interface {ifname} hello-authentication",
+                    (if_conf.get("hello-authentication") or {}).get(
+                        "key-chain"
+                    ),
+                )
+            )
+        for ifname, if_conf in (
+            new_tree.get("routing/control-plane-protocols/ripv2/interface")
+            or {}
+        ).items():
+            kc_refs.append(
+                (
+                    f"ripv2 interface {ifname}",
+                    (if_conf.get("authentication") or {}).get("key-chain"),
+                )
+            )
+        for where, kc in kc_refs:
+            if kc is not None and kc not in chains:
+                raise CommitError(f"{where}: unknown key-chain {kc!r}")
         # OSPFv3 authentication is IPsec-based (RFC 4552) and not yet
         # implemented; reject rather than silently run unauthenticated.
         v3_areas = new_tree.get(
@@ -559,6 +605,7 @@ class RoutingProvider(Provider, Actor):
             # the changed keychain (in place — adjacencies re-key live).
             self._refresh_ospf_auth()
             self._refresh_isis_auth()
+            self._refresh_rip_auth()
             return
         if isinstance(msg, IbusMsg) and msg.topic == TOPIC_INTERFACE_DEL:
             # Interface removed from the system: down it in every protocol
@@ -1247,13 +1294,20 @@ class RoutingProvider(Provider, Actor):
             for ifname, if_conf in wanted.items():
                 cost = if_conf.get("cost", 1)
                 split = if_conf.get("split-horizon", "poison-reverse")
+                akw = (
+                    {}
+                    if want_v6  # RFC 2080: RIPng has no in-protocol auth
+                    else self._rip_auth_kwargs(if_conf.get("authentication"))
+                )
                 cur = inst.interfaces.get(ifname)
                 if cur is not None:
                     # Live reconfiguration (reference configuration.rs
-                    # InterfaceCostUpdate): metrics recompute table-wide.
+                    # InterfaceCostUpdate): metrics recompute table-wide;
+                    # auth changes apply to the running circuit.
                     if cur[0].cost != cost:
                         inst.iface_cost_update(ifname, cost)
                     cur[0].split_horizon = split
+                    self._set_rip_auth(cur[0], akw)
                     continue
                 st = self.ifp.interfaces.get(ifname)
                 if st is None:
@@ -1267,13 +1321,67 @@ class RoutingProvider(Provider, Actor):
                 a = addrs[0]
                 inst.add_interface(
                     ifname,
-                    RipIfConfig(cost=cost, split_horizon=split),
+                    RipIfConfig(cost=cost, split_horizon=split, **akw),
                     a.ip,
                     a.network,
                 )
             for ifname in list(inst.interfaces):
                 if ifname not in wanted:
                     inst.remove_interface(ifname)
+
+    def _rip_auth_kwargs(self, auth_conf) -> dict:
+        """RipIfConfig auth fields from interface auth config (reference
+        holo-rip configuration.rs:309-339 key + crypto-algorithm; the
+        key-chain option adds lifetime-resolved keys).  Unknown chain
+        names FAIL CLOSED with a random key nobody shares."""
+        import os as _os
+
+        if not auth_conf:
+            return {}
+        kc_name = auth_conf.get("key-chain")
+        if kc_name:
+            resolved = self._resolve_keychain(kc_name)
+            if resolved is None:
+                return {"auth_key": _os.urandom(16)}
+            return {
+                "auth_keychain": resolved,
+                "auth_clock": lambda: self.loop.clock.now(),
+            }
+        key = auth_conf.get("key")
+        if not key:
+            return {}
+        if auth_conf.get("type", "md5") == "password":
+            return {"auth_password": key}
+        return {
+            # RFC 2082 carries a u8 key id on the wire.
+            "auth_key": key.encode(),
+            "auth_key_id": auth_conf.get("key-id", 1) & 0xFF,
+        }
+
+    def _set_rip_auth(self, cfg, akw: dict) -> None:
+        """Apply resolved auth kwargs onto a live RipIfConfig (absent
+        keys clear — removing auth config really removes auth)."""
+        cfg.auth_password = akw.get("auth_password")
+        cfg.auth_key = akw.get("auth_key")
+        cfg.auth_key_id = akw.get("auth_key_id", 1)
+        cfg.auth_keychain = akw.get("auth_keychain")
+        cfg.auth_clock = akw.get("auth_clock")
+
+    def _refresh_rip_auth(self) -> None:
+        """Keychain store changed: re-resolve keychain-backed RIP
+        circuits (the OSPF/IS-IS refresh analog)."""
+        tree = getattr(self, "_last_tree", None)
+        inst = self.instances.get("ripv2")
+        if tree is None or inst is None:
+            return
+        base = "routing/control-plane-protocols/ripv2"
+        for ifname, if_conf in (tree.get(f"{base}/interface") or {}).items():
+            cur = inst.interfaces.get(ifname)
+            auth_conf = if_conf.get("authentication")
+            if cur is not None and auth_conf and auth_conf.get("key-chain"):
+                self._set_rip_auth(
+                    cur[0], self._rip_auth_kwargs(auth_conf)
+                )
 
     def _apply_igmp(self, new):
         """IGMP querier lifecycle from config (reference: holo-igmp

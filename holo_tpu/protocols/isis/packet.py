@@ -681,14 +681,19 @@ AUTH_HMAC_MD5 = 54  # RFC 5304 authentication type
 AUTH_CRYPTO = 3  # RFC 5310 generic cryptographic authentication
 
 _ISIS_HMACS = {"hmac-md5": ("md5", 16), "hmac-sha1": ("sha1", 20),
-               "hmac-sha256": ("sha256", 32)}
+               "hmac-sha256": ("sha256", 32), "hmac-sha384": ("sha384", 48),
+               "hmac-sha512": ("sha512", 64)}
 
 # ietf-key-chain crypto-algorithm identities use the OSPF-style names; a
-# keychain shared between protocols must resolve to the IS-IS TLV algos.
+# keychain shared between protocols must resolve to the IS-IS TLV algos
+# (EVERY name the key-chain YANG enum allows must map, or a legal config
+# would KeyError at signing time).
 _KEYCHAIN_ALGO = {
     "md5": "hmac-md5",
     "hmac-sha-1": "hmac-sha1",
     "hmac-sha-256": "hmac-sha256",
+    "hmac-sha-384": "hmac-sha384",
+    "hmac-sha-512": "hmac-sha512",
 }
 
 

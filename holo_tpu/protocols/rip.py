@@ -95,7 +95,17 @@ class RipPacket:
         return w.finish()
 
     @classmethod
-    def decode(cls, data: bytes, auth_password: str | None = None, auth_key: bytes | None = None) -> "RipPacket":
+    def decode(
+        cls,
+        data: bytes,
+        auth_password: str | None = None,
+        auth_key: bytes | None = None,
+        auth_key_lookup=None,
+    ) -> "RipPacket":
+        """``auth_key_lookup`` (key_id -> key bytes | None) serves
+        keychain-backed interfaces: the wire key id selects the accept
+        key by lifetime (utils/keychain.py), so rollover works for RIP
+        MD5 the same way it does for OSPF/IS-IS."""
         r = Reader(data)
         try:
             cmd = RipCommand(r.u8())
@@ -108,7 +118,11 @@ class RipPacket:
         rtes = []
         import hashlib
 
-        authed = auth_password is None and auth_key is None
+        authed = (
+            auth_password is None
+            and auth_key is None
+            and auth_key_lookup is None
+        )
         first = True
         auth_len = len(data)
         while r.pos + 20 <= auth_len:
@@ -122,26 +136,39 @@ class RipPacket:
                         authed = True
                     elif auth_password is not None:
                         raise DecodeError("bad RIP password")
+                    else:
+                        # RFC 2453 §4.1: a router not configured for
+                        # (this type of) authentication discards
+                        # authenticated messages.
+                        raise DecodeError("unexpected authenticated packet")
                     first = False
                     continue
                 if first and atype == AUTH_CRYPTO:
                     pkt_len = r.u16()
-                    r.u8()  # key id
+                    key_id = r.u8()
                     r.u8()  # auth data length
                     r.u32()  # sequence number
                     r.u32()
                     r.u32()
-                    if auth_key is not None:
-                        want = hashlib.md5(
-                            data[:pkt_len + 4]
-                            + auth_key[:16].ljust(16, b"\x00")
-                        ).digest()
-                        got = data[pkt_len + 4 : pkt_len + 20]
-                        import hmac as _h
+                    key = auth_key
+                    if auth_key_lookup is not None:
+                        key = auth_key_lookup(key_id)
+                        if key is None:
+                            raise DecodeError("unknown RIP key id")
+                    if key is None:
+                        # RFC 2453 §4.1: not configured for MD5 auth —
+                        # discard rather than accept unverified.
+                        raise DecodeError("unexpected authenticated packet")
+                    want = hashlib.md5(
+                        data[:pkt_len + 4]
+                        + key[:16].ljust(16, b"\x00")
+                    ).digest()
+                    got = data[pkt_len + 4 : pkt_len + 20]
+                    import hmac as _h
 
-                        if not _h.compare_digest(want, got):
-                            raise DecodeError("bad RIP MD5 digest")
-                        authed = True
+                    if not _h.compare_digest(want, got):
+                        raise DecodeError("bad RIP MD5 digest")
+                    authed = True
                     auth_len = min(auth_len, pkt_len)
                     first = False
                     continue
@@ -238,7 +265,7 @@ class RipVersion:
 
     @staticmethod
     def encode(command, entries, auth=None) -> bytes:
-        pw, key, key_id, seqno = auth or (None, None, 1, 0)
+        pw, key, key_id, seqno = (auth or (None, None, 1, 0))[:4]
         return RipPacket(
             command,
             [Rte(prefix, IPv4Address(0), metric, tag)
@@ -249,8 +276,12 @@ class RipVersion:
 
     @staticmethod
     def decode(data: bytes, auth=None):
-        pw, key = (auth or (None, None, 1, 0))[:2]
-        pkt = RipPacket.decode(data, auth_password=pw, auth_key=key)
+        a = auth or (None, None, 1, 0)
+        pw, key = a[:2]
+        lookup = a[4] if len(a) > 4 else None
+        pkt = RipPacket.decode(
+            data, auth_password=pw, auth_key=key, auth_key_lookup=lookup
+        )
         return pkt.command, [
             (r.prefix, r.tag, r.metric, r.nexthop if int(r.nexthop) else None)
             for r in pkt.rtes
@@ -339,8 +370,45 @@ class RipIfConfig:
     auth_password: str | None = None
     auth_key: bytes | None = None
     auth_key_id: int = 1
+    # Lifetime-based key selection (utils/keychain.py, the OSPF/IS-IS
+    # semantics): the active SEND key signs, the wire key id selects the
+    # accept key by lifetime — rollover without packet loss.
+    auth_keychain: object = None
+    auth_clock: object = None
+
+    def _now(self) -> float:
+        import time as _time
+
+        return (
+            self.auth_clock() if callable(self.auth_clock) else _time.time()
+        )
 
     def auth_tuple(self, seqno: int = 0):
+        if self.auth_keychain is not None:
+            kc = self.auth_keychain
+
+            def lookup(key_id: int):
+                # The wire id is the u8 the sender masked to — compare
+                # masked so key ids >= 256 still authenticate.
+                now = self._now()
+                for k in kc.keys:
+                    if (k.id & 0xFF) == key_id and (
+                        k.accept_lifetime.is_active(now)
+                    ):
+                        return k.string
+                return None
+
+            k = kc.key_lookup_send(self._now())
+            # No active send key: tx goes unauthenticated (the peer's
+            # auth requirement rejects it — a visible coverage gap, not
+            # a forged-looking digest), rx still resolves by key id.
+            return (
+                None,
+                k.string if k is not None else None,
+                (k.id & 0xFF) if k is not None else 1,
+                seqno,
+                lookup,
+            )
         if self.auth_password is None and self.auth_key is None:
             return None
         return (self.auth_password, self.auth_key, self.auth_key_id, seqno)

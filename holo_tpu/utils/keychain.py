@@ -34,6 +34,10 @@ def _parse_time(val) -> float | None:
     if s in ("always", ""):
         return None
     try:
+        return float(s)  # epoch seconds (string-typed YANG leaves)
+    except ValueError:
+        pass
+    try:
         dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
     except ValueError as e:
         raise ValueError(f"invalid lifetime date-and-time {s!r}") from e

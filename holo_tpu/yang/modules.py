@@ -246,7 +246,17 @@ def _rip_subtree(name):
           _leaf("cost", "uint8", default=1),
           _leaf("split-horizon", "enum",
                 enum=("disabled", "simple", "poison-reverse"),
-                default="poison-reverse")),
+                default="poison-reverse"),
+          # ietf-rip per-interface authentication (reference holo-rip
+          # configuration.rs:309-339: key + crypto-algorithm); the
+          # key-chain option resolves keys by lifetime.  RIPng (RFC
+          # 2080) has no in-protocol auth — validate() rejects it there.
+          C("authentication",
+            _leaf("key"),
+            _leaf("key-id", "uint32", default=1),
+            _leaf("type", "enum", enum=("password", "md5"),
+                  default="md5"),
+            _leaf("key-chain"))),
     )
 
 

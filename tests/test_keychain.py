@@ -398,3 +398,128 @@ def test_isis_auth_live_reconfig_and_rollover():
     d.commit(cand)
     assert inst.auth.keychain is None
     assert inst.auth.key_id == 70000 & 0xFFFF
+
+
+def test_rip_keychain_rollover_zero_loss():
+    """Config-driven RIPv2 MD5 via a key-chain with lifetimes: two
+    daemons exchange authenticated updates across a send-key boundary
+    without losing routes (the wire key id selects the accept key)."""
+    import ipaddress
+
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="r1")
+    d2 = Daemon(loop=loop, netio=fabric, name="r2")
+    fabric.join("l", "r1.ripv2", "eth0", ipaddress.ip_address("10.0.40.1"))
+    fabric.join("l", "r2.ripv2", "eth0", ipaddress.ip_address("10.0.40.2"))
+    for d, addr, extra in [
+        (d1, "10.0.40.1/30", "192.0.2.0/24"),
+        (d2, "10.0.40.2/30", "198.51.100.0/24"),
+    ]:
+        cand = d.candidate()
+        kb = "key-chains/key-chain[rip-keys]"
+        cand.set(f"{kb}/key[1]/key-string", "one")
+        cand.set(f"{kb}/key[1]/send-lifetime/end-date-time", 60)
+        cand.set(f"{kb}/key[1]/accept-lifetime/end-date-time", 120)
+        cand.set(f"{kb}/key[2]/key-string", "two")
+        cand.set(f"{kb}/key[2]/send-lifetime/start-date-time", 60)
+        cand.set(f"{kb}/key[2]/accept-lifetime/start-date-time", 30)
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("interfaces/interface[lo0]/address", [extra])
+        base = "routing/control-plane-protocols/ripv2"
+        cand.set(f"{base}/update-interval", 5)
+        cand.set(f"{base}/interface[eth0]/cost", 1)
+        cand.set(f"{base}/interface[lo0]/cost", 1)
+        cand.set(f"{base}/interface[eth0]/authentication/key-chain",
+                 "rip-keys")
+        d.commit(cand)
+    loop.advance(30)
+    i1 = d1.routing.instances["ripv2"]
+    far = ipaddress.ip_network("198.51.100.0/24")
+    assert far in i1.routes, "authenticated route exchange failed"
+    loop.advance(80)  # cross the t=60 send boundary (key 1 -> key 2)
+    assert far in i1.routes, "route lost across RIP key rollover"
+    cfg = i1.interfaces["eth0"][0]
+    k = cfg.auth_keychain.key_lookup_send(loop.clock.now())
+    assert k is not None and k.id == 2  # signing with the new key now
+
+    # A third daemon with NO auth config never syncs with r1.
+    d3 = Daemon(loop=loop, netio=fabric, name="r3")
+    fabric.join("l", "r3.ripv2", "eth0", ipaddress.ip_address("10.0.40.3"))
+    cand = d3.candidate()
+    cand.set("interfaces/interface[eth0]/address", ["10.0.40.3/30"])
+    cand.set("routing/control-plane-protocols/ripv2/interface[eth0]/cost", 1)
+    d3.commit(cand)
+    loop.advance(30)
+    i3 = d3.routing.instances["ripv2"]
+    assert far not in i3.routes  # unauthenticated: r2's updates rejected
+
+    # RIPng rejects auth config outright (RFC 2080).
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ripng/interface[eth0]"
+        "/authentication/key", "x",
+    )
+    with _pytest.raises(Exception, match="RIPng has no in-protocol"):
+        d1.commit(cand)
+
+
+def test_keychain_reference_validation_symmetry():
+    """A typo'd key-chain reference is rejected at commit time for
+    EVERY consumer — IS-IS and RIP, not just OSPF (r5 review)."""
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, netio=MockFabric(loop), name="kv2")
+    for path in (
+        "routing/control-plane-protocols/isis/authentication/key-chain",
+        "routing/control-plane-protocols/isis/interface[e0]"
+        "/hello-authentication/key-chain",
+        "routing/control-plane-protocols/ripv2/interface[e0]"
+        "/authentication/key-chain",
+    ):
+        cand = d.candidate()
+        if "isis" in path:
+            cand.set(
+                "routing/control-plane-protocols/isis/system-id",
+                "0000.0000.0011",
+            )
+        cand.set(path, "no-such-chain")
+        with _pytest.raises(Exception, match="unknown key-chain"):
+            d.commit(cand)
+
+
+def test_isis_keychain_sha512():
+    """Every algorithm the key-chain enum allows signs IS-IS PDUs
+    (r5 review: sha-384/512 used to KeyError at encode time)."""
+    from holo_tpu.protocols.isis.packet import AuthCtxIsis
+
+    kc = Keychain("s", [Key(5, "hmac-sha-512", b"k512")])
+    auth = AuthCtxIsis(key=b"", keychain=kc, clock=lambda: 1.0)
+    eff = auth.for_send()
+    assert eff.algo == "hmac-sha512"
+    assert len(eff._hmac(b"payload")) == 64
+
+
+def test_rip_keychain_key_id_over_255():
+    """Keychain key ids above 255 still authenticate: the receiver
+    compares the masked wire id (r5 review)."""
+    from holo_tpu.protocols.rip import RipIfConfig, RipPacket, RipCommand
+
+    kc = Keychain("r", [Key(300, "md5", b"sekrit")])
+    cfg = RipIfConfig(auth_keychain=kc, auth_clock=lambda: 1.0)
+    pw, key, key_id, seqno, lookup = cfg.auth_tuple(7)
+    assert key == b"sekrit" and key_id == 300 & 0xFF
+    raw = RipPacket(RipCommand.RESPONSE, []).encode(
+        auth_key=key, auth_key_id=key_id, seqno=seqno
+    )
+    out = RipPacket.decode(raw, auth_key_lookup=lookup)
+    assert out.command == RipCommand.RESPONSE
