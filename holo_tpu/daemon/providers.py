@@ -475,18 +475,52 @@ class RoutingProvider(Provider, Actor):
         for where, kc in kc_refs:
             if kc is not None and kc not in chains:
                 raise CommitError(f"{where}: unknown key-chain {kc!r}")
-        # OSPFv3 authentication is IPsec-based (RFC 4552) and not yet
-        # implemented; reject rather than silently run unauthenticated.
+        # OSPFv3 authentication is the RFC 7166 trailer (HMAC family):
+        # v2-style simple/md5 types have no v3 encoding — reject them,
+        # and key-chain references must resolve.
         v3_areas = new_tree.get(
             "routing/control-plane-protocols/ospfv3/area", {}
         ) or {}
         for area_conf in v3_areas.values():
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
-                if if_conf.get("authentication"):
+                auth = if_conf.get("authentication") or {}
+                if auth.get("type") in ("simple", "md5"):
                     raise CommitError(
-                        f"ospfv3 interface {ifname}: authentication is not "
-                        "supported yet (RFC 4552 IPsec pending)"
+                        f"ospfv3 interface {ifname}: OSPFv3 uses the "
+                        f"RFC 7166 authentication trailer (key + "
+                        f"crypto-algorithm or key-chain), not v2-style "
+                        f"{auth['type']!r}"
                     )
+                kc = auth.get("key-chain")
+                if kc is not None and kc not in chains:
+                    raise CommitError(
+                        f"ospfv3 interface {ifname}: unknown key-chain "
+                        f"{kc!r}"
+                    )
+                if kc is not None:
+                    # Every key must carry an RFC 7166-capable algorithm
+                    # or its active window would be a silent auth outage
+                    # (resolve_send -> None -> unauthenticated sends).
+                    from holo_tpu.protocols.ospf.packet_v3 import (
+                        _AT_KEYCHAIN_ALGO,
+                    )
+
+                    bad = [
+                        kid
+                        for kid, kconf in (
+                            chains[kc].get("key") or {}
+                        ).items()
+                        if _AT_KEYCHAIN_ALGO.get(
+                            kconf.get("crypto-algorithm", "md5")
+                        )
+                        is None
+                    ]
+                    if bad:
+                        raise CommitError(
+                            f"ospfv3 interface {ifname}: key-chain {kc!r} "
+                            f"key(s) {bad} have no RFC 7166 algorithm "
+                            f"(md5 is not valid for OSPFv3)"
+                        )
         if new_tree.get("routing/control-plane-protocols/ospfv3/redistribute"):
             raise CommitError(
                 "ospfv3 redistribution is not supported yet"
@@ -604,6 +638,7 @@ class RoutingProvider(Provider, Actor):
             # Key rotation: re-resolve AuthCtx for interfaces referencing
             # the changed keychain (in place — adjacencies re-key live).
             self._refresh_ospf_auth()
+            self._refresh_ospfv3_auth()
             self._refresh_isis_auth()
             self._refresh_rip_auth()
             return
@@ -904,11 +939,67 @@ class RoutingProvider(Provider, Actor):
                         cost=if_conf.get("cost", 10),
                         hello_interval=if_conf.get("hello-interval", 10),
                         dead_interval=if_conf.get("dead-interval", 40),
+                        auth=self._ospfv3_auth(
+                            if_conf.get("authentication")
+                        ),
                     ),
                     link_local,
                     prefixes,
                 )
                 self.loop.send(inst.name, V3IfUpMsg(ifname))
+        # Auth is change-driven on running circuits too.
+        self._refresh_ospfv3_auth(new)
+
+    def _ospfv3_auth(self, auth_conf):
+        """RFC 7166 authentication-trailer context from interface config
+        (reference configuration.rs ospfv3_key_chain + sa paths): a
+        key-chain resolves by lifetime with the SA id as the key id; an
+        inline key uses sa-id + crypto-algorithm.  Unknown chain names
+        FAIL CLOSED with a random key nobody shares."""
+        import os as _os
+
+        from holo_tpu.protocols.ospf.packet_v3 import AuthCtxV3
+
+        if not auth_conf:
+            return None
+        kc_name = auth_conf.get("key-chain")
+        if kc_name:
+            resolved = self._resolve_keychain(kc_name)
+            if resolved is not None:
+                return AuthCtxV3(
+                    key=b"",
+                    keychain=resolved,
+                    clock=lambda: self.loop.clock.now(),
+                )
+            return AuthCtxV3(key=_os.urandom(16))
+        key = auth_conf.get("key")
+        if not key:
+            return None
+        return AuthCtxV3(
+            key=key.encode(),
+            sa_id=auth_conf.get("sa-id", 1) & 0xFFFF,
+            algo=auth_conf.get("crypto-algorithm", "sha256"),
+        )
+
+    def _refresh_ospfv3_auth(self, tree=None) -> None:
+        """(Re)apply v3 circuit auth — change-driven per commit AND on
+        keychain store updates (the _refresh_ospf_auth analog)."""
+        tree = tree if tree is not None else getattr(self, "_last_tree", None)
+        inst = self.instances.get("ospfv3")
+        if tree is None or inst is None:
+            return
+        areas = tree.get(
+            "routing/control-plane-protocols/ospfv3/area", {}
+        ) or {}
+        for area_conf in areas.values():
+            for ifname, if_conf in (
+                area_conf.get("interface") or {}
+            ).items():
+                iface = inst.interfaces.get(ifname)
+                if iface is not None:
+                    iface.config.auth = self._ospfv3_auth(
+                        if_conf.get("authentication")
+                    )
 
     def _sink_routes(self, protocol, items: dict) -> None:
         """Shared delta route sink: items = {prefix: (metric, {(if, addr)})}.

@@ -756,7 +756,8 @@ class AuthCtxIsis:
             return [self]
         now = self._now()
         if key_id is not None:
-            k = self.keychain.key_lookup_accept(key_id, now)
+            # Masked compare: RFC 5310 carries a u16 id, for_send masks.
+            k = self.keychain.key_lookup_accept(key_id, now, mask=0xFFFF)
             keys = [k] if k is not None else []
         else:
             keys = [

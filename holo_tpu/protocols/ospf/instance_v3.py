@@ -2142,6 +2142,11 @@ class OspfV3Instance(Actor):
                        instance_id=iface.config.instance_id)
         auth = iface.config.auth
         if auth is not None:
+            # One keychain consultation per packet: SA id and digest
+            # must come from the same key (resolve_send; no active key
+            # sends unauthenticated, like the v2/IS-IS paths).
+            auth = auth.resolve_send()
+        if auth is not None:
             self._at_seqno += 1
             if self._nvstore is not None and self._at_seqno >= self._at_reserved:
                 self._reserve_at_seqnos()

@@ -875,7 +875,9 @@ class AuthCtx:
             if key_id != self.key_id:
                 return None
             return self.key, self.algo
-        k = self.keychain.key_lookup_accept(key_id, self._now())
+        # Masked compare: the OSPFv2 key id is u8 on the wire and
+        # tx_key_id masks — the accept side must match the same way.
+        k = self.keychain.key_lookup_accept(key_id, self._now(), mask=0xFF)
         if k is None:
             return None
         return k.string, k.algo

@@ -667,18 +667,79 @@ class Packet:
         return cls(router_id, area_id, body, instance_id, auth_seqno=seqno)
 
 
-_AT_HMACS = {"sha256": ("sha256", 32), "sha384": ("sha384", 48), "sha1": ("sha1", 20)}
+_AT_HMACS = {"sha256": ("sha256", 32), "sha384": ("sha384", 48),
+             "sha1": ("sha1", 20), "sha512": ("sha512", 64)}
 AT_TYPE_HMAC = 1  # RFC 7166 §2.1 authentication type
+
+# ietf-key-chain crypto-algorithm identities -> RFC 7166 HMAC names.
+# MD5 has no RFC 7166 authentication type: md5 keys resolve to None, and
+# commit validation rejects chains containing them for OSPFv3 use
+# (providers.py validate) so the gap can never be configured silently.
+_AT_KEYCHAIN_ALGO = {
+    "hmac-sha-1": "sha1",
+    "hmac-sha-256": "sha256",
+    "hmac-sha-384": "sha384",
+    "hmac-sha-512": "sha512",
+    "sha1": "sha1",
+    "sha256": "sha256",
+    "sha384": "sha384",
+    "sha512": "sha512",
+}
 
 
 @dataclass
 class AuthCtxV3:
-    """RFC 7166 authentication-trailer context (HMAC family)."""
+    """RFC 7166 authentication-trailer context (HMAC family).
+
+    With a ``keychain`` (reference ospfv3/packet/mod.rs:860-876
+    AuthMethod::Keychain over holo-utils keychain.rs), the SA id on the
+    wire IS the key id: sending resolves the active send key once per
+    packet, verification looks the received SA id up against accept
+    lifetimes — key rollover without packet loss."""
 
     key: bytes
     sa_id: int = 1
     algo: str = "sha256"
     seqno: int = 0  # 64-bit, monotonic per sender
+    keychain: object = None  # utils.keychain.Keychain
+    clock: object = None
+
+    def _now(self) -> float:
+        if callable(self.clock):
+            return self.clock()
+        import time as _time
+
+        return _time.time()
+
+    def resolve_send(self) -> "AuthCtxV3 | None":
+        """Fixed-key context for ONE outgoing packet (SA id, digest
+        length, and digest must agree).  None when the keychain has no
+        usable active send key: the packet goes out unauthenticated and
+        the peer's auth requirement rejects it (a visible coverage gap,
+        like the v2/IS-IS paths)."""
+        if self.keychain is None:
+            return self
+        k = self.keychain.key_lookup_send(self._now())
+        if k is None:
+            return None
+        algo = _AT_KEYCHAIN_ALGO.get(k.algo)
+        if algo is None:
+            return None  # md5 etc.: not valid for RFC 7166
+        return AuthCtxV3(
+            key=k.string, sa_id=k.id & 0xFFFF, algo=algo, seqno=self.seqno
+        )
+
+    def _resolve_accept(self, sa_id: int) -> "AuthCtxV3 | None":
+        if self.keychain is None:
+            return self if sa_id == self.sa_id else None
+        # Masked compare: the SA field is u16 and resolve_send masks.
+        k = self.keychain.key_lookup_accept(sa_id, self._now(), mask=0xFFFF)
+        if k is None:
+            return None
+        algo = _AT_KEYCHAIN_ALGO.get(k.algo)
+        if algo is None:
+            return None
+        return AuthCtxV3(key=k.string, sa_id=sa_id, algo=algo)
 
     def _digest(self, pkt: bytes, preamble: bytes) -> bytes:
         import hashlib
@@ -696,20 +757,24 @@ class AuthCtxV3:
 
     def verify(self, pkt: bytes, trailer: bytes) -> int:
         """Returns the trailer's sequence number; raises on any failure
-        (missing trailer, wrong SA, bad digest)."""
+        (missing trailer, unknown SA, bad digest).  The received SA id
+        selects the accept key (keychain-aware)."""
         import hmac as _hmac
 
-        name, dlen = _AT_HMACS[self.algo]
-        if len(trailer) < 16 + dlen:
+        if len(trailer) < 16:
             raise DecodeError("authentication trailer missing/short")
         at_type, at_len, _res, sa_id, seqno = struct.unpack(
             ">HHHHQ", trailer[:16]
         )
+        eff = self._resolve_accept(sa_id)
+        if eff is None:
+            raise DecodeError("unknown authentication SA")
+        name, dlen = _AT_HMACS[eff.algo]
+        if len(trailer) < 16 + dlen:
+            raise DecodeError("authentication trailer missing/short")
         if at_type != AT_TYPE_HMAC or at_len != 16 + dlen:
             raise DecodeError("bad authentication trailer parameters")
-        if sa_id != self.sa_id:
-            raise DecodeError("unknown authentication SA")
-        want = self._digest(pkt, trailer[:16])
+        want = eff._digest(pkt, trailer[:16])
         if not _hmac.compare_digest(want, trailer[16 : 16 + dlen]):
             raise DecodeError("authentication digest mismatch")
         return seqno

@@ -49,6 +49,10 @@ AUTH_CRYPTO = 3  # RFC 2082/4822 keyed digest
 class RipPacket:
     command: RipCommand
     rtes: list[Rte] = field(default_factory=list)
+    # RFC 2082 sequence number of a crypto-authenticated packet (None
+    # for unauthenticated/simple-password packets) — the receiver's
+    # replay check compares it per source.
+    auth_seqno: int | None = None
 
     def encode(self, auth_password: str | None = None, auth_key: bytes | None = None, auth_key_id: int = 1, seqno: int = 0) -> bytes:
         """RFC 2453 §4.1 / RFC 2082: with ``auth_password`` the first
@@ -123,6 +127,7 @@ class RipPacket:
             and auth_key is None
             and auth_key_lookup is None
         )
+        auth_seqno = None
         first = True
         auth_len = len(data)
         while r.pos + 20 <= auth_len:
@@ -147,7 +152,7 @@ class RipPacket:
                     pkt_len = r.u16()
                     key_id = r.u8()
                     r.u8()  # auth data length
-                    r.u32()  # sequence number
+                    rx_seqno = r.u32()
                     r.u32()
                     r.u32()
                     key = auth_key
@@ -169,6 +174,7 @@ class RipPacket:
                     if not _h.compare_digest(want, got):
                         raise DecodeError("bad RIP MD5 digest")
                     authed = True
+                    auth_seqno = rx_seqno
                     auth_len = min(auth_len, pkt_len)
                     first = False
                     continue
@@ -202,7 +208,7 @@ class RipPacket:
             rtes.append(Rte(prefix, nh, metric, tag))
         if not authed:
             raise DecodeError("RIP authentication required")
-        return cls(cmd, rtes)
+        return cls(cmd, rtes, auth_seqno=auth_seqno)
 
 
 @dataclass
@@ -285,7 +291,7 @@ class RipVersion:
         return pkt.command, [
             (r.prefix, r.tag, r.metric, r.nexthop if int(r.nexthop) else None)
             for r in pkt.rtes
-        ]
+        ], pkt.auth_seqno
 
     @staticmethod
     def encode_request_all() -> bytes:
@@ -320,7 +326,7 @@ class RipngVersion:
                 out.append((None, tag, metric, None))
             else:
                 out.append((prefix, tag, metric, nh))
-        return pkt.command, out
+        return pkt.command, out, None
 
     @staticmethod
     def encode_request_all() -> bytes:
@@ -383,21 +389,28 @@ class RipIfConfig:
             self.auth_clock() if callable(self.auth_clock) else _time.time()
         )
 
+    def _accept_lookup(self):
+        """key_id -> key bytes | None: the RFC 2082 u8 wire id selects
+        the accept key by lifetime (masked compare in the keychain)."""
+        kc = self.auth_keychain
+
+        def lookup(key_id: int):
+            k = kc.key_lookup_accept(key_id, self._now(), mask=0xFF)
+            return k.string if k is not None else None
+
+        return lookup
+
+    def rx_auth_tuple(self):
+        """Accept-side context only — decode never needs the send key,
+        so the per-packet send-lifetime scan is skipped."""
+        if self.auth_keychain is not None:
+            return (None, None, 1, 0, self._accept_lookup())
+        return self.auth_tuple()
+
     def auth_tuple(self, seqno: int = 0):
         if self.auth_keychain is not None:
             kc = self.auth_keychain
-
-            def lookup(key_id: int):
-                # The wire id is the u8 the sender masked to — compare
-                # masked so key ids >= 256 still authenticate.
-                now = self._now()
-                for k in kc.keys:
-                    if (k.id & 0xFF) == key_id and (
-                        k.accept_lifetime.is_active(now)
-                    ):
-                        return k.string
-                return None
-
+            lookup = self._accept_lookup()
             k = kc.key_lookup_send(self._now())
             # No active send key: tx goes unauthenticated (the peer's
             # auth requirement rejects it — a visible coverage gap, not
@@ -445,6 +458,8 @@ class RipInstance(Actor):
         self.static_neighbors: set = set()
         self.distance = 120
         self._seqno = 0  # RFC 4822 auth sequence number
+        # RFC 2082 §3.2.2 replay floor per (ifname, source).
+        self._rx_auth_seqnos: dict = {}
         # Triggered-update machinery (RFC 2453 §3.10.1, reference
         # events.rs:361-394): suppressed before the initial update;
         # rate-limited by the holdoff window afterwards.
@@ -561,11 +576,20 @@ class RipInstance(Actor):
         if msg.src == our_addr:
             return
         try:
-            command, entries = self.V.decode(
-                msg.data, auth=cfg.auth_tuple()
+            command, entries, auth_seqno = self.V.decode(
+                msg.data, auth=cfg.rx_auth_tuple()
             )
         except DecodeError:
             return
+        if auth_seqno is not None:
+            # RFC 2082 §3.2.2 replay protection: a crypto-authenticated
+            # packet whose sequence number is LOWER than the last one
+            # accepted from this source is a replay — discard.
+            key = (msg.ifname, msg.src)
+            last = self._rx_auth_seqnos.get(key)
+            if last is not None and auth_seqno < last:
+                return
+            self._rx_auth_seqnos[key] = auth_seqno
         now = self.loop.clock.now()
         if command == RipCommand.REQUEST:
             self._rx_request(msg, entries)

@@ -59,7 +59,7 @@ class _TxCapture(NetIo):
 
 def _pdu_to_json(version, data: bytes) -> dict:
     """Our wire bytes -> the reference's serde shape."""
-    command, entries = version.decode(data)
+    command, entries, _seqno = version.decode(data)
     rtes = []
     for prefix, tag, metric, nh in entries:
         if prefix is None:

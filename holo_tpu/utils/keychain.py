@@ -89,12 +89,19 @@ class Keychain:
                 return key
         return None
 
-    def key_lookup_accept(self, key_id: int, now: float) -> Key | None:
-        """The key with this id, iff its accept lifetime is active
-        (keychain.rs:84-92)."""
+    def key_lookup_accept(
+        self, key_id: int, now: float, mask: int | None = None
+    ) -> Key | None:
+        """The accept-active key matching this id (keychain.rs:84-92).
+
+        ``mask`` compares MASKED ids: protocols carry narrower id fields
+        on the wire (RIP u8, OSPFv3/IS-IS u16) and the sender masks at
+        encode time — the accept side must compare the same way or key
+        ids above the field width never authenticate."""
         for key in self.keys:
-            if key.id == key_id:
-                return key if key.accept_lifetime.is_active(now) else None
+            kid = key.id if mask is None else key.id & mask
+            if kid == key_id and key.accept_lifetime.is_active(now):
+                return key
         return None
 
     def key_lookup_accept_any(self, now: float) -> Key | None:

@@ -229,6 +229,13 @@ def _ospf_subtree(name):
                     _leaf("type", "enum",
                           enum=("none", "simple", "md5"), default="none"),
                     _leaf("key"),
+                    # OSPFv3 (RFC 7166) inline-key parameters: the SA id
+                    # carried in the authentication trailer + HMAC
+                    # algorithm.  Ignored by OSPFv2.
+                    _leaf("sa-id", "uint16", default=1),
+                    _leaf("crypto-algorithm", "enum",
+                          enum=("sha1", "sha256", "sha384", "sha512"),
+                          default="sha256"),
                 ),
             ),
         ),

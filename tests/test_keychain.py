@@ -523,3 +523,135 @@ def test_rip_keychain_key_id_over_255():
     )
     out = RipPacket.decode(raw, auth_key_lookup=lookup)
     assert out.command == RipCommand.RESPONSE
+
+
+def test_ospfv3_keychain_rollover_zero_loss():
+    """Config-driven OSPFv3 RFC 7166 auth via a key-chain: the trailer
+    SA id is the key id, rollover crosses a send boundary (including an
+    algorithm change) with the adjacency intact (reference
+    ospfv3/packet/mod.rs:860-876 AuthMethod::Keychain)."""
+    import ipaddress
+
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="v1")
+    d2 = Daemon(loop=loop, netio=fabric, name="v2")
+    fabric.join("l", "v1.ospfv3", "eth0", ipaddress.ip_address("fe80::1"))
+    fabric.join("l", "v2.ospfv3", "eth0", ipaddress.ip_address("fe80::2"))
+    for d, rid, ll, pfx in [
+        (d1, "1.1.1.1", "fe80::1/64", "2001:db8:1::1/64"),
+        (d2, "2.2.2.2", "fe80::2/64", "2001:db8:2::1/64"),
+    ]:
+        cand = d.candidate()
+        kb = "key-chains/key-chain[v3-keys]"
+        cand.set(f"{kb}/key[1]/key-string", "one")
+        cand.set(f"{kb}/key[1]/crypto-algorithm", "hmac-sha-256")
+        cand.set(f"{kb}/key[1]/send-lifetime/end-date-time", 90)
+        cand.set(f"{kb}/key[1]/accept-lifetime/end-date-time", 150)
+        cand.set(f"{kb}/key[2]/key-string", "two")
+        cand.set(f"{kb}/key[2]/crypto-algorithm", "hmac-sha-512")
+        cand.set(f"{kb}/key[2]/send-lifetime/start-date-time", 90)
+        cand.set(f"{kb}/key[2]/accept-lifetime/start-date-time", 45)
+        cand.set("interfaces/interface[eth0]/address", [ll, pfx])
+        cand.set("routing/control-plane-protocols/ospfv3/router-id", rid)
+        base = (
+            "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+            "/interface[eth0]"
+        )
+        cand.set(f"{base}/cost", 4)
+        cand.set(f"{base}/hello-interval", 2)
+        cand.set(f"{base}/dead-interval", 8)
+        cand.set(f"{base}/authentication/key-chain", "v3-keys")
+        d.commit(cand)
+    loop.advance(40)
+    from ipaddress import IPv6Network as N6
+
+    far = N6("2001:db8:2::/64")
+    assert far in d1.routing.rib.active_routes(), "v3 auth exchange failed"
+    inst = d1.routing.instances["ospfv3"]
+    auth = inst.interfaces["eth0"].config.auth
+    assert auth is not None and auth.keychain is not None
+    assert auth.resolve_send().sa_id == 1
+    loop.advance(120)  # cross t=90: key/algo roll to sha-512 key 2
+    assert far in d1.routing.rib.active_routes(), "route lost in rollover"
+    nbrs = inst.interfaces["eth0"].neighbors
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+
+    assert any(n.state == NsmState.FULL for n in nbrs.values())
+    assert auth.resolve_send().sa_id == 2
+
+    # v2-style auth types are rejected for v3 at commit time.
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth0]/authentication/type", "md5",
+    )
+    with _pytest.raises(Exception, match="RFC 7166"):
+        d1.commit(cand)
+
+
+def test_rip_md5_replay_rejected():
+    """RFC 2082 §3.2.2: a captured authenticated RESPONSE replayed
+    after newer packets were accepted is discarded (r5 review)."""
+    from ipaddress import IPv4Address as A4
+    from ipaddress import IPv4Network as N4
+
+    from holo_tpu.protocols.rip import (
+        RipIfConfig, RipInstance, RipPacket, RipCommand, Rte,
+    )
+    from holo_tpu.utils.netio import MockFabric, NetRxPacket
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    inst = RipInstance("rp", netio=fabric.sender_for("rp"))
+    loop.register(inst)
+    inst.add_interface(
+        "e0", RipIfConfig(auth_key=b"k", auth_key_id=1),
+        A4("10.0.50.1"), N4("10.0.50.0/24"),
+    )
+    src = A4("10.0.50.2")
+
+    def adv(prefix, metric, seqno):
+        raw = RipPacket(
+            RipCommand.RESPONSE, [Rte(N4(prefix), A4("0.0.0.0"), metric)]
+        ).encode(auth_key=b"k", auth_key_id=1, seqno=seqno)
+        loop.send("rp", NetRxPacket("e0", src, A4("224.0.0.9"), raw))
+        loop.advance(1)
+
+    captured = N4("203.0.113.0/24")
+    adv("203.0.113.0/24", 1, seqno=5)
+    assert captured in inst.routes
+    # Route withdrawn with a NEWER seqno...
+    adv("203.0.113.0/24", 16, seqno=6)
+    assert inst.routes[captured].metric == 16  # poisoned
+    # ...then the old packet is replayed: it must NOT resurrect it.
+    adv("203.0.113.0/24", 1, seqno=5)
+    assert inst.routes[captured].metric == 16, "replayed packet accepted"
+
+
+def test_ospfv3_rejects_md5_keychain():
+    """A chain containing md5 keys (incl. the crypto-algorithm default)
+    cannot be referenced by an OSPFv3 interface — commit rejected
+    (r5 review: used to commit silently and run unauthenticated)."""
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, netio=MockFabric(loop), name="vm")
+    cand = d.candidate()
+    cand.set("key-chains/key-chain[m]/key[1]/key-string", "x")  # md5 default
+    cand.set("interfaces/interface[eth0]/address", ["fe80::9/64"])
+    cand.set("routing/control-plane-protocols/ospfv3/router-id", "9.9.9.9")
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth0]/authentication/key-chain", "m",
+    )
+    with _pytest.raises(Exception, match="no RFC 7166 algorithm"):
+        d.commit(cand)
