@@ -498,6 +498,11 @@ class RoutingProvider(Provider, Actor):
                         f"{kc!r}"
                     )
                 if kc is not None:
+                    if not (chains[kc].get("key") or {}):
+                        raise CommitError(
+                            f"ospfv3 interface {ifname}: key-chain {kc!r} "
+                            f"has no keys"
+                        )
                     # Every key must carry an RFC 7166-capable algorithm
                     # or its active window would be a silent auth outage
                     # (resolve_send -> None -> unauthenticated sends).
@@ -2121,8 +2126,20 @@ class RoutingProvider(Provider, Actor):
                     )
             except Exception:  # noqa: BLE001 — ad-hoc state must survive
                 log.exception("ietf-isis state render failed")
+            isis_subs = (
+                list(isis.instances())
+                if hasattr(isis, "instances") and callable(isis.instances)
+                else [isis]
+            )
             state["routing"]["isis"] = {
                 "spf-run-count": isis.spf_run_count,
+                # SPF run log ring (reference state.rs spf_log events):
+                # records the Full-vs-RouteOnly classification per run.
+                "spf-log": [
+                    {"level": sub.level} | dict(e)
+                    for sub in isis_subs
+                    for e in getattr(sub, "spf_log", [])
+                ],
                 "lsdb-count": len(isis.lsdb),
                 "database": [
                     {

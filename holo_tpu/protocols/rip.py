@@ -694,6 +694,11 @@ class RipInstance(Actor):
 
     def nbr_timeout(self, addr) -> None:
         self.neighbors.pop(addr, None)
+        # Drop the RFC 2082 replay floor with the neighbor: a restarted
+        # peer resumes its sequence counter near zero, and a stale floor
+        # would blackhole it forever.
+        for key in [k for k in self._rx_auth_seqnos if k[1] == addr]:
+            del self._rx_auth_seqnos[key]
 
     def route_timeout(self, prefix) -> None:
         route = self.routes.get(prefix)

@@ -655,3 +655,66 @@ def test_ospfv3_rejects_md5_keychain():
     )
     with _pytest.raises(Exception, match="no RFC 7166 algorithm"):
         d.commit(cand)
+
+
+def test_rip_replay_floor_resets_on_neighbor_timeout():
+    """A restarted peer (auth seqno back near zero) recovers once its
+    neighbor entry times out — the replay floor must not outlive the
+    neighbor (r5 review)."""
+    from ipaddress import IPv4Address as A4
+    from ipaddress import IPv4Network as N4
+
+    from holo_tpu.protocols.rip import (
+        RipCommand, RipIfConfig, RipInstance, RipPacket, Rte,
+    )
+    from holo_tpu.utils.netio import MockFabric, NetRxPacket
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    inst = RipInstance("rf", netio=fabric.sender_for("rf"))
+    loop.register(inst)
+    inst.add_interface(
+        "e0", RipIfConfig(auth_key=b"k", auth_key_id=1),
+        A4("10.0.51.1"), N4("10.0.51.0/24"),
+    )
+    src = A4("10.0.51.2")
+
+    def adv(metric, seqno):
+        raw = RipPacket(
+            RipCommand.RESPONSE,
+            [Rte(N4("203.0.113.0/24"), A4("0.0.0.0"), metric)],
+        ).encode(auth_key=b"k", auth_key_id=1, seqno=seqno)
+        loop.send("rf", NetRxPacket("e0", src, A4("224.0.0.9"), raw))
+        loop.advance(1)
+
+    adv(1, seqno=500)
+    assert N4("203.0.113.0/24") in inst.routes
+    # Peer "reboots": low seqno rejected while the floor stands...
+    adv(2, seqno=3)
+    assert inst.routes[N4("203.0.113.0/24")].metric == 2  # cost 1 + 1
+    # metric unchanged means rejected; verify via the floor directly:
+    assert inst._rx_auth_seqnos[("e0", src)] == 500
+    inst.nbr_timeout(src)
+    assert ("e0", src) not in inst._rx_auth_seqnos
+    adv(4, seqno=3)  # now accepted
+    assert inst.routes[N4("203.0.113.0/24")].metric == 5
+
+
+def test_ospfv3_rejects_empty_keychain():
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, netio=MockFabric(loop), name="ve")
+    cand = d.candidate()
+    cand.set("key-chains/key-chain[empty]/name", "empty")
+    cand.set("interfaces/interface[eth0]/address", ["fe80::8/64"])
+    cand.set("routing/control-plane-protocols/ospfv3/router-id", "8.8.8.8")
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth0]/authentication/key-chain", "empty",
+    )
+    with _pytest.raises(Exception, match="has no keys"):
+        d.commit(cand)
