@@ -435,9 +435,15 @@ class RoutingProvider(Provider, Actor):
         for area_conf in areas.values():
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 kc = (if_conf.get("authentication") or {}).get("key-chain")
-                if kc is not None and kc not in chains:
+                if kc is None:
+                    continue
+                if kc not in chains:
                     raise CommitError(
                         f"interface {ifname}: unknown key-chain {kc!r}"
+                    )
+                if not (chains[kc].get("key") or {}):
+                    raise CommitError(
+                        f"interface {ifname}: key-chain {kc!r} has no keys"
                     )
         # Same resolution check for EVERY key-chain consumer — a typo'd
         # name must fail the commit, not silently run with the random
@@ -473,8 +479,14 @@ class RoutingProvider(Provider, Actor):
                 )
             )
         for where, kc in kc_refs:
-            if kc is not None and kc not in chains:
+            if kc is None:
+                continue
+            if kc not in chains:
                 raise CommitError(f"{where}: unknown key-chain {kc!r}")
+            if not (chains[kc].get("key") or {}):
+                # An empty chain resolves to the fail-closed random key
+                # — a silent auth outage nobody asked for.
+                raise CommitError(f"{where}: key-chain {kc!r} has no keys")
         # OSPFv3 authentication is the RFC 7166 trailer (HMAC family):
         # v2-style simple/md5 types have no v3 encoding — reject them,
         # and key-chain references must resolve.
@@ -2138,7 +2150,9 @@ class RoutingProvider(Provider, Actor):
                 "spf-log": [
                     {"level": sub.level} | dict(e)
                     for sub in isis_subs
-                    for e in getattr(sub, "spf_log", [])
+                    # list() snapshot: the instance thread appends/trims
+                    # the ring while this management-side render runs.
+                    for e in list(getattr(sub, "spf_log", []))
                 ],
                 "lsdb-count": len(isis.lsdb),
                 "database": [

@@ -230,6 +230,43 @@ def targets() -> dict:
         # igmp (no reference counterpart — ours has a kernel-facing decoder)
         "igmp_packet_decode": igmp.IgmpPacket.decode,
     }
+
+    # Authenticated decode paths (r5): the auth framing (trailer
+    # lengths, key ids, digests, LLS CA TLVs) is attacker-controlled
+    # parsing that the unauthenticated targets never reach.
+    from holo_tpu.utils.keychain import Key, Keychain
+
+    _kc = Keychain("fuzz", [Key(1, "md5", b"fuzz-key"),
+                            Key(2, "hmac-sha-256", b"fuzz-key-2")])
+    _ospf_auth = ospf_pkt.AuthCtx(
+        ospf_pkt.AuthType.CRYPTOGRAPHIC, keychain=_kc, clock=lambda: 1.0
+    )
+    _v3_auth = v3.AuthCtxV3(key=b"", keychain=_kc, clock=lambda: 1.0)
+    _isis_auth = isis_pkt.AuthCtxIsis(
+        key=b"", keychain=_kc, clock=lambda: 1.0
+    )
+
+    def _rip_lookup(key_id):
+        k = _kc.key_lookup_accept(key_id, 1.0, mask=0xFF)
+        return k.string if k is not None else None
+
+    def _isis_auth_verify(data):
+        t, pdu = isis_pkt.decode_pdu(data)
+        tlvs = getattr(pdu, "tlvs", None)
+        if isinstance(tlvs, dict):
+            isis_pkt.verify_pdu_auth(data, tlvs, _isis_auth)
+        return pdu
+
+    out |= {
+        "ospfv2_packet_decode_auth": lambda b: ospf_pkt.Packet.decode(
+            b, auth=_ospf_auth
+        ),
+        "ospfv3_at_verify": lambda b: _v3_auth.verify(b[:32], b[32:]),
+        "isis_pdu_auth_verify": _isis_auth_verify,
+        "ripv2_pdu_decode_auth": lambda b: rip.RipPacket.decode(
+            b, auth_key_lookup=_rip_lookup
+        ),
+    }
     return out
 
 

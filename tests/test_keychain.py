@@ -718,3 +718,41 @@ def test_ospfv3_rejects_empty_keychain():
     )
     with _pytest.raises(Exception, match="has no keys"):
         d.commit(cand)
+
+
+def test_empty_keychain_rejected_for_all_consumers():
+    """An empty chain is a silent auth outage for EVERY consumer —
+    rejected at commit for OSPFv2, IS-IS, and RIP too (r5 review)."""
+    import pytest as _pytest
+
+    from holo_tpu.daemon.daemon import Daemon
+    from holo_tpu.utils.netio import MockFabric
+
+    loop = EventLoop(clock=VirtualClock())
+    d = Daemon(loop=loop, netio=MockFabric(loop), name="ke")
+    for path, extra in (
+        (
+            "routing/control-plane-protocols/ospfv2/area[0.0.0.0]"
+            "/interface[e0]/authentication/key-chain",
+            [("routing/control-plane-protocols/ospfv2/router-id",
+              "7.7.7.7")],
+        ),
+        (
+            "routing/control-plane-protocols/isis/authentication"
+            "/key-chain",
+            [("routing/control-plane-protocols/isis/system-id",
+              "0000.0000.0031")],
+        ),
+        (
+            "routing/control-plane-protocols/ripv2/interface[e0]"
+            "/authentication/key-chain",
+            [],
+        ),
+    ):
+        cand = d.candidate()
+        cand.set("key-chains/key-chain[hollow]/name", "hollow")
+        for p, v in extra:
+            cand.set(p, v)
+        cand.set(path, "hollow")
+        with _pytest.raises(Exception, match="has no keys"):
+            d.commit(cand)

@@ -23,6 +23,12 @@ EOF
     python bench.py > BENCH_live.json 2> RELAY_BENCH.err
     rc=$?
     echo "$(date +%H:%M:%S) bench rc=$rc (see BENCH_live.json)" >> RELAY_WATCH.log
+    # Harvest the rest of the TPU window: 50k batch sweep, engine A/B
+    # on real hardware, fixpoint profile (VERDICT r4 task 1b/1c).
+    echo "$(date +%H:%M:%S) profiling..." >> RELAY_WATCH.log
+    python tools/tpu_profile.py > TPU_PROFILE_SUMMARY.json 2> RELAY_PROFILE.err
+    rc=$?
+    echo "$(date +%H:%M:%S) profile rc=$rc (see TPU_PROFILE.json)" >> RELAY_WATCH.log
     exit 0
   else
     echo "$ts probe $N: down" >> RELAY_WATCH.log
