@@ -2112,6 +2112,13 @@ class RoutingProvider(Provider, Actor):
                 state["routing"]["ietf-ospf:ospfv3"] = v3_state(v3)
             except Exception:  # noqa: BLE001 — ad-hoc state must survive
                 log.exception("ietf-ospf v3 state render failed")
+            # SPF run log ring (full/intra/inter/external types), like
+            # the v2 and IS-IS blocks; list() snapshots vs the instance
+            # thread's append/trim under threaded isolation.
+            state["routing"]["ospfv3"] = {
+                "spf-run-count": v3.spf_run_count,
+                "spf-log": list(getattr(v3, "spf_log", [])),
+            }
         isis = self.instances.get("isis")
         if isis is not None:
             # The YANG-modeled ietf-isis operational tree — the same
