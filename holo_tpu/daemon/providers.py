@@ -804,7 +804,11 @@ class RoutingProvider(Provider, Actor):
             stub_cost = area_conf.get("default-cost", 1)
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst._if_area:
-                    continue  # reconfig of existing interfaces: later round
+                    # Live reconfiguration on the running circuit
+                    # (reference configuration.rs InterfaceCostUpdate);
+                    # auth refreshes via _refresh_ospf_auth.
+                    inst.iface_cost_update(ifname, if_conf.get("cost", 10))
+                    continue
                 st = self.ifp.interfaces.get(ifname)
                 if st is None or not st.addresses:
                     continue
@@ -836,6 +840,10 @@ class RoutingProvider(Provider, Actor):
                 inst.areas[aid].stub != stub or inst.areas[aid].nssa != nssa
             ):
                 inst.set_area_type(aid, stub=stub, nssa=nssa)
+        # Auth is change-driven on running circuits too: an inline key
+        # change must re-key immediately, not only on keychain events
+        # (_last_tree is set before the apply chain runs).
+        self._refresh_ospf_auth()
         if redist_changed:
             self._reconcile_redistribution(inst)
 
@@ -938,6 +946,9 @@ class RoutingProvider(Provider, Actor):
         for area_id, area_conf in areas.items():
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst.interfaces:
+                    # Live reconfiguration (reference
+                    # InterfaceCostUpdate analog); auth refreshes below.
+                    inst.iface_cost_update(ifname, if_conf.get("cost", 10))
                     continue
                 st = self.ifp.interfaces.get(ifname)
                 if st is None:

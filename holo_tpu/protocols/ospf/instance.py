@@ -1203,6 +1203,19 @@ class OspfInstance(Actor):
             if seqs:
                 self._nvstore.put(self._grace_seqno_key, max(seqs))
 
+    def iface_cost_update(self, ifname: str, cost: int) -> None:
+        """Live cost reconfiguration (reference northbound
+        InterfaceCostUpdate): the new metric re-originates our
+        router-LSA, and neighbors reconverge through normal flooding."""
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        if iface.config.cost == cost:
+            return
+        iface.config.cost = cost
+        self._originate_router_lsa(area)
+
     def _is_own_grace_lsa(self, key: "LsaKey") -> bool:
         """Self-originated Grace-LSA key (link-local opaque type 3)."""
         return (

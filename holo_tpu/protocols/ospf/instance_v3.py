@@ -1345,6 +1345,19 @@ class OspfV3Instance(Actor):
             )
         return {rid: nhs for rid, (_d, nhs) in best.items()}
 
+    def iface_cost_update(self, ifname: str, cost: int) -> None:
+        """Live cost reconfiguration (reference InterfaceCostUpdate):
+        re-originate the router-LSA with the new metric."""
+        iface = self.interfaces.get(ifname)
+        if iface is None or iface.config.cost == cost:
+            return
+        iface.config.cost = cost
+        self._originate_router_lsa()
+        # The interface cost is ALSO the stub-prefix metric in the
+        # intra-area-prefix LSA — without re-originating it, neighbors
+        # keep routing to our prefixes at the stale cost.
+        self._originate_intra_area_prefix()
+
     def _classify_spf(self, triggers: list) -> dict | None:
         """Full-vs-partial classification (reference ospfv3/spf.rs:97-163).
         Returns None when a full SPF is required.

@@ -863,3 +863,135 @@ def test_isis_level_all_notifications_use_instance_name():
     names = {b["routing-protocol-name"] for b in ups}
     assert names == {"n1.isis"}, names  # node name, no -l1/-l2 suffix
     assert {b["isis-level"] for b in ups} <= {"level-1", "level-2"}
+
+
+def test_ospf_cost_live_reconfig():
+    """A cost change on a RUNNING interface re-originates the router
+    LSA and reconverges the neighbor (reference InterfaceCostUpdate) —
+    v2 and v3."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="c1")
+    d2 = Daemon(loop=loop, netio=fabric, name="c2")
+    fabric.join("l4", "c1.ospfv2", "eth0", ipaddress.ip_address("10.0.70.1"))
+    fabric.join("l4", "c2.ospfv2", "eth0", ipaddress.ip_address("10.0.70.2"))
+    fabric.join("l6", "c1.ospfv3", "eth1", ipaddress.ip_address("fe80::71"))
+    fabric.join("l6", "c2.ospfv3", "eth1", ipaddress.ip_address("fe80::72"))
+    for d, rid, a4, ll, pfx in [
+        (d1, "1.1.1.1", "10.0.70.1/30", "fe80::71/64", "2001:db8:71::1/64"),
+        (d2, "2.2.2.2", "10.0.70.2/30", "fe80::72/64", "2001:db8:72::1/64"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [a4])
+        cand.set("interfaces/interface[eth1]/address", [ll, pfx])
+        base = "routing/control-plane-protocols"
+        cand.set(f"{base}/ospfv2/router-id", rid)
+        ob = f"{base}/ospfv2/area[0.0.0.0]/interface[eth0]"
+        cand.set(f"{ob}/interface-type", "point-to-point")
+        cand.set(f"{base}/ospfv3/router-id", rid)
+        cand.set(f"{base}/ospfv3/area[0.0.0.0]/interface[eth1]/cost", 10)
+        d.commit(cand)
+    loop.advance(60)
+    from ipaddress import IPv4Network as N4
+    from ipaddress import IPv6Network as N6
+
+    rib = d1.routing.rib.active_routes()
+    assert N6("2001:db8:72::/64") in rib
+
+    # v2 cost change: d2's peer prefix distance moves with it.
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv2/area[0.0.0.0]"
+        "/interface[eth0]/cost", 55,
+    )
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth1]/cost", 66,
+    )
+    d1.commit(cand)
+    loop.advance(30)
+    v2 = d1.routing.instances["ospfv2"]
+    area = next(iter(v2.areas.values()))
+    assert area.interfaces["eth0"].config.cost == 55
+    assert v2.routes[N4("10.0.70.0/30")].dist == 55  # our own cost now
+    v3 = d1.routing.instances["ospfv3"]
+    assert v3.interfaces["eth1"].config.cost == 66
+    assert v3.routes[N6("2001:db8:72::/64")].dist == 66 + 10  # + d2 prefix metric
+
+
+def test_ospf_live_rekey_and_v3_prefix_metric():
+    """r5 review regressions: (1) an inline key change on a RUNNING v2
+    interface re-keys at commit time; (2) a v3 cost change updates the
+    NEIGHBOR'S view of our prefixes (intra-area-prefix re-origination)."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="k1")
+    d2 = Daemon(loop=loop, netio=fabric, name="k2")
+    fabric.join("l7", "k1.ospfv2", "eth0", ipaddress.ip_address("10.0.71.1"))
+    fabric.join("l7", "k2.ospfv2", "eth0", ipaddress.ip_address("10.0.71.2"))
+    fabric.join("l8", "k1.ospfv3", "eth1", ipaddress.ip_address("fe80::81"))
+    fabric.join("l8", "k2.ospfv3", "eth1", ipaddress.ip_address("fe80::82"))
+    for d, rid, a4, ll, pfx in [
+        (d1, "1.1.1.1", "10.0.71.1/30", "fe80::81/64", "2001:db8:81::1/64"),
+        (d2, "2.2.2.2", "10.0.71.2/30", "fe80::82/64", "2001:db8:82::1/64"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [a4])
+        cand.set("interfaces/interface[eth1]/address", [ll, pfx])
+        base = "routing/control-plane-protocols"
+        cand.set(f"{base}/ospfv2/router-id", rid)
+        ob = f"{base}/ospfv2/area[0.0.0.0]/interface[eth0]"
+        cand.set(f"{ob}/interface-type", "point-to-point")
+        cand.set(f"{ob}/authentication/type", "md5")
+        cand.set(f"{ob}/authentication/key", "old-key")
+        cand.set(f"{base}/ospfv3/router-id", rid)
+        cand.set(f"{base}/ospfv3/area[0.0.0.0]/interface[eth1]/cost", 10)
+        d.commit(cand)
+    loop.advance(60)
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+
+    def full(d):
+        inst = d.routing.instances["ospfv2"]
+        return any(
+            n.state == NsmState.FULL
+            for a in inst.areas.values()
+            for i in a.interfaces.values()
+            for n in i.neighbors.values()
+        )
+
+    assert full(d1) and full(d2)
+    # (1) Re-key BOTH sides on running interfaces: the commit applies
+    # the new key immediately — adjacency survives and new packets
+    # authenticate with the new key.
+    for d in (d1, d2):
+        cand = d.candidate()
+        cand.set(
+            "routing/control-plane-protocols/ospfv2/area[0.0.0.0]"
+            "/interface[eth0]/authentication/key", "new-key",
+        )
+        d.commit(cand)
+    inst = d1.routing.instances["ospfv2"]
+    area = next(iter(inst.areas.values()))
+    assert area.interfaces["eth0"].config.auth.key == b"new-key"
+    loop.advance(60)  # several hello/dead cycles on the new key
+    assert full(d1) and full(d2), "adjacency lost after live re-key"
+
+    # (2) v3 cost change must move the NEIGHBOR'S distance to OUR
+    # prefix (the intra-area-prefix LSA carries the metric).
+    from ipaddress import IPv6Network as N6
+
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth1]/cost", 66,
+    )
+    d1.commit(cand)
+    loop.advance(30)
+    v3_d2 = d2.routing.instances["ospfv3"]
+    assert v3_d2.routes[N6("2001:db8:81::/64")].dist == 10 + 66, (
+        v3_d2.routes.get(N6("2001:db8:81::/64"))
+    )
