@@ -1164,6 +1164,12 @@ class RoutingProvider(Provider, Actor):
         self._isis_ifnames = list(new.get(f"{base}/interface") or {})
         for ifname, if_conf in (new.get(f"{base}/interface") or {}).items():
             if ifname in inst.interfaces:
+                # Live reconfiguration on the running circuit (reference
+                # InterfaceUpdate): metric changes re-originate the LSP;
+                # auth refreshes via _apply_isis_auth below.  Through
+                # the handle so threaded marshalling holds (the L1/L2
+                # node fans the call out to both levels itself).
+                inst.iface_metric_update(ifname, if_conf.get("metric", 10))
                 continue
             st = self.ifp.interfaces.get(ifname)
             if st is None or not st.addresses:

@@ -1780,6 +1780,18 @@ class IsisInstance(Actor):
 
     # -- SPF (shared backend)
 
+    def iface_metric_update(self, ifname: str, metric: int) -> None:
+        """Live metric reconfiguration (reference northbound
+        InterfaceUpdate): re-originate our LSP with the new
+        IS-reachability metric; neighbors reconverge via flooding."""
+        iface = self.interfaces.get(ifname)
+        if iface is None or iface.config.metric == metric:
+            return
+        iface.config.metric = metric
+        # Pseudonode LSPs list members at metric 0 — only our own LSP
+        # carries the metric, so no pseudonode re-origination needed.
+        self._originate_lsp(force=True)
+
     def _schedule_spf(self, topology: bool = True) -> None:
         if topology:
             self._spf_type_full = True

@@ -164,6 +164,10 @@ class IsisLevelAllInstance(Actor):
         for inst in self.instances():
             inst.if_down(ifname)
 
+    def iface_metric_update(self, ifname: str, metric: int) -> None:
+        for inst in self.instances():
+            inst.iface_metric_update(ifname, metric)
+
     def rx_pdu(self, ifname: str, pdu_type: PduType, pdu, snpa: bytes = b"") -> None:
         """Dispatch by PDU level; L1L2 p2p hellos feed both levels."""
         if pdu_type == PduType.HELLO_P2P:

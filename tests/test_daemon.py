@@ -995,3 +995,55 @@ def test_ospf_live_rekey_and_v3_prefix_metric():
     assert v3_d2.routes[N6("2001:db8:81::/64")].dist == 10 + 66, (
         v3_d2.routes.get(N6("2001:db8:81::/64"))
     )
+
+
+import pytest
+
+
+@pytest.mark.parametrize("level", ["level-2", "level-all"])
+def test_isis_metric_live_reconfig(level):
+    """IS-IS metric change on a RUNNING circuit re-originates the LSP
+    and moves the neighbor's route metric (reference InterfaceUpdate) —
+    both the single-level instance and the L1/L2 node fan-out."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="m1")
+    d2 = Daemon(loop=loop, netio=fabric, name="m2")
+    fabric.join("l9", "m1.isis", "eth0", ipaddress.ip_address("10.0.72.1"))
+    fabric.join("l9", "m2.isis", "eth0", ipaddress.ip_address("10.0.72.2"))
+    for d, sysid, addr, lo in [
+        (d1, "0000.0000.0051", "10.0.72.1/30", "192.0.2.51/32"),
+        (d2, "0000.0000.0052", "10.0.72.2/30", "198.51.100.52/32"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [addr])
+        cand.set("interfaces/interface[lo0]/address", [lo])
+        base = "routing/control-plane-protocols/isis"
+        cand.set(f"{base}/system-id", sysid)
+        cand.set(f"{base}/level", level)
+        cand.set(f"{base}/interface[eth0]/interface-type", "point-to-point")
+        cand.set(f"{base}/interface[eth0]/metric", 10)
+        cand.set(f"{base}/interface[lo0]/metric", 1)
+        d.commit(cand)
+    loop.advance(40)
+    from ipaddress import IPv4Network as N4
+
+    far = N4("192.0.2.51/32")
+    i2 = d2.routing.instances["isis"]
+    assert far in i2.routes and i2.routes[far][0] == 10 + 1
+
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/isis/interface[eth0]/metric", 40
+    )
+    d1.commit(cand)
+    loop.advance(30)
+    # The changed metric is d1's OUTBOUND edge, so it is d1's own route
+    # to d2's prefix that moves (d2's path to d1 uses d2's metric).
+    i1 = d1.routing.instances["isis"]
+    far2 = N4("198.51.100.52/32")
+    assert far2 in i1.routes and i1.routes[far2][0] == 40 + 1, (
+        i1.routes.get(far2)
+    )
