@@ -805,9 +805,16 @@ class RoutingProvider(Provider, Actor):
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst._if_area:
                     # Live reconfiguration on the running circuit
-                    # (reference configuration.rs InterfaceCostUpdate);
-                    # auth refreshes via _refresh_ospf_auth.
+                    # (reference configuration.rs InterfaceUpdate
+                    # family); auth refreshes via _refresh_ospf_auth.
                     inst.iface_cost_update(ifname, if_conf.get("cost", 10))
+                    inst.iface_update(
+                        ifname,
+                        hello=if_conf.get("hello-interval", 10),
+                        dead=if_conf.get("dead-interval", 40),
+                        priority=if_conf.get("priority", 1),
+                        passive=if_conf.get("passive", False),
+                    )
                     continue
                 st = self.ifp.interfaces.get(ifname)
                 if st is None or not st.addresses:
@@ -946,9 +953,16 @@ class RoutingProvider(Provider, Actor):
         for area_id, area_conf in areas.items():
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst.interfaces:
-                    # Live reconfiguration (reference
-                    # InterfaceCostUpdate analog); auth refreshes below.
+                    # Live reconfiguration (reference InterfaceUpdate
+                    # family analog); auth refreshes below.
                     inst.iface_cost_update(ifname, if_conf.get("cost", 10))
+                    inst.iface_update(
+                        ifname,
+                        hello=if_conf.get("hello-interval", 10),
+                        dead=if_conf.get("dead-interval", 40),
+                        priority=if_conf.get("priority", 1),
+                        passive=if_conf.get("passive", False),
+                    )
                     continue
                 st = self.ifp.interfaces.get(ifname)
                 if st is None:
@@ -967,6 +981,8 @@ class RoutingProvider(Provider, Actor):
                         cost=if_conf.get("cost", 10),
                         hello_interval=if_conf.get("hello-interval", 10),
                         dead_interval=if_conf.get("dead-interval", 40),
+                        priority=if_conf.get("priority", 1),
+                        passive=if_conf.get("passive", False),
                         auth=self._ospfv3_auth(
                             if_conf.get("authentication")
                         ),

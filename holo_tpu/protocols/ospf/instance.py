@@ -1203,6 +1203,76 @@ class OspfInstance(Actor):
             if seqs:
                 self._nvstore.put(self._grace_seqno_key, max(seqs))
 
+    def iface_update(
+        self,
+        ifname: str,
+        hello: int | None = None,
+        dead: int | None = None,
+        priority: int | None = None,
+        passive: bool | None = None,
+    ) -> None:
+        """Live interface reconfiguration beyond cost (reference
+        northbound InterfaceUpdate family).
+
+        - hello/dead intervals apply from the NEXT hello (the hello
+          timer re-arms with the config value each fire); a mismatch
+          with the peer drops its hellos until both sides agree —
+          standard OSPF semantics.
+        - priority is advertised in the next hello; elections react via
+          the peers' NeighborChange processing.
+        - passive=True kills the circuit's neighbors (the interface
+          stops exchanging hellos); passive=False restarts the hello
+          task that the passive gate parked."""
+        ai = self._iface(ifname)
+        if ai is None:
+            return
+        area, iface = ai
+        cfg = iface.config
+        if hello is not None:
+            cfg.hello_interval = hello
+        if dead is not None:
+            cfg.dead_interval = dead
+        if priority is not None:
+            cfg.priority = priority
+        if passive is not None and cfg.passive != passive:
+            cfg.passive = passive
+            if iface.state == IsmState.DOWN:
+                # A link-down interface has nothing to tear down or
+                # revive — and forcing WAITING here would advertise a
+                # dead link AND break the next if_up's DOWN check.
+                return
+            if passive:
+                # Same teardown discipline as if_down: the going_down
+                # guard suppresses interim DR elections per KILL_NBR
+                # (a passive interface must not end up claiming DR).
+                iface.going_down = True
+                try:
+                    for nbr_id in list(iface.neighbors):
+                        self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+                finally:
+                    iface.going_down = False
+                iface.dr = IPv4Address(0)
+                iface.bdr = IPv4Address(0)
+                if cfg.if_type == IfType.BROADCAST:
+                    self._set_ism_state(iface, IsmState.WAITING)
+                for key in ("hello", "wait"):
+                    t = self._timers.get((key, ifname))
+                    if t:
+                        t.cancel()
+                self._originate_router_lsa(area)
+            elif iface.state != IsmState.DOWN:
+                # Revival re-enters the §9.1 Waiting phase on broadcast
+                # circuits and restarts the hello task the passive gate
+                # parked.
+                if cfg.if_type == IfType.BROADCAST:
+                    self._set_ism_state(iface, IsmState.WAITING)
+                    self._timer(
+                        ("wait", ifname), lambda: WaitTimerMsg(ifname)
+                    ).start(cfg.dead_interval)
+                self._timer(
+                    ("hello", ifname), lambda: HelloTimerMsg(ifname)
+                ).start(0.0)
+
     def iface_cost_update(self, ifname: str, cost: int) -> None:
         """Live cost reconfiguration (reference northbound
         InterfaceCostUpdate): the new metric re-originates our
@@ -3674,6 +3744,10 @@ class OspfInstance(Actor):
             return
         area, iface = ai
         if iface.state == IsmState.DOWN:
+            return
+        if iface.config.passive:
+            # Passive circuits neither send NOR process OSPF packets —
+            # a peer's hellos must not recreate phantom neighbors here.
             return
         try:
             pkt = Packet.decode(msg.data, auth=iface.config.auth)

@@ -52,6 +52,8 @@ class V3IfConfig:
     if_type: IfType = IfType.POINT_TO_POINT
     priority: int = 1
     loopback: bool = False
+    # Passive circuits advertise their prefixes but exchange no packets.
+    passive: bool = False
     auth: object = None  # packet_v3.AuthCtxV3 or None (RFC 7166 trailer)
 
 
@@ -314,7 +316,7 @@ class OspfV3Instance(Actor):
         if iface is None or iface.up:
             return
         iface.up = True
-        if iface.is_lan:
+        if iface.is_lan and not iface.config.passive:
             # §9.4 Waiting: listen for an incumbent DR before claiming.
             iface.up_since = self.loop.clock.now()
             iface.wait_until = (
@@ -357,7 +359,7 @@ class OspfV3Instance(Actor):
 
     def _send_hello(self, ifname: str) -> None:
         iface = self.interfaces.get(ifname)
-        if iface is None or not iface.up:
+        if iface is None or not iface.up or iface.config.passive:
             return
         opts = P.Options.V6 | P.Options.R
         if not self._area_of(iface).no_external:
@@ -420,7 +422,7 @@ class OspfV3Instance(Actor):
     # -- DR election (RFC 5340 §4.2.1.1: §9.4 with router-ids)
 
     def _run_dr_election(self, iface: V3Interface) -> None:
-        if not iface.up:
+        if not iface.up or iface.config.passive:
             return
         if self.loop.clock.now() < iface.wait_until:
             # BackupSeen: an established DR/BDR declared by a 2-Way
@@ -1345,6 +1347,54 @@ class OspfV3Instance(Actor):
             )
         return {rid: nhs for rid, (_d, nhs) in best.items()}
 
+    def iface_update(
+        self,
+        ifname: str,
+        hello: int | None = None,
+        dead: int | None = None,
+        priority: int | None = None,
+        passive: bool | None = None,
+    ) -> None:
+        """Live interface reconfiguration beyond cost (the v2
+        iface_update analog): hello/dead apply from the next hello (the
+        hello timer re-arms with the config value), priority is
+        advertised from the next hello, and a passive flip tears
+        down / revives the circuit's packet exchange while its prefixes
+        stay advertised."""
+        iface = self.interfaces.get(ifname)
+        if iface is None:
+            return
+        cfg = iface.config
+        if hello is not None:
+            cfg.hello_interval = hello
+        if dead is not None:
+            cfg.dead_interval = dead
+        if priority is not None:
+            cfg.priority = priority
+        if passive is not None and cfg.passive != passive:
+            cfg.passive = passive
+            if passive:
+                for nbr_id in list(iface.neighbors):
+                    self._nbr_event(ifname, nbr_id, NsmEvent.KILL_NBR)
+                iface.dr = IPv4Address(0)
+                iface.bdr = IPv4Address(0)
+                for key in (("hello", ifname), ("wait", ifname)):
+                    t = self._timers.get(key)
+                    if t:
+                        t.cancel()
+                self._originate_router_lsa()
+            elif iface.up:
+                if iface.is_lan:
+                    # §9.4 Waiting again before claiming DR.
+                    iface.up_since = self.loop.clock.now()
+                    iface.wait_until = (
+                        self.loop.clock.now() + cfg.dead_interval
+                    )
+                    self._timer(
+                        ("wait", ifname), lambda: WaitTimerV3(ifname)
+                    ).start(cfg.dead_interval)
+                self._send_hello(ifname)
+
     def iface_cost_update(self, ifname: str, cost: int) -> None:
         """Live cost reconfiguration (reference InterfaceCostUpdate):
         re-originate the router-LSA with the new metric."""
@@ -2114,7 +2164,8 @@ class OspfV3Instance(Actor):
 
     def _rx(self, msg: NetRxPacket) -> None:
         iface = self.interfaces.get(msg.ifname)
-        if iface is None or not iface.up:
+        if iface is None or not iface.up or iface.config.passive:
+            # Passive circuits neither send NOR process OSPF packets.
             return
         try:
             pkt = P.Packet.decode(

@@ -1047,3 +1047,115 @@ def test_isis_metric_live_reconfig(level):
     assert far2 in i1.routes and i1.routes[far2][0] == 40 + 1, (
         i1.routes.get(far2)
     )
+
+
+def test_ospf_passive_and_hello_live_reconfig():
+    """Passive flip and hello/dead changes apply to RUNNING circuits:
+    passive=true kills the adjacency and parks the hello task,
+    passive=false revives it (reference InterfaceUpdate family)."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="p1")
+    d2 = Daemon(loop=loop, netio=fabric, name="p2")
+    fabric.join("la", "p1.ospfv2", "eth0", ipaddress.ip_address("10.0.73.1"))
+    fabric.join("la", "p2.ospfv2", "eth0", ipaddress.ip_address("10.0.73.2"))
+    for d, rid, a4 in [
+        (d1, "1.1.1.1", "10.0.73.1/30"),
+        (d2, "2.2.2.2", "10.0.73.2/30"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [a4])
+        base = "routing/control-plane-protocols/ospfv2"
+        cand.set(f"{base}/router-id", rid)
+        ob = f"{base}/area[0.0.0.0]/interface[eth0]"
+        cand.set(f"{ob}/interface-type", "point-to-point")
+        cand.set(f"{ob}/hello-interval", 2)
+        cand.set(f"{ob}/dead-interval", 8)
+        d.commit(cand)
+    loop.advance(40)
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+
+    def full(d):
+        inst = d.routing.instances["ospfv2"]
+        return any(
+            n.state == NsmState.FULL
+            for a in inst.areas.values()
+            for i in a.interfaces.values()
+            for n in i.neighbors.values()
+        )
+
+    assert full(d1) and full(d2)
+    # Passive on d1: the adjacency dies (our side immediately, d2's by
+    # dead timer).
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv2/area[0.0.0.0]"
+        "/interface[eth0]/passive", True,
+    )
+    d1.commit(cand)
+    loop.advance(20)
+    assert not full(d1) and not full(d2)
+    # Back to active: the hello task restarts and FULL re-forms.
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv2/area[0.0.0.0]"
+        "/interface[eth0]/passive", False,
+    )
+    d1.commit(cand)
+    loop.advance(40)
+    assert full(d1) and full(d2), "adjacency did not revive after passive=false"
+
+
+def test_ospfv3_passive_live_reconfig():
+    """v3 analog of the passive flip: adjacency dies, prefixes stay
+    advertised, revival re-forms FULL (r5 review: v2/v3 divergence)."""
+    import ipaddress
+
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    d1 = Daemon(loop=loop, netio=fabric, name="q1")
+    d2 = Daemon(loop=loop, netio=fabric, name="q2")
+    fabric.join("lb", "q1.ospfv3", "eth0", ipaddress.ip_address("fe80::91"))
+    fabric.join("lb", "q2.ospfv3", "eth0", ipaddress.ip_address("fe80::92"))
+    for d, rid, ll, pfx in [
+        (d1, "1.1.1.1", "fe80::91/64", "2001:db8:91::1/64"),
+        (d2, "2.2.2.2", "fe80::92/64", "2001:db8:92::1/64"),
+    ]:
+        cand = d.candidate()
+        cand.set("interfaces/interface[eth0]/address", [ll, pfx])
+        base = "routing/control-plane-protocols/ospfv3"
+        cand.set(f"{base}/router-id", rid)
+        cand.set(f"{base}/area[0.0.0.0]/interface[eth0]/cost", 10)
+        cand.set(f"{base}/area[0.0.0.0]/interface[eth0]/hello-interval", 2)
+        cand.set(f"{base}/area[0.0.0.0]/interface[eth0]/dead-interval", 8)
+        d.commit(cand)
+    loop.advance(40)
+    from holo_tpu.protocols.ospf.neighbor import NsmState
+
+    def full(d):
+        inst = d.routing.instances["ospfv3"]
+        return any(
+            n.state == NsmState.FULL
+            for i in inst.interfaces.values()
+            for n in i.neighbors.values()
+        )
+
+    assert full(d1) and full(d2)
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth0]/passive", True,
+    )
+    d1.commit(cand)
+    loop.advance(20)
+    assert not full(d1) and not full(d2)
+    cand = d1.candidate()
+    cand.set(
+        "routing/control-plane-protocols/ospfv3/area[0.0.0.0]"
+        "/interface[eth0]/passive", False,
+    )
+    d1.commit(cand)
+    loop.advance(40)
+    assert full(d1) and full(d2), "v3 adjacency did not revive"
