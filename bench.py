@@ -58,6 +58,7 @@ STAGE_TIMEOUT = {
     "cpubaseline": 600,
     "ospfv3_multiarea": 1200,
     "isis_l1l2": 1200,
+    "frr_batch": 900,
 }
 
 
@@ -439,6 +440,53 @@ def stage_isis_l1l2(n_l2, n_l1, ecmp, B, cpu_runs):
     }
 
 
+def stage_frr_batch(rows, cols, reps, parity):
+    """FRR backup-table batch (ISSUE 1): ONE batched dispatch computes
+    the all-roots distance matrix, the per-protected-link
+    post-convergence planes, and the LFA/rLFA/TI-LFA selection tables.
+    runs/sec counts whole engine.compute() calls (marshal + dispatch +
+    readback — the unit the protocol layer pays per SPF).  Parity-gated
+    against the scalar oracle; runs on JAX-CPU unchanged, so the
+    CPU-fallback path keeps a live row while the relay is down."""
+    from holo_tpu.frr.manager import FrrEngine
+    from holo_tpu.spf.synth import grid_topology
+
+    topo = grid_topology(rows, cols, seed=3)
+    eng = FrrEngine("tpu")
+    table = eng.compute(topo)  # warmup: compile + device-graph cache
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        eng.compute(topo)
+        times.append(time.perf_counter() - t0)
+    dt = sum(times) / reps
+    result = {
+        "runs_per_sec": 1.0 / dt,
+        "batch_ms": dt * 1e3,
+        "n_vertices": int(topo.n_vertices),
+        "n_links": int(table.n_links),
+        "coverage": round(table.coverage(), 4),
+        "times_ms": [round(t * 1e3, 2) for t in times],
+    }
+    if parity:
+        ref = FrrEngine("scalar").compute(topo)
+        result["ok"] = all(
+            np.array_equal(getattr(ref, f), getattr(table, f))
+            for f in (
+                "lfa_adj",
+                "lfa_nodeprot",
+                "rlfa_pq",
+                "tilfa_p",
+                "tilfa_q",
+                "post_dist",
+                "post_nh",
+            )
+        )
+    else:
+        result["ok"] = True
+    return result
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -505,6 +553,11 @@ def main() -> None:
                 if small
                 else stage_isis_l1l2(9_000, 1_000, 64, 128, 8)
             ),
+            "frr_batch": lambda: (
+                stage_frr_batch(6, 6, 3, True)
+                if small
+                else stage_frr_batch(12, 12, 3, True)
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -533,6 +586,11 @@ def main() -> None:
         )
         extra["isis_l1l2_jaxcpu_small"] = _run_stage(
             "isis_l1l2", True, cpu=True
+        )
+        # FRR backup-table batch (ISSUE 1): parity-gated JAX-CPU row so
+        # the all-roots scenario stays covered while the relay is down.
+        extra["frr_batch_jaxcpu_small"] = _run_stage(
+            "frr_batch", True, cpu=True
         )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -600,6 +658,9 @@ def main() -> None:
         # shared engine, parity-gated per area/level.
         extra["ospfv3_multiarea"] = _run_stage("ospfv3_multiarea", small)
         extra["isis_l1l2"] = _run_stage("isis_l1l2", small)
+    # FRR backup-table batch (ISSUE 1): the all-roots SPF + repair
+    # selection scenario, parity-gated vs the scalar oracle.
+    extra["frr_batch"] = _run_stage("frr_batch", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

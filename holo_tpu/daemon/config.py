@@ -7,9 +7,16 @@ northbound transaction engine instead.
 
 from __future__ import annotations
 
-import tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
+
+try:
+    import tomllib  # Python >= 3.11
+except ImportError:  # pragma: no cover - interpreter-dependent
+    # tomli is the stdlib module's upstream: a drop-in loads() for
+    # pre-3.11 interpreters (a hand-rolled parser silently mis-handles
+    # real TOML — escaped quotes, commas inside array strings).
+    import tomli as tomllib
 
 
 @dataclass

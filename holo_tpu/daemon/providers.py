@@ -180,8 +180,11 @@ class InterfaceProvider(Provider, Actor):
             st.addresses = [ip_interface(a) for a in entry.get("address", [])]
             self.ibus.publish(
                 TOPIC_INTERFACE_UPD,
+                # operative = admin AND carrier: a config commit must
+                # not report a carrier-down link as up (the RIB treats
+                # operative=True as an FRR restore signal).
                 InterfaceUpdMsg(ifname=name, ifindex=st.ifindex, mtu=st.mtu,
-                                operative=st.enabled),
+                                operative=st.enabled and st.operative),
                 ifname=name,
             )
             for addr in st.addresses:
@@ -795,6 +798,17 @@ class RoutingProvider(Provider, Actor):
             inst.config.router_id = IPv4Address(router_id)
             inst.config.spf = timers
             inst.backend = backend
+        # IP fast reroute (mirrors the reference YANG fast-reroute
+        # container: ietf-ospf fast-reroute/lfa plus holo's remote-lfa /
+        # ti-lfa extension leaves).  A change must force a full SPF run:
+        # that is what recomputes (or, on disable, drops) the backup
+        # tables and republishes routes with the new repair set.
+        new_frr = self._frr_config(new.get(f"{base}/fast-reroute"))
+        if new_frr != inst.config.frr:
+            inst.config.frr = new_frr
+            inst._schedule_spf()
+        # RFC 6987 stub-router maintenance mode (max-metric router-LSA).
+        inst.set_stub_router(bool(new.get(f"{base}/stub-router", False)))
 
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
@@ -807,6 +821,7 @@ class RoutingProvider(Provider, Actor):
                     # Live reconfiguration on the running circuit
                     # (reference configuration.rs InterfaceUpdate
                     # family); auth refreshes via _refresh_ospf_auth.
+                    st = self.ifp.interfaces.get(ifname)
                     inst.iface_cost_update(ifname, if_conf.get("cost", 10))
                     inst.iface_update(
                         ifname,
@@ -814,6 +829,9 @@ class RoutingProvider(Provider, Actor):
                         dead=if_conf.get("dead-interval", 40),
                         priority=if_conf.get("priority", 1),
                         passive=if_conf.get("passive", False),
+                        mtu=st.mtu if st is not None else None,
+                        mtu_ignore=if_conf.get("mtu-ignore", False),
+                        transmit_delay=if_conf.get("transmit-delay", 1),
                     )
                     continue
                 st = self.ifp.interfaces.get(ifname)
@@ -835,6 +853,8 @@ class RoutingProvider(Provider, Actor):
                     priority=if_conf.get("priority", 1),
                     passive=if_conf.get("passive", False),
                     mtu=st.mtu,
+                    mtu_ignore=if_conf.get("mtu-ignore", False),
+                    transmit_delay=if_conf.get("transmit-delay", 1),
                     bfd_enabled=if_conf.get("bfd", False),
                     auth=self._ospf_auth(if_conf.get("authentication")),
                 )
@@ -853,6 +873,25 @@ class RoutingProvider(Provider, Actor):
         self._refresh_ospf_auth()
         if redist_changed:
             self._reconcile_redistribution(inst)
+
+    @staticmethod
+    def _frr_config(frr_conf):
+        """ietf fast-reroute container -> FrrConfig (None = disabled).
+
+        Shape (shared by OSPFv2/v3 and IS-IS):
+          fast-reroute: {lfa: true, remote-lfa: bool, ti-lfa: bool,
+                         engine: scalar|tpu}
+        """
+        if not frr_conf:
+            return None
+        from holo_tpu.frr.manager import FrrConfig
+
+        return FrrConfig(
+            enabled=bool(frr_conf.get("lfa", True)),
+            remote_lfa=bool(frr_conf.get("remote-lfa", False)),
+            ti_lfa=bool(frr_conf.get("ti-lfa", False)),
+            engine=frr_conf.get("engine", "scalar"),
+        )
 
     def _reconcile_redistribution(self, inst) -> None:
         """Replay the RIB against a changed redistribute set: inject
@@ -949,12 +988,21 @@ class RoutingProvider(Provider, Actor):
             )
             inst = self._place_instance(inst)
             self.instances["ospfv3"] = inst
+        # IP fast reroute + RFC 6987 stub-router (same leaves as v2).  An
+        # FRR change forces a full SPF so backup tables and published
+        # routes follow the new policy immediately.
+        new_frr = self._frr_config(new.get(f"{base}/fast-reroute"))
+        if new_frr != inst.frr:
+            inst.frr = new_frr
+            inst._schedule_spf()
+        inst.set_stub_router(bool(new.get(f"{base}/stub-router", False)))
         areas = new.get(f"{base}/area", {}) or {}
         for area_id, area_conf in areas.items():
             for ifname, if_conf in (area_conf.get("interface") or {}).items():
                 if ifname in inst.interfaces:
                     # Live reconfiguration (reference InterfaceUpdate
                     # family analog); auth refreshes below.
+                    st = self.ifp.interfaces.get(ifname)
                     inst.iface_cost_update(ifname, if_conf.get("cost", 10))
                     inst.iface_update(
                         ifname,
@@ -962,6 +1010,9 @@ class RoutingProvider(Provider, Actor):
                         dead=if_conf.get("dead-interval", 40),
                         priority=if_conf.get("priority", 1),
                         passive=if_conf.get("passive", False),
+                        mtu=st.mtu if st is not None else None,
+                        mtu_ignore=if_conf.get("mtu-ignore", False),
+                        transmit_delay=if_conf.get("transmit-delay", 1),
                     )
                     continue
                 st = self.ifp.interfaces.get(ifname)
@@ -983,6 +1034,9 @@ class RoutingProvider(Provider, Actor):
                         dead_interval=if_conf.get("dead-interval", 40),
                         priority=if_conf.get("priority", 1),
                         passive=if_conf.get("passive", False),
+                        mtu=st.mtu,
+                        mtu_ignore=if_conf.get("mtu-ignore", False),
+                        transmit_delay=if_conf.get("transmit-delay", 1),
                         auth=self._ospfv3_auth(
                             if_conf.get("authentication")
                         ),
@@ -1046,7 +1100,10 @@ class RoutingProvider(Provider, Actor):
                     )
 
     def _sink_routes(self, protocol, items: dict) -> None:
-        """Shared delta route sink: items = {prefix: (metric, {(if, addr)})}.
+        """Shared delta route sink: items = {prefix: (metric, {(if, addr)})}
+        or, with IP-FRR repairs, (metric, nhs, {primary -> (backup,
+        labels)}) — the backups ride the RouteMsg so the RIB can flip to
+        them on BFD/link-down without waiting for this layer.
 
         Caches the last pushed set per protocol so unchanged routes skip
         RIB churn; the cache is cleared when the instance stops (otherwise
@@ -1068,7 +1125,15 @@ class RoutingProvider(Provider, Actor):
         for prefix, entry in items.items():
             if old.get(prefix) == entry:
                 continue
-            metric, nhs = entry
+            metric, nhs = entry[0], entry[1]
+            raw_backups = entry[2] if len(entry) > 2 else None
+            backups = {}
+            for (pi, pa), ((bi, ba), labels) in (raw_backups or {}).items():
+                if pa is None or ba is None:
+                    continue
+                backups[Nexthop(addr=pa, ifname=pi)] = Nexthop(
+                    addr=ba, ifname=bi, labels=tuple(labels)
+                )
             self.rib.route_add(
                 RouteMsg(
                     protocol=protocol,
@@ -1078,6 +1143,7 @@ class RoutingProvider(Provider, Actor):
                     nexthops=frozenset(
                         Nexthop(addr=a, ifname=i) for i, a in nhs
                     ),
+                    backups=backups,
                 )
             )
         caches[protocol] = dict(items)
@@ -1096,7 +1162,11 @@ class RoutingProvider(Provider, Actor):
         self._sink_routes(
             Protocol.OSPFV3,
             {
-                p: (r.dist, frozenset(r.nexthops))
+                p: (
+                    r.dist,
+                    frozenset(r.nexthops),
+                    getattr(r, "backups", None),
+                )
                 for p, r in routes.items()
             },
         )
@@ -1175,6 +1245,13 @@ class RoutingProvider(Provider, Actor):
             )
             inst = self._place_instance(raw)
             self.instances["isis"] = inst
+        # IP fast reroute (default-topology LFA; same container shape as
+        # the OSPF instances).  A change schedules a topology SPF so the
+        # backup tables and published routes follow the new policy.
+        new_frr = self._frr_config(new.get(f"{base}/fast-reroute"))
+        if new_frr != inst.frr:
+            inst.frr = new_frr
+            inst._schedule_spf()
         # Configured interface order for operational-state rendering: a
         # down interface leaves inst.interfaces but must still render.
         self._isis_ifnames = list(new.get(f"{base}/interface") or {})
@@ -1295,9 +1372,14 @@ class RoutingProvider(Provider, Actor):
     def _isis_routes_to_rib(self, routes):
         from holo_tpu.utils.southbound import Protocol
 
+        inst = self.instances.get("isis")
+        frr_backups = getattr(inst, "frr_backups", None) or {}
         self._sink_routes(
             Protocol.ISIS,
-            {p: (metric, frozenset(nhs)) for p, (metric, nhs) in routes.items()},
+            {
+                p: (metric, frozenset(nhs), frr_backups.get(p))
+                for p, (metric, nhs) in routes.items()
+            },
         )
 
     def _apply_ldp(self, new):

@@ -1,0 +1,167 @@
+"""FRR input marshaling: Topology → protected links + repair candidates.
+
+Shapes are padded to a multiple of ``pad_multiple`` so XLA compiles once
+per (N, L, A) bucket under LSA churn (same bucketing policy as
+``ops/graph.build_ell``).  Padding rows carry ``valid == False`` and MUST
+be result-neutral: the kernel and the scalar oracle both mask them out,
+and the fuzz target ``frr_padding_invariants`` checks that growing the
+pad never changes a table entry.
+
+Model (shared by kernel and oracle — keep the two in lockstep):
+
+- A *protected link* is a root out-edge: one per p2p/vlink neighbor edge
+  and one per attached transit network (the interface).  Its failure
+  masks the edge and its first reverse edge (both directions of the
+  link, like ``whatif_link_failure_masks``); for parallel p2p links the
+  reverse pick is the first matching edge — the vertex graph cannot
+  distinguish siblings, so siblings share the reverse (documented
+  limitation).
+- A *repair candidate* (adjacency) is a direct next hop the root could
+  repair through: a root out-edge to a router carrying a next-hop atom,
+  or a (root-adjacent network → member router) edge with an atom.  Each
+  candidate rides exactly one protected link (``adj_link``) — candidates
+  on the failed interface are unusable for that link, while a parallel
+  link to the same neighbor remains usable (RFC 5286 link protection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from holo_tpu.ops.graph import Topology
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((max(x, 1)) + m - 1) // m * m
+
+
+@dataclass
+class FrrInputs:
+    """Host-side padded FRR tables for one topology root."""
+
+    # Protected links (root out-edges); padded with valid=False.
+    link_edge: np.ndarray  # int32[Lp] edge id (-1 pad)
+    link_far: np.ndarray  # int32[Lp] far-end vertex (0 pad)
+    link_cost: np.ndarray  # int32[Lp]
+    link_valid: np.ndarray  # bool[Lp]
+    edge_masks: np.ndarray  # bool[Lp, E] post-convergence scenario masks
+    # Repair candidates; padded with valid=False.
+    adj_edge: np.ndarray  # int32[Ap] edge id of the candidate edge
+    adj_nbr: np.ndarray  # int32[Ap] neighbor router vertex
+    adj_cost: np.ndarray  # int32[Ap] root→neighbor cost over this candidate
+    adj_link: np.ndarray  # int32[Ap] protected-link index it rides (-1 pad)
+    adj_atom: np.ndarray  # int32[Ap] direct next-hop atom id
+    adj_valid: np.ndarray  # bool[Ap]
+    n_links: int  # unpadded L
+    n_adj: int  # unpadded A
+    # next-hop atom id -> protected link index (which interface an
+    # installed primary next hop rides; drives failure→destination fanout).
+    atom_link: dict
+
+    @property
+    def shape_key(self) -> tuple:
+        return (
+            self.link_valid.shape[0],
+            self.adj_valid.shape[0],
+            self.edge_masks.shape[1],
+        )
+
+
+def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
+    """Build the padded FRR tables for ``topo.root``."""
+    root = int(topo.root)
+    e_src = topo.edge_src
+    e_dst = topo.edge_dst
+    e_cost = topo.edge_cost
+    atom = topo.edge_direct_atom
+    is_router = topo.is_router
+    n_edges = topo.n_edges
+
+    pair_of: dict[tuple[int, int], int] = {}
+    for e in range(n_edges):
+        pair_of.setdefault((int(e_src[e]), int(e_dst[e])), e)
+
+    # Protected links: root out-edges, in edge order.
+    link_edge: list[int] = [
+        e for e in range(n_edges) if int(e_src[e]) == root
+    ]
+    link_of_edge = {e: l for l, e in enumerate(link_edge)}
+    nlinks = len(link_edge)
+
+    masks = np.ones((nlinks, n_edges), bool)
+    for l, e in enumerate(link_edge):
+        masks[l, e] = False
+        rev = pair_of.get((int(e_dst[e]), int(e_src[e])))
+        if rev is not None:
+            masks[l, rev] = False
+
+    # Repair candidates + atom→link map.
+    adj_edge: list[int] = []
+    adj_nbr: list[int] = []
+    adj_cost: list[int] = []
+    adj_link: list[int] = []
+    adj_atom: list[int] = []
+    atom_link: dict[int, int] = {}
+    for l, e in enumerate(link_edge):
+        far = int(e_dst[e])
+        if int(atom[e]) >= 0:
+            atom_link.setdefault(int(atom[e]), l)
+        if is_router[far]:
+            if int(atom[e]) >= 0:
+                adj_edge.append(e)
+                adj_nbr.append(far)
+                adj_cost.append(int(e_cost[e]))
+                adj_link.append(l)
+                adj_atom.append(int(atom[e]))
+        else:
+            # LAN: members reachable through this interface are candidates
+            # (and their atoms ride this link for the failure fanout).
+            for e2 in range(n_edges):
+                if int(e_src[e2]) != far or int(atom[e2]) < 0:
+                    continue
+                member = int(e_dst[e2])
+                if member == root or not is_router[member]:
+                    continue
+                atom_link.setdefault(int(atom[e2]), l)
+                adj_edge.append(e2)
+                adj_nbr.append(member)
+                adj_cost.append(int(e_cost[e]) + int(e_cost[e2]))
+                adj_link.append(l)
+                adj_atom.append(int(atom[e2]))
+    nadj = len(adj_edge)
+
+    lp = _round_up(nlinks, pad_multiple)
+    ap = _round_up(nadj, pad_multiple)
+
+    def pad_i32(vals, size, fill):
+        out = np.full(size, fill, np.int32)
+        out[: len(vals)] = np.asarray(vals, np.int32).reshape(-1)[: len(vals)]
+        return out
+
+    link_valid = np.zeros(lp, bool)
+    link_valid[:nlinks] = True
+    adj_valid = np.zeros(ap, bool)
+    adj_valid[:nadj] = True
+    # Pad scenarios keep every edge up: their post-SPF equals the base
+    # SPF, and every output row is masked by link_valid anyway.
+    masks_p = np.ones((lp, n_edges), bool)
+    masks_p[:nlinks] = masks
+
+    return FrrInputs(
+        link_edge=pad_i32(link_edge, lp, -1),
+        link_far=pad_i32([int(e_dst[e]) for e in link_edge], lp, 0),
+        link_cost=pad_i32([int(e_cost[e]) for e in link_edge], lp, 1),
+        link_valid=link_valid,
+        edge_masks=masks_p,
+        adj_edge=pad_i32(adj_edge, ap, -1),
+        adj_nbr=pad_i32(adj_nbr, ap, 0),
+        adj_cost=pad_i32(adj_cost, ap, 1),
+        adj_link=pad_i32(adj_link, ap, -1),
+        adj_atom=pad_i32(adj_atom, ap, -1),
+        adj_valid=adj_valid,
+        n_links=nlinks,
+        n_adj=nadj,
+        atom_link=atom_link,
+    )

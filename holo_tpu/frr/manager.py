@@ -1,0 +1,218 @@
+"""FRR engine + backup resolution policy.
+
+``FrrEngine`` is the dispatch point the protocol layer calls right after
+its primary SPF: Topology in, :class:`BackupTable` out, through either
+the batched device kernel (:func:`holo_tpu.frr.kernel.frr_batch`, cached
+per shape bucket like ``TpuSpfBackend``) or the scalar oracle.  Both are
+bit-identical; 'scalar' is the default for the same reason it is for
+SPF — zero marshaling latency on small LSDBs.
+
+``resolve_backup`` applies the configured protection policy to one
+(protected link, destination vertex) query: direct LFA first (cheapest —
+no extra encapsulation), then remote-LFA PQ tunnel, then the TI-LFA
+segment repair.  The result is symbolic (atoms + repair vertices); the
+protocol layer maps atoms to (interface, address) next hops and repair
+vertices to SR labels via its own SID tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from holo_tpu.frr.inputs import marshal_frr
+from holo_tpu.frr.kernel import BackupTable
+from holo_tpu.ops.graph import Topology
+
+
+@dataclass
+class FrrConfig:
+    """Mirrors the reference YANG fast-reroute containers
+    (ietf-ospf ``fast-reroute/lfa``, holo's ti-lfa extension leaves)."""
+
+    enabled: bool = False  # LFA (RFC 5286)
+    remote_lfa: bool = False  # RFC 7490 (requires enabled)
+    ti_lfa: bool = False  # TI-LFA segment repairs (requires enabled + SR)
+    engine: str = "scalar"  # 'scalar' | 'tpu'
+
+    def active(self) -> bool:
+        return self.enabled
+
+
+@dataclass(frozen=True)
+class BackupEntry:
+    """One resolved repair for (protected link, destination vertex)."""
+
+    kind: str  # 'lfa' | 'rlfa' | 'ti-lfa'
+    atom: int | None  # release next-hop atom (None: caller falls back
+    # to its primary next hop toward via[0])
+    via: tuple[int, ...] = ()  # repair vertices: () | (pq,) | (p[, q])
+    node_protecting: bool = False
+
+
+def first_atom(words: np.ndarray) -> int | None:
+    """Lowest set atom id in a uint32 bitmask row (deterministic pick)."""
+    for wi, word in enumerate(np.asarray(words, np.uint32)):
+        w = int(word)
+        if w:
+            return wi * 32 + (w & -w).bit_length() - 1
+    return None
+
+
+def resolve_backup(
+    table: BackupTable, cfg: FrrConfig, link: int, dest: int
+) -> BackupEntry | None:
+    """Pick the repair for (link, dest) under ``cfg``; None = unprotected."""
+    if not cfg.enabled or link < 0 or link >= table.n_links:
+        return None
+    fin = table.inputs
+    a = int(table.lfa_adj[link, dest])
+    if a >= 0:
+        return BackupEntry(
+            kind="lfa",
+            atom=int(fin.adj_atom[a]),
+            via=(int(fin.adj_nbr[a]),),
+            node_protecting=bool(table.lfa_nodeprot[link, dest]),
+        )
+    if cfg.remote_lfa:
+        pq = int(table.rlfa_pq[link, dest])
+        if pq >= 0:
+            # Release toward the PQ node: its own LFA pick when the
+            # plain P-space route would still cross the failed link.
+            rel = int(table.lfa_adj[link, pq])
+            atom = int(fin.adj_atom[rel]) if rel >= 0 else None
+            return BackupEntry(kind="rlfa", atom=atom, via=(pq,))
+    if cfg.ti_lfa:
+        p = int(table.tilfa_p[link, dest])
+        if p >= 0:
+            q = int(table.tilfa_q[link, dest])
+            atom = first_atom(table.post_nh[link, dest])
+            via = (p,) if q < 0 else (p, q)
+            return BackupEntry(kind="ti-lfa", atom=atom, via=via)
+    return None
+
+
+def repair_map(
+    table: BackupTable | None,
+    cfg: FrrConfig,
+    words: np.ndarray,
+    vertex: int,
+) -> dict[int, BackupEntry]:
+    """{primary next-hop atom id -> repair} for one destination vertex.
+
+    The shared protocol-side consumption step (OSPFv2/v3, IS-IS): each
+    primary atom rides exactly one protected link (``atom_link``), and
+    the repair for (that link, this destination) is what the router
+    flips to when the link's BFD session or carrier drops.  Entries
+    whose repair has no release atom (an unreachable tunnel release) are
+    omitted — the caller cannot build a forwarding entry from them."""
+    out: dict[int, BackupEntry] = {}
+    if table is None or not cfg.active():
+        return out
+    n_words = np.asarray(words, np.uint32)
+    for wi, word in enumerate(n_words):
+        w = int(word)
+        while w:
+            low = w & -w
+            a = wi * 32 + low.bit_length() - 1
+            w ^= low
+            link = table.link_of_atom(a)
+            if link is None:
+                continue
+            entry = resolve_backup(table, cfg, link, vertex)
+            if entry is not None and entry.atom is not None:
+                out[a] = entry
+    return out
+
+
+def ensure_engine(current, cfg: FrrConfig) -> "FrrEngine":
+    """Reuse ``current`` when it already runs ``cfg.engine``, else build
+    a fresh engine (the graph/jit caches are per-engine).  The shared
+    lazy-create step for every protocol instance holding a
+    ``_frr_engine`` slot."""
+    if current is not None and current.engine == cfg.engine:
+        return current
+    return FrrEngine(engine=cfg.engine)
+
+
+class FrrEngine:
+    """Backup-table computation behind the SpfBackend-style interface."""
+
+    def __init__(
+        self,
+        engine: str = "scalar",
+        n_atoms: int = 64,
+        max_iters: int | None = None,
+    ):
+        self.engine = engine
+        self.n_atoms = n_atoms
+        self.max_iters = max_iters
+        self._jit = None  # built lazily (jax import on first TPU compute)
+        self._graph_cache: dict[tuple, object] = {}
+
+    # -- device path
+
+    def _prepare(self, topo: Topology):
+        import jax
+
+        from holo_tpu.ops.graph import build_ell
+        from holo_tpu.ops.spf_engine import device_graph_from_ell
+
+        key = topo.cache_key
+        g = self._graph_cache.get(key)
+        if g is None:
+            ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
+            g = jax.device_put(device_graph_from_ell(ell))
+            self._graph_cache[key] = g
+            while len(self._graph_cache) > 4:
+                self._graph_cache.pop(next(iter(self._graph_cache)))
+        return g
+
+    def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
+        import jax
+
+        from holo_tpu.frr.kernel import frr_batch
+
+        if self._jit is None:
+            self._jit = jax.jit(
+                lambda g, root, lf, lc, lv, em, an, ac, al, av: frr_batch(
+                    g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
+                )
+            )
+        g = self._prepare(topo)
+        out = self._jit(
+            g,
+            topo.root,
+            fin.link_far,
+            fin.link_cost,
+            fin.link_valid,
+            fin.edge_masks,
+            fin.adj_nbr,
+            fin.adj_cost,
+            fin.adj_link,
+            fin.adj_valid,
+        )
+        nl = fin.n_links
+        return BackupTable(
+            inputs=fin,
+            root=int(topo.root),
+            lfa_adj=np.asarray(out.lfa_adj)[:nl],
+            lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
+            rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
+            tilfa_p=np.asarray(out.tilfa_p)[:nl],
+            tilfa_q=np.asarray(out.tilfa_q)[:nl],
+            post_dist=np.asarray(out.post_dist)[:nl],
+            post_nh=np.asarray(out.post_nh)[:nl],
+        )
+
+    # -- dispatch
+
+    def compute(self, topo: Topology) -> BackupTable:
+        """One batched backup-table computation for ``topo.root``."""
+        fin = marshal_frr(topo)
+        if self.engine == "tpu":
+            return self._compute_tpu(topo, fin)
+        from holo_tpu.frr.scalar import frr_reference
+
+        return frr_reference(topo, self.n_atoms, inputs=fin)

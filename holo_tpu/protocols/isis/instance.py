@@ -382,6 +382,14 @@ class IsisInstance(Actor):
         # sysid] = iface names whose neighbor is adjacent to that sender.
         self.flooding_reduction = False
         self._covered_by: dict[bytes, set[str]] = {}
+        # IP fast reroute (holo_tpu.frr.FrrConfig; None = disabled):
+        # the default-topology backup table is refreshed by every full
+        # SPF; frr_backups maps prefix -> {primary (if, addr) ->
+        # (backup, labels)} for the RIB feed.
+        self.frr = None
+        self.frr_tables: dict = {}
+        self.frr_backups: dict = {}
+        self._frr_engine = None
 
     def attach(self, loop_):
         super().attach(loop_)
@@ -1986,6 +1994,17 @@ class IsisInstance(Actor):
 
             topo, atoms4 = _build(lambda k, node: node["is"], 0)
             res4 = self.backend.compute(topo)
+            # IP-FRR: the default-topology backup batch rides the full
+            # SPF (route-only runs keep the tables — the IS graph is
+            # unchanged by definition of RouteOnly).
+            frr_cfg = self.frr
+            if frr_cfg is not None and frr_cfg.active():
+                from holo_tpu.frr.manager import ensure_engine
+
+                self._frr_engine = ensure_engine(self._frr_engine, frr_cfg)
+                self.frr_tables = {0: self._frr_engine.compute(topo)}
+            else:
+                self.frr_tables = {}
             self.vertex_dist = {
                 k[:6]: int(res4.dist[index[k]])
                 for k in nodes
@@ -2064,13 +2083,20 @@ class IsisInstance(Actor):
         # vertex (ourselves): the reference marks these CONNECTED and
         # never installs them (route.rs:86-88,285-301).
         connected: set = set()
+        # Winning SPT vertex per prefix (FRR consumption key): (v, v6?).
+        vertex_of: dict = {}
 
-        def _add(prefix, total, nhs, external=False, local=False):
+        def _add(prefix, total, nhs, external=False, local=False, vertex=-1,
+                 want_v6=False):
             rank = (external, total)
             cur = rank_of.get(prefix)
             if cur is None or rank < cur:
                 rank_of[prefix] = rank
                 routes[prefix] = (total, _clamp(nhs))
+                if vertex >= 0 and not local:
+                    vertex_of[prefix] = (vertex, want_v6)
+                else:
+                    vertex_of.pop(prefix, None)
                 if local:
                     connected.add(prefix)
                 else:
@@ -2100,13 +2126,13 @@ class IsisInstance(Actor):
                 nhs4 = _af_nexthops(res4, atoms4, v, False)
                 for reach in node["ip"]:
                     _add(reach.prefix, int(res4.dist[v]) + reach.metric,
-                         nhs4, reach.external, local=local)
+                         nhs4, reach.external, local=local, vertex=v)
             ip6_list = node["ip6mt"] if mt6 else node["ip6"]
             if af6 and res6.dist[v] < INF and ip6_list:
                 nhs6 = _af_nexthops(res6, atoms6, v, True)
                 for reach in ip6_list:
                     _add(reach.prefix, int(res6.dist[v]) + reach.metric,
-                         nhs6, local=local)
+                         nhs6, local=local, vertex=v, want_v6=True)
 
         # Level-1 routers that are not themselves attached install a
         # per-AF default route toward the nearest attached router(s),
@@ -2148,6 +2174,40 @@ class IsisInstance(Actor):
                         nhs |= cur
                 if best is not None:
                     _add(default, best, nhs)
+        # IP-FRR: join the default-topology backup table onto the route
+        # table.  Direct LFAs only (no SR tunnel encapsulation wired for
+        # the repair path here); the MT-2 IPv6 overlay is a separate
+        # graph the default-topology table does not cover.
+        self.frr_backups = {}
+        frr_cfg = self.frr
+        table = self.frr_tables.get(0)
+        if frr_cfg is not None and frr_cfg.active() and table is not None:
+            from holo_tpu.frr.manager import repair_map
+
+            # Prefixes sharing a terminating vertex share the repair map.
+            memo: dict[tuple, dict] = {}
+            for prefix, (v, want_v6) in vertex_of.items():
+                if want_v6 and mt6:
+                    continue
+                res_, atoms_ = (res6, atoms6) if want_v6 else (res4, atoms4)
+                repairs = memo.get((want_v6, v))
+                if repairs is None:
+                    repairs = memo[(want_v6, v)] = repair_map(
+                        table, frr_cfg, res_.nexthop_words[v], v
+                    )
+                backups = {}
+                for a, entry in repairs.items():
+                    if entry.kind != "lfa":
+                        continue
+                    ifn, p4, p6 = atoms_[a]
+                    bifn, b4, b6 = atoms_[entry.atom]
+                    paddr, baddr = (p6, b6) if want_v6 else (p4, b4)
+                    if paddr is None or baddr is None:
+                        continue
+                    backups[(ifn, paddr)] = ((bifn, baddr), ())
+                if backups:
+                    self.frr_backups[prefix] = backups
+
         # SPF run log ring (reference spf.rs log_spf_run): records the
         # Full/RouteOnly split for operational state.
         self.spf_log.append(

@@ -50,6 +50,25 @@ class IsisLevelAllInstance(Actor):
         self.l1.notif_cb = cb
         self.l2.notif_cb = cb
 
+    @property
+    def frr(self):
+        return self.l1.frr
+
+    @frr.setter
+    def frr(self, cfg):
+        # IP fast reroute applies per level (each level's SPF computes
+        # its own backup tables over its own IS graph).
+        self.l1.frr = cfg
+        self.l2.frr = cfg
+
+    @property
+    def frr_backups(self) -> dict:
+        """Merged per-prefix repairs, same precedence as the route merge
+        (L1 wins where both levels reach a prefix)."""
+        merged = dict(self.l2.frr_backups)
+        merged.update(self.l1.frr_backups)
+        return merged
+
     def __init__(self, name: str, sysid: bytes, area: bytes, netio=None,
                  spf_backend_factory=None, route_cb=None, **kw):
         self.name = name
@@ -322,6 +341,12 @@ class IsisLevelAllInstance(Actor):
             if p not in self.connected_prefixes
             and (p in self.summary_prefixes or r[1])
         }
+
+    def _schedule_spf(self, topology: bool = True) -> None:
+        # Config-driven reschedule (e.g. a fast-reroute change) applies
+        # to both levels, like the frr setter above.
+        for inst in self.instances():
+            inst._schedule_spf(topology)
 
     def run_spf(self, level: int | None = None) -> None:
         for inst in self.instances():

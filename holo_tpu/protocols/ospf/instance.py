@@ -42,6 +42,7 @@ from holo_tpu.protocols.ospf.neighbor import (
 )
 from holo_tpu.protocols.ospf.packet import (
     MAX_AGE,
+    MAX_LINK_METRIC,
     AuthType,
     DbDesc,
     DbDescFlags,
@@ -167,6 +168,14 @@ class InstanceConfig:
     virtual_links: tuple = ()
     vlink_hello_interval: int = 10
     vlink_dead_interval: int = 60
+    # IP fast reroute (holo_tpu.frr.FrrConfig; None = disabled): after
+    # every full SPF one batched backup-table run per area precomputes
+    # LFA/remote-LFA/TI-LFA repairs, attached to published routes.
+    frr: object = None
+    # RFC 6987 stub-router: advertise MaxLinkMetric (0xFFFF) on every
+    # transit/p2p link so neighbors route around us while our own
+    # adjacencies and stub prefixes stay reachable (maintenance mode).
+    stub_router: bool = False
     # Interop knobs for replaying the reference's recorded exchanges
     # (tools/stepwise.py): seed DD seqnos like the reference's
     # 'deterministic' build, and override the §13(5a) arrival throttle
@@ -327,6 +336,12 @@ class OspfInstance(Actor):
         self._nssa_translated: set[IPv4Network] = set()
         # Segment routing state (labels resolved after each SPF).
         self.sr_labels: dict = {}
+        # IP-FRR backup tables (area_id -> BackupTable), refreshed by
+        # every full SPF run; partial runs keep them (no topology change
+        # by definition).  The engine persists for its shape-bucket
+        # compile cache.
+        self.frr_tables: dict = {}
+        self._frr_engine = None
         self.bier_routes: dict = {}
         # Shared opaque-id allocator for RFC 7684 extended-prefix LSAs:
         # keys are ("sr", prefix) and ("bier", sd_id); ids never reused.
@@ -408,9 +423,10 @@ class OspfInstance(Actor):
         """RFC 7770 Router-Information opaque LSA (one per area).
 
         Advertises the informational capabilities the instance actually
-        has: GR helper (gr.rs) and stub-router support (reference
-        holo-ospf originates the same pair at area start).  Returns
-        (lsid, body) for the deferred-check queue.
+        has: GR helper (gr.rs) and stub-router support — real since
+        ``set_stub_router`` implements the RFC 6987 max-metric behavior
+        (reference holo-ospf originates the same pair at area start).
+        Returns (lsid, body) for the deferred-check queue.
         """
         from holo_tpu.protocols.ospf.packet import (
             RI_CAP_GR_HELPER,
@@ -430,6 +446,17 @@ class OspfInstance(Actor):
             ),
         )
 
+
+    def set_stub_router(self, enabled: bool) -> None:
+        """RFC 6987 stub-router (max-metric) maintenance mode: flip the
+        leaf and re-originate every area's router-LSA with MaxLinkMetric
+        on transit links (reference: the same leaf re-triggers
+        lsa_orig_router)."""
+        if enabled == self.config.stub_router:
+            return
+        self.config.stub_router = enabled
+        for area in self.areas.values():
+            self._originate_router_lsa(area)
 
     def set_node_tags(self, tags: tuple[int, ...]) -> None:
         """RFC 7777 node administrative tags (RI LSA, re-originated on
@@ -1210,6 +1237,9 @@ class OspfInstance(Actor):
         dead: int | None = None,
         priority: int | None = None,
         passive: bool | None = None,
+        mtu: int | None = None,
+        mtu_ignore: bool | None = None,
+        transmit_delay: int | None = None,
     ) -> None:
         """Live interface reconfiguration beyond cost (reference
         northbound InterfaceUpdate family).
@@ -1234,6 +1264,14 @@ class OspfInstance(Actor):
             cfg.dead_interval = dead
         if priority is not None:
             cfg.priority = priority
+        if mtu is not None:
+            # The §10.6 DD Interface-MTU check reads this live — a stale
+            # creation-time snapshot would wedge jumbo adjacencies.
+            cfg.mtu = mtu
+        if mtu_ignore is not None:
+            cfg.mtu_ignore = mtu_ignore
+        if transmit_delay is not None:
+            cfg.transmit_delay = transmit_delay
         if passive is not None and cfg.passive != passive:
             cfg.passive = passive
             if iface.state == IsmState.DOWN:
@@ -1601,6 +1639,15 @@ class OspfInstance(Actor):
                 return
         if nbr.state < NsmState.EX_START:
             return
+        # §10.6: reject a DD whose Interface MTU exceeds what we can
+        # receive unfragmented, unless mtu-ignore is set.  Virtual links
+        # carry MTU 0 and are exempt (§10.8).
+        if (
+            dd.mtu > iface.config.mtu
+            and not iface.config.mtu_ignore
+            and iface.config.if_type != IfType.VIRTUAL_LINK
+        ):
+            return
         if nbr.state == NsmState.EX_START:
             negotiated = False
             if (
@@ -1731,25 +1778,27 @@ class OspfInstance(Actor):
             if e is None:
                 self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
                 return
-            lsas.append(self._aged_copy(e))
+            lsas.append(self._aged_copy(e, iface.config.transmit_delay))
         if lsas:
             self._send(iface, nbr.src, LsUpdate(lsas), area)
 
-    def _aged_copy(self, entry) -> Lsa:
-        """LSA with age advanced to now (for tx; §13.1 InfTransDelay ~1s)."""
+    def _aged_copy(self, entry, delay: int = 0) -> Lsa:
+        """LSA with age advanced to now plus the outgoing interface's
+        InfTransDelay (§13.1/§13.3), capped at MaxAge.  The copy/patch
+        step is the shared ``lsa_tx_copy``, expressed as the delta from
+        the stored age to (current age + delay)."""
         lsa = entry.lsa
-        age = entry.current_age(self.loop.clock.now())
-        if age == lsa.age:
-            return lsa
-        import copy
+        from holo_tpu.protocols.ospf.packet import lsa_tx_copy
 
-        out = copy.copy(lsa)
-        out.age = age
-        if lsa.raw:
-            raw = bytearray(lsa.raw)
-            raw[0:2] = age.to_bytes(2, "big")
-            out.raw = bytes(raw)
-        return out
+        age = min(entry.current_age(self.loop.clock.now()) + delay, MAX_AGE)
+        return lsa_tx_copy(lsa, age - lsa.age)
+
+    @staticmethod
+    def _tx_copy(lsa: Lsa, delay: int) -> Lsa:
+        """§13.3 InfTransDelay age increment (shared helper)."""
+        from holo_tpu.protocols.ospf.packet import lsa_tx_copy
+
+        return lsa_tx_copy(lsa, delay)
 
     @staticmethod
     def _validate_lsa(lsa: Lsa) -> str | None:
@@ -1862,7 +1911,14 @@ class OspfInstance(Actor):
                     self._send(iface, nbr.src, LsAck([lsa]), area)
             else:
                 # DB copy is newer: send it back directly (§13 (8)).
-                self._send(iface, nbr.src, LsUpdate([self._aged_copy(cur)]), area)
+                self._send(
+                    iface,
+                    nbr.src,
+                    LsUpdate(
+                        [self._aged_copy(cur, iface.config.transmit_delay)]
+                    ),
+                    area,
+                )
             # Fulfilled request?
             if lsa.key in nbr.ls_request:
                 req = nbr.ls_request[lsa.key]
@@ -2023,7 +2079,12 @@ class OspfInstance(Actor):
                     continue
             if iface is from_iface:
                 flooded_back = True
-            self._send(iface, ALL_SPF_RTRS_V4, LsUpdate([lsa]), area)
+            self._send(
+                iface,
+                ALL_SPF_RTRS_V4,
+                LsUpdate([self._tx_copy(lsa, iface.config.transmit_delay)]),
+                area,
+            )
         return flooded_back
 
     def _arm_rxmt(self, iface: OspfInterface, nbr: Neighbor) -> None:
@@ -2050,7 +2111,10 @@ class OspfInstance(Actor):
         if nbr.state == NsmState.LOADING and nbr.ls_request:
             self._send_ls_request(area, iface, nbr)
         if nbr.ls_rxmt:
-            lsas = list(nbr.ls_rxmt.values())[:20]
+            lsas = [
+                self._tx_copy(l, iface.config.transmit_delay)
+                for l in list(nbr.ls_rxmt.values())[:20]
+            ]
             self._send(iface, nbr.src, LsUpdate(lsas), area)
         if (
             nbr.state in (NsmState.EX_START, NsmState.EXCHANGE, NsmState.LOADING)
@@ -2351,6 +2415,13 @@ class OspfInstance(Actor):
 
     def _build_router_lsa(self, area: Area) -> "LsaRouter":
         links: list[RouterLink] = []
+        # RFC 6987 stub-router: transit-traffic links (p2p, transit,
+        # vlink) advertise MaxLinkMetric so neighbors route around us;
+        # stub links keep their real cost so our own prefixes stay
+        # reachable (maintenance mode).
+        def transit_cost(cost: int) -> int:
+            return MAX_LINK_METRIC if self.config.stub_router else cost
+
         # Real interfaces first, loopback host routes last (matches the
         # reference's router-LSA build order).
         ifaces = sorted(
@@ -2370,7 +2441,7 @@ class OspfInstance(Actor):
                                 RouterLinkType.VIRTUAL_LINK,
                                 nbr.router_id,
                                 iface.addr_ip,
-                                iface.config.cost,
+                                transit_cost(iface.config.cost),
                             )
                         )
                 continue
@@ -2393,7 +2464,8 @@ class OspfInstance(Actor):
                     if self._nbr_counts_full(nbr):
                         links.append(
                             RouterLink(RouterLinkType.POINT_TO_POINT,
-                                       nbr.router_id, iface.addr_ip, cost)
+                                       nbr.router_id, iface.addr_ip,
+                                       transit_cost(cost))
                         )
                 links.append(
                     RouterLink(RouterLinkType.STUB_NETWORK,
@@ -2411,7 +2483,8 @@ class OspfInstance(Actor):
                 if iface.state >= IsmState.DR_OTHER and (dr_full or we_are_dr_with_full):
                     links.append(
                         RouterLink(RouterLinkType.TRANSIT_NETWORK,
-                                   iface.dr, iface.addr_ip, cost)
+                                   iface.dr, iface.addr_ip,
+                                   transit_cost(cost))
                     )
                 else:
                     links.append(
@@ -2693,6 +2766,19 @@ class OspfInstance(Actor):
                 ):
                     all_routes[prefix] = route
 
+        # IP-FRR: one batched backup-table dispatch per area right after
+        # the primary SPF (the reference hangs TI-LFA off the same
+        # moment) — all-roots distance matrix + per-link post-convergence
+        # planes + vectorized LFA/rLFA/TI-LFA selection.
+        engine = self._frr_engine_for()
+        if engine is not None:
+            self.frr_tables = {
+                aid: engine.compute(st.topo)
+                for aid, (st, _res) in area_results.items()
+            }
+        else:
+            self.frr_tables = {}
+
         # Inter-area routes (RFC 2328 §16.2): shared consumption stage
         # (also used by the partial run with a prefix scope).
         intra_prefixes = set(all_routes.keys())
@@ -2808,15 +2894,23 @@ class OspfInstance(Actor):
                     # externals after this stage.
                     cur = None
                 if cur is None or dist < cur.dist:
-                    route = IntraRoute(prefix, dist, nhs, area.area_id, "inter")
+                    # vertex = the advertising ABR: FRR protects the
+                    # path toward the area-exit router (the repair
+                    # covers the intra-area leg, like the reference).
+                    route = IntraRoute(
+                        prefix, dist, nhs, area.area_id, "inter", vertex=abr_v
+                    )
                     routes[prefix] = route
                     inter_routes[prefix] = route
                     changed = True
                 elif dist == cur.dist and cur.rtype == "inter":
-                    # Equal-cost inter-area paths union their next hops
-                    # (area_id reflects the latest contributing area).
+                    # Equal-cost inter-area paths union their next hops.
+                    # (area_id, vertex) is the FRR consumption key and
+                    # must stay a consistent pair — keep the first
+                    # contributing area's, like the v3 merge.
                     route = IntraRoute(
-                        prefix, dist, cur.nexthops | nhs, area.area_id, "inter"
+                        prefix, dist, cur.nexthops | nhs, cur.area_id,
+                        "inter", vertex=cur.vertex,
                     )
                     routes[prefix] = route
                     inter_routes[prefix] = route
@@ -3522,11 +3616,52 @@ class OspfInstance(Actor):
                     )
         return out
 
+    def _frr_engine_for(self):
+        """The instance's FrrEngine when fast reroute is configured."""
+        cfg = self.config.frr
+        if cfg is None or not cfg.active():
+            return None
+        from holo_tpu.frr.manager import ensure_engine
+
+        self._frr_engine = ensure_engine(self._frr_engine, cfg)
+        return self._frr_engine
+
+    def _attach_frr_backups(self, all_routes: dict) -> None:
+        """Join the per-area backup tables onto the route table (runs
+        after SR label resolution: remote/TI-LFA repairs tunnel through
+        node-SID labels and attach only when the stack resolves)."""
+        cfg = self.config.frr
+        if (
+            cfg is None
+            or not cfg.active()
+            or not self.frr_tables
+            or self._spf_cache is None
+        ):
+            return
+        from holo_tpu.protocols.ospf.spf_run import attach_frr_backups
+
+        # Per-area vertex -> node-SID label maps (vertex ids are area
+        # scoped; the SID of a router's host prefix stands for the node).
+        vlabels: dict = {}
+        for _prefix, (label, route) in self.sr_labels.items():
+            v = getattr(route, "vertex", -1)
+            if v >= 0:
+                vlabels.setdefault(route.area_id, {}).setdefault(v, label)
+        for aid, (st, res) in self._spf_cache["area_results"].items():
+            table = self.frr_tables.get(aid)
+            if table is None:
+                continue
+            label_of = vlabels.get(aid, {}).get if cfg.ti_lfa or cfg.remote_lfa else None
+            attach_frr_backups(
+                st, res, all_routes, table, cfg, label_of, area_id=aid
+            )
+
     def _finish_spf(self, all_routes: dict) -> None:
         self._originate_prefix_sids()
         self._originate_bier()
         self.bier_routes = self._resolve_bier(all_routes)
         self.sr_labels = self._resolve_sr_labels(all_routes)
+        self._attach_frr_backups(all_routes)
         old = self.routes
         self.routes = all_routes
         if self.route_cb is not None:
@@ -3567,7 +3702,12 @@ class OspfInstance(Actor):
                 uninstall(prefix)
         for prefix, route in new.items():
             prev = old.get(prefix)
-            if prev is not None and prev.dist == route.dist and prev.nexthops == route.nexthops:
+            if (
+                prev is not None
+                and prev.dist == route.dist
+                and prev.nexthops == route.nexthops
+                and getattr(prev, "backups", None) == getattr(route, "backups", None)
+            ):
                 continue
             if not installable(route):
                 # A previously-installed route degrading to connected
@@ -3588,6 +3728,22 @@ class OspfInstance(Actor):
                 for nh in route.nexthops
                 if nh.addr is not None
             )
+            backups = {}
+            for pnh, (bnh, labels) in (getattr(route, "backups", None) or {}).items():
+                if pnh.addr is None or bnh.addr is None:
+                    continue
+                backups[
+                    Nexthop(
+                        addr=pnh.addr,
+                        ifname=pnh.ifname,
+                        ifindex=self._ifindex_of(pnh.ifname),
+                    )
+                ] = Nexthop(
+                    addr=bnh.addr,
+                    ifname=bnh.ifname,
+                    ifindex=self._ifindex_of(bnh.ifname),
+                    labels=tuple(labels),
+                )
             installed.add(prefix)
             self.ibus.request(
                 self.routing_actor,
@@ -3597,6 +3753,7 @@ class OspfInstance(Actor):
                     distance=self._route_distance(route),
                     metric=route.dist,
                     nexthops=nhs,
+                    backups=backups,
                 ),
                 sender=self.name,
             )

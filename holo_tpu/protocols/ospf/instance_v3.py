@@ -48,6 +48,10 @@ class V3IfConfig:
     dead_interval: int = 40
     rxmt_interval: int = 5
     mtu: int = 1500
+    # RFC 2328 §10.6 / RFC 5340: DD Interface-MTU check bypass and the
+    # §13.3 InfTransDelay LSA age increment (ietf-ospf interface leaves).
+    mtu_ignore: bool = False
+    transmit_delay: int = 1
     instance_id: int = 0
     if_type: IfType = IfType.POINT_TO_POINT
     priority: int = 1
@@ -137,6 +141,11 @@ class V6Route:
     prefix_options: int = 0
     # Area that contributed the winning path (None for external).
     area_id: object = None
+    # SPT vertex the winning path terminates at (-1 when not derived
+    # from an SPT vertex) — the IP-FRR consumption key.
+    vertex: int = -1
+    # IP-FRR repairs: {primary (ifname, ll-addr) -> (backup, labels)}.
+    backups: dict | None = None
 
 
 @dataclass
@@ -192,6 +201,14 @@ class OspfV3Instance(Actor):
         # v6 prefixes we redistribute as AS-external LSAs (ASBR duty).
         self.redistributed: dict[IPv6Network, int] = {}  # prefix -> metric
         self.spf_run_count = 0
+        # IP fast reroute (holo_tpu.frr.FrrConfig; None = disabled) and
+        # the per-area backup tables the area SPF refreshes.
+        self.frr = None
+        self.frr_tables: dict = {}
+        self._frr_engine = None
+        # RFC 6987 stub-router: MaxLinkMetric on transit/p2p router-LSA
+        # links (maintenance mode; same leaf as the v2 instance).
+        self.stub_router = False
         # Full-vs-partial classification (reference ospfv3/spf.rs:97-163):
         # changed LSAs accumulate as (new, old) pairs; non-LSA events
         # force Full.  The cache keeps the last full run's SPTs + route
@@ -609,6 +626,10 @@ class OspfV3Instance(Actor):
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EX_START:
             return
+        # RFC 2328 §10.6 (per RFC 5340 §4.2.2 unchanged): reject a DD
+        # whose Interface MTU exceeds ours, unless mtu-ignore is set.
+        if dd.mtu > iface.config.mtu and not iface.config.mtu_ignore:
+            return
         F = P.DbDescFlags
         if nbr.state == NsmState.EX_START:
             negotiated = False
@@ -714,6 +735,14 @@ class OspfV3Instance(Actor):
             self._send(iface, nbr.src, P.LsRequest(keys))
             self._arm_rxmt(iface, nbr)
 
+    @staticmethod
+    def _tx_copy(lsa, delay: int):
+        """§13.3 InfTransDelay age increment (shared helper; RFC 5340
+        keeps the header layout and §13.3 unchanged)."""
+        from holo_tpu.protocols.ospf.packet import lsa_tx_copy
+
+        return lsa_tx_copy(lsa, delay, P.MAX_AGE)
+
     def _rx_ls_request(self, iface: V3Interface, src, pkt) -> None:
         nbr = iface.neighbors.get(pkt.router_id)
         if nbr is None or nbr.state < NsmState.EXCHANGE:
@@ -725,7 +754,7 @@ class OspfV3Instance(Actor):
             if e is None:
                 self._nbr_event(iface.name, pkt.router_id, NsmEvent.BAD_LS_REQ)
                 return
-            lsas.append(e.lsa)
+            lsas.append(self._tx_copy(e.lsa, iface.config.transmit_delay))
         if lsas:
             self._send(iface, nbr.src, P.LsUpdate(lsas))
 
@@ -770,7 +799,13 @@ class OspfV3Instance(Actor):
                 else:
                     self._send(iface, nbr.src, P.LsAck([lsa]))
             else:
-                self._send(iface, nbr.src, P.LsUpdate([cur.lsa]))
+                self._send(
+                    iface,
+                    nbr.src,
+                    P.LsUpdate(
+                        [self._tx_copy(cur.lsa, iface.config.transmit_delay)]
+                    ),
+                )
             if lsa.key in nbr.ls_request:
                 req = nbr.ls_request[lsa.key]
                 if lsa.compare(req) >= 0:
@@ -865,7 +900,13 @@ class OspfV3Instance(Actor):
                 sent = True
                 self._arm_rxmt(iface, nbr)
             if sent:
-                self._send(iface, ALL_SPF_RTRS_V6, P.LsUpdate([lsa]))
+                self._send(
+                    iface,
+                    ALL_SPF_RTRS_V6,
+                    P.LsUpdate(
+                        [self._tx_copy(lsa, iface.config.transmit_delay)]
+                    ),
+                )
         if lsa.is_maxage:
             # The MaxAge copy STAYS installed until every retransmission
             # list drains and no neighbor is in Exchange/Loading — the
@@ -918,7 +959,14 @@ class OspfV3Instance(Actor):
             self._send_ls_request(iface, nbr)
         if nbr.ls_rxmt:
             self._send(
-                iface, nbr.src, P.LsUpdate(list(nbr.ls_rxmt.values())[:20])
+                iface,
+                nbr.src,
+                P.LsUpdate(
+                    [
+                        self._tx_copy(l, iface.config.transmit_delay)
+                        for l in list(nbr.ls_rxmt.values())[:20]
+                    ]
+                ),
             )
         if (
             nbr.state in (NsmState.EX_START, NsmState.EXCHANGE, NsmState.LOADING)
@@ -1015,6 +1063,14 @@ class OspfV3Instance(Actor):
         for area in self.areas.values():
             self._originate_router_lsa_area(area)
 
+    def set_stub_router(self, enabled: bool) -> None:
+        """RFC 6987 stub-router (max-metric) maintenance mode: flip the
+        leaf and re-originate every area's router-LSA."""
+        if enabled == self.stub_router:
+            return
+        self.stub_router = enabled
+        self._originate_router_lsa()
+
     def _originate_router_lsa_area(self, area: V3Area) -> None:
         links = []
         flags = P.RouterFlags(0)
@@ -1022,6 +1078,14 @@ class OspfV3Instance(Actor):
             flags |= P.RouterFlags.B
         if self.redistributed and not area.no_external:
             flags |= P.RouterFlags.E
+        # RFC 6987 stub-router: every router-LSA link (all v3 router
+        # links are transit — prefixes live in intra-area-prefix LSAs,
+        # which keep their real metric) advertises MaxLinkMetric.
+        from holo_tpu.protocols.ospf.packet import MAX_LINK_METRIC
+
+        def transit_cost(cost: int) -> int:
+            return MAX_LINK_METRIC if self.stub_router else cost
+
         for iface in self._area_ifaces(area):
             if not iface.up:
                 continue
@@ -1032,7 +1096,7 @@ class OspfV3Instance(Actor):
                     links.append(
                         P.RouterLinkV3(
                             P.RouterLinkType.TRANSIT_NETWORK,
-                            iface.config.cost,
+                            transit_cost(iface.config.cost),
                             iface.iface_id,
                             self._dr_iface_id(iface),
                             iface.dr,
@@ -1044,7 +1108,7 @@ class OspfV3Instance(Actor):
                     links.append(
                         P.RouterLinkV3(
                             P.RouterLinkType.POINT_TO_POINT,
-                            iface.config.cost,
+                            transit_cost(iface.config.cost),
                             iface.iface_id,
                             nbr.iface_id,
                             nbr.router_id,
@@ -1127,7 +1191,8 @@ class OspfV3Instance(Actor):
     def _originate_router_information(self, area: V3Area) -> None:
         """RFC 7770 Router-Information LSA, one per area (the v3 analog
         of v2's RI opaque; the reference originates GR-helper +
-        stub-router capabilities at area start)."""
+        stub-router capabilities at area start — both real here:
+        ``set_stub_router`` implements the RFC 6987 max-metric mode)."""
         from holo_tpu.protocols.ospf.packet import (
             RI_CAP_GR_HELPER,
             RI_CAP_STUB_ROUTER,
@@ -1354,6 +1419,9 @@ class OspfV3Instance(Actor):
         dead: int | None = None,
         priority: int | None = None,
         passive: bool | None = None,
+        mtu: int | None = None,
+        mtu_ignore: bool | None = None,
+        transmit_delay: int | None = None,
     ) -> None:
         """Live interface reconfiguration beyond cost (the v2
         iface_update analog): hello/dead apply from the next hello (the
@@ -1371,6 +1439,13 @@ class OspfV3Instance(Actor):
             cfg.dead_interval = dead
         if priority is not None:
             cfg.priority = priority
+        if mtu is not None:
+            # Live input to the §10.6 DD Interface-MTU check.
+            cfg.mtu = mtu
+        if mtu_ignore is not None:
+            cfg.mtu_ignore = mtu_ignore
+        if transmit_delay is not None:
+            cfg.transmit_delay = transmit_delay
         if passive is not None and cfg.passive != passive:
             cfg.passive = passive
             if passive:
@@ -1504,13 +1579,13 @@ class OspfV3Instance(Actor):
                     if cur is None or total < cur.dist:
                         intra[prefix] = V6Route(
                             prefix, total, nhs, prefix_options=opts,
-                            area_id=aid,
+                            area_id=aid, vertex=v,
                         )
                     elif total == cur.dist:
                         intra[prefix] = V6Route(
                             prefix, total, cur.nexthops | nhs,
                             prefix_options=cur.prefix_options,
-                            area_id=aid,
+                            area_id=aid, vertex=cur.vertex,
                         )
             intra_by_area[aid] = intra
             for prefix, route in intra.items():
@@ -1518,9 +1593,14 @@ class OspfV3Instance(Actor):
                 if cur is None or route.dist < cur.dist:
                     routes[prefix] = route
                 elif route.dist == cur.dist:
+                    # Cross-area ECMP union keeps the first contributing
+                    # area's (area_id, vertex) — the FRR consumption key
+                    # must stay a consistent pair.
                     routes[prefix] = V6Route(
                         prefix, route.dist, cur.nexthops | route.nexthops,
                         route_type=cur.route_type,
+                        prefix_options=cur.prefix_options,
+                        area_id=cur.area_id, vertex=cur.vertex,
                     )
 
         # 2. inter-area routes from received Inter-Area-Prefix LSAs:
@@ -1563,9 +1643,50 @@ class OspfV3Instance(Actor):
             "routes": routes,
             "inter_routes": inter_routes,
         }
+        self._attach_frr_backups(routes, area_results)
         self.routes = routes
         if self.route_cb is not None:
             self.route_cb(routes)
+
+    def _attach_frr_backups(self, routes: dict, area_results: dict) -> None:
+        """Join the per-area backup tables onto the v6 route table.
+
+        Direct LFAs only: OSPFv3 here has no SRv6/SRH machinery to
+        encapsulate a remote-LFA or TI-LFA repair, so tunnel repairs
+        stay in ``frr_tables`` (operational visibility) without a
+        forwarding entry — RFC 7490 §2's encapsulation requirement."""
+        cfg = self.frr
+        if cfg is None or not cfg.active() or not self.frr_tables:
+            return
+        from holo_tpu.frr.manager import repair_map
+        from holo_tpu.protocols.ospf.spf_run import NexthopAtom
+
+        # Prefixes sharing a terminating vertex share the repair map.
+        memo: dict[tuple, dict] = {}
+        for route in routes.values():
+            v = getattr(route, "vertex", -1)
+            out = area_results.get(route.area_id)
+            table = self.frr_tables.get(route.area_id)
+            if v < 0 or out is None or table is None:
+                continue
+            _index, _keys, res, atoms, _pl = out
+            repairs = memo.get((route.area_id, v))
+            if repairs is None:
+                repairs = memo[(route.area_id, v)] = repair_map(
+                    table, cfg, res.nexthop_words[v], v
+                )
+            backups = {}
+            for a, entry in repairs.items():
+                if entry.kind != "lfa":
+                    continue
+                atom, batom = atoms[a], atoms[entry.atom]
+                if isinstance(atom, NexthopAtom) or isinstance(
+                    batom, NexthopAtom
+                ):
+                    continue  # vlink bundles: no single protected link
+                backups[atom] = (batom, ())
+            if backups:
+                route.backups = backups
 
     def _derive_inter_area(
         self, area_results: dict, inter_routes: dict, only: set | None = None
@@ -1603,14 +1724,14 @@ class OspfV3Instance(Actor):
                     inter_routes[prefix] = V6Route(
                         prefix, dist, nhs, route_type="inter-area",
                         prefix_options=lsa.body.prefix_options,
-                        area_id=aid,
+                        area_id=aid, vertex=abr_v,
                     )
                 elif dist == cur.dist:
                     inter_routes[prefix] = V6Route(
                         prefix, dist, cur.nexthops | nhs,
                         route_type="inter-area",
                         prefix_options=cur.prefix_options,
-                        area_id=cur.area_id,
+                        area_id=cur.area_id, vertex=cur.vertex,
                     )
 
     def _derive_external(
@@ -1740,13 +1861,13 @@ class OspfV3Instance(Actor):
                         if cur is None or total < cur.dist:
                             intra[prefix] = V6Route(
                                 prefix, total, nhs, prefix_options=opts,
-                                area_id=aid,
+                                area_id=aid, vertex=v,
                             )
                         elif total == cur.dist:
                             intra[prefix] = V6Route(
                                 prefix, total, cur.nexthops | nhs,
                                 prefix_options=cur.prefix_options,
-                                area_id=aid,
+                                area_id=aid, vertex=cur.vertex,
                             )
             # Merge the recomputed intra winners across areas (same
             # preference as the full run: lowest dist, ECMP union).
@@ -1761,10 +1882,14 @@ class OspfV3Instance(Actor):
                     if cur is None or route.dist < cur.dist:
                         routes[prefix] = route
                     elif route.dist == cur.dist:
+                        # Same cross-area ECMP merge as the full run:
+                        # keep the first area's FRR consumption key.
                         routes[prefix] = V6Route(
                             prefix, route.dist,
                             cur.nexthops | route.nexthops,
                             route_type=cur.route_type,
+                            prefix_options=cur.prefix_options,
+                            area_id=cur.area_id, vertex=cur.vertex,
                         )
             # Prefixes now without an intra path fall back to a cached
             # inter-area candidate, else to the external stage.
@@ -1832,6 +1957,10 @@ class OspfV3Instance(Actor):
         del self.spf_log[:-32]
         cache["routes"] = routes
         cache["inter_routes"] = inter_routes
+        # Rebuilt routes need their repairs re-joined like the full run,
+        # or a partial run would publish them backup-less and flap the
+        # kernel entries off/on their precomputed repairs.
+        self._attach_frr_backups(routes, area_results)
         self.routes = routes
         if self.route_cb is not None:
             self.route_cb(routes)
@@ -2158,6 +2287,16 @@ class OspfV3Instance(Actor):
         topo.touch()
 
         res = self.backend.compute(topo)
+        # IP-FRR: the area's backup-table batch rides the same SPF
+        # moment (all-roots matrix + per-link post-convergence planes).
+        cfg = self.frr
+        if cfg is not None and cfg.active():
+            from holo_tpu.frr.manager import ensure_engine
+
+            self._frr_engine = ensure_engine(self._frr_engine, cfg)
+            self.frr_tables[area.area_id] = self._frr_engine.compute(topo)
+        else:
+            self.frr_tables.pop(area.area_id, None)
         return index, keys, res, atoms, prefix_lsas
 
     # -- rx/tx

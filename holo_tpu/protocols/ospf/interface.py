@@ -53,6 +53,14 @@ class IfConfig:
     # kernel-loopback interfaces this way in the router-LSA build).
     loopback: bool = False
     mtu: int = 1500
+    # RFC 2328 §10.6: a DD whose Interface MTU exceeds ours is rejected
+    # (adjacency sticks in ExStart) unless mtu-ignore bypasses the check
+    # (ietf-ospf interface leaf of the same name).
+    mtu_ignore: bool = False
+    # §13.3 InfTransDelay: seconds added to every LSA's age when it is
+    # copied into an outgoing Link State Update on this interface
+    # (ietf-ospf transmit-delay leaf).
+    transmit_delay: int = 1
     bfd_enabled: bool = False
     auth: object = None  # AuthCtx (packet.py) or None
     # RFC 7684 prefix attribute flags advertised in extended-prefix

@@ -29,8 +29,32 @@ MAX_AGE = 3600  # seconds (RFC 2328 §B)
 LS_REFRESH_TIME = 1800
 MAX_AGE_DIFF = 900
 LS_INFINITY = 0xFFFFFF
+# RFC 6987 §2: the largest 16-bit router-link metric — a stub router
+# advertises it on transit links so neighbors avoid it for transit
+# traffic while its own prefixes stay reachable.
+MAX_LINK_METRIC = 0xFFFF
 INITIAL_SEQ_NO = -0x7FFFFFFF  # 0x80000001 signed
 MAX_SEQ_NO = 0x7FFFFFFF
+
+
+def lsa_tx_copy(lsa, delay: int, max_age: int = MAX_AGE):
+    """§13.3: LS age is incremented by the interface's InfTransDelay
+    (transmit-delay leaf) when copied into an outgoing LS Update, capped
+    at MaxAge.  The Fletcher checksum excludes the age field, so the raw
+    bytes only need the age halfword patched.  RFC 5340 keeps both the
+    header layout and §13.3 unchanged, so the v2 and v3 instances share
+    this one helper."""
+    if delay <= 0 or lsa.age >= max_age:
+        return lsa
+    import copy
+
+    out = copy.copy(lsa)
+    out.age = min(lsa.age + delay, max_age)
+    if lsa.raw:
+        raw = bytearray(lsa.raw)
+        raw[0:2] = out.age.to_bytes(2, "big")
+        out.raw = bytes(raw)
+    return out
 
 
 class PacketType(enum.IntEnum):

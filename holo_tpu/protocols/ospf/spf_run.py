@@ -275,6 +275,13 @@ class IntraRoute:
     # "nssa-2" — drives per-type admin distance and maps onto the
     # ietf-ospf route-type enumeration in operational state.
     rtype: str = "intra"
+    # SPF vertex the winning path terminates at (-1 when the route was
+    # not derived from an SPT vertex, e.g. externals): the IP-FRR
+    # consumption key — backup tables are indexed by destination vertex.
+    vertex: int = -1
+    # IP-FRR repairs attached after the backup-table run:
+    # {primary RouteNexthop -> (backup RouteNexthop, label stack)}.
+    backups: dict | None = None
 
 
 def atom_bits(words: np.ndarray, n_atoms: int) -> list[int]:
@@ -316,13 +323,17 @@ def derive_routes(
     """
     routes: dict[IPv4Network, IntraRoute] = {}
 
-    def offer(prefix, dist, nhs):
+    def offer(prefix, dist, nhs, vertex=-1):
         cur = routes.get(prefix)
         if cur is None or dist < cur.dist:
-            routes[prefix] = IntraRoute(prefix, dist, nhs, area_id)
+            routes[prefix] = IntraRoute(prefix, dist, nhs, area_id, vertex=vertex)
         elif dist == cur.dist:
+            # Equal-cost contributions union next hops; the first
+            # contributing vertex keeps the FRR consumption key (its
+            # backup covers the merged set's shared failure domain only
+            # approximately, matching the reference's per-route pick).
             routes[prefix] = IntraRoute(
-                prefix, dist, cur.nexthops | nhs, area_id
+                prefix, dist, cur.nexthops | nhs, area_id, vertex=cur.vertex
             )
 
     inv_net = {i: a for a, i in st.network_index.items()}
@@ -347,7 +358,7 @@ def derive_routes(
             if body is None:
                 continue
             prefix = apply_mask(inv_net[v], body.mask)
-            offer(prefix, int(res.dist[v]), nhs)
+            offer(prefix, int(res.dist[v]), nhs, vertex=v)
         else:
             body = rlsa.get(inv_rtr[v])
             if body is None:
@@ -355,5 +366,67 @@ def derive_routes(
             for link in body.links:
                 if link.link_type == RouterLinkType.STUB_NETWORK:
                     prefix = apply_mask(link.id, link.data)
-                    offer(prefix, int(res.dist[v]) + link.metric, nhs)
+                    offer(prefix, int(res.dist[v]) + link.metric, nhs, vertex=v)
     return routes
+
+
+def attach_frr_backups(
+    st: SpfTopology,
+    res: SpfResult,
+    routes: dict,
+    table,
+    cfg,
+    label_of_vertex=None,
+    area_id=None,
+) -> int:
+    """Attach precomputed repairs to routes derived from ``st``/``res``.
+
+    For every route whose winning path ends at an SPT vertex, each
+    primary next-hop atom maps (via the backup table's ``atom_link``) to
+    its protected link, and ``resolve_backup`` picks the repair.  Direct
+    LFAs attach as plain next hops; remote-LFA / TI-LFA repairs need a
+    tunnel to their release vertex, so they attach only when
+    ``label_of_vertex`` resolves a segment (node-SID label) for every
+    repair vertex — without SR there is no loop-free encapsulation and
+    the destination stays unprotected (RFC 7490 §2 applies).  Returns
+    the number of routes that gained at least one backup."""
+    from holo_tpu.frr.manager import repair_map
+
+    n = st.topo.n_vertices
+    attached = 0
+    # All prefixes terminating at the same SPT vertex share one repair
+    # map — memoize per vertex (O(reachable vertices), not O(routes)).
+    memo: dict[int, dict] = {}
+    for route in routes.values():
+        if area_id is not None and route.area_id != area_id:
+            continue
+        v = getattr(route, "vertex", -1)
+        if v < 0 or v >= n:
+            continue
+        repairs = memo.get(v)
+        if repairs is None:
+            repairs = memo[v] = repair_map(
+                table, cfg, res.nexthop_words[v], v
+            )
+        backups = {}
+        for a, entry in repairs.items():
+            atom = st.atoms[a]
+            batom = st.atoms[entry.atom]
+            if atom.expand is not None or batom.expand is not None:
+                continue  # vlink bundles have no single protected link
+            labels: tuple = ()
+            if entry.kind != "lfa":
+                if label_of_vertex is None:
+                    continue
+                resolved = [label_of_vertex(p) for p in entry.via]
+                if any(l is None for l in resolved):
+                    continue
+                labels = tuple(resolved)
+            backups[RouteNexthop(atom.ifname, atom.addr)] = (
+                RouteNexthop(batom.ifname, batom.addr),
+                labels,
+            )
+        if backups:
+            route.backups = backups
+            attached += 1
+    return attached

@@ -598,7 +598,11 @@ class NetlinkKernel(Kernel):
 
     # -- Kernel interface
 
-    def install(self, prefix, nexthops, proto: Protocol) -> None:
+    def install(self, prefix, nexthops, proto: Protocol, backups=None) -> None:
+        # ``backups`` (primary -> loop-free alternate) are intentionally
+        # not programmed here: Linux has no backup-nexthop attribute for
+        # IPv4/v6 routes, so the repair flip is a full RTM_NEWROUTE
+        # replace issued by RibManager.local_repair with the backup set.
         payload = self._route_payload(prefix, nexthops)
         self.nl.request_ack(RTM_NEWROUTE, NLM_F_CREATE | NLM_F_REPLACE, payload)
 

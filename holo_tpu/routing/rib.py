@@ -10,14 +10,18 @@ routes for tests; ``NetlinkKernel`` (daemon-only) talks rtnetlink.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Callable, NamedTuple
 
 from holo_tpu.utils.ibus import (
+    TOPIC_BFD_STATE,
+    TOPIC_INTERFACE_DEL,
+    TOPIC_INTERFACE_UPD,
     TOPIC_NHT_UPD,
     TOPIC_REDISTRIBUTE_ADD,
     TOPIC_REDISTRIBUTE_DEL,
     TOPIC_ROUTE_ADD,
     TOPIC_ROUTE_DEL,
+    BfdStateUpd,
     Ibus,
     IbusMsg,
 )
@@ -27,6 +31,7 @@ from holo_tpu.utils.southbound import (
     LabelInstallMsg,
     LabelUninstallMsg,
     DEFAULT_DISTANCE,
+    InterfaceUpdMsg,
     Nexthop,
     Protocol,
     RouteKeyMsg,
@@ -34,10 +39,27 @@ from holo_tpu.utils.southbound import (
 )
 
 
+class _Repair(NamedTuple):
+    """An active IP-FRR local repair: the original best RouteMsg and the
+    outstanding ``(ifname, addr)`` failure events applied to it."""
+
+    msg: RouteMsg
+    events: tuple
+
+
 class Kernel:
     """FIB programming interface (netlink.rs equivalent)."""
 
-    def install(self, prefix: IpNetwork, nexthops: frozenset[Nexthop], proto: Protocol) -> None:
+    def install(
+        self,
+        prefix: IpNetwork,
+        nexthops: frozenset[Nexthop],
+        proto: Protocol,
+        backups: dict | None = None,
+    ) -> None:
+        """Program ``prefix``.  ``backups`` (primary → loop-free backup
+        next hop) ride along so the fast-reroute flip is a single
+        replace from state the FIB layer already holds."""
         raise NotImplementedError
 
     def uninstall(self, prefix: IpNetwork) -> None:
@@ -56,24 +78,26 @@ class Kernel:
 class MockKernel(Kernel):
     def __init__(self) -> None:
         self.fib: dict[IpNetwork, tuple[frozenset[Nexthop], Protocol]] = {}
+        self.backups: dict[IpNetwork, dict] = {}  # prefix -> primary->backup
         self.lfib: dict[int, frozenset[Nexthop]] = {}  # in-label -> nexthops
         self.log: list[tuple[str, IpNetwork]] = []
 
-    def install(self, prefix, nexthops, proto):
+    def install(self, prefix, nexthops, proto, backups=None):
         self.fib[prefix] = (nexthops, proto)
+        if backups:
+            self.backups[prefix] = dict(backups)
+        else:
+            self.backups.pop(prefix, None)
         self.log.append(("install", prefix))
 
     def uninstall(self, prefix):
         self.fib.pop(prefix, None)
+        self.backups.pop(prefix, None)
         self.log.append(("uninstall", prefix))
 
     def install_label(self, in_label, nexthops):
         self.lfib[in_label] = nexthops
         self.log.append(("install-label", in_label))
-
-    def purge_stale(self):
-        self.fib.clear()
-        self.lfib.clear()
 
     def uninstall_label(self, in_label):
         self.lfib.pop(in_label, None)
@@ -81,6 +105,8 @@ class MockKernel(Kernel):
 
     def purge_stale(self):
         self.fib.clear()
+        self.backups.clear()
+        self.lfib.clear()
 
 
 @dataclass
@@ -143,13 +169,54 @@ class RibManager(Actor):
         self._programmed: set[IpNetwork] = set()  # prefixes in the kernel FIB
         # Next-hop tracking: addr -> (last NhtUpd, subscriber names).
         self._nht: dict = {}
+        # IP-FRR local repair: prefix -> (original RouteMsg, outstanding
+        # failure events).  A repair is cleared only when the winning
+        # entry for the prefix actually changes (reconvergence
+        # republishes it) or every failure event is restored — an
+        # unrelated protocol's add/del must not reinstall the dead
+        # primaries.  Membership (`in`) is the e2e-visible surface.
+        self.repaired: dict[IpNetwork, _Repair] = {}
         # (protocol, af) redistribution subscriptions handled via ibus topics.
         self.kernel.purge_stale()
 
     # -- actor
 
+    def attach(self, loop_) -> None:
+        super().attach(loop_)
+        # Fast-failure triggers for the FRR flip (reference: holo-routing
+        # consumes the same ibus feeds): BFD session state and interface
+        # operational state.
+        self.ibus.subscribe(TOPIC_BFD_STATE, self.name)
+        self.ibus.subscribe(TOPIC_INTERFACE_UPD, self.name)
+        self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
+
     def handle(self, msg) -> None:
         if isinstance(msg, IbusMsg):
+            if msg.topic == TOPIC_BFD_STATE:
+                upd = msg.payload
+                if isinstance(upd, BfdStateUpd) and upd.key:
+                    flip = (
+                        self.local_repair
+                        if upd.state == "down"
+                        else self.local_restore
+                    )
+                    if upd.key[0] == "mh":
+                        flip(None, addr=upd.key[2])
+                    else:
+                        flip(upd.key[0], addr=upd.key[1])
+                return
+            if msg.topic == TOPIC_INTERFACE_UPD:
+                upd = msg.payload
+                if isinstance(upd, InterfaceUpdMsg):
+                    if not upd.operative:
+                        self.local_repair(upd.ifname)
+                    else:
+                        self.local_restore(upd.ifname)
+                return
+            if msg.topic == TOPIC_INTERFACE_DEL:
+                if isinstance(msg.payload, str):
+                    self.local_repair(msg.payload)
+                return
             payload = msg.payload
             if isinstance(payload, RouteMsg):
                 self.route_add(payload)
@@ -163,6 +230,109 @@ class RibManager(Actor):
                 self.nht_register(payload.addr, payload.sender or msg.sender)
             elif isinstance(payload, NhtUnregister):
                 self.nht_unregister(payload.addr, payload.sender or msg.sender)
+
+    # -- IP fast reroute: O(1) flip to precomputed backups
+
+    @staticmethod
+    def _nh_failed(nh: Nexthop, ifname: str | None, addr) -> bool:
+        if ifname is not None and nh.ifname == ifname:
+            # Interface failure takes every next hop riding it (addr
+            # narrows a BFD single-hop event to the session's neighbor).
+            return addr is None or nh.addr == addr
+        return addr is not None and nh.addr == addr
+
+    def _hit_by(self, nh: Nexthop, events) -> bool:
+        return any(self._nh_failed(nh, i, a) for i, a in events)
+
+    def _repair_install(self, prefix, msg, events) -> bool:
+        """Install ``msg``'s survivor set under ``events``: primaries
+        not hit by any outstanding failure, plus each failed primary's
+        precomputed backup when the backup itself is unhit.  False when
+        nothing survives (caller leaves the FIB entry for reconvergence
+        — pulling the route would blackhole sooner, not later)."""
+        failed = {nh for nh in msg.nexthops if self._hit_by(nh, events)}
+        survivors = set(msg.nexthops) - failed
+        for nh in failed:
+            backup = msg.backups.get(nh) if msg.backups else None
+            if backup is not None and not self._hit_by(backup, events):
+                survivors.add(backup)
+        if not survivors:
+            return False
+        self.kernel.install(prefix, frozenset(survivors), msg.protocol)
+        return True
+
+    def local_repair(self, ifname: str | None, addr=None) -> int:
+        """Flip programmed routes whose next hops ride the failed
+        interface/neighbor onto their precomputed loop-free backups.
+
+        This is the IP-FRR local-repair moment (reference: TI-LFA's
+        whole point): no SPF, no route recomputation — one kernel
+        replace per affected prefix, using backup next hops the
+        protocols attached at the last convergence.  Failure events
+        accumulate, so a second failure re-repairs an already-repaired
+        prefix.  Reconvergence republishes the prefix and ``_reselect``
+        clears the repair; :meth:`local_restore` unwinds events that
+        recover first.  Returns the number of prefixes flipped."""
+        event = (ifname, addr)
+        flipped = 0
+        for prefix, pr in self.routes.items():
+            if prefix not in self._programmed:
+                continue
+            best = pr.best()
+            if best is None or not best.msg.nexthops:
+                continue
+            msg = best.msg
+            rec = self.repaired.get(prefix)
+            if rec is not None and event in rec.events:
+                continue
+            # Only act when the event hits a primary or an in-use backup.
+            if not any(
+                self._nh_failed(nh, ifname, addr) for nh in msg.nexthops
+            ) and not (
+                msg.backups
+                and any(
+                    self._nh_failed(b, ifname, addr)
+                    for b in msg.backups.values()
+                )
+            ):
+                continue
+            events = ((*rec.events, event) if rec else (event,))
+            if not self._repair_install(prefix, msg, events):
+                continue
+            self.repaired[prefix] = _Repair(msg, events)
+            flipped += 1
+        return flipped
+
+    def local_restore(self, ifname: str | None, addr=None) -> int:
+        """Clear a recovered failure event from active local repairs:
+        reinstall the original next-hop set once every event is gone, or
+        the recomputed survivor set while other failures are still
+        outstanding.
+
+        The counterpart of :meth:`local_repair` for failures that clear
+        before the owning protocol republishes the prefix (a carrier
+        flap inside hold timers, a BFD session recovering) — without it
+        a static/ECMP route would stay degraded forever.  ``_reselect``
+        clears ``repaired`` whenever the winning entry changes, so the
+        stored message is still the prefix's best."""
+        event = (ifname, addr)
+        restored = 0
+        for prefix, rec in list(self.repaired.items()):
+            if event not in rec.events:
+                continue
+            events = tuple(e for e in rec.events if e != event)
+            if not events:
+                self.kernel.install(
+                    prefix,
+                    rec.msg.nexthops,
+                    rec.msg.protocol,
+                    backups=rec.msg.backups or None,
+                )
+                del self.repaired[prefix]
+            elif self._repair_install(prefix, rec.msg, events):
+                self.repaired[prefix] = _Repair(rec.msg, events)
+            restored += 1
+        return restored
 
     # -- next-hop tracking (reference rib.rs:64,290)
 
@@ -256,6 +426,7 @@ class RibManager(Actor):
         pr.entries.pop(msg.protocol, None)
         if not pr.entries:
             del self.routes[msg.prefix]
+            self.repaired.pop(msg.prefix, None)
             if msg.prefix in self._programmed:
                 self.kernel.uninstall(msg.prefix)
                 self._programmed.discard(msg.prefix)
@@ -280,9 +451,28 @@ class RibManager(Actor):
             # the prefix was previously programmed with next hops, withdraw
             # the stale kernel entry.
             if best.msg.nexthops:
-                self.kernel.install(prefix, best.msg.nexthops, best.msg.protocol)
+                rec = self.repaired.get(prefix)
+                if rec is not None and rec.msg is best.msg:
+                    # The winning entry is untouched since the FRR flip
+                    # (this reselect was driven by some OTHER protocol's
+                    # add/del for the prefix): reinstalling its primaries
+                    # would revert the repair onto the dead next hop.
+                    # Keep the repair until the owner republishes.
+                    return
+                # A reinstall replaces any active FRR local repair: the
+                # protocol has reconverged (or re-published) this prefix.
+                self.repaired.pop(prefix, None)
+                self.kernel.install(
+                    prefix,
+                    best.msg.nexthops,
+                    best.msg.protocol,
+                    backups=best.msg.backups or None,
+                )
                 self._programmed.add(prefix)
             elif prefix in self._programmed:
+                # The withdrawn entry takes any active local repair with
+                # it — a later restore must not resurrect the route.
+                self.repaired.pop(prefix, None)
                 self.kernel.uninstall(prefix)
                 self._programmed.discard(prefix)
             self.ibus.publish(TOPIC_REDISTRIBUTE_ADD, best.msg)

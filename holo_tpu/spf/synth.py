@@ -162,6 +162,62 @@ def fat_tree_topology(k: int = 20, seed: int = 0) -> Topology:
     return topo
 
 
+def ring_topology(n_routers: int, max_cost: int = 10, seed: int = 0) -> Topology:
+    """Router ring (the canonical LFA-coverage-gap shape: with uniform
+    costs half the ring has no per-neighbor LFA and needs rLFA/TI-LFA)."""
+    rng = np.random.default_rng(seed)
+    src, dst, cost = [], [], []
+    for i in range(n_routers):
+        j = (i + 1) % n_routers
+        src.extend((i, j))
+        dst.extend((j, i))
+        cost.extend(
+            (int(rng.integers(1, max_cost + 1)), int(rng.integers(1, max_cost + 1)))
+        )
+    topo = Topology(
+        n_vertices=n_routers,
+        is_router=np.ones(n_routers, bool),
+        edge_src=np.array(src, np.int32),
+        edge_dst=np.array(dst, np.int32),
+        edge_cost=np.array(cost, np.int32),
+        root=0,
+    )
+    assign_direct_atoms(topo)
+    return topo
+
+
+def grid_topology(rows: int, cols: int, max_cost: int = 10, seed: int = 0) -> Topology:
+    """rows×cols router grid with per-direction random costs."""
+    rng = np.random.default_rng(seed)
+    n = rows * cols
+    vid = lambda r, c: r * cols + c
+    src, dst, cost = [], [], []
+
+    def add2(a, b):
+        src.extend((a, b))
+        dst.extend((b, a))
+        cost.extend(
+            (int(rng.integers(1, max_cost + 1)), int(rng.integers(1, max_cost + 1)))
+        )
+
+    for r in range(rows):
+        for c in range(cols):
+            if c + 1 < cols:
+                add2(vid(r, c), vid(r, c + 1))
+            if r + 1 < rows:
+                add2(vid(r, c), vid(r + 1, c))
+    topo = Topology(
+        n_vertices=n,
+        is_router=np.ones(n, bool),
+        edge_src=np.array(src, np.int32),
+        edge_dst=np.array(dst, np.int32),
+        edge_cost=np.array(cost, np.int32),
+        root=0,
+    )
+    assign_direct_atoms(topo)
+    return topo
+
+
 def whatif_link_failure_masks(topo: Topology, n_scenarios: int, seed: int = 0) -> np.ndarray:
     """bool[B, E] masks, each failing one bidirectional link (both directions).
 

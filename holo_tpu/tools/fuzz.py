@@ -39,15 +39,24 @@ class FuzzResult:
     crashes: list = field(default_factory=list)  # (exc, repr, hex)
 
 
+#: sys.monitoring (PEP 669) landed in CPython 3.12.  Without it the loop
+#: still runs — blind (no corpus growth), which is strictly better than
+#: not fuzzing at all on older interpreters.
+COVERAGE_AVAILABLE = hasattr(sys, "monitoring")
+
+
 class _Coverage:
-    """Line coverage over holo_tpu.protocols via sys.monitoring."""
+    """Line coverage over holo_tpu.protocols + holo_tpu.frr via
+    sys.monitoring; degrades to coverage-less execution when the
+    interpreter predates PEP 669."""
 
     def __init__(self):
         self.seen: set = set()
         self._new = False
 
     def _on_line(self, code, line):
-        if "holo_tpu/protocols" not in code.co_filename:
+        f = code.co_filename
+        if "holo_tpu/protocols" not in f and "holo_tpu/frr" not in f:
             return sys.monitoring.DISABLE
         key = (id(code), line)
         if key not in self.seen:
@@ -57,12 +66,16 @@ class _Coverage:
         return sys.monitoring.DISABLE
 
     def start(self):
+        if not COVERAGE_AVAILABLE:
+            return
         mon = sys.monitoring
         mon.use_tool_id(_TOOL_ID, "holo-fuzz")
         mon.register_callback(_TOOL_ID, mon.events.LINE, self._on_line)
         mon.set_events(_TOOL_ID, mon.events.LINE)
 
     def stop(self):
+        if not COVERAGE_AVAILABLE:
+            return
         mon = sys.monitoring
         mon.set_events(_TOOL_ID, 0)
         mon.free_tool_id(_TOOL_ID)
@@ -70,7 +83,8 @@ class _Coverage:
     def run(self, fn, data) -> tuple[bool, BaseException | None]:
         """Execute fn(data); returns (new_coverage, crash_exc)."""
         self._new = False
-        sys.monitoring.restart_events()
+        if COVERAGE_AVAILABLE:
+            sys.monitoring.restart_events()
         try:
             fn(data)
         except DecodeError:
@@ -145,6 +159,68 @@ def fuzz_target(
     finally:
         cov.stop()
     return res
+
+
+def frr_padding_invariants(data: bytes) -> None:
+    """Padded-input invariants of the FRR pipeline (not a wire decoder):
+    pad rows carry ``valid == False`` and MUST be result-neutral.  The
+    input bytes pick a small synth topology and a grown pad bucket; the
+    structural invariants of :func:`holo_tpu.frr.inputs.marshal_frr` are
+    checked and the scalar oracle's backup tables must be bit-identical
+    across pad sizes (the device kernel is pinned bit-for-bit to the
+    oracle — including one grown-pad case — in tests/test_frr_parity.py,
+    so oracle invariance transfers).  Any violation raises
+    AssertionError, which the harness reports as a crash.
+    """
+    if len(data) < 4:
+        raise DecodeError("frr spec: need 4 bytes (kind, size, seed, pad)")
+    import numpy as np  # noqa: PLC0415
+
+    from holo_tpu.frr.inputs import marshal_frr  # noqa: PLC0415
+    from holo_tpu.frr.scalar import frr_reference  # noqa: PLC0415
+    from holo_tpu.spf import synth  # noqa: PLC0415
+
+    kind, size, seed, pad = data[0] % 3, 3 + data[1] % 4, data[2], data[3]
+    if kind == 0:
+        topo = synth.ring_topology(size, seed=seed)
+    elif kind == 1:
+        topo = synth.grid_topology(2, size, seed=seed)
+    else:
+        topo = synth.random_ospf_topology(
+            n_routers=size + 2, n_networks=2, extra_p2p=2, seed=seed
+        )
+    small = marshal_frr(topo, pad_multiple=1)
+    grown = marshal_frr(topo, pad_multiple=8 * (1 + pad % 4))  # 8..32
+    # Structural: pad rows are inert by construction.
+    for fin in (small, grown):
+        nl, na = fin.n_links, fin.n_adj
+        assert not fin.link_valid[nl:].any(), "pad link marked valid"
+        assert not fin.adj_valid[na:].any(), "pad adjacency marked valid"
+        assert (fin.link_edge[nl:] == -1).all(), "pad link edge not -1"
+        assert (fin.adj_link[na:] == -1).all(), "pad adj link not -1"
+        assert fin.edge_masks[nl:].all(), "pad scenario must keep edges up"
+    nl, na = small.n_links, small.n_adj
+    assert (grown.n_links, grown.n_adj) == (nl, na), "pad changed counts"
+    assert grown.atom_link == small.atom_link, "pad changed atom→link map"
+    for f in ("link_edge", "link_far", "link_cost"):
+        assert (getattr(small, f)[:nl] == getattr(grown, f)[:nl]).all(), f
+    assert (small.edge_masks[:nl] == grown.edge_masks[:nl]).all()
+    for f in ("adj_edge", "adj_nbr", "adj_cost", "adj_link", "adj_atom"):
+        assert (getattr(small, f)[:na] == getattr(grown, f)[:na]).all(), f
+    # Semantic: growing the pad never changes a table entry.
+    a = frr_reference(topo, inputs=small)
+    b = frr_reference(topo, inputs=grown)
+    for f in (
+        "lfa_adj",
+        "lfa_nodeprot",
+        "rlfa_pq",
+        "tilfa_p",
+        "tilfa_q",
+        "post_dist",
+        "post_nh",
+    ):
+        if not np.array_equal(getattr(a, f), getattr(b, f)):
+            raise AssertionError(f"pad-variant table: {f}")
 
 
 # ===== target registry (the reference's fuzz_targets/** inventory) =====
@@ -229,6 +305,8 @@ def targets() -> dict:
         "bgp_routerefresh_decode": bgp_body(bgp.RouteRefreshMsg),
         # igmp (no reference counterpart — ours has a kernel-facing decoder)
         "igmp_packet_decode": igmp.IgmpPacket.decode,
+        # frr/ (ISSUE 1): padded-input invariants of the LFA kernel model.
+        "frr_padding_invariants": frr_padding_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

@@ -69,6 +69,10 @@ class RouteMsg:
     nexthops: frozenset[Nexthop] = frozenset()
     tag: int | None = None
     opaque_attrs: dict = field(default_factory=dict)
+    # IP-FRR precomputed repairs: primary next hop -> loop-free backup
+    # (holo_tpu.frr).  The RIB keeps them beside the installed primaries
+    # and flips to them in O(1) on BFD/link-down, before reconvergence.
+    backups: dict = field(default_factory=dict)
 
 
 @dataclass

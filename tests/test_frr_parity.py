@@ -1,0 +1,118 @@
+"""Bit-identical parity: batched FRR kernel vs scalar oracle.
+
+Acceptance gate for the FRR subsystem (ISSUE 1): every backup table —
+LFA pick + node-protection flag, remote-LFA PQ coverage, TI-LFA (P, Q)
+segments, post-convergence dist/next-hops — must match the scalar
+oracle exactly over the synth topology family (ring, grid, fat-tree,
+random, with and without LAN pseudo-nodes).
+"""
+
+import numpy as np
+import pytest
+
+from holo_tpu.frr.manager import FrrConfig, FrrEngine, resolve_backup
+from holo_tpu.ops.graph import INF
+from holo_tpu.spf.synth import (
+    fat_tree_topology,
+    grid_topology,
+    random_ospf_topology,
+    ring_topology,
+)
+
+N_ATOMS = 64
+
+
+def assert_table_parity(scalar, tpu):
+    for name in (
+        "lfa_adj",
+        "lfa_nodeprot",
+        "rlfa_pq",
+        "tilfa_p",
+        "tilfa_q",
+        "post_dist",
+        "post_nh",
+    ):
+        np.testing.assert_array_equal(
+            getattr(scalar, name), getattr(tpu, name), err_msg=name
+        )
+
+
+def _topos(seed):
+    return {
+        "ring": ring_topology(10, seed=seed),
+        "grid": grid_topology(4, 4, seed=seed),
+        "fat-tree": fat_tree_topology(k=4, seed=seed),
+        "random": random_ospf_topology(
+            n_routers=10, n_networks=3, seed=seed
+        ),
+    }
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("shape", ["ring", "grid", "fat-tree", "random"])
+def test_frr_kernel_oracle_parity(seed, shape):
+    topo = _topos(seed)[shape]
+    scalar = FrrEngine("scalar", N_ATOMS).compute(topo)
+    tpu = FrrEngine("tpu", N_ATOMS).compute(topo)
+    assert_table_parity(scalar, tpu)
+
+
+def test_ring_uniform_cost_needs_remote_repair():
+    """The textbook rLFA case: a uniform-cost ring has destinations with
+    no per-neighbor LFA; rLFA/TI-LFA must cover (nearly) all of them."""
+    topo = ring_topology(8, max_cost=1, seed=0)  # uniform costs
+    table = FrrEngine("scalar", N_ATOMS).compute(topo)
+    eligible = table.post_dist < INF
+    eligible[:, topo.root] = False
+    lfa_only = (table.lfa_adj >= 0) & eligible
+    assert lfa_only.sum() < eligible.sum(), "ring should defeat plain LFA"
+    assert table.coverage() == 1.0, "rLFA/TI-LFA should cover the ring"
+
+
+def test_resolve_backup_policy_order():
+    topo = ring_topology(8, max_cost=1, seed=0)
+    table = FrrEngine("scalar", N_ATOMS).compute(topo)
+    cfg_all = FrrConfig(enabled=True, remote_lfa=True, ti_lfa=True)
+    cfg_lfa = FrrConfig(enabled=True)
+    got_kinds = set()
+    for l in range(table.n_links):
+        for d in range(topo.n_vertices):
+            if d == topo.root:
+                continue
+            e = resolve_backup(table, cfg_all, l, d)
+            if e is not None:
+                got_kinds.add(e.kind)
+                if e.kind == "lfa":
+                    assert e.atom is not None and e.atom >= 0
+                else:
+                    # LFA-disabled policy must yield nothing where only
+                    # remote repairs exist.
+                    assert resolve_backup(table, cfg_lfa, l, d) is None
+            assert resolve_backup(table, FrrConfig(), l, d) is None
+    assert "rlfa" in got_kinds or "ti-lfa" in got_kinds
+
+
+def test_padding_is_result_neutral():
+    """Growing the pad bucket must not change any table entry (the fuzz
+    target's invariant, pinned here deterministically)."""
+    from holo_tpu.frr.inputs import marshal_frr
+    from holo_tpu.frr.scalar import frr_reference
+
+    topo = random_ospf_topology(n_routers=8, n_networks=2, seed=4)
+    a = frr_reference(topo, N_ATOMS, inputs=marshal_frr(topo, pad_multiple=1))
+    b = frr_reference(topo, N_ATOMS, inputs=marshal_frr(topo, pad_multiple=16))
+    assert_table_parity(a, b)
+    # And through the device kernel, where pads actually enter the math.
+    ta = FrrEngine("tpu", N_ATOMS).compute(topo)
+    assert_table_parity(a, ta)
+
+
+def test_lfa_never_uses_protected_interface():
+    for seed in range(3):
+        topo = random_ospf_topology(n_routers=9, n_networks=3, seed=seed)
+        table = FrrEngine("scalar", N_ATOMS).compute(topo)
+        fin = table.inputs
+        for l in range(table.n_links):
+            picks = table.lfa_adj[l]
+            for a in picks[picks >= 0]:
+                assert int(fin.adj_link[a]) != l

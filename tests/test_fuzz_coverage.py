@@ -14,7 +14,7 @@ import os
 
 import pytest
 
-from holo_tpu.tools.fuzz import run_all, targets
+from holo_tpu.tools.fuzz import COVERAGE_AVAILABLE, run_all, targets
 from holo_tpu.utils.bytesbuf import DecodeError
 
 BUDGET_S = float(os.environ.get("HOLO_TPU_FUZZ_BUDGET", "0.15"))
@@ -32,8 +32,11 @@ def test_coverage_guided_sweep_no_crashes():
     }
     assert not crashed, crashed
     # Guidance sanity: coverage feedback grew at least one corpus beyond
-    # its seeds (i.e. the loop is genuinely coverage-driven).
-    assert any(r.corpus_size > 20 for r in results.values())
+    # its seeds (i.e. the loop is genuinely coverage-driven).  Pre-3.12
+    # interpreters have no sys.monitoring: the sweep still runs (blind),
+    # but corpora cannot grow.
+    if COVERAGE_AVAILABLE:
+        assert any(r.corpus_size > 20 for r in results.values())
 
 
 @pytest.mark.parametrize(
