@@ -59,6 +59,7 @@ STAGE_TIMEOUT = {
     "ospfv3_multiarea": 1200,
     "isis_l1l2": 1200,
     "frr_batch": 900,
+    "telemetry_overhead": 900,
 }
 
 
@@ -173,11 +174,16 @@ def _gather_run(topo, masks, cpu_runs=0, reps=3, n_atoms=64, engine="fused"):
         _sync(step(g, masks_dev).dist)
         times.append(time.perf_counter() - t0)
     dt = sum(times) / reps
+    from holo_tpu import telemetry
+
     result = {
         "runs_per_sec": B / dt,
         "batch_ms": dt * 1e3,
         "engine": engine,
         "times_ms": [round(t * 1e3, 2) for t in times],
+        # Explanatory signal riding the row: marshal cost + padded-slot
+        # occupancy from the instrumented ELL path (holo_tpu.telemetry).
+        "telemetry": telemetry.snapshot(prefix="holo_spf"),
     }
     if cpu_runs:
         cpu_dist, cpu_rps, cpu_p50 = _cpu_baseline(topo, masks, cpu_runs)
@@ -460,6 +466,8 @@ def stage_frr_batch(rows, cols, reps, parity):
         eng.compute(topo)
         times.append(time.perf_counter() - t0)
     dt = sum(times) / reps
+    from holo_tpu import telemetry
+
     result = {
         "runs_per_sec": 1.0 / dt,
         "batch_ms": dt * 1e3,
@@ -467,6 +475,8 @@ def stage_frr_batch(rows, cols, reps, parity):
         "n_links": int(table.n_links),
         "coverage": round(table.coverage(), 4),
         "times_ms": [round(t * 1e3, 2) for t in times],
+        # Recompile count / cache behavior / pad occupancy for the row.
+        "telemetry": telemetry.snapshot(prefix="holo_frr"),
     }
     if parity:
         ref = FrrEngine("scalar").compute(topo)
@@ -485,6 +495,54 @@ def stage_frr_batch(rows, cols, reps, parity):
     else:
         result["ok"] = True
     return result
+
+
+def stage_telemetry_overhead(k, B, reps=15):
+    """ISSUE 2 acceptance row: the instrumented SPF dispatch path
+    (TpuSpfBackend — counters, histograms, spans) against the SAME path
+    with the registry disabled.  Reps interleave the two arms so clock
+    drift hits both equally; ok requires overhead < 2% AND the jit
+    recompile counter staying flat across same-shape re-runs."""
+    from holo_tpu import telemetry
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    backend.compute_whatif(topo, masks)  # warm: compile + graph cache
+    compiles0 = telemetry.snapshot(prefix="holo_spf_jit_compiles")
+    on_times, off_times = [], []
+    for rep in range(reps):
+        # Alternate arm order per rep: cache/GC warmth from the previous
+        # dispatch lands on each arm equally, not always on the same one.
+        arms = ((True, on_times), (False, off_times))
+        for arm, times in arms if rep % 2 == 0 else arms[::-1]:
+            telemetry.set_enabled(arm)
+            t0 = time.perf_counter()
+            backend.compute_whatif(topo, masks)
+            times.append(time.perf_counter() - t0)
+    telemetry.set_enabled(True)
+    compiles1 = telemetry.snapshot(prefix="holo_spf_jit_compiles")
+    # Min-of-N per arm: the instrumentation cost is deterministic and
+    # additive while scheduler noise is one-sided positive, so the two
+    # minima isolate the true per-dispatch delta far better than means
+    # (medians of ms-scale dispatches still carry multi-percent jitter).
+    on_ms = float(np.min(on_times) * 1e3)
+    off_ms = float(np.min(off_times) * 1e3)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
+    # The disabled arm skips the _enabled counter bumps but NOT the
+    # jit shape-signature tracking (that is plain set logic), so the
+    # flatness check is valid across both arms.
+    recompiles_flat = compiles0 == compiles1
+    return {
+        "ok": bool(overhead_pct < 2.0 and recompiles_flat),
+        "enabled_ms": round(on_ms, 3),
+        "disabled_ms": round(off_ms, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "recompiles_flat": recompiles_flat,
+        "batch": int(B),
+        "reps": reps,
+        "telemetry": telemetry.snapshot(prefix="holo_spf"),
+    }
 
 
 def _run_stage(name, small, cpu=False, engine=None):
@@ -558,6 +616,9 @@ def main() -> None:
                 if small
                 else stage_frr_batch(12, 12, 3, True)
             ),
+            "telemetry_overhead": lambda: stage_telemetry_overhead(
+                k10, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -591,6 +652,12 @@ def main() -> None:
         # the all-roots scenario stays covered while the relay is down.
         extra["frr_batch_jaxcpu_small"] = _run_stage(
             "frr_batch", True, cpu=True
+        )
+        # Telemetry overhead gate (ISSUE 2): instrumented vs disabled
+        # registry on the SPF dispatch path — platform-independent, so
+        # the JAX-CPU row keeps the acceptance signal alive.
+        extra["telemetry_overhead_jaxcpu_small"] = _run_stage(
+            "telemetry_overhead", True, cpu=True
         )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -661,6 +728,9 @@ def main() -> None:
     # FRR backup-table batch (ISSUE 1): the all-roots SPF + repair
     # selection scenario, parity-gated vs the scalar oracle.
     extra["frr_batch"] = _run_stage("frr_batch", small)
+    # Telemetry overhead gate (ISSUE 2): the instrumented SPF dispatch
+    # must stay within noise (<2%) of a registry-disabled run.
+    extra["telemetry_overhead"] = _run_stage("telemetry_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

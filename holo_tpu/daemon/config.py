@@ -54,6 +54,18 @@ class EventRecorderConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    # The registry itself is always on (metrics cost nanoseconds and the
+    # gNMI state subtree serves them regardless); this section gates the
+    # Prometheus scrape endpoint and the exit trace dump.
+    enabled: bool = False
+    address: str = "127.0.0.1:9464"  # Prometheus /metrics endpoint
+    # Path for a Chrome trace-event JSON span dump written at daemon
+    # stop (None = no dump; HOLO_TPU_TRACE_DUMP env overrides).
+    trace_dump: str | None = None
+
+
+@dataclass
 class RuntimeConfig:
     # "threaded" (default): each protocol instance on its own OS thread
     # — the reference's PRODUCTION posture (per-instance spawn_blocking,
@@ -78,6 +90,7 @@ class DaemonConfig:
     grpc: GrpcConfig = field(default_factory=GrpcConfig)
     gnmi: GnmiConfig = field(default_factory=GnmiConfig)
     event_recorder: EventRecorderConfig = field(default_factory=EventRecorderConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
 
     @classmethod
@@ -114,6 +127,11 @@ class DaemonConfig:
             e = raw["event_recorder"]
             cfg.event_recorder.enabled = e.get("enabled", False)
             cfg.event_recorder.dir = e.get("dir", cfg.event_recorder.dir)
+        if "telemetry" in raw:
+            t = raw["telemetry"]
+            cfg.telemetry.enabled = t.get("enabled", False)
+            cfg.telemetry.address = t.get("address", cfg.telemetry.address)
+            cfg.telemetry.trace_dump = t.get("trace-dump")
         if "runtime" in raw:
             iso = raw["runtime"].get("isolation")
             if iso is not None:

@@ -128,13 +128,17 @@ class Daemon:
             send_loop.register(p, name=self._p + p.name)
 
         db = Path(self.config.db_path) if self.config.db_path else None
+        from holo_tpu.telemetry.provider import TelemetryStateProvider
+
         self.northbound = Northbound(
             full_schema(),
             [self.interface, self.keychain, self.policy, self.system,
-             self.routing, _RuntimeStateProvider(self)],
+             self.routing, _RuntimeStateProvider(self),
+             TelemetryStateProvider()],
             db_path=db,
         )
         self._grpc_server = None
+        self._telemetry_server = None
 
         # Event recorder (reference holo-protocol/src/lib.rs:266-269 +
         # holod.toml [event_recorder]): every message delivered on the
@@ -318,7 +322,33 @@ class Daemon:
         )
         return self._gnmi_server
 
+    def start_telemetry(self, address: str | None = None):
+        """Prometheus text endpoint on a stdlib HTTP thread ([telemetry]
+        config section; the gNMI/gRPC state subtree is always served)."""
+        from holo_tpu import telemetry
+        from holo_tpu.telemetry.prometheus import start_http_server
+
+        self._telemetry_server = start_http_server(
+            telemetry.registry(),
+            address or self.config.telemetry.address,
+        )
+        return self._telemetry_server
+
     def stop(self):
+        if self._telemetry_server is not None:
+            self._telemetry_server.shutdown()
+            # shutdown() only exits serve_forever; the listening fd must
+            # be closed explicitly or a stop/start cycle races GC for
+            # the port (EADDRINUSE).
+            self._telemetry_server.server_close()
+            self._telemetry_server = None
+        if self.config.telemetry.trace_dump:
+            from holo_tpu import telemetry
+
+            try:
+                telemetry.tracer().dump(self.config.telemetry.trace_dump)
+            except OSError:
+                log.exception("trace dump failed")
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=0.5)
         if getattr(self, "_gnmi_server", None) is not None:
@@ -386,13 +416,22 @@ def setup_logging(cfg) -> None:
     if cfg.logging.style == "json":
         import json as _json
 
+        from holo_tpu import telemetry
+
         class _JsonFormatter(logging.Formatter):
             def format(self, record):
+                # Correlation keys: the active telemetry span id (join
+                # log lines against Chrome trace dumps) and the protocol
+                # instance name (an explicit ``instance`` record attr
+                # wins; else the innermost span's instance tag).
                 out = {
                     "ts": self.formatTime(record),
                     "level": record.levelname.lower(),
                     "target": record.name,
                     "message": record.getMessage(),
+                    "instance": getattr(record, "instance", None)
+                    or telemetry.current_instance(),
+                    "span": telemetry.current_span_id(),
                 }
                 if record.exc_info:
                     out["exception"] = self.formatException(record.exc_info)
@@ -448,6 +487,9 @@ def main(argv=None):
     if cfg.gnmi.enabled:
         daemon.start_gnmi()
         log.info("gNMI northbound on %s", cfg.gnmi.address)
+    if cfg.telemetry.enabled:
+        daemon.start_telemetry()
+        log.info("telemetry /metrics on %s", cfg.telemetry.address)
     log.info("holo_tpu daemon running")
     # Kernel link/address monitor (production path; requires NETLINK).
     monitor = None

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import queue
 import sys
+import threading
 import time
 from concurrent import futures
 from pathlib import Path as FsPath
@@ -22,8 +23,22 @@ sys.path.insert(0, str(FsPath(__file__).resolve().parent))
 import gnmi_lite_pb2 as pb  # noqa: E402
 
 import holo_tpu
+from holo_tpu import telemetry
 from holo_tpu.northbound.provider import CommitError
 from holo_tpu.yang.schema import SchemaError
+
+# Subscribe-path hardening metrics: per-subscriber queues are bounded
+# (SUBSCRIBE_QUEUE_DEPTH) so a stalled consumer costs dropped updates —
+# counted here — instead of unbounded daemon memory.
+_SUB_DROPS = telemetry.counter(
+    "holo_gnmi_subscribe_dropped_total",
+    "gNMI Subscribe updates dropped on a full subscriber queue",
+)
+_SUBSCRIBERS = telemetry.gauge(
+    "holo_gnmi_subscribers", "Active gNMI Subscribe streams"
+)
+
+SUBSCRIBE_QUEUE_DEPTH = 256
 
 
 def path_to_str(path: pb.Path) -> str:
@@ -54,6 +69,37 @@ class GnmiService:
     def __init__(self, daemon):
         self.daemon = daemon
         self._subscribers: list[queue.Queue] = []
+        self._sub_lock = threading.Lock()
+
+    def _add_subscriber(self, q: queue.Queue) -> None:
+        with self._sub_lock:
+            self._subscribers.append(q)
+            _SUBSCRIBERS.set(len(self._subscribers))
+
+    def _remove_subscriber(self, q: queue.Queue) -> None:
+        """Idempotent removal: the stream's finally block AND any future
+        notify-side eviction may both call this — a double remove must
+        not raise inside a gRPC generator teardown.  The gauge updates
+        under the same lock so concurrent teardowns cannot publish a
+        stale count."""
+        with self._sub_lock:
+            try:
+                self._subscribers.remove(q)
+            except ValueError:
+                pass
+            _SUBSCRIBERS.set(len(self._subscribers))
+
+    def _fanout(self, notif) -> None:
+        """Best-effort delivery to every subscriber: bounded queues drop
+        (and count) on overflow rather than block the publisher or grow
+        memory for a stalled consumer."""
+        with self._sub_lock:
+            targets = list(self._subscribers)
+        for q in targets:
+            try:
+                q.put_nowait(notif)
+            except queue.Full:
+                _SUB_DROPS.inc()
 
     def Capabilities(self, request, context):
         resp = pb.CapabilityResponse(
@@ -165,8 +211,8 @@ class GnmiService:
         )
 
     def Subscribe(self, request_iterator, context):
-        q: queue.Queue = queue.Queue(maxsize=256)
-        self._subscribers.append(q)
+        q: queue.Queue = queue.Queue(maxsize=SUBSCRIBE_QUEUE_DEPTH)
+        self._add_subscriber(q)
         try:
             first = next(iter(request_iterator), None)
             # Initial sync: current state snapshot then sync_response.
@@ -191,7 +237,7 @@ class GnmiService:
                     continue
                 yield pb.SubscribeResponse(update=notif)
         finally:
-            self._subscribers.remove(q)
+            self._remove_subscriber(q)
 
     def _notify_yang(self, payload: dict) -> None:
         # Protocol YANG notifications ride the same update stream, one
@@ -204,11 +250,7 @@ class GnmiService:
                     json_ietf_val=json.dumps(body, default=str)
                 ),
             )
-            for q in list(self._subscribers):
-                try:
-                    q.put_nowait(notif)
-                except queue.Full:
-                    pass
+            self._fanout(notif)
 
     def _notify_commit(self, txn) -> None:
         notif = pb.Notification(timestamp=int(time.time() * 1e9))
@@ -220,11 +262,7 @@ class GnmiService:
                 )
             ),
         )
-        for q in list(self._subscribers):
-            try:
-                q.put_nowait(notif)
-            except queue.Full:
-                pass
+        self._fanout(notif)
 
 
 def _typed_value(value) -> pb.TypedValue:

@@ -17,13 +17,42 @@ vertices to SR labels via its own SID tables.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from holo_tpu import telemetry
 from holo_tpu.frr.inputs import marshal_frr
 from holo_tpu.frr.kernel import BackupTable
 from holo_tpu.ops.graph import Topology
+
+# FRR dispatch observability, mirroring the SPF backend's signal set:
+# wall time per backup-table computation, recompiles vs shape hits, and
+# how much of the padded link/adjacency planes is real work.
+_FRR_SECONDS = telemetry.histogram(
+    "holo_frr_dispatch_seconds",
+    "Wall time of one backup-table computation (marshal + dispatch + readback)",
+    ("engine",),
+)
+_FRR_COMPILES = telemetry.counter(
+    "holo_frr_jit_compiles_total",
+    "FRR dispatches hitting a new shape bucket (XLA recompile)",
+)
+_FRR_JIT_HITS = telemetry.counter(
+    "holo_frr_jit_cache_hits_total",
+    "FRR dispatches served from an already-compiled shape bucket",
+)
+_FRR_GRAPH_CACHE = telemetry.counter(
+    "holo_frr_graph_cache_total",
+    "Marshaled DeviceGraph cache lookups (FRR engine)",
+    ("result",),
+)
+_FRR_PAD_OCCUPANCY = telemetry.gauge(
+    "holo_frr_pad_occupancy",
+    "Valid fraction of the padded FRR plane (last dispatch)",
+    ("plane",),
+)
 
 
 @dataclass
@@ -150,6 +179,7 @@ class FrrEngine:
         self.max_iters = max_iters
         self._jit = None  # built lazily (jax import on first TPU compute)
         self._graph_cache: dict[tuple, object] = {}
+        self._compiled_shapes: set[tuple] = set()
 
     # -- device path
 
@@ -162,11 +192,14 @@ class FrrEngine:
         key = topo.cache_key
         g = self._graph_cache.get(key)
         if g is None:
+            _FRR_GRAPH_CACHE.labels(result="miss").inc()
             ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
             g = jax.device_put(device_graph_from_ell(ell))
             self._graph_cache[key] = g
             while len(self._graph_cache) > 4:
                 self._graph_cache.pop(next(iter(self._graph_cache)))
+        else:
+            _FRR_GRAPH_CACHE.labels(result="hit").inc()
         return g
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
@@ -181,6 +214,12 @@ class FrrEngine:
                 )
             )
         g = self._prepare(topo)
+        sig = (fin.link_far.shape, fin.edge_masks.shape, fin.adj_nbr.shape)
+        if sig in self._compiled_shapes:
+            _FRR_JIT_HITS.inc()
+        else:
+            self._compiled_shapes.add(sig)
+            _FRR_COMPILES.inc()
         out = self._jit(
             g,
             topo.root,
@@ -210,9 +249,24 @@ class FrrEngine:
 
     def compute(self, topo: Topology) -> BackupTable:
         """One batched backup-table computation for ``topo.root``."""
-        fin = marshal_frr(topo)
-        if self.engine == "tpu":
-            return self._compute_tpu(topo, fin)
-        from holo_tpu.frr.scalar import frr_reference
+        t0 = time.perf_counter()
+        with telemetry.span("frr.dispatch", engine=self.engine):
+            fin = marshal_frr(topo)
+            lp = fin.link_valid.shape[0]
+            ap = fin.adj_valid.shape[0]
+            if lp:
+                _FRR_PAD_OCCUPANCY.labels(plane="links").set(fin.n_links / lp)
+            if ap:
+                _FRR_PAD_OCCUPANCY.labels(plane="adjs").set(
+                    float(np.asarray(fin.adj_valid).mean())
+                )
+            if self.engine == "tpu":
+                table = self._compute_tpu(topo, fin)
+            else:
+                from holo_tpu.frr.scalar import frr_reference
 
-        return frr_reference(topo, self.n_atoms, inputs=fin)
+                table = frr_reference(topo, self.n_atoms, inputs=fin)
+        _FRR_SECONDS.labels(engine=self.engine).observe(
+            time.perf_counter() - t0
+        )
+        return table

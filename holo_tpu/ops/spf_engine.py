@@ -25,13 +25,29 @@ All int32, exact; results are bit-comparable against the scalar oracle
 
 from __future__ import annotations
 
+import time
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from holo_tpu import telemetry
 from holo_tpu.ops.graph import INF, EllGraph
+
+# Host-side marshal metrics: every DeviceGraph build reports how long
+# the ELL expansion took and how much of the padded slot space is real
+# (waste here is waste in EVERY subsequent device round).
+_MARSHALS = telemetry.counter(
+    "holo_spf_marshal_total", "DeviceGraph marshals (ELL expansion)"
+)
+_MARSHAL_SECONDS = telemetry.histogram(
+    "holo_spf_marshal_seconds", "Host-side ELL -> DeviceGraph marshal time"
+)
+_ELL_OCCUPANCY = telemetry.gauge(
+    "holo_spf_ell_occupancy",
+    "Valid fraction of padded ELL in-edge slots (last marshal)",
+)
 
 
 class DeviceGraph(NamedTuple):
@@ -56,6 +72,7 @@ class SpfTensors(NamedTuple):
 
 def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
     """Expand per-slot direct atoms into one-hot bitmask words (host side)."""
+    t0 = time.perf_counter()
     n, k = ell.in_src.shape
     w = max((ell.n_atoms + 31) // 32, 1)
     words = np.zeros((n, k, w), np.uint32)
@@ -64,7 +81,7 @@ def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
     rows, cols = np.nonzero(has)
     a = atom[rows, cols]
     words[rows, cols, a // 32] = np.uint32(1) << (a % 32).astype(np.uint32)
-    return DeviceGraph(
+    g = DeviceGraph(
         in_src=jnp.asarray(ell.in_src),
         in_cost=jnp.asarray(ell.in_cost),
         in_valid=jnp.asarray(ell.in_valid),
@@ -72,6 +89,11 @@ def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
         direct_nh_words=jnp.asarray(words),
         is_router=jnp.asarray(ell.is_router),
     )
+    _MARSHALS.inc()
+    _MARSHAL_SECONDS.observe(time.perf_counter() - t0)
+    if n * k:
+        _ELL_OCCUPANCY.set(float(np.asarray(ell.in_valid).mean()))
+    return g
 
 
 def _slot_mask(g: DeviceGraph, edge_mask: jax.Array | None) -> jax.Array:

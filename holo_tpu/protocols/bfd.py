@@ -25,10 +25,24 @@ import hmac
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address
 
+from holo_tpu import telemetry
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
 from holo_tpu.utils.ibus import TOPIC_BFD_STATE, BfdSessionReg, BfdSessionUnreg, BfdStateUpd, Ibus, IbusMsg
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
+
+# Session FSM + wire observability.  A "flap" is the monitored failure
+# event (UP -> DOWN): it is what triggers the RIB's FRR local repair,
+# so its count joins directly against holo_rib_backup_flips_total.
+_BFD_TRANSITIONS = telemetry.counter(
+    "holo_bfd_transitions_total", "BFD session state transitions", ("to",)
+)
+_BFD_FLAPS = telemetry.counter(
+    "holo_bfd_flaps_total", "BFD sessions dropping from UP to DOWN"
+)
+_BFD_PACKETS = telemetry.counter(
+    "holo_bfd_packets_total", "BFD control packets", ("dir",)
+)
 
 
 class BfdState(enum.IntEnum):
@@ -408,6 +422,7 @@ class BfdInstance(Actor):
         if msg.data.startswith(ECHO_MAGIC):
             self._rx_echo(msg)
             return
+        _BFD_PACKETS.labels(dir="rx").inc()
         try:
             pkt = BfdPacket.decode(msg.data)
         except DecodeError:
@@ -483,6 +498,9 @@ class BfdInstance(Actor):
     def _transition(self, s: Session, new: BfdState, diag: BfdDiag = BfdDiag.NONE) -> None:
         if s.state == new:
             return
+        _BFD_TRANSITIONS.labels(to=new.name.lower()).inc()
+        if s.state == BfdState.UP and new == BfdState.DOWN:
+            _BFD_FLAPS.inc()
         s.state = new
         s.diag = diag
         if new == BfdState.DOWN:
@@ -578,6 +596,7 @@ class BfdInstance(Actor):
             auth=auth,
         )
         wire = pkt.encode(auth_key=s.auth_key or None)
+        _BFD_PACKETS.labels(dir="tx").inc()
         if s.is_multihop():
             _, src, dst = s.key
             self.netio.send(None, src, dst, wire)

@@ -20,11 +20,25 @@ from ipaddress import IPv4Address, IPv4Network, IPv6Address, IPv6Network
 
 import logging
 
+from holo_tpu import telemetry
 from holo_tpu.protocols.bgp_worker import EvalBatchRequest
 from holo_tpu.protocols.bgp_worker import EvalBatchResult as _EvalBatchResultT
 from holo_tpu.utils.bytesbuf import DecodeError, Reader, Writer
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
+
+# Peer FSM observability: transitions keyed by target state plus the
+# drop counter (ESTABLISHED -> IDLE — the flap the operator pages on).
+_BGP_TRANSITIONS = telemetry.counter(
+    "holo_bgp_transitions_total",
+    "BGP peer FSM state transitions",
+    ("instance", "to"),
+)
+_BGP_DROPS = telemetry.counter(
+    "holo_bgp_session_drops_total",
+    "Established BGP sessions dropped",
+    ("instance",),
+)
 
 log = logging.getLogger("holo_tpu.bgp")
 
@@ -623,6 +637,7 @@ class BgpInstance(Actor):
     def start_peer(self, addr: IPv4Address) -> None:
         peer = self.peers[addr]
         peer.state = PeerState.CONNECT
+        _BGP_TRANSITIONS.labels(instance=self.name, to="connect").inc()
         self._send_open(peer)
 
     def remove_peer(self, addr: IPv4Address) -> None:
@@ -726,6 +741,7 @@ class BgpInstance(Actor):
     def _send_open(self, peer: Peer) -> None:
         self._send(peer, OpenMsg(self.asn, peer.config.hold_time, self.router_id))
         peer.state = PeerState.OPEN_SENT
+        _BGP_TRANSITIONS.labels(instance=self.name, to="open-sent").inc()
         self._hold_timer(peer).start(peer.config.hold_time)
         self._timer(("retry", peer.config.addr),
                     lambda a=peer.config.addr: ConnectRetryMsg(a)).start(
@@ -735,6 +751,9 @@ class BgpInstance(Actor):
     def _drop_peer(self, peer: Peer) -> None:
         was_established = peer.state == PeerState.ESTABLISHED
         peer.state = PeerState.IDLE
+        _BGP_TRANSITIONS.labels(instance=self.name, to="idle").inc()
+        if was_established:
+            _BGP_DROPS.labels(instance=self.name).inc()
         if was_established and self.notif_cb is not None:
             # Reference notification.rs:28-50 (codes of the NOTIFICATION
             # message, when one was exchanged, travel in the event).
@@ -818,12 +837,16 @@ class BgpInstance(Actor):
             self._send_open(peer)
         self._send(peer, KeepaliveMsg())
         peer.state = PeerState.OPEN_CONFIRM
+        _BGP_TRANSITIONS.labels(instance=self.name, to="open-confirm").inc()
         self._hold_timer(peer).start(peer.hold_time)
         self._keepalive_timer(peer).start(max(peer.hold_time / 3, 1))
 
     def _rx_keepalive(self, peer: Peer) -> None:
         if peer.state == PeerState.OPEN_CONFIRM:
             peer.state = PeerState.ESTABLISHED
+            _BGP_TRANSITIONS.labels(
+                instance=self.name, to="established"
+            ).inc()
             # Codes from a previous flap must not leak into this
             # session's eventual backward-transition event.
             peer.last_notification_rcvd = None

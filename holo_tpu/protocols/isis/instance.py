@@ -13,6 +13,7 @@ from ipaddress import IPv4Address, IPv4Network
 
 import numpy as np
 
+from holo_tpu import telemetry
 from holo_tpu.ops.graph import INF, Topology, mutual_keep_mask
 from holo_tpu.protocols.isis.packet import (
     LSP_MAX_AGE,
@@ -34,6 +35,23 @@ from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
 from holo_tpu.utils.bytesbuf import DecodeError
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
+
+# Adjacency churn, PDU rx rate, and SPF runs per instance (L1/L2 actors
+# carry distinct instance names, so levels separate naturally).
+_ISIS_ADJ_TRANSITIONS = telemetry.counter(
+    "holo_isis_adj_transitions_total",
+    "IS-IS adjacency up/down changes",
+    ("instance", "to"),
+)
+_ISIS_PDUS_RX = telemetry.counter(
+    "holo_isis_pdus_rx_total", "IS-IS PDUs received (decoded)", ("instance",)
+)
+_ISIS_RX_BAD = telemetry.counter(
+    "holo_isis_rx_bad_total", "IS-IS PDUs dropped in decode/auth", ("instance",)
+)
+_ISIS_SPF_RUNS = telemetry.counter(
+    "holo_isis_spf_runs_total", "IS-IS SPF runs", ("instance",)
+)
 
 def _sid_flags(psid) -> int:
     """RFC 8667 §2.1 prefix-SID flags from config: no-PHP (P) and
@@ -1001,6 +1019,9 @@ class IsisInstance(Actor):
     def _notify_adj_change(self, iface, sysid: bytes, up: bool) -> None:
         from holo_tpu.protocols.isis.nb_state import sysid_str
 
+        _ISIS_ADJ_TRANSITIONS.labels(
+            instance=self.name, to="up" if up else "down"
+        ).inc()
         self._notify(
             "adjacency-state-change",
             self._notif_common(iface)
@@ -1509,8 +1530,10 @@ class IsisInstance(Actor):
         try:
             pdu_type, pdu = decode_pdu(msg.data, auth=rx_auth)
         except DecodeError as e:
+            _ISIS_RX_BAD.labels(instance=self.name).inc()
             self._notify_decode_error(iface, msg.data, e, rx_auth)
             return
+        _ISIS_PDUS_RX.labels(instance=self.name).inc()
         snpa = msg.src if isinstance(msg.src, bytes) else b""
         self.rx_pdu(msg.ifname, pdu_type, pdu, snpa)
 
@@ -1818,6 +1841,11 @@ class IsisInstance(Actor):
             self.spf_delay_state = "quiet"
 
     def run_spf(self) -> None:
+        with telemetry.span("isis.spf", instance=self.name):
+            self._run_spf_traced()
+
+    def _run_spf_traced(self) -> None:
+        _ISIS_SPF_RUNS.labels(instance=self.name).inc()
         self.spf_run_count += 1
         now = self.loop.clock.now()
         nodes: dict[bytes, dict] = {}  # key: sysid+pn byte

@@ -21,6 +21,7 @@ import enum
 from dataclasses import dataclass, field
 from ipaddress import IPv4Address, IPv4Network
 
+from holo_tpu import telemetry
 from holo_tpu.protocols.ospf.interface import (
     ElectionView,
     IfConfig,
@@ -28,6 +29,32 @@ from holo_tpu.protocols.ospf.interface import (
     IsmState,
     OspfInterface,
     elect_dr_bdr,
+)
+
+# Protocol observability shared by OSPFv2 and OSPFv3 (the v3 instance
+# imports these families): NSM transitions, wire rx/tx/retransmit
+# rates, and SPF runs.  Labels stay low-cardinality (instance name +
+# an 8-state enum / direction).
+_OSPF_NBR_TRANSITIONS = telemetry.counter(
+    "holo_ospf_nbr_transitions_total",
+    "OSPF neighbor FSM state changes",
+    ("instance", "to"),
+)
+_OSPF_PACKETS = telemetry.counter(
+    "holo_ospf_packets_total", "OSPF packets", ("instance", "dir")
+)
+_OSPF_RX_BAD = telemetry.counter(
+    "holo_ospf_rx_bad_total",
+    "OSPF packets dropped in decode/auth",
+    ("instance",),
+)
+_OSPF_RETRANSMITS = telemetry.counter(
+    "holo_ospf_retransmits_total",
+    "OSPF rxmt-timer firings that resent DD/request/update state",
+    ("instance",),
+)
+_OSPF_SPF_RUNS = telemetry.counter(
+    "holo_ospf_spf_runs_total", "SPF runs", ("instance", "type")
 )
 from holo_tpu.protocols.ospf.lsdb import (
     MIN_LS_ARRIVAL,
@@ -1495,6 +1522,9 @@ class OspfInstance(Actor):
         if nbr.state != old_state:
             from holo_tpu.protocols.ospf.nb_state import _NSM_NAME
 
+            _OSPF_NBR_TRANSITIONS.labels(
+                instance=self.name, to=_NSM_NAME[nbr.state]
+            ).inc()
             self._notify(
                 "ietf-ospf:nbr-state-change",
                 self._notif_iface(iface)
@@ -2103,13 +2133,18 @@ class OspfInstance(Actor):
         nbr = iface.neighbors.get(nbr_id)
         if nbr is None:
             return
+        resent = False
         if nbr.state == NsmState.EX_START or (
             nbr.state == NsmState.EXCHANGE and nbr.master
         ):
             if nbr.last_sent_dd is not None:
                 self._send(iface, nbr.src, nbr.last_sent_dd, area)
+                resent = True
         if nbr.state == NsmState.LOADING and nbr.ls_request:
             self._send_ls_request(area, iface, nbr)
+            resent = True
+        if resent or nbr.ls_rxmt:
+            _OSPF_RETRANSMITS.labels(instance=self.name).inc()
         if nbr.ls_rxmt:
             lsas = [
                 self._tx_copy(l, iface.config.transmit_delay)
@@ -2675,6 +2710,10 @@ class OspfInstance(Actor):
         }
 
     def run_spf(self) -> None:
+        with telemetry.span("ospf.spf", instance=self.name):
+            self._run_spf_traced()
+
+    def _run_spf_traced(self) -> None:
         now = self.loop.clock.now()
         self.spf_run_count += 1
         start_time = now
@@ -2688,8 +2727,10 @@ class OspfInstance(Actor):
         self._spf_force_full = False
         partial = None if force_full else self._classify_spf(trigger_lsas)
         if partial is not None and self._spf_cache is not None:
+            _OSPF_SPF_RUNS.labels(instance=self.name, type="partial").inc()
             self._run_spf_partial(partial, scheduled_at, triggers, start_time)
             return
+        _OSPF_SPF_RUNS.labels(instance=self.name, type="full").inc()
         all_routes = {}
         area_intra: dict[IPv4Address, dict] = {}
         area_results: dict[IPv4Address, tuple] = {}
@@ -3910,11 +3951,13 @@ class OspfInstance(Actor):
             pkt = Packet.decode(msg.data, auth=iface.config.auth)
         except Exception:
             # Malformed/unauthenticated: drop + notify (events.rs:132).
+            _OSPF_RX_BAD.labels(instance=self.name).inc()
             self._notify(
                 "ietf-ospf:if-rx-bad-packet",
                 self._notif_iface(iface) | {"packet-source": str(msg.src)},
             )
             return
+        _OSPF_PACKETS.labels(instance=self.name, dir="rx").inc()
         # Destination validation (ospfv2/interface.rs:94-126): our own
         # address, AllSPFRouters, or AllDRouters when we are DR/BDR.
         if msg.dst is not None and msg.dst not in (
@@ -4013,4 +4056,5 @@ class OspfInstance(Actor):
             dst = iface.vlink_dst
             if dst is None:
                 return
+        _OSPF_PACKETS.labels(instance=self.name, dir="tx").inc()
         self.netio.send(out_ifname, iface.addr_ip, dst, pkt.encode(auth=auth))

@@ -20,8 +20,15 @@ from ipaddress import IPv4Address, IPv6Address, IPv6Network
 
 import numpy as np
 
+from holo_tpu import telemetry
 from holo_tpu.ops.graph import INF, Topology
 from holo_tpu.protocols.ospf import packet_v3 as P
+from holo_tpu.protocols.ospf.instance import (
+    _OSPF_NBR_TRANSITIONS,
+    _OSPF_PACKETS,
+    _OSPF_RX_BAD,
+    _OSPF_SPF_RUNS,
+)
 from holo_tpu.protocols.ospf.interface import ElectionView, IfType, elect_dr_bdr
 from holo_tpu.protocols.ospf.lsdb import MIN_LS_ARRIVAL, Lsdb, next_seq_no
 from holo_tpu.protocols.ospf.spf_run import atom_bits
@@ -525,6 +532,12 @@ class OspfV3Instance(Actor):
         old_state = nbr.state
         res = nsm_transition(nbr, event, adj_ok=self._adj_ok(iface, nbr))
         nbr.state = res.new_state
+        if nbr.state != old_state:
+            from holo_tpu.protocols.ospf.nb_state import _NSM_NAME
+
+            _OSPF_NBR_TRANSITIONS.labels(
+                instance=self.name, to=_NSM_NAME[nbr.state]
+            ).inc()
         if nbr.state != old_state and self.notif_cb is not None:
             # Reference holo-ospf northbound/notification.rs (shared by
             # both versions): same shape as the v2 instance's notify.
@@ -1530,14 +1543,20 @@ class OspfV3Instance(Actor):
         }
 
     def run_spf(self) -> None:
+        with telemetry.span("ospfv3.spf", instance=self.name):
+            self._run_spf_traced()
+
+    def _run_spf_traced(self) -> None:
         triggers = self._spf_triggers
         self._spf_triggers = []
         force_full = self._spf_force_full
         self._spf_force_full = False
         partial = None if force_full else self._classify_spf(triggers)
         if partial is not None and self._spf_cache is not None:
+            _OSPF_SPF_RUNS.labels(instance=self.name, type="partial").inc()
             self._run_spf_partial(partial)
             return
+        _OSPF_SPF_RUNS.labels(instance=self.name, type="full").inc()
         self.spf_run_count += 1
         start_time = self.loop.clock.now()
         area_results = {}
@@ -2311,7 +2330,9 @@ class OspfV3Instance(Actor):
                 msg.data, src=msg.src, dst=msg.dst, auth=iface.config.auth
             )
         except Exception:
+            _OSPF_RX_BAD.labels(instance=self.name).inc()
             return
+        _OSPF_PACKETS.labels(instance=self.name, dir="rx").inc()
         if pkt.router_id == self.router_id:
             return
         if iface.config.auth is not None:
@@ -2354,6 +2375,7 @@ class OspfV3Instance(Actor):
             if self._nvstore is not None and self._at_seqno >= self._at_reserved:
                 self._reserve_at_seqnos()
             auth.seqno = self._at_seqno
+        _OSPF_PACKETS.labels(instance=self.name, dir="tx").inc()
         self.netio.send(
             iface.name,
             iface.link_local,

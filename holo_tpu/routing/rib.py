@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, NamedTuple
 
+from holo_tpu import telemetry
 from holo_tpu.utils.ibus import (
     TOPIC_BFD_STATE,
     TOPIC_INTERFACE_DEL,
@@ -36,6 +37,28 @@ from holo_tpu.utils.southbound import (
     Protocol,
     RouteKeyMsg,
     RouteMsg,
+)
+
+
+# RIB churn observability: route add/replace/withdraw rates are the
+# protocol-visible convergence signal; backup flips/restores count the
+# IP-FRR local-repair moments (each one is a dataplane-affecting event).
+_RIB_OPS = telemetry.counter(
+    "holo_rib_route_ops_total", "RIB route operations", ("op",)
+)
+_RIB_INSTALLS = telemetry.counter(
+    "holo_rib_kernel_installs_total", "Kernel FIB install/uninstall calls", ("op",)
+)
+_RIB_FLIPS = telemetry.counter(
+    "holo_rib_backup_flips_total",
+    "Prefixes flipped to precomputed FRR backups by local repair",
+)
+_RIB_RESTORES = telemetry.counter(
+    "holo_rib_backup_restores_total",
+    "Repaired prefixes unwound after a failure event recovered",
+)
+_RIB_PREFIXES = telemetry.gauge(
+    "holo_rib_prefixes", "Prefixes currently present in the RIB"
 )
 
 
@@ -259,6 +282,7 @@ class RibManager(Actor):
         if not survivors:
             return False
         self.kernel.install(prefix, frozenset(survivors), msg.protocol)
+        _RIB_INSTALLS.labels(op="repair").inc()
         return True
 
     def local_repair(self, ifname: str | None, addr=None) -> int:
@@ -301,6 +325,8 @@ class RibManager(Actor):
                 continue
             self.repaired[prefix] = _Repair(msg, events)
             flipped += 1
+        if flipped:
+            _RIB_FLIPS.inc(flipped)
         return flipped
 
     def local_restore(self, ifname: str | None, addr=None) -> int:
@@ -332,6 +358,8 @@ class RibManager(Actor):
             elif self._repair_install(prefix, rec.msg, events):
                 self.repaired[prefix] = _Repair(rec.msg, events)
             restored += 1
+        if restored:
+            _RIB_RESTORES.inc(restored)
         return restored
 
     # -- next-hop tracking (reference rib.rs:64,290)
@@ -400,9 +428,13 @@ class RibManager(Actor):
 
     def route_add(self, msg: RouteMsg) -> None:
         pr = self.routes.setdefault(msg.prefix, _PrefixRoutes())
+        _RIB_OPS.labels(
+            op="replace" if msg.protocol in pr.entries else "add"
+        ).inc()
         pr.entries[msg.protocol] = RibEntry(msg)
         self._reselect(msg.prefix)
         self._nht_reeval(msg.prefix)
+        _RIB_PREFIXES.set(len(self.routes))
 
     def label_add(self, msg: LabelInstallMsg) -> None:
         """LFIB programming: the protocol's (LDP/SR) label binding joined
@@ -423,12 +455,18 @@ class RibManager(Actor):
         pr = self.routes.get(msg.prefix)
         if pr is None:
             return
+        if msg.protocol in pr.entries:
+            _RIB_OPS.labels(op="withdraw").inc()
         pr.entries.pop(msg.protocol, None)
+        _RIB_PREFIXES.set(
+            len(self.routes) - (0 if pr.entries else 1)
+        )
         if not pr.entries:
             del self.routes[msg.prefix]
             self.repaired.pop(msg.prefix, None)
             if msg.prefix in self._programmed:
                 self.kernel.uninstall(msg.prefix)
+                _RIB_INSTALLS.labels(op="uninstall").inc()
                 self._programmed.discard(msg.prefix)
             self.ibus.publish(
                 TOPIC_REDISTRIBUTE_DEL, RouteKeyMsg(msg.protocol, msg.prefix)
@@ -468,12 +506,14 @@ class RibManager(Actor):
                     best.msg.protocol,
                     backups=best.msg.backups or None,
                 )
+                _RIB_INSTALLS.labels(op="install").inc()
                 self._programmed.add(prefix)
             elif prefix in self._programmed:
                 # The withdrawn entry takes any active local repair with
                 # it — a later restore must not resurrect the route.
                 self.repaired.pop(prefix, None)
                 self.kernel.uninstall(prefix)
+                _RIB_INSTALLS.labels(op="uninstall").inc()
                 self._programmed.discard(prefix)
             self.ibus.publish(TOPIC_REDISTRIBUTE_ADD, best.msg)
         if self.on_change is not None:

@@ -10,11 +10,13 @@ can only do serially.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
 import numpy as np
 
+from holo_tpu import telemetry
 from holo_tpu.ops.graph import Topology, build_ell
 from holo_tpu.ops.spf_engine import (
     DeviceGraph,
@@ -24,6 +26,43 @@ from holo_tpu.ops.spf_engine import (
     spf_whatif_batch,
 )
 from holo_tpu.spf.scalar import spf_reference
+
+# Device-dispatch observability (the tentpole signal set): wall time per
+# dispatch, device->host readback time, jit recompiles vs shape-cache
+# hits (a silent recompile storm is the classic invisible regression),
+# and marshaled-graph cache behavior.  Shape tracking is done HERE (a
+# seen-signature set per backend) rather than poking jit internals, so
+# it works identically on every jax version and platform.
+_DISPATCH_SECONDS = telemetry.histogram(
+    "holo_spf_dispatch_seconds",
+    "Wall time of one SPF dispatch (incl. readback)",
+    ("backend", "kind"),
+)
+_TRANSFER_SECONDS = telemetry.histogram(
+    "holo_spf_transfer_seconds",
+    "Device->host readback time per dispatch",
+    ("kind",),
+)
+_JIT_COMPILES = telemetry.counter(
+    "holo_spf_jit_compiles_total",
+    "Dispatches that hit a new (engine, shape) bucket (XLA recompile)",
+    ("kind",),
+)
+_JIT_HITS = telemetry.counter(
+    "holo_spf_jit_cache_hits_total",
+    "Dispatches served from an already-compiled shape bucket",
+    ("kind",),
+)
+_GRAPH_CACHE = telemetry.counter(
+    "holo_spf_graph_cache_total",
+    "Marshaled DeviceGraph cache lookups",
+    ("result",),
+)
+_BATCH_SCENARIOS = telemetry.counter(
+    "holo_spf_scenarios_total",
+    "Scenario-SPFs computed (batch rows count individually)",
+    ("kind",),
+)
 
 
 @dataclass
@@ -75,10 +114,30 @@ class ScalarSpfBackend(SpfBackend):
         )
 
     def compute(self, topo, edge_mask=None):
-        return self._one(topo, edge_mask)
+        # Same dispatch histogram as the TPU backend (kind axis shared):
+        # a default-config daemon still reports SPF timing; only the
+        # transfer/recompile signals are device-specific.
+        t0 = time.perf_counter()
+        with telemetry.span("spf.dispatch", kind="one", backend="scalar"):
+            res = self._one(topo, edge_mask)
+        _DISPATCH_SECONDS.labels(backend="scalar", kind="one").observe(
+            time.perf_counter() - t0
+        )
+        _BATCH_SCENARIOS.labels(kind="one").inc()
+        return res
 
     def compute_whatif(self, topo, edge_masks):
-        return [self._one(topo, m) for m in edge_masks]
+        t0 = time.perf_counter()
+        with telemetry.span(
+            "spf.dispatch", kind="whatif", backend="scalar",
+            batch=len(edge_masks),
+        ):
+            res = [self._one(topo, m) for m in edge_masks]
+        _DISPATCH_SECONDS.labels(backend="scalar", kind="whatif").observe(
+            time.perf_counter() - t0
+        )
+        _BATCH_SCENARIOS.labels(kind="whatif").inc(len(res))
+        return res
 
     def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
         import copy
@@ -130,6 +189,9 @@ class TpuSpfBackend(SpfBackend):
         self.one_engine = one_engine
         self._blocked_cache: dict[tuple, object] = {}
         self._jit_blocked = None  # built lazily (pallas import)
+        # (kind, shape...) signatures already dispatched: a miss here is
+        # a fresh XLA compile for this backend instance.
+        self._compiled_shapes: set[tuple] = set()
         # Small LRU of marshaled graphs: an instance typically alternates
         # between its LSDB topology and derived ones (hop graphs for
         # flooding reduction), which must not evict each other.
@@ -153,12 +215,23 @@ class TpuSpfBackend(SpfBackend):
         key = topo.cache_key
         g = self._cache.get(key)
         if g is None:
+            _GRAPH_CACHE.labels(result="miss").inc()
             ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
             g = device_graph_from_ell(ell)
             self._cache[key] = g
             while len(self._cache) > 4:
                 self._cache.pop(next(iter(self._cache)))
+        else:
+            _GRAPH_CACHE.labels(result="hit").inc()
         return g
+
+    def _track_compile(self, kind: str, *shape) -> None:
+        sig = (kind, self.one_engine, *shape)
+        if sig in self._compiled_shapes:
+            _JIT_HITS.labels(kind=kind).inc()
+        else:
+            self._compiled_shapes.add(sig)
+            _JIT_COMPILES.labels(kind=kind).inc()
 
     def _full_mask(self, topo: Topology, edge_mask) -> np.ndarray:
         if edge_mask is None:
@@ -172,14 +245,26 @@ class TpuSpfBackend(SpfBackend):
             )
             if res is not None:
                 return res[0]
-        g = self.prepare(topo)
-        out = self._jit_one(g, topo.root, self._full_mask(topo, edge_mask))
-        return SpfResult(
-            dist=np.asarray(out.dist),
-            parent=np.asarray(out.parent),
-            hops=np.asarray(out.hops),
-            nexthop_words=np.asarray(out.nexthops),
-        )
+        t0 = time.perf_counter()
+        with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
+            g = self.prepare(topo)
+            self._track_compile(
+                "one", g.in_src.shape, g.direct_nh_words.shape[2],
+                topo.n_edges,
+            )
+            out = self._jit_one(g, topo.root, self._full_mask(topo, edge_mask))
+            t1 = time.perf_counter()
+            res = SpfResult(
+                dist=np.asarray(out.dist),
+                parent=np.asarray(out.parent),
+                hops=np.asarray(out.hops),
+                nexthop_words=np.asarray(out.nexthops),
+            )
+        t2 = time.perf_counter()
+        _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
+        _BATCH_SCENARIOS.labels(kind="one").inc()
+        return res
 
     def prepare_blocked(self, topo: Topology):
         """Marshal (and cache) the blocked planes; None if unsupported.
@@ -219,13 +304,24 @@ class TpuSpfBackend(SpfBackend):
             self._jit_blocked = jax.jit(
                 partial(whatif_spf_blocked, max_iters=self.max_iters)
             )
-        out = self._jit_blocked(g, fdst, fid)
-        dist, parent, hops, nh = (
-            np.asarray(out.dist),
-            np.asarray(out.parent),
-            np.asarray(out.hops),
-            np.asarray(out.nexthops),
-        )
+        t0 = time.perf_counter()
+        with telemetry.span(
+            "spf.dispatch", kind="blocked", backend="tpu",
+            batch=len(edge_masks),
+        ):
+            self._track_compile("blocked", fdst.shape, fid.shape)
+            out = self._jit_blocked(g, fdst, fid)
+            t1 = time.perf_counter()
+            dist, parent, hops, nh = (
+                np.asarray(out.dist),
+                np.asarray(out.parent),
+                np.asarray(out.hops),
+                np.asarray(out.nexthops),
+            )
+        t2 = time.perf_counter()
+        _TRANSFER_SECONDS.labels(kind="blocked").observe(t2 - t1)
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="blocked").observe(t2 - t0)
+        _BATCH_SCENARIOS.labels(kind="blocked").inc(dist.shape[0])
         return [
             SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
             for i in range(dist.shape[0])
@@ -236,19 +332,34 @@ class TpuSpfBackend(SpfBackend):
             res = self._whatif_blocked(topo, edge_masks)
             if res is not None:
                 return res
-        g = self.prepare(topo)
-        out = self._jit_batch(g, topo.root, np.asarray(edge_masks, bool))
-        # One bulk device→host transfer per plane: per-scenario slicing of
-        # device arrays would pay the host round-trip B×4 times.
-        dist, parent, hops, nh = (
-            np.asarray(out.dist),
-            np.asarray(out.parent),
-            np.asarray(out.hops),
-            np.asarray(out.nexthops),
-        )
+        t0 = time.perf_counter()
+        with telemetry.span(
+            "spf.dispatch", kind="whatif", backend="tpu",
+            batch=len(edge_masks),
+        ):
+            g = self.prepare(topo)
+            masks = np.asarray(edge_masks, bool)
+            self._track_compile(
+                "whatif", g.in_src.shape, g.direct_nh_words.shape[2],
+                masks.shape,
+            )
+            out = self._jit_batch(g, topo.root, masks)
+            t1 = time.perf_counter()
+            # One bulk device→host transfer per plane: per-scenario slicing
+            # of device arrays would pay the host round-trip B×4 times.
+            dist, parent, hops, nh = (
+                np.asarray(out.dist),
+                np.asarray(out.parent),
+                np.asarray(out.hops),
+                np.asarray(out.nexthops),
+            )
+        t2 = time.perf_counter()
+        _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
+        _BATCH_SCENARIOS.labels(kind="whatif").inc(masks.shape[0])
         return [
             SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
-            for i in range(edge_masks.shape[0])
+            for i in range(masks.shape[0])
         ]
 
     def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
@@ -259,11 +370,26 @@ class TpuSpfBackend(SpfBackend):
         other root.  Multi-root users (IS-IS flooding reduction, TI-LFA)
         need the SPT shape only.
         """
-        g = self.prepare(topo)
-        mask = np.ones(topo.n_edges, bool)
-        out = self._jit_multiroot(g, np.asarray(roots, np.int32), mask)
-        return MultiRootResult(
-            dist=np.asarray(out.dist),
-            parent=np.asarray(out.parent),
-            hops=np.asarray(out.hops),
-        )
+        t0 = time.perf_counter()
+        with telemetry.span(
+            "spf.dispatch", kind="multiroot", backend="tpu", roots=len(roots)
+        ):
+            g = self.prepare(topo)
+            roots_i32 = np.asarray(roots, np.int32)
+            self._track_compile(
+                "multiroot", g.in_src.shape, g.direct_nh_words.shape[2],
+                roots_i32.shape[0], topo.n_edges,
+            )
+            mask = np.ones(topo.n_edges, bool)
+            out = self._jit_multiroot(g, roots_i32, mask)
+            t1 = time.perf_counter()
+            res = MultiRootResult(
+                dist=np.asarray(out.dist),
+                parent=np.asarray(out.parent),
+                hops=np.asarray(out.hops),
+            )
+        t2 = time.perf_counter()
+        _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)
+        _BATCH_SCENARIOS.labels(kind="multiroot").inc(roots_i32.shape[0])
+        return res

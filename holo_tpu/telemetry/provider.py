@@ -1,0 +1,61 @@
+"""Telemetry as operational state: a read-only northbound provider
+serving the registry under the ``holo-telemetry`` subtree, so gNMI
+``Get``/``Subscribe`` (and the gRPC GetState path) see live metric
+leaves with no extra plumbing — the ``_RuntimeStateProvider`` pattern.
+
+Tree shape (walks into one gNMI update per leaf under PROTO encoding):
+
+    holo-telemetry/
+      metric[<name>]/            # list keyed by exposition name
+        name                     # counter/gauge: bare family name;
+        value                    #   histograms expand to _count/_sum
+        labels                   # "k=v,k=v" ("" when label-less)
+"""
+
+from __future__ import annotations
+
+from holo_tpu.northbound.provider import Provider as NbProvider
+
+ROOT = "holo-telemetry"
+
+
+class TelemetryStateProvider(NbProvider):
+    """Read-only: owns no config subtree, vetoes nothing."""
+
+    name = "telemetry"
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from holo_tpu import telemetry
+
+            registry = telemetry.registry()
+        self._registry = registry
+
+    def filter_changes(self, changes):
+        return []  # state-only: never part of a commit fan-out
+
+    def get_state(self, path: str | None = None) -> dict:
+        if path and not ROOT.startswith(path.split("/")[0]):
+            return {}
+        metrics = []
+        for fam in self._registry.families():
+            for key, child in fam.children():
+                labels = ",".join(
+                    f"{n}={v}" for n, v in zip(fam.labelnames, key)
+                )
+                if fam.kind == "histogram":
+                    rows = [
+                        (f"{fam.name}_count", child.count),
+                        (f"{fam.name}_sum", round(child.sum, 9)),
+                    ]
+                else:
+                    rows = [(fam.name, child.value)]
+                for name, value in rows:
+                    metrics.append(
+                        {
+                            "name": f"{name}{{{labels}}}" if labels else name,
+                            "value": value,
+                            "labels": labels,
+                        }
+                    )
+        return {ROOT: {"metric": metrics}}

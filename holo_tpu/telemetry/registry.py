@@ -1,0 +1,318 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The observability analog of the reference's tokio-console/tracing
+instrumentation, shaped for the TPU hot paths: every metric is a named
+family with optional label dimensions; children are created lazily per
+label-value tuple and updated under a per-child lock (increments are a
+couple of dict hits + a float add, cheap enough for the dispatch path —
+gated by :func:`holo_tpu.telemetry.set_enabled` so the overhead bench
+can A/B a disabled registry).
+
+Naming convention (documented in COMPONENTS.md):
+
+    holo_<subsystem>_<what>[_<unit>][_total]
+
+e.g. ``holo_spf_dispatch_seconds`` (histogram),
+``holo_rib_route_adds_total`` (counter), ``holo_ibus_subscribers``
+(gauge).  Counters end in ``_total``; histograms of durations end in
+``_seconds`` — both Prometheus conventions, so the text exposition
+(:mod:`holo_tpu.telemetry.prometheus`) needs no renaming pass.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+# Default histogram buckets: SPF dispatches span ~100us (tiny LSDB,
+# warm jit) to minutes (50k-vertex cold compile) — log-spaced seconds.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0,
+)
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    """Global kill switch: disabled metrics become no-ops (the overhead
+    bench's control arm).  Collection still works — values just freeze."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class Counter:
+    """Monotonic counter child.  ``inc`` only accepts non-negative deltas."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not _enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value child.  ``set_fn`` makes it callback-backed
+    (sampled at collect time — queue depths, cache sizes)."""
+
+    __slots__ = ("_lock", "_value", "_fn")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Callable[[], float] | None = None
+
+    def set(self, value: float) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_fn(self, fn: Callable[[], float] | None) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — sampling must never raise
+                return 0.0
+        return self._value
+
+
+class Histogram:
+    """Fixed-boundary histogram child (cumulative at render time)."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self._lock = threading.Lock()
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        if not _enabled:
+            return
+        i = 0
+        for i, b in enumerate(self.buckets):  # noqa: B007 — small, fixed
+            if value <= b:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative_count)] including the +Inf bucket."""
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        acc = 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            out.append((b, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricFamily:
+    """A named metric with label dimensions; children per label tuple."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        buckets: tuple[float, ...] | None = None,
+    ):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(kv[n] for n in self.labelnames)
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {key}"
+            )
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    if self.kind == "histogram":
+                        child = Histogram(self._buckets or DEFAULT_BUCKETS)
+                    else:
+                        child = _KINDS[self.kind]()
+                    self._children[key] = child
+        return child
+
+    # Label-less families proxy the single child's API so call sites
+    # read `family.inc()` instead of `family.labels().inc()`.
+
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def set_fn(self, fn) -> None:
+        self._default().set_fn(fn)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    @property
+    def count(self):
+        return self._default().count
+
+    @property
+    def sum(self):
+        return self._default().sum
+
+    def cumulative(self):
+        return self._default().cumulative()
+
+    def children(self) -> Iterable[tuple[tuple, object]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Get-or-create registry of metric families (process-wide default in
+    :mod:`holo_tpu.telemetry`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, MetricFamily] = {}
+
+    def _get(
+        self,
+        name: str,
+        kind: str,
+        help: str,
+        labelnames: tuple[str, ...],
+        buckets: tuple[float, ...] | None = None,
+    ) -> MetricFamily:
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}"
+                )
+            return fam
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = MetricFamily(name, kind, help, labelnames, buckets)
+                self._families[name] = fam
+        return fam
+
+    def counter(
+        self, name: str, help: str = "", labelnames: tuple[str, ...] = ()
+    ) -> MetricFamily:
+        return self._get(name, "counter", help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: tuple[str, ...] = ()
+    ) -> MetricFamily:
+        return self._get(name, "gauge", help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        buckets: tuple[float, ...] | None = None,
+    ) -> MetricFamily:
+        return self._get(name, "histogram", help, labelnames, buckets)
+
+    def families(self) -> list[MetricFamily]:
+        with self._lock:
+            return sorted(self._families.values(), key=lambda f: f.name)
+
+    def snapshot(self, prefix: str | None = None) -> dict:
+        """Flat JSON-able view: counters/gauges -> number, histograms ->
+        {count, sum} — what bench stages attach to their emitted rows."""
+        out: dict = {}
+        for fam in self.families():
+            if prefix is not None and not fam.name.startswith(prefix):
+                continue
+            for key, child in fam.children():
+                label = ",".join(
+                    f"{n}={v}" for n, v in zip(fam.labelnames, key)
+                )
+                name = f"{fam.name}{{{label}}}" if label else fam.name
+                if fam.kind == "histogram":
+                    out[name] = {
+                        "count": child.count,
+                        "sum": round(child.sum, 6),
+                    }
+                else:
+                    out[name] = child.value
+        return out
+
+    def clear(self) -> None:
+        """Drop every family (tests only — live handles go stale)."""
+        with self._lock:
+            self._families.clear()

@@ -174,6 +174,33 @@ def cmd_conformance(args) -> int:
     return 1 if failed else 0
 
 
+def cmd_trace(args) -> int:
+    """Run a synthetic SPF + FRR workload with span tracing and dump the
+    spans as Chrome trace-event JSON (load in chrome://tracing or
+    https://ui.perfetto.dev) — the quickest way to SEE where a dispatch
+    spends its time.  A daemon produces the same artifact at stop via
+    ``[telemetry] trace-dump`` or ``HOLO_TPU_TRACE_DUMP=<path>``."""
+    from holo_tpu import telemetry
+    from holo_tpu.frr.manager import FrrEngine
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth import grid_topology, whatif_link_failure_masks
+
+    topo = grid_topology(args.rows, args.rows, seed=1)
+    backend = TpuSpfBackend()
+    with telemetry.span("trace.workload", instance="synth"):
+        for _ in range(max(args.repeat, 1)):
+            backend.compute(topo)
+        masks = whatif_link_failure_masks(topo, 8, seed=2)
+        backend.compute_whatif(topo, masks)
+        FrrEngine("tpu").compute(topo)
+    n = telemetry.tracer().dump(args.output)
+    print(f"wrote {n} spans to {args.output}")
+    snap = telemetry.snapshot(prefix="holo_spf")
+    for name in sorted(snap):
+        print(f"  {name} = {snap[name]}")
+    return 0
+
+
 def cmd_import_yang(args) -> int:
     """Parse YANG text file(s) and dump the resulting schema subtrees —
     the libyang-load analog for externally authored modules.  Multiple
@@ -299,6 +326,14 @@ def main(argv=None) -> int:
                    help="one topology dir (default: all)")
     s.add_argument("--protocol", choices=("ospf", "isis"), default="ospf")
     s.set_defaults(fn=cmd_conformance)
+    s = sub.add_parser(
+        "trace",
+        help="trace a synthetic SPF/FRR workload to Chrome trace JSON",
+    )
+    s.add_argument("-o", "--output", default="holo_tpu_trace.json")
+    s.add_argument("--rows", type=int, default=6, help="grid topology side")
+    s.add_argument("--repeat", type=int, default=3, help="single-SPF runs")
+    s.set_defaults(fn=cmd_trace)
     s = sub.add_parser(
         "import-yang",
         help="parse YANG text module(s) and dump their schema subtrees",

@@ -17,6 +17,7 @@ import dataclasses
 import enum
 import importlib
 import json
+import time
 from ipaddress import IPv4Address, IPv4Network, IPv6Address, IPv6Network, ip_address, ip_network
 from pathlib import Path
 
@@ -97,11 +98,22 @@ class EventRecorder:
         # instance's thread under preemptive isolation): line-buffered
         # appends must not interleave.
         self._lock = threading.Lock()
+        # Inter-event latency reconstruction: "time" is the (possibly
+        # virtual) loop clock, useless for real latency under a virtual
+        # clock and not monotonic across daemon restarts — so each entry
+        # also carries a monotonic offset from recorder creation plus a
+        # global sequence number (one counter across every instrumented
+        # loop: replays can totally order cross-thread deliveries).
+        self._mono0 = time.monotonic()
+        self._seq = 0
 
     def record(self, actor: str, now: float, msg) -> None:
         try:
             entry = {"actor": actor, "time": now, "msg": _encode_value(msg)}
             with self._lock:
+                entry["mono"] = round(time.monotonic() - self._mono0, 9)
+                entry["seq"] = self._seq
+                self._seq += 1
                 self._fh.write(json.dumps(entry) + "\n")
                 self._fh.flush()
         except Exception:
@@ -132,6 +144,23 @@ def instrument(loop: EventLoop, recorder: EventRecorder, actors: set[str] | None
     loop._deliver_one = deliver_one
 
 
+def read_entries(path: Path) -> list[dict]:
+    """Decode a recording with backward-compatible defaults: recordings
+    made before the mono/seq stamps replay unchanged (mono falls back to
+    the recorded loop time, seq to the line index), so old incident
+    journals stay loadable while new ones carry real inter-event
+    latency."""
+    out = []
+    for i, line in enumerate(Path(path).read_text().splitlines()):
+        if not line.strip():
+            continue
+        entry = json.loads(line)
+        entry.setdefault("mono", float(entry.get("time", 0.0)))
+        entry.setdefault("seq", i)
+        out.append(entry)
+    return out
+
+
 def replay(path: Path, loop: EventLoop, actor_map: dict[str, str] | None = None) -> int:
     """Feed a recording back into registered actors.  Returns #messages.
 
@@ -142,10 +171,7 @@ def replay(path: Path, loop: EventLoop, actor_map: dict[str, str] | None = None)
     """
     n = 0
     last_t = 0.0
-    for line in Path(path).read_text().splitlines():
-        if not line.strip():
-            continue
-        entry = json.loads(line)
+    for entry in read_entries(path):
         actor = (actor_map or {}).get(entry["actor"], entry["actor"])
         t = entry.get("time", 0.0)
         if t > last_t and hasattr(loop.clock, "advance"):

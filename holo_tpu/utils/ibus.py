@@ -16,8 +16,21 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any
 
+from holo_tpu import telemetry
 from holo_tpu.utils.runtime import EventLoop
 from holo_tpu.utils.southbound import Protocol
+
+# Bus observability: publish rate per topic plus the undeliverable
+# count (a send to an unregistered/disconnected actor — the reference's
+# channel-drop detection moment, ibus.rs:473-488).
+_PUBLISHES = telemetry.counter(
+    "holo_ibus_publish_total", "ibus publications delivered", ("topic",)
+)
+_UNDELIVERABLE = telemetry.counter(
+    "holo_ibus_undeliverable_total",
+    "ibus sends dropped (no such actor / disconnected)",
+    ("topic",),
+)
 
 
 @dataclass
@@ -92,10 +105,17 @@ class Ibus:
     ) -> int:
         """Deliver to all subscribers whose filters match; returns count."""
         n = 0
+        dropped = 0
         for s in self._subs.get(topic, []):
             if all(match.get(k) == v for k, v in s.filter.items()):
                 if self.loop.send(s.actor, IbusMsg(topic, payload, sender)):
                     n += 1
+                else:
+                    dropped += 1
+        if n:
+            _PUBLISHES.labels(topic=topic).inc(n)
+        if dropped:
+            _UNDELIVERABLE.labels(topic=topic).inc(dropped)
         return n
 
     def request(self, server_actor: str, payload: Any, sender: str = "") -> bool:

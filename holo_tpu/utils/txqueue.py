@@ -17,9 +17,26 @@ from __future__ import annotations
 import queue
 import threading
 
+from holo_tpu import telemetry
 from holo_tpu.utils.netio import NetIo
 
 _STOP = object()
+
+# Per-interface Tx task observability: queue depth is the backpressure
+# signal (a climbing depth = the wire can't keep up with production);
+# drops only happen for late sends after close().
+_TX_SENT = telemetry.counter(
+    "holo_txqueue_sent_total", "Packets sent by per-interface Tx tasks", ("ifname",)
+)
+_TX_ERRORS = telemetry.counter(
+    "holo_txqueue_errors_total", "Tx task sends that raised", ("ifname",)
+)
+_TX_DROPPED = telemetry.counter(
+    "holo_txqueue_dropped_total", "Sends dropped after close()", ("ifname",)
+)
+_TX_DEPTH = telemetry.gauge(
+    "holo_txqueue_depth", "Tx queue depth at last enqueue", ("ifname",)
+)
 
 
 class _IfaceTxTask:
@@ -42,8 +59,9 @@ class _IfaceTxTask:
             try:
                 self.inner.send(self.ifname, src, dst, data)
                 self.sent += 1
+                _TX_SENT.labels(ifname=self.ifname).inc()
             except Exception:  # noqa: BLE001 — a bad send must not kill tx
-                pass
+                _TX_ERRORS.labels(ifname=self.ifname).inc()
 
     def request_stop(self) -> None:
         try:
@@ -93,6 +111,9 @@ class TxTaskNetIo(NetIo):
         t = self._task(ifname)
         if t is not None:
             t.q.put((src, dst, data))
+            _TX_DEPTH.labels(ifname=ifname).set(t.q.qsize())
+        else:
+            _TX_DROPPED.labels(ifname=ifname).inc()
 
     def __getattr__(self, name: str):
         # Forward everything we don't override to the wrapped NetIo:

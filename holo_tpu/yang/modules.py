@@ -196,6 +196,20 @@ def _spf_control():
     )
 
 
+def _fast_reroute():
+    """ietf-ospf/isis fast-reroute container + holo's remote-lfa /
+    ti-lfa / engine extension leaves — the shape the routing provider's
+    ``_frr_config`` consumes (providers.py).  No defaulted leaves: an
+    untouched container stays absent, which means FRR disabled."""
+    return C(
+        "fast-reroute",
+        _leaf("lfa", "boolean"),  # RFC 5286 (absent = true when set)
+        _leaf("remote-lfa", "boolean"),  # RFC 7490
+        _leaf("ti-lfa", "boolean"),  # requires SR
+        _leaf("engine", "enum", enum=("scalar", "tpu")),
+    )
+
+
 def _ospf_subtree(name):
     return C(
         name,
@@ -203,6 +217,7 @@ def _ospf_subtree(name):
         _leaf("enabled", "boolean", default=True),
         LeafList("redistribute", "string"),  # protocols to inject as type-5
         _spf_control(),
+        _fast_reroute(),
         L(
             "area",
             "area-id",
@@ -357,6 +372,7 @@ def routing_module():
               _leaf("level", "enum", enum=("level-1", "level-2", "level-all"),
                     default="level-all"),
               _spf_control(),
+              _fast_reroute(),
               # Instance-level LSP/SNP authentication (reference
               # holo-isis northbound configuration.rs:531-597: key-chain
               # OR inline key + key-id + crypto-algorithm).
