@@ -1,0 +1,53 @@
+"""holo-lint: repo-native static analysis for JAX hot-path hazards and
+daemon lock discipline.
+
+The Rust reference enforces its safety story mechanically
+(``unsafe_code = "forbid"``); this package is the Python/JAX rebuild's
+analog: an AST-based analyzer whose rules encode the two defect classes
+our telemetry can only observe *after the fact* —
+
+- **Tracer/dispatch rules (HL1xx)** over the device-compute modules
+  (``ops/``, ``spf/``, ``frr/``, ``parallel/``): implicit host syncs on
+  the dispatch path, Python control flow on traced values, jit patterns
+  that force recompiles, and float/dtype drift that threatens
+  bit-identical RIB parity with the scalar oracle.
+- **Concurrency rules (HL2xx)** over the threaded daemon (``daemon/``,
+  ``utils/ibus.py``, ``utils/txqueue.py``, ``utils/preempt.py``,
+  ``telemetry/``): shared attributes mutated without their owning lock,
+  locks held across blocking calls, and callback/publish invocation
+  while holding a lock — a deadlock class the native TSan job cannot
+  see.
+
+Entry points:
+
+- ``holo-tpu-tools lint`` (:mod:`holo_tpu.tools.cli`) — the gate, wired
+  into tier-1 via ``tests/test_lint_repo_clean.py`` and the verify
+  chain in ROADMAP.md;
+- :func:`run_paths` / :func:`run_source` — the library API (used by the
+  golden-fixture tests);
+- :mod:`holo_tpu.analysis.runtime` — the runtime sanitizer mode
+  (``jax.transfer_guard``) that catches transfers static analysis
+  cannot prove.
+
+Findings are suppressed inline with ``# holo-lint: disable=<id>`` (same
+line or the line above) and ratcheted through a checked-in baseline
+file (``holo_tpu/analysis/baseline.json``): the gate fails only on
+findings NOT in the baseline, so it starts green and tightens as
+baseline entries are fixed and removed.
+"""
+
+from __future__ import annotations
+
+from holo_tpu.analysis.core import (  # noqa: F401 — public API
+    Finding,
+    LintConfig,
+    LintResult,
+    Rule,
+    all_rules,
+    compare_to_baseline,
+    default_baseline_path,
+    load_baseline,
+    run_paths,
+    run_source,
+    write_baseline,
+)

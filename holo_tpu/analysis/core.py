@@ -1,0 +1,339 @@
+"""holo-lint core: rule registry, module model, suppressions, baseline.
+
+Everything here is stdlib-only (``ast`` + ``json``) and import-light:
+the lint gate runs in the tier-1 verify chain, so it must not pay a JAX
+import (the runtime sanitizer in :mod:`holo_tpu.analysis.runtime` is
+the only piece that touches JAX, and it imports it lazily).
+
+Identity model: a finding's baseline key is line-number-free
+(``rule|path|context|message``) so unrelated edits moving code up or
+down a file do not churn the baseline; duplicates within one context
+are counted, so "two unlocked writes to the same attr in one method"
+cannot silently become three.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# -- suppression syntax -------------------------------------------------
+
+# `# holo-lint: disable=HL101` (same line or the line above the finding).
+# Multiple ids comma-separated; `disable=all` silences every rule.
+_SUPPRESS_RE = re.compile(r"#\s*holo-lint:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+
+def parse_suppressions(source: str) -> dict[int, set[str]]:
+    """1-based line -> set of suppressed rule ids (or {'all'})."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            out[i] = ids
+    return out
+
+
+# -- findings -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "HL101"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    context: str  # enclosing qualname ("Class.method", "<module>")
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-free identity used for baseline matching."""
+        return f"{self.rule}|{self.path}|{self.context}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.context}] {self.message}"
+
+
+# -- configuration ------------------------------------------------------
+
+# Defaults mirror the subsystem split documented in COMPONENTS.md: the
+# tracer family covers every module that marshals for or computes on
+# the device; the concurrency family covers the thread-shared daemon
+# surface.  utils/runtime.py (the cooperative single-thread EventLoop)
+# is deliberately NOT in the concurrency list: its single-writer actor
+# discipline is the synchronization, and lock rules would only produce
+# noise there.
+DISPATCH_PREFIXES = (
+    "holo_tpu/ops",
+    "holo_tpu/spf",
+    "holo_tpu/frr",
+    "holo_tpu/parallel",
+)
+CONCURRENCY_PREFIXES = (
+    "holo_tpu/daemon",
+    "holo_tpu/telemetry",
+    "holo_tpu/utils/ibus.py",
+    "holo_tpu/utils/txqueue.py",
+    "holo_tpu/utils/preempt.py",
+)
+# HL204 (no-lock shared container) is scoped tighter still: daemon/
+# providers run on the primary loop under the actor model, where a
+# lock-free dict is the design, not a bug.
+SHARED_STATE_PREFIXES = (
+    "holo_tpu/utils/ibus.py",
+    "holo_tpu/utils/txqueue.py",
+    "holo_tpu/telemetry",
+)
+
+
+@dataclass
+class LintConfig:
+    dispatch_prefixes: tuple[str, ...] = DISPATCH_PREFIXES
+    concurrency_prefixes: tuple[str, ...] = CONCURRENCY_PREFIXES
+    shared_state_prefixes: tuple[str, ...] = SHARED_STATE_PREFIXES
+    exclude_parts: tuple[str, ...] = ("__pycache__",)
+
+    def in_dispatch_scope(self, relpath: str) -> bool:
+        return relpath.startswith(self.dispatch_prefixes)
+
+    def in_concurrency_scope(self, relpath: str) -> bool:
+        return relpath.startswith(self.concurrency_prefixes)
+
+    def in_shared_state_scope(self, relpath: str) -> bool:
+        return relpath.startswith(self.shared_state_prefixes)
+
+
+# -- module model -------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """One parsed module plus the derived maps every rule needs."""
+
+    def __init__(self, relpath: str, source: str, config: LintConfig):
+        self.relpath = relpath
+        self.source = source
+        self.config = config
+        self.tree = ast.parse(source, filename=relpath)
+        self.suppressions = parse_suppressions(source)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Enclosing def/class chain, e.g. 'TxTaskNetIo.close'."""
+        parts: list[str] = []
+        cur: ast.AST | None = node
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def classes(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                yield node
+
+    def suppressed(self, finding: Finding) -> bool:
+        for line in (finding.line, finding.line - 1):
+            ids = self.suppressions.get(line)
+            if ids and ("all" in ids or finding.rule in ids):
+                return True
+        return False
+
+
+# -- rules --------------------------------------------------------------
+
+
+class Rule:
+    """One lint rule: an id, a family, and a per-module check."""
+
+    id = "HL000"
+    title = "abstract rule"
+    family = "tracer"  # "tracer" | "locks"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, mod: ModuleInfo, node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=mod.relpath,
+            line=getattr(node, "lineno", 1),
+            context=mod.qualname(node),
+            message=message,
+        )
+
+
+def all_rules() -> list[Rule]:
+    """Instantiate the full registry (import is deferred so `core` has
+    no circular dependency on the rule modules)."""
+    from holo_tpu.analysis import rules_locks, rules_tracer
+
+    return [cls() for cls in rules_tracer.RULES + rules_locks.RULES]
+
+
+# -- running ------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    parse_errors: list[str] = field(default_factory=list)
+    files_checked: int = 0
+
+
+def run_source(
+    source: str,
+    relpath: str,
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+) -> LintResult:
+    """Lint one module given as text (fixture tests use this)."""
+    config = config or LintConfig()
+    rules = rules if rules is not None else all_rules()
+    result = LintResult(files_checked=1)
+    try:
+        mod = ModuleInfo(relpath, source, config)
+    except SyntaxError as e:
+        result.parse_errors.append(f"{relpath}: {e}")
+        return result
+    for rule in rules:
+        for f in rule.check(mod):
+            (result.suppressed if mod.suppressed(f) else result.findings).append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+def run_paths(
+    paths: list[Path],
+    root: Path,
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+) -> LintResult:
+    """Lint every ``*.py`` under ``paths``; relpaths are vs ``root``."""
+    config = config or LintConfig()
+    rules = rules if rules is not None else all_rules()
+    result = LintResult()
+    files: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    for f in files:
+        if any(part in config.exclude_parts for part in f.parts):
+            continue
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            # Outside the repo root (ad-hoc `lint /some/copy/...`):
+            # re-anchor at the last `holo_tpu/` segment so the scope
+            # prefixes still apply instead of silently matching nothing.
+            posix = f.as_posix()
+            idx = posix.rfind("/holo_tpu/")
+            rel = posix[idx + 1:] if idx >= 0 else posix
+        one = run_source(f.read_text(), rel, config, rules)
+        result.findings.extend(one.findings)
+        result.suppressed.extend(one.suppressed)
+        result.parse_errors.extend(one.parse_errors)
+        result.files_checked += 1
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+# -- baseline (the ratchet) ---------------------------------------------
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Path) -> Counter:
+    """Baseline file -> multiset of finding keys.  Missing file = empty
+    (the gate then requires a fully clean tree)."""
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text())
+    out: Counter = Counter()
+    for entry in data.get("findings", []):
+        out[entry["key"]] += int(entry.get("count", 1))
+    return out
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    counts = Counter(f.key for f in findings)
+    doc = {
+        "comment": (
+            "holo-lint ratchet baseline: keys are rule|path|context|message "
+            "(line-free).  The gate fails on findings NOT listed here.  "
+            "Entries exist only while a fix is pending — remove them as "
+            "findings are fixed; never add new ones to silence a new defect "
+            "(use an inline `# holo-lint: disable=<id>` with a justification "
+            "comment for sanctioned exceptions)."
+        ),
+        "findings": [
+            {"key": k, "count": c} for k, c in sorted(counts.items())
+        ],
+    }
+    path.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def compare_to_baseline(
+    findings: list[Finding], baseline: Counter
+) -> tuple[list[Finding], Counter]:
+    """(new findings not covered by the baseline, unused baseline keys).
+
+    Multiset semantics: a baseline count of 1 covers exactly one live
+    finding with that key; a second identical finding is NEW.
+    """
+    budget = Counter(baseline)
+    new: list[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            new.append(f)
+    unused = Counter({k: c for k, c in budget.items() if c > 0})
+    return new, unused

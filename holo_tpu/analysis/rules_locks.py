@@ -1,0 +1,470 @@
+"""holo-lint concurrency rules (HL2xx): daemon lock discipline.
+
+Scope: the thread-shared daemon surface (``daemon/``, ``telemetry/``,
+``utils/ibus.py``, ``utils/txqueue.py``, ``utils/preempt.py`` —
+:data:`holo_tpu.analysis.core.CONCURRENCY_PREFIXES`).  The cooperative
+EventLoop core (``utils/runtime.py``) is deliberately out of scope: its
+single-writer actor discipline *is* the synchronization.
+
+The model is a per-class lockset: attributes assigned
+``threading.Lock()``/``RLock()``/``Condition()`` are lock attrs;
+``with self.<lock>:`` statements (plus ``with <local> :`` for locks
+created in the same function, and any ``with x.y_lock:``-shaped
+context) delimit locked regions.  Three discipline rules run inside
+that model, plus one for thread-shared classes with no lock at all.
+These are exactly the defect classes the native TSan job
+(tests/test_native_sanitizers.py) cannot see from Python: it watches
+the C side, while the GIL hides Python-level atomicity violations
+until a preemption lands between bytecodes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, Rule, dotted
+
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "Lock",
+    "RLock",
+}
+_CONDITION_CTORS = {"threading.Condition", "Condition"}
+# `with <expr>:` contexts that look like a lock even when the attr is
+# defined elsewhere (e.g. `with self.daemon.lock:` in gnmi_server).
+_LOCKISH_NAME = re.compile(r"(^|_)lock$")
+
+# Calls that can block (or wait on another thread) — holding a lock
+# across any of these stalls every contender, and a contender that is
+# the thing being waited on deadlocks the daemon.
+_BLOCKING_ATTRS = {
+    "send",
+    "sendall",
+    "sendto",
+    "sendmsg",
+    "recv",
+    "recvfrom",
+    "recv_into",
+    "accept",
+    "connect",
+    "join",
+    "sleep",
+    "block_until_ready",
+    "device_put",
+    "acquire",
+    "put",
+    "wait",
+    "wait_for",
+    "run_until_idle",
+    "advance",
+    "call",
+}
+# Container-mutating method names (HL201/HL204 write detection).
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "pop",
+    "popleft",
+    "appendleft",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "discard",
+}
+# Attribute names that hold user/stored callables: invoking one while
+# holding a lock hands our monitor to arbitrary code (HL203).
+_CALLBACK_ATTRS = {
+    "_fn",
+    "_cb",
+    "_callback",
+    "callback",
+    "cb",
+    "hook",
+    "_hook",
+    "handler",
+    "_handler",
+    "publish",
+    "emit",
+    "deliver",
+    "notify_cb",
+}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'X' for an `self.X` attribute node, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Lock attrs, condition attrs, and locked line-regions per class."""
+
+    def __init__(self, mod: ModuleInfo, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.lock_attrs: set[str] = set()
+        self.condition_attrs: set[str] = set()
+        self.methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                ctor = dotted(node.value.func)
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        self.lock_attrs.add(attr)
+                    elif ctor in _CONDITION_CTORS:
+                        self.condition_attrs.add(attr)
+
+    @property
+    def guard_attrs(self) -> set[str]:
+        return self.lock_attrs | self.condition_attrs
+
+    def _local_locks(self, fn: ast.FunctionDef) -> set[str]:
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if dotted(node.value.func) in (
+                    _LOCK_CTORS | _CONDITION_CTORS
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    def lock_regions(self, fn: ast.FunctionDef) -> list[tuple[str, ast.With]]:
+        """(lock-expression-dotted, with-node) for locked regions in fn."""
+        local = self._local_locks(fn)
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                ctx = item.context_expr
+                d = dotted(ctx)
+                if d is None:
+                    continue
+                attr = _self_attr(ctx)
+                if (
+                    (attr is not None and attr in self.guard_attrs)
+                    or (d in local)
+                    or _LOCKISH_NAME.search(d.rsplit(".", 1)[-1])
+                ):
+                    out.append((d, node))
+                    break
+        return out
+
+
+def _classes(mod: ModuleInfo):
+    for cls in mod.classes():
+        yield _ClassModel(mod, cls)
+
+
+def _in_node(node: ast.AST, region: ast.With) -> bool:
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return False
+    end = getattr(region, "end_lineno", region.lineno)
+    # Exclude the with-line itself (the lock expression).
+    return region.lineno < line <= end
+
+
+def _attr_writes_and_reads(fn: ast.FunctionDef):
+    """Yield (node, attr, is_write) for every `self.X` access in fn."""
+    for node in ast.walk(fn):
+        attr = _self_attr(node)
+        if attr is not None:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            yield node, attr, is_write
+        # self.X[k] = v / del self.X[k]
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            base = _self_attr(node.value)
+            if base is not None:
+                yield node, base, True
+        # self.X.append(...) and friends
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            base = _self_attr(node.func.value)
+            if base is not None:
+                yield node, base, True
+
+
+class UnlockedSharedMutationRule(Rule):
+    """HL201: attribute mutated without its owning lock.
+
+    If an attribute is accessed under ``with self.<lock>:`` anywhere in
+    the class, every *mutation* of it elsewhere must hold the lock too
+    (``__init__`` is exempt: the object is not yet shared).  A write
+    that races the locked readers is exactly the torn-state class the
+    GIL hides until a preemption lands mid-method.
+    """
+
+    id = "HL201"
+    title = "attribute mutated outside its owning lock"
+    family = "locks"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_concurrency_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for cm in _classes(mod):
+            if not cm.guard_attrs:
+                continue
+            locked_attrs: set[str] = set()
+            accesses = []  # (method, node, attr, is_write, locked)
+            for fn in cm.methods:
+                regions = [w for _, w in cm.lock_regions(fn)]
+                for node, attr, is_write in _attr_writes_and_reads(fn):
+                    if attr in cm.guard_attrs:
+                        continue
+                    locked = any(_in_node(node, r) for r in regions)
+                    if locked:
+                        locked_attrs.add(attr)
+                    accesses.append((fn, node, attr, is_write, locked))
+            for fn, node, attr, is_write, locked in accesses:
+                if (
+                    is_write
+                    and not locked
+                    and attr in locked_attrs
+                    and fn.name not in ("__init__", "__new__")
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"self.{attr} is lock-protected elsewhere in "
+                            f"{cm.cls.name} but mutated here without the "
+                            "lock",
+                        )
+                    )
+        return out
+
+
+class BlockingCallUnderLockRule(Rule):
+    """HL202: lock held across a blocking call.
+
+    Socket sends, queue puts, thread joins, sleeps, device dispatch,
+    nested lock acquisition — while the lock is held, every other
+    contender stalls behind an operation of unbounded latency, and if
+    the blocked-on party needs the same lock, the daemon deadlocks.
+    Pattern to use instead: snapshot under the lock, release, then do
+    the slow thing (see TxTaskNetIo.close / GnmiService._fanout).
+    """
+
+    id = "HL202"
+    title = "blocking call while holding a lock"
+    family = "locks"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_concurrency_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for cm in _classes(mod):
+            for fn in cm.methods:
+                regions = cm.lock_regions(fn)
+                if not regions:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call) or not isinstance(
+                        node.func, ast.Attribute
+                    ):
+                        continue
+                    name = node.func.attr
+                    # dict.get(k[, d]) is not queue.get(): only a
+                    # zero-arg .get() can be a blocking queue pop.
+                    blocking = name in _BLOCKING_ATTRS or (
+                        name == "get" and not node.args and not node.keywords
+                    )
+                    if not blocking:
+                        continue
+                    recv = dotted(node.func.value)
+                    for lock_name, region in regions:
+                        if not _in_node(node, region):
+                            continue
+                        # cond.wait() inside `with cond:` releases the
+                        # lock — that is the correct pattern, skip it.
+                        if name in ("wait", "wait_for") and recv == lock_name:
+                            continue
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f".{name}() called while holding "
+                                f"{lock_name}; snapshot under the lock, "
+                                "release, then block",
+                            )
+                        )
+                        break
+                # Nested lock regions: lock-ordering deadlock risk.
+                for i, (name_a, with_a) in enumerate(regions):
+                    for name_b, with_b in regions:
+                        if with_b is with_a:
+                            continue
+                        if _in_node(with_b, with_a) and name_a != name_b:
+                            out.append(
+                                self.finding(
+                                    mod,
+                                    with_b,
+                                    f"acquires {name_b} while holding "
+                                    f"{name_a}: lock-ordering deadlock "
+                                    "risk; restructure to "
+                                    "snapshot-then-release",
+                                )
+                            )
+        return out
+
+
+class CallbackUnderLockRule(Rule):
+    """HL203: callback/publish invocation while holding a lock.
+
+    Invoking a stored callable (user callback, ibus publish, telemetry
+    export hook) under a lock hands the monitor to arbitrary code: if
+    that code — on this or another thread — reaches for the same lock,
+    the daemon deadlocks.  TSan on the native side cannot see this
+    class at all.  Snapshot the callback list under the lock, release,
+    then invoke.
+    """
+
+    id = "HL203"
+    title = "callback invoked while holding a lock"
+    family = "locks"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_concurrency_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for cm in _classes(mod):
+            for fn in cm.methods:
+                regions = [w for _, w in cm.lock_regions(fn)]
+                if not regions:
+                    continue
+                # Names bound as for-loop targets: calling one means
+                # invoking a dynamically-selected callable.
+                loop_targets = {
+                    t.id
+                    for n in ast.walk(fn)
+                    if isinstance(n, ast.For)
+                    for t in ast.walk(n.target)
+                    if isinstance(t, ast.Name)
+                }
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not any(_in_node(node, r) for r in regions):
+                        continue
+                    func = node.func
+                    flagged = None
+                    if (
+                        isinstance(func, ast.Name)
+                        and func.id in loop_targets
+                    ):
+                        flagged = f"{func.id}(...)"
+                    elif (
+                        isinstance(func, ast.Attribute)
+                        and func.attr in _CALLBACK_ATTRS
+                    ):
+                        flagged = f".{func.attr}(...)"
+                    if flagged:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"{flagged} invoked while holding a "
+                                "lock; snapshot-then-release before "
+                                "calling out",
+                            )
+                        )
+        return out
+
+
+class NoLockSharedContainerRule(Rule):
+    """HL204: thread-shared container with no lock at all.
+
+    In the explicitly thread-shared utility modules, a class whose
+    container attribute is mutated in one method and iterated in
+    another — with no lock in the class — races: dict/list iteration
+    observes resizes mid-walk (RuntimeError at best, skipped or
+    doubled entries at worst).  Scope is deliberately narrow
+    (``SHARED_STATE_PREFIXES``): daemon providers run under the
+    single-threaded actor model where lock-free containers are the
+    design.
+    """
+
+    id = "HL204"
+    title = "thread-shared container mutated and iterated with no lock"
+    family = "locks"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_shared_state_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for cm in _classes(mod):
+            if cm.guard_attrs:
+                continue  # lock exists: HL201/202/203 govern instead
+            mutated: dict[str, tuple[ast.AST, str]] = {}
+            iterated: dict[str, tuple[ast.AST, str]] = {}
+            for fn in cm.methods:
+                for node, attr, is_write in _attr_writes_and_reads(fn):
+                    if is_write and fn.name != "__init__":
+                        mutated.setdefault(attr, (node, fn.name))
+                for node in ast.walk(fn):
+                    iters: list[ast.AST] = []
+                    if isinstance(node, ast.For):
+                        iters = [node.iter]
+                    elif isinstance(
+                        node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+                    ):
+                        iters = [g.iter for g in node.generators]
+                    for it in iters:
+                        for sub in ast.walk(it):
+                            attr = _self_attr(sub)
+                            if attr is not None:
+                                iterated.setdefault(attr, (node, fn.name))
+            for attr in sorted(set(mutated) & set(iterated)):
+                node, wmeth = mutated[attr]
+                _, imeth = iterated[attr]
+                if wmeth == imeth:
+                    continue  # same-method: single caller context
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"{cm.cls.name}.{attr} is mutated in {wmeth}() "
+                        f"and iterated in {imeth}() with no lock in the "
+                        "class; add a lock and use "
+                        "snapshot-then-release",
+                    )
+                )
+        return out
+
+
+RULES = [
+    UnlockedSharedMutationRule,
+    BlockingCallUnderLockRule,
+    CallbackUnderLockRule,
+    NoLockSharedContainerRule,
+]

@@ -1,0 +1,633 @@
+"""holo-lint tracer/dispatch rules (HL1xx).
+
+Scope: the device-compute modules (``ops/``, ``spf/``, ``frr/``,
+``parallel/`` — :data:`holo_tpu.analysis.core.DISPATCH_PREFIXES`).
+Within those, rules look at *device functions*: functions that touch
+the device API (``jnp.*``/``jax.*`` calls, jitted ``self._jit*``
+callables, or the repo's known device-returning entry points).
+
+The static model is deliberately shallow — a per-function taint set
+(values derived from device calls or ``jax.Array``-annotated params)
+with host sinks (``np.asarray``, ``float``, ``int``, ``len``…)
+un-tainting.  It cannot prove the absence of a hazard; the runtime
+sanitizer (:mod:`holo_tpu.analysis.runtime`, ``jax.transfer_guard``)
+covers what the AST cannot see.  Sanctioned marshal/unmarshal
+boundaries — ``with sanctioned_transfer(...):`` blocks — are exempt
+from HL101, mirroring the runtime guard's ``allow`` scope exactly: one
+marker serves both the static and the runtime check.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, Rule, dotted
+
+# Calls whose results live on device.  `_jit*` attributes are the
+# repo's convention for persisted jitted callables; the named entry
+# points are the engine/marshal functions other modules call directly.
+_DEVICE_PREFIXES = ("jnp.", "jax.")
+_JIT_NAME = re.compile(r"^_jit\w*$")
+_DEVICE_RETURNING = {
+    "spf_one",
+    "spf_one_fused",
+    "spf_one_hybrid",
+    "spf_whatif_batch",
+    "spf_multiroot",
+    "sssp_distances",
+    "device_graph_from_ell",
+    "marshal_block_spf",
+    "frr_batch",
+    "whatif_spf_blocked",
+    "prepare",
+    "prepare_blocked",
+    "_prepare",
+}
+# Host sinks: calling these yields a HOST value (taint stops).
+_HOST_SINKS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "float",
+    "int",
+    "bool",
+    "len",
+    "str",
+    "repr",
+}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+_REDUCTIONS = {
+    "mean",
+    "sum",
+    "min",
+    "max",
+    "all",
+    "any",
+    "prod",
+    "std",
+    "var",
+    "count_nonzero",
+    "nonzero",
+}
+_ARRAY_ANNOTATIONS = re.compile(
+    r"jax\.Array|jnp\.ndarray|DeviceGraph|SpfTensors|ArrayLike"
+)
+_SANCTION_MARKERS = ("sanctioned_transfer", "transfer_guard", "allow_transfers")
+_MATERIALIZE_BUILTINS = {"float", "bool"}
+_MATERIALIZE_METHODS = {"item", "tolist"}
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+# jax.* entry points that return HOST data (device handles, pytrees of
+# python objects, config) — not device arrays.
+_HOST_JAX = {
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_index",
+    "jax.process_count",
+    "jax.default_backend",
+    "jax.transfer_guard",
+}
+_HOST_JAX_PREFIXES = ("jax.tree", "jax.config", "jax.debug", "jax.profiler")
+
+
+def _last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_device_call(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    if d is None:
+        return False
+    if d in _HOST_JAX or d.startswith(_HOST_JAX_PREFIXES):
+        return False
+    if d.startswith(_DEVICE_PREFIXES):
+        return True
+    seg = _last_seg(d)
+    return bool(_JIT_NAME.match(seg)) or seg in _DEVICE_RETURNING
+
+
+def is_device_function(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    """Does this function touch the device API anywhere in its body?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _is_device_call(node):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not fn
+        ):
+            continue  # nested defs are visited on their own
+    return False
+
+
+def _line_ranges(nodes) -> list[tuple[int, int]]:
+    out = []
+    for n in nodes:
+        end = getattr(n, "end_lineno", None) or n.lineno
+        out.append((n.lineno, end))
+    return out
+
+
+def sanctioned_ranges(mod: ModuleInfo) -> list[tuple[int, int]]:
+    """Line spans of `with sanctioned_transfer(...)`-style blocks."""
+    spans = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Call):
+                    d = dotted(ctx.func) or ""
+                    if any(m in d for m in _SANCTION_MARKERS):
+                        spans.extend(_line_ranges([node]))
+                        break
+    return spans
+
+
+def deferred_ranges(mod: ModuleInfo) -> list[tuple[int, int]]:
+    """Line spans of callables handed to `.set_fn(...)` — deferred
+    sampling is the *fix* for on-path metric reads, not a violation."""
+    spans = []
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "set_fn"
+        ):
+            spans.extend(_line_ranges(node.args))
+    return spans
+
+
+def _in_ranges(line: int, spans: list[tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+class _TaintView:
+    """Per-function taint: names whose values may live on device."""
+
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.names: set[str] = set()
+        args = fn.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            ann = a.annotation
+            if ann is not None and _ARRAY_ANNOTATIONS.search(
+                ast.unparse(ann)
+            ):
+                self.names.add(a.arg)
+        # Fixed-point over simple assignments (cap: nesting is shallow).
+        for _ in range(4):
+            changed = False
+            for node in ast.walk(fn):
+                targets: list[ast.expr] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                if value is None or not self.tainted(value):
+                    continue
+                for t in targets:
+                    # Only simple name targets (and tuple/list unpacks of
+                    # them) are tracked: attribute/subscript targets would
+                    # wrongly taint their base (`self._jit = jax.jit(...)`
+                    # must NOT taint `self`).
+                    if isinstance(t, ast.Name):
+                        names = [t]
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names = [
+                            e.value if isinstance(e, ast.Starred) else e
+                            for e in t.elts
+                        ]
+                        names = [e for e in names if isinstance(e, ast.Name)]
+                    else:
+                        names = []
+                    for nm in names:
+                        if nm.id not in self.names:
+                            self.names.add(nm.id)
+                            changed = True
+            if not changed:
+                break
+
+    def tainted(self, node: ast.expr) -> bool:
+        """May this expression hold device data?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and (
+                d in _HOST_SINKS or _last_seg(d) in ("item", "tolist")
+            ):
+                return False  # host materialization: taint stops here
+            if _is_device_call(node):
+                return True
+            return any(self.tainted(a) for a in node.args) or any(
+                self.tainted(k.value) for k in node.keywords
+            )
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False  # static under trace
+            return self.tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Attribute) and base.attr in _SHAPE_ATTRS:
+                return False  # x.shape[0]
+            return self.tainted(base) or self.tainted(node.slice)
+        if isinstance(node, ast.BinOp):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity checks are host-decidable
+            return self.tainted(node.left) or any(
+                self.tainted(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.tainted(node.body)
+                or self.tainted(node.orelse)
+                or self.tainted(node.test)
+            )
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        return False
+
+
+def _device_functions(mod: ModuleInfo):
+    for fn in mod.functions():
+        if is_device_function(fn):
+            yield fn
+
+
+class HostSyncRule(Rule):
+    """HL101: implicit device→host sync on the dispatch path.
+
+    ``np.asarray(x)`` / ``float(x)`` / ``bool(x)`` / ``x.item()`` /
+    ``x.tolist()`` on a device value inside a device function forces a
+    blocking transfer mid-dispatch.  Sanctioned marshal/unmarshal
+    boundaries (``with sanctioned_transfer(...)``) are exempt — they
+    are where the transfer is *supposed* to happen, and the runtime
+    guard opens the same window.
+    """
+
+    id = "HL101"
+    title = "implicit host sync on device value in dispatch path"
+    family = "tracer"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        exempt = sanctioned_ranges(mod) + deferred_ranges(mod)
+        out: list[Finding] = []
+        for fn in _device_functions(mod):
+            taint = _TaintView(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _in_ranges(node.lineno, exempt):
+                    continue
+                d = dotted(node.func)
+                # x.item() / x.tolist() on a tainted receiver
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MATERIALIZE_METHODS
+                    and taint.tainted(node.func.value)
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f".{node.func.attr}() materializes a device "
+                            "value on host mid-dispatch; move it behind "
+                            "the sanctioned unmarshal boundary",
+                        )
+                    )
+                    continue
+                if d is None or not node.args:
+                    continue
+                arg0 = node.args[0]
+                if d in _NP_MATERIALIZE and taint.tainted(arg0):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"{d}() on a device value is an implicit "
+                            "device->host transfer; wrap the sanctioned "
+                            "unmarshal boundary in sanctioned_transfer()",
+                        )
+                    )
+                elif d in _MATERIALIZE_BUILTINS and taint.tainted(arg0):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"{d}() on a device value blocks on device "
+                            "completion mid-dispatch; defer the read or "
+                            "move it behind the sanctioned boundary",
+                        )
+                    )
+        return out
+
+
+class TracedControlFlowRule(Rule):
+    """HL102: Python control flow on a traced value.
+
+    `if`/`while`/`for`/`assert` on device values fails under `jit`
+    (ConcretizationTypeError) or — worse — silently forces a sync when
+    the function runs eagerly.  Use `jnp.where`/`lax.cond`/`lax.
+    while_loop`, or hoist the decision to static (shape) data.
+    """
+
+    id = "HL102"
+    title = "Python control flow on traced value"
+    family = "tracer"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for fn in _device_functions(mod):
+            taint = _TaintView(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)) and taint.tainted(
+                    node.test
+                ):
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"`{kw}` on a traced value; use jnp.where/"
+                            "lax.cond/lax.while_loop or decide from "
+                            "static shape data",
+                        )
+                    )
+                elif isinstance(node, ast.Assert) and taint.tainted(
+                    node.test
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            "`assert` on a traced value; use "
+                            "checkify/debug assertions or host-side "
+                            "validation before dispatch",
+                        )
+                    )
+                elif isinstance(node, ast.For) and taint.tainted(node.iter):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            "`for` over a traced value; use lax.scan/"
+                            "fori_loop or iterate a static range",
+                        )
+                    )
+        return out
+
+
+class RecompileHazardRule(Rule):
+    """HL103: jit patterns that force recompiles.
+
+    A ``jax.jit(...)`` whose result is immediately invoked (or built
+    inside a loop body) re-traces and re-compiles on every pass —
+    the silent recompile storm the telemetry counters exist to catch.
+    Persist the jitted callable (module level, ``__init__``, or a
+    cached attribute).
+    """
+
+    id = "HL103"
+    title = "jit recompile hazard"
+    family = "tracer"
+
+    _JIT_FACTORIES = {"jax.jit", "jax.pmap", "jit", "pmap"}
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d not in self._JIT_FACTORIES:
+                continue
+            parent = mod.parent(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                out.append(
+                    self.finding(
+                        mod,
+                        node,
+                        f"{d}(...) immediately invoked: re-traces and "
+                        "recompiles on every call; persist the jitted "
+                        "callable",
+                    )
+                )
+                continue
+            cur = parent
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                if isinstance(cur, (ast.For, ast.While)):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"{d}(...) constructed inside a loop body: "
+                            "one fresh compile per iteration; hoist and "
+                            "persist the jitted callable",
+                        )
+                    )
+                    break
+                cur = mod.parent(cur)
+        return out
+
+
+class DtypeParityRule(Rule):
+    """HL104: float/dtype drift threatening bit-identical parity.
+
+    The SPF/FRR planes are exact int32 end to end, gated bit-identical
+    against the scalar oracle.  A float dtype, a bare float literal in
+    a device op, or a true division on traced ints silently promotes
+    and breaks that contract.
+    """
+
+    id = "HL104"
+    title = "float/dtype promotion threatens bit-identical parity"
+    family = "tracer"
+
+    _FLOAT_DTYPES = {
+        "np.float64",
+        "np.float32",
+        "np.float16",
+        "jnp.float64",
+        "jnp.float32",
+        "jnp.float16",
+        "jnp.bfloat16",
+    }
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for fn in _device_functions(mod):
+            taint = _TaintView(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute):
+                    d = dotted(node)
+                    if d in self._FLOAT_DTYPES:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"{d} in a device function: the exact "
+                                "int32 parity contract forbids float "
+                                "dtypes on the dispatch path",
+                            )
+                        )
+                elif (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Div)
+                    and (
+                        taint.tainted(node.left)
+                        or taint.tainted(node.right)
+                    )
+                ):
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            "true division on a traced value promotes "
+                            "to float and breaks bit-identical parity; "
+                            "use // or integer ops",
+                        )
+                    )
+                elif isinstance(node, ast.Call) and (
+                    (dotted(node.func) or "").startswith(_DEVICE_PREFIXES)
+                ):
+                    for arg in list(node.args) + [
+                        k.value for k in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, float
+                        ):
+                            out.append(
+                                self.finding(
+                                    mod,
+                                    node,
+                                    "bare float literal in a device op "
+                                    "promotes the computation off the "
+                                    "exact int32 plane",
+                                )
+                            )
+                            break
+        return out
+
+
+class EagerMetricReadRule(Rule):
+    """HL105: eager host reduction feeding telemetry on the dispatch
+    path.
+
+    A metric update (``.set``/``.observe``/``.inc``) whose argument
+    performs an array reduction (``.mean()``, ``np.asarray(x).mean()``,
+    ``.sum()``…) does O(N) host work — or worse, a device sync —
+    inside the marshal/dispatch critical section.  Defer it:
+    ``gauge.set_fn(lambda: ...)`` samples at scrape time, off the hot
+    path, or compute the value from O(1) metadata.
+    """
+
+    id = "HL105"
+    title = "eager metric computation on dispatch path"
+    family = "tracer"
+
+    _UPDATES = {"set", "observe", "inc", "dec"}
+    _METRIC_ROOT = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+    def _metric_receiver(self, func: ast.Attribute) -> bool:
+        """Receiver looks like a metric family: an UPPERCASE module
+        constant, optionally through ``.labels(...)``."""
+        recv = func.value
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Attribute)
+            and recv.func.attr == "labels"
+        ):
+            recv = recv.func.value
+        d = dotted(recv)
+        if d is None:
+            return False
+        return bool(self._METRIC_ROOT.match(d.split(".")[0]))
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        exempt = deferred_ranges(mod)
+        out: list[Finding] = []
+        # Scope: every function in a dispatch module — marshal helpers
+        # feed the same critical section even when they never touch jnp.
+        for fn in mod.functions():
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._UPDATES
+                    and self._metric_receiver(node.func)
+                ):
+                    continue
+                if _in_ranges(node.lineno, exempt):
+                    continue
+                for arg in node.args:
+                    reduction = None
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call):
+                            seg = (
+                                sub.func.attr
+                                if isinstance(sub.func, ast.Attribute)
+                                else _last_seg(dotted(sub.func) or "")
+                            )
+                            if seg in _REDUCTIONS:
+                                reduction = seg
+                                break
+                            d = dotted(sub.func) or ""
+                            if d in _NP_MATERIALIZE and sub.args and not (
+                                isinstance(sub.args[0], ast.Constant)
+                            ):
+                                reduction = seg
+                                break
+                    if reduction:
+                        out.append(
+                            self.finding(
+                                mod,
+                                node,
+                                f"metric arg computes `{reduction}` on "
+                                "the dispatch path; defer via "
+                                "gauge.set_fn(...) or use O(1) metadata",
+                            )
+                        )
+                        break
+        return out
+
+
+RULES = [
+    HostSyncRule,
+    TracedControlFlowRule,
+    RecompileHazardRule,
+    DtypeParityRule,
+    EagerMetricReadRule,
+]

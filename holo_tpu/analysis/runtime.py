@@ -1,0 +1,75 @@
+"""holo-lint runtime sanitizer mode: ``jax.transfer_guard`` wiring.
+
+Static analysis proves what it can see; this module catches the rest
+at run time.  Under :func:`transfer_sanitizer` every *implicit*
+device↔host transfer — ``np.asarray`` on a device array, a numpy
+operand silently device_put by a jnp op, a traced value forced
+concrete — raises instead of silently syncing.  The SPF/FRR parity
+and e2e suites run under it (see ``holo_tpu.testing``), so any new
+code that smuggles a transfer onto the dispatch path fails the tier-1
+gate even when no HL1xx rule matches the pattern.
+
+The counterpart is :func:`sanctioned_transfer`: the ONE place a
+marshal/unmarshal transfer is supposed to happen (the backend's
+dispatch boundary in ``spf/backend.py`` / ``frr/manager.py``) opens an
+explicit ``allow`` window.  The same marker is what the static HL101
+rule treats as exempt — one annotation serves both checks.
+
+Relation to the native TSan job (tests/test_native_sanitizers.py):
+TSan watches the C/C++ side for data races; the transfer guard watches
+the Python/JAX side for hidden syncs; the HL2xx lock rules watch the
+Python side for the lock-discipline classes neither sanitizer can see.
+
+JAX is imported lazily: the lint gate itself must stay import-light.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+# Observability for the sanctioned windows: how often the dispatch
+# boundary opens tells the bench whether marshal traffic is growing.
+_SANCTIONED: dict[str, int] = {}
+
+
+def transfer_sanitizer():
+    """Context manager: disallow implicit device↔host transfers.
+
+    Explicit transfers (``jax.device_put``) and sanctioned windows
+    (:func:`sanctioned_transfer`) stay allowed.  Nesting follows JAX's
+    innermost-wins semantics.
+    """
+    import jax
+
+    return jax.transfer_guard("disallow")
+
+
+@contextlib.contextmanager
+def sanctioned_transfer(reason: str):
+    """Open an explicit allow-window for a marshal/unmarshal boundary.
+
+    ``reason`` names the boundary (it keys the per-boundary counter in
+    :func:`sanctioned_counts`); the static HL101 rule exempts code
+    inside ``with sanctioned_transfer(...):`` blocks, so the runtime
+    window and the static exemption can never drift apart.
+    """
+    import jax
+
+    _SANCTIONED[reason] = _SANCTIONED.get(reason, 0) + 1
+    with jax.transfer_guard("allow"):
+        yield
+
+
+def sanctioned_counts() -> dict[str, int]:
+    """How many times each sanctioned boundary opened (tests/debug)."""
+    return dict(_SANCTIONED)
+
+
+def sanitizer_enabled_by_env() -> bool:
+    """Opt-in knob for ad-hoc runs: HOLO_TPU_TRANSFER_SANITIZER=1."""
+    return os.environ.get("HOLO_TPU_TRANSFER_SANITIZER", "") not in (
+        "",
+        "0",
+        "false",
+    )

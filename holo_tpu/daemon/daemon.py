@@ -266,7 +266,13 @@ class Daemon:
     def commit(self, candidate, **kw):
         with self.lock:
             txn = self.northbound.commit(candidate, **kw)
-            self.loop.run_until_idle()
+            # Commit atomicity REQUIRES pumping the loop under the lock:
+            # a gNMI Get between commit and convergence would render
+            # half-applied state.  self.lock is a reentrant RLock and
+            # handlers run on THIS thread, so re-acquisition cannot
+            # deadlock; the cost is commit-latency for concurrent
+            # readers, which is the documented semantics.
+            self.loop.run_until_idle()  # holo-lint: disable=HL202
         # Commit notifications fan out to every management surface
         # (gRPC Subscribe, gNMI Subscribe, ...), regardless of which one
         # performed the commit.

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from holo_tpu import telemetry
+from holo_tpu.analysis.runtime import sanctioned_transfer
 from holo_tpu.frr.inputs import marshal_frr
 from holo_tpu.frr.kernel import BackupTable
 from holo_tpu.ops.graph import Topology
@@ -213,37 +214,42 @@ class FrrEngine:
                     g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
                 )
             )
-        g = self._prepare(topo)
         sig = (fin.link_far.shape, fin.edge_masks.shape, fin.adj_nbr.shape)
         if sig in self._compiled_shapes:
             _FRR_JIT_HITS.inc()
         else:
             self._compiled_shapes.add(sig)
             _FRR_COMPILES.inc()
-        out = self._jit(
-            g,
-            topo.root,
-            fin.link_far,
-            fin.link_cost,
-            fin.link_valid,
-            fin.edge_masks,
-            fin.adj_nbr,
-            fin.adj_cost,
-            fin.adj_link,
-            fin.adj_valid,
-        )
+        # The FRR analog of the SPF backend's sanctioned boundary: the
+        # padded planes move host->device here, results device->host
+        # below, and nowhere else.
+        with sanctioned_transfer("frr.batch.marshal"):
+            g = self._prepare(topo)
+            out = self._jit(
+                g,
+                topo.root,
+                fin.link_far,
+                fin.link_cost,
+                fin.link_valid,
+                fin.edge_masks,
+                fin.adj_nbr,
+                fin.adj_cost,
+                fin.adj_link,
+                fin.adj_valid,
+            )
         nl = fin.n_links
-        return BackupTable(
-            inputs=fin,
-            root=int(topo.root),
-            lfa_adj=np.asarray(out.lfa_adj)[:nl],
-            lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
-            rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
-            tilfa_p=np.asarray(out.tilfa_p)[:nl],
-            tilfa_q=np.asarray(out.tilfa_q)[:nl],
-            post_dist=np.asarray(out.post_dist)[:nl],
-            post_nh=np.asarray(out.post_nh)[:nl],
-        )
+        with sanctioned_transfer("frr.batch.unmarshal"):
+            return BackupTable(
+                inputs=fin,
+                root=int(topo.root),
+                lfa_adj=np.asarray(out.lfa_adj)[:nl],
+                lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
+                rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
+                tilfa_p=np.asarray(out.tilfa_p)[:nl],
+                tilfa_q=np.asarray(out.tilfa_q)[:nl],
+                post_dist=np.asarray(out.post_dist)[:nl],
+                post_nh=np.asarray(out.post_nh)[:nl],
+            )
 
     # -- dispatch
 
@@ -257,8 +263,13 @@ class FrrEngine:
             if lp:
                 _FRR_PAD_OCCUPANCY.labels(plane="links").set(fin.n_links / lp)
             if ap:
-                _FRR_PAD_OCCUPANCY.labels(plane="adjs").set(
-                    float(np.asarray(fin.adj_valid).mean())
+                # Deferred (set_fn): the O(Ap) reduction runs at scrape
+                # time, not inside the dispatch critical section
+                # (holo-lint HL105); reads still mean "last marshal",
+                # and the one-shot sampler releases the plane after the
+                # first scrape.
+                _FRR_PAD_OCCUPANCY.labels(plane="adjs").set_fn(
+                    telemetry.deferred_mean(fin.adj_valid)
                 )
             if self.engine == "tpu":
                 table = self._compute_tpu(topo, fin)

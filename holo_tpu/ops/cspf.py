@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from holo_tpu.analysis.runtime import sanctioned_transfer
 from holo_tpu.ops.graph import INF, Topology, build_ell
 from holo_tpu.ops.spf_engine import device_graph_from_ell, spf_whatif_batch
 
@@ -109,9 +110,12 @@ class CspfEngine:
         if len(constraints) != len(dsts):
             raise ValueError("constraints and dsts must pair up")
         masks = constraint_masks(self.topo, self.attrs, constraints)
-        out = self._jit(self._g, self.topo.root, masks)
-        dist = np.asarray(out.dist)  # [B, N]
-        parent = np.asarray(out.parent)  # [B, N]
+        # Sanctioned marshal/unmarshal boundary (mirrors spf/backend.py).
+        with sanctioned_transfer("cspf.batch.marshal"):
+            out = self._jit(self._g, self.topo.root, masks)
+        with sanctioned_transfer("cspf.batch.unmarshal"):
+            dist = np.asarray(out.dist)  # [B, N]
+            parent = np.asarray(out.parent)  # [B, N]
         n = self.topo.n_vertices
         paths = []
         for b, dst in enumerate(dsts):

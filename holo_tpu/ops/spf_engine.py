@@ -91,8 +91,11 @@ def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
     )
     _MARSHALS.inc()
     _MARSHAL_SECONDS.observe(time.perf_counter() - t0)
-    if n * k:
-        _ELL_OCCUPANCY.set(float(np.asarray(ell.in_valid).mean()))
+    # Occupancy is sampled lazily at scrape time: the O(N*K) reduction
+    # has no business inside the marshal critical section (holo-lint
+    # HL105) — the gauge still reads "last marshal", and the one-shot
+    # sampler drops its array reference after the first scrape.
+    _ELL_OCCUPANCY.set_fn(telemetry.deferred_mean(ell.in_valid))
     return g
 
 

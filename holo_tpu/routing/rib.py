@@ -495,19 +495,24 @@ class RibManager(Actor):
                     # (this reselect was driven by some OTHER protocol's
                     # add/del for the prefix): reinstalling its primaries
                     # would revert the repair onto the dead next hop.
-                    # Keep the repair until the owner republishes.
-                    return
-                # A reinstall replaces any active FRR local repair: the
-                # protocol has reconverged (or re-published) this prefix.
-                self.repaired.pop(prefix, None)
-                self.kernel.install(
-                    prefix,
-                    best.msg.nexthops,
-                    best.msg.protocol,
-                    backups=best.msg.backups or None,
-                )
-                _RIB_INSTALLS.labels(op="install").inc()
-                self._programmed.add(prefix)
+                    # Keep the repair until the owner republishes — but
+                    # ONLY the kernel install is skipped: the
+                    # redistribute publish and on_change below still
+                    # fire, like every other reselect.
+                    pass
+                else:
+                    # A reinstall replaces any active FRR local repair:
+                    # the protocol has reconverged (or re-published)
+                    # this prefix.
+                    self.repaired.pop(prefix, None)
+                    self.kernel.install(
+                        prefix,
+                        best.msg.nexthops,
+                        best.msg.protocol,
+                        backups=best.msg.backups or None,
+                    )
+                    _RIB_INSTALLS.labels(op="install").inc()
+                    self._programmed.add(prefix)
             elif prefix in self._programmed:
                 # The withdrawn entry takes any active local repair with
                 # it — a later restore must not resurrect the route.

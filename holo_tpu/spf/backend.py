@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from holo_tpu import telemetry
+from holo_tpu.analysis.runtime import sanctioned_transfer
 from holo_tpu.ops.graph import Topology, build_ell
 from holo_tpu.ops.spf_engine import (
     DeviceGraph,
@@ -247,19 +248,26 @@ class TpuSpfBackend(SpfBackend):
                 return res[0]
         t0 = time.perf_counter()
         with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
-            g = self.prepare(topo)
-            self._track_compile(
-                "one", g.in_src.shape, g.direct_nh_words.shape[2],
-                topo.n_edges,
-            )
-            out = self._jit_one(g, topo.root, self._full_mask(topo, edge_mask))
+            # THE sanctioned marshal boundary: host graph + root + mask
+            # move to device here and nowhere else (transfer_guard
+            # "disallow" everywhere outside these windows).
+            with sanctioned_transfer("spf.one.marshal"):
+                g = self.prepare(topo)
+                self._track_compile(
+                    "one", g.in_src.shape, g.direct_nh_words.shape[2],
+                    topo.n_edges,
+                )
+                out = self._jit_one(
+                    g, topo.root, self._full_mask(topo, edge_mask)
+                )
             t1 = time.perf_counter()
-            res = SpfResult(
-                dist=np.asarray(out.dist),
-                parent=np.asarray(out.parent),
-                hops=np.asarray(out.hops),
-                nexthop_words=np.asarray(out.nexthops),
-            )
+            with sanctioned_transfer("spf.one.unmarshal"):
+                res = SpfResult(
+                    dist=np.asarray(out.dist),
+                    parent=np.asarray(out.parent),
+                    hops=np.asarray(out.hops),
+                    nexthop_words=np.asarray(out.nexthops),
+                )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
@@ -289,15 +297,17 @@ class TpuSpfBackend(SpfBackend):
     def _whatif_blocked(self, topo, edge_masks):
         from holo_tpu.ops.blocked_spf import failed_edges_perm, whatif_spf_blocked
 
-        g = self.prepare_blocked(topo)
-        if g is None:
-            return None
-        try:
-            fdst, fid = failed_edges_perm(
-                np.asarray(g.orig2perm), topo, np.asarray(edge_masks, bool)
-            )
-        except ValueError:
-            return None  # too many failed edges per scenario
+        with sanctioned_transfer("spf.blocked.marshal"):
+            g = self.prepare_blocked(topo)
+            if g is None:
+                return None
+            try:
+                fdst, fid = failed_edges_perm(
+                    np.asarray(g.orig2perm), topo,
+                    np.asarray(edge_masks, bool),
+                )
+            except ValueError:
+                return None  # too many failed edges per scenario
         if self._jit_blocked is None:
             from functools import partial
 
@@ -310,14 +320,16 @@ class TpuSpfBackend(SpfBackend):
             batch=len(edge_masks),
         ):
             self._track_compile("blocked", fdst.shape, fid.shape)
-            out = self._jit_blocked(g, fdst, fid)
+            with sanctioned_transfer("spf.blocked.dispatch"):
+                out = self._jit_blocked(g, fdst, fid)
             t1 = time.perf_counter()
-            dist, parent, hops, nh = (
-                np.asarray(out.dist),
-                np.asarray(out.parent),
-                np.asarray(out.hops),
-                np.asarray(out.nexthops),
-            )
+            with sanctioned_transfer("spf.blocked.unmarshal"):
+                dist, parent, hops, nh = (
+                    np.asarray(out.dist),
+                    np.asarray(out.parent),
+                    np.asarray(out.hops),
+                    np.asarray(out.nexthops),
+                )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="blocked").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="blocked").observe(t2 - t0)
@@ -337,22 +349,24 @@ class TpuSpfBackend(SpfBackend):
             "spf.dispatch", kind="whatif", backend="tpu",
             batch=len(edge_masks),
         ):
-            g = self.prepare(topo)
-            masks = np.asarray(edge_masks, bool)
-            self._track_compile(
-                "whatif", g.in_src.shape, g.direct_nh_words.shape[2],
-                masks.shape,
-            )
-            out = self._jit_batch(g, topo.root, masks)
+            with sanctioned_transfer("spf.whatif.marshal"):
+                g = self.prepare(topo)
+                masks = np.asarray(edge_masks, bool)
+                self._track_compile(
+                    "whatif", g.in_src.shape, g.direct_nh_words.shape[2],
+                    masks.shape,
+                )
+                out = self._jit_batch(g, topo.root, masks)
             t1 = time.perf_counter()
             # One bulk device→host transfer per plane: per-scenario slicing
             # of device arrays would pay the host round-trip B×4 times.
-            dist, parent, hops, nh = (
-                np.asarray(out.dist),
-                np.asarray(out.parent),
-                np.asarray(out.hops),
-                np.asarray(out.nexthops),
-            )
+            with sanctioned_transfer("spf.whatif.unmarshal"):
+                dist, parent, hops, nh = (
+                    np.asarray(out.dist),
+                    np.asarray(out.parent),
+                    np.asarray(out.hops),
+                    np.asarray(out.nexthops),
+                )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
@@ -374,20 +388,22 @@ class TpuSpfBackend(SpfBackend):
         with telemetry.span(
             "spf.dispatch", kind="multiroot", backend="tpu", roots=len(roots)
         ):
-            g = self.prepare(topo)
-            roots_i32 = np.asarray(roots, np.int32)
-            self._track_compile(
-                "multiroot", g.in_src.shape, g.direct_nh_words.shape[2],
-                roots_i32.shape[0], topo.n_edges,
-            )
-            mask = np.ones(topo.n_edges, bool)
-            out = self._jit_multiroot(g, roots_i32, mask)
+            with sanctioned_transfer("spf.multiroot.marshal"):
+                g = self.prepare(topo)
+                roots_i32 = np.asarray(roots, np.int32)
+                self._track_compile(
+                    "multiroot", g.in_src.shape, g.direct_nh_words.shape[2],
+                    roots_i32.shape[0], topo.n_edges,
+                )
+                mask = np.ones(topo.n_edges, bool)
+                out = self._jit_multiroot(g, roots_i32, mask)
             t1 = time.perf_counter()
-            res = MultiRootResult(
-                dist=np.asarray(out.dist),
-                parent=np.asarray(out.parent),
-                hops=np.asarray(out.hops),
-            )
+            with sanctioned_transfer("spf.multiroot.unmarshal"):
+                res = MultiRootResult(
+                    dist=np.asarray(out.dist),
+                    parent=np.asarray(out.parent),
+                    hops=np.asarray(out.hops),
+                )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)

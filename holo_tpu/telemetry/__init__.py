@@ -39,6 +39,7 @@ from holo_tpu.telemetry.registry import (  # noqa: F401 — public API
     Gauge,
     Histogram,
     MetricsRegistry,
+    deferred_mean,
     enabled,
 )
 from holo_tpu.telemetry.trace import SpanTracer

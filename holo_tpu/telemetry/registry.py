@@ -98,7 +98,10 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        if self._fn is not None:
+        # The kill switch covers callback-backed gauges too: the
+        # overhead bench's disabled arm must not run deferred O(N)
+        # sampling closures at collect time.
+        if self._fn is not None and _enabled:
             try:
                 return float(self._fn())
             except Exception:  # noqa: BLE001 — sampling must never raise
@@ -151,6 +154,25 @@ class Histogram:
             out.append((b, acc))
         out.append((float("inf"), acc + counts[-1]))
         return out
+
+
+def deferred_mean(arr) -> Callable[[], float]:
+    """One-shot lazy occupancy sampler for ``Gauge.set_fn``.
+
+    Computes ``arr.mean()`` on the FIRST call (scrape time — off the
+    dispatch path, holo-lint HL105), caches the float, and releases the
+    array reference so a marshal-time closure does not pin a padded
+    plane for the rest of the process lifetime.
+    """
+    cell: list = [arr, None]
+
+    def sample() -> float:
+        if cell[1] is None:
+            a, cell[0] = cell[0], None
+            cell[1] = float(a.mean()) if a is not None and a.size else 0.0
+        return cell[1]
+
+    return sample
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -8,7 +8,26 @@ virtual multi-device CPU platform regardless of the host's default
 
 from __future__ import annotations
 
+import contextlib
 import os
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Run the enclosed block under the holo-lint runtime sanitizer:
+    ``jax.transfer_guard("disallow")``.
+
+    The SPF/FRR parity and e2e suites wrap every test in this: any
+    device↔host transfer OUTSIDE the sanctioned marshal/unmarshal
+    boundaries (``sanctioned_transfer(...)`` in ``spf/backend.py`` /
+    ``frr/manager.py`` / ``ops/cspf.py``) raises, catching hidden
+    syncs that static analysis (HL101) cannot prove.  Explicit
+    ``jax.device_put`` stays allowed — that is what "explicit" means.
+    """
+    from holo_tpu.analysis.runtime import transfer_sanitizer
+
+    with transfer_sanitizer():
+        yield
 
 
 def force_virtual_cpu_mesh(n_devices: int) -> None:

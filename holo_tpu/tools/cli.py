@@ -302,6 +302,95 @@ def cmd_deviations(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """holo-lint: repo-native static analysis (JAX hot-path hazards +
+    daemon lock discipline), gated against a ratchet baseline.  Exit 0
+    when the tree matches the baseline, 1 on new findings, 2 on usage
+    or parse errors."""
+    from pathlib import Path
+
+    from holo_tpu.analysis import (
+        compare_to_baseline,
+        default_baseline_path,
+        load_baseline,
+        run_paths,
+        write_baseline,
+    )
+
+    pkg_root = Path(__file__).resolve().parent.parent  # holo_tpu/
+    repo_root = pkg_root.parent
+    paths = [Path(p) for p in args.paths] if args.paths else [pkg_root]
+    for p in paths:
+        if not p.exists():
+            print(f"error: no such path {p}", file=sys.stderr)
+            return 2
+
+    if args.list_rules:
+        from holo_tpu.analysis import all_rules
+
+        for rule in all_rules():
+            print(f"{rule.id}  [{rule.family:6s}]  {rule.title}")
+        return 0
+
+    result = run_paths(paths, root=repo_root)
+    if result.parse_errors:
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        return 2
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else default_baseline_path()
+    )
+    if args.write_baseline:
+        write_baseline(baseline_path, result.findings)
+        print(
+            f"baseline: wrote {len(result.findings)} finding(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    new, unused = compare_to_baseline(result.findings, baseline)
+
+    if args.json:
+        doc = {
+            "files_checked": result.files_checked,
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "context": f.context,
+                    "message": f.message,
+                    "baselined": f not in new,
+                }
+                for f in result.findings
+            ],
+            "new": len(new),
+            "suppressed": len(result.suppressed),
+            "unused_baseline_keys": sorted(unused),
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(result.findings) - len(new)
+        print(
+            f"holo-lint: {result.files_checked} files, "
+            f"{len(new)} new finding(s), {n_base} baselined, "
+            f"{len(result.suppressed)} suppressed"
+        )
+        if unused:
+            print(
+                f"holo-lint: {sum(unused.values())} baseline entr"
+                f"{'y is' if sum(unused.values()) == 1 else 'ies are'} "
+                "stale (fixed) — ratchet by removing them:"
+            )
+            for key in sorted(unused):
+                print(f"  {key}")
+    return 1 if new else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="holo-tpu-tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -346,6 +435,28 @@ def main(argv=None) -> int:
     )
     s.add_argument("files", nargs="+", help="module file, then its imports")
     s.set_defaults(fn=cmd_deviations)
+    s = sub.add_parser(
+        "lint",
+        help="holo-lint: JAX hot-path + lock-discipline static analysis",
+    )
+    s.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: the holo_tpu package)",
+    )
+    s.add_argument(
+        "--baseline",
+        help="ratchet baseline JSON "
+             "(default: holo_tpu/analysis/baseline.json)",
+    )
+    s.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings as the new baseline and exit 0",
+    )
+    s.add_argument("--json", action="store_true", help="JSON report")
+    s.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    s.set_defaults(fn=cmd_lint)
     args = ap.parse_args(argv)
     return args.fn(args)
 

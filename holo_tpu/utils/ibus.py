@@ -13,6 +13,7 @@ channel-drop detection at ibus.rs:473-488).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -78,40 +79,62 @@ class _Sub:
 
 
 class Ibus:
-    """Topic-routed pub/sub over the event loop."""
+    """Topic-routed pub/sub over the event loop.
+
+    Thread-shared under preemptive isolation: protocol instances
+    publish from their own ThreadedLoop threads while commit-time
+    (un)subscribes run on the management thread, so ``_subs`` has an
+    owning lock.  Discipline (holo-lint HL203): the lock only guards
+    the subscription table — matching subscribers are *snapshotted*
+    under the lock and delivery (``loop.send``, which may take another
+    loop's wake lock) happens after release, so a publish can never
+    deadlock against a subscriber's own locking.
+    """
 
     def __init__(self, loop_: EventLoop):
         self.loop = loop_
         self._subs: dict[str, list[_Sub]] = {}
+        self._lock = threading.Lock()
 
     def subscribe(self, topic: str, actor: str, **filters) -> None:
-        subs = self._subs.setdefault(topic, [])
-        if not any(s.actor == actor and s.filter == filters for s in subs):
-            subs.append(_Sub(actor, filters))
+        with self._lock:
+            subs = self._subs.setdefault(topic, [])
+            if not any(
+                s.actor == actor and s.filter == filters for s in subs
+            ):
+                subs.append(_Sub(actor, filters))
 
     def unsubscribe(self, topic: str, actor: str) -> None:
-        self._subs[topic] = [
-            s for s in self._subs.get(topic, []) if s.actor != actor
-        ]
+        with self._lock:
+            self._subs[topic] = [
+                s for s in self._subs.get(topic, []) if s.actor != actor
+            ]
 
     def unsubscribe_all(self, actor: str) -> None:
-        for topic in self._subs:
-            self._subs[topic] = [
-                s for s in self._subs[topic] if s.actor != actor
-            ]
+        with self._lock:
+            for topic in self._subs:
+                self._subs[topic] = [
+                    s for s in self._subs[topic] if s.actor != actor
+                ]
 
     def publish(
         self, topic: str, payload: Any, sender: str = "", **match
     ) -> int:
         """Deliver to all subscribers whose filters match; returns count."""
+        # Snapshot-then-release: never call loop.send under _lock.
+        with self._lock:
+            targets = [
+                s.actor
+                for s in self._subs.get(topic, [])
+                if all(match.get(k) == v for k, v in s.filter.items())
+            ]
         n = 0
         dropped = 0
-        for s in self._subs.get(topic, []):
-            if all(match.get(k) == v for k, v in s.filter.items()):
-                if self.loop.send(s.actor, IbusMsg(topic, payload, sender)):
-                    n += 1
-                else:
-                    dropped += 1
+        for actor in targets:
+            if self.loop.send(actor, IbusMsg(topic, payload, sender)):
+                n += 1
+            else:
+                dropped += 1
         if n:
             _PUBLISHES.labels(topic=topic).inc(n)
         if dropped:

@@ -7,7 +7,19 @@ that ride this PR (RFC 6987 stub-router, mtu-ignore / transmit-delay).
 from ipaddress import IPv4Address as A
 from ipaddress import IPv4Network as N
 
+import pytest
+
 from holo_tpu.frr.manager import FrrConfig
+from holo_tpu.testing import no_implicit_transfers
+
+
+@pytest.fixture(autouse=True)
+def _transfer_sanitizer():
+    """E2E repair paths run under jax.transfer_guard('disallow') too —
+    a protocol-layer change that smuggles a device sync outside the
+    sanctioned FRR/SPF boundaries must fail here, not on a bench."""
+    with no_implicit_transfers():
+        yield
 from holo_tpu.protocols.ospf.instance import (
     IfConfig,
     IfUpMsg,

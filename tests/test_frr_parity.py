@@ -18,8 +18,18 @@ from holo_tpu.spf.synth import (
     random_ospf_topology,
     ring_topology,
 )
+from holo_tpu.testing import no_implicit_transfers
 
 N_ATOMS = 64
+
+
+@pytest.fixture(autouse=True)
+def _transfer_sanitizer():
+    """Every FRR parity test runs under jax.transfer_guard('disallow'):
+    only the engine's sanctioned marshal/unmarshal boundary may move
+    data between host and device (holo-lint runtime mode)."""
+    with no_implicit_transfers():
+        yield
 
 
 def assert_table_parity(scalar, tpu):

@@ -1,0 +1,82 @@
+"""The holo-lint tier-1 gate: the live tree must match the baseline.
+
+This is the in-pytest arm of the ratchet (the CLI arm is
+``holo-tpu-tools lint --baseline holo_tpu/analysis/baseline.json`` in
+the ROADMAP verify chain): any NEW finding fails tier-1, and a STALE
+baseline entry (its finding was fixed) also fails — the baseline only
+ever shrinks.
+"""
+
+from pathlib import Path
+
+from holo_tpu.analysis import (
+    all_rules,
+    compare_to_baseline,
+    default_baseline_path,
+    load_baseline,
+    run_paths,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_repo_matches_baseline():
+    result = run_paths([REPO / "holo_tpu"], root=REPO)
+    assert not result.parse_errors, result.parse_errors
+    assert result.files_checked > 60  # the whole package, not a subset
+
+    baseline = load_baseline(default_baseline_path())
+    new, unused = compare_to_baseline(result.findings, baseline)
+    assert not new, "new holo-lint findings (fix or baseline them):\n" + (
+        "\n".join(f.render() for f in new)
+    )
+    assert not unused, (
+        "stale baseline entries (their findings were fixed) — ratchet by "
+        "removing them from holo_tpu/analysis/baseline.json:\n"
+        + "\n".join(sorted(unused))
+    )
+
+
+def test_every_suppression_carries_a_rule_id():
+    # `disable=all` is for fixtures/docs, not the live tree: every
+    # in-tree suppression must name the rule it silences.
+    import re
+
+    pat = re.compile(r"holo-lint:\s*disable=([A-Za-z0-9_,\s-]+)")
+    offenders = []
+    for path in sorted((REPO / "holo_tpu").rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        for i, line in enumerate(path.read_text().splitlines(), start=1):
+            m = pat.search(line)
+            if m and "all" in {s.strip() for s in m.group(1).split(",")}:
+                offenders.append(f"{path}:{i}")
+    assert not offenders, offenders
+
+
+def test_rule_catalog_documented():
+    # COMPONENTS.md documents every rule id the analyzer ships.
+    text = (REPO / "COMPONENTS.md").read_text()
+    missing = [r.id for r in all_rules() if r.id not in text]
+    assert not missing, f"rules undocumented in COMPONENTS.md: {missing}"
+
+
+def test_cli_gate_exits_clean():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "holo_tpu.tools.cli",
+            "lint",
+            "--baseline",
+            str(default_baseline_path()),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,557 @@
+"""holo-lint golden fixtures: every rule fires on a known-bad snippet,
+honors `# holo-lint: disable=<id>`, and stays quiet on the clean
+rewrite.  The snippets are the rule catalog's executable documentation
+— each triple is (bad, suppressed, clean) for one rule id.
+"""
+
+import textwrap
+
+from holo_tpu.analysis import LintConfig, run_source
+
+OPS = "holo_tpu/ops/_fixture.py"  # tracer (dispatch) scope
+DAEMON = "holo_tpu/daemon/_fixture.py"  # concurrency scope
+SHARED = "holo_tpu/telemetry/_fixture.py"  # HL204 shared-state scope
+OUTSIDE = "holo_tpu/yang/_fixture.py"  # out of every rule scope
+
+
+def lint(src: str, relpath: str):
+    return run_source(textwrap.dedent(src), relpath, LintConfig())
+
+
+def rules_fired(src: str, relpath: str) -> set[str]:
+    return {f.rule for f in lint(src, relpath).findings}
+
+
+def assert_triple(rule: str, bad: str, suppressed: str, clean: str, path: str):
+    """One flagged snippet, one suppressed, one clean — per rule."""
+    res = lint(bad, path)
+    assert rule in {f.rule for f in res.findings}, (
+        f"{rule} did not fire on its bad fixture:\n"
+        + "\n".join(f.render() for f in res.findings)
+    )
+    sup = lint(suppressed, path)
+    assert rule not in {f.rule for f in sup.findings}, f"{rule} not suppressed"
+    assert rule in {f.rule for f in sup.suppressed}, (
+        f"{rule} suppression not recorded"
+    )
+    cl = lint(clean, path)
+    assert rule not in {f.rule for f in cl.findings}, (
+        f"{rule} fired on the clean fixture:\n"
+        + "\n".join(f.render() for f in cl.findings)
+    )
+
+
+# -- HL101: implicit host sync on device value --------------------------
+
+HL101_BAD = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        return np.asarray(out)
+"""
+HL101_SUPPRESSED = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        return np.asarray(out)  # holo-lint: disable=HL101
+"""
+HL101_CLEAN = """
+    import jax.numpy as jnp
+    import numpy as np
+    from holo_tpu.analysis.runtime import sanctioned_transfer
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        with sanctioned_transfer("fixture.unmarshal"):
+            return np.asarray(out)
+"""
+
+
+def test_hl101_host_sync():
+    assert_triple("HL101", HL101_BAD, HL101_SUPPRESSED, HL101_CLEAN, OPS)
+
+
+def test_hl101_item_and_float_forms():
+    src = """
+        import jax.numpy as jnp
+
+        def peek(x):
+            y = jnp.sum(x)
+            return float(y)
+
+        def peek2(x):
+            y = jnp.sum(x)
+            return y.item()
+    """
+    findings = lint(src, OPS).findings
+    assert sum(f.rule == "HL101" for f in findings) == 2
+
+
+def test_hl101_out_of_scope_module_is_ignored():
+    assert rules_fired(HL101_BAD, OUTSIDE) == set()
+
+
+# -- HL102: Python control flow on traced value -------------------------
+
+HL102_BAD = """
+    import jax.numpy as jnp
+
+    def step(x):
+        y = jnp.sum(x)
+        if y > 0:
+            return y
+        return y + 1
+"""
+HL102_SUPPRESSED = """
+    import jax.numpy as jnp
+
+    def step(x):
+        y = jnp.sum(x)
+        if y > 0:  # holo-lint: disable=HL102
+            return y
+        return y + 1
+"""
+HL102_CLEAN = """
+    import jax.numpy as jnp
+
+    def step(x):
+        y = jnp.sum(x)
+        if x.shape[0] > 0:  # shape data is static under trace
+            return jnp.where(y > 0, y, y + 1)
+        return y
+"""
+
+
+def test_hl102_traced_control_flow():
+    assert_triple("HL102", HL102_BAD, HL102_SUPPRESSED, HL102_CLEAN, OPS)
+
+
+def test_hl102_none_checks_are_static():
+    src = """
+        import jax.numpy as jnp
+
+        def step(mask, x):
+            y = jnp.sum(x)
+            if mask is not None and mask.shape[0] > 0:
+                y = y + 1
+            while x.ndim > 2:
+                x = x[0]
+            return y
+    """
+    assert "HL102" not in rules_fired(src, OPS)
+
+
+# -- HL103: jit recompile hazards ---------------------------------------
+
+HL103_BAD = """
+    import jax
+
+    def run(xs):
+        return jax.jit(lambda v: v + 1)(xs)
+"""
+HL103_SUPPRESSED = """
+    import jax
+
+    def run(xs):
+        return jax.jit(lambda v: v + 1)(xs)  # holo-lint: disable=HL103
+"""
+HL103_CLEAN = """
+    import jax
+
+    _STEP = jax.jit(lambda v: v + 1)
+
+    def run(xs):
+        return _STEP(xs)
+"""
+
+
+def test_hl103_recompile_hazard():
+    assert_triple("HL103", HL103_BAD, HL103_SUPPRESSED, HL103_CLEAN, OPS)
+
+
+def test_hl103_jit_in_loop():
+    src = """
+        import jax
+
+        def sweep(batches):
+            outs = []
+            for b in batches:
+                f = jax.jit(lambda v: v * 2)
+                outs.append(f(b))
+            return outs
+    """
+    assert "HL103" in rules_fired(src, OPS)
+
+
+def test_hl103_guarded_lazy_init_is_clean():
+    src = """
+        import jax
+
+        class Backend:
+            def __init__(self):
+                self._jit_fn = None
+
+            def compute(self, x):
+                if self._jit_fn is None:
+                    self._jit_fn = jax.jit(lambda v: v + 1)
+                return self._jit_fn(x)
+    """
+    assert "HL103" not in rules_fired(src, OPS)
+
+
+# -- HL104: float/dtype parity drift ------------------------------------
+
+HL104_BAD = """
+    import jax.numpy as jnp
+
+    def relax(x):
+        y = jnp.asarray(x)
+        return y / 2
+"""
+HL104_SUPPRESSED = """
+    import jax.numpy as jnp
+
+    def relax(x):
+        y = jnp.asarray(x)
+        return y / 2  # holo-lint: disable=HL104
+"""
+HL104_CLEAN = """
+    import jax.numpy as jnp
+
+    def relax(x):
+        y = jnp.asarray(x)
+        return y // 2
+"""
+
+
+def test_hl104_parity_drift():
+    assert_triple("HL104", HL104_BAD, HL104_SUPPRESSED, HL104_CLEAN, OPS)
+
+
+def test_hl104_float_dtype_and_literal():
+    src = """
+        import jax.numpy as jnp
+
+        def bad_dtype(x):
+            return jnp.asarray(x, jnp.float32)
+
+        def bad_literal(x):
+            return jnp.full(x.shape, 1.5)
+    """
+    findings = lint(src, OPS).findings
+    assert sum(f.rule == "HL104" for f in findings) == 2
+
+
+# -- HL105: eager metric computation on dispatch path -------------------
+
+HL105_BAD = """
+    import numpy as np
+    from holo_tpu import telemetry
+
+    _OCC = telemetry.gauge("holo_fixture_occupancy")
+
+    def marshal(valid):
+        _OCC.set(float(np.asarray(valid).mean()))
+"""
+HL105_SUPPRESSED = """
+    import numpy as np
+    from holo_tpu import telemetry
+
+    _OCC = telemetry.gauge("holo_fixture_occupancy")
+
+    def marshal(valid):
+        _OCC.set(float(np.asarray(valid).mean()))  # holo-lint: disable=HL105
+"""
+HL105_CLEAN = """
+    from holo_tpu import telemetry
+
+    _OCC = telemetry.gauge("holo_fixture_occupancy")
+
+    def marshal(valid, n_valid, n_slots):
+        _OCC.set_fn(lambda v=valid: float(v.mean()))  # deferred: scrape time
+        _OCC.set(n_valid / n_slots)  # O(1) metadata is fine too
+"""
+
+
+def test_hl105_eager_metric():
+    assert_triple("HL105", HL105_BAD, HL105_SUPPRESSED, HL105_CLEAN, OPS)
+
+
+# -- HL201: attribute mutated outside its owning lock -------------------
+
+HL201_BAD = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def snapshot(self):
+            with self._lock:
+                return dict(self._items)
+
+        def poke(self, k, v):
+            self._items[k] = v
+"""
+HL201_SUPPRESSED = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def snapshot(self):
+            with self._lock:
+                return dict(self._items)
+
+        def poke(self, k, v):
+            self._items[k] = v  # holo-lint: disable=HL201
+"""
+HL201_CLEAN = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def snapshot(self):
+            with self._lock:
+                return dict(self._items)
+
+        def poke(self, k, v):
+            with self._lock:
+                self._items[k] = v
+"""
+
+
+def test_hl201_unlocked_mutation():
+    assert_triple("HL201", HL201_BAD, HL201_SUPPRESSED, HL201_CLEAN, DAEMON)
+
+
+def test_hl201_init_writes_exempt():
+    # __init__ writes before the object is shared: never flagged.
+    assert "HL201" not in rules_fired(HL201_CLEAN, DAEMON)
+
+
+# -- HL202: blocking call while holding a lock --------------------------
+
+HL202_BAD = """
+    import threading
+    import time
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def run(self, q, item):
+            with self._lock:
+                q.put(item)
+                time.sleep(0.1)
+"""
+HL202_SUPPRESSED = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def run(self, q, item):
+            with self._lock:
+                q.put(item)  # holo-lint: disable=HL202
+"""
+HL202_CLEAN = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+
+        def run(self, q):
+            with self._lock:
+                batch = list(self._pending)
+                self._pending.clear()
+            for item in batch:
+                q.put(item)
+"""
+
+
+def test_hl202_blocking_under_lock():
+    assert_triple("HL202", HL202_BAD, HL202_SUPPRESSED, HL202_CLEAN, DAEMON)
+
+
+def test_hl202_condition_wait_is_the_correct_pattern():
+    src = """
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Condition(self._lock)
+
+            def pump(self):
+                with self._wake:
+                    self._wake.wait(timeout=0.5)
+    """
+    assert "HL202" not in rules_fired(src, DAEMON)
+
+
+def test_hl202_nested_locks():
+    src = """
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sub_lock = threading.Lock()
+
+            def both(self):
+                with self._lock:
+                    with self._sub_lock:
+                        return 1
+    """
+    assert "HL202" in rules_fired(src, DAEMON)
+
+
+# -- HL203: callback invoked while holding a lock -----------------------
+
+HL203_BAD = """
+    import threading
+
+    class Notifier:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cbs = []
+
+        def fire(self, msg):
+            with self._lock:
+                for cb in self._cbs:
+                    cb(msg)
+"""
+HL203_SUPPRESSED = """
+    import threading
+
+    class Notifier:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cbs = []
+
+        def fire(self, msg):
+            with self._lock:
+                for cb in self._cbs:
+                    cb(msg)  # holo-lint: disable=HL203
+"""
+HL203_CLEAN = """
+    import threading
+
+    class Notifier:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cbs = []
+
+        def fire(self, msg):
+            with self._lock:
+                targets = list(self._cbs)
+            for cb in targets:
+                cb(msg)
+"""
+
+
+def test_hl203_callback_under_lock():
+    assert_triple("HL203", HL203_BAD, HL203_SUPPRESSED, HL203_CLEAN, DAEMON)
+
+
+# -- HL204: thread-shared container with no lock ------------------------
+
+HL204_BAD = """
+    class Bus:
+        def __init__(self):
+            self._subs = {}
+
+        def add(self, k, v):
+            self._subs[k] = v
+
+        def fanout(self, msg):
+            return [s for s in self._subs.values() if s]
+"""
+HL204_SUPPRESSED = """
+    class Bus:
+        def __init__(self):
+            self._subs = {}
+
+        def add(self, k, v):
+            self._subs[k] = v  # holo-lint: disable=HL204
+
+        def fanout(self, msg):
+            return [s for s in self._subs.values() if s]
+"""
+HL204_CLEAN = """
+    import threading
+
+    class Bus:
+        def __init__(self):
+            self._subs = {}
+            self._lock = threading.Lock()
+
+        def add(self, k, v):
+            with self._lock:
+                self._subs[k] = v
+
+        def fanout(self, msg):
+            with self._lock:
+                return [s for s in self._subs.values() if s]
+"""
+
+
+def test_hl204_no_lock_shared_container():
+    assert_triple("HL204", HL204_BAD, HL204_SUPPRESSED, HL204_CLEAN, SHARED)
+
+
+def test_hl204_daemon_actor_classes_out_of_scope():
+    # daemon/ providers run under the single-threaded actor model:
+    # HL204's scope excludes them by design.
+    assert "HL204" not in rules_fired(HL204_BAD, DAEMON)
+
+
+# -- machinery ----------------------------------------------------------
+
+
+def test_disable_all_and_previous_line():
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def dispatch(g):
+            out = jnp.add(g, 1)
+            # holo-lint: disable=all
+            return np.asarray(out)
+    """
+    res = lint(src, OPS)
+    assert not res.findings and res.suppressed
+
+
+def test_parse_error_reported_not_raised():
+    res = lint("def broken(:\n", OPS)
+    assert res.parse_errors and not res.findings
+
+
+def test_baseline_multiset_semantics():
+    from collections import Counter
+
+    from holo_tpu.analysis import compare_to_baseline
+    from holo_tpu.analysis.core import Finding
+
+    f = Finding("HL101", "a.py", 3, "fn", "msg")
+    g = Finding("HL101", "a.py", 9, "fn", "msg")  # same key, other line
+    baseline = Counter({f.key: 1})
+    new, unused = compare_to_baseline([f, g], baseline)
+    assert len(new) == 1 and not unused  # second duplicate is NEW
+    new, unused = compare_to_baseline([], baseline)
+    assert not new and unused[f.key] == 1  # stale entry surfaces

@@ -10,8 +10,18 @@ import pytest
 
 from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
 from holo_tpu.spf.synth import random_ospf_topology, whatif_link_failure_masks
+from holo_tpu.testing import no_implicit_transfers
 
 N_ATOMS = 64
+
+
+@pytest.fixture(autouse=True)
+def _transfer_sanitizer():
+    """Every parity test runs under jax.transfer_guard('disallow'):
+    only the backend's sanctioned marshal/unmarshal boundaries may
+    move data between host and device (holo-lint runtime mode)."""
+    with no_implicit_transfers():
+        yield
 
 # Every gather-path fixpoint formulation must be bit-identical: 'seq'
 # the staged-loop form (production default, both here and in

@@ -519,3 +519,39 @@ def test_txqueue_and_ibus_metrics():
         - before.get("holo_ibus_undeliverable_total{topic=test.topic}", 0)
         == 1
     )
+
+
+# -- deferred occupancy sampling (holo-lint HL105 fix, PR 3) ------------
+
+
+def test_deferred_mean_one_shot_release_and_kill_switch():
+    """set_fn + deferred_mean: the reduction runs at scrape time (not
+    on the dispatch path), the array reference is dropped after the
+    first sample, and set_enabled(False) gates fn-backed gauges too."""
+    import gc
+    import weakref
+
+    import numpy as np
+
+    g = telemetry.gauge("holo_test_deferred_occupancy")
+    arr = np.ones((4, 8), bool)
+    arr[0, :4] = False
+    ref = weakref.ref(arr)
+    g.set_fn(telemetry.deferred_mean(arr))
+    del arr
+    gc.collect()
+    assert ref() is not None  # pinned until first scrape...
+    assert g.value == 1.0 - 4 / 32
+    gc.collect()
+    assert ref() is None  # ...released after it; value stays cached
+    assert g.value == 1.0 - 4 / 32
+
+    # Kill switch: a disabled registry must not run sampling closures.
+    calls = []
+    g.set_fn(lambda: calls.append(1) or 7.0)
+    telemetry.set_enabled(False)
+    try:
+        assert g.value == 0.0 and not calls
+    finally:
+        telemetry.set_enabled(True)
+    assert g.value == 7.0 and calls

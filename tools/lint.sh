@@ -1,0 +1,15 @@
+#!/bin/sh
+# holo-lint pre-commit gate: JAX hot-path hazards + daemon lock
+# discipline, ratcheted against holo_tpu/analysis/baseline.json.
+#
+# Usage:
+#   tools/lint.sh            # gate (exit 0 clean, 1 new findings)
+#   tools/lint.sh --json     # machine-readable report
+#   tools/lint.sh --list-rules
+#
+# Wire as a pre-commit hook with:
+#   ln -s ../../tools/lint.sh .git/hooks/pre-commit
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m holo_tpu.tools.cli lint \
+    --baseline holo_tpu/analysis/baseline.json "$@"
