@@ -60,6 +60,7 @@ STAGE_TIMEOUT = {
     "isis_l1l2": 1200,
     "frr_batch": 900,
     "telemetry_overhead": 900,
+    "fallback_overhead": 900,
 }
 
 
@@ -545,6 +546,42 @@ def stage_telemetry_overhead(k, B, reps=15):
     }
 
 
+def stage_fallback_overhead(k, B, reps=15):
+    """ISSUE 4 acceptance row: the breaker-guarded SPF dispatch on the
+    HEALTHY path (closed circuit — per-call admit check + success
+    accounting) against the same backend with the breaker bypassed.
+    Same interleaved min-of-N discipline as telemetry_overhead; ok
+    requires <2% overhead AND the circuit still closed (a bench run
+    must never trip the breaker)."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    backend.compute_whatif(topo, masks)  # warm: compile + graph cache
+    guarded, bypassed = [], []
+    for rep in range(reps):
+        arms = ((True, guarded), (False, bypassed))
+        for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+            backend.breaker.enabled = armed
+            t0 = time.perf_counter()
+            backend.compute_whatif(topo, masks)
+            times.append(time.perf_counter() - t0)
+    backend.breaker.enabled = True
+    on_ms = float(np.min(guarded) * 1e3)
+    off_ms = float(np.min(bypassed) * 1e3)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
+    snap = backend.breaker.snapshot()
+    return {
+        "ok": bool(overhead_pct < 2.0 and snap["state"] == "closed"),
+        "guarded_ms": round(on_ms, 3),
+        "bypassed_ms": round(off_ms, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "breaker": snap,
+        "batch": int(B),
+        "reps": reps,
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -619,6 +656,9 @@ def main() -> None:
             "telemetry_overhead": lambda: stage_telemetry_overhead(
                 k10, 32 if small else 64
             ),
+            "fallback_overhead": lambda: stage_fallback_overhead(
+                k10, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -656,6 +696,12 @@ def main() -> None:
         # Telemetry overhead gate (ISSUE 2): instrumented vs disabled
         # registry on the SPF dispatch path — platform-independent, so
         # the JAX-CPU row keeps the acceptance signal alive.
+        # Breaker healthy-path overhead gate (ISSUE 4): the guard is
+        # host-side arithmetic, platform-independent — the JAX-CPU row
+        # keeps the acceptance signal alive while the relay is down.
+        extra["fallback_overhead_jaxcpu_small"] = _run_stage(
+            "fallback_overhead", True, cpu=True
+        )
         extra["telemetry_overhead_jaxcpu_small"] = _run_stage(
             "telemetry_overhead", True, cpu=True
         )
@@ -731,6 +777,10 @@ def main() -> None:
     # Telemetry overhead gate (ISSUE 2): the instrumented SPF dispatch
     # must stay within noise (<2%) of a registry-disabled run.
     extra["telemetry_overhead"] = _run_stage("telemetry_overhead", small)
+    # Breaker instrumentation gate (ISSUE 4): the healthy-path guard
+    # around the device dispatch must stay within noise (<2%) of a
+    # bypassed breaker.
+    extra["fallback_overhead"] = _run_stage("fallback_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

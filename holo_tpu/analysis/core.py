@@ -89,6 +89,21 @@ SHARED_STATE_PREFIXES = (
     "holo_tpu/utils/txqueue.py",
     "holo_tpu/telemetry",
 )
+# HL106 (swallow-and-continue) runs where a silently eaten exception
+# becomes silent wrong routing state: the dispatch modules, the actor
+# runtime + everything hosting actor handlers (daemon, protocols), the
+# resilience machinery itself, and the forensics journal.
+SWALLOW_PREFIXES = DISPATCH_PREFIXES + (
+    "holo_tpu/daemon",
+    "holo_tpu/protocols",
+    "holo_tpu/resilience",
+    "holo_tpu/telemetry",
+    "holo_tpu/utils/runtime.py",
+    "holo_tpu/utils/preempt.py",
+    "holo_tpu/utils/txqueue.py",
+    "holo_tpu/utils/ibus.py",
+    "holo_tpu/utils/event_recorder.py",
+)
 
 
 @dataclass
@@ -96,6 +111,7 @@ class LintConfig:
     dispatch_prefixes: tuple[str, ...] = DISPATCH_PREFIXES
     concurrency_prefixes: tuple[str, ...] = CONCURRENCY_PREFIXES
     shared_state_prefixes: tuple[str, ...] = SHARED_STATE_PREFIXES
+    swallow_prefixes: tuple[str, ...] = SWALLOW_PREFIXES
     exclude_parts: tuple[str, ...] = ("__pycache__",)
 
     def in_dispatch_scope(self, relpath: str) -> bool:
@@ -106,6 +122,9 @@ class LintConfig:
 
     def in_shared_state_scope(self, relpath: str) -> bool:
         return relpath.startswith(self.shared_state_prefixes)
+
+    def in_swallow_scope(self, relpath: str) -> bool:
+        return relpath.startswith(self.swallow_prefixes)
 
 
 # -- module model -------------------------------------------------------
@@ -208,9 +227,14 @@ class Rule:
 def all_rules() -> list[Rule]:
     """Instantiate the full registry (import is deferred so `core` has
     no circular dependency on the rule modules)."""
-    from holo_tpu.analysis import rules_locks, rules_tracer
+    from holo_tpu.analysis import rules_locks, rules_resilience, rules_tracer
 
-    return [cls() for cls in rules_tracer.RULES + rules_locks.RULES]
+    return [
+        cls()
+        for cls in (
+            rules_tracer.RULES + rules_resilience.RULES + rules_locks.RULES
+        )
+    ]
 
 
 # -- running ------------------------------------------------------------

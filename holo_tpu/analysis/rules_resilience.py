@@ -1,0 +1,84 @@
+"""holo-lint resilience rules (HL1xx continued).
+
+HL106 targets the failure-handling anti-pattern the resilience
+subsystem exists to eliminate: ``except Exception: pass`` (or a bare
+``except:``) on dispatch-path or actor-loop code.  Swallow-and-continue
+there turns a crashed dispatch or a dying actor into silent
+wrong-or-stale routing state — the supervisor/breaker machinery can
+only act on failures it gets to SEE.  Broad handlers are fine when they
+*do* something (log, count, fall back, re-raise); only an empty body
+(``pass`` / ``...``) is flagged.  Narrow handlers (``except
+queue.Full: pass``) encode a deliberate, understood case and stay
+allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, Rule, dotted
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or one naming Exception/BaseException (alone or
+    inside a tuple, plain or dotted like ``builtins.Exception``)."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        d = dotted(node)
+        if d is not None and d.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _is_swallow(handler: ast.ExceptHandler) -> bool:
+    """Handler body does nothing: only ``pass`` / ``...`` statements."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+class SwallowedExceptionRule(Rule):
+    id = "HL106"
+    title = "swallow-and-continue on dispatch/actor-loop code"
+    family = "resilience"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_swallow_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if _is_broad(handler) and _is_swallow(handler):
+                    what = (
+                        "bare `except:`"
+                        if handler.type is None
+                        else "`except Exception:`"
+                    )
+                    out.append(
+                        self.finding(
+                            mod,
+                            handler,
+                            f"{what} with an empty body swallows "
+                            "failures the supervisor/breaker must see; "
+                            "log, count, fall back, or narrow the type",
+                        )
+                    )
+        return out
+
+
+RULES = [SwallowedExceptionRule]

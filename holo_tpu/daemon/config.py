@@ -66,6 +66,27 @@ class TelemetryConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    # Actor supervision ([resilience] in holod.toml): restart crashed
+    # protocol actors with exponential backoff + deterministic jitter;
+    # a crash loop (threshold crashes within window) parks the actor in
+    # a permanent degraded state instead of flapping.
+    supervision: bool = True
+    restart_base_delay: float = 0.5
+    restart_max_delay: float = 30.0
+    crash_loop_threshold: int = 5
+    crash_loop_window: float = 60.0
+    # Dispatch circuit breaker defaults (TpuSpfBackend / FrrEngine):
+    # consecutive failures before the circuit opens, seconds before a
+    # half-open probe, optional per-dispatch deadline budget (seconds;
+    # an overrun counts as a failure — once the circuit opens, SPF goes
+    # to the scalar oracle up front instead of waiting on the device).
+    breaker_failure_threshold: int = 3
+    breaker_recovery_timeout: float = 30.0
+    breaker_deadline: float | None = None
+
+
+@dataclass
 class RuntimeConfig:
     # "threaded" (default): each protocol instance on its own OS thread
     # — the reference's PRODUCTION posture (per-instance spawn_blocking,
@@ -92,6 +113,7 @@ class DaemonConfig:
     event_recorder: EventRecorderConfig = field(default_factory=EventRecorderConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @classmethod
     def load(cls, path: str | Path | None) -> "DaemonConfig":
@@ -132,6 +154,21 @@ class DaemonConfig:
             cfg.telemetry.enabled = t.get("enabled", False)
             cfg.telemetry.address = t.get("address", cfg.telemetry.address)
             cfg.telemetry.trace_dump = t.get("trace-dump")
+        if "resilience" in raw:
+            r = raw["resilience"]
+            res = cfg.resilience
+            res.supervision = r.get("supervision", True)
+            for toml_key, attr in (
+                ("restart-base-delay", "restart_base_delay"),
+                ("restart-max-delay", "restart_max_delay"),
+                ("crash-loop-threshold", "crash_loop_threshold"),
+                ("crash-loop-window", "crash_loop_window"),
+                ("breaker-failure-threshold", "breaker_failure_threshold"),
+                ("breaker-recovery-timeout", "breaker_recovery_timeout"),
+                ("breaker-deadline", "breaker_deadline"),
+            ):
+                if toml_key in r:
+                    setattr(res, attr, r[toml_key])
         if "runtime" in raw:
             iso = raw["runtime"].get("isolation")
             if iso is not None:

@@ -160,6 +160,30 @@ class Daemon:
             )
             instrument(self.loop, self.recorder)
 
+        # Actor supervision ([resilience], holo_tpu/resilience/): crashed
+        # protocol actors restart under an exponential-backoff policy
+        # with deterministic jitter; crash loops park the actor in a
+        # permanent degraded state.  The supervisor is itself an actor
+        # on the primary loop, so with the event recorder enabled every
+        # crash notice / restart tick is journaled and replayable.
+        self.supervisor = None
+        rc = self.config.resilience
+        if rc.supervision:
+            from holo_tpu.resilience.supervisor import (
+                RestartPolicy,
+                Supervisor,
+            )
+
+            self.supervisor = Supervisor(
+                policy=RestartPolicy(
+                    base_delay=rc.restart_base_delay,
+                    max_delay=rc.restart_max_delay,
+                    crash_loop_threshold=rc.crash_loop_threshold,
+                    crash_loop_window=rc.crash_loop_window,
+                ),
+                name=f"{self._p}supervisor",
+            ).install(self.loop)
+
     # -- preemptive instance placement ([runtime] isolation = "threaded")
 
     # Instance-side callbacks the providers install: these mutate shared
@@ -175,6 +199,13 @@ class Daemon:
         )
 
         tl = ThreadedLoop(name=f"{self._p}inst-{inst.name}")
+        if self.supervisor is not None:
+            # Crashes on the instance's own thread marshal back to the
+            # primary-loop supervisor as messages; the restart itself is
+            # marshaled the other way (tl.send posts + wakes the pump)
+            # so on_restart and held-mail redelivery run single-writer
+            # on the instance's thread.
+            self.supervisor.adopt(tl.loop, sender=tl.send)
         if self.recorder is not None:
             # Instance messages bypass the primary loop under isolation;
             # journal them on the instance's own loop (same recorder —
@@ -239,6 +270,11 @@ class Daemon:
         self.loop_router.unregister_remote(name)
         tl = self.instance_loops.pop(name, None)
         if tl is not None:
+            if self.supervisor is not None:
+                # Deliberate teardown is not a crash: drop the loop and
+                # per-actor verdicts so a re-created instance under the
+                # same name is supervised afresh.
+                self.supervisor.unadopt(tl.loop)
             actors = list(tl.loop.actors)
             insts = [tl.loop.actors[a] for a in actors]
             for a in actors:  # multi-actor nodes route every sub-name
@@ -362,12 +398,19 @@ class Daemon:
         for name, tl in list(self.instance_loops.items()):
             if self.loop_router is not None:
                 self.loop_router.unregister_remote(name)
+            if self.supervisor is not None:
+                self.supervisor.unadopt(tl.loop)
             inst = tl.loop.actors.get(name)
             tl.stop()
             netio = getattr(inst, "netio", None)
             if netio is not None and hasattr(netio, "close"):
                 netio.close()  # drain + join the per-interface Tx tasks
         self.instance_loops.clear()
+        if self.recorder is not None:
+            # Flush AFTER the tx queues drained so the journal's tail
+            # covers everything the daemon actually sent; fsync so the
+            # post-mortem trace survives a crash-restart cycle.
+            self.recorder.close()
 
 
 class _RuntimeStateProvider(NbProvider):
@@ -481,6 +524,17 @@ def main(argv=None):
     args = ap.parse_args(argv)
     cfg = DaemonConfig.load(args.config)
     setup_logging(cfg)
+    # Dispatch-breaker knobs apply process-wide (protocol code builds
+    # its SPF/FRR engines — and so their breakers — internally).  Set
+    # at daemon BOOT only: merely constructing a Daemon object (tests,
+    # simulations) must not rewrite process globals.
+    from holo_tpu.resilience.breaker import configure_defaults
+
+    configure_defaults(
+        failure_threshold=cfg.resilience.breaker_failure_threshold,
+        recovery_timeout=cfg.resilience.breaker_recovery_timeout,
+        deadline=cfg.resilience.breaker_deadline,
+    )
     from holo_tpu.daemon import hardening
 
     lock_fd = None
@@ -533,6 +587,11 @@ def main(argv=None):
     _h.install_signal_handlers(
         lambda: stopping.append(True),
         dump_cb=lambda: rt_provider.get_state().get("holo-runtime"),
+        # First thing on SIGTERM/SIGINT: fsync the event journal so the
+        # post-mortem trace survives even if the orderly drain hangs.
+        flush_cb=(
+            daemon.recorder.flush if daemon.recorder is not None else None
+        ),
     )
     try:
         import time

@@ -94,13 +94,24 @@ def drop_privileges(user: str) -> None:
     )
 
 
-def install_signal_handlers(shutdown_cb, dump_cb=None) -> None:
+def install_signal_handlers(shutdown_cb, dump_cb=None, flush_cb=None) -> None:
     """SIGINT/SIGTERM -> orderly shutdown; SIGHUP ignored (config is
     transactional via the northbound, not file reload); SIGUSR1 ->
-    runtime-introspection dump to the log when ``dump_cb`` is given."""
+    runtime-introspection dump to the log when ``dump_cb`` is given.
+
+    ``flush_cb`` runs FIRST in the handler: it fsyncs crash-forensics
+    state (the event-recorder journal) before the orderly shutdown even
+    starts, so the post-mortem trace survives a teardown that hangs or
+    a process killed mid-drain — the orderly path in ``Daemon.stop``
+    flushes again after the tx queues drain."""
 
     def _handler(signum, _frame):
         log.info("signal %s: shutting down", signal.Signals(signum).name)
+        if flush_cb is not None:
+            try:
+                flush_cb()
+            except Exception:  # the shutdown must proceed regardless
+                log.exception("shutdown flush failed")
         shutdown_cb()
 
     signal.signal(signal.SIGINT, _handler)

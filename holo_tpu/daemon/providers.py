@@ -774,7 +774,13 @@ class RoutingProvider(Provider, Actor):
             time_to_learn=delay.get("time-to-learn", 500) / 1000,
         )
         backend_name = spf.get("backend", "scalar")
-        backend = TpuSpfBackend() if backend_name == "tpu" else ScalarSpfBackend()
+        # Reuse the live backend when the engine kind is unchanged (the
+        # ensure_engine pattern): a rebuilt TpuSpfBackend on every
+        # commit would discard the warm jit/graph caches and mint a
+        # fresh breaker metric series each time.
+        want = TpuSpfBackend if backend_name == "tpu" else ScalarSpfBackend
+        prev = getattr(inst, "backend", None) if inst is not None else None
+        backend = prev if type(prev) is want else want()
         old_redist = getattr(self, "_ospf_redistribute", set())
         self._ospf_redistribute = set(new.get(f"{base}/redistribute") or [])
         redist_changed = old_redist != self._ospf_redistribute

@@ -27,6 +27,8 @@ from holo_tpu.analysis.runtime import sanctioned_transfer
 from holo_tpu.frr.inputs import marshal_frr
 from holo_tpu.frr.kernel import BackupTable
 from holo_tpu.ops.graph import Topology
+from holo_tpu.resilience import faults
+from holo_tpu.resilience.breaker import CircuitBreaker
 
 # FRR dispatch observability, mirroring the SPF backend's signal set:
 # wall time per backup-table computation, recompiles vs shape hits, and
@@ -174,10 +176,17 @@ class FrrEngine:
         engine: str = "scalar",
         n_atoms: int = 64,
         max_iters: int | None = None,
+        breaker: CircuitBreaker | None = None,
     ):
+        """``breaker`` guards the device path like the SPF backend's: a
+        failed/overdue ``frr_batch`` dispatch re-runs on the scalar
+        oracle (bit-identical backup tables by the parity contract)."""
         self.engine = engine
         self.n_atoms = n_atoms
         self.max_iters = max_iters
+        self.breaker = (
+            breaker if breaker is not None else CircuitBreaker("frr-dispatch")
+        )
         self._jit = None  # built lazily (jax import on first TPU compute)
         self._graph_cache: dict[tuple, object] = {}
         self._compiled_shapes: set[tuple] = set()
@@ -204,6 +213,7 @@ class FrrEngine:
         return g
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
+        faults.crashpoint("frr.dispatch")
         import jax
 
         from holo_tpu.frr.kernel import frr_batch
@@ -251,6 +261,13 @@ class FrrEngine:
                 post_nh=np.asarray(out.post_nh)[:nl],
             )
 
+    def _scalar_fallback(self, topo: Topology, fin) -> BackupTable:
+        """Breaker degraded path: the oracle over the SAME marshaled
+        inputs — the backup table is bit-identical by the parity suite."""
+        from holo_tpu.frr.scalar import frr_reference
+
+        return frr_reference(topo, self.n_atoms, inputs=fin)
+
     # -- dispatch
 
     def compute(self, topo: Topology) -> BackupTable:
@@ -272,7 +289,11 @@ class FrrEngine:
                     telemetry.deferred_mean(fin.adj_valid)
                 )
             if self.engine == "tpu":
-                table = self._compute_tpu(topo, fin)
+                table = self.breaker.call(
+                    lambda: self._compute_tpu(topo, fin),
+                    lambda: self._scalar_fallback(topo, fin),
+                    context="frr.batch",
+                )
             else:
                 from holo_tpu.frr.scalar import frr_reference
 

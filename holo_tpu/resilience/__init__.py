@@ -1,0 +1,61 @@
+"""Resilience subsystem: supervision, dispatch circuit breaking, chaos.
+
+Three layers (ISSUE 4), each documented in its module:
+
+- :mod:`holo_tpu.resilience.supervisor` — actor restart policy
+  (exponential backoff + deterministic jitter, crash-loop detection ->
+  permanent degraded) installed as the EventLoop supervisor by the
+  daemon;
+- :mod:`holo_tpu.resilience.breaker` — circuit breaker around the TPU
+  device dispatch with the proven bit-identical scalar oracle as the
+  transparent fallback (wired in ``spf/backend.py`` / ``frr/manager.py``);
+- :mod:`holo_tpu.resilience.faults` — seeded deterministic FaultPlan +
+  injector driving the chaos e2e suite.
+
+Stdlib-only and import-light: nothing here touches JAX, so the daemon,
+the lint gate, and the chaos harness can import it without paying a
+device runtime import.
+"""
+
+from __future__ import annotations
+
+from holo_tpu.resilience.breaker import (  # noqa: F401 — public API
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    DeadlineOverrun,
+    breakers,
+)
+from holo_tpu.resilience.faults import (  # noqa: F401 — public API
+    FaultInjector,
+    FaultPlan,
+    FaultyNetIo,
+    InjectedFault,
+    crashpoint,
+    inject,
+)
+from holo_tpu.resilience.supervisor import (  # noqa: F401 — public API
+    RestartPolicy,
+    Supervisor,
+    supervisors,
+)
+
+
+def health_snapshot() -> dict:
+    """Aggregate resilience health for the ``holo-telemetry`` leaf:
+    live breaker states + supervisor restart/degraded bookkeeping."""
+    out: dict = {}
+    brs = {name: br.snapshot() for name, br in breakers().items()}
+    if brs:
+        out["breakers"] = brs
+    sups = [s.snapshot() for s in supervisors()]
+    if sups:
+        merged = {"degraded-actors": [], "restarts": {}, "crashes": {}}
+        for s in sups:
+            merged["degraded-actors"].extend(s["degraded-actors"])
+            merged["restarts"].update(s["restarts"])
+            merged["crashes"].update(s["crashes"])
+        merged["degraded-actors"].sort()
+        out["supervision"] = merged
+    return out

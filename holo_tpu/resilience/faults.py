@@ -1,0 +1,243 @@
+"""Deterministic fault injection: seeded FaultPlan + seam helpers.
+
+Chaos testing is only useful when a failing run can be replayed
+bit-for-bit, so every injection decision comes from a *per-site* RNG
+stream derived from ``(plan.seed, site)`` — interleaving across seams
+never perturbs another seam's stream, and the same plan against the
+same virtual-clock scenario yields the same event sequence (guarded by
+the chaos determinism test).
+
+Seams (all pre-existing in the codebase, armed here):
+
+- **device dispatch** — ``crashpoint("spf.dispatch")`` /
+  ``("frr.dispatch")`` in the backends raises :class:`InjectedFault`
+  when armed (forced counts or probability), exercising the circuit
+  breaker's scalar fallback;
+- **wire** — :meth:`FaultInjector.wire_fabric` installs a seeded drop
+  rule on a :class:`MockFabric`; :meth:`FaultInjector.wrap_netio`
+  raises ``OSError`` from ``send`` (the txqueue ``send_error`` path);
+- **ibus** — :meth:`FaultInjector.wrap_ibus` defers matched publishes
+  through a loop timer (delivery-delay chaos);
+- **time** — :meth:`FaultInjector.jittered_advance` moves the virtual
+  clock in deterministically uneven steps (timer-jitter chaos);
+- **actors** — :meth:`FaultInjector.kill_actor` posts a
+  :class:`~holo_tpu.utils.runtime.PoisonPill`, crashing the target
+  inside its handler frame (the supervision seam).
+
+The hot-path cost when nothing is armed is one module-global ``None``
+check in :func:`crashpoint`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from holo_tpu import telemetry
+from holo_tpu.utils.netio import NetIo
+from holo_tpu.utils.runtime import EventLoop, PoisonPill
+
+_INJECTED = telemetry.counter(
+    "holo_resilience_faults_injected_total",
+    "Faults injected by the chaos harness, by seam site",
+    ("site",),
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed crashpoint (chaos testing only)."""
+
+
+@dataclass
+class FaultPlan:
+    """One seeded chaos scenario.  Probabilities are per event; forced
+    dispatch failures (``dispatch_fail``) burn down deterministically —
+    ``{"spf.dispatch": 3}`` fails exactly the next three dispatches."""
+
+    seed: int = 0
+    drop_prob: float = 0.0  # wire frame drops (MockFabric rule)
+    send_error_prob: float = 0.0  # NetIo.send raising OSError
+    publish_delay: float = 0.0  # ibus delivery deferral (seconds)
+    publish_delay_prob: float = 0.0
+    timer_jitter: float = 0.0  # +/- fraction for jittered_advance steps
+    dispatch_fail: dict = field(default_factory=dict)  # site -> count
+    dispatch_fail_prob: float = 0.0
+
+    def rng(self, site: str) -> random.Random:
+        """Independent deterministic stream for one seam site."""
+        h = hashlib.sha256(f"{self.seed}:{site}".encode()).digest()
+        return random.Random(int.from_bytes(h[:8], "big"))
+
+
+class FaultInjector:
+    """Applies one :class:`FaultPlan`; tracks what actually fired."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.injected: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self._forced = dict(plan.dispatch_fail)
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = self.plan.rng(site)
+        return rng
+
+    def _record(self, site: str) -> None:
+        self.injected[site] = self.injected.get(site, 0) + 1
+        _INJECTED.labels(site=site).inc()
+
+    # -- dispatch seam
+
+    def crashpoint(self, site: str) -> None:
+        n = self._forced.get(site, 0)
+        if n > 0:
+            self._forced[site] = n - 1
+            self._record(site)
+            raise InjectedFault(f"forced dispatch failure at {site}")
+        p = self.plan.dispatch_fail_prob
+        if p and self._rng(f"dispatch:{site}").random() < p:
+            self._record(site)
+            raise InjectedFault(f"random dispatch failure at {site}")
+
+    # -- wire seams
+
+    def wire_fabric(self, fabric) -> None:
+        """Install a seeded frame-drop rule on a MockFabric."""
+        rng = self._rng("fabric.drop")
+
+        def rule(_link, _dst, _data) -> bool:
+            if self.plan.drop_prob and rng.random() < self.plan.drop_prob:
+                self._record("fabric.drop")
+                return True
+            return False
+
+        fabric.add_drop_rule(rule)
+
+    def wrap_netio(self, netio: NetIo) -> "FaultyNetIo":
+        """Decorate a NetIo so sends raise OSError per the plan."""
+        return FaultyNetIo(netio, self)
+
+    # -- ibus seam
+
+    def wrap_ibus(self, bus) -> None:
+        """Defer the bus's matched deliveries through loop timers.
+
+        Replaces ``bus.loop`` with a send proxy; timers are armed on the
+        inner loop, so a deferred message is delivered once (no
+        re-delay recursion)."""
+        bus.loop = _DelayedSendLoop(bus.loop, self)
+
+    # -- time seam
+
+    def jittered_advance(self, loop: EventLoop, total: float, steps: int = 8) -> None:
+        """Advance the virtual clock by ``total`` in deterministically
+        uneven steps — timers near step boundaries fire in different
+        batches than under a smooth advance, without changing the total."""
+        if not self.plan.timer_jitter or steps <= 1:
+            loop.advance(total)
+            return
+        rng = self._rng("clock.jitter")
+        weights = [
+            1.0 + self.plan.timer_jitter * (2.0 * rng.random() - 1.0)
+            for _ in range(steps)
+        ]
+        scale = total / sum(weights)
+        for w in weights:
+            loop.advance(w * scale)
+
+    # -- actor seam
+
+    def kill_actor(self, loop: EventLoop, actor: str, reason: str = "chaos") -> bool:
+        """Crash ``actor`` inside its handler frame (supervision seam).
+        False when the send was refused (unknown/abandoned actor) —
+        nothing was injected then and the tally must not move."""
+        if loop.send(actor, PoisonPill(reason=reason)):
+            self._record("actor.kill")
+            return True
+        return False
+
+
+class FaultyNetIo(NetIo):
+    """NetIo decorator raising seeded OSErrors from ``send`` — the
+    production failure mode a per-interface Tx task must survive (and
+    attribute: txqueue drop cause ``send_error``)."""
+
+    def __init__(self, inner: NetIo, injector: FaultInjector):
+        self.inner = inner
+        self._inj = injector
+
+    def send(self, ifname, src, dst, data) -> None:
+        p = self._inj.plan.send_error_prob
+        if p and self._inj._rng("netio.send").random() < p:
+            self._inj._record("netio.send")
+            raise OSError(f"injected send error on {ifname}")
+        self.inner.send(ifname, src, dst, data)
+
+    def __getattr__(self, name: str):
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
+class _DelayedSendLoop:
+    """Loop proxy: sends may be deferred via a timer on the inner loop."""
+
+    def __init__(self, inner: EventLoop, injector: FaultInjector):
+        self._inner = inner
+        self._inj = injector
+
+    def send(self, actor: str, msg) -> bool:
+        plan = self._inj.plan
+        # Both knobs must be armed — like every other seam, a 0.0
+        # probability disables the fault entirely.
+        if (
+            plan.publish_delay
+            and plan.publish_delay_prob
+            and self._inj._rng("ibus.delay").random() < plan.publish_delay_prob
+        ):
+            if actor in self._inner.actors:
+                t = self._inner.timer(actor, lambda m=msg: m)
+                t.start(plan.publish_delay)
+                self._inj._record("ibus.delay")
+                return True
+        return self._inner.send(actor, msg)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+# -- global arming (the module-level seam hot paths consult) ------------
+
+_active: FaultInjector | None = None
+
+
+def active() -> FaultInjector | None:
+    return _active
+
+
+def crashpoint(site: str) -> None:
+    """Dispatch-path seam: no-op unless a plan is armed via inject()."""
+    if _active is not None:
+        _active.crashpoint(site)
+
+
+@contextmanager
+def inject(plan_or_injector):
+    """Arm a plan (or a prebuilt injector) for the dynamic extent."""
+    global _active
+    inj = (
+        plan_or_injector
+        if isinstance(plan_or_injector, FaultInjector)
+        else FaultInjector(plan_or_injector)
+    )
+    prev = _active
+    _active = inj
+    try:
+        yield inj
+    finally:
+        _active = prev

@@ -19,6 +19,8 @@ import numpy as np
 from holo_tpu import telemetry
 from holo_tpu.analysis.runtime import sanctioned_transfer
 from holo_tpu.ops.graph import Topology, build_ell
+from holo_tpu.resilience import faults
+from holo_tpu.resilience.breaker import CircuitBreaker
 from holo_tpu.ops.spf_engine import (
     DeviceGraph,
     device_graph_from_ell,
@@ -172,6 +174,7 @@ class TpuSpfBackend(SpfBackend):
         max_iters: int | None = None,
         engine: str = "gather",
         one_engine: str = "seq",
+        breaker: CircuitBreaker | None = None,
     ):
         """``engine``: 'gather' (ELL gathers; handles any topology) or
         'blocked' (block-sparse Pallas kernels; fastest on large LSDBs,
@@ -183,11 +186,20 @@ class TpuSpfBackend(SpfBackend):
         bit-identical, differing only in TPU round/gather scheduling.
         'seq' is the default: it is the fastest measured formulation on
         the only platform benchmarked so far (JAX-CPU; BENCH_r03) — flip
-        per-platform only once a TPU run shows another engine winning."""
+        per-platform only once a TPU run shows another engine winning.
+
+        ``breaker`` guards every device dispatch: XLA exceptions and
+        deadline overruns fall back to the scalar oracle (bit-identical
+        by the parity contract), and repeated failures open the circuit
+        so a dead relay stops being retried on the SPF hot path."""
         self.n_atoms = n_atoms
         self.max_iters = max_iters
         self.engine = engine
         self.one_engine = one_engine
+        self.breaker = (
+            breaker if breaker is not None else CircuitBreaker("spf-dispatch")
+        )
+        self._oracle = ScalarSpfBackend(n_atoms)
         self._blocked_cache: dict[tuple, object] = {}
         self._jit_blocked = None  # built lazily (pallas import)
         # (kind, shape...) signatures already dispatched: a miss here is
@@ -239,7 +251,35 @@ class TpuSpfBackend(SpfBackend):
             return np.ones(topo.n_edges, bool)
         return np.asarray(edge_mask, bool)
 
+    # Public entry points run under the circuit breaker: an XLA failure
+    # or deadline overrun transparently re-runs the batch on the scalar
+    # oracle (RIB output unchanged by construction — the parity suites
+    # pin the two backends bit-identical), and repeated failures open
+    # the circuit so a dead device stops being retried per-SPF.
+
     def compute(self, topo, edge_mask=None):
+        return self.breaker.call(
+            lambda: self._device_compute(topo, edge_mask),
+            lambda: self._oracle.compute(topo, edge_mask),
+            context="spf.one",
+        )
+
+    def compute_whatif(self, topo, edge_masks):
+        return self.breaker.call(
+            lambda: self._device_whatif(topo, edge_masks),
+            lambda: self._oracle.compute_whatif(topo, edge_masks),
+            context="spf.whatif",
+        )
+
+    def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
+        return self.breaker.call(
+            lambda: self._device_multiroot(topo, roots),
+            lambda: self._oracle.compute_multiroot(topo, roots),
+            context="spf.multiroot",
+        )
+
+    def _device_compute(self, topo, edge_mask=None):
+        faults.crashpoint("spf.dispatch")
         if self.engine == "blocked":
             res = self._whatif_blocked(
                 topo, self._full_mask(topo, edge_mask)[None, :]
@@ -339,7 +379,8 @@ class TpuSpfBackend(SpfBackend):
             for i in range(dist.shape[0])
         ]
 
-    def compute_whatif(self, topo, edge_masks):
+    def _device_whatif(self, topo, edge_masks):
+        faults.crashpoint("spf.dispatch")
         if self.engine == "blocked":
             res = self._whatif_blocked(topo, edge_masks)
             if res is not None:
@@ -376,7 +417,7 @@ class TpuSpfBackend(SpfBackend):
             for i in range(masks.shape[0])
         ]
 
-    def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
+    def _device_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
         """Distances/parents/hops from many roots (one device program).
 
         Next-hop bitmasks are intentionally NOT returned: direct atoms are
@@ -384,6 +425,7 @@ class TpuSpfBackend(SpfBackend):
         other root.  Multi-root users (IS-IS flooding reduction, TI-LFA)
         need the SPT shape only.
         """
+        faults.crashpoint("spf.dispatch")
         t0 = time.perf_counter()
         with telemetry.span(
             "spf.dispatch", kind="multiroot", backend="tpu", roots=len(roots)

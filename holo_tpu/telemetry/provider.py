@@ -10,6 +10,9 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
         name                     # counter/gauge: bare family name;
         value                    #   histograms expand to _count/_sum
         labels                   # "k=v,k=v" ("" when label-less)
+      health/                    # resilience summary (ISSUE 4)
+        breakers/<name>/...      # dispatch-breaker state + failure tally
+        supervision/...          # degraded actors, restart counts
 """
 
 from __future__ import annotations
@@ -58,4 +61,17 @@ class TelemetryStateProvider(NbProvider):
                             "labels": labels,
                         }
                     )
-        return {ROOT: {"metric": metrics}}
+        out = {"metric": metrics}
+        health = _resilience_health()
+        if health:
+            out["health"] = health
+        return {ROOT: out}
+
+
+def _resilience_health() -> dict:
+    """Breaker + supervision summary — the health leaf an operator (or
+    an alerting pipeline subscribed over gNMI) watches instead of
+    deriving state from raw counters."""
+    from holo_tpu.resilience import health_snapshot
+
+    return health_snapshot()

@@ -17,11 +17,15 @@ import dataclasses
 import enum
 import importlib
 import json
+import logging
+import os
 import time
 from ipaddress import IPv4Address, IPv4Network, IPv6Address, IPv6Network, ip_address, ip_network
 from pathlib import Path
 
 from holo_tpu.utils.runtime import Actor, EventLoop
+
+log = logging.getLogger("holo_tpu.event_recorder")
 
 
 def _encode_value(v):
@@ -117,10 +121,43 @@ class EventRecorder:
                 self._fh.write(json.dumps(entry) + "\n")
                 self._fh.flush()
         except Exception:
-            pass  # recording must never break the instance
+            # Recording must never break the instance, but a silently
+            # dying journal is a forensics gap worth one debug line
+            # (holo-lint HL106: no swallow-and-continue on actor paths).
+            log.debug("event record failed for %s", actor, exc_info=True)
+
+    def flush(self, sync: bool = True) -> None:
+        """Flush buffered entries; ``sync`` fsyncs so the journal
+        survives a crash-restart cycle (the SIGTERM path calls this
+        before teardown even starts).
+
+        Signal-handler safe: the handler runs on the main thread, which
+        may be interrupted INSIDE record()'s critical section — a
+        blocking acquire here would self-deadlock on the lock our own
+        interrupted frame holds.  Best-effort is correct: record()
+        already flushed every entry to the OS, only the fsync is at
+        stake, and the orderly stop path fsyncs again."""
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            if self._fh.closed:
+                return
+            self._fh.flush()
+            if sync:
+                os.fsync(self._fh.fileno())
+        finally:
+            self._lock.release()
 
     def close(self) -> None:
-        self._fh.close()
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.flush()
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:
+                log.warning("journal fsync failed at close", exc_info=True)
+            self._fh.close()
 
 
 def instrument(loop: EventLoop, recorder: EventRecorder, actors: set[str] | None = None) -> None:
@@ -132,6 +169,13 @@ def instrument(loop: EventLoop, recorder: EventRecorder, actors: set[str] | None
         # logic, recording before handling).
         while loop._ready:
             name = loop._ready[0]
+            if name in loop._crashed:
+                # Mirror the loop's crashed-skip: the token is consumed
+                # without a delivery, so nothing must be journaled for
+                # it (restart_actor re-readies held mail, which is then
+                # recorded at its actual delivery).
+                loop._ready.popleft()
+                continue
             inbox = loop._inboxes.get(name)
             if not inbox:
                 loop._ready.popleft()

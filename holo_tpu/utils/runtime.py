@@ -111,6 +111,13 @@ class Actor:
     def on_stop(self) -> None:
         """Cleanup hook (channel-drop cascade equivalent)."""
 
+    def on_restart(self) -> None:
+        """Supervised-restart hook: called after a crash, before held
+        mail is redelivered.  Default is a no-op — actor state survives
+        the crash (single-writer discipline means it was only ever
+        mutated by the handler that raised); override to re-arm
+        resources the crash may have orphaned."""
+
 
 @dataclass
 class ActorCrashed:
@@ -120,12 +127,32 @@ class ActorCrashed:
     error: BaseException
 
 
+@dataclass
+class PoisonPill:
+    """Fault-injection message: its delivery raises inside the target
+    actor's handler frame, exercising the crash-containment and
+    supervision path exactly as a real handler exception would — the
+    actor-kill seam the chaos harness (holo_tpu.resilience.faults)
+    drives.  Serializes through the event recorder like any message."""
+
+    reason: str = "injected"
+
+
+class InjectedCrash(RuntimeError):
+    """The exception a delivered :class:`PoisonPill` raises."""
+
+
 class EventLoop:
     """Cooperative scheduler: per-actor FIFO inboxes + timer heap + IO.
 
     IO sources register a (fileno, callback) pair; in virtual-clock mode IO
     is driven by tests injecting messages instead (mock sockets).
     """
+
+    # Bound on mail held for a crashed-but-supervised actor: a restart
+    # policy that never fires (or a long backoff) must not let one dead
+    # actor's inbox grow without limit.
+    held_mail_limit = 4096
 
     def __init__(self, clock=None):
         self.clock = clock if clock is not None else RealClock()
@@ -138,6 +165,13 @@ class EventLoop:
         self._supervisor: Callable[[ActorCrashed], None] | None = None
         self._stopping = False
         self._delivered: dict[str, int] = {}
+        # Supervised loops hold mail for crashed actors (redelivered on
+        # restart) instead of refusing it; plain loops keep the original
+        # drop semantics.  Abandoned actors (crash-loop -> permanent
+        # degraded) refuse mail even on supervised loops.
+        self._hold_crashed = False
+        self._abandoned: set[str] = set()
+        self._held_dropped: dict[str, int] = {}
 
     # -- actors
 
@@ -155,19 +189,85 @@ class EventLoop:
         self._inboxes.pop(name, None)
         self._crashed.pop(name, None)
         self._delivered.pop(name, None)
+        self._abandoned.discard(name)
+        self._held_dropped.pop(name, None)
         if actor is not None:
             actor.on_stop()
 
-    def set_supervisor(self, fn: Callable[[ActorCrashed], None]) -> None:
+    def set_supervisor(
+        self,
+        fn: Callable[[ActorCrashed], None],
+        hold_crashed: bool = False,
+    ) -> None:
+        """Install the crash-notice callback.  ``hold_crashed`` opts the
+        loop into held mail: sends to a crashed actor queue (bounded by
+        :attr:`held_mail_limit`) for redelivery at :meth:`restart_actor`
+        — the timer re-arm chains protocol actors depend on (hello ->
+        handler -> re-arm) survive a supervised restart this way."""
         self._supervisor = fn
+        self._hold_crashed = bool(hold_crashed)
+
+    def restart_actor(self, name: str) -> bool:
+        """Clear an actor's crashed state and redeliver held mail.
+
+        The supervision restart primitive: state is NOT reset (single
+        writer means only the raising handler touched it); the actor's
+        :meth:`Actor.on_restart` hook runs first and a raise there
+        counts as a fresh crash (notifying the supervisor again)."""
+        if name not in self._crashed or name in self._abandoned:
+            return False
+        actor = self.actors.get(name)
+        if actor is None:
+            return False
+        del self._crashed[name]
+        try:
+            actor.on_restart()
+        except Exception as exc:
+            log.exception("actor %s crashed in on_restart", name)
+            self._crashed[name] = exc
+            if self._supervisor:
+                self._supervisor(ActorCrashed(name, exc))
+            return False
+        inbox = self._inboxes.get(name)
+        if inbox:
+            self._ready.extend([name] * len(inbox))
+        return True
+
+    def abandon_actor(self, name: str) -> None:
+        """Permanent-degraded: drop held mail and refuse future sends
+        (the crash-loop terminal state; only unregister clears it)."""
+        self._abandoned.add(name)
+        inbox = self._inboxes.get(name)
+        if inbox:
+            inbox.clear()
 
     # -- messaging
 
     def send(self, actor: str, msg: Any) -> bool:
-        """Enqueue msg to actor's inbox; False if actor unknown/crashed."""
-        if actor not in self._inboxes or actor in self._crashed:
+        """Enqueue msg to actor's inbox; False if actor unknown/crashed
+        (crashed-but-supervised actors hold mail, see set_supervisor)."""
+        inbox = self._inboxes.get(actor)
+        if inbox is None or actor in self._abandoned:
             return False
-        self._inboxes[actor].append(msg)
+        if actor in self._crashed:
+            if self._hold_crashed:
+                if len(inbox) >= self.held_mail_limit:
+                    self._held_dropped[actor] = (
+                        self._held_dropped.get(actor, 0) + 1
+                    )
+                    return False
+                inbox.append(msg)  # no _ready entry until restart
+                if actor not in self._crashed:
+                    # Cross-thread race: restart_actor cleared the crash
+                    # between our check and the append.  restart deletes
+                    # _crashed BEFORE it counts the inbox, so seeing it
+                    # cleared here means its token sweep may have missed
+                    # this message — schedule it (surplus tokens are
+                    # harmless, an unscheduled message is lost).
+                    self._ready.append(actor)
+                return True
+            return False
+        inbox.append(msg)
         self._ready.append(actor)
         return True
 
@@ -217,6 +317,10 @@ class EventLoop:
                     "inbox-depth": len(self._inboxes.get(name, ())),
                     "messages-delivered": self._delivered.get(name, 0),
                     "crashed": name in self._crashed,
+                    # Mail refused at held_mail_limit while the actor
+                    # was down — the operator's lost-messages signal
+                    # during a long restart backoff.
+                    "held-mail-dropped": self._held_dropped.get(name, 0),
                 }
                 for name in self.actors
             },
@@ -231,6 +335,12 @@ class EventLoop:
     def _deliver_one(self) -> bool:
         while self._ready:
             name = self._ready.popleft()
+            if name in self._crashed:
+                # Crash containment covers the whole backlog: messages
+                # queued BEFORE the crash stay in the inbox (their ready
+                # tokens are consumed here; restart_actor re-readies the
+                # full inbox), a crashed handler must not keep running.
+                continue
             inbox = self._inboxes.get(name)
             if not inbox:
                 continue
@@ -240,6 +350,8 @@ class EventLoop:
                 continue
             self._delivered[name] = self._delivered.get(name, 0) + 1
             try:
+                if isinstance(msg, PoisonPill):
+                    raise InjectedCrash(msg.reason)
                 actor.handle(msg)
             except Exception as exc:  # crash containment
                 log.exception("actor %s crashed", name)

@@ -6,10 +6,12 @@ decoupled from the kernel send, a slow interface exerts backpressure on
 its own producers only, and per-interface ordering is preserved.
 
 :class:`TxTaskNetIo` is the NetIo-wrapping analog: one daemon thread +
-bounded queue per interface, created lazily on first send.  A full
-queue blocks the producer (the reference's bounded mpsc semantics) —
-never drops — and `close()` drains each queue before joining so no
-accepted packet is lost.
+bounded queue per interface, created lazily on first send.  By default
+a full queue blocks the producer (the reference's bounded mpsc
+semantics); an optional ``put_timeout`` bounds that blocking and drops
+on expiry instead.  `close()` drains each queue before joining.  Every
+drop is cause-attributed (``overflow`` / ``send_error`` for a packet
+the wire send lost / ``closed`` for late sends after teardown).
 """
 
 from __future__ import annotations
@@ -23,8 +25,12 @@ from holo_tpu.utils.netio import NetIo
 _STOP = object()
 
 # Per-interface Tx task observability: queue depth is the backpressure
-# signal (a climbing depth = the wire can't keep up with production);
-# drops only happen for late sends after close().
+# signal (a climbing depth = the wire can't keep up with production).
+# Drops carry a cause so an incident can be attributed without a
+# packet capture: "overflow" (bounded enqueue timed out against a
+# wedged wire), "send_error" (the kernel send raised — the breaker's
+# degraded path surfaces here when a dead interface eats the retry),
+# "closed" (late send after teardown).
 _TX_SENT = telemetry.counter(
     "holo_txqueue_sent_total", "Packets sent by per-interface Tx tasks", ("ifname",)
 )
@@ -32,7 +38,9 @@ _TX_ERRORS = telemetry.counter(
     "holo_txqueue_errors_total", "Tx task sends that raised", ("ifname",)
 )
 _TX_DROPPED = telemetry.counter(
-    "holo_txqueue_dropped_total", "Sends dropped after close()", ("ifname",)
+    "holo_txqueue_dropped_total",
+    "Packets dropped by per-interface Tx tasks, by cause",
+    ("ifname", "cause"),
 )
 _TX_DEPTH = telemetry.gauge(
     "holo_txqueue_depth", "Tx queue depth at last enqueue", ("ifname",)
@@ -61,7 +69,11 @@ class _IfaceTxTask:
                 self.sent += 1
                 _TX_SENT.labels(ifname=self.ifname).inc()
             except Exception:  # noqa: BLE001 — a bad send must not kill tx
+                # The accepted packet is gone: attribute the loss.
                 _TX_ERRORS.labels(ifname=self.ifname).inc()
+                _TX_DROPPED.labels(
+                    ifname=self.ifname, cause="send_error"
+                ).inc()
 
     def request_stop(self) -> None:
         try:
@@ -83,9 +95,21 @@ class TxTaskNetIo(NetIo):
     """NetIo decorator: routes each interface's sends through its own
     bounded Tx task (reference tasks.rs per-interface Tx channels)."""
 
-    def __init__(self, inner: NetIo, maxsize: int = 256):
+    def __init__(
+        self,
+        inner: NetIo,
+        maxsize: int = 256,
+        put_timeout: float | None = None,
+    ):
+        """``put_timeout`` bounds how long a producer blocks against a
+        full queue: None (default) keeps the reference's block-forever
+        backpressure; a number makes the enqueue drop after that many
+        seconds with cause="overflow" — the posture for producers that
+        must not wedge behind a dead wire (e.g. a degraded daemon
+        draining at shutdown)."""
         self.inner = inner
         self.maxsize = maxsize
+        self.put_timeout = put_timeout
         self._tasks: dict[str, _IfaceTxTask] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -109,11 +133,22 @@ class TxTaskNetIo(NetIo):
         # instance handler that outlived its 5s teardown join) is
         # dropped: resurrecting a task here would leak its thread.
         t = self._task(ifname)
-        if t is not None:
-            t.q.put((src, dst, data))
+        if t is None:
+            _TX_DROPPED.labels(ifname=ifname, cause="closed").inc()
+            return
+        try:
+            if self.put_timeout is None:
+                t.q.put((src, dst, data))
+            else:
+                t.q.put((src, dst, data), timeout=self.put_timeout)
+        except queue.Full:
+            _TX_DROPPED.labels(ifname=ifname, cause="overflow").inc()
+            # The gauge must show the pinned-full queue during the very
+            # incident the drop cause attributes, not the depth of the
+            # last successful enqueue.
             _TX_DEPTH.labels(ifname=ifname).set(t.q.qsize())
-        else:
-            _TX_DROPPED.labels(ifname=ifname).inc()
+            return
+        _TX_DEPTH.labels(ifname=ifname).set(t.q.qsize())
 
     def __getattr__(self, name: str):
         # Forward everything we don't override to the wrapped NetIo:

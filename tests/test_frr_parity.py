@@ -117,6 +117,28 @@ def test_padding_is_result_neutral():
     assert_table_parity(a, ta)
 
 
+def test_forced_frr_dispatch_failure_scalar_fallback_bit_identical():
+    """ISSUE 4 satellite (FRR side): a forced kernel-dispatch failure
+    falls back to the oracle over the SAME marshaled inputs — every
+    backup-table plane byte-identical to an uninterrupted scalar run."""
+    from holo_tpu.resilience import CircuitBreaker, FaultPlan, inject
+
+    topo = grid_topology(4, 4, seed=1)
+    scalar = FrrEngine("scalar", N_ATOMS).compute(topo)
+    eng = FrrEngine(
+        "tpu", N_ATOMS, breaker=CircuitBreaker("frr-parity-fallback")
+    )
+    with inject(FaultPlan(dispatch_fail={"frr.dispatch": 1})) as inj:
+        got = eng.compute(topo)
+    assert inj.injected["frr.dispatch"] == 1
+    assert_table_parity(scalar, got)
+    assert eng.breaker.consecutive_failures == 1
+    assert eng.breaker.state == "closed"
+    got2 = eng.compute(topo)  # healthy: device kernel again
+    assert_table_parity(scalar, got2)
+    assert eng.breaker.consecutive_failures == 0
+
+
 def test_lfa_never_uses_protected_interface():
     for seed in range(3):
         topo = random_ospf_topology(n_routers=9, n_networks=3, seed=seed)

@@ -281,6 +281,81 @@ def test_hl105_eager_metric():
     assert_triple("HL105", HL105_BAD, HL105_SUPPRESSED, HL105_CLEAN, OPS)
 
 
+# -- HL106: swallow-and-continue on dispatch/actor-loop code ------------
+
+HL106_BAD = """
+    def dispatch_batch(self, g, masks):
+        try:
+            return self._jit_batch(g, masks)
+        except Exception:
+            pass
+"""
+HL106_SUPPRESSED = """
+    def dispatch_batch(self, g, masks):
+        try:
+            return self._jit_batch(g, masks)
+        except Exception:  # holo-lint: disable=HL106
+            pass
+"""
+HL106_CLEAN = """
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def dispatch_batch(self, g, masks):
+        try:
+            return self._jit_batch(g, masks)
+        except Exception:
+            log.exception("dispatch failed; falling back")
+            return self._oracle(g, masks)
+"""
+
+
+def test_hl106_swallowed_exception():
+    assert_triple("HL106", HL106_BAD, HL106_SUPPRESSED, HL106_CLEAN, OPS)
+
+
+def test_hl106_bare_except_and_tuple_forms():
+    src = """
+        def pump(self):
+            try:
+                self.step()
+            except:
+                pass
+
+        def pump2(self):
+            try:
+                self.step()
+            except (ValueError, Exception):
+                ...
+    """
+    findings = lint(src, DAEMON).findings
+    assert sum(f.rule == "HL106" for f in findings) == 2
+
+
+def test_hl106_narrow_or_handled_is_clean():
+    src = """
+        import queue
+
+        def pump(self):
+            try:
+                self.q.put(1, timeout=5)
+            except queue.Full:
+                pass  # narrow: a deliberate, understood case
+
+        def pump2(self):
+            try:
+                self.step()
+            except Exception:
+                self.crashed += 1
+    """
+    assert "HL106" not in rules_fired(src, DAEMON)
+
+
+def test_hl106_out_of_scope_module_is_ignored():
+    assert rules_fired(HL106_BAD, OUTSIDE) == set()
+
+
 # -- HL201: attribute mutated outside its owning lock -------------------
 
 HL201_BAD = """

@@ -1,0 +1,235 @@
+"""Chaos e2e: the resilience subsystem under injected failure.
+
+The acceptance scenario (ISSUE 4): kill a protocol actor AND force >= 3
+consecutive TPU dispatch failures — the run must end with the actor
+restarted (restart counter > 0), the breaker OPEN then restored via a
+half-open probe, and the final RIB bit-identical to a clean
+scalar-oracle run of the same topology events.
+
+Plus the harness's own guarantee: the same FaultPlan seed produces an
+identical event-recorder sequence across two runs (chaos results must
+be replayable), and OSPF reconverges through packet loss.
+"""
+
+import json
+from contextlib import nullcontext
+from ipaddress import IPv4Address as A
+from ipaddress import IPv4Network as N
+
+from holo_tpu.protocols.ospf.instance import (
+    IfConfig,
+    IfUpMsg,
+    InstanceConfig,
+    OspfInstance,
+)
+from holo_tpu.protocols.ospf.interface import IfType
+from holo_tpu.resilience import (
+    CircuitBreaker,
+    FaultInjector,
+    FaultPlan,
+    RestartPolicy,
+    Supervisor,
+    inject,
+)
+from holo_tpu.routing.rib import MockKernel, RibManager
+from holo_tpu.utils.event_recorder import EventRecorder, instrument, read_entries
+from holo_tpu.utils.ibus import Ibus
+from holo_tpu.utils.netio import MockFabric
+from holo_tpu.utils.runtime import EventLoop, VirtualClock
+from holo_tpu.utils.southbound import Protocol
+
+AREA0 = A("0.0.0.0")
+DEST = N("10.0.23.0/30")  # the r2--r3 subnet, primary via r2 from r1
+
+
+def triangle(loop, fabric, r1_backend=None):
+    """r1--r2 (10), r2--r3 (10), r1--r3 (100); r1 optionally computes
+    SPF on an injected (breaker-guarded TPU) backend."""
+    buses, kernels, ribs, routers = {}, {}, {}, {}
+    for name, rid in [("r1", "1.1.1.1"), ("r2", "2.2.2.2"), ("r3", "3.3.3.3")]:
+        bus = Ibus(loop)
+        k = MockKernel()
+        rib = RibManager(bus, k)
+        rib.name = f"routing-{name}"
+        loop.register(rib)
+        inst = OspfInstance(
+            name=name,
+            config=InstanceConfig(router_id=A(rid)),
+            netio=fabric.sender_for(name),
+            spf_backend=r1_backend if name == "r1" else None,
+        )
+        loop.register(inst)
+        inst.attach_ibus(bus, routing_actor=rib.name)
+        buses[name], kernels[name], ribs[name], routers[name] = bus, k, rib, inst
+
+    cfg = lambda c: IfConfig(if_type=IfType.POINT_TO_POINT, cost=c)
+    r1, r2, r3 = routers["r1"], routers["r2"], routers["r3"]
+    r1.add_interface("e0", cfg(10), N("10.0.12.0/30"), A("10.0.12.1"))
+    r2.add_interface("e0", cfg(10), N("10.0.12.0/30"), A("10.0.12.2"))
+    r2.add_interface("e1", cfg(10), N("10.0.23.0/30"), A("10.0.23.1"))
+    r3.add_interface("e0", cfg(10), N("10.0.23.0/30"), A("10.0.23.2"))
+    r1.add_interface("e1", cfg(100), N("10.0.13.0/30"), A("10.0.13.1"))
+    r3.add_interface("e1", cfg(100), N("10.0.13.0/30"), A("10.0.13.2"))
+    fabric.join("l12", "r1", "e0", A("10.0.12.1"))
+    fabric.join("l12", "r2", "e0", A("10.0.12.2"))
+    fabric.join("l23", "r2", "e1", A("10.0.23.1"))
+    fabric.join("l23", "r3", "e0", A("10.0.23.2"))
+    fabric.join("l13", "r1", "e1", A("10.0.13.1"))
+    fabric.join("l13", "r3", "e1", A("10.0.13.2"))
+    for r in routers.values():
+        for area in r.areas.values():
+            for ifname in area.interfaces:
+                loop.send(r.name, IfUpMsg(ifname))
+    return buses, kernels, ribs, routers
+
+
+def test_chaos_actor_kill_breaker_cycle_and_rib_parity():
+    """THE acceptance scenario.  The chaos arm and the clean control arm
+    see the SAME topology events; the control's r1 computes on the
+    scalar oracle throughout, so final-FIB equality IS the 'RIB
+    bit-identical to the scalar oracle' contract."""
+
+    def scenario(chaos: bool):
+        from holo_tpu.spf.backend import TpuSpfBackend
+
+        loop = EventLoop(clock=VirtualClock())
+        fabric = MockFabric(loop)
+        breaker = sup = backend = None
+        if chaos:
+            breaker = CircuitBreaker(
+                "spf-chaos",
+                failure_threshold=3,
+                recovery_timeout=30.0,
+                clock=loop.clock.now,
+            )
+            backend = TpuSpfBackend(64, breaker=breaker)
+            sup = Supervisor(
+                RestartPolicy(base_delay=1.0, jitter=0.1)
+            ).install(loop)
+        buses, kernels, ribs, routers = triangle(loop, fabric, backend)
+        loop.advance(90)  # converge
+
+        inj = FaultInjector(
+            FaultPlan(seed=11, dispatch_fail={"spf.dispatch": 3})
+        )
+        if chaos:
+            # Kill the protocol actor: the pill crashes r1 inside its
+            # handler; supervision restarts it after ~1s backoff with
+            # the in-flight mail held and redelivered.
+            inj.kill_actor(loop, "r1")
+            loop.run_until_idle()
+            assert "r1" in loop._crashed
+        loop.advance(5)
+        if chaos:
+            assert "r1" not in loop._crashed
+            assert sup.restarts["r1"] > 0, "restart counter must move"
+
+        # Three LSDB changes -> three r1 SPF runs, each a forced TPU
+        # dispatch failure served bit-identically by the scalar oracle.
+        with inject(inj) if chaos else nullcontext():
+            for third_octet in (110, 111, 112):
+                routers["r3"].interface_address_add(
+                    "e0", N(f"192.168.{third_octet}.0/24")
+                )
+                loop.advance(15)
+            if chaos:
+                assert breaker.state == "open", (
+                    f"3 consecutive failures must open the circuit "
+                    f"(spf runs: {routers['r1'].spf_run_count})"
+                )
+            # While OPEN the device is not attempted (the forced-failure
+            # budget is exhausted — any attempt now would SUCCEED and
+            # close the circuit early, so staying open proves the
+            # short-circuit).
+            routers["r3"].interface_address_add("e0", N("192.168.113.0/24"))
+            loop.advance(15)
+            if chaos:
+                assert breaker.state == "open"
+            # Recovery: past the timeout the next SPF run is the
+            # half-open probe; the device is healthy again (injector
+            # still armed, budget spent) so service restores.
+            loop.advance(31)
+            routers["r3"].interface_address_add("e0", N("192.168.114.0/24"))
+            loop.advance(15)
+        if chaos:
+            assert breaker.state == "closed", "half-open probe must restore"
+            assert inj.injected["spf.dispatch"] == 3
+        loop.advance(30)  # settle
+        return kernels, routers
+
+    chaos_kernels, chaos_routers = scenario(chaos=True)
+    clean_kernels, clean_routers = scenario(chaos=False)
+
+    # The chaos run converged at all...
+    fib = chaos_kernels["r1"].fib
+    assert DEST in fib and fib[DEST][1] == Protocol.OSPFV2
+    assert N("192.168.114.0/24") in fib
+    # ...and every router's final FIB is bit-identical to the clean
+    # scalar-oracle run over the same topology events.
+    for name in ("r1", "r2", "r3"):
+        assert chaos_kernels[name].fib == clean_kernels[name].fib, name
+
+
+def _recorded_run(tmp_path, tag: str):
+    """One seeded chaos run with the journal on: packet drops, delayed
+    ibus deliveries, jittered time, and an actor kill + restart."""
+    plan = FaultPlan(
+        seed=5,
+        drop_prob=0.12,
+        publish_delay=0.3,
+        publish_delay_prob=1.0,  # ibus traffic is sparse: defer all of it
+        timer_jitter=0.4,
+    )
+    inj = FaultInjector(plan)
+    loop = EventLoop(clock=VirtualClock())
+    rec = EventRecorder(tmp_path / f"events-{tag}.jsonl")
+    instrument(loop, rec)
+    fabric = MockFabric(loop)
+    inj.wire_fabric(fabric)
+    sup = Supervisor(RestartPolicy(base_delay=1.0, jitter=0.2)).install(loop)
+    buses, kernels, ribs, routers = triangle(loop, fabric)
+    inj.wrap_ibus(buses["r1"])
+    with inject(inj):
+        inj.jittered_advance(loop, 90, steps=18)
+        inj.kill_actor(loop, "r1")
+        loop.run_until_idle()
+        inj.jittered_advance(loop, 40, steps=8)
+    rec.close()
+    assert sup.restarts.get("r1", 0) == 1
+    assert inj.injected.get("fabric.drop", 0) > 0, "loss must actually fire"
+    assert inj.injected.get("ibus.delay", 0) > 0
+    # Chaos or not, the network converged.
+    assert {str(nh.addr) for nh in kernels["r1"].fib[DEST][0]} == {"10.0.12.2"}
+    return [
+        (e["actor"], e["time"], json.dumps(e["msg"], sort_keys=True))
+        for e in read_entries(tmp_path / f"events-{tag}.jsonl")
+    ], dict(inj.injected)
+
+
+def test_same_fault_plan_seed_identical_event_sequence(tmp_path):
+    """The harness's own determinism contract: two runs of one seeded
+    plan journal byte-identical (actor, time, message) sequences —
+    guarding the chaos machinery itself against nondeterminism."""
+    seq_a, injected_a = _recorded_run(tmp_path, "a")
+    seq_b, injected_b = _recorded_run(tmp_path, "b")
+    assert injected_a == injected_b
+    assert len(seq_a) > 100, "the scenario must actually exercise the loop"
+    assert seq_a == seq_b
+
+
+def test_ospf_reconverges_through_packet_loss():
+    """Convergence-under-failure, the metric that matters: with a lossy
+    wire AND a link failure mid-run, retransmission machinery still
+    reconverges every router onto the surviving path."""
+    plan = FaultPlan(seed=9, drop_prob=0.10, timer_jitter=0.3)
+    inj = FaultInjector(plan)
+    loop = EventLoop(clock=VirtualClock())
+    fabric = MockFabric(loop)
+    inj.wire_fabric(fabric)
+    buses, kernels, ribs, routers = triangle(loop, fabric)
+    inj.jittered_advance(loop, 150, steps=15)
+    assert {str(nh.addr) for nh in kernels["r1"].fib[DEST][0]} == {"10.0.12.2"}
+    # The r1--r2 link dies under continuing loss: r1 must end on r3.
+    fabric.set_link_up("l12", False)
+    inj.jittered_advance(loop, 120, steps=12)
+    assert {str(nh.addr) for nh in kernels["r1"].fib[DEST][0]} == {"10.0.13.2"}

@@ -146,6 +146,33 @@ def test_atom_overflow_rejected():
         build_ell(topo, n_atoms=1)
 
 
+def test_forced_dispatch_failure_scalar_fallback_bit_identical():
+    """ISSUE 4 satellite: a forced mid-batch dispatch failure must be
+    served by the breaker's scalar fallback with results byte-identical
+    to an uninterrupted scalar run — the RIB cannot tell the difference.
+    The next healthy dispatch runs on the device again (closed breaker,
+    failure streak reset)."""
+    from holo_tpu.resilience import CircuitBreaker, FaultPlan, inject
+
+    topo = random_ospf_topology(n_routers=14, n_networks=4, seed=3)
+    masks = whatif_link_failure_masks(topo, n_scenarios=6, seed=3)
+    scalar = ScalarSpfBackend(N_ATOMS).compute_whatif(topo, masks)
+    be = TpuSpfBackend(
+        N_ATOMS, breaker=CircuitBreaker("spf-parity-fallback")
+    )
+    with inject(FaultPlan(dispatch_fail={"spf.dispatch": 1})) as inj:
+        got = be.compute_whatif(topo, masks)
+    assert inj.injected["spf.dispatch"] == 1, "the failure must have fired"
+    for s, t in zip(scalar, got):
+        assert_parity(topo, s, t)
+    assert be.breaker.consecutive_failures == 1
+    assert be.breaker.state == "closed"
+    got2 = be.compute_whatif(topo, masks)  # healthy: device path again
+    for s, t in zip(scalar, got2):
+        assert_parity(topo, s, t)
+    assert be.breaker.consecutive_failures == 0
+
+
 def test_multiroot_matches_per_root():
     topo = random_ospf_topology(n_routers=12, n_networks=3, seed=7)
     roots = np.array(

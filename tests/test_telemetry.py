@@ -489,7 +489,7 @@ def test_txqueue_and_ibus_metrics():
     tx.send("eth9", None, None, b"late")  # after close: counted drop
     assert (
         telemetry.snapshot(prefix="holo_txqueue")[
-            "holo_txqueue_dropped_total{ifname=eth9}"
+            "holo_txqueue_dropped_total{ifname=eth9,cause=closed}"
         ]
         >= 1
     )
