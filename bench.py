@@ -61,6 +61,7 @@ STAGE_TIMEOUT = {
     "frr_batch": 900,
     "telemetry_overhead": 900,
     "fallback_overhead": 900,
+    "profiling_overhead": 900,
 }
 
 
@@ -582,6 +583,57 @@ def stage_fallback_overhead(k, B, reps=15):
     }
 
 
+def stage_profiling_overhead(k, B, reps=15):
+    """ISSUE 5 acceptance row: the SPF dispatch path with the deep
+    profiler armed (marshal/device/readback sub-spans + exemplars) AND
+    the flight recorder ring tapping every span, against the same path
+    with both off.  Same interleaved min-of-N discipline as
+    telemetry_overhead; ok requires overhead < 2% and the on-arm ring
+    actually capturing spans (an empty ring would gate nothing)."""
+    from holo_tpu import telemetry
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.telemetry import flight, profiling
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    # Warm with profiling ON: the compile AND its one-off cost-analysis
+    # capture both land here, outside the timed region.
+    profiling.set_device_profiling(True)
+    flight.configure(entries=4096)
+    backend.compute_whatif(topo, masks)
+    on_times, off_times = [], []
+    for rep in range(reps):
+        arms = ((True, on_times), (False, off_times))
+        for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+            profiling.set_device_profiling(armed)
+            if not armed:
+                telemetry.tracer().on_complete = None  # detach the tap
+            else:
+                flight.configure(entries=4096)
+            t0 = time.perf_counter()
+            backend.compute_whatif(topo, masks)
+            times.append(time.perf_counter() - t0)
+    profiling.set_device_profiling(True)
+    ring_entries = flight.recorder().stats()["entries"]
+    cost_sites = sorted({site for site, _ in profiling.cost_table()})
+    profiling.set_device_profiling(False)
+    flight.configure(entries=0)
+    on_ms = float(np.min(on_times) * 1e3)
+    off_ms = float(np.min(off_times) * 1e3)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
+    return {
+        "ok": bool(overhead_pct < 2.0 and ring_entries > 0),
+        "enabled_ms": round(on_ms, 3),
+        "disabled_ms": round(off_ms, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "flight_ring_entries": ring_entries,
+        "cost_sites": cost_sites,
+        "batch": int(B),
+        "reps": reps,
+        "telemetry": telemetry.snapshot(prefix="holo_profile"),
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -659,6 +711,9 @@ def main() -> None:
             "fallback_overhead": lambda: stage_fallback_overhead(
                 k10, 32 if small else 64
             ),
+            "profiling_overhead": lambda: stage_profiling_overhead(
+                k10, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -704,6 +759,11 @@ def main() -> None:
         )
         extra["telemetry_overhead_jaxcpu_small"] = _run_stage(
             "telemetry_overhead", True, cpu=True
+        )
+        # Deep-profiling + flight-recorder gate (ISSUE 5): host-side
+        # instrumentation, platform-independent — same story.
+        extra["profiling_overhead_jaxcpu_small"] = _run_stage(
+            "profiling_overhead", True, cpu=True
         )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -781,6 +841,10 @@ def main() -> None:
     # around the device dispatch must stay within noise (<2%) of a
     # bypassed breaker.
     extra["fallback_overhead"] = _run_stage("fallback_overhead", small)
+    # Deep-profiling + flight-recorder gate (ISSUE 5): sub-spans,
+    # exemplars, and the span-tap ring must stay within noise (<2%) of
+    # the un-profiled dispatch path.
+    extra["profiling_overhead"] = _run_stage("profiling_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

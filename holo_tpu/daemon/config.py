@@ -63,6 +63,17 @@ class TelemetryConfig:
     # Path for a Chrome trace-event JSON span dump written at daemon
     # stop (None = no dump; HOLO_TPU_TRACE_DUMP env overrides).
     trace_dump: str | None = None
+    # Flight recorder (ISSUE 5): > 0 arms a bounded in-memory ring of
+    # recent spans / journal markers / resilience events; breaker-open,
+    # crash-loop degrade, and SIGTERM then dump a postmortem JSON
+    # bundle to postmortem-dir (render: holo-tpu-tools postmortem).
+    flight_buffer_entries: int = 0
+    postmortem_dir: str | None = None
+    # Per-dispatch device-time breakdown (marshal / device / readback
+    # sub-spans + compile-time FLOP/bytes cost capture).  Off by
+    # default: the enabled path adds a block_until_ready barrier per
+    # dispatch (gated < 2% by bench.py profiling_overhead).
+    profile_device_time: bool = False
 
 
 @dataclass
@@ -154,6 +165,13 @@ class DaemonConfig:
             cfg.telemetry.enabled = t.get("enabled", False)
             cfg.telemetry.address = t.get("address", cfg.telemetry.address)
             cfg.telemetry.trace_dump = t.get("trace-dump")
+            cfg.telemetry.flight_buffer_entries = int(
+                t.get("flight-buffer-entries", 0)
+            )
+            cfg.telemetry.postmortem_dir = t.get("postmortem-dir")
+            cfg.telemetry.profile_device_time = t.get(
+                "profile-device-time", False
+            )
         if "resilience" in raw:
             r = raw["resilience"]
             res = cfg.resilience

@@ -160,6 +160,25 @@ class Daemon:
             )
             instrument(self.loop, self.recorder)
 
+        # Flight recorder + deep profiling ([telemetry], ISSUE 5): the
+        # ring is armed here (process-wide — breaker/supervisor/SIGTERM
+        # postmortem triggers all reach the same recorder) with THIS
+        # daemon's loop clock, so virtual-clock runs produce
+        # deterministic bundles and production stamps real time.
+        tcfg = self.config.telemetry
+        if tcfg.flight_buffer_entries:
+            from holo_tpu.telemetry import flight
+
+            flight.configure(
+                entries=tcfg.flight_buffer_entries,
+                postmortem_dir=tcfg.postmortem_dir,
+                clock=self.loop.clock.now,
+            )
+        if tcfg.profile_device_time:
+            from holo_tpu.telemetry import profiling
+
+            profiling.set_device_profiling(True)
+
         # Actor supervision ([resilience], holo_tpu/resilience/): crashed
         # protocol actors restart under an exponential-backoff policy
         # with deterministic jitter; crash loops park the actor in a
@@ -584,6 +603,8 @@ def main(argv=None):
         p for p in daemon.northbound.providers
         if isinstance(p, _RuntimeStateProvider)
     )
+    from holo_tpu.telemetry import flight as _flight
+
     _h.install_signal_handlers(
         lambda: stopping.append(True),
         dump_cb=lambda: rt_provider.get_state().get("holo-runtime"),
@@ -592,6 +613,9 @@ def main(argv=None):
         flush_cb=(
             daemon.recorder.flush if daemon.recorder is not None else None
         ),
+        # Then freeze the flight ring to a bundle (no-op unless
+        # [telemetry] flight-buffer-entries + postmortem-dir are set).
+        postmortem_cb=lambda: _flight.trigger("sigterm"),
     )
     try:
         import time

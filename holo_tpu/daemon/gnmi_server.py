@@ -37,8 +37,79 @@ _SUB_DROPS = telemetry.counter(
 _SUBSCRIBERS = telemetry.gauge(
     "holo_gnmi_subscribers", "Active gNMI Subscribe streams"
 )
+_SAMPLE_UPDATES = telemetry.counter(
+    "holo_gnmi_sample_updates_total",
+    "Leaf updates pushed by SAMPLE / heartbeat subscription timers",
+    ("mode",),
+)
 
 SUBSCRIBE_QUEUE_DEPTH = 256
+# SAMPLE subscriptions leaving sample_interval at 0 get the
+# target-chosen default (gNMI spec wording); a floor keeps a hostile
+# 1ns interval from spinning the stream thread.
+DEFAULT_SAMPLE_INTERVAL = 1.0
+MIN_SAMPLE_INTERVAL = 0.01
+
+
+class _SubSampler:
+    """Per-subscription STREAM timer state (gNMI 0.8 semantics).
+
+    - ``SAMPLE``: push the subscribed subtree's scalar leaves every
+      ``sample_interval`` (ns).  With ``suppress_redundant`` only leaves
+      whose value changed since the last push go out; a non-zero
+      ``heartbeat_interval`` forces a full resend at each beat so a
+      quiet leaf still proves liveness.
+    - ``ON_CHANGE`` / ``TARGET_DEFINED`` with ``heartbeat_interval``:
+      the notification fanout carries the changes; this timer resends
+      the current (unchanged) leaves at each beat.
+
+    Samplers run on the stream's own generator thread and bypass the
+    bounded fanout queue entirely — gRPC flow control is their
+    backpressure, so the overflow-drop counter keeps meaning exactly
+    "fanout updates lost to a stalled consumer".
+    """
+
+    def __init__(self, sub) -> None:
+        now = time.monotonic()
+        self.path = path_to_str(sub.path)
+        self.suppress = bool(sub.suppress_redundant)
+        self.interval = None
+        if sub.mode == pb.SAMPLE:
+            self.interval = max(
+                sub.sample_interval / 1e9 or DEFAULT_SAMPLE_INTERVAL,
+                MIN_SAMPLE_INTERVAL,
+            )
+        self.heartbeat = (
+            max(sub.heartbeat_interval / 1e9, MIN_SAMPLE_INTERVAL)
+            if sub.heartbeat_interval
+            else None
+        )
+        self.next_sample = now + self.interval if self.interval else None
+        self.next_beat = now + self.heartbeat if self.heartbeat else None
+        self.last: dict[str, object] = {}
+        self.fired = (False, False)  # (beat, sample) of the last advance
+
+    @property
+    def active(self) -> bool:
+        return self.next_sample is not None or self.next_beat is not None
+
+    def next_due(self) -> float | None:
+        due = [t for t in (self.next_sample, self.next_beat) if t is not None]
+        return min(due) if due else None
+
+    def advance_if_due(self, now: float) -> bool:
+        """True when a beat or sample tick is due; advances the timers
+        and remembers which fired (read by the renderer)."""
+        beat = self.next_beat is not None and now >= self.next_beat
+        sample = self.next_sample is not None and now >= self.next_sample
+        if not (beat or sample):
+            return False
+        while self.next_beat is not None and self.next_beat <= now:
+            self.next_beat += self.heartbeat
+        while self.next_sample is not None and self.next_sample <= now:
+            self.next_sample += self.interval
+        self.fired = (beat, sample)
+        return True
 
 
 def path_to_str(path: pb.Path) -> str:
@@ -230,14 +301,83 @@ class GnmiService:
                 and first.subscribe.mode == pb.SubscriptionList.ONCE
             ):
                 return
+            # STREAM: the bounded fanout queue carries on-change
+            # notifications; per-subscription samplers add periodic
+            # SAMPLE pushes and ON_CHANGE heartbeat resends.
+            samplers = self._make_samplers(first)
             while context.is_active():
+                wait = 1.0
+                now = time.monotonic()
+                for s in samplers:
+                    due = s.next_due()
+                    if due is not None:
+                        wait = min(wait, due - now)
                 try:
-                    notif = q.get(timeout=1.0)
+                    notif = q.get(timeout=max(wait, 0.005))
+                    yield pb.SubscribeResponse(update=notif)
                 except queue.Empty:
-                    continue
-                yield pb.SubscribeResponse(update=notif)
+                    pass
+                now = time.monotonic()
+                due = [s for s in samplers if s.advance_if_due(now)]
+                if due:
+                    # One state fetch per distinct path per wake, under
+                    # ONE lock acquisition: N samplers coming due
+                    # together must not serialize N full provider-tree
+                    # walks against the commit path.
+                    states = {}
+                    with self.daemon.lock:
+                        for p in {s.path for s in due}:
+                            states[p] = self.daemon.northbound.get_state(
+                                p or None
+                            )
+                    for s in due:
+                        out = self._sample_notif(s, states[s.path])
+                        if out is not None:
+                            yield pb.SubscribeResponse(update=out)
         finally:
             self._remove_subscriber(q)
+
+    @staticmethod
+    def _make_samplers(first) -> list[_SubSampler]:
+        if first is None or not first.HasField("subscribe"):
+            return []
+        return [
+            s
+            for s in map(_SubSampler, first.subscribe.subscription)
+            if s.active
+        ]
+
+    def _sample_notif(self, s: _SubSampler, state):
+        """Render one due sampler's updates from an already-fetched
+        state tree (None when every leaf was suppressed as redundant)."""
+        beat, sample = s.fired
+        leaves = {
+            p: v
+            for p, v in _walk_leaves("", state)
+            if not s.path
+            or p == s.path
+            or p.startswith((s.path + "/", s.path + "["))
+        }
+        # A heartbeat resends everything; a suppress-redundant sample
+        # pushes only leaves whose value moved since the last push.
+        out = {
+            p: v
+            for p, v in leaves.items()
+            if beat or not (sample and s.suppress and s.last.get(p) == v)
+        }
+        s.last = leaves
+        if not out:
+            return None
+        notif = pb.Notification(timestamp=int(time.time() * 1e9))
+        for p, v in sorted(out.items()):
+            notif.update.add(path=str_to_path(p), val=_typed_value(v))
+        # A beat forcing the resend wins the label even when a sample
+        # tick is due in the same wake — it is what put the unchanged
+        # leaves back on the wire.
+        _SAMPLE_UPDATES.labels(mode="heartbeat" if beat else "sample").inc(
+            len(out)
+        )
+        return notif
 
     def _notify_yang(self, payload: dict) -> None:
         # Protocol YANG notifications ride the same update stream, one

@@ -94,7 +94,9 @@ def drop_privileges(user: str) -> None:
     )
 
 
-def install_signal_handlers(shutdown_cb, dump_cb=None, flush_cb=None) -> None:
+def install_signal_handlers(
+    shutdown_cb, dump_cb=None, flush_cb=None, postmortem_cb=None
+) -> None:
     """SIGINT/SIGTERM -> orderly shutdown; SIGHUP ignored (config is
     transactional via the northbound, not file reload); SIGUSR1 ->
     runtime-introspection dump to the log when ``dump_cb`` is given.
@@ -103,7 +105,10 @@ def install_signal_handlers(shutdown_cb, dump_cb=None, flush_cb=None) -> None:
     state (the event-recorder journal) before the orderly shutdown even
     starts, so the post-mortem trace survives a teardown that hangs or
     a process killed mid-drain — the orderly path in ``Daemon.stop``
-    flushes again after the tx queues drain."""
+    flushes again after the tx queues drain.  ``postmortem_cb`` runs
+    right after it (flight-recorder bundle capture: the journal is
+    synced first so the bundle's journal-tail markers reference entries
+    that are already durable on disk)."""
 
     def _handler(signum, _frame):
         log.info("signal %s: shutting down", signal.Signals(signum).name)
@@ -112,6 +117,11 @@ def install_signal_handlers(shutdown_cb, dump_cb=None, flush_cb=None) -> None:
                 flush_cb()
             except Exception:  # the shutdown must proceed regardless
                 log.exception("shutdown flush failed")
+        if postmortem_cb is not None:
+            try:
+                postmortem_cb()
+            except Exception:  # forensics must not block the shutdown
+                log.exception("shutdown postmortem failed")
         shutdown_cb()
 
     signal.signal(signal.SIGINT, _handler)

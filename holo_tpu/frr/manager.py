@@ -29,6 +29,7 @@ from holo_tpu.frr.kernel import BackupTable
 from holo_tpu.ops.graph import Topology
 from holo_tpu.resilience import faults
 from holo_tpu.resilience.breaker import CircuitBreaker
+from holo_tpu.telemetry import profiling
 
 # FRR dispatch observability, mirroring the SPF backend's signal set:
 # wall time per backup-table computation, recompiles vs shape hits, and
@@ -188,28 +189,21 @@ class FrrEngine:
             breaker if breaker is not None else CircuitBreaker("frr-dispatch")
         )
         self._jit = None  # built lazily (jax import on first TPU compute)
-        self._graph_cache: dict[tuple, object] = {}
         self._compiled_shapes: set[tuple] = set()
 
     # -- device path
 
     def _prepare(self, topo: Topology):
-        import jax
+        # Shared with TpuSpfBackend.prepare (ROADMAP cleanup): an
+        # instance running SPF + FRR now marshals its DeviceGraph once —
+        # the holo_spf_marshal_cache_total hit/miss pair makes the dedup
+        # visible, while this engine's historical series stays alive.
+        from holo_tpu.ops.spf_engine import shared_graph_cache
 
-        from holo_tpu.ops.graph import build_ell
-        from holo_tpu.ops.spf_engine import device_graph_from_ell
-
-        key = topo.cache_key
-        g = self._graph_cache.get(key)
-        if g is None:
-            _FRR_GRAPH_CACHE.labels(result="miss").inc()
-            ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
-            g = jax.device_put(device_graph_from_ell(ell))
-            self._graph_cache[key] = g
-            while len(self._graph_cache) > 4:
-                self._graph_cache.pop(next(iter(self._graph_cache)))
-        else:
-            _FRR_GRAPH_CACHE.labels(result="hit").inc()
+        g, hit = shared_graph_cache().get(
+            topo, max(self.n_atoms, topo.n_atoms())
+        )
+        _FRR_GRAPH_CACHE.labels(result="hit" if hit else "miss").inc()
         return g
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
@@ -227,39 +221,49 @@ class FrrEngine:
         sig = (fin.link_far.shape, fin.edge_masks.shape, fin.adj_nbr.shape)
         if sig in self._compiled_shapes:
             _FRR_JIT_HITS.inc()
+            fresh = False
         else:
             self._compiled_shapes.add(sig)
             _FRR_COMPILES.inc()
+            fresh = True
         # The FRR analog of the SPF backend's sanctioned boundary: the
         # padded planes move host->device here, results device->host
         # below, and nowhere else.
-        with sanctioned_transfer("frr.batch.marshal"):
-            g = self._prepare(topo)
-            out = self._jit(
-                g,
-                topo.root,
-                fin.link_far,
-                fin.link_cost,
-                fin.link_valid,
-                fin.edge_masks,
-                fin.adj_nbr,
-                fin.adj_cost,
-                fin.adj_link,
-                fin.adj_valid,
+        args = (
+            fin.link_far,
+            fin.link_cost,
+            fin.link_valid,
+            fin.edge_masks,
+            fin.adj_nbr,
+            fin.adj_cost,
+            fin.adj_link,
+            fin.adj_valid,
+        )
+        with profiling.stage("frr.batch", "marshal"):
+            with sanctioned_transfer("frr.batch.marshal"):
+                g = self._prepare(topo)
+                out = self._jit(g, topo.root, *args)
+        if fresh:
+            profiling.record_cost(
+                "frr.batch", self._jit, g, topo.root, *args, shape_sig=sig
             )
+        with profiling.stage("frr.batch", "device"):
+            with profiling.annotation("frr.batch.device"):
+                profiling.sync(out)
         nl = fin.n_links
-        with sanctioned_transfer("frr.batch.unmarshal"):
-            return BackupTable(
-                inputs=fin,
-                root=int(topo.root),
-                lfa_adj=np.asarray(out.lfa_adj)[:nl],
-                lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
-                rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
-                tilfa_p=np.asarray(out.tilfa_p)[:nl],
-                tilfa_q=np.asarray(out.tilfa_q)[:nl],
-                post_dist=np.asarray(out.post_dist)[:nl],
-                post_nh=np.asarray(out.post_nh)[:nl],
-            )
+        with profiling.stage("frr.batch", "readback"):
+            with sanctioned_transfer("frr.batch.unmarshal"):
+                return BackupTable(
+                    inputs=fin,
+                    root=int(topo.root),
+                    lfa_adj=np.asarray(out.lfa_adj)[:nl],
+                    lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
+                    rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
+                    tilfa_p=np.asarray(out.tilfa_p)[:nl],
+                    tilfa_q=np.asarray(out.tilfa_q)[:nl],
+                    post_dist=np.asarray(out.post_dist)[:nl],
+                    post_nh=np.asarray(out.post_nh)[:nl],
+                )
 
     def _scalar_fallback(self, topo: Topology, fin) -> BackupTable:
         """Breaker degraded path: the oracle over the SAME marshaled

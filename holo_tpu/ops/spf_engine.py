@@ -48,6 +48,11 @@ _ELL_OCCUPANCY = telemetry.gauge(
     "holo_spf_ell_occupancy",
     "Valid fraction of padded ELL in-edge slots (last marshal)",
 )
+_MARSHAL_CACHE = telemetry.counter(
+    "holo_spf_marshal_cache_total",
+    "Shared marshaled-DeviceGraph cache lookups (SPF + FRR engines)",
+    ("result",),
+)
 
 
 class DeviceGraph(NamedTuple):
@@ -97,6 +102,70 @@ def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
     # sampler drops its array reference after the first scrape.
     _ELL_OCCUPANCY.set_fn(telemetry.deferred_mean(ell.in_valid))
     return g
+
+
+class DeviceGraphCache:
+    """Process-wide LRU of marshaled DeviceGraphs, shared by every SPF
+    backend and FRR engine (ROADMAP cleanup: an instance running SPF +
+    FRR used to hold two private caches and marshal the same LSDB
+    twice).  Keyed by ``(topology uid, generation, n_atoms)`` — the
+    same identity contract as the old per-engine caches: in-place
+    topology mutators must ``touch()``.
+
+    Thread-shared under ``[runtime] isolation=threaded`` (instance
+    threads dispatch concurrently): lookups and inserts run under an
+    owning lock; the expensive ELL expansion runs outside it, so two
+    concurrent first-misses marshal twice and the second insert wins —
+    wasted work once, never a stall or a torn entry.
+    """
+
+    def __init__(self, capacity: int = 16):
+        import threading
+
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._cache: dict[tuple, DeviceGraph] = {}
+
+    def get(self, topo, n_atoms: int) -> tuple[DeviceGraph, bool]:
+        """(device graph, cache hit?).  Callers invoke this inside their
+        sanctioned marshal windows — the device_put below is the
+        transfer the window exists for."""
+        key = (*topo.cache_key, int(n_atoms))
+        with self._lock:
+            g = self._cache.get(key)
+            if g is not None:
+                # Refresh LRU position (dicts preserve insertion order).
+                del self._cache[key]
+                self._cache[key] = g
+        if g is not None:
+            _MARSHAL_CACHE.labels(result="hit").inc()
+            return g, True
+        _MARSHAL_CACHE.labels(result="miss").inc()
+        from holo_tpu.ops.graph import build_ell
+
+        ell = build_ell(topo, n_atoms=n_atoms)
+        g = jax.device_put(device_graph_from_ell(ell))
+        with self._lock:
+            self._cache[key] = g
+            while len(self._cache) > self.capacity:
+                self._cache.pop(next(iter(self._cache)))
+        return g, False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
+
+_SHARED_GRAPH_CACHE = DeviceGraphCache()
+
+
+def shared_graph_cache() -> DeviceGraphCache:
+    """The process-wide marshaled-graph cache."""
+    return _SHARED_GRAPH_CACHE
 
 
 def _slot_mask(g: DeviceGraph, edge_mask: jax.Array | None) -> jax.Array:

@@ -33,6 +33,7 @@ import weakref
 from typing import Callable
 
 from holo_tpu import telemetry
+from holo_tpu.telemetry import flight
 
 log = logging.getLogger("holo_tpu.resilience.breaker")
 
@@ -181,6 +182,17 @@ class CircuitBreaker:
     def _emit(self, to: str) -> None:
         _STATE.labels(breaker=self.name).set(_STATE_CODE[to])
         _TRANSITIONS.labels(breaker=self.name, to=to).inc()
+        # Flight-recorder forensics (no-ops while disarmed): every
+        # transition lands in the ring; the open transition is a
+        # postmortem trigger — the moment the device service was
+        # declared down is exactly when the recent-span/journal context
+        # is worth freezing to disk.
+        flight.event("breaker", breaker=self.name, to=to)
+        if to == OPEN:
+            flight.trigger(
+                f"breaker-open:{self.name}",
+                extra={"last-error": self.last_error or ""},
+            )
 
     def _admit(self) -> bool:
         """Decide whether this call may try the device.  Returns True to

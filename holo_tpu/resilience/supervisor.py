@@ -25,6 +25,7 @@ import weakref
 from dataclasses import dataclass
 
 from holo_tpu import telemetry
+from holo_tpu.telemetry import flight
 from holo_tpu.utils.runtime import Actor, EventLoop
 
 log = logging.getLogger("holo_tpu.resilience.supervisor")
@@ -250,6 +251,7 @@ class Supervisor(Actor):
     def _on_crash(self, msg: CrashNotice) -> None:
         actor = msg.actor
         _CRASHES.labels(actor=actor).inc()
+        flight.event("actor-crash", actor=actor, error=msg.error)
         self.crashes[actor] = self.crashes.get(actor, 0) + 1
         if actor in self.degraded:
             return
@@ -272,6 +274,10 @@ class Supervisor(Actor):
 
     def _degrade(self, actor: str, error: str) -> None:
         self.degraded.add(actor)
+        # Crash-loop → permanent degraded is a postmortem trigger: the
+        # crash cadence and the mail that provoked it are still in the
+        # flight ring right now (no-op while the recorder is disarmed).
+        flight.trigger(f"crash-loop:{actor}", extra={"error": error})
         owning = self._owning(actor)
         if owning is not None:
             # abandon_actor only marks a set + clears a deque (both
@@ -305,6 +311,7 @@ class Supervisor(Actor):
             return  # e.g. on_restart re-crashed: a fresh CrashNotice follows
         self.restarts[actor] = self.restarts.get(actor, 0) + 1
         _RESTARTS.labels(actor=actor).inc()
+        flight.event("actor-restart", actor=actor, n=self.restarts[actor])
         log.info(
             "actor %s restarted (restart %d); held mail redelivered",
             actor, self.restarts[actor],

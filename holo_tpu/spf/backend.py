@@ -18,17 +18,18 @@ import numpy as np
 
 from holo_tpu import telemetry
 from holo_tpu.analysis.runtime import sanctioned_transfer
-from holo_tpu.ops.graph import Topology, build_ell
+from holo_tpu.ops.graph import Topology
 from holo_tpu.resilience import faults
 from holo_tpu.resilience.breaker import CircuitBreaker
 from holo_tpu.ops.spf_engine import (
     DeviceGraph,
-    device_graph_from_ell,
+    shared_graph_cache,
     spf_multiroot,
     spf_one,
     spf_whatif_batch,
 )
 from holo_tpu.spf.scalar import spf_reference
+from holo_tpu.telemetry import profiling
 
 # Device-dispatch observability (the tentpole signal set): wall time per
 # dispatch, device->host readback time, jit recompiles vs shape-cache
@@ -205,10 +206,6 @@ class TpuSpfBackend(SpfBackend):
         # (kind, shape...) signatures already dispatched: a miss here is
         # a fresh XLA compile for this backend instance.
         self._compiled_shapes: set[tuple] = set()
-        # Small LRU of marshaled graphs: an instance typically alternates
-        # between its LSDB topology and derived ones (hop graphs for
-        # flooding reduction), which must not evict each other.
-        self._cache: dict[tuple, DeviceGraph] = {}
         from holo_tpu.ops.spf_engine import _ONE_ENGINES
 
         one = _ONE_ENGINES[one_engine]
@@ -223,28 +220,28 @@ class TpuSpfBackend(SpfBackend):
         )
 
     def prepare(self, topo: Topology) -> DeviceGraph:
-        # Keyed by (process-unique uid, generation): in-place mutators must
-        # topo.touch(), and uid reuse across freed objects cannot occur.
-        key = topo.cache_key
-        g = self._cache.get(key)
-        if g is None:
-            _GRAPH_CACHE.labels(result="miss").inc()
-            ell = build_ell(topo, n_atoms=max(self.n_atoms, topo.n_atoms()))
-            g = device_graph_from_ell(ell)
-            self._cache[key] = g
-            while len(self._cache) > 4:
-                self._cache.pop(next(iter(self._cache)))
-        else:
-            _GRAPH_CACHE.labels(result="hit").inc()
+        # The process-wide shared cache (keyed by the topology's
+        # (process-unique uid, generation) identity — in-place mutators
+        # must topo.touch()): an instance running SPF + FRR marshals its
+        # DeviceGraph once, not once per engine.  The per-engine counter
+        # keeps the historical series alive alongside the shared
+        # holo_spf_marshal_cache_total pair.
+        g, hit = shared_graph_cache().get(
+            topo, max(self.n_atoms, topo.n_atoms())
+        )
+        _GRAPH_CACHE.labels(result="hit" if hit else "miss").inc()
         return g
 
-    def _track_compile(self, kind: str, *shape) -> None:
+    def _track_compile(self, kind: str, *shape) -> bool:
+        """Returns True when this (engine, shape) bucket is fresh — a
+        real XLA compile, and the moment to capture its cost analysis."""
         sig = (kind, self.one_engine, *shape)
         if sig in self._compiled_shapes:
             _JIT_HITS.labels(kind=kind).inc()
-        else:
-            self._compiled_shapes.add(sig)
-            _JIT_COMPILES.labels(kind=kind).inc()
+            return False
+        self._compiled_shapes.add(sig)
+        _JIT_COMPILES.labels(kind=kind).inc()
+        return True
 
     def _full_mask(self, topo: Topology, edge_mask) -> np.ndarray:
         if edge_mask is None:
@@ -291,23 +288,33 @@ class TpuSpfBackend(SpfBackend):
             # THE sanctioned marshal boundary: host graph + root + mask
             # move to device here and nowhere else (transfer_guard
             # "disallow" everywhere outside these windows).
-            with sanctioned_transfer("spf.one.marshal"):
-                g = self.prepare(topo)
-                self._track_compile(
-                    "one", g.in_src.shape, g.direct_nh_words.shape[2],
-                    topo.n_edges,
+            with profiling.stage("spf.one", "marshal"):
+                with sanctioned_transfer("spf.one.marshal"):
+                    g = self.prepare(topo)
+                    mask = self._full_mask(topo, edge_mask)
+                    sig = (
+                        g.in_src.shape, g.direct_nh_words.shape[2],
+                        topo.n_edges,
+                    )
+                    fresh = self._track_compile("one", *sig)
+                    out = self._jit_one(g, topo.root, mask)
+            if fresh:
+                profiling.record_cost(
+                    "spf.one", self._jit_one, g, topo.root, mask,
+                    shape_sig=sig,
                 )
-                out = self._jit_one(
-                    g, topo.root, self._full_mask(topo, edge_mask)
-                )
+            with profiling.stage("spf.one", "device"):
+                with profiling.annotation("spf.one.device"):
+                    profiling.sync(out)
             t1 = time.perf_counter()
-            with sanctioned_transfer("spf.one.unmarshal"):
-                res = SpfResult(
-                    dist=np.asarray(out.dist),
-                    parent=np.asarray(out.parent),
-                    hops=np.asarray(out.hops),
-                    nexthop_words=np.asarray(out.nexthops),
-                )
+            with profiling.stage("spf.one", "readback"):
+                with sanctioned_transfer("spf.one.unmarshal"):
+                    res = SpfResult(
+                        dist=np.asarray(out.dist),
+                        parent=np.asarray(out.parent),
+                        hops=np.asarray(out.hops),
+                        nexthop_words=np.asarray(out.nexthops),
+                    )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
@@ -359,17 +366,27 @@ class TpuSpfBackend(SpfBackend):
             "spf.dispatch", kind="blocked", backend="tpu",
             batch=len(edge_masks),
         ):
-            self._track_compile("blocked", fdst.shape, fid.shape)
-            with sanctioned_transfer("spf.blocked.dispatch"):
-                out = self._jit_blocked(g, fdst, fid)
-            t1 = time.perf_counter()
-            with sanctioned_transfer("spf.blocked.unmarshal"):
-                dist, parent, hops, nh = (
-                    np.asarray(out.dist),
-                    np.asarray(out.parent),
-                    np.asarray(out.hops),
-                    np.asarray(out.nexthops),
+            with profiling.stage("spf.blocked", "marshal"):
+                fresh = self._track_compile("blocked", fdst.shape, fid.shape)
+                with sanctioned_transfer("spf.blocked.dispatch"):
+                    out = self._jit_blocked(g, fdst, fid)
+            if fresh:
+                profiling.record_cost(
+                    "spf.blocked", self._jit_blocked, g, fdst, fid,
+                    shape_sig=(fdst.shape, fid.shape),
                 )
+            with profiling.stage("spf.blocked", "device"):
+                with profiling.annotation("spf.blocked.device"):
+                    profiling.sync(out)
+            t1 = time.perf_counter()
+            with profiling.stage("spf.blocked", "readback"):
+                with sanctioned_transfer("spf.blocked.unmarshal"):
+                    dist, parent, hops, nh = (
+                        np.asarray(out.dist),
+                        np.asarray(out.parent),
+                        np.asarray(out.hops),
+                        np.asarray(out.nexthops),
+                    )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="blocked").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="blocked").observe(t2 - t0)
@@ -390,24 +407,35 @@ class TpuSpfBackend(SpfBackend):
             "spf.dispatch", kind="whatif", backend="tpu",
             batch=len(edge_masks),
         ):
-            with sanctioned_transfer("spf.whatif.marshal"):
-                g = self.prepare(topo)
-                masks = np.asarray(edge_masks, bool)
-                self._track_compile(
-                    "whatif", g.in_src.shape, g.direct_nh_words.shape[2],
-                    masks.shape,
+            with profiling.stage("spf.whatif", "marshal"):
+                with sanctioned_transfer("spf.whatif.marshal"):
+                    g = self.prepare(topo)
+                    masks = np.asarray(edge_masks, bool)
+                    sig = (
+                        g.in_src.shape, g.direct_nh_words.shape[2],
+                        masks.shape,
+                    )
+                    fresh = self._track_compile("whatif", *sig)
+                    out = self._jit_batch(g, topo.root, masks)
+            if fresh:
+                profiling.record_cost(
+                    "spf.whatif", self._jit_batch, g, topo.root, masks,
+                    shape_sig=sig,
                 )
-                out = self._jit_batch(g, topo.root, masks)
+            with profiling.stage("spf.whatif", "device"):
+                with profiling.annotation("spf.whatif.device"):
+                    profiling.sync(out)
             t1 = time.perf_counter()
             # One bulk device→host transfer per plane: per-scenario slicing
             # of device arrays would pay the host round-trip B×4 times.
-            with sanctioned_transfer("spf.whatif.unmarshal"):
-                dist, parent, hops, nh = (
-                    np.asarray(out.dist),
-                    np.asarray(out.parent),
-                    np.asarray(out.hops),
-                    np.asarray(out.nexthops),
-                )
+            with profiling.stage("spf.whatif", "readback"):
+                with sanctioned_transfer("spf.whatif.unmarshal"):
+                    dist, parent, hops, nh = (
+                        np.asarray(out.dist),
+                        np.asarray(out.parent),
+                        np.asarray(out.hops),
+                        np.asarray(out.nexthops),
+                    )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
@@ -430,22 +458,33 @@ class TpuSpfBackend(SpfBackend):
         with telemetry.span(
             "spf.dispatch", kind="multiroot", backend="tpu", roots=len(roots)
         ):
-            with sanctioned_transfer("spf.multiroot.marshal"):
-                g = self.prepare(topo)
-                roots_i32 = np.asarray(roots, np.int32)
-                self._track_compile(
-                    "multiroot", g.in_src.shape, g.direct_nh_words.shape[2],
-                    roots_i32.shape[0], topo.n_edges,
+            with profiling.stage("spf.multiroot", "marshal"):
+                with sanctioned_transfer("spf.multiroot.marshal"):
+                    g = self.prepare(topo)
+                    roots_i32 = np.asarray(roots, np.int32)
+                    sig = (
+                        g.in_src.shape, g.direct_nh_words.shape[2],
+                        roots_i32.shape[0], topo.n_edges,
+                    )
+                    fresh = self._track_compile("multiroot", *sig)
+                    mask = np.ones(topo.n_edges, bool)
+                    out = self._jit_multiroot(g, roots_i32, mask)
+            if fresh:
+                profiling.record_cost(
+                    "spf.multiroot", self._jit_multiroot, g, roots_i32, mask,
+                    shape_sig=sig,
                 )
-                mask = np.ones(topo.n_edges, bool)
-                out = self._jit_multiroot(g, roots_i32, mask)
+            with profiling.stage("spf.multiroot", "device"):
+                with profiling.annotation("spf.multiroot.device"):
+                    profiling.sync(out)
             t1 = time.perf_counter()
-            with sanctioned_transfer("spf.multiroot.unmarshal"):
-                res = MultiRootResult(
-                    dist=np.asarray(out.dist),
-                    parent=np.asarray(out.parent),
-                    hops=np.asarray(out.hops),
-                )
+            with profiling.stage("spf.multiroot", "readback"):
+                with sanctioned_transfer("spf.multiroot.unmarshal"):
+                    res = MultiRootResult(
+                        dist=np.asarray(out.dist),
+                        parent=np.asarray(out.parent),
+                        hops=np.asarray(out.hops),
+                    )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)

@@ -1,0 +1,176 @@
+"""Per-dispatch device-time breakdown (the deep-profiling tentpole).
+
+PR 2's dispatch telemetry measures the SPF/FRR hot path from the host
+side only: one wall-clock histogram around the whole dispatch and a
+readback timer.  This module splits each dispatch span into the three
+phases that actually matter for the DeltaPath incremental-SPF work —
+
+- **marshal** — host graph/plane preparation + the (async) jit call;
+- **device** — device execution, measured by ``jax.block_until_ready``
+  bracketing on CPU/relay backends (an optional
+  ``jax.profiler.TraceAnnotation`` path activates on a real TPU so the
+  phases also land in XLA's own profiler timeline);
+- **readback** — device→host materialization of the result planes.
+
+Each phase records a nested trace sub-span AND a
+``holo_profile_stage_seconds{site,stage}`` histogram observation
+carrying an OpenMetrics **exemplar** ``{span_id=...}`` — a scrape can
+jump from a latency bucket straight to the trace span that produced it.
+
+Compile-time cost attribution rides the same switch: when a backend
+sees a fresh (engine, shape) bucket it calls :func:`record_cost`, which
+runs ``jit(...).lower(...).compile().cost_analysis()`` and records the
+XLA FLOP / bytes-accessed estimates per dispatch site — the denominator
+that turns a measured device time into achieved-vs-peak utilization.
+
+Everything is **off by default** (``[telemetry] profile-device-time``
+in holod.toml, :func:`set_device_profiling` programmatically): when
+disabled, :func:`stage` costs one module-global bool check and
+:func:`sync` is a no-op — no extra device synchronization is added to
+the dispatch path, which is what the ``bench.py profiling_overhead``
+gate (<2%) holds the enabled arm to as well.  Metric updates here are
+O(1) (a float and a small exemplar tuple) — nothing reads device
+values or reduces arrays on the traced path (holo-lint HL101/HL105).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from contextlib import contextmanager
+
+from holo_tpu import telemetry
+
+log = logging.getLogger("holo_tpu.telemetry")
+
+_STAGE_SECONDS = telemetry.histogram(
+    "holo_profile_stage_seconds",
+    "Per-dispatch sub-span time (marshal / device / readback)",
+    ("site", "stage"),
+)
+_COST_FLOPS = telemetry.gauge(
+    "holo_profile_cost_flops",
+    "XLA compile-time FLOP estimate for the last-compiled shape bucket",
+    ("site",),
+)
+_COST_BYTES = telemetry.gauge(
+    "holo_profile_cost_bytes",
+    "XLA compile-time bytes-accessed estimate for the last-compiled "
+    "shape bucket",
+    ("site",),
+)
+
+_enabled = False
+
+# (site, shape signature) -> {"flops": float, "bytes": float}; one entry
+# per compiled shape bucket, exactly mirroring the backends' jit caches.
+_cost_lock = threading.Lock()
+_cost_table: dict[tuple, dict] = {}
+
+
+def set_device_profiling(on: bool) -> None:
+    """Arm/disarm the per-dispatch breakdown (daemon boot reads
+    ``[telemetry] profile-device-time``; bench/tests flip it directly)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def device_profiling() -> bool:
+    return _enabled
+
+
+@contextmanager
+def stage(site: str, name: str):
+    """One dispatch phase: a nested trace sub-span plus a
+    ``holo_profile_stage_seconds`` observation whose exemplar links the
+    bucket to the sub-span id.  ``site`` is the dispatch site
+    (``spf.one``, ``spf.whatif``, ``frr.batch``, ...), ``name`` the
+    phase (``marshal`` / ``device`` / ``readback``)."""
+    if not _enabled:
+        yield None
+        return
+    t0 = time.perf_counter()
+    with telemetry.span(f"{site}.{name}", stage=name) as sid:
+        yield sid
+    _STAGE_SECONDS.labels(site=site, stage=name).observe(
+        time.perf_counter() - t0, exemplar={"span_id": sid}
+    )
+
+
+def sync(tree) -> None:
+    """Completion barrier bounding the **device** phase: block until the
+    jit result pytree is ready.  A no-op when profiling is off — the
+    un-profiled dispatch path keeps its async overlap and pays for the
+    device inside the readback materialization instead."""
+    if not _enabled:
+        return
+    import jax
+
+    try:
+        jax.block_until_ready(tree)
+    except Exception:  # noqa: BLE001 — a profiler barrier must never
+        # fail a dispatch the breaker would otherwise see succeed.
+        log.debug("block_until_ready failed under profiling", exc_info=True)
+
+
+def annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` on a real TPU (the phases then
+    appear in XLA's own profiler timeline), a null context elsewhere."""
+    from contextlib import nullcontext
+
+    if not _enabled:
+        return nullcontext()
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — best-effort on exotic backends
+        log.debug("profiler annotation unavailable", exc_info=True)
+    return nullcontext()
+
+
+def record_cost(site: str, jitfn, *args, shape_sig: tuple = ()) -> dict | None:
+    """Compile-time FLOP/bytes estimate for a freshly-compiled shape
+    bucket via ``jitfn.lower(*args).compile().cost_analysis()``.
+
+    Called by the backends right after :meth:`_track_compile` reports a
+    fresh (engine, shape) signature, so the table mirrors the jit cache
+    one-to-one.  The lower+compile pair re-runs XLA compilation for the
+    bucket (the AOT path does not share the jit dispatch cache), which
+    is why this only runs when profiling is armed — it is compile-time
+    cost on a cold bucket, never per-dispatch cost.  Never raises:
+    backends without cost analysis record nothing."""
+    if not _enabled:
+        return None
+    try:
+        ca = jitfn.lower(*args).compile().cost_analysis()
+    except Exception as e:  # noqa: BLE001 — platform-dependent API
+        log.debug("cost analysis unavailable for %s: %r", site, e)
+        return None
+    if isinstance(ca, (list, tuple)):  # some jax versions: one per device
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    entry = {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes": float(ca.get("bytes accessed", 0.0)),
+    }
+    with _cost_lock:
+        _cost_table[(site, tuple(shape_sig))] = entry
+    _COST_FLOPS.labels(site=site).set(entry["flops"])
+    _COST_BYTES.labels(site=site).set(entry["bytes"])
+    return entry
+
+
+def cost_table() -> dict[tuple, dict]:
+    """Snapshot of {(site, shape signature) -> cost estimates}."""
+    with _cost_lock:
+        return {k: dict(v) for k, v in _cost_table.items()}
+
+
+def clear_cost_table() -> None:
+    """Tests only."""
+    with _cost_lock:
+        _cost_table.clear()

@@ -16,6 +16,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("holo_tpu.telemetry")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# Exemplars are an OpenMetrics feature: the classic 0.0.4 grammar allows
+# only `value [timestamp]` after the labels, so a 0.0.4 scrape must
+# never see them.  The endpoint renders them only when the scraper
+# advertises OpenMetrics in its Accept header (Prometheus does when
+# configured for it), and then also serves this content type + `# EOF`.
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 def _fmt_value(v: float) -> str:
@@ -38,8 +46,22 @@ def _labelstr(names, values, extra: tuple[tuple[str, str], ...] = ()) -> str:
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-def render_text(registry) -> str:
-    """The whole registry in Prometheus exposition format."""
+def _exemplar_str(ex: tuple) -> str:
+    """OpenMetrics exemplar suffix: `` # {k="v"} value``, rendered on
+    histogram ``_bucket`` lines whose bucket holds one
+    (:meth:`Histogram.observe` with ``exemplar=``)."""
+    pairs, value = ex
+    labels = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return f" # {{{labels}}} {_fmt_value(value)}"
+
+
+def render_text(registry, openmetrics: bool = False) -> str:
+    """The whole registry in Prometheus exposition format.
+
+    ``openmetrics=True`` additionally renders histogram-bucket
+    exemplars and the terminating ``# EOF`` — valid only under the
+    OpenMetrics content type, never on a 0.0.4 scrape (whose grammar
+    would reject the exemplar suffix and fail the entire scrape)."""
     lines: list[str] = []
     for fam in registry.families():
         if fam.help:
@@ -52,11 +74,13 @@ def render_text(registry) -> str:
             children = [((), fam.labels())]
         for key, child in children:
             if fam.kind == "histogram":
+                exemplars = child.exemplars() if openmetrics else {}
                 for le, acc in child.cumulative():
+                    ex = exemplars.get(le)
                     lines.append(
                         f"{fam.name}_bucket"
                         f"{_labelstr(fam.labelnames, key, (('le', _fmt_value(le)),))}"
-                        f" {acc}"
+                        f" {acc}{_exemplar_str(ex) if ex else ''}"
                     )
                 base = _labelstr(fam.labelnames, key)
                 lines.append(f"{fam.name}_sum{base} {_fmt_value(child.sum)}")
@@ -76,14 +100,25 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.split("?")[0] not in ("/metrics", "/"):
             self.send_error(404)
             return
+        # Content negotiation: exemplars only for scrapers that accept
+        # OpenMetrics (a 0.0.4 parser would reject the whole scrape).
+        openmetrics = "application/openmetrics-text" in self.headers.get(
+            "Accept", ""
+        )
         try:
-            body = render_text(self.registry).encode()
+            body = render_text(self.registry, openmetrics=openmetrics)
+            if openmetrics:
+                body += "# EOF\n"
+            body = body.encode()
         except Exception:  # noqa: BLE001 — a scrape must not kill the server
             log.exception("metrics render failed")
             self.send_error(500)
             return
         self.send_response(200)
-        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header(
+            "Content-Type",
+            OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE,
+        )
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

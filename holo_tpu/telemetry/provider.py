@@ -13,6 +13,8 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
       health/                    # resilience summary (ISSUE 4)
         breakers/<name>/...      # dispatch-breaker state + failure tally
         supervision/...          # degraded actors, restart counts
+      flight/                    # flight recorder (ISSUE 5; only while
+        entries, capacity, dumps #   armed via flight-buffer-entries)
 """
 
 from __future__ import annotations
@@ -65,6 +67,11 @@ class TelemetryStateProvider(NbProvider):
         health = _resilience_health()
         if health:
             out["health"] = health
+        from holo_tpu.telemetry import flight
+
+        rec = flight.recorder()
+        if rec is not None:
+            out["flight"] = rec.stats()
         return {ROOT: out}
 
 

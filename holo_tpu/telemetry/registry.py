@@ -110,9 +110,17 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-boundary histogram child (cumulative at render time)."""
+    """Fixed-boundary histogram child (cumulative at render time).
 
-    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+    ``observe(..., exemplar={...})`` attaches an OpenMetrics exemplar to
+    the bucket the observation lands in (last writer wins): a small
+    label dict — in this codebase ``{"span_id": <trace span id>}`` — so
+    a scrape can jump from a latency bucket straight to the trace span
+    that produced it.  Storage is lazy (one list allocated on the first
+    exemplar) and O(1) per observe: just a tuple swap under the lock.
+    """
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count", "_exemplars")
 
     def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         self._lock = threading.Lock()
@@ -120,8 +128,9 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
         self._count = 0
+        self._exemplars: list | None = None  # lazy: [(labels, value)|None]
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
         if not _enabled:
             return
         i = 0
@@ -134,6 +143,29 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * (len(self.buckets) + 1)
+                self._exemplars[i] = (
+                    tuple((str(k), str(v)) for k, v in exemplar.items()),
+                    float(value),
+                )
+
+    def exemplars(self) -> dict[float, tuple]:
+        """{bucket le -> (label pairs, observed value)} for buckets that
+        have one; the +Inf bucket keys as ``float('inf')``."""
+        with self._lock:
+            ex = list(self._exemplars) if self._exemplars is not None else []
+        out: dict[float, tuple] = {}
+        for i, e in enumerate(ex):
+            if e is not None:
+                le = (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else float("inf")
+                )
+                out[le] = e
+        return out
 
     @property
     def count(self) -> int:
@@ -237,8 +269,8 @@ class MetricFamily:
     def set_fn(self, fn) -> None:
         self._default().set_fn(fn)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
+        self._default().observe(value, exemplar)
 
     @property
     def value(self):

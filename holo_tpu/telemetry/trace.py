@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+
+log = logging.getLogger("holo_tpu.telemetry")
 
 
 class Span:
@@ -44,8 +47,21 @@ class SpanTracer:
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._tls = threading.local()
+        self.clock = time.monotonic
         self._epoch = time.monotonic()
         self.enabled = True
+        # Completion tap (the flight recorder): called with each Span
+        # AFTER it is appended to the ring, outside the ring lock.
+        self.on_complete = None
+
+    def use_clock(self, clock, epoch: float | None = None) -> None:
+        """Swap the time source (chaos tests pass the virtual loop
+        clock so span start/duration — and everything downstream, the
+        flight-recorder ring included — becomes deterministic).  The
+        epoch defaults to ``clock()`` at the swap, so timestamps start
+        near zero under either source."""
+        self.clock = clock
+        self._epoch = clock() if epoch is None else epoch
 
     # -- context (threadlocal span stack + instance name)
 
@@ -82,11 +98,11 @@ class SpanTracer:
         st = self._stack()
         parent = st[-1][0] if st else None
         st.append((span_id, attrs))
-        t0 = time.monotonic()
+        t0 = self.clock()
         try:
             yield span_id
         finally:
-            dur = time.monotonic() - t0
+            dur = self.clock() - t0
             st.pop()
             sp = Span(
                 span_id,
@@ -99,6 +115,14 @@ class SpanTracer:
             )
             with self._lock:
                 self._spans.append(sp)
+            hook = self.on_complete
+            if hook is not None:
+                try:
+                    hook(sp)
+                except Exception:  # noqa: BLE001 — a tap must never
+                    # break the traced code path (holo-lint HL106: the
+                    # failure is still surfaced, at debug level).
+                    log.debug("span completion tap failed", exc_info=True)
 
     def spans(self) -> list[Span]:
         with self._lock:

@@ -302,6 +302,77 @@ def cmd_deviations(args) -> int:
     return 0
 
 
+def cmd_postmortem(args) -> int:
+    """Pretty-print a flight-recorder postmortem bundle (written by the
+    daemon on breaker-open / crash-loop / SIGTERM when ``[telemetry]
+    flight-buffer-entries`` + ``postmortem-dir`` are set).  ``--json``
+    re-emits the canonical sorted JSON (diff two seeded runs with it)."""
+    try:
+        with open(args.bundle) as fh:
+            bundle = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if bundle.get("schema") != "holo-postmortem/1":
+        print(
+            f"error: {args.bundle} is not a holo-postmortem/1 bundle",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(bundle, sort_keys=True, indent=2))
+        return 0
+    ring = bundle.get("ring", [])
+    print(f"postmortem #{bundle.get('dump')}: {bundle.get('reason')}")
+    kinds = {}
+    for e in ring:
+        kinds[e[0]] = kinds.get(e[0], 0) + 1
+    print(
+        f"ring: {len(ring)} entries ("
+        + ", ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+        + ")"
+    )
+    for e in ring:
+        if e[0] == "event":
+            _, kind, fields, t = e
+            kv = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+            print(f"  [{t:10.3f}] {kind:18s} {kv}")
+    spans = [e for e in ring if e[0] == "span"]
+    if spans:
+        print(f"last spans ({min(len(spans), args.spans)} of {len(spans)}):")
+        for _, name, sid, parent, start, dur, attrs in spans[-args.spans:]:
+            kv = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            print(
+                f"  #{sid:<4d} {name:24s} {dur / 1e3:9.3f}ms"
+                f"  parent={parent if parent is not None else '-':<4} {kv}"
+            )
+    health = bundle.get("health", {})
+    for name, br in sorted(health.get("breakers", {}).items()):
+        print(
+            f"breaker {name}: {br['state']} "
+            f"(failures={br['consecutive-failures']}"
+            f"/{br['failure-threshold']}, last={br['last-error'] or '-'})"
+        )
+    sup = health.get("supervision")
+    if sup:
+        print(
+            f"supervision: degraded={sup['degraded-actors'] or '-'} "
+            f"restarts={sup['restarts']}"
+        )
+    metrics = bundle.get("metrics", {})
+    if metrics:
+        print(f"metric deltas since arm ({len(metrics)} series):")
+        for name in sorted(metrics):
+            print(f"  {name} += {metrics[name]}")
+    tail = bundle.get("journal-tail", [])
+    if tail:
+        print(
+            f"journal tail: seq {tail[0][0]}..{tail[-1][0]} "
+            f"({len(tail)} markers)"
+        )
+    return 0
+
+
 def cmd_lint(args) -> int:
     """holo-lint: repo-native static analysis (JAX hot-path hazards +
     daemon lock discipline), gated against a ratchet baseline.  Exit 0
@@ -435,6 +506,20 @@ def main(argv=None) -> int:
     )
     s.add_argument("files", nargs="+", help="module file, then its imports")
     s.set_defaults(fn=cmd_deviations)
+    s = sub.add_parser(
+        "postmortem",
+        help="pretty-print a flight-recorder postmortem bundle",
+    )
+    s.add_argument("bundle", help="postmortem-*.json bundle file")
+    s.add_argument(
+        "--json", action="store_true",
+        help="re-emit the canonical sorted JSON instead of a summary",
+    )
+    s.add_argument(
+        "--spans", type=int, default=12,
+        help="how many trailing spans to show (default 12)",
+    )
+    s.set_defaults(fn=cmd_postmortem)
     s = sub.add_parser(
         "lint",
         help="holo-lint: JAX hot-path + lock-discipline static analysis",

@@ -23,6 +23,7 @@ import time
 from ipaddress import IPv4Address, IPv4Network, IPv6Address, IPv6Network, ip_address, ip_network
 from pathlib import Path
 
+from holo_tpu.telemetry import flight
 from holo_tpu.utils.runtime import Actor, EventLoop
 
 log = logging.getLogger("holo_tpu.event_recorder")
@@ -120,6 +121,11 @@ class EventRecorder:
                 self._seq += 1
                 self._fh.write(json.dumps(entry) + "\n")
                 self._fh.flush()
+            # Flight-recorder journal marker (no-op while disarmed):
+            # postmortem bundles carry the tail of these seqs, joining
+            # the in-memory ring to this journal file on disk.  Outside
+            # the append lock — the flight ring has its own.
+            flight.journal_mark(entry["seq"], actor)
         except Exception:
             # Recording must never break the instance, but a silently
             # dying journal is a forensics gap worth one debug line

@@ -217,6 +217,92 @@ def test_same_fault_plan_seed_identical_event_sequence(tmp_path):
     assert seq_a == seq_b
 
 
+def test_breaker_open_postmortem_bundle_deterministic(tmp_path):
+    """ISSUE 5 chaos satellite: a forced spf.dispatch breaker-open under
+    a seeded FaultPlan produces EXACTLY ONE postmortem bundle whose
+    journal-seq tail matches the event recorder — and the bundle is
+    byte-identical across two runs of the same seed (modulo dump path):
+    spans ride the virtual clock, ids renumber, metric deltas are
+    per-run counts."""
+    import gc
+    import time as _time
+
+    from holo_tpu import telemetry
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.telemetry import flight
+
+    def run(tag: str) -> str:
+        from ipaddress import IPv4Network as NN
+
+        gc.collect()  # free the previous run's breaker weakrefs
+        loop = EventLoop(clock=VirtualClock())
+        telemetry.tracer().use_clock(loop.clock.now)
+        dump_dir = tmp_path / tag
+        flight.configure(
+            entries=1024, postmortem_dir=dump_dir, clock=loop.clock.now
+        )
+        rec = EventRecorder(tmp_path / f"pm-{tag}.jsonl")
+        instrument(loop, rec)
+        fabric = MockFabric(loop)
+        breaker = CircuitBreaker(
+            "spf-postmortem",
+            failure_threshold=3,
+            recovery_timeout=1e9,  # stay open through the settle window
+            clock=loop.clock.now,
+        )
+        backend = TpuSpfBackend(64, breaker=breaker)
+        buses, kernels, ribs, routers = triangle(loop, fabric, backend)
+        loop.advance(90)  # converge
+        inj = FaultInjector(
+            FaultPlan(seed=7, dispatch_fail={"spf.dispatch": 3})
+        )
+        with inject(inj):
+            for third_octet in (120, 121, 122):
+                routers["r3"].interface_address_add(
+                    "e0", NN(f"192.168.{third_octet}.0/24")
+                )
+                loop.advance(15)
+        assert breaker.state == "open"
+        assert inj.injected["spf.dispatch"] == 3
+        rec.close()
+        flight.configure(entries=0)
+
+        bundles = sorted(dump_dir.glob("postmortem-*.json"))
+        assert len(bundles) == 1, [b.name for b in bundles]
+        bundle = json.loads(bundles[0].read_text())
+        assert bundle["reason"] == "breaker-open:spf-postmortem"
+        # The journal-seq tail joins the bundle to the journal file:
+        # every [seq, actor] marker must match the recorded entry.
+        entries = read_entries(tmp_path / f"pm-{tag}.jsonl")
+        tail = bundle["journal-tail"]
+        assert tail, "the ring must carry journal markers"
+        for seq, actor in tail:
+            assert entries[seq]["seq"] == seq
+            assert entries[seq]["actor"] == actor
+        # The breaker-open event and the open-state health verdict made
+        # it into the bundle.
+        events = [e for e in bundle["ring"] if e[0] == "event"]
+        assert any(
+            e[1] == "breaker" and e[2]["to"] == "open" for e in events
+        )
+        assert (
+            bundle["health"]["breakers"]["spf-postmortem"]["state"] == "open"
+        )
+        assert bundle["metrics"][
+            "holo_resilience_breaker_failures_total"
+            "{breaker=spf-postmortem,cause=exception}"
+        ] == 3
+        return bundles[0].read_text()
+
+    try:
+        text_a = run("a")
+        text_b = run("b")
+    finally:
+        flight.configure(entries=0)
+        telemetry.tracer().use_clock(_time.monotonic)
+    assert text_a == text_b, "seeded chaos bundle must be byte-identical"
+
+
 def test_ospf_reconverges_through_packet_loss():
     """Convergence-under-failure, the metric that matters: with a lossy
     wire AND a link failure mid-run, retransmission machinery still

@@ -81,6 +81,47 @@ def test_replay_cli(tmp_path, capsys):
     assert "10.0.0.0/30" in out  # route reproduced offline
 
 
+def test_postmortem_cli_summary_and_json(tmp_path, capsys):
+    """`postmortem <bundle>` renders the forensics summary; `--json`
+    re-emits the canonical sorted JSON; non-bundles are rejected."""
+    from holo_tpu import telemetry
+    from holo_tpu.telemetry import flight
+
+    t = [0.0]
+    rec = flight.FlightRecorder(
+        capacity=64, postmortem_dir=tmp_path, clock=lambda: t[0]
+    )
+    telemetry.tracer().on_complete = rec.note_span
+    try:
+        telemetry.counter("holo_pmcli_probe_total").inc(2)
+        with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
+            pass
+        rec.journal_mark(41, "r1")
+        rec.journal_mark(42, "r1")
+        rec.event("breaker", breaker="spf-dispatch", to="open")
+        path, _ = rec.postmortem("breaker-open:spf-dispatch")
+    finally:
+        telemetry.tracer().on_complete = None
+
+    rc, out = run_cli("postmortem", str(path), capsys=capsys)
+    assert rc == 0
+    assert "breaker-open:spf-dispatch" in out
+    assert "journal tail: seq 41..42" in out
+    assert "spf.dispatch" in out  # the span made the summary
+    assert "holo_pmcli_probe_total += 2" in out
+
+    rc, out = run_cli("postmortem", "--json", str(path), capsys=capsys)
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["schema"] == "holo-postmortem/1"
+    assert doc["journal-tail"] == [[41, "r1"], [42, "r1"]]
+
+    bogus = tmp_path / "not-a-bundle.json"
+    bogus.write_text(json.dumps({"hello": 1}))
+    rc, _ = run_cli("postmortem", str(bogus), capsys=capsys)
+    assert rc == 2
+
+
 def test_deviations_generator(capsys):
     """`deviations MODULE.yang` emits the holo-tools yang_deviations
     skeleton: header, import with the module's own prefix, one
