@@ -62,6 +62,8 @@ STAGE_TIMEOUT = {
     "telemetry_overhead": 900,
     "fallback_overhead": 900,
     "profiling_overhead": 900,
+    "convergence_storm": 1200,
+    "convergence_overhead": 900,
 }
 
 
@@ -634,6 +636,85 @@ def stage_profiling_overhead(k, B, reps=15):
     }
 
 
+def stage_convergence_storm(n_routers, events, reps=2):
+    """ISSUE 6 acceptance row: seeded flap storm with 10% loss over a
+    synthetic multi-thousand-router OSPFv2 LSDB in a real instance,
+    measured end to end by the convergence observatory.  Reports
+    per-trigger p50/p95/p99/max event-to-FIB distributions split by
+    dispatch mode (batched-device vs scalar-fallback), and gates on the
+    causal timelines being byte-identical across ``reps`` runs of the
+    same seed (the virtual-clock determinism contract)."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+
+    t0 = time.perf_counter()
+    digests, report = [], None
+    for _ in range(reps):
+        # Fresh backend per run: the jit/shape caches must not make the
+        # second run causally different from the first.
+        report, digest, _net = run_convergence_storm(
+            n_routers=n_routers, events=events, seed=17,
+            spf_backend=TpuSpfBackend(),
+        )
+        digests.append(digest)
+    identical = len(set(digests)) == 1
+    lsa = report["triggers"].get("lsa", {})
+    converged = report["outcomes"].get("converged", 0)
+    return {
+        "ok": bool(
+            identical
+            and converged > 0
+            and lsa.get("all", {}).get("count", 0) > 0
+        ),
+        "identical_across_runs": identical,
+        "digest": digests[0][:16],
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "report": report,
+    }
+
+
+def stage_convergence_overhead(k, B, reps=15):
+    """ISSUE 6 overhead gate: the SPF dispatch path with the convergence
+    tracker ARMED and an open causal event active (worst case — every
+    dispatch runs the note_dispatch bookkeeping) against the same path
+    disarmed.  Same interleaved min-of-N discipline as the other
+    overhead gates; ok requires <2%."""
+    from contextlib import nullcontext
+
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.telemetry import convergence
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    backend.compute_whatif(topo, masks)  # warm: compile + graph cache
+    on_times, off_times = [], []
+    for rep in range(reps):
+        arms = ((True, on_times), (False, off_times))
+        for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+            if armed:
+                convergence.configure(4096)
+                ctx = convergence.activation(convergence.begin("lsa"))
+            else:
+                convergence.configure(0)
+                ctx = nullcontext()
+            with ctx:
+                t0 = time.perf_counter()
+                backend.compute_whatif(topo, masks)
+                times.append(time.perf_counter() - t0)
+    convergence.configure(0)
+    on_ms = float(np.min(on_times) * 1e3)
+    off_ms = float(np.min(off_times) * 1e3)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
+    return {
+        "ok": bool(overhead_pct < 2.0),
+        "enabled_ms": round(on_ms, 3),
+        "disabled_ms": round(off_ms, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "batch": int(B),
+        "reps": reps,
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -714,6 +795,14 @@ def main() -> None:
             "profiling_overhead": lambda: stage_profiling_overhead(
                 k10, 32 if small else 64
             ),
+            "convergence_storm": lambda: (
+                stage_convergence_storm(400, 120)
+                if small
+                else stage_convergence_storm(2500, 400)
+            ),
+            "convergence_overhead": lambda: stage_convergence_overhead(
+                k10, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -764,6 +853,15 @@ def main() -> None:
         # instrumentation, platform-independent — same story.
         extra["profiling_overhead_jaxcpu_small"] = _run_stage(
             "profiling_overhead", True, cpu=True
+        )
+        # Convergence observatory (ISSUE 6): the seeded storm runs on
+        # the virtual clock + JAX-CPU by design, so the headline
+        # scenario-diversity row survives a dead relay at full fidelity.
+        extra["convergence_storm_jaxcpu_small"] = _run_stage(
+            "convergence_storm", True, cpu=True
+        )
+        extra["convergence_overhead_jaxcpu_small"] = _run_stage(
+            "convergence_overhead", True, cpu=True
         )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -845,6 +943,10 @@ def main() -> None:
     # exemplars, and the span-tap ring must stay within noise (<2%) of
     # the un-profiled dispatch path.
     extra["profiling_overhead"] = _run_stage("profiling_overhead", small)
+    # Convergence observatory (ISSUE 6): seeded flap-storm distributions
+    # (deterministic digests) + the armed-instrument <2% gate.
+    extra["convergence_storm"] = _run_stage("convergence_storm", small)
+    extra["convergence_overhead"] = _run_stage("convergence_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

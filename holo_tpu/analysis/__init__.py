@@ -46,6 +46,7 @@ from holo_tpu.analysis.core import (  # noqa: F401 — public API
     all_rules,
     compare_to_baseline,
     default_baseline_path,
+    gate_findings,
     load_baseline,
     run_paths,
     run_source,

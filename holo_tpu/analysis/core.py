@@ -49,6 +49,11 @@ class Finding:
     line: int  # 1-based
     context: str  # enclosing qualname ("Class.method", "<module>")
     message: str
+    # Per-rule severity tier: "error" findings gate tier-1 (exit 1 /
+    # pytest failure); "warn" findings are reported but never fail the
+    # gate.  Excluded from the baseline key so promoting a rule between
+    # tiers does not churn the ratchet.
+    severity: str = "error"
 
     @property
     def key(self) -> str:
@@ -56,7 +61,11 @@ class Finding:
         return f"{self.rule}|{self.path}|{self.context}|{self.message}"
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule} [{self.context}] {self.message}"
+        tag = "" if self.severity == "error" else f" ({self.severity})"
+        return (
+            f"{self.path}:{self.line}: {self.rule}{tag} "
+            f"[{self.context}] {self.message}"
+        )
 
 
 # -- configuration ------------------------------------------------------
@@ -203,11 +212,20 @@ class ModuleInfo:
 
 
 class Rule:
-    """One lint rule: an id, a family, and a per-module check."""
+    """One lint rule: an id, a family, a severity tier, and a
+    per-module check.
+
+    ``severity``: "error" (default — new findings fail the tier-1 gate)
+    or "warn" (reported, surfaced in ``--list-rules``/JSON, but never
+    an exit-1).  Every shipped rule is currently error-tier; the warn
+    tier exists so a new rule can soak on real code before it is
+    promoted to gate duty.
+    """
 
     id = "HL000"
     title = "abstract rule"
     family = "tracer"  # "tracer" | "locks"
+    severity = "error"  # "error" | "warn"
 
     def check(self, mod: ModuleInfo) -> list[Finding]:
         raise NotImplementedError
@@ -221,6 +239,7 @@ class Rule:
             line=getattr(node, "lineno", 1),
             context=mod.qualname(node),
             message=message,
+            severity=self.severity,
         )
 
 
@@ -326,8 +345,16 @@ def load_baseline(path: Path) -> Counter:
     return out
 
 
+def gate_findings(findings: list[Finding]) -> list[Finding]:
+    """The subset that actually gates tier-1: error-tier findings.
+    Warn-tier findings are informational (they still render and land in
+    the JSON report, but never exit 1)."""
+    return [f for f in findings if f.severity == "error"]
+
+
 def write_baseline(path: Path, findings: list[Finding]) -> None:
     counts = Counter(f.key for f in findings)
+    severities = {f.key: f.severity for f in findings}
     doc = {
         "comment": (
             "holo-lint ratchet baseline: keys are rule|path|context|message "
@@ -338,7 +365,8 @@ def write_baseline(path: Path, findings: list[Finding]) -> None:
             "comment for sanctioned exceptions)."
         ),
         "findings": [
-            {"key": k, "count": c} for k, c in sorted(counts.items())
+            {"key": k, "count": c, "severity": severities.get(k, "error")}
+            for k, c in sorted(counts.items())
         ],
     }
     path.write_text(json.dumps(doc, indent=2) + "\n")

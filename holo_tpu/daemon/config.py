@@ -74,6 +74,12 @@ class TelemetryConfig:
     # default: the enabled path adds a block_until_ready barrier per
     # dispatch (gated < 2% by bench.py profiling_overhead).
     profile_device_time: bool = False
+    # Convergence observatory (ISSUE 6): > 0 arms the causal
+    # event→FIB tracker with that many open-event/timeline slots —
+    # holo_convergence_seconds{trigger,phase} histograms, causal ids on
+    # ibus envelopes, per-event timelines into the flight ring.  Off by
+    # default (gated < 2% by bench.py convergence_overhead).
+    convergence_events: int = 0
 
 
 @dataclass
@@ -169,6 +175,9 @@ class DaemonConfig:
                 t.get("flight-buffer-entries", 0)
             )
             cfg.telemetry.postmortem_dir = t.get("postmortem-dir")
+            cfg.telemetry.convergence_events = int(
+                t.get("convergence-events", 0)
+            )
             cfg.telemetry.profile_device_time = t.get(
                 "profile-device-time", False
             )

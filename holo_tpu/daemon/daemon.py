@@ -178,6 +178,15 @@ class Daemon:
             from holo_tpu.telemetry import profiling
 
             profiling.set_device_profiling(True)
+        # Convergence observatory ([telemetry] convergence-events,
+        # ISSUE 6): causal event→FIB tracing on this daemon's loop
+        # clock; timelines land in the flight ring when it is armed.
+        if tcfg.convergence_events:
+            from holo_tpu.telemetry import convergence
+
+            convergence.configure(
+                tcfg.convergence_events, clock=self.loop.clock.now
+            )
 
         # Actor supervision ([resilience], holo_tpu/resilience/): crashed
         # protocol actors restart under an exponential-backoff policy
@@ -225,6 +234,10 @@ class Daemon:
             # so on_restart and held-mail redelivery run single-writer
             # on the instance's thread.
             self.supervisor.adopt(tl.loop, sender=tl.send)
+            # The pump THREAD itself is supervised too: a loop-machinery
+            # exception killing the pump respawns it under the same
+            # restart policy instead of leaving the instance deaf.
+            self.supervisor.watch_pump(tl)
         if self.recorder is not None:
             # Instance messages bypass the primary loop under isolation;
             # journal them on the instance's own loop (same recorder —

@@ -25,6 +25,7 @@ import gnmi_lite_pb2 as pb  # noqa: E402
 import holo_tpu
 from holo_tpu import telemetry
 from holo_tpu.northbound.provider import CommitError
+from holo_tpu.telemetry import flight
 from holo_tpu.yang.schema import SchemaError
 
 # Subscribe-path hardening metrics: per-subscriber queues are bounded
@@ -141,10 +142,21 @@ class GnmiService:
         self.daemon = daemon
         self._subscribers: list[queue.Queue] = []
         self._sub_lock = threading.Lock()
+        # Per-subscriber identity + drop-burst tracking (ISSUE 6
+        # carry-over from PR 5): subscriber ordinal -> consecutive
+        # drops in the current burst.  Burst edges land in the
+        # flight-recorder ring so a postmortem bundle shows WHICH
+        # subscriber was shedding and when — the aggregate counter
+        # alone cannot answer that.
+        self._sub_ids: dict[int, int] = {}  # id(queue) -> ordinal
+        self._next_sub = 0
+        self._bursts: dict[int, int] = {}  # ordinal -> burst depth
 
     def _add_subscriber(self, q: queue.Queue) -> None:
         with self._sub_lock:
             self._subscribers.append(q)
+            self._next_sub += 1
+            self._sub_ids[id(q)] = self._next_sub
             _SUBSCRIBERS.set(len(self._subscribers))
 
     def _remove_subscriber(self, q: queue.Queue) -> None:
@@ -158,19 +170,60 @@ class GnmiService:
                 self._subscribers.remove(q)
             except ValueError:
                 pass
+            sid = self._sub_ids.pop(id(q), None)
+            burst = self._bursts.pop(sid, 0) if sid is not None else 0
             _SUBSCRIBERS.set(len(self._subscribers))
+        if burst:
+            # The subscriber died mid-burst: close the story in the ring.
+            flight.event(
+                "gnmi-drop-burst", subscriber=sid, dropped=burst,
+                ended="disconnect",
+            )
 
     def _fanout(self, notif) -> None:
         """Best-effort delivery to every subscriber: bounded queues drop
         (and count) on overflow rather than block the publisher or grow
-        memory for a stalled consumer."""
+        memory for a stalled consumer.  Burst edges (first drop; first
+        successful put after drops) are recorded per subscriber in the
+        flight ring — outside the subscriber lock."""
         with self._sub_lock:
-            targets = list(self._subscribers)
-        for q in targets:
+            # Burst membership rides the same snapshot: the all-healthy
+            # path (no open burst, put succeeds) then takes no further
+            # locks per subscriber — only burst edges pay for one.
+            targets = []
+            for q in self._subscribers:
+                sid = self._sub_ids.get(id(q), 0)
+                targets.append((q, sid, sid in self._bursts))
+        events = []
+        for q, sid, in_burst in targets:
             try:
                 q.put_nowait(notif)
             except queue.Full:
                 _SUB_DROPS.inc()
+                with self._sub_lock:
+                    if id(q) not in self._sub_ids:
+                        # Removed concurrently: _remove_subscriber
+                        # already closed (or owns) this burst story —
+                        # re-creating the entry would leak it forever.
+                        depth = 0
+                    else:
+                        depth = self._bursts.get(sid, 0) + 1
+                        self._bursts[sid] = depth
+                if depth == 1:
+                    events.append(("gnmi-drop-burst-start", sid, 0))
+            else:
+                if in_burst:
+                    with self._sub_lock:
+                        burst = self._bursts.pop(sid, 0)
+                    if burst:
+                        events.append(("gnmi-drop-burst", sid, burst))
+        for kind, sid, dropped in events:
+            if kind == "gnmi-drop-burst-start":
+                flight.event(kind, subscriber=sid)
+            else:
+                flight.event(
+                    kind, subscriber=sid, dropped=dropped, ended="drained"
+                )
 
     def Capabilities(self, request, context):
         resp = pb.CapabilityResponse(

@@ -178,15 +178,25 @@ class InterfaceProvider(Provider, Actor):
             st.mtu = new_mtu
             st.enabled = new_enabled
             st.addresses = [ip_interface(a) for a in entry.get("address", [])]
-            self.ibus.publish(
-                TOPIC_INTERFACE_UPD,
-                # operative = admin AND carrier: a config commit must
-                # not report a carrier-down link as up (the RIB treats
-                # operative=True as an FRR restore signal).
-                InterfaceUpdMsg(ifname=name, ifindex=st.ifindex, mtu=st.mtu,
-                                operative=st.enabled and st.operative),
-                ifname=name,
+            # Causal origin: an interface config change is a topology
+            # event (convergence trigger class "ifconfig").
+            from holo_tpu.telemetry import convergence
+
+            eid = convergence.begin(
+                convergence.TRIGGER_IFCONFIG, ifname=name,
+                operative=st.enabled and st.operative,
             )
+            with convergence.activation(eid):
+                self.ibus.publish(
+                    TOPIC_INTERFACE_UPD,
+                    # operative = admin AND carrier: a config commit must
+                    # not report a carrier-down link as up (the RIB treats
+                    # operative=True as an FRR restore signal).
+                    InterfaceUpdMsg(ifname=name, ifindex=st.ifindex,
+                                    mtu=st.mtu,
+                                    operative=st.enabled and st.operative),
+                    ifname=name,
+                )
             for addr in st.addresses:
                 self.ibus.publish(TOPIC_ADDRESS_ADD, (name, addr), ifname=name)
         from holo_tpu.utils.ibus import TOPIC_INTERFACE_DEL
@@ -230,12 +240,21 @@ class InterfaceProvider(Provider, Actor):
             st.operative = ev.up and ev.running
             if ev.mtu:
                 st.mtu = ev.mtu
-            self.ibus.publish(
-                TOPIC_INTERFACE_UPD,
-                InterfaceUpdMsg(ifname=ev.ifname, ifindex=st.ifindex,
-                                mtu=st.mtu, operative=st.operative),
-                ifname=ev.ifname,
+            # Causal origin: a kernel link event is the carrier-loss /
+            # carrier-recovery moment (convergence trigger "carrier").
+            from holo_tpu.telemetry import convergence
+
+            eid = convergence.begin(
+                convergence.TRIGGER_CARRIER, ifname=ev.ifname,
+                operative=st.operative,
             )
+            with convergence.activation(eid):
+                self.ibus.publish(
+                    TOPIC_INTERFACE_UPD,
+                    InterfaceUpdMsg(ifname=ev.ifname, ifindex=st.ifindex,
+                                    mtu=st.mtu, operative=st.operative),
+                    ifname=ev.ifname,
+                )
         elif ev.kind == "link-del":
             if self.interfaces.pop(ev.ifname, None) is not None:
                 self.ibus.publish(TOPIC_INTERFACE_DEL, ev.ifname,

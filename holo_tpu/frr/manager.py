@@ -29,7 +29,7 @@ from holo_tpu.frr.kernel import BackupTable
 from holo_tpu.ops.graph import Topology
 from holo_tpu.resilience import faults
 from holo_tpu.resilience.breaker import CircuitBreaker
-from holo_tpu.telemetry import profiling
+from holo_tpu.telemetry import convergence, profiling
 
 # FRR dispatch observability, mirroring the SPF backend's signal set:
 # wall time per backup-table computation, recompiles vs shape hits, and
@@ -251,6 +251,7 @@ class FrrEngine:
             with profiling.annotation("frr.batch.device"):
                 profiling.sync(out)
         nl = fin.n_links
+        convergence.note_dispatch("frr", "device")
         with profiling.stage("frr.batch", "readback"):
             with sanctioned_transfer("frr.batch.unmarshal"):
                 return BackupTable(
@@ -270,7 +271,10 @@ class FrrEngine:
         inputs — the backup table is bit-identical by the parity suite."""
         from holo_tpu.frr.scalar import frr_reference
 
-        return frr_reference(topo, self.n_atoms, inputs=fin)
+        try:
+            return frr_reference(topo, self.n_atoms, inputs=fin)
+        finally:
+            convergence.note_dispatch("frr", "fallback")
 
     # -- dispatch
 
@@ -302,6 +306,7 @@ class FrrEngine:
                 from holo_tpu.frr.scalar import frr_reference
 
                 table = frr_reference(topo, self.n_atoms, inputs=fin)
+                convergence.note_dispatch("frr", "scalar")
         _FRR_SECONDS.labels(engine=self.engine).observe(
             time.perf_counter() - t0
         )

@@ -540,7 +540,16 @@ class BfdInstance(Actor):
                 BfdState.INIT: "init",
                 BfdState.ADMIN_DOWN: "admin-down",
             }[new]
-            self.ibus.publish(TOPIC_BFD_STATE, BfdStateUpd(s.key, label))
+            # Causal origin stamp: a BFD state change IS a topology
+            # event — the id rides the publish into the RIB's O(1)
+            # local-repair flip and any subscribed protocol's SPF.
+            from holo_tpu.telemetry import convergence
+
+            eid = convergence.begin(
+                convergence.TRIGGER_BFD, state=label, key=str(s.key)
+            )
+            with convergence.activation(eid):
+                self.ibus.publish(TOPIC_BFD_STATE, BfdStateUpd(s.key, label))
         # Faster tx once the session leaves Down.
         self._arm_tx(s, self._tx_interval(s))
 

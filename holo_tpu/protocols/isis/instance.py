@@ -32,6 +32,7 @@ from holo_tpu.protocols.isis.packet import (
     decode_pdu,
 )
 from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.telemetry import convergence
 from holo_tpu.utils.bytesbuf import DecodeError
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
@@ -382,6 +383,8 @@ class IsisInstance(Actor):
         self.hostnames: dict[bytes, str] = {}
         self.spf_run_count = 0
         self._spf_pending = False
+        # Convergence-observatory causal ids pending on the next run.
+        self._conv_pending: list = []
         # Full-vs-RouteOnly classification (reference
         # holo-isis/src/spf.rs:150-156, lsdb.rs:1558-1612): an LSP whose
         # IS-reachability TLVs are unchanged only needs route
@@ -1828,6 +1831,11 @@ class IsisInstance(Actor):
             self._spf_type_full = True
         if self.spf_delay_state == "quiet":
             self.spf_delay_state = "short-wait"
+        # Causal origin stamp (LSP arrival/change is the IS-IS trigger
+        # class; shared contract, see the OSPFv2 instance).
+        convergence.pend_schedule(
+            self._conv_pending, convergence.TRIGGER_LSP, instance=self.name
+        )
         if not self._spf_pending:
             self._spf_pending = True
             self._spf_timer.start(0.1)
@@ -1841,8 +1849,9 @@ class IsisInstance(Actor):
             self.spf_delay_state = "quiet"
 
     def run_spf(self) -> None:
-        with telemetry.span("isis.spf", instance=self.name):
-            self._run_spf_traced()
+        with convergence.spf_run(self._conv_pending, self.name):
+            with telemetry.span("isis.spf", instance=self.name):
+                self._run_spf_traced()
 
     def _run_spf_traced(self) -> None:
         _ISIS_SPF_RUNS.labels(instance=self.name).inc()

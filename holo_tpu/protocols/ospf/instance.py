@@ -91,6 +91,7 @@ from holo_tpu.protocols.ospf.packet import (
 )
 from holo_tpu.protocols.ospf.spf_run import build_topology, derive_routes
 from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.telemetry import convergence
 from holo_tpu.utils.ip import ALL_DR_RTRS_V4, ALL_SPF_RTRS_V4, mask_of
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
@@ -352,6 +353,9 @@ class OspfInstance(Actor):
         self._spf_triggers: list = []
         self._spf_force_full = True
         self._spf_cache: dict | None = None
+        # Convergence-observatory causal ids pending on the next SPF run
+        # (bounded; stamped in _schedule_spf, drained by run_spf).
+        self._conv_pending: list = []
         self.ibus = None  # set via attach_ibus for RIB integration
         self.routing_actor = "routing"
         # Externals we originate (type 5; stored in every area's LSDB with
@@ -2620,6 +2624,19 @@ class OspfInstance(Actor):
             self._spf_force_full = True
         else:
             self._spf_triggers.append(trigger)
+        # Convergence observatory: stamp the causal event at its origin
+        # (an LSA install or a trigger-less config/interface event) —
+        # or inherit the already-active ids when this schedule is part
+        # of a larger causal chain.  Pending ids drain at the SPF run
+        # the delay FSM coalesces them into (shared contract:
+        # convergence.pend_schedule / convergence.spf_run).
+        convergence.pend_schedule(
+            self._conv_pending,
+            convergence.TRIGGER_LSA
+            if trigger is not None
+            else convergence.TRIGGER_IFCONFIG,
+            instance=self.name,
+        )
         cfg = self.config.spf
         now = self.loop.clock.now()
         self._spf_trigger_count += 1
@@ -2710,8 +2727,12 @@ class OspfInstance(Actor):
         }
 
     def run_spf(self) -> None:
-        with telemetry.span("ospf.spf", instance=self.name):
-            self._run_spf_traced()
+        # Pending causal ids drain into an active context: route
+        # publishes to the RIB (ibus requests / marshalled route_cb)
+        # capture them, so the event rides through to the FIB commit.
+        with convergence.spf_run(self._conv_pending, self.name):
+            with telemetry.span("ospf.spf", instance=self.name):
+                self._run_spf_traced()
 
     def _run_spf_traced(self) -> None:
         now = self.loop.clock.now()

@@ -39,6 +39,7 @@ from holo_tpu.protocols.ospf.neighbor import (
     nsm_transition,
 )
 from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
+from holo_tpu.telemetry import convergence
 from holo_tpu.utils.ip import ALL_SPF_RTRS_V6
 from holo_tpu.utils.netio import NetIo, NetRxPacket
 from holo_tpu.utils.runtime import Actor
@@ -223,6 +224,8 @@ class OspfV3Instance(Actor):
         self._spf_triggers: list = []
         self._spf_force_full = True
         self._spf_cache: dict | None = None
+        # Convergence-observatory causal ids pending on the next run.
+        self._conv_pending: list = []
         # SPF run log ring (reference spf.rs:770-804).
         self.spf_log: list[dict] = []
         self._dd_seq = 0x3000
@@ -1314,6 +1317,14 @@ class OspfV3Instance(Actor):
             self._spf_force_full = True
         else:
             self._spf_triggers.append(trigger)
+        # Causal origin stamp (shared contract; see the v2 instance).
+        convergence.pend_schedule(
+            self._conv_pending,
+            convergence.TRIGGER_LSA
+            if trigger is not None
+            else convergence.TRIGGER_IFCONFIG,
+            instance=self.name,
+        )
         if not self._spf_pending:
             self._spf_pending = True
             self._spf_timer.start(0.1)
@@ -1543,8 +1554,9 @@ class OspfV3Instance(Actor):
         }
 
     def run_spf(self) -> None:
-        with telemetry.span("ospfv3.spf", instance=self.name):
-            self._run_spf_traced()
+        with convergence.spf_run(self._conv_pending, self.name):
+            with telemetry.span("ospfv3.spf", instance=self.name):
+                self._run_spf_traced()
 
     def _run_spf_traced(self) -> None:
         triggers = self._spf_triggers

@@ -160,6 +160,26 @@ class FaultInjector:
             return True
         return False
 
+    def kill_pump(self, tl, reason: str = "chaos") -> None:
+        """Kill a :class:`~holo_tpu.utils.preempt.ThreadedLoop`'s pump
+        THREAD (not an actor): arm a zero-delay timer whose message
+        factory raises — ``Timer._fire`` runs it outside the EventLoop's
+        crash containment, so the exception escapes ``run_until_idle``
+        and takes the pump thread down.  This is the seam the
+        pump-respawn supervision path (``Supervisor.watch_pump``) is
+        tested against."""
+
+        def boom():
+            raise InjectedFault(f"pump kill: {reason}")
+
+        t = tl.loop.timer("_pump_kill", boom)
+        t.start(0.0)
+        self._record("pump.kill")
+        # Nudge the pump so it wakes immediately instead of on its next
+        # poll interval (the send target is unknown by design — only
+        # the wake matters).
+        tl.send("_pump_kill", None)
+
 
 class FaultyNetIo(NetIo):
     """NetIo decorator raising seeded OSErrors from ``send`` — the

@@ -138,6 +138,10 @@ class Supervisor(Actor):
         self.degraded: set[str] = set()
         self._recent: dict[str, list[float]] = {}  # crash times in window
         self._timers: dict[str, object] = {}
+        # Watched ThreadedLoop pumps: pseudo-actor name -> ThreadedLoop.
+        # A dead pump thread is restarted through the same policy
+        # machinery as a crashed actor (backoff, crash-loop degrade).
+        self._pumps: dict[str, object] = {}
         _SUPERVISORS.add(self)
 
     # -- wiring
@@ -208,6 +212,27 @@ class Supervisor(Actor):
 
         loop.set_supervisor(notify, hold_crashed=True)
 
+    def watch_pump(self, tl) -> str:
+        """Supervise a :class:`~holo_tpu.utils.preempt.ThreadedLoop`'s
+        pump THREAD itself (the detected-but-not-respawned gap: a pump
+        dying to a loop-machinery exception used to leave the instance
+        deaf until unplacement).  The pump is modeled as a pseudo-actor
+        ``pump:<loop name>`` under the same :class:`RestartPolicy` —
+        exponential backoff with deterministic jitter, crash-loop →
+        permanent degraded.  Returns the pseudo-actor name."""
+        name = f"pump:{tl.name}"
+        self._pumps[name] = tl
+        home = self._loops[0][0] if self._loops else self.loop
+
+        def on_crash(exc, n=name) -> None:
+            # Runs on the dying pump thread: marshal to the home loop
+            # like every other crash notice (journaled + replayable).
+            flight.event("pump-crash", loop=n, error=repr(exc))
+            home.send(self.name, CrashNotice(n, repr(exc)))
+
+        tl.on_pump_crash = on_crash
+        return name
+
     def unadopt(self, loop: EventLoop) -> None:
         """Stop supervising ``loop`` (instance unplacement): drop the
         reference (the daemon churns instances over a long lifetime —
@@ -217,6 +242,11 @@ class Supervisor(Actor):
         stale crash history."""
         for name in list(loop.actors):
             self.forget(name)
+        for pname, tl in list(self._pumps.items()):
+            if tl.loop is loop:
+                tl.on_pump_crash = None
+                del self._pumps[pname]
+                self.forget(pname)
         self._loops = [(lp, s) for lp, s in self._loops if lp is not loop]
 
     def forget(self, actor: str) -> None:
@@ -294,6 +324,13 @@ class Supervisor(Actor):
     def _restart(self, actor: str) -> None:
         self._timers.pop(actor, None)
         if actor in self.degraded:
+            return
+        tl = self._pumps.get(actor)
+        if tl is not None:
+            # Pump respawn: a fresh thread over the same EventLoop —
+            # actors/inboxes/timers survive, pending mail drains as
+            # soon as the new pump runs.
+            self._restarted(actor, tl.respawn())
             return
         owning = self._owning(actor)
         if owning is None:

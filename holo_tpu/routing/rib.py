@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Callable, NamedTuple
 
 from holo_tpu import telemetry
+from holo_tpu.telemetry import convergence
 from holo_tpu.utils.ibus import (
     TOPIC_BFD_STATE,
     TOPIC_INTERFACE_DEL,
@@ -327,6 +328,9 @@ class RibManager(Actor):
             flipped += 1
         if flipped:
             _RIB_FLIPS.inc(flipped)
+            # The backup flip IS the FIB moment for a BFD/carrier event:
+            # the causal context rode in on the IbusMsg envelope.
+            convergence.fib_commit(op="repair", flips=flipped)
         return flipped
 
     def local_restore(self, ifname: str | None, addr=None) -> int:
@@ -360,6 +364,7 @@ class RibManager(Actor):
             restored += 1
         if restored:
             _RIB_RESTORES.inc(restored)
+            convergence.fib_commit(op="restore", restores=restored)
         return restored
 
     # -- next-hop tracking (reference rib.rs:64,290)
@@ -431,6 +436,7 @@ class RibManager(Actor):
         _RIB_OPS.labels(
             op="replace" if msg.protocol in pr.entries else "add"
         ).inc()
+        convergence.observe(convergence.PHASE_RIB, op="add")
         pr.entries[msg.protocol] = RibEntry(msg)
         self._reselect(msg.prefix)
         self._nht_reeval(msg.prefix)
@@ -457,6 +463,7 @@ class RibManager(Actor):
             return
         if msg.protocol in pr.entries:
             _RIB_OPS.labels(op="withdraw").inc()
+            convergence.observe(convergence.PHASE_RIB, op="withdraw")
         pr.entries.pop(msg.protocol, None)
         _RIB_PREFIXES.set(
             len(self.routes) - (0 if pr.entries else 1)
@@ -468,6 +475,7 @@ class RibManager(Actor):
                 self.kernel.uninstall(msg.prefix)
                 _RIB_INSTALLS.labels(op="uninstall").inc()
                 self._programmed.discard(msg.prefix)
+                convergence.fib_commit(op="uninstall")
             self.ibus.publish(
                 TOPIC_REDISTRIBUTE_DEL, RouteKeyMsg(msg.protocol, msg.prefix)
             )
@@ -513,6 +521,11 @@ class RibManager(Actor):
                     )
                     _RIB_INSTALLS.labels(op="install").inc()
                     self._programmed.add(prefix)
+                    # Event-to-FIB: the kernel now reflects the change
+                    # this causal event started (first install closes
+                    # the event; later installs for the same event are
+                    # the same virtual instant under the loop clock).
+                    convergence.fib_commit(op="install")
             elif prefix in self._programmed:
                 # The withdrawn entry takes any active local repair with
                 # it — a later restore must not resurrect the route.
@@ -520,6 +533,7 @@ class RibManager(Actor):
                 self.kernel.uninstall(prefix)
                 _RIB_INSTALLS.labels(op="uninstall").inc()
                 self._programmed.discard(prefix)
+                convergence.fib_commit(op="uninstall")
             self.ibus.publish(TOPIC_REDISTRIBUTE_ADD, best.msg)
         if self.on_change is not None:
             self.on_change()

@@ -29,7 +29,7 @@ from holo_tpu.ops.spf_engine import (
     spf_whatif_batch,
 )
 from holo_tpu.spf.scalar import spf_reference
-from holo_tpu.telemetry import profiling
+from holo_tpu.telemetry import convergence, profiling
 
 # Device-dispatch observability (the tentpole signal set): wall time per
 # dispatch, device->host readback time, jit recompiles vs shape-cache
@@ -128,6 +128,7 @@ class ScalarSpfBackend(SpfBackend):
             time.perf_counter() - t0
         )
         _BATCH_SCENARIOS.labels(kind="one").inc()
+        convergence.note_dispatch("spf", "scalar")
         return res
 
     def compute_whatif(self, topo, edge_masks):
@@ -141,6 +142,7 @@ class ScalarSpfBackend(SpfBackend):
             time.perf_counter() - t0
         )
         _BATCH_SCENARIOS.labels(kind="whatif").inc(len(res))
+        convergence.note_dispatch("spf", "scalar")
         return res
 
     def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
@@ -254,24 +256,41 @@ class TpuSpfBackend(SpfBackend):
     # pin the two backends bit-identical), and repeated failures open
     # the circuit so a dead device stops being retried per-SPF.
 
+    @staticmethod
+    def _noted_fallback(fn):
+        """Run the scalar fallback and tag the active convergence
+        events with ``fallback`` (AFTER the oracle's own ``scalar``
+        note, so the sticky fallback verdict is what the event closes
+        with — storm distributions split on it)."""
+        try:
+            return fn()
+        finally:
+            convergence.note_dispatch("spf", "fallback")
+
     def compute(self, topo, edge_mask=None):
         return self.breaker.call(
             lambda: self._device_compute(topo, edge_mask),
-            lambda: self._oracle.compute(topo, edge_mask),
+            lambda: self._noted_fallback(
+                lambda: self._oracle.compute(topo, edge_mask)
+            ),
             context="spf.one",
         )
 
     def compute_whatif(self, topo, edge_masks):
         return self.breaker.call(
             lambda: self._device_whatif(topo, edge_masks),
-            lambda: self._oracle.compute_whatif(topo, edge_masks),
+            lambda: self._noted_fallback(
+                lambda: self._oracle.compute_whatif(topo, edge_masks)
+            ),
             context="spf.whatif",
         )
 
     def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
         return self.breaker.call(
             lambda: self._device_multiroot(topo, roots),
-            lambda: self._oracle.compute_multiroot(topo, roots),
+            lambda: self._noted_fallback(
+                lambda: self._oracle.compute_multiroot(topo, roots)
+            ),
             context="spf.multiroot",
         )
 
@@ -319,6 +338,7 @@ class TpuSpfBackend(SpfBackend):
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="one").inc()
+        convergence.note_dispatch("spf", "device")
         return res
 
     def prepare_blocked(self, topo: Topology):
@@ -440,6 +460,7 @@ class TpuSpfBackend(SpfBackend):
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="whatif").inc(masks.shape[0])
+        convergence.note_dispatch("spf", "device")
         return [
             SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
             for i in range(masks.shape[0])
@@ -489,4 +510,5 @@ class TpuSpfBackend(SpfBackend):
         _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="multiroot").inc(roots_i32.shape[0])
+        convergence.note_dispatch("spf", "device")
         return res

@@ -10,6 +10,12 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
         name                     # counter/gauge: bare family name;
         value                    #   histograms expand to _count/_sum
         labels                   # "k=v,k=v" ("" when label-less)
+        exemplars                # histogram _count rows only: the
+                                 #   OpenMetrics bucket exemplars
+                                 #   ("le=<b>:span_id=<id>:value=<v>;...")
+                                 #   Prometheus renders since PR 5 —
+                                 #   the gNMI surface now carries the
+                                 #   same span-id join keys
       health/                    # resilience summary (ISSUE 4)
         breakers/<name>/...      # dispatch-breaker state + failure tally
         supervision/...          # degraded actors, restart counts
@@ -55,14 +61,21 @@ class TelemetryStateProvider(NbProvider):
                     ]
                 else:
                     rows = [(fam.name, child.value)]
+                exemplars = (
+                    _exemplar_leaf(child) if fam.kind == "histogram" else ""
+                )
                 for name, value in rows:
-                    metrics.append(
-                        {
-                            "name": f"{name}{{{labels}}}" if labels else name,
-                            "value": value,
-                            "labels": labels,
-                        }
-                    )
+                    entry = {
+                        "name": f"{name}{{{labels}}}" if labels else name,
+                        "value": value,
+                        "labels": labels,
+                    }
+                    if exemplars and name.endswith("_count"):
+                        # One leaf per histogram child (on the _count
+                        # row): the bucket exemplars Prometheus has
+                        # rendered since PR 5, now on the gNMI surface.
+                        entry["exemplars"] = exemplars
+                    metrics.append(entry)
         out = {"metric": metrics}
         health = _resilience_health()
         if health:
@@ -72,7 +85,25 @@ class TelemetryStateProvider(NbProvider):
         rec = flight.recorder()
         if rec is not None:
             out["flight"] = rec.stats()
+        from holo_tpu.telemetry import convergence
+
+        tr = convergence.tracker()
+        if tr is not None:
+            out["convergence"] = tr.stats()
         return {ROOT: out}
+
+
+def _exemplar_leaf(hist) -> str:
+    """Compact scalar rendering of a histogram child's OpenMetrics
+    bucket exemplars: ``le=<bucket>:<k>=<v>:value=<obs>`` joined by
+    ``;`` in ascending bucket order (a gNMI leaf carries one scalar —
+    the span-id join key is what matters)."""
+    out = []
+    for le, (pairs, value) in sorted(hist.exemplars().items()):
+        le_s = "+Inf" if le == float("inf") else f"{le:g}"
+        kv = ":".join(f"{k}={v}" for k, v in pairs)
+        out.append(f"le={le_s}:{kv}:value={value:g}")
+    return ";".join(out)
 
 
 def _resilience_health() -> dict:

@@ -400,7 +400,10 @@ def cmd_lint(args) -> int:
         from holo_tpu.analysis import all_rules
 
         for rule in all_rules():
-            print(f"{rule.id}  [{rule.family:6s}]  {rule.title}")
+            print(
+                f"{rule.id}  [{rule.family:6s}]  [{rule.severity:5s}]  "
+                f"{rule.title}"
+            )
         return 0
 
     result = run_paths(paths, root=repo_root)
@@ -422,6 +425,12 @@ def cmd_lint(args) -> int:
 
     baseline = load_baseline(baseline_path)
     new, unused = compare_to_baseline(result.findings, baseline)
+    # Severity tiers: only error-tier findings gate (exit 1); warn-tier
+    # findings render as warnings and ride the JSON report.
+    from holo_tpu.analysis import gate_findings
+
+    new_errors = gate_findings(new)
+    new_warns = [f for f in new if f.severity != "error"]
 
     if args.json:
         doc = {
@@ -433,22 +442,28 @@ def cmd_lint(args) -> int:
                     "line": f.line,
                     "context": f.context,
                     "message": f.message,
+                    "severity": f.severity,
                     "baselined": f not in new,
                 }
                 for f in result.findings
             ],
             "new": len(new),
+            "new_errors": len(new_errors),
+            "new_warnings": len(new_warns),
             "suppressed": len(result.suppressed),
             "unused_baseline_keys": sorted(unused),
         }
         print(json.dumps(doc, indent=2))
     else:
-        for f in new:
+        for f in new_errors:
             print(f.render())
+        for f in new_warns:
+            print(f"warning: {f.render()}")
         n_base = len(result.findings) - len(new)
         print(
             f"holo-lint: {result.files_checked} files, "
-            f"{len(new)} new finding(s), {n_base} baselined, "
+            f"{len(new_errors)} new error(s), "
+            f"{len(new_warns)} new warning(s), {n_base} baselined, "
             f"{len(result.suppressed)} suppressed"
         )
         if unused:
@@ -459,7 +474,7 @@ def cmd_lint(args) -> int:
             )
             for key in sorted(unused):
                 print(f"  {key}")
-    return 1 if new else 0
+    return 1 if new_errors else 0
 
 
 def main(argv=None) -> int:

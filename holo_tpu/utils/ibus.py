@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from holo_tpu import telemetry
+from holo_tpu.telemetry import convergence
 from holo_tpu.utils.runtime import EventLoop
 from holo_tpu.utils.southbound import Protocol
 
@@ -36,11 +37,23 @@ _UNDELIVERABLE = telemetry.counter(
 
 @dataclass
 class IbusMsg:
-    """Envelope delivered to subscriber actors."""
+    """Envelope delivered to subscriber actors.
+
+    ``event_id`` is the causal-event stamp of the convergence
+    observatory: construction captures the publisher's active event ids
+    (a tuple, or None while the tracker is disarmed / no event is open)
+    so the EventLoop delivery hook can re-activate the causal context
+    inside the subscriber's handler — this is how an LSA arrival's id
+    rides publish → protocol actor → RIB → FIB commit."""
 
     topic: str
     payload: Any
     sender: str = ""
+    event_id: tuple | None = None
+
+    def __post_init__(self):
+        if self.event_id is None:
+            self.event_id = convergence.current() or None
 
 
 # Topic names (grouped as in ibus.rs:112-228).

@@ -41,6 +41,13 @@ class ThreadedLoop:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
+        # Pump-death containment: an exception escaping the loop
+        # machinery itself (not a handler — those are contained by the
+        # EventLoop) kills the pump thread.  The callback lets a
+        # supervisor respawn it under its restart policy instead of the
+        # instance silently going deaf (ROADMAP item 3 carry-over).
+        self.on_pump_crash = None  # callable(exc) | None
+        self.pump_crashes = 0
         self._thread = threading.Thread(
             target=self._pump, name=name, daemon=True
         )
@@ -48,6 +55,25 @@ class ThreadedLoop:
     def start(self) -> "ThreadedLoop":
         self._thread.start()
         return self
+
+    def pump_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def respawn(self) -> bool:
+        """Start a fresh pump thread after a pump crash (the supervisor
+        restart primitive for the pump itself).  The loop's actors,
+        inboxes, and timers are untouched — only the thread died — so
+        pending mail drains as soon as the new pump runs.  False when
+        the old thread is still alive (nothing to do) or the loop was
+        stopped on purpose."""
+        with self._wake:
+            if self._stop or self._thread.is_alive():
+                return False
+            self._thread = threading.Thread(
+                target=self._pump, name=self.name, daemon=True
+            )
+        self._thread.start()
+        return True
 
     def register(self, actor: Actor, name: str | None = None) -> None:
         with self._lock:
@@ -144,6 +170,24 @@ class ThreadedLoop:
         self._thread.join(timeout=5)
 
     def _pump(self) -> None:
+        try:
+            self._pump_body()
+        except Exception as exc:  # noqa: BLE001 — pump-death containment
+            # Handler exceptions never reach here (EventLoop contains
+            # them); this is the loop machinery itself dying (a raising
+            # timer msg_fn, a broken clock).  Report to the supervisor
+            # hook so the pump can be respawned under policy.
+            self.pump_crashes += 1
+            import logging
+
+            logging.getLogger("holo_tpu.runtime").exception(
+                "pump thread %s died", self.name
+            )
+            hook = self.on_pump_crash
+            if hook is not None:
+                hook(exc)
+
+    def _pump_body(self) -> None:
         while True:
             with self._wake:
                 if self._stop:
@@ -213,11 +257,17 @@ class _MarshalCall:
     the same serialization as every other provider message.
     """
 
-    __slots__ = ("fn", "args")
+    __slots__ = ("fn", "args", "event_id")
 
     def __init__(self, fn, args):
         self.fn = fn
         self.args = args
+        # Causal stamp: a route_cb marshalled off an instance thread
+        # mid-SPF carries the convergence event ids across the thread
+        # hop (the primary loop's delivery hook re-activates them).
+        from holo_tpu.telemetry import convergence
+
+        self.event_id = convergence.current() or None
 
 
 class CallRunner(Actor):

@@ -28,6 +28,23 @@ from typing import Any, Callable
 
 log = logging.getLogger("holo_tpu.runtime")
 
+# Delivery-context hook (the convergence observatory's propagation
+# seam): when installed, every message delivery asks the hook for a
+# context manager derived from the message (e.g. re-activating the
+# causal event ids an IbusMsg was stamped with) and runs the handler
+# inside it.  None (the default) costs one module-global check per
+# delivery; the hook returning None means "no context for this message".
+_DELIVERY_CONTEXT = None
+
+
+def set_delivery_context(fn) -> None:
+    """Install/clear the delivery-context hook (``fn(msg) -> context
+    manager | None``).  Installed by
+    :func:`holo_tpu.telemetry.convergence.configure`; tests may stack
+    their own as long as they restore the previous value."""
+    global _DELIVERY_CONTEXT
+    _DELIVERY_CONTEXT = fn
+
 
 class RealClock:
     def now(self) -> float:
@@ -352,7 +369,13 @@ class EventLoop:
             try:
                 if isinstance(msg, PoisonPill):
                     raise InjectedCrash(msg.reason)
-                actor.handle(msg)
+                hook = _DELIVERY_CONTEXT
+                ctx = hook(msg) if hook is not None else None
+                if ctx is None:
+                    actor.handle(msg)
+                else:
+                    with ctx:
+                        actor.handle(msg)
             except Exception as exc:  # crash containment
                 log.exception("actor %s crashed", name)
                 self._crashed[name] = exc

@@ -1,0 +1,383 @@
+"""Convergence observatory (ISSUE 6): causal event→FIB tracing.
+
+Covers the propagation contract unit-by-unit (origin stamp → ibus
+envelope → delivery-hook context → RIB commit), the deterministic
+seeded-storm e2e (identical causal timelines across runs; final FIB
+bit-identical to a clean scalar run), and the exemplar/flight surfaces
+the observatory feeds.
+"""
+
+import time
+
+import pytest
+
+from holo_tpu import telemetry
+from holo_tpu.telemetry import convergence
+from holo_tpu.utils.ibus import Ibus, IbusMsg
+from holo_tpu.utils.runtime import Actor, EventLoop, VirtualClock
+
+
+@pytest.fixture()
+def tracker():
+    loop_clock = [0.0]
+    tr = convergence.configure(256, clock=lambda: loop_clock[0])
+    tr._test_clock = loop_clock  # advance by mutating [0]
+    yield tr
+    convergence.configure(0)
+
+
+def _conv_hist():
+    return telemetry.registry().histogram(
+        "holo_convergence_seconds", labelnames=("trigger", "phase")
+    )
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_begin_activation_current(tracker):
+    assert convergence.current() == ()
+    eid = convergence.begin("lsa", detail="x")
+    with convergence.activation(eid):
+        assert convergence.current() == (eid,)
+        with convergence.activation((eid, eid + 1)):
+            assert convergence.current() == (eid, eid + 1)
+        assert convergence.current() == (eid,)
+    assert convergence.current() == ()
+
+
+def test_disarmed_is_noop():
+    convergence.configure(0)
+    assert convergence.begin("lsa") is None
+    assert convergence.current() == ()
+    with convergence.activation(None):
+        pass
+    convergence.observe("spf")
+    convergence.fib_commit()
+    assert convergence.sweep() == 0
+
+
+def test_ibus_envelope_captures_active_event(tracker):
+    eid = convergence.begin("bfd")
+    with convergence.activation(eid):
+        msg = IbusMsg("t", "payload")
+    assert msg.event_id == (eid,)
+    assert IbusMsg("t", "p").event_id is None
+
+
+def test_delivery_hook_reactivates_context(tracker):
+    """ibus publish → subscriber actor handling runs INSIDE the causal
+    context the publisher had active (the runtime delivery hook)."""
+    loop = EventLoop(clock=VirtualClock())
+    bus = Ibus(loop)
+    seen = []
+
+    class Sub(Actor):
+        name = "sub"
+
+        def handle(self, msg):
+            seen.append(convergence.current())
+
+    loop.register(Sub())
+    bus.subscribe("topic", "sub")
+    eid = convergence.begin("lsa")
+    with convergence.activation(eid):
+        bus.publish("topic", "hello")
+    loop.run_until_idle()
+    assert seen == [(eid,)]
+
+
+def test_marshalled_callback_carries_event_id(tracker):
+    from holo_tpu.utils.preempt import _MarshalCall
+
+    eid = convergence.begin("lsa")
+    with convergence.activation(eid):
+        mc = _MarshalCall(lambda: None, ())
+    assert mc.event_id == (eid,)
+    assert _MarshalCall(lambda: None, ()).event_id is None
+
+
+def test_observe_once_per_phase_with_exemplar(tracker):
+    before = _conv_hist().labels(trigger="lsa", phase="spf").count
+    eid = convergence.begin("lsa")
+    tracker._test_clock[0] = 1.5
+    convergence.observe("spf", eids=(eid,))
+    convergence.observe("spf", eids=(eid,))  # dedup: once per phase
+    child = _conv_hist().labels(trigger="lsa", phase="spf")
+    assert child.count == before + 1
+    # No span active -> the exemplar carries the event id join key.
+    ex = child.exemplars()
+    assert any(
+        ("event_id", str(eid)) in pairs for pairs, _v in ex.values()
+    )
+
+
+def test_fib_commit_closes_event_and_flags_fallback(tracker):
+    eid = convergence.begin("lsa")
+    with convergence.activation(eid):
+        convergence.note_dispatch("spf", "fallback")
+        tracker._test_clock[0] = 2.0
+        convergence.fib_commit(op="install")
+    recs = tracker.timelines()
+    assert len(recs) == 1 and recs[0]["outcome"] == "converged"
+    assert recs[0]["fallback"] is True
+    assert [s for s, _t, _a in recs[0]["timeline"]] == [
+        "origin", "dispatch", "fallback",
+    ]
+    assert tracker.stats()["open"] == 0
+    # The total landed under phase="fallback", not "fib".
+    assert _conv_hist().labels(trigger="lsa", phase="fallback").count >= 1
+
+
+def test_rib_chain_event_to_fib(tracker):
+    """ibus request → RibManager route_add → kernel install closes the
+    event with rib + fib phases observed."""
+    from ipaddress import IPv4Address as A
+    from ipaddress import IPv4Network as N
+
+    from holo_tpu.routing.rib import MockKernel, RibManager
+    from holo_tpu.utils.southbound import Nexthop, Protocol, RouteMsg
+
+    loop = EventLoop(clock=VirtualClock())
+    bus = Ibus(loop)
+    kernel = MockKernel()
+    rib = RibManager(bus, kernel)
+    loop.register(rib)
+    eid = convergence.begin("lsa")
+    with convergence.activation(eid):
+        bus.request(
+            "routing",
+            RouteMsg(
+                protocol=Protocol.OSPFV2,
+                prefix=N("10.9.0.0/24"),
+                distance=110,
+                metric=10,
+                nexthops=frozenset({Nexthop(addr=A("10.0.0.2"), ifname="e0")}),
+            ),
+            sender="test",
+        )
+    loop.run_until_idle()
+    assert N("10.9.0.0/24") in kernel.fib
+    recs = tracker.timelines()
+    assert len(recs) == 1 and recs[0]["outcome"] == "converged"
+    steps = [s for s, _t, _a in recs[0]["timeline"]]
+    assert "rib" in steps and "fib" in steps
+
+
+def test_capacity_evicts_oldest_open_event():
+    tr = convergence.configure(4, clock=time.monotonic)
+    try:
+        eids = [convergence.begin("lsa") for _ in range(6)]
+        assert tr.stats()["open"] == 4
+        outcomes = {r["eid"]: r["outcome"] for r in tr.timelines()}
+        assert outcomes == {eids[0]: "evicted", eids[1]: "evicted"}
+    finally:
+        convergence.configure(0)
+
+
+def test_isis_spf_delay_fsm_survives_causal_stamp():
+    """Regression guard: the causal stamp in IS-IS _schedule_spf must
+    ride ALONGSIDE the RFC 8405 delay-FSM transition, not replace it
+    (quiet → short-wait on the first IGP event)."""
+    from holo_tpu.protocols.isis.instance import IsisInstance
+
+    loop = EventLoop(clock=VirtualClock())
+    inst = IsisInstance("is-fsm", b"\x00\x00\x00\x00\x00\x01")
+    loop.register(inst)
+    assert inst.spf_delay_state == "quiet"
+    inst._schedule_spf()
+    assert inst.spf_delay_state == "short-wait"
+    inst.spf_delay_event("learn")
+    assert inst.spf_delay_state == "long-wait"
+
+
+# ---------------------------------------------------------- storm e2e
+
+
+def test_storm_deterministic_and_scalar_parity():
+    """ISSUE 6 acceptance: two seeded storms produce byte-identical
+    causal timelines, and the TPU-backend storm's final FIB is
+    bit-identical to a clean scalar-backend run of the same seed."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+
+    kw = dict(n_routers=60, events=40, seed=11)
+    r1, d1, net1 = run_convergence_storm(
+        spf_backend=TpuSpfBackend(), **kw
+    )
+    r2, d2, net2 = run_convergence_storm(
+        spf_backend=TpuSpfBackend(), **kw
+    )
+    assert d1 == d2, "same seed must produce identical causal timelines"
+    assert r1["triggers"] == r2["triggers"]
+    # Clean scalar run: same seed, same events, oracle backend.
+    _r3, _d3, net3 = run_convergence_storm(spf_backend=None, **kw)
+    assert net1.kernel.fib == net3.kernel.fib, (
+        "device-backend storm FIB must be bit-identical to the scalar run"
+    )
+    assert r1["outcomes"].get("converged", 0) > 0
+    # The device backend actually served the SPF-bound triggers.
+    assert "device" in r1["triggers"]["lsa"]
+
+
+def test_storm_loss_shows_in_tail():
+    """10% loss defers LSA arrivals by the retransmit penalty: the lsa
+    trigger's max latency must exceed the no-loss run's."""
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+
+    lossy, _, _ = run_convergence_storm(
+        n_routers=60, events=40, seed=11, drop_prob=0.5
+    )
+    clean, _, _ = run_convergence_storm(
+        n_routers=60, events=40, seed=11, drop_prob=0.0
+    )
+    lm = lossy["triggers"]["lsa"]["all"]["max"]
+    cm = clean["triggers"]["lsa"]["all"]["max"]
+    assert lm > cm, (lm, cm)
+
+
+def test_storm_timelines_reach_flight_ring():
+    """Completed causal timelines land in the flight-recorder ring (and
+    therefore in postmortem bundles)."""
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+    from holo_tpu.telemetry import flight
+
+    flight.configure(entries=4096)
+    try:
+        report, _d, _n = run_convergence_storm(
+            n_routers=60, events=30, seed=5
+        )
+        ring = flight.recorder().snapshot_ring()
+        conv = [
+            e for e in ring if e[0] == "event" and e[1] == "convergence"
+        ]
+        assert len(conv) >= report["outcomes"].get("converged", 0) > 0
+        assert all("trigger" in e[2] and "phases" in e[2] for e in conv)
+    finally:
+        flight.configure(entries=0)
+
+
+# ------------------------------------------------------- gNMI surfaces
+
+
+def test_gnmi_metric_leaf_carries_exemplars():
+    """PR 5 carry-over: the gNMI holo-telemetry metric leaves now carry
+    the OpenMetrics exemplar span ids Prometheus already renders."""
+    from holo_tpu.telemetry.provider import TelemetryStateProvider
+
+    hist = telemetry.histogram(
+        "holo_test_exemplar_seconds", "t", ("site",)
+    )
+    hist.labels(site="x").observe(0.004, exemplar={"span_id": 41})
+    state = TelemetryStateProvider().get_state()
+    rows = {
+        m["name"]: m
+        for m in state["holo-telemetry"]["metric"]
+    }
+    row = rows["holo_test_exemplar_seconds_count{site=x}"]
+    assert "span_id=41" in row["exemplars"]
+    assert "value=0.004" in row["exemplars"]
+    # Non-histogram rows carry no exemplar leaf.
+    assert "exemplars" not in rows.get(
+        "holo_test_exemplar_seconds_sum{site=x}", {}
+    )
+
+
+def test_gnmi_drop_bursts_recorded_in_flight_ring():
+    """PR 5 carry-over: per-subscriber dropped-update bursts land in the
+    flight ring with the subscriber ordinal, so a postmortem shows WHO
+    was shedding and when."""
+    import queue
+
+    from holo_tpu.daemon.gnmi_server import GnmiService
+    from holo_tpu.telemetry import flight
+
+    flight.configure(entries=1024)
+    try:
+        svc = GnmiService(daemon=None)
+        q = queue.Queue(maxsize=2)
+        svc._add_subscriber(q)
+        for _ in range(5):  # 2 delivered, 3 dropped
+            svc._fanout("notif")
+        ring = flight.recorder().snapshot_ring()
+        starts = [
+            e for e in ring
+            if e[0] == "event" and e[1] == "gnmi-drop-burst-start"
+        ]
+        assert len(starts) == 1 and starts[0][2]["subscriber"] == 1
+        # Draining the queue ends the burst with the dropped count.
+        q.get_nowait()
+        q.get_nowait()
+        svc._fanout("notif")
+        ring = flight.recorder().snapshot_ring()
+        ends = [
+            e for e in ring
+            if e[0] == "event" and e[1] == "gnmi-drop-burst"
+        ]
+        assert len(ends) == 1
+        assert ends[0][2]["dropped"] == 3
+        assert ends[0][2]["ended"] == "drained"
+        # A subscriber dying mid-burst closes its story too.
+        q2 = queue.Queue(maxsize=1)
+        svc._add_subscriber(q2)
+        svc._fanout("a")
+        svc._fanout("b")  # q2 full -> burst opens (q drained above)
+        svc._remove_subscriber(q2)
+        ring = flight.recorder().snapshot_ring()
+        disc = [
+            e for e in ring
+            if e[0] == "event"
+            and e[1] == "gnmi-drop-burst"
+            and e[2].get("ended") == "disconnect"
+        ]
+        assert len(disc) == 1 and disc[0][2]["subscriber"] == 2
+    finally:
+        flight.configure(entries=0)
+
+
+# ------------------------------------------------------ lint severity
+
+
+def test_lint_severity_tiers():
+    from holo_tpu.analysis import Rule, gate_findings, run_source
+
+    class WarnRule(Rule):
+        id = "HL999"
+        title = "test warn rule"
+        severity = "warn"
+
+        def check(self, mod):
+            return [self.finding(mod, mod.tree, "soaking rule hit")]
+
+    class ErrRule(WarnRule):
+        id = "HL998"
+        severity = "error"
+
+    res = run_source("x = 1\n", "holo_tpu/ops/x.py", rules=[WarnRule(), ErrRule()])
+    assert len(res.findings) == 2
+    gated = gate_findings(res.findings)
+    assert [f.rule for f in gated] == ["HL998"]
+    warn = next(f for f in res.findings if f.rule == "HL999")
+    assert warn.severity == "warn"
+    assert "(warn)" in warn.render()
+    assert "severity" not in warn.key  # tier changes never churn keys
+
+
+def test_lint_baseline_records_severity(tmp_path):
+    import json
+
+    from holo_tpu.analysis import Finding, write_baseline
+
+    f = Finding("HL999", "p.py", 1, "<module>", "m", severity="warn")
+    write_baseline(tmp_path / "b.json", [f])
+    doc = json.loads((tmp_path / "b.json").read_text())
+    assert doc["findings"][0]["severity"] == "warn"
+
+
+def test_list_rules_shows_severity():
+    from holo_tpu.analysis import all_rules
+
+    assert all(r.severity in ("error", "warn") for r in all_rules())
+    # Every shipped rule stays on gate duty (the warn tier is for
+    # soaking future rules; the tier-1 gate must not silently weaken).
+    assert all(r.severity == "error" for r in all_rules())

@@ -13,6 +13,7 @@ from holo_tpu.analysis import (
     all_rules,
     compare_to_baseline,
     default_baseline_path,
+    gate_findings,
     load_baseline,
     run_paths,
 )
@@ -27,8 +28,12 @@ def test_repo_matches_baseline():
 
     baseline = load_baseline(default_baseline_path())
     new, unused = compare_to_baseline(result.findings, baseline)
-    assert not new, "new holo-lint findings (fix or baseline them):\n" + (
-        "\n".join(f.render() for f in new)
+    # The gate rides error-tier rules only (warn-tier findings report
+    # without failing tier-1 — the CLI arm applies the same split).
+    new_errors = gate_findings(new)
+    assert not new_errors, (
+        "new holo-lint findings (fix or baseline them):\n"
+        + "\n".join(f.render() for f in new_errors)
     )
     assert not unused, (
         "stale baseline entries (their findings were fixed) — ratchet by "

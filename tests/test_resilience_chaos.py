@@ -303,6 +303,109 @@ def test_breaker_open_postmortem_bundle_deterministic(tmp_path):
     assert text_a == text_b, "seeded chaos bundle must be byte-identical"
 
 
+def test_breaker_open_mid_storm_tags_fallback_latencies():
+    """ISSUE 6 chaos satellite: when the dispatch breaker opens in the
+    middle of a convergence storm, the events served by the scalar
+    fallback close under phase="fallback" — the storm report splits
+    them out from the batched-device distribution."""
+    from holo_tpu.resilience import faults
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import StormNet
+    from holo_tpu.telemetry import convergence
+
+    net = StormNet(n_routers=60, seed=21, spf_backend=None)
+    breaker = CircuitBreaker(
+        "spf-storm",
+        failure_threshold=2,
+        recovery_timeout=1e9,  # stays open through the storm tail
+        clock=net.loop.clock.now,
+    )
+    net.inst.backend = TpuSpfBackend(64, breaker=breaker)
+    tracker = convergence.configure(1024, clock=net.loop.clock.now)
+    try:
+        plan = FaultPlan(seed=21, dispatch_fail={"spf.dispatch": 2})
+        with inject(FaultInjector(plan)):
+            for i in range(8):
+                net.flap(net.flappable[i], lost=False)
+                net.loop.advance(12.0)
+        net.loop.advance(60.0)
+        tracker.sweep()
+        assert breaker.state == "open"
+        recs = [
+            r for r in tracker.timelines() if r["outcome"] == "converged"
+        ]
+        fallbacks = [r for r in recs if r["fallback"]]
+        assert fallbacks, "breaker fallback must tag convergence events"
+        assert all(
+            any(step == "fallback" for step, _t, _a in r["timeline"])
+            for r in fallbacks
+        )
+        # The histogram split the storm bench reports on.
+        hist = telemetry_registry_hist()
+        assert hist.labels(trigger="lsa", phase="fallback").count > 0
+    finally:
+        convergence.configure(0)
+
+
+def telemetry_registry_hist():
+    from holo_tpu import telemetry
+
+    return telemetry.registry().histogram(
+        "holo_convergence_seconds", labelnames=("trigger", "phase")
+    )
+
+
+def test_convergence_storm_survives_pump_thread_kill():
+    """ISSUE 6 satellite: a ThreadedLoop pump crash mid-run is detected
+    AND respawned under the RestartPolicy (the detected-but-not-
+    respawned gap), and the storm network hosted on that loop keeps
+    converging afterwards."""
+    import time as _time
+
+    from holo_tpu.spf.synth_storm import StormNet
+    from holo_tpu.utils.preempt import ThreadedLoop
+    from holo_tpu.utils.runtime import RealClock
+
+    home = EventLoop(clock=RealClock())
+    sup = Supervisor(RestartPolicy(base_delay=0.05, jitter=0.0)).install(home)
+    tl = ThreadedLoop(name="storm-host")
+    net = StormNet(n_routers=40, seed=9, loop=tl)
+    sup.adopt(tl.loop, sender=tl.send)
+    pump_name = sup.watch_pump(tl)
+    tl.start()
+
+    def settle(pred, timeout=10.0) -> bool:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            home.run_until_idle()
+            if pred():
+                return True
+            _time.sleep(0.02)
+        return False
+
+    # Initial convergence on the pump thread (real clock).
+    assert settle(lambda: len(net.kernel.fib) > 0), "no initial FIB"
+    fib0 = dict(net.kernel.fib)
+
+    inj = FaultInjector(FaultPlan(seed=9))
+    inj.kill_pump(tl)
+    assert settle(lambda: not tl.pump_alive(), 5.0), "pump must die"
+    assert tl.pump_crashes == 1
+    # Supervision: CrashNotice marshals home, backoff fires, respawn.
+    assert settle(lambda: tl.pump_alive(), 10.0), "pump must respawn"
+    assert sup.restarts.get(pump_name, 0) == 1
+
+    # The storm keeps converging on the respawned pump: flap an edge
+    # whose endpoint owns a stub prefix and watch the FIB move.
+    runs0 = net.inst.spf_run_count
+    net.flap(net.flappable[0], lost=False)
+    assert settle(lambda: net.inst.spf_run_count > runs0), (
+        "post-respawn SPF must run"
+    )
+    assert len(net.kernel.fib) > 0, f"FIB lost after respawn (was {fib0})"
+    tl.stop()
+
+
 def test_ospf_reconverges_through_packet_loss():
     """Convergence-under-failure, the metric that matters: with a lossy
     wire AND a link failure mid-run, retransmission machinery still

@@ -286,9 +286,7 @@ def test_gnmi_subscriber_overflow_drop_counter_and_safe_removal():
     removal is idempotent (a double remove must not raise)."""
     import holo_tpu.daemon.gnmi_server as gs
 
-    svc = gs.GnmiService.__new__(gs.GnmiService)
-    svc._subscribers = []
-    svc._sub_lock = threading.Lock()
+    svc = gs.GnmiService(daemon=None)
     q: queue.Queue = queue.Queue(maxsize=2)
     svc._add_subscriber(q)
     drops0 = telemetry.snapshot(prefix="holo_gnmi").get(
