@@ -196,14 +196,22 @@ class FrrEngine:
     def _prepare(self, topo: Topology):
         # Shared with TpuSpfBackend.prepare (ROADMAP cleanup): an
         # instance running SPF + FRR now marshals its DeviceGraph once —
-        # the holo_spf_marshal_cache_total hit/miss pair makes the dedup
-        # visible, while this engine's historical series stays alive.
+        # the holo_spf_marshal_cache_total hit/miss/delta triple makes
+        # the dedup visible, while this engine's series stays alive.
+        #
+        # Incremental-vs-full choice (DeltaPath): the FRR kernel
+        # gathers its per-protected-link scenario masks through
+        # ``in_edge_id``, so it can ride a delta-updated resident graph
+        # only while edge ids stay valid — pure weight-change chains
+        # within depth/padding headroom.  ``need_edge_ids`` makes the
+        # cache rebuild (full path) for structurally-updated entries;
+        # every disposition lands in holo_spf_delta_total{kind,path}.
         from holo_tpu.ops.spf_engine import shared_graph_cache
 
-        g, hit = shared_graph_cache().get(
-            topo, max(self.n_atoms, topo.n_atoms())
+        g, how = shared_graph_cache().get(
+            topo, max(self.n_atoms, topo.n_atoms()), need_edge_ids=True
         )
-        _FRR_GRAPH_CACHE.labels(result="hit" if hit else "miss").inc()
+        _FRR_GRAPH_CACHE.labels(result=how).inc()
         return g
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:

@@ -14,16 +14,36 @@ axis.  All arithmetic is exact int32, enabling bit-identical parity with the
 scalar reference semantics.
 """
 
-from holo_tpu.ops.graph import INF, EllGraph, Topology, build_ell
-from holo_tpu.ops.spf_engine import SpfTensors, spf_one, spf_whatif_batch, sssp_distances
+from holo_tpu.ops.graph import (
+    INF,
+    EllGraph,
+    Topology,
+    TopologyDelta,
+    build_ell,
+    diff_topologies,
+)
+from holo_tpu.ops.spf_engine import (
+    DeviceGraphCache,
+    SpfTensors,
+    shared_graph_cache,
+    spf_one,
+    spf_one_incremental,
+    spf_whatif_batch,
+    sssp_distances,
+)
 
 __all__ = [
     "INF",
     "EllGraph",
     "Topology",
+    "TopologyDelta",
     "build_ell",
+    "diff_topologies",
+    "DeviceGraphCache",
     "SpfTensors",
+    "shared_graph_cache",
     "spf_one",
+    "spf_one_incremental",
     "spf_whatif_batch",
     "sssp_distances",
 ]

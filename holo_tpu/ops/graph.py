@@ -72,10 +72,21 @@ class Topology:
         # MUST call touch() or cached DeviceGraphs go stale.
         self._uid = next(_TOPOLOGY_UIDS)
         self.generation = 0
+        # DeltaPath lineage: a TopologyDelta linking this topology to a
+        # previously-marshaled base (set by the protocol layer at the
+        # LSDB seam via link_delta()).  The device-graph cache and SPF
+        # backend use it to update the resident EllGraph buffers in
+        # place instead of re-marshaling from scratch.
+        self.delta_base: "TopologyDelta | None" = None
 
     def touch(self) -> None:
-        """Invalidate marshaling caches after an in-place mutation."""
+        """Invalidate marshaling caches after an in-place mutation.
+
+        Also drops any delta lineage: a delta describes the arrays as
+        they were when it was diffed — applying it after a mutation
+        would serve a graph that silently misses the mutation."""
         self.generation += 1
+        self.delta_base = None
 
     @property
     def cache_key(self) -> tuple:
@@ -90,6 +101,14 @@ class Topology:
     @property
     def n_edges(self) -> int:
         return int(self.edge_src.shape[0])
+
+    def link_delta(self, delta: "TopologyDelta") -> None:
+        """Attach DeltaPath lineage: this topology equals the base
+        topology identified by ``delta.base_key`` with ``delta``
+        applied.  Consumers (DeviceGraphCache / TpuSpfBackend) may then
+        update the base's device-resident EllGraph in place instead of
+        re-marshaling."""
+        self.delta_base = delta
 
     def filter_mutual(self) -> "Topology":
         """Drop edges whose reverse edge does not exist.
@@ -199,4 +218,180 @@ def build_ell(
         in_direct_atom=in_direct_atom,
         is_router=topo.is_router.copy(),
         n_atoms=n_atoms,
+    )
+
+
+def _i32(values) -> np.ndarray:
+    return np.asarray(list(values), np.int32).reshape(-1)
+
+
+@dataclass
+class TopologyDelta:
+    """Typed topology change set (DeltaPath, arXiv:1808.06893).
+
+    Describes how a target topology differs from an already-marshaled
+    *base* topology (identified by ``base_key = (uid, generation)``) in
+    terms the device-resident EllGraph can absorb as in-place scatter
+    updates:
+
+    - **weight changes** — the same directed edge (src, dst, atom) with
+      a new cost; the ELL slot is rewritten, edge indices stay valid
+      (``ids_stable``).
+    - **edge add/remove** — directed edges entering/leaving the graph;
+      removals invalidate their slot, additions occupy padding slack in
+      the destination row (overflow → full rebuild).  Edge indices
+      shift, so the updated graph no longer serves edge-mask consumers
+      (``ids_stable`` False).
+    - **node overload bit** — ``overload`` vertices are struck from
+      transit: every slot whose source is an overloaded vertex goes
+      invalid (IS-IS overload semantics — still reachable as a
+      destination, never used as a via).  One-way: clearing overload
+      requires a full rebuild.
+
+    ``seed_rows()`` is the Bounded-Dijkstra-style radius cut: the set
+    of vertices whose previous distances may now be *too small* (edge
+    removed, cost increased, via struck).  Distances elsewhere remain
+    valid upper bounds, so the incremental kernel only invalidates the
+    previous-SPT descendants of these rows.
+    """
+
+    base_key: tuple  # (uid, generation) of the base Topology
+    # cost changes: directed edge (src, dst, atom), old -> new cost
+    w_src: np.ndarray = field(default_factory=lambda: _i32(()))
+    w_dst: np.ndarray = field(default_factory=lambda: _i32(()))
+    w_old: np.ndarray = field(default_factory=lambda: _i32(()))
+    w_new: np.ndarray = field(default_factory=lambda: _i32(()))
+    w_atom: np.ndarray = field(default_factory=lambda: _i32(()))
+    # removed directed edges
+    r_src: np.ndarray = field(default_factory=lambda: _i32(()))
+    r_dst: np.ndarray = field(default_factory=lambda: _i32(()))
+    r_cost: np.ndarray = field(default_factory=lambda: _i32(()))
+    r_atom: np.ndarray = field(default_factory=lambda: _i32(()))
+    # added directed edges
+    a_src: np.ndarray = field(default_factory=lambda: _i32(()))
+    a_dst: np.ndarray = field(default_factory=lambda: _i32(()))
+    a_cost: np.ndarray = field(default_factory=lambda: _i32(()))
+    a_atom: np.ndarray = field(default_factory=lambda: _i32(()))
+    # vertices struck from transit (overload bit set since the base)
+    overload: np.ndarray = field(default_factory=lambda: _i32(()))
+    # True iff the base's edge ordering (and thus in_edge_id) is still
+    # valid for the target topology: pure weight-change deltas only.
+    ids_stable: bool = True
+
+    @property
+    def n_ops(self) -> int:
+        return (
+            self.w_src.shape[0]
+            + self.r_src.shape[0]
+            + self.a_src.shape[0]
+            + self.overload.shape[0]
+        )
+
+    @property
+    def kind(self) -> str:
+        """Delta taxonomy bucket (metric label): the single op class
+        present, ``mixed`` when several combine, ``empty`` for a
+        content-identical alias."""
+        present = [
+            name
+            for name, n in (
+                ("struct", self.r_src.shape[0] + self.a_src.shape[0]),
+                ("weight", self.w_src.shape[0]),
+                ("overload", self.overload.shape[0]),
+            )
+            if n
+        ]
+        if not present:
+            return "empty"
+        return present[0] if len(present) == 1 else "mixed"
+
+    def seed_rows(self) -> np.ndarray:
+        """int32[S] vertices whose previous distance may be stale-low:
+        targets of removed edges, targets of cost increases, and the
+        overloaded vertices themselves (every path transiting them
+        passes through them, so SPT-descendant invalidation from the
+        vertex covers every route its strike can break)."""
+        rows = [
+            self.r_dst,
+            self.w_dst[self.w_new > self.w_old],
+            self.overload,
+        ]
+        return np.unique(np.concatenate([_i32(r) for r in rows]))
+
+
+def diff_topologies(
+    base: Topology, new: Topology, max_ops: int = 512
+) -> TopologyDelta | None:
+    """Compute a :class:`TopologyDelta` taking ``base`` to ``new``, or
+    None when the change is not delta-representable (different vertex
+    model, or more than ``max_ops`` edge operations — at which point a
+    full re-marshal is the cheaper path anyway).
+
+    Vertex identity is positional: callers at the LSDB seam must only
+    diff topologies built over the SAME vertex ordering (same
+    router/network index maps) and the same next-hop atom table —
+    :func:`holo_tpu.protocols.ospf.spf_run.link_spf_delta` checks that
+    before calling here.
+    """
+    if (
+        base.n_vertices != new.n_vertices
+        or base.root != new.root
+        or not np.array_equal(base.is_router, new.is_router)
+    ):
+        return None
+    if base.n_edges == new.n_edges and (
+        np.array_equal(base.edge_src, new.edge_src)
+        and np.array_equal(base.edge_dst, new.edge_dst)
+        and np.array_equal(base.edge_direct_atom, new.edge_direct_atom)
+    ):
+        # Fast path: identical edge list (and ordering) — a pure weight
+        # delta, edge indices remain valid for mask consumers.
+        changed = np.nonzero(base.edge_cost != new.edge_cost)[0]
+        if changed.shape[0] > max_ops:
+            return None
+        return TopologyDelta(
+            base_key=base.cache_key,
+            w_src=base.edge_src[changed].copy(),
+            w_dst=base.edge_dst[changed].copy(),
+            w_old=base.edge_cost[changed].copy(),
+            w_new=new.edge_cost[changed].copy(),
+            w_atom=base.edge_direct_atom[changed].copy(),
+            ids_stable=True,
+        )
+    # General path: multiset difference over (src, dst, cost, atom)
+    # rows.  A moved/re-costed edge shows up as one removal plus one
+    # addition — the slot machinery frees then reuses the ELL slot.
+    # Cheap early-out before the O(E) work: the edge-count gap is a
+    # lower bound on the op count.
+    if abs(base.n_edges - new.n_edges) > max_ops:
+        return None
+
+    def rows(t: Topology) -> np.ndarray:
+        out = np.empty((t.n_edges, 4), np.int32)
+        out[:, 0] = t.edge_src
+        out[:, 1] = t.edge_dst
+        out[:, 2] = t.edge_cost
+        out[:, 3] = t.edge_direct_atom
+        return out
+
+    # Vectorized multiset diff (this runs on the per-SPF hot path for
+    # exactly the large topologies DeltaPath targets — no Python loop
+    # over E): signed-count the lex-sorted union of both edge lists.
+    both = np.concatenate([rows(base), rows(new)], axis=0)
+    uniq, inv = np.unique(both, axis=0, return_inverse=True)
+    count = np.zeros(uniq.shape[0], np.int64)
+    np.add.at(count, inv[: base.n_edges], 1)
+    np.add.at(count, inv[base.n_edges:], -1)
+    rem_mask = count > 0
+    add_mask = count < 0
+    n_ops = int(count[rem_mask].sum() - count[add_mask].sum())
+    if n_ops > max_ops:
+        return None
+    r = np.repeat(uniq[rem_mask], count[rem_mask], axis=0)
+    a = np.repeat(uniq[add_mask], -count[add_mask], axis=0)
+    return TopologyDelta(
+        base_key=base.cache_key,
+        r_src=r[:, 0], r_dst=r[:, 1], r_cost=r[:, 2], r_atom=r[:, 3],
+        a_src=a[:, 0], a_dst=a[:, 1], a_cost=a[:, 2], a_atom=a[:, 3],
+        ids_stable=False,
     )

@@ -26,6 +26,7 @@ All int32, exact; results are bit-comparable against the scalar oracle
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import NamedTuple
 
 import jax
@@ -33,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from holo_tpu import telemetry
-from holo_tpu.ops.graph import INF, EllGraph
+from holo_tpu.ops.graph import INF, EllGraph, TopologyDelta
 
 # Host-side marshal metrics: every DeviceGraph build reports how long
 # the ELL expansion took and how much of the padded slot space is real
@@ -53,6 +54,22 @@ _MARSHAL_CACHE = telemetry.counter(
     "Shared marshaled-DeviceGraph cache lookups (SPF + FRR engines)",
     ("result",),
 )
+_DELTA_TOTAL = telemetry.counter(
+    "holo_spf_delta_total",
+    "DeltaPath topology-delta dispositions: in-place device-graph "
+    "updates vs full-rebuild fallbacks, by delta taxonomy",
+    ("kind", "path"),
+)
+_CACHE_EVICTIONS = telemetry.counter(
+    "holo_spf_marshal_cache_evictions_total",
+    "Shared marshaled-DeviceGraph cache LRU evictions",
+)
+
+
+def note_delta(kind: str, path: str) -> None:
+    """Count one DeltaPath disposition (cache and SPF backend share the
+    ``holo_spf_delta_total{kind,path}`` series)."""
+    _DELTA_TOTAL.labels(kind=kind, path=path).inc()
 
 
 class DeviceGraph(NamedTuple):
@@ -104,6 +121,160 @@ def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
     return g
 
 
+class _EllMirror:
+    """Host-side mirror of a cached entry's ELL slot occupancy.
+
+    apply_delta needs to resolve edge-level delta ops to (row, slot)
+    scatter targets and to find padding slack for additions — without
+    reading the device buffers back (the no-host-round-trip contract).
+    The mirror owns COPIES of the marshal-time arrays (jnp.asarray may
+    alias numpy memory on CPU backends, and the mirror mutates).
+    """
+
+    def __init__(self, ell: EllGraph):
+        self.in_src = ell.in_src.copy()
+        self.in_cost = ell.in_cost.copy()
+        self.in_valid = ell.in_valid.copy()
+        self.in_atom = ell.in_direct_atom.copy()
+        self.n_atoms = int(ell.n_atoms)
+        self.n_valid = int(ell.in_valid.sum())
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_valid / max(self.in_valid.size, 1)
+
+
+@dataclass
+class _CacheEntry:
+    graph: DeviceGraph
+    mirror: _EllMirror
+    depth: int = 0  # delta-chain length since the last full marshal
+    # in_edge_id no longer matches the serving topology's edge list
+    # (structural deltas shift edge indices): entries in this state can
+    # serve mask-free SPF but not edge-mask consumers (what-if, FRR).
+    ids_stale: bool = False
+
+
+class _DeltaUnappliable(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _apply_delta_slots(g: DeviceGraph, rows, cols, src, cost, valid, words, strike):
+    """Scatter a lowered TopologyDelta into the resident graph buffers.
+
+    Jitted with the graph DONATED: the update happens in place on the
+    device (no host round-trip; pad ops carry out-of-range rows and are
+    dropped).  ``strike`` is the transit-strike (overload) vertex mask,
+    post-masking slot validity through the updated sources.
+    """
+    in_src = g.in_src.at[rows, cols].set(src, mode="drop")
+    in_cost = g.in_cost.at[rows, cols].set(cost, mode="drop")
+    in_valid = g.in_valid.at[rows, cols].set(valid, mode="drop")
+    in_valid = in_valid & ~strike[in_src]
+    nh_words = g.direct_nh_words.at[rows, cols].set(words, mode="drop")
+    return g._replace(
+        in_src=in_src, in_cost=in_cost, in_valid=in_valid,
+        direct_nh_words=nh_words,
+    )
+
+
+_APPLY_DELTA = jax.jit(_apply_delta_slots, donate_argnums=(0,))
+
+
+#: One fixed scatter/seed bucket for the common case: every delta pads
+#: to this many rows (out-of-range sentinels drop), so a process
+#: compiles the apply + incremental-kernel pair ONCE per graph shape —
+#: bucket churn would otherwise put one XLA compile spike per novel
+#: delta size into the storm tail the p95 acceptance gate watches.
+_DELTA_PAD_FLOOR = 256
+
+
+def _pad_pow2(n: int, floor: int = _DELTA_PAD_FLOOR) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def _lower_delta(mirror: _EllMirror, delta: TopologyDelta, n_vertices: int):
+    """Resolve edge-level delta ops to padded slot-scatter arrays,
+    mutating the mirror to the post-delta state.  Raises
+    :class:`_DeltaUnappliable` on padding overflow / atom overflow /
+    an op that does not match the mirrored occupancy."""
+
+    def find(dst, src, cost, atom) -> int:
+        m = (
+            mirror.in_valid[dst]
+            & (mirror.in_src[dst] == src)
+            & (mirror.in_cost[dst] == cost)
+            & (mirror.in_atom[dst] == atom)
+        )
+        hit = np.nonzero(m)[0]
+        if hit.shape[0] == 0:
+            raise _DeltaUnappliable("missing-edge")
+        return int(hit[0])
+
+    touched: set[tuple[int, int]] = set()
+    d = delta
+    # Removals first: they free the padding slack additions reuse.
+    for src, dst, cost, atom in zip(d.r_src, d.r_dst, d.r_cost, d.r_atom):
+        col = find(dst, src, cost, atom)
+        mirror.in_valid[dst, col] = False
+        mirror.in_src[dst, col] = 0
+        mirror.in_cost[dst, col] = 0
+        mirror.in_atom[dst, col] = -1
+        mirror.n_valid -= 1
+        touched.add((int(dst), col))
+    for src, dst, old, new, atom in zip(
+        d.w_src, d.w_dst, d.w_old, d.w_new, d.w_atom
+    ):
+        col = find(dst, src, old, atom)
+        mirror.in_cost[dst, col] = new
+        touched.add((int(dst), col))
+    for src, dst, cost, atom in zip(d.a_src, d.a_dst, d.a_cost, d.a_atom):
+        if atom >= mirror.n_atoms:
+            raise _DeltaUnappliable("atom-overflow")
+        free = np.nonzero(~mirror.in_valid[dst])[0]
+        if free.shape[0] == 0:
+            raise _DeltaUnappliable("padding-overflow")
+        col = int(free[0])
+        mirror.in_valid[dst, col] = True
+        mirror.in_src[dst, col] = src
+        mirror.in_cost[dst, col] = cost
+        mirror.in_atom[dst, col] = atom
+        mirror.n_valid += 1
+        touched.add((int(dst), col))
+    # Overload strikes: device-side mask through in_src; mirror keeps
+    # the struck slots invalid so later deltas see the real occupancy.
+    strike = np.zeros(n_vertices, bool)
+    if d.overload.shape[0]:
+        strike[d.overload] = True
+        hit = np.isin(mirror.in_src, d.overload) & mirror.in_valid
+        mirror.n_valid -= int(hit.sum())
+        mirror.in_valid[hit] = False
+    # One scatter op per touched slot, carrying the FINAL mirror state
+    # (a freed-then-reused slot must not scatter twice).
+    w = max((mirror.n_atoms + 31) // 32, 1)
+    pad = _pad_pow2(len(touched))
+    rows = np.full(pad, n_vertices, np.int32)  # OOB sentinel: dropped
+    cols = np.zeros(pad, np.int32)
+    src = np.zeros(pad, np.int32)
+    cost = np.zeros(pad, np.int32)
+    valid = np.zeros(pad, bool)
+    words = np.zeros((pad, w), np.uint32)
+    for i, (r, c) in enumerate(sorted(touched)):
+        rows[i], cols[i] = r, c
+        src[i] = mirror.in_src[r, c]
+        cost[i] = mirror.in_cost[r, c]
+        valid[i] = mirror.in_valid[r, c]
+        a = int(mirror.in_atom[r, c])
+        if a >= 0:
+            words[i, a // 32] = np.uint32(1) << np.uint32(a % 32)
+    return rows, cols, src, cost, valid, words, strike
+
+
 class DeviceGraphCache:
     """Process-wide LRU of marshaled DeviceGraphs, shared by every SPF
     backend and FRR engine (ROADMAP cleanup: an instance running SPF +
@@ -112,44 +283,161 @@ class DeviceGraphCache:
     same identity contract as the old per-engine caches: in-place
     topology mutators must ``touch()``.
 
+    DeltaPath (ROADMAP item 1): entries are long-lived device residents
+    updated IN PLACE.  When a lookup misses but the topology carries
+    delta lineage (``Topology.link_delta``) to a resident base entry,
+    the delta is lowered to slot scatters and applied on device with
+    buffer donation — no re-marshal, no host round-trip.  Entries track
+    their delta-chain depth; chains deeper than ``max_delta_depth``,
+    padding/atom overflow, or a mask-consumer asking for a
+    structurally-updated entry (stale edge ids) all fall back to the
+    full-rebuild path (``holo_spf_delta_total{kind,path}``).
+
     Thread-shared under ``[runtime] isolation=threaded`` (instance
     threads dispatch concurrently): lookups and inserts run under an
     owning lock; the expensive ELL expansion runs outside it, so two
     concurrent first-misses marshal twice and the second insert wins —
-    wasted work once, never a stall or a torn entry.
+    wasted work once, never a stall or a torn entry.  The delta path
+    CLAIMS its base entry (pops it under the lock) before donating the
+    buffers, so the dict itself never hands out a consumed graph.
+    NOTE the narrower contract donation imposes: a DeviceGraph obtained
+    from an earlier get() is invalidated when a delta is later applied
+    to that entry — safe today because a topology's chain is only ever
+    dispatched from its owning instance's actor thread (SPF then FRR,
+    sequentially); cross-thread sharing of one topology's entry would
+    need a read-lease before donation could stay.
     """
 
-    def __init__(self, capacity: int = 16):
+    def __init__(self, capacity: int = 16, max_delta_depth: int = 256):
         import threading
 
         self.capacity = int(capacity)
+        self.max_delta_depth = int(max_delta_depth)
         self._lock = threading.Lock()
-        self._cache: dict[tuple, DeviceGraph] = {}
+        self._cache: dict[tuple, _CacheEntry] = {}
+        self._evictions = 0
+        self._deltas_applied = 0
 
-    def get(self, topo, n_atoms: int) -> tuple[DeviceGraph, bool]:
-        """(device graph, cache hit?).  Callers invoke this inside their
-        sanctioned marshal windows — the device_put below is the
-        transfer the window exists for."""
+    def get(
+        self,
+        topo,
+        n_atoms: int,
+        need_edge_ids: bool = False,
+        allow_delta: bool = True,
+    ) -> tuple[DeviceGraph, str]:
+        """(device graph, 'hit' | 'delta' | 'miss').  Callers invoke
+        this inside their sanctioned marshal windows — the device_put /
+        delta scatter below is the transfer the window exists for.
+
+        ``need_edge_ids``: the caller gathers through ``in_edge_id``
+        (edge-mask consumers: what-if batches, FRR planes) — entries
+        whose edge ids went stale under a structural delta are rebuilt.
+        """
         key = (*topo.cache_key, int(n_atoms))
         with self._lock:
-            g = self._cache.get(key)
-            if g is not None:
-                # Refresh LRU position (dicts preserve insertion order).
-                del self._cache[key]
-                self._cache[key] = g
-        if g is not None:
+            e = self._cache.get(key)
+            if e is not None:
+                if need_edge_ids and e.ids_stale:
+                    # A structurally-updated resident cannot serve mask
+                    # consumers: rebuild (and reset the chain) below.
+                    self._cache.pop(key, None)
+                    e = None
+                else:
+                    # Refresh LRU position (dicts preserve insert order).
+                    del self._cache[key]
+                    self._cache[key] = e
+        if e is not None:
             _MARSHAL_CACHE.labels(result="hit").inc()
-            return g, True
+            return e.graph, "hit"
+        if allow_delta:
+            g = self._try_delta(topo, n_atoms, need_edge_ids)
+            if g is not None:
+                _MARSHAL_CACHE.labels(result="delta").inc()
+                return g, "delta"
         _MARSHAL_CACHE.labels(result="miss").inc()
         from holo_tpu.ops.graph import build_ell
 
         ell = build_ell(topo, n_atoms=n_atoms)
         g = jax.device_put(device_graph_from_ell(ell))
+        entry = _CacheEntry(graph=g, mirror=_EllMirror(ell))
         with self._lock:
-            self._cache[key] = g
-            while len(self._cache) > self.capacity:
-                self._cache.pop(next(iter(self._cache)))
-        return g, False
+            self._cache[key] = entry
+            self._evict_locked()
+        return g, "miss"
+
+    def _try_delta(
+        self, topo, n_atoms: int, need_edge_ids: bool
+    ) -> DeviceGraph | None:
+        delta = getattr(topo, "delta_base", None)
+        if delta is None:
+            return None
+        kind = delta.kind
+        base_key = (*delta.base_key, int(n_atoms))
+        with self._lock:
+            base = self._cache.get(base_key)
+            if base is None:
+                path = "full-no-base"
+                base = None
+            elif base.depth + 1 > self.max_delta_depth:
+                path = "full-depth"
+                base = None
+            elif need_edge_ids and (base.ids_stale or not delta.ids_stable):
+                path = "full-edge-ids"
+                base = None
+            else:
+                # Claim the base: its buffers are about to be donated.
+                del self._cache[base_key]
+                path = "apply"
+        if base is None:
+            _DELTA_TOTAL.labels(kind=kind, path=path).inc()
+            return None
+        try:
+            ops = _lower_delta(base.mirror, delta, topo.n_vertices)
+        except _DeltaUnappliable as exc:
+            # The mirror may be half-updated: the claimed base entry is
+            # dropped and the caller re-marshals from scratch.
+            _DELTA_TOTAL.labels(kind=kind, path=f"full-{exc.reason}").inc()
+            return None
+        g = _APPLY_DELTA(base.graph, *ops)
+        entry = _CacheEntry(
+            graph=g,
+            mirror=base.mirror,
+            depth=base.depth + 1,
+            ids_stale=base.ids_stale or not delta.ids_stable,
+        )
+        with self._lock:
+            self._cache[(*topo.cache_key, int(n_atoms))] = entry
+            self._evict_locked()
+            self._deltas_applied += 1
+        _DELTA_TOTAL.labels(kind=kind, path="apply").inc()
+        return g
+
+    def _evict_locked(self) -> None:
+        while len(self._cache) > self.capacity:
+            self._cache.pop(next(iter(self._cache)))
+            self._evictions += 1
+            _CACHE_EVICTIONS.inc()
+
+    def stats(self) -> dict:
+        """Eviction/occupancy summary for the holo-telemetry gNMI leaf
+        (rides next to the holo_spf_marshal_cache_total hit/miss
+        counters)."""
+        with self._lock:
+            entries = list(self._cache.values())
+            evictions = self._evictions
+            applied = self._deltas_applied
+        depths = [e.depth for e in entries]
+        occ = [e.mirror.occupancy for e in entries]
+        return {
+            "entries": len(entries),
+            "capacity": self.capacity,
+            "evictions": evictions,
+            "deltas-applied": applied,
+            "delta-entries": sum(1 for d in depths if d > 0),
+            "max-chain-depth": max(depths, default=0),
+            "stale-id-entries": sum(1 for e in entries if e.ids_stale),
+            "occupancy": round(sum(occ) / len(occ), 4) if occ else 0.0,
+        }
 
     def __len__(self) -> int:
         with self._lock:
@@ -507,16 +795,38 @@ def spf_one_hybrid(
     parent = _first_parent(g, dag, d_nbr)
 
     big = jnp.int32(n + 1)
-    vidx = jnp.arange(n)
-    is_root = vidx == root
+    limit = n if max_iters is None else max_iters
+    hops0 = jnp.where(jnp.arange(n) == root, 0, big).astype(jnp.int32)
+    nh0 = jnp.zeros((n, w), jnp.int32)
+    hops, nh = _hops_nh_fixpoint(g, root, dag, parent, hops0, nh0, limit)
+    return SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+
+
+def _hops_nh_fixpoint(g, root, dag, parent, hops0, nh0, limit):
+    """Packed Jacobi hops + next-hop fixpoint over a settled DAG —
+    phase 2 of the hybrid engine, shared with the incremental kernel.
+
+    The body RECOMPUTES (never accumulates) each value from the
+    gathered neighbor state, and the DAG/parent chain is acyclic with a
+    fixed boundary (the root), so the fixpoint equations have exactly
+    one solution: ANY seed in the value domain converges to the same
+    bit-exact answer.  Fresh seeds (hops0 = root-only, nh0 = 0) give
+    the hybrid engine; the previous run's arrays give the incremental
+    path, where convergence takes rounds proportional to the depth of
+    the region the delta actually changed.
+    """
+    n = g.in_src.shape[0]
+    big = jnp.int32(n + 1)
+    is_root = jnp.arange(n) == root
     inc = g.is_router.astype(jnp.int32)
     parent_slot = g.in_src == parent[:, None]
     has_parent = parent < n
     direct_i32 = jax.lax.bitcast_convert_type(g.direct_nh_words, jnp.int32)
-    limit = n if max_iters is None else max_iters
-
-    hops0 = jnp.where(is_root, 0, big).astype(jnp.int32)
-    nh0 = jnp.zeros((n, w), jnp.int32)
 
     def cond(carry):
         _, _, changed, it = carry
@@ -542,6 +852,88 @@ def spf_one_hybrid(
 
     hops, nh, _, _ = jax.lax.while_loop(
         cond, body, (hops0, nh0, jnp.bool_(True), 0)
+    )
+    return hops, nh
+
+
+def spf_one_incremental(
+    g: DeviceGraph,
+    root: jax.Array,
+    prev: SpfTensors,
+    seed_rows: jax.Array,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """Incremental full SPF: recompute only what a topology delta can
+    have changed, seeded from the previous run's tensors (DeltaPath,
+    arXiv:1808.06893; radius cut per Bounded Dijkstra, 1903.00436).
+
+    ``g`` is the delta-UPDATED device graph; ``prev`` the tensors
+    computed on the base graph; ``seed_rows`` (padded with
+    out-of-range sentinels) the vertices whose previous distance may
+    now be stale-low (:meth:`TopologyDelta.seed_rows`).
+
+    1. Invalidate the previous-SPT descendants of the seed rows: a
+       vertex whose first-parent chain avoids every seed still has its
+       old shortest path intact at no greater cost, so its previous
+       distance remains a valid upper bound.  Rounds ~ affected-subtree
+       depth (one [N] gather each).
+    2. Min-plus relaxation seeded with those upper bounds (INF inside
+       the invalidated region): converges in rounds ~ the radius of
+       the affected region instead of the full graph diameter.
+    3. DAG/first-parent from the settled distances (closed form), then
+       the shared hops/next-hop fixpoint seeded with the previous
+       arrays — unique-fixpoint recompute, so stale values self-correct
+       in rounds ~ changed-region depth.
+
+    Bit-identical to ``spf_one(g, root)`` by fixpoint uniqueness
+    (property-gated in tests/test_delta_spf.py).
+    """
+    n, k = g.in_src.shape
+    limit = n if max_iters is None else max_iters
+    big = jnp.int32(n + 1)
+    ok = g.in_valid  # the incremental path never carries an edge mask
+
+    # 1. affected = seeds + their previous first-parent-tree descendants.
+    par = prev.parent
+    has_par = par < n
+    par_safe = jnp.where(has_par, par, 0)
+    aff0 = jnp.zeros((n,), bool).at[seed_rows].set(True, mode="drop")
+
+    def acond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def abody(carry):
+        aff, _, it = carry
+        new = aff | (jnp.where(has_par, aff[par_safe], False))
+        return new, jnp.any(new != aff), it + 1
+
+    aff, _, _ = jax.lax.while_loop(acond, abody, (aff0, jnp.bool_(True), 0))
+
+    # 2. seeded relaxation on the updated graph.
+    dist0 = jnp.where(aff, INF, prev.dist).at[root].set(0)
+
+    def rcond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def rbody(carry):
+        dist, _, it = carry
+        d_nbr = dist[g.in_src]
+        usable = ok & (d_nbr < INF)
+        cand = jnp.where(usable, d_nbr + g.in_cost, INF)
+        new = jnp.minimum(dist, cand.min(axis=1))
+        return new, jnp.any(new != dist), it + 1
+
+    dist, _, _ = jax.lax.while_loop(rcond, rbody, (dist0, jnp.bool_(True), 0))
+
+    # 3. DAG + first parent are closed-form in dist; hops/nh reconverge
+    # from the previous arrays through the shared recompute fixpoint.
+    dag = _sp_dag(g, dist, ok, root)
+    parent = _first_parent(g, dag, dist[g.in_src])
+    nh_prev = jax.lax.bitcast_convert_type(prev.nexthops, jnp.int32)
+    hops, nh = _hops_nh_fixpoint(
+        g, root, dag, parent, prev.hops, nh_prev, limit
     )
     return SpfTensors(
         dist=dist,

@@ -366,6 +366,9 @@ class IsisInstance(Actor):
         self._plain_raw: dict = {}
         self.netio = netio
         self.backend = spf_backend or ScalarSpfBackend()
+        # DeltaPath: previous run's (vertex order, atoms, topology) per
+        # MT id — the diff base for in-place device-graph updates.
+        self._spf_delta_bases: dict = {}
         self.route_cb = route_cb
         # Production sends an immediate hello on circuit-up and on
         # adjacency transitions (the reference's IntervalTask fires
@@ -2029,7 +2032,26 @@ class IsisInstance(Actor):
                 topo.touch()
                 return topo, atoms
 
+            def _link_delta(mt_id, topo_new, atoms_new):
+                # DeltaPath seam (same contract as OSPF): identical
+                # vertex ordering + atom table → diff against the
+                # previous run so the resident device graph updates in
+                # place instead of re-marshaling the LSP database.
+                prev = self._spf_delta_bases.get(mt_id)
+                if (
+                    prev is not None
+                    and prev[0] == order
+                    and prev[1] == atoms_new
+                ):
+                    from holo_tpu.ops.graph import diff_topologies
+
+                    delta = diff_topologies(prev[2], topo_new)
+                    if delta is not None:
+                        topo_new.link_delta(delta)
+                self._spf_delta_bases[mt_id] = (order, atoms_new, topo_new)
+
             topo, atoms4 = _build(lambda k, node: node["is"], 0)
+            _link_delta(0, topo, atoms4)
             res4 = self.backend.compute(topo)
             # IP-FRR: the default-topology backup batch rides the full
             # SPF (route-only runs keep the tables — the IS graph is
@@ -2057,6 +2079,7 @@ class IsisInstance(Actor):
                     lambda k, node: node["is6"] if k[6] == 0 else node["is"],
                     MT_IPV6,
                 )
+                _link_delta(MT_IPV6, topo6, atoms6)
                 res6 = self.backend.compute(topo6)
             else:
                 res6, atoms6 = res4, atoms4

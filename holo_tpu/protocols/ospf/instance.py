@@ -89,7 +89,11 @@ from holo_tpu.protocols.ospf.packet import (
     RouterLink,
     RouterLinkType,
 )
-from holo_tpu.protocols.ospf.spf_run import build_topology, derive_routes
+from holo_tpu.protocols.ospf.spf_run import (
+    build_topology,
+    derive_routes,
+    link_spf_delta,
+)
 from holo_tpu.spf.backend import ScalarSpfBackend, SpfBackend
 from holo_tpu.telemetry import convergence
 from holo_tpu.utils.ip import ALL_DR_RTRS_V4, ALL_SPF_RTRS_V4, mask_of
@@ -353,6 +357,9 @@ class OspfInstance(Actor):
         self._spf_triggers: list = []
         self._spf_force_full = True
         self._spf_cache: dict | None = None
+        # DeltaPath: the previous full run's marshaled SpfTopology per
+        # area — the diff base for incremental device-graph updates.
+        self._spf_delta_bases: dict = {}
         # Convergence-observatory causal ids pending on the next SPF run
         # (bounded; stamped in _schedule_spf, drained by run_spf).
         self._conv_pending: list = []
@@ -2799,7 +2806,13 @@ class OspfInstance(Actor):
                 vlink_nexthops,
             )
             if st is None:
+                self._spf_delta_bases.pop(area.area_id, None)
                 continue
+            # DeltaPath seam: diff against the previous run's marshaled
+            # topology so the backend can update the device-resident
+            # graph in place instead of re-marshaling the area LSDB.
+            link_spf_delta(self._spf_delta_bases.get(area.area_id), st)
+            self._spf_delta_bases[area.area_id] = st
             res = self.backend.compute(st.topo)
             area_results[area.area_id] = (st, res)
             # Reachable routers per area WITH their flags as of this SPF

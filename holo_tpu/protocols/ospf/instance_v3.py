@@ -214,6 +214,9 @@ class OspfV3Instance(Actor):
         self.frr = None
         self.frr_tables: dict = {}
         self._frr_engine = None
+        # DeltaPath: the previous run's (vertex keys, atoms, topology)
+        # per area — the diff base for in-place device-graph updates.
+        self._spf_delta_bases: dict = {}
         # RFC 6987 stub-router: MaxLinkMetric on transit/p2p router-LSA
         # links (maintenance mode; same leaf as the v2 instance).
         self.stub_router = False
@@ -2316,6 +2319,18 @@ class OspfV3Instance(Actor):
                     atoms.append((iface.name, nbr.src))
         topo.edge_direct_atom = atom_ids
         topo.touch()
+
+        # DeltaPath seam (same contract as the v2 instance): identical
+        # vertex ordering + atom table → diff against the previous
+        # run's topology so the device-resident graph updates in place.
+        prev = self._spf_delta_bases.get(area.area_id)
+        if prev is not None and prev[0] == keys and prev[1] == atoms:
+            from holo_tpu.ops.graph import diff_topologies
+
+            delta = diff_topologies(prev[2], topo)
+            if delta is not None:
+                topo.link_delta(delta)
+        self._spf_delta_bases[area.area_id] = (keys, atoms, topo)
 
         res = self.backend.compute(topo)
         # IP-FRR: the area's backup-table batch rides the same SPF

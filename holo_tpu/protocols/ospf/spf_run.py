@@ -259,6 +259,34 @@ def build_topology(
     return SpfTopology(topo, atoms, router_index, network_index)
 
 
+def link_spf_delta(
+    prev: SpfTopology | None, new: SpfTopology, max_ops: int = 512
+) -> bool:
+    """DeltaPath construction at the LSDB seam: attach delta lineage to
+    ``new`` when it differs from the previous run's marshaled topology
+    by a small edge-level change over the SAME vertex model and
+    next-hop atom table.  The device-graph cache then updates the
+    resident EllGraph in place and the TPU backend recomputes
+    incrementally instead of re-marshaling the whole LSDB (ROADMAP
+    item 1).  Returns whether lineage was attached; False always means
+    the full-rebuild path, never an error."""
+    if prev is None:
+        return False
+    if (
+        prev.atoms != new.atoms
+        or prev.router_index != new.router_index
+        or prev.network_index != new.network_index
+    ):
+        return False
+    from holo_tpu.ops.graph import diff_topologies
+
+    delta = diff_topologies(prev.topo, new.topo, max_ops=max_ops)
+    if delta is None:
+        return False
+    new.topo.link_delta(delta)
+    return True
+
+
 @dataclass(frozen=True)
 class RouteNexthop:
     ifname: str

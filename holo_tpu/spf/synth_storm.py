@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import time
 from dataclasses import dataclass
 from ipaddress import IPv4Address, IPv4Network
 
@@ -399,6 +400,41 @@ def storm_report(timelines: list[dict]) -> dict:
     return report
 
 
+def _instrument_dispatch_wall(net: StormNet):
+    """Wrap the DUT backend's ``compute`` to attribute REAL (wall-clock)
+    SPF dispatch seconds to the active causal triggers.
+
+    The storm's event-to-FIB latencies are virtual-clock quantities —
+    deterministic, but blind to how long the device work actually takes
+    (the virtual clock does not advance while Python computes).  This
+    sink is the DeltaPath headline instrument: the per-trigger
+    dispatch-wall distribution is what the incremental path must shrink
+    while the virtual timelines (and FIB digests) stay byte-identical.
+
+    Returns ``(sink, restore)``; the harness calls ``restore`` when the
+    storm ends so a caller-supplied backend leaves unwrapped (backends
+    are parameters — reuse across storms must not nest timers).
+    """
+    sink: dict[str, list[float]] = {}
+    backend = net.inst.backend
+    inner = backend.compute
+
+    def timed(topo, edge_mask=None):
+        t0 = time.perf_counter()
+        res = inner(topo, edge_mask)
+        dt = time.perf_counter() - t0
+        for trig in set(convergence.active_triggers()) or {"untracked"}:
+            sink.setdefault(trig, []).append(dt)
+        return res
+
+    backend.compute = timed
+
+    def restore():
+        backend.compute = inner
+
+    return sink, restore
+
+
 def storm_digest(timelines: list[dict]) -> str:
     """Canonical digest of the causal timelines for the determinism
     gate (same seed → same digest).  Trace span ids are stripped: the
@@ -442,6 +478,7 @@ def run_convergence_storm(
     tracker = convergence.configure(
         tracker_capacity, clock=net.loop.clock.now
     )
+    dispatch_wall, restore_dispatch = _instrument_dispatch_wall(net)
     try:
         mix_rng = inj._rng("storm.mix")
         loss_rng = inj._rng("storm.loss")
@@ -480,6 +517,14 @@ def run_convergence_storm(
         report["n-routers"] = n_routers
         report["spf-runs"] = net.inst.spf_run_count
         report["fib-size"] = len(net.kernel.fib)
+        # REAL per-trigger dispatch seconds (never in the digest: wall
+        # time is nondeterministic by nature; the determinism gate is
+        # the virtual timelines + FIB digest above).
+        report["dispatch-wall"] = {
+            trig: _percentiles(vals)
+            for trig, vals in sorted(dispatch_wall.items())
+        }
         return report, storm_digest(timelines), net
     finally:
+        restore_dispatch()
         convergence.configure(0)

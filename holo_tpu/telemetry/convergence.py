@@ -168,6 +168,11 @@ class ConvergenceTracker:
         with self._lock:
             return [ev for e in eids if (ev := self._open.get(e)) is not None]
 
+    def active_triggers(self) -> tuple[str, ...]:
+        """Trigger names of the currently-active causal events (storm
+        harness: attribute real dispatch wall time to its trigger)."""
+        return tuple(ev.trigger for ev in self._events(self.current()))
+
     def _entry(self, ev: _Event, step: str, attrs: dict) -> None:
         """Append one timeline entry (caller holds no lock)."""
         t = round(self._clock() - ev.t0, 9)
@@ -355,6 +360,11 @@ def begin(trigger: str, **attrs) -> int | None:
 def current() -> tuple[int, ...]:
     t = _TRACKER
     return t.current() if t is not None else ()
+
+
+def active_triggers() -> tuple[str, ...]:
+    t = _TRACKER
+    return t.active_triggers() if t is not None else ()
 
 
 def activation(eids):

@@ -21,6 +21,9 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
         supervision/...          # degraded actors, restart counts
       flight/                    # flight recorder (ISSUE 5; only while
         entries, capacity, dumps #   armed via flight-buffer-entries)
+      spf-graph-cache/           # shared marshaled-graph cache (ISSUE 7):
+        entries, capacity,       #   eviction/occupancy + DeltaPath chain
+        evictions, deltas-...    #   state, next to the hit/miss counters
 """
 
 from __future__ import annotations
@@ -90,6 +93,14 @@ class TelemetryStateProvider(NbProvider):
         tr = convergence.tracker()
         if tr is not None:
             out["convergence"] = tr.stats()
+        # Lazy: the marshal cache pulls in jax — a daemon that never
+        # dispatched device work should not pay the import at scrape
+        # time, so the leaf appears once the engine module is loaded.
+        import sys
+
+        eng = sys.modules.get("holo_tpu.ops.spf_engine")
+        if eng is not None:
+            out["spf-graph-cache"] = eng.shared_graph_cache().stats()
         return {ROOT: out}
 
 

@@ -218,31 +218,41 @@ def test_two_daemons_ebgp_over_tcp():
             c.set(f"{base}/network[{n}]/prefix", n)
         d.commit(c)
 
-    conf(d1, "127.0.5.1", "127.0.5.2", 65001, 65002, "1.1.1.1",
-         ["10.50.0.0/16"])
-    conf(d2, "127.0.5.2", "127.0.5.1", 65002, 65001, "2.2.2.2", [])
+    try:
+        conf(d1, "127.0.5.1", "127.0.5.2", 65001, 65002, "1.1.1.1",
+             ["10.50.0.0/16"])
+        conf(d2, "127.0.5.2", "127.0.5.1", 65002, 65001, "2.2.2.2", [])
 
-    b1 = d1.routing.instances["bgp"]
-    b2 = d2.routing.instances["bgp"]
-    ios = [d1.routing.bgp_tcp_io, d2.routing.bgp_tcp_io]
-    assert all(io is not None for io in ios)
-    ok = _drive(
-        loop, ios,
-        lambda: N("10.50.0.0/16") in b2.loc_rib,
-        timeout=15.0,
-    )
-    assert ok, (
-        f"route did not propagate; states: "
-        f"{[p.state for p in b1.peers.values()]}"
-        f"{[p.state for p in b2.peers.values()]}"
-    )
-    assert b2.loc_rib[N("10.50.0.0/16")][0].attrs.as_path == (65001,)
-    # The learned route reaches d2's RIB manager
-    from holo_tpu.utils.southbound import Protocol
-    entries = d2.routing.rib.routes.get(N("10.50.0.0/16"))
-    assert entries is not None and Protocol.BGP in entries.entries
-    for io in ios:
-        io.close()
+        b1 = d1.routing.instances["bgp"]
+        b2 = d2.routing.instances["bgp"]
+        ios = [d1.routing.bgp_tcp_io, d2.routing.bgp_tcp_io]
+        assert all(io is not None for io in ios)
+        ok = _drive(
+            loop, ios,
+            lambda: N("10.50.0.0/16") in b2.loc_rib,
+            timeout=15.0,
+        )
+        assert ok, (
+            f"route did not propagate; states: "
+            f"{[p.state for p in b1.peers.values()]}"
+            f"{[p.state for p in b2.peers.values()]}"
+        )
+        assert b2.loc_rib[N("10.50.0.0/16")][0].attrs.as_path == (65001,)
+        # The learned route reaches d2's RIB manager
+        from holo_tpu.utils.southbound import Protocol
+        entries = d2.routing.rib.routes.get(N("10.50.0.0/16"))
+        assert entries is not None and Protocol.BGP in entries.entries
+    finally:
+        # Stop BOTH daemons: leaked threaded-instance pump loops keep
+        # real-clock BGP connect-retry timers firing global metric
+        # counters for the rest of the pytest process, which breaks the
+        # postmortem bundle byte-determinism window downstream
+        # (tests/test_resilience_chaos.py).
+        for d in (d1, d2):
+            d.stop()
+        for io in (d1.routing.bgp_tcp_io, d2.routing.bgp_tcp_io):
+            if io is not None:
+                io.close()
 
 
 def test_session_reset_allows_reestablishment():

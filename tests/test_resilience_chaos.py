@@ -235,6 +235,12 @@ def test_breaker_open_postmortem_bundle_deterministic(tmp_path):
         from ipaddress import IPv4Network as NN
 
         gc.collect()  # free the previous run's breaker weakrefs
+        # Determinism isolation: eviction counts depend on how full the
+        # process-wide marshal cache is when the run starts (ISSUE 7
+        # makes entries long-lived), so each arm starts empty.
+        from holo_tpu.ops.spf_engine import shared_graph_cache
+
+        shared_graph_cache().clear()
         loop = EventLoop(clock=VirtualClock())
         telemetry.tracer().use_clock(loop.clock.now)
         dump_dir = tmp_path / tag
@@ -404,6 +410,141 @@ def test_convergence_storm_survives_pump_thread_kill():
     )
     assert len(net.kernel.fib) > 0, f"FIB lost after respawn (was {fib0})"
     tl.stop()
+
+
+def test_delta_chain_breaker_open_falls_back_bit_identical():
+    """ISSUE 7 chaos acceptance (1/3): forced dispatch failures open
+    the breaker in the middle of a DeltaPath storm — every event from
+    then on is served by the scalar fallback, and the final FIB is
+    bit-identical to an all-scalar control run of the same seeded
+    events.  Runs under jax.transfer_guard('disallow')."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import StormNet
+    from holo_tpu.testing import no_implicit_transfers
+
+    def run(backend):
+        net = StormNet(n_routers=60, seed=27, spf_backend=backend)
+        for i in range(8):
+            net.flap(net.flappable[i], lost=False)
+            net.loop.advance(12.0)
+        net.ifconfig_metric()
+        net.loop.advance(40.0)
+        return dict(net.kernel.fib)
+
+    with no_implicit_transfers():
+        breaker = CircuitBreaker(
+            "spf-delta-breaker",
+            failure_threshold=2,
+            recovery_timeout=1e9,  # stays open through the tail
+        )
+        be = TpuSpfBackend(64, breaker=breaker)
+        plan = FaultPlan(seed=27, dispatch_fail={"spf.dispatch": 2})
+        with inject(FaultInjector(plan)) as inj:
+            chaos_fib = run(be)
+        assert inj.injected["spf.dispatch"] == 2
+        assert breaker.state == "open"
+        control_fib = run(None)  # scalar oracle end to end
+    assert chaos_fib == control_fib
+
+
+def test_delta_chain_depth_cap_full_rebuild_identical_digests():
+    """ISSUE 7 chaos acceptance (2/3): a depth-capped delta chain keeps
+    falling back to the full-rebuild device path mid-storm — causal
+    timelines AND FIB digests stay byte-identical to the uncapped
+    incremental run.  Runs under jax.transfer_guard('disallow')."""
+    from holo_tpu import telemetry
+    from holo_tpu.ops.spf_engine import shared_graph_cache
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+    from holo_tpu.testing import no_implicit_transfers
+
+    def storm():
+        _report, digest, net = run_convergence_storm(
+            n_routers=60, events=24, seed=29,
+            spf_backend=TpuSpfBackend(64),
+        )
+        return digest, dict(net.kernel.fib)
+
+    cache = shared_graph_cache()
+    old_depth = cache.max_delta_depth
+    with no_implicit_transfers():
+        digest_inc, fib_inc = storm()
+        cache.max_delta_depth = 1
+        before = telemetry.snapshot(prefix="holo_spf_delta")
+        try:
+            digest_capped, fib_capped = storm()
+        finally:
+            cache.max_delta_depth = old_depth
+        after = telemetry.snapshot(prefix="holo_spf_delta")
+    fellback = sum(
+        v for k, v in after.items() if "path=full-depth" in k
+    ) - sum(v for k, v in before.items() if "path=full-depth" in k)
+    assert fellback > 0, "the cap must actually force full rebuilds"
+    assert digest_capped == digest_inc, "causal timelines must not move"
+    assert fib_capped == fib_inc
+
+
+def test_delta_padding_overflow_full_rebuild_identical():
+    """ISSUE 7 chaos acceptance (3/3): a delta overflowing the ELL
+    padding slack is refused in place and served by the full-rebuild
+    path with bit-identical results, under the transfer guard."""
+    import numpy as np
+
+    from holo_tpu import telemetry
+    from holo_tpu.ops.graph import Topology, diff_topologies
+    from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+    from holo_tpu.spf.synth import random_ospf_topology
+    from holo_tpu.testing import no_implicit_transfers
+
+    with no_implicit_transfers():
+        topo = random_ospf_topology(n_routers=12, n_networks=3, seed=8)
+        be = TpuSpfBackend(64)
+        be.compute(topo)
+        v = int(topo.edge_dst[0])
+        k_pad = 8 * (
+            1
+            + int(np.bincount(topo.edge_dst, minlength=topo.n_vertices).max())
+            // 8
+        )
+        extra = [
+            [(v + 1 + i) % topo.n_vertices, v, 5, -1]
+            for i in range(k_pad + 2)
+        ]
+        nxt = Topology(
+            n_vertices=topo.n_vertices,
+            is_router=topo.is_router.copy(),
+            edge_src=np.concatenate(
+                [topo.edge_src, np.asarray([e[0] for e in extra], np.int32)]
+            ),
+            edge_dst=np.concatenate(
+                [topo.edge_dst, np.asarray([e[1] for e in extra], np.int32)]
+            ),
+            edge_cost=np.concatenate(
+                [topo.edge_cost, np.asarray([e[2] for e in extra], np.int32)]
+            ),
+            edge_direct_atom=np.concatenate(
+                [
+                    topo.edge_direct_atom,
+                    np.asarray([e[3] for e in extra], np.int32),
+                ]
+            ),
+            root=topo.root,
+        )
+        delta = diff_topologies(topo, nxt, max_ops=4 * k_pad + 64)
+        assert delta is not None
+        nxt.link_delta(delta)
+        before = telemetry.snapshot(prefix="holo_spf_delta")
+        got = be.compute(nxt)
+        ref = ScalarSpfBackend(64).compute(nxt)
+        after = telemetry.snapshot(prefix="holo_spf_delta")
+    overflowed = sum(
+        v for k, v in after.items() if "full-padding-overflow" in k
+    ) - sum(v for k, v in before.items() if "full-padding-overflow" in k)
+    assert overflowed > 0, "the overflow fallback must actually fire"
+    for f in ("dist", "parent", "hops", "nexthop_words"):
+        np.testing.assert_array_equal(
+            getattr(ref, f), getattr(got, f), err_msg=f
+        )
 
 
 def test_ospf_reconverges_through_packet_loss():
