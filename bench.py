@@ -62,17 +62,21 @@ STAGE_TIMEOUT = {
     "telemetry_overhead": 900,
     "fallback_overhead": 900,
     "profiling_overhead": 900,
-    "convergence_storm": 1200,
+    "convergence_storm": 1800,
     "convergence_overhead": 900,
+    "delta_spf": 900,
+    "incremental_overhead": 900,
 }
 
 
-def _probe_once(timeout_s: float) -> bool:
+def _probe_once(timeout_s: float) -> tuple[bool, str | None]:
     """One fresh-subprocess probe of the default JAX platform.
 
     Wedging is per-process on the axon relay: a fresh interpreter can
     succeed minutes after another one hung, so each attempt must be a new
-    subprocess with its own hard timeout.
+    subprocess with its own hard timeout.  Returns (ok, error) so the
+    bench JSON can surface WHY the relay was declared down instead of
+    silently degrading the headline to the CPU scalar baseline.
     """
     code = (
         "import jax, numpy as np;"
@@ -83,9 +87,12 @@ def _probe_once(timeout_s: float) -> bool:
         proc = subprocess.run(
             [sys.executable, "-c", code], timeout=timeout_s, capture_output=True
         )
-        return proc.returncode == 0
+        if proc.returncode == 0:
+            return True, None
+        err = (proc.stderr or b"").decode(errors="replace").strip()
+        return False, (err[-300:] or f"probe exit code {proc.returncode}")
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"probe timeout after {timeout_s:.0f}s (relay wedged?)"
 
 
 def _device_responsive(
@@ -111,20 +118,33 @@ def _device_responsive(
     while True:
         attempt += 1
         t0 = time.monotonic()
-        ok = _probe_once(probe_timeout_s)
+        ok, err = _probe_once(probe_timeout_s)
         if history is not None:
-            history.append(
-                {
-                    "attempt": attempt,
-                    "ok": ok,
-                    "took_s": round(time.monotonic() - t0, 1),
-                }
-            )
+            entry = {
+                "attempt": attempt,
+                "ok": ok,
+                "took_s": round(time.monotonic() - t0, 1),
+            }
+            if err:
+                entry["error"] = err
+            history.append(entry)
         if ok:
             return True
         if time.monotonic() + retry_sleep_s + probe_timeout_s > deadline:
             return False
         time.sleep(retry_sleep_s)
+
+
+def _relay_summary(up: bool, history: list) -> dict:
+    """The explicit relay-status row for the bench JSON: `down` has been
+    silently degrading the headline to the CPU scalar baseline since
+    round 3 — surface the state and the last probe error instead."""
+    errors = [h.get("error") for h in history if h.get("error")]
+    return {
+        "status": "up" if up else "down",
+        "probes": len(history),
+        "last_error": errors[-1] if errors else None,
+    }
 
 
 def _sync(x) -> float:
@@ -648,26 +668,84 @@ def stage_convergence_storm(n_routers, events, reps=2):
     from holo_tpu.spf.synth_storm import run_convergence_storm
 
     t0 = time.perf_counter()
-    digests, report = [], None
-    for _ in range(reps):
-        # Fresh backend per run: the jit/shape caches must not make the
-        # second run causally different from the first.
+    digests, report, inc_first = [], None, None
+    # ONE incremental backend across reps: rep 1 is the FIRST-ENCOUNTER
+    # distribution (the two DeltaPath jits compile once), later reps
+    # are warm — the digest gate proves jit warmth leaves the causal
+    # run byte-identical either way.
+    inc_backend = TpuSpfBackend()
+    for i in range(reps):
         report, digest, _net = run_convergence_storm(
             n_routers=n_routers, events=events, seed=17,
-            spf_backend=TpuSpfBackend(),
+            spf_backend=inc_backend,
         )
+        if i == 0:
+            inc_first = report
         digests.append(digest)
+    # DeltaPath comparison arm (ISSUE 7): the SAME seeded storm with
+    # incremental dispatch disabled — causal timelines and FIB digests
+    # must stay byte-identical (bit-parity contract) while the REAL
+    # per-trigger dispatch-wall distributions show the win.  Two runs:
+    # the FIRST is how the shipped full-rebuild path actually meets a
+    # storm (every novel live-edge-count re-jits the mask shape, and
+    # every event re-marshals — those spikes ARE its p95), the second
+    # is the fully-warm steady state for an honest like-for-like split.
+    full_backend = TpuSpfBackend(incremental=False)
+    full_report = full_first = None
+    for i in range(2):
+        full_report, full_digest, _net = run_convergence_storm(
+            n_routers=n_routers, events=events, seed=17,
+            spf_backend=full_backend,
+        )
+        if i == 0:
+            full_first = full_report
+        digests.append(full_digest)
     identical = len(set(digests)) == 1
     lsa = report["triggers"].get("lsa", {})
     converged = report["outcomes"].get("converged", 0)
+
+    def split(rep):
+        return rep["dispatch-wall"].get("lsa", {})
+
+    def ratio(full_d, inc_d):
+        return {
+            q: round(full_d[q] / inc_d[q], 2)
+            for q in ("p50", "p95", "p99")
+            if inc_d.get(q) and full_d.get(q)
+        }
+
+    speedup_cold = ratio(split(full_first), split(inc_first))
+    speedup_warm = ratio(split(full_report), split(report))
+    from holo_tpu import telemetry
+
     return {
+        # ISSUE 7 acceptance rides the ok gate: byte-identical digests
+        # AND the first-encounter lsa-trigger dispatch-wall p95
+        # improving >= 2x over the full-rebuild path (both arms cold:
+        # a fresh daemon meeting the storm on each path — the full
+        # path's per-event marshal + mask-shape recompile churn is
+        # exactly the cost DeltaPath removes; the warm steady-state
+        # split rides along un-gated).
         "ok": bool(
             identical
             and converged > 0
             and lsa.get("all", {}).get("count", 0) > 0
+            and speedup_cold.get("p95", 0.0) >= 2.0
         ),
         "identical_across_runs": identical,
+        "identical_incremental_vs_full": digests[0] == full_digest,
         "digest": digests[0][:16],
+        "lsa_wall_first_encounter": {
+            "incremental": split(inc_first),
+            "full_rebuild": split(full_first),
+            "speedup": speedup_cold,
+        },
+        "lsa_wall_warm": {
+            "incremental": split(report),
+            "full_rebuild": split(full_report),
+            "speedup": speedup_warm,
+        },
+        "delta_telemetry": telemetry.snapshot(prefix="holo_spf_delta"),
         "wall_s": round(time.perf_counter() - t0, 1),
         "report": report,
     }
@@ -712,6 +790,187 @@ def stage_convergence_overhead(k, B, reps=15):
         "overhead_pct": round(overhead_pct, 3),
         "batch": int(B),
         "reps": reps,
+    }
+
+
+def stage_delta_spf(n_routers, steps, parity_every=8):
+    """ISSUE 7 acceptance row: single-flap incremental SPF (DeltaPath
+    in-place device-graph update + seeded recompute) vs the full
+    re-marshal + full recompute path, on one evolving topology chain.
+    Per-trigger split: pure metric changes (`weight`) vs link flaps
+    (`struct`, edge pair down/up).  Parity-gated against the scalar
+    oracle every ``parity_every`` steps; the chains run on distinct
+    Topology objects so the two arms never share cache entries."""
+    import numpy as np
+
+    from holo_tpu import telemetry
+    from holo_tpu.ops.graph import diff_topologies
+    from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+    from holo_tpu.spf.synth import clone_topology as clone
+    from holo_tpu.spf.synth import random_ospf_topology
+    from holo_tpu.telemetry import profiling
+
+    rng = np.random.default_rng(23)
+    base = random_ospf_topology(
+        n_routers=n_routers, n_networks=n_routers // 10,
+        extra_p2p=n_routers // 2, seed=23,
+    )
+
+    def mutate(topo, step):
+        """One storm event: a metric change or a bidirectional flap."""
+        if step % 2 == 0:
+            e = int(rng.integers(0, topo.n_edges))
+            return clone(
+                topo, cost={e: int(rng.integers(1, 64))}
+            ), "weight"
+        # Flap: drop both directions of a random non-root edge.
+        for _ in range(32):
+            e = int(rng.integers(0, topo.n_edges))
+            s, d = int(topo.edge_src[e]), int(topo.edge_dst[e])
+            if s == topo.root or d == topo.root:
+                continue
+            keep = ~(
+                ((topo.edge_src == s) & (topo.edge_dst == d))
+                | ((topo.edge_src == d) & (topo.edge_dst == s))
+            )
+            return clone(topo, keep=keep), "struct"
+        return clone(topo), "weight"
+
+    inc_be = TpuSpfBackend()
+    full_be = TpuSpfBackend(incremental=False)
+    oracle = ScalarSpfBackend()
+    # Profiling armed for the warmup compiles only: the cost_analysis
+    # table then carries the spf.delta vs spf.one FLOP/bytes split (the
+    # compile-time view of the win) without taxing the timed loop.
+    profiling.set_device_profiling(True)
+    # Two identical chains over DISTINCT Topology objects (distinct
+    # cache identities): the incremental arm carries delta lineage, the
+    # full arm never does.
+    inc_topo = base
+    inc_be.compute(inc_topo)  # warm: compile + marshal
+    full_be.compute(clone(base))
+    # Warm the delta-apply + incremental kernels too (one compile per
+    # (shape, seed-bucket) pair): the timed loop measures dispatches.
+    warm, _ = mutate(inc_topo, 0)
+    wdelta = diff_topologies(inc_topo, warm)
+    if wdelta is not None:
+        warm.link_delta(wdelta)
+        inc_be.compute(warm)
+        inc_topo = warm
+        full_be.compute(clone(warm))
+    profiling.set_device_profiling(False)
+    times: dict = {"weight": {"inc": [], "full": []},
+                   "struct": {"inc": [], "full": []}}
+    ok = True
+    deltas = 0
+    for step in range(steps):
+        nxt, kind = mutate(inc_topo, step)
+        inc_next, full_next = nxt, clone(nxt)
+        delta = diff_topologies(inc_topo, inc_next)
+        if delta is not None:
+            inc_next.link_delta(delta)
+            deltas += 1
+        t0 = time.perf_counter()
+        r_inc = inc_be.compute(inc_next)
+        times[kind]["inc"].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        r_full = full_be.compute(full_next)
+        times[kind]["full"].append(time.perf_counter() - t0)
+        if step % parity_every == 0 or step == steps - 1:
+            ref = oracle.compute(inc_next)
+            for f in ("dist", "parent", "hops", "nexthop_words"):
+                ok = ok and bool(
+                    np.array_equal(getattr(ref, f), getattr(r_inc, f))
+                    and np.array_equal(getattr(ref, f), getattr(r_full, f))
+                )
+        inc_topo = inc_next
+
+    def dist(vals):
+        if not vals:
+            return {}
+        arr = np.sort(np.asarray(vals)) * 1e3
+        return {
+            "p50_ms": round(float(arr[len(arr) // 2]), 3),
+            "p95_ms": round(float(arr[min(len(arr) - 1, int(0.95 * len(arr)))]), 3),
+            "count": len(arr),
+        }
+
+    rows = {}
+    for kind, arms in times.items():
+        inc_d, full_d = dist(arms["inc"]), dist(arms["full"])
+        rows[kind] = {
+            "incremental": inc_d,
+            "full_rebuild": full_d,
+            "speedup_p50": round(full_d["p50_ms"] / inc_d["p50_ms"], 2)
+            if inc_d.get("p50_ms")
+            else None,
+        }
+    return {
+        "ok": bool(ok and deltas > 0),
+        "parity": ok,
+        "n_vertices": int(base.n_vertices),
+        "steps": steps,
+        "deltas_linked": deltas,
+        "triggers": rows,
+        "delta_telemetry": telemetry.snapshot(prefix="holo_spf_delta"),
+        # Compile-time cost_analysis split: the delta kernel's
+        # FLOP/bytes next to the full engine's, per shape bucket.
+        "cost_analysis": {
+            f"{site}{list(sig)}": entry
+            for (site, sig), entry in sorted(
+                profiling.cost_table().items(), key=lambda kv: kv[0][0]
+            )
+        },
+    }
+
+
+def stage_incremental_overhead(k, B, reps=24, inner=4):
+    """ISSUE 7 overhead gate: the no-delta steady-state dispatch path
+    with the DeltaPath machinery ARMED (lineage checks + previous-
+    tensor retention) against the same path disarmed.  Same interleaved
+    min-of-N discipline as the other overhead gates, with an INNER loop
+    per sample: a single ~0.5ms kind=one dispatch sits at the
+    allocator-noise floor, so each sample amortizes ``inner`` dispatches
+    (the armed delta is a few host-side lookups — well under the
+    per-dispatch jitter).  ok requires <2%."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    topo, _masks = _make(k, B)
+    backend = TpuSpfBackend()
+    # Warm thoroughly: compile + graph cache, then enough dispatches
+    # for the allocator/CPU to reach steady state — the armed delta is
+    # single-digit microseconds of host lookups, so the stage measures
+    # a multi-ms dispatch (k sized up by the caller) where the 2%
+    # threshold sits far above scheduler jitter.
+    for _ in range(16):
+        backend.compute(topo)
+    on_times, off_times = [], []
+    for rep in range(reps):
+        arms = ((True, on_times), (False, off_times))
+        for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+            backend.incremental = armed
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                backend.compute(topo)
+            times.append((time.perf_counter() - t0) / inner)
+    backend.incremental = True
+    # PAIRED comparison: allocator/scheduler drift at this dispatch
+    # size (~0.5ms) exceeds the 2% threshold across a whole arm, but
+    # each rep's adjacent armed/disarmed samples share it — the median
+    # per-pair delta isolates the true armed cost (a few host lookups).
+    deltas = [a - b for a, b in zip(on_times, off_times)]
+    off_ms = float(np.median(off_times) * 1e3)
+    on_ms = float(np.median(on_times) * 1e3)
+    delta_ms = float(np.median(deltas) * 1e3)
+    overhead_pct = delta_ms / off_ms * 100.0 if off_ms else 0.0
+    return {
+        "ok": bool(overhead_pct < 2.0),
+        "armed_ms": round(on_ms, 4),
+        "disarmed_ms": round(off_ms, 4),
+        "paired_delta_ms": round(delta_ms, 5),
+        "overhead_pct": round(overhead_pct, 3),
+        "reps": reps,
+        "inner": inner,
     }
 
 
@@ -803,20 +1062,32 @@ def main() -> None:
             "convergence_overhead": lambda: stage_convergence_overhead(
                 k10, 32 if small else 64
             ),
+            "delta_spf": lambda: (
+                stage_delta_spf(300, 40)
+                if small
+                else stage_delta_spf(2000, 120)
+            ),
+            "incremental_overhead": lambda: stage_incremental_overhead(
+                40 if small else 90, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
 
     probe_history: list = []
     suffix = ""
-    if not _device_responsive(history=probe_history):
+    relay_up = _device_responsive(history=probe_history)
+    if not relay_up:
         # The platform never answered a probe within the retry budget.
         # Emit the cheap, interpretable artifact: the native C++ scalar
         # baseline (no JAX device involved) as the headline row, plus a
         # small JAX-CPU sanity run — NOT a full-size JAX-CPU slog.
         suffix = "_cpufallback"
 
-    extra: dict = {"probe_history": probe_history}
+    extra: dict = {
+        "relay": _relay_summary(relay_up, probe_history),
+        "probe_history": probe_history,
+    }
     if suffix:
         k10 = 20 if small else 90
         cpu10 = 8 if small else 32
@@ -862,6 +1133,16 @@ def main() -> None:
         )
         extra["convergence_overhead_jaxcpu_small"] = _run_stage(
             "convergence_overhead", True, cpu=True
+        )
+        # DeltaPath incremental SPF (ISSUE 7): single-flap incremental
+        # vs full-rebuild split + the no-delta steady-state gate — both
+        # platform-independent, so the JAX-CPU rows keep the acceptance
+        # signal alive while the relay is down.
+        extra["delta_spf_jaxcpu_small"] = _run_stage(
+            "delta_spf", True, cpu=True
+        )
+        extra["incremental_overhead_jaxcpu_small"] = _run_stage(
+            "incremental_overhead", True, cpu=True
         )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
@@ -944,9 +1225,15 @@ def main() -> None:
     # the un-profiled dispatch path.
     extra["profiling_overhead"] = _run_stage("profiling_overhead", small)
     # Convergence observatory (ISSUE 6): seeded flap-storm distributions
-    # (deterministic digests) + the armed-instrument <2% gate.
+    # (deterministic digests) + the armed-instrument <2% gate.  Since
+    # ISSUE 7 the storm also runs the full-rebuild comparison arm: the
+    # lsa-trigger dispatch-wall split IS the DeltaPath headline.
     extra["convergence_storm"] = _run_stage("convergence_storm", small)
     extra["convergence_overhead"] = _run_stage("convergence_overhead", small)
+    # DeltaPath incremental SPF (ISSUE 7): single-flap incremental vs
+    # full-rebuild microbench + the <2% no-delta steady-state gate.
+    extra["delta_spf"] = _run_stage("delta_spf", small)
+    extra["incremental_overhead"] = _run_stage("incremental_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

@@ -624,10 +624,166 @@ class EagerMetricReadRule(Rule):
         return out
 
 
+class LoopHostClosureRule(Rule):
+    """HL107: host side effect inside a ``lax`` control-flow callable.
+
+    The branch/body callables handed to ``lax.cond`` / ``lax.
+    while_loop`` / ``lax.scan`` / ``lax.fori_loop`` are TRACED: they
+    execute a handful of times at trace time and never again, so a
+    metric update, ``print``/logging, ``time.*`` read, or numpy
+    materialization closed over by one silently stops firing
+    per-iteration under jit — or forces a hidden host sync when the
+    function runs eagerly.  Hoist the side effect out of the loop (the
+    dispatch wrappers in spf/backend.py are the right seam) or use
+    ``jax.debug.*`` primitives designed for traced contexts.
+
+    Ships at WARN tier to soak (ROADMAP carry-over; per-rule severity
+    tiers landed in PR 6 exactly for this).
+    """
+
+    id = "HL107"
+    title = "host side effect in lax control-flow callable"
+    family = "tracer"
+    severity = "warn"
+
+    _CTRL = {
+        "jax.lax.cond", "lax.cond",
+        "jax.lax.while_loop", "lax.while_loop",
+        "jax.lax.scan", "lax.scan",
+        "jax.lax.fori_loop", "lax.fori_loop",
+    }
+    _CTRL_NAMES = {"cond", "while_loop", "scan", "fori_loop"}
+
+    @classmethod
+    def _ctrl_aliases(cls, mod: ModuleInfo) -> set[str]:
+        """Local names bound to lax control-flow primitives via
+        ``from jax.lax import while_loop [as wl]`` — the import style
+        the dotted forms alone would miss."""
+        out: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                "jax.lax", "jax._src.lax.control_flow",
+            ):
+                for alias in node.names:
+                    if alias.name in cls._CTRL_NAMES:
+                        out.add(alias.asname or alias.name)
+        return out
+    _HOST_CALLS = {"print", "open", "input"}
+    _HOST_PREFIXES = ("time.", "logging.", "log.")
+    _UPDATES = {"set", "observe", "inc", "dec"}
+    _METRIC_ROOT = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+    def _metric_update(self, node: ast.Call) -> bool:
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in self._UPDATES
+        ):
+            return False
+        recv = node.func.value
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Attribute)
+            and recv.func.attr == "labels"
+        ):
+            recv = recv.func.value
+        d = dotted(recv)
+        return d is not None and bool(
+            self._METRIC_ROOT.match(d.split(".")[0])
+        )
+
+    def _host_effect(self, fn_node) -> ast.Call | None:
+        body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted(sub.func) or ""
+                if (
+                    d in self._HOST_CALLS
+                    or d.startswith(self._HOST_PREFIXES)
+                    or d in _NP_MATERIALIZE
+                    or self._metric_update(sub)
+                ):
+                    return sub
+        return None
+
+    @staticmethod
+    def _enclosing_fn(mod: ModuleInfo, node):
+        cur = mod.parent(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            cur = mod.parent(cur)
+        return cur
+
+    def _resolve_callable(self, mod: ModuleInfo, node, name: str):
+        """Closure-style name resolution: search the enclosing function
+        chain innermost-first for a def owned by that scope, then the
+        module top level.  A module-wide name map would let same-named
+        nested callables (the repo's own cond/body convention) shadow
+        each other across functions."""
+        scope = self._enclosing_fn(mod, node)
+        while scope is not None:
+            for child in ast.walk(scope):
+                if (
+                    isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and child.name == name
+                    and child is not scope
+                    and self._enclosing_fn(mod, child) is scope
+                ):
+                    return child
+            scope = self._enclosing_fn(mod, scope)
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name == name
+            ):
+                return stmt
+        return None
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_dispatch_scope(mod.relpath):
+            return []
+        ctrl = self._CTRL | self._ctrl_aliases(mod)
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (dotted(node.func) or "") not in ctrl:
+                continue
+            callables = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in callables:
+                if isinstance(arg, ast.Lambda):
+                    fn = arg
+                elif isinstance(arg, ast.Name):
+                    fn = self._resolve_callable(mod, node, arg.id)
+                    if fn is None:
+                        continue
+                else:
+                    continue
+                offender = self._host_effect(fn)
+                if offender is not None:
+                    d = dotted(offender.func) or "host call"
+                    out.append(
+                        self.finding(
+                            mod,
+                            offender,
+                            f"`{d}(...)` inside a lax control-flow "
+                            "callable runs at trace time only (or "
+                            "forces a host sync eagerly); hoist it out "
+                            "of the traced body",
+                        )
+                    )
+        return out
+
+
 RULES = [
     HostSyncRule,
     TracedControlFlowRule,
     RecompileHazardRule,
     DtypeParityRule,
     EagerMetricReadRule,
+    LoopHostClosureRule,
 ]

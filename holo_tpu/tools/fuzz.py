@@ -223,6 +223,112 @@ def frr_padding_invariants(data: bytes) -> None:
             raise AssertionError(f"pad-variant table: {f}")
 
 
+def delta_apply_invariants(data: bytes) -> None:
+    """DeltaPath invariants (ISSUE 7; not a wire decoder): an arbitrary
+    chain of topology deltas applied through ``DeviceGraphCache`` —
+    weight changes, edge add/remove, transit strikes, depth caps and
+    forced full rebuilds — must leave the device-resident graph
+    representing EXACTLY the final topology: for every vertex, the
+    multiset of valid (src, cost, atom) in-slots equals the topology's
+    in-edges, and the one-hot atom words match the slot atoms.  Since
+    every SPF engine consumes only those planes (plus ``in_edge_id``,
+    which delta chains invalidate for mask consumers by contract), slot
+    equality implies bit-identical SPF results; the devicewide parity
+    property is pinned in tests/test_delta_spf.py.  Violations raise
+    AssertionError, which the harness reports as a crash.
+    """
+    if len(data) < 6:
+        raise DecodeError("delta spec: need 6+ bytes (kind,size,seed,depth,ops)")
+    import numpy as np  # noqa: PLC0415
+
+    from holo_tpu.ops.graph import diff_topologies  # noqa: PLC0415
+    from holo_tpu.ops.spf_engine import DeviceGraphCache  # noqa: PLC0415
+    from holo_tpu.spf import synth  # noqa: PLC0415
+    from holo_tpu.spf.synth import clone_topology as clone  # noqa: PLC0415
+
+    kind, size, seed = data[0] % 3, 4 + data[1] % 5, data[2]
+    if kind == 0:
+        topo = synth.ring_topology(size, seed=seed)
+    elif kind == 1:
+        topo = synth.grid_topology(2, size, seed=seed)
+    else:
+        topo = synth.random_ospf_topology(
+            n_routers=size + 2, n_networks=2, extra_p2p=2, seed=seed
+        )
+    cache = DeviceGraphCache(capacity=4, max_delta_depth=1 + data[3] % 5)
+    n_atoms = 64
+
+    def check(g, t):
+        """Device graph == final topology, row by row (multisets)."""
+        in_src = np.asarray(g.in_src)
+        in_cost = np.asarray(g.in_cost)
+        in_valid = np.asarray(g.in_valid)
+        words = np.asarray(g.direct_nh_words)
+        for v in range(t.n_vertices):
+            want = sorted(
+                (int(s), int(c), int(a))
+                for s, d, c, a in zip(
+                    t.edge_src, t.edge_dst, t.edge_cost, t.edge_direct_atom
+                )
+                if d == v
+            )
+            got = []
+            for k in np.nonzero(in_valid[v])[0]:
+                bits = [
+                    wi * 32 + b
+                    for wi in range(words.shape[2])
+                    for b in range(32)
+                    if words[v, k, wi] >> np.uint32(b) & np.uint32(1)
+                ]
+                assert len(bits) <= 1, f"slot carries {len(bits)} atoms"
+                got.append(
+                    (int(in_src[v, k]), int(in_cost[v, k]),
+                     bits[0] if bits else -1)
+                )
+            assert sorted(got) == want, f"row {v}: {sorted(got)} != {want}"
+
+    g, _ = cache.get(topo, n_atoms)
+    check(g, topo)
+    cur = topo
+    for b in data[4:24]:
+        n, ne = cur.n_vertices, cur.n_edges
+        op = b >> 6
+        if op == 0 and ne:  # metric change
+            nxt = clone(cur, cost={b % ne: 1 + b % 61})
+        elif op == 1 and ne:  # drop one directed edge
+            keep = np.ones(ne, bool)
+            keep[b % ne] = False
+            nxt = clone(cur, keep=keep)
+        elif op == 2:  # add a directed edge (atom -1 or small)
+            nxt = clone(
+                cur, extra=[[b % n, (b // 7) % n, 1 + b % 31, b % 5 - 1]]
+            )
+        else:  # transit strike (overload bit): no diff form — direct delta
+            v = b % n
+            keep = cur.edge_src != v
+            nxt = clone(cur, keep=keep)
+            from holo_tpu.ops.graph import TopologyDelta  # noqa: PLC0415
+
+            nxt.link_delta(
+                TopologyDelta(
+                    base_key=cur.cache_key,
+                    overload=np.asarray([v], np.int32),
+                    ids_stable=False,
+                )
+            )
+            g, _ = cache.get(nxt, n_atoms)
+            check(g, nxt)
+            cur = nxt
+            continue
+        delta = diff_topologies(cur, nxt)
+        if delta is not None:
+            nxt.link_delta(delta)
+        # Alternate mask-consumer lookups: stale-id entries must rebuild.
+        g, _ = cache.get(nxt, n_atoms, need_edge_ids=bool(b & 0x20))
+        check(g, nxt)
+        cur = nxt
+
+
 # ===== target registry (the reference's fuzz_targets/** inventory) =====
 
 
@@ -307,6 +413,9 @@ def targets() -> dict:
         "igmp_packet_decode": igmp.IgmpPacket.decode,
         # frr/ (ISSUE 1): padded-input invariants of the LFA kernel model.
         "frr_padding_invariants": frr_padding_invariants,
+        # DeltaPath (ISSUE 7): device-resident graph delta-chain
+        # invariants of the shared marshal cache.
+        "delta_apply_invariants": delta_apply_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

@@ -595,6 +595,198 @@ def test_hl204_daemon_actor_classes_out_of_scope():
     assert "HL204" not in rules_fired(HL204_BAD, DAEMON)
 
 
+# -- HL107: host side effect in lax control-flow callable ---------------
+
+HL107_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    from holo_tpu import telemetry
+
+    _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+    def relax(g, dist):
+        def cond(carry):
+            d, changed = carry
+            return changed
+
+        def body(carry):
+            d, _ = carry
+            _ROUNDS.labels(site="relax").inc()
+            new = jnp.minimum(d, d[g] + 1)
+            return new, jnp.any(new != d)
+
+        out, _ = jax.lax.while_loop(cond, body, (dist, jnp.bool_(True)))
+        return out
+"""
+HL107_SUPPRESSED = """
+    import jax
+    import jax.numpy as jnp
+
+    from holo_tpu import telemetry
+
+    _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+    def relax(g, dist):
+        def cond(carry):
+            d, changed = carry
+            return changed
+
+        def body(carry):
+            d, _ = carry
+            _ROUNDS.labels(site="relax").inc()  # holo-lint: disable=HL107
+            new = jnp.minimum(d, d[g] + 1)
+            return new, jnp.any(new != d)
+
+        out, _ = jax.lax.while_loop(cond, body, (dist, jnp.bool_(True)))
+        return out
+"""
+HL107_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    from holo_tpu import telemetry
+
+    _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+    def relax(g, dist):
+        def cond(carry):
+            d, changed, it = carry
+            return changed
+
+        def body(carry):
+            d, _, it = carry
+            new = jnp.minimum(d, d[g] + 1)
+            return new, jnp.any(new != d), it + 1
+
+        out, _, rounds = jax.lax.while_loop(
+            cond, body, (dist, jnp.bool_(True), 0)
+        )
+        _ROUNDS.labels(site="relax").inc()  # host side: after the loop
+        return out
+"""
+
+
+def test_hl107_loop_host_closure():
+    assert_triple(
+        "HL107", HL107_BAD, HL107_SUPPRESSED, HL107_CLEAN, OPS
+    )
+
+
+def test_hl107_lambda_and_time_forms():
+    src = """
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        def run(x):
+            t = jax.lax.fori_loop(
+                0, 4, lambda i, c: c + time.perf_counter(), x
+            )
+            return jax.lax.cond(
+                x[0] > 0, lambda: jnp.sum(x), lambda: jnp.zeros(())
+            ) + t
+    """
+    findings = lint(src, OPS).findings
+    assert sum(f.rule == "HL107" for f in findings) == 1
+
+
+def test_hl107_keyword_callable_form():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        from holo_tpu import telemetry
+
+        _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+        def relax(g, dist):
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                _ROUNDS.inc()
+                return jnp.minimum(c[0], c[0][g]), c[1]
+
+            out, _ = jax.lax.while_loop(
+                cond_fun=cond, body_fun=body, init_val=(dist, True)
+            )
+            return out
+    """
+    assert "HL107" in rules_fired(src, OPS)
+
+
+def test_hl107_same_named_bodies_resolve_per_scope():
+    """Two functions each defining a nested `body` (the codebase's own
+    cond/body convention) must resolve independently: the dirty one
+    fires, the clean one does not shadow it."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        from holo_tpu import telemetry
+
+        _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+        def clean_loop(g, x):
+            def body(c):
+                return jnp.minimum(c, c[g])
+
+            def cond(c):
+                return jnp.any(c > 0)
+
+            return jax.lax.while_loop(cond, body, x)
+
+        def dirty_loop(g, x):
+            def body(c):
+                _ROUNDS.inc()
+                return jnp.minimum(c, c[g])
+
+            def cond(c):
+                return jnp.any(c > 0)
+
+            return jax.lax.while_loop(cond, body, x)
+    """
+    findings = [f for f in lint(src, OPS).findings if f.rule == "HL107"]
+    # A module-wide name map resolves BOTH loops' `body` to the last
+    # def seen (the dirty one) and flags both call sites.
+    assert len(findings) == 1
+
+
+def test_hl107_bare_import_form():
+    src = """
+        from jax.lax import while_loop
+
+        import jax.numpy as jnp
+
+        from holo_tpu import telemetry
+
+        _ROUNDS = telemetry.counter("fixture_rounds_total", "rounds")
+
+        def relax(g, dist):
+            def cond(c):
+                return jnp.any(c > 0)
+
+            def body(c):
+                _ROUNDS.inc()
+                return jnp.minimum(c, c[g])
+
+            return while_loop(cond, body, dist)
+    """
+    assert "HL107" in rules_fired(src, OPS)
+
+
+def test_hl107_is_warn_tier():
+    res = lint(HL107_BAD, OPS)
+    tiers = {f.rule: f.severity for f in res.findings}
+    assert tiers.get("HL107") == "warn"
+
+
+def test_hl107_out_of_scope_module_is_ignored():
+    assert "HL107" not in rules_fired(HL107_BAD, OUTSIDE)
+
+
 # -- machinery ----------------------------------------------------------
 
 
