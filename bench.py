@@ -66,6 +66,8 @@ STAGE_TIMEOUT = {
     "convergence_overhead": 900,
     "delta_spf": 900,
     "incremental_overhead": 900,
+    "shard_spf": 1200,
+    "sharding_overhead": 900,
 }
 
 
@@ -974,6 +976,180 @@ def stage_incremental_overhead(k, B, reps=24, inner=4):
     }
 
 
+def stage_shard_spf(n_routers, reps=3):
+    """ISSUE 8 acceptance row: the REAL TpuSpfBackend sharded dispatch
+    path over a forced 8-device virtual CPU mesh — scenario-count
+    sweep 1→2·devices per mesh shape, runs/s + compile-time
+    cost_analysis, parity-gated bit-identical against the scalar
+    oracle, with the shard-dispatch counter proving every timed batch
+    actually took the mesh path.  `relay` is explicit: this stage
+    NEVER touches the TPU relay (virtual host devices measure sharding
+    mechanics + GSPMD partitioning, not chip throughput — real-ICI
+    scaling is a follow-up once a slice is attached)."""
+    from holo_tpu.testing import force_virtual_cpu_mesh
+
+    force_virtual_cpu_mesh(8)
+    import jax
+
+    from holo_tpu import telemetry
+    from holo_tpu.parallel.mesh import (
+        configure_process_mesh,
+        reset_process_mesh,
+    )
+    from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+    from holo_tpu.spf.synth import (
+        random_ospf_topology,
+        whatif_link_failure_masks,
+    )
+    from holo_tpu.telemetry import profiling
+
+    n_devices = len(jax.devices())
+    topo = random_ospf_topology(
+        n_routers=n_routers,
+        n_networks=n_routers // 5,
+        extra_p2p=n_routers,
+        seed=8,
+    )
+    sweep_b = sorted({1, 2, n_devices // 2, n_devices, 2 * n_devices})
+    mesh_rows: dict = {}
+    ok = True
+
+    def counter():
+        snap = telemetry.snapshot(prefix="holo_spf_shard_dispatch_total")
+        return snap.get("holo_spf_shard_dispatch_total{kind=whatif}", 0.0)
+
+    oracle = ScalarSpfBackend()
+    try:
+        for nb, nn in ((n_devices, 1), (n_devices // 2, 2), (2, n_devices // 2)):
+            configure_process_mesh(nb, nn)
+            be = TpuSpfBackend()
+            # Warm with profiling armed: the compiles AND their one-off
+            # cost_analysis captures land here, outside the timed loop.
+            profiling.set_device_profiling(True)
+            for b in sweep_b:
+                be.compute_whatif(
+                    topo, whatif_link_failure_masks(topo, b, seed=1)
+                )
+            profiling.set_device_profiling(False)
+            rows = {}
+            for b in sweep_b:
+                masks = whatif_link_failure_masks(topo, b, seed=1)
+                c0 = counter()
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    res = be.compute_whatif(topo, masks)
+                    times.append(time.perf_counter() - t0)
+                dt = sum(times) / reps
+                sharded = counter() - c0
+                if b == n_devices:
+                    ref = oracle.compute_whatif(topo, masks)
+                    parity = all(
+                        np.array_equal(getattr(r, f), getattr(s, f))
+                        for r, s in zip(ref, res)
+                        for f in ("dist", "parent", "hops", "nexthop_words")
+                    )
+                    ok = ok and parity
+                    rows[f"B{b}"] = {"parity_vs_oracle": parity}
+                rows.setdefault(f"B{b}", {}).update(
+                    {
+                        "runs_per_sec": round(b / dt, 2),
+                        "batch_ms": round(dt * 1e3, 3),
+                        "shard_dispatches": sharded,
+                    }
+                )
+                ok = ok and sharded == reps
+            full = rows[f"B{n_devices}"]["runs_per_sec"]
+            single = rows["B1"]["runs_per_sec"]
+            mesh_rows[f"{nb}x{nn}"] = rows | {
+                # Throughput leverage of the batch axis (informational
+                # on virtual CPU devices; the gate is parity + the
+                # counter — chip scaling needs real ICI).
+                "batch_axis_speedup": round(full / single, 2) if single else 0.0
+            }
+    finally:
+        reset_process_mesh()
+        profiling.set_device_profiling(False)
+    return {
+        "ok": bool(ok),
+        "devices": n_devices,
+        "relay": "not-used (forced 8-device virtual CPU mesh)",
+        "scenario_sweep": sweep_b,
+        "meshes": mesh_rows,
+        "cost_analysis": {
+            # sig = (graph shape, W, mask shape, mesh identity): keep
+            # the mesh axes in the key — the sweep's shapes coincide on
+            # meshes whose padded dims agree, and the per-mesh split IS
+            # the deliverable — but drop the device-id tuple noise.
+            f"{site}{list(sig[:3])}@mesh{sig[3][0]}x{sig[3][1]}": entry
+            for (site, sig), entry in sorted(
+                profiling.cost_table().items(), key=lambda kv: kv[0][0]
+            )
+            if site == "spf.whatif" and sig[3] is not None
+        },
+        "telemetry": telemetry.snapshot(prefix="holo_spf_shard"),
+    }
+
+
+def stage_sharding_overhead(k, B, reps=24, inner=2):
+    """ISSUE 8 overhead gate: the mesh-aware dispatch path on a
+    1-DEVICE mesh (placement, batch padding check, sharded jit with a
+    degenerate constraint) against the plain single-device path, on
+    the same warm backend.  Cache entries and jits are keyed by mesh
+    identity, so toggling the installed mesh between arms re-hits warm
+    state — the paired-median discipline of incremental_overhead
+    isolates the true per-dispatch delta.  ok requires <2%."""
+    from holo_tpu.testing import force_virtual_cpu_mesh
+
+    force_virtual_cpu_mesh(8)
+    import jax
+
+    from holo_tpu.parallel.mesh import (
+        configure_process_mesh,
+        reset_process_mesh,
+    )
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    topo, masks = _make(k, B)
+    be = TpuSpfBackend()
+    one_dev = jax.devices()[:1]
+    # Warm both arms: compile + marshal both cache placements.
+    configure_process_mesh(1, 1, devices=one_dev)
+    be.compute_whatif(topo, masks)
+    reset_process_mesh()
+    be.compute_whatif(topo, masks)
+    on_times, off_times = [], []
+    try:
+        for rep in range(reps):
+            arms = ((True, on_times), (False, off_times))
+            for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+                if armed:
+                    configure_process_mesh(1, 1, devices=one_dev)
+                else:
+                    reset_process_mesh()
+                t0 = time.perf_counter()
+                for _ in range(inner):
+                    be.compute_whatif(topo, masks)
+                times.append((time.perf_counter() - t0) / inner)
+    finally:
+        reset_process_mesh()
+    deltas = [a - b for a, b in zip(on_times, off_times)]
+    off_ms = float(np.median(off_times) * 1e3)
+    on_ms = float(np.median(on_times) * 1e3)
+    delta_ms = float(np.median(deltas) * 1e3)
+    overhead_pct = delta_ms / off_ms * 100.0 if off_ms else 0.0
+    return {
+        "ok": bool(overhead_pct < 2.0),
+        "meshed_ms": round(on_ms, 4),
+        "plain_ms": round(off_ms, 4),
+        "paired_delta_ms": round(delta_ms, 5),
+        "overhead_pct": round(overhead_pct, 3),
+        "batch": int(B),
+        "reps": reps,
+        "inner": inner,
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -1070,6 +1246,12 @@ def main() -> None:
             "incremental_overhead": lambda: stage_incremental_overhead(
                 40 if small else 90, 32 if small else 64
             ),
+            "shard_spf": lambda: (
+                stage_shard_spf(60) if small else stage_shard_spf(400)
+            ),
+            "sharding_overhead": lambda: stage_sharding_overhead(
+                20 if small else 40, 16 if small else 32
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -1144,6 +1326,12 @@ def main() -> None:
         extra["incremental_overhead_jaxcpu_small"] = _run_stage(
             "incremental_overhead", True, cpu=True
         )
+        # Multi-chip sharded dispatch (ISSUE 8): forces its own
+        # 8-device virtual CPU mesh, so the real-dispatch-path row and
+        # its <2% 1-device-mesh gate survive a dead relay at full
+        # fidelity (the stage never touches the relay by design).
+        extra["shard_spf"] = _run_stage("shard_spf", True)
+        extra["sharding_overhead"] = _run_stage("sharding_overhead", True)
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
         print(
@@ -1234,6 +1422,12 @@ def main() -> None:
     # full-rebuild microbench + the <2% no-delta steady-state gate.
     extra["delta_spf"] = _run_stage("delta_spf", small)
     extra["incremental_overhead"] = _run_stage("incremental_overhead", small)
+    # Multi-chip sharded dispatch (ISSUE 8): scenario-count sweep per
+    # mesh shape through the REAL TpuSpfBackend sharded path (forced
+    # 8-device virtual CPU mesh — sharding mechanics, not chip
+    # throughput) + the <2% 1-device-mesh overhead gate.
+    extra["shard_spf"] = _run_stage("shard_spf", small)
+    extra["sharding_overhead"] = _run_stage("sharding_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

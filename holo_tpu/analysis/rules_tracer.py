@@ -56,6 +56,10 @@ _HOST_SINKS = {
     "len",
     "str",
     "repr",
+    # Profiling completion barriers: block_until_ready wrappers that
+    # return host metadata (a bool) — taint stops like float()/item().
+    "profiling.device_stages",
+    "telemetry.profiling.device_stages",
 }
 _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
 _REDUCTIONS = {
@@ -637,14 +641,15 @@ class LoopHostClosureRule(Rule):
     dispatch wrappers in spf/backend.py are the right seam) or use
     ``jax.debug.*`` primitives designed for traced contexts.
 
-    Ships at WARN tier to soak (ROADMAP carry-over; per-rule severity
-    tiers landed in PR 6 exactly for this).
+    Shipped at WARN tier in PR 7 to soak; promoted to ERROR tier in
+    PR 8 after a clean soak (zero false positives, repo stayed clean)
+    — the tier-1 gate now fails on new findings like every other rule.
     """
 
     id = "HL107"
     title = "host side effect in lax control-flow callable"
     family = "tracer"
-    severity = "warn"
+    severity = "error"
 
     _CTRL = {
         "jax.lax.cond", "lax.cond",

@@ -104,6 +104,23 @@ class ResilienceConfig:
 
 
 @dataclass
+class ParallelConfig:
+    # Multi-chip dispatch mesh ([parallel] in holod.toml, ISSUE 8): the
+    # daemon installs one process-wide (batch, node) jax mesh at boot
+    # and TpuSpfBackend / FrrEngine / the shared DeviceGraphCache
+    # dispatch sharded over it (parallel/mesh.py layout contract).
+    # Default: enabled, all devices on the batch axis (what-if batches
+    # scale embarrassingly) — a 1-device host degenerates to the
+    # single-device program at <2% overhead (bench sharding_overhead).
+    enabled: bool = True
+    # Axis sizes; None = derive (both None -> all devices on batch;
+    # one set -> the other is devices/that).  batch*node must equal the
+    # device count or boot logs a warning and stays single-device.
+    batch: int | None = None
+    node: int | None = None
+
+
+@dataclass
 class RuntimeConfig:
     # "threaded" (default): each protocol instance on its own OS thread
     # — the reference's PRODUCTION posture (per-instance spawn_blocking,
@@ -131,6 +148,7 @@ class DaemonConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
 
     @classmethod
     def load(cls, path: str | Path | None) -> "DaemonConfig":
@@ -196,6 +214,24 @@ class DaemonConfig:
             ):
                 if toml_key in r:
                     setattr(res, attr, r[toml_key])
+        if "parallel" in raw:
+            p = raw["parallel"]
+            cfg.parallel.enabled = p.get("enabled", True)
+            for key in ("batch", "node"):
+                if key in p:
+                    v = p[key]
+                    # bool is an int subclass: `batch = true` must be
+                    # rejected, not silently installed as batch=1.
+                    if (
+                        isinstance(v, bool)
+                        or not isinstance(v, int)
+                        or v < 1
+                    ):
+                        raise ValueError(
+                            f"[parallel] {key} must be a positive "
+                            f"integer, got {v!r}"
+                        )
+                    setattr(cfg.parallel, key, v)
         if "runtime" in raw:
             iso = raw["runtime"].get("isolation")
             if iso is not None:

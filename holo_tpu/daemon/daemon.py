@@ -567,6 +567,27 @@ def main(argv=None):
         recovery_timeout=cfg.resilience.breaker_recovery_timeout,
         deadline=cfg.resilience.breaker_deadline,
     )
+    # Multi-chip dispatch mesh ([parallel], ISSUE 8): process-wide like
+    # the breaker knobs, installed at BOOT only (constructing a Daemon
+    # object must not rewrite process globals).  A shape that does not
+    # fit the device count degrades to single-device dispatch with a
+    # warning rather than refusing to boot.
+    if cfg.parallel.enabled:
+        try:
+            from holo_tpu.parallel.mesh import configure_process_mesh
+
+            mesh = configure_process_mesh(
+                cfg.parallel.batch, cfg.parallel.node
+            )
+            log.info(
+                "parallel dispatch mesh %s over %d device(s)",
+                dict(mesh.shape),
+                mesh.size,
+            )
+        except Exception as e:  # noqa: BLE001 — mesh is an optimization
+            log.warning(
+                "parallel mesh unavailable (%s); single-device dispatch", e
+            )
     from holo_tpu.daemon import hardening
 
     lock_fd = None

@@ -57,6 +57,21 @@ _FRR_PAD_OCCUPANCY = telemetry.gauge(
     "Valid fraction of the padded FRR plane (last dispatch)",
     ("plane",),
 )
+# Same family the SPF backend increments (registry get-or-create by
+# name): one process-wide series of mesh-sharded dispatches, split by
+# dispatch kind.
+_FRR_SHARD_DISPATCHES = telemetry.counter(
+    "holo_spf_shard_dispatch_total",
+    "Dispatches routed through the process-mesh sharded path "
+    "(parallel/mesh.py layout contract)",
+    ("kind",),
+)
+
+
+def _mesh():
+    from holo_tpu.parallel.mesh import process_mesh
+
+    return process_mesh()
 
 
 @dataclass
@@ -190,6 +205,74 @@ class FrrEngine:
         )
         self._jit = None  # built lazily (jax import on first TPU compute)
         self._compiled_shapes: set[tuple] = set()
+        # Mesh-sharded all-roots programs, one per mesh identity
+        # (outputs pinned to the batch sharding over protected links).
+        self._shard_jits: dict[tuple, object] = {}
+
+    def _sharded_jit(self, mesh):
+        if mesh.size == 1:
+            # Degenerate mesh: the plain program is the sharded program
+            # (built by _compute_tpu before dispatch branches).
+            return self._jit
+        import jax
+
+        from holo_tpu.frr.kernel import frr_batch
+        from holo_tpu.parallel.mesh import constrain_batch, mesh_cache_key
+
+        key = mesh_cache_key(mesh)
+        fn = self._shard_jits.get(key)
+        if fn is None:
+
+            @jax.jit
+            def step(g, root, lf, lc, lv, em, an, ac, al, av):
+                out = frr_batch(
+                    g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
+                )
+                return constrain_batch(mesh, out)
+
+            fn = self._shard_jits[key] = step
+        return fn
+
+    @staticmethod
+    def _shard_args(mesh, fin):
+        """Place the FRR planes per the mesh layout contract: the
+        per-protected-link planes (the all-roots/what-if batch axis)
+        sharded over ``batch`` — padded to the axis size with
+        valid=False links whose scenario masks fail nothing — and the
+        repair-candidate adjacency planes replicated."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        nb = mesh.shape["batch"]
+        lf, lc, lv, em = (
+            fin.link_far, fin.link_cost, fin.link_valid, fin.edge_masks,
+        )
+        pad = (-lf.shape[0]) % nb
+        if pad:
+            lf = np.concatenate([lf, np.zeros(pad, lf.dtype)])
+            lc = np.concatenate([lc, np.ones(pad, lc.dtype)])
+            lv = np.concatenate([lv, np.zeros(pad, bool)])
+            em = np.concatenate([em, np.ones((pad, em.shape[1]), bool)])
+        if mesh.size == 1:
+            # Nothing to shard: the jit commits host arrays itself
+            # (see mesh.shard_scenarios — the sharding_overhead gate).
+            return (
+                lf, lc, lv, em,
+                fin.adj_nbr, fin.adj_cost, fin.adj_link, fin.adj_valid,
+            )
+        link = NamedSharding(mesh, P("batch"))
+        mask = NamedSharding(mesh, P("batch", None))
+        rep = NamedSharding(mesh, P())
+        return (
+            jax.device_put(lf, link),
+            jax.device_put(lc, link),
+            jax.device_put(lv, link),
+            jax.device_put(em, mask),
+            jax.device_put(fin.adj_nbr, rep),
+            jax.device_put(fin.adj_cost, rep),
+            jax.device_put(fin.adj_link, rep),
+            jax.device_put(fin.adj_valid, rep),
+        )
 
     # -- device path
 
@@ -216,9 +299,16 @@ class FrrEngine:
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
         faults.crashpoint("frr.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            # Shard-dispatch chaos seam: device loss / XLA failure on
+            # any shard surfaces here and the breaker serves the whole
+            # batch from the scalar oracle.
+            faults.crashpoint("frr.shard")
         import jax
 
         from holo_tpu.frr.kernel import frr_batch
+        from holo_tpu.parallel.mesh import mesh_cache_key
 
         if self._jit is None:
             self._jit = jax.jit(
@@ -226,52 +316,67 @@ class FrrEngine:
                     g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
                 )
             )
-        sig = (fin.link_far.shape, fin.edge_masks.shape, fin.adj_nbr.shape)
-        if sig in self._compiled_shapes:
-            _FRR_JIT_HITS.inc()
-            fresh = False
-        else:
-            self._compiled_shapes.add(sig)
-            _FRR_COMPILES.inc()
-            fresh = True
         # The FRR analog of the SPF backend's sanctioned boundary: the
         # padded planes move host->device here, results device->host
         # below, and nowhere else.
-        args = (
-            fin.link_far,
-            fin.link_cost,
-            fin.link_valid,
-            fin.edge_masks,
-            fin.adj_nbr,
-            fin.adj_cost,
-            fin.adj_link,
-            fin.adj_valid,
-        )
         with profiling.stage("frr.batch", "marshal"):
             with sanctioned_transfer("frr.batch.marshal"):
                 g = self._prepare(topo)
-                out = self._jit(g, topo.root, *args)
+                if mesh is not None:
+                    args = self._shard_args(mesh, fin)
+                    step = self._sharded_jit(mesh)
+                else:
+                    args = (
+                        fin.link_far,
+                        fin.link_cost,
+                        fin.link_valid,
+                        fin.edge_masks,
+                        fin.adj_nbr,
+                        fin.adj_cost,
+                        fin.adj_link,
+                        fin.adj_valid,
+                    )
+                    step = self._jit
+                sig = (
+                    args[0].shape, args[3].shape, args[4].shape,
+                    mesh_cache_key(mesh),
+                )
+                if sig in self._compiled_shapes:
+                    _FRR_JIT_HITS.inc()
+                    fresh = False
+                else:
+                    self._compiled_shapes.add(sig)
+                    _FRR_COMPILES.inc()
+                    fresh = True
+                out = step(g, topo.root, *args)
         if fresh:
             profiling.record_cost(
-                "frr.batch", self._jit, g, topo.root, *args, shape_sig=sig
+                "frr.batch", step, g, topo.root, *args, shape_sig=sig
             )
         with profiling.stage("frr.batch", "device"):
             with profiling.annotation("frr.batch.device"):
-                profiling.sync(out)
+                if not profiling.device_stages("frr.batch", out):
+                    profiling.sync(out)
         nl = fin.n_links
+        n = int(topo.n_vertices)
+        if mesh is not None:
+            _FRR_SHARD_DISPATCHES.labels(kind="frr").inc()
         convergence.note_dispatch("frr", "device")
         with profiling.stage("frr.batch", "readback"):
             with sanctioned_transfer("frr.batch.unmarshal"):
+                # [:nl] drops the link-plane pad (marshal bucket + mesh
+                # batch-axis pad); [:n] drops the node-sharded row pad
+                # on the vertex axis — both no-ops single-device.
                 return BackupTable(
                     inputs=fin,
                     root=int(topo.root),
-                    lfa_adj=np.asarray(out.lfa_adj)[:nl],
-                    lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl],
-                    rlfa_pq=np.asarray(out.rlfa_pq)[:nl],
-                    tilfa_p=np.asarray(out.tilfa_p)[:nl],
-                    tilfa_q=np.asarray(out.tilfa_q)[:nl],
-                    post_dist=np.asarray(out.post_dist)[:nl],
-                    post_nh=np.asarray(out.post_nh)[:nl],
+                    lfa_adj=np.asarray(out.lfa_adj)[:nl, :n],
+                    lfa_nodeprot=np.asarray(out.lfa_nodeprot)[:nl, :n],
+                    rlfa_pq=np.asarray(out.rlfa_pq)[:nl, :n],
+                    tilfa_p=np.asarray(out.tilfa_p)[:nl, :n],
+                    tilfa_q=np.asarray(out.tilfa_q)[:nl, :n],
+                    post_dist=np.asarray(out.post_dist)[:nl, :n],
+                    post_nh=np.asarray(out.post_nh)[:nl, :n],
                 )
 
     def _scalar_fallback(self, topo: Topology, fin) -> BackupTable:

@@ -153,6 +153,12 @@ class _CacheEntry:
     # (structural deltas shift edge indices): entries in this state can
     # serve mask-free SPF but not edge-mask consumers (what-if, FRR).
     ids_stale: bool = False
+    # The dispatch mesh the planes were placed under (row-sharded over
+    # its node axis, batch-replicated — parallel/mesh.py layout
+    # contract), or None for single-device placement.  Entries are also
+    # KEYED by the mesh identity, so a reconfigured mesh never hands a
+    # stale placement to a new-mesh jit.
+    mesh: object | None = None
 
 
 class _DeltaUnappliable(Exception):
@@ -181,6 +187,44 @@ def _apply_delta_slots(g: DeviceGraph, rows, cols, src, cost, valid, words, stri
 
 
 _APPLY_DELTA = jax.jit(_apply_delta_slots, donate_argnums=(0,))
+
+# Sharded apply variants, one per process-mesh identity: out_shardings
+# pins the updated planes to the entry's row-sharded layout so the
+# donated in-place scatter stays per-shard (no resharding collective,
+# no placement drift down a delta chain).
+_APPLY_DELTA_SHARDED: dict[tuple, object] = {}
+
+
+def _process_mesh_state():
+    """(mesh, cache-key component) of the process dispatch mesh.
+
+    Lazy import: parallel/mesh.py imports this module at top level, so
+    the dependency must stay one-way at import time.  After the first
+    call this is a sys.modules dict hit — nanoseconds on the dispatch
+    path (the incremental_overhead/sharding_overhead gates cover it).
+    """
+    from holo_tpu.parallel import mesh as _pm
+
+    m = _pm.process_mesh()
+    return m, (None if m is None else _pm.mesh_cache_key(m))
+
+
+def _apply_delta_for(mesh) -> object:
+    """The delta-apply jit matching an entry's placement."""
+    if mesh is None:
+        return _APPLY_DELTA
+    from holo_tpu.parallel import mesh as _pm
+
+    key = _pm.mesh_cache_key(mesh)
+    fn = _APPLY_DELTA_SHARDED.get(key)
+    if fn is None:
+        fn = jax.jit(
+            _apply_delta_slots,
+            donate_argnums=(0,),
+            out_shardings=_pm.graph_sharding(mesh),
+        )
+        _APPLY_DELTA_SHARDED[key] = fn
+    return fn
 
 
 #: One fixed scatter/seed bucket for the common case: every delta pads
@@ -258,7 +302,11 @@ def _lower_delta(mirror: _EllMirror, delta: TopologyDelta, n_vertices: int):
     # (a freed-then-reused slot must not scatter twice).
     w = max((mirror.n_atoms + 31) // 32, 1)
     pad = _pad_pow2(len(touched))
-    rows = np.full(pad, n_vertices, np.int32)  # OOB sentinel: dropped
+    # Pad-op sentinel: row n_vertices is OOB (dropped) on an unpadded
+    # resident; on a node-sharded resident (rows padded past N) it is
+    # in-bounds but writes src=0/cost=0/valid=False/words=0 — exactly
+    # the padded row's existing state, so the scatter stays a no-op.
+    rows = np.full(pad, n_vertices, np.int32)
     cols = np.zeros(pad, np.int32)
     src = np.zeros(pad, np.int32)
     cost = np.zeros(pad, np.int32)
@@ -332,8 +380,14 @@ class DeviceGraphCache:
         ``need_edge_ids``: the caller gathers through ``in_edge_id``
         (edge-mask consumers: what-if batches, FRR planes) — entries
         whose edge ids went stale under a structural delta are rebuilt.
+
+        Shard-aware (ISSUE 8): under an installed process mesh the
+        planes are placed row-sharded over the mesh's node axis
+        (batch-replicated) per the parallel/mesh.py layout contract,
+        and the mesh identity joins the cache key.
         """
-        key = (*topo.cache_key, int(n_atoms))
+        mesh, mkey = _process_mesh_state()
+        key = (*topo.cache_key, int(n_atoms), mkey)
         with self._lock:
             e = self._cache.get(key)
             if e is not None:
@@ -358,8 +412,21 @@ class DeviceGraphCache:
         from holo_tpu.ops.graph import build_ell
 
         ell = build_ell(topo, n_atoms=n_atoms)
-        g = jax.device_put(device_graph_from_ell(ell))
-        entry = _CacheEntry(graph=g, mirror=_EllMirror(ell))
+        g = device_graph_from_ell(ell)
+        if mesh is not None:
+            from holo_tpu.parallel.mesh import shard_graph
+
+            g = shard_graph(g, mesh)
+        else:
+            g = jax.device_put(g)
+        # A 1-device mesh places exactly like no mesh (shard_graph's
+        # degenerate path): record it as unsharded so apply_delta and
+        # the stats leaf describe the real placement.
+        entry = _CacheEntry(
+            graph=g,
+            mirror=_EllMirror(ell),
+            mesh=mesh if (mesh is not None and mesh.size > 1) else None,
+        )
         with self._lock:
             self._cache[key] = entry
             self._evict_locked()
@@ -372,7 +439,8 @@ class DeviceGraphCache:
         if delta is None:
             return None
         kind = delta.kind
-        base_key = (*delta.base_key, int(n_atoms))
+        _mesh, mkey = _process_mesh_state()
+        base_key = (*delta.base_key, int(n_atoms), mkey)
         with self._lock:
             base = self._cache.get(base_key)
             if base is None:
@@ -398,15 +466,16 @@ class DeviceGraphCache:
             # dropped and the caller re-marshals from scratch.
             _DELTA_TOTAL.labels(kind=kind, path=f"full-{exc.reason}").inc()
             return None
-        g = _APPLY_DELTA(base.graph, *ops)
+        g = _apply_delta_for(base.mesh)(base.graph, *ops)
         entry = _CacheEntry(
             graph=g,
             mirror=base.mirror,
             depth=base.depth + 1,
             ids_stale=base.ids_stale or not delta.ids_stable,
+            mesh=base.mesh,
         )
         with self._lock:
-            self._cache[(*topo.cache_key, int(n_atoms))] = entry
+            self._cache[(*topo.cache_key, int(n_atoms), mkey)] = entry
             self._evict_locked()
             self._deltas_applied += 1
         _DELTA_TOTAL.labels(kind=kind, path="apply").inc()
@@ -421,13 +490,51 @@ class DeviceGraphCache:
     def stats(self) -> dict:
         """Eviction/occupancy summary for the holo-telemetry gNMI leaf
         (rides next to the holo_spf_marshal_cache_total hit/miss
-        counters)."""
+        counters).  Under an installed process mesh the summary also
+        carries per-device placement: how many resident entries touch
+        each device and the rows/bytes of graph plane actually held
+        there (sharded entries hold a row block per node-axis device
+        and a full replica per batch-axis row) — metadata reads only,
+        no device->host transfer."""
         with self._lock:
             entries = list(self._cache.values())
             evictions = self._evictions
             applied = self._deltas_applied
         depths = [e.depth for e in entries]
         occ = [e.mirror.occupancy for e in entries]
+        from holo_tpu.parallel import mesh as _pm
+
+        mesh = _pm.process_mesh()
+        per_dev: dict[str, dict] = {}
+        sharded = 0
+        for e in entries:
+            if e.mesh is not None:
+                sharded += 1
+            try:
+                devs: dict[str, dict] = {}
+                for plane in e.graph:
+                    shards = getattr(plane, "addressable_shards", None)
+                    if not shards:
+                        continue
+                    for sh in shards:
+                        d = devs.setdefault(
+                            str(getattr(sh.device, "id", sh.device)),
+                            {"bytes": 0, "rows": 0},
+                        )
+                        d["bytes"] += int(sh.data.nbytes)
+                        if plane is e.graph.in_src:
+                            d["rows"] += int(sh.data.shape[0])
+            except Exception:  # noqa: BLE001 — placement introspection
+                # is platform-best-effort; the leaf must never fail a
+                # scrape over an exotic array type.
+                continue
+            for dev, d in devs.items():
+                agg = per_dev.setdefault(
+                    dev, {"entries": 0, "bytes": 0, "rows": 0}
+                )
+                agg["entries"] += 1
+                agg["bytes"] += d["bytes"]
+                agg["rows"] += d["rows"]
         return {
             "entries": len(entries),
             "capacity": self.capacity,
@@ -437,6 +544,13 @@ class DeviceGraphCache:
             "max-chain-depth": max(depths, default=0),
             "stale-id-entries": sum(1 for e in entries if e.ids_stale),
             "occupancy": round(sum(occ) / len(occ), 4) if occ else 0.0,
+            "sharded-entries": sharded,
+            "mesh": (
+                {"batch": mesh.shape["batch"], "node": mesh.shape["node"]}
+                if mesh is not None
+                else None
+            ),
+            "per-device": per_dev,
         }
 
     def __len__(self) -> int:

@@ -14,12 +14,33 @@ axes are LSDB size and the number of concurrent SPF problems.  Those map to a
 Shardings are expressed with `jax.sharding.NamedSharding` annotations and the
 program stays a single jitted computation — XLA/GSPMD inserts the collectives
 (all-gathers on the node axis) automatically.
+
+Since ISSUE 8 this is the REAL dispatch path, not a dryrun: the daemon
+installs a process-wide mesh at boot (``[parallel]`` in holod.toml) and
+``TpuSpfBackend`` / ``FrrEngine`` / the shared ``DeviceGraphCache`` all
+consult :func:`process_mesh` per dispatch (see mesh.py).
 """
 
 from holo_tpu.parallel.mesh import (
+    configure_process_mesh,
     make_spf_mesh,
+    mesh_cache_key,
+    process_mesh,
+    reset_process_mesh,
     shard_graph,
+    shard_roots,
+    shard_scenarios,
     sharded_whatif_step,
 )
 
-__all__ = ["make_spf_mesh", "shard_graph", "sharded_whatif_step"]
+__all__ = [
+    "configure_process_mesh",
+    "make_spf_mesh",
+    "mesh_cache_key",
+    "process_mesh",
+    "reset_process_mesh",
+    "shard_graph",
+    "shard_roots",
+    "shard_scenarios",
+    "sharded_whatif_step",
+]

@@ -69,6 +69,25 @@ _BATCH_SCENARIOS = telemetry.counter(
     "Scenario-SPFs computed (batch rows count individually)",
     ("kind",),
 )
+_SHARD_DISPATCHES = telemetry.counter(
+    "holo_spf_shard_dispatch_total",
+    "Dispatches routed through the process-mesh sharded path "
+    "(parallel/mesh.py layout contract)",
+    ("kind",),
+)
+
+
+def _mesh():
+    """The process dispatch mesh (parallel/mesh.py), or None."""
+    from holo_tpu.parallel.mesh import process_mesh
+
+    return process_mesh()
+
+
+def _mesh_key():
+    from holo_tpu.parallel.mesh import mesh_cache_key
+
+    return mesh_cache_key()
 
 
 @dataclass
@@ -79,6 +98,23 @@ class SpfResult:
     parent: np.ndarray  # int32[N]
     hops: np.ndarray  # int32[N]
     nexthop_words: np.ndarray  # uint32[N, W]
+
+
+def _host_tensors(out, n: int):
+    """Materialize device SPF tensors into the host contract: vertex
+    axis sliced back to N and the sentinels renormalized.
+
+    Node-sharded residents pad rows to a multiple of the mesh's node
+    axis, so the device program's "no parent" sentinel is the PADDED
+    row count R (and unreachable hops R+1) — map them back to N / N+1
+    so sharded output is byte-identical to the single-device path.  On
+    an unpadded graph every step is a no-op (slice of full extent;
+    minimum against a value no tensor reaches)."""
+    dist = np.asarray(out.dist)[..., :n]
+    parent = np.minimum(np.asarray(out.parent)[..., :n], np.int32(n))
+    hops = np.minimum(np.asarray(out.hops)[..., :n], np.int32(n + 1))
+    nh = np.asarray(out.nexthops)[..., :n, :]
+    return dist, parent, hops, nh
 
 
 @dataclass
@@ -247,6 +283,42 @@ class TpuSpfBackend(SpfBackend):
             ),
             donate_argnums=(2,),
         )
+        # Mesh-sharded dispatch programs, built lazily per (kind, mesh
+        # identity): outputs pinned to the batch sharding so GSPMD
+        # propagates the scenario/root split through the whole program.
+        self._shard_jits: dict[tuple, object] = {}
+
+    def _sharded_whatif(self, mesh):
+        if mesh.size == 1:
+            # Degenerate mesh: the plain program IS the sharded program
+            # (mesh.constrain_batch would be a no-op) — reuse its jit
+            # cache so the 1-device mesh costs nothing but the routing.
+            return self._jit_batch
+        from holo_tpu.parallel.mesh import mesh_cache_key, sharded_whatif_jit
+
+        key = ("whatif", mesh_cache_key(mesh))
+        fn = self._shard_jits.get(key)
+        if fn is None:
+            fn = sharded_whatif_jit(mesh, self.max_iters, self.one_engine)
+            self._shard_jits[key] = fn
+        return fn
+
+    def _sharded_multiroot(self, mesh):
+        if mesh.size == 1:  # see _sharded_whatif
+            return self._jit_multiroot
+        from holo_tpu.parallel.mesh import constrain_batch, mesh_cache_key
+
+        key = ("multiroot", mesh_cache_key(mesh))
+        fn = self._shard_jits.get(key)
+        if fn is None:
+
+            @jax.jit
+            def step(g, rs, m):
+                out = spf_multiroot(g, rs, m, self.max_iters)
+                return constrain_batch(mesh, out)
+
+            fn = self._shard_jits[key] = step
+        return fn
 
     def prepare(
         self,
@@ -280,7 +352,7 @@ class TpuSpfBackend(SpfBackend):
         already-stored set stays — the no-delta steady state then holds
         one buffer set instead of churning a fresh one per dispatch
         (the incremental_overhead <2% gate measures exactly this)."""
-        key = (*topo.cache_key, int(n_atoms), int(topo.root))
+        key = (*topo.cache_key, int(n_atoms), int(topo.root), _mesh_key())
         if key in self._prev_one:
             return
         self._prev_one[key] = out
@@ -289,7 +361,10 @@ class TpuSpfBackend(SpfBackend):
 
     def _track_compile(self, kind: str, *shape) -> bool:
         """Returns True when this (engine, shape) bucket is fresh — a
-        real XLA compile, and the moment to capture its cost analysis."""
+        real XLA compile, and the moment to capture its cost analysis.
+        Callers append the process-mesh identity to ``shape``: the same
+        shapes under a different sharding are a different XLA program,
+        and the cost-analysis table keys on the same signature."""
         sig = (kind, self.one_engine, *shape)
         if sig in self._compiled_shapes:
             _JIT_HITS.labels(kind=kind).inc()
@@ -349,6 +424,12 @@ class TpuSpfBackend(SpfBackend):
 
     def _device_compute(self, topo, edge_mask=None):
         faults.crashpoint("spf.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            # The shard-dispatch chaos seam: a device lost from the
+            # mesh / an XLA failure on any shard surfaces here and the
+            # breaker serves the WHOLE batch from the scalar oracle.
+            faults.crashpoint("spf.shard")
         if self.engine == "blocked":
             res = self._whatif_blocked(
                 topo, self._full_mask(topo, edge_mask)[None, :]
@@ -375,7 +456,7 @@ class TpuSpfBackend(SpfBackend):
                     mask = self._full_mask(topo, edge_mask)
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        topo.n_edges,
+                        topo.n_edges, _mesh_key(),
                     )
                     fresh = self._track_compile("one", *sig)
                     out = self._jit_one(g, topo.root, mask)
@@ -386,20 +467,23 @@ class TpuSpfBackend(SpfBackend):
                 )
             with profiling.stage("spf.one", "device"):
                 with profiling.annotation("spf.one.device"):
-                    profiling.sync(out)
+                    if not profiling.device_stages("spf.one", out):
+                        profiling.sync(out)
             t1 = time.perf_counter()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
+                    dist, parent, hops, nh = _host_tensors(
+                        out, topo.n_vertices
+                    )
                     res = SpfResult(
-                        dist=np.asarray(out.dist),
-                        parent=np.asarray(out.parent),
-                        hops=np.asarray(out.hops),
-                        nexthop_words=np.asarray(out.nexthops),
+                        dist=dist, parent=parent, hops=hops, nexthop_words=nh
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="one").inc()
+        if mesh is not None:
+            _SHARD_DISPATCHES.labels(kind="one").inc()
         convergence.note_dispatch("spf", "device")
         if edge_mask is None and self.incremental:
             # Disarmed backends skip retention: they could never
@@ -419,7 +503,7 @@ class TpuSpfBackend(SpfBackend):
         if delta is None or not self.incremental:
             return None
         n_atoms = max(self.n_atoms, topo.n_atoms())
-        prev_key = (*delta.base_key, int(n_atoms), int(topo.root))
+        prev_key = (*delta.base_key, int(n_atoms), int(topo.root), _mesh_key())
         prev = self._prev_one.get(prev_key)
         if prev is None:
             note_delta(delta.kind, "full-no-prev")
@@ -458,10 +542,16 @@ class TpuSpfBackend(SpfBackend):
                     _GRAPH_CACHE.labels(result=how).inc()
                     seeds = delta.seed_rows()
                     pad = _pad_pow2(seeds.shape[0])
-                    seeds_p = np.full(pad, topo.n_vertices, np.int32)
+                    # Pad sentinel = the resident's PADDED row count
+                    # (node-sharded residents pad rows past N): truly
+                    # out of range for the aff-scatter's mode="drop".
+                    seeds_p = np.full(
+                        pad, int(g.in_src.shape[0]), np.int32
+                    )
                     seeds_p[: seeds.shape[0]] = seeds
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2], pad,
+                        _mesh_key(),
                     )
                     fresh = self._track_compile("delta", *sig)
                     # The previous tensors are DONATED into the kernel:
@@ -476,20 +566,23 @@ class TpuSpfBackend(SpfBackend):
                 )
             with profiling.stage("spf.one", "device"):
                 with profiling.annotation("spf.one.delta.device"):
-                    profiling.sync(out)
+                    if not profiling.device_stages("spf.one", out):
+                        profiling.sync(out)
             t1 = time.perf_counter()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
+                    dist, parent, hops, nh = _host_tensors(
+                        out, topo.n_vertices
+                    )
                     res = SpfResult(
-                        dist=np.asarray(out.dist),
-                        parent=np.asarray(out.parent),
-                        hops=np.asarray(out.hops),
-                        nexthop_words=np.asarray(out.nexthops),
+                        dist=dist, parent=parent, hops=hops, nexthop_words=nh
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="one").inc()
+        if _mesh() is not None:
+            _SHARD_DISPATCHES.labels(kind="one").inc()
         convergence.note_dispatch("spf", "device")
         note_delta(delta.kind, "incremental")
         self._remember(topo, n_atoms, out)
@@ -572,14 +665,20 @@ class TpuSpfBackend(SpfBackend):
 
     def _device_whatif(self, topo, edge_masks):
         faults.crashpoint("spf.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            faults.crashpoint("spf.shard")
         if self.engine == "blocked":
+            # The blocked-Pallas experiment marshals its own planes and
+            # stays single-device; the mesh path rides the gather
+            # engines (the headline since r02).
             res = self._whatif_blocked(topo, edge_masks)
             if res is not None:
                 return res
+        B = len(edge_masks)
         t0 = time.perf_counter()
         with telemetry.span(
-            "spf.dispatch", kind="whatif", backend="tpu",
-            batch=len(edge_masks),
+            "spf.dispatch", kind="whatif", backend="tpu", batch=B,
         ):
             with profiling.stage("spf.whatif", "marshal"):
                 with sanctioned_transfer("spf.whatif.marshal"):
@@ -588,39 +687,56 @@ class TpuSpfBackend(SpfBackend):
                     # rebuilt (need_edge_ids).
                     g = self.prepare(topo, need_edge_ids=True)
                     masks = np.asarray(edge_masks, bool)
+                    if mesh is not None:
+                        # THE sharded scenario axis: masks placed
+                        # batch-sharded (padded to the axis size with
+                        # no-failure rows), outputs pinned to the batch
+                        # sharding — GSPMD fans the B scenarios out
+                        # over the mesh's batch devices while the
+                        # cache-resident graph planes ride row-sharded
+                        # over node (the mesh.py layout contract).
+                        from holo_tpu.parallel.mesh import shard_scenarios
+
+                        masks_dev = shard_scenarios(mesh, masks)
+                        step = self._sharded_whatif(mesh)
+                    else:
+                        masks_dev = masks
+                        step = self._jit_batch
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        masks.shape,
+                        masks_dev.shape, _mesh_key(),
                     )
                     fresh = self._track_compile("whatif", *sig)
-                    out = self._jit_batch(g, topo.root, masks)
+                    out = step(g, topo.root, masks_dev)
             if fresh:
                 profiling.record_cost(
-                    "spf.whatif", self._jit_batch, g, topo.root, masks,
+                    "spf.whatif", step, g, topo.root, masks_dev,
                     shape_sig=sig,
                 )
             with profiling.stage("spf.whatif", "device"):
                 with profiling.annotation("spf.whatif.device"):
-                    profiling.sync(out)
+                    if not profiling.device_stages("spf.whatif", out):
+                        profiling.sync(out)
             t1 = time.perf_counter()
             # One bulk device→host transfer per plane: per-scenario slicing
             # of device arrays would pay the host round-trip B×4 times.
             with profiling.stage("spf.whatif", "readback"):
                 with sanctioned_transfer("spf.whatif.unmarshal"):
-                    dist, parent, hops, nh = (
-                        np.asarray(out.dist),
-                        np.asarray(out.parent),
-                        np.asarray(out.hops),
-                        np.asarray(out.nexthops),
+                    dist, parent, hops, nh = _host_tensors(
+                        out, topo.n_vertices
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
-        _BATCH_SCENARIOS.labels(kind="whatif").inc(masks.shape[0])
+        _BATCH_SCENARIOS.labels(kind="whatif").inc(B)
+        if mesh is not None:
+            _SHARD_DISPATCHES.labels(kind="whatif").inc()
         convergence.note_dispatch("spf", "device")
+        # Slice off the batch-pad rows (sharded dispatch pads B up to a
+        # multiple of the mesh batch axis) — [:B] is a no-op otherwise.
         return [
             SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
-            for i in range(masks.shape[0])
+            for i in range(B)
         ]
 
     def _device_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
@@ -632,40 +748,59 @@ class TpuSpfBackend(SpfBackend):
         need the SPT shape only.
         """
         faults.crashpoint("spf.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            faults.crashpoint("spf.shard")
+        R = len(roots)
         t0 = time.perf_counter()
         with telemetry.span(
-            "spf.dispatch", kind="multiroot", backend="tpu", roots=len(roots)
+            "spf.dispatch", kind="multiroot", backend="tpu", roots=R
         ):
             with profiling.stage("spf.multiroot", "marshal"):
                 with sanctioned_transfer("spf.multiroot.marshal"):
                     g = self.prepare(topo)
                     roots_i32 = np.asarray(roots, np.int32)
+                    if mesh is not None:
+                        # The all-roots plane rides the same batch
+                        # axis: roots sharded over it (padded with
+                        # root 0; pad rows sliced off below).
+                        from holo_tpu.parallel.mesh import shard_roots
+
+                        roots_dev = shard_roots(mesh, roots_i32)
+                        step = self._sharded_multiroot(mesh)
+                    else:
+                        roots_dev = roots_i32
+                        step = self._jit_multiroot
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        roots_i32.shape[0], topo.n_edges,
+                        roots_dev.shape[0], topo.n_edges, _mesh_key(),
                     )
                     fresh = self._track_compile("multiroot", *sig)
                     mask = np.ones(topo.n_edges, bool)
-                    out = self._jit_multiroot(g, roots_i32, mask)
+                    out = step(g, roots_dev, mask)
             if fresh:
                 profiling.record_cost(
-                    "spf.multiroot", self._jit_multiroot, g, roots_i32, mask,
+                    "spf.multiroot", step, g, roots_dev, mask,
                     shape_sig=sig,
                 )
             with profiling.stage("spf.multiroot", "device"):
                 with profiling.annotation("spf.multiroot.device"):
-                    profiling.sync(out)
+                    if not profiling.device_stages("spf.multiroot", out):
+                        profiling.sync(out)
             t1 = time.perf_counter()
             with profiling.stage("spf.multiroot", "readback"):
                 with sanctioned_transfer("spf.multiroot.unmarshal"):
+                    dist, parent, hops, _nh = _host_tensors(
+                        out, topo.n_vertices
+                    )
                     res = MultiRootResult(
-                        dist=np.asarray(out.dist),
-                        parent=np.asarray(out.parent),
-                        hops=np.asarray(out.hops),
+                        dist=dist[:R], parent=parent[:R], hops=hops[:R]
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)
-        _BATCH_SCENARIOS.labels(kind="multiroot").inc(roots_i32.shape[0])
+        _BATCH_SCENARIOS.labels(kind="multiroot").inc(R)
+        if mesh is not None:
+            _SHARD_DISPATCHES.labels(kind="multiroot").inc()
         convergence.note_dispatch("spf", "device")
         return res

@@ -13,9 +13,13 @@ phases that actually matter for the DeltaPath incremental-SPF work —
 - **readback** — device→host materialization of the result planes.
 
 Each phase records a nested trace sub-span AND a
-``holo_profile_stage_seconds{site,stage}`` histogram observation
+``holo_profile_stage_seconds{site,stage,device}`` histogram observation
 carrying an OpenMetrics **exemplar** ``{span_id=...}`` — a scrape can
 jump from a latency bucket straight to the trace span that produced it.
+``device="-"`` is the whole-dispatch span; under a process mesh the
+device phase additionally splits into per-device completion sub-spans
+(``device=<id>``, :func:`device_stages`) so a straggling shard is
+attributable to its chip.
 
 Compile-time cost attribution rides the same switch: when a backend
 sees a fresh (engine, shape) bucket it calls :func:`record_cost`, which
@@ -46,8 +50,10 @@ log = logging.getLogger("holo_tpu.telemetry")
 
 _STAGE_SECONDS = telemetry.histogram(
     "holo_profile_stage_seconds",
-    "Per-dispatch sub-span time (marshal / device / readback)",
-    ("site", "stage"),
+    "Per-dispatch sub-span time (marshal / device / readback); "
+    "device=<id> rows are the per-device completion split of a "
+    "mesh-sharded dispatch ('-' = host-side / whole-dispatch span)",
+    ("site", "stage", "device"),
 )
 _COST_FLOPS = telemetry.gauge(
     "holo_profile_cost_flops",
@@ -81,21 +87,66 @@ def device_profiling() -> bool:
 
 
 @contextmanager
-def stage(site: str, name: str):
+def stage(site: str, name: str, device: str = "-"):
     """One dispatch phase: a nested trace sub-span plus a
     ``holo_profile_stage_seconds`` observation whose exemplar links the
     bucket to the sub-span id.  ``site`` is the dispatch site
     (``spf.one``, ``spf.whatif``, ``frr.batch``, ...), ``name`` the
-    phase (``marshal`` / ``device`` / ``readback``)."""
+    phase (``marshal`` / ``device`` / ``readback``); ``device`` is the
+    per-device split label of a sharded dispatch ('-' = whole span,
+    see :func:`device_stages`)."""
     if not _enabled:
         yield None
         return
     t0 = time.perf_counter()
-    with telemetry.span(f"{site}.{name}", stage=name) as sid:
+    with telemetry.span(f"{site}.{name}", stage=name, device=device) as sid:
         yield sid
-    _STAGE_SECONDS.labels(site=site, stage=name).observe(
+    _STAGE_SECONDS.labels(site=site, stage=name, device=device).observe(
         time.perf_counter() - t0, exemplar={"span_id": sid}
     )
+
+
+def device_stages(site: str, tree) -> bool:
+    """Per-device completion split of a mesh-sharded dispatch: block on
+    each device's result shards in device-id order, recording one
+    ``stage(site, "device", device=<id>)`` sub-span each.
+
+    Spans are sequential from the host's vantage point: the first
+    device's span absorbs most of the wait and later spans measure the
+    RESIDUAL skew after earlier devices completed — exactly the
+    straggler signal worth watching on a real mesh (a healthy sharded
+    dispatch shows one fat span and near-zero residuals; a slow chip
+    shows up as a fat residual at its id).  Returns False — recording
+    nothing — when profiling is disarmed or the result lives on fewer
+    than two devices; callers then fall back to the plain :func:`sync`
+    barrier, so single-device dispatch behavior is unchanged."""
+    if not _enabled:
+        return False
+    import jax
+
+    by_dev: dict = {}
+    try:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards:
+                continue
+            for sh in shards:
+                by_dev.setdefault(sh.device, []).append(sh.data)
+    except Exception:  # noqa: BLE001 — introspection is best-effort;
+        # the caller's sync barrier still bounds the device phase.
+        log.debug("shard enumeration failed under profiling", exc_info=True)
+        return False
+    if len(by_dev) < 2:
+        return False
+    for dev in sorted(by_dev, key=lambda d: getattr(d, "id", 0)):
+        with stage(site, "device", device=str(getattr(dev, "id", dev))):
+            try:
+                jax.block_until_ready(by_dev[dev])
+            except Exception:  # noqa: BLE001 — same contract as sync()
+                log.debug(
+                    "block_until_ready failed under profiling", exc_info=True
+                )
+    return True
 
 
 def sync(tree) -> None:

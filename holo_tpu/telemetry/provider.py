@@ -24,6 +24,8 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
       spf-graph-cache/           # shared marshaled-graph cache (ISSUE 7):
         entries, capacity,       #   eviction/occupancy + DeltaPath chain
         evictions, deltas-...    #   state, next to the hit/miss counters
+        sharded-entries, mesh,   #   + mesh placement (ISSUE 8): resident
+        per-device/...           #   entries/rows/bytes per device
 """
 
 from __future__ import annotations

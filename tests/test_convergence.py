@@ -379,10 +379,9 @@ def test_list_rules_shows_severity():
 
     assert all(r.severity in ("error", "warn") for r in all_rules())
     # Every established rule stays on gate duty; the warn tier carries
-    # exactly the rules currently soaking toward error tier (ISSUE 7:
-    # HL107, the lax host-closure rule).  Promote, don't accumulate.
+    # exactly the rules currently soaking toward error tier.  HL107
+    # soaked through PR 7 and was promoted to error in ISSUE 8, so the
+    # soak set is empty again.  Promote, don't accumulate.
     soaking = {r.id for r in all_rules() if r.severity == "warn"}
-    assert soaking == {"HL107"}
-    assert all(
-        r.severity == "error" for r in all_rules() if r.id != "HL107"
-    )
+    assert soaking == set()
+    assert all(r.severity == "error" for r in all_rules())

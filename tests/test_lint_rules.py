@@ -145,6 +145,25 @@ def test_hl102_none_checks_are_static():
     assert "HL102" not in rules_fired(src, OPS)
 
 
+def test_hl102_profiling_barrier_returns_host_bool():
+    """profiling.device_stages is a block_until_ready completion
+    barrier returning host metadata: branching on it is host-decidable
+    (ISSUE 8 — the per-device device-phase split), taint stops there
+    exactly like float()/item()."""
+    src = """
+        import jax.numpy as jnp
+
+        from holo_tpu.telemetry import profiling
+
+        def step(x):
+            out = jnp.cumsum(x)
+            if not profiling.device_stages("spf.whatif", out):
+                profiling.sync(out)
+            return out
+    """
+    assert "HL102" not in rules_fired(src, OPS)
+
+
 # -- HL103: jit recompile hazards ---------------------------------------
 
 HL103_BAD = """
@@ -777,10 +796,12 @@ def test_hl107_bare_import_form():
     assert "HL107" in rules_fired(src, OPS)
 
 
-def test_hl107_is_warn_tier():
+def test_hl107_is_error_tier():
+    """Promoted from warn (PR 7 soak) to error tier: HL107 findings now
+    gate tier-1 like every other shipped rule."""
     res = lint(HL107_BAD, OPS)
     tiers = {f.rule: f.severity for f in res.findings}
-    assert tiers.get("HL107") == "warn"
+    assert tiers.get("HL107") == "error"
 
 
 def test_hl107_out_of_scope_module_is_ignored():

@@ -547,6 +547,67 @@ def test_delta_padding_overflow_full_rebuild_identical():
         )
 
 
+def test_shard_dispatch_failure_mid_storm_falls_back_bit_identical():
+    """ISSUE 8 chaos satellite: with the process mesh installed (the
+    real multi-chip dispatch path), forced shard-dispatch failures
+    mid-storm open the breaker — every event from then on is served by
+    the scalar oracle, tagged phase="fallback" on its convergence
+    timeline, and the final FIB is bit-identical to an all-scalar
+    control run of the same seeded events."""
+    from holo_tpu.parallel.mesh import (
+        configure_process_mesh,
+        reset_process_mesh,
+    )
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import StormNet
+    from holo_tpu.telemetry import convergence
+
+    def run(backend, with_tracker=False):
+        net = StormNet(n_routers=60, seed=31, spf_backend=backend)
+        tracker = (
+            convergence.configure(1024, clock=net.loop.clock.now)
+            if with_tracker
+            else None
+        )
+        for i in range(8):
+            net.flap(net.flappable[i], lost=False)
+            net.loop.advance(12.0)
+        net.loop.advance(60.0)
+        if tracker is not None:
+            tracker.sweep()
+        return dict(net.kernel.fib), tracker
+
+    configure_process_mesh(4, 2)
+    try:
+        breaker = CircuitBreaker(
+            "spf-shard-storm",
+            failure_threshold=2,
+            recovery_timeout=1e9,  # stays open through the storm tail
+        )
+        plan = FaultPlan(seed=31, dispatch_fail={"spf.shard": 2})
+        with inject(FaultInjector(plan)) as inj:
+            chaos_fib, tracker = run(
+                TpuSpfBackend(64, breaker=breaker), with_tracker=True
+            )
+        assert inj.injected["spf.shard"] == 2
+        assert breaker.state == "open"
+        fallbacks = [
+            r
+            for r in tracker.timelines()
+            if r["outcome"] == "converged" and r["fallback"]
+        ]
+        assert fallbacks, "shard failures must tag convergence events"
+        assert all(
+            any(step == "fallback" for step, _t, _a in r["timeline"])
+            for r in fallbacks
+        )
+    finally:
+        convergence.configure(0)
+        reset_process_mesh()
+    control_fib, _ = run(None)  # scalar oracle end to end
+    assert chaos_fib == control_fib
+
+
 def test_ospf_reconverges_through_packet_loss():
     """Convergence-under-failure, the metric that matters: with a lossy
     wire AND a link failure mid-run, retransmission machinery still

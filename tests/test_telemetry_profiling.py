@@ -57,7 +57,8 @@ def test_dispatch_splits_into_nested_subspans(profiled):
         assert sub.parent_id == dispatch.span_id, stage_name
         assert sub.attrs["stage"] == stage_name
         key = (
-            f"holo_profile_stage_seconds{{site=spf.one,stage={stage_name}}}"
+            f"holo_profile_stage_seconds"
+            f"{{site=spf.one,stage={stage_name},device=-}}"
         )
         assert _stage_counts()[key] == before_counts.get(key, 0) + 1
 
@@ -189,9 +190,9 @@ def test_profiled_dispatch_exemplars_link_to_subspans(profiled):
     backend = TpuSpfBackend()
     backend.compute(grid_topology(4, 4, seed=4))
     fam = telemetry.histogram(
-        "holo_profile_stage_seconds", labelnames=("site", "stage")
+        "holo_profile_stage_seconds", labelnames=("site", "stage", "device")
     )
-    child = fam.labels(site="spf.one", stage="marshal")
+    child = fam.labels(site="spf.one", stage="marshal", device="-")
     exemplars = child.exemplars()
     assert exemplars, "profiled dispatch must attach an exemplar"
     span_ids = {
