@@ -68,6 +68,8 @@ STAGE_TIMEOUT = {
     "incremental_overhead": 900,
     "shard_spf": 1200,
     "sharding_overhead": 900,
+    "pipeline_spf": 1800,
+    "pipeline_overhead": 900,
 }
 
 
@@ -1150,6 +1152,311 @@ def stage_sharding_overhead(k, B, reps=24, inner=2):
     }
 
 
+def stage_pipeline_spf(n_routers, events):
+    """ISSUE 9 acceptance row: the async dispatch pipeline + engine
+    auto-tuner against the synchronous path.
+
+    Three parts: (1) the seeded convergence storm run on three arms —
+    async-pipelined, synchronous device, all-scalar — gated on the
+    async arm beating sync on the per-trigger lsa dispatch-wall p50
+    (the time the protocol actor spends blocked INSIDE the dispatch
+    call), byte-identical FIBs across all three arms, and a
+    byte-identical causal digest between the two device arms (the
+    scalar arm's digest legitimately differs: its dispatch entries
+    record mode=scalar); the actor-side wait the lazy result still
+    pays is reported honestly as blocked-wall next to it.  (2) a
+    consecutive-dispatch overlap microbench: four independent LSDBs'
+    SPF+FRR dispatches submitted back-to-back through the depth-2
+    pipeline vs computed serially — the marshal/device overlap the
+    double buffer exists for, with the measured overlap ratio.
+    (3) tuner rows: the per-shape engine sweep with measured winners
+    per (V, E, batch) bucket vs every pinned engine, compile-time
+    cost_analysis deltas riding along, gated on a COLD tuner (fresh
+    process state, table loaded from disk) reproducing the learned
+    winners in pure exploit mode."""
+    import tempfile
+    from pathlib import Path
+
+    from holo_tpu import pipeline, telemetry
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth import (
+        random_ospf_topology,
+        whatif_link_failure_masks,
+    )
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+    from holo_tpu.telemetry import profiling
+
+    t_start = time.perf_counter()
+
+    # -- (1) storm arms -------------------------------------------------
+    def storm_arm(backend, asynchronous=False):
+        report, digest, net = run_convergence_storm(
+            n_routers=n_routers, events=events, seed=17,
+            spf_backend=backend,
+        )
+        if asynchronous:
+            pipeline.process_pipeline().drain(timeout=30)
+        fib = json.dumps(
+            sorted((str(k), str(v)) for k, v in net.kernel.fib.items())
+        )
+        import hashlib
+
+        return report, digest, hashlib.sha256(fib.encode()).hexdigest()
+
+    sync_rep, sync_dig, sync_fib = storm_arm(TpuSpfBackend(64))
+    _scalar_rep, _scalar_dig, scalar_fib = storm_arm(None)
+    pipe = pipeline.configure_process_pipeline(depth=2)
+    async_rep, async_dig, async_fib = storm_arm(
+        pipeline.wrap_spf_backend(TpuSpfBackend(64)), asynchronous=True
+    )
+    pipe_stats = pipe.stats()
+    wait_snap = telemetry.snapshot(prefix="holo_pipeline_wait")
+    pipeline.reset_process_pipeline()
+
+    def lsa_wall(rep):
+        return rep.get("dispatch-wall", {}).get("lsa", {})
+
+    sync_p50 = lsa_wall(sync_rep).get("p50", 0.0)
+    async_p50 = lsa_wall(async_rep).get("p50", float("inf"))
+    storm_row = {
+        "sync_lsa_dispatch_wall": lsa_wall(sync_rep),
+        "async_lsa_dispatch_wall": lsa_wall(async_rep),
+        "dispatch_wall_p50_speedup": round(sync_p50 / async_p50, 2)
+        if async_p50
+        else None,
+        # Honest companion numbers: the wait the lazy result still pays
+        # (holo_pipeline_wait_seconds) and the worker's overlap ratio.
+        "async_blocked_wait": wait_snap,
+        "pipeline": pipe_stats,
+        "fib_identical_async_sync_scalar": (
+            async_fib == sync_fib == scalar_fib
+        ),
+        "causal_digest_async_eq_sync": async_dig == sync_dig,
+    }
+
+    # -- (2) consecutive-dispatch overlap -------------------------------
+    from holo_tpu.frr.manager import FrrEngine
+
+    topos = [
+        random_ospf_topology(
+            n_routers=max(n_routers // 2, 60),
+            n_networks=max(n_routers // 10, 8),
+            extra_p2p=max(n_routers // 2, 40),
+            seed=100 + i,
+        )
+        for i in range(4)
+    ]
+    sync_be = TpuSpfBackend(64)
+    sync_frr = FrrEngine("tpu")
+    for t in topos:  # warm compiles + marshals for both arms
+        sync_be.compute(t)
+        sync_frr.compute(t)
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for t in topos:
+            sync_be.compute(t)
+            sync_frr.compute(t)
+    sync_wall = (time.perf_counter() - t0) / reps
+    pipe = pipeline.configure_process_pipeline(depth=2)
+    async_be = pipeline.wrap_spf_backend(sync_be)
+    async_frr = pipeline.wrap_frr_engine(sync_frr)
+    # Warm the pipelined path once (thread spin-up etc.).
+    [r.wait() for r in [async_be.compute(t) for t in topos]]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pending = []
+        for t in topos:
+            pending.append(async_be.compute(t))
+            pending.append(async_frr.compute(t))
+        for r in pending:
+            r.wait()
+    async_wall = (time.perf_counter() - t0) / reps
+    overlap_stats = pipe.stats()
+    pipeline.reset_process_pipeline()
+    consecutive_row = {
+        "sync_wall_ms": round(sync_wall * 1e3, 3),
+        "async_wall_ms": round(async_wall * 1e3, 3),
+        "speedup": round(sync_wall / async_wall, 3) if async_wall else None,
+        "overlap_ratio": overlap_stats["overlap-ratio"],
+        "dispatches_per_round": len(topos) * 2,
+    }
+
+    # -- (3) tuner rows -------------------------------------------------
+    tdir = Path(tempfile.mkdtemp(prefix="holo-tuner-bench-"))
+    table_path = tdir / "tuner.json"
+    sizes = [
+        ("small", random_ospf_topology(
+            n_routers=60, n_networks=10, extra_p2p=40, seed=41
+        ), 16),
+        ("mid", random_ospf_topology(
+            n_routers=max(n_routers, 300),
+            n_networks=max(n_routers // 10, 30),
+            extra_p2p=max(n_routers, 200),
+            seed=42,
+        ), 16),
+    ]
+    # Pinned-engine comparison rows FIRST, tuner disarmed (an armed
+    # tuner overrides every backend's engine pick by design).
+    tuner_rows = {}
+    for label, topo, batch in sizes:
+        masks = whatif_link_failure_masks(topo, batch, seed=1)
+        pinned = {}
+        for eng in pipeline.ENGINES:
+            pb = TpuSpfBackend(64, one_engine=eng)
+            pb.compute_whatif(topo, masks)  # warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                pb.compute_whatif(topo, masks)
+            pinned[eng] = round(
+                batch * 3 / (time.perf_counter() - t0), 2
+            )
+        tuner_rows[label] = {
+            "n_vertices": int(topo.n_vertices),
+            "batch": batch,
+            "pinned_runs_per_sec": pinned,
+            "measured_best_pinned": max(pinned, key=pinned.get),
+        }
+    # Now arm the tuner and let it learn both shapes (cost priors ride
+    # the armed profiler's cost_analysis capture).
+    profiling.set_device_profiling(True)
+    tuner = pipeline.configure_engine_tuner(
+        path=table_path, explore_rounds=2, reprobe_every=0
+    )
+    for label, topo, batch in sizes:
+        masks = whatif_link_failure_masks(topo, batch, seed=1)
+        be = TpuSpfBackend(64)
+        for _ in range(12):
+            be.compute_whatif(topo, masks)
+        bucket = pipeline.shape_bucket(
+            topo.n_vertices, topo.n_edges, batch, None
+        )
+        bkey = json.dumps(["whatif", *bucket])
+        tuner_rows[label]["winner"] = (
+            tuner.stats()["winners"].get(bkey, {}).get("winner")
+        )
+    tuner.save()
+    # COLD reproduction: a fresh tuner restores the table and picks the
+    # winner for each bucket in pure exploit mode (zero exploration).
+    cold = pipeline.EngineTuner(
+        path=table_path, explore_rounds=2, reprobe_every=0
+    )
+    cold_ok = True
+    winners_credible = True
+    for label, topo, batch in sizes:
+        bucket = pipeline.shape_bucket(
+            topo.n_vertices, topo.n_edges, batch, None
+        )
+        pick = cold.pick("whatif", bucket)
+        want = tuner_rows[label]["winner"]
+        tuner_rows[label]["cold_pick"] = pick
+        cold_ok = cold_ok and (want is not None and pick == want)
+        # Credibility: the learned winner must be the measured pinned
+        # best, or within 20% of it (the top engines at some shapes
+        # measure within noise of each other — seq vs hybrid on small
+        # jaxcpu graphs — and either pick is correct there).
+        pinned = tuner_rows[label]["pinned_runs_per_sec"]
+        best = max(pinned.values())
+        winners_credible = winners_credible and (
+            want in pinned and pinned[want] >= 0.8 * best
+        )
+    cost = {
+        f"{site}{list(sig)[:3]}+{list(sig)[4:]}": entry
+        for (site, sig), entry in sorted(
+            profiling.cost_table().items(), key=lambda kv: kv[0][0]
+        )
+        if site == "spf.whatif" and len(sig) >= 5
+    }
+    profiling.set_device_profiling(False)
+    pipeline.reset_engine_tuner()
+
+    ok = bool(
+        storm_row["fib_identical_async_sync_scalar"]
+        and storm_row["causal_digest_async_eq_sync"]
+        and async_p50 < sync_p50
+        and cold_ok
+        and winners_credible
+    )
+    return {
+        "ok": ok,
+        "storm": storm_row,
+        "consecutive": consecutive_row,
+        "tuner": tuner_rows,
+        "tuner_cold_reproduces_winners": cold_ok,
+        "tuner_winners_credible": winners_credible,
+        "cost_analysis": cost,
+        "n_routers": n_routers,
+        "events": events,
+        "wall_s": round(time.perf_counter() - t_start, 1),
+        "telemetry": telemetry.snapshot(prefix="holo_pipeline"),
+    }
+
+
+def stage_pipeline_overhead(k, B, reps=24, inner=4):
+    """ISSUE 9 overhead gate: the pipeline machinery must cost <2% in
+    the depth-1/disabled configuration.  Two paired-median rows on the
+    same warm backend (incremental_overhead discipline): (a) DISABLED —
+    the wrap_spf_backend facade with no process pipeline armed (pure
+    delegation, what every daemon — default config — pays for the
+    feature existing): THE <2% gate.  (b) DEPTH-1 — dispatches routed
+    through the worker with the caller forcing immediately (submit +
+    two thread handoffs + force, nothing overlapping): reported
+    honestly against the same bare baseline as the floor price of
+    unblocking the actor — a fixed ~0.1-0.2ms per dispatch that is
+    sub-2% at production dispatch sizes (10k-vertex ~15ms) but not at
+    this stage's small-k sizing, so it informs rather than gates."""
+    from holo_tpu import pipeline
+    from holo_tpu.spf.backend import TpuSpfBackend
+
+    topo, _masks = _make(k, B)
+    bare = TpuSpfBackend()
+    for _ in range(16):
+        bare.compute(topo)  # warm: compile + graph cache + allocator
+    facade = pipeline.wrap_spf_backend(bare)  # no pipeline: identity
+    assert facade is bare
+    pipe = pipeline.configure_process_pipeline(depth=1)
+    wrapped = pipeline.wrap_spf_backend(bare)
+    wrapped.compute(topo).wait()  # spin the worker up
+
+    def sample(fn):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            fn()
+        return (time.perf_counter() - t0) / inner
+
+    bare_times, disabled_times, depth1_times = [], [], []
+    disabled = pipeline.AsyncSpfBackend(bare, None)  # facade, no pipe
+    arms = (
+        (lambda: bare.compute(topo), bare_times),
+        (lambda: disabled.compute(topo), disabled_times),
+        (lambda: wrapped.compute(topo).wait(), depth1_times),
+    )
+    for rep in range(reps):
+        order = arms if rep % 2 == 0 else arms[::-1]
+        for fn, times in order:
+            times.append(sample(fn))
+    pipeline.reset_process_pipeline()
+    bare_ms = float(np.median(bare_times) * 1e3)
+    dis_delta = float(
+        np.median([a - b for a, b in zip(disabled_times, bare_times)]) * 1e3
+    )
+    d1_delta = float(
+        np.median([a - b for a, b in zip(depth1_times, bare_times)]) * 1e3
+    )
+    dis_pct = dis_delta / bare_ms * 100.0 if bare_ms else 0.0
+    d1_pct = d1_delta / bare_ms * 100.0 if bare_ms else 0.0
+    return {
+        "ok": bool(dis_pct < 2.0),
+        "bare_ms": round(bare_ms, 4),
+        "disabled_paired_delta_ms": round(dis_delta, 5),
+        "disabled_overhead_pct": round(dis_pct, 3),
+        "depth1_paired_delta_ms": round(d1_delta, 5),
+        "depth1_overhead_pct": round(d1_pct, 3),
+        "reps": reps,
+        "inner": inner,
+    }
+
+
 def _run_stage(name, small, cpu=False, engine=None):
     cmd = [sys.executable, __file__, "--stage", name]
     if small:
@@ -1252,6 +1559,14 @@ def main() -> None:
             "sharding_overhead": lambda: stage_sharding_overhead(
                 20 if small else 40, 16 if small else 32
             ),
+            "pipeline_spf": lambda: (
+                stage_pipeline_spf(400, 120)
+                if small
+                else stage_pipeline_spf(2500, 400)
+            ),
+            "pipeline_overhead": lambda: stage_pipeline_overhead(
+                40 if small else 90, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -1332,6 +1647,16 @@ def main() -> None:
         # fidelity (the stage never touches the relay by design).
         extra["shard_spf"] = _run_stage("shard_spf", True)
         extra["sharding_overhead"] = _run_stage("sharding_overhead", True)
+        # Async dispatch pipeline + engine auto-tuner (ISSUE 9): the
+        # storm arms and the tuner run on the virtual clock + JAX-CPU
+        # by design (the acceptance platform), and the overhead gate is
+        # host-side machinery — both keep full fidelity relay-down.
+        extra["pipeline_spf_jaxcpu_small"] = _run_stage(
+            "pipeline_spf", True, cpu=True
+        )
+        extra["pipeline_overhead_jaxcpu_small"] = _run_stage(
+            "pipeline_overhead", True, cpu=True
+        )
         base = extra["cpubaseline"]
         n10 = base.get("n_vertices", "500" if small else "10125")
         print(
@@ -1428,6 +1753,13 @@ def main() -> None:
     # throughput) + the <2% 1-device-mesh overhead gate.
     extra["shard_spf"] = _run_stage("shard_spf", small)
     extra["sharding_overhead"] = _run_stage("sharding_overhead", small)
+    # Async dispatch pipeline + engine auto-tuner (ISSUE 9): storm
+    # async-vs-sync-vs-scalar arms (FIB + causal-digest gated), the
+    # consecutive-dispatch overlap microbench, per-shape tuner winners
+    # vs pinned engines with cold-table reproduction, and the <2%
+    # depth-1/disabled overhead gate.
+    extra["pipeline_spf"] = _run_stage("pipeline_spf", small)
+    extra["pipeline_overhead"] = _run_stage("pipeline_overhead", small)
     # Config 1: the 100-router CPU-reference floor (no device needed).
     extra["cpu100"] = _run_stage("cpu100", small)
 

@@ -82,6 +82,7 @@ DISPATCH_PREFIXES = (
     "holo_tpu/spf",
     "holo_tpu/frr",
     "holo_tpu/parallel",
+    "holo_tpu/pipeline",
 )
 CONCURRENCY_PREFIXES = (
     "holo_tpu/daemon",
@@ -226,6 +227,7 @@ class Rule:
     title = "abstract rule"
     family = "tracer"  # "tracer" | "locks"
     severity = "error"  # "error" | "warn"
+    cross_module = False  # True: check_project(mods) instead of check(mod)
 
     def check(self, mod: ModuleInfo) -> list[Finding]:
         raise NotImplementedError
@@ -243,15 +245,40 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A rule that needs the WHOLE parsed module set at once — the
+    cross-module analyses (HL108's imported-helper taint) that a
+    per-module ``check`` cannot express.  The runner parses every
+    module first, runs the per-module rules as before, then hands the
+    full list to each project rule exactly once; findings still anchor
+    to (and suppress in) the module they point at."""
+
+    cross_module = True
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        return []  # project rules only run in check_project
+
+    def check_project(self, mods: list[ModuleInfo]) -> list[Finding]:
+        raise NotImplementedError
+
+
 def all_rules() -> list[Rule]:
     """Instantiate the full registry (import is deferred so `core` has
     no circular dependency on the rule modules)."""
-    from holo_tpu.analysis import rules_locks, rules_resilience, rules_tracer
+    from holo_tpu.analysis import (
+        rules_locks,
+        rules_resilience,
+        rules_tracer,
+        rules_xmodule,
+    )
 
     return [
         cls()
         for cls in (
-            rules_tracer.RULES + rules_resilience.RULES + rules_locks.RULES
+            rules_tracer.RULES
+            + rules_xmodule.RULES
+            + rules_resilience.RULES
+            + rules_locks.RULES
         )
     ]
 
@@ -267,26 +294,60 @@ class LintResult:
     files_checked: int = 0
 
 
+def run_sources(
+    sources: list[tuple[str, str]],
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+) -> LintResult:
+    """Lint a set of ``(relpath, source)`` modules given as text — the
+    shared core of :func:`run_source` / :func:`run_paths`, and the
+    fixture surface for cross-module rules (several modules in one
+    call)."""
+    config = config or LintConfig()
+    rules = rules if rules is not None else all_rules()
+    result = LintResult()
+    mods: list[ModuleInfo] = []
+    by_path: dict[str, ModuleInfo] = {}
+    for relpath, source in sources:
+        result.files_checked += 1
+        try:
+            mod = ModuleInfo(relpath, source, config)
+        except SyntaxError as e:
+            result.parse_errors.append(f"{relpath}: {e}")
+            continue
+        mods.append(mod)
+        by_path[mod.relpath] = mod
+
+    def record(f: Finding) -> None:
+        owner = by_path.get(f.path)
+        if owner is not None and owner.suppressed(f):
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+
+    for mod in mods:
+        for rule in rules:
+            if rule.cross_module:
+                continue
+            for f in rule.check(mod):
+                record(f)
+    for rule in rules:
+        if rule.cross_module:
+            for f in rule.check_project(mods):
+                record(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
 def run_source(
     source: str,
     relpath: str,
     config: LintConfig | None = None,
     rules: list[Rule] | None = None,
 ) -> LintResult:
-    """Lint one module given as text (fixture tests use this)."""
-    config = config or LintConfig()
-    rules = rules if rules is not None else all_rules()
-    result = LintResult(files_checked=1)
-    try:
-        mod = ModuleInfo(relpath, source, config)
-    except SyntaxError as e:
-        result.parse_errors.append(f"{relpath}: {e}")
-        return result
-    for rule in rules:
-        for f in rule.check(mod):
-            (result.suppressed if mod.suppressed(f) else result.findings).append(f)
-    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return result
+    """Lint one module given as text (fixture tests use this; project
+    rules see a one-module set)."""
+    return run_sources([(relpath, source)], config, rules)
 
 
 def run_paths(
@@ -297,14 +358,13 @@ def run_paths(
 ) -> LintResult:
     """Lint every ``*.py`` under ``paths``; relpaths are vs ``root``."""
     config = config or LintConfig()
-    rules = rules if rules is not None else all_rules()
-    result = LintResult()
     files: list[Path] = []
     for p in paths:
         if p.is_dir():
             files.extend(sorted(p.rglob("*.py")))
         elif p.suffix == ".py":
             files.append(p)
+    sources: list[tuple[str, str]] = []
     for f in files:
         if any(part in config.exclude_parts for part in f.parts):
             continue
@@ -317,13 +377,8 @@ def run_paths(
             posix = f.as_posix()
             idx = posix.rfind("/holo_tpu/")
             rel = posix[idx + 1:] if idx >= 0 else posix
-        one = run_source(f.read_text(), rel, config, rules)
-        result.findings.extend(one.findings)
-        result.suppressed.extend(one.suppressed)
-        result.parse_errors.extend(one.parse_errors)
-        result.files_checked += 1
-    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return result
+        sources.append((rel, f.read_text()))
+    return run_sources(sources, config, rules)
 
 
 # -- baseline (the ratchet) ---------------------------------------------

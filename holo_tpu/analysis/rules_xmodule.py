@@ -1,0 +1,272 @@
+"""holo-lint cross-module tracer rules (HL108).
+
+The HL1xx rules in :mod:`rules_tracer` are per-module by construction:
+HL101 flags ``np.asarray(x)`` / ``float(x)`` on a device value *inside
+the device function itself*.  The blind spot this module closes is the
+helper one import away — ``from holo_tpu.foo.util import summarize`` —
+whose body materializes its parameter on the host.  The call site looks
+innocent (no sink in sight), the helper looks innocent (its parameter
+is just a name), and only the pair is a hidden mid-dispatch sync.
+
+HL108 runs as a :class:`~holo_tpu.analysis.core.ProjectRule`: pass 1
+indexes every module for **sink helpers** — functions that apply a host
+sink (``np.asarray`` / ``float`` / ``int`` / ``bool`` / ``.item()`` /
+``.tolist()``) to one of their own parameters outside a sanctioned
+window; pass 2 walks the dispatch-scope device functions, resolves
+imported names back to those helpers, and flags calls whose argument at
+a sinking parameter position carries device taint.  Sanctioned
+boundaries exempt both sides, exactly like HL101: a sink inside a
+``with sanctioned_transfer(...):`` block never marks the helper, and a
+call inside one is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, ProjectRule, dotted
+from holo_tpu.analysis.rules_tracer import (
+    _TaintView,
+    _device_functions,
+    _in_ranges,
+    _last_seg,
+    sanctioned_ranges,
+)
+
+# Host sinks a helper can apply to its parameter.  Narrower than
+# HL101's set on purpose: shape/metadata reads are not transfers, and
+# `len`/`str` on a jax array is already an error elsewhere.
+_SINK_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "float",
+    "int",
+    "bool",
+}
+_SINK_METHODS = {"item", "tolist"}
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (list(a.posonlyargs) + list(a.args))]
+
+
+def _param_root(node: ast.expr, params: set[str]) -> str | None:
+    """The parameter a sink expression ultimately reads: ``p``,
+    ``p.dist``, ``p[0]`` all root at ``p``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name) and node.id in params:
+        return node.id
+    return None
+
+
+def _module_relpath(dotted_mod: str) -> str:
+    """'holo_tpu.a.b' -> 'holo_tpu/a/b.py' (the ModuleInfo relpath)."""
+    return dotted_mod.replace(".", "/") + ".py"
+
+
+def sink_params(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    exempt: list[tuple[int, int]],
+) -> dict[str, str]:
+    """{param name -> sink spelling} for parameters this function
+    materializes on the host outside sanctioned ranges."""
+    params = set(_param_names(fn))
+    out: dict[str, str] = {}
+    if not params:
+        return out
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if _in_ranges(node.lineno, exempt):
+            continue
+        d = dotted(node.func)
+        if d in _SINK_CALLS and node.args:
+            root = _param_root(node.args[0], params)
+            if root is not None:
+                out.setdefault(root, f"{d}()")
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SINK_METHODS
+        ):
+            root = _param_root(node.func.value, params)
+            if root is not None:
+                out.setdefault(root, f".{node.func.attr}()")
+    return out
+
+
+class _HelperIndex:
+    """Pass 1: every module's top-level sink helpers.
+
+    Keyed ``(module relpath, function name)`` → ``{param name: sink,
+    "": positional index map}``; only module-level functions index
+    (methods would need receiver-type resolution the AST cannot do).
+    """
+
+    def __init__(self, mods: list[ModuleInfo]):
+        self.helpers: dict[tuple[str, str], dict] = {}
+        for mod in mods:
+            exempt = sanctioned_ranges(mod)
+            for stmt in mod.tree.body:
+                if not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                sinks = sink_params(stmt, exempt)
+                if not sinks:
+                    continue
+                self.helpers[(mod.relpath, stmt.name)] = {
+                    "sinks": sinks,
+                    "params": _param_names(stmt),
+                    "line": stmt.lineno,
+                }
+
+    def lookup(self, relpath: str, name: str) -> dict | None:
+        return self.helpers.get((relpath, name))
+
+
+def _import_map(mod: ModuleInfo) -> dict[str, tuple[str, str | None]]:
+    """Local name → (imported module relpath, function | None).
+
+    ``from holo_tpu.a.b import helper as h`` → ``h: (a/b.py, helper)``;
+    ``import holo_tpu.a.b as m`` / ``from holo_tpu.a import b`` →
+    ``m``/``b``: (a/b.py, None) — the attribute call ``m.helper(...)``
+    resolves the function part at the call site."""
+    out: dict[str, tuple[str, str | None]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if not node.module.startswith("holo_tpu"):
+                continue
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # Either `from pkg.mod import fn` or `from pkg import mod`
+                out[local] = (
+                    _module_relpath(node.module),
+                    alias.name,
+                )
+                out.setdefault(
+                    f"{local}#submodule",
+                    (_module_relpath(f"{node.module}.{alias.name}"), None),
+                )
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if not alias.name.startswith("holo_tpu"):
+                    continue
+                local = alias.asname or alias.name.split(".")[0]
+                if alias.asname or "." not in alias.name:
+                    out[local] = (_module_relpath(alias.name), None)
+    return out
+
+
+class CrossModuleHostSinkRule(ProjectRule):
+    """HL108: device value reaches a host sink through an imported
+    helper.
+
+    A device function passes a tainted value to a function defined in
+    ANOTHER module whose body applies ``np.asarray``/``float``/… to
+    that parameter outside any sanctioned window — an implicit
+    device→host transfer HL101 cannot see from either side alone.
+    Move the materialization behind the caller's sanctioned unmarshal
+    boundary, or accept host data in the helper's contract.
+    """
+
+    id = "HL108"
+    title = "cross-module device-value host sink via imported helper"
+    family = "tracer"
+    severity = "error"
+
+    def check_project(self, mods: list[ModuleInfo]) -> list[Finding]:
+        index = _HelperIndex(mods)
+        if not index.helpers:
+            return []
+        out: list[Finding] = []
+        for mod in mods:
+            if not mod.config.in_dispatch_scope(mod.relpath):
+                continue
+            imports = _import_map(mod)
+            if not imports:
+                continue
+            exempt = sanctioned_ranges(mod)
+            for fn in _device_functions(mod):
+                taint = _TaintView(fn)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _in_ranges(node.lineno, exempt):
+                        continue
+                    helper = self._resolve(mod, index, imports, node)
+                    if helper is None:
+                        continue
+                    info, label = helper
+                    hit = self._tainted_sink_arg(node, info, taint)
+                    if hit is None:
+                        continue
+                    param, sink = hit
+                    out.append(
+                        self.finding(
+                            mod,
+                            node,
+                            f"device value flows into host sink {sink} "
+                            f"through helper `{label}` (parameter "
+                            f"`{param}`) defined in another module; "
+                            "move the materialization behind the "
+                            "sanctioned unmarshal boundary",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _resolve(mod, index, imports, node) -> tuple[dict, str] | None:
+        """(helper info, display label) for a call that resolves to a
+        sink helper defined in a DIFFERENT module."""
+        d = dotted(node.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            tgt = imports.get(parts[0])
+            if tgt is None or tgt[1] is None:
+                return None
+            relpath, fname = tgt
+            info = index.lookup(relpath, fname)
+        else:
+            # m.helper(...) through `import pkg.mod as m` or
+            # `from pkg import mod`.
+            tgt = imports.get(parts[0])
+            if tgt is None:
+                return None
+            relpath, sub = tgt
+            if sub is not None:
+                # `from pkg import mod` came through as (pkg.py, mod):
+                # the attribute call means `mod` was a submodule.
+                alt = imports.get(f"{parts[0]}#submodule")
+                if alt is None:
+                    return None
+                relpath = alt[0]
+            info = index.lookup(relpath, parts[1])
+            fname = parts[1]
+        if info is None or relpath == mod.relpath:
+            return None
+        return info, f"{relpath}:{fname}"
+
+    @staticmethod
+    def _tainted_sink_arg(node, info, taint) -> tuple[str, str] | None:
+        """(param name, sink) when a tainted argument lands on one of
+        the helper's sinking parameters."""
+        params = info["params"]
+        sinks = info["sinks"]
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(params) and params[i] in sinks and taint.tainted(arg):
+                return params[i], sinks[params[i]]
+        for kw in node.keywords:
+            if kw.arg in sinks and taint.tainted(kw.value):
+                return kw.arg, sinks[kw.arg]
+        return None
+
+
+RULES = [CrossModuleHostSinkRule]

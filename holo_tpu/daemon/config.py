@@ -121,6 +121,29 @@ class ParallelConfig:
 
 
 @dataclass
+class PipelineConfig:
+    # Async dispatch pipeline + engine auto-tuner ([pipeline] in
+    # holod.toml, ISSUE 9): when enabled, the daemon installs one
+    # process-wide dispatch pipeline at boot and TpuSpfBackend /
+    # FrrEngine instances built by the providers are wrapped so
+    # protocol actors enqueue SPF/FRR work instead of blocking on the
+    # device (holo_tpu/pipeline/dispatch.py).  Off by default: the
+    # synchronous dispatch path stays byte-for-byte what PR 8 shipped.
+    enabled: bool = False
+    # Launched-but-unfinished entries (2 = double buffering) and the
+    # bounded queue (a full queue backpressures the submitting actor).
+    depth: int = 2
+    queue: int = 32
+    # Per-shape engine auto-tuner (holo_tpu/pipeline/tuner.py): can be
+    # armed independently of the async pipeline — the synchronous
+    # dispatch path consults it too.
+    tuner: bool = False
+    # Versioned on-disk tuner table (restarts don't re-learn); None
+    # keeps the table in memory only.
+    tuner_cache: str | None = None
+
+
+@dataclass
 class RuntimeConfig:
     # "threaded" (default): each protocol instance on its own OS thread
     # — the reference's PRODUCTION posture (per-instance spawn_blocking,
@@ -149,6 +172,7 @@ class DaemonConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
 
     @classmethod
     def load(cls, path: str | Path | None) -> "DaemonConfig":
@@ -232,6 +256,26 @@ class DaemonConfig:
                             f"integer, got {v!r}"
                         )
                     setattr(cfg.parallel, key, v)
+        if "pipeline" in raw:
+            p = raw["pipeline"]
+            cfg.pipeline.enabled = p.get("enabled", False)
+            cfg.pipeline.tuner = p.get("tuner", cfg.pipeline.enabled)
+            cfg.pipeline.tuner_cache = p.get("tuner-cache")
+            for key in ("depth", "queue"):
+                if key in p:
+                    v = p[key]
+                    # bool is an int subclass: `depth = true` must be
+                    # rejected, not silently installed as depth=1.
+                    if (
+                        isinstance(v, bool)
+                        or not isinstance(v, int)
+                        or v < 1
+                    ):
+                        raise ValueError(
+                            f"[pipeline] {key} must be a positive "
+                            f"integer, got {v!r}"
+                        )
+                    setattr(cfg.pipeline, key, v)
         if "runtime" in raw:
             iso = raw["runtime"].get("isolation")
             if iso is not None:

@@ -588,6 +588,30 @@ def main(argv=None):
             log.warning(
                 "parallel mesh unavailable (%s); single-device dispatch", e
             )
+    # Async dispatch pipeline + engine auto-tuner ([pipeline], ISSUE 9):
+    # process-wide like the mesh, installed at BOOT only.  The tuner can
+    # arm independently (the synchronous dispatch path consults it too);
+    # a configured tuner-cache path restores the learned per-shape
+    # winners so restarts don't re-learn.
+    if cfg.pipeline.enabled or cfg.pipeline.tuner:
+        from holo_tpu import pipeline as _pipeline
+
+        if cfg.pipeline.tuner:
+            tuner = _pipeline.configure_engine_tuner(
+                path=cfg.pipeline.tuner_cache
+            )
+            log.info(
+                "engine auto-tuner armed (%d persisted shape buckets)",
+                tuner.stats()["buckets"],
+            )
+        if cfg.pipeline.enabled:
+            _pipeline.configure_process_pipeline(
+                depth=cfg.pipeline.depth, capacity=cfg.pipeline.queue
+            )
+            log.info(
+                "async dispatch pipeline armed (depth=%d queue=%d)",
+                cfg.pipeline.depth, cfg.pipeline.queue,
+            )
     from holo_tpu.daemon import hardening
 
     lock_fd = None
@@ -690,6 +714,14 @@ def main(argv=None):
     except KeyboardInterrupt:
         daemon.stop()
     finally:
+        if cfg.pipeline.tuner and cfg.pipeline.tuner_cache:
+            # Final table flush (promotions already saved eagerly):
+            # the learned winners must survive an orderly shutdown.
+            from holo_tpu.pipeline import active_tuner
+
+            t = active_tuner()
+            if t is not None:
+                t.save()
         if lock_fd is not None:
             os.close(lock_fd)
 

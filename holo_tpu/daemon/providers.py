@@ -799,7 +799,14 @@ class RoutingProvider(Provider, Actor):
         # fresh breaker metric series each time.
         want = TpuSpfBackend if backend_name == "tpu" else ScalarSpfBackend
         prev = getattr(inst, "backend", None) if inst is not None else None
-        backend = prev if type(prev) is want else want()
+        # A pipelined backend wraps the real one (AsyncSpfBackend.inner,
+        # ISSUE 9): the reuse check looks through the facade, and a
+        # fresh tpu backend rides the process pipeline when one is
+        # armed (wrap_spf_backend is the identity otherwise).
+        from holo_tpu.pipeline import wrap_spf_backend
+
+        prev_core = getattr(prev, "inner", prev)
+        backend = prev if type(prev_core) is want else wrap_spf_backend(want())
         old_redist = getattr(self, "_ospf_redistribute", set())
         self._ospf_redistribute = set(new.get(f"{base}/redistribute") or [])
         redist_changed = old_redist != self._ospf_redistribute

@@ -178,10 +178,16 @@ def ensure_engine(current, cfg: FrrConfig) -> "FrrEngine":
     """Reuse ``current`` when it already runs ``cfg.engine``, else build
     a fresh engine (the graph/jit caches are per-engine).  The shared
     lazy-create step for every protocol instance holding a
-    ``_frr_engine`` slot."""
+    ``_frr_engine`` slot.  With the process dispatch pipeline armed
+    ([pipeline] in holod.toml) a fresh tpu engine is wrapped so the
+    backup-table dispatch rides the async pipeline (``current`` may
+    therefore be an AsyncFrrEngine — its ``engine`` attribute
+    delegates, so the reuse check is unchanged)."""
     if current is not None and current.engine == cfg.engine:
         return current
-    return FrrEngine(engine=cfg.engine)
+    from holo_tpu.pipeline import wrap_frr_engine
+
+    return wrap_frr_engine(FrrEngine(engine=cfg.engine))
 
 
 class FrrEngine:
@@ -298,6 +304,15 @@ class FrrEngine:
         return g
 
     def _compute_tpu(self, topo: Topology, fin) -> BackupTable:
+        return self._finish_tpu(self._launch_tpu(topo, fin))
+
+    def _launch_tpu(self, topo: Topology, fin) -> tuple:
+        """Phase 1 of the (optionally pipelined) FRR dispatch: chaos
+        seams, plane marshal, the ASYNC jit call.  Returns the handle
+        :meth:`_finish_tpu` completes; between the two the device
+        executes while the pipeline worker launches other entries
+        (ISSUE 9 split-phase contract, mirroring
+        ``TpuSpfBackend.launch_one``)."""
         faults.crashpoint("frr.dispatch")
         mesh = _mesh()
         if mesh is not None:
@@ -318,7 +333,7 @@ class FrrEngine:
             )
         # The FRR analog of the SPF backend's sanctioned boundary: the
         # padded planes move host->device here, results device->host
-        # below, and nowhere else.
+        # in _finish_tpu, and nowhere else.
         with profiling.stage("frr.batch", "marshal"):
             with sanctioned_transfer("frr.batch.marshal"):
                 g = self._prepare(topo)
@@ -353,13 +368,18 @@ class FrrEngine:
             profiling.record_cost(
                 "frr.batch", step, g, topo.root, *args, shape_sig=sig
             )
+        return (out, fin, topo, mesh is not None)
+
+    def _finish_tpu(self, handle: tuple) -> BackupTable:
+        """Phase 2: device completion + readback + accounting."""
+        out, fin, topo, sharded = handle
         with profiling.stage("frr.batch", "device"):
             with profiling.annotation("frr.batch.device"):
                 if not profiling.device_stages("frr.batch", out):
                     profiling.sync(out)
         nl = fin.n_links
         n = int(topo.n_vertices)
-        if mesh is not None:
+        if sharded:
             _FRR_SHARD_DISPATCHES.labels(kind="frr").inc()
         convergence.note_dispatch("frr", "device")
         with profiling.stage("frr.batch", "readback"):
@@ -379,6 +399,22 @@ class FrrEngine:
                     post_nh=np.asarray(out.post_nh)[:nl, :n],
                 )
 
+    def marshal_inputs(self, topo: Topology):
+        """Marshal the FRR planes + pad-occupancy gauges (the shared
+        front half of :meth:`compute`, exposed for the pipelined
+        facade)."""
+        fin = marshal_frr(topo)
+        lp = fin.link_valid.shape[0]
+        ap = fin.adj_valid.shape[0]
+        if lp:
+            _FRR_PAD_OCCUPANCY.labels(plane="links").set(fin.n_links / lp)
+        if ap:
+            # Deferred (set_fn): see compute().
+            _FRR_PAD_OCCUPANCY.labels(plane="adjs").set_fn(
+                telemetry.deferred_mean(fin.adj_valid)
+            )
+        return fin
+
     def _scalar_fallback(self, topo: Topology, fin) -> BackupTable:
         """Breaker degraded path: the oracle over the SAME marshaled
         inputs — the backup table is bit-identical by the parity suite."""
@@ -395,20 +431,9 @@ class FrrEngine:
         """One batched backup-table computation for ``topo.root``."""
         t0 = time.perf_counter()
         with telemetry.span("frr.dispatch", engine=self.engine):
-            fin = marshal_frr(topo)
-            lp = fin.link_valid.shape[0]
-            ap = fin.adj_valid.shape[0]
-            if lp:
-                _FRR_PAD_OCCUPANCY.labels(plane="links").set(fin.n_links / lp)
-            if ap:
-                # Deferred (set_fn): the O(Ap) reduction runs at scrape
-                # time, not inside the dispatch critical section
-                # (holo-lint HL105); reads still mean "last marshal",
-                # and the one-shot sampler releases the plane after the
-                # first scrape.
-                _FRR_PAD_OCCUPANCY.labels(plane="adjs").set_fn(
-                    telemetry.deferred_mean(fin.adj_valid)
-                )
+            # Occupancy gauges ride marshal_inputs; the adj-plane mean
+            # is deferred to scrape time via set_fn (holo-lint HL105).
+            fin = self.marshal_inputs(topo)
             if self.engine == "tpu":
                 table = self.breaker.call(
                     lambda: self._compute_tpu(topo, fin),

@@ -366,6 +366,30 @@ class DeviceGraphCache:
         self._evictions = 0
         self._deltas_applied = 0
 
+    def _depth_cap(self, topo) -> int:
+        """The chain-depth cap for this topology's shape bucket.
+
+        PR 7 shipped ``max_delta_depth`` as a fixed knob; with the
+        engine tuner armed (ISSUE 9) the cap is derived per shape
+        bucket from the measured delta-stage vs full-rebuild walls the
+        SPF backend feeds into the persisted tuner table — a bucket
+        whose in-place delta is 40x cheaper than a re-marshal affords a
+        much longer chain than one where the delta barely wins.  The
+        static knob remains both the untuned default and the
+        no-measurements fallback.  Lazy import: nanoseconds after the
+        first call, and the pipeline package must stay optional here.
+        """
+        from holo_tpu.pipeline.tuner import active_tuner, shape_bucket
+
+        t = active_tuner()
+        if t is None:
+            return self.max_delta_depth
+        _mesh, mkey = _process_mesh_state()
+        return t.max_delta_depth(
+            shape_bucket(topo.n_vertices, topo.n_edges, 1, mkey),
+            default=self.max_delta_depth,
+        )
+
     def get(
         self,
         topo,
@@ -441,12 +465,13 @@ class DeviceGraphCache:
         kind = delta.kind
         _mesh, mkey = _process_mesh_state()
         base_key = (*delta.base_key, int(n_atoms), mkey)
+        depth_cap = self._depth_cap(topo)
         with self._lock:
             base = self._cache.get(base_key)
             if base is None:
                 path = "full-no-base"
                 base = None
-            elif base.depth + 1 > self.max_delta_depth:
+            elif base.depth + 1 > depth_cap:
                 path = "full-depth"
                 base = None
             elif need_edge_ids and (base.ids_stale or not delta.ids_stable):

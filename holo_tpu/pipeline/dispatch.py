@@ -1,0 +1,809 @@
+"""Double-buffered async dispatch pipeline (ISSUE 9 tentpole, part a).
+
+Protocol actors used to block synchronously on every SPF/FRR marshal →
+device-execute → readback round trip.  This module puts a bounded
+dispatch queue and one pipeline worker between the actors and the
+device, in the spirit of DeltaPath's dataflow pipelining
+(arXiv:1808.06893):
+
+- actors **enqueue** work (:meth:`DispatchPipeline.submit`) and get a
+  ticket back immediately; :class:`LazySpfResult` defers the block to
+  the first *use* of the result, so the host work between the dispatch
+  call and the first consumption (LSDB walks, route bookkeeping)
+  overlaps the device execution for free;
+- the worker runs the split-phase backend API
+  (``TpuSpfBackend.launch_* / finish_*``): while dispatch *i* executes
+  on the device, dispatch *i+1*'s host marshal proceeds — depth-bounded
+  double buffering (``depth=2`` default), with the finish (device sync
+  + readback) of the oldest in-flight entry interleaved;
+- **ordering** is strict per ``(instance topology uid, root)`` key:
+  results complete in submission order for a key, and at most ONE entry
+  per key is ever in flight — the *ownership handoff* the DeltaPath
+  donation contract requires (an in-flight dispatch's donated previous
+  tensors / resident graph buffers must never be consumed by a queued
+  delta for the same chain; the next entry launches only after the
+  previous one's ``finish`` has re-deposited the retained tensors);
+- superseded **what-if batches coalesce**: a queued advisory batch for
+  the same key is dropped (ticket marked superseded) when a batch for a
+  newer topology generation arrives, and a resubmission of the same
+  generation shares the queued ticket instead of duplicating work;
+- **breaker awareness**: while a dispatch breaker is OPEN, advisory
+  what-if batches are skipped at the submit seam — previously each one
+  paid the full scalar re-run just to produce advisory output nobody
+  was owed.
+
+Chaos seam: the async dispatch closures run
+``faults.crashpoint("pipeline.dispatch")`` inside the breaker guard, so
+a seeded plan can fail pipelined dispatches mid-storm and the scalar
+fallback must keep FIBs bit-identical (tests/test_pipeline.py).
+
+Everything lands in the ``holo_pipeline_*`` metric family: queue depth,
+in-flight count, per-kind dispatch counters, coalesced/skipped tallies,
+caller wait time, and the measured overlap ratio (device-in-flight
+seconds that ran while the worker was free to do other host work).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+
+from holo_tpu import telemetry
+from holo_tpu.telemetry import convergence
+
+log = logging.getLogger("holo_tpu.pipeline")
+
+_QUEUE_DEPTH = telemetry.gauge(
+    "holo_pipeline_queue_depth",
+    "Entries waiting in the dispatch pipeline queue",
+)
+_INFLIGHT = telemetry.gauge(
+    "holo_pipeline_inflight",
+    "Launched-but-unfinished pipeline entries (device in flight)",
+)
+_DISPATCHES = telemetry.counter(
+    "holo_pipeline_dispatch_total",
+    "Pipeline entries completed, by dispatch kind",
+    ("kind",),
+)
+_COALESCED = telemetry.counter(
+    "holo_pipeline_coalesced_total",
+    "Queued what-if batches coalesced (shared or superseded)",
+    ("reason",),
+)
+_BREAKER_SKIPS = telemetry.counter(
+    "holo_pipeline_breaker_skip_total",
+    "Advisory batches skipped at submit because the circuit was open",
+)
+_WAIT_SECONDS = telemetry.histogram(
+    "holo_pipeline_wait_seconds",
+    "Caller-side wait from result force to completion",
+    ("kind",),
+)
+_OVERLAP_RATIO = telemetry.gauge(
+    "holo_pipeline_overlap_ratio",
+    "Fraction of device-in-flight time overlapped with other host work",
+)
+
+
+class PipelineClosed(RuntimeError):
+    """Submit against a closed pipeline."""
+
+
+class PipelineTicket:
+    """Completion handle for one submitted dispatch."""
+
+    __slots__ = (
+        "key", "kind", "generation", "_event", "_value", "_exc",
+        "skipped", "superseded", "_pipeline",
+    )
+
+    def __init__(self, pipeline, key, kind: str, generation: int):
+        self.key = key
+        self.kind = kind
+        self.generation = generation
+        self._pipeline = pipeline
+        self._event = threading.Event()
+        self._value = None
+        self._exc: BaseException | None = None
+        self.skipped = False  # breaker-open skip: never executed
+        self.superseded = False  # coalesced away by a newer generation
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        """Block until completion; re-raises a passthrough exception on
+        the caller's thread (same contract as the synchronous dispatch).
+        Skipped/superseded tickets return None."""
+        if not self._event.is_set():
+            t0 = time.perf_counter()
+            if not self._event.wait(timeout):
+                raise TimeoutError(
+                    f"pipeline result for {self.key}/{self.kind} not ready"
+                )
+            _WAIT_SECONDS.labels(kind=self.kind).observe(
+                time.perf_counter() - t0
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    # pipeline-side completion
+    def _complete(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def _skip(self, superseded: bool = False) -> None:
+        if superseded:
+            self.superseded = True
+        else:
+            self.skipped = True
+        self._event.set()
+
+
+class _Item:
+    """One queued dispatch."""
+
+    __slots__ = (
+        "key", "kind", "generation", "ticket", "run", "launch", "finish",
+        "coalesce", "eids", "handle", "t_launch_end",
+    )
+
+    def __init__(
+        self, ticket, run=None, launch=None, finish=None,
+        coalesce=False, eids=(),
+    ):
+        self.ticket = ticket
+        self.key = ticket.key
+        self.kind = ticket.kind
+        self.generation = ticket.generation
+        self.run = run
+        self.launch = launch
+        self.finish = finish
+        self.coalesce = coalesce
+        self.eids = tuple(eids)
+        self.handle = None
+        self.t_launch_end = 0.0
+
+
+class DispatchPipeline:
+    """Bounded dispatch queue + one pipeline worker thread.
+
+    ``depth`` bounds the launched-but-unfinished entries (2 = classic
+    double buffering); ``capacity`` bounds the queue — a full queue
+    backpressures the submitting actor (bounded means bounded).
+    ``guard`` is an optional zero-arg callable returning a context
+    manager entered around every worker-side phase: tests pass
+    ``holo_tpu.testing.no_implicit_transfers`` so the pipelined path
+    runs under the same transfer sanitizer as the synchronous suites.
+    """
+
+    def __init__(
+        self,
+        depth: int = 2,
+        capacity: int = 32,
+        name: str = "pipeline",
+        guard=None,
+    ):
+        self.depth = max(int(depth), 1)
+        self.capacity = max(int(capacity), 1)
+        self.name = name
+        self.guard = guard
+        self._cv = threading.Condition()
+        self._queue: deque[_Item] = deque()
+        self._inflight: list[_Item] = []
+        self._inflight_keys: set = set()
+        # Items the worker popped but has not yet parked in _inflight /
+        # finalized — without this, drain() would report empty while a
+        # launch (or a whole single-phase run) is still executing.
+        self._working = 0
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        # stats (mutated under _cv or worker-only)
+        self._submitted = 0
+        self._completed = 0
+        self._coalesced = 0
+        self._skipped = 0
+        self._launch_seconds = 0.0
+        self._finish_seconds = 0.0
+        self._overlap_seconds = 0.0
+        self._max_inflight_per_key = 0  # invariant probe (tests): <= 1
+        _QUEUE_DEPTH.set_fn(lambda: float(len(self._queue)))
+        _INFLIGHT.set_fn(lambda: float(len(self._inflight)))
+
+    # -- submit side ----------------------------------------------------
+
+    def submit(
+        self,
+        key,
+        kind: str,
+        run=None,
+        launch=None,
+        finish=None,
+        generation: int = 0,
+        coalesce: bool = False,
+        skip_when_open=None,
+    ) -> PipelineTicket:
+        """Enqueue one dispatch and return its ticket.
+
+        Exactly one of ``run`` (single-phase: the worker executes it
+        whole) or the ``launch``/``finish`` pair (split-phase: overlap
+        eligible) must be given.  ``coalesce=True`` marks an advisory
+        what-if batch: same-(key, generation) resubmissions share the
+        queued ticket, a newer generation supersedes a queued older
+        one, and ``skip_when_open`` (a CircuitBreaker) short-circuits
+        the submit entirely while the circuit is open."""
+        if (run is None) == (launch is None or finish is None):
+            raise ValueError("pass run=... OR launch=.../finish=...")
+        ticket = PipelineTicket(self, key, kind, int(generation))
+        if skip_when_open is not None and skip_when_open.state == "open":
+            # The breaker is already serving FIB-feeding dispatches from
+            # the oracle; an advisory batch is not owed a scalar re-run.
+            ticket._skip()
+            self._skipped += 1
+            _BREAKER_SKIPS.inc()
+            return ticket
+        item = _Item(
+            ticket, run=run, launch=launch, finish=finish,
+            coalesce=coalesce, eids=convergence.current(),
+        )
+        with self._cv:
+            if self._closed:
+                raise PipelineClosed(self.name)
+            if coalesce:
+                for old in list(self._queue):
+                    if not (
+                        old.coalesce
+                        and old.key == key
+                        and old.kind == kind
+                    ):
+                        continue
+                    if old.generation == item.generation:
+                        # Identical work already queued: share it.
+                        self._coalesced += 1
+                        _COALESCED.labels(reason="shared").inc()
+                        return old.ticket
+                    if old.generation < item.generation:
+                        # Stale batch nobody needs anymore.
+                        self._queue.remove(old)
+                        old.ticket._skip(superseded=True)
+                        self._coalesced += 1
+                        _COALESCED.labels(reason="superseded").inc()
+            while len(self._queue) >= self.capacity and not self._closed:
+                self._cv.wait(0.5)
+            if self._closed:
+                raise PipelineClosed(self.name)
+            self._queue.append(item)
+            self._submitted += 1
+            self._ensure_worker_locked()
+            self._cv.notify_all()
+        return ticket
+
+    def _ensure_worker_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name=f"holo-pipeline-{self.name}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # -- worker side ----------------------------------------------------
+
+    def _next_launchable_locked(self) -> _Item | None:
+        """Oldest queued item whose key is not in flight (per-key
+        ownership handoff: never two launches for one key)."""
+        for item in self._queue:
+            if item.key not in self._inflight_keys:
+                self._queue.remove(item)
+                return item
+        return None
+
+    def _worker(self) -> None:
+        while True:
+            launch_item = None
+            finish_item = None
+            with self._cv:
+                if (
+                    self._closed
+                    and not self._queue
+                    and not self._inflight
+                ):
+                    self._cv.notify_all()
+                    return
+                launch_item = (
+                    self._next_launchable_locked()
+                    if len(self._inflight) < self.depth
+                    else None
+                )
+                if launch_item is None:
+                    if self._inflight:
+                        finish_item = self._inflight.pop(0)
+                        self._working += 1
+                    else:
+                        self._cv.wait(0.5)
+                        continue
+                else:
+                    self._working += 1
+            if launch_item is not None:
+                self._do_launch(launch_item)
+                continue
+            self._do_finish(finish_item)
+
+    def _ctx(self, item: _Item):
+        g = self.guard() if self.guard is not None else nullcontext()
+        return g, convergence.activation(item.eids)
+
+    def _do_launch(self, item: _Item) -> None:
+        t0 = time.perf_counter()
+        try:
+            guard, act = self._ctx(item)
+            with guard, act:
+                if item.run is not None:
+                    item.ticket._complete(item.run())
+                    self._finalize(item, finished=True)
+                    return
+                item.handle = item.launch()
+        except BaseException as exc:  # noqa: BLE001 — marshaled to the
+            # caller's thread by ticket.result(); the worker survives.
+            item.ticket._fail(exc)
+            self._finalize(item, finished=True)
+            return
+        finally:
+            self._launch_seconds += time.perf_counter() - t0
+        item.t_launch_end = time.perf_counter()
+        with self._cv:
+            self._inflight.append(item)
+            self._inflight_keys.add(item.key)
+            self._working -= 1
+            per_key = sum(
+                1 for i in self._inflight if i.key == item.key
+            )
+            self._max_inflight_per_key = max(
+                self._max_inflight_per_key, per_key
+            )
+            self._cv.notify_all()
+
+    def _do_finish(self, item: _Item) -> None:
+        t_fs = time.perf_counter()
+        # Device time that elapsed while the worker was busy elsewhere
+        # (launching the next entry / idle-waiting): the overlap the
+        # double buffer exists to create.
+        self._overlap_seconds += max(t_fs - item.t_launch_end, 0.0)
+        try:
+            guard, act = self._ctx(item)
+            with guard, act:
+                item.ticket._complete(item.finish(item.handle))
+        except BaseException as exc:  # noqa: BLE001 — see _do_launch
+            item.ticket._fail(exc)
+        finally:
+            self._finish_seconds += time.perf_counter() - t_fs
+            self._finalize(item, finished=False)
+
+    def _finalize(self, item: _Item, finished: bool) -> None:
+        with self._cv:
+            self._inflight_keys.discard(item.key)
+            self._working -= 1
+            self._completed += 1
+            self._cv.notify_all()
+        _DISPATCHES.labels(kind=item.kind).inc()
+        denom = self._overlap_seconds + self._finish_seconds
+        if denom > 0:
+            _OVERLAP_RATIO.set(self._overlap_seconds / denom)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until queue + in-flight are empty (True on success)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._inflight_keys or self._working:
+                wait = 0.5
+                if deadline is not None:
+                    wait = deadline - time.monotonic()
+                    if wait <= 0:
+                        return False
+                self._cv.wait(min(wait, 0.5))
+        return True
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Refuse new submits, drain, stop the worker."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        # Detach the sampled gauges: a set_fn closure over self would
+        # otherwise pin this closed pipeline forever and keep scraping
+        # its dead queue.  Safe ordering with configure_process_pipeline
+        # (old closed BEFORE the replacement's __init__ re-points them).
+        _QUEUE_DEPTH.set_fn(None)
+        _QUEUE_DEPTH.set(0.0)
+        _INFLIGHT.set_fn(None)
+        _INFLIGHT.set(0.0)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        with self._cv:
+            denom = self._overlap_seconds + self._finish_seconds
+            return {
+                "depth": self.depth,
+                "capacity": self.capacity,
+                "queued": len(self._queue),
+                "inflight": len(self._inflight),
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "coalesced": self._coalesced,
+                "breaker-skipped": self._skipped,
+                "launch-seconds": round(self._launch_seconds, 6),
+                "finish-seconds": round(self._finish_seconds, 6),
+                "overlap-seconds": round(self._overlap_seconds, 6),
+                "overlap-ratio": round(
+                    self._overlap_seconds / denom, 4
+                ) if denom > 0 else 0.0,
+                "max-inflight-per-key": self._max_inflight_per_key,
+            }
+
+
+# -- lazy results -------------------------------------------------------
+
+
+class LazySpfResult:
+    """Duck-typed :class:`holo_tpu.spf.backend.SpfResult`: attribute
+    access forces the pipeline ticket.  The protocol layer reads
+    ``dist``/``parent``/``hops``/``nexthop_words`` — each blocks until
+    the worker completed the dispatch, which by then has usually
+    overlapped the caller's own host work."""
+
+    __slots__ = ("_ticket",)
+
+    _FIELDS = ("dist", "parent", "hops", "nexthop_words")
+
+    def __init__(self, ticket: PipelineTicket):
+        self._ticket = ticket
+
+    def _force(self):
+        res = self._ticket.result()
+        if res is None:
+            raise RuntimeError(
+                f"pipelined SPF dispatch for {self._ticket.key} was "
+                f"{'skipped' if self._ticket.skipped else 'superseded'}"
+            )
+        return res
+
+    def __getattr__(self, name):
+        if name in self._FIELDS:
+            return getattr(self._force(), name)
+        raise AttributeError(name)
+
+    def wait(self):
+        """Explicit force (returns the real SpfResult)."""
+        return self._force()
+
+
+class LazyBackupTable:
+    """Duck-typed :class:`holo_tpu.frr.kernel.BackupTable`: any
+    attribute access forces the FRR pipeline ticket — the protocol
+    layer stores the table at SPF time but only consumes it when a
+    repair is resolved (BFD/carrier flip), so the FRR dispatch rides
+    the pipeline for free."""
+
+    __slots__ = ("_ticket",)
+
+    def __init__(self, ticket: PipelineTicket):
+        self._ticket = ticket
+
+    def _force(self):
+        res = self._ticket.result()
+        if res is None:
+            raise RuntimeError(
+                f"pipelined FRR dispatch for {self._ticket.key} skipped"
+            )
+        return res
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._force(), name)
+
+    def wait(self):
+        return self._force()
+
+
+# -- async backend facades ---------------------------------------------
+
+#: exception types the breaker never masks (bugs, not device failures);
+#: mirrored from resilience.breaker so the split-phase closures agree.
+def _passthrough():
+    from holo_tpu.resilience.breaker import _PASSTHROUGH
+
+    return _PASSTHROUGH
+
+
+def _guarded_launch(breaker, context: str, launch_fn) -> tuple:
+    """Phase 1 of a split breaker-guarded dispatch — ONE implementation
+    shared by the SPF and FRR facades so the breaker contract (admit →
+    chaos seam → passthrough abort → failure) cannot drift between
+    them.  Returns the ``(verdict, guard, handle)`` state
+    :func:`_guarded_finish` completes."""
+    from holo_tpu.resilience import faults
+
+    guard = breaker.split(context)
+    if not guard.admitted:
+        return ("fallback", guard, None)
+    try:
+        faults.crashpoint("pipeline.dispatch")
+        return ("ok", guard, launch_fn())
+    except _passthrough():
+        guard.abort()
+        raise
+    except Exception as exc:  # noqa: BLE001 — breaker contract
+        guard.failure(exc)
+        return ("fallback", guard, None)
+
+
+def _guarded_finish(state: tuple, finish_fn, fallback_fn):
+    """Phase 2: complete the device dispatch or serve the bit-identical
+    fallback; success records the whole launch→finish deadline span."""
+    verdict, guard, handle = state
+    if verdict == "fallback":
+        return fallback_fn()
+    try:
+        res = finish_fn(handle)
+    except _passthrough():
+        guard.abort()
+        raise
+    except Exception as exc:  # noqa: BLE001 — breaker contract
+        guard.failure(exc)
+        return fallback_fn()
+    guard.success()
+    return res
+
+
+class AsyncSpfBackend:
+    """``SpfBackend`` facade routing dispatches through a pipeline.
+
+    ``compute`` enqueues a split-phase (launch/finish) dispatch and
+    returns a :class:`LazySpfResult`; the synchronous breaker contract
+    is preserved phase by phase via ``CircuitBreaker.split`` — an XLA
+    failure in either phase re-runs on the scalar oracle
+    (bit-identical), repeated failures open the circuit, and
+    passthrough exceptions surface on the caller's thread at force
+    time.  ``compute_whatif_async`` adds the advisory-batch semantics
+    (coalescing + breaker-open skip); the plain ``compute_whatif`` /
+    ``compute_multiroot`` stay synchronous delegates — their callers
+    (CLI, bench) want blocking results.
+    """
+
+    #: retained chain-root entries (one live dispatch chain per entry)
+    CHAIN_CAPACITY = 512
+
+    def __init__(self, inner, pipeline: DispatchPipeline):
+        self.inner = inner
+        self.pipeline = pipeline
+        # Topology uid -> chain-root uid.  Every SPF run marshals a
+        # FRESH Topology object (new uid), so the ordering/ownership
+        # unit is the DELTA CHAIN: a topology carrying ``delta_base``
+        # lineage joins its base's chain, everything else roots a new
+        # one.  This is what makes "(instance, root)" concrete at the
+        # backend layer — one instance area advances one chain.
+        self._chains: dict = {}
+
+    @property
+    def name(self) -> str:
+        return f"{self.inner.name}-async"
+
+    def __getattr__(self, attr):
+        # breaker / incremental / engine / prepare / oracle ... all
+        # delegate: the facade adds scheduling, not behavior.
+        return getattr(self.inner, attr)
+
+    # -- keys ----------------------------------------------------------
+
+    def _key(self, topo) -> tuple:
+        """The strict-ordering / ownership-handoff unit: (delta-chain
+        root uid, root vertex).  Consecutive generations of one
+        instance area MUST serialize — an in-flight dispatch's donated
+        previous tensors / resident graph buffers must never be
+        consumed by a queued delta of the same chain — while unrelated
+        areas/instances overlap freely."""
+        uid = topo.cache_key[0]
+        delta = getattr(topo, "delta_base", None)
+        if delta is not None:
+            base_uid = delta.base_key[0]
+            chain = self._chains.get(base_uid, base_uid)
+        else:
+            chain = self._chains.get(uid, uid)
+        self._chains[uid] = chain
+        while len(self._chains) > self.CHAIN_CAPACITY:
+            self._chains.pop(next(iter(self._chains)))
+        return (chain, int(topo.root))
+
+    # -- SpfBackend interface ------------------------------------------
+
+    def compute(self, topo, edge_mask=None):
+        inner = self.inner
+        pipe = self.pipeline
+        if pipe is None or pipe.closed:
+            return inner.compute(topo, edge_mask)
+        if inner.breaker.state == "open":
+            # Degraded mode runs on the CALLER's thread, exactly like
+            # the unpipelined breaker: N threaded instances' scalar
+            # fallbacks must not serialize behind the one pipeline
+            # worker while the device is down.  Safe w.r.t. the
+            # per-key contract: the scalar path touches no device
+            # residents or retained tensors.
+            return inner.compute(topo, edge_mask)
+        if getattr(inner, "engine", None) == "blocked":
+            # The blocked-Pallas experiment has no split-phase path;
+            # run it whole on the worker (actors still don't block).
+            ticket = pipe.submit(
+                self._key(topo), "one",
+                run=lambda: inner.compute(topo, edge_mask),
+            )
+            return LazySpfResult(ticket)
+        fallback = lambda: inner._noted_fallback(  # noqa: E731
+            lambda: inner._oracle.compute(topo, edge_mask)
+        )
+        ticket = pipe.submit(
+            self._key(topo), "one",
+            launch=lambda: _guarded_launch(
+                inner.breaker, "spf.one",
+                lambda: inner.launch_one(topo, edge_mask),
+            ),
+            finish=lambda st: _guarded_finish(
+                st, inner.finish_one, fallback
+            ),
+        )
+        return LazySpfResult(ticket)
+
+    def compute_whatif(self, topo, edge_masks):
+        return self.inner.compute_whatif(topo, edge_masks)
+
+    def compute_multiroot(self, topo, roots):
+        return self.inner.compute_multiroot(topo, roots)
+
+    # -- advisory what-if (the coalescing + breaker-skip seam) ----------
+
+    def compute_whatif_async(self, topo, edge_masks) -> PipelineTicket:
+        """Enqueue an advisory what-if batch.  Returns the ticket;
+        ``result()`` yields the usual list of SpfResults — or None when
+        the batch was skipped (circuit open) or superseded by a newer
+        generation's batch for the same (uid, root)."""
+        inner = self.inner
+        pipe = self.pipeline
+        gen = int(topo.cache_key[1])
+        if pipe is None or pipe.closed:
+            t = PipelineTicket(None, self._key(topo), "whatif", gen)
+            t._complete(inner.compute_whatif(topo, edge_masks))
+            return t
+        return pipe.submit(
+            self._key(topo), "whatif",
+            run=lambda: inner.compute_whatif(topo, edge_masks),
+            generation=gen,
+            coalesce=True,
+            skip_when_open=inner.breaker,
+        )
+
+
+class AsyncFrrEngine:
+    """``FrrEngine`` facade: ``compute`` enqueues the batched
+    backup-table dispatch (split-phase on the tpu engine) and returns a
+    :class:`LazyBackupTable` — SPF and FRR dispatches for one topology
+    then overlap, since the FRR planes derive from the topology, not
+    the SPF result."""
+
+    def __init__(self, inner, pipeline: DispatchPipeline):
+        self.inner = inner
+        self.pipeline = pipeline
+
+    def __getattr__(self, attr):
+        return getattr(self.inner, attr)
+
+    @property
+    def name(self) -> str:
+        return f"{getattr(self.inner, 'engine', 'frr')}-async"
+
+    def compute(self, topo):
+        inner = self.inner
+        pipe = self.pipeline
+        if (
+            pipe is None
+            or pipe.closed
+            or getattr(inner, "engine", "scalar") != "tpu"
+            or inner.breaker.state == "open"  # see AsyncSpfBackend
+        ):
+            return inner.compute(topo)
+        # Distinct ordering domain from the SPF dispatches of the same
+        # topology: FRR reads the resident graph but donates nothing,
+        # and the shared DeviceGraphCache serializes its own mutation
+        # under its lock — so SPF(topo) and FRR(topo) may overlap.
+        # Plane marshal (occupancy gauges included) rides the worker;
+        # the failure path re-marshals for the oracle — paying the
+        # host marshal twice on the RARE failed dispatch beats paying
+        # it on the actor for every healthy one.
+        key = ("frr", topo.cache_key[0], int(topo.root))
+        ticket = pipe.submit(
+            key, "frr",
+            launch=lambda: _guarded_launch(
+                inner.breaker, "frr.batch",
+                lambda: inner._launch_tpu(
+                    topo, inner.marshal_inputs(topo)
+                ),
+            ),
+            finish=lambda st: _guarded_finish(
+                st, inner._finish_tpu,
+                lambda: inner._scalar_fallback(
+                    topo, inner.marshal_inputs(topo)
+                ),
+            ),
+        )
+        return LazyBackupTable(ticket)
+
+
+# -- process-wide singleton --------------------------------------------
+
+_PIPELINE: DispatchPipeline | None = None
+_PIPELINE_LOCK = threading.Lock()
+
+
+def configure_process_pipeline(
+    depth: int = 2, capacity: int = 32, guard=None
+) -> DispatchPipeline:
+    """Install the process-wide dispatch pipeline (daemon boot from
+    ``[pipeline]``; bench/tests call directly).  Closes any previous
+    pipeline first so its worker cannot race the replacement."""
+    global _PIPELINE
+    with _PIPELINE_LOCK:
+        if _PIPELINE is not None:
+            _PIPELINE.close()
+        _PIPELINE = DispatchPipeline(
+            depth=depth, capacity=capacity, name="process", guard=guard
+        )
+        return _PIPELINE
+
+
+def process_pipeline() -> DispatchPipeline | None:
+    return _PIPELINE
+
+
+def reset_process_pipeline() -> None:
+    """Close + uninstall (tests / bench teardown)."""
+    global _PIPELINE
+    with _PIPELINE_LOCK:
+        if _PIPELINE is not None:
+            _PIPELINE.close()
+        _PIPELINE = None
+
+
+def wrap_spf_backend(backend):
+    """Route a TpuSpfBackend through the process pipeline when one is
+    armed; scalar backends and unarmed processes pass through unchanged
+    (the ``[pipeline] enabled=false`` default costs nothing)."""
+    pipe = _PIPELINE
+    if pipe is None or pipe.closed:
+        return backend
+    if backend is None or getattr(backend, "name", "") != "tpu":
+        return backend
+    return AsyncSpfBackend(backend, pipe)
+
+
+def wrap_frr_engine(engine):
+    """FRR analog of :func:`wrap_spf_backend`."""
+    pipe = _PIPELINE
+    if pipe is None or pipe.closed:
+        return engine
+    if engine is None or getattr(engine, "engine", "scalar") != "tpu":
+        return engine
+    return AsyncFrrEngine(engine, pipe)

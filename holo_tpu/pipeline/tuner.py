@@ -1,0 +1,499 @@
+"""Per-shape engine auto-tuner (ISSUE 9 tentpole, part b).
+
+The bench sweep history shows the winning gather-path fixpoint engine
+flips with topology size — ``seq`` 1481 vs ``fused`` 464 vs ``hybrid``
+892 runs/s on small jaxcpu graphs while ``gather``-family engines at
+big V behave differently again on TPU (BENCH r02-r04) — yet the engine
+has been a static config knob (``TpuSpfBackend(one_engine=...)``).
+This module turns it into a measured decision per **shape bucket**:
+
+    bucket = (pow2(V), pow2(E), pow2(batch), mesh identity)
+
+For each (kind, bucket) the tuner runs a deterministic explore/exploit
+schedule over the parity-identical engine set (every engine computes
+the bit-exact same SPF, so flipping engines can never change routing
+state — only latency):
+
+- **explore** — until every candidate engine has ``explore_rounds``
+  measured dispatches, pick engines round-robin, ordered by the
+  compile-time ``cost_analysis()`` prior when one was captured
+  (cheapest estimated bytes first — the profile-guided search-space
+  cut of Bounded Dijkstra, arXiv:1903.00436, applied to engine
+  selection);
+- **exploit** — pick the engine with the lowest measured median wall;
+  every ``reprobe_every`` dispatches one non-winner is re-measured
+  (round-robin) so a drifting platform can flip the winner back.
+
+Decisions, promotions (winner changes), and the exploration phase are
+all counted in the ``holo_pipeline_tuner_*`` metric family.
+
+The same per-bucket table also carries the DeltaPath depth knob
+(ROADMAP item 1 follow-up): the backend feeds measured ``delta``-stage
+vs full-rebuild walls per bucket, and
+:meth:`EngineTuner.max_delta_depth` derives the chain-depth cap from
+their ratio — a bucket whose in-place delta is 40x cheaper than a
+re-marshal can afford a much longer chain than one where the delta
+barely wins (`holo_tpu.ops.spf_engine.DeviceGraphCache` consults this
+through :func:`active_tuner`).
+
+Persistence: the whole table round-trips through a **versioned** JSON
+file (``[pipeline] tuner-cache`` in holod.toml) written atomically
+(tmp + rename), so a restarted daemon starts in the exploit phase with
+the learned winners instead of re-learning them ("restarts don't
+re-learn"); a version bump discards stale tables wholesale.
+
+Everything here is import-light (telemetry + stdlib) and O(1) per
+decision: the hot path pays two dict hits and a deque median over at
+most ``SAMPLE_WINDOW`` floats.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from pathlib import Path
+
+from holo_tpu import telemetry
+
+log = logging.getLogger("holo_tpu.pipeline.tuner")
+
+#: persisted-table format version: bump to invalidate old tables
+TABLE_VERSION = 1
+
+#: gather-path fixpoint engines (all bit-identical; see ops/spf_engine)
+ENGINES = ("seq", "fused", "packed", "hybrid")
+
+#: measured samples retained per (kind, bucket, engine) — medians over
+#: a short window track platform drift without unbounded memory
+SAMPLE_WINDOW = 9
+
+#: DeltaPath depth-cap derivation bounds (satellite: auto-tuned
+#: max_delta_depth).  depth = clamp(round(full/delta) * DEPTH_SCALE).
+DEPTH_SCALE = 32
+DEPTH_MIN = 32
+DEPTH_MAX = 4096
+#: samples of each arm required before the cap leaves the default
+DEPTH_MIN_SAMPLES = 3
+
+_DECISIONS = telemetry.counter(
+    "holo_pipeline_tuner_decisions_total",
+    "Engine-tuner picks by schedule phase",
+    ("kind", "engine", "phase"),
+)
+_PROMOTIONS = telemetry.counter(
+    "holo_pipeline_tuner_promotions_total",
+    "Shape buckets whose measured winner changed",
+    ("kind",),
+)
+_BUCKETS = telemetry.gauge(
+    "holo_pipeline_tuner_buckets",
+    "Shape buckets the tuner currently tracks",
+)
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (bucket quantization; >= 1)."""
+    out = 1
+    n = max(int(n), 1)
+    while out < n:
+        out *= 2
+    return out
+
+
+def shape_bucket(
+    n_vertices: int, n_edges: int, batch: int = 1, mesh=None
+) -> tuple:
+    """The tuner's shape key: pow2-quantized (V, E, batch) + the mesh
+    identity (the same shapes under a different sharding are a
+    different XLA program — see ``TpuSpfBackend._track_compile``)."""
+    return (_pow2(n_vertices), _pow2(n_edges), _pow2(batch), mesh)
+
+
+def _median(vals) -> float | None:
+    """Lower median: with an even sample count, prefer the smaller
+    middle value — stray one-off spikes (GC, scheduler) must not
+    outvote a warm measurement in a 2-sample window."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    return float(s[(len(s) - 1) // 2])
+
+
+class _BucketState:
+    """Per-(kind, bucket) tuner state (mutated under the tuner lock)."""
+
+    __slots__ = ("dispatches", "samples", "cost", "winner", "explored")
+
+    def __init__(self):
+        self.dispatches = 0
+        # engine -> deque of measured wall seconds (most recent last)
+        self.samples: dict[str, deque] = {}
+        # engine -> {"flops": f, "bytes": b} compile-time prior
+        self.cost: dict[str, dict] = {}
+        self.winner: str | None = None
+        self.explored = 0  # decisions spent in the explore phase
+
+
+class EngineTuner:
+    """Measured per-shape engine selection + DeltaPath depth tuning.
+
+    Thread-shared (instance threads dispatch concurrently under
+    ``[runtime] isolation=threaded``; the pipeline worker observes from
+    its own thread): all state mutates under one lock, decisions are
+    O(1), and nothing here ever touches a device value.
+    """
+
+    def __init__(
+        self,
+        path: str | Path | None = None,
+        engines: tuple[str, ...] = ENGINES,
+        explore_rounds: int = 2,
+        reprobe_every: int = 64,
+        default_engine: str = "seq",
+        default_delta_depth: int = 256,
+    ):
+        self.engines = tuple(engines)
+        self.explore_rounds = int(explore_rounds)
+        self.reprobe_every = int(reprobe_every)
+        self.default_engine = default_engine
+        self.default_delta_depth = int(default_delta_depth)
+        self.path = Path(path) if path is not None else None
+        self._lock = threading.Lock()
+        self._table: dict[tuple, _BucketState] = {}
+        # (bucket) -> {"delta": deque, "full": deque} stage walls
+        self._depth: dict[tuple, dict[str, deque]] = {}
+        self._promotions = 0
+        self._loaded = False
+        if self.path is not None:
+            self.load()
+
+    # -- keys ----------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, bucket: tuple) -> tuple:
+        return (str(kind), *bucket)
+
+    def _state(self, key: tuple) -> _BucketState:
+        st = self._table.get(key)
+        if st is None:
+            st = self._table[key] = _BucketState()
+            _BUCKETS.set(len(self._table))
+        return st
+
+    # -- engine selection ----------------------------------------------
+
+    def pick(self, kind: str, bucket: tuple) -> str:
+        """The engine this dispatch should run.  Deterministic: the
+        schedule depends only on the bucket's dispatch counter and the
+        recorded samples, never on an RNG — two daemons replaying the
+        same dispatch sequence make identical choices."""
+        key = self._key(kind, bucket)
+        with self._lock:
+            st = self._state(key)
+            st.dispatches += 1
+            # Explore until every engine has explore_rounds samples.
+            needy = [
+                e
+                for e in self._explore_order(st)
+                if len(st.samples.get(e, ())) < self.explore_rounds
+            ]
+            if needy:
+                engine = needy[st.explored % len(needy)]
+                st.explored += 1
+                phase = "explore"
+            else:
+                winner = self._winner_locked(st)
+                if (
+                    self.reprobe_every
+                    and st.dispatches % self.reprobe_every == 0
+                    and len(self.engines) > 1
+                ):
+                    # Deterministic round-robin over the non-winners.
+                    others = [e for e in self.engines if e != winner]
+                    engine = others[
+                        (st.dispatches // self.reprobe_every) % len(others)
+                    ]
+                    phase = "reprobe"
+                else:
+                    engine = winner
+                    phase = "exploit"
+        _DECISIONS.labels(kind=kind, engine=engine, phase=phase).inc()
+        return engine
+
+    def _explore_order(self, st: _BucketState) -> tuple[str, ...]:
+        """Candidate order for exploration: engines with a compile-time
+        cost prior first, cheapest estimated bytes-accessed leading —
+        the likely winner gets measured earliest, so even a truncated
+        explore phase tends to have sampled it."""
+        if not st.cost:
+            return self.engines
+        return tuple(
+            sorted(
+                self.engines,
+                key=lambda e: st.cost.get(e, {}).get("bytes", float("inf")),
+            )
+        )
+
+    def _winner_locked(self, st: _BucketState) -> str:
+        best, best_med = None, None
+        for e in self.engines:
+            med = _median(st.samples.get(e))
+            if med is not None and (best_med is None or med < best_med):
+                best, best_med = e, med
+        return best if best is not None else self.default_engine
+
+    def observe(
+        self, kind: str, bucket: tuple, engine: str, seconds: float
+    ) -> None:
+        """Record one measured dispatch wall for (bucket, engine); a
+        winner change is a promotion (counted, and the table is
+        persisted so the restart picks it cold)."""
+        key = self._key(kind, bucket)
+        promoted = False
+        with self._lock:
+            st = self._state(key)
+            dq = st.samples.get(engine)
+            if dq is None:
+                dq = st.samples[engine] = deque(maxlen=SAMPLE_WINDOW)
+            dq.append(float(seconds))
+            new_winner = self._winner_locked(st)
+            if new_winner != st.winner:
+                promoted = st.winner is not None
+                st.winner = new_winner
+                if promoted:
+                    self._promotions += 1
+        if promoted:
+            _PROMOTIONS.labels(kind=kind).inc()
+            self.save()
+
+    def cost_prior(
+        self, kind: str, bucket: tuple, engine: str, entry: dict | None
+    ) -> None:
+        """Attach a compile-time ``cost_analysis()`` estimate (the
+        backends call this right after a fresh jit compile — see
+        ``profiling.record_cost``).  None is a no-op (platforms without
+        cost analysis)."""
+        if not entry:
+            return
+        key = self._key(kind, bucket)
+        with self._lock:
+            self._state(key).cost[engine] = {
+                "flops": float(entry.get("flops", 0.0)),
+                "bytes": float(entry.get("bytes", 0.0)),
+            }
+
+    # -- DeltaPath depth tuning ----------------------------------------
+
+    def observe_delta(self, bucket: tuple, seconds: float) -> None:
+        """One measured incremental (delta-path) dispatch wall."""
+        self._observe_depth(bucket, "delta", seconds)
+
+    def observe_full(self, bucket: tuple, seconds: float) -> None:
+        """One measured full-rebuild (re-marshal) dispatch wall."""
+        self._observe_depth(bucket, "full", seconds)
+
+    def _observe_depth(self, bucket: tuple, arm: str, seconds: float) -> None:
+        with self._lock:
+            d = self._depth.setdefault(
+                tuple(bucket),
+                {
+                    "delta": deque(maxlen=SAMPLE_WINDOW),
+                    "full": deque(maxlen=SAMPLE_WINDOW),
+                },
+            )
+            d[arm].append(float(seconds))
+
+    def max_delta_depth(self, bucket: tuple, default: int | None = None) -> int:
+        """The chain-depth cap for this shape bucket: proportional to
+        how much cheaper the measured delta path is than a full
+        rebuild (clamped to [DEPTH_MIN, DEPTH_MAX]).  Until both arms
+        have DEPTH_MIN_SAMPLES per-bucket measurements, fall back to
+        the process-wide ``holo_profile_stage_seconds`` medians of the
+        ``delta`` vs ``marshal`` stages (the PR 7 profiling data that
+        motivated this satellite) when device profiling is armed, and
+        to ``default`` otherwise."""
+        if default is None:
+            default = self.default_delta_depth
+        with self._lock:
+            d = self._depth.get(tuple(bucket))
+            delta_med = _median(d["delta"]) if d else None
+            full_med = _median(d["full"]) if d else None
+            enough = d is not None and (
+                len(d["delta"]) >= DEPTH_MIN_SAMPLES
+                and len(d["full"]) >= DEPTH_MIN_SAMPLES
+            )
+        if not enough or not delta_med or full_med is None:
+            # Global fallback: the aggregate delta vs marshal stage
+            # medians — shape-blind, but directionally right for a
+            # bucket the backend has not measured yet.
+            from holo_tpu.telemetry import profiling
+
+            delta_med = profiling.stage_median("spf.one", "delta")
+            full_med = profiling.stage_median("spf.one", "marshal")
+            if not delta_med or full_med is None:
+                return int(default)
+        ratio = max(full_med / delta_med, 1.0)
+        return max(DEPTH_MIN, min(DEPTH_MAX, int(round(ratio)) * DEPTH_SCALE))
+
+    # -- persistence ----------------------------------------------------
+
+    @staticmethod
+    def _bucket_str(key: tuple) -> str:
+        return json.dumps(list(key))
+
+    @staticmethod
+    def _bucket_from_str(s: str) -> tuple:
+        out = []
+        for v in json.loads(s):
+            out.append(tuple(v) if isinstance(v, list) else v)
+        return tuple(out)
+
+    def snapshot(self) -> dict:
+        """The persisted document (also the debugging surface)."""
+        with self._lock:
+            buckets = {}
+            for key, st in self._table.items():
+                buckets[self._bucket_str(key)] = {
+                    "dispatches": st.dispatches,
+                    "winner": st.winner,
+                    "samples": {
+                        e: [round(v, 9) for v in dq]
+                        for e, dq in st.samples.items()
+                    },
+                    "cost": dict(st.cost),
+                }
+            depth = {
+                self._bucket_str(b): {
+                    arm: [round(v, 9) for v in dq] for arm, dq in d.items()
+                }
+                for b, d in self._depth.items()
+            }
+        return {
+            "version": TABLE_VERSION,
+            "engines": list(self.engines),
+            "buckets": buckets,
+            "depth": depth,
+        }
+
+    def save(self, path: str | Path | None = None) -> bool:
+        """Atomic write (tmp + rename) of the versioned table; False
+        when no path is configured.  Never raises: a full disk must not
+        take an SPF dispatch down."""
+        p = Path(path) if path is not None else self.path
+        if p is None:
+            return False
+        try:
+            doc = json.dumps(self.snapshot(), sort_keys=True, indent=1)
+            tmp = p.with_suffix(p.suffix + ".tmp")
+            tmp.write_text(doc + "\n")
+            os.replace(tmp, p)
+            return True
+        except OSError as e:
+            log.warning("tuner table save to %s failed: %s", p, e)
+            return False
+
+    def load(self, path: str | Path | None = None) -> bool:
+        """Load a persisted table; version mismatch or a corrupt file
+        discards it (the tuner just re-learns).  Returns True when
+        state was restored."""
+        p = Path(path) if path is not None else self.path
+        if p is None or not p.exists():
+            return False
+        try:
+            doc = json.loads(p.read_text())
+        except (OSError, ValueError) as e:
+            log.warning("tuner table load from %s failed: %s", p, e)
+            return False
+        if doc.get("version") != TABLE_VERSION:
+            log.info(
+                "tuner table %s has version %r (want %d); discarding",
+                p, doc.get("version"), TABLE_VERSION,
+            )
+            return False
+        with self._lock:
+            self._table.clear()
+            for bstr, entry in doc.get("buckets", {}).items():
+                try:
+                    key = self._bucket_from_str(bstr)
+                except ValueError:
+                    continue
+                st = _BucketState()
+                st.dispatches = int(entry.get("dispatches", 0))
+                st.winner = entry.get("winner")
+                for e, vals in entry.get("samples", {}).items():
+                    st.samples[e] = deque(
+                        [float(v) for v in vals], maxlen=SAMPLE_WINDOW
+                    )
+                st.cost = {
+                    e: dict(c) for e, c in entry.get("cost", {}).items()
+                }
+                self._table[key] = st
+            self._depth.clear()
+            for bstr, d in doc.get("depth", {}).items():
+                try:
+                    b = self._bucket_from_str(bstr)
+                except ValueError:
+                    continue
+                self._depth[b] = {
+                    arm: deque(
+                        [float(v) for v in vals], maxlen=SAMPLE_WINDOW
+                    )
+                    for arm, vals in d.items()
+                }
+            _BUCKETS.set(len(self._table))
+            self._loaded = True
+        return True
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> dict:
+        """holo-telemetry state-leaf / bench view."""
+        with self._lock:
+            winners = {}
+            for key, st in self._table.items():
+                winners[self._bucket_str(key)] = {
+                    "winner": st.winner or self.default_engine,
+                    "dispatches": st.dispatches,
+                    "measured-engines": sorted(st.samples),
+                }
+            return {
+                "buckets": len(self._table),
+                "promotions": self._promotions,
+                "loaded-from-disk": self._loaded,
+                "path": str(self.path) if self.path else None,
+                "winners": winners,
+                "depth-buckets": len(self._depth),
+            }
+
+
+# -- process-wide singleton --------------------------------------------
+
+_TUNER: EngineTuner | None = None
+_TUNER_LOCK = threading.Lock()
+
+
+def configure_engine_tuner(
+    path: str | Path | None = None, **kw
+) -> EngineTuner:
+    """Install the process-wide tuner (daemon boot from ``[pipeline]``;
+    bench/tests call directly).  Replaces any previous tuner."""
+    global _TUNER
+    with _TUNER_LOCK:
+        _TUNER = EngineTuner(path=path, **kw)
+        return _TUNER
+
+
+def active_tuner() -> EngineTuner | None:
+    """The installed tuner, or None (backends then keep their pinned
+    engine and DeviceGraphCache its static depth cap)."""
+    return _TUNER
+
+
+def reset_engine_tuner() -> None:
+    """Uninstall (tests / bench teardown)."""
+    global _TUNER
+    with _TUNER_LOCK:
+        _TUNER = None

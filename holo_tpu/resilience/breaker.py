@@ -315,6 +315,17 @@ class CircuitBreaker:
         self._on_success()
         return result
 
+    # -- split-phase guard (pipelined dispatch, ISSUE 9)
+
+    def split(self, context: str = "") -> "SplitGuard":
+        """The :meth:`call` contract unbundled for two-phase (launch /
+        finish) dispatch: the async pipeline admits at launch time,
+        reports a failure from either phase, and records success —
+        with the deadline measured across BOTH phases — at finish.
+        The caller owns running the fallback when not admitted or
+        after a failure; see ``pipeline/dispatch.py``."""
+        return SplitGuard(self, context)
+
     def snapshot(self) -> dict:
         """Health-leaf view (served under holo-telemetry/health)."""
         with self._lock:
@@ -325,3 +336,65 @@ class CircuitBreaker:
                 "recovery-timeout": self.recovery_timeout,
                 "last-error": self.last_error or "",
             }
+
+
+class SplitGuard:
+    """One guarded dispatch split across two phases (see
+    :meth:`CircuitBreaker.split`).
+
+    Lifecycle: construct (admits or refuses), then exactly one of
+    :meth:`failure` / :meth:`success` / :meth:`abort`.  ``admitted``
+    False means the circuit is open — the caller must serve the
+    dispatch from the fallback (the ``cause="open"`` fallback counter
+    has already been bumped, matching :meth:`CircuitBreaker.call`).  A
+    disabled breaker admits unconditionally and records nothing.
+    """
+
+    __slots__ = ("breaker", "context", "admitted", "_t0", "_settled")
+
+    def __init__(self, breaker: CircuitBreaker, context: str = ""):
+        self.breaker = breaker
+        self.context = context
+        self._settled = breaker.enabled is False
+        self._t0 = breaker._clock()
+        if not breaker.enabled:
+            self.admitted = True
+        else:
+            self.admitted = breaker._admit()
+            if not self.admitted:
+                _FALLBACKS.labels(breaker=breaker.name, cause="open").inc()
+                self._settled = True
+
+    def failure(self, exc: BaseException, cause: str = "exception") -> None:
+        """A phase failed with a device-shaped error: count it (the
+        caller then runs the bit-identical fallback)."""
+        if self._settled:
+            return
+        self._settled = True
+        self.breaker._on_failure(cause, exc)
+        _FALLBACKS.labels(breaker=self.breaker.name, cause=cause).inc()
+
+    def abort(self) -> None:
+        """A passthrough (bug-class) exception escaped with no device
+        verdict: release the half-open probe slot, record nothing."""
+        if self._settled:
+            return
+        self._settled = True
+        self.breaker._abort_probe()
+
+    def success(self) -> None:
+        """Both phases completed.  The deadline budget spans launch
+        through finish — exactly the window :meth:`CircuitBreaker.call`
+        measures around its primary."""
+        if self._settled:
+            return
+        self._settled = True
+        b = self.breaker
+        elapsed = b._clock() - self._t0
+        if b.deadline is not None and elapsed > b.deadline:
+            b._on_failure(
+                "deadline",
+                DeadlineOverrun(f"{elapsed:.3f}s > {b.deadline}s"),
+            )
+            return
+        b._on_success()

@@ -64,6 +64,13 @@ class FaultPlan:
     timer_jitter: float = 0.0  # +/- fraction for jittered_advance steps
     dispatch_fail: dict = field(default_factory=dict)  # site -> count
     dispatch_fail_prob: float = 0.0
+    # BGP TCP transport seams (utils/tcpio.py, ISSUE 9 satellite):
+    # injected connection resets (the session tears down exactly like a
+    # peer RST — the FSM must re-establish and reconverge) and partial
+    # writes (socket sends capped to a few bytes per call — framing
+    # must reassemble across arbitrarily fragmented tx).
+    tcp_reset_prob: float = 0.0
+    tcp_partial_write_prob: float = 0.0
 
     def rng(self, site: str) -> random.Random:
         """Independent deterministic stream for one seam site."""
@@ -102,6 +109,31 @@ class FaultInjector:
         if p and self._rng(f"dispatch:{site}").random() < p:
             self._record(site)
             raise InjectedFault(f"random dispatch failure at {site}")
+
+    # -- BGP TCP transport seams (utils/tcpio.py)
+
+    def tcp_reset(self, site: str = "tcp.reset") -> bool:
+        """True when this socket operation should tear the session down
+        (injected connection reset)."""
+        p = self.plan.tcp_reset_prob
+        if p and self._rng(site).random() < p:
+            self._record(site)
+            return True
+        return False
+
+    def tcp_send_cap(self, n: int) -> int:
+        """Bytes this socket send may actually write: ``n`` normally, a
+        deterministic small cap (1..16) when a partial write fires —
+        the kernel-buffer-full fragmentation the framing layer must
+        reassemble across."""
+        p = self.plan.tcp_partial_write_prob
+        if not p or n <= 1:
+            return n
+        rng = self._rng("tcp.partial")
+        if rng.random() < p:
+            self._record("tcp.partial")
+            return min(n, 1 + rng.randrange(16))
+        return n
 
     # -- wire seams
 

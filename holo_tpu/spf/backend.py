@@ -126,6 +126,31 @@ class MultiRootResult:
     hops: np.ndarray  # int32[R, N]
 
 
+@dataclass
+class _InFlightOne:
+    """Phase-1 state of a split (pipelined) kind=one dispatch — see
+    ``TpuSpfBackend.launch_one`` / ``finish_one``."""
+
+    out: object  # device SpfTensors, dispatch possibly still in flight
+    topo: Topology
+    t0: float
+    engine: str
+    bucket: tuple | None
+    mode: str  # "full" | "delta"
+    n_atoms: int
+    delta_kind: str = ""
+    remember: bool = False
+    sharded: bool = False
+    remarshal: bool = False
+    fresh: bool = False  # fresh XLA compile: not a tuner sample
+    # Wall of the launch phase alone: tuner samples use launch_s +
+    # finish wall, EXCLUDING the time the entry sat parked in the
+    # pipeline's in-flight slot while the worker served other keys —
+    # parked time is scheduling, not engine cost, and would bias both
+    # the engine medians and the delta/full depth ratio.
+    launch_s: float = 0.0
+
+
 class SpfBackend:
     """Interface: one SPF run, a what-if batch, or a multi-root batch."""
 
@@ -265,15 +290,14 @@ class TpuSpfBackend(SpfBackend):
         # device-resident seed state of the incremental kernel.  The
         # entry is DONATED into the kernel that consumes it.
         self._prev_one: dict[tuple, object] = {}
-        from holo_tpu.ops.spf_engine import _ONE_ENGINES
-
-        one = _ONE_ENGINES[one_engine]
-        self._jit_one = jax.jit(lambda g, r, m: one(g, r, m, self.max_iters))
-        self._jit_batch = jax.jit(
-            lambda g, r, ms: spf_whatif_batch(
-                g, r, ms, self.max_iters, engine=one_engine
-            )
-        )
+        # Gather-path jits, one per fixpoint engine (lazily built):
+        # the engine auto-tuner (holo_tpu.pipeline.tuner) flips the
+        # formulation per shape bucket at dispatch time, so the pinned
+        # ``one_engine`` is only the untuned default.  All engines are
+        # bit-identical (parity-gated), so a flip is a latency choice,
+        # never a semantic one.
+        self._one_jits: dict[str, object] = {}
+        self._batch_jits: dict[str, object] = {}
         self._jit_multiroot = jax.jit(
             lambda g, rs, m: spf_multiroot(g, rs, m, self.max_iters)
         )
@@ -283,23 +307,116 @@ class TpuSpfBackend(SpfBackend):
             ),
             donate_argnums=(2,),
         )
-        # Mesh-sharded dispatch programs, built lazily per (kind, mesh
-        # identity): outputs pinned to the batch sharding so GSPMD
-        # propagates the scenario/root split through the whole program.
+        # What the last prepare() actually did ('hit'/'delta'/'miss'):
+        # the depth auto-tuner attributes full-rebuild walls to cache
+        # misses only (a warm hit is not a re-marshal cost).
+        self._last_prepare_how = ""
+        # Mesh-sharded dispatch programs, built lazily per (kind,
+        # engine, mesh identity): outputs pinned to the batch sharding
+        # so GSPMD propagates the scenario/root split through the whole
+        # program.
         self._shard_jits: dict[tuple, object] = {}
 
-    def _sharded_whatif(self, mesh):
+    def _jit_one_for(self, engine: str):
+        fn = self._one_jits.get(engine)
+        if fn is None:
+            from holo_tpu.ops.spf_engine import _ONE_ENGINES
+
+            one = _ONE_ENGINES[engine]
+            fn = self._one_jits[engine] = jax.jit(
+                lambda g, r, m: one(g, r, m, self.max_iters)
+            )
+        return fn
+
+    def _jit_batch_for(self, engine: str):
+        fn = self._batch_jits.get(engine)
+        if fn is None:
+            fn = self._batch_jits[engine] = jax.jit(
+                lambda g, r, ms: spf_whatif_batch(
+                    g, r, ms, self.max_iters, engine=engine
+                )
+            )
+        return fn
+
+    # Kept as properties: external probes (tests, cost tooling) and the
+    # degenerate-mesh routing below still read the pinned-engine jits.
+    @property
+    def _jit_one(self):
+        return self._jit_one_for(self.one_engine)
+
+    @property
+    def _jit_batch(self):
+        return self._jit_batch_for(self.one_engine)
+
+    def _pick_engine(self, kind: str, topo, batch: int = 1):
+        """(engine, shape bucket | None) for this dispatch: the
+        process engine tuner's per-shape choice when one is armed, else
+        the pinned ``one_engine``.  Lazy import keeps the unarmed path
+        at a sys.modules hit (pipeline_overhead gate)."""
+        from holo_tpu.pipeline.tuner import active_tuner, shape_bucket
+
+        t = active_tuner()
+        if t is None or self.engine == "blocked":
+            return self.one_engine, None
+        bucket = shape_bucket(
+            topo.n_vertices, topo.n_edges, batch, _mesh_key()
+        )
+        return t.pick(kind, bucket), bucket
+
+    @staticmethod
+    def _tuner_observe(kind, bucket, engine, seconds) -> None:
+        if bucket is None:
+            return
+        from holo_tpu.pipeline.tuner import active_tuner
+
+        t = active_tuner()
+        if t is not None:
+            t.observe(kind, bucket, engine, seconds)
+
+    @staticmethod
+    def _tuner_cost(kind, bucket, engine, entry) -> None:
+        if bucket is None or entry is None:
+            return
+        from holo_tpu.pipeline.tuner import active_tuner
+
+        t = active_tuner()
+        if t is not None:
+            t.cost_prior(kind, bucket, engine, entry)
+
+    def _depth_bucket(self, topo):
+        """The DeltaPath depth-tuning bucket (kind=one, batch=1)."""
+        from holo_tpu.pipeline.tuner import shape_bucket
+
+        return shape_bucket(topo.n_vertices, topo.n_edges, 1, _mesh_key())
+
+    def _tuner_depth_observe(self, topo, arm: str, seconds: float) -> None:
+        """Feed a measured delta-path / full-rebuild wall into the
+        persisted tuner table (the per-shape max_delta_depth input)."""
+        from holo_tpu.pipeline.tuner import active_tuner
+
+        t = active_tuner()
+        if t is None:
+            return
+        b = self._depth_bucket(topo)
+        if arm == "delta":
+            t.observe_delta(b, seconds)
+        else:
+            t.observe_full(b, seconds)
+
+    def _sharded_whatif(self, mesh, engine: str | None = None):
+        if engine is None:
+            engine = self.one_engine
         if mesh.size == 1:
             # Degenerate mesh: the plain program IS the sharded program
             # (mesh.constrain_batch would be a no-op) — reuse its jit
             # cache so the 1-device mesh costs nothing but the routing.
-            return self._jit_batch
+            return self._jit_batch_for(engine)
         from holo_tpu.parallel.mesh import mesh_cache_key, sharded_whatif_jit
 
-        key = ("whatif", mesh_cache_key(mesh))
+        key = ("whatif", engine, mesh_cache_key(mesh))
         fn = self._shard_jits.get(key)
         if fn is None:
-            fn = sharded_whatif_jit(mesh, self.max_iters, self.one_engine)
+            fn = sharded_whatif_jit(mesh, self.max_iters, engine)
             self._shard_jits[key] = fn
         return fn
 
@@ -342,6 +459,7 @@ class TpuSpfBackend(SpfBackend):
             allow_delta=allow_delta,
         )
         _GRAPH_CACHE.labels(result=how).inc()
+        self._last_prepare_how = how
         return g
 
     def _remember(self, topo: Topology, n_atoms: int, out) -> None:
@@ -359,13 +477,15 @@ class TpuSpfBackend(SpfBackend):
         while len(self._prev_one) > self.prev_capacity:
             self._prev_one.pop(next(iter(self._prev_one)))
 
-    def _track_compile(self, kind: str, *shape) -> bool:
+    def _track_compile(self, kind: str, engine: str, *shape) -> bool:
         """Returns True when this (engine, shape) bucket is fresh — a
         real XLA compile, and the moment to capture its cost analysis.
+        ``engine`` is the fixpoint formulation actually dispatched (the
+        tuner may differ from the pinned one_engine per shape bucket).
         Callers append the process-mesh identity to ``shape``: the same
         shapes under a different sharding are a different XLA program,
         and the cost-analysis table keys on the same signature."""
-        sig = (kind, self.one_engine, *shape)
+        sig = (kind, engine, *shape)
         if sig in self._compiled_shapes:
             _JIT_HITS.labels(kind=kind).inc()
             return False
@@ -441,6 +561,7 @@ class TpuSpfBackend(SpfBackend):
             if res is not None:
                 return res
         t0 = time.perf_counter()
+        engine, bucket = self._pick_engine("one", topo)
         with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
             # THE sanctioned marshal boundary: host graph + root + mask
             # move to device here and nowhere else (transfer_guard
@@ -453,18 +574,20 @@ class TpuSpfBackend(SpfBackend):
                     g = self.prepare(
                         topo, need_edge_ids=edge_mask is not None
                     )
+                    remarshal = self._last_prepare_how == "miss"
                     mask = self._full_mask(topo, edge_mask)
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        topo.n_edges, _mesh_key(),
+                        topo.n_edges, _mesh_key(), engine,
                     )
-                    fresh = self._track_compile("one", *sig)
-                    out = self._jit_one(g, topo.root, mask)
+                    fresh = self._track_compile("one", engine, *sig)
+                    out = self._jit_one_for(engine)(g, topo.root, mask)
             if fresh:
-                profiling.record_cost(
-                    "spf.one", self._jit_one, g, topo.root, mask,
-                    shape_sig=sig,
+                entry = profiling.record_cost(
+                    "spf.one", self._jit_one_for(engine), g, topo.root,
+                    mask, shape_sig=sig,
                 )
+                self._tuner_cost("one", bucket, engine, entry)
             with profiling.stage("spf.one", "device"):
                 with profiling.annotation("spf.one.device"):
                     if not profiling.device_stages("spf.one", out):
@@ -485,6 +608,15 @@ class TpuSpfBackend(SpfBackend):
         if mesh is not None:
             _SHARD_DISPATCHES.labels(kind="one").inc()
         convergence.note_dispatch("spf", "device")
+        if not fresh:
+            # Fresh-compile dispatches carry one-off XLA compile wall:
+            # feeding them to the tuner would let compile spikes outvote
+            # the steady-state cost the decision is about.
+            self._tuner_observe("one", bucket, engine, t2 - t0)
+        if remarshal and edge_mask is None:
+            # A full re-marshal paid: the depth tuner's "full" arm (the
+            # cost a deeper delta chain would have avoided).
+            self._tuner_depth_observe(topo, "full", t2 - t0)
         if edge_mask is None and self.incremental:
             # Disarmed backends skip retention: they could never
             # consume the tensors, and the incremental_overhead gate
@@ -553,7 +685,7 @@ class TpuSpfBackend(SpfBackend):
                         g.in_src.shape, g.direct_nh_words.shape[2], pad,
                         _mesh_key(),
                     )
-                    fresh = self._track_compile("delta", *sig)
+                    fresh = self._track_compile("delta", "incr", *sig)
                     # The previous tensors are DONATED into the kernel:
                     # drop our reference first so a failed dispatch can
                     # never leave a consumed entry behind.
@@ -585,6 +717,9 @@ class TpuSpfBackend(SpfBackend):
             _SHARD_DISPATCHES.labels(kind="one").inc()
         convergence.note_dispatch("spf", "device")
         note_delta(delta.kind, "incremental")
+        # The depth tuner's "delta" arm: what an in-place update +
+        # seeded recompute actually costs at this shape.
+        self._tuner_depth_observe(topo, "delta", t2 - t0)
         self._remember(topo, n_atoms, out)
         return res
 
@@ -634,7 +769,9 @@ class TpuSpfBackend(SpfBackend):
             batch=len(edge_masks),
         ):
             with profiling.stage("spf.blocked", "marshal"):
-                fresh = self._track_compile("blocked", fdst.shape, fid.shape)
+                fresh = self._track_compile(
+                    "blocked", "blocked", fdst.shape, fid.shape
+                )
                 with sanctioned_transfer("spf.blocked.dispatch"):
                     out = self._jit_blocked(g, fdst, fid)
             if fresh:
@@ -677,6 +814,7 @@ class TpuSpfBackend(SpfBackend):
                 return res
         B = len(edge_masks)
         t0 = time.perf_counter()
+        engine, bucket = self._pick_engine("whatif", topo, B)
         with telemetry.span(
             "spf.dispatch", kind="whatif", backend="tpu", batch=B,
         ):
@@ -698,21 +836,22 @@ class TpuSpfBackend(SpfBackend):
                         from holo_tpu.parallel.mesh import shard_scenarios
 
                         masks_dev = shard_scenarios(mesh, masks)
-                        step = self._sharded_whatif(mesh)
+                        step = self._sharded_whatif(mesh, engine)
                     else:
                         masks_dev = masks
-                        step = self._jit_batch
+                        step = self._jit_batch_for(engine)
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        masks_dev.shape, _mesh_key(),
+                        masks_dev.shape, _mesh_key(), engine,
                     )
-                    fresh = self._track_compile("whatif", *sig)
+                    fresh = self._track_compile("whatif", engine, *sig)
                     out = step(g, topo.root, masks_dev)
             if fresh:
-                profiling.record_cost(
+                entry = profiling.record_cost(
                     "spf.whatif", step, g, topo.root, masks_dev,
                     shape_sig=sig,
                 )
+                self._tuner_cost("whatif", bucket, engine, entry)
             with profiling.stage("spf.whatif", "device"):
                 with profiling.annotation("spf.whatif.device"):
                     if not profiling.device_stages("spf.whatif", out):
@@ -732,6 +871,8 @@ class TpuSpfBackend(SpfBackend):
         if mesh is not None:
             _SHARD_DISPATCHES.labels(kind="whatif").inc()
         convergence.note_dispatch("spf", "device")
+        if not fresh:  # see _device_compute: no compile-spike samples
+            self._tuner_observe("whatif", bucket, engine, t2 - t0)
         # Slice off the batch-pad rows (sharded dispatch pads B up to a
         # multiple of the mesh batch axis) — [:B] is a no-op otherwise.
         return [
@@ -775,7 +916,7 @@ class TpuSpfBackend(SpfBackend):
                         g.in_src.shape, g.direct_nh_words.shape[2],
                         roots_dev.shape[0], topo.n_edges, _mesh_key(),
                     )
-                    fresh = self._track_compile("multiroot", *sig)
+                    fresh = self._track_compile("multiroot", "seq", *sig)
                     mask = np.ones(topo.n_edges, bool)
                     out = step(g, roots_dev, mask)
             if fresh:
@@ -803,4 +944,166 @@ class TpuSpfBackend(SpfBackend):
         if mesh is not None:
             _SHARD_DISPATCHES.labels(kind="multiroot").inc()
         convergence.note_dispatch("spf", "device")
+        return res
+
+    # -- split-phase dispatch (the pipeline seam, ISSUE 9) --------------
+    #
+    # launch_one() performs everything host-side-blocking (chaos seams,
+    # marshal or DeltaPath in-place update + donation, the ASYNC jit
+    # call) and returns an in-flight handle; finish_one() pays the
+    # device completion + readback and the accounting.  Between the
+    # two, the device executes while the pipeline worker launches the
+    # next entry — the overlap the double buffer exists for.  The
+    # phases emit separate `spf.launch` / `spf.finish` spans instead of
+    # one enclosing `spf.dispatch` span: the worker interleaves other
+    # items' phases on its one thread, and a straddling span would
+    # cross the tracer's thread-local nesting.  Results are bit-
+    # identical to _device_compute by construction (same jits, same
+    # readback; parity-gated in tests/test_pipeline.py).
+
+    def launch_one(self, topo, edge_mask=None) -> "_InFlightOne":
+        faults.crashpoint("spf.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            faults.crashpoint("spf.shard")
+        n_atoms = max(self.n_atoms, topo.n_atoms())
+        if edge_mask is None:
+            h = self._launch_incremental(topo, n_atoms)
+            if h is not None:
+                return h
+        t0 = time.perf_counter()
+        engine, bucket = self._pick_engine("one", topo)
+        with telemetry.span(
+            "spf.launch", kind="one", backend="tpu", engine=engine
+        ):
+            with profiling.stage("spf.one", "marshal"):
+                with sanctioned_transfer("spf.one.marshal"):
+                    g = self.prepare(
+                        topo, need_edge_ids=edge_mask is not None
+                    )
+                    remarshal = self._last_prepare_how == "miss"
+                    mask = self._full_mask(topo, edge_mask)
+                    sig = (
+                        g.in_src.shape, g.direct_nh_words.shape[2],
+                        topo.n_edges, _mesh_key(), engine,
+                    )
+                    fresh = self._track_compile("one", engine, *sig)
+                    out = self._jit_one_for(engine)(g, topo.root, mask)
+            if fresh:
+                entry = profiling.record_cost(
+                    "spf.one", self._jit_one_for(engine), g, topo.root,
+                    mask, shape_sig=sig,
+                )
+                self._tuner_cost("one", bucket, engine, entry)
+        return _InFlightOne(
+            out=out, topo=topo, t0=t0, engine=engine, bucket=bucket,
+            mode="full", n_atoms=n_atoms,
+            remember=edge_mask is None and self.incremental,
+            sharded=mesh is not None,
+            remarshal=remarshal and edge_mask is None,
+            fresh=fresh,
+            launch_s=time.perf_counter() - t0,
+        )
+
+    def _launch_incremental(self, topo, n_atoms) -> "_InFlightOne | None":
+        """Split-phase DeltaPath launch: same contract (and the same
+        donation discipline — the previous tensors leave ``_prev_one``
+        BEFORE the kernel call) as :meth:`_try_incremental`; the
+        pipeline's per-key ownership handoff guarantees no queued delta
+        for this chain launches until finish_one re-deposited the new
+        tensors."""
+        delta = getattr(topo, "delta_base", None)
+        if delta is None or not self.incremental:
+            return None
+        prev_key = (
+            *delta.base_key, int(n_atoms), int(topo.root), _mesh_key()
+        )
+        prev = self._prev_one.get(prev_key)
+        if prev is None:
+            note_delta(delta.kind, "full-no-prev")
+            return None
+        t0 = time.perf_counter()
+        with telemetry.span(
+            "spf.launch", kind="one", backend="tpu", mode="delta"
+        ):
+            with profiling.stage("spf.one", "delta"):
+                with sanctioned_transfer("spf.one.delta"):
+                    from holo_tpu.ops.spf_engine import _pad_pow2
+
+                    g, how = shared_graph_cache().get(
+                        topo, n_atoms, allow_delta=True
+                    )
+                    if how == "miss":
+                        # Cache refused the delta (reasons already
+                        # counted): this dispatch belongs to the full
+                        # path, which hits the fresh entry.
+                        return None
+                    _GRAPH_CACHE.labels(result=how).inc()
+                    seeds = delta.seed_rows()
+                    pad = _pad_pow2(seeds.shape[0])
+                    seeds_p = np.full(
+                        pad, int(g.in_src.shape[0]), np.int32
+                    )
+                    seeds_p[: seeds.shape[0]] = seeds
+                    sig = (
+                        g.in_src.shape, g.direct_nh_words.shape[2], pad,
+                        _mesh_key(),
+                    )
+                    fresh = self._track_compile("delta", "incr", *sig)
+                    del self._prev_one[prev_key]
+                    out = self._jit_incr(g, topo.root, prev, seeds_p)
+            if fresh:
+                profiling.record_cost(
+                    "spf.delta", self._jit_incr, g, topo.root, out,
+                    seeds_p, shape_sig=sig,
+                )
+        return _InFlightOne(
+            out=out, topo=topo, t0=t0, engine="incr", bucket=None,
+            mode="delta", delta_kind=delta.kind, n_atoms=n_atoms,
+            remember=True, sharded=_mesh() is not None,
+            launch_s=time.perf_counter() - t0,
+        )
+
+    def finish_one(self, h: "_InFlightOne") -> SpfResult:
+        t_fs = time.perf_counter()
+        with telemetry.span(
+            "spf.finish", kind="one", backend="tpu", mode=h.mode
+        ):
+            with profiling.stage("spf.one", "device"):
+                with profiling.annotation("spf.one.device"):
+                    if not profiling.device_stages("spf.one", h.out):
+                        profiling.sync(h.out)
+            t1 = time.perf_counter()
+            with profiling.stage("spf.one", "readback"):
+                with sanctioned_transfer("spf.one.unmarshal"):
+                    dist, parent, hops, nh = _host_tensors(
+                        h.out, h.topo.n_vertices
+                    )
+                    res = SpfResult(
+                        dist=dist, parent=parent, hops=hops,
+                        nexthop_words=nh,
+                    )
+        t2 = time.perf_counter()
+        _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(
+            t2 - h.t0
+        )
+        _BATCH_SCENARIOS.labels(kind="one").inc()
+        if h.sharded:
+            _SHARD_DISPATCHES.labels(kind="one").inc()
+        convergence.note_dispatch("spf", "device")
+        # Tuner samples exclude the parked interval between the two
+        # phases (see _InFlightOne.launch_s); the dispatch histogram
+        # above keeps the true end-to-end wall.
+        unparked = h.launch_s + (t2 - t_fs)
+        if h.mode == "delta":
+            note_delta(h.delta_kind, "incremental")
+            self._tuner_depth_observe(h.topo, "delta", unparked)
+        else:
+            if not h.fresh:  # see _device_compute: no compile spikes
+                self._tuner_observe("one", h.bucket, h.engine, unparked)
+            if h.remarshal:
+                self._tuner_depth_observe(h.topo, "full", unparked)
+        if h.remember and self.incremental:
+            self._remember(h.topo, h.n_atoms, h.out)
         return res

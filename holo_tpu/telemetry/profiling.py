@@ -215,6 +215,31 @@ def record_cost(site: str, jitfn, *args, shape_sig: tuple = ()) -> dict | None:
     return entry
 
 
+def stage_median(
+    site: str, stage: str, device: str = "-"
+) -> float | None:
+    """Approximate median of ``holo_profile_stage_seconds{site,stage}``
+    from the histogram's cumulative bucket counts (upper bucket
+    boundary of the bucket containing the median — a <=2x
+    overestimate given the log-spaced ladder, which is plenty for
+    ratio decisions).  None when the stage has no observations.
+
+    This is the engine auto-tuner's GLOBAL fallback signal
+    (holo_tpu/pipeline/tuner.py): its per-shape-bucket decisions use
+    the dispatch walls the backends feed it directly, but a
+    fresh bucket with no samples can still consult the process-wide
+    stage distribution, and the bench's tuner rows report both."""
+    child = _STAGE_SECONDS.labels(site=site, stage=stage, device=device)
+    total = child.count
+    if not total:
+        return None
+    half = (total + 1) // 2
+    for le, cum in child.cumulative():
+        if cum >= half:
+            return float(le)
+    return None
+
+
 def cost_table() -> dict[tuple, dict]:
     """Snapshot of {(site, shape signature) -> cost estimates}."""
     with _cost_lock:

@@ -103,6 +103,21 @@ class TelemetryStateProvider(NbProvider):
         eng = sys.modules.get("holo_tpu.ops.spf_engine")
         if eng is not None:
             out["spf-graph-cache"] = eng.shared_graph_cache().stats()
+        # Async dispatch pipeline + engine tuner (ISSUE 9): the leaf
+        # appears once the pipeline package is armed (same lazy
+        # discipline — an unarmed daemon pays nothing at scrape time).
+        disp = sys.modules.get("holo_tpu.pipeline.dispatch")
+        if disp is not None:
+            # Bind once: a concurrent reset_process_pipeline() between
+            # a check and a second lookup must not crash the scrape.
+            pipe = disp.process_pipeline()
+            if pipe is not None:
+                out["pipeline"] = pipe.stats()
+        tun = sys.modules.get("holo_tpu.pipeline.tuner")
+        if tun is not None:
+            tuner = tun.active_tuner()
+            if tuner is not None:
+                out["engine-tuner"] = tuner.stats()
         return {ROOT: out}
 
 

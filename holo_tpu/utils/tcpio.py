@@ -467,9 +467,25 @@ class BgpTcpIo(NetIo):
         self.loop.send(self.actor, ConnectionDownMsg(slot.peer_ip))
 
     def _flush(self, slot: _PeerSlot) -> None:
+        from holo_tpu.resilience import faults
+
         while slot.txbuf:
+            cap = len(slot.txbuf)
+            inj = faults.active()
+            if inj is not None:
+                # Chaos seams (FaultPlan tcp_* knobs): an injected
+                # reset presents exactly like a peer RST mid-write;
+                # a partial write caps the send so framing has to
+                # reassemble across arbitrary fragmentation.  Cost
+                # while disarmed: one module-global None check.
+                if inj.tcp_reset("tcp.flush.reset"):
+                    self._teardown(slot)
+                    return
+                cap = inj.tcp_send_cap(cap)
             try:
-                n = slot.sock.send(slot.txbuf)
+                n = slot.sock.send(
+                    slot.txbuf[:cap] if cap < len(slot.txbuf) else slot.txbuf
+                )
             except BlockingIOError:
                 return  # rest goes out on the next send/pump
             except OSError:
@@ -480,6 +496,14 @@ class BgpTcpIo(NetIo):
     def _read(self, slot: _PeerSlot) -> int:
         if slot.sock is None:
             return 0  # torn down earlier in this pump cycle
+        from holo_tpu.resilience import faults
+
+        inj = faults.active()
+        if inj is not None and inj.tcp_reset("tcp.read.reset"):
+            # Injected connection reset on the receive side (chaos
+            # seam): identical surface to recv() raising ECONNRESET.
+            self._teardown(slot)
+            return 0
         try:
             data = slot.sock.recv(65536)
         except BlockingIOError:

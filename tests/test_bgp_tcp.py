@@ -138,6 +138,82 @@ def test_ebgp_ibgp_chain_over_tcp_v4_and_v6():
         io.close()
 
 
+def test_chaos_tcp_resets_and_partial_writes_reconverge():
+    """ISSUE 9 satellite: seeded FaultPlan chaos over the BGP TCP
+    transport — injected connection resets (identical surface to a
+    peer RST) and partial writes (sends capped to a few bytes, so the
+    length-delimited framing must reassemble across arbitrary
+    fragmentation) while routes are being exchanged.  Once the plan
+    disarms, the deterministic role split re-establishes the session
+    and ``_advertise_all`` resends the Adj-RIB-Out: every originated
+    route must converge on both speakers — the same final RIB a clean
+    run produces."""
+    from holo_tpu.resilience.faults import FaultInjector, FaultPlan, inject
+
+    loop = EventLoop(clock=RealClock())
+    r1, io1 = _mk_speaker(loop, "c1", 65001, "1.1.1.1", "127.0.5.1", port=17904)
+    r2, io2 = _mk_speaker(loop, "c2", 65002, "2.2.2.2", "127.0.5.2", port=17904)
+    _peer(r1, io1, "127.0.5.1", "127.0.5.2", 65002)
+    _peer(r2, io2, "127.0.5.2", "127.0.5.1", 65001)
+    ios = [io1, io2]
+
+    def established():
+        return all(
+            p.state == PeerState.ESTABLISHED
+            for inst in (r1, r2)
+            for p in inst.peers.values()
+        )
+
+    assert _drive(loop, ios, established), "no initial session"
+
+    nets = [N(f"10.{50 + i}.0.0/16") for i in range(8)]
+    plan = FaultPlan(seed=31, tcp_reset_prob=0.04,
+                     tcp_partial_write_prob=0.6)
+    inj = FaultInjector(plan)
+    with inject(inj):
+        # Originate under fire: every route announcement rides a
+        # transport that keeps fragmenting and resetting under it.
+        for i, net in enumerate(nets):
+            (r1 if i % 2 == 0 else r2).originate(net)
+            _drive(loop, ios, lambda: False, timeout=0.4)
+    fired = {k: v for k, v in inj.injected.items() if k.startswith("tcp.")}
+    assert fired, "chaos plan never fired a tcp transport seam"
+
+    # Disarmed: session recovers, full Adj-RIB-Out resend reconverges.
+    assert _drive(
+        loop,
+        ios,
+        lambda: established()
+        and all(n in r1.loc_rib and n in r2.loc_rib for n in nets),
+        timeout=25.0,
+    ), (
+        f"no reconvergence after tcp chaos (fired={fired}; "
+        f"r1={sorted(str(n) for n in r1.loc_rib)}, "
+        f"r2={sorted(str(n) for n in r2.loc_rib)})"
+    )
+    for io in ios:
+        io.close()
+
+
+def test_chaos_tcp_same_seed_same_injection_sequence():
+    """The tcp seams ride FaultPlan's per-site deterministic streams:
+    the same plan replays the same reset/partial decisions."""
+    from holo_tpu.resilience.faults import FaultInjector, FaultPlan
+
+    def sequence():
+        inj = FaultInjector(
+            FaultPlan(seed=7, tcp_reset_prob=0.3,
+                      tcp_partial_write_prob=0.5)
+        )
+        return (
+            [inj.tcp_reset("tcp.flush.reset") for _ in range(32)],
+            [inj.tcp_send_cap(400) for _ in range(32)],
+            dict(inj.injected),
+        )
+
+    assert sequence() == sequence()
+
+
 def _md5_supported():
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:

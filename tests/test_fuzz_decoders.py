@@ -223,28 +223,47 @@ def _ldp_pdu_decode(data: bytes):
         raise DecodeError(str(e)) from e
 
 
-@pytest.mark.parametrize("name", sorted(decoders().keys()))
-def test_fuzz_decoder(name):
-    import random
-    import zlib
+#: the seeded chaos plan driving every fuzz stream (ISSUE 9 satellite:
+#: FaultPlan is the repo's one source of deterministic randomness — the
+#: fuzz targets now draw their corpus mutations from the same per-site
+#: streams the chaos harness uses, so a failing iteration replays
+#: bit-for-bit from (FUZZ_SEED, target name) alone, and interleaving
+#: targets can never perturb each other's sequences)
+FUZZ_SEED = 0x5EED
 
-    rng = random.Random(zlib.crc32(name.encode()))
-    decode = decoders()[name]
-    seeds = corpus()
-    crashes = []
-    for i in range(ITERATIONS):
+
+def fuzz_stream(name: str):
+    """The per-target deterministic RNG: ``FaultPlan.rng`` keyed by the
+    fuzz site, exactly like a dispatch/wire chaos seam."""
+    from holo_tpu.resilience.faults import FaultPlan
+
+    return FaultPlan(seed=FUZZ_SEED).rng(f"fuzz:{name}")
+
+
+def fuzz_cases(name: str, seeds, iterations=ITERATIONS):
+    """Deterministic mutation sequence for one decoder target."""
+    rng = fuzz_stream(name)
+    for _ in range(iterations):
         mode = rng.randrange(3)
         if mode == 0:  # pure random bytes
-            data = rng.randbytes(rng.randrange(0, 200))
+            yield rng.randbytes(rng.randrange(0, 200))
         elif mode == 1:  # mutate a valid packet
             data = bytearray(rng.choice(seeds))
             for _ in range(rng.randrange(1, 8)):
                 if data:
                     data[rng.randrange(len(data))] = rng.randrange(256)
-            data = bytes(data)
+            yield bytes(data)
         else:  # truncate a valid packet
             seed = rng.choice(seeds)
-            data = seed[: rng.randrange(0, len(seed) + 1)]
+            yield seed[: rng.randrange(0, len(seed) + 1)]
+
+
+@pytest.mark.parametrize("name", sorted(decoders().keys()))
+def test_fuzz_decoder(name):
+    decode = decoders()[name]
+    seeds = corpus()
+    crashes = []
+    for i, data in enumerate(fuzz_cases(name, seeds)):
         try:
             decode(data)
         except DecodeError:
@@ -252,3 +271,15 @@ def test_fuzz_decoder(name):
         except Exception as e:  # noqa: BLE001 - the point of the fuzzer
             crashes.append((i, type(e).__name__, str(e)[:80], data.hex()[:60]))
     assert not crashes, crashes[:3]
+
+
+def test_fuzz_streams_are_plan_deterministic_and_independent():
+    """Same (seed, site) -> same byte sequence; different sites ->
+    independent streams (the FaultPlan per-site contract the fuzz
+    targets now inherit)."""
+    seeds = corpus()
+    a = list(fuzz_cases("bgp_msg", seeds, iterations=40))
+    b = list(fuzz_cases("bgp_msg", seeds, iterations=40))
+    assert a == b, "fuzz stream must replay bit-for-bit"
+    c = list(fuzz_cases("rip", seeds, iterations=40))
+    assert a != c, "per-target streams must be independent"

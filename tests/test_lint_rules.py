@@ -808,6 +808,197 @@ def test_hl107_out_of_scope_module_is_ignored():
     assert "HL107" not in rules_fired(HL107_BAD, OUTSIDE)
 
 
+# -- HL108: cross-module device-value host sink (ISSUE 9 satellite) -----
+
+HELPER_PATH = "holo_tpu/telemetry/_helper_fixture.py"
+HELPER_SRC = """
+    import numpy as np
+
+    def summarize(planes, scale=1):
+        # Host sink on a parameter: np.asarray(planes) materializes
+        # whatever the caller passed — harmless for host arrays, a
+        # hidden device->host transfer for device values.
+        return np.asarray(planes).sum() * scale
+
+    def shape_only(planes):
+        return planes.shape[0]  # metadata read: not a sink
+"""
+
+HL108_BAD = """
+    import jax.numpy as jnp
+
+    from holo_tpu.telemetry._helper_fixture import summarize
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        return summarize(out)
+"""
+HL108_SUPPRESSED = """
+    import jax.numpy as jnp
+
+    from holo_tpu.telemetry._helper_fixture import summarize
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        return summarize(out)  # holo-lint: disable=HL108
+"""
+HL108_CLEAN = """
+    import jax.numpy as jnp
+
+    from holo_tpu.analysis.runtime import sanctioned_transfer
+    from holo_tpu.telemetry._helper_fixture import summarize
+
+    def dispatch(g, mask):
+        out = jnp.add(g, mask)
+        with sanctioned_transfer("fixture.unmarshal"):
+            return summarize(out)
+"""
+
+
+def lint_pair(caller_src: str, caller_path: str = OPS):
+    from holo_tpu.analysis.core import run_sources
+
+    return run_sources(
+        [
+            (HELPER_PATH, textwrap.dedent(HELPER_SRC)),
+            (caller_path, textwrap.dedent(caller_src)),
+        ],
+        LintConfig(),
+    )
+
+
+def test_hl108_cross_module_sink():
+    res = lint_pair(HL108_BAD)
+    assert "HL108" in {f.rule for f in res.findings}, [
+        f.render() for f in res.findings
+    ]
+    # The finding anchors at the CALL SITE in the dispatch module.
+    f = next(f for f in res.findings if f.rule == "HL108")
+    assert f.path == OPS and "summarize" in f.message
+    sup = lint_pair(HL108_SUPPRESSED)
+    assert "HL108" not in {f.rule for f in sup.findings}
+    assert "HL108" in {f.rule for f in sup.suppressed}
+    cl = lint_pair(HL108_CLEAN)
+    assert "HL108" not in {f.rule for f in cl.findings}, [
+        f.render() for f in cl.findings
+    ]
+
+
+def test_hl108_module_attribute_call_form():
+    src = """
+        import jax.numpy as jnp
+
+        import holo_tpu.telemetry._helper_fixture as helpers
+
+        def dispatch(g, mask):
+            out = jnp.add(g, mask)
+            return helpers.summarize(out)
+    """
+    res = lint_pair(src)
+    assert "HL108" in {f.rule for f in res.findings}
+
+
+def test_hl108_keyword_argument_form():
+    src = """
+        import jax.numpy as jnp
+
+        from holo_tpu.telemetry._helper_fixture import summarize
+
+        def dispatch(g, mask):
+            out = jnp.add(g, mask)
+            return summarize(planes=out)
+    """
+    assert "HL108" in {f.rule for f in lint_pair(src).findings}
+
+
+def test_hl108_host_value_and_non_sink_param_stay_clean():
+    src = """
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from holo_tpu.telemetry._helper_fixture import (
+            shape_only,
+            summarize,
+        )
+
+        def dispatch(g, mask):
+            out = jnp.add(g, mask)
+            host = np.ones(4)
+            a = summarize(host)     # host value: no transfer
+            b = shape_only(out)     # metadata-only helper: no sink
+            # Tainted value on a NON-sinking parameter position only.
+            c = summarize(host, scale=2)
+            return a + b + c
+    """
+    res = lint_pair(src)
+    assert "HL108" not in {f.rule for f in res.findings}, [
+        f.render() for f in res.findings
+    ]
+
+
+def test_hl108_same_module_helper_is_hl101_territory():
+    """A sink helper in the SAME module is out of HL108's scope (the
+    cross-module rule must not double-report what per-module taint can
+    in principle see)."""
+    src = """
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        def local_summarize(planes):
+            return np.asarray(planes).sum()
+
+        def dispatch(g, mask):
+            out = jnp.add(g, mask)
+            return local_summarize(out)
+    """
+    res = lint(src, OPS)
+    assert "HL108" not in {f.rule for f in res.findings}
+
+
+def test_hl108_sanctioned_helper_body_not_indexed():
+    helper = """
+        import numpy as np
+
+        from holo_tpu.analysis.runtime import sanctioned_transfer
+
+        def unmarshal(planes):
+            with sanctioned_transfer("fixture.unmarshal"):
+                return np.asarray(planes)
+    """
+    caller = """
+        import jax.numpy as jnp
+
+        from holo_tpu.telemetry._helper_fixture import unmarshal
+
+        def dispatch(g, mask):
+            out = jnp.add(g, mask)
+            return unmarshal(out)
+    """
+    from holo_tpu.analysis.core import run_sources
+
+    res = run_sources(
+        [
+            (HELPER_PATH, textwrap.dedent(helper)),
+            (OPS, textwrap.dedent(caller)),
+        ],
+        LintConfig(),
+    )
+    assert "HL108" not in {f.rule for f in res.findings}
+
+
+def test_hl108_out_of_scope_caller_is_ignored():
+    res = lint_pair(HL108_BAD, caller_path=OUTSIDE)
+    assert "HL108" not in {f.rule for f in res.findings}
+
+
+def test_hl108_is_error_tier():
+    res = lint_pair(HL108_BAD)
+    tiers = {f.rule: f.severity for f in res.findings}
+    assert tiers.get("HL108") == "error"
+
+
 # -- machinery ----------------------------------------------------------
 
 

@@ -1,0 +1,244 @@
+"""Per-shape engine auto-tuner (ISSUE 9 tentpole, part b).
+
+Deterministic explore/exploit schedule, winner promotion, cost-prior
+ordered exploration, versioned table persistence (a cold daemon
+reproduces the learned winners with zero re-exploration), the
+auto-tuned per-shape DeltaPath depth cap (PR 7 follow-up), and the
+backend integration: parity is engine-independent, so tuner flips can
+never change routing output.
+"""
+
+import numpy as np
+import pytest
+
+from holo_tpu import pipeline, telemetry
+from holo_tpu.pipeline.tuner import (
+    DEPTH_MIN_SAMPLES,
+    DEPTH_SCALE,
+    ENGINES,
+    EngineTuner,
+    shape_bucket,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    pipeline.reset_engine_tuner()
+    pipeline.reset_process_pipeline()
+
+
+B = shape_bucket(1000, 4000, 8, None)
+
+
+def test_shape_bucket_quantization():
+    assert shape_bucket(1000, 4000, 8, None) == (1024, 4096, 8, None)
+    assert shape_bucket(1024, 4096, 8, None) == (1024, 4096, 8, None)
+    assert shape_bucket(1, 0, 1, ("m", 2)) == (1, 1, 1, ("m", 2))
+    # Nearby sizes share a bucket; a 2x jump does not.
+    assert shape_bucket(900, 3900, 8) == shape_bucket(1000, 4000, 8)
+    assert shape_bucket(900, 3900, 8) != shape_bucket(2100, 3900, 8)
+
+
+def test_explore_then_exploit_deterministic():
+    t = EngineTuner(explore_rounds=1, reprobe_every=0)
+    seen = []
+    for _ in range(len(ENGINES)):
+        e = t.pick("one", B)
+        seen.append(e)
+        t.observe("one", B, e, 1.0 if e != "hybrid" else 0.1)
+    # Explore phase measured every engine exactly once.
+    assert sorted(seen) == sorted(ENGINES)
+    # Exploit phase: the measured winner, repeatedly.
+    assert [t.pick("one", B) for _ in range(5)] == ["hybrid"] * 5
+
+
+def test_schedule_replays_identically():
+    def run():
+        t = EngineTuner(explore_rounds=2, reprobe_every=8)
+        picks = []
+        for i in range(64):
+            e = t.pick("one", B)
+            picks.append(e)
+            t.observe("one", B, e, {"seq": 3.0, "fused": 2.0,
+                                    "packed": 4.0, "hybrid": 1.0}[e])
+        return picks
+
+    assert run() == run(), "tuner schedule must be RNG-free deterministic"
+
+
+def test_reprobe_revisits_non_winners():
+    t = EngineTuner(explore_rounds=1, reprobe_every=4)
+    for _ in range(len(ENGINES)):
+        e = t.pick("one", B)
+        t.observe("one", B, e, 0.1 if e == "seq" else 1.0)
+    picks = [t.pick("one", B) for _ in range(16)]
+    assert picks.count("seq") >= 10, picks  # mostly exploit
+    assert set(picks) - {"seq"}, "reprobe must revisit non-winners"
+
+
+def test_promotion_on_winner_flip_counts_and_persists(tmp_path):
+    path = tmp_path / "tuner.json"
+    t = EngineTuner(path=path, explore_rounds=1, reprobe_every=4)
+    for _ in range(len(ENGINES)):
+        e = t.pick("one", B)
+        t.observe("one", B, e, 0.5 if e == "seq" else 1.0)
+    assert t.stats()["winners"][t._bucket_str(("one", *B))]["winner"] == "seq"
+    promos0 = t.stats()["promotions"]
+    # The platform drifts: fused now measures faster, repeatedly.
+    for _ in range(9):
+        t.observe("one", B, "fused", 0.01)
+    assert t.stats()["promotions"] > promos0
+    assert path.exists(), "promotion must persist the table"
+
+
+def test_cost_prior_orders_exploration():
+    t = EngineTuner(explore_rounds=1, reprobe_every=0)
+    t.cost_prior("one", B, "hybrid", {"flops": 10, "bytes": 10})
+    t.cost_prior("one", B, "seq", {"flops": 99, "bytes": 99})
+    first = t.pick("one", B)
+    # Cheapest estimated bytes leads the explore order.
+    assert first == "hybrid"
+
+
+def test_persistence_cold_table_reproduces_winner(tmp_path):
+    """The acceptance contract: a COLD tuner loading the persisted
+    table picks the learned winner on its very first dispatch — no
+    re-exploration after a restart."""
+    path = tmp_path / "tuner.json"
+    warm = EngineTuner(path=path, explore_rounds=1)
+    for _ in range(len(ENGINES)):
+        e = warm.pick("whatif", B)
+        warm.observe("whatif", B, e, 0.2 if e == "packed" else 2.0)
+    assert warm.save()
+    cold = EngineTuner(path=path, explore_rounds=1, reprobe_every=0)
+    assert cold.stats()["loaded-from-disk"]
+    assert cold.pick("whatif", B) == "packed"
+    decisions = telemetry.snapshot(
+        prefix="holo_pipeline_tuner_decisions"
+    )
+    key = "holo_pipeline_tuner_decisions_total{kind=whatif,engine=packed,phase=exploit}"
+    assert decisions.get(key, 0) >= 1, decisions
+
+
+def test_persistence_version_mismatch_discarded(tmp_path):
+    path = tmp_path / "tuner.json"
+    path.write_text('{"version": 999, "buckets": {"bogus": {}}}')
+    t = EngineTuner(path=path)
+    assert not t.stats()["loaded-from-disk"]
+    assert t.stats()["buckets"] == 0
+
+
+def test_persistence_corrupt_file_is_relearned(tmp_path):
+    path = tmp_path / "tuner.json"
+    path.write_text("{not json")
+    t = EngineTuner(path=path)
+    assert t.stats()["buckets"] == 0
+    e = t.pick("one", B)
+    assert e in ENGINES
+
+
+def test_depth_cap_scales_with_measured_ratio(tmp_path):
+    t = EngineTuner(default_delta_depth=256)
+    b = shape_bucket(500, 2000, 1, None)
+    # No per-bucket measurements: the static default — unless an
+    # earlier test in this process already populated the global
+    # profiling-stage fallback (holo_profile_stage_seconds is
+    # process-wide), in which case the fallback ratio applies.
+    from holo_tpu.telemetry import profiling
+
+    if (
+        profiling.stage_median("spf.one", "delta") is None
+        or profiling.stage_median("spf.one", "marshal") is None
+    ):
+        assert t.max_delta_depth(b) == 256
+    for _ in range(DEPTH_MIN_SAMPLES):
+        t.observe_delta(b, 0.001)
+        t.observe_full(b, 0.040)  # delta 40x cheaper
+    assert t.max_delta_depth(b) == 40 * DEPTH_SCALE
+    # A bucket where the delta barely wins gets a shallow cap (floor).
+    b2 = shape_bucket(50, 100, 1, None)
+    for _ in range(DEPTH_MIN_SAMPLES):
+        t.observe_delta(b2, 0.010)
+        t.observe_full(b2, 0.011)
+    assert t.max_delta_depth(b2) == DEPTH_SCALE
+    # Depth observations round-trip through the persisted table.
+    path = tmp_path / "tuner.json"
+    assert t.save(path)
+    cold = EngineTuner(path=path)
+    assert cold.max_delta_depth(b) == 40 * DEPTH_SCALE
+
+
+def test_device_graph_cache_consults_tuned_depth_cap():
+    """Integration (PR 7 follow-up satellite): with a tuner armed, the
+    shared DeviceGraphCache's delta-chain cap comes from the measured
+    per-shape table — a shallow tuned cap forces the full-rebuild path
+    exactly like the static knob, bit-identically."""
+    from holo_tpu.ops.graph import diff_topologies
+    from holo_tpu.ops.spf_engine import shared_graph_cache
+    from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+    from holo_tpu.spf.synth import clone_topology, random_ospf_topology
+
+    topo = random_ospf_topology(
+        n_routers=30, n_networks=5, extra_p2p=15, seed=9
+    )
+    t = pipeline.configure_engine_tuner()
+    b = shape_bucket(topo.n_vertices, topo.n_edges, 1, None)
+    # Teach the tuner this shape barely benefits: cap = DEPTH_SCALE.
+    for _ in range(DEPTH_MIN_SAMPLES):
+        t.observe_delta(b, 1.0)
+        t.observe_full(b, 1.0)
+    assert shared_graph_cache()._depth_cap(topo) == DEPTH_SCALE
+    # And the dispatch stays bit-identical either way.
+    be = TpuSpfBackend()
+    oracle = ScalarSpfBackend()
+    be.compute(topo)
+    rng = np.random.default_rng(11)
+    cur = topo
+    for _ in range(3):
+        e = int(rng.integers(0, cur.n_edges))
+        nxt = clone_topology(cur, cost={e: int(rng.integers(1, 64))})
+        d = diff_topologies(cur, nxt)
+        nxt.link_delta(d)
+        res = be.compute(nxt)
+        ref = oracle.compute(nxt)
+        for f in ("dist", "parent", "hops", "nexthop_words"):
+            assert np.array_equal(getattr(ref, f), getattr(res, f)), f
+        cur = nxt
+
+
+def test_backend_tuner_flips_are_parity_invariant():
+    """Engine choice is a latency decision, never a semantic one: with
+    the tuner exploring all four formulations across dispatches, every
+    result stays bit-identical to the scalar oracle."""
+    from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+    from holo_tpu.spf.synth import random_ospf_topology
+
+    pipeline.configure_engine_tuner(explore_rounds=2)
+    topo = random_ospf_topology(
+        n_routers=40, n_networks=6, extra_p2p=25, seed=13
+    )
+    be = TpuSpfBackend(incremental=False)
+    ref = ScalarSpfBackend().compute(topo)
+    engines_used = set()
+    for _ in range(10):
+        res = be.compute(topo)
+        for f in ("dist", "parent", "hops", "nexthop_words"):
+            assert np.array_equal(getattr(ref, f), getattr(res, f)), f
+        t = pipeline.active_tuner()
+        st = t.stats()["winners"]
+        for entry in st.values():
+            engines_used.update(entry["measured-engines"])
+    assert len(engines_used) == len(ENGINES), engines_used
+
+
+def test_tuner_metrics_family_present():
+    pipeline.configure_engine_tuner(explore_rounds=1)
+    t = pipeline.active_tuner()
+    e = t.pick("one", B)
+    t.observe("one", B, e, 1.0)
+    snap = telemetry.snapshot(prefix="holo_pipeline_tuner")
+    assert any(
+        k.startswith("holo_pipeline_tuner_decisions_total") for k in snap
+    ), snap
+    assert snap.get("holo_pipeline_tuner_buckets", 0) >= 1
