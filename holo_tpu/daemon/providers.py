@@ -595,6 +595,7 @@ class RoutingProvider(Provider, Actor):
         nvstore=None,
         link_mgr=None,
         yang_notify=None,
+        microloop_delay: float = 0.0,
     ):
         self.loop = loop
         self.ibus = ibus
@@ -618,7 +619,9 @@ class RoutingProvider(Provider, Actor):
         self.netio_factory = netio if callable(netio) else (lambda _actor: netio)
         self.ifp = interface_provider
         self.prefix = prefix
-        self.rib = RibManager(ibus, kernel or MockKernel())
+        self.rib = RibManager(
+            ibus, kernel or MockKernel(), microloop_delay=microloop_delay
+        )
         self.rib.on_change = self._rib_changed
         self.instances: dict[str, OspfInstance] = {}
 

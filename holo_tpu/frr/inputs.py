@@ -47,6 +47,10 @@ class FrrInputs:
     link_cost: np.ndarray  # int32[Lp]
     link_valid: np.ndarray  # bool[Lp]
     edge_masks: np.ndarray  # bool[Lp, E] post-convergence scenario masks
+    # SRLG bitmask of the protected link's edge (uint32[Lp], 0 pad):
+    # the shared-risk policy plane — candidates sharing any group bit
+    # with the protected link are excluded when the policy is armed.
+    link_srlg: np.ndarray
     # Repair candidates; padded with valid=False.
     adj_edge: np.ndarray  # int32[Ap] edge id of the candidate edge
     adj_nbr: np.ndarray  # int32[Ap] neighbor router vertex
@@ -54,6 +58,7 @@ class FrrInputs:
     adj_link: np.ndarray  # int32[Ap] protected-link index it rides (-1 pad)
     adj_atom: np.ndarray  # int32[Ap] direct next-hop atom id
     adj_valid: np.ndarray  # bool[Ap]
+    adj_srlg: np.ndarray  # uint32[Ap] SRLG bitmask of the candidate edge
     n_links: int  # unpadded L
     n_adj: int  # unpadded A
     # next-hop atom id -> protected link index (which interface an
@@ -98,11 +103,13 @@ def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
             masks[l, rev] = False
 
     # Repair candidates + atom→link map.
+    srlg = topo.edge_srlg
     adj_edge: list[int] = []
     adj_nbr: list[int] = []
     adj_cost: list[int] = []
     adj_link: list[int] = []
     adj_atom: list[int] = []
+    adj_srlg: list[int] = []
     atom_link: dict[int, int] = {}
     for l, e in enumerate(link_edge):
         far = int(e_dst[e])
@@ -115,6 +122,7 @@ def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
                 adj_cost.append(int(e_cost[e]))
                 adj_link.append(l)
                 adj_atom.append(int(atom[e]))
+                adj_srlg.append(int(srlg[e]))
         else:
             # LAN: members reachable through this interface are candidates
             # (and their atoms ride this link for the failure fanout).
@@ -130,6 +138,9 @@ def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
                 adj_cost.append(int(e_cost[e]) + int(e_cost[e2]))
                 adj_link.append(l)
                 adj_atom.append(int(atom[e2]))
+                # The LAN repair rides our interface edge AND the
+                # network→member leg: its risk set is the union.
+                adj_srlg.append(int(srlg[e]) | int(srlg[e2]))
     nadj = len(adj_edge)
 
     lp = _round_up(nlinks, pad_multiple)
@@ -138,6 +149,11 @@ def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
     def pad_i32(vals, size, fill):
         out = np.full(size, fill, np.int32)
         out[: len(vals)] = np.asarray(vals, np.int32).reshape(-1)[: len(vals)]
+        return out
+
+    def pad_u32(vals, size):
+        out = np.zeros(size, np.uint32)
+        out[: len(vals)] = np.asarray(vals, np.uint32).reshape(-1)[: len(vals)]
         return out
 
     link_valid = np.zeros(lp, bool)
@@ -155,12 +171,14 @@ def marshal_frr(topo: Topology, pad_multiple: int = 8) -> FrrInputs:
         link_cost=pad_i32([int(e_cost[e]) for e in link_edge], lp, 1),
         link_valid=link_valid,
         edge_masks=masks_p,
+        link_srlg=pad_u32([int(srlg[e]) for e in link_edge], lp),
         adj_edge=pad_i32(adj_edge, ap, -1),
         adj_nbr=pad_i32(adj_nbr, ap, 0),
         adj_cost=pad_i32(adj_cost, ap, 1),
         adj_link=pad_i32(adj_link, ap, -1),
         adj_atom=pad_i32(adj_atom, ap, -1),
         adj_valid=adj_valid,
+        adj_srlg=pad_u32(adj_srlg, ap),
         n_links=nlinks,
         n_adj=nadj,
         atom_link=atom_link,

@@ -87,8 +87,19 @@ def frr_batch(
     adj_cost: jax.Array,
     adj_link: jax.Array,
     adj_valid: jax.Array,
+    link_srlg: jax.Array | None = None,
+    adj_srlg: jax.Array | None = None,
+    require_np: jax.Array | bool = False,
     max_iters: int | None = None,
 ) -> FrrTensors:
+    """``link_srlg``/``adj_srlg`` (uint32 SRLG bitmasks, ISSUE 10): a
+    repair candidate sharing ANY risk group with the protected link is
+    excluded from the usable plane — all-zero planes (the default, and
+    the disarmed policy) exclude nothing, so the mask costs one
+    elementwise AND.  ``require_np`` (traced bool) restricts the LFA
+    pick to node-protecting candidates (RFC 5286 inequality 3 as a hard
+    policy instead of a preference); destinations without one fall
+    through to remote-LFA / TI-LFA exactly like uncovered ones."""
     n = g.in_src.shape[0]
     nlinks = link_far.shape[0]
     nadj = adj_nbr.shape[0]
@@ -114,6 +125,11 @@ def frr_batch(
         & link_valid[:, None]
         & (adj_link[None, :] != jnp.arange(nlinks)[:, None])
     )  # [L, A]
+    if link_srlg is not None and adj_srlg is not None:
+        # Shared-risk exclusion: the vectorized SRLG policy mask.
+        usable = usable & (
+            (link_srlg[:, None] & adj_srlg[None, :]) == jnp.uint32(0)
+        )
     dfar = D[link_far]  # [L, N]
     dn_far = dn[:, link_far].T  # [L, A]: D[nbr_a, far_l]
     nodeprot = dn[None, :, :] < _fadd(
@@ -122,7 +138,13 @@ def frr_batch(
     cand = usable[:, :, None] & loopfree[None, :, :] & valid_d[None, None, :]
     np_cand = cand & nodeprot
     has_np = np_cand.any(axis=1)  # [L, N]
-    sel = jnp.where(has_np[:, None, :], np_cand, cand)
+    # Preference becomes policy under require_np: only node-protecting
+    # candidates are selectable at all.
+    sel = jnp.where(
+        jnp.asarray(require_np),
+        np_cand,
+        jnp.where(has_np[:, None, :], np_cand, cand),
+    )
     altdist = _fadd(adj_cost[:, None], dn)  # [A, N]
     k1 = jnp.where(sel, altdist[None, :, :], INF)
     m1 = k1.min(axis=1)  # [L, N]

@@ -77,15 +77,43 @@ def _mesh():
 @dataclass
 class FrrConfig:
     """Mirrors the reference YANG fast-reroute containers
-    (ietf-ospf ``fast-reroute/lfa``, holo's ti-lfa extension leaves)."""
+    (ietf-ospf ``fast-reroute/lfa``, holo's ti-lfa extension leaves).
+
+    Policy knobs (ISSUE 10) are applied as vectorized masks inside the
+    batched kernel (and mirrored by the scalar oracle):
+
+    - ``node_protection`` — only node-protecting LFAs are selectable
+      (inequality 3 as policy); uncovered destinations fall through to
+      remote-LFA / TI-LFA.
+    - ``srlg_disjoint`` — repair candidates sharing any SRLG bit
+      (``Topology.edge_srlg``) with the protected link are excluded.
+    - ``protected_prefixes`` — per-prefix protection filter: when
+      non-None, backups attach only to routes covered by one of these
+      networks (RFC 7916-style protection policy scope).
+    """
 
     enabled: bool = False  # LFA (RFC 5286)
     remote_lfa: bool = False  # RFC 7490 (requires enabled)
     ti_lfa: bool = False  # TI-LFA segment repairs (requires enabled + SR)
     engine: str = "scalar"  # 'scalar' | 'tpu'
+    node_protection: bool = False  # LFA must node-protect
+    srlg_disjoint: bool = False  # backup must be SRLG-disjoint
+    protected_prefixes: tuple | None = None  # None = protect everything
 
     def active(self) -> bool:
         return self.enabled
+
+    def protects_prefix(self, prefix) -> bool:
+        """Per-prefix protection filtering: is ``prefix`` in scope?"""
+        if self.protected_prefixes is None:
+            return True
+        for scope in self.protected_prefixes:
+            try:
+                if prefix == scope or prefix.subnet_of(scope):
+                    return True
+            except (TypeError, ValueError):
+                continue  # mixed address families never match
+        return False
 
 
 @dataclass(frozen=True)
@@ -184,10 +212,13 @@ def ensure_engine(current, cfg: FrrConfig) -> "FrrEngine":
     therefore be an AsyncFrrEngine — its ``engine`` attribute
     delegates, so the reuse check is unchanged)."""
     if current is not None and current.engine == cfg.engine:
+        current.set_policy(cfg)
         return current
     from holo_tpu.pipeline import wrap_frr_engine
 
-    return wrap_frr_engine(FrrEngine(engine=cfg.engine))
+    engine = wrap_frr_engine(FrrEngine(engine=cfg.engine))
+    engine.set_policy(cfg)
+    return engine
 
 
 class FrrEngine:
@@ -214,6 +245,13 @@ class FrrEngine:
         # Mesh-sharded all-roots programs, one per mesh identity
         # (outputs pinned to the batch sharding over protected links).
         self._shard_jits: dict[tuple, object] = {}
+        # Protection policy (node-protection / SRLG-disjoint masks) —
+        # traced kernel inputs, so a policy flip never recompiles.
+        self.policy = FrrConfig()
+
+    def set_policy(self, cfg: "FrrConfig") -> None:
+        """Adopt the instance's protection policy (ensure_engine seam)."""
+        self.policy = cfg
 
     def _sharded_jit(self, mesh):
         if mesh.size == 1:
@@ -230,17 +268,30 @@ class FrrEngine:
         if fn is None:
 
             @jax.jit
-            def step(g, root, lf, lc, lv, em, an, ac, al, av):
+            def step(g, root, lf, lc, lv, em, an, ac, al, av, lsr, asr, rnp):
                 out = frr_batch(
-                    g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
+                    g, root, lf, lc, lv, em, an, ac, al, av,
+                    link_srlg=lsr, adj_srlg=asr, require_np=rnp,
+                    max_iters=self.max_iters,
                 )
                 return constrain_batch(mesh, out)
 
             fn = self._shard_jits[key] = step
         return fn
 
-    @staticmethod
-    def _shard_args(mesh, fin):
+    def _policy_args(self, fin) -> tuple:
+        """(link_srlg, adj_srlg, require_np) kernel inputs under the
+        current policy.  Disarmed SRLG policy passes all-zero planes —
+        the mask then excludes nothing and the table is bit-identical
+        to the pre-policy kernel (parity suites run disarmed)."""
+        if self.policy.srlg_disjoint:
+            lsr, asr = fin.link_srlg, fin.adj_srlg
+        else:
+            lsr = np.zeros_like(fin.link_srlg)
+            asr = np.zeros_like(fin.adj_srlg)
+        return lsr, asr, np.bool_(self.policy.node_protection)
+
+    def _shard_args(self, mesh, fin):
         """Place the FRR planes per the mesh layout contract: the
         per-protected-link planes (the all-roots/what-if batch axis)
         sharded over ``batch`` — padded to the axis size with
@@ -253,18 +304,21 @@ class FrrEngine:
         lf, lc, lv, em = (
             fin.link_far, fin.link_cost, fin.link_valid, fin.edge_masks,
         )
+        lsr, asr, rnp = self._policy_args(fin)
         pad = (-lf.shape[0]) % nb
         if pad:
             lf = np.concatenate([lf, np.zeros(pad, lf.dtype)])
             lc = np.concatenate([lc, np.ones(pad, lc.dtype)])
             lv = np.concatenate([lv, np.zeros(pad, bool)])
             em = np.concatenate([em, np.ones((pad, em.shape[1]), bool)])
+            lsr = np.concatenate([lsr, np.zeros(pad, lsr.dtype)])
         if mesh.size == 1:
             # Nothing to shard: the jit commits host arrays itself
             # (see mesh.shard_scenarios — the sharding_overhead gate).
             return (
                 lf, lc, lv, em,
                 fin.adj_nbr, fin.adj_cost, fin.adj_link, fin.adj_valid,
+                lsr, asr, rnp,
             )
         link = NamedSharding(mesh, P("batch"))
         mask = NamedSharding(mesh, P("batch", None))
@@ -278,6 +332,9 @@ class FrrEngine:
             jax.device_put(fin.adj_cost, rep),
             jax.device_put(fin.adj_link, rep),
             jax.device_put(fin.adj_valid, rep),
+            jax.device_put(lsr, link),
+            jax.device_put(asr, rep),
+            np.bool_(rnp),
         )
 
     # -- device path
@@ -327,8 +384,12 @@ class FrrEngine:
 
         if self._jit is None:
             self._jit = jax.jit(
-                lambda g, root, lf, lc, lv, em, an, ac, al, av: frr_batch(
-                    g, root, lf, lc, lv, em, an, ac, al, av, self.max_iters
+                lambda g, root, lf, lc, lv, em, an, ac, al, av, lsr, asr, rnp: (
+                    frr_batch(
+                        g, root, lf, lc, lv, em, an, ac, al, av,
+                        link_srlg=lsr, adj_srlg=asr, require_np=rnp,
+                        max_iters=self.max_iters,
+                    )
                 )
             )
         # The FRR analog of the SPF backend's sanctioned boundary: the
@@ -350,6 +411,7 @@ class FrrEngine:
                         fin.adj_cost,
                         fin.adj_link,
                         fin.adj_valid,
+                        *self._policy_args(fin),
                     )
                     step = self._jit
                 sig = (
@@ -417,11 +479,15 @@ class FrrEngine:
 
     def _scalar_fallback(self, topo: Topology, fin) -> BackupTable:
         """Breaker degraded path: the oracle over the SAME marshaled
-        inputs — the backup table is bit-identical by the parity suite."""
+        inputs and policy — bit-identical by the parity suite."""
         from holo_tpu.frr.scalar import frr_reference
 
         try:
-            return frr_reference(topo, self.n_atoms, inputs=fin)
+            return frr_reference(
+                topo, self.n_atoms, inputs=fin,
+                srlg_disjoint=self.policy.srlg_disjoint,
+                node_protection=self.policy.node_protection,
+            )
         finally:
             convergence.note_dispatch("frr", "fallback")
 
@@ -443,7 +509,11 @@ class FrrEngine:
             else:
                 from holo_tpu.frr.scalar import frr_reference
 
-                table = frr_reference(topo, self.n_atoms, inputs=fin)
+                table = frr_reference(
+                    topo, self.n_atoms, inputs=fin,
+                    srlg_disjoint=self.policy.srlg_disjoint,
+                    node_protection=self.policy.node_protection,
+                )
                 convergence.note_dispatch("frr", "scalar")
         _FRR_SECONDS.labels(engine=self.engine).observe(
             time.perf_counter() - t0

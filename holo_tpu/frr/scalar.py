@@ -42,8 +42,15 @@ def frr_reference(
     topo: Topology,
     n_atoms: int = 64,
     inputs: FrrInputs | None = None,
+    srlg_disjoint: bool = False,
+    node_protection: bool = False,
 ) -> BackupTable:
-    """Compute the full backup table with scalar loops."""
+    """Compute the full backup table with scalar loops.
+
+    ``srlg_disjoint``: exclude repair candidates sharing any SRLG bit
+    with the protected link (mirror of the kernel's vectorized policy
+    mask).  ``node_protection``: only node-protecting LFAs are
+    selectable (inequality 3 as policy, not preference)."""
     fin = inputs if inputs is not None else marshal_frr(topo)
     n = topo.n_vertices
     root = int(topo.root)
@@ -75,7 +82,14 @@ def frr_reference(
         post_dist[l] = post.dist
         post_nh[l] = post.nexthop_words(max(n_atoms, topo.n_atoms()))
 
-        usable = [alink[a] != l for a in range(na)]
+        usable = [
+            alink[a] != l
+            and (
+                not srlg_disjoint
+                or (int(fin.link_srlg[l]) & int(fin.adj_srlg[a])) == 0
+            )
+            for a in range(na)
+        ]
 
         # -- LFA (RFC 5286 inequalities 1 + 3, lexicographic pick)
         for dst in range(n):
@@ -92,6 +106,8 @@ def frr_reference(
                 alt = _fadd(acost[a], dn_d)
                 if alt < _INF:
                     cands.append((nprot, alt, nbr[a], a))
+            if node_protection:
+                cands = [c for c in cands if c[0]]
             if not cands:
                 continue
             if any(c[0] for c in cands):

@@ -26,6 +26,15 @@ import numpy as np
 # overflow as long as candidate sums are masked before the add (see sssp.py).
 INF = np.int32(1 << 30)
 
+# Multipath path-count saturation (UCMP weights): shortest-path counts
+# explode combinatorially on dense equal-cost meshes, so every engine
+# (device kernel AND scalar oracle) computes the SAME clamped recursion
+#   npaths[v] = min(sum_{DAG parents u} npaths[u], MP_SAT)
+# over already-clamped parent values.  The clamp keeps the per-round
+# row sum exact in int32: K_pad * MP_SAT < 2**31 for K_pad <= 16384,
+# far above any in-degree bucket build_ell produces in practice.
+MP_SAT = np.int32(1 << 17)
+
 _TOPOLOGY_UIDS = itertools.count()
 
 
@@ -54,6 +63,12 @@ class Topology:
     # layer's next-hop table (interface, address pairs); ECMP sets are
     # bitmasks over these atoms.
     edge_direct_atom: np.ndarray | None = None
+    # Shared-risk link group membership per edge as a uint32 bitmask
+    # (bit g = the edge belongs to SRLG g; 0 = no shared risk).  Policy
+    # input to the FRR engines only — it never enters the DeviceGraph,
+    # so DeltaPath residents cannot serve it stale.  The protocol layer
+    # (or tests/synth) sets it; default is all-zeros (no SRLGs).
+    edge_srlg: np.ndarray | None = None
     # Root vertex index (the calculating router).
     root: int = 0
     names: list = field(default_factory=list)  # optional, debugging only
@@ -67,6 +82,10 @@ class Topology:
             self.edge_direct_atom = np.full(self.edge_src.shape, -1, np.int32)
         else:
             self.edge_direct_atom = np.asarray(self.edge_direct_atom, np.int32)
+        if self.edge_srlg is None:
+            self.edge_srlg = np.zeros(self.edge_src.shape, np.uint32)
+        else:
+            self.edge_srlg = np.asarray(self.edge_srlg, np.uint32)
         # Identity for device-marshaling caches: a process-unique id plus a
         # generation bumped by touch().  Callers mutating arrays in place
         # MUST call touch() or cached DeviceGraphs go stale.
@@ -124,6 +143,7 @@ class Topology:
             edge_dst=self.edge_dst[keep],
             edge_cost=self.edge_cost[keep],
             edge_direct_atom=self.edge_direct_atom[keep],
+            edge_srlg=self.edge_srlg[keep],
             root=self.root,
             names=self.names,
         )

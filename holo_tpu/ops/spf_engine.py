@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from holo_tpu import telemetry
-from holo_tpu.ops.graph import INF, EllGraph, TopologyDelta
+from holo_tpu.ops.graph import INF, MP_SAT, EllGraph, TopologyDelta
 
 # Host-side marshal metrics: every DeviceGraph build reports how long
 # the ELL expansion took and how much of the padded slot space is real
@@ -90,6 +90,52 @@ class SpfTensors(NamedTuple):
     parent: jax.Array  # int32[N]; chosen first parent, N (sentinel) if none
     hops: jax.Array  # int32[N]; router hops from root (first-parent rule)
     nexthops: jax.Array  # uint32[N, W] atom bitmask
+
+
+class MultipathTensors(NamedTuple):
+    """Multi-parent frontier planes of one SPF run (ISSUE 10 tentpole).
+
+    ``Kp`` is the pow2-padded parent-set width (k <= 8) and ``A`` the
+    atom-lane width (``W * 32``).  Per vertex:
+
+    - ``parents`` — up to Kp admissible parents in ascending
+      ``(path cost via parent, parent id)`` order, sentinel N beyond
+      the set.  Admissible = shortest-path-DAG parents (the weighted
+      ECMP set, path cost == dist) followed by *loop-free diversity*
+      parents: sources u of valid in-edges with ``dist[u] < dist[v]``
+      strictly — every shortest root→u path then provably avoids v
+      (a path through v would cost >= dist[v] > dist[u]), so the
+      alternative root→u→v path is loop-free (the per-vertex downward
+      criterion of RFC 5286 inequality 1 with D(u,v) collapsed; the
+      k-shortest-diversity selection of arXiv:2007.03776 done as a
+      dense batched computation).
+    - ``pdist`` — total path cost via that parent (INF past the set);
+      ``pdist == dist`` marks the equal-cost (ECMP) members.
+    - ``pweight`` — saturated shortest-path count of the parent
+      (``npaths[parent]``): the UCMP mass a via-parent split carries.
+    - ``npaths`` — saturated shortest-path count of the vertex itself.
+    - ``nh_weights`` — per next-hop atom UCMP weights: the saturated
+      number of shortest root→v paths whose first hop is that atom
+      (sums to ``npaths`` when every hops==0 DAG slot carries an atom).
+    """
+
+    parents: jax.Array  # int32[N, Kp]; sentinel N past the set
+    pdist: jax.Array  # int32[N, Kp]; INF past the set
+    pweight: jax.Array  # int32[N, Kp]; 0 past the set
+    npaths: jax.Array  # int32[N]; saturated at MP_SAT
+    nh_weights: jax.Array  # int32[N, A]; saturated at MP_SAT
+
+
+def mp_pad(k: int) -> int:
+    """The pow2-padded parent-set width for a ``max-paths`` k (<= 8).
+
+    One compiled program per padded width: the protocol's 1..8 knob
+    collapses onto {1, 2, 4, 8} shape buckets."""
+    k = max(1, min(int(k), 8))
+    kp = 1
+    while kp < k:
+        kp *= 2
+    return kp
 
 
 def device_graph_from_ell(ell: EllGraph) -> DeviceGraph:
@@ -993,6 +1039,290 @@ def _hops_nh_fixpoint(g, root, dag, parent, hops0, nh0, limit):
         cond, body, (hops0, nh0, jnp.bool_(True), 0)
     )
     return hops, nh
+
+
+def _slot_atom_onehot(g: DeviceGraph) -> jax.Array:
+    """int32[N, K, A] 0/1 expansion of the per-slot direct-atom words —
+    the static scatter basis of the per-atom UCMP weight recurrence."""
+    n, k = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    bits = jnp.arange(32, dtype=jnp.uint32)
+    oh = ((g.direct_nh_words[:, :, :, None] >> bits) & jnp.uint32(1)).astype(
+        jnp.int32
+    )  # [N, K, W, 32]
+    return oh.reshape(n, k, w * 32)
+
+
+def _mp_fixpoint(g, root, dag, parent, hops0, nh0, np0, aw0, limit):
+    """Packed Jacobi fixpoint over a settled DAG for the FULL multipath
+    state: hops + next-hop words + saturated path counts + per-atom
+    UCMP weights, ONE row gather per round (the widened analog of
+    :func:`_hops_nh_fixpoint`; state lanes int32[N, 2+W+A]).
+
+    Every lane is RECOMPUTED (never accumulated) from the gathered
+    neighbor values and the DAG/parent chain is acyclic with a fixed
+    boundary, so each fixpoint equation — including the clamped
+    path-count recursion ``npaths[v] = min(sum npaths[u], MP_SAT)``,
+    which is monotone in already-clamped parent values — has exactly
+    one solution: any seed converges bit-exactly (fresh seeds give the
+    full kernel, the previous run's arrays give the incremental path).
+    """
+    n = g.in_src.shape[0]
+    w = g.direct_nh_words.shape[2]
+    big = jnp.int32(n + 1)
+    sat = jnp.int32(MP_SAT)
+    is_root = jnp.arange(n) == root
+    inc = g.is_router.astype(jnp.int32)
+    parent_slot = g.in_src == parent[:, None]
+    has_parent = parent < n
+    direct_i32 = jax.lax.bitcast_convert_type(g.direct_nh_words, jnp.int32)
+    onehot = _slot_atom_onehot(g)  # int32[N, K, A]
+
+    def cond(carry):
+        _, _, _, _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        hops, nh, npaths, aw, _, it = carry
+        state = jnp.concatenate(
+            [hops[:, None], npaths[:, None], nh, aw], axis=1
+        )  # int32[N, 2+W+A]
+        nbr = state[g.in_src]  # [N, K, C] — the ONE gather per round
+        h_nbr = nbr[:, :, 0]
+        np_nbr = nbr[:, :, 1]
+
+        ph = jnp.where(parent_slot, h_nbr, big).min(axis=1)
+        hops_new = jnp.where(
+            is_root, 0, jnp.where(has_parent & (ph < big), ph + inc, big)
+        ).astype(jnp.int32)
+
+        nh_new = _nh_words_round(
+            dag, h_nbr, direct_i32, lambda wi: nbr[:, :, 2 + wi]
+        )
+
+        # Saturated path counts: sum of (clamped) parent counts over
+        # the DAG slots.  Row sums stay exact in int32 (see MP_SAT).
+        np_sum = jnp.where(dag, np_nbr, 0).sum(axis=1)
+        np_new = jnp.where(
+            is_root, 1, jnp.minimum(np_sum, sat)
+        ).astype(jnp.int32)
+
+        # Per-atom weights: a hops==0 DAG parent contributes its path
+        # count on the slot's direct atom lane; any other DAG parent
+        # contributes its own weight row — the direct-vs-inherit split
+        # of the next-hop rule, carrying multiplicity.
+        direct_slot = (dag & (h_nbr == 0)).astype(jnp.int32)
+        inherit_slot = (dag & (h_nbr != 0)).astype(jnp.int32)
+        aw_nbr = nbr[:, :, 2 + w :]  # [N, K, A]
+        contrib = (
+            onehot * (np_nbr * direct_slot)[:, :, None]
+            + aw_nbr * inherit_slot[:, :, None]
+        )
+        aw_new = jnp.minimum(contrib.sum(axis=1), sat).astype(jnp.int32)
+
+        changed = (
+            jnp.any(hops_new != hops)
+            | jnp.any(nh_new != nh)
+            | jnp.any(np_new != npaths)
+            | jnp.any(aw_new != aw)
+        )
+        return hops_new, nh_new, np_new, aw_new, changed, it + 1
+
+    hops, nh, npaths, aw, _, _ = jax.lax.while_loop(
+        cond, body, (hops0, nh0, np0, aw0, jnp.bool_(True), 0)
+    )
+    return hops, nh, npaths, aw
+
+
+def _mp_parent_sets(g, root, dist, ok, npaths, kp: int):
+    """Closed-form parent-set extraction from settled distances:
+    (parents, pdist, pweight) int32[N, Kp] planes per the
+    :class:`MultipathTensors` contract.
+
+    ``kp`` rounds of masked lexicographic min over the [N, K] slot
+    planes — each round emits the best remaining (path cost, source)
+    pair and retires every slot of that source, so parallel links
+    collapse onto one parent entry at their cheapest cost."""
+    n = g.in_src.shape[0]
+    d_nbr = dist[g.in_src]
+    not_root = (jnp.arange(n) != root)[:, None]
+    reach = (dist < INF)[:, None]
+    dag = (
+        ok & (d_nbr < INF) & reach & (d_nbr + g.in_cost == dist[:, None])
+        & not_root
+    )
+    # Loop-free diversity slots: strictly-downward sources.  Strictness
+    # matters — dist[u] == dist[v] (zero-cost network→router edges)
+    # could route a shortest root→u path through v.
+    divers = ok & (d_nbr < INF) & reach & (d_nbr < dist[:, None]) & not_root
+    adm = dag | divers
+    pathcost = jnp.where(adm, d_nbr + g.in_cost, INF)
+    np_nbr = npaths[g.in_src]  # [N, K]
+
+    parents, pdists, pweights = [], [], []
+    remaining = adm
+    for _ in range(kp):
+        cmin = jnp.where(remaining, pathcost, INF).min(axis=1)
+        tie = remaining & (pathcost == cmin[:, None])
+        smin = jnp.where(tie, g.in_src, n).min(axis=1)
+        has = cmin < INF
+        parents.append(jnp.where(has, smin, n).astype(jnp.int32))
+        pdists.append(jnp.where(has, cmin, INF).astype(jnp.int32))
+        sel = tie & (g.in_src == smin[:, None])
+        pweights.append(
+            jnp.where(has, jnp.where(sel, np_nbr, 0).max(axis=1), 0).astype(
+                jnp.int32
+            )
+        )
+        remaining = remaining & (g.in_src != smin[:, None])
+    return (
+        jnp.stack(parents, axis=1),
+        jnp.stack(pdists, axis=1),
+        jnp.stack(pweights, axis=1),
+    )
+
+
+def spf_one_multipath(
+    g: DeviceGraph,
+    root: jax.Array,
+    kp: int,
+    edge_mask: jax.Array | None = None,
+    max_iters: int | None = None,
+) -> tuple[SpfTensors, MultipathTensors]:
+    """Full SPF + the multi-parent frontier in ONE jitted program.
+
+    Phase 1 is the lean distance relaxation; the DAG, first parent and
+    parent-set planes are closed-form in the settled distances; phase 2
+    chases hops, next-hop words, path counts and per-atom UCMP weights
+    together through a single packed row gather per round (the hybrid
+    engine's schedule, widened).  ``kp`` is static (pow2, <= 8): one
+    XLA program per (shape, kp) bucket.  The SpfTensors half is
+    bit-identical to :func:`spf_one` (parity-gated), so arming
+    multipath can never change single-path routing state.
+
+    Memory note: the packed state carries ``A = W*32`` weight lanes —
+    size batches like the what-if bench, not the 50k single-SPF path.
+    """
+    n, k = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    ok = _slot_mask(g, edge_mask)
+    dist = sssp_distances(g, root, edge_mask, max_iters)
+    dag = _sp_dag(g, dist, ok, root)
+    parent = _first_parent(g, dag, dist[g.in_src])
+
+    big = jnp.int32(n + 1)
+    limit = n if max_iters is None else max_iters
+    hops0 = jnp.where(jnp.arange(n) == root, 0, big).astype(jnp.int32)
+    nh0 = jnp.zeros((n, w), jnp.int32)
+    np0 = jnp.where(jnp.arange(n) == root, 1, 0).astype(jnp.int32)
+    aw0 = jnp.zeros((n, w * 32), jnp.int32)
+    hops, nh, npaths, aw = _mp_fixpoint(
+        g, root, dag, parent, hops0, nh0, np0, aw0, limit
+    )
+    parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
+    sp = SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+    mp = MultipathTensors(
+        parents=parents,
+        pdist=pdist,
+        pweight=pweight,
+        npaths=jnp.where(dist < INF, npaths, 0),
+        nh_weights=aw,
+    )
+    return sp, mp
+
+
+def spf_one_incremental_multipath(
+    g: DeviceGraph,
+    root: jax.Array,
+    prev: SpfTensors,
+    prev_mp: MultipathTensors,
+    seed_rows: jax.Array,
+    kp: int,
+    max_iters: int | None = None,
+) -> tuple[SpfTensors, MultipathTensors]:
+    """Incremental multipath SPF: the DeltaPath recompute
+    (:func:`spf_one_incremental`) with the widened phase-2 state seeded
+    from the previous run's multipath planes.  The parent-set planes
+    are closed-form in the settled distances, so only the packed
+    fixpoint reconverges — rounds ~ changed-region depth.  Bit-identical
+    to ``spf_one_multipath(g, root, kp)`` by fixpoint uniqueness."""
+    n, k = g.in_src.shape
+    limit = n if max_iters is None else max_iters
+    big = jnp.int32(n + 1)
+    ok = g.in_valid  # the incremental path never carries an edge mask
+
+    par = prev.parent
+    has_par = par < n
+    par_safe = jnp.where(has_par, par, 0)
+    aff0 = jnp.zeros((n,), bool).at[seed_rows].set(True, mode="drop")
+
+    def acond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def abody(carry):
+        aff, _, it = carry
+        new = aff | (jnp.where(has_par, aff[par_safe], False))
+        return new, jnp.any(new != aff), it + 1
+
+    aff, _, _ = jax.lax.while_loop(acond, abody, (aff0, jnp.bool_(True), 0))
+    dist0 = jnp.where(aff, INF, prev.dist).at[root].set(0)
+
+    def rcond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def rbody(carry):
+        dist, _, it = carry
+        d_nbr = dist[g.in_src]
+        usable = ok & (d_nbr < INF)
+        cand = jnp.where(usable, d_nbr + g.in_cost, INF)
+        new = jnp.minimum(dist, cand.min(axis=1))
+        return new, jnp.any(new != dist), it + 1
+
+    dist, _, _ = jax.lax.while_loop(rcond, rbody, (dist0, jnp.bool_(True), 0))
+
+    dag = _sp_dag(g, dist, ok, root)
+    parent = _first_parent(g, dag, dist[g.in_src])
+    nh_prev = jax.lax.bitcast_convert_type(prev.nexthops, jnp.int32)
+    hops, nh, npaths, aw = _mp_fixpoint(
+        g, root, dag, parent, prev.hops, nh_prev,
+        prev_mp.npaths, prev_mp.nh_weights, limit,
+    )
+    parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
+    sp = SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+    mp = MultipathTensors(
+        parents=parents,
+        pdist=pdist,
+        pweight=pweight,
+        npaths=jnp.where(dist < INF, npaths, 0),
+        nh_weights=aw,
+    )
+    return sp, mp
+
+
+def spf_multipath_batch(
+    g: DeviceGraph,
+    root: jax.Array,
+    edge_masks: jax.Array,
+    kp: int,
+    max_iters: int | None = None,
+) -> tuple[SpfTensors, MultipathTensors]:
+    """Batched multipath what-if: vmap of :func:`spf_one_multipath`
+    over scenario edge masks (bool[B, E]) — ECMP/UCMP and diversity
+    planes for every scenario in one dispatch."""
+    fn = jax.vmap(lambda m: spf_one_multipath(g, root, kp, m, max_iters))
+    return fn(edge_masks)
 
 
 def spf_one_incremental(

@@ -245,3 +245,17 @@ def sharded_whatif_jit(
         return constrain_batch(mesh, out)
 
     return step
+
+
+def sharded_multipath_jit(mesh: Mesh, kp: int, max_iters: int | None = None):
+    """Sharded multipath what-if (ISSUE 10): the scenario batch rides
+    the same batch axis, the parent-set / weight planes ride the
+    result pytree — one program per (mesh, kp)."""
+    from holo_tpu.ops.spf_engine import spf_multipath_batch
+
+    @jax.jit
+    def step(g: DeviceGraph, root, edge_masks):
+        sp, mp = spf_multipath_batch(g, root, edge_masks, kp, max_iters)
+        return constrain_batch(mesh, sp), constrain_batch(mesh, mp)
+
+    return step

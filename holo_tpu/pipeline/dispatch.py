@@ -98,7 +98,7 @@ class PipelineTicket:
 
     __slots__ = (
         "key", "kind", "generation", "_event", "_value", "_exc",
-        "skipped", "superseded", "_pipeline",
+        "skipped", "superseded", "_pipeline", "_cbs", "_cb_lock",
     )
 
     def __init__(self, pipeline, key, kind: str, generation: int):
@@ -111,6 +111,34 @@ class PipelineTicket:
         self._exc: BaseException | None = None
         self.skipped = False  # breaker-open skip: never executed
         self.superseded = False  # coalesced away by a newer generation
+        self._cbs: list = []
+        self._cb_lock = threading.Lock()
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(ticket)`` at completion (immediately when already
+        done).  Callbacks fire on the COMPLETING thread — the pipeline
+        worker for queued work — so receivers must hop back onto their
+        own actor loop before touching instance state (the deferred
+        FRR-attach seam posts itself a loop message).  Callback
+        exceptions are swallowed: a consumer bug must not poison the
+        worker or the other callbacks."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._cbs.append(fn)
+                return
+        self._run_cb(fn)
+
+    def _run_cb(self, fn) -> None:
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001 — see add_done_callback
+            log.exception("pipeline ticket done-callback failed")
+
+    def _fire_cbs(self) -> None:
+        with self._cb_lock:
+            cbs, self._cbs = self._cbs, []
+        for fn in cbs:
+            self._run_cb(fn)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -136,10 +164,12 @@ class PipelineTicket:
     def _complete(self, value) -> None:
         self._value = value
         self._event.set()
+        self._fire_cbs()
 
     def _fail(self, exc: BaseException) -> None:
         self._exc = exc
         self._event.set()
+        self._fire_cbs()
 
     def _skip(self, superseded: bool = False) -> None:
         if superseded:
@@ -147,6 +177,7 @@ class PipelineTicket:
         else:
             self.skipped = True
         self._event.set()
+        self._fire_cbs()
 
 
 class _Item:
@@ -468,7 +499,10 @@ class LazySpfResult:
 
     __slots__ = ("_ticket",)
 
-    _FIELDS = ("dist", "parent", "hops", "nexthop_words")
+    _FIELDS = (
+        "dist", "parent", "hops", "nexthop_words",
+        "parents", "pdist", "pweight", "npaths", "nh_weights",
+    )
 
     def __init__(self, ticket: PipelineTicket):
         self._ticket = ticket
@@ -511,6 +545,16 @@ class LazyBackupTable:
                 f"pipelined FRR dispatch for {self._ticket.key} skipped"
             )
         return res
+
+    def pending(self) -> bool:
+        """True while the dispatch is still in flight — the protocol's
+        defer-the-force probe (ISSUE 10: the SPF path must not pay the
+        FRR force; it re-attaches from a worker done-callback)."""
+        return not self._ticket.done()
+
+    def on_done(self, fn) -> None:
+        """Completion hook (fires on the pipeline worker thread)."""
+        self._ticket.add_done_callback(fn)
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -632,11 +676,11 @@ class AsyncSpfBackend:
 
     # -- SpfBackend interface ------------------------------------------
 
-    def compute(self, topo, edge_mask=None):
+    def compute(self, topo, edge_mask=None, multipath_k: int = 1):
         inner = self.inner
         pipe = self.pipeline
         if pipe is None or pipe.closed:
-            return inner.compute(topo, edge_mask)
+            return inner.compute(topo, edge_mask, multipath_k=multipath_k)
         if inner.breaker.state == "open":
             # Degraded mode runs on the CALLER's thread, exactly like
             # the unpipelined breaker: N threaded instances' scalar
@@ -644,8 +688,8 @@ class AsyncSpfBackend:
             # worker while the device is down.  Safe w.r.t. the
             # per-key contract: the scalar path touches no device
             # residents or retained tensors.
-            return inner.compute(topo, edge_mask)
-        if getattr(inner, "engine", None) == "blocked":
+            return inner.compute(topo, edge_mask, multipath_k=multipath_k)
+        if getattr(inner, "engine", None) == "blocked" and multipath_k <= 1:
             # The blocked-Pallas experiment has no split-phase path;
             # run it whole on the worker (actors still don't block).
             ticket = pipe.submit(
@@ -654,13 +698,17 @@ class AsyncSpfBackend:
             )
             return LazySpfResult(ticket)
         fallback = lambda: inner._noted_fallback(  # noqa: E731
-            lambda: inner._oracle.compute(topo, edge_mask)
+            lambda: inner._oracle.compute(
+                topo, edge_mask, multipath_k=multipath_k
+            )
         )
         ticket = pipe.submit(
             self._key(topo), "one",
             launch=lambda: _guarded_launch(
                 inner.breaker, "spf.one",
-                lambda: inner.launch_one(topo, edge_mask),
+                lambda: inner.launch_one(
+                    topo, edge_mask, multipath_k=multipath_k
+                ),
             ),
             finish=lambda st: _guarded_finish(
                 st, inner.finish_one, fallback
@@ -668,22 +716,34 @@ class AsyncSpfBackend:
         )
         return LazySpfResult(ticket)
 
-    def compute_whatif(self, topo, edge_masks):
-        return self.inner.compute_whatif(topo, edge_masks)
+    def compute_whatif(self, topo, edge_masks, multipath_k: int = 1):
+        return self.inner.compute_whatif(
+            topo, edge_masks, multipath_k=multipath_k
+        )
 
     def compute_multiroot(self, topo, roots):
         return self.inner.compute_multiroot(topo, roots)
 
     # -- advisory what-if (the coalescing + breaker-skip seam) ----------
 
-    def compute_whatif_async(self, topo, edge_masks) -> PipelineTicket:
+    def compute_whatif_async(
+        self, topo, edge_masks, generation: int | None = None
+    ) -> PipelineTicket:
         """Enqueue an advisory what-if batch.  Returns the ticket;
         ``result()`` yields the usual list of SpfResults — or None when
         the batch was skipped (circuit open) or superseded by a newer
-        generation's batch for the same (uid, root)."""
+        generation's batch for the same (uid, root).
+
+        ``generation`` defaults to the topology's own generation, but
+        protocol actors pass a monotonic per-instance stamp (their SPF
+        run counter): every SPF marshals a FRESH topology whose local
+        generation restarts, and without the stamp a queued batch from
+        run N would be "shared" with run N+1 instead of superseded."""
         inner = self.inner
         pipe = self.pipeline
-        gen = int(topo.cache_key[1])
+        gen = int(
+            topo.cache_key[1] if generation is None else generation
+        )
         if pipe is None or pipe.closed:
             t = PipelineTicket(None, self._key(topo), "whatif", gen)
             t._complete(inner.compute_whatif(topo, edge_masks))

@@ -61,7 +61,8 @@ from holo_tpu import telemetry
 log = logging.getLogger("holo_tpu.pipeline.tuner")
 
 #: persisted-table format version: bump to invalidate old tables
-TABLE_VERSION = 1
+#: (v2: shape buckets grew the multipath parent-set width element)
+TABLE_VERSION = 2
 
 #: gather-path fixpoint engines (all bit-identical; see ops/spf_engine)
 ENGINES = ("seq", "fused", "packed", "hybrid")
@@ -104,12 +105,16 @@ def _pow2(n: int) -> int:
 
 
 def shape_bucket(
-    n_vertices: int, n_edges: int, batch: int = 1, mesh=None
+    n_vertices: int, n_edges: int, batch: int = 1, mesh=None, k: int = 1
 ) -> tuple:
     """The tuner's shape key: pow2-quantized (V, E, batch) + the mesh
     identity (the same shapes under a different sharding are a
-    different XLA program — see ``TpuSpfBackend._track_compile``)."""
-    return (_pow2(n_vertices), _pow2(n_edges), _pow2(batch), mesh)
+    different XLA program — see ``TpuSpfBackend._track_compile``) + the
+    multipath parent-set width ``k`` (ISSUE 10: the widened kernel is a
+    different program with different walls — k=8 samples must never
+    outvote the k=1 engine medians, and the DeltaPath depth ratio of a
+    multipath chain is its own measurement)."""
+    return (_pow2(n_vertices), _pow2(n_edges), _pow2(batch), mesh, int(k))
 
 
 def _median(vals) -> float | None:

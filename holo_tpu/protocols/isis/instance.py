@@ -2050,6 +2050,13 @@ class IsisInstance(Actor):
                         topo_new.link_delta(delta)
                 self._spf_delta_bases[mt_id] = (order, atoms_new, topo_new)
 
+            # IS-IS max-paths stays a HOST-side clamp with the
+            # reference's lowest-address semantics (spf.rs:920-929,
+            # bit-for-bit — conformance replays depend on it), so the
+            # dispatch deliberately does NOT arm the widened multipath
+            # kernel: its UCMP planes would be computed and never read
+            # here.  The weight-consuming seams are the OSPF stacks
+            # (v2 derive_routes / v3 _clamp_max_paths).
             topo, atoms4 = _build(lambda k, node: node["is"], 0)
             _link_delta(0, topo, atoms4)
             res4 = self.backend.compute(topo)

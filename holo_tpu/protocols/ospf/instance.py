@@ -146,6 +146,16 @@ class GrRestartExpireMsg:
 
 
 @dataclass
+class FrrTablesReadyMsg:
+    """Posted (cross-thread) by the pipeline worker's done-callback
+    when every pending lazy backup table of an SPF run completed: the
+    actor then attaches backups and republishes routes that gained
+    them — the force never runs on the SPF critical path (ISSUE 10)."""
+
+    run: int = 0  # spf_run_count stamp (stale messages are harmless)
+
+
+@dataclass
 class AgeTickMsg:
     pass
 
@@ -204,6 +214,17 @@ class InstanceConfig:
     # every full SPF one batched backup-table run per area precomputes
     # LFA/remote-LFA/TI-LFA repairs, attached to published routes.
     frr: object = None
+    # ECMP width limit (ietf-ospf ``max-paths``): None = unlimited
+    # (every equal-cost next hop installs, the historical behavior).
+    # 2..8 arms the vectorized multipath dispatch (ISSUE 10): the SPF
+    # runs with k-wide parent-set planes, routes carry UCMP weights,
+    # and ECMP sets clamp to the highest-weight max-paths next hops.
+    max_paths: int | None = None
+    # Advisory what-if batching (PR 9 follow-up): > 0 enqueues that
+    # many single-link-failure scenarios through the async pipeline
+    # after every full SPF (coalesced/skipped by the pipeline; results
+    # feed the whatif-advisory stats only, never the RIB).
+    whatif_advisory: int = 0
     # RFC 6987 stub-router: advertise MaxLinkMetric (0xFFFF) on every
     # transit/p2p link so neighbors route around us while our own
     # adjacencies and stub prefixes stay reachable (maintenance mode).
@@ -380,6 +401,12 @@ class OspfInstance(Actor):
         # compile cache.
         self.frr_tables: dict = {}
         self._frr_engine = None
+        # ISSUE 10 satellite: deferred FRR-backup attach (pipelined
+        # tables are forced on the worker, never on the SPF path) and
+        # advisory what-if tickets + counters per area.
+        self._frr_attach_deferred = False
+        self._whatif_tickets: dict = {}
+        self._whatif_stats: dict = {"enqueued": 0, "completed": 0}
         self.bier_routes: dict = {}
         # Shared opaque-id allocator for RFC 7684 extended-prefix LSAs:
         # keys are ("sr", prefix) and ("bier", sd_id); ids never reused.
@@ -625,6 +652,8 @@ class OspfInstance(Actor):
             self._spf_holddown_fired()
         elif isinstance(msg, GrRestartExpireMsg):
             self._gr_restart_expired()
+        elif isinstance(msg, FrrTablesReadyMsg):
+            self._frr_tables_ready()
         elif isinstance(msg, AgeTickMsg):
             self._age_tick()
         elif isinstance(msg, IfUpMsg):
@@ -2813,7 +2842,9 @@ class OspfInstance(Actor):
             # graph in place instead of re-marshaling the area LSDB.
             link_spf_delta(self._spf_delta_bases.get(area.area_id), st)
             self._spf_delta_bases[area.area_id] = st
-            res = self.backend.compute(st.topo)
+            res = self.backend.compute(
+                st.topo, multipath_k=self._multipath_k()
+            )
             area_results[area.area_id] = (st, res)
             # Reachable routers per area WITH their flags as of this SPF
             # run: operational state serves abr-count/asbr-count from the
@@ -2832,7 +2863,10 @@ class OspfInstance(Actor):
                 for rid, v in st.router_index.items()
                 if res.dist[v] < _INF
             }
-            intra = derive_routes(st, res, area.lsdb, now, area.area_id)
+            intra = derive_routes(
+                st, res, area.lsdb, now, area.area_id,
+                max_paths=self.config.max_paths,
+            )
             area_intra[area.area_id] = intra
             for prefix, route in intra.items():
                 cur = all_routes.get(prefix)
@@ -2853,6 +2887,10 @@ class OspfInstance(Actor):
             }
         else:
             self.frr_tables = {}
+
+        # Advisory what-if batches ride the async pipeline (PR 9
+        # follow-up); enqueue-only — nothing here waits on them.
+        self._enqueue_whatif_advisory(area_results)
 
         # Inter-area routes (RFC 2328 §16.2): shared consumption stage
         # (also used by the partial run with a prefix scope).
@@ -3691,6 +3729,97 @@ class OspfInstance(Actor):
                     )
         return out
 
+    def _multipath_k(self) -> int:
+        """The SPF dispatch's parent-set width: ``max-paths`` when it
+        limits ECMP (2..8 → the vectorized multipath kernel with UCMP
+        weights), else 1 (the unchanged single-parent program)."""
+        m = self.config.max_paths
+        return m if (m is not None and m > 1) else 1
+
+    def _enqueue_whatif_advisory(self, area_results: dict) -> None:
+        """Protocol-level consumption of ``compute_whatif_async`` (PR 9
+        follow-up): after each full SPF, enqueue an advisory batch of
+        single-link-failure scenarios per area through the async
+        pipeline.  Purely advisory — nothing on the SPF path waits for
+        the results; a storm's batches coalesce (newer SPF generation
+        supersedes a queued older one) and breaker-open batches are
+        skipped, both visible in ``holo_pipeline_coalesced_total`` /
+        ``holo_pipeline_breaker_skip_total``."""
+        budget = int(self.config.whatif_advisory or 0)
+        enqueue = getattr(self.backend, "compute_whatif_async", None)
+        if budget <= 0 or enqueue is None:
+            return
+        import numpy as np
+
+        for aid, (st, _res) in area_results.items():
+            topo = st.topo
+            if topo.n_edges == 0:
+                continue
+            pair: dict = {}
+            for e in range(topo.n_edges):
+                pair.setdefault(
+                    (int(topo.edge_src[e]), int(topo.edge_dst[e])), e
+                )
+            n = min(budget, topo.n_edges)
+            masks = np.ones((n, topo.n_edges), bool)
+            row = 0
+            for e in range(topo.n_edges):
+                if row >= n:
+                    break
+                rev = pair.get(
+                    (int(topo.edge_dst[e]), int(topo.edge_src[e]))
+                )
+                if rev is not None and rev < e:
+                    # The reverse direction already produced this
+                    # link's scenario: one row per LINK, not per
+                    # directed edge, or half the budget is duplicates.
+                    continue
+                # Mask both directions of the link (§16.1 contract).
+                masks[row, e] = False
+                if rev is not None:
+                    masks[row, rev] = False
+                row += 1
+            ticket = enqueue(
+                topo, masks[:row], generation=self.spf_run_count
+            )
+            self._whatif_tickets[aid] = ticket
+            self._whatif_stats["enqueued"] += 1
+            ticket.add_done_callback(self._whatif_done)
+
+    def _whatif_done(self, _ticket) -> None:
+        # Worker-thread callback: a plain counter bump only (ints are
+        # GIL-atomic; the advisory results themselves stay on the
+        # ticket for operational-state readers).
+        self._whatif_stats["completed"] += 1
+
+    def _frr_tables_ready(self) -> None:
+        """Actor-side completion of a deferred FRR attach: join the
+        (now materialized) backup tables onto the current routes and
+        republish the prefixes that gained backups."""
+        if not self._frr_attach_deferred or self._spf_cache is None:
+            return
+        self._frr_attach_deferred = False
+        import copy as _copy
+
+        routes = self.routes
+        before = {p: r.backups for p, r in routes.items()}
+        # NOT deferred=True: a newer SPF may have swapped in tables
+        # that are THEMSELVES still in flight — the pending check then
+        # re-defers (fresh callbacks) instead of forcing them here.
+        self._attach_frr_backups(routes)
+        if self._frr_attach_deferred:
+            return
+        old = {}
+        for p, r in routes.items():
+            if (r.backups or None) != (before.get(p) or None):
+                c = _copy.copy(r)
+                c.backups = before.get(p)
+                old[p] = c
+            else:
+                old[p] = r
+        if self.ibus is not None:
+            self._sync_rib(old, routes)
+
     def _frr_engine_for(self):
         """The instance's FrrEngine when fast reroute is configured."""
         cfg = self.config.frr
@@ -3701,10 +3830,17 @@ class OspfInstance(Actor):
         self._frr_engine = ensure_engine(self._frr_engine, cfg)
         return self._frr_engine
 
-    def _attach_frr_backups(self, all_routes: dict) -> None:
+    def _attach_frr_backups(self, all_routes: dict, deferred: bool = False) -> None:
         """Join the per-area backup tables onto the route table (runs
         after SR label resolution: remote/TI-LFA repairs tunnel through
-        node-SID labels and attach only when the stack resolves)."""
+        node-SID labels and attach only when the stack resolves).
+
+        When the tables are PIPELINED and still in flight, the attach
+        is deferred (ISSUE 10 satellite): a done-callback on the last
+        pending ticket posts :class:`FrrTablesReadyMsg` back to this
+        actor, and the SPF path proceeds without forcing — the FRR
+        device wait moves entirely onto the pipeline worker
+        (``holo_pipeline_wait_seconds{kind=frr}`` stays empty)."""
         cfg = self.config.frr
         if (
             cfg is None
@@ -3713,6 +3849,38 @@ class OspfInstance(Actor):
             or self._spf_cache is None
         ):
             return
+        if not deferred:
+            pending = [
+                t
+                for t in self.frr_tables.values()
+                if getattr(t, "pending", None) is not None and t.pending()
+            ]
+            if pending:
+                self._frr_attach_deferred = True
+                run = self.spf_run_count
+                import threading
+
+                lock = threading.Lock()
+                remaining = [len(pending)]
+
+                def _one_done(_ticket, _remaining=remaining, _run=run,
+                              _lock=lock):
+                    # May fire on the pipeline worker OR inline on this
+                    # actor thread (a ticket that completed between the
+                    # pending scan and registration): the countdown
+                    # must be atomic or a lost decrement strands the
+                    # deferred attach forever.  The winner hops back
+                    # onto the actor loop (deque append is thread-safe;
+                    # the loop drains it on its own thread).
+                    with _lock:
+                        _remaining[0] -= 1
+                        last = _remaining[0] <= 0
+                    if last:
+                        self.loop.send(self.name, FrrTablesReadyMsg(_run))
+
+                for t in pending:
+                    t.on_done(_one_done)
+                return
         from holo_tpu.protocols.ospf.spf_run import attach_frr_backups
 
         # Per-area vertex -> node-SID label maps (vertex ids are area
@@ -3732,6 +3900,15 @@ class OspfInstance(Actor):
             )
 
     def _finish_spf(self, all_routes: dict) -> None:
+        # max-paths applies to the WHOLE table (full and partial runs):
+        # inter-area and external routes inherit raw SPF next-hop sets
+        # via their ABR/ASBR vertex and must clamp like intra routes
+        # (the v3 instance clamps its merged table the same way).
+        # Intra routes were already clamped weight-aware in
+        # derive_routes; re-clamping them is a no-op.
+        from holo_tpu.protocols.ospf.spf_run import clamp_multipath
+
+        clamp_multipath(all_routes, self.config.max_paths)
         self._originate_prefix_sids()
         self._originate_bier()
         self.bier_routes = self._resolve_bier(all_routes)
@@ -3782,6 +3959,8 @@ class OspfInstance(Actor):
                 and prev.dist == route.dist
                 and prev.nexthops == route.nexthops
                 and getattr(prev, "backups", None) == getattr(route, "backups", None)
+                and getattr(prev, "nh_weights", None)
+                == getattr(route, "nh_weights", None)
             ):
                 continue
             if not installable(route):
@@ -3803,6 +3982,17 @@ class OspfInstance(Actor):
                 for nh in route.nexthops
                 if nh.addr is not None
             )
+            nh_weights = {}
+            for nh, w in (getattr(route, "nh_weights", None) or {}).items():
+                if nh.addr is None or nh not in route.nexthops:
+                    continue
+                nh_weights[
+                    Nexthop(
+                        addr=nh.addr,
+                        ifname=nh.ifname,
+                        ifindex=self._ifindex_of(nh.ifname),
+                    )
+                ] = int(w)
             backups = {}
             for pnh, (bnh, labels) in (getattr(route, "backups", None) or {}).items():
                 if pnh.addr is None or bnh.addr is None:
@@ -3829,6 +4019,7 @@ class OspfInstance(Actor):
                     metric=route.dist,
                     nexthops=nhs,
                     backups=backups,
+                    nh_weights=nh_weights,
                 ),
                 sender=self.name,
             )

@@ -214,6 +214,10 @@ class OspfV3Instance(Actor):
         self.frr = None
         self.frr_tables: dict = {}
         self._frr_engine = None
+        # ietf-ospf max-paths (ISSUE 10): None = unlimited ECMP;
+        # 2..8 arms the vectorized multipath dispatch (same contract
+        # as the v2 instance's config.max_paths).
+        self.max_paths: int | None = None
         # DeltaPath: the previous run's (vertex keys, atoms, topology)
         # per area — the diff base for in-place device-graph updates.
         self._spf_delta_bases: dict = {}
@@ -1677,10 +1681,68 @@ class OspfV3Instance(Actor):
             "routes": routes,
             "inter_routes": inter_routes,
         }
+        self._clamp_max_paths(routes, area_results)
         self._attach_frr_backups(routes, area_results)
         self.routes = routes
         if self.route_cb is not None:
             self.route_cb(routes)
+
+    def _clamp_max_paths(self, routes: dict, area_results: dict | None = None) -> None:
+        """ietf-ospf max-paths (ISSUE 10): truncate every route's ECMP
+        set deterministically to the configured width.  With the
+        multipath dispatch armed (max_paths > 1 → the kernel computed
+        UCMP planes) the rank is weight-DESCENDING — the highest-mass
+        paths survive — tie-broken by lowest link-local address (the
+        reference's clamp key); without weights the address key alone
+        decides."""
+        m = self.max_paths
+        if not m or m < 1:
+            return
+        from dataclasses import replace as _replace
+
+        def weights_for(r) -> dict:
+            """{(ifname, ll) -> UCMP weight} from the winning area's
+            multipath planes (empty when unavailable)."""
+            ar = (area_results or {}).get(r.area_id)
+            if ar is None or r.vertex < 0:
+                return {}
+            res, atoms = ar[2], ar[3]
+            nhw = getattr(res, "nh_weights", None)
+            if nhw is None or r.vertex >= len(res.dist):
+                return {}
+            from holo_tpu.protocols.ospf.spf_run import (
+                NexthopAtom,
+                atom_bits,
+            )
+
+            out: dict = {}
+            row = nhw[r.vertex]
+            for a in atom_bits(res.nexthop_words[r.vertex], len(atoms)):
+                atom = atoms[a]
+                w = int(row[a]) if a < len(row) else 0
+                targets = (
+                    atom.expand or ()
+                    if isinstance(atom, NexthopAtom)
+                    else (atom,)
+                )
+                for nh in targets:
+                    out[nh] = out.get(nh, 0) + w
+            return out
+
+        for prefix, r in list(routes.items()):
+            if len(r.nexthops) <= m:
+                continue
+            w = weights_for(r)
+            ranked = sorted(
+                r.nexthops,
+                key=lambda h: (
+                    -w.get(h, 1),
+                    h[1] is None,
+                    h[1].packed if h[1] is not None else b"",
+                    h[0] or "",
+                ),
+            )
+            routes[prefix] = _replace(r, nexthops=frozenset(ranked[:m]))
 
     def _attach_frr_backups(self, routes: dict, area_results: dict) -> None:
         """Join the per-area backup tables onto the v6 route table.
@@ -1994,6 +2056,7 @@ class OspfV3Instance(Actor):
         # Rebuilt routes need their repairs re-joined like the full run,
         # or a partial run would publish them backup-less and flap the
         # kernel entries off/on their precomputed repairs.
+        self._clamp_max_paths(routes, area_results)
         self._attach_frr_backups(routes, area_results)
         self.routes = routes
         if self.route_cb is not None:
@@ -2332,7 +2395,12 @@ class OspfV3Instance(Actor):
                 topo.link_delta(delta)
         self._spf_delta_bases[area.area_id] = (keys, atoms, topo)
 
-        res = self.backend.compute(topo)
+        mp_k = (
+            self.max_paths
+            if self.max_paths is not None and self.max_paths > 1
+            else 1
+        )
+        res = self.backend.compute(topo, multipath_k=mp_k)
         # IP-FRR: the area's backup-table batch rides the same SPF
         # moment (all-roots matrix + per-link post-convergence planes).
         cfg = self.frr

@@ -310,6 +310,10 @@ class IntraRoute:
     # IP-FRR repairs attached after the backup-table run:
     # {primary RouteNexthop -> (backup RouteNexthop, label stack)}.
     backups: dict | None = None
+    # UCMP weights {RouteNexthop -> saturated shortest-path count}
+    # (ISSUE 10): present only when the SPF ran with multipath planes;
+    # rides RouteMsg.nh_weights into the RIB's weighted install.
+    nh_weights: dict | None = None
 
 
 def atom_bits(words: np.ndarray, n_atoms: int) -> list[int]:
@@ -332,12 +336,66 @@ def _atoms_of(words: np.ndarray, atoms: list[NexthopAtom]) -> frozenset[RouteNex
     return frozenset(out)
 
 
+def _atom_weights_of(
+    words: np.ndarray, weights_row: np.ndarray, atoms: list[NexthopAtom]
+) -> dict:
+    """{RouteNexthop -> UCMP weight} for one vertex's next-hop set;
+    atoms resolving to the same next hop (or a vlink expansion) sum."""
+    out: dict = {}
+    for a in atom_bits(words, len(atoms)):
+        atom = atoms[a]
+        w = int(weights_row[a]) if a < len(weights_row) else 0
+        targets = (
+            atom.expand
+            if atom.expand is not None
+            else (RouteNexthop(atom.ifname, atom.addr),)
+        )
+        for nh in targets:
+            out[nh] = out.get(nh, 0) + w
+    return out
+
+
+def _nh_rank(nh, weights: dict):
+    """Deterministic multipath clamp order: UCMP weight descending,
+    then lowest next-hop address (the reference's ECMP clamp key),
+    then interface name."""
+    return (
+        -weights.get(nh, 1),
+        nh.addr is None,
+        nh.addr.packed if nh.addr is not None else b"",
+        nh.ifname or "",
+    )
+
+
+def clamp_multipath(routes: dict, max_paths: int | None) -> int:
+    """Truncate every route's ECMP set to ``max_paths`` next hops (the
+    OSPF ``max-paths`` seam), keeping the highest-weight paths; weights
+    dicts are filtered to the survivors.  Returns routes clamped."""
+    if not max_paths or max_paths < 1:
+        return 0
+    clamped = 0
+    for route in routes.values():
+        if len(route.nexthops) <= max_paths:
+            continue
+        w = route.nh_weights or {}
+        ranked = sorted(route.nexthops, key=lambda nh: _nh_rank(nh, w))
+        keep = frozenset(ranked[:max_paths])
+        route.nexthops = keep
+        if route.nh_weights:
+            route.nh_weights = {
+                nh: ww for nh, ww in route.nh_weights.items() if nh in keep
+            }
+        clamped += 1
+    return clamped
+
+
 def derive_routes(
     st: SpfTopology,
     res: SpfResult,
     lsdb: Lsdb,
     now: float,
     area_id: IPv4Address,
+    max_paths: int | None = None,
 ) -> dict[IPv4Network, IntraRoute]:
     """Intra-area routes from SPF results (RFC 2328 §16.1 steps 2-4).
 
@@ -351,17 +409,26 @@ def derive_routes(
     """
     routes: dict[IPv4Network, IntraRoute] = {}
 
-    def offer(prefix, dist, nhs, vertex=-1):
+    def offer(prefix, dist, nhs, vertex=-1, weights=None):
         cur = routes.get(prefix)
         if cur is None or dist < cur.dist:
-            routes[prefix] = IntraRoute(prefix, dist, nhs, area_id, vertex=vertex)
+            routes[prefix] = IntraRoute(
+                prefix, dist, nhs, area_id, vertex=vertex,
+                nh_weights=dict(weights) if weights else None,
+            )
         elif dist == cur.dist:
             # Equal-cost contributions union next hops; the first
             # contributing vertex keeps the FRR consumption key (its
             # backup covers the merged set's shared failure domain only
             # approximately, matching the reference's per-route pick).
+            merged = None
+            if cur.nh_weights or weights:
+                merged = dict(cur.nh_weights or {})
+                for nh, w in (weights or {}).items():
+                    merged[nh] = merged.get(nh, 0) + w
             routes[prefix] = IntraRoute(
-                prefix, dist, cur.nexthops | nhs, area_id, vertex=cur.vertex
+                prefix, dist, cur.nexthops | nhs, area_id,
+                vertex=cur.vertex, nh_weights=merged,
             )
 
     inv_net = {i: a for a, i in st.network_index.items()}
@@ -376,17 +443,25 @@ def derive_routes(
         elif e.lsa.type == LsaType.ROUTER:
             rlsa[e.lsa.adv_rtr] = e.lsa.body
 
+    # Per-vertex UCMP weights ride the multipath planes when the
+    # dispatch carried them (max-paths > 1 → multipath kernel).
+    nhw = getattr(res, "nh_weights", None)
     n = st.topo.n_vertices
     for v in range(n):
         if res.dist[v] >= INF:
             continue
         nhs = _atoms_of(res.nexthop_words[v], st.atoms)
+        weights = (
+            _atom_weights_of(res.nexthop_words[v], nhw[v], st.atoms)
+            if nhw is not None
+            else None
+        )
         if v in inv_net:
             body = nlsa.get(inv_net[v])
             if body is None:
                 continue
             prefix = apply_mask(inv_net[v], body.mask)
-            offer(prefix, int(res.dist[v]), nhs, vertex=v)
+            offer(prefix, int(res.dist[v]), nhs, vertex=v, weights=weights)
         else:
             body = rlsa.get(inv_rtr[v])
             if body is None:
@@ -394,7 +469,11 @@ def derive_routes(
             for link in body.links:
                 if link.link_type == RouterLinkType.STUB_NETWORK:
                     prefix = apply_mask(link.id, link.data)
-                    offer(prefix, int(res.dist[v]) + link.metric, nhs, vertex=v)
+                    offer(
+                        prefix, int(res.dist[v]) + link.metric, nhs,
+                        vertex=v, weights=weights,
+                    )
+    clamp_multipath(routes, max_paths)
     return routes
 
 
@@ -428,6 +507,8 @@ def attach_frr_backups(
     for route in routes.values():
         if area_id is not None and route.area_id != area_id:
             continue
+        if not cfg.protects_prefix(route.prefix):
+            continue  # per-prefix protection filtering (policy scope)
         v = getattr(route, "vertex", -1)
         if v < 0 or v >= n:
             continue

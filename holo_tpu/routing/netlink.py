@@ -598,11 +598,16 @@ class NetlinkKernel(Kernel):
 
     # -- Kernel interface
 
-    def install(self, prefix, nexthops, proto: Protocol, backups=None) -> None:
+    def install(
+        self, prefix, nexthops, proto: Protocol, backups=None, weights=None
+    ) -> None:
         # ``backups`` (primary -> loop-free alternate) are intentionally
         # not programmed here: Linux has no backup-nexthop attribute for
         # IPv4/v6 routes, so the repair flip is a full RTM_NEWROUTE
         # replace issued by RibManager.local_repair with the backup set.
+        # ``weights`` (UCMP) would map onto RTA_MULTIPATH rtnh_hops;
+        # the lite encoder programs equal-cost legs only (documented
+        # limitation — the weighted group lives in the RIB layer).
         payload = self._route_payload(prefix, nexthops)
         self.nl.request_ack(RTM_NEWROUTE, NLM_F_CREATE | NLM_F_REPLACE, payload)
 

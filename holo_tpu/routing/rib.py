@@ -61,6 +61,11 @@ _RIB_RESTORES = telemetry.counter(
 _RIB_PREFIXES = telemetry.gauge(
     "holo_rib_prefixes", "Prefixes currently present in the RIB"
 )
+_RIB_MICROLOOP = telemetry.counter(
+    "holo_rib_microloop_delays_total",
+    "Reconvergence installs delayed by the RFC 8333 microloop-avoidance "
+    "window (the repair path kept meanwhile)",
+)
 
 
 class _Repair(NamedTuple):
@@ -80,10 +85,13 @@ class Kernel:
         nexthops: frozenset[Nexthop],
         proto: Protocol,
         backups: dict | None = None,
+        weights: dict | None = None,
     ) -> None:
         """Program ``prefix``.  ``backups`` (primary → loop-free backup
         next hop) ride along so the fast-reroute flip is a single
-        replace from state the FIB layer already holds."""
+        replace from state the FIB layer already holds.  ``weights``
+        ({next hop → UCMP weight}, ISSUE 10) program a weighted
+        next-hop group; None/empty = equal-cost hashing."""
         raise NotImplementedError
 
     def uninstall(self, prefix: IpNetwork) -> None:
@@ -103,20 +111,33 @@ class MockKernel(Kernel):
     def __init__(self) -> None:
         self.fib: dict[IpNetwork, tuple[frozenset[Nexthop], Protocol]] = {}
         self.backups: dict[IpNetwork, dict] = {}  # prefix -> primary->backup
+        self.weights: dict[IpNetwork, dict] = {}  # prefix -> nh->weight
         self.lfib: dict[int, frozenset[Nexthop]] = {}  # in-label -> nexthops
         self.log: list[tuple[str, IpNetwork]] = []
 
-    def install(self, prefix, nexthops, proto, backups=None):
+    def install(self, prefix, nexthops, proto, backups=None, weights=None):
+        # Cumulative multipath surface (storm/bench assertions must not
+        # depend on whether the run ENDS mid-failure with repairs
+        # holding single-survivor sets).
+        if len(nexthops) > 1:
+            self.multipath_installs = getattr(self, "multipath_installs", 0) + 1
+        if weights:
+            self.weighted_installs = getattr(self, "weighted_installs", 0) + 1
         self.fib[prefix] = (nexthops, proto)
         if backups:
             self.backups[prefix] = dict(backups)
         else:
             self.backups.pop(prefix, None)
+        if weights:
+            self.weights[prefix] = dict(weights)
+        else:
+            self.weights.pop(prefix, None)
         self.log.append(("install", prefix))
 
     def uninstall(self, prefix):
         self.fib.pop(prefix, None)
         self.backups.pop(prefix, None)
+        self.weights.pop(prefix, None)
         self.log.append(("uninstall", prefix))
 
     def install_label(self, in_label, nexthops):
@@ -131,6 +152,14 @@ class MockKernel(Kernel):
         self.fib.clear()
         self.backups.clear()
         self.lfib.clear()
+
+
+@dataclass
+class MicroloopFlipMsg:
+    """Timer message ending a prefix's RFC 8333 microloop-avoidance
+    window: the delayed post-reconvergence install happens now."""
+
+    prefix: object
 
 
 @dataclass
@@ -182,9 +211,25 @@ class RibManager(Actor):
 
     name = "routing"
 
-    def __init__(self, ibus: Ibus, kernel: Kernel | None = None):
+    def __init__(
+        self,
+        ibus: Ibus,
+        kernel: Kernel | None = None,
+        microloop_delay: float = 0.0,
+    ):
+        """``microloop_delay`` > 0 arms RFC 8333 microloop avoidance:
+        a reconvergence install that would replace an ACTIVE fast-
+        reroute repair is delayed by that many seconds (the repair —
+        already loop-free by construction — keeps forwarding), so this
+        router does not flip to the new primaries while upstream
+        routers still forward on pre-convergence state.  0 (default)
+        installs immediately — the historical behavior."""
         self.ibus = ibus
         self.kernel = kernel or MockKernel()
+        self.microloop_delay = float(microloop_delay)
+        # prefix -> pending delayed RouteMsg + its window timer.
+        self._microloop_pending: dict = {}
+        self._microloop_timers: dict = {}
         self.routes: dict[IpNetwork, _PrefixRoutes] = {}
         self.mpls: dict[int, LabelInstallMsg] = {}  # in-label -> LFIB entry
         # Invoked after any route table change (the provider uses it to
@@ -215,6 +260,9 @@ class RibManager(Actor):
         self.ibus.subscribe(TOPIC_INTERFACE_DEL, self.name)
 
     def handle(self, msg) -> None:
+        if isinstance(msg, MicroloopFlipMsg):
+            self._microloop_fire(msg.prefix)
+            return
         if isinstance(msg, IbusMsg):
             if msg.topic == TOPIC_BFD_STATE:
                 upd = msg.payload
@@ -357,6 +405,7 @@ class RibManager(Actor):
                     rec.msg.nexthops,
                     rec.msg.protocol,
                     backups=rec.msg.backups or None,
+                    weights=getattr(rec.msg, "nh_weights", None) or None,
                 )
                 del self.repaired[prefix]
             elif self._repair_install(prefix, rec.msg, events):
@@ -366,6 +415,47 @@ class RibManager(Actor):
             _RIB_RESTORES.inc(restored)
             convergence.fib_commit(op="restore", restores=restored)
         return restored
+
+    # -- RFC 8333 microloop avoidance (delayed post-reconvergence flip)
+
+    def _microloop_clear(self, prefix) -> None:
+        self._microloop_pending.pop(prefix, None)
+        t = self._microloop_timers.pop(prefix, None)
+        if t is not None:
+            t.cancel()
+
+    def _microloop_fire(self, prefix) -> None:
+        """Window expiry: install the held reconvergence result — if it
+        is still the prefix's winning entry (a later reselect replaces
+        the pending message; a withdraw cancels the window)."""
+        msg = self._microloop_pending.pop(prefix, None)
+        self._microloop_timers.pop(prefix, None)
+        if msg is None:
+            return
+        pr = self.routes.get(prefix)
+        best = pr.best() if pr is not None else None
+        if best is None or best.msg is not msg:
+            return  # superseded since the window opened
+        rec = self.repaired.get(prefix)
+        if rec is not None and rec.msg is msg:
+            # A NEW failure hit during the window: local_repair already
+            # re-flipped against the held message's next hops and the
+            # repair record now tracks it.  Installing the raw primary
+            # set here would put the just-failed next hop back in the
+            # FIB — keep the repair; reconvergence for the new failure
+            # republishes the prefix and clears it the normal way.
+            return
+        self.repaired.pop(prefix, None)
+        self.kernel.install(
+            prefix,
+            msg.nexthops,
+            msg.protocol,
+            backups=msg.backups or None,
+            weights=msg.nh_weights or None,
+        )
+        _RIB_INSTALLS.labels(op="install").inc()
+        self._programmed.add(prefix)
+        convergence.fib_commit(op="install", microloop="delayed")
 
     # -- next-hop tracking (reference rib.rs:64,290)
 
@@ -471,6 +561,7 @@ class RibManager(Actor):
         if not pr.entries:
             del self.routes[msg.prefix]
             self.repaired.pop(msg.prefix, None)
+            self._microloop_clear(msg.prefix)
             if msg.prefix in self._programmed:
                 self.kernel.uninstall(msg.prefix)
                 _RIB_INSTALLS.labels(op="uninstall").inc()
@@ -508,16 +599,38 @@ class RibManager(Actor):
                     # redistribute publish and on_change below still
                     # fire, like every other reselect.
                     pass
+                elif (
+                    rec is not None
+                    and self.microloop_delay > 0
+                    and getattr(self, "loop", None) is not None
+                ):
+                    # RFC 8333 microloop avoidance: the protocol HAS
+                    # reconverged, but flipping off the (loop-free)
+                    # repair immediately risks transient microloops
+                    # while neighbors still run pre-convergence state.
+                    # Hold the repair, install after the window.
+                    self._microloop_pending[prefix] = best.msg
+                    t = self._microloop_timers.get(prefix)
+                    if t is None:
+                        t = self.loop.timer(
+                            self.name,
+                            lambda p=prefix: MicroloopFlipMsg(p),
+                        )
+                        self._microloop_timers[prefix] = t
+                    t.start(self.microloop_delay)
+                    _RIB_MICROLOOP.inc()
                 else:
                     # A reinstall replaces any active FRR local repair:
                     # the protocol has reconverged (or re-published)
                     # this prefix.
                     self.repaired.pop(prefix, None)
+                    self._microloop_clear(prefix)
                     self.kernel.install(
                         prefix,
                         best.msg.nexthops,
                         best.msg.protocol,
                         backups=best.msg.backups or None,
+                        weights=best.msg.nh_weights or None,
                     )
                     _RIB_INSTALLS.labels(op="install").inc()
                     self._programmed.add(prefix)
@@ -530,6 +643,7 @@ class RibManager(Actor):
                 # The withdrawn entry takes any active local repair with
                 # it — a later restore must not resurrect the route.
                 self.repaired.pop(prefix, None)
+                self._microloop_clear(prefix)
                 self.kernel.uninstall(prefix)
                 _RIB_INSTALLS.labels(op="uninstall").inc()
                 self._programmed.discard(prefix)

@@ -23,14 +23,18 @@ from holo_tpu.resilience import faults
 from holo_tpu.resilience.breaker import CircuitBreaker
 from holo_tpu.ops.spf_engine import (
     DeviceGraph,
+    mp_pad,
     note_delta,
     shared_graph_cache,
+    spf_multipath_batch,
     spf_multiroot,
     spf_one,
     spf_one_incremental,
+    spf_one_incremental_multipath,
+    spf_one_multipath,
     spf_whatif_batch,
 )
-from holo_tpu.spf.scalar import spf_reference
+from holo_tpu.spf.scalar import spf_multipath_reference, spf_reference
 from holo_tpu.telemetry import convergence, profiling
 
 # Device-dispatch observability (the tentpole signal set): wall time per
@@ -92,12 +96,22 @@ def _mesh_key():
 
 @dataclass
 class SpfResult:
-    """Backend-independent SPF output in host (numpy) space."""
+    """Backend-independent SPF output in host (numpy) space.
+
+    The multipath planes (ISSUE 10) are present iff the dispatch asked
+    for them (``multipath_k > 1``); ``None`` otherwise — the k=1 path
+    is byte-for-byte the single-parent dispatch (the
+    ``multipath_overhead`` gate's contract)."""
 
     dist: np.ndarray  # int32[N]
     parent: np.ndarray  # int32[N]
     hops: np.ndarray  # int32[N]
     nexthop_words: np.ndarray  # uint32[N, W]
+    parents: np.ndarray | None = None  # int32[N, Kp]; sentinel N
+    pdist: np.ndarray | None = None  # int32[N, Kp]; INF past the set
+    pweight: np.ndarray | None = None  # int32[N, Kp]
+    npaths: np.ndarray | None = None  # int32[N]
+    nh_weights: np.ndarray | None = None  # int32[N, A]
 
 
 def _host_tensors(out, n: int):
@@ -115,6 +129,21 @@ def _host_tensors(out, n: int):
     hops = np.minimum(np.asarray(out.hops)[..., :n], np.int32(n + 1))
     nh = np.asarray(out.nexthops)[..., :n, :]
     return dist, parent, hops, nh
+
+
+def _host_mp(mp, n: int) -> dict:
+    """Multipath-plane readback under the same sharded-row contract as
+    :func:`_host_tensors`: vertex axis sliced to N, the padded-row
+    parent sentinel R renormalized to N.  SpfResult field kwargs."""
+    return {
+        "parents": np.minimum(
+            np.asarray(mp.parents)[..., :n, :], np.int32(n)
+        ),
+        "pdist": np.asarray(mp.pdist)[..., :n, :],
+        "pweight": np.asarray(mp.pweight)[..., :n, :],
+        "npaths": np.asarray(mp.npaths)[..., :n],
+        "nh_weights": np.asarray(mp.nh_weights)[..., :n, :],
+    }
 
 
 @dataclass
@@ -139,6 +168,7 @@ class _InFlightOne:
     mode: str  # "full" | "delta"
     n_atoms: int
     delta_kind: str = ""
+    kp: int = 1  # pow2 multipath width; 1 = single-parent kernel
     remember: bool = False
     sharded: bool = False
     remarshal: bool = False
@@ -171,22 +201,38 @@ class ScalarSpfBackend(SpfBackend):
     def __init__(self, n_atoms: int = 64):
         self.n_atoms = n_atoms
 
-    def _one(self, topo: Topology, edge_mask) -> SpfResult:
+    def _one(self, topo: Topology, edge_mask, kp: int = 1) -> SpfResult:
+        n_atoms = max(self.n_atoms, topo.n_atoms())
+        if kp > 1:
+            out, omp = spf_multipath_reference(
+                topo, kp, edge_mask, n_lanes=((n_atoms + 31) // 32) * 32
+            )
+            return SpfResult(
+                dist=out.dist,
+                parent=out.parent,
+                hops=out.hops,
+                nexthop_words=out.nexthop_words(n_atoms),
+                parents=omp.parents,
+                pdist=omp.pdist,
+                pweight=omp.pweight,
+                npaths=omp.npaths,
+                nh_weights=omp.nh_weights,
+            )
         out = spf_reference(topo, edge_mask)
         return SpfResult(
             dist=out.dist,
             parent=out.parent,
             hops=out.hops,
-            nexthop_words=out.nexthop_words(max(self.n_atoms, topo.n_atoms())),
+            nexthop_words=out.nexthop_words(n_atoms),
         )
 
-    def compute(self, topo, edge_mask=None):
+    def compute(self, topo, edge_mask=None, multipath_k: int = 1):
         # Same dispatch histogram as the TPU backend (kind axis shared):
         # a default-config daemon still reports SPF timing; only the
         # transfer/recompile signals are device-specific.
         t0 = time.perf_counter()
         with telemetry.span("spf.dispatch", kind="one", backend="scalar"):
-            res = self._one(topo, edge_mask)
+            res = self._one(topo, edge_mask, mp_pad(multipath_k))
         _DISPATCH_SECONDS.labels(backend="scalar", kind="one").observe(
             time.perf_counter() - t0
         )
@@ -194,13 +240,14 @@ class ScalarSpfBackend(SpfBackend):
         convergence.note_dispatch("spf", "scalar")
         return res
 
-    def compute_whatif(self, topo, edge_masks):
+    def compute_whatif(self, topo, edge_masks, multipath_k: int = 1):
         t0 = time.perf_counter()
+        kp = mp_pad(multipath_k)
         with telemetry.span(
             "spf.dispatch", kind="whatif", backend="scalar",
             batch=len(edge_masks),
         ):
-            res = [self._one(topo, m) for m in edge_masks]
+            res = [self._one(topo, m, kp) for m in edge_masks]
         _DISPATCH_SECONDS.labels(backend="scalar", kind="whatif").observe(
             time.perf_counter() - t0
         )
@@ -298,6 +345,13 @@ class TpuSpfBackend(SpfBackend):
         # never a semantic one.
         self._one_jits: dict[str, object] = {}
         self._batch_jits: dict[str, object] = {}
+        # Multipath (ISSUE 10) jits, one per pow2 parent-set width kp:
+        # the widened kernel is dispatched ONLY when a dispatch asks
+        # for multipath_k > 1 — the k=1 path rides the unchanged
+        # single-parent programs (the multipath_overhead contract).
+        self._mp_jits: dict[int, object] = {}
+        self._mp_batch_jits: dict[int, object] = {}
+        self._mp_incr_jits: dict[int, object] = {}
         self._jit_multiroot = jax.jit(
             lambda g, rs, m: spf_multiroot(g, rs, m, self.max_iters)
         )
@@ -338,6 +392,42 @@ class TpuSpfBackend(SpfBackend):
             )
         return fn
 
+    def _jit_mp_for(self, kp: int):
+        fn = self._mp_jits.get(kp)
+        if fn is None:
+            fn = self._mp_jits[kp] = jax.jit(
+                lambda g, r, m, _kp=kp: spf_one_multipath(
+                    g, r, _kp, m, self.max_iters
+                )
+            )
+        return fn
+
+    def _jit_mp_batch_for(self, kp: int):
+        fn = self._mp_batch_jits.get(kp)
+        if fn is None:
+            fn = self._mp_batch_jits[kp] = jax.jit(
+                lambda g, r, ms, _kp=kp: spf_multipath_batch(
+                    g, r, ms, _kp, self.max_iters
+                )
+            )
+        return fn
+
+    def _jit_mp_incr_for(self, kp: int):
+        """Incremental multipath jit: the previous SpfTensors AND
+        MultipathTensors are donated — same ownership discipline as
+        ``_jit_incr``, widened."""
+        fn = self._mp_incr_jits.get(kp)
+        if fn is None:
+            fn = self._mp_incr_jits[kp] = jax.jit(
+                lambda g, r, prev, prev_mp, seeds, _kp=kp: (
+                    spf_one_incremental_multipath(
+                        g, r, prev, prev_mp, seeds, _kp, self.max_iters
+                    )
+                ),
+                donate_argnums=(2, 3),
+            )
+        return fn
+
     # Kept as properties: external probes (tests, cost tooling) and the
     # degenerate-mesh routing below still read the pinned-engine jits.
     @property
@@ -348,19 +438,26 @@ class TpuSpfBackend(SpfBackend):
     def _jit_batch(self):
         return self._jit_batch_for(self.one_engine)
 
-    def _pick_engine(self, kind: str, topo, batch: int = 1):
+    def _pick_engine(self, kind: str, topo, batch: int = 1, kp: int = 1):
         """(engine, shape bucket | None) for this dispatch: the
         process engine tuner's per-shape choice when one is armed, else
         the pinned ``one_engine``.  Lazy import keeps the unarmed path
-        at a sys.modules hit (pipeline_overhead gate)."""
+        at a sys.modules hit (pipeline_overhead gate).
+
+        Multipath dispatches (``kp > 1``) have a single widened
+        formulation — engine ``mp`` — but still report under a bucket
+        carrying kp in the shape key (the tuner learns k as part of
+        the shape: k=1 engine medians never mix with k=8 walls)."""
         from holo_tpu.pipeline.tuner import active_tuner, shape_bucket
 
         t = active_tuner()
         if t is None or self.engine == "blocked":
-            return self.one_engine, None
+            return ("mp" if kp > 1 else self.one_engine), None
         bucket = shape_bucket(
-            topo.n_vertices, topo.n_edges, batch, _mesh_key()
+            topo.n_vertices, topo.n_edges, batch, _mesh_key(), k=kp
         )
+        if kp > 1:
+            return "mp", bucket
         return t.pick(kind, bucket), bucket
 
     @staticmethod
@@ -383,13 +480,19 @@ class TpuSpfBackend(SpfBackend):
         if t is not None:
             t.cost_prior(kind, bucket, engine, entry)
 
-    def _depth_bucket(self, topo):
-        """The DeltaPath depth-tuning bucket (kind=one, batch=1)."""
+    def _depth_bucket(self, topo, kp: int = 1):
+        """The DeltaPath depth-tuning bucket (kind=one, batch=1).
+        ``kp`` rides the shape key: the widened kernel's delta/full
+        walls must not contaminate the k=1 bucket's depth ratio."""
         from holo_tpu.pipeline.tuner import shape_bucket
 
-        return shape_bucket(topo.n_vertices, topo.n_edges, 1, _mesh_key())
+        return shape_bucket(
+            topo.n_vertices, topo.n_edges, 1, _mesh_key(), k=kp
+        )
 
-    def _tuner_depth_observe(self, topo, arm: str, seconds: float) -> None:
+    def _tuner_depth_observe(
+        self, topo, arm: str, seconds: float, kp: int = 1
+    ) -> None:
         """Feed a measured delta-path / full-rebuild wall into the
         persisted tuner table (the per-shape max_delta_depth input)."""
         from holo_tpu.pipeline.tuner import active_tuner
@@ -397,7 +500,7 @@ class TpuSpfBackend(SpfBackend):
         t = active_tuner()
         if t is None:
             return
-        b = self._depth_bucket(topo)
+        b = self._depth_bucket(topo, kp)
         if arm == "delta":
             t.observe_delta(b, seconds)
         else:
@@ -417,6 +520,21 @@ class TpuSpfBackend(SpfBackend):
         fn = self._shard_jits.get(key)
         if fn is None:
             fn = sharded_whatif_jit(mesh, self.max_iters, engine)
+            self._shard_jits[key] = fn
+        return fn
+
+    def _sharded_mp_whatif(self, mesh, kp: int):
+        if mesh.size == 1:  # see _sharded_whatif
+            return self._jit_mp_batch_for(kp)
+        from holo_tpu.parallel.mesh import (
+            mesh_cache_key,
+            sharded_multipath_jit,
+        )
+
+        key = ("mp-whatif", kp, mesh_cache_key(mesh))
+        fn = self._shard_jits.get(key)
+        if fn is None:
+            fn = sharded_multipath_jit(mesh, kp, self.max_iters)
             self._shard_jits[key] = fn
         return fn
 
@@ -462,15 +580,24 @@ class TpuSpfBackend(SpfBackend):
         self._last_prepare_how = how
         return g
 
-    def _remember(self, topo: Topology, n_atoms: int, out) -> None:
+    def _remember(self, topo: Topology, n_atoms: int, out, kp: int = 1) -> None:
         """Retain this run's device tensors as the next delta's seed.
 
         Idempotent per key: a repeated dispatch of the same (topology
         generation, root) produces bit-identical tensors, so the
         already-stored set stays — the no-delta steady state then holds
         one buffer set instead of churning a fresh one per dispatch
-        (the incremental_overhead <2% gate measures exactly this)."""
-        key = (*topo.cache_key, int(n_atoms), int(topo.root), _mesh_key())
+        (the incremental_overhead <2% gate measures exactly this).
+
+        ``kp`` joins the key: a multipath chain seeds from multipath
+        tensors ((SpfTensors, MultipathTensors) pairs) and a k=1 chain
+        from plain SpfTensors — a ``max-paths`` reconfigure mid-chain
+        degrades that root's next delta to ``full-no-prev``, never to a
+        wrong-width donation."""
+        key = (
+            *topo.cache_key, int(n_atoms), int(topo.root), _mesh_key(),
+            int(kp),
+        )
         if key in self._prev_one:
             return
         self._prev_one[key] = out
@@ -515,20 +642,26 @@ class TpuSpfBackend(SpfBackend):
         finally:
             convergence.note_dispatch("spf", "fallback")
 
-    def compute(self, topo, edge_mask=None):
+    def compute(self, topo, edge_mask=None, multipath_k: int = 1):
+        kp = mp_pad(multipath_k)
         return self.breaker.call(
-            lambda: self._device_compute(topo, edge_mask),
+            lambda: self._device_compute(topo, edge_mask, kp),
             lambda: self._noted_fallback(
-                lambda: self._oracle.compute(topo, edge_mask)
+                lambda: self._oracle.compute(
+                    topo, edge_mask, multipath_k=kp
+                )
             ),
             context="spf.one",
         )
 
-    def compute_whatif(self, topo, edge_masks):
+    def compute_whatif(self, topo, edge_masks, multipath_k: int = 1):
+        kp = mp_pad(multipath_k)
         return self.breaker.call(
-            lambda: self._device_whatif(topo, edge_masks),
+            lambda: self._device_whatif(topo, edge_masks, kp),
             lambda: self._noted_fallback(
-                lambda: self._oracle.compute_whatif(topo, edge_masks)
+                lambda: self._oracle.compute_whatif(
+                    topo, edge_masks, multipath_k=kp
+                )
             ),
             context="spf.whatif",
         )
@@ -542,7 +675,7 @@ class TpuSpfBackend(SpfBackend):
             context="spf.multiroot",
         )
 
-    def _device_compute(self, topo, edge_mask=None):
+    def _device_compute(self, topo, edge_mask=None, kp: int = 1):
         faults.crashpoint("spf.dispatch")
         mesh = _mesh()
         if mesh is not None:
@@ -550,18 +683,23 @@ class TpuSpfBackend(SpfBackend):
             # mesh / an XLA failure on any shard surfaces here and the
             # breaker serves the WHOLE batch from the scalar oracle.
             faults.crashpoint("spf.shard")
-        if self.engine == "blocked":
+        if self.engine == "blocked" and kp == 1:
+            # The blocked-Pallas experiment has no multipath planes;
+            # kp > 1 rides the gather-path multipath kernel below.
             res = self._whatif_blocked(
                 topo, self._full_mask(topo, edge_mask)[None, :]
             )
             if res is not None:
                 return res[0]
         if edge_mask is None:
-            res = self._try_incremental(topo)
+            res = self._try_incremental(topo, kp)
             if res is not None:
                 return res
         t0 = time.perf_counter()
-        engine, bucket = self._pick_engine("one", topo)
+        engine, bucket = self._pick_engine("one", topo, kp=kp)
+        step = (
+            self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
+        )
         with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
             # THE sanctioned marshal boundary: host graph + root + mask
             # move to device here and nowhere else (transfer_guard
@@ -578,14 +716,13 @@ class TpuSpfBackend(SpfBackend):
                     mask = self._full_mask(topo, edge_mask)
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        topo.n_edges, _mesh_key(), engine,
+                        topo.n_edges, _mesh_key(), engine, kp,
                     )
                     fresh = self._track_compile("one", engine, *sig)
-                    out = self._jit_one_for(engine)(g, topo.root, mask)
+                    out = step(g, topo.root, mask)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.one", self._jit_one_for(engine), g, topo.root,
-                    mask, shape_sig=sig,
+                    "spf.one", step, g, topo.root, mask, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
             with profiling.stage("spf.one", "device"):
@@ -595,11 +732,14 @@ class TpuSpfBackend(SpfBackend):
             t1 = time.perf_counter()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
+                    sp = out[0] if kp > 1 else out
                     dist, parent, hops, nh = _host_tensors(
-                        out, topo.n_vertices
+                        sp, topo.n_vertices
                     )
+                    mpkw = _host_mp(out[1], topo.n_vertices) if kp > 1 else {}
                     res = SpfResult(
-                        dist=dist, parent=parent, hops=hops, nexthop_words=nh
+                        dist=dist, parent=parent, hops=hops,
+                        nexthop_words=nh, **mpkw,
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
@@ -616,26 +756,33 @@ class TpuSpfBackend(SpfBackend):
         if remarshal and edge_mask is None:
             # A full re-marshal paid: the depth tuner's "full" arm (the
             # cost a deeper delta chain would have avoided).
-            self._tuner_depth_observe(topo, "full", t2 - t0)
+            self._tuner_depth_observe(topo, "full", t2 - t0, kp)
         if edge_mask is None and self.incremental:
             # Disarmed backends skip retention: they could never
             # consume the tensors, and the incremental_overhead gate
             # compares exactly this armed-vs-disarmed difference.
-            self._remember(topo, max(self.n_atoms, topo.n_atoms()), out)
+            self._remember(
+                topo, max(self.n_atoms, topo.n_atoms()), out, kp
+            )
         return res
 
-    def _try_incremental(self, topo) -> SpfResult | None:
+    def _try_incremental(self, topo, kp: int = 1) -> SpfResult | None:
         """DeltaPath dispatch: the resident device graph absorbs the
         topology delta in place and the incremental kernel recomputes
         seeded from the previous run's tensors — O(affected) rounds and
         a delta-sized transfer instead of a full marshal.  Returns None
         (→ full-rebuild path) when the chain cannot be served; every
-        disposition lands in ``holo_spf_delta_total{kind,path}``."""
+        disposition lands in ``holo_spf_delta_total{kind,path}``.
+        ``kp > 1`` rides the widened incremental kernel, seeded from
+        (and donating) the chain's retained multipath tensors."""
         delta = getattr(topo, "delta_base", None)
         if delta is None or not self.incremental:
             return None
         n_atoms = max(self.n_atoms, topo.n_atoms())
-        prev_key = (*delta.base_key, int(n_atoms), int(topo.root), _mesh_key())
+        prev_key = (
+            *delta.base_key, int(n_atoms), int(topo.root), _mesh_key(),
+            int(kp),
+        )
         prev = self._prev_one.get(prev_key)
         if prev is None:
             note_delta(delta.kind, "full-no-prev")
@@ -683,18 +830,29 @@ class TpuSpfBackend(SpfBackend):
                     seeds_p[: seeds.shape[0]] = seeds
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2], pad,
-                        _mesh_key(),
+                        _mesh_key(), kp,
                     )
                     fresh = self._track_compile("delta", "incr", *sig)
                     # The previous tensors are DONATED into the kernel:
                     # drop our reference first so a failed dispatch can
                     # never leave a consumed entry behind.
                     del self._prev_one[prev_key]
-                    out = self._jit_incr(g, topo.root, prev, seeds_p)
+                    if kp > 1:
+                        step = self._jit_mp_incr_for(kp)
+                        out = step(g, topo.root, prev[0], prev[1], seeds_p)
+                    else:
+                        step = self._jit_incr
+                        out = step(g, topo.root, prev, seeds_p)
             if fresh:
+                # The donated prev args are gone: re-trace against this
+                # run's own output tensors (same shapes/dtypes).
+                cost_args = (
+                    (g, topo.root, out[0], out[1], seeds_p)
+                    if kp > 1
+                    else (g, topo.root, out, seeds_p)
+                )
                 profiling.record_cost(
-                    "spf.delta", self._jit_incr, g, topo.root, out, seeds_p,
-                    shape_sig=sig,
+                    "spf.delta", step, *cost_args, shape_sig=sig,
                 )
             with profiling.stage("spf.one", "device"):
                 with profiling.annotation("spf.one.delta.device"):
@@ -703,11 +861,14 @@ class TpuSpfBackend(SpfBackend):
             t1 = time.perf_counter()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
+                    sp = out[0] if kp > 1 else out
                     dist, parent, hops, nh = _host_tensors(
-                        out, topo.n_vertices
+                        sp, topo.n_vertices
                     )
+                    mpkw = _host_mp(out[1], topo.n_vertices) if kp > 1 else {}
                     res = SpfResult(
-                        dist=dist, parent=parent, hops=hops, nexthop_words=nh
+                        dist=dist, parent=parent, hops=hops,
+                        nexthop_words=nh, **mpkw,
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
@@ -719,8 +880,8 @@ class TpuSpfBackend(SpfBackend):
         note_delta(delta.kind, "incremental")
         # The depth tuner's "delta" arm: what an in-place update +
         # seeded recompute actually costs at this shape.
-        self._tuner_depth_observe(topo, "delta", t2 - t0)
-        self._remember(topo, n_atoms, out)
+        self._tuner_depth_observe(topo, "delta", t2 - t0, kp)
+        self._remember(topo, n_atoms, out, kp)
         return res
 
     def prepare_blocked(self, topo: Topology):
@@ -800,12 +961,12 @@ class TpuSpfBackend(SpfBackend):
             for i in range(dist.shape[0])
         ]
 
-    def _device_whatif(self, topo, edge_masks):
+    def _device_whatif(self, topo, edge_masks, kp: int = 1):
         faults.crashpoint("spf.dispatch")
         mesh = _mesh()
         if mesh is not None:
             faults.crashpoint("spf.shard")
-        if self.engine == "blocked":
+        if self.engine == "blocked" and kp == 1:
             # The blocked-Pallas experiment marshals its own planes and
             # stays single-device; the mesh path rides the gather
             # engines (the headline since r02).
@@ -814,7 +975,7 @@ class TpuSpfBackend(SpfBackend):
                 return res
         B = len(edge_masks)
         t0 = time.perf_counter()
-        engine, bucket = self._pick_engine("whatif", topo, B)
+        engine, bucket = self._pick_engine("whatif", topo, B, kp=kp)
         with telemetry.span(
             "spf.dispatch", kind="whatif", backend="tpu", batch=B,
         ):
@@ -836,13 +997,21 @@ class TpuSpfBackend(SpfBackend):
                         from holo_tpu.parallel.mesh import shard_scenarios
 
                         masks_dev = shard_scenarios(mesh, masks)
-                        step = self._sharded_whatif(mesh, engine)
+                        step = (
+                            self._sharded_mp_whatif(mesh, kp)
+                            if kp > 1
+                            else self._sharded_whatif(mesh, engine)
+                        )
                     else:
                         masks_dev = masks
-                        step = self._jit_batch_for(engine)
+                        step = (
+                            self._jit_mp_batch_for(kp)
+                            if kp > 1
+                            else self._jit_batch_for(engine)
+                        )
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        masks_dev.shape, _mesh_key(), engine,
+                        masks_dev.shape, _mesh_key(), engine, kp,
                     )
                     fresh = self._track_compile("whatif", engine, *sig)
                     out = step(g, topo.root, masks_dev)
@@ -861,9 +1030,11 @@ class TpuSpfBackend(SpfBackend):
             # of device arrays would pay the host round-trip B×4 times.
             with profiling.stage("spf.whatif", "readback"):
                 with sanctioned_transfer("spf.whatif.unmarshal"):
+                    sp = out[0] if kp > 1 else out
                     dist, parent, hops, nh = _host_tensors(
-                        out, topo.n_vertices
+                        sp, topo.n_vertices
                     )
+                    mpkw = _host_mp(out[1], topo.n_vertices) if kp > 1 else {}
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
@@ -876,7 +1047,11 @@ class TpuSpfBackend(SpfBackend):
         # Slice off the batch-pad rows (sharded dispatch pads B up to a
         # multiple of the mesh batch axis) — [:B] is a no-op otherwise.
         return [
-            SpfResult(dist=dist[i], parent=parent[i], hops=hops[i], nexthop_words=nh[i])
+            SpfResult(
+                dist=dist[i], parent=parent[i], hops=hops[i],
+                nexthop_words=nh[i],
+                **{f: plane[i] for f, plane in mpkw.items()},
+            )
             for i in range(B)
         ]
 
@@ -961,18 +1136,22 @@ class TpuSpfBackend(SpfBackend):
     # identical to _device_compute by construction (same jits, same
     # readback; parity-gated in tests/test_pipeline.py).
 
-    def launch_one(self, topo, edge_mask=None) -> "_InFlightOne":
+    def launch_one(self, topo, edge_mask=None, multipath_k: int = 1) -> "_InFlightOne":
         faults.crashpoint("spf.dispatch")
         mesh = _mesh()
         if mesh is not None:
             faults.crashpoint("spf.shard")
+        kp = mp_pad(multipath_k)
         n_atoms = max(self.n_atoms, topo.n_atoms())
         if edge_mask is None:
-            h = self._launch_incremental(topo, n_atoms)
+            h = self._launch_incremental(topo, n_atoms, kp)
             if h is not None:
                 return h
         t0 = time.perf_counter()
-        engine, bucket = self._pick_engine("one", topo)
+        engine, bucket = self._pick_engine("one", topo, kp=kp)
+        step = (
+            self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
+        )
         with telemetry.span(
             "spf.launch", kind="one", backend="tpu", engine=engine
         ):
@@ -985,19 +1164,18 @@ class TpuSpfBackend(SpfBackend):
                     mask = self._full_mask(topo, edge_mask)
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
-                        topo.n_edges, _mesh_key(), engine,
+                        topo.n_edges, _mesh_key(), engine, kp,
                     )
                     fresh = self._track_compile("one", engine, *sig)
-                    out = self._jit_one_for(engine)(g, topo.root, mask)
+                    out = step(g, topo.root, mask)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.one", self._jit_one_for(engine), g, topo.root,
-                    mask, shape_sig=sig,
+                    "spf.one", step, g, topo.root, mask, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
         return _InFlightOne(
             out=out, topo=topo, t0=t0, engine=engine, bucket=bucket,
-            mode="full", n_atoms=n_atoms,
+            mode="full", n_atoms=n_atoms, kp=kp,
             remember=edge_mask is None and self.incremental,
             sharded=mesh is not None,
             remarshal=remarshal and edge_mask is None,
@@ -1005,7 +1183,9 @@ class TpuSpfBackend(SpfBackend):
             launch_s=time.perf_counter() - t0,
         )
 
-    def _launch_incremental(self, topo, n_atoms) -> "_InFlightOne | None":
+    def _launch_incremental(
+        self, topo, n_atoms, kp: int = 1
+    ) -> "_InFlightOne | None":
         """Split-phase DeltaPath launch: same contract (and the same
         donation discipline — the previous tensors leave ``_prev_one``
         BEFORE the kernel call) as :meth:`_try_incremental`; the
@@ -1016,7 +1196,8 @@ class TpuSpfBackend(SpfBackend):
         if delta is None or not self.incremental:
             return None
         prev_key = (
-            *delta.base_key, int(n_atoms), int(topo.root), _mesh_key()
+            *delta.base_key, int(n_atoms), int(topo.root), _mesh_key(),
+            int(kp),
         )
         prev = self._prev_one.get(prev_key)
         if prev is None:
@@ -1047,19 +1228,28 @@ class TpuSpfBackend(SpfBackend):
                     seeds_p[: seeds.shape[0]] = seeds
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2], pad,
-                        _mesh_key(),
+                        _mesh_key(), kp,
                     )
                     fresh = self._track_compile("delta", "incr", *sig)
                     del self._prev_one[prev_key]
-                    out = self._jit_incr(g, topo.root, prev, seeds_p)
+                    if kp > 1:
+                        step = self._jit_mp_incr_for(kp)
+                        out = step(g, topo.root, prev[0], prev[1], seeds_p)
+                    else:
+                        step = self._jit_incr
+                        out = step(g, topo.root, prev, seeds_p)
             if fresh:
+                cost_args = (
+                    (g, topo.root, out[0], out[1], seeds_p)
+                    if kp > 1
+                    else (g, topo.root, out, seeds_p)
+                )
                 profiling.record_cost(
-                    "spf.delta", self._jit_incr, g, topo.root, out,
-                    seeds_p, shape_sig=sig,
+                    "spf.delta", step, *cost_args, shape_sig=sig,
                 )
         return _InFlightOne(
             out=out, topo=topo, t0=t0, engine="incr", bucket=None,
-            mode="delta", delta_kind=delta.kind, n_atoms=n_atoms,
+            mode="delta", delta_kind=delta.kind, n_atoms=n_atoms, kp=kp,
             remember=True, sharded=_mesh() is not None,
             launch_s=time.perf_counter() - t0,
         )
@@ -1076,12 +1266,18 @@ class TpuSpfBackend(SpfBackend):
             t1 = time.perf_counter()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
+                    sp = h.out[0] if h.kp > 1 else h.out
                     dist, parent, hops, nh = _host_tensors(
-                        h.out, h.topo.n_vertices
+                        sp, h.topo.n_vertices
+                    )
+                    mpkw = (
+                        _host_mp(h.out[1], h.topo.n_vertices)
+                        if h.kp > 1
+                        else {}
                     )
                     res = SpfResult(
                         dist=dist, parent=parent, hops=hops,
-                        nexthop_words=nh,
+                        nexthop_words=nh, **mpkw,
                     )
         t2 = time.perf_counter()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
@@ -1098,12 +1294,12 @@ class TpuSpfBackend(SpfBackend):
         unparked = h.launch_s + (t2 - t_fs)
         if h.mode == "delta":
             note_delta(h.delta_kind, "incremental")
-            self._tuner_depth_observe(h.topo, "delta", unparked)
+            self._tuner_depth_observe(h.topo, "delta", unparked, h.kp)
         else:
             if not h.fresh:  # see _device_compute: no compile spikes
                 self._tuner_observe("one", h.bucket, h.engine, unparked)
             if h.remarshal:
-                self._tuner_depth_observe(h.topo, "full", unparked)
+                self._tuner_depth_observe(h.topo, "full", unparked, h.kp)
         if h.remember and self.incremental:
-            self._remember(h.topo, h.n_atoms, h.out)
+            self._remember(h.topo, h.n_atoms, h.out, h.kp)
         return res

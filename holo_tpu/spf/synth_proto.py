@@ -26,9 +26,9 @@ class _CaptureBackend:
         self.name = inner.name
         self.topos: list = []
 
-    def compute(self, topo):
+    def compute(self, topo, multipath_k: int = 1):
         self.topos.append(topo)
-        return self.inner.compute(topo)
+        return self.inner.compute(topo, multipath_k=multipath_k)
 
 
 def _spanning_edges(n: int, extra: int, rng) -> list[tuple[int, int, int]]:

@@ -125,12 +125,15 @@ class StormNet:
         prefix_every: int = 8,
         hubs: int = 6,
         loop=None,
+        max_paths: int | None = None,
     ):
         """``loop`` defaults to a fresh virtual-clock EventLoop (the
         deterministic storm configuration); passing a
         :class:`~holo_tpu.utils.preempt.ThreadedLoop` instead hosts the
         whole network on a real pump thread — the configuration the
-        pump-kill chaos test drives."""
+        pump-kill chaos test drives.  ``max_paths`` (ISSUE 10) arms the
+        multipath dispatch on the DUT: the dual-gateway ECMP pairs then
+        install as real next-hop SETS with UCMP weights."""
         assert n_routers >= hubs + 8, "need root + 2 gateways + hubs + some"
         self.n_routers = n_routers
         self.loop = loop if loop is not None else EventLoop(
@@ -141,7 +144,7 @@ class StormNet:
         self.rib = RibManager(self.bus, self.kernel)
         self.rib.name = "routing"
         self.loop.register(self.rib)
-        cfg = InstanceConfig(router_id=_rid(0))
+        cfg = InstanceConfig(router_id=_rid(0), max_paths=max_paths)
         self.inst = OspfInstance(
             name=self.DUT,
             config=cfg,
@@ -419,9 +422,9 @@ def _instrument_dispatch_wall(net: StormNet):
     backend = net.inst.backend
     inner = backend.compute
 
-    def timed(topo, edge_mask=None):
+    def timed(topo, edge_mask=None, multipath_k: int = 1):
         t0 = time.perf_counter()
-        res = inner(topo, edge_mask)
+        res = inner(topo, edge_mask, multipath_k=multipath_k)
         dt = time.perf_counter() - t0
         for trig in set(convergence.active_triggers()) or {"untracked"}:
             sink.setdefault(trig, []).append(dt)
@@ -461,6 +464,7 @@ def run_convergence_storm(
     drop_prob: float = 0.10,
     settle: float = 60.0,
     prefix_every: int = 8,
+    max_paths: int | None = None,
 ) -> tuple[dict, str, "StormNet"]:
     """One seeded convergence storm end to end.  Returns ``(report,
     digest, net)``; the report carries per-trigger p50/p95/p99/max
@@ -473,7 +477,7 @@ def run_convergence_storm(
     inj = FaultInjector(plan)
     net = StormNet(
         n_routers=n_routers, seed=seed, spf_backend=spf_backend,
-        prefix_every=prefix_every,
+        prefix_every=prefix_every, max_paths=max_paths,
     )
     tracker = convergence.configure(
         tracker_capacity, clock=net.loop.clock.now
@@ -517,6 +521,14 @@ def run_convergence_storm(
         report["n-routers"] = n_routers
         report["spf-runs"] = net.inst.spf_run_count
         report["fib-size"] = len(net.kernel.fib)
+        # Multipath surface (ISSUE 10): cumulative installs that carried
+        # real next-hop SETS / UCMP weight groups (cumulative, so a
+        # storm that happens to END mid-failure — repairs holding
+        # single-survivor sets — still reports the multipath activity).
+        report["fib-multipath"] = getattr(
+            net.kernel, "multipath_installs", 0
+        )
+        report["fib-weighted"] = getattr(net.kernel, "weighted_installs", 0)
         # REAL per-trigger dispatch seconds (never in the digest: wall
         # time is nondeterministic by nature; the determinism gate is
         # the virtual timelines + FIB digest above).

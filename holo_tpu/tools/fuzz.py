@@ -329,6 +329,101 @@ def delta_apply_invariants(data: bytes) -> None:
         cur = nxt
 
 
+def multipath_invariants(data: bytes) -> None:
+    """Multipath invariants (ISSUE 10; not a wire decoder): the scalar
+    multipath oracle over arbitrary small topologies must produce
+    next-hop sets and parent planes that are LOOP-FREE and
+    WEIGHT-CONSISTENT:
+
+    - parent sets are sorted by (path cost, parent id), carry no
+      duplicates, and every parent satisfies the loop-free criterion
+      (``dist[u] < dist[v]`` strictly, or it is an equal-cost DAG
+      member with ``pdist == dist[v]``); path costs never undercut the
+      shortest distance;
+    - the ECMP members (``pdist == dist``) are exactly the DAG parent
+      sources (truncated to the set width);
+    - ``npaths`` satisfies the saturated DAG recursion and atoms with
+      positive UCMP weight are a subset of the ECMP next-hop bitmask.
+
+    The device kernel is pinned bit-identical to this oracle in
+    tests/test_multipath.py, so oracle invariants are kernel
+    invariants.  Violations raise AssertionError (reported as a crash).
+    """
+    if len(data) < 4:
+        raise DecodeError("multipath spec: need 4+ bytes (kind,size,seed,k)")
+    import numpy as np  # noqa: PLC0415
+
+    from holo_tpu.ops.graph import INF, MP_SAT  # noqa: PLC0415
+    from holo_tpu.spf import synth  # noqa: PLC0415
+    from holo_tpu.spf.scalar import (  # noqa: PLC0415
+        spf_multipath_reference,
+    )
+
+    kind, size, seed, kp = (
+        data[0] % 3, 4 + data[1] % 6, data[2], 1 << (data[3] % 4)
+    )
+    if kind == 0:
+        topo = synth.ring_topology(size, max_cost=3, seed=seed)
+    elif kind == 1:
+        topo = synth.grid_topology(2, size, max_cost=3, seed=seed)
+    else:
+        topo = synth.random_ospf_topology(
+            n_routers=size + 2, n_networks=2, extra_p2p=size, max_cost=3,
+            seed=seed,
+        )
+    base, mp = spf_multipath_reference(topo, kp)
+    dist = base.dist
+    n = topo.n_vertices
+    inf, sat = int(INF), int(MP_SAT)
+
+    dag_srcs: list[set] = [set() for _ in range(n)]
+    np_sum = np.zeros(n, np.int64)
+    for e in range(topo.n_edges):
+        u, v = int(topo.edge_src[e]), int(topo.edge_dst[e])
+        if (
+            v != topo.root
+            and int(dist[u]) < inf
+            and int(dist[u]) + int(topo.edge_cost[e]) == int(dist[v])
+        ):
+            dag_srcs[v].add(u)
+            np_sum[v] += int(mp.npaths[u])
+
+    for v in range(n):
+        if int(dist[v]) >= inf:
+            assert int(mp.npaths[v]) == 0, f"npaths on unreachable {v}"
+            continue
+        # npaths: saturated DAG recursion over already-clamped values.
+        want = 1 if v == topo.root else min(int(np_sum[v]), sat)
+        assert int(mp.npaths[v]) == want, f"npaths[{v}]"
+        row = [
+            (int(mp.parents[v, j]), int(mp.pdist[v, j]))
+            for j in range(kp)
+            if int(mp.parents[v, j]) < n
+        ]
+        keys = [(c, u) for u, c in row]
+        assert keys == sorted(keys), f"parent order {v}"
+        assert len({u for u, _ in row}) == len(row), f"dup parent {v}"
+        ecmp = {u for u, c in row if c == int(dist[v])}
+        for u, c in row:
+            assert c >= int(dist[v]), f"pathcost undercuts dist at {v}"
+            assert u != v, f"self-parent {v}"
+            assert (
+                int(dist[u]) < int(dist[v]) or c == int(dist[v])
+            ), f"loop-unsafe parent {u}->{v}"
+        # ECMP members == DAG parent sources (modulo width truncation).
+        if len(row) < kp:
+            assert ecmp == dag_srcs[v], f"ecmp set {v}"
+        else:
+            assert ecmp <= dag_srcs[v], f"ecmp overreach {v}"
+        # Weighted atoms are a subset of the ECMP next-hop bitmask.
+        for a in range(mp.nh_weights.shape[1]):
+            w = int(mp.nh_weights[v, a])
+            assert 0 <= w <= sat, f"weight range {v},{a}"
+            if w > 0:
+                word = int(base.nexthop_words(64)[v, a // 32])
+                assert word >> (a % 32) & 1, f"weighted atom {a} not in set"
+
+
 # ===== target registry (the reference's fuzz_targets/** inventory) =====
 
 
@@ -416,6 +511,9 @@ def targets() -> dict:
         # DeltaPath (ISSUE 7): device-resident graph delta-chain
         # invariants of the shared marshal cache.
         "delta_apply_invariants": delta_apply_invariants,
+        # Multipath (ISSUE 10): loop-free + weight-consistent parent
+        # set / UCMP planes of the multipath oracle.
+        "multipath_invariants": multipath_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

@@ -73,6 +73,10 @@ class RouteMsg:
     # (holo_tpu.frr).  The RIB keeps them beside the installed primaries
     # and flips to them in O(1) on BFD/link-down, before reconvergence.
     backups: dict = field(default_factory=dict)
+    # UCMP weights {Nexthop -> saturated path count} (ISSUE 10): ride
+    # beside the ECMP set so the FIB layer can program weighted
+    # next-hop groups; empty = plain equal-cost hashing.
+    nh_weights: dict = field(default_factory=dict)
 
 
 @dataclass

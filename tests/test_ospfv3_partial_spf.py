@@ -18,9 +18,9 @@ class _CountingBackend:
         self.name = inner.name
         self.computes = 0
 
-    def compute(self, topo):
+    def compute(self, topo, multipath_k: int = 1):
         self.computes += 1
-        return self.inner.compute(topo)
+        return self.inner.compute(topo, multipath_k=multipath_k)
 
 
 def _pair():

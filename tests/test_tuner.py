@@ -32,12 +32,18 @@ B = shape_bucket(1000, 4000, 8, None)
 
 
 def test_shape_bucket_quantization():
-    assert shape_bucket(1000, 4000, 8, None) == (1024, 4096, 8, None)
-    assert shape_bucket(1024, 4096, 8, None) == (1024, 4096, 8, None)
-    assert shape_bucket(1, 0, 1, ("m", 2)) == (1, 1, 1, ("m", 2))
+    assert shape_bucket(1000, 4000, 8, None) == (1024, 4096, 8, None, 1)
+    assert shape_bucket(1024, 4096, 8, None) == (1024, 4096, 8, None, 1)
+    assert shape_bucket(1, 0, 1, ("m", 2)) == (1, 1, 1, ("m", 2), 1)
     # Nearby sizes share a bucket; a 2x jump does not.
     assert shape_bucket(900, 3900, 8) == shape_bucket(1000, 4000, 8)
     assert shape_bucket(900, 3900, 8) != shape_bucket(2100, 3900, 8)
+    # The multipath width is part of the shape key (ISSUE 10): k=1 and
+    # k=8 dispatches of the same graph are different programs.
+    assert shape_bucket(1000, 4000, 8, None, k=8) == (
+        1024, 4096, 8, None, 8,
+    )
+    assert shape_bucket(1000, 4000, 8, k=8) != shape_bucket(1000, 4000, 8)
 
 
 def test_explore_then_exploit_deterministic():
