@@ -80,6 +80,21 @@ class TelemetryConfig:
     # ibus envelopes, per-event timelines into the flight ring.  Off by
     # default (gated < 2% by bench.py convergence_overhead).
     convergence_events: int = 0
+    # Shared-delta gNMI fan-out (ISSUE 11): SAMPLE/ON_CHANGE streams
+    # ride ONE per-tick state snapshot + change-set rendered once and
+    # fanned out to every due subscriber (O(1) render cost in
+    # subscriber count).  Off -> the pre-ISSUE-11 per-subscriber walk
+    # path, byte-identical output (the same path any engine failure
+    # degrades to).
+    gnmi_shared_fanout: bool = True
+    # Base tick (seconds) for ON_CHANGE delta delivery and the fan-out
+    # coalescing cadence floor.
+    fanout_tick: float = 1.0
+    # ROADMAP carry-over: when set AND a real TPU is attached, capture
+    # one jax.profiler.trace() around a seeded SPF dispatch into this
+    # directory at boot.  Relay-probe-aware: without a TPU the daemon
+    # records an explicit `relay: not-used` row — never a failure.
+    device_trace_dir: str | None = None
 
 
 @dataclass
@@ -223,6 +238,11 @@ class DaemonConfig:
             cfg.telemetry.profile_device_time = t.get(
                 "profile-device-time", False
             )
+            cfg.telemetry.gnmi_shared_fanout = t.get(
+                "gnmi-shared-fanout", True
+            )
+            cfg.telemetry.fanout_tick = float(t.get("fanout-tick", 1.0))
+            cfg.telemetry.device_trace_dir = t.get("device-trace-dir")
         if "resilience" in raw:
             r = raw["resilience"]
             res = cfg.resilience

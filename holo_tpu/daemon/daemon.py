@@ -178,6 +178,27 @@ class Daemon:
             from holo_tpu.telemetry import profiling
 
             profiling.set_device_profiling(True)
+        # Device-trace capture ([telemetry] device-trace-dir, ISSUE 11
+        # carry-over): one real jax.profiler.trace() around a seeded
+        # SPF dispatch when a TPU is attached.  Relay-probe-aware — no
+        # TPU yields an explicit `relay: not-used` row and never blocks
+        # the boot.
+        self._device_trace = None
+        if tcfg.device_trace_dir:
+            from holo_tpu.telemetry import profiling
+
+            try:
+                self._device_trace = profiling.capture_device_trace(
+                    tcfg.device_trace_dir
+                )
+                log.info("device trace: %s", self._device_trace)
+            except Exception as e:  # noqa: BLE001 — never a boot blocker
+                self._device_trace = {
+                    "relay": "not-used",
+                    "captured": False,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+                log.warning("device trace capture failed: %s", e)
         # Convergence observatory ([telemetry] convergence-events,
         # ISSUE 6): causal event→FIB tracing on this daemon's loop
         # clock; timelines land in the flight ring when it is armed.
@@ -426,6 +447,7 @@ class Daemon:
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=0.5)
         if getattr(self, "_gnmi_server", None) is not None:
+            # serve_gnmi folds the fan-out ticker join into stop().
             self._gnmi_server.stop(grace=0.5)
         for name, tl in list(self.instance_loops.items()):
             if self.loop_router is not None:

@@ -5,6 +5,15 @@ config+state, Set runs one transaction per request, Subscribe streams
 notifications.  gNMI paths map to the YANG-lite tree: path elems with keys
 become the bracket path segments (``interface[name=eth0]`` ->
 ``interface[eth0]``).
+
+STREAM serving scale (ISSUE 11): SAMPLE / ON_CHANGE subscriptions are
+normally cheap epoch cursors inside the shared-delta
+:class:`holo_tpu.telemetry.delta.FanoutEngine` — one state snapshot,
+one change-set, and one render per coalesced tick epoch, fanned out to
+every due subscriber through the bounded per-subscriber queues.  The
+per-subscriber walk path (``_SubSampler``) remains as the
+byte-identical fallback when the engine is disabled or its breaker
+opens.
 """
 
 from __future__ import annotations
@@ -25,15 +34,20 @@ import gnmi_lite_pb2 as pb  # noqa: E402
 import holo_tpu
 from holo_tpu import telemetry
 from holo_tpu.northbound.provider import CommitError
+from holo_tpu.telemetry import delta as fanout_delta
 from holo_tpu.telemetry import flight
 from holo_tpu.yang.schema import SchemaError
 
 # Subscribe-path hardening metrics: per-subscriber queues are bounded
 # (SUBSCRIBE_QUEUE_DEPTH) so a stalled consumer costs dropped updates —
-# counted here — instead of unbounded daemon memory.
+# counted here — instead of unbounded daemon memory.  Delivery-side
+# tallies are stamped=False: they bump WHILE the delta engine serves a
+# push, and re-arming the next tick's walk with our own bookkeeping
+# would keep an idle system churning forever (registry.py rationale).
 _SUB_DROPS = telemetry.counter(
     "holo_gnmi_subscribe_dropped_total",
     "gNMI Subscribe updates dropped on a full subscriber queue",
+    stamped=False,
 )
 _SUBSCRIBERS = telemetry.gauge(
     "holo_gnmi_subscribers", "Active gNMI Subscribe streams"
@@ -42,6 +56,7 @@ _SAMPLE_UPDATES = telemetry.counter(
     "holo_gnmi_sample_updates_total",
     "Leaf updates pushed by SAMPLE / heartbeat subscription timers",
     ("mode",),
+    stamped=False,
 )
 
 SUBSCRIBE_QUEUE_DEPTH = 256
@@ -53,7 +68,15 @@ MIN_SAMPLE_INTERVAL = 0.01
 
 
 class _SubSampler:
-    """Per-subscription STREAM timer state (gNMI 0.8 semantics).
+    """Per-subscription STREAM timer state (gNMI 0.8 semantics) — the
+    per-subscriber WALK path.
+
+    Since ISSUE 11 this is the fallback arm: streams normally attach to
+    the shared-delta :class:`holo_tpu.telemetry.delta.FanoutEngine`
+    (one snapshot + one render per tick epoch, shared across every due
+    subscriber) and only run these samplers when the engine is disabled
+    or its breaker opened.  The semantics here are the byte-identical
+    contract the engine is graded against (``bench.py gnmi_fanout``).
 
     - ``SAMPLE``: push the subscribed subtree's scalar leaves every
       ``sample_interval`` (ns).  With ``suppress_redundant`` only leaves
@@ -70,8 +93,9 @@ class _SubSampler:
     "fanout updates lost to a stalled consumer".
     """
 
-    def __init__(self, sub) -> None:
-        now = time.monotonic()
+    def __init__(self, sub, now: float | None = None) -> None:
+        if now is None:
+            now = time.monotonic()
         self.path = path_to_str(sub.path)
         self.suppress = bool(sub.suppress_redundant)
         self.interval = None
@@ -138,9 +162,19 @@ def str_to_path(s: str) -> pb.Path:
 
 
 class GnmiService:
-    def __init__(self, daemon):
+    def __init__(
+        self,
+        daemon,
+        shared_fanout: bool = True,
+        fanout_tick: float = 1.0,
+    ):
         self.daemon = daemon
-        self._subscribers: list[queue.Queue] = []
+        # Copy-on-write subscriber snapshot (ISSUE 11 lock-discipline
+        # fix): an immutable tuple of (queue, ordinal) pairs rebuilt on
+        # add/remove, so _fanout's lock hold is two reference reads —
+        # never per-subscriber work — matching the Ibus._subs
+        # snapshot-then-release discipline (HL203 surface).
+        self._subscribers: tuple = ()
         self._sub_lock = threading.Lock()
         # Per-subscriber identity + drop-burst tracking (ISSUE 6
         # carry-over from PR 5): subscriber ordinal -> consecutive
@@ -151,13 +185,48 @@ class GnmiService:
         self._sub_ids: dict[int, int] = {}  # id(queue) -> ordinal
         self._next_sub = 0
         self._bursts: dict[int, int] = {}  # ordinal -> burst depth
+        # Injectable notification timestamp source: the byte-identity
+        # bench arm pins it so the shared-render and walk paths stamp
+        # identically.
+        self._clock_ns = lambda: int(time.time() * 1e9)
+        # Shared-delta fan-out engine (ISSUE 11): one state snapshot +
+        # one render per tick epoch, shared across all due subscribers.
+        self.fanout = None
+        if shared_fanout:
+            self.fanout = fanout_delta.FanoutEngine(
+                fetch_state=self._fetch_state,
+                deliver=self._deliver,
+                burst_snapshot=self._burst_snapshot,
+                on_push=self._count_push,
+                tick=fanout_tick,
+                clock_ns=lambda: self._clock_ns(),
+            )
+            fanout_delta.register_engine(self.fanout)
 
-    def _add_subscriber(self, q: queue.Queue) -> None:
+    def _fetch_state(self):
+        """Scope-aware snapshot for the delta engine: fetch only the
+        union of subscribed subtree roots (ONE lock acquisition, the
+        legacy wake-loop discipline) — a narrow subscription must not
+        cost a full provider-tree walk per tick."""
+        roots = self.fanout.sample_roots() if self.fanout else None
+        with self.daemon.lock:
+            nb = self.daemon.northbound
+            if roots is None:
+                return nb.get_state(None)
+            return [nb.get_state(r or None) for r in roots]
+
+    @staticmethod
+    def _count_push(mode: str, n_updates: int) -> None:
+        _SAMPLE_UPDATES.labels(mode=mode).inc(n_updates)
+
+    def _add_subscriber(self, q: queue.Queue) -> int:
         with self._sub_lock:
-            self._subscribers.append(q)
             self._next_sub += 1
-            self._sub_ids[id(q)] = self._next_sub
+            sid = self._next_sub
+            self._sub_ids[id(q)] = sid
+            self._subscribers = self._subscribers + ((q, sid),)
             _SUBSCRIBERS.set(len(self._subscribers))
+        return sid
 
     def _remove_subscriber(self, q: queue.Queue) -> None:
         """Idempotent removal: the stream's finally block AND any future
@@ -166,10 +235,9 @@ class GnmiService:
         under the same lock so concurrent teardowns cannot publish a
         stale count."""
         with self._sub_lock:
-            try:
-                self._subscribers.remove(q)
-            except ValueError:
-                pass
+            self._subscribers = tuple(
+                (qq, s) for qq, s in self._subscribers if qq is not q
+            )
             sid = self._sub_ids.pop(id(q), None)
             burst = self._bursts.pop(sid, 0) if sid is not None else 0
             _SUBSCRIBERS.set(len(self._subscribers))
@@ -180,50 +248,55 @@ class GnmiService:
                 ended="disconnect",
             )
 
+    def _burst_snapshot(self) -> set:
+        """Ordinals currently mid-burst (O(open bursts), usually 0)."""
+        with self._sub_lock:
+            return set(self._bursts)
+
+    def _deliver(self, q, sid: int, notif, in_burst: bool) -> bool:
+        """Bounded best-effort put with per-subscriber drop-burst
+        accounting — shared by the on-change fanout and the delta
+        engine's shared-render pushes.  Burst edges (first drop; first
+        successful put after drops) land in the flight ring; the
+        subscriber lock is only taken ON an edge, never on the healthy
+        path."""
+        try:
+            q.put_nowait(notif)
+        except queue.Full:
+            _SUB_DROPS.inc()
+            with self._sub_lock:
+                if id(q) not in self._sub_ids:
+                    # Removed concurrently: _remove_subscriber already
+                    # closed (or owns) this burst story — re-creating
+                    # the entry would leak it forever.
+                    depth = 0
+                else:
+                    depth = self._bursts.get(sid, 0) + 1
+                    self._bursts[sid] = depth
+            if depth == 1:
+                flight.event("gnmi-drop-burst-start", subscriber=sid)
+            return False
+        if in_burst:
+            with self._sub_lock:
+                burst = self._bursts.pop(sid, 0)
+            if burst:
+                flight.event(
+                    "gnmi-drop-burst", subscriber=sid, dropped=burst,
+                    ended="drained",
+                )
+        return True
+
     def _fanout(self, notif) -> None:
         """Best-effort delivery to every subscriber: bounded queues drop
         (and count) on overflow rather than block the publisher or grow
-        memory for a stalled consumer.  Burst edges (first drop; first
-        successful put after drops) are recorded per subscriber in the
-        flight ring — outside the subscriber lock."""
+        memory for a stalled consumer.  The lock is held for two
+        reference reads (copy-on-write snapshot + open-burst set);
+        every put and burst edge happens after release."""
         with self._sub_lock:
-            # Burst membership rides the same snapshot: the all-healthy
-            # path (no open burst, put succeeds) then takes no further
-            # locks per subscriber — only burst edges pay for one.
-            targets = []
-            for q in self._subscribers:
-                sid = self._sub_ids.get(id(q), 0)
-                targets.append((q, sid, sid in self._bursts))
-        events = []
-        for q, sid, in_burst in targets:
-            try:
-                q.put_nowait(notif)
-            except queue.Full:
-                _SUB_DROPS.inc()
-                with self._sub_lock:
-                    if id(q) not in self._sub_ids:
-                        # Removed concurrently: _remove_subscriber
-                        # already closed (or owns) this burst story —
-                        # re-creating the entry would leak it forever.
-                        depth = 0
-                    else:
-                        depth = self._bursts.get(sid, 0) + 1
-                        self._bursts[sid] = depth
-                if depth == 1:
-                    events.append(("gnmi-drop-burst-start", sid, 0))
-            else:
-                if in_burst:
-                    with self._sub_lock:
-                        burst = self._bursts.pop(sid, 0)
-                    if burst:
-                        events.append(("gnmi-drop-burst", sid, burst))
-        for kind, sid, dropped in events:
-            if kind == "gnmi-drop-burst-start":
-                flight.event(kind, subscriber=sid)
-            else:
-                flight.event(
-                    kind, subscriber=sid, dropped=dropped, ended="drained"
-                )
+            targets = self._subscribers
+            bursts = set(self._bursts)
+        for q, sid in targets:
+            self._deliver(q, sid, notif, sid in bursts)
 
     def Capabilities(self, request, context):
         resp = pb.CapabilityResponse(
@@ -336,13 +409,14 @@ class GnmiService:
 
     def Subscribe(self, request_iterator, context):
         q: queue.Queue = queue.Queue(maxsize=SUBSCRIBE_QUEUE_DEPTH)
-        self._add_subscriber(q)
+        sid = self._add_subscriber(q)
+        handle = None
         try:
             first = next(iter(request_iterator), None)
             # Initial sync: current state snapshot then sync_response.
             with self.daemon.lock:
                 state = self.daemon.northbound.get_state(None)
-            notif = pb.Notification(timestamp=int(time.time() * 1e9))
+            notif = pb.Notification(timestamp=self._clock_ns())
             notif.update.add(
                 path=pb.Path(),
                 val=pb.TypedValue(json_ietf_val=json.dumps(state, default=str)),
@@ -355,10 +429,40 @@ class GnmiService:
             ):
                 return
             # STREAM: the bounded fanout queue carries on-change
-            # notifications; per-subscription samplers add periodic
-            # SAMPLE pushes and ON_CHANGE heartbeat resends.
-            samplers = self._make_samplers(first)
+            # notifications, and — shared-delta path (ISSUE 11) — the
+            # fan-out engine's shared rendered pushes: this stream is
+            # then a cheap epoch cursor inside the engine's interval
+            # buckets and the loop below is a pure queue drain.
+            if (
+                self.fanout is not None
+                and first is not None
+                and first.HasField("subscribe")
+            ):
+                handle = self.fanout.attach(
+                    q, sid, first.subscribe.subscription
+                )
+            # Fallback contract: engine disabled or breaker open —
+            # per-subscription samplers walk the subtree on this
+            # stream's own timers (the pre-ISSUE-11 path, byte-
+            # identical output).
+            samplers = (
+                self._make_samplers(first) if handle is None else []
+            )
             while context.is_active():
+                if handle is not None:
+                    if not self.fanout.healthy():
+                        # Engine breaker opened mid-stream: degrade to
+                        # the walk path for the rest of this stream.
+                        self.fanout.detach(handle)
+                        handle = None
+                        samplers = self._make_samplers(first)
+                        continue
+                    try:
+                        notif = q.get(timeout=0.25)
+                        yield pb.SubscribeResponse(update=notif)
+                    except queue.Empty:
+                        pass
+                    continue
                 wait = 1.0
                 now = time.monotonic()
                 for s in samplers:
@@ -388,6 +492,8 @@ class GnmiService:
                         if out is not None:
                             yield pb.SubscribeResponse(update=out)
         finally:
+            if handle is not None:
+                self.fanout.detach(handle)
             self._remove_subscriber(q)
 
     @staticmethod
@@ -421,7 +527,7 @@ class GnmiService:
         s.last = leaves
         if not out:
             return None
-        notif = pb.Notification(timestamp=int(time.time() * 1e9))
+        notif = pb.Notification(timestamp=self._clock_ns())
         for p, v in sorted(out.items()):
             notif.update.add(path=str_to_path(p), val=_typed_value(v))
         # A beat forcing the resend wins the label even when a sample
@@ -434,9 +540,13 @@ class GnmiService:
 
     def _notify_yang(self, payload: dict) -> None:
         # Protocol YANG notifications ride the same update stream, one
-        # update per notification keyed by its qualified name.
+        # update per notification keyed by its qualified name.  The
+        # delta engine's stamp short-circuit is voided: protocol state
+        # moved outside the metrics registry.
+        if self.fanout is not None:
+            self.fanout.invalidate()
         for kind, body in payload.items():
-            notif = pb.Notification(timestamp=int(time.time() * 1e9))
+            notif = pb.Notification(timestamp=self._clock_ns())
             notif.update.add(
                 path=str_to_path(kind),
                 val=pb.TypedValue(
@@ -446,7 +556,9 @@ class GnmiService:
             self._fanout(notif)
 
     def _notify_commit(self, txn) -> None:
-        notif = pb.Notification(timestamp=int(time.time() * 1e9))
+        if self.fanout is not None:
+            self.fanout.invalidate()
+        notif = pb.Notification(timestamp=self._clock_ns())
         notif.update.add(
             path=str_to_path("transactions"),
             val=pb.TypedValue(
@@ -535,8 +647,26 @@ def _apply_json(tree, base: str, sub) -> None:
             tree.set(p, v)
 
 
-def serve_gnmi(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server:
-    service = GnmiService(daemon)
+def serve_gnmi(
+    daemon,
+    address: str,
+    tls_cert=None,
+    tls_key=None,
+    shared_fanout: bool | None = None,
+    fanout_tick: float | None = None,
+) -> grpc.Server:
+    tcfg = getattr(getattr(daemon, "config", None), "telemetry", None)
+    if shared_fanout is None:
+        shared_fanout = getattr(tcfg, "gnmi_shared_fanout", True)
+    if fanout_tick is None:
+        fanout_tick = getattr(tcfg, "fanout_tick", 1.0)
+    service = GnmiService(
+        daemon, shared_fanout=shared_fanout, fanout_tick=fanout_tick
+    )
+    if service.fanout is not None:
+        # The coalescing ticker parks while no stream has a bucket, so
+        # an idle service costs one blocked daemon thread.
+        service.fanout.start()
     daemon.add_commit_listener(service._notify_commit)
     daemon.add_notification_listener(service._notify_yang)
     svc_desc = pb.DESCRIPTOR.services_by_name["gNMI"]
@@ -562,6 +692,18 @@ def serve_gnmi(daemon, address: str, tls_cert=None, tls_key=None) -> grpc.Server
     _bind(server, address, tls_cert, tls_key)
     server.start()
     daemon._gnmi_service = service
+    if service.fanout is not None:
+        # The pre-existing caller contract is `server.stop(grace)`:
+        # fold the fan-out ticker shutdown into it so every stop path
+        # (tests, Daemon.stop, operators) joins the thread instead of
+        # leaking a parked engine per serve_gnmi call.
+        grpc_stop = server.stop
+
+        def _stop(grace=None):
+            service.fanout.stop()
+            return grpc_stop(grace)
+
+        server.stop = _stop
     return server
 
 

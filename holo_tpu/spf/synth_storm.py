@@ -465,6 +465,7 @@ def run_convergence_storm(
     settle: float = 60.0,
     prefix_every: int = 8,
     max_paths: int | None = None,
+    event_hook=None,
 ) -> tuple[dict, str, "StormNet"]:
     """One seeded convergence storm end to end.  Returns ``(report,
     digest, net)``; the report carries per-trigger p50/p95/p99/max
@@ -472,7 +473,15 @@ def run_convergence_storm(
 
     The event mix and every stochastic choice come from
     ``FaultPlan(seed)`` per-site streams, and time is virtual — two
-    runs with one seed produce byte-identical digests."""
+    runs with one seed produce byte-identical digests.
+
+    ``event_hook(net, index, now)`` — optional observer called after
+    each event's inter-event gap has elapsed (and once more after the
+    settle window, with ``index == events``).  The gNMI fan-out bench
+    rides this seam: a subscriber fleet joins/leaves and the shared
+    delta engine ticks at these deterministic virtual times.  The hook
+    only READS daemon state — the storm's causal timelines and FIB
+    digests are unaffected by its presence."""
     plan = FaultPlan(seed=seed, drop_prob=drop_prob)
     inj = FaultInjector(plan)
     net = StormNet(
@@ -488,7 +497,7 @@ def run_convergence_storm(
         loss_rng = inj._rng("storm.loss")
         gap_rng = inj._rng("storm.gap")
         bfd_down = carrier_down = False
-        for _ in range(events):
+        for ev_i in range(events):
             roll = mix_rng.random()
             if roll < 0.70:
                 edge = net.flappable[
@@ -512,7 +521,11 @@ def run_convergence_storm(
                 else 2.0 + gap_rng.random() * 4.0
             )
             net.loop.advance(gap)
+            if event_hook is not None:
+                event_hook(net, ev_i, net.loop.clock.now())
         net.loop.advance(settle)
+        if event_hook is not None:
+            event_hook(net, events, net.loop.clock.now())
         swept = tracker.sweep()
         timelines = tracker.timelines()
         report = storm_report(timelines)

@@ -41,6 +41,8 @@ from holo_tpu.telemetry.registry import (  # noqa: F401 — public API
     MetricsRegistry,
     deferred_mean,
     enabled,
+    volatile_children,
+    write_stamp,
 )
 from holo_tpu.telemetry.trace import SpanTracer
 
@@ -66,16 +68,21 @@ def tracer() -> SpanTracer:
     return _tracer
 
 
-def counter(name: str, help: str = "", labelnames=()):
-    return _registry.counter(name, help, tuple(labelnames))
+def counter(name: str, help: str = "", labelnames=(), stamped: bool = True):
+    return _registry.counter(name, help, tuple(labelnames), stamped=stamped)
 
 
-def gauge(name: str, help: str = "", labelnames=()):
-    return _registry.gauge(name, help, tuple(labelnames))
+def gauge(name: str, help: str = "", labelnames=(), stamped: bool = True):
+    return _registry.gauge(name, help, tuple(labelnames), stamped=stamped)
 
 
-def histogram(name: str, help: str = "", labelnames=(), buckets=None):
-    return _registry.histogram(name, help, tuple(labelnames), buckets)
+def histogram(
+    name: str, help: str = "", labelnames=(), buckets=None,
+    stamped: bool = True,
+):
+    return _registry.histogram(
+        name, help, tuple(labelnames), buckets, stamped=stamped
+    )
 
 
 def span(name: str, **attrs):

@@ -250,3 +250,54 @@ def clear_cost_table() -> None:
     """Tests only."""
     with _cost_lock:
         _cost_table.clear()
+
+
+def capture_device_trace(
+    trace_dir, n_routers: int = 48, seed: int = 3
+) -> dict:
+    """One REAL ``jax.profiler.trace()`` around a seeded SPF dispatch
+    ([telemetry] device-trace-dir; ROADMAP item-5 carry-over).
+
+    Relay-probe-aware: the capture only runs when the default platform
+    is an actual TPU — the CPU/relay approximation yields an explicit
+    ``relay: not-used`` row instead, NEVER a failure, so the bench's
+    ``device_trace`` row stays interpretable while the relay is down.
+    The compile is warmed outside the trace so the captured timeline is
+    one steady-state dispatch, not a Mosaic compile."""
+    from pathlib import Path
+
+    row: dict = {"relay": "not-used", "captured": False,
+                 "trace_dir": str(trace_dir)}
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception as e:  # noqa: BLE001 — a dead relay is a row, not a crash
+        row["error"] = f"{type(e).__name__}: {e}"[:200]
+        return row
+    row["platform"] = platform
+    if platform != "tpu":
+        row["reason"] = f"no TPU attached (platform={platform})"
+        return row
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth import random_ospf_topology
+
+    topo = random_ospf_topology(
+        n_routers=n_routers,
+        n_networks=max(n_routers // 8, 4),
+        extra_p2p=max(n_routers // 2, 16),
+        seed=seed,
+    )
+    backend = TpuSpfBackend()
+    backend.compute(topo)  # warm: compile + marshal outside the trace
+    out = Path(trace_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(out)):
+        backend.compute(topo)
+    row.update(
+        relay="used",
+        captured=True,
+        n_vertices=int(topo.n_vertices),
+        files=sum(1 for p in out.rglob("*") if p.is_file()),
+    )
+    return row

@@ -26,6 +26,9 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
         evictions, deltas-...    #   state, next to the hit/miss counters
         sharded-entries, mesh,   #   + mesh placement (ISSUE 8): resident
         per-device/...           #   entries/rows/bytes per device
+      gnmi-fanout/               # shared-delta fan-out engine (ISSUE 11):
+        epoch, subscribers,      #   epoch id, cursor/bucket population,
+        buckets, breaker, ...    #   breaker state + failure tally
 """
 
 from __future__ import annotations
@@ -118,6 +121,18 @@ class TelemetryStateProvider(NbProvider):
             tuner = tun.active_tuner()
             if tuner is not None:
                 out["engine-tuner"] = tuner.stats()
+        # Shared-delta gNMI fan-out (ISSUE 11): epoch / bucket /
+        # breaker stats, one entry per live engine (same lazy
+        # discipline — a daemon that never served a stream pays
+        # nothing at scrape time).  Get-only by contract: the engine
+        # excludes this leaf from its own sampled store (delta.py
+        # SELF_ROOT) so its epoch bookkeeping cannot feed back into
+        # the change-set it is diffing.
+        fan = sys.modules.get("holo_tpu.telemetry.delta")
+        if fan is not None:
+            rows = fan.engines_stats()
+            if rows:
+                out["gnmi-fanout"] = rows[0] if len(rows) == 1 else rows
         return {ROOT: out}
 
 

@@ -33,6 +33,48 @@ DEFAULT_BUCKETS = (
 
 _enabled = True
 
+# Leaf-version stamping (ISSUE 11): every metric write advances a
+# process-wide monotonic stamp and records it on the child.  The gNMI
+# shared-delta fan-out engine compares stamps instead of re-walking the
+# subtree: an unchanged stamp proves the whole registry-backed state
+# surface is byte-identical to the previous tick (suppress-redundant
+# and heartbeat become epoch comparisons).  A single-element list keeps
+# the read-modify-write GIL-atomic enough: racing writers may coalesce
+# increments, but the stamp always ADVANCES when anything was written,
+# which is the only property the delta engine needs.
+_WRITE_STAMP = [0]
+# Callback-backed gauges (``set_fn``) change value at COLLECT time with
+# no write to stamp — their existence disables the stamp short-circuit.
+_VOLATILE = [0]
+
+
+def write_stamp() -> int:
+    """Monotonic stamp of the last registry write (any child)."""
+    return _WRITE_STAMP[0]
+
+
+def volatile_children() -> int:
+    """Number of live callback-backed gauge children (their values move
+    without a write, so a non-zero count voids the stamp contract)."""
+    return _VOLATILE[0]
+
+
+def _bump_stamp() -> int:
+    s = _WRITE_STAMP[0] + 1
+    _WRITE_STAMP[0] = s
+    return s
+
+
+# Families registered with ``stamped=False`` update their children
+# WITHOUT advancing the global write stamp: the delta engine's own
+# bookkeeping (render counters, sample-update tallies) must not re-arm
+# the walk it instruments — otherwise every heartbeat served from the
+# render cache would wake the next tick's walk, which would see the
+# counter leaves changed, advance the epoch, deliver, bump again, and
+# never quiesce.  Unstamped children still render on every export
+# surface; their changes reach suppress-redundant subscribers
+# piggybacked on the next stamped write.
+
 
 def set_enabled(on: bool) -> None:
     """Global kill switch: disabled metrics become no-ops (the overhead
@@ -48,11 +90,13 @@ def enabled() -> bool:
 class Counter:
     """Monotonic counter child.  ``inc`` only accepts non-negative deltas."""
 
-    __slots__ = ("_lock", "_value")
+    __slots__ = ("_lock", "_value", "_stamp", "_stamped")
 
-    def __init__(self) -> None:
+    def __init__(self, stamped: bool = True) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
+        self._stamp = 0
+        self._stamped = stamped
 
     def inc(self, amount: float = 1.0) -> None:
         if not _enabled:
@@ -61,40 +105,61 @@ class Counter:
             raise ValueError("counters only go up")
         with self._lock:
             self._value += amount
+            self._stamp = _bump_stamp() if self._stamped else _WRITE_STAMP[0]
 
     @property
     def value(self) -> float:
         return self._value
+
+    @property
+    def stamp(self) -> int:
+        """Write-time version: the global stamp of the last mutation."""
+        return self._stamp
 
 
 class Gauge:
     """Point-in-time value child.  ``set_fn`` makes it callback-backed
     (sampled at collect time — queue depths, cache sizes)."""
 
-    __slots__ = ("_lock", "_value", "_fn")
+    __slots__ = ("_lock", "_value", "_fn", "_stamp", "_stamped")
 
-    def __init__(self) -> None:
+    def __init__(self, stamped: bool = True) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
         self._fn: Callable[[], float] | None = None
+        self._stamp = 0
+        self._stamped = stamped
 
     def set(self, value: float) -> None:
         if not _enabled:
             return
         with self._lock:
             self._value = float(value)
+            self._stamp = _bump_stamp() if self._stamped else _WRITE_STAMP[0]
 
     def inc(self, amount: float = 1.0) -> None:
         if not _enabled:
             return
         with self._lock:
             self._value += amount
+            self._stamp = _bump_stamp() if self._stamped else _WRITE_STAMP[0]
 
     def dec(self, amount: float = 1.0) -> None:
         self.inc(-amount)
 
     def set_fn(self, fn: Callable[[], float] | None) -> None:
+        # Volatility accounting: a live callback makes this child's
+        # value move without a stamped write, voiding the delta
+        # engine's skip-the-walk short-circuit.
+        if fn is not None and self._fn is None:
+            _VOLATILE[0] += 1
+        elif fn is None and self._fn is not None:
+            _VOLATILE[0] -= 1
         self._fn = fn
+
+    @property
+    def stamp(self) -> int:
+        return self._stamp
 
     @property
     def value(self) -> float:
@@ -120,15 +185,24 @@ class Histogram:
     exemplar) and O(1) per observe: just a tuple swap under the lock.
     """
 
-    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count", "_exemplars")
+    __slots__ = (
+        "_lock", "buckets", "_counts", "_sum", "_count", "_exemplars",
+        "_stamp", "_stamped",
+    )
 
-    def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+    def __init__(
+        self,
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+        stamped: bool = True,
+    ) -> None:
         self._lock = threading.Lock()
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
         self._count = 0
         self._exemplars: list | None = None  # lazy: [(labels, value)|None]
+        self._stamp = 0
+        self._stamped = stamped
 
     def observe(self, value: float, exemplar: dict | None = None) -> None:
         if not _enabled:
@@ -143,6 +217,7 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            self._stamp = _bump_stamp() if self._stamped else _WRITE_STAMP[0]
             if exemplar is not None:
                 if self._exemplars is None:
                     self._exemplars = [None] * (len(self.buckets) + 1)
@@ -174,6 +249,10 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    @property
+    def stamp(self) -> int:
+        return self._stamp
 
     def cumulative(self) -> list[tuple[float, int]]:
         """[(le, cumulative_count)] including the +Inf bucket."""
@@ -220,12 +299,14 @@ class MetricFamily:
         help: str = "",
         labelnames: tuple[str, ...] = (),
         buckets: tuple[float, ...] | None = None,
+        stamped: bool = True,
     ):
         self.name = name
         self.kind = kind
         self.help = help
         self.labelnames = tuple(labelnames)
         self._buckets = buckets
+        self._stamped = stamped
         self._lock = threading.Lock()
         self._children: dict[tuple, object] = {}
 
@@ -245,9 +326,12 @@ class MetricFamily:
                 child = self._children.get(key)
                 if child is None:
                     if self.kind == "histogram":
-                        child = Histogram(self._buckets or DEFAULT_BUCKETS)
+                        child = Histogram(
+                            self._buckets or DEFAULT_BUCKETS,
+                            stamped=self._stamped,
+                        )
                     else:
-                        child = _KINDS[self.kind]()
+                        child = _KINDS[self.kind](stamped=self._stamped)
                     self._children[key] = child
         return child
 
@@ -307,6 +391,7 @@ class MetricsRegistry:
         help: str,
         labelnames: tuple[str, ...],
         buckets: tuple[float, ...] | None = None,
+        stamped: bool = True,
     ) -> MetricFamily:
         fam = self._families.get(name)
         if fam is not None:
@@ -318,19 +403,29 @@ class MetricsRegistry:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
-                fam = MetricFamily(name, kind, help, labelnames, buckets)
+                fam = MetricFamily(
+                    name, kind, help, labelnames, buckets, stamped=stamped
+                )
                 self._families[name] = fam
         return fam
 
     def counter(
-        self, name: str, help: str = "", labelnames: tuple[str, ...] = ()
+        self,
+        name: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        stamped: bool = True,
     ) -> MetricFamily:
-        return self._get(name, "counter", help, labelnames)
+        return self._get(name, "counter", help, labelnames, stamped=stamped)
 
     def gauge(
-        self, name: str, help: str = "", labelnames: tuple[str, ...] = ()
+        self,
+        name: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        stamped: bool = True,
     ) -> MetricFamily:
-        return self._get(name, "gauge", help, labelnames)
+        return self._get(name, "gauge", help, labelnames, stamped=stamped)
 
     def histogram(
         self,
@@ -338,8 +433,11 @@ class MetricsRegistry:
         help: str = "",
         labelnames: tuple[str, ...] = (),
         buckets: tuple[float, ...] | None = None,
+        stamped: bool = True,
     ) -> MetricFamily:
-        return self._get(name, "histogram", help, labelnames, buckets)
+        return self._get(
+            name, "histogram", help, labelnames, buckets, stamped=stamped
+        )
 
     def families(self) -> list[MetricFamily]:
         with self._lock:

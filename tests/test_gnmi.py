@@ -105,6 +105,39 @@ def test_gnmi_end_to_end():
         server.stop(grace=0)
 
 
+def test_gnmi_serve_wires_shared_fanout_from_config():
+    """serve_gnmi arms the shared-delta fan-out engine by default
+    (ISSUE 11) and honours `[telemetry] gnmi-shared-fanout = false`
+    (the byte-identical per-subscriber walk configuration)."""
+    import holo_tpu.daemon.gnmi_server as gs
+    from holo_tpu.daemon.config import DaemonConfig
+
+    d = Daemon(loop=EventLoop(clock=VirtualClock()), name="fw1")
+    port = free_port()
+    server = gs.serve_gnmi(d, f"127.0.0.1:{port}")
+    try:
+        svc = d._gnmi_service
+        assert svc.fanout is not None
+        assert svc.fanout.tick == d.config.telemetry.fanout_tick
+        assert svc.fanout.stats()["breaker"] == "closed"
+        assert svc.fanout._thread is not None  # ticker armed
+    finally:
+        server.stop(grace=0)
+    # server.stop joins the fan-out ticker too (no leaked engine per
+    # serve_gnmi call — the pre-existing caller contract suffices).
+    assert svc.fanout._thread is None
+
+    cfg = DaemonConfig()
+    cfg.telemetry.gnmi_shared_fanout = False
+    d2 = Daemon(config=cfg, loop=EventLoop(clock=VirtualClock()), name="fw2")
+    port = free_port()
+    server = gs.serve_gnmi(d2, f"127.0.0.1:{port}")
+    try:
+        assert d2._gnmi_service.fanout is None
+    finally:
+        server.stop(grace=0)
+
+
 def test_gnmi_subscribe_streams_yang_notifications():
     """Protocol YANG notifications reach gNMI STREAM subscribers as
     updates pathed by the notification's qualified name."""

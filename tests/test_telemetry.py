@@ -299,7 +299,9 @@ def test_gnmi_subscriber_overflow_drop_counter_and_safe_removal():
     assert snap["holo_gnmi_subscribe_dropped_total"] - drops0 == 3
     svc._remove_subscriber(q)
     svc._remove_subscriber(q)  # exception-safe double removal
-    assert svc._subscribers == []
+    # Copy-on-write snapshot (ISSUE 11): the subscriber table is an
+    # immutable tuple so _fanout's lock hold is O(1).
+    assert svc._subscribers == ()
     assert snap["holo_gnmi_subscribers"] == 1.0
     assert (
         telemetry.snapshot(prefix="holo_gnmi")["holo_gnmi_subscribers"] == 0.0
