@@ -83,6 +83,11 @@ DISPATCH_PREFIXES = (
     "holo_tpu/frr",
     "holo_tpu/parallel",
     "holo_tpu/pipeline",
+    # The dispatch observatory rides the hot observe path (ISSUE 12):
+    # HL101-HL108 apply to it exactly like the dispatch modules it
+    # instruments (it must never touch a device value or reduce an
+    # array on the traced path).
+    "holo_tpu/telemetry/observatory.py",
 )
 CONCURRENCY_PREFIXES = (
     "holo_tpu/daemon",

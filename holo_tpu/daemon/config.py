@@ -95,6 +95,21 @@ class TelemetryConfig:
     # directory at boot.  Relay-probe-aware: without a TPU the daemon
     # records an explicit `relay: not-used` row — never a failure.
     device_trace_dir: str | None = None
+    # Dispatch observatory (ISSUE 12): streaming quantile sketches per
+    # (site, stage, engine, shape-bucket, kind) fed from the profiling
+    # sub-span path, roofline attribution against the compile-time
+    # cost model, and the warn-only regression sentinel.  Arming it
+    # also arms profile-device-time (the observatory feeds off the
+    # sub-span walls).  Gated < 2% by bench.py observatory_overhead.
+    observatory: bool = False
+    # Persisted sentinel baseline (the BENCH_baseline.json discipline:
+    # seed unseen keys, flag >10% drift, ratchet improvements).  None
+    # keeps the ledger in memory only.
+    observatory_ledger: str | None = None
+    # Roofline peak specs {flops=<per sec>, bytes=<per sec>, name=...};
+    # None = the honest CPU defaults ("relay: not-used") until the TPU
+    # relay returns with real specs.
+    roofline_peaks: dict | None = None
 
 
 @dataclass
@@ -243,6 +258,22 @@ class DaemonConfig:
             )
             cfg.telemetry.fanout_tick = float(t.get("fanout-tick", 1.0))
             cfg.telemetry.device_trace_dir = t.get("device-trace-dir")
+            cfg.telemetry.observatory = t.get("observatory", False)
+            cfg.telemetry.observatory_ledger = t.get("observatory-ledger")
+            rp = t.get("roofline-peaks")
+            if rp is not None:
+                ok = isinstance(rp, dict) and all(
+                    isinstance(rp.get(k), (int, float))
+                    and not isinstance(rp.get(k), bool)
+                    and rp.get(k) > 0
+                    for k in ("flops", "bytes")
+                )
+                if not ok:
+                    raise ValueError(
+                        "[telemetry] roofline-peaks must be a table with "
+                        f"positive 'flops' and 'bytes', got {rp!r}"
+                    )
+                cfg.telemetry.roofline_peaks = dict(rp)
         if "resilience" in raw:
             r = raw["resilience"]
             res = cfg.resilience

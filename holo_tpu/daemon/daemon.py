@@ -178,6 +178,18 @@ class Daemon:
             from holo_tpu.telemetry import profiling
 
             profiling.set_device_profiling(True)
+        # Dispatch observatory ([telemetry] observatory, ISSUE 12):
+        # streaming sketches + roofline attribution + the warn-only
+        # regression sentinel.  It feeds off the profiling sub-span
+        # walls, so arming it arms device profiling too.
+        if tcfg.observatory:
+            from holo_tpu.telemetry import observatory, profiling
+
+            profiling.set_device_profiling(True)
+            observatory.configure(
+                ledger_path=tcfg.observatory_ledger,
+                peaks=tcfg.roofline_peaks,
+            )
         # Device-trace capture ([telemetry] device-trace-dir, ISSUE 11
         # carry-over): one real jax.profiler.trace() around a seeded
         # SPF dispatch when a TPU is attached.  Relay-probe-aware — no
@@ -444,6 +456,16 @@ class Daemon:
                 telemetry.tracer().dump(self.config.telemetry.trace_dump)
             except OSError:
                 log.exception("trace dump failed")
+        if self.config.telemetry.observatory:
+            # Close the final sentinel window: checkpoint() seeds and
+            # compares every key once more and persists the baseline
+            # when anything changed (writes only ever happen at
+            # checkpoint boundaries — never on the dispatch thread).
+            import sys as _sys
+
+            obsm = _sys.modules.get("holo_tpu.telemetry.observatory")
+            if obsm is not None and obsm.active() is not None:
+                obsm.active().checkpoint()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=0.5)
         if getattr(self, "_gnmi_server", None) is not None:

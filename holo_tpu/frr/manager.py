@@ -395,7 +395,10 @@ class FrrEngine:
         # The FRR analog of the SPF backend's sanctioned boundary: the
         # padded planes move host->device here, results device->host
         # in _finish_tpu, and nowhere else.
-        with profiling.stage("frr.batch", "marshal"):
+        obucket = self._obs_bucket(topo) if profiling.observing() else None
+        with self._obs_ctx(obucket), profiling.stage(
+            "frr.batch", "marshal"
+        ):
             with sanctioned_transfer("frr.batch.marshal"):
                 g = self._prepare(topo)
                 if mesh is not None:
@@ -427,15 +430,46 @@ class FrrEngine:
                     fresh = True
                 out = step(g, topo.root, *args)
         if fresh:
-            profiling.record_cost(
+            entry = profiling.record_cost(
                 "frr.batch", step, g, topo.root, *args, shape_sig=sig
             )
-        return (out, fin, topo, mesh is not None)
+            if entry is not None and obucket is not None:
+                from holo_tpu.telemetry import observatory
+
+                observatory.note_cost(
+                    "frr.batch", "frr", "frr", obucket, entry
+                )
+        return (out, fin, topo, mesh is not None, obucket)
+
+    @staticmethod
+    def _obs_bucket(topo):
+        """The observatory shape key for this FRR batch (the SPF
+        tuner's quantization, batch = the all-roots plane) — computed
+        ONCE per dispatch at launch and carried through the handle."""
+        from holo_tpu.parallel.mesh import mesh_cache_key
+        from holo_tpu.pipeline.tuner import shape_bucket
+
+        return shape_bucket(
+            topo.n_vertices, topo.n_edges, 1, mesh_cache_key()
+        )
+
+    @staticmethod
+    def _obs_ctx(obucket):
+        """Dispatch-context window for the observatory feed (ISSUE 12):
+        a shared null context while it is disarmed."""
+        if obucket is None:
+            return profiling.dispatch_context()
+        return profiling.dispatch_context(
+            kind="frr", engine="frr", bucket=obucket
+        )
 
     def _finish_tpu(self, handle: tuple) -> BackupTable:
         """Phase 2: device completion + readback + accounting."""
-        out, fin, topo, sharded = handle
-        with profiling.stage("frr.batch", "device"):
+        out, fin, topo, sharded, obucket = handle
+        with self._obs_ctx(obucket), profiling.stage(
+            "frr.batch", "device"
+        ):
+            faults.delaypoint("frr.dispatch")
             with profiling.annotation("frr.batch.device"):
                 if not profiling.device_stages("frr.batch", out):
                     profiling.sync(out)
@@ -444,7 +478,9 @@ class FrrEngine:
         if sharded:
             _FRR_SHARD_DISPATCHES.labels(kind="frr").inc()
         convergence.note_dispatch("frr", "device")
-        with profiling.stage("frr.batch", "readback"):
+        with self._obs_ctx(obucket), profiling.stage(
+            "frr.batch", "readback"
+        ):
             with sanctioned_transfer("frr.batch.unmarshal"):
                 # [:nl] drops the link-plane pad (marshal bucket + mesh
                 # batch-axis pad); [:n] drops the node-sharded row pad

@@ -454,6 +454,88 @@ class EngineTuner:
 
     # -- introspection --------------------------------------------------
 
+    def ledger(self) -> list[dict]:
+        """Per-bucket win/loss rows for ``holo-tpu-tools explain`` —
+        the tuner's decisions made explainable: the winner, every
+        measured engine's median wall + compile-time cost prior, and
+        the resource axis the winner actually leads on (``packed beat
+        fused on bytes, not flops``)."""
+        rows = []
+        with self._lock:
+            items = sorted(
+                self._table.items(), key=lambda kv: self._bucket_str(kv[0])
+            )
+            for key, st in items:
+                kind, bucket = key[0], key[1:]
+                winner = st.winner or self.default_engine
+                measured = [
+                    e for e in st.samples
+                    if _median(st.samples[e]) is not None
+                ]
+                if len(measured) == 1 and winner not in measured:
+                    # A bucket with one formulation outside the tuned
+                    # set (the k>1 "mp" kernel): there was no choice —
+                    # report the engine that actually ran, not the
+                    # never-dispatched default.
+                    winner = measured[0]
+                engines = {}
+                for e in sorted(st.samples):
+                    med = _median(st.samples[e])
+                    engines[e] = {
+                        "median_ms": (
+                            round(med * 1e3, 4) if med is not None else None
+                        ),
+                        "samples": len(st.samples[e]),
+                        "cost": st.cost.get(e),
+                    }
+                rows.append(
+                    {
+                        "kind": kind,
+                        "bucket": list(bucket),
+                        "winner": winner,
+                        "dispatches": st.dispatches,
+                        "engines": engines,
+                        "basis": self._win_basis(st, winner),
+                    }
+                )
+        return rows
+
+    def _win_basis(self, st: _BucketState, winner: str) -> str:
+        """Why the winner wins, on the cost model's axes: strictly the
+        lowest estimated bytes among measured rivals -> "bytes",
+        strictly the lowest flops -> "flops", otherwise the measured
+        wall alone decided (call under the tuner lock)."""
+        if _median(st.samples.get(winner)) is None:
+            return "default (no samples)"
+        rivals = [
+            e
+            for e in st.samples
+            if e != winner and _median(st.samples[e]) is not None
+        ]
+        if not rivals:
+            return "only measured engine"
+        wc = st.cost.get(winner)
+        priced = [e for e in rivals if st.cost.get(e)]
+        basis = "wall"
+        if wc and priced:
+            inf = float("inf")
+            if all(
+                wc.get("bytes", inf) < st.cost[e].get("bytes", inf)
+                for e in priced
+            ):
+                basis = "bytes"
+            elif all(
+                wc.get("flops", inf) < st.cost[e].get("flops", inf)
+                for e in priced
+            ):
+                basis = "flops"
+        # Name only the rivals the claim was actually checked against:
+        # a cost-axis basis compared the PRICED rivals; an unpriced
+        # rival (no cost_analysis on this platform) was only ever
+        # beaten on the measured wall.
+        named = sorted(priced if basis in ("bytes", "flops") else rivals)
+        return f"{winner} beat {', '.join(named)} on {basis}"
+
     def stats(self) -> dict:
         """holo-telemetry state-leaf / bench view."""
         with self._lock:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import hashlib
 import random
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
@@ -71,6 +72,13 @@ class FaultPlan:
     # must reassemble across arbitrarily fragmented tx).
     tcp_reset_prob: float = 0.0
     tcp_partial_write_prob: float = 0.0
+    # Dispatch-delay seam (ISSUE 12): {site: seconds} of injected stall
+    # inside the device sub-span of every dispatch at that site — the
+    # dispatch still SUCCEEDS, so the breaker never trips; the layer
+    # that must notice is the observatory's regression sentinel (its
+    # acceptance test slows one shape bucket and expects the warn-only
+    # flag within one storm).
+    dispatch_delay: dict = field(default_factory=dict)
 
     def rng(self, site: str) -> random.Random:
         """Independent deterministic stream for one seam site."""
@@ -109,6 +117,15 @@ class FaultInjector:
         if p and self._rng(f"dispatch:{site}").random() < p:
             self._record(site)
             raise InjectedFault(f"random dispatch failure at {site}")
+
+    def delaypoint(self, site: str) -> None:
+        """Slow (never fail) the dispatch at ``site`` by the planned
+        stall — inside the device sub-span, so the injected latency is
+        attributed exactly where a real platform slowdown would land."""
+        d = self.plan.dispatch_delay.get(site, 0.0)
+        if d:
+            self._record(f"delay:{site}")
+            time.sleep(d)
 
     # -- BGP TCP transport seams (utils/tcpio.py)
 
@@ -276,6 +293,12 @@ def crashpoint(site: str) -> None:
     """Dispatch-path seam: no-op unless a plan is armed via inject()."""
     if _active is not None:
         _active.crashpoint(site)
+
+
+def delaypoint(site: str) -> None:
+    """Dispatch-stall seam: no-op unless a plan is armed via inject()."""
+    if _active is not None:
+        _active.delaypoint(site)
 
 
 @contextmanager

@@ -164,7 +164,7 @@ class _InFlightOne:
     topo: Topology
     t0: float
     engine: str
-    bucket: tuple | None
+    bucket: tuple | None  # tuner bucket; None = "never feed the tuner"
     mode: str  # "full" | "delta"
     n_atoms: int
     delta_kind: str = ""
@@ -173,6 +173,9 @@ class _InFlightOne:
     sharded: bool = False
     remarshal: bool = False
     fresh: bool = False  # fresh XLA compile: not a tuner sample
+    # Observatory shape key (ISSUE 12) — deliberately separate from
+    # ``bucket`` so observing never overrides the tuner's None sentinel.
+    obucket: tuple | None = None
     # Wall of the launch phase alone: tuner samples use launch_s +
     # finish wall, EXCLUDING the time the entry sat parked in the
     # pipeline's in-flight slot while the worker served other keys —
@@ -230,18 +233,18 @@ class ScalarSpfBackend(SpfBackend):
         # Same dispatch histogram as the TPU backend (kind axis shared):
         # a default-config daemon still reports SPF timing; only the
         # transfer/recompile signals are device-specific.
-        t0 = time.perf_counter()
+        t0 = profiling.clock()
         with telemetry.span("spf.dispatch", kind="one", backend="scalar"):
             res = self._one(topo, edge_mask, mp_pad(multipath_k))
         _DISPATCH_SECONDS.labels(backend="scalar", kind="one").observe(
-            time.perf_counter() - t0
+            profiling.clock() - t0
         )
         _BATCH_SCENARIOS.labels(kind="one").inc()
         convergence.note_dispatch("spf", "scalar")
         return res
 
     def compute_whatif(self, topo, edge_masks, multipath_k: int = 1):
-        t0 = time.perf_counter()
+        t0 = profiling.clock()
         kp = mp_pad(multipath_k)
         with telemetry.span(
             "spf.dispatch", kind="whatif", backend="scalar",
@@ -249,7 +252,7 @@ class ScalarSpfBackend(SpfBackend):
         ):
             res = [self._one(topo, m, kp) for m in edge_masks]
         _DISPATCH_SECONDS.labels(backend="scalar", kind="whatif").observe(
-            time.perf_counter() - t0
+            profiling.clock() - t0
         )
         _BATCH_SCENARIOS.labels(kind="whatif").inc(len(res))
         convergence.note_dispatch("spf", "scalar")
@@ -480,6 +483,36 @@ class TpuSpfBackend(SpfBackend):
         if t is not None:
             t.cost_prior(kind, bucket, engine, entry)
 
+    def _obs_bucket(self, topo, batch: int, kp: int, bucket):
+        """The observatory's shape key for this dispatch (ISSUE 12):
+        the tuner bucket when one was computed, else the same pow2
+        quantization derived directly — sketches must key on shape
+        even when no tuner is armed.  Kept SEPARATE from the tuner's
+        bucket variable: ``_pick_engine`` returns ``bucket=None`` as a
+        deliberate "never feed the tuner" sentinel (blocked-engine
+        backends, unarmed tuner), and arming a passive observability
+        feature must not start mutating engine-selection state.
+        Returns None while the observatory is disarmed."""
+        if not profiling.observing():
+            return None
+        if bucket is not None:
+            return bucket
+        from holo_tpu.pipeline.tuner import shape_bucket
+
+        return shape_bucket(
+            topo.n_vertices, topo.n_edges, batch, _mesh_key(), k=kp
+        )
+
+    @staticmethod
+    def _obs_cost(site, kind, engine, bucket, entry) -> None:
+        """Forward a fresh-compile cost entry to the observatory's
+        roofline join (the ``cost_prior`` twin for sketches)."""
+        if entry is None or not profiling.observing():
+            return
+        from holo_tpu.telemetry import observatory
+
+        observatory.note_cost(site, kind, engine, bucket, entry)
+
     def _depth_bucket(self, topo, kp: int = 1):
         """The DeltaPath depth-tuning bucket (kind=one, batch=1).
         ``kp`` rides the shape key: the widened kernel's delta/full
@@ -695,12 +728,15 @@ class TpuSpfBackend(SpfBackend):
             res = self._try_incremental(topo, kp)
             if res is not None:
                 return res
-        t0 = time.perf_counter()
+        t0 = profiling.clock()
         engine, bucket = self._pick_engine("one", topo, kp=kp)
+        obucket = self._obs_bucket(topo, 1, kp, bucket)
         step = (
             self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
         )
-        with telemetry.span("spf.dispatch", kind="one", backend="tpu"):
+        with profiling.dispatch_context(
+            kind="one", engine=engine, bucket=obucket
+        ), telemetry.span("spf.dispatch", kind="one", backend="tpu"):
             # THE sanctioned marshal boundary: host graph + root + mask
             # move to device here and nowhere else (transfer_guard
             # "disallow" everywhere outside these windows).
@@ -725,11 +761,13 @@ class TpuSpfBackend(SpfBackend):
                     "spf.one", step, g, topo.root, mask, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
+                self._obs_cost("spf.one", "one", engine, obucket, entry)
             with profiling.stage("spf.one", "device"):
+                faults.delaypoint("spf.dispatch")
                 with profiling.annotation("spf.one.device"):
                     if not profiling.device_stages("spf.one", out):
                         profiling.sync(out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
                     sp = out[0] if kp > 1 else out
@@ -741,7 +779,7 @@ class TpuSpfBackend(SpfBackend):
                         dist=dist, parent=parent, hops=hops,
                         nexthop_words=nh, **mpkw,
                     )
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="one").inc()
@@ -787,8 +825,11 @@ class TpuSpfBackend(SpfBackend):
         if prev is None:
             note_delta(delta.kind, "full-no-prev")
             return None
-        t0 = time.perf_counter()
-        with telemetry.span(
+        t0 = profiling.clock()
+        obucket = self._obs_bucket(topo, 1, kp, None)
+        with profiling.dispatch_context(
+            kind="delta", engine="incr", bucket=obucket
+        ), telemetry.span(
             "spf.dispatch", kind="one", backend="tpu", mode="delta"
         ):
             with profiling.stage("spf.one", "delta"):
@@ -851,14 +892,16 @@ class TpuSpfBackend(SpfBackend):
                     if kp > 1
                     else (g, topo.root, out, seeds_p)
                 )
-                profiling.record_cost(
+                entry = profiling.record_cost(
                     "spf.delta", step, *cost_args, shape_sig=sig,
                 )
+                self._obs_cost("spf.one", "delta", "incr", obucket, entry)
             with profiling.stage("spf.one", "device"):
+                faults.delaypoint("spf.dispatch")
                 with profiling.annotation("spf.one.delta.device"):
                     if not profiling.device_stages("spf.one", out):
                         profiling.sync(out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
                     sp = out[0] if kp > 1 else out
@@ -870,7 +913,7 @@ class TpuSpfBackend(SpfBackend):
                         dist=dist, parent=parent, hops=hops,
                         nexthop_words=nh, **mpkw,
                     )
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="one").inc()
@@ -924,8 +967,11 @@ class TpuSpfBackend(SpfBackend):
             self._jit_blocked = jax.jit(
                 partial(whatif_spf_blocked, max_iters=self.max_iters)
             )
-        t0 = time.perf_counter()
-        with telemetry.span(
+        t0 = profiling.clock()
+        bl_bucket = self._obs_bucket(topo, len(edge_masks), 1, None)
+        with profiling.dispatch_context(
+            kind="blocked", engine="blocked", bucket=bl_bucket
+        ), telemetry.span(
             "spf.dispatch", kind="blocked", backend="tpu",
             batch=len(edge_masks),
         ):
@@ -936,14 +982,17 @@ class TpuSpfBackend(SpfBackend):
                 with sanctioned_transfer("spf.blocked.dispatch"):
                     out = self._jit_blocked(g, fdst, fid)
             if fresh:
-                profiling.record_cost(
+                entry = profiling.record_cost(
                     "spf.blocked", self._jit_blocked, g, fdst, fid,
                     shape_sig=(fdst.shape, fid.shape),
+                )
+                self._obs_cost(
+                    "spf.blocked", "blocked", "blocked", bl_bucket, entry
                 )
             with profiling.stage("spf.blocked", "device"):
                 with profiling.annotation("spf.blocked.device"):
                     profiling.sync(out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             with profiling.stage("spf.blocked", "readback"):
                 with sanctioned_transfer("spf.blocked.unmarshal"):
                     dist, parent, hops, nh = (
@@ -952,7 +1001,7 @@ class TpuSpfBackend(SpfBackend):
                         np.asarray(out.hops),
                         np.asarray(out.nexthops),
                     )
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="blocked").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="blocked").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="blocked").inc(dist.shape[0])
@@ -974,9 +1023,12 @@ class TpuSpfBackend(SpfBackend):
             if res is not None:
                 return res
         B = len(edge_masks)
-        t0 = time.perf_counter()
+        t0 = profiling.clock()
         engine, bucket = self._pick_engine("whatif", topo, B, kp=kp)
-        with telemetry.span(
+        obucket = self._obs_bucket(topo, B, kp, bucket)
+        with profiling.dispatch_context(
+            kind="whatif", engine=engine, bucket=obucket
+        ), telemetry.span(
             "spf.dispatch", kind="whatif", backend="tpu", batch=B,
         ):
             with profiling.stage("spf.whatif", "marshal"):
@@ -1021,11 +1073,15 @@ class TpuSpfBackend(SpfBackend):
                     shape_sig=sig,
                 )
                 self._tuner_cost("whatif", bucket, engine, entry)
+                self._obs_cost(
+                    "spf.whatif", "whatif", engine, obucket, entry
+                )
             with profiling.stage("spf.whatif", "device"):
+                faults.delaypoint("spf.dispatch")
                 with profiling.annotation("spf.whatif.device"):
                     if not profiling.device_stages("spf.whatif", out):
                         profiling.sync(out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             # One bulk device→host transfer per plane: per-scenario slicing
             # of device arrays would pay the host round-trip B×4 times.
             with profiling.stage("spf.whatif", "readback"):
@@ -1035,7 +1091,7 @@ class TpuSpfBackend(SpfBackend):
                         sp, topo.n_vertices
                     )
                     mpkw = _host_mp(out[1], topo.n_vertices) if kp > 1 else {}
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="whatif").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="whatif").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="whatif").inc(B)
@@ -1068,8 +1124,11 @@ class TpuSpfBackend(SpfBackend):
         if mesh is not None:
             faults.crashpoint("spf.shard")
         R = len(roots)
-        t0 = time.perf_counter()
-        with telemetry.span(
+        t0 = profiling.clock()
+        mr_bucket = self._obs_bucket(topo, R, 1, None)
+        with profiling.dispatch_context(
+            kind="multiroot", engine="seq", bucket=mr_bucket
+        ), telemetry.span(
             "spf.dispatch", kind="multiroot", backend="tpu", roots=R
         ):
             with profiling.stage("spf.multiroot", "marshal"):
@@ -1095,15 +1154,18 @@ class TpuSpfBackend(SpfBackend):
                     mask = np.ones(topo.n_edges, bool)
                     out = step(g, roots_dev, mask)
             if fresh:
-                profiling.record_cost(
+                entry = profiling.record_cost(
                     "spf.multiroot", step, g, roots_dev, mask,
                     shape_sig=sig,
+                )
+                self._obs_cost(
+                    "spf.multiroot", "multiroot", "seq", mr_bucket, entry
                 )
             with profiling.stage("spf.multiroot", "device"):
                 with profiling.annotation("spf.multiroot.device"):
                     if not profiling.device_stages("spf.multiroot", out):
                         profiling.sync(out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             with profiling.stage("spf.multiroot", "readback"):
                 with sanctioned_transfer("spf.multiroot.unmarshal"):
                     dist, parent, hops, _nh = _host_tensors(
@@ -1112,7 +1174,7 @@ class TpuSpfBackend(SpfBackend):
                     res = MultiRootResult(
                         dist=dist[:R], parent=parent[:R], hops=hops[:R]
                     )
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="multiroot").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="multiroot").observe(t2 - t0)
         _BATCH_SCENARIOS.labels(kind="multiroot").inc(R)
@@ -1147,12 +1209,15 @@ class TpuSpfBackend(SpfBackend):
             h = self._launch_incremental(topo, n_atoms, kp)
             if h is not None:
                 return h
-        t0 = time.perf_counter()
+        t0 = profiling.clock()
         engine, bucket = self._pick_engine("one", topo, kp=kp)
+        obucket = self._obs_bucket(topo, 1, kp, bucket)
         step = (
             self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
         )
-        with telemetry.span(
+        with profiling.dispatch_context(
+            kind="one", engine=engine, bucket=obucket
+        ), telemetry.span(
             "spf.launch", kind="one", backend="tpu", engine=engine
         ):
             with profiling.stage("spf.one", "marshal"):
@@ -1173,14 +1238,15 @@ class TpuSpfBackend(SpfBackend):
                     "spf.one", step, g, topo.root, mask, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
+                self._obs_cost("spf.one", "one", engine, obucket, entry)
         return _InFlightOne(
             out=out, topo=topo, t0=t0, engine=engine, bucket=bucket,
             mode="full", n_atoms=n_atoms, kp=kp,
             remember=edge_mask is None and self.incremental,
             sharded=mesh is not None,
             remarshal=remarshal and edge_mask is None,
-            fresh=fresh,
-            launch_s=time.perf_counter() - t0,
+            fresh=fresh, obucket=obucket,
+            launch_s=profiling.clock() - t0,
         )
 
     def _launch_incremental(
@@ -1203,8 +1269,11 @@ class TpuSpfBackend(SpfBackend):
         if prev is None:
             note_delta(delta.kind, "full-no-prev")
             return None
-        t0 = time.perf_counter()
-        with telemetry.span(
+        t0 = profiling.clock()
+        obucket = self._obs_bucket(topo, 1, kp, None)
+        with profiling.dispatch_context(
+            kind="delta", engine="incr", bucket=obucket
+        ), telemetry.span(
             "spf.launch", kind="one", backend="tpu", mode="delta"
         ):
             with profiling.stage("spf.one", "delta"):
@@ -1244,26 +1313,31 @@ class TpuSpfBackend(SpfBackend):
                     if kp > 1
                     else (g, topo.root, out, seeds_p)
                 )
-                profiling.record_cost(
+                entry = profiling.record_cost(
                     "spf.delta", step, *cost_args, shape_sig=sig,
                 )
+                self._obs_cost("spf.one", "delta", "incr", obucket, entry)
         return _InFlightOne(
             out=out, topo=topo, t0=t0, engine="incr", bucket=None,
             mode="delta", delta_kind=delta.kind, n_atoms=n_atoms, kp=kp,
-            remember=True, sharded=_mesh() is not None,
-            launch_s=time.perf_counter() - t0,
+            remember=True, sharded=_mesh() is not None, obucket=obucket,
+            launch_s=profiling.clock() - t0,
         )
 
     def finish_one(self, h: "_InFlightOne") -> SpfResult:
-        t_fs = time.perf_counter()
-        with telemetry.span(
+        t_fs = profiling.clock()
+        with profiling.dispatch_context(
+            kind="delta" if h.mode == "delta" else "one",
+            engine=h.engine, bucket=h.obucket,
+        ), telemetry.span(
             "spf.finish", kind="one", backend="tpu", mode=h.mode
         ):
             with profiling.stage("spf.one", "device"):
+                faults.delaypoint("spf.dispatch")
                 with profiling.annotation("spf.one.device"):
                     if not profiling.device_stages("spf.one", h.out):
                         profiling.sync(h.out)
-            t1 = time.perf_counter()
+            t1 = profiling.clock()
             with profiling.stage("spf.one", "readback"):
                 with sanctioned_transfer("spf.one.unmarshal"):
                     sp = h.out[0] if h.kp > 1 else h.out
@@ -1279,7 +1353,7 @@ class TpuSpfBackend(SpfBackend):
                         dist=dist, parent=parent, hops=hops,
                         nexthop_words=nh, **mpkw,
                     )
-        t2 = time.perf_counter()
+        t2 = profiling.clock()
         _TRANSFER_SECONDS.labels(kind="one").observe(t2 - t1)
         _DISPATCH_SECONDS.labels(backend="tpu", kind="one").observe(
             t2 - h.t0

@@ -42,7 +42,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 
 from holo_tpu import telemetry
 
@@ -69,6 +69,27 @@ _COST_BYTES = telemetry.gauge(
 
 _enabled = False
 
+# Dispatch-observatory feed (ISSUE 12): when armed, every stage
+# observation is ALSO handed to the observer callback — the streaming
+# quantile sketches in holo_tpu.telemetry.observatory.  One module
+# global: the disarmed hot-path cost is exactly this None check.
+_OBSERVER = None
+
+# Stage timer: time.perf_counter in production; the observatory's
+# DeterministicTimer swaps it so a seeded workload produces
+# byte-identical sketches (set_stage_timer).
+_timer = time.perf_counter
+_timer_overridden = False
+
+# Dispatch context (thread-local): the backend labels its dispatch
+# window with (kind, engine, shape-bucket) so the observatory can key
+# sketches without new arguments threading through every stage() call.
+# Only ever entered while an observer is armed — dispatch_context()
+# returns a shared null context otherwise, so the un-observed hot path
+# pays one global check and one call.
+_ctx_local = threading.local()
+_NULLCTX = nullcontext()
+
 # (site, shape signature) -> {"flops": float, "bytes": float}; one entry
 # per compiled shape bucket, exactly mirroring the backends' jit caches.
 _cost_lock = threading.Lock()
@@ -86,6 +107,69 @@ def device_profiling() -> bool:
     return _enabled
 
 
+def set_observer(fn) -> None:
+    """Install/remove the dispatch-observatory stage observer (ISSUE
+    12; :func:`holo_tpu.telemetry.observatory.configure` is the only
+    caller).  ``fn(site, stage, device, seconds)`` runs after every
+    completed stage observation; ``None`` disarms — the stage hot path
+    then pays exactly one global check for the feature."""
+    global _OBSERVER
+    _OBSERVER = fn
+
+
+def observing() -> bool:
+    """True while a stage observer (the observatory) is armed."""
+    return _OBSERVER is not None
+
+
+def set_stage_timer(fn) -> None:
+    """Swap the stage timer (``None`` restores ``time.perf_counter``).
+    The observatory's ``DeterministicTimer`` uses this for
+    byte-identical seeded runs; nothing else should."""
+    global _timer, _timer_overridden
+    _timer = fn if fn is not None else time.perf_counter
+    _timer_overridden = fn is not None
+
+
+def stage_timer_overridden() -> bool:
+    return _timer_overridden
+
+
+def clock() -> float:
+    """The stage timer — ``time.perf_counter`` unless a deterministic
+    timer is installed.  Dispatch walls that feed the engine tuner read
+    THIS instead of ``time.perf_counter`` directly, so a deterministic
+    explain run makes deterministic tuner decisions (and the whole
+    report stays byte-identical); in production the two are the same
+    function."""
+    return _timer()
+
+
+def dispatch_ctx() -> dict | None:
+    """The active dispatch context (observer keying), or None."""
+    return getattr(_ctx_local, "ctx", None)
+
+
+@contextmanager
+def _dispatch_context(kw: dict):
+    prev = getattr(_ctx_local, "ctx", None)
+    _ctx_local.ctx = kw
+    try:
+        yield
+    finally:
+        _ctx_local.ctx = prev
+
+
+def dispatch_context(**kw):
+    """Label the enclosed dispatch for the observatory feed — the
+    backends wrap each device dispatch with its (kind, engine,
+    shape-bucket).  A shared null context when no observer is armed,
+    so the unobserved dispatch path pays one check + one call."""
+    if _OBSERVER is None:
+        return _NULLCTX
+    return _dispatch_context(kw)
+
+
 @contextmanager
 def stage(site: str, name: str, device: str = "-"):
     """One dispatch phase: a nested trace sub-span plus a
@@ -94,16 +178,42 @@ def stage(site: str, name: str, device: str = "-"):
     (``spf.one``, ``spf.whatif``, ``frr.batch``, ...), ``name`` the
     phase (``marshal`` / ``device`` / ``readback``); ``device`` is the
     per-device split label of a sharded dispatch ('-' = whole span,
-    see :func:`device_stages`)."""
+    see :func:`device_stages`).
+
+    When the dispatch observatory is armed (:func:`set_observer`) the
+    measured wall is ALSO fed to its streaming sketches — including
+    with device profiling off, so the observatory can stay always-on
+    without the histogram/exemplar machinery; observations keep the
+    existing contract of recording only on clean exit."""
+    obs = _OBSERVER
     if not _enabled:
+        if obs is None:
+            yield None
+            return
+        t0 = _timer()
         yield None
+        _observe_guarded(obs, site, name, device, _timer() - t0)
         return
-    t0 = time.perf_counter()
+    t0 = _timer()
     with telemetry.span(f"{site}.{name}", stage=name, device=device) as sid:
         yield sid
+    dt = _timer() - t0
     _STAGE_SECONDS.labels(site=site, stage=name, device=device).observe(
-        time.perf_counter() - t0, exemplar={"span_id": sid}
+        dt, exemplar={"span_id": sid}
     )
+    if obs is not None:
+        _observe_guarded(obs, site, name, device, dt)
+
+
+def _observe_guarded(obs, site, name, device, dt) -> None:
+    """The observatory is warn-only BY CONTRACT: an observer bug (e.g.
+    a lock-free race losing a bin mid-quantile) must never propagate
+    into the dispatch, where the circuit breaker would misread it as a
+    device failure and serve the scalar fallback."""
+    try:
+        obs(site, name, device, dt)
+    except Exception:  # noqa: BLE001 — see contract above
+        log.debug("stage observer failed", exc_info=True)
 
 
 def device_stages(site: str, tree) -> bool:
@@ -153,8 +263,10 @@ def sync(tree) -> None:
     """Completion barrier bounding the **device** phase: block until the
     jit result pytree is ready.  A no-op when profiling is off — the
     un-profiled dispatch path keeps its async overlap and pays for the
-    device inside the readback materialization instead."""
-    if not _enabled:
+    device inside the readback materialization instead.  An armed
+    observatory also needs the barrier: without it every device wall
+    would hide inside the readback sketch."""
+    if not _enabled and _OBSERVER is None:
         return
     import jax
 
@@ -192,8 +304,10 @@ def record_cost(site: str, jitfn, *args, shape_sig: tuple = ()) -> dict | None:
     bucket (the AOT path does not share the jit dispatch cache), which
     is why this only runs when profiling is armed — it is compile-time
     cost on a cold bucket, never per-dispatch cost.  Never raises:
-    backends without cost analysis record nothing."""
-    if not _enabled:
+    backends without cost analysis record nothing.  The armed
+    observatory needs the same capture (its roofline numerators), so
+    either switch enables it."""
+    if not _enabled and _OBSERVER is None:
         return None
     try:
         ca = jitfn.lower(*args).compile().cost_analysis()
@@ -266,7 +380,9 @@ def capture_device_trace(
     one steady-state dispatch, not a Mosaic compile."""
     from pathlib import Path
 
-    row: dict = {"relay": "not-used", "captured": False,
+    from holo_tpu.telemetry import relay
+
+    row: dict = {"relay": relay.not_used(), "captured": False,
                  "trace_dir": str(trace_dir)}
     try:
         import jax
@@ -274,8 +390,17 @@ def capture_device_trace(
         platform = jax.devices()[0].platform
     except Exception as e:  # noqa: BLE001 — a dead relay is a row, not a crash
         row["error"] = f"{type(e).__name__}: {e}"[:200]
+        relay.note_probe(False, error=row["error"])
         return row
     row["platform"] = platform
+    # The platform verdict doubles as the daemon's in-process relay
+    # observation (holo_relay_up / holo-telemetry/relay): a daemon
+    # configured with device-trace-dir reports what it actually found
+    # instead of leaving the watch to the bench process alone.
+    relay.note_probe(
+        platform == "tpu",
+        error=None if platform == "tpu" else f"platform={platform}",
+    )
     if platform != "tpu":
         row["reason"] = f"no TPU attached (platform={platform})"
         return row

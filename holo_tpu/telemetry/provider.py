@@ -29,6 +29,11 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
       gnmi-fanout/               # shared-delta fan-out engine (ISSUE 11):
         epoch, subscribers,      #   epoch id, cursor/bucket population,
         buckets, breaker, ...    #   breaker state + failure tally
+      observatory/               # dispatch observatory (ISSUE 12; while
+        sketches, observations,  #   armed): sketch population, sentinel
+        sentinel/...             #   ledger + regressed keys, peak source
+      relay/                     # TPU relay watch (ISSUE 12): last probe
+        status, probes, ...      #   verdict, tally, last error
 """
 
 from __future__ import annotations
@@ -133,6 +138,20 @@ class TelemetryStateProvider(NbProvider):
             rows = fan.engines_stats()
             if rows:
                 out["gnmi-fanout"] = rows[0] if len(rows) == 1 else rows
+        # Dispatch observatory (ISSUE 12): sketch population, sentinel
+        # ledger state, roofline peak source — present while armed.
+        obsm = sys.modules.get("holo_tpu.telemetry.observatory")
+        if obsm is not None:
+            ob = obsm.active()
+            if ob is not None:
+                out["observatory"] = ob.stats()
+        # TPU relay watch (ISSUE 12 satellite): probe verdicts become
+        # queryable state instead of a log file nobody reads in-process.
+        relm = sys.modules.get("holo_tpu.telemetry.relay")
+        if relm is not None:
+            rs = relm.stats()
+            if rs.get("probes") or rs.get("status") != "unknown":
+                out["relay"] = rs
         return {ROOT: out}
 
 

@@ -1,0 +1,92 @@
+"""First-class TPU-relay watch (ISSUE 12 satellite).
+
+The relay has been down since round 3 — RELAY_WATCH.log shows 45
+straight down-probes — yet the only in-process signal was per-stage
+``extra.relay`` strings hand-rolled across ``bench.py`` and
+``profiling.capture_device_trace``.  This module is the one place that
+state lives:
+
+- ``holo_relay_up`` gauge + ``holo_relay_probes_total{result}`` counter
+  (Prometheus + the gNMI metric leaves, like every other family);
+- a ``holo-telemetry/relay`` state leaf (:func:`stats`, served by
+  :class:`~holo_tpu.telemetry.provider.TelemetryStateProvider`) with
+  probe count / last error / last verdict;
+- the shared row helpers the bench stages previously hand-rolled:
+  :func:`summary` (the ``extra.relay`` dict) and :func:`not_used` (the
+  per-stage "this stage never touched the relay" marker).
+
+Probes themselves stay where they were (fresh-subprocess probes in
+``bench.py`` — wedging is per-process, so an in-process probe would be
+a lie); callers report verdicts here via :func:`note_probe`.  A daemon
+gets its own in-process verdict from the platform check inside
+``profiling.capture_device_trace`` (``[telemetry] device-trace-dir``);
+a daemon configured without it leaves the leaf absent rather than
+faking a probe it never ran.
+"""
+
+from __future__ import annotations
+
+from holo_tpu import telemetry
+
+_UP = telemetry.gauge(
+    "holo_relay_up",
+    "1 while the last TPU relay probe answered, 0 after a failed "
+    "probe, unset before the first verdict",
+)
+_PROBES = telemetry.counter(
+    "holo_relay_probes_total",
+    "TPU relay probe verdicts reported to the watch",
+    ("result",),
+)
+
+# Module-singleton state (GIL-atomic single-writer updates: the bench
+# driver / daemon probe loop is one thread).
+_state = {
+    "status": "unknown",  # unknown | up | down
+    "probes": 0,
+    "last_error": None,
+    "last_took_s": None,
+}
+
+
+def note_probe(ok: bool, error: str | None = None, took_s=None) -> None:
+    """Record one probe verdict (gauge + counter + leaf state)."""
+    _state["status"] = "up" if ok else "down"
+    _state["probes"] += 1
+    if error:
+        _state["last_error"] = str(error)[:300]
+    elif ok:
+        _state["last_error"] = None
+    if took_s is not None:
+        _state["last_took_s"] = round(float(took_s), 3)
+    _UP.set(1.0 if ok else 0.0)
+    _PROBES.labels(result="up" if ok else "down").inc()
+
+
+def status() -> dict:
+    """Current watch state (a copy)."""
+    return dict(_state)
+
+
+def stats() -> dict:
+    """holo-telemetry/relay gNMI leaf."""
+    return dict(_state)
+
+
+def summary(up: bool, history: list | None = None) -> dict:
+    """The bench's ``extra.relay`` row: overall verdict + probe tally +
+    the last probe error — one shape for every consumer (previously
+    hand-rolled per stage)."""
+    history = history or []
+    errors = [h.get("error") for h in history if h.get("error")]
+    return {
+        "status": "up" if up else "down",
+        "probes": len(history) or _state["probes"],
+        "last_error": errors[-1] if errors else _state["last_error"],
+    }
+
+
+def not_used(reason: str | None = None) -> str:
+    """The per-stage "this row never touched the relay" marker — the
+    one spelling every stage row and fallback-list entry shares."""
+    return f"not-used ({reason})" if reason else "not-used"

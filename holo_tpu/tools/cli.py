@@ -174,6 +174,42 @@ def cmd_conformance(args) -> int:
     return 1 if failed else 0
 
 
+def _print_table(headers, rows, top=None, indent="  ") -> None:
+    """The one fixed-width table renderer ``trace`` / ``postmortem`` /
+    ``explain`` share (previously two hand-rolled variants).  ``top``
+    truncates AFTER the caller's sort — cost-center ranking lives with
+    the data, not the renderer."""
+    if top is not None:
+        rows = rows[:top]
+    rows = [[str(c) for c in r] for r in rows]
+    widths = [len(h) for h in headers]
+    for r in rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    print(
+        (indent + "  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+        .rstrip()
+    )
+    for r in rows:
+        print(
+            (indent + "  ".join(c.ljust(w) for c, w in zip(r, widths)))
+            .rstrip()
+        )
+
+
+def _snapshot_cost_rows(snap: dict) -> list[tuple]:
+    """Metric-snapshot rows ranked as cost centers: histograms by total
+    seconds, scalars by value, descending."""
+    rows = []
+    for name, v in snap.items():
+        if isinstance(v, dict):
+            rows.append((name, v.get("count", 0), float(v.get("sum", 0.0))))
+        else:
+            rows.append((name, "", float(v)))
+    rows.sort(key=lambda r: (-r[2], r[0]))
+    return [(n, c, f"{s:g}") for n, c, s in rows]
+
+
 def cmd_trace(args) -> int:
     """Run a synthetic SPF + FRR workload with span tracing and dump the
     spans as Chrome trace-event JSON (load in chrome://tracing or
@@ -196,9 +232,179 @@ def cmd_trace(args) -> int:
     n = telemetry.tracer().dump(args.output)
     print(f"wrote {n} spans to {args.output}")
     snap = telemetry.snapshot(prefix="holo_spf")
-    for name in sorted(snap):
-        print(f"  {name} = {snap[name]}")
+    print(f"top {args.top} cost centers:")
+    _print_table(
+        ("metric", "count", "total"),
+        _snapshot_cost_rows(snap),
+        top=args.top,
+    )
     return 0
+
+
+def _explain_workload(k: int, batch: int, reps: int, seed: int) -> None:
+    """The explain CLI's seeded dispatch mix: repeated single-SPF runs
+    (the tuner's explore rounds), what-if batches, the multipath
+    k ∈ {1,2,4,8} sweep (the A-lane gather cost the ROADMAP carries),
+    and one FRR all-roots batch.  With the default ``reps`` the tuner
+    stays inside its deterministic explore phase, so a deterministic
+    stage timer makes the whole run byte-identical."""
+    from holo_tpu.frr.manager import FrrEngine
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth import (
+        fat_tree_topology,
+        whatif_link_failure_masks,
+    )
+
+    topo = fat_tree_topology(k=k, seed=seed)
+    masks = whatif_link_failure_masks(topo, batch, seed=seed + 1)
+    backend = TpuSpfBackend()
+    for _ in range(max(reps, 1)):
+        backend.compute(topo)
+    for _ in range(max(reps, 1)):
+        backend.compute_whatif(topo, masks)
+    for kk in (1, 2, 4, 8):
+        for _ in range(2):
+            backend.compute(topo, multipath_k=kk)
+    FrrEngine("tpu").compute(topo)
+
+
+def cmd_explain(args) -> int:
+    """Dispatch-observatory report (ISSUE 12): run a seeded workload —
+    the synthetic dispatch mix, or a full convergence storm with
+    ``--storm`` — with the observatory, deep profiling, and the engine
+    tuner armed, then render top-k cost centers with sketch-derived
+    p50/p99, per-(engine, shape-bucket) roofline attribution (achieved
+    FLOP/s, bytes/s, arithmetic intensity, memory-/compute-bound
+    verdict), the tuner's win/loss ledger, and the sentinel state.
+
+    Deterministic by default: the stage timer is a counter clock, so
+    two same-seed runs print byte-identical reports (walls become
+    timer-read counts — the classification and attribution signal is
+    real; pass ``--wall-clock`` for honest walls at the price of
+    run-to-run jitter)."""
+    from holo_tpu.pipeline import tuner as tuner_mod
+    from holo_tpu.telemetry import observatory, profiling
+
+    if not args.wall_clock:
+        profiling.set_stage_timer(observatory.DeterministicTimer())
+    profiling.set_device_profiling(True)
+    obs = observatory.configure(
+        check_every=16,
+        ledger_path=args.ledger,
+    )
+    tuner = tuner_mod.configure_engine_tuner()
+    try:
+        if args.storm:
+            from holo_tpu.spf.synth_storm import run_convergence_storm
+
+            run_convergence_storm(
+                n_routers=args.storm, events=args.events, seed=args.seed
+            )
+        else:
+            _explain_workload(args.k, args.batch, args.reps, args.seed)
+        # Close the run's sentinel window: seed/compare every key now
+        # (not just those that crossed a check_every boundary) and
+        # persist the --ledger baseline for the next invocation.
+        obs.checkpoint()
+        doc = obs.report(top=args.top)
+        doc["tuner"] = tuner.ledger()
+        if args.json:
+            print(json.dumps(doc, sort_keys=True, indent=2))
+            return 0
+        peaks = doc["peaks"]
+        print(
+            f"dispatch observatory — timing: {doc['timing']}, peaks: "
+            f"{peaks['source']} "
+            f"(ridge {peaks['ridge_flops_per_byte']:g} flop/B)"
+        )
+        print(f"top {args.top} cost centers:")
+        _print_table(
+            ("site/stage", "engine", "kind", "bucket", "n",
+             "total_s", "p50_ms", "p99_ms"),
+            [
+                (
+                    f"{r['site']}/{r['stage']}", r["engine"], r["kind"],
+                    json.dumps(r["bucket"], separators=(",", ":")),
+                    r["count"], f"{r['total_s']:g}",
+                    f"{r['p50_s'] * 1e3:.3f}", f"{r['p99_s'] * 1e3:.3f}",
+                )
+                for r in doc["cost_centers"]
+            ],
+        )
+        print("roofline (per engine × shape-bucket):")
+        _print_table(
+            ("site", "engine", "kind", "bucket", "AI", "verdict",
+             "flop/s", "B/s", "roofline", "p50_ms", "p99_ms"),
+            [
+                (
+                    r["site"], r["engine"], r["kind"],
+                    json.dumps(r["bucket"], separators=(",", ":")),
+                    (
+                        f"{r['ai_flops_per_byte']:g}"
+                        if r["ai_flops_per_byte"] is not None
+                        else "-"
+                    ),
+                    r["verdict"],
+                    (
+                        f"{r['achieved_flops_per_sec']:.3e}"
+                        if r.get("achieved_flops_per_sec")
+                        else "-"
+                    ),
+                    (
+                        f"{r['achieved_bytes_per_sec']:.3e}"
+                        if r.get("achieved_bytes_per_sec")
+                        else "-"
+                    ),
+                    (
+                        f"{r['roofline_fraction']:.2%}"
+                        if r.get("roofline_fraction") is not None
+                        else "-"
+                    ),
+                    (
+                        f"{r['device_p50_s'] * 1e3:.3f}"
+                        if r.get("device_p50_s") is not None
+                        else "-"
+                    ),
+                    (
+                        f"{r['device_p99_s'] * 1e3:.3f}"
+                        if r.get("device_p99_s") is not None
+                        else "-"
+                    ),
+                )
+                for r in doc["roofline"]
+            ],
+        )
+        print("engine tuner win/loss ledger:")
+        _print_table(
+            ("kind", "bucket", "winner", "dispatches", "measured", "basis"),
+            [
+                (
+                    t["kind"],
+                    json.dumps(t["bucket"], separators=(",", ":")),
+                    t["winner"], t["dispatches"],
+                    ",".join(
+                        f"{e}={v['median_ms']}ms"
+                        for e, v in t["engines"].items()
+                    ),
+                    t["basis"],
+                )
+                for t in doc["tuner"]
+            ],
+        )
+        s = doc["sentinel"]
+        print(
+            f"sentinel: {s['ledger-entries']} ledger entries, "
+            f"{s['seeded']} seeded, {s['ratcheted']} ratcheted, "
+            f"{s['flags']} flags"
+            + (f", regressed: {', '.join(s['regressed'])}"
+               if s["regressed"] else "")
+        )
+        return 0
+    finally:
+        observatory.configure(enabled=False)
+        profiling.set_device_profiling(False)
+        profiling.set_stage_timer(None)
+        tuner_mod.reset_engine_tuner()
 
 
 def cmd_import_yang(args) -> int:
@@ -339,13 +545,25 @@ def cmd_postmortem(args) -> int:
             print(f"  [{t:10.3f}] {kind:18s} {kv}")
     spans = [e for e in ring if e[0] == "span"]
     if spans:
-        print(f"last spans ({min(len(spans), args.spans)} of {len(spans)}):")
-        for _, name, sid, parent, start, dur, attrs in spans[-args.spans:]:
-            kv = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
-            print(
-                f"  #{sid:<4d} {name:24s} {dur / 1e3:9.3f}ms"
-                f"  parent={parent if parent is not None else '-':<4} {kv}"
+        if args.top:
+            # Cost-center view (shared with trace/explain): the
+            # heaviest spans in the whole ring, duration-descending.
+            picked = sorted(spans, key=lambda e: -e[5])[: args.top]
+            print(f"top {len(picked)} spans by duration (of {len(spans)}):")
+        else:
+            picked = spans[-args.spans:]
+            print(f"last spans ({len(picked)} of {len(spans)}):")
+        rows = [
+            (
+                f"#{sid}",
+                name,
+                f"{dur / 1e3:.3f}ms",
+                parent if parent is not None else "-",
+                " ".join(f"{k}={v}" for k, v in sorted(attrs.items())),
             )
+            for _, name, sid, parent, start, dur, attrs in picked
+        ]
+        _print_table(("span", "name", "wall", "parent", "attrs"), rows)
     health = bundle.get("health", {})
     for name, br in sorted(health.get("breakers", {}).items()):
         print(
@@ -508,7 +726,42 @@ def main(argv=None) -> int:
     s.add_argument("-o", "--output", default="holo_tpu_trace.json")
     s.add_argument("--rows", type=int, default=6, help="grid topology side")
     s.add_argument("--repeat", type=int, default=3, help="single-SPF runs")
+    s.add_argument(
+        "--top", type=int, default=12,
+        help="cost centers to print (metric rows, total-descending)",
+    )
     s.set_defaults(fn=cmd_trace)
+    s = sub.add_parser(
+        "explain",
+        help="dispatch-observatory report: top-k cost centers, roofline "
+             "attribution, tuner win/loss ledger over a seeded workload",
+    )
+    s.add_argument("--top", type=int, default=10, help="cost centers to show")
+    s.add_argument("--seed", type=int, default=7)
+    s.add_argument("--k", type=int, default=12, help="fat-tree arity")
+    s.add_argument("--batch", type=int, default=16, help="what-if batch size")
+    s.add_argument(
+        "--reps", type=int, default=8,
+        help="single-SPF / what-if repetitions (the default exactly "
+             "covers the tuner's deterministic explore phase)",
+    )
+    s.add_argument(
+        "--storm", type=int, default=0, metavar="ROUTERS",
+        help="run a seeded convergence storm of this many routers "
+             "instead of the synthetic dispatch mix",
+    )
+    s.add_argument("--events", type=int, default=60, help="storm events")
+    s.add_argument(
+        "--ledger",
+        help="sentinel baseline JSON (seed/flag/ratchet across runs)",
+    )
+    s.add_argument(
+        "--wall-clock", action="store_true",
+        help="measure real walls instead of the deterministic "
+             "byte-identical counter clock",
+    )
+    s.add_argument("--json", action="store_true", help="JSON report")
+    s.set_defaults(fn=cmd_explain)
     s = sub.add_parser(
         "import-yang",
         help="parse YANG text module(s) and dump their schema subtrees",
@@ -533,6 +786,12 @@ def main(argv=None) -> int:
     s.add_argument(
         "--spans", type=int, default=12,
         help="how many trailing spans to show (default 12)",
+    )
+    s.add_argument(
+        "--top", type=int, default=0, metavar="N",
+        help="show the N heaviest spans in the ring instead of the "
+             "trailing window (cost-center sorting, shared with "
+             "trace/explain)",
     )
     s.set_defaults(fn=cmd_postmortem)
     s = sub.add_parser(
