@@ -31,8 +31,16 @@ from holo_tpu.ops.spf_engine import (
     spf_whatif_batch,
     sssp_distances,
 )
+from holo_tpu.ops.tropical import (
+    TropicalTiles,
+    tropical_spf_one,
+    tropical_whatif_batch,
+)
 
 __all__ = [
+    "TropicalTiles",
+    "tropical_spf_one",
+    "tropical_whatif_batch",
     "INF",
     "EllGraph",
     "Topology",

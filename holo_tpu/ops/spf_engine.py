@@ -195,6 +195,15 @@ class _CacheEntry:
     graph: DeviceGraph
     mirror: _EllMirror
     depth: int = 0  # delta-chain length since the last full marshal
+    # Tropical tile attachment (ISSUE 13): the blocked min-plus planes
+    # marshaled alongside the ELL resident, lazily built on the first
+    # tropical dispatch and updated IN PLACE by lowered tile scatters
+    # when a delta is applied.  ``trop_meta`` is the host-side tile
+    # index (block size, grid) the lowering needs.  A delta the tiles
+    # cannot absorb drops only the attachment (rebuilt lazily from the
+    # post-delta mirror) — never the ELL resident.
+    tropical: object | None = None
+    trop_meta: dict | None = None
     # in_edge_id no longer matches the serving topology's edge list
     # (structural deltas shift edge indices): entries in this state can
     # serve mask-free SPF but not edge-mask consumers (what-if, FRR).
@@ -270,6 +279,28 @@ def _apply_delta_for(mesh) -> object:
             out_shardings=_pm.graph_sharding(mesh),
         )
         _APPLY_DELTA_SHARDED[key] = fn
+    return fn
+
+
+# Tile-attachment delta jits (ISSUE 13), donated like the slot apply;
+# one per mesh identity (replicated placement — see parallel/mesh.py).
+_APPLY_TILES: dict[tuple | None, object] = {}
+
+
+def _apply_tiles_for(mesh) -> object:
+    key = None
+    shard_kw = {}
+    if mesh is not None:
+        from holo_tpu.parallel import mesh as _pm
+
+        key = _pm.mesh_cache_key(mesh)
+        shard_kw = {"out_shardings": _pm.tile_sharding(mesh)}
+    fn = _APPLY_TILES.get(key)
+    if fn is None:
+        from holo_tpu.ops.tropical import apply_tile_delta
+
+        fn = jax.jit(apply_tile_delta, donate_argnums=(0,), **shard_kw)
+        _APPLY_TILES[key] = fn
     return fn
 
 
@@ -537,13 +568,38 @@ class DeviceGraphCache:
             # dropped and the caller re-marshals from scratch.
             _DELTA_TOTAL.labels(kind=kind, path=f"full-{exc.reason}").inc()
             return None
+        tile_ops = None
+        if base.tropical is not None:
+            # The tile attachment rides the chain: lower the same delta
+            # against the POST-delta mirror (updated by _lower_delta
+            # above).  An unappliable tile delta drops ONLY the
+            # attachment — rebuilt lazily from the mirror — never the
+            # ELL resident.
+            from holo_tpu.ops import tropical as _trop
+
+            try:
+                tile_ops = _trop.lower_tile_delta(
+                    base.mirror, delta, base.trop_meta
+                )
+            except _trop.TileDeltaUnappliable as exc:
+                base.tropical = None
+                base.trop_meta = None
+                _trop.note_tile_delta(f"drop-{exc.reason}")
         g = _apply_delta_for(base.mesh)(base.graph, *ops)
+        tt = None
+        if tile_ops is not None:
+            from holo_tpu.ops import tropical as _trop
+
+            tt = _apply_tiles_for(base.mesh)(base.tropical, *tile_ops)
+            _trop.note_tile_delta("apply")
         entry = _CacheEntry(
             graph=g,
             mirror=base.mirror,
             depth=base.depth + 1,
             ids_stale=base.ids_stale or not delta.ids_stable,
             mesh=base.mesh,
+            tropical=tt,
+            trop_meta=base.trop_meta if tt is not None else None,
         )
         with self._lock:
             self._cache[(*topo.cache_key, int(n_atoms), mkey)] = entry
@@ -551,6 +607,76 @@ class DeviceGraphCache:
             self._deltas_applied += 1
         _DELTA_TOTAL.labels(kind=kind, path="apply").inc()
         return g
+
+    def get_tropical(self, topo, n_atoms: int):
+        """The entry's tropical tile attachment, building (and placing)
+        it from the mirrored ELL state on first use.  Call inside the
+        same sanctioned marshal window as :meth:`get` — the device_put
+        here is part of that transfer.  The attachment tracks the entry
+        through DeltaPath updates (see ``_try_delta``), so a chain
+        marshals its tiles once, not once per delta."""
+        from holo_tpu.ops import tropical as _trop
+
+        _mesh, mkey = _process_mesh_state()
+        key = (*topo.cache_key, int(n_atoms), mkey)
+        snap = None
+        e_mesh = None
+        for _ in range(2):
+            with self._lock:
+                e = self._cache.get(key)
+                if e is not None:
+                    if e.tropical is not None:
+                        return e.tropical
+                    # Snapshot the mutable host mirror UNDER the lock:
+                    # _try_delta claims entries under this same lock
+                    # before mutating their mirror in place, so an
+                    # in-cache entry's mirror is only stable while we
+                    # hold it — an unlocked tile build from the live
+                    # mirror could tear against a concurrent delta.
+                    snap = (
+                        e.mirror.in_src.copy(),
+                        e.mirror.in_cost.copy(),
+                        e.mirror.in_valid.copy(),
+                    )
+                    e_mesh = e.mesh
+                    break
+            # Entry aged out between get() and here (or get() was never
+            # called): one re-prepare restores it.
+            self.get(topo, n_atoms)
+        if snap is None:
+            # Capacity pressure: the re-prepared entry was evicted by a
+            # concurrent insert before the locked read.  Serve a
+            # one-shot unattached tile build rather than raising — the
+            # dispatch stays correct, only the attachment reuse is
+            # lost for this call.
+            from holo_tpu.ops.graph import build_ell
+
+            ell = build_ell(topo, n_atoms=n_atoms)
+            tt_host, _ = _trop.build_tiles_host(
+                ell.in_src, ell.in_cost, ell.in_valid
+            )
+            if _mesh is not None:
+                from holo_tpu.parallel.mesh import shard_tiles
+
+                return shard_tiles(tt_host, _mesh)
+            return jax.device_put(tt_host)
+        tt_host, meta = _trop.build_tiles_host(*snap)
+        if e_mesh is not None:
+            from holo_tpu.parallel.mesh import shard_tiles
+
+            tt = shard_tiles(tt_host, e_mesh)
+        else:
+            tt = jax.device_put(tt_host)
+        with self._lock:
+            # Re-fetch by key: same key ⇒ same topology generation ⇒
+            # the snapshot content is valid for whatever entry serves
+            # the key now (a claimed-and-gone entry simply loses the
+            # attachment for this call).
+            e2 = self._cache.get(key)
+            if e2 is not None and e2.tropical is None:
+                e2.tropical = tt
+                e2.trop_meta = meta
+        return tt
 
     def _evict_locked(self) -> None:
         while len(self._cache) > self.capacity:
@@ -614,6 +740,9 @@ class DeviceGraphCache:
             "delta-entries": sum(1 for d in depths if d > 0),
             "max-chain-depth": max(depths, default=0),
             "stale-id-entries": sum(1 for e in entries if e.ids_stale),
+            "tropical-entries": sum(
+                1 for e in entries if e.tropical is not None
+            ),
             "occupancy": round(sum(occ) / len(occ), 4) if occ else 0.0,
             "sharded-entries": sharded,
             "mesh": (

@@ -1,0 +1,879 @@
+"""Tropical (min-plus) matmul SPF engine — the MXU-facing kernel (ISSUE 13).
+
+Every gather-path engine (seq/fused/packed/hybrid, and the widened "mp"
+program) relaxes distances through an [N, K] ELL gather per round —
+vector-unit pointer chasing the observatory (PR 12) classifies
+memory-bound on every bucket.  This module reformulates the relax step
+as **blocked min-plus matrix multiplication** over a tiled adjacency
+representation (the tropical-semiring algebraic-path framing of "The
+mdt algorithm", PAPERS.md):
+
+    dist_block = min(dist_block, min_plus_matmul(adj_tile, dist_block))
+
+- **Tiles** — the directed adjacency is blocked into [B, B] int32
+  weight tiles over a pow2 block size chosen per graph to minimize tile
+  work (``T * B^2`` plus a gather-bytes tax); only tiles containing at
+  least one edge are materialized, indexed by ``(tile_rb, tile_cb)``
+  plus a dense ``tile_id[NB, NB]`` lookup grid.  Entry ``(i, j)`` of a
+  tile holds the MINIMUM cost over parallel edges
+  ``(cb*B+j) -> (rb*B+i)`` and INF where no edge exists.
+- **Fixpoint** — each round gathers the source block of every active
+  tile once ([T, B, S] for S independent scenario/root lanes), performs
+  the dense broadcast-add + row-min contraction, and scatter-mins the
+  per-tile results into the destination blocks.  The scenario/root axis
+  rides the contraction as the dense right-hand operand, so tiles are
+  read once per round for the WHOLE batch — the data reuse the MXU /
+  contraction units are built for, where the gather engines re-issue
+  [N, K] index traffic per lane.
+- **Frontier masking** (Bounded Dijkstra radius cut, PAPERS.md) —
+  blocks whose vertices did not change last round contribute nothing
+  this round (their candidates were already folded in), per (block,
+  lane); with the global no-change exit this bounds rounds by the hop
+  diameter and keeps settled regions value-inert.
+- **Exact masks** — what-if edge masks cannot be applied to a collapsed
+  min-tile (removing the argmin of a min is not invertible), so masked
+  scenarios carry *repair rows*: the destination vertices of failed
+  edges, whose candidates are recomputed each round with an exact
+  masked [S, M, K] ELL row relaxation that REPLACES the tile
+  aggregate for those rows.  Failed edges only ever affect their own
+  destinations, so every other row's tile value is exact — parallel
+  links included.
+- **Tie-breaks** — distances are a unique fixpoint, so phase 2 (DAG,
+  first parent, hops, next-hop words) is the existing shared machinery
+  (:func:`~holo_tpu.ops.spf_engine._hops_nh_fixpoint` and friends):
+  bit-identical to the scalar oracle by construction.
+- **Multipath (the k>1 A-lane)** — the ledgered 11-12x gather-bytes
+  cost of the widened program (PR 12 k-sweep) is the per-round
+  [N, K, A] weight-lane gather.  Here the settled DAG is scattered ONCE
+  into count tiles and the saturated path-count / per-atom UCMP weight
+  fixpoints become dense integer contractions over the same tiles
+  (``einsum('tij,tja->tia')``) — contraction flops instead of gather
+  bytes, same clamped recursions, bit-identical planes.
+
+DeltaPath composes: the tiles are a cache attachment next to the ELL
+planes (:class:`~holo_tpu.ops.spf_engine.DeviceGraphCache`), updated in
+place by lowered tile scatters when a topology delta is applied, so
+resident chains never re-marshal the tile planes either.
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from holo_tpu import telemetry
+from holo_tpu.ops.graph import INF, MP_SAT
+from holo_tpu.ops.spf_engine import (
+    MultipathTensors,
+    SpfTensors,
+    _first_parent,
+    _hops_nh_fixpoint,
+    _mp_parent_sets,
+    _slot_atom_onehot,
+    _slot_mask,
+    _sp_dag,
+)
+
+_MARSHALS = telemetry.counter(
+    "holo_spf_tropical_marshal_total", "Tropical tile-plane marshals"
+)
+_MARSHAL_SECONDS = telemetry.histogram(
+    "holo_spf_tropical_marshal_seconds",
+    "Host-side mirror -> tile-plane marshal time",
+)
+_TILE_OCCUPANCY = telemetry.gauge(
+    "holo_spf_tropical_tile_occupancy",
+    "Real-edge fraction of materialized tile entries (last marshal)",
+)
+_TILE_DELTAS = telemetry.counter(
+    "holo_spf_tropical_delta_total",
+    "Tile-attachment delta dispositions (in-place scatter vs drop)",
+    ("path",),
+)
+
+
+def note_tile_delta(path: str) -> None:
+    """Count one tile-attachment delta disposition (shared with the
+    DeviceGraphCache's delta path)."""
+    _TILE_DELTAS.labels(path=path).inc()
+
+#: candidate pow2 block sizes the marshal scores (see _pick_block)
+_BLOCKS = (8, 16, 32, 64, 128)
+
+#: lane-chunk width of the batched kernels: bounds the [T, B, S] source
+#: gather (the per-round working set) while keeping enough lanes for
+#: the contraction to amortize each tile read across the batch
+LANE_CHUNK = 128
+
+
+class TropicalTiles(NamedTuple):
+    """Blocked min-plus adjacency planes (pure-array pytree), grouped
+    by DESTINATION row block.
+
+    ``tiles[rb, t][i, j]`` = min cost over edges
+    ``cb[rb, t]*B + j -> rb*B + i`` (INF where no edge); slot axis
+    ``t < Tm`` padded with all-INF tiles whose ``cb`` is the sentinel
+    ``NB`` (gathering the appended INF block).  ``pos[rb, c]`` recovers
+    the slot of block pair ``(rb, c)`` (``Tm`` = no tile, a drop
+    sentinel for device-side scatters).  The row-block grouping is the
+    point: each fixpoint round REDUCES over the slot axis instead of
+    scatter-combining per-tile results — broadcast-add + multi-axis
+    min, one fused dense contraction, no scatter on the hot path.
+    Vertices pad to ``NB * B``; padded rows/columns are all-INF inert.
+    """
+
+    tiles: jax.Array  # int32[NB, Tm, B, B]
+    cb: jax.Array  # int32[NB, Tm]; NB = pad sentinel
+    pos: jax.Array  # int32[NB, NB]; value Tm = no tile
+
+
+class TileDeltaUnappliable(Exception):
+    """A topology delta the tile attachment cannot absorb in place
+    (an added edge lands in a block pair with no materialized tile).
+    The attachment is dropped and lazily rebuilt from the mirror; the
+    ELL resident itself keeps serving."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _pick_block(n: int, rows: np.ndarray, srcs: np.ndarray) -> int:
+    """The pow2 tile block size for this graph: smallest total tile
+    work over the PADDED slot axis (each row block carries the worst
+    row block's tile count).  Score = ``NB * Tm * B^2`` (the dense
+    contraction entries streamed per round) plus ``8 * NB * Tm * B``
+    (the per-slot source-block gather tax, which punishes tiny
+    blocks).  Tiny graphs collapse onto one block so every shape
+    bucket stays static."""
+    cap = 8
+    while cap < min(n, _BLOCKS[-1]):
+        cap *= 2
+    best_b, best_score = cap, None
+    for b in _BLOCKS:
+        if b > cap:
+            break
+        nb = -(-n // b)
+        pair = np.unique((rows // b).astype(np.int64) * nb + srcs // b)
+        tm = (
+            int(np.bincount(pair // nb, minlength=nb).max())
+            if pair.size
+            else 1
+        )
+        # Padded contraction entries dominate the measured round cost
+        # (sparse graphs: fill-in grows with the block, so smaller
+        # blocks usually win); the + term is a slot-gather tax that
+        # only breaks ties against degenerate tiny blocks.
+        score = nb * tm * b * b + 8 * nb * tm * b
+        if best_score is None or score < best_score:
+            best_b, best_score = b, score
+    return best_b
+
+
+def build_tiles_host(
+    in_src: np.ndarray,
+    in_cost: np.ndarray,
+    in_valid: np.ndarray,
+    block: int | None = None,
+) -> tuple[TropicalTiles, dict]:
+    """Marshal ELL slot planes (numpy, host side) into tile planes.
+
+    Returns ``(tiles-as-numpy, meta)`` — the caller device_puts the
+    pytree; ``meta`` (``block``, ``nb``, ``tm``, ``pos`` grid) stays
+    host-side for delta lowering and rebuilds.  Parallel edges collapse
+    onto their min cost (exact for distance relaxation; masks repair
+    through the ELL rows, see module docstring)."""
+    t0 = time.perf_counter()
+    n = int(in_src.shape[0])
+    rows, cols = np.nonzero(in_valid)
+    srcs = in_src[rows, cols].astype(np.int64)
+    costs = in_cost[rows, cols]
+    b = int(block) if block is not None else _pick_block(n, rows, srcs)
+    nb = max(-(-n // b), 1)
+    if rows.size:
+        pair = np.unique((rows // b).astype(np.int64) * nb + srcs // b)
+        prb = (pair // nb).astype(np.int64)
+        pcb = (pair % nb).astype(np.int64)
+        counts = np.bincount(prb, minlength=nb)
+        tm = max(int(counts.max()), 1)
+        # Slot of each (rb, cb) pair: its rank within its row block
+        # (pairs are lex-sorted, so ranks follow ascending cb).
+        first = np.searchsorted(prb, prb, side="left")
+        slot = np.arange(pair.size, dtype=np.int64) - first
+        pos = np.full((nb, nb), tm, np.int32)
+        pos[prb, pcb] = slot
+        cb = np.full((nb, tm), nb, np.int32)
+        cb[prb, slot] = pcb
+        tiles = np.full((nb, tm, b, b), INF, np.int32)
+        np.minimum.at(
+            tiles,
+            (rows // b, pos[rows // b, srcs // b], rows % b, srcs % b),
+            costs,
+        )
+        n_pairs = int(pair.size)
+    else:
+        # Edgeless graph: one inert all-INF slot per row block keeps
+        # every shape static and every scatter well-formed.
+        tm = 1
+        pos = np.full((nb, nb), 1, np.int32)
+        cb = np.full((nb, 1), nb, np.int32)
+        tiles = np.full((nb, 1, b, b), INF, np.int32)
+        n_pairs = 0
+    tt = TropicalTiles(tiles=tiles, cb=cb, pos=pos)
+    meta = {
+        "block": b, "nb": nb, "tm": tm, "pos": pos.copy(), "n": n,
+        "pairs": n_pairs,
+    }
+    _MARSHALS.inc()
+    _MARSHAL_SECONDS.observe(time.perf_counter() - t0)
+    # O(1) from already-known counts — no array reduction on this path.
+    occupancy = rows.size / tiles.size if tiles.size else 0.0
+    _TILE_OCCUPANCY.set(occupancy)
+    return tt, meta
+
+
+def lower_tile_delta(mirror, delta, meta):
+    """Lower a TopologyDelta into padded tile-scatter arrays against the
+    POST-delta mirror (call after ``_lower_delta`` updated it).
+
+    Every touched ``(src, dst)`` pair scatters its final min-over-
+    parallel-edges cost (INF when none survive); overloaded vertices
+    become a column strike.  Raises :class:`TileDeltaUnappliable` when
+    an addition lands outside the materialized tile set."""
+    from holo_tpu.ops.spf_engine import _pad_pow2
+
+    b, nb, tm, grid = meta["block"], meta["nb"], meta["tm"], meta["pos"]
+    pairs = set()
+    for s, d in zip(delta.r_src, delta.r_dst):
+        pairs.add((int(s), int(d)))
+    for s, d in zip(delta.w_src, delta.w_dst):
+        pairs.add((int(s), int(d)))
+    for s, d in zip(delta.a_src, delta.a_dst):
+        pairs.add((int(s), int(d)))
+    ops = []
+    for u, v in sorted(pairs):
+        slot = int(grid[v // b, u // b])
+        if slot >= tm:
+            # No tile holds this block pair.  Removals/re-costs of an
+            # existing edge always have one; only additions can miss.
+            raise TileDeltaUnappliable("tile-missing")
+        m = mirror.in_valid[v] & (mirror.in_src[v] == u)
+        val = int(mirror.in_cost[v][m].min()) if m.any() else int(INF)
+        ops.append((v // b, slot, v % b, u % b, val))
+    npad = nb * b
+    strike = np.zeros(npad, bool)
+    if delta.overload.shape[0]:
+        strike[delta.overload] = True
+    pad = _pad_pow2(len(ops))
+    trb = np.full(pad, nb, np.int32)  # OOB row block: dropped
+    tsl = np.zeros(pad, np.int32)
+    ti = np.zeros(pad, np.int32)
+    tj = np.zeros(pad, np.int32)
+    val = np.zeros(pad, np.int32)
+    for i, (r_, s_, i_, j_, v_) in enumerate(ops):
+        trb[i], tsl[i], ti[i], tj[i], val[i] = r_, s_, i_, j_, v_
+    return trb, tsl, ti, tj, val, strike
+
+
+def apply_tile_delta(tt: TropicalTiles, trb, tsl, ti, tj, val, strike):
+    """Scatter a lowered tile delta into the resident planes (jitted by
+    the cache with the tiles DONATED — the in-place DeltaPath update).
+    Strike first: explicit ops carry the final mirror state, which
+    already accounts for struck slots."""
+    nb, tm, b, _ = tt.tiles.shape
+    # Column-vertex index per slot; sentinel slots (cb == NB) clamp to
+    # a real block — they are all-INF already, so the where is inert.
+    colv = (
+        jnp.minimum(tt.cb, nb - 1)[:, :, None] * b
+        + jnp.arange(b, dtype=jnp.int32)[None, None, :]
+    )  # [NB, Tm, B]
+    tiles = jnp.where(
+        strike[colv][:, :, None, :], jnp.int32(INF), tt.tiles
+    )
+    tiles = tiles.at[trb, tsl, ti, tj].set(val, mode="drop")
+    return tt._replace(tiles=tiles)
+
+
+def repair_rows_host(edge_dst, masks, sentinel: int) -> np.ndarray:
+    """int32[S, M]: per scenario, the unique destination vertices of
+    masked-out edges, padded with ``sentinel`` (>= the padded row
+    count, so device scatters drop them).  M is the pow2 hull of the
+    worst scenario (0 when nothing fails anywhere)."""
+    masks = np.asarray(masks, bool)
+    dst = np.asarray(edge_dst, np.int32)
+    per = [np.unique(dst[~m]) for m in masks]
+    worst = max((r.shape[0] for r in per), default=0)
+    if worst == 0:
+        return np.zeros((masks.shape[0], 0), np.int32)
+    m = 8
+    while m < worst:
+        m *= 2
+    out = np.full((masks.shape[0], m), sentinel, np.int32)
+    for i, r in enumerate(per):
+        out[i, : r.shape[0]] = r
+    return out
+
+
+# -- the fixpoint kernel -------------------------------------------------
+
+
+def _pad_rows_to(x, target: int, fill):
+    rows = x.shape[0]
+    if target == rows:
+        return x
+    if target < rows:
+        return x[:target]
+    pad = jnp.full((target - rows,) + x.shape[1:], fill, x.dtype)
+    return jnp.concatenate([x, pad], axis=0)
+
+
+def _tile_relax(g, tt: TropicalTiles, dist0, masks, repair_rows, limit):
+    """The blocked min-plus fixpoint over S independent lanes.
+
+    ``dist0`` int32[L, S] (L = the graph's padded row count);
+    ``repair_rows`` int32[S, M] + ``masks`` bool[S, E] arm the exact
+    masked-row repair (pass None/None for unmasked lanes).  Returns the
+    settled int32[L, S] distances — the unique shortest-path fixpoint,
+    bit-identical to the gather engines' relaxation."""
+    ell, s = dist0.shape
+    nb, tm, b, _ = tt.tiles.shape
+    npad = nb * b
+    inf = jnp.int32(INF)
+    m = 0 if repair_rows is None else repair_rows.shape[1]
+    if m:
+        k = g.in_src.shape[1]
+        fr_safe = jnp.minimum(repair_rows, ell - 1)  # [S, M]
+        r_nbr = g.in_src[fr_safe]  # [S, M, K]
+        r_cost = g.in_cost[fr_safe]
+        r_ok = g.in_valid[fr_safe]
+        if masks is not None and masks.shape[1] > 0:
+            ids = g.in_edge_id[fr_safe]
+            r_ok = r_ok & jnp.take_along_axis(
+                masks, ids.reshape(s, m * k), axis=1
+            ).reshape(s, m, k)
+        # Sentinel rows (>= the graph's row count) must DROP on the
+        # repair scatter even when the tile vertex space pads further.
+        r_idx = jnp.where(repair_rows >= ell, npad, repair_rows)
+
+    # The loop carries the TILE-padded [npad, S] state: every in-loop
+    # reshape is then exactly block-divisible (no per-round pad/slice —
+    # which also keeps GSPMD from folding a consumer's row sharding
+    # into the carry), and pad rows have no tile edges so they relax to
+    # nothing and slice off after the loop.
+    #
+    # Saturating uint32 arithmetic replaces an INF-validity mask: every
+    # operand is <= INF = 2^30, so sums fit uint32 exactly, and a
+    # candidate with an INF operand lands >= INF — clamping it back to
+    # INF is exact because dist is always <= INF (min against the INF
+    # seed), so such a candidate can only ever TIE the sentinel, never
+    # displace a value.  That drops the [NB, Tm, B, B, S] boolean mask
+    # and select from the round entirely.
+    tiles_u = tt.tiles.astype(jnp.uint32)  # hoisted: loop-invariant
+    uinf = jnp.uint32(INF)
+
+    def cond(carry):
+        _, _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        dist, active, _, it = carry  # dist int32[npad, S]
+        db = dist.reshape(nb, b, s)
+        # Source blocks per row-block slot, sentinel slots (cb == NB)
+        # gathering the appended INF block; frontier-inactive source
+        # blocks masked to INF — a block unchanged last round already
+        # contributed everything it can (monotone relaxation).
+        db_ext = jnp.concatenate(
+            [db, jnp.full((1, b, s), inf, jnp.int32)]
+        )
+        act_ext = jnp.concatenate(
+            [active, jnp.zeros((1, s), bool)]
+        )
+        srcb = jnp.where(
+            act_ext[tt.cb][:, :, :, None],
+            db_ext[tt.cb].transpose(0, 1, 3, 2),
+            inf,
+        ).astype(jnp.uint32)  # [NB, Tm, S, B(j)] — the slot gather
+        # min-plus contraction: reduce the source axis j (kept
+        # MINOR-most so the reduction runs over contiguous rows of
+        # both operands) and the row-block slot axis in one fused
+        # multi-axis min — no scatter on the hot path.
+        cand = (
+            tiles_u[:, :, :, None, :] + srcb[:, :, None, :, :]
+        ).min(axis=(1, 4))  # [NB, B, S]
+        agg = jnp.minimum(cand, uinf).astype(jnp.int32).reshape(npad, s)
+        if m:
+            # Exact masked recompute for failed-edge destinations: the
+            # tile value may undercut the masked truth there, so the
+            # ELL row relax REPLACES (never mins with) the aggregate.
+            dn = jnp.take_along_axis(
+                dist.T, r_nbr.reshape(s, m * k), axis=1
+            ).reshape(s, m, k)
+            okr = r_ok & (dn < inf)
+            cr = jnp.where(okr, dn + r_cost, inf).min(axis=2)  # [S, M]
+            agg = jax.vmap(
+                lambda row, idx, v: row.at[idx].set(v, mode="drop")
+            )(agg.T, r_idx, cr).T
+        new = jnp.minimum(dist, agg)
+        ch = new != dist
+        act = ch.reshape(nb, b, s).any(axis=1)
+        return new, act, jnp.any(ch), it + 1
+
+    act0 = jnp.ones((nb, s), bool)
+    dist, _, _, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            _constrain_replicated(_pad_rows_to(dist0, npad, inf)),
+            act0,
+            jnp.bool_(True),
+            0,
+        ),
+    )
+    return _constrain_replicated(_pad_rows_to(dist, ell, inf))
+
+
+def _constrain_replicated(x):
+    """Pin a tile-fixpoint carry/result REPLICATED under a live process
+    mesh — the sharding firewall on BOTH sides of the loop.
+
+    The tile loop's carries must stay replicated (tiles are replicated
+    and the scatter-min/reshape pair has no legal row-sharded form);
+    without these boundaries GSPMD propagates a row sharding — from a
+    seed derived off the row-sharded graph planes, or backward from
+    phase 2's ``dist[g.in_src]`` gathers — into the while_loop and
+    (observed on the forced multi-device CPU platform) miscompiles the
+    carry into garbage.  With the constraints the loop computes
+    replicated and consumers reshard after it.  Trace-time mesh read:
+    the backend's jit caches re-trace when placements change, and the
+    degenerate/no-mesh paths skip the constraint."""
+    from holo_tpu.parallel import mesh as _pm
+
+    m = _pm.process_mesh()
+    if m is None or m.size == 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(m, PartitionSpec())
+    )
+
+
+def _count_tiles(g, tt: TropicalTiles, slot_flag):
+    """Scatter a boolean ELL slot plane into int32 count tiles: entry
+    (rb, slot, v%B, src%B) = how many flagged slots connect that pair
+    (the coefficient matrix of the DAG-linear multipath fixpoints).
+    Slots outside the tile set (never: flagged slots are valid edges)
+    and padded rows drop via the pos-grid sentinel."""
+    n, _ = g.in_src.shape
+    nb, tm, b, _ = tt.tiles.shape
+    v = jnp.arange(n, dtype=jnp.int32)[:, None]
+    # Replicated operands for the count scatter: a row-sharded in_src /
+    # slot flag would run the scatter-add per shard (the same sharding
+    # hazard _constrain_replicated fences in the relax loop).
+    src = _constrain_replicated(g.in_src)
+    flag = _constrain_replicated(slot_flag)
+    vb = jnp.minimum(v // b, nb - 1)
+    sb = jnp.minimum(src // b, nb - 1)
+    slot = jnp.where(flag, tt.pos[vb, sb], tm)  # Tm = dropped
+    return _constrain_replicated(
+        jnp.zeros((nb, tm, b, b), jnp.int32)
+        .at[vb, slot, v % b, src % b]
+        .add(jnp.where(flag, 1, 0), mode="drop")
+    )
+
+
+def _np_tile_fixpoint(g, tt, dag, root, np0, limit):
+    """Saturated shortest-path counts as a dense DAG-tile contraction:
+    ``npaths[v] = min(sum over DAG slots of npaths[src], MP_SAT)`` —
+    the same clamped recursion as the mp gather kernel, one
+    ``einsum('tij,tj->ti')`` per round instead of an [N, K] gather.
+    Unique fixpoint over the acyclic DAG: any seed converges."""
+    n = g.in_src.shape[0]
+    nb, tm, b, _ = tt.tiles.shape
+    npad = nb * b
+    sat = jnp.int32(MP_SAT)
+    is_root = jnp.arange(n) == root
+    dagc = _count_tiles(g, tt, dag)
+    cb_safe = jnp.minimum(tt.cb, nb - 1)  # sentinel blocks: dagc is 0
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        np_, _, it = carry
+        blk = _pad_rows_to(np_, npad, jnp.int32(0)).reshape(nb, b)
+        # Row-block combine IS the contraction's slot axis — no
+        # scatter: sum over (slot, j) of count * npaths[src].
+        tot = jnp.einsum(
+            "rtij,rtj->ri", dagc, blk[cb_safe],
+            preferred_element_type=jnp.int32,
+        )
+        tot = _pad_rows_to(tot.reshape(npad), n, jnp.int32(0))
+        new = jnp.where(is_root, 1, jnp.minimum(tot, sat)).astype(jnp.int32)
+        return new, jnp.any(new != np_), it + 1
+
+    np_, _, _ = jax.lax.while_loop(
+        cond, body, (_constrain_replicated(np0), jnp.bool_(True), 0)
+    )
+    return _constrain_replicated(np_)
+
+
+def _aw_tile_fixpoint(g, tt, dag, hops, npaths, aw0, limit):
+    """Per-atom UCMP weights as the dense [T,B,B]x[B,A] contraction —
+    the k>1 A-lane's gather bytes (11-12x k=1, the PR-12 ledger number)
+    moved onto contraction flops.  With hops and npaths settled, the
+    direct-atom seed is fixed (computed once) and the inherit half is a
+    linear fixpoint over the inherit-slot count tiles; clamping matches
+    the mp kernel's ``min(sum, MP_SAT)`` bit-for-bit."""
+    n = g.in_src.shape[0]
+    nb, tm, b, _ = tt.tiles.shape
+    npad = nb * b
+    sat = jnp.int32(MP_SAT)
+    h_nbr = hops[g.in_src]  # one [N, K] gather, once (not per round)
+    np_nbr = npaths[g.in_src]
+    direct = dag & (h_nbr == 0)
+    inherit = dag & (h_nbr != 0)
+    onehot = _slot_atom_onehot(g)  # int32[N, K, A]
+    seed = _constrain_replicated(
+        (onehot * jnp.where(direct, np_nbr, 0)[:, :, None]).sum(axis=1)
+    )
+    inhc = _count_tiles(g, tt, inherit)
+    cb_safe = jnp.minimum(tt.cb, nb - 1)  # sentinel blocks: inhc is 0
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        aw, _, it = carry
+        a = aw.shape[1]
+        blk = _pad_rows_to(aw, npad, jnp.int32(0)).reshape(nb, b, a)
+        # THE dense [NB,Tm,B,B]x[NB,Tm,B,A] contraction: the k>1
+        # A-lane's per-round gather bytes as contraction flops.
+        inh = jnp.einsum(
+            "rtij,rtja->ria", inhc, blk[cb_safe],
+            preferred_element_type=jnp.int32,
+        )
+        inh = _pad_rows_to(inh.reshape(npad, a), n, jnp.int32(0))
+        new = jnp.minimum(seed + inh, sat).astype(jnp.int32)
+        return new, jnp.any(new != aw), it + 1
+
+    aw, _, _ = jax.lax.while_loop(
+        cond, body, (_constrain_replicated(aw0), jnp.bool_(True), 0)
+    )
+    return _constrain_replicated(aw)
+
+
+# -- full SPF programs ---------------------------------------------------
+
+
+def _phase2(g, root, dist, ok, limit, hops0=None, nh0=None):
+    """The shared SPF tail after the distance fixpoint: DAG, first
+    parent, hops/next-hop reconvergence, tensor assembly — ONE copy so
+    the parity-critical tie-break and assembly logic cannot drift
+    between dispatch kinds.  ``hops0``/``nh0`` seed the fixpoint
+    (incremental callers pass the previous run's planes; fresh callers
+    omit them for the root seed — either converges bit-exactly, the
+    fixpoint is unique over the acyclic DAG).  Returns
+    ``(SpfTensors, dag, raw_hops)``; ``raw_hops`` is the unmasked
+    fixpoint value the multipath weight contraction consumes."""
+    n, _ = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    big = jnp.int32(n + 1)
+    dag = _sp_dag(g, dist, ok, root)
+    parent = _first_parent(g, dag, dist[g.in_src])
+    if hops0 is None:
+        hops0 = jnp.where(jnp.arange(n) == root, 0, big).astype(jnp.int32)
+    if nh0 is None:
+        nh0 = jnp.zeros((n, w), jnp.int32)
+    hops, nh = _hops_nh_fixpoint(g, root, dag, parent, hops0, nh0, limit)
+    sp = SpfTensors(
+        dist=dist,
+        parent=parent,
+        hops=jnp.where(dist < INF, hops, big),
+        nexthops=jax.lax.bitcast_convert_type(nh, jnp.uint32),
+    )
+    return sp, dag, hops
+
+
+def tropical_spf_one(
+    g,
+    tt: TropicalTiles,
+    root,
+    edge_mask=None,
+    repair_rows=None,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """Full SPF with the dist phase on the tile planes and the shared
+    hops/next-hop phase 2 — bit-identical to :func:`spf_one` (the
+    engines' parity contract).  A non-trivial ``edge_mask`` REQUIRES
+    ``repair_rows`` covering every failed edge's destination
+    (:func:`repair_rows_host`); the backend guarantees this."""
+    n, _ = g.in_src.shape
+    limit = n if max_iters is None else max_iters
+    dist0 = jnp.full((n,), INF, jnp.int32).at[root].set(0)
+    masks = None
+    rr = None
+    if repair_rows is not None and repair_rows.shape[0] > 0:
+        rr = repair_rows[None, :]
+        if edge_mask is not None and edge_mask.shape[0] > 0:
+            masks = edge_mask[None, :]
+    dist = _tile_relax(g, tt, dist0[:, None], masks, rr, limit)[:, 0]
+    sp, _, _ = _phase2(g, root, dist, _slot_mask(g, edge_mask), limit)
+    return sp
+
+
+def _whatif_chunk(g, tt, root, masks, repair_rows, limit):
+    n, _ = g.in_src.shape
+    s = masks.shape[0]
+    dist0 = jnp.full((n, s), INF, jnp.int32).at[root, :].set(0)
+    rr = repair_rows if repair_rows.shape[1] > 0 else None
+    mk = masks if (rr is not None and masks.shape[1] > 0) else None
+    dist = _tile_relax(g, tt, dist0, mk, rr, limit)  # [n, S]
+
+    def rest(dist_s, mask_s):
+        return _phase2(g, root, dist_s, _slot_mask(g, mask_s), limit)[0]
+
+    return jax.vmap(rest)(dist.T, masks)
+
+
+def tropical_whatif_batch(
+    g,
+    tt: TropicalTiles,
+    root,
+    edge_masks,
+    repair_rows,
+    max_iters: int | None = None,
+    chunk: int = LANE_CHUNK,
+) -> SpfTensors:
+    """Batched what-if SPF on the tile planes: the scenario axis is the
+    dense right-hand operand of the min-plus contraction (tiles read
+    once per round for a whole lane chunk).  Chunks run sequentially
+    (``lax.map``) so the [T, B, S] working set stays bounded."""
+    s = edge_masks.shape[0]
+    n, _ = g.in_src.shape
+    e = edge_masks.shape[1]
+    m = repair_rows.shape[1]
+    limit = n if max_iters is None else max_iters
+    if s <= chunk:
+        return _whatif_chunk(g, tt, root, edge_masks, repair_rows, limit)
+    pad = (-s) % chunk
+    if pad:
+        edge_masks = jnp.concatenate(
+            [edge_masks, jnp.ones((pad, e), bool)]
+        )
+        repair_rows = jnp.concatenate(
+            [repair_rows, jnp.full((pad, m), n, jnp.int32)]
+        )
+    nc = (s + pad) // chunk
+    out = jax.lax.map(
+        lambda ab: _whatif_chunk(g, tt, root, ab[0], ab[1], limit),
+        (
+            edge_masks.reshape(nc, chunk, e),
+            repair_rows.reshape(nc, chunk, m),
+        ),
+    )
+    return jax.tree.map(
+        lambda x: x.reshape((nc * chunk,) + x.shape[2:])[:s], out
+    )
+
+
+def tropical_multiroot(
+    g,
+    tt: TropicalTiles,
+    roots,
+    edge_mask=None,
+    repair_rows=None,
+    max_iters: int | None = None,
+    chunk: int = LANE_CHUNK,
+) -> SpfTensors:
+    """SPF from many roots: the root axis rides the contraction lanes
+    (each lane a different seed), then the shared per-root phase 2.
+
+    The ONE ``edge_mask`` is shared by every root lane, so a
+    non-trivial mask REQUIRES ``repair_rows`` (int32[M], the masked
+    edges' destinations from :func:`repair_rows_host`) exactly like
+    :func:`tropical_spf_one` — the mask/rows broadcast across the
+    lanes and the exact masked-row repair rides every round."""
+    n, _ = g.in_src.shape
+    r = roots.shape[0]
+    limit = n if max_iters is None else max_iters
+    rr1 = None
+    mk1 = None
+    if repair_rows is not None and repair_rows.shape[0] > 0:
+        rr1 = repair_rows
+        if edge_mask is not None and edge_mask.shape[0] > 0:
+            mk1 = edge_mask
+
+    def run_chunk(rts):
+        s = rts.shape[0]
+        dist0 = (
+            jnp.full((n, s), INF, jnp.int32)
+            .at[rts, jnp.arange(s)]
+            .set(0)
+        )
+        rr = (
+            None
+            if rr1 is None
+            else jnp.broadcast_to(rr1[None, :], (s, rr1.shape[0]))
+        )
+        mk = (
+            None
+            if mk1 is None
+            else jnp.broadcast_to(mk1[None, :], (s, mk1.shape[0]))
+        )
+        dist = _tile_relax(g, tt, dist0, mk, rr, limit)
+
+        def rest(dist_s, rt):
+            return _phase2(g, rt, dist_s, _slot_mask(g, edge_mask), limit)[0]
+
+        return jax.vmap(rest)(dist.T, rts)
+
+    if r <= chunk:
+        return run_chunk(roots)
+    pad = (-r) % chunk
+    rts = roots if not pad else jnp.concatenate(
+        [roots, jnp.zeros(pad, jnp.int32)]
+    )
+    nc = (r + pad) // chunk
+    out = jax.lax.map(run_chunk, rts.reshape(nc, chunk))
+    return jax.tree.map(
+        lambda x: x.reshape((nc * chunk,) + x.shape[2:])[:r], out
+    )
+
+
+def tropical_spf_one_multipath(
+    g,
+    tt: TropicalTiles,
+    root,
+    kp: int,
+    edge_mask=None,
+    repair_rows=None,
+    max_iters: int | None = None,
+) -> tuple[SpfTensors, MultipathTensors]:
+    """The widened multipath program on the tiles (the k>1 A-lane
+    consumer): dist via the min-plus fixpoint, hops/next-hop via the
+    shared packed phase 2, then the path-count and UCMP weight planes
+    via dense DAG-tile contractions.  Bit-identical to
+    :func:`spf_one_multipath` (every fixpoint is the same clamped
+    recursion with a unique solution over the settled acyclic DAG)."""
+    n, _ = g.in_src.shape
+    w = g.direct_nh_words.shape[2]
+    limit = n if max_iters is None else max_iters
+    dist0 = jnp.full((n,), INF, jnp.int32).at[root].set(0)
+    masks = None
+    rr = None
+    if repair_rows is not None and repair_rows.shape[0] > 0:
+        rr = repair_rows[None, :]
+        if edge_mask is not None and edge_mask.shape[0] > 0:
+            masks = edge_mask[None, :]
+    dist = _tile_relax(g, tt, dist0[:, None], masks, rr, limit)[:, 0]
+    ok = _slot_mask(g, edge_mask)
+    sp, dag, hops = _phase2(g, root, dist, ok, limit)
+    np0 = jnp.where(jnp.arange(n) == root, 1, 0).astype(jnp.int32)
+    npaths = _np_tile_fixpoint(g, tt, dag, root, np0, limit)
+    aw0 = jnp.zeros((n, w * 32), jnp.int32)
+    aw = _aw_tile_fixpoint(g, tt, dag, hops, npaths, aw0, limit)
+    parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
+    mp = MultipathTensors(
+        parents=parents,
+        pdist=pdist,
+        pweight=pweight,
+        npaths=jnp.where(dist < INF, npaths, 0),
+        nh_weights=aw,
+    )
+    return sp, mp
+
+
+def _affected(g, prev_parent, seed_rows, limit):
+    """bool[N]: the seed rows plus their previous-SPT descendants (the
+    DeltaPath invalidation region — same loop as the gather engines)."""
+    n = g.in_src.shape[0]
+    has_par = prev_parent < n
+    par_safe = jnp.where(has_par, prev_parent, 0)
+    aff0 = jnp.zeros((n,), bool).at[seed_rows].set(True, mode="drop")
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < limit)
+
+    def body(carry):
+        aff, _, it = carry
+        new = aff | jnp.where(has_par, aff[par_safe], False)
+        return new, jnp.any(new != aff), it + 1
+
+    aff, _, _ = jax.lax.while_loop(cond, body, (aff0, jnp.bool_(True), 0))
+    return aff
+
+
+def tropical_spf_one_incremental(
+    g,
+    tt: TropicalTiles,
+    root,
+    prev: SpfTensors,
+    seed_rows,
+    max_iters: int | None = None,
+) -> SpfTensors:
+    """DeltaPath incremental SPF on the tiles: invalidate the previous
+    SPT descendants of the seed rows, re-relax seeded from the
+    surviving upper bounds (rounds ~ affected-region radius — the
+    frontier mask keeps settled blocks inert), then the shared phase-2
+    recompute seeded from the previous tensors.  Bit-identical to
+    ``tropical_spf_one(g, tt, root)`` by fixpoint uniqueness."""
+    n, _ = g.in_src.shape
+    limit = n if max_iters is None else max_iters
+    aff = _affected(g, prev.parent, seed_rows, limit)
+    dist0 = jnp.where(aff, INF, prev.dist).at[root].set(0)
+    dist = _tile_relax(g, tt, dist0[:, None], None, None, limit)[:, 0]
+    # The incremental path never carries an edge mask; phase 2 is
+    # seeded from the previous run's planes.
+    nh_prev = jax.lax.bitcast_convert_type(prev.nexthops, jnp.int32)
+    sp, _, _ = _phase2(
+        g, root, dist, g.in_valid, limit, hops0=prev.hops, nh0=nh_prev
+    )
+    return sp
+
+
+def tropical_spf_one_incremental_multipath(
+    g,
+    tt: TropicalTiles,
+    root,
+    prev: SpfTensors,
+    prev_mp: MultipathTensors,
+    seed_rows,
+    kp: int,
+    max_iters: int | None = None,
+) -> tuple[SpfTensors, MultipathTensors]:
+    """Incremental multipath on the tiles: the widened planes reconverge
+    through the DAG-tile contractions seeded from the previous run
+    (rounds ~ changed-region depth).  Bit-identical to the full
+    ``tropical_spf_one_multipath`` by fixpoint uniqueness."""
+    n, _ = g.in_src.shape
+    limit = n if max_iters is None else max_iters
+    aff = _affected(g, prev.parent, seed_rows, limit)
+    dist0 = jnp.where(aff, INF, prev.dist).at[root].set(0)
+    dist = _tile_relax(g, tt, dist0[:, None], None, None, limit)[:, 0]
+    ok = g.in_valid
+    nh_prev = jax.lax.bitcast_convert_type(prev.nexthops, jnp.int32)
+    sp, dag, hops = _phase2(
+        g, root, dist, ok, limit, hops0=prev.hops, nh0=nh_prev
+    )
+    npaths = _np_tile_fixpoint(g, tt, dag, root, prev_mp.npaths, limit)
+    aw = _aw_tile_fixpoint(
+        g, tt, dag, hops, npaths, prev_mp.nh_weights, limit
+    )
+    parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
+    mp = MultipathTensors(
+        parents=parents,
+        pdist=pdist,
+        pweight=pweight,
+        npaths=jnp.where(dist < INF, npaths, 0),
+        nh_weights=aw,
+    )
+    return sp, mp

@@ -166,6 +166,73 @@ def shard_graph(g: DeviceGraph, mesh: Mesh) -> DeviceGraph:
     return DeviceGraph(*(put(x, s) for x, s in zip(g, spec)))
 
 
+def tile_sharding(mesh: Mesh):
+    """Placement of the tropical tile planes (ISSUE 13): fully
+    REPLICATED over both axes.  The tiles are the contraction's shared
+    left operand — every batch shard reads all of them every round, and
+    row-sharding a [T, B, B] scatter-min would put a node-axis
+    collective inside the fixpoint body."""
+    from holo_tpu.ops.tropical import TropicalTiles
+
+    rep = NamedSharding(mesh, P())
+    return TropicalTiles(tiles=rep, cb=rep, pos=rep)
+
+
+def shard_tiles(tt, mesh: Mesh):
+    """Place tropical tile planes under the mesh (replicated); the
+    1-device mesh degenerates to a plain put like shard_graph."""
+    if mesh.size == 1:
+        return jax.device_put(tt, mesh.devices.flat[0])
+    return jax.device_put(tt, tile_sharding(mesh))
+
+
+def shard_repair_rows(
+    mesh: Mesh, rows: np.ndarray, sentinel: int
+) -> jax.Array:
+    """Place a per-scenario repair-row batch sharded over ``batch``,
+    padded with sentinel-only rows to match the padded scenario axis
+    (a pad scenario fails nothing, so its repair set is empty)."""
+    r = np.asarray(rows, np.int32)
+    pad = (-r.shape[0]) % mesh.shape["batch"]
+    if pad:
+        r = np.concatenate(
+            [r, np.full((pad, r.shape[1]), sentinel, np.int32)]
+        )
+    if mesh.size == 1:  # see shard_scenarios
+        return r
+    return jax.device_put(r, NamedSharding(mesh, P("batch", None)))
+
+
+def sharded_tropical_whatif_jit(mesh: Mesh, max_iters: int | None = None):
+    """Sharded tropical what-if (ISSUE 13): the scenario lanes ride the
+    batch axis through the min-plus contraction; tiles replicated."""
+    from holo_tpu.ops.tropical import tropical_whatif_batch
+
+    @jax.jit
+    def step(g: DeviceGraph, tt, root, edge_masks, repair_rows):
+        out = tropical_whatif_batch(
+            g, tt, root, edge_masks, repair_rows, max_iters
+        )
+        return constrain_batch(mesh, out)
+
+    return step
+
+
+def sharded_tropical_multiroot_jit(mesh: Mesh, max_iters: int | None = None):
+    """Sharded tropical multiroot: roots on the batch axis, tiles
+    replicated, outputs pinned to the batch sharding."""
+    from holo_tpu.ops.tropical import tropical_multiroot
+
+    @jax.jit
+    def step(g: DeviceGraph, tt, roots, edge_mask, repair_rows):
+        out = tropical_multiroot(
+            g, tt, roots, edge_mask, repair_rows, max_iters
+        )
+        return constrain_batch(mesh, out)
+
+    return step
+
+
 def shard_scenarios(mesh: Mesh, edge_masks: np.ndarray) -> jax.Array:
     """Place a scenario edge-mask batch sharded over ``batch``.
 

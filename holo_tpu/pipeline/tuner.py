@@ -61,11 +61,19 @@ from holo_tpu import telemetry
 log = logging.getLogger("holo_tpu.pipeline.tuner")
 
 #: persisted-table format version: bump to invalidate old tables
-#: (v2: shape buckets grew the multipath parent-set width element)
-TABLE_VERSION = 2
+#: (v2: shape buckets grew the multipath parent-set width element;
+#: v3: the tropical min-plus engine joined the candidate sets, ISSUE 13)
+TABLE_VERSION = 3
 
-#: gather-path fixpoint engines (all bit-identical; see ops/spf_engine)
-ENGINES = ("seq", "fused", "packed", "hybrid")
+#: k=1 fixpoint engines (all bit-identical; see ops/spf_engine +
+#: ops/tropical — the tropical entry is the blocked min-plus program)
+ENGINES = ("seq", "fused", "packed", "hybrid", "tropical")
+
+#: k>1 multipath formulations: the packed row-gather kernel ("mp") and
+#: its tropical DAG-tile-contraction variant.  A/B'd per shape bucket
+#: for kind=one only — the widened tropical program scatters per-run
+#: DAG tiles, which a big what-if batch would multiply by B.
+MP_ENGINES = ("mp", "mp_tropical")
 
 #: measured samples retained per (kind, bucket, engine) — medians over
 #: a short window track platform drift without unbounded memory
@@ -155,12 +163,14 @@ class EngineTuner:
         self,
         path: str | Path | None = None,
         engines: tuple[str, ...] = ENGINES,
+        mp_engines: tuple[str, ...] = MP_ENGINES,
         explore_rounds: int = 2,
         reprobe_every: int = 64,
         default_engine: str = "seq",
         default_delta_depth: int = 256,
     ):
         self.engines = tuple(engines)
+        self.mp_engines = tuple(mp_engines)
         self.explore_rounds = int(explore_rounds)
         self.reprobe_every = int(reprobe_every)
         self.default_engine = default_engine
@@ -190,19 +200,30 @@ class EngineTuner:
 
     # -- engine selection ----------------------------------------------
 
+    def _candidates(self, kind: str, bucket: tuple) -> tuple[str, ...]:
+        """The engine set this (kind, bucket) chooses among: the k=1
+        gather+tropical family, or — for k>1 single-SPF dispatches —
+        the multipath pair (``mp`` vs ``mp_tropical``).  k>1 what-if
+        batches stay on ``mp`` (see MP_ENGINES)."""
+        k = bucket[4] if len(bucket) > 4 and isinstance(bucket[4], int) else 1
+        if k > 1:
+            return self.mp_engines if kind == "one" else ("mp",)
+        return self.engines
+
     def pick(self, kind: str, bucket: tuple) -> str:
         """The engine this dispatch should run.  Deterministic: the
         schedule depends only on the bucket's dispatch counter and the
         recorded samples, never on an RNG — two daemons replaying the
         same dispatch sequence make identical choices."""
         key = self._key(kind, bucket)
+        cands = self._candidates(kind, bucket)
         with self._lock:
             st = self._state(key)
             st.dispatches += 1
-            # Explore until every engine has explore_rounds samples.
+            # Explore until every candidate has explore_rounds samples.
             needy = [
                 e
-                for e in self._explore_order(st)
+                for e in self._explore_order(st, cands)
                 if len(st.samples.get(e, ())) < self.explore_rounds
             ]
             if needy:
@@ -210,14 +231,14 @@ class EngineTuner:
                 st.explored += 1
                 phase = "explore"
             else:
-                winner = self._winner_locked(st)
+                winner = self._winner_locked(st, cands)
                 if (
                     self.reprobe_every
                     and st.dispatches % self.reprobe_every == 0
-                    and len(self.engines) > 1
+                    and len(cands) > 1
                 ):
                     # Deterministic round-robin over the non-winners.
-                    others = [e for e in self.engines if e != winner]
+                    others = [e for e in cands if e != winner]
                     engine = others[
                         (st.dispatches // self.reprobe_every) % len(others)
                     ]
@@ -228,27 +249,54 @@ class EngineTuner:
         _DECISIONS.labels(kind=kind, engine=engine, phase=phase).inc()
         return engine
 
-    def _explore_order(self, st: _BucketState) -> tuple[str, ...]:
+    def _explore_order(
+        self, st: _BucketState, cands: tuple[str, ...] | None = None
+    ) -> tuple[str, ...]:
         """Candidate order for exploration: engines with a compile-time
         cost prior first, cheapest estimated bytes-accessed leading —
         the likely winner gets measured earliest, so even a truncated
         explore phase tends to have sampled it."""
+        if cands is None:
+            cands = self.engines
         if not st.cost:
-            return self.engines
+            return cands
         return tuple(
             sorted(
-                self.engines,
+                cands,
                 key=lambda e: st.cost.get(e, {}).get("bytes", float("inf")),
             )
         )
 
-    def _winner_locked(self, st: _BucketState) -> str:
+    def _winner_locked(
+        self, st: _BucketState, cands: tuple[str, ...] | None = None
+    ) -> str:
+        if cands is None:
+            # Measured engines outside the k=1 set (the mp family) must
+            # still be able to win their own buckets.
+            cands = tuple(
+                dict.fromkeys(self.engines + tuple(sorted(st.samples)))
+            )
         best, best_med = None, None
-        for e in self.engines:
+        for e in cands:
             med = _median(st.samples.get(e))
             if med is not None and (best_med is None or med < best_med):
                 best, best_med = e, med
-        return best if best is not None else self.default_engine
+        if best is not None:
+            return best
+        return self.default_engine if self.default_engine in cands else cands[0]
+
+    def current_winner(self, kind: str, bucket: tuple) -> str | None:
+        """Read-only peek at a bucket's measured winner (no schedule
+        advance, no metrics): the backend routes engine-fixed kernels —
+        the DeltaPath incremental dispatch — through the tropical tiles
+        when this bucket's full-dispatch winner is tropical.  None when
+        the bucket has never been measured."""
+        key = self._key(kind, bucket)
+        with self._lock:
+            st = self._table.get(key)
+            if st is None or not st.samples:
+                return None
+            return self._winner_locked(st, self._candidates(kind, bucket))
 
     def observe(
         self, kind: str, bucket: tuple, engine: str, seconds: float

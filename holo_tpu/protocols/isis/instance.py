@@ -112,6 +112,11 @@ class IsisIfConfig:
     bfd_min_tx: int = 1000000
     bfd_min_rx: int = 1000000
     bfd_multiplier: int = 3
+    # Fast-reroute SRLG membership of this circuit (ietf fast-reroute
+    # config): lowered to the uint32 Topology.edge_srlg bitmask at SPT
+    # marshal time (spf_run.srlg_bits semantics) — the srlg_disjoint
+    # FRR policy input.  Ids fold mod 32, conservative-correct.
+    srlg: tuple = ()
 
 
 @dataclass
@@ -2029,6 +2034,21 @@ class IsisInstance(Actor):
                             atom_ids[e_i] = len(atoms)
                             atoms.append(hop)
                 topo.edge_direct_atom = atom_ids
+                from holo_tpu.protocols.ospf.spf_run import (
+                    apply_interface_srlg,
+                    srlg_bits,
+                )
+
+                iface_srlg = {
+                    i.name: srlg_bits(i.config.srlg)
+                    for i in self.interfaces.values()
+                    if i.config.srlg
+                }
+                if iface_srlg:
+                    # IS-IS atoms are (ifname, addr4, addr6) tuples.
+                    apply_interface_srlg(
+                        topo, [a[0] for a in atoms], iface_srlg
+                    )
                 topo.touch()
                 return topo, atoms
 

@@ -2829,10 +2829,19 @@ class OspfInstance(Actor):
             vlink_nexthops = None
             if int(area.area_id) == 0:
                 vlink_nexthops = self._vlink_nexthops(area, area_results, now)
+            # Interface fast-reroute SRLG config -> Topology.edge_srlg
+            # (the FRR srlg_disjoint policy input; ROADMAP carry-over).
+            from holo_tpu.protocols.ospf.spf_run import srlg_bits
+
+            iface_srlg = {
+                i.name: srlg_bits(i.config.srlg)
+                for i in area.interfaces.values()
+                if i.config.srlg
+            }
             st = build_topology(
                 area.lsdb, self.config.router_id, now, iface_by_addr,
                 iface_by_nbr, p2p_nbr_addr, iface_by_ifindex,
-                vlink_nexthops,
+                vlink_nexthops, iface_srlg=iface_srlg,
             )
             if st is None:
                 self._spf_delta_bases.pop(area.area_id, None)

@@ -31,7 +31,11 @@ from holo_tpu.protocols.ospf.instance import (
 )
 from holo_tpu.protocols.ospf.interface import ElectionView, IfType, elect_dr_bdr
 from holo_tpu.protocols.ospf.lsdb import MIN_LS_ARRIVAL, Lsdb, next_seq_no
-from holo_tpu.protocols.ospf.spf_run import atom_bits
+from holo_tpu.protocols.ospf.spf_run import (
+    apply_interface_srlg,
+    atom_bits,
+    srlg_bits,
+)
 from holo_tpu.protocols.ospf.neighbor import (
     Neighbor,
     NsmEvent,
@@ -67,6 +71,9 @@ class V3IfConfig:
     # Passive circuits advertise their prefixes but exchange no packets.
     passive: bool = False
     auth: object = None  # packet_v3.AuthCtxV3 or None (RFC 7166 trailer)
+    # Fast-reroute SRLG membership (see IfConfig.srlg): lowered to
+    # Topology.edge_srlg at SPF marshal time for the FRR policy masks.
+    srlg: tuple = ()
 
 
 @dataclass
@@ -2381,6 +2388,22 @@ class OspfV3Instance(Actor):
                     atom_ids[e_i] = len(atoms)
                     atoms.append((iface.name, nbr.src))
         topo.edge_direct_atom = atom_ids
+        iface_srlg = {
+            i.name: srlg_bits(i.config.srlg)
+            for i in self._area_ifaces(area)
+            if i.config.srlg
+        }
+        if iface_srlg:
+            # v3 atoms are NexthopAtom (vlinks) or (ifname, addr)
+            # tuples — normalize to per-atom interface names.
+            apply_interface_srlg(
+                topo,
+                [
+                    a.ifname if hasattr(a, "ifname") else a[0]
+                    for a in atoms
+                ],
+                iface_srlg,
+            )
         topo.touch()
 
         # DeltaPath seam (same contract as the v2 instance): identical

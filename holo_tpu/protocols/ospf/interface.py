@@ -68,6 +68,11 @@ class IfConfig:
     # (reference ospfv2/lsdb.rs:760-783, iana.rs LsaExtPrefixFlags).
     node_flag: bool = False
     anycast_flag: bool = False
+    # Shared-risk link group ids of this interface (ietf fast-reroute
+    # SRLG membership).  Lowered to the uint32 ``Topology.edge_srlg``
+    # bitmask at SPF marshal time (spf_run.srlg_bits; ids fold mod 32,
+    # conservative-correct) — the srlg_disjoint FRR policy input.
+    srlg: tuple = ()
 
 
 @dataclass

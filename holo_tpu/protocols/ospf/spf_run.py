@@ -31,6 +31,47 @@ from holo_tpu.spf.backend import SpfResult
 from holo_tpu.utils.ip import apply_mask
 
 
+def srlg_bits(groups) -> int:
+    """uint32 bitmask of configured SRLG group ids.
+
+    Group ids fold modulo 32 onto the mask bits — membership testing
+    stays conservative-correct under folding (a shared bit is treated
+    as a shared risk, never the reverse), matching the FRR engines'
+    ``srlg_disjoint`` exclusion semantics over ``Topology.edge_srlg``.
+    """
+    bits = 0
+    for gid in groups or ():
+        bits |= 1 << (int(gid) % 32)
+    return bits
+
+
+def apply_interface_srlg(
+    topo: Topology, atom_ifnames, srlg_of_ifname: dict
+) -> None:
+    """Stamp ``Topology.edge_srlg`` from per-interface fast-reroute
+    config (the ROADMAP carry-over: until now only tests/synth ever set
+    the seam).
+
+    ``atom_ifnames[a]`` is the outgoing interface of next-hop atom
+    ``a`` (None for borrowed/vlink atoms); ``srlg_of_ifname`` maps
+    interface name -> uint32 SRLG bitmask (:func:`srlg_bits`).  Every
+    edge resolving through a configured interface — exactly the root
+    out-edges the FRR engines treat as protected links and repair
+    candidates — carries that interface's groups.  In-place: callers
+    stamp after ``edge_direct_atom`` is final."""
+    if not srlg_of_ifname:
+        return
+    srlg = np.zeros(topo.n_edges, np.uint32)
+    for e in range(topo.n_edges):
+        a = int(topo.edge_direct_atom[e])
+        if a < 0 or a >= len(atom_ifnames):
+            continue
+        ifn = atom_ifnames[a]
+        if ifn is not None:
+            srlg[e] = np.uint32(srlg_of_ifname.get(ifn, 0))
+    topo.edge_srlg = srlg
+
+
 @dataclass(frozen=True)
 class NexthopAtom:
     """Resolved direct next hop: outgoing interface + neighbor address.
@@ -65,6 +106,7 @@ def build_topology(
     p2p_nbr_addr: dict[tuple, IPv4Address] | None = None,
     iface_by_ifindex: dict[int, str] | None = None,
     vlink_nexthops: dict | None = None,
+    iface_srlg: dict[str, int] | None = None,
 ) -> SpfTopology | None:
     """Lower the area LSDB to the SPF vertex/edge model.
 
@@ -255,6 +297,12 @@ def build_topology(
                     break
 
     topo.edge_direct_atom = atom_ids
+    if iface_srlg:
+        # Interface fast-reroute SRLG config -> the edge_srlg seam the
+        # FRR policy masks consume (srlg_disjoint).
+        apply_interface_srlg(
+            topo, [a.ifname for a in atoms], iface_srlg
+        )
     topo.touch()
     return SpfTopology(topo, atoms, router_index, network_index)
 

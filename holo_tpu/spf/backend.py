@@ -34,6 +34,18 @@ from holo_tpu.ops.spf_engine import (
     spf_one_multipath,
     spf_whatif_batch,
 )
+from holo_tpu.ops.tropical import (
+    repair_rows_host,
+    tropical_multiroot,
+    tropical_spf_one,
+    tropical_spf_one_incremental,
+    tropical_spf_one_incremental_multipath,
+    tropical_spf_one_multipath,
+    tropical_whatif_batch,
+)
+
+#: engine names that dispatch through the tropical tile planes
+_TROPICAL_ENGINES = ("tropical", "mp_tropical")
 from holo_tpu.spf.scalar import spf_multipath_reference, spf_reference
 from holo_tpu.telemetry import convergence, profiling
 
@@ -355,6 +367,11 @@ class TpuSpfBackend(SpfBackend):
         self._mp_jits: dict[int, object] = {}
         self._mp_batch_jits: dict[int, object] = {}
         self._mp_incr_jits: dict[int, object] = {}
+        # Tropical (ISSUE 13) jits: the blocked min-plus programs take
+        # the tile planes as an extra operand, so they live in their
+        # own caches; the tuner flips between the families per shape
+        # bucket (all bit-identical — a flip is a latency choice).
+        self._trop_jits: dict[tuple, object] = {}
         self._jit_multiroot = jax.jit(
             lambda g, rs, m: spf_multiroot(g, rs, m, self.max_iters)
         )
@@ -431,14 +448,172 @@ class TpuSpfBackend(SpfBackend):
             )
         return fn
 
-    # Kept as properties: external probes (tests, cost tooling) and the
-    # degenerate-mesh routing below still read the pinned-engine jits.
+    def _jit_trop(self, key: str, build):
+        fn = self._trop_jits.get(key)
+        if fn is None:
+            fn = self._trop_jits[key] = build()
+        return fn
+
+    @property
+    def _jit_trop_one(self):
+        return self._jit_trop(
+            "one",
+            lambda: jax.jit(
+                lambda g, tt, r, m, rr: tropical_spf_one(
+                    g, tt, r, m, rr, self.max_iters
+                )
+            ),
+        )
+
+    @property
+    def _jit_trop_batch(self):
+        return self._jit_trop(
+            "whatif",
+            lambda: jax.jit(
+                lambda g, tt, r, ms, rr: tropical_whatif_batch(
+                    g, tt, r, ms, rr, self.max_iters
+                )
+            ),
+        )
+
+    def _jit_trop_mp_for(self, kp: int):
+        return self._jit_trop(
+            f"mp{kp}",
+            lambda: jax.jit(
+                lambda g, tt, r, m, rr, _kp=kp: tropical_spf_one_multipath(
+                    g, tt, r, _kp, m, rr, self.max_iters
+                )
+            ),
+        )
+
+    @property
+    def _jit_trop_incr(self):
+        return self._jit_trop(
+            "incr",
+            lambda: jax.jit(
+                lambda g, tt, r, prev, seeds: tropical_spf_one_incremental(
+                    g, tt, r, prev, seeds, self.max_iters
+                ),
+                donate_argnums=(3,),
+            ),
+        )
+
+    def _jit_trop_mp_incr_for(self, kp: int):
+        return self._jit_trop(
+            f"mp-incr{kp}",
+            lambda: jax.jit(
+                lambda g, tt, r, prev, prev_mp, seeds, _kp=kp: (
+                    tropical_spf_one_incremental_multipath(
+                        g, tt, r, prev, prev_mp, seeds, _kp, self.max_iters
+                    )
+                ),
+                donate_argnums=(3, 4),
+            ),
+        )
+
+    @property
+    def _jit_trop_multiroot(self):
+        return self._jit_trop(
+            "multiroot",
+            lambda: jax.jit(
+                lambda g, tt, rs, m, rr: tropical_multiroot(
+                    g, tt, rs, m, rr, self.max_iters
+                )
+            ),
+        )
+
+    def _trop_operands(self, topo, g, mask=None):
+        """(tiles, repair rows) for one tropical dispatch — call inside
+        the sanctioned marshal window (the tile device_put and the
+        repair-row lowering are part of that transfer).  The repair
+        rows carry the destinations of masked-out edges, padded with
+        the resident's PADDED row count (drop sentinel)."""
+        tt = shared_graph_cache().get_tropical(
+            topo, max(self.n_atoms, topo.n_atoms())
+        )
+        rows = int(g.in_src.shape[0])
+        if mask is None:
+            rr = np.zeros(0, np.int32)
+        else:
+            rr = repair_rows_host(
+                topo.edge_dst, np.asarray(mask, bool)[None, :], rows
+            )[0]
+        return tt, rr
+
+    def _one_step(self, engine: str, kp: int, g, tt, root, mask, rr):
+        """(jit, args) of one single-SPF dispatch for the picked
+        engine — the gather/tropical/mp/mp_tropical fan-in shared by
+        the sync and split-phase paths."""
+        if kp > 1:
+            if engine == "mp_tropical":
+                return self._jit_trop_mp_for(kp), (g, tt, root, mask, rr)
+            return self._jit_mp_for(kp), (g, root, mask)
+        if engine == "tropical":
+            return self._jit_trop_one, (g, tt, root, mask, rr)
+        return self._jit_one_for(engine), (g, root, mask)
+
+    def _incr_step(self, topo, g, n_atoms, kp, pad, prev_key, prev, seeds_p):
+        """Dispatch ONE incremental (DeltaPath) kernel — the
+        gather/tropical x single/multipath fan-in shared by the sync
+        and split-phase paths.  Must run inside the caller's
+        ``spf.one.delta`` sanctioned window (the tile attach may
+        device_put).  The previous tensors are DONATED into the
+        kernel: our ``_prev_one`` reference is dropped here, before
+        dispatch, so a failed dispatch can never leave a consumed
+        entry behind.  Returns ``(step, out, trop, tt, sig, fresh)``."""
+        trop = self._trop_incremental(topo, kp)
+        tt = (
+            shared_graph_cache().get_tropical(topo, n_atoms)
+            if trop
+            else None
+        )
+        sig = (
+            g.in_src.shape, g.direct_nh_words.shape[2], pad,
+            _mesh_key(), kp,
+            None if tt is None else tt.tiles.shape,
+        )
+        fresh = self._track_compile("delta", "incr", *sig)
+        del self._prev_one[prev_key]
+        if kp > 1:
+            if trop:
+                step = self._jit_trop_mp_incr_for(kp)
+                out = step(g, tt, topo.root, prev[0], prev[1], seeds_p)
+            else:
+                step = self._jit_mp_incr_for(kp)
+                out = step(g, topo.root, prev[0], prev[1], seeds_p)
+        elif trop:
+            step = self._jit_trop_incr
+            out = step(g, tt, topo.root, prev, seeds_p)
+        else:
+            step = self._jit_incr
+            out = step(g, topo.root, prev, seeds_p)
+        return step, out, trop, tt, sig, fresh
+
+    def _incr_cost_args(self, trop, tt, g, root, out, seeds_p, kp):
+        """record_cost re-trace args for a fresh incremental compile —
+        the donated prev args are gone, so this run's own output
+        tensors stand in (same shapes/dtypes)."""
+        root_args = (g, tt, root) if trop else (g, root)
+        return (
+            (*root_args, out[0], out[1], seeds_p)
+            if kp > 1
+            else (*root_args, out, seeds_p)
+        )
+
+    # Kept as properties: external probes (tests, cost tooling) read
+    # the pinned-engine jits.  Pinned tropical returns the tile-plane
+    # jit — NOTE its call signature is (g, tt, root, mask, rr), not
+    # the gather engines' (g, root, mask).
     @property
     def _jit_one(self):
+        if self.one_engine == "tropical":
+            return self._jit_trop_one
         return self._jit_one_for(self.one_engine)
 
     @property
     def _jit_batch(self):
+        if self.one_engine == "tropical":
+            return self._jit_trop_batch
         return self._jit_batch_for(self.one_engine)
 
     def _pick_engine(self, kind: str, topo, batch: int = 1, kp: int = 1):
@@ -447,20 +622,25 @@ class TpuSpfBackend(SpfBackend):
         the pinned ``one_engine``.  Lazy import keeps the unarmed path
         at a sys.modules hit (pipeline_overhead gate).
 
-        Multipath dispatches (``kp > 1``) have a single widened
-        formulation — engine ``mp`` — but still report under a bucket
-        carrying kp in the shape key (the tuner learns k as part of
-        the shape: k=1 engine medians never mix with k=8 walls)."""
+        Multipath dispatches (``kp > 1``) choose between the packed
+        row-gather kernel (``mp``) and its tropical DAG-tile variant
+        (``mp_tropical``, kind=one only — ISSUE 13), still under a
+        bucket carrying kp in the shape key (the tuner learns k as
+        part of the shape: k=1 engine medians never mix with k=8
+        walls)."""
         from holo_tpu.pipeline.tuner import active_tuner, shape_bucket
 
         t = active_tuner()
         if t is None or self.engine == "blocked":
-            return ("mp" if kp > 1 else self.one_engine), None
+            if kp > 1:
+                pinned_trop = (
+                    self.one_engine == "tropical" and kind == "one"
+                )
+                return ("mp_tropical" if pinned_trop else "mp"), None
+            return self.one_engine, None
         bucket = shape_bucket(
             topo.n_vertices, topo.n_edges, batch, _mesh_key(), k=kp
         )
-        if kp > 1:
-            return "mp", bucket
         return t.pick(kind, bucket), bucket
 
     @staticmethod
@@ -523,6 +703,25 @@ class TpuSpfBackend(SpfBackend):
             topo.n_vertices, topo.n_edges, 1, _mesh_key(), k=kp
         )
 
+    def _trop_incremental(self, topo, kp: int) -> bool:
+        """Route this chain's engine-fixed incremental kernel through
+        the tropical tiles?  Yes when the backend is pinned tropical,
+        or when the tuner's measured full-dispatch winner for this
+        shape bucket is the tropical family — the incremental program
+        should relax on the same representation the full program
+        proved fastest at this shape."""
+        if self.one_engine == "tropical":
+            return True
+        from holo_tpu.pipeline.tuner import active_tuner
+
+        t = active_tuner()
+        if t is None:
+            return False
+        return (
+            t.current_winner("one", self._depth_bucket(topo, kp))
+            in _TROPICAL_ENGINES
+        )
+
     def _tuner_depth_observe(
         self, topo, arm: str, seconds: float, kp: int = 1
     ) -> None:
@@ -553,6 +752,36 @@ class TpuSpfBackend(SpfBackend):
         fn = self._shard_jits.get(key)
         if fn is None:
             fn = sharded_whatif_jit(mesh, self.max_iters, engine)
+            self._shard_jits[key] = fn
+        return fn
+
+    def _sharded_trop_whatif(self, mesh):
+        if mesh.size == 1:  # see _sharded_whatif
+            return self._jit_trop_batch
+        from holo_tpu.parallel.mesh import (
+            mesh_cache_key,
+            sharded_tropical_whatif_jit,
+        )
+
+        key = ("whatif-tropical", mesh_cache_key(mesh))
+        fn = self._shard_jits.get(key)
+        if fn is None:
+            fn = sharded_tropical_whatif_jit(mesh, self.max_iters)
+            self._shard_jits[key] = fn
+        return fn
+
+    def _sharded_trop_multiroot(self, mesh):
+        if mesh.size == 1:
+            return self._jit_trop_multiroot
+        from holo_tpu.parallel.mesh import (
+            mesh_cache_key,
+            sharded_tropical_multiroot_jit,
+        )
+
+        key = ("multiroot-tropical", mesh_cache_key(mesh))
+        fn = self._shard_jits.get(key)
+        if fn is None:
+            fn = sharded_tropical_multiroot_jit(mesh, self.max_iters)
             self._shard_jits[key] = fn
         return fn
 
@@ -731,9 +960,6 @@ class TpuSpfBackend(SpfBackend):
         t0 = profiling.clock()
         engine, bucket = self._pick_engine("one", topo, kp=kp)
         obucket = self._obs_bucket(topo, 1, kp, bucket)
-        step = (
-            self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
-        )
         with profiling.dispatch_context(
             kind="one", engine=engine, bucket=obucket
         ), telemetry.span("spf.dispatch", kind="one", backend="tpu"):
@@ -750,15 +976,23 @@ class TpuSpfBackend(SpfBackend):
                     )
                     remarshal = self._last_prepare_how == "miss"
                     mask = self._full_mask(topo, edge_mask)
+                    tt = rr = None
+                    if engine in _TROPICAL_ENGINES:
+                        tt, rr = self._trop_operands(topo, g, edge_mask)
+                    step, args = self._one_step(
+                        engine, kp, g, tt, topo.root, mask, rr
+                    )
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
                         topo.n_edges, _mesh_key(), engine, kp,
+                        None if tt is None else tt.tiles.shape,
+                        None if rr is None else rr.shape,
                     )
                     fresh = self._track_compile("one", engine, *sig)
-                    out = step(g, topo.root, mask)
+                    out = step(*args)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.one", step, g, topo.root, mask, shape_sig=sig,
+                    "spf.one", step, *args, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
                 self._obs_cost("spf.one", "one", engine, obucket, entry)
@@ -869,28 +1103,13 @@ class TpuSpfBackend(SpfBackend):
                         pad, int(g.in_src.shape[0]), np.int32
                     )
                     seeds_p[: seeds.shape[0]] = seeds
-                    sig = (
-                        g.in_src.shape, g.direct_nh_words.shape[2], pad,
-                        _mesh_key(), kp,
+                    step, out, trop, tt, sig, fresh = self._incr_step(
+                        topo, g, n_atoms, kp, pad, prev_key, prev,
+                        seeds_p,
                     )
-                    fresh = self._track_compile("delta", "incr", *sig)
-                    # The previous tensors are DONATED into the kernel:
-                    # drop our reference first so a failed dispatch can
-                    # never leave a consumed entry behind.
-                    del self._prev_one[prev_key]
-                    if kp > 1:
-                        step = self._jit_mp_incr_for(kp)
-                        out = step(g, topo.root, prev[0], prev[1], seeds_p)
-                    else:
-                        step = self._jit_incr
-                        out = step(g, topo.root, prev, seeds_p)
             if fresh:
-                # The donated prev args are gone: re-trace against this
-                # run's own output tensors (same shapes/dtypes).
-                cost_args = (
-                    (g, topo.root, out[0], out[1], seeds_p)
-                    if kp > 1
-                    else (g, topo.root, out, seeds_p)
+                cost_args = self._incr_cost_args(
+                    trop, tt, g, topo.root, out, seeds_p, kp
                 )
                 entry = profiling.record_cost(
                     "spf.delta", step, *cost_args, shape_sig=sig,
@@ -1038,6 +1257,14 @@ class TpuSpfBackend(SpfBackend):
                     # rebuilt (need_edge_ids).
                     g = self.prepare(topo, need_edge_ids=True)
                     masks = np.asarray(edge_masks, bool)
+                    tt = rr = None
+                    if engine == "tropical":
+                        tt = shared_graph_cache().get_tropical(
+                            topo, max(self.n_atoms, topo.n_atoms())
+                        )
+                        rr = repair_rows_host(
+                            topo.edge_dst, masks, int(g.in_src.shape[0])
+                        )
                     if mesh is not None:
                         # THE sharded scenario axis: masks placed
                         # batch-sharded (padded to the axis size with
@@ -1046,31 +1273,45 @@ class TpuSpfBackend(SpfBackend):
                         # over the mesh's batch devices while the
                         # cache-resident graph planes ride row-sharded
                         # over node (the mesh.py layout contract).
-                        from holo_tpu.parallel.mesh import shard_scenarios
+                        from holo_tpu.parallel.mesh import (
+                            shard_repair_rows,
+                            shard_scenarios,
+                        )
 
                         masks_dev = shard_scenarios(mesh, masks)
-                        step = (
-                            self._sharded_mp_whatif(mesh, kp)
-                            if kp > 1
-                            else self._sharded_whatif(mesh, engine)
-                        )
+                        if engine == "tropical":
+                            rr = shard_repair_rows(
+                                mesh, rr, int(g.in_src.shape[0])
+                            )
+                            step = self._sharded_trop_whatif(mesh)
+                        elif kp > 1:
+                            step = self._sharded_mp_whatif(mesh, kp)
+                        else:
+                            step = self._sharded_whatif(mesh, engine)
                     else:
                         masks_dev = masks
-                        step = (
-                            self._jit_mp_batch_for(kp)
-                            if kp > 1
-                            else self._jit_batch_for(engine)
-                        )
+                        if engine == "tropical":
+                            step = self._jit_trop_batch
+                        elif kp > 1:
+                            step = self._jit_mp_batch_for(kp)
+                        else:
+                            step = self._jit_batch_for(engine)
+                    args = (
+                        (g, tt, topo.root, masks_dev, rr)
+                        if engine == "tropical"
+                        else (g, topo.root, masks_dev)
+                    )
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
                         masks_dev.shape, _mesh_key(), engine, kp,
+                        None if tt is None else tt.tiles.shape,
+                        None if rr is None else rr.shape,
                     )
                     fresh = self._track_compile("whatif", engine, *sig)
-                    out = step(g, topo.root, masks_dev)
+                    out = step(*args)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.whatif", step, g, topo.root, masks_dev,
-                    shape_sig=sig,
+                    "spf.whatif", step, *args, shape_sig=sig,
                 )
                 self._tuner_cost("whatif", bucket, engine, entry)
                 self._obs_cost(
@@ -1125,15 +1366,24 @@ class TpuSpfBackend(SpfBackend):
             faults.crashpoint("spf.shard")
         R = len(roots)
         t0 = profiling.clock()
+        # The multiroot program has no tuner kind of its own: it rides
+        # the tropical tiles when the backend is pinned tropical (the
+        # root axis becomes the contraction's dense lanes), else the
+        # proven seq formulation.
+        mr_engine = "tropical" if self.one_engine == "tropical" else "seq"
         mr_bucket = self._obs_bucket(topo, R, 1, None)
         with profiling.dispatch_context(
-            kind="multiroot", engine="seq", bucket=mr_bucket
+            kind="multiroot", engine=mr_engine, bucket=mr_bucket
         ), telemetry.span(
             "spf.dispatch", kind="multiroot", backend="tpu", roots=R
         ):
             with profiling.stage("spf.multiroot", "marshal"):
                 with sanctioned_transfer("spf.multiroot.marshal"):
                     g = self.prepare(topo)
+                    tt = None
+                    rr = np.zeros(0, np.int32)
+                    if mr_engine == "tropical":
+                        tt, rr = self._trop_operands(topo, g)
                     roots_i32 = np.asarray(roots, np.int32)
                     if mesh is not None:
                         # The all-roots plane rides the same batch
@@ -1142,24 +1392,40 @@ class TpuSpfBackend(SpfBackend):
                         from holo_tpu.parallel.mesh import shard_roots
 
                         roots_dev = shard_roots(mesh, roots_i32)
-                        step = self._sharded_multiroot(mesh)
+                        step = (
+                            self._sharded_trop_multiroot(mesh)
+                            if mr_engine == "tropical"
+                            else self._sharded_multiroot(mesh)
+                        )
                     else:
                         roots_dev = roots_i32
-                        step = self._jit_multiroot
+                        step = (
+                            self._jit_trop_multiroot
+                            if mr_engine == "tropical"
+                            else self._jit_multiroot
+                        )
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
                         roots_dev.shape[0], topo.n_edges, _mesh_key(),
+                        None if tt is None else tt.tiles.shape,
                     )
-                    fresh = self._track_compile("multiroot", "seq", *sig)
+                    fresh = self._track_compile(
+                        "multiroot", mr_engine, *sig
+                    )
                     mask = np.ones(topo.n_edges, bool)
-                    out = step(g, roots_dev, mask)
+                    args = (
+                        (g, tt, roots_dev, mask, rr)
+                        if mr_engine == "tropical"
+                        else (g, roots_dev, mask)
+                    )
+                    out = step(*args)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.multiroot", step, g, roots_dev, mask,
-                    shape_sig=sig,
+                    "spf.multiroot", step, *args, shape_sig=sig,
                 )
                 self._obs_cost(
-                    "spf.multiroot", "multiroot", "seq", mr_bucket, entry
+                    "spf.multiroot", "multiroot", mr_engine, mr_bucket,
+                    entry,
                 )
             with profiling.stage("spf.multiroot", "device"):
                 with profiling.annotation("spf.multiroot.device"):
@@ -1212,9 +1478,6 @@ class TpuSpfBackend(SpfBackend):
         t0 = profiling.clock()
         engine, bucket = self._pick_engine("one", topo, kp=kp)
         obucket = self._obs_bucket(topo, 1, kp, bucket)
-        step = (
-            self._jit_mp_for(kp) if kp > 1 else self._jit_one_for(engine)
-        )
         with profiling.dispatch_context(
             kind="one", engine=engine, bucket=obucket
         ), telemetry.span(
@@ -1227,15 +1490,23 @@ class TpuSpfBackend(SpfBackend):
                     )
                     remarshal = self._last_prepare_how == "miss"
                     mask = self._full_mask(topo, edge_mask)
+                    tt = rr = None
+                    if engine in _TROPICAL_ENGINES:
+                        tt, rr = self._trop_operands(topo, g, edge_mask)
+                    step, args = self._one_step(
+                        engine, kp, g, tt, topo.root, mask, rr
+                    )
                     sig = (
                         g.in_src.shape, g.direct_nh_words.shape[2],
                         topo.n_edges, _mesh_key(), engine, kp,
+                        None if tt is None else tt.tiles.shape,
+                        None if rr is None else rr.shape,
                     )
                     fresh = self._track_compile("one", engine, *sig)
-                    out = step(g, topo.root, mask)
+                    out = step(*args)
             if fresh:
                 entry = profiling.record_cost(
-                    "spf.one", step, g, topo.root, mask, shape_sig=sig,
+                    "spf.one", step, *args, shape_sig=sig,
                 )
                 self._tuner_cost("one", bucket, engine, entry)
                 self._obs_cost("spf.one", "one", engine, obucket, entry)
@@ -1295,23 +1566,13 @@ class TpuSpfBackend(SpfBackend):
                         pad, int(g.in_src.shape[0]), np.int32
                     )
                     seeds_p[: seeds.shape[0]] = seeds
-                    sig = (
-                        g.in_src.shape, g.direct_nh_words.shape[2], pad,
-                        _mesh_key(), kp,
+                    step, out, trop, tt, sig, fresh = self._incr_step(
+                        topo, g, n_atoms, kp, pad, prev_key, prev,
+                        seeds_p,
                     )
-                    fresh = self._track_compile("delta", "incr", *sig)
-                    del self._prev_one[prev_key]
-                    if kp > 1:
-                        step = self._jit_mp_incr_for(kp)
-                        out = step(g, topo.root, prev[0], prev[1], seeds_p)
-                    else:
-                        step = self._jit_incr
-                        out = step(g, topo.root, prev, seeds_p)
             if fresh:
-                cost_args = (
-                    (g, topo.root, out[0], out[1], seeds_p)
-                    if kp > 1
-                    else (g, topo.root, out, seeds_p)
+                cost_args = self._incr_cost_args(
+                    trop, tt, g, topo.root, out, seeds_p, kp
                 )
                 entry = profiling.record_cost(
                     "spf.delta", step, *cost_args, shape_sig=sig,

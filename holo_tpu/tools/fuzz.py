@@ -424,6 +424,101 @@ def multipath_invariants(data: bytes) -> None:
                 assert word >> (a % 32) & 1, f"weighted atom {a} not in set"
 
 
+def tropical_tile_invariants(data: bytes) -> None:
+    """Tropical tile-plane invariants (ISSUE 13; not a wire decoder):
+    the blocked min-plus marshal over arbitrary small topologies must
+    produce planes that are (a) structurally sound — per row block,
+    slot cb ascending with an all-INF sentinel tail, the pos grid a
+    faithful inverse, every padded vertex row/column all-INF — (b)
+    value-faithful — every valid ELL
+    edge's tile entry equals the MIN cost over its parallel group,
+    every entry with no edge INF — and (c) semantically exact — a
+    host-side (numpy) min-plus fixpoint over the tiles reproduces the
+    scalar oracle's distances bit-for-bit.  The device kernel consumes
+    only these planes for its dist phase (parity pinned in
+    tests/test_tropical.py), so marshal invariance is kernel
+    invariance.  Violations raise AssertionError (a crash)."""
+    if len(data) < 4:
+        raise DecodeError("tropical spec: need 4+ bytes (kind,size,seed,b)")
+    import numpy as np  # noqa: PLC0415
+
+    from holo_tpu.ops.graph import INF, build_ell  # noqa: PLC0415
+    from holo_tpu.ops.tropical import (  # noqa: PLC0415
+        _BLOCKS,
+        build_tiles_host,
+    )
+    from holo_tpu.spf import synth  # noqa: PLC0415
+    from holo_tpu.spf.scalar import spf_reference  # noqa: PLC0415
+
+    kind, size, seed = data[0] % 3, 4 + data[1] % 6, data[2]
+    block = _BLOCKS[data[3] % len(_BLOCKS)] if data[3] % 2 else None
+    if kind == 0:
+        topo = synth.ring_topology(size, max_cost=4, seed=seed)
+    elif kind == 1:
+        topo = synth.grid_topology(2, size, max_cost=4, seed=seed)
+    else:
+        topo = synth.random_ospf_topology(
+            n_routers=size + 2, n_networks=2, extra_p2p=size, max_cost=4,
+            seed=seed,
+        )
+    ell = build_ell(topo)
+    if block is not None and block < topo.n_vertices:
+        block = None  # explicit blocks must cover the pow2 cap rule
+    tt, meta = build_tiles_host(
+        ell.in_src, ell.in_cost, ell.in_valid, block=block
+    )
+    nb, tm, b, _ = tt.tiles.shape
+    n = topo.n_vertices
+    assert nb * b >= n, "tile vertex space must cover the graph"
+    assert meta["tm"] == tm and meta["block"] == b and meta["nb"] == nb
+    # (a) structural: per row block, slot cb ascending with sentinel
+    # tail; pos grid is the inverse map; sentinel slots all-INF.
+    rows_, cols_ = np.nonzero(ell.in_valid)
+    for r in range(nb):
+        cbs = [int(c) for c in tt.cb[r]]
+        real = [c for c in cbs if c < nb]
+        assert real == sorted(real), "slot order"
+        assert cbs[len(real):] == [nb] * (tm - len(real)), "sentinel tail"
+        for s_, c in enumerate(real):
+            assert int(tt.pos[r, c]) == s_, "pos inverse"
+            assert int(meta["pos"][r, c]) == s_, "meta pos inverse"
+        for s_ in range(len(real), tm):
+            assert (tt.tiles[r, s_] == INF).all(), "sentinel slot not INF"
+    # (b) value-faithful: dense expected matrix vs tile entries.
+    want = np.full((nb * b, nb * b), INF, np.int64)
+    srcs = ell.in_src[rows_, cols_]
+    costs = ell.in_cost[rows_, cols_]
+    np.minimum.at(want, (rows_, srcs), costs)
+    got = np.full((nb * b, nb * b), INF, np.int64)
+    for r in range(nb):
+        for s_ in range(tm):
+            c = int(tt.cb[r, s_])
+            if c < nb:
+                got[r * b : (r + 1) * b, c * b : (c + 1) * b] = tt.tiles[
+                    r, s_
+                ]
+    assert np.array_equal(got[:n, :n], want[:n, :n]), "tile values"
+    # Padded vertex rows/cols (and uncovered block pairs) stay INF.
+    assert (got[n:] == INF).all() and (got[:, n:] == INF).all(), (
+        "pad sentinel rows/cols must be INF"
+    )
+    # (c) semantic: host min-plus fixpoint == scalar oracle distances.
+    dist = np.full(nb * b, INF, np.int64)
+    dist[topo.root] = 0
+    for _ in range(nb * b):
+        cand = np.where(
+            (got < INF) & (dist[None, :] < INF), got + dist[None, :], INF
+        ).min(axis=1)
+        new = np.minimum(dist, cand)
+        if np.array_equal(new, dist):
+            break
+        dist = new
+    ref = spf_reference(topo)
+    assert np.array_equal(dist[:n], ref.dist.astype(np.int64)), (
+        "tile fixpoint distances != scalar oracle"
+    )
+
+
 # ===== target registry (the reference's fuzz_targets/** inventory) =====
 
 
@@ -514,6 +609,9 @@ def targets() -> dict:
         # Multipath (ISSUE 10): loop-free + weight-consistent parent
         # set / UCMP planes of the multipath oracle.
         "multipath_invariants": multipath_invariants,
+        # Tropical tiles (ISSUE 13): blocked min-plus marshal structure
+        # + value faithfulness + fixpoint-vs-oracle distances.
+        "tropical_tile_invariants": tropical_tile_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

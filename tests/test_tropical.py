@@ -1,0 +1,606 @@
+"""Tropical min-plus matmul SPF engine (ISSUE 13): bit-identical parity
+across every arm, tile-plane invariants, DeltaPath tile updates, tuner
+integration.
+
+The engine contract: the blocked min-plus distance fixpoint plus the
+shared phase-2 machinery must be indistinguishable — bit-for-bit — from
+the scalar oracle and every gather engine, across plain dispatches,
+what-if edge masks (the exact repair-row path), DeltaPath chains (tiles
+updated in place), the sharded mesh, breaker fallback, and the k>1
+multipath planes (the DAG-tile contraction variant).
+"""
+
+import numpy as np
+import pytest
+
+from holo_tpu import pipeline
+from holo_tpu.ops import tropical as trop
+from holo_tpu.ops.graph import INF, build_ell, diff_topologies
+from holo_tpu.ops.spf_engine import device_graph_from_ell, shared_graph_cache
+from holo_tpu.spf.backend import ScalarSpfBackend, TpuSpfBackend
+from holo_tpu.spf.synth import (
+    clone_topology as clone,
+    random_ospf_topology,
+    whatif_link_failure_masks,
+)
+from holo_tpu.testing import no_implicit_transfers
+
+N_ATOMS = 64
+SPF_FIELDS = ("dist", "parent", "hops", "nexthop_words")
+MP_FIELDS = ("parents", "pdist", "pweight", "npaths", "nh_weights")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Transfer sanitizer on every test; shared caches and tuner reset
+    after (the suite shares its process with every tier-1 test)."""
+    shared_graph_cache().clear()
+    with no_implicit_transfers():
+        yield
+    pipeline.reset_engine_tuner()
+    shared_graph_cache().clear()
+
+
+def assert_spf(a, b, msg=""):
+    for f in SPF_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(a, f), getattr(b, f), err_msg=f"{msg}{f}"
+        )
+
+
+def assert_mp(a, b, msg=""):
+    assert_spf(a, b, msg)
+    for f in MP_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(a, f), getattr(b, f), err_msg=f"{msg}{f}"
+        )
+
+
+# -- tile-plane invariants ----------------------------------------------
+
+
+def test_tile_marshal_invariants():
+    """Per row block: slot cb ascending with sentinel tail, pos grid
+    the inverse map; every edge's entry the min over its parallel
+    group; pad rows/cols INF inert."""
+    topo = random_ospf_topology(
+        n_routers=30, n_networks=6, extra_p2p=40, max_cost=4, seed=2
+    )
+    ell = build_ell(topo, n_atoms=N_ATOMS)
+    tt, meta = trop.build_tiles_host(ell.in_src, ell.in_cost, ell.in_valid)
+    nb, tm, b, _ = tt.tiles.shape
+    n = topo.n_vertices
+    assert nb * b >= n
+    assert (meta["tm"], meta["block"], meta["nb"]) == (tm, b, nb)
+    for r in range(nb):
+        cbs = [int(c) for c in tt.cb[r]]
+        real = [c for c in cbs if c < nb]
+        assert real == sorted(real) and len(set(real)) == len(real)
+        assert cbs[len(real):] == [nb] * (tm - len(real))
+        for s, c in enumerate(real):
+            assert int(tt.pos[r, c]) == s
+        for s in range(len(real), tm):
+            assert (tt.tiles[r, s] == int(INF)).all()
+    # Dense expected matrix (min over parallel edges) vs tile entries.
+    want = np.full((nb * b, nb * b), int(INF), np.int64)
+    rows, cols = np.nonzero(ell.in_valid)
+    np.minimum.at(
+        want, (rows, ell.in_src[rows, cols]), ell.in_cost[rows, cols]
+    )
+    got = np.full_like(want, int(INF))
+    for r in range(nb):
+        for s in range(tm):
+            c = int(tt.cb[r, s])
+            if c < nb:
+                got[r * b : (r + 1) * b, c * b : (c + 1) * b] = (
+                    tt.tiles[r, s]
+                )
+    assert np.array_equal(got, want)
+    # Tile-padding sentinels: rows/cols past N carry no edges.
+    assert (got[n:] == int(INF)).all() and (got[:, n:] == int(INF)).all()
+
+
+def test_tile_marshal_edgeless():
+    """E=0 graphs marshal one inert all-INF tile (static shapes)."""
+    from holo_tpu.ops.graph import Topology
+
+    topo = Topology(
+        n_vertices=1,
+        is_router=np.ones(1, bool),
+        edge_src=np.zeros(0, np.int32),
+        edge_dst=np.zeros(0, np.int32),
+        edge_cost=np.zeros(0, np.int32),
+        root=0,
+    )
+    ell = build_ell(topo, n_atoms=N_ATOMS)
+    tt, meta = trop.build_tiles_host(ell.in_src, ell.in_cost, ell.in_valid)
+    assert (tt.tiles == int(INF)).all()
+    scalar = ScalarSpfBackend(N_ATOMS).compute(topo)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute(topo)
+    assert_spf(scalar, got)
+
+
+# -- device ≡ oracle parity, plain + masked arms -------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize(
+    "shape",
+    [
+        dict(n_routers=12, n_networks=0),
+        dict(n_routers=10, n_networks=4),
+        # extra_p2p creates parallel (src, dst) edges: the collapsed
+        # min-tile + repair-row path must stay exact through them.
+        dict(n_routers=40, n_networks=10, extra_p2p=60),
+    ],
+)
+def test_single_spf_parity(seed, shape):
+    topo = random_ospf_topology(seed=seed, **shape)
+    scalar = ScalarSpfBackend(N_ATOMS).compute(topo)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute(topo)
+    assert_spf(scalar, got)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_whatif_batch_parity(seed):
+    """Masked scenarios: the repair rows must reproduce the masked
+    relaxation exactly (failed edges only affect their destinations)."""
+    topo = random_ospf_topology(
+        n_routers=16, n_networks=5, extra_p2p=20, seed=seed
+    )
+    masks = whatif_link_failure_masks(topo, n_scenarios=8, seed=seed)
+    scalar = ScalarSpfBackend(N_ATOMS).compute_whatif(topo, masks)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute_whatif(
+        topo, masks
+    )
+    for i, (s, t) in enumerate(zip(scalar, got)):
+        assert_spf(s, t, msg=f"scenario {i} ")
+
+
+def test_root_disconnect_mask():
+    """Worst-case mask: every root edge failed — repair rows cover the
+    root's whole neighborhood, everything else unreachable."""
+    topo = random_ospf_topology(n_routers=8, n_networks=2, seed=1)
+    mask = np.ones(topo.n_edges, bool)
+    for e in range(topo.n_edges):
+        if topo.edge_src[e] == topo.root or topo.edge_dst[e] == topo.root:
+            mask[e] = False
+    scalar = ScalarSpfBackend(N_ATOMS).compute(topo, mask)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute(topo, mask)
+    assert_spf(scalar, got)
+    unreachable = np.arange(topo.n_vertices) != topo.root
+    assert (got.dist[unreachable] == INF).all()
+
+
+def test_multiroot_parity():
+    topo = random_ospf_topology(n_routers=12, n_networks=3, seed=7)
+    roots = np.array(
+        [i for i in range(topo.n_vertices) if topo.is_router[i]][:4],
+        np.int32,
+    )
+    want = TpuSpfBackend(N_ATOMS).compute_multiroot(topo, roots)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute_multiroot(
+        topo, roots
+    )
+    for f in ("dist", "parent", "hops"):
+        np.testing.assert_array_equal(
+            getattr(want, f), getattr(got, f), err_msg=f
+        )
+
+
+def test_multiroot_masked_parity():
+    """A non-trivial edge mask shared by every root lane must ride the
+    repair-row machinery: tropical_multiroot ≡ spf_multiroot bit-for-
+    bit under the mask (regression: the mask used to skip the distance
+    fixpoint entirely)."""
+    import jax
+
+    from holo_tpu.ops.spf_engine import spf_multiroot
+
+    topo = random_ospf_topology(
+        n_routers=14, n_networks=4, extra_p2p=20, seed=1
+    )
+    mask = np.ones(topo.n_edges, bool)
+    mask[::3] = False  # fail every 3rd edge
+    roots = np.arange(3, dtype=np.int32)
+    ell = build_ell(topo, n_atoms=N_ATOMS)
+    g = jax.device_put(device_graph_from_ell(ell))
+    tt = jax.device_put(
+        trop.build_tiles_host(ell.in_src, ell.in_cost, ell.in_valid)[0]
+    )
+    rr = trop.repair_rows_host(
+        topo.edge_dst, mask[None, :], topo.n_vertices
+    )[0]
+    mask_dev = jax.device_put(mask)
+    rr_dev = jax.device_put(rr)
+    roots_dev = jax.device_put(roots)
+    want = jax.jit(lambda *a: spf_multiroot(*a))(g, roots_dev, mask_dev)
+    got = jax.jit(lambda *a: trop.tropical_multiroot(*a))(
+        g, tt, roots_dev, mask_dev, rr_dev
+    )
+    for f in ("dist", "parent", "hops", "nexthops"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)),
+            np.asarray(getattr(got, f)),
+            err_msg=f,
+        )
+
+
+def test_whatif_chunked_lanes():
+    """The lane-chunked (lax.map) what-if path is bit-identical to the
+    single-chunk program."""
+    import jax
+
+    topo = random_ospf_topology(n_routers=14, n_networks=4, seed=4)
+    masks = whatif_link_failure_masks(topo, n_scenarios=10, seed=4)
+    ell = build_ell(topo, n_atoms=N_ATOMS)
+    g = jax.device_put(device_graph_from_ell(ell))
+    tt = jax.device_put(
+        trop.build_tiles_host(ell.in_src, ell.in_cost, ell.in_valid)[0]
+    )
+    rr = trop.repair_rows_host(topo.edge_dst, masks, topo.n_vertices)
+    # Explicit puts only: the autouse transfer guard stays armed.
+    root = jax.device_put(np.int32(topo.root))
+    masks_dev = jax.device_put(masks)
+    rr_dev = jax.device_put(rr)
+    whole = jax.jit(
+        lambda *a: trop.tropical_whatif_batch(*a)
+    )(g, tt, root, masks_dev, rr_dev)
+    chunked = jax.jit(
+        lambda *a: trop.tropical_whatif_batch(*a, chunk=4)
+    )(g, tt, root, masks_dev, rr_dev)
+    for f in ("dist", "parent", "hops", "nexthops"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(whole, f)),
+            np.asarray(getattr(chunked, f)),
+            err_msg=f,
+        )
+
+
+# -- k>1 multipath (the A-lane consumer) ---------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 8])
+@pytest.mark.parametrize("seed", range(3))
+def test_multipath_parity(k, seed):
+    """mp_tropical (DAG-tile contraction planes) ≡ the scalar multipath
+    oracle, tied weights forcing real ECMP/UCMP mass."""
+    topo = random_ospf_topology(
+        n_routers=20, n_networks=5, extra_p2p=30, max_cost=3, seed=seed
+    )
+    scalar = ScalarSpfBackend(N_ATOMS).compute(topo, multipath_k=k)
+    got = TpuSpfBackend(N_ATOMS, one_engine="tropical").compute(
+        topo, multipath_k=k
+    )
+    assert_mp(scalar, got, msg=f"k={k} ")
+
+
+# -- DeltaPath chains: tiles updated in place ----------------------------
+
+
+def test_delta_chain_parity_and_inplace_tiles():
+    topo = random_ospf_topology(
+        n_routers=18, n_networks=4, extra_p2p=10, max_cost=5, seed=7
+    )
+    be = TpuSpfBackend(N_ATOMS, one_engine="tropical")
+    sc = ScalarSpfBackend(N_ATOMS)
+    assert_spf(sc.compute(topo), be.compute(topo))
+    before = shared_graph_cache().stats()
+    assert before["tropical-entries"] >= 1
+    cur = topo
+    for step in range(8):
+        op = step % 3
+        if op == 0:  # weight change (ids stable)
+            nxt = clone(cur, cost={(step * 3) % cur.n_edges: 1 + step})
+        elif op == 1:  # drop a directed edge pair member
+            keep = np.ones(cur.n_edges, bool)
+            keep[(step * 5) % cur.n_edges] = False
+            nxt = clone(cur, keep=keep)
+        else:  # add a directed edge
+            nxt = clone(
+                cur, extra=[[step % cur.n_vertices, (step + 3) % cur.n_vertices, 2, -1]]
+            )
+        d = diff_topologies(cur, nxt)
+        assert d is not None
+        nxt.link_delta(d)
+        assert_spf(sc.compute(nxt), be.compute(nxt), msg=f"step {step} ")
+        cur = nxt
+    stats = shared_graph_cache().stats()
+    assert stats["deltas-applied"] >= 8, stats
+    # The chain kept a live tile attachment (or lazily rebuilt one):
+    # the final entry serves tropical without a full re-marshal.
+    assert stats["tropical-entries"] >= 1, stats
+
+
+def test_delta_overload_strikes_tiles():
+    """A transit strike must mask the struck vertex's tile COLUMNS in
+    place — the relaxation may still reach it, never through it."""
+    from holo_tpu.ops.graph import TopologyDelta
+
+    topo = random_ospf_topology(n_routers=14, n_networks=3, seed=9)
+    be = TpuSpfBackend(N_ATOMS, one_engine="tropical")
+    sc = ScalarSpfBackend(N_ATOMS)
+    be.compute(topo)
+    strike = next(
+        v
+        for v in range(topo.n_vertices)
+        if topo.is_router[v] and v != topo.root
+    )
+    keep = topo.edge_src != strike
+    nxt = clone(topo, keep=keep)
+    nxt.link_delta(
+        TopologyDelta(
+            base_key=topo.cache_key,
+            overload=np.asarray([strike], np.int32),
+            ids_stable=False,
+        )
+    )
+    assert_spf(sc.compute(nxt), be.compute(nxt))
+
+
+# -- sharded mesh arms ---------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_mesh_parity(shape):
+    """Every tropical arm under a process mesh (batch- and node-
+    sharded): one, delta chain (tiles updated in place under the
+    mesh), what-if, multipath, multiroot — byte-identical to the
+    scalar oracle.  Runs on the conftest's forced 8-device virtual CPU
+    platform."""
+    from holo_tpu.parallel.mesh import (
+        configure_process_mesh,
+        process_mesh,
+        reset_process_mesh,
+    )
+
+    topo = random_ospf_topology(
+        n_routers=20, n_networks=5, extra_p2p=12, max_cost=4, seed=3
+    )
+    masks = whatif_link_failure_masks(topo, 6, seed=2)
+    sc = ScalarSpfBackend(N_ATOMS)
+    roots = np.array(
+        [i for i in range(topo.n_vertices) if topo.is_router[i]][:5],
+        np.int32,
+    )
+    configure_process_mesh(*shape)
+    try:
+        be = TpuSpfBackend(N_ATOMS, one_engine="tropical")
+        assert_spf(sc.compute(topo), be.compute(topo), msg="one ")
+        cur = topo
+        for step in range(3):
+            nxt = clone(cur, cost={(step * 5) % cur.n_edges: 2 + step})
+            d = diff_topologies(cur, nxt)
+            nxt.link_delta(d)
+            assert_spf(
+                sc.compute(nxt), be.compute(nxt), msg=f"delta{step} "
+            )
+            cur = nxt
+        for a, b in zip(
+            sc.compute_whatif(topo, masks), be.compute_whatif(topo, masks)
+        ):
+            assert_spf(a, b, msg="whatif ")
+        assert_mp(
+            sc.compute(topo, multipath_k=4),
+            be.compute(topo, multipath_k=4),
+            msg="mp ",
+        )
+        mr_s = sc.compute_multiroot(topo, roots)
+        mr_t = be.compute_multiroot(topo, roots)
+        for f in ("dist", "parent", "hops"):
+            np.testing.assert_array_equal(
+                getattr(mr_s, f), getattr(mr_t, f), err_msg=f"mr {f}"
+            )
+    finally:
+        reset_process_mesh()
+    assert process_mesh() is None
+
+
+# -- breaker fallback arm ------------------------------------------------
+
+
+def test_breaker_fallback_bit_identical():
+    from holo_tpu.resilience import CircuitBreaker, FaultPlan, inject
+
+    topo = random_ospf_topology(n_routers=14, n_networks=4, seed=3)
+    masks = whatif_link_failure_masks(topo, n_scenarios=6, seed=3)
+    scalar = ScalarSpfBackend(N_ATOMS).compute_whatif(topo, masks)
+    be = TpuSpfBackend(
+        N_ATOMS,
+        one_engine="tropical",
+        breaker=CircuitBreaker("tropical-parity-fallback"),
+    )
+    with inject(FaultPlan(dispatch_fail={"spf.dispatch": 1})) as inj:
+        got = be.compute_whatif(topo, masks)
+    assert inj.injected["spf.dispatch"] == 1
+    for s, t in zip(scalar, got):
+        assert_spf(s, t)
+    assert be.breaker.state == "closed"
+    got2 = be.compute_whatif(topo, masks)  # healthy: device path again
+    for s, t in zip(scalar, got2):
+        assert_spf(s, t)
+    assert be.breaker.consecutive_failures == 0
+
+
+# -- tuner integration ---------------------------------------------------
+
+
+def test_tuner_explores_tropical_and_mp_family():
+    """The armed tuner A/Bs tropical per shape bucket (kind one/whatif)
+    and the mp pair for k>1 single dispatches — results bit-identical
+    throughout, so the flips are latency-only."""
+    from holo_tpu.pipeline.tuner import ENGINES, MP_ENGINES
+
+    t = pipeline.configure_engine_tuner(explore_rounds=1, reprobe_every=0)
+    topo = random_ospf_topology(n_routers=14, n_networks=4, seed=1)
+    sc = ScalarSpfBackend(N_ATOMS)
+    be = TpuSpfBackend(N_ATOMS)
+    ref = sc.compute(topo)
+    for i in range(2 * len(ENGINES) + 2):
+        assert_spf(ref, be.compute(topo), msg=f"one i={i} ")
+    mref = sc.compute(topo, multipath_k=8)
+    for i in range(2 * len(MP_ENGINES) + 2):
+        assert_mp(mref, be.compute(topo, multipath_k=8), msg=f"mp i={i} ")
+    measured = set()
+    for v in t.stats()["winners"].values():
+        measured |= set(v["measured-engines"])
+    assert "tropical" in measured
+    assert {"mp", "mp_tropical"} <= measured
+
+
+def test_tuner_bucket_keying_mp_candidates():
+    """Candidate sets per bucket: k=1 buckets choose among the gather +
+    tropical family; k>1 kind=one among the mp pair; k>1 what-if stays
+    mp-only (the per-scenario DAG-tile scatter would multiply by B)."""
+    from holo_tpu.pipeline.tuner import (
+        ENGINES,
+        MP_ENGINES,
+        EngineTuner,
+        shape_bucket,
+    )
+
+    t = EngineTuner(explore_rounds=1, reprobe_every=0)
+    b1 = shape_bucket(1000, 4000, 1, None, k=1)
+    b8 = shape_bucket(1000, 4000, 1, None, k=8)
+    assert t._candidates("one", b1) == ENGINES
+    assert "tropical" in t._candidates("whatif", b1)
+    assert t._candidates("one", b8) == MP_ENGINES
+    assert t._candidates("whatif", b8) == ("mp",)
+    # mp-family winners stand on their own bucket.
+    t.observe("one", b8, "mp", 2.0)
+    t.observe("one", b8, "mp_tropical", 0.5)
+    assert t.current_winner("one", b8) == "mp_tropical"
+    assert t.current_winner("one", b1) is None  # never measured
+
+
+def test_tuner_table_v2_discarded(tmp_path):
+    """Version migration: a persisted v2 table (pre-tropical engine
+    set) must be discarded cleanly — the tuner re-learns instead of
+    exploiting winners measured over the old candidate set."""
+    import json
+
+    from holo_tpu.pipeline.tuner import TABLE_VERSION, EngineTuner
+
+    assert TABLE_VERSION == 3
+    p = tmp_path / "tuner.json"
+    p.write_text(
+        json.dumps(
+            {
+                "version": 2,
+                "engines": ["seq", "fused", "packed", "hybrid"],
+                "buckets": {
+                    '["one", 1024, 4096, 1, null, 1]': {
+                        "dispatches": 99,
+                        "winner": "seq",
+                        "samples": {"seq": [0.001]},
+                        "cost": {},
+                    }
+                },
+                "depth": {},
+            }
+        )
+    )
+    t = EngineTuner(path=p)
+    assert not t._loaded
+    assert t.stats()["buckets"] == 0
+    # A fresh save/load round-trips at v3.
+    assert t.save()
+    t2 = EngineTuner(path=p)
+    assert t2._loaded
+
+
+def test_explain_ledger_win_basis_for_tropical():
+    """`holo-tpu-tools explain` surfaces WHY tropical wins or loses a
+    bucket on the cost model's axes ("won on flops, not bytes")."""
+    from holo_tpu.pipeline.tuner import EngineTuner, shape_bucket
+
+    t = EngineTuner(explore_rounds=1, reprobe_every=0)
+    b = shape_bucket(10000, 700000, 128, None, k=1)
+    # Tropical: more flops, fewer bytes, fastest wall (the MXU story).
+    t.cost_prior("whatif", b, "tropical", {"flops": 9e9, "bytes": 1e8})
+    t.cost_prior("whatif", b, "seq", {"flops": 1e9, "bytes": 9e8})
+    t.observe("whatif", b, "seq", 0.200)
+    t.observe("whatif", b, "tropical", 0.020)
+    row = next(r for r in t.ledger() if r["kind"] == "whatif")
+    assert row["winner"] == "tropical"
+    assert row["basis"] == "tropical beat seq on bytes"
+    assert row["engines"]["tropical"]["cost"]["flops"] == 9e9
+
+
+def test_incremental_routes_through_tropical_winner():
+    """A bucket whose measured full-dispatch winner is tropical routes
+    its DeltaPath incremental kernel through the tiles (and stays
+    bit-identical)."""
+    from holo_tpu.pipeline.tuner import shape_bucket
+
+    t = pipeline.configure_engine_tuner(explore_rounds=1, reprobe_every=0)
+    topo = random_ospf_topology(n_routers=16, n_networks=4, seed=5)
+    from holo_tpu.parallel.mesh import mesh_cache_key
+
+    b = shape_bucket(
+        topo.n_vertices, topo.n_edges, 1, mesh_cache_key(), k=1
+    )
+    # Pre-seed measurements so exploit picks tropical immediately.
+    for e, wall in (
+        ("seq", 0.1), ("fused", 0.1), ("packed", 0.1),
+        ("hybrid", 0.1), ("tropical", 0.001),
+    ):
+        t.observe("one", b, e, wall)
+    be = TpuSpfBackend(N_ATOMS)
+    sc = ScalarSpfBackend(N_ATOMS)
+    assert be._trop_incremental(topo, 1)
+    assert_spf(sc.compute(topo), be.compute(topo))
+    nxt = clone(topo, cost={0: 7})
+    d = diff_topologies(topo, nxt)
+    nxt.link_delta(d)
+    assert_spf(sc.compute(nxt), be.compute(nxt))
+
+
+def test_fuzz_target_registered():
+    from holo_tpu.tools.fuzz import targets, tropical_tile_invariants
+
+    assert targets()["tropical_tile_invariants"] is tropical_tile_invariants
+    # One seeded pass of the invariant body (the coverage loop rides
+    # tests/test_fuzz_coverage.py).
+    tropical_tile_invariants(bytes([2, 3, 5, 1]))
+
+
+# -- SRLG satellite ------------------------------------------------------
+
+
+def test_srlg_bits_and_interface_wiring():
+    from holo_tpu.protocols.ospf.spf_run import (
+        apply_interface_srlg,
+        srlg_bits,
+    )
+
+    assert srlg_bits(()) == 0
+    assert srlg_bits((0, 3)) == 0b1001
+    assert srlg_bits((35,)) == srlg_bits((3,))  # mod-32 fold
+    topo = random_ospf_topology(n_routers=8, n_networks=2, seed=0)
+    atom_ifnames = []
+    n_atoms = int(topo.edge_direct_atom.max()) + 1
+    atom_ifnames = [
+        ("eth0" if a % 2 == 0 else "eth1") for a in range(n_atoms)
+    ]
+    apply_interface_srlg(topo, atom_ifnames, {"eth0": srlg_bits((1, 2))})
+    for e in range(topo.n_edges):
+        a = int(topo.edge_direct_atom[e])
+        want = (
+            srlg_bits((1, 2))
+            if a >= 0 and atom_ifnames[a] == "eth0"
+            else 0
+        )
+        assert int(topo.edge_srlg[e]) == want, f"edge {e}"
+
+
+def test_srlg_interface_config_fields():
+    """The fast-reroute SRLG seam exists on every protocol's interface
+    config (OSPFv2/v3 + IS-IS) — the ROADMAP carry-over's config
+    surface."""
+    from holo_tpu.protocols.isis.instance import IsisIfConfig
+    from holo_tpu.protocols.ospf.instance_v3 import V3IfConfig
+    from holo_tpu.protocols.ospf.interface import IfConfig
+
+    for cls in (IfConfig, V3IfConfig, IsisIfConfig):
+        assert cls().srlg == ()

@@ -67,7 +67,8 @@ def test_schedule_replays_identically():
             e = t.pick("one", B)
             picks.append(e)
             t.observe("one", B, e, {"seq": 3.0, "fused": 2.0,
-                                    "packed": 4.0, "hybrid": 1.0}[e])
+                                    "packed": 4.0, "hybrid": 1.0,
+                                    "tropical": 5.0}[e])
         return picks
 
     assert run() == run(), "tuner schedule must be RNG-free deterministic"
