@@ -17,6 +17,16 @@ our telemetry can only observe *after the fact* —
   locks held across blocking calls, and callback/publish invocation
   while holding a lock — a deadlock class the native TSan job cannot
   see.
+- **Lifetime/sharding rules (ISSUE 14)**: HL109 use-after-donate over
+  the ``donate_argnums`` dispatch seams (paired with the runtime
+  donation guard in :mod:`holo_tpu.analysis.runtime`), HL110
+  unconstrained lax-loop carries in replication-fenced mesh modules
+  (the PR-13 GSPMD miscompile as a rule), and HL205 cross-thread
+  publication without an approved seam (warn-tier soak).
+
+Repeat runs ride the all-or-nothing incremental cache
+(:mod:`holo_tpu.analysis.cache`): an unchanged tree replays the stored
+result; any edit, or any change to this package, rescans everything.
 
 Entry points:
 
@@ -38,12 +48,19 @@ baseline entries are fixed and removed.
 
 from __future__ import annotations
 
+from holo_tpu.analysis.cache import (  # noqa: F401 — public API
+    default_cache_path,
+    ruleset_fingerprint,
+    run_paths_cached,
+    self_check,
+)
 from holo_tpu.analysis.core import (  # noqa: F401 — public API
     Finding,
     LintConfig,
     LintResult,
     Rule,
     all_rules,
+    audit_suppressions,
     compare_to_baseline,
     default_baseline_path,
     gate_findings,

@@ -1,0 +1,284 @@
+"""holo-lint incremental cache: skip the scan when the tree is clean.
+
+The tier-1 gate runs the linter TWICE per verify (the CLI arm in
+``tools/lint.sh`` and the in-pytest arm in
+``tests/test_lint_repo_clean.py``) over a module set that keeps
+growing, and the second run always sees the exact bytes the first one
+just scanned.  This module makes that second run ~free: a cache file
+records, per ``(file, ruleset fingerprint)``, the mtime/size/sha256 of
+every module plus the full serialized :class:`~holo_tpu.analysis.core.
+LintResult`, and a run whose tree validates byte-for-byte replays the
+stored result instead of re-scanning.
+
+Soundness over cleverness: holo-lint's headline rules are
+*cross-module* (HL108's imported-helper taint, HL109's donation index,
+HL110's mesh-jit closure), so one changed file can flip findings in a
+module that did not change.  Per-file finding replay is therefore
+unsound by construction; the cache is all-or-nothing instead — ANY
+mismatch (content, file set, ruleset version) is a cache miss and the
+whole tree rescans.  That is exactly the contract the gate needs:
+unchanged tree -> replay, changed tree -> full scan, never a stale
+finding.
+
+Validation ladder per file: mtime_ns+size equal -> trust (no read);
+else sha256 of the bytes -> equal means a touch-without-edit (the
+entry's stat is refreshed in place); else miss.  The fingerprint hashes
+every ``holo_tpu/analysis/*.py`` source, so editing ANY rule, the
+scope config, or this module invalidates every cache on disk.
+
+:func:`self_check` runs cached and cold back to back and diffs the
+rendered findings — the loud-failure mode the in-pytest gate uses to
+prove the replay is byte-identical to a real scan.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from holo_tpu.analysis.core import (
+    Finding,
+    LintConfig,
+    LintResult,
+    Rule,
+    collect_files,
+    run_paths,
+    run_sources,
+)
+
+# Bump when the cache document layout changes (readers reject other
+# versions and fall back to a cold scan).
+CACHE_VERSION = 1
+
+
+def ruleset_fingerprint() -> str:
+    """Hash of every analysis-package source file.
+
+    The cache key's "rule-set version" half: any edit to a rule, the
+    core machinery, the scope prefixes, or the cache itself must
+    invalidate stored findings — hashing the package's own bytes needs
+    no manually-bumped version constant that someone would forget."""
+    pkg = Path(__file__).resolve().parent
+    h = hashlib.sha256()
+    for p in sorted(pkg.glob("*.py")):
+        h.update(p.name.encode())
+        h.update(b"\0")
+        h.update(p.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def default_cache_path(root: Path) -> Path:
+    return root / ".holo_lint_cache.json"
+
+
+# -- (de)serialization --------------------------------------------------
+
+
+def _finding_doc(f: Finding) -> dict:
+    return {
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "context": f.context,
+        "message": f.message,
+        "severity": f.severity,
+    }
+
+
+def _finding_from(d: dict) -> Finding:
+    return Finding(
+        rule=d["rule"],
+        path=d["path"],
+        line=int(d["line"]),
+        context=d["context"],
+        message=d["message"],
+        severity=d.get("severity", "error"),
+    )
+
+
+def _result_doc(result: LintResult) -> dict:
+    return {
+        "findings": [_finding_doc(f) for f in result.findings],
+        "suppressed": [_finding_doc(f) for f in result.suppressed],
+        "suppression_sites": [
+            list(site) for site in result.suppression_sites
+        ],
+        "rule_seconds": result.rule_seconds,
+        "files_checked": result.files_checked,
+    }
+
+
+def _result_from(d: dict) -> LintResult:
+    return LintResult(
+        findings=[_finding_from(x) for x in d["findings"]],
+        suppressed=[_finding_from(x) for x in d["suppressed"]],
+        parse_errors=[],
+        files_checked=int(d["files_checked"]),
+        suppression_sites=[
+            (p, int(line), rid)
+            for p, line, rid in d["suppression_sites"]
+        ],
+        rule_seconds=dict(d.get("rule_seconds", {})),
+    )
+
+
+def _load(path: Path) -> dict | None:
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != CACHE_VERSION:
+        return None
+    return doc
+
+
+def _save(path: Path, doc: dict) -> None:
+    try:
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(doc) + "\n")
+        tmp.replace(path)
+    except OSError:
+        # Read-only checkout / parallel writer: the cache is an
+        # optimization, never a correctness dependency.
+        pass
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# -- the cached runner --------------------------------------------------
+
+
+def run_paths_cached(
+    paths: list[Path],
+    root: Path,
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+    cache_path: Path | None = None,
+) -> LintResult:
+    """:func:`~holo_tpu.analysis.core.run_paths` behind the
+    all-or-nothing cache.
+
+    Replay sets ``result.files_cached == result.files_checked`` (every
+    module skipped); a cold scan leaves ``files_cached == 0`` and
+    rewrites the cache — except when custom ``rules`` are in play
+    (fixture subsets must never poison the full-registry cache)."""
+    config = config or LintConfig()
+    cache_path = cache_path or default_cache_path(root)
+    if rules is not None:
+        return run_paths(paths, root, config, rules)
+    files = collect_files(paths, root, config)
+    fingerprint = ruleset_fingerprint()
+    doc = _load(cache_path)
+    if (
+        doc is not None
+        and doc.get("fingerprint") == fingerprint
+        and set(doc.get("files", {})) == {rel for _, rel in files}
+    ):
+        entries = doc["files"]
+        stat_refreshed = False
+        valid = True
+        for f, rel in files:
+            ent = entries[rel]
+            try:
+                st = f.stat()
+            except OSError:
+                valid = False
+                break
+            if (
+                st.st_mtime_ns == ent["mtime_ns"]
+                and st.st_size == ent["size"]
+            ):
+                continue
+            if _sha256(f.read_bytes()) == ent["sha256"]:
+                # Touched, not edited: refresh the stat so the next
+                # run takes the no-read fast path again.
+                ent["mtime_ns"] = st.st_mtime_ns
+                ent["size"] = st.st_size
+                stat_refreshed = True
+                continue
+            valid = False
+            break
+        if valid:
+            result = _result_from(doc["result"])
+            result.files_cached = result.files_checked
+            if stat_refreshed:
+                _save(cache_path, doc)
+            return result
+    # Cold scan: read each file's bytes ONCE, hash those exact bytes,
+    # and lint the decoded text — the stored sha is then always paired
+    # with the findings it produced, even if the file is edited while
+    # the scan runs (re-reading after the scan would pair the NEW
+    # content's hash with the OLD content's findings: a stale replay).
+    sources: list[tuple[str, str]] = []
+    entries: dict[str, dict] = {}
+    cacheable = True
+    for f, rel in files:
+        try:
+            st = f.stat()
+            data = f.read_bytes()
+        except OSError:
+            cacheable = False  # racing tree mutation: don't cache it
+            continue
+        sources.append((rel, data.decode()))
+        entries[rel] = {
+            "mtime_ns": st.st_mtime_ns,
+            "size": st.st_size,
+            "sha256": _sha256(data),
+        }
+    result = run_sources(sources, config, rules)
+    if cacheable and not result.parse_errors:
+        _save(
+            cache_path,
+            {
+                "version": CACHE_VERSION,
+                "fingerprint": fingerprint,
+                "files": entries,
+                "result": _result_doc(result),
+            },
+        )
+    return result
+
+
+def self_check(
+    paths: list[Path],
+    root: Path,
+    config: LintConfig | None = None,
+    cache_path: Path | None = None,
+) -> list[str]:
+    """Prove the cache replays exactly what a real scan produces.
+
+    Runs the cached path, then a cold scan of the same tree, and
+    renders both finding sets (plus the suppressed set and the
+    suppression sites — the audit surface must match too).  Returns a
+    list of human-readable mismatch lines; empty means the cache is
+    faithful.  The in-pytest gate calls this so a cache bug fails
+    tier-1 loudly instead of silently passing a stale verdict."""
+    cached = run_paths_cached(paths, root, config, cache_path=cache_path)
+    cold = run_paths(paths, root, config)
+
+    def view(result: LintResult) -> list[str]:
+        lines = [f.render() for f in result.findings]
+        lines += [f"suppressed: {f.render()}" for f in result.suppressed]
+        lines += [
+            f"site: {p}:{line}={rid}"
+            for p, line, rid in result.suppression_sites
+        ]
+        return lines
+
+    a, b = view(cached), view(cold)
+    if a == b:
+        return []
+    out = []
+    for line in b:
+        if line not in a:
+            out.append(f"cold scan only: {line}")
+    for line in a:
+        if line not in b:
+            out.append(f"cached replay only: {line}")
+    if not out:
+        out.append("finding order diverged between cached and cold runs")
+    return out

@@ -23,8 +23,11 @@ from pathlib import Path
 
 # -- suppression syntax -------------------------------------------------
 
-# `# holo-lint: disable=HL101` (same line or the line above the finding).
-# Multiple ids comma-separated; `disable=all` silences every rule.
+# `# holo-lint: disable=<id>` (same line or the line above the
+# finding).  Multiple ids comma-separated; `disable=all` silences every
+# rule.  (The placeholder above deliberately fails _SUPPRESS_RE — a
+# literal rule id in this comment would register as a suppression site
+# and rot under the --check-suppressions audit.)
 _SUPPRESS_RE = re.compile(r"#\s*holo-lint:\s*disable=([A-Za-z0-9_,\s-]+)")
 
 
@@ -104,6 +107,12 @@ SHARED_STATE_PREFIXES = (
     "holo_tpu/utils/txqueue.py",
     "holo_tpu/telemetry",
 )
+# HL205 (cross-thread publication) adds the async dispatch pipeline to
+# the thread-shared surface: its worker thread publishes results and
+# stats that actor/provider code reads.
+PUBLICATION_PREFIXES = CONCURRENCY_PREFIXES + (
+    "holo_tpu/pipeline",
+)
 # HL106 (swallow-and-continue) runs where a silently eaten exception
 # becomes silent wrong routing state: the dispatch modules, the actor
 # runtime + everything hosting actor handlers (daemon, protocols), the
@@ -127,6 +136,7 @@ class LintConfig:
     concurrency_prefixes: tuple[str, ...] = CONCURRENCY_PREFIXES
     shared_state_prefixes: tuple[str, ...] = SHARED_STATE_PREFIXES
     swallow_prefixes: tuple[str, ...] = SWALLOW_PREFIXES
+    publication_prefixes: tuple[str, ...] = PUBLICATION_PREFIXES
     exclude_parts: tuple[str, ...] = ("__pycache__",)
 
     def in_dispatch_scope(self, relpath: str) -> bool:
@@ -140,6 +150,9 @@ class LintConfig:
 
     def in_swallow_scope(self, relpath: str) -> bool:
         return relpath.startswith(self.swallow_prefixes)
+
+    def in_publication_scope(self, relpath: str) -> bool:
+        return relpath.startswith(self.publication_prefixes)
 
 
 # -- module model -------------------------------------------------------
@@ -271,8 +284,10 @@ def all_rules() -> list[Rule]:
     """Instantiate the full registry (import is deferred so `core` has
     no circular dependency on the rule modules)."""
     from holo_tpu.analysis import (
+        rules_donation,
         rules_locks,
         rules_resilience,
+        rules_sharding,
         rules_tracer,
         rules_xmodule,
     )
@@ -282,6 +297,8 @@ def all_rules() -> list[Rule]:
         for cls in (
             rules_tracer.RULES
             + rules_xmodule.RULES
+            + rules_donation.RULES
+            + rules_sharding.RULES
             + rules_resilience.RULES
             + rules_locks.RULES
         )
@@ -297,6 +314,17 @@ class LintResult:
     suppressed: list[Finding] = field(default_factory=list)
     parse_errors: list[str] = field(default_factory=list)
     files_checked: int = 0
+    # Every `# holo-lint: disable=<id>` comment seen, as (path, line,
+    # rule id) — the suppression-audit surface (--check-suppressions).
+    suppression_sites: list[tuple[str, int, str]] = field(
+        default_factory=list
+    )
+    # Wall seconds per rule id, accumulated across modules (surfaced
+    # in the --json report so the sentinel ledger can track lint cost).
+    rule_seconds: dict[str, float] = field(default_factory=dict)
+    # Incremental-cache accounting (run_paths fills it when a cache is
+    # in play; 0/None otherwise).
+    files_cached: int = 0
 
 
 def run_sources(
@@ -308,6 +336,8 @@ def run_sources(
     shared core of :func:`run_source` / :func:`run_paths`, and the
     fixture surface for cross-module rules (several modules in one
     call)."""
+    import time as _time
+
     config = config or LintConfig()
     rules = rules if rules is not None else all_rules()
     result = LintResult()
@@ -322,6 +352,9 @@ def run_sources(
             continue
         mods.append(mod)
         by_path[mod.relpath] = mod
+        for line, ids in sorted(mod.suppressions.items()):
+            for rid in sorted(ids):
+                result.suppression_sites.append((relpath, line, rid))
 
     def record(f: Finding) -> None:
         owner = by_path.get(f.path)
@@ -330,16 +363,22 @@ def run_sources(
         else:
             result.findings.append(f)
 
+    def timed(rule: Rule, run) -> None:
+        t0 = _time.perf_counter()
+        for f in run():
+            record(f)
+        result.rule_seconds[rule.id] = result.rule_seconds.get(
+            rule.id, 0.0
+        ) + (_time.perf_counter() - t0)
+
     for mod in mods:
         for rule in rules:
             if rule.cross_module:
                 continue
-            for f in rule.check(mod):
-                record(f)
+            timed(rule, lambda r=rule, m=mod: r.check(m))
     for rule in rules:
         if rule.cross_module:
-            for f in rule.check_project(mods):
-                record(f)
+            timed(rule, lambda r=rule: r.check_project(mods))
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return result
 
@@ -355,13 +394,14 @@ def run_source(
     return run_sources([(relpath, source)], config, rules)
 
 
-def run_paths(
-    paths: list[Path],
-    root: Path,
-    config: LintConfig | None = None,
-    rules: list[Rule] | None = None,
-) -> LintResult:
-    """Lint every ``*.py`` under ``paths``; relpaths are vs ``root``."""
+def collect_files(
+    paths: list[Path], root: Path, config: LintConfig | None = None
+) -> list[tuple[Path, str]]:
+    """``(file, relpath)`` for every lintable ``*.py`` under ``paths``
+    — the shared file walk of :func:`run_paths` and the incremental
+    cache in :mod:`holo_tpu.analysis.cache` (both must agree on the
+    file set or the cache would validate against a different tree than
+    the scan reads)."""
     config = config or LintConfig()
     files: list[Path] = []
     for p in paths:
@@ -369,7 +409,7 @@ def run_paths(
             files.extend(sorted(p.rglob("*.py")))
         elif p.suffix == ".py":
             files.append(p)
-    sources: list[tuple[str, str]] = []
+    out: list[tuple[Path, str]] = []
     for f in files:
         if any(part in config.exclude_parts for part in f.parts):
             continue
@@ -382,7 +422,22 @@ def run_paths(
             posix = f.as_posix()
             idx = posix.rfind("/holo_tpu/")
             rel = posix[idx + 1:] if idx >= 0 else posix
-        sources.append((rel, f.read_text()))
+        out.append((f, rel))
+    return out
+
+
+def run_paths(
+    paths: list[Path],
+    root: Path,
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+) -> LintResult:
+    """Lint every ``*.py`` under ``paths``; relpaths are vs ``root``."""
+    config = config or LintConfig()
+    sources = [
+        (rel, f.read_text())
+        for f, rel in collect_files(paths, root, config)
+    ]
     return run_sources(sources, config, rules)
 
 
@@ -430,6 +485,39 @@ def write_baseline(path: Path, findings: list[Finding]) -> None:
         ],
     }
     path.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+# -- suppression audit --------------------------------------------------
+
+
+def audit_suppressions(result: LintResult) -> list[str]:
+    """Stale ``# holo-lint: disable=<id>`` comments: sites whose rule
+    no longer fires on that line.
+
+    A suppression comment at line L covers findings at L (same line)
+    and L+1 (line above the finding) — see :meth:`ModuleInfo.
+    suppressed`.  A site with no matching *suppressed* finding is rot:
+    the hazard was fixed (or the rule changed) and the comment now
+    silences nothing, which corrodes the audit trail the next reader
+    trusts.  ``disable=all`` sites are audited the same way (any
+    suppressed finding on the covered lines keeps them live).
+    Returns human-readable ``path:line: <id>`` descriptions.
+    """
+    live: set[tuple[str, int, str]] = set()
+    for f in result.suppressed:
+        for line in (f.line, f.line - 1):
+            live.add((f.path, line, f.rule))
+            live.add((f.path, line, "all"))
+    stale: list[str] = []
+    for path, line, rid in result.suppression_sites:
+        if (path, line, rid) not in live:
+            what = (
+                "disable=all silences nothing on this line"
+                if rid == "all"
+                else f"disable={rid} — {rid} no longer fires here"
+            )
+            stale.append(f"{path}:{line}: stale suppression ({what})")
+    return stale
 
 
 def compare_to_baseline(

@@ -1,0 +1,610 @@
+"""holo-lint donation-lifetime rule (HL109): use-after-donate.
+
+``jax.jit(..., donate_argnums=/donate_argnames=)`` transfers buffer
+ownership to the kernel: the donated actual argument is CONSUMED by the
+dispatch and must never be read, re-dispatched, or retained afterwards.
+The repo's DeltaPath discipline makes donated residents pervasive
+(``_prev_one`` seeds, the resident-graph scatter), and the contract has
+so far lived only as a runtime convention ("at most ONE in-flight entry
+per key").  This rule makes it compile-time.
+
+Two-pass :class:`~holo_tpu.analysis.core.ProjectRule` (the HL108
+machinery):
+
+Pass 1 — the **donation index** over every module:
+
+* *direct* donating callables — names/attributes assigned a
+  ``jax.jit(..., donate_argnums=...)`` (module level or ``self._attr``),
+  and ``@property`` getters whose body builds one (the
+  ``_jit_trop_incr`` idiom: reading the attribute yields the jit);
+* *factories* — functions whose body builds and returns a donating jit
+  (``_jit_mp_incr_for``-style per-width caches): *calling* the factory
+  yields a donating callable;
+* *helpers* — functions that pass one of their OWN parameters at a
+  donated position of a donating callable (``_incr_step``-style
+  dispatch fan-ins): calling the helper donates the actual argument.
+  Helper indexing iterates so a helper-of-a-helper propagates.
+
+Pass 2 — every function in dispatch scope, statements in line order:
+a call that resolves to a donating callable/factory-result/helper
+taints the donated actual arguments' roots (``prev``, ``base.graph``);
+any LATER read, re-dispatch, or retention (``self._prev[k] = prev``) of
+a tainted root flags.  Rebinding the name kills the taint.  Exemptions
+share vocabulary with the runtime guard in
+:mod:`holo_tpu.analysis.runtime`: reads inside a ``with
+consumes_donated(...):`` window — the legitimate re-deposit seams —
+and arguments of the guard's own ``note_donated(...)`` seam calls are
+exempt, exactly as ``sanctioned_transfer`` exempts HL101.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, ProjectRule, dotted
+
+_JIT_CTORS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+# Guard-seam calls whose arguments legitimately read a donated name
+# (they poison/account it — that IS the contract, not a violation).
+_GUARD_CALLS = {"note_donated", "consumes_donated"}
+_CONSUME_MARKER = "consumes_donated"
+
+
+def _donation_kwargs(call: ast.Call) -> tuple[tuple[int, ...], tuple[str, ...]] | None:
+    """(donated positional indexes, donated names) of a jit ctor call,
+    or None when the call donates nothing."""
+    if dotted(call.func) not in _JIT_CTORS:
+        return None
+    nums: tuple[int, ...] = ()
+    names: tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = _str_tuple(kw.value)
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+def _int_tuple(node: ast.expr) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.expr) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _expr_root(node: ast.expr) -> str | None:
+    """Stable textual root of an lvalue/rvalue chain: ``prev`` for
+    ``prev[0]`` / ``prev.dist``; ``base.graph`` for ``base.graph`` —
+    a Name, or a Name.attr two-segment chain (deeper chains root at
+    the two-segment prefix so ``base.graph`` and ``base.mirror`` stay
+    distinct tokens)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Name):
+            return f"{base.id}.{node.attr}"
+        # self.x.y / deeper: root at the innermost two segments we can
+        # name; give up otherwise (no taint — conservative).
+        inner = _expr_root(base)
+        if inner is not None and "." not in inner:
+            return f"{inner}.{node.attr}"
+    return None
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (list(a.posonlyargs) + list(a.args))]
+
+
+def _is_property(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dotted(dec) or ""
+        if d == "property" or d.endswith(".getter"):
+            return True
+    return False
+
+
+def _module_relpath(dotted_mod: str) -> str:
+    return dotted_mod.replace(".", "/") + ".py"
+
+
+class _DonationIndex:
+    """Pass 1: the project-wide donation index.
+
+    ``direct``: bare callable name -> argnums (calling the name runs a
+    donating jit — covers module constants, ``self._attr`` jit caches,
+    and property getters).  ``factories``: function name -> argnums
+    (calling it RETURNS a donating jit).  ``helpers``: (module relpath,
+    function name) -> {param -> donated-by} for functions that donate a
+    parameter onward; bare-name view in ``helper_names`` for
+    same-module resolution.
+    """
+
+    def __init__(self, mods: list[ModuleInfo]):
+        self.direct: dict[str, tuple[int, ...]] = {}
+        self.direct_names: dict[str, tuple[str, ...]] = {}
+        self.factories: dict[str, tuple[int, ...]] = {}
+        self.factory_names: dict[str, tuple[str, ...]] = {}
+        self.helpers: dict[tuple[str, str], dict] = {}
+        for mod in mods:
+            self._index_jits(mod)
+        # Helper indexing needs the jit index first, then iterates so
+        # helper-of-helper chains (depth 2 in the repo) propagate.
+        for _ in range(2):
+            changed = False
+            for mod in mods:
+                changed |= self._index_helpers(mod)
+            if not changed:
+                break
+
+    # -- jit ctor attribution -------------------------------------------
+
+    def _index_jits(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            don = _donation_kwargs(node)
+            if don is None:
+                continue
+            nums, names = don
+            for kind, name in self._owners_of(mod, node):
+                if kind == "direct":
+                    self.direct[name] = nums
+                    self.direct_names[name] = names
+                else:
+                    self.factories[name] = nums
+                    self.factory_names[name] = names
+
+    @staticmethod
+    def _owners_of(mod: ModuleInfo, call: ast.Call):
+        """[('direct'|'factory', bare name), ...] for a donating jit
+        ctor — every handle the repo's idioms can reach it through.
+
+        Assignment targets: an Attribute target (``self._jit_incr =
+        jax.jit(...)``) and a module-level Name target (``_APPLY_DELTA
+        = jax.jit(...)``) are *direct* handles.  A function-local Name
+        target (``fn = ... = jax.jit(...)``) is deliberately NOT a
+        handle — locals named ``fn`` are everywhere — the enclosing
+        function covers it instead: a property getter is a *direct*
+        handle (attribute access yields the jit), any other function a
+        *factory* (calling it returns the jit)."""
+        owners: list[tuple[str, str]] = []
+        enclosing = None
+        cur = mod.parent(call)
+        while cur is not None:
+            if isinstance(cur, ast.Assign) and enclosing is None:
+                for t in cur.targets:
+                    if isinstance(t, ast.Attribute):
+                        owners.append(("direct", t.attr))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                enclosing = cur
+                break
+            cur = mod.parent(cur)
+        if enclosing is None:
+            # Module level: the Name target is the handle.
+            cur = mod.parent(call)
+            while cur is not None and not isinstance(cur, ast.Assign):
+                cur = mod.parent(cur)
+            if isinstance(cur, ast.Assign):
+                for t in cur.targets:
+                    if isinstance(t, ast.Name):
+                        owners.append(("direct", t.id))
+        elif _is_property(enclosing):
+            owners.append(("direct", enclosing.name))
+        elif not any(k == "direct" for k, _ in owners):
+            owners.append(("factory", enclosing.name))
+        return owners
+
+    # -- helper attribution ---------------------------------------------
+
+    def _index_helpers(self, mod: ModuleInfo) -> bool:
+        changed = False
+        for fn in mod.functions():
+            params = _param_names(fn)
+            if not params:
+                continue
+            locals_map = _donating_locals(fn, self)
+            donated_params: dict[str, str] = {}
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                hit = resolve_donating_call(call, self, locals_map, None)
+                if hit is None:
+                    continue
+                argnums, argnames, label, offset = hit
+                for tok in donated_arg_roots(
+                    call, argnums, argnames, offset
+                ):
+                    if tok in params and "." not in tok:
+                        donated_params.setdefault(tok, label)
+            if not donated_params:
+                continue
+            key = (mod.relpath, fn.name)
+            if key not in self.helpers:
+                changed = True
+            self.helpers[key] = {
+                "params": params,
+                "donates": donated_params,
+                "method": bool(params) and params[0] == "self",
+            }
+        return changed
+
+
+def _donating_locals(fn, index: "_DonationIndex") -> dict[str, list]:
+    """Local names bound to a donating callable inside ``fn``:
+    ``step = self._jit_incr`` (direct attr), ``step =
+    self._jit_mp_incr_for(kp)`` (factory call) — each binding recorded
+    with its line so a call resolves through the NEAREST PRECEDING
+    binding (branch-local rebinds of the same name — the backend's
+    ``step = ...`` fan-in idiom — must not bleed across branches)."""
+    out: dict[str, list] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = node.value
+        entry = None
+        if isinstance(v, ast.Call):
+            d = dotted(v.func)
+            seg = _last_seg(d) if d else None
+            if seg in index.factories:
+                entry = (
+                    index.factories[seg], index.factory_names[seg]
+                )
+        else:
+            d = dotted(v)
+            seg = _last_seg(d) if d else None
+            if seg in index.direct:
+                entry = (index.direct[seg], index.direct_names[seg])
+        if entry is not None:
+            out.setdefault(t.id, []).append((node.lineno, entry))
+    for bindings in out.values():
+        bindings.sort()
+    return out
+
+
+def _binding_at(
+    locals_map: dict[str, list], name: str, line: int
+) -> tuple | None:
+    """The (argnums, argnames) of the nearest binding of ``name`` at
+    or before ``line``."""
+    best = None
+    for lineno, entry in locals_map.get(name, ()):
+        if lineno <= line:
+            best = entry
+    return best
+
+
+def resolve_donating_call(
+    call: ast.Call,
+    index: _DonationIndex,
+    locals_map: dict[str, tuple],
+    imports: dict | None,
+    relpath: str | None = None,
+):
+    """(argnums, argnames, label, param offset) when ``call`` donates.
+
+    Covers: direct donating names (``_APPLY_DELTA(...)`` /
+    ``self._jit_incr(...)`` / bound locals), immediately-invoked
+    factories (``_apply_delta_for(mesh)(g, ...)``), and donating
+    helpers (same module by bare name; cross-module through the HL108
+    import map).  ``offset`` is 1 for helper *methods* called as
+    ``self.helper(...)`` (their param list leads with self).
+    """
+    func = call.func
+    d = dotted(func)
+    seg = _last_seg(d) if d else None
+    # step(...) through a local bound to a donating callable
+    if isinstance(func, ast.Name) and func.id in locals_map:
+        entry = _binding_at(locals_map, func.id, call.lineno)
+        if entry is None:
+            return None
+        nums, names = entry
+        return nums, names, func.id, 0
+    # _APPLY_DELTA(...) / self._jit_incr(...) / self._jit_trop_incr(...)
+    if seg is not None and seg in index.direct:
+        return index.direct[seg], index.direct_names[seg], seg, 0
+    # factory(...)(donated, ...) — immediately-invoked factory result
+    if isinstance(func, ast.Call):
+        fd = dotted(func.func)
+        fseg = _last_seg(fd) if fd else None
+        if fseg in index.factories:
+            return (
+                index.factories[fseg],
+                index.factory_names[fseg],
+                fseg,
+                0,
+            )
+    # helper(...) — same module (bare/self call) or imported
+    if seg is not None:
+        info = None
+        label = seg
+        if relpath is not None:
+            info = index.helpers.get((relpath, seg))
+        if info is None and imports:
+            tgt = imports.get(seg)
+            if tgt is not None and tgt[1] is not None:
+                info = index.helpers.get((tgt[0], tgt[1]))
+                if info is not None:
+                    label = f"{tgt[0]}:{tgt[1]}"
+        if info is None and relpath is None:
+            # pass-1 helper indexing: resolve same-module helpers by
+            # bare name across the whole index (methods included).
+            for (rp, name), h in index.helpers.items():
+                if name == seg:
+                    info = h
+                    break
+        if info is not None:
+            params = info["params"]
+            offset = (
+                1
+                if info["method"] and isinstance(func, ast.Attribute)
+                else 0
+            )
+            nums = tuple(
+                i
+                for i, p in enumerate(params[offset:])
+                if p in info["donates"]
+            )
+            names = tuple(info["donates"])
+            return nums, names, label, offset
+    return None
+
+
+def donated_arg_roots(
+    call: ast.Call,
+    argnums: tuple[int, ...],
+    argnames: tuple[str, ...],
+    offset: int = 0,
+) -> list[str]:
+    """Textual roots of the actual arguments sitting at donated
+    positions of ``call`` (``offset`` already folded into argnums by
+    the caller for helpers; jit argnums are lambda-positional)."""
+    out: list[str] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            continue
+        if i in argnums:
+            root = _expr_root(arg)
+            if root is not None:
+                out.append(root)
+    for kw in call.keywords:
+        if kw.arg is not None and kw.arg in argnames:
+            root = _expr_root(kw.value)
+            if root is not None:
+                out.append(root)
+    return out
+
+
+def _consume_ranges(mod: ModuleInfo) -> list[tuple[int, int]]:
+    """Line spans of ``with consumes_donated(...):`` windows."""
+    spans: list[tuple[int, int]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                d = dotted(ctx.func) or ""
+                if _CONSUME_MARKER in d:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    spans.append((node.lineno, end))
+                    break
+    return spans
+
+
+def _import_map(mod: ModuleInfo) -> dict[str, tuple[str, str | None]]:
+    """Local name -> (module relpath, symbol) for holo_tpu imports —
+    the HL108 resolution, duplicated small rather than coupled."""
+    out: dict[str, tuple[str, str | None]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if not node.module.startswith("holo_tpu"):
+                continue
+            for alias in node.names:
+                local = alias.asname or alias.name
+                out[local] = (_module_relpath(node.module), alias.name)
+    return out
+
+
+class UseAfterDonateRule(ProjectRule):
+    """HL109: donated buffer read, re-dispatched, or retained after
+    the dispatch that consumed it.
+
+    The donating kernel owns the argument's buffers from the call
+    onward; a later read of the same name is garbage on real hardware
+    (the CPU test platform silently forgives it).  Drop the reference
+    before dispatch (the ``del self._prev_one[key]`` discipline), or
+    mark the legitimate re-deposit seam with ``with
+    consumes_donated(...):`` — the same vocabulary the runtime
+    donation guard counts.
+    """
+
+    id = "HL109"
+    title = "use-after-donate on a buffer-donating dispatch"
+    family = "tracer"
+    severity = "error"
+
+    def check_project(self, mods: list[ModuleInfo]) -> list[Finding]:
+        index = _DonationIndex(mods)
+        if not (index.direct or index.factories or index.helpers):
+            return []
+        out: list[Finding] = []
+        for mod in mods:
+            if not mod.config.in_dispatch_scope(mod.relpath):
+                continue
+            imports = _import_map(mod)
+            exempt = _consume_ranges(mod)
+            for fn in mod.functions():
+                out.extend(
+                    self._check_function(mod, fn, index, imports, exempt)
+                )
+        return out
+
+    def _check_function(self, mod, fn, index, imports, exempt):
+        locals_map = _donating_locals(fn, index)
+        # (root token, donation end line, label) — in donation order.
+        donated: dict[str, tuple[int, str]] = {}
+        findings: list[Finding] = []
+        # Statement-ordered walk: ast.walk is unordered, so sort every
+        # relevant node by position once.
+        nodes = sorted(
+            (n for n in ast.walk(fn) if hasattr(n, "lineno")),
+            key=lambda n: (n.lineno, getattr(n, "col_offset", 0)),
+        )
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+        donation_of: dict[ast.Call, tuple] = {}
+        for call in calls:
+            hit = resolve_donating_call(
+                call, index, locals_map, imports, mod.relpath
+            )
+            if hit is None:
+                continue
+            argnums, argnames, label, offset = hit
+            roots = donated_arg_roots(call, argnums, argnames, offset)
+            if roots:
+                donation_of[call] = (roots, label)
+        if not donation_of:
+            return findings
+        # `prev = step(g, prev)` rebinding: the sorted walk visits the
+        # Assign before its value Call, so the rebind kill must be
+        # replayed AFTER the call's donation taints — the target holds
+        # the fresh output, not the consumed operand.
+        rebound_by: dict[ast.Call, set[str]] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = {
+                t.id
+                for tgt in node.targets
+                for t in (
+                    tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                    else [tgt]
+                )
+                if isinstance(t, ast.Name)
+            }
+            if not names:
+                continue
+            for call in ast.walk(node.value):
+                if isinstance(call, ast.Call) and call in donation_of:
+                    rebound_by.setdefault(call, set()).update(names)
+        guard_arg_lines = self._guard_arg_lines(fn)
+        for node in nodes:
+            # Rebinding a donated name kills its taint.
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for t in (
+                        tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                        else [tgt]
+                    ):
+                        if isinstance(t, ast.Name):
+                            donated.pop(t.id, None)
+            if isinstance(node, ast.Call) and node in donation_of:
+                roots, label = donation_of[node]
+                end = getattr(node, "end_lineno", node.lineno)
+                for r in roots:
+                    donated[r] = (end, label)
+                for name in rebound_by.get(node, ()):
+                    donated.pop(name, None)
+                continue
+            if not donated:
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi in exempt):
+                continue
+            if line in guard_arg_lines:
+                continue
+            hit = self._offending_use(node, donated)
+            if hit is None:
+                continue
+            root, label, how = hit
+            findings.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"`{root}` was donated into `{label}(...)` and is "
+                    f"{how} here — the dispatch consumed its buffers; "
+                    "drop the reference before dispatch or mark the "
+                    "re-deposit seam with consumes_donated(...)",
+                )
+            )
+            donated.pop(root, None)  # one finding per donated name
+        return findings
+
+    @staticmethod
+    def _guard_arg_lines(fn) -> set[int]:
+        """Lines whose reads belong to the runtime guard's own seam
+        calls (``note_donated(reason, prev)``)."""
+        out: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if _last_seg(d) in _GUARD_CALLS:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    out.update(range(node.lineno, end + 1))
+        return out
+
+    @staticmethod
+    def _offending_use(node: ast.AST, donated: dict):
+        """(root, label, how) when this node reads or retains a
+        donated root after its donation line."""
+        # Retention: self._prev[k] = prev / self.x = prev
+        if isinstance(node, ast.Assign):
+            vroot = _expr_root(node.value)
+            if vroot in donated:
+                line, label = donated[vroot]
+                if node.lineno > line:
+                    return vroot, label, "retained"
+            return None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                return None
+            root = _expr_root(node)
+            # A Name that is the base of a tracked two-segment token
+            # must not fire on its own (`base` inside `base.mirror`),
+            # but the exact token and its extensions must.
+            for tok, (line, label) in donated.items():
+                if node.lineno <= line:
+                    continue
+                if root == tok:
+                    return tok, label, "read"
+                if (
+                    isinstance(node, ast.Attribute)
+                    and root is not None
+                    and root.startswith(tok + ".")
+                ):
+                    return tok, label, "read"
+        return None
+
+
+RULES = [UseAfterDonateRule]

@@ -95,6 +95,10 @@ _CALLBACK_ATTRS = {
 }
 
 
+def _last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
 def _self_attr(node: ast.AST) -> str | None:
     """'X' for an `self.X` attribute node, else None."""
     if (
@@ -462,9 +466,211 @@ class NoLockSharedContainerRule(Rule):
         return out
 
 
+# -- HL205: cross-thread publication (ISSUE 14) -------------------------
+
+# Thread-root registry: functions known to BE a non-actor thread's run
+# loop even when the `threading.Thread(target=self.X)` construction is
+# not in the same class (indirection through supervisors/daemon boot).
+# The per-class Thread(target=...) scan below catches the direct form.
+THREAD_ROOT_NAMES = {
+    "_worker",  # pipeline dispatch worker (pipeline/dispatch.py)
+    "_run",  # fanout ticker (telemetry/delta.py), txqueue sender
+    "_pump",  # ThreadedLoop pump threads
+    "_ticker",
+    "_sample_loop",
+}
+
+# Attribute ctors that ARE publication seams: a queue/event attribute
+# is the synchronization, not a raced value.
+_SEAM_CTORS = {
+    "queue.Queue",
+    "Queue",
+    "queue.SimpleQueue",
+    "SimpleQueue",
+    "collections.deque",
+    "deque",
+    "threading.Event",
+    "Event",
+}
+
+
+class CrossThreadPublicationRule(Rule):
+    """HL205: attribute published from a worker/ticker/pump thread and
+    read from actor/provider scope with no approved seam.
+
+    The daemon's informal contract — "GIL-atomic discipline" — let a
+    non-actor thread write ``self.x`` and an actor read it bare, and
+    the HL204 suppressions that rode it were hand-waved, not checked.
+    This rule checks the model: per class, methods reachable from a
+    thread root (a ``threading.Thread(target=self.X)`` target or the
+    :data:`THREAD_ROOT_NAMES` registry) are *thread-side*; an
+    attribute they mutate outside every lock region, read bare from a
+    non-thread-side method, is an unsynchronized cross-thread
+    publication.  Approved seams: hold the lock on either side, swap a
+    copy-on-write tuple (``self.subs = tuple(...)`` — the ``Ibus``
+    discipline), publish a plain constant flag (monotonic
+    ``self._closed = True``-style latches stay GIL-atomic by design),
+    or hand the value through a bounded queue / ``loop.send`` (those
+    never look like bare attribute writes in the first place).
+
+    Ships at WARN tier to soak (the HL107 precedent): findings report
+    and ride the JSON output but do not gate tier-1 until promoted.
+    """
+
+    id = "HL205"
+    title = "cross-thread publication without an approved seam"
+    family = "locks"
+    severity = "warn"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        if not mod.config.in_publication_scope(mod.relpath):
+            return []
+        out: list[Finding] = []
+        for cm in _classes(mod):
+            out.extend(self._check_class(mod, cm))
+        return out
+
+    def _check_class(self, mod: ModuleInfo, cm: _ClassModel):
+        methods = {fn.name: fn for fn in cm.methods}
+        roots = self._thread_roots(cm) & set(methods)
+        if not roots:
+            return []
+        thread_side = self._reachable(methods, roots)
+        seam_attrs = self._seam_attrs(cm) | cm.guard_attrs
+        writes: dict[str, tuple[ast.AST, str]] = {}
+        reads: dict[str, str] = {}
+        for fn in cm.methods:
+            if fn.name in ("__init__", "__new__"):
+                continue
+            regions = [w for _, w in cm.lock_regions(fn)]
+
+            def locked(node) -> bool:
+                return any(_in_node(node, r) for r in regions)
+
+            if fn.name in thread_side:
+                _annotate_assign_values(fn)
+                for node, attr, is_write in _attr_writes_and_reads(fn):
+                    if not is_write or attr in seam_attrs:
+                        continue
+                    if locked(node) or self._approved_write(node):
+                        continue
+                    writes.setdefault(attr, (node, fn.name))
+            else:
+                for node in ast.walk(fn):
+                    attr = _self_attr(node)
+                    if (
+                        attr is None
+                        or attr in seam_attrs
+                        or not isinstance(
+                            getattr(node, "ctx", None), ast.Load
+                        )
+                    ):
+                        continue
+                    if locked(node):
+                        continue
+                    reads.setdefault(attr, fn.name)
+        out = []
+        for attr in sorted(set(writes) & set(reads)):
+            node, wmeth = writes[attr]
+            out.append(
+                self.finding(
+                    mod,
+                    node,
+                    f"{cm.cls.name}.{attr} is published from the "
+                    f"{wmeth}() thread path and read bare from "
+                    f"{reads[attr]}() in actor/provider scope; route "
+                    "it through an approved seam (lock, bounded-queue "
+                    "put, loop.send, or a copy-on-write tuple swap)",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _thread_roots(cm: _ClassModel) -> set[str]:
+        roots = set(THREAD_ROOT_NAMES)
+        for node in ast.walk(cm.cls):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if _last_seg(d) != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr is not None:
+                        roots.add(attr)
+        return roots
+
+    @staticmethod
+    def _reachable(methods: dict, roots: set[str]) -> set[str]:
+        """Transitive closure of self.X() calls from the root set."""
+        seen: set[str] = set()
+        work = [r for r in roots if r in methods]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in methods and callee not in seen:
+                        work.append(callee)
+        return seen
+
+    @staticmethod
+    def _seam_attrs(cm: _ClassModel) -> set[str]:
+        """Attributes holding queues/events/deques — the seam objects
+        themselves (puts/sets on them are the approved pattern)."""
+        out: set[str] = set()
+        for node in ast.walk(cm.cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if dotted(node.value.func) in _SEAM_CTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            out.add(attr)
+        return out
+
+    @staticmethod
+    def _approved_write(node: ast.AST) -> bool:
+        """COW tuple swaps and constant flag latches are approved
+        publications even without a lock.  Container mutations and
+        subscript stores arrive as Subscript/Call nodes with no
+        stamped value and never qualify — only whole-attribute
+        rebinds."""
+        value = getattr(node, "_hl205_value", None)
+        if value is None:
+            return False
+        if isinstance(value, ast.Constant):
+            return True
+        if isinstance(value, ast.Tuple):
+            return True
+        if isinstance(value, ast.Call) and (
+            dotted(value.func) or ""
+        ) == "tuple":
+            return True
+        return False
+
+
+def _annotate_assign_values(fn) -> None:
+    """Stamp each Assign target with its value so _approved_write can
+    see what was published (ast has no child->parent link)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                t._hl205_value = node.value
+        elif isinstance(node, ast.AugAssign):
+            t = node.target
+            t._hl205_value = None
+
+
 RULES = [
     UnlockedSharedMutationRule,
     BlockingCallUnderLockRule,
     CallbackUnderLockRule,
     NoLockSharedContainerRule,
+    CrossThreadPublicationRule,
 ]

@@ -1,0 +1,358 @@
+"""holo-lint sharding-constraint rule (HL110): unconstrained loop carry.
+
+The PR-13 miscompile as a rule.  Under a multi-node process mesh,
+GSPMD propagates shardings *through* ``lax.while_loop`` / ``scan`` /
+``fori_loop`` carries: a carry seeded from a row-sharded graph plane —
+or resharded backward from a consumer's gather — can silently acquire a
+row sharding the loop body has no legal implementation for, and on
+node-sharded meshes the compiled loop produced garbage until
+``_constrain_replicated`` fenced BOTH sides of the carry
+(``ops/tropical.py``).  The fix is mechanical and local: pin every
+derived carry element with ``with_sharding_constraint`` (the repo's
+fence helpers wrap it), so the next dense-tile or partitioned-SPF
+kernel cannot silently regress on multi-node meshes.
+
+Two-pass :class:`~holo_tpu.analysis.core.ProjectRule`:
+
+Pass 1 resolves which modules are **compiled under a per-mesh jit**
+from the ``parallel/mesh.py`` helpers: functions that build a jit and
+pin shardings (``NamedSharding`` / ``with_sharding_constraint`` /
+``out_shardings=``) are mesh-jit builders; every function their jitted
+bodies call — expanded transitively over the project call graph — is
+mesh-compiled.
+
+Pass 2 enforces the carry contract inside **fence-declaring** modules
+in dispatch scope: a module that defines a replication fence (a helper
+whose body applies ``with_sharding_constraint``) — or imports one and
+is mesh-compiled per pass 1 — has declared that its loop carries must
+stay replicated.  In such modules, every element of every lax-loop
+init carry must be either *fenced* (wrapped in the fence /
+``with_sharding_constraint``) or *fresh* (a constant or a
+freshly-constructed ``jnp.zeros/ones/full/arange/bool_`` — values with
+no sharding to propagate).  Any derived value reaching the carry
+unfenced flags.
+
+Modules with no fence have no replicated-carry contract and are out of
+scope — the gather engines' carries legitimately ride GSPMD
+propagation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, ProjectRule, dotted
+
+_LOOP_CALLS = {
+    "jax.lax.while_loop": 2,
+    "lax.while_loop": 2,
+    "jax.lax.scan": 1,
+    "lax.scan": 1,
+    "jax.lax.fori_loop": 3,
+    "lax.fori_loop": 3,
+}
+_LOOP_NAMES = {"while_loop": 2, "scan": 1, "fori_loop": 3}
+_INIT_KEYWORDS = {"init_val", "init"}
+
+# Constructors whose results carry no inherited sharding: safe carry
+# seeds without a fence.  like_-constructors are deliberately absent
+# (zeros_like(x) inherits x's sharding under GSPMD).
+_FRESH_CTORS = {
+    "zeros",
+    "ones",
+    "full",
+    "arange",
+    "eye",
+    "bool_",
+    "int32",
+    "uint32",
+    "int8",
+    "uint8",
+}
+_CONSTRAIN_SEG = "with_sharding_constraint"
+_FENCE_HINT = "_constrain"
+
+
+def _last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def fence_names(mod: ModuleInfo) -> tuple[set[str], set[str]]:
+    """(locally-defined fences, imported fence names).
+
+    A *fence* is a helper whose body applies
+    ``with_sharding_constraint`` — the ``_constrain_replicated``
+    pattern.  Imports count when the imported name carries the
+    ``_constrain`` hint or is ``with_sharding_constraint`` itself."""
+    local: set[str] = set()
+    for fn in mod.functions():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if _last_seg(d) == _CONSTRAIN_SEG:
+                    local.add(fn.name)
+                    break
+    imported: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if _FENCE_HINT in alias.name or (
+                    alias.name == _CONSTRAIN_SEG
+                ):
+                    imported.add(name)
+    return local, imported
+
+
+def _module_relpath(dotted_mod: str) -> str:
+    return dotted_mod.replace(".", "/") + ".py"
+
+
+class _MeshJitIndex:
+    """Pass 1: the modules whose functions are compiled under a
+    per-mesh jit.
+
+    Seeds: functions (any module) that both build a jit (``jax.jit``
+    call or ``@jax.jit`` on a nested def) and pin shardings.  The
+    names their bodies call resolve through each module's holo_tpu
+    imports; the closure expands until fixed."""
+
+    def __init__(self, mods: list[ModuleInfo]):
+        self.by_path = {m.relpath: m for m in mods}
+        # (relpath, function name) worklist of mesh-compiled functions.
+        seeds: list[tuple[str, str]] = []
+        for mod in mods:
+            for fn in mod.functions():
+                if self._is_mesh_builder(fn):
+                    for callee in self._called_names(fn):
+                        for tgt in self._resolve(mod, callee):
+                            seeds.append(tgt)
+        self.mesh_compiled: set[tuple[str, str]] = set()
+        work = list(seeds)
+        while work:
+            key = work.pop()
+            if key in self.mesh_compiled:
+                continue
+            relpath, name = key
+            mod = self.by_path.get(relpath)
+            fn = None if mod is None else self._function(mod, name)
+            if fn is None:
+                continue
+            self.mesh_compiled.add(key)
+            for callee in self._called_names(fn):
+                work.extend(self._resolve(mod, callee))
+        self.mesh_modules = {rp for rp, _ in self.mesh_compiled}
+
+    @staticmethod
+    def _is_mesh_builder(fn) -> bool:
+        has_jit = False
+        has_shard = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if d in ("jax.jit", "jit"):
+                    has_jit = True
+                    if any(
+                        kw.arg in ("in_shardings", "out_shardings")
+                        for kw in node.keywords
+                    ):
+                        has_shard = True
+                seg = _last_seg(d)
+                if seg in ("NamedSharding", _CONSTRAIN_SEG):
+                    has_shard = True
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for dec in node.decorator_list:
+                    if (dotted(dec) or "") in ("jax.jit", "jit"):
+                        has_jit = True
+        return has_jit and has_shard
+
+    @staticmethod
+    def _called_names(fn) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None:
+                    out.add(d)
+        return out
+
+    @staticmethod
+    def _function(mod: ModuleInfo, name: str):
+        for fn in mod.functions():
+            if fn.name == name:
+                return fn
+        return None
+
+    def _resolve(self, mod: ModuleInfo, called: str):
+        """Project-wide (relpath, fname) candidates for a called name:
+        same module by bare name, or through a holo_tpu import."""
+        seg_first = called.split(".")[0]
+        seg_last = _last_seg(called)
+        out: list[tuple[str, str]] = []
+        if "." not in called and self._function(mod, called):
+            out.append((mod.relpath, called))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if not node.module.startswith("holo_tpu"):
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if local == called:
+                        out.append(
+                            (_module_relpath(node.module), alias.name)
+                        )
+                    elif local == seg_first and "." in called:
+                        # `from holo_tpu.ops import tropical` +
+                        # tropical.fn(...)
+                        out.append((
+                            _module_relpath(
+                                f"{node.module}.{alias.name}"
+                            ),
+                            seg_last,
+                        ))
+        return out
+
+
+class UnconstrainedLoopCarryRule(ProjectRule):
+    """HL110: mesh-sharded operand reaches a lax loop carry without a
+    sharding constraint.
+
+    In a module whose loops declare the replicated-carry discipline
+    (a ``_constrain_replicated``-style fence exists), every derived
+    init-carry element must pass through the fence — GSPMD otherwise
+    propagates a row sharding into the carry and node-sharded meshes
+    miscompile (the PR-13 firewall, now checked).
+    """
+
+    id = "HL110"
+    title = "unconstrained lax loop carry under a per-mesh jit"
+    family = "tracer"
+    severity = "error"
+
+    def check_project(self, mods: list[ModuleInfo]) -> list[Finding]:
+        index = _MeshJitIndex(mods)
+        out: list[Finding] = []
+        for mod in mods:
+            if not mod.config.in_dispatch_scope(mod.relpath):
+                continue
+            local, imported = fence_names(mod)
+            in_scope = bool(local) or (
+                bool(imported)
+                and mod.relpath in index.mesh_modules
+            )
+            if not in_scope:
+                continue
+            fences = local | imported | {_CONSTRAIN_SEG}
+            out.extend(self._check_module(mod, fences))
+        return out
+
+    def _check_module(
+        self, mod: ModuleInfo, fences: set[str]
+    ) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            init_pos = self._loop_init_pos(node)
+            if init_pos is None:
+                continue
+            init = self._init_arg(node, init_pos)
+            if init is None:
+                continue
+            loop = _last_seg(dotted(node.func) or "loop")
+            assigns = self._local_values(mod, node)
+            for elt in self._carry_elements(init):
+                if self._element_ok(elt, fences, assigns):
+                    continue
+                out.append(
+                    self.finding(
+                        mod,
+                        elt if hasattr(elt, "lineno") else node,
+                        f"carry element `{ast.unparse(elt)}` reaches "
+                        f"lax.{loop} without a sharding constraint; "
+                        "wrap it in the module's replication fence "
+                        "(with_sharding_constraint) so GSPMD cannot "
+                        "propagate a row sharding into the loop "
+                        "carry on node-sharded meshes",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _loop_init_pos(node: ast.Call) -> int | None:
+        d = dotted(node.func)
+        if d in _LOOP_CALLS:
+            return _LOOP_CALLS[d]
+        if d is not None and _last_seg(d) in _LOOP_NAMES:
+            # `from jax.lax import while_loop` alias form.
+            if d == _last_seg(d):
+                return _LOOP_NAMES[d]
+        return None
+
+    @staticmethod
+    def _init_arg(node: ast.Call, pos: int) -> ast.expr | None:
+        for kw in node.keywords:
+            if kw.arg in _INIT_KEYWORDS:
+                return kw.value
+        if pos < len(node.args):
+            return node.args[pos]
+        return None
+
+    @staticmethod
+    def _carry_elements(init: ast.expr) -> list[ast.expr]:
+        if isinstance(init, (ast.Tuple, ast.List)):
+            return list(init.elts)
+        return [init]
+
+    @staticmethod
+    def _local_values(mod: ModuleInfo, node: ast.Call):
+        """Name -> assigned value expressions in the loop's enclosing
+        function (a Name carry element is judged by what was bound to
+        it; multiple bindings must ALL be clean)."""
+        fn = mod.enclosing_function(node)
+        if fn is None:
+            return {}
+        out: dict[str, list[ast.expr]] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(sub.value)
+        return out
+
+    @classmethod
+    def _element_ok(
+        cls,
+        elt: ast.expr,
+        fences: set[str],
+        assigns: dict | None = None,
+        depth: int = 0,
+    ) -> bool:
+        if isinstance(elt, ast.Constant):
+            return True
+        if isinstance(elt, ast.UnaryOp):
+            return cls._element_ok(elt.operand, fences, assigns, depth)
+        if isinstance(elt, ast.Name) and assigns and depth < 2:
+            values = assigns.get(elt.id)
+            if values:
+                return all(
+                    cls._element_ok(v, fences, assigns, depth + 1)
+                    for v in values
+                )
+            return False
+        if isinstance(elt, ast.Call):
+            d = dotted(elt.func) or ""
+            seg = _last_seg(d)
+            if seg in fences or seg == _CONSTRAIN_SEG:
+                return True
+            if seg in _FRESH_CTORS and (
+                d.startswith(("jnp.", "jax.numpy.", "np.", "numpy."))
+                or d == seg
+            ):
+                return True
+        return False
+
+
+RULES = [UnconstrainedLoopCarryRule]

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from holo_tpu import telemetry
+from holo_tpu.analysis.runtime import note_donated
 from holo_tpu.ops.graph import INF, MP_SAT, EllGraph, TopologyDelta
 
 # Host-side marshal metrics: every DeviceGraph build reports how long
@@ -586,12 +587,17 @@ class DeviceGraphCache:
                 base.trop_meta = None
                 _trop.note_tile_delta(f"drop-{exc.reason}")
         g = _apply_delta_for(base.mesh)(base.graph, *ops)
+        # Runtime half of HL109: the claimed entry's planes were just
+        # donated into the scatter — poison them under the test-mode
+        # donation guard so a stale reference raises at read time.
+        note_donated("spf.graph.delta", base.graph)
         tt = None
         if tile_ops is not None:
             from holo_tpu.ops import tropical as _trop
 
             tt = _apply_tiles_for(base.mesh)(base.tropical, *tile_ops)
             _trop.note_tile_delta("apply")
+            note_donated("spf.tiles.delta", base.tropical)
         entry = _CacheEntry(
             graph=g,
             mirror=base.mirror,

@@ -808,7 +808,9 @@ def _affected(g, prev_parent, seed_rows, limit):
         new = aff | jnp.where(has_par, aff[par_safe], False)
         return new, jnp.any(new != aff), it + 1
 
-    aff, _, _ = jax.lax.while_loop(cond, body, (aff0, jnp.bool_(True), 0))
+    aff, _, _ = jax.lax.while_loop(
+        cond, body, (_constrain_replicated(aff0), jnp.bool_(True), 0)
+    )
     return aff
 
 

@@ -52,6 +52,7 @@ from collections import deque
 from contextlib import nullcontext
 
 from holo_tpu import telemetry
+from holo_tpu.analysis.runtime import consumes_donated
 from holo_tpu.telemetry import convergence
 
 log = logging.getLogger("holo_tpu.pipeline")
@@ -410,7 +411,14 @@ class DispatchPipeline:
         self._overlap_seconds += max(t_fs - item.t_launch_end, 0.0)
         try:
             guard, act = self._ctx(item)
-            with guard, act:
+            # The pipeline's per-key ownership handoff: finish()
+            # re-deposits the fresh tensors that replace the donated
+            # previous set, and only then may a queued delta of the
+            # same chain launch (submit() serializes on the key).
+            # consumes_donated is the HL109 seam vocabulary — the
+            # runtime guard counts the window so tests can pin that
+            # the handoff actually ran under the async path.
+            with guard, act, consumes_donated("pipeline.key.handoff"):
                 item.ticket._complete(item.finish(item.handle))
         except BaseException as exc:  # noqa: BLE001 — see _do_launch
             item.ticket._fail(exc)

@@ -17,7 +17,12 @@ import jax
 import numpy as np
 
 from holo_tpu import telemetry
-from holo_tpu.analysis.runtime import sanctioned_transfer
+from holo_tpu.analysis.runtime import (
+    assert_live,
+    consumes_donated,
+    note_donated,
+    sanctioned_transfer,
+)
 from holo_tpu.ops.graph import Topology
 from holo_tpu.resilience import faults
 from holo_tpu.resilience.breaker import CircuitBreaker
@@ -587,6 +592,11 @@ class TpuSpfBackend(SpfBackend):
         else:
             step = self._jit_incr
             out = step(g, topo.root, prev, seeds_p)
+        # Runtime half of HL109: under the test-mode donation guard
+        # the consumed previous tensors are actually poisoned, so any
+        # use-after-donate the static rule missed raises at read time
+        # on the CPU platform exactly as it would corrupt on device.
+        note_donated("spf.one.delta", prev)
         return step, out, trop, tt, sig, fresh
 
     def _incr_cost_args(self, trop, tt, g, root, out, seeds_p, kp):
@@ -862,9 +872,14 @@ class TpuSpfBackend(SpfBackend):
         )
         if key in self._prev_one:
             return
-        self._prev_one[key] = out
-        while len(self._prev_one) > self.prev_capacity:
-            self._prev_one.pop(next(iter(self._prev_one)))
+        # The legitimate re-deposit seam of the donation handoff: the
+        # FRESH output tensors take the consumed previous set's place.
+        # consumes_donated is the shared HL109 vocabulary — the static
+        # rule exempts this window, the runtime guard counts it.
+        with consumes_donated("spf.prev.redeposit"):
+            self._prev_one[key] = out
+            while len(self._prev_one) > self.prev_capacity:
+                self._prev_one.pop(next(iter(self._prev_one)))
 
     def _track_compile(self, kind: str, engine: str, *shape) -> bool:
         """Returns True when this (engine, shape) bucket is fresh — a
@@ -1117,6 +1132,10 @@ class TpuSpfBackend(SpfBackend):
                 self._obs_cost("spf.one", "delta", "incr", obucket, entry)
             with profiling.stage("spf.one", "device"):
                 faults.delaypoint("spf.dispatch")
+                # Donation-guard force boundary: a leaked donated alias
+                # in the output set fails HERE, named, not as a generic
+                # deleted-array error inside the readback.
+                assert_live("spf.one.readback", out)
                 with profiling.annotation("spf.one.delta.device"):
                     if not profiling.device_stages("spf.one", out):
                         profiling.sync(out)
@@ -1595,6 +1614,8 @@ class TpuSpfBackend(SpfBackend):
         ):
             with profiling.stage("spf.one", "device"):
                 faults.delaypoint("spf.dispatch")
+                # Donation-guard force boundary (see _try_incremental).
+                assert_live("spf.one.readback", h.out)
                 with profiling.annotation("spf.one.device"):
                     if not profiling.device_stages("spf.one", h.out):
                         profiling.sync(h.out)

@@ -30,6 +30,24 @@ def no_implicit_transfers():
         yield
 
 
+@contextlib.contextmanager
+def donation_guarded():
+    """Run the enclosed block under the holo-lint DONATION guard.
+
+    The runtime half of HL109: inside this block every donating
+    dispatch seam (``note_donated`` in ``spf/backend.py`` /
+    ``ops/spf_engine.py``) actually ``delete()``s the donated buffers,
+    so a use-after-donate bug that the CPU platform would silently
+    forgive raises at force/readback time exactly as it would fail on
+    real hardware.  Parity suites compose it with
+    :func:`no_implicit_transfers`.
+    """
+    from holo_tpu.analysis.runtime import donation_guard
+
+    with donation_guard():
+        yield
+
+
 def force_virtual_cpu_mesh(n_devices: int) -> None:
     """Force an n-device virtual CPU platform before backend init.
 

@@ -599,10 +599,13 @@ def cmd_lint(args) -> int:
     from pathlib import Path
 
     from holo_tpu.analysis import (
+        audit_suppressions,
         compare_to_baseline,
         default_baseline_path,
         load_baseline,
         run_paths,
+        run_paths_cached,
+        self_check,
         write_baseline,
     )
 
@@ -624,11 +627,45 @@ def cmd_lint(args) -> int:
             )
         return 0
 
-    result = run_paths(paths, root=repo_root)
+    # The incremental cache covers the default full-package scan only:
+    # an ad-hoc `lint some/path` has a different file set and must not
+    # overwrite the gate's cache (all-or-nothing validation would then
+    # force the next gate run cold).
+    use_cache = not args.no_cache and not args.paths
+    if args.self_check:
+        if not use_cache:
+            # self_check exercises the default cache file; running it
+            # over an ad-hoc path set would store that partial file
+            # set and force the next gate run cold.
+            print(
+                "error: --self-check validates the full-package cache "
+                "and cannot combine with --no-cache or explicit paths",
+                file=sys.stderr,
+            )
+            return 2
+        mismatches = self_check(paths, root=repo_root)
+        if mismatches:
+            for m in mismatches:
+                print(f"cache self-check: {m}", file=sys.stderr)
+            print(
+                "holo-lint: cache self-check FAILED — cached replay "
+                "diverged from a cold scan (delete "
+                ".holo_lint_cache.json and report this)",
+                file=sys.stderr,
+            )
+            return 2
+    if use_cache:
+        result = run_paths_cached(paths, root=repo_root)
+    else:
+        result = run_paths(paths, root=repo_root)
     if result.parse_errors:
         for err in result.parse_errors:
             print(f"parse error: {err}", file=sys.stderr)
         return 2
+
+    stale_suppressions = (
+        audit_suppressions(result) if args.check_suppressions else []
+    )
 
     baseline_path = (
         Path(args.baseline) if args.baseline else default_baseline_path()
@@ -652,7 +689,19 @@ def cmd_lint(args) -> int:
 
     if args.json:
         doc = {
+            # Bump schema_version whenever a field is added/renamed so
+            # the sentinel ledger (BENCH observatory) can gate its
+            # parser instead of silently misreading lint telemetry.
+            "schema_version": 2,
             "files_checked": result.files_checked,
+            "files_cached": result.files_cached,
+            # Wall seconds per rule id (whole run) — the ledger tracks
+            # lint cost per rule as the module set grows.
+            "rule_seconds": {
+                k: round(v, 6)
+                for k, v in sorted(result.rule_seconds.items())
+            },
+            "stale_suppressions": stale_suppressions,
             "findings": [
                 {
                     "rule": f.rule,
@@ -677,13 +726,26 @@ def cmd_lint(args) -> int:
             print(f.render())
         for f in new_warns:
             print(f"warning: {f.render()}")
+        for s in stale_suppressions:
+            print(s)
         n_base = len(result.findings) - len(new)
+        cached = (
+            f" ({result.files_cached} cached)"
+            if result.files_cached
+            else ""
+        )
         print(
-            f"holo-lint: {result.files_checked} files, "
+            f"holo-lint: {result.files_checked} files{cached}, "
             f"{len(new_errors)} new error(s), "
             f"{len(new_warns)} new warning(s), {n_base} baselined, "
             f"{len(result.suppressed)} suppressed"
         )
+        if stale_suppressions:
+            print(
+                f"holo-lint: {len(stale_suppressions)} stale "
+                "suppression(s) — delete the dead disable comment(s) "
+                "or fix the rule id they name"
+            )
         if unused:
             print(
                 f"holo-lint: {sum(unused.values())} baseline entr"
@@ -692,7 +754,7 @@ def cmd_lint(args) -> int:
             )
             for key in sorted(unused):
                 print(f"  {key}")
-    return 1 if new_errors else 0
+    return 1 if (new_errors or stale_suppressions) else 0
 
 
 def main(argv=None) -> int:
@@ -814,6 +876,20 @@ def main(argv=None) -> int:
     s.add_argument("--json", action="store_true", help="JSON report")
     s.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    s.add_argument(
+        "--check-suppressions", action="store_true",
+        help="flag stale `# holo-lint: disable=` comments whose rule "
+             "no longer fires on that line (exit 1)",
+    )
+    s.add_argument(
+        "--no-cache", action="store_true",
+        help="force a full scan (skip the incremental lint cache)",
+    )
+    s.add_argument(
+        "--self-check", action="store_true",
+        help="run cached + cold scans and fail loudly (exit 2) if the "
+             "cache replay diverges from the full scan",
     )
     s.set_defaults(fn=cmd_lint)
     args = ap.parse_args(argv)

@@ -344,3 +344,88 @@ def test_cache_stats_on_gnmi_leaf():
         assert key in leaf, key
     assert leaf["entries"] >= 1
     assert 0.0 < leaf["occupancy"] <= 1.0
+
+
+# -- donation guard: the runtime half of HL109 (ISSUE 14) ---------------
+
+
+def test_donation_guard_poisons_and_asserts():
+    """Unit contract: disarmed note_donated is a no-op; armed, it
+    deletes the donated handles, and assert_live converts a later read
+    into a named DonatedBufferError at the force boundary."""
+    import jax.numpy as jnp
+
+    from holo_tpu.analysis import runtime as art
+    from holo_tpu.testing import donation_guarded
+
+    arr = jnp.arange(4)
+    art.note_donated("fixture.disarmed", arr)
+    assert not arr.is_deleted()
+    art.assert_live("fixture.disarmed", arr)  # disarmed: no-op too
+    with donation_guarded():
+        arr2 = jnp.arange(8)
+        art.note_donated("fixture.armed", (arr2, None))
+        assert arr2.is_deleted()
+        with pytest.raises(art.DonatedBufferError, match="fixture.read"):
+            art.assert_live("fixture.read", arr2)
+    assert art.donated_counts().get("fixture.armed", 0) >= 1
+
+
+def test_donation_guard_catches_retained_prev_alias():
+    """The runtime arm of the ISSUE-14 mutation proof: a reference
+    that illegally outlives the DeltaPath donation (exactly the HL109
+    retention bug) is poisoned by the dispatch seam, so reading it at
+    test time raises instead of silently passing on the CPU platform
+    (which ignores donation and would have returned stale bytes)."""
+    from holo_tpu.analysis import runtime as art
+    from holo_tpu.testing import donation_guarded
+
+    with donation_guarded():
+        topo = random_ospf_topology(n_routers=16, n_networks=4, seed=3)
+        be = TpuSpfBackend(N_ATOMS)
+        be.compute(topo)
+        # The seeded bug: an alias of the retained prev tensors that
+        # the next delta dispatch will donate out from under us.
+        stale = next(iter(be._prev_one.values()))
+        before = art.donated_counts().get("spf.one.delta", 0)
+        nxt = clone(topo, cost={0: 7})
+        delta = diff_topologies(topo, nxt)
+        assert delta is not None
+        nxt.link_delta(delta)
+        be.compute(nxt)
+        assert art.donated_counts().get("spf.one.delta", 0) > before, (
+            "delta dispatch did not ride the incremental (donating) path"
+        )
+        with pytest.raises(art.DonatedBufferError):
+            art.assert_live("test.readback", stale)
+
+
+def test_delta_chain_parity_under_donation_guard():
+    """One parity arm under the armed guard (composed with the
+    transfer sanitizer via the suite's autouse fixture): poisoning
+    every donated seed must not disturb bit-identity — the production
+    path never reads what it donated — and both halves of the shared
+    seam vocabulary must actually run."""
+    from holo_tpu.analysis import runtime as art
+    from holo_tpu.testing import donation_guarded
+
+    with donation_guarded():
+        rng = np.random.default_rng(11)
+        topo = random_ospf_topology(
+            n_routers=20, n_networks=5, extra_p2p=20, seed=11
+        )
+        be = TpuSpfBackend(N_ATOMS)
+        oracle = ScalarSpfBackend(N_ATOMS)
+        be.compute(topo)
+        cur = topo
+        for _step in range(6):
+            nxt = random_mutation(cur, rng)
+            delta = diff_topologies(cur, nxt)
+            if delta is not None:
+                nxt.link_delta(delta)
+            assert_results_equal(
+                oracle.compute(nxt), be.compute(nxt), f"step {_step}"
+            )
+            cur = nxt
+        assert art.donated_counts().get("spf.one.delta", 0) > 0
+        assert art.consumed_counts().get("spf.prev.redeposit", 0) > 0

@@ -1034,3 +1034,845 @@ def test_baseline_multiset_semantics():
     assert len(new) == 1 and not unused  # second duplicate is NEW
     new, unused = compare_to_baseline([], baseline)
     assert not new and unused[f.key] == 1  # stale entry surfaces
+
+
+# -- HL109: use-after-donate (ISSUE 14) ---------------------------------
+
+HL109_BAD = """
+    import jax
+
+    _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+    def dispatch(g, prev, seeds):
+        out = _STEP(g, prev, seeds)
+        return out + prev
+"""
+HL109_SUPPRESSED = """
+    import jax
+
+    _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+    def dispatch(g, prev, seeds):
+        out = _STEP(g, prev, seeds)
+        return out + prev  # holo-lint: disable=HL109
+"""
+HL109_CLEAN = """
+    import jax
+
+    _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+    def dispatch(g, prev, seeds):
+        out = _STEP(g, prev, seeds)
+        return out
+"""
+
+
+def test_hl109_use_after_donate():
+    assert_triple("HL109", HL109_BAD, HL109_SUPPRESSED, HL109_CLEAN, OPS)
+
+
+def test_hl109_retention_form():
+    # The `self._prev[k] = prev` retention the DeltaPath handoff bans:
+    # the dict would hand a consumed buffer to the NEXT dispatch.
+    src = """
+        import jax
+
+        _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+        class Backend:
+            def run(self, g, prev, key, seeds):
+                out = _STEP(g, prev, seeds)
+                self._prev[key] = prev
+                return out
+    """
+    res = lint(src, OPS)
+    f = next(f for f in res.findings if f.rule == "HL109")
+    assert "retained" in f.message and "Backend.run" in f.context
+
+
+def test_hl109_donate_argnames_keyword_form():
+    src = """
+        import jax
+
+        _STEP = jax.jit(lambda g, prev: g, donate_argnames=("prev",))
+
+        def dispatch(g, prev):
+            out = _STEP(g, prev=prev)
+            return prev
+    """
+    assert "HL109" in rules_fired(src, OPS)
+
+
+def test_hl109_factory_local_binding_form():
+    # `step = _step_for(k); step(g, prev)` — the per-width jit-cache
+    # idiom: the local resolves through the factory's donation.
+    src = """
+        import jax
+
+        def _step_for(k):
+            return jax.jit(lambda g, prev: g, donate_argnums=(1,))
+
+        def dispatch(g, prev):
+            step = _step_for(2)
+            out = step(g, prev)
+            return prev
+    """
+    assert "HL109" in rules_fired(src, OPS)
+
+
+def test_hl109_rebind_kills_taint():
+    src = """
+        import jax
+
+        _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+        def dispatch(g, prev, seeds):
+            out = _STEP(g, prev, seeds)
+            prev = out
+            return prev
+    """
+    assert "HL109" not in rules_fired(src, OPS)
+
+
+def test_hl109_guard_seams_are_exempt():
+    # note_donated's own argument read and the consumes_donated window
+    # are the shared vocabulary with the runtime guard — never findings.
+    src = """
+        import jax
+
+        from holo_tpu.analysis.runtime import consumes_donated, note_donated
+
+        _STEP = jax.jit(lambda g, prev: g, donate_argnums=(1,))
+
+        def dispatch(g, prev):
+            out = _STEP(g, prev)
+            note_donated("fixture.delta", prev)
+            with consumes_donated("fixture.redeposit"):
+                stash = prev
+            return out
+    """
+    assert "HL109" not in rules_fired(src, OPS)
+
+
+DONOR_PATH = "holo_tpu/spf/_donor_fixture.py"
+DONOR_SRC = """
+    import jax
+
+    _STEP = jax.jit(lambda g, prev: g, donate_argnums=(1,))
+
+    def incr_step(g, prev):
+        return _STEP(g, prev)
+"""
+
+
+def test_hl109_cross_module_donated_arg():
+    # The donation taints THROUGH an imported helper: incr_step's
+    # `prev` parameter lands on _STEP's donated position, so calling
+    # it consumes the caller's actual argument.
+    import textwrap as _tw
+
+    from holo_tpu.analysis.core import run_sources
+
+    caller = """
+        from holo_tpu.spf._donor_fixture import incr_step
+
+        def dispatch(g, prev):
+            out = incr_step(g, prev)
+            return prev
+    """
+    res = run_sources(
+        [
+            (DONOR_PATH, _tw.dedent(DONOR_SRC)),
+            (OPS, _tw.dedent(caller)),
+        ],
+        LintConfig(),
+    )
+    hits = [f for f in res.findings if f.rule == "HL109"]
+    assert hits and hits[0].path == OPS, [
+        f.render() for f in res.findings
+    ]
+
+
+def test_hl109_out_of_scope_module_is_ignored():
+    assert rules_fired(HL109_BAD, OUTSIDE) == set()
+
+
+def test_hl109_is_error_tier():
+    res = lint(HL109_BAD, OPS)
+    tiers = {f.rule: f.severity for f in res.findings}
+    assert tiers.get("HL109") == "error"
+
+
+# -- HL110: unconstrained loop carry (ISSUE 14) -------------------------
+
+HL110_BAD = """
+    import jax
+    import jax.numpy as jnp
+    from jax.lax import with_sharding_constraint
+
+    _REPL = None
+
+    def _constrain_replicated(x):
+        return with_sharding_constraint(x, _REPL)
+
+    def fixpoint(g, dist):
+        dist0 = dist * 2
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            return (c[0], jnp.bool_(False))
+
+        out, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True)))
+        return out
+"""
+HL110_SUPPRESSED = """
+    import jax
+    import jax.numpy as jnp
+    from jax.lax import with_sharding_constraint
+
+    _REPL = None
+
+    def _constrain_replicated(x):
+        return with_sharding_constraint(x, _REPL)
+
+    def fixpoint(g, dist):
+        dist0 = dist * 2
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            return (c[0], jnp.bool_(False))
+
+        # holo-lint: disable=HL110
+        out, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True)))
+        return out
+"""
+HL110_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+    from jax.lax import with_sharding_constraint
+
+    _REPL = None
+
+    def _constrain_replicated(x):
+        return with_sharding_constraint(x, _REPL)
+
+    def fixpoint(g, dist):
+        dist0 = dist * 2
+
+        def cond(c):
+            return c[1]
+
+        def body(c):
+            return (c[0], jnp.bool_(False))
+
+        out, _ = jax.lax.while_loop(
+            cond, body, (_constrain_replicated(dist0), jnp.bool_(True))
+        )
+        return out
+"""
+
+
+def test_hl110_unconstrained_loop_carry():
+    assert_triple("HL110", HL110_BAD, HL110_SUPPRESSED, HL110_CLEAN, OPS)
+
+
+def test_hl110_fresh_constructors_are_clean_seeds():
+    # jnp.zeros/ones/bool_ carries inherit no sharding — no fence
+    # needed.  zeros_like is absent from the allowlist on purpose.
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.lax import with_sharding_constraint
+
+        def _constrain_replicated(x):
+            return with_sharding_constraint(x, None)
+
+        def fixpoint(n):
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                return (c[0], jnp.bool_(False))
+
+            out, _ = jax.lax.while_loop(
+                cond, body, (jnp.zeros((4,), jnp.uint32), jnp.bool_(True))
+            )
+            return out
+    """
+    assert "HL110" not in rules_fired(src, OPS)
+
+
+def test_hl110_scan_and_fori_forms():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.lax import with_sharding_constraint
+
+        def _constrain_replicated(x):
+            return with_sharding_constraint(x, None)
+
+        def sweep(g, dist):
+            carry, _ = jax.lax.scan(lambda c, x: (c, x), dist, g)
+            return carry
+
+        def rounds(g, dist):
+            return jax.lax.fori_loop(0, 4, lambda i, c: c, dist)
+    """
+    findings = [f for f in lint(src, OPS).findings if f.rule == "HL110"]
+    assert len(findings) == 2, [f.render() for f in findings]
+
+
+def test_hl110_module_without_fence_is_out_of_scope():
+    # No replication fence declared -> the module's carries legitimately
+    # ride GSPMD propagation (the gather engines).
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def fixpoint(g, dist):
+            dist0 = dist * 2
+
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                return (c[0], jnp.bool_(False))
+
+            out, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True)))
+            return out
+    """
+    assert "HL110" not in rules_fired(src, OPS)
+
+
+def test_hl110_imported_fence_with_mesh_jit_closure():
+    # Pass-1 resolution: the kernel module imports a fence and is
+    # reached from a per-mesh jit builder, so its unfenced carry flags
+    # even with no locally-defined fence helper.
+    import textwrap as _tw
+
+    from holo_tpu.analysis.core import run_sources
+
+    kern = """
+        import jax
+        import jax.numpy as jnp
+
+        from holo_tpu.ops.tropical import _constrain_replicated
+
+        def kernel(g, dist):
+            dist0 = dist + 1
+
+            def cond(c):
+                return c[1]
+
+            def body(c):
+                return (c[0], jnp.bool_(False))
+
+            out, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True)))
+            return out
+    """
+    builder = """
+        import jax
+        from jax.sharding import NamedSharding
+
+        from holo_tpu.ops._kern_fixture import kernel
+
+        def build(mesh, spec):
+            return jax.jit(
+                lambda g, d: kernel(g, d),
+                out_shardings=NamedSharding(mesh, spec),
+            )
+    """
+    res = run_sources(
+        [
+            ("holo_tpu/ops/_kern_fixture.py", _tw.dedent(kern)),
+            ("holo_tpu/parallel/_mesh_fixture.py", _tw.dedent(builder)),
+        ],
+        LintConfig(),
+    )
+    hits = [f for f in res.findings if f.rule == "HL110"]
+    assert hits and hits[0].path == "holo_tpu/ops/_kern_fixture.py", [
+        f.render() for f in res.findings
+    ]
+
+
+def test_hl110_is_error_tier():
+    res = lint(HL110_BAD, OPS)
+    tiers = {f.rule: f.severity for f in res.findings}
+    assert tiers.get("HL110") == "error"
+
+
+# -- HL205: cross-thread publication (ISSUE 14) -------------------------
+
+HL205_BAD = """
+    import threading
+
+    class Fanout:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.rendered = None
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self.rendered = self._render()
+
+        def _render(self):
+            return object()
+
+        def snapshot(self):
+            return self.rendered
+"""
+HL205_SUPPRESSED = """
+    import threading
+
+    class Fanout:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.rendered = None
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self.rendered = self._render()  # holo-lint: disable=HL205
+
+        def _render(self):
+            return object()
+
+        def snapshot(self):
+            return self.rendered
+"""
+HL205_CLEAN = """
+    import threading
+
+    class Fanout:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.rendered = None
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._lock:
+                self.rendered = self._render()
+
+        def _render(self):
+            return object()
+
+        def snapshot(self):
+            with self._lock:
+                return self.rendered
+"""
+
+
+def test_hl205_cross_thread_publication():
+    assert_triple(
+        "HL205", HL205_BAD, HL205_SUPPRESSED, HL205_CLEAN, SHARED
+    )
+
+
+def test_hl205_is_warn_tier_soak():
+    # HL107 precedent: one soak at warn before gate duty — findings
+    # report and ride the JSON output but never exit-1.
+    from holo_tpu.analysis import gate_findings
+
+    res = lint(HL205_BAD, SHARED)
+    f = next(f for f in res.findings if f.rule == "HL205")
+    assert f.severity == "warn"
+    assert f not in gate_findings(res.findings)
+
+
+def test_hl205_approved_seams_are_clean():
+    # COW tuple swap (the Ibus discipline) and a constant flag latch
+    # are approved publications; a write reached only through the
+    # thread path still counts via the self-call closure.
+    src = """
+        import threading
+
+        class Ticker:
+            def __init__(self):
+                self.subs = ()
+                self._closed = False
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._tick()
+
+            def _tick(self):
+                self.subs = tuple(list(self.subs))
+                self._closed = True
+
+            def read_side(self):
+                return self.subs, self._closed
+    """
+    assert "HL205" not in rules_fired(src, SHARED)
+
+
+def test_hl205_registry_thread_root_without_thread_ctor():
+    # `_worker` is in the thread-root registry: the Thread(target=...)
+    # construction may live in a supervisor module the class never
+    # sees, so the name alone marks the method thread-side.
+    src = """
+        class Pipeline:
+            def _worker(self):
+                self.stats = {"n": 1}
+
+            def snapshot(self):
+                return self.stats
+    """
+    assert "HL205" in rules_fired(src, "holo_tpu/pipeline/_fixture.py")
+
+
+def test_hl205_out_of_scope_module_is_ignored():
+    assert rules_fired(HL205_BAD, OUTSIDE) == set()
+
+
+def test_soak_tier_is_exactly_hl205():
+    # The severity-tier contract: HL205 is the ONLY rule still soaking
+    # at warn; promoting it (or adding a new soak) must edit this test.
+    from holo_tpu.analysis import all_rules
+
+    soak = {r.id for r in all_rules() if r.severity == "warn"}
+    assert soak == {"HL205"}
+
+
+# -- suppression audit (ISSUE 14) ---------------------------------------
+
+
+def test_suppression_audit_flags_stale_sites():
+    from holo_tpu.analysis import audit_suppressions
+
+    src = """
+        import jax.numpy as jnp
+
+        def ok(x):
+            return x + 1  # holo-lint: disable=HL101
+    """
+    stale = audit_suppressions(lint(src, OPS))
+    assert len(stale) == 1 and "HL101" in stale[0], stale
+
+
+def test_suppression_audit_live_site_not_flagged():
+    from holo_tpu.analysis import audit_suppressions
+
+    assert audit_suppressions(lint(HL101_SUPPRESSED, OPS)) == []
+
+
+def test_suppression_audit_wrong_rule_id_is_stale():
+    # Suppressing a DIFFERENT rule than the one firing: the HL102
+    # disable does nothing (the HL101 finding still reports) and the
+    # audit calls the comment out as rot.
+    from holo_tpu.analysis import audit_suppressions
+
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def dispatch(g):
+            out = jnp.add(g, 1)
+            return np.asarray(out)  # holo-lint: disable=HL102
+    """
+    res = lint(src, OPS)
+    assert "HL101" in {f.rule for f in res.findings}
+    stale = audit_suppressions(res)
+    assert len(stale) == 1 and "HL102" in stale[0], stale
+
+
+def test_suppression_audit_disable_all_covered():
+    from holo_tpu.analysis import audit_suppressions
+
+    live = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def dispatch(g):
+            out = jnp.add(g, 1)
+            # holo-lint: disable=all
+            return np.asarray(out)
+    """
+    assert audit_suppressions(lint(live, OPS)) == []
+    stale = """
+        import jax.numpy as jnp
+
+        def ok(x):
+            # holo-lint: disable=all
+            return x + 1
+    """
+    out = audit_suppressions(lint(stale, OPS))
+    assert len(out) == 1 and "disable=all" in out[0], out
+
+
+# -- incremental lint cache (ISSUE 14) ----------------------------------
+
+CACHED_BAD_MODULE = """
+import jax.numpy as jnp
+import numpy as np
+
+
+def dispatch(g):
+    out = jnp.add(g, 1)
+    return np.asarray(out)
+"""
+
+
+def _mini_tree(root):
+    pkg = root / "holo_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    mod = pkg / "mod.py"
+    mod.write_text(CACHED_BAD_MODULE)
+    (root / "holo_tpu" / "clean.py").write_text("X = 1\n")
+    return mod
+
+
+def _views(result):
+    return [f.render() for f in result.findings]
+
+
+def test_lint_cache_replays_byte_identical(tmp_path):
+    from holo_tpu.analysis import run_paths_cached
+
+    mod = _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    cold = run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert cold.files_cached == 0 and cold.files_checked == 2
+    assert "HL101" in {f.rule for f in cold.findings}
+    warm = run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert warm.files_cached == warm.files_checked == 2
+    assert _views(warm) == _views(cold)
+    assert warm.rule_seconds == cold.rule_seconds
+
+    # Touch without edit: content hash revalidates, stays cached.
+    import os
+
+    st = mod.stat()
+    os.utime(mod, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    touched = run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert touched.files_cached == 2
+
+
+def test_lint_cache_miss_on_edit_rescans_everything(tmp_path):
+    from holo_tpu.analysis import run_paths_cached
+
+    mod = _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    run_paths_cached([tmp_path / "holo_tpu"], tmp_path, cache_path=cache)
+    mod.write_text(CACHED_BAD_MODULE.replace("np.asarray(out)", "out"))
+    res = run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert res.files_cached == 0  # all-or-nothing: full rescan
+    assert "HL101" not in {f.rule for f in res.findings}
+
+
+def test_lint_cache_miss_on_file_set_change(tmp_path):
+    from holo_tpu.analysis import run_paths_cached
+
+    _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    run_paths_cached([tmp_path / "holo_tpu"], tmp_path, cache_path=cache)
+    (tmp_path / "holo_tpu" / "extra.py").write_text("Y = 2\n")
+    res = run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert res.files_cached == 0 and res.files_checked == 3
+
+
+def test_lint_cache_miss_on_ruleset_change(tmp_path, monkeypatch):
+    from holo_tpu.analysis import cache as cache_mod
+
+    _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    cache_mod.run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    monkeypatch.setattr(
+        cache_mod, "ruleset_fingerprint", lambda: "deadbeefdeadbeef"
+    )
+    res = cache_mod.run_paths_cached(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert res.files_cached == 0  # edited rule set invalidates replay
+
+
+def test_lint_cache_custom_rule_subsets_bypass_cache(tmp_path):
+    # Fixture subsets must never poison the full-registry cache.
+    import json
+
+    from holo_tpu.analysis import run_paths_cached
+    from holo_tpu.analysis.rules_tracer import RULES as TRACER_RULES
+
+    _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    run_paths_cached([tmp_path / "holo_tpu"], tmp_path, cache_path=cache)
+    before = json.loads(cache.read_text())
+    res = run_paths_cached(
+        [tmp_path / "holo_tpu"],
+        tmp_path,
+        rules=[TRACER_RULES[0]()],
+        cache_path=cache,
+    )
+    assert res.files_cached == 0
+    assert json.loads(cache.read_text()) == before
+
+
+def test_lint_cache_self_check_detects_tampered_replay(tmp_path):
+    # The loud-failure mode: a cache whose stored result diverges from
+    # a cold scan of the same tree must be reported, not trusted.
+    import json
+
+    from holo_tpu.analysis import self_check
+
+    _mini_tree(tmp_path)
+    cache = tmp_path / "cache.json"
+    assert (
+        self_check([tmp_path / "holo_tpu"], tmp_path, cache_path=cache)
+        == []
+    )
+    doc = json.loads(cache.read_text())
+    doc["result"]["findings"] = []  # tamper: drop the HL101 finding
+    cache.write_text(json.dumps(doc))
+    mismatches = self_check(
+        [tmp_path / "holo_tpu"], tmp_path, cache_path=cache
+    )
+    assert mismatches and any("cold scan only" in m for m in mismatches)
+
+
+# -- seeded mutation proofs (ISSUE 14 acceptance) -----------------------
+
+from pathlib import Path as _Path
+
+_REPO = _Path(__file__).resolve().parent.parent
+
+
+def test_mutation_dropping_constrain_replicated_caught_by_hl110():
+    """Teeth proof: delete the PR-13 GSPMD firewall from a scratch
+    copy of ops/tropical.py and HL110 must catch exactly that."""
+    path = "holo_tpu/ops/tropical.py"
+    src = (_REPO / path).read_text()
+    fenced = "cond, body, (_constrain_replicated(aff0), jnp.bool_(True), 0)"
+    assert fenced in src, "mutation anchor moved — update this test"
+    assert "HL110" not in {
+        f.rule for f in run_source(src, path).findings
+    }
+    mutated = src.replace(
+        fenced, "cond, body, (aff0, jnp.bool_(True), 0)"
+    )
+    res = run_source(mutated, path)
+    hits = [f for f in res.findings if f.rule == "HL110"]
+    assert hits and any("aff0" in f.message for f in hits), [
+        f.render() for f in res.findings
+    ]
+
+
+def test_mutation_rereading_donated_prev_caught_by_hl109():
+    """Teeth proof: retain the donated previous tensors after the
+    DeltaPath dispatch in a scratch copy of spf/backend.py and HL109
+    must catch exactly that."""
+    path = "holo_tpu/spf/backend.py"
+    src = (_REPO / path).read_text()
+    anchor = 'note_donated("spf.one.delta", prev)'
+    assert anchor in src, "mutation anchor moved — update this test"
+    assert "HL109" not in {
+        f.rule for f in run_source(src, path).findings
+    }
+    mutated = src.replace(
+        anchor, anchor + "\n        self._stale_prev = prev"
+    )
+    res = run_source(mutated, path)
+    hits = [f for f in res.findings if f.rule == "HL109"]
+    assert hits and any("retained" in f.message for f in hits), [
+        f.render() for f in res.findings
+    ]
+
+
+def test_hl109_self_rebind_is_clean():
+    # `prev = step(g, prev)` rebinds prev to the FRESH output — the
+    # natural incremental-dispatch style must not keep the old taint
+    # (the sorted walk visits the Assign before its value Call, so the
+    # rebind kill replays after the donation taints).
+    src = """
+        import jax
+
+        _STEP = jax.jit(lambda g, prev, seeds: g, donate_argnums=(1,))
+
+        def dispatch(g, prev, seeds):
+            prev = _STEP(g, prev, seeds)
+            use = prev + 1
+            return use, prev
+    """
+    res = lint(src, OPS)
+    assert "HL109" not in {f.rule for f in res.findings}, [
+        f.render() for f in res.findings
+    ]
+
+
+def test_hl109_tuple_rebind_is_clean():
+    src = """
+        import jax
+
+        _STEP = jax.jit(lambda g, prev, seeds: (g, g), donate_argnums=(1,))
+
+        def dispatch(g, prev, seeds):
+            prev, aux = _STEP(g, prev, seeds)
+            return prev + aux
+    """
+    res = lint(src, OPS)
+    assert "HL109" not in {f.rule for f in res.findings}, [
+        f.render() for f in res.findings
+    ]
+
+
+def test_donation_guard_env_knob_arms_at_import():
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from holo_tpu.analysis.runtime import donation_guard_armed;"
+        "print(donation_guard_armed())"
+    )
+    for val, want in (("1", "True"), ("0", "False")):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "HOLO_TPU_DONATION_GUARD": val},
+            capture_output=True,
+            text=True,
+            cwd=_REPO,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == want, (val, out.stdout, out.stderr)
+
+
+def test_cli_self_check_refuses_adhoc_paths():
+    # --self-check exercises the default cache file; over an ad-hoc
+    # path set it would store that partial file set and force the next
+    # gate run cold, so the CLI refuses (usage error, cache untouched).
+    import subprocess
+    import sys
+
+    cache = _REPO / ".holo_lint_cache.json"
+    before = cache.read_bytes() if cache.exists() else None
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "holo_tpu.tools.cli",
+            "lint",
+            "--self-check",
+            "holo_tpu/ops",
+        ],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 2 and "--self-check" in out.stderr
+    after = cache.read_bytes() if cache.exists() else None
+    assert before == after

@@ -3,13 +3,22 @@
 # discipline, ratcheted against holo_tpu/analysis/baseline.json.
 #
 # Usage:
-#   tools/lint.sh            # gate (exit 0 clean, 1 new findings)
-#   tools/lint.sh --json     # machine-readable report
+#   tools/lint.sh            # gate (exit 0 clean, 1 new findings or
+#                            #       stale suppressions)
+#   tools/lint.sh --json     # machine-readable report (schema_version 2)
 #   tools/lint.sh --list-rules
+#   tools/lint.sh --no-cache # force a full scan
+#
+# The gate audits suppressions by default (--check-suppressions): a
+# `# holo-lint: disable=` comment whose rule no longer fires there is
+# rot and fails the gate.  Repeat runs on an unchanged tree replay the
+# incremental cache (.holo_lint_cache.json, gitignored); the in-pytest
+# arm (tests/test_lint_repo_clean.py) self-checks the cache against a
+# cold scan every run, so a divergent replay fails tier-1 loudly.
 #
 # Wire as a pre-commit hook with:
 #   ln -s ../../tools/lint.sh .git/hooks/pre-commit
 set -eu
 cd "$(dirname "$0")/.."
 exec python -m holo_tpu.tools.cli lint \
-    --baseline holo_tpu/analysis/baseline.json "$@"
+    --baseline holo_tpu/analysis/baseline.json --check-suppressions "$@"
