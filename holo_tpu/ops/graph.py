@@ -72,6 +72,14 @@ class Topology:
     # Root vertex index (the calculating router).
     root: int = 0
     names: list = field(default_factory=list)  # optional, debugging only
+    # Native partition hint (ISSUE 15): per-vertex group id stamped by
+    # the protocol layer at the marshal seam (OSPF area / IS-IS level
+    # membership via spf_run.apply_partition_hint) or by synth multi-
+    # area builders.  ``partition_topology`` honors it verbatim; None
+    # means "flat" and the deterministic BFS/greedy cut decides.  Like
+    # edge_srlg it never enters the DeviceGraph planes, so DeltaPath
+    # residents cannot serve it stale.
+    partition_hint: np.ndarray | None = None
 
     def __post_init__(self) -> None:
         self.is_router = np.asarray(self.is_router, dtype=bool)
@@ -86,6 +94,8 @@ class Topology:
             self.edge_srlg = np.zeros(self.edge_src.shape, np.uint32)
         else:
             self.edge_srlg = np.asarray(self.edge_srlg, np.uint32)
+        if self.partition_hint is not None:
+            self.partition_hint = np.asarray(self.partition_hint, np.int32)
         # Identity for device-marshaling caches: a process-unique id plus a
         # generation bumped by touch().  Callers mutating arrays in place
         # MUST call touch() or cached DeviceGraphs go stale.
@@ -146,6 +156,7 @@ class Topology:
             edge_srlg=self.edge_srlg[keep],
             root=self.root,
             names=self.names,
+            partition_hint=self.partition_hint,
         )
 
 
@@ -339,6 +350,187 @@ class TopologyDelta:
         return np.unique(np.concatenate([_i32(r) for r in rows]))
 
 
+def _undirected_adjacency(
+    n: int, edge_src: np.ndarray, edge_dst: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """CSR (indptr, indices) of the undirected structure, neighbor
+    lists sorted ascending — the shared basis of the BFS/greedy cut and
+    the RCM bandwidth permutation (both must be deterministic)."""
+    src = np.concatenate([edge_src, edge_dst]).astype(np.int64)
+    dst = np.concatenate([edge_dst, edge_src]).astype(np.int64)
+    order = np.lexsort((dst, src))
+    src, dst = src[order], dst[order]
+    # Dedup parallel/mirrored entries.
+    if src.shape[0]:
+        keep = np.ones(src.shape[0], bool)
+        keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+        src, dst = src[keep], dst[keep]
+    indptr = np.zeros(n + 1, np.int64)
+    np.add.at(indptr, src + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    return indptr, dst.astype(np.int32)
+
+
+def partition_topology(
+    topo: Topology,
+    n_parts: int | None = None,
+    max_part: int | None = None,
+) -> np.ndarray:
+    """int32[N] partition assignment (ids 0..P-1, every id non-empty).
+
+    Native structure first: a stamped ``partition_hint`` (OSPF areas /
+    IS-IS levels via the protocol seams, or synth multi-area builders)
+    is honored verbatim — distinct hint values map onto dense partition
+    ids in ascending hint order.  Flat graphs get a deterministic
+    METIS-style greedy cut: BFS-grow regions of ~``ceil(N / n_parts)``
+    vertices (or ``max_part``) from the lowest-indexed unassigned
+    vertex, neighbors visited in ascending id order — locality-seeking
+    like a KL/METIS first pass, with none of their randomized
+    refinement so every run cuts identically.
+    """
+    n = topo.n_vertices
+    hint = topo.partition_hint
+    if hint is not None:
+        if hint.shape[0] != n:
+            raise ValueError(
+                f"partition_hint has {hint.shape[0]} entries, "
+                f"topology has {n} vertices"
+            )
+        _, dense = np.unique(hint, return_inverse=True)
+        return dense.astype(np.int32)
+    if max_part is None:
+        if n_parts is None or n_parts < 1:
+            raise ValueError("need n_parts or max_part for a flat cut")
+        max_part = -(-n // int(n_parts))
+    max_part = max(int(max_part), 1)
+    indptr, nbrs = _undirected_adjacency(n, topo.edge_src, topo.edge_dst)
+    part = np.full(n, -1, np.int32)
+    next_part = 0
+    cursor = 0  # lowest possibly-unassigned vertex
+    while cursor < n:
+        if part[cursor] >= 0:
+            cursor += 1
+            continue
+        # BFS-grow one region from the seed until the size target.
+        frontier = [cursor]
+        part[cursor] = next_part
+        size = 1
+        while frontier and size < max_part:
+            nxt: list[int] = []
+            for v in frontier:
+                for u in nbrs[indptr[v]: indptr[v + 1]]:
+                    if part[u] < 0:
+                        part[u] = next_part
+                        nxt.append(int(u))
+                        size += 1
+                        if size >= max_part:
+                            break
+                if size >= max_part:
+                    break
+            frontier = nxt
+        next_part += 1
+    # Fragment cleanup: greedy growth strands leftover vertices whose
+    # neighbors were all claimed (classic first-pass artifact) as tiny
+    # regions that would bloat the skeleton.  Deterministically merge
+    # every undersized region into its most-connected neighbor region
+    # (ties -> lowest region id), smallest regions first.
+    min_size = max(2, max_part // 4)
+    sizes = np.bincount(part, minlength=next_part).astype(np.int64)
+    esrc_p = part[topo.edge_src]
+    edst_p = part[topo.edge_dst]
+    alive = sizes > 0
+    for _ in range(next_part):
+        small = [
+            p for p in range(next_part)
+            if alive[p] and sizes[p] < min_size
+        ]
+        if not small:
+            break
+        p = min(small, key=lambda q: (sizes[q], q))
+        cut = esrc_p != edst_p
+        touch = np.concatenate(
+            [edst_p[cut & (esrc_p == p)], esrc_p[cut & (edst_p == p)]]
+        )
+        if touch.shape[0] == 0:
+            # Isolated component: nothing to merge into — keep it.
+            alive[p] = False
+            continue
+        counts = np.bincount(touch, minlength=next_part)
+        target = int(np.argmax(counts))  # argmax: lowest id wins ties
+        part[part == p] = target
+        esrc_p = part[topo.edge_src]
+        edst_p = part[topo.edge_dst]
+        sizes[target] += sizes[p]
+        sizes[p] = 0
+        alive[p] = False
+    # Dense ids in ascending surviving-region order.
+    _, dense = np.unique(part, return_inverse=True)
+    return dense.astype(np.int32)
+
+
+def bandwidth_permutation(
+    n: int, edge_src: np.ndarray, edge_dst: np.ndarray
+) -> np.ndarray:
+    """Reverse Cuthill-McKee ordering: int32[n] ``perm`` with
+    ``perm[new] = old`` — relabeling vertices by it clusters each
+    vertex's neighbors into nearby indices, which cuts off-diagonal
+    block fill-in in blocked (tile) layouts and shrinks the butterfly
+    working set of banded gathers.  Deterministic: components start at
+    their minimum-degree (then lowest-id) vertex in ascending id order,
+    BFS visits neighbors in ascending (degree, id) order, and the final
+    order is reversed (the classic RCM profile reduction).
+    """
+    indptr, nbrs = _undirected_adjacency(
+        n, np.asarray(edge_src), np.asarray(edge_dst)
+    )
+    deg = np.diff(indptr)
+    seen = np.zeros(n, bool)
+    chunks: list[np.ndarray] = []
+    # Component seeds in ascending (degree, id) order.  BFS levels are
+    # processed whole (vectorized — this runs on the tile/partition
+    # marshal path at 100k+ vertices): each unseen child joins at its
+    # FIRST parent's rank and a level orders by (parent rank, degree,
+    # id), which is exactly the classic per-vertex FIFO expansion with
+    # per-parent (degree, id)-sorted children.
+    seed_rank = np.lexsort((np.arange(n), deg))
+    for s in seed_rank:
+        if seen[s]:
+            continue
+        seen[s] = True
+        frontier = np.asarray([s], np.int64)
+        chunks.append(frontier)
+        while frontier.shape[0]:
+            counts = indptr[frontier + 1] - indptr[frontier]
+            total = int(counts.sum())
+            if total == 0:
+                break
+            # Gather all frontier out-neighbors (ragged -> flat).
+            flat = np.repeat(
+                indptr[frontier] - np.concatenate(
+                    [[0], np.cumsum(counts)[:-1]]
+                ),
+                counts,
+            ) + np.arange(total)
+            childs = nbrs[flat].astype(np.int64)
+            prank = np.repeat(np.arange(frontier.shape[0]), counts)
+            fresh = ~seen[childs]
+            childs, prank = childs[fresh], prank[fresh]
+            if childs.shape[0] == 0:
+                break
+            # First-parent assignment: minimal rank per child.
+            first = np.lexsort((prank, childs))
+            childs, prank = childs[first], prank[first]
+            keep = np.ones(childs.shape[0], bool)
+            keep[1:] = childs[1:] != childs[:-1]
+            childs, prank = childs[keep], prank[keep]
+            level = childs[np.lexsort((childs, deg[childs], prank))]
+            seen[level] = True
+            chunks.append(level)
+            frontier = level
+    order = np.concatenate(chunks) if chunks else np.empty(0, np.int64)
+    return order[::-1].astype(np.int32)
+
+
 def diff_topologies(
     base: Topology, new: Topology, max_ops: int = 512
 ) -> TopologyDelta | None:
@@ -357,6 +549,14 @@ def diff_topologies(
         base.n_vertices != new.n_vertices
         or base.root != new.root
         or not np.array_equal(base.is_router, new.is_router)
+    ):
+        return None
+    # A changed native partition hint changes the cut geometry the
+    # partitioned-SPF resident was planned over (ISSUE 15) — not
+    # delta-representable; re-marshal.
+    bh, nh = base.partition_hint, new.partition_hint
+    if (bh is None) != (nh is None) or (
+        bh is not None and not np.array_equal(bh, nh)
     ):
         return None
     if base.n_edges == new.n_edges and (

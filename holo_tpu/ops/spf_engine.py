@@ -434,7 +434,12 @@ class DeviceGraphCache:
     need a read-lease before donation could stay.
     """
 
-    def __init__(self, capacity: int = 16, max_delta_depth: int = 256):
+    def __init__(
+        self,
+        capacity: int = 16,
+        max_delta_depth: int = 256,
+        part_capacity: int = 8,
+    ):
         import threading
 
         self.capacity = int(capacity)
@@ -443,6 +448,50 @@ class DeviceGraphCache:
         self._cache: dict[tuple, _CacheEntry] = {}
         self._evictions = 0
         self._deltas_applied = 0
+        # Partitioned-SPF residents (ISSUE 15): stacked per-partition
+        # plane sets (ops/partition.PartResident) ride the SAME shared
+        # cache — one lock discipline, one LRU/eviction surface — in a
+        # parallel keyed store (their key is the serving chain
+        # (backend, root, n_atoms, mesh), not a topology generation:
+        # the resident advances in place along its delta chain).  The
+        # engine's in-place donation update imposes the same narrowed
+        # contract as _CacheEntry: a resident obtained from an earlier
+        # lookup is invalidated when a later delta donates its planes.
+        self.part_capacity = int(part_capacity)
+        self._part: dict[tuple, object] = {}
+
+    def get_partitioned(self, key: tuple):
+        """The partitioned resident serving ``key`` (LRU-refreshed), or
+        None.  Callers validate the resident's ``topo_key`` themselves
+        — chain identity lives on the resident, not the store."""
+        with self._lock:
+            res = self._part.get(key)
+            if res is not None:
+                del self._part[key]
+                self._part[key] = res
+        return res
+
+    def put_partitioned(self, key: tuple, res) -> None:
+        with self._lock:
+            self._part[key] = res
+            while len(self._part) > self.part_capacity:
+                self._part.pop(next(iter(self._part)))
+                self._evictions += 1
+                _CACHE_EVICTIONS.inc()
+
+    def drop_partitioned(self, key: tuple) -> None:
+        with self._lock:
+            self._part.pop(key, None)
+
+    def partitioned_entries(self, namespace=None) -> dict:
+        """key -> resident snapshot (optionally filtered to one
+        backend's ``namespace`` — key[0] by the backend's convention)."""
+        with self._lock:
+            return {
+                k: v
+                for k, v in self._part.items()
+                if namespace is None or k[0] == namespace
+            }
 
     def _depth_cap(self, topo) -> int:
         """The chain-depth cap for this topology's shape bucket.
@@ -703,6 +752,7 @@ class DeviceGraphCache:
             entries = list(self._cache.values())
             evictions = self._evictions
             applied = self._deltas_applied
+            part_residents = list(self._part.values())
         depths = [e.depth for e in entries]
         occ = [e.mirror.occupancy for e in entries]
         from holo_tpu.parallel import mesh as _pm
@@ -750,6 +800,10 @@ class DeviceGraphCache:
                 1 for e in entries if e.tropical is not None
             ),
             "occupancy": round(sum(occ) / len(occ), 4) if occ else 0.0,
+            "partitioned-residents": len(part_residents),
+            "partitioned-parts": sum(
+                r.plan.n_parts for r in part_residents
+            ),
             "sharded-entries": sharded,
             "mesh": (
                 {"batch": mesh.shape["batch"], "node": mesh.shape["node"]}
@@ -766,6 +820,7 @@ class DeviceGraphCache:
     def clear(self) -> None:
         with self._lock:
             self._cache.clear()
+            self._part.clear()
 
 
 _SHARED_GRAPH_CACHE = DeviceGraphCache()

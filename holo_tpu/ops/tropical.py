@@ -125,11 +125,25 @@ class TropicalTiles(NamedTuple):
     scatter-combining per-tile results — broadcast-add + multi-axis
     min, one fused dense contraction, no scatter on the hot path.
     Vertices pad to ``NB * B``; padded rows/columns are all-INF inert.
+
+    The tile vertex space is PERMUTED (ISSUE 15 satellite): marshal
+    relabels vertices by the RCM bandwidth permutation before blocking,
+    which clusters each vertex's neighborhood into nearby indices and
+    cuts the off-diagonal block fill-in (fewer materialized tiles per
+    row block = fewer padded contraction entries per round).  ``perm``
+    maps permuted rows back to external ids (pad rows carry 0 — their
+    tile columns are all-INF, so the gathered value is inert) and
+    ``inv`` maps external ids in.  The permutation is applied at the
+    kernels' entry gathers and inverted at their exits: every external
+    surface (dist vectors, repair rows, masks, results) stays in
+    external id space, bit-identical to the unpermuted engine.
     """
 
-    tiles: jax.Array  # int32[NB, Tm, B, B]
+    tiles: jax.Array  # int32[NB, Tm, B, B] (permuted space)
     cb: jax.Array  # int32[NB, Tm]; NB = pad sentinel
     pos: jax.Array  # int32[NB, NB]; value Tm = no tile
+    perm: jax.Array  # int32[NB*B]: permuted row -> external id (pad: 0)
+    inv: jax.Array  # int32[N]: external id -> permuted row
 
 
 class TileDeltaUnappliable(Exception):
@@ -193,6 +207,17 @@ def build_tiles_host(
     rows, cols = np.nonzero(in_valid)
     srcs = in_src[rows, cols].astype(np.int64)
     costs = in_cost[rows, cols]
+    # RCM relabeling before blocking (ISSUE 15 satellite): banded
+    # structure -> fewer distinct (row block, col block) pairs -> a
+    # smaller padded slot axis.  Purely internal — perm/inv planes map
+    # every kernel surface back to external ids.
+    from holo_tpu.ops.graph import bandwidth_permutation
+
+    perm = bandwidth_permutation(n, srcs, rows)  # perm[new] = old
+    inv = np.empty(n, np.int32)
+    inv[perm] = np.arange(n, dtype=np.int32)
+    rows = inv[rows].astype(np.int64)
+    srcs = inv[srcs.astype(np.int64)].astype(np.int64)
     b = int(block) if block is not None else _pick_block(n, rows, srcs)
     nb = max(-(-n // b), 1)
     if rows.size:
@@ -224,10 +249,14 @@ def build_tiles_host(
         cb = np.full((nb, 1), nb, np.int32)
         tiles = np.full((nb, 1, b, b), INF, np.int32)
         n_pairs = 0
-    tt = TropicalTiles(tiles=tiles, cb=cb, pos=pos)
+    perm_pad = np.zeros(nb * b, np.int32)
+    perm_pad[:n] = perm
+    tt = TropicalTiles(
+        tiles=tiles, cb=cb, pos=pos, perm=perm_pad, inv=inv
+    )
     meta = {
         "block": b, "nb": nb, "tm": tm, "pos": pos.copy(), "n": n,
-        "pairs": n_pairs,
+        "pairs": n_pairs, "perm": perm.copy(), "inv": inv.copy(),
     }
     _MARSHALS.inc()
     _MARSHAL_SECONDS.observe(time.perf_counter() - t0)
@@ -248,6 +277,7 @@ def lower_tile_delta(mirror, delta, meta):
     from holo_tpu.ops.spf_engine import _pad_pow2
 
     b, nb, tm, grid = meta["block"], meta["nb"], meta["tm"], meta["pos"]
+    inv = meta["inv"]  # external id -> permuted tile row
     pairs = set()
     for s, d in zip(delta.r_src, delta.r_dst):
         pairs.add((int(s), int(d)))
@@ -257,18 +287,21 @@ def lower_tile_delta(mirror, delta, meta):
         pairs.add((int(s), int(d)))
     ops = []
     for u, v in sorted(pairs):
-        slot = int(grid[v // b, u // b])
+        pu, pv = int(inv[u]), int(inv[v])
+        slot = int(grid[pv // b, pu // b])
         if slot >= tm:
             # No tile holds this block pair.  Removals/re-costs of an
             # existing edge always have one; only additions can miss.
             raise TileDeltaUnappliable("tile-missing")
         m = mirror.in_valid[v] & (mirror.in_src[v] == u)
         val = int(mirror.in_cost[v][m].min()) if m.any() else int(INF)
-        ops.append((v // b, slot, v % b, u % b, val))
+        ops.append((pv // b, slot, pv % b, pu % b, val))
     npad = nb * b
+    # Strike mask in PERMUTED space (apply_tile_delta's colv indexes
+    # the tile vertex space).
     strike = np.zeros(npad, bool)
     if delta.overload.shape[0]:
-        strike[delta.overload] = True
+        strike[inv[delta.overload]] = True
     pad = _pad_pow2(len(ops))
     trb = np.full(pad, nb, np.int32)  # OOB row block: dropped
     tsl = np.zeros(pad, np.int32)
@@ -343,12 +376,13 @@ def _tile_relax(g, tt: TropicalTiles, dist0, masks, repair_rows, limit):
     ell, s = dist0.shape
     nb, tm, b, _ = tt.tiles.shape
     npad = nb * b
+    n_true = tt.inv.shape[0]  # real vertex count (<= ell <= npad)
     inf = jnp.int32(INF)
     m = 0 if repair_rows is None else repair_rows.shape[1]
     if m:
         k = g.in_src.shape[1]
         fr_safe = jnp.minimum(repair_rows, ell - 1)  # [S, M]
-        r_nbr = g.in_src[fr_safe]  # [S, M, K]
+        r_nbr = g.in_src[fr_safe]  # [S, M, K] external source ids
         r_cost = g.in_cost[fr_safe]
         r_ok = g.in_valid[fr_safe]
         if masks is not None and masks.shape[1] > 0:
@@ -356,9 +390,15 @@ def _tile_relax(g, tt: TropicalTiles, dist0, masks, repair_rows, limit):
             r_ok = r_ok & jnp.take_along_axis(
                 masks, ids.reshape(s, m * k), axis=1
             ).reshape(s, m, k)
-        # Sentinel rows (>= the graph's row count) must DROP on the
-        # repair scatter even when the tile vertex space pads further.
-        r_idx = jnp.where(repair_rows >= ell, npad, repair_rows)
+        # The loop carry lives in PERMUTED tile space: repair gathers
+        # map source ids in, the scatter maps target rows in.  Sentinel
+        # rows (>= the real vertex count) must DROP on the scatter.
+        r_nbr_p = tt.inv[r_nbr]  # [S, M, K] permuted rows
+        r_idx = jnp.where(
+            repair_rows >= n_true,
+            npad,
+            tt.inv[jnp.minimum(repair_rows, n_true - 1)],
+        )
 
     # The loop carries the TILE-padded [npad, S] state: every in-loop
     # reshape is then exactly block-divisible (no per-round pad/slice —
@@ -411,7 +451,7 @@ def _tile_relax(g, tt: TropicalTiles, dist0, masks, repair_rows, limit):
             # tile value may undercut the masked truth there, so the
             # ELL row relax REPLACES (never mins with) the aggregate.
             dn = jnp.take_along_axis(
-                dist.T, r_nbr.reshape(s, m * k), axis=1
+                dist.T, r_nbr_p.reshape(s, m * k), axis=1
             ).reshape(s, m, k)
             okr = r_ok & (dn < inf)
             cr = jnp.where(okr, dn + r_cost, inf).min(axis=2)  # [S, M]
@@ -424,17 +464,21 @@ def _tile_relax(g, tt: TropicalTiles, dist0, masks, repair_rows, limit):
         return new, act, jnp.any(ch), it + 1
 
     act0 = jnp.ones((nb, s), bool)
+    # Permuted entry/exit gathers: pad rows read external row 0 — their
+    # tile columns are all-INF, so the seeded value is inert (never
+    # improved, never a contribution, never read back).
+    dist0_p = dist0[tt.perm]
     dist, _, _, _ = jax.lax.while_loop(
         cond,
         body,
         (
-            _constrain_replicated(_pad_rows_to(dist0, npad, inf)),
+            _constrain_replicated(dist0_p),
             act0,
             jnp.bool_(True),
             0,
         ),
     )
-    return _constrain_replicated(_pad_rows_to(dist, ell, inf))
+    return _constrain_replicated(_pad_rows_to(dist[tt.inv], ell, inf))
 
 
 def _constrain_replicated(x):
@@ -471,18 +515,23 @@ def _count_tiles(g, tt: TropicalTiles, slot_flag):
     and padded rows drop via the pos-grid sentinel."""
     n, _ = g.in_src.shape
     nb, tm, b, _ = tt.tiles.shape
+    n_true = tt.inv.shape[0]
     v = jnp.arange(n, dtype=jnp.int32)[:, None]
     # Replicated operands for the count scatter: a row-sharded in_src /
     # slot flag would run the scatter-add per shard (the same sharding
     # hazard _constrain_replicated fences in the relax loop).
     src = _constrain_replicated(g.in_src)
     flag = _constrain_replicated(slot_flag)
-    vb = jnp.minimum(v // b, nb - 1)
-    sb = jnp.minimum(src // b, nb - 1)
+    # Tile space is permuted: map rows/sources in (flag is False on
+    # padded graph rows, so the clamped gather never mis-scatters).
+    pv = tt.inv[jnp.minimum(v, n_true - 1)]
+    ps = tt.inv[src]
+    vb = pv // b
+    sb = ps // b
     slot = jnp.where(flag, tt.pos[vb, sb], tm)  # Tm = dropped
     return _constrain_replicated(
         jnp.zeros((nb, tm, b, b), jnp.int32)
-        .at[vb, slot, v % b, src % b]
+        .at[vb, slot, pv % b, ps % b]
         .add(jnp.where(flag, 1, 0), mode="drop")
     )
 
@@ -496,10 +545,12 @@ def _np_tile_fixpoint(g, tt, dag, root, np0, limit):
     n = g.in_src.shape[0]
     nb, tm, b, _ = tt.tiles.shape
     npad = nb * b
+    n_true = tt.inv.shape[0]
     sat = jnp.int32(MP_SAT)
     is_root = jnp.arange(n) == root
     dagc = _count_tiles(g, tt, dag)
     cb_safe = jnp.minimum(tt.cb, nb - 1)  # sentinel blocks: dagc is 0
+    pvalid = jnp.arange(npad) < n_true  # real permuted rows
 
     def cond(carry):
         _, changed, it = carry
@@ -507,14 +558,16 @@ def _np_tile_fixpoint(g, tt, dag, root, np0, limit):
 
     def body(carry):
         np_, _, it = carry
-        blk = _pad_rows_to(np_, npad, jnp.int32(0)).reshape(nb, b)
+        # The carry stays external; the contraction runs in permuted
+        # tile space (gather in, gather out).
+        blk = jnp.where(pvalid, np_[tt.perm], 0).reshape(nb, b)
         # Row-block combine IS the contraction's slot axis — no
         # scatter: sum over (slot, j) of count * npaths[src].
         tot = jnp.einsum(
             "rtij,rtj->ri", dagc, blk[cb_safe],
             preferred_element_type=jnp.int32,
         )
-        tot = _pad_rows_to(tot.reshape(npad), n, jnp.int32(0))
+        tot = _pad_rows_to(tot.reshape(npad)[tt.inv], n, jnp.int32(0))
         new = jnp.where(is_root, 1, jnp.minimum(tot, sat)).astype(jnp.int32)
         return new, jnp.any(new != np_), it + 1
 
@@ -534,6 +587,7 @@ def _aw_tile_fixpoint(g, tt, dag, hops, npaths, aw0, limit):
     n = g.in_src.shape[0]
     nb, tm, b, _ = tt.tiles.shape
     npad = nb * b
+    n_true = tt.inv.shape[0]
     sat = jnp.int32(MP_SAT)
     h_nbr = hops[g.in_src]  # one [N, K] gather, once (not per round)
     np_nbr = npaths[g.in_src]
@@ -545,6 +599,7 @@ def _aw_tile_fixpoint(g, tt, dag, hops, npaths, aw0, limit):
     )
     inhc = _count_tiles(g, tt, inherit)
     cb_safe = jnp.minimum(tt.cb, nb - 1)  # sentinel blocks: inhc is 0
+    pvalid = jnp.arange(npad) < n_true  # real permuted rows
 
     def cond(carry):
         _, changed, it = carry
@@ -553,14 +608,17 @@ def _aw_tile_fixpoint(g, tt, dag, hops, npaths, aw0, limit):
     def body(carry):
         aw, _, it = carry
         a = aw.shape[1]
-        blk = _pad_rows_to(aw, npad, jnp.int32(0)).reshape(nb, b, a)
+        # External carry, permuted contraction (see _np_tile_fixpoint).
+        blk = jnp.where(
+            pvalid[:, None], aw[tt.perm], 0
+        ).reshape(nb, b, a)
         # THE dense [NB,Tm,B,B]x[NB,Tm,B,A] contraction: the k>1
         # A-lane's per-round gather bytes as contraction flops.
         inh = jnp.einsum(
             "rtij,rtja->ria", inhc, blk[cb_safe],
             preferred_element_type=jnp.int32,
         )
-        inh = _pad_rows_to(inh.reshape(npad, a), n, jnp.int32(0))
+        inh = _pad_rows_to(inh.reshape(npad, a)[tt.inv], n, jnp.int32(0))
         new = jnp.minimum(seed + inh, sat).astype(jnp.int32)
         return new, jnp.any(new != aw), it + 1
 

@@ -175,7 +175,7 @@ def tile_sharding(mesh: Mesh):
     from holo_tpu.ops.tropical import TropicalTiles
 
     rep = NamedSharding(mesh, P())
-    return TropicalTiles(tiles=rep, cb=rep, pos=rep)
+    return TropicalTiles(tiles=rep, cb=rep, pos=rep, perm=rep, inv=rep)
 
 
 def shard_tiles(tt, mesh: Mesh):
@@ -312,6 +312,33 @@ def sharded_whatif_jit(
         return constrain_batch(mesh, out)
 
     return step
+
+
+def replicated_sharding(mesh: Mesh):
+    """A fully-replicated NamedSharding (the fallback placement for
+    partition batches that do not divide the batch axis)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_part_planes(mesh: Mesh, planes):
+    """Place stacked partitioned-SPF planes (ISSUE 15) with the
+    partition axis sharded over ``batch`` — the same axis the what-if
+    scenario batch rides; every lane is an independent small program,
+    so GSPMD fans the partition set across the batch devices.  The
+    caller guarantees the partition axis divides the batch axis."""
+
+    def put(x):
+        spec = P(*(("batch",) + (None,) * (x.ndim - 1)))
+        return jax.device_put(np.asarray(x), NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, planes)
+
+
+def constrain_parts(mesh: Mesh, out):
+    """Pin a partitioned-solve result pytree's leading (partition) axis
+    to the batch sharding — the partition edition of
+    :func:`constrain_batch` (no-op on a 1-device mesh)."""
+    return constrain_batch(mesh, out)
 
 
 def sharded_multipath_jit(mesh: Mesh, kp: int, max_iters: int | None = None):

@@ -705,6 +705,21 @@ class AsyncSpfBackend:
                 run=lambda: inner.compute(topo, edge_mask),
             )
             return LazySpfResult(ticket)
+        use_part = getattr(inner, "_use_partitioned", None)
+        if use_part is not None and use_part(topo):
+            # Partitioned SPF (ISSUE 15) is a host-orchestrated
+            # multi-dispatch (boundary solve -> skeleton stitch ->
+            # halo-exchange rounds) with no single launch/finish seam:
+            # run it whole on the worker.  Ordering still holds — the
+            # per-key serialization covers the resident's donated
+            # plane handoff exactly like the split-phase chains.
+            ticket = pipe.submit(
+                self._key(topo), "one",
+                run=lambda: inner.compute(
+                    topo, edge_mask, multipath_k=multipath_k
+                ),
+            )
+            return LazySpfResult(ticket)
         fallback = lambda: inner._noted_fallback(  # noqa: E731
             lambda: inner._oracle.compute(
                 topo, edge_mask, multipath_k=multipath_k

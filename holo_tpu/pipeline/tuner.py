@@ -338,6 +338,42 @@ class EngineTuner:
                 "bytes": float(entry.get("bytes", 0.0)),
             }
 
+    # -- partitioned-SPF arbitration (ISSUE 15) ------------------------
+
+    def observe_partitioned(self, bucket: tuple, seconds: float) -> None:
+        """One measured partitioned-SPF dispatch wall for this shape
+        bucket.  Partitioned rows live under their own kind (they are a
+        different PROGRAM STRUCTURE, not another parity-identical
+        engine), so the kind=one explore/exploit schedule can never
+        pick 'partitioned' for a monolithic dispatch — the threshold
+        contract in ``TpuSpfBackend`` stays the routing authority and
+        the table carries the measured evidence."""
+        self.observe("partitioned", bucket, "partitioned", seconds)
+
+    def partitioned_advantage(self, bucket: tuple) -> float | None:
+        """median(monolithic winner wall) / median(partitioned wall)
+        for one shape bucket — >1 means the partitioned path is
+        measured faster at this shape.  None until both arms have
+        samples (bench/operators read this; the backend's
+        ``partition_threshold`` is deliberately not auto-flipped by
+        it)."""
+        with self._lock:
+            st_p = self._table.get(self._key("partitioned", bucket))
+            p_med = (
+                _median(st_p.samples.get("partitioned", ()))
+                if st_p is not None
+                else None
+            )
+            st_o = self._table.get(self._key("one", bucket))
+            o_med = None
+            if st_o is not None:
+                w = self._winner_locked(st_o)
+                if w is not None:
+                    o_med = _median(st_o.samples.get(w, ()))
+        if not p_med or not o_med:
+            return None
+        return o_med / p_med
+
     # -- DeltaPath depth tuning ----------------------------------------
 
     def observe_delta(self, bucket: tuple, seconds: float) -> None:

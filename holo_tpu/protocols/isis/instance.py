@@ -1873,9 +1873,13 @@ class IsisInstance(Actor):
             node = nodes.setdefault(
                 key,
                 {"is": [], "ip": [], "ip6": [], "is6": [], "ip6mt": [],
-                 "flags": 0, "mt": {}, "protos": set()},
+                 "flags": 0, "mt": {}, "protos": set(), "areas": []},
             )
             tlvs = e.lsp.tlvs
+            # Advertised area addresses (TLV 1; routers only — the
+            # native hierarchical grouping the partitioned-SPF hint
+            # reads, ISSUE 15).
+            node["areas"].extend(tlvs.get("area_addresses") or ())
             node["is"].extend(tlvs.get("ext_is_reach", []))
             node["ip"].extend(tlvs.get("ext_ip_reach", []))
             node["ip6"].extend(tlvs.get("ipv6_reach", []))
@@ -2049,6 +2053,31 @@ class IsisInstance(Actor):
                     apply_interface_srlg(
                         topo, [a[0] for a in atoms], iface_srlg
                     )
+                # Native hierarchical partition hint (ISSUE 15): group
+                # vertices by advertised area address — an L2 topology
+                # spans areas and cuts along them; L1 (single area)
+                # stays flat (apply_partition_hint stamps only when
+                # >=2 groups cover every vertex).  Pseudonodes ride
+                # their DIS router's area so zero-cost LAN edges stay
+                # intra-partition.
+                from holo_tpu.protocols.ospf.spf_run import (
+                    apply_partition_hint,
+                )
+
+                area_of = {
+                    k: min(node["areas"])
+                    for k, node in nodes.items()
+                    if k[6] == 0 and node["areas"]
+                }
+                apply_partition_hint(
+                    topo,
+                    [
+                        area_of.get(
+                            k if k[6] == 0 else k[:6] + b"\x00"
+                        )
+                        for k in order
+                    ],
+                )
                 topo.touch()
                 return topo, atoms
 

@@ -381,6 +381,11 @@ class OspfInstance(Actor):
         # DeltaPath: the previous full run's marshaled SpfTopology per
         # area — the diff base for incremental device-graph updates.
         self._spf_delta_bases: dict = {}
+        # Hierarchical partition hint (ISSUE 15): router-id -> group
+        # label, stamped onto Topology.partition_hint at marshal time
+        # (spf_run.apply_partition_hint) so the partitioned-SPF path
+        # cuts along operator-known structure instead of a flat BFS cut.
+        self.spf_partition_of: dict | None = None
         # Convergence-observatory causal ids pending on the next SPF run
         # (bounded; stamped in _schedule_spf, drained by run_spf).
         self._conv_pending: list = []
@@ -2842,6 +2847,7 @@ class OspfInstance(Actor):
                 area.lsdb, self.config.router_id, now, iface_by_addr,
                 iface_by_nbr, p2p_nbr_addr, iface_by_ifindex,
                 vlink_nexthops, iface_srlg=iface_srlg,
+                partition_of=self.spf_partition_of,
             )
             if st is None:
                 self._spf_delta_bases.pop(area.area_id, None)

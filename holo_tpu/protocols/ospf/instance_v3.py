@@ -228,6 +228,10 @@ class OspfV3Instance(Actor):
         # DeltaPath: the previous run's (vertex keys, atoms, topology)
         # per area — the diff base for in-place device-graph updates.
         self._spf_delta_bases: dict = {}
+        # Hierarchical partition hint (ISSUE 15): router-id -> group
+        # label lowered through spf_run.apply_partition_hint at the
+        # marshal seam (same contract as the v2 instance).
+        self.spf_partition_of: dict | None = None
         # RFC 6987 stub-router: MaxLinkMetric on transit/p2p router-LSA
         # links (maintenance mode; same leaf as the v2 instance).
         self.stub_router = False
@@ -2404,6 +2408,28 @@ class OspfV3Instance(Actor):
                 ],
                 iface_srlg,
             )
+        if self.spf_partition_of:
+            # Hierarchical partition hint (ISSUE 15): router groups
+            # from config; a network vertex rides the lowest-labeled
+            # attached router (v2 contract — zero-cost net->rtr edges
+            # stay intra-partition wherever the grouping allows).
+            from holo_tpu.protocols.ospf.spf_run import (
+                apply_partition_hint,
+            )
+
+            part_of = self.spf_partition_of
+            groups: list = []
+            for k in keys:
+                if k[0] == "R":
+                    groups.append(part_of.get(k[1]))
+                else:
+                    att = [
+                        part_of[m]
+                        for m in networks[(k[1], k[2])].attached
+                        if m in part_of
+                    ]
+                    groups.append(min(att) if att else None)
+            apply_partition_hint(topo, groups)
         topo.touch()
 
         # DeltaPath seam (same contract as the v2 instance): identical

@@ -72,6 +72,37 @@ def apply_interface_srlg(
     topo.edge_srlg = srlg
 
 
+def apply_partition_hint(topo: Topology, groups) -> None:
+    """Stamp ``Topology.partition_hint`` from a per-vertex grouping
+    (ISSUE 15): the protocol seam the hierarchical partitioned-SPF path
+    reads (``ops/graph.partition_topology`` honors the hint verbatim).
+
+    ``groups`` is a sequence of hashable, orderable group labels — one
+    per vertex in vertex order (IS-IS area addresses, OSPF sub-area
+    groupings, synth multi-area ids) — or None entries for ungrouped
+    vertices.  The stamp happens only when EVERY vertex is grouped and
+    at least two distinct groups exist; otherwise the topology stays
+    flat and the deterministic BFS/greedy cut decides at partition
+    time.  Distinct labels map onto dense partition ids in ascending
+    label order, so the hint is reproducible across marshals (the
+    DeltaPath chain contract).  Like ``edge_srlg`` the hint never
+    enters the DeviceGraph planes — residents cannot serve it stale."""
+    if groups is None:
+        return
+    labels = list(groups)
+    if len(labels) != topo.n_vertices or any(
+        g is None for g in labels
+    ):
+        return
+    uniq = sorted(set(labels))
+    if len(uniq) < 2:
+        return
+    dense = {g: i for i, g in enumerate(uniq)}
+    topo.partition_hint = np.array(
+        [dense[g] for g in labels], np.int32
+    )
+
+
 @dataclass(frozen=True)
 class NexthopAtom:
     """Resolved direct next hop: outgoing interface + neighbor address.
@@ -107,6 +138,7 @@ def build_topology(
     iface_by_ifindex: dict[int, str] | None = None,
     vlink_nexthops: dict | None = None,
     iface_srlg: dict[str, int] | None = None,
+    partition_of: dict | None = None,
 ) -> SpfTopology | None:
     """Lower the area LSDB to the SPF vertex/edge model.
 
@@ -303,6 +335,23 @@ def build_topology(
         apply_interface_srlg(
             topo, [a.ifname for a in atoms], iface_srlg
         )
+    if partition_of:
+        # Hierarchical partition hint (ISSUE 15): per-router group
+        # labels (config/topology-design groupings the operator knows —
+        # PoPs, rings, sub-area clusters); a transit network rides the
+        # lowest-labeled attached router so zero-cost net->rtr edges
+        # stay intra-partition wherever the grouping allows.
+        groups: list = []
+        for dr_addr in networks:
+            att = [
+                partition_of[r]
+                for r in nlsa[dr_addr].attached
+                if r in partition_of
+            ]
+            groups.append(min(att) if att else None)
+        for rid in routers:
+            groups.append(partition_of.get(rid))
+        apply_partition_hint(topo, groups)
     topo.touch()
     return SpfTopology(topo, atoms, router_index, network_index)
 

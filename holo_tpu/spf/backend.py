@@ -10,6 +10,7 @@ can only do serially.
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass
 
@@ -291,6 +292,11 @@ class ScalarSpfBackend(SpfBackend):
         )
 
 
+# Partitioned-resident cache namespaces (one per backend, process-wide
+# unique for the process lifetime — see TpuSpfBackend._part_ns).
+_PART_NS_IDS = itertools.count()
+
+
 class TpuSpfBackend(SpfBackend):
     """JAX/XLA backend: jitted tensor SPF, cached per topology generation.
 
@@ -310,6 +316,9 @@ class TpuSpfBackend(SpfBackend):
         breaker: CircuitBreaker | None = None,
         incremental: bool = True,
         prev_capacity: int = 32,
+        partition_threshold: int | None = None,
+        partition_parts: int | None = None,
+        partition_max_part: int = 4096,
     ):
         """``engine``: 'gather' (ELL gathers; handles any topology) or
         'blocked' (block-sparse Pallas kernels; fastest on large LSDBs,
@@ -337,7 +346,20 @@ class TpuSpfBackend(SpfBackend):
         previous-tensor entries — one live (topology, root) chain per
         entry, so size it >= the number of areas/MTs the instance
         computes per SPF cycle or their chains silently degrade to
-        ``full-no-prev``."""
+        ``full-no-prev``.
+
+        ``partition_threshold`` arms the hierarchical partitioned path
+        (ISSUE 15): kind=one/whatif dispatches on topologies with at
+        least that many vertices route through
+        :class:`holo_tpu.ops.partition.PartitionedSpfEngine` — the
+        graph is cut (natively via ``Topology.partition_hint``, else
+        the deterministic BFS/greedy cut into ``partition_parts`` parts
+        or parts of ≤ ``partition_max_part`` vertices), solved as one
+        batched dispatch of small per-partition programs, and stitched
+        exactly through the boundary-contraction skeleton.  None (the
+        default) keeps every dispatch monolithic.  Bit-identical to the
+        monolithic kernels and scalar oracle on every arm (the parity
+        contract); breaker fallback and DeltaPath compose."""
         self.n_atoms = n_atoms
         self.max_iters = max_iters
         self.engine = engine
@@ -395,6 +417,18 @@ class TpuSpfBackend(SpfBackend):
         # so GSPMD propagates the scenario/root split through the whole
         # program.
         self._shard_jits: dict[tuple, object] = {}
+        # Partitioned-SPF state (ISSUE 15): the engine is lazy (first
+        # partitioned dispatch); residents ride the process-wide
+        # DeviceGraphCache as per-partition entries — one lock/LRU/
+        # eviction surface with the monolithic DeltaPath residents —
+        # keyed per (backend namespace, root, n_atoms, mesh) chain.
+        self.partition_threshold = partition_threshold
+        self.partition_parts = partition_parts
+        self.partition_max_part = int(partition_max_part)
+        self._part_engine = None
+        # Monotonic, never reused (id(self) can be recycled after GC,
+        # letting a new backend adopt a dead backend's residents).
+        self._part_ns = f"part:{next(_PART_NS_IDS)}"
 
     def _jit_one_for(self, engine: str):
         fn = self._one_jits.get(engine)
@@ -921,6 +955,10 @@ class TpuSpfBackend(SpfBackend):
 
     def compute(self, topo, edge_mask=None, multipath_k: int = 1):
         kp = mp_pad(multipath_k)
+        if self._use_partitioned(topo):
+            return self.compute_partitioned(
+                topo, edge_mask, multipath_k=kp
+            )
         return self.breaker.call(
             lambda: self._device_compute(topo, edge_mask, kp),
             lambda: self._noted_fallback(
@@ -933,6 +971,19 @@ class TpuSpfBackend(SpfBackend):
 
     def compute_whatif(self, topo, edge_masks, multipath_k: int = 1):
         kp = mp_pad(multipath_k)
+        if self._use_partitioned(topo):
+            return self.breaker.call(
+                lambda: [
+                    self._device_partitioned(topo, m, kp)
+                    for m in edge_masks
+                ],
+                lambda: self._noted_fallback(
+                    lambda: self._oracle.compute_whatif(
+                        topo, edge_masks, multipath_k=kp
+                    )
+                ),
+                context="spf.whatif",
+            )
         return self.breaker.call(
             lambda: self._device_whatif(topo, edge_masks, kp),
             lambda: self._noted_fallback(
@@ -942,6 +993,177 @@ class TpuSpfBackend(SpfBackend):
             ),
             context="spf.whatif",
         )
+
+    # -- partitioned dispatch (ISSUE 15) --------------------------------
+
+    def _use_partitioned(self, topo) -> bool:
+        return (
+            self.partition_threshold is not None
+            and topo.n_vertices >= self.partition_threshold
+            and self.engine != "blocked"
+        )
+
+    def compute_partitioned(self, topo, edge_mask=None, multipath_k: int = 1):
+        """Explicit partitioned dispatch (auto-routed from ``compute``
+        when ``partition_threshold`` arms it) — breaker-guarded with
+        the bit-identical scalar oracle as the fallback arm, exactly
+        like the monolithic paths."""
+        kp = mp_pad(multipath_k)
+        return self.breaker.call(
+            lambda: self._device_partitioned(topo, edge_mask, kp),
+            lambda: self._noted_fallback(
+                lambda: self._oracle.compute(
+                    topo, edge_mask, multipath_k=kp
+                )
+            ),
+            context="spf.partitioned",
+        )
+
+    def _part_engine_for(self):
+        if self._part_engine is None:
+            from holo_tpu.ops.partition import PartitionedSpfEngine
+
+            self._part_engine = PartitionedSpfEngine(
+                max_iters=self.max_iters
+            )
+        return self._part_engine
+
+    def _part_key(self, topo, n_atoms: int) -> tuple:
+        return (self._part_ns, int(topo.root), int(n_atoms), _mesh_key())
+
+    def partition_residents(self) -> list:
+        """This backend's live partitioned residents (tests/bench)."""
+        from holo_tpu.ops.spf_engine import shared_graph_cache
+
+        return list(
+            shared_graph_cache()
+            .partitioned_entries(self._part_ns)
+            .values()
+        )
+
+    def _part_resident_for(self, topo, n_atoms: int, need_edge_ids: bool):
+        """The partitioned resident serving this topology's chain,
+        re-marshaled when the chain broke (or never existed).  Returns
+        ``(resident, how)`` with how in {'hit', 'miss'} — the delta
+        path claims the resident separately."""
+        from holo_tpu.ops.spf_engine import shared_graph_cache
+
+        eng = self._part_engine_for()
+        key = self._part_key(topo, n_atoms)
+        cache = shared_graph_cache()
+        res = cache.get_partitioned(key)
+        if (
+            res is not None
+            and res.topo_key == topo.cache_key
+            and not (need_edge_ids and res.ids_stale)
+        ):
+            return res, "hit"
+        res = eng.marshal(
+            topo,
+            n_atoms,
+            n_parts=self.partition_parts,
+            max_part=(
+                None
+                if self.partition_parts is not None
+                else self.partition_max_part
+            ),
+        )
+        cache.put_partitioned(key, res)
+        return res, "miss"
+
+    def _device_partitioned(self, topo, edge_mask, kp: int = 1):
+        faults.crashpoint("spf.dispatch")
+        mesh = _mesh()
+        if mesh is not None:
+            faults.crashpoint("spf.shard")
+        from holo_tpu.ops.spf_engine import shared_graph_cache
+
+        eng = self._part_engine_for()
+        n_atoms = max(self.n_atoms, topo.n_atoms())
+        t0 = profiling.clock()
+        obucket = self._obs_bucket(topo, 1, kp, None)
+        key = self._part_key(topo, n_atoms)
+        result = None
+        how = None
+        delta = getattr(topo, "delta_base", None)
+        with profiling.dispatch_context(
+            kind="partitioned", engine="partitioned", bucket=obucket
+        ), telemetry.span(
+            "spf.dispatch", kind="partitioned", backend="tpu"
+        ):
+            res = shared_graph_cache().get_partitioned(key)
+            if (
+                edge_mask is None
+                and delta is not None
+                and self.incremental
+                and res is not None
+            ):
+                # Bounded re-solve: affected partitions + skeleton.
+                with profiling.stage("spf.partitioned", "delta"):
+                    served = eng.try_delta(topo, res, kp)
+                if served is not None:
+                    result, _info = served
+                    note_delta(delta.kind, "partitioned-incremental")
+            if result is None:
+                with profiling.stage("spf.partitioned", "marshal"):
+                    with sanctioned_transfer("spf.partition.marshal"):
+                        res, how = self._part_resident_for(
+                            topo, n_atoms, edge_mask is not None
+                        )
+                with profiling.stage("spf.partitioned", "solve"):
+                    result = eng.solve(topo, res, edge_mask, kp)
+                if delta is not None and edge_mask is None:
+                    note_delta(delta.kind, "partitioned-full")
+            mpkw = {
+                f: result[f]
+                for f in (
+                    "parents", "pdist", "pweight", "npaths", "nh_weights"
+                )
+                if f in result
+            }
+            out = SpfResult(
+                dist=result["dist"],
+                parent=result["parent"],
+                hops=result["hops"],
+                nexthop_words=result["nexthop_words"],
+                **mpkw,
+            )
+        t1 = profiling.clock()
+        _DISPATCH_SECONDS.labels(backend="tpu", kind="partitioned").observe(
+            t1 - t0
+        )
+        kind = "one" if edge_mask is None else "whatif"
+        if edge_mask is None and how == "hit":
+            # Feed the tuner's partitioned rows (same shape key as the
+            # kind=one monolithic walls, so partitioned_advantage
+            # compares like with like) — FULL solves on a WARM resident
+            # only: a per-mask what-if wall, a bounded delta re-solve,
+            # or a marshal-miss dispatch (one-off re-marshal + XLA
+            # compile wall) is not comparable to the kind=one
+            # steady-state median, which excludes the same costs.
+            from holo_tpu.pipeline.tuner import active_tuner
+
+            tun = active_tuner()
+            if tun is not None:
+                tun.observe_partitioned(
+                    self._depth_bucket(topo, kp), t1 - t0
+                )
+        _BATCH_SCENARIOS.labels(kind=kind).inc()
+        if mesh is not None:
+            _SHARD_DISPATCHES.labels(kind=kind).inc()
+        convergence.note_dispatch("spf", "device")
+        return out
+
+    def partition_stats(self) -> dict:
+        """Resident summaries for the telemetry leaf / bench rows."""
+        from holo_tpu.ops.spf_engine import shared_graph_cache
+
+        return {
+            str(k[1:]): r.stats()
+            for k, r in shared_graph_cache()
+            .partitioned_entries(self._part_ns)
+            .items()
+        }
 
     def compute_multiroot(self, topo, roots: np.ndarray) -> "MultiRootResult":
         return self.breaker.call(

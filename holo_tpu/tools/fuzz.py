@@ -484,11 +484,16 @@ def tropical_tile_invariants(data: bytes) -> None:
             assert int(meta["pos"][r, c]) == s_, "meta pos inverse"
         for s_ in range(len(real), tm):
             assert (tt.tiles[r, s_] == INF).all(), "sentinel slot not INF"
-    # (b) value-faithful: dense expected matrix vs tile entries.
+    # (b) value-faithful: dense expected matrix vs tile entries — in
+    # the marshal's PERMUTED vertex space (ISSUE 15 RCM relabeling;
+    # perm/inv must round-trip).
+    perm, inv = meta["perm"], meta["inv"]
+    assert np.array_equal(np.sort(perm), np.arange(n)), "perm bijection"
+    assert np.array_equal(perm[inv], np.arange(n)), "inv inverse"
     want = np.full((nb * b, nb * b), INF, np.int64)
     srcs = ell.in_src[rows_, cols_]
     costs = ell.in_cost[rows_, cols_]
-    np.minimum.at(want, (rows_, srcs), costs)
+    np.minimum.at(want, (inv[rows_], inv[srcs]), costs)
     got = np.full((nb * b, nb * b), INF, np.int64)
     for r in range(nb):
         for s_ in range(tm):
@@ -502,9 +507,10 @@ def tropical_tile_invariants(data: bytes) -> None:
     assert (got[n:] == INF).all() and (got[:, n:] == INF).all(), (
         "pad sentinel rows/cols must be INF"
     )
-    # (c) semantic: host min-plus fixpoint == scalar oracle distances.
+    # (c) semantic: host min-plus fixpoint == scalar oracle distances
+    # (fixpoint in permuted space; compared back through perm).
     dist = np.full(nb * b, INF, np.int64)
-    dist[topo.root] = 0
+    dist[inv[topo.root]] = 0
     for _ in range(nb * b):
         cand = np.where(
             (got < INF) & (dist[None, :] < INF), got + dist[None, :], INF
@@ -514,8 +520,134 @@ def tropical_tile_invariants(data: bytes) -> None:
             break
         dist = new
     ref = spf_reference(topo)
-    assert np.array_equal(dist[:n], ref.dist.astype(np.int64)), (
+    assert np.array_equal(dist[inv], ref.dist.astype(np.int64)), (
         "tile fixpoint distances != scalar oracle"
+    )
+
+
+def partition_invariants(data: bytes) -> None:
+    """Partitioned-SPF plan invariants (ISSUE 15; not a wire decoder):
+    over arbitrary small topologies (ring/grid/random, optionally
+    carrying a seeded native ``partition_hint``) the partition plan
+    must be (a) an exact cover — dense non-empty partition ids, every
+    vertex exactly one own row, local ids bijective — (b) boundary-
+    closed — both endpoints of every cut edge (plus the root) are
+    skeleton vertices, each partition's halo is exactly the external
+    cut-edge sources into it — and (c) stitch-exact — a host
+    intra-partition Dijkstra per boundary vertex builds the contracted
+    skeleton's edge weights, and :func:`skeleton_solve` over that
+    skeleton reproduces the scalar oracle's global distances at every
+    skeleton vertex bit-for-bit (the contraction-exactness argument the
+    device path inherits).  Violations raise AssertionError (a crash)."""
+    if len(data) < 4:
+        raise DecodeError("partition spec: need 4+ bytes (kind,size,seed,p)")
+    import heapq  # noqa: PLC0415
+
+    import numpy as np  # noqa: PLC0415
+
+    from holo_tpu.ops.graph import INF  # noqa: PLC0415
+    from holo_tpu.ops.partition import (  # noqa: PLC0415
+        build_plan,
+        skeleton_solve,
+    )
+    from holo_tpu.spf import synth  # noqa: PLC0415
+    from holo_tpu.spf.scalar import spf_reference  # noqa: PLC0415
+
+    kind, size, seed = data[0] % 3, 4 + data[1] % 8, data[2]
+    if kind == 0:
+        topo = synth.ring_topology(size, max_cost=4, seed=seed)
+    elif kind == 1:
+        topo = synth.grid_topology(2, size, max_cost=4, seed=seed)
+    else:
+        topo = synth.random_ospf_topology(
+            n_routers=size + 2, n_networks=2, extra_p2p=size, max_cost=4,
+            seed=seed,
+        )
+    n = topo.n_vertices
+    if data[3] % 4 == 0:
+        # Native-hint arm: a seeded grouping stamped the way the
+        # protocol seams do (apply_partition_hint semantics).
+        rng = np.random.default_rng(seed)
+        topo.partition_hint = rng.integers(
+            0, 2 + data[3] % 3, n, dtype=np.int32
+        )
+        plan = build_plan(topo)
+    else:
+        plan = build_plan(topo, max_part=max(2, n // (2 + data[3] % 3)))
+
+    # (a) exact cover.
+    part = plan.part_of
+    assert part.min() >= 0 and part.max() == plan.n_parts - 1, "dense ids"
+    assert np.all(np.bincount(part, minlength=plan.n_parts) > 0), (
+        "empty partition id"
+    )
+    allv = np.sort(np.concatenate(plan.verts))
+    assert np.array_equal(allv, np.arange(n)), "verts not an exact cover"
+    for p in range(plan.n_parts):
+        assert np.array_equal(part[plan.verts[p]], np.full(
+            plan.verts[p].shape[0], p
+        )), "verts/part_of disagree"
+        assert np.array_equal(
+            plan.local_of[plan.verts[p]],
+            np.arange(plan.verts[p].shape[0]),
+        ), "local ids not bijective"
+
+    # (b) boundary closure.
+    cutm = part[topo.edge_src] != part[topo.edge_dst]
+    assert np.array_equal(
+        np.sort(plan.cut_eid), np.nonzero(cutm)[0]
+    ), "cut edge set"
+    skel_set = set(plan.skel.tolist())
+    assert int(topo.root) in skel_set, "root not in skeleton"
+    for e in plan.cut_eid:
+        assert int(topo.edge_src[e]) in skel_set, "cut src outside skel"
+        assert int(topo.edge_dst[e]) in skel_set, "cut dst outside skel"
+    for p in range(plan.n_parts):
+        want_halo = np.unique(
+            topo.edge_src[plan.cut_eid][
+                part[topo.edge_dst[plan.cut_eid]] == p
+            ]
+        )
+        assert np.array_equal(plan.halo[p], want_halo), "halo set"
+        assert np.array_equal(
+            plan.bnd[p], plan.skel[part[plan.skel] == p]
+        ), "bnd set"
+
+    # (c) skeleton weights from host intra-partition Dijkstras, then
+    # the stitch reproduces the oracle's global skeleton distances.
+    btab = np.full(
+        (plan.n_parts, plan.b_pad, plan.b_pad), int(INF), np.int64
+    )
+    for p in range(plan.n_parts):
+        intra = np.nonzero(
+            (part[topo.edge_src] == p) & (part[topo.edge_dst] == p)
+        )[0]
+        adj: dict[int, list] = {}
+        for e in intra:
+            adj.setdefault(int(topo.edge_src[e]), []).append(
+                (int(topo.edge_dst[e]), int(topo.edge_cost[e]))
+            )
+        for i, s in enumerate(plan.bnd[p]):
+            dist = {int(s): 0}
+            heap = [(0, int(s))]
+            while heap:
+                d, v = heapq.heappop(heap)
+                if d > dist.get(v, int(INF)):
+                    continue
+                for u, wgt in adj.get(v, ()):
+                    nd = d + wgt
+                    if nd < dist.get(u, int(INF)):
+                        dist[u] = nd
+                        heapq.heappush(heap, (nd, u))
+            for j, t in enumerate(plan.bnd[p]):
+                btab[p, i, j] = dist.get(int(t), int(INF))
+    skel_dist = skeleton_solve(plan, btab)
+    ref = spf_reference(topo)
+    want = np.minimum(
+        ref.dist[plan.skel].astype(np.int64), int(INF)
+    )
+    assert np.array_equal(np.minimum(skel_dist, int(INF)), want), (
+        "skeleton stitch != scalar oracle at skeleton vertices"
     )
 
 
@@ -612,6 +744,9 @@ def targets() -> dict:
         # Tropical tiles (ISSUE 13): blocked min-plus marshal structure
         # + value faithfulness + fixpoint-vs-oracle distances.
         "tropical_tile_invariants": tropical_tile_invariants,
+        # Partitioned SPF (ISSUE 15): exact partition cover, cut-closed
+        # boundary/halo sets, skeleton-stitch exactness vs the oracle.
+        "partition_invariants": partition_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

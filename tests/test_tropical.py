@@ -81,11 +81,18 @@ def test_tile_marshal_invariants():
             assert int(tt.pos[r, c]) == s
         for s in range(len(real), tm):
             assert (tt.tiles[r, s] == int(INF)).all()
-    # Dense expected matrix (min over parallel edges) vs tile entries.
+    # Dense expected matrix (min over parallel edges) vs tile entries —
+    # in the marshal's PERMUTED vertex space (ISSUE 15: RCM relabeling
+    # before blocking; perm/inv round-trip is asserted separately).
+    perm, inv = meta["perm"], meta["inv"]
+    assert np.array_equal(np.sort(perm), np.arange(n))
+    assert np.array_equal(perm[inv], np.arange(n))
     want = np.full((nb * b, nb * b), int(INF), np.int64)
     rows, cols = np.nonzero(ell.in_valid)
     np.minimum.at(
-        want, (rows, ell.in_src[rows, cols]), ell.in_cost[rows, cols]
+        want,
+        (inv[rows], inv[ell.in_src[rows, cols]]),
+        ell.in_cost[rows, cols],
     )
     got = np.full_like(want, int(INF))
     for r in range(nb):
