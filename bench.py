@@ -79,6 +79,7 @@ STAGE_TIMEOUT = {
     "observatory_overhead": 900,
     "tropical_spf": 1500,
     "partitioned_spf": 1500,
+    "bgp_table": 1500,
 }
 
 
@@ -2709,6 +2710,257 @@ def stage_observatory_overhead(k, B, reps=24, inner=2):
     }
 
 
+def stage_bgp_table(small):
+    """ISSUE 16: device-resident BGP best-path over a full Internet
+    table.  Three measurements, all gated on engine-level parity:
+
+    1. PARITY (the gate): a synthetic multi-peer feed through the real
+       BgpEngine twice — scalar decision process vs TpuBgpTableBackend —
+       comparing the complete Loc-RIB snapshot (best route, nexthop
+       sets, reject/ineligible reason strings, igp_cost side effects).
+       Any mismatch fails the whole stage; the throughput rows below
+       never excuse a wrong RIB.
+    2. COLD FOLD: prefixes/s of the §9.1.2.2 fold kernel over a packed
+       full-table plane (full: 512k prefixes x 64 peers; --small: 32k x
+       16 — same code path, honestly labeled).  The feed is synthesized
+       at the LANE level (the backend's own packed encoding) because the
+       cold wall is the kernel, not the Python marshal the incremental
+       path amortizes away.
+    3. UPDATE BATCH: p99 wall of a scatter-k-rows + recompute-radius
+       round — the steady-state UPDATE burst shape — with the donated
+       scatter and the gathered `_decide` sub-fold.
+
+    A scalar-loop row (the engine's `_best_path` over the parity feed)
+    anchors the speedup claim, and the armed-profiler cost_analysis of
+    the fold lands in the report for the roofline ledger.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from holo_tpu.ops import bgp_table as bt
+    from holo_tpu.protocols.bgp_engine import (
+        AdjRib,
+        AsSegment,
+        BaseAttrs,
+        BgpEngine,
+        Destination,
+        NhtEntry,
+        Route,
+        RouteOrigin,
+    )
+    from holo_tpu.telemetry import profiling
+
+    afs = "ipv4-unicast"
+    n_prefixes, n_peers = (32_768, 16) if small else (524_288, 64)
+    n_parity, parity_peers = (512, 8) if small else (2_048, 8)
+    rng = np.random.default_rng(16)
+
+    # -- 1. parity gate through the real engine pair ---------------------
+    def build(backend):
+        calls = []
+        eng = BgpEngine(
+            "bench", ibus_cb=lambda k, p: calls.append((k, p)),
+            table_backend=backend,
+        )
+        eng.asn = 65000
+        table = eng.tables[afs]
+        for nh in range(parity_peers):
+            table.nht[f"9.9.{nh}.1"] = NhtEntry(
+                metric=int(rng2.integers(1, 64))
+                if (nh % 5) else None  # every 5th next hop unresolvable
+            )
+        for i in range(n_parity):
+            prefix = f"10.{(i >> 8) & 255}.{i & 255}.0/24"
+            dest = table.prefixes.setdefault(prefix, Destination())
+            for p in range(parity_peers):
+                if rng2.random() < 0.4:
+                    continue
+                addr = f"1.1.1.{p + 1}"
+                med = None if rng2.random() < 0.2 else int(
+                    rng2.integers(0, 1000)
+                )
+                attrs = BaseAttrs(
+                    origin=("Igp", "Egp", "Incomplete")[
+                        int(rng2.integers(0, 3))
+                    ],
+                    as_path=(AsSegment(
+                        "Sequence",
+                        tuple(int(a) for a in rng2.integers(
+                            1, 500, size=int(rng2.integers(1, 5))
+                        )),
+                    ),),
+                    nexthop=f"9.9.{int(rng2.integers(0, parity_peers))}.1",
+                    med=med,
+                    local_pref=int(rng2.integers(50, 300))
+                    if rng2.random() < 0.5 else None,
+                )
+                dest.adj_rib.setdefault(addr, AdjRib()).in_post = Route(
+                    origin=RouteOrigin(
+                        identifier=f"0.0.0.{p + 1}", remote_addr=addr
+                    ),
+                    attrs=attrs,
+                    route_type="External" if p % 2 else "Internal",
+                )
+            table.queued.add(prefix)
+            if backend is not None:
+                backend.note_route_change(afs, prefix)
+        return eng, table
+
+    def snap(table):
+        out = {}
+        for prefix, dest in table.prefixes.items():
+            out[prefix] = (
+                None if dest.local is None
+                else (dest.local.attrs, dest.local.route_type,
+                      dest.local.igp_cost),
+                dest.local_nexthops,
+                tuple(sorted(
+                    (a, adj.in_post.reject_reason,
+                     adj.in_post.ineligible_reason, adj.in_post.igp_cost)
+                    for a, adj in dest.adj_rib.items() if adj.in_post
+                )),
+            )
+        return out
+
+    mp_cfg = {
+        "enabled": True, "ebgp_max": 4, "ibgp_max": 2,
+        "allow_multiple_as": True,
+    }
+    rng2 = np.random.default_rng(17)
+    s_eng, s_table = build(None)
+    s_eng.multipath[afs] = dict(mp_cfg)
+    rng2 = np.random.default_rng(17)  # identical feed for the device arm
+    backend = bt.TpuBgpTableBackend()
+    d_eng, d_table = build(backend)
+    d_eng.multipath[afs] = dict(mp_cfg)
+    t0 = time.perf_counter()
+    s_eng.run_decision_process()
+    scalar_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    profiling.set_device_profiling(True)  # cost_analysis capture
+    try:
+        d_eng.run_decision_process()
+    finally:
+        profiling.set_device_profiling(False)
+    engine_device_s = time.perf_counter() - t0
+    parity = snap(s_table) == snap(d_table)
+    stats = backend.stats()
+
+    # -- 2. cold fold over the packed full-table plane -------------------
+    R, C = bt._pow2(n_prefixes), bt._pow2(n_peers)
+    K = 64  # next-hop id space
+
+    def nbias(a):  # the backend's u32->i32 order-preserving bias
+        return (np.asarray(a, np.int64) - (1 << 31)).astype(np.int32)
+
+    planes_np = np.zeros((bt.N_LANES, R, C), np.int32)
+    occ = (rng.random((R, C)) < 0.5).astype(np.int32)
+    occ[:, bt.LOCAL_COL] = 0  # peer columns only; local column empty
+    occ[np.arange(R), 1 + rng.integers(0, C - 1, size=R)] = 1
+    planes_np[bt.L_OCC] = occ
+    planes_np[bt.L_LP] = nbias(
+        0xFFFFFFFF - rng.integers(50, 300, size=(R, C), dtype=np.int64)
+    )
+    planes_np[bt.L_L1] = (
+        rng.integers(1, 6, size=(R, C)) << 2
+    ) | rng.integers(0, 3, size=(R, C))
+    planes_np[bt.L_MED] = nbias(
+        rng.integers(0, 1000, size=(R, C), dtype=np.int64)
+    )
+    planes_np[bt.L_FAS] = rng.integers(1, 64, size=(R, C))
+    planes_np[bt.L_RT] = rng.integers(0, 2, size=(R, C))
+    planes_np[bt.L_RID] = nbias(
+        rng.integers(0, 1 << 32, size=(R, C), dtype=np.int64)
+    )
+    planes_np[bt.L_HASRID] = 1
+    planes_np[bt.L_NH] = rng.integers(0, K, size=(R, C))
+    planes_np[bt.L_PATH] = rng.integers(0, 4096, size=(R, C))
+    planes_np[bt.L_LOOP] = (rng.random((R, C)) < 0.02).astype(np.int32)
+    planes_np *= occ  # empty cells stay all-zero, as the backend writes
+    planes_np[bt.L_OCC] = occ
+    order = np.concatenate(
+        [np.arange(1, C, dtype=np.int32), [bt.LOCAL_COL]]
+    ).astype(np.int32)
+    addr_rank = np.arange(C, dtype=np.int32)
+    has_addr = (np.arange(C) != bt.LOCAL_COL).astype(np.int32)
+    nht_enc = nbias(rng.integers(1, 65, size=K, dtype=np.int64))
+    nht_res = (rng.random(K) < 0.9).astype(np.int32)
+    nht_res[0] = 1
+    mp_vec = np.array([1, 2, 4], np.int32)
+    args = [
+        jnp.asarray(a)
+        for a in (order, addr_rank, has_addr, nht_enc, nht_res, mp_vec)
+    ]
+    planes = jnp.asarray(planes_np)
+    profiling.set_device_profiling(True)
+    try:
+        out = bt.fold_planes(planes, *args)  # warm: compile
+        jax.block_until_ready(out)
+        profiling.record_cost(  # roofline numerators for the ledger
+            "bgp.table.cold", bt.fold_planes, planes, *args,
+            shape_sig=("cold", R, C),
+        )
+    finally:
+        profiling.set_device_profiling(False)
+    reps = 3 if small else 5
+    cold_t = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = bt.fold_planes(planes, *args)
+        jax.block_until_ready(out)
+        cold_t.append(time.perf_counter() - t0)
+    cold_s = float(np.median(cold_t))
+    prefixes_per_sec = n_prefixes / cold_s if cold_s else 0.0
+
+    # -- 3. UPDATE-burst rounds: donated scatter + radius recompute ------
+    batch_k = 256 if small else 1_024
+    radius = 4 * batch_k  # recompute radius: churned rows + neighbors
+    rounds = 20 if small else 40
+    upd_t = []
+    for r in range(rounds):
+        rows_idx = jnp.asarray(
+            rng.choice(R, size=batch_k, replace=False).astype(np.int32)
+        )
+        fresh = jnp.asarray(
+            planes_np[:, rng.integers(0, R, size=batch_k), :]
+        )
+        sub_idx = jnp.asarray(
+            np.sort(rng.choice(R, size=radius, replace=False))
+            .astype(np.int32)
+        )
+        t0 = time.perf_counter()
+        planes = bt._scatter(planes, rows_idx, fresh)
+        out = bt._decide(planes, sub_idx, *args)
+        jax.block_until_ready(out)
+        upd_t.append(time.perf_counter() - t0)
+    upd = np.sort(np.asarray(upd_t[2:])) * 1e3  # drop compile rounds
+    p99 = float(upd[min(len(upd) - 1, int(0.99 * len(upd)))])
+
+    scalar_prefixes_per_sec = n_parity / scalar_s if scalar_s else 0.0
+    return {
+        "ok": bool(parity and stats["fallbacks"] == 0),
+        "parity": bool(parity),
+        "n_prefixes": n_prefixes,
+        "n_peers": n_peers,
+        "parity_feed": {"prefixes": n_parity, "peers": parity_peers},
+        "bgp_prefixes_per_sec": round(prefixes_per_sec, 1),
+        "cold_fold_ms": round(cold_s * 1e3, 3),
+        "bgp_update_p99_ms": round(p99, 3),
+        "update_batch": {"rows": batch_k, "radius": radius,
+                         "rounds": rounds},
+        "scalar_prefixes_per_sec": round(scalar_prefixes_per_sec, 1),
+        "engine_device_s": round(engine_device_s, 3),
+        "backend": stats,
+        "cost_analysis": {
+            f"{site}{list(sig)}": entry
+            for (site, sig), entry in sorted(
+                profiling.cost_table().items(), key=lambda kv: kv[0][0]
+            )
+            if site.startswith("bgp")
+        },
+    }
+
+
 # -- bench regression ledger (ISSUE 11 satellite) ------------------------
 
 # Scalar keys lifted from stage rows into the persisted ledger:
@@ -2741,6 +2993,11 @@ _LEDGER_KEYS = (
     # bounded DeltaPath re-solve wall.
     ("partitioned_runs_per_sec", True),
     ("partitioned_delta_ms", False),
+    # ISSUE 16: the device BGP plane's acceptance scalars — cold
+    # best-path throughput over the packed full table and the
+    # UPDATE-burst scatter+recompute p99.
+    ("bgp_prefixes_per_sec", True),
+    ("bgp_update_p99_ms", False),
 )
 
 
@@ -2951,6 +3208,7 @@ def main() -> None:
                 else stage_tropical_spf(ks=(30, 60, 90), B=128, cpu_runs=8)
             ),
             "partitioned_spf": lambda: stage_partitioned_spf(small),
+            "bgp_table": lambda: stage_bgp_table(small),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -3090,6 +3348,13 @@ def main() -> None:
         extra["partitioned_spf_jaxcpu"] = _run_stage(
             "partitioned_spf", small, cpu=True
         )
+        # Device BGP table (ISSUE 16): every row is engine-parity-gated
+        # against the scalar decision process, and the fold kernel is
+        # pure jnp — a small JAX-CPU run keeps the acceptance signal
+        # (throughput honestly labeled as CPU) while the relay is down.
+        extra["bgp_table_jaxcpu_small"] = _run_stage(
+            "bgp_table", True, cpu=True
+        )
         # Device-trace carry-over: relay down means no TPU to trace —
         # the row says so explicitly instead of probing a wedged relay.
         extra["device_trace"] = {
@@ -3221,6 +3486,10 @@ def main() -> None:
     # multi-area sweep — digest parity on every arm, per-phase splits,
     # bounded delta re-solves, and the >=100k feasibility row.
     extra["partitioned_spf"] = _run_stage("partitioned_spf", small)
+    # Device-resident BGP plane (ISSUE 16): cold full-table best-path
+    # throughput + UPDATE-burst p99, gated on Loc-RIB parity between
+    # the device backend and the scalar decision process.
+    extra["bgp_table"] = _run_stage("bgp_table", small)
     # Device-trace carry-over: a real jax.profiler capture when the
     # attached platform is an actual TPU; explicit not-used row else.
     extra["device_trace"] = _run_stage("device_trace", small)

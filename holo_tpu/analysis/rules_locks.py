@@ -513,14 +513,15 @@ class CrossThreadPublicationRule(Rule):
     or hand the value through a bounded queue / ``loop.send`` (those
     never look like bare attribute writes in the first place).
 
-    Ships at WARN tier to soak (the HL107 precedent): findings report
-    and ride the JSON output but do not gate tier-1 until promoted.
+    Soaked at WARN tier through ISSUE 14/15 (the HL107 precedent) with
+    zero tree findings; promoted to ERROR tier in ISSUE 16 — the rule
+    now gates tier-1 like the rest of the lock family.
     """
 
     id = "HL205"
     title = "cross-thread publication without an approved seam"
     family = "locks"
-    severity = "warn"
+    severity = "error"
 
     def check(self, mod: ModuleInfo) -> list[Finding]:
         if not mod.config.in_publication_scope(mod.relpath):

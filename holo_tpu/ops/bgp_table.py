@@ -1,0 +1,975 @@
+"""Device-resident BGP plane: the RFC 4271 §9.1 decision process as a
+batched reduction over packed attribute lanes (ISSUE 16).
+
+The Adj-RIB-In for one address family becomes a set of device planes,
+``(N_LANES, rows, cols)`` int32, one row per prefix, one column per
+peer plus column 0 for the locally originated / redistributed route.
+Every attribute the §9.1.2.2 ladder touches is interned host-side into
+an order-preserving integer lane, so one pass of batched compares
+decides every queued prefix at once:
+
+====  ==============  ====================================================
+lane  name            encoding (all int32; ``bias(u) = u - 2**31``)
+====  ==============  ====================================================
+0     lp              ``bias(0xFFFFFFFF - local_pref)`` — higher LP first
+                      (default 100 applied at intern time)
+1     l1              ``path_length << 2 | origin_order`` — two ladder
+                      rungs in one lane; equality of the lane is exactly
+                      "same length AND same origin", which the multipath
+                      equality test needs verbatim
+2     med             ``bias(med or 0)`` — the oracle folds a missing MED
+                      to 0, so no presence lane is needed
+3     fas             dense intern id of ``first_as()`` (equality-only:
+                      it gates whether the MED rung fires at all)
+4     rt              0 = Internal, 1 = External (HIGHER preferred —
+                      the one inverted rung)
+5     igp             local/redistributed routes only: ``bias(0)`` for a
+                      missing cost (preferred) else ``bias(cost + 1)``;
+                      peer routes derive this lane on device from the
+                      NHT metric vector, so IGP churn never re-marshals
+6     rid             ``bias(int(IPv4Address(identifier)))``
+7     has_rid         the oracle skips the router-id rung unless BOTH
+                      sides carry one — presence must travel with it
+8     nh              dense intern id of ``ll_nexthop or nexthop``; also
+                      the index into the NHT metric/resolved vectors
+9     path            dense intern id of the full AS path tuple (the
+                      iBGP multipath rung compares paths, not lengths)
+10    occ             cell holds a route
+11    loop            ``as_path_contains(local_asn)`` — AS-loop mask
+12    local           ``origin.is_local()``
+====  ==============  ====================================================
+
+Why a fold and not an argmin: the MED rung only fires when both routes
+share ``first_as()``, which makes the oracle comparator NON-transitive
+(X1=(AS1, med hi, rid lo), X2=(AS2, med 0), X3=(AS1, med 0, rid hi)
+forms a preference cycle).  No static per-route sort key exists, so the
+kernel is a ``lax.fori_loop`` of length ``cols`` — each step one
+element-wise batched compare over all queued prefixes, visiting columns
+in the oracle's candidate order (peers sorted by address, local column
+last).  Whenever MED never fires this reduces to argmin over the packed
+key; when it does, the fold IS the oracle's sequential walk, vectorized
+across the prefix axis instead of the candidate axis.  The fold also
+emits the per-candidate reject-reason codes (the YANG rib renders them,
+so they are observable state) and the multipath equal-set with the
+first-``max_paths``-in-address-order selection applied on device.
+
+Incrementality: engines note content changes per prefix
+(``note_route_change``), UPDATE application is one donated scatter of
+exactly those rows, and the recompute radius is the engine's own
+``queued`` set — NHT-only churn (IGP convergence shaking BGP) re-reads
+resident rows with zero re-marshal because the IGP lane is derived on
+device.  Residency follows the ``DeviceGraphCache`` discipline: planes
+grow by doubling, old buffers are donated on scatter/regrow, and
+steady-state churn never re-marshals the table.
+
+The scalar decision process stays verbatim in
+:mod:`holo_tpu.protocols.bgp_engine` as the bit-identical oracle; the
+``CircuitBreaker("bgp-table")`` serves whole batches from it on any
+device fault, and any route the lane contract cannot represent (AS
+path >= 2**24 hops, out-of-range attribute, unparseable router-id)
+poisons only its own prefix back to the scalar path.
+"""
+
+from __future__ import annotations
+
+import weakref
+from dataclasses import dataclass, field
+from ipaddress import IPv4Address
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from holo_tpu import telemetry
+from holo_tpu.analysis.runtime import note_donated, sanctioned_transfer
+from holo_tpu.resilience import faults
+from holo_tpu.resilience.breaker import CircuitBreaker
+from holo_tpu.telemetry import observatory, profiling
+
+__all__ = [
+    "MarshalError",
+    "REJECT_REASONS",
+    "ScalarBgpTableBackend",
+    "TpuBgpTableBackend",
+    "DeviceRankBackend",
+    "fold_planes",
+    "backends_stats",
+]
+
+# ---------------------------------------------------------------------------
+# observability (ISSUE 16 satellite: the holo_bgp_table_* family)
+
+_DISPATCH_TOTAL = telemetry.counter(
+    "holo_bgp_table_dispatch_total",
+    "BGP table device dispatches",
+    ("kind",),
+)
+_UPDATE_ROWS = telemetry.counter(
+    "holo_bgp_table_update_rows",
+    "Adj-RIB-In rows scattered into the device planes",
+    ("kind",),
+)
+_RECOMPUTED = telemetry.counter(
+    "holo_bgp_table_recomputed_prefixes",
+    "Prefixes whose best path was recomputed on device",
+    ("kind",),
+)
+_FALLBACK = telemetry.counter(
+    "holo_bgp_table_fallback_total",
+    "Decisions served by the scalar oracle instead of the device",
+    ("context",),
+)
+_JIT_COMPILES = telemetry.counter(
+    "holo_bgp_table_jit_compiles_total",
+    "BGP table dispatches that hit a new shape bucket",
+    ("kind",),
+)
+_JIT_HITS = telemetry.counter(
+    "holo_bgp_table_jit_cache_hits_total",
+    "BGP table dispatches served from a compiled shape bucket",
+    ("kind",),
+)
+
+# ---------------------------------------------------------------------------
+# lane layout
+
+(
+    L_LP,
+    L_L1,
+    L_MED,
+    L_FAS,
+    L_RT,
+    L_IGP,
+    L_RID,
+    L_HASRID,
+    L_NH,
+    L_PATH,
+    L_OCC,
+    L_LOOP,
+    L_LOCAL,
+) = range(13)
+N_LANES = 13
+
+#: column 0 always holds the locally originated / redistributed route —
+#: a fixed slot so capacity growth pads on the right and never moves it.
+LOCAL_COL = 0
+
+_BIAS = 1 << 31
+_U32 = (1 << 32) - 1
+
+#: reject-reason code -> the oracle's reason string (0 = winner / unset).
+REJECT_REASONS = (
+    None,
+    "local-pref-lower",
+    "as-path-longer",
+    "origin-type-higher",
+    "med-higher",
+    "prefer-external",
+    "nexthop-cost-higher",
+    "higher-router-id",
+    "higher-peer-address",
+)
+R_LP, R_PLEN, R_ORIGIN, R_MED, R_RT, R_IGP, R_RID, R_ADDR = range(1, 9)
+
+_ORIGIN_ORDER = {"Igp": 0, "Egp": 1, "Incomplete": 2}
+_DFLT_LOCAL_PREF = 100
+
+
+class MarshalError(ValueError):
+    """A route the lane contract cannot represent — the owning prefix is
+    poisoned back to the scalar oracle, nothing else degrades."""
+
+
+def _addr_key(addr: str):
+    """Mirror of ``bgp_engine._addr_key`` (v4 numeric, v6 after) —
+    duplicated so the ops layer never imports the protocol layer."""
+    try:
+        return (0, int(IPv4Address(addr)))
+    except Exception:  # noqa: BLE001 — v6 sorts after v4
+        return (1, addr)
+
+
+def _u32(v, what: str) -> int:
+    v = int(v)
+    if not 0 <= v <= _U32:
+        raise MarshalError(f"{what} out of u32 range: {v}")
+    return v
+
+
+def _bias(u: int) -> int:
+    return int(u) - _BIAS
+
+
+class _Interner:
+    """Dense equality-only ids (first_as / nexthop / AS-path lanes)."""
+
+    def __init__(self):
+        self.ids: dict = {}
+        self.values: list = []
+
+    def intern(self, value) -> int:
+        got = self.ids.get(value)
+        if got is None:
+            got = self.ids[value] = len(self.values)
+            self.values.append(value)
+            if got >= _BIAS:
+                raise MarshalError("interner overflow")
+        return got
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+def _encode_cell(route, col_addr, asn, fas_ids, path_ids, nh_ids) -> list:
+    """One (prefix, peer) cell -> the 13 lane values.  Raises
+    :class:`MarshalError` for anything outside the lane contract."""
+    a = route.attrs
+    lp = a.local_pref if a.local_pref is not None else _DFLT_LOCAL_PREF
+    lane_lp = _bias(_U32 - _u32(lp, "local-pref"))
+    plen = a.path_length()
+    if plen >= (1 << 24):
+        raise MarshalError(f"as-path length {plen} >= 2**24")
+    origin_ord = _ORIGIN_ORDER.get(a.origin)
+    if origin_ord is None:
+        raise MarshalError(f"unknown origin {a.origin!r}")
+    lane_l1 = (plen << 2) | origin_ord
+    lane_med = _bias(_u32(a.med or 0, "med"))
+    lane_fas = fas_ids.intern(a.first_as())
+    if route.route_type == "Internal":
+        lane_rt = 0
+    elif route.route_type == "External":
+        lane_rt = 1
+    else:
+        raise MarshalError(f"unknown route type {route.route_type!r}")
+    is_local = route.origin.is_local()
+    if is_local:
+        igp = route.igp_cost
+        lane_igp = _bias(0 if igp is None else _u32(igp, "igp-cost") + 1)
+        lane_nh = 0
+    else:
+        nexthop = a.ll_nexthop or a.nexthop
+        if nexthop is None:
+            raise MarshalError("peer route without next hop")
+        lane_nh = nh_ids.intern(nexthop)
+        lane_igp = 0  # derived on device from the NHT metric vector
+    if col_addr is not None and route.origin.remote_addr != col_addr:
+        # The peer-address rung rides a per-COLUMN rank vector; a route
+        # whose remote_addr is not its column's address would compare
+        # against the wrong rank.
+        raise MarshalError("route remote_addr differs from its column")
+    if col_addr is None and route.origin.remote_addr is not None:
+        # Local column with a peer address: same rank mismatch hazard.
+        raise MarshalError("local-column route carries a remote_addr")
+    rid = route.origin.identifier
+    if rid is None:
+        lane_rid, lane_hasrid = 0, 0
+    else:
+        try:
+            lane_rid = _bias(int(IPv4Address(rid)))
+        except Exception as exc:  # noqa: BLE001 — oracle would also choke
+            raise MarshalError(f"unparseable router-id {rid!r}") from exc
+        lane_hasrid = 1
+    return [
+        lane_lp,
+        lane_l1,
+        lane_med,
+        lane_fas,
+        lane_rt,
+        lane_igp,
+        lane_rid,
+        lane_hasrid,
+        lane_nh,
+        path_ids.intern(a.as_path),
+        1,
+        1 if a.as_path_contains(asn) else 0,
+        1 if is_local else 0,
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the fold kernel
+
+
+def _fold_planes(sub, order, addr_rank, has_addr, nht_enc, nht_res, mp):
+    """The §9.1.2.2 ladder over packed lanes.
+
+    ``sub``       (N_LANES, M, C) int32 — the queued rows
+    ``order``     (C,) int32 permutation — oracle candidate order
+                  (peers by address, local column last)
+    ``addr_rank`` (C,) int32 — per-column peer-address rank
+    ``has_addr``  (C,) int32 — column has a peer address
+    ``nht_enc``   (K,) int32 — biased ``metric + 1`` per next-hop id
+    ``nht_res``   (K,) int32 — next-hop id resolves
+    ``mp``        (3,) int32 — (allow_multiple_as, ibgp_max, ebgp_max)
+
+    Returns ``(best_col, reasons, elig, mp_sel)``: winning column per
+    row (-1 when nothing is eligible), the per-cell reject-reason code
+    plane, the eligibility mask, and the device-selected multipath set.
+    """
+    occ = sub[L_OCC].astype(bool)
+    loop = sub[L_LOOP].astype(bool)
+    local = sub[L_LOCAL].astype(bool)
+    nhc = jnp.clip(sub[L_NH], 0, nht_enc.shape[0] - 1)
+    resolved = local | nht_res[nhc].astype(bool)
+    igp = jnp.where(local, sub[L_IGP], nht_enc[nhc])
+    elig = occ & ~loop & resolved
+    m_rows, n_cols = occ.shape
+    cols2d = jnp.arange(n_cols, dtype=jnp.int32)[None, :]
+
+    def step(j, carry):
+        best_col, has_best, b, b_addr, b_hasaddr, b_igp, reasons = carry
+        c = order[j]
+        cand = lax.dynamic_index_in_dim(sub, c, axis=2, keepdims=False)
+        igp_c = lax.dynamic_index_in_dim(igp, c, axis=1, keepdims=False)
+        elig_c = lax.dynamic_index_in_dim(elig, c, axis=1, keepdims=False)
+        a_addr = addr_rank[c]
+        a_has = has_addr[c].astype(bool)
+        # The ladder is evaluated bottom-up: each rung's `where`
+        # overwrites the deeper verdict, so the shallowest differing
+        # rung decides — exactly the oracle's early-return order.
+        better = jnp.zeros((m_rows,), bool)
+        reason = jnp.full((m_rows,), R_ADDR, jnp.int32)
+        addr_app = a_has & b_hasaddr & (a_addr != b_addr)
+        better = jnp.where(addr_app, a_addr < b_addr, better)
+        rid_app = (cand[L_HASRID] & b[L_HASRID]).astype(bool) & (
+            cand[L_RID] != b[L_RID]
+        )
+        better = jnp.where(rid_app, cand[L_RID] < b[L_RID], better)
+        reason = jnp.where(rid_app, R_RID, reason)
+        igp_d = igp_c != b_igp
+        better = jnp.where(igp_d, igp_c < b_igp, better)
+        reason = jnp.where(igp_d, R_IGP, reason)
+        rt_d = cand[L_RT] != b[L_RT]
+        better = jnp.where(rt_d, cand[L_RT] > b[L_RT], better)
+        reason = jnp.where(rt_d, R_RT, reason)
+        med_app = (cand[L_FAS] == b[L_FAS]) & (cand[L_MED] != b[L_MED])
+        better = jnp.where(med_app, cand[L_MED] < b[L_MED], better)
+        reason = jnp.where(med_app, R_MED, reason)
+        l1_d = cand[L_L1] != b[L_L1]
+        better = jnp.where(l1_d, cand[L_L1] < b[L_L1], better)
+        reason = jnp.where(
+            l1_d,
+            jnp.where((cand[L_L1] >> 2) != (b[L_L1] >> 2), R_PLEN, R_ORIGIN),
+            reason,
+        )
+        lp_d = cand[L_LP] != b[L_LP]
+        better = jnp.where(lp_d, cand[L_LP] < b[L_LP], better)
+        reason = jnp.where(lp_d, R_LP, reason)
+
+        take = elig_c & (~has_best | better)
+        lose = elig_c & has_best
+        loser = jnp.where(better, best_col, c)
+        reasons = jnp.where(
+            lose[:, None] & (cols2d == loser[:, None]),
+            reason[:, None],
+            reasons,
+        )
+        b = jnp.where(take[None, :], cand, b)
+        b_addr = jnp.where(take, a_addr, b_addr)
+        b_hasaddr = jnp.where(take, a_has, b_hasaddr)
+        b_igp = jnp.where(take, igp_c, b_igp)
+        best_col = jnp.where(take, c, best_col)
+        return best_col, has_best | elig_c, b, b_addr, b_hasaddr, b_igp, reasons
+
+    init = (
+        jnp.full((m_rows,), -1, jnp.int32),
+        jnp.zeros((m_rows,), bool),
+        jnp.zeros((N_LANES, m_rows), jnp.int32),
+        jnp.zeros((m_rows,), jnp.int32),
+        jnp.zeros((m_rows,), bool),
+        jnp.zeros((m_rows,), jnp.int32),
+        jnp.zeros((m_rows, n_cols), jnp.int32),
+    )
+    best_col, has_best, b, _, _, b_igp, reasons = lax.fori_loop(
+        0, n_cols, step, init
+    )
+
+    # Multipath: rib.rs:463-487 equality vs the winner, then the first
+    # max_paths matches in address order (local column excluded — the
+    # oracle's nexthop walk iterates the Adj-RIB only).
+    fas_eq = sub[L_FAS] == b[L_FAS][:, None]
+    med_ok = ~fas_eq | (sub[L_MED] == b[L_MED][:, None])
+    is_ext = b[L_RT][:, None] == 1
+    branch = jnp.where(
+        is_ext,
+        mp[0].astype(bool) | fas_eq,
+        sub[L_PATH] == b[L_PATH][:, None],
+    )
+    eq = (
+        elig
+        & (cols2d != LOCAL_COL)
+        & has_best[:, None]
+        & (sub[L_LP] == b[L_LP][:, None])
+        & (sub[L_L1] == b[L_L1][:, None])
+        & (sub[L_RT] == b[L_RT][:, None])
+        & (igp == b_igp[:, None])
+        & med_ok
+        & branch
+    )
+    maxp = jnp.where(b[L_RT] == 0, mp[1], mp[2])
+    eq_ord = jnp.take(eq, order, axis=1)
+    csum = jnp.cumsum(eq_ord.astype(jnp.int32), axis=1)
+    sel_ord = eq_ord & (csum <= maxp[:, None])
+    mp_sel = jnp.zeros_like(eq).at[:, order].set(sel_ord)
+    return best_col, reasons, elig, mp_sel
+
+
+#: jitted entry points — jax caches per shape; compile tracking happens
+#: in the backend (a seen-signature set, the SPF backend discipline).
+fold_planes = jax.jit(_fold_planes)
+
+
+def _decide_fn(planes, idx, order, addr_rank, has_addr, nht_enc, nht_res, mp):
+    return _fold_planes(
+        planes[:, idx, :], order, addr_rank, has_addr, nht_enc, nht_res, mp
+    )
+
+
+_decide = jax.jit(_decide_fn)
+_scatter = jax.jit(
+    lambda planes, idx, rows: planes.at[:, idx, :].set(rows),
+    donate_argnums=(0,),
+)
+_grow = jax.jit(
+    lambda planes, nr, nc: jnp.pad(planes, ((0, 0), (0, nr), (0, nc))),
+    static_argnums=(1, 2),
+    donate_argnums=(0,),
+)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _obs_bucket(n_prefixes: int, n_peers: int):
+    """(pow2 prefixes, pow2 peers) observatory/tuner bucket, tagged so a
+    BGP wall can never land in an SPF bucket (lazy import: the ops layer
+    must stay importable without arming the pipeline package)."""
+    from holo_tpu.pipeline.tuner import bgp_shape_bucket
+
+    return bgp_shape_bucket(n_prefixes, n_peers)
+
+
+# ---------------------------------------------------------------------------
+# backends
+
+
+class ScalarBgpTableBackend:
+    """The seam's identity element: every call delegates to the engine's
+    verbatim scalar decision process (the bit-identical oracle)."""
+
+    name = "scalar"
+
+    def begin_batch(self, engine, afs, table, prefixes) -> None:
+        return None
+
+    def note_route_change(self, afs: str, prefix: str) -> None:
+        return None
+
+    def best_path(self, engine, afs, table, prefix, dest):
+        return engine._best_path(table, dest)
+
+    def compute_nexthops(self, engine, afs, prefix, dest, best):
+        return engine._compute_nexthops(afs, dest, best)
+
+    def stats(self) -> dict:
+        return {"backend": self.name}
+
+
+@dataclass
+class _DevTable:
+    """Per-address-family resident planes + host-side interners."""
+
+    planes: jax.Array  # (N_LANES, cap_rows, cap_cols) int32
+    cap_rows: int
+    cap_cols: int
+    rows: dict = field(default_factory=dict)  # prefix -> row index
+    cols: dict = field(default_factory=dict)  # addr -> col index (>= 1)
+    fas_ids: _Interner = field(default_factory=_Interner)
+    path_ids: _Interner = field(default_factory=_Interner)
+    nh_ids: _Interner = field(default_factory=_Interner)
+    poisoned: set = field(default_factory=set)  # prefixes stuck on scalar
+    scatters: int = 0
+    grows: int = 0
+
+
+class TpuBgpTableBackend:
+    """Device best-path/multipath over resident packed planes, with the
+    scalar decision process as breaker fallback and per-prefix poison
+    escape hatch.  One instance serves every address family of one
+    engine (planes are keyed per afs)."""
+
+    name = "tpu"
+
+    def __init__(self, breaker: CircuitBreaker | None = None):
+        self.breaker = breaker or CircuitBreaker("bgp-table")
+        self._tables: dict[str, _DevTable] = {}
+        self._dirty: dict[str, set] = {}
+        self._batch: dict[str, dict | None] = {}
+        self._compiled: set = set()
+        self._dispatches = 0
+        self._fallbacks = 0
+        _register_backend(self)
+
+    # -- engine hooks ------------------------------------------------
+
+    def note_route_change(self, afs: str, prefix: str) -> None:
+        """Content changed under ``prefix`` — its device row is stale.
+        NHT-only churn does NOT come through here, which is what keeps
+        IGP convergence from re-marshaling the table."""
+        self._dirty.setdefault(afs, set()).add(prefix)
+
+    def begin_batch(self, engine, afs, table, prefixes) -> None:
+        self._batch[afs] = None
+        prefixes = list(prefixes)
+        if not prefixes:
+            return
+
+        def _device():
+            return self._device_batch(engine, afs, table, prefixes)
+
+        def _fallback():
+            self._fallbacks += 1
+            _FALLBACK.labels(context="bgp.decision").inc()
+            return None
+
+        self._batch[afs] = self.breaker.call(
+            _device, _fallback, context="bgp.decision"
+        )
+
+    def best_path(self, engine, afs, table, prefix, dest):
+        batch = self._batch.get(afs)
+        res = batch.get(prefix) if batch else None
+        if res is None:
+            _FALLBACK.labels(context="bgp.prefix").inc()
+            return engine._best_path(table, dest)
+        best_col, reasons, _elig, _mp_sel = res
+        dt = self._tables[afs]
+        best_route = None
+        expect_best = best_col >= 0
+        for addr, adj in dest.adj_rib.items():
+            route = adj.in_post
+            if route is None:
+                continue
+            col = dt.cols.get(addr)
+            if col is None:  # never marshaled: state drifted — bail out
+                return engine._best_path(table, dest)
+            best_route = self._apply_cell(
+                engine, table, route, col, best_col, reasons, best_route
+            )
+        if dest.redistribute is not None:
+            best_route = self._apply_cell(
+                engine,
+                table,
+                dest.redistribute,
+                LOCAL_COL,
+                best_col,
+                reasons,
+                best_route,
+            )
+        if not expect_best:
+            return None
+        if best_route is None:  # drift between scatter and readback
+            return engine._best_path(table, dest)
+        return type(best_route)(
+            origin=best_route.origin,
+            attrs=best_route.attrs,
+            route_type=best_route.route_type,
+            igp_cost=best_route.igp_cost,
+        )
+
+    @staticmethod
+    def _apply_cell(engine, table, route, col, best_col, reasons, best_route):
+        """Replay the oracle's per-candidate side effects (reason
+        strings are YANG-observable state) from the device verdicts."""
+        route.reject_reason = None
+        route.ineligible_reason = None
+        if route.attrs.as_path_contains(engine.asn):
+            route.ineligible_reason = "as-loop"
+            return best_route
+        if not route.origin.is_local():
+            nexthop = route.attrs.ll_nexthop or route.attrs.nexthop
+            nht = table.nht.get(nexthop)
+            route.igp_cost = nht.metric if nht else None
+            if route.igp_cost is None:
+                route.ineligible_reason = "unresolvable"
+                return best_route
+        if col == best_col:
+            return route
+        code = int(reasons[col])
+        if code:
+            route.reject_reason = REJECT_REASONS[code]
+        return best_route
+
+    def compute_nexthops(self, engine, afs, prefix, dest, best):
+        if best.origin.is_local():
+            return None
+        mp = engine.multipath.get(afs)
+        if not mp or not mp.get("enabled"):
+            return frozenset({best.attrs.ll_nexthop or best.attrs.nexthop})
+        batch = self._batch.get(afs)
+        res = batch.get(prefix) if batch else None
+        if res is None:
+            return engine._compute_nexthops(afs, dest, best)
+        _best_col, _reasons, _elig, mp_sel = res
+        dt = self._tables[afs]
+        nexthops = []
+        for addr, adj in dest.adj_rib.items():
+            route = adj.in_post
+            col = dt.cols.get(addr)
+            if route is None or col is None or not mp_sel[col]:
+                continue
+            nexthops.append(route.attrs.ll_nexthop or route.attrs.nexthop)
+        return frozenset(nexthops)
+
+    # -- device batch ------------------------------------------------
+
+    def _alloc_table(self, afs, cap_r: int, cap_c: int) -> _DevTable:
+        with sanctioned_transfer("bgp.table.alloc"):
+            planes = jnp.zeros((N_LANES, cap_r, cap_c), dtype=jnp.int32)
+        dt = self._tables[afs] = _DevTable(planes, cap_r, cap_c)
+        return dt
+
+    def _ensure_table(self, afs, n_rows: int, n_cols: int) -> _DevTable:
+        dt = self._tables.get(afs)
+        if dt is None:
+            return self._alloc_table(
+                afs, max(4, _pow2(n_rows)), max(2, _pow2(n_cols))
+            )
+        if n_rows > dt.cap_rows or n_cols > dt.cap_cols:
+            cap_r = max(dt.cap_rows, _pow2(n_rows))
+            cap_c = max(dt.cap_cols, _pow2(n_cols))
+            old = dt.planes
+            dt.planes = _grow(
+                old, cap_r - dt.cap_rows, cap_c - dt.cap_cols
+            )
+            note_donated("bgp.table.grow", old)
+            dt.cap_rows, dt.cap_cols = cap_r, cap_c
+            dt.grows += 1
+        return dt
+
+    def _device_batch(self, engine, afs, table, prefixes) -> dict:
+        faults.crashpoint("bgp.dispatch")
+        dirty = self._dirty.setdefault(afs, set())
+
+        # Column/row discovery before sizing the planes.
+        dt0 = self._tables.get(afs)
+        known_rows = dt0.rows if dt0 else {}
+        known_cols = dt0.cols if dt0 else {}
+        new_rows = [p for p in prefixes if p not in known_rows]
+        addrs = set(known_cols)
+        for p in prefixes:
+            dest = table.prefixes.get(p)
+            if dest is not None:
+                addrs.update(dest.adj_rib)
+        dt = self._ensure_table(
+            afs, len(known_rows) + len(new_rows), len(addrs) + 1
+        )
+        for p in new_rows:
+            dt.rows[p] = len(dt.rows)
+        for addr in sorted(addrs - set(dt.cols), key=_addr_key):
+            dt.cols[addr] = len(dt.cols) + 1  # col 0 is the local slot
+
+        marshal = [
+            p for p in prefixes if p in dirty or p in set(new_rows)
+        ]
+        rows_np, idx_np, batch_poison = self._marshal_rows(
+            engine, table, dt, marshal
+        )
+        dirty.difference_update(marshal)
+        dt.poisoned.difference_update(marshal)
+        dt.poisoned.update(batch_poison)
+
+        live = [
+            p
+            for p in prefixes
+            if p not in dt.poisoned and p in dt.rows
+        ]
+        mp_cfg = engine.multipath.get(afs) or {}
+        kind = "cold" if len(marshal) == len(prefixes) else "incremental"
+        bucket = _obs_bucket(len(live), len(dt.cols))
+        with profiling.dispatch_context(
+            kind="bgp", engine="fold", bucket=bucket
+        ), telemetry.span("bgp.table.dispatch", kind=kind, backend="tpu"):
+            with profiling.stage("bgp.table", "marshal"):
+                with sanctioned_transfer("bgp.table.marshal"):
+                    if len(idx_np):
+                        old = dt.planes
+                        dt.planes = _scatter(
+                            old,
+                            jnp.asarray(idx_np),
+                            jnp.asarray(rows_np),
+                        )
+                        note_donated("bgp.table.scatter", old)
+                        dt.scatters += 1
+                        _UPDATE_ROWS.labels(kind=kind).inc(len(idx_np))
+                    args = self._dispatch_args(dt, table, live, mp_cfg)
+            sig = (
+                "decide",
+                dt.cap_rows,
+                dt.cap_cols,
+                args[1].shape[0],
+                args[5].shape[0],
+            )
+            fresh = self._track_compile(kind, sig)
+            out = _decide(*args)
+            if fresh:
+                entry = profiling.record_cost(
+                    "bgp.table", _decide, *args, shape_sig=sig
+                )
+                if entry and observatory.active() is not None:
+                    observatory.note_cost(
+                        "bgp.table", "bgp", "fold", bucket, entry
+                    )
+            with profiling.stage("bgp.table", "device"):
+                faults.delaypoint("bgp.dispatch")
+                profiling.sync(out)
+            with profiling.stage("bgp.table", "readback"):
+                with sanctioned_transfer("bgp.table.unmarshal"):
+                    best_col, reasons, elig, mp_sel = (
+                        np.asarray(x) for x in out
+                    )
+        self._dispatches += 1
+        _DISPATCH_TOTAL.labels(kind=kind).inc()
+        _RECOMPUTED.labels(kind=kind).inc(len(live))
+        return {
+            p: (
+                int(best_col[i]),
+                reasons[i],
+                elig[i],
+                mp_sel[i],
+            )
+            for i, p in enumerate(live)
+        }
+
+    def _marshal_rows(self, engine, table, dt, marshal):
+        """Host-side lane packing for the changed rows.  A cell the
+        contract cannot represent poisons its prefix (scalar fallback)
+        and zeroes the row so stale device state can never win."""
+        n_cols = dt.cap_cols
+        rows_np = np.zeros((N_LANES, len(marshal), n_cols), np.int32)
+        idx_np = np.zeros((len(marshal),), np.int32)
+        poison = set()
+        for i, prefix in enumerate(marshal):
+            idx_np[i] = dt.rows[prefix]
+            dest = table.prefixes.get(prefix)
+            if dest is None:
+                continue  # withdrawn everywhere: row stays zero
+            try:
+                for addr, adj in dest.adj_rib.items():
+                    if adj.in_post is None:
+                        continue
+                    rows_np[:, i, dt.cols[addr]] = _encode_cell(
+                        adj.in_post,
+                        addr,
+                        engine.asn,
+                        dt.fas_ids,
+                        dt.path_ids,
+                        dt.nh_ids,
+                    )
+                if dest.redistribute is not None:
+                    rows_np[:, i, LOCAL_COL] = _encode_cell(
+                        dest.redistribute,
+                        None,
+                        engine.asn,
+                        dt.fas_ids,
+                        dt.path_ids,
+                        dt.nh_ids,
+                    )
+            except MarshalError:
+                rows_np[:, i, :] = 0
+                poison.add(prefix)
+                _FALLBACK.labels(context="bgp.marshal").inc()
+        return rows_np, idx_np, poison
+
+    def _dispatch_args(self, dt, table, live, mp_cfg):
+        n_cols = dt.cap_cols
+        # Candidate order: peers by address rank, unassigned columns
+        # (never eligible) next, local column strictly last.
+        by_addr = sorted(dt.cols.items(), key=lambda kv: _addr_key(kv[0]))
+        order_np = np.zeros((n_cols,), np.int32)
+        addr_rank_np = np.zeros((n_cols,), np.int32)
+        has_addr_np = np.zeros((n_cols,), np.int32)
+        pos = 0
+        assigned = {LOCAL_COL}
+        for rank, (_addr, col) in enumerate(by_addr):
+            order_np[pos] = col
+            addr_rank_np[col] = rank
+            has_addr_np[col] = 1
+            assigned.add(col)
+            pos += 1
+        for col in range(n_cols):
+            if col not in assigned:
+                order_np[pos] = col
+                pos += 1
+        order_np[pos] = LOCAL_COL
+
+        k = max(1, _pow2(len(dt.nh_ids)))
+        nht_enc_np = np.full((k,), _bias(0), np.int32)
+        nht_res_np = np.zeros((k,), np.int32)
+        for nh_id, addr in enumerate(dt.nh_ids.values):
+            nht = table.nht.get(addr)
+            if nht is not None and nht.metric is not None:
+                nht_enc_np[nh_id] = _bias(_u32(nht.metric, "metric") + 1)
+                nht_res_np[nh_id] = 1
+
+        m = max(1, _pow2(len(live)))
+        idx_np = np.zeros((m,), np.int32)
+        for i, p in enumerate(live):
+            idx_np[i] = dt.rows[p]
+        mp_np = np.asarray(
+            [
+                1 if mp_cfg.get("allow_multiple_as") else 0,
+                int(mp_cfg.get("ibgp_max", 1)),
+                int(mp_cfg.get("ebgp_max", 1)),
+            ],
+            np.int32,
+        )
+        return (
+            dt.planes,
+            jnp.asarray(idx_np),
+            jnp.asarray(order_np),
+            jnp.asarray(addr_rank_np),
+            jnp.asarray(has_addr_np),
+            jnp.asarray(nht_enc_np),
+            jnp.asarray(nht_res_np),
+            jnp.asarray(mp_np),
+        )
+
+    def _track_compile(self, kind: str, sig: tuple) -> bool:
+        fresh = sig not in self._compiled
+        if fresh:
+            self._compiled.add(sig)
+            _JIT_COMPILES.labels(kind=kind).inc()
+        else:
+            _JIT_HITS.labels(kind=kind).inc()
+        return fresh
+
+    # -- state surface ----------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``holo-telemetry/bgp-table`` gNMI leaf payload."""
+        tables = {}
+        resident_bytes = 0
+        for afs, dt in self._tables.items():
+            resident_bytes += N_LANES * dt.cap_rows * dt.cap_cols * 4
+            tables[afs] = {
+                "rows": len(dt.rows),
+                "cols": len(dt.cols),
+                "cap-rows": dt.cap_rows,
+                "cap-cols": dt.cap_cols,
+                "scatters": dt.scatters,
+                "grows": dt.grows,
+                "poisoned": len(dt.poisoned),
+            }
+        return {
+            "backend": self.name,
+            "dispatches": self._dispatches,
+            "fallbacks": self._fallbacks,
+            "compiled-shapes": len(self._compiled),
+            "resident-bytes": resident_bytes,
+            "tables": tables,
+        }
+
+
+# Live-backend registry for the telemetry provider (weakrefs: a backend
+# dropped with its engine must not leak through the gNMI surface).
+_BACKENDS: list = []
+
+
+def _register_backend(backend) -> None:
+    _BACKENDS.append(weakref.ref(backend))
+
+
+def backends_stats() -> list[dict]:
+    out = []
+    dead = []
+    for ref in _BACKENDS:
+        backend = ref()
+        if backend is None:
+            dead.append(ref)
+        else:
+            out.append(backend.stats())
+    for ref in dead:
+        _BACKENDS.remove(ref)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the bgp.py `_decision` boundary: that rank tuple has no conditional
+# MED rung, so it IS a clean total order — a packed-lane stable lexsort
+# is argsort-exact there.
+
+_lexsort = jax.jit(lambda lanes: jnp.lexsort(tuple(lanes)[::-1]))
+
+#: per-lane encodings for bgp.py's rank tuple
+#: (-local_pref, path len, origin, med, peer class, router id).
+_RANK_SPEC = ("neg_u32", "u31", "u31", "u32", "u31", "u32")
+
+
+class DeviceRankBackend:
+    """Batched stable sort of ``bgp.Bgp._decision`` rank tuples on
+    device.  ``rank_order`` returns the sort permutation, or ``None``
+    when a tuple falls outside the lane contract or the device faults —
+    the caller then runs its own ``list.sort`` (the oracle)."""
+
+    name = "tpu-rank"
+
+    def __init__(self, breaker: CircuitBreaker | None = None):
+        self.breaker = breaker or CircuitBreaker("bgp-rank")
+        self._compiled: set = set()
+
+    def _encode(self, ranks) -> np.ndarray | None:
+        n = len(ranks)
+        lanes = np.full((len(_RANK_SPEC), _pow2(max(1, n))), 2**31 - 1, np.int32)
+        try:
+            for i, rank in enumerate(ranks):
+                for j, (spec, v) in enumerate(zip(_RANK_SPEC, rank)):
+                    if spec == "neg_u32":  # v = -lp, lp in [0, 2**32)
+                        lanes[j, i] = _bias(_u32(-v, "neg lane") ^ _U32)
+                    elif spec == "u32":
+                        lanes[j, i] = _bias(_u32(v, "u32 lane"))
+                    else:  # u31: must fit int32 directly
+                        v = int(v)
+                        if not 0 <= v < _BIAS:
+                            raise MarshalError("u31 lane out of range")
+                        lanes[j, i] = v
+        except MarshalError:
+            _FALLBACK.labels(context="bgp.rank").inc()
+            return None
+        return lanes
+
+    def rank_order(self, ranks) -> list[int] | None:
+        if len(ranks) < 2:
+            return list(range(len(ranks)))
+        lanes = self._encode(ranks)
+        if lanes is None:
+            return None
+
+        def _device():
+            sig = ("rank", lanes.shape[1])
+            fresh = sig not in self._compiled
+            if fresh:
+                self._compiled.add(sig)
+                _JIT_COMPILES.labels(kind="rank").inc()
+            else:
+                _JIT_HITS.labels(kind="rank").inc()
+            with telemetry.span(
+                "bgp.rank.dispatch", kind="rank", backend="tpu"
+            ):
+                with sanctioned_transfer("bgp.rank.marshal"):
+                    order = _lexsort(jnp.asarray(lanes))
+                with sanctioned_transfer("bgp.rank.unmarshal"):
+                    order_np = np.asarray(order)
+            _DISPATCH_TOTAL.labels(kind="rank").inc()
+            return [int(i) for i in order_np if i < len(ranks)]
+
+        def _fallback():
+            _FALLBACK.labels(context="bgp.rank").inc()
+            return None
+
+        return self.breaker.call(_device, _fallback, context="bgp.rank")

@@ -125,6 +125,15 @@ def shape_bucket(
     return (_pow2(n_vertices), _pow2(n_edges), _pow2(batch), mesh, int(k))
 
 
+def bgp_shape_bucket(n_prefixes: int, n_peers: int) -> tuple:
+    """Observatory/tuner bucket for the device BGP table (ISSUE 16):
+    pow2-quantized (prefixes, peers), tagged with a leading ``"bgp"``
+    discriminant so a BGP fold wall can never land in — or outvote —
+    an SPF bucket (SPF keys are 5-tuples of ints/mesh; this is a
+    3-tuple led by a string, disjoint by construction)."""
+    return ("bgp", _pow2(max(1, n_prefixes)), _pow2(max(1, n_peers)))
+
+
 def _median(vals) -> float | None:
     """Lower median: with an even sample count, prefer the smaller
     middle value — stray one-off spikes (GC, scheduler) must not

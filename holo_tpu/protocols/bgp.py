@@ -614,6 +614,11 @@ class BgpInstance(Actor):
         self.route_cb = route_cb
         self.notif_cb = notif_cb
         self.policy_worker = policy_worker
+        # Decision-rank dispatch seam (ISSUE 16): a DeviceRankBackend
+        # (holo_tpu/ops/bgp_table.py) sorts the _decision rank tuples on
+        # device — this rank IS a total order (no conditional MED rung),
+        # so a packed-lane stable lexsort is exact.  None = host sort.
+        self.rank_backend = None
         self.peers: dict = {}  # peer address (v4 or v6) -> Peer
         self.local_addr: dict[str, IPv4Address] = {}  # ifname -> our v4 addr
         self.local_addr6: dict[str, IPv6Address] = {}  # ifname -> our v6 addr
@@ -989,7 +994,13 @@ class BgpInstance(Actor):
                 int(peer.remote_rid or 0) if peer else 0,
             )
 
-        cands.sort(key=rank)
+        order = None
+        if self.rank_backend is not None:
+            order = self.rank_backend.rank_order([rank(e) for e in cands])
+        if order is not None:
+            cands = [cands[i] for i in order]
+        else:
+            cands.sort(key=rank)
         if cands:
             self.loc_rib[prefix] = cands
         else:

@@ -275,11 +275,23 @@ _AF_TUPLE = {
 class BgpEngine:
     """One BGP speaker (holo-bgp Instance + InstanceState combined)."""
 
-    def __init__(self, name: str, send_cb=None, ibus_cb=None, notif_cb=None):
+    def __init__(
+        self,
+        name: str,
+        send_cb=None,
+        ibus_cb=None,
+        notif_cb=None,
+        table_backend=None,
+    ):
         self.name = name
         self.send_cb = send_cb or (lambda kind, payload: None)
         self.ibus_cb = ibus_cb or (lambda kind, payload: None)
         self.notif_cb = notif_cb or (lambda data: None)
+        # Decision-process dispatch seam (ISSUE 16): None keeps the
+        # scalar walk below byte-for-byte; a BgpTableBackend (see
+        # holo_tpu/ops/bgp_table.py) moves best-path/multipath onto
+        # device planes with this scalar path as its oracle + fallback.
+        self.table_backend = table_backend
 
         # config
         self.asn = 0
@@ -533,6 +545,8 @@ class BgpEngine:
             if adj is not None and adj.in_post is not None:
                 self._nexthop_untrack(table, prefix, adj.in_post)
             table.queued.add(prefix)
+            if self.table_backend is not None:
+                self.table_backend.note_route_change(afs, prefix)
 
     # ---- message sending
 
@@ -722,6 +736,8 @@ class BgpEngine:
                 self._nexthop_untrack(table, prefix, adj.in_post)
                 adj.in_post = None
             table.queued.add(prefix)
+            if self.table_backend is not None:
+                self.table_backend.note_route_change(afs, prefix)
 
     # ---- policy results (recorded worker outputs; events.rs:441-639)
 
@@ -752,6 +768,8 @@ class BgpEngine:
                         self._nexthop_untrack(table, prefix, adj.in_post)
                         adj.in_post = None
                 table.queued.add(prefix)
+                if self.table_backend is not None:
+                    self.table_backend.note_route_change(afs, prefix)
             self.trigger_decision_process()
         else:  # Export
             for prefix, result in routes:
@@ -798,6 +816,8 @@ class BgpEngine:
             if dest is not None:
                 dest.redistribute = None
         table.queued.add(prefix)
+        if self.table_backend is not None:
+            self.table_backend.note_route_change(afs, prefix)
         self.trigger_decision_process()
 
     # ---- ibus rx
@@ -851,12 +871,22 @@ class BgpEngine:
         table = self.tables[afs]
         queued = sorted(table.queued, key=_prefix_key)
         table.queued = set()
+        tb = self.table_backend
+        if tb is not None:
+            # One device batch for the whole queued set: scatter the
+            # changed rows, recompute only these prefixes, read the
+            # verdicts back once.  Per-prefix results are consumed in
+            # best_path below; any miss falls back to the scalar walk.
+            tb.begin_batch(self, afs, table, queued)
         reach, unreach = [], []
         for prefix in queued:
             dest = table.prefixes.get(prefix)
             if dest is None:
                 continue
-            best = self._best_path(table, dest)
+            if tb is not None:
+                best = tb.best_path(self, afs, table, prefix, dest)
+            else:
+                best = self._best_path(table, dest)
             self._loc_rib_update(afs, table, prefix, dest, best)
             if best is not None:
                 reach.append((prefix, best))
@@ -971,7 +1001,12 @@ class BgpEngine:
     ) -> None:
         """rib.rs:776-847."""
         if best is not None:
-            nexthops = self._compute_nexthops(afs, dest, best)
+            if self.table_backend is not None:
+                nexthops = self.table_backend.compute_nexthops(
+                    self, afs, prefix, dest, best
+                )
+            else:
+                nexthops = self._compute_nexthops(afs, dest, best)
             if (
                 dest.local is not None
                 and dest.local.origin == best.origin

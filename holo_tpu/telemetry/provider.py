@@ -29,6 +29,9 @@ Tree shape (walks into one gNMI update per leaf under PROTO encoding):
       gnmi-fanout/               # shared-delta fan-out engine (ISSUE 11):
         epoch, subscribers,      #   epoch id, cursor/bucket population,
         buckets, breaker, ...    #   breaker state + failure tally
+      bgp-table/                 # device BGP plane (ISSUE 16): dispatch
+        dispatches, fallbacks,   #   and fallback tallies, compiled shapes,
+        tables/...               #   resident rows/cols + poisoned prefixes
       observatory/               # dispatch observatory (ISSUE 12; while
         sketches, observations,  #   armed): sketch population, sentinel
         sentinel/...             #   ledger + regressed keys, peak source
@@ -133,6 +136,14 @@ class TelemetryStateProvider(NbProvider):
         # excludes this leaf from its own sampled store (delta.py
         # SELF_ROOT) so its epoch bookkeeping cannot feed back into
         # the change-set it is diffing.
+        # Device BGP table (ISSUE 16): Adj-RIB-In plane residency and
+        # dispatch/fallback tallies, one entry per live backend (same
+        # lazy discipline — scalar-only daemons never import the module).
+        bgm = sys.modules.get("holo_tpu.ops.bgp_table")
+        if bgm is not None:
+            rows = bgm.backends_stats()
+            if rows:
+                out["bgp-table"] = rows[0] if len(rows) == 1 else rows
         fan = sys.modules.get("holo_tpu.telemetry.delta")
         if fan is not None:
             rows = fan.engines_stats()

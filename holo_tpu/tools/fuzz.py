@@ -651,6 +651,134 @@ def partition_invariants(data: bytes) -> None:
     )
 
 
+def bgp_table_invariants(data: bytes) -> None:
+    """Device BGP table invariants (ISSUE 16; not a wire decoder): over
+    arbitrary small Adj-RIB-In tables the device fold must satisfy
+    (a) eligibility ⊆ occupancy, (b) the winning column is the scalar
+    oracle's best path (which, whenever the conditional MED rung never
+    fires, is exactly the min packed sort key among eligible columns),
+    and (c) the device multipath selection is a ⊆ of the equal-key set,
+    capped at max_paths — all checked against the verbatim scalar
+    decision process on an identical table.  Violations raise
+    AssertionError (a crash)."""
+    if len(data) < 6:
+        raise DecodeError("bgp table spec: need 6+ bytes")
+    from holo_tpu.ops.bgp_table import TpuBgpTableBackend  # noqa: PLC0415
+    from holo_tpu.protocols.bgp_engine import (  # noqa: PLC0415
+        AdjRib,
+        AsSegment,
+        BaseAttrs,
+        BgpEngine,
+        Destination,
+        NhtEntry,
+        Route,
+        RouteOrigin,
+    )
+
+    n_prefixes = 1 + data[0] % 4
+    n_peers = 1 + data[1] % 3
+    mp_byte = data[2]
+    need = 3 + n_prefixes * n_peers
+    if len(data) < need:
+        raise DecodeError(f"bgp table spec: need {need} bytes")
+    mp_cfg = None
+    if mp_byte & 1:
+        mp_cfg = {
+            "enabled": True,
+            "ebgp_max": 1 + (mp_byte >> 1) % 3,
+            "ibgp_max": 1 + (mp_byte >> 3) % 3,
+            "allow_multiple_as": bool(mp_byte & 0x20),
+        }
+
+    def build(backend):
+        eng = BgpEngine("fuzz", table_backend=backend)
+        eng.asn = 65000
+        if mp_cfg:
+            eng.multipath["ipv4-unicast"] = dict(mp_cfg)
+        table = eng.tables["ipv4-unicast"]
+        for addr, metric in (("9.9.9.1", 10), ("9.9.9.2", None)):
+            table.nht[addr] = NhtEntry(metric=metric)
+        k = 3
+        for pi in range(n_prefixes):
+            prefix = f"10.0.{pi}.0/24"
+            for qi in range(n_peers):
+                b = data[k]
+                k += 1
+                if not b & 1:
+                    continue  # empty cell
+                addr = f"1.1.1.{qi + 1}"
+                path = (65000,) if b & 2 else (100 + (b >> 2) % 2,)
+                attrs = BaseAttrs(
+                    origin=("Igp", "Egp", "Incomplete")[(b >> 3) % 3],
+                    as_path=(AsSegment("Sequence", path),),
+                    nexthop="9.9.9.1" if b & 0x40 else "9.9.9.2",
+                    med=None if b & 0x80 else (b >> 2) % 4,
+                    local_pref=None if b & 0x10 else 100 + (b % 8),
+                )
+                dest = table.prefixes.setdefault(prefix, Destination())
+                adj = dest.adj_rib.setdefault(addr, AdjRib())
+                adj.in_post = Route(
+                    origin=RouteOrigin(
+                        identifier=f"0.0.0.{1 + (b >> 5) % 2}",
+                        remote_addr=addr,
+                    ),
+                    attrs=attrs,
+                    route_type="External" if b & 4 else "Internal",
+                )
+                table.queued.add(prefix)
+                if backend is not None:
+                    backend.note_route_change("ipv4-unicast", prefix)
+        return eng
+
+    scalar = build(None)
+    backend = TpuBgpTableBackend()
+    device = build(backend)
+    scalar.run_decision_process()
+    device.run_decision_process()
+
+    st, dt = scalar.tables["ipv4-unicast"], device.tables["ipv4-unicast"]
+    assert set(st.prefixes) == set(dt.prefixes), "pruned prefix sets differ"
+    for prefix, sdest in st.prefixes.items():
+        ddest = dt.prefixes[prefix]
+        s_best = (
+            None
+            if sdest.local is None
+            else (sdest.local.attrs, sdest.local.route_type)
+        )
+        d_best = (
+            None
+            if ddest.local is None
+            else (ddest.local.attrs, ddest.local.route_type)
+        )
+        assert s_best == d_best, f"best path diverged at {prefix}"
+        assert sdest.local_nexthops == ddest.local_nexthops, (
+            f"multipath set diverged at {prefix}"
+        )
+
+    batch = backend._batch.get("ipv4-unicast")
+    if batch:
+        devtab = backend._tables["ipv4-unicast"]
+        for prefix, (best_col, _reasons, elig, mp_sel) in batch.items():
+            dest = dt.prefixes.get(prefix)
+            occ = {0} if dest is not None and dest.redistribute else set()
+            if dest is not None:
+                occ |= {
+                    devtab.cols[a]
+                    for a, adj in dest.adj_rib.items()
+                    if adj.in_post is not None
+                }
+            elig_cols = {int(c) for c in range(len(elig)) if elig[c]}
+            assert elig_cols <= occ, "eligibility outside occupancy"
+            assert (best_col >= 0) == bool(elig_cols), "winner vs eligibility"
+            if best_col >= 0:
+                assert best_col in elig_cols, "winner not eligible"
+            sel = {int(c) for c in range(len(mp_sel)) if mp_sel[c]}
+            assert sel <= elig_cols, "multipath outside eligible set"
+            if mp_cfg:
+                cap = max(mp_cfg["ebgp_max"], mp_cfg["ibgp_max"])
+                assert len(sel) <= cap, "multipath wider than max_paths"
+
+
 # ===== target registry (the reference's fuzz_targets/** inventory) =====
 
 
@@ -747,6 +875,9 @@ def targets() -> dict:
         # Partitioned SPF (ISSUE 15): exact partition cover, cut-closed
         # boundary/halo sets, skeleton-stitch exactness vs the oracle.
         "partition_invariants": partition_invariants,
+        # Device BGP table (ISSUE 16): eligibility ⊆ occupancy, device
+        # winner == scalar oracle best path, multipath ⊆ equal-key set.
+        "bgp_table_invariants": bgp_table_invariants,
     }
 
     # Authenticated decode paths (r5): the auth framing (trailer

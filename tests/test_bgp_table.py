@@ -1,0 +1,471 @@
+"""Device BGP table vs the scalar decision process (ISSUE 16).
+
+Every arm builds two identical engines — one on the verbatim scalar
+walk, one on :class:`TpuBgpTableBackend` — runs the decision process
+under ``jax.transfer_guard("disallow")`` plus the armed donation guard,
+and asserts the full observable state is bit-identical: Loc-RIB routes
+and nexthop sets, per-candidate reject/ineligible reason strings (YANG
+renders them), candidate ``igp_cost`` side effects, and the ibus
+RouteIpAdd/RouteIpDel stream.
+"""
+
+from dataclasses import replace
+
+import jax
+import pytest
+
+from holo_tpu.analysis import runtime
+from holo_tpu.ops.bgp_table import (
+    DeviceRankBackend,
+    ScalarBgpTableBackend,
+    TpuBgpTableBackend,
+    backends_stats,
+)
+from holo_tpu.protocols.bgp_engine import (
+    AdjRib,
+    AsSegment,
+    BaseAttrs,
+    BgpEngine,
+    Destination,
+    NhtEntry,
+    Route,
+    RouteOrigin,
+)
+from holo_tpu.resilience.breaker import CircuitBreaker
+
+AFS = "ipv4-unicast"
+
+
+def seg(*asns):
+    return (AsSegment("Sequence", tuple(asns)),)
+
+
+def mk_engine(backend=None, mp=None):
+    calls = []
+    eng = BgpEngine(
+        "r1",
+        ibus_cb=lambda kind, payload: calls.append((kind, payload)),
+        table_backend=backend,
+    )
+    eng.asn = 65000
+    if mp:
+        eng.multipath[AFS] = dict(mp)
+    return eng, calls
+
+
+def install(eng, routes, nht=(), redistribute=()):
+    """routes: (prefix, peer_addr, attrs, route_type, router_id)."""
+    table = eng.tables[AFS]
+    for prefix, addr, attrs, route_type, rid in routes:
+        dest = table.prefixes.setdefault(prefix, Destination())
+        adj = dest.adj_rib.setdefault(addr, AdjRib())
+        adj.in_post = Route(
+            origin=RouteOrigin(identifier=rid, remote_addr=addr),
+            attrs=attrs,
+            route_type=route_type,
+        )
+        queue(eng, prefix)
+    for prefix, attrs in redistribute:
+        dest = table.prefixes.setdefault(prefix, Destination())
+        dest.redistribute = Route(
+            origin=RouteOrigin(protocol="static"),
+            attrs=attrs,
+            route_type="Internal",
+        )
+        queue(eng, prefix)
+    for addr, metric in dict(nht).items():
+        table.nht[addr] = NhtEntry(metric=metric)
+
+
+def queue(eng, prefix):
+    eng.tables[AFS].queued.add(prefix)
+    if eng.table_backend is not None:
+        eng.table_backend.note_route_change(AFS, prefix)
+
+
+def withdraw(eng, prefix, addr):
+    table = eng.tables[AFS]
+    adj = table.prefixes[prefix].adj_rib[addr]
+    eng._nexthop_untrack(table, prefix, adj.in_post)
+    adj.in_pre = None
+    adj.in_post = None
+    queue(eng, prefix)
+
+
+def run(eng):
+    if isinstance(eng.table_backend, TpuBgpTableBackend):
+        # The guards are the arm's point: any unsanctioned transfer or
+        # use-after-donation in the device path must fail loudly here.
+        with jax.transfer_guard("disallow"), runtime.donation_guard():
+            eng.run_decision_process()
+    else:
+        eng.run_decision_process()
+
+
+def snapshot(eng):
+    out = {}
+    for prefix, dest in eng.tables[AFS].prefixes.items():
+        out[prefix] = {
+            "local": None
+            if dest.local is None
+            else (
+                dest.local.origin,
+                dest.local.attrs,
+                dest.local.route_type,
+                dest.local.igp_cost,
+            ),
+            "nexthops": dest.local_nexthops,
+            "adj": {
+                addr: (
+                    adj.in_post.reject_reason,
+                    adj.in_post.ineligible_reason,
+                    adj.in_post.igp_cost,
+                )
+                for addr, adj in dest.adj_rib.items()
+                if adj.in_post is not None
+            },
+            "redistribute": None
+            if dest.redistribute is None
+            else (
+                dest.redistribute.reject_reason,
+                dest.redistribute.ineligible_reason,
+            ),
+        }
+    return out
+
+
+def assert_parity(pair, calls):
+    (scalar, device) = pair
+    assert snapshot(scalar) == snapshot(device)
+    assert calls[0] == calls[1]
+
+
+def parity_pair(routes, nht=(), mp=None, redistribute=(), backend=None):
+    scalar, s_calls = mk_engine(mp=mp)
+    install(scalar, routes, nht, redistribute)
+    device, d_calls = mk_engine(
+        backend=backend or TpuBgpTableBackend(), mp=mp
+    )
+    install(device, routes, nht, redistribute)
+    run(scalar)
+    run(device)
+    assert_parity((scalar, device), (s_calls, d_calls))
+    return scalar, device, s_calls, d_calls
+
+
+def test_plain_best_path_parity():
+    scalar, device, _, _ = parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(100), nexthop="9.9.9.1",
+                       med=100), "External", "1.1.1.1"),
+            ("10.0.0.0/24", "1.1.1.2",
+             BaseAttrs(origin="Igp", as_path=seg(200), nexthop="9.9.9.2",
+                       med=0), "External", "1.1.1.2"),
+            ("10.0.0.0/24", "1.1.1.3",
+             BaseAttrs(origin="Igp", as_path=seg(100), nexthop="9.9.9.3",
+                       med=0), "External", "1.1.1.3"),
+            ("10.0.1.0/24", "1.1.1.2",
+             BaseAttrs(origin="Egp", as_path=seg(100), nexthop="9.9.9.9"),
+             "External", "1.1.1.2"),  # unresolvable next hop
+            ("10.0.2.0/24", "1.1.1.2",
+             BaseAttrs(origin="Igp", as_path=seg(65000, 1),
+                       nexthop="9.9.9.2"), "External", "1.1.1.2"),  # AS loop
+        ],
+        nht={"9.9.9.1": 10, "9.9.9.2": 10, "9.9.9.3": 5},
+    )
+    st = device.table_backend.stats()
+    assert st["dispatches"] == 1 and st["fallbacks"] == 0
+
+
+def test_med_non_transitive_cycle_parity():
+    """X3 beats X1 on MED, X1 beats X2 on router-id, X2 beats X3 on
+    router-id: a preference CYCLE — no static sort key exists, only the
+    sequential fold reproduces the oracle.  The device must agree."""
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1",
+                       med=100), "External", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.2",
+             BaseAttrs(origin="Igp", as_path=seg(2), nexthop="9.9.9.1",
+                       med=0), "External", "0.0.0.2"),
+            ("10.0.0.0/24", "1.1.1.3",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1",
+                       med=0), "External", "0.0.0.3"),
+        ],
+        nht={"9.9.9.1": 10},
+    )
+
+
+def test_med_missing_folds_to_zero():
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1",
+                       med=None), "External", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.2",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1",
+                       med=5), "External", "0.0.0.2"),
+        ],
+        nht={"9.9.9.1": 10},
+    )
+
+
+def test_tie_breaker_ladder_parity():
+    """One arm per rung: local-pref, path length, origin, peer type,
+    IGP cost (incl. the None-preferred asymmetry), router-id, and the
+    final peer-address / incumbent-wins fallback."""
+    a = BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1")
+    cases = [
+        (replace(a, local_pref=200), replace(a, local_pref=100)),
+        (replace(a, as_path=seg(1)), replace(a, as_path=seg(1, 2))),
+        (replace(a, origin="Igp"), replace(a, origin="Incomplete")),
+        (a, a),  # full tie -> router-id rung
+    ]
+    for attrs1, attrs2 in cases:
+        parity_pair(
+            [
+                ("10.0.0.0/24", "1.1.1.1", attrs1, "External", "0.0.0.2"),
+                ("10.0.0.0/24", "1.1.1.2", attrs2, "External", "0.0.0.1"),
+            ],
+            nht={"9.9.9.1": 10},
+        )
+    # prefer-external + IGP cost rungs
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1", a, "Internal", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.2", a, "External", "0.0.0.2"),
+        ],
+        nht={"9.9.9.1": 10},
+    )
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             replace(a, nexthop="9.9.9.1"), "External", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.2",
+             replace(a, nexthop="9.9.9.2"), "External", "0.0.0.2"),
+        ],
+        nht={"9.9.9.1": 20, "9.9.9.2": 10},
+    )
+    # identical router-ids -> higher-peer-address fallback
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.2", a, "External", "0.0.0.9"),
+            ("10.0.0.0/24", "1.1.1.1", a, "External", "0.0.0.9"),
+        ],
+        nht={"9.9.9.1": 10},
+    )
+
+
+def test_redistribute_column_parity():
+    local = BaseAttrs(origin="Igp", as_path=())
+    peer = BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1")
+    for lp in (50, 200):
+        parity_pair(
+            [("10.0.0.0/24", "1.1.1.1", replace(peer, local_pref=lp),
+              "External", "0.0.0.1")],
+            nht={"9.9.9.1": 10},
+            redistribute=[("10.0.0.0/24", local)],
+        )
+
+
+@pytest.mark.parametrize(
+    "mp",
+    [
+        {"enabled": True, "ebgp_max": 2, "ibgp_max": 1,
+         "allow_multiple_as": True},
+        {"enabled": True, "ebgp_max": 4, "ibgp_max": 1,
+         "allow_multiple_as": False},
+        {"enabled": False},
+    ],
+)
+def test_multipath_parity(mp):
+    parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+             "External", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.2",
+             BaseAttrs(origin="Igp", as_path=seg(2), nexthop="9.9.9.2"),
+             "External", "0.0.0.1"),
+            ("10.0.0.0/24", "1.1.1.3",
+             BaseAttrs(origin="Igp", as_path=seg(3), nexthop="9.9.9.3"),
+             "External", "0.0.0.1"),
+        ],
+        nht={"9.9.9.1": 10, "9.9.9.2": 10, "9.9.9.3": 10},
+        mp=mp,
+    )
+
+
+def test_peer_flap_parity():
+    routes = [
+        ("10.0.0.0/24", "1.1.1.1",
+         BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+         "External", "0.0.0.1"),
+        ("10.0.0.0/24", "1.1.1.2",
+         BaseAttrs(origin="Igp", as_path=seg(2), nexthop="9.9.9.2"),
+         "External", "0.0.0.2"),
+    ]
+    nht = {"9.9.9.1": 20, "9.9.9.2": 10}
+    scalar, device, s_calls, d_calls = parity_pair(routes, nht)
+    for eng in (scalar, device):
+        withdraw(eng, "10.0.0.0/24", "1.1.1.2")
+        run(eng)
+    assert_parity((scalar, device), (s_calls, d_calls))
+    # flap back up
+    for eng in (scalar, device):
+        table = eng.tables[AFS]
+        adj = table.prefixes["10.0.0.0/24"].adj_rib["1.1.1.2"]
+        adj.in_post = Route(
+            origin=RouteOrigin(identifier="0.0.0.2", remote_addr="1.1.1.2"),
+            attrs=routes[1][2],
+            route_type="External",
+        )
+        eng._nexthop_track(table, "10.0.0.0/24", adj.in_post)
+        queue(eng, "10.0.0.0/24")
+        run(eng)
+    assert_parity((scalar, device), (s_calls, d_calls))
+
+
+def test_incremental_chain_reuses_resident_rows():
+    routes = [
+        ("10.0.0.0/24", "1.1.1.1",
+         BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+         "External", "0.0.0.1"),
+        ("10.0.1.0/24", "1.1.1.1",
+         BaseAttrs(origin="Igp", as_path=seg(1, 2), nexthop="9.9.9.1"),
+         "External", "0.0.0.1"),
+    ]
+    scalar, device, s_calls, d_calls = parity_pair(
+        routes, nht={"9.9.9.1": 10}
+    )
+    for eng in (scalar, device):
+        table = eng.tables[AFS]
+        table.nht["9.9.9.1"].prefixes = {
+            "10.0.0.0/24": 1, "10.0.1.0/24": 1
+        }
+    scatters_before = device.table_backend.stats()["tables"][AFS]["scatters"]
+    # NHT-only churn: queued via nexthop_update, no note_route_change —
+    # the device must recompute from RESIDENT rows, zero re-marshal.
+    for eng in (scalar, device):
+        eng.nexthop_update("9.9.9.1", 99)
+        run(eng)
+    assert_parity((scalar, device), (s_calls, d_calls))
+    st = device.table_backend.stats()["tables"][AFS]
+    assert st["scatters"] == scatters_before, "NHT churn re-marshaled"
+    # metric loss makes everything unresolvable -> RouteIpDel parity
+    for eng in (scalar, device):
+        eng.nexthop_update("9.9.9.1", None)
+        run(eng)
+    assert_parity((scalar, device), (s_calls, d_calls))
+
+
+def test_breaker_fallback_parity():
+    backend = TpuBgpTableBackend(
+        breaker=CircuitBreaker(
+            "bgp-table-test-fallback", failure_threshold=1, enabled=True
+        )
+    )
+    backend._device_batch = _boom  # device path always faults
+    scalar, device, _, _ = parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+             "External", "0.0.0.1"),
+        ],
+        nht={"9.9.9.1": 10},
+        backend=backend,
+    )
+    assert device.table_backend.stats()["fallbacks"] >= 1
+
+
+def _boom(*_args, **_kw):
+    raise RuntimeError("injected device fault")
+
+
+def test_marshal_poison_falls_back_per_prefix():
+    """A route outside the lane contract (med >= 2**32) poisons only
+    its own prefix; everything else stays on device, parity holds."""
+    scalar, device, _, _ = parity_pair(
+        [
+            ("10.0.0.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1",
+                       med=2**40), "External", "0.0.0.1"),
+            ("10.0.1.0/24", "1.1.1.1",
+             BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+             "External", "0.0.0.1"),
+        ],
+        nht={"9.9.9.1": 10},
+    )
+    st = device.table_backend.stats()["tables"][AFS]
+    assert st["poisoned"] == 1
+
+
+def test_scalar_backend_is_the_identity_seam():
+    routes = [
+        ("10.0.0.0/24", "1.1.1.1",
+         BaseAttrs(origin="Igp", as_path=seg(1), nexthop="9.9.9.1"),
+         "External", "0.0.0.1"),
+    ]
+    bare, bare_calls = mk_engine()
+    install(bare, routes, {"9.9.9.1": 10})
+    bare.run_decision_process()
+    seam, seam_calls = mk_engine(backend=ScalarBgpTableBackend())
+    install(seam, routes, {"9.9.9.1": 10})
+    seam.run_decision_process()
+    assert_parity((bare, seam), (bare_calls, seam_calls))
+
+
+def test_stats_ride_the_gnmi_leaf():
+    backend = TpuBgpTableBackend()
+    assert any(
+        s["backend"] == "tpu" for s in backends_stats()
+    )
+    from holo_tpu.telemetry.provider import TelemetryStateProvider
+
+    state = TelemetryStateProvider().get_state()
+    assert "bgp-table" in state["holo-telemetry"], (
+        "bgp_table imported but no holo-telemetry/bgp-table leaf"
+    )
+    del backend
+
+
+def test_device_rank_backend_matches_host_sort():
+    rb = DeviceRankBackend()
+    ranks = [
+        (-200, 1, 0, 0, 1, 7),
+        (-100, 1, 0, 0, 1, 7),
+        (-200, 1, 0, 0, 1, 3),
+        (-200, 2, 0, 5, 2, 3),
+        (-200, 1, 0, 0, 1, 3),  # duplicate: stability must hold
+    ]
+    order = rb.rank_order(list(ranks))
+    want = sorted(range(len(ranks)), key=lambda i: ranks[i])
+    assert order == want
+    # out-of-contract lane -> None (caller falls back to list.sort)
+    assert rb.rank_order([(0, 0, 0, 2**32, 0, 0), (0, 0, 0, 0, 0, 0)]) is None
+
+
+def test_bgp_instance_decision_rides_rank_backend():
+    from ipaddress import IPv4Address, IPv4Network
+
+    from holo_tpu.protocols import bgp
+
+    class _NullNetIo:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    def build(rank_backend):
+        inst = bgp.BgpInstance(
+            "b1", 65000, IPv4Address("10.255.0.1"), _NullNetIo()
+        )
+        inst.rank_backend = rank_backend
+        prefix = IPv4Network("10.9.0.0/24")
+        inst.originated[prefix] = bgp.PathAttrs(
+            origin=bgp.Origin.IGP, as_path=()
+        )
+        inst._decision(prefix)
+        return [e.attrs for e in inst.loc_rib[prefix]]
+
+    assert build(None) == build(DeviceRankBackend())

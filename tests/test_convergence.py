@@ -380,8 +380,9 @@ def test_list_rules_shows_severity():
     assert all(r.severity in ("error", "warn") for r in all_rules())
     # Every established rule stays on gate duty; the warn tier carries
     # exactly the rules currently soaking toward error tier.  HL107
-    # soaked through PR 7 and was promoted in ISSUE 8; HL205 landed in
-    # ISSUE 14 and is soaking now.  Promote, don't accumulate.
+    # soaked through PR 7 and was promoted in ISSUE 8; HL205 soaked
+    # from ISSUE 14 and was promoted in ISSUE 16.  Promote, don't
+    # accumulate: the soak set is empty until a new rule lands.
     soaking = {r.id for r in all_rules() if r.severity == "warn"}
-    assert soaking == {"HL205"}
-    assert all(r.severity == "error" for r in all_rules() if r.id != "HL205")
+    assert soaking == set()
+    assert all(r.severity == "error" for r in all_rules())

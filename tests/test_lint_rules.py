@@ -1471,15 +1471,15 @@ def test_hl205_cross_thread_publication():
     )
 
 
-def test_hl205_is_warn_tier_soak():
-    # HL107 precedent: one soak at warn before gate duty — findings
-    # report and ride the JSON output but never exit-1.
+def test_hl205_is_error_tier_gated():
+    # Promoted after the ISSUE 14/15 soak (HL107 precedent): findings
+    # now gate tier-1 like the rest of the lock family.
     from holo_tpu.analysis import gate_findings
 
     res = lint(HL205_BAD, SHARED)
     f = next(f for f in res.findings if f.rule == "HL205")
-    assert f.severity == "warn"
-    assert f not in gate_findings(res.findings)
+    assert f.severity == "error"
+    assert f in gate_findings(res.findings)
 
 
 def test_hl205_approved_seams_are_clean():
@@ -1527,13 +1527,13 @@ def test_hl205_out_of_scope_module_is_ignored():
     assert rules_fired(HL205_BAD, OUTSIDE) == set()
 
 
-def test_soak_tier_is_exactly_hl205():
-    # The severity-tier contract: HL205 is the ONLY rule still soaking
-    # at warn; promoting it (or adding a new soak) must edit this test.
+def test_soak_tier_is_empty():
+    # The severity-tier contract: HL205 finished its soak in ISSUE 16,
+    # so NO rule ships at warn; adding a new soak must edit this test.
     from holo_tpu.analysis import all_rules
 
     soak = {r.id for r in all_rules() if r.severity == "warn"}
-    assert soak == {"HL205"}
+    assert soak == set()
 
 
 # -- suppression audit (ISSUE 14) ---------------------------------------
