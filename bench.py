@@ -80,6 +80,8 @@ STAGE_TIMEOUT = {
     "tropical_spf": 1500,
     "partitioned_spf": 1500,
     "bgp_table": 1500,
+    "critical_path": 1800,
+    "critpath_overhead": 900,
 }
 
 
@@ -835,6 +837,139 @@ def stage_convergence_overhead(k, B, reps=15):
                 backend.compute_whatif(topo, masks)
                 times.append(time.perf_counter() - t0)
     convergence.configure(0)
+    on_ms = float(np.min(on_times) * 1e3)
+    off_ms = float(np.min(off_times) * 1e3)
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
+    return {
+        "ok": bool(overhead_pct < 2.0),
+        "enabled_ms": round(on_ms, 3),
+        "disabled_ms": round(off_ms, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "batch": int(B),
+        "reps": reps,
+    }
+
+
+def stage_critical_path(n_routers, events):
+    """ISSUE 17 acceptance row: the critical-path ledger over the
+    seeded storm.  Reports the per-phase trigger→FIB split (p50/p99 ms
+    in cut order), the bound-verdict tally, and the two headline
+    scalars — ``host_fraction_p99`` (the fraction of the summed-phase
+    p99 owned by host choreography: ROADMAP item 5's before-number)
+    and ``unattributed_frac_p50`` (the gap-free gate: the residual no
+    stamp explains must stay <1% of the wall at p50).  A chaos arm
+    re-runs a small same-seed storm with ``FaultPlan.dispatch_delay``
+    injected and gates on the delay landing in the DEVICE phase
+    (wrong-phase attribution fails the row) while the causal digest
+    stays byte-identical (real sleeps are invisible to the virtual
+    clock).  The device-residency snapshot rides along."""
+    from holo_tpu.resilience import faults
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+    from holo_tpu.telemetry import critpath, residency
+
+    t0 = time.perf_counter()
+    cp = critpath.configure(check_every=64)
+    try:
+        report, digest, _net = run_convergence_storm(
+            n_routers=n_routers, events=events, seed=17,
+            spf_backend=TpuSpfBackend(),
+        )
+        cp.checkpoint()
+        clean = cp.report(top=0)
+        phases_ms = {
+            r["phase"]: {
+                "p50_ms": round(r["p50"] * 1e3, 3),
+                "p99_ms": round(r["p99"] * 1e3, 3),
+                "share_p99": r["share_p99"],
+            }
+            for r in clean["phases"]
+        }
+        # Chaos arm: small same-seed storm, clean vs injected 5 ms
+        # device-dispatch delay — the delta must book to `device`.
+        chaos_n, chaos_ev, delay = min(n_routers, 120), min(events, 48), 0.005
+
+        def chaos_run(plan):
+            c = critpath.configure(check_every=0)
+            with faults.inject(plan) as inj:
+                _r, dg, _n = run_convergence_storm(
+                    n_routers=chaos_n, events=chaos_ev, seed=17,
+                    spf_backend=TpuSpfBackend(),
+                )
+            q = c.phase_quantiles()
+            dev = q.get("device", {"p50": 0.0})["p50"]
+            return dev, dg, dict(inj.injected)
+
+        dev_clean, dg_clean, _ = chaos_run(faults.FaultPlan())
+        dev_chaos, dg_chaos, injected = chaos_run(
+            faults.FaultPlan(dispatch_delay={"spf.dispatch": delay})
+        )
+        chaos_attributed = bool(
+            injected.get("delay:spf.dispatch", 0) > 0
+            and dev_chaos >= dev_clean + 0.5 * delay
+        )
+        uf = clean["unattributed-frac-p50"]
+        hf = clean["host-fraction-p99"]
+        out = {
+            "ok": bool(
+                clean["completed"] > 0
+                and uf is not None
+                and uf < 0.01
+                and chaos_attributed
+                and dg_clean == dg_chaos
+            ),
+            "completed": clean["completed"],
+            "dropped": clean["dropped"],
+            "verdicts": clean["verdicts"],
+            "phases": phases_ms,
+            "wall_p50_ms": round((clean["wall"] or {}).get("p50", 0.0) * 1e3, 3),
+            "wall_p99_ms": round((clean["wall"] or {}).get("p99", 0.0) * 1e3, 3),
+            "host_fraction_p99": hf,
+            "unattributed_frac_p50": uf,
+            "chaos": {
+                "device_p50_clean_ms": round(dev_clean * 1e3, 3),
+                "device_p50_injected_ms": round(dev_chaos * 1e3, 3),
+                "injected_delay_ms": delay * 1e3,
+                "attributed_to_device": chaos_attributed,
+                "digest_identical": dg_clean == dg_chaos,
+            },
+            "residency": residency.snapshot(),
+            "digest": digest[:16],
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        # Ledger scalars: per-phase p99 flattened to top-level keys so
+        # the regression ledger (ISSUE 11 satellite) tracks each phase.
+        for ph, row in phases_ms.items():
+            out[f"critpath_{ph}_p99_ms"] = row["p99_ms"]
+        return out
+    finally:
+        critpath.configure(0)
+
+
+def stage_critpath_overhead(k, B, reps=15):
+    """ISSUE 17 overhead gate: the SPF dispatch path with convergence
+    armed AND an open causal event active in BOTH arms (the ledger's
+    stamps only fire inside events — that is the worst case being
+    measured), critical-path ledger armed vs disarmed.  Same
+    interleaved min-of-N discipline as the other gates; ok <2%."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.telemetry import convergence, critpath
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    backend.compute_whatif(topo, masks)  # warm: compile + graph cache
+    on_times, off_times = [], []
+    for rep in range(reps):
+        arms = ((True, on_times), (False, off_times))
+        for armed, times in arms if rep % 2 == 0 else arms[::-1]:
+            critpath.configure(4096 if armed else 0, check_every=0)
+            convergence.configure(4096)
+            with convergence.activation(convergence.begin("lsa")):
+                t0 = time.perf_counter()
+                backend.compute_whatif(topo, masks)
+                times.append(time.perf_counter() - t0)
+            convergence.configure(0)
+    critpath.configure(0)
     on_ms = float(np.min(on_times) * 1e3)
     off_ms = float(np.min(off_times) * 1e3)
     overhead_pct = (on_ms - off_ms) / off_ms * 100.0 if off_ms else 0.0
@@ -2998,6 +3133,18 @@ _LEDGER_KEYS = (
     # UPDATE-burst scatter+recompute p99.
     ("bgp_prefixes_per_sec", True),
     ("bgp_update_p99_ms", False),
+    # ISSUE 17: the critical-path ledger's per-phase p99 split plus
+    # the host-choreography headline — the before-numbers ROADMAP
+    # item 5's streaming-convergence refactor must drive down.
+    ("critpath_wake_p99_ms", False),
+    ("critpath_coalesce_wait_p99_ms", False),
+    ("critpath_queue_wait_p99_ms", False),
+    ("critpath_marshal_p99_ms", False),
+    ("critpath_device_p99_ms", False),
+    ("critpath_force_wait_p99_ms", False),
+    ("critpath_rib_p99_ms", False),
+    ("critpath_fib_commit_p99_ms", False),
+    ("host_fraction_p99", False),
 )
 
 
@@ -3209,6 +3356,14 @@ def main() -> None:
             ),
             "partitioned_spf": lambda: stage_partitioned_spf(small),
             "bgp_table": lambda: stage_bgp_table(small),
+            "critical_path": lambda: (
+                stage_critical_path(400, 120)
+                if small
+                else stage_critical_path(2500, 400)
+            ),
+            "critpath_overhead": lambda: stage_critpath_overhead(
+                k10, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -3355,6 +3510,16 @@ def main() -> None:
         extra["bgp_table_jaxcpu_small"] = _run_stage(
             "bgp_table", True, cpu=True
         )
+        # Critical-path ledger (ISSUE 17): the storm + its phase
+        # attribution run on the virtual clock + JAX-CPU by design, and
+        # the overhead gate is host-side machinery — both keep full
+        # fidelity while the relay is down.
+        extra["critical_path_jaxcpu_small"] = _run_stage(
+            "critical_path", True, cpu=True
+        )
+        extra["critpath_overhead_jaxcpu_small"] = _run_stage(
+            "critpath_overhead", True, cpu=True
+        )
         # Device-trace carry-over: relay down means no TPU to trace —
         # the row says so explicitly instead of probing a wedged relay.
         extra["device_trace"] = {
@@ -3490,6 +3655,12 @@ def main() -> None:
     # throughput + UPDATE-burst p99, gated on Loc-RIB parity between
     # the device backend and the scalar decision process.
     extra["bgp_table"] = _run_stage("bgp_table", small)
+    # Critical-path ledger (ISSUE 17): per-phase trigger→FIB waterfall
+    # split over the seeded storm (chaos-verified attribution, the
+    # <1% unattributed-residual gate, residency rows) + the <2%
+    # armed-ledger overhead gate.
+    extra["critical_path"] = _run_stage("critical_path", small)
+    extra["critpath_overhead"] = _run_stage("critpath_overhead", small)
     # Device-trace carry-over: a real jax.profiler capture when the
     # attached platform is an actual TPU; explicit not-used row else.
     extra["device_trace"] = _run_stage("device_trace", small)

@@ -91,6 +91,9 @@ DISPATCH_PREFIXES = (
     # instruments (it must never touch a device value or reduce an
     # array on the traced path).
     "holo_tpu/telemetry/observatory.py",
+    # The critical-path ledger's stamp methods run on the dispatch
+    # worker and the force seam (ISSUE 17): same hot-path rules.
+    "holo_tpu/telemetry/critpath.py",
 )
 CONCURRENCY_PREFIXES = (
     "holo_tpu/daemon",

@@ -53,7 +53,7 @@ from contextlib import nullcontext
 
 from holo_tpu import telemetry
 from holo_tpu.analysis.runtime import consumes_donated
-from holo_tpu.telemetry import convergence
+from holo_tpu.telemetry import convergence, critpath
 
 log = logging.getLogger("holo_tpu.pipeline")
 
@@ -99,7 +99,7 @@ class PipelineTicket:
 
     __slots__ = (
         "key", "kind", "generation", "_event", "_value", "_exc",
-        "skipped", "superseded", "_pipeline", "_cbs", "_cb_lock",
+        "skipped", "superseded", "_pipeline", "_cbs", "_cb_lock", "eids",
     )
 
     def __init__(self, pipeline, key, kind: str, generation: int):
@@ -114,6 +114,9 @@ class PipelineTicket:
         self.superseded = False  # coalesced away by a newer generation
         self._cbs: list = []
         self._cb_lock = threading.Lock()
+        # Causal convergence ids captured at submit (the critical-path
+        # ledger's cross-thread join key for the force-wait stamps).
+        self.eids: tuple = ()
 
     def add_done_callback(self, fn) -> None:
         """Run ``fn(ticket)`` at completion (immediately when already
@@ -149,14 +152,26 @@ class PipelineTicket:
         the caller's thread (same contract as the synchronous dispatch).
         Skipped/superseded tickets return None."""
         if not self._event.is_set():
+            critpath.note_force(self.eids, "b")
             t0 = time.perf_counter()
             if not self._event.wait(timeout):
                 raise TimeoutError(
                     f"pipeline result for {self.key}/{self.kind} not ready"
                 )
-            _WAIT_SECONDS.labels(kind=self.kind).observe(
-                time.perf_counter() - t0
+            # Span exemplar (ISSUE 17 satellite): a p99 force-wait is
+            # joinable back to its flight-recorder timeline exactly like
+            # holo_profile_stage_seconds buckets — the caller's active
+            # span when one exists, the causal event id otherwise.
+            sid = telemetry.current_span_id()
+            exemplar = (
+                {"span_id": sid}
+                if sid is not None
+                else ({"event_id": self.eids[0]} if self.eids else None)
             )
+            _WAIT_SECONDS.labels(kind=self.kind).observe(
+                time.perf_counter() - t0, exemplar=exemplar
+            )
+            critpath.note_force(self.eids, "e")
         if self._exc is not None:
             raise self._exc
         return self._value
@@ -186,7 +201,7 @@ class _Item:
 
     __slots__ = (
         "key", "kind", "generation", "ticket", "run", "launch", "finish",
-        "coalesce", "eids", "handle", "t_launch_end",
+        "coalesce", "eids", "handle", "t_launch_end", "stalled",
     )
 
     def __init__(
@@ -204,6 +219,9 @@ class _Item:
         self.eids = tuple(eids)
         self.handle = None
         self.t_launch_end = 0.0
+        # Per-key ordering-stall latch: stamped into the critical-path
+        # waterfall on the FIRST skip only (worker rescans are routine).
+        self.stalled = False
 
 
 class DispatchPipeline:
@@ -287,6 +305,7 @@ class DispatchPipeline:
             ticket, run=run, launch=launch, finish=finish,
             coalesce=coalesce, eids=convergence.current(),
         )
+        ticket.eids = item.eids
         with self._cv:
             if self._closed:
                 raise PipelineClosed(self.name)
@@ -299,7 +318,16 @@ class DispatchPipeline:
                     ):
                         continue
                     if old.generation == item.generation:
-                        # Identical work already queued: share it.
+                        # Identical work already queued: share it — the
+                        # new submit's causal events ride the queued
+                        # item from here on (their queue-wait started
+                        # now, at THIS admission).
+                        if item.eids:
+                            old.eids = tuple(
+                                dict.fromkeys(old.eids + item.eids)
+                            )
+                            old.ticket.eids = old.eids
+                            critpath.note_enqueue(item.eids)
                         self._coalesced += 1
                         _COALESCED.labels(reason="shared").inc()
                         return old.ticket
@@ -317,6 +345,7 @@ class DispatchPipeline:
             self._submitted += 1
             self._ensure_worker_locked()
             self._cv.notify_all()
+        critpath.note_enqueue(item.eids)
         return ticket
 
     def _ensure_worker_locked(self) -> None:
@@ -329,19 +358,26 @@ class DispatchPipeline:
 
     # -- worker side ----------------------------------------------------
 
-    def _next_launchable_locked(self) -> _Item | None:
+    def _next_launchable_locked(self, stalled: list) -> _Item | None:
         """Oldest queued item whose key is not in flight (per-key
-        ownership handoff: never two launches for one key)."""
+        ownership handoff: never two launches for one key).  Items
+        skipped because their key IS in flight are collected into
+        ``stalled`` on their first skip only (``_Item.stalled`` latch)
+        — the per-key ordering-stall stamp of the critical-path ledger."""
         for item in self._queue:
             if item.key not in self._inflight_keys:
                 self._queue.remove(item)
                 return item
+            if not item.stalled:
+                item.stalled = True
+                stalled.append(item)
         return None
 
     def _worker(self) -> None:
         while True:
             launch_item = None
             finish_item = None
+            stalled: list = []
             with self._cv:
                 if (
                     self._closed
@@ -351,7 +387,7 @@ class DispatchPipeline:
                     self._cv.notify_all()
                     return
                 launch_item = (
-                    self._next_launchable_locked()
+                    self._next_launchable_locked(stalled)
                     if len(self._inflight) < self.depth
                     else None
                 )
@@ -364,6 +400,10 @@ class DispatchPipeline:
                         continue
                 else:
                     self._working += 1
+            # Stall stamps run OUTSIDE the cv lock (ISSUE 17 contract:
+            # no new work under the queue lock on the dispatch thread).
+            for it in stalled:
+                critpath.note_stall(it.eids)
             if launch_item is not None:
                 self._do_launch(launch_item)
                 continue
@@ -374,12 +414,14 @@ class DispatchPipeline:
         return g, convergence.activation(item.eids)
 
     def _do_launch(self, item: _Item) -> None:
+        critpath.note_launch(item.eids, "b")
         t0 = time.perf_counter()
         try:
             guard, act = self._ctx(item)
             with guard, act:
                 if item.run is not None:
                     item.ticket._complete(item.run())
+                    critpath.note_finish(item.eids, "e")
                     self._finalize(item, finished=True)
                     return
                 item.handle = item.launch()
@@ -390,6 +432,7 @@ class DispatchPipeline:
             return
         finally:
             self._launch_seconds += time.perf_counter() - t0
+        critpath.note_launch(item.eids, "e")
         item.t_launch_end = time.perf_counter()
         with self._cv:
             self._inflight.append(item)
@@ -404,6 +447,7 @@ class DispatchPipeline:
             self._cv.notify_all()
 
     def _do_finish(self, item: _Item) -> None:
+        critpath.note_finish(item.eids, "b")
         t_fs = time.perf_counter()
         # Device time that elapsed while the worker was busy elsewhere
         # (launching the next entry / idle-waiting): the overlap the
@@ -420,6 +464,7 @@ class DispatchPipeline:
             # the handoff actually ran under the async path.
             with guard, act, consumes_donated("pipeline.key.handoff"):
                 item.ticket._complete(item.finish(item.handle))
+            critpath.note_finish(item.eids, "e")
         except BaseException as exc:  # noqa: BLE001 — see _do_launch
             item.ticket._fail(exc)
         finally:

@@ -377,7 +377,12 @@ class RibManager(Actor):
         if flipped:
             _RIB_FLIPS.inc(flipped)
             # The backup flip IS the FIB moment for a BFD/carrier event:
-            # the causal context rode in on the IbusMsg envelope.
+            # the causal context rode in on the IbusMsg envelope.  The
+            # rib phase is observed at the same moment (ISSUE 17): a
+            # repair event then decomposes into rib (the O(1) flip
+            # computation, begin→here) vs fib_commit in the
+            # critical-path ledger instead of one undifferentiated lump.
+            convergence.observe(convergence.PHASE_RIB, op="repair")
             convergence.fib_commit(op="repair", flips=flipped)
         return flipped
 
@@ -413,6 +418,9 @@ class RibManager(Actor):
             restored += 1
         if restored:
             _RIB_RESTORES.inc(restored)
+            # Same split as local_repair: rib = the restore scan,
+            # fib_commit = the closing reinstall moment.
+            convergence.observe(convergence.PHASE_RIB, op="restore")
             convergence.fib_commit(op="restore", restores=restored)
         return restored
 

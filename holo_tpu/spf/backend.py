@@ -429,6 +429,12 @@ class TpuSpfBackend(SpfBackend):
         # Monotonic, never reused (id(self) can be recycled after GC,
         # letting a new backend adopt a dead backend's residents).
         self._part_ns = f"part:{next(_PART_NS_IDS)}"
+        # Device-residency byte ledger (ISSUE 17 satellite): weakref
+        # registration only — the ledger walks _prev_one lazily at
+        # scrape time, and a dropped backend never leaks through it.
+        from holo_tpu.telemetry import residency
+
+        residency.register_spf_backend(self)
 
     def _jit_one_for(self, engine: str):
         fn = self._one_jits.get(engine)

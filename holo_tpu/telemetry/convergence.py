@@ -78,6 +78,23 @@ _CONV_EVENTS = telemetry.counter(
 #: per-event timeline entries kept before the tail is dropped
 TIMELINE_LIMIT = 64
 
+# Critical-path ledger hook (ISSUE 17): while armed, event lifecycle
+# moments (begin / spf-scheduled / phase observed / finish) are ALSO
+# stamped into holo_tpu.telemetry.critpath's cross-thread waterfall.
+# One module global, installed only by critpath.configure — the
+# disarmed cost at every seam is exactly this None check.  The hook
+# keeps its OWN clock (profiling.clock): the tracker's clock may be a
+# storm's virtual loop clock, under which host compute is invisible.
+_CP_HOOK = None
+
+
+def set_critpath_hook(ledger) -> None:
+    """Install/remove the critical-path ledger
+    (:func:`holo_tpu.telemetry.critpath.configure` is the only
+    caller); ``None`` disarms."""
+    global _CP_HOOK
+    _CP_HOOK = ledger
+
 
 class _Event:
     """One open causal event (mutated only under the tracker lock)."""
@@ -159,6 +176,9 @@ class ConvergenceTracker:
             self._open[eid] = ev
             if len(self._open) > self.capacity:
                 _, evicted = self._open.popitem(last=False)
+        cp = _CP_HOOK
+        if cp is not None:
+            cp.ev_begin(eid, str(trigger))
         if evicted is not None:
             self._finish(evicted, "evicted")
         _CONV_EVENTS.labels(trigger=trigger, outcome="begun").inc()
@@ -221,6 +241,9 @@ class ConvergenceTracker:
                     fresh = True
             if not fresh:
                 continue
+            cp = _CP_HOOK
+            if cp is not None:
+                cp.ev_phase(ev.eid, phase)
             exemplar = (
                 {"span_id": sid} if sid is not None else {"event_id": ev.eid}
             )
@@ -271,6 +294,9 @@ class ConvergenceTracker:
             }
             self._done.append(record)
             self._completed += 1
+        cp = _CP_HOOK
+        if cp is not None:
+            cp.ev_done(ev.eid, outcome, ev.fallback)
         _CONV_EVENTS.labels(trigger=ev.trigger, outcome=outcome).inc()
         # Ring entry outside our lock (the flight recorder locks its
         # own ring); disarmed flight makes this a no-op.
@@ -429,6 +455,10 @@ def pend_schedule(pending: list, default_trigger: str, instance: str = "") -> No
         if e not in pending and len(pending) < PENDING_LIMIT:
             pending.append(e)
     t.mark("spf-scheduled", eids=eids, instance=instance)
+    cp = _CP_HOOK
+    if cp is not None:
+        for e in eids:
+            cp.ev_sched(e)
 
 
 @contextmanager

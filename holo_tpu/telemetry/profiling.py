@@ -75,6 +75,13 @@ _enabled = False
 # global: the disarmed hot-path cost is exactly this None check.
 _OBSERVER = None
 
+# Critical-path feed (ISSUE 17): when armed, every stage's begin AND
+# end edge is handed to the phase hook — the cross-thread waterfall in
+# holo_tpu.telemetry.critpath stamps the active convergence events
+# with marshal/device cuts.  Same discipline as _OBSERVER: one module
+# global, the disarmed hot-path cost is exactly this None check.
+_PHASE_HOOK = None
+
 # Stage timer: time.perf_counter in production; the observatory's
 # DeterministicTimer swaps it so a seeded workload produces
 # byte-identical sketches (set_stage_timer).
@@ -120,6 +127,17 @@ def set_observer(fn) -> None:
 def observing() -> bool:
     """True while a stage observer (the observatory) is armed."""
     return _OBSERVER is not None
+
+
+def set_phase_hook(fn) -> None:
+    """Install/remove the critical-path stage-edge hook (ISSUE 17;
+    :func:`holo_tpu.telemetry.critpath.configure` is the only caller).
+    ``fn(site, stage, device, edge)`` runs at every stage begin
+    (``edge='b'``) and clean-exit end (``edge='e'``) — the hook reads
+    :func:`clock` itself, so a DeterministicTimer makes its stamps
+    byte-identical too; ``None`` disarms."""
+    global _PHASE_HOOK
+    _PHASE_HOOK = fn
 
 
 def set_stage_timer(fn) -> None:
@@ -186,13 +204,18 @@ def stage(site: str, name: str, device: str = "-"):
     without the histogram/exemplar machinery; observations keep the
     existing contract of recording only on clean exit."""
     obs = _OBSERVER
+    ph = _PHASE_HOOK
+    if ph is not None:
+        _phase_guarded(ph, site, name, device, "b")
     if not _enabled:
         if obs is None:
             yield None
-            return
-        t0 = _timer()
-        yield None
-        _observe_guarded(obs, site, name, device, _timer() - t0)
+        else:
+            t0 = _timer()
+            yield None
+            _observe_guarded(obs, site, name, device, _timer() - t0)
+        if ph is not None:
+            _phase_guarded(ph, site, name, device, "e")
         return
     t0 = _timer()
     with telemetry.span(f"{site}.{name}", stage=name, device=device) as sid:
@@ -203,6 +226,8 @@ def stage(site: str, name: str, device: str = "-"):
     )
     if obs is not None:
         _observe_guarded(obs, site, name, device, dt)
+    if ph is not None:
+        _phase_guarded(ph, site, name, device, "e")
 
 
 def _observe_guarded(obs, site, name, device, dt) -> None:
@@ -214,6 +239,15 @@ def _observe_guarded(obs, site, name, device, dt) -> None:
         obs(site, name, device, dt)
     except Exception:  # noqa: BLE001 — see contract above
         log.debug("stage observer failed", exc_info=True)
+
+
+def _phase_guarded(ph, site, name, device, edge) -> None:
+    """Same warn-only contract as :func:`_observe_guarded`: a
+    critical-path hook bug must never fail the dispatch it stamps."""
+    try:
+        ph(site, name, device, edge)
+    except Exception:  # noqa: BLE001 — see contract above
+        log.debug("stage phase hook failed", exc_info=True)
 
 
 def device_stages(site: str, tree) -> bool:

@@ -156,6 +156,23 @@ class TelemetryStateProvider(NbProvider):
             ob = obsm.active()
             if ob is not None:
                 out["observatory"] = ob.stats()
+        # Critical-path ledger (ISSUE 17): per-phase trigger→FIB
+        # quantiles, bound-verdict tally, host-fraction — while armed.
+        cpm = sys.modules.get("holo_tpu.telemetry.critpath")
+        if cpm is not None:
+            cp = cpm.active()
+            if cp is not None:
+                out["critical-path"] = cp.stats()
+        # Device-residency byte ledger (ISSUE 17 satellite): per-plane
+        # resident bytes — present once any device subsystem loaded
+        # (the module itself stays lazy like the leaves it sums).
+        resm = sys.modules.get("holo_tpu.telemetry.residency")
+        if resm is not None:
+            rs = resm.snapshot()
+            if rs.get("total-bytes") or any(
+                r["entries"] for r in rs["planes"].values()
+            ):
+                out["device-residency"] = rs
         # TPU relay watch (ISSUE 12 satellite): probe verdicts become
         # queryable state instead of a log file nobody reads in-process.
         relm = sys.modules.get("holo_tpu.telemetry.relay")

@@ -283,7 +283,7 @@ def cmd_explain(args) -> int:
     real; pass ``--wall-clock`` for honest walls at the price of
     run-to-run jitter)."""
     from holo_tpu.pipeline import tuner as tuner_mod
-    from holo_tpu.telemetry import observatory, profiling
+    from holo_tpu.telemetry import critpath, observatory, profiling
 
     if not args.wall_clock:
         profiling.set_stage_timer(observatory.DeterministicTimer())
@@ -292,6 +292,10 @@ def cmd_explain(args) -> int:
         check_every=16,
         ledger_path=args.ledger,
     )
+    # Critical-path ledger (ISSUE 17): stamps read the same stage
+    # timer as the observatory, so the waterfall section inherits the
+    # byte-identical contract under the deterministic counter clock.
+    cp = critpath.configure(check_every=16) if args.critical_path else None
     tuner = tuner_mod.configure_engine_tuner()
     try:
         if args.storm:
@@ -308,6 +312,9 @@ def cmd_explain(args) -> int:
         obs.checkpoint()
         doc = obs.report(top=args.top)
         doc["tuner"] = tuner.ledger()
+        if cp is not None:
+            cp.checkpoint()
+            doc["critical_path"] = cp.report(top=args.top)
         if args.json:
             print(json.dumps(doc, sort_keys=True, indent=2))
             return 0
@@ -399,9 +406,59 @@ def cmd_explain(args) -> int:
             + (f", regressed: {', '.join(s['regressed'])}"
                if s["regressed"] else "")
         )
+        if cp is not None:
+            cpd = doc["critical_path"]
+            v = cpd["verdicts"]
+            hf = cpd["host-fraction-p99"]
+            uf = cpd["unattributed-frac-p50"]
+            print(
+                f"critical path — {cpd['completed']} events "
+                f"({cpd['dropped']} dropped), verdicts: "
+                f"host={v['host']} queue={v['queue']} "
+                f"device={v['device']}, host-fraction-p99: "
+                + (f"{hf:.2%}" if hf is not None else "-")
+                + ", unattributed-frac-p50: "
+                + (f"{uf:.2%}" if uf is not None else "-")
+            )
+            print("phase ledger (cut order):")
+            _print_table(
+                ("phase", "p50_ms", "p99_ms", "mean_ms", "share_p99"),
+                [
+                    (
+                        r["phase"], f"{r['p50'] * 1e3:.3f}",
+                        f"{r['p99'] * 1e3:.3f}",
+                        f"{r['mean'] * 1e3:.3f}",
+                        f"{r['share_p99']:.2%}",
+                    )
+                    for r in cpd["phases"]
+                ],
+            )
+            print(f"last {len(cpd['events'])} waterfalls:")
+            _print_table(
+                ("n", "trigger", "verdict", "wall_ms", "top phases",
+                 "stalls"),
+                [
+                    (
+                        w["n"], w["trigger"], w["verdict"],
+                        f"{w['wall'] * 1e3:.3f}",
+                        " ".join(
+                            f"{p}={w['phases'][p] * 1e3:.3f}ms"
+                            for p, _ in sorted(
+                                w["phases"].items(),
+                                key=lambda kv: (-kv[1], kv[0]),
+                            )[:3]
+                            if w["phases"][p] > 0.0
+                        ) or "-",
+                        w["stalls"],
+                    )
+                    for w in cpd["events"]
+                ],
+            )
         return 0
     finally:
         observatory.configure(enabled=False)
+        if cp is not None:
+            critpath.configure(0)
         profiling.set_device_profiling(False)
         profiling.set_stage_timer(None)
         tuner_mod.reset_engine_tuner()
@@ -821,6 +878,11 @@ def main(argv=None) -> int:
         "--wall-clock", action="store_true",
         help="measure real walls instead of the deterministic "
              "byte-identical counter clock",
+    )
+    s.add_argument(
+        "--critical-path", action="store_true",
+        help="arm the critical-path ledger and append the per-phase "
+             "trigger→FIB waterfall section (meaningful with --storm)",
     )
     s.add_argument("--json", action="store_true", help="JSON report")
     s.set_defaults(fn=cmd_explain)
