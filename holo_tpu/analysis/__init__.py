@@ -37,7 +37,13 @@ Entry points:
   golden-fixture tests);
 - :mod:`holo_tpu.analysis.runtime` — the runtime sanitizer mode
   (``jax.transfer_guard``) that catches transfers static analysis
-  cannot prove.
+  cannot prove;
+- :mod:`holo_tpu.analysis.jaxpr_audit` — the HL3xx jaxpr-level kernel
+  audit: every jit seam self-registers in
+  :mod:`holo_tpu.analysis.kernels` (inert outside audit mode) and the
+  audit abstractly lowers it on CPU to prove donation, host-transfer,
+  dtype, compile-signature, and sharding-fence contracts on the
+  compiled IR, behind a per-kernel fingerprint cache.
 
 Findings are suppressed inline with ``# holo-lint: disable=<id>`` (same
 line or the line above) and ratcheted through a checked-in baseline
@@ -49,8 +55,10 @@ baseline entries are fixed and removed.
 from __future__ import annotations
 
 from holo_tpu.analysis.cache import (  # noqa: F401 — public API
+    default_audit_cache_path,
     default_cache_path,
     ruleset_fingerprint,
+    run_audit_cached,
     run_paths_cached,
     self_check,
 )

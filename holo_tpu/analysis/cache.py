@@ -243,11 +243,214 @@ def run_paths_cached(
     return result
 
 
+# -- the jaxpr-audit cache arm ------------------------------------------
+#
+# Same philosophy, different granularity.  The audit's expensive unit is
+# one kernel lowering, and (unlike the AST rules) kernels are independent
+# of each other: a kernel's findings depend only on its registering
+# module's bytes, the audit machinery itself, and its canonical spec
+# tuple.  So the audit cache is per-kernel — fingerprint = sha256 of
+# (registering module bytes, audit infra bytes, spec signature) — with a
+# fully-warm fast path that validates every recorded file and replays the
+# stored result WITHOUT importing jax at all, keeping the warm gate near
+# the AST-only wall time.
+
+AUDIT_CACHE_VERSION = 1
+
+
+def default_audit_cache_path(root: Path) -> Path:
+    return root / ".holo_audit_cache.json"
+
+
+def _audit_infra_paths() -> list[Path]:
+    """The audit machinery whose bytes feed every kernel fingerprint."""
+    pkg = Path(__file__).resolve().parent
+    return [pkg / "kernels.py", pkg / "jaxpr_audit.py", pkg / "rules_jaxpr.py"]
+
+
+def _audit_result_doc(result) -> dict:
+    return {
+        "findings": [_finding_doc(f) for f in result.findings],
+        "suppressed": [_finding_doc(f) for f in result.suppressed],
+        "kernel_seconds": dict(result.kernel_seconds),
+        "kernels_checked": result.kernels_checked,
+        "skipped": list(result.skipped),
+        "device_count": result.device_count,
+    }
+
+
+def _audit_result_from(d: dict):
+    from holo_tpu.analysis.jaxpr_audit import AuditResult
+
+    result = AuditResult(
+        findings=[_finding_from(x) for x in d["findings"]],
+        suppressed=[_finding_from(x) for x in d["suppressed"]],
+        kernel_seconds=dict(d.get("kernel_seconds", {})),
+        kernels_checked=int(d.get("kernels_checked", 0)),
+        skipped=list(d.get("skipped", [])),
+        device_count=int(d.get("device_count", 0)),
+    )
+    result.kernels_cached = result.kernels_checked
+    return result
+
+
+def _load_audit_doc(path: Path) -> dict | None:
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != AUDIT_CACHE_VERSION:
+        return None
+    return doc
+
+
+def _validate_file_entries(entries: dict, root: Path) -> bool:
+    """mtime/size -> sha256 ladder over a recorded file map (no refresh)."""
+    for rel, ent in entries.items():
+        p = root / rel
+        try:
+            st = p.stat()
+        except OSError:
+            return False
+        if st.st_mtime_ns == ent["mtime_ns"] and st.st_size == ent["size"]:
+            continue
+        try:
+            if _sha256(p.read_bytes()) == ent["sha256"]:
+                continue
+        except OSError:
+            return False
+        return False
+    return True
+
+
+def _file_entry(p: Path) -> dict | None:
+    try:
+        st = p.stat()
+        data = p.read_bytes()
+    except OSError:
+        return None
+    return {
+        "mtime_ns": st.st_mtime_ns,
+        "size": st.st_size,
+        "sha256": _sha256(data),
+    }
+
+
+def run_audit_cached(root: Path, cache_path: Path | None = None,
+                     no_cache: bool = False):
+    """The jaxpr audit behind the per-kernel cache.
+
+    Fully-warm path: every file the last armed run depended on (seam
+    modules + audit infra) validates byte-for-byte -> replay the stored
+    :class:`~holo_tpu.analysis.jaxpr_audit.AuditResult` without importing
+    jax.  Otherwise arm the audit, reuse the kernels whose individual
+    fingerprints still match, re-lower the rest, and rewrite the cache.
+    ``no_cache=True`` bypasses both read and write (full re-lowering).
+    """
+    root = Path(root)
+    cache_path = cache_path or default_audit_cache_path(root)
+    doc = None if no_cache else _load_audit_doc(cache_path)
+
+    if (
+        doc is not None
+        and doc.get("files")
+        and _validate_file_entries(doc["files"], root)
+    ):
+        return _audit_result_from(doc["result"])
+
+    from holo_tpu.analysis import jaxpr_audit
+
+    entries = jaxpr_audit.load_registry()
+
+    infra = hashlib.sha256()
+    files: dict[str, dict] = {}
+    for p in _audit_infra_paths():
+        ent = _file_entry(p)
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:  # audit run against a root outside the repo
+            rel = p.name
+        if ent is not None:
+            files[rel] = ent
+            infra.update(ent["sha256"].encode())
+    infra_hash = infra.hexdigest()
+
+    module_hash: dict[str, str] = {}
+    for entry in entries.values():
+        if entry.module in module_hash:
+            continue
+        ent = _file_entry(root / entry.module)
+        if ent is None:
+            module_hash[entry.module] = ""
+            continue
+        files[entry.module] = ent
+        module_hash[entry.module] = ent["sha256"]
+
+    fingerprints: dict[str, str] = {}
+    for name, entry in entries.items():
+        fingerprints[name] = _sha256(
+            (
+                module_hash.get(entry.module, "")
+                + infra_hash
+                + jaxpr_audit.spec_signature(entry)
+            ).encode()
+        )
+
+    reuse: dict[str, dict] = {}
+    if doc is not None:
+        stored = doc.get("kernels", {})
+        same_devices = True
+        try:
+            import jax
+
+            same_devices = doc.get("result", {}).get("device_count") == len(
+                jax.devices()
+            )
+        except Exception:  # pragma: no cover
+            same_devices = False
+        if same_devices:
+            for name, row in stored.items():
+                if (
+                    name in fingerprints
+                    and row.get("fingerprint") == fingerprints[name]
+                ):
+                    reuse[name] = {
+                        "findings": [
+                            _finding_from(x) for x in row.get("raw", [])
+                        ],
+                        "seconds": row.get("seconds", 0.0),
+                    }
+
+    result = jaxpr_audit.run_audit(str(root), reuse=reuse)
+
+    if not no_cache:
+        kernels_doc = {
+            name: {
+                "fingerprint": fingerprints.get(name, ""),
+                "raw": [_finding_doc(f) for f in rows],
+                "seconds": result.kernel_seconds.get(name, 0.0),
+            }
+            for name, rows in result.kernel_findings.items()
+        }
+        _save(
+            cache_path,
+            {
+                "version": AUDIT_CACHE_VERSION,
+                "files": files,
+                "kernels": kernels_doc,
+                "result": _audit_result_doc(result),
+            },
+        )
+    return result
+
+
 def self_check(
     paths: list[Path],
     root: Path,
     config: LintConfig | None = None,
     cache_path: Path | None = None,
+    audit: bool = False,
+    audit_cache_path: Path | None = None,
 ) -> list[str]:
     """Prove the cache replays exactly what a real scan produces.
 
@@ -270,15 +473,46 @@ def self_check(
         return lines
 
     a, b = view(cached), view(cold)
-    if a == b:
-        return []
-    out = []
-    for line in b:
-        if line not in a:
-            out.append(f"cold scan only: {line}")
-    for line in a:
-        if line not in b:
-            out.append(f"cached replay only: {line}")
-    if not out:
-        out.append("finding order diverged between cached and cold runs")
+    out: list[str] = []
+    if a != b:
+        for line in b:
+            if line not in a:
+                out.append(f"cold scan only: {line}")
+        for line in a:
+            if line not in b:
+                out.append(f"cached replay only: {line}")
+        if not out:
+            out.append(
+                "finding order diverged between cached and cold runs"
+            )
+
+    if audit:
+        # Audit arm: the cached audit must replay exactly what a full
+        # re-lowering produces (same findings, same suppressed set).
+        from holo_tpu.analysis.jaxpr_audit import run_audit
+
+        warm = run_audit_cached(root, cache_path=audit_cache_path)
+        fresh = run_audit(str(root))
+
+        def audit_view(result) -> list[str]:
+            lines = [f.render() for f in result.findings]
+            lines += [
+                f"suppressed: {f.render()}" for f in result.suppressed
+            ]
+            lines += [f"skipped: {name}" for name in sorted(result.skipped)]
+            return lines
+
+        c, d = audit_view(warm), audit_view(fresh)
+        if c != d:
+            for line in d:
+                if line not in c:
+                    out.append(f"audit cold only: {line}")
+            for line in c:
+                if line not in d:
+                    out.append(f"audit cached replay only: {line}")
+            if not out:
+                out.append(
+                    "audit finding order diverged between cached and "
+                    "cold runs"
+                )
     return out

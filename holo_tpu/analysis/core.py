@@ -288,6 +288,7 @@ def all_rules() -> list[Rule]:
     no circular dependency on the rule modules)."""
     from holo_tpu.analysis import (
         rules_donation,
+        rules_jaxpr,
         rules_locks,
         rules_resilience,
         rules_sharding,
@@ -304,6 +305,7 @@ def all_rules() -> list[Rule]:
             + rules_sharding.RULES
             + rules_resilience.RULES
             + rules_locks.RULES
+            + rules_jaxpr.RULES
         )
     ]
 

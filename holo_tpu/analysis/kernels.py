@@ -1,0 +1,146 @@
+"""Kernel registry for the jaxpr-level contract audit (HL3xx family).
+
+Every jit-construction seam in the dispatch plane self-registers here with
+``register_kernel(...)``: a name, a builder thunk that returns the jitted
+callable, a spec thunk that returns the canonical abstract argument shapes
+(``jax.ShapeDtypeStruct`` pytrees), and the declared contracts — donated
+argnums, required sharding fences, dtype discipline, and the static shape
+bucket count the dispatch site can produce.
+
+Registration is deliberately inert: this module imports nothing heavy (no
+jax), and the builder/spec thunks are *never invoked* at registration time.
+They only run inside :mod:`holo_tpu.analysis.jaxpr_audit` when the audit is
+armed, so registering a kernel adds zero cost to the dispatch path — that
+laziness is the "no-op outside audit mode" property the registry promises.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "KernelSpec",
+    "register_kernel",
+    "registry",
+    "clear_registry",
+]
+
+#: Default dtype discipline for the saturating-uint32 fixpoint plane: every
+#: eqn output in a registered kernel must land in one of these lanes unless
+#: the registration widens the set explicitly.
+DEFAULT_DTYPES: Tuple[str, ...] = ("int32", "uint32", "bool")
+
+#: Default compile-signature budget: a dispatch seam may produce at most this
+#: many distinct shape buckets before HL304 flags recompile churn.
+DEFAULT_BUCKET_BUDGET = 64
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered kernel seam and its declared device contracts.
+
+    ``builder`` returns the jitted callable (``builder()`` normally,
+    ``builder(mesh)`` when ``needs_mesh``). ``specs`` returns the tuple of
+    canonical abstract arguments to lower against. Both are thunks so that
+    registration never constructs JAX objects.
+    """
+
+    name: str
+    builder: Callable
+    specs: Callable[[], tuple]
+    donate: Tuple[int, ...] = ()
+    fences: int = 0
+    dtypes: Tuple[str, ...] = DEFAULT_DTYPES
+    buckets: Optional[int] = None
+    budget: int = DEFAULT_BUCKET_BUDGET
+    needs_mesh: bool = False
+    module: str = field(default="", compare=False)
+    line: int = field(default=0, compare=False)
+
+
+_REGISTRY: Dict[str, KernelSpec] = {}
+
+
+def _caller_site(depth: int) -> Tuple[str, int]:
+    """Repo-relative path and line of the registration call site."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:  # pragma: no cover - interpreter without frame depth
+        return "", 0
+    path = frame.f_code.co_filename
+    line = frame.f_lineno
+    # Make the path repo-relative so findings anchor like AST findings do.
+    probe = os.path.dirname(os.path.abspath(path))
+    root = ""
+    for _ in range(12):
+        if os.path.isdir(os.path.join(probe, "holo_tpu")):
+            root = probe
+            break
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    if root:
+        try:
+            path = os.path.relpath(os.path.abspath(path), root)
+        except ValueError:  # pragma: no cover - cross-drive on windows
+            pass
+    return path.replace(os.sep, "/"), line
+
+
+def register_kernel(
+    name: str,
+    builder: Optional[Callable] = None,
+    *,
+    specs: Callable[[], tuple],
+    donate: Tuple[int, ...] = (),
+    fences: int = 0,
+    dtypes: Tuple[str, ...] = DEFAULT_DTYPES,
+    buckets: Optional[int] = None,
+    budget: int = DEFAULT_BUCKET_BUDGET,
+    needs_mesh: bool = False,
+):
+    """Register a kernel seam for the jaxpr audit.
+
+    Usable as a plain call (``register_kernel("spf.one", builder=..., ...)``)
+    or as a decorator when ``builder`` is omitted. Re-registration under the
+    same name overwrites the previous entry, so repeated module imports are
+    idempotent. The call itself is cheap and side-effect free beyond the
+    registry dict: no thunk is invoked until the audit arms.
+    """
+
+    def _record(fn: Callable) -> Callable:
+        # Plain call: user -> register_kernel -> _record -> _caller_site (depth 3).
+        # Decorator: user applies the returned _record directly (depth 2).
+        module, line = _caller_site(2 if builder is None else 3)
+        _REGISTRY[name] = KernelSpec(
+            name=name,
+            builder=fn,
+            specs=specs,
+            donate=tuple(donate),
+            fences=fences,
+            dtypes=tuple(dtypes),
+            buckets=buckets,
+            budget=budget,
+            needs_mesh=needs_mesh,
+            module=module,
+            line=line,
+        )
+        return fn
+
+    if builder is None:
+        return _record
+    return _record(builder)
+
+
+def registry() -> Dict[str, KernelSpec]:
+    """Snapshot of the currently registered kernels, keyed by name."""
+    return dict(_REGISTRY)
+
+
+def clear_registry() -> None:
+    """Drop all registrations (test isolation helper)."""
+    _REGISTRY.clear()

@@ -1,0 +1,89 @@
+"""HL3xx — jaxpr-level kernel-contract rules.
+
+Unlike the HL1xx/HL2xx families these rules do not inspect source syntax:
+their findings are produced by :mod:`holo_tpu.analysis.jaxpr_audit`, which
+abstractly lowers every kernel registered in :mod:`holo_tpu.analysis.kernels`
+and checks the declared contracts against the compiled IR. The classes here
+exist so the family plugs into the shared catalog, severity tiers, baseline
+ratchet, and suppression audit exactly like the AST rules — their ``check``
+methods are intentionally empty.
+
+Tiering follows the HL107/HL205 precedent: contract *violations that corrupt
+state or leak to the host* (HL301, HL302) are error-tier and gate commits;
+discipline drift (HL303 widening, HL304 signature budget, HL305 fences) soaks
+at warn tier until the family has baked.
+"""
+
+from __future__ import annotations
+
+from holo_tpu.analysis.core import Finding, ModuleInfo, Rule
+
+
+class _JaxprRule(Rule):
+    """Base for IR-backed rules: the AST pass contributes nothing."""
+
+    family = "jaxpr"
+
+    def check(self, mod: ModuleInfo) -> list[Finding]:
+        return []
+
+
+class DonationNotRealizedRule(_JaxprRule):
+    """HL301: a declared ``donate_argnums`` is absent from the lowered
+    ``input_output_aliases``. The donation silently does nothing — the
+    runtime guard's ``note_donated`` poison never fires and the buffer is
+    double-allocated instead of reused."""
+
+    id = "HL301"
+    title = "declared buffer donation not realized in lowered kernel"
+    severity = "error"
+
+
+class HostLeakInKernelRule(_JaxprRule):
+    """HL302: a host round-trip primitive (``pure_callback``, ``io_callback``,
+    ``debug_callback``, host ``device_put``, infeed/outfeed) appears inside a
+    dispatch-scope jaxpr. Kernels must stay device-resident end to end."""
+
+    id = "HL302"
+    title = "host-transfer primitive inside dispatch-scope kernel"
+    severity = "error"
+
+
+class DtypeWideningRule(_JaxprRule):
+    """HL303: an eqn in a saturating-uint32/int32 fixpoint kernel produces a
+    lane outside the kernel's declared dtype discipline (int64, float,
+    weak-type promotion). Widened lanes break saturation semantics and parity
+    with the device plane."""
+
+    id = "HL303"
+    title = "dtype lane widened beyond declared kernel discipline"
+    severity = "warn"
+
+
+class CompileSignatureBudgetRule(_JaxprRule):
+    """HL304: a registered dispatch seam admits unbounded input shapes or its
+    static bucket count exceeds the compile-signature budget — the
+    recompile-churn hazard HL105 can only guess at from syntax."""
+
+    id = "HL304"
+    title = "compile-signature budget exceeded or unbounded-shape arg"
+    severity = "warn"
+
+
+class FenceNotRealizedRule(_JaxprRule):
+    """HL305: a per-mesh kernel declares required sharding fences but the
+    lowered jaxpr contains fewer ``sharding_constraint`` eqns than declared —
+    the fence HL110 demands in source never made it into the IR."""
+
+    id = "HL305"
+    title = "declared sharding fence missing from lowered kernel"
+    severity = "warn"
+
+
+RULES = [
+    DonationNotRealizedRule,
+    HostLeakInKernelRule,
+    DtypeWideningRule,
+    CompileSignatureBudgetRule,
+    FenceNotRealizedRule,
+]

@@ -555,3 +555,87 @@ class FrrEngine:
             time.perf_counter() - t0
         )
         return table
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# Inert contract descriptors for holo_tpu.analysis.jaxpr_audit.  This
+# module keeps jax out of its import graph, so the thunks import jax
+# themselves — they only ever run when the audit arms.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+_AUDIT_LINKS, _AUDIT_ADJ = 8, 16
+
+
+def _audit_frr_specs() -> tuple:
+    import jax
+    import jax.numpy as jnp
+
+    from holo_tpu.ops.spf_engine import _AUDIT_E, audit_graph_spec
+
+    s = jax.ShapeDtypeStruct
+    lk, ad = _AUDIT_LINKS, _AUDIT_ADJ
+    return (
+        audit_graph_spec(),
+        s((), jnp.int32),  # root
+        s((lk,), jnp.int32),  # link_far
+        s((lk,), jnp.int32),  # link_cost
+        s((lk,), jnp.bool_),  # link_valid
+        s((lk, _AUDIT_E), jnp.bool_),  # edge_masks
+        s((ad,), jnp.int32),  # adj_nbr
+        s((ad,), jnp.int32),  # adj_cost
+        s((ad,), jnp.int32),  # adj_link
+        s((ad,), jnp.bool_),  # adj_valid
+        s((lk,), jnp.uint32),  # link_srlg
+        s((ad,), jnp.uint32),  # adj_srlg
+        s((), jnp.bool_),  # require_np
+    )
+
+
+def _audit_frr_builder():
+    import jax
+
+    from holo_tpu.frr.kernel import frr_batch
+
+    return jax.jit(
+        lambda g, root, lf, lc, lv, em, an, ac, al, av, lsr, asr, rnp: (
+            frr_batch(
+                g, root, lf, lc, lv, em, an, ac, al, av,
+                link_srlg=lsr, adj_srlg=asr, require_np=rnp,
+                max_iters=None,
+            )
+        )
+    )
+
+
+def _audit_frr_sharded_builder(mesh):
+    import jax
+
+    from holo_tpu.frr.kernel import frr_batch
+    from holo_tpu.parallel.mesh import constrain_batch
+
+    @jax.jit
+    def step(g, root, lf, lc, lv, em, an, ac, al, av, lsr, asr, rnp):
+        out = frr_batch(
+            g, root, lf, lc, lv, em, an, ac, al, av,
+            link_srlg=lsr, adj_srlg=asr, require_np=rnp, max_iters=None,
+        )
+        return constrain_batch(mesh, out)
+
+    return step
+
+
+_register_kernel(
+    "frr.batch",
+    builder=_audit_frr_builder,
+    specs=_audit_frr_specs,
+    buckets=16,  # pow2 protected-link x adjacency pads per shape
+)
+
+_register_kernel(
+    "frr.batch.sharded",
+    builder=_audit_frr_sharded_builder,
+    specs=_audit_frr_specs,
+    fences=1,
+    needs_mesh=True,
+    buckets=16,
+)

@@ -431,10 +431,14 @@ _scatter = jax.jit(
     lambda planes, idx, rows: planes.at[:, idx, :].set(rows),
     donate_argnums=(0,),
 )
+# No donation on _grow: jnp.pad always changes the buffer shape, so a
+# declared donation could never be realized as an input/output alias —
+# XLA silently drops it (the HL301 hazard) and both tables stay live
+# until the old one is collected.  Keep the old planes un-poisoned and
+# let them die naturally after the copy.
 _grow = jax.jit(
     lambda planes, nr, nc: jnp.pad(planes, ((0, 0), (0, nr), (0, nc))),
     static_argnums=(1, 2),
-    donate_argnums=(0,),
 )
 
 
@@ -643,11 +647,11 @@ class TpuBgpTableBackend:
         if n_rows > dt.cap_rows or n_cols > dt.cap_cols:
             cap_r = max(dt.cap_rows, _pow2(n_rows))
             cap_c = max(dt.cap_cols, _pow2(n_cols))
-            old = dt.planes
+            # _grow copies (shape change — donation is unrealizable, see
+            # the jit above), so the old planes are NOT poisoned here.
             dt.planes = _grow(
-                old, cap_r - dt.cap_rows, cap_c - dt.cap_cols
+                dt.planes, cap_r - dt.cap_rows, cap_c - dt.cap_cols
             )
-            note_donated("bgp.table.grow", old)
             dt.cap_rows, dt.cap_cols = cap_r, cap_c
             dt.grows += 1
         return dt
@@ -973,3 +977,74 @@ class DeviceRankBackend:
             return None
 
         return self.breaker.call(_device, _fallback, context="bgp.rank")
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# Inert contract descriptors for holo_tpu.analysis.jaxpr_audit; thunks
+# run only when the audit arms.  The fold/decide/scatter builders return
+# the module-level jits the dispatch path actually uses, so the audit
+# proves the live objects, not reconstructions.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+_AUDIT_M, _AUDIT_C, _AUDIT_ROWS, _AUDIT_NH = 16, 8, 32, 8
+
+
+def _audit_bgp_specs():
+    s = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    return {
+        "sub": s((N_LANES, _AUDIT_M, _AUDIT_C), i32),
+        "planes": s((N_LANES, _AUDIT_ROWS, _AUDIT_C), i32),
+        "rows": s((N_LANES, _AUDIT_M, _AUDIT_C), i32),
+        "idx": s((_AUDIT_M,), i32),
+        "order": s((_AUDIT_C,), i32),
+        "rank": s((_AUDIT_C,), i32),
+        "has": s((_AUDIT_C,), i32),
+        "nht": s((_AUDIT_NH,), i32),
+        "mp": s((3,), i32),
+    }
+
+
+_register_kernel(
+    "bgp.table.fold",
+    builder=lambda: fold_planes,
+    specs=lambda: (
+        lambda a: (
+            a["sub"], a["order"], a["rank"], a["has"],
+            a["nht"], a["nht"], a["mp"],
+        )
+    )(_audit_bgp_specs()),
+    buckets=32,  # pow2 row x pow2 peer-column buckets
+)
+
+_register_kernel(
+    "bgp.table.decide",
+    builder=lambda: _decide,
+    specs=lambda: (
+        lambda a: (
+            a["planes"], a["idx"], a["order"], a["rank"], a["has"],
+            a["nht"], a["nht"], a["mp"],
+        )
+    )(_audit_bgp_specs()),
+    buckets=32,
+)
+
+_register_kernel(
+    "bgp.table.scatter",
+    builder=lambda: _scatter,
+    specs=lambda: (
+        lambda a: (a["planes"], a["idx"], a["rows"])
+    )(_audit_bgp_specs()),
+    donate=(0,),
+    buckets=32,
+)
+
+_register_kernel(
+    "bgp.table.grow",
+    builder=lambda: _grow,
+    # Static grow amounts ride the spec tuple as plain ints.
+    specs=lambda: (
+        lambda a: (a["planes"], _AUDIT_ROWS, _AUDIT_C)
+    )(_audit_bgp_specs()),
+    buckets=32,
+)

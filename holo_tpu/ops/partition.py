@@ -1699,3 +1699,112 @@ class _ExchangeState:
         st.np_tab = res.np_tab.copy()
         st.aw_tab = res.aw_tab.copy()
         return st
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# Inert contract descriptors for holo_tpu.analysis.jaxpr_audit; the
+# builders mirror PartitionedSpfEngine._jit constructions (same kernels,
+# same donations) at a fixed audit limit.  Thunks run only when the
+# audit arms.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+_AUDIT_P, _AUDIT_L, _AUDIT_SK, _AUDIT_BP = 4, 32, 8, 8
+_AUDIT_LIMIT = 32
+
+
+def audit_part_planes_spec(
+    p=_AUDIT_P, l=_AUDIT_L, k=8, w=2, bp=_AUDIT_BP
+) -> PartPlanes:
+    """Abstract PartPlanes matching the partition marshal layout."""
+    s = jax.ShapeDtypeStruct
+    i32, u32, b = jnp.int32, jnp.uint32, jnp.bool_
+    return PartPlanes(
+        in_src=s((p, l, k), i32),
+        in_cost=s((p, l, k), i32),
+        in_valid=s((p, l, k), b),
+        in_edge_id=s((p, l, k), i32),
+        direct_words=s((p, l, k, w), u32),
+        is_router=s((p, l), b),
+        gid=s((p, l), i32),
+        own=s((p, l), b),
+        pinned=s((p, l), b),
+        root_local=s((p,), i32),
+        bnd_local=s((p, bp), i32),
+    )
+
+
+def _audit_part_specs():
+    s = jax.ShapeDtypeStruct
+    i32, u32, b = jnp.int32, jnp.uint32, jnp.bool_
+    p, l, w = _AUDIT_P, _AUDIT_L, 2
+    return {
+        "pl": audit_part_planes_spec(),
+        "roots": s((p, _AUDIT_SK), i32),
+        "seed": s((p, l), i32),
+        "dist": s((p, l), i32),
+        "hops": s((p, l), i32),
+        "nh": s((p, l, w), i32),
+        "mask": s((128,), b),
+        "idx": s((2,), i32),
+        "drow": s((256,), i32),
+        "dwords": s((256, w), u32),
+        "dvalid": s((256,), b),
+    }
+
+
+_register_kernel(
+    "spf.partition.bdist",
+    builder=lambda: jax.jit(
+        lambda pl, roots, m: boundary_dist_kernel(pl, roots, m, _AUDIT_LIMIT)
+    ),
+    specs=lambda: (
+        lambda a: (a["pl"], a["roots"], a["mask"])
+    )(_audit_part_specs()),
+    buckets=16,  # pow2 partition-lane x root-chunk buckets
+)
+
+_register_kernel(
+    "spf.partition.fdist",
+    builder=lambda: jax.jit(
+        lambda pl, seed, m: final_dist_kernel(pl, seed, m, _AUDIT_LIMIT)
+    ),
+    specs=lambda: (
+        lambda a: (a["pl"], a["seed"], a["mask"])
+    )(_audit_part_specs()),
+    buckets=16,
+)
+
+_register_kernel(
+    "spf.partition.phase2",
+    builder=lambda: jax.jit(
+        lambda pl, d, h, nh, m: phase2_kernel(
+            pl, d, h, nh, m, _AUDIT_P * _AUDIT_L, _AUDIT_LIMIT
+        )
+    ),
+    specs=lambda: (
+        lambda a: (a["pl"], a["dist"], a["hops"], a["nh"], a["mask"])
+    )(_audit_part_specs()),
+    buckets=16,
+)
+
+_register_kernel(
+    "spf.partition.gather",
+    builder=lambda: jax.jit(gather_parts_kernel),
+    specs=lambda: (
+        lambda a: (a["pl"], a["idx"])
+    )(_audit_part_specs()),
+    buckets=8,  # pow2 gather-subset lanes
+)
+
+_register_kernel(
+    "spf.partition.apply_delta",
+    builder=lambda: jax.jit(apply_part_delta_kernel, donate_argnums=(0,)),
+    specs=lambda: (
+        lambda a: (
+            a["pl"], a["drow"], a["drow"], a["drow"], a["drow"],
+            a["drow"], a["dvalid"], a["dwords"],
+        )
+    )(_audit_part_specs()),
+    donate=(0,),
+    buckets=16,  # pow2 delta-row pads
+)

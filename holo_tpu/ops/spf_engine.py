@@ -1430,17 +1430,20 @@ def spf_one_incremental_multipath(
     g: DeviceGraph,
     root: jax.Array,
     prev: SpfTensors,
-    prev_mp: MultipathTensors,
+    prev_npaths: jax.Array,
+    prev_nh_weights: jax.Array,
     seed_rows: jax.Array,
     kp: int,
     max_iters: int | None = None,
 ) -> tuple[SpfTensors, MultipathTensors]:
     """Incremental multipath SPF: the DeltaPath recompute
     (:func:`spf_one_incremental`) with the widened phase-2 state seeded
-    from the previous run's multipath planes.  The parent-set planes
-    are closed-form in the settled distances, so only the packed
-    fixpoint reconverges — rounds ~ changed-region depth.  Bit-identical
-    to ``spf_one_multipath(g, root, kp)`` by fixpoint uniqueness."""
+    from the previous run's multipath planes.  Only ``npaths`` and
+    ``nh_weights`` carry state between runs — the parent-set planes are
+    closed-form in the settled distances, so they are recomputed (not
+    taken as inputs; donating them would never realize as an alias).
+    Rounds ~ changed-region depth.  Bit-identical to
+    ``spf_one_multipath(g, root, kp)`` by fixpoint uniqueness."""
     n, k = g.in_src.shape
     limit = n if max_iters is None else max_iters
     big = jnp.int32(n + 1)
@@ -1482,7 +1485,7 @@ def spf_one_incremental_multipath(
     nh_prev = jax.lax.bitcast_convert_type(prev.nexthops, jnp.int32)
     hops, nh, npaths, aw = _mp_fixpoint(
         g, root, dag, parent, prev.hops, nh_prev,
-        prev_mp.npaths, prev_mp.nh_weights, limit,
+        prev_npaths, prev_nh_weights, limit,
     )
     parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
     sp = SpfTensors(
@@ -1643,3 +1646,70 @@ def spf_multiroot(
     flooding reduction (holo-isis/src/flooding/manet.rs:39-97) or TI-LFA."""
     fn = jax.vmap(lambda r: spf_one(g, r, edge_mask, max_iters))
     return fn(roots)
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# Inert contract descriptors for holo_tpu.analysis.jaxpr_audit: the
+# builder/spec thunks below run ONLY when the audit arms — registration
+# itself is a dict write, so the dispatch path never pays for them.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+#: Canonical audit shapes: small enough to lower in milliseconds, wide
+#: enough to exercise every gather/scatter lane the real shapes use.
+_AUDIT_N, _AUDIT_K, _AUDIT_W, _AUDIT_E = 64, 8, 2, 128
+_AUDIT_B = 8  # scenario/root batch lanes
+
+
+def audit_graph_spec(n=_AUDIT_N, k=_AUDIT_K, w=_AUDIT_W) -> DeviceGraph:
+    """Abstract DeviceGraph matching the marshal layout, for lowering."""
+    s = jax.ShapeDtypeStruct
+    return DeviceGraph(
+        in_src=s((n, k), jnp.int32),
+        in_cost=s((n, k), jnp.int32),
+        in_valid=s((n, k), jnp.bool_),
+        in_edge_id=s((n, k), jnp.int32),
+        direct_nh_words=s((n, k, w), jnp.uint32),
+        is_router=s((n,), jnp.bool_),
+    )
+
+
+def audit_spf_spec(n=_AUDIT_N, w=_AUDIT_W) -> SpfTensors:
+    s = jax.ShapeDtypeStruct
+    return SpfTensors(
+        dist=s((n,), jnp.int32),
+        parent=s((n,), jnp.int32),
+        hops=s((n,), jnp.int32),
+        nexthops=s((n, w), jnp.uint32),
+    )
+
+
+def audit_mp_spec(n=_AUDIT_N, kp=2, w=_AUDIT_W) -> MultipathTensors:
+    s = jax.ShapeDtypeStruct
+    return MultipathTensors(
+        parents=s((n, kp), jnp.int32),
+        pdist=s((n, kp), jnp.int32),
+        pweight=s((n, kp), jnp.int32),
+        npaths=s((n,), jnp.int32),
+        nh_weights=s((n, w * 32), jnp.int32),
+    )
+
+
+def _audit_delta_specs() -> tuple:
+    s = jax.ShapeDtypeStruct
+    r = _DELTA_PAD_FLOOR
+    i32, u32, b = jnp.int32, jnp.uint32, jnp.bool_
+    return (
+        audit_graph_spec(),
+        s((r,), i32), s((r,), i32), s((r,), i32),
+        s((r,), i32), s((r,), b), s((r, _AUDIT_W), u32),
+        s((_AUDIT_N,), b),
+    )
+
+
+_register_kernel(
+    "spf.delta.apply",
+    builder=lambda: _APPLY_DELTA,
+    specs=_audit_delta_specs,
+    donate=(0,),
+    buckets=16,  # pow2 delta-row pads above _DELTA_PAD_FLOOR, per shape
+)

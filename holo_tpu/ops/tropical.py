@@ -905,15 +905,19 @@ def tropical_spf_one_incremental_multipath(
     tt: TropicalTiles,
     root,
     prev: SpfTensors,
-    prev_mp: MultipathTensors,
+    prev_npaths,
+    prev_nh_weights,
     seed_rows,
     kp: int,
     max_iters: int | None = None,
 ) -> tuple[SpfTensors, MultipathTensors]:
     """Incremental multipath on the tiles: the widened planes reconverge
     through the DAG-tile contractions seeded from the previous run
-    (rounds ~ changed-region depth).  Bit-identical to the full
-    ``tropical_spf_one_multipath`` by fixpoint uniqueness."""
+    (rounds ~ changed-region depth).  Only ``npaths``/``nh_weights``
+    carry state — the parent-set planes are closed-form in the settled
+    distances and recomputed, so they are not inputs (a donated input
+    that is never read cannot realize as an alias).  Bit-identical to
+    the full ``tropical_spf_one_multipath`` by fixpoint uniqueness."""
     n, _ = g.in_src.shape
     limit = n if max_iters is None else max_iters
     aff = _affected(g, prev.parent, seed_rows, limit)
@@ -924,9 +928,9 @@ def tropical_spf_one_incremental_multipath(
     sp, dag, hops = _phase2(
         g, root, dist, ok, limit, hops0=prev.hops, nh0=nh_prev
     )
-    npaths = _np_tile_fixpoint(g, tt, dag, root, prev_mp.npaths, limit)
+    npaths = _np_tile_fixpoint(g, tt, dag, root, prev_npaths, limit)
     aw = _aw_tile_fixpoint(
-        g, tt, dag, hops, npaths, prev_mp.nh_weights, limit
+        g, tt, dag, hops, npaths, prev_nh_weights, limit
     )
     parents, pdist, pweight = _mp_parent_sets(g, root, dist, ok, npaths, kp)
     mp = MultipathTensors(
@@ -937,3 +941,148 @@ def tropical_spf_one_incremental_multipath(
         nh_weights=aw,
     )
     return sp, mp
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# Inert contract descriptors for holo_tpu.analysis.jaxpr_audit; thunks
+# run only when the audit arms.  The jits built here mirror the backend's
+# _jit_trop_* constructions exactly (same arg order, same donations) with
+# max_iters=None — the contracts proven are the dispatch contracts.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+_AUDIT_NB, _AUDIT_TM, _AUDIT_BLK = 8, 4, 8
+_AUDIT_RR = 8  # repair-row pad lanes
+
+
+def audit_tiles_spec(nb=_AUDIT_NB, tm=_AUDIT_TM, blk=_AUDIT_BLK) -> TropicalTiles:
+    """Abstract TropicalTiles matching the blocked marshal layout."""
+    s = jax.ShapeDtypeStruct
+    return TropicalTiles(
+        tiles=s((nb, tm, blk, blk), jnp.int32),
+        cb=s((nb, tm), jnp.int32),
+        pos=s((nb, nb), jnp.int32),
+        perm=s((nb * blk,), jnp.int32),
+        inv=s((nb * blk,), jnp.int32),
+    )
+
+
+def _audit_specs():
+    from holo_tpu.ops.spf_engine import (
+        _AUDIT_B,
+        _AUDIT_E,
+        _AUDIT_N,
+        audit_graph_spec,
+        audit_mp_spec,
+        audit_spf_spec,
+    )
+
+    s = jax.ShapeDtypeStruct
+    return {
+        "g": audit_graph_spec(),
+        "tt": audit_tiles_spec(),
+        "sp": audit_spf_spec(),
+        "mp": audit_mp_spec(),
+        "root": s((), jnp.int32),
+        "roots": s((_AUDIT_B,), jnp.int32),
+        "mask": s((_AUDIT_E,), jnp.bool_),
+        "masks": s((_AUDIT_B, _AUDIT_E), jnp.bool_),
+        "rr": s((_AUDIT_RR,), jnp.int32),
+        "rrs": s((_AUDIT_B, _AUDIT_RR), jnp.int32),
+        "seeds": s((256,), jnp.int32),
+        "strike": s((_AUDIT_N,), jnp.bool_),
+        "tdelta": tuple(s((256,), jnp.int32) for _ in range(5)),
+    }
+
+
+_register_kernel(
+    "spf.delta.apply_tiles",
+    builder=lambda: __import__(
+        "holo_tpu.ops.spf_engine", fromlist=["_apply_tiles_for"]
+    )._apply_tiles_for(None),
+    specs=lambda: (
+        lambda a: (a["tt"],) + a["tdelta"] + (a["strike"],)
+    )(_audit_specs()),
+    donate=(0,),
+    buckets=16,  # pow2 delta-row pads x block-size buckets
+)
+
+_register_kernel(
+    "spf.tropical.one",
+    builder=lambda: jax.jit(
+        lambda g, tt, r, m, rr: tropical_spf_one(g, tt, r, m, rr, None)
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["root"], a["mask"], a["rr"])
+    )(_audit_specs()),
+    buckets=5,  # one program per pow2 tile block size (8..128)
+)
+
+_register_kernel(
+    "spf.tropical.whatif",
+    builder=lambda: jax.jit(
+        lambda g, tt, r, ms, rr: tropical_whatif_batch(g, tt, r, ms, rr, None)
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["root"], a["masks"], a["rrs"])
+    )(_audit_specs()),
+    buckets=16,  # block-size x scenario-chunk buckets
+)
+
+_register_kernel(
+    "spf.tropical.multiroot",
+    builder=lambda: jax.jit(
+        lambda g, tt, rs, m, rr: tropical_multiroot(g, tt, rs, m, rr, None)
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["roots"], a["mask"], a["rr"])
+    )(_audit_specs()),
+    buckets=16,
+)
+
+_register_kernel(
+    "spf.tropical.multipath.k2",
+    builder=lambda: jax.jit(
+        lambda g, tt, r, m, rr: tropical_spf_one_multipath(
+            g, tt, r, 2, m, rr, None
+        )
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["root"], a["mask"], a["rr"])
+    )(_audit_specs()),
+    buckets=20,  # block-size x kp {1,2,4,8} buckets
+)
+
+_register_kernel(
+    "spf.tropical.incremental",
+    builder=lambda: jax.jit(
+        lambda g, tt, r, prev, seeds: tropical_spf_one_incremental(
+            g, tt, r, prev, seeds, None
+        ),
+        donate_argnums=(3,),
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["root"], a["sp"], a["seeds"])
+    )(_audit_specs()),
+    donate=(3,),
+    buckets=16,  # block-size x pow2 seed-row pads
+)
+
+_register_kernel(
+    "spf.tropical.incremental.multipath.k2",
+    builder=lambda: jax.jit(
+        lambda g, tt, r, prev, np_p, aw_p, seeds: (
+            tropical_spf_one_incremental_multipath(
+                g, tt, r, prev, np_p, aw_p, seeds, 2, None
+            )
+        ),
+        donate_argnums=(3, 4, 5),
+    ),
+    specs=lambda: (
+        lambda a: (
+            a["g"], a["tt"], a["root"], a["sp"],
+            a["mp"].npaths, a["mp"].nh_weights, a["seeds"],
+        )
+    )(_audit_specs()),
+    donate=(3, 4, 5),
+    buckets=32,
+)

@@ -353,3 +353,76 @@ def sharded_multipath_jit(mesh: Mesh, kp: int, max_iters: int | None = None):
         return constrain_batch(mesh, sp), constrain_batch(mesh, mp)
 
     return step
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# The per-mesh builders above are the fence-bearing seams: under a
+# multi-device mesh every output is pinned through constrain_batch, and
+# HL305 proves the pin survives to the lowered jaxpr as real
+# sharding_constraint eqns.  Thunks run only when the audit arms (the
+# audit passes its own >=2-device CPU mesh).
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+
+def _audit_mesh_specs():
+    from holo_tpu.ops.spf_engine import audit_graph_spec
+    from holo_tpu.ops.tropical import audit_tiles_spec
+    import jax.numpy as jnp
+
+    s = jax.ShapeDtypeStruct
+    b, e, rr = 8, 128, 8
+    return {
+        "g": audit_graph_spec(),
+        "tt": audit_tiles_spec(),
+        "root": s((), jnp.int32),
+        "roots": s((b,), jnp.int32),
+        "mask": s((e,), jnp.bool_),
+        "masks": s((b, e), jnp.bool_),
+        "rr": s((rr,), jnp.int32),
+        "rrs": s((b, rr), jnp.int32),
+    }
+
+
+_register_kernel(
+    "spf.shard.whatif",
+    builder=lambda mesh: sharded_whatif_jit(mesh, None, "seq"),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["masks"])
+    )(_audit_mesh_specs()),
+    fences=1,
+    needs_mesh=True,
+    buckets=16,  # pow2 scenario lanes x mesh identities
+)
+
+_register_kernel(
+    "spf.shard.multipath.k2",
+    builder=lambda mesh: sharded_multipath_jit(mesh, 2, None),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["masks"])
+    )(_audit_mesh_specs()),
+    fences=1,
+    needs_mesh=True,
+    buckets=32,
+)
+
+_register_kernel(
+    "spf.shard.tropical.whatif",
+    builder=lambda mesh: sharded_tropical_whatif_jit(mesh, None),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["root"], a["masks"], a["rrs"])
+    )(_audit_mesh_specs()),
+    fences=1,
+    needs_mesh=True,
+    buckets=32,
+)
+
+_register_kernel(
+    "spf.shard.tropical.multiroot",
+    builder=lambda mesh: sharded_tropical_multiroot_jit(mesh, None),
+    specs=lambda: (
+        lambda a: (a["g"], a["tt"], a["roots"], a["mask"], a["rr"])
+    )(_audit_mesh_specs()),
+    fences=1,
+    needs_mesh=True,
+    buckets=32,
+)

@@ -478,18 +478,23 @@ class TpuSpfBackend(SpfBackend):
         return fn
 
     def _jit_mp_incr_for(self, kp: int):
-        """Incremental multipath jit: the previous SpfTensors AND
-        MultipathTensors are donated — same ownership discipline as
-        ``_jit_incr``, widened."""
+        """Incremental multipath jit: the previous SpfTensors plus the
+        two multipath planes that actually carry state (``npaths``,
+        ``nh_weights``) are donated — same ownership discipline as
+        ``_jit_incr``, widened.  The parent-set planes are closed-form
+        in the settled distances and never read by the kernel, so they
+        are not passed (HL301: a donated-but-unused arg is pruned and
+        its alias can never realize)."""
         fn = self._mp_incr_jits.get(kp)
         if fn is None:
             fn = self._mp_incr_jits[kp] = jax.jit(
-                lambda g, r, prev, prev_mp, seeds, _kp=kp: (
+                lambda g, r, prev, np_prev, aw_prev, seeds, _kp=kp: (
                     spf_one_incremental_multipath(
-                        g, r, prev, prev_mp, seeds, _kp, self.max_iters
+                        g, r, prev, np_prev, aw_prev, seeds,
+                        _kp, self.max_iters,
                     )
                 ),
-                donate_argnums=(2, 3),
+                donate_argnums=(2, 3, 4),
             )
         return fn
 
@@ -544,15 +549,18 @@ class TpuSpfBackend(SpfBackend):
         )
 
     def _jit_trop_mp_incr_for(self, kp: int):
+        # Donation mirrors _jit_mp_incr_for: prev plus the two live
+        # multipath planes only — the parent-set planes never realize.
         return self._jit_trop(
             f"mp-incr{kp}",
             lambda: jax.jit(
-                lambda g, tt, r, prev, prev_mp, seeds, _kp=kp: (
+                lambda g, tt, r, prev, np_prev, aw_prev, seeds, _kp=kp: (
                     tropical_spf_one_incremental_multipath(
-                        g, tt, r, prev, prev_mp, seeds, _kp, self.max_iters
+                        g, tt, r, prev, np_prev, aw_prev, seeds,
+                        _kp, self.max_iters,
                     )
                 ),
-                donate_argnums=(3, 4),
+                donate_argnums=(3, 4, 5),
             ),
         )
 
@@ -620,12 +628,15 @@ class TpuSpfBackend(SpfBackend):
         fresh = self._track_compile("delta", "incr", *sig)
         del self._prev_one[prev_key]
         if kp > 1:
+            np_prev, aw_prev = prev[1].npaths, prev[1].nh_weights
             if trop:
                 step = self._jit_trop_mp_incr_for(kp)
-                out = step(g, tt, topo.root, prev[0], prev[1], seeds_p)
+                out = step(
+                    g, tt, topo.root, prev[0], np_prev, aw_prev, seeds_p
+                )
             else:
                 step = self._jit_mp_incr_for(kp)
-                out = step(g, topo.root, prev[0], prev[1], seeds_p)
+                out = step(g, topo.root, prev[0], np_prev, aw_prev, seeds_p)
         elif trop:
             step = self._jit_trop_incr
             out = step(g, tt, topo.root, prev, seeds_p)
@@ -636,6 +647,10 @@ class TpuSpfBackend(SpfBackend):
         # the consumed previous tensors are actually poisoned, so any
         # use-after-donate the static rule missed raises at read time
         # on the CPU platform exactly as it would corrupt on device.
+        # The whole previous state is poisoned — including the
+        # multipath parent-set planes that are recomputed rather than
+        # donated — because ownership transfers wholesale here even
+        # where the jit-level donation is narrower.
         note_donated("spf.one.delta", prev)
         return step, out, trop, tt, sig, fresh
 
@@ -645,7 +660,7 @@ class TpuSpfBackend(SpfBackend):
         tensors stand in (same shapes/dtypes)."""
         root_args = (g, tt, root) if trop else (g, root)
         return (
-            (*root_args, out[0], out[1], seeds_p)
+            (*root_args, out[0], out[1].npaths, out[1].nh_weights, seeds_p)
             if kp > 1
             else (*root_args, out, seeds_p)
         )
@@ -1887,3 +1902,135 @@ class TpuSpfBackend(SpfBackend):
         if h.remember and self.incremental:
             self._remember(h.topo, h.n_atoms, h.out, h.kp)
         return res
+
+
+# -- jaxpr-audit registrations (HL3xx) ----------------------------------
+# The per-instance jit caches above (_jit_one_for/_jit_incr/_jit_mp_*)
+# are the gather-path dispatch seams; each registers an equivalent
+# module-level construction (same kernel fn, same arg order, same
+# donate_argnums, max_iters=None) so the audit proves the contracts the
+# instance jits rely on.  Thunks run only when the audit arms.
+from holo_tpu.analysis.kernels import register_kernel as _register_kernel  # noqa: E402
+
+
+def _audit_specs():
+    from holo_tpu.ops.spf_engine import (
+        _AUDIT_B,
+        _AUDIT_E,
+        audit_graph_spec,
+        audit_mp_spec,
+        audit_spf_spec,
+    )
+    import jax.numpy as jnp
+
+    s = jax.ShapeDtypeStruct
+    return {
+        "g": audit_graph_spec(),
+        "sp": audit_spf_spec(),
+        "mp": audit_mp_spec(),
+        "root": s((), jnp.int32),
+        "roots": s((_AUDIT_B,), jnp.int32),
+        "mask": s((_AUDIT_E,), jnp.bool_),
+        "masks": s((_AUDIT_B, _AUDIT_E), jnp.bool_),
+        "seeds": s((256,), jnp.int32),
+    }
+
+
+def _register_one_engines() -> None:
+    from holo_tpu.ops.spf_engine import _ONE_ENGINES
+
+    for eng in sorted(_ONE_ENGINES):
+        _register_kernel(
+            f"spf.one.{eng}",
+            builder=(
+                # The jit lives inside an inert audit thunk: it is
+                # built at most once per engine, when the HL3xx audit
+                # arms — never on the dispatch path this rule guards.
+                # holo-lint: disable=HL103
+                lambda e=eng: jax.jit(
+                    lambda g, r, m, _e=e: __import__(
+                        "holo_tpu.ops.spf_engine", fromlist=["_ONE_ENGINES"]
+                    )._ONE_ENGINES[_e](g, r, m, None)
+                )
+            ),
+            specs=lambda: (
+                lambda a: (a["g"], a["root"], a["mask"])
+            )(_audit_specs()),
+            buckets=4,  # engine picked per jit; shapes ride the resident
+        )
+
+
+_register_one_engines()
+
+_register_kernel(
+    "spf.whatif.batch",
+    builder=lambda: jax.jit(
+        lambda g, r, ms: spf_whatif_batch(g, r, ms, None, engine="seq")
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["masks"])
+    )(_audit_specs()),
+    buckets=16,  # pow2 scenario-lane pads per shape
+)
+
+_register_kernel(
+    "spf.multiroot",
+    builder=lambda: jax.jit(lambda g, rs, m: spf_multiroot(g, rs, m, None)),
+    specs=lambda: (
+        lambda a: (a["g"], a["roots"], a["mask"])
+    )(_audit_specs()),
+    buckets=16,
+)
+
+_register_kernel(
+    "spf.one.incremental",
+    builder=lambda: jax.jit(
+        lambda g, r, prev, seeds: spf_one_incremental(g, r, prev, seeds, None),
+        donate_argnums=(2,),
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["sp"], a["seeds"])
+    )(_audit_specs()),
+    donate=(2,),
+    buckets=16,  # pow2 seed-row pads per shape
+)
+
+_register_kernel(
+    "spf.one.multipath.k2",
+    builder=lambda: jax.jit(
+        lambda g, r, m: spf_one_multipath(g, r, 2, m, None)
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["mask"])
+    )(_audit_specs()),
+    buckets=4,  # kp collapses onto {1, 2, 4, 8}
+)
+
+_register_kernel(
+    "spf.multipath.batch.k2",
+    builder=lambda: jax.jit(
+        lambda g, r, ms: spf_multipath_batch(g, r, ms, 2, None)
+    ),
+    specs=lambda: (
+        lambda a: (a["g"], a["root"], a["masks"])
+    )(_audit_specs()),
+    buckets=32,  # kp x scenario-lane buckets
+)
+
+_register_kernel(
+    "spf.one.incremental.multipath.k2",
+    builder=lambda: jax.jit(
+        lambda g, r, prev, np_p, aw_p, seeds: spf_one_incremental_multipath(
+            g, r, prev, np_p, aw_p, seeds, 2, None
+        ),
+        donate_argnums=(2, 3, 4),
+    ),
+    specs=lambda: (
+        lambda a: (
+            a["g"], a["root"], a["sp"],
+            a["mp"].npaths, a["mp"].nh_weights, a["seeds"],
+        )
+    )(_audit_specs()),
+    donate=(2, 3, 4),
+    buckets=32,
+)

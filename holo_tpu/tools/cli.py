@@ -700,7 +700,9 @@ def cmd_lint(args) -> int:
                 file=sys.stderr,
             )
             return 2
-        mismatches = self_check(paths, root=repo_root)
+        mismatches = self_check(
+            paths, root=repo_root, audit=not args.no_audit
+        )
         if mismatches:
             for m in mismatches:
                 print(f"cache self-check: {m}", file=sys.stderr)
@@ -719,6 +721,18 @@ def cmd_lint(args) -> int:
         for err in result.parse_errors:
             print(f"parse error: {err}", file=sys.stderr)
         return 2
+
+    # The HL3xx jaxpr kernel audit joins the gate on the default
+    # full-package lint only: an ad-hoc `lint some/path` checks files,
+    # not compiled kernel contracts.  Audit findings merge into the
+    # same baseline/suppression/severity machinery as the AST rules.
+    audit = None
+    if not args.paths and not args.no_audit:
+        from holo_tpu.analysis import run_audit_cached
+
+        audit = run_audit_cached(repo_root, no_cache=args.no_cache)
+        result.findings.extend(audit.findings)
+        result.suppressed.extend(audit.suppressed)
 
     stale_suppressions = (
         audit_suppressions(result) if args.check_suppressions else []
@@ -749,7 +763,8 @@ def cmd_lint(args) -> int:
             # Bump schema_version whenever a field is added/renamed so
             # the sentinel ledger (BENCH observatory) can gate its
             # parser instead of silently misreading lint telemetry.
-            "schema_version": 2,
+            # v3: adds the "audit" block (HL3xx jaxpr kernel audit).
+            "schema_version": 3,
             "files_checked": result.files_checked,
             "files_cached": result.files_cached,
             # Wall seconds per rule id (whole run) — the ledger tracks
@@ -757,6 +772,20 @@ def cmd_lint(args) -> int:
             "rule_seconds": {
                 k: round(v, 6)
                 for k, v in sorted(result.rule_seconds.items())
+            },
+            # HL3xx jaxpr kernel audit telemetry: per-kernel lowering
+            # wall seconds (0.0 for cache-replayed kernels) so the
+            # ledger can track audit cost as the registry grows.  None
+            # when the audit did not run (--no-audit or explicit paths).
+            "audit": None if audit is None else {
+                "kernels_checked": audit.kernels_checked,
+                "kernels_cached": audit.kernels_cached,
+                "skipped": sorted(audit.skipped),
+                "device_count": audit.device_count,
+                "kernel_seconds": {
+                    k: round(v, 6)
+                    for k, v in sorted(audit.kernel_seconds.items())
+                },
             },
             "stale_suppressions": stale_suppressions,
             "findings": [
@@ -797,6 +826,22 @@ def cmd_lint(args) -> int:
             f"{len(new_warns)} new warning(s), {n_base} baselined, "
             f"{len(result.suppressed)} suppressed"
         )
+        if audit is not None:
+            a_cached = (
+                f" ({audit.kernels_cached} cached)"
+                if audit.kernels_cached
+                else ""
+            )
+            a_skip = (
+                f", {len(audit.skipped)} skipped (no mesh)"
+                if audit.skipped
+                else ""
+            )
+            print(
+                f"holo-lint: audit {audit.kernels_checked} "
+                f"kernel(s){a_cached} on {audit.device_count} "
+                f"device(s){a_skip}"
+            )
         if stale_suppressions:
             print(
                 f"holo-lint: {len(stale_suppressions)} stale "
@@ -952,6 +997,11 @@ def main(argv=None) -> int:
         "--self-check", action="store_true",
         help="run cached + cold scans and fail loudly (exit 2) if the "
              "cache replay diverges from the full scan",
+    )
+    s.add_argument(
+        "--no-audit", action="store_true",
+        help="skip the HL3xx jaxpr kernel audit (the abstract CPU "
+             "lowering of every registered jit seam)",
     )
     s.set_defaults(fn=cmd_lint)
     args = ap.parse_args(argv)

@@ -126,7 +126,7 @@ def test_cli_gate_exits_clean_and_second_run_rides_the_cache():
     second = run_gate("--json")
     assert second.returncode == 0, second.stdout + second.stderr
     a, b = _json.loads(first.stdout), _json.loads(second.stdout)
-    assert b["schema_version"] == 2
+    assert b["schema_version"] == 3
     assert b["files_cached"] >= 0.9 * b["files_checked"], (
         b["files_cached"],
         b["files_checked"],
@@ -134,3 +134,11 @@ def test_cli_gate_exits_clean_and_second_run_rides_the_cache():
     assert a["findings"] == b["findings"]
     assert a["stale_suppressions"] == b["stale_suppressions"] == []
     assert b["rule_seconds"], "per-rule timing missing from JSON report"
+    # The HL3xx jaxpr audit joins the default gate: the second run must
+    # replay every kernel from the per-kernel audit cache.
+    assert b["audit"] is not None, "audit block missing from JSON report"
+    assert b["audit"]["kernels_checked"] >= 30
+    assert b["audit"]["kernels_cached"] == b["audit"]["kernels_checked"]
+    assert a["audit"]["kernel_seconds"].keys() == (
+        b["audit"]["kernel_seconds"].keys()
+    )

@@ -1529,11 +1529,17 @@ def test_hl205_out_of_scope_module_is_ignored():
 
 def test_soak_tier_is_empty():
     # The severity-tier contract: HL205 finished its soak in ISSUE 16,
-    # so NO rule ships at warn; adding a new soak must edit this test.
+    # so no AST rule ships at warn.  The ISSUE 18 jaxpr-audit rules
+    # soak their advisory tiers (dtype widening, bucket budget, fence
+    # realization) at warn; the donation and host-leak proofs (HL301,
+    # HL302) gate at error from birth.  Adding or promoting a soak
+    # must edit this test.
     from holo_tpu.analysis import all_rules
 
     soak = {r.id for r in all_rules() if r.severity == "warn"}
-    assert soak == set()
+    assert soak == {"HL303", "HL304", "HL305"}
+    errors = {r.id for r in all_rules() if r.severity == "error"}
+    assert {"HL301", "HL302"} <= errors
 
 
 # -- suppression audit (ISSUE 14) ---------------------------------------

@@ -1,19 +1,32 @@
 #!/bin/sh
 # holo-lint pre-commit gate: JAX hot-path hazards + daemon lock
-# discipline, ratcheted against holo_tpu/analysis/baseline.json.
+# discipline + the HL3xx jaxpr kernel audit, ratcheted against
+# holo_tpu/analysis/baseline.json.
 #
 # Usage:
 #   tools/lint.sh            # gate (exit 0 clean, 1 new findings or
 #                            #       stale suppressions)
-#   tools/lint.sh --json     # machine-readable report (schema_version 2)
+#   tools/lint.sh --json     # machine-readable report (schema_version 3)
 #   tools/lint.sh --list-rules
-#   tools/lint.sh --no-cache # force a full scan
+#   tools/lint.sh --no-cache # force a full scan + full kernel re-lowering
+#   tools/lint.sh --no-audit # AST rules only, skip the kernel audit
+#
+# Beside the AST rules (HL1xx/HL2xx), the default gate abstractly
+# lowers every registered jit seam on CPU and proves its contracts on
+# the compiled IR (HL3xx):
+#   HL301 (error) declared donation absent from input_output_aliases
+#   HL302 (error) host callback/transfer primitive inside a kernel
+#   HL303 (warn)  dtype widening beyond the declared discipline
+#   HL304 (warn)  unbounded compile-signature bucket budget
+#   HL305 (warn)  declared sharding fence absent from the jaxpr
 #
 # The gate audits suppressions by default (--check-suppressions): a
 # `# holo-lint: disable=` comment whose rule no longer fires there is
 # rot and fails the gate.  Repeat runs on an unchanged tree replay the
-# incremental cache (.holo_lint_cache.json, gitignored); the in-pytest
-# arm (tests/test_lint_repo_clean.py) self-checks the cache against a
+# incremental caches (.holo_lint_cache.json and .holo_audit_cache.json,
+# both gitignored; the audit cache is per-kernel, so editing one seam
+# re-lowers only its kernels); the in-pytest arm
+# (tests/test_lint_repo_clean.py) self-checks both caches against a
 # cold scan every run, so a divergent replay fails tier-1 loudly.
 #
 # Wire as a pre-commit hook with:
