@@ -171,6 +171,19 @@ class PipelineConfig:
     # Versioned on-disk tuner table (restarts don't re-learn); None
     # keeps the table in memory only.
     tuner_cache: str | None = None
+    # Survivability plane (ISSUE 19).  Default relative deadline
+    # (seconds) stamped onto advisory what-if tickets — expired batches
+    # are shed at dequeue; None = advisory work never expires.
+    advisory_deadline: float | None = None
+    # Hung-dispatch watchdog: a supervised sentinel abandons a
+    # launch/finish phase that overruns max(site-p99 × multiplier,
+    # floor) — the ticket is served from the bit-identical scalar
+    # fallback, the breaker escalates, and the worker respawns under
+    # the Supervisor RestartPolicy.  Off by default (the stamps cost
+    # nothing while disarmed, but a hang budget is policy).
+    watchdog: bool = False
+    watchdog_multiplier: float = 4.0
+    watchdog_floor: float = 5.0
 
 
 @dataclass
@@ -327,6 +340,22 @@ class DaemonConfig:
                             f"integer, got {v!r}"
                         )
                     setattr(cfg.pipeline, key, v)
+            cfg.pipeline.watchdog = p.get("watchdog", False)
+            for key, toml_key in (
+                ("advisory_deadline", "advisory-deadline"),
+                ("watchdog_multiplier", "watchdog-multiplier"),
+                ("watchdog_floor", "watchdog-floor"),
+            ):
+                if toml_key in p:
+                    v = p[toml_key]
+                    if isinstance(v, bool) or not isinstance(
+                        v, (int, float)
+                    ) or v <= 0:
+                        raise ValueError(
+                            f"[pipeline] {toml_key} must be a positive "
+                            f"number, got {v!r}"
+                        )
+                    setattr(cfg.pipeline, key, float(v))
         if "runtime" in raw:
             iso = raw["runtime"].get("isolation")
             if iso is not None:

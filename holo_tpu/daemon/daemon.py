@@ -244,6 +244,20 @@ class Daemon:
                 ),
                 name=f"{self._p}supervisor",
             ).install(self.loop)
+            # Dispatch survivability (ISSUE 19): the process pipeline's
+            # worker thread and the hung-dispatch sentinel ride the
+            # same RestartPolicy as the protocol pumps (watch_pump
+            # parity) — a worker death from any cause respawns under
+            # backoff with the queued tickets intact.
+            from holo_tpu.pipeline import process_pipeline
+            from holo_tpu.resilience.watchdog import process_watchdog
+
+            pipe = process_pipeline()
+            if pipe is not None and not pipe.closed:
+                self.supervisor.watch_worker(pipe, "pipeline")
+            wd = process_watchdog()
+            if wd is not None:
+                self.supervisor.watch_worker(wd, wd.name)
 
     # -- preemptive instance placement ([runtime] isolation = "threaded")
 
@@ -649,13 +663,35 @@ def main(argv=None):
                 tuner.stats()["buckets"],
             )
         if cfg.pipeline.enabled:
-            _pipeline.configure_process_pipeline(
-                depth=cfg.pipeline.depth, capacity=cfg.pipeline.queue
+            _pipe = _pipeline.configure_process_pipeline(
+                depth=cfg.pipeline.depth, capacity=cfg.pipeline.queue,
+                advisory_deadline=cfg.pipeline.advisory_deadline,
             )
             log.info(
-                "async dispatch pipeline armed (depth=%d queue=%d)",
+                "async dispatch pipeline armed (depth=%d queue=%d "
+                "advisory-deadline=%s)",
                 cfg.pipeline.depth, cfg.pipeline.queue,
+                cfg.pipeline.advisory_deadline,
             )
+            if cfg.pipeline.watchdog:
+                # Hung-dispatch sentinel ([pipeline] watchdog, ISSUE
+                # 19): budgets learned from the observatory's p99
+                # sketches, floor-clamped while sites are cold.
+                from holo_tpu.resilience.watchdog import (
+                    configure_process_watchdog,
+                )
+
+                configure_process_watchdog(
+                    _pipe,
+                    multiplier=cfg.pipeline.watchdog_multiplier,
+                    floor=cfg.pipeline.watchdog_floor,
+                )
+                log.info(
+                    "dispatch watchdog armed (multiplier=%.1f "
+                    "floor=%.1fs)",
+                    cfg.pipeline.watchdog_multiplier,
+                    cfg.pipeline.watchdog_floor,
+                )
     from holo_tpu.daemon import hardening
 
     lock_fd = None

@@ -7,7 +7,12 @@ The execution layer between the protocol actors and the device:
   readback across consecutive SPF/FRR dispatches, with strict
   per-(uid, root) ordering, what-if coalescing, breaker-open skip, and
   the DeltaPath donation ownership handoff (depth-2 double buffering,
-  one in-flight entry per key).
+  one in-flight entry per key).  The dispatch survivability plane
+  (ISSUE 19) rides the same queue: class-aware priority admission
+  (correctness > advisory > background), deadline-aware graded
+  load-shedding, the hung-dispatch watchdog hooks
+  (:mod:`holo_tpu.resilience.watchdog`), and supervised worker
+  respawn (``Supervisor.watch_worker``).
 - :mod:`holo_tpu.pipeline.tuner` — measured per-(V, E, batch, mesh)
   shape-bucket engine selection from compile-time ``cost_analysis()``
   priors + dispatch-wall medians, persisted to a versioned table

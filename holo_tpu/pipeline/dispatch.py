@@ -32,15 +32,52 @@ device, in the spirit of DeltaPath's dataflow pipelining
   paid the full scalar re-run just to produce advisory output nobody
   was owed.
 
-Chaos seam: the async dispatch closures run
-``faults.crashpoint("pipeline.dispatch")`` inside the breaker guard, so
-a seeded plan can fail pipelined dispatches mid-storm and the scalar
-fallback must keep FIBs bit-identical (tests/test_pipeline.py).
+The survivability plane (ISSUE 19) hardens this queue into something a
+serving system can stand on:
+
+- **priority admission** — every ticket carries a class from
+  :data:`holo_tpu.resilience.overload.CLASSES` (``correctness`` >
+  ``advisory`` > ``background``).  The dequeue is class-aware (lowest
+  rank first, FIFO within a rank), so FIB-feeding SPF/FRR work never
+  queues behind what-if/twin batches; a FULL queue sheds
+  lowest-class-first instead of blocking the submitting actor.
+  ``correctness`` is NEVER shed — it keeps the bounded-blocking
+  contract exactly as before;
+- **deadline-aware shedding** — advisory tickets may carry a
+  submit-time deadline and are dropped at dequeue once expired (an
+  hour-old what-if batch is not owed a dispatch).  Sheds land in
+  ``holo_pipeline_shed_total{class,reason}``, a flight event, and the
+  critical-path ledger's ``shed`` disposition;
+- **hung-dispatch watchdog hooks** — when a
+  :class:`holo_tpu.resilience.watchdog.DispatchWatchdog` is armed, the
+  worker stamps each in-flight launch/finish phase
+  (``_begin_phase``/``_end_phase``); the sentinel may
+  :meth:`DispatchPipeline.abandon_active` an overrunning phase — the
+  wedged thread is disowned (it exits at its next ownership check),
+  the per-key donation token is released through the
+  ``consumes_donated`` handoff seam, and the ticket is served from its
+  bit-identical scalar fallback while a fresh worker respawns
+  (``respawn()``, supervised via ``Supervisor.watch_worker`` parity
+  with ``watch_pump``);
+- **transient-retry taxonomy** — ``_guarded_launch`` grants
+  transient-classified device errors
+  (:func:`holo_tpu.resilience.overload.is_transient`) one
+  jittered-backoff retry BEFORE the breaker counts a strike;
+  deterministic errors go straight to the fallback as before.
+
+Chaos seams: the async dispatch closures run
+``faults.crashpoint("pipeline.dispatch")`` inside the breaker guard;
+the worker additionally traverses ``faults.killpoint("pipeline.worker")``
+(thread death → supervised respawn) and
+``faults.hangpoint("pipeline.launch"/"pipeline.finish")`` (wedge →
+watchdog) — every arm must keep correctness FIB digests bit-identical
+to the unfaulted control (tests/test_pipeline.py, tests/test_overload.py).
 
 Everything lands in the ``holo_pipeline_*`` metric family: queue depth,
-in-flight count, per-kind dispatch counters, coalesced/skipped tallies,
-caller wait time, and the measured overlap ratio (device-in-flight
-seconds that ran while the worker was free to do other host work).
+in-flight count, per-kind dispatch counters, coalesced/skipped/shed
+tallies, worker respawns, caller wait time, and the measured overlap
+ratio (device-in-flight seconds that ran while the worker was free to
+do other host work).
 """
 
 from __future__ import annotations
@@ -53,7 +90,9 @@ from contextlib import nullcontext
 
 from holo_tpu import telemetry
 from holo_tpu.analysis.runtime import consumes_donated
-from holo_tpu.telemetry import convergence, critpath
+from holo_tpu.resilience import faults
+from holo_tpu.resilience.overload import CLASS_RANK, CLASSES
+from holo_tpu.telemetry import convergence, critpath, flight
 
 log = logging.getLogger("holo_tpu.pipeline")
 
@@ -88,6 +127,15 @@ _OVERLAP_RATIO = telemetry.gauge(
     "holo_pipeline_overlap_ratio",
     "Fraction of device-in-flight time overlapped with other host work",
 )
+_SHED = telemetry.counter(
+    "holo_pipeline_shed_total",
+    "Tickets shed by the overload plane, by ticket class and reason",
+    ("class", "reason"),
+)
+_WORKER_RESPAWNS = telemetry.counter(
+    "holo_pipeline_worker_respawns_total",
+    "Pipeline worker threads respawned after a crash or abandoned hang",
+)
 
 
 class PipelineClosed(RuntimeError):
@@ -98,20 +146,30 @@ class PipelineTicket:
     """Completion handle for one submitted dispatch."""
 
     __slots__ = (
-        "key", "kind", "generation", "_event", "_value", "_exc",
-        "skipped", "superseded", "_pipeline", "_cbs", "_cb_lock", "eids",
+        "key", "kind", "generation", "cls", "_event", "_value", "_exc",
+        "skipped", "superseded", "shed", "_done", "_pipeline", "_cbs",
+        "_cb_lock", "eids",
     )
 
-    def __init__(self, pipeline, key, kind: str, generation: int):
+    def __init__(
+        self, pipeline, key, kind: str, generation: int,
+        cls: str = "correctness",
+    ):
         self.key = key
         self.kind = kind
         self.generation = generation
+        self.cls = cls
         self._pipeline = pipeline
         self._event = threading.Event()
         self._value = None
         self._exc: BaseException | None = None
         self.skipped = False  # breaker-open skip: never executed
         self.superseded = False  # coalesced away by a newer generation
+        self.shed = None  # overload shed reason ("capacity"/"expired")
+        # First-settler claim: a ticket may race two resolvers — the
+        # watchdog serving the scalar fallback vs the wedged worker
+        # finally unblocking — and exactly one outcome must win.
+        self._done = False
         self._cbs: list = []
         self._cb_lock = threading.Lock()
         # Causal convergence ids captured at submit (the critical-path
@@ -176,22 +234,48 @@ class PipelineTicket:
             raise self._exc
         return self._value
 
-    # pipeline-side completion
+    # pipeline-side completion (first settler wins; later attempts —
+    # e.g. a disowned wedged worker completing after the watchdog
+    # already served the fallback — are silently discarded)
+    def _claim(self) -> bool:
+        with self._cb_lock:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
     def _complete(self, value) -> None:
+        if not self._claim():
+            return
         self._value = value
         self._event.set()
         self._fire_cbs()
 
     def _fail(self, exc: BaseException) -> None:
+        if not self._claim():
+            return
         self._exc = exc
         self._event.set()
         self._fire_cbs()
 
     def _skip(self, superseded: bool = False) -> None:
+        if not self._claim():
+            return
         if superseded:
             self.superseded = True
         else:
             self.skipped = True
+        self._event.set()
+        self._fire_cbs()
+
+    def _shed(self, reason: str) -> None:
+        """Overload shed: resolved-but-never-ran, like a breaker skip
+        (``skipped`` stays the consumer-facing flag; ``shed`` carries
+        the why)."""
+        if not self._claim():
+            return
+        self.shed = reason
+        self.skipped = True
         self._event.set()
         self._fire_cbs()
 
@@ -202,16 +286,20 @@ class _Item:
     __slots__ = (
         "key", "kind", "generation", "ticket", "run", "launch", "finish",
         "coalesce", "eids", "handle", "t_launch_end", "stalled",
+        "cls", "rank", "deadline", "site", "fallback", "breaker",
+        "abandoned",
     )
 
     def __init__(
         self, ticket, run=None, launch=None, finish=None,
-        coalesce=False, eids=(),
+        coalesce=False, eids=(), site=None, fallback=None, breaker=None,
     ):
         self.ticket = ticket
         self.key = ticket.key
         self.kind = ticket.kind
         self.generation = ticket.generation
+        self.cls = ticket.cls
+        self.rank = CLASS_RANK[ticket.cls]
         self.run = run
         self.launch = launch
         self.finish = finish
@@ -222,6 +310,16 @@ class _Item:
         # Per-key ordering-stall latch: stamped into the critical-path
         # waterfall on the FIRST skip only (worker rescans are routine).
         self.stalled = False
+        # Survivability plane (ISSUE 19): absolute expiry (pipeline
+        # clock; None = no deadline), the observatory site whose p99
+        # sketches calibrate the watchdog budget, the bit-identical
+        # scalar fallback + breaker the watchdog serves/escalates on a
+        # hang, and the abandoned latch set by abandon_active.
+        self.deadline = None
+        self.site = site
+        self.fallback = fallback
+        self.breaker = breaker
+        self.abandoned = False
 
 
 class DispatchPipeline:
@@ -242,11 +340,20 @@ class DispatchPipeline:
         capacity: int = 32,
         name: str = "pipeline",
         guard=None,
+        clock=time.monotonic,
+        advisory_deadline: float | None = None,
     ):
         self.depth = max(int(depth), 1)
         self.capacity = max(int(capacity), 1)
         self.name = name
         self.guard = guard
+        # Deadline clock — consulted ONLY when a ticket actually
+        # carries a deadline (the disarmed-path identity contract:
+        # tests submit through a poisoned clock and must never trip it).
+        self._clock = clock
+        #: default relative deadline stamped onto advisory tickets
+        #: that did not pass their own (None = advisory never expires)
+        self.advisory_deadline = advisory_deadline
         self._cv = threading.Condition()
         self._queue: deque[_Item] = deque()
         self._inflight: list[_Item] = []
@@ -257,11 +364,26 @@ class DispatchPipeline:
         self._working = 0
         self._closed = False
         self._thread: threading.Thread | None = None
+        self._worker_spawned = False  # first spawn vs respawn tally
+        # Watchdog plane: (item, phase, since) stamp of the in-flight
+        # launch/finish phase — ONE tuple store/read (GIL-atomic), only
+        # while armed (_watch_clock not None); the sentinel reads it
+        # lock-free and abandon_active re-verifies under _cv.
+        self._watch_clock = None
+        self._active = None
+        # Crash seam (Supervisor.watch_worker): worker death marshals
+        # through this callback when supervised, else self-respawns.
+        self.on_worker_crash = None
         # stats (mutated under _cv or worker-only)
         self._submitted = 0
         self._completed = 0
         self._coalesced = 0
         self._skipped = 0
+        self._sheds = 0
+        self._shed_by_class: dict = {}
+        self._hangs = 0
+        self._worker_crashes = 0
+        self._worker_respawns = 0
         self._launch_seconds = 0.0
         self._finish_seconds = 0.0
         self._overlap_seconds = 0.0
@@ -281,6 +403,11 @@ class DispatchPipeline:
         generation: int = 0,
         coalesce: bool = False,
         skip_when_open=None,
+        cls: str = "correctness",
+        deadline: float | None = None,
+        site: str | None = None,
+        fallback=None,
+        breaker=None,
     ) -> PipelineTicket:
         """Enqueue one dispatch and return its ticket.
 
@@ -290,10 +417,30 @@ class DispatchPipeline:
         what-if batch: same-(key, generation) resubmissions share the
         queued ticket, a newer generation supersedes a queued older
         one, and ``skip_when_open`` (a CircuitBreaker) short-circuits
-        the submit entirely while the circuit is open."""
+        the submit entirely while the circuit is open.
+
+        Survivability plane: ``cls`` is the priority class
+        (``correctness`` keeps bounded-blocking and is never shed;
+        ``advisory``/``background`` shed instead of blocking when the
+        queue is full).  ``deadline`` (relative seconds; advisory-only)
+        expires the ticket at dequeue — advisory tickets default to the
+        pipeline's ``advisory_deadline``.  ``site`` names the
+        observatory cost-center whose p99 sketches calibrate the
+        watchdog hang budget; ``fallback``/``breaker`` are what the
+        watchdog serves/escalates when it abandons a hung phase."""
+        if cls not in CLASS_RANK:
+            raise ValueError(
+                f"unknown ticket class {cls!r} (one of {CLASSES})"
+            )
         if (run is None) == (launch is None or finish is None):
             raise ValueError("pass run=... OR launch=.../finish=...")
-        ticket = PipelineTicket(self, key, kind, int(generation))
+        if deadline is not None and cls == "correctness":
+            # Correctness work is owed a dispatch, always — an expiry
+            # would be a silent FIB-feeding drop.
+            raise ValueError("correctness tickets cannot carry a deadline")
+        if deadline is None and cls == "advisory":
+            deadline = self.advisory_deadline
+        ticket = PipelineTicket(self, key, kind, int(generation), cls=cls)
         if skip_when_open is not None and skip_when_open.state == "open":
             # The breaker is already serving FIB-feeding dispatches from
             # the oracle; an advisory batch is not owed a scalar re-run.
@@ -304,81 +451,249 @@ class DispatchPipeline:
         item = _Item(
             ticket, run=run, launch=launch, finish=finish,
             coalesce=coalesce, eids=convergence.current(),
+            site=site, fallback=fallback, breaker=breaker,
         )
         ticket.eids = item.eids
-        with self._cv:
-            if self._closed:
-                raise PipelineClosed(self.name)
-            if coalesce:
-                for old in list(self._queue):
-                    if not (
-                        old.coalesce
-                        and old.key == key
-                        and old.kind == kind
-                    ):
-                        continue
-                    if old.generation == item.generation:
-                        # Identical work already queued: share it — the
-                        # new submit's causal events ride the queued
-                        # item from here on (their queue-wait started
-                        # now, at THIS admission).
-                        if item.eids:
-                            old.eids = tuple(
-                                dict.fromkeys(old.eids + item.eids)
-                            )
-                            old.ticket.eids = old.eids
-                            critpath.note_enqueue(item.eids)
-                        self._coalesced += 1
-                        _COALESCED.labels(reason="shared").inc()
-                        return old.ticket
-                    if old.generation < item.generation:
-                        # Stale batch nobody needs anymore.
-                        self._queue.remove(old)
-                        old.ticket._skip(superseded=True)
-                        self._coalesced += 1
-                        _COALESCED.labels(reason="superseded").inc()
-            while len(self._queue) >= self.capacity and not self._closed:
-                self._cv.wait(0.5)
-            if self._closed:
-                raise PipelineClosed(self.name)
-            self._queue.append(item)
-            self._submitted += 1
-            self._ensure_worker_locked()
-            self._cv.notify_all()
+        if deadline is not None:
+            # The ONLY clock read on the submit path — disarmed tickets
+            # (no deadline) never touch it (poisoned-clock contract).
+            item.deadline = self._clock() + float(deadline)
+        # Admission-time stamp, BEFORE the capacity gate: a submitter
+        # blocked on a full queue books that wall as ``queue_wait`` in
+        # the critical-path waterfalls (overload must be attributable),
+        # not silently inside the caller's frame.  note_enqueue is
+        # idempotent per record, so the coalesce-shared path needs no
+        # second stamp.
         critpath.note_enqueue(item.eids)
+        shed_self = False
+        victims: list = []
+        try:
+            with self._cv:
+                if self._closed:
+                    raise PipelineClosed(self.name)
+                if coalesce:
+                    for old in list(self._queue):
+                        if not (
+                            old.coalesce
+                            and old.key == key
+                            and old.kind == kind
+                        ):
+                            continue
+                        if old.generation == item.generation:
+                            # Identical work already queued: share it —
+                            # the new submit's causal events ride the
+                            # queued item from here on (their
+                            # queue-wait started now, at THIS
+                            # admission).
+                            if item.eids:
+                                old.eids = tuple(
+                                    dict.fromkeys(old.eids + item.eids)
+                                )
+                                old.ticket.eids = old.eids
+                            self._coalesced += 1
+                            _COALESCED.labels(reason="shared").inc()
+                            return old.ticket
+                        if old.generation < item.generation:
+                            # Stale batch nobody needs anymore.
+                            self._queue.remove(old)
+                            old.ticket._skip(superseded=True)
+                            self._coalesced += 1
+                            _COALESCED.labels(reason="superseded").inc()
+                while len(self._queue) >= self.capacity and not self._closed:
+                    victim = self._capacity_victim_locked(item.rank)
+                    if victim is not None:
+                        # Graded load-shedding: evict the worst-class
+                        # (oldest within it) queued ticket instead of
+                        # walling the submitter.
+                        self._queue.remove(victim)
+                        self._note_shed_locked(victim)
+                        victims.append(victim)
+                        continue
+                    if item.rank > 0:
+                        # Queue full of equal-or-better work and the
+                        # incoming ticket is sheddable: shed IT rather
+                        # than block the actor — nobody is owed a
+                        # stale advisory result.
+                        self._note_shed_locked(item)
+                        shed_self = True
+                        break
+                    # Correctness: bounded means bounded — block until
+                    # space frees or the pipeline closes (close() wakes
+                    # this wait; the recheck below raises).
+                    self._cv.wait(0.5)
+                if self._closed:
+                    raise PipelineClosed(self.name)
+                if not shed_self:
+                    self._queue.append(item)
+                    self._submitted += 1
+                    self._ensure_worker_locked()
+                    self._cv.notify_all()
+        finally:
+            # Victim tickets settle OUTSIDE the lock (done-callbacks
+            # must never run under _cv) — including on the
+            # PipelineClosed raise above.
+            for v in victims:
+                self._shed_item(v, "capacity")
+        if shed_self:
+            self._shed_item(item, "capacity")
         return ticket
+
+    def _capacity_victim_locked(self, incoming_rank: int):
+        """Worst-class victim a full queue gives up for an incoming
+        ticket of ``incoming_rank``: highest rank wins, oldest within
+        that rank; ``correctness`` (rank 0) is untouchable and a victim
+        must rank >= the incoming ticket (an equal-rank advisory yields
+        to a fresher one).  None = nothing sheddable."""
+        victim = None
+        for item in self._queue:
+            if item.rank == 0 or item.rank < incoming_rank:
+                continue
+            if victim is None or item.rank > victim.rank:
+                victim = item
+        return victim
+
+    def _note_shed_locked(self, item) -> None:
+        self._sheds += 1
+        self._shed_by_class[item.cls] = (
+            self._shed_by_class.get(item.cls, 0) + 1
+        )
+
+    def _shed_item(self, item, reason: str) -> None:
+        """Settle a shed ticket (outside _cv: fires done-callbacks)."""
+        _SHED.labels(**{"class": item.cls, "reason": reason}).inc()
+        flight.event(
+            "pipeline-shed", pipeline=self.name, dispatch=item.kind,
+            cls=item.cls, reason=reason,
+        )
+        critpath.note_shed(item.eids)
+        item.ticket._shed(reason)
 
     def _ensure_worker_locked(self) -> None:
         if self._thread is None or not self._thread.is_alive():
+            self._spawn_worker_locked()
+
+    def _spawn_worker_locked(self) -> None:
+        # Callers hold _cv; the re-acquire is reentrant (Condition's
+        # default lock is an RLock) and makes the publication of
+        # self._thread an explicit lock-seam write.
+        with self._cv:
+            if self._worker_spawned:
+                # Anything after the first spawn is a respawn —
+                # crashed, abandoned-as-wedged, or close()-exited then
+                # resubmitted.
+                self._worker_respawns += 1
+                _WORKER_RESPAWNS.inc()
+            self._worker_spawned = True
             self._thread = threading.Thread(
-                target=self._worker, name=f"holo-pipeline-{self.name}",
+                target=self._worker_main,
+                name=f"holo-pipeline-{self.name}",
                 daemon=True,
             )
             self._thread.start()
 
+    def respawn(self) -> bool:
+        """Start a fresh worker over the surviving queue (supervised
+        restart hook — ``Supervisor.watch_worker`` duck-type — and the
+        watchdog's post-abandon revival).  No-op when a healthy owned
+        worker is already running; False once closed."""
+        with self._cv:
+            if self._closed:
+                return False
+            t = self._thread
+            if (
+                t is not None
+                and t.is_alive()
+                and t is not threading.current_thread()
+            ):
+                return True
+            self._spawn_worker_locked()
+            self._cv.notify_all()
+            return True
+
     # -- worker side ----------------------------------------------------
 
-    def _next_launchable_locked(self, stalled: list) -> _Item | None:
-        """Oldest queued item whose key is not in flight (per-key
-        ownership handoff: never two launches for one key).  Items
-        skipped because their key IS in flight are collected into
-        ``stalled`` on their first skip only (``_Item.stalled`` latch)
-        — the per-key ordering-stall stamp of the critical-path ledger."""
-        for item in self._queue:
-            if item.key not in self._inflight_keys:
-                self._queue.remove(item)
-                return item
-            if not item.stalled:
-                item.stalled = True
-                stalled.append(item)
-        return None
+    def _worker_main(self) -> None:
+        """Thread target: the loop plus the crash seam.  A worker death
+        from ANY cause (chaos killpoint, a bookkeeping bug) must never
+        strand the queued tickets — it marshals to the supervisor when
+        watched (``on_worker_crash`` → CrashNotice → RestartPolicy
+        backoff) and self-respawns immediately otherwise."""
+        try:
+            self._worker()
+        except BaseException as exc:  # noqa: BLE001 — last-resort seam;
+            # the per-item paths already contain their own failures.
+            with self._cv:
+                self._worker_crashes += 1
+                if self._thread is threading.current_thread():
+                    self._thread = None
+                self._cv.notify_all()
+            log.exception("pipeline %s worker crashed", self.name)
+            flight.event(
+                "pipeline-worker-crash", pipeline=self.name,
+                error=repr(exc),
+            )
+            cb = self.on_worker_crash
+            if cb is not None:
+                cb(exc)
+            elif not self._closed:
+                self.respawn()
+
+    def _next_launchable_locked(
+        self, stalled: list, expired: list
+    ) -> _Item | None:
+        """Best queued launchable item: lowest class rank first (FIB-
+        feeding correctness work never queues behind advisory batches),
+        FIFO within a rank, per-key ownership handoff respected (never
+        two launches for one key).  Expired-deadline items are removed
+        into ``expired`` (shed at dequeue — the hour-old what-if batch
+        is not owed a dispatch); items skipped because their key IS in
+        flight land in ``stalled`` on their first skip only (the
+        ``_Item.stalled`` latch) — the per-key ordering-stall stamp of
+        the critical-path ledger."""
+        # The worker calls this holding _cv; the re-acquire is
+        # reentrant (Condition's default lock is an RLock) and makes
+        # the queue mutations explicit lock-seam writes.
+        with self._cv:
+            best = None
+            now = None
+            for item in list(self._queue):
+                if item.deadline is not None:
+                    if now is None:
+                        now = self._clock()
+                    if now >= item.deadline:
+                        self._queue.remove(item)
+                        self._note_shed_locked(item)
+                        expired.append(item)
+                        continue
+                if item.key in self._inflight_keys:
+                    if not item.stalled:
+                        item.stalled = True
+                        stalled.append(item)
+                    continue
+                if best is None or item.rank < best.rank:
+                    best = item
+                    if best.rank == 0:
+                        break  # nothing outranks correctness
+            if best is not None:
+                self._queue.remove(best)
+            return best
 
     def _worker(self) -> None:
         while True:
+            # Chaos seam: thread-death injection (supervised-respawn
+            # coverage).  Traversed with no item in hand, so queued
+            # tickets survive the kill intact.
+            faults.killpoint("pipeline.worker")
             launch_item = None
             finish_item = None
             stalled: list = []
+            expired: list = []
             with self._cv:
+                if self._thread is not threading.current_thread():
+                    # Disowned: the watchdog abandoned this thread as
+                    # wedged (or a respawn superseded it) — a
+                    # replacement owns the queue now.
+                    return
                 if (
                     self._closed
                     and not self._queue
@@ -387,7 +702,7 @@ class DispatchPipeline:
                     self._cv.notify_all()
                     return
                 launch_item = (
-                    self._next_launchable_locked(stalled)
+                    self._next_launchable_locked(stalled, expired)
                     if len(self._inflight) < self.depth
                     else None
                 )
@@ -395,23 +710,98 @@ class DispatchPipeline:
                     if self._inflight:
                         finish_item = self._inflight.pop(0)
                         self._working += 1
-                    else:
+                    elif not expired:
                         self._cv.wait(0.5)
-                        continue
                 else:
                     self._working += 1
-            # Stall stamps run OUTSIDE the cv lock (ISSUE 17 contract:
-            # no new work under the queue lock on the dispatch thread).
+            # Stall/shed stamps run OUTSIDE the cv lock (ISSUE 17
+            # contract: no new work under the queue lock on the
+            # dispatch thread).
             for it in stalled:
                 critpath.note_stall(it.eids)
+            for it in expired:
+                self._shed_item(it, "expired")
             if launch_item is not None:
                 self._do_launch(launch_item)
-                continue
-            self._do_finish(finish_item)
+            elif finish_item is not None:
+                self._do_finish(finish_item)
 
     def _ctx(self, item: _Item):
         g = self.guard() if self.guard is not None else nullcontext()
         return g, convergence.activation(item.eids)
+
+    # -- watchdog plane -------------------------------------------------
+
+    def arm_watchdog(self, clock) -> None:
+        """Begin stamping in-flight phase walls (DispatchWatchdog)."""
+        self._watch_clock = clock
+
+    def disarm_watchdog(self) -> None:
+        self._watch_clock = None
+        self._active = None
+
+    def _begin_phase(self, item: _Item, phase: str) -> None:
+        wc = self._watch_clock
+        if wc is None:
+            return  # disarmed: zero clock reads, zero stores
+        # One tuple store (GIL-atomic); the sentinel reads it lock-free
+        # and abandon_active re-verifies the exact tuple under _cv.
+        self._active = (item, phase, wc())
+
+    def _end_phase(self, item: _Item) -> bool:
+        """True when this thread still owns ``item`` (the common case);
+        False when the watchdog abandoned the phase while we were
+        wedged — the ticket was served from the fallback, the
+        bookkeeping was settled by abandon_active, and this thread was
+        disowned (it exits at the next loop-top ownership check)."""
+        if self._watch_clock is None and not item.abandoned:
+            return True
+        with self._cv:
+            act = self._active
+            if act is not None and act[0] is item:
+                self._active = None
+            return not item.abandoned
+
+    def abandon_active(self, item, phase: str) -> bool:
+        """Watchdog verdict: give up on the in-flight ``phase`` of
+        ``item``.  False when the phase is no longer active (it
+        completed while the sentinel decided) — nothing happens then.
+        On True: the worker thread is disowned as wedged, the item's
+        bookkeeping is settled as completed-by-fallback, and — for a
+        finish-phase hang — the per-key donation token is released
+        through the audited ``consumes_donated`` seam, so a queued
+        delta of the same chain may launch on the respawned worker
+        without ever violating donation ownership (the disowned
+        thread's late completion is discarded by the ticket's
+        first-settler claim and its _end_phase result)."""
+        with self._cv:
+            act = self._active
+            if act is None or act[0] is not item or act[1] != phase:
+                return False
+            item.abandoned = True
+            self._active = None
+            self._hangs += 1
+            if (
+                self._thread is not None
+                and self._thread is not threading.current_thread()
+            ):
+                self._thread = None  # wedged: ownership check exits it
+            self._working -= 1
+            self._completed += 1
+            self._cv.notify_all()
+        if phase == "finish":
+            # The wedged finish() never re-deposited the donated
+            # tensors; the scalar fallback path touches no device
+            # residents, so ownership of the chain transfers through
+            # the same audited handoff window the healthy path uses.
+            with consumes_donated("pipeline.key.handoff"):
+                with self._cv:
+                    self._inflight_keys.discard(item.key)
+                    self._cv.notify_all()
+        _DISPATCHES.labels(kind=item.kind).inc()
+        return True
+
+    # -- phases ---------------------------------------------------------
 
     def _do_launch(self, item: _Item) -> None:
         critpath.note_launch(item.eids, "b")
@@ -419,14 +809,25 @@ class DispatchPipeline:
         try:
             guard, act = self._ctx(item)
             with guard, act:
+                self._begin_phase(item, "launch")
+                # Chaos seam: wedge-the-worker injection (watchdog
+                # coverage) — inside the phase stamp, like a real stall.
+                faults.hangpoint("pipeline.launch")
                 if item.run is not None:
-                    item.ticket._complete(item.run())
+                    value = item.run()
+                    if not self._end_phase(item):
+                        return  # abandoned: watchdog settled everything
+                    item.ticket._complete(value)
                     critpath.note_finish(item.eids, "e")
                     self._finalize(item, finished=True)
                     return
                 item.handle = item.launch()
+                if not self._end_phase(item):
+                    return  # abandoned mid-launch: drop the orphan handle
         except BaseException as exc:  # noqa: BLE001 — marshaled to the
             # caller's thread by ticket.result(); the worker survives.
+            if not self._end_phase(item):
+                return
             item.ticket._fail(exc)
             self._finalize(item, finished=True)
             return
@@ -453,6 +854,7 @@ class DispatchPipeline:
         # (launching the next entry / idle-waiting): the overlap the
         # double buffer exists to create.
         self._overlap_seconds += max(t_fs - item.t_launch_end, 0.0)
+        owned = True
         try:
             guard, act = self._ctx(item)
             # The pipeline's per-key ownership handoff: finish()
@@ -463,13 +865,22 @@ class DispatchPipeline:
             # runtime guard counts the window so tests can pin that
             # the handoff actually ran under the async path.
             with guard, act, consumes_donated("pipeline.key.handoff"):
-                item.ticket._complete(item.finish(item.handle))
-            critpath.note_finish(item.eids, "e")
+                self._begin_phase(item, "finish")
+                faults.hangpoint("pipeline.finish")
+                value = item.finish(item.handle)
+                owned = self._end_phase(item)
+                if owned:
+                    item.ticket._complete(value)
+            if owned:
+                critpath.note_finish(item.eids, "e")
         except BaseException as exc:  # noqa: BLE001 — see _do_launch
-            item.ticket._fail(exc)
+            owned = self._end_phase(item)
+            if owned:
+                item.ticket._fail(exc)
         finally:
             self._finish_seconds += time.perf_counter() - t_fs
-            self._finalize(item, finished=False)
+            if owned:
+                self._finalize(item, finished=False)
 
     def _finalize(self, item: _Item, finished: bool) -> None:
         with self._cv:
@@ -537,6 +948,11 @@ class DispatchPipeline:
                     self._overlap_seconds / denom, 4
                 ) if denom > 0 else 0.0,
                 "max-inflight-per-key": self._max_inflight_per_key,
+                "sheds": self._sheds,
+                "shed-by-class": dict(self._shed_by_class),
+                "hangs": self._hangs,
+                "worker-crashes": self._worker_crashes,
+                "worker-respawns": self._worker_respawns,
             }
 
 
@@ -631,23 +1047,42 @@ def _passthrough():
 def _guarded_launch(breaker, context: str, launch_fn) -> tuple:
     """Phase 1 of a split breaker-guarded dispatch — ONE implementation
     shared by the SPF and FRR facades so the breaker contract (admit →
-    chaos seam → passthrough abort → failure) cannot drift between
-    them.  Returns the ``(verdict, guard, handle)`` state
-    :func:`_guarded_finish` completes."""
-    from holo_tpu.resilience import faults
+    chaos seam → retry taxonomy → passthrough abort → failure) cannot
+    drift between them.  Returns the ``(verdict, guard, handle)`` state
+    :func:`_guarded_finish` completes.
+
+    Transient-retry taxonomy (ISSUE 19): a transient-classified device
+    error (:func:`overload.is_transient` — a relay blip, UNAVAILABLE, a
+    timed-out collective) gets the policy's jittered-backoff retries
+    BEFORE the breaker counts a strike; deterministic errors (a shape
+    bug reproduces identically — retrying is pure added latency) go
+    straight to the fallback verdict as before."""
+    from holo_tpu.resilience import overload
 
     guard = breaker.split(context)
     if not guard.admitted:
         return ("fallback", guard, None)
-    try:
-        faults.crashpoint("pipeline.dispatch")
-        return ("ok", guard, launch_fn())
-    except _passthrough():
-        guard.abort()
-        raise
-    except Exception as exc:  # noqa: BLE001 — breaker contract
-        guard.failure(exc)
-        return ("fallback", guard, None)
+    policy = overload.default_retry_policy()
+    attempt = 0
+    while True:
+        try:
+            faults.crashpoint("pipeline.dispatch")
+            handle = launch_fn()
+        except _passthrough():
+            guard.abort()
+            raise
+        except Exception as exc:  # noqa: BLE001 — breaker contract
+            if attempt < policy.retries and overload.is_transient(exc):
+                attempt += 1
+                time.sleep(policy.backoff(context, attempt))
+                continue
+            if attempt:
+                overload.note_retry("exhausted")
+            guard.failure(exc)
+            return ("fallback", guard, None)
+        if attempt:
+            overload.note_retry("recovered")
+        return ("ok", guard, handle)
 
 
 def _guarded_finish(state: tuple, finish_fn, fallback_fn):
@@ -748,6 +1183,11 @@ class AsyncSpfBackend:
             ticket = pipe.submit(
                 self._key(topo), "one",
                 run=lambda: inner.compute(topo, edge_mask),
+                cls="correctness", site="spf.blocked",
+                fallback=lambda: inner._noted_fallback(
+                    lambda: inner._oracle.compute(topo, edge_mask)
+                ),
+                breaker=inner.breaker,
             )
             return LazySpfResult(ticket)
         use_part = getattr(inner, "_use_partitioned", None)
@@ -758,11 +1198,18 @@ class AsyncSpfBackend:
             # run it whole on the worker.  Ordering still holds — the
             # per-key serialization covers the resident's donated
             # plane handoff exactly like the split-phase chains.
+            fallback = lambda: inner._noted_fallback(  # noqa: E731
+                lambda: inner._oracle.compute(
+                    topo, edge_mask, multipath_k=multipath_k
+                )
+            )
             ticket = pipe.submit(
                 self._key(topo), "one",
                 run=lambda: inner.compute(
                     topo, edge_mask, multipath_k=multipath_k
                 ),
+                cls="correctness", site="spf.partitioned",
+                fallback=fallback, breaker=inner.breaker,
             )
             return LazySpfResult(ticket)
         fallback = lambda: inner._noted_fallback(  # noqa: E731
@@ -781,6 +1228,8 @@ class AsyncSpfBackend:
             finish=lambda st: _guarded_finish(
                 st, inner.finish_one, fallback
             ),
+            cls="correctness", site="spf.one",
+            fallback=fallback, breaker=inner.breaker,
         )
         return LazySpfResult(ticket)
 
@@ -822,6 +1271,12 @@ class AsyncSpfBackend:
             generation=gen,
             coalesce=True,
             skip_when_open=inner.breaker,
+            # Advisory class: first shed under overload, expires at the
+            # pipeline's advisory_deadline.  No fallback — a hung
+            # advisory batch is not owed a scalar re-run (the ticket
+            # fails with WatchdogTimeout; consumers treat it like a
+            # skip).
+            cls="advisory", site="spf.whatif",
         )
 
 
@@ -876,6 +1331,11 @@ class AsyncFrrEngine:
                     topo, inner.marshal_inputs(topo)
                 ),
             ),
+            cls="correctness", site="frr.batch",
+            fallback=lambda: inner._scalar_fallback(
+                topo, inner.marshal_inputs(topo)
+            ),
+            breaker=inner.breaker,
         )
         return LazyBackupTable(ticket)
 
@@ -887,7 +1347,8 @@ _PIPELINE_LOCK = threading.Lock()
 
 
 def configure_process_pipeline(
-    depth: int = 2, capacity: int = 32, guard=None
+    depth: int = 2, capacity: int = 32, guard=None,
+    advisory_deadline: float | None = None,
 ) -> DispatchPipeline:
     """Install the process-wide dispatch pipeline (daemon boot from
     ``[pipeline]``; bench/tests call directly).  Closes any previous
@@ -897,7 +1358,8 @@ def configure_process_pipeline(
         if _PIPELINE is not None:
             _PIPELINE.close()
         _PIPELINE = DispatchPipeline(
-            depth=depth, capacity=capacity, name="process", guard=guard
+            depth=depth, capacity=capacity, name="process", guard=guard,
+            advisory_deadline=advisory_deadline,
         )
         return _PIPELINE
 

@@ -12,6 +12,16 @@ Three layers (ISSUE 4), each documented in its module:
 - :mod:`holo_tpu.resilience.faults` — seeded deterministic FaultPlan +
   injector driving the chaos e2e suite.
 
+The dispatch survivability plane (ISSUE 19) adds two more:
+
+- :mod:`holo_tpu.resilience.overload` — ticket priority classes
+  (``correctness`` > ``advisory`` > ``background``) and the
+  transient-vs-deterministic retry taxonomy consulted by the pipeline's
+  guarded launch;
+- :mod:`holo_tpu.resilience.watchdog` — the hung-dispatch sentinel
+  (observatory-learned budgets, abandon → scalar fallback → breaker
+  escalation → supervised worker respawn).
+
 Stdlib-only and import-light: nothing here touches JAX, so the daemon,
 the lint gate, and the chaos harness can import it without paying a
 device runtime import.
@@ -33,12 +43,25 @@ from holo_tpu.resilience.faults import (  # noqa: F401 — public API
     FaultyNetIo,
     InjectedFault,
     crashpoint,
+    hangpoint,
     inject,
+    killpoint,
+)
+from holo_tpu.resilience.overload import (  # noqa: F401 — public API
+    CLASS_RANK,
+    CLASSES,
+    RetryPolicy,
+    configure_retry,
+    is_transient,
 )
 from holo_tpu.resilience.supervisor import (  # noqa: F401 — public API
     RestartPolicy,
     Supervisor,
     supervisors,
+)
+from holo_tpu.resilience.watchdog import (  # noqa: F401 — public API
+    DispatchWatchdog,
+    WatchdogTimeout,
 )
 
 

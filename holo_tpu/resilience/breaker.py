@@ -268,6 +268,18 @@ class CircuitBreaker:
                 "restored (circuit closed)", self.name,
             )
 
+    def force_failure(self, cause: str, error: BaseException) -> None:
+        """Count a failure that produced no exception through a guard —
+        a hung dispatch the watchdog abandoned is a device-service
+        failure even though nothing raised.  Same FSM path as a
+        guarded exception (half-open probe released, open-at-threshold)
+        plus the fallback tally, since the caller is about to serve the
+        scalar fallback."""
+        if not self.enabled:
+            return
+        self._on_failure(cause, error)
+        _FALLBACKS.labels(breaker=self.name, cause=cause).inc()
+
     # -- the guard
 
     def call(self, primary, fallback, context: str = ""):

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import hashlib
 import random
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -79,6 +80,18 @@ class FaultPlan:
     # acceptance test slows one shape bucket and expects the warn-only
     # flag within one storm).
     dispatch_delay: dict = field(default_factory=dict)
+    # Survivability seams (ISSUE 19).  ``dispatch_hang``: {site: max
+    # seconds} — the pipeline worker WEDGES inside the hangpoint (the
+    # launch/finish phase neither returns nor raises) until either the
+    # cap elapses or ``release_hangs()`` frees it; unlike
+    # dispatch_delay the stall is meant to outlive the watchdog budget,
+    # exercising abandon→fallback→respawn rather than the observatory
+    # sentinel.  ``worker_kill``: {site: count} forced burn-down — the
+    # killpoint raises InjectedFault OUTSIDE any breaker guard, taking
+    # the worker thread itself down (the pump-kill analogue for the
+    # pipeline's supervised-respawn path).
+    dispatch_hang: dict = field(default_factory=dict)
+    worker_kill: dict = field(default_factory=dict)
 
     def rng(self, site: str) -> random.Random:
         """Independent deterministic stream for one seam site."""
@@ -94,6 +107,9 @@ class FaultInjector:
         self.injected: dict[str, int] = {}
         self._rngs: dict[str, random.Random] = {}
         self._forced = dict(plan.dispatch_fail)
+        self._hangs = dict(plan.dispatch_hang)  # site -> max seconds
+        self._kills = dict(plan.worker_kill)  # site -> remaining count
+        self._hang_release = threading.Event()
 
     def _rng(self, site: str) -> random.Random:
         rng = self._rngs.get(site)
@@ -126,6 +142,53 @@ class FaultInjector:
         if d:
             self._record(f"delay:{site}")
             time.sleep(d)
+
+    def hangpoint(self, site: str) -> None:
+        """WEDGE the calling thread at ``site`` for up to the planned
+        seconds (or until :meth:`release_hangs`).  One-shot per site:
+        the plan entry is consumed when it fires, so the respawned
+        worker's retraversal of the same site proceeds clean — the
+        hang models a wedged device call, not a poisoned site."""
+        d = self._hangs.pop(site, 0.0)
+        if d:
+            self._record(f"hang:{site}")
+            self._hang_release.wait(d)
+
+    def release_hangs(self) -> None:
+        """Free every thread currently wedged in a hangpoint (teardown
+        helper — lets tests close pipelines without waiting out the
+        full planned stall)."""
+        self._hang_release.set()
+
+    def killpoint(self, site: str) -> None:
+        """Raise straight through the calling thread's frame at
+        ``site`` — OUTSIDE any breaker guard, so the worker thread
+        itself dies (forced burn-down, like ``dispatch_fail``)."""
+        n = self._kills.get(site, 0)
+        if n > 0:
+            self._kills[site] = n - 1
+            self._record(f"kill:{site}")
+            raise InjectedFault(f"forced worker kill at {site}")
+
+    def queue_flood(self, pipeline, n: int, cls: str = "advisory", site: str = "flood"):
+        """Synthetic advisory storm: submit ``n`` instantly-completing
+        run= tickets of ``cls`` into ``pipeline``.  Returns the ticket
+        list; because nothing here is ``correctness`` class, a full
+        queue sheds rather than blocks — the caller's thread (a
+        protocol actor in storm tests) is never walled."""
+        tickets = []
+        for i in range(n):
+            tickets.append(
+                pipeline.submit(
+                    key=(site, i),
+                    kind=f"chaos.{site}",
+                    run=lambda: None,
+                    cls=cls,
+                    site=f"chaos.{site}",
+                )
+            )
+        self._record(f"flood:{site}")
+        return tickets
 
     # -- BGP TCP transport seams (utils/tcpio.py)
 
@@ -299,6 +362,18 @@ def delaypoint(site: str) -> None:
     """Dispatch-stall seam: no-op unless a plan is armed via inject()."""
     if _active is not None:
         _active.delaypoint(site)
+
+
+def hangpoint(site: str) -> None:
+    """Hung-dispatch seam: no-op unless a plan is armed via inject()."""
+    if _active is not None:
+        _active.hangpoint(site)
+
+
+def killpoint(site: str) -> None:
+    """Worker-kill seam: no-op unless a plan is armed via inject()."""
+    if _active is not None:
+        _active.killpoint(site)
 
 
 @contextmanager

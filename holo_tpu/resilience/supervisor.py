@@ -233,6 +233,29 @@ class Supervisor(Actor):
         tl.on_pump_crash = on_crash
         return name
 
+    def watch_worker(self, worker, name: str | None = None) -> str:
+        """Supervise a dispatch-plane worker THREAD (``watch_pump``
+        parity for non-EventLoop pumps): anything exposing
+        ``on_worker_crash`` (crash callback slot) + ``respawn()`` —
+        the :class:`~holo_tpu.pipeline.dispatch.DispatchPipeline`
+        worker and the hung-dispatch watchdog sentinel both qualify.
+        Modeled as pseudo-actor ``worker:<name>`` under the same
+        :class:`RestartPolicy` (backoff, crash-loop → degraded).
+        Queued tickets survive the respawn: the queue lives on the
+        pipeline object, not the thread."""
+        pname = f"worker:{name or getattr(worker, 'name', 'anon')}"
+        self._pumps[pname] = worker
+        home = self._loops[0][0] if self._loops else self.loop
+
+        def on_crash(exc, n=pname) -> None:
+            # Runs on the dying worker thread: marshal to the home loop
+            # like every other crash notice (journaled + replayable).
+            flight.event("worker-crash", worker=n, error=repr(exc))
+            home.send(self.name, CrashNotice(n, repr(exc)))
+
+        worker.on_worker_crash = on_crash
+        return pname
+
     def unadopt(self, loop: EventLoop) -> None:
         """Stop supervising ``loop`` (instance unplacement): drop the
         reference (the daemon churns instances over a long lifetime —
@@ -243,7 +266,9 @@ class Supervisor(Actor):
         for name in list(loop.actors):
             self.forget(name)
         for pname, tl in list(self._pumps.items()):
-            if tl.loop is loop:
+            # Dispatch-plane workers (watch_worker) have no .loop — they
+            # belong to no EventLoop and are never dropped by unadopt.
+            if getattr(tl, "loop", None) is loop:
                 tl.on_pump_crash = None
                 del self._pumps[pname]
                 self.forget(pname)

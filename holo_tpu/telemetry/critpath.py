@@ -241,6 +241,7 @@ class CritPathLedger:
         self._verdicts = {"host": 0, "queue": 0, "device": 0}
         self._completed = 0
         self._dropped = 0
+        self._sheds = 0
 
     # -- hot path: stamps -----------------------------------------------
 
@@ -413,6 +414,16 @@ class CritPathLedger:
             if rec is not None:
                 rec.stalls += 1
 
+    def note_shed(self, eids) -> None:
+        """Overload shed disposition: the dispatch never ran (capacity
+        shed or deadline expiry), so the open records are discarded
+        rather than decomposed — a shed event has no trigger→FIB wall.
+        The tally is its own ledger line: sheds are a load-management
+        verdict, not a tracker overflow (``dropped``)."""
+        self._sheds += 1
+        for eid in eids:
+            self._recs.pop(eid, None)
+
     # -- sentinel (reuses the dispatch observatory's machinery) ---------
 
     def _sentinel_pass(self) -> None:
@@ -495,6 +506,7 @@ class CritPathLedger:
             "open": len(self._recs),
             "completed": self._completed,
             "dropped": self._dropped,
+            "sheds": self._sheds,
             "capacity": self.capacity,
             "sketches": len(self._sketches),
             "verdicts": dict(self._verdicts),
@@ -528,6 +540,7 @@ class CritPathLedger:
         return {
             "completed": self._completed,
             "dropped": self._dropped,
+            "sheds": self._sheds,
             "verdicts": dict(self._verdicts),
             "phases": rows,
             "wall": phases.get("wall"),
@@ -615,3 +628,13 @@ def note_stall(eids) -> None:
     if cp is None or not eids:
         return
     cp.note_stall(eids)
+
+
+def note_shed(eids) -> None:
+    """Overload shed disposition (ISSUE 19).  No ``eids`` gate: a
+    synthetic flood ticket carries none, but the shed itself must
+    still land in the ledger tally."""
+    cp = _CP
+    if cp is None:
+        return
+    cp.note_shed(eids)

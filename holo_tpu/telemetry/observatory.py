@@ -535,6 +535,22 @@ class Observatory:
             "p99_s": round(sk.quantile(0.99), 9),
         }
 
+    def site_p99(self, site: str) -> float | None:
+        """Worst p99 seconds across every (stage, engine, shape-bucket,
+        kind) sketch at ``site`` — the hung-dispatch watchdog's learned
+        budget base (conservative by construction: a hang is declared
+        only well past the slowest bucket's observed tail).  None while
+        the site is cold."""
+        worst = None
+        # list() = one GIL-atomic snapshot (the cost_centers idiom).
+        for key, sk in list(self._sketches.items()):
+            if key[0] != site or not sk.count:
+                continue
+            q = sk.quantile(0.99)
+            if worst is None or q > worst:
+                worst = q
+        return worst
+
     def cost_centers(self, top: int | None = None) -> list[dict]:
         """Sketch keys ranked by total attributed seconds — where the
         dispatch time actually went, with sketch-derived quantiles."""
