@@ -85,6 +85,8 @@ STAGE_TIMEOUT = {
     "critical_path": 1800,
     "critpath_overhead": 900,
     "audit_overhead": 900,
+    "slo_storm": 1800,
+    "slo_overhead": 900,
 }
 
 
@@ -1895,6 +1897,198 @@ def stage_overload_overhead(k, B, reps=24, inner=4):
     }
 
 
+def stage_slo_storm(n_routers, events, breach_routers=40, breach_events=10):
+    """ISSUE 20 acceptance row: the SLO plane + synthetic canary over
+    the seeded storm.
+
+    Three arms: (a) a canary-free control — its production FIB digest
+    is the identity reference; (b) the same-seed storm with the SLO
+    engine armed and a canary prober riding the storm loop, its probes
+    admitted as background-class pipeline tickets — gated on the
+    production FIB digest being byte-identical to the control (the
+    canary's routes live in its own kernel), probe attribution quality
+    (unattributed fraction < 1%), and the canary burn-rate sentinel
+    staying SILENT on the healthy arm; (c) a small same-seed breach
+    sub-storm with ``FaultPlan.dispatch_delay`` wedging every canary
+    dispatch past the probe threshold — gated on the fast-window
+    sentinel firing EXACTLY once (latched) while every breaker stays
+    closed (warn-only by contract).  The armed arm's budget math seeds
+    the ledger: trigger→FIB budget remaining + canary probe p99."""
+    from dataclasses import replace
+
+    from holo_tpu import pipeline
+    from holo_tpu.resilience import health_snapshot
+    from holo_tpu.resilience.faults import FaultPlan, inject
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.spf.synth_storm import run_convergence_storm
+    from holo_tpu.telemetry import slo as slo_mod
+    from holo_tpu.telemetry.canary import CanaryProber, fib_digest
+
+    t0 = time.perf_counter()
+
+    def arm(routers, evts, canary_on=False, breach=None):
+        pipe = pipeline.configure_process_pipeline(depth=2, capacity=32)
+        eng = prober = None
+        hook = None
+        if canary_on:
+            # CPU-honest canary threshold (1 s real wall): the default
+            # 250 ms objective is calibrated for a warm production
+            # daemon, not a storm sharing one CPU with jit compiles —
+            # a loose threshold keeps the CLEAN arm's silence gate
+            # about the sentinel contract, not scheduler noise.
+            eng = slo_mod.configure(
+                check_every=0,
+                objectives=tuple(
+                    replace(o, threshold_s=1.0) if o.name == "canary" else o
+                    for o in slo_mod.default_objectives()
+                ),
+            )
+            state = {}
+
+            def hook(net, index, now):
+                if "p" not in state:
+                    state["p"] = CanaryProber(
+                        net.loop, period=2.0, deadline=2.0, warmup=10.0
+                    )
+                    state["p"].start()
+        plan = FaultPlan(seed=20, dispatch_delay=breach or {})
+        try:
+            with inject(plan):
+                _report, digest, net = run_convergence_storm(
+                    n_routers=routers, events=evts, seed=20,
+                    spf_backend=pipeline.wrap_spf_backend(
+                        TpuSpfBackend(64)
+                    ),
+                    event_hook=hook,
+                )
+                pipe.drain(timeout=60)
+        finally:
+            prober = None if not canary_on else state.get("p")
+            if prober is not None:
+                prober.stop()
+        row = {
+            "digest": digest,
+            "fib": fib_digest(net.kernel.fib),
+            "canary": prober.stats() if prober is not None else None,
+            "unattributed_fraction": (
+                prober.unattributed_fraction() if prober is not None
+                else None
+            ),
+        }
+        if eng is not None:
+            eng.checkpoint()
+            row["slo"] = eng.report()
+            st = eng.objective("canary")
+            row["canary_fires_fast"] = st.fires["fast"]
+            slo_mod.configure(False)
+        pipeline.reset_process_pipeline()
+        return row
+
+    ctl = arm(n_routers, events)
+    armed = arm(n_routers, events, canary_on=True)
+    # Breach: every canary dispatch sleeps past the 1 s probe
+    # threshold (REAL seconds — invisible to the virtual end-cuts, so
+    # the storm's causal story is untouched); small sub-storm because
+    # each wedged probe pays the sleep for real.
+    breach = arm(
+        breach_routers, breach_events, canary_on=True,
+        breach={"canary.probe": 2.5},
+    )
+    breakers_closed = not any(
+        b.get("state") == "open"
+        for b in health_snapshot().get("breakers", {}).values()
+    )
+    rows = {r["objective"]: r for r in armed["slo"]["objectives"]}
+    budget = rows["trigger-fib"]["budget_remaining"]
+    canary_p99 = (
+        rows["canary"].get("measured_ms", {}).get("p99")
+    )
+    unattr = armed["unattributed_fraction"] or 0.0
+    completed = armed["canary"]["completed"] if armed["canary"] else 0
+    return {
+        "ok": bool(
+            armed["fib"] == ctl["fib"]
+            and completed > 0
+            and unattr < 0.01
+            and armed["canary_fires_fast"] == 0
+            and breach["canary_fires_fast"] == 1
+            and breakers_closed
+        ),
+        "fib_identical_with_canary": armed["fib"] == ctl["fib"],
+        "canary_probes_completed": completed,
+        "canary_unattributed_fraction": round(unattr, 4),
+        "clean_sentinel_fires": armed["canary_fires_fast"],
+        "breach_sentinel_fires": breach["canary_fires_fast"],
+        "breach_probes": breach["canary"],
+        "breakers_closed": bool(breakers_closed),
+        "slo_budget_remaining": budget,
+        "canary_p99_ms": canary_p99,
+        "trigger_fib_row": rows["trigger-fib"],
+        "sheds": armed["slo"]["sheds"],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def stage_slo_overhead(k, B, reps=24, inner=4):
+    """ISSUE 20 overhead gate: the SLO plane's hot seams — the
+    convergence end-cut hook at ``fib_commit`` plus the sentinel check
+    cadence — armed vs disarmed on the full begin→dispatch→commit
+    cycle, with the convergence tracker armed in BOTH arms (the hook
+    only fires inside events: that is the worst case being measured).
+    Paired-median discipline (overload_overhead): alternate arm order,
+    median of per-rep deltas; ok <2%."""
+    from holo_tpu.spf.backend import TpuSpfBackend
+    from holo_tpu.telemetry import convergence
+    from holo_tpu.telemetry import slo as slo_mod
+
+    topo, masks = _make(k, B)
+    backend = TpuSpfBackend()
+    backend.compute_whatif(topo, masks)  # warm: compile + graph cache
+    convergence.configure(8192)
+
+    def sample():
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            eid = convergence.begin("lsa")
+            with convergence.activation((eid,)):
+                backend.compute_whatif(topo, masks)
+                convergence.fib_commit(eids=(eid,))
+        return (time.perf_counter() - t0) / inner
+
+    armed_times, disarmed_times = [], []
+
+    def armed():
+        slo_mod.configure(check_every=16)
+        try:
+            return sample()
+        finally:
+            slo_mod.configure(False)
+
+    def disarmed():
+        return sample()
+
+    arms = ((disarmed, disarmed_times), (armed, armed_times))
+    for rep in range(reps):
+        order = arms if rep % 2 == 0 else arms[::-1]
+        for fn, times in order:
+            times.append(fn())
+    convergence.configure(0)
+    disarmed_ms = float(np.median(disarmed_times) * 1e3)
+    armed_delta = float(
+        np.median([a - b for a, b in zip(armed_times, disarmed_times)])
+        * 1e3
+    )
+    armed_pct = armed_delta / disarmed_ms * 100.0 if disarmed_ms else 0.0
+    return {
+        "ok": bool(armed_pct < 2.0),
+        "disarmed_ms": round(disarmed_ms, 4),
+        "armed_paired_delta_ms": round(armed_delta, 5),
+        "slo_overhead_pct": round(armed_pct, 3),
+        "reps": reps,
+        "inner": inner,
+    }
+
+
 def stage_multipath_spf(k, B, reps=3):
     """ISSUE 10 acceptance row: the vectorized multipath kernel swept
     over parent-set widths k ∈ {1, 2, 4, 8} on a tied-weight random
@@ -3410,6 +3604,12 @@ _LEDGER_KEYS = (
     ("watchdog_hangs", True),
     ("correctness_p99_ratio", False),
     ("overload_overhead_pct", False),
+    # ISSUE 20: the SLO plane's acceptance scalars — trigger→FIB error
+    # budget remaining over the seeded storm, the canary's measured
+    # probe p99, and the armed-engine hot-path cost.
+    ("slo_budget_remaining", True),
+    ("canary_p99_ms", False),
+    ("slo_overhead_pct", False),
 )
 
 
@@ -3638,6 +3838,14 @@ def main() -> None:
                 k10, 32 if small else 64
             ),
             "audit_overhead": lambda: stage_audit_overhead(),
+            "slo_storm": lambda: (
+                stage_slo_storm(400, 120)
+                if small
+                else stage_slo_storm(2500, 400)
+            ),
+            "slo_overhead": lambda: stage_slo_overhead(
+                40 if small else 90, 32 if small else 64
+            ),
         }[stage]
         print(json.dumps(fn()))
         return
@@ -3808,6 +4016,17 @@ def main() -> None:
         # design (it never probes the relay), so the warm-gate and
         # cold-lowering cost rows keep full fidelity relay-down.
         extra["audit_overhead"] = _run_stage("audit_overhead", True)
+        # SLO plane + canary (ISSUE 20): the storm arms ride the
+        # virtual clock + JAX-CPU by design, every gate is FIB parity
+        # or host-side budget math, and the relay objective simply
+        # grades the relay as down — the acceptance signal keeps full
+        # fidelity while the relay is down.
+        extra["slo_storm_jaxcpu_small"] = _run_stage(
+            "slo_storm", True, cpu=True
+        )
+        extra["slo_overhead_jaxcpu_small"] = _run_stage(
+            "slo_overhead", True, cpu=True
+        )
         # Device-trace carry-over: relay down means no TPU to trace —
         # the row says so explicitly instead of probing a wedged relay.
         extra["device_trace"] = {

@@ -94,6 +94,10 @@ DISPATCH_PREFIXES = (
     # The critical-path ledger's stamp methods run on the dispatch
     # worker and the force seam (ISSUE 17): same hot-path rules.
     "holo_tpu/telemetry/critpath.py",
+    # The SLO engine's note_* seams run on the fib_commit path and the
+    # dispatch worker's shed/serve paths (ISSUE 20): same hot-path
+    # rules — grading is counter math, never a device touch.
+    "holo_tpu/telemetry/slo.py",
 )
 CONCURRENCY_PREFIXES = (
     "holo_tpu/daemon",

@@ -110,6 +110,27 @@ class TelemetryConfig:
     # None = the honest CPU defaults ("relay: not-used") until the TPU
     # relay returns with real specs.
     roofline_peaks: dict | None = None
+    # SLO plane (ISSUE 20): error budgets + multi-window burn-rate
+    # sentinels graded from the convergence end-cut / pipeline shed /
+    # relay watch streams.  Objectives come from
+    # [[telemetry.slo-objectives]] tables (name, kind, source,
+    # quantile, threshold-ms, target); empty = the shipped default set
+    # (trigger-fib latency, canary, relay availability, background
+    # delivery).  Warn-only by contract; gated < 2% by bench.py
+    # slo_overhead.
+    slo: bool = False
+    slo_objectives: tuple = ()
+    slo_fast_window: float = 3600.0
+    slo_slow_window: float = 86400.0
+    slo_fast_burn: float = 14.4
+    # Synthetic canary prober (ISSUE 20): a standing synthetic instance
+    # on the daemon loop injecting heartbeat topology deltas through
+    # the real actor→ibus→pipeline→RIB path as background-class
+    # tickets.  Requires convergence-events > 0 — probes close at
+    # fib_commit via the causal tracker.
+    canary: bool = False
+    canary_period: float = 5.0
+    canary_deadline: float = 0.25
 
 
 @dataclass
@@ -287,6 +308,61 @@ class DaemonConfig:
                         f"positive 'flops' and 'bytes', got {rp!r}"
                     )
                 cfg.telemetry.roofline_peaks = dict(rp)
+            cfg.telemetry.slo = t.get("slo", False)
+            objs = t.get("slo-objectives")
+            if objs is not None:
+                from holo_tpu.telemetry.slo import Objective
+
+                if not isinstance(objs, list):
+                    raise ValueError(
+                        "[telemetry] slo-objectives must be an array of "
+                        f"tables, got {objs!r}"
+                    )
+                try:
+                    cfg.telemetry.slo_objectives = tuple(
+                        Objective.from_config(o) for o in objs
+                    )
+                except (KeyError, TypeError, ValueError) as exc:
+                    raise ValueError(
+                        f"[telemetry] slo-objectives invalid: {exc!r}"
+                    ) from exc
+            cfg.telemetry.slo_fast_window = float(
+                t.get("slo-fast-window", 3600.0)
+            )
+            cfg.telemetry.slo_slow_window = float(
+                t.get("slo-slow-window", 86400.0)
+            )
+            cfg.telemetry.slo_fast_burn = float(t.get("slo-fast-burn", 14.4))
+            if (
+                cfg.telemetry.slo_fast_window <= 0
+                or cfg.telemetry.slo_slow_window
+                < cfg.telemetry.slo_fast_window
+                or cfg.telemetry.slo_fast_burn <= 0
+            ):
+                raise ValueError(
+                    "[telemetry] slo windows must satisfy 0 < "
+                    "slo-fast-window <= slo-slow-window and "
+                    "slo-fast-burn > 0"
+                )
+            cfg.telemetry.canary = t.get("canary", False)
+            cfg.telemetry.canary_period = float(t.get("canary-period", 5.0))
+            cfg.telemetry.canary_deadline = float(
+                t.get("canary-deadline", 0.25)
+            )
+            if cfg.telemetry.canary_period <= 0:
+                raise ValueError(
+                    "[telemetry] canary-period must be positive, got "
+                    f"{cfg.telemetry.canary_period}"
+                )
+            if (
+                cfg.telemetry.canary
+                and cfg.telemetry.convergence_events <= 0
+            ):
+                raise ValueError(
+                    "[telemetry] canary requires convergence-events > 0 "
+                    "(probes close at fib_commit through the causal "
+                    "tracker)"
+                )
         if "resilience" in raw:
             r = raw["resilience"]
             res = cfg.resilience

@@ -220,6 +220,35 @@ class Daemon:
             convergence.configure(
                 tcfg.convergence_events, clock=self.loop.clock.now
             )
+        # SLO plane ([telemetry] slo, ISSUE 20): error budgets +
+        # burn-rate sentinels graded from the convergence / shed /
+        # relay streams the subsystems above produce.  The engine keeps
+        # its default profiling clock (burn windows are REAL-time
+        # quantities even when the loop clock is virtual).
+        if tcfg.slo:
+            from holo_tpu.telemetry import slo
+
+            slo.configure(
+                True,
+                objectives=tcfg.slo_objectives or None,
+                fast_window=tcfg.slo_fast_window,
+                slow_window=tcfg.slo_slow_window,
+                fast_burn=tcfg.slo_fast_burn,
+            )
+        # Synthetic canary ([telemetry] canary, ISSUE 20): a standing
+        # probe instance on THIS loop — heartbeat topology deltas
+        # through the real dispatch path as background tickets, closing
+        # at fib_commit (config validation guarantees the convergence
+        # tracker above is armed).
+        if tcfg.canary:
+            from holo_tpu.telemetry import canary
+
+            canary.configure(
+                True,
+                loop=self.loop,
+                period=tcfg.canary_period,
+                deadline=tcfg.canary_deadline,
+            )
 
         # Actor supervision ([resilience], holo_tpu/resilience/): crashed
         # protocol actors restart under an exponential-backoff policy
@@ -480,6 +509,27 @@ class Daemon:
             obsm = _sys.modules.get("holo_tpu.telemetry.observatory")
             if obsm is not None and obsm.active() is not None:
                 obsm.active().checkpoint()
+        if self.config.telemetry.canary:
+            # Stop the heartbeat timer before the instance loops drain:
+            # a probe injected into a stopping loop would close as
+            # unattributed and pollute the availability objective's
+            # final window for no operational reason.
+            import sys as _sys
+
+            cam = _sys.modules.get("holo_tpu.telemetry.canary")
+            if cam is not None and cam.active() is not None:
+                cam.configure(False)
+        if self.config.telemetry.slo:
+            # Final budget settlement: trim windows, run every sentinel
+            # check once more, and feed the latency sketches through the
+            # observatory ledger (warn-only) so a short-lived daemon
+            # still leaves one baseline row per objective behind.
+            import sys as _sys
+
+            slm = _sys.modules.get("holo_tpu.telemetry.slo")
+            if slm is not None and slm.active() is not None:
+                slm.active().checkpoint()
+                slm.configure(False)
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=0.5)
         if getattr(self, "_gnmi_server", None) is not None:

@@ -92,7 +92,7 @@ from holo_tpu import telemetry
 from holo_tpu.analysis.runtime import consumes_donated
 from holo_tpu.resilience import faults
 from holo_tpu.resilience.overload import CLASS_RANK, CLASSES
-from holo_tpu.telemetry import convergence, critpath, flight
+from holo_tpu.telemetry import convergence, critpath, flight, slo
 
 log = logging.getLogger("holo_tpu.pipeline")
 
@@ -131,6 +131,15 @@ _SHED = telemetry.counter(
     "holo_pipeline_shed_total",
     "Tickets shed by the overload plane, by ticket class and reason",
     ("class", "reason"),
+)
+# Margins span a just-missed dequeue (sub-millisecond past expiry) to
+# an advisory that sat a whole storm behind correctness work — the
+# default log ladder covers both ends.
+_SHED_MARGIN = telemetry.histogram(
+    "holo_pipeline_shed_margin_seconds",
+    "How far past its deadline an expired ticket already was at "
+    "dequeue (near-miss sheds vs hopeless ones)",
+    ("class",),
 )
 _WORKER_RESPAWNS = telemetry.counter(
     "holo_pipeline_worker_respawns_total",
@@ -250,6 +259,11 @@ class PipelineTicket:
         self._value = value
         self._event.set()
         self._fire_cbs()
+        # Delivery-objective feed (ISSUE 20): a value delivered — even
+        # a watchdog-served fallback — is a GOOD graded event for the
+        # ticket's priority class; sheds grade bad in _shed_item.  One
+        # module-global check while the SLO plane is disarmed.
+        slo.note_served(self.cls)
 
     def _fail(self, exc: BaseException) -> None:
         if not self._claim():
@@ -558,14 +572,25 @@ class DispatchPipeline:
             self._shed_by_class.get(item.cls, 0) + 1
         )
 
-    def _shed_item(self, item, reason: str) -> None:
-        """Settle a shed ticket (outside _cv: fires done-callbacks)."""
+    def _shed_item(self, item, reason: str, margin: float | None = None) -> None:
+        """Settle a shed ticket (outside _cv: fires done-callbacks).
+        ``margin`` — seconds past the deadline at dequeue — only exists
+        for expiry sheds; capacity evictions have no deadline frame."""
         _SHED.labels(**{"class": item.cls, "reason": reason}).inc()
+        if margin is not None:
+            # Exemplar-joined to the ticket's causal events exactly like
+            # the force-wait histogram: a p99 margin is traceable back to
+            # the flight-recorder timeline of the event that missed.
+            exemplar = {"event_id": item.eids[0]} if item.eids else None
+            _SHED_MARGIN.labels(**{"class": item.cls}).observe(
+                margin, exemplar=exemplar
+            )
         flight.event(
             "pipeline-shed", pipeline=self.name, dispatch=item.kind,
             cls=item.cls, reason=reason,
         )
         critpath.note_shed(item.eids)
+        slo.note_shed(item.cls, reason)
         item.ticket._shed(reason)
 
     def _ensure_worker_locked(self) -> None:
@@ -663,7 +688,9 @@ class DispatchPipeline:
                     if now >= item.deadline:
                         self._queue.remove(item)
                         self._note_shed_locked(item)
-                        expired.append(item)
+                        # Carry the lateness out with the item: the
+                        # margin histogram observes OUTSIDE _cv.
+                        expired.append((item, now - item.deadline))
                         continue
                 if item.key in self._inflight_keys:
                     if not item.stalled:
@@ -719,8 +746,8 @@ class DispatchPipeline:
             # dispatch thread).
             for it in stalled:
                 critpath.note_stall(it.eids)
-            for it in expired:
-                self._shed_item(it, "expired")
+            for it, margin in expired:
+                self._shed_item(it, "expired", margin=margin)
             if launch_item is not None:
                 self._do_launch(launch_item)
             elif finish_item is not None:

@@ -96,6 +96,23 @@ def set_critpath_hook(ledger) -> None:
     _CP_HOOK = ledger
 
 
+# SLO-engine hook (ISSUE 20): while armed, every fib_commit close ALSO
+# grades the event's end-cut latency against the declared objectives in
+# holo_tpu.telemetry.slo.  Same contract as _CP_HOOK: one module
+# global, installed only by slo.configure, a single None check when
+# disarmed — and the clock is read ONLY under a non-None hook, so the
+# disarmed path stays byte-identical (poisoned-clock tested).
+_SLO_HOOK = None
+
+
+def set_slo_hook(engine) -> None:
+    """Install/remove the SLO engine
+    (:func:`holo_tpu.telemetry.slo.configure` is the only caller);
+    ``None`` disarms."""
+    global _SLO_HOOK
+    _SLO_HOOK = engine
+
+
 class _Event:
     """One open causal event (mutated only under the tracker lock)."""
 
@@ -263,6 +280,13 @@ class ConvergenceTracker:
             with self._lock:
                 phase = PHASE_FALLBACK if ev.fallback else PHASE_FIB
             self.observe(phase, eids=(ev.eid,), op=op, **attrs)
+            sl = _SLO_HOOK
+            if sl is not None:
+                # End-cut on the TRACKER's clock (virtual in storms) —
+                # the latency the convergence histogram itself records.
+                sl.note_endcut(
+                    ev.trigger, max(self._clock() - ev.t0, 0.0), ev.fallback
+                )
             with self._lock:
                 if self._open.pop(ev.eid, None) is not None:
                     to_close.append(ev)

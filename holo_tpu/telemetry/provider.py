@@ -163,6 +163,19 @@ class TelemetryStateProvider(NbProvider):
             cp = cpm.active()
             if cp is not None:
                 out["critical-path"] = cp.stats()
+        # SLO plane (ISSUE 20): per-objective burn/budget/sentinel
+        # state — while armed; the canary prober's attribution tallies
+        # ride the same leaf when one is standing.
+        slm = sys.modules.get("holo_tpu.telemetry.slo")
+        if slm is not None:
+            sl = slm.active()
+            if sl is not None:
+                out["slo"] = sl.stats()
+                cam = sys.modules.get("holo_tpu.telemetry.canary")
+                if cam is not None:
+                    pr = cam.active()
+                    if pr is not None:
+                        out["slo"]["canary"] = pr.stats()
         # Device-residency byte ledger (ISSUE 17 satellite): per-plane
         # resident bytes — present once any device subsystem loaded
         # (the module itself stays lazy like the leaves it sums).

@@ -27,6 +27,7 @@ faking a probe it never ran.
 from __future__ import annotations
 
 from holo_tpu import telemetry
+from holo_tpu.telemetry import slo
 
 _UP = telemetry.gauge(
     "holo_relay_up",
@@ -61,6 +62,11 @@ def note_probe(ok: bool, error: str | None = None, took_s=None) -> None:
         _state["last_took_s"] = round(float(took_s), 3)
     _UP.set(1.0 if ok else 0.0)
     _PROBES.labels(result="up" if ok else "down").inc()
+    # SLO availability feed (ISSUE 20): every holo_relay_up flip grades
+    # the relay objective — "MXU bets blocked on the relay" becomes
+    # budget arithmetic (down seconds over the compliance window)
+    # instead of a prose note.  One module-global check when disarmed.
+    slo.note_relay(bool(ok))
 
 
 def status() -> dict:

@@ -296,14 +296,55 @@ def cmd_explain(args) -> int:
     # timer as the observatory, so the waterfall section inherits the
     # byte-identical contract under the deterministic counter clock.
     cp = critpath.configure(check_every=16) if args.critical_path else None
+    # SLO plane (ISSUE 20): the engine clock is profiling.clock, so the
+    # burn/budget arithmetic inherits the byte-identical contract under
+    # the deterministic counter clock exactly like the ledgers above.
+    sl = None
+    prober = None
+    if args.slo:
+        from holo_tpu.telemetry import relay, slo
+
+        sl = slo.configure(check_every=16)
+        st = relay.status()
+        if st["status"] != "unknown":
+            # The relay availability objective grades real watch
+            # verdicts only — a process that never probed the relay
+            # reports the row as budget-unknown rather than faking one.
+            sl.note_relay(st["status"] == "up")
     tuner = tuner_mod.configure_engine_tuner()
     try:
         if args.storm:
             from holo_tpu.spf.synth_storm import run_convergence_storm
 
-            run_convergence_storm(
-                n_routers=args.storm, events=args.events, seed=args.seed
-            )
+            hook = None
+            if sl is not None:
+                from holo_tpu.telemetry import canary
+
+                state: dict = {}
+
+                def hook(net, i, now):
+                    if "prober" not in state:
+                        # Arm on the first hook tick: the storm loop
+                        # only exists once the net is built.  Virtual
+                        # heartbeats fire during every advance from
+                        # here on — deterministic probe schedule.
+                        state["prober"] = canary.CanaryProber(
+                            net.loop, period=2.0, warmup=10.0
+                        )
+                        state["prober"].start()
+
+                run_convergence_storm(
+                    n_routers=args.storm, events=args.events,
+                    seed=args.seed, event_hook=hook,
+                )
+                prober = state.get("prober")
+                if prober is not None:
+                    prober.stop()
+            else:
+                run_convergence_storm(
+                    n_routers=args.storm, events=args.events,
+                    seed=args.seed,
+                )
         else:
             _explain_workload(args.k, args.batch, args.reps, args.seed)
         # Close the run's sentinel window: seed/compare every key now
@@ -315,6 +356,11 @@ def cmd_explain(args) -> int:
         if cp is not None:
             cp.checkpoint()
             doc["critical_path"] = cp.report(top=args.top)
+        if sl is not None:
+            sl.checkpoint()
+            doc["slo"] = sl.report()
+            if prober is not None:
+                doc["slo"]["canary"] = prober.stats()
         if args.json:
             print(json.dumps(doc, sort_keys=True, indent=2))
             return 0
@@ -454,11 +500,67 @@ def cmd_explain(args) -> int:
                     for w in cpd["events"]
                 ],
             )
+        if sl is not None:
+            sld = doc["slo"]
+            w = sld["windows"]
+            print(
+                f"slo — windows: fast {w['fast_s']:g}s / slow "
+                f"{w['slow_s']:g}s, burn limits "
+                f"{w['fast_burn_limit']:g}/{w['slow_burn_limit']:g}"
+            )
+            _print_table(
+                ("objective", "kind", "events", "good", "bad",
+                 "burn_fast", "burn_slow", "budget", "fires",
+                 "measured_p99_ms"),
+                [
+                    (
+                        r["objective"], r["kind"], r["events"],
+                        r["good_fast"], r["bad_fast"],
+                        (
+                            f"{r['burn_fast']:g}"
+                            if r["burn_fast"] is not None else "-"
+                        ),
+                        (
+                            f"{r['burn_slow']:g}"
+                            if r["burn_slow"] is not None else "-"
+                        ),
+                        (
+                            f"{r['budget_remaining']:g}"
+                            if r["budget_remaining"] is not None else "-"
+                        ),
+                        r["sentinel_fires_fast"] + r["sentinel_fires_slow"],
+                        (
+                            f"{r['measured_ms']['p99']:g}"
+                            if r.get("measured_ms") else "-"
+                        ),
+                    )
+                    for r in sld["objectives"]
+                ],
+            )
+            if sld["sheds"]:
+                print(
+                    "sheds: " + ", ".join(
+                        f"{k}={v}" for k, v in sld["sheds"].items()
+                    )
+                )
+            if "canary" in sld:
+                c = sld["canary"]
+                print(
+                    f"canary: {c['probes']} probes, "
+                    f"{c['attributed']} attributed, "
+                    f"{c['unattributed']} unattributed, "
+                    f"{c['failed']} failed ({c['sheds']} shed, "
+                    f"{c['overdue']} overdue)"
+                )
         return 0
     finally:
         observatory.configure(enabled=False)
         if cp is not None:
             critpath.configure(0)
+        if sl is not None:
+            from holo_tpu.telemetry import slo
+
+            slo.configure(False)
         profiling.set_device_profiling(False)
         profiling.set_stage_timer(None)
         tuner_mod.reset_engine_tuner()
@@ -928,6 +1030,12 @@ def main(argv=None) -> int:
         "--critical-path", action="store_true",
         help="arm the critical-path ledger and append the per-phase "
              "trigger→FIB waterfall section (meaningful with --storm)",
+    )
+    s.add_argument(
+        "--slo", action="store_true",
+        help="arm the SLO plane (error budgets + burn-rate sentinels) "
+             "and append the objective table; with --storm a synthetic "
+             "canary rides the storm loop as its own objective",
     )
     s.add_argument("--json", action="store_true", help="JSON report")
     s.set_defaults(fn=cmd_explain)

@@ -381,6 +381,9 @@ def test_grpc_tls(tmp_path):
     self-signed server cert; the client trusts it as root CA."""
     import datetime
 
+    pytest.importorskip(
+        "cryptography", reason="self-signed cert generation needs pyca"
+    )
     import grpc as _grpc
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
